@@ -1,0 +1,21 @@
+"""SSZ engine: type system, serialization, merkleization.
+
+The spec-facing surface matches the reference's
+eth2spec.utils.ssz.{ssz_typing,ssz_impl} capability
+(/root/reference/tests/core/pyspec/eth2spec/utils/ssz/), implemented from
+scratch (see types.py / merkle.py / impl.py).
+"""
+from .types import (  # noqa: F401
+    SSZType, uint, uint8, uint16, uint32, uint64, uint128, uint256,
+    boolean, bit, byte, Bitvector, Bitlist, ByteVector, ByteList,
+    Vector, List, Container, Union,
+    Bytes1, Bytes4, Bytes8, Bytes20, Bytes31, Bytes32, Bytes48, Bytes96,
+)
+from .impl import (  # noqa: F401
+    serialize, hash_tree_root, uint_to_bytes, copy,
+    use_python_backend, use_tpu_backend, current_backend,
+)
+from .merkle import (  # noqa: F401
+    merkleize_chunks, mix_in_length, get_merkle_proof, is_valid_merkle_branch,
+    ZERO_HASHES,
+)

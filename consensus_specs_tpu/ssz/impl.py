@@ -1,0 +1,46 @@
+"""Free-function SSZ API, mirroring the reference's ssz_impl surface
+(/root/reference/tests/core/pyspec/eth2spec/utils/ssz/ssz_impl.py:8-37):
+serialize / hash_tree_root / uint_to_bytes / copy — plus a pluggable
+merkle backend switch so hash_tree_root can run on the JAX SHA-256 kernel.
+"""
+from __future__ import annotations
+
+from .types import SSZType, uint
+from . import merkle
+
+_ssz_backend = "python"
+
+
+def use_python_backend() -> None:
+    global _ssz_backend
+    merkle.set_level_hasher(None)
+    _ssz_backend = "python"
+
+
+def use_tpu_backend() -> None:
+    """Route merkle level hashing through the batched JAX SHA-256 kernel."""
+    global _ssz_backend
+    from ..ops.sha256 import hash_level_jax
+    merkle.set_level_hasher(hash_level_jax)
+    _ssz_backend = "tpu"
+
+
+def current_backend() -> str:
+    return _ssz_backend
+
+
+def serialize(obj: SSZType) -> bytes:
+    return obj.serialize()
+
+
+def hash_tree_root(obj) -> bytes:
+    from .types import Bytes32
+    return Bytes32(obj.hash_tree_root())
+
+
+def uint_to_bytes(n: uint) -> bytes:
+    return serialize(n)
+
+
+def copy(obj: SSZType):
+    return obj.copy()

@@ -1,0 +1,144 @@
+"""Binary SHA-256 merkleization over 32-byte chunks.
+
+Capability parity with the reference's merkleization rules
+(/root/reference/ssz/simple-serialize.md:229-257 "Merkleization" and
+/root/reference/tests/core/pyspec/eth2spec/utils/merkle_minimal.py), re-built
+as a flat chunk-array sweep so the same level-by-level loop can be dispatched
+either to hashlib (oracle) or to the batched JAX SHA-256 kernel (TPU backend,
+see consensus_specs_tpu.ops.sha256).
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Sequence
+
+ZERO_CHUNK = b"\x00" * 32
+MAX_DEPTH = 64
+
+
+def sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def hash_pair(left: bytes, right: bytes) -> bytes:
+    return hashlib.sha256(left + right).digest()
+
+
+def _build_zero_hashes() -> list[bytes]:
+    zh = [ZERO_CHUNK]
+    for _ in range(MAX_DEPTH):
+        zh.append(hash_pair(zh[-1], zh[-1]))
+    return zh
+
+
+#: ZERO_HASHES[i] = root of a fully-zero subtree of depth i
+ZERO_HASHES: list[bytes] = _build_zero_hashes()
+
+
+def next_power_of_two(n: int) -> int:
+    if n <= 1:
+        return 1
+    return 1 << (n - 1).bit_length()
+
+
+def chunk_depth(chunk_count: int) -> int:
+    """Depth of the padded tree for `chunk_count` leaves."""
+    return max(0, (next_power_of_two(chunk_count) - 1).bit_length())
+
+
+# Pluggable level-hasher.  `hash_level(data)` takes a bytes object that is a
+# concatenation of 2N chunks and returns the N parent chunks concatenated.
+# The TPU backend replaces this with a batched JAX SHA-256 compression sweep.
+def _hash_level_python(data: bytes) -> bytes:
+    out = bytearray()
+    h = hashlib.sha256
+    for i in range(0, len(data), 64):
+        out += h(data[i:i + 64]).digest()
+    return bytes(out)
+
+
+_hash_level = _hash_level_python
+
+
+def set_level_hasher(fn) -> None:
+    """Install a replacement level hasher (e.g. the JAX batched kernel)."""
+    global _hash_level
+    _hash_level = fn if fn is not None else _hash_level_python
+
+
+def merkleize_chunks(chunks: Sequence[bytes], limit: int | None = None) -> bytes:
+    """Merkle root of `chunks`, virtually padded with zero chunks.
+
+    `limit` is the maximum number of leaves the tree is sized for (list
+    merkleization); None means pad to the next power of two of len(chunks)
+    (vector merkleization).  Only the populated subtree is hashed; zero
+    subtrees come from the precomputed ZERO_HASHES table.
+    """
+    count = len(chunks)
+    if limit is not None:
+        if count > limit:
+            raise ValueError(f"chunk count {count} exceeds limit {limit}")
+        depth = chunk_depth(limit)
+    else:
+        depth = chunk_depth(count)
+
+    if count == 0:
+        return ZERO_HASHES[depth]
+
+    level = b"".join(chunks)
+    for d in range(depth):
+        n = len(level) // 32
+        if n % 2 == 1:
+            level += ZERO_HASHES[d]
+        level = _hash_level(level)
+    assert len(level) == 32
+    return level
+
+
+def mix_in_length(root: bytes, length: int) -> bytes:
+    return hash_pair(root, length.to_bytes(32, "little"))
+
+
+def mix_in_selector(root: bytes, selector: int) -> bytes:
+    return hash_pair(root, selector.to_bytes(32, "little"))
+
+
+def get_merkle_proof(chunks: Sequence[bytes], index: int,
+                     limit: int | None = None) -> list[bytes]:
+    """Merkle branch for leaf `index` in the (virtually padded) tree.
+
+    Same capability as the reference's merkle_minimal.get_merkle_proof
+    (/root/reference/tests/core/pyspec/eth2spec/utils/merkle_minimal.py).
+    """
+    count = len(chunks)
+    depth = chunk_depth(limit if limit is not None else count)
+    proof = []
+    level_chunks = list(chunks)
+    idx = index
+    for d in range(depth):
+        sib = idx ^ 1
+        if sib < len(level_chunks):
+            proof.append(level_chunks[sib])
+        else:
+            proof.append(ZERO_HASHES[d])
+        # build next level
+        nxt = []
+        for i in range(0, len(level_chunks), 2):
+            left = level_chunks[i]
+            right = level_chunks[i + 1] if i + 1 < len(level_chunks) else ZERO_HASHES[d]
+            nxt.append(hash_pair(left, right))
+        level_chunks = nxt
+        idx >>= 1
+    return proof
+
+
+def is_valid_merkle_branch(leaf: bytes, branch: Sequence[bytes], depth: int,
+                           index: int, root: bytes) -> bool:
+    """Verify a merkle branch (spec: phase0 beacon-chain.md is_valid_merkle_branch)."""
+    value = leaf
+    for i in range(depth):
+        if (index >> i) & 1:
+            value = hash_pair(branch[i], value)
+        else:
+            value = hash_pair(value, branch[i])
+    return value == root

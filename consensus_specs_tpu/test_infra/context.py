@@ -1,0 +1,9 @@
+"""Test-harness context: global defaults set by pytest CLI flags.
+
+Mirrors the reference harness's context defaults
+(/root/reference/tests/core/pyspec/eth2spec/test/context.py and
+conftest.py:30-99).  The decorator engine builds on these.
+"""
+
+DEFAULT_TEST_PRESET = "minimal"
+DEFAULT_PYTEST_FORKS = None  # None = all forks

@@ -1,0 +1,175 @@
+"""Pluggable BLS backend shim — the primary plug point of the framework.
+
+Capability parity with the reference's eth2spec.utils.bls
+(/root/reference/tests/core/pyspec/eth2spec/utils/bls.py:74-397): a
+module-global backend switched at runtime, a `bls_active` flag that lets the
+test harness stub signature checks, and the spec-facing API
+(Sign/Verify/Aggregate/FastAggregateVerify/AggregateVerify/AggregatePKs/
+SkToPk/KeyValidate) plus low-level curve ops used by KZG and Whisk.
+
+Backends:
+  * "native" — our from-scratch pure-Python BLS12-381 (crypto/bls12_381.py),
+    the correctness oracle.
+  * "tpu"    — JAX/Pallas batched verification kernels (ops/), falling back
+    to native for single ops until each kernel lands.
+"""
+from __future__ import annotations
+
+import functools
+
+# global switches (reference: bls.py:74-124)
+bls_active = True
+_backend_name = "native"
+
+STUB_SIGNATURE = b"\x11" * 96
+STUB_PUBKEY = b"\x22" * 48
+STUB_COORDINATES = (0, 0)
+
+
+def use_backend(name: str) -> None:
+    global _backend_name
+    if name not in ("native", "tpu", "fastest"):
+        raise ValueError(f"unknown bls backend {name!r}")
+    if name == "fastest":
+        name = "tpu"
+    _backend_name = name
+
+
+def use_native() -> None:
+    use_backend("native")
+
+
+def use_tpu() -> None:
+    use_backend("tpu")
+
+
+def current_backend() -> str:
+    return _backend_name
+
+
+def only_with_bls(alt_return=None):
+    """Decorator: skip the wrapped function when bls is disabled
+    (reference: bls.py:127-138)."""
+    def decorator(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not bls_active:
+                return alt_return
+            return fn(*args, **kwargs)
+        return wrapper
+    return decorator
+
+
+def _native():
+    from ..crypto import bls12_381 as n
+    return n
+
+
+# --- signature API (reference: bls.py:141-221) -----------------------------
+
+@only_with_bls(alt_return=True)
+def Verify(PK, message, signature):
+    n = _native()  # backend import errors must surface, not read as "invalid"
+    try:
+        return n.Verify(bytes(PK), bytes(message), bytes(signature))
+    except ValueError:
+        return False
+
+
+@only_with_bls(alt_return=True)
+def AggregateVerify(pubkeys, messages, signature):
+    n = _native()
+    try:
+        return n.AggregateVerify(
+            [bytes(pk) for pk in pubkeys],
+            [bytes(m) for m in messages], bytes(signature))
+    except ValueError:
+        return False
+
+
+@only_with_bls(alt_return=True)
+def FastAggregateVerify(pubkeys, message, signature):
+    n = _native()
+    try:
+        return n.FastAggregateVerify(
+            [bytes(pk) for pk in pubkeys], bytes(message), bytes(signature))
+    except ValueError:
+        return False
+
+
+@only_with_bls(alt_return=STUB_SIGNATURE)
+def Aggregate(signatures):
+    return _native().Aggregate([bytes(s) for s in signatures])
+
+
+@only_with_bls(alt_return=STUB_SIGNATURE)
+def Sign(SK, message):
+    return _native().Sign(int(SK), bytes(message))
+
+
+@only_with_bls(alt_return=STUB_PUBKEY)
+def AggregatePKs(pubkeys):
+    return _native().AggregatePKs([bytes(pk) for pk in pubkeys])
+
+
+@only_with_bls(alt_return=STUB_PUBKEY)
+def SkToPk(SK):
+    return _native().SkToPk(int(SK))
+
+
+def KeyValidate(pubkey) -> bool:
+    return _native().KeyValidate(bytes(pubkey))
+
+
+# --- low-level curve API for KZG/Whisk (reference: bls.py:224-392) ---------
+
+def add(lhs, rhs):
+    return _native().add(lhs, rhs)
+
+
+def multiply(point, scalar):
+    return _native().multiply(point, scalar)
+
+
+def neg(point):
+    return _native().neg(point)
+
+
+def multi_exp(points, integers):
+    return _native().multi_exp(points, integers)
+
+
+def pairing_check(values) -> bool:
+    return _native().pairing_check(values)
+
+
+def G1_to_bytes48(point) -> bytes:
+    return _native().G1_to_bytes48(point)
+
+
+def bytes48_to_G1(b):
+    return _native().bytes48_to_G1(bytes(b))
+
+
+def G2_to_bytes96(point) -> bytes:
+    return _native().G2_to_bytes96(point)
+
+
+def bytes96_to_G2(b):
+    return _native().bytes96_to_G2(bytes(b))
+
+
+def Z1():
+    return _native().Z1()
+
+
+def Z2():
+    return _native().Z2()
+
+
+def G1():
+    return _native().G1()
+
+
+def G2():
+    return _native().G2()

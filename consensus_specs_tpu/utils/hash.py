@@ -1,0 +1,10 @@
+"""The consensus hash function (SHA-256), matching the reference surface
+(/root/reference/tests/core/pyspec/eth2spec/utils/hash_function.py:8-9).
+"""
+import hashlib
+
+from ..ssz.types import Bytes32
+
+
+def hash(data: bytes) -> Bytes32:  # noqa: A001 - spec name
+    return Bytes32(hashlib.sha256(data).digest())

@@ -1,0 +1,41 @@
+"""Test configuration.
+
+Tests run on CPU with 8 virtual XLA devices so multi-chip sharding
+(jax.sharding.Mesh + shard_map) is exercised without TPU hardware.  These
+env vars must be set before jax initializes, hence at conftest import time.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def pytest_addoption(parser):
+    parser.addoption("--preset", action="store", default="minimal",
+                     help="preset to run spec tests with (minimal/mainnet)")
+    parser.addoption("--fork", action="store", default=None,
+                     help="restrict spec tests to a single fork")
+    parser.addoption("--disable-bls", action="store_true", default=False,
+                     help="stub out BLS signature checks for speed")
+    parser.addoption("--bls-type", action="store", default="native",
+                     help="BLS backend: native (pure python) or tpu")
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _configure(request):
+    from consensus_specs_tpu.test_infra import context
+    context.DEFAULT_TEST_PRESET = request.config.getoption("--preset")
+    context.DEFAULT_PYTEST_FORKS = (
+        [request.config.getoption("--fork")]
+        if request.config.getoption("--fork") else None)
+    from consensus_specs_tpu.utils import bls
+    if request.config.getoption("--disable-bls"):
+        bls.bls_active = False
+    bls.use_backend(request.config.getoption("--bls-type"))
+    yield

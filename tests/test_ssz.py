@@ -1,0 +1,202 @@
+"""SSZ engine unit tests: serialization round-trips, known merkle roots.
+
+Mirrors the reference's ssz_generic / ssz_static test strategy
+(SURVEY.md §4) at unit granularity.
+"""
+import hashlib
+
+import pytest
+
+from consensus_specs_tpu.ssz import (
+    uint8, uint16, uint32, uint64, uint256, boolean,
+    Bitvector, Bitlist, ByteVector, ByteList, Vector, List, Container, Union,
+    Bytes32, Bytes48,
+    serialize, hash_tree_root, merkleize_chunks, ZERO_HASHES,
+    is_valid_merkle_branch, get_merkle_proof,
+)
+
+
+def h(a, b):
+    return hashlib.sha256(a + b).digest()
+
+
+def test_uint_serialize():
+    assert serialize(uint64(5)) == (5).to_bytes(8, "little")
+    assert serialize(uint8(255)) == b"\xff"
+    assert serialize(uint256(1)) == (1).to_bytes(32, "little")
+    assert uint64.deserialize(serialize(uint64(123456789))) == 123456789
+
+
+def test_uint_overflow_raises():
+    with pytest.raises(ValueError):
+        uint8(256)
+    with pytest.raises(ValueError):
+        uint64(2**64)
+    with pytest.raises(ValueError):
+        uint64(5) - uint64(6)
+    with pytest.raises(ValueError):
+        uint64(2**63) * 2
+    assert uint64(5) + 6 == 11
+    assert isinstance(uint64(5) + 6, uint64)
+
+
+def test_uint_hash_tree_root():
+    assert hash_tree_root(uint64(5)) == (5).to_bytes(8, "little") + b"\x00" * 24
+    assert hash_tree_root(boolean(True)) == b"\x01" + b"\x00" * 31
+
+
+def test_bytes_types():
+    b = Bytes32(b"\x01" * 32)
+    assert serialize(b) == b"\x01" * 32
+    assert hash_tree_root(b) == b"\x01" * 32
+    b48 = Bytes48(b"\x02" * 48)
+    # two chunks: first 32 bytes, then 16 bytes zero-padded
+    expected = h(b"\x02" * 32, b"\x02" * 16 + b"\x00" * 16)
+    assert hash_tree_root(b48) == expected
+    with pytest.raises(ValueError):
+        Bytes32(b"\x00" * 31)
+
+
+def test_bytelist():
+    bl = ByteList[64](b"hi")
+    assert serialize(bl) == b"hi"
+    # one data chunk (padded), limit 2 chunks -> one hash level, mix length
+    data_root = h(b"hi" + b"\x00" * 30, b"\x00" * 32)
+    assert hash_tree_root(bl) == h(data_root, (2).to_bytes(32, "little"))
+    assert ByteList[64].deserialize(b"hi") == bl
+
+
+def test_vector_basic_packing():
+    v = Vector[uint64, 2]([1, 2])
+    assert serialize(v) == (1).to_bytes(8, "little") + (2).to_bytes(8, "little")
+    # 16 bytes -> a single chunk, root == padded chunk
+    assert hash_tree_root(v) == serialize(v) + b"\x00" * 16
+    v8 = Vector[uint64, 8](range(8))
+    # two chunks
+    chunk0 = b"".join(i.to_bytes(8, "little") for i in range(4))
+    chunk1 = b"".join(i.to_bytes(8, "little") for i in range(4, 8))
+    assert hash_tree_root(v8) == h(chunk0, chunk1)
+
+
+def test_list_roots():
+    t = List[uint64, 1024]
+    empty = t()
+    # limit 1024*8/32 = 256 chunks -> depth 8
+    assert hash_tree_root(empty) == h(ZERO_HASHES[8], (0).to_bytes(32, "little"))
+    one = t([7])
+    leaf = (7).to_bytes(8, "little") + b"\x00" * 24
+    node = leaf
+    for d in range(8):
+        node = h(node, ZERO_HASHES[d])
+    assert hash_tree_root(one) == h(node, (1).to_bytes(32, "little"))
+
+
+def test_list_append_limit():
+    t = List[uint8, 2]
+    x = t()
+    x.append(1)
+    x.append(2)
+    with pytest.raises(ValueError):
+        x.append(3)
+    assert serialize(x) == b"\x01\x02"
+
+
+def test_bitvector():
+    t = Bitvector[10]
+    bv = t([True] + [False] * 8 + [True])
+    assert serialize(bv) == bytes([0b00000001, 0b00000010])
+    assert t.deserialize(serialize(bv))[9] is True
+    assert hash_tree_root(bv) == bytes([1, 2]) + b"\x00" * 30
+    with pytest.raises(ValueError):
+        t.deserialize(bytes([0xFF, 0xFF]))  # padding bits set
+
+
+def test_bitlist():
+    t = Bitlist[8]
+    bl = t([True, False, True])
+    # bits 101 -> 0b101, delimiter at index 3 -> 0b1101
+    assert serialize(bl) == bytes([0b1101])
+    rt = t.deserialize(serialize(bl))
+    assert list(rt) == [True, False, True]
+    assert hash_tree_root(bl) == h(bytes([0b101]) + b"\x00" * 31,
+                                   (3).to_bytes(32, "little"))
+    empty = t()
+    assert serialize(empty) == bytes([1])
+    assert list(t.deserialize(bytes([1]))) == []
+
+
+class Checkpoint(Container):
+    epoch: uint64
+    root: Bytes32
+
+
+class VarBody(Container):
+    slot: uint64
+    data: List[uint8, 32]
+
+
+def test_container_fixed():
+    c = Checkpoint(epoch=3, root=b"\xaa" * 32)
+    assert serialize(c) == (3).to_bytes(8, "little") + b"\xaa" * 32
+    assert Checkpoint.deserialize(serialize(c)) == c
+    assert hash_tree_root(c) == h((3).to_bytes(8, "little") + b"\x00" * 24,
+                                  b"\xaa" * 32)
+    # defaults
+    d = Checkpoint()
+    assert d.epoch == 0 and d.root == Bytes32()
+
+
+def test_container_variable():
+    c = VarBody(slot=1, data=[1, 2, 3])
+    ser = serialize(c)
+    # fixed part: 8 bytes slot + 4 byte offset (=12), then data
+    assert ser == (1).to_bytes(8, "little") + (12).to_bytes(4, "little") + b"\x01\x02\x03"
+    assert VarBody.deserialize(ser) == c
+
+
+def test_container_mutation_and_copy():
+    c = VarBody(slot=1, data=[1])
+    c2 = c.copy()
+    c.slot = 9
+    c.data.append(5)
+    assert c2.slot == 1 and len(c2.data) == 1
+    assert c.slot == 9 and len(c.data) == 2
+
+
+def test_nested_list_of_containers():
+    t = List[Checkpoint, 4]
+    l = t([Checkpoint(epoch=1, root=b"\x01" * 32)])
+    r0 = hash_tree_root(l[0])
+    node = h(r0, ZERO_HASHES[0])
+    node = h(node, ZERO_HASHES[1])
+    assert hash_tree_root(l) == h(node, (1).to_bytes(32, "little"))
+    # round trip (variable-size container list uses offsets)
+    t2 = List[VarBody, 4]
+    l2 = t2([VarBody(slot=1, data=[1, 2]), VarBody(slot=2, data=[])])
+    assert t2.deserialize(serialize(l2)) == l2
+
+
+def test_union():
+    t = Union[None, uint64, Bytes32]
+    u = t(1, 5)
+    assert serialize(u) == bytes([1]) + (5).to_bytes(8, "little")
+    assert t.deserialize(serialize(u)) == u
+    assert hash_tree_root(u) == h((5).to_bytes(8, "little") + b"\x00" * 24,
+                                  (1).to_bytes(32, "little"))
+    n = t(0, None)
+    assert serialize(n) == bytes([0])
+    assert hash_tree_root(n) == h(b"\x00" * 32, (0).to_bytes(32, "little"))
+
+
+def test_merkle_proofs():
+    chunks = [bytes([i]) * 32 for i in range(5)]
+    root = merkleize_chunks(chunks, limit=8)
+    proof = get_merkle_proof(chunks, 3, limit=8)
+    assert is_valid_merkle_branch(chunks[3], proof, 3, 3, root)
+    assert not is_valid_merkle_branch(chunks[2], proof, 3, 3, root)
+
+
+def test_merkleize_limit_zero_vs_one():
+    assert merkleize_chunks([], limit=1) == b"\x00" * 32
+    assert merkleize_chunks([b"\x01" * 32], limit=1) == b"\x01" * 32
+    assert merkleize_chunks([], limit=0) == b"\x00" * 32
