@@ -1,0 +1,68 @@
+"""Benchmark driver: TPU merkleization vs CPU-oracle baseline.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Round-1 flagship workload: SSZ merkle root of a mainnet-scale chunk tree
+(2^20 chunks = 32 MiB ≈ the BeaconState validator-registry subtree at ~1M
+validators, SURVEY.md §6).  The baseline is the pure-Python/hashlib oracle
+(our stand-in for the reference's remerkleable merkleization, which is also
+hashlib-per-node underneath).  Later rounds extend this to full epoch
+state_transition with BLS on (BASELINE.md north star).
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def bench_merkle(depth: int = 20, sample_baseline_depth: int = 14):
+    import jax
+    from consensus_specs_tpu.ops import sha256 as ops_sha
+    from consensus_specs_tpu.ssz.merkle import merkleize_chunks
+
+    n = 1 << depth
+    rng = np.random.default_rng(42)
+    words = rng.integers(0, 2**32, size=(n, 8), dtype=np.uint32)
+    chunks_bytes = words.astype(">u4").tobytes()
+
+    # --- TPU path: device-resident level sweep -------------------------
+    dev_words = jax.device_put(jnp_asarray(words))
+    root_dev = ops_sha.merkle_tree_root(dev_words, depth)  # compile+warm
+    jax.block_until_ready(root_dev)
+    t0 = time.perf_counter()
+    iters = 5
+    for _ in range(iters):
+        root_dev = ops_sha.merkle_tree_root(dev_words, depth)
+    jax.block_until_ready(root_dev)
+    tpu_time = (time.perf_counter() - t0) / iters
+
+    # --- CPU oracle baseline (hashlib), measured on a subtree ----------
+    m = 1 << sample_baseline_depth
+    sub_chunks = [chunks_bytes[i * 32:(i + 1) * 32] for i in range(m)]
+    t0 = time.perf_counter()
+    cpu_root_sub = merkleize_chunks(sub_chunks)
+    cpu_time = (time.perf_counter() - t0) * (n / m)
+
+    # correctness cross-check on the subtree
+    sub_root_dev = ops_sha.merkle_root_jax(chunks_bytes[: m * 32])
+    assert sub_root_dev == cpu_root_sub, "TPU/CPU merkle roots disagree"
+
+    total_hashes = 2 * n - 1  # 2-to-1 hashes in the tree (incl. pad levels)
+    return {
+        "metric": "ssz_merkle_root_1M_chunks_hashes_per_sec",
+        "value": round(total_hashes / tpu_time, 1),
+        "unit": "sha256_2to1/s",
+        "vs_baseline": round(cpu_time / tpu_time, 2),
+    }
+
+
+def jnp_asarray(x):
+    import jax.numpy as jnp
+    return jnp.asarray(x)
+
+
+if __name__ == "__main__":
+    result = bench_merkle()
+    print(json.dumps(result))
+    sys.stdout.flush()

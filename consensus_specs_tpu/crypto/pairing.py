@@ -1,0 +1,124 @@
+"""Optimal ate pairing on BLS12-381.
+
+From-scratch implementation over the fields.py tower.  G2 points are
+untwisted into E(Fq12) via (x, y) -> (x/w^2, y/w^3) (w^6 = XI, derived from
+the tower relations), and the Miller loop runs over the bits of |z| with
+line evaluations at the G1 argument.  The final exponentiation does the
+cheap (q^6 - 1) step via conjugate/inverse and one big-integer power for
+the remainder; Frobenius-based hard-part optimization is a later round.
+
+Verified against the production KZG trusted setup: e([tau]G1, G2) ==
+e(G1, [tau]G2) for the monomial points (tests/test_bls.py).
+"""
+from __future__ import annotations
+
+from .fields import Q, R, BLS_X, Fq2, Fq6, Fq12
+from .curve import Point, Fq1
+
+# |z| bits for the Miller loop
+_ATE_LOOP = abs(BLS_X)
+
+# final exponent after the easy (q^6 - 1) step:
+#   (q^12 - 1) / r = (q^6 - 1) * (q^2 + 1) * ((q^4 - q^2 + 1) / r)
+_HARD_EXP = (Q * Q + 1) * ((Q**4 - Q * Q + 1) // R)
+
+
+def _embed_fq2(a: Fq2) -> Fq12:
+    return Fq12(Fq6(a, Fq2.zero(), Fq2.zero()), Fq6.zero())
+
+
+def _embed_fq(a: int) -> Fq12:
+    return Fq12(Fq6(Fq2(a, 0), Fq2.zero(), Fq2.zero()), Fq6.zero())
+
+
+# w   = (0, 1) in the Fq6 pair basis;  w^2 = v;  v^3 = XI
+_W = Fq12(Fq6.zero(), Fq6.one())
+_W2_INV = (_W * _W).inv()
+_W3_INV = (_W * _W * _W).inv()
+
+
+class _P12:
+    """Affine point over Fq12 (None coords = infinity)."""
+    __slots__ = ("x", "y")
+
+    def __init__(self, x: Fq12, y: Fq12):
+        self.x = x
+        self.y = y
+
+
+def _untwist(q: Point) -> _P12:
+    xa, ya = q.affine()
+    return _P12(_embed_fq2(xa) * _W2_INV, _embed_fq2(ya) * _W3_INV)
+
+
+def _line_eval(t: _P12, u: _P12, xp: Fq12, yp: Fq12) -> Fq12:
+    """Evaluate the line through T and U (or tangent at T if T==U) at P."""
+    if t.x == u.x and t.y == u.y:
+        # tangent: slope = 3x^2 / 2y
+        num = t.x.square()
+        num = num + num + num
+        den = t.y + t.y
+    elif t.x == u.x:
+        # vertical line
+        return xp - t.x
+    else:
+        num = u.y - t.y
+        den = u.x - t.x
+    slope = num * den.inv()
+    return slope * (xp - t.x) - (yp - t.y)
+
+
+def _p12_add(a: _P12, b: _P12) -> _P12:
+    if a.x == b.x and a.y == b.y:
+        num = a.x.square()
+        num = num + num + num
+        den = a.y + a.y
+    elif a.x == b.x:
+        raise ZeroDivisionError("vertical addition in miller loop")
+    else:
+        num = b.y - a.y
+        den = b.x - a.x
+    s = num * den.inv()
+    x3 = s.square() - a.x - b.x
+    y3 = s * (a.x - x3) - a.y
+    return _P12(x3, y3)
+
+
+def miller_loop(p: Point, q: Point) -> Fq12:
+    """Miller loop value f_{|z|,Q}(P); final exponentiation applied separately."""
+    if p.is_infinity() or q.is_infinity():
+        return Fq12.one()
+    xa, ya = p.affine()
+    xp, yp = _embed_fq(xa.v), _embed_fq(ya.v)
+    qt = _untwist(q)
+    t = _P12(qt.x, qt.y)
+    f = Fq12.one()
+    for bit in bin(_ATE_LOOP)[3:]:
+        f = f.square() * _line_eval(t, t, xp, yp)
+        t = _p12_add(t, t)
+        if bit == "1":
+            f = f * _line_eval(t, qt, xp, yp)
+            t = _p12_add(t, qt)
+    # z < 0: conjugate (differs from the true inverse by a norm-subfield
+    # factor, which the final exponentiation kills)
+    return f.conjugate()
+
+
+def final_exponentiation(f: Fq12) -> Fq12:
+    # easy part: f^(q^6 - 1) = conj(f) / f
+    f = f.conjugate() * f.inv()
+    # hard part (one big pow; Frobenius decomposition later)
+    return f.pow(_HARD_EXP)
+
+
+def pairing(p: Point, q: Point) -> Fq12:
+    """e(P, Q) for P in G1, Q in G2."""
+    return final_exponentiation(miller_loop(p, q))
+
+
+def pairing_check(pairs: list[tuple[Point, Point]]) -> bool:
+    """prod e(P_i, Q_i) == 1, with a single shared final exponentiation."""
+    f = Fq12.one()
+    for p, q in pairs:
+        f = f * miller_loop(p, q)
+    return final_exponentiation(f).is_one()

@@ -1,0 +1,170 @@
+"""Batched SHA-256 on TPU via JAX/XLA.
+
+The consensus workload hashes millions of fixed-size 64-byte blocks (merkle
+tree levels, shuffle rounds — see SURVEY.md §2.2 "Hash" and §7 step 1).  The
+64-byte 2-to-1 compression is a perfect TPU shape: thousands of independent
+lanes of uint32 bitwise math on the VPU, no MXU needed, no data-dependent
+control flow.  We implement the compression function over a batch axis and
+build merkle-tree reduction as a level-by-level sweep that stays on device.
+
+SHA-256 padding note: all inputs here are exactly 64 bytes, so the padding
+block is the same constant for every message — each 2-to-1 hash is exactly
+two compressions (message block, then the shared pad block).
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+# round constants (FIPS 180-4)
+_K = np.array([
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5,
+    0x3956c25b, 0x59f111f1, 0x923f82a4, 0xab1c5ed5,
+    0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174,
+    0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+    0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7,
+    0xc6e00bf3, 0xd5a79147, 0x06ca6351, 0x14292967,
+    0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+    0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3,
+    0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5,
+    0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f, 0x682e6ff3,
+    0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
+], dtype=np.uint32)
+
+_IV = np.array([
+    0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+    0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19,
+], dtype=np.uint32)
+
+# the constant padding block for a 64-byte message: 0x80, zeros, bitlen=512
+_PAD_BLOCK = np.zeros(16, dtype=np.uint32)
+_PAD_BLOCK[0] = 0x80000000
+_PAD_BLOCK[15] = 512
+
+
+def _rotr(x, n):
+    return (x >> n) | (x << (32 - n))
+
+
+def sha256_compress(state, block):
+    """One SHA-256 compression: state [..., 8] u32, block [..., 16] u32.
+
+    The message-schedule expansion and the 64 rounds run as lax.scan loops
+    (sequential by construction; the parallelism is the batch axis), which
+    keeps the XLA graph small — compile time stays flat no matter how many
+    tree levels or SPMD partitions sit on top.
+    """
+    # internal layout [word, ...batch]: scan stacks along axis 0
+    w_t = jnp.moveaxis(block, -1, 0)
+
+    def expand_step(window, _):
+        w15 = window[1]
+        w2 = window[14]
+        s0 = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> 3)
+        s1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> 10)
+        new = window[0] + s0 + window[9] + s1
+        return jnp.concatenate([window[1:], new[None]], axis=0), new
+
+    _, extra = jax.lax.scan(expand_step, w_t, None, length=48)
+    w_all = jnp.concatenate([w_t, extra], axis=0)  # [64, ...batch]
+
+    def round_step(carry, wk):
+        a, b, c, d, e, f, g, h = carry
+        w_i, k_i = wk
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + k_i + w_i
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        return (t1 + t2, a, b, c, d + t1, e, f, g), None
+
+    init = tuple(state[..., i] for i in range(8))
+    final, _ = jax.lax.scan(round_step, init, (w_all, jnp.asarray(_K)))
+    return jnp.stack(final, axis=-1) + state
+
+
+def sha256_64byte(blocks):
+    """Digest of a batch of 64-byte messages.
+
+    blocks: uint32[N, 16] (big-endian words).  Returns uint32[N, 8].
+    """
+    iv = jnp.broadcast_to(jnp.asarray(_IV), blocks.shape[:-1] + (8,))
+    mid = sha256_compress(iv, blocks)
+    pad = jnp.broadcast_to(jnp.asarray(_PAD_BLOCK), blocks.shape[:-1] + (16,))
+    return sha256_compress(mid, pad)
+
+
+@jax.jit
+def _hash_pairs_fixed(chunks):
+    n = chunks.shape[0] // 2
+    return sha256_64byte(chunks.reshape(n, 16))
+
+
+def hash_pairs(chunks):
+    """2-to-1 hash of adjacent chunk pairs: uint32[2N, 8] -> uint32[N, 8].
+
+    Batch is padded up to the next power of two so XLA compiles one kernel
+    per size bucket instead of one per distinct level size.
+    """
+    n2 = chunks.shape[0]
+    # clamp the bucket floor so the whole top of a big tree reuses one
+    # kernel; 128 wasted pair-hashes are noise next to a recompile
+    bucket = max(256, 1 << max(1, (n2 - 1).bit_length()))
+    if bucket != n2:
+        pad = jnp.zeros((bucket - n2, 8), dtype=jnp.uint32)
+        chunks = jnp.concatenate([chunks, pad], axis=0)
+    out = _hash_pairs_fixed(chunks)
+    return out[: n2 // 2]
+
+
+def merkle_tree_root(chunks, depth: int):
+    """Root of a balanced tree over uint32[2**depth, 8] chunks.
+
+    A host loop over the bucketed pair-hash keeps one cached kernel per
+    power-of-two level size (reused across all trees) instead of one giant
+    unrolled graph per depth; the data stays on device throughout.
+    """
+    level = chunks
+    for _ in range(depth):
+        level = hash_pairs(level)
+    return level[0]
+
+
+# ---------------------------------------------------------------------------
+# host-side bridges
+# ---------------------------------------------------------------------------
+
+def bytes_to_words(data: bytes) -> np.ndarray:
+    """32-byte chunks (concatenated) -> uint32[N, 8] big-endian words."""
+    return np.frombuffer(data, dtype=">u4").reshape(-1, 8).astype(np.uint32)
+
+
+def words_to_bytes(words) -> bytes:
+    return np.asarray(words).astype(">u4").tobytes()
+
+
+def hash_level_jax(data: bytes) -> bytes:
+    """Drop-in level hasher for ssz.merkle.set_level_hasher: hash the
+    concatenation of 2N chunks into N parent chunks in one device batch."""
+    words = bytes_to_words(data)
+    out = hash_pairs(jnp.asarray(words))
+    return words_to_bytes(jax.device_get(out))
+
+
+def merkle_root_jax(chunks: bytes) -> bytes:
+    """Device-resident merkle root of a power-of-two chunk array."""
+    words = bytes_to_words(chunks)
+    n = words.shape[0]
+    assert n & (n - 1) == 0, "chunk count must be a power of two"
+    depth = n.bit_length() - 1
+    root = merkle_tree_root(jnp.asarray(words), depth)
+    return words_to_bytes(jax.device_get(root))

@@ -1,0 +1,64 @@
+"""Spec registry: one cached spec instance per (fork, preset, config).
+
+The counterpart of the reference's spec_targets
+(/root/reference/tests/core/pyspec/eth2spec/test/helpers/specs.py).
+"""
+from __future__ import annotations
+
+import importlib
+import importlib.util
+
+_BUILTIN_FORKS = [
+    ("phase0", "Phase0Spec"),
+    ("altair", "AltairSpec"),
+    ("bellatrix", "BellatrixSpec"),
+    ("capella", "CapellaSpec"),
+    ("deneb", "DenebSpec"),
+    ("electra", "ElectraSpec"),
+    ("fulu", "FuluSpec"),
+    ("whisk", "WhiskSpec"),
+    ("eip7732", "Eip7732Spec"),
+    ("eip6800", "Eip6800Spec"),
+]
+
+_REGISTRY: dict = {}
+_INSTANCES: dict = {}
+_loaded = False
+
+
+def register(fork_name: str, cls) -> None:
+    _ensure_loaded()
+    _REGISTRY[fork_name] = cls
+
+
+def available_forks() -> list:
+    _ensure_loaded()
+    return list(_REGISTRY)
+
+
+def get_spec(fork_name: str, preset_name: str = "mainnet", config=None):
+    """Spec instance for (fork, preset); instances with default config are
+    cached, custom configs build fresh."""
+    _ensure_loaded()
+    if fork_name not in _REGISTRY:
+        raise KeyError(f"unknown fork {fork_name!r}; have {list(_REGISTRY)}")
+    if config is not None:
+        return _REGISTRY[fork_name](preset_name, config)
+    key = (fork_name, preset_name)
+    if key not in _INSTANCES:
+        _INSTANCES[key] = _REGISTRY[fork_name](preset_name)
+    return _INSTANCES[key]
+
+
+def _ensure_loaded() -> None:
+    global _loaded
+    if _loaded:
+        return
+    _loaded = True
+    for fork_name, class_name in _BUILTIN_FORKS:
+        # skip forks whose module doesn't exist yet; genuine import errors
+        # inside an existing module must propagate
+        if importlib.util.find_spec(f"{__name__}.{fork_name}") is None:
+            continue
+        module = importlib.import_module(f"{__name__}.{fork_name}")
+        _REGISTRY[fork_name] = getattr(module, class_name)

@@ -1,0 +1,53 @@
+"""Spec-object machinery.
+
+Each fork is a class (Phase0Spec -> AltairSpec -> ...); a *spec instance* is
+(fork class × preset × config), carrying its own SSZ container classes
+(preset values define vector/list shapes) and all spec functions as methods.
+Class inheritance gives the reference's fork-overlay semantics
+(/root/reference/pysetup/helpers.py:233 combine_spec_objects — later fork
+wins) directly in Python, with `super()` for upgrade deltas.
+"""
+from __future__ import annotations
+
+
+
+from ..config import Config, load_config, load_preset
+
+
+class BaseSpec:
+    fork: str = "base"
+
+    def __init__(self, preset_name: str = "mainnet",
+                 config: Config | None = None):
+        self.preset_name = preset_name
+        self.preset = load_preset(preset_name)
+        self.config = config if config is not None else load_config(preset_name)
+        # preset values become plain attributes (compile-time tier)
+        for k, v in self.preset.items():
+            setattr(self, k, v)
+        self._caches: dict = {}
+        self._build_constants()
+        self._build_types()
+
+    def _build_constants(self) -> None:
+        pass
+
+    def _build_types(self) -> None:
+        pass
+
+    # -- memoization across expensive pure accessors (the reference's
+    #    cache_this layer, /root/reference/pysetup/spec_builders/phase0.py:47)
+    def _cached(self, key, fn):
+        cache = self._caches
+        if key not in cache:
+            cache[key] = fn()
+        return cache[key]
+
+    def is_post(self, fork_name: str) -> bool:
+        """True if this spec is at or after the given fork."""
+        order = ["phase0", "altair", "bellatrix", "capella", "deneb",
+                 "electra", "fulu", "eip7732", "whisk", "eip6800"]
+        mro_forks = [c.fork for c in type(self).__mro__ if hasattr(c, "fork")]
+        return fork_name in mro_forks or (
+            self.fork in order and fork_name in order
+            and order.index(self.fork) >= order.index(fork_name))

@@ -1,0 +1,447 @@
+"""Phase0 LMD-GHOST fork choice.
+
+From-scratch implementation of /root/reference/specs/phase0/fork-choice.md:
+Store, get_head, on_tick/on_block/on_attestation/on_attester_slashing,
+proposer boost, unrealized-checkpoint pull-up, and the proposer-reorg
+helpers.  Mixed into Phase0Spec (methods use the spec's own accessors).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Set
+
+from ..ssz import Bytes32, hash_tree_root, uint64
+
+
+@dataclass
+class LatestMessage:
+    epoch: int
+    root: bytes
+
+
+@dataclass
+class Store:
+    time: int
+    genesis_time: int
+    justified_checkpoint: object
+    finalized_checkpoint: object
+    unrealized_justified_checkpoint: object
+    unrealized_finalized_checkpoint: object
+    proposer_boost_root: bytes
+    equivocating_indices: Set[int] = field(default_factory=set)
+    blocks: Dict[bytes, object] = field(default_factory=dict)
+    block_states: Dict[bytes, object] = field(default_factory=dict)
+    block_timeliness: Dict[bytes, bool] = field(default_factory=dict)
+    checkpoint_states: Dict[object, object] = field(default_factory=dict)
+    latest_messages: Dict[int, LatestMessage] = field(default_factory=dict)
+    unrealized_justifications: Dict[bytes, object] = field(default_factory=dict)
+
+
+class Phase0ForkChoice:
+    INTERVALS_PER_SLOT = 3
+
+    Store = Store
+    LatestMessage = LatestMessage
+
+    # ------------------------------------------------------------------
+    # store construction & time
+    # ------------------------------------------------------------------
+    def get_forkchoice_store(self, anchor_state, anchor_block) -> Store:
+        assert anchor_block.state_root == hash_tree_root(anchor_state)
+        anchor_root = hash_tree_root(anchor_block)
+        anchor_epoch = self.get_current_epoch(anchor_state)
+        justified_checkpoint = self.Checkpoint(epoch=anchor_epoch,
+                                               root=anchor_root)
+        finalized_checkpoint = self.Checkpoint(epoch=anchor_epoch,
+                                               root=anchor_root)
+        return Store(
+            time=int(anchor_state.genesis_time
+                     + self.config.SECONDS_PER_SLOT * anchor_state.slot),
+            genesis_time=int(anchor_state.genesis_time),
+            justified_checkpoint=justified_checkpoint,
+            finalized_checkpoint=finalized_checkpoint,
+            unrealized_justified_checkpoint=justified_checkpoint,
+            unrealized_finalized_checkpoint=finalized_checkpoint,
+            proposer_boost_root=Bytes32(),
+            blocks={anchor_root: anchor_block.copy()},
+            block_states={anchor_root: anchor_state.copy()},
+            checkpoint_states={justified_checkpoint: anchor_state.copy()},
+            unrealized_justifications={anchor_root: justified_checkpoint},
+        )
+
+    def get_slots_since_genesis(self, store: Store) -> int:
+        return (store.time - store.genesis_time) \
+            // self.config.SECONDS_PER_SLOT
+
+    def get_current_slot(self, store: Store) -> int:
+        return uint64(self.GENESIS_SLOT + self.get_slots_since_genesis(store))
+
+    def get_current_store_epoch(self, store: Store) -> int:
+        return self.compute_epoch_at_slot(self.get_current_slot(store))
+
+    def compute_slots_since_epoch_start(self, slot) -> int:
+        return int(slot - self.compute_start_slot_at_epoch(
+            self.compute_epoch_at_slot(slot)))
+
+    # ------------------------------------------------------------------
+    # ancestry & weights
+    # ------------------------------------------------------------------
+    def get_ancestor(self, store: Store, root, slot):
+        block = store.blocks[root]
+        if block.slot > slot:
+            return self.get_ancestor(store, block.parent_root, slot)
+        return root
+
+    def get_checkpoint_block(self, store: Store, root, epoch):
+        epoch_first_slot = self.compute_start_slot_at_epoch(epoch)
+        return self.get_ancestor(store, root, epoch_first_slot)
+
+    def calculate_committee_fraction(self, state, committee_percent) -> int:
+        committee_weight = self.get_total_active_balance(state) \
+            // self.SLOTS_PER_EPOCH
+        return uint64((committee_weight * committee_percent) // 100)
+
+    def get_proposer_score(self, store: Store) -> int:
+        justified_checkpoint_state = \
+            store.checkpoint_states[store.justified_checkpoint]
+        committee_weight = \
+            self.get_total_active_balance(justified_checkpoint_state) \
+            // self.SLOTS_PER_EPOCH
+        return uint64((committee_weight
+                       * self.config.PROPOSER_SCORE_BOOST) // 100)
+
+    def get_weight(self, store: Store, root) -> int:
+        state = store.checkpoint_states[store.justified_checkpoint]
+        unslashed_and_active_indices = [
+            i for i in self.get_active_validator_indices(
+                state, self.get_current_epoch(state))
+            if not state.validators[i].slashed]
+        attestation_score = uint64(sum(
+            int(state.validators[i].effective_balance)
+            for i in unslashed_and_active_indices
+            if (int(i) in store.latest_messages
+                and int(i) not in store.equivocating_indices
+                and self.get_ancestor(
+                    store, store.latest_messages[int(i)].root,
+                    store.blocks[root].slot) == root)))
+        if store.proposer_boost_root == Bytes32():
+            return attestation_score
+        proposer_score = uint64(0)
+        if self.get_ancestor(store, store.proposer_boost_root,
+                             store.blocks[root].slot) == root:
+            proposer_score = self.get_proposer_score(store)
+        return uint64(attestation_score + proposer_score)
+
+    # ------------------------------------------------------------------
+    # head selection
+    # ------------------------------------------------------------------
+    def get_voting_source(self, store: Store, block_root):
+        block = store.blocks[block_root]
+        current_epoch = self.get_current_store_epoch(store)
+        block_epoch = self.compute_epoch_at_slot(block.slot)
+        if current_epoch > block_epoch:
+            # block from a prior epoch: the unrealized justification counts
+            return store.unrealized_justifications[block_root]
+        head_state = store.block_states[block_root]
+        return head_state.current_justified_checkpoint
+
+    def filter_block_tree(self, store: Store, block_root, blocks) -> bool:
+        block = store.blocks[block_root]
+        children = [root for root in store.blocks
+                    if store.blocks[root].parent_root == block_root]
+        if any(children):
+            results = [self.filter_block_tree(store, child, blocks)
+                       for child in children]
+            if any(results):
+                blocks[block_root] = block
+                return True
+            return False
+
+        # leaf: viable-for-head criteria
+        current_epoch = self.get_current_store_epoch(store)
+        voting_source = self.get_voting_source(store, block_root)
+        correct_justified = (
+            store.justified_checkpoint.epoch == self.GENESIS_EPOCH
+            or voting_source.epoch == store.justified_checkpoint.epoch
+            or voting_source.epoch + 2 >= current_epoch)
+        finalized_checkpoint_block = self.get_checkpoint_block(
+            store, block_root, store.finalized_checkpoint.epoch)
+        correct_finalized = (
+            store.finalized_checkpoint.epoch == self.GENESIS_EPOCH
+            or store.finalized_checkpoint.root == finalized_checkpoint_block)
+        if correct_justified and correct_finalized:
+            blocks[block_root] = block
+            return True
+        return False
+
+    def get_filtered_block_tree(self, store: Store) -> dict:
+        base = store.justified_checkpoint.root
+        blocks: dict = {}
+        self.filter_block_tree(store, base, blocks)
+        return blocks
+
+    def get_head(self, store: Store):
+        blocks = self.get_filtered_block_tree(store)
+        head = store.justified_checkpoint.root
+        while True:
+            children = [root for root in blocks
+                        if blocks[root].parent_root == head]
+            if len(children) == 0:
+                return head
+            # lexicographic root order breaks ties
+            head = max(children,
+                       key=lambda root: (self.get_weight(store, root),
+                                         bytes(root)))
+
+    # ------------------------------------------------------------------
+    # checkpoint bookkeeping
+    # ------------------------------------------------------------------
+    def update_checkpoints(self, store: Store, justified_checkpoint,
+                           finalized_checkpoint) -> None:
+        if justified_checkpoint.epoch > store.justified_checkpoint.epoch:
+            store.justified_checkpoint = justified_checkpoint
+        if finalized_checkpoint.epoch > store.finalized_checkpoint.epoch:
+            store.finalized_checkpoint = finalized_checkpoint
+
+    def update_unrealized_checkpoints(
+            self, store: Store, unrealized_justified_checkpoint,
+            unrealized_finalized_checkpoint) -> None:
+        if (unrealized_justified_checkpoint.epoch
+                > store.unrealized_justified_checkpoint.epoch):
+            store.unrealized_justified_checkpoint = \
+                unrealized_justified_checkpoint
+        if (unrealized_finalized_checkpoint.epoch
+                > store.unrealized_finalized_checkpoint.epoch):
+            store.unrealized_finalized_checkpoint = \
+                unrealized_finalized_checkpoint
+
+    def compute_pulled_up_tip(self, store: Store, block_root) -> None:
+        state = store.block_states[block_root].copy()
+        self.process_justification_and_finalization(state)
+        store.unrealized_justifications[block_root] = \
+            state.current_justified_checkpoint
+        self.update_unrealized_checkpoints(
+            store, state.current_justified_checkpoint,
+            state.finalized_checkpoint)
+        # blocks from prior epochs apply realized checkpoints immediately
+        block_epoch = self.compute_epoch_at_slot(
+            store.blocks[block_root].slot)
+        current_epoch = self.get_current_store_epoch(store)
+        if block_epoch < current_epoch:
+            self.update_checkpoints(store, state.current_justified_checkpoint,
+                                    state.finalized_checkpoint)
+
+    # ------------------------------------------------------------------
+    # handlers
+    # ------------------------------------------------------------------
+    def on_tick_per_slot(self, store: Store, time: int) -> None:
+        previous_slot = self.get_current_slot(store)
+        store.time = int(time)
+        current_slot = self.get_current_slot(store)
+        if current_slot > previous_slot:
+            store.proposer_boost_root = Bytes32()
+        if (current_slot > previous_slot
+                and self.compute_slots_since_epoch_start(current_slot) == 0):
+            self.update_checkpoints(store,
+                                    store.unrealized_justified_checkpoint,
+                                    store.unrealized_finalized_checkpoint)
+
+    def on_tick(self, store: Store, time: int) -> None:
+        # tick through every intervening slot boundary
+        tick_slot = (int(time) - store.genesis_time) \
+            // self.config.SECONDS_PER_SLOT
+        while self.get_current_slot(store) < tick_slot:
+            previous_time = store.genesis_time \
+                + (self.get_current_slot(store) + 1) \
+                * self.config.SECONDS_PER_SLOT
+            self.on_tick_per_slot(store, previous_time)
+        self.on_tick_per_slot(store, time)
+
+    def on_block(self, store: Store, signed_block) -> None:
+        block = signed_block.message
+        # parent known
+        assert block.parent_root in store.block_states
+        # not from the future
+        assert self.get_current_slot(store) >= block.slot
+        # descends from (and is after) the finalized checkpoint
+        finalized_slot = self.compute_start_slot_at_epoch(
+            store.finalized_checkpoint.epoch)
+        assert block.slot > finalized_slot
+        assert self.get_checkpoint_block(
+            store, block.parent_root, store.finalized_checkpoint.epoch) \
+            == store.finalized_checkpoint.root
+
+        self.check_block_data_availability(store, signed_block)
+
+        state = store.block_states[block.parent_root].copy()
+        self.state_transition(state, signed_block, True)
+
+        block_root = hash_tree_root(block)
+        store.blocks[block_root] = block
+        store.block_states[block_root] = state
+
+        # timeliness & proposer boost
+        time_into_slot = (store.time - store.genesis_time) \
+            % self.config.SECONDS_PER_SLOT
+        is_before_attesting_interval = time_into_slot < (
+            self.config.SECONDS_PER_SLOT // self.INTERVALS_PER_SLOT)
+        is_timely = (self.get_current_slot(store) == block.slot
+                     and is_before_attesting_interval)
+        store.block_timeliness[block_root] = is_timely
+        is_first_block = store.proposer_boost_root == Bytes32()
+        if is_timely and is_first_block:
+            store.proposer_boost_root = block_root
+
+        self.update_checkpoints(store, state.current_justified_checkpoint,
+                                state.finalized_checkpoint)
+        self.compute_pulled_up_tip(store, block_root)
+
+    def check_block_data_availability(self, store, signed_block) -> None:
+        """Phase0: nothing to check (deneb overrides for blob DA)."""
+
+    def validate_target_epoch_against_current_time(self, store,
+                                                   attestation) -> None:
+        target = attestation.data.target
+        current_epoch = self.get_current_store_epoch(store)
+        previous_epoch = (current_epoch - 1
+                          if current_epoch > self.GENESIS_EPOCH
+                          else self.GENESIS_EPOCH)
+        assert target.epoch in (current_epoch, previous_epoch)
+
+    def validate_on_attestation(self, store, attestation,
+                                is_from_block: bool) -> None:
+        target = attestation.data.target
+        if not is_from_block:
+            self.validate_target_epoch_against_current_time(store, attestation)
+        assert target.epoch == self.compute_epoch_at_slot(
+            attestation.data.slot)
+        assert target.root in store.blocks
+        assert attestation.data.beacon_block_root in store.blocks
+        assert store.blocks[attestation.data.beacon_block_root].slot \
+            <= attestation.data.slot
+        # LMD vote must be consistent with the FFG target
+        assert target.root == self.get_checkpoint_block(
+            store, attestation.data.beacon_block_root, target.epoch)
+        # only apply after the attestation's slot has passed
+        assert self.get_current_slot(store) >= attestation.data.slot + 1
+
+    def store_target_checkpoint_state(self, store, target) -> None:
+        if target not in store.checkpoint_states:
+            base_state = store.block_states[target.root].copy()
+            if base_state.slot < self.compute_start_slot_at_epoch(
+                    target.epoch):
+                self.process_slots(base_state,
+                                   self.compute_start_slot_at_epoch(
+                                       target.epoch))
+            store.checkpoint_states[target] = base_state
+
+    def update_latest_messages(self, store, attesting_indices,
+                               attestation) -> None:
+        target = attestation.data.target
+        beacon_block_root = attestation.data.beacon_block_root
+        non_equivocating = [i for i in attesting_indices
+                            if int(i) not in store.equivocating_indices]
+        for i in non_equivocating:
+            i = int(i)
+            if (i not in store.latest_messages
+                    or target.epoch > store.latest_messages[i].epoch):
+                store.latest_messages[i] = LatestMessage(
+                    epoch=int(target.epoch), root=beacon_block_root)
+
+    def on_attestation(self, store, attestation,
+                       is_from_block: bool = False) -> None:
+        self.validate_on_attestation(store, attestation, is_from_block)
+        self.store_target_checkpoint_state(store, attestation.data.target)
+        target_state = store.checkpoint_states[attestation.data.target]
+        indexed_attestation = self.get_indexed_attestation(
+            target_state, attestation)
+        assert self.is_valid_indexed_attestation(
+            target_state, indexed_attestation)
+        self.update_latest_messages(
+            store, indexed_attestation.attesting_indices, attestation)
+
+    def on_attester_slashing(self, store, attester_slashing) -> None:
+        attestation_1 = attester_slashing.attestation_1
+        attestation_2 = attester_slashing.attestation_2
+        assert self.is_slashable_attestation_data(
+            attestation_1.data, attestation_2.data)
+        state = store.block_states[store.justified_checkpoint.root]
+        assert self.is_valid_indexed_attestation(state, attestation_1)
+        assert self.is_valid_indexed_attestation(state, attestation_2)
+        indices = set(int(i) for i in attestation_1.attesting_indices) \
+            & set(int(i) for i in attestation_2.attesting_indices)
+        store.equivocating_indices.update(indices)
+
+    # ------------------------------------------------------------------
+    # proposer-reorg helpers (fork-choice.md "Helpers")
+    # ------------------------------------------------------------------
+    def is_head_late(self, store, head_root) -> bool:
+        return not store.block_timeliness[head_root]
+
+    def is_shuffling_stable(self, slot) -> bool:
+        return self.compute_slots_since_epoch_start(slot) != 0
+
+    def is_ffg_competitive(self, store, head_root, parent_root) -> bool:
+        return (store.unrealized_justifications[head_root]
+                == store.unrealized_justifications[parent_root])
+
+    def is_finalization_ok(self, store, slot) -> bool:
+        epochs_since_finalization = self.compute_epoch_at_slot(slot) \
+            - store.finalized_checkpoint.epoch
+        return epochs_since_finalization \
+            <= self.config.REORG_MAX_EPOCHS_SINCE_FINALIZATION
+
+    def is_proposing_on_time(self, store) -> bool:
+        time_into_slot = (store.time - store.genesis_time) \
+            % self.config.SECONDS_PER_SLOT
+        proposer_reorg_cutoff = self.config.SECONDS_PER_SLOT \
+            // self.INTERVALS_PER_SLOT // 2
+        return time_into_slot <= proposer_reorg_cutoff
+
+    def is_head_weak(self, store, head_root) -> bool:
+        justified_state = store.checkpoint_states[store.justified_checkpoint]
+        reorg_threshold = self.calculate_committee_fraction(
+            justified_state, self.config.REORG_HEAD_WEIGHT_THRESHOLD)
+        return self.get_weight(store, head_root) < reorg_threshold
+
+    def is_parent_strong(self, store, parent_root) -> bool:
+        justified_state = store.checkpoint_states[store.justified_checkpoint]
+        parent_threshold = self.calculate_committee_fraction(
+            justified_state, self.config.REORG_PARENT_WEIGHT_THRESHOLD)
+        return self.get_weight(store, parent_root) > parent_threshold
+
+    def get_proposer_head(self, store, head_root, slot):
+        head_block = store.blocks[head_root]
+        parent_root = head_block.parent_root
+        parent_block = store.blocks[parent_root]
+
+        head_late = self.is_head_late(store, head_root)
+        shuffling_stable = self.is_shuffling_stable(slot)
+        ffg_competitive = self.is_ffg_competitive(store, head_root,
+                                                  parent_root)
+        finalization_ok = self.is_finalization_ok(store, slot)
+        proposing_on_time = self.is_proposing_on_time(store)
+
+        # single-slot reorgs only
+        parent_slot_ok = parent_block.slot + 1 == head_block.slot
+        current_time_ok = head_block.slot + 1 == slot
+        single_slot_reorg = parent_slot_ok and current_time_ok
+
+        # boost must have worn off
+        assert store.proposer_boost_root != head_root
+        head_weak = self.is_head_weak(store, head_root)
+        parent_strong = self.is_parent_strong(store, parent_root)
+
+        if all([head_late, shuffling_stable, ffg_competitive, finalization_ok,
+                proposing_on_time, single_slot_reorg, head_weak,
+                parent_strong]):
+            return parent_root
+        return head_root
+
+    # safe-block helper (fork_choice/safe-block.md)
+    def get_safe_beacon_block_root(self, store):
+        return store.justified_checkpoint.root
+
+    def get_safe_execution_block_hash(self, store):
+        """Phase0 has no execution payloads; bellatrix overrides."""
+        return Bytes32()

@@ -1,0 +1,212 @@
+"""Validator duties, weak subjectivity, and p2p helper functions.
+
+From-scratch implementations of the executable parts of
+/root/reference/specs/phase0/validator.md, weak-subjectivity.md, and
+p2p-interface.md (compute_subscribed_subnets).  Mixed into Phase0Spec.
+"""
+from __future__ import annotations
+
+from ..ssz import uint8, uint32, uint64, Bytes32, hash_tree_root, uint_to_bytes
+from ..utils import bls
+
+ETH_TO_GWEI = 10**9
+SAFETY_DECAY = 10
+
+
+class Phase0ValidatorDuties:
+
+    # ------------------------------------------------------------------
+    # validator.md
+    # ------------------------------------------------------------------
+    def check_if_validator_active(self, state, validator_index) -> bool:
+        return self.is_active_validator(state.validators[validator_index],
+                                        self.get_current_epoch(state))
+
+    def get_committee_assignment(self, state, epoch, validator_index):
+        """(committee, committee_index, slot) for the validator, or None."""
+        next_epoch = uint64(self.get_current_epoch(state) + 1)
+        assert epoch <= next_epoch
+        start_slot = self.compute_start_slot_at_epoch(epoch)
+        committee_count_per_slot = self.get_committee_count_per_slot(
+            state, epoch)
+        for slot in range(start_slot, start_slot + self.SLOTS_PER_EPOCH):
+            for index in range(committee_count_per_slot):
+                committee = self.get_beacon_committee(
+                    state, uint64(slot), uint64(index))
+                if validator_index in committee:
+                    return committee, uint64(index), uint64(slot)
+        return None
+
+    def is_proposer(self, state, validator_index) -> bool:
+        return self.get_beacon_proposer_index(state) == validator_index
+
+    def get_epoch_signature(self, state, block, privkey):
+        domain = self.get_domain(state, self.DOMAIN_RANDAO,
+                                 self.compute_epoch_at_slot(block.slot))
+        signing_root = self.compute_signing_root(
+            uint64(self.compute_epoch_at_slot(block.slot)), domain)
+        return bls.Sign(privkey, signing_root)
+
+    def compute_time_at_slot(self, state, slot) -> int:
+        return uint64(state.genesis_time
+                      + slot * self.config.SECONDS_PER_SLOT)
+
+    def voting_period_start_time(self, state) -> int:
+        eth1_voting_period_start_slot = uint64(
+            state.slot - state.slot % (self.EPOCHS_PER_ETH1_VOTING_PERIOD
+                                       * self.SLOTS_PER_EPOCH))
+        return self.compute_time_at_slot(state,
+                                         eth1_voting_period_start_slot)
+
+    def is_candidate_block(self, block, period_start) -> bool:
+        follow = self.config.SECONDS_PER_ETH1_BLOCK \
+            * self.config.ETH1_FOLLOW_DISTANCE
+        return (block.timestamp + follow <= period_start
+                and block.timestamp + follow * 2 >= period_start)
+
+    def get_eth1_data(self, block):
+        """Stub eth1-chain accessor (tests inject block.deposit_* directly)."""
+        return self.Eth1Data(deposit_root=block.deposit_root,
+                             deposit_count=block.deposit_count,
+                             block_hash=hash_tree_root(block))
+
+    def get_eth1_vote(self, state, eth1_chain):
+        period_start = self.voting_period_start_time(state)
+        votes_to_consider = [
+            self.get_eth1_data(block) for block in eth1_chain
+            if (self.is_candidate_block(block, period_start)
+                and self.get_eth1_data(block).deposit_count
+                >= state.eth1_data.deposit_count)]
+        valid_votes = [vote for vote in state.eth1_data_votes
+                       if vote in votes_to_consider]
+        # default: smallest-distance candidate, else current eth1_data
+        default_vote = (votes_to_consider[len(votes_to_consider) - 1]
+                        if any(votes_to_consider) else state.eth1_data)
+        return max(
+            valid_votes,
+            key=lambda v: (valid_votes.count(v),
+                           -valid_votes.index(v)),  # earliest wins ties
+            default=default_vote)
+
+    def compute_new_state_root(self, state, block):
+        temp_state = state.copy()
+        signed_block = self.SignedBeaconBlock(message=block)
+        self.state_transition(temp_state, signed_block,
+                              validate_result=False)
+        return hash_tree_root(temp_state)
+
+    def get_block_signature(self, state, block, privkey):
+        domain = self.get_domain(state, self.DOMAIN_BEACON_PROPOSER,
+                                 self.compute_epoch_at_slot(block.slot))
+        return bls.Sign(privkey,
+                        self.compute_signing_root(block, domain))
+
+    def get_attestation_signature(self, state, attestation_data, privkey):
+        domain = self.get_domain(state, self.DOMAIN_BEACON_ATTESTER,
+                                 attestation_data.target.epoch)
+        return bls.Sign(privkey, self.compute_signing_root(
+            attestation_data, domain))
+
+    def compute_subnet_for_attestation(self, committees_per_slot, slot,
+                                       committee_index) -> int:
+        slots_since_epoch_start = uint64(slot % self.SLOTS_PER_EPOCH)
+        committees_since_epoch_start = \
+            committees_per_slot * slots_since_epoch_start
+        return uint64((committees_since_epoch_start + committee_index)
+                      % self.ATTESTATION_SUBNET_COUNT)
+
+    def get_slot_signature(self, state, slot, privkey):
+        domain = self.get_domain(state, self.DOMAIN_SELECTION_PROOF,
+                                 self.compute_epoch_at_slot(slot))
+        return bls.Sign(privkey,
+                        self.compute_signing_root(uint64(slot), domain))
+
+    def is_aggregator(self, state, slot, index, slot_signature) -> bool:
+        committee = self.get_beacon_committee(state, slot, index)
+        modulo = max(1, len(committee)
+                     // self.TARGET_AGGREGATORS_PER_COMMITTEE)
+        from .phase0 import bytes_to_uint64
+        return bytes_to_uint64(
+            self.hash(bytes(slot_signature))[0:8]) % modulo == 0
+
+    def get_aggregate_signature(self, attestations):
+        return bls.Aggregate([a.signature for a in attestations])
+
+    def get_aggregate_and_proof(self, state, aggregator_index, aggregate,
+                                privkey):
+        return self.AggregateAndProof(
+            aggregator_index=aggregator_index,
+            aggregate=aggregate,
+            selection_proof=self.get_slot_signature(
+                state, aggregate.data.slot, privkey))
+
+    def get_aggregate_and_proof_signature(self, state, aggregate_and_proof,
+                                          privkey):
+        aggregate = aggregate_and_proof.aggregate
+        domain = self.get_domain(
+            state, self.DOMAIN_AGGREGATE_AND_PROOF,
+            self.compute_epoch_at_slot(aggregate.data.slot))
+        return bls.Sign(privkey, self.compute_signing_root(
+            aggregate_and_proof, domain))
+
+    # ------------------------------------------------------------------
+    # weak-subjectivity.md
+    # ------------------------------------------------------------------
+    def compute_weak_subjectivity_period(self, state) -> int:
+        ws_period = int(self.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY)
+        n = len(self.get_active_validator_indices(
+            state, self.get_current_epoch(state)))
+        t = int(self.get_total_active_balance(state)) // n // ETH_TO_GWEI
+        T = self.MAX_EFFECTIVE_BALANCE // ETH_TO_GWEI
+        delta = int(self.get_validator_churn_limit(state))
+        Delta = self.MAX_DEPOSITS * self.SLOTS_PER_EPOCH
+        D = SAFETY_DECAY
+        if T * (200 + 3 * D) < t * (200 + 12 * D):
+            epochs_for_validator_set_churn = (
+                n * (t * (200 + 12 * D) - T * (200 + 3 * D))
+                // (600 * delta * (2 * t + T)))
+            epochs_for_balance_top_ups = (
+                n * (200 + 3 * D) // (600 * Delta))
+            ws_period += max(epochs_for_validator_set_churn,
+                             epochs_for_balance_top_ups)
+        else:
+            ws_period += 3 * n * D * t // (200 * Delta * (T - t))
+        return uint64(ws_period)
+
+    def is_within_weak_subjectivity_period(self, store, ws_state,
+                                           ws_checkpoint) -> bool:
+        assert ws_state.latest_block_header.state_root == ws_checkpoint.root
+        assert self.compute_epoch_at_slot(ws_state.slot) \
+            == ws_checkpoint.epoch
+        ws_period = self.compute_weak_subjectivity_period(ws_state)
+        ws_state_epoch = self.compute_epoch_at_slot(ws_state.slot)
+        current_epoch = self.compute_epoch_at_slot(
+            self.get_current_slot(store))
+        return current_epoch <= ws_state_epoch + ws_period
+
+    # ------------------------------------------------------------------
+    # p2p-interface.md (executable helpers)
+    # ------------------------------------------------------------------
+    ATTESTATION_SUBNET_EXTRA_BITS = 0
+
+    @property
+    def ATTESTATION_SUBNET_PREFIX_BITS(self) -> int:
+        return (self.ATTESTATION_SUBNET_COUNT - 1).bit_length() \
+            + self.ATTESTATION_SUBNET_EXTRA_BITS
+
+    def compute_subscribed_subnet(self, node_id, epoch, index) -> int:
+        node_id_prefix = int(node_id) >> (self.NODE_ID_BITS
+                                          - self.ATTESTATION_SUBNET_PREFIX_BITS)
+        node_offset = int(node_id) % self.EPOCHS_PER_SUBNET_SUBSCRIPTION
+        permutation_seed = self.hash(uint_to_bytes(uint64(
+            (int(epoch) + node_offset)
+            // self.EPOCHS_PER_SUBNET_SUBSCRIPTION)))
+        permutated_prefix = self.compute_shuffled_index(
+            node_id_prefix, 1 << self.ATTESTATION_SUBNET_PREFIX_BITS,
+            permutation_seed)
+        return uint64((permutated_prefix + index)
+                      % self.ATTESTATION_SUBNET_COUNT)
+
+    def compute_subscribed_subnets(self, node_id, epoch):
+        return [self.compute_subscribed_subnet(node_id, epoch, index)
+                for index in range(self.SUBNETS_PER_NODE)]

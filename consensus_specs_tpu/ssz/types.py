@@ -707,6 +707,11 @@ class Container(SSZType):
             anns = klass.__dict__.get("__annotations__", {})
             for k, v in anns.items():
                 if not k.startswith("_"):
+                    if isinstance(v, str):
+                        raise TypeError(
+                            f"{cls.__name__}.{k}: field annotation is a "
+                            "string — remove `from __future__ import "
+                            "annotations` from the defining module")
                     fields[k] = v
         if fields:
             cls._field_names = tuple(fields)

@@ -1,0 +1,29 @@
+"""Test infrastructure: keys, genesis fixtures, block/attestation builders,
+decorator engine, and BLS toggling — the counterpart of the reference's
+eth2spec.test harness (SURVEY.md §2.4).
+"""
+from contextlib import contextmanager
+
+from ..utils import bls as _bls
+
+
+@contextmanager
+def disable_bls():
+    """Stub BLS inside the block — the reference's --disable-bls semantics
+    for bulk trajectory tests where signature crypto is not under test."""
+    previous = _bls.bls_active
+    _bls.bls_active = False
+    try:
+        yield
+    finally:
+        _bls.bls_active = previous
+
+
+@contextmanager
+def enable_bls():
+    previous = _bls.bls_active
+    _bls.bls_active = True
+    try:
+        yield
+    finally:
+        _bls.bls_active = previous

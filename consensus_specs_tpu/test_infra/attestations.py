@@ -1,0 +1,132 @@
+"""Attestation-building test helpers.
+
+Counterpart of the reference harness's helpers/attestations.py
+(get_valid_attestation / sign_attestation / build_attestation_data).
+"""
+from __future__ import annotations
+
+from ..ssz import hash_tree_root, uint64
+from ..utils import bls
+from .keys import privkey_for_pubkey
+from .blocks import build_empty_block_for_next_slot
+
+
+def build_attestation_data(spec, state, slot, index):
+    assert state.slot >= slot
+
+    if slot == state.slot:
+        beacon_block_root = build_empty_block_for_next_slot(
+            spec, state).parent_root
+    else:
+        beacon_block_root = spec.get_block_root_at_slot(state, slot)
+
+    current_epoch_start_slot = spec.compute_start_slot_at_epoch(
+        spec.get_current_epoch(state))
+    if slot < current_epoch_start_slot:
+        epoch_boundary_root = spec.get_block_root(
+            state, spec.get_previous_epoch(state))
+    elif slot == current_epoch_start_slot:
+        epoch_boundary_root = beacon_block_root
+    else:
+        epoch_boundary_root = spec.get_block_root(
+            state, spec.get_current_epoch(state))
+
+    if slot < current_epoch_start_slot:
+        source = state.previous_justified_checkpoint
+    else:
+        source = state.current_justified_checkpoint
+
+    return spec.AttestationData(
+        slot=uint64(slot),
+        index=uint64(index),
+        beacon_block_root=beacon_block_root,
+        source=source,
+        target=spec.Checkpoint(
+            epoch=spec.compute_epoch_at_slot(slot),
+            root=epoch_boundary_root))
+
+
+def sign_aggregate_attestation(spec, state, attestation_data,
+                               participants) -> bytes:
+    signatures = []
+    for validator_index in participants:
+        privkey = privkey_for_pubkey(
+            state.validators[validator_index].pubkey)
+        signatures.append(
+            spec.get_attestation_signature(state, attestation_data, privkey))
+    return bls.Aggregate(signatures)
+
+
+def sign_attestation(spec, state, attestation) -> None:
+    participants = spec.get_attesting_indices(state, attestation)
+    attestation.signature = sign_aggregate_attestation(
+        spec, state, attestation.data, participants)
+
+
+def get_valid_attestation(spec, state, slot=None, index=None,
+                          filter_participant_set=None, signed=False):
+    # No slot/index implies the current slot's first committee
+    if slot is None:
+        slot = state.slot
+    if index is None:
+        index = 0
+
+    attestation_data = build_attestation_data(spec, state, slot, index)
+    committee = spec.get_beacon_committee(
+        state, attestation_data.slot, attestation_data.index)
+
+    participants = set(committee)
+    if filter_participant_set is not None:
+        participants = filter_participant_set(participants)
+
+    aggregation_bits = [validator_index in participants
+                        for validator_index in committee]
+    attestation = spec.Attestation(
+        aggregation_bits=aggregation_bits, data=attestation_data)
+    if signed and participants:
+        sign_attestation(spec, state, attestation)
+    return attestation
+
+
+def add_attestations_to_state(spec, state, attestations, slot) -> None:
+    from .blocks import transition_to
+    transition_to(spec, state, slot)
+    for attestation in attestations:
+        spec.process_attestation(state, attestation)
+
+
+def next_epoch_with_attestations(spec, state, fill_cur_epoch: bool,
+                                 fill_prev_epoch: bool):
+    """Advance one epoch, attaching full attestations via empty blocks.
+
+    Returns (attestations_in_blocks, post_state) trajectory pieces like the
+    reference helper (helpers/attestations.py:289) — used by finality tests.
+    """
+    from .blocks import build_empty_block_for_next_slot, \
+        state_transition_and_sign_block
+
+    signed_blocks = []
+    for _ in range(spec.SLOTS_PER_EPOCH):
+        block = build_empty_block_for_next_slot(spec, state)
+        if fill_cur_epoch and state.slot >= spec.MIN_ATTESTATION_INCLUSION_DELAY:
+            slot_to_attest = uint64(
+                state.slot - spec.MIN_ATTESTATION_INCLUSION_DELAY + 1)
+            if slot_to_attest >= spec.compute_start_slot_at_epoch(
+                    spec.get_current_epoch(state)):
+                committees_per_slot = spec.get_committee_count_per_slot(
+                    state, spec.compute_epoch_at_slot(slot_to_attest))
+                for index in range(committees_per_slot):
+                    attestation = get_valid_attestation(
+                        spec, state, slot_to_attest, index, signed=True)
+                    block.body.attestations.append(attestation)
+        if fill_prev_epoch:
+            slot_to_attest = uint64(state.slot - spec.SLOTS_PER_EPOCH + 1)
+            committees_per_slot = spec.get_committee_count_per_slot(
+                state, spec.compute_epoch_at_slot(slot_to_attest))
+            for index in range(committees_per_slot):
+                attestation = get_valid_attestation(
+                    spec, state, slot_to_attest, index, signed=True)
+                block.body.attestations.append(attestation)
+        signed_blocks.append(
+            state_transition_and_sign_block(spec, state, block))
+    return signed_blocks, state

@@ -1,0 +1,92 @@
+"""Block-building test helpers.
+
+Counterpart of the reference harness's helpers/block.py and state.py:
+build/sign empty blocks, advance slots/epochs, full
+state_transition_and_sign_block.
+"""
+from __future__ import annotations
+
+from ..ssz import Bytes32, hash_tree_root, uint64
+from ..utils import bls
+from .keys import privkey_for_pubkey
+
+
+def transition_to(spec, state, slot) -> None:
+    """Advance state to `slot` (no-op if already there)."""
+    assert state.slot <= slot
+    if state.slot < slot:
+        spec.process_slots(state, uint64(slot))
+
+
+def next_slot(spec, state) -> None:
+    spec.process_slots(state, uint64(state.slot + 1))
+
+
+def next_epoch(spec, state) -> None:
+    slot = uint64(state.slot + spec.SLOTS_PER_EPOCH
+                  - state.slot % spec.SLOTS_PER_EPOCH)
+    spec.process_slots(state, slot)
+
+
+def proposer_privkey(spec, state, proposer_index) -> int:
+    return privkey_for_pubkey(state.validators[proposer_index].pubkey)
+
+
+def build_empty_block(spec, state, slot=None):
+    """An empty block at `slot` consistent with (an advanced copy of) state."""
+    if slot is None:
+        slot = state.slot
+    if slot < state.slot:
+        raise ValueError("cannot build a block for a past slot")
+    lookahead = state
+    if state.slot < slot:
+        lookahead = state.copy()
+        spec.process_slots(lookahead, uint64(slot))
+    proposer_index = spec.get_beacon_proposer_index(lookahead)
+    header = lookahead.latest_block_header.copy()
+    if header.state_root == Bytes32():
+        header.state_root = hash_tree_root(lookahead)
+    block = spec.BeaconBlock(
+        slot=uint64(slot),
+        proposer_index=proposer_index,
+        parent_root=hash_tree_root(header))
+    block.body.eth1_data.deposit_count = lookahead.eth1_deposit_index
+    # randao reveal for the block's epoch, signed by the proposer
+    privkey = proposer_privkey(spec, lookahead, proposer_index)
+    block.body.randao_reveal = spec.get_epoch_signature(
+        lookahead, block, privkey)
+    return block
+
+
+def build_empty_block_for_next_slot(spec, state):
+    return build_empty_block(spec, state, uint64(state.slot + 1))
+
+
+def sign_block(spec, state, block):
+    privkey = proposer_privkey(spec, state, block.proposer_index)
+    domain = spec.get_domain(state, spec.DOMAIN_BEACON_PROPOSER,
+                             spec.compute_epoch_at_slot(block.slot))
+    signing_root = spec.compute_signing_root(block, domain)
+    return spec.SignedBeaconBlock(
+        message=block, signature=bls.Sign(privkey, signing_root))
+
+
+def state_transition_and_sign_block(spec, state, block):
+    """Fill block.state_root, sign, and apply to `state`; returns the
+    signed block (the harness's standard way to extend a chain)."""
+    temp = state.copy()
+    if temp.slot < block.slot:
+        spec.process_slots(temp, block.slot)
+    spec.process_block(temp, block)
+    block.state_root = hash_tree_root(temp)
+    signed_block = sign_block(spec, state, block)
+    spec.state_transition(state, signed_block)
+    return signed_block
+
+
+def apply_empty_block(spec, state, slot=None):
+    """Apply an empty block at `slot` (default: the next slot)."""
+    if slot is None:
+        slot = uint64(state.slot + 1)
+    block = build_empty_block(spec, state, slot)
+    return state_transition_and_sign_block(spec, state, block)

@@ -1,0 +1,63 @@
+"""Mock genesis state construction for tests.
+
+Capability parity with the reference harness's genesis fixtures
+(/root/reference/tests/core/pyspec/eth2spec/test/helpers/genesis.py:16-47):
+validators are built directly (no deposit proofs) from the deterministic
+key table, then the state is assembled exactly as the genesis function
+would have left it.
+"""
+from __future__ import annotations
+
+from ..ssz import hash_tree_root, uint64
+from .keys import pubkeys
+
+
+def build_mock_validator(spec, i: int, balance: int):
+    pubkey = pubkeys[i]
+    # BLS-prefixed withdrawal credentials derived from the pubkey
+    withdrawal_credentials = (
+        spec.BLS_WITHDRAWAL_PREFIX + bytes(spec.hash(pubkey))[1:])
+    return spec.Validator(
+        pubkey=pubkey,
+        withdrawal_credentials=withdrawal_credentials,
+        activation_eligibility_epoch=spec.FAR_FUTURE_EPOCH,
+        activation_epoch=spec.FAR_FUTURE_EPOCH,
+        exit_epoch=spec.FAR_FUTURE_EPOCH,
+        withdrawable_epoch=spec.FAR_FUTURE_EPOCH,
+        effective_balance=uint64(min(
+            int(balance) - int(balance) % spec.EFFECTIVE_BALANCE_INCREMENT,
+            spec.MAX_EFFECTIVE_BALANCE)))
+
+
+def create_genesis_state(spec, validator_balances, activation_threshold=None):
+    if activation_threshold is None:
+        activation_threshold = spec.MAX_EFFECTIVE_BALANCE
+    deposit_root = b"\x42" * 32
+    eth1_block_hash = b"\xda" * 32
+    state = spec.BeaconState(
+        genesis_time=spec.config.MIN_GENESIS_TIME,
+        eth1_deposit_index=len(validator_balances),
+        eth1_data=spec.Eth1Data(
+            deposit_root=deposit_root,
+            deposit_count=len(validator_balances),
+            block_hash=eth1_block_hash),
+        latest_block_header=spec.BeaconBlockHeader(
+            body_root=hash_tree_root(spec.BeaconBlockBody())),
+        randao_mixes=[eth1_block_hash] * spec.EPOCHS_PER_HISTORICAL_VECTOR)
+
+    for index, balance in enumerate(validator_balances):
+        validator = build_mock_validator(spec, index, balance)
+        if validator.effective_balance >= activation_threshold:
+            validator.activation_eligibility_epoch = spec.GENESIS_EPOCH
+            validator.activation_epoch = spec.GENESIS_EPOCH
+        state.validators.append(validator)
+        state.balances.append(balance)
+
+    state.genesis_validators_root = hash_tree_root(state.validators)
+    return state
+
+
+def default_balances(spec):
+    """Enough full-balance validators for a healthy committee structure."""
+    num_validators = spec.SLOTS_PER_EPOCH * 8
+    return [spec.MAX_EFFECTIVE_BALANCE] * num_validators

@@ -1,0 +1,53 @@
+"""Deterministic test keypairs.
+
+Same convention as the reference harness (privkeys 1..N,
+/root/reference/tests/core/pyspec/eth2spec/test/helpers/keys.py:1-6) but
+pubkeys are derived lazily through our own BLS (no external key table) and
+memoized — deriving all of them eagerly would cost seconds of scalar mults.
+"""
+from __future__ import annotations
+
+from ..crypto import bls12_381 as _native
+
+KEY_COUNT = 8192
+
+privkeys = [i + 1 for i in range(KEY_COUNT)]
+
+_pubkey_cache: dict[int, bytes] = {}
+
+
+def pubkey_of(privkey: int) -> bytes:
+    pk = _pubkey_cache.get(privkey)
+    if pk is None:
+        pk = _native.SkToPk(privkey)
+        _pubkey_cache[privkey] = pk
+    return pk
+
+
+class _PubkeyTable:
+    """List-like lazy pubkey table: pubkeys[i] == SkToPk(privkeys[i])."""
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [pubkey_of(pk) for pk in privkeys[i]]
+        return pubkey_of(privkeys[i])
+
+    def __len__(self):
+        return KEY_COUNT
+
+    def index(self, pubkey) -> int:
+        pubkey = bytes(pubkey)
+        for i, pk in list(_pubkey_cache.items()):
+            if pk == pubkey:
+                return privkeys.index(i)
+        for i in range(KEY_COUNT):  # fall back to deriving
+            if pubkey_of(privkeys[i]) == pubkey:
+                return i
+        raise ValueError("unknown pubkey")
+
+
+pubkeys = _PubkeyTable()
+
+
+def privkey_for_pubkey(pubkey) -> int:
+    return privkeys[pubkeys.index(bytes(pubkey))]

@@ -1,0 +1,127 @@
+"""BLS12-381 suite tests: group laws, pairing identities against the
+production KZG trusted setup, signature round trips, shim behavior.
+
+Mirrors the reference's bls test-vector generator coverage
+(/root/reference/tests/generators/bls/main.py) at unit granularity.
+"""
+import json
+
+import pytest
+
+from consensus_specs_tpu.crypto import bls12_381 as native
+from consensus_specs_tpu.crypto import curve as cv
+from consensus_specs_tpu.crypto.fields import R, Q, Fq2
+from consensus_specs_tpu.crypto.pairing import pairing
+from consensus_specs_tpu.crypto.hash_to_curve import (
+    hash_to_g2, sswu_map, iso_map, expand_message_xmd, H_EFF,
+)
+from consensus_specs_tpu.utils import bls as shim
+
+TRUSTED_SETUP = "/root/reference/presets/mainnet/trusted_setups/trusted_setup_4096.json"
+
+
+def test_generators_on_curve_and_order():
+    g1, g2 = cv.g1_generator(), cv.g2_generator()
+    assert g1.on_curve() and g2.on_curve()
+    assert (g1 * R).is_infinity() and (g2 * R).is_infinity()
+
+
+def test_trusted_setup_points_roundtrip():
+    ts = json.load(open(TRUSTED_SETUP))
+    for h in ts["g1_monomial"][:4] + ts["g1_lagrange"][:4]:
+        b = bytes.fromhex(h[2:])
+        assert cv.g1_to_bytes(cv.g1_from_bytes(b)) == b
+    for h in ts["g2_monomial"][:2]:
+        b = bytes.fromhex(h[2:])
+        assert cv.g2_to_bytes(cv.g2_from_bytes(b)) == b
+
+
+def test_pairing_bilinear_vs_trusted_setup():
+    """e([tau]G1, G2) == e(G1, [tau]G2) can only hold with a correct pairing."""
+    ts = json.load(open(TRUSTED_SETUP))
+    tau_g1 = cv.g1_from_bytes(bytes.fromhex(ts["g1_monomial"][1][2:]))
+    tau_g2 = cv.g2_from_bytes(bytes.fromhex(ts["g2_monomial"][1][2:]))
+    assert native.pairing_check([(tau_g1, cv.g2_generator()),
+                                 (-cv.g1_generator(), tau_g2)])
+
+
+def test_pairing_bilinearity_scalars():
+    g1, g2 = cv.g1_generator(), cv.g2_generator()
+    assert pairing(g1 * 3, g2 * 5) == pairing(g1, g2).pow(15)
+
+
+def test_iso_map_constants():
+    for i in range(3):
+        x, y = sswu_map(Fq2(1000 + i, 2000 + 7 * i))
+        assert iso_map(x, y).on_curve()
+
+
+def test_hash_to_g2_subgroup():
+    p = hash_to_g2(b"\x01\x02\x03")
+    assert p.on_curve() and (p * R).is_infinity()
+    assert hash_to_g2(b"\x01\x02\x03") == p
+    assert hash_to_g2(b"\x01\x02\x04") != p
+
+
+def test_expand_message_xmd_shape():
+    out = expand_message_xmd(b"abc", b"DST", 256)
+    assert len(out) == 256
+    assert out != expand_message_xmd(b"abd", b"DST", 256)
+
+
+def test_sign_verify_roundtrip():
+    sk = 12345
+    pk = native.SkToPk(sk)
+    msg = b"beacon block root"
+    sig = native.Sign(sk, msg)
+    assert len(pk) == 48 and len(sig) == 96
+    assert native.Verify(pk, msg, sig)
+    assert not native.Verify(pk, b"wrong message", sig)
+    assert not native.Verify(native.SkToPk(54321), msg, sig)
+
+
+def test_aggregate_verify():
+    sks = [1, 2, 3]
+    msg = b"same message"
+    pks = [native.SkToPk(sk) for sk in sks]
+    sigs = [native.Sign(sk, msg) for sk in sks]
+    agg = native.Aggregate(sigs)
+    assert native.FastAggregateVerify(pks, msg, agg)
+    assert not native.FastAggregateVerify(pks[:2], msg, agg)
+    # distinct messages
+    msgs = [b"m1", b"m2"]
+    sigs2 = [native.Sign(1, msgs[0]), native.Sign(2, msgs[1])]
+    agg2 = native.Aggregate(sigs2)
+    assert native.AggregateVerify(pks[:2], msgs, agg2)
+    assert not native.AggregateVerify(pks[:2], msgs[::-1], agg2)
+
+
+def test_aggregate_pks_matches_sum():
+    pks = [native.SkToPk(sk) for sk in (5, 6)]
+    agg = native.AggregatePKs(pks)
+    assert agg == native.SkToPk(11)
+
+
+def test_key_validate():
+    assert native.KeyValidate(native.SkToPk(7))
+    assert not native.KeyValidate(bytes([0xC0]) + b"\x00" * 47)  # infinity
+    assert not native.KeyValidate(b"\xff" * 48)
+
+
+def test_shim_stub_mode():
+    previous = shim.bls_active
+    shim.bls_active = False
+    try:
+        assert shim.Verify(b"x", b"y", b"z") is True
+        assert shim.Sign(1, b"m") == shim.STUB_SIGNATURE
+    finally:
+        shim.bls_active = previous
+
+
+def test_shim_live_mode():
+    pk = shim.SkToPk(42)
+    sig = shim.Sign(42, b"hello")
+    assert shim.Verify(pk, b"hello", sig)
+    assert not shim.Verify(pk, b"bye", sig)
+    # malformed inputs -> False, not an exception
+    assert not shim.Verify(b"\x00" * 48, b"m", b"\x00" * 96)
