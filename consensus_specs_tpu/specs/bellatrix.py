@@ -1,0 +1,338 @@
+"""Bellatrix spec: execution payloads and the merge transition.
+
+From-scratch implementation of /root/reference/specs/bellatrix/
+{beacon-chain.md,fork.md,fork-choice.md,validator.md} as an AltairSpec
+subclass.  The ExecutionEngine is the spec's process boundary to the
+execution layer; the NoopExecutionEngine stub answers True to everything
+(the reference's pysetup/spec_builders/bellatrix.py:39-64 pattern).
+"""
+from dataclasses import dataclass, field
+
+from ..ssz import (
+    uint64, uint256, Bitvector, Vector, List, Container, ByteList,
+    ByteVector, Bytes4, Bytes20, Bytes32, Bytes48, Bytes96,
+    hash_tree_root,
+)
+from .altair import AltairSpec
+
+
+@dataclass
+class PowBlockData:
+    block_hash: bytes = b"\x00" * 32
+    parent_hash: bytes = b"\x00" * 32
+    total_difficulty: int = 0
+
+
+class NoopExecutionEngine:
+    """Stub engine: all verifications pass, no payloads are built."""
+
+    def notify_new_payload(self, execution_payload,
+                           parent_beacon_block_root=None) -> bool:
+        return True
+
+    def notify_forkchoice_updated(self, head_block_hash,
+                                  safe_block_hash,
+                                  finalized_block_hash,
+                                  payload_attributes) -> object:
+        return None
+
+    def get_payload(self, payload_id):
+        raise NotImplementedError("no payload building in the noop engine")
+
+    def is_valid_block_hash(self, execution_payload,
+                            parent_beacon_block_root=None) -> bool:
+        return True
+
+    def is_valid_versioned_hashes(self, new_payload_request) -> bool:
+        return True
+
+    def verify_and_notify_new_payload(self, new_payload_request) -> bool:
+        return True
+
+
+class BellatrixSpec(AltairSpec):
+    fork = "bellatrix"
+
+    def _build_constants(self) -> None:
+        super()._build_constants()
+        self.Transaction = ByteList[self.MAX_BYTES_PER_TRANSACTION]
+        self.ExecutionAddress = Bytes20
+        self.EXECUTION_ENGINE = NoopExecutionEngine()
+        # stubbed pow-chain view for merge-transition tests (per instance)
+        self.pow_chain = {}
+
+    def _build_types(self) -> None:
+        super()._build_types()
+        p = self
+
+        class ExecutionPayload(Container):
+            parent_hash: Bytes32
+            fee_recipient: Bytes20
+            state_root: Bytes32
+            receipts_root: Bytes32
+            logs_bloom: ByteVector[p.BYTES_PER_LOGS_BLOOM]
+            prev_randao: Bytes32
+            block_number: uint64
+            gas_limit: uint64
+            gas_used: uint64
+            timestamp: uint64
+            extra_data: ByteList[p.MAX_EXTRA_DATA_BYTES]
+            base_fee_per_gas: uint256
+            block_hash: Bytes32
+            transactions: List[p.Transaction, p.MAX_TRANSACTIONS_PER_PAYLOAD]
+
+        class ExecutionPayloadHeader(Container):
+            parent_hash: Bytes32
+            fee_recipient: Bytes20
+            state_root: Bytes32
+            receipts_root: Bytes32
+            logs_bloom: ByteVector[p.BYTES_PER_LOGS_BLOOM]
+            prev_randao: Bytes32
+            block_number: uint64
+            gas_limit: uint64
+            gas_used: uint64
+            timestamp: uint64
+            extra_data: ByteList[p.MAX_EXTRA_DATA_BYTES]
+            base_fee_per_gas: uint256
+            block_hash: Bytes32
+            transactions_root: Bytes32
+
+        class BeaconBlockBody(Container):
+            randao_reveal: Bytes96
+            eth1_data: p.Eth1Data
+            graffiti: Bytes32
+            proposer_slashings: List[p.ProposerSlashing, p.MAX_PROPOSER_SLASHINGS]
+            attester_slashings: List[p.AttesterSlashing, p.MAX_ATTESTER_SLASHINGS]
+            attestations: List[p.Attestation, p.MAX_ATTESTATIONS]
+            deposits: List[p.Deposit, p.MAX_DEPOSITS]
+            voluntary_exits: List[p.SignedVoluntaryExit, p.MAX_VOLUNTARY_EXITS]
+            sync_aggregate: p.SyncAggregate
+            execution_payload: ExecutionPayload
+
+        class BeaconBlock(Container):
+            slot: uint64
+            proposer_index: uint64
+            parent_root: Bytes32
+            state_root: Bytes32
+            body: BeaconBlockBody
+
+        class SignedBeaconBlock(Container):
+            message: BeaconBlock
+            signature: Bytes96
+
+        class BeaconState(Container):
+            genesis_time: uint64
+            genesis_validators_root: Bytes32
+            slot: uint64
+            fork: p.Fork
+            latest_block_header: p.BeaconBlockHeader
+            block_roots: Vector[Bytes32, p.SLOTS_PER_HISTORICAL_ROOT]
+            state_roots: Vector[Bytes32, p.SLOTS_PER_HISTORICAL_ROOT]
+            historical_roots: List[Bytes32, p.HISTORICAL_ROOTS_LIMIT]
+            eth1_data: p.Eth1Data
+            eth1_data_votes: List[p.Eth1Data, p.EPOCHS_PER_ETH1_VOTING_PERIOD * p.SLOTS_PER_EPOCH]
+            eth1_deposit_index: uint64
+            validators: List[p.Validator, p.VALIDATOR_REGISTRY_LIMIT]
+            balances: List[uint64, p.VALIDATOR_REGISTRY_LIMIT]
+            randao_mixes: Vector[Bytes32, p.EPOCHS_PER_HISTORICAL_VECTOR]
+            slashings: Vector[uint64, p.EPOCHS_PER_SLASHINGS_VECTOR]
+            previous_epoch_participation: List[p.ParticipationFlags, p.VALIDATOR_REGISTRY_LIMIT]
+            current_epoch_participation: List[p.ParticipationFlags, p.VALIDATOR_REGISTRY_LIMIT]
+            justification_bits: Bitvector[p.JUSTIFICATION_BITS_LENGTH]
+            previous_justified_checkpoint: p.Checkpoint
+            current_justified_checkpoint: p.Checkpoint
+            finalized_checkpoint: p.Checkpoint
+            inactivity_scores: List[uint64, p.VALIDATOR_REGISTRY_LIMIT]
+            current_sync_committee: p.SyncCommittee
+            next_sync_committee: p.SyncCommittee
+            latest_execution_payload_header: ExecutionPayloadHeader
+
+        class PowBlock(Container):
+            block_hash: Bytes32
+            parent_hash: Bytes32
+            total_difficulty: uint256
+
+        for name, cls in list(locals().items()):
+            if isinstance(cls, type) and issubclass(cls, Container):
+                setattr(self, name, cls)
+
+    # ------------------------------------------------------------------
+    # merge predicates
+    # ------------------------------------------------------------------
+    def is_merge_transition_complete(self, state) -> bool:
+        return state.latest_execution_payload_header \
+            != self.ExecutionPayloadHeader()
+
+    def is_merge_transition_block(self, state, body) -> bool:
+        return (not self.is_merge_transition_complete(state)
+                and body.execution_payload != self.ExecutionPayload())
+
+    def is_execution_enabled(self, state, body) -> bool:
+        return self.is_merge_transition_block(state, body) \
+            or self.is_merge_transition_complete(state)
+
+    def compute_timestamp_at_slot(self, state, slot) -> int:
+        slots_since_genesis = slot - self.GENESIS_SLOT
+        return uint64(state.genesis_time
+                      + slots_since_genesis * self.config.SECONDS_PER_SLOT)
+
+    def get_pow_block(self, block_hash):
+        return self.pow_chain.get(bytes(block_hash))
+
+    def is_valid_terminal_pow_block(self, block, parent) -> bool:
+        ttd = int(self.config.TERMINAL_TOTAL_DIFFICULTY)
+        is_total_difficulty_reached = block.total_difficulty >= ttd
+        is_parent_total_difficulty_valid = parent.total_difficulty < ttd
+        return is_total_difficulty_reached \
+            and is_parent_total_difficulty_valid
+
+    def validate_merge_block(self, block) -> None:
+        terminal_hash = bytes.fromhex(
+            str(self.config.TERMINAL_BLOCK_HASH)[2:])
+        if terminal_hash != b"\x00" * 32:
+            assert self.compute_epoch_at_slot(block.slot) >= int(
+                self.config.TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH)
+            assert bytes(block.body.execution_payload.parent_hash) \
+                == terminal_hash
+            return
+        pow_block = self.get_pow_block(
+            block.body.execution_payload.parent_hash)
+        assert pow_block is not None
+        pow_parent = self.get_pow_block(pow_block.parent_hash)
+        assert pow_parent is not None
+        assert self.is_valid_terminal_pow_block(pow_block, pow_parent)
+
+    # ------------------------------------------------------------------
+    # block processing
+    # ------------------------------------------------------------------
+    def process_block(self, state, block) -> None:
+        self.process_block_header(state, block)
+        if self.is_execution_enabled(state, block.body):
+            self.process_execution_payload(
+                state, block.body, self.EXECUTION_ENGINE)
+        self.process_randao(state, block.body)
+        self.process_eth1_data(state, block.body)
+        self.process_operations(state, block.body)
+        self.process_sync_aggregate(state, block.body.sync_aggregate)
+
+    def process_execution_payload(self, state, body, execution_engine) -> None:
+        payload = body.execution_payload
+        if self.is_merge_transition_complete(state):
+            assert payload.parent_hash == \
+                state.latest_execution_payload_header.block_hash
+        assert payload.prev_randao == self.get_randao_mix(
+            state, self.get_current_epoch(state))
+        assert payload.timestamp == self.compute_timestamp_at_slot(
+            state, state.slot)
+        assert execution_engine.verify_and_notify_new_payload(payload)
+        state.latest_execution_payload_header = \
+            self.build_execution_payload_header(payload)
+
+    def build_execution_payload_header(self, payload):
+        return self.ExecutionPayloadHeader(
+            parent_hash=payload.parent_hash,
+            fee_recipient=payload.fee_recipient,
+            state_root=payload.state_root,
+            receipts_root=payload.receipts_root,
+            logs_bloom=payload.logs_bloom,
+            prev_randao=payload.prev_randao,
+            block_number=payload.block_number,
+            gas_limit=payload.gas_limit,
+            gas_used=payload.gas_used,
+            timestamp=payload.timestamp,
+            extra_data=payload.extra_data,
+            base_fee_per_gas=payload.base_fee_per_gas,
+            block_hash=payload.block_hash,
+            transactions_root=hash_tree_root(payload.transactions))
+
+    # quotients
+    def inactivity_penalty_quotient(self) -> int:
+        return self.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX
+
+    def min_slashing_penalty_quotient(self) -> int:
+        return self.MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX
+
+    def proportional_slashing_multiplier(self) -> int:
+        return self.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX
+
+    # fork-choice extension (fork_choice/safe-block.md + bellatrix/fork-choice.md)
+    def get_safe_execution_block_hash(self, store):
+        safe_block_root = self.get_safe_beacon_block_root(store)
+        safe_block = store.blocks[safe_block_root]
+        if self.is_execution_enabled(
+                store.block_states[safe_block_root], safe_block.body):
+            return safe_block.body.execution_payload.block_hash
+        return Bytes32()
+
+    def should_override_forkchoice_update(self, store, head_root) -> bool:
+        head_block = store.blocks[head_root]
+        parent_root = head_block.parent_root
+        proposal_slot = uint64(head_block.slot + 1)
+        current_slot = self.get_current_slot(store)
+
+        head_late = self.is_head_late(store, head_root)
+        shuffling_stable = self.is_shuffling_stable(proposal_slot)
+        ffg_competitive = self.is_ffg_competitive(store, head_root,
+                                                  parent_root)
+        finalization_ok = self.is_finalization_ok(store, proposal_slot)
+        proposing_reorg_slot = current_slot == head_block.slot or \
+            current_slot == proposal_slot
+        parent_block = store.blocks[parent_root]
+        parent_slot_ok = parent_block.slot + 1 == head_block.slot
+        proposing_on_time = (self.is_proposing_on_time(store)
+                             if current_slot == proposal_slot else True)
+        if not all([head_late, shuffling_stable, ffg_competitive,
+                    finalization_ok, proposing_reorg_slot, parent_slot_ok,
+                    proposing_on_time]):
+            return False
+        # only consult weights once the head slot's attestations have been
+        # counted; before that, assume the reorg conditions hold
+        head_weak = True
+        parent_strong = True
+        if current_slot > head_block.slot:
+            head_weak = self.is_head_weak(store, head_root)
+            parent_strong = self.is_parent_strong(store, parent_root)
+        return head_weak and parent_strong
+
+    # ------------------------------------------------------------------
+    # fork upgrade (bellatrix/fork.md)
+    # ------------------------------------------------------------------
+    def genesis_fork_versions(self):
+        return (Bytes4(self.config.ALTAIR_FORK_VERSION),
+                Bytes4(self.config.BELLATRIX_FORK_VERSION))
+
+    def upgrade_from(self, pre):
+        epoch = self.get_current_epoch(pre)
+        post = self.BeaconState(
+            genesis_time=pre.genesis_time,
+            genesis_validators_root=pre.genesis_validators_root,
+            slot=pre.slot,
+            fork=self.Fork(
+                previous_version=pre.fork.current_version,
+                current_version=Bytes4(self.config.BELLATRIX_FORK_VERSION),
+                epoch=epoch),
+            latest_block_header=pre.latest_block_header,
+            block_roots=list(pre.block_roots),
+            state_roots=list(pre.state_roots),
+            historical_roots=list(pre.historical_roots),
+            eth1_data=pre.eth1_data,
+            eth1_data_votes=list(pre.eth1_data_votes),
+            eth1_deposit_index=pre.eth1_deposit_index,
+            validators=list(pre.validators),
+            balances=list(pre.balances),
+            randao_mixes=list(pre.randao_mixes),
+            slashings=list(pre.slashings),
+            previous_epoch_participation=list(
+                pre.previous_epoch_participation),
+            current_epoch_participation=list(
+                pre.current_epoch_participation),
+            justification_bits=list(pre.justification_bits),
+            previous_justified_checkpoint=pre.previous_justified_checkpoint,
+            current_justified_checkpoint=pre.current_justified_checkpoint,
+            finalized_checkpoint=pre.finalized_checkpoint,
+            inactivity_scores=list(pre.inactivity_scores),
+            current_sync_committee=pre.current_sync_committee,
+            next_sync_committee=pre.next_sync_committee,
+            # latest_execution_payload_header stays default (pre-merge)
+        )
+        return post

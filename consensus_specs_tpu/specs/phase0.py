@@ -582,6 +582,12 @@ class Phase0Spec(Phase0ForkChoice, Phase0ValidatorDuties, BaseSpec):
         state.genesis_validators_root = hash_tree_root(state.validators)
         return state
 
+    def genesis_fork_versions(self):
+        """(previous_version, current_version) for a state born at this
+        fork — used by mock-genesis fixtures; later forks override."""
+        v = Bytes4(self.config.GENESIS_FORK_VERSION)
+        return (v, v)
+
     def is_valid_genesis_state(self, state) -> bool:
         if state.genesis_time < self.config.MIN_GENESIS_TIME:
             return False

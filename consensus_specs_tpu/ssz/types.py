@@ -721,6 +721,23 @@ class Container(SSZType):
     def fields(cls) -> dict:
         return dict(zip(cls._field_names, cls._field_types))
 
+    @classmethod
+    def coerce(cls, value):
+        if isinstance(value, cls):
+            return value
+        if isinstance(value, Container):
+            # same-shaped container from another spec instance (each fork x
+            # preset builds its own classes): rebuild structurally
+            if not cls.ssz_compatible(type(value)):
+                raise TypeError(
+                    f"cannot coerce {type(value).__name__} to "
+                    f"{cls.__name__}: incompatible SSZ structure")
+            return cls.deserialize(value.serialize())
+        if isinstance(value, dict):
+            return cls(**value)
+        raise TypeError(
+            f"cannot coerce {type(value).__name__} to {cls.__name__}")
+
     def __init__(self, **kwargs):
         values = {}
         for name, t in zip(self._field_names, self._field_types):

@@ -55,7 +55,43 @@ def build_empty_block(spec, state, slot=None):
     privkey = proposer_privkey(spec, lookahead, proposer_index)
     block.body.randao_reveal = spec.get_epoch_signature(
         lookahead, block, privkey)
+    if spec.is_post("altair"):
+        # empty sync aggregate carries the point-at-infinity signature
+        block.body.sync_aggregate.sync_committee_signature = \
+            spec.G2_POINT_AT_INFINITY
+    if spec.is_post("bellatrix") and spec.is_merge_transition_complete(
+            lookahead):
+        block.body.execution_payload = build_empty_execution_payload(
+            spec, lookahead)
     return block
+
+
+def build_empty_execution_payload(spec, state):
+    """A payload consistent with `state` at its current slot: satisfies the
+    spec asserts (parent hash, randao, timestamp, expected withdrawals);
+    execution-layer contents are vacuous under the noop engine."""
+    latest = state.latest_execution_payload_header
+    payload = spec.ExecutionPayload(
+        parent_hash=latest.block_hash,
+        fee_recipient=b"\x00" * 20,
+        state_root=latest.state_root,
+        receipts_root=b"\x1d\xcc\x4d\xe8\xde\xc7\x5d\x7a\xab\x85\xb5\x67"
+                      b"\xb6\xcc\xd4\x1a\xd3\x12\x45\x1b\x94\x8a\x74\x13"
+                      b"\xf0\xa1\x42\xfd\x40\xd4\x93\x47",
+        logs_bloom=b"\x00" * spec.BYTES_PER_LOGS_BLOOM,
+        prev_randao=spec.get_randao_mix(state,
+                                        spec.get_current_epoch(state)),
+        block_number=uint64(latest.block_number + 1),
+        gas_limit=latest.gas_limit,
+        gas_used=0,
+        timestamp=spec.compute_timestamp_at_slot(state, state.slot),
+        base_fee_per_gas=latest.base_fee_per_gas)
+    if spec.is_post("capella"):
+        payload.withdrawals = spec.get_expected_withdrawals(state)
+    # a deterministic fake block hash binding the payload contents
+    payload.block_hash = spec.hash(
+        bytes(spec.hash_tree_root(payload)) + b"FAKE RLP HASH")
+    return payload
 
 
 def build_empty_block_for_next_slot(spec, state):

@@ -45,6 +45,11 @@ def create_genesis_state(spec, validator_balances, activation_threshold=None):
             body_root=hash_tree_root(spec.BeaconBlockBody())),
         randao_mixes=[eth1_block_hash] * spec.EPOCHS_PER_HISTORICAL_VECTOR)
 
+    previous_version, current_version = spec.genesis_fork_versions()
+    state.fork = spec.Fork(previous_version=previous_version,
+                           current_version=current_version,
+                           epoch=spec.GENESIS_EPOCH)
+
     for index, balance in enumerate(validator_balances):
         validator = build_mock_validator(spec, index, balance)
         if validator.effective_balance >= activation_threshold:
@@ -54,7 +59,43 @@ def create_genesis_state(spec, validator_balances, activation_threshold=None):
         state.balances.append(balance)
 
     state.genesis_validators_root = hash_tree_root(state.validators)
+
+    if spec.is_post("altair"):
+        n = len(validator_balances)
+        state.previous_epoch_participation = [0] * n
+        state.current_epoch_participation = [0] * n
+        state.inactivity_scores = [0] * n
+        state.current_sync_committee = spec.get_next_sync_committee(state)
+        state.next_sync_committee = spec.get_next_sync_committee(state)
+
+    if spec.is_post("bellatrix"):
+        # post-bellatrix mock genesis is post-merge: sample payload header
+        state.latest_execution_payload_header = \
+            sample_genesis_execution_payload_header(spec, eth1_block_hash)
+
     return state
+
+
+def sample_genesis_execution_payload_header(spec, eth1_block_hash):
+    header = spec.ExecutionPayloadHeader(
+        parent_hash=b"\x30" * 32,
+        fee_recipient=b"\x42" * 20,
+        state_root=b"\x20" * 32,
+        receipts_root=b"\x20" * 32,
+        logs_bloom=b"\x35" * spec.BYTES_PER_LOGS_BLOOM,
+        prev_randao=eth1_block_hash,
+        block_number=0,
+        gas_limit=30000000,
+        gas_used=0,
+        timestamp=0,
+        base_fee_per_gas=1000000000,
+        block_hash=eth1_block_hash,
+        transactions_root=spec.hash_tree_root(
+            spec.ExecutionPayload.fields()["transactions"]()))
+    if spec.is_post("capella"):
+        header.withdrawals_root = spec.hash_tree_root(
+            spec.ExecutionPayload.fields()["withdrawals"]())
+    return header
 
 
 def default_balances(spec):

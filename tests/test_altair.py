@@ -1,0 +1,128 @@
+"""Altair end-to-end: sync committees, participation-flag accounting,
+sync aggregates, and the phase0->altair upgrade.
+"""
+import pytest
+
+from consensus_specs_tpu.specs import get_spec
+from consensus_specs_tpu.ssz import hash_tree_root, uint64
+from consensus_specs_tpu.test_infra import disable_bls
+from consensus_specs_tpu.test_infra.genesis import (
+    create_genesis_state, default_balances)
+from consensus_specs_tpu.test_infra.blocks import (
+    apply_empty_block, build_empty_block_for_next_slot, next_slot,
+    next_epoch, state_transition_and_sign_block)
+from consensus_specs_tpu.test_infra.attestations import get_valid_attestation
+from consensus_specs_tpu.test_infra.keys import privkey_for_pubkey
+from consensus_specs_tpu.utils import bls
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_spec("altair", "minimal")
+
+
+@pytest.fixture()
+def state(spec):
+    with disable_bls():  # mock genesis needs no signatures
+        return create_genesis_state(spec, default_balances(spec))
+
+
+def test_genesis_has_sync_committees(spec, state):
+    assert len(state.current_sync_committee.pubkeys) == \
+        spec.SYNC_COMMITTEE_SIZE
+    assert spec.eth_aggregate_pubkeys(
+        list(state.current_sync_committee.pubkeys)) == \
+        state.current_sync_committee.aggregate_pubkey
+
+
+def test_empty_block_transition(spec, state):
+    with disable_bls():
+        signed = apply_empty_block(spec, state)
+    assert state.slot == 1
+    assert signed.message.state_root == hash_tree_root(state)
+
+
+def test_attestation_sets_participation_flags(spec, state):
+    with disable_bls():
+        attestation = get_valid_attestation(spec, state, signed=True)
+        next_slot(spec, state)
+        spec.process_attestation(state, attestation)
+    flagged = [i for i, f in enumerate(state.current_epoch_participation)
+               if f != 0]
+    attesters = spec.get_attesting_indices(state, attestation)
+    assert set(flagged) == set(int(i) for i in attesters)
+    for i in flagged:
+        assert spec.has_flag(state.current_epoch_participation[i],
+                             spec.TIMELY_SOURCE_FLAG_INDEX)
+        assert spec.has_flag(state.current_epoch_participation[i],
+                             spec.TIMELY_HEAD_FLAG_INDEX)
+
+
+def test_sync_aggregate_real_signatures(spec, state):
+    """North-star config #2 shape: a full sync-committee aggregate verify."""
+    next_slot(spec, state)
+    previous_slot = uint64(state.slot - 1)
+    root = spec.get_block_root_at_slot(state, previous_slot)
+    domain = spec.get_domain(state, spec.DOMAIN_SYNC_COMMITTEE,
+                             spec.compute_epoch_at_slot(previous_slot))
+    signing_root = spec.compute_signing_root(root, domain)
+
+    committee_pubkeys = list(state.current_sync_committee.pubkeys)
+    signatures = [
+        bls.Sign(privkey_for_pubkey(pk), signing_root)
+        for pk in committee_pubkeys]
+    aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * spec.SYNC_COMMITTEE_SIZE,
+        sync_committee_signature=bls.Aggregate(signatures))
+
+    pre_proposer_balance = int(state.balances[
+        spec.get_beacon_proposer_index(state)])
+    spec.process_sync_aggregate(state, aggregate)
+    # everyone participated: no decreases; proposer strictly gains
+    assert int(state.balances[spec.get_beacon_proposer_index(state)]) \
+        > pre_proposer_balance
+
+
+def test_sync_aggregate_bad_signature_rejected(spec, state):
+    next_slot(spec, state)
+    aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * spec.SYNC_COMMITTEE_SIZE,
+        sync_committee_signature=b"\x11" * 96)
+    with pytest.raises(AssertionError):
+        spec.process_sync_aggregate(state, aggregate)
+
+
+def test_empty_sync_aggregate_infinity_signature(spec, state):
+    next_slot(spec, state)
+    aggregate = spec.SyncAggregate(
+        sync_committee_bits=[False] * spec.SYNC_COMMITTEE_SIZE,
+        sync_committee_signature=spec.G2_POINT_AT_INFINITY)
+    spec.process_sync_aggregate(state, aggregate)  # must not raise
+
+
+def test_epoch_accounting_and_finality(spec, state):
+    from consensus_specs_tpu.test_infra.attestations import (
+        next_epoch_with_attestations)
+    with disable_bls():
+        next_epoch(spec, state)
+        apply_empty_block(spec, state)
+        for _ in range(4):
+            next_epoch_with_attestations(spec, state, True, True)
+        assert state.finalized_checkpoint.epoch > 0
+        # no inactivity leak under full participation
+        assert not spec.is_in_inactivity_leak(state)
+        assert all(int(s) == 0 for s in state.inactivity_scores)
+
+
+def test_upgrade_from_phase0(spec):
+    phase0 = get_spec("phase0", "minimal")
+    with disable_bls():
+        pre = create_genesis_state(phase0, default_balances(phase0))
+        next_epoch(phase0, pre)
+        post = spec.upgrade_from(pre)
+    assert bytes(post.fork.current_version) == \
+        bytes.fromhex(spec.config.ALTAIR_FORK_VERSION[2:])
+    assert len(post.inactivity_scores) == len(pre.validators)
+    assert len(post.current_sync_committee.pubkeys) == \
+        spec.SYNC_COMMITTEE_SIZE
+    assert hash_tree_root(post.validators) == hash_tree_root(pre.validators)

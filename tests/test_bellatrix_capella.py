@@ -1,0 +1,126 @@
+"""Bellatrix + capella: execution payloads, merge predicates, withdrawals,
+BLS-to-execution changes, fork upgrades.
+"""
+import pytest
+
+from consensus_specs_tpu.specs import get_spec
+from consensus_specs_tpu.ssz import hash_tree_root, uint64
+from consensus_specs_tpu.test_infra import disable_bls
+from consensus_specs_tpu.test_infra.genesis import (
+    create_genesis_state, default_balances)
+from consensus_specs_tpu.test_infra.blocks import (
+    apply_empty_block, build_empty_block_for_next_slot,
+    build_empty_execution_payload, next_slot, next_epoch,
+    state_transition_and_sign_block)
+from consensus_specs_tpu.test_infra.keys import pubkeys, privkeys
+from consensus_specs_tpu.utils import bls
+
+
+@pytest.fixture(scope="module")
+def bspec():
+    return get_spec("bellatrix", "minimal")
+
+
+@pytest.fixture(scope="module")
+def cspec():
+    return get_spec("capella", "minimal")
+
+
+def make_state(spec):
+    with disable_bls():
+        return create_genesis_state(spec, default_balances(spec))
+
+
+def test_bellatrix_genesis_is_post_merge(bspec):
+    state = make_state(bspec)
+    assert bspec.is_merge_transition_complete(state)
+
+
+def test_bellatrix_empty_block_with_payload(bspec):
+    state = make_state(bspec)
+    with disable_bls():
+        signed = apply_empty_block(bspec, state)
+    payload = signed.message.body.execution_payload
+    assert payload.block_number == 1
+    assert state.latest_execution_payload_header.block_hash == \
+        payload.block_hash
+
+
+def test_bellatrix_payload_bad_timestamp_rejected(bspec):
+    state = make_state(bspec)
+    with disable_bls():
+        block = build_empty_block_for_next_slot(bspec, state)
+        block.body.execution_payload.timestamp = uint64(12345)
+        bspec.process_slots(state, block.slot)
+        with pytest.raises(AssertionError):
+            bspec.process_block(state, block)
+
+
+def test_capella_withdrawals_sweep(cspec):
+    state = make_state(cspec)
+    # give validator 3 an eth1 credential and an excess balance
+    v = state.validators[3]
+    v.withdrawal_credentials = (
+        cspec.ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11 + b"\xaa" * 20)
+    state.balances[3] = uint64(int(state.balances[3]) + 5_000_000_000)
+
+    expected = cspec.get_expected_withdrawals(state)
+    assert len(expected) == 1
+    assert int(expected[0].validator_index) == 3
+    assert int(expected[0].amount) == 5_000_000_000
+
+    with disable_bls():
+        apply_empty_block(cspec, state)
+    assert int(state.balances[3]) == cspec.MAX_EFFECTIVE_BALANCE
+    assert int(state.next_withdrawal_index) == 1
+
+
+def test_capella_bls_to_execution_change(cspec):
+    state = make_state(cspec)
+    index = 5
+    privkey = privkeys[index]
+    change = cspec.BLSToExecutionChange(
+        validator_index=index,
+        from_bls_pubkey=pubkeys[index],
+        to_execution_address=b"\xbb" * 20)
+    domain = cspec.compute_domain(
+        cspec.DOMAIN_BLS_TO_EXECUTION_CHANGE,
+        genesis_validators_root=state.genesis_validators_root)
+    signing_root = cspec.compute_signing_root(change, domain)
+    signed = cspec.SignedBLSToExecutionChange(
+        message=change, signature=bls.Sign(privkey, signing_root))
+
+    cspec.process_bls_to_execution_change(state, signed)
+    wc = bytes(state.validators[index].withdrawal_credentials)
+    assert wc[:1] == cspec.ETH1_ADDRESS_WITHDRAWAL_PREFIX
+    assert wc[12:] == b"\xbb" * 20
+
+    # probe: replay now fails (credentials no longer BLS-prefixed)
+    with pytest.raises(AssertionError):
+        cspec.process_bls_to_execution_change(state, signed)
+
+
+def test_upgrade_chain_phase0_to_capella():
+    with disable_bls():
+        phase0 = get_spec("phase0", "minimal")
+        state = create_genesis_state(phase0, default_balances(phase0))
+        next_epoch(phase0, state)
+        for fork in ("altair", "bellatrix", "capella"):
+            spec = get_spec(fork, "minimal")
+            state = spec.upgrade_from(state)
+            expected_version = getattr(spec.config,
+                                       f"{fork.upper()}_FORK_VERSION")
+            assert bytes(state.fork.current_version) == \
+                bytes.fromhex(expected_version[2:])
+        cspec = get_spec("capella", "minimal")
+        assert int(state.next_withdrawal_index) == 0
+        # post-upgrade state still transitions (pre-merge: no payload)
+        apply_empty_block(cspec, state)
+
+
+def test_capella_epoch_transition(cspec):
+    state = make_state(cspec)
+    with disable_bls():
+        next_epoch(cspec, state)
+        apply_empty_block(cspec, state)
+    assert state.slot == cspec.SLOTS_PER_EPOCH + 1
