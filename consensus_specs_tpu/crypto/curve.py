@@ -195,6 +195,51 @@ class Point:
         return f"Point(infinity)" if a is None else f"Point({a[0]!r}, {a[1]!r})"
 
 
+def msm(points: list, scalars: list) -> Point:
+    """Pippenger multi-scalar multiplication.
+
+    The pure-Python counterpart of the reference's arkworks
+    `multiexp_unchecked` (SURVEY.md §2.2) and the algorithmic blueprint for
+    the TPU bucket-accumulation kernel (ops/).  ~c-bit windows over 255-bit
+    scalars with bucket accumulation per window.
+    """
+    if len(points) != len(scalars):
+        raise ValueError("msm: length mismatch")
+    pairs = [(p, int(s) % R) for p, s in zip(points, scalars)
+             if int(s) % R != 0 and not p.is_infinity()]
+    if not pairs:
+        base = points[0].b if points else B1
+        return Point.infinity(base)
+    points = [p for p, _ in pairs]
+    scalars = [s for _, s in pairs]
+    n = len(points)
+    c = 8 if n >= 128 else (4 if n >= 8 else 1)
+    mask = (1 << c) - 1
+    num_windows = (255 + c) // c
+    window_sums = []
+    for w in range(num_windows):
+        shift = w * c
+        buckets: list = [None] * mask
+        for p, s in zip(points, scalars):
+            idx = (s >> shift) & mask
+            if idx:
+                buckets[idx - 1] = p if buckets[idx - 1] is None \
+                    else buckets[idx - 1] + p
+        running = Point.infinity(points[0].b)
+        acc = Point.infinity(points[0].b)
+        for b in reversed(buckets):
+            if b is not None:
+                running = running + b
+            acc = acc + running
+        window_sums.append(acc)
+    result = window_sums[-1]
+    for ws in reversed(window_sums[:-1]):
+        for _ in range(c):
+            result = result.double()
+        result = result + ws
+    return result
+
+
 def g1_generator() -> Point:
     return Point(G1_X, G1_Y, Fq1.one(), B1)
 

@@ -221,14 +221,29 @@ class CapellaSpec(BellatrixSpec):
     # ------------------------------------------------------------------
     def process_block(self, state, block) -> None:
         self.process_block_header(state, block)
-        if self.is_execution_enabled(state, block.body):
-            self.process_withdrawals(state, block.body.execution_payload)
-            self.process_execution_payload(
-                state, block.body, self.EXECUTION_ENGINE)
+        # [Modified in Capella] no is_execution_enabled gate: withdrawals
+        # and payload processing are unconditional post-capella
+        self.process_withdrawals(state, block.body.execution_payload)
+        self.process_execution_payload(
+            state, block.body, self.EXECUTION_ENGINE)
         self.process_randao(state, block.body)
         self.process_eth1_data(state, block.body)
         self.process_operations(state, block.body)
         self.process_sync_aggregate(state, block.body.sync_aggregate)
+
+    def process_execution_payload(self, state, body,
+                                  execution_engine) -> None:
+        payload = body.execution_payload
+        # [Modified in Capella] parent-hash check is unconditional
+        assert payload.parent_hash == \
+            state.latest_execution_payload_header.block_hash
+        assert payload.prev_randao == self.get_randao_mix(
+            state, self.get_current_epoch(state))
+        assert payload.timestamp == self.compute_timestamp_at_slot(
+            state, state.slot)
+        assert execution_engine.verify_and_notify_new_payload(payload)
+        state.latest_execution_payload_header = \
+            self.build_execution_payload_header(payload)
 
     def process_operations(self, state, body) -> None:
         super().process_operations(state, body)

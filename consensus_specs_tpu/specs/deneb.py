@@ -1,0 +1,369 @@
+"""Deneb spec: blobs (EIP-4844), KZG commitments, blob sidecars.
+
+From-scratch implementation of /root/reference/specs/deneb/
+{beacon-chain.md,polynomial-commitments.md,fork-choice.md,p2p-interface.md}
+as a CapellaSpec subclass.  The KZG engine lives in crypto/kzg.py; the spec
+surface re-exports it under the spec function names.
+"""
+from dataclasses import dataclass
+
+from ..ssz import (
+    uint64, uint256, Bitvector, Vector, List, Container, ByteList,
+    ByteVector, Bytes4, Bytes20, Bytes32, Bytes48, Bytes96,
+    hash_tree_root,
+)
+from ..ssz.proofs import (
+    compute_merkle_proof, get_generalized_index,
+    get_generalized_index_length, get_subtree_index,
+)
+from ..crypto.kzg import (
+    get_kzg, bls_field_to_bytes, bytes_to_bls_field, hash_to_bls_field,
+    compute_powers, bit_reversal_permutation, BYTES_PER_FIELD_ELEMENT,
+)
+from .capella import CapellaSpec
+
+
+@dataclass
+class NewPayloadRequest:
+    execution_payload: object
+    versioned_hashes: list
+    parent_beacon_block_root: bytes
+
+
+class DenebSpec(CapellaSpec):
+    fork = "deneb"
+
+    def _build_constants(self) -> None:
+        super()._build_constants()
+        self.VERSIONED_HASH_VERSION_KZG = b"\x01"
+        self.BLS_MODULUS = \
+            0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+        self.BYTES_PER_FIELD_ELEMENT = BYTES_PER_FIELD_ELEMENT
+        self.BYTES_PER_BLOB = \
+            BYTES_PER_FIELD_ELEMENT * self.FIELD_ELEMENTS_PER_BLOB
+        self.VersionedHash = Bytes32
+        self.BlobIndex = uint64
+        self.KZGCommitment = Bytes48
+        self.KZGProof = Bytes48
+        self._kzg = get_kzg(self.FIELD_ELEMENTS_PER_BLOB)
+
+    def _build_types(self) -> None:
+        super()._build_types()
+        p = self
+
+        self.Blob = ByteVector[p.BYTES_PER_BLOB]
+
+        class ExecutionPayload(Container):
+            parent_hash: Bytes32
+            fee_recipient: Bytes20
+            state_root: Bytes32
+            receipts_root: Bytes32
+            logs_bloom: ByteVector[p.BYTES_PER_LOGS_BLOOM]
+            prev_randao: Bytes32
+            block_number: uint64
+            gas_limit: uint64
+            gas_used: uint64
+            timestamp: uint64
+            extra_data: ByteList[p.MAX_EXTRA_DATA_BYTES]
+            base_fee_per_gas: uint256
+            block_hash: Bytes32
+            transactions: List[p.Transaction, p.MAX_TRANSACTIONS_PER_PAYLOAD]
+            withdrawals: List[p.Withdrawal, p.MAX_WITHDRAWALS_PER_PAYLOAD]
+            blob_gas_used: uint64
+            excess_blob_gas: uint64
+
+        class ExecutionPayloadHeader(Container):
+            parent_hash: Bytes32
+            fee_recipient: Bytes20
+            state_root: Bytes32
+            receipts_root: Bytes32
+            logs_bloom: ByteVector[p.BYTES_PER_LOGS_BLOOM]
+            prev_randao: Bytes32
+            block_number: uint64
+            gas_limit: uint64
+            gas_used: uint64
+            timestamp: uint64
+            extra_data: ByteList[p.MAX_EXTRA_DATA_BYTES]
+            base_fee_per_gas: uint256
+            block_hash: Bytes32
+            transactions_root: Bytes32
+            withdrawals_root: Bytes32
+            blob_gas_used: uint64
+            excess_blob_gas: uint64
+
+        class BeaconBlockBody(Container):
+            randao_reveal: Bytes96
+            eth1_data: p.Eth1Data
+            graffiti: Bytes32
+            proposer_slashings: List[p.ProposerSlashing, p.MAX_PROPOSER_SLASHINGS]
+            attester_slashings: List[p.AttesterSlashing, p.MAX_ATTESTER_SLASHINGS]
+            attestations: List[p.Attestation, p.MAX_ATTESTATIONS]
+            deposits: List[p.Deposit, p.MAX_DEPOSITS]
+            voluntary_exits: List[p.SignedVoluntaryExit, p.MAX_VOLUNTARY_EXITS]
+            sync_aggregate: p.SyncAggregate
+            execution_payload: ExecutionPayload
+            bls_to_execution_changes: List[p.SignedBLSToExecutionChange, p.MAX_BLS_TO_EXECUTION_CHANGES]
+            blob_kzg_commitments: List[Bytes48, p.MAX_BLOB_COMMITMENTS_PER_BLOCK]
+
+        class BeaconBlock(Container):
+            slot: uint64
+            proposer_index: uint64
+            parent_root: Bytes32
+            state_root: Bytes32
+            body: BeaconBlockBody
+
+        class SignedBeaconBlock(Container):
+            message: BeaconBlock
+            signature: Bytes96
+
+        class BeaconState(Container):
+            genesis_time: uint64
+            genesis_validators_root: Bytes32
+            slot: uint64
+            fork: p.Fork
+            latest_block_header: p.BeaconBlockHeader
+            block_roots: Vector[Bytes32, p.SLOTS_PER_HISTORICAL_ROOT]
+            state_roots: Vector[Bytes32, p.SLOTS_PER_HISTORICAL_ROOT]
+            historical_roots: List[Bytes32, p.HISTORICAL_ROOTS_LIMIT]
+            eth1_data: p.Eth1Data
+            eth1_data_votes: List[p.Eth1Data, p.EPOCHS_PER_ETH1_VOTING_PERIOD * p.SLOTS_PER_EPOCH]
+            eth1_deposit_index: uint64
+            validators: List[p.Validator, p.VALIDATOR_REGISTRY_LIMIT]
+            balances: List[uint64, p.VALIDATOR_REGISTRY_LIMIT]
+            randao_mixes: Vector[Bytes32, p.EPOCHS_PER_HISTORICAL_VECTOR]
+            slashings: Vector[uint64, p.EPOCHS_PER_SLASHINGS_VECTOR]
+            previous_epoch_participation: List[p.ParticipationFlags, p.VALIDATOR_REGISTRY_LIMIT]
+            current_epoch_participation: List[p.ParticipationFlags, p.VALIDATOR_REGISTRY_LIMIT]
+            justification_bits: Bitvector[p.JUSTIFICATION_BITS_LENGTH]
+            previous_justified_checkpoint: p.Checkpoint
+            current_justified_checkpoint: p.Checkpoint
+            finalized_checkpoint: p.Checkpoint
+            inactivity_scores: List[uint64, p.VALIDATOR_REGISTRY_LIMIT]
+            current_sync_committee: p.SyncCommittee
+            next_sync_committee: p.SyncCommittee
+            latest_execution_payload_header: ExecutionPayloadHeader
+            next_withdrawal_index: uint64
+            next_withdrawal_validator_index: uint64
+            historical_summaries: List[p.HistoricalSummary, p.HISTORICAL_ROOTS_LIMIT]
+
+        class BlobSidecar(Container):
+            index: uint64
+            blob: p.Blob
+            kzg_commitment: Bytes48
+            kzg_proof: Bytes48
+            signed_block_header: p.SignedBeaconBlockHeader
+            kzg_commitment_inclusion_proof: Vector[Bytes32, p.KZG_COMMITMENT_INCLUSION_PROOF_DEPTH]
+
+        class BlobIdentifier(Container):
+            block_root: Bytes32
+            index: uint64
+
+        for name, cls in list(locals().items()):
+            if isinstance(cls, type) and issubclass(cls, Container):
+                setattr(self, name, cls)
+
+    # ------------------------------------------------------------------
+    # KZG spec surface (polynomial-commitments.md)
+    # ------------------------------------------------------------------
+    blob_to_kzg_commitment = property(
+        lambda self: self._kzg.blob_to_kzg_commitment)
+    compute_kzg_proof = property(lambda self: self._kzg.compute_kzg_proof)
+    compute_blob_kzg_proof = property(
+        lambda self: self._kzg.compute_blob_kzg_proof)
+    verify_kzg_proof = property(lambda self: self._kzg.verify_kzg_proof)
+    verify_kzg_proof_batch = property(
+        lambda self: self._kzg.verify_kzg_proof_batch)
+    verify_blob_kzg_proof = property(
+        lambda self: self._kzg.verify_blob_kzg_proof)
+    verify_blob_kzg_proof_batch = property(
+        lambda self: self._kzg.verify_blob_kzg_proof_batch)
+    blob_to_polynomial = property(lambda self: self._kzg.blob_to_polynomial)
+    compute_challenge = property(lambda self: self._kzg.compute_challenge)
+    g1_lincomb = property(lambda self: self._kzg.g1_lincomb)
+    evaluate_polynomial_in_evaluation_form = property(
+        lambda self: self._kzg.evaluate_polynomial_in_evaluation_form)
+
+    bytes_to_bls_field = staticmethod(bytes_to_bls_field)
+    bls_field_to_bytes = staticmethod(bls_field_to_bytes)
+    hash_to_bls_field = staticmethod(hash_to_bls_field)
+    compute_powers = staticmethod(compute_powers)
+    bit_reversal_permutation = staticmethod(bit_reversal_permutation)
+
+    # ------------------------------------------------------------------
+    # blob helpers (beacon-chain.md)
+    # ------------------------------------------------------------------
+    def kzg_commitment_to_versioned_hash(self, kzg_commitment) -> bytes:
+        return Bytes32(self.VERSIONED_HASH_VERSION_KZG
+                       + bytes(self.hash(bytes(kzg_commitment)))[1:])
+
+    def max_blobs_per_block(self) -> int:
+        return self.config.MAX_BLOBS_PER_BLOCK
+
+    # ------------------------------------------------------------------
+    # block processing deltas
+    # ------------------------------------------------------------------
+    def process_execution_payload(self, state, body,
+                                  execution_engine) -> None:
+        payload = body.execution_payload
+        assert payload.parent_hash == \
+            state.latest_execution_payload_header.block_hash
+        assert payload.prev_randao == self.get_randao_mix(
+            state, self.get_current_epoch(state))
+        assert payload.timestamp == self.compute_timestamp_at_slot(
+            state, state.slot)
+        # [New in Deneb] blob cap
+        assert len(body.blob_kzg_commitments) <= self.max_blobs_per_block()
+        versioned_hashes = [
+            self.kzg_commitment_to_versioned_hash(commitment)
+            for commitment in body.blob_kzg_commitments]
+        assert execution_engine.verify_and_notify_new_payload(
+            NewPayloadRequest(
+                execution_payload=payload,
+                versioned_hashes=versioned_hashes,
+                parent_beacon_block_root=state.latest_block_header.parent_root))
+        state.latest_execution_payload_header = \
+            self.build_execution_payload_header(payload)
+
+    def build_execution_payload_header(self, payload):
+        header = super().build_execution_payload_header(payload)
+        header.blob_gas_used = payload.blob_gas_used
+        header.excess_blob_gas = payload.excess_blob_gas
+        return header
+
+    def voluntary_exit_domain(self, state, voluntary_exit):
+        # [Modified in Deneb:EIP7044] pinned to the capella fork version
+        return self.compute_domain(
+            self.DOMAIN_VOLUNTARY_EXIT,
+            Bytes4(self.config.CAPELLA_FORK_VERSION),
+            state.genesis_validators_root)
+
+    def is_timely_target(self, state, is_matching_target,
+                         inclusion_delay) -> bool:
+        # [Modified in Deneb:EIP7045] no inclusion-delay bound for target
+        return is_matching_target
+
+    def check_attestation_inclusion_window(self, state, data) -> None:
+        # [Modified in Deneb:EIP7045] no upper inclusion bound
+        pass
+
+    # ------------------------------------------------------------------
+    # fork choice: blob data availability (deneb/fork-choice.md)
+    # ------------------------------------------------------------------
+    def retrieve_blobs_and_proofs(self, beacon_block_root):
+        """Network-retrieval stub; tests monkeypatch this
+        (the reference's pysetup/spec_builders/deneb.py:41-44 pattern)."""
+        return "TEST", "TEST"
+
+    def is_data_available(self, beacon_block_root, blob_kzg_commitments) -> bool:
+        blobs, proofs = self.retrieve_blobs_and_proofs(beacon_block_root)
+        if isinstance(blobs, str) and blobs == "TEST":
+            return True  # stubbed retrieval: assume available
+        return self.verify_blob_kzg_proof_batch(
+            blobs, [bytes(c) for c in blob_kzg_commitments], proofs)
+
+    def check_block_data_availability(self, store, signed_block) -> None:
+        assert self.is_data_available(
+            hash_tree_root(signed_block.message),
+            signed_block.message.body.blob_kzg_commitments)
+
+    # ------------------------------------------------------------------
+    # blob sidecars (p2p-interface.md + validator.md)
+    # ------------------------------------------------------------------
+    def get_blob_sidecars(self, signed_block, blobs, blob_kzg_proofs):
+        block = signed_block.message
+        block_header = self.BeaconBlockHeader(
+            slot=block.slot,
+            proposer_index=block.proposer_index,
+            parent_root=block.parent_root,
+            state_root=block.state_root,
+            body_root=hash_tree_root(block.body))
+        signed_block_header = self.SignedBeaconBlockHeader(
+            message=block_header, signature=signed_block.signature)
+        sidecars = []
+        for index, blob in enumerate(blobs):
+            gindex = get_generalized_index(
+                self.BeaconBlockBody, "blob_kzg_commitments", index)
+            proof = compute_merkle_proof(block.body, gindex)
+            sidecars.append(self.BlobSidecar(
+                index=index,
+                blob=blob,
+                kzg_commitment=block.body.blob_kzg_commitments[index],
+                kzg_proof=blob_kzg_proofs[index],
+                signed_block_header=signed_block_header,
+                kzg_commitment_inclusion_proof=proof))
+        return sidecars
+
+    def verify_blob_sidecar_inclusion_proof(self, blob_sidecar) -> bool:
+        gindex = get_generalized_index(
+            self.BeaconBlockBody, "blob_kzg_commitments",
+            int(blob_sidecar.index))
+        return self.is_valid_merkle_branch(
+            leaf=hash_tree_root(blob_sidecar.kzg_commitment),
+            branch=blob_sidecar.kzg_commitment_inclusion_proof,
+            depth=self.KZG_COMMITMENT_INCLUSION_PROOF_DEPTH,
+            index=get_subtree_index(gindex),
+            root=blob_sidecar.signed_block_header.message.body_root)
+
+    # ------------------------------------------------------------------
+    # fork upgrade (deneb/fork.md)
+    # ------------------------------------------------------------------
+    def genesis_fork_versions(self):
+        return (Bytes4(self.config.CAPELLA_FORK_VERSION),
+                Bytes4(self.config.DENEB_FORK_VERSION))
+
+    def upgrade_from(self, pre):
+        epoch = self.get_current_epoch(pre)
+        pre_header = pre.latest_execution_payload_header
+        post_header = self.ExecutionPayloadHeader(
+            parent_hash=pre_header.parent_hash,
+            fee_recipient=pre_header.fee_recipient,
+            state_root=pre_header.state_root,
+            receipts_root=pre_header.receipts_root,
+            logs_bloom=pre_header.logs_bloom,
+            prev_randao=pre_header.prev_randao,
+            block_number=pre_header.block_number,
+            gas_limit=pre_header.gas_limit,
+            gas_used=pre_header.gas_used,
+            timestamp=pre_header.timestamp,
+            extra_data=pre_header.extra_data,
+            base_fee_per_gas=pre_header.base_fee_per_gas,
+            block_hash=pre_header.block_hash,
+            transactions_root=pre_header.transactions_root,
+            withdrawals_root=pre_header.withdrawals_root,
+            blob_gas_used=0,
+            excess_blob_gas=0)
+        post = self.BeaconState(
+            genesis_time=pre.genesis_time,
+            genesis_validators_root=pre.genesis_validators_root,
+            slot=pre.slot,
+            fork=self.Fork(
+                previous_version=pre.fork.current_version,
+                current_version=Bytes4(self.config.DENEB_FORK_VERSION),
+                epoch=epoch),
+            latest_block_header=pre.latest_block_header,
+            block_roots=list(pre.block_roots),
+            state_roots=list(pre.state_roots),
+            historical_roots=list(pre.historical_roots),
+            eth1_data=pre.eth1_data,
+            eth1_data_votes=list(pre.eth1_data_votes),
+            eth1_deposit_index=pre.eth1_deposit_index,
+            validators=list(pre.validators),
+            balances=list(pre.balances),
+            randao_mixes=list(pre.randao_mixes),
+            slashings=list(pre.slashings),
+            previous_epoch_participation=list(
+                pre.previous_epoch_participation),
+            current_epoch_participation=list(
+                pre.current_epoch_participation),
+            justification_bits=list(pre.justification_bits),
+            previous_justified_checkpoint=pre.previous_justified_checkpoint,
+            current_justified_checkpoint=pre.current_justified_checkpoint,
+            finalized_checkpoint=pre.finalized_checkpoint,
+            inactivity_scores=list(pre.inactivity_scores),
+            current_sync_committee=pre.current_sync_committee,
+            next_sync_committee=pre.next_sync_committee,
+            latest_execution_payload_header=post_header,
+            next_withdrawal_index=pre.next_withdrawal_index,
+            next_withdrawal_validator_index=pre.next_withdrawal_validator_index,
+            historical_summaries=list(pre.historical_summaries))
+        return post

@@ -59,8 +59,10 @@ def build_empty_block(spec, state, slot=None):
         # empty sync aggregate carries the point-at-infinity signature
         block.body.sync_aggregate.sync_committee_signature = \
             spec.G2_POINT_AT_INFINITY
-    if spec.is_post("bellatrix") and spec.is_merge_transition_complete(
-            lookahead):
+    if spec.is_post("capella") or (
+            spec.is_post("bellatrix")
+            and spec.is_merge_transition_complete(lookahead)):
+        # capella+ processes payloads unconditionally (even pre-merge)
         block.body.execution_payload = build_empty_execution_payload(
             spec, lookahead)
     return block

@@ -1,0 +1,59 @@
+"""Trusted-setup generation tooling (dev/test setups).
+
+Counterpart of the reference's utils/kzg.py + scripts/gen_kzg_trusted_setups
+(SURVEY.md §2.2): powers-of-secret monomial setups and the group FFT that
+converts them to Lagrange form.  The conventional dev secret is 1337
+(reference Makefile:263-270).
+"""
+from __future__ import annotations
+
+from ..crypto.fields import R
+from ..crypto import curve as cv
+
+PRIMITIVE_ROOT_OF_UNITY = 7
+
+
+def root_of_unity(order: int) -> int:
+    assert (R - 1) % order == 0
+    root = pow(PRIMITIVE_ROOT_OF_UNITY, (R - 1) // order, R)
+    assert pow(root, order, R) == 1 and pow(root, order // 2, R) != 1
+    return root
+
+
+def group_fft(values: list, root: int) -> list:
+    """Radix-2 FFT over group elements (scalars in the exponent)."""
+    n = len(values)
+    if n == 1:
+        return list(values)
+    even = group_fft(values[::2], root * root % R)
+    odd = group_fft(values[1::2], root * root % R)
+    out = [None] * n
+    w = 1
+    for i in range(n // 2):
+        t = odd[i] * w
+        out[i] = even[i] + t
+        out[i + n // 2] = even[i] - t
+        w = w * root % R
+    return out
+
+
+def monomial_to_lagrange(points: list) -> list:
+    """[tau^i]G -> [L_i(tau)]G via inverse group FFT."""
+    n = len(points)
+    inv_root = pow(root_of_unity(n), R - 2, R)
+    inv_n = pow(n, R - 2, R)
+    return [p * inv_n for p in group_fft(points, inv_root)]
+
+
+def generate_setup(width: int, secret: int = 1337) -> dict:
+    """A complete dev trusted setup in the on-disk JSON shape."""
+    g1 = cv.g1_generator()
+    g2 = cv.g2_generator()
+    g1_monomial = [g1 * pow(secret, i, R) for i in range(width)]
+    g2_monomial = [g2 * pow(secret, i, R) for i in range(min(width, 65))]
+    g1_lagrange = monomial_to_lagrange(g1_monomial)
+    return {
+        "g1_monomial": ["0x" + cv.g1_to_bytes(p).hex() for p in g1_monomial],
+        "g1_lagrange": ["0x" + cv.g1_to_bytes(p).hex() for p in g1_lagrange],
+        "g2_monomial": ["0x" + cv.g2_to_bytes(p).hex() for p in g2_monomial],
+    }

@@ -1,0 +1,95 @@
+"""Generalized-index proofs: every computed branch must verify against the
+view's own hash_tree_root via is_valid_merkle_branch.
+"""
+import pytest
+
+from consensus_specs_tpu.ssz import (
+    Container, List, Vector, Bitlist, uint8, uint64, Bytes32, Bytes48,
+    hash_tree_root, is_valid_merkle_branch,
+)
+from consensus_specs_tpu.ssz.proofs import (
+    compute_merkle_proof, get_generalized_index,
+    get_generalized_index_length, get_subtree_index,
+)
+
+
+class Inner(Container):
+    a: uint64
+    b: Bytes32
+
+
+class Outer(Container):
+    x: uint64
+    inner: Inner
+    items: List[Inner, 8]
+    raw: List[uint64, 16]
+    bits: Bitlist[20]
+
+
+def make_view():
+    return Outer(
+        x=7,
+        inner=Inner(a=1, b=b"\x22" * 32),
+        items=[Inner(a=2, b=b"\x33" * 32), Inner(a=3, b=b"\x44" * 32)],
+        raw=[9, 8, 7],
+        bits=[True, False, True])
+
+
+def check(view, gindex, leaf):
+    branch = compute_merkle_proof(view, gindex)
+    assert is_valid_merkle_branch(
+        bytes(leaf), branch, get_generalized_index_length(gindex),
+        get_subtree_index(gindex), bytes(hash_tree_root(view)))
+
+
+def test_container_field_proof():
+    view = make_view()
+    g = get_generalized_index(Outer, "x")
+    check(view, g, hash_tree_root(uint64(7)))
+    g = get_generalized_index(Outer, "inner")
+    check(view, g, hash_tree_root(view.inner))
+
+
+def test_nested_field_proof():
+    view = make_view()
+    g = get_generalized_index(Outer, "inner", "b")
+    check(view, g, b"\x22" * 32)
+
+
+def test_list_element_proof():
+    view = make_view()
+    g = get_generalized_index(Outer, "items", 1)
+    check(view, g, hash_tree_root(view.items[1]))
+    # absent element: SSZ pads composite lists with zero chunks
+    g = get_generalized_index(Outer, "items", 5)
+    check(view, g, b"\x00" * 32)
+
+
+def test_list_length_proof():
+    view = make_view()
+    g = get_generalized_index(Outer, "items", "__len__")
+    check(view, g, (2).to_bytes(32, "little"))
+
+
+def test_basic_list_chunk_proof():
+    view = make_view()
+    g = get_generalized_index(Outer, "raw", 0)  # chunk containing elems 0-3
+    chunk = b"".join(int(v).to_bytes(8, "little") for v in [9, 8, 7]) \
+        + b"\x00" * 8
+    check(view, g, chunk)
+
+
+def test_deep_nested_list_proof():
+    view = make_view()
+    g = get_generalized_index(Outer, "items", 0, "a")
+    check(view, g, hash_tree_root(uint64(2)))
+
+
+def test_mutation_invalidates_proof():
+    view = make_view()
+    g = get_generalized_index(Outer, "inner", "b")
+    branch = compute_merkle_proof(view, g)
+    view.x = 8  # mutate an unrelated field
+    assert not is_valid_merkle_branch(
+        b"\x22" * 32, branch, get_generalized_index_length(g),
+        get_subtree_index(g), bytes(hash_tree_root(view)))
