@@ -110,6 +110,7 @@ class KZG:
         assert len(self._g1_lagrange_bytes) == self.width
         self._g1_lagrange_brp: list[Point] | None = None
         self._g2_monomial: list[Point] | None = None
+        self._roots_brp: tuple | None = None
 
     # -- setup access (decompressed lazily; ceremony output is trusted,
     #    so no per-point subgroup check here)
@@ -127,16 +128,17 @@ class KZG:
         return self._g2_monomial
 
     # -- domain
-    @lru_cache(maxsize=None)
     def _roots_of_unity_brp(self) -> tuple:
         """Roots of unity in bit-reversal order (the blob evaluation
         domain), polynomial-commitments.md compute_roots_of_unity +
         bit_reversal_permutation (:142)."""
-        root = pow(PRIMITIVE_ROOT_OF_UNITY,
-                   (BLS_MODULUS - 1) // self.width, BLS_MODULUS)
-        roots = compute_powers(root, self.width)
-        assert root != 1 and pow(root, self.width, BLS_MODULUS) == 1
-        return tuple(bit_reversal_permutation(roots))
+        if self._roots_brp is None:
+            root = pow(PRIMITIVE_ROOT_OF_UNITY,
+                       (BLS_MODULUS - 1) // self.width, BLS_MODULUS)
+            roots = compute_powers(root, self.width)
+            assert root != 1 and pow(root, self.width, BLS_MODULUS) == 1
+            self._roots_brp = tuple(bit_reversal_permutation(roots))
+        return self._roots_brp
 
     # -- blob <-> polynomial
     def blob_to_polynomial(self, blob: bytes) -> list[int]:

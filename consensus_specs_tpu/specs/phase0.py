@@ -529,7 +529,8 @@ class Phase0Spec(Phase0ForkChoice, Phase0ValidatorDuties, BaseSpec):
         if whistleblower_index is None:
             whistleblower_index = proposer_index
         whistleblower_reward = uint64(
-            validator.effective_balance // self.WHISTLEBLOWER_REWARD_QUOTIENT)
+            validator.effective_balance
+            // self.whistleblower_reward_quotient())
         proposer_reward = self.slashing_proposer_reward(whistleblower_reward)
         self.increase_balance(state, proposer_index, proposer_reward)
         self.increase_balance(state, whistleblower_index,
@@ -538,6 +539,9 @@ class Phase0Spec(Phase0ForkChoice, Phase0ValidatorDuties, BaseSpec):
     # fork-overridable pieces of slash_validator
     def min_slashing_penalty_quotient(self) -> int:
         return self.MIN_SLASHING_PENALTY_QUOTIENT
+
+    def whistleblower_reward_quotient(self) -> int:
+        return self.WHISTLEBLOWER_REWARD_QUOTIENT
 
     def slashing_proposer_reward(self, whistleblower_reward) -> int:
         return uint64(whistleblower_reward // self.PROPOSER_REWARD_QUOTIENT)

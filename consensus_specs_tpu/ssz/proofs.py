@@ -8,7 +8,8 @@ protocol (altair).
 """
 from __future__ import annotations
 
-from .merkle import ZERO_HASHES, chunk_depth, hash_pair, next_power_of_two
+from .merkle import (ZERO_HASHES, chunk_depth, hash_pair, merkleize_chunks,
+                     next_power_of_two)
 from .types import (
     Bits, Bitlist, ByteList, ByteVector, Container, List, SSZType, Union,
     Vector, _Sequence, is_basic_type,
@@ -147,16 +148,8 @@ def _chunk_subtree_node(chunks: list[bytes], depth: int, gindex: int) -> bytes:
     sub = chunks[start:start + size]
     if not sub:
         return ZERO_HASHES[sub_depth]
-    # merkleize the slice at fixed depth
-    level = list(sub)
-    for d in range(sub_depth):
-        nxt = []
-        for i in range(0, len(level), 2):
-            left = level[i]
-            right = level[i + 1] if i + 1 < len(level) else ZERO_HASHES[d]
-            nxt.append(hash_pair(left, right))
-        level = nxt
-    return level[0]
+    # merkleize the slice at fixed depth via the pluggable level hasher
+    return merkleize_chunks(sub, limit=size)
 
 
 def _node_of(view, gindex: int) -> bytes:

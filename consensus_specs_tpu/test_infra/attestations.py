@@ -71,9 +71,15 @@ def get_valid_attestation(spec, state, slot=None, index=None,
     if index is None:
         index = 0
 
-    attestation_data = build_attestation_data(spec, state, slot, index)
-    committee = spec.get_beacon_committee(
-        state, attestation_data.slot, attestation_data.index)
+    if spec.is_post("electra"):
+        # EIP-7549: committee index moves to committee_bits; data.index == 0
+        attestation_data = build_attestation_data(spec, state, slot, 0)
+        committee = spec.get_beacon_committee(
+            state, attestation_data.slot, index)
+    else:
+        attestation_data = build_attestation_data(spec, state, slot, index)
+        committee = spec.get_beacon_committee(
+            state, attestation_data.slot, attestation_data.index)
 
     participants = set(committee)
     if filter_participant_set is not None:
@@ -81,8 +87,15 @@ def get_valid_attestation(spec, state, slot=None, index=None,
 
     aggregation_bits = [validator_index in participants
                         for validator_index in committee]
-    attestation = spec.Attestation(
-        aggregation_bits=aggregation_bits, data=attestation_data)
+    if spec.is_post("electra"):
+        committee_bits = [i == index
+                          for i in range(spec.MAX_COMMITTEES_PER_SLOT)]
+        attestation = spec.Attestation(
+            aggregation_bits=aggregation_bits, data=attestation_data,
+            committee_bits=committee_bits)
+    else:
+        attestation = spec.Attestation(
+            aggregation_bits=aggregation_bits, data=attestation_data)
     if signed and participants:
         sign_attestation(spec, state, attestation)
     return attestation

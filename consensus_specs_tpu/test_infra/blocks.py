@@ -88,7 +88,10 @@ def build_empty_execution_payload(spec, state):
         gas_used=0,
         timestamp=spec.compute_timestamp_at_slot(state, state.slot),
         base_fee_per_gas=latest.base_fee_per_gas)
-    if spec.is_post("capella"):
+    if spec.is_post("electra"):
+        # electra returns (withdrawals, processed_partial_count)
+        payload.withdrawals = spec.get_expected_withdrawals(state)[0]
+    elif spec.is_post("capella"):
         payload.withdrawals = spec.get_expected_withdrawals(state)
     # a deterministic fake block hash binding the payload contents
     payload.block_hash = spec.hash(

@@ -14,9 +14,21 @@ from .keys import pubkeys
 
 def build_mock_validator(spec, i: int, balance: int):
     pubkey = pubkeys[i]
-    # BLS-prefixed withdrawal credentials derived from the pubkey
-    withdrawal_credentials = (
-        spec.BLS_WITHDRAWAL_PREFIX + bytes(spec.hash(pubkey))[1:])
+    if spec.is_post("electra"):
+        if balance > spec.MIN_ACTIVATION_BALANCE:
+            # compounding credentials above the min activation balance
+            withdrawal_credentials = (
+                spec.COMPOUNDING_WITHDRAWAL_PREFIX + b"\x00" * 11
+                + bytes(spec.hash(pubkey))[12:])
+        else:
+            withdrawal_credentials = (
+                spec.BLS_WITHDRAWAL_PREFIX + bytes(spec.hash(pubkey))[1:])
+        max_effective_balance = spec.MAX_EFFECTIVE_BALANCE_ELECTRA
+    else:
+        # BLS-prefixed withdrawal credentials derived from the pubkey
+        withdrawal_credentials = (
+            spec.BLS_WITHDRAWAL_PREFIX + bytes(spec.hash(pubkey))[1:])
+        max_effective_balance = spec.MAX_EFFECTIVE_BALANCE
     return spec.Validator(
         pubkey=pubkey,
         withdrawal_credentials=withdrawal_credentials,
@@ -26,7 +38,7 @@ def build_mock_validator(spec, i: int, balance: int):
         withdrawable_epoch=spec.FAR_FUTURE_EPOCH,
         effective_balance=uint64(min(
             int(balance) - int(balance) % spec.EFFECTIVE_BALANCE_INCREMENT,
-            spec.MAX_EFFECTIVE_BALANCE)))
+            max_effective_balance)))
 
 
 def create_genesis_state(spec, validator_balances, activation_threshold=None):
@@ -72,6 +84,10 @@ def create_genesis_state(spec, validator_balances, activation_threshold=None):
         # post-bellatrix mock genesis is post-merge: sample payload header
         state.latest_execution_payload_header = \
             sample_genesis_execution_payload_header(spec, eth1_block_hash)
+
+    if spec.is_post("electra"):
+        state.deposit_requests_start_index = \
+            spec.UNSET_DEPOSIT_REQUESTS_START_INDEX
 
     return state
 

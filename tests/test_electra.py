@@ -1,0 +1,292 @@
+"""Electra: EIP-7251 maxEB machinery, EIP-7549 committee-bit attestations,
+EIP-7002/6110 execution-layer requests, pending queues, fork upgrade.
+
+Mirrors the shape of the reference's test/electra suites
+(/root/reference/tests/core/pyspec/eth2spec/test/electra/).
+"""
+import pytest
+
+from consensus_specs_tpu.specs import get_spec
+from consensus_specs_tpu.ssz import hash_tree_root, uint64
+from consensus_specs_tpu.test_infra import disable_bls
+from consensus_specs_tpu.test_infra.genesis import (
+    create_genesis_state, default_balances)
+from consensus_specs_tpu.test_infra.blocks import (
+    apply_empty_block, build_empty_block_for_next_slot, next_epoch,
+    next_slot, state_transition_and_sign_block)
+from consensus_specs_tpu.test_infra.attestations import get_valid_attestation
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_spec("electra", "minimal")
+
+
+@pytest.fixture()
+def state(spec):
+    with disable_bls():
+        return create_genesis_state(spec, default_balances(spec))
+
+
+def test_empty_block_transition(spec, state):
+    with disable_bls():
+        apply_empty_block(spec, state)
+    assert state.slot == 1
+
+
+def test_epoch_transition(spec, state):
+    with disable_bls():
+        next_epoch(spec, state)
+    assert state.slot == spec.SLOTS_PER_EPOCH
+
+
+def test_attestation_committee_bits(spec, state):
+    with disable_bls():
+        attestation = get_valid_attestation(spec, state, signed=True)
+        next_slot(spec, state)
+        pre_participation = list(state.current_epoch_participation)
+        spec.process_attestation(state, attestation)
+    assert attestation.data.index == 0
+    assert sum(bool(b) for b in attestation.committee_bits) == 1
+    assert list(state.current_epoch_participation) != pre_participation
+
+
+def test_attestation_nonzero_data_index_rejected(spec, state):
+    with disable_bls():
+        attestation = get_valid_attestation(spec, state, signed=True)
+        attestation.data.index = 1
+        next_slot(spec, state)
+        with pytest.raises(AssertionError):
+            spec.process_attestation(state, attestation)
+
+
+def test_attestation_in_block(spec, state):
+    with disable_bls():
+        attestation = get_valid_attestation(spec, state, signed=True)
+        next_slot(spec, state)
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.attestations.append(attestation)
+        state_transition_and_sign_block(spec, state, block)
+
+
+def test_withdrawal_request_full_exit(spec, state):
+    with disable_bls():
+        # advance past SHARD_COMMITTEE_PERIOD so exits are allowed
+        state.slot = uint64(
+            spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH)
+        index = 0
+        validator = state.validators[index]
+        # give it eth1 credentials so the source address check passes
+        address = b"\x11" * 20
+        validator.withdrawal_credentials = (
+            spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11 + address)
+        request = spec.WithdrawalRequest(
+            source_address=address,
+            validator_pubkey=validator.pubkey,
+            amount=spec.FULL_EXIT_REQUEST_AMOUNT)
+        spec.process_withdrawal_request(state, request)
+    assert state.validators[index].exit_epoch < spec.FAR_FUTURE_EPOCH
+
+
+def test_withdrawal_request_wrong_source_ignored(spec, state):
+    with disable_bls():
+        state.slot = uint64(
+            spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH)
+        index = 0
+        validator = state.validators[index]
+        address = b"\x11" * 20
+        validator.withdrawal_credentials = (
+            spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11 + address)
+        request = spec.WithdrawalRequest(
+            source_address=b"\x22" * 20,  # mismatched
+            validator_pubkey=validator.pubkey,
+            amount=spec.FULL_EXIT_REQUEST_AMOUNT)
+        spec.process_withdrawal_request(state, request)
+    assert state.validators[index].exit_epoch == spec.FAR_FUTURE_EPOCH
+
+
+def test_partial_withdrawal_request(spec, state):
+    with disable_bls():
+        state.slot = uint64(
+            spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH)
+        index = 0
+        validator = state.validators[index]
+        address = b"\x11" * 20
+        validator.withdrawal_credentials = (
+            spec.COMPOUNDING_WITHDRAWAL_PREFIX + b"\x00" * 11 + address)
+        # excess balance above MIN_ACTIVATION_BALANCE
+        state.balances[index] = uint64(
+            spec.MIN_ACTIVATION_BALANCE + 2 * 10**9)
+        request = spec.WithdrawalRequest(
+            source_address=address,
+            validator_pubkey=validator.pubkey,
+            amount=uint64(10**9))
+        spec.process_withdrawal_request(state, request)
+    assert len(state.pending_partial_withdrawals) == 1
+    pw = state.pending_partial_withdrawals[0]
+    assert pw.validator_index == index
+    assert pw.amount == 10**9
+    # validator did NOT exit
+    assert state.validators[index].exit_epoch == spec.FAR_FUTURE_EPOCH
+
+
+def test_switch_to_compounding_request(spec, state):
+    with disable_bls():
+        index = 0
+        validator = state.validators[index]
+        address = b"\x11" * 20
+        validator.withdrawal_credentials = (
+            spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11 + address)
+        state.balances[index] = uint64(spec.MIN_ACTIVATION_BALANCE + 10**9)
+        request = spec.ConsolidationRequest(
+            source_address=address,
+            source_pubkey=validator.pubkey,
+            target_pubkey=validator.pubkey)
+        spec.process_consolidation_request(state, request)
+    assert spec.has_compounding_withdrawal_credential(
+        state.validators[index])
+    # excess balance was queued as a pending deposit
+    assert state.balances[index] == spec.MIN_ACTIVATION_BALANCE
+    assert len(state.pending_deposits) == 1
+    assert state.pending_deposits[0].amount == 10**9
+
+
+def test_consolidation_request(spec):
+    # needs enough stake that the consolidation churn limit is non-zero
+    # (the reference's scaled_churn_balances states, context.py:103-238)
+    with disable_bls():
+        # balance churn must exceed the activation-exit cap:
+        # total/CHURN_LIMIT_QUOTIENT > MAX_PER_EPOCH_ACTIVATION_EXIT_CHURN
+        n = 2 * (spec.config.MAX_PER_EPOCH_ACTIVATION_EXIT_CHURN_LIMIT
+                 * spec.config.CHURN_LIMIT_QUOTIENT
+                 // spec.MIN_ACTIVATION_BALANCE)
+        state = create_genesis_state(
+            spec, [spec.MIN_ACTIVATION_BALANCE] * int(n))
+        state.slot = uint64(
+            spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH)
+        source, target = 1, 2
+        address = b"\x33" * 20
+        state.validators[source].withdrawal_credentials = (
+            spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11 + address)
+        state.validators[target].withdrawal_credentials = (
+            spec.COMPOUNDING_WITHDRAWAL_PREFIX + b"\x00" * 11
+            + b"\x44" * 20)
+        request = spec.ConsolidationRequest(
+            source_address=address,
+            source_pubkey=state.validators[source].pubkey,
+            target_pubkey=state.validators[target].pubkey)
+        spec.process_consolidation_request(state, request)
+    assert len(state.pending_consolidations) == 1
+    pc = state.pending_consolidations[0]
+    assert pc.source_index == source and pc.target_index == target
+    assert state.validators[source].exit_epoch < spec.FAR_FUTURE_EPOCH
+
+
+def test_deposit_request_queues_pending_deposit(spec, state):
+    with disable_bls():
+        request = spec.DepositRequest(
+            pubkey=state.validators[0].pubkey,
+            withdrawal_credentials=b"\x01" + b"\x00" * 31,
+            amount=uint64(32 * 10**9),
+            signature=b"\x00" * 96,
+            index=uint64(0))
+        spec.process_deposit_request(state, request)
+    assert state.deposit_requests_start_index == 0
+    assert len(state.pending_deposits) == 1
+    assert state.pending_deposits[0].slot == state.slot
+
+
+def test_pending_deposit_applied_at_epoch(spec, state):
+    with disable_bls():
+        index = 0
+        pre_balance = int(state.balances[index])
+        # top-up for an existing validator: signature not re-checked
+        state.pending_deposits.append(spec.PendingDeposit(
+            pubkey=state.validators[index].pubkey,
+            withdrawal_credentials=(
+                state.validators[index].withdrawal_credentials),
+            amount=uint64(10**9),
+            signature=spec.G2_POINT_AT_INFINITY,
+            slot=spec.GENESIS_SLOT))
+        spec.process_pending_deposits(state)
+    assert int(state.balances[index]) == pre_balance + 10**9
+    assert len(state.pending_deposits) == 0
+
+
+def test_pending_consolidation_applied_at_epoch(spec, state):
+    with disable_bls():
+        source, target = 1, 2
+        state.validators[source].withdrawable_epoch = \
+            spec.get_current_epoch(state)
+        state.pending_consolidations.append(spec.PendingConsolidation(
+            source_index=source, target_index=target))
+        src_balance = int(state.balances[source])
+        tgt_balance = int(state.balances[target])
+        eff = int(state.validators[source].effective_balance)
+        spec.process_pending_consolidations(state)
+    assert int(state.balances[source]) == src_balance - eff
+    assert int(state.balances[target]) == tgt_balance + eff
+    assert len(state.pending_consolidations) == 0
+
+
+def test_effective_balance_cap_compounding(spec, state):
+    with disable_bls():
+        index = 0
+        state.validators[index].withdrawal_credentials = (
+            spec.COMPOUNDING_WITHDRAWAL_PREFIX + b"\x00" * 31)
+        state.balances[index] = uint64(100 * 10**9)
+        spec.process_effective_balance_updates(state)
+    assert state.validators[index].effective_balance == 100 * 10**9
+
+    with disable_bls():
+        # non-compounding validator stays capped at MIN_ACTIVATION_BALANCE
+        other = 1
+        state.balances[other] = uint64(100 * 10**9)
+        state.validators[other].withdrawal_credentials = (
+            spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 31)
+        spec.process_effective_balance_updates(state)
+    assert state.validators[other].effective_balance == \
+        spec.MIN_ACTIVATION_BALANCE
+
+
+def test_upgrade_deneb_to_electra(spec):
+    deneb = get_spec("deneb", "minimal")
+    with disable_bls():
+        pre = create_genesis_state(deneb, default_balances(deneb))
+        apply_empty_block(deneb, pre)
+        post = spec.upgrade_from(pre)
+    assert bytes(post.fork.current_version) == bytes.fromhex(
+        spec.config.ELECTRA_FORK_VERSION[2:])
+    assert post.deposit_requests_start_index == \
+        spec.UNSET_DEPOSIT_REQUESTS_START_INDEX
+    assert post.earliest_exit_epoch >= 1
+    # all genesis validators were already active: no pending deposits
+    assert len(post.pending_deposits) == 0
+    # the upgraded state merkleizes
+    hash_tree_root(post)
+
+
+def test_voluntary_exit_blocked_by_pending_withdrawal(spec, state):
+    with disable_bls():
+        state.slot = uint64(
+            spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH)
+        index = 0
+        state.pending_partial_withdrawals.append(
+            spec.PendingPartialWithdrawal(
+                validator_index=index, amount=uint64(10**9),
+                withdrawable_epoch=uint64(10**6)))
+        exit_msg = spec.SignedVoluntaryExit(
+            message=spec.VoluntaryExit(epoch=0, validator_index=index))
+        with pytest.raises(AssertionError):
+            spec.process_voluntary_exit(state, exit_msg)
+
+
+def test_finality_two_epochs(spec, state):
+    """Multi-epoch sanity: attestation-filled epochs justify and finalize."""
+    from consensus_specs_tpu.test_infra.attestations import (
+        next_epoch_with_attestations)
+    with disable_bls():
+        next_epoch(spec, state)
+        for _ in range(4):
+            next_epoch_with_attestations(spec, state, True, True)
+    assert state.finalized_checkpoint.epoch > 0
