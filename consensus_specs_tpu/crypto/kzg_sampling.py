@@ -1,0 +1,488 @@
+"""KZG cell proofs for data-availability sampling (PeerDAS / fulu).
+
+From-scratch implementation of
+/root/reference/specs/fulu/polynomial-commitments-sampling.md — public
+methods `compute_cells_and_kzg_proofs`, `verify_cell_kzg_proof_batch`,
+`recover_cells_and_kzg_proofs` plus the full helper surface (FFTs,
+coefficient-form polynomial arithmetic, cosets, vanishing polynomials).
+
+Performance design (results byte-identical to the reference's O(n^2)
+algorithms, verified by differential tests):
+- cell evaluations come from ONE size-2n FFT of the padded coefficient
+  polynomial instead of per-point Horner (the brp slice of the extended
+  domain IS the cell coset);
+- the multi-proof quotient f(X)/(X^k - h^k) uses synthetic division
+  (the coset vanishing polynomial has that closed form);
+- coset interpolation in the batch verifier uses a small inverse FFT with
+  a power-of-h unscaling instead of Lagrange interpolation.
+"""
+from __future__ import annotations
+
+from functools import lru_cache
+
+from .fields import R as BLS_MODULUS
+from . import curve as cv
+from .curve import msm
+from .kzg import (
+    KZG, FieldMath, BYTES_PER_FIELD_ELEMENT, KZG_ENDIANNESS,
+    PRIMITIVE_ROOT_OF_UNITY, bit_reversal_permutation, bls_field_to_bytes,
+    bytes_to_bls_field, compute_powers, hash_to_bls_field,
+)
+
+RANDOM_CHALLENGE_KZG_CELL_BATCH_DOMAIN = b"RCKZGCBATCH__V1_"
+BYTES_PER_COMMITMENT = 48
+BYTES_PER_PROOF = 48
+
+
+def reverse_bits(n: int, order: int) -> int:
+    """Bit-reverse `n` within log2(order) bits."""
+    assert order & (order - 1) == 0
+    bits = order.bit_length() - 1
+    return int(format(n, f"0{bits}b")[::-1], 2) if bits else 0
+
+
+@lru_cache(maxsize=16)
+def compute_roots_of_unity(order: int) -> tuple:
+    """Natural-order roots of unity of the given power-of-two order."""
+    root = pow(PRIMITIVE_ROOT_OF_UNITY, (BLS_MODULUS - 1) // order,
+               BLS_MODULUS)
+    assert pow(root, order, BLS_MODULUS) == 1
+    assert order == 1 or pow(root, order // 2, BLS_MODULUS) != 1
+    return tuple(compute_powers(root, order))
+
+
+# ---------------------------------------------------------------------------
+# FFTs (polynomial-commitments-sampling.md:135-197)
+# ---------------------------------------------------------------------------
+
+def _fft_field(vals, roots_of_unity):
+    """Recursive reference shape, iterative implementation: evaluates the
+    coefficient list `vals` on the domain (natural order)."""
+    n = len(vals)
+    if n == 1:
+        return list(vals)
+    # iterative Cooley-Tukey: bit-reverse copy, then butterfly sweeps
+    out = [vals[reverse_bits(i, n)] for i in range(n)]
+    m = 1
+    while m < n:
+        stride = n // (2 * m)
+        for start in range(0, n, 2 * m):
+            for k in range(m):
+                w = roots_of_unity[k * stride]
+                a = out[start + k]
+                b = out[start + k + m] * w % BLS_MODULUS
+                out[start + k] = (a + b) % BLS_MODULUS
+                out[start + k + m] = (a - b) % BLS_MODULUS
+        m *= 2
+    return out
+
+
+def fft_field(vals, roots_of_unity, inv: bool = False):
+    """polynomial-commitments-sampling.md:151"""
+    if inv:
+        invlen = pow(len(vals), BLS_MODULUS - 2, BLS_MODULUS)
+        inv_roots = list(roots_of_unity[0:1]) + list(roots_of_unity[:0:-1])
+        return [x * invlen % BLS_MODULUS
+                for x in _fft_field(vals, inv_roots)]
+    return _fft_field(vals, roots_of_unity)
+
+
+def coset_fft_field(vals, roots_of_unity, inv: bool = False):
+    """FFT/IFFT over the coset g*DOMAIN with g = PRIMITIVE_ROOT_OF_UNITY
+    (polynomial-commitments-sampling.md:166)."""
+    def shift_vals(vals, factor):
+        shift = 1
+        out = []
+        for v in vals:
+            out.append(v * shift % BLS_MODULUS)
+            shift = shift * factor % BLS_MODULUS
+        return out
+
+    shift_factor = PRIMITIVE_ROOT_OF_UNITY
+    if inv:
+        vals = fft_field(vals, roots_of_unity, inv)
+        return shift_vals(vals, FieldMath.inverse(shift_factor))
+    vals = shift_vals(vals, shift_factor)
+    return fft_field(vals, roots_of_unity, inv)
+
+
+# ---------------------------------------------------------------------------
+# coefficient-form polynomial arithmetic (:234-338)
+# ---------------------------------------------------------------------------
+
+def add_polynomialcoeff(a, b):
+    a, b = (a, b) if len(a) >= len(b) else (b, a)
+    length_b = len(b)
+    return [(a[i] + (b[i] if i < length_b else 0)) % BLS_MODULUS
+            for i in range(len(a))]
+
+
+def multiply_polynomialcoeff(a, b):
+    r = [0] * (len(a) + len(b) - 1)
+    for power, coef in enumerate(a):
+        for j, x in enumerate(b):
+            r[power + j] = (r[power + j] + coef * x) % BLS_MODULUS
+    return r
+
+
+def divide_polynomialcoeff(a, b):
+    """Long polynomial division (:273)."""
+    a = list(a)
+    o = []
+    apos = len(a) - 1
+    bpos = len(b) - 1
+    diff = apos - bpos
+    inv_lead = FieldMath.inverse(b[bpos])
+    while diff >= 0:
+        quot = a[apos] * inv_lead % BLS_MODULUS
+        o.insert(0, quot)
+        for i in range(bpos, -1, -1):
+            a[diff + i] = (a[diff + i] - b[i] * quot) % BLS_MODULUS
+        apos -= 1
+        diff -= 1
+    return o
+
+
+def interpolate_polynomialcoeff(xs, ys):
+    """Lagrange interpolation (:295)."""
+    assert len(xs) == len(ys)
+    r = [0]
+    for i in range(len(xs)):
+        summand = [ys[i]]
+        for j in range(len(ys)):
+            if j != i:
+                weight_adjustment = FieldMath.inverse(
+                    (xs[i] - xs[j]) % BLS_MODULUS)
+                summand = multiply_polynomialcoeff(
+                    summand,
+                    [(-weight_adjustment * xs[j]) % BLS_MODULUS,
+                     weight_adjustment])
+        r = add_polynomialcoeff(r, summand)
+    return r
+
+
+def vanishing_polynomialcoeff(xs):
+    p = [1]
+    for x in xs:
+        p = multiply_polynomialcoeff(p, [(-x) % BLS_MODULUS, 1])
+    return p
+
+
+def evaluate_polynomialcoeff(polynomial_coeff, z):
+    y = 0
+    for coef in reversed(polynomial_coeff):
+        y = (y * z + coef) % BLS_MODULUS
+    return y
+
+
+class KZGSampling(KZG):
+    """KZG engine extended with the DAS cell-proof surface."""
+
+    def __init__(self, field_elements_per_blob: int = 4096,
+                 field_elements_per_cell: int = 64, **kwargs):
+        super().__init__(field_elements_per_blob, **kwargs)
+        self.fe_per_cell = field_elements_per_cell
+        self.ext_width = 2 * self.width
+        self.cells_per_ext_blob = self.ext_width // self.fe_per_cell
+        self.bytes_per_cell = self.fe_per_cell * BYTES_PER_FIELD_ELEMENT
+        assert len(self._g2_monomial_bytes) > self.fe_per_cell
+        self._roots_ext_brp: tuple | None = None
+        self._g1_monomial: list | None = None
+
+    def g1_monomial(self):
+        if self._g1_monomial is None:
+            self._g1_monomial = [cv.g1_from_bytes(b, subgroup_check=False)
+                                 for b in self._g1_monomial_bytes]
+        return self._g1_monomial
+
+    def _roots_of_unity_ext_brp(self) -> tuple:
+        if self._roots_ext_brp is None:
+            self._roots_ext_brp = tuple(bit_reversal_permutation(
+                list(compute_roots_of_unity(self.ext_width))))
+        return self._roots_ext_brp
+
+    # -- cells <-> evals (:105-127)
+    def cell_to_coset_evals(self, cell: bytes) -> list[int]:
+        assert len(cell) == self.bytes_per_cell
+        return [bytes_to_bls_field(
+            bytes(cell)[i * 32:(i + 1) * 32])
+            for i in range(self.fe_per_cell)]
+
+    def coset_evals_to_cell(self, coset_evals: list[int]) -> bytes:
+        return b"".join(bls_field_to_bytes(e) for e in coset_evals)
+
+    # -- cosets (:484-515)
+    def coset_shift_for_cell(self, cell_index: int) -> int:
+        assert cell_index < self.cells_per_ext_blob
+        return self._roots_of_unity_ext_brp()[
+            self.fe_per_cell * cell_index]
+
+    def coset_for_cell(self, cell_index: int) -> list[int]:
+        assert cell_index < self.cells_per_ext_blob
+        brp = self._roots_of_unity_ext_brp()
+        return list(brp[self.fe_per_cell * cell_index:
+                        self.fe_per_cell * (cell_index + 1)])
+
+    # -- eval form -> coefficient form (:234)
+    def polynomial_eval_to_coeff(self, polynomial: list[int]) -> list[int]:
+        roots = compute_roots_of_unity(self.width)
+        return fft_field(bit_reversal_permutation(list(polynomial)),
+                         roots, inv=True)
+
+    # -- multiproofs (:348-374)
+    def compute_kzg_proof_multi_impl(self, polynomial_coeff, zs):
+        """Generic Q(X) = f(X)/Z(X) path (reference shape); the batch cell
+        computation below uses the closed-form fast path."""
+        ys = [evaluate_polynomialcoeff(polynomial_coeff, z) for z in zs]
+        denominator_poly = vanishing_polynomialcoeff(zs)
+        quotient_polynomial = divide_polynomialcoeff(
+            polynomial_coeff, denominator_poly)
+        proof = self.g1_lincomb(
+            self.g1_monomial()[:len(quotient_polynomial)],
+            quotient_polynomial)
+        return proof, ys
+
+    def _divide_by_coset_vanishing(self, polynomial_coeff, shift):
+        """f(X) // (X^k - shift^k) by synthetic division — the vanishing
+        polynomial of the coset shift*G has this closed form."""
+        k = self.fe_per_cell
+        c = pow(shift, k, BLS_MODULUS)
+        n = len(polynomial_coeff)
+        if n <= k:
+            return []
+        q = [0] * (n - k)
+        for i in range(n - k - 1, -1, -1):
+            upper = q[i + k] if i + k < n - k else 0
+            q[i] = (polynomial_coeff[i + k] + c * upper) % BLS_MODULUS
+        return q
+
+    # -- cell computation (:524-557)
+    def compute_cells_and_kzg_proofs_polynomialcoeff(self, polynomial_coeff):
+        # all cell evaluations via one extended-domain FFT: the brp slice
+        # [k*cell : (k+1)*cell] of the extended domain IS coset_for_cell(k)
+        padded = list(polynomial_coeff) \
+            + [0] * (self.ext_width - len(polynomial_coeff))
+        roots_ext = compute_roots_of_unity(self.ext_width)
+        evals_natural = fft_field(padded, roots_ext)
+        evals_brp = bit_reversal_permutation(evals_natural)
+
+        cells, proofs = [], []
+        for i in range(self.cells_per_ext_blob):
+            ys = evals_brp[i * self.fe_per_cell:(i + 1) * self.fe_per_cell]
+            shift = self.coset_shift_for_cell(i)
+            quotient = self._divide_by_coset_vanishing(
+                polynomial_coeff, shift)
+            proof = self.g1_lincomb(
+                self.g1_monomial()[:len(quotient)], quotient) \
+                if quotient else self.g1_lincomb([], [])
+            cells.append(self.coset_evals_to_cell(ys))
+            proofs.append(proof)
+        return cells, proofs
+
+    def compute_cells_and_kzg_proofs(self, blob: bytes):
+        """Public method (:542)."""
+        assert len(blob) == BYTES_PER_FIELD_ELEMENT * self.width
+        polynomial = self.blob_to_polynomial(blob)
+        polynomial_coeff = self.polynomial_eval_to_coeff(polynomial)
+        return self.compute_cells_and_kzg_proofs_polynomialcoeff(
+            polynomial_coeff)
+
+    # -- verification (:202-227, :379-477, :564-608)
+    def compute_verify_cell_kzg_proof_batch_challenge(
+            self, commitments, commitment_indices, cell_indices,
+            cosets_evals, proofs) -> int:
+        hashinput = RANDOM_CHALLENGE_KZG_CELL_BATCH_DOMAIN
+        hashinput += self.width.to_bytes(8, KZG_ENDIANNESS)
+        hashinput += self.fe_per_cell.to_bytes(8, KZG_ENDIANNESS)
+        hashinput += len(commitments).to_bytes(8, KZG_ENDIANNESS)
+        hashinput += len(cell_indices).to_bytes(8, KZG_ENDIANNESS)
+        for commitment in commitments:
+            hashinput += bytes(commitment)
+        for k, coset_evals in enumerate(cosets_evals):
+            hashinput += int(commitment_indices[k]).to_bytes(
+                8, KZG_ENDIANNESS)
+            hashinput += int(cell_indices[k]).to_bytes(8, KZG_ENDIANNESS)
+            for coset_eval in coset_evals:
+                hashinput += bls_field_to_bytes(coset_eval)
+            hashinput += bytes(proofs[k])
+        return hash_to_bls_field(hashinput)
+
+    def _interpolate_coset(self, cell_index: int, coset_evals):
+        """I(X) with I(coset[j]) == evals[j], via small inverse FFT.
+        coset_for_cell orders points as h*g^bitrev(j), so un-brp first;
+        F(X)=I(hX) has coeffs ifft(evals), then unscale by h^-i."""
+        k = self.fe_per_cell
+        small_roots = compute_roots_of_unity(k)
+        ys_natural = [0] * k
+        for j, y in enumerate(coset_evals):
+            ys_natural[reverse_bits(j, k)] = y
+        f_coeffs = fft_field(ys_natural, small_roots, inv=True)
+        h_inv = FieldMath.inverse(self.coset_shift_for_cell(cell_index))
+        scale = 1
+        out = []
+        for c in f_coeffs:
+            out.append(c * scale % BLS_MODULUS)
+            scale = scale * h_inv % BLS_MODULUS
+        return out
+
+    def verify_cell_kzg_proof_batch_impl(self, commitments,
+                                         commitment_indices, cell_indices,
+                                         cosets_evals, proofs) -> bool:
+        """Universal verification equation (:379)."""
+        assert len(commitment_indices) == len(cell_indices) \
+            == len(cosets_evals) == len(proofs)
+        assert len(commitments) == len(set(commitments))
+        for commitment_index in commitment_indices:
+            assert commitment_index < len(commitments)
+
+        num_cells = len(cell_indices)
+        n = self.fe_per_cell
+        num_commitments = len(commitments)
+
+        r = self.compute_verify_cell_kzg_proof_batch_challenge(
+            commitments, commitment_indices, cell_indices, cosets_evals,
+            proofs)
+        r_powers = compute_powers(r, num_cells)
+
+        proof_points = [cv.g1_from_bytes(bytes(p), subgroup_check=False)
+                        for p in proofs]
+        # LL = sum_k r^k proofs[k]
+        ll = msm(proof_points, r_powers)
+        # LR = [s^n]
+        lr = cv.g2_from_bytes(self._g2_monomial_bytes[n],
+                              subgroup_check=False)
+
+        # RLC = sum_i weights[i] commitments[i]
+        weights = [0] * num_commitments
+        for k in range(num_cells):
+            i = commitment_indices[k]
+            weights[i] = (weights[i] + r_powers[k]) % BLS_MODULUS
+        commitment_points = [
+            cv.g1_from_bytes(bytes(c), subgroup_check=False)
+            for c in commitments]
+        rlc = msm(commitment_points, weights)
+
+        # RLI = [sum_k r^k interp_k(s)]
+        sum_interp_polys_coeff = [0] * n
+        for k in range(num_cells):
+            interp = self._interpolate_coset(cell_indices[k],
+                                             cosets_evals[k])
+            scaled = [c * r_powers[k] % BLS_MODULUS for c in interp]
+            sum_interp_polys_coeff = add_polynomialcoeff(
+                sum_interp_polys_coeff, scaled)
+        rli = msm(self.g1_monomial()[:n], sum_interp_polys_coeff[:n])
+
+        # RLP = sum_k (r^k h_k^n) proofs[k]
+        weighted_r_powers = []
+        for k in range(num_cells):
+            h_k = self.coset_shift_for_cell(cell_indices[k])
+            h_k_pow = pow(h_k, n, BLS_MODULUS)
+            weighted_r_powers.append(r_powers[k] * h_k_pow % BLS_MODULUS)
+        rlp = msm(proof_points, weighted_r_powers)
+
+        rl = rlc + (-rli) + rlp
+
+        from .pairing import pairing_check
+        g2_0 = cv.g2_from_bytes(self._g2_monomial_bytes[0],
+                                subgroup_check=False)
+        return pairing_check([(ll, lr), (rl, -g2_0)])
+
+    def verify_cell_kzg_proof_batch(self, commitments_bytes, cell_indices,
+                                    cells, proofs_bytes) -> bool:
+        """Public method (:564)."""
+        assert len(commitments_bytes) == len(cells) == len(proofs_bytes) \
+            == len(cell_indices)
+        for commitment_bytes in commitments_bytes:
+            assert len(commitment_bytes) == BYTES_PER_COMMITMENT
+        for cell_index in cell_indices:
+            assert cell_index < self.cells_per_ext_blob
+        for cell in cells:
+            assert len(cell) == self.bytes_per_cell
+        for proof_bytes in proofs_bytes:
+            assert len(proof_bytes) == BYTES_PER_PROOF
+
+        # deterministic order-preserving dedup (the reference uses set())
+        deduplicated = list(dict.fromkeys(bytes(c)
+                                          for c in commitments_bytes))
+        for c in deduplicated:
+            self.validate_kzg_g1(c)
+        commitment_indices = [deduplicated.index(bytes(c))
+                              for c in commitments_bytes]
+        cosets_evals = [self.cell_to_coset_evals(cell) for cell in cells]
+        for p in proofs_bytes:
+            self.validate_kzg_g1(p)
+        return self.verify_cell_kzg_proof_batch_impl(
+            deduplicated, commitment_indices, cell_indices, cosets_evals,
+            [bytes(p) for p in proofs_bytes])
+
+    # -- reconstruction (:615-741)
+    def construct_vanishing_polynomial(self, missing_cell_indices):
+        roots_of_unity_reduced = compute_roots_of_unity(
+            self.cells_per_ext_blob)
+        short_zero_poly = vanishing_polynomialcoeff([
+            roots_of_unity_reduced[
+                reverse_bits(i, self.cells_per_ext_blob)]
+            for i in missing_cell_indices])
+        zero_poly_coeff = [0] * self.ext_width
+        for i, coeff in enumerate(short_zero_poly):
+            zero_poly_coeff[i * self.fe_per_cell] = coeff
+        return zero_poly_coeff
+
+    def recover_polynomialcoeff(self, cell_indices, cosets_evals):
+        """Zero-poly FFT recovery (:646)."""
+        roots_ext = compute_roots_of_unity(self.ext_width)
+
+        extended_evaluation_rbo = [0] * self.ext_width
+        for cell_index, cell in zip(cell_indices, cosets_evals):
+            start = cell_index * self.fe_per_cell
+            extended_evaluation_rbo[start:start + self.fe_per_cell] = cell
+        extended_evaluation = bit_reversal_permutation(
+            extended_evaluation_rbo)
+
+        missing_cell_indices = [
+            i for i in range(self.cells_per_ext_blob)
+            if i not in cell_indices]
+        zero_poly_coeff = self.construct_vanishing_polynomial(
+            missing_cell_indices)
+        zero_poly_eval = fft_field(zero_poly_coeff, roots_ext)
+
+        extended_evaluation_times_zero = [
+            a * b % BLS_MODULUS
+            for a, b in zip(zero_poly_eval, extended_evaluation)]
+        extended_evaluation_times_zero_coeffs = fft_field(
+            extended_evaluation_times_zero, roots_ext, inv=True)
+
+        extended_evaluations_over_coset = coset_fft_field(
+            extended_evaluation_times_zero_coeffs, roots_ext)
+        zero_poly_over_coset = coset_fft_field(zero_poly_coeff, roots_ext)
+
+        inv_zero = FieldMath.batch_inverse(zero_poly_over_coset)
+        reconstructed_poly_over_coset = [
+            a * b % BLS_MODULUS
+            for a, b in zip(extended_evaluations_over_coset, inv_zero)]
+        reconstructed_poly_coeff = coset_fft_field(
+            reconstructed_poly_over_coset, roots_ext, inv=True)
+        return reconstructed_poly_coeff[:self.width]
+
+    def recover_cells_and_kzg_proofs(self, cell_indices, cells):
+        """Public method (:706)."""
+        assert len(cell_indices) == len(cells)
+        assert self.cells_per_ext_blob / 2 <= len(cell_indices) \
+            <= self.cells_per_ext_blob
+        assert len(cell_indices) == len(set(cell_indices))
+        for cell_index in cell_indices:
+            assert cell_index < self.cells_per_ext_blob
+        for cell in cells:
+            assert len(cell) == self.bytes_per_cell
+
+        cosets_evals = [self.cell_to_coset_evals(cell) for cell in cells]
+        polynomial_coeff = self.recover_polynomialcoeff(
+            cell_indices, cosets_evals)
+        return self.compute_cells_and_kzg_proofs_polynomialcoeff(
+            polynomial_coeff)
+
+
+@lru_cache(maxsize=4)
+def get_kzg_sampling(field_elements_per_blob: int = 4096,
+                     field_elements_per_cell: int = 64) -> KZGSampling:
+    return KZGSampling(field_elements_per_blob, field_elements_per_cell)

@@ -1,0 +1,353 @@
+"""Fulu spec: PeerDAS — cells/columns, custody groups, cell KZG proofs,
+erasure recovery, peer sampling.
+
+From-scratch implementation of /root/reference/specs/fulu/
+{das-core.md,polynomial-commitments-sampling.md,fork.md,fork-choice.md,
+p2p-interface.md,peer-sampling.md,beacon-chain.md} as an ElectraSpec
+subclass.  The cell-proof engine lives in crypto/kzg_sampling.py.
+"""
+from ..ssz import (
+    uint64, Vector, List, Container, ByteVector, Bytes4, Bytes32, Bytes48,
+    hash_tree_root,
+)
+from ..ssz.proofs import (
+    compute_merkle_proof, get_generalized_index, get_subtree_index,
+)
+from ..crypto.kzg_sampling import get_kzg_sampling
+from ..utils.hash import hash as sha256_hash
+from .electra import ElectraSpec
+from .phase0 import bytes_to_uint64
+
+
+class FuluSpec(ElectraSpec):
+    fork = "fulu"
+
+    # ------------------------------------------------------------------
+    # constants & derived presets (das-core.md:42-74, sampling.md:84-96)
+    # ------------------------------------------------------------------
+    def _build_constants(self) -> None:
+        super()._build_constants()
+        self.UINT256_MAX = 2**256 - 1
+        self.FIELD_ELEMENTS_PER_EXT_BLOB = 2 * self.FIELD_ELEMENTS_PER_BLOB
+        self.BYTES_PER_CELL = \
+            self.FIELD_ELEMENTS_PER_CELL * self.BYTES_PER_FIELD_ELEMENT
+        self.CELLS_PER_EXT_BLOB = \
+            self.FIELD_ELEMENTS_PER_EXT_BLOB // self.FIELD_ELEMENTS_PER_CELL
+        self.RowIndex = uint64
+        self.ColumnIndex = uint64
+        self.CustodyIndex = uint64
+        self.CellIndex = uint64
+        self._kzg_sampling = get_kzg_sampling(
+            self.FIELD_ELEMENTS_PER_BLOB, self.FIELD_ELEMENTS_PER_CELL)
+
+    def _build_types(self) -> None:
+        super()._build_types()
+        p = self
+
+        self.Cell = ByteVector[p.BYTES_PER_CELL]
+
+        class DataColumnSidecar(Container):
+            index: uint64
+            column: List[p.Cell, p.MAX_BLOB_COMMITMENTS_PER_BLOCK]
+            kzg_commitments: List[Bytes48, p.MAX_BLOB_COMMITMENTS_PER_BLOCK]
+            kzg_proofs: List[Bytes48, p.MAX_BLOB_COMMITMENTS_PER_BLOCK]
+            signed_block_header: p.SignedBeaconBlockHeader
+            kzg_commitments_inclusion_proof: Vector[Bytes32, p.KZG_COMMITMENTS_INCLUSION_PROOF_DEPTH]
+
+        class MatrixEntry(Container):
+            cell: p.Cell
+            kzg_proof: Bytes48
+            column_index: uint64
+            row_index: uint64
+
+        class DataColumnIdentifier(Container):
+            block_root: Bytes32
+            index: uint64
+
+        for name, cls in list(locals().items()):
+            if isinstance(cls, type) and issubclass(cls, Container):
+                setattr(self, name, cls)
+
+    # ------------------------------------------------------------------
+    # KZG sampling surface (polynomial-commitments-sampling.md public
+    # methods + helpers)
+    # ------------------------------------------------------------------
+    compute_cells_and_kzg_proofs = property(
+        lambda self: self._kzg_sampling.compute_cells_and_kzg_proofs)
+    verify_cell_kzg_proof_batch = property(
+        lambda self: self._kzg_sampling.verify_cell_kzg_proof_batch)
+    recover_cells_and_kzg_proofs = property(
+        lambda self: self._kzg_sampling.recover_cells_and_kzg_proofs)
+    cell_to_coset_evals = property(
+        lambda self: self._kzg_sampling.cell_to_coset_evals)
+    coset_evals_to_cell = property(
+        lambda self: self._kzg_sampling.coset_evals_to_cell)
+    coset_for_cell = property(
+        lambda self: self._kzg_sampling.coset_for_cell)
+    coset_shift_for_cell = property(
+        lambda self: self._kzg_sampling.coset_shift_for_cell)
+
+    # ------------------------------------------------------------------
+    # custody (das-core.md:102-137)
+    # ------------------------------------------------------------------
+    def get_custody_groups(self, node_id: int, custody_group_count: int):
+        assert custody_group_count <= self.config.NUMBER_OF_CUSTODY_GROUPS
+        current_id = int(node_id)
+        custody_groups: list = []
+        while len(custody_groups) < custody_group_count:
+            digest = sha256_hash(current_id.to_bytes(32, "little"))
+            custody_group = uint64(
+                bytes_to_uint64(digest[0:8])
+                % self.config.NUMBER_OF_CUSTODY_GROUPS)
+            if custody_group not in custody_groups:
+                custody_groups.append(custody_group)
+            if current_id == self.UINT256_MAX:
+                current_id = 0
+            else:
+                current_id += 1
+        assert len(custody_groups) == len(set(custody_groups))
+        return sorted(custody_groups)
+
+    def compute_columns_for_custody_group(self, custody_group: int):
+        assert custody_group < self.config.NUMBER_OF_CUSTODY_GROUPS
+        columns_per_group = self.config.NUMBER_OF_COLUMNS \
+            // self.config.NUMBER_OF_CUSTODY_GROUPS
+        return sorted([
+            uint64(self.config.NUMBER_OF_CUSTODY_GROUPS * i + custody_group)
+            for i in range(columns_per_group)])
+
+    # ------------------------------------------------------------------
+    # matrix (das-core.md:139-186)
+    # ------------------------------------------------------------------
+    def compute_matrix(self, blobs):
+        matrix = []
+        for blob_index, blob in enumerate(blobs):
+            cells, proofs = self.compute_cells_and_kzg_proofs(bytes(blob))
+            for cell_index, (cell, proof) in enumerate(zip(cells, proofs)):
+                matrix.append(self.MatrixEntry(
+                    cell=cell,
+                    kzg_proof=proof,
+                    row_index=blob_index,
+                    column_index=cell_index))
+        return matrix
+
+    def recover_matrix(self, partial_matrix, blob_count: int):
+        matrix = []
+        for blob_index in range(blob_count):
+            cell_indices = [int(e.column_index) for e in partial_matrix
+                            if e.row_index == blob_index]
+            cells = [bytes(e.cell) for e in partial_matrix
+                     if e.row_index == blob_index]
+            recovered_cells, recovered_proofs = \
+                self.recover_cells_and_kzg_proofs(cell_indices, cells)
+            for cell_index, (cell, proof) in enumerate(
+                    zip(recovered_cells, recovered_proofs)):
+                matrix.append(self.MatrixEntry(
+                    cell=cell,
+                    kzg_proof=proof,
+                    row_index=blob_index,
+                    column_index=cell_index))
+        return matrix
+
+    # ------------------------------------------------------------------
+    # sidecars (das-core.md:187-221, p2p-interface.md:81-141)
+    # ------------------------------------------------------------------
+    def compute_signed_block_header(self, signed_block):
+        block = signed_block.message
+        block_header = self.BeaconBlockHeader(
+            slot=block.slot,
+            proposer_index=block.proposer_index,
+            parent_root=block.parent_root,
+            state_root=block.state_root,
+            body_root=hash_tree_root(block.body))
+        return self.SignedBeaconBlockHeader(
+            message=block_header, signature=signed_block.signature)
+
+    def get_data_column_sidecars(self, signed_block, cells_and_kzg_proofs):
+        blob_kzg_commitments = \
+            signed_block.message.body.blob_kzg_commitments
+        assert len(cells_and_kzg_proofs) == len(blob_kzg_commitments)
+        signed_block_header = self.compute_signed_block_header(signed_block)
+        kzg_commitments_inclusion_proof = compute_merkle_proof(
+            signed_block.message.body,
+            get_generalized_index(self.BeaconBlockBody,
+                                  "blob_kzg_commitments"))
+        sidecars = []
+        for column_index in range(self.config.NUMBER_OF_COLUMNS):
+            column_cells, column_proofs = [], []
+            for cells, proofs in cells_and_kzg_proofs:
+                column_cells.append(cells[column_index])
+                column_proofs.append(proofs[column_index])
+            sidecars.append(self.DataColumnSidecar(
+                index=column_index,
+                column=column_cells,
+                kzg_commitments=list(blob_kzg_commitments),
+                kzg_proofs=column_proofs,
+                signed_block_header=signed_block_header,
+                kzg_commitments_inclusion_proof=(
+                    kzg_commitments_inclusion_proof)))
+        return sidecars
+
+    def verify_data_column_sidecar(self, sidecar) -> bool:
+        """p2p-interface.md:81"""
+        if sidecar.index >= self.config.NUMBER_OF_COLUMNS:
+            return False
+        if len(sidecar.kzg_commitments) == 0:
+            return False
+        if (len(sidecar.column) != len(sidecar.kzg_commitments)
+                or len(sidecar.column) != len(sidecar.kzg_proofs)):
+            return False
+        return True
+
+    def verify_data_column_sidecar_kzg_proofs(self, sidecar) -> bool:
+        """p2p-interface.md:103"""
+        cell_indices = [int(sidecar.index)] * len(sidecar.column)
+        return self.verify_cell_kzg_proof_batch(
+            commitments_bytes=[bytes(c) for c in sidecar.kzg_commitments],
+            cell_indices=cell_indices,
+            cells=[bytes(c) for c in sidecar.column],
+            proofs_bytes=[bytes(p) for p in sidecar.kzg_proofs])
+
+    def verify_data_column_sidecar_inclusion_proof(self, sidecar) -> bool:
+        """p2p-interface.md:122"""
+        gindex = get_subtree_index(get_generalized_index(
+            self.BeaconBlockBody, "blob_kzg_commitments"))
+        return self.is_valid_merkle_branch(
+            leaf=hash_tree_root(sidecar.kzg_commitments),
+            branch=sidecar.kzg_commitments_inclusion_proof,
+            depth=self.KZG_COMMITMENTS_INCLUSION_PROOF_DEPTH,
+            index=gindex,
+            root=sidecar.signed_block_header.message.body_root)
+
+    def compute_subnet_for_data_column_sidecar(self, column_index: int):
+        return uint64(int(column_index)
+                      % self.config.DATA_COLUMN_SIDECAR_SUBNET_COUNT)
+
+    # ------------------------------------------------------------------
+    # peer sampling (peer-sampling.md:33)
+    # ------------------------------------------------------------------
+    def get_extended_sample_count(self, allowed_failures: int) -> int:
+        assert 0 <= allowed_failures <= self.config.NUMBER_OF_COLUMNS // 2
+
+        def math_comb(n: int, k: int) -> int:
+            if not 0 <= k <= n:
+                return 0
+            r = 1
+            for i in range(min(k, n - k)):
+                r = r * (n - i) // (i + 1)
+            return r
+
+        def hypergeom_cdf(k, M, n, N) -> float:
+            k, M, n, N = int(k), int(M), int(n), int(N)
+            return sum(math_comb(n, i) * math_comb(M - n, N - i)
+                       / math_comb(M, N) for i in range(k + 1))
+
+        number_of_columns = self.config.NUMBER_OF_COLUMNS
+        samples_per_slot = self.config.SAMPLES_PER_SLOT
+        worst_case_missing = number_of_columns // 2 + 1
+        false_positive_threshold = hypergeom_cdf(
+            0, number_of_columns, worst_case_missing, samples_per_slot)
+        for sample_count in range(samples_per_slot,
+                                  number_of_columns + 1):
+            if hypergeom_cdf(allowed_failures, number_of_columns,
+                             worst_case_missing,
+                             sample_count) <= false_positive_threshold:
+                break
+        return uint64(sample_count)
+
+    # ------------------------------------------------------------------
+    # beacon-chain delta (beacon-chain.md:37) + fork choice
+    # ------------------------------------------------------------------
+    def max_blobs_per_block(self) -> int:
+        # [Modified in Fulu:EIP7594]
+        return self.config.MAX_BLOBS_PER_BLOCK_FULU
+
+    def retrieve_column_sidecars(self, beacon_block_root):
+        """Network-retrieval stub; tests monkeypatch
+        (fulu/fork-choice.md:26 is_data_available)."""
+        return "TEST"
+
+    def is_data_available(self, beacon_block_root,
+                          blob_kzg_commitments=None) -> bool:
+        column_sidecars = self.retrieve_column_sidecars(beacon_block_root)
+        if isinstance(column_sidecars, str) and column_sidecars == "TEST":
+            return True
+        return all(
+            self.verify_data_column_sidecar(sidecar)
+            and self.verify_data_column_sidecar_kzg_proofs(sidecar)
+            for sidecar in column_sidecars)
+
+    # ------------------------------------------------------------------
+    # fork helpers (fork.md:41)
+    # ------------------------------------------------------------------
+    def compute_fork_version(self, epoch):
+        cfg = self.config
+        ladder = [
+            (cfg.FULU_FORK_EPOCH, cfg.FULU_FORK_VERSION),
+            (cfg.ELECTRA_FORK_EPOCH, cfg.ELECTRA_FORK_VERSION),
+            (cfg.DENEB_FORK_EPOCH, cfg.DENEB_FORK_VERSION),
+            (cfg.CAPELLA_FORK_EPOCH, cfg.CAPELLA_FORK_VERSION),
+            (cfg.BELLATRIX_FORK_EPOCH, cfg.BELLATRIX_FORK_VERSION),
+            (cfg.ALTAIR_FORK_EPOCH, cfg.ALTAIR_FORK_VERSION),
+        ]
+        for fork_epoch, version in ladder:
+            if epoch >= fork_epoch:
+                return Bytes4(version)
+        return Bytes4(cfg.GENESIS_FORK_VERSION)
+
+    def genesis_fork_versions(self):
+        return (Bytes4(self.config.ELECTRA_FORK_VERSION),
+                Bytes4(self.config.FULU_FORK_VERSION))
+
+    def upgrade_from(self, pre):
+        """upgrade_to_fulu (fulu/fork.md:75): same state shape as electra,
+        only the fork version advances."""
+        epoch = self.get_current_epoch(pre)
+        post = self.BeaconState(
+            genesis_time=pre.genesis_time,
+            genesis_validators_root=pre.genesis_validators_root,
+            slot=pre.slot,
+            fork=self.Fork(
+                previous_version=pre.fork.current_version,
+                current_version=Bytes4(self.config.FULU_FORK_VERSION),
+                epoch=epoch),
+            latest_block_header=pre.latest_block_header,
+            block_roots=list(pre.block_roots),
+            state_roots=list(pre.state_roots),
+            historical_roots=list(pre.historical_roots),
+            eth1_data=pre.eth1_data,
+            eth1_data_votes=list(pre.eth1_data_votes),
+            eth1_deposit_index=pre.eth1_deposit_index,
+            validators=list(pre.validators),
+            balances=list(pre.balances),
+            randao_mixes=list(pre.randao_mixes),
+            slashings=list(pre.slashings),
+            previous_epoch_participation=list(
+                pre.previous_epoch_participation),
+            current_epoch_participation=list(
+                pre.current_epoch_participation),
+            justification_bits=list(pre.justification_bits),
+            previous_justified_checkpoint=pre.previous_justified_checkpoint,
+            current_justified_checkpoint=pre.current_justified_checkpoint,
+            finalized_checkpoint=pre.finalized_checkpoint,
+            inactivity_scores=list(pre.inactivity_scores),
+            current_sync_committee=pre.current_sync_committee,
+            next_sync_committee=pre.next_sync_committee,
+            latest_execution_payload_header=(
+                pre.latest_execution_payload_header),
+            next_withdrawal_index=pre.next_withdrawal_index,
+            next_withdrawal_validator_index=(
+                pre.next_withdrawal_validator_index),
+            historical_summaries=list(pre.historical_summaries),
+            deposit_requests_start_index=pre.deposit_requests_start_index,
+            deposit_balance_to_consume=pre.deposit_balance_to_consume,
+            exit_balance_to_consume=pre.exit_balance_to_consume,
+            earliest_exit_epoch=pre.earliest_exit_epoch,
+            consolidation_balance_to_consume=(
+                pre.consolidation_balance_to_consume),
+            earliest_consolidation_epoch=pre.earliest_consolidation_epoch,
+            pending_deposits=list(pre.pending_deposits),
+            pending_partial_withdrawals=list(
+                pre.pending_partial_withdrawals),
+            pending_consolidations=list(pre.pending_consolidations))
+        return post
