@@ -1,0 +1,53 @@
+"""Fork-transition test harness.
+
+Counterpart of the reference harness's helpers/fork_transition.py
+(transition_until_fork / do_fork): advance a pre-fork state to the fork
+boundary under the pre spec, apply the post spec's state upgrade, and
+optionally apply the first post-fork block at the boundary slot.
+"""
+from __future__ import annotations
+
+from ..ssz import hash_tree_root, uint64
+from .blocks import build_empty_block, sign_block
+
+# canonical mainnet fork ladder (spec class MRO order)
+FORK_ORDER = ["phase0", "altair", "bellatrix", "capella", "deneb",
+              "electra", "fulu"]
+
+
+def transition_until_fork(pre_spec, state, fork_epoch: int) -> None:
+    """Advance to the last slot before the fork boundary, then process
+    the boundary epoch under the pre spec (the upgrade happens after the
+    pre-fork epoch processing, fork.md 'Fork trigger')."""
+    boundary_slot = uint64(fork_epoch * pre_spec.SLOTS_PER_EPOCH)
+    assert state.slot <= boundary_slot
+    if state.slot < boundary_slot:
+        pre_spec.process_slots(state, boundary_slot)
+
+
+def do_fork(pre_spec, post_spec, state, with_block: bool = True):
+    """Upgrade `state` (sitting at an epoch boundary) to the post fork,
+    optionally applying an empty post-fork block at the boundary slot.
+    Returns (post_state, signed_block_or_None)."""
+    assert state.slot % pre_spec.SLOTS_PER_EPOCH == 0
+    post_state = post_spec.upgrade_from(state)
+    assert post_state.fork.previous_version == state.fork.current_version
+
+    if not with_block:
+        return post_state, None
+
+    block = build_empty_block(post_spec, post_state, slot=post_state.slot)
+    # apply directly (process_slots already ran under the pre spec)
+    temp = post_state.copy()
+    post_spec.process_block(temp, block)
+    block.state_root = hash_tree_root(temp)
+    signed = sign_block(post_spec, post_state, block)
+    post_spec.process_block(post_state, block)
+    return post_state, signed
+
+
+def transition_across(pre_spec, post_spec, state, fork_epoch: int,
+                      with_block: bool = True):
+    """transition_until_fork + do_fork in one step."""
+    transition_until_fork(pre_spec, state, fork_epoch)
+    return do_fork(pre_spec, post_spec, state, with_block=with_block)

@@ -1,0 +1,89 @@
+"""Fork-transition tests: cross each fork boundary with a live state and
+keep the chain running under the post spec.
+
+Counterpart of the reference's transition generator
+(/root/reference/tests/generators/transition/main.py +
+test/helpers/fork_transition.py).
+"""
+import pytest
+
+from consensus_specs_tpu.specs import get_spec
+from consensus_specs_tpu.ssz import hash_tree_root
+from consensus_specs_tpu.test_infra import disable_bls
+from consensus_specs_tpu.test_infra.genesis import (
+    create_genesis_state, default_balances)
+from consensus_specs_tpu.test_infra.blocks import (
+    apply_empty_block, next_epoch)
+from consensus_specs_tpu.test_infra.fork_transition import (
+    FORK_ORDER, do_fork, transition_across, transition_until_fork)
+
+
+PAIRS = list(zip(FORK_ORDER[:-1], FORK_ORDER[1:]))
+
+
+@pytest.mark.parametrize("pre_fork,post_fork", PAIRS,
+                         ids=[f"{a}_to_{b}" for a, b in PAIRS])
+def test_single_fork_transition(pre_fork, post_fork):
+    pre_spec = get_spec(pre_fork, "minimal")
+    post_spec = get_spec(post_fork, "minimal")
+    with disable_bls():
+        state = create_genesis_state(pre_spec, default_balances(pre_spec))
+        apply_empty_block(pre_spec, state)
+        post_state, signed = transition_across(
+            pre_spec, post_spec, state, fork_epoch=1)
+        # chain continues under the post spec
+        apply_empty_block(post_spec, post_state)
+    assert post_state.fork.epoch == 1
+    assert bytes(post_state.fork.current_version) != \
+        bytes(post_state.fork.previous_version)
+    hash_tree_root(post_state)
+
+
+def test_full_fork_ladder():
+    """One state carried phase0 -> fulu across every fork boundary."""
+    with disable_bls():
+        spec = get_spec(FORK_ORDER[0], "minimal")
+        state = create_genesis_state(spec, default_balances(spec))
+        apply_empty_block(spec, state)
+        for i, post_fork in enumerate(FORK_ORDER[1:], start=1):
+            post_spec = get_spec(post_fork, "minimal")
+            state, _ = transition_across(spec, post_spec, state,
+                                         fork_epoch=i)
+            spec = post_spec
+            # one extra block under the new fork before the next jump
+            apply_empty_block(spec, state)
+    assert spec.fork == "fulu"
+    assert state.fork.epoch == len(FORK_ORDER) - 1
+    assert bytes(state.fork.current_version) == bytes.fromhex(
+        spec.config.FULU_FORK_VERSION[2:])
+    hash_tree_root(state)
+
+
+def test_transition_without_block():
+    pre_spec = get_spec("phase0", "minimal")
+    post_spec = get_spec("altair", "minimal")
+    with disable_bls():
+        state = create_genesis_state(pre_spec, default_balances(pre_spec))
+        apply_empty_block(pre_spec, state)
+        post_state, signed = transition_across(
+            pre_spec, post_spec, state, fork_epoch=1, with_block=False)
+    assert signed is None
+    assert post_state.slot == pre_spec.SLOTS_PER_EPOCH
+
+
+def test_fork_preserves_registry():
+    """Validator set and balances survive every upgrade unchanged (modulo
+    electra's pending-deposit reshuffling of inactive validators, which
+    doesn't apply to an all-active genesis set)."""
+    with disable_bls():
+        spec = get_spec("phase0", "minimal")
+        state = create_genesis_state(spec, default_balances(spec))
+        apply_empty_block(spec, state)
+        pre_root = hash_tree_root(state.validators)
+        for i, post_fork in enumerate(FORK_ORDER[1:], start=1):
+            post_spec = get_spec(post_fork, "minimal")
+            state, _ = transition_across(spec, post_spec, state,
+                                         fork_epoch=i, with_block=False)
+            spec = post_spec
+            next_epoch(spec, state)
+    assert hash_tree_root(state.validators) == pre_root
