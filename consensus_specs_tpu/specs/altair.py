@@ -12,10 +12,11 @@ from ..ssz import (
     Bytes4, Bytes32, Bytes48, Bytes96, hash_tree_root, uint_to_bytes,
 )
 from ..utils import bls
+from .light_client import LightClientMixin
 from .phase0 import Phase0Spec, integer_squareroot
 
 
-class AltairSpec(Phase0Spec):
+class AltairSpec(LightClientMixin, Phase0Spec):
     fork = "altair"
 
     # ------------------------------------------------------------------

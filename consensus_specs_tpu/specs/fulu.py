@@ -278,23 +278,9 @@ class FuluSpec(ElectraSpec):
             for sidecar in column_sidecars)
 
     # ------------------------------------------------------------------
-    # fork helpers (fork.md:41)
+    # fork helpers (fork.md:41; compute_fork_version is the generic
+    # ladder on Phase0Spec)
     # ------------------------------------------------------------------
-    def compute_fork_version(self, epoch):
-        cfg = self.config
-        ladder = [
-            (cfg.FULU_FORK_EPOCH, cfg.FULU_FORK_VERSION),
-            (cfg.ELECTRA_FORK_EPOCH, cfg.ELECTRA_FORK_VERSION),
-            (cfg.DENEB_FORK_EPOCH, cfg.DENEB_FORK_VERSION),
-            (cfg.CAPELLA_FORK_EPOCH, cfg.CAPELLA_FORK_VERSION),
-            (cfg.BELLATRIX_FORK_EPOCH, cfg.BELLATRIX_FORK_VERSION),
-            (cfg.ALTAIR_FORK_EPOCH, cfg.ALTAIR_FORK_VERSION),
-        ]
-        for fork_epoch, version in ladder:
-            if epoch >= fork_epoch:
-                return Bytes4(version)
-        return Bytes4(cfg.GENESIS_FORK_VERSION)
-
     def genesis_fork_versions(self):
         return (Bytes4(self.config.ELECTRA_FORK_VERSION),
                 Bytes4(self.config.FULU_FORK_VERSION))

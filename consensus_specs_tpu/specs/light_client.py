@@ -1,0 +1,591 @@
+"""Light-client sync protocol (altair + capella/deneb/electra upgrades).
+
+From-scratch implementation of
+/root/reference/specs/altair/light-client/{sync-protocol.md,full-node.md}
+with the capella execution-header extension
+(specs/capella/light-client/sync-protocol.md), the deneb blob-field rules
+(specs/deneb/light-client/sync-protocol.md) and the electra generalized-
+index migration (specs/electra/light-client/sync-protocol.md).
+
+Mixed into AltairSpec so every post-altair spec instance carries the
+protocol; container shapes and generalized indices adapt per fork.
+
+NOTE: SSZ Container fields are live class annotations (no PEP 563 here).
+"""
+from dataclasses import dataclass
+from typing import Optional
+
+from ..ssz import (
+    Container, Vector, Bytes32, Bytes96, hash_tree_root, uint64,
+)
+from ..ssz.merkle import is_valid_merkle_branch
+from ..ssz.proofs import compute_merkle_proof, get_generalized_index
+from ..utils import bls
+
+
+def floorlog2(x: int) -> int:
+    assert x > 0
+    return int(x).bit_length() - 1
+
+
+@dataclass
+class LightClientStore:
+    """altair/light-client/sync-protocol.md:157"""
+    finalized_header: object
+    current_sync_committee: object
+    next_sync_committee: object
+    best_valid_update: Optional[object]
+    optimistic_header: object
+    previous_max_active_participants: int
+    current_max_active_participants: int
+
+
+class LightClientMixin:
+    # frozen pre-electra constants (sync-protocol.md:72-78; electra
+    # sync-protocol.md "Frozen constants")
+    FINALIZED_ROOT_GINDEX = 105
+    CURRENT_SYNC_COMMITTEE_GINDEX = 54
+    NEXT_SYNC_COMMITTEE_GINDEX = 55
+
+    # ------------------------------------------------------------------
+    # fork-dependent generalized indices
+    # ------------------------------------------------------------------
+    def _own_state_gindex(self, *path) -> int:
+        key = ("lc_state_gindex", path)
+        return self._cached(key, lambda: get_generalized_index(
+            self.BeaconState, *path))
+
+    def execution_payload_gindex(self) -> int:
+        """capella/light-client/sync-protocol.md EXECUTION_PAYLOAD_GINDEX
+        (= 25)."""
+        return self._cached(
+            ("lc_exec_gindex",),
+            lambda: get_generalized_index(self.BeaconBlockBody,
+                                          "execution_payload"))
+
+    def finalized_root_gindex_at_slot(self, slot) -> int:
+        epoch = self.compute_epoch_at_slot(slot)
+        if self.is_post("electra") and \
+                epoch >= self.config.ELECTRA_FORK_EPOCH:
+            return self._own_state_gindex("finalized_checkpoint", "root")
+        return self.FINALIZED_ROOT_GINDEX
+
+    def current_sync_committee_gindex_at_slot(self, slot) -> int:
+        epoch = self.compute_epoch_at_slot(slot)
+        if self.is_post("electra") and \
+                epoch >= self.config.ELECTRA_FORK_EPOCH:
+            return self._own_state_gindex("current_sync_committee")
+        return self.CURRENT_SYNC_COMMITTEE_GINDEX
+
+    def next_sync_committee_gindex_at_slot(self, slot) -> int:
+        epoch = self.compute_epoch_at_slot(slot)
+        if self.is_post("electra") and \
+                epoch >= self.config.ELECTRA_FORK_EPOCH:
+            return self._own_state_gindex("next_sync_committee")
+        return self.NEXT_SYNC_COMMITTEE_GINDEX
+
+    # ------------------------------------------------------------------
+    # container types (built lazily; shapes depend on the spec's fork)
+    # ------------------------------------------------------------------
+    def _lc(self) -> dict:
+        def build():
+            p = self
+            fin_len = floorlog2(
+                self._own_state_gindex("finalized_checkpoint", "root")
+                if self.is_post("electra") else self.FINALIZED_ROOT_GINDEX)
+            csc_len = floorlog2(
+                self._own_state_gindex("current_sync_committee")
+                if self.is_post("electra")
+                else self.CURRENT_SYNC_COMMITTEE_GINDEX)
+            nsc_len = floorlog2(
+                self._own_state_gindex("next_sync_committee")
+                if self.is_post("electra")
+                else self.NEXT_SYNC_COMMITTEE_GINDEX)
+
+            if self.is_post("capella"):
+                exec_len = floorlog2(self.execution_payload_gindex())
+
+                class LightClientHeader(Container):
+                    beacon: p.BeaconBlockHeader
+                    execution: p.ExecutionPayloadHeader
+                    execution_branch: Vector[Bytes32, exec_len]
+            else:
+                class LightClientHeader(Container):
+                    beacon: p.BeaconBlockHeader
+
+            class LightClientBootstrap(Container):
+                header: LightClientHeader
+                current_sync_committee: p.SyncCommittee
+                current_sync_committee_branch: Vector[Bytes32, csc_len]
+
+            class LightClientUpdate(Container):
+                attested_header: LightClientHeader
+                next_sync_committee: p.SyncCommittee
+                next_sync_committee_branch: Vector[Bytes32, nsc_len]
+                finalized_header: LightClientHeader
+                finality_branch: Vector[Bytes32, fin_len]
+                sync_aggregate: p.SyncAggregate
+                signature_slot: uint64
+
+            class LightClientFinalityUpdate(Container):
+                attested_header: LightClientHeader
+                finalized_header: LightClientHeader
+                finality_branch: Vector[Bytes32, fin_len]
+                sync_aggregate: p.SyncAggregate
+                signature_slot: uint64
+
+            class LightClientOptimisticUpdate(Container):
+                attested_header: LightClientHeader
+                sync_aggregate: p.SyncAggregate
+                signature_slot: uint64
+
+            types = {
+                "LightClientHeader": LightClientHeader,
+                "LightClientBootstrap": LightClientBootstrap,
+                "LightClientUpdate": LightClientUpdate,
+                "LightClientFinalityUpdate": LightClientFinalityUpdate,
+                "LightClientOptimisticUpdate": LightClientOptimisticUpdate,
+            }
+            for name, cls in types.items():
+                setattr(self, name, cls)
+            return types
+        return self._cached(("lc_types",), build)
+
+    # ------------------------------------------------------------------
+    # header validity (capella/deneb/electra deltas folded in)
+    # ------------------------------------------------------------------
+    def get_lc_execution_root(self, header):
+        """capella/light-client/sync-protocol.md get_lc_execution_root,
+        with the electra-era historical dispatch."""
+        if not self.is_post("capella"):
+            return Bytes32()
+        epoch = self.compute_epoch_at_slot(header.beacon.slot)
+        if epoch < self.config.CAPELLA_FORK_EPOCH:
+            return Bytes32()
+        if self.is_post("deneb") and \
+                epoch < self.config.DENEB_FORK_EPOCH:
+            # historical capella-era header: hash with the capella shape
+            from . import get_spec
+            capella_type = get_spec(
+                "capella", self.preset_name).ExecutionPayloadHeader
+            fields = {name: getattr(header.execution, name)
+                      for name in capella_type.fields()}
+            return hash_tree_root(capella_type(**fields))
+        return hash_tree_root(header.execution)
+
+    def is_valid_light_client_header(self, header) -> bool:
+        if not self.is_post("capella"):
+            return True
+        epoch = self.compute_epoch_at_slot(header.beacon.slot)
+        if epoch < self.config.CAPELLA_FORK_EPOCH:
+            return (header.execution == self.ExecutionPayloadHeader()
+                    and header.execution_branch == type(
+                        header.execution_branch)())
+        if self.is_post("deneb") and epoch < self.config.DENEB_FORK_EPOCH:
+            # deneb LC: blob-gas fields must be zero before deneb
+            if (header.execution.blob_gas_used != 0
+                    or header.execution.excess_blob_gas != 0):
+                return False
+        gindex = self.execution_payload_gindex()
+        return is_valid_merkle_branch(
+            bytes(self.get_lc_execution_root(header)),
+            [bytes(b) for b in header.execution_branch],
+            floorlog2(gindex),
+            gindex % 2**floorlog2(gindex),
+            bytes(header.beacon.body_root))
+
+    # ------------------------------------------------------------------
+    # predicates & small helpers (sync-protocol.md:210-325)
+    # ------------------------------------------------------------------
+    def is_sync_committee_update(self, update) -> bool:
+        return update.next_sync_committee_branch != \
+            type(update.next_sync_committee_branch)()
+
+    def is_finality_update(self, update) -> bool:
+        return update.finality_branch != type(update.finality_branch)()
+
+    def is_next_sync_committee_known(self, store) -> bool:
+        return store.next_sync_committee != self.SyncCommittee()
+
+    def get_safety_threshold(self, store) -> int:
+        return max(store.previous_max_active_participants,
+                   store.current_max_active_participants) // 2
+
+    def is_valid_normalized_merkle_branch(self, leaf, branch, gindex,
+                                          root) -> bool:
+        depth = floorlog2(gindex)
+        index = gindex % 2**depth
+        num_extra = len(branch) - depth
+        for i in range(num_extra):
+            if bytes(branch[i]) != bytes(32):
+                return False
+        return is_valid_merkle_branch(
+            bytes(leaf), [bytes(b) for b in branch[num_extra:]],
+            depth, index, bytes(root))
+
+    def compute_sync_committee_period_at_slot(self, slot) -> int:
+        return self.compute_sync_committee_period(
+            self.compute_epoch_at_slot(slot))
+
+    def is_better_update(self, new_update, old_update) -> bool:
+        """Update preference order (sync-protocol.md:227)."""
+        max_active_participants = len(
+            new_update.sync_aggregate.sync_committee_bits)
+        new_num = sum(bool(b) for b in
+                      new_update.sync_aggregate.sync_committee_bits)
+        old_num = sum(bool(b) for b in
+                      old_update.sync_aggregate.sync_committee_bits)
+        new_has_supermajority = new_num * 3 >= max_active_participants * 2
+        old_has_supermajority = old_num * 3 >= max_active_participants * 2
+        if new_has_supermajority != old_has_supermajority:
+            return new_has_supermajority
+        if not new_has_supermajority and new_num != old_num:
+            return new_num > old_num
+
+        period = self.compute_sync_committee_period_at_slot
+        new_has_relevant = self.is_sync_committee_update(new_update) and (
+            period(new_update.attested_header.beacon.slot)
+            == period(new_update.signature_slot))
+        old_has_relevant = self.is_sync_committee_update(old_update) and (
+            period(old_update.attested_header.beacon.slot)
+            == period(old_update.signature_slot))
+        if new_has_relevant != old_has_relevant:
+            return new_has_relevant
+
+        new_has_finality = self.is_finality_update(new_update)
+        old_has_finality = self.is_finality_update(old_update)
+        if new_has_finality != old_has_finality:
+            return new_has_finality
+
+        if new_has_finality:
+            new_sc_finality = (
+                period(new_update.finalized_header.beacon.slot)
+                == period(new_update.attested_header.beacon.slot))
+            old_sc_finality = (
+                period(old_update.finalized_header.beacon.slot)
+                == period(old_update.attested_header.beacon.slot))
+            if new_sc_finality != old_sc_finality:
+                return new_sc_finality
+
+        if new_num != old_num:
+            return new_num > old_num
+
+        if new_update.attested_header.beacon.slot \
+                != old_update.attested_header.beacon.slot:
+            return new_update.attested_header.beacon.slot \
+                < old_update.attested_header.beacon.slot
+        return new_update.signature_slot < old_update.signature_slot
+
+    # ------------------------------------------------------------------
+    # initialization (sync-protocol.md:334)
+    # ------------------------------------------------------------------
+    def initialize_light_client_store(self, trusted_block_root,
+                                      bootstrap) -> LightClientStore:
+        self._lc()
+        assert self.is_valid_light_client_header(bootstrap.header)
+        assert hash_tree_root(bootstrap.header.beacon) == trusted_block_root
+        assert self.is_valid_normalized_merkle_branch(
+            hash_tree_root(bootstrap.current_sync_committee),
+            bootstrap.current_sync_committee_branch,
+            self.current_sync_committee_gindex_at_slot(
+                bootstrap.header.beacon.slot),
+            bootstrap.header.beacon.state_root)
+        return LightClientStore(
+            finalized_header=bootstrap.header,
+            current_sync_committee=bootstrap.current_sync_committee,
+            next_sync_committee=self.SyncCommittee(),
+            best_valid_update=None,
+            optimistic_header=bootstrap.header,
+            previous_max_active_participants=0,
+            current_max_active_participants=0)
+
+    # ------------------------------------------------------------------
+    # update validation / application (sync-protocol.md:368-533)
+    # ------------------------------------------------------------------
+    def validate_light_client_update(self, store, update, current_slot,
+                                     genesis_validators_root) -> None:
+        sync_aggregate = update.sync_aggregate
+        assert sum(bool(b) for b in sync_aggregate.sync_committee_bits) \
+            >= self.MIN_SYNC_COMMITTEE_PARTICIPANTS
+
+        assert self.is_valid_light_client_header(update.attested_header)
+        update_attested_slot = update.attested_header.beacon.slot
+        update_finalized_slot = update.finalized_header.beacon.slot
+        assert (current_slot >= update.signature_slot
+                > update_attested_slot >= update_finalized_slot)
+        store_period = self.compute_sync_committee_period_at_slot(
+            store.finalized_header.beacon.slot)
+        update_signature_period = \
+            self.compute_sync_committee_period_at_slot(
+                update.signature_slot)
+        if self.is_next_sync_committee_known(store):
+            assert update_signature_period in (store_period,
+                                               store_period + 1)
+        else:
+            assert update_signature_period == store_period
+
+        update_attested_period = \
+            self.compute_sync_committee_period_at_slot(update_attested_slot)
+        update_has_next_sync_committee = (
+            not self.is_next_sync_committee_known(store)
+            and self.is_sync_committee_update(update)
+            and update_attested_period == store_period)
+        assert (update_attested_slot > store.finalized_header.beacon.slot
+                or update_has_next_sync_committee)
+
+        if not self.is_finality_update(update):
+            assert update.finalized_header == self.LightClientHeader()
+        else:
+            if update_finalized_slot == self.GENESIS_SLOT:
+                assert update.finalized_header == self.LightClientHeader()
+                finalized_root = Bytes32()
+            else:
+                assert self.is_valid_light_client_header(
+                    update.finalized_header)
+                finalized_root = hash_tree_root(
+                    update.finalized_header.beacon)
+            assert self.is_valid_normalized_merkle_branch(
+                finalized_root,
+                update.finality_branch,
+                self.finalized_root_gindex_at_slot(update_attested_slot),
+                update.attested_header.beacon.state_root)
+
+        if not self.is_sync_committee_update(update):
+            assert update.next_sync_committee == self.SyncCommittee()
+        else:
+            if update_attested_period == store_period and \
+                    self.is_next_sync_committee_known(store):
+                assert update.next_sync_committee == \
+                    store.next_sync_committee
+            assert self.is_valid_normalized_merkle_branch(
+                hash_tree_root(update.next_sync_committee),
+                update.next_sync_committee_branch,
+                self.next_sync_committee_gindex_at_slot(
+                    update_attested_slot),
+                update.attested_header.beacon.state_root)
+
+        if update_signature_period == store_period:
+            sync_committee = store.current_sync_committee
+        else:
+            sync_committee = store.next_sync_committee
+        participant_pubkeys = [
+            pubkey for (bit, pubkey)
+            in zip(sync_aggregate.sync_committee_bits,
+                   sync_committee.pubkeys) if bit]
+        fork_version_slot = uint64(max(int(update.signature_slot), 1) - 1)
+        fork_version = self.compute_fork_version(
+            self.compute_epoch_at_slot(fork_version_slot))
+        domain = self.compute_domain(self.DOMAIN_SYNC_COMMITTEE,
+                                     fork_version, genesis_validators_root)
+        signing_root = self.compute_signing_root(
+            update.attested_header.beacon, domain)
+        assert bls.FastAggregateVerify(
+            participant_pubkeys, signing_root,
+            sync_aggregate.sync_committee_signature)
+
+    def apply_light_client_update(self, store, update) -> None:
+        store_period = self.compute_sync_committee_period_at_slot(
+            store.finalized_header.beacon.slot)
+        update_finalized_period = \
+            self.compute_sync_committee_period_at_slot(
+                update.finalized_header.beacon.slot)
+        if not self.is_next_sync_committee_known(store):
+            assert update_finalized_period == store_period
+            store.next_sync_committee = update.next_sync_committee
+        elif update_finalized_period == store_period + 1:
+            store.current_sync_committee = store.next_sync_committee
+            store.next_sync_committee = update.next_sync_committee
+            store.previous_max_active_participants = \
+                store.current_max_active_participants
+            store.current_max_active_participants = 0
+        if update.finalized_header.beacon.slot \
+                > store.finalized_header.beacon.slot:
+            store.finalized_header = update.finalized_header
+            if store.finalized_header.beacon.slot \
+                    > store.optimistic_header.beacon.slot:
+                store.optimistic_header = store.finalized_header
+
+    def process_light_client_store_force_update(self, store,
+                                                current_slot) -> None:
+        if (current_slot > store.finalized_header.beacon.slot
+                + self.UPDATE_TIMEOUT
+                and store.best_valid_update is not None):
+            if store.best_valid_update.finalized_header.beacon.slot \
+                    <= store.finalized_header.beacon.slot:
+                store.best_valid_update.finalized_header = \
+                    store.best_valid_update.attested_header
+            self.apply_light_client_update(store,
+                                           store.best_valid_update)
+            store.best_valid_update = None
+
+    def process_light_client_update(self, store, update, current_slot,
+                                    genesis_validators_root) -> None:
+        self.validate_light_client_update(
+            store, update, current_slot, genesis_validators_root)
+
+        sync_committee_bits = update.sync_aggregate.sync_committee_bits
+        num_participants = sum(bool(b) for b in sync_committee_bits)
+
+        if (store.best_valid_update is None
+                or self.is_better_update(update,
+                                         store.best_valid_update)):
+            store.best_valid_update = update
+
+        store.current_max_active_participants = max(
+            store.current_max_active_participants, num_participants)
+
+        if (num_participants > self.get_safety_threshold(store)
+                and update.attested_header.beacon.slot
+                > store.optimistic_header.beacon.slot):
+            store.optimistic_header = update.attested_header
+
+        update_has_finalized_next_sync_committee = (
+            not self.is_next_sync_committee_known(store)
+            and self.is_sync_committee_update(update)
+            and self.is_finality_update(update)
+            and (self.compute_sync_committee_period_at_slot(
+                    update.finalized_header.beacon.slot)
+                 == self.compute_sync_committee_period_at_slot(
+                    update.attested_header.beacon.slot)))
+        if (num_participants * 3 >= len(sync_committee_bits) * 2
+                and (update.finalized_header.beacon.slot
+                     > store.finalized_header.beacon.slot
+                     or update_has_finalized_next_sync_committee)):
+            self.apply_light_client_update(store, update)
+            store.best_valid_update = None
+
+    def process_light_client_finality_update(
+            self, store, finality_update, current_slot,
+            genesis_validators_root) -> None:
+        types = self._lc()
+        update = types["LightClientUpdate"](
+            attested_header=finality_update.attested_header,
+            finalized_header=finality_update.finalized_header,
+            finality_branch=finality_update.finality_branch,
+            sync_aggregate=finality_update.sync_aggregate,
+            signature_slot=finality_update.signature_slot)
+        self.process_light_client_update(
+            store, update, current_slot, genesis_validators_root)
+
+    def process_light_client_optimistic_update(
+            self, store, optimistic_update, current_slot,
+            genesis_validators_root) -> None:
+        types = self._lc()
+        update = types["LightClientUpdate"](
+            attested_header=optimistic_update.attested_header,
+            sync_aggregate=optimistic_update.sync_aggregate,
+            signature_slot=optimistic_update.signature_slot)
+        self.process_light_client_update(
+            store, update, current_slot, genesis_validators_root)
+
+    # ------------------------------------------------------------------
+    # full-node data derivation (full-node.md:40-171)
+    # ------------------------------------------------------------------
+    def block_to_light_client_header(self, block):
+        types = self._lc()
+        message = block.message
+        beacon = self.BeaconBlockHeader(
+            slot=message.slot,
+            proposer_index=message.proposer_index,
+            parent_root=message.parent_root,
+            state_root=message.state_root,
+            body_root=hash_tree_root(message.body))
+        if not self.is_post("capella"):
+            return types["LightClientHeader"](beacon=beacon)
+
+        epoch = self.compute_epoch_at_slot(message.slot)
+        if epoch < self.config.CAPELLA_FORK_EPOCH:
+            return types["LightClientHeader"](beacon=beacon)
+        payload = message.body.execution_payload
+        execution_header = self.build_execution_payload_header(payload)
+        execution_branch = compute_merkle_proof(
+            message.body, self.execution_payload_gindex())
+        return types["LightClientHeader"](
+            beacon=beacon,
+            execution=execution_header,
+            execution_branch=execution_branch)
+
+    def create_light_client_bootstrap(self, state, block):
+        types = self._lc()
+        assert self.compute_epoch_at_slot(state.slot) \
+            >= self.config.ALTAIR_FORK_EPOCH
+        assert state.slot == state.latest_block_header.slot
+        header = state.latest_block_header.copy()
+        header.state_root = hash_tree_root(state)
+        assert hash_tree_root(header) == hash_tree_root(block.message)
+        return types["LightClientBootstrap"](
+            header=self.block_to_light_client_header(block),
+            current_sync_committee=state.current_sync_committee,
+            current_sync_committee_branch=compute_merkle_proof(
+                state,
+                self.current_sync_committee_gindex_at_slot(state.slot)))
+
+    def create_light_client_update(self, state, block, attested_state,
+                                   attested_block, finalized_block):
+        types = self._lc()
+        assert self.compute_epoch_at_slot(attested_state.slot) \
+            >= self.config.ALTAIR_FORK_EPOCH
+        assert sum(bool(b) for b in
+                   block.message.body.sync_aggregate.sync_committee_bits) \
+            >= self.MIN_SYNC_COMMITTEE_PARTICIPANTS
+
+        assert state.slot == state.latest_block_header.slot
+        header = state.latest_block_header.copy()
+        header.state_root = hash_tree_root(state)
+        assert hash_tree_root(header) == hash_tree_root(block.message)
+        update_signature_period = \
+            self.compute_sync_committee_period_at_slot(block.message.slot)
+
+        assert attested_state.slot == \
+            attested_state.latest_block_header.slot
+        attested_header = attested_state.latest_block_header.copy()
+        attested_header.state_root = hash_tree_root(attested_state)
+        assert hash_tree_root(attested_header) \
+            == hash_tree_root(attested_block.message) \
+            == block.message.parent_root
+        update_attested_period = \
+            self.compute_sync_committee_period_at_slot(
+                attested_block.message.slot)
+
+        update = types["LightClientUpdate"]()
+        update.attested_header = \
+            self.block_to_light_client_header(attested_block)
+
+        if update_attested_period == update_signature_period:
+            update.next_sync_committee = attested_state.next_sync_committee
+            update.next_sync_committee_branch = compute_merkle_proof(
+                attested_state,
+                self.next_sync_committee_gindex_at_slot(
+                    attested_state.slot))
+
+        if finalized_block is not None:
+            if finalized_block.message.slot != self.GENESIS_SLOT:
+                update.finalized_header = \
+                    self.block_to_light_client_header(finalized_block)
+                assert hash_tree_root(update.finalized_header.beacon) \
+                    == attested_state.finalized_checkpoint.root
+            else:
+                assert attested_state.finalized_checkpoint.root == Bytes32()
+            update.finality_branch = compute_merkle_proof(
+                attested_state,
+                self.finalized_root_gindex_at_slot(attested_state.slot))
+
+        update.sync_aggregate = block.message.body.sync_aggregate
+        update.signature_slot = block.message.slot
+        return update
+
+    def create_light_client_finality_update(self, update):
+        types = self._lc()
+        return types["LightClientFinalityUpdate"](
+            attested_header=update.attested_header,
+            finalized_header=update.finalized_header,
+            finality_branch=update.finality_branch,
+            sync_aggregate=update.sync_aggregate,
+            signature_slot=update.signature_slot)
+
+    def create_light_client_optimistic_update(self, update):
+        types = self._lc()
+        return types["LightClientOptimisticUpdate"](
+            attested_header=update.attested_header,
+            sync_aggregate=update.sync_aggregate,
+            signature_slot=update.signature_slot)

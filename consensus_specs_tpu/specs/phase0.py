@@ -374,6 +374,21 @@ class Phase0Spec(Phase0ForkChoice, Phase0ValidatorDuties, BaseSpec):
         return Bytes4(self.compute_fork_data_root(
             current_version, genesis_validators_root)[:4])
 
+    def compute_fork_version(self, epoch):
+        """Fork version active at `epoch`, over this spec's fork ladder
+        (each fork's fork.md compute_fork_version, generalized)."""
+        ladder = ["fulu", "electra", "deneb", "capella", "bellatrix",
+                  "altair"]
+        for name in ladder:
+            if not self.is_post(name):
+                continue
+            fork_epoch = self.config.get(
+                f"{name.upper()}_FORK_EPOCH", 2**64 - 1)
+            if epoch >= fork_epoch:
+                return Bytes4(
+                    getattr(self.config, f"{name.upper()}_FORK_VERSION"))
+        return Bytes4(self.config.GENESIS_FORK_VERSION)
+
     def compute_domain(self, domain_type, fork_version=None,
                        genesis_validators_root=None):
         if fork_version is None:
