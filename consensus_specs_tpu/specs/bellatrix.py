@@ -14,6 +14,7 @@ from ..ssz import (
     hash_tree_root,
 )
 from .altair import AltairSpec
+from .optimistic_sync import OptimisticSync
 
 
 @dataclass
@@ -50,7 +51,7 @@ class NoopExecutionEngine:
         return True
 
 
-class BellatrixSpec(AltairSpec):
+class BellatrixSpec(OptimisticSync, AltairSpec):
     fork = "bellatrix"
 
     def _build_constants(self) -> None:

@@ -1,0 +1,230 @@
+"""Optimistic sync (merge-era partial sync without execution verification).
+
+From-scratch implementation of the reference's /root/reference/sync/optimistic.md:
+OptimisticStore, candidate rules (is_optimistic_candidate_block), the
+NOT_VALIDATED -> VALID / INVALIDATED retrospective transitions (with
+ancestor/descendant propagation), latestValidHash invalidation rules, and
+the optimistic fork-choice filter (INVALIDATED weight exclusion).
+
+Mixed into BellatrixSpec and later forks; the payload-status plumbing is a
+small state machine over block roots, so it is host-side Python (no TPU
+compute lives here — the heavy work stays in state_transition).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Dict, Set
+
+from ..ssz import Bytes32, hash_tree_root
+
+
+class PayloadStatus(Enum):
+    """Collapsed PayloadStatusV1 statuses (optimistic.md "Helpers")."""
+    VALID = "VALID"
+    NOT_VALIDATED = "NOT_VALIDATED"   # SYNCING | ACCEPTED
+    INVALIDATED = "INVALIDATED"       # INVALID | INVALID_BLOCK_HASH
+
+
+@dataclass
+class OptimisticStore:
+    optimistic_roots: Set[bytes] = field(default_factory=set)
+    head_block_root: bytes = b"\x00" * 32
+    blocks: Dict[bytes, object] = field(default_factory=dict)
+    block_states: Dict[bytes, object] = field(default_factory=dict)
+    invalidated_roots: Set[bytes] = field(default_factory=set)
+
+
+class OptimisticSync:
+    """Mixin providing the optimistic-sync mechanics."""
+
+    SAFE_SLOTS_TO_IMPORT_OPTIMISTICALLY = 128
+
+    OptimisticStore = OptimisticStore
+    PayloadStatus = PayloadStatus
+
+    # ------------------------------------------------------------------
+    # helpers (optimistic.md "Helpers")
+    # ------------------------------------------------------------------
+    def get_optimistic_store(self, anchor_state, anchor_block) -> OptimisticStore:
+        anchor_root = hash_tree_root(anchor_block)
+        return OptimisticStore(
+            optimistic_roots=set(),
+            head_block_root=anchor_root,
+            blocks={anchor_root: anchor_block.copy()},
+            block_states={anchor_root: anchor_state.copy()},
+        )
+
+    def is_optimistic(self, opt_store: OptimisticStore, block) -> bool:
+        return bytes(hash_tree_root(block)) in opt_store.optimistic_roots
+
+    def latest_verified_ancestor(self, opt_store: OptimisticStore, block):
+        # caller guarantees `block` is never INVALIDATED
+        while True:
+            if (not self.is_optimistic(opt_store, block)
+                    or block.parent_root == Bytes32()):
+                return block
+            block = opt_store.blocks[bytes(block.parent_root)]
+
+    def is_execution_block(self, block) -> bool:
+        return block.body.execution_payload != self.ExecutionPayload()
+
+    def is_optimistic_candidate_block(self, opt_store: OptimisticStore,
+                                      current_slot, block) -> bool:
+        if self.is_execution_block(opt_store.blocks[bytes(block.parent_root)]):
+            return True
+        if block.slot + self.SAFE_SLOTS_TO_IMPORT_OPTIMISTICALLY \
+                <= current_slot:
+            return True
+        return False
+
+    # ------------------------------------------------------------------
+    # import path (optimistic.md "How to optimistically import blocks")
+    # ------------------------------------------------------------------
+    def optimistically_import_block(self, opt_store: OptimisticStore,
+                                    current_slot, signed_block,
+                                    payload_status: PayloadStatus,
+                                    post_state=None) -> None:
+        """Import one block given the engine's payload status.
+
+        INVALIDATED responses are rejected outright; NOT_VALIDATED imports
+        record the root as optimistic; a VALID import is final immediately
+        and (per optimistic.md) also validates every NOT_VALIDATED ancestor.
+        """
+        block = signed_block.message
+        if payload_status is PayloadStatus.INVALIDATED:
+            raise AssertionError("INVALIDATED payload must not be imported")
+        parent_root = bytes(block.parent_root)
+        assert parent_root not in opt_store.invalidated_roots, \
+            "parent has an INVALIDATED payload"
+        if payload_status is PayloadStatus.NOT_VALIDATED:
+            assert self.is_optimistic_candidate_block(
+                opt_store, current_slot, block)
+        block_root = bytes(hash_tree_root(block))
+        opt_store.blocks[block_root] = block.copy()
+        if post_state is not None:
+            opt_store.block_states[block_root] = post_state.copy()
+        if payload_status is PayloadStatus.NOT_VALIDATED:
+            opt_store.optimistic_roots.add(block_root)
+        else:  # VALID: ancestors transition NOT_VALIDATED -> VALID too
+            self.validate_optimistic_block(opt_store, block_root)
+
+    # ------------------------------------------------------------------
+    # retrospective transitions
+    # ------------------------------------------------------------------
+    def _descendants(self, opt_store: OptimisticStore, root: bytes):
+        out = []
+        frontier = [root]
+        while frontier:
+            parent = frontier.pop()
+            for r, b in opt_store.blocks.items():
+                if bytes(b.parent_root) == parent:
+                    out.append(r)
+                    frontier.append(r)
+        return out
+
+    def validate_optimistic_block(self, opt_store: OptimisticStore,
+                                  block_root: bytes) -> None:
+        """NOT_VALIDATED -> VALID: the block and all its ancestors leave
+        the optimistic set."""
+        block_root = bytes(block_root)
+        assert block_root not in opt_store.invalidated_roots
+        block = opt_store.blocks[block_root]
+        while True:
+            opt_store.optimistic_roots.discard(
+                bytes(hash_tree_root(block)))
+            parent = bytes(block.parent_root)
+            if parent not in opt_store.blocks:
+                return
+            block = opt_store.blocks[parent]
+
+    def invalidate_optimistic_block(self, opt_store: OptimisticStore,
+                                    block_root: bytes) -> None:
+        """NOT_VALIDATED -> INVALIDATED: the block and all its descendants
+        are invalidated and removed from the optimistic set.
+
+        A VALID -> INVALIDATED transition is impossible per optimistic.md
+        ("Transitioning from VALID -> INVALIDATED"): seeing one means the
+        execution engine contradicted itself, which is surfaced as a hard
+        error rather than applied silently.
+        """
+        block_root = bytes(block_root)
+        for root in [block_root] + self._descendants(opt_store, block_root):
+            if (root not in opt_store.optimistic_roots
+                    and root not in opt_store.invalidated_roots):
+                raise RuntimeError(
+                    "execution engine inconsistency: VALID block "
+                    f"{root.hex()} reported INVALIDATED")
+            opt_store.optimistic_roots.discard(root)
+            opt_store.invalidated_roots.add(root)
+
+    def process_invalid_payload_response(self, opt_store: OptimisticStore,
+                                         block_root: bytes,
+                                         latest_valid_hash) -> None:
+        """Apply latestValidHash semantics (optimistic.md table):
+
+        - meaningful hash -> invalidate the *child* of the block whose
+          payload has that hash, in the chain containing `block_root`
+        - all-zero hash   -> invalidate from the first execution block
+        - None            -> invalidate `block_root` itself
+        Unknown meaningful hashes degrade to the None behaviour.
+        """
+        block_root = bytes(block_root)
+        chain = [block_root]  # ancestors from block_root to anchor
+        b = opt_store.blocks[block_root]
+        while bytes(b.parent_root) in opt_store.blocks:
+            chain.append(bytes(b.parent_root))
+            b = opt_store.blocks[bytes(b.parent_root)]
+
+        invalid_root = block_root
+        if latest_valid_hash is None:
+            pass
+        elif bytes(latest_valid_hash) == bytes(Bytes32()):
+            # first execution block in the chain (searched root-ward)
+            for root in reversed(chain):
+                if self.is_execution_block(opt_store.blocks[root]):
+                    invalid_root = root
+                    break
+        else:
+            # child of the block carrying latestValidHash
+            for child, parent in zip(chain[:-1], chain[1:]):
+                payload = opt_store.blocks[parent].body.execution_payload
+                if bytes(payload.block_hash) == bytes(latest_valid_hash):
+                    invalid_root = child
+                    break
+        self.invalidate_optimistic_block(opt_store, invalid_root)
+
+    # ------------------------------------------------------------------
+    # fork-choice interaction
+    # ------------------------------------------------------------------
+    def get_optimistic_head(self, opt_store: OptimisticStore, store):
+        """Fork choice with INVALIDATED blocks removed (optimistic.md "Fork
+        Choice"): invalidated blocks are pruned from the block tree and the
+        votes cast for them carry no weight, so the heaviest *valid* branch
+        wins — not merely the nearest valid ancestor of the poisoned head.
+        """
+        invalid = opt_store.invalidated_roots
+        if not invalid:
+            head = self.get_head(store)
+        else:
+            from dataclasses import replace
+            pruned = replace(
+                store,
+                blocks={r: b for r, b in store.blocks.items()
+                        if bytes(r) not in invalid},
+                block_states={r: s for r, s in store.block_states.items()
+                              if bytes(r) not in invalid},
+                latest_messages={
+                    i: m for i, m in store.latest_messages.items()
+                    if bytes(m.root) not in invalid},
+                proposer_boost_root=(
+                    Bytes32() if bytes(store.proposer_boost_root) in invalid
+                    else store.proposer_boost_root),
+            )
+            head = self.get_head(pruned)
+        opt_store.head_block_root = bytes(head)
+        return head
+
+    def is_optimistic_node(self, opt_store: OptimisticStore, head) -> bool:
+        return self.is_optimistic(opt_store, opt_store.blocks[bytes(head)]) \
+            if bytes(head) in opt_store.blocks else False

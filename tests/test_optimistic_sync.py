@@ -1,0 +1,278 @@
+"""Optimistic sync: candidate rules, retrospective VALID/INVALIDATED
+transitions, latestValidHash semantics, optimistic head filtering.
+
+Capability counterpart of the reference's
+tests/core/pyspec/eth2spec/test/bellatrix/sync/test_optimistic.py and
+test/helpers/optimistic_sync.py.
+"""
+import pytest
+
+from consensus_specs_tpu.specs import get_spec
+from consensus_specs_tpu.specs.optimistic_sync import PayloadStatus
+from consensus_specs_tpu.ssz import Bytes32, hash_tree_root
+from consensus_specs_tpu.test_infra import disable_bls
+from consensus_specs_tpu.test_infra.genesis import (
+    create_genesis_state, default_balances)
+from consensus_specs_tpu.test_infra.blocks import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block)
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_spec("bellatrix", "minimal")
+
+
+def build_chain(spec, n):
+    """Genesis state + n signed blocks on one chain."""
+    with disable_bls():
+        state = create_genesis_state(spec, default_balances(spec))
+        genesis_block = spec.BeaconBlock(state_root=hash_tree_root(state))
+        signed = []
+        for _ in range(n):
+            block = build_empty_block_for_next_slot(spec, state)
+            signed.append(state_transition_and_sign_block(spec, state, block))
+    return state, genesis_block, signed
+
+
+def make_opt_store(spec, anchor_state, anchor_block):
+    return spec.get_optimistic_store(anchor_state, anchor_block)
+
+
+def test_optimistic_import_and_validate_chain(spec):
+    state, genesis_block, signed = build_chain(spec, 3)
+    # anchor: pre-chain genesis
+    with disable_bls():
+        anchor_state = create_genesis_state(spec, default_balances(spec))
+    opt_store = make_opt_store(spec, anchor_state, genesis_block)
+
+    current_slot = signed[-1].message.slot \
+        + spec.SAFE_SLOTS_TO_IMPORT_OPTIMISTICALLY
+    for sb in signed:
+        spec.optimistically_import_block(
+            opt_store, current_slot, sb, PayloadStatus.NOT_VALIDATED)
+
+    roots = [bytes(hash_tree_root(sb.message)) for sb in signed]
+    assert all(r in opt_store.optimistic_roots for r in roots)
+    assert spec.is_optimistic(opt_store, signed[-1].message)
+
+    # latest verified ancestor of the tip is the anchor
+    anc = spec.latest_verified_ancestor(opt_store, signed[-1].message)
+    assert hash_tree_root(anc) == hash_tree_root(genesis_block)
+
+    # NOT_VALIDATED -> VALID on the tip validates all ancestors
+    spec.validate_optimistic_block(opt_store, roots[-1])
+    assert not opt_store.optimistic_roots
+    assert not spec.is_optimistic(opt_store, signed[0].message)
+
+
+def test_optimistic_invalidate_descendants(spec):
+    state, genesis_block, signed = build_chain(spec, 3)
+    with disable_bls():
+        anchor_state = create_genesis_state(spec, default_balances(spec))
+    opt_store = make_opt_store(spec, anchor_state, genesis_block)
+    current_slot = signed[-1].message.slot \
+        + spec.SAFE_SLOTS_TO_IMPORT_OPTIMISTICALLY
+    for sb in signed:
+        spec.optimistically_import_block(
+            opt_store, current_slot, sb, PayloadStatus.NOT_VALIDATED)
+    roots = [bytes(hash_tree_root(sb.message)) for sb in signed]
+
+    # invalidating the middle block kills it and its descendant
+    spec.invalidate_optimistic_block(opt_store, roots[1])
+    assert roots[0] in opt_store.optimistic_roots
+    assert roots[1] in opt_store.invalidated_roots
+    assert roots[2] in opt_store.invalidated_roots
+
+    # importing a child of an INVALIDATED parent is rejected
+    with pytest.raises(AssertionError):
+        spec.optimistically_import_block(
+            opt_store, current_slot, signed[2], PayloadStatus.NOT_VALIDATED)
+
+
+def test_invalidated_payload_status_rejected(spec):
+    state, genesis_block, signed = build_chain(spec, 1)
+    with disable_bls():
+        anchor_state = create_genesis_state(spec, default_balances(spec))
+    opt_store = make_opt_store(spec, anchor_state, genesis_block)
+    with pytest.raises(AssertionError):
+        spec.optimistically_import_block(
+            opt_store, signed[0].message.slot + 1, signed[0],
+            PayloadStatus.INVALIDATED)
+
+
+def test_candidate_rule_execution_parent_or_safe_slots(spec):
+    state, genesis_block, signed = build_chain(spec, 2)
+    with disable_bls():
+        anchor_state = create_genesis_state(spec, default_balances(spec))
+    opt_store = make_opt_store(spec, anchor_state, genesis_block)
+
+    first = signed[0].message
+    # bellatrix genesis in our fixtures is post-merge: the genesis block has
+    # an empty payload, so the candidate rule falls to the slot distance
+    assert not spec.is_execution_block(genesis_block)
+    assert not spec.is_optimistic_candidate_block(
+        opt_store, first.slot + 1, first)
+    assert spec.is_optimistic_candidate_block(
+        opt_store, first.slot + spec.SAFE_SLOTS_TO_IMPORT_OPTIMISTICALLY,
+        first)
+
+    # once the parent is an execution block, always a candidate
+    spec.optimistically_import_block(
+        opt_store, first.slot + spec.SAFE_SLOTS_TO_IMPORT_OPTIMISTICALLY,
+        signed[0], PayloadStatus.VALID)
+    second = signed[1].message
+    assert spec.is_execution_block(first)
+    assert spec.is_optimistic_candidate_block(
+        opt_store, second.slot + 1, second)
+
+
+def test_latest_valid_hash_child_invalidation(spec):
+    state, genesis_block, signed = build_chain(spec, 3)
+    with disable_bls():
+        anchor_state = create_genesis_state(spec, default_balances(spec))
+    opt_store = make_opt_store(spec, anchor_state, genesis_block)
+    current_slot = signed[-1].message.slot \
+        + spec.SAFE_SLOTS_TO_IMPORT_OPTIMISTICALLY
+    for sb in signed:
+        spec.optimistically_import_block(
+            opt_store, current_slot, sb, PayloadStatus.NOT_VALIDATED)
+    roots = [bytes(hash_tree_root(sb.message)) for sb in signed]
+
+    # latestValidHash = payload hash of block 0 -> invalidate from block 1
+    lvh = signed[0].message.body.execution_payload.block_hash
+    spec.process_invalid_payload_response(opt_store, roots[2], lvh)
+    assert roots[0] in opt_store.optimistic_roots
+    assert roots[1] in opt_store.invalidated_roots
+    assert roots[2] in opt_store.invalidated_roots
+
+
+def test_latest_valid_hash_none_invalidates_self_only(spec):
+    state, genesis_block, signed = build_chain(spec, 2)
+    with disable_bls():
+        anchor_state = create_genesis_state(spec, default_balances(spec))
+    opt_store = make_opt_store(spec, anchor_state, genesis_block)
+    current_slot = signed[-1].message.slot \
+        + spec.SAFE_SLOTS_TO_IMPORT_OPTIMISTICALLY
+    for sb in signed:
+        spec.optimistically_import_block(
+            opt_store, current_slot, sb, PayloadStatus.NOT_VALIDATED)
+    roots = [bytes(hash_tree_root(sb.message)) for sb in signed]
+
+    spec.process_invalid_payload_response(opt_store, roots[1], None)
+    assert roots[0] in opt_store.optimistic_roots
+    assert roots[1] in opt_store.invalidated_roots
+
+
+def test_latest_valid_hash_zero_invalidates_from_first_execution_block(spec):
+    state, genesis_block, signed = build_chain(spec, 3)
+    with disable_bls():
+        anchor_state = create_genesis_state(spec, default_balances(spec))
+    opt_store = make_opt_store(spec, anchor_state, genesis_block)
+    current_slot = signed[-1].message.slot \
+        + spec.SAFE_SLOTS_TO_IMPORT_OPTIMISTICALLY
+    for sb in signed:
+        spec.optimistically_import_block(
+            opt_store, current_slot, sb, PayloadStatus.NOT_VALIDATED)
+    roots = [bytes(hash_tree_root(sb.message)) for sb in signed]
+
+    zero = b"\x00" * 32
+    spec.process_invalid_payload_response(opt_store, roots[2], zero)
+    # every imported block carries a payload, so the whole chain goes
+    assert all(r in opt_store.invalidated_roots for r in roots)
+
+
+def test_valid_import_validates_ancestors(spec):
+    state, genesis_block, signed = build_chain(spec, 2)
+    with disable_bls():
+        anchor_state = create_genesis_state(spec, default_balances(spec))
+    opt_store = make_opt_store(spec, anchor_state, genesis_block)
+    current_slot = signed[-1].message.slot \
+        + spec.SAFE_SLOTS_TO_IMPORT_OPTIMISTICALLY
+    spec.optimistically_import_block(
+        opt_store, current_slot, signed[0], PayloadStatus.NOT_VALIDATED)
+    # engine fully validates the child: the NOT_VALIDATED parent goes VALID
+    spec.optimistically_import_block(
+        opt_store, current_slot, signed[1], PayloadStatus.VALID)
+    assert not opt_store.optimistic_roots
+    assert not spec.is_optimistic(opt_store, signed[0].message)
+
+
+def test_invalidating_valid_block_is_critical_error(spec):
+    state, genesis_block, signed = build_chain(spec, 1)
+    with disable_bls():
+        anchor_state = create_genesis_state(spec, default_balances(spec))
+    opt_store = make_opt_store(spec, anchor_state, genesis_block)
+    current_slot = signed[0].message.slot \
+        + spec.SAFE_SLOTS_TO_IMPORT_OPTIMISTICALLY
+    spec.optimistically_import_block(
+        opt_store, current_slot, signed[0], PayloadStatus.VALID)
+    root = bytes(hash_tree_root(signed[0].message))
+    with pytest.raises(RuntimeError):
+        spec.invalidate_optimistic_block(opt_store, root)
+
+
+def test_optimistic_head_reorgs_to_valid_branch(spec):
+    """Invalidating a whole branch must move the head to the competing valid
+    branch, not merely to the invalid head's nearest valid ancestor."""
+    with disable_bls():
+        state = create_genesis_state(spec, default_balances(spec))
+        anchor_block = spec.BeaconBlock(state_root=hash_tree_root(state))
+        store = spec.get_forkchoice_store(state, anchor_block)
+        opt_store = spec.get_optimistic_store(state, anchor_block)
+
+        # branch A: two blocks; branch B: one sibling block at slot 1
+        state_a = state.copy()
+        sb_a = []
+        for i in range(2):
+            block = build_empty_block_for_next_slot(spec, state_a)
+            block.body.graffiti = Bytes32(b"A" * 32)
+            sb_a.append(state_transition_and_sign_block(spec, state_a, block))
+        state_b = state.copy()
+        block_b = build_empty_block_for_next_slot(spec, state_b)
+        block_b.body.graffiti = Bytes32(b"B" * 32)
+        sb_b = state_transition_and_sign_block(spec, state_b, block_b)
+
+        spec.on_tick(store, store.genesis_time
+                     + 2 * spec.config.SECONDS_PER_SLOT)
+        for sb in sb_a + [sb_b]:
+            spec.on_block(store, sb)
+            spec.optimistically_import_block(
+                opt_store,
+                sb.message.slot + spec.SAFE_SLOTS_TO_IMPORT_OPTIMISTICALLY,
+                sb, PayloadStatus.NOT_VALIDATED)
+
+    # invalidate branch A from its first block: head must land on branch B
+    spec.invalidate_optimistic_block(
+        opt_store, bytes(hash_tree_root(sb_a[0].message)))
+    head = spec.get_optimistic_head(opt_store, store)
+    assert bytes(head) == bytes(hash_tree_root(sb_b.message))
+    assert opt_store.head_block_root == bytes(head)
+
+
+def test_optimistic_head_skips_invalidated(spec):
+    with disable_bls():
+        state = create_genesis_state(spec, default_balances(spec))
+        anchor_block = spec.BeaconBlock(state_root=hash_tree_root(state))
+        store = spec.get_forkchoice_store(state, anchor_block)
+        opt_store = spec.get_optimistic_store(state, anchor_block)
+
+        fc_state = state.copy()
+        blocks = []
+        for _ in range(2):
+            block = build_empty_block_for_next_slot(spec, fc_state)
+            sb = state_transition_and_sign_block(spec, fc_state, block)
+            spec.on_tick(store, store.genesis_time
+                         + int(sb.message.slot) * spec.config.SECONDS_PER_SLOT)
+            spec.on_block(store, sb)
+            blocks.append(sb)
+            spec.optimistically_import_block(
+                opt_store,
+                sb.message.slot + spec.SAFE_SLOTS_TO_IMPORT_OPTIMISTICALLY,
+                sb, PayloadStatus.NOT_VALIDATED)
+
+    tip_root = bytes(hash_tree_root(blocks[-1].message))
+    assert spec.get_head(store) == tip_root
+    # invalidate the tip: optimistic head falls back to its parent
+    spec.invalidate_optimistic_block(opt_store, tip_root)
+    assert bytes(spec.get_optimistic_head(opt_store, store)) == \
+        bytes(hash_tree_root(blocks[0].message))
