@@ -1,0 +1,8 @@
+"""Debug/inspection tools: random SSZ objects and SSZ<->jsonable codecs.
+
+Capability counterpart of the reference's
+/root/reference/tests/core/pyspec/eth2spec/debug/{random_value,encode,decode}.py.
+"""
+from .random_value import RandomizationMode, get_random_ssz_object  # noqa: F401
+from .encode import encode  # noqa: F401
+from .decode import decode  # noqa: F401

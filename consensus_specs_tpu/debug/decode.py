@@ -1,0 +1,37 @@
+"""Jsonable structure -> SSZ view (inverse of encode.py)."""
+from __future__ import annotations
+
+from ..ssz.types import (
+    uint, boolean, Bitvector, Bitlist, ByteVector, ByteList,
+    Vector, List, Container, Union,
+)
+
+
+def decode(data, typ):
+    if issubclass(typ, boolean):
+        return typ(data)
+    if issubclass(typ, uint):
+        return typ(int(data))
+    if issubclass(typ, (ByteVector, ByteList)):
+        if isinstance(data, str):
+            return typ(bytes.fromhex(data[2:] if data.startswith("0x")
+                                     else data))
+        return typ(bytes(data))
+    if issubclass(typ, (Bitvector, Bitlist)):
+        if isinstance(data, str):
+            raw = bytes.fromhex(data[2:] if data.startswith("0x") else data)
+        else:
+            raw = bytes(data)
+        return typ.deserialize(raw)
+    if issubclass(typ, (Vector, List)):
+        return typ([decode(elem, typ.ELEM_TYPE) for elem in data])
+    if issubclass(typ, Union):
+        sel = int(data["selector"])
+        opt = typ.OPTIONS[sel]
+        if opt is None:
+            return typ(sel, None)
+        return typ(sel, decode(data["value"], opt))
+    if issubclass(typ, Container):
+        return typ(**{name: decode(data[name], ftyp)
+                      for name, ftyp in typ.fields().items()})
+    raise TypeError(f"cannot decode into {typ!r}")

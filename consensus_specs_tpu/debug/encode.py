@@ -1,0 +1,35 @@
+"""SSZ view -> jsonable (yaml-dumpable) structure.
+
+Same on-disk conventions as the reference's debug/encode.py so generated
+vectors stay interchangeable: uints wider than 64 bits and uint64 values
+become decimal strings (yaml can't hold full uint64 precision), byte
+strings become 0x-hex, bit types dump their serialized byte form.
+"""
+from __future__ import annotations
+
+from ..ssz.types import (
+    uint, boolean, Bitvector, Bitlist, ByteVector, ByteList,
+    Vector, List, Container, Union,
+)
+
+
+def encode(value):
+    if isinstance(value, boolean):
+        return bool(value)
+    if isinstance(value, uint):
+        if value.type_byte_length() > 8 or int(value) >= 2 ** 63:
+            return str(int(value))
+        return int(value)
+    if isinstance(value, (ByteVector, ByteList)):
+        return "0x" + bytes(value).hex()
+    if isinstance(value, (Bitvector, Bitlist)):
+        return "0x" + value.serialize().hex()
+    if isinstance(value, (Vector, List)):
+        return [encode(elem) for elem in value]
+    if isinstance(value, Union):
+        return {"selector": int(value.selector),
+                "value": None if value.value is None else encode(value.value)}
+    if isinstance(value, Container):
+        return {name: encode(getattr(value, name))
+                for name in value.fields()}
+    raise TypeError(f"cannot encode {type(value)!r}")

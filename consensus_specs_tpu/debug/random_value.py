@@ -1,0 +1,133 @@
+"""Random SSZ object generation for fuzz/static tests.
+
+Same capability as the reference's debug/random_value.py (six
+RandomizationModes driving value and length choices), rebuilt over our own
+type descriptors (ssz/types.py).  Used by the ssz_static-style tests and
+the test-vector generators.
+"""
+from __future__ import annotations
+
+from enum import Enum
+from random import Random
+
+from ..ssz.types import (
+    uint, boolean, Bitvector, Bitlist, ByteVector, ByteList,
+    Vector, List, Container, Union,
+)
+
+
+class RandomizationMode(Enum):
+    RANDOM = 0          # uniformly random values, random lengths
+    ZERO = 1            # minimal/zero values
+    MAX = 2             # maximal values
+    NIL_COUNT = 3       # random values, zero-length collections
+    ONE_COUNT = 4       # random values, single-element collections
+    MAX_COUNT = 5       # random values, limit-length collections
+
+
+def _random_length(mode: RandomizationMode, rng: Random,
+                   max_len: int, limit: int) -> int:
+    cap = min(max_len, limit)
+    if mode == RandomizationMode.ZERO:
+        return 0
+    if mode == RandomizationMode.NIL_COUNT:
+        return 0
+    if mode == RandomizationMode.ONE_COUNT:
+        return min(1, cap)
+    if mode in (RandomizationMode.MAX, RandomizationMode.MAX_COUNT):
+        return cap
+    return rng.randint(0, cap)
+
+
+def get_random_ssz_object(rng: Random, typ, max_bytes_length: int = 256,
+                          max_list_length: int = 8,
+                          mode: RandomizationMode = RandomizationMode.RANDOM,
+                          chaos: bool = False):
+    """Build a random instance of `typ`.
+
+    `chaos` re-rolls the mode per element/field so one object mixes
+    zero/max/random regions (the reference's chaos flag).
+    """
+    if chaos:
+        mode = rng.choice(list(RandomizationMode))
+
+    if issubclass(typ, boolean):
+        if mode == RandomizationMode.ZERO:
+            return typ(False)
+        if mode == RandomizationMode.MAX:
+            return typ(True)
+        return typ(rng.choice((True, False)))
+
+    if issubclass(typ, uint):
+        bits = 8 * typ.type_byte_length()
+        if mode == RandomizationMode.ZERO:
+            return typ(0)
+        if mode == RandomizationMode.MAX:
+            return typ((1 << bits) - 1)
+        return typ(rng.getrandbits(bits))
+
+    if issubclass(typ, ByteVector):
+        n = typ.LENGTH
+        if mode == RandomizationMode.ZERO:
+            return typ(b"\x00" * n)
+        if mode == RandomizationMode.MAX:
+            return typ(b"\xff" * n)
+        return typ(bytes(rng.getrandbits(8) for _ in range(n)))
+
+    if issubclass(typ, ByteList):
+        n = _random_length(mode, rng, max_bytes_length, typ.LIMIT)
+        fill = (b"\x00" if mode == RandomizationMode.ZERO
+                else b"\xff" if mode == RandomizationMode.MAX else None)
+        if fill is not None:
+            return typ(fill * n)
+        return typ(bytes(rng.getrandbits(8) for _ in range(n)))
+
+    if issubclass(typ, Bitvector):
+        if mode == RandomizationMode.ZERO:
+            return typ([False] * typ.LENGTH)
+        if mode == RandomizationMode.MAX:
+            return typ([True] * typ.LENGTH)
+        return typ([rng.choice((True, False)) for _ in range(typ.LENGTH)])
+
+    if issubclass(typ, Bitlist):
+        n = _random_length(mode, rng, max_list_length, typ.LIMIT)
+        if mode == RandomizationMode.ZERO:
+            return typ([False] * n)
+        if mode == RandomizationMode.MAX:
+            return typ([True] * n)
+        return typ([rng.choice((True, False)) for _ in range(n)])
+
+    if issubclass(typ, Vector):
+        return typ([
+            get_random_ssz_object(rng, typ.ELEM_TYPE, max_bytes_length,
+                                  max_list_length, mode, chaos)
+            for _ in range(typ.LENGTH)])
+
+    if issubclass(typ, List):
+        n = _random_length(mode, rng, max_list_length, typ.LIMIT)
+        return typ([
+            get_random_ssz_object(rng, typ.ELEM_TYPE, max_bytes_length,
+                                  max_list_length, mode, chaos)
+            for _ in range(n)])
+
+    if issubclass(typ, Union):
+        options = typ.OPTIONS
+        if mode == RandomizationMode.ZERO:
+            sel = 0
+        elif mode == RandomizationMode.MAX:
+            sel = len(options) - 1
+        else:
+            sel = rng.randrange(len(options))
+        opt = options[sel]
+        if opt is None:
+            return typ(sel, None)
+        return typ(sel, get_random_ssz_object(
+            rng, opt, max_bytes_length, max_list_length, mode, chaos))
+
+    if issubclass(typ, Container):
+        return typ(**{
+            name: get_random_ssz_object(rng, ftyp, max_bytes_length,
+                                        max_list_length, mode, chaos)
+            for name, ftyp in typ.fields().items()})
+
+    raise TypeError(f"cannot generate a random {typ!r}")

@@ -113,14 +113,15 @@ class OptimisticSync:
     # retrospective transitions
     # ------------------------------------------------------------------
     def _descendants(self, opt_store: OptimisticStore, root: bytes):
+        children: Dict[bytes, list] = {}
+        for r, b in opt_store.blocks.items():
+            children.setdefault(bytes(b.parent_root), []).append(bytes(r))
         out = []
         frontier = [root]
         while frontier:
-            parent = frontier.pop()
-            for r, b in opt_store.blocks.items():
-                if bytes(b.parent_root) == parent:
-                    out.append(r)
-                    frontier.append(r)
+            kids = children.get(frontier.pop(), ())
+            out.extend(kids)
+            frontier.extend(kids)
         return out
 
     def validate_optimistic_block(self, opt_store: OptimisticStore,
@@ -180,17 +181,23 @@ class OptimisticSync:
         if latest_valid_hash is None:
             pass
         elif bytes(latest_valid_hash) == bytes(Bytes32()):
-            # first execution block in the chain (searched root-ward)
+            # earliest NOT_VALIDATED execution block in the chain (searched
+            # root-ward).  VALID ancestors — e.g. a post-merge checkpoint
+            # anchor — are certified already and cannot be invalidated.
             for root in reversed(chain):
-                if self.is_execution_block(opt_store.blocks[root]):
+                if (root in opt_store.optimistic_roots
+                        and self.is_execution_block(opt_store.blocks[root])):
                     invalid_root = root
                     break
         else:
-            # child of the block carrying latestValidHash
+            # child of the block carrying latestValidHash; the carrying
+            # block itself is thereby certified VALID along with its
+            # ancestors (engine says it is the latest *valid* payload)
             for child, parent in zip(chain[:-1], chain[1:]):
                 payload = opt_store.blocks[parent].body.execution_payload
                 if bytes(payload.block_hash) == bytes(latest_valid_hash):
                     invalid_root = child
+                    self.validate_optimistic_block(opt_store, parent)
                     break
         self.invalidate_optimistic_block(opt_store, invalid_root)
 
@@ -207,20 +214,31 @@ class OptimisticSync:
         if not invalid:
             head = self.get_head(store)
         else:
-            from dataclasses import replace
-            pruned = replace(
-                store,
-                blocks={r: b for r, b in store.blocks.items()
-                        if bytes(r) not in invalid},
-                block_states={r: s for r, s in store.block_states.items()
-                              if bytes(r) not in invalid},
-                latest_messages={
-                    i: m for i, m in store.latest_messages.items()
-                    if bytes(m.root) not in invalid},
-                proposer_boost_root=(
-                    Bytes32() if bytes(store.proposer_boost_root) in invalid
-                    else store.proposer_boost_root),
-            )
+            # rebuilt only when the store or invalidated set changed since
+            # the last call; afterwards the pruned view is reused
+            key = (len(invalid), len(store.blocks),
+                   len(store.latest_messages),
+                   bytes(store.proposer_boost_root))
+            cached = getattr(opt_store, "_pruned_cache", None)
+            if cached is not None and cached[0] == key:
+                pruned = cached[1]
+            else:
+                from dataclasses import replace
+                pruned = replace(
+                    store,
+                    blocks={r: b for r, b in store.blocks.items()
+                            if bytes(r) not in invalid},
+                    block_states={r: s for r, s in store.block_states.items()
+                                  if bytes(r) not in invalid},
+                    latest_messages={
+                        i: m for i, m in store.latest_messages.items()
+                        if bytes(m.root) not in invalid},
+                    proposer_boost_root=(
+                        Bytes32()
+                        if bytes(store.proposer_boost_root) in invalid
+                        else store.proposer_boost_root),
+                )
+                opt_store._pruned_cache = (key, pruned)
             head = self.get_head(pruned)
         opt_store.head_block_root = bytes(head)
         return head

@@ -1,0 +1,100 @@
+"""Debug tools + ssz_static-style roundtrips: random objects of every spec
+container type must survive serialize/deserialize and encode/decode with
+stable hash_tree_root.
+
+Capability counterpart of the reference's ssz_static generator
+(tests/generators/ssz_static/main.py) and debug/ modules.
+"""
+from random import Random
+
+import pytest
+
+from consensus_specs_tpu.debug import (
+    RandomizationMode, get_random_ssz_object, encode, decode)
+from consensus_specs_tpu.specs import get_spec
+from consensus_specs_tpu.ssz import (
+    hash_tree_root, uint64, uint256, Bytes32, Bitlist, List, Vector,
+    Container, Union, boolean, uint8)
+
+
+def spec_container_types(spec):
+    """All Container subclasses hung on a spec instance."""
+    out = {}
+    for name in dir(spec):
+        t = getattr(spec, name, None)
+        if isinstance(t, type) and issubclass(t, Container) \
+                and t._field_names:
+            out[name] = t
+    return out
+
+
+@pytest.mark.parametrize("fork", ["phase0", "altair", "bellatrix", "capella",
+                                  "deneb", "electra", "fulu"])
+@pytest.mark.parametrize("mode", [RandomizationMode.RANDOM,
+                                  RandomizationMode.ZERO,
+                                  RandomizationMode.MAX,
+                                  RandomizationMode.ONE_COUNT])
+def test_ssz_static_roundtrip(fork, mode):
+    spec = get_spec(fork, "minimal")
+    rng = Random(5566)
+    for name, typ in sorted(spec_container_types(spec).items()):
+        obj = get_random_ssz_object(rng, typ, max_bytes_length=64,
+                                    max_list_length=3, mode=mode)
+        data = obj.serialize()
+        back = typ.deserialize(data)
+        assert back.serialize() == data, name
+        assert hash_tree_root(back) == hash_tree_root(obj), name
+        # jsonable roundtrip
+        enc = encode(obj)
+        dec = decode(enc, typ)
+        assert hash_tree_root(dec) == hash_tree_root(obj), name
+
+
+def test_random_modes_shape_lengths():
+    rng = Random(1)
+    T = List[uint64, 16]
+    assert len(get_random_ssz_object(rng, T,
+                                     mode=RandomizationMode.NIL_COUNT)) == 0
+    assert len(get_random_ssz_object(rng, T,
+                                     mode=RandomizationMode.ONE_COUNT)) == 1
+    assert len(get_random_ssz_object(
+        rng, T, max_list_length=16,
+        mode=RandomizationMode.MAX_COUNT)) == 16
+
+
+def test_encode_uint_width_conventions():
+    assert encode(uint8(3)) == 3
+    assert encode(uint64(5)) == 5
+    # uint64 values ≥ 2^63 and wide uints go to decimal strings
+    assert encode(uint64(2 ** 64 - 1)) == str(2 ** 64 - 1)
+    assert encode(uint256(10)) == "10"
+
+
+def test_union_and_bitlist_roundtrip():
+    U = Union[None, uint64, Bytes32]
+    rng = Random(7)
+    for mode in RandomizationMode:
+        obj = get_random_ssz_object(rng, U, mode=mode)
+        assert hash_tree_root(decode(encode(obj), U)) == hash_tree_root(obj)
+    B = Bitlist[17]
+    for mode in RandomizationMode:
+        obj = get_random_ssz_object(rng, B, max_list_length=17, mode=mode)
+        assert hash_tree_root(decode(encode(obj), B)) == hash_tree_root(obj)
+
+
+def test_chaos_mode_generates():
+    rng = Random(9)
+
+    class Inner(Container):
+        a: uint64
+        flag: boolean
+
+    class Outer(Container):
+        xs: List[uint64, 8]
+        inner: Inner
+        v: Vector[uint8, 4]
+
+    for _ in range(20):
+        obj = get_random_ssz_object(rng, Outer, chaos=True)
+        assert hash_tree_root(Outer.deserialize(obj.serialize())) \
+            == hash_tree_root(obj)

@@ -138,10 +138,12 @@ def test_latest_valid_hash_child_invalidation(spec):
             opt_store, current_slot, sb, PayloadStatus.NOT_VALIDATED)
     roots = [bytes(hash_tree_root(sb.message)) for sb in signed]
 
-    # latestValidHash = payload hash of block 0 -> invalidate from block 1
+    # latestValidHash = payload hash of block 0 -> invalidate from block 1;
+    # block 0 itself is certified VALID by the same response
     lvh = signed[0].message.body.execution_payload.block_hash
     spec.process_invalid_payload_response(opt_store, roots[2], lvh)
-    assert roots[0] in opt_store.optimistic_roots
+    assert roots[0] not in opt_store.optimistic_roots
+    assert roots[0] not in opt_store.invalidated_roots
     assert roots[1] in opt_store.invalidated_roots
     assert roots[2] in opt_store.invalidated_roots
 
@@ -209,6 +211,51 @@ def test_invalidating_valid_block_is_critical_error(spec):
     root = bytes(hash_tree_root(signed[0].message))
     with pytest.raises(RuntimeError):
         spec.invalidate_optimistic_block(opt_store, root)
+
+
+def test_latest_valid_hash_zero_with_post_merge_anchor(spec):
+    """A VALID post-merge anchor must survive a 0x00..00 latestValidHash:
+    invalidation starts at the earliest NOT_VALIDATED execution block."""
+    state, genesis_block, signed = build_chain(spec, 2)
+    with disable_bls():
+        anchor_state = create_genesis_state(spec, default_balances(spec))
+    opt_store = make_opt_store(spec, anchor_state, genesis_block)
+    current_slot = signed[-1].message.slot \
+        + spec.SAFE_SLOTS_TO_IMPORT_OPTIMISTICALLY
+    # first block imported VALID (anchor-like certified execution block)
+    spec.optimistically_import_block(
+        opt_store, current_slot, signed[0], PayloadStatus.VALID)
+    spec.optimistically_import_block(
+        opt_store, current_slot, signed[1], PayloadStatus.NOT_VALIDATED)
+    roots = [bytes(hash_tree_root(sb.message)) for sb in signed]
+
+    spec.process_invalid_payload_response(opt_store, roots[1], b"\x00" * 32)
+    assert roots[0] not in opt_store.invalidated_roots
+    assert roots[1] in opt_store.invalidated_roots
+
+
+def test_latest_valid_hash_certifies_carrying_block(spec):
+    """A meaningful latestValidHash certifies the carrying block VALID (and
+    its ancestors) while invalidating the child chain."""
+    state, genesis_block, signed = build_chain(spec, 3)
+    with disable_bls():
+        anchor_state = create_genesis_state(spec, default_balances(spec))
+    opt_store = make_opt_store(spec, anchor_state, genesis_block)
+    current_slot = signed[-1].message.slot \
+        + spec.SAFE_SLOTS_TO_IMPORT_OPTIMISTICALLY
+    for sb in signed:
+        spec.optimistically_import_block(
+            opt_store, current_slot, sb, PayloadStatus.NOT_VALIDATED)
+    roots = [bytes(hash_tree_root(sb.message)) for sb in signed]
+
+    lvh = signed[1].message.body.execution_payload.block_hash
+    spec.process_invalid_payload_response(opt_store, roots[2], lvh)
+    # blocks 0 and 1 are now VALID (left the optimistic set, not invalid)
+    assert roots[0] not in opt_store.optimistic_roots
+    assert roots[1] not in opt_store.optimistic_roots
+    assert roots[0] not in opt_store.invalidated_roots
+    assert roots[1] not in opt_store.invalidated_roots
+    assert roots[2] in opt_store.invalidated_roots
 
 
 def test_optimistic_head_reorgs_to_valid_branch(spec):
