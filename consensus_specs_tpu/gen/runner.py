@@ -1,0 +1,174 @@
+"""Test-vector generator runner.
+
+Counterpart of the reference's gen_helpers/gen_base/gen_runner.py: writes
+each case to <output>/<preset>/<fork>/<runner>/<handler>/<suite>/<case>/
+as meta.yaml + *.yaml + *.ssz_snappy, with the same reliability contract:
+
+- an INCOMPLETE tag file marks in-progress case dirs; crashes leave it
+  behind for `detect_incomplete` to find
+- re-runs skip completed case dirs (resumable generation) unless --force
+- failures append tracebacks to testgen_error_log.txt and don't abort the
+  whole run
+- per-runner diagnostics.json with case counts and slow-case durations
+
+Host-level fan-out (the reference's pathos pool / `make -j gen_all`) is
+round-robin case sharding: run N processes with `--shard i/N` each
+(scripts/gen_vectors.py); resume semantics make the union safe.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import time
+import traceback
+
+import yaml
+
+from . import snappy
+from .typing import TestCase, TestProvider
+from .vector_test import run_yields
+
+INCOMPLETE_TAG = "INCOMPLETE"
+SLOW_CASE_SECONDS = 1.0
+
+
+# ---------------------------------------------------------------------------
+# yaml conventions: hex-bytes as 0x strings, big ints as decimal strings
+# ---------------------------------------------------------------------------
+
+class _VectorDumper(yaml.SafeDumper):
+    pass
+
+
+_VectorDumper.add_representer(
+    bytes, lambda d, v: d.represent_scalar(
+        "tag:yaml.org,2002:str", "0x" + v.hex()))
+
+
+def _dump_yaml(obj, path: str) -> None:
+    with open(path, "w") as f:
+        yaml.dump(obj, f, Dumper=_VectorDumper, default_flow_style=None,
+                  sort_keys=False)
+
+
+# ---------------------------------------------------------------------------
+# per-case execution
+# ---------------------------------------------------------------------------
+
+def _write_case(case: TestCase, case_dir: str) -> dict:
+    """Run one case fn and write its artifacts. Returns diagnostics."""
+    os.makedirs(case_dir, exist_ok=True)
+    tag_path = os.path.join(case_dir, INCOMPLETE_TAG)
+    with open(tag_path, "w"):
+        pass
+
+    t0 = time.perf_counter()
+    parts = run_yields(case.case_fn)
+    meta = {}
+    written = 0
+    for name, kind, value in parts:
+        if kind == "none":
+            continue  # expected-invalid marker: simply absent on disk
+        if kind == "meta":
+            meta[name] = value
+        elif kind in ("cfg", "data"):
+            _dump_yaml(value, os.path.join(case_dir, f"{name}.yaml"))
+            written += 1
+        elif kind == "ssz":
+            with open(os.path.join(case_dir, f"{name}.ssz_snappy"),
+                      "wb") as f:
+                f.write(snappy.compress(value))
+            written += 1
+        else:
+            raise ValueError(f"unknown artifact kind {kind!r}")
+    if meta:
+        _dump_yaml(meta, os.path.join(case_dir, "meta.yaml"))
+        written += 1
+    elapsed = time.perf_counter() - t0
+
+    os.remove(tag_path)
+    return {"files": written, "seconds": elapsed}
+
+
+def _case_done(case_dir: str) -> bool:
+    return (os.path.isdir(case_dir)
+            and os.listdir(case_dir)
+            and not os.path.exists(os.path.join(case_dir, INCOMPLETE_TAG)))
+
+
+# ---------------------------------------------------------------------------
+# runner entry
+# ---------------------------------------------------------------------------
+
+def run_generator(runner_name: str, providers, args=None) -> dict:
+    """Generate all cases from `providers` under an output directory.
+
+    Returns the diagnostics dict (also written to diagnostics.json).
+    """
+    parser = argparse.ArgumentParser(prog=f"gen-{runner_name}")
+    parser.add_argument("-o", "--output-dir", required=True)
+    parser.add_argument("-f", "--force", action="store_true",
+                        help="regenerate existing (complete) case dirs")
+    parser.add_argument("--preset-list", nargs="*", default=None)
+    parser.add_argument("--fork-list", nargs="*", default=None)
+    parser.add_argument("--modcheck", action="store_true",
+                        help="only check providers are importable, no output")
+    ns = parser.parse_args(args)
+
+    if ns.modcheck:
+        for provider in providers:
+            provider.prepare()
+        return {"modcheck": "ok"}
+
+    diagnostics = {
+        "generated": 0, "skipped": 0, "failed": 0,
+        "durations": {}, "slow": [],
+    }
+    error_log = os.path.join(ns.output_dir, "testgen_error_log.txt")
+    os.makedirs(ns.output_dir, exist_ok=True)
+
+    for provider in providers:
+        provider.prepare()
+        for case in provider.make_cases():
+            if ns.preset_list and case.preset_name not in ns.preset_list:
+                continue
+            if ns.fork_list and case.fork_name not in ns.fork_list:
+                continue
+            case_dir = os.path.join(ns.output_dir, case.dir_path())
+            if _case_done(case_dir) and not ns.force:
+                diagnostics["skipped"] += 1
+                continue
+            if os.path.isdir(case_dir):
+                shutil.rmtree(case_dir)  # incomplete or forced: regenerate
+            try:
+                result = _write_case(case, case_dir)
+            except Exception:
+                diagnostics["failed"] += 1
+                with open(error_log, "a") as f:
+                    f.write(f"=== {case.dir_path()} ===\n")
+                    f.write(traceback.format_exc() + "\n")
+                continue
+            diagnostics["generated"] += 1
+            diagnostics["durations"][case.dir_path()] = \
+                round(result["seconds"], 4)
+            if result["seconds"] > SLOW_CASE_SECONDS:
+                diagnostics["slow"].append(case.dir_path())
+                print(f"(!) slow case {case.dir_path()}: "
+                      f"{result['seconds']:.2f}s", file=sys.stderr)
+
+    with open(os.path.join(ns.output_dir,
+                           f"diagnostics_{runner_name}.json"), "w") as f:
+        json.dump(diagnostics, f, indent=2, sort_keys=True)
+    return diagnostics
+
+
+def detect_incomplete(output_dir: str) -> list:
+    """Find case dirs left INCOMPLETE by a crashed run (make detect_errors)."""
+    out = []
+    for root, _dirs, files in os.walk(output_dir):
+        if INCOMPLETE_TAG in files:
+            out.append(os.path.relpath(root, output_dir))
+    return sorted(out)

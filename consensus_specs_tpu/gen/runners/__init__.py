@@ -1,0 +1,19 @@
+"""Test-vector runners (the reference's tests/generators/*).
+
+Each module exposes providers(), returning a list of TestProvider.  The
+RUNNERS registry drives scripts/gen_vectors.py.
+"""
+from importlib import import_module
+
+RUNNER_NAMES = [
+    "shuffling", "ssz_static", "operations", "epoch_processing",
+    "sanity", "bls", "kzg",
+]
+
+
+def get_providers(runner_name: str):
+    if runner_name not in RUNNER_NAMES:
+        raise KeyError(f"unknown runner {runner_name!r}; "
+                       f"have {RUNNER_NAMES}")
+    mod = import_module(f"{__name__}.{runner_name}")
+    return mod.providers()

@@ -1,0 +1,81 @@
+"""BLS crypto-suite vectors: sign/verify/aggregate/fast_aggregate_verify.
+
+Format parity with the reference's tests/generators/bls/main.py: yaml
+cases with {input, output}.  Deterministic private keys match the test
+harness convention (small scalars).
+"""
+from ..typing import TestCase, TestProvider, hex_str as _hex
+from ...utils import bls
+
+PRIVKEYS = [1 + i for i in range(3)]
+MESSAGES = [b"\x00" * 32, b"\x56" * 32, b"\xab" * 32]
+
+
+def _yaml_case(handler, name, payload):
+    def fn():
+        yield "data", "data", payload
+    return TestCase(
+        fork_name="general", preset_name="general", runner_name="bls",
+        handler_name=handler, suite_name=handler, case_name=name,
+        case_fn=fn)
+
+
+def _sign_cases():
+    for i, sk in enumerate(PRIVKEYS):
+        for j, msg in enumerate(MESSAGES):
+            sig = bls.Sign(sk, msg)
+            yield _yaml_case("sign", f"sign_{i}_{j}", {
+                "input": {"privkey": _hex(sk.to_bytes(32, "big")),
+                          "message": _hex(msg)},
+                "output": _hex(sig)})
+
+
+def _verify_cases():
+    sk = PRIVKEYS[0]
+    pk = bls.SkToPk(sk)
+    msg = MESSAGES[0]
+    sig = bls.Sign(sk, msg)
+    yield _yaml_case("verify", "verify_valid", {
+        "input": {"pubkey": _hex(pk), "message": _hex(msg),
+                  "signature": _hex(sig)},
+        "output": True})
+    wrong = bls.Sign(PRIVKEYS[1], msg)
+    yield _yaml_case("verify", "verify_wrong_key", {
+        "input": {"pubkey": _hex(pk), "message": _hex(msg),
+                  "signature": _hex(wrong)},
+        "output": False})
+    yield _yaml_case("verify", "verify_infinity_sig", {
+        "input": {"pubkey": _hex(pk), "message": _hex(msg),
+                  "signature": _hex(b"\xc0" + b"\x00" * 95)},
+        "output": False})
+
+
+def _aggregate_cases():
+    msg = MESSAGES[1]
+    sigs = [bls.Sign(sk, msg) for sk in PRIVKEYS]
+    agg = bls.Aggregate(sigs)
+    yield _yaml_case("aggregate", "aggregate_3", {
+        "input": [_hex(s) for s in sigs], "output": _hex(agg)})
+
+
+def _fast_aggregate_verify_cases():
+    msg = MESSAGES[2]
+    pks = [bls.SkToPk(sk) for sk in PRIVKEYS]
+    agg = bls.Aggregate([bls.Sign(sk, msg) for sk in PRIVKEYS])
+    yield _yaml_case("fast_aggregate_verify", "fav_valid", {
+        "input": {"pubkeys": [_hex(p) for p in pks], "message": _hex(msg),
+                  "signature": _hex(agg)},
+        "output": True})
+    yield _yaml_case("fast_aggregate_verify", "fav_missing_key", {
+        "input": {"pubkeys": [_hex(p) for p in pks[:-1]],
+                  "message": _hex(msg), "signature": _hex(agg)},
+        "output": False})
+
+
+def providers():
+    def make_cases():
+        yield from _sign_cases()
+        yield from _verify_cases()
+        yield from _aggregate_cases()
+        yield from _fast_aggregate_verify_cases()
+    return [TestProvider(make_cases=make_cases)]

@@ -1,0 +1,82 @@
+"""KZG commitment vectors (the reference's kzg_4844 generator).
+
+Runs on a width-64 dev trusted setup (secret=1337, same convention as the
+reference's `make kzg_setups`) so pure-host generation stays fast; the
+format — yaml cases with {input, output} of hex blobs/commitments/proofs —
+matches tests/formats/kzg_4844.
+"""
+from ..typing import TestCase, TestProvider, hex_str as _hex
+from ...crypto.kzg import KZG, bls_field_to_bytes
+from ...utils.kzg_setup_gen import generate_setup
+
+WIDTH = 64
+SECRET = 1337
+
+_kzg_cache = []
+
+
+def _kzg() -> KZG:
+    if not _kzg_cache:
+        _kzg_cache.append(KZG(WIDTH, setup=generate_setup(WIDTH, SECRET)))
+    return _kzg_cache[0]
+
+
+def _blob(seed: int) -> bytes:
+    vals = [(seed * 7919 + i * 104729) % (2 ** 200) for i in range(WIDTH)]
+    return b"".join(bls_field_to_bytes(v) for v in vals)
+
+
+def _yaml_case(handler, name, payload):
+    def fn():
+        yield "data", "data", payload
+    return TestCase(
+        fork_name="deneb", preset_name="general", runner_name="kzg",
+        handler_name=handler, suite_name=f"kzg_{handler}",
+        case_name=name, case_fn=fn)
+
+
+def providers():
+    def make_cases():
+        kzg = _kzg()
+        for seed in range(2):
+            blob = _blob(seed)
+            commitment = kzg.blob_to_kzg_commitment(blob)
+            yield _yaml_case(
+                "blob_to_kzg_commitment", f"commit_{seed}",
+                {"input": {"blob": _hex(blob)}, "output": _hex(commitment)})
+
+            z = bls_field_to_bytes(4096 + seed)
+            proof, y = kzg.compute_kzg_proof(blob, z)
+            yield _yaml_case(
+                "compute_kzg_proof", f"proof_{seed}",
+                {"input": {"blob": _hex(blob), "z": _hex(z)},
+                 "output": [_hex(proof), _hex(y)]})
+            yield _yaml_case(
+                "verify_kzg_proof", f"verify_{seed}",
+                {"input": {"commitment": _hex(commitment), "z": _hex(z),
+                           "y": _hex(y), "proof": _hex(proof)},
+                 "output": True})
+
+            blob_proof = kzg.compute_blob_kzg_proof(blob, commitment)
+            yield _yaml_case(
+                "compute_blob_kzg_proof", f"blob_proof_{seed}",
+                {"input": {"blob": _hex(blob),
+                           "commitment": _hex(commitment)},
+                 "output": _hex(blob_proof)})
+            yield _yaml_case(
+                "verify_blob_kzg_proof", f"blob_verify_{seed}",
+                {"input": {"blob": _hex(blob),
+                           "commitment": _hex(commitment),
+                           "proof": _hex(blob_proof)},
+                 "output": True})
+        # one negative: proof for the wrong blob
+        blob_a, blob_b = _blob(0), _blob(1)
+        commitment_b = kzg.blob_to_kzg_commitment(blob_b)
+        proof_a = kzg.compute_blob_kzg_proof(
+            blob_a, kzg.blob_to_kzg_commitment(blob_a))
+        yield _yaml_case(
+            "verify_blob_kzg_proof", "blob_verify_wrong_blob",
+            {"input": {"blob": _hex(blob_b), "commitment": _hex(commitment_b),
+                       "proof": _hex(proof_a)},
+             "output": False})
+    return [TestProvider(make_cases=make_cases)]

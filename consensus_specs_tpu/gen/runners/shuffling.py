@@ -1,0 +1,34 @@
+"""Shuffling vectors: full swap-or-not permutations per seed.
+
+Format parity with the reference's tests/generators/shuffling/main.py:
+one `mapping.yaml` per case with seed, count and the shuffled mapping.
+"""
+from ..typing import TestCase, TestProvider
+from ...specs import get_spec
+from ...utils.hash import hash as hash_eth2
+
+
+def _case(spec, preset, seed, count):
+    def fn():
+        mapping = [int(spec.compute_shuffled_index(i, count, seed))
+                   for i in range(count)]
+        yield "mapping", "data", {
+            "seed": "0x" + seed.hex(),
+            "count": count,
+            "mapping": mapping,
+        }
+    return TestCase(
+        fork_name="phase0", preset_name=preset, runner_name="shuffling",
+        handler_name="core", suite_name="shuffle",
+        case_name=f"shuffle_0x{seed.hex()[:8]}_{count}", case_fn=fn)
+
+
+def providers():
+    def make_cases():
+        for preset in ("minimal", "mainnet"):
+            spec = get_spec("phase0", preset)
+            for seed_i in range(4):
+                seed = hash_eth2(seed_i.to_bytes(4, "little"))
+                for count in (0, 1, 2, 3, 5, 8, 16, 64):
+                    yield _case(spec, preset, seed, count)
+    return [TestProvider(make_cases=make_cases)]

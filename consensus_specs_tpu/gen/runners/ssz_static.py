@@ -1,0 +1,54 @@
+"""ssz_static vectors: random container instances per fork with roots.
+
+Format parity with the reference's tests/generators/ssz_static/main.py:
+per case `roots.yaml` (hash_tree_root), `serialized.ssz_snappy`, and
+`value.yaml` (jsonable form).
+"""
+from random import Random
+
+from ..typing import TestCase, TestProvider
+from ...debug import RandomizationMode, get_random_ssz_object, encode
+from ...specs import get_spec
+from ...ssz import hash_tree_root
+from ...ssz.types import Container
+
+FORKS = ["phase0", "altair", "bellatrix", "capella", "deneb", "electra",
+         "fulu"]
+MODES = [RandomizationMode.RANDOM, RandomizationMode.ZERO,
+         RandomizationMode.MAX, RandomizationMode.ONE_COUNT]
+
+
+def _container_types(spec):
+    out = {}
+    for name in dir(spec):
+        t = getattr(spec, name, None)
+        if isinstance(t, type) and issubclass(t, Container) \
+                and t._field_names:
+            out[name] = t
+    return out
+
+
+def _case(fork, preset, type_name, typ, mode, seed):
+    def fn():
+        rng = Random(seed)
+        obj = get_random_ssz_object(rng, typ, max_bytes_length=256,
+                                    max_list_length=4, mode=mode)
+        yield "value", "data", encode(obj)
+        yield "serialized", "ssz", obj.serialize()
+        yield "roots", "data", {"root": "0x" + hash_tree_root(obj).hex()}
+    return TestCase(
+        fork_name=fork, preset_name=preset, runner_name="ssz_static",
+        handler_name=type_name, suite_name=f"ssz_{mode.name.lower()}",
+        case_name=f"case_{seed}", case_fn=fn)
+
+
+def providers():
+    def make_cases():
+        for fork in FORKS:
+            spec = get_spec(fork, "minimal")
+            for type_name, typ in sorted(_container_types(spec).items()):
+                for mode in MODES:
+                    for seed in range(2):
+                        yield _case(fork, "minimal", type_name, typ,
+                                    mode, seed)
+    return [TestProvider(make_cases=make_cases)]

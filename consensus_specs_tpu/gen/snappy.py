@@ -1,0 +1,236 @@
+"""Snappy compression (block + framing format) in pure Python.
+
+The reference writes test vectors as `.ssz_snappy` (snappy frame format,
+see gen_runner.py:424-430 there, via the C python-snappy package).  That
+package isn't in this image, so the codec is implemented from the public
+format specs (google/snappy: format_description.txt, framing_format.txt).
+The native C++ tier can later take over the hot path; this keeps the
+on-disk format byte-compatible either way.
+
+Public API: compress(data) / decompress(data) — framing format, as used
+for .ssz_snappy files; compress_block / decompress_block — raw block
+format.
+"""
+from __future__ import annotations
+
+# ---------------------------------------------------------------------------
+# CRC-32C (Castagnoli), reflected polynomial 0x82F63B78
+# ---------------------------------------------------------------------------
+
+def _make_crc32c_table():
+    table = []
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+        table.append(c)
+    return table
+
+
+_CRC_TABLE = _make_crc32c_table()
+
+
+def crc32c(data: bytes) -> int:
+    c = 0xFFFFFFFF
+    for b in data:
+        c = _CRC_TABLE[(c ^ b) & 0xFF] ^ (c >> 8)
+    return c ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    """Framing format masks the CRC to avoid crc-of-crc pathologies."""
+    c = crc32c(data)
+    return (((c >> 15) | (c << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# raw block format
+# ---------------------------------------------------------------------------
+
+_MAX_OFFSET = 65535
+_MIN_MATCH = 4
+
+
+def _emit_literal(out: bytearray, data: bytes, start: int, end: int) -> None:
+    n = end - start
+    if n == 0:
+        return
+    if n <= 60:
+        out.append((n - 1) << 2)
+    else:
+        length_bytes = (n - 1).to_bytes(4, "little").rstrip(b"\x00") or b"\x00"
+        out.append((59 + len(length_bytes)) << 2)
+        out += length_bytes
+    out += data[start:end]
+
+
+def compress_block(data: bytes) -> bytes:
+    """Greedy hash-table LZ: copy-2 elements (2-byte offset, len 4..64)."""
+    n = len(data)
+    out = bytearray()
+    # preamble: uncompressed length varint
+    v = n
+    while v >= 0x80:
+        out.append((v & 0x7F) | 0x80)
+        v >>= 7
+    out.append(v)
+
+    if n < _MIN_MATCH:
+        _emit_literal(out, data, 0, n)
+        return bytes(out)
+
+    table: dict = {}
+    i = 0
+    lit_start = 0
+    while i + _MIN_MATCH <= n:
+        key = data[i:i + _MIN_MATCH]
+        cand = table.get(key)
+        table[key] = i
+        if cand is not None and i - cand <= _MAX_OFFSET:
+            # extend the match
+            length = _MIN_MATCH
+            while (i + length < n and length < 64
+                   and data[cand + length] == data[i + length]):
+                length += 1
+            _emit_literal(out, data, lit_start, i)
+            offset = i - cand
+            out.append(((length - 1) << 2) | 0b10)
+            out += offset.to_bytes(2, "little")
+            i += length
+            lit_start = i
+        else:
+            i += 1
+    _emit_literal(out, data, lit_start, n)
+    return bytes(out)
+
+
+def decompress_block(data: bytes) -> bytes:
+    # preamble varint
+    n = 0
+    shift = 0
+    pos = 0
+    while True:
+        if pos >= len(data):
+            raise ValueError("truncated snappy preamble")
+        b = data[pos]
+        pos += 1
+        n |= (b & 0x7F) << shift
+        shift += 7
+        if not b & 0x80:
+            break
+    out = bytearray()
+    while pos < len(data):
+        tag = data[pos]
+        pos += 1
+        elem_type = tag & 0b11
+        if elem_type == 0b00:  # literal
+            length = (tag >> 2) + 1
+            if length > 60:
+                nbytes = length - 60
+                if pos + nbytes > len(data):
+                    raise ValueError("truncated literal length")
+                length = int.from_bytes(data[pos:pos + nbytes], "little") + 1
+                pos += nbytes
+            if pos + length > len(data):
+                raise ValueError("truncated literal body")
+            out += data[pos:pos + length]
+            pos += length
+        else:
+            if elem_type == 0b01:  # copy, 1-byte offset
+                length = ((tag >> 2) & 0b111) + 4
+                if pos >= len(data):
+                    raise ValueError("truncated copy-1")
+                offset = ((tag >> 5) << 8) | data[pos]
+                pos += 1
+            elif elem_type == 0b10:  # copy, 2-byte offset
+                length = (tag >> 2) + 1
+                if pos + 2 > len(data):
+                    raise ValueError("truncated copy-2")
+                offset = int.from_bytes(data[pos:pos + 2], "little")
+                pos += 2
+            else:  # copy, 4-byte offset
+                length = (tag >> 2) + 1
+                if pos + 4 > len(data):
+                    raise ValueError("truncated copy-4")
+                offset = int.from_bytes(data[pos:pos + 4], "little")
+                pos += 4
+            if offset == 0 or offset > len(out):
+                raise ValueError("bad copy offset")
+            start = len(out) - offset
+            if offset >= length:  # disjoint: bulk copy
+                out += out[start:start + length]
+            else:  # self-overlapping: byte-at-a-time
+                for k in range(length):
+                    out.append(out[start + k])
+    if len(out) != n:
+        raise ValueError(
+            f"snappy length mismatch: expected {n}, got {len(out)}")
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# framing format
+# ---------------------------------------------------------------------------
+
+_STREAM_ID = b"\xff\x06\x00\x00sNaPpY"
+_MAX_FRAME_INPUT = 65536
+
+
+def compress(data: bytes) -> bytes:
+    """Snappy framing-format stream (the .ssz_snappy encoding)."""
+    out = bytearray(_STREAM_ID)
+    for i in range(0, len(data), _MAX_FRAME_INPUT) or [0]:
+        chunk = data[i:i + _MAX_FRAME_INPUT]
+        crc = _masked_crc(chunk).to_bytes(4, "little")
+        comp = compress_block(chunk)
+        if len(comp) < len(chunk):
+            body = crc + comp
+            out.append(0x00)  # compressed data chunk
+        else:
+            body = crc + chunk
+            out.append(0x01)  # uncompressed data chunk
+        out += len(body).to_bytes(3, "little")
+        out += body
+    return bytes(out)
+
+
+def decompress(data: bytes) -> bytes:
+    if not data.startswith(_STREAM_ID[:1]):
+        raise ValueError("not a snappy framed stream")
+    pos = 0
+    out = bytearray()
+    seen_stream_id = False
+    while pos < len(data):
+        if pos + 4 > len(data):
+            raise ValueError("truncated chunk header")
+        ctype = data[pos]
+        clen = int.from_bytes(data[pos + 1:pos + 4], "little")
+        pos += 4
+        if pos + clen > len(data):
+            raise ValueError("truncated chunk body")
+        body = data[pos:pos + clen]
+        pos += clen
+        if ctype == 0xFF:  # stream identifier
+            if body != _STREAM_ID[4:]:
+                raise ValueError("bad stream identifier")
+            seen_stream_id = True
+        elif ctype == 0x00:  # compressed data
+            if not seen_stream_id:
+                raise ValueError("data chunk before stream identifier")
+            crc, comp = body[:4], body[4:]
+            chunk = decompress_block(comp)
+            if _masked_crc(chunk).to_bytes(4, "little") != crc:
+                raise ValueError("crc mismatch")
+            out += chunk
+        elif ctype == 0x01:  # uncompressed data
+            if not seen_stream_id:
+                raise ValueError("data chunk before stream identifier")
+            crc, chunk = body[:4], body[4:]
+            if _masked_crc(chunk).to_bytes(4, "little") != crc:
+                raise ValueError("crc mismatch")
+            out += chunk
+        elif 0x80 <= ctype <= 0xFE:
+            continue  # skippable padding
+        else:
+            raise ValueError(f"unknown unskippable chunk type {ctype:#x}")
+    return bytes(out)

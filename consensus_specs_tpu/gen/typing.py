@@ -1,0 +1,36 @@
+"""Test-vector generator core types.
+
+Counterpart of the reference's gen_helpers/gen_base/gen_typing.py: a
+TestCase names its output path (preset/fork/runner/handler/suite/case) and
+carries a case function; a TestProvider yields cases for one runner.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Iterable
+
+
+def hex_str(b: bytes) -> str:
+    """Vector-file hex convention: 0x-prefixed lowercase."""
+    return "0x" + bytes(b).hex()
+
+
+@dataclass
+class TestCase:
+    fork_name: str
+    preset_name: str
+    runner_name: str
+    handler_name: str
+    suite_name: str
+    case_name: str
+    case_fn: Callable[[], Iterable]   # yields (name, kind, value) parts
+
+    def dir_path(self) -> str:
+        return "/".join([self.preset_name, self.fork_name, self.runner_name,
+                         self.handler_name, self.suite_name, self.case_name])
+
+
+@dataclass
+class TestProvider:
+    prepare: Callable[[], None] = lambda: None
+    make_cases: Callable[[], Iterable[TestCase]] = lambda: ()

@@ -1,0 +1,58 @@
+"""Dual-mode yield protocol for spec tests.
+
+Same capability as the reference's test/utils/utils.py vector_test(): a
+test body `yield`s named artifacts; under pytest the generator is drained
+(only the asserts matter), under the vector generator the same run streams
+each artifact to disk.
+
+Artifact kinds:
+    "meta" — scalar collected into meta.yaml
+    "cfg"  — dict dumped as its own yaml file
+    "data" — jsonable dumped as yaml
+    "ssz"  — raw bytes written as <name>.ssz_snappy
+SSZ views yielded without an explicit kind become both data (debug yaml is
+skipped — the reference stopped emitting it too) and ssz bytes.
+"""
+from __future__ import annotations
+
+import functools
+
+from ..ssz.types import SSZType
+
+
+def _classify(name, value, kind):
+    if kind is not None:
+        return name, kind, value
+    if isinstance(value, SSZType):
+        return name, "ssz", value.serialize()
+    if isinstance(value, bytes):
+        return name, "ssz", value
+    return name, "data", value
+
+
+def run_yields(fn, *args, **kwargs):
+    """Drain a yielding test body, returning the list of artifact parts."""
+    gen = fn(*args, **kwargs)
+    if gen is None:
+        return []
+    parts = []
+    for item in gen:
+        if len(item) == 3:
+            name, kind, value = item
+        else:
+            name, value = item
+            kind = None
+        if value is None:
+            # `yield 'post', None` marks an expected-invalid case
+            parts.append((name, "none", None))
+            continue
+        parts.append(_classify(name, value, kind))
+    return parts
+
+
+def vector_test(fn):
+    """Pytest-facing wrapper: drains the yields so asserts run."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        run_yields(fn, *args, **kwargs)
+    return wrapper

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Generate conformance test vectors.
+
+Usage:
+    python scripts/gen_vectors.py <runner|all> -o out/ [--force]
+        [--preset-list minimal] [--fork-list phase0 altair]
+        [--shard I/N]     # host-level sharding: this host takes cases i%N==I
+
+Counterpart of the reference's `make gen_<runner>` / `make gen_all`.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from consensus_specs_tpu.gen.runner import run_generator  # noqa: E402
+from consensus_specs_tpu.gen.runners import (  # noqa: E402
+    RUNNER_NAMES, get_providers)
+from consensus_specs_tpu.gen.typing import TestProvider  # noqa: E402
+
+
+def _sharded(providers, shard_spec: str):
+    """Filter cases to this host's shard (i % n == i0)."""
+    i0, n = (int(x) for x in shard_spec.split("/"))
+    out = []
+    for provider in providers:
+        def make_cases(p=provider):
+            for idx, case in enumerate(p.make_cases()):
+                if idx % n == i0:
+                    yield case
+        out.append(TestProvider(prepare=provider.prepare,
+                                make_cases=make_cases))
+    return out
+
+
+def main(argv):
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    runner = argv[0]
+    rest = list(argv[1:])
+    shard = None
+    if "--shard" in rest:
+        i = rest.index("--shard")
+        if i + 1 >= len(rest) or "/" not in rest[i + 1]:
+            print("usage: --shard I/N (e.g. --shard 0/4)", file=sys.stderr)
+            return 2
+        shard = rest[i + 1]
+        del rest[i:i + 2]
+    names = RUNNER_NAMES if runner == "all" else [runner]
+    for name in names:
+        providers = get_providers(name)
+        if shard:
+            providers = _sharded(providers, shard)
+        diag = run_generator(name, providers, rest)
+        print(f"{name}: {diag}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
