@@ -1,0 +1,248 @@
+"""BLS12-381 base field Fq on TPU: limb arithmetic in JAX.
+
+Design (SURVEY.md §7 step 1, pallas_guide mental model): an Fq element is
+32 limbs x 12 bits (little-endian) in a uint32 vector, batched over leading
+axes.  12-bit limbs keep every intermediate — 32-term products plus carry
+tails — under 2^31, so the whole tower runs on native int32/uint32 vector
+ops (no 64-bit emulation on TPU).  Multiplication is schoolbook convolution
+(32 statically-unrolled shifted MACs) followed by Montgomery reduction in
+base 2^12.  Elements stay in Montgomery form (R = 2^384 mod q) between
+host conversions.
+
+The pure-Python tower (crypto/fields.py) is the correctness oracle; every
+op here is differential-tested against it.
+
+Capability counterpart of the reference's external BLS backends
+(py_arkworks_bls12381 Rust / milagro C — see
+/root/reference/tests/core/pyspec/eth2spec/utils/bls.py:25-30).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..crypto.fields import Q
+
+# ---------------------------------------------------------------------------
+# representation constants
+# ---------------------------------------------------------------------------
+
+LIMB_BITS = 12
+LIMBS = 32            # 32 * 12 = 384 bits >= 381
+BASE = 1 << LIMB_BITS
+MASK = BASE - 1
+
+R_MONT = pow(2, LIMB_BITS * LIMBS, Q)          # Montgomery radix R mod q
+R2_MONT = R_MONT * R_MONT % Q                  # R^2 mod q (to-Mont factor)
+NINV = (-pow(Q, -1, BASE)) % BASE              # -q^{-1} mod 2^12
+
+
+def _int_to_limbs_np(x: int) -> np.ndarray:
+    return np.array([(x >> (LIMB_BITS * i)) & MASK for i in range(LIMBS)],
+                    dtype=np.uint32)
+
+
+Q_LIMBS = _int_to_limbs_np(Q)
+R2_LIMBS = _int_to_limbs_np(R2_MONT)
+ONE_MONT_LIMBS = _int_to_limbs_np(R_MONT)      # 1 in Montgomery form
+ZERO_LIMBS = np.zeros(LIMBS, dtype=np.uint32)
+
+
+# ---------------------------------------------------------------------------
+# host codecs
+# ---------------------------------------------------------------------------
+
+def to_limbs(x: int) -> jnp.ndarray:
+    """Plain integer -> canonical (non-Montgomery) limb vector."""
+    return jnp.asarray(_int_to_limbs_np(x % Q))
+
+def from_limbs(v) -> int:
+    arr = np.asarray(v, dtype=np.uint64)
+    out = 0
+    for i in reversed(range(arr.shape[-1])):
+        out = (out << LIMB_BITS) | int(arr[..., i])
+    return out
+
+
+def pack(xs) -> jnp.ndarray:
+    """List of ints -> batched canonical limb array [n, LIMBS]."""
+    return jnp.asarray(np.stack([_int_to_limbs_np(x % Q) for x in xs]))
+
+
+def unpack(v) -> list:
+    arr = np.asarray(v)
+    return [from_limbs(arr[i]) for i in range(arr.shape[0])]
+
+
+# ---------------------------------------------------------------------------
+# normalization helpers (all jit-safe, batched over leading axes)
+# ---------------------------------------------------------------------------
+
+def _carry_propagate(t):
+    """Make limbs canonical (< 2^12); t limbs must each fit uint32."""
+    def step(carry, limb):
+        s = limb + carry
+        return s >> LIMB_BITS, s & MASK
+    carry, limbs = jax.lax.scan(step, jnp.zeros(t.shape[:-1], t.dtype),
+                                jnp.moveaxis(t, -1, 0))
+    return jnp.moveaxis(limbs, 0, -1)
+
+
+def _geq(a, b):
+    """Lexicographic a >= b over canonical limbs (batched)."""
+    # scan from most-significant: keep first difference
+    gt = jnp.zeros(a.shape[:-1], dtype=jnp.bool_)
+    lt = jnp.zeros(a.shape[:-1], dtype=jnp.bool_)
+    for i in reversed(range(LIMBS)):
+        ai, bi = a[..., i], b[..., i]
+        gt = gt | (~lt & (ai > bi))
+        lt = lt | (~gt & (ai < bi))
+    return ~lt
+
+
+def _sub_limbs(a, b):
+    """a - b with borrow propagation; caller guarantees a >= b."""
+    def step(borrow, ab):
+        ai, bi = ab
+        d = ai + BASE - bi - borrow
+        return 1 - (d >> LIMB_BITS), d & MASK
+    borrow, limbs = jax.lax.scan(
+        step, jnp.zeros(a.shape[:-1], a.dtype),
+        (jnp.moveaxis(a, -1, 0), jnp.moveaxis(b, -1, 0)))
+    return jnp.moveaxis(limbs, 0, -1)
+
+
+def _csub_q(a):
+    """Conditionally subtract q when a >= q (canonical limbs in/out)."""
+    q = jnp.asarray(Q_LIMBS)
+    need = _geq(a, jnp.broadcast_to(q, a.shape))
+    diff = _sub_limbs(a, jnp.broadcast_to(q, a.shape))
+    return jnp.where(need[..., None], diff, a)
+
+
+# ---------------------------------------------------------------------------
+# field ops (Montgomery domain unless stated)
+# ---------------------------------------------------------------------------
+
+def add(a, b):
+    return _csub_q(_carry_propagate(a + b))
+
+
+def sub(a, b):
+    # (a + q) - b: a+q >= q > b, so the borrow subtraction never underflows
+    q = jnp.asarray(Q_LIMBS)
+    t = _carry_propagate(a + jnp.broadcast_to(q, a.shape))
+    return _csub_q(_sub_limbs(t, b))
+
+
+def neg(a):
+    """-a mod q (Montgomery form preserved); -0 = 0."""
+    q = jnp.asarray(Q_LIMBS)
+    is_zero = jnp.all(a == 0, axis=-1)
+    d = _sub_limbs(jnp.broadcast_to(q, a.shape), a)
+    return jnp.where(is_zero[..., None], a, d)
+
+
+# static Toeplitz gather: c[k] = sum_j a[k-j] * b[j] as one batched matvec
+_TOEPLITZ_IDX = np.zeros((2 * LIMBS - 1, LIMBS), dtype=np.int32)
+_TOEPLITZ_MASK = np.zeros((2 * LIMBS - 1, LIMBS), dtype=np.uint32)
+for _k in range(2 * LIMBS - 1):
+    for _j in range(LIMBS):
+        if 0 <= _k - _j < LIMBS:
+            _TOEPLITZ_IDX[_k, _j] = _k - _j
+            _TOEPLITZ_MASK[_k, _j] = 1
+
+# q shifted left by i limbs, one static row per reduction step
+_Q_SHIFTS = np.zeros((LIMBS, 2 * LIMBS + 1), dtype=np.uint32)
+for _i in range(LIMBS):
+    _Q_SHIFTS[_i, _i:_i + LIMBS] = Q_LIMBS
+
+
+def _conv(a, b):
+    """Schoolbook polynomial product as a Toeplitz matvec:
+    [..., 2*LIMBS-1] coefficient sums, each < 32 * (2^12-1)^2 < 2^29.
+    One einsum per call — MXU/VPU-friendly and graph-compact (the pairing
+    stacks thousands of these).
+    """
+    at = a[..., jnp.asarray(_TOEPLITZ_IDX)] * jnp.asarray(_TOEPLITZ_MASK)
+    return jnp.einsum("...kj,...j->...k", at, b)
+
+
+def _mont_reduce(t):
+    """Montgomery reduction of a [..., 2*LIMBS-1] convolution (base 2^12).
+
+    Returns canonical limbs of t * R^{-1} mod q.
+    """
+    q_shifts = jnp.asarray(_Q_SHIFTS)
+    # one extra slot so the final carry add stays in range
+    pad = t.shape[:-1] + (2 * LIMBS + 1 - t.shape[-1],)
+    t = jnp.concatenate([t, jnp.zeros(pad, t.dtype)], axis=-1)
+
+    def body(i, t):
+        m = (t[..., i] * NINV) & MASK
+        t = t + m[..., None] * q_shifts[i]
+        carry = t[..., i] >> LIMB_BITS
+        return t.at[..., i + 1].add(carry)
+
+    t = jax.lax.fori_loop(0, LIMBS, body, t)
+    r = t[..., LIMBS:2 * LIMBS + 1]
+    r = _carry_propagate(r)[..., :LIMBS]
+    return _csub_q(_csub_q(r))
+
+
+def mul(a, b):
+    """Montgomery product: a * b * R^{-1} mod q."""
+    return _mont_reduce(_conv(a, b))
+
+
+def square(a):
+    return mul(a, a)
+
+
+def to_mont(a):
+    """Canonical limbs -> Montgomery form."""
+    return mul(a, jnp.broadcast_to(jnp.asarray(R2_LIMBS), a.shape))
+
+
+def from_mont(a):
+    """Montgomery form -> canonical limbs (multiply by 1)."""
+    one = jnp.zeros_like(a).at[..., 0].set(1)
+    return mul(a, one)
+
+
+def is_zero(a):
+    return jnp.all(a == 0, axis=-1)
+
+
+def eq(a, b):
+    return jnp.all(a == b, axis=-1)
+
+
+def select(cond, a, b):
+    """cond ? a : b, broadcasting cond over the limb axis."""
+    return jnp.where(cond[..., None], a, b)
+
+
+def zeros_like(a):
+    return jnp.zeros_like(a)
+
+
+def one_mont(shape_like):
+    """1 in Montgomery form, broadcast to shape_like's batch shape."""
+    return jnp.broadcast_to(jnp.asarray(ONE_MONT_LIMBS), shape_like.shape)
+
+
+# host-side: encode ints straight into Montgomery form
+def pack_mont(xs) -> jnp.ndarray:
+    return jnp.asarray(
+        np.stack([_int_to_limbs_np(x % Q * R_MONT % Q) for x in xs]))
+
+
+def unpack_mont(v) -> list:
+    arr = np.asarray(from_mont_np(v))
+    return [from_limbs(arr[i]) for i in range(arr.shape[0])]
+
+
+def from_mont_np(v):
+    return np.asarray(from_mont(jnp.asarray(v)))

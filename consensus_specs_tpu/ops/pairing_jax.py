@@ -1,0 +1,203 @@
+"""Optimal ate pairing on BLS12-381 over TPU limbs, batched.
+
+Miller loop with the twist trick: Q stays on the M-twist E'(Fq2)
+(y^2 = x^3 + 4(u+1)); the untwist psi(x,y) = (x/w^2, y/w^3) maps to
+E(Fq12).  Lines through untwisted points, evaluated at P in G1 and scaled
+by w^3 and by per-line Fq2 denominators, land in the sparse Fq12 form
+c0 + c1*v + c4*vw.  Both scalings are killed by the final exponentiation
+(their orders divide 2(q^2-1) | (q^6-1)(q^2+1)), so the pairing value is
+exact — this is the derivation behind the standard "mul_by_014" line
+update in production pairing libraries.
+
+Final exponentiation: easy part f^(q^6-1) = conj(f) * inv(f); the
+remaining (q^2+1) * (q^4-q^2+1)/r exponent is applied by a fixed-bit
+square-and-multiply scan (~2k iterations).  No Frobenius constants needed;
+a chained-Frobenius hard part is a later optimization.
+
+Oracle: crypto/pairing.py (untwist-into-Fq12 affine implementation).
+Verified identities: bilinearity and e(aG1, bG2) == e(G1, G2)^(ab).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..crypto.fields import Q, R
+from . import fq
+from . import fq_tower as ft
+
+BLS_X_ABS = 0xD201000000010000          # |x|, x negative for BLS12-381
+
+# miller-loop bit sequence: bits of |x| msb-first, skipping the leading 1
+_MILLER_BITS = np.array(
+    [int(b) for b in bin(BLS_X_ABS)[3:]], dtype=np.uint32)
+
+# final-exponentiation fixed exponent after the easy q^6-1 part:
+# (q^2+1) * (q^4 - q^2 + 1) / r
+_HARD_EXP = (Q * Q + 1) * ((Q**4 - Q**2 + 1) // R)
+_HARD_BITS = np.array([int(b) for b in bin(_HARD_EXP)[2:]], dtype=np.uint32)
+
+
+# ---------------------------------------------------------------------------
+# sparse line -> fq12 embedding
+# ---------------------------------------------------------------------------
+
+def _line_to_fq12(c0, c1, c4):
+    """Line c0 + c1*v + c4*vw as a full fq12 tensor.
+
+    fq12 component order: [c0.a, c0.b, (v) a, b, (v^2) a, b,
+                           (w) a, b, (vw) a, b, (v^2 w) a, b].
+    """
+    batch = c0.shape[:-2]
+    zeros = jnp.zeros(batch + (2, fq.LIMBS), dtype=jnp.uint32)
+    return jnp.concatenate(
+        [c0, c1, zeros, zeros, c4, zeros], axis=-2)
+
+
+# ---------------------------------------------------------------------------
+# miller loop steps (twist-point Jacobian, line coeffs in fq2)
+# ---------------------------------------------------------------------------
+
+def _double_step(T, xp, yp):
+    """Tangent line at T evaluated at P=(xp, yp), plus T <- 2T.
+
+    Line (scaled by w^3 and 2YZ^3): c0 = 3X^3 - 2Y^2, c1 = -3X^2 Z^2 xp,
+    c4 = 2 Y Z^3 yp.
+    """
+    X, Y, Z = T
+    X2 = ft.fq2_square(X)
+    Y2 = ft.fq2_square(Y)
+    Z2 = ft.fq2_square(Z)
+    X3 = ft.fq2_mul(X, X2)
+    Z3 = ft.fq2_mul(Z, Z2)
+    threeX3 = ft.fq2_add(X3, ft.fq2_add(X3, X3))
+    c0 = ft.fq2_sub(threeX3, ft.fq2_add(Y2, Y2))
+    threeX2Z2 = ft.fq2_mul(X2, Z2)
+    threeX2Z2 = ft.fq2_add(threeX2Z2, ft.fq2_add(threeX2Z2, threeX2Z2))
+    c1 = ft.fq2_neg(ft.fq2_mul_fq(threeX2Z2, xp))
+    YZ3 = ft.fq2_mul(Y, Z3)
+    c4 = ft.fq2_mul_fq(ft.fq2_add(YZ3, YZ3), yp)
+
+    # dbl-2009-l (a = 0)
+    B = Y2
+    C = ft.fq2_square(B)
+    t = ft.fq2_square(ft.fq2_add(X, B))
+    D = ft.fq2_sub(ft.fq2_sub(t, X2), C)
+    D = ft.fq2_add(D, D)
+    E = ft.fq2_add(X2, ft.fq2_add(X2, X2))
+    F = ft.fq2_square(E)
+    Xn = ft.fq2_sub(F, ft.fq2_add(D, D))
+    C8 = ft.fq2_add(C, C)
+    C8 = ft.fq2_add(C8, C8)
+    C8 = ft.fq2_add(C8, C8)
+    Yn = ft.fq2_sub(ft.fq2_mul(E, ft.fq2_sub(D, Xn)), C8)
+    Zn = ft.fq2_mul(Y, Z)
+    Zn = ft.fq2_add(Zn, Zn)
+    return (Xn, Yn, Zn), (c0, c1, c4)
+
+
+def _add_step(T, Qa, xp, yp):
+    """Line through T and affine twist point Qa=(x2,y2) at P, plus T <- T+Q.
+
+    With theta = Y1 - y2 Z1^3 and lam = X1 - x2 Z1^2 (scaled by Z1*lam):
+    c0 = theta*x2 - y2*Z1*lam, c1 = -theta*xp, c4 = Z1*lam*yp.
+    """
+    X1, Y1, Z1 = T
+    x2, y2 = Qa
+    Z1Z1 = ft.fq2_square(Z1)
+    U2 = ft.fq2_mul(x2, Z1Z1)
+    S2 = ft.fq2_mul(y2, ft.fq2_mul(Z1, Z1Z1))
+    theta = ft.fq2_sub(Y1, S2)
+    lam = ft.fq2_sub(X1, U2)
+    Z1lam = ft.fq2_mul(Z1, lam)
+    c0 = ft.fq2_sub(ft.fq2_mul(theta, x2), ft.fq2_mul(y2, Z1lam))
+    c1 = ft.fq2_neg(ft.fq2_mul_fq(theta, xp))
+    c4 = ft.fq2_mul_fq(Z1lam, yp)
+
+    # madd-2007-bl (mixed addition, a = 0)
+    H = ft.fq2_neg(lam)                      # U2 - X1
+    HH = ft.fq2_square(H)
+    I = ft.fq2_add(HH, HH)
+    I = ft.fq2_add(I, I)
+    J = ft.fq2_mul(H, I)
+    r = ft.fq2_neg(theta)                    # S2 - Y1
+    r = ft.fq2_add(r, r)
+    V = ft.fq2_mul(X1, I)
+    Xn = ft.fq2_sub(ft.fq2_sub(ft.fq2_square(r), J), ft.fq2_add(V, V))
+    YJ = ft.fq2_mul(Y1, J)
+    Yn = ft.fq2_sub(ft.fq2_mul(r, ft.fq2_sub(V, Xn)), ft.fq2_add(YJ, YJ))
+    Zn = ft.fq2_mul(Z1, H)
+    Zn = ft.fq2_add(Zn, Zn)                  # madd-2007-bl: Z3 = 2*Z1*H
+    return (Xn, Yn, Zn), (c0, c1, c4)
+
+
+def miller_loop(xp, yp, xq, yq, skip=None):
+    """Batched Miller loop.
+
+    xp, yp: G1 affine coords, Montgomery limbs [..., 32].
+    xq, yq: twist G2 affine coords, [..., 2, 32].
+    skip: optional bool [...] — pairs whose contribution is forced to one
+    (how infinity points enter: they have no affine coords, and
+    e(O, Q) = e(P, O) = 1; callers substitute any valid point and set
+    skip, matching the oracle's miller_loop infinity short-circuit).
+    Returns f in Fq12 [..., 12, 32] (already conjugated for x < 0).
+    """
+    batch = xp.shape[:-1]
+    one2 = jnp.broadcast_to(
+        jnp.asarray(np.stack([fq.ONE_MONT_LIMBS, fq.ZERO_LIMBS])),
+        batch + (2, fq.LIMBS))
+    T = (xq, yq, one2)
+    f = ft.fq12_one(batch)
+
+    def step(carry, bit):
+        f, T = carry
+        T, (c0, c1, c4) = _double_step(T, xp, yp)
+        f = ft.fq12_mul(ft.fq12_square(f), _line_to_fq12(c0, c1, c4))
+        Ta, (a0, a1, a4) = _add_step(T, (xq, yq), xp, yp)
+        fa = ft.fq12_mul(f, _line_to_fq12(a0, a1, a4))
+        take = jnp.broadcast_to(bit.astype(bool), batch)
+        f = ft.fq12_select(take, fa, f)
+        T = tuple(jnp.where(bit.astype(bool), a, t) for a, t in zip(Ta, T))
+        return (f, T), None
+
+    (f, T), _ = jax.lax.scan(step, (f, T), jnp.asarray(_MILLER_BITS))
+    f = ft.fq12_conj(f)         # x < 0
+    if skip is not None:
+        f = ft.fq12_select(skip, ft.fq12_one(batch), f)
+    return f
+
+
+def final_exponentiation(f):
+    """f^((q^12-1)/r), batched [..., 12, 32] -> [..., 12, 32]."""
+    f1 = ft.fq12_mul(ft.fq12_conj(f), ft.fq12_inv(f))   # f^(q^6-1)
+    return ft.fq12_pow_fixed(f1, _HARD_BITS)
+
+
+def multi_miller_product(xps, yps, xqs, yqs, skip=None):
+    """Product over the pairs axis (-1 of batch) of miller loops.
+
+    Inputs carry a trailing pairs axis k: xps [..., k, 32], xqs
+    [..., k, 2, 32]; optional skip [..., k] marks infinity pairs.  The k
+    miller loops run stacked in one batch; their Fq12 outputs are
+    multiplied together — one shared final exponentiation then decides
+    the whole product (the standard pairing-check shape).
+    """
+    f = miller_loop(xps, yps, xqs, yqs, skip)   # [..., k, 12, 32]
+    k = f.shape[-3]
+    out = f[..., 0, :, :]
+    for i in range(1, k):
+        out = ft.fq12_mul(out, f[..., i, :, :])
+    return out
+
+
+def pairing_check(xps, yps, xqs, yqs, skip=None):
+    """Batched check  prod_i e(P_i, Q_i) == 1  over the trailing pairs axis.
+
+    Returns a boolean per batch element.
+    """
+    f = multi_miller_product(xps, yps, xqs, yqs, skip)
+    return ft.fq12_is_one(final_exponentiation(f))
+
+
+pairing_check_jit = jax.jit(pairing_check)

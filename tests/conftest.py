@@ -13,6 +13,10 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
+# persistent XLA binary cache: the limb-crypto graphs (pairing, scalar mul)
+# compile in tens of seconds; cache them across pytest runs
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(__file__), ".jax_cache"))
 
 
 def pytest_addoption(parser):

@@ -1,0 +1,94 @@
+"""Differential tests: JAX Jacobian curve ops vs the pure-Python oracle
+(crypto/curve.py) for G1 and G2."""
+from random import Random
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from consensus_specs_tpu.crypto import curve as cv
+from consensus_specs_tpu.crypto.fields import R
+from consensus_specs_tpu.ops import curve_jax as cj
+
+rng = Random(0xC0DE)
+
+G1 = cv.g1_generator()
+G2 = cv.g2_generator()
+
+K1 = [rng.randrange(R) for _ in range(6)]
+K2 = [rng.randrange(R) for _ in range(4)]
+P1 = [G1 * k for k in K1]
+P2 = [G2 * k for k in K2]
+
+
+def same_g1(jax_pt, oracle_pts):
+    got = cj.g1_unpack(jax_pt)
+    return all(a == b for a, b in zip(got, oracle_pts))
+
+
+def same_g2(jax_pt, oracle_pts):
+    got = cj.g2_unpack(jax_pt)
+    return all(a == b for a, b in zip(got, oracle_pts))
+
+
+def test_g1_double_add():
+    pts = cj.g1_pack(P1)
+    assert same_g1(cj.g1_double(pts), [p.double() for p in P1])
+    pts_b = cj.g1_pack(P1[::-1])
+    assert same_g1(cj.g1_add(pts, pts_b),
+                   [a + b for a, b in zip(P1, P1[::-1])])
+
+
+def test_g1_add_edge_cases():
+    inf = cv.g1_infinity()
+    cases_a = [P1[0], inf, P1[1], P1[2], inf]
+    cases_b = [inf, P1[0], P1[1], -P1[2], inf]
+    a, b = cj.g1_pack(cases_a), cj.g1_pack(cases_b)
+    want = [x + y for x, y in zip(cases_a, cases_b)]
+    assert same_g1(cj.g1_add(a, b), want)
+
+
+def test_g1_scalar_mul():
+    scalars = [0, 1, 2, 7, R - 1, rng.randrange(R)]
+    pts = cj.g1_pack([G1] * len(scalars))
+    bits = cj.scalars_to_bits(scalars)
+    got = cj.g1_scalar_mul(pts, bits)
+    assert same_g1(got, [G1 * s for s in scalars])
+
+
+def test_g1_msm():
+    scalars = [rng.randrange(R) for _ in range(5)]
+    pts = cj.g1_pack(P1[:5])
+    bits = cj.scalars_to_bits(scalars)
+    got = cj.g1_msm(pts, bits)
+    want = cv.msm(P1[:5], scalars)
+    one = cj.g1_unpack(tuple(x[None] for x in got))[0]
+    assert one == want
+
+
+def test_g2_double_add_scalar():
+    pts = cj.g2_pack(P2)
+    assert same_g2(cj.g2_double(pts), [p.double() for p in P2])
+    pts_b = cj.g2_pack(P2[::-1])
+    assert same_g2(cj.g2_add(pts, pts_b),
+                   [a + b for a, b in zip(P2, P2[::-1])])
+    scalars = [3, rng.randrange(R)]
+    bits = cj.scalars_to_bits(scalars)
+    got = cj.g2_scalar_mul(cj.g2_pack([G2, P2[0]]), bits)
+    assert same_g2(got, [G2 * scalars[0], P2[0] * scalars[1]])
+
+
+def test_g2_add_edge_cases():
+    inf = cv.g2_infinity()
+    cases_a = [P2[0], inf, P2[1], P2[1]]
+    cases_b = [inf, P2[0], P2[1], -P2[1]]
+    a, b = cj.g2_pack(cases_a), cj.g2_pack(cases_b)
+    want = [x + y for x, y in zip(cases_a, cases_b)]
+    assert same_g2(cj.g2_add(a, b), want)
+
+
+def test_point_sum_tree_odd_count():
+    pts = cj.g1_pack(P1[:3])
+    got = cj.g1_sum(pts)
+    want = P1[0] + P1[1] + P1[2]
+    assert cj.g1_unpack(tuple(x[None] for x in got))[0] == want

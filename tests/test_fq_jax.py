@@ -1,0 +1,96 @@
+"""Differential tests: JAX limb Fq arithmetic vs pure-Python oracle.
+
+Every op on random batches must agree with plain int arithmetic mod Q
+(crypto/fields.py is the oracle convention — SURVEY.md §7 step 1).
+"""
+from random import Random
+
+import numpy as np
+import jax
+import pytest
+
+from consensus_specs_tpu.crypto.fields import Q
+from consensus_specs_tpu.ops import fq
+
+rng = Random(0xB15)
+N = 64
+
+XS = [rng.randrange(Q) for _ in range(N)]
+YS = [rng.randrange(Q) for _ in range(N)]
+EDGE = [0, 1, 2, Q - 1, Q - 2, (Q - 1) // 2, 2**380, 2**300 + 12345]
+
+
+def test_codec_roundtrip():
+    for x in EDGE + XS[:8]:
+        assert fq.from_limbs(fq.to_limbs(x)) == x % Q
+    batch = fq.pack(EDGE)
+    assert fq.unpack(batch) == [x % Q for x in EDGE]
+
+
+def test_mont_roundtrip():
+    batch = fq.pack(XS + EDGE)
+    m = fq.to_mont(batch)
+    back = fq.from_mont(m)
+    assert fq.unpack(back) == [x % Q for x in XS + EDGE]
+    # pack_mont agrees with to_mont(pack)
+    m2 = fq.pack_mont(XS + EDGE)
+    assert np.array_equal(np.asarray(m), np.asarray(m2))
+
+
+def test_add_sub_neg():
+    a, b = fq.pack(XS), fq.pack(YS)
+    assert fq.unpack(fq.add(a, b)) == [(x + y) % Q for x, y in zip(XS, YS)]
+    assert fq.unpack(fq.sub(a, b)) == [(x - y) % Q for x, y in zip(XS, YS)]
+    assert fq.unpack(fq.neg(a)) == [(-x) % Q for x in XS]
+    # edge: a - a = 0, 0 - x, neg(0) = 0
+    z = fq.pack([0] * len(EDGE))
+    e = fq.pack(EDGE)
+    assert fq.unpack(fq.sub(e, e)) == [0] * len(EDGE)
+    assert fq.unpack(fq.sub(z, e)) == [(-x) % Q for x in EDGE]
+    assert fq.unpack(fq.neg(z)) == [0] * len(EDGE)
+
+
+def test_mul_matches_oracle():
+    a, b = fq.pack_mont(XS), fq.pack_mont(YS)
+    prod = fq.mul(a, b)
+    assert fq.unpack_mont(prod) == [x * y % Q for x, y in zip(XS, YS)]
+
+
+def test_mul_edge_cases():
+    pairs = [(0, 0), (0, Q - 1), (1, Q - 1), (Q - 1, Q - 1), (2, (Q + 1) // 2)]
+    a = fq.pack_mont([p[0] for p in pairs])
+    b = fq.pack_mont([p[1] for p in pairs])
+    assert fq.unpack_mont(fq.mul(a, b)) == [x * y % Q for x, y in pairs]
+
+
+def test_square_and_chains():
+    a = fq.pack_mont(XS[:16])
+    assert fq.unpack_mont(fq.square(a)) == [x * x % Q for x in XS[:16]]
+    # repeated squaring: x^(2^20) — catches drift/normalization bugs
+    acc = a
+    want = XS[:16]
+    for _ in range(20):
+        acc = fq.square(acc)
+        want = [w * w % Q for w in want]
+    assert fq.unpack_mont(acc) == want
+
+
+def test_ops_under_jit_and_vmap():
+    a, b = fq.pack_mont(XS[:8]), fq.pack_mont(YS[:8])
+    f = jax.jit(lambda x, y: fq.mul(fq.add(x, y), fq.sub(x, y)))
+    got = fq.unpack_mont(f(a, b))
+    want = [((x + y) * (x - y)) % Q for x, y in zip(XS[:8], YS[:8])]
+    assert got == want
+    # vmap over an extra axis
+    a2 = np.stack([np.asarray(a), np.asarray(b)])
+    g = jax.vmap(fq.square)
+    got2 = np.asarray(g(jax.numpy.asarray(a2)))
+    assert fq.unpack_mont(got2[0]) == [x * x % Q for x in XS[:8]]
+    assert fq.unpack_mont(got2[1]) == [y * y % Q for y in YS[:8]]
+
+
+def test_predicates():
+    a = fq.pack([0, 1, Q - 1, 0])
+    assert list(np.asarray(fq.is_zero(a))) == [True, False, False, True]
+    b = fq.pack([0, 2, Q - 1, 5])
+    assert list(np.asarray(fq.eq(a, b))) == [True, False, True, False]

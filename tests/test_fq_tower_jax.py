@@ -1,0 +1,107 @@
+"""Differential tests: JAX limb tower (Fq2/Fq6/Fq12) vs pure-Python oracle."""
+from random import Random
+
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.crypto.fields import Q, Fq2, Fq6, Fq12
+from consensus_specs_tpu.ops import fq, fq_tower as ft
+
+rng = Random(0x7034E4)
+N = 8
+
+
+def rand_fq2():
+    return Fq2(rng.randrange(Q), rng.randrange(Q))
+
+
+def rand_fq6():
+    return Fq6(rand_fq2(), rand_fq2(), rand_fq2())
+
+
+def rand_fq12():
+    return Fq12(rand_fq6(), rand_fq6())
+
+
+A2 = [rand_fq2() for _ in range(N)] + [Fq2.zero(), Fq2.one(), Fq2(0, 1)]
+B2 = [rand_fq2() for _ in range(N)] + [Fq2.one(), Fq2(Q - 1, Q - 1),
+                                       Fq2(5, 0)]
+A6 = [rand_fq6() for _ in range(N)] + [Fq6.zero(), Fq6.one()]
+B6 = [rand_fq6() for _ in range(N)] + [Fq6.one(), Fq6.zero()]
+A12 = [rand_fq12() for _ in range(N)] + [Fq12.one()]
+B12 = [rand_fq12() for _ in range(N)] + [Fq12.one()]
+
+
+def test_fq2_roundtrip_and_ops():
+    a, b = ft.fq2_pack_mont(A2), ft.fq2_pack_mont(B2)
+    assert ft.fq2_unpack_mont(a) == A2
+    assert ft.fq2_unpack_mont(ft.fq2_mul(a, b)) == \
+        [x * y for x, y in zip(A2, B2)]
+    assert ft.fq2_unpack_mont(ft.fq2_add(a, b)) == \
+        [x + y for x, y in zip(A2, B2)]
+    assert ft.fq2_unpack_mont(ft.fq2_sub(a, b)) == \
+        [x - y for x, y in zip(A2, B2)]
+    assert ft.fq2_unpack_mont(ft.fq2_square(a)) == [x * x for x in A2]
+    assert ft.fq2_unpack_mont(ft.fq2_mul_xi(a)) == \
+        [x.mul_by_xi() for x in A2]
+    assert ft.fq2_unpack_mont(ft.fq2_conj(a)) == [x.conjugate() for x in A2]
+
+
+def test_fq2_inverse():
+    vals = [x for x in A2 if not x.is_zero()]
+    a = ft.fq2_pack_mont(vals)
+    got = ft.fq2_unpack_mont(ft.fq2_inv(a))
+    assert got == [x.inv() for x in vals]
+
+
+def test_fq6_ops():
+    a, b = ft.fq6_pack_mont(A6), ft.fq6_pack_mont(B6)
+    assert ft.fq6_unpack_mont(a) == A6
+    assert ft.fq6_unpack_mont(ft.fq6_mul(a, b)) == \
+        [x * y for x, y in zip(A6, B6)]
+    assert ft.fq6_unpack_mont(ft.fq6_mul_by_v(a)) == \
+        [x.mul_by_v() for x in A6]
+    assert ft.fq6_unpack_mont(ft.fq6_square(a)) == [x.square() for x in A6]
+
+
+def test_fq6_inverse():
+    vals = [x for x in A6 if not x.is_zero()]
+    a = ft.fq6_pack_mont(vals)
+    assert ft.fq6_unpack_mont(ft.fq6_inv(a)) == [x.inv() for x in vals]
+
+
+def test_fq12_ops():
+    a, b = ft.fq12_pack_mont(A12), ft.fq12_pack_mont(B12)
+    assert ft.fq12_unpack_mont(a) == A12
+    assert ft.fq12_unpack_mont(ft.fq12_mul(a, b)) == \
+        [x * y for x, y in zip(A12, B12)]
+    assert ft.fq12_unpack_mont(ft.fq12_square(a)) == \
+        [x.square() for x in A12]
+    assert ft.fq12_unpack_mont(ft.fq12_conj(a)) == \
+        [x.conjugate() for x in A12]
+
+
+def test_fq12_inverse_and_identity():
+    vals = A12[:4]
+    a = ft.fq12_pack_mont(vals)
+    inv = ft.fq12_inv(a)
+    assert ft.fq12_unpack_mont(inv) == [x.inv() for x in vals]
+    prod = ft.fq12_mul(a, inv)
+    assert list(np.asarray(ft.fq12_is_one(prod))) == [True] * len(vals)
+
+
+def test_fq12_pow_fixed():
+    e = 0xD201000000010000  # |BLS x|
+    bits = np.array([int(b) for b in bin(e)[2:]], dtype=np.uint32)
+    vals = A12[:3]
+    a = ft.fq12_pack_mont(vals)
+    got = ft.fq12_unpack_mont(ft.fq12_pow_fixed(a, bits))
+    assert got == [x.pow(e) for x in vals]
+
+
+def test_fq12_one_and_select():
+    one = ft.fq12_one((2,))
+    assert ft.fq12_unpack_mont(one) == [Fq12.one()] * 2
+    a = ft.fq12_pack_mont(A12[:2])
+    sel = ft.fq12_select(np.array([True, False]), a, one)
+    assert ft.fq12_unpack_mont(sel) == [A12[0], Fq12.one()]

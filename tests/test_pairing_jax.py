@@ -1,0 +1,106 @@
+"""Differential tests: JAX batched ate pairing vs the pure-Python oracle.
+
+The JAX miller loop scales lines differently (per-line Fq2 factors and the
+w^3 twist scaling, all killed by final exponentiation), so comparisons are
+made on FINAL pairing values and on pairing-check verdicts.
+"""
+from random import Random
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from consensus_specs_tpu.crypto import curve as cv
+from consensus_specs_tpu.crypto import pairing as oracle
+from consensus_specs_tpu.crypto.fields import R
+from consensus_specs_tpu.ops import fq, fq_tower as ft, pairing_jax as pj
+
+rng = Random(0xE44)
+
+G1 = cv.g1_generator()
+G2 = cv.g2_generator()
+
+pairing_e = jax.jit(lambda xp, yp, xq, yq: pj.final_exponentiation(
+    pj.miller_loop(xp, yp, xq, yq)))
+
+
+def pack_g1_affine(points):
+    xs, ys = [], []
+    for p in points:
+        xa, ya = p.affine()
+        xs.append(xa.v)
+        ys.append(ya.v)
+    return fq.pack_mont(xs), fq.pack_mont(ys)
+
+
+def pack_g2_affine(points):
+    xs, ys = [], []
+    for p in points:
+        xa, ya = p.affine()
+        xs.append(xa)
+        ys.append(ya)
+    return ft.fq2_pack_mont(xs), ft.fq2_pack_mont(ys)
+
+
+def test_pairing_matches_oracle():
+    ks = [1, 2, rng.randrange(R)]
+    ls = [1, 3, rng.randrange(R)]
+    ps = [G1 * k for k in ks]
+    qs = [G2 * l for l in ls]
+    xp, yp = pack_g1_affine(ps)
+    xq, yq = pack_g2_affine(qs)
+    e = pairing_e(xp, yp, xq, yq)
+    got = ft.fq12_unpack_mont(e)
+    want = [oracle.pairing(p, q) for p, q in zip(ps, qs)]
+    assert got == want
+
+
+def test_bilinearity():
+    a, b = rng.randrange(R), rng.randrange(R)
+    ps = [G1 * a, G1 * (a * b % R), G1]
+    qs = [G2 * b, G2, G2 * (a * b % R)]
+    xp, yp = pack_g1_affine(ps)
+    xq, yq = pack_g2_affine(qs)
+    vals = ft.fq12_unpack_mont(pairing_e(xp, yp, xq, yq))
+    # e(aP, bQ) == e(abP, Q) == e(P, abQ)
+    assert vals[0] == vals[1] == vals[2]
+
+
+def test_pairing_check_skip_mask_matches_infinity_semantics():
+    """skip=True pairs contribute 1, matching the oracle's e(O, .) = 1."""
+    sk = rng.randrange(R)
+    H = G2 * 777
+    pk, sig = G1 * sk, H * sk
+    # pair 0 is garbage but skipped; pairs 1-2 are a valid relation
+    xp = jnp.stack([pack_g1_affine([G1, pk, -G1])[0]])
+    yp = jnp.stack([pack_g1_affine([G1, pk, -G1])[1]])
+    xq = jnp.stack([pack_g2_affine([G2 * 5, H, sig])[0]])
+    yq = jnp.stack([pack_g2_affine([G2 * 5, H, sig])[1]])
+    skip = jnp.asarray(np.array([[True, False, False]]))
+    got = list(np.asarray(pj.pairing_check_jit(xp, yp, xq, yq, skip)))
+    assert got == [True]
+
+
+def test_pairing_check_signature_relation():
+    """e(pk, H) * e(-G1, sig) == 1 for sig = sk*H — the verification shape."""
+    sk = rng.randrange(R)
+    H = G2 * rng.randrange(R)          # stand-in for hash_to_g2 output
+    pk = G1 * sk
+    sig = H * sk
+
+    # batch of 3: [valid, wrong sig, wrong pk]
+    checks = [
+        ([pk, -G1], [H, sig]),
+        ([pk, -G1], [H, sig + H]),
+        ([G1 * (sk + 1), -G1], [H, sig]),
+    ]
+    xp = jnp.stack([pack_g1_affine(c[0])[0] for c in checks])
+    yp = jnp.stack([pack_g1_affine(c[0])[1] for c in checks])
+    xq = jnp.stack([pack_g2_affine(c[1])[0] for c in checks])
+    yq = jnp.stack([pack_g2_affine(c[1])[1] for c in checks])
+
+    got = list(np.asarray(pj.pairing_check_jit(xp, yp, xq, yq)))
+    assert got == [True, False, False]
+    # oracle agreement
+    for (g1s, g2s), verdict in zip(checks, got):
+        assert oracle.pairing_check(list(zip(g1s, g2s))) == verdict
