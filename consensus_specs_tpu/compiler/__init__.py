@@ -1,0 +1,8 @@
+"""Markdown spec compiler (the reference's L2 layer).
+
+Turns markdown spec documents — fenced python blocks, constant/preset/
+config tables — into executable modules wired to the framework runtime,
+with fork-overlay merging and dependency-ordered SSZ class emission.
+"""
+from .parser import parse_markdown, parse_value, ParsedSpec  # noqa: F401
+from .builder import build_spec, emit_source, Config  # noqa: F401

@@ -1,0 +1,125 @@
+"""Spec builder: merge parsed fork docs and emit an executable module.
+
+Capability counterpart of the reference's pysetup pipeline
+(/root/reference/pysetup/helpers.py:37-273 `objects_to_spec`,
+`combine_spec_objects`, `dependency_order_class_objects` and
+setup.py:373 `build_spec`):
+
+- fork docs merge in order, newer definitions override older ones
+- SSZ container classes are emitted in field-dependency fixpoint order
+- preset vars bake in as module constants (shape-defining, compile-time)
+- config vars land in a mutable `config` namespace (runtime-swappable,
+  the reference's two-tier preset/config split)
+- the emitted source execs against our runtime (ssz types, bls shim,
+  hash) into a real module object
+"""
+from __future__ import annotations
+
+import re
+import types
+
+from .parser import ParsedSpec, parse_markdown, parse_value
+
+_HEADER = '''\
+"""GENERATED spec module — consensus_specs_tpu.compiler output."""
+from dataclasses import dataclass, field
+from consensus_specs_tpu.ssz import (
+    boolean, uint8, uint16, uint32, uint64, uint128, uint256,
+    Bitlist, Bitvector, ByteList, ByteVector, List, Vector, Container,
+    Union, Bytes1, Bytes4, Bytes8, Bytes20, Bytes31, Bytes32, Bytes48,
+    Bytes96, hash_tree_root, serialize,
+)
+from consensus_specs_tpu.utils import bls
+from consensus_specs_tpu.utils.hash import hash
+'''
+
+
+class Config(types.SimpleNamespace):
+    """Runtime-swappable config namespace."""
+
+
+def _const_rhs(expr: str) -> str:
+    """Right-hand side for a constant: simple literals collapse to their
+    value; anything referencing other names (uint64(...), 10 * BASE) is
+    emitted verbatim and evaluates in the generated module's namespace,
+    where the runtime types and earlier constants are in scope."""
+    value = parse_value(expr)
+    if isinstance(value, str) and value == expr.strip().strip("`"):
+        return value        # unresolvable here: defer to module namespace
+    return repr(value)
+
+
+def dependency_order_classes(classes: dict) -> list:
+    """Order class sources so every referenced spec class precedes its
+    users (fixpoint over referenced names, reference helpers.py:201)."""
+    names = set(classes)
+    deps = {}
+    for name, src in classes.items():
+        body = src.split("\n", 1)[1] if "\n" in src else ""
+        deps[name] = {m for m in re.findall(r"\b([A-Z]\w*)\b", body)
+                      if m in names and m != name}
+    ordered, done = [], set()
+    while len(ordered) < len(classes):
+        progress = False
+        for name in sorted(classes):
+            if name in done:
+                continue
+            if deps[name] <= done:
+                ordered.append(name)
+                done.add(name)
+                progress = True
+        if not progress:           # cycle: emit remaining alphabetically
+            for name in sorted(names - done):
+                ordered.append(name)
+                done.add(name)
+    return ordered
+
+
+def emit_source(spec: ParsedSpec, preset: dict | None = None) -> str:
+    """Assemble the module source: header, types, constants, classes,
+    functions, config."""
+    parts = [_HEADER]
+
+    for name, type_expr in spec.custom_types.items():
+        parts.append(f"{name} = {type_expr}")
+
+    preset = dict(preset or {})
+    for name, expr in spec.preset_vars.items():
+        if name in preset:
+            parts.append(f"{name} = {preset[name]!r}")
+        else:
+            parts.append(f"{name} = {_const_rhs(expr)}")
+    for name, expr in spec.constants.items():
+        parts.append(f"{name} = {_const_rhs(expr)}")
+
+    for name in dependency_order_classes(spec.classes):
+        parts.append(spec.classes[name])
+
+    for name, src in spec.functions.items():
+        parts.append(src)
+
+    cfg_items = ", ".join(
+        f"{k}={parse_value(v)!r}" for k, v in spec.config_vars.items())
+    parts.append("from consensus_specs_tpu.compiler.builder import Config")
+    parts.append(f"config = Config({cfg_items})")
+
+    return "\n\n\n".join(parts) + "\n"
+
+
+def build_spec(doc_texts: list, preset: dict | None = None,
+               module_name: str = "generated_spec"):
+    """Parse + merge fork markdown docs (oldest first) and exec the module.
+
+    Returns (module, source).
+    """
+    merged = ParsedSpec()
+    for text in doc_texts:
+        merged = parse_markdown(text).merge_over(merged)
+    source = emit_source(merged, preset)
+    module = types.ModuleType(module_name)
+    # dont_inherit: this builder's __future__ flags (stringified
+    # annotations) must not leak into the generated module — SSZ field
+    # annotations have to stay live class objects
+    exec(compile(source, f"<{module_name}>", "exec", dont_inherit=True),
+         module.__dict__)
+    return module, source

@@ -1,0 +1,141 @@
+"""Markdown spec parser: headings, fenced python blocks, constant tables.
+
+Capability counterpart of the reference's marko-based extractor
+(/root/reference/setup.py:203-341 `get_spec`), built as a small
+line-oriented GFM subset parser (no external markdown dependency):
+
+- ```python fenced blocks become functions (`def name`), SSZ container
+  classes (`class X(Container)`), or dataclasses
+- two-column tables `| Name | Value |` become constants; a table under a
+  heading containing "preset" contributes preset vars, under "config"
+  runtime config vars, otherwise plain constants
+- `<!-- skip -->` immediately before a block excludes it
+- custom-type tables `| Name | SSZ equivalent | ... |` become type aliases
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ParsedSpec:
+    functions: dict = field(default_factory=dict)     # name -> source
+    classes: dict = field(default_factory=dict)       # name -> source
+    constants: dict = field(default_factory=dict)     # name -> value expr
+    preset_vars: dict = field(default_factory=dict)
+    config_vars: dict = field(default_factory=dict)
+    custom_types: dict = field(default_factory=dict)  # name -> type expr
+
+    def merge_over(self, older: "ParsedSpec") -> "ParsedSpec":
+        """This spec layered on top of `older` (newer definitions win)."""
+        out = ParsedSpec(
+            functions={**older.functions, **self.functions},
+            classes={**older.classes, **self.classes},
+            constants={**older.constants, **self.constants},
+            preset_vars={**older.preset_vars, **self.preset_vars},
+            config_vars={**older.config_vars, **self.config_vars},
+            custom_types={**older.custom_types, **self.custom_types},
+        )
+        return out
+
+
+_NAME_RE = re.compile(r"^[A-Z][A-Z0-9_]*$")
+# anchored per-line: decorators (@dataclass etc.) may precede the keyword
+_DEF_RE = re.compile(r"^def\s+(\w+)", re.M)
+_CLASS_RE = re.compile(r"^class\s+(\w+)", re.M)
+
+
+def _table_rows(lines, start):
+    """Parse a GFM table starting at `start`; returns (rows, end_index)."""
+    rows = []
+    i = start
+    while i < len(lines) and lines[i].strip().startswith("|"):
+        cells = [c.strip() for c in lines[i].strip().strip("|").split("|")]
+        rows.append(cells)
+        i += 1
+    return rows, i
+
+
+def _is_separator_row(cells) -> bool:
+    return all(re.fullmatch(r":?-+:?", c) or c == "" for c in cells)
+
+
+def parse_markdown(text: str) -> ParsedSpec:
+    spec = ParsedSpec()
+    lines = text.split("\n")
+    heading = ""
+    skip_next = False
+    i = 0
+    while i < len(lines):
+        line = lines[i]
+        stripped = line.strip()
+
+        if stripped.startswith("#"):
+            heading = stripped.lstrip("#").strip().lower()
+            i += 1
+            continue
+
+        if stripped == "<!-- skip -->":
+            skip_next = True
+            i += 1
+            continue
+
+        if stripped.startswith("```python"):
+            j = i + 1
+            block = []
+            while j < len(lines) and not lines[j].strip().startswith("```"):
+                block.append(lines[j])
+                j += 1
+            source = "\n".join(block).rstrip()
+            if not skip_next and source:
+                m = _CLASS_RE.search(source)
+                f = _DEF_RE.search(source)
+                if m and (not f or m.start() < f.start()):
+                    spec.classes[m.group(1)] = source
+                elif f:
+                    spec.functions[f.group(1)] = source
+            skip_next = False
+            i = j + 1
+            continue
+
+        if stripped.startswith("|"):
+            rows, end = _table_rows(lines, i)
+            i = end
+            if skip_next:
+                skip_next = False
+                continue
+            if len(rows) >= 2 and _is_separator_row(rows[1]):
+                header = [h.lower() for h in rows[0]]
+                body = rows[2:]
+                if len(header) >= 2 and "ssz equivalent" in header[1]:
+                    for cells in body:
+                        if len(cells) >= 2 and cells[0]:
+                            spec.custom_types[cells[0].strip("`")] = \
+                                cells[1].strip("`")
+                elif len(header) >= 2 and header[0] == "name":
+                    target = spec.constants
+                    if "preset" in heading:
+                        target = spec.preset_vars
+                    elif "config" in heading:
+                        target = spec.config_vars
+                    for cells in body:
+                        if len(cells) < 2:
+                            continue
+                        name = cells[0].strip("`")
+                        if _NAME_RE.match(name):
+                            target[name] = cells[1].strip("`")
+            continue
+
+        i += 1
+    return spec
+
+
+def parse_value(expr: str):
+    """Evaluate a constant cell: ints (any base, `2**n`, `10 * SOME`),
+    hex byte strings, quoted strings."""
+    expr = expr.strip().strip("`")
+    try:
+        return eval(expr, {"__builtins__": {}}, {})  # noqa: S307 - spec
+    except Exception:
+        return expr
