@@ -44,10 +44,9 @@ class BaseSpec:
         return cache[key]
 
     def is_post(self, fork_name: str) -> bool:
-        """True if this spec is at or after the given fork."""
-        order = ["phase0", "altair", "bellatrix", "capella", "deneb",
-                 "electra", "fulu", "eip7732", "whisk", "eip6800"]
-        mro_forks = [c.fork for c in type(self).__mro__ if hasattr(c, "fork")]
-        return fork_name in mro_forks or (
-            self.fork in order and fork_name in order
-            and order.index(self.fork) >= order.index(fork_name))
+        """True if this spec builds on the given fork (MRO ancestry — the
+        linear mainline order would misclassify feature forks like whisk,
+        which branches off capella)."""
+        mro_forks = [c.fork for c in type(self).__mro__
+                     if hasattr(c, "fork")]
+        return fork_name in mro_forks

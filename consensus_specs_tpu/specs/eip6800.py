@@ -1,0 +1,85 @@
+"""EIP-6800 (verkle trees) spec: stateless execution witnesses.
+
+From-scratch implementation of
+/root/reference/specs/_features/eip6800/beacon-chain.md as a DenebSpec
+subclass: the execution payload carries an ExecutionWitness (verkle state
+diff + IPA multiproof containers) and the payload header commits to its
+root.  Witness *verification* happens in the execution layer; consensus
+carries and merkleizes the structures.
+"""
+from ..ssz import (
+    uint64, Union, Vector, List, Container, ByteList, Bytes1, Bytes31,
+    Bytes32, hash_tree_root,
+)
+from .deneb import DenebSpec
+
+
+class Eip6800Spec(DenebSpec):
+    fork = "eip6800"
+
+    def _build_types(self) -> None:
+        super()._build_types()
+        p = self
+
+        # custom types (eip6800/beacon-chain.md:37-43)
+        self.BanderwagonGroupElement = Bytes32
+        self.BanderwagonFieldElement = Bytes32
+        self.Stem = Bytes31
+
+        class SuffixStateDiff(Container):
+            suffix: Bytes1
+            # None = not currently present / value not updated
+            current_value: Union[None, Bytes32]
+            new_value: Union[None, Bytes32]
+
+        class StemStateDiff(Container):
+            stem: p.Stem
+            suffix_diffs: List[SuffixStateDiff, p.VERKLE_WIDTH]
+
+        class IPAProof(Container):
+            cl: Vector[p.BanderwagonGroupElement, p.IPA_PROOF_DEPTH]
+            cr: Vector[p.BanderwagonGroupElement, p.IPA_PROOF_DEPTH]
+            final_evaluation: p.BanderwagonFieldElement
+
+        class VerkleProof(Container):
+            other_stems: List[Bytes31, p.MAX_STEMS]
+            depth_extension_present: ByteList[p.MAX_STEMS]
+            commitments_by_path: List[
+                p.BanderwagonGroupElement,
+                p.MAX_STEMS * p.MAX_COMMITMENTS_PER_STEM]
+            d: p.BanderwagonGroupElement
+            ipa_proof: IPAProof
+
+        class ExecutionWitness(Container):
+            state_diff: List[StemStateDiff, p.MAX_STEMS]
+            verkle_proof: VerkleProof
+
+        # extended containers: appended/overridden fields via annotation
+        # inheritance (ssz/types.py Container.__init_subclass__)
+        class ExecutionPayload(p.ExecutionPayload):
+            execution_witness: ExecutionWitness      # [New in EIP6800]
+
+        class ExecutionPayloadHeader(p.ExecutionPayloadHeader):
+            execution_witness_root: Bytes32          # [New in EIP6800]
+
+        class BeaconBlockBody(p.BeaconBlockBody):
+            execution_payload: ExecutionPayload      # [Modified]
+
+        class BeaconBlock(p.BeaconBlock):
+            body: BeaconBlockBody
+
+        class SignedBeaconBlock(p.SignedBeaconBlock):
+            message: BeaconBlock
+
+        class BeaconState(p.BeaconState):
+            latest_execution_payload_header: ExecutionPayloadHeader
+
+        for name, cls in list(locals().items()):
+            if isinstance(cls, type) and issubclass(cls, Container):
+                setattr(self, name, cls)
+
+    def build_execution_payload_header(self, payload):
+        header = super().build_execution_payload_header(payload)
+        header.execution_witness_root = hash_tree_root(
+            payload.execution_witness)              # [New in EIP6800]
+        return header

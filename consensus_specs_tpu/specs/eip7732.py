@@ -1,0 +1,475 @@
+"""EIP-7732 (ePBS) spec: enshrined proposer-builder separation.
+
+From-scratch implementation of
+/root/reference/specs/_features/eip7732/beacon-chain.md as an ElectraSpec
+subclass: the block commits to a builder's signed bid
+(SignedExecutionPayloadHeader); the payload arrives separately as a
+SignedExecutionPayloadEnvelope verified by process_execution_payload; a
+payload-timeliness committee (PTC) attests presence/withholding and
+process_payload_attestation rewards or punishes accordingly.
+"""
+from ..ssz import (
+    uint8, uint64, boolean, Bitvector, Vector, List, Container, Bytes4,
+    Bytes32, Bytes48, Bytes96, hash_tree_root,
+)
+from .electra import ElectraSpec, NewPayloadRequest
+from ..utils import bls
+
+
+class Eip7732Spec(ElectraSpec):
+    fork = "eip7732"
+
+    # ------------------------------------------------------------------
+    # constants (eip7732/beacon-chain.md:75-105)
+    # ------------------------------------------------------------------
+    def _build_constants(self) -> None:
+        super()._build_constants()
+        self.PAYLOAD_ABSENT = uint8(0)
+        self.PAYLOAD_PRESENT = uint8(1)
+        self.PAYLOAD_WITHHELD = uint8(2)
+        self.PAYLOAD_INVALID_STATUS = uint8(3)
+        self.DOMAIN_BEACON_BUILDER = bytes.fromhex("1b000000")
+        self.DOMAIN_PTC_ATTESTER = bytes.fromhex("0c000000")
+
+    # ------------------------------------------------------------------
+    # containers (eip7732/beacon-chain.md:107-280)
+    # ------------------------------------------------------------------
+    def _build_types(self) -> None:
+        super()._build_types()
+        p = self
+
+        class PayloadAttestationData(Container):
+            beacon_block_root: Bytes32
+            slot: uint64
+            payload_status: uint8
+
+        class PayloadAttestation(Container):
+            aggregation_bits: Bitvector[p.PTC_SIZE]
+            data: PayloadAttestationData
+            signature: Bytes96
+
+        class PayloadAttestationMessage(Container):
+            validator_index: uint64
+            data: PayloadAttestationData
+            signature: Bytes96
+
+        class IndexedPayloadAttestation(Container):
+            attesting_indices: List[uint64, p.PTC_SIZE]
+            data: PayloadAttestationData
+            signature: Bytes96
+
+        # the bid: only the commitment data, not the full payload
+        class ExecutionPayloadHeader(Container):
+            parent_block_hash: Bytes32
+            parent_block_root: Bytes32
+            block_hash: Bytes32
+            gas_limit: uint64
+            builder_index: uint64
+            slot: uint64
+            value: uint64
+            blob_kzg_commitments_root: Bytes32
+
+        class SignedExecutionPayloadHeader(Container):
+            message: ExecutionPayloadHeader
+            signature: Bytes96
+
+        class ExecutionPayloadEnvelope(Container):
+            payload: p.ExecutionPayload
+            execution_requests: p.ExecutionRequests
+            builder_index: uint64
+            beacon_block_root: Bytes32
+            blob_kzg_commitments: List[Bytes48,
+                                       p.MAX_BLOB_COMMITMENTS_PER_BLOCK]
+            payload_withheld: boolean
+            state_root: Bytes32
+
+        class SignedExecutionPayloadEnvelope(Container):
+            message: ExecutionPayloadEnvelope
+            signature: Bytes96
+
+        class BeaconBlockBody(Container):
+            randao_reveal: Bytes96
+            eth1_data: p.Eth1Data
+            graffiti: Bytes32
+            proposer_slashings: List[p.ProposerSlashing,
+                                     p.MAX_PROPOSER_SLASHINGS]
+            attester_slashings: List[p.AttesterSlashing,
+                                     p.MAX_ATTESTER_SLASHINGS_ELECTRA]
+            attestations: List[p.Attestation, p.MAX_ATTESTATIONS_ELECTRA]
+            deposits: List[p.Deposit, p.MAX_DEPOSITS]
+            voluntary_exits: List[p.SignedVoluntaryExit,
+                                  p.MAX_VOLUNTARY_EXITS]
+            sync_aggregate: p.SyncAggregate
+            bls_to_execution_changes: List[p.SignedBLSToExecutionChange,
+                                           p.MAX_BLS_TO_EXECUTION_CHANGES]
+            # PBS: payload removed, bid + PTC votes added
+            signed_execution_payload_header: SignedExecutionPayloadHeader
+            payload_attestations: List[PayloadAttestation,
+                                       p.MAX_PAYLOAD_ATTESTATIONS]
+
+        class BeaconBlock(Container):
+            slot: uint64
+            proposer_index: uint64
+            parent_root: Bytes32
+            state_root: Bytes32
+            body: BeaconBlockBody
+
+        class SignedBeaconBlock(Container):
+            message: BeaconBlock
+            signature: Bytes96
+
+        electra_state = self.BeaconState
+
+        class BeaconState(Container):
+            genesis_time: uint64
+            genesis_validators_root: Bytes32
+            slot: uint64
+            fork: p.Fork
+            latest_block_header: p.BeaconBlockHeader
+            block_roots: Vector[Bytes32, p.SLOTS_PER_HISTORICAL_ROOT]
+            state_roots: Vector[Bytes32, p.SLOTS_PER_HISTORICAL_ROOT]
+            historical_roots: List[Bytes32, p.HISTORICAL_ROOTS_LIMIT]
+            eth1_data: p.Eth1Data
+            eth1_data_votes: List[p.Eth1Data,
+                                  p.EPOCHS_PER_ETH1_VOTING_PERIOD
+                                  * p.SLOTS_PER_EPOCH]
+            eth1_deposit_index: uint64
+            validators: List[p.Validator, p.VALIDATOR_REGISTRY_LIMIT]
+            balances: List[uint64, p.VALIDATOR_REGISTRY_LIMIT]
+            randao_mixes: Vector[Bytes32, p.EPOCHS_PER_HISTORICAL_VECTOR]
+            slashings: Vector[uint64, p.EPOCHS_PER_SLASHINGS_VECTOR]
+            previous_epoch_participation: List[uint8,
+                                               p.VALIDATOR_REGISTRY_LIMIT]
+            current_epoch_participation: List[uint8,
+                                              p.VALIDATOR_REGISTRY_LIMIT]
+            justification_bits: Bitvector[p.JUSTIFICATION_BITS_LENGTH]
+            previous_justified_checkpoint: p.Checkpoint
+            current_justified_checkpoint: p.Checkpoint
+            finalized_checkpoint: p.Checkpoint
+            inactivity_scores: List[uint64, p.VALIDATOR_REGISTRY_LIMIT]
+            current_sync_committee: p.SyncCommittee
+            next_sync_committee: p.SyncCommittee
+            latest_execution_payload_header: ExecutionPayloadHeader
+            next_withdrawal_index: uint64
+            next_withdrawal_validator_index: uint64
+            historical_summaries: List[p.HistoricalSummary,
+                                       p.HISTORICAL_ROOTS_LIMIT]
+            deposit_requests_start_index: uint64
+            deposit_balance_to_consume: uint64
+            exit_balance_to_consume: uint64
+            earliest_exit_epoch: uint64
+            consolidation_balance_to_consume: uint64
+            earliest_consolidation_epoch: uint64
+            pending_deposits: List[p.PendingDeposit,
+                                   p.PENDING_DEPOSITS_LIMIT]
+            pending_partial_withdrawals: List[
+                p.PendingPartialWithdrawal,
+                p.PENDING_PARTIAL_WITHDRAWALS_LIMIT]
+            pending_consolidations: List[p.PendingConsolidation,
+                                         p.PENDING_CONSOLIDATIONS_LIMIT]
+            # PBS
+            latest_block_hash: Bytes32
+            latest_full_slot: uint64
+            latest_withdrawals_root: Bytes32
+
+        del electra_state
+        for name, cls in list(locals().items()):
+            if isinstance(cls, type) and issubclass(cls, Container):
+                setattr(self, name, cls)
+
+    # ------------------------------------------------------------------
+    # helpers (eip7732/beacon-chain.md:282-417)
+    # ------------------------------------------------------------------
+    def bit_floor(self, n: int) -> int:
+        if n == 0:
+            return uint64(0)
+        return uint64(1 << (int(n).bit_length() - 1))
+
+    def remove_flag(self, flags, flag_index):
+        flag = uint8(2 ** flag_index)
+        return flags & ~flag & 0xFF
+
+    def is_parent_block_full(self, state) -> bool:
+        return state.latest_execution_payload_header.block_hash \
+            == state.latest_block_hash
+
+    def get_ptc(self, state, slot):
+        """Payload-timeliness committee for `slot` (beacon-chain.md:350)."""
+        epoch = self.compute_epoch_at_slot(slot)
+        committees_per_slot = self.bit_floor(min(
+            self.get_committee_count_per_slot(state, epoch), self.PTC_SIZE))
+        members_per_committee = self.PTC_SIZE // committees_per_slot
+        validator_indices = []
+        for idx in range(committees_per_slot):
+            beacon_committee = self.get_beacon_committee(state, slot, idx)
+            validator_indices += list(
+                beacon_committee)[:members_per_committee]
+        return validator_indices
+
+    def get_attesting_indices(self, state, attestation):
+        """[Modified] PTC members' votes are ignored."""
+        output = super().get_attesting_indices(state, attestation)
+        ptc = set(int(i) for i in
+                  self.get_ptc(state, attestation.data.slot))
+        return set(i for i in output if int(i) not in ptc)
+
+    def get_payload_attesting_indices(self, state, slot,
+                                      payload_attestation):
+        ptc = self.get_ptc(state, slot)
+        return set(index for i, index in enumerate(ptc)
+                   if payload_attestation.aggregation_bits[i])
+
+    def get_indexed_payload_attestation(self, state, slot,
+                                        payload_attestation):
+        attesting_indices = self.get_payload_attesting_indices(
+            state, slot, payload_attestation)
+        return self.IndexedPayloadAttestation(
+            attesting_indices=sorted(int(i) for i in attesting_indices),
+            data=payload_attestation.data,
+            signature=payload_attestation.signature)
+
+    def is_valid_indexed_payload_attestation(
+            self, state, indexed_payload_attestation) -> bool:
+        if indexed_payload_attestation.data.payload_status \
+                >= self.PAYLOAD_INVALID_STATUS:
+            return False
+        indices = [int(i) for i in
+                   indexed_payload_attestation.attesting_indices]
+        if len(indices) == 0 or indices != sorted(set(indices)):
+            return False
+        pubkeys = [state.validators[i].pubkey for i in indices]
+        domain = self.get_domain(state, self.DOMAIN_PTC_ATTESTER, None)
+        signing_root = self.compute_signing_root(
+            indexed_payload_attestation.data, domain)
+        return bls.FastAggregateVerify(
+            pubkeys, signing_root, indexed_payload_attestation.signature)
+
+    # ------------------------------------------------------------------
+    # block processing (eip7732/beacon-chain.md:427-600)
+    # ------------------------------------------------------------------
+    def process_block(self, state, block) -> None:
+        self.process_block_header(state, block)
+        self.process_withdrawals(state)                  # [Modified]
+        self.process_execution_payload_header(state, block)   # [New]
+        self.process_randao(state, block.body)
+        self.process_eth1_data(state, block.body)
+        self.process_operations(state, block.body)       # [Modified]
+        self.process_sync_aggregate(state, block.body.sync_aggregate)
+
+    def process_withdrawals(self, state) -> None:
+        """[Modified] deterministic from state alone; payload honors the
+        recorded latest_withdrawals_root later."""
+        if not self.is_parent_block_full(state):
+            return
+        withdrawals, processed_partial_withdrawals_count = \
+            self.get_expected_withdrawals(state)
+        withdrawals_list = List[self.Withdrawal,
+                                self.MAX_WITHDRAWALS_PER_PAYLOAD](
+            withdrawals)
+        state.latest_withdrawals_root = hash_tree_root(withdrawals_list)
+        for withdrawal in withdrawals:
+            self.decrease_balance(state, withdrawal.validator_index,
+                                  withdrawal.amount)
+        state.pending_partial_withdrawals = \
+            type(state.pending_partial_withdrawals)(
+                list(state.pending_partial_withdrawals)[
+                    processed_partial_withdrawals_count:])
+        if len(withdrawals) != 0:
+            state.next_withdrawal_index = uint64(
+                withdrawals[-1].index + 1)
+        if len(withdrawals) == self.MAX_WITHDRAWALS_PER_PAYLOAD:
+            next_validator_index = uint64(
+                (withdrawals[-1].validator_index + 1)
+                % len(state.validators))
+        else:
+            next_index = (int(state.next_withdrawal_validator_index)
+                          + self.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP)
+            next_validator_index = uint64(
+                next_index % len(state.validators))
+        state.next_withdrawal_validator_index = next_validator_index
+
+    def verify_execution_payload_header_signature(self, state,
+                                                  signed_header) -> bool:
+        builder = state.validators[signed_header.message.builder_index]
+        signing_root = self.compute_signing_root(
+            signed_header.message,
+            self.get_domain(state, self.DOMAIN_BEACON_BUILDER))
+        return bls.Verify(builder.pubkey, signing_root,
+                          signed_header.signature)
+
+    def process_execution_payload_header(self, state, block) -> None:
+        signed_header = block.body.signed_execution_payload_header
+        assert self.verify_execution_payload_header_signature(
+            state, signed_header)
+        header = signed_header.message
+        builder_index = header.builder_index
+        builder = state.validators[builder_index]
+        assert self.is_active_validator(builder,
+                                        self.get_current_epoch(state))
+        assert not builder.slashed
+        amount = header.value
+        assert state.balances[builder_index] >= amount
+        assert header.slot == block.slot
+        assert header.parent_block_hash == state.latest_block_hash
+        assert header.parent_block_root == block.parent_root
+        self.decrease_balance(state, builder_index, amount)
+        self.increase_balance(state, block.proposer_index, amount)
+        state.latest_execution_payload_header = header
+
+    def process_operations(self, state, body) -> None:
+        """[Modified] payload attestations join; execution-request ops
+        move into the envelope."""
+        assert len(body.deposits) == min(
+            self.MAX_DEPOSITS,
+            int(state.eth1_data.deposit_count)
+            - int(state.eth1_deposit_index))
+        for operation in body.proposer_slashings:
+            self.process_proposer_slashing(state, operation)
+        for operation in body.attester_slashings:
+            self.process_attester_slashing(state, operation)
+        for operation in body.attestations:
+            self.process_attestation(state, operation)
+        for operation in body.deposits:
+            self.process_deposit(state, operation)
+        for operation in body.voluntary_exits:
+            self.process_voluntary_exit(state, operation)
+        for operation in body.bls_to_execution_changes:
+            self.process_bls_to_execution_change(state, operation)
+        for operation in body.payload_attestations:          # [New]
+            self.process_payload_attestation(state, operation)
+
+    def process_payload_attestation(self, state,
+                                    payload_attestation) -> None:
+        data = payload_attestation.data
+        assert data.beacon_block_root == state.latest_block_header.parent_root
+        assert data.slot + 1 == state.slot
+
+        indexed = self.get_indexed_payload_attestation(
+            state, data.slot, payload_attestation)
+        assert self.is_valid_indexed_payload_attestation(state, indexed)
+
+        if state.slot % self.SLOTS_PER_EPOCH == 0:
+            epoch_participation = state.previous_epoch_participation
+        else:
+            epoch_participation = state.current_epoch_participation
+
+        payload_was_present = data.slot == state.latest_full_slot
+        voted_present = data.payload_status == self.PAYLOAD_PRESENT
+        proposer_reward_denominator = (
+            (int(self.WEIGHT_DENOMINATOR) - int(self.PROPOSER_WEIGHT))
+            * int(self.WEIGHT_DENOMINATOR) // int(self.PROPOSER_WEIGHT))
+        proposer_index = self.get_beacon_proposer_index(state)
+        if voted_present != payload_was_present:
+            proposer_penalty_numerator = 0
+            for index in indexed.attesting_indices:
+                for flag_index, weight in enumerate(
+                        self.PARTICIPATION_FLAG_WEIGHTS):
+                    if self.has_flag(epoch_participation[index],
+                                     flag_index):
+                        epoch_participation[index] = self.remove_flag(
+                            epoch_participation[index], flag_index)
+                        proposer_penalty_numerator += int(
+                            self.get_base_reward(state, index)) * int(weight)
+            proposer_penalty = 2 * proposer_penalty_numerator \
+                // proposer_reward_denominator
+            self.decrease_balance(state, proposer_index, proposer_penalty)
+            return
+
+        proposer_reward_numerator = 0
+        for index in indexed.attesting_indices:
+            for flag_index, weight in enumerate(
+                    self.PARTICIPATION_FLAG_WEIGHTS):
+                if not self.has_flag(epoch_participation[index], flag_index):
+                    epoch_participation[index] = self.add_flag(
+                        epoch_participation[index], flag_index)
+                    proposer_reward_numerator += int(
+                        self.get_base_reward(state, index)) * int(weight)
+        proposer_reward = proposer_reward_numerator \
+            // proposer_reward_denominator
+        self.increase_balance(state, proposer_index, proposer_reward)
+
+    def is_merge_transition_complete(self, state) -> bool:
+        header = self.ExecutionPayloadHeader()
+        kzgs = List[Bytes48, self.MAX_BLOB_COMMITMENTS_PER_BLOCK]()
+        header.blob_kzg_commitments_root = hash_tree_root(kzgs)
+        return state.latest_execution_payload_header != header
+
+    # ------------------------------------------------------------------
+    # execution payload processing (eip7732/beacon-chain.md:644-727)
+    # ------------------------------------------------------------------
+    def verify_execution_payload_envelope_signature(
+            self, state, signed_envelope) -> bool:
+        builder = state.validators[signed_envelope.message.builder_index]
+        signing_root = self.compute_signing_root(
+            signed_envelope.message,
+            self.get_domain(state, self.DOMAIN_BEACON_BUILDER))
+        return bls.Verify(builder.pubkey, signing_root,
+                          signed_envelope.signature)
+
+    def process_execution_payload(self, state, signed_envelope,
+                                  execution_engine=None,
+                                  verify: bool = True) -> None:
+        """[Modified] independent transition step fed by the builder's
+        envelope, not part of process_block."""
+        if execution_engine is None:
+            execution_engine = self.EXECUTION_ENGINE
+        if verify:
+            assert self.verify_execution_payload_envelope_signature(
+                state, signed_envelope)
+        envelope = signed_envelope.message
+        payload = envelope.payload
+
+        previous_state_root = hash_tree_root(state)
+        if state.latest_block_header.state_root == Bytes32():
+            state.latest_block_header.state_root = previous_state_root
+
+        assert envelope.beacon_block_root == hash_tree_root(
+            state.latest_block_header)
+        committed_header = state.latest_execution_payload_header
+        assert envelope.builder_index == committed_header.builder_index
+        assert committed_header.blob_kzg_commitments_root == \
+            hash_tree_root(envelope.blob_kzg_commitments)
+
+        if not envelope.payload_withheld:
+            assert hash_tree_root(payload.withdrawals) == \
+                state.latest_withdrawals_root
+            assert committed_header.gas_limit == payload.gas_limit
+            assert committed_header.block_hash == payload.block_hash
+            assert payload.parent_hash == state.latest_block_hash
+            assert payload.prev_randao == self.get_randao_mix(
+                state, self.get_current_epoch(state))
+            assert payload.timestamp == self.compute_timestamp_at_slot(
+                state, state.slot)
+            assert len(envelope.blob_kzg_commitments) <= \
+                self.max_blobs_per_block()
+            versioned_hashes = [
+                self.kzg_commitment_to_versioned_hash(c)
+                for c in envelope.blob_kzg_commitments]
+            requests = envelope.execution_requests
+            assert execution_engine.verify_and_notify_new_payload(
+                NewPayloadRequest(
+                    execution_payload=payload,
+                    versioned_hashes=versioned_hashes,
+                    parent_beacon_block_root=(
+                        state.latest_block_header.parent_root),
+                    execution_requests=requests))
+
+            for operation in requests.deposits:
+                self.process_deposit_request(state, operation)
+            for operation in requests.withdrawals:
+                self.process_withdrawal_request(state, operation)
+            for operation in requests.consolidations:
+                self.process_consolidation_request(state, operation)
+
+            state.latest_block_hash = payload.block_hash
+            state.latest_full_slot = state.slot
+
+        if verify:
+            assert envelope.state_root == hash_tree_root(state)
+
+    # ------------------------------------------------------------------
+    # fork upgrade
+    # ------------------------------------------------------------------
+    def genesis_fork_versions(self):
+        return (Bytes4(self.config.ELECTRA_FORK_VERSION),
+                Bytes4(self.config.EIP7732_FORK_VERSION))

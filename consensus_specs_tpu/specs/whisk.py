@@ -1,0 +1,267 @@
+"""Whisk (single secret leader election) spec.
+
+From-scratch implementation of
+/root/reference/specs/_features/whisk/beacon-chain.md as a CapellaSpec
+subclass: candidate/proposer tracker selection each shuffling phase,
+per-block tracker shuffles with shuffle proofs, first-proposal tracker
+registration, and opening-proof-gated block headers (the proposer-index
+equality check is dropped — identity stays secret until proposal).
+
+Proof verification is our own scheme (crypto/whisk_proofs.py) behind the
+same IsValidWhiskShuffleProof / IsValidWhiskOpeningProof interface the
+reference gets from the external curdleproofs package.
+"""
+from ..ssz import (
+    uint64, Vector, List, Container, ByteList, Bytes32, Bytes48, Bytes96,
+    hash_tree_root,
+)
+from ..crypto import whisk_proofs
+from ..utils import bls
+from .capella import CapellaSpec
+from .phase0 import bytes_to_uint64
+
+
+class WhiskSpec(CapellaSpec):
+    fork = "whisk"
+
+    # ------------------------------------------------------------------
+    # constants (whisk/beacon-chain.md:39-103)
+    # ------------------------------------------------------------------
+    def _build_constants(self) -> None:
+        super()._build_constants()
+        self.DOMAIN_WHISK_CANDIDATE_SELECTION = bytes.fromhex("07000000")
+        self.DOMAIN_WHISK_SHUFFLE = bytes.fromhex("07100000")
+        self.DOMAIN_WHISK_PROPOSER_SELECTION = bytes.fromhex("07200000")
+        self.BLS_G1_GENERATOR = bls.G1_to_bytes48(bls.G1())
+        self.BLS_MODULUS = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+
+    def _build_types(self) -> None:
+        super()._build_types()
+        p = self
+
+        self.WhiskShuffleProof = ByteList[p.WHISK_MAX_SHUFFLE_PROOF_SIZE]
+        self.WhiskTrackerProof = ByteList[p.WHISK_MAX_OPENING_PROOF_SIZE]
+
+        class WhiskTracker(Container):
+            r_G: Bytes48    # r * G
+            k_r_G: Bytes48  # k * r * G
+
+        class BeaconBlockBody(p.BeaconBlockBody):
+            whisk_opening_proof: p.WhiskTrackerProof
+            whisk_post_shuffle_trackers: Vector[
+                WhiskTracker, p.WHISK_VALIDATORS_PER_SHUFFLE]
+            whisk_shuffle_proof: p.WhiskShuffleProof
+            whisk_registration_proof: p.WhiskTrackerProof
+            whisk_tracker: WhiskTracker
+            whisk_k_commitment: Bytes48
+
+        class BeaconBlock(p.BeaconBlock):
+            body: BeaconBlockBody
+
+        class SignedBeaconBlock(p.SignedBeaconBlock):
+            message: BeaconBlock
+
+        class BeaconState(p.BeaconState):
+            whisk_candidate_trackers: Vector[
+                WhiskTracker, p.WHISK_CANDIDATE_TRACKERS_COUNT]
+            whisk_proposer_trackers: Vector[
+                WhiskTracker, p.WHISK_PROPOSER_TRACKERS_COUNT]
+            whisk_trackers: List[WhiskTracker, p.VALIDATOR_REGISTRY_LIMIT]
+            whisk_k_commitments: List[Bytes48, p.VALIDATOR_REGISTRY_LIMIT]
+
+        for name, cls in list(locals().items()):
+            if isinstance(cls, type) and issubclass(cls, Container):
+                setattr(self, name, cls)
+
+    # ------------------------------------------------------------------
+    # cryptography interface (whisk/beacon-chain.md:86-128)
+    # ------------------------------------------------------------------
+    def BLSG1ScalarMultiply(self, scalar, point):
+        return bls.G1_to_bytes48(
+            bls.multiply(bls.bytes48_to_G1(point), int(scalar)))
+
+    def bytes_to_bls_field(self, b) -> int:
+        return int.from_bytes(bytes(b), "little") % self.BLS_MODULUS
+
+    def IsValidWhiskShuffleProof(self, pre_shuffle_trackers,
+                                 post_shuffle_trackers,
+                                 shuffle_proof) -> bool:
+        pre = [(bytes(t.r_G), bytes(t.k_r_G))
+               for t in pre_shuffle_trackers]
+        post = [(bytes(t.r_G), bytes(t.k_r_G))
+                for t in post_shuffle_trackers]
+        return whisk_proofs.verify_shuffle(pre, post, bytes(shuffle_proof))
+
+    def IsValidWhiskOpeningProof(self, tracker, k_commitment,
+                                 tracker_proof) -> bool:
+        return whisk_proofs.verify_opening(
+            bytes(tracker.r_G), bytes(tracker.k_r_G),
+            bytes(k_commitment), bytes(tracker_proof))
+
+    # ------------------------------------------------------------------
+    # epoch processing (whisk/beacon-chain.md:137-239)
+    # ------------------------------------------------------------------
+    def select_whisk_proposer_trackers(self, state, epoch) -> None:
+        proposer_seed = self.get_seed(
+            state,
+            max(int(epoch) - int(self.config.WHISK_PROPOSER_SELECTION_GAP),
+                0),
+            self.DOMAIN_WHISK_PROPOSER_SELECTION)
+        for i in range(self.WHISK_PROPOSER_TRACKERS_COUNT):
+            index = self.compute_shuffled_index(
+                i, len(state.whisk_candidate_trackers), proposer_seed)
+            state.whisk_proposer_trackers[i] = \
+                state.whisk_candidate_trackers[index]
+
+    def select_whisk_candidate_trackers(self, state, epoch) -> None:
+        active_validator_indices = self.get_active_validator_indices(
+            state, epoch)
+        from ..utils.hash import hash as sha256
+        for i in range(self.WHISK_CANDIDATE_TRACKERS_COUNT):
+            seed = sha256(self.get_seed(
+                state, epoch, self.DOMAIN_WHISK_CANDIDATE_SELECTION)
+                + int(i).to_bytes(8, "little"))
+            candidate_index = self.compute_proposer_index(
+                state, active_validator_indices, seed)
+            state.whisk_candidate_trackers[i] = \
+                state.whisk_trackers[candidate_index]
+
+    def process_whisk_updates(self, state) -> None:
+        next_epoch = self.get_current_epoch(state) + 1
+        if next_epoch % self.config.WHISK_EPOCHS_PER_SHUFFLING_PHASE == 0:
+            self.select_whisk_proposer_trackers(state, next_epoch)
+            self.select_whisk_candidate_trackers(state, next_epoch)
+
+    def process_epoch(self, state) -> None:
+        super().process_epoch(state)
+        self.process_whisk_updates(state)   # [New in Whisk]
+
+    # ------------------------------------------------------------------
+    # block processing (whisk/beacon-chain.md:243-380)
+    # ------------------------------------------------------------------
+    def process_whisk_opening_proof(self, state, block) -> None:
+        tracker = state.whisk_proposer_trackers[
+            int(state.slot) % self.WHISK_PROPOSER_TRACKERS_COUNT]
+        k_commitment = state.whisk_k_commitments[block.proposer_index]
+        assert self.IsValidWhiskOpeningProof(
+            tracker, k_commitment, block.body.whisk_opening_proof)
+
+    def process_block_header(self, state, block) -> None:
+        """[Modified] proposer-index equality dropped; opening proof
+        gates proposal instead."""
+        assert block.slot == state.slot
+        assert block.slot > state.latest_block_header.slot
+        assert block.parent_root == hash_tree_root(
+            state.latest_block_header)
+        state.latest_block_header = self.BeaconBlockHeader(
+            slot=block.slot,
+            proposer_index=block.proposer_index,
+            parent_root=block.parent_root,
+            state_root=Bytes32(),
+            body_root=hash_tree_root(block.body))
+        proposer = state.validators[block.proposer_index]
+        assert not proposer.slashed
+        self.process_whisk_opening_proof(state, block)   # [New in Whisk]
+
+    def get_shuffle_indices(self, randao_reveal):
+        indices = []
+        from ..utils.hash import hash as sha256
+        for i in range(self.WHISK_VALIDATORS_PER_SHUFFLE):
+            pre_image = bytes(randao_reveal) + int(i).to_bytes(8, "little")
+            indices.append(bytes_to_uint64(sha256(pre_image)[:8])
+                           % self.WHISK_CANDIDATE_TRACKERS_COUNT)
+        return indices
+
+    def process_shuffled_trackers(self, state, body) -> None:
+        shuffle_epoch = self.get_current_epoch(state) \
+            % self.config.WHISK_EPOCHS_PER_SHUFFLING_PHASE
+
+        cooldown = shuffle_epoch \
+            + self.config.WHISK_PROPOSER_SELECTION_GAP + 1 \
+            >= self.config.WHISK_EPOCHS_PER_SHUFFLING_PHASE
+        if cooldown:
+            # trackers must be zeroed during cooldown
+            empty = Vector[self.WhiskTracker,
+                           self.WHISK_VALIDATORS_PER_SHUFFLE]()
+            assert body.whisk_post_shuffle_trackers == empty
+            assert bytes(body.whisk_shuffle_proof) == b""
+        else:
+            shuffle_indices = self.get_shuffle_indices(body.randao_reveal)
+            pre_shuffle_trackers = [state.whisk_candidate_trackers[i]
+                                    for i in shuffle_indices]
+            assert self.IsValidWhiskShuffleProof(
+                pre_shuffle_trackers,
+                body.whisk_post_shuffle_trackers,
+                body.whisk_shuffle_proof)
+            for i, shuffle_index in enumerate(shuffle_indices):
+                state.whisk_candidate_trackers[shuffle_index] = \
+                    body.whisk_post_shuffle_trackers[i]
+
+    def is_k_commitment_unique(self, state, k_commitment) -> bool:
+        return all(bytes(c) != bytes(k_commitment)
+                   for c in state.whisk_k_commitments)
+
+    def process_whisk_registration(self, state, body) -> None:
+        proposer_index = self.get_beacon_proposer_index(state)
+        if bytes(state.whisk_trackers[proposer_index].r_G) == \
+                bytes(self.BLS_G1_GENERATOR):      # first proposal
+            assert bytes(body.whisk_tracker.r_G) != \
+                bytes(self.BLS_G1_GENERATOR)
+            assert self.is_k_commitment_unique(state,
+                                               body.whisk_k_commitment)
+            assert self.IsValidWhiskOpeningProof(
+                body.whisk_tracker, body.whisk_k_commitment,
+                body.whisk_registration_proof)
+            state.whisk_trackers[proposer_index] = body.whisk_tracker
+            state.whisk_k_commitments[proposer_index] = \
+                body.whisk_k_commitment
+        else:                                       # later proposals
+            assert bytes(body.whisk_registration_proof) == b""
+            assert body.whisk_tracker == self.WhiskTracker()
+            assert bytes(body.whisk_k_commitment) == bytes(Bytes48())
+
+    def process_block(self, state, block) -> None:
+        super().process_block(state, block)
+        self.process_shuffled_trackers(state, block.body)
+        self.process_whisk_registration(state, block.body)
+
+    # ------------------------------------------------------------------
+    # deposits (whisk/beacon-chain.md:382-430)
+    # ------------------------------------------------------------------
+    def get_initial_whisk_k(self, validator_index, counter) -> int:
+        from ..utils.hash import hash as sha256
+        return self.bytes_to_bls_field(sha256(
+            int(validator_index).to_bytes(8, "little")
+            + int(counter).to_bytes(8, "little")))
+
+    def get_unique_whisk_k(self, state, validator_index) -> int:
+        counter = 0
+        while True:
+            k = self.get_initial_whisk_k(validator_index, counter)
+            if self.is_k_commitment_unique(
+                    state, self.BLSG1ScalarMultiply(
+                        k, self.BLS_G1_GENERATOR)):
+                return k
+            counter += 1
+
+    def get_k_commitment(self, k) -> bytes:
+        return self.BLSG1ScalarMultiply(k, self.BLS_G1_GENERATOR)
+
+    def get_initial_tracker(self, k):
+        return self.WhiskTracker(
+            r_G=self.BLS_G1_GENERATOR,
+            k_r_G=self.BLSG1ScalarMultiply(k, self.BLS_G1_GENERATOR))
+
+    def add_validator_to_registry(self, state, pubkey,
+                                  withdrawal_credentials, amount) -> None:
+        super().add_validator_to_registry(
+            state, pubkey, withdrawal_credentials, amount)
+        k = self.get_unique_whisk_k(state, len(state.validators) - 1)
+        state.whisk_trackers.append(self.get_initial_tracker(k))
+        state.whisk_k_commitments.append(self.get_k_commitment(k))
+
+    def get_beacon_proposer_index(self, state):
+        """[Modified] proposer is whoever opened the tracker — read from
+        the header cached by process_block_header."""
+        assert state.latest_block_header.slot == state.slot
+        return state.latest_block_header.proposer_index

@@ -80,7 +80,17 @@ def create_genesis_state(spec, validator_balances, activation_threshold=None):
         state.current_sync_committee = spec.get_next_sync_committee(state)
         state.next_sync_committee = spec.get_next_sync_committee(state)
 
-    if spec.is_post("bellatrix"):
+    if spec.fork == "eip7732":
+        # ePBS: the header is a builder bid; genesis commits to an empty
+        # kzg list, the last full slot is genesis itself
+        empty_kzgs = spec.ExecutionPayloadEnvelope.fields()[
+            "blob_kzg_commitments"]()
+        state.latest_execution_payload_header.blob_kzg_commitments_root = \
+            hash_tree_root(empty_kzgs)
+        state.latest_execution_payload_header.block_hash = eth1_block_hash
+        state.latest_block_hash = eth1_block_hash
+        state.latest_full_slot = spec.GENESIS_SLOT
+    elif spec.is_post("bellatrix"):
         # post-bellatrix mock genesis is post-merge: sample payload header
         state.latest_execution_payload_header = \
             sample_genesis_execution_payload_header(spec, eth1_block_hash)
@@ -88,6 +98,17 @@ def create_genesis_state(spec, validator_balances, activation_threshold=None):
     if spec.is_post("electra"):
         state.deposit_requests_start_index = \
             spec.UNSET_DEPOSIT_REQUESTS_START_INDEX
+
+    if spec.fork == "whisk":
+        # mirror the whisk fork upgrade: initial per-validator trackers +
+        # two candidate selections and one proposer selection
+        for i in range(len(validator_balances)):
+            k = spec.get_unique_whisk_k(state, i)
+            state.whisk_trackers.append(spec.get_initial_tracker(k))
+            state.whisk_k_commitments.append(spec.get_k_commitment(k))
+        epoch = spec.GENESIS_EPOCH
+        spec.select_whisk_candidate_trackers(state, epoch)
+        spec.select_whisk_proposer_trackers(state, epoch)
 
     return state
 

@@ -1,0 +1,330 @@
+"""_features forks: whisk (SSLE), eip7732 (ePBS), eip6800 (verkle)."""
+import pytest
+
+from consensus_specs_tpu.crypto import whisk_proofs
+from consensus_specs_tpu.specs import get_spec
+from consensus_specs_tpu.ssz import Vector, hash_tree_root, uint64
+from consensus_specs_tpu.test_infra import disable_bls
+from consensus_specs_tpu.test_infra.genesis import (
+    create_genesis_state, default_balances)
+from consensus_specs_tpu.test_infra.blocks import (
+    build_empty_block_for_next_slot, transition_to)
+from consensus_specs_tpu.utils import bls
+
+
+# ---------------------------------------------------------------------------
+# whisk proof system
+# ---------------------------------------------------------------------------
+
+def test_whisk_opening_proof_roundtrip():
+    G = bls.G1_to_bytes48(bls.G1())
+    k, r, t = 1234567, 424242, 987654321
+    r_G = bls.G1_to_bytes48(bls.multiply(bls.G1(), r))
+    k_r_G = bls.G1_to_bytes48(bls.multiply(bls.bytes48_to_G1(r_G), k))
+    k_commitment = bls.G1_to_bytes48(bls.multiply(bls.G1(), k))
+    proof = whisk_proofs.prove_opening(r_G, k, t)
+    assert whisk_proofs.verify_opening(r_G, k_r_G, k_commitment, proof)
+    # wrong k_commitment rejected
+    bad = bls.G1_to_bytes48(bls.multiply(bls.G1(), k + 1))
+    assert not whisk_proofs.verify_opening(r_G, k_r_G, bad, proof)
+    assert not whisk_proofs.verify_opening(r_G, k_r_G, k_commitment,
+                                           b"\x00" * 128)
+
+
+def test_whisk_shuffle_proof_roundtrip():
+    G1 = bls.G1()
+    pre = []
+    for i in range(4):
+        r, k = 100 + i, 7 + i
+        r_G = bls.multiply(G1, r)
+        pre.append((bls.G1_to_bytes48(r_G),
+                    bls.G1_to_bytes48(bls.multiply(r_G, k))))
+    perm = [2, 0, 3, 1]
+    rers = [11, 22, 33, 44]
+    post, proof = whisk_proofs.prove_shuffle(pre, perm, rers)
+    assert whisk_proofs.verify_shuffle(pre, post, proof)
+    # tampered post tracker rejected
+    bad_post = list(post)
+    bad_post[0] = (post[1][0], post[0][1])
+    assert not whisk_proofs.verify_shuffle(pre, bad_post, proof)
+    assert not whisk_proofs.verify_shuffle(pre, post, proof[:-1])
+
+
+# ---------------------------------------------------------------------------
+# whisk spec
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def wspec():
+    return get_spec("whisk", "minimal")
+
+
+@pytest.fixture(scope="module")
+def wstate(wspec):
+    with disable_bls():
+        return create_genesis_state(wspec, default_balances(wspec))
+
+
+def test_whisk_genesis_trackers(wspec, wstate):
+    n = len(wstate.validators)
+    assert len(wstate.whisk_trackers) == n
+    assert len(wstate.whisk_k_commitments) == n
+    # initial trackers use the generator as r_G
+    assert bytes(wstate.whisk_trackers[0].r_G) == \
+        bytes(wspec.BLS_G1_GENERATOR)
+    # proposer trackers were selected from candidates
+    assert any(bytes(t.k_r_G) != bytes(wspec.WhiskTracker().k_r_G)
+               for t in wstate.whisk_proposer_trackers)
+
+
+def test_whisk_opening_proof_gates_header(wspec, wstate):
+    state = wstate.copy()
+    slot = int(state.slot) + 1
+
+    # find the k that opens the proposer tracker for `slot`
+    tracker = state.whisk_proposer_trackers[
+        slot % wspec.WHISK_PROPOSER_TRACKERS_COUNT]
+    k_by_commitment = {}
+    for i in range(len(state.validators)):
+        k = wspec.get_initial_whisk_k(i, 0)
+        assert bytes(wspec.get_k_commitment(k)) == \
+            bytes(state.whisk_k_commitments[i])  # counter-0 k, no collision
+        k_by_commitment[bytes(state.whisk_k_commitments[i])] = (i, k)
+    # tracker is initial: k_r_G == k * G == commitment
+    proposer_index, k = k_by_commitment[bytes(tracker.k_r_G)]
+
+    with disable_bls():
+        wspec.process_slots(state, slot)
+    block = wspec.BeaconBlock(
+        slot=slot, proposer_index=proposer_index,
+        parent_root=hash_tree_root(state.latest_block_header),
+        body=wspec.BeaconBlockBody())
+    block.body.whisk_opening_proof = whisk_proofs.prove_opening(
+        bytes(tracker.r_G), k, t=777)
+    wspec.process_block_header(state, block)
+    assert wspec.get_beacon_proposer_index(state) == proposer_index
+
+    # a wrong-k proof must fail
+    state2 = wstate.copy()
+    with disable_bls():
+        wspec.process_slots(state2, slot)
+    bad = wspec.BeaconBlock(
+        slot=slot, proposer_index=proposer_index,
+        parent_root=hash_tree_root(state2.latest_block_header),
+        body=wspec.BeaconBlockBody())
+    bad.body.whisk_opening_proof = whisk_proofs.prove_opening(
+        bytes(tracker.r_G), k + 1, t=777)
+    with pytest.raises(AssertionError):
+        wspec.process_block_header(state2, bad)
+
+
+def test_whisk_shuffled_trackers_processing(wspec, wstate):
+    state = wstate.copy()
+    body = wspec.BeaconBlockBody()
+    body.randao_reveal = b"\x5b" * 96
+
+    indices = wspec.get_shuffle_indices(body.randao_reveal)
+    assert len(indices) == wspec.WHISK_VALIDATORS_PER_SHUFFLE
+    pre = [(bytes(state.whisk_candidate_trackers[i].r_G),
+            bytes(state.whisk_candidate_trackers[i].k_r_G))
+           for i in indices]
+    perm = list(range(len(indices)))[::-1]
+    rers = [5 + i for i in range(len(indices))]
+    post, proof = whisk_proofs.prove_shuffle(pre, perm, rers)
+    body.whisk_post_shuffle_trackers = Vector[
+        wspec.WhiskTracker, wspec.WHISK_VALIDATORS_PER_SHUFFLE](
+        [wspec.WhiskTracker(r_G=p0, k_r_G=p1) for p0, p1 in post])
+    body.whisk_shuffle_proof = proof
+
+    wspec.process_shuffled_trackers(state, body)
+    assert bytes(state.whisk_candidate_trackers[indices[0]].r_G) == post[0][0]
+
+    # invalid proof rejected
+    state2 = wstate.copy()
+    body.whisk_shuffle_proof = proof[:-4] + b"\x00\x00\x00\x00"
+    with pytest.raises(AssertionError):
+        wspec.process_shuffled_trackers(state2, body)
+
+
+def test_whisk_registration(wspec, wstate):
+    state = wstate.copy()
+    # fake a processed header so get_beacon_proposer_index works
+    state.latest_block_header.slot = state.slot
+    state.latest_block_header.proposer_index = 3
+
+    body = wspec.BeaconBlockBody()
+    k_new, r_new = 999999, 31337
+    r_G = bls.G1_to_bytes48(bls.multiply(bls.G1(), r_new))
+    tracker = wspec.WhiskTracker(
+        r_G=r_G,
+        k_r_G=bls.G1_to_bytes48(
+            bls.multiply(bls.bytes48_to_G1(r_G), k_new)))
+    body.whisk_tracker = tracker
+    body.whisk_k_commitment = wspec.get_k_commitment(k_new)
+    body.whisk_registration_proof = whisk_proofs.prove_opening(
+        r_G, k_new, t=4242)
+    wspec.process_whisk_registration(state, body)
+    assert bytes(state.whisk_trackers[3].r_G) == bytes(r_G)
+
+    # second registration attempt must now present empty fields
+    body2 = wspec.BeaconBlockBody()
+    wspec.process_whisk_registration(state, body2)  # no-op path
+    with pytest.raises(AssertionError):
+        body3 = wspec.BeaconBlockBody()
+        body3.whisk_tracker = tracker  # non-empty on later proposal
+        wspec.process_whisk_registration(state, body3)
+
+
+# ---------------------------------------------------------------------------
+# eip7732 (ePBS)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def pspec():
+    return get_spec("eip7732", "minimal")
+
+
+def test_eip7732_bid_and_envelope_flow(pspec):
+    with disable_bls():
+        state = create_genesis_state(pspec, default_balances(pspec))
+        slot = int(state.slot) + 1
+        pspec.process_slots(state, slot)
+
+        builder_index = 1
+        bid = pspec.ExecutionPayloadHeader(
+            parent_block_hash=state.latest_block_hash,
+            parent_block_root=hash_tree_root(state.latest_block_header),
+            block_hash=b"\x0b" * 32,
+            gas_limit=30_000_000,
+            builder_index=builder_index,
+            slot=slot,
+            value=1_000_000,
+            blob_kzg_commitments_root=hash_tree_root(
+                pspec.ExecutionPayloadEnvelope.fields()[
+                    "blob_kzg_commitments"]()))
+        block = pspec.BeaconBlock(
+            slot=slot,
+            proposer_index=pspec.get_beacon_proposer_index(state),
+            parent_root=hash_tree_root(state.latest_block_header),
+            body=pspec.BeaconBlockBody(
+                signed_execution_payload_header=(
+                    pspec.SignedExecutionPayloadHeader(message=bid))))
+
+        # bid transfer in isolation: value moves builder -> proposer
+        probe_state = state.copy()
+        balances_before = (int(probe_state.balances[builder_index]),
+                           int(probe_state.balances[block.proposer_index]))
+        pspec.process_execution_payload_header(probe_state, block)
+        assert int(probe_state.balances[builder_index]) == \
+            balances_before[0] - 1_000_000
+        assert int(probe_state.balances[block.proposer_index]) == \
+            balances_before[1] + 1_000_000
+
+        pspec.process_block(state, block)
+        assert state.latest_execution_payload_header == bid
+        # the proposer sets state_root to the post-block state root; the
+        # envelope's beacon_block_root then matches the filled-in header
+        block.state_root = hash_tree_root(state)
+
+        # build and process the payload envelope
+        payload = pspec.ExecutionPayload(
+            parent_hash=state.latest_block_hash,
+            block_hash=b"\x0b" * 32,
+            gas_limit=30_000_000,
+            prev_randao=pspec.get_randao_mix(
+                state, pspec.get_current_epoch(state)),
+            timestamp=pspec.compute_timestamp_at_slot(state, state.slot))
+        envelope = pspec.ExecutionPayloadEnvelope(
+            payload=payload,
+            builder_index=builder_index,
+            beacon_block_root=hash_tree_root(block),
+            payload_withheld=False)
+        # state root: compute on a copy first
+        probe = state.copy()
+        pspec.process_execution_payload(
+            probe, pspec.SignedExecutionPayloadEnvelope(message=envelope),
+            verify=False)
+        envelope.state_root = hash_tree_root(probe)
+        pspec.process_execution_payload(
+            state, pspec.SignedExecutionPayloadEnvelope(message=envelope))
+        assert state.latest_block_hash == b"\x0b" * 32
+        assert int(state.latest_full_slot) == slot
+
+
+def test_eip7732_ptc_and_payload_attestation(pspec):
+    with disable_bls():
+        state = create_genesis_state(pspec, default_balances(pspec))
+        transition_to(pspec, state, int(state.slot) + 2)
+
+        ptc = pspec.get_ptc(state, int(state.slot) - 1)
+        assert len(ptc) == pspec.PTC_SIZE
+
+        # PTC votes are excluded from regular attestation credit
+        att_slot = int(state.slot) - 1
+        # fake latest header for proposer lookup
+        state.latest_block_header.slot = state.slot
+
+        bits = [True] * int(pspec.PTC_SIZE)
+        att = pspec.PayloadAttestation(
+            aggregation_bits=bits,
+            data=pspec.PayloadAttestationData(
+                beacon_block_root=state.latest_block_header.parent_root,
+                slot=att_slot,
+                payload_status=pspec.PAYLOAD_ABSENT))
+        # payload was NOT full at att_slot, vote says absent: correct
+        pspec.process_payload_attestation(state, att)
+
+        # invalid payload status rejected
+        att_bad = pspec.PayloadAttestation(
+            aggregation_bits=bits,
+            data=pspec.PayloadAttestationData(
+                beacon_block_root=state.latest_block_header.parent_root,
+                slot=att_slot,
+                payload_status=pspec.PAYLOAD_INVALID_STATUS))
+        with pytest.raises(AssertionError):
+            pspec.process_payload_attestation(state, att_bad)
+
+
+def test_eip7732_withdrawals_deterministic(pspec):
+    with disable_bls():
+        state = create_genesis_state(pspec, default_balances(pspec))
+        # parent full at genesis: withdrawals sweep runs and records root
+        assert pspec.is_parent_block_full(state)
+        pspec.process_withdrawals(state)
+        assert state.latest_withdrawals_root == hash_tree_root(
+            pspec.ExecutionPayload.fields()["withdrawals"]())
+
+
+# ---------------------------------------------------------------------------
+# eip6800 (verkle)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def vspec():
+    return get_spec("eip6800", "minimal")
+
+
+def test_eip6800_witness_containers_roundtrip(vspec):
+    wit = vspec.ExecutionWitness(
+        state_diff=[vspec.StemStateDiff(
+            stem=b"\x01" * 31,
+            suffix_diffs=[vspec.SuffixStateDiff(
+                suffix=b"\x07",
+                current_value=vspec.SuffixStateDiff.fields()
+                ["current_value"](1, b"\x22" * 32),
+                new_value=vspec.SuffixStateDiff.fields()
+                ["new_value"](0, None))])])
+    data = wit.serialize()
+    back = vspec.ExecutionWitness.deserialize(data)
+    assert hash_tree_root(back) == hash_tree_root(wit)
+
+
+def test_eip6800_payload_carries_witness(vspec):
+    from consensus_specs_tpu.test_infra.blocks import apply_empty_block
+    with disable_bls():
+        state = create_genesis_state(vspec, default_balances(vspec))
+        signed = apply_empty_block(vspec, state)
+    payload = signed.message.body.execution_payload
+    assert hasattr(payload, "execution_witness")
+    assert state.latest_execution_payload_header.execution_witness_root \
+        == hash_tree_root(payload.execution_witness)
