@@ -1,23 +1,96 @@
-"""Benchmark driver: TPU merkleization vs CPU-oracle baseline.
+"""Benchmark driver: batched TPU BLS attestation verification.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Round-1 flagship workload: SSZ merkle root of a mainnet-scale chunk tree
-(2^20 chunks = 32 MiB ≈ the BeaconState validator-registry subtree at ~1M
-validators, SURVEY.md §6).  The baseline is the pure-Python/hashlib oracle
-(our stand-in for the reference's remerkleable merkleization, which is also
-hashlib-per-node underneath).  Later rounds extend this to full epoch
-state_transition with BLS on (BASELINE.md north star).
+Flagship workload (BASELINE.md norths star / config #3 shape): a block's
+worth of FastAggregateVerify jobs — N_ATT attestations, each over a
+COMMITTEE-sized pubkey set with a distinct message — verified end-to-end:
+host aggregation + hash-to-field/SSWU, device batched cofactor clearing,
+Miller loops and shared final exponentiations (ops/bls_tpu.py).
+
+Baseline: the pure-Python oracle (crypto/bls12_381.FastAggregateVerify),
+the stand-in for the reference's py_ecc backend
+(/root/reference/tests/core/pyspec/eth2spec/utils/bls.py:87-124), measured
+on BASE_SAMPLE jobs and scaled.
+
+`python bench.py merkle` runs the previous SSZ-merkleization benchmark.
 """
 import json
+import os
 import sys
 import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(os.path.dirname(__file__) or ".",
+                                   "tests", ".jax_cache"))
 
 import numpy as np
 
 
+N_ATT = 64          # attestations per batch
+COMMITTEE = 128     # pubkeys per attestation (mainnet target size)
+BASE_SAMPLE = 3     # oracle jobs to time for the baseline estimate
+
+
+def _build_workload():
+    from consensus_specs_tpu.crypto import curve as cv
+    from consensus_specs_tpu.crypto.fields import R
+    from consensus_specs_tpu.crypto.hash_to_curve import hash_to_g2
+
+    g1 = cv.g1_generator()
+    # committee pubkeys as decompressed points (the spec's pubkey cache)
+    sks = [(i * 6364136223846793005 + 1442695040888963407) % R or 1
+           for i in range(COMMITTEE)]
+    pk_points = [g1 * sk for sk in sks]
+    agg_sk = sum(sks) % R
+
+    messages, sigs = [], []
+    for i in range(N_ATT):
+        msg = i.to_bytes(8, "little") + b"\x5a" * 24
+        messages.append(msg)
+        sigs.append(hash_to_g2(msg) * agg_sk)
+    return pk_points, messages, sigs
+
+
+def bench_attestations():
+    from consensus_specs_tpu.ops import bls_tpu
+
+    pk_points, messages, sigs = _build_workload()
+    pk_lists = [pk_points] * N_ATT
+
+    # warm-up at the FULL batch shape — the kernels pad the batch axis to
+    # powers of two, so a smaller warm-up would leave the timed run paying
+    # the multi-minute XLA compile for the (N_ATT, ...) shapes
+    warm = bls_tpu.fast_aggregate_verify_batch(pk_lists, messages, sigs)
+    assert all(warm), "warm-up verification failed"
+
+    t0 = time.perf_counter()
+    verdicts = bls_tpu.fast_aggregate_verify_batch(pk_lists, messages, sigs)
+    tpu_time = time.perf_counter() - t0
+    assert all(verdicts), "benchmark verification failed"
+
+    # oracle baseline on a sample, scaled
+    from consensus_specs_tpu.crypto import bls12_381 as native
+    from consensus_specs_tpu.crypto import curve as cv
+    sig_bytes = [cv.g2_to_bytes(s) for s in sigs[:BASE_SAMPLE]]
+    pk_bytes = [cv.g1_to_bytes(p) for p in pk_points]
+    t0 = time.perf_counter()
+    for i in range(BASE_SAMPLE):
+        assert native.FastAggregateVerify(pk_bytes, messages[i],
+                                          sig_bytes[i])
+    base_time = (time.perf_counter() - t0) / BASE_SAMPLE * N_ATT
+
+    return {
+        "metric": "fast_aggregate_verify_attestations_per_sec",
+        "value": round(N_ATT / tpu_time, 2),
+        "unit": f"attestations/s (committee={COMMITTEE})",
+        "vs_baseline": round(base_time / tpu_time, 2),
+    }
+
+
 def bench_merkle(depth: int = 20, sample_baseline_depth: int = 14):
     import jax
+    import jax.numpy as jnp
     from consensus_specs_tpu.ops import sha256 as ops_sha
     from consensus_specs_tpu.ssz.merkle import merkleize_chunks
 
@@ -26,9 +99,8 @@ def bench_merkle(depth: int = 20, sample_baseline_depth: int = 14):
     words = rng.integers(0, 2**32, size=(n, 8), dtype=np.uint32)
     chunks_bytes = words.astype(">u4").tobytes()
 
-    # --- TPU path: device-resident level sweep -------------------------
-    dev_words = jax.device_put(jnp_asarray(words))
-    root_dev = ops_sha.merkle_tree_root(dev_words, depth)  # compile+warm
+    dev_words = jax.device_put(jnp.asarray(words))
+    root_dev = ops_sha.merkle_tree_root(dev_words, depth)
     jax.block_until_ready(root_dev)
     t0 = time.perf_counter()
     iters = 5
@@ -37,18 +109,16 @@ def bench_merkle(depth: int = 20, sample_baseline_depth: int = 14):
     jax.block_until_ready(root_dev)
     tpu_time = (time.perf_counter() - t0) / iters
 
-    # --- CPU oracle baseline (hashlib), measured on a subtree ----------
     m = 1 << sample_baseline_depth
     sub_chunks = [chunks_bytes[i * 32:(i + 1) * 32] for i in range(m)]
     t0 = time.perf_counter()
     cpu_root_sub = merkleize_chunks(sub_chunks)
     cpu_time = (time.perf_counter() - t0) * (n / m)
 
-    # correctness cross-check on the subtree
     sub_root_dev = ops_sha.merkle_root_jax(chunks_bytes[: m * 32])
     assert sub_root_dev == cpu_root_sub, "TPU/CPU merkle roots disagree"
 
-    total_hashes = 2 * n - 1  # 2-to-1 hashes in the tree (incl. pad levels)
+    total_hashes = 2 * n - 1
     return {
         "metric": "ssz_merkle_root_1M_chunks_hashes_per_sec",
         "value": round(total_hashes / tpu_time, 1),
@@ -57,12 +127,8 @@ def bench_merkle(depth: int = 20, sample_baseline_depth: int = 14):
     }
 
 
-def jnp_asarray(x):
-    import jax.numpy as jnp
-    return jnp.asarray(x)
-
-
 if __name__ == "__main__":
-    result = bench_merkle()
+    which = sys.argv[1] if len(sys.argv) > 1 else "attestations"
+    result = bench_merkle() if which == "merkle" else bench_attestations()
     print(json.dumps(result))
     sys.stdout.flush()

@@ -13,6 +13,8 @@ format.
 """
 from __future__ import annotations
 
+from .. import native as _native
+
 # ---------------------------------------------------------------------------
 # CRC-32C (Castagnoli), reflected polynomial 0x82F63B78
 # ---------------------------------------------------------------------------
@@ -31,6 +33,8 @@ _CRC_TABLE = _make_crc32c_table()
 
 
 def crc32c(data: bytes) -> int:
+    if _native.available():
+        return _native.crc32c(data)
     c = 0xFFFFFFFF
     for b in data:
         c = _CRC_TABLE[(c ^ b) & 0xFF] ^ (c >> 8)
@@ -65,7 +69,11 @@ def _emit_literal(out: bytearray, data: bytes, start: int, end: int) -> None:
 
 
 def compress_block(data: bytes) -> bytes:
-    """Greedy hash-table LZ: copy-2 elements (2-byte offset, len 4..64)."""
+    """Greedy hash-table LZ: copy-2 elements (2-byte offset, len 4..64).
+
+    Routed through the native C++ tier when built (same format)."""
+    if _native.available():
+        return _native.snappy_compress_block(data)
     n = len(data)
     out = bytearray()
     # preamble: uncompressed length varint
@@ -104,20 +112,34 @@ def compress_block(data: bytes) -> bytes:
     return bytes(out)
 
 
-def decompress_block(data: bytes) -> bytes:
-    # preamble varint
+_MAX_BLOCK_OUT = 1 << 31      # sanity cap on the declared output size
+
+
+def _parse_preamble(data: bytes):
     n = 0
     shift = 0
     pos = 0
     while True:
         if pos >= len(data):
             raise ValueError("truncated snappy preamble")
+        if shift > 35:
+            raise ValueError("oversized snappy preamble varint")
         b = data[pos]
         pos += 1
         n |= (b & 0x7F) << shift
         shift += 7
         if not b & 0x80:
             break
+    if n > _MAX_BLOCK_OUT:
+        raise ValueError("snappy block declares unreasonable output size")
+    return n, pos
+
+
+def decompress_block(data: bytes) -> bytes:
+    if _native.available():
+        expect, _ = _parse_preamble(data)
+        return _native.snappy_decompress_block(data, expect)
+    n, pos = _parse_preamble(data)
     out = bytearray()
     while pos < len(data):
         tag = data[pos]

@@ -1,0 +1,71 @@
+"""ctypes bindings for the native host tier (native/libconsensus_native.so).
+
+The framework's counterpart of the reference's C-backed host packages
+(milagro / python-snappy / pycryptodome, SURVEY.md §2.2).  Everything here
+degrades gracefully: `available()` is False when the library isn't built
+and callers keep their pure-Python paths.
+
+Build with: python scripts/build_native.py
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+_LIB_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "native", "libconsensus_native.so")
+
+_lib = None
+if os.path.exists(_LIB_PATH):
+    try:
+        _lib = ctypes.CDLL(_LIB_PATH)
+        _lib.sha256_2to1_batch.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t]
+        _lib.crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        _lib.crc32c.restype = ctypes.c_uint32
+        _lib.snappy_max_compressed.argtypes = [ctypes.c_size_t]
+        _lib.snappy_max_compressed.restype = ctypes.c_size_t
+        _lib.snappy_compress_block.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p]
+        _lib.snappy_compress_block.restype = ctypes.c_size_t
+        _lib.snappy_decompress_block.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+            ctypes.c_size_t, ctypes.POINTER(ctypes.c_size_t)]
+        _lib.snappy_decompress_block.restype = ctypes.c_int
+    except OSError:
+        _lib = None
+
+
+def available() -> bool:
+    return _lib is not None
+
+
+def sha256_2to1_batch(data: bytes) -> bytes:
+    """n 64-byte blocks -> n 32-byte digests."""
+    assert len(data) % 64 == 0
+    n = len(data) // 64
+    out = ctypes.create_string_buffer(32 * n)
+    _lib.sha256_2to1_batch(data, out, n)
+    return out.raw
+
+
+def crc32c(data: bytes) -> int:
+    return int(_lib.crc32c(bytes(data), len(data)))
+
+
+def snappy_compress_block(data: bytes) -> bytes:
+    cap = _lib.snappy_max_compressed(len(data))
+    out = ctypes.create_string_buffer(cap)
+    n = _lib.snappy_compress_block(bytes(data), len(data), out)
+    return out.raw[:n]
+
+
+def snappy_decompress_block(data: bytes, max_out: int) -> bytes:
+    out = ctypes.create_string_buffer(max_out)
+    out_len = ctypes.c_size_t(0)
+    rc = _lib.snappy_decompress_block(bytes(data), len(data), out,
+                                      max_out, ctypes.byref(out_len))
+    if rc != 0:
+        raise ValueError(f"malformed snappy block (native rc={rc})")
+    return out.raw[:out_len.value]

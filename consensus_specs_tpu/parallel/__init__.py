@@ -1,0 +1,12 @@
+"""Distributed layer: device meshes and XLA-collective reductions.
+
+The TPU-native communication backend (SURVEY.md §2.6): where the
+reference's scale-out is process pools over CPU cores, this framework
+shards its data-parallel axes — validators, merkle chunks, G1 point sets,
+generator cases — over a jax.sharding.Mesh and reduces with lax
+collectives (psum / all_gather) riding ICI.  Host-level fan-out across
+DCN stays at the generator layer (scripts/gen_vectors.py --shard).
+"""
+from .mesh import get_mesh, device_count  # noqa: F401
+from .collectives import (  # noqa: F401
+    make_balance_total, make_merkle_root, make_g1_sum, shard_array)

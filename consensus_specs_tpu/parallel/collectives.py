@@ -1,0 +1,103 @@
+"""Sharded reductions over the device mesh.
+
+Three reduction shapes cover the framework's hot paths (SURVEY.md §2.6):
+
+* sharded_balance_total — the epoch-processing scalar reduction
+  (get_total_active_balance and friends): local sum + psum.
+* sharded_merkle_root — hash_tree_root over a chunk tree sharded on the
+  leaf axis: local subtree sweep, all_gather of subtree roots, replicated
+  top sweep (the BeaconState merkleization layout).
+* sharded_g1_sum — aggregate-pubkey / MSM-partial reduction: each device
+  tree-sums its shard of G1 points, partial sums are all_gathered and the
+  small replicated tail is tree-added.  G1 addition is the reduction op
+  the ICI ring carries for big-batch BLS aggregation.
+
+All functions are shard_map bodies over a 1-D mesh axis "data"; callers
+jit them via `make_*` builders that close over the mesh.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import curve_jax as cj
+from ..ops.sha256 import sha256_64byte
+
+
+AXIS = "data"
+
+
+# ---------------------------------------------------------------------------
+# balances
+# ---------------------------------------------------------------------------
+
+def sharded_balance_total(local_balances):
+    """Body: sum the local balance shard, psum across the mesh."""
+    return jax.lax.psum(jnp.sum(local_balances), AXIS)
+
+
+def make_balance_total(mesh: Mesh):
+    return jax.jit(jax.shard_map(
+        sharded_balance_total, mesh=mesh,
+        in_specs=P(AXIS), out_specs=P(), check_vma=False))
+
+
+# ---------------------------------------------------------------------------
+# merkle
+# ---------------------------------------------------------------------------
+
+def _tree_levels(level, depth: int):
+    for _ in range(depth):
+        n = level.shape[0] // 2
+        level = sha256_64byte(level.reshape(n, 16))
+    return level
+
+
+def sharded_merkle_root(local_chunks, local_depth: int):
+    """Body: local subtree root, all_gather, replicated top sweep."""
+    local_root = _tree_levels(local_chunks, local_depth)     # [1, 8]
+    roots = jax.lax.all_gather(local_root.reshape(8), AXIS)  # [n_dev, 8]
+    top_depth = int(np.log2(roots.shape[0]))
+    return _tree_levels(roots, top_depth)[0]
+
+
+def make_merkle_root(mesh: Mesh, chunks_per_device: int):
+    local_depth = int(np.log2(chunks_per_device))
+    return jax.jit(jax.shard_map(
+        partial(sharded_merkle_root, local_depth=local_depth), mesh=mesh,
+        in_specs=P(AXIS, None), out_specs=P(), check_vma=False))
+
+
+# ---------------------------------------------------------------------------
+# G1 point-set reduction
+# ---------------------------------------------------------------------------
+
+def sharded_g1_sum(X, Y, Z):
+    """Body: tree-sum the local shard of Jacobian points, all_gather the
+    per-device partials, tree-add the replicated tail."""
+    lx, ly, lz = cj.point_sum_tree(cj.F1, (X, Y, Z))
+    gx = jax.lax.all_gather(lx, AXIS)        # [n_dev, 32]
+    gy = jax.lax.all_gather(ly, AXIS)
+    gz = jax.lax.all_gather(lz, AXIS)
+    return cj.point_sum_tree(cj.F1, (gx, gy, gz))
+
+
+def make_g1_sum(mesh: Mesh):
+    return jax.jit(jax.shard_map(
+        sharded_g1_sum, mesh=mesh,
+        in_specs=(P(AXIS, None),) * 3, out_specs=(P(),) * 3,
+        check_vma=False))
+
+
+# ---------------------------------------------------------------------------
+# device placement helper
+# ---------------------------------------------------------------------------
+
+def shard_array(mesh: Mesh, arr, spec=None):
+    if spec is None:
+        spec = P(AXIS) if np.ndim(arr) == 1 else P(AXIS, *([None] * (np.ndim(arr) - 1)))
+    return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, spec))

@@ -65,10 +65,17 @@ def _native():
     return n
 
 
+def _tpu():
+    from ..ops import bls_tpu as t
+    return t
+
+
 # --- signature API (reference: bls.py:141-221) -----------------------------
 
 @only_with_bls(alt_return=True)
 def Verify(PK, message, signature):
+    if _backend_name == "tpu":
+        return _tpu().Verify(bytes(PK), bytes(message), bytes(signature))
     n = _native()  # backend import errors must surface, not read as "invalid"
     try:
         return n.Verify(bytes(PK), bytes(message), bytes(signature))
@@ -78,6 +85,10 @@ def Verify(PK, message, signature):
 
 @only_with_bls(alt_return=True)
 def AggregateVerify(pubkeys, messages, signature):
+    if _backend_name == "tpu":
+        return _tpu().AggregateVerify(
+            [bytes(pk) for pk in pubkeys],
+            [bytes(m) for m in messages], bytes(signature))
     n = _native()
     try:
         return n.AggregateVerify(
@@ -89,12 +100,56 @@ def AggregateVerify(pubkeys, messages, signature):
 
 @only_with_bls(alt_return=True)
 def FastAggregateVerify(pubkeys, message, signature):
+    if _backend_name == "tpu":
+        return _tpu().FastAggregateVerify(
+            [bytes(pk) for pk in pubkeys], bytes(message), bytes(signature))
     n = _native()
     try:
         return n.FastAggregateVerify(
             [bytes(pk) for pk in pubkeys], bytes(message), bytes(signature))
     except ValueError:
         return False
+
+
+# --- batched verification (TPU-native extension; one device dispatch for a
+# block's worth of signature checks) ----------------------------------------
+
+def _pk_bytes(pk):
+    """Batch APIs accept compressed bytes or decompressed curve Points
+    (the pubkey-cache shape); normalize for the byte-level native suite."""
+    if hasattr(pk, "is_infinity"):
+        return _native().G1_to_bytes48(pk)
+    return bytes(pk)
+
+
+def _sig_bytes(sig):
+    if hasattr(sig, "is_infinity"):
+        return _native().G2_to_bytes96(sig)
+    return bytes(sig)
+
+
+def FastAggregateVerifyBatch(pubkey_lists, messages, signatures):
+    """Verdict list for many FastAggregateVerify jobs.  On the tpu backend
+    all pairings run as one batched kernel; native falls back per-job.
+    With bls disabled every job reads as valid, matching the scalar API's
+    stub-True contract."""
+    if not bls_active:
+        return [True] * len(pubkey_lists)
+    if _backend_name == "tpu":
+        return _tpu().fast_aggregate_verify_batch(
+            pubkey_lists, messages, signatures)
+    return [FastAggregateVerify([_pk_bytes(pk) for pk in pks], m,
+                                _sig_bytes(s))
+            for pks, m, s in zip(pubkey_lists, messages, signatures)]
+
+
+def VerifyBatch(pubkeys, messages, signatures):
+    if not bls_active:
+        return [True] * len(pubkeys)
+    if _backend_name == "tpu":
+        return _tpu().verify_batch(pubkeys, messages, signatures)
+    return [Verify(_pk_bytes(pk), m, _sig_bytes(s))
+            for pk, m, s in zip(pubkeys, messages, signatures)]
 
 
 @only_with_bls(alt_return=STUB_SIGNATURE)
@@ -140,6 +195,8 @@ def multi_exp(points, integers):
 
 
 def pairing_check(values) -> bool:
+    if _backend_name == "tpu":
+        return _tpu().pairing_check_points(values)
     return _native().pairing_check(values)
 
 

@@ -1,0 +1,238 @@
+// Native host tier for consensus_specs_tpu.
+//
+// The reference leans on C/Rust packages for its host-side hot loops
+// (milagro BLS, python-snappy, pycryptodome — SURVEY.md §2.2).  This
+// library is the framework's equivalent: batched SHA-256 two-to-one
+// compression (host merkleization fallback), CRC-32C, and snappy block
+// codec (test-vector IO), exposed with a C ABI for ctypes.
+//
+// Build: scripts/build_native.py (plain g++ -O3 -shared -fPIC).
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// SHA-256 (FIPS 180-4)
+// ---------------------------------------------------------------------------
+
+const uint32_t K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+inline uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+void sha256_compress(uint32_t state[8], const uint8_t block[64]) {
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++)
+        w[i] = (uint32_t(block[4 * i]) << 24) | (uint32_t(block[4 * i + 1]) << 16) |
+               (uint32_t(block[4 * i + 2]) << 8) | uint32_t(block[4 * i + 3]);
+    for (int i = 16; i < 64; i++) {
+        uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+        uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+        w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = state[0], b = state[1], c = state[2], d = state[3];
+    uint32_t e = state[4], f = state[5], g = state[6], h = state[7];
+    for (int i = 0; i < 64; i++) {
+        uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+        uint32_t ch = (e & f) ^ (~e & g);
+        uint32_t t1 = h + S1 + ch + K[i] + w[i];
+        uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+        uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+        uint32_t t2 = S0 + maj;
+        h = g; g = f; f = e; e = d + t1;
+        d = c; c = b; b = a; a = t1 + t2;
+    }
+    state[0] += a; state[1] += b; state[2] += c; state[3] += d;
+    state[4] += e; state[5] += f; state[6] += g; state[7] += h;
+}
+
+const uint32_t IV[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                        0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+
+// fixed padding block for a 64-byte message (bit length 512)
+const uint8_t PAD64[64] = {0x80, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+                           0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+                           0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+                           0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 2, 0};
+
+// ---------------------------------------------------------------------------
+// CRC-32C (Castagnoli), table-driven
+// ---------------------------------------------------------------------------
+
+uint32_t crc_table[256];
+bool crc_init_done = false;
+
+void crc_init() {
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; k++)
+            c = (c & 1) ? 0x82F63B78u ^ (c >> 1) : c >> 1;
+        crc_table[i] = c;
+    }
+    crc_init_done = true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// n two-to-one hashes: in = n*64 bytes, out = n*32 bytes
+void sha256_2to1_batch(const uint8_t* in, uint8_t* out, size_t n) {
+    for (size_t j = 0; j < n; j++) {
+        uint32_t st[8];
+        std::memcpy(st, IV, sizeof(IV));
+        sha256_compress(st, in + 64 * j);
+        sha256_compress(st, PAD64);
+        for (int i = 0; i < 8; i++) {
+            out[32 * j + 4 * i] = uint8_t(st[i] >> 24);
+            out[32 * j + 4 * i + 1] = uint8_t(st[i] >> 16);
+            out[32 * j + 4 * i + 2] = uint8_t(st[i] >> 8);
+            out[32 * j + 4 * i + 3] = uint8_t(st[i]);
+        }
+    }
+}
+
+uint32_t crc32c(const uint8_t* data, size_t n) {
+    if (!crc_init_done) crc_init();
+    uint32_t c = 0xFFFFFFFFu;
+    for (size_t i = 0; i < n; i++)
+        c = crc_table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+    return c ^ 0xFFFFFFFFu;
+}
+
+// ---------------------------------------------------------------------------
+// snappy block format
+// ---------------------------------------------------------------------------
+
+size_t snappy_max_compressed(size_t n) { return 32 + n + n / 6; }
+
+// greedy hash-table LZ with copy-2 elements; mirrors gen/snappy.py
+size_t snappy_compress_block(const uint8_t* in, size_t n, uint8_t* out) {
+    size_t pos = 0;
+    // preamble varint
+    size_t v = n;
+    while (v >= 0x80) { out[pos++] = uint8_t(v) | 0x80; v >>= 7; }
+    out[pos++] = uint8_t(v);
+
+    const size_t HASH_BITS = 14;
+    const size_t HASH_SIZE = size_t(1) << HASH_BITS;
+    static thread_local int64_t table[size_t(1) << 14];
+    for (size_t i = 0; i < HASH_SIZE; i++) table[i] = -1;
+
+    auto emit_literal = [&](size_t start, size_t end) {
+        size_t len = end - start;
+        if (len == 0) return;
+        if (len <= 60) {
+            out[pos++] = uint8_t((len - 1) << 2);
+        } else {
+            size_t l = len - 1;
+            int nbytes = 0;
+            uint8_t tmp[4];
+            while (l) { tmp[nbytes++] = uint8_t(l); l >>= 8; }
+            out[pos++] = uint8_t((59 + nbytes) << 2);
+            for (int i = 0; i < nbytes; i++) out[pos++] = tmp[i];
+        }
+        std::memcpy(out + pos, in + start, len);
+        pos += len;
+    };
+
+    size_t i = 0, lit_start = 0;
+    while (i + 4 <= n) {
+        uint32_t key;
+        std::memcpy(&key, in + i, 4);
+        size_t h = (key * 0x1e35a7bdu) >> (32 - HASH_BITS);
+        int64_t cand = table[h];
+        table[h] = int64_t(i);
+        if (cand >= 0 && i - size_t(cand) <= 65535 &&
+            std::memcmp(in + cand, in + i, 4) == 0) {
+            size_t len = 4;
+            while (i + len < n && len < 64 && in[cand + len] == in[i + len])
+                len++;
+            emit_literal(lit_start, i);
+            size_t offset = i - size_t(cand);
+            out[pos++] = uint8_t(((len - 1) << 2) | 0b10);
+            out[pos++] = uint8_t(offset);
+            out[pos++] = uint8_t(offset >> 8);
+            i += len;
+            lit_start = i;
+        } else {
+            i++;
+        }
+    }
+    emit_literal(lit_start, n);
+    return pos;
+}
+
+// returns 0 on success, negative on malformed input
+int snappy_decompress_block(const uint8_t* in, size_t n, uint8_t* out,
+                            size_t out_cap, size_t* out_len) {
+    size_t pos = 0, expect = 0;
+    int shift = 0;
+    while (true) {
+        if (pos >= n) return -1;
+        uint8_t b = in[pos++];
+        expect |= size_t(b & 0x7F) << shift;
+        shift += 7;
+        if (!(b & 0x80)) break;
+    }
+    if (expect > out_cap) return -2;
+    size_t o = 0;
+    while (pos < n) {
+        uint8_t tag = in[pos++];
+        int type = tag & 0b11;
+        if (type == 0) {
+            size_t len = (tag >> 2) + 1;
+            if (len > 60) {
+                int nbytes = int(len) - 60;
+                if (pos + nbytes > n) return -3;
+                len = 0;
+                for (int i = 0; i < nbytes; i++)
+                    len |= size_t(in[pos + i]) << (8 * i);
+                len += 1;
+                pos += nbytes;
+            }
+            if (pos + len > n || o + len > out_cap) return -4;
+            std::memcpy(out + o, in + pos, len);
+            pos += len; o += len;
+        } else {
+            size_t len, offset;
+            if (type == 1) {
+                len = ((tag >> 2) & 0b111) + 4;
+                if (pos >= n) return -5;
+                offset = (size_t(tag >> 5) << 8) | in[pos++];
+            } else if (type == 2) {
+                len = (tag >> 2) + 1;
+                if (pos + 2 > n) return -6;
+                offset = size_t(in[pos]) | (size_t(in[pos + 1]) << 8);
+                pos += 2;
+            } else {
+                len = (tag >> 2) + 1;
+                if (pos + 4 > n) return -7;
+                offset = size_t(in[pos]) | (size_t(in[pos + 1]) << 8) |
+                         (size_t(in[pos + 2]) << 16) |
+                         (size_t(in[pos + 3]) << 24);
+                pos += 4;
+            }
+            if (offset == 0 || offset > o || o + len > out_cap) return -8;
+            for (size_t k = 0; k < len; k++) { out[o] = out[o - offset]; o++; }
+        }
+    }
+    if (o != expect) return -9;
+    *out_len = o;
+    return 0;
+}
+
+}  // extern "C"

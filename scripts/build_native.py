@@ -1,0 +1,27 @@
+#!/usr/bin/env python
+"""Build the native host tier (native/src -> native/libconsensus_native.so).
+
+Plain g++; no cmake/bazel needed for a single translation unit.  Run once
+per checkout; consensus_specs_tpu.native falls back to pure Python when
+the library is absent.
+"""
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC = os.path.join(ROOT, "native", "src", "consensus_native.cc")
+OUT = os.path.join(ROOT, "native", "libconsensus_native.so")
+
+
+def main():
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           "-o", OUT, SRC]
+    print(" ".join(cmd))
+    subprocess.check_call(cmd)
+    print(f"built {OUT}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

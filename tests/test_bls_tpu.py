@@ -1,0 +1,116 @@
+"""TPU BLS backend: byte-level verdict parity with the native oracle."""
+from random import Random
+
+import pytest
+
+from consensus_specs_tpu.crypto import bls12_381 as native
+from consensus_specs_tpu.ops import bls_tpu
+from consensus_specs_tpu.utils import bls as shim
+
+rng = Random(0xFA57)
+
+SKS = [rng.randrange(1, 2**200) for _ in range(4)]
+PKS = [native.SkToPk(sk) for sk in SKS]
+MSG = b"\x42" * 32
+MSG2 = b"\x43" * 32
+SIGS = [native.Sign(sk, MSG) for sk in SKS]
+
+
+def _native_verify(pk, m, s):
+    """Shim semantics: decode/infinity errors read as False."""
+    try:
+        return native.Verify(pk, m, s)
+    except ValueError:
+        return False
+
+
+def test_verify_batch_parity():
+    wrong_sig = native.Sign(SKS[1], MSG)
+    bad_bytes = b"\x00" * 96
+    pks = [PKS[0], PKS[0], PKS[0], b"\xc0" + b"\x00" * 47]
+    msgs = [MSG, MSG, MSG, MSG]
+    sigs = [SIGS[0], wrong_sig, bad_bytes, SIGS[0]]
+    got = bls_tpu.verify_batch(pks, msgs, sigs)
+    want = [_native_verify(pk, m, s) for pk, m, s in zip(pks, msgs, sigs)]
+    assert got == want == [True, False, False, False]
+
+
+def test_fast_aggregate_verify_parity():
+    agg = native.Aggregate(SIGS)
+    got = bls_tpu.fast_aggregate_verify_batch(
+        [PKS, PKS[:-1], [], PKS],
+        [MSG, MSG, MSG, MSG2],
+        [agg, agg, agg, agg])
+    want = [native.FastAggregateVerify(PKS, MSG, agg),
+            native.FastAggregateVerify(PKS[:-1], MSG, agg),
+            native.FastAggregateVerify([], MSG, agg),
+            native.FastAggregateVerify(PKS, MSG2, agg)]
+    assert got == want == [True, False, False, False]
+
+
+def test_aggregate_verify_parity():
+    msgs = [bytes([i]) * 32 for i in range(len(SKS))]
+    sigs = [native.Sign(sk, m) for sk, m in zip(SKS, msgs)]
+    agg = native.Aggregate(sigs)
+    got = bls_tpu.aggregate_verify_batch(
+        [PKS, PKS], [msgs, msgs[::-1]], [agg, agg])
+    want = [native.AggregateVerify(PKS, msgs, agg),
+            native.AggregateVerify(PKS, msgs[::-1], agg)]
+    assert got == want == [True, False]
+
+
+def test_shim_backend_switch():
+    shim.use_tpu()
+    try:
+        assert shim.Verify(PKS[0], MSG, SIGS[0]) is True
+        assert shim.Verify(PKS[0], MSG, SIGS[1]) is False
+        agg = native.Aggregate(SIGS)
+        assert shim.FastAggregateVerify(PKS, MSG, agg) is True
+        verdicts = shim.FastAggregateVerifyBatch(
+            [PKS, PKS], [MSG, MSG2], [agg, agg])
+        assert verdicts == [True, False]
+    finally:
+        shim.use_native()
+
+
+def test_hash_to_g2_batch_parity():
+    from consensus_specs_tpu.crypto.hash_to_curve import hash_to_g2
+    msgs = [b"\x01" * 32, b"hello world", b""]
+    got = bls_tpu.hash_to_g2_batch(msgs)
+    want = [hash_to_g2(m) for m in msgs]
+    assert all(a == b for a, b in zip(got, want))
+
+
+def test_point_object_fast_path():
+    """Pubkeys/signatures may arrive as decompressed Points (cache shape)."""
+    from consensus_specs_tpu.crypto import curve as cv
+    pk_points = [cv.g1_from_bytes(pk) for pk in PKS]
+    agg = native.Aggregate(SIGS)
+    sig_point = cv.g2_from_bytes(agg)
+    got = bls_tpu.fast_aggregate_verify_batch(
+        [pk_points], [MSG], [sig_point])
+    assert got == [True]
+
+
+def test_batch_api_accepts_points_on_native_fallback():
+    from consensus_specs_tpu.crypto import curve as cv
+    pk_point = cv.g1_from_bytes(PKS[0])
+    sig_point = cv.g2_from_bytes(SIGS[0])
+    shim.use_native()
+    got = shim.FastAggregateVerifyBatch([[pk_point]], [MSG], [sig_point])
+    assert got == [True]
+    assert shim.VerifyBatch([pk_point], [MSG], [sig_point]) == [True]
+
+
+def test_pairing_check_points_with_infinity():
+    from consensus_specs_tpu.crypto import curve as cv
+    sk = SKS[0]
+    H = cv.g2_generator() * 12345
+    pairs_valid = [(cv.g1_generator() * sk, H),
+                   (-cv.g1_generator(), H * sk)]
+    assert bls_tpu.pairing_check_points(pairs_valid) is True
+    assert bls_tpu.pairing_check_points(
+        [(cv.g1_infinity(), H)]) is True  # e(O, Q) == 1
+    pairs_bad = [(cv.g1_generator() * sk, H),
+                 (-cv.g1_generator(), H * (sk + 1))]
+    assert bls_tpu.pairing_check_points(pairs_bad) is False
