@@ -3,9 +3,10 @@
 From-scratch implementation over the fields.py tower.  G2 points are
 untwisted into E(Fq12) via (x, y) -> (x/w^2, y/w^3) (w^6 = XI, derived from
 the tower relations), and the Miller loop runs over the bits of |z| with
-line evaluations at the G1 argument.  The final exponentiation does the
-cheap (q^6 - 1) step via conjugate/inverse and one big-integer power for
-the remainder; Frobenius-based hard-part optimization is a later round.
+line evaluations at the G1 argument.  The final exponentiation uses the
+easy (q^6-1)(q^2+1) step then the standard BLS12 x-chain hard part
+(cyclotomic squarings + Frobenius maps), computing e(P,Q)^3 uniformly —
+sound for every equality/is-one use (see final_exponentiation).
 
 Verified against the production KZG trusted setup: e([tau]G1, G2) ==
 e(G1, [tau]G2) for the monomial points (tests/test_bls.py).
@@ -18,9 +19,11 @@ from .curve import Point, Fq1
 # |z| bits for the Miller loop
 _ATE_LOOP = abs(BLS_X)
 
-# final exponent after the easy (q^6 - 1) step:
-#   (q^12 - 1) / r = (q^6 - 1) * (q^2 + 1) * ((q^4 - q^2 + 1) / r)
-_HARD_EXP = (Q * Q + 1) * ((Q**4 - Q * Q + 1) // R)
+# hard-part exponent (after the easy (q^6-1)(q^2+1) step); the x-chain in
+# _hard_part computes exactly m^(3*_HARD_EXP) — cubing is a bijection on the
+# order-r target subgroup, so equality/is-one semantics are unchanged as
+# long as every pairing goes through the same chain
+_HARD_EXP = (Q**4 - Q * Q + 1) // R
 
 
 def _embed_fq2(a: Fq2) -> Fq12:
@@ -104,11 +107,55 @@ def miller_loop(p: Point, q: Point) -> Fq12:
     return f.conjugate()
 
 
+def _exp_by_neg_x(m: Fq12) -> Fq12:
+    """m^x for the (negative) BLS parameter x, m unitary: square-and-multiply
+    by |x| with cyclotomic squarings, then conjugate."""
+    acc = m
+    for bit in bin(_ATE_LOOP)[3:]:
+        acc = acc.cyclotomic_square()
+        if bit == "1":
+            acc = acc * m
+    return acc.conjugate()
+
+
+def _hard_part(m: Fq12) -> Fq12:
+    """m^(3 * (q^4 - q^2 + 1) / r) by the standard BLS12 addition chain
+    (5 exp-by-x + 3 Frobenius; verified symbolically in
+    tests/test_bls.py::test_hard_part_chain_exponent)."""
+    t2 = m
+    t1 = t2.cyclotomic_square().conjugate()      # m^-2
+    t3 = _exp_by_neg_x(t2)                       # m^x
+    t4 = t3.cyclotomic_square()                  # m^2x
+    t5 = t1 * t3                                 # m^(x-2)
+    t1 = _exp_by_neg_x(t5)                       # m^(x^2-2x)
+    t0 = _exp_by_neg_x(t1)                       # m^(x^3-2x^2)
+    t6 = _exp_by_neg_x(t0)                       # m^(x^4-2x^3)
+    t6 = t6 * t4                                 # m^(x^4-2x^3+2x)
+    t4 = _exp_by_neg_x(t6)
+    t5 = t5.conjugate()
+    t4 = t4 * t5 * t2
+    t5 = t2.conjugate()
+    t1 = t1 * t2                                 # m^(x^2-2x+1)
+    t1 = t1.frobenius(3)
+    t6 = t6 * t5
+    t6 = t6.frobenius(1)
+    t3 = t3 * t0
+    t3 = t3.frobenius(2)
+    t3 = t3 * t1
+    t3 = t3 * t6
+    return t3 * t4
+
+
 def final_exponentiation(f: Fq12) -> Fq12:
-    # easy part: f^(q^6 - 1) = conj(f) / f
-    f = f.conjugate() * f.inv()
-    # hard part (one big pow; Frobenius decomposition later)
-    return f.pow(_HARD_EXP)
+    """f^(3 * (q^12 - 1) / r): easy part then the x-chain hard part.
+
+    The extra factor of 3 (inherent to the chain) is harmless: pairing
+    values live in the order-r subgroup where cubing is a bijection, so
+    e(P,Q)-equality and is-one checks are unaffected.
+    """
+    f1 = f.conjugate() * f.inv()                 # f^(q^6-1)
+    m = f1.frobenius(2) * f1                     # ^(q^2+1): now unitary
+    return _hard_part(m)
 
 
 def pairing(p: Point, q: Point) -> Fq12:

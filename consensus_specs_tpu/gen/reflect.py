@@ -1,0 +1,68 @@
+"""Reflect decorated spec tests into vector-generator cases.
+
+Counterpart of the reference's gen_from_tests machinery
+(/root/reference/tests/core/pyspec/eth2spec/gen_helpers/gen_from_tests/
+gen.py:18-61,101-116,140-203): every `@spec_state_test`-style function in a
+module IS a vector case — `generate_from_tests` walks a module's test
+functions and collects their `make_vector_cases` output, so pytest suites
+and conformance vectors are one codebase.
+"""
+from __future__ import annotations
+
+import importlib
+import inspect
+
+from .typing import TestCase, TestProvider
+
+
+def generate_from_tests(runner_name: str, handler_name: str, module,
+                        forks=None, presets=None, suite_name="pyspec"):
+    """TestCases for every decorated test_* function in `module`."""
+    if isinstance(module, str):
+        module = importlib.import_module(module)
+    cases: list[TestCase] = []
+    for name, fn in inspect.getmembers(module):
+        if not name.startswith("test_"):
+            continue
+        maker = getattr(fn, "make_vector_cases", None)
+        if maker is None:
+            continue  # plain unit test, not exported (reference check_mods)
+        cases.extend(maker(runner_name, handler_name, suite_name=suite_name,
+                           forks=forks, presets=presets))
+    return cases
+
+
+def providers_from_handlers(runner_name: str, handler_modules: dict,
+                            forks=None, presets=None):
+    """One TestProvider covering {handler_name: module(s)} — the shape of a
+    runner main (reference run_state_test_generators)."""
+    def make_cases():
+        for handler, mods in handler_modules.items():
+            if not isinstance(mods, (list, tuple)):
+                mods = [mods]
+            for mod in mods:
+                yield from generate_from_tests(
+                    runner_name, handler, mod, forks=forks, presets=presets)
+    return [TestProvider(make_cases=make_cases)]
+
+
+def check_handler_modules(handler_modules: dict) -> list:
+    """Completeness check: every named module imports and contains at
+    least one exportable test (reference check_mods gen.py:140-203).
+    Returns a list of problems (empty = ok)."""
+    problems = []
+    for handler, mods in handler_modules.items():
+        if not isinstance(mods, (list, tuple)):
+            mods = [mods]
+        for mod in mods:
+            try:
+                module = (importlib.import_module(mod)
+                          if isinstance(mod, str) else mod)
+            except Exception as e:
+                problems.append(f"{handler}: import failed: {e}")
+                continue
+            if not any(hasattr(fn, "make_vector_cases")
+                       for name, fn in inspect.getmembers(module)
+                       if name.startswith("test_")):
+                problems.append(f"{handler}: no exportable tests")
+    return problems

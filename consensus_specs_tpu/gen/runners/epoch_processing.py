@@ -1,47 +1,10 @@
-"""Epoch-processing vectors: pre-state + one epoch sub-pass + post-state.
-
-Format parity with the reference's tests/generators/epoch_processing.
-"""
-from ..typing import TestCase, TestProvider
-from ...specs import get_spec
-from ...test_infra import disable_bls
-from ...test_infra.genesis import create_genesis_state, default_balances
-from ...test_infra.blocks import next_epoch
-
-FORKS = ["phase0", "altair", "bellatrix", "capella", "deneb", "electra"]
-
-SUB_PASSES = [
-    "justification_and_finalization",
-    "registry_updates",
-    "slashings",
-    "effective_balance_updates",
-    "eth1_data_reset",
-    "slashings_reset",
-    "randao_mixes_reset",
-]
-
-
-def _case(fork, sub_pass):
-    def fn():
-        spec = get_spec(fork, "minimal")
-        with disable_bls():
-            state = create_genesis_state(spec, default_balances(spec))
-            # advance into an epoch with history so the pass has work to do
-            next_epoch(spec, state)
-            next_epoch(spec, state)
-            yield "pre", state.copy()
-            getattr(spec, f"process_{sub_pass}")(state)
-            yield "post", state
-    return TestCase(
-        fork_name=fork, preset_name="minimal",
-        runner_name="epoch_processing", handler_name=sub_pass,
-        suite_name="epoch_processing", case_name=f"{sub_pass}_basic",
-        case_fn=fn)
+"""Epoch-processing vectors (pre/post per sub-pass), reflected from the
+dual-mode spec tests (spec_tests/epoch_processing/*; format
+tests/formats/epoch_processing)."""
+from ..reflect import providers_from_handlers
+from ...spec_tests.epoch_processing import EPOCH_PROCESSING_HANDLERS
 
 
 def providers():
-    def make_cases():
-        for fork in FORKS:
-            for sub_pass in SUB_PASSES:
-                yield _case(fork, sub_pass)
-    return [TestProvider(make_cases=make_cases)]
+    return providers_from_handlers(
+        "epoch_processing", EPOCH_PROCESSING_HANDLERS)

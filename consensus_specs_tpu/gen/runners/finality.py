@@ -1,0 +1,9 @@
+"""Finality trajectory vectors (pre + blocks_i + post), reflected from the
+dual-mode spec tests (spec_tests/finality/*; format
+tests/formats/finality)."""
+from ..reflect import providers_from_handlers
+from ...spec_tests.finality import FINALITY_HANDLERS
+
+
+def providers():
+    return providers_from_handlers("finality", FINALITY_HANDLERS)

@@ -1,0 +1,8 @@
+"""Genesis initialization/validity vectors, reflected from the dual-mode
+spec tests (spec_tests/genesis/*; format tests/formats/genesis)."""
+from ..reflect import providers_from_handlers
+from ...spec_tests.genesis import GENESIS_HANDLERS
+
+
+def providers():
+    return providers_from_handlers("genesis", GENESIS_HANDLERS)

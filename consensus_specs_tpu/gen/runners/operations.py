@@ -1,76 +1,10 @@
-"""Operation-processing vectors: pre-state + operation + post-state.
-
-Format parity with the reference's tests/generators/operations: each case
-dir holds pre.ssz_snappy, <operation>.ssz_snappy, and post.ssz_snappy
-(absent post = expected-invalid).
-"""
-from ..typing import TestCase, TestProvider
-from ...specs import get_spec
-from ...test_infra import disable_bls
-from ...test_infra.genesis import create_genesis_state, default_balances
-from ...test_infra.attestations import get_valid_attestation
-from ...test_infra.blocks import transition_to
-
-FORKS = ["phase0", "altair", "bellatrix", "capella", "deneb", "electra"]
-
-
-def _fresh_state(spec):
-    with disable_bls():
-        return create_genesis_state(spec, default_balances(spec))
-
-
-def _attestation_case(fork, variant):
-    def fn():
-        spec = get_spec(fork, "minimal")
-        state = _fresh_state(spec)
-        with disable_bls():
-            attestation = get_valid_attestation(spec, state, signed=True)
-            transition_to(spec, state,
-                          state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
-            if variant != "valid":
-                # bad target epoch: must be corrupted BEFORE the yield —
-                # artifacts serialize at yield time
-                attestation.data.target.epoch += 10
-            yield "pre", state.copy()
-            yield "attestation", attestation
-            if variant == "valid":
-                spec.process_attestation(state, attestation)
-                yield "post", state
-            else:
-                try:
-                    spec.process_attestation(state, attestation)
-                except (AssertionError, ValueError):
-                    yield "post", None
-                else:
-                    raise AssertionError("expected invalid attestation")
-    return TestCase(
-        fork_name=fork, preset_name="minimal", runner_name="operations",
-        handler_name="attestation", suite_name="operations",
-        case_name=f"attestation_{variant}", case_fn=fn)
-
-
-def _block_header_case(fork):
-    def fn():
-        spec = get_spec(fork, "minimal")
-        state = _fresh_state(spec)
-        from ...test_infra.blocks import build_empty_block_for_next_slot
-        with disable_bls():
-            block = build_empty_block_for_next_slot(spec, state)
-            spec.process_slots(state, block.slot)
-            yield "pre", state.copy()
-            yield "block", block
-            spec.process_block_header(state, block)
-            yield "post", state
-    return TestCase(
-        fork_name=fork, preset_name="minimal", runner_name="operations",
-        handler_name="block_header", suite_name="operations",
-        case_name="block_header_basic", case_fn=fn)
+"""Operation-processing vectors, reflected from the dual-mode spec tests
+(spec_tests/operations/*) — the reference's gen_from_tests architecture:
+each pytest test body IS the vector case (format
+tests/formats/operations)."""
+from ..reflect import providers_from_handlers
+from ...spec_tests.operations import OPERATION_HANDLERS
 
 
 def providers():
-    def make_cases():
-        for fork in FORKS:
-            for variant in ("valid", "invalid_target"):
-                yield _attestation_case(fork, variant)
-            yield _block_header_case(fork)
-    return [TestProvider(make_cases=make_cases)]
+    return providers_from_handlers("operations", OPERATION_HANDLERS)

@@ -1,0 +1,9 @@
+"""Reward-component vectors (Deltas per component), reflected from the
+dual-mode spec tests (spec_tests/rewards/*; format
+tests/formats/rewards)."""
+from ..reflect import providers_from_handlers
+from ...spec_tests.rewards import REWARDS_HANDLERS
+
+
+def providers():
+    return providers_from_handlers("rewards", REWARDS_HANDLERS)

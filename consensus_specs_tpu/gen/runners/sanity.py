@@ -1,55 +1,9 @@
-"""Sanity vectors: whole-slot and whole-block trajectories.
-
-Format parity with the reference's tests/generators/sanity: slots cases
-yield pre + slots count + post; block cases yield pre + blocks_<i> + post.
-"""
-from ..typing import TestCase, TestProvider
-from ...specs import get_spec
-from ...test_infra import disable_bls
-from ...test_infra.genesis import create_genesis_state, default_balances
-from ...test_infra.blocks import (
-    build_empty_block_for_next_slot, state_transition_and_sign_block)
-
-FORKS = ["phase0", "altair", "bellatrix", "capella", "deneb", "electra"]
-
-
-def _slots_case(fork, n_slots):
-    def fn():
-        spec = get_spec(fork, "minimal")
-        with disable_bls():
-            state = create_genesis_state(spec, default_balances(spec))
-            yield "pre", state.copy()
-            yield "slots", "meta", n_slots
-            spec.process_slots(state, state.slot + n_slots)
-            yield "post", state
-    return TestCase(
-        fork_name=fork, preset_name="minimal", runner_name="sanity",
-        handler_name="slots", suite_name="sanity",
-        case_name=f"slots_{n_slots}", case_fn=fn)
-
-
-def _blocks_case(fork, n_blocks):
-    def fn():
-        spec = get_spec(fork, "minimal")
-        with disable_bls():
-            state = create_genesis_state(spec, default_balances(spec))
-            yield "pre", state.copy()
-            for i in range(n_blocks):
-                block = build_empty_block_for_next_slot(spec, state)
-                signed = state_transition_and_sign_block(spec, state, block)
-                yield f"blocks_{i}", signed
-            yield "blocks_count", "meta", n_blocks
-            yield "post", state
-    return TestCase(
-        fork_name=fork, preset_name="minimal", runner_name="sanity",
-        handler_name="blocks", suite_name="sanity",
-        case_name=f"empty_blocks_{n_blocks}", case_fn=fn)
+"""Sanity vectors (slots + blocks trajectories), reflected from the
+dual-mode spec tests (spec_tests/sanity/*; format
+tests/formats/sanity)."""
+from ..reflect import providers_from_handlers
+from ...spec_tests.sanity import SANITY_HANDLERS
 
 
 def providers():
-    def make_cases():
-        for fork in FORKS:
-            for n in (1, 2):
-                yield _slots_case(fork, n)
-            yield _blocks_case(fork, 2)
-    return [TestProvider(make_cases=make_cases)]
+    return providers_from_handlers("sanity", SANITY_HANDLERS)

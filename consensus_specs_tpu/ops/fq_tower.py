@@ -232,6 +232,77 @@ def fq12_select(cond, a, b):
 
 
 # ---------------------------------------------------------------------------
+# frobenius + cyclotomic squaring (final-exponentiation fast path)
+# ---------------------------------------------------------------------------
+
+# fq12 layout component j -> basis w^k, k = _FROB_K[j]; frobenius scales
+# conj(comp_j) by XI^(k(q-1)/6)
+_FROB_K = (0, 2, 4, 1, 3, 5)
+
+
+def _frob_gamma_limbs() -> np.ndarray:
+    # single source of truth: the oracle's table (crypto/fields.py
+    # _FROB_GAMMA = XI^(k(q-1)/6)), re-packed into Montgomery limbs
+    from ..crypto.fields import _FROB_GAMMA
+    gammas = [_FROB_GAMMA[k] for k in _FROB_K]
+    return np.stack(
+        [np.asarray(fq.pack_mont([g.c0, g.c1])) for g in gammas])
+
+
+_FROB_GAMMA_LIMBS = _frob_gamma_limbs()
+
+
+def fq12_frobenius(a, power: int = 1):
+    """x -> x^(q^power); one batched fq2 mul per application."""
+    out = a
+    for _ in range(power):
+        v = out.reshape(out.shape[:-2] + (6, 2, fq.LIMBS))
+        v = jnp.concatenate(
+            [v[..., 0:1, :], fq.neg(v[..., 1:2, :])], axis=-2)   # conj
+        v = fq2_mul(v, jnp.asarray(_FROB_GAMMA_LIMBS))
+        out = v.reshape(a.shape)
+    return out
+
+
+def fq12_cyclotomic_square(a):
+    """Granger-Scott squaring for unitary elements: three Fq4 squarings,
+    all nine underlying fq2 squares in ONE stacked call (vs 12 fq2 muls
+    for a generic fq12_square).  Mirrors Fq12.cyclotomic_square."""
+    c = a.reshape(a.shape[:-2] + (6, 2, fq.LIMBS))
+    z0, z4, z3 = c[..., 0, :, :], c[..., 1, :, :], c[..., 2, :, :]
+    z2, z1, z5 = c[..., 3, :, :], c[..., 4, :, :], c[..., 5, :, :]
+
+    s = fq2_square(jnp.stack(
+        [z0, z1, fq2_add(z0, z1),
+         z2, z3, fq2_add(z2, z3),
+         z4, z5, fq2_add(z4, z5)], axis=-3))
+
+    def fp4(i):
+        t0, t1, tsum = (s[..., i, :, :], s[..., i + 1, :, :],
+                        s[..., i + 2, :, :])
+        return (fq2_add(fq2_mul_xi(t1), t0),
+                fq2_sub(fq2_sub(tsum, t0), t1))
+
+    def dbl_plus(t, z, sign):
+        """2*(t +/- z) + t."""
+        base = fq2_sub(t, z) if sign < 0 else fq2_add(t, z)
+        return fq2_add(fq2_add(base, base), t)
+
+    t0, t1 = fp4(0)
+    z0n = dbl_plus(t0, z0, -1)
+    z1n = dbl_plus(t1, z1, +1)
+    ta0, ta1 = fp4(3)
+    tb0, tb1 = fp4(6)
+    z4n = dbl_plus(ta0, z4, -1)
+    z5n = dbl_plus(ta1, z5, +1)
+    t = fq2_mul_xi(tb1)
+    z2n = dbl_plus(t, z2, +1)
+    z3n = dbl_plus(tb0, z3, -1)
+
+    return jnp.concatenate([z0n, z4n, z3n, z2n, z1n, z5n], axis=-2)
+
+
+# ---------------------------------------------------------------------------
 # inversion (tower descent; Fq inverse by fixed-exponent power)
 # ---------------------------------------------------------------------------
 

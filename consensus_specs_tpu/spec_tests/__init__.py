@@ -1,0 +1,9 @@
+"""Dual-mode spec test suites.
+
+Every module here holds `@spec_state_test`-decorated generator functions:
+under pytest the yields are drained and the asserts run; under the vector
+generator the same bodies stream their artifacts to disk as conformance
+vectors (the reference's single-test-body/two-modes architecture,
+SURVEY.md §4).  tests/test_spec_suites.py collects them for pytest;
+gen/runners/* reflect them via gen.reflect.generate_from_tests.
+"""

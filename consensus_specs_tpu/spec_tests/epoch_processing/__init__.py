@@ -1,0 +1,15 @@
+"""Epoch-processing spec tests (pre + post vectors per sub-pass)."""
+
+EPOCH_PROCESSING_HANDLERS = {
+    "justification_and_finalization":
+        "consensus_specs_tpu.spec_tests.epoch_processing."
+        "test_justification_and_finalization",
+    "effective_balance_updates":
+        "consensus_specs_tpu.spec_tests.epoch_processing."
+        "test_effective_balance_updates",
+    "slashings":
+        "consensus_specs_tpu.spec_tests.epoch_processing.test_slashings",
+    "registry_updates":
+        "consensus_specs_tpu.spec_tests.epoch_processing."
+        "test_registry_updates",
+}

@@ -1,0 +1,30 @@
+"""process_effective_balance_updates epoch tests (hysteresis)."""
+from ...ssz import uint64
+from ...test_infra.context import spec_state_test, with_all_phases
+from ...test_infra.epoch_processing import run_epoch_processing_with
+
+
+@with_all_phases
+@spec_state_test
+def test_effective_balance_hysteresis(spec, state):
+    """Balances nudged across / within the hysteresis thresholds."""
+    max_eb = int(spec.MAX_EFFECTIVE_BALANCE)
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    half_inc = inc // 2
+    cases = [
+        (max_eb, max_eb, max_eb),                       # as-is
+        (max_eb, max_eb - 1, max_eb),                   # below but within
+        (max_eb, max_eb - half_inc - 1, max_eb - inc),  # below threshold
+        (max_eb, max_eb + 1, max_eb),                   # above but within
+        (max_eb - inc, max_eb - 1, max_eb - inc),       # up within
+        (max_eb - inc, max_eb + half_inc + inc // 4, max_eb),  # up across
+    ]
+    for i, (pre_eff, balance, _post_eff) in enumerate(cases):
+        state.validators[i].effective_balance = uint64(pre_eff)
+        state.balances[i] = uint64(balance)
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_effective_balance_updates")
+
+    for i, (_pre_eff, _balance, post_eff) in enumerate(cases):
+        assert int(state.validators[i].effective_balance) == post_eff, i

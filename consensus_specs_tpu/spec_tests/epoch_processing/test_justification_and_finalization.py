@@ -1,0 +1,53 @@
+"""process_justification_and_finalization epoch tests."""
+from ...test_infra.context import (
+    spec_state_test, with_all_phases, never_bls)
+from ...test_infra.blocks import next_epoch
+from ...test_infra.epoch_processing import run_epoch_processing_with
+
+
+def _set_full_participation(spec, state):
+    """Mark every active validator as a previous+current target attester."""
+    if spec.is_post("altair"):
+        full = 0
+        for flag in range(len(spec.PARTICIPATION_FLAG_WEIGHTS)):
+            full = spec.add_flag(full, flag)
+        n = len(state.validators)
+        state.previous_epoch_participation = [full] * n
+        state.current_epoch_participation = [full] * n
+    else:
+        from ...test_infra.attestations import next_epoch_with_attestations
+        # real pending attestations are required pre-altair
+        _, _ = next_epoch_with_attestations(spec, state, True, True)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_full_participation_justifies(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    _set_full_participation(spec, state)
+    yield from run_epoch_processing_with(
+        spec, state, "process_justification_and_finalization")
+    assert int(state.current_justified_checkpoint.epoch) > 0
+
+
+@with_all_phases
+@spec_state_test
+def test_no_participation_no_justification(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    pre_justified = state.current_justified_checkpoint.copy()
+    yield from run_epoch_processing_with(
+        spec, state, "process_justification_and_finalization")
+    assert state.current_justified_checkpoint == pre_justified
+
+
+@with_all_phases
+@spec_state_test
+def test_genesis_epoch_no_op(spec, state):
+    assert spec.get_current_epoch(state) == spec.GENESIS_EPOCH
+    pre_bits = state.justification_bits.copy()
+    yield from run_epoch_processing_with(
+        spec, state, "process_justification_and_finalization")
+    assert state.justification_bits == pre_bits

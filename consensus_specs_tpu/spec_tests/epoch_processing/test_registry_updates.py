@@ -1,0 +1,53 @@
+"""process_registry_updates epoch tests (eligibility, ejection,
+activation queue)."""
+from ...ssz import uint64
+from ...test_infra.context import spec_state_test, with_all_phases
+from ...test_infra.epoch_processing import run_epoch_processing_with
+from ...test_infra.genesis import build_mock_validator
+
+
+@with_all_phases
+@spec_state_test
+def test_new_validator_becomes_eligible(spec, state):
+    fresh = build_mock_validator(
+        spec, len(state.validators), spec.MAX_EFFECTIVE_BALANCE)
+    state.validators.append(fresh)
+    state.balances.append(spec.MAX_EFFECTIVE_BALANCE)
+    if spec.is_post("altair"):
+        state.previous_epoch_participation.append(0)
+        state.current_epoch_participation.append(0)
+        state.inactivity_scores.append(0)
+    index = len(state.validators) - 1
+    assert state.validators[index].activation_eligibility_epoch == \
+        spec.FAR_FUTURE_EPOCH
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    assert state.validators[index].activation_eligibility_epoch != \
+        spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_low_balance_validator_ejected(spec, state):
+    index = 2
+    state.validators[index].effective_balance = uint64(
+        spec.config.EJECTION_BALANCE)
+    assert state.validators[index].exit_epoch == spec.FAR_FUTURE_EPOCH
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    assert state.validators[index].exit_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_eligible_validator_gets_activated(spec, state):
+    index = 3
+    v = state.validators[index]
+    v.activation_epoch = spec.FAR_FUTURE_EPOCH
+    v.activation_eligibility_epoch = uint64(0)
+    state.finalized_checkpoint.epoch = uint64(
+        int(spec.get_current_epoch(state)))
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    assert state.validators[index].activation_epoch != \
+        spec.FAR_FUTURE_EPOCH

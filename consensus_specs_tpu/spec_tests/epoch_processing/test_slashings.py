@@ -1,0 +1,39 @@
+"""process_slashings epoch tests (correlation penalty)."""
+from ...ssz import uint64
+from ...test_infra.context import spec_state_test, with_all_phases
+from ...test_infra.epoch_processing import run_epoch_processing_with
+
+
+def _slash_validators_in_window(spec, state, indices):
+    """Mark validators slashed with withdrawable_epoch in the penalty
+    window and record slashed balance."""
+    epoch = int(spec.get_current_epoch(state))
+    total = 0
+    for i in indices:
+        v = state.validators[i]
+        v.slashed = True
+        v.withdrawable_epoch = uint64(
+            epoch + int(spec.EPOCHS_PER_SLASHINGS_VECTOR) // 2)
+        total += int(v.effective_balance)
+    state.slashings[epoch % spec.EPOCHS_PER_SLASHINGS_VECTOR] = \
+        uint64(total)
+
+
+@with_all_phases
+@spec_state_test
+def test_correlated_penalty(spec, state):
+    n = len(state.validators)
+    targets = list(range(0, n, max(1, n // 8)))[:8]
+    _slash_validators_in_window(spec, state, targets)
+    pre = [int(state.balances[i]) for i in targets]
+    yield from run_epoch_processing_with(spec, state, "process_slashings")
+    for i, b in zip(targets, pre):
+        assert int(state.balances[i]) <= b
+
+
+@with_all_phases
+@spec_state_test
+def test_no_slashings_no_penalty(spec, state):
+    pre = [int(b) for b in state.balances]
+    yield from run_epoch_processing_with(spec, state, "process_slashings")
+    assert [int(b) for b in state.balances] == pre

@@ -1,0 +1,5 @@
+"""Multi-epoch finality trajectory spec tests."""
+
+FINALITY_HANDLERS = {
+    "finality": "consensus_specs_tpu.spec_tests.finality.test_finality",
+}

@@ -1,0 +1,64 @@
+"""Finality-rule trajectory tests (reference
+test/phase0/finality/test_finality.py shape; vector format
+tests/formats/finality: pre + blocks_i + post).
+"""
+from ...test_infra.context import (
+    spec_state_test, with_all_phases, never_bls)
+from ...test_infra.blocks import next_epoch
+from ...test_infra.attestations import next_epoch_with_attestations
+
+
+def _run_epochs(spec, state, plan):
+    """plan: list of (fill_cur, fill_prev) per epoch.  Returns all signed
+    blocks produced."""
+    blocks = []
+    for fill_cur, fill_prev in plan:
+        signed, _ = next_epoch_with_attestations(
+            spec, state, fill_cur, fill_prev)
+        blocks.extend(signed)
+    return blocks
+
+
+def _finality_case(spec, state, plan):
+    yield "pre", state.copy()
+    blocks = _run_epochs(spec, state, plan)
+    for i, sb in enumerate(blocks):
+        yield f"blocks_{i}", sb
+    yield "blocks_count", "meta", len(blocks)
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_finality_from_full_participation(spec, state):
+    """Sustained full current-epoch attestation justifies then finalizes."""
+    next_epoch(spec, state)
+    pre_finalized = int(state.finalized_checkpoint.epoch)
+    yield from _finality_case(
+        spec, state, [(True, False)] * 5)
+    assert int(state.finalized_checkpoint.epoch) > pre_finalized
+    assert int(state.current_justified_checkpoint.epoch) > \
+        int(state.finalized_checkpoint.epoch) - 2
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_no_attestations_no_finality(spec, state):
+    next_epoch(spec, state)
+    yield from _finality_case(spec, state, [(False, False)] * 3)
+    assert int(state.finalized_checkpoint.epoch) == 0
+    assert int(state.current_justified_checkpoint.epoch) == 0
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_finality_rule_2_previous_epoch(spec, state):
+    """Justification via previous-epoch attestations only."""
+    next_epoch(spec, state)
+    pre_justified = int(state.current_justified_checkpoint.epoch)
+    yield from _finality_case(
+        spec, state, [(False, True)] * 4)
+    assert int(state.current_justified_checkpoint.epoch) > pre_justified

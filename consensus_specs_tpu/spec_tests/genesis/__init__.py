@@ -1,0 +1,7 @@
+"""Genesis initialization/validity spec tests."""
+
+GENESIS_HANDLERS = {
+    "initialization":
+        "consensus_specs_tpu.spec_tests.genesis.test_initialization",
+    "validity": "consensus_specs_tpu.spec_tests.genesis.test_validity",
+}

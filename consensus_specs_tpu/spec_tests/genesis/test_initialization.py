@@ -1,0 +1,49 @@
+"""initialize_beacon_state_from_eth1 tests (vector format
+tests/formats/genesis/initialization: eth1.yaml + deposits + state)."""
+from ...ssz import uint64
+from ...test_infra.context import (
+    spec_test, with_phases, never_bls)
+from ...test_infra.deposits import build_deposit
+from ...test_infra.keys import privkeys, pubkeys
+
+
+def _genesis_deposits(spec, count, amount):
+    deposit_data_list = []
+    deposits = []
+    root = b"\x00" * 32
+    for i in range(count):
+        wc = spec.BLS_WITHDRAWAL_PREFIX + bytes(
+            spec.hash(pubkeys[i]))[1:]
+        deposit, root, deposit_data_list = build_deposit(
+            spec, deposit_data_list, pubkeys[i], privkeys[i], amount,
+            wc, signed=True)
+        deposits.append(deposit)
+    return deposits, root
+
+
+# genesis vectors are phase0-only in the reference; later forks initialize
+# via upgrade functions
+@with_phases(["phase0"])
+@spec_test
+@never_bls
+def test_initialize_beacon_state_from_eth1(spec):
+    count = int(spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT)
+    deposits, deposit_root = _genesis_deposits(
+        spec, count, spec.MAX_EFFECTIVE_BALANCE)
+    eth1_block_hash = b"\x12" * 32
+    eth1_timestamp = int(spec.config.MIN_GENESIS_TIME)
+
+    yield "eth1", "data", {
+        "eth1_block_hash": "0x" + eth1_block_hash.hex(),
+        "eth1_timestamp": eth1_timestamp,
+    }
+    for i, d in enumerate(deposits):
+        yield f"deposits_{i}", d
+    yield "deposits_count", "meta", len(deposits)
+
+    state = spec.initialize_beacon_state_from_eth1(
+        eth1_block_hash, uint64(eth1_timestamp), deposits)
+    assert state.eth1_data.deposit_root == deposit_root
+    assert len(state.validators) == count
+    assert spec.is_valid_genesis_state(state)
+    yield "state", state

@@ -1,0 +1,25 @@
+"""is_valid_genesis_state tests (vector format
+tests/formats/genesis/validity: genesis.ssz_snappy + is_valid.yaml)."""
+from ...test_infra.context import (
+    spec_state_test, with_phases, never_bls)
+
+
+@with_phases(["phase0"])
+@spec_state_test
+@never_bls
+def test_full_genesis_is_valid(spec, state):
+    yield "genesis", state.copy()
+    valid = spec.is_valid_genesis_state(state)
+    yield "is_valid", "data", bool(valid)
+    assert valid
+
+
+@with_phases(["phase0"])
+@spec_state_test
+@never_bls
+def test_early_genesis_time_invalid(spec, state):
+    state.genesis_time = 0
+    yield "genesis", state.copy()
+    valid = spec.is_valid_genesis_state(state)
+    yield "is_valid", "data", bool(valid)
+    assert not valid

@@ -1,0 +1,13 @@
+"""Operation-processing spec tests (pre + operation + post vectors)."""
+
+OPERATION_HANDLERS = {
+    "attestation": "consensus_specs_tpu.spec_tests.operations.test_attestation",
+    "block_header": "consensus_specs_tpu.spec_tests.operations.test_block_header",
+    "proposer_slashing":
+        "consensus_specs_tpu.spec_tests.operations.test_proposer_slashing",
+    "attester_slashing":
+        "consensus_specs_tpu.spec_tests.operations.test_attester_slashing",
+    "deposit": "consensus_specs_tpu.spec_tests.operations.test_deposit",
+    "voluntary_exit":
+        "consensus_specs_tpu.spec_tests.operations.test_voluntary_exit",
+}

@@ -1,0 +1,105 @@
+"""process_attestation operation tests (reference:
+test/phase0/block_processing/test_process_attestation.py shape; vector
+format tests/formats/operations)."""
+from ...ssz import uint64
+from ...test_infra.context import (
+    spec_state_test, with_all_phases, with_all_phases_from, always_bls)
+from ...test_infra.attestations import (
+    get_valid_attestation, sign_attestation)
+from ...test_infra.blocks import transition_to
+
+
+def run_attestation_processing(spec, state, attestation, valid=True):
+    yield "pre", state.copy()
+    yield "attestation", attestation
+    if not valid:
+        try:
+            spec.process_attestation(state, attestation)
+        except (AssertionError, ValueError, IndexError):
+            yield "post", None
+            return
+        raise AssertionError("attestation unexpectedly valid")
+    current_count = len(getattr(state, "current_epoch_attestations", []))
+    spec.process_attestation(state, attestation)
+    if not spec.is_post("altair"):
+        assert len(state.current_epoch_attestations) == current_count + 1
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_one_basic_attestation(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_attestation_signature(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=False)
+    attestation.signature = b"\x11" + b"\x00" * 95
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_before_inclusion_delay(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    # state.slot == attestation.slot: inclusion delay not yet satisfied
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases_from("phase0", to="capella")
+@spec_state_test
+def test_invalid_after_epoch_slots(spec, state):
+    """Pre-deneb only: EIP-7045 removed the one-epoch inclusion upper
+    bound, so this is VALID from deneb on."""
+    attestation = get_valid_attestation(spec, state, signed=True)
+    transition_to(spec, state,
+                  state.slot + spec.SLOTS_PER_EPOCH + 1)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_wrong_target_epoch(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=False)
+    attestation.data.target.epoch = uint64(
+        int(attestation.data.target.epoch) + 10)
+    sign_attestation(spec, state, attestation)
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_source_root(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=False)
+    attestation.data.source.root = b"\x77" * 32
+    sign_attestation(spec, state, attestation)
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_partial_committee_attestation(spec, state):
+    attestation = get_valid_attestation(
+        spec, state,
+        filter_participant_set=lambda p: set(list(sorted(p))[:len(p) // 2]),
+        signed=True)
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation)

@@ -1,0 +1,48 @@
+"""process_attester_slashing operation tests."""
+from ...test_infra.context import (
+    spec_state_test, with_all_phases, always_bls)
+from ...test_infra.slashings import get_valid_attester_slashing
+
+
+def run_attester_slashing_processing(spec, state, attester_slashing,
+                                     valid=True):
+    yield "pre", state.copy()
+    yield "attester_slashing", attester_slashing
+    if not valid:
+        try:
+            spec.process_attester_slashing(state, attester_slashing)
+        except (AssertionError, ValueError, IndexError):
+            yield "post", None
+            return
+        raise AssertionError("attester slashing unexpectedly valid")
+    slashable = [int(i) for i in
+                 attester_slashing.attestation_1.attesting_indices]
+    spec.process_attester_slashing(state, attester_slashing)
+    assert any(state.validators[i].slashed for i in slashable)
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_basic_double(spec, state):
+    slashing = get_valid_attester_slashing(spec, state)
+    yield from run_attester_slashing_processing(spec, state, slashing)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_sig_1(spec, state):
+    slashing = get_valid_attester_slashing(
+        spec, state, signed_1=False, signed_2=True)
+    yield from run_attester_slashing_processing(
+        spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_same_data(spec, state):
+    slashing = get_valid_attester_slashing(spec, state)
+    slashing.attestation_2 = slashing.attestation_1
+    yield from run_attester_slashing_processing(
+        spec, state, slashing, valid=False)

@@ -1,0 +1,58 @@
+"""process_block_header operation tests."""
+from ...ssz import uint64
+from ...test_infra.context import spec_state_test, with_all_phases
+from ...test_infra.blocks import build_empty_block_for_next_slot
+
+
+def run_block_header_processing(spec, state, block, valid=True):
+    spec.process_slots(state, block.slot)
+    yield "pre", state.copy()
+    yield "block", block
+    if not valid:
+        try:
+            spec.process_block_header(state, block)
+        except (AssertionError, ValueError, IndexError):
+            yield "post", None
+            return
+        raise AssertionError("block header unexpectedly valid")
+    spec.process_block_header(state, block)
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_basic_block_header(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    yield from run_block_header_processing(spec, state, block)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_slot_block_header(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    block.slot = uint64(int(state.slot) + 2)   # header slot != state slot
+    yield "pre", state.copy()
+    yield "block", block
+    try:
+        spec.process_block_header(state, block)
+    except AssertionError:
+        yield "post", None
+        return
+    raise AssertionError("unexpectedly valid")
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_parent_root(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    block.parent_root = b"\x99" * 32
+    yield from run_block_header_processing(spec, state, block, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_index(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    block.proposer_index = uint64(
+        (int(block.proposer_index) + 1) % len(state.validators))
+    yield from run_block_header_processing(spec, state, block, valid=False)

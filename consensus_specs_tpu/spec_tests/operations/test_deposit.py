@@ -1,0 +1,51 @@
+"""process_deposit operation tests (merkle proof + signature paths)."""
+from ...test_infra.context import (
+    spec_state_test, with_all_phases, with_all_phases_from)
+from ...test_infra.deposits import (
+    prepare_state_and_deposit, run_deposit_processing)
+
+
+@with_all_phases
+@spec_state_test
+def test_new_deposit_under_max(spec, state):
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE - 1
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, amount, signed=True)
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_top_up_max_effective_balance(spec, state):
+    validator_index = 0
+    amount = spec.MAX_EFFECTIVE_BALANCE // 4
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, amount, signed=True)
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases_from("phase0", to="deneb")
+@spec_state_test
+def test_new_deposit_invalid_sig(spec, state):
+    """An unsigned new-validator deposit is VALID to process but not
+    effective (no validator added) — pre-electra semantics; electra defers
+    the signature check to pending-deposit application."""
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, amount, signed=False)
+    yield from run_deposit_processing(spec, state, deposit, validator_index,
+                                      effective=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_deposit_proof(spec, state):
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, amount, signed=True)
+    deposit.proof[3] = b"\x55" * 32
+    yield from run_deposit_processing(spec, state, deposit, validator_index,
+                                      valid=False)

@@ -1,0 +1,61 @@
+"""process_proposer_slashing operation tests."""
+from ...test_infra.context import (
+    spec_state_test, with_all_phases, always_bls)
+from ...test_infra.slashings import get_valid_proposer_slashing
+
+
+def run_proposer_slashing_processing(spec, state, proposer_slashing,
+                                     valid=True):
+    yield "pre", state.copy()
+    yield "proposer_slashing", proposer_slashing
+    if not valid:
+        try:
+            spec.process_proposer_slashing(state, proposer_slashing)
+        except (AssertionError, ValueError, IndexError):
+            yield "post", None
+            return
+        raise AssertionError("proposer slashing unexpectedly valid")
+    spec.process_proposer_slashing(state, proposer_slashing)
+    slashed_index = int(
+        proposer_slashing.signed_header_1.message.proposer_index)
+    # NOTE: no strict balance-decrease assert — when the slashed validator
+    # is also the block proposer (as here), electra's EIP-7251 quotients
+    # make penalty and whistleblower reward cancel exactly
+    assert state.validators[slashed_index].slashed
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_basic_proposer_slashing(spec, state):
+    slashing = get_valid_proposer_slashing(spec, state)
+    yield from run_proposer_slashing_processing(spec, state, slashing)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_sig_1(spec, state):
+    slashing = get_valid_proposer_slashing(
+        spec, state, signed_1=False, signed_2=True)
+    yield from run_proposer_slashing_processing(
+        spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_identical_headers(spec, state):
+    slashing = get_valid_proposer_slashing(spec, state)
+    slashing.signed_header_2 = slashing.signed_header_1
+    yield from run_proposer_slashing_processing(
+        spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_not_slashable(spec, state):
+    slashing = get_valid_proposer_slashing(spec, state)
+    index = int(slashing.signed_header_1.message.proposer_index)
+    state.validators[index].slashed = True
+    yield from run_proposer_slashing_processing(
+        spec, state, slashing, valid=False)

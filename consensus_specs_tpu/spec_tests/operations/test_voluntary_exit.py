@@ -1,0 +1,71 @@
+"""process_voluntary_exit operation tests."""
+from ...ssz import uint64
+from ...test_infra.context import (
+    spec_state_test, with_all_phases, always_bls)
+from ...test_infra.blocks import transition_to
+from ...test_infra.slashings import get_valid_voluntary_exit
+
+
+def _mature_state(spec, state):
+    """Exit requires activation + SHARD_COMMITTEE_PERIOD epochs."""
+    epochs = int(spec.config.SHARD_COMMITTEE_PERIOD) + 1
+    transition_to(spec, state,
+                  state.slot + epochs * spec.SLOTS_PER_EPOCH)
+
+
+def run_voluntary_exit_processing(spec, state, signed_exit, valid=True):
+    yield "pre", state.copy()
+    yield "voluntary_exit", signed_exit
+    index = int(signed_exit.message.validator_index)
+    if not valid:
+        try:
+            spec.process_voluntary_exit(state, signed_exit)
+        except (AssertionError, ValueError, IndexError):
+            yield "post", None
+            return
+        raise AssertionError("voluntary exit unexpectedly valid")
+    spec.process_voluntary_exit(state, signed_exit)
+    assert state.validators[index].exit_epoch < spec.FAR_FUTURE_EPOCH
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_basic_exit(spec, state):
+    _mature_state(spec, state)
+    signed_exit = get_valid_voluntary_exit(spec, state, 0)
+    yield from run_voluntary_exit_processing(spec, state, signed_exit)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_exit_signature(spec, state):
+    _mature_state(spec, state)
+    signed_exit = get_valid_voluntary_exit(spec, state, 0, signed=False)
+    yield from run_voluntary_exit_processing(spec, state, signed_exit,
+                                             valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_validator_not_active_long_enough(spec, state):
+    signed_exit = get_valid_voluntary_exit(spec, state, 0)
+    yield from run_voluntary_exit_processing(spec, state, signed_exit,
+                                             valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_exit_in_future(spec, state):
+    _mature_state(spec, state)
+    exit_msg = spec.VoluntaryExit(
+        epoch=uint64(int(spec.get_current_epoch(state)) + 10),
+        validator_index=uint64(0))
+    from ...test_infra.keys import privkey_for_pubkey
+    from ...test_infra.slashings import sign_voluntary_exit
+    signed_exit = sign_voluntary_exit(
+        spec, state, exit_msg,
+        privkey_for_pubkey(state.validators[0].pubkey))
+    yield from run_voluntary_exit_processing(spec, state, signed_exit,
+                                             valid=False)

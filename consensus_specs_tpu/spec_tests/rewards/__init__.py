@@ -1,0 +1,5 @@
+"""Per-component reward/penalty delta spec tests."""
+
+REWARDS_HANDLERS = {
+    "basic": "consensus_specs_tpu.spec_tests.rewards.test_basic",
+}

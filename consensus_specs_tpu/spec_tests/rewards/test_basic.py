@@ -1,0 +1,81 @@
+"""Reward/penalty component-delta tests (reference test/helpers/rewards.py
+capability; vector format tests/formats/rewards: one Deltas object per
+component).
+
+phase0 emits source/target/head/inclusion_delay/inactivity components from
+the pending-attestation path; altair+ emits the three flag components plus
+inactivity from participation flags.
+"""
+from ...ssz import List, uint64
+from ...ssz.types import Container
+from ...test_infra.context import (
+    spec_state_test, with_all_phases, never_bls)
+from ...test_infra.blocks import next_epoch
+from ...test_infra.attestations import next_epoch_with_attestations
+
+VALIDATOR_REGISTRY_LIMIT = 2**40
+
+
+class Deltas(Container):
+    rewards: List[uint64, VALIDATOR_REGISTRY_LIMIT]
+    penalties: List[uint64, VALIDATOR_REGISTRY_LIMIT]
+
+
+def _emit_deltas(spec, state):
+    """Yield per-component Deltas matching the scalar spec helpers."""
+    from ...specs import epoch_fast
+    with epoch_fast.scalar_epoch():
+        if spec.is_post("altair"):
+            names = ["source", "target", "head"]
+            for flag_index, name in enumerate(names):
+                rewards, penalties = spec.get_flag_index_deltas(
+                    state, flag_index)
+                yield f"{name}_deltas", Deltas(rewards=rewards,
+                                               penalties=penalties)
+            rewards, penalties = spec.get_inactivity_penalty_deltas(state)
+            yield "inactivity_penalty_deltas", Deltas(
+                rewards=rewards, penalties=penalties)
+        else:
+            pairs = [
+                ("source_deltas", spec.get_source_deltas),
+                ("target_deltas", spec.get_target_deltas),
+                ("head_deltas", spec.get_head_deltas),
+                ("inclusion_delay_deltas",
+                 spec.get_inclusion_delay_deltas),
+                ("inactivity_penalty_deltas",
+                 spec.get_inactivity_penalty_deltas),
+            ]
+            for name, fn in pairs:
+                rewards, penalties = fn(state)
+                yield name, Deltas(rewards=rewards, penalties=penalties)
+
+
+def _prepare_participation(spec, state, full=True):
+    next_epoch(spec, state)
+    if spec.is_post("altair"):
+        n = len(state.validators)
+        flags = 0
+        if full:
+            for i in range(len(spec.PARTICIPATION_FLAG_WEIGHTS)):
+                flags = spec.add_flag(flags, i)
+        state.previous_epoch_participation = [flags] * n
+    elif full:
+        next_epoch_with_attestations(spec, state, False, True)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_full_participation(spec, state):
+    _prepare_participation(spec, state, full=True)
+    yield "pre", state.copy()
+    yield from _emit_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_empty_participation(spec, state):
+    _prepare_participation(spec, state, full=False)
+    yield "pre", state.copy()
+    yield from _emit_deltas(spec, state)

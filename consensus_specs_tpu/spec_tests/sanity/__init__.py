@@ -1,0 +1,6 @@
+"""Whole-block / slot-advance sanity spec tests."""
+
+SANITY_HANDLERS = {
+    "blocks": "consensus_specs_tpu.spec_tests.sanity.test_blocks",
+    "slots": "consensus_specs_tpu.spec_tests.sanity.test_slots",
+}

@@ -1,0 +1,112 @@
+"""Whole-block sanity tests (reference test/phase0/sanity/test_blocks.py
+shape; vector format tests/formats/sanity/blocks)."""
+from ...ssz import hash_tree_root, uint64
+from ...test_infra.context import (
+    spec_state_test, with_all_phases, always_bls, never_bls)
+from ...test_infra.attestations import get_valid_attestation
+from ...test_infra.blocks import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block,
+    transition_to)
+
+
+def _run_blocks(spec, state, blocks_builder, valid=True):
+    """Yield pre, apply blocks from `blocks_builder(state)`, yield each
+    signed block and post."""
+    yield "pre", state.copy()
+    signed_blocks = []
+    try:
+        signed_blocks = blocks_builder(state)
+    except (AssertionError, ValueError, IndexError):
+        if valid:
+            raise
+        yield "blocks_count", "meta", 0
+        yield "post", None
+        return
+    for i, sb in enumerate(signed_blocks):
+        yield f"blocks_{i}", sb
+    yield "blocks_count", "meta", len(signed_blocks)
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_empty_block_transition(spec, state):
+    pre_slot = int(state.slot)
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build)
+    assert int(state.slot) == pre_slot + 1
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_signed_empty_block(spec, state):
+    """Same transition with real proposer/randao signatures verified."""
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_empty_epoch_transition(spec, state):
+    pre_slot = int(state.slot)
+    def build(state):
+        from ...test_infra.blocks import build_empty_block
+        block = build_empty_block(
+            spec, state, uint64(pre_slot + spec.SLOTS_PER_EPOCH))
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build)
+    assert int(state.slot) == pre_slot + spec.SLOTS_PER_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_attestation_block(spec, state):
+    """A block carrying one attestation; participation is recorded."""
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    def build(state):
+        attestation = get_valid_attestation(
+            spec, state,
+            slot=uint64(state.slot - spec.MIN_ATTESTATION_INCLUSION_DELAY
+                        + 1),
+            signed=True)
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.attestations.append(attestation)
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_invalid_prev_slot_block(spec, state):
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        signed = state_transition_and_sign_block(spec, state.copy(), block)
+        # re-applying at the same slot must fail
+        spec.state_transition(state, signed)
+        spec.state_transition(state, signed)
+        return [signed]
+    yield from _run_blocks(spec, state, build, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_invalid_state_root(spec, state):
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.state_root = b"\xaa" * 32
+        from ...test_infra.blocks import sign_block
+        signed = sign_block(spec, state, block)
+        spec.state_transition(state, signed)
+        return [signed]
+    yield from _run_blocks(spec, state, build, valid=False)

@@ -1,0 +1,39 @@
+"""Slot-advance sanity tests (vector format tests/formats/sanity/slots:
+pre + slots.yaml + post)."""
+from ...ssz import hash_tree_root, uint64
+from ...test_infra.context import spec_state_test, with_all_phases
+
+
+def _run_slots(spec, state, slots: int):
+    yield "pre", state.copy()
+    yield "slots", "data", int(slots)
+    spec.process_slots(state, uint64(int(state.slot) + slots))
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_slots_1(spec, state):
+    pre_root = hash_tree_root(state)
+    yield from _run_slots(spec, state, 1)
+    assert hash_tree_root(state) != pre_root
+    assert int(state.slot) == 1
+
+
+@with_all_phases
+@spec_state_test
+def test_empty_epoch(spec, state):
+    yield from _run_slots(spec, state, int(spec.SLOTS_PER_EPOCH))
+
+
+@with_all_phases
+@spec_state_test
+def test_double_empty_epoch(spec, state):
+    yield from _run_slots(spec, state, 2 * int(spec.SLOTS_PER_EPOCH))
+
+
+@with_all_phases
+@spec_state_test
+def test_over_epoch_boundary(spec, state):
+    spec.process_slots(state, uint64(int(spec.SLOTS_PER_EPOCH) // 2))
+    yield from _run_slots(spec, state, int(spec.SLOTS_PER_EPOCH))

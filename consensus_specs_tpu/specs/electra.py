@@ -189,9 +189,9 @@ class ElectraSpec(DenebSpec):
         MAX_RANDOM_VALUE = 2**16 - 1
         i = 0
         total = len(indices)
+        perm = self._shuffle_permutation(seed, total)
         while True:
-            candidate_index = indices[self.compute_shuffled_index(
-                i % total, total, seed)]
+            candidate_index = indices[int(perm[i % total])]
             random_bytes = self.hash(
                 bytes(seed) + self.uint_to_bytes(uint64(i // 16)))
             offset = i % 16 * 2
@@ -450,6 +450,10 @@ class ElectraSpec(DenebSpec):
     def process_slashings(self, state) -> None:
         """Increment-factored correlation penalty
         (electra/beacon-chain.md:846)."""
+        from . import epoch_fast
+        if epoch_fast.ENABLED:
+            epoch_fast.slashings_pass(self, state)
+            return
         epoch = self.get_current_epoch(state)
         total_balance = self.get_total_active_balance(state)
         adjusted_total_slashing_balance = min(

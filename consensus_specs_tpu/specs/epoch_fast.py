@@ -1,0 +1,392 @@
+"""Vectorized (validator-axis) epoch processing.
+
+The reference's epoch passes are per-validator Python loops over O(n)
+validators with O(n) helpers inside (e.g. `get_base_reward` recomputing the
+total active balance), which is quadratic at mainnet scale
+(reference: specs/phase0/beacon-chain.md:1553-1589, altair:385-421).  This
+engine re-designs each hot pass as numpy array sweeps over a
+structure-of-arrays extraction of the validator registry: masks instead of
+per-index `if`, scatter-adds instead of dict accumulation, one pass per
+delta family.  Write-back touches only changed elements, so the SSZ views
+stay the source of truth and results are bit-identical to the scalar spec
+methods (differential tests: tests/test_epoch_fast.py).
+
+The engine is enabled by default (ENABLED); `scalar_epoch()` restores the
+reference-shaped scalar path for differential testing.  The heavy pure
+reductions here are numpy on host — the device-bound work of an epoch
+(hash_tree_root merkleization, BLS verification, shuffling) flows through
+the JAX kernels in ops/.
+"""
+from __future__ import annotations
+
+import contextlib
+from math import isqrt
+
+import numpy as np
+
+ENABLED = True
+
+_I64MAX = np.iinfo(np.int64).max
+_ORDER_BITS = 24          # attestations per epoch < 2**24; delay keys above
+
+
+@contextlib.contextmanager
+def scalar_epoch():
+    """Temporarily disable the vectorized engine (differential testing)."""
+    global ENABLED
+    prev, ENABLED = ENABLED, False
+    try:
+        yield
+    finally:
+        ENABLED = prev
+
+
+# ---------------------------------------------------------------------------
+# structure-of-arrays extraction
+# ---------------------------------------------------------------------------
+
+class StateArrays:
+    """Validator-axis columns of the BeaconState (read-only snapshot)."""
+
+    def __init__(self, state):
+        vs = state.validators
+        n = len(vs)
+        self.n = n
+        self.eff = np.fromiter(
+            (int(v.effective_balance) for v in vs), np.int64, n)
+        self.slashed = np.fromiter((bool(v.slashed) for v in vs), bool, n)
+        self.activation_eligibility = np.fromiter(
+            (int(v.activation_eligibility_epoch) for v in vs), np.uint64, n)
+        self.activation = np.fromiter(
+            (int(v.activation_epoch) for v in vs), np.uint64, n)
+        self.exit = np.fromiter(
+            (int(v.exit_epoch) for v in vs), np.uint64, n)
+        self.withdrawable = np.fromiter(
+            (int(v.withdrawable_epoch) for v in vs), np.uint64, n)
+        self.balances = np.fromiter(
+            (int(b) for b in state.balances), np.int64, n)
+
+    def active(self, epoch) -> np.ndarray:
+        e = np.uint64(int(epoch))
+        return (self.activation <= e) & (e < self.exit)
+
+    def eligible(self, previous_epoch) -> np.ndarray:
+        """Reference get_eligible_validator_indices semantics."""
+        prev = int(previous_epoch)
+        return self.active(prev) | (
+            self.slashed & (np.uint64(prev + 1) < self.withdrawable))
+
+    def total_active_balance(self, epoch, increment) -> int:
+        return max(int(increment), int(self.eff[self.active(epoch)].sum()))
+
+
+def _write_balances(state, old: np.ndarray, new: np.ndarray) -> None:
+    for i in np.nonzero(new != old)[0]:
+        state.balances[int(i)] = int(new[i])
+
+
+# ---------------------------------------------------------------------------
+# phase0: attestation participation masks
+# ---------------------------------------------------------------------------
+
+def phase0_attestation_masks(spec, state, epoch):
+    """source/target/head attester masks for `epoch`'s pending attestations
+    plus, per source attester, the minimal-inclusion-delay key and its
+    proposer (reference beacon-chain.md:1497-1551 matching helpers)."""
+    n = len(state.validators)
+    src = np.zeros(n, bool)
+    tgt = np.zeros(n, bool)
+    head = np.zeros(n, bool)
+    best_key = np.full(n, _I64MAX, np.int64)
+    best_prop = np.zeros(n, np.int64)
+    atts = spec.get_matching_source_attestations(state, epoch)
+    if not atts:
+        return src, tgt, head, best_key, best_prop
+    target_root = spec.get_block_root(state, epoch)
+    for order, a in enumerate(atts):
+        committee = spec.get_beacon_committee(
+            state, a.data.slot, a.data.index)
+        m = len(committee)
+        comm = np.fromiter((int(c) for c in committee), np.int64, m)
+        bits = np.fromiter(
+            (bool(b) for b in a.aggregation_bits), bool, m)
+        att = comm[bits]
+        src[att] = True
+        if a.data.target.root == target_root:
+            tgt[att] = True
+            if a.data.beacon_block_root == spec.get_block_root_at_slot(
+                    state, int(a.data.slot)):
+                head[att] = True
+        key = (int(a.inclusion_delay) << _ORDER_BITS) | order
+        upd = key < best_key[att]
+        best_key[att] = np.where(upd, key, best_key[att])
+        best_prop[att] = np.where(upd, int(a.proposer_index), best_prop[att])
+    return src, tgt, head, best_key, best_prop
+
+
+def phase0_target_balances(spec, state, arr: StateArrays):
+    """(total_active, prev_target, cur_target) attesting balances for
+    justification (beacon-chain.md:1360-1386)."""
+    incr = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    cur = int(spec.get_current_epoch(state))
+    prev = int(spec.get_previous_epoch(state))
+    total = arr.total_active_balance(cur, incr)
+    out = []
+    for epoch in (prev, cur):
+        _, tgt, _, _, _ = phase0_attestation_masks(spec, state, epoch)
+        m = tgt & ~arr.slashed
+        out.append(max(incr, int(arr.eff[m].sum())))
+    return total, out[0], out[1]
+
+
+def phase0_attestation_deltas(spec, state):
+    """Vectorized get_attestation_deltas (beacon-chain.md:1553-1589):
+    source/target/head components, inclusion-delay rewards with proposer
+    scatter, inactivity-leak penalties."""
+    arr = StateArrays(state)
+    n = arr.n
+    incr = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    cur = int(spec.get_current_epoch(state))
+    prev = int(spec.get_previous_epoch(state))
+    tb = arr.total_active_balance(cur, incr)
+    base = (arr.eff * int(spec.BASE_REWARD_FACTOR) // isqrt(tb)
+            // int(spec.BASE_REWARDS_PER_EPOCH))
+    prop_reward = base // int(spec.PROPOSER_REWARD_QUOTIENT)
+    eligible = arr.eligible(prev)
+    leak = bool(spec.is_in_inactivity_leak(state))
+    finality_delay = int(spec.get_finality_delay(state))
+
+    src, tgt, head, best_key, best_prop = phase0_attestation_masks(
+        spec, state, prev)
+
+    rewards = np.zeros(n, np.int64)
+    penalties = np.zeros(n, np.int64)
+
+    # source/target/head components
+    for mask in (src, tgt, head):
+        unsl = mask & ~arr.slashed
+        att_bal = max(incr, int(arr.eff[unsl].sum()))
+        if leak:
+            comp = base
+        else:
+            comp = base * (att_bal // incr) // (tb // incr)
+        rewards += np.where(eligible & unsl, comp, 0)
+        penalties += np.where(eligible & ~unsl, base, 0)
+
+    # inclusion-delay rewards (no eligibility filter, matches scalar)
+    unsl_src = np.nonzero(src & ~arr.slashed)[0]
+    if unsl_src.size:
+        delays = best_key[unsl_src] >> _ORDER_BITS
+        max_att = base[unsl_src] - prop_reward[unsl_src]
+        np.add.at(rewards, unsl_src, max_att // delays)
+        np.add.at(rewards, best_prop[unsl_src], prop_reward[unsl_src])
+
+    # inactivity leak penalties
+    if leak:
+        unsl_tgt = tgt & ~arr.slashed
+        pen = int(spec.BASE_REWARDS_PER_EPOCH) * base - prop_reward
+        penalties += np.where(eligible, pen, 0)
+        extra = (arr.eff * finality_delay
+                 // int(spec.INACTIVITY_PENALTY_QUOTIENT))
+        penalties += np.where(eligible & ~unsl_tgt, extra, 0)
+
+    return arr, rewards, penalties
+
+
+# ---------------------------------------------------------------------------
+# altair-family: flag-based deltas
+# ---------------------------------------------------------------------------
+
+def _participation(state, which: str, n: int) -> np.ndarray:
+    col = (state.previous_epoch_participation if which == "previous"
+           else state.current_epoch_participation)
+    return np.fromiter((int(x) for x in col), np.int64, n)
+
+
+def altair_unslashed_participating(spec, state, arr, flag_index, epoch):
+    which = ("current"
+             if int(epoch) == int(spec.get_current_epoch(state))
+             else "previous")
+    part = _participation(state, which, arr.n)
+    return (arr.active(epoch) & (((part >> int(flag_index)) & 1) == 1)
+            & ~arr.slashed)
+
+
+def altair_target_balances(spec, state, arr: StateArrays):
+    incr = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    cur = int(spec.get_current_epoch(state))
+    prev = int(spec.get_previous_epoch(state))
+    flag = int(spec.TIMELY_TARGET_FLAG_INDEX)
+    total = arr.total_active_balance(cur, incr)
+    prev_m = altair_unslashed_participating(spec, state, arr, flag, prev)
+    cur_m = altair_unslashed_participating(spec, state, arr, flag, cur)
+    return (total,
+            max(incr, int(arr.eff[prev_m].sum())),
+            max(incr, int(arr.eff[cur_m].sum())))
+
+
+def altair_delta_sets(spec, state):
+    """Vectorized flag deltas + inactivity deltas (altair
+    beacon-chain.md:385-421), as an ordered list of (rewards, penalties) —
+    the scalar path applies each set sequentially with zero-flooring, so
+    the order is part of the semantics."""
+    arr = StateArrays(state)
+    n = arr.n
+    incr = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    cur = int(spec.get_current_epoch(state))
+    prev = int(spec.get_previous_epoch(state))
+    tb = arr.total_active_balance(cur, incr)
+    base_per_incr = (incr * int(spec.BASE_REWARD_FACTOR) // isqrt(tb))
+    base = (arr.eff // incr) * base_per_incr
+    eligible = arr.eligible(prev)
+    leak = bool(spec.is_in_inactivity_leak(state))
+    active_increments = tb // incr
+    wd = int(spec.WEIGHT_DENOMINATOR)
+
+    sets = []
+    for flag_index, weight in enumerate(spec.PARTICIPATION_FLAG_WEIGHTS):
+        w = int(weight)
+        unsl = altair_unslashed_participating(
+            spec, state, arr, flag_index, prev)
+        part_incr = int(arr.eff[unsl].sum())
+        part_incr = max(incr, part_incr) // incr
+        rewards = np.zeros(n, np.int64)
+        penalties = np.zeros(n, np.int64)
+        if not leak:
+            num = base * w * part_incr
+            rewards = np.where(eligible & unsl,
+                               num // (active_increments * wd), 0)
+        if flag_index != int(spec.TIMELY_HEAD_FLAG_INDEX):
+            penalties = np.where(eligible & ~unsl, base * w // wd, 0)
+        sets.append((rewards, penalties))
+
+    # inactivity penalties
+    scores = np.fromiter(
+        (int(s) for s in state.inactivity_scores), np.int64, n)
+    tgt_unsl = altair_unslashed_participating(
+        spec, state, arr, int(spec.TIMELY_TARGET_FLAG_INDEX), prev)
+    denom = (int(spec.config.INACTIVITY_SCORE_BIAS)
+             * int(spec.inactivity_penalty_quotient()))
+    pen = arr.eff * scores // denom
+    penalties = np.where(eligible & ~tgt_unsl, pen, 0)
+    sets.append((np.zeros(n, np.int64), penalties))
+    return arr, sets
+
+
+def altair_inactivity_updates(spec, state) -> None:
+    """Vectorized process_inactivity_updates (altair beacon-chain.md:602)."""
+    arr = StateArrays(state)
+    prev = int(spec.get_previous_epoch(state))
+    eligible = arr.eligible(prev)
+    tgt_unsl = altair_unslashed_participating(
+        spec, state, arr, int(spec.TIMELY_TARGET_FLAG_INDEX), prev)
+    scores = np.fromiter(
+        (int(s) for s in state.inactivity_scores), np.int64, arr.n)
+    new = scores.copy()
+    bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+    new = np.where(eligible & tgt_unsl, new - np.minimum(1, new), new)
+    new = np.where(eligible & ~tgt_unsl, new + bias, new)
+    if not bool(spec.is_in_inactivity_leak(state)):
+        rec = int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE)
+        new = np.where(eligible, new - np.minimum(rec, new), new)
+    for i in np.nonzero(new != scores)[0]:
+        state.inactivity_scores[int(i)] = int(new[i])
+
+
+# ---------------------------------------------------------------------------
+# balance application & remaining passes
+# ---------------------------------------------------------------------------
+
+def apply_delta_sets(state, arr: StateArrays, sets) -> None:
+    """Apply (rewards, penalties) sets sequentially with the spec's
+    zero-floor decrease semantics."""
+    bal = arr.balances
+    new = bal.copy()
+    for rewards, penalties in sets:
+        new = np.maximum(new + rewards - penalties, 0)
+    _write_balances(state, bal, new)
+    arr.balances = new
+
+
+def slashings_pass(spec, state) -> bool:
+    """Vectorized process_slashings; handles both the phase0/altair form
+    (beacon-chain.md:1640) and electra's increment-factored penalty
+    (electra beacon-chain.md:846).  Returns False if the spec overrides
+    process_slashings with something unknown."""
+    arr = StateArrays(state)
+    incr = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    epoch = int(spec.get_current_epoch(state))
+    tb = arr.total_active_balance(epoch, incr)
+    adj = min(sum(int(x) for x in state.slashings)
+              * int(spec.proportional_slashing_multiplier()), tb)
+    mask = arr.slashed & (
+        np.uint64(epoch + int(spec.EPOCHS_PER_SLASHINGS_VECTOR) // 2)
+        == arr.withdrawable)
+    if spec.is_post("electra"):
+        per_incr = adj // (tb // incr)
+        pen = (arr.eff // incr) * per_incr
+    else:
+        pen = (arr.eff // incr) * adj // tb * incr
+    new = np.maximum(arr.balances - np.where(mask, pen, 0), 0)
+    _write_balances(state, arr.balances, new)
+    return True
+
+
+def effective_balance_updates_pass(spec, state) -> None:
+    """Vectorized process_effective_balance_updates
+    (beacon-chain.md:1656; electra compounding max via credential
+    prefix)."""
+    arr = StateArrays(state)
+    incr = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    h = incr // int(spec.HYSTERESIS_QUOTIENT)
+    down = h * int(spec.HYSTERESIS_DOWNWARD_MULTIPLIER)
+    up = h * int(spec.HYSTERESIS_UPWARD_MULTIPLIER)
+    if spec.is_post("electra"):
+        prefix = np.fromiter(
+            (v.withdrawal_credentials[0] for v in state.validators),
+            np.uint8, arr.n)
+        comp = prefix == int.from_bytes(
+            bytes(spec.COMPOUNDING_WITHDRAWAL_PREFIX), "big")
+        max_eff = np.where(comp, int(spec.MAX_EFFECTIVE_BALANCE_ELECTRA),
+                           int(spec.MIN_ACTIVATION_BALANCE))
+    else:
+        max_eff = np.full(arr.n, int(spec.MAX_EFFECTIVE_BALANCE), np.int64)
+    cond = ((arr.balances + down < arr.eff)
+            | (arr.eff + up < arr.balances))
+    new_eff = np.minimum(arr.balances - arr.balances % incr, max_eff)
+    for i in np.nonzero(cond & (new_eff != arr.eff))[0]:
+        state.validators[int(i)].effective_balance = int(new_eff[i])
+
+
+def registry_updates_pass(spec, state) -> None:
+    """Vectorized pre-electra process_registry_updates
+    (beacon-chain.md:1590): mask-based eligibility/ejection detection,
+    lexsort-based activation queue; only the (rare) mutating indices run
+    scalar spec calls so churn bookkeeping stays identical."""
+    arr = StateArrays(state)
+    cur = int(spec.get_current_epoch(state))
+    far = np.uint64(int(spec.FAR_FUTURE_EPOCH))
+
+    # eligibility for the activation queue
+    elig_q = (arr.activation_eligibility == far) & (
+        arr.eff == int(spec.MAX_EFFECTIVE_BALANCE))
+    for i in np.nonzero(elig_q)[0]:
+        state.validators[int(i)].activation_eligibility_epoch = cur + 1
+        arr.activation_eligibility[i] = cur + 1
+
+    # ejections (sequential churn semantics via scalar initiate)
+    eject = arr.active(cur) & (
+        arr.eff <= int(spec.config.EJECTION_BALANCE))
+    for i in np.nonzero(eject)[0]:
+        spec.initiate_validator_exit(state, int(i))
+
+    # activation queue: finalized-eligibility, not yet activated
+    finalized = int(state.finalized_checkpoint.epoch)
+    ready = ((arr.activation_eligibility <= np.uint64(finalized))
+             & (arr.activation == far))
+    idx = np.nonzero(ready)[0]
+    order = np.lexsort((idx, arr.activation_eligibility[idx]))
+    churn = int(spec.get_validator_churn_limit(state))
+    target_epoch = int(spec.compute_activation_exit_epoch(cur))
+    for i in idx[order][:churn]:
+        state.validators[int(i)].activation_epoch = target_epoch

@@ -335,14 +335,35 @@ class Phase0Spec(Phase0ForkChoice, Phase0ValidatorDuties, BaseSpec):
             index = flip if bit else index
         return uint64(index)
 
+    _SHUFFLE_CACHE_SIZE = 8
+
+    def _shuffle_permutation(self, seed, index_count: int):
+        """Full swap-or-not permutation for (seed, n), LRU-cached per spec
+        instance — the batched counterpart of the reference's per-index LRU
+        (pysetup/spec_builders/phase0.py:59-62).  Bounded: a fresh seed per
+        epoch in a long-running generator would otherwise grow ~8n bytes
+        per epoch forever."""
+        from .shuffle import shuffle_permutation
+        cache = self._caches.setdefault("shuffle_perm_lru", {})
+        key = (bytes(seed), int(index_count))
+        if key not in cache:
+            if len(cache) >= self._SHUFFLE_CACHE_SIZE:
+                cache.pop(next(iter(cache)))
+            cache[key] = shuffle_permutation(
+                bytes(seed), int(index_count), self.SHUFFLE_ROUND_COUNT)
+        else:
+            cache[key] = cache.pop(key)   # refresh LRU order
+        return cache[key]
+
     def compute_proposer_index(self, state, indices, seed) -> int:
         """Balance-weighted rejection sampling over a shuffled candidate list."""
         assert len(indices) > 0
         MAX_RANDOM_BYTE = 2**8 - 1
         i = 0
         total = len(indices)
+        perm = self._shuffle_permutation(seed, total)
         while True:
-            candidate_index = indices[self.compute_shuffled_index(i % total, total, seed)]
+            candidate_index = indices[int(perm[i % total])]
             random_byte = self.hash(bytes(seed) + uint_to_bytes(uint64(i // 32)))[i % 32]
             effective_balance = state.validators[candidate_index].effective_balance
             if (effective_balance * MAX_RANDOM_BYTE
@@ -353,8 +374,8 @@ class Phase0Spec(Phase0ForkChoice, Phase0ValidatorDuties, BaseSpec):
     def compute_committee(self, indices, seed, index: int, count: int):
         start = len(indices) * index // count
         end = len(indices) * (index + 1) // count
-        return [indices[self.compute_shuffled_index(i, len(indices), seed)]
-                for i in range(start, end)]
+        perm = self._shuffle_permutation(seed, len(indices))
+        return [indices[int(perm[i])] for i in range(start, end)]
 
     def compute_epoch_at_slot(self, slot) -> int:
         return uint64(slot // self.SLOTS_PER_EPOCH)
@@ -426,8 +447,6 @@ class Phase0Spec(Phase0ForkChoice, Phase0ValidatorDuties, BaseSpec):
         return state.randao_mixes[epoch % self.EPOCHS_PER_HISTORICAL_VECTOR]
 
     def get_active_validator_indices(self, state, epoch):
-        key = ("active_indices", id(state), int(epoch),
-               len(state.validators))
         return [uint64(i) for i, v in enumerate(state.validators)
                 if self.is_active_validator(v, epoch)]
 
@@ -697,6 +716,14 @@ class Phase0Spec(Phase0ForkChoice, Phase0ValidatorDuties, BaseSpec):
         # no processing within the first two epochs
         if self.get_current_epoch(state) <= self.GENESIS_EPOCH + 1:
             return
+        from . import epoch_fast
+        if epoch_fast.ENABLED:
+            arr = epoch_fast.StateArrays(state)
+            total, prev_bal, cur_bal = epoch_fast.phase0_target_balances(
+                self, state, arr)
+            self.weigh_justification_and_finalization(
+                state, uint64(total), uint64(prev_bal), uint64(cur_bal))
+            return
         previous_attestations = self.get_matching_target_attestations(
             state, self.get_previous_epoch(state))
         current_attestations = self.get_matching_target_attestations(
@@ -880,6 +907,12 @@ class Phase0Spec(Phase0ForkChoice, Phase0ValidatorDuties, BaseSpec):
         # no rewards in GENESIS_EPOCH (no previous epoch to attest to)
         if self.get_current_epoch(state) == self.GENESIS_EPOCH:
             return
+        from . import epoch_fast
+        if epoch_fast.ENABLED:
+            arr, rewards, penalties = epoch_fast.phase0_attestation_deltas(
+                self, state)
+            epoch_fast.apply_delta_sets(state, arr, [(rewards, penalties)])
+            return
         rewards, penalties = self.get_attestation_deltas(state)
         for index in range(len(state.validators)):
             self.increase_balance(state, index, rewards[index])
@@ -887,6 +920,10 @@ class Phase0Spec(Phase0ForkChoice, Phase0ValidatorDuties, BaseSpec):
 
     # -- registry & leftovers
     def process_registry_updates(self, state) -> None:
+        from . import epoch_fast
+        if epoch_fast.ENABLED:
+            epoch_fast.registry_updates_pass(self, state)
+            return
         # eligibility and ejections
         for index, validator in enumerate(state.validators):
             if self.is_eligible_for_activation_queue(validator):
@@ -911,6 +948,10 @@ class Phase0Spec(Phase0ForkChoice, Phase0ValidatorDuties, BaseSpec):
                 self.get_current_epoch(state))
 
     def process_slashings(self, state) -> None:
+        from . import epoch_fast
+        if epoch_fast.ENABLED:
+            epoch_fast.slashings_pass(self, state)
+            return
         epoch = self.get_current_epoch(state)
         total_balance = self.get_total_active_balance(state)
         adjusted_total_slashing_balance = min(
@@ -936,6 +977,10 @@ class Phase0Spec(Phase0ForkChoice, Phase0ValidatorDuties, BaseSpec):
             state.eth1_data_votes = type(state.eth1_data_votes)()
 
     def process_effective_balance_updates(self, state) -> None:
+        from . import epoch_fast
+        if epoch_fast.ENABLED:
+            epoch_fast.effective_balance_updates_pass(self, state)
+            return
         for index, validator in enumerate(state.validators):
             balance = state.balances[index]
             hysteresis_increment = uint64(

@@ -1,0 +1,55 @@
+"""Vectorized swap-or-not shuffle: the full permutation in one sweep.
+
+The spec's per-index `compute_shuffled_index` (reference:
+specs/phase0/beacon-chain.md:775-797) costs 90 rounds x 2 SHA-256 per
+index.  Computing the WHOLE permutation at once collapses that to
+90 x (ceil(n/256) + 1) hashes total — every index in a 256-position block
+shares one `source` digest, and the swap decisions become numpy mask ops
+over the index axis.  This is the committee fast path the reference gets
+from its LRU layer (pysetup/spec_builders/phase0.py:59-62), re-designed as
+a batched array kernel instead of memoized scalar calls.
+
+Differentially tested against the scalar spec function
+(tests/test_epoch_fast.py::test_shuffle_permutation_matches_scalar).
+"""
+from __future__ import annotations
+
+import hashlib
+import numpy as np
+
+
+def shuffle_permutation(seed: bytes, n: int, rounds: int) -> np.ndarray:
+    """perm with perm[i] == compute_shuffled_index(i, n, seed), vectorized.
+
+    Returns an int64 array of length n.
+    """
+    if n <= 1:
+        return np.arange(max(n, 0), dtype=np.int64)
+    idx = np.arange(n, dtype=np.int64)
+    n_blocks = (n + 255) // 256
+    sha = hashlib.sha256
+    for r in range(rounds):
+        rb = bytes([r])
+        pivot = int.from_bytes(sha(seed + rb).digest()[:8], "little") % n
+        flip = (pivot - idx) % n
+        pos = np.maximum(idx, flip)
+        src = np.frombuffer(
+            b"".join(sha(seed + rb + b.to_bytes(4, "little")).digest()
+                     for b in range(n_blocks)),
+            dtype=np.uint8).reshape(n_blocks, 32)
+        byte_val = src[pos >> 8, (pos & 0xFF) >> 3]
+        bit = (byte_val >> (pos & 0x07).astype(np.uint8)) & 1
+        idx = np.where(bit == 1, flip, idx)
+    return idx
+
+
+def proposer_candidate_tables(seed: bytes, n: int,
+                              max_rounds: int = 4096) -> np.ndarray:
+    """random_byte[i] for the proposer rejection-sampling loop
+    (beacon-chain.md:802-816): byte i%32 of hash(seed + uint64(i//32))."""
+    sha = hashlib.sha256
+    n_words = (max_rounds + 31) // 32
+    return np.frombuffer(
+        b"".join(sha(seed + w.to_bytes(8, "little")).digest()
+                 for w in range(n_words)),
+        dtype=np.uint8)

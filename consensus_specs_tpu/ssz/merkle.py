@@ -59,11 +59,37 @@ def _hash_level_python(data: bytes) -> bytes:
 
 _hash_level = _hash_level_python
 
+# Bulk hasher: used instead of _hash_level for levels of >= _bulk_threshold
+# chunks, where the device batch amortizes the host<->device transfer.  Small
+# levels (the vast majority of container nodes) stay on hashlib.
+_bulk_hash_level = None
+_bulk_threshold = 2048
+
 
 def set_level_hasher(fn) -> None:
     """Install a replacement level hasher (e.g. the JAX batched kernel)."""
     global _hash_level
     _hash_level = fn if fn is not None else _hash_level_python
+
+
+def set_bulk_level_hasher(fn, threshold: int = 2048) -> None:
+    """Install a large-level hasher: `fn` receives the concatenation of 2N
+    chunks (N >= threshold) and returns the N parents.  Pass None to
+    uninstall.  This is how the TPU SHA-256 kernel plugs into every
+    hash_tree_root without penalizing small containers."""
+    global _bulk_hash_level, _bulk_threshold
+    _bulk_hash_level = fn
+    _bulk_threshold = threshold
+
+
+def use_tpu_hashing(threshold: int = 2048) -> None:
+    """Route big merkle levels through the batched JAX SHA-256 kernel."""
+    from ..ops.sha256 import hash_level_jax
+    set_bulk_level_hasher(hash_level_jax, threshold)
+
+
+def use_host_hashing() -> None:
+    set_bulk_level_hasher(None)
 
 
 def merkleize_chunks(chunks: Sequence[bytes], limit: int | None = None) -> bytes:
@@ -90,7 +116,11 @@ def merkleize_chunks(chunks: Sequence[bytes], limit: int | None = None) -> bytes
         n = len(level) // 32
         if n % 2 == 1:
             level += ZERO_HASHES[d]
-        level = _hash_level(level)
+            n += 1
+        if _bulk_hash_level is not None and n // 2 >= _bulk_threshold:
+            level = _bulk_hash_level(level)
+        else:
+            level = _hash_level(level)
     assert len(level) == 32
     return level
 

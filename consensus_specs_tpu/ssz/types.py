@@ -43,6 +43,20 @@ class SSZType:
         return cls(value)
 
     @classmethod
+    def coerce_assign(cls, value):
+        """Coerce for STORAGE inside another view.  Composite (mutable)
+        views are copied so the stored value never aliases a caller-held
+        view — the reference's remerkleable views are persistent, so
+        assignment there is by value; sharing our mutable views would let
+        a later mutation of one object silently rewrite another (e.g.
+        storing state.current_justified_checkpoint into an
+        AttestationData must snapshot it)."""
+        v = cls.coerce(value)
+        if v is value and isinstance(v, _MUTABLE_VIEW_BASES):
+            return _structural_copy(v)
+        return v
+
+    @classmethod
     def decode_bytes(cls, data: bytes):
         return cls.deserialize(data)
 
@@ -375,6 +389,9 @@ class Bits(SSZType):
     def __setitem__(self, i, v):
         self._bits[i] = bool(v)
 
+    def copy(self):
+        return _structural_copy(self)
+
     def _pack_bits(self) -> bytes:
         out = bytearray((len(self._bits) + 7) // 8)
         for i, b in enumerate(self._bits):
@@ -512,7 +529,16 @@ class _Sequence(SSZType):
 
     def __init__(self, elems=()):
         t = self.ELEM_TYPE
-        self._elems = [t.coerce(e) for e in elems]
+        self._elems = [t.coerce_assign(e) for e in elems]
+
+    @classmethod
+    def _from_elems(cls, elems: list):
+        """Internal no-coerce constructor for deserialize paths (elements
+        are freshly built and correctly typed — re-coercing would copy
+        every composite element a second time)."""
+        obj = cls.__new__(cls)
+        obj._elems = elems
+        return obj
 
     def __len__(self):
         return len(self._elems)
@@ -526,7 +552,7 @@ class _Sequence(SSZType):
         return self._elems[i]
 
     def __setitem__(self, i, v):
-        self._elems[i] = self.ELEM_TYPE.coerce(v)
+        self._elems[i] = self.ELEM_TYPE.coerce_assign(v)
 
     def index(self, v):
         return self._elems.index(self.ELEM_TYPE.coerce(v))
@@ -577,6 +603,9 @@ class _Sequence(SSZType):
             return _pack_basics(self._elems, self.ELEM_TYPE)
         return [e.hash_tree_root() for e in self._elems]
 
+    def copy(self):
+        return _structural_copy(self)
+
     def __repr__(self):
         return f"{type(self).__name__}({self._elems!r})"
 
@@ -619,7 +648,11 @@ class Vector(_Sequence, metaclass=ParamMeta):
     @classmethod
     def deserialize(cls, data):
         elems = cls._deserialize_elems(data)
-        return cls(elems)
+        if len(elems) != cls.LENGTH:
+            raise ValueError(
+                f"{cls.__name__}: need {cls.LENGTH} elements, "
+                f"got {len(elems)}")
+        return cls._from_elems(elems)
 
     def hash_tree_root(self):
         if is_basic_type(self.ELEM_TYPE):
@@ -657,7 +690,7 @@ class List(_Sequence, metaclass=ParamMeta):
     def append(self, v):
         if len(self._elems) >= self.LIMIT:
             raise ValueError("list full")
-        self._elems.append(self.ELEM_TYPE.coerce(v))
+        self._elems.append(self.ELEM_TYPE.coerce_assign(v))
 
     def pop(self, i=-1):
         return self._elems.pop(i)
@@ -667,7 +700,11 @@ class List(_Sequence, metaclass=ParamMeta):
 
     @classmethod
     def deserialize(cls, data):
-        return cls(cls._deserialize_elems(data))
+        elems = cls._deserialize_elems(data)
+        if len(elems) > cls.LIMIT:
+            raise ValueError(
+                f"{cls.__name__}: exceeds limit {cls.LIMIT}")
+        return cls._from_elems(elems)
 
     def hash_tree_root(self):
         if is_basic_type(self.ELEM_TYPE):
@@ -742,7 +779,7 @@ class Container(SSZType):
         values = {}
         for name, t in zip(self._field_names, self._field_types):
             if name in kwargs:
-                values[name] = t.coerce(kwargs.pop(name))
+                values[name] = t.coerce_assign(kwargs.pop(name))
             else:
                 values[name] = t.default()
         if kwargs:
@@ -759,7 +796,7 @@ class Container(SSZType):
     def __setattr__(self, name, value):
         if name in self._field_names:
             idx = self._field_names.index(name)
-            self._values[name] = self._field_types[idx].coerce(value)
+            self._values[name] = self._field_types[idx].coerce_assign(value)
         else:
             object.__setattr__(self, name, value)
 
@@ -830,6 +867,9 @@ class Container(SSZType):
         obj = cls.__new__(cls)
         object.__setattr__(obj, "_values", values)
         return obj
+
+    def copy(self):
+        return _structural_copy(self)
 
     def hash_tree_root(self) -> bytes:
         chunks = [self._values[n].hash_tree_root() for n in self._field_names]
@@ -904,6 +944,9 @@ class Union(SSZType, metaclass=ParamMeta):
             return cls(sel, None)
         return cls(sel, t.deserialize(data[1:]))
 
+    def copy(self):
+        return _structural_copy(self)
+
     def hash_tree_root(self):
         root = ZERO_CHUNK if self.value is None else self.value.hash_tree_root()
         return mix_in_selector(root, self.selector)
@@ -928,3 +971,43 @@ Bytes96 = ByteVector[96]
 bit = boolean
 byte = uint8
 null = None
+
+# mutable composite views: stored-by-copy on assignment (see
+# SSZType.coerce_assign).  uintN / boolean / ByteVector / ByteList are
+# immutable Python objects and safe to share.
+_MUTABLE_VIEW_BASES = (_Sequence, Container, Bits, Union)
+
+
+def _structural_copy(v):
+    """Deep copy of a composite view WITHOUT the serialize round-trip of
+    SSZType.copy(): rebuild the object graph, sharing immutable leaves
+    (uints/bytes) and recursing only through mutable views.  This is the
+    hot path of coerce_assign — every composite assignment/append pays
+    it."""
+    if isinstance(v, _Sequence):
+        t = v.ELEM_TYPE
+        if is_basic_type(t) or not issubclass(t, _MUTABLE_VIEW_BASES):
+            return type(v)._from_elems(list(v._elems))
+        return type(v)._from_elems([_structural_copy(e) for e in v._elems])
+    if isinstance(v, Container):
+        values = {}
+        for name in v._field_names:
+            f = v._values[name]
+            values[name] = (_structural_copy(f)
+                            if isinstance(f, _MUTABLE_VIEW_BASES) else f)
+        obj = type(v).__new__(type(v))
+        object.__setattr__(obj, "_values", values)
+        return obj
+    if isinstance(v, Bits):
+        obj = type(v).__new__(type(v))
+        obj._bits = list(v._bits)
+        return obj
+    if isinstance(v, Union):
+        val = v.value
+        if isinstance(val, _MUTABLE_VIEW_BASES):
+            val = _structural_copy(val)
+        obj = type(v).__new__(type(v))
+        obj.selector = v.selector
+        obj.value = val
+        return obj
+    raise TypeError(f"not a composite view: {type(v).__name__}")

@@ -1,9 +1,303 @@
-"""Test-harness context: global defaults set by pytest CLI flags.
+"""Decorator engine: fork/preset/BLS/state orchestration for spec tests.
 
-Mirrors the reference harness's context defaults
-(/root/reference/tests/core/pyspec/eth2spec/test/context.py and
-conftest.py:30-99).  The decorator engine builds on these.
+Capability parity with the reference harness's context machinery
+(/root/reference/tests/core/pyspec/eth2spec/test/context.py:282-783 —
+`@spec_state_test`, fork filters, `@with_presets`, `@always_bls`,
+`with_custom_state`, `with_config_overrides`) plus the dual-mode yield
+protocol (test/utils/utils.py:6-74), re-designed for the class-based spec
+registry: decorators attach metadata, the runner wrapper iterates the
+selected (fork x preset) targets, builds LRU-cached genesis states, and
+either drains the test body's yields (pytest mode) or streams them as a
+vector TestCase (generator mode via `make_vector_cases`).
+
+Usage:
+
+    @with_all_phases
+    @spec_state_test
+    def test_something(spec, state):
+        yield "pre", state.copy()
+        ...
+        yield "post", state
 """
+from __future__ import annotations
 
+import functools
+from contextlib import contextmanager
+
+from ..specs import get_spec
+from ..utils import bls as bls_utils
+
+# set by tests/conftest.py from CLI flags
 DEFAULT_TEST_PRESET = "minimal"
-DEFAULT_PYTEST_FORKS = None  # None = all forks
+DEFAULT_PYTEST_FORKS = None  # None = all mainline forks
+
+MAINLINE_FORKS = ["phase0", "altair", "bellatrix", "capella", "deneb",
+                  "electra", "fulu"]
+# feature forks run only when explicitly named by @with_phases
+FEATURE_FORKS = ["whisk", "eip7732", "eip6800"]
+ALL_FORKS = MAINLINE_FORKS + FEATURE_FORKS
+
+
+def is_post_fork(a: str, b: str) -> bool:
+    """True if mainline fork `a` is `b` or later."""
+    return MAINLINE_FORKS.index(a) >= MAINLINE_FORKS.index(b)
+
+
+# ---------------------------------------------------------------------------
+# balance shapers (reference context.py:103-238)
+# ---------------------------------------------------------------------------
+
+from .genesis import default_balances  # noqa: E402 (single source of truth)
+
+
+def low_balances(spec):
+    low = spec.MAX_EFFECTIVE_BALANCE // 8
+    return [low] * (spec.SLOTS_PER_EPOCH * 8)
+
+
+def misc_balances(spec):
+    n = spec.SLOTS_PER_EPOCH * 8
+    return [spec.MAX_EFFECTIVE_BALANCE * (i % 5) // 4 or
+            spec.config.EJECTION_BALANCE for i in range(n)]
+
+
+def default_activation_threshold(spec):
+    return spec.MAX_EFFECTIVE_BALANCE
+
+
+def zero_activation_threshold(spec):
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# cached genesis states
+# ---------------------------------------------------------------------------
+
+_state_cache: dict = {}
+
+
+def _genesis_state(spec, balances_fn, threshold_fn, cfg_key):
+    key = (spec.fork, spec.preset_name, cfg_key,
+           f"{balances_fn.__module__}.{balances_fn.__qualname__}",
+           f"{threshold_fn.__module__}.{threshold_fn.__qualname__}")
+    if key not in _state_cache:
+        from .genesis import create_genesis_state
+        with _forced_bls(False):
+            _state_cache[key] = create_genesis_state(
+                spec, balances_fn(spec), threshold_fn(spec))
+    return _state_cache[key].copy()
+
+
+@contextmanager
+def _forced_bls(active: bool):
+    prev = bls_utils.bls_active
+    bls_utils.bls_active = active
+    try:
+        yield
+    finally:
+        bls_utils.bls_active = prev
+
+
+# ---------------------------------------------------------------------------
+# metadata decorators
+# ---------------------------------------------------------------------------
+
+def _meta(fn) -> dict:
+    if not hasattr(fn, "_spec_meta"):
+        fn._spec_meta = {}
+    return fn._spec_meta
+
+
+def with_phases(forks):
+    def deco(fn):
+        _meta(fn)["forks"] = list(forks)
+        return fn
+    return deco
+
+
+def with_all_phases(fn):
+    _meta(fn)["forks"] = list(MAINLINE_FORKS)
+    return fn
+
+
+def with_all_phases_from(fork, to=None):
+    i = MAINLINE_FORKS.index(fork)
+    j = MAINLINE_FORKS.index(to) + 1 if to else len(MAINLINE_FORKS)
+
+    def deco(fn):
+        _meta(fn)["forks"] = MAINLINE_FORKS[i:j]
+        return fn
+    return deco
+
+
+def with_all_phases_except(excluded):
+    def deco(fn):
+        _meta(fn)["forks"] = [f for f in MAINLINE_FORKS
+                              if f not in excluded]
+        return fn
+    return deco
+
+
+def with_presets(presets, reason: str | None = None):
+    def deco(fn):
+        _meta(fn)["presets"] = list(presets)
+        _meta(fn)["preset_reason"] = reason
+        return fn
+    return deco
+
+
+def always_bls(fn):
+    _meta(fn)["bls"] = "always"
+    return fn
+
+
+def never_bls(fn):
+    _meta(fn)["bls"] = "never"
+    return fn
+
+
+def with_custom_state(balances_fn, threshold_fn=default_activation_threshold):
+    def deco(fn):
+        _meta(fn)["balances_fn"] = balances_fn
+        _meta(fn)["threshold_fn"] = threshold_fn
+        return fn
+    return deco
+
+
+def with_config_overrides(overrides: dict):
+    """Run against a spec whose runtime config has `overrides` applied
+    (reference context.py:600-665; configs are the runtime tier, so no
+    recompile — a fresh spec instance is built per overridden config)."""
+    def deco(fn):
+        _meta(fn)["config_overrides"] = dict(overrides)
+        return fn
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# the runner wrapper
+# ---------------------------------------------------------------------------
+
+def _selected_targets(meta, forks=None, presets=None):
+    """Yield (fork, preset, spec) for every applicable target."""
+    from ..config import load_config
+
+    presets = presets or [DEFAULT_TEST_PRESET]
+    test_forks = meta.get("forks") or list(MAINLINE_FORKS)
+    if forks is not None:
+        test_forks = [f for f in test_forks if f in forks]
+    elif DEFAULT_PYTEST_FORKS is not None:
+        test_forks = [f for f in test_forks if f in DEFAULT_PYTEST_FORKS]
+    overrides = meta.get("config_overrides")
+    for preset in presets:
+        if meta.get("presets") and preset not in meta["presets"]:
+            continue
+        for fork in test_forks:
+            if overrides:
+                config = load_config(preset).replace(**overrides)
+                yield fork, preset, get_spec(fork, preset, config)
+            else:
+                yield fork, preset, get_spec(fork, preset)
+
+
+@contextmanager
+def _bls_mode(meta, generator_mode: bool):
+    mode = meta.get("bls", "optional")
+    if generator_mode:
+        # emitted vectors must carry real signatures unless the test
+        # explicitly opts out
+        with _forced_bls(mode != "never"):
+            yield
+    elif mode == "always":
+        with _forced_bls(True):
+            yield
+    elif mode == "never":
+        with _forced_bls(False):
+            yield
+    else:
+        yield  # follow the session default (--disable-bls)
+
+
+def _cfg_key(meta) -> str:
+    ov = meta.get("config_overrides")
+    return "" if not ov else repr(sorted(ov.items()))
+
+
+def _run_single(fn, meta, spec, needs_state, collect):
+    kwargs = {"spec": spec}
+    if needs_state:
+        kwargs["state"] = _genesis_state(
+            spec,
+            meta.get("balances_fn", default_balances),
+            meta.get("threshold_fn", default_activation_threshold),
+            _cfg_key(meta))
+    gen = fn(**kwargs)
+    if gen is None:
+        return []
+    if collect:
+        from ..gen.vector_test import run_yields
+        return run_yields(lambda: gen)
+    for _ in gen:
+        pass
+    return []
+
+
+def _make_runner(fn, needs_state: bool):
+    @functools.wraps(fn)
+    def runner():
+        meta = _meta(runner)
+        ran = 0
+        for _fork, _preset, spec in _selected_targets(meta):
+            with _bls_mode(meta, generator_mode=False):
+                _run_single(fn, meta, spec, needs_state, collect=False)
+            ran += 1
+        if ran == 0:
+            import pytest
+            pytest.skip("no applicable (fork, preset) target")
+
+    # pytest resolves fixture names through __wrapped__/signature; this
+    # wrapper takes none — hide the inner (spec, state) signature
+    import inspect
+    runner.__signature__ = inspect.Signature()
+    if hasattr(runner, "__wrapped__"):
+        del runner.__wrapped__
+    runner._spec_meta = _meta(fn)
+    runner._spec_inner = fn
+    runner._needs_state = needs_state
+
+    def make_vector_cases(runner_name, handler_name, suite_name="pyspec",
+                          forks=None, presets=None, case_name=None):
+        """Reflect this test into generator TestCases, one per target —
+        the reference's gen_from_tests capability (gen.py:18-61)."""
+        from ..gen.typing import TestCase
+        meta = _meta(runner)
+        name = case_name or (fn.__name__[5:]
+                             if fn.__name__.startswith("test_")
+                             else fn.__name__)
+        cases = []
+        for fork, preset, spec in _selected_targets(
+                meta, forks=forks, presets=presets or ["minimal"]):
+            def case_fn(spec=spec, meta=meta):
+                with _bls_mode(meta, generator_mode=True):
+                    for part in _run_single(fn, meta, spec, needs_state,
+                                            collect=True):
+                        yield part
+            cases.append(TestCase(
+                fork_name=fork, preset_name=preset,
+                runner_name=runner_name, handler_name=handler_name,
+                suite_name=suite_name, case_name=name, case_fn=case_fn))
+        return cases
+
+    runner.make_vector_cases = make_vector_cases
+    return runner
+
+
+def spec_state_test(fn):
+    """Test body gets (spec, state); state is a fresh copy of the cached
+    mock genesis for the target (fork, preset, balances)."""
+    return _make_runner(fn, needs_state=True)
+
+
+def spec_test(fn):
+    """Test body gets (spec) only."""
+    return _make_runner(fn, needs_state=False)

@@ -1,0 +1,121 @@
+"""Deposit-building test helpers.
+
+Counterpart of the reference harness's helpers/deposits.py (468 LoC):
+build DepositData with a real signature, assemble the incremental deposit
+tree, and produce merkle proofs that satisfy process_deposit's
+is_valid_merkle_branch check (phase0 beacon-chain.md:1900).
+"""
+from __future__ import annotations
+
+from ..ssz import hash_tree_root, uint64
+from ..ssz.merkle import get_merkle_proof, merkleize_chunks, mix_in_length
+from ..utils import bls
+from .keys import privkeys, pubkeys
+
+
+def build_deposit_data(spec, pubkey, privkey, amount,
+                       withdrawal_credentials, signed=False):
+    data = spec.DepositData(
+        pubkey=pubkey,
+        withdrawal_credentials=withdrawal_credentials,
+        amount=uint64(amount))
+    if signed:
+        sign_deposit_data(spec, data, privkey)
+    return data
+
+
+def sign_deposit_data(spec, deposit_data, privkey) -> None:
+    """Deposits are signed over the genesis-version domain with a zeroed
+    validators root (they predate the chain)."""
+    deposit_message = spec.DepositMessage(
+        pubkey=deposit_data.pubkey,
+        withdrawal_credentials=deposit_data.withdrawal_credentials,
+        amount=deposit_data.amount)
+    domain = spec.compute_domain(spec.DOMAIN_DEPOSIT)
+    signing_root = spec.compute_signing_root(deposit_message, domain)
+    deposit_data.signature = bls.Sign(privkey, signing_root)
+
+
+def deposit_tree(spec, deposit_data_list):
+    """Leaves (hash_tree_root per DepositData) of the deposit contract
+    tree, padded to depth DEPOSIT_CONTRACT_TREE_DEPTH with a mixed-in
+    count — returns (root, leaves)."""
+    leaves = [bytes(hash_tree_root(d)) for d in deposit_data_list]
+    limit = 2 ** spec.DEPOSIT_CONTRACT_TREE_DEPTH
+    root = mix_in_length(merkleize_chunks(leaves, limit=limit), len(leaves))
+    return root, leaves
+
+
+def build_deposit(spec, deposit_data_list, pubkey, privkey, amount,
+                  withdrawal_credentials, signed):
+    """Append a new deposit to `deposit_data_list` and return
+    (deposit_with_proof, root, deposit_data_list)."""
+    data = build_deposit_data(spec, pubkey, privkey, amount,
+                              withdrawal_credentials, signed=signed)
+    deposit_data_list.append(data)
+    index = len(deposit_data_list) - 1
+    root, leaves = deposit_tree(spec, deposit_data_list)
+    limit = 2 ** spec.DEPOSIT_CONTRACT_TREE_DEPTH
+    proof = get_merkle_proof(leaves, index, limit=limit) + [
+        int(len(leaves)).to_bytes(32, "little")]
+    deposit = spec.Deposit(proof=proof, data=data)
+    return deposit, root, deposit_data_list
+
+
+def prepare_state_and_deposit(spec, state, validator_index, amount,
+                              withdrawal_credentials=None, signed=False):
+    """Mutate state's eth1 data to commit to a one-deposit tree and return
+    the matching Deposit (reference helpers/deposits.py
+    prepare_state_and_deposit)."""
+    pubkey = pubkeys[validator_index]
+    privkey = privkeys[validator_index]
+    if withdrawal_credentials is None:
+        withdrawal_credentials = (
+            spec.BLS_WITHDRAWAL_PREFIX + bytes(spec.hash(pubkey))[1:])
+    deposit, root, _ = build_deposit(
+        spec, [], pubkey, privkey, amount, withdrawal_credentials, signed)
+    state.eth1_deposit_index = 0
+    state.eth1_data.deposit_root = root
+    state.eth1_data.deposit_count = 1
+    return deposit
+
+
+def run_deposit_processing(spec, state, deposit, validator_index,
+                           valid=True, effective=True):
+    """Yield-protocol driver for a deposit operation case.
+
+    Pre-electra, effects land immediately; electra (EIP-6110) queues a
+    PendingDeposit and defers balance/registry effects."""
+    pre_validator_count = len(state.validators)
+    pre_balance = 0
+    is_top_up = validator_index < pre_validator_count
+    if is_top_up:
+        pre_balance = int(state.balances[validator_index])
+    pre_pending = (len(state.pending_deposits)
+                   if spec.is_post("electra") else 0)
+
+    yield "pre", state.copy()
+    yield "deposit", deposit
+
+    if not valid:
+        try:
+            spec.process_deposit(state, deposit)
+        except (AssertionError, ValueError, IndexError):
+            yield "post", None
+            return
+        raise AssertionError("expected invalid deposit")
+
+    spec.process_deposit(state, deposit)
+    yield "post", state
+
+    if spec.is_post("electra"):
+        # EIP-6110: the balance is queued as a PendingDeposit; a new valid
+        # pubkey still lands in the registry immediately (with 0 balance)
+        assert len(state.pending_deposits) == pre_pending + 1
+    elif not effective:
+        assert len(state.validators) == pre_validator_count
+    elif is_top_up:
+        assert state.balances[validator_index] == \
+            pre_balance + deposit.data.amount
+    else:
+        assert len(state.validators) == pre_validator_count + 1

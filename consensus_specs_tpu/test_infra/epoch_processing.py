@@ -1,0 +1,71 @@
+"""Epoch-processing test driver.
+
+Counterpart of the reference harness's helpers/epoch_processing.py: run the
+epoch passes preceding a target pass, then yield pre/post around the target
+— the shape of every `epoch_processing` conformance vector.
+"""
+from __future__ import annotations
+
+from ..ssz import uint64
+from .blocks import transition_to
+
+
+def epoch_pass_order(spec) -> list:
+    """Sub-pass order of process_epoch for this fork (mirrors the
+    per-fork process_epoch bodies; phase0 beacon-chain.md:1302, altair
+    :564, electra :800)."""
+    if not spec.is_post("altair"):
+        return [
+            "process_justification_and_finalization",
+            "process_rewards_and_penalties",
+            "process_registry_updates",
+            "process_slashings",
+            "process_eth1_data_reset",
+            "process_effective_balance_updates",
+            "process_slashings_reset",
+            "process_randao_mixes_reset",
+            "process_historical_roots_update",
+            "process_participation_record_updates",
+        ]
+    order = [
+        "process_justification_and_finalization",
+        "process_inactivity_updates",
+        "process_rewards_and_penalties",
+        "process_registry_updates",
+        "process_slashings",
+        "process_eth1_data_reset",
+    ]
+    if spec.is_post("electra"):
+        order += ["process_pending_deposits",
+                  "process_pending_consolidations"]
+    order += ["process_effective_balance_updates",
+              "process_slashings_reset",
+              "process_randao_mixes_reset"]
+    if spec.is_post("capella"):
+        order += ["process_historical_summaries_update"]
+    else:
+        order += ["process_historical_roots_update"]
+    order += ["process_participation_flag_updates",
+              "process_sync_committee_updates"]
+    return order
+
+
+def run_epoch_processing_to(spec, state, pass_name: str) -> None:
+    """Advance to the final slot of the epoch, then run every pass that
+    precedes `pass_name`."""
+    slot = uint64(state.slot + spec.SLOTS_PER_EPOCH
+                  - state.slot % spec.SLOTS_PER_EPOCH - 1)
+    transition_to(spec, state, slot)
+    for name in epoch_pass_order(spec):
+        if name == pass_name:
+            return
+        getattr(spec, name)(state)
+    raise ValueError(f"unknown epoch pass {pass_name!r}")
+
+
+def run_epoch_processing_with(spec, state, pass_name: str):
+    """Yield-protocol driver: pre, run `pass_name`, post."""
+    run_epoch_processing_to(spec, state, pass_name)
+    yield "pre", state.copy()
+    getattr(spec, pass_name)(state)
+    yield "post", state

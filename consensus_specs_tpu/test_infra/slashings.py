@@ -1,0 +1,86 @@
+"""Proposer/attester-slashing and voluntary-exit test helpers.
+
+Counterpart of the reference harness's helpers/{proposer_slashings,
+attester_slashings,voluntary_exits}.py: build conflicting signed headers,
+conflicting attestations, and signed exits for operation tests.
+"""
+from __future__ import annotations
+
+from ..ssz import hash_tree_root, uint64
+from ..utils import bls
+from .attestations import get_valid_attestation, sign_attestation
+from .keys import privkey_for_pubkey
+
+
+def sign_block_header(spec, state, header, privkey):
+    domain = spec.get_domain(state, spec.DOMAIN_BEACON_PROPOSER,
+                             spec.compute_epoch_at_slot(header.slot))
+    signing_root = spec.compute_signing_root(header, domain)
+    return spec.SignedBeaconBlockHeader(
+        message=header, signature=bls.Sign(privkey, signing_root))
+
+
+def get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True,
+                                proposer_index=None):
+    if proposer_index is None:
+        proposer_index = spec.get_beacon_proposer_index(state)
+    privkey = privkey_for_pubkey(state.validators[proposer_index].pubkey)
+    slot = state.slot
+
+    header_1 = spec.BeaconBlockHeader(
+        slot=slot, proposer_index=proposer_index,
+        parent_root=b"\x33" * 32, state_root=b"\x44" * 32,
+        body_root=b"\x55" * 32)
+    header_2 = header_1.copy()
+    header_2.state_root = b"\x99" * 32
+
+    if signed_1:
+        signed_header_1 = sign_block_header(spec, state, header_1, privkey)
+    else:
+        signed_header_1 = spec.SignedBeaconBlockHeader(message=header_1)
+    if signed_2:
+        signed_header_2 = sign_block_header(spec, state, header_2, privkey)
+    else:
+        signed_header_2 = spec.SignedBeaconBlockHeader(message=header_2)
+    return spec.ProposerSlashing(signed_header_1=signed_header_1,
+                                 signed_header_2=signed_header_2)
+
+
+def get_valid_attester_slashing(spec, state, slot=None, signed_1=True,
+                                signed_2=True):
+    """Two attestations with the same data except beacon_block_root — a
+    double vote."""
+    att_1 = get_valid_attestation(spec, state, slot=slot, signed=signed_1)
+    att_2 = att_1.copy()
+    att_2.data.beacon_block_root = b"\x01" * 32
+    if signed_2:
+        sign_attestation(spec, state, att_2)
+    return spec.AttesterSlashing(
+        attestation_1=spec.get_indexed_attestation(state, att_1),
+        attestation_2=spec.get_indexed_attestation(state, att_2))
+
+
+def sign_voluntary_exit(spec, state, voluntary_exit, privkey):
+    if spec.is_post("deneb"):
+        # EIP-7044: exits sign over the capella fork domain permanently
+        domain = spec.compute_domain(
+            spec.DOMAIN_VOLUNTARY_EXIT,
+            spec.config.CAPELLA_FORK_VERSION,
+            state.genesis_validators_root)
+    else:
+        domain = spec.get_domain(state, spec.DOMAIN_VOLUNTARY_EXIT,
+                                 voluntary_exit.epoch)
+    signing_root = spec.compute_signing_root(voluntary_exit, domain)
+    return spec.SignedVoluntaryExit(
+        message=voluntary_exit, signature=bls.Sign(privkey, signing_root))
+
+
+def get_valid_voluntary_exit(spec, state, validator_index, signed=True):
+    voluntary_exit = spec.VoluntaryExit(
+        epoch=spec.get_current_epoch(state),
+        validator_index=uint64(validator_index))
+    if signed:
+        privkey = privkey_for_pubkey(
+            state.validators[validator_index].pubkey)
+        return sign_voluntary_exit(spec, state, voluntary_exit, privkey)
+    return spec.SignedVoluntaryExit(message=voluntary_exit)

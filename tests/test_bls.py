@@ -125,3 +125,39 @@ def test_shim_live_mode():
     assert not shim.Verify(pk, b"bye", sig)
     # malformed inputs -> False, not an exception
     assert not shim.Verify(b"\x00" * 48, b"m", b"\x00" * 96)
+
+
+def test_hard_part_chain_exponent():
+    """Symbolic verification of the x-chain hard part: mirror _hard_part's
+    step sequence on integer exponents of a unitary element (order divides
+    phi = q^4 - q^2 + 1, where conjugate = negate, frobenius = *q,
+    exp-by-x = *x) and check the result is EXACTLY 3*(q^4-q^2+1)/r."""
+    from consensus_specs_tpu.crypto.fields import BLS_X
+
+    x = BLS_X
+    t2 = 1
+    t1 = 2 * t2 * -1            # cyclotomic_square + conjugate
+    t3 = t2 * x
+    t4 = 2 * t3
+    t5 = t1 + t3
+    t1 = t5 * x
+    t0 = t1 * x
+    t6 = t0 * x
+    t6 = t6 + t4
+    t4 = t6 * x
+    t5 = -t5
+    t4 = t4 + t5 + t2
+    t5 = -t2
+    t1 = t1 + t2
+    t1 = t1 * Q**3
+    t6 = t6 + t5
+    t6 = t6 * Q
+    t3 = t3 + t0
+    t3 = t3 * Q**2
+    t3 = t3 + t1
+    t3 = t3 + t6
+    result = t3 + t4
+
+    hard = (Q**4 - Q**2 + 1) // R
+    assert (Q**4 - Q**2 + 1) % R == 0
+    assert result == 3 * hard

@@ -1,0 +1,94 @@
+"""Differential tests: vectorized epoch engine vs the scalar spec path.
+
+Every pass of specs/epoch_fast.py must leave a byte-identical post-state
+(hash_tree_root equality) to the reference-shaped per-validator loops it
+replaces — across forks, with attestations/participation, slashings,
+ejections, activations and an inactivity leak in play.
+"""
+import pytest
+
+from consensus_specs_tpu.specs import get_spec
+from consensus_specs_tpu.specs import epoch_fast
+from consensus_specs_tpu.specs.shuffle import shuffle_permutation
+from consensus_specs_tpu.ssz import hash_tree_root, uint64
+from consensus_specs_tpu.test_infra import disable_bls
+from consensus_specs_tpu.test_infra.genesis import (
+    create_genesis_state, default_balances)
+from consensus_specs_tpu.test_infra.blocks import next_epoch, next_slot
+from consensus_specs_tpu.test_infra.attestations import (
+    next_epoch_with_attestations)
+
+
+def _prepared_state(spec):
+    """A state with live attestations/participation plus edge validators:
+    one slashed (correlated-penalty window), one ejectable, one pending
+    activation."""
+    state = create_genesis_state(spec, default_balances(spec))
+    next_epoch(spec, state)
+    _, state = next_epoch_with_attestations(spec, state, True, False)
+    _, state = next_epoch_with_attestations(spec, state, True, True)
+
+    # slashed validator inside the correlated-penalty halfway window
+    epoch = int(spec.get_current_epoch(state))
+    v = state.validators[3]
+    v.slashed = True
+    v.withdrawable_epoch = uint64(
+        epoch + spec.EPOCHS_PER_SLASHINGS_VECTOR // 2)
+    state.slashings[epoch % spec.EPOCHS_PER_SLASHINGS_VECTOR] = uint64(
+        10**9)
+    # ejectable validator
+    state.validators[5].effective_balance = uint64(
+        spec.config.EJECTION_BALANCE)
+    # fresh (not yet eligible) validator to exercise the activation queue
+    from consensus_specs_tpu.test_infra.genesis import build_mock_validator
+    fresh = build_mock_validator(
+        spec, len(state.validators), spec.MAX_EFFECTIVE_BALANCE)
+    state.validators.append(fresh)
+    state.balances.append(spec.MAX_EFFECTIVE_BALANCE)
+    if spec.is_post("altair"):
+        state.previous_epoch_participation.append(0)
+        state.current_epoch_participation.append(0)
+        state.inactivity_scores.append(0)
+    return state
+
+
+@pytest.mark.parametrize("fork", ["phase0", "altair", "deneb", "electra"])
+def test_process_epoch_fast_matches_scalar(fork):
+    spec = get_spec(fork, "minimal")
+    with disable_bls():
+        state = _prepared_state(spec)
+        fast_state = state.copy()
+        scalar_state = state.copy()
+        spec.process_epoch(fast_state)
+        with epoch_fast.scalar_epoch():
+            spec.process_epoch(scalar_state)
+    assert hash_tree_root(fast_state) == hash_tree_root(scalar_state)
+
+
+@pytest.mark.parametrize("fork", ["phase0", "altair"])
+def test_process_epoch_fast_matches_scalar_in_leak(fork):
+    """Finality delay > MIN_EPOCHS_TO_INACTIVITY_PENALTY: leak formulas."""
+    spec = get_spec(fork, "minimal")
+    with disable_bls():
+        state = create_genesis_state(spec, default_balances(spec))
+        # empty epochs -> no finalization -> leak; give altair some
+        # participation so deltas are not all-zero
+        for _ in range(int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 3):
+            next_epoch(spec, state)
+        _, state = next_epoch_with_attestations(spec, state, True, False)
+        assert spec.is_in_inactivity_leak(state)
+        fast_state = state.copy()
+        scalar_state = state.copy()
+        spec.process_epoch(fast_state)
+        with epoch_fast.scalar_epoch():
+            spec.process_epoch(scalar_state)
+    assert hash_tree_root(fast_state) == hash_tree_root(scalar_state)
+
+
+def test_shuffle_permutation_matches_scalar():
+    spec = get_spec("phase0", "minimal")
+    seed = bytes(range(32))
+    for n in (1, 2, 5, 33, 257, 612):
+        perm = shuffle_permutation(seed, n, spec.SHUFFLE_ROUND_COUNT)
+        assert list(perm) == [
+            int(spec.compute_shuffled_index(i, n, seed)) for i in range(n)]

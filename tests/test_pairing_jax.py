@@ -20,8 +20,10 @@ rng = Random(0xE44)
 G1 = cv.g1_generator()
 G2 = cv.g2_generator()
 
-pairing_e = jax.jit(lambda xp, yp, xq, yq: pj.final_exponentiation(
-    pj.miller_loop(xp, yp, xq, yq)))
+def pairing_e(xp, yp, xq, yq):
+    """Staged pairing: host-dispatched miller steps + staged final exp (the
+    production path; a monolithic jit would re-trace the whole chain)."""
+    return pj.final_exponentiation_staged(pj.miller_loop(xp, yp, xq, yq))
 
 
 def pack_g1_affine(points):
