@@ -23,14 +23,29 @@ from .parser import ParsedSpec, parse_markdown, parse_value
 _HEADER = '''\
 """GENERATED spec module — consensus_specs_tpu.compiler output."""
 from dataclasses import dataclass, field
+from typing import (
+    Any, Dict, NamedTuple, Optional, Sequence, Set, Tuple, TypeVar)
+
+T = TypeVar("T")
+TPoint = TypeVar("TPoint")
 from consensus_specs_tpu.ssz import (
-    boolean, uint8, uint16, uint32, uint64, uint128, uint256,
+    boolean, uint, uint8, uint16, uint32, uint64, uint128, uint256,
     Bitlist, Bitvector, ByteList, ByteVector, List, Vector, Container,
     Union, Bytes1, Bytes4, Bytes8, Bytes20, Bytes31, Bytes32, Bytes48,
-    Bytes96, hash_tree_root, serialize,
+    Bytes96, hash_tree_root, serialize, uint_to_bytes,
 )
 from consensus_specs_tpu.utils import bls
 from consensus_specs_tpu.utils.hash import hash
+
+
+def copy(value):
+    return value.copy()
+
+
+# annotation-only aliases the reference injects via its builders
+SSZObject = Container
+SSZVariableName = str
+GeneralizedIndex = int
 '''
 
 
@@ -47,6 +62,32 @@ def _const_rhs(expr: str) -> str:
     if isinstance(value, str) and value == expr.strip().strip("`"):
         return value        # unresolvable here: defer to module namespace
     return repr(value)
+
+
+def _dependency_order(defs: dict) -> list:
+    """Order name->rhs definitions so referenced names precede their
+    users; ties keep input order, unresolvable cycles fall back to input
+    order."""
+    names = set(defs)
+    deps = {n: {m for m in re.findall(r"\b(\w+)\b", rhs)
+                if m in names and m != n}
+            for n, rhs in defs.items()}
+    ordered, done = [], set()
+    while len(ordered) < len(defs):
+        progress = False
+        for name in defs:
+            if name in done:
+                continue
+            if deps[name] <= done:
+                ordered.append(name)
+                done.add(name)
+                progress = True
+        if not progress:
+            for name in defs:
+                if name not in done:
+                    ordered.append(name)
+                    done.add(name)
+    return ordered
 
 
 def dependency_order_classes(classes: dict) -> list:
@@ -75,31 +116,70 @@ def dependency_order_classes(classes: dict) -> list:
     return ordered
 
 
-def emit_source(spec: ParsedSpec, preset: dict | None = None) -> str:
+def emit_source(spec: ParsedSpec, preset: dict | None = None,
+                config: dict | None = None,
+                prelude: str = "",
+                extra_scalars: dict | None = None) -> str:
     """Assemble the module source: header, types, constants, classes,
-    functions, config."""
+    prelude, functions, config.  `preset` overrides preset-var values
+    (compile-time tier); `config` overrides config-var values (runtime
+    tier); `prelude` is fork-injected code (engine stubs, trusted
+    setups — compiler/forks.py)."""
     parts = [_HEADER]
 
-    for name, type_expr in spec.custom_types.items():
-        parts.append(f"{name} = {type_expr}")
+    # names the prelude defines (e.g. the KZG trusted-setup vectors, whose
+    # markdown table cells describe the TYPE, not a value — the reference
+    # inlines real data there too, setup.py:190-195)
+    prelude_names: set = set()
+    for m in re.finditer(r"^([A-Za-z_0-9 ,]+?)\s*=", prelude or "", re.M):
+        for tok in m.group(1).split(","):
+            if tok.strip().isidentifier():
+                prelude_names.add(tok.strip())
 
+    # presets, custom types and constants reference each other in both
+    # directions (Transaction = ByteList[MAX_BYTES_PER_TRANSACTION];
+    # GENESIS_SLOT = Slot(0); Blob = ByteVector[BYTES_PER_FIELD_ELEMENT *
+    # FIELD_ELEMENTS_PER_BLOB]) — emit them in one dependency-ordered
+    # fixpoint, like the class ordering below
     preset = dict(preset or {})
+    scalars: dict[str, str] = {}
     for name, expr in spec.preset_vars.items():
-        if name in preset:
-            parts.append(f"{name} = {preset[name]!r}")
-        else:
-            parts.append(f"{name} = {_const_rhs(expr)}")
+        if name not in prelude_names:
+            scalars[name] = (repr(preset[name]) if name in preset
+                             else _const_rhs(expr))
+    for name, type_expr in spec.custom_types.items():
+        scalars[name] = type_expr
     for name, expr in spec.constants.items():
-        parts.append(f"{name} = {_const_rhs(expr)}")
+        if name not in prelude_names:
+            scalars[name] = _const_rhs(expr)
+    for name, rhs in (extra_scalars or {}).items():
+        scalars.setdefault(name, rhs)
+
+    for name in _dependency_order(scalars):
+        parts.append(f"{name} = {scalars[name]}")
 
     for name in dependency_order_classes(spec.classes):
         parts.append(spec.classes[name])
 
+    if prelude:
+        parts.append(prelude.strip())
+
+    # runtime-config tier: bare config-var references inside function
+    # bodies are rewritten to `config.X` so tests can swap configurations
+    # without re-emitting the module (the reference's regex rewrite,
+    # pysetup/helpers.py:83-102)
+    cfg_names = sorted(spec.config_vars, key=len, reverse=True)
+    cfg_re = (re.compile(r"\b(" + "|".join(cfg_names) + r")\b")
+              if cfg_names else None)
     for name, src in spec.functions.items():
+        if cfg_re is not None:
+            src = cfg_re.sub(lambda m: f"config.{m.group(1)}", src)
         parts.append(src)
 
+    config = dict(config or {})
     cfg_items = ", ".join(
-        f"{k}={parse_value(v)!r}" for k, v in spec.config_vars.items())
+        f"{k}={config[k]!r}" if k in config else f"{k}={parse_value(v)!r}"
+        for k, v in spec.config_vars.items())
     parts.append("from consensus_specs_tpu.compiler.builder import Config")
     parts.append(f"config = Config({cfg_items})")
 
@@ -107,7 +187,10 @@ def emit_source(spec: ParsedSpec, preset: dict | None = None) -> str:
 
 
 def build_spec(doc_texts: list, preset: dict | None = None,
-               module_name: str = "generated_spec"):
+               config: dict | None = None,
+               module_name: str = "generated_spec",
+               prelude: str = "",
+               extra_scalars: dict | None = None):
     """Parse + merge fork markdown docs (oldest first) and exec the module.
 
     Returns (module, source).
@@ -115,7 +198,7 @@ def build_spec(doc_texts: list, preset: dict | None = None,
     merged = ParsedSpec()
     for text in doc_texts:
         merged = parse_markdown(text).merge_over(merged)
-    source = emit_source(merged, preset)
+    source = emit_source(merged, preset, config, prelude, extra_scalars)
     module = types.ModuleType(module_name)
     # dont_inherit: this builder's __future__ flags (stringified
     # annotations) must not leak into the generated module — SSZ field

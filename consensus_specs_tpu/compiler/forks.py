@@ -1,0 +1,125 @@
+"""Per-fork build knowledge: doc chains and injected preludes.
+
+Capability counterpart of the reference's per-fork spec builders
+(pysetup/spec_builders/*.py and pysetup/md_doc_paths.py:79-97): each fork
+names the markdown docs that feed its build and a prelude injected between
+the SSZ classes and the functions — execution-engine stubs, KZG trusted
+setup, and other symbols the reference wires in via imports.
+"""
+from __future__ import annotations
+
+import os
+
+FORK_CHAIN = ["phase0", "altair", "bellatrix", "capella", "deneb",
+              "electra", "fulu"]
+
+# docs contributed BY each fork (ancestors' docs are prepended)
+FORK_DOCS = {
+    "phase0": ["beacon-chain.md"],
+    "altair": ["beacon-chain.md", "bls.md"],
+    "bellatrix": ["beacon-chain.md"],
+    "capella": ["beacon-chain.md"],
+    "deneb": ["polynomial-commitments.md", "beacon-chain.md"],
+    "electra": ["beacon-chain.md"],
+    "fulu": ["polynomial-commitments-sampling.md", "das-core.md",
+             "beacon-chain.md"],
+}
+
+# the bellatrix execution-engine protocol: the spec treats the EL as an
+# opaque boundary; tests run against a noop engine answering True
+# (reference pysetup/spec_builders/bellatrix.py:39-64, deneb.py:48-80)
+_ENGINE_PRELUDE = '''
+class ExecutionEngine:
+    """Noop execution engine: the EL process boundary, stubbed."""
+
+    def notify_new_payload(self, *args, **kwargs) -> bool:
+        return True
+
+    def verify_and_notify_new_payload(self, new_payload_request) -> bool:
+        return True
+
+    def notify_forkchoice_updated(self, *args, **kwargs):
+        return None
+
+    def get_payload(self, payload_id):
+        raise NotImplementedError("no payload building in the noop engine")
+
+    def is_valid_block_hash(self, *args, **kwargs) -> bool:
+        return True
+
+    def is_valid_versioned_hashes(self, *args, **kwargs) -> bool:
+        return True
+
+
+NoopExecutionEngine = ExecutionEngine
+
+EXECUTION_ENGINE = NoopExecutionEngine()
+'''
+
+# deneb trusted setup: the reference inlines the JSON into the generated
+# module (setup.py:190-195); we load it through the runtime at import time
+_KZG_PRELUDE = '''
+from consensus_specs_tpu.compiler.forks import load_kzg_trusted_setup as \\
+    _load_kzg_trusted_setup
+
+KZG_SETUP_G1_MONOMIAL, KZG_SETUP_G1_LAGRANGE, KZG_SETUP_G2_MONOMIAL = \\
+    _load_kzg_trusted_setup()
+'''
+
+FORK_PRELUDES = {
+    "bellatrix": _ENGINE_PRELUDE,
+    "deneb": _KZG_PRELUDE,
+}
+
+# constants a fork's class shapes need that live in docs outside its build
+# chain (e.g. fulu's inclusion-proof depth is "predefined" in
+# p2p-interface.md) — injected into the scalar-definition fixpoint
+FORK_SCALARS = {
+    "fulu": {
+        # floorlog2(get_generalized_index(BeaconBlockBody,
+        # 'blob_kzg_commitments')): predefined in fulu/p2p-interface.md
+        "KZG_COMMITMENTS_INCLUSION_PROOF_DEPTH": "uint64(4)",
+        # discovery-layer type (phase0/p2p-interface.md custom types)
+        "NodeID": "uint256",
+    },
+}
+
+
+def load_kzg_trusted_setup():
+    """(G1 monomial, G1 lagrange, G2 monomial) as bytes48/bytes96 tuples."""
+    import json
+    path = os.path.join(os.path.dirname(__file__), "..", "config",
+                        "trusted_setups", "trusted_setup_4096.json")
+    with open(path) as f:
+        ts = json.load(f)
+    return (tuple(bytes.fromhex(h[2:]) for h in ts["g1_monomial"]),
+            tuple(bytes.fromhex(h[2:]) for h in ts["g1_lagrange"]),
+            tuple(bytes.fromhex(h[2:]) for h in ts["g2_monomial"]))
+
+
+def doc_paths(specs_dir: str, fork: str) -> list:
+    """Full doc chain for `fork`: ancestor docs oldest-first."""
+    chain = FORK_CHAIN[: FORK_CHAIN.index(fork) + 1]
+    out = []
+    for f in chain:
+        for doc in FORK_DOCS.get(f, []):
+            p = os.path.join(specs_dir, f, doc)
+            if os.path.exists(p):
+                out.append(p)
+    return out
+
+
+def fork_prelude(fork: str) -> str:
+    """Concatenated preludes of the fork and its ancestors."""
+    chain = FORK_CHAIN[: FORK_CHAIN.index(fork) + 1]
+    return "\n".join(FORK_PRELUDES[f] for f in chain
+                     if f in FORK_PRELUDES)
+
+
+def fork_scalars(fork: str) -> dict:
+    """Merged injected scalar definitions for the fork chain."""
+    chain = FORK_CHAIN[: FORK_CHAIN.index(fork) + 1]
+    out: dict = {}
+    for f in chain:
+        out.update(FORK_SCALARS.get(f, {}))
+    return out

@@ -61,10 +61,20 @@ def _is_separator_row(cells) -> bool:
     return all(re.fullmatch(r":?-+:?", c) or c == "" for c in cells)
 
 
+def _cell_expr(cell: str) -> str:
+    """Extract the code expression from a table cell: the first
+    backtick-delimited token if present (real spec cells read
+    '`uint64(2**6)` (= 64)'), else the raw cell."""
+    m = re.search(r"`([^`]+)`", cell)
+    return m.group(1) if m else cell.strip()
+
+
 def parse_markdown(text: str) -> ParsedSpec:
     spec = ParsedSpec()
     lines = text.split("\n")
-    heading = ""
+    # heading STACK so tables under '### Misc' inside '## Preset' classify
+    # by the full path (the real specs nest their preset/config tables)
+    heading_stack: list[tuple[int, str]] = []
     skip_next = False
     i = 0
     while i < len(lines):
@@ -72,7 +82,11 @@ def parse_markdown(text: str) -> ParsedSpec:
         stripped = line.strip()
 
         if stripped.startswith("#"):
-            heading = stripped.lstrip("#").strip().lower()
+            level = len(stripped) - len(stripped.lstrip("#"))
+            text_part = stripped.lstrip("#").strip().lower()
+            while heading_stack and heading_stack[-1][0] >= level:
+                heading_stack.pop()
+            heading_stack.append((level, text_part))
             i += 1
             continue
 
@@ -108,23 +122,24 @@ def parse_markdown(text: str) -> ParsedSpec:
             if len(rows) >= 2 and _is_separator_row(rows[1]):
                 header = [h.lower() for h in rows[0]]
                 body = rows[2:]
+                path = " / ".join(t for _, t in heading_stack)
                 if len(header) >= 2 and "ssz equivalent" in header[1]:
                     for cells in body:
                         if len(cells) >= 2 and cells[0]:
-                            spec.custom_types[cells[0].strip("`")] = \
-                                cells[1].strip("`")
+                            spec.custom_types[_cell_expr(cells[0])] = \
+                                _cell_expr(cells[1])
                 elif len(header) >= 2 and header[0] == "name":
                     target = spec.constants
-                    if "preset" in heading:
+                    if "preset" in path:
                         target = spec.preset_vars
-                    elif "config" in heading:
+                    elif "config" in path:
                         target = spec.config_vars
                     for cells in body:
                         if len(cells) < 2:
                             continue
-                        name = cells[0].strip("`")
+                        name = _cell_expr(cells[0])
                         if _NAME_RE.match(name):
-                            target[name] = cells[1].strip("`")
+                            target[name] = _cell_expr(cells[1])
             continue
 
         i += 1

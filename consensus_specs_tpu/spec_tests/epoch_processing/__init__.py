@@ -12,4 +12,6 @@ EPOCH_PROCESSING_HANDLERS = {
     "registry_updates":
         "consensus_specs_tpu.spec_tests.epoch_processing."
         "test_registry_updates",
+    "resets":
+        "consensus_specs_tpu.spec_tests.epoch_processing.test_resets",
 }

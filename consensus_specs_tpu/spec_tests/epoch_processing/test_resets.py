@@ -1,0 +1,53 @@
+"""Reset sub-pass epoch tests: eth1 votes, slashings vector slot, randao
+mix rotation."""
+from ...ssz import uint64
+from ...test_infra.context import spec_state_test, with_all_phases
+from ...test_infra.blocks import transition_to
+from ...test_infra.epoch_processing import run_epoch_processing_with
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_vote_reset_at_period_boundary(spec, state):
+    # advance into the LAST epoch of an eth1 voting period
+    period_slots = (int(spec.EPOCHS_PER_ETH1_VOTING_PERIOD)
+                    * int(spec.SLOTS_PER_EPOCH))
+    transition_to(spec, state, period_slots - int(spec.SLOTS_PER_EPOCH))
+    state.eth1_data_votes.append(spec.Eth1Data(deposit_count=7))
+    yield from run_epoch_processing_with(
+        spec, state, "process_eth1_data_reset")
+    assert len(state.eth1_data_votes) == 0
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_vote_no_reset_mid_period(spec, state):
+    if int(spec.EPOCHS_PER_ETH1_VOTING_PERIOD) == 1:
+        return  # every epoch is a boundary under this preset
+    state.eth1_data_votes.append(spec.Eth1Data(deposit_count=7))
+    yield from run_epoch_processing_with(
+        spec, state, "process_eth1_data_reset")
+    assert len(state.eth1_data_votes) == 1
+
+
+@with_all_phases
+@spec_state_test
+def test_slashings_reset(spec, state):
+    next_epoch = int(spec.get_current_epoch(state)) + 1
+    slot_index = next_epoch % int(spec.EPOCHS_PER_SLASHINGS_VECTOR)
+    state.slashings[slot_index] = uint64(10**9)
+    yield from run_epoch_processing_with(
+        spec, state, "process_slashings_reset")
+    assert int(state.slashings[slot_index]) == 0
+
+
+@with_all_phases
+@spec_state_test
+def test_randao_mixes_reset(spec, state):
+    current_epoch = int(spec.get_current_epoch(state))
+    next_slot_index = (current_epoch + 1) % int(
+        spec.EPOCHS_PER_HISTORICAL_VECTOR)
+    yield from run_epoch_processing_with(
+        spec, state, "process_randao_mixes_reset")
+    assert bytes(state.randao_mixes[next_slot_index]) == bytes(
+        spec.get_randao_mix(state, current_epoch))

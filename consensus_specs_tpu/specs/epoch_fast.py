@@ -89,10 +89,13 @@ def _write_balances(state, old: np.ndarray, new: np.ndarray) -> None:
 # phase0: attestation participation masks
 # ---------------------------------------------------------------------------
 
-def phase0_attestation_masks(spec, state, epoch):
+def phase0_attestation_masks(spec, state, epoch, targets_only=False):
     """source/target/head attester masks for `epoch`'s pending attestations
     plus, per source attester, the minimal-inclusion-delay key and its
-    proposer (reference beacon-chain.md:1497-1551 matching helpers)."""
+    proposer (reference beacon-chain.md:1497-1551 matching helpers).
+
+    `targets_only` skips the head/inclusion-delay bookkeeping — the
+    justification pass needs only the target mask."""
     n = len(state.validators)
     src = np.zeros(n, bool)
     tgt = np.zeros(n, bool)
@@ -114,9 +117,11 @@ def phase0_attestation_masks(spec, state, epoch):
         src[att] = True
         if a.data.target.root == target_root:
             tgt[att] = True
-            if a.data.beacon_block_root == spec.get_block_root_at_slot(
-                    state, int(a.data.slot)):
+            if not targets_only and a.data.beacon_block_root == \
+                    spec.get_block_root_at_slot(state, int(a.data.slot)):
                 head[att] = True
+        if targets_only:
+            continue
         key = (int(a.inclusion_delay) << _ORDER_BITS) | order
         upd = key < best_key[att]
         best_key[att] = np.where(upd, key, best_key[att])
@@ -133,7 +138,8 @@ def phase0_target_balances(spec, state, arr: StateArrays):
     total = arr.total_active_balance(cur, incr)
     out = []
     for epoch in (prev, cur):
-        _, tgt, _, _, _ = phase0_attestation_masks(spec, state, epoch)
+        _, tgt, _, _, _ = phase0_attestation_masks(
+            spec, state, epoch, targets_only=True)
         m = tgt & ~arr.slashed
         out.append(max(incr, int(arr.eff[m].sum())))
     return total, out[0], out[1]

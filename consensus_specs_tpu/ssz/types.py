@@ -392,6 +392,16 @@ class Bits(SSZType):
     def copy(self):
         return _structural_copy(self)
 
+    def __eq__(self, other):
+        if isinstance(other, (list, tuple)):
+            return (len(self._bits) == len(other)
+                    and all(bool(a) == bool(b)
+                            for a, b in zip(self._bits, other)))
+        return SSZType.__eq__(self, other)
+
+    def __hash__(self):
+        return SSZType.__hash__(self)
+
     def _pack_bits(self) -> bytes:
         out = bytearray((len(self._bits) + 7) // 8)
         for i, b in enumerate(self._bits):
@@ -605,6 +615,18 @@ class _Sequence(SSZType):
 
     def copy(self):
         return _structural_copy(self)
+
+    def __eq__(self, other):
+        # spec code compares views against plain python sequences
+        # (e.g. `indices == sorted(set(indices))`) — remerkleable supports
+        # this, so we must too
+        if isinstance(other, (list, tuple)):
+            return (len(self._elems) == len(other)
+                    and all(a == b for a, b in zip(self._elems, other)))
+        return SSZType.__eq__(self, other)
+
+    def __hash__(self):
+        return SSZType.__hash__(self)
 
     def __repr__(self):
         return f"{type(self).__name__}({self._elems!r})"

@@ -53,14 +53,17 @@ def epoch_pass_order(spec) -> list:
 def run_epoch_processing_to(spec, state, pass_name: str) -> None:
     """Advance to the final slot of the epoch, then run every pass that
     precedes `pass_name`."""
+    order = epoch_pass_order(spec)
+    if pass_name not in order:        # validate BEFORE mutating the state
+        raise ValueError(
+            f"unknown epoch pass {pass_name!r} for fork {spec.fork}")
     slot = uint64(state.slot + spec.SLOTS_PER_EPOCH
                   - state.slot % spec.SLOTS_PER_EPOCH - 1)
     transition_to(spec, state, slot)
-    for name in epoch_pass_order(spec):
+    for name in order:
         if name == pass_name:
             return
         getattr(spec, name)(state)
-    raise ValueError(f"unknown epoch pass {pass_name!r}")
 
 
 def run_epoch_processing_with(spec, state, pass_name: str):

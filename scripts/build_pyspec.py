@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Build executable spec modules from the markdown sources.
+
+Counterpart of the reference's `python setup.py pyspec` command
+(setup.py:397-483): for each fork, merge its doc chain (all ancestor
+forks' beacon-chain.md, oldest first) and emit one module per preset.
+
+Usage:
+    python scripts/build_pyspec.py [--specs-dir DIR] [--out DIR]
+        [--forks phase0 altair ...] [--presets minimal mainnet]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from consensus_specs_tpu.compiler.builder import build_spec  # noqa: E402
+from consensus_specs_tpu.compiler.forks import (  # noqa: E402
+    doc_paths, fork_prelude, fork_scalars)
+from consensus_specs_tpu.config import load_config, load_preset  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--specs-dir", default="/root/reference/specs")
+    ap.add_argument("--out", default="build/pyspec")
+    ap.add_argument("--forks", nargs="*", default=["phase0", "altair"])
+    ap.add_argument("--presets", nargs="*",
+                    default=["minimal", "mainnet"])
+    ns = ap.parse_args()
+
+    os.makedirs(ns.out, exist_ok=True)
+    failures = 0
+    for fork in ns.forks:
+        paths = doc_paths(ns.specs_dir, fork)
+        if not paths:
+            print(f"[build_pyspec] {fork}: no docs found, skipping")
+            continue
+        docs = [open(p).read() for p in paths]
+        for preset in ns.presets:
+            name = f"{fork}_{preset}"
+            try:
+                _mod, src = build_spec(
+                    docs, preset=load_preset(preset),
+                    config=load_config(preset).as_dict(),
+                    module_name=name, prelude=fork_prelude(fork),
+                    extra_scalars=fork_scalars(fork))
+            except Exception as e:
+                print(f"[build_pyspec] {name}: FAILED: "
+                      f"{type(e).__name__}: {e}")
+                failures += 1
+                continue
+            out_path = os.path.join(ns.out, f"{name}.py")
+            with open(out_path, "w") as f:
+                f.write(src)
+            print(f"[build_pyspec] wrote {out_path} "
+                  f"({len(src.splitlines())} lines)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -158,6 +158,7 @@ def test_hard_part_chain_exponent():
     t3 = t3 + t6
     result = t3 + t4
 
-    hard = (Q**4 - Q**2 + 1) // R
+    from consensus_specs_tpu.crypto.pairing import _HARD_EXP
     assert (Q**4 - Q**2 + 1) % R == 0
-    assert result == 3 * hard
+    assert _HARD_EXP == (Q**4 - Q**2 + 1) // R
+    assert result == 3 * _HARD_EXP
