@@ -31,6 +31,25 @@ G1_POINT_AT_INFINITY = bytes([0xC0]) + b"\x00" * 47
 _SETUP_PATH = os.path.join(os.path.dirname(__file__), "..", "config",
                            "trusted_setups", "trusted_setup_4096.json")
 
+# pluggable device MSM (the arkworks-multiexp slot of the reference's
+# backend stack): installed by use_tpu_msm(), used by g1_lincomb for big
+# batches
+_device_msm = None
+_device_msm_threshold = 128
+
+
+def set_device_msm(fn, threshold: int = 128) -> None:
+    """Install a device MSM `fn(points, scalars) -> Point` (None to
+    uninstall)."""
+    global _device_msm, _device_msm_threshold
+    _device_msm = fn
+    _device_msm_threshold = threshold
+
+
+def use_tpu_msm(threshold: int = 128) -> None:
+    from ..ops.msm import g1_multi_exp
+    set_device_msm(g1_multi_exp, threshold)
+
 
 class FieldMath:
     """Scalar-field helpers (polynomial-commitments.md "BLS field")."""
@@ -155,7 +174,13 @@ class KZG:
 
     # -- core polynomial ops
     def g1_lincomb(self, points: list[Point], scalars: list[int]) -> bytes:
-        """MSM -> compressed bytes (polynomial-commitments.md:268)."""
+        """MSM -> compressed bytes (polynomial-commitments.md:268).
+
+        Routes through the device MSM kernel when installed and the batch
+        is large enough to amortize transfer (set_device_msm); otherwise
+        the host Pippenger oracle."""
+        if _device_msm is not None and len(points) >= _device_msm_threshold:
+            return cv.g1_to_bytes(_device_msm(points, scalars))
         return cv.g1_to_bytes(msm(points, scalars))
 
     def evaluate_polynomial_in_evaluation_form(self, polynomial: list[int],

@@ -8,6 +8,7 @@ from importlib import import_module
 RUNNER_NAMES = [
     "shuffling", "ssz_static", "operations", "epoch_processing",
     "sanity", "bls", "kzg", "rewards", "finality", "genesis",
+    "fork_choice", "transition", "ssz_generic",
 ]
 
 

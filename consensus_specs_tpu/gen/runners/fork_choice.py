@@ -1,0 +1,10 @@
+"""Fork-choice step-script vectors, reflected from the dual-mode spec
+tests (spec_tests/fork_choice/*; format tests/formats/fork_choice —
+steps.yaml of on_tick/on_block/on_attestation/checks events plus one
+ssz file per referenced object)."""
+from ..reflect import providers_from_handlers
+from ...spec_tests.fork_choice import FORK_CHOICE_HANDLERS
+
+
+def providers():
+    return providers_from_handlers("fork_choice", FORK_CHOICE_HANDLERS)

@@ -1,0 +1,125 @@
+"""ssz_generic vectors: per-type valid AND invalid serializations.
+
+Format parity with the reference's tests/generators/ssz_generic (one case
+module per type family; invalid cases carry only serialized.ssz_snappy and
+clients must fail to decode them; valid cases carry value.yaml + root).
+Handlers: uints, basic_vector, bitvector, bitlist, containers, boolean.
+"""
+from random import Random
+
+from ..typing import TestCase, TestProvider
+from ...debug import encode
+from ...ssz import hash_tree_root
+from ...ssz.types import (
+    Bitlist, Bitvector, Container, List, Vector, boolean, uint8, uint16,
+    uint32, uint64, uint128, uint256)
+
+
+class SingleFieldContainer(Container):
+    a: uint64
+
+
+class SmallContainer(Container):
+    a: uint16
+    b: Vector[uint8, 4]
+
+
+class VarContainer(Container):
+    x: uint32
+    data: List[uint16, 8]
+
+
+def _valid_case(handler, name, obj):
+    def fn():
+        yield "value", "data", encode(obj)
+        yield "serialized", "ssz", obj.serialize()
+        # ssz_generic convention: the root lives in meta.yaml (roots.yaml
+        # is the ssz_static convention)
+        yield "root", "meta", "0x" + hash_tree_root(obj).hex()
+    return handler, f"valid_{name}", fn
+
+
+def _invalid_case(handler, name, typ, data: bytes):
+    def fn():
+        try:
+            typ.deserialize(data)
+        except (ValueError, IndexError):
+            pass
+        else:
+            raise AssertionError(
+                f"{typ.__name__} decoded invalid bytes {data.hex()!r}")
+        yield "serialized", "ssz", data
+    return handler, f"invalid_{name}", fn
+
+
+def _cases():
+    rng = Random(0x55A)
+    out = []
+
+    # uints: valid round-trips + wrong-length encodings
+    for typ in (uint8, uint16, uint32, uint64, uint128, uint256):
+        bits = typ.BYTE_LEN * 8
+        for label, value in [("zero", 0), ("max", (1 << bits) - 1),
+                             ("random", rng.randrange(1 << bits))]:
+            out.append(_valid_case(
+                "uints", f"uint{bits}_{label}", typ(value)))
+        out.append(_invalid_case(
+            "uints", f"uint{bits}_one_byte_longer", typ,
+            bytes(typ.BYTE_LEN + 1)))
+        out.append(_invalid_case(
+            "uints", f"uint{bits}_one_byte_shorter", typ,
+            bytes(max(typ.BYTE_LEN - 1, 0))))
+
+    # boolean: only 0x00/0x01 decode
+    out.append(_valid_case("boolean", "true", boolean(1)))
+    out.append(_valid_case("boolean", "false", boolean(0)))
+    out.append(_invalid_case("boolean", "byte_2", boolean, b"\x02"))
+    out.append(_invalid_case("boolean", "empty", boolean, b""))
+
+    # basic vectors
+    v = Vector[uint64, 4]([1, 2, 3, 4])
+    out.append(_valid_case("basic_vector", "vec_uint64_4", v))
+    out.append(_invalid_case("basic_vector", "vec_uint64_4_extra_byte",
+                             Vector[uint64, 4], v.serialize() + b"\x00"))
+    out.append(_invalid_case("basic_vector", "vec_uint64_4_truncated",
+                             Vector[uint64, 4], v.serialize()[:-1]))
+
+    # bitvector / bitlist (delimiter handling)
+    bv = Bitvector[10]([i % 2 == 0 for i in range(10)])
+    out.append(_valid_case("bitvector", "bitvec_10", bv))
+    out.append(_invalid_case("bitvector", "bitvec_10_high_padding_bit",
+                             Bitvector[10], b"\xff\xff"))
+    bl = Bitlist[8]([True, False, True])
+    out.append(_valid_case("bitlist", "bitlist_8_len3", bl))
+    out.append(_invalid_case("bitlist", "bitlist_8_no_delimiter",
+                             Bitlist[8], b"\x00"))
+    out.append(_invalid_case("bitlist", "bitlist_8_over_limit",
+                             Bitlist[8], b"\xff\x03"))
+
+    # containers: fixed and variable size, offset corruption
+    sf = SingleFieldContainer(a=0x0123456789ABCDEF)
+    out.append(_valid_case("containers", "single_field", sf))
+    out.append(_invalid_case("containers", "single_field_truncated",
+                             SingleFieldContainer, sf.serialize()[:-2]))
+    sc = SmallContainer(a=7, b=[1, 2, 3, 4])
+    out.append(_valid_case("containers", "small_fixed", sc))
+    vc = VarContainer(x=9, data=[5, 6, 7])
+    out.append(_valid_case("containers", "variable_list", vc))
+    enc = bytearray(vc.serialize())
+    enc[4] = 0xFF                       # corrupt the offset word
+    out.append(_invalid_case("containers", "variable_list_bad_offset",
+                             VarContainer, bytes(enc)))
+    out.append(_invalid_case("containers", "variable_list_offset_cut",
+                             VarContainer, vc.serialize()[:5]))
+
+    return out
+
+
+def providers():
+    def make_cases():
+        for handler, case_name, fn in _cases():
+            yield TestCase(
+                fork_name="phase0", preset_name="general",
+                runner_name="ssz_generic", handler_name=handler,
+                suite_name="ssz_generic", case_name=case_name, case_fn=fn)
+    return [TestProvider(make_cases=make_cases)]

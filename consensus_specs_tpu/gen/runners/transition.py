@@ -1,0 +1,8 @@
+"""Cross-fork transition vectors, reflected from the dual-mode spec tests
+(spec_tests/transition/*; format tests/formats/transition)."""
+from ..reflect import providers_from_handlers
+from ...spec_tests.transition import TRANSITION_HANDLERS
+
+
+def providers():
+    return providers_from_handlers("transition", TRANSITION_HANDLERS)

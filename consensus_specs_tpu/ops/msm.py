@@ -1,0 +1,61 @@
+"""Device multi-scalar multiplication: host-facing wrapper over the
+batched Jacobian MSM kernel (ops/curve_jax.py msm).
+
+Capability counterpart of the reference's arkworks `multiexp_unchecked`
+(utils/bls.py:224-296): `g1_multi_exp(points, scalars)` takes oracle G1
+Points and python ints and returns the combined Point, running the
+per-point double-and-add lanes and the pairwise tree reduction on device.
+The batch axis is padded to a power of two (with infinity/zero pairs) so
+log-many kernel shapes serve every workload size; deneb's `g1_lincomb`
+over the 4096-point Lagrange basis (polynomial-commitments.md:268) is the
+headline shape.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..crypto import curve as cv
+from ..crypto.fields import R
+from . import curve_jax as cj
+
+
+def _pad_pow2(n: int) -> int:
+    return 1 << (n - 1).bit_length() if n > 1 else 1
+
+
+def g1_multi_exp(points, scalars):
+    """sum_i scalars[i] * points[i] over G1; returns an oracle Point."""
+    if len(points) != len(scalars):
+        raise ValueError("g1_multi_exp: length mismatch")
+    if not points:
+        return cv.g1_infinity()
+    n = len(points)
+    m = _pad_pow2(n)
+    pts = list(points) + [cv.g1_infinity()] * (m - n)
+    sc = [int(s) % R for s in scalars] + [0] * (m - n)
+    packed = cj.g1_pack(pts)
+    bits = cj.scalars_to_bits(sc)
+    out = cj.g1_msm(packed, bits)
+    X = np.asarray(out[0])[None]
+    Y = np.asarray(out[1])[None]
+    Z = np.asarray(out[2])[None]
+    return cj.g1_unpack((jnp.asarray(X), jnp.asarray(Y),
+                         jnp.asarray(Z)))[0]
+
+
+def g2_multi_exp(points, scalars):
+    """sum_i scalars[i] * points[i] over G2; returns an oracle Point."""
+    if len(points) != len(scalars):
+        raise ValueError("g2_multi_exp: length mismatch")
+    if not points:
+        return cv.g2_infinity()
+    n = len(points)
+    m = _pad_pow2(n)
+    pts = list(points) + [cv.g2_infinity()] * (m - n)
+    sc = [int(s) % R for s in scalars] + [0] * (m - n)
+    packed = cj.g2_pack(pts)
+    bits = cj.scalars_to_bits(sc)
+    out = cj.g2_msm(packed, bits)
+    return cj.g2_unpack(tuple(
+        jnp.asarray(np.asarray(c))[None] for c in out))[0]

@@ -1,0 +1,8 @@
+"""Fork-choice step-script spec tests."""
+
+FORK_CHOICE_HANDLERS = {
+    "get_head":
+        "consensus_specs_tpu.spec_tests.fork_choice.test_get_head",
+    "on_block":
+        "consensus_specs_tpu.spec_tests.fork_choice.test_on_block",
+}

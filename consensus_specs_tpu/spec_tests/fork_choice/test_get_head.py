@@ -1,0 +1,87 @@
+"""get_head fork-choice tests: chains, ties, and attestation weight
+(reference test/phase0/fork_choice/test_get_head.py shape; vector format
+tests/formats/fork_choice)."""
+from ...ssz import hash_tree_root
+from ...test_infra.context import (
+    spec_state_test, with_all_phases, never_bls)
+from ...test_infra.attestations import get_valid_attestation
+from ...test_infra.blocks import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block)
+from ...test_infra.fork_choice import (
+    start_fork_choice_test, tick_and_add_block, add_attestation,
+    output_store_checks, emit_steps, tick_to_slot)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_genesis_head(spec, state):
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    anchor_root = hash_tree_root(
+        spec.BeaconBlock(state_root=hash_tree_root(state)))
+    assert spec.get_head(store) == anchor_root
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_chain_head_follows_blocks(spec, state):
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    for _ in range(3):
+        block = build_empty_block_for_next_slot(spec, state)
+        signed = state_transition_and_sign_block(spec, state, block)
+        for name, v in tick_and_add_block(spec, store, signed, steps):
+            yield name, v
+    head = spec.get_head(store)
+    assert head == hash_tree_root(signed.message)
+    assert int(store.blocks[head].slot) == 3
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_attestation_weight_decides_fork(spec, state):
+    """Two one-block forks; an attestation for the lighter tip flips the
+    head — LMD-GHOST weight at work."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+
+    state_a = state.copy()
+    state_b = state.copy()
+    block_a = build_empty_block_for_next_slot(spec, state_a)
+    signed_a = state_transition_and_sign_block(spec, state_a, block_a)
+    block_b = build_empty_block_for_next_slot(spec, state_b)
+    block_b.body.graffiti = b"\x42" * 32
+    signed_b = state_transition_and_sign_block(spec, state_b, block_b)
+
+    for name, v in tick_and_add_block(spec, store, signed_a, steps):
+        yield name, v
+    for name, v in tick_and_add_block(spec, store, signed_b, steps):
+        yield name, v
+
+    root_a = hash_tree_root(signed_a.message)
+    root_b = hash_tree_root(signed_b.message)
+    first_head = spec.get_head(store)
+    assert first_head in (root_a, root_b)
+    loser = root_b if first_head == root_a else root_a
+    loser_state = state_b if first_head == root_a else state_a
+
+    # attest to the losing tip at its own slot, deliverable one slot later
+    attestation = get_valid_attestation(
+        spec, loser_state, slot=loser_state.slot, signed=True)
+    attestation.data.beacon_block_root = loser
+    tick_to_slot(spec, store, int(loser_state.slot) + 1, steps)
+    for name, v in add_attestation(spec, store, attestation, steps):
+        yield name, v
+    assert spec.get_head(store) == loser
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)

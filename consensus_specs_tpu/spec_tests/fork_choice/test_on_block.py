@@ -1,0 +1,59 @@
+"""on_block fork-choice tests: basic application, future-slot rejection,
+unknown-parent rejection."""
+from ...ssz import hash_tree_root
+from ...test_infra.context import (
+    spec_state_test, with_all_phases, never_bls)
+from ...test_infra.blocks import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block,
+    sign_block)
+from ...test_infra.fork_choice import (
+    start_fork_choice_test, tick_and_add_block, add_block,
+    output_store_checks, emit_steps, tick_to_slot)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_basic_on_block(spec, state):
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    for name, v in tick_and_add_block(spec, store, signed, steps):
+        yield name, v
+    root = hash_tree_root(signed.message)
+    assert root in store.blocks and root in store.block_states
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_invalid_block_from_future(spec, state):
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    # build a valid block but do NOT advance store time to its slot
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    for name, v in add_block(spec, store, signed, steps, valid=False):
+        yield name, v
+    yield from emit_steps(steps)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_invalid_unknown_parent(spec, state):
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    block = build_empty_block_for_next_slot(spec, state)
+    block.parent_root = b"\x66" * 32
+    signed = sign_block(spec, state, block)
+    tick_to_slot(spec, store, int(block.slot), steps)
+    for name, v in add_block(spec, store, signed, steps, valid=False):
+        yield name, v
+    yield from emit_steps(steps)

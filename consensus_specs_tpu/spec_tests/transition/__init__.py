@@ -1,0 +1,5 @@
+"""Cross-fork transition spec tests."""
+
+TRANSITION_HANDLERS = {
+    "core": "consensus_specs_tpu.spec_tests.transition.test_transition",
+}

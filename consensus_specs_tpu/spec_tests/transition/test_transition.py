@@ -1,0 +1,54 @@
+"""Fork-boundary transition tests emitting the reference transition vector
+shape (tests/formats/transition: pre + blocks_i + post + meta with
+post_fork/fork_epoch)."""
+from ...specs import get_spec
+from ...test_infra.context import (
+    spec_test, with_phases, never_bls, MAINLINE_FORKS, _genesis_state,
+    default_balances, default_activation_threshold)
+from ...test_infra.blocks import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block)
+from ...test_infra.fork_transition import transition_across
+
+
+def _transition_case(spec, post_fork: str, fork_epoch: int = 2):
+    post_spec = get_spec(post_fork, spec.preset_name)
+    state = _genesis_state(spec, default_balances,
+                           default_activation_threshold, "")
+    yield "pre", state.copy()
+
+    post_state, fork_block = transition_across(
+        spec, post_spec, state, fork_epoch, with_block=True)
+    blocks = [fork_block] if fork_block is not None else []
+
+    # continue one slot under the post fork
+    block = build_empty_block_for_next_slot(post_spec, post_state)
+    blocks.append(
+        state_transition_and_sign_block(post_spec, post_state, block))
+
+    for i, sb in enumerate(blocks):
+        yield f"blocks_{i}", sb
+    yield "fork_epoch", "meta", fork_epoch
+    yield "post_fork", "meta", post_fork
+    yield "blocks_count", "meta", len(blocks)
+    yield "post", post_state
+
+    assert post_state.fork.current_version != state.fork.current_version
+    assert int(post_state.slot) == fork_epoch * int(
+        spec.SLOTS_PER_EPOCH) + 1
+
+
+def _make_transition_test(pre_fork: str, post_fork: str):
+    def test_fn(spec):
+        yield from _transition_case(spec, post_fork)
+    # name BEFORE wrapping: vector case names reflect the inner __name__
+    test_fn.__name__ = f"test_transition_{pre_fork}_to_{post_fork}"
+    test_fn.__qualname__ = test_fn.__name__
+    wrapped = spec_test(never_bls(test_fn))
+    return with_phases([pre_fork])(wrapped)
+
+
+# one transition test per mainline boundary
+for _pre, _post in zip(MAINLINE_FORKS, MAINLINE_FORKS[1:]):
+    _fn = _make_transition_test(_pre, _post)
+    globals()[_fn.__name__] = _fn
+del _fn

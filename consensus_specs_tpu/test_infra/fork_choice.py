@@ -1,0 +1,112 @@
+"""Step-emitting fork-choice test harness.
+
+Capability counterpart of the reference's helpers/fork_choice.py:53-235 —
+the mechanism by which multi-node behavior is tested without a network:
+each peer's view is a sequence of store events (`on_tick`, `on_block`,
+`on_attestation`, `checks`), recorded as a steps list that the fork_choice
+vector format (tests/formats/fork_choice/README.md:30-80) serializes to
+steps.yaml plus one ssz file per object.
+
+Usage inside a dual-mode test:
+
+    store, steps, anchor = start_fork_choice_test(spec, state)
+    ...
+    yield from tick_and_add_block(spec, store, signed_block, steps)
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)
+"""
+from __future__ import annotations
+
+from ..ssz import hash_tree_root
+
+
+def start_fork_choice_test(spec, state):
+    """Build the anchor store and the initial artifacts.
+
+    Returns (store, steps, emit_parts) where emit_parts are the
+    anchor_state / anchor_block artifacts to yield first."""
+    anchor_block = spec.BeaconBlock(state_root=hash_tree_root(state))
+    store = spec.get_forkchoice_store(state, anchor_block)
+    parts = [("anchor_state", state.copy()),
+             ("anchor_block", anchor_block)]
+    return store, [], parts
+
+
+def on_tick_and_append_step(spec, store, time, steps) -> None:
+    spec.on_tick(store, int(time))
+    steps.append({"tick": int(time)})
+
+
+def tick_to_slot(spec, store, slot, steps) -> None:
+    time = (int(store.genesis_time)
+            + int(slot) * int(spec.config.SECONDS_PER_SLOT))
+    on_tick_and_append_step(spec, store, time, steps)
+
+
+def add_block(spec, store, signed_block, steps, valid=True):
+    """Apply a signed block to the store, recording the step and the block
+    artifact.  Returns the artifact list to yield."""
+    root = hash_tree_root(signed_block.message)
+    name = f"block_{root.hex()[:16]}"
+    parts = [(name, signed_block)]
+    step = {"block": name, "valid": bool(valid)}
+    if not valid:
+        try:
+            spec.on_block(store, signed_block)
+        except (AssertionError, ValueError, KeyError):
+            steps.append(step)
+            return parts
+        raise AssertionError("block unexpectedly valid in fork choice")
+    spec.on_block(store, signed_block)
+    steps.append(step)
+    return parts
+
+
+def tick_and_add_block(spec, store, signed_block, steps, valid=True):
+    """Advance time to the block's slot, then apply it."""
+    slot = int(signed_block.message.slot)
+    time = (int(store.genesis_time)
+            + slot * int(spec.config.SECONDS_PER_SLOT))
+    if int(store.time) < time:
+        on_tick_and_append_step(spec, store, time, steps)
+    return add_block(spec, store, signed_block, steps, valid=valid)
+
+
+def add_attestation(spec, store, attestation, steps, valid=True):
+    root = hash_tree_root(attestation)
+    name = f"attestation_{root.hex()[:16]}"
+    parts = [(name, attestation)]
+    step = {"attestation": name, "valid": bool(valid)}
+    if not valid:
+        try:
+            spec.on_attestation(store, attestation)
+        except (AssertionError, ValueError, KeyError):
+            steps.append(step)
+            return parts
+        raise AssertionError("attestation unexpectedly valid")
+    spec.on_attestation(store, attestation)
+    steps.append(step)
+    return parts
+
+
+def output_store_checks(spec, store, steps) -> None:
+    """Record the observable store state (format README 'checks' step)."""
+    head = spec.get_head(store)
+    steps.append({"checks": {
+        "time": int(store.time),
+        "head": {"slot": int(store.blocks[head].slot),
+                 "root": "0x" + bytes(head).hex()},
+        "justified_checkpoint": {
+            "epoch": int(store.justified_checkpoint.epoch),
+            "root": "0x" + bytes(store.justified_checkpoint.root).hex()},
+        "finalized_checkpoint": {
+            "epoch": int(store.finalized_checkpoint.epoch),
+            "root": "0x" + bytes(store.finalized_checkpoint.root).hex()},
+        "proposer_boost_root":
+            "0x" + bytes(store.proposer_boost_root).hex(),
+    }})
+
+
+def emit_steps(steps):
+    """Final artifact of a fork-choice case: the steps script."""
+    yield "steps", "data", steps

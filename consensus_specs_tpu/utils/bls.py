@@ -190,7 +190,25 @@ def neg(point):
     return _native().neg(point)
 
 
+# minimum batch size for device MSM dispatch: below this the per-call
+# transfer + kernel launch (and a first-time XLA compile per shape) dwarfs
+# the host Pippenger cost
+MULTI_EXP_DEVICE_THRESHOLD = 128
+
+
 def multi_exp(points, integers):
+    """Multi-scalar multiplication over G1 or G2 points (the reference's
+    arkworks multiexp slot, bls.py:224-296).  The tpu backend routes big
+    G1/G2 batches through the device MSM kernel."""
+    if (_backend_name == "tpu"
+            and len(points) >= MULTI_EXP_DEVICE_THRESHOLD):
+        from ..crypto import curve as cv
+        from ..ops import msm as device_msm
+        first = points[0]
+        if isinstance(first, cv.Point):
+            if isinstance(first.x, cv.Fq1):
+                return device_msm.g1_multi_exp(points, integers)
+            return device_msm.g2_multi_exp(points, integers)
     return _native().multi_exp(points, integers)
 
 
