@@ -8,7 +8,9 @@ from importlib import import_module
 RUNNER_NAMES = [
     "shuffling", "ssz_static", "operations", "epoch_processing",
     "sanity", "bls", "kzg", "rewards", "finality", "genesis",
-    "fork_choice", "transition", "ssz_generic",
+    "fork_choice", "transition", "ssz_generic", "forks",
+    "merkle_proof", "networking", "kzg_7594", "random",
+    "light_client", "sync",
 ]
 
 

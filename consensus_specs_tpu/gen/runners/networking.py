@@ -1,0 +1,71 @@
+"""Networking vectors: the executable p2p helpers.
+
+Format parity with the reference's tests/generators/networking: fulu
+custody-group assignment (`get_custody_groups`,
+`compute_columns_for_custody_group`) as data.yaml input/output cases,
+plus phase0 subnet computation.
+"""
+from ..typing import TestCase, TestProvider
+from ...specs import get_spec
+
+
+def _custody_groups_case(node_id: int, count: int, label: str):
+    def fn():
+        spec = get_spec("fulu", "minimal")
+        groups = spec.get_custody_groups(node_id, count)
+        yield "data", "data", {
+            "node_id": str(node_id),
+            "custody_group_count": count,
+            "result": [int(g) for g in groups],
+        }
+        assert len(groups) == count
+        assert sorted(set(int(g) for g in groups)) == \
+            sorted(int(g) for g in groups)
+    return TestCase(
+        fork_name="fulu", preset_name="minimal", runner_name="networking",
+        handler_name="get_custody_groups", suite_name="networking",
+        case_name=label, case_fn=fn)
+
+
+def _custody_columns_case(group: int):
+    def fn():
+        spec = get_spec("fulu", "minimal")
+        columns = spec.compute_columns_for_custody_group(group)
+        yield "data", "data", {
+            "custody_group": group,
+            "result": [int(c) for c in columns],
+        }
+    return TestCase(
+        fork_name="fulu", preset_name="minimal", runner_name="networking",
+        handler_name="compute_columns_for_custody_group",
+        suite_name="networking",
+        case_name=f"group_{group}", case_fn=fn)
+
+
+def _subnets_case(node_id: int, epoch: int):
+    def fn():
+        spec = get_spec("phase0", "minimal")
+        subnets = spec.compute_subscribed_subnets(node_id, epoch)
+        yield "data", "data", {
+            "node_id": str(node_id),
+            "epoch": epoch,
+            "result": [int(s) for s in subnets],
+        }
+    return TestCase(
+        fork_name="phase0", preset_name="minimal",
+        runner_name="networking",
+        handler_name="compute_subscribed_subnets",
+        suite_name="networking",
+        case_name=f"node_{node_id % 997}_epoch_{epoch}", case_fn=fn)
+
+
+def providers():
+    def make_cases():
+        yield _custody_groups_case(0, 4, "node_zero_min_count")
+        yield _custody_groups_case(2**255 - 19, 4, "node_high")
+        yield _custody_groups_case(123456789, 128, "all_groups")
+        for group in (0, 1, 127):
+            yield _custody_columns_case(group)
+        yield _subnets_case(0, 0)
+        yield _subnets_case(2**200 + 7, 3)
+    return [TestProvider(make_cases=make_cases)]

@@ -1,0 +1,93 @@
+"""Optimistic-sync vectors: payload-status step scripts.
+
+Format parity with the reference's tests/generators/sync (format
+tests/formats/sync: fork-choice-style steps.yaml where on_block steps
+carry a payload status, plus head checks)."""
+from ..typing import TestCase, TestProvider
+from ...specs import get_spec
+from ...specs.optimistic_sync import PayloadStatus
+from ...ssz import hash_tree_root
+from ...test_infra import disable_bls
+from ...test_infra.context import (
+    _genesis_state, default_balances, default_activation_threshold)
+from ...test_infra.blocks import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block)
+
+FORKS = ["bellatrix", "capella", "deneb"]
+
+
+def _optimistic_case(fork, invalidate: bool):
+    def fn():
+        spec = get_spec(fork, "minimal")
+        with disable_bls():
+            state = _genesis_state(spec, default_balances,
+                                   default_activation_threshold,
+                                   f"sync-{fork}")
+            anchor_block = spec.BeaconBlock(
+                state_root=hash_tree_root(state))
+            store = spec.get_forkchoice_store(state, anchor_block)
+            opt_store = spec.get_optimistic_store(state, anchor_block)
+            yield "anchor_state", state.copy()
+            yield "anchor_block", anchor_block
+
+            steps = []
+            signed_blocks = []
+            for _ in range(2):
+                block = build_empty_block_for_next_slot(spec, state)
+                signed = state_transition_and_sign_block(
+                    spec, state, block)
+                signed_blocks.append(signed)
+                time = (int(store.genesis_time) + int(block.slot)
+                        * int(spec.config.SECONDS_PER_SLOT))
+                spec.on_tick(store, time)
+                steps.append({"tick": time})
+                spec.on_block(store, signed)
+                spec.optimistically_import_block(
+                    opt_store,
+                    signed.message.slot
+                    + spec.SAFE_SLOTS_TO_IMPORT_OPTIMISTICALLY,
+                    signed, PayloadStatus.NOT_VALIDATED)
+                name = "block_" + hash_tree_root(
+                    signed.message).hex()[:16]
+                yield name, signed
+                steps.append({"block": name,
+                              "payload_status": "SYNCING"})
+
+            tip = bytes(hash_tree_root(signed_blocks[-1].message))
+            assert spec.is_optimistic_node(
+                opt_store, spec.get_optimistic_head(opt_store, store))
+            if invalidate:
+                spec.invalidate_optimistic_block(opt_store, tip)
+                steps.append({
+                    "payload_status_update": {
+                        "block_root": "0x" + tip.hex(),
+                        "status": "INVALIDATED"}})
+            else:
+                spec.validate_optimistic_block(opt_store, tip)
+                steps.append({
+                    "payload_status_update": {
+                        "block_root": "0x" + tip.hex(),
+                        "status": "VALID"}})
+
+            head = bytes(spec.get_optimistic_head(opt_store, store))
+            steps.append({"checks": {
+                "head": {"root": "0x" + head.hex(),
+                         "slot": int(store.blocks[head].slot)}}})
+            if invalidate:
+                assert head != tip
+            else:
+                assert head == tip
+            yield "steps", "data", steps
+    name = "invalidated_tip" if invalidate else "all_valid"
+    return TestCase(
+        fork_name=fork, preset_name="minimal", runner_name="sync",
+        handler_name="optimistic", suite_name="optimistic_sync",
+        case_name=name, case_fn=fn)
+
+
+def providers():
+    def make_cases():
+        for fork in FORKS:
+            yield _optimistic_case(fork, invalidate=False)
+            yield _optimistic_case(fork, invalidate=True)
+    return [TestProvider(make_cases=make_cases)]

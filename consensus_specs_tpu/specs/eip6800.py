@@ -78,7 +78,25 @@ class Eip6800Spec(DenebSpec):
             if isinstance(cls, type) and issubclass(cls, Container):
                 setattr(self, name, cls)
 
+    @property
+    def EIP6800_FORK_VERSION(self):
+        # config tier (config/params.py), mirroring the reference's
+        # placeholder version in eip6800/fork.md:29; pure eip6800 networks
+        # start at this version (no upgrade_to function exists)
+        from ..ssz import Bytes4
+        return Bytes4(self.config.EIP6800_FORK_VERSION)
+
+    def genesis_fork_versions(self):
+        from ..ssz import Bytes4
+        return (Bytes4(self.config.DENEB_FORK_VERSION),
+                self.EIP6800_FORK_VERSION)
+
     def build_execution_payload_header(self, payload):
+        """The [Modified in EIP6800] half of process_execution_payload
+        (eip6800/beacon-chain.md:172-220): the cached header additionally
+        commits to the execution witness root.  The surrounding payload
+        validation is inherited from deneb's process_execution_payload,
+        which routes header construction through this hook."""
         header = super().build_execution_payload_header(payload)
         header.execution_witness_root = hash_tree_root(
             payload.execution_witness)              # [New in EIP6800]

@@ -82,10 +82,15 @@ def set_bulk_level_hasher(fn, threshold: int = 2048) -> None:
     _bulk_threshold = threshold
 
 
-def use_tpu_hashing(threshold: int = 2048) -> None:
-    """Route big merkle levels through the batched JAX SHA-256 kernel."""
-    from ..ops.sha256 import hash_level_jax
-    set_bulk_level_hasher(hash_level_jax, threshold)
+def use_tpu_hashing(threshold: int = 2048, pallas: bool = False) -> None:
+    """Route big merkle levels through the batched JAX SHA-256 kernel
+    (pallas=True selects the fused Pallas kernel — TPU backends only)."""
+    if pallas:
+        from ..ops.sha256_pallas import hash_level_pallas
+        set_bulk_level_hasher(hash_level_pallas, threshold)
+    else:
+        from ..ops.sha256 import hash_level_jax
+        set_bulk_level_hasher(hash_level_jax, threshold)
 
 
 def use_host_hashing() -> None:

@@ -328,3 +328,41 @@ def test_eip6800_payload_carries_witness(vspec):
     assert hasattr(payload, "execution_witness")
     assert state.latest_execution_payload_header.execution_witness_root \
         == hash_tree_root(payload.execution_witness)
+
+
+def test_eip6800_state_transition_with_nonempty_witness(vspec):
+    """Full state_transition over a block whose payload carries a real
+    verkle state diff; the cached header must commit to the witness."""
+    from consensus_specs_tpu.test_infra.blocks import (
+        build_empty_block_for_next_slot, state_transition_and_sign_block)
+    with disable_bls():
+        state = create_genesis_state(vspec, default_balances(vspec))
+        block = build_empty_block_for_next_slot(vspec, state)
+        witness = vspec.ExecutionWitness(
+            state_diff=[vspec.StemStateDiff(
+                stem=b"\x03" * 31,
+                suffix_diffs=[vspec.SuffixStateDiff(
+                    suffix=b"\x01",
+                    current_value=vspec.SuffixStateDiff.fields()
+                    ["current_value"](1, b"\x11" * 32),
+                    new_value=vspec.SuffixStateDiff.fields()
+                    ["new_value"](1, b"\x22" * 32))])],
+            verkle_proof=vspec.VerkleProof(
+                other_stems=[b"\x04" * 31],
+                depth_extension_present=b"\x01",
+                commitments_by_path=[b"\x05" * 32],
+                d=b"\x06" * 32))
+        block.body.execution_payload.execution_witness = witness
+        signed = state_transition_and_sign_block(vspec, state, block)
+    assert state.latest_execution_payload_header.execution_witness_root \
+        == hash_tree_root(witness)
+    # round-trip the whole signed block through SSZ
+    back = vspec.SignedBeaconBlock.deserialize(signed.serialize())
+    assert hash_tree_root(back) == hash_tree_root(signed)
+
+
+def test_eip6800_genesis_fork_version(vspec):
+    with disable_bls():
+        state = create_genesis_state(vspec, default_balances(vspec))
+    assert bytes(state.fork.current_version) == \
+        bytes(vspec.EIP6800_FORK_VERSION)
