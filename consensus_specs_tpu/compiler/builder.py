@@ -24,7 +24,8 @@ _HEADER = '''\
 """GENERATED spec module — consensus_specs_tpu.compiler output."""
 from dataclasses import dataclass, field
 from typing import (
-    Any, Dict, NamedTuple, Optional, Sequence, Set, Tuple, TypeVar)
+    Any, Callable, Dict, NamedTuple, Optional, Sequence, Set, Tuple,
+    TypeVar)
 
 T = TypeVar("T")
 TPoint = TypeVar("TPoint")

@@ -567,6 +567,12 @@ class _Sequence(SSZType):
     def index(self, v):
         return self._elems.index(self.ELEM_TYPE.coerce(v))
 
+    def count(self, v):
+        try:
+            return self._elems.count(self.ELEM_TYPE.coerce(v))
+        except (ValueError, TypeError):
+            return 0  # un-coercible values occur 0 times (list.count parity)
+
     def __contains__(self, v):
         try:
             return self.ELEM_TYPE.coerce(v) in self._elems

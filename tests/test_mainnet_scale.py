@@ -58,3 +58,18 @@ def test_mainnet_scale_hash_tree_root(big_state):
     assert elapsed < 120, f"merkleization too slow: {elapsed:.1f}s"
     # determinism across the bulk-level dispatch boundary
     assert hash_tree_root(state) == root
+
+
+def test_bulk_level_hasher_byte_identical(big_state):
+    """The JAX bulk level hasher (set_bulk_level_hasher plug point) must
+    produce byte-identical roots to hashlib on the full mainnet-shape
+    state — the wiring VERDICT flagged as never installed."""
+    from consensus_specs_tpu.ssz import merkle
+    spec, state = big_state
+    host_root = hash_tree_root(state)
+    merkle.use_tpu_hashing(threshold=4096)
+    try:
+        dev_root = hash_tree_root(state)
+    finally:
+        merkle.use_host_hashing()
+    assert dev_root == host_root
