@@ -1,0 +1,53 @@
+# Build/test/generate driving — capability parity with the reference's
+# Makefile targets (test, gen_%, gen_all, detect_errors, pyspec).
+
+PYTHON ?= python
+OUT ?= out/vectors
+JOBS ?= 1
+
+RUNNERS := shuffling ssz_static operations epoch_processing sanity bls \
+	kzg rewards finality genesis fork_choice transition ssz_generic \
+	forks merkle_proof networking kzg_7594 random light_client sync
+
+.PHONY: test test-quick native pyspec bench gen_all detect_errors \
+	$(addprefix gen_,$(RUNNERS))
+
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+# skip the slow limb-kernel compile tiers (full crypto still covered by
+# the oracle suites); the kernel tiers run in nightly/TPU sessions
+test-quick:
+	$(PYTHON) -m pytest tests/ -q \
+		--ignore=tests/test_pairing_jax.py \
+		--ignore=tests/test_bls_tpu.py \
+		--ignore=tests/test_curve_jax.py \
+		--ignore=tests/test_fq_jax.py \
+		--ignore=tests/test_fq_tower_jax.py \
+		--ignore=tests/test_sha256_jax.py \
+		--ignore=tests/test_kzg.py
+
+native:
+	$(PYTHON) scripts/build_native.py
+
+# emit executable spec modules from the reference markdown
+pyspec:
+	$(PYTHON) scripts/build_pyspec.py --out build/pyspec \
+		--forks phase0 altair bellatrix capella deneb electra fulu
+
+bench:
+	$(PYTHON) bench.py
+
+# static pattern rule: GNU make refuses to run implicit pattern rules
+# for .PHONY targets
+$(addprefix gen_,$(RUNNERS)): gen_%:
+	$(PYTHON) scripts/gen_vectors.py $* -o $(OUT) --jobs $(JOBS)
+
+gen_all:
+	$(PYTHON) scripts/gen_vectors.py all -o $(OUT) --jobs $(JOBS)
+
+detect_errors:
+	$(PYTHON) -c "from consensus_specs_tpu.gen.runner import \
+		detect_incomplete; import sys; bad = detect_incomplete('$(OUT)'); \
+		print('\n'.join(bad) or 'no incomplete cases'); \
+		sys.exit(1 if bad else 0)"

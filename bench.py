@@ -346,7 +346,17 @@ def main():
 
     if which != "all":
         fn, tier_budget = TIERS[which]
-        result = run_tier_inline(which, fn, min(tier_budget, budget))
+        profile_dir = os.environ.get("BENCH_PROFILE")
+        if profile_dir:
+            # device-level traces per tier (xprof format; SURVEY §5
+            # tracing) — view with tensorboard or xprofiler
+            import jax
+            ctx = jax.profiler.trace(os.path.join(profile_dir, which))
+        else:
+            import contextlib
+            ctx = contextlib.nullcontext()
+        with ctx:
+            result = run_tier_inline(which, fn, min(tier_budget, budget))
         if result is None:
             sys.exit(1)
         print(json.dumps(result))

@@ -101,3 +101,42 @@ def shard_array(mesh: Mesh, arr, spec=None):
     if spec is None:
         spec = P(AXIS) if np.ndim(arr) == 1 else P(AXIS, *([None] * (np.ndim(arr) - 1)))
     return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, spec))
+
+
+# ---------------------------------------------------------------------------
+# epoch deltas (validator-axis data parallelism)
+# ---------------------------------------------------------------------------
+
+def sharded_flag_deltas(local_eff_incr, local_active, local_part,
+                        weight: int, weight_denominator: int,
+                        base_per_increment: int):
+    """Body: one altair participation-flag delta pass over a validator
+    axis sharded across the mesh (altair beacon-chain.md:385-421 made
+    SPMD).  The two global reductions — active increments and
+    participating increments — ride the ICI as psums; everything else is
+    local elementwise math.  Values are in EFFECTIVE_BALANCE_INCREMENT
+    units so int32 lanes stay exact."""
+    active_incr = jax.lax.psum(
+        jnp.sum(jnp.where(local_active, local_eff_incr, 0)), AXIS)
+    part_incr = jax.lax.psum(
+        jnp.sum(jnp.where(local_part & local_active, local_eff_incr, 0)),
+        AXIS)
+    base = local_eff_incr * base_per_increment
+    rewards = jnp.where(
+        local_part & local_active,
+        base * weight * part_incr // (active_incr * weight_denominator),
+        0)
+    penalties = jnp.where(
+        local_active & ~local_part,
+        base * weight // weight_denominator, 0)
+    return rewards, penalties
+
+
+def make_flag_deltas(mesh: Mesh, weight: int, weight_denominator: int,
+                     base_per_increment: int):
+    return jax.jit(jax.shard_map(
+        partial(sharded_flag_deltas, weight=weight,
+                weight_denominator=weight_denominator,
+                base_per_increment=base_per_increment),
+        mesh=mesh, in_specs=(P(AXIS), P(AXIS), P(AXIS)),
+        out_specs=(P(AXIS), P(AXIS)), check_vma=False))

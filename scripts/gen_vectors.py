@@ -34,6 +34,31 @@ def _sharded(providers, shard_spec: str):
     return out
 
 
+def _run_jobs(runner: str, rest: list, jobs: int,
+              outer_shard: str | None) -> int:
+    """Multi-process fan-out (the reference's pathos pool / `make -j
+    gen_all` capability, gen_runner.py:269-274): each worker takes a
+    round-robin case shard; resume semantics make the on-disk union
+    safe, and the INCOMPLETE/error-log machinery reports per-worker
+    failures.  A host-level --shard I/N composes: worker j of this host
+    runs the global shard (I + N*j)/(N*jobs), so the union over this
+    host's workers is exactly the host's I/N slice."""
+    import subprocess
+    if outer_shard:
+        i0, n = (int(x) for x in outer_shard.split("/"))
+    else:
+        i0, n = 0, 1
+    procs = []
+    for j in range(jobs):
+        cmd = [sys.executable, os.path.abspath(__file__), runner,
+               *rest, "--shard", f"{i0 + n * j}/{n * jobs}"]
+        procs.append(subprocess.Popen(cmd))
+    rc = 0
+    for p in procs:
+        rc |= p.wait()
+    return rc
+
+
 def main(argv):
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)
@@ -41,6 +66,15 @@ def main(argv):
     runner = argv[0]
     rest = list(argv[1:])
     shard = None
+    jobs = None
+    if "--jobs" in rest:
+        i = rest.index("--jobs")
+        if i + 1 >= len(rest) or not rest[i + 1].isdigit() \
+                or int(rest[i + 1]) < 1:
+            print("usage: --jobs N (positive integer)", file=sys.stderr)
+            return 2
+        jobs = int(rest[i + 1])
+        del rest[i:i + 2]
     if "--shard" in rest:
         i = rest.index("--shard")
         if i + 1 >= len(rest) or "/" not in rest[i + 1]:
@@ -48,6 +82,8 @@ def main(argv):
             return 2
         shard = rest[i + 1]
         del rest[i:i + 2]
+    if jobs and jobs > 1:
+        return _run_jobs(runner, rest, jobs, shard)
     names = RUNNER_NAMES if runner == "all" else [runner]
     for name in names:
         providers = get_providers(name)

@@ -59,3 +59,27 @@ def test_sharded_g1_sum_matches_oracle(mesh):
     for p in pts[1:]:
         want = want + p
     assert got == want
+
+
+def test_sharded_flag_deltas_matches_numpy(mesh):
+    import numpy as np
+    from consensus_specs_tpu.parallel.collectives import make_flag_deltas
+    from consensus_specs_tpu.parallel import shard_array
+    n = 8 * 4
+    eff = np.full(n, 32, dtype=np.int32)
+    active = np.ones(n, dtype=bool)
+    active[5] = False
+    part = np.arange(n) % 3 == 0
+    rewards, penalties = make_flag_deltas(
+        mesh, weight=14, weight_denominator=64, base_per_increment=7)(
+        shard_array(mesh, eff), shard_array(mesh, active),
+        shard_array(mesh, part))
+    act_incr = int(eff[active].sum())
+    p_incr = int(eff[active & part].sum())
+    want_r = np.where(part & active,
+                      eff.astype(np.int64) * 7 * 14 * p_incr
+                      // (act_incr * 64), 0)
+    want_p = np.where(active & ~part,
+                      eff.astype(np.int64) * 7 * 14 // 64, 0)
+    assert (np.asarray(rewards) == want_r).all()
+    assert (np.asarray(penalties) == want_p).all()
