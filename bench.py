@@ -83,22 +83,32 @@ def run_tier_subprocess(name, budget_s):
     SIGALRM cannot interrupt a blocking XLA compile (signal handlers only
     run between bytecodes), so in-process timeouts can hang past the
     driver budget and forfeit already-completed tiers; a killed subprocess
-    cannot.  The child prints its single JSON line, which we parse."""
+    cannot.  Timeout escalates SIGTERM -> (10s grace) -> SIGKILL: an
+    instantly SIGKILLed child cannot release its TPU claim, and a stale
+    claim wedges the axon tunnel for every later process (observed: even
+    `jnp.zeros(8).sum()` then blocks in backend init for minutes).  The
+    child prints its single JSON line, which we parse."""
     import subprocess
     t0 = time.perf_counter()
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), name],
+        stdout=subprocess.PIPE, stderr=sys.stderr)
     try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), name],
-            stdout=subprocess.PIPE, stderr=sys.stderr,
-            timeout=budget_s)
+        out, _ = proc.communicate(timeout=budget_s)
     except subprocess.TimeoutExpired:
-        log(f"[bench] tier {name}: KILLED after {budget_s:.0f}s")
+        proc.terminate()
+        try:
+            out, _ = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, _ = proc.communicate()
+        log(f"[bench] tier {name}: TERMINATED after {budget_s:.0f}s")
         return None
     log(f"[bench] tier {name}: rc={proc.returncode} in "
         f"{time.perf_counter() - t0:.1f}s")
     if proc.returncode != 0:
         return None
-    for line in reversed(proc.stdout.decode().splitlines()):
+    for line in reversed(out.decode().splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
@@ -331,11 +341,14 @@ def bench_attestations():
     }
 
 
+# cheap proven tiers first (a number is banked early), then the
+# flagship; kzg last — its 4096-point MSM compile is the most likely to
+# exhaust a tier budget without producing
 TIERS = {
     "merkle": (bench_merkle, 150),
     "epoch": (bench_epoch, 300),
-    "kzg": (bench_kzg, 300),
     "attestations": (bench_attestations, 420),
+    "kzg": (bench_kzg, 300),
 }
 
 

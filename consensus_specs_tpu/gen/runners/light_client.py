@@ -96,4 +96,10 @@ def providers():
         for fork in FORKS:
             yield _bootstrap_case(fork)
             yield _sync_committee_proof_case(fork)
+        # step-driven sync scenarios, reflected from the dual-mode suite
+        # (format tests/formats/light_client/sync.md counterpart)
+        from ..reflect import generate_from_tests
+        yield from generate_from_tests(
+            "light_client", "sync",
+            "consensus_specs_tpu.spec_tests.light_client.test_sync")
     return [TestProvider(make_cases=make_cases)]

@@ -20,9 +20,24 @@ Verified identities: bilinearity and e(aG1, bG2) == e(G1, G2)^(ab).
 """
 from __future__ import annotations
 
+import functools
+import os as _os
+
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+# dispatch granularity (PAIRING_MODE env) — see the mode notes above
+# pairing_check for the tradeoff table.  Default is platform-split: on
+# CPU hosts per-step kernels compile fastest (this build host has ONE
+# core; a chunk kernel costs minutes of XLA time) and launch latency is
+# nil, while through the axon TPU relay every launch pays a network
+# round trip (staged = ~650 trips/check) but compilation is served by
+# the remote compile service — so chunks win there.
+_DEFAULT_MODE = ("staged" if "cpu" in _os.environ.get("JAX_PLATFORMS", "")
+                 else "chunked")
+PAIRING_MODE = _os.environ.get("PAIRING_MODE", _DEFAULT_MODE)
+_CHUNK_BITS = 8
 
 from . import fq
 from . import fq_tower as ft
@@ -255,13 +270,142 @@ def final_exponentiation_staged(f):
         frob=_frob_jit, expx=_exp_by_neg_x_staged)
 
 
-@jax.jit
-def _prod_reduce(f):
+def _prod_reduce_raw(f):
     """Fq12 product over the pairs axis: [..., k, 12, 32] -> [..., 12, 32]."""
     out = f[..., 0, :, :]
     for i in range(1, f.shape[-3]):
         out = ft.fq12_mul(out, f[..., i, :, :])
     return out
+
+
+_prod_reduce = jax.jit(_prod_reduce_raw)
+
+
+# ---------------------------------------------------------------------------
+# fused single-kernel path (lax.scan)
+# ---------------------------------------------------------------------------
+# The staged path above dispatches one jitted kernel per Miller bit /
+# ladder step — ~650 launches per pairing check.  On a directly attached
+# device that's fine; through the axon relay each launch pays a network
+# round trip and the check takes minutes.  The fused path rolls both
+# ladders into lax.scan bodies (compiled ONCE — scan does not unroll) and
+# runs the whole check in a single launch.  The zero-bits pay a wasted
+# add-step/multiply under a select, ~40% extra Fq12 work, which is noise
+# next to per-launch latency.  With the persistent compile cache the
+# one-time compile amortizes across processes.
+
+def _miller_scan(xp, yp, xq, yq):
+    """Miller loop as one lax.scan over the bits of |x|."""
+    batch = xp.shape[:-1]
+    one2 = jnp.broadcast_to(
+        jnp.asarray(np.stack([fq.ONE_MONT_LIMBS, fq.ZERO_LIMBS])),
+        batch + (2, fq.LIMBS))
+    f0 = ft.fq12_one(batch)
+    bits = jnp.asarray(_MILLER_BITS)
+
+    def body(carry, bit):
+        f, T = carry
+        T, (c0, c1, c4) = _double_step(T, xp, yp)
+        f = ft.fq12_mul(ft.fq12_square(f), _line_to_fq12(c0, c1, c4))
+        Ta, (a0, a1, a4) = _add_step(T, (xq, yq), xp, yp)
+        fa = ft.fq12_mul(f, _line_to_fq12(a0, a1, a4))
+        take = bit.astype(bool)
+        f = jnp.where(take, fa, f)
+        T = tuple(jnp.where(take, a, b) for a, b in zip(Ta, T))
+        return (f, T), None
+
+    (f, _T), _ = jax.lax.scan(body, (f0, (xq, yq, one2)), bits)
+    return ft.fq12_conj(f)          # x < 0
+
+
+def _exp_by_neg_x_scan(m):
+    """exp-by-|x| ladder as one lax.scan (square always, multiply under
+    a select on the bit)."""
+    def body(acc, bit):
+        acc = ft.fq12_cyclotomic_square(acc)
+        acc = jnp.where(bit.astype(bool), ft.fq12_mul(acc, m), acc)
+        return acc, None
+    acc, _ = jax.lax.scan(body, m, jnp.asarray(_MILLER_BITS))
+    return ft.fq12_conj(acc)
+
+
+@jax.jit
+def _pairing_check_fused(xps, yps, xqs, yqs, skip):
+    """Whole batched check — Miller product, final exponentiation,
+    is-one — as ONE compiled program."""
+    f = _miller_scan(xps, yps, xqs, yqs)
+    f = ft.fq12_select(skip, ft.fq12_one(f.shape[:-2]), f)
+    f = _prod_reduce_raw(f)
+    m = _easy_part(f)
+    v = _hard_chain(
+        m, cyc=ft.fq12_cyclotomic_square, mul=ft.fq12_mul,
+        conj=ft.fq12_conj, frob=ft.fq12_frobenius,
+        expx=_exp_by_neg_x_scan)
+    return ft.fq12_is_one(v)
+
+
+# ---------------------------------------------------------------------------
+# chunked path: static-bit-pattern chunk kernels
+# ---------------------------------------------------------------------------
+
+def _bit_chunks():
+    bits = _MILLER_BITS.tolist()
+    return [tuple(bits[i:i + _CHUNK_BITS])
+            for i in range(0, len(bits), _CHUNK_BITS)]
+
+
+_BIT_CHUNKS = _bit_chunks()
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _miller_chunk(bits, f, T, xq, yq, xp, yp):
+    """`len(bits)` Miller iterations with the bit pattern baked in as a
+    static arg — one launch per chunk, one compile per distinct
+    pattern."""
+    for bit in bits:
+        T, (c0, c1, c4) = _double_step(T, xp, yp)
+        f = ft.fq12_mul(ft.fq12_square(f), _line_to_fq12(c0, c1, c4))
+        if bit:
+            T, (c0, c1, c4) = _add_step(T, (xq, yq), xp, yp)
+            f = ft.fq12_mul(f, _line_to_fq12(c0, c1, c4))
+    return f, T
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _ladder_chunk(bits, acc, m):
+    """`len(bits)` square-and-multiply ladder steps, bit pattern static.
+    The exp-by-x ladder walks the same |x| bits as the Miller loop, so
+    the five expx calls of the hard chain all reuse these compiles."""
+    for bit in bits:
+        acc = ft.fq12_cyclotomic_square(acc)
+        if bit:
+            acc = ft.fq12_mul(acc, m)
+    return acc
+
+
+def _miller_chunked(xp, yp, xq, yq, skip):
+    batch = xp.shape[:-1]
+    one2 = jnp.broadcast_to(
+        jnp.asarray(np.stack([fq.ONE_MONT_LIMBS, fq.ZERO_LIMBS])),
+        batch + (2, fq.LIMBS))
+    T = (xq, yq, one2)
+    f = ft.fq12_one(batch)
+    for bits in _BIT_CHUNKS:
+        f, T = _miller_chunk(bits, f, T, xq, yq, xp, yp)
+    return _miller_finish(f, skip)
+
+
+def _exp_by_neg_x_chunked(m):
+    acc = m
+    for bits in _BIT_CHUNKS:
+        acc = _ladder_chunk(bits, acc, m)
+    return _conj_jit(acc)
+
+
+def final_exponentiation_chunked(f):
+    return _hard_chain(
+        _easy_jit(f), cyc=_cyc_jit, mul=_mul_jit, conj=_conj_jit,
+        frob=_frob_jit, expx=_exp_by_neg_x_chunked)
 
 
 def multi_miller_product(xps, yps, xqs, yqs, skip=None):
@@ -283,6 +427,18 @@ def multi_miller_product(xps, yps, xqs, yqs, skip=None):
 # floor stays at 1: padded rows are free on TPU lanes but real serial work
 # on a small CPU host, so tests shouldn't pay for bench-sized buckets.
 _BUCKET_MIN_ROWS = 1
+
+# dispatch granularity (PAIRING_MODE env):
+#   chunked (default) — 8-bit jitted chunks of the Miller loop / exp
+#     ladder with static bit patterns: ~20 one-time compiles, ~70 device
+#     launches per check.  The balance point: per-STEP dispatch (staged)
+#     is ~650 launches and each launch pays a network round trip through
+#     the axon relay; per-CHECK fusion (fused) is one launch but its
+#     scan body inlines ~300 Montgomery multiplies (each an einsum +
+#     fori_loop) and XLA compile blows past 8 minutes even on CPU.
+#   staged — one jitted kernel per step (fastest compile, most launches)
+#   fused — whole check as one lax.scan program (fewest launches,
+#     extreme compile cost; kept for directly-attached devices)
 
 
 def _bucket_rows(n: int) -> int:
@@ -318,8 +474,15 @@ def pairing_check(xps, yps, xqs, yqs, skip=None):
         skip = jnp.concatenate(
             [skip, jnp.ones((bp - b, k), dtype=bool)], axis=0)
 
-    f = multi_miller_product(xps, yps, xqs, yqs, skip)
-    v = _is_one_jit(final_exponentiation_staged(f))
+    if PAIRING_MODE == "fused":
+        v = _pairing_check_fused(xps, yps, xqs, yqs, skip)
+    elif PAIRING_MODE == "chunked":
+        f = _miller_chunked(xps, yps, xqs, yqs, skip)
+        f = _prod_reduce(f)
+        v = _is_one_jit(final_exponentiation_chunked(f))
+    else:
+        f = multi_miller_product(xps, yps, xqs, yqs, skip)
+        v = _is_one_jit(final_exponentiation_staged(f))
     return jnp.reshape(v[:b], lead)
 
 
@@ -329,10 +492,11 @@ pairing_check_jit = pairing_check
 
 
 def warmup(k: int = 2, rows: int = _BUCKET_MIN_ROWS) -> None:
-    """Pre-compile every stage kernel for the (rows, k) bucket, compiling
-    concurrently: XLA compilation releases the GIL, so on a multi-core
-    host the wall-clock cost is that of the slowest single kernel instead
-    of the sum over all of them."""
+    """Pre-compile the kernels for the (rows, k) bucket.  Fused path:
+    one program.  Staged path: every stage kernel, compiling
+    concurrently (XLA compilation releases the GIL, so on a multi-core
+    host the wall-clock cost is that of the slowest single kernel
+    instead of the sum over all of them)."""
     import concurrent.futures as cf
 
     z12k = jnp.zeros((rows, k, 12, fq.LIMBS), jnp.uint32)
@@ -340,6 +504,40 @@ def warmup(k: int = 2, rows: int = _BUCKET_MIN_ROWS) -> None:
     z1 = jnp.zeros((rows, k, fq.LIMBS), jnp.uint32)
     sk = jnp.zeros((rows, k), bool)
     m = jnp.zeros((rows, 12, fq.LIMBS), jnp.uint32)
+
+    if PAIRING_MODE == "fused":
+        # all-skip rows: every lane checks 1 == 1, exercising the whole
+        # program shape without meaningful data
+        jax.block_until_ready(_pairing_check_fused(
+            z1, z1, z2, z2, jnp.ones((rows, k), bool)))
+        return
+
+    if PAIRING_MODE == "chunked":
+        one2 = jnp.zeros((rows, k, 2, fq.LIMBS), jnp.uint32)
+        f0 = ft.fq12_one((rows, k))
+        jobs = [
+            # chunk kernels compile concurrently per distinct pattern
+            *[(lambda bits=bits: _miller_chunk(
+                bits, f0, (z2, z2, one2), z2, z2, z1, z1))
+              for bits in set(_BIT_CHUNKS)],
+            *[(lambda bits=bits: _ladder_chunk(bits, m, m))
+              for bits in set(_BIT_CHUNKS)],
+            lambda: _miller_finish(z12k, sk),
+            lambda: _prod_reduce(z12k),
+            lambda: _easy_jit(m),
+            lambda: _cyc_jit(m),
+            lambda: _mul_jit(m, m),
+            lambda: _conj_jit(m),
+            lambda: _frob_jit(m, 1),
+            lambda: _frob_jit(m, 2),
+            lambda: _frob_jit(m, 3),
+            lambda: _is_one_jit(m),
+        ]
+        with cf.ThreadPoolExecutor(max_workers=8) as ex:
+            for _ in ex.map(lambda fn: jax.block_until_ready(fn()),
+                            jobs):
+                pass
+        return
     jobs = [
         lambda: _miller_step_double(z12k, (z2, z2, z2), z1, z1),
         lambda: _miller_step_add(z12k, (z2, z2, z2), z2, z2, z1, z1),

@@ -114,14 +114,17 @@ def sharded_flag_deltas(local_eff_incr, local_active, local_part,
     axis sharded across the mesh (altair beacon-chain.md:385-421 made
     SPMD).  The two global reductions — active increments and
     participating increments — ride the ICI as psums; everything else is
-    local elementwise math.  Values are in EFFECTIVE_BALANCE_INCREMENT
-    units so int32 lanes stay exact."""
+    local elementwise math.  Inputs are in EFFECTIVE_BALANCE_INCREMENT
+    units, but the reward numerator base*weight*part_incr tops 2^31 past
+    ~30k mainnet validators, so the lanes run in int64 (make_flag_deltas
+    traces this under enable_x64)."""
+    eff64 = local_eff_incr.astype(jnp.int64)
     active_incr = jax.lax.psum(
-        jnp.sum(jnp.where(local_active, local_eff_incr, 0)), AXIS)
+        jnp.sum(jnp.where(local_active, eff64, 0)), AXIS)
     part_incr = jax.lax.psum(
-        jnp.sum(jnp.where(local_part & local_active, local_eff_incr, 0)),
+        jnp.sum(jnp.where(local_part & local_active, eff64, 0)),
         AXIS)
-    base = local_eff_incr * base_per_increment
+    base = eff64 * base_per_increment
     rewards = jnp.where(
         local_part & local_active,
         base * weight * part_incr // (active_incr * weight_denominator),
@@ -134,9 +137,16 @@ def sharded_flag_deltas(local_eff_incr, local_active, local_part,
 
 def make_flag_deltas(mesh: Mesh, weight: int, weight_denominator: int,
                      base_per_increment: int):
-    return jax.jit(jax.shard_map(
+    jfn = jax.jit(jax.shard_map(
         partial(sharded_flag_deltas, weight=weight,
                 weight_denominator=weight_denominator,
                 base_per_increment=base_per_increment),
         mesh=mesh, in_specs=(P(AXIS), P(AXIS), P(AXIS)),
         out_specs=(P(AXIS), P(AXIS)), check_vma=False))
+
+    def call(eff_incr, active, part):
+        # int64 lanes only inside this trace; the process-global dtype
+        # default stays int32
+        with jax.enable_x64():
+            return jfn(eff_incr, active, part)
+    return call

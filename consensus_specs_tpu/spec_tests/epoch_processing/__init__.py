@@ -14,4 +14,7 @@ EPOCH_PROCESSING_HANDLERS = {
         "test_registry_updates",
     "resets":
         "consensus_specs_tpu.spec_tests.epoch_processing.test_resets",
+    "participation_updates":
+        "consensus_specs_tpu.spec_tests.epoch_processing."
+        "test_participation_updates",
 }

@@ -1,0 +1,186 @@
+"""Epoch-processing tests for the altair-family participation machinery:
+inactivity updates, participation-flag rotation, sync-committee rotation
+(reference: test/altair/epoch_processing/*)."""
+from ...ssz import uint64
+from ...test_infra.context import (
+    spec_state_test, with_all_phases_from, never_bls)
+from ...test_infra.blocks import next_epoch, transition_to
+from ...test_infra.epoch_processing import run_epoch_processing_with
+
+
+def _full_flags(spec):
+    flags = 0
+    for i in range(len(spec.PARTICIPATION_FLAG_WEIGHTS)):
+        flags = spec.add_flag(flags, i)
+    return flags
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_inactivity_scores_genesis_noop(spec, state):
+    """In-leak score bumps don't apply during the genesis epoch."""
+    assert spec.get_current_epoch(state) == spec.GENESIS_EPOCH
+    next_epoch(spec, state)
+    yield from run_epoch_processing_with(spec, state,
+                                         "process_inactivity_updates")
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_inactivity_scores_leaking(spec, state):
+    """Drive the chain into a leak (no finality for
+    MIN_EPOCHS_TO_INACTIVITY_PENALTY+) with empty participation; scores
+    must rise by INACTIVITY_SCORE_BIAS."""
+    target = (int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 3) * \
+        int(spec.SLOTS_PER_EPOCH)
+    transition_to(spec, state, uint64(target))
+    state.previous_epoch_participation = [0] * len(state.validators)
+    state.current_epoch_participation = [0] * len(state.validators)
+    assert spec.is_in_inactivity_leak(state)
+    pre_scores = list(state.inactivity_scores)
+    yield from run_epoch_processing_with(spec, state,
+                                         "process_inactivity_updates")
+    bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+    for i, v in enumerate(state.validators):
+        if spec.is_active_validator(v, spec.get_previous_epoch(state)):
+            assert int(state.inactivity_scores[i]) == \
+                int(pre_scores[i]) + bias
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_inactivity_scores_recovery(spec, state):
+    """Full participation with finality: scores decay by the recovery
+    rate."""
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    n = len(state.validators)
+    state.inactivity_scores = [100] * n
+    state.previous_epoch_participation = [_full_flags(spec)] * n
+    # finality close enough: not leaking
+    state.finalized_checkpoint.epoch = uint64(
+        max(int(spec.get_current_epoch(state)) - 2, 0))
+    assert not spec.is_in_inactivity_leak(state)
+    yield from run_epoch_processing_with(spec, state,
+                                         "process_inactivity_updates")
+    # participating: -1; not leaking: a further -RECOVERY_RATE
+    rate = int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE)
+    for i, v in enumerate(state.validators):
+        if spec.is_active_validator(v, spec.get_previous_epoch(state)):
+            assert int(state.inactivity_scores[i]) == 100 - 1 - rate
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_participation_flag_rotation(spec, state):
+    next_epoch(spec, state)
+    n = len(state.validators)
+    cur = [_full_flags(spec)] * n
+    state.current_epoch_participation = cur
+    state.previous_epoch_participation = [1] * n
+    yield from run_epoch_processing_with(
+        spec, state, "process_participation_flag_updates")
+    assert list(state.previous_epoch_participation) == cur
+    assert list(state.current_epoch_participation) == [0] * n
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_sync_committee_rotation_at_period_boundary(spec, state):
+    """At an EPOCHS_PER_SYNC_COMMITTEE_PERIOD boundary the next
+    committee shifts in and a fresh one is computed."""
+    period_slots = int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD) * \
+        int(spec.SLOTS_PER_EPOCH)
+    transition_to(spec, state, uint64(period_slots - 1))
+    expected_current = state.next_sync_committee.copy()
+    yield from run_epoch_processing_with(
+        spec, state, "process_sync_committee_updates")
+    assert state.current_sync_committee == expected_current
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_sync_committee_no_rotation_mid_period(spec, state):
+    next_epoch(spec, state)
+    pre_cur = state.current_sync_committee.copy()
+    pre_next = state.next_sync_committee.copy()
+    yield from run_epoch_processing_with(
+        spec, state, "process_sync_committee_updates")
+    assert state.current_sync_committee == pre_cur
+    assert state.next_sync_committee == pre_next
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+@never_bls
+def test_historical_summaries_update(spec, state):
+    """At a SLOTS_PER_HISTORICAL_ROOT boundary a summary is appended."""
+    boundary = int(spec.SLOTS_PER_HISTORICAL_ROOT)
+    transition_to(spec, state, uint64(boundary - 1))
+    pre_len = len(state.historical_summaries)
+    yield from run_epoch_processing_with(
+        spec, state, "process_historical_summaries_update")
+    assert len(state.historical_summaries) == pre_len + 1
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@never_bls
+def test_pending_deposit_applied(spec, state):
+    """A pending deposit for an existing validator tops up its
+    balance."""
+    from ...test_infra.epoch_processing import run_epoch_processing_to
+    next_epoch(spec, state)
+    amount = spec.EFFECTIVE_BALANCE_INCREMENT
+    state.pending_deposits = [spec.PendingDeposit(
+        pubkey=state.validators[0].pubkey,
+        withdrawal_credentials=state.validators[0].withdrawal_credentials,
+        amount=amount,
+        signature=b"\x11" + b"\x00" * 95,
+        slot=spec.GENESIS_SLOT)]
+    # run the earlier passes first so the balance snapshot isolates this
+    # pass (rewards/penalties also move balances)
+    run_epoch_processing_to(spec, state, "process_pending_deposits")
+    pre_balance = int(state.balances[0])
+    yield "pre", state.copy()
+    spec.process_pending_deposits(state)
+    yield "post", state
+    assert int(state.balances[0]) == pre_balance + int(amount)
+    assert len(state.pending_deposits) == 0
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@never_bls
+def test_pending_consolidation_applied(spec, state):
+    """A withdrawable pending consolidation moves the source balance to
+    the target."""
+    from ...test_infra.withdrawals import (
+        set_eth1_withdrawal_credentials,
+        set_compounding_withdrawal_credentials)
+    next_epoch(spec, state)
+    source, target = 0, 1
+    set_eth1_withdrawal_credentials(spec, state, source)
+    set_compounding_withdrawal_credentials(spec, state, target)
+    cur = spec.get_current_epoch(state)
+    state.validators[source].exit_epoch = uint64(max(int(cur) - 1, 0))
+    state.validators[source].withdrawable_epoch = cur
+    state.pending_consolidations = [spec.PendingConsolidation(
+        source_index=source, target_index=target)]
+    from ...test_infra.epoch_processing import run_epoch_processing_to
+    run_epoch_processing_to(spec, state,
+                            "process_pending_consolidations")
+    pre_source = int(state.balances[source])
+    pre_target = int(state.balances[target])
+    yield "pre", state.copy()
+    spec.process_pending_consolidations(state)
+    yield "post", state
+    assert len(state.pending_consolidations) == 0
+    assert int(state.balances[source]) == 0
+    assert int(state.balances[target]) == pre_source + pre_target

@@ -1,0 +1,3 @@
+"""Step-driven light-client sync suite (reference:
+test/altair/light_client/test_sync.py capability; format
+tests/formats/light_client/sync.md)."""

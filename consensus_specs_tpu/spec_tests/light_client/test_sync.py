@@ -1,0 +1,109 @@
+"""Light-client sync protocol scenarios as step scripts.
+
+Each test builds a small chain with real sync-committee aggregates,
+bootstraps a store from a trusted block, applies update/force-update
+steps, and yields the sync vector format (meta, bootstrap, update_i...,
+steps)."""
+from ...ssz import hash_tree_root, uint64
+from ...test_infra.context import (
+    spec_test, with_all_phases_from, always_bls, _genesis_state,
+    default_balances, default_activation_threshold)
+from ...test_infra.light_client_sync import (
+    LightClientSyncTest, build_chain, make_update)
+
+
+def _setup(spec, n_blocks=6):
+    """LC protocol functions are fork-epoch-gated (header shape follows
+    the epoch), so run under a config with every active fork's epoch
+    pinned to 0 (the reference's with_config_overrides LC pattern)."""
+    from ...specs import get_spec
+    overrides = {}
+    for name in ["ALTAIR", "BELLATRIX", "CAPELLA", "DENEB", "ELECTRA",
+                 "FULU"]:
+        if spec.is_post(name.lower()):
+            overrides[f"{name}_FORK_EPOCH"] = 0
+    spec = get_spec(spec.fork, spec.preset_name,
+                    spec.config.replace(**overrides))
+    state = _genesis_state(spec, default_balances,
+                           default_activation_threshold, "lc-sync")
+    states, blocks = build_chain(spec, n_blocks, state)
+    bootstrap = spec.create_light_client_bootstrap(states[0], blocks[0])
+    test = LightClientSyncTest(spec, blocks[0], bootstrap)
+    return spec, state, test, states, blocks
+
+
+@with_all_phases_from("altair")
+@spec_test
+@always_bls
+def test_light_client_sync_optimistic(spec):
+    """An update without finality advances the optimistic header."""
+    spec, state, test, states, blocks = _setup(spec)
+    update = make_update(spec, states, blocks, signature_index=3)
+    current_slot = int(blocks[3].message.slot) + 1
+    test.process_update(update, current_slot,
+                        state.genesis_validators_root)
+    assert test.store.optimistic_header.beacon.slot == \
+        blocks[2].message.slot
+    yield from test.yield_parts(state)
+
+
+@with_all_phases_from("altair")
+@spec_test
+@always_bls
+def test_light_client_sync_with_finality(spec):
+    """An update carrying a finality branch moves the finalized
+    header."""
+    spec, state, test, states, blocks = _setup(spec, n_blocks=2)
+    # finalize block 1 in the live chain state, THEN extend the chain so
+    # later blocks commit to the finalized checkpoint (a post-hoc state
+    # mutation would break the header/state-root identity)
+    state.finalized_checkpoint = spec.Checkpoint(
+        epoch=spec.compute_epoch_at_slot(blocks[1].message.slot),
+        root=hash_tree_root(blocks[1].message))
+    more_states, more_blocks = build_chain(spec, 3, state)
+    states += more_states
+    blocks += more_blocks
+    update = make_update(spec, states, blocks, signature_index=4,
+                         finalized_index=1)
+    current_slot = int(blocks[4].message.slot) + 1
+    test.process_update(update, current_slot,
+                        state.genesis_validators_root)
+    assert test.store.finalized_header.beacon.slot == \
+        blocks[1].message.slot
+    yield from test.yield_parts(state)
+
+
+@with_all_phases_from("altair")
+@spec_test
+@always_bls
+def test_light_client_sync_multiple_updates(spec):
+    """Two sequential optimistic updates advance the header twice."""
+    spec, state, test, states, blocks = _setup(spec, n_blocks=7)
+    for sig_index in (3, 5):
+        update = make_update(spec, states, blocks,
+                             signature_index=sig_index)
+        test.process_update(update,
+                            int(blocks[sig_index].message.slot) + 1,
+                            state.genesis_validators_root)
+    assert test.store.optimistic_header.beacon.slot == \
+        blocks[4].message.slot
+    yield from test.yield_parts(state)
+
+
+@with_all_phases_from("altair")
+@spec_test
+@always_bls
+def test_light_client_force_update(spec):
+    """A best-valid-update beyond the timeout is force-applied."""
+    spec, state, test, states, blocks = _setup(spec)
+    update = make_update(spec, states, blocks, signature_index=3,
+                         participation=0.5)
+    current_slot = int(blocks[3].message.slot) + 1
+    test.process_update(update, current_slot,
+                        state.genesis_validators_root)
+    assert test.store.best_valid_update is not None
+    timeout_slot = current_slot + \
+        int(spec.UPDATE_TIMEOUT)
+    test.force_update(timeout_slot)
+    assert test.store.best_valid_update is None
+    yield from test.yield_parts(state)

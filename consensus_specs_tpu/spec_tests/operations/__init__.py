@@ -10,4 +10,15 @@ OPERATION_HANDLERS = {
     "deposit": "consensus_specs_tpu.spec_tests.operations.test_deposit",
     "voluntary_exit":
         "consensus_specs_tpu.spec_tests.operations.test_voluntary_exit",
+    "sync_aggregate":
+        "consensus_specs_tpu.spec_tests.operations.test_sync_aggregate",
+    "withdrawals":
+        "consensus_specs_tpu.spec_tests.operations.test_withdrawals",
+    "bls_to_execution_change":
+        "consensus_specs_tpu.spec_tests.operations."
+        "test_bls_to_execution_change",
+    "execution_payload":
+        "consensus_specs_tpu.spec_tests.operations.test_execution_payload",
+    "execution_requests":
+        "consensus_specs_tpu.spec_tests.operations.test_execution_requests",
 }

@@ -1,0 +1,104 @@
+"""process_bls_to_execution_change operation tests (capella+;
+reference: test/capella/block_processing/test_process_bls_to_execution_change.py
+shape)."""
+from ...ssz import Bytes32, uint64
+from ...test_infra.context import (
+    spec_state_test, with_all_phases_from, always_bls)
+from ...test_infra.keys import privkeys, pubkeys
+from ...utils import bls
+
+
+def _stage_bls_credentials(spec, state, index, key_index=None):
+    """Give validator `index` 0x00 BLS credentials derived from a test
+    key we control; returns the (pubkey, privkey) pair used."""
+    key_index = index if key_index is None else key_index
+    from_pubkey = pubkeys[key_index]
+    creds = bytes(spec.BLS_WITHDRAWAL_PREFIX) + \
+        bytes(spec.hash(from_pubkey))[1:]
+    state.validators[index].withdrawal_credentials = Bytes32(creds)
+    return from_pubkey, privkeys[key_index]
+
+
+def _signed_change(spec, state, index, from_pubkey, privkey,
+                   address=b"\x42" * 20, sign=True):
+    change = spec.BLSToExecutionChange(
+        validator_index=uint64(index),
+        from_bls_pubkey=from_pubkey,
+        to_execution_address=address)
+    domain = spec.compute_domain(
+        spec.DOMAIN_BLS_TO_EXECUTION_CHANGE,
+        genesis_validators_root=state.genesis_validators_root)
+    signature = bls.Sign(privkey, spec.compute_signing_root(
+        change, domain)) if sign else b"\x11" + b"\x00" * 95
+    return spec.SignedBLSToExecutionChange(message=change,
+                                           signature=signature)
+
+
+def _run(spec, state, signed_change, valid=True):
+    yield "pre", state.copy()
+    yield "address_change", signed_change
+    if not valid:
+        try:
+            spec.process_bls_to_execution_change(state, signed_change)
+        except (AssertionError, ValueError, IndexError):
+            yield "post", None
+            return
+        raise AssertionError("address change unexpectedly valid")
+    spec.process_bls_to_execution_change(state, signed_change)
+    yield "post", state
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+@always_bls
+def test_success(spec, state):
+    pub, priv = _stage_bls_credentials(spec, state, 0)
+    signed = _signed_change(spec, state, 0, pub, priv)
+    yield from _run(spec, state, signed)
+    creds = bytes(state.validators[0].withdrawal_credentials)
+    assert creds[:1] == bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX)
+    assert creds[12:] == b"\x42" * 20
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+@always_bls
+def test_invalid_signature(spec, state):
+    pub, priv = _stage_bls_credentials(spec, state, 0)
+    signed = _signed_change(spec, state, 0, pub, priv, sign=False)
+    yield from _run(spec, state, signed, valid=False)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+@always_bls
+def test_invalid_already_execution_credentials(spec, state):
+    """Default genesis credentials here are 0x01 — change must fail."""
+    pub, priv = _stage_bls_credentials(spec, state, 0)
+    state.validators[0].withdrawal_credentials = Bytes32(
+        bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX) + b"\x00" * 11 +
+        b"\xaa" * 20)
+    signed = _signed_change(spec, state, 0, pub, priv)
+    yield from _run(spec, state, signed, valid=False)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+@always_bls
+def test_invalid_wrong_from_pubkey(spec, state):
+    """Credentials derived from a different key than the one in the
+    change message."""
+    _stage_bls_credentials(spec, state, 0, key_index=0)
+    wrong_pub, wrong_priv = pubkeys[5], privkeys[5]
+    signed = _signed_change(spec, state, 0, wrong_pub, wrong_priv)
+    yield from _run(spec, state, signed, valid=False)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+@always_bls
+def test_invalid_validator_index_out_of_range(spec, state):
+    pub, priv = _stage_bls_credentials(spec, state, 0)
+    signed = _signed_change(spec, state, 0, pub, priv)
+    signed.message.validator_index = uint64(len(state.validators))
+    yield from _run(spec, state, signed, valid=False)

@@ -1,0 +1,86 @@
+"""process_execution_payload operation tests (bellatrix+; reference:
+test/bellatrix/block_processing/test_process_execution_payload.py
+shape).  The noop engine answers True, so the consensus-side asserts
+(parent hash, randao, timestamp, blob commitment limits) are under
+test."""
+from ...ssz import uint64
+from ...test_infra.context import spec_state_test, with_all_phases_from
+from ...test_infra.blocks import build_empty_execution_payload
+
+
+def _body_for(spec, payload, commitments=None):
+    body = spec.BeaconBlockBody()
+    body.execution_payload = payload
+    if commitments is not None:
+        body.blob_kzg_commitments = commitments
+    return body
+
+
+def _run(spec, state, payload, valid=True, commitments=None):
+    # bellatrix's process_execution_payload takes the body (deneb needs
+    # the commitments); emit the payload for the vector
+    body = _body_for(spec, payload, commitments)
+    yield "pre", state.copy()
+    yield "execution_payload", payload
+    if not valid:
+        try:
+            spec.process_execution_payload(state, body,
+                                           spec.EXECUTION_ENGINE)
+        except (AssertionError, ValueError, IndexError):
+            yield "post", None
+            return
+        raise AssertionError("payload unexpectedly valid")
+    spec.process_execution_payload(state, body, spec.EXECUTION_ENGINE)
+    yield "post", state
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+def test_success_empty_payload(spec, state):
+    payload = build_empty_execution_payload(spec, state)
+    yield from _run(spec, state, payload)
+    assert state.latest_execution_payload_header.block_hash == \
+        payload.block_hash
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+def test_invalid_parent_hash(spec, state):
+    payload = build_empty_execution_payload(spec, state)
+    payload.parent_hash = b"\x55" * 32
+    yield from _run(spec, state, payload, valid=False)
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+def test_invalid_prev_randao(spec, state):
+    payload = build_empty_execution_payload(spec, state)
+    payload.prev_randao = b"\x42" * 32
+    yield from _run(spec, state, payload, valid=False)
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+def test_invalid_timestamp(spec, state):
+    payload = build_empty_execution_payload(spec, state)
+    payload.timestamp = uint64(int(payload.timestamp) + 1)
+    yield from _run(spec, state, payload, valid=False)
+
+
+@with_all_phases_from("deneb")
+@spec_state_test
+def test_invalid_too_many_blob_commitments(spec, state):
+    payload = build_empty_execution_payload(spec, state)
+    limit = int(spec.max_blobs_per_block())
+    commitments = [b"\xc0" + b"\x00" * 47] * (limit + 1)
+    yield from _run(spec, state, payload, valid=False,
+                    commitments=commitments)
+
+
+@with_all_phases_from("deneb")
+@spec_state_test
+def test_blob_commitments_at_limit(spec, state):
+    payload = build_empty_execution_payload(spec, state)
+    limit = int(spec.max_blobs_per_block())
+    commitments = [b"\xc0" + b"\x00" * 47] * limit
+    yield from _run(spec, state, payload, commitments=commitments)

@@ -1,0 +1,181 @@
+"""Electra EL-triggered request operation tests: withdrawal requests
+(EIP-7002), deposit requests (EIP-6110), consolidation requests
+(EIP-7251).  Reference shapes:
+test/electra/block_processing/test_process_{withdrawal,deposit,consolidation}_request.py.
+
+Request processing is no-fault: malformed requests are ignored, not
+rejected, so "invalid" cases assert the state is untouched."""
+from ...ssz import uint64
+from ...test_infra.context import spec_state_test, with_all_phases_from
+from ...test_infra.keys import pubkeys
+from ...test_infra.withdrawals import (
+    set_eth1_withdrawal_credentials,
+    set_compounding_withdrawal_credentials)
+
+_ADDR = b"\xaa" * 20
+
+
+def _run(spec, state, kind, request, mutates=True):
+    pre = state.copy()
+    yield "pre", pre
+    yield kind, request
+    getattr(spec, f"process_{kind}")(state, request)
+    if not mutates:
+        assert spec.hash_tree_root(state) == spec.hash_tree_root(pre)
+    yield "post", state
+
+
+def _age_validator(spec, state, index):
+    """Move the chain past the shard-committee-period gate for exits."""
+    state.slot = uint64(
+        int(state.slot)
+        + int(spec.config.SHARD_COMMITTEE_PERIOD) * int(spec.SLOTS_PER_EPOCH))
+
+
+# ---------------------------------------------------------------------------
+# withdrawal requests (EIP-7002)
+# ---------------------------------------------------------------------------
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_withdrawal_request_full_exit(spec, state):
+    _age_validator(spec, state, 0)
+    set_eth1_withdrawal_credentials(spec, state, 0, address=_ADDR)
+    request = spec.WithdrawalRequest(
+        source_address=_ADDR,
+        validator_pubkey=state.validators[0].pubkey,
+        amount=spec.FULL_EXIT_REQUEST_AMOUNT)
+    yield from _run(spec, state, "withdrawal_request", request)
+    assert state.validators[0].exit_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_withdrawal_request_partial(spec, state):
+    _age_validator(spec, state, 0)
+    set_compounding_withdrawal_credentials(spec, state, 0, address=_ADDR)
+    state.validators[0].effective_balance = spec.MIN_ACTIVATION_BALANCE
+    excess = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    state.balances[0] = uint64(
+        int(spec.MIN_ACTIVATION_BALANCE) + excess)
+    request = spec.WithdrawalRequest(
+        source_address=_ADDR,
+        validator_pubkey=state.validators[0].pubkey,
+        amount=uint64(excess))
+    yield from _run(spec, state, "withdrawal_request", request)
+    assert len(state.pending_partial_withdrawals) == 1
+    assert int(state.pending_partial_withdrawals[0].amount) == excess
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_withdrawal_request_wrong_source_ignored(spec, state):
+    _age_validator(spec, state, 0)
+    set_eth1_withdrawal_credentials(spec, state, 0, address=_ADDR)
+    request = spec.WithdrawalRequest(
+        source_address=b"\xbb" * 20,
+        validator_pubkey=state.validators[0].pubkey,
+        amount=spec.FULL_EXIT_REQUEST_AMOUNT)
+    yield from _run(spec, state, "withdrawal_request", request,
+                    mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_withdrawal_request_unknown_pubkey_ignored(spec, state):
+    _age_validator(spec, state, 0)
+    request = spec.WithdrawalRequest(
+        source_address=_ADDR,
+        validator_pubkey=pubkeys[len(state.validators) + 7],
+        amount=spec.FULL_EXIT_REQUEST_AMOUNT)
+    yield from _run(spec, state, "withdrawal_request", request,
+                    mutates=False)
+
+
+# ---------------------------------------------------------------------------
+# deposit requests (EIP-6110)
+# ---------------------------------------------------------------------------
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_deposit_request_appends_pending(spec, state):
+    request = spec.DepositRequest(
+        pubkey=pubkeys[1],
+        withdrawal_credentials=b"\x01" + b"\x00" * 31,
+        amount=spec.MIN_ACTIVATION_BALANCE,
+        signature=b"\x11" + b"\x00" * 95,
+        index=uint64(0))
+    yield from _run(spec, state, "deposit_request", request)
+    assert len(state.pending_deposits) == 1
+    assert state.deposit_requests_start_index == uint64(0)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_deposit_request_start_index_set_once(spec, state):
+    for idx in (5, 9):
+        request = spec.DepositRequest(
+            pubkey=pubkeys[1],
+            withdrawal_credentials=b"\x01" + b"\x00" * 31,
+            amount=spec.MIN_ACTIVATION_BALANCE,
+            signature=b"\x11" + b"\x00" * 95,
+            index=uint64(idx))
+        if idx == 5:
+            yield from _run(spec, state, "deposit_request", request)
+        else:
+            spec.process_deposit_request(state, request)
+    assert state.deposit_requests_start_index == uint64(5)
+    assert len(state.pending_deposits) == 2
+
+
+# ---------------------------------------------------------------------------
+# consolidation requests (EIP-7251)
+# ---------------------------------------------------------------------------
+
+def _stage_consolidation(spec, state, source=0, target=1):
+    _age_validator(spec, state, source)
+    set_eth1_withdrawal_credentials(spec, state, source, address=_ADDR)
+    set_compounding_withdrawal_credentials(spec, state, target)
+    # consolidation churn must exceed MIN_ACTIVATION_BALANCE
+    state.balances = [uint64(int(b) * 64) for b in state.balances]
+    for v in state.validators:
+        v.effective_balance = uint64(int(v.effective_balance) * 64)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_consolidation_request_queues_pending(spec, state):
+    _stage_consolidation(spec, state)
+    request = spec.ConsolidationRequest(
+        source_address=_ADDR,
+        source_pubkey=state.validators[0].pubkey,
+        target_pubkey=state.validators[1].pubkey)
+    yield from _run(spec, state, "consolidation_request", request)
+    assert len(state.pending_consolidations) == 1
+    assert state.validators[0].exit_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_consolidation_request_switch_to_compounding(spec, state):
+    _age_validator(spec, state, 0)
+    set_eth1_withdrawal_credentials(spec, state, 0, address=_ADDR)
+    request = spec.ConsolidationRequest(
+        source_address=_ADDR,
+        source_pubkey=state.validators[0].pubkey,
+        target_pubkey=state.validators[0].pubkey)
+    yield from _run(spec, state, "consolidation_request", request)
+    creds = bytes(state.validators[0].withdrawal_credentials)
+    assert creds[:1] == bytes(spec.COMPOUNDING_WITHDRAWAL_PREFIX)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_consolidation_request_unknown_target_ignored(spec, state):
+    _stage_consolidation(spec, state)
+    request = spec.ConsolidationRequest(
+        source_address=_ADDR,
+        source_pubkey=state.validators[0].pubkey,
+        target_pubkey=pubkeys[len(state.validators) + 3])
+    yield from _run(spec, state, "consolidation_request", request,
+                    mutates=False)

@@ -1,0 +1,112 @@
+"""process_sync_aggregate operation tests (altair+; reference:
+test/altair/block_processing/sync_aggregate/*; vector format
+tests/formats/operations)."""
+from ...test_infra.context import (
+    spec_state_test, with_all_phases_from, always_bls)
+from ...test_infra.blocks import (
+    build_empty_block_for_next_slot, next_slot, transition_to)
+from ...test_infra.sync_committee import (
+    get_sync_aggregate, run_sync_committee_processing,
+    compute_aggregate_sync_committee_signature)
+
+
+def _block_with_aggregate(spec, state, participation_fn=None):
+    """Advance one slot and attach a valid aggregate signed for that
+    slot."""
+    block = build_empty_block_for_next_slot(spec, state)
+    transition_to(spec, state, block.slot)
+    block.body.sync_aggregate = get_sync_aggregate(
+        spec, state, participation_fn=participation_fn)
+    return block
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@always_bls
+def test_sync_committee_rewards_all_participating(spec, state):
+    block = _block_with_aggregate(spec, state)
+    pre_balances = list(state.balances)
+    yield from run_sync_committee_processing(spec, state, block)
+    # every participant is rewarded (committee members may repeat)
+    assert sum(state.balances) > sum(pre_balances)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@always_bls
+def test_sync_committee_half_participating(spec, state):
+    block = _block_with_aggregate(spec, state,
+                                  participation_fn=lambda p: p % 2 == 0)
+    yield from run_sync_committee_processing(spec, state, block)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@always_bls
+def test_sync_committee_no_participants(spec, state):
+    """Empty participation with the infinity-point signature is valid
+    (eth_fast_aggregate_verify special case)."""
+    block = _block_with_aggregate(spec, state,
+                                  participation_fn=lambda p: False)
+    pre_balances = list(state.balances)
+    yield from run_sync_committee_processing(spec, state, block)
+    # everyone in the committee is penalized, no rewards
+    assert sum(state.balances) < sum(pre_balances)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@always_bls
+def test_invalid_signature_bad_domain(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    transition_to(spec, state, block.slot)
+    agg = get_sync_aggregate(spec, state)
+    # flip one signature byte
+    sig = bytearray(bytes(agg.sync_committee_signature))
+    sig[5] ^= 0xFF
+    agg.sync_committee_signature = bytes(sig)
+    block.body.sync_aggregate = agg
+    yield from run_sync_committee_processing(spec, state, block,
+                                             valid=False)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@always_bls
+def test_invalid_signature_missing_participant(spec, state):
+    """Bits claim full participation but one member didn't sign."""
+    block = build_empty_block_for_next_slot(spec, state)
+    transition_to(spec, state, block.slot)
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    sig = compute_aggregate_sync_committee_signature(
+        spec, state, list(range(size - 1)))
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * size,
+        sync_committee_signature=sig)
+    yield from run_sync_committee_processing(spec, state, block,
+                                             valid=False)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@always_bls
+def test_invalid_signature_infinity_with_participants(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    transition_to(spec, state, block.slot)
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * size,
+        sync_committee_signature=spec.G2_POINT_AT_INFINITY)
+    yield from run_sync_committee_processing(spec, state, block,
+                                             valid=False)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@always_bls
+def test_proposer_in_committee(spec, state):
+    """Full participation across an extra slot so the proposer may be a
+    participant; processing must stay consistent either way."""
+    next_slot(spec, state)
+    block = _block_with_aggregate(spec, state)
+    yield from run_sync_committee_processing(spec, state, block)

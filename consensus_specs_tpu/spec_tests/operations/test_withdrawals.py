@@ -1,0 +1,116 @@
+"""process_withdrawals operation tests (capella+; reference:
+test/capella/block_processing/test_process_withdrawals.py shape)."""
+from ...ssz import uint64
+from ...test_infra.context import spec_state_test, with_all_phases_from
+from ...test_infra.withdrawals import (
+    get_expected_withdrawals, payload_with_expected_withdrawals,
+    prepare_fully_withdrawable_validator,
+    prepare_partially_withdrawable_validator, run_withdrawals_processing)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+def test_no_withdrawals(spec, state):
+    payload = payload_with_expected_withdrawals(spec, state)
+    assert len(payload.withdrawals) == 0
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+def test_one_full_withdrawal(spec, state):
+    prepare_fully_withdrawable_validator(spec, state, 0)
+    payload = payload_with_expected_withdrawals(spec, state)
+    assert len(payload.withdrawals) == 1
+    assert payload.withdrawals[0].amount == state.balances[0]
+    yield from run_withdrawals_processing(spec, state, payload)
+    assert state.balances[0] == 0
+    assert state.next_withdrawal_index == uint64(1)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+def test_one_partial_withdrawal(spec, state):
+    excess = 2000000000
+    prepare_partially_withdrawable_validator(spec, state, 1,
+                                             excess=excess)
+    payload = payload_with_expected_withdrawals(spec, state)
+    assert len(payload.withdrawals) == 1
+    assert int(payload.withdrawals[0].amount) == excess
+    yield from run_withdrawals_processing(spec, state, payload)
+    assert state.balances[1] == spec.MAX_EFFECTIVE_BALANCE
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+def test_mixed_full_and_partial(spec, state):
+    prepare_fully_withdrawable_validator(spec, state, 0)
+    prepare_partially_withdrawable_validator(spec, state, 2)
+    payload = payload_with_expected_withdrawals(spec, state)
+    assert len(payload.withdrawals) == 2
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+def test_invalid_missing_withdrawal(spec, state):
+    prepare_fully_withdrawable_validator(spec, state, 0)
+    payload = payload_with_expected_withdrawals(spec, state)
+    payload.withdrawals = []
+    yield from run_withdrawals_processing(spec, state, payload,
+                                          valid=False)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+def test_invalid_wrong_amount(spec, state):
+    prepare_fully_withdrawable_validator(spec, state, 0)
+    payload = payload_with_expected_withdrawals(spec, state)
+    payload.withdrawals[0].amount = uint64(
+        int(payload.withdrawals[0].amount) + 1)
+    yield from run_withdrawals_processing(spec, state, payload,
+                                          valid=False)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+def test_invalid_wrong_validator_index(spec, state):
+    prepare_fully_withdrawable_validator(spec, state, 0)
+    payload = payload_with_expected_withdrawals(spec, state)
+    payload.withdrawals[0].validator_index = uint64(3)
+    yield from run_withdrawals_processing(spec, state, payload,
+                                          valid=False)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+def test_sweep_cursor_advances(spec, state):
+    """The sweep cursor moves by the bound when the payload isn't
+    full."""
+    pre_cursor = int(state.next_withdrawal_validator_index)
+    payload = payload_with_expected_withdrawals(spec, state)
+    yield from run_withdrawals_processing(spec, state, payload)
+    bound = min(len(state.validators),
+                int(spec.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP))
+    assert int(state.next_withdrawal_validator_index) == \
+        (pre_cursor + bound) % len(state.validators)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_pending_partial_withdrawal(spec, state):
+    """Electra: a pending partial withdrawal request is honored by the
+    sweep once withdrawable."""
+    from ...test_infra.withdrawals import set_eth1_withdrawal_credentials
+    index = 0
+    amount = spec.EFFECTIVE_BALANCE_INCREMENT
+    set_eth1_withdrawal_credentials(spec, state, index)
+    state.balances[index] = uint64(
+        int(spec.MAX_EFFECTIVE_BALANCE) + int(amount))
+    state.pending_partial_withdrawals = [spec.PendingPartialWithdrawal(
+        validator_index=index, amount=amount,
+        withdrawable_epoch=spec.get_current_epoch(state))]
+    payload = payload_with_expected_withdrawals(spec, state)
+    assert len(payload.withdrawals) >= 1
+    yield from run_withdrawals_processing(spec, state, payload)
+    assert len(state.pending_partial_withdrawals) == 0

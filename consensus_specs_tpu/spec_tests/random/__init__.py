@@ -1,0 +1,2 @@
+"""Randomized block-trajectory suite (reference:
+tests/generators/random capability — seeded, replay-exact scenarios)."""

@@ -1,0 +1,61 @@
+"""Seeded random block trajectories per fork (reference:
+test/<fork>/random/test_random.py, code-generated there; hand-rolled
+here over the shared trajectory driver).  Each test yields the standard
+sanity-blocks vector shape: pre, blocks_<i>..., post."""
+from ...test_infra.context import (
+    spec_state_test, with_all_phases, never_bls)
+from ...test_infra.random import run_random_trajectory
+
+
+def _run(spec, state, seed, slots=8):
+    """`pre` reflects the post-randomization, pre-blocks state."""
+    from ...ssz import uint64
+    from ...test_infra.blocks import next_slot, transition_to
+    from ...test_infra.random import (
+        apply_random_block, randomize_state, rng_for)
+    rng = rng_for(spec, seed)
+    transition_to(spec, state, uint64(int(spec.SLOTS_PER_EPOCH) * 2))
+    randomize_state(spec, state, rng)
+    yield "pre", state.copy()
+    signed = []
+    for _ in range(slots):
+        if rng.random() < 0.25:
+            next_slot(spec, state)
+        signed.append(apply_random_block(spec, state, rng))
+    for i, sb in enumerate(signed):
+        yield f"blocks_{i}", sb
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_random_scenario_0(spec, state):
+    yield from _run(spec, state, seed=0)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_random_scenario_1(spec, state):
+    yield from _run(spec, state, seed=1)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_random_scenario_2(spec, state):
+    yield from _run(spec, state, seed=2, slots=5)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_random_replay_exact(spec, state):
+    """The same seed replays to byte-identical post-state roots — the
+    determinism contract randomized vectors rely on."""
+    s2 = state.copy()
+    blocks1 = run_random_trajectory(spec, state, seed=42, slots=4)
+    blocks2 = run_random_trajectory(spec, s2, seed=42, slots=4)
+    assert [spec.hash_tree_root(b) for b in blocks1] == \
+        [spec.hash_tree_root(b) for b in blocks2]
+    assert spec.hash_tree_root(state) == spec.hash_tree_root(s2)

@@ -1,0 +1,137 @@
+"""Step-driven light-client sync harness (reference:
+test/helpers/light_client_sync.py — the step-emitting mechanism behind
+the light_client/sync vector format, tests/formats/light_client/sync.md:
+a bootstrap plus a steps.yaml of process_update / force_update events
+with per-step store checks).
+"""
+from __future__ import annotations
+
+from ..ssz import Bytes32, hash_tree_root, uint64
+from ..utils import bls as bls_utils
+from .blocks import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block)
+from .context import _forced_bls
+from .keys import privkey_for_pubkey
+
+
+def build_chain(spec, n_blocks, state):
+    """n empty signed blocks from `state`; returns (states, blocks) with
+    states[i] the post-state of blocks[i] (signatures stubbed — LC
+    verification only touches the sync-committee signatures we add)."""
+    states, blocks = [], []
+    with _forced_bls(False):
+        for _ in range(n_blocks):
+            block = build_empty_block_for_next_slot(spec, state)
+            signed = state_transition_and_sign_block(spec, state, block)
+            states.append(state.copy())
+            blocks.append(signed)
+    return states, blocks
+
+
+def build_sync_aggregate(spec, state, signature_slot, attested_root,
+                         participation=1.0):
+    """A real SyncAggregate over `attested_root` signed by the leading
+    `participation` fraction of the committee."""
+    committee = state.current_sync_committee.pubkeys
+    n_sign = int(len(committee) * participation)
+    previous_slot = uint64(int(signature_slot) - 1)
+    domain = spec.get_domain(state, spec.DOMAIN_SYNC_COMMITTEE,
+                             spec.compute_epoch_at_slot(previous_slot))
+    signing_root = spec.compute_signing_root(
+        Bytes32(attested_root), domain)
+    sigs = [bls_utils.Sign(privkey_for_pubkey(pk), signing_root)
+            for pk in list(committee)[:n_sign]]
+    bits = [i < n_sign for i in range(len(committee))]
+    signature = bls_utils.Aggregate(sigs) if sigs \
+        else spec.G2_POINT_AT_INFINITY
+    return spec.SyncAggregate(sync_committee_bits=bits,
+                              sync_committee_signature=signature)
+
+
+def make_update(spec, states, blocks, signature_index,
+                finalized_index=None, participation=1.0):
+    """LightClientUpdate whose signature block (at signature_index)
+    attests blocks[signature_index - 1]."""
+    att_index = signature_index - 1
+    attested_root = hash_tree_root(blocks[att_index].message)
+    aggregate = build_sync_aggregate(
+        spec, states[signature_index],
+        blocks[signature_index].message.slot, attested_root,
+        participation)
+    with _forced_bls(False):
+        pre = states[att_index].copy()
+        block = build_empty_block_for_next_slot(spec, pre)
+        block.body.sync_aggregate = aggregate
+        signed = state_transition_and_sign_block(spec, pre, block)
+    finalized_block = None if finalized_index is None \
+        else blocks[finalized_index]
+    update = spec.create_light_client_update(
+        pre, signed, states[att_index], blocks[att_index],
+        finalized_block)
+    return update
+
+
+def store_checks(spec, store) -> dict:
+    """The per-step check object of the sync format."""
+    def header_checks(header):
+        out = {
+            "slot": int(header.beacon.slot),
+            "beacon_root": "0x" + bytes(
+                hash_tree_root(header.beacon)).hex(),
+        }
+        if spec.is_post("capella"):
+            out["execution_root"] = "0x" + bytes(
+                spec.get_lc_execution_root(header)).hex()
+        return out
+    return {
+        "finalized_header": header_checks(store.finalized_header),
+        "optimistic_header": header_checks(store.optimistic_header),
+    }
+
+
+class LightClientSyncTest:
+    """Accumulates steps + artifacts in the on-disk sync format; drive
+    with process_update / force_update, then yield_parts() in a
+    dual-mode test."""
+
+    def __init__(self, spec, trusted_block, bootstrap):
+        self.spec = spec
+        self.trusted_block_root = hash_tree_root(trusted_block.message)
+        self.bootstrap = bootstrap
+        self.store = spec.initialize_light_client_store(
+            self.trusted_block_root, bootstrap)
+        self.steps = []
+        self.artifacts = []
+
+    def process_update(self, update, current_slot,
+                       genesis_validators_root):
+        name = f"update_{len(self.steps)}"
+        self.spec.process_light_client_update(
+            self.store, update, uint64(current_slot),
+            genesis_validators_root)
+        self.artifacts.append((name, update))
+        self.steps.append({"process_update": {
+            "update": name,
+            "current_slot": int(current_slot),
+            "checks": store_checks(self.spec, self.store),
+        }})
+
+    def force_update(self, current_slot):
+        self.spec.process_light_client_store_force_update(
+            self.store, uint64(current_slot))
+        self.steps.append({"force_update": {
+            "current_slot": int(current_slot),
+            "checks": store_checks(self.spec, self.store),
+        }})
+
+    def yield_parts(self, state):
+        yield "meta", {
+            "genesis_validators_root": "0x" + bytes(
+                state.genesis_validators_root).hex(),
+            "trusted_block_root": "0x" + bytes(
+                self.trusted_block_root).hex(),
+        }
+        yield "bootstrap", self.bootstrap
+        for name, obj in self.artifacts:
+            yield name, obj
+        yield "steps", self.steps

@@ -1,0 +1,122 @@
+"""Randomized-scenario helpers (reference: test/helpers/random.py +
+test/utils/randomized_block_tests.py capability).
+
+Seeded mutators randomize state fields within spec-legal ranges, and a
+seeded block builder assembles blocks with a random mix of operations.
+Determinism contract: the same (spec, seed) always produces the same
+trajectory, so randomized vectors are replay-exact.
+"""
+from __future__ import annotations
+
+import random as _random
+
+from ..ssz import uint64
+from .attestations import get_valid_attestation
+from .blocks import (
+    build_empty_block_for_next_slot, next_slot,
+    state_transition_and_sign_block, transition_to)
+from .slashings import (
+    get_valid_attester_slashing, get_valid_proposer_slashing,
+    get_valid_voluntary_exit)
+
+
+def rng_for(spec, seed: int) -> _random.Random:
+    return _random.Random(f"{spec.fork}:{spec.preset_name}:{seed}")
+
+
+def randomize_inactivity_scores(spec, state, rng) -> None:
+    state.inactivity_scores = [
+        uint64(rng.randrange(0, 50)) for _ in state.validators]
+
+
+def randomize_balances(spec, state, rng) -> None:
+    max_eb = int(spec.MAX_EFFECTIVE_BALANCE)
+    state.balances = [
+        uint64(rng.randrange(max_eb // 2, max_eb + max_eb // 8))
+        for _ in state.validators]
+
+
+def randomize_participation(spec, state, rng) -> None:
+    if spec.is_post("altair"):
+        full = (1 << len(spec.PARTICIPATION_FLAG_WEIGHTS)) - 1
+        state.previous_epoch_participation = [
+            rng.randrange(0, full + 1) for _ in state.validators]
+        state.current_epoch_participation = [
+            rng.randrange(0, full + 1) for _ in state.validators]
+
+
+def randomize_state(spec, state, rng) -> None:
+    randomize_balances(spec, state, rng)
+    randomize_participation(spec, state, rng)
+    if spec.is_post("altair"):
+        randomize_inactivity_scores(spec, state, rng)
+
+
+def random_block(spec, state, rng):
+    """An empty-to-busy block for the next slot: each op class included
+    with some probability, always consistent with the state."""
+    block = build_empty_block_for_next_slot(spec, state)
+    if rng.random() < 0.6:
+        # attestation for a prior slot (satisfies inclusion delay)
+        target = int(state.slot) + 1 - int(
+            spec.MIN_ATTESTATION_INCLUSION_DELAY)
+        if target >= 0:
+            attestation = get_valid_attestation(
+                spec, state, slot=uint64(max(target, 0)), signed=True)
+            block.body.attestations = [attestation]
+    if rng.random() < 0.2:
+        block.body.proposer_slashings = [
+            get_valid_proposer_slashing(spec, state)]
+    elif rng.random() < 0.2:
+        block.body.attester_slashings = [
+            get_valid_attester_slashing(spec, state)]
+    return block
+
+
+def _skip_slashed_proposers(spec, state) -> None:
+    """Advance past slots whose proposer is slashed — such slots can
+    only ever be empty (process_block_header rejects the proposer), so
+    the trajectory leaves them blockless."""
+    for _ in range(2 * int(spec.SLOTS_PER_EPOCH)):
+        look = state.copy()
+        spec.process_slots(look, uint64(int(state.slot) + 1))
+        proposer = look.validators[
+            spec.get_beacon_proposer_index(look)]
+        if not proposer.slashed:
+            return
+        next_slot(spec, state)
+    raise AssertionError("no proposable slot within two epochs")
+
+
+def apply_random_block(spec, state, rng):
+    """Build and apply one random block; if the op mix turns out
+    illegal in context, deterministically fall back to an empty
+    block."""
+    _skip_slashed_proposers(spec, state)
+    scratch = state.copy()
+    try:
+        block = random_block(spec, scratch, rng)
+        signed = state_transition_and_sign_block(spec, scratch, block)
+    except (AssertionError, ValueError, IndexError):
+        block = build_empty_block_for_next_slot(spec, state)
+        return state_transition_and_sign_block(spec, state, block)
+    # replay the known-good block on the real state
+    spec.state_transition(state, signed)
+    return signed
+
+
+def run_random_trajectory(spec, state, seed: int, slots: int = 8):
+    """Apply `slots` random blocks; returns the signed blocks.  All
+    blocks are valid by construction (illegal op mixes degrade to empty
+    blocks, deterministically per seed)."""
+    rng = rng_for(spec, seed)
+    # warm the chain past genesis-epoch edge cases, then scramble
+    transition_to(spec, state,
+                  uint64(int(spec.SLOTS_PER_EPOCH) * 2))
+    randomize_state(spec, state, rng)
+    blocks = []
+    for _ in range(slots):
+        if rng.random() < 0.25:
+            next_slot(spec, state)  # empty slot
+        blocks.append(apply_random_block(spec, state, rng))
+    return blocks

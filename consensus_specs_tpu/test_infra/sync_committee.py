@@ -1,0 +1,76 @@
+"""Sync-committee test helpers (altair+).
+
+Counterpart of the reference harness's helpers/sync_committee.py: build
+real (or deliberately broken) SyncAggregates for a state by signing the
+previous slot's block root with the current committee's keys, matching
+process_sync_aggregate's verification path
+(reference specs/altair/beacon-chain.md:534-568).
+"""
+from __future__ import annotations
+
+from ..ssz import uint64
+from ..utils import bls
+from .keys import privkey_for_pubkey
+
+
+def compute_sync_committee_signing_root(spec, state, signature_slot=None):
+    if signature_slot is None:
+        signature_slot = state.slot
+    previous_slot = uint64(max(int(signature_slot), 1) - 1)
+    domain = spec.get_domain(state, spec.DOMAIN_SYNC_COMMITTEE,
+                             spec.compute_epoch_at_slot(previous_slot))
+    return spec.compute_signing_root(
+        spec.get_block_root_at_slot(state, previous_slot), domain)
+
+
+def compute_aggregate_sync_committee_signature(spec, state, participants,
+                                               signature_slot=None,
+                                               privkey_override=None):
+    """Aggregate signature of the committee members whose *positions*
+    (indices into current_sync_committee.pubkeys) are `participants`."""
+    if not participants:
+        return spec.G2_POINT_AT_INFINITY
+    signing_root = compute_sync_committee_signing_root(
+        spec, state, signature_slot)
+    signatures = []
+    for pos in participants:
+        pubkey = state.current_sync_committee.pubkeys[pos]
+        privkey = (privkey_override if privkey_override is not None
+                   else privkey_for_pubkey(pubkey))
+        signatures.append(bls.Sign(privkey, signing_root))
+    return bls.Aggregate(signatures)
+
+
+def get_sync_aggregate(spec, state, participation_fn=None,
+                       signature_slot=None):
+    """A valid SyncAggregate for `state`.  participation_fn filters the
+    committee positions (default: everyone participates)."""
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    positions = list(range(size))
+    if participation_fn is not None:
+        positions = [p for p in positions if participation_fn(p)]
+    bits = [False] * size
+    for p in positions:
+        bits[p] = True
+    return spec.SyncAggregate(
+        sync_committee_bits=bits,
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, positions, signature_slot))
+
+
+def run_sync_committee_processing(spec, state, block, valid=True):
+    """Dual-mode runner: yields pre/block/post around
+    process_sync_aggregate (the operations-runner sync_aggregate
+    handler)."""
+    yield "pre", state.copy()
+    yield "sync_aggregate", block.body.sync_aggregate
+    if not valid:
+        try:
+            spec.process_sync_aggregate(state,
+                                        block.body.sync_aggregate)
+        except (AssertionError, ValueError, IndexError):
+            yield "post", None
+            return
+        raise AssertionError("sync aggregate unexpectedly valid")
+    spec.process_sync_aggregate(state, block.body.sync_aggregate)
+    yield "post", state

@@ -1,0 +1,83 @@
+"""Withdrawal test helpers (capella+).
+
+Counterpart of the reference harness's helpers/withdrawals.py: set
+execution/compounding withdrawal credentials and stage validators so the
+sweep (reference specs/capella/beacon-chain.md:345-420) produces full or
+partial withdrawals on demand.
+"""
+from __future__ import annotations
+
+from ..ssz import Bytes32, uint64
+
+
+def set_eth1_withdrawal_credentials(spec, state, index, address=None):
+    """Give validator `index` 0x01 (eth1) withdrawal credentials."""
+    if address is None:
+        address = b"\xaa" * 20
+    validator = state.validators[index]
+    validator.withdrawal_credentials = Bytes32(
+        bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX) + b"\x00" * 11 + address)
+
+
+def set_compounding_withdrawal_credentials(spec, state, index,
+                                           address=None):
+    """Electra 0x02 compounding credentials."""
+    if address is None:
+        address = b"\xaa" * 20
+    validator = state.validators[index]
+    validator.withdrawal_credentials = Bytes32(
+        bytes(spec.COMPOUNDING_WITHDRAWAL_PREFIX) + b"\x00" * 11 + address)
+
+
+def prepare_fully_withdrawable_validator(spec, state, index,
+                                         balance=None):
+    """Make validator `index` fully withdrawable at the current epoch."""
+    set_eth1_withdrawal_credentials(spec, state, index)
+    validator = state.validators[index]
+    epoch = spec.get_current_epoch(state)
+    validator.exit_epoch = uint64(max(int(epoch) - 1, 0))
+    validator.withdrawable_epoch = epoch
+    if balance is not None:
+        state.balances[index] = uint64(balance)
+
+
+def prepare_partially_withdrawable_validator(spec, state, index,
+                                             excess=1000000000):
+    """Make validator `index` partially withdrawable: max effective
+    balance with an excess on top."""
+    set_eth1_withdrawal_credentials(spec, state, index)
+    validator = state.validators[index]
+    validator.effective_balance = spec.MAX_EFFECTIVE_BALANCE
+    state.balances[index] = uint64(
+        int(spec.MAX_EFFECTIVE_BALANCE) + excess)
+
+
+def get_expected_withdrawals(spec, state):
+    """Fork-agnostic expected-withdrawals list (electra returns a
+    (withdrawals, processed_partial_count) pair)."""
+    result = spec.get_expected_withdrawals(state)
+    return result[0] if spec.is_post("electra") else result
+
+
+def payload_with_expected_withdrawals(spec, state):
+    """An execution payload carrying exactly the expected withdrawals."""
+    from .blocks import build_empty_execution_payload
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals = get_expected_withdrawals(spec, state)
+    return payload
+
+
+def run_withdrawals_processing(spec, state, payload, valid=True):
+    """Dual-mode runner around process_withdrawals (operations-runner
+    withdrawals handler: vector format carries the payload)."""
+    yield "pre", state.copy()
+    yield "execution_payload", payload
+    if not valid:
+        try:
+            spec.process_withdrawals(state, payload)
+        except (AssertionError, ValueError, IndexError):
+            yield "post", None
+            return
+        raise AssertionError("withdrawals unexpectedly valid")
+    spec.process_withdrawals(state, payload)
+    yield "post", state

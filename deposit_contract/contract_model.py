@@ -1,0 +1,120 @@
+"""Python behavioral model of deposit_contract.sol.
+
+Mirrors the contract's progressive (O(log n)-storage) Merkle tree,
+deposit validation, and event emission so its semantics can be
+differential-tested against the consensus spec's own deposit
+merkleization without an EVM (reference capability:
+solidity_deposit_contract/ + its web3 test harness; behavior spec:
+specs/phase0/deposit-contract.md).
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+TREE_DEPTH = 32
+MAX_DEPOSIT_COUNT = 2 ** TREE_DEPTH - 1
+GWEI = 10 ** 9
+ETHER = 10 ** 18
+MIN_DEPOSIT_WEI = ETHER  # 1 ether
+
+
+def _sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def _le64(value: int) -> bytes:
+    return int(value).to_bytes(8, "little")
+
+
+ZERO_HASHES = [b"\x00" * 32]
+for _ in range(TREE_DEPTH - 1):
+    ZERO_HASHES.append(_sha256(ZERO_HASHES[-1] + ZERO_HASHES[-1]))
+
+
+def deposit_data_root(pubkey: bytes, withdrawal_credentials: bytes,
+                      amount_gwei: int, signature: bytes) -> bytes:
+    """SSZ hash_tree_root of DepositData, part-wise as the contract
+    computes it."""
+    pubkey_root = _sha256(bytes(pubkey) + b"\x00" * 16)
+    signature_root = _sha256(
+        _sha256(bytes(signature[:64]))
+        + _sha256(bytes(signature[64:]) + b"\x00" * 32))
+    return _sha256(
+        _sha256(pubkey_root + bytes(withdrawal_credentials))
+        + _sha256(_le64(amount_gwei) + b"\x00" * 24 + signature_root))
+
+
+@dataclass
+class DepositEvent:
+    pubkey: bytes
+    withdrawal_credentials: bytes
+    amount: bytes      # little-endian uint64 gwei
+    signature: bytes
+    index: bytes       # little-endian uint64
+
+
+@dataclass
+class DepositContractModel:
+    branch: list = field(
+        default_factory=lambda: [b"\x00" * 32] * TREE_DEPTH)
+    deposit_count: int = 0
+    events: list = field(default_factory=list)
+
+    def get_deposit_root(self) -> bytes:
+        node = b"\x00" * 32
+        size = self.deposit_count
+        for h in range(TREE_DEPTH):
+            if size & 1:
+                node = _sha256(self.branch[h] + node)
+            else:
+                node = _sha256(node + ZERO_HASHES[h])
+            size //= 2
+        return _sha256(node + _le64(self.deposit_count) + b"\x00" * 24)
+
+    def get_deposit_count(self) -> bytes:
+        return _le64(self.deposit_count)
+
+    def deposit(self, pubkey: bytes, withdrawal_credentials: bytes,
+                signature: bytes, deposit_data_root_arg: bytes, *,
+                value_wei: int) -> None:
+        """The contract's deposit() including every require()."""
+        if len(pubkey) != 48:
+            raise ValueError("invalid pubkey length")
+        if len(withdrawal_credentials) != 32:
+            raise ValueError("invalid withdrawal_credentials length")
+        if len(signature) != 96:
+            raise ValueError("invalid signature length")
+        if value_wei < MIN_DEPOSIT_WEI:
+            raise ValueError("deposit value too low")
+        if value_wei % GWEI != 0:
+            raise ValueError("deposit value not multiple of gwei")
+        amount = value_wei // GWEI
+        if amount > 2 ** 64 - 1:
+            raise ValueError("deposit value too high")
+
+        # EVM revert semantics: a require() after the emit still rolls
+        # the event back, so the model validates everything first
+        node = deposit_data_root(pubkey, withdrawal_credentials, amount,
+                                 signature)
+        if node != bytes(deposit_data_root_arg):
+            raise ValueError(
+                "reconstructed DepositData does not match supplied root")
+        if self.deposit_count >= MAX_DEPOSIT_COUNT:
+            raise ValueError("merkle tree full")
+
+        self.events.append(DepositEvent(
+            pubkey=bytes(pubkey),
+            withdrawal_credentials=bytes(withdrawal_credentials),
+            amount=_le64(amount),
+            signature=bytes(signature),
+            index=_le64(self.deposit_count)))
+        self.deposit_count += 1
+        size = self.deposit_count
+        for h in range(TREE_DEPTH):
+            if size & 1:
+                self.branch[h] = node
+                return
+            node = _sha256(self.branch[h] + node)
+            size //= 2
+        raise AssertionError("unreachable")

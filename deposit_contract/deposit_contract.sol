@@ -1,0 +1,192 @@
+// SPDX-License-Identifier: CC0-1.0
+pragma solidity ^0.8.20;
+
+/// Beacon-chain staking deposit contract.
+///
+/// From-scratch implementation of the behavior specified in the
+/// consensus spec (reference specs/phase0/deposit-contract.md): a
+/// `deposit` function taking (pubkey, withdrawal_credentials,
+/// signature, deposit_data_root) plus ETH value, an incremental
+/// (progressive) Merkle tree of DepositData roots using O(log n)
+/// storage, a DepositEvent log per deposit, and EIP-165 support.
+/// The companion Python behavioral model (contract_model.py) is
+/// differential-tested against the consensus spec's own deposit
+/// merkleization (tests/test_deposit_contract.py).
+contract DepositContract {
+    uint256 private constant DEPOSIT_CONTRACT_TREE_DEPTH = 32;
+    // NOTE: this also changes the SSZ List length-mix-in below
+    uint256 private constant MAX_DEPOSIT_COUNT =
+        2 ** DEPOSIT_CONTRACT_TREE_DEPTH - 1;
+
+    bytes32[DEPOSIT_CONTRACT_TREE_DEPTH] private branch;
+    uint256 private deposit_count;
+
+    bytes32[DEPOSIT_CONTRACT_TREE_DEPTH] private zero_hashes;
+
+    event DepositEvent(
+        bytes pubkey,
+        bytes withdrawal_credentials,
+        bytes amount,
+        bytes signature,
+        bytes index
+    );
+
+    constructor() {
+        // zero_hashes[0] == bytes32(0) implicitly
+        for (uint256 h = 0; h < DEPOSIT_CONTRACT_TREE_DEPTH - 1; h++) {
+            zero_hashes[h + 1] = sha256(
+                abi.encodePacked(zero_hashes[h], zero_hashes[h])
+            );
+        }
+    }
+
+    /// The current deposit root: fold the stored left-subtree branch
+    /// against zero hashes, then mix in the deposit count (SSZ
+    /// List[DepositData, 2**32] hash_tree_root semantics).
+    function get_deposit_root() external view returns (bytes32) {
+        bytes32 node;
+        uint256 size = deposit_count;
+        for (uint256 h = 0; h < DEPOSIT_CONTRACT_TREE_DEPTH; h++) {
+            if ((size & 1) == 1) {
+                node = sha256(abi.encodePacked(branch[h], node));
+            } else {
+                node = sha256(abi.encodePacked(node, zero_hashes[h]));
+            }
+            size /= 2;
+        }
+        return sha256(
+            abi.encodePacked(node, to_little_endian_64(uint64(deposit_count)),
+                bytes24(0))
+        );
+    }
+
+    function get_deposit_count() external view returns (bytes memory) {
+        return to_little_endian_64(uint64(deposit_count));
+    }
+
+    function deposit(
+        bytes calldata pubkey,
+        bytes calldata withdrawal_credentials,
+        bytes calldata signature,
+        bytes32 deposit_data_root
+    ) external payable {
+        require(pubkey.length == 48, "DepositContract: invalid pubkey length");
+        require(
+            withdrawal_credentials.length == 32,
+            "DepositContract: invalid withdrawal_credentials length"
+        );
+        require(
+            signature.length == 96,
+            "DepositContract: invalid signature length"
+        );
+
+        require(msg.value >= 1 ether, "DepositContract: deposit value too low");
+        require(
+            msg.value % 1 gwei == 0,
+            "DepositContract: deposit value not multiple of gwei"
+        );
+        uint256 deposit_amount = msg.value / 1 gwei;
+        require(
+            deposit_amount <= type(uint64).max,
+            "DepositContract: deposit value too high"
+        );
+
+        emit DepositEvent(
+            pubkey,
+            withdrawal_credentials,
+            to_little_endian_64(uint64(deposit_amount)),
+            signature,
+            to_little_endian_64(uint64(deposit_count))
+        );
+
+        // DepositData hash_tree_root, computed SSZ-style from the parts
+        bytes32 pubkey_root = sha256(abi.encodePacked(pubkey, bytes16(0)));
+        bytes32 signature_root = sha256(
+            abi.encodePacked(
+                sha256(abi.encodePacked(signature[:64])),
+                sha256(abi.encodePacked(signature[64:], bytes32(0)))
+            )
+        );
+        bytes32 node = sha256(
+            abi.encodePacked(
+                sha256(abi.encodePacked(pubkey_root, withdrawal_credentials)),
+                sha256(
+                    abi.encodePacked(
+                        to_little_endian_64(uint64(deposit_amount)),
+                        bytes24(0),
+                        signature_root
+                    )
+                )
+            )
+        );
+        require(
+            node == deposit_data_root,
+            "DepositContract: reconstructed DepositData does not match supplied deposit_data_root"
+        );
+
+        // progressive merkle insertion: walk up to the first even level
+        require(
+            deposit_count < MAX_DEPOSIT_COUNT,
+            "DepositContract: merkle tree full"
+        );
+        deposit_count += 1;
+        uint256 size = deposit_count;
+        for (uint256 h = 0; h < DEPOSIT_CONTRACT_TREE_DEPTH; h++) {
+            if ((size & 1) == 1) {
+                branch[h] = node;
+                return;
+            }
+            node = sha256(abi.encodePacked(branch[h], node));
+            size /= 2;
+        }
+        assert(false); // unreachable: deposit_count < MAX_DEPOSIT_COUNT
+    }
+
+    function supportsInterface(bytes4 interfaceId)
+        external
+        pure
+        returns (bool)
+    {
+        return
+            interfaceId == type(IERC165).interfaceId ||
+            interfaceId == IDepositContract.deposit.selector ^
+                IDepositContract.get_deposit_root.selector ^
+                IDepositContract.get_deposit_count.selector;
+    }
+
+    function to_little_endian_64(uint64 value)
+        internal
+        pure
+        returns (bytes memory ret)
+    {
+        ret = new bytes(8);
+        for (uint256 i = 0; i < 8; i++) {
+            ret[i] = bytes1(uint8(value >> (8 * i)));
+        }
+    }
+}
+
+interface IERC165 {
+    function supportsInterface(bytes4 interfaceId) external view returns (bool);
+}
+
+interface IDepositContract {
+    event DepositEvent(
+        bytes pubkey,
+        bytes withdrawal_credentials,
+        bytes amount,
+        bytes signature,
+        bytes index
+    );
+
+    function deposit(
+        bytes calldata pubkey,
+        bytes calldata withdrawal_credentials,
+        bytes calldata signature,
+        bytes32 deposit_data_root
+    ) external payable;
+
+    function get_deposit_count() external view returns (bytes memory);
+
+    function get_deposit_root() external view returns (bytes32);
+}

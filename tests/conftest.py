@@ -55,9 +55,34 @@ def pytest_addoption(parser):
                      help="stub out BLS signature checks for speed")
     parser.addoption("--bls-type", action="store", default="native",
                      help="BLS backend: native (pure python) or tpu")
+    parser.addoption(
+        "--kernel-tiers", action="store_true",
+        default=os.environ.get("RUN_KERNEL_TIERS", "") not in ("", "0"),
+        help="include the multi-minute XLA limb-kernel compile suites "
+             "(also enabled via RUN_KERNEL_TIERS=1; `make test-kernels`)")
 
 
 import pytest  # noqa: E402
+
+# compile-heavy limb-crypto kernel suites: each triggers minutes of XLA
+# graph compilation (pairing ladders, scalar-mul chains).  Gated so the
+# default suite finishes inside a CI budget; fast smoke coverage of the
+# same code paths stays default (test_sha256_jax, oracle crypto suites).
+KERNEL_TIER_FILES = {
+    "test_pairing_jax.py", "test_bls_tpu.py", "test_curve_jax.py",
+    "test_fq_tower_jax.py",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--kernel-tiers"):
+        return
+    skip = pytest.mark.skip(
+        reason="kernel tier (multi-minute XLA compile): enable with "
+               "--kernel-tiers / RUN_KERNEL_TIERS=1 / make test-kernels")
+    for item in items:
+        if os.path.basename(str(item.fspath)) in KERNEL_TIER_FILES:
+            item.add_marker(skip)
 
 
 @pytest.fixture(autouse=True, scope="session")

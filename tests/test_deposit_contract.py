@@ -1,0 +1,138 @@
+"""Deposit-contract behavioral model vs the consensus spec.
+
+The model (deposit_contract/contract_model.py) mirrors
+deposit_contract.sol; these tests prove its roots/proofs line up with
+the spec's own deposit machinery: DepositData hash_tree_root,
+Eth1Data-style deposit roots, and is_valid_merkle_branch acceptance of
+proofs drawn from a full tree over the same leaves (reference
+capability: solidity_deposit_contract tests)."""
+import sys
+import os
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from deposit_contract.contract_model import (  # noqa: E402
+    DepositContractModel, GWEI, TREE_DEPTH, ZERO_HASHES,
+    deposit_data_root)
+from consensus_specs_tpu.specs import get_spec  # noqa: E402
+from consensus_specs_tpu.ssz import hash_tree_root, uint64  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_spec("phase0", "minimal")
+
+
+def _deposit_parts(i):
+    pubkey = bytes([i + 1]) + b"\x5b" * 47
+    creds = b"\x00" + bytes([i]) * 31
+    sig = bytes([i + 7]) * 96
+    amount = 32 * 10 ** 9  # gwei
+    return pubkey, creds, sig, amount
+
+
+def test_deposit_data_root_matches_ssz(spec):
+    pubkey, creds, sig, amount = _deposit_parts(0)
+    dd = spec.DepositData(pubkey=pubkey, withdrawal_credentials=creds,
+                          amount=uint64(amount), signature=sig)
+    assert deposit_data_root(pubkey, creds, amount, sig) == \
+        bytes(hash_tree_root(dd))
+
+
+def test_progressive_root_matches_full_tree(spec):
+    """The O(log n) branch fold equals hash_tree_root of the SSZ list
+    of DepositData (the beacon chain's view of the contract state)."""
+    from consensus_specs_tpu.ssz import List
+    model = DepositContractModel()
+    DepositDataList = List[spec.DepositData, 2 ** TREE_DEPTH]
+    items = []
+    for i in range(5):
+        pubkey, creds, sig, amount = _deposit_parts(i)
+        root = deposit_data_root(pubkey, creds, amount, sig)
+        model.deposit(pubkey, creds, sig, root,
+                      value_wei=amount * GWEI)
+        items.append(spec.DepositData(
+            pubkey=pubkey, withdrawal_credentials=creds,
+            amount=uint64(amount), signature=sig))
+        assert model.get_deposit_root() == \
+            bytes(hash_tree_root(DepositDataList(items)))
+        assert model.get_deposit_count() == \
+            (i + 1).to_bytes(8, "little")
+
+
+def test_deposit_events_and_validation():
+    model = DepositContractModel()
+    pubkey, creds, sig, amount = _deposit_parts(3)
+    root = deposit_data_root(pubkey, creds, amount, sig)
+
+    with pytest.raises(ValueError, match="pubkey"):
+        model.deposit(b"\x00" * 47, creds, sig, root,
+                      value_wei=amount * GWEI)
+    with pytest.raises(ValueError, match="too low"):
+        model.deposit(pubkey, creds, sig, root, value_wei=10 ** 17)
+    with pytest.raises(ValueError, match="gwei"):
+        model.deposit(pubkey, creds, sig, root,
+                      value_wei=amount * GWEI + 1)
+    with pytest.raises(ValueError, match="does not match"):
+        model.deposit(pubkey, creds, sig, b"\x13" * 32,
+                      value_wei=amount * GWEI)
+    assert model.deposit_count == 0
+
+    model.deposit(pubkey, creds, sig, root, value_wei=amount * GWEI)
+    # reverted calls leave no events (EVM rollback semantics)
+    assert len(model.events) == 1
+    ev = model.events[-1]
+    assert ev.pubkey == pubkey
+    assert ev.amount == amount.to_bytes(8, "little")
+    assert ev.index == (0).to_bytes(8, "little")
+
+
+def test_branch_proofs_verify_against_spec(spec):
+    """Deposit proofs built over the model's leaves verify with the
+    spec's is_valid_merkle_branch against the model's root (the
+    process_deposit acceptance path)."""
+    from consensus_specs_tpu.ssz.merkle import merkleize_chunks
+    model = DepositContractModel()
+    leaves = []
+    for i in range(4):
+        pubkey, creds, sig, amount = _deposit_parts(i)
+        root = deposit_data_root(pubkey, creds, amount, sig)
+        model.deposit(pubkey, creds, sig, root,
+                      value_wei=amount * GWEI)
+        leaves.append(root)
+
+    # full padded tree over the leaves
+    import hashlib
+
+    def sha(b):
+        return hashlib.sha256(b).digest()
+
+    level = leaves + [b"\x00" * 32] * 0
+    layers = [list(level)]
+    for h in range(TREE_DEPTH):
+        nxt = []
+        cur = layers[-1]
+        for j in range(0, len(cur) + 1, 2):
+            left = cur[j] if j < len(cur) else ZERO_HASHES[h]
+            right = cur[j + 1] if j + 1 < len(cur) else ZERO_HASHES[h]
+            nxt.append(sha(left + right))
+            if j + 2 > len(cur):
+                break
+        layers.append(nxt)
+
+    count = len(leaves)
+    for index in range(count):
+        branch = []
+        idx = index
+        for h in range(TREE_DEPTH):
+            sibling = idx ^ 1
+            cur = layers[h]
+            branch.append(cur[sibling] if sibling < len(cur)
+                          else ZERO_HASHES[h])
+            idx //= 2
+        branch.append(count.to_bytes(8, "little") + b"\x00" * 24)
+        assert spec.is_valid_merkle_branch(
+            leaves[index], branch, TREE_DEPTH + 1, index,
+            model.get_deposit_root())

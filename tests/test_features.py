@@ -41,13 +41,25 @@ def test_whisk_shuffle_proof_roundtrip():
                     bls.G1_to_bytes48(bls.multiply(r_G, k))))
     perm = [2, 0, 3, 1]
     rers = [11, 22, 33, 44]
-    post, proof = whisk_proofs.prove_shuffle(pre, perm, rers)
+    post, proof = whisk_proofs.prove_shuffle(pre, perm, rers, seed=b"t")
     assert whisk_proofs.verify_shuffle(pre, post, proof)
     # tampered post tracker rejected
     bad_post = list(post)
     bad_post[0] = (post[1][0], post[0][1])
     assert not whisk_proofs.verify_shuffle(pre, bad_post, proof)
     assert not whisk_proofs.verify_shuffle(pre, post, proof[:-1])
+    # proof is zero-knowledge: the permutation appears nowhere in the
+    # wire format (no plaintext perm-index section; switch settings are
+    # hidden behind OR-proofs).  Distinct permutations with the same
+    # statement shape produce same-sized, structurally identical proofs.
+    # distinct seed per proof: reusing one leaks sigma nonces
+    post2, proof2 = whisk_proofs.prove_shuffle(
+        pre, [0, 1, 2, 3], rers, seed=b"t2")
+    assert len(proof2) == len(proof)
+    # corrupting any single switch proof must reject
+    tampered = bytearray(proof)
+    tampered[-20] ^= 1
+    assert not whisk_proofs.verify_shuffle(pre, post, bytes(tampered))
 
 
 # ---------------------------------------------------------------------------

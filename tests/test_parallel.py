@@ -65,8 +65,10 @@ def test_sharded_flag_deltas_matches_numpy(mesh):
     import numpy as np
     from consensus_specs_tpu.parallel.collectives import make_flag_deltas
     from consensus_specs_tpu.parallel import shard_array
+    # increments sized so the reward numerator base*weight*part_incr
+    # overflows int32 (mainnet-scale regression: lanes must be int64)
     n = 8 * 4
-    eff = np.full(n, 32, dtype=np.int32)
+    eff = np.full(n, 1 << 16, dtype=np.int32)
     active = np.ones(n, dtype=bool)
     active[5] = False
     part = np.arange(n) % 3 == 0
