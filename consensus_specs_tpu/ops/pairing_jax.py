@@ -29,15 +29,23 @@ import jax.numpy as jnp
 
 # dispatch granularity (PAIRING_MODE env) — see the mode notes above
 # pairing_check for the tradeoff table.  Default is platform-split: on
-# CPU hosts per-step kernels compile fastest (this build host has ONE
-# core; a chunk kernel costs minutes of XLA time) and launch latency is
-# nil, while through the axon TPU relay every launch pays a network
-# round trip (staged = ~650 trips/check) but compilation is served by
-# the remote compile service — so chunks win there.
-_DEFAULT_MODE = ("staged" if "cpu" in _os.environ.get("JAX_PLATFORMS", "")
-                 else "chunked")
-PAIRING_MODE = _os.environ.get("PAIRING_MODE", _DEFAULT_MODE)
+# CPU hosts per-step kernels compile fastest (a chunk kernel costs
+# minutes of XLA time on a small core count) and launch latency is nil,
+# while through a TPU relay every launch pays a network round trip
+# (staged = ~650 trips/check) but compilation is served remotely — so
+# chunks win there.  Resolved lazily from the ACTIVE backend, not env
+# guessing: JAX_PLATFORMS is unset on vanilla CPU hosts and may be a
+# fallback list.
+PAIRING_MODE = _os.environ.get("PAIRING_MODE")
 _CHUNK_BITS = 8
+
+
+def _resolve_mode() -> str:
+    global PAIRING_MODE
+    if PAIRING_MODE is None:
+        PAIRING_MODE = ("staged" if jax.default_backend() == "cpu"
+                        else "chunked")
+    return PAIRING_MODE
 
 from . import fq
 from . import fq_tower as ft
@@ -474,9 +482,10 @@ def pairing_check(xps, yps, xqs, yqs, skip=None):
         skip = jnp.concatenate(
             [skip, jnp.ones((bp - b, k), dtype=bool)], axis=0)
 
-    if PAIRING_MODE == "fused":
+    mode = _resolve_mode()
+    if mode == "fused":
         v = _pairing_check_fused(xps, yps, xqs, yqs, skip)
-    elif PAIRING_MODE == "chunked":
+    elif mode == "chunked":
         f = _miller_chunked(xps, yps, xqs, yqs, skip)
         f = _prod_reduce(f)
         v = _is_one_jit(final_exponentiation_chunked(f))
@@ -505,14 +514,15 @@ def warmup(k: int = 2, rows: int = _BUCKET_MIN_ROWS) -> None:
     sk = jnp.zeros((rows, k), bool)
     m = jnp.zeros((rows, 12, fq.LIMBS), jnp.uint32)
 
-    if PAIRING_MODE == "fused":
+    mode = _resolve_mode()
+    if mode == "fused":
         # all-skip rows: every lane checks 1 == 1, exercising the whole
         # program shape without meaningful data
         jax.block_until_ready(_pairing_check_fused(
             z1, z1, z2, z2, jnp.ones((rows, k), bool)))
         return
 
-    if PAIRING_MODE == "chunked":
+    if mode == "chunked":
         one2 = jnp.zeros((rows, k, 2, fq.LIMBS), jnp.uint32)
         f0 = ft.fq12_one((rows, k))
         jobs = [

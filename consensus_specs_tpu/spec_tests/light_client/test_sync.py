@@ -6,8 +6,12 @@ steps, and yields the sync vector format (meta, bootstrap, update_i...,
 steps)."""
 from ...ssz import hash_tree_root, uint64
 from ...test_infra.context import (
-    spec_test, with_all_phases_from, always_bls, _genesis_state,
+    spec_test, with_phases, always_bls, _genesis_state,
     default_balances, default_activation_threshold)
+
+# pre-capella, capella-header, and electra-gindex variants cover the
+# three LC header/proof shapes without paying all seven forks
+LC_FORKS = ["altair", "capella", "electra"]
 from ...test_infra.light_client_sync import (
     LightClientSyncTest, build_chain, make_update)
 
@@ -32,7 +36,7 @@ def _setup(spec, n_blocks=6):
     return spec, state, test, states, blocks
 
 
-@with_all_phases_from("altair")
+@with_phases(LC_FORKS)
 @spec_test
 @always_bls
 def test_light_client_sync_optimistic(spec):
@@ -47,7 +51,7 @@ def test_light_client_sync_optimistic(spec):
     yield from test.yield_parts(state)
 
 
-@with_all_phases_from("altair")
+@with_phases(LC_FORKS)
 @spec_test
 @always_bls
 def test_light_client_sync_with_finality(spec):
@@ -73,7 +77,7 @@ def test_light_client_sync_with_finality(spec):
     yield from test.yield_parts(state)
 
 
-@with_all_phases_from("altair")
+@with_phases(LC_FORKS)
 @spec_test
 @always_bls
 def test_light_client_sync_multiple_updates(spec):
@@ -90,7 +94,7 @@ def test_light_client_sync_multiple_updates(spec):
     yield from test.yield_parts(state)
 
 
-@with_all_phases_from("altair")
+@with_phases(LC_FORKS)
 @spec_test
 @always_bls
 def test_light_client_force_update(spec):

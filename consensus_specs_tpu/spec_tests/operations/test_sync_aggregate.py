@@ -2,7 +2,12 @@
 test/altair/block_processing/sync_aggregate/*; vector format
 tests/formats/operations)."""
 from ...test_infra.context import (
-    spec_state_test, with_all_phases_from, always_bls)
+    spec_state_test, with_phases, always_bls)
+
+# real-signature suite: three representative forks keep the default
+# pytest run inside budget (32 committee signatures per target); the
+# vector generator can widen via make_vector_cases(forks=...)
+SYNC_FORKS = ["altair", "deneb", "electra"]
 from ...test_infra.blocks import (
     build_empty_block_for_next_slot, next_slot, transition_to)
 from ...test_infra.sync_committee import (
@@ -20,7 +25,7 @@ def _block_with_aggregate(spec, state, participation_fn=None):
     return block
 
 
-@with_all_phases_from("altair")
+@with_phases(SYNC_FORKS)
 @spec_state_test
 @always_bls
 def test_sync_committee_rewards_all_participating(spec, state):
@@ -31,7 +36,7 @@ def test_sync_committee_rewards_all_participating(spec, state):
     assert sum(state.balances) > sum(pre_balances)
 
 
-@with_all_phases_from("altair")
+@with_phases(SYNC_FORKS)
 @spec_state_test
 @always_bls
 def test_sync_committee_half_participating(spec, state):
@@ -40,7 +45,7 @@ def test_sync_committee_half_participating(spec, state):
     yield from run_sync_committee_processing(spec, state, block)
 
 
-@with_all_phases_from("altair")
+@with_phases(SYNC_FORKS)
 @spec_state_test
 @always_bls
 def test_sync_committee_no_participants(spec, state):
@@ -54,14 +59,40 @@ def test_sync_committee_no_participants(spec, state):
     assert sum(state.balances) < sum(pre_balances)
 
 
-@with_all_phases_from("altair")
+@with_phases(SYNC_FORKS)
 @spec_state_test
 @always_bls
 def test_invalid_signature_bad_domain(spec, state):
+    """The full committee signs the right root under the WRONG domain
+    (attester domain instead of DOMAIN_SYNC_COMMITTEE)."""
+    from ...ssz import uint64
+    from ...test_infra.keys import privkey_for_pubkey
+    from ...utils import bls
+    block = build_empty_block_for_next_slot(spec, state)
+    transition_to(spec, state, block.slot)
+    previous_slot = uint64(max(int(state.slot), 1) - 1)
+    wrong_domain = spec.get_domain(
+        state, spec.DOMAIN_BEACON_ATTESTER,
+        spec.compute_epoch_at_slot(previous_slot))
+    signing_root = spec.compute_signing_root(
+        spec.get_block_root_at_slot(state, previous_slot), wrong_domain)
+    sigs = [bls.Sign(privkey_for_pubkey(pk), signing_root)
+            for pk in state.current_sync_committee.pubkeys]
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * int(spec.SYNC_COMMITTEE_SIZE),
+        sync_committee_signature=bls.Aggregate(sigs))
+    yield from run_sync_committee_processing(spec, state, block,
+                                             valid=False)
+
+
+@with_phases(SYNC_FORKS)
+@spec_state_test
+@always_bls
+def test_invalid_signature_corrupted(spec, state):
+    """A correctly-domained aggregate with one flipped byte."""
     block = build_empty_block_for_next_slot(spec, state)
     transition_to(spec, state, block.slot)
     agg = get_sync_aggregate(spec, state)
-    # flip one signature byte
     sig = bytearray(bytes(agg.sync_committee_signature))
     sig[5] ^= 0xFF
     agg.sync_committee_signature = bytes(sig)
@@ -70,7 +101,7 @@ def test_invalid_signature_bad_domain(spec, state):
                                              valid=False)
 
 
-@with_all_phases_from("altair")
+@with_phases(SYNC_FORKS)
 @spec_state_test
 @always_bls
 def test_invalid_signature_missing_participant(spec, state):
@@ -87,7 +118,7 @@ def test_invalid_signature_missing_participant(spec, state):
                                              valid=False)
 
 
-@with_all_phases_from("altair")
+@with_phases(SYNC_FORKS)
 @spec_state_test
 @always_bls
 def test_invalid_signature_infinity_with_participants(spec, state):
@@ -101,7 +132,7 @@ def test_invalid_signature_infinity_with_participants(spec, state):
                                              valid=False)
 
 
-@with_all_phases_from("altair")
+@with_phases(SYNC_FORKS)
 @spec_state_test
 @always_bls
 def test_proposer_in_committee(spec, state):

@@ -3,31 +3,23 @@ test/<fork>/random/test_random.py, code-generated there; hand-rolled
 here over the shared trajectory driver).  Each test yields the standard
 sanity-blocks vector shape: pre, blocks_<i>..., post."""
 from ...test_infra.context import (
-    spec_state_test, with_all_phases, never_bls)
+    spec_state_test, with_all_phases, with_phases, never_bls)
 from ...test_infra.random import run_random_trajectory
 
 
 def _run(spec, state, seed, slots=8):
-    """`pre` reflects the post-randomization, pre-blocks state."""
-    from ...ssz import uint64
-    from ...test_infra.blocks import next_slot, transition_to
-    from ...test_infra.random import (
-        apply_random_block, randomize_state, rng_for)
-    rng = rng_for(spec, seed)
-    transition_to(spec, state, uint64(int(spec.SLOTS_PER_EPOCH) * 2))
-    randomize_state(spec, state, rng)
+    """`pre` reflects the post-randomization, pre-blocks state; the
+    blocks come from the shared test_infra trajectory driver."""
+    from ...test_infra.random import trajectory_blocks
+    gen = trajectory_blocks(spec, state, seed, slots)
     yield "pre", state.copy()
-    signed = []
-    for _ in range(slots):
-        if rng.random() < 0.25:
-            next_slot(spec, state)
-        signed.append(apply_random_block(spec, state, rng))
+    signed = list(gen)
     for i, sb in enumerate(signed):
         yield f"blocks_{i}", sb
     yield "post", state
 
 
-@with_all_phases
+@with_phases(["phase0", "altair", "deneb"])  # signed tier
 @spec_state_test
 def test_random_scenario_0(spec, state):
     yield from _run(spec, state, seed=0)

@@ -2,4 +2,5 @@
 
 REWARDS_HANDLERS = {
     "basic": "consensus_specs_tpu.spec_tests.rewards.test_basic",
+    "leak": "consensus_specs_tpu.spec_tests.rewards.test_leak",
 }

@@ -1,0 +1,58 @@
+"""Reward deltas under an inactivity leak (reference:
+test/phase0/rewards/test_leak.py shape; vector format
+tests/formats/rewards)."""
+from ...ssz import uint64
+from ...test_infra.context import (
+    spec_state_test, with_all_phases, never_bls)
+from ...test_infra.blocks import transition_to
+from .test_basic import Deltas, _emit_deltas
+
+
+def _enter_leak(spec, state, participating: bool):
+    """Advance past MIN_EPOCHS_TO_INACTIVITY_PENALTY without finality;
+    optionally leave everyone participating."""
+    target = (int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 3) * \
+        int(spec.SLOTS_PER_EPOCH)
+    transition_to(spec, state, uint64(target))
+    n = len(state.validators)
+    if spec.is_post("altair"):
+        flags = 0
+        if participating:
+            for i in range(len(spec.PARTICIPATION_FLAG_WEIGHTS)):
+                flags = spec.add_flag(flags, i)
+        state.previous_epoch_participation = [flags] * n
+        state.inactivity_scores = [
+            0 if participating
+            else int(spec.config.INACTIVITY_SCORE_BIAS) * 4] * n
+    assert spec.is_in_inactivity_leak(state)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_leak_empty_participation(spec, state):
+    """Leaking with no participation: inactivity penalties bite."""
+    _enter_leak(spec, state, participating=False)
+    yield "pre", state.copy()
+    deltas = list(_emit_deltas(spec, state))
+    for name, d in deltas:
+        yield name, d
+    _, inactivity = deltas[-1]
+    assert sum(int(p) for p in inactivity.penalties) > 0
+    assert sum(int(r) for r in inactivity.rewards) == 0
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_leak_full_participation(spec, state):
+    """Leaking but fully participating: no inactivity penalties for
+    altair+ (zero scores); phase0 cancels via the base-reward offset."""
+    _enter_leak(spec, state, participating=True)
+    yield "pre", state.copy()
+    deltas = list(_emit_deltas(spec, state))
+    for name, d in deltas:
+        yield name, d
+    if spec.is_post("altair"):
+        _, inactivity = deltas[-1]
+        assert sum(int(p) for p in inactivity.penalties) == 0

@@ -3,4 +3,6 @@
 SANITY_HANDLERS = {
     "blocks": "consensus_specs_tpu.spec_tests.sanity.test_blocks",
     "slots": "consensus_specs_tpu.spec_tests.sanity.test_slots",
+    "multi_operations":
+        "consensus_specs_tpu.spec_tests.sanity.test_multi_operations",
 }

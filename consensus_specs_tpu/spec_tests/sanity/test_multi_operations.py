@@ -1,0 +1,39 @@
+"""Sanity blocks carrying a full operation mix (reference:
+test/phase0/sanity/test_blocks.py multi-op cases +
+helpers/multi_operations.py)."""
+from ...test_infra.context import spec_state_test, with_all_phases
+from ...test_infra.blocks import state_transition_and_sign_block
+from ...test_infra.multi_operations import build_block_with_operations
+
+
+@with_all_phases
+@spec_state_test
+def test_block_with_full_operation_mix(spec, state):
+    """One block carrying an attestation, a deposit, both slashing
+    kinds, and a voluntary exit; every channel applies."""
+    block, expect = build_block_with_operations(spec, state)
+    pre_validator_count = len(state.validators)
+    yield "pre", state.copy()
+    signed = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed]
+    yield "post", state
+    for idx in expect["slashed"]:
+        assert state.validators[idx].slashed
+    for idx in expect["exited"]:
+        assert state.validators[idx].exit_epoch != spec.FAR_FUTURE_EPOCH
+    assert len(state.validators) == pre_validator_count + 1  # deposit
+
+
+@with_all_phases
+@spec_state_test
+def test_block_with_attestations_only(spec, state):
+    block, _ = build_block_with_operations(
+        spec, state, with_deposit=False, with_proposer_slashing=False,
+        with_attester_slashing=False, with_voluntary_exit=False)
+    yield "pre", state.copy()
+    signed = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed]
+    yield "post", state
+    if not spec.is_post("altair"):
+        assert len(state.current_epoch_attestations) + \
+            len(state.previous_epoch_attestations) >= 1

@@ -1,0 +1,72 @@
+"""Build blocks carrying a full mix of operations (reference:
+test/helpers/multi_operations.py capability): one block exercising every
+operation channel the fork supports, each constructed against the same
+pre-state so they stay mutually valid.
+"""
+from __future__ import annotations
+
+from ..ssz import uint64
+from .attestations import get_valid_attestation
+from .blocks import build_empty_block_for_next_slot, transition_to
+from .deposits import prepare_state_and_deposit
+from .slashings import (
+    get_valid_attester_slashing, get_valid_proposer_slashing,
+    get_valid_voluntary_exit)
+
+
+def build_block_with_operations(spec, state, *,
+                                n_attestations: int = 1,
+                                with_deposit: bool = True,
+                                with_proposer_slashing: bool = True,
+                                with_attester_slashing: bool = True,
+                                with_voluntary_exit: bool = True):
+    """(block, expectations) for the advanced `state`.
+
+    Mutually-exclusive victims: the proposer slashing takes validator
+    well past the committee window, the attester slashing a committee
+    from a past slot, the exit another index — so every op applies in
+    one process_operations pass."""
+    # age the chain so exits pass the SHARD_COMMITTEE_PERIOD gate
+    period_slots = (int(spec.config.SHARD_COMMITTEE_PERIOD) + 1) * \
+        int(spec.SLOTS_PER_EPOCH)
+    if int(state.slot) < period_slots:
+        transition_to(spec, state, uint64(period_slots))
+
+    deposit = None
+    if with_deposit:
+        deposit = prepare_state_and_deposit(
+            spec, state, len(state.validators),
+            spec.MAX_EFFECTIVE_BALANCE, signed=True)
+
+    attestations = []
+    for i in range(n_attestations):
+        att = get_valid_attestation(spec, state, signed=True)
+        attestations.append(att)
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.attestations = attestations
+
+    expectations = {"slashed": set(), "exited": set()}
+    if with_proposer_slashing:
+        victim = len(state.validators) - 1
+        ps = get_valid_proposer_slashing(spec, state,
+                                         proposer_index=victim)
+        block.body.proposer_slashings = [ps]
+        expectations["slashed"].add(victim)
+    if with_attester_slashing:
+        aslash = get_valid_attester_slashing(spec, state)
+        block.body.attester_slashings = [aslash]
+        for idx in aslash.attestation_1.attesting_indices:
+            expectations["slashed"].add(int(idx))
+    if with_voluntary_exit:
+        exit_index = len(state.validators) - 2
+        if exit_index not in expectations["slashed"]:
+            sve = get_valid_voluntary_exit(spec, state, exit_index,
+                                           signed=True)
+            block.body.voluntary_exits = [sve]
+            expectations["exited"].add(exit_index)
+    if deposit is not None:
+        block.body.deposits = [deposit]
+    return block, expectations

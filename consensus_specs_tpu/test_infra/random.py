@@ -105,18 +105,27 @@ def apply_random_block(spec, state, rng):
     return signed
 
 
+def trajectory_blocks(spec, state, seed: int, slots: int):
+    """THE trajectory definition: warm past the genesis epoch, scramble
+    the state (eagerly, so callers can snapshot the pre-blocks state),
+    then return a generator of `slots` random signed blocks (mutating
+    `state`).  Both the pytest determinism check and the vector-emitting
+    tests drive this one path, so they cannot drift apart."""
+    rng = rng_for(spec, seed)
+    transition_to(spec, state,
+                  uint64(int(spec.SLOTS_PER_EPOCH) * 2))
+    randomize_state(spec, state, rng)
+
+    def blocks():
+        for _ in range(slots):
+            if rng.random() < 0.25:
+                next_slot(spec, state)  # empty slot
+            yield apply_random_block(spec, state, rng)
+    return blocks()
+
+
 def run_random_trajectory(spec, state, seed: int, slots: int = 8):
     """Apply `slots` random blocks; returns the signed blocks.  All
     blocks are valid by construction (illegal op mixes degrade to empty
     blocks, deterministically per seed)."""
-    rng = rng_for(spec, seed)
-    # warm the chain past genesis-epoch edge cases, then scramble
-    transition_to(spec, state,
-                  uint64(int(spec.SLOTS_PER_EPOCH) * 2))
-    randomize_state(spec, state, rng)
-    blocks = []
-    for _ in range(slots):
-        if rng.random() < 0.25:
-            next_slot(spec, state)  # empty slot
-        blocks.append(apply_random_block(spec, state, rng))
-    return blocks
+    return list(trajectory_blocks(spec, state, seed, slots))
