@@ -1,4 +1,6 @@
-"""BLS crypto-suite vectors: sign/verify/aggregate/fast_aggregate_verify.
+"""BLS crypto-suite vectors, all seven reference handlers: sign, verify,
+aggregate, fast_aggregate_verify, aggregate_verify,
+eth_aggregate_pubkeys, eth_fast_aggregate_verify.
 
 Format parity with the reference's tests/generators/bls/main.py: yaml
 cases with {input, output}.  Deterministic private keys match the test
@@ -72,10 +74,68 @@ def _fast_aggregate_verify_cases():
         "output": False})
 
 
+def _aggregate_verify_cases():
+    """Distinct (pubkey, message) pairs under one aggregate."""
+    pks = [bls.SkToPk(sk) for sk in PRIVKEYS]
+    sigs = [bls.Sign(sk, msg) for sk, msg in zip(PRIVKEYS, MESSAGES)]
+    agg = bls.Aggregate(sigs)
+    yield _yaml_case("aggregate_verify", "av_valid", {
+        "input": {"pubkeys": [_hex(p) for p in pks],
+                  "messages": [_hex(m) for m in MESSAGES],
+                  "signature": _hex(agg)},
+        "output": True})
+    shuffled = [MESSAGES[1], MESSAGES[0], MESSAGES[2]]
+    yield _yaml_case("aggregate_verify", "av_wrong_message_order", {
+        "input": {"pubkeys": [_hex(p) for p in pks],
+                  "messages": [_hex(m) for m in shuffled],
+                  "signature": _hex(agg)},
+        "output": False})
+    yield _yaml_case("aggregate_verify", "av_empty", {
+        "input": {"pubkeys": [], "messages": [],
+                  "signature": _hex(b"\xc0" + b"\x00" * 95)},
+        "output": False})
+
+
+def _eth_aggregate_pubkeys_cases():
+    """altair eth_aggregate_pubkeys: sum of pubkeys; empty list invalid."""
+    pks = [bls.SkToPk(sk) for sk in PRIVKEYS]
+    agg = bls.AggregatePKs(pks)
+    yield _yaml_case("eth_aggregate_pubkeys", "eap_3", {
+        "input": [_hex(p) for p in pks], "output": _hex(agg)})
+    yield _yaml_case("eth_aggregate_pubkeys", "eap_single", {
+        "input": [_hex(pks[0])], "output": _hex(pks[0])})
+    yield _yaml_case("eth_aggregate_pubkeys", "eap_empty", {
+        "input": [], "output": None})
+
+
+def _eth_fast_aggregate_verify_cases():
+    """altair variant: empty pubkeys + infinity signature is VALID."""
+    msg = MESSAGES[0]
+    pks = [bls.SkToPk(sk) for sk in PRIVKEYS]
+    agg = bls.Aggregate([bls.Sign(sk, msg) for sk in PRIVKEYS])
+    inf_sig = b"\xc0" + b"\x00" * 95
+    yield _yaml_case("eth_fast_aggregate_verify", "efav_valid", {
+        "input": {"pubkeys": [_hex(p) for p in pks], "message": _hex(msg),
+                  "signature": _hex(agg)},
+        "output": True})
+    yield _yaml_case("eth_fast_aggregate_verify", "efav_empty_infinity", {
+        "input": {"pubkeys": [], "message": _hex(msg),
+                  "signature": _hex(inf_sig)},
+        "output": True})
+    yield _yaml_case("eth_fast_aggregate_verify",
+                     "efav_nonempty_infinity", {
+        "input": {"pubkeys": [_hex(p) for p in pks], "message": _hex(msg),
+                  "signature": _hex(inf_sig)},
+        "output": False})
+
+
 def providers():
     def make_cases():
         yield from _sign_cases()
         yield from _verify_cases()
         yield from _aggregate_cases()
         yield from _fast_aggregate_verify_cases()
+        yield from _aggregate_verify_cases()
+        yield from _eth_aggregate_pubkeys_cases()
+        yield from _eth_fast_aggregate_verify_cases()
     return [TestProvider(make_cases=make_cases)]

@@ -156,14 +156,10 @@ def point_sum_tree(F, pts):
     return (X[0], Y[0], Z[0])
 
 
-def msm(F, pts, scalar_bits):
-    """Multi-scalar mul: per-point scalar mults (batched) + tree sum.
-
-    pts: (X, Y, Z) each [n, ...]; scalar_bits [n, n_bits] msb-first.
-    """
-    prods = point_scalar_mul(F, pts, scalar_bits)
-    return point_sum_tree(F, prods)
-
+# NOTE: no fused msm() here on purpose — jitting scalar-mul + the full
+# unrolled reduction tree in one graph is what pushed the 4096-point
+# MSM compile past the bench budget.  ops/msm.py composes
+# g*_scalar_mul with a host-driven pairwise tree over g*_add instead.
 
 # ---------------------------------------------------------------------------
 # jitted entry points (compile once per shape; eager dispatch of the limb
@@ -173,12 +169,10 @@ def msm(F, pts, scalar_bits):
 g1_add = jax.jit(lambda p, q: point_add(F1, p, q))
 g1_double = jax.jit(lambda p: point_double(F1, p))
 g1_scalar_mul = jax.jit(lambda p, bits: point_scalar_mul(F1, p, bits))
-g1_msm = jax.jit(lambda p, bits: msm(F1, p, bits))
 g1_sum = jax.jit(lambda p: point_sum_tree(F1, p))
 g2_add = jax.jit(lambda p, q: point_add(F2, p, q))
 g2_double = jax.jit(lambda p: point_double(F2, p))
 g2_scalar_mul = jax.jit(lambda p, bits: point_scalar_mul(F2, p, bits))
-g2_msm = jax.jit(lambda p, bits: msm(F2, p, bits))
 
 
 # ---------------------------------------------------------------------------

@@ -6,11 +6,13 @@ steps, and yields the sync vector format (meta, bootstrap, update_i...,
 steps)."""
 from ...ssz import hash_tree_root, uint64
 from ...test_infra.context import (
-    spec_test, with_phases, always_bls, _genesis_state,
+    spec_test, with_all_phases_from, with_pytest_fork_subset,
+    always_bls, _genesis_state,
     default_balances, default_activation_threshold)
 
-# pre-capella, capella-header, and electra-gindex variants cover the
-# three LC header/proof shapes without paying all seven forks
+# the PYTEST run covers the three LC header/proof shape variants
+# (pre-capella, capella header, electra gindices); the generator
+# still emits sync vectors for every altair+ fork
 LC_FORKS = ["altair", "capella", "electra"]
 from ...test_infra.light_client_sync import (
     LightClientSyncTest, build_chain, make_update)
@@ -36,7 +38,8 @@ def _setup(spec, n_blocks=6):
     return spec, state, test, states, blocks
 
 
-@with_phases(LC_FORKS)
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(LC_FORKS)
 @spec_test
 @always_bls
 def test_light_client_sync_optimistic(spec):
@@ -51,7 +54,8 @@ def test_light_client_sync_optimistic(spec):
     yield from test.yield_parts(state)
 
 
-@with_phases(LC_FORKS)
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(LC_FORKS)
 @spec_test
 @always_bls
 def test_light_client_sync_with_finality(spec):
@@ -77,7 +81,8 @@ def test_light_client_sync_with_finality(spec):
     yield from test.yield_parts(state)
 
 
-@with_phases(LC_FORKS)
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(LC_FORKS)
 @spec_test
 @always_bls
 def test_light_client_sync_multiple_updates(spec):
@@ -94,7 +99,8 @@ def test_light_client_sync_multiple_updates(spec):
     yield from test.yield_parts(state)
 
 
-@with_phases(LC_FORKS)
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(LC_FORKS)
 @spec_test
 @always_bls
 def test_light_client_force_update(spec):

@@ -2,11 +2,12 @@
 test/altair/block_processing/sync_aggregate/*; vector format
 tests/formats/operations)."""
 from ...test_infra.context import (
-    spec_state_test, with_phases, always_bls)
+    spec_state_test, with_all_phases_from, with_pytest_fork_subset,
+    always_bls)
 
-# real-signature suite: three representative forks keep the default
-# pytest run inside budget (32 committee signatures per target); the
-# vector generator can widen via make_vector_cases(forks=...)
+# real-signature suite: the default PYTEST run covers three
+# representative forks (32 committee signatures per target); the
+# generator still emits vectors for every altair+ fork
 SYNC_FORKS = ["altair", "deneb", "electra"]
 from ...test_infra.blocks import (
     build_empty_block_for_next_slot, next_slot, transition_to)
@@ -25,7 +26,8 @@ def _block_with_aggregate(spec, state, participation_fn=None):
     return block
 
 
-@with_phases(SYNC_FORKS)
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(SYNC_FORKS)
 @spec_state_test
 @always_bls
 def test_sync_committee_rewards_all_participating(spec, state):
@@ -36,7 +38,8 @@ def test_sync_committee_rewards_all_participating(spec, state):
     assert sum(state.balances) > sum(pre_balances)
 
 
-@with_phases(SYNC_FORKS)
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(SYNC_FORKS)
 @spec_state_test
 @always_bls
 def test_sync_committee_half_participating(spec, state):
@@ -45,7 +48,8 @@ def test_sync_committee_half_participating(spec, state):
     yield from run_sync_committee_processing(spec, state, block)
 
 
-@with_phases(SYNC_FORKS)
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(SYNC_FORKS)
 @spec_state_test
 @always_bls
 def test_sync_committee_no_participants(spec, state):
@@ -59,7 +63,8 @@ def test_sync_committee_no_participants(spec, state):
     assert sum(state.balances) < sum(pre_balances)
 
 
-@with_phases(SYNC_FORKS)
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(SYNC_FORKS)
 @spec_state_test
 @always_bls
 def test_invalid_signature_bad_domain(spec, state):
@@ -85,7 +90,8 @@ def test_invalid_signature_bad_domain(spec, state):
                                              valid=False)
 
 
-@with_phases(SYNC_FORKS)
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(SYNC_FORKS)
 @spec_state_test
 @always_bls
 def test_invalid_signature_corrupted(spec, state):
@@ -101,7 +107,8 @@ def test_invalid_signature_corrupted(spec, state):
                                              valid=False)
 
 
-@with_phases(SYNC_FORKS)
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(SYNC_FORKS)
 @spec_state_test
 @always_bls
 def test_invalid_signature_missing_participant(spec, state):
@@ -118,7 +125,8 @@ def test_invalid_signature_missing_participant(spec, state):
                                              valid=False)
 
 
-@with_phases(SYNC_FORKS)
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(SYNC_FORKS)
 @spec_state_test
 @always_bls
 def test_invalid_signature_infinity_with_participants(spec, state):
@@ -132,7 +140,8 @@ def test_invalid_signature_infinity_with_participants(spec, state):
                                              valid=False)
 
 
-@with_phases(SYNC_FORKS)
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(SYNC_FORKS)
 @spec_state_test
 @always_bls
 def test_proposer_in_committee(spec, state):

@@ -3,7 +3,8 @@ test/<fork>/random/test_random.py, code-generated there; hand-rolled
 here over the shared trajectory driver).  Each test yields the standard
 sanity-blocks vector shape: pre, blocks_<i>..., post."""
 from ...test_infra.context import (
-    spec_state_test, with_all_phases, with_phases, never_bls)
+    spec_state_test, with_all_phases, with_pytest_fork_subset,
+    never_bls)
 from ...test_infra.random import run_random_trajectory
 
 
@@ -19,7 +20,8 @@ def _run(spec, state, seed, slots=8):
     yield "post", state
 
 
-@with_phases(["phase0", "altair", "deneb"])  # signed tier
+@with_all_phases
+@with_pytest_fork_subset(["phase0", "altair", "deneb"])  # signed tier
 @spec_state_test
 def test_random_scenario_0(spec, state):
     yield from _run(spec, state, seed=0)

@@ -138,6 +138,17 @@ def with_all_phases_except(excluded):
     return deco
 
 
+def with_pytest_fork_subset(forks):
+    """Restrict the PYTEST run to `forks` without narrowing the
+    generator: expensive real-signature suites keep CI inside budget on
+    a representative subset while conformance vectors still cover every
+    fork the test applies to."""
+    def deco(fn):
+        _meta(fn)["pytest_forks"] = list(forks)
+        return fn
+    return deco
+
+
 def with_presets(presets, reason: str | None = None):
     def deco(fn):
         _meta(fn)["presets"] = list(presets)
@@ -247,7 +258,10 @@ def _make_runner(fn, needs_state: bool):
     def runner():
         meta = _meta(runner)
         ran = 0
-        for _fork, _preset, spec in _selected_targets(meta):
+        # pytest-only narrowing; make_vector_cases ignores this so the
+        # generator keeps full fork coverage
+        for _fork, _preset, spec in _selected_targets(
+                meta, forks=meta.get("pytest_forks")):
             with _bls_mode(meta, generator_mode=False):
                 _run_single(fn, meta, spec, needs_state, collect=False)
             ran += 1

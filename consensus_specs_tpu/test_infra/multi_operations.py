@@ -15,7 +15,7 @@ from .slashings import (
 
 
 def build_block_with_operations(spec, state, *,
-                                n_attestations: int = 1,
+                                with_attestation: bool = True,
                                 with_deposit: bool = True,
                                 with_proposer_slashing: bool = True,
                                 with_attester_slashing: bool = True,
@@ -39,9 +39,9 @@ def build_block_with_operations(spec, state, *,
             spec.MAX_EFFECTIVE_BALANCE, signed=True)
 
     attestations = []
-    for i in range(n_attestations):
-        att = get_valid_attestation(spec, state, signed=True)
-        attestations.append(att)
+    if with_attestation:
+        attestations.append(
+            get_valid_attestation(spec, state, signed=True))
     transition_to(spec, state,
                   state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
 

@@ -57,13 +57,13 @@ def test_g1_scalar_mul():
 
 
 def test_g1_msm():
+    """The live device-MSM path: batched scalar mults + host-driven
+    pairwise tree reduction (ops/msm.py)."""
+    from consensus_specs_tpu.ops import msm as dmsm
     scalars = [rng.randrange(R) for _ in range(5)]
-    pts = cj.g1_pack(P1[:5])
-    bits = cj.scalars_to_bits(scalars)
-    got = cj.g1_msm(pts, bits)
+    got = dmsm.g1_multi_exp(P1[:5], scalars)
     want = cv.msm(P1[:5], scalars)
-    one = cj.g1_unpack(tuple(x[None] for x in got))[0]
-    assert one == want
+    assert got == want
 
 
 def test_g2_double_add_scalar():
