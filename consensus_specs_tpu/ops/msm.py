@@ -1,5 +1,6 @@
-"""Device multi-scalar multiplication: host-facing wrapper over the
-batched Jacobian MSM kernel (ops/curve_jax.py msm).
+"""Device multi-scalar multiplication: batched Jacobian scalar-mul
+lanes (ops/curve_jax.py g*_scalar_mul) composed with a host-driven
+pairwise-add tree reduction.
 
 Capability counterpart of the reference's arkworks `multiexp_unchecked`
 (utils/bls.py:224-296): `g1_multi_exp(points, scalars)` takes oracle G1

@@ -197,7 +197,9 @@ def _selected_targets(meta, forks=None, presets=None):
     test_forks = meta.get("forks") or list(MAINLINE_FORKS)
     if forks is not None:
         test_forks = [f for f in test_forks if f in forks]
-    elif DEFAULT_PYTEST_FORKS is not None:
+    if DEFAULT_PYTEST_FORKS is not None:
+        # the --fork CLI filter applies on top of any explicit subset
+        # (pytest_forks must not resurrect forks the user filtered out)
         test_forks = [f for f in test_forks if f in DEFAULT_PYTEST_FORKS]
     overrides = meta.get("config_overrides")
     for preset in presets:

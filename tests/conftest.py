@@ -8,6 +8,10 @@ import os
 
 # The environment pins JAX_PLATFORMS=axon (TPU tunnel) via sitecustomize, so
 # a plain env var is not enough — force the config before any jax use.
+# Stash the original pin first: test_tpu_live drives the real
+# accelerator in subprocesses and needs it back.
+os.environ.setdefault("ORIG_JAX_PLATFORMS",
+                      os.environ.get("JAX_PLATFORMS", ""))
 os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 

@@ -1,0 +1,139 @@
+"""Validator-duties / weak-subjectivity / p2p-helper tests (reference:
+specs/phase0/validator.md honest-validator helpers,
+weak-subjectivity.md:87-176, p2p-interface.md:1071-1090)."""
+import pytest
+
+from consensus_specs_tpu.specs import get_spec
+from consensus_specs_tpu.ssz import uint64
+from consensus_specs_tpu.test_infra.context import (
+    _genesis_state, default_balances, default_activation_threshold)
+from consensus_specs_tpu.test_infra.blocks import (
+    build_empty_block_for_next_slot, next_epoch)
+from consensus_specs_tpu.test_infra.keys import privkeys
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_spec("phase0", "minimal")
+
+
+@pytest.fixture()
+def state(spec):
+    return _genesis_state(spec, default_balances,
+                          default_activation_threshold, "duties")
+
+
+def test_committee_assignment_covers_every_active_validator(spec, state):
+    """Each active validator appears in exactly one committee per
+    epoch."""
+    epoch = spec.get_current_epoch(state)
+    seen = {}
+    for index in range(len(state.validators)):
+        assignment = spec.get_committee_assignment(state, epoch, index)
+        if spec.check_if_validator_active(state, index):
+            assert assignment is not None
+            committee, c_index, slot = assignment
+            assert index in committee
+            assert spec.compute_epoch_at_slot(slot) == epoch
+            seen[index] = (int(c_index), int(slot))
+    assert len(seen) == len(state.validators)
+
+
+def test_is_proposer_matches_selection(spec, state):
+    proposer = spec.get_beacon_proposer_index(state)
+    assert spec.is_proposer(state, proposer)
+    others = [i for i in range(len(state.validators)) if i != proposer]
+    assert not spec.is_proposer(state, others[0])
+
+
+def test_aggregator_selection_is_deterministic(spec, state):
+    """is_aggregator depends only on the slot signature (spec
+    validator.md aggregation selection)."""
+    slot = state.slot
+    committee = spec.get_beacon_committee(state, slot, uint64(0))
+    sig = spec.get_slot_signature(state, slot, privkeys[0])
+    a = spec.is_aggregator(state, slot, uint64(0), sig)
+    b = spec.is_aggregator(state, slot, uint64(0), sig)
+    assert a == b
+    assert len(committee) >= 1
+
+
+def test_subnet_computation_in_range(spec, state):
+    committees = spec.get_committee_count_per_slot(
+        state, spec.get_current_epoch(state))
+    subnet = spec.compute_subnet_for_attestation(
+        committees, state.slot, uint64(0))
+    assert 0 <= int(subnet) < int(spec.ATTESTATION_SUBNET_COUNT)
+
+
+def test_subscribed_subnets_stable_within_period(spec, state):
+    """A node's subnet subscriptions are stable across an epoch inside
+    one subscription period and distinct per node (with overwhelming
+    probability for distinct ids)."""
+    # ids chosen with distinct top-PREFIX_BITS and equal (zero)
+    # node_offset: the shuffle is a permutation, so distinct prefixes
+    # under one seed GUARANTEE distinct subnets
+    node_a, node_b = 0x5 << 252, 0x9 << 252
+    epoch = uint64(5)
+    subs = spec.compute_subscribed_subnets(node_a, epoch)
+    assert len(subs) == int(spec.config.SUBNETS_PER_NODE)
+    for s in subs:
+        assert 0 <= int(s) < int(spec.ATTESTATION_SUBNET_COUNT)
+    assert subs == spec.compute_subscribed_subnets(node_a, epoch)
+    # consecutive epochs inside one EPOCHS_PER_SUBNET_SUBSCRIPTION
+    # period with node_offset 0 resolve to the same permutation seed
+    period = int(spec.config.EPOCHS_PER_SUBNET_SUBSCRIPTION)
+    e0 = uint64(period * 3)
+    assert spec.compute_subscribed_subnets(node_a, e0) == \
+        spec.compute_subscribed_subnets(node_a, uint64(int(e0) + 1))
+    # distinct node ids land on distinct subnets for these fixed inputs
+    # (deterministic here; a seed that ignored node_id would collide)
+    assert spec.compute_subscribed_subnets(node_a, epoch) != \
+        spec.compute_subscribed_subnets(node_b, epoch)
+
+
+def test_weak_subjectivity_period_floor(spec, state):
+    """ws period >= MIN_VALIDATOR_WITHDRAWABILITY_DELAY and grows with
+    balance deviation handling (weak-subjectivity.md:87)."""
+    ws = spec.compute_weak_subjectivity_period(state)
+    assert int(ws) >= int(spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY)
+
+
+def test_is_within_weak_subjectivity_period(spec, state):
+    next_epoch(spec, state)
+    # store whose clock sits exactly at the ws state's epoch
+    from consensus_specs_tpu.ssz import hash_tree_root
+    header = state.latest_block_header.copy()
+    if header.state_root == b"\x00" * 32:
+        header.state_root = hash_tree_root(state)
+    ws_state = state
+    # the spec pins ws_checkpoint.root to the header's state root
+    ws_checkpoint = spec.Checkpoint(
+        epoch=spec.compute_epoch_at_slot(state.slot),
+        root=header.state_root)
+
+    class _Store:
+        genesis_time = state.genesis_time
+        time = int(state.genesis_time) + \
+            int(state.slot) * int(spec.config.SECONDS_PER_SLOT)
+    ws_state.latest_block_header.state_root = header.state_root
+    assert spec.is_within_weak_subjectivity_period(
+        _Store, ws_state, ws_checkpoint)
+
+
+def test_eth1_vote_and_block_signature(spec, state):
+    """get_eth1_vote falls back to state.eth1_data with no candidate
+    chain; block signature verifies against the proposer key."""
+    vote = spec.get_eth1_vote(state, [])
+    assert vote == state.eth1_data
+    block = build_empty_block_for_next_slot(spec, state)
+    proposer = block.proposer_index
+    sig = spec.get_block_signature(
+        state, block, privkeys[
+            spec_pubkey_index(spec, state, proposer)])
+    assert isinstance(bytes(sig), bytes) and len(bytes(sig)) == 96
+
+
+def spec_pubkey_index(spec, state, validator_index):
+    from consensus_specs_tpu.test_infra.keys import pubkeys
+    return pubkeys.index(bytes(state.validators[validator_index].pubkey))
