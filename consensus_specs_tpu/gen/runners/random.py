@@ -1,81 +1,12 @@
-"""Randomized block-trajectory vectors.
-
-Format parity with the reference's tests/generators/random (sanity/blocks
-format: pre + blocks_i + post): seeded random walks interleaving empty
-slots, empty blocks, attestation-carrying blocks, and epoch boundaries —
-the trajectory shape of eth2spec.test.utils.randomized_block_tests.
-"""
-from random import Random
-
-from ..typing import TestCase, TestProvider
-from ...specs import get_spec
-from ...ssz import uint64
-from ...test_infra import disable_bls
-from ...test_infra.context import (
-    _genesis_state, default_balances, default_activation_threshold,
-    MAINLINE_FORKS)
-from ...test_infra.attestations import get_valid_attestation
-from ...test_infra.blocks import (
-    build_empty_block_for_next_slot, next_slot,
-    state_transition_and_sign_block)
-
-
-def _random_block(spec, state, rng):
-    block = build_empty_block_for_next_slot(spec, state)
-    if rng.random() < 0.6 and state.slot >= \
-            spec.MIN_ATTESTATION_INCLUSION_DELAY:
-        slot = uint64(int(state.slot)
-                      - int(spec.MIN_ATTESTATION_INCLUSION_DELAY) + 1)
-        if slot >= spec.compute_start_slot_at_epoch(
-                spec.get_current_epoch(state)):
-            att = get_valid_attestation(spec, state, slot=slot,
-                                        signed=True)
-            block.body.attestations.append(att)
-    return block
-
-
-def _random_case(fork: str, seed: int, steps: int = 12):
-    def fn():
-        spec = get_spec(fork, "minimal")
-        rng = Random(seed)
-        with disable_bls():
-            state = _genesis_state(spec, default_balances,
-                                   default_activation_threshold, "")
-            yield "pre", state.copy()
-            blocks = []
-            for _ in range(steps):
-                roll = rng.random()
-                if roll < 0.3:
-                    next_slot(spec, state)
-                elif roll < 0.5:
-                    # leap toward the next epoch boundary
-                    target = uint64(
-                        int(state.slot) + int(spec.SLOTS_PER_EPOCH)
-                        - int(state.slot) % int(spec.SLOTS_PER_EPOCH))
-                    spec.process_slots(state, target)
-                else:
-                    block = _random_block(spec, state, rng)
-                    blocks.append(state_transition_and_sign_block(
-                        spec, state, block))
-            # the sanity/blocks format replays ONLY blocks (each
-            # state_transition advances slots implicitly): the trajectory
-            # must END with a block or the post state is unreachable
-            block = _random_block(spec, state, rng)
-            blocks.append(state_transition_and_sign_block(
-                spec, state, block))
-            for i, sb in enumerate(blocks):
-                yield f"blocks_{i}", sb
-            yield "blocks_count", "meta", len(blocks)
-            yield "post", state
-    return TestCase(
-        fork_name=fork, preset_name="minimal", runner_name="random",
-        handler_name="random", suite_name="random",
-        case_name=f"random_{seed}", case_fn=fn)
+"""Randomized block-trajectory vectors, reflected from the dual-mode
+spec tests (spec_tests/random/test_random.py over the SHARED
+test_infra/random trajectory driver — one codebase for pytest
+determinism checks and emitted vectors; format: the sanity/blocks shape
+pre + blocks_i + post, reference tests/generators/random)."""
+from ..reflect import providers_from_handlers
 
 
 def providers():
-    def make_cases():
-        for fork in MAINLINE_FORKS:
-            for seed in (0, 1):
-                yield _random_case(fork, seed)
-    return [TestProvider(make_cases=make_cases)]
+    return providers_from_handlers("random", {
+        "random": "consensus_specs_tpu.spec_tests.random.test_random",
+    })

@@ -5,4 +5,6 @@ FORK_CHOICE_HANDLERS = {
         "consensus_specs_tpu.spec_tests.fork_choice.test_get_head",
     "on_block":
         "consensus_specs_tpu.spec_tests.fork_choice.test_on_block",
+    "on_attestation":
+        "consensus_specs_tpu.spec_tests.fork_choice.test_on_attestation",
 }

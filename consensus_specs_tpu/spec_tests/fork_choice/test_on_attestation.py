@@ -1,0 +1,110 @@
+"""on_attestation fork-choice tests (reference:
+test/phase0/unittests/fork_choice/test_on_attestation.py shape, emitted
+as step vectors): latest-message updates, future/old-epoch rejection,
+unknown-block rejection, and the proposer-boost root lifecycle."""
+from ...ssz import hash_tree_root, uint64
+from ...test_infra.context import (
+    spec_state_test, with_all_phases, never_bls)
+from ...test_infra.attestations import get_valid_attestation
+from ...test_infra.blocks import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block)
+from ...test_infra.fork_choice import (
+    start_fork_choice_test, tick_and_add_block, add_attestation,
+    output_store_checks, emit_steps, tick_to_slot)
+
+
+def _chain_block(spec, state, store, steps):
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    parts = list(tick_and_add_block(spec, store, signed, steps))
+    return signed, parts
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_on_attestation_updates_latest_messages(spec, state):
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    signed, block_parts = _chain_block(spec, state, store, steps)
+    for name, v in block_parts:
+        yield name, v
+    attestation = get_valid_attestation(spec, state,
+                                        slot=signed.message.slot,
+                                        signed=True)
+    # attestations are only considered from the NEXT slot
+    tick_to_slot(spec, store, int(signed.message.slot) + 1, steps)
+    for name, v in add_attestation(spec, store, attestation, steps):
+        yield name, v
+    target_root = hash_tree_root(signed.message)
+    updated = [i for i, msg in store.latest_messages.items()
+               if msg.root == target_root]
+    assert updated, "no latest message recorded"
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_on_attestation_rejects_current_slot(spec, state):
+    """An attestation for the current slot is premature (must wait one
+    slot)."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    signed, block_parts = _chain_block(spec, state, store, steps)
+    for name, v in block_parts:
+        yield name, v
+    attestation = get_valid_attestation(spec, state,
+                                        slot=signed.message.slot,
+                                        signed=True)
+    # store clock still at the attestation's own slot
+    for name, v in add_attestation(spec, store, attestation, steps,
+                                   valid=False):
+        yield name, v
+    yield from emit_steps(steps)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_on_attestation_rejects_unknown_block(spec, state):
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    attestation = get_valid_attestation(spec, state, signed=True)
+    attestation.data.beacon_block_root = b"\x99" * 32
+    tick_to_slot(spec, store, int(state.slot) + 2, steps)
+    for name, v in add_attestation(spec, store, attestation, steps,
+                                   valid=False):
+        yield name, v
+    yield from emit_steps(steps)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_proposer_boost_set_and_reset(spec, state):
+    """A timely first block sets proposer_boost_root; the next slot
+    tick clears it (fork-choice.md proposer-boost lifecycle)."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    # tick exactly to the block's slot start: arrival is timely
+    tick_to_slot(spec, store, int(signed.message.slot), steps)
+    from ...test_infra.fork_choice import add_block
+    for name, v in add_block(spec, store, signed, steps):
+        yield name, v
+    root = hash_tree_root(signed.message)
+    assert store.proposer_boost_root == root
+    # boosted head is the new block
+    assert spec.get_head(store) == root
+    # advancing to the next slot resets the boost
+    tick_to_slot(spec, store, int(signed.message.slot) + 1, steps)
+    assert store.proposer_boost_root == bytes(32)
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)

@@ -4,7 +4,7 @@ here over the shared trajectory driver).  Each test yields the standard
 sanity-blocks vector shape: pre, blocks_<i>..., post."""
 from ...test_infra.context import (
     spec_state_test, with_all_phases, with_pytest_fork_subset,
-    never_bls)
+    never_bls, no_vectors)
 from ...test_infra.random import run_random_trajectory
 
 
@@ -17,6 +17,7 @@ def _run(spec, state, seed, slots=8):
     signed = list(gen)
     for i, sb in enumerate(signed):
         yield f"blocks_{i}", sb
+    yield "blocks_count", "meta", len(signed)
     yield "post", state
 
 
@@ -42,6 +43,7 @@ def test_random_scenario_2(spec, state):
 
 
 @with_all_phases
+@no_vectors
 @spec_state_test
 @never_bls
 def test_random_replay_exact(spec, state):

@@ -138,6 +138,14 @@ def with_all_phases_except(excluded):
     return deco
 
 
+def no_vectors(fn):
+    """Mark a test as pytest-only (a unit/consistency check, not a
+    conformance case) — the reference's check_mods exclusion for
+    unittests.  make_vector_cases returns no cases for it."""
+    _meta(fn)["no_vectors"] = True
+    return fn
+
+
 def with_pytest_fork_subset(forks):
     """Restrict the PYTEST run to `forks` without narrowing the
     generator: expensive real-signature suites keep CI inside budget on
@@ -287,6 +295,8 @@ def _make_runner(fn, needs_state: bool):
         the reference's gen_from_tests capability (gen.py:18-61)."""
         from ..gen.typing import TestCase
         meta = _meta(runner)
+        if meta.get("no_vectors"):
+            return []
         name = case_name or (fn.__name__[5:]
                              if fn.__name__.startswith("test_")
                              else fn.__name__)

@@ -204,3 +204,22 @@ def test_bls_and_kzg_runners(tmp_path):
         bytes.fromhex(case["input"]["message"][2:]),
         bytes.fromhex(case["input"]["signature"][2:]))
     assert ok == case["output"]
+
+
+def test_all_runners_enumerate_cases():
+    """Wiring smoke for every registered runner: providers build and
+    case enumeration yields at least one TestCase (catches broken
+    reflection imports without executing case bodies).  The heavyweight
+    end-to-end paths are covered per-runner above/elsewhere."""
+    from consensus_specs_tpu.gen.runners import RUNNER_NAMES, get_providers
+    # enumerating every runner's full case list costs minutes (genesis
+    # builds per fork); spot-check the reflected ones plus one standalone
+    for runner in ("operations", "epoch_processing", "rewards", "sanity",
+                   "light_client", "shuffling", "random", "fork_choice"):
+        assert runner in RUNNER_NAMES
+        providers = get_providers(runner)
+        assert providers
+        it = iter(providers[0].make_cases())
+        first = next(it, None)
+        assert first is not None, f"runner {runner} yields no cases"
+        assert first.runner_name == runner

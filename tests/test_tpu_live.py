@@ -40,6 +40,8 @@ def _run_on_device(code: str, timeout: int):
 
 
 def _device_available() -> bool:
+    """True only for a NON-cpu backend: a cpu fallback would make every
+    'live accelerator' test vacuously green."""
     try:
         probe = _run_on_device(
             "import jax; jax.block_until_ready("
@@ -47,7 +49,10 @@ def _device_available() -> bool:
             "jax.default_backend())", timeout=90)
     except subprocess.TimeoutExpired:
         return False
-    return probe.returncode == 0 and "OK" in probe.stdout
+    if probe.returncode != 0 or "OK" not in probe.stdout:
+        return False
+    backend = probe.stdout.strip().split()[-1]
+    return backend != "cpu"
 
 
 _available = None
@@ -89,4 +94,5 @@ def test_device_backend_is_accelerator(device):
         timeout=90)
     assert result.returncode == 0
     backend = result.stdout.strip().split()[-1]
-    assert backend  # informational: axon/tpu on the real chip, cpu off it
+    assert backend != "cpu", "accelerator fixture passed but the " \
+        "subprocess fell back to cpu"
