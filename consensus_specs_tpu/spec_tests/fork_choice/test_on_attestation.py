@@ -2,7 +2,7 @@
 test/phase0/unittests/fork_choice/test_on_attestation.py shape, emitted
 as step vectors): latest-message updates, future/old-epoch rejection,
 unknown-block rejection, and the proposer-boost root lifecycle."""
-from ...ssz import hash_tree_root, uint64
+from ...ssz import hash_tree_root
 from ...test_infra.context import (
     spec_state_test, with_all_phases, never_bls)
 from ...test_infra.attestations import get_valid_attestation
@@ -10,7 +10,7 @@ from ...test_infra.blocks import (
     build_empty_block_for_next_slot, state_transition_and_sign_block)
 from ...test_infra.fork_choice import (
     start_fork_choice_test, tick_and_add_block, add_attestation,
-    output_store_checks, emit_steps, tick_to_slot)
+    add_block, output_store_checks, emit_steps, tick_to_slot)
 
 
 def _chain_block(spec, state, store, steps):
@@ -96,7 +96,6 @@ def test_proposer_boost_set_and_reset(spec, state):
     signed = state_transition_and_sign_block(spec, state, block)
     # tick exactly to the block's slot start: arrival is timely
     tick_to_slot(spec, store, int(signed.message.slot), steps)
-    from ...test_infra.fork_choice import add_block
     for name, v in add_block(spec, store, signed, steps):
         yield name, v
     root = hash_tree_root(signed.message)

@@ -13,10 +13,11 @@ from ..ssz import (
     Bytes32, Bytes48, Bytes96, hash_tree_root,
 )
 from .electra import ElectraSpec, NewPayloadRequest
+from .eip7732_fork_choice import Eip7732ForkChoice
 from ..utils import bls
 
 
-class Eip7732Spec(ElectraSpec):
+class Eip7732Spec(Eip7732ForkChoice, ElectraSpec):
     fork = "eip7732"
 
     # ------------------------------------------------------------------

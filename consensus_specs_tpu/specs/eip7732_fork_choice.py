@@ -1,0 +1,432 @@
+"""EIP-7732 (ePBS) fork choice: (block, slot, payload-present) voting.
+
+From-scratch implementation of
+/root/reference/specs/_features/eip7732/fork-choice.md: the store tracks
+empty/full intermediate states per consensus block plus PTC votes;
+LMD-GHOST runs over ChildNode triples (root, slot, is_payload_present)
+with three boosts (proposer, builder-reveal, builder-withhold); new
+handlers on_execution_payload and on_payload_attestation_message.
+Mixed into Eip7732Spec ahead of the phase0 fork choice in the MRO.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+from ..ssz import Bytes32, hash_tree_root, uint64
+from .fork_choice import Store as BaseStore
+
+
+@dataclass
+class LatestMessageBySlot:
+    """EIP-7732 LatestMessage tracks the SLOT (not the epoch)."""
+    slot: int
+    root: bytes
+
+
+@dataclass
+class ChildNode:
+    """(block, slot, bool) LMD voting unit (fork-choice.md:55-63)."""
+    root: bytes
+    slot: int
+    is_payload_present: bool
+
+
+@dataclass
+class Eip7732Store(BaseStore):
+    # [New in EIP-7732]
+    payload_withhold_boost_root: bytes = Bytes32()
+    payload_withhold_boost_full: bool = True
+    payload_reveal_boost_root: bytes = Bytes32()
+    execution_payload_states: Dict[bytes, object] = field(
+        default_factory=dict)
+    ptc_vote: Dict[bytes, list] = field(default_factory=dict)
+
+
+class Eip7732ForkChoice:
+    INTERVALS_PER_SLOT = 4              # [modified in EIP-7732]
+    PROPOSER_SCORE_BOOST_PCT = 20       # [modified in EIP-7732]
+    PAYLOAD_WITHHOLD_BOOST_PCT = 40
+    PAYLOAD_REVEAL_BOOST_PCT = 40
+
+    Store = Eip7732Store
+    LatestMessage = LatestMessageBySlot
+    ChildNode = ChildNode
+
+    @property
+    def PAYLOAD_TIMELY_THRESHOLD(self) -> int:
+        return int(self.PTC_SIZE) // 2
+
+    # ------------------------------------------------------------------
+    # store construction
+    # ------------------------------------------------------------------
+    def get_forkchoice_store(self, anchor_state, anchor_block):
+        assert anchor_block.state_root == hash_tree_root(anchor_state)
+        anchor_root = hash_tree_root(anchor_block)
+        anchor_epoch = self.get_current_epoch(anchor_state)
+        justified = self.Checkpoint(epoch=anchor_epoch, root=anchor_root)
+        finalized = self.Checkpoint(epoch=anchor_epoch, root=anchor_root)
+        return Eip7732Store(
+            time=int(anchor_state.genesis_time
+                     + self.config.SECONDS_PER_SLOT * anchor_state.slot),
+            genesis_time=int(anchor_state.genesis_time),
+            justified_checkpoint=justified,
+            finalized_checkpoint=finalized,
+            unrealized_justified_checkpoint=justified,
+            unrealized_finalized_checkpoint=finalized,
+            proposer_boost_root=Bytes32(),
+            blocks={anchor_root: anchor_block.copy()},
+            block_states={anchor_root: anchor_state.copy()},
+            checkpoint_states={justified: anchor_state.copy()},
+            unrealized_justifications={anchor_root: justified},
+            execution_payload_states={anchor_root: anchor_state.copy()},
+            ptc_vote={anchor_root: [self.PAYLOAD_ABSENT]
+                      * int(self.PTC_SIZE)},
+        )
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+    def update_latest_messages(self, store, attesting_indices,
+                               attestation) -> None:
+        # keyed by SLOT (fork-choice.md:77-88)
+        slot = attestation.data.slot
+        root = attestation.data.beacon_block_root
+        for i in attesting_indices:
+            if i in store.equivocating_indices:
+                continue
+            if i not in store.latest_messages or \
+                    slot > store.latest_messages[i].slot:
+                store.latest_messages[i] = LatestMessageBySlot(
+                    slot=int(slot), root=bytes(root))
+
+    def notify_ptc_messages(self, store, state,
+                            payload_attestations) -> None:
+        """Apply in-block payload attestations (no signature checks —
+        the block carried them)."""
+        if state.slot == 0:
+            return
+        for payload_attestation in payload_attestations:
+            indexed = self.get_indexed_payload_attestation(
+                state, uint64(int(state.slot) - 1), payload_attestation)
+            for idx in indexed.attesting_indices:
+                self.on_payload_attestation_message(
+                    store,
+                    self.PayloadAttestationMessage(
+                        validator_index=idx,
+                        data=payload_attestation.data,
+                        signature=b"\x00" * 96),
+                    is_from_block=True)
+
+    def is_payload_present(self, store, beacon_block_root) -> bool:
+        assert beacon_block_root in store.ptc_vote
+        return store.ptc_vote[beacon_block_root].count(
+            self.PAYLOAD_PRESENT) > self.PAYLOAD_TIMELY_THRESHOLD
+
+    def is_parent_node_full(self, store, block) -> bool:
+        parent = store.blocks[block.parent_root]
+        parent_block_hash = \
+            block.body.signed_execution_payload_header.message.parent_block_hash
+        message_block_hash = \
+            parent.body.signed_execution_payload_header.message.block_hash
+        return bytes(parent_block_hash) == bytes(message_block_hash)
+
+    def get_ancestor(self, store, root, slot) -> ChildNode:
+        """Ancestor WITH payload status (fork-choice.md:195-213)."""
+        block = store.blocks[root]
+        if block.slot <= slot:
+            return ChildNode(
+                root=bytes(root), slot=int(slot),
+                is_payload_present=self.is_payload_present(store, root))
+        parent = store.blocks[block.parent_root]
+        if parent.slot > slot:
+            return self.get_ancestor(store, block.parent_root, slot)
+        return ChildNode(
+            root=bytes(block.parent_root), slot=int(parent.slot),
+            is_payload_present=self.is_parent_node_full(store, block))
+
+    def get_checkpoint_block(self, store, root, epoch) -> bytes:
+        epoch_first_slot = self.compute_start_slot_at_epoch(epoch)
+        return self.get_ancestor(store, root, epoch_first_slot).root
+
+    def is_supporting_vote(self, store, node: ChildNode, message) -> bool:
+        if bytes(node.root) == bytes(message.root):
+            return node.slot <= message.slot
+        message_block = store.blocks[message.root]
+        if node.slot >= message_block.slot:
+            return False
+        ancestor = self.get_ancestor(store, message.root, node.slot)
+        return (bytes(node.root) == bytes(ancestor.root)
+                and node.is_payload_present == ancestor.is_payload_present)
+
+    # ------------------------------------------------------------------
+    # boosts
+    # ------------------------------------------------------------------
+    def _committee_boost(self, state, percent) -> int:
+        committee_weight = self.get_total_active_balance(state) \
+            // self.SLOTS_PER_EPOCH
+        return uint64((committee_weight * percent) // 100)
+
+    def compute_proposer_boost(self, store, state, node: ChildNode) -> int:
+        if store.proposer_boost_root == Bytes32():
+            return uint64(0)
+        ancestor = self.get_ancestor(store, store.proposer_boost_root,
+                                     node.slot)
+        if bytes(ancestor.root) != bytes(node.root):
+            return uint64(0)
+        proposer_boost_slot = \
+            store.blocks[store.proposer_boost_root].slot
+        if node.slot > proposer_boost_slot:
+            return uint64(0)   # not applied after skipped slots
+        if (node.slot < proposer_boost_slot
+                and ancestor.is_payload_present
+                != node.is_payload_present):
+            return uint64(0)
+        return self._committee_boost(state,
+                                     self.PROPOSER_SCORE_BOOST_PCT)
+
+    def compute_withhold_boost(self, store, state,
+                               node: ChildNode) -> int:
+        if store.payload_withhold_boost_root == Bytes32():
+            return uint64(0)
+        ancestor = self.get_ancestor(
+            store, store.payload_withhold_boost_root, node.slot)
+        if bytes(ancestor.root) != bytes(node.root):
+            return uint64(0)
+        if node.slot >= \
+                store.blocks[store.payload_withhold_boost_root].slot:
+            ancestor.is_payload_present = store.payload_withhold_boost_full
+        if ancestor.is_payload_present != node.is_payload_present:
+            return uint64(0)
+        return self._committee_boost(state,
+                                     self.PAYLOAD_WITHHOLD_BOOST_PCT)
+
+    def compute_reveal_boost(self, store, state, node: ChildNode) -> int:
+        if store.payload_reveal_boost_root == Bytes32():
+            return uint64(0)
+        ancestor = self.get_ancestor(
+            store, store.payload_reveal_boost_root, node.slot)
+        if bytes(ancestor.root) != bytes(node.root):
+            return uint64(0)
+        if node.slot >= store.blocks[store.payload_reveal_boost_root].slot:
+            ancestor.is_payload_present = True
+        if ancestor.is_payload_present != node.is_payload_present:
+            return uint64(0)
+        return self._committee_boost(state,
+                                     self.PAYLOAD_REVEAL_BOOST_PCT)
+
+    # ------------------------------------------------------------------
+    # weights & head
+    # ------------------------------------------------------------------
+    def get_weight(self, store, node: ChildNode) -> int:
+        state = store.checkpoint_states[store.justified_checkpoint]
+        unslashed_and_active = [
+            i for i in self.get_active_validator_indices(
+                state, self.get_current_epoch(state))
+            if not state.validators[i].slashed]
+        attestation_score = sum(
+            int(state.validators[i].effective_balance)
+            for i in unslashed_and_active
+            if (i in store.latest_messages
+                and i not in store.equivocating_indices
+                and self.is_supporting_vote(
+                    store, node, store.latest_messages[i])))
+        return uint64(attestation_score
+                      + self.compute_proposer_boost(store, state, node)
+                      + self.compute_reveal_boost(store, state, node)
+                      + self.compute_withhold_boost(store, state, node))
+
+    def _root_node(self, store, root) -> ChildNode:
+        """Adapt a bare block root to its ChildNode (the block at its
+        own slot with its PTC-voted payload status) — for the inherited
+        root-based proposer-reorg helpers (is_head_weak /
+        is_parent_strong), which predate (block, slot, bool) voting."""
+        block = store.blocks[root]
+        return ChildNode(
+            root=bytes(root), slot=int(block.slot),
+            is_payload_present=self.is_payload_present(store, root))
+
+    def is_head_weak(self, store, head_root) -> bool:
+        justified_state = store.checkpoint_states[
+            store.justified_checkpoint]
+        reorg_threshold = self.calculate_committee_fraction(
+            justified_state, self.config.REORG_HEAD_WEIGHT_THRESHOLD)
+        return self.get_weight(
+            store, self._root_node(store, head_root)) < reorg_threshold
+
+    def is_parent_strong(self, store, parent_root) -> bool:
+        justified_state = store.checkpoint_states[
+            store.justified_checkpoint]
+        parent_threshold = self.calculate_committee_fraction(
+            justified_state, self.config.REORG_PARENT_WEIGHT_THRESHOLD)
+        return self.get_weight(
+            store, self._root_node(store, parent_root)) > parent_threshold
+
+    def get_head(self, store) -> ChildNode:
+        blocks = self.get_filtered_block_tree(store)
+        justified_root = bytes(store.justified_checkpoint.root)
+        justified_block = store.blocks[justified_root]
+        best_child = ChildNode(
+            root=justified_root, slot=int(justified_block.slot),
+            is_payload_present=self.is_payload_present(store,
+                                                       justified_root))
+        while True:
+            children = [
+                ChildNode(root=bytes(root), slot=int(block.slot),
+                          is_payload_present=present)
+                for (root, block) in blocks.items()
+                if bytes(block.parent_root) == best_child.root
+                and block.slot > best_child.slot
+                and (best_child.root == justified_root
+                     or self.is_parent_node_full(store, block)
+                     == best_child.is_payload_present)
+                for present in (True, False)
+                if root in store.execution_payload_states or not present
+            ]
+            if len(children) == 0:
+                return best_child
+            highest_child_slot = max(c.slot for c in children)
+            children.append(ChildNode(
+                root=best_child.root, slot=best_child.slot + 1,
+                is_payload_present=best_child.is_payload_present))
+            new_best_child = max(children, key=lambda child: (
+                int(self.get_weight(store, child)),
+                int(blocks[child.root].slot),
+                self.is_payload_present(store, child.root),
+                child.is_payload_present,
+                child.root))
+            if new_best_child.root == best_child.root and \
+                    new_best_child.slot >= highest_child_slot:
+                return new_best_child
+            best_child = new_best_child
+
+    # ------------------------------------------------------------------
+    # handlers
+    # ------------------------------------------------------------------
+    def on_block(self, store, signed_block) -> None:
+        block = signed_block.message
+        assert block.parent_root in store.block_states
+
+        parent_block = store.blocks[block.parent_root]
+        header = block.body.signed_execution_payload_header.message
+        parent_header = \
+            parent_block.body.signed_execution_payload_header.message
+        if self.is_parent_node_full(store, block):
+            assert block.parent_root in store.execution_payload_states
+            state = store.execution_payload_states[
+                block.parent_root].copy()
+        else:
+            assert bytes(header.parent_block_hash) == \
+                bytes(parent_header.parent_block_hash)
+            state = store.block_states[block.parent_root].copy()
+
+        current_slot = self.get_current_slot(store)
+        assert current_slot >= block.slot
+        finalized_slot = self.compute_start_slot_at_epoch(
+            store.finalized_checkpoint.epoch)
+        assert block.slot > finalized_slot
+        finalized_checkpoint_block = self.get_checkpoint_block(
+            store, block.parent_root, store.finalized_checkpoint.epoch)
+        assert bytes(store.finalized_checkpoint.root) == \
+            bytes(finalized_checkpoint_block)
+
+        block_root = hash_tree_root(block)
+        self.state_transition(state, signed_block, True)
+
+        store.blocks[block_root] = block
+        store.block_states[block_root] = state
+        store.ptc_vote[block_root] = \
+            [self.PAYLOAD_ABSENT] * int(self.PTC_SIZE)
+
+        self.notify_ptc_messages(store, state,
+                                 block.body.payload_attestations)
+
+        time_into_slot = (store.time - store.genesis_time) \
+            % self.config.SECONDS_PER_SLOT
+        is_before_attesting_interval = time_into_slot < \
+            self.config.SECONDS_PER_SLOT // self.INTERVALS_PER_SLOT
+        is_timely = self.get_current_slot(store) == block.slot \
+            and is_before_attesting_interval
+        store.block_timeliness[block_root] = is_timely
+        if is_timely and store.proposer_boost_root == Bytes32():
+            store.proposer_boost_root = block_root
+
+        self.update_checkpoints(store, state.current_justified_checkpoint,
+                                state.finalized_checkpoint)
+        self.compute_pulled_up_tip(store, block_root)
+
+    def on_execution_payload(self, store, signed_envelope) -> None:
+        """New handler: a revealed SignedExecutionPayloadEnvelope
+        produces the block's FULL state (fork-choice.md:450-476)."""
+        envelope = signed_envelope.message
+        assert envelope.beacon_block_root in store.block_states
+        assert self.is_data_available(envelope.beacon_block_root,
+                                      envelope.blob_kzg_commitments)
+        state = store.block_states[envelope.beacon_block_root].copy()
+        self.process_execution_payload(state, signed_envelope,
+                                       self.EXECUTION_ENGINE)
+        store.execution_payload_states[envelope.beacon_block_root] = state
+
+    def seconds_into_slot(self, store) -> int:
+        return (store.time - store.genesis_time) \
+            % self.config.SECONDS_PER_SLOT
+
+    def on_tick_per_slot(self, store, time) -> None:
+        previous_slot = self.get_current_slot(store)
+        store.time = int(time)
+        current_slot = self.get_current_slot(store)
+        if current_slot > previous_slot:
+            store.proposer_boost_root = Bytes32()
+        elif self.seconds_into_slot(store) >= \
+                self.config.SECONDS_PER_SLOT // self.INTERVALS_PER_SLOT:
+            # attestation time: reset the payload boosts
+            store.payload_withhold_boost_root = Bytes32()
+            store.payload_withhold_boost_full = False
+            store.payload_reveal_boost_root = Bytes32()
+        if current_slot > previous_slot and \
+                self.compute_slots_since_epoch_start(current_slot) == 0:
+            self.update_checkpoints(
+                store, store.unrealized_justified_checkpoint,
+                store.unrealized_finalized_checkpoint)
+
+    def on_payload_attestation_message(self, store, ptc_message,
+                                       is_from_block: bool = False) -> None:
+        data = ptc_message.data
+        state = store.block_states[data.beacon_block_root]
+        ptc = self.get_ptc(state, data.slot)
+        if data.slot != state.slot:
+            return
+        assert ptc_message.validator_index in ptc
+
+        if not is_from_block:
+            assert data.slot == self.get_current_slot(store)
+            assert self.is_valid_indexed_payload_attestation(
+                state,
+                self.IndexedPayloadAttestation(
+                    attesting_indices=[ptc_message.validator_index],
+                    data=data,
+                    signature=ptc_message.signature))
+
+        ptc_index = list(ptc).index(ptc_message.validator_index)
+        ptc_vote = store.ptc_vote[data.beacon_block_root]
+        ptc_vote[ptc_index] = data.payload_status
+
+        if is_from_block and int(data.slot) + 1 != \
+                int(self.get_current_slot(store)):
+            return
+        time_into_slot = (store.time - store.genesis_time) \
+            % self.config.SECONDS_PER_SLOT
+        if is_from_block and time_into_slot >= \
+                self.config.SECONDS_PER_SLOT // self.INTERVALS_PER_SLOT:
+            return
+
+        if ptc_vote.count(self.PAYLOAD_PRESENT) > \
+                self.PAYLOAD_TIMELY_THRESHOLD:
+            store.payload_reveal_boost_root = bytes(
+                data.beacon_block_root)
+        if ptc_vote.count(self.PAYLOAD_WITHHELD) > \
+                self.PAYLOAD_TIMELY_THRESHOLD:
+            block = store.blocks[data.beacon_block_root]
+            store.payload_withhold_boost_root = bytes(block.parent_root)
+            store.payload_withhold_boost_full = \
+                self.is_parent_node_full(store, block)

@@ -1,0 +1,210 @@
+"""EIP-7732 (ePBS) fork-choice tests: (block, slot, payload-present)
+voting, PTC vote tracking, payload boosts, on_execution_payload
+(reference specs/_features/eip7732/fork-choice.md)."""
+import pytest
+
+from consensus_specs_tpu.specs import get_spec
+from consensus_specs_tpu.specs.eip7732_fork_choice import ChildNode
+from consensus_specs_tpu.ssz import hash_tree_root, uint64
+from consensus_specs_tpu.test_infra import disable_bls
+from consensus_specs_tpu.test_infra.genesis import (
+    create_genesis_state, default_balances)
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_spec("eip7732", "minimal")
+
+
+def _anchor(spec):
+    """Genesis anchor whose block root equals the state's latest block
+    header root and whose bid agrees with latest_block_hash, so
+    descendants classify the anchor as a FULL node."""
+    state = create_genesis_state(spec, default_balances(spec))
+    body = spec.BeaconBlockBody(
+        signed_execution_payload_header=(
+            spec.SignedExecutionPayloadHeader(
+                message=spec.ExecutionPayloadHeader(
+                    block_hash=state.latest_block_hash))))
+    state.latest_block_header.body_root = hash_tree_root(body)
+    block = spec.BeaconBlock(
+        slot=state.latest_block_header.slot,
+        proposer_index=state.latest_block_header.proposer_index,
+        parent_root=state.latest_block_header.parent_root,
+        state_root=hash_tree_root(state),
+        body=body)
+    return state, block
+
+
+def _bid_block(spec, state, block_hash=b"\x0b" * 32, value=0):
+    """A consensus block carrying a builder bid at the next slot."""
+    slot = int(state.slot) + 1
+    spec.process_slots(state, uint64(slot))
+    bid = spec.ExecutionPayloadHeader(
+        parent_block_hash=state.latest_block_hash,
+        parent_block_root=hash_tree_root(state.latest_block_header),
+        block_hash=block_hash,
+        gas_limit=30_000_000,
+        builder_index=1,
+        slot=slot,
+        value=value,
+        blob_kzg_commitments_root=hash_tree_root(
+            spec.ExecutionPayloadEnvelope.fields()[
+                "blob_kzg_commitments"]()))
+    block = spec.BeaconBlock(
+        slot=uint64(slot),
+        proposer_index=spec.get_beacon_proposer_index(state),
+        parent_root=hash_tree_root(state.latest_block_header),
+        body=spec.BeaconBlockBody(
+            signed_execution_payload_header=(
+                spec.SignedExecutionPayloadHeader(message=bid))))
+    post = state.copy()
+    spec.process_block(post, block)
+    block.state_root = hash_tree_root(post)
+    return block, post
+
+
+def _tick_to(spec, store, slot):
+    spec.on_tick(store, int(store.genesis_time)
+                 + int(slot) * int(spec.config.SECONDS_PER_SLOT))
+
+
+def test_store_tracks_payload_state(spec):
+    with disable_bls():
+        state, anchor = _anchor(spec)
+        store = spec.get_forkchoice_store(state, anchor)
+        root = hash_tree_root(anchor)
+        assert root in store.execution_payload_states
+        assert root in store.ptc_vote
+        assert len(store.ptc_vote[root]) == int(spec.PTC_SIZE)
+        assert not spec.is_payload_present(store, root)
+
+
+def test_on_block_empty_parent_and_ptc_votes(spec):
+    with disable_bls():
+        state, anchor = _anchor(spec)
+        store = spec.get_forkchoice_store(state, anchor)
+        block, _post = _bid_block(spec, state)
+        _tick_to(spec, store, block.slot)
+        signed = spec.SignedBeaconBlock(message=block)
+        spec.on_block(store, signed)
+        root = hash_tree_root(block)
+        assert root in store.blocks
+        assert store.ptc_vote[root] == \
+            [spec.PAYLOAD_ABSENT] * int(spec.PTC_SIZE)
+        # head: the new block, empty (no payload revealed)
+        head = spec.get_head(store)
+        assert isinstance(head, ChildNode)
+        assert head.root == bytes(root)
+        assert head.is_payload_present is False
+
+
+def test_on_execution_payload_creates_full_state(spec):
+    with disable_bls():
+        state, anchor = _anchor(spec)
+        store = spec.get_forkchoice_store(state, anchor)
+        block, post = _bid_block(spec, state)
+        _tick_to(spec, store, block.slot)
+        spec.on_block(store, spec.SignedBeaconBlock(message=block))
+        root = hash_tree_root(block)
+
+        payload = spec.ExecutionPayload(
+            parent_hash=post.latest_block_hash,
+            block_hash=b"\x0b" * 32,
+            gas_limit=30_000_000,
+            prev_randao=spec.get_randao_mix(
+                post, spec.get_current_epoch(post)),
+            timestamp=spec.compute_timestamp_at_slot(post, post.slot))
+        envelope = spec.ExecutionPayloadEnvelope(
+            payload=payload, builder_index=1,
+            beacon_block_root=root, payload_withheld=False)
+        probe = store.block_states[root].copy()
+        spec.process_execution_payload(
+            probe, spec.SignedExecutionPayloadEnvelope(message=envelope),
+            verify=False)
+        envelope.state_root = hash_tree_root(probe)
+        spec.on_execution_payload(
+            store, spec.SignedExecutionPayloadEnvelope(message=envelope))
+        assert root in store.execution_payload_states
+        assert int(store.execution_payload_states[root].latest_full_slot) \
+            == int(block.slot)
+
+
+def test_payload_attestation_sets_reveal_boost(spec):
+    with disable_bls():
+        state, anchor = _anchor(spec)
+        store = spec.get_forkchoice_store(state, anchor)
+        block, post = _bid_block(spec, state)
+        _tick_to(spec, store, block.slot)
+        spec.on_block(store, spec.SignedBeaconBlock(message=block))
+        root = hash_tree_root(block)
+        # tick into the NEXT slot but before the attesting interval so
+        # from-block PTC messages still update the boosts
+        spec.on_tick(store, int(store.genesis_time)
+                     + (int(block.slot) + 1)
+                     * int(spec.config.SECONDS_PER_SLOT))
+
+        block_state = store.block_states[root]
+        ptc = spec.get_ptc(block_state, block_state.slot)
+        for validator_index in ptc:
+            spec.on_payload_attestation_message(
+                store,
+                spec.PayloadAttestationMessage(
+                    validator_index=validator_index,
+                    data=spec.PayloadAttestationData(
+                        beacon_block_root=root,
+                        slot=block_state.slot,
+                        payload_status=spec.PAYLOAD_PRESENT),
+                    signature=b"\x00" * 96),
+                is_from_block=True)
+        assert spec.is_payload_present(store, root)
+        assert store.payload_reveal_boost_root == bytes(root)
+        # with the payload voted present, the FULL node wins the head
+        head = spec.get_head(store)
+        assert head.root == bytes(root)
+
+
+def test_withheld_votes_set_withhold_boost(spec):
+    with disable_bls():
+        state, anchor = _anchor(spec)
+        anchor_root = hash_tree_root(anchor)
+        store = spec.get_forkchoice_store(state, anchor)
+        block, post = _bid_block(spec, state)
+        _tick_to(spec, store, block.slot)
+        spec.on_block(store, spec.SignedBeaconBlock(message=block))
+        root = hash_tree_root(block)
+        spec.on_tick(store, int(store.genesis_time)
+                     + (int(block.slot) + 1)
+                     * int(spec.config.SECONDS_PER_SLOT))
+        block_state = store.block_states[root]
+        ptc = spec.get_ptc(block_state, block_state.slot)
+        for validator_index in ptc:
+            spec.on_payload_attestation_message(
+                store,
+                spec.PayloadAttestationMessage(
+                    validator_index=validator_index,
+                    data=spec.PayloadAttestationData(
+                        beacon_block_root=root,
+                        slot=block_state.slot,
+                        payload_status=spec.PAYLOAD_WITHHELD),
+                    signature=b"\x00" * 96),
+                is_from_block=True)
+        # withhold boost points at the PARENT with its fullness status
+        assert store.payload_withhold_boost_root == bytes(anchor_root)
+        assert not spec.is_payload_present(store, root)
+
+
+def test_reorg_helpers_accept_roots(spec):
+    """The inherited proposer-reorg helpers take bare roots; on the
+    ePBS store they must adapt to ChildNode weighting instead of
+    crashing (regression: get_weight(root) raised AttributeError)."""
+    with disable_bls():
+        state, anchor = _anchor(spec)
+        store = spec.get_forkchoice_store(state, anchor)
+        block, _post = _bid_block(spec, state)
+        _tick_to(spec, store, block.slot)
+        spec.on_block(store, spec.SignedBeaconBlock(message=block))
+        root = hash_tree_root(block)
+        assert spec.is_head_weak(store, root) in (True, False)
+        assert spec.is_parent_strong(store, block.parent_root) \
+            in (True, False)
