@@ -474,3 +474,62 @@ class Eip7732Spec(Eip7732ForkChoice, ElectraSpec):
     def genesis_fork_versions(self):
         return (Bytes4(self.config.ELECTRA_FORK_VERSION),
                 Bytes4(self.config.EIP7732_FORK_VERSION))
+
+    def upgrade_from(self, pre):
+        """upgrade_to_eip7732 (eip7732/fork.md:74-135): electra state
+        carried over; the payload header resets to the empty BID header
+        and the ePBS trackers seed from the pre-fork payload."""
+        epoch = self.get_current_epoch(pre)
+        post = self.BeaconState(
+            genesis_time=pre.genesis_time,
+            genesis_validators_root=pre.genesis_validators_root,
+            slot=pre.slot,
+            fork=self.Fork(
+                previous_version=pre.fork.current_version,
+                current_version=Bytes4(self.config.EIP7732_FORK_VERSION),
+                epoch=epoch),
+            latest_block_header=pre.latest_block_header,
+            block_roots=list(pre.block_roots),
+            state_roots=list(pre.state_roots),
+            historical_roots=list(pre.historical_roots),
+            eth1_data=pre.eth1_data,
+            eth1_data_votes=list(pre.eth1_data_votes),
+            eth1_deposit_index=pre.eth1_deposit_index,
+            validators=list(pre.validators),
+            balances=list(pre.balances),
+            randao_mixes=list(pre.randao_mixes),
+            slashings=list(pre.slashings),
+            previous_epoch_participation=list(
+                pre.previous_epoch_participation),
+            current_epoch_participation=list(
+                pre.current_epoch_participation),
+            justification_bits=list(pre.justification_bits),
+            previous_justified_checkpoint=pre.previous_justified_checkpoint,
+            current_justified_checkpoint=pre.current_justified_checkpoint,
+            finalized_checkpoint=pre.finalized_checkpoint,
+            inactivity_scores=list(pre.inactivity_scores),
+            current_sync_committee=pre.current_sync_committee,
+            next_sync_committee=pre.next_sync_committee,
+            # [Modified] empty bid header; ePBS trackers seed from the
+            # pre-fork payload
+            latest_execution_payload_header=self.ExecutionPayloadHeader(),
+            next_withdrawal_index=pre.next_withdrawal_index,
+            next_withdrawal_validator_index=(
+                pre.next_withdrawal_validator_index),
+            historical_summaries=list(pre.historical_summaries),
+            deposit_requests_start_index=pre.deposit_requests_start_index,
+            deposit_balance_to_consume=pre.deposit_balance_to_consume,
+            exit_balance_to_consume=pre.exit_balance_to_consume,
+            earliest_exit_epoch=pre.earliest_exit_epoch,
+            consolidation_balance_to_consume=(
+                pre.consolidation_balance_to_consume),
+            earliest_consolidation_epoch=pre.earliest_consolidation_epoch,
+            pending_deposits=list(pre.pending_deposits),
+            pending_partial_withdrawals=list(
+                pre.pending_partial_withdrawals),
+            pending_consolidations=list(pre.pending_consolidations),
+            latest_block_hash=(
+                pre.latest_execution_payload_header.block_hash),
+            latest_full_slot=pre.slot,
+        )
+        return post

@@ -240,6 +240,9 @@ class OptimisticSync:
                 )
                 opt_store._pruned_cache = (key, pruned)
             head = self.get_head(pruned)
+        # eip7732's fork choice returns a (root, slot, payload) node;
+        # unwrap to the root every other consumer expects
+        head = getattr(head, "root", head)
         opt_store.head_block_root = bytes(head)
         return head
 

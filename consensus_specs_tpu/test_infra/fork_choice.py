@@ -92,6 +92,8 @@ def add_attestation(spec, store, attestation, steps, valid=True):
 def output_store_checks(spec, store, steps) -> None:
     """Record the observable store state (format README 'checks' step)."""
     head = spec.get_head(store)
+    # eip7732 returns a ChildNode; the on-disk checks use the root
+    head = getattr(head, "root", head)
     steps.append({"checks": {
         "time": int(store.time),
         "head": {"slot": int(store.blocks[head].slot),

@@ -208,3 +208,15 @@ def test_reorg_helpers_accept_roots(spec):
         assert spec.is_head_weak(store, root) in (True, False)
         assert spec.is_parent_strong(store, block.parent_root) \
             in (True, False)
+
+
+def test_optimistic_head_unwraps_child_node(spec):
+    """get_optimistic_head must hand back a ROOT on the ePBS store
+    (regression: bytes(ChildNode) raised TypeError)."""
+    with disable_bls():
+        state, anchor = _anchor(spec)
+        store = spec.get_forkchoice_store(state, anchor)
+        opt_store = spec.get_optimistic_store(
+            state, anchor)
+        head = spec.get_optimistic_head(opt_store, store)
+        assert bytes(head) == bytes(hash_tree_root(anchor))

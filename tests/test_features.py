@@ -378,3 +378,42 @@ def test_eip6800_genesis_fork_version(vspec):
         state = create_genesis_state(vspec, default_balances(vspec))
     assert bytes(state.fork.current_version) == \
         bytes(vspec.EIP6800_FORK_VERSION)
+
+
+def test_whisk_upgrade_from_capella():
+    """upgrade_to_whisk: trackers/commitments seeded for every
+    validator, proposer + candidate trackers selected."""
+    wspec = get_spec("whisk", "minimal")
+    cspec = get_spec("capella", "minimal")
+    with disable_bls():
+        pre = create_genesis_state(cspec, default_balances(cspec))
+        post = wspec.upgrade_from(pre)
+    n = len(pre.validators)
+    assert len(post.validators) == n
+    assert len(post.whisk_trackers) == n
+    assert len(post.whisk_k_commitments) == n
+    assert bytes(post.fork.current_version) == \
+        bytes.fromhex(wspec.config.WHISK_FORK_VERSION[2:])
+    # selections ran: proposer trackers no longer all-default
+    assert any(bytes(t.r_G) != b"\x00" * 48
+               for t in post.whisk_proposer_trackers)
+    # each tracker matches its k commitment relation at index 0
+    k0 = wspec.get_initial_whisk_k(0, 0)
+    assert bytes(post.whisk_k_commitments[0]) == \
+        bytes(wspec.get_k_commitment(k0))
+
+
+def test_eip7732_upgrade_from_electra(pspec):
+    espec = get_spec("electra", "minimal")
+    with disable_bls():
+        pre = create_genesis_state(espec, default_balances(espec))
+        post = pspec.upgrade_from(pre)
+    assert bytes(post.fork.current_version) == \
+        bytes.fromhex(pspec.config.EIP7732_FORK_VERSION[2:])
+    # bid header resets; trackers seed from the pre-fork payload
+    assert post.latest_execution_payload_header == \
+        pspec.ExecutionPayloadHeader()
+    assert bytes(post.latest_block_hash) == \
+        bytes(pre.latest_execution_payload_header.block_hash)
+    assert int(post.latest_full_slot) == int(pre.slot)
+    assert len(post.validators) == len(pre.validators)
