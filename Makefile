@@ -9,8 +9,13 @@ RUNNERS := shuffling ssz_static operations epoch_processing sanity bls \
 	kzg rewards finality genesis fork_choice transition ssz_generic \
 	forks merkle_proof networking kzg_7594 random light_client sync
 
-.PHONY: test test-quick test-kernels native pyspec bench gen_all \
+.PHONY: test test-quick test-kernels lint native pyspec bench gen_all \
 	detect_errors $(addprefix gen_,$(RUNNERS))
+
+# syntax/bytecode check over every package and script (the CI lint job)
+lint:
+	$(PYTHON) -m compileall -q consensus_specs_tpu tests scripts \
+		deposit_contract bench.py __graft_entry__.py
 
 # default suite: the multi-minute XLA limb-kernel compile suites are
 # skipped by conftest (KERNEL_TIER_FILES) so this finishes in a CI

@@ -10,10 +10,11 @@ from ...test_infra.context import (
     always_bls, _genesis_state,
     default_balances, default_activation_threshold)
 
-# the PYTEST run covers the three LC header/proof shape variants
-# (pre-capella, capella header, electra gindices); the generator
-# still emits sync vectors for every altair+ fork
-LC_FORKS = ["altair", "capella", "electra"]
+# the PYTEST run covers the pre-capella and electra-gindex shape
+# variants (the capella execution-header variant is exercised by
+# tests/test_light_client.py); the generator still emits sync
+# vectors for every altair+ fork
+LC_FORKS = ["altair", "electra"]
 from ...test_infra.light_client_sync import (
     LightClientSyncTest, build_chain, make_update)
 

@@ -5,10 +5,10 @@ from ...test_infra.context import (
     spec_state_test, with_all_phases_from, with_pytest_fork_subset,
     always_bls)
 
-# real-signature suite: the default PYTEST run covers three
+# real-signature suite: the default PYTEST run covers two
 # representative forks (32 committee signatures per target); the
 # generator still emits vectors for every altair+ fork
-SYNC_FORKS = ["altair", "deneb", "electra"]
+SYNC_FORKS = ["altair", "electra"]
 from ...test_infra.blocks import (
     build_empty_block_for_next_slot, next_slot, transition_to)
 from ...test_infra.sync_committee import (

@@ -22,7 +22,7 @@ def _run(spec, state, seed, slots=8):
 
 
 @with_all_phases
-@with_pytest_fork_subset(["phase0", "altair", "deneb"])  # signed tier
+@with_pytest_fork_subset(["phase0", "deneb"])  # signed tier
 @spec_state_test
 def test_random_scenario_0(spec, state):
     yield from _run(spec, state, seed=0)

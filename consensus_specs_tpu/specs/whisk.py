@@ -280,7 +280,6 @@ class WhiskSpec(CapellaSpec):
         keeping full-length balances/participation — an apparent
         oversight in the TBD-status draft; we carry the registry over.
         """
-        from ..ssz import uint64
         epoch = self.get_current_epoch(pre)
         ks = [self.get_initial_whisk_k(i, 0)
               for i in range(len(pre.validators))]

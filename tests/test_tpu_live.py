@@ -46,7 +46,7 @@ def _device_available() -> bool:
         probe = _run_on_device(
             "import jax; jax.block_until_ready("
             "jax.numpy.zeros(8).sum()); print('OK', "
-            "jax.default_backend())", timeout=90)
+            "jax.default_backend())", timeout=60)
     except subprocess.TimeoutExpired:
         return False
     if probe.returncode != 0 or "OK" not in probe.stdout:
