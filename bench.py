@@ -391,7 +391,9 @@ def bench_attestations():
 TIERS = {
     "merkle": (bench_merkle, 150),
     "epoch": (bench_epoch, 300),
-    "transition": (bench_transition, 300),
+    # state build (~80s) + full-state merkleization/slot + scaled scalar
+    # baseline: needs more headroom than the epoch tier
+    "transition": (bench_transition, 350),
     "attestations": (bench_attestations, 420),
     "kzg": (bench_kzg, 300),
 }

@@ -69,14 +69,61 @@ def providers():
                            "commitment": _hex(commitment),
                            "proof": _hex(blob_proof)},
                  "output": True})
-        # one negative: proof for the wrong blob
+        # negatives: wrong blob, wrong evaluation point, corrupt inputs
         blob_a, blob_b = _blob(0), _blob(1)
+        commitment_a = kzg.blob_to_kzg_commitment(blob_a)
         commitment_b = kzg.blob_to_kzg_commitment(blob_b)
-        proof_a = kzg.compute_blob_kzg_proof(
-            blob_a, kzg.blob_to_kzg_commitment(blob_a))
+        proof_a = kzg.compute_blob_kzg_proof(blob_a, commitment_a)
         yield _yaml_case(
             "verify_blob_kzg_proof", "blob_verify_wrong_blob",
             {"input": {"blob": _hex(blob_b), "commitment": _hex(commitment_b),
                        "proof": _hex(proof_a)},
+             "output": False})
+
+        z = bls_field_to_bytes(4096)
+        proof, y = kzg.compute_kzg_proof(blob_a, z)
+        wrong_y = bls_field_to_bytes(
+            (int.from_bytes(bytes(y), "big") + 1))
+        yield _yaml_case(
+            "verify_kzg_proof", "verify_wrong_y",
+            {"input": {"commitment": _hex(commitment_a), "z": _hex(z),
+                       "y": _hex(wrong_y), "proof": _hex(proof)},
+             "output": False})
+        # invalid (non-canonical) field element z: top bytes all 0xff
+        bad_z = b"\xff" * 32
+        try:
+            kzg.compute_kzg_proof(blob_a, bad_z)
+        except (AssertionError, ValueError):
+            pass
+        else:
+            raise RuntimeError("non-canonical z accepted")
+        yield _yaml_case(
+            "compute_kzg_proof", "proof_invalid_z",
+            {"input": {"blob": _hex(blob_a), "z": _hex(bad_z)},
+             "output": None})
+        # corrupt commitment (not on curve / wrong flag bits)
+        bad_commitment = b"\x12" + bytes(commitment_a)[1:]
+        yield _yaml_case(
+            "verify_blob_kzg_proof", "blob_verify_bad_commitment",
+            {"input": {"blob": _hex(blob_a),
+                       "commitment": _hex(bad_commitment),
+                       "proof": _hex(proof_a)},
+             "output": None})
+
+        # batch verify: valid pair + order sensitivity
+        proof_b = kzg.compute_blob_kzg_proof(blob_b, commitment_b)
+        yield _yaml_case(
+            "verify_blob_kzg_proof_batch", "batch_valid",
+            {"input": {"blobs": [_hex(blob_a), _hex(blob_b)],
+                       "commitments": [_hex(commitment_a),
+                                       _hex(commitment_b)],
+                       "proofs": [_hex(proof_a), _hex(proof_b)]},
+             "output": True})
+        yield _yaml_case(
+            "verify_blob_kzg_proof_batch", "batch_swapped_proofs",
+            {"input": {"blobs": [_hex(blob_a), _hex(blob_b)],
+                       "commitments": [_hex(commitment_a),
+                                       _hex(commitment_b)],
+                       "proofs": [_hex(proof_b), _hex(proof_a)]},
              "output": False})
     return [TestProvider(make_cases=make_cases)]

@@ -2,7 +2,7 @@
 tests/formats/genesis/initialization: eth1.yaml + deposits + state)."""
 from ...ssz import uint64
 from ...test_infra.context import (
-    spec_test, with_phases, never_bls)
+    spec_test, with_all_phases_from, never_bls)
 from ...test_infra.deposits import build_deposit
 from ...test_infra.keys import privkeys, pubkeys
 
@@ -21,9 +21,11 @@ def _genesis_deposits(spec, count, amount):
     return deposits, root
 
 
-# genesis vectors are phase0-only in the reference; later forks initialize
-# via upgrade functions
-@with_phases(["phase0"])
+# pre-electra forks share the eth1-style initializer (per-fork genesis
+# versions via genesis_fork_versions()); electra+ routes deposits
+# through the pending-deposit queue — balances land at epoch
+# processing — so plain initialization cannot reach a valid genesis
+@with_all_phases_from("phase0", to="deneb")
 @spec_test
 @never_bls
 def test_initialize_beacon_state_from_eth1(spec):

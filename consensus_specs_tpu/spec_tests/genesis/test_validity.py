@@ -1,10 +1,10 @@
 """is_valid_genesis_state tests (vector format
 tests/formats/genesis/validity: genesis.ssz_snappy + is_valid.yaml)."""
 from ...test_infra.context import (
-    spec_state_test, with_phases, never_bls)
+    spec_state_test, with_all_phases, never_bls)
 
 
-@with_phases(["phase0"])
+@with_all_phases
 @spec_state_test
 @never_bls
 def test_full_genesis_is_valid(spec, state):
@@ -14,7 +14,7 @@ def test_full_genesis_is_valid(spec, state):
     assert valid
 
 
-@with_phases(["phase0"])
+@with_all_phases
 @spec_state_test
 @never_bls
 def test_early_genesis_time_invalid(spec, state):

@@ -585,9 +585,14 @@ class Phase0Spec(Phase0ForkChoice, Phase0ValidatorDuties, BaseSpec):
     # ------------------------------------------------------------------
     def initialize_beacon_state_from_eth1(self, eth1_block_hash,
                                           eth1_timestamp, deposits):
+        # per-fork genesis versions: each fork's builder in the
+        # reference rewrites this initializer with its own version pair
+        # (pysetup/spec_builders); here the overridable
+        # genesis_fork_versions() carries that role
+        previous_version, current_version = self.genesis_fork_versions()
         fork = self.Fork(
-            previous_version=Bytes4(self.config.GENESIS_FORK_VERSION),
-            current_version=Bytes4(self.config.GENESIS_FORK_VERSION),
+            previous_version=previous_version,
+            current_version=current_version,
             epoch=self.GENESIS_EPOCH)
         state = self.BeaconState(
             genesis_time=uint64(eth1_timestamp + self.config.GENESIS_DELAY),
