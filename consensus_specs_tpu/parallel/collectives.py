@@ -1,6 +1,6 @@
 """Sharded reductions over the device mesh.
 
-Three reduction shapes cover the framework's hot paths (SURVEY.md §2.6):
+The reduction shapes covering the framework's hot paths (SURVEY.md §2.6):
 
 * sharded_balance_total — the epoch-processing scalar reduction
   (get_total_active_balance and friends): local sum + psum.
@@ -91,6 +91,42 @@ def make_g1_sum(mesh: Mesh):
         sharded_g1_sum, mesh=mesh,
         in_specs=(P(AXIS, None),) * 3, out_specs=(P(),) * 3,
         check_vma=False))
+
+
+def sharded_g1_ring_sum(X, Y, Z):
+    """Body: RING reduction of per-device partial sums over ICI.
+
+    Each device tree-sums its local shard, then the partials travel the
+    ring with lax.ppermute: after n_dev-1 hops every device has added
+    every partial, with each hop moving only one point (3x32 limb
+    words) over a single neighbor link — the bandwidth shape of a ring
+    all-reduce, vs all_gather's n_dev-wide fan-in.  This is the "ring
+    all-gather of per-chip partial MSM buckets" pattern of SURVEY §2.6;
+    big MSMs shard their buckets exactly like this.
+    """
+    n_dev = jax.lax.axis_size(AXIS)
+    local = cj.point_sum_tree(cj.F1, (X, Y, Z))   # local partial
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    def hop(_i, carry):
+        acc, incoming = carry
+        incoming = tuple(
+            jax.lax.ppermute(c, AXIS, perm) for c in incoming)
+        return cj.point_add(cj.F1, acc, incoming), incoming
+
+    # fori_loop keeps ONE hop body in the graph (an unrolled ring
+    # compiles n_dev-1 point-adds inline — minutes of XLA on small
+    # hosts)
+    acc, _ = jax.lax.fori_loop(0, n_dev - 1, hop, (local, local))
+    # [1, 32] per device -> callers see [n_dev, 32] rows, all equal
+    return tuple(c[None] for c in acc)
+
+
+def make_g1_ring_sum(mesh: Mesh):
+    return jax.jit(jax.shard_map(
+        sharded_g1_ring_sum, mesh=mesh,
+        in_specs=(P(AXIS, None),) * 3,
+        out_specs=(P(AXIS, None),) * 3, check_vma=False))
 
 
 # ---------------------------------------------------------------------------

@@ -85,3 +85,20 @@ def test_sharded_flag_deltas_matches_numpy(mesh):
                       eff.astype(np.int64) * 7 * 14 // 64, 0)
     assert (np.asarray(rewards) == want_r).all()
     assert (np.asarray(penalties) == want_p).all()
+
+
+def test_sharded_g1_ring_sum_matches_oracle(mesh):
+    """Ring (ppermute) reduction of per-device G1 partials: every
+    device ends with the full sum, equal to the oracle."""
+    from consensus_specs_tpu.parallel.collectives import make_g1_ring_sum
+    pts = [cv.g1_generator() * (i + 1) for i in range(16)]
+    X, Y, Z = cj.g1_pack(pts)
+    fn = make_g1_ring_sum(mesh)
+    gx, gy, gz = fn(shard_array(mesh, np.asarray(X)),
+                    shard_array(mesh, np.asarray(Y)),
+                    shard_array(mesh, np.asarray(Z)))
+    rows = cj.g1_unpack((np.asarray(gx), np.asarray(gy), np.asarray(gz)))
+    want = cv.g1_infinity()
+    for p in pts:
+        want = want + p
+    assert all(r == want for r in rows)       # replicated across the ring
