@@ -13,7 +13,10 @@ from ...ssz import hash_tree_root
 from ...ssz.types import Container
 
 FORKS = ["phase0", "altair", "bellatrix", "capella", "deneb", "electra",
-         "fulu"]
+         "fulu",
+         # feature forks: their new containers (trackers, bids,
+         # envelopes, witnesses) need static vectors too
+         "whisk", "eip7732", "eip6800"]
 MODES = [RandomizationMode.RANDOM, RandomizationMode.ZERO,
          RandomizationMode.MAX, RandomizationMode.ONE_COUNT]
 

@@ -29,7 +29,8 @@ def spec_container_types(spec):
 
 
 @pytest.mark.parametrize("fork", ["phase0", "altair", "bellatrix", "capella",
-                                  "deneb", "electra", "fulu"])
+                                  "deneb", "electra", "fulu",
+                                  "whisk", "eip7732", "eip6800"])
 @pytest.mark.parametrize("mode", [RandomizationMode.RANDOM,
                                   RandomizationMode.ZERO,
                                   RandomizationMode.MAX,
