@@ -37,7 +37,8 @@ native:
 # emit executable spec modules from the reference markdown
 pyspec:
 	$(PYTHON) scripts/build_pyspec.py --out build/pyspec \
-		--forks phase0 altair bellatrix capella deneb electra fulu
+		--forks phase0 altair bellatrix capella deneb electra fulu \
+		whisk eip7732 eip6800
 
 bench:
 	$(PYTHON) bench.py

@@ -120,12 +120,16 @@ def dependency_order_classes(classes: dict) -> list:
 def emit_source(spec: ParsedSpec, preset: dict | None = None,
                 config: dict | None = None,
                 prelude: str = "",
-                extra_scalars: dict | None = None) -> str:
+                extra_scalars: dict | None = None,
+                class_subs: list | None = None) -> str:
     """Assemble the module source: header, types, constants, classes,
     prelude, functions, config.  `preset` overrides preset-var values
     (compile-time tier); `config` overrides config-var values (runtime
     tier); `prelude` is fork-injected code (engine stubs, trusted
-    setups — compiler/forks.py)."""
+    setups — compiler/forks.py); `class_subs` are (pattern, repl) regex
+    rewrites applied to CLASS BODIES only (e.g. eip6800's nullable
+    `Optional[X]` fields becoming SSZ `Union[None, X]` without touching
+    typing.Optional in function annotations)."""
     parts = [_HEADER]
 
     # names the prelude defines (e.g. the KZG trusted-setup vectors, whose
@@ -151,19 +155,30 @@ def emit_source(spec: ParsedSpec, preset: dict | None = None,
     for name, type_expr in spec.custom_types.items():
         scalars[name] = type_expr
     for name, expr in spec.constants.items():
-        if name not in prelude_names:
-            scalars[name] = _const_rhs(expr)
+        if name in prelude_names:
+            continue
+        if expr.strip().rstrip("*") in ("TBD", "N/A"):
+            # draft placeholder (e.g. whisk's CURDLEPROOFS_CRS) — a
+            # definition must come from extra_scalars or the prelude
+            continue
+        scalars[name] = _const_rhs(expr)
     for name, rhs in (extra_scalars or {}).items():
         scalars.setdefault(name, rhs)
 
     for name in _dependency_order(scalars):
         parts.append(f"{name} = {scalars[name]}")
 
-    for name in dependency_order_classes(spec.classes):
-        parts.append(spec.classes[name])
-
+    # preludes precede the class definitions: class-body annotations
+    # evaluate eagerly, so rebindings like eip6800's SSZ Optional must
+    # already be in scope when the containers build
     if prelude:
         parts.append(prelude.strip())
+
+    for name in dependency_order_classes(spec.classes):
+        src = spec.classes[name]
+        for pattern, repl in (class_subs or []):
+            src = re.sub(pattern, repl, src)
+        parts.append(src)
 
     # runtime-config tier: bare config-var references inside function
     # bodies are rewritten to `config.X` so tests can swap configurations
@@ -191,7 +206,8 @@ def build_spec(doc_texts: list, preset: dict | None = None,
                config: dict | None = None,
                module_name: str = "generated_spec",
                prelude: str = "",
-               extra_scalars: dict | None = None):
+               extra_scalars: dict | None = None,
+               class_subs: list | None = None):
     """Parse + merge fork markdown docs (oldest first) and exec the module.
 
     Returns (module, source).
@@ -199,7 +215,8 @@ def build_spec(doc_texts: list, preset: dict | None = None,
     merged = ParsedSpec()
     for text in doc_texts:
         merged = parse_markdown(text).merge_over(merged)
-    source = emit_source(merged, preset, config, prelude, extra_scalars)
+    source = emit_source(merged, preset, config, prelude,
+                         extra_scalars, class_subs)
     module = types.ModuleType(module_name)
     # dont_inherit: this builder's __future__ flags (stringified
     # annotations) must not leak into the generated module — SSZ field

@@ -13,6 +13,20 @@ import os
 FORK_CHAIN = ["phase0", "altair", "bellatrix", "capella", "deneb",
               "electra", "fulu"]
 
+# feature forks branch off the mainline (reference
+# pysetup/md_doc_paths.py:17-28 PREVIOUS_FORK_OF)
+PREVIOUS_FORK = {"whisk": "capella", "eip7732": "electra",
+                 "eip6800": "deneb"}
+FEATURE_DIRS = {f: os.path.join("_features", f) for f in PREVIOUS_FORK}
+
+
+def chain_of(fork: str) -> list:
+    """Doc-chain fork names oldest-first (mainline prefix + feature)."""
+    if fork in PREVIOUS_FORK:
+        base = PREVIOUS_FORK[fork]
+        return FORK_CHAIN[: FORK_CHAIN.index(base) + 1] + [fork]
+    return FORK_CHAIN[: FORK_CHAIN.index(fork) + 1]
+
 # docs contributed BY each fork (ancestors' docs are prepended)
 FORK_DOCS = {
     "phase0": ["beacon-chain.md"],
@@ -23,6 +37,9 @@ FORK_DOCS = {
     "electra": ["beacon-chain.md"],
     "fulu": ["polynomial-commitments-sampling.md", "das-core.md",
              "beacon-chain.md"],
+    "whisk": ["beacon-chain.md"],
+    "eip7732": ["beacon-chain.md"],
+    "eip6800": ["beacon-chain.md"],
 }
 
 # the bellatrix execution-engine protocol: the spec treats the EL as an
@@ -66,15 +83,61 @@ KZG_SETUP_G1_MONOMIAL, KZG_SETUP_G1_LAGRANGE, KZG_SETUP_G2_MONOMIAL = \\
     _load_kzg_trusted_setup()
 '''
 
+# whisk: the markdown calls the external curdleproofs verifiers
+# (whisk/beacon-chain.md:105-128); route them to our from-scratch proof
+# system behind the same interface
+_WHISK_PRELUDE = """
+class _Curdleproofs:
+    @staticmethod
+    def IsValidWhiskShuffleProof(crs, pre_trackers, post_trackers,
+                                 shuffle_proof):
+        from consensus_specs_tpu.crypto import whisk_proofs
+        return whisk_proofs.verify_shuffle(
+            [(bytes(t.r_G), bytes(t.k_r_G)) for t in pre_trackers],
+            [(bytes(t.r_G), bytes(t.k_r_G)) for t in post_trackers],
+            bytes(shuffle_proof))
+
+    @staticmethod
+    def IsValidWhiskOpeningProof(tracker, k_commitment, tracker_proof):
+        from consensus_specs_tpu.crypto import whisk_proofs
+        return whisk_proofs.verify_opening(
+            bytes(tracker.r_G), bytes(tracker.k_r_G),
+            bytes(k_commitment), bytes(tracker_proof))
+
+
+curdleproofs = _Curdleproofs()
+"""
+
 FORK_PRELUDES = {
     "bellatrix": _ENGINE_PRELUDE,
     "deneb": _KZG_PRELUDE,
+    "whisk": _WHISK_PRELUDE,
 }
+
+# class-body-only regex rewrites: eip6800 container fields use
+# Optional[X] for nullable values (eip6800/beacon-chain.md
+# SuffixStateDiff), which is SSZ Union[None, X]; scoping the rewrite to
+# class bodies leaves typing.Optional in function annotations intact
+FORK_CLASS_SUBS = {
+    "eip6800": [(r"\bOptional\[", "Union[None, ")],
+}
+
+
+def fork_class_subs(fork: str) -> list:
+    out: list = []
+    for f in chain_of(fork):
+        out.extend(FORK_CLASS_SUBS.get(f, []))
+    return out
 
 # constants a fork's class shapes need that live in docs outside its build
 # chain (e.g. fulu's inclusion-proof depth is "predefined" in
 # p2p-interface.md) — injected into the scalar-definition fixpoint
 FORK_SCALARS = {
+    "whisk": {
+        # "TBD" in the markdown constants table; our verifier carries
+        # its own parameters, the CRS slot just needs to exist
+        "CURDLEPROOFS_CRS": "None",
+    },
     "fulu": {
         # floorlog2(get_generalized_index(BeaconBlockBody,
         # 'blob_kzg_commitments')): predefined in fulu/p2p-interface.md
@@ -83,6 +146,27 @@ FORK_SCALARS = {
         "NodeID": "uint256",
     },
 }
+
+
+def build_fork(specs_dir: str, fork: str, preset_name: str,
+               module_name: str | None = None):
+    """THE fork-build recipe (doc chain + prelude + scalars + class
+    subs + preset/config): shared by scripts/build_pyspec.py and the
+    compiler tests so they cannot drift.  Returns (module, source)."""
+    from .builder import build_spec
+    from ..config import load_config, load_preset
+    paths = doc_paths(specs_dir, fork)
+    if not paths:
+        raise FileNotFoundError(f"no docs for fork {fork!r} under "
+                                f"{specs_dir}")
+    return build_spec(
+        [open(p).read() for p in paths],
+        preset=load_preset(preset_name),
+        config=load_config(preset_name).as_dict(),
+        module_name=module_name or f"{fork}_{preset_name}",
+        prelude=fork_prelude(fork),
+        extra_scalars=fork_scalars(fork),
+        class_subs=fork_class_subs(fork))
 
 
 def load_kzg_trusted_setup():
@@ -99,11 +183,11 @@ def load_kzg_trusted_setup():
 
 def doc_paths(specs_dir: str, fork: str) -> list:
     """Full doc chain for `fork`: ancestor docs oldest-first."""
-    chain = FORK_CHAIN[: FORK_CHAIN.index(fork) + 1]
     out = []
-    for f in chain:
+    for f in chain_of(fork):
+        subdir = FEATURE_DIRS.get(f, f)
         for doc in FORK_DOCS.get(f, []):
-            p = os.path.join(specs_dir, f, doc)
+            p = os.path.join(specs_dir, subdir, doc)
             if os.path.exists(p):
                 out.append(p)
     return out
@@ -111,15 +195,13 @@ def doc_paths(specs_dir: str, fork: str) -> list:
 
 def fork_prelude(fork: str) -> str:
     """Concatenated preludes of the fork and its ancestors."""
-    chain = FORK_CHAIN[: FORK_CHAIN.index(fork) + 1]
-    return "\n".join(FORK_PRELUDES[f] for f in chain
+    return "\n".join(FORK_PRELUDES[f] for f in chain_of(fork)
                      if f in FORK_PRELUDES)
 
 
 def fork_scalars(fork: str) -> dict:
     """Merged injected scalar definitions for the fork chain."""
-    chain = FORK_CHAIN[: FORK_CHAIN.index(fork) + 1]
     out: dict = {}
-    for f in chain:
+    for f in chain_of(fork):
         out.update(FORK_SCALARS.get(f, {}))
     return out

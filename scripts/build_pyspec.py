@@ -15,10 +15,8 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from consensus_specs_tpu.compiler.builder import build_spec  # noqa: E402
 from consensus_specs_tpu.compiler.forks import (  # noqa: E402
-    doc_paths, fork_prelude, fork_scalars)
-from consensus_specs_tpu.config import load_config, load_preset  # noqa: E402
+    build_fork, doc_paths)
 
 
 def main() -> int:
@@ -37,15 +35,11 @@ def main() -> int:
         if not paths:
             print(f"[build_pyspec] {fork}: no docs found, skipping")
             continue
-        docs = [open(p).read() for p in paths]
         for preset in ns.presets:
             name = f"{fork}_{preset}"
             try:
-                _mod, src = build_spec(
-                    docs, preset=load_preset(preset),
-                    config=load_config(preset).as_dict(),
-                    module_name=name, prelude=fork_prelude(fork),
-                    extra_scalars=fork_scalars(fork))
+                _mod, src = build_fork(ns.specs_dir, fork, preset,
+                                       module_name=name)
             except Exception as e:
                 print(f"[build_pyspec] {name}: FAILED: "
                       f"{type(e).__name__}: {e}")
