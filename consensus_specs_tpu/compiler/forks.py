@@ -2,9 +2,12 @@
 
 Capability counterpart of the reference's per-fork spec builders
 (pysetup/spec_builders/*.py and pysetup/md_doc_paths.py:79-97): each fork
-names the markdown docs that feed its build and a prelude injected between
-the SSZ classes and the functions — execution-engine stubs, KZG trusted
-setup, and other symbols the reference wires in via imports.
+names the markdown docs that feed its build and a prelude injected
+BEFORE the SSZ classes (class-body annotations evaluate eagerly, so
+rebinds the classes rely on must already be in scope) — execution-engine
+stubs, KZG trusted setup, the whisk curdleproofs shim, and other symbols
+the reference wires in via imports.  Preludes must not reference spec
+containers at top level; those only exist later in the module.
 """
 from __future__ import annotations
 

@@ -102,10 +102,88 @@ def _recover_case(seed):
         case_name=f"recover_{seed}", case_fn=fn)
 
 
+def _recover_insufficient_case(seed):
+    """Fewer than 50% of the cells: recovery must be rejected."""
+    def fn():
+        kz = _kzg()
+        blob = _blob(seed)
+        cells, _proofs = kz.compute_cells_and_kzg_proofs(blob)
+        keep = list(range(len(cells) // 2 - 1))   # one short of half
+        try:
+            kz.recover_cells_and_kzg_proofs(
+                keep, [cells[i] for i in keep])
+        except (AssertionError, ValueError):
+            pass
+        else:
+            raise RuntimeError("insufficient cells accepted")
+        yield "data", "data", {
+            "input": {"cell_indices": keep,
+                      "cells": ["0x" + bytes(cells[i]).hex()
+                                for i in keep]},
+            "output": None,
+        }
+    return TestCase(
+        fork_name="fulu", preset_name="general", runner_name="kzg_7594",
+        handler_name="recover_cells_and_kzg_proofs", suite_name="kzg",
+        case_name=f"recover_insufficient_{seed}", case_fn=fn)
+
+
+def _recover_scattered_case(seed):
+    """Recovery from a NON-contiguous surviving set (every other
+    cell)."""
+    def fn():
+        kz = _kzg()
+        blob = _blob(seed)
+        cells, proofs = kz.compute_cells_and_kzg_proofs(blob)
+        keep = list(range(0, len(cells), 2))
+        rec_cells, rec_proofs = kz.recover_cells_and_kzg_proofs(
+            keep, [cells[i] for i in keep])
+        assert [bytes(c) for c in rec_cells] == [bytes(c) for c in cells]
+        assert [bytes(p) for p in rec_proofs] == \
+            [bytes(p) for p in proofs]
+        yield "data", "data", {
+            "input": {"cell_indices": keep,
+                      "cells": ["0x" + bytes(cells[i]).hex()
+                                for i in keep]},
+            "output": [["0x" + bytes(c).hex() for c in rec_cells],
+                       ["0x" + bytes(p).hex() for p in rec_proofs]],
+        }
+    return TestCase(
+        fork_name="fulu", preset_name="general", runner_name="kzg_7594",
+        handler_name="recover_cells_and_kzg_proofs", suite_name="kzg",
+        case_name=f"recover_scattered_{seed}", case_fn=fn)
+
+
+def _verify_wrong_index_case(seed):
+    """A valid proof presented for the WRONG cell index must fail."""
+    def fn():
+        kz = _kzg()
+        blob = _blob(seed)
+        commitment = kz.blob_to_kzg_commitment(blob)
+        cells, proofs = kz.compute_cells_and_kzg_proofs(blob)
+        ok = kz.verify_cell_kzg_proof_batch(
+            [commitment], [1], [cells[0]], [proofs[0]])
+        assert not ok
+        yield "data", "data", {
+            "input": {"commitments": ["0x" + bytes(commitment).hex()],
+                      "cell_indices": [1],
+                      "cells": ["0x" + bytes(cells[0]).hex()],
+                      "proofs": ["0x" + bytes(proofs[0]).hex()]},
+            "output": False,
+        }
+    return TestCase(
+        fork_name="fulu", preset_name="general", runner_name="kzg_7594",
+        handler_name="verify_cell_kzg_proof_batch", suite_name="kzg",
+        case_name=f"verify_wrong_index_{seed}", case_fn=fn)
+
+
 def providers():
     def make_cases():
         yield _compute_cells_case(1)
         yield _verify_case(2, tamper=False)
         yield _verify_case(3, tamper=True)
         yield _recover_case(4)
+        yield _verify_wrong_index_case(5)
+        yield _recover_scattered_case(6)
+        yield _recover_insufficient_case(7)
     return [TestProvider(make_cases=make_cases)]

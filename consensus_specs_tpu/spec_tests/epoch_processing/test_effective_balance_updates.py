@@ -1,6 +1,8 @@
 """process_effective_balance_updates epoch tests (hysteresis)."""
 from ...ssz import uint64
-from ...test_infra.context import spec_state_test, with_all_phases
+from ...test_infra.context import (
+    spec_state_test, with_all_phases, with_custom_state,
+    misc_balances, zero_activation_threshold)
 from ...test_infra.epoch_processing import run_epoch_processing_with
 
 
@@ -28,3 +30,21 @@ def test_effective_balance_hysteresis(spec, state):
 
     for i, (_pre_eff, _balance, post_eff) in enumerate(cases):
         assert int(state.validators[i].effective_balance) == post_eff, i
+
+
+@with_all_phases
+@with_custom_state(misc_balances, zero_activation_threshold)
+@spec_state_test
+def test_effective_balance_updates_misc_balances(spec, state):
+    """The hysteresis sweep over a genesis built from the misc-balance
+    shaper (mixed effective balances incl. ejection-level validators) —
+    exercises the with_custom_state genesis machinery end-to-end."""
+    pre_effs = [int(v.effective_balance) for v in state.validators]
+    assert len(set(pre_effs)) > 2       # genuinely mixed registry
+    yield from run_epoch_processing_with(
+        spec, state, "process_effective_balance_updates")
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    max_eb = int(spec.MAX_EFFECTIVE_BALANCE)
+    for i, v in enumerate(state.validators):
+        eff = int(v.effective_balance)
+        assert eff % inc == 0 and eff <= max_eb, i

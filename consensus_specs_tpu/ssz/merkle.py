@@ -96,6 +96,12 @@ def use_tpu_hashing(threshold: int = 2048, pallas: bool = False) -> None:
 def use_host_hashing() -> None:
     set_bulk_level_hasher(None)
 
+# NOTE: the native C++ tier's sha256_2to1_batch is NOT wired here on
+# purpose — measured 0.92x vs hashlib on a SHA-NI host (OpenSSL's
+# assembly beats portable C++ per hash; the saved Python loop overhead
+# doesn't cover the gap).  The plug points above stand ready if a
+# vectorized native hasher lands.
+
 
 def merkleize_chunks(chunks: Sequence[bytes], limit: int | None = None) -> bytes:
     """Merkle root of `chunks`, virtually padded with zero chunks.

@@ -15,8 +15,7 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from consensus_specs_tpu.compiler.forks import (  # noqa: E402
-    build_fork, doc_paths)
+from consensus_specs_tpu.compiler.forks import build_fork  # noqa: E402
 
 
 def main() -> int:
@@ -31,15 +30,14 @@ def main() -> int:
     os.makedirs(ns.out, exist_ok=True)
     failures = 0
     for fork in ns.forks:
-        paths = doc_paths(ns.specs_dir, fork)
-        if not paths:
-            print(f"[build_pyspec] {fork}: no docs found, skipping")
-            continue
         for preset in ns.presets:
             name = f"{fork}_{preset}"
             try:
                 _mod, src = build_fork(ns.specs_dir, fork, preset,
                                        module_name=name)
+            except FileNotFoundError:
+                print(f"[build_pyspec] {fork}: no docs found, skipping")
+                break
             except Exception as e:
                 print(f"[build_pyspec] {name}: FAILED: "
                       f"{type(e).__name__}: {e}")
