@@ -151,6 +151,12 @@ FORK_SCALARS = {
 }
 
 
+class MissingDocs(FileNotFoundError):
+    """No markdown docs found for a fork (distinct from other
+    FileNotFoundErrors raised during the build, e.g. a missing trusted
+    setup — callers skipping absent docs must not swallow those)."""
+
+
 def build_fork(specs_dir: str, fork: str, preset_name: str,
                module_name: str | None = None):
     """THE fork-build recipe (doc chain + prelude + scalars + class
@@ -160,8 +166,7 @@ def build_fork(specs_dir: str, fork: str, preset_name: str,
     from ..config import load_config, load_preset
     paths = doc_paths(specs_dir, fork)
     if not paths:
-        raise FileNotFoundError(f"no docs for fork {fork!r} under "
-                                f"{specs_dir}")
+        raise MissingDocs(f"no docs for fork {fork!r} under {specs_dir}")
     return build_spec(
         [open(p).read() for p in paths],
         preset=load_preset(preset_name),

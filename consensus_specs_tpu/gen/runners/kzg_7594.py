@@ -49,141 +49,89 @@ def _compute_cells_case(seed):
         case_name=f"compute_cells_{seed}", case_fn=fn)
 
 
-def _verify_case(seed, tamper):
+def _verify_case(seed, name, expect, tamper=False, claim_idx=None):
+    """One verify_cell_kzg_proof_batch case: cells/proofs come from the
+    source indices, the CLAIMED indices may differ (wrong-index cases),
+    and the first cell may be tampered."""
     def fn():
         kz = _kzg()
         blob = _blob(seed)
         commitment = kz.blob_to_kzg_commitment(blob)
         cells, proofs = kz.compute_cells_and_kzg_proofs(blob)
-        idx = [0, len(cells) // 2]
-        use_cells = [cells[i] for i in idx]
+        src = [0, len(cells) // 2]
+        idx = claim_idx if claim_idx is not None else src
+        use_cells = [cells[i] for i in src]
         if tamper:
             use_cells[0] = bytes(use_cells[0][:-32]) + b"\x00" * 31 + b"\x01"
         ok = kz.verify_cell_kzg_proof_batch(
             [commitment] * len(idx), idx, use_cells,
-            [proofs[i] for i in idx])
+            [proofs[i] for i in src])
         yield "data", "data", {
             "input": {
                 "commitments": ["0x" + bytes(commitment).hex()] * len(idx),
                 "cell_indices": idx,
                 "cells": ["0x" + bytes(c).hex() for c in use_cells],
-                "proofs": ["0x" + bytes(proofs[i]).hex() for i in idx],
+                "proofs": ["0x" + bytes(proofs[i]).hex() for i in src],
             },
             "output": bool(ok),
         }
-        assert ok is (not tamper)
-    name = "verify_tampered" if tamper else "verify_valid"
+        assert ok is expect
     return TestCase(
         fork_name="fulu", preset_name="general", runner_name="kzg_7594",
         handler_name="verify_cell_kzg_proof_batch", suite_name="kzg",
         case_name=f"{name}_{seed}", case_fn=fn)
 
 
-def _recover_case(seed):
+def _recover_case(seed, name, keep_fn, expect_reject=False):
+    """One recover_cells_and_kzg_proofs case; keep_fn maps the cell
+    count to the surviving index list.  Rejections emit output: null."""
     def fn():
         kz = _kzg()
         blob = _blob(seed)
         cells, proofs = kz.compute_cells_and_kzg_proofs(blob)
-        # drop the first half; recovery needs any 50%
-        keep = list(range(len(cells) // 2, len(cells)))
-        rec_cells, rec_proofs = kz.recover_cells_and_kzg_proofs(
-            keep, [cells[i] for i in keep])
-        yield "data", "data", {
-            "input": {"cell_indices": keep,
-                      "cells": ["0x" + bytes(cells[i]).hex()
-                                for i in keep]},
-            "output": [["0x" + bytes(c).hex() for c in rec_cells],
-                       ["0x" + bytes(p).hex() for p in rec_proofs]],
-        }
-        assert [bytes(c) for c in rec_cells] == [bytes(c) for c in cells]
-    return TestCase(
-        fork_name="fulu", preset_name="general", runner_name="kzg_7594",
-        handler_name="recover_cells_and_kzg_proofs", suite_name="kzg",
-        case_name=f"recover_{seed}", case_fn=fn)
-
-
-def _recover_insufficient_case(seed):
-    """Fewer than 50% of the cells: recovery must be rejected."""
-    def fn():
-        kz = _kzg()
-        blob = _blob(seed)
-        cells, _proofs = kz.compute_cells_and_kzg_proofs(blob)
-        keep = list(range(len(cells) // 2 - 1))   # one short of half
-        try:
-            kz.recover_cells_and_kzg_proofs(
-                keep, [cells[i] for i in keep])
-        except (AssertionError, ValueError):
-            pass
+        keep = keep_fn(len(cells))
+        payload = {"input": {"cell_indices": keep,
+                             "cells": ["0x" + bytes(cells[i]).hex()
+                                       for i in keep]}}
+        if expect_reject:
+            try:
+                kz.recover_cells_and_kzg_proofs(
+                    keep, [cells[i] for i in keep])
+            except (AssertionError, ValueError):
+                pass
+            else:
+                raise RuntimeError("insufficient cells accepted")
+            payload["output"] = None
         else:
-            raise RuntimeError("insufficient cells accepted")
-        yield "data", "data", {
-            "input": {"cell_indices": keep,
-                      "cells": ["0x" + bytes(cells[i]).hex()
-                                for i in keep]},
-            "output": None,
-        }
+            rec_cells, rec_proofs = kz.recover_cells_and_kzg_proofs(
+                keep, [cells[i] for i in keep])
+            assert [bytes(c) for c in rec_cells] == \
+                [bytes(c) for c in cells]
+            assert [bytes(q) for q in rec_proofs] == \
+                [bytes(q) for q in proofs]
+            payload["output"] = [
+                ["0x" + bytes(c).hex() for c in rec_cells],
+                ["0x" + bytes(q).hex() for q in rec_proofs]]
+        yield "data", "data", payload
     return TestCase(
         fork_name="fulu", preset_name="general", runner_name="kzg_7594",
         handler_name="recover_cells_and_kzg_proofs", suite_name="kzg",
-        case_name=f"recover_insufficient_{seed}", case_fn=fn)
-
-
-def _recover_scattered_case(seed):
-    """Recovery from a NON-contiguous surviving set (every other
-    cell)."""
-    def fn():
-        kz = _kzg()
-        blob = _blob(seed)
-        cells, proofs = kz.compute_cells_and_kzg_proofs(blob)
-        keep = list(range(0, len(cells), 2))
-        rec_cells, rec_proofs = kz.recover_cells_and_kzg_proofs(
-            keep, [cells[i] for i in keep])
-        assert [bytes(c) for c in rec_cells] == [bytes(c) for c in cells]
-        assert [bytes(p) for p in rec_proofs] == \
-            [bytes(p) for p in proofs]
-        yield "data", "data", {
-            "input": {"cell_indices": keep,
-                      "cells": ["0x" + bytes(cells[i]).hex()
-                                for i in keep]},
-            "output": [["0x" + bytes(c).hex() for c in rec_cells],
-                       ["0x" + bytes(p).hex() for p in rec_proofs]],
-        }
-    return TestCase(
-        fork_name="fulu", preset_name="general", runner_name="kzg_7594",
-        handler_name="recover_cells_and_kzg_proofs", suite_name="kzg",
-        case_name=f"recover_scattered_{seed}", case_fn=fn)
-
-
-def _verify_wrong_index_case(seed):
-    """A valid proof presented for the WRONG cell index must fail."""
-    def fn():
-        kz = _kzg()
-        blob = _blob(seed)
-        commitment = kz.blob_to_kzg_commitment(blob)
-        cells, proofs = kz.compute_cells_and_kzg_proofs(blob)
-        ok = kz.verify_cell_kzg_proof_batch(
-            [commitment], [1], [cells[0]], [proofs[0]])
-        assert not ok
-        yield "data", "data", {
-            "input": {"commitments": ["0x" + bytes(commitment).hex()],
-                      "cell_indices": [1],
-                      "cells": ["0x" + bytes(cells[0]).hex()],
-                      "proofs": ["0x" + bytes(proofs[0]).hex()]},
-            "output": False,
-        }
-    return TestCase(
-        fork_name="fulu", preset_name="general", runner_name="kzg_7594",
-        handler_name="verify_cell_kzg_proof_batch", suite_name="kzg",
-        case_name=f"verify_wrong_index_{seed}", case_fn=fn)
+        case_name=f"{name}_{seed}", case_fn=fn)
 
 
 def providers():
     def make_cases():
         yield _compute_cells_case(1)
-        yield _verify_case(2, tamper=False)
-        yield _verify_case(3, tamper=True)
-        yield _recover_case(4)
-        yield _verify_wrong_index_case(5)
-        yield _recover_scattered_case(6)
-        yield _recover_insufficient_case(7)
+        yield _verify_case(2, "verify_valid", expect=True)
+        yield _verify_case(3, "verify_tampered", expect=False,
+                           tamper=True)
+        yield _verify_case(5, "verify_wrong_index", expect=False,
+                           claim_idx=[1, 2])
+        yield _recover_case(4, "recover",
+                            lambda n: list(range(n // 2, n)))
+        yield _recover_case(6, "recover_scattered",
+                            lambda n: list(range(0, n, 2)))
+        yield _recover_case(7, "recover_insufficient",
+                            lambda n: list(range(n // 2 - 1)),
+                            expect_reject=True)
     return [TestProvider(make_cases=make_cases)]

@@ -52,3 +52,54 @@ for _pre, _post in zip(MAINLINE_FORKS, MAINLINE_FORKS[1:]):
     _fn = _make_transition_test(_pre, _post)
     globals()[_fn.__name__] = _fn
 del _fn
+
+
+@with_phases(["phase0"])
+@spec_test
+@never_bls
+def test_transition_with_pending_attestations_translated(spec):
+    """Cross phase0->altair with PENDING attestations: upgrade_to_altair
+    translates them into participation flags (reference altair/fork.md
+    translate_participation).  The vector is fully replayable: every
+    pre-fork block is yielded, the boundary slot carries the first
+    ALTAIR block, and the pre-fork attestations reach the upgrade in
+    previous_epoch_attestations via the boundary rotation."""
+    from ...ssz import uint64
+    from ...test_infra.attestations import get_valid_attestation
+    post_spec = get_spec("altair", spec.preset_name)
+    state = _genesis_state(spec, default_balances,
+                           default_activation_threshold, "")
+    yield "pre", state.copy()
+
+    # attestation-filled blocks up to (not including) the boundary slot
+    blocks = []
+    for _ in range(int(spec.SLOTS_PER_EPOCH) - 1):
+        block = build_empty_block_for_next_slot(spec, state)
+        if state.slot >= spec.MIN_ATTESTATION_INCLUSION_DELAY:
+            slot_to_attest = uint64(
+                int(state.slot) - int(spec.MIN_ATTESTATION_INCLUSION_DELAY)
+                + 1)
+            block.body.attestations = [get_valid_attestation(
+                spec, state, slot=slot_to_attest, signed=True)]
+        blocks.append(
+            state_transition_and_sign_block(spec, state, block))
+    assert len(state.current_epoch_attestations) > 0
+
+    # the boundary crossing rotates current -> previous, THEN the
+    # upgrade runs and translates them (fork.md trigger ordering)
+    fork_epoch = int(spec.get_current_epoch(state)) + 1
+    post_state, fork_block = transition_across(
+        spec, post_spec, state, fork_epoch, with_block=True)
+    assert any(int(f) != 0
+               for f in post_state.previous_epoch_participation)
+    blocks.append(fork_block)
+
+    block = build_empty_block_for_next_slot(post_spec, post_state)
+    blocks.append(
+        state_transition_and_sign_block(post_spec, post_state, block))
+    for i, sb in enumerate(blocks):
+        yield f"blocks_{i}", sb
+    yield "fork_epoch", "meta", fork_epoch
+    yield "post_fork", "meta", "altair"
+    yield "blocks_count", "meta", len(blocks)
+    yield "post", post_state

@@ -15,7 +15,8 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from consensus_specs_tpu.compiler.forks import build_fork  # noqa: E402
+from consensus_specs_tpu.compiler.forks import (  # noqa: E402
+    MissingDocs, build_fork)
 
 
 def main() -> int:
@@ -35,7 +36,7 @@ def main() -> int:
             try:
                 _mod, src = build_fork(ns.specs_dir, fork, preset,
                                        module_name=name)
-            except FileNotFoundError:
+            except MissingDocs:
                 print(f"[build_pyspec] {fork}: no docs found, skipping")
                 break
             except Exception as e:
