@@ -1,7 +1,8 @@
 """process_effective_balance_updates epoch tests (hysteresis)."""
 from ...ssz import uint64
 from ...test_infra.context import (
-    spec_state_test, with_all_phases, with_custom_state,
+    spec_state_test, with_all_phases, with_all_phases_from,
+    with_custom_state,
     misc_balances, zero_activation_threshold)
 from ...test_infra.epoch_processing import run_epoch_processing_with
 
@@ -48,3 +49,26 @@ def test_effective_balance_updates_misc_balances(spec, state):
     for i, v in enumerate(state.validators):
         eff = int(v.effective_balance)
         assert eff % inc == 0 and eff <= max_eb, i
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_effective_balance_compounding_ceiling(spec, state):
+    """Electra: 0x02 compounding credentials raise the effective-balance
+    ceiling to MAX_EFFECTIVE_BALANCE_ELECTRA while 0x01 validators stay
+    capped at MIN_ACTIVATION_BALANCE-scale MAX_EFFECTIVE_BALANCE."""
+    from ...test_infra.withdrawals import (
+        set_compounding_withdrawal_credentials,
+        set_eth1_withdrawal_credentials)
+    big = int(spec.MAX_EFFECTIVE_BALANCE_ELECTRA)
+    set_compounding_withdrawal_credentials(spec, state, 0)
+    state.balances[0] = uint64(big + int(spec.EFFECTIVE_BALANCE_INCREMENT))
+    set_eth1_withdrawal_credentials(spec, state, 1)
+    state.balances[1] = uint64(big)   # same balance, non-compounding
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_effective_balance_updates")
+
+    assert int(state.validators[0].effective_balance) == big
+    assert int(state.validators[1].effective_balance) == \
+        int(spec.MIN_ACTIVATION_BALANCE)
