@@ -306,3 +306,30 @@ def test_generated_whisk_verifies_our_shuffle_proof(feature_mods):
     assert not w.IsValidWhiskShuffleProof(
         [mk(t) for t in pre], [mk(t) for t in pre],
         w.WhiskShuffleProof(proof))
+
+
+def test_generated_deneb_kzg_verifies_library_proof():
+    """The GENERATED deneb module's verify_kzg_proof — markdown code,
+    baked 4096-point trusted setup, shim-routed pairing — accepts a
+    proof computed by the library (crypto/kzg.py) and rejects a wrong
+    claimed evaluation.  North-star config #4's correctness leg."""
+    from consensus_specs_tpu.compiler.forks import build_fork
+    from consensus_specs_tpu.crypto.kzg import KZG
+
+    mod, _src = build_fork("/root/reference/specs", "deneb", "minimal",
+                           module_name="deneb_minimal_generated_kzg")
+    kz = KZG()   # production 4096 setup
+    import random
+    rng = random.Random(11)
+    blob = b"".join(
+        (rng.randrange(1 << 200)).to_bytes(32, "big")
+        for _ in range(kz.width))
+    commitment = kz.blob_to_kzg_commitment(blob)
+    z = (7777).to_bytes(32, "big")
+    proof, y = kz.compute_kzg_proof(blob, z)
+
+    assert mod.verify_kzg_proof(commitment, z, y, proof)
+    wrong_y = (int.from_bytes(y, "big") + 1).to_bytes(32, "big")
+    assert not mod.verify_kzg_proof(commitment, z, wrong_y, proof)
+    # the generated module's field helpers agree with the library too
+    assert int(mod.bytes_to_bls_field(z)) == 7777
