@@ -62,3 +62,48 @@ def test_finality_rule_2_previous_epoch(spec, state):
     yield from _finality_case(
         spec, state, [(False, True)] * 4)
     assert int(state.current_justified_checkpoint.epoch) > pre_justified
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_finality_rule_4_source_skipped_epoch(spec, state):
+    """Rule 4 shape: an unattested epoch breaks the chain; resumed full
+    participation re-justifies and finality catches up from the new
+    source, never crossing the gap."""
+    next_epoch(spec, state)
+    yield "pre", state.copy()
+    blocks = _run_epochs(spec, state, [(True, False)] * 3)
+    finalized_before_gap = int(state.finalized_checkpoint.epoch)
+    blocks += _run_epochs(spec, state, [(False, False)])   # the gap
+    assert int(state.finalized_checkpoint.epoch) == finalized_before_gap
+    blocks += _run_epochs(spec, state, [(True, False)] * 3)
+    for i, sb in enumerate(blocks):
+        yield f"blocks_{i}", sb
+    yield "blocks_count", "meta", len(blocks)
+    yield "post", state
+    assert int(state.finalized_checkpoint.epoch) > finalized_before_gap
+    # justification recovered beyond the unattested epoch
+    assert int(state.current_justified_checkpoint.epoch) >= \
+        int(state.finalized_checkpoint.epoch)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_finality_rule_3_123_finalizes_1(spec, state):
+    """Rule 3 shape: justified epochs n-2 and n-1 with current-epoch
+    votes finalize n-2 (the 2nd/3rd-most-recent-justified rule)."""
+    next_epoch(spec, state)
+    yield "pre", state.copy()
+    # one previous-epoch-voted pass (slower justification), then
+    # current-epoch passes — exercises the mixed bit patterns
+    blocks = _run_epochs(spec, state, [(False, True), (True, False),
+                                       (True, False), (True, False)])
+    for i, sb in enumerate(blocks):
+        yield f"blocks_{i}", sb
+    yield "blocks_count", "meta", len(blocks)
+    yield "post", state
+    assert int(state.finalized_checkpoint.epoch) > 0
+    bits = list(state.justification_bits)
+    assert any(bits), "no justification bits set"
