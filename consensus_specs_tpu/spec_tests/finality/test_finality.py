@@ -3,7 +3,8 @@ test/phase0/finality/test_finality.py shape; vector format
 tests/formats/finality: pre + blocks_i + post).
 """
 from ...test_infra.context import (
-    spec_state_test, with_all_phases, never_bls)
+    spec_state_test, with_all_phases, with_pytest_fork_subset,
+    never_bls)
 from ...test_infra.blocks import next_epoch
 from ...test_infra.attestations import next_epoch_with_attestations
 
@@ -65,6 +66,7 @@ def test_finality_rule_2_previous_epoch(spec, state):
 
 
 @with_all_phases
+@with_pytest_fork_subset(["phase0", "altair", "electra"])
 @spec_state_test
 @never_bls
 def test_finality_rule_4_source_skipped_epoch(spec, state):
@@ -89,6 +91,7 @@ def test_finality_rule_4_source_skipped_epoch(spec, state):
 
 
 @with_all_phases
+@with_pytest_fork_subset(["phase0", "altair", "electra"])
 @spec_state_test
 @never_bls
 def test_finality_rule_3_123_finalizes_1(spec, state):
