@@ -12,7 +12,7 @@ protocol; container shapes and generalized indices adapt per fork.
 
 NOTE: SSZ Container fields are live class annotations (no PEP 563 here).
 """
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Optional
 
 from ..ssz import (
@@ -38,6 +38,17 @@ class LightClientStore:
     optimistic_header: object
     previous_max_active_participants: int
     current_max_active_participants: int
+
+
+@dataclass
+class LightClientDataStore:
+    """Server-side LC data collection: bootstraps by finalized block
+    root, the best update per sync-committee period, and the latest
+    finality/optimistic updates."""
+    bootstraps: dict = field(default_factory=dict)
+    best_updates: dict = field(default_factory=dict)
+    latest_finality_update: object = None
+    latest_optimistic_update: object = None
 
 
 class LightClientMixin:
@@ -589,3 +600,76 @@ class LightClientMixin:
             attested_header=update.attested_header,
             sync_aggregate=update.sync_aggregate,
             signature_slot=update.signature_slot)
+
+    # ------------------------------------------------------------------
+    # light-client data collection (the LC SERVER side; reference
+    # capability: test/helpers/light_client_data_collection.py + the
+    # p2p LightClientUpdatesByRange/Bootstrap request semantics)
+    # ------------------------------------------------------------------
+    # p2p request bound (reference config MAX_REQUEST_LIGHT_CLIENT_UPDATES)
+    MAX_REQUEST_LIGHT_CLIENT_UPDATES = 128
+
+    def new_light_client_data_store(self):
+        return LightClientDataStore()
+
+    def lc_data_on_block(self, store: "LightClientDataStore", state,
+                         block, attested_state, attested_block,
+                         finalized_block=None) -> None:
+        """Feed one imported head block into the collection: derive the
+        update whose attested header is the parent, keep the best per
+        sync-committee period (is_better_update), and refresh the
+        latest finality/optimistic updates by attested slot."""
+        try:
+            update = self.create_light_client_update(
+                state, block, attested_state, attested_block,
+                finalized_block)
+        except AssertionError:
+            # not update material (low participation, pre-altair
+            # attested epoch): a server simply collects nothing, it
+            # does not fail the import
+            return
+        period = self.compute_sync_committee_period_at_slot(
+            update.attested_header.beacon.slot)
+        best = store.best_updates.get(period)
+        if best is None or self.is_better_update(update, best):
+            store.best_updates[period] = update
+
+        att_slot = int(update.attested_header.beacon.slot)
+        if self.is_finality_update(update) and (
+                store.latest_finality_update is None
+                or att_slot > int(store.latest_finality_update
+                                  .attested_header.beacon.slot)):
+            store.latest_finality_update = \
+                self.create_light_client_finality_update(update)
+        if store.latest_optimistic_update is None or att_slot > int(
+                store.latest_optimistic_update
+                .attested_header.beacon.slot):
+            store.latest_optimistic_update = \
+                self.create_light_client_optimistic_update(update)
+
+    def lc_data_on_finalized(self, store: "LightClientDataStore", state,
+                             block) -> None:
+        """A finalized block becomes bootstrap material
+        (LightClientBootstrap request semantics)."""
+        root = hash_tree_root(block.message)
+        store.bootstraps[bytes(root)] = \
+            self.create_light_client_bootstrap(state, block)
+
+    def get_light_client_updates(self, store: "LightClientDataStore",
+                                 start_period: int, count: int) -> list:
+        """LightClientUpdatesByRange: best updates for up to
+        MAX_REQUEST_LIGHT_CLIENT_UPDATES consecutive periods, stopping
+        at the first gap."""
+        out = []
+        capped = min(int(count), self.MAX_REQUEST_LIGHT_CLIENT_UPDATES)
+        for period in range(int(start_period),
+                            int(start_period) + capped):
+            update = store.best_updates.get(period)
+            if update is None:
+                break
+            out.append(update)
+        return out
+
+    def get_light_client_bootstrap(self, store: "LightClientDataStore",
+                                   block_root: bytes):
+        return store.bootstraps.get(bytes(block_root))

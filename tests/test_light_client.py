@@ -321,3 +321,64 @@ def test_safety_threshold_and_known_committee(spec):
         current_max_active_participants=4)
     assert spec.get_safety_threshold(s) == 5
     assert not spec.is_next_sync_committee_known(s)
+
+
+# ---------------------------------------------------------------------------
+# data collection (the LC server side)
+# ---------------------------------------------------------------------------
+
+def test_lc_data_collection(spec):
+    """Feed a chain into the data store: best update per period prefers
+    higher participation, the range getter stops at gaps, finalized
+    blocks serve bootstraps, the latest optimistic update tracks the
+    newest attested slot, and ineligible blocks are skipped, not
+    crashed on."""
+    from consensus_specs_tpu.test_infra.light_client_sync import (
+        build_sync_aggregate as shared_aggregate)
+    states, blocks = build_chain(spec, 7)
+    store = spec.new_light_client_data_store()
+
+    def feed(sig_index, participation):
+        att = sig_index - 1
+        aggregate = shared_aggregate(
+            spec, states[sig_index], blocks[sig_index].message.slot,
+            hash_tree_root(blocks[att].message),
+            participation=participation)
+        with disable_bls():
+            pre = states[att].copy()
+            block = build_empty_block_for_next_slot(spec, pre)
+            block.body.sync_aggregate = aggregate
+            signed = state_transition_and_sign_block(spec, pre, block)
+        spec.lc_data_on_block(store, pre, signed, states[att],
+                              blocks[att])
+
+    # low participation first, then full: the better update must
+    # STRICTLY win
+    feed(2, participation=0.5)
+    period = spec.compute_sync_committee_period_at_slot(
+        blocks[1].message.slot)
+    first_best = store.best_updates[int(period)]
+    feed(3, participation=1.0)
+    best = store.best_updates[int(period)]
+    assert sum(map(bool, best.sync_aggregate.sync_committee_bits)) > \
+        sum(map(bool, first_best.sync_aggregate.sync_committee_bits))
+
+    # an empty-participation block is SKIPPED (no crash, store intact)
+    feed(4, participation=0.0)
+    assert store.best_updates[int(period)] == best
+
+    # range getter: one period present, stops there
+    updates = spec.get_light_client_updates(store, int(period), 4)
+    assert len(updates) >= 1 and updates[0] == best
+
+    # bootstrap served for a finalized block
+    spec.lc_data_on_finalized(store, states[0], blocks[0])
+    root = hash_tree_root(blocks[0].message)
+    assert spec.get_light_client_bootstrap(store, root) is not None
+    assert spec.get_light_client_bootstrap(store, b"\x00" * 32) is None
+
+    # optimistic update tracks the newest ELIGIBLE attested slot
+    assert store.latest_optimistic_update is not None
+    assert int(store.latest_optimistic_update
+               .attested_header.beacon.slot) == \
+        int(blocks[2].message.slot)
