@@ -66,3 +66,81 @@ def check_handler_modules(handler_modules: dict) -> list:
                        if name.startswith("test_")):
                 problems.append(f"{handler}: no exportable tests")
     return problems
+
+
+def check_mods() -> list:
+    """Repo-wide completeness check (reference check_mods,
+    gen_from_tests/gen.py:140-203): every test module FILE under
+    spec_tests/<package>/ must be reflected by its runner's handler
+    registry, and every registered module must import and carry
+    exportable tests.  Returns a list of problems (empty = ok)."""
+    import os
+    import consensus_specs_tpu.spec_tests as st
+
+    registries = {
+        "operations": ("consensus_specs_tpu.spec_tests.operations",
+                       "OPERATION_HANDLERS"),
+        "epoch_processing": (
+            "consensus_specs_tpu.spec_tests.epoch_processing",
+            "EPOCH_PROCESSING_HANDLERS"),
+        "rewards": ("consensus_specs_tpu.spec_tests.rewards",
+                    "REWARDS_HANDLERS"),
+        "sanity": ("consensus_specs_tpu.spec_tests.sanity",
+                   "SANITY_HANDLERS"),
+        "fork_choice": ("consensus_specs_tpu.spec_tests.fork_choice",
+                        "FORK_CHOICE_HANDLERS"),
+        "genesis": ("consensus_specs_tpu.spec_tests.genesis",
+                    "GENESIS_HANDLERS"),
+    }
+    # suites whose runners reflect them directly (single-module)
+    direct = {
+        "finality": "consensus_specs_tpu.spec_tests.finality.test_finality",
+        "transition":
+            "consensus_specs_tpu.spec_tests.transition.test_transition",
+        "random": "consensus_specs_tpu.spec_tests.random.test_random",
+        "light_client":
+            "consensus_specs_tpu.spec_tests.light_client.test_sync",
+    }
+
+    problems = []
+    root = os.path.dirname(os.path.abspath(st.__file__))
+    for pkg in sorted(os.listdir(root)):
+        pkg_dir = os.path.join(root, pkg)
+        if not os.path.isdir(pkg_dir) or pkg.startswith("__"):
+            continue
+        files = {f"consensus_specs_tpu.spec_tests.{pkg}.{f[:-3]}"
+                 for f in os.listdir(pkg_dir)
+                 if f.startswith("test_") and f.endswith(".py")}
+        if pkg in registries:
+            mod_name, attr = registries[pkg]
+            registry = getattr(importlib.import_module(mod_name), attr)
+            registered = set()
+            for mods in registry.values():
+                if not isinstance(mods, (list, tuple)):
+                    mods = [mods]
+                registered.update(
+                    getattr(m, "__name__", m) for m in mods)
+            missing = files - registered
+            for m in sorted(missing):
+                problems.append(
+                    f"{pkg}: {m} exists but is not registered — its "
+                    f"tests emit no vectors")
+            problems.extend(
+                f"{pkg}/{p}" for p in check_handler_modules(registry))
+        elif pkg in direct:
+            missing = files - {direct[pkg]}
+            for m in sorted(missing):
+                problems.append(
+                    f"{pkg}: {m} exists but the runner reflects only "
+                    f"{direct[pkg]}")
+            if direct[pkg] not in files:
+                problems.append(
+                    f"{pkg}: reflected module {direct[pkg]} has no "
+                    f"file on disk")
+            problems.extend(
+                f"{pkg}/{p}"
+                for p in check_handler_modules({pkg: direct[pkg]}))
+        else:
+            problems.append(f"unknown spec_tests package {pkg!r} — no "
+                            f"runner reflects it")
+    return problems

@@ -5,6 +5,9 @@ Usage:
     python scripts/gen_vectors.py <runner|all> -o out/ [--force]
         [--preset-list minimal] [--fork-list phase0 altair]
         [--shard I/N]     # host-level sharding: this host takes cases i%N==I
+    python scripts/gen_vectors.py --modcheck
+        # completeness check: every spec_tests module must be reflected
+        # by a runner (exit 1 on problems)
 
 Counterpart of the reference's `make gen_<runner>` / `make gen_all`.
 """
@@ -18,6 +21,17 @@ from consensus_specs_tpu.gen.runner import run_generator  # noqa: E402
 from consensus_specs_tpu.gen.runners import (  # noqa: E402
     RUNNER_NAMES, get_providers)
 from consensus_specs_tpu.gen.typing import TestProvider  # noqa: E402
+
+
+def _modcheck() -> int:
+    """--modcheck: fail when a spec_tests module is not reflected by
+    any runner (the reference's `make gen_... --modcheck` capability)."""
+    from consensus_specs_tpu.gen.reflect import check_mods
+    problems = check_mods()
+    for p in problems:
+        print(f"[modcheck] {p}")
+    print(f"[modcheck] {'FAILED' if problems else 'ok'}")
+    return 1 if problems else 0
 
 
 def _sharded(providers, shard_spec: str):
@@ -63,6 +77,8 @@ def main(argv):
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)
         return 0
+    if argv[0] == "--modcheck":
+        return _modcheck()
     runner = argv[0]
     rest = list(argv[1:])
     shard = None

@@ -223,3 +223,11 @@ def test_all_runners_enumerate_cases():
         first = next(it, None)
         assert first is not None, f"runner {runner} yields no cases"
         assert first.runner_name == runner
+
+
+def test_modcheck_clean():
+    """Every spec_tests module is reflected by a runner (the reference
+    check_mods guarantee: a test file that silently emits no vectors is
+    a completeness bug)."""
+    from consensus_specs_tpu.gen.reflect import check_mods
+    assert check_mods() == []
