@@ -15,7 +15,8 @@ def test_block_with_full_operation_mix(spec, state):
     pre_validator_count = len(state.validators)
     yield "pre", state.copy()
     signed = state_transition_and_sign_block(spec, state, block)
-    yield "blocks", [signed]
+    yield "blocks_0", signed
+    yield "blocks_count", "meta", 1
     yield "post", state
     for idx in expect["slashed"]:
         assert state.validators[idx].slashed
@@ -32,7 +33,8 @@ def test_block_with_attestations_only(spec, state):
         with_attester_slashing=False, with_voluntary_exit=False)
     yield "pre", state.copy()
     signed = state_transition_and_sign_block(spec, state, block)
-    yield "blocks", [signed]
+    yield "blocks_0", signed
+    yield "blocks_count", "meta", 1
     yield "post", state
     if not spec.is_post("altair"):
         assert len(state.current_epoch_attestations) + \
