@@ -1,0 +1,56 @@
+"""SSZ decoder robustness fuzz (bounded, deterministic).
+
+The ssz_generic vectors cover hand-picked invalid encodings; this sweep
+complements them with the strict-codec property over EVERY container
+type of every fork: random or truncated bytes either raise ValueError
+(never IndexError / struct.error / other surprises) or decode to an
+object that re-serializes to EXACTLY the input bytes — a decoder that
+silently mis-frames its input fails the equality.
+"""
+from random import Random
+
+import pytest
+
+from consensus_specs_tpu.debug import RandomizationMode, get_random_ssz_object
+from consensus_specs_tpu.specs import available_forks, get_spec
+from test_debug_tools import spec_container_types
+
+FORKS = available_forks()
+
+
+@pytest.mark.parametrize("fork", FORKS)
+def test_random_bytes_fail_cleanly_or_roundtrip(fork):
+    spec = get_spec(fork, "minimal")
+    rng = Random(f"fuzz-{fork}")
+    for name, typ in sorted(spec_container_types(spec).items()):
+        for trial in range(3):
+            blob = rng.randbytes(rng.randrange(0, 200))
+            try:
+                obj = typ.deserialize(blob)
+            except ValueError:
+                continue
+            # strict codec: accepted bytes must round-trip EXACTLY
+            assert obj.serialize() == blob, (name, trial)
+
+
+@pytest.mark.parametrize("fork", ["phase0", "electra", "eip7732"])
+def test_truncated_valid_encodings_strict(fork):
+    """Chopping bytes off a valid encoding must raise ValueError or (for
+    byte counts that happen to frame a valid value) round-trip exactly —
+    silent mis-framing is the failure mode under test."""
+    spec = get_spec(fork, "minimal")
+    rng = Random(f"trunc-{fork}")
+    for name, typ in sorted(spec_container_types(spec).items()):
+        obj = get_random_ssz_object(rng, typ, max_bytes_length=64,
+                                    max_list_length=3,
+                                    mode=RandomizationMode.RANDOM)
+        data = obj.serialize()
+        if len(data) == 0:
+            continue
+        for cut in {1, max(1, len(data) // 2)}:
+            blob = data[:-cut]
+            try:
+                back = typ.deserialize(blob)
+            except ValueError:
+                continue
+            assert back.serialize() == blob, (name, cut)
