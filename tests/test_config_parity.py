@@ -1,0 +1,74 @@
+"""Preset/config parity with the reference YAML.
+
+config/params.py was machine-extracted from the reference's
+presets/{minimal,mainnet}/*.yaml and configs/{minimal,mainnet}.yaml;
+this guard proves there is no drift: every reference key must exist
+here with an equivalent value (ints compare numerically, 0x-strings
+case-insensitively).  Keys the reference adds later surface as
+failures instead of silently missing constants.
+"""
+import os
+
+import pytest
+import yaml
+
+from consensus_specs_tpu.config import load_config, load_preset
+
+REF = "/root/reference"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(os.path.join(REF, "presets")),
+    reason="reference presets not mounted")
+
+
+def _norm(v):
+    """Canonicalize to int where possible: pyyaml already int-ifies
+    0x-literals (YAML 1.1), so hex STRINGS on our side must compare
+    numerically."""
+    if isinstance(v, str):
+        s = v.strip()
+        try:
+            return int(s, 0)
+        except ValueError:
+            return s
+    return v
+
+
+def _ref_yaml(path):
+    out = {}
+    with open(path) as f:
+        for key, value in (yaml.safe_load(f) or {}).items():
+            out[key] = _norm(value)
+    return out
+
+
+@pytest.mark.parametrize("preset", ["minimal", "mainnet"])
+def test_preset_values_match_reference(preset):
+    ours = {k: _norm(v) for k, v in load_preset(preset).items()}
+    checked = 0
+    for fname in sorted(os.listdir(os.path.join(REF, "presets", preset))):
+        if not fname.endswith(".yaml"):
+            continue
+        ref = _ref_yaml(os.path.join(REF, "presets", preset, fname))
+        for key, value in ref.items():
+            assert key in ours, f"{preset}/{fname}: missing {key}"
+            assert ours[key] == value, (
+                f"{preset}/{fname}: {key} = {ours[key]!r}, "
+                f"reference {value!r}")
+            checked += 1
+    assert checked > 50
+
+
+@pytest.mark.parametrize("name", ["minimal", "mainnet"])
+def test_config_values_match_reference(name):
+    ours = {k: _norm(v) for k, v in load_config(name).as_dict().items()}
+    ref = _ref_yaml(os.path.join(REF, "configs", f"{name}.yaml"))
+    checked = 0
+    for key, value in ref.items():
+        if key in ("PRESET_BASE", "CONFIG_NAME"):
+            continue
+        assert key in ours, f"{name}: missing config {key}"
+        assert ours[key] == value, (
+            f"{name}: {key} = {ours[key]!r}, reference {value!r}")
+        checked += 1
+    assert checked > 40
