@@ -333,3 +333,28 @@ def test_generated_deneb_kzg_verifies_library_proof():
     assert not mod.verify_kzg_proof(commitment, z, wrong_y, proof)
     # the generated module's field helpers agree with the library too
     assert int(mod.bytes_to_bls_field(z)) == 7777
+
+
+@pytest.mark.parametrize("fork", ["phase0", "deneb", "electra"])
+def test_generated_constants_sweep_matches_hand_spec(fork):
+    """EVERY int-valued UPPERCASE name shared between the generated
+    module and the hand-written spec must agree — a transcription error
+    in either implementation fails here by name."""
+    from consensus_specs_tpu.compiler.forks import build_fork
+    mod, _src = build_fork("/root/reference/specs", fork, "minimal",
+                           module_name=f"{fork}_const_sweep")
+    spec = get_spec(fork, "minimal")
+    checked = 0
+    for name in dir(mod):
+        if not name.isupper() or name.startswith("_"):
+            continue
+        gen_v = getattr(mod, name)
+        if isinstance(gen_v, bool) or not isinstance(gen_v, int):
+            continue
+        hand_v = getattr(spec, name, None)
+        if hand_v is None or not isinstance(hand_v, int):
+            continue
+        assert int(gen_v) == int(hand_v), \
+            f"{fork}.{name}: generated {int(gen_v)} != hand {int(hand_v)}"
+        checked += 1
+    assert checked > 30, f"only {checked} shared constants compared"
