@@ -3,10 +3,19 @@ test/phase0/block_processing/test_process_attestation.py shape; vector
 format tests/formats/operations)."""
 from ...ssz import uint64
 from ...test_infra.context import (
-    spec_state_test, with_all_phases, with_all_phases_from, always_bls)
+    spec_state_test, with_all_phases, with_all_phases_from,
+    always_bls, never_bls, with_custom_state, with_pytest_fork_subset,
+    with_presets, low_balances)
 from ...test_infra.attestations import (
-    get_valid_attestation, sign_attestation)
-from ...test_infra.blocks import transition_to
+    get_valid_attestation, sign_attestation, sign_aggregate_attestation,
+    compute_max_inclusion_slot, build_attestation_data,
+    get_empty_eip7549_aggregation_bits, get_valid_attestation_at_slot)
+from ...test_infra.blocks import (
+    transition_to, next_epoch_via_block, transition_to_slot_via_block)
+
+# the new deep-coverage cases pytest a representative pre/post-electra
+# pair; conformance vectors still cover every applicable fork
+FORK_PAIR = ["phase0", "electra"]
 
 
 def run_attestation_processing(spec, state, attestation, valid=True):
@@ -19,10 +28,17 @@ def run_attestation_processing(spec, state, attestation, valid=True):
             yield "post", None
             return
         raise AssertionError("attestation unexpectedly valid")
-    current_count = len(getattr(state, "current_epoch_attestations", []))
+    if not spec.is_post("altair"):
+        is_current = (attestation.data.target.epoch
+                      == spec.get_current_epoch(state))
+        pending = (state.current_epoch_attestations if is_current
+                   else state.previous_epoch_attestations)
+        count = len(pending)
     spec.process_attestation(state, attestation)
     if not spec.is_post("altair"):
-        assert len(state.current_epoch_attestations) == current_count + 1
+        pending = (state.current_epoch_attestations if is_current
+                   else state.previous_epoch_attestations)
+        assert len(pending) == count + 1
     yield "post", state
 
 
@@ -103,3 +119,659 @@ def test_partial_committee_attestation(spec, state):
     transition_to(spec, state,
                   state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
     yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+@with_custom_state(low_balances,
+                   threshold_fn=lambda spec: spec.config.EJECTION_BALANCE)
+def test_multi_proposer_index_iterations(spec, state):
+    transition_to(spec, state, state.slot + spec.SLOTS_PER_EPOCH * 2)
+    attestation = get_valid_attestation(spec, state, signed=True)
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_previous_epoch(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_epoch_via_block(spec, state)
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+@always_bls
+def test_invalid_empty_participants_zeroes_sig(spec, state):
+    attestation = get_valid_attestation(
+        spec, state, filter_participant_set=lambda comm: [])
+    attestation.signature = b"\x00" * 96
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+@always_bls
+def test_invalid_empty_participants_seemingly_valid_sig(spec, state):
+    attestation = get_valid_attestation(
+        spec, state, filter_participant_set=lambda comm: [])
+    # the point-at-infinity signature: valid for zero pubkeys on some
+    # BLS implementations, must still be rejected
+    attestation.signature = b"\xc0" + b"\x00" * 95
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_at_max_inclusion_slot(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    transition_to_slot_via_block(
+        spec, state, compute_max_inclusion_slot(spec, attestation))
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_invalid_after_max_inclusion_slot(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    transition_to_slot_via_block(
+        spec, state, compute_max_inclusion_slot(spec, attestation) + 1)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_invalid_old_source_epoch(spec, state):
+    transition_to(spec, state, state.slot + spec.SLOTS_PER_EPOCH * 5)
+    state.finalized_checkpoint.epoch = uint64(2)
+    state.previous_justified_checkpoint.epoch = uint64(3)
+    state.current_justified_checkpoint.epoch = uint64(4)
+    attestation = get_valid_attestation(
+        spec, state, slot=uint64(spec.SLOTS_PER_EPOCH * 3 + 1))
+    # sanity: pointing at the oldest known source epoch...
+    assert attestation.data.source.epoch == \
+        state.previous_justified_checkpoint.epoch
+    # ...then beyond it
+    attestation.data.source.epoch = uint64(
+        int(attestation.data.source.epoch) - 1)
+    sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+@always_bls
+def test_invalid_wrong_index_for_committee_signature(spec, state):
+    attestation = get_valid_attestation(spec, state)
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    if spec.is_post("electra"):
+        # EIP-7549: the committee is selected by committee_bits
+        committee_index = spec.get_committee_indices(
+            attestation.committee_bits)[0]
+        attestation.committee_bits[committee_index] = False
+        attestation.committee_bits[committee_index + 1] = True
+    else:
+        attestation.data.index = uint64(int(attestation.data.index) + 1)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+def reduce_state_committee_count_from_max(spec, state):
+    """Shrink the registry until committees/slot < MAX_COMMITTEES_PER_SLOT."""
+    while spec.get_committee_count_per_slot(
+            state, spec.get_current_epoch(state)) >= \
+            spec.MAX_COMMITTEES_PER_SLOT:
+        state.validators = state.validators[:len(state.validators) // 2]
+        state.balances = state.balances[:len(state.balances) // 2]
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+@never_bls
+def test_invalid_wrong_index_for_slot_0(spec, state):
+    reduce_state_committee_count_from_max(spec, state)
+    attestation = get_valid_attestation(spec, state)
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    # committees per slot is now below the max, so max-1 is out of range
+    index = spec.MAX_COMMITTEES_PER_SLOT - 1
+    if spec.is_post("electra"):
+        for i in range(spec.MAX_COMMITTEES_PER_SLOT):
+            attestation.committee_bits[i] = (i == index)
+    else:
+        attestation.data.index = uint64(index)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+@never_bls
+def test_invalid_wrong_index_for_slot_1(spec, state):
+    reduce_state_committee_count_from_max(spec, state)
+    committee_count = spec.get_committee_count_per_slot(
+        state, spec.get_current_epoch(state))
+    attestation = get_valid_attestation(spec, state, index=0)
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    # off by one: first out-of-range committee index
+    if spec.is_post("electra"):
+        for i in range(spec.MAX_COMMITTEES_PER_SLOT):
+            attestation.committee_bits[i] = (i == committee_count)
+    else:
+        attestation.data.index = uint64(committee_count)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases_from("phase0", to="deneb")
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+@never_bls
+def test_invalid_index(spec, state):
+    """data.index == MAX_COMMITTEES_PER_SLOT: past the valid range.
+    (Electra replaces data.index with committee_bits, whose SSZ shape
+    makes this unrepresentable — covered by the electra module.)"""
+    attestation = get_valid_attestation(spec, state)
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    attestation.data.index = uint64(spec.MAX_COMMITTEES_PER_SLOT)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_invalid_mismatched_target_and_slot(spec, state):
+    next_epoch_via_block(spec, state)
+    next_epoch_via_block(spec, state)
+    attestation = get_valid_attestation(spec, state)
+    attestation.data.slot = uint64(
+        int(attestation.data.slot) - spec.SLOTS_PER_EPOCH)
+    sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_invalid_old_target_epoch(spec, state):
+    assert spec.MIN_ATTESTATION_INCLUSION_DELAY < spec.SLOTS_PER_EPOCH * 2
+    attestation = get_valid_attestation(spec, state, signed=True)
+    # two epochs on: the target epoch is older than the previous epoch
+    transition_to(spec, state, state.slot + spec.SLOTS_PER_EPOCH * 2)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_invalid_future_target_epoch(spec, state):
+    assert spec.MIN_ATTESTATION_INCLUSION_DELAY < spec.SLOTS_PER_EPOCH * 2
+    attestation = get_valid_attestation(spec, state)
+    participants = spec.get_attesting_indices(state, attestation)
+    attestation.data.target.epoch = uint64(
+        int(spec.get_current_epoch(state)) + 1)
+    # sign over the mutated data so only the epoch check can fail
+    attestation.signature = sign_aggregate_attestation(
+        spec, state, attestation.data, participants)
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_invalid_new_source_epoch(spec, state):
+    attestation = get_valid_attestation(spec, state)
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    attestation.data.source.epoch = uint64(
+        int(attestation.data.source.epoch) + 1)
+    sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_invalid_source_root_is_target_root(spec, state):
+    attestation = get_valid_attestation(spec, state)
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    attestation.data.source.root = attestation.data.target.root
+    sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_invalid_current_source_root(spec, state):
+    transition_to(spec, state, state.slot + spec.SLOTS_PER_EPOCH * 5)
+    state.finalized_checkpoint.epoch = uint64(2)
+    state.previous_justified_checkpoint = spec.Checkpoint(
+        epoch=uint64(3), root=b"\x01" * 32)
+    state.current_justified_checkpoint = spec.Checkpoint(
+        epoch=uint64(4), root=b"\x32" * 32)
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    attestation = get_valid_attestation(
+        spec, state, slot=uint64(spec.SLOTS_PER_EPOCH * 5))
+    # sanity: a current-epoch attestation carrying the current source
+    assert attestation.data.target.epoch == spec.get_current_epoch(state)
+    assert state.current_justified_checkpoint.root != \
+        state.previous_justified_checkpoint.root
+    assert attestation.data.source.root == \
+        state.current_justified_checkpoint.root
+    # source root must be the current justified one, not the previous
+    attestation.data.source.root = state.previous_justified_checkpoint.root
+    sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_invalid_previous_source_root(spec, state):
+    transition_to(spec, state, state.slot + spec.SLOTS_PER_EPOCH * 5)
+    state.finalized_checkpoint.epoch = uint64(2)
+    state.previous_justified_checkpoint = spec.Checkpoint(
+        epoch=uint64(3), root=b"\x01" * 32)
+    state.current_justified_checkpoint = spec.Checkpoint(
+        epoch=uint64(4), root=b"\x32" * 32)
+    attestation = get_valid_attestation(
+        spec, state, slot=uint64(spec.SLOTS_PER_EPOCH * 4 + 1))
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    # sanity: a previous-epoch attestation carrying the previous source
+    assert attestation.data.target.epoch == spec.get_previous_epoch(state)
+    assert state.current_justified_checkpoint.root != \
+        state.previous_justified_checkpoint.root
+    assert attestation.data.source.root == \
+        state.previous_justified_checkpoint.root
+    # source root must be the previous justified one, not the current
+    attestation.data.source.root = state.current_justified_checkpoint.root
+    sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_invalid_too_many_aggregation_bits(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    attestation.aggregation_bits.append(False)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_invalid_too_few_aggregation_bits(spec, state):
+    attestation = get_valid_attestation(spec, state)
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    bits_type = type(attestation.aggregation_bits)
+    attestation.aggregation_bits = bits_type(
+        [True] + [False] * (len(attestation.aggregation_bits) - 1))
+    sign_attestation(spec, state, attestation)
+    attestation.aggregation_bits = bits_type(
+        list(attestation.aggregation_bits)[:-1])
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+# -- full correct attestation contents at different inclusion delays -----
+
+def _run_delayed_attestation(spec, state, delay, valid=True,
+                             wrong_head=False, wrong_target=False):
+    attestation = get_valid_attestation(spec, state, signed=False)
+    transition_to(spec, state, state.slot + delay)
+    if wrong_head:
+        attestation.data.beacon_block_root = b"\x42" * 32
+    if wrong_target:
+        attestation.data.target.root = b"\x42" * 32
+    sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=valid)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_correct_attestation_included_at_min_inclusion_delay(spec, state):
+    yield from _run_delayed_attestation(
+        spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_correct_attestation_included_at_sqrt_epoch_delay(spec, state):
+    yield from _run_delayed_attestation(
+        spec, state, spec.integer_squareroot(uint64(spec.SLOTS_PER_EPOCH)))
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_correct_attestation_included_at_one_epoch_delay(spec, state):
+    yield from _run_delayed_attestation(spec, state, spec.SLOTS_PER_EPOCH)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_correct_attestation_included_at_max_inclusion_slot(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    transition_to(spec, state,
+                  compute_max_inclusion_slot(spec, attestation))
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_invalid_correct_attestation_included_after_max_inclusion_slot(
+        spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    transition_to(spec, state,
+                  compute_max_inclusion_slot(spec, attestation) + 1)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+# -- incorrect head, correct source/target -------------------------------
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_incorrect_head_included_at_min_inclusion_delay(spec, state):
+    yield from _run_delayed_attestation(
+        spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY, wrong_head=True)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_incorrect_head_included_at_sqrt_epoch_delay(spec, state):
+    yield from _run_delayed_attestation(
+        spec, state, spec.integer_squareroot(uint64(spec.SLOTS_PER_EPOCH)),
+        wrong_head=True)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_incorrect_head_included_at_max_inclusion_slot(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=False)
+    transition_to(spec, state,
+                  compute_max_inclusion_slot(spec, attestation))
+    attestation.data.beacon_block_root = b"\x42" * 32
+    sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_invalid_incorrect_head_included_after_max_inclusion_slot(
+        spec, state):
+    attestation = get_valid_attestation(spec, state, signed=False)
+    transition_to(spec, state,
+                  compute_max_inclusion_slot(spec, attestation) + 1)
+    attestation.data.beacon_block_root = b"\x42" * 32
+    sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+# -- incorrect head and target, correct source ---------------------------
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_incorrect_head_and_target_min_inclusion_delay(spec, state):
+    yield from _run_delayed_attestation(
+        spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY,
+        wrong_head=True, wrong_target=True)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_incorrect_head_and_target_included_at_sqrt_epoch_delay(spec, state):
+    yield from _run_delayed_attestation(
+        spec, state, spec.integer_squareroot(uint64(spec.SLOTS_PER_EPOCH)),
+        wrong_head=True, wrong_target=True)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_incorrect_head_and_target_included_at_epoch_delay(spec, state):
+    yield from _run_delayed_attestation(
+        spec, state, spec.SLOTS_PER_EPOCH,
+        wrong_head=True, wrong_target=True)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_invalid_incorrect_head_and_target_included_after_max_inclusion_slot(
+        spec, state):
+    attestation = get_valid_attestation(spec, state, signed=False)
+    transition_to(spec, state,
+                  compute_max_inclusion_slot(spec, attestation) + 1)
+    attestation.data.beacon_block_root = b"\x42" * 32
+    attestation.data.target.root = b"\x42" * 32
+    sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+# -- correct head and source, incorrect target ---------------------------
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_incorrect_target_included_at_min_inclusion_delay(spec, state):
+    yield from _run_delayed_attestation(
+        spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY, wrong_target=True)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_incorrect_target_included_at_sqrt_epoch_delay(spec, state):
+    yield from _run_delayed_attestation(
+        spec, state, spec.integer_squareroot(uint64(spec.SLOTS_PER_EPOCH)),
+        wrong_target=True)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_incorrect_target_included_at_epoch_delay(spec, state):
+    yield from _run_delayed_attestation(
+        spec, state, spec.SLOTS_PER_EPOCH, wrong_target=True)
+
+
+@with_all_phases
+@with_pytest_fork_subset(FORK_PAIR)
+@spec_state_test
+def test_invalid_incorrect_target_included_after_max_inclusion_slot(
+        spec, state):
+    attestation = get_valid_attestation(spec, state, signed=False)
+    transition_to(spec, state,
+                  compute_max_inclusion_slot(spec, attestation) + 1)
+    attestation.data.target.root = b"\x42" * 32
+    sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+# -- EIP-7549 committee-bits cases (electra+; reference
+# test/electra/block_processing/test_process_attestation.py) ------------
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_invalid_attestation_data_index_not_zero(spec, state):
+    committee_index = 1
+    attestation = get_valid_attestation(spec, state, index=committee_index)
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    assert committee_index == spec.get_committee_indices(
+        attestation.committee_bits)[0]
+    attestation.data.index = uint64(committee_index)
+    sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@always_bls
+def test_invalid_committee_index(spec, state):
+    committee_index = 0
+    attestation = get_valid_attestation(spec, state, index=committee_index,
+                                        signed=True)
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    assert attestation.committee_bits[committee_index]
+    attestation.committee_bits[committee_index] = False
+    attestation.committee_bits[committee_index + 1] = True
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_invalid_too_many_committee_bits(spec, state):
+    attestation = get_valid_attestation(spec, state, index=0, signed=True)
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    attestation.committee_bits[1] = True
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_invalid_nonset_committee_bits(spec, state):
+    attestation = get_valid_attestation(spec, state, index=0, signed=True)
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    attestation.committee_bits[0] = False
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@with_presets(["minimal"], "need multiple committees per slot")
+def test_invalid_nonset_multiple_committee_bits(spec, state):
+    attestation_data = build_attestation_data(spec, state, state.slot, 0)
+    attestation = spec.Attestation(data=attestation_data)
+    committees_per_slot = spec.get_committee_count_per_slot(
+        state, spec.get_current_epoch(state))
+    for index in range(committees_per_slot):
+        attestation.committee_bits[index] = True
+    attestation.aggregation_bits = get_empty_eip7549_aggregation_bits(
+        spec, state, attestation.committee_bits, attestation.data.slot)
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@with_presets(["minimal"], "need multiple committees per slot")
+@always_bls
+def test_multiple_committees(spec, state):
+    # one on-chain aggregate spanning every committee of the slot
+    attestation = get_valid_attestation_at_slot(state, spec, state.slot)
+    attesting_indices = set()
+    committees_per_slot = spec.get_committee_count_per_slot(
+        state, spec.get_current_epoch(state))
+    for index in range(committees_per_slot):
+        attesting_indices.update(
+            spec.get_beacon_committee(state, state.slot, index))
+    assert spec.get_attesting_indices(state, attestation) == \
+        attesting_indices
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@with_presets(["minimal"], "need multiple committees per slot")
+@always_bls
+def test_one_committee_with_gap(spec, state):
+    attestation = get_valid_attestation(spec, state, index=1, signed=True)
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@with_presets(["minimal"], "need multiple committees per slot")
+def test_invalid_nonset_bits_for_one_committee(spec, state):
+    committee_0 = spec.get_beacon_committee(state, state.slot, 0)
+    attestation_1 = get_valid_attestation(spec, state, index=1, signed=True)
+    # on-chain aggregate claiming committees {0,1} but with committee 0's
+    # aggregation bits all unset
+    aggregate = spec.Attestation(data=attestation_1.data,
+                                 signature=attestation_1.signature)
+    aggregate.committee_bits[0] = True
+    aggregate.committee_bits[1] = True
+    aggregate.aggregation_bits = get_empty_eip7549_aggregation_bits(
+        spec, state, aggregate.committee_bits, aggregate.data.slot)
+    committee_offset = len(committee_0)
+    for i in range(len(attestation_1.aggregation_bits)):
+        aggregate.aggregation_bits[committee_offset + i] = \
+            attestation_1.aggregation_bits[i]
+    assert spec.get_attesting_indices(state, aggregate) == \
+        spec.get_attesting_indices(state, attestation_1)
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, aggregate,
+                                          valid=False)

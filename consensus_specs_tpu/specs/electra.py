@@ -291,6 +291,29 @@ class ElectraSpec(DenebSpec):
             committee_offset += len(committee)
         return output
 
+    def compute_on_chain_aggregate(self, network_aggregates):
+        """Densely pack same-data aggregates from distinct committees
+        into one on-chain Attestation (electra/validator.md:118)."""
+        from ..utils import bls
+        aggregates = sorted(
+            network_aggregates,
+            key=lambda a: self.get_committee_indices(a.committee_bits)[0])
+        data = aggregates[0].data
+        aggregation_bits = []
+        for a in aggregates:
+            aggregation_bits.extend(a.aggregation_bits)
+        signature = bls.Aggregate([bytes(a.signature) for a in aggregates])
+        committee_indices = [
+            self.get_committee_indices(a.committee_bits)[0]
+            for a in aggregates]
+        committee_flags = [(index in committee_indices)
+                           for index in range(self.MAX_COMMITTEES_PER_SLOT)]
+        return self.Attestation(
+            aggregation_bits=aggregation_bits,
+            data=data,
+            committee_bits=committee_flags,
+            signature=signature)
+
     def get_next_sync_committee_indices(self, state):
         """16-bit random filter (electra/beacon-chain.md:626)."""
         epoch = uint64(self.get_current_epoch(state) + 1)

@@ -101,6 +101,55 @@ def get_valid_attestation(spec, state, slot=None, index=None,
     return attestation
 
 
+def get_empty_eip7549_aggregation_bits(spec, state, committee_bits, slot):
+    """All-zero aggregation bits sized for the committees selected by
+    `committee_bits` (reference helpers/attestations.py:436)."""
+    participants_count = 0
+    for index in spec.get_committee_indices(committee_bits):
+        participants_count += len(
+            spec.get_beacon_committee(state, slot, index))
+    att_type = spec.Attestation
+    bits_type = att_type._field_types[
+        att_type._field_names.index("aggregation_bits")]
+    return bits_type([False] * participants_count)
+
+
+def get_valid_attestations_at_slot(state, spec, slot_to_attest,
+                                   participation_fn=None):
+    """One signed single-committee attestation per committee of the slot."""
+    epoch = spec.compute_epoch_at_slot(slot_to_attest)
+    committees_per_slot = spec.get_committee_count_per_slot(state, epoch)
+    for index in range(committees_per_slot):
+        def participants_filter(comm):
+            if participation_fn is None:
+                return comm
+            return participation_fn(slot_to_attest, index, comm)
+        yield get_valid_attestation(
+            spec, state, slot_to_attest, index=index,
+            filter_participant_set=participants_filter, signed=True)
+
+
+def get_valid_attestation_at_slot(state, spec, slot_to_attest,
+                                  participation_fn=None):
+    """Post-electra on-chain aggregate spanning every committee of the
+    slot (reference helpers/attestations.py:228)."""
+    assert spec.is_post("electra")
+    attestations = list(get_valid_attestations_at_slot(
+        state, spec, slot_to_attest, participation_fn=participation_fn))
+    assert attestations, "no valid attestations found"
+    return spec.compute_on_chain_aggregate(attestations)
+
+
+def compute_max_inclusion_slot(spec, attestation):
+    """Latest slot the attestation may be included at (reference
+    helpers/attestations.py:152): EIP-7045 (deneb) extends inclusion to
+    the end of the epoch after the attestation's."""
+    if spec.is_post("deneb"):
+        next_epoch = spec.compute_epoch_at_slot(attestation.data.slot) + 1
+        return spec.compute_start_slot_at_epoch(uint64(next_epoch + 1)) - 1
+    return attestation.data.slot + spec.SLOTS_PER_EPOCH
+
+
 def add_attestations_to_state(spec, state, attestations, slot) -> None:
     from .blocks import transition_to
     transition_to(spec, state, slot)

@@ -131,3 +131,20 @@ def apply_empty_block(spec, state, slot=None):
         slot = uint64(state.slot + 1)
     block = build_empty_block(spec, state, slot)
     return state_transition_and_sign_block(spec, state, block)
+
+
+def transition_to_slot_via_block(spec, state, slot):
+    """Advance to `slot` by applying one empty block there (reference
+    helpers/state.py:36)."""
+    assert state.slot < slot
+    apply_empty_block(spec, state, uint64(slot))
+    assert state.slot == slot
+
+
+def next_epoch_via_block(spec, state):
+    """Advance to the start of the next epoch via an empty block
+    (reference helpers/state.py:71)."""
+    return apply_empty_block(
+        spec, state,
+        uint64(state.slot + spec.SLOTS_PER_EPOCH
+               - state.slot % spec.SLOTS_PER_EPOCH))

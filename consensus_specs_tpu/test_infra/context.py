@@ -51,7 +51,9 @@ from .genesis import default_balances  # noqa: E402 (single source of truth)
 
 
 def low_balances(spec):
-    low = spec.MAX_EFFECTIVE_BALANCE // 8
+    # low but above EJECTION_BALANCE, so validators stay active
+    # (reference context.py low_balances: 18 ETH)
+    low = 18 * 10**9
     return [low] * (spec.SLOTS_PER_EPOCH * 8)
 
 
