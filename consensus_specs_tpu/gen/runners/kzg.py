@@ -101,8 +101,15 @@ def providers():
             "compute_kzg_proof", "proof_invalid_z",
             {"input": {"blob": _hex(blob_a), "z": _hex(bad_z)},
              "output": None})
-        # corrupt commitment (not on curve / wrong flag bits)
+        # corrupt commitment (not on curve / wrong flag bits) — prove the
+        # library actually rejects it before emitting the must-reject case
         bad_commitment = b"\x12" + bytes(commitment_a)[1:]
+        try:
+            kzg.verify_blob_kzg_proof(blob_a, bad_commitment, proof_a)
+        except (AssertionError, ValueError):
+            pass
+        else:
+            raise RuntimeError("corrupt commitment accepted")
         yield _yaml_case(
             "verify_blob_kzg_proof", "blob_verify_bad_commitment",
             {"input": {"blob": _hex(blob_a),

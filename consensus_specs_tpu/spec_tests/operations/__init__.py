@@ -19,6 +19,11 @@ OPERATION_HANDLERS = {
         "test_bls_to_execution_change",
     "execution_payload":
         "consensus_specs_tpu.spec_tests.operations.test_execution_payload",
-    "execution_requests":
-        "consensus_specs_tpu.spec_tests.operations.test_execution_requests",
+    "withdrawal_request":
+        "consensus_specs_tpu.spec_tests.operations.test_withdrawal_request",
+    "deposit_request":
+        "consensus_specs_tpu.spec_tests.operations.test_deposit_request",
+    "consolidation_request":
+        "consensus_specs_tpu.spec_tests.operations."
+        "test_consolidation_request",
 }

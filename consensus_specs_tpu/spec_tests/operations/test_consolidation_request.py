@@ -1,0 +1,412 @@
+"""EIP-7251 EL-triggered consolidation request operation tests
+(electra+).
+
+Reference battery:
+test/electra/block_processing/test_process_consolidation_request.py (32
+cases).  Covers the consolidation path, the same-pubkey
+switch-to-compounding path, and the no-fault ignored conditions for
+both.
+"""
+from ...ssz import uint64
+from ...test_infra.context import (
+    spec_state_test, with_all_phases_from, with_presets)
+from ...test_infra.keys import pubkeys
+from ...test_infra.withdrawals import (
+    set_eth1_withdrawal_credentials,
+    set_compounding_withdrawal_credentials)
+from ...test_infra.electra_requests import (
+    DEFAULT_ADDRESS, WRONG_ADDRESS, age_past_exit_gate, scale_churn,
+    run_request_processing, make_exited, make_inactive,
+    add_pending_partial_withdrawal)
+
+
+def _stage(spec, state, source=0, target=1, source_compounding=False):
+    """Eligible source (eth1 or compounding creds, aged) + compounding
+    target + churn headroom."""
+    age_past_exit_gate(spec, state)
+    if source_compounding:
+        set_compounding_withdrawal_credentials(spec, state, source,
+                                               address=DEFAULT_ADDRESS)
+    else:
+        set_eth1_withdrawal_credentials(spec, state, source,
+                                        address=DEFAULT_ADDRESS)
+    set_compounding_withdrawal_credentials(spec, state, target)
+    scale_churn(spec, state)
+
+
+def _request(spec, state, source=0, target=1, address=DEFAULT_ADDRESS):
+    return spec.ConsolidationRequest(
+        source_address=address,
+        source_pubkey=state.validators[source].pubkey,
+        target_pubkey=state.validators[target].pubkey)
+
+
+def _switch_request(spec, state, index, address=DEFAULT_ADDRESS):
+    return _request(spec, state, index, index, address)
+
+
+# ---------------------------------------------------------------------------
+# successful consolidations
+# ---------------------------------------------------------------------------
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_basic_consolidation(spec, state):
+    _stage(spec, state)
+    yield from run_request_processing(
+        spec, state, "consolidation_request", _request(spec, state))
+    assert len(state.pending_consolidations) == 1
+    pc = state.pending_consolidations[0]
+    assert (int(pc.source_index), int(pc.target_index)) == (0, 1)
+    assert state.validators[0].exit_epoch != spec.FAR_FUTURE_EPOCH
+    assert int(state.validators[0].withdrawable_epoch) == (
+        int(state.validators[0].exit_epoch)
+        + int(spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY))
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_basic_consolidation_with_compounding_source(spec, state):
+    _stage(spec, state, source_compounding=True)
+    yield from run_request_processing(
+        spec, state, "consolidation_request", _request(spec, state))
+    assert len(state.pending_consolidations) == 1
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_basic_consolidation_with_excess_target_balance(spec, state):
+    _stage(spec, state)
+    state.balances[1] = uint64(
+        int(state.balances[1]) + int(spec.EFFECTIVE_BALANCE_INCREMENT))
+    yield from run_request_processing(
+        spec, state, "consolidation_request", _request(spec, state))
+    assert len(state.pending_consolidations) == 1
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_basic_consolidation_with_preexisting_churn(spec, state):
+    _stage(spec, state)
+    # partially-consumed churn in the current consolidation epoch
+    state.consolidation_balance_to_consume = uint64(
+        int(spec.get_consolidation_churn_limit(state)) // 2)
+    state.earliest_consolidation_epoch = uint64(
+        int(spec.get_current_epoch(state)) + 1
+        + int(spec.MAX_SEED_LOOKAHEAD))
+    yield from run_request_processing(
+        spec, state, "consolidation_request", _request(spec, state))
+    assert len(state.pending_consolidations) == 1
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_consolidation_balance_larger_than_churn_limit(spec, state):
+    # source effective balance above the per-epoch churn: exit epoch is
+    # pushed past the earliest consolidation epoch
+    _stage(spec, state)
+    churn = int(spec.get_consolidation_churn_limit(state))
+    state.validators[0].effective_balance = uint64(churn * 2)
+    state.balances[0] = uint64(churn * 2)
+    yield from run_request_processing(
+        spec, state, "consolidation_request", _request(spec, state))
+    assert len(state.pending_consolidations) == 1
+    assert int(state.validators[0].exit_epoch) > int(
+        spec.compute_activation_exit_epoch(
+            spec.get_current_epoch(state)))
+
+
+# ---------------------------------------------------------------------------
+# switch-to-compounding (same pubkey)
+# ---------------------------------------------------------------------------
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_basic_switch_to_compounding(spec, state):
+    age_past_exit_gate(spec, state)
+    set_eth1_withdrawal_credentials(spec, state, 0,
+                                    address=DEFAULT_ADDRESS)
+    yield from run_request_processing(
+        spec, state, "consolidation_request",
+        _switch_request(spec, state, 0))
+    creds = bytes(state.validators[0].withdrawal_credentials)
+    assert creds[:1] == bytes(spec.COMPOUNDING_WITHDRAWAL_PREFIX)
+    # a switch is not a consolidation: nothing queued, no exit
+    assert len(state.pending_consolidations) == 0
+    assert state.validators[0].exit_epoch == spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_switch_to_compounding_with_excess_balance(spec, state):
+    age_past_exit_gate(spec, state)
+    set_eth1_withdrawal_credentials(spec, state, 0,
+                                    address=DEFAULT_ADDRESS)
+    state.balances[0] = uint64(
+        int(spec.MIN_ACTIVATION_BALANCE)
+        + int(spec.EFFECTIVE_BALANCE_INCREMENT))
+    yield from run_request_processing(
+        spec, state, "consolidation_request",
+        _switch_request(spec, state, 0))
+    # the excess over MIN_ACTIVATION_BALANCE is queued as a deposit
+    assert len(state.pending_deposits) == 1
+    assert int(state.pending_deposits[0].amount) == \
+        int(spec.EFFECTIVE_BALANCE_INCREMENT)
+
+
+@with_all_phases_from("electra")
+@with_presets(["minimal"], "filling the queue is preset-sized")
+@spec_state_test
+def test_switch_to_compounding_with_pending_consolidations_at_limit(
+        spec, state):
+    # the pending-consolidations limit does not gate the switch path
+    age_past_exit_gate(spec, state)
+    set_eth1_withdrawal_credentials(spec, state, 0,
+                                    address=DEFAULT_ADDRESS)
+    limit = int(spec.PENDING_CONSOLIDATIONS_LIMIT)
+    for _ in range(limit):
+        state.pending_consolidations.append(
+            spec.PendingConsolidation(source_index=2, target_index=3))
+    yield from run_request_processing(
+        spec, state, "consolidation_request",
+        _switch_request(spec, state, 0))
+    creds = bytes(state.validators[0].withdrawal_credentials)
+    assert creds[:1] == bytes(spec.COMPOUNDING_WITHDRAWAL_PREFIX)
+
+
+# ---------------------------------------------------------------------------
+# ignored consolidations
+# ---------------------------------------------------------------------------
+
+@with_all_phases_from("electra")
+@with_presets(["minimal"], "filling the queue is preset-sized")
+@spec_state_test
+def test_incorrect_exceed_pending_consolidations_limit(spec, state):
+    _stage(spec, state)
+    limit = int(spec.PENDING_CONSOLIDATIONS_LIMIT)
+    for _ in range(limit):
+        state.pending_consolidations.append(
+            spec.PendingConsolidation(source_index=2, target_index=3))
+    yield from run_request_processing(
+        spec, state, "consolidation_request", _request(spec, state),
+        mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_incorrect_not_enough_consolidation_churn_available(spec, state):
+    # unscaled registry: churn limit <= MIN_ACTIVATION_BALANCE
+    age_past_exit_gate(spec, state)
+    set_eth1_withdrawal_credentials(spec, state, 0,
+                                    address=DEFAULT_ADDRESS)
+    set_compounding_withdrawal_credentials(spec, state, 1)
+    assert int(spec.get_consolidation_churn_limit(state)) <= \
+        int(spec.MIN_ACTIVATION_BALANCE)
+    yield from run_request_processing(
+        spec, state, "consolidation_request", _request(spec, state),
+        mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_incorrect_exited_source(spec, state):
+    _stage(spec, state)
+    make_exited(spec, state, 0)
+    yield from run_request_processing(
+        spec, state, "consolidation_request", _request(spec, state),
+        mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_incorrect_exited_target(spec, state):
+    _stage(spec, state)
+    make_exited(spec, state, 1)
+    yield from run_request_processing(
+        spec, state, "consolidation_request", _request(spec, state),
+        mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_incorrect_inactive_source(spec, state):
+    _stage(spec, state)
+    make_inactive(spec, state, 0)
+    yield from run_request_processing(
+        spec, state, "consolidation_request", _request(spec, state),
+        mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_incorrect_inactive_target(spec, state):
+    _stage(spec, state)
+    make_inactive(spec, state, 1)
+    yield from run_request_processing(
+        spec, state, "consolidation_request", _request(spec, state),
+        mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_incorrect_no_source_execution_withdrawal_credential(spec, state):
+    # source keeps default 0x00 BLS credentials
+    age_past_exit_gate(spec, state)
+    set_compounding_withdrawal_credentials(spec, state, 1)
+    scale_churn(spec, state)
+    yield from run_request_processing(
+        spec, state, "consolidation_request", _request(spec, state),
+        mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_incorrect_target_with_bls_credential(spec, state):
+    age_past_exit_gate(spec, state)
+    set_eth1_withdrawal_credentials(spec, state, 0,
+                                    address=DEFAULT_ADDRESS)
+    scale_churn(spec, state)
+    yield from run_request_processing(
+        spec, state, "consolidation_request", _request(spec, state),
+        mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_incorrect_target_with_eth1_credential(spec, state):
+    _stage(spec, state)
+    set_eth1_withdrawal_credentials(spec, state, 1)
+    yield from run_request_processing(
+        spec, state, "consolidation_request", _request(spec, state),
+        mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_incorrect_source_address(spec, state):
+    _stage(spec, state)
+    yield from run_request_processing(
+        spec, state, "consolidation_request",
+        _request(spec, state, address=WRONG_ADDRESS), mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_incorrect_unknown_source_pubkey(spec, state):
+    _stage(spec, state)
+    request = spec.ConsolidationRequest(
+        source_address=DEFAULT_ADDRESS,
+        source_pubkey=pubkeys[len(state.validators) + 3],
+        target_pubkey=state.validators[1].pubkey)
+    yield from run_request_processing(
+        spec, state, "consolidation_request", request, mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_incorrect_unknown_target_pubkey(spec, state):
+    _stage(spec, state)
+    request = spec.ConsolidationRequest(
+        source_address=DEFAULT_ADDRESS,
+        source_pubkey=state.validators[0].pubkey,
+        target_pubkey=pubkeys[len(state.validators) + 3])
+    yield from run_request_processing(
+        spec, state, "consolidation_request", request, mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_incorrect_source_has_pending_withdrawal(spec, state):
+    _stage(spec, state)
+    add_pending_partial_withdrawal(spec, state, 0)
+    yield from run_request_processing(
+        spec, state, "consolidation_request", _request(spec, state),
+        mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_incorrect_source_not_active_long_enough(spec, state):
+    # no aging: activation + SHARD_COMMITTEE_PERIOD gate fails
+    set_eth1_withdrawal_credentials(spec, state, 0,
+                                    address=DEFAULT_ADDRESS)
+    set_compounding_withdrawal_credentials(spec, state, 1)
+    scale_churn(spec, state)
+    yield from run_request_processing(
+        spec, state, "consolidation_request", _request(spec, state),
+        mutates=False)
+
+
+# ---------------------------------------------------------------------------
+# ignored switch-to-compounding
+# ---------------------------------------------------------------------------
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_switch_to_compounding_exited_source_ignored(spec, state):
+    age_past_exit_gate(spec, state)
+    set_eth1_withdrawal_credentials(spec, state, 0,
+                                    address=DEFAULT_ADDRESS)
+    make_exited(spec, state, 0)
+    yield from run_request_processing(
+        spec, state, "consolidation_request",
+        _switch_request(spec, state, 0), mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_switch_to_compounding_inactive_source_ignored(spec, state):
+    age_past_exit_gate(spec, state)
+    set_eth1_withdrawal_credentials(spec, state, 0,
+                                    address=DEFAULT_ADDRESS)
+    make_inactive(spec, state, 0)
+    yield from run_request_processing(
+        spec, state, "consolidation_request",
+        _switch_request(spec, state, 0), mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_switch_to_compounding_source_bls_credential_ignored(spec, state):
+    # 0x00 source credentials: neither a valid switch nor (same-pubkey)
+    # a valid consolidation
+    age_past_exit_gate(spec, state)
+    yield from run_request_processing(
+        spec, state, "consolidation_request",
+        _switch_request(spec, state, 0), mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_switch_to_compounding_already_compounding_ignored(spec, state):
+    age_past_exit_gate(spec, state)
+    set_compounding_withdrawal_credentials(spec, state, 0,
+                                           address=DEFAULT_ADDRESS)
+    yield from run_request_processing(
+        spec, state, "consolidation_request",
+        _switch_request(spec, state, 0), mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_switch_to_compounding_not_authorized_ignored(spec, state):
+    age_past_exit_gate(spec, state)
+    set_eth1_withdrawal_credentials(spec, state, 0,
+                                    address=DEFAULT_ADDRESS)
+    yield from run_request_processing(
+        spec, state, "consolidation_request",
+        _switch_request(spec, state, 0, address=WRONG_ADDRESS),
+        mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_switch_to_compounding_unknown_source_pubkey_ignored(spec, state):
+    age_past_exit_gate(spec, state)
+    unknown = pubkeys[len(state.validators) + 3]
+    request = spec.ConsolidationRequest(
+        source_address=DEFAULT_ADDRESS,
+        source_pubkey=unknown,
+        target_pubkey=unknown)
+    yield from run_request_processing(
+        spec, state, "consolidation_request", request, mutates=False)

@@ -1,0 +1,138 @@
+"""EIP-6110 EL-triggered deposit request operation tests (electra+).
+
+Reference battery:
+test/electra/block_processing/test_process_deposit_request.py (8
+cases).  process_deposit_request only queues a PendingDeposit (signature
+validity is judged later by process_pending_deposits), so every case
+mutates the state.
+"""
+from ...ssz import uint64
+from ...test_infra.context import spec_state_test, with_all_phases_from
+from ...test_infra.keys import pubkeys, privkeys
+from ...test_infra.deposits import build_deposit_data
+from ...test_infra.electra_requests import run_request_processing
+
+
+def _signed_request(spec, state, validator_index, amount,
+                    withdrawal_credentials, index=0, valid_sig=True):
+    pubkey = pubkeys[validator_index]
+    data = build_deposit_data(
+        spec, pubkey, privkeys[validator_index], amount,
+        withdrawal_credentials, signed=valid_sig)
+    if not valid_sig:
+        data.signature = b"\x11" + b"\x00" * 95
+    return spec.DepositRequest(
+        pubkey=pubkey,
+        withdrawal_credentials=withdrawal_credentials,
+        amount=uint64(amount),
+        signature=data.signature,
+        index=uint64(index))
+
+
+def _run(spec, state, request):
+    yield from run_request_processing(
+        spec, state, "deposit_request", request)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_deposit_request_min_activation(spec, state):
+    fresh = len(state.validators)
+    request = _signed_request(
+        spec, state, fresh, int(spec.MIN_ACTIVATION_BALANCE),
+        b"\x01" + b"\x00" * 31)
+    yield from _run(spec, state, request)
+    assert len(state.pending_deposits) == 1
+    assert state.pending_deposits[0].amount == \
+        spec.MIN_ACTIVATION_BALANCE
+    assert state.pending_deposits[0].slot == state.slot
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_deposit_request_max_effective_balance_compounding(spec, state):
+    fresh = len(state.validators)
+    request = _signed_request(
+        spec, state, fresh, int(spec.MAX_EFFECTIVE_BALANCE_ELECTRA),
+        bytes(spec.COMPOUNDING_WITHDRAWAL_PREFIX) + b"\x00" * 11
+        + b"\xaa" * 20)
+    yield from _run(spec, state, request)
+    assert int(state.pending_deposits[0].amount) == \
+        int(spec.MAX_EFFECTIVE_BALANCE_ELECTRA)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_deposit_request_top_up(spec, state):
+    # deposit for an already-registered pubkey queues a top-up
+    amount = int(spec.MIN_ACTIVATION_BALANCE) // 4
+    request = _signed_request(
+        spec, state, 0, amount, b"\x01" + b"\x00" * 31)
+    yield from _run(spec, state, request)
+    assert len(state.pending_deposits) == 1
+    assert int(state.pending_deposits[0].amount) == amount
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_deposit_request_top_up_compounding(spec, state):
+    amount = int(spec.MIN_ACTIVATION_BALANCE) // 4
+    request = _signed_request(
+        spec, state, 0, amount,
+        bytes(spec.COMPOUNDING_WITHDRAWAL_PREFIX) + b"\x00" * 11
+        + b"\xaa" * 20)
+    yield from _run(spec, state, request)
+    assert len(state.pending_deposits) == 1
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_deposit_request_invalid_sig(spec, state):
+    # still queued — the signature is judged at apply time
+    fresh = len(state.validators)
+    request = _signed_request(
+        spec, state, fresh, int(spec.MIN_ACTIVATION_BALANCE),
+        b"\x01" + b"\x00" * 31, valid_sig=False)
+    yield from _run(spec, state, request)
+    assert len(state.pending_deposits) == 1
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_deposit_request_top_up_invalid_sig(spec, state):
+    amount = int(spec.MIN_ACTIVATION_BALANCE) // 4
+    request = _signed_request(
+        spec, state, 0, amount, b"\x01" + b"\x00" * 31,
+        valid_sig=False)
+    yield from _run(spec, state, request)
+    assert len(state.pending_deposits) == 1
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_deposit_request_set_start_index(spec, state):
+    fresh = len(state.validators)
+    request = _signed_request(
+        spec, state, fresh, int(spec.MIN_ACTIVATION_BALANCE),
+        b"\x01" + b"\x00" * 31, index=5)
+    assert state.deposit_requests_start_index == \
+        spec.UNSET_DEPOSIT_REQUESTS_START_INDEX
+    yield from _run(spec, state, request)
+    assert state.deposit_requests_start_index == uint64(5)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_deposit_request_set_start_index_only_once(spec, state):
+    fresh = len(state.validators)
+    first = _signed_request(
+        spec, state, fresh, int(spec.MIN_ACTIVATION_BALANCE),
+        b"\x01" + b"\x00" * 31, index=5)
+    second = _signed_request(
+        spec, state, fresh, int(spec.MIN_ACTIVATION_BALANCE),
+        b"\x01" + b"\x00" * 31, index=9)
+    spec.process_deposit_request(state, first)
+    assert state.deposit_requests_start_index == uint64(5)
+    yield from _run(spec, state, second)
+    assert state.deposit_requests_start_index == uint64(5)
+    assert len(state.pending_deposits) == 2

@@ -1,11 +1,34 @@
 """process_execution_payload operation tests (bellatrix+; reference:
 test/bellatrix/block_processing/test_process_execution_payload.py
-shape).  The noop engine answers True, so the consensus-side asserts
-(parent hash, randao, timestamp, blob commitment limits) are under
-test."""
+shape).
+
+Vector format follows the reference operations format
+(tests/formats/operations/README.md): the input is the full
+``BeaconBlockBody`` yielded as ``body`` (deneb+ blob commitments live in
+the body, so a payload-only input would be unrepresentable), plus an
+``execution.yaml`` ``{execution_valid: bool}`` telling the consumer what
+the mocked execution engine answered (the reference generator also
+writes ``name + '.yaml'`` — gen_runner.py:382 — despite the format
+README calling it execution.yml).
+"""
 from ...ssz import uint64
 from ...test_infra.context import spec_state_test, with_all_phases_from
 from ...test_infra.blocks import build_empty_execution_payload
+
+
+class _MockExecutionEngine:
+    """Engine double answering a fixed verdict (reference mocks the
+    engine the same way to test the ``execution_valid=False`` path)."""
+
+    def __init__(self, inner, valid: bool):
+        self._inner = inner
+        self._valid = valid
+
+    def verify_and_notify_new_payload(self, new_payload_request) -> bool:
+        return self._valid
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
 
 
 def _body_for(spec, payload, commitments=None):
@@ -16,21 +39,21 @@ def _body_for(spec, payload, commitments=None):
     return body
 
 
-def _run(spec, state, payload, valid=True, commitments=None):
-    # bellatrix's process_execution_payload takes the body (deneb needs
-    # the commitments); emit the payload for the vector
+def _run(spec, state, payload, valid=True, commitments=None,
+         execution_valid=True):
     body = _body_for(spec, payload, commitments)
     yield "pre", state.copy()
-    yield "execution_payload", payload
-    if not valid:
+    yield "execution", "cfg", {"execution_valid": execution_valid}
+    yield "body", body
+    engine = _MockExecutionEngine(spec.EXECUTION_ENGINE, execution_valid)
+    if not (valid and execution_valid):
         try:
-            spec.process_execution_payload(state, body,
-                                           spec.EXECUTION_ENGINE)
+            spec.process_execution_payload(state, body, engine)
         except (AssertionError, ValueError, IndexError):
             yield "post", None
             return
         raise AssertionError("payload unexpectedly valid")
-    spec.process_execution_payload(state, body, spec.EXECUTION_ENGINE)
+    spec.process_execution_payload(state, body, engine)
     yield "post", state
 
 
@@ -41,6 +64,14 @@ def test_success_empty_payload(spec, state):
     yield from _run(spec, state, payload)
     assert state.latest_execution_payload_header.block_hash == \
         payload.block_hash
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+def test_invalid_execution_engine_verdict(spec, state):
+    # consensus-side checks all pass; the (mocked) engine rejects
+    payload = build_empty_execution_payload(spec, state)
+    yield from _run(spec, state, payload, execution_valid=False)
 
 
 @with_all_phases_from("bellatrix")

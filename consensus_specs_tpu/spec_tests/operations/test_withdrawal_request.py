@@ -1,0 +1,358 @@
+"""EIP-7002 EL-triggered withdrawal request operation tests (electra+).
+
+Reference battery:
+test/electra/block_processing/test_process_withdrawal_request.py (29
+cases).  Request processing is no-fault — malformed/ineligible requests
+are ignored, so "ignored" cases assert the state root is untouched.
+"""
+from ...ssz import uint64
+from ...test_infra.context import (
+    spec_state_test, with_all_phases_from, with_presets)
+from ...test_infra.keys import pubkeys
+from ...test_infra.withdrawals import (
+    set_eth1_withdrawal_credentials,
+    set_compounding_withdrawal_credentials)
+from ...test_infra.electra_requests import (
+    DEFAULT_ADDRESS, WRONG_ADDRESS, age_past_exit_gate,
+    run_request_processing, make_inactive,
+    add_pending_partial_withdrawal)
+
+
+def _full_exit_request(spec, state, index, address=DEFAULT_ADDRESS):
+    return spec.WithdrawalRequest(
+        source_address=address,
+        validator_pubkey=state.validators[index].pubkey,
+        amount=spec.FULL_EXIT_REQUEST_AMOUNT)
+
+
+def _partial_request(spec, state, index, amount, address=DEFAULT_ADDRESS):
+    return spec.WithdrawalRequest(
+        source_address=address,
+        validator_pubkey=state.validators[index].pubkey,
+        amount=uint64(amount))
+
+
+def _stage_partial(spec, state, index, excess):
+    """Compounding validator at MIN_ACTIVATION_BALANCE effective with
+    `excess` Gwei on top — the partial-withdrawal sweet spot."""
+    set_compounding_withdrawal_credentials(spec, state, index,
+                                           address=DEFAULT_ADDRESS)
+    state.validators[index].effective_balance = \
+        spec.MIN_ACTIVATION_BALANCE
+    state.balances[index] = uint64(
+        int(spec.MIN_ACTIVATION_BALANCE) + excess)
+
+
+# ---------------------------------------------------------------------------
+# full exits
+# ---------------------------------------------------------------------------
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_basic_full_exit(spec, state):
+    age_past_exit_gate(spec, state)
+    set_eth1_withdrawal_credentials(spec, state, 1,
+                                    address=DEFAULT_ADDRESS)
+    request = _full_exit_request(spec, state, 1)
+    yield from run_request_processing(
+        spec, state, "withdrawal_request", request)
+    assert state.validators[1].exit_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_basic_full_exit_first_validator(spec, state):
+    age_past_exit_gate(spec, state)
+    set_eth1_withdrawal_credentials(spec, state, 0,
+                                    address=DEFAULT_ADDRESS)
+    request = _full_exit_request(spec, state, 0)
+    yield from run_request_processing(
+        spec, state, "withdrawal_request", request)
+    assert state.validators[0].exit_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_full_exit_with_compounding_credentials(spec, state):
+    age_past_exit_gate(spec, state)
+    set_compounding_withdrawal_credentials(spec, state, 0,
+                                           address=DEFAULT_ADDRESS)
+    request = _full_exit_request(spec, state, 0)
+    yield from run_request_processing(
+        spec, state, "withdrawal_request", request)
+    assert state.validators[0].exit_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases_from("electra")
+@with_presets(["minimal"], "filling the queue is preset-sized")
+@spec_state_test
+def test_full_exit_with_full_partial_withdrawal_queue(spec, state):
+    # the queue-limit early-out only applies to partial requests; a full
+    # exit goes through even with the queue at its limit
+    age_past_exit_gate(spec, state)
+    set_eth1_withdrawal_credentials(spec, state, 0,
+                                    address=DEFAULT_ADDRESS)
+    limit = int(spec.PENDING_PARTIAL_WITHDRAWALS_LIMIT)
+    for _ in range(limit):
+        add_pending_partial_withdrawal(spec, state, 1)
+    request = _full_exit_request(spec, state, 0)
+    yield from run_request_processing(
+        spec, state, "withdrawal_request", request)
+    assert state.validators[0].exit_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_incorrect_source_address_ignored(spec, state):
+    age_past_exit_gate(spec, state)
+    set_eth1_withdrawal_credentials(spec, state, 0,
+                                    address=DEFAULT_ADDRESS)
+    request = _full_exit_request(spec, state, 0, address=WRONG_ADDRESS)
+    yield from run_request_processing(
+        spec, state, "withdrawal_request", request, mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_incorrect_credential_prefix_ignored(spec, state):
+    # 0x00 BLS credentials are not execution credentials
+    age_past_exit_gate(spec, state)
+    request = _full_exit_request(spec, state, 0)
+    yield from run_request_processing(
+        spec, state, "withdrawal_request", request, mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_on_exit_initiated_validator_ignored(spec, state):
+    age_past_exit_gate(spec, state)
+    set_eth1_withdrawal_credentials(spec, state, 0,
+                                    address=DEFAULT_ADDRESS)
+    spec.initiate_validator_exit(state, 0)
+    request = _full_exit_request(spec, state, 0)
+    yield from run_request_processing(
+        spec, state, "withdrawal_request", request, mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_activation_epoch_too_recent_ignored(spec, state):
+    # no aging: current epoch < activation + SHARD_COMMITTEE_PERIOD
+    set_eth1_withdrawal_credentials(spec, state, 0,
+                                    address=DEFAULT_ADDRESS)
+    request = _full_exit_request(spec, state, 0)
+    yield from run_request_processing(
+        spec, state, "withdrawal_request", request, mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_unknown_pubkey_ignored(spec, state):
+    age_past_exit_gate(spec, state)
+    request = spec.WithdrawalRequest(
+        source_address=DEFAULT_ADDRESS,
+        validator_pubkey=pubkeys[len(state.validators) + 7],
+        amount=spec.FULL_EXIT_REQUEST_AMOUNT)
+    yield from run_request_processing(
+        spec, state, "withdrawal_request", request, mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_inactive_validator_ignored(spec, state):
+    age_past_exit_gate(spec, state)
+    set_eth1_withdrawal_credentials(spec, state, 0,
+                                    address=DEFAULT_ADDRESS)
+    make_inactive(spec, state, 0)
+    request = _full_exit_request(spec, state, 0)
+    yield from run_request_processing(
+        spec, state, "withdrawal_request", request, mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_full_exit_with_pending_withdrawal_ignored(spec, state):
+    # a full exit is deferred while pending partials exist for the
+    # validator (pending_balance_to_withdraw != 0)
+    age_past_exit_gate(spec, state)
+    set_eth1_withdrawal_credentials(spec, state, 0,
+                                    address=DEFAULT_ADDRESS)
+    add_pending_partial_withdrawal(spec, state, 0)
+    request = _full_exit_request(spec, state, 0)
+    yield from run_request_processing(
+        spec, state, "withdrawal_request", request, mutates=False)
+
+
+# ---------------------------------------------------------------------------
+# partial withdrawals
+# ---------------------------------------------------------------------------
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_basic_partial_withdrawal_request(spec, state):
+    age_past_exit_gate(spec, state)
+    excess = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    _stage_partial(spec, state, 0, excess)
+    request = _partial_request(spec, state, 0, excess)
+    yield from run_request_processing(
+        spec, state, "withdrawal_request", request)
+    assert len(state.pending_partial_withdrawals) == 1
+    assert int(state.pending_partial_withdrawals[0].amount) == excess
+    # partial withdrawals never initiate an exit
+    assert state.validators[0].exit_epoch == spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_partial_withdrawal_higher_excess_balance(spec, state):
+    # excess above the requested amount: full amount is withdrawn
+    age_past_exit_gate(spec, state)
+    amount = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    _stage_partial(spec, state, 0, 2 * amount)
+    request = _partial_request(spec, state, 0, amount)
+    yield from run_request_processing(
+        spec, state, "withdrawal_request", request)
+    assert int(state.pending_partial_withdrawals[0].amount) == amount
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_partial_withdrawal_amount_capped_at_excess(spec, state):
+    # request above the excess: only the excess is withdrawable
+    age_past_exit_gate(spec, state)
+    excess = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    _stage_partial(spec, state, 0, excess)
+    request = _partial_request(spec, state, 0, 3 * excess)
+    yield from run_request_processing(
+        spec, state, "withdrawal_request", request)
+    assert int(state.pending_partial_withdrawals[0].amount) == excess
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_partial_withdrawal_with_pending_withdrawals(spec, state):
+    # pending amounts reduce the remaining excess
+    age_past_exit_gate(spec, state)
+    unit = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    _stage_partial(spec, state, 0, 3 * unit)
+    add_pending_partial_withdrawal(spec, state, 0, amount=unit)
+    request = _partial_request(spec, state, 0, 4 * unit)
+    yield from run_request_processing(
+        spec, state, "withdrawal_request", request)
+    assert len(state.pending_partial_withdrawals) == 2
+    assert int(state.pending_partial_withdrawals[1].amount) == 2 * unit
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_partial_withdrawal_low_amount(spec, state):
+    age_past_exit_gate(spec, state)
+    unit = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    _stage_partial(spec, state, 0, unit)
+    request = _partial_request(spec, state, 0, unit // 4)
+    yield from run_request_processing(
+        spec, state, "withdrawal_request", request)
+    assert int(state.pending_partial_withdrawals[0].amount) == unit // 4
+
+
+@with_all_phases_from("electra")
+@with_presets(["minimal"], "filling the queue is preset-sized")
+@spec_state_test
+def test_partial_withdrawal_queue_full_ignored(spec, state):
+    age_past_exit_gate(spec, state)
+    unit = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    _stage_partial(spec, state, 0, unit)
+    limit = int(spec.PENDING_PARTIAL_WITHDRAWALS_LIMIT)
+    for _ in range(limit):
+        add_pending_partial_withdrawal(spec, state, 1)
+    request = _partial_request(spec, state, 0, unit)
+    yield from run_request_processing(
+        spec, state, "withdrawal_request", request, mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_partial_no_compounding_credentials_ignored(spec, state):
+    # 0x01 credentials cannot take partial withdrawals
+    age_past_exit_gate(spec, state)
+    set_eth1_withdrawal_credentials(spec, state, 0,
+                                    address=DEFAULT_ADDRESS)
+    unit = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    state.balances[0] = uint64(int(spec.MIN_ACTIVATION_BALANCE) + unit)
+    request = _partial_request(spec, state, 0, unit)
+    yield from run_request_processing(
+        spec, state, "withdrawal_request", request, mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_partial_no_excess_balance_ignored(spec, state):
+    age_past_exit_gate(spec, state)
+    _stage_partial(spec, state, 0, 0)
+    request = _partial_request(
+        spec, state, 0, int(spec.EFFECTIVE_BALANCE_INCREMENT))
+    yield from run_request_processing(
+        spec, state, "withdrawal_request", request, mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_partial_insufficient_effective_balance_ignored(spec, state):
+    age_past_exit_gate(spec, state)
+    unit = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    _stage_partial(spec, state, 0, unit)
+    state.validators[0].effective_balance = uint64(
+        int(spec.MIN_ACTIVATION_BALANCE) - unit)
+    request = _partial_request(spec, state, 0, unit)
+    yield from run_request_processing(
+        spec, state, "withdrawal_request", request, mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_pending_withdrawals_consume_all_excess_ignored(spec, state):
+    # pending amounts already cover the excess: nothing left to withdraw
+    age_past_exit_gate(spec, state)
+    unit = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    _stage_partial(spec, state, 0, unit)
+    add_pending_partial_withdrawal(spec, state, 0, amount=unit)
+    pre_len = len(state.pending_partial_withdrawals)
+    request = _partial_request(spec, state, 0, unit)
+    yield from run_request_processing(
+        spec, state, "withdrawal_request", request, mutates=False)
+    assert len(state.pending_partial_withdrawals) == pre_len
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_partial_withdrawal_incorrect_source_address_ignored(spec, state):
+    age_past_exit_gate(spec, state)
+    unit = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    _stage_partial(spec, state, 0, unit)
+    request = _partial_request(spec, state, 0, unit,
+                               address=WRONG_ADDRESS)
+    yield from run_request_processing(
+        spec, state, "withdrawal_request", request, mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_partial_withdrawal_on_exit_initiated_validator_ignored(
+        spec, state):
+    age_past_exit_gate(spec, state)
+    unit = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    _stage_partial(spec, state, 0, unit)
+    spec.initiate_validator_exit(state, 0)
+    request = _partial_request(spec, state, 0, unit)
+    yield from run_request_processing(
+        spec, state, "withdrawal_request", request, mutates=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_partial_withdrawal_activation_too_recent_ignored(spec, state):
+    unit = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    _stage_partial(spec, state, 0, unit)
+    request = _partial_request(spec, state, 0, unit)
+    yield from run_request_processing(
+        spec, state, "withdrawal_request", request, mutates=False)

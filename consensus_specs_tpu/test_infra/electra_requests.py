@@ -1,0 +1,68 @@
+"""Staging helpers for electra EL-request operation tests (EIP-7002
+withdrawal requests, EIP-6110 deposit requests, EIP-7251 consolidation
+requests).
+
+Counterpart of the staging done inline by the reference suites
+(test/electra/block_processing/test_process_{withdrawal,deposit,
+consolidation}_request.py): age validators past the exit gate, scale the
+registry so the consolidation churn limit clears MIN_ACTIVATION_BALANCE,
+and run a request through the no-fault processors while asserting
+whether the state moved.
+"""
+from __future__ import annotations
+
+from ..ssz import uint64
+
+DEFAULT_ADDRESS = b"\xaa" * 20
+WRONG_ADDRESS = b"\xbb" * 20
+
+
+def age_past_exit_gate(spec, state):
+    """Advance the chain past SHARD_COMMITTEE_PERIOD so exits and
+    consolidations clear the activation-age gate
+    (electra/beacon-chain.md:1511,1654)."""
+    state.slot = uint64(
+        int(state.slot)
+        + int(spec.config.SHARD_COMMITTEE_PERIOD)
+        * int(spec.SLOTS_PER_EPOCH))
+
+
+def scale_churn(spec, state, factor=64):
+    """Scale every balance so get_consolidation_churn_limit exceeds
+    MIN_ACTIVATION_BALANCE (otherwise every consolidation is a no-op)."""
+    state.balances = [uint64(int(b) * factor) for b in state.balances]
+    for v in state.validators:
+        v.effective_balance = uint64(int(v.effective_balance) * factor)
+
+
+def run_request_processing(spec, state, kind, request, mutates=True):
+    """Yield the operation vector and process; request processing is
+    no-fault, so ignored requests assert an untouched state root."""
+    pre = state.copy()
+    yield "pre", pre
+    yield kind, request
+    getattr(spec, f"process_{kind}")(state, request)
+    if not mutates:
+        assert spec.hash_tree_root(state) == spec.hash_tree_root(pre)
+    yield "post", state
+
+
+def make_exited(spec, state, index):
+    state.validators[index].exit_epoch = uint64(
+        int(spec.get_current_epoch(state)) + 4)
+
+
+def make_inactive(spec, state, index):
+    state.validators[index].activation_epoch = uint64(
+        int(spec.get_current_epoch(state)) + 8)
+
+
+def add_pending_partial_withdrawal(spec, state, index, amount=None):
+    if amount is None:
+        amount = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    state.pending_partial_withdrawals.append(
+        spec.PendingPartialWithdrawal(
+            validator_index=index,
+            amount=uint64(amount),
+            withdrawable_epoch=uint64(
+                int(spec.get_current_epoch(state)) + 1)))
