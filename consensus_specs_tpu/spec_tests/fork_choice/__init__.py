@@ -7,4 +7,9 @@ FORK_CHOICE_HANDLERS = {
         "consensus_specs_tpu.spec_tests.fork_choice.test_on_block",
     "on_attestation":
         "consensus_specs_tpu.spec_tests.fork_choice.test_on_attestation",
+    "ex_ante":
+        "consensus_specs_tpu.spec_tests.fork_choice.test_ex_ante",
+    "get_proposer_head":
+        "consensus_specs_tpu.spec_tests.fork_choice."
+        "test_get_proposer_head",
 }

@@ -1,0 +1,207 @@
+"""Ex-ante reorg resistance: the proposer boost defeats withheld-block
+attacks (reference test/phase0/fork_choice/test_ex_ante.py).
+
+Attack shape: the adversary proposes B at slot N+1 but withholds it,
+releasing B (plus private attestations) right as the honest C arrives at
+N+2 — hoping stale votes outweigh the fresh block.  The boost gives the
+timely C one committee-weight × PROPOSER_SCORE_BOOST% of advantage,
+which a bounded adversary cannot match ex ante.
+"""
+from ...ssz import hash_tree_root
+from ...test_infra.context import (
+    spec_state_test, with_all_phases, with_presets, never_bls)
+from ...test_infra.attestations import (
+    get_valid_attestation, sign_attestation)
+from ...test_infra.blocks import (
+    build_empty_block, build_empty_block_for_next_slot,
+    state_transition_and_sign_block)
+from ...test_infra.fork_choice import (
+    start_fork_choice_test, tick_and_add_block, add_block,
+    add_attestation, output_store_checks, emit_steps, tick_to_slot)
+
+
+def _head_root(spec, store):
+    head = spec.get_head(store)
+    return getattr(head, "root", head)
+
+
+def _apply_base_block_a(spec, state, store, steps):
+    """Common base: block A at slot N, received timely — A is head."""
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_a = state_transition_and_sign_block(spec, state, block)
+    parts = tick_and_add_block(spec, store, signed_a, steps)
+    assert _head_root(spec, store) == hash_tree_root(signed_a.message)
+    return parts, signed_a
+
+
+def _withheld_b_and_honest_c(spec, state_a):
+    """Adversary's B at N+1 (parent A) and honest C at N+2 (parent A)."""
+    state_b = state_a.copy()
+    block_b = build_empty_block(spec, state_b,
+                                slot=int(state_a.slot) + 1)
+    signed_b = state_transition_and_sign_block(spec, state_b, block_b)
+    state_c = state_a.copy()
+    block_c = build_empty_block(spec, state_c,
+                                slot=int(state_a.slot) + 2)
+    signed_c = state_transition_and_sign_block(spec, state_c, block_c)
+    return (signed_b, state_b), (signed_c, state_c)
+
+
+def _attestation_to(spec, state, signed_block, participants=1):
+    """A `participants`-strong attestation voting `signed_block`."""
+    def _filter(participant_set):
+        return sorted(participant_set)[:participants]
+    attestation = get_valid_attestation(
+        spec, state, slot=state.slot, signed=False,
+        filter_participant_set=_filter)
+    attestation.data.beacon_block_root = hash_tree_root(
+        signed_block.message)
+    sign_attestation(spec, state, attestation)
+    return attestation
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_ex_ante_vanilla(spec, state):
+    """Single adversarial attestation: C keeps the head through the
+    reveal (boost > one vote)."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    more, _a = _apply_base_block_a(spec, state, store, steps)
+    for name, v in more:
+        yield name, v
+    (signed_b, state_b), (signed_c, _sc) = \
+        _withheld_b_and_honest_c(spec, state)
+    attestation = _attestation_to(spec, state_b, signed_b)
+
+    # C received timely at N+2 — boosted head
+    tick_to_slot(spec, store, int(signed_c.message.slot), steps)
+    for name, v in add_block(spec, store, signed_c, steps):
+        yield name, v
+    root_c = hash_tree_root(signed_c.message)
+    assert _head_root(spec, store) == root_c
+    # reveal B — C stays head on the boost
+    for name, v in add_block(spec, store, signed_b, steps):
+        yield name, v
+    assert _head_root(spec, store) == root_c
+    # reveal the withheld vote — still C
+    for name, v in add_attestation(spec, store, attestation, steps):
+        yield name, v
+    assert _head_root(spec, store) == root_c
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)
+
+
+@with_all_phases
+@with_presets(["mainnet"],
+              "minimal's committee already outweighs the boost")
+@spec_state_test
+@never_bls
+def test_ex_ante_attestations_is_greater_than_proposer_boost_with_boost(
+        spec, state):
+    """Enough adversarial votes overcome the boost: B takes the head."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    more, _a = _apply_base_block_a(spec, state, store, steps)
+    for name, v in more:
+        yield name, v
+    (signed_b, state_b), (signed_c, _sc) = \
+        _withheld_b_and_honest_c(spec, state)
+
+    tick_to_slot(spec, store, int(signed_c.message.slot), steps)
+    for name, v in add_block(spec, store, signed_c, steps):
+        yield name, v
+    root_c = hash_tree_root(signed_c.message)
+    assert _head_root(spec, store) == root_c
+    for name, v in add_block(spec, store, signed_b, steps):
+        yield name, v
+    assert _head_root(spec, store) == root_c
+
+    # minimum participant count whose weight beats the boost
+    committee_weight = int(spec.get_total_active_balance(state)) \
+        // int(spec.SLOTS_PER_EPOCH)
+    proposer_score = (committee_weight
+                      * int(spec.config.PROPOSER_SCORE_BOOST)) // 100
+    base_balance = int(state.validators[0].effective_balance)
+    participants = proposer_score // base_balance + 1
+    attestation = _attestation_to(spec, state_b, signed_b,
+                                  participants=participants)
+    for name, v in add_attestation(spec, store, attestation, steps):
+        yield name, v
+    assert _head_root(spec, store) == hash_tree_root(signed_b.message)
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_ex_ante_sandwich_without_attestations(spec, state):
+    """B withheld, C honest, D (child of B) timely at N+3: each timely
+    block takes the head in turn — the sandwich without votes is just
+    boost hand-offs."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    more, _a = _apply_base_block_a(spec, state, store, steps)
+    for name, v in more:
+        yield name, v
+    (signed_b, state_b), (signed_c, _sc) = \
+        _withheld_b_and_honest_c(spec, state)
+    state_d = state_b.copy()
+    block_d = build_empty_block(spec, state_d, slot=int(state.slot) + 3)
+    signed_d = state_transition_and_sign_block(spec, state_d, block_d)
+
+    tick_to_slot(spec, store, int(signed_c.message.slot), steps)
+    for name, v in add_block(spec, store, signed_c, steps):
+        yield name, v
+    assert _head_root(spec, store) == hash_tree_root(signed_c.message)
+    for name, v in add_block(spec, store, signed_b, steps):
+        yield name, v
+    assert _head_root(spec, store) == hash_tree_root(signed_c.message)
+    tick_to_slot(spec, store, int(signed_d.message.slot), steps)
+    for name, v in add_block(spec, store, signed_d, steps):
+        yield name, v
+    assert _head_root(spec, store) == hash_tree_root(signed_d.message)
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_ex_ante_sandwich_with_honest_attestation(spec, state):
+    """One honest vote for C cannot stop the D boost at N+3."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    more, _a = _apply_base_block_a(spec, state, store, steps)
+    for name, v in more:
+        yield name, v
+    (signed_b, state_b), (signed_c, state_c) = \
+        _withheld_b_and_honest_c(spec, state)
+    honest_attestation = _attestation_to(spec, state_c, signed_c)
+    state_d = state_b.copy()
+    block_d = build_empty_block(spec, state_d, slot=int(state.slot) + 3)
+    signed_d = state_transition_and_sign_block(spec, state_d, block_d)
+
+    tick_to_slot(spec, store, int(signed_c.message.slot), steps)
+    for name, v in add_block(spec, store, signed_c, steps):
+        yield name, v
+    assert _head_root(spec, store) == hash_tree_root(signed_c.message)
+    for name, v in add_block(spec, store, signed_b, steps):
+        yield name, v
+    assert _head_root(spec, store) == hash_tree_root(signed_c.message)
+    # honest vote lands with the next tick, then D arrives boosted
+    tick_to_slot(spec, store, int(signed_d.message.slot), steps)
+    for name, v in add_attestation(spec, store, honest_attestation,
+                                   steps):
+        yield name, v
+    for name, v in add_block(spec, store, signed_d, steps):
+        yield name, v
+    assert _head_root(spec, store) == hash_tree_root(signed_d.message)
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)

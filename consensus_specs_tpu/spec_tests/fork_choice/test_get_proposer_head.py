@@ -1,0 +1,100 @@
+"""Proposer head selection (single-slot reorg of a weak late head;
+reference test/phase0/fork_choice/test_get_proposer_head.py).
+
+get_proposer_head lets the slot-N+1 proposer build on the parent of a
+late, under-attested head block when every safety condition holds
+(fork-choice.md reorg helpers); otherwise it must extend the head.
+"""
+from ...ssz import hash_tree_root
+from ...test_infra.context import (
+    spec_state_test, with_all_phases, never_bls)
+from ...test_infra.attestations import get_valid_attestations_at_slot
+from ...test_infra.blocks import (
+    build_empty_block, build_empty_block_for_next_slot,
+    state_transition_and_sign_block)
+from ...test_infra.fork_choice import (
+    start_fork_choice_test, tick_and_add_block, add_block,
+    add_attestation, tick_to_attesting_interval, output_store_checks,
+    emit_steps, tick_to_slot)
+
+
+def _head_root(spec, store):
+    head = spec.get_head(store)
+    return getattr(head, "root", head)
+
+
+def _build_weak_head_on_strong_parent(spec, state, store, steps,
+                                      head_timely):
+    """Parent P at slot 1 (strongly attested), head H at slot 2 with no
+    votes, arriving timely or late per `head_timely`.  Returns
+    (parts, root_p, root_h)."""
+    parts = []
+    block_p = build_empty_block_for_next_slot(spec, state)
+    signed_p = state_transition_and_sign_block(spec, state, block_p)
+    parts.extend(tick_and_add_block(spec, store, signed_p, steps))
+    root_p = hash_tree_root(signed_p.message)
+
+    state_h = state.copy()
+    block_h = build_empty_block(spec, state_h, slot=int(state.slot) + 1)
+    signed_h = state_transition_and_sign_block(spec, state_h, block_h)
+    root_h = hash_tree_root(signed_h.message)
+
+    # every committee of slots 1 and 2 votes P (H unseen when attesting)
+    votes = list(get_valid_attestations_at_slot(state, spec, block_p.slot))
+    slot2_state = state.copy()
+    spec.process_slots(slot2_state, block_h.slot)
+    votes += list(get_valid_attestations_at_slot(
+        slot2_state, spec, block_h.slot))
+
+    if head_timely:
+        tick_to_slot(spec, store, int(block_h.slot), steps)
+        parts.extend(add_block(spec, store, signed_h, steps))
+    else:
+        # arrive after the attesting interval: block_timeliness false
+        tick_to_attesting_interval(spec, store, int(block_h.slot), steps)
+        parts.extend(add_block(spec, store, signed_h, steps))
+
+    # next slot: the would-be proposer evaluates at the slot start
+    tick_to_slot(spec, store, int(block_h.slot) + 1, steps)
+    for attestation in votes:
+        parts.extend(add_attestation(spec, store, attestation, steps))
+    return parts, root_p, root_h
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_basic_is_head_root(spec, state):
+    """A timely head is never reorged, however weak."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    more, root_p, root_h = _build_weak_head_on_strong_parent(
+        spec, state, store, steps, head_timely=True)
+    for name, v in more:
+        yield name, v
+    slot = int(store.blocks[root_h].slot) + 1
+    assert spec.get_proposer_head(store, root_h, slot) == root_h
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_basic_is_parent_root(spec, state):
+    """A late, voteless head on a strong parent is reorged: the
+    proposer builds on the parent."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    more, root_p, root_h = _build_weak_head_on_strong_parent(
+        spec, state, store, steps, head_timely=False)
+    for name, v in more:
+        yield name, v
+    assert spec.is_head_weak(store, root_h)
+    assert spec.is_parent_strong(store, root_p)
+    slot = int(store.blocks[root_h].slot) + 1
+    assert spec.get_proposer_head(store, root_h, slot) == root_p
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)

@@ -1,13 +1,18 @@
-"""on_block fork-choice tests: basic application, future-slot rejection,
-unknown-parent rejection."""
-from ...ssz import hash_tree_root
+"""on_block fork-choice tests: basic application, rejection paths
+(future slot, unknown parent, finalized-ancestry violations), proposer
+boost, checkpoint bookkeeping, justification withholding.
+
+Reference battery: test/phase0/fork_choice/test_on_block.py."""
+from ...ssz import Bytes32, hash_tree_root, uint64
 from ...test_infra.context import (
-    spec_state_test, with_all_phases, never_bls)
+    spec_state_test, with_all_phases, with_pytest_fork_subset, never_bls)
 from ...test_infra.blocks import (
-    build_empty_block_for_next_slot, state_transition_and_sign_block,
-    sign_block)
+    apply_empty_block, build_empty_block_for_next_slot, next_epoch,
+    state_transition_and_sign_block, sign_block)
+from ...test_infra.attestations import next_epoch_with_attestations
 from ...test_infra.fork_choice import (
     start_fork_choice_test, tick_and_add_block, add_block,
+    apply_next_epoch_with_attestations, tick_to_attesting_interval,
     output_store_checks, emit_steps, tick_to_slot)
 
 
@@ -56,4 +61,228 @@ def test_invalid_unknown_parent(spec, state):
     tick_to_slot(spec, store, int(block.slot), steps)
     for name, v in add_block(spec, store, signed, steps, valid=False):
         yield name, v
+    yield from emit_steps(steps)
+
+
+@with_all_phases
+@with_pytest_fork_subset(["phase0", "altair", "electra"])
+@spec_state_test
+@never_bls
+def test_on_block_checkpoints(spec, state):
+    """Justified checkpoint advances as attestation-filled epochs flow
+    through the store (reference test_on_block.py shape)."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    # skip the partial genesis epoch, then two filled epochs
+    next_epoch(spec, state)
+    tick_to_slot(spec, store, int(state.slot), steps)
+    for fill_prev in (False, True):
+        more, _blocks = apply_next_epoch_with_attestations(
+            spec, state, store, steps, True, fill_prev)
+        for name, v in more:
+            yield name, v
+    assert int(store.justified_checkpoint.epoch) > 0
+    assert store.justified_checkpoint == \
+        state.current_justified_checkpoint
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)
+
+
+def _finalize_store(spec, state, store, steps):
+    """Run filled epochs through the store until it finalizes."""
+    parts = []
+    next_epoch(spec, state)
+    tick_to_slot(spec, store, int(state.slot), steps)
+    for _ in range(4):
+        more, blocks = apply_next_epoch_with_attestations(
+            spec, state, store, steps, True, True)
+        parts.extend(more)
+        if int(store.finalized_checkpoint.epoch) > 0:
+            break
+    assert int(store.finalized_checkpoint.epoch) > 0, \
+        "store failed to finalize"
+    return parts
+
+
+@with_all_phases
+@with_pytest_fork_subset(["phase0", "electra"])
+@spec_state_test
+@never_bls
+def test_invalid_on_block_before_finalized(spec, state):
+    """A block at/before the finalized slot is rejected."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    pre_finality_state = state.copy()
+    for name, v in _finalize_store(spec, state, store, steps):
+        yield name, v
+    # a competing block built from the pre-finality past
+    block = build_empty_block_for_next_slot(spec, pre_finality_state)
+    signed = state_transition_and_sign_block(
+        spec, pre_finality_state, block)
+    for name, v in add_block(spec, store, signed, steps, valid=False):
+        yield name, v
+    yield from emit_steps(steps)
+
+
+@with_all_phases
+@with_pytest_fork_subset(["phase0", "electra"])
+@spec_state_test
+@never_bls
+def test_on_block_finalized_skip_slots(spec, state):
+    """A descendant of the finalized checkpoint remains addable across
+    skipped slots."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    for name, v in _finalize_store(spec, state, store, steps):
+        yield name, v
+    # skip a few slots, then extend the canonical head
+    target_slot = int(state.slot) + 3
+    spec.process_slots(state, uint64(target_slot))
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    for name, v in tick_and_add_block(spec, store, signed, steps):
+        yield name, v
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)
+
+
+@with_all_phases
+@with_pytest_fork_subset(["phase0", "electra"])
+@spec_state_test
+@never_bls
+def test_invalid_on_block_finalized_not_in_skip_chain(spec, state):
+    """A block whose ancestry bypasses the finalized checkpoint is
+    rejected even though its parent is known."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    # stash a sibling branch root before finalization
+    alt_state = state.copy()
+    alt_signed = apply_empty_block(spec, alt_state)
+    for name, v in tick_and_add_block(spec, store, alt_signed, steps):
+        yield name, v
+    for name, v in _finalize_store(spec, state, store, steps):
+        yield name, v
+    # extend the stale branch PAST the finalized slot so the rejection
+    # comes from the finalized-ancestry check, not the slot bound
+    finalized_slot = int(spec.compute_start_slot_at_epoch(
+        store.finalized_checkpoint.epoch))
+    spec.process_slots(alt_state, uint64(finalized_slot + 1))
+    block = build_empty_block_for_next_slot(spec, alt_state)
+    signed = state_transition_and_sign_block(spec, alt_state, block)
+    assert int(block.slot) > finalized_slot
+    assert spec.get_checkpoint_block(
+        store, block.parent_root, store.finalized_checkpoint.epoch) \
+        != store.finalized_checkpoint.root
+    for name, v in add_block(spec, store, signed, steps, valid=False):
+        yield name, v
+    yield from emit_steps(steps)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_proposer_boost_timely_block(spec, state):
+    """A block arriving inside the attesting interval of its own slot
+    earns the proposer boost; the boost clears at the next slot."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    # tick exactly to the slot start: inside the attesting interval
+    tick_to_slot(spec, store, int(block.slot), steps)
+    for name, v in add_block(spec, store, signed, steps):
+        yield name, v
+    root = hash_tree_root(signed.message)
+    assert store.proposer_boost_root == root
+    assert int(spec.get_weight(store, root)) > 0
+    output_store_checks(spec, store, steps)
+    # boost resets when the next slot begins
+    tick_to_slot(spec, store, int(block.slot) + 1, steps)
+    assert store.proposer_boost_root == Bytes32()
+    assert int(spec.get_weight(store, root)) == 0
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_proposer_boost_untimely_block(spec, state):
+    """A block arriving after the attesting interval gets no boost."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    tick_to_attesting_interval(spec, store, int(block.slot), steps)
+    for name, v in add_block(spec, store, signed, steps):
+        yield name, v
+    assert store.proposer_boost_root == Bytes32()
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_proposer_boost_is_first_block(spec, state):
+    """Only the first timely block of a slot takes the boost."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    # two competing children of genesis at the same slot
+    state_b = state.copy()
+    block_a = build_empty_block_for_next_slot(spec, state)
+    signed_a = state_transition_and_sign_block(spec, state, block_a)
+    block_b = build_empty_block_for_next_slot(spec, state_b)
+    block_b.body.graffiti = b"\x01" * 32
+    signed_b = state_transition_and_sign_block(spec, state_b, block_b)
+    tick_to_slot(spec, store, int(block_a.slot), steps)
+    for name, v in add_block(spec, store, signed_a, steps):
+        yield name, v
+    root_a = hash_tree_root(signed_a.message)
+    assert store.proposer_boost_root == root_a
+    for name, v in add_block(spec, store, signed_b, steps):
+        yield name, v
+    # boost stays with the first arrival
+    assert store.proposer_boost_root == root_a
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)
+
+
+@with_all_phases
+@with_pytest_fork_subset(["phase0", "altair", "electra"])
+@spec_state_test
+@never_bls
+def test_justification_withholding(spec, state):
+    """Withheld justifying blocks update the checkpoint only once
+    revealed (reference test_on_block.py justification-withholding
+    shape)."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    # establish a justified base first (pull-ups no-op in epochs <= 1)
+    next_epoch(spec, state)
+    tick_to_slot(spec, store, int(state.slot), steps)
+    more, _blocks = apply_next_epoch_with_attestations(
+        spec, state, store, steps, True, True)
+    for name, v in more:
+        yield name, v
+    justified_before = int(store.justified_checkpoint.epoch)
+    # attacker computes an attestation-filled epoch but withholds it
+    withheld_blocks, _post = next_epoch_with_attestations(
+        spec, state, True, False)
+    assert int(store.justified_checkpoint.epoch) == justified_before
+    # reveal: feed every withheld block at the current (later) time
+    tick_to_slot(spec, store, int(state.slot), steps)
+    for signed in withheld_blocks:
+        for name, v in add_block(spec, store, signed, steps):
+            yield name, v
+    assert int(store.justified_checkpoint.epoch) > justified_before
+    output_store_checks(spec, store, steps)
     yield from emit_steps(steps)

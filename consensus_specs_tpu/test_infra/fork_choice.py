@@ -43,9 +43,24 @@ def tick_to_slot(spec, store, slot, steps) -> None:
     on_tick_and_append_step(spec, store, time, steps)
 
 
+def tick_to_attesting_interval(spec, store, slot, steps) -> None:
+    """Tick just past `slot`'s attesting interval: blocks applied now
+    are untimely (no proposer boost)."""
+    time = (int(store.genesis_time)
+            + int(slot) * int(spec.config.SECONDS_PER_SLOT)
+            + int(spec.config.SECONDS_PER_SLOT)
+            // int(spec.INTERVALS_PER_SLOT))
+    on_tick_and_append_step(spec, store, time, steps)
+
+
 def add_block(spec, store, signed_block, steps, valid=True):
     """Apply a signed block to the store, recording the step and the block
-    artifact.  Returns the artifact list to yield."""
+    artifact.  Returns the artifact list to yield.
+
+    As in the reference harness (helpers/fork_choice.py::add_block), an
+    on_block step implies receiving the block's attestations and attester
+    slashings — without this the justified checkpoint state never lands
+    in store.checkpoint_states and get_weight cannot score branches."""
     root = hash_tree_root(signed_block.message)
     name = f"block_{root.hex()[:16]}"
     parts = [(name, signed_block)]
@@ -59,6 +74,10 @@ def add_block(spec, store, signed_block, steps, valid=True):
         raise AssertionError("block unexpectedly valid in fork choice")
     spec.on_block(store, signed_block)
     steps.append(step)
+    for attestation in signed_block.message.body.attestations:
+        spec.on_attestation(store, attestation, is_from_block=True)
+    for attester_slashing in signed_block.message.body.attester_slashings:
+        spec.on_attester_slashing(store, attester_slashing)
     return parts
 
 
@@ -87,6 +106,45 @@ def add_attestation(spec, store, attestation, steps, valid=True):
     spec.on_attestation(store, attestation)
     steps.append(step)
     return parts
+
+
+def add_attester_slashing(spec, store, attester_slashing, steps,
+                          valid=True):
+    """Apply an attester slashing to the store (format README
+    'attester_slashing' step — equivocation discard)."""
+    root = hash_tree_root(attester_slashing)
+    name = f"attester_slashing_{root.hex()[:16]}"
+    parts = [(name, attester_slashing)]
+    step = {"attester_slashing": name, "valid": bool(valid)}
+    if not valid:
+        try:
+            spec.on_attester_slashing(store, attester_slashing)
+        except (AssertionError, ValueError, KeyError):
+            steps.append(step)
+            return parts
+        raise AssertionError("attester slashing unexpectedly valid")
+    spec.on_attester_slashing(store, attester_slashing)
+    steps.append(step)
+    return parts
+
+
+def apply_next_epoch_with_attestations(spec, state, store, steps,
+                                       fill_cur_epoch=True,
+                                       fill_prev_epoch=False):
+    """Advance `state` one epoch with attestation-filled blocks and feed
+    every block through the store (reference
+    helpers/fork_choice.py::apply_next_epoch_with_attestations shape).
+
+    Returns (parts, signed_blocks): artifacts to yield and the blocks
+    applied."""
+    from .attestations import next_epoch_with_attestations
+    signed_blocks, _post = next_epoch_with_attestations(
+        spec, state, fill_cur_epoch, fill_prev_epoch)
+    parts = []
+    for signed_block in signed_blocks:
+        parts.extend(
+            tick_and_add_block(spec, store, signed_block, steps))
+    return parts, signed_blocks
 
 
 def output_store_checks(spec, store, steps) -> None:
