@@ -16,6 +16,7 @@ setup.py:373 `build_spec`):
 from __future__ import annotations
 
 import re
+import textwrap
 import types
 
 from .parser import ParsedSpec, parse_markdown, parse_value
@@ -24,8 +25,8 @@ _HEADER = '''\
 """GENERATED spec module — consensus_specs_tpu.compiler output."""
 from dataclasses import dataclass, field
 from typing import (
-    Any, Callable, Dict, NamedTuple, Optional, Sequence, Set, Tuple,
-    TypeVar)
+    Any, Callable, Dict, NamedTuple, Optional, Protocol, Sequence, Set,
+    Tuple, TypeVar)
 
 T = TypeVar("T")
 TPoint = TypeVar("TPoint")
@@ -121,7 +122,8 @@ def emit_source(spec: ParsedSpec, preset: dict | None = None,
                 config: dict | None = None,
                 prelude: str = "",
                 extra_scalars: dict | None = None,
-                class_subs: list | None = None) -> str:
+                class_subs: list | None = None,
+                epilogue: str = "") -> str:
     """Assemble the module source: header, types, constants, classes,
     prelude, functions, config.  `preset` overrides preset-var values
     (compile-time tier); `config` overrides config-var values (runtime
@@ -187,10 +189,34 @@ def emit_source(spec: ParsedSpec, preset: dict | None = None,
     cfg_names = sorted(spec.config_vars, key=len, reverse=True)
     cfg_re = (re.compile(r"\b(" + "|".join(cfg_names) + r")\b")
               if cfg_names else None)
+
+    def _cfg(src: str) -> str:
+        return cfg_re.sub(lambda m: f"config.{m.group(1)}", src) \
+            if cfg_re is not None else src
+
+    # protocol classes from `self:`-typed markdown functions (reference
+    # setup.py:234-241 / pysetup emission): abstract methods stay `...`,
+    # concrete bodies (e.g. verify_and_notify_new_payload's empty-
+    # transaction check) are REAL spec code the engine epilogue inherits.
+    # Emitted before the free functions because parameter annotations
+    # (`engine: ExecutionEngine`) evaluate at def time.
+    for pname in sorted(spec.protocols):
+        body = "\n\n".join(
+            # `self: Name` -> `self`: the annotation would evaluate
+            # inside the class body where the name doesn't exist yet
+            # (reference helpers.py:66 does the same replace)
+            textwrap.indent(_cfg(src).replace(f"self: {pname}", "self"),
+                            "    ")
+            for _fn, src in spec.protocols[pname].items())
+        parts.append(f"class {pname}(Protocol):\n{body}")
+
+    # fork epilogues subclass the extracted protocols (the noop engine,
+    # reference execution_engine_cls injection)
+    if epilogue:
+        parts.append(epilogue.strip())
+
     for name, src in spec.functions.items():
-        if cfg_re is not None:
-            src = cfg_re.sub(lambda m: f"config.{m.group(1)}", src)
-        parts.append(src)
+        parts.append(_cfg(src))
 
     config = dict(config or {})
     cfg_items = ", ".join(
@@ -207,7 +233,8 @@ def build_spec(doc_texts: list, preset: dict | None = None,
                module_name: str = "generated_spec",
                prelude: str = "",
                extra_scalars: dict | None = None,
-               class_subs: list | None = None):
+               class_subs: list | None = None,
+               epilogue: str = ''):
     """Parse + merge fork markdown docs (oldest first) and exec the module.
 
     Returns (module, source).
@@ -216,7 +243,7 @@ def build_spec(doc_texts: list, preset: dict | None = None,
     for text in doc_texts:
         merged = parse_markdown(text).merge_over(merged)
     source = emit_source(merged, preset, config, prelude,
-                         extra_scalars, class_subs)
+                         extra_scalars, class_subs, epilogue)
     module = types.ModuleType(module_name)
     # dont_inherit: this builder's __future__ flags (stringified
     # annotations) must not leak into the generated module — SSZ field

@@ -45,12 +45,18 @@ FORK_DOCS = {
     "eip6800": ["beacon-chain.md"],
 }
 
-# the bellatrix execution-engine protocol: the spec treats the EL as an
-# opaque boundary; tests run against a noop engine answering True
-# (reference pysetup/spec_builders/bellatrix.py:39-64, deneb.py:48-80)
-_ENGINE_PRELUDE = '''
-class ExecutionEngine:
-    """Noop execution engine: the EL process boundary, stubbed."""
+# the bellatrix execution-engine boundary: the ExecutionEngine Protocol
+# class itself is now EXTRACTED from the markdown's `self:`-typed
+# functions (compiler/parser.py _SELF_TYPE_RE, like reference
+# setup.py:234-241), so the injected code is only what the reference's
+# builders inject too: the noop engine instance
+# (pysetup/spec_builders/bellatrix.py:39-64, deneb.py:48-80 — note the
+# reference Noop OVERRIDES verify_and_notify_new_payload to plain True,
+# it does not inherit the protocol body; match that)
+_ENGINE_EPILOGUE = '''
+class NoopExecutionEngine(ExecutionEngine):
+    """Noop execution engine: the EL process boundary, stubbed
+    (answers True to every verification, builds no payloads)."""
 
     def notify_new_payload(self, *args, **kwargs) -> bool:
         return True
@@ -70,8 +76,6 @@ class ExecutionEngine:
     def is_valid_versioned_hashes(self, *args, **kwargs) -> bool:
         return True
 
-
-NoopExecutionEngine = ExecutionEngine
 
 EXECUTION_ENGINE = NoopExecutionEngine()
 '''
@@ -112,9 +116,14 @@ curdleproofs = _Curdleproofs()
 """
 
 FORK_PRELUDES = {
-    "bellatrix": _ENGINE_PRELUDE,
     "deneb": _KZG_PRELUDE,
     "whisk": _WHISK_PRELUDE,
+}
+
+# epilogues land AFTER the extracted Protocol classes (they subclass
+# them) and before the free functions
+FORK_EPILOGUES = {
+    "bellatrix": _ENGINE_EPILOGUE,
 }
 
 # class-body-only regex rewrites: eip6800 container fields use
@@ -174,7 +183,8 @@ def build_fork(specs_dir: str, fork: str, preset_name: str,
         module_name=module_name or f"{fork}_{preset_name}",
         prelude=fork_prelude(fork),
         extra_scalars=fork_scalars(fork),
-        class_subs=fork_class_subs(fork))
+        class_subs=fork_class_subs(fork),
+        epilogue=fork_epilogue(fork))
 
 
 def load_kzg_trusted_setup():
@@ -205,6 +215,12 @@ def fork_prelude(fork: str) -> str:
     """Concatenated preludes of the fork and its ancestors."""
     return "\n".join(FORK_PRELUDES[f] for f in chain_of(fork)
                      if f in FORK_PRELUDES)
+
+
+def fork_epilogue(fork: str) -> str:
+    """Concatenated epilogues of the fork and its ancestors."""
+    return "\n".join(FORK_EPILOGUES[f] for f in chain_of(fork)
+                      if f in FORK_EPILOGUES)
 
 
 def fork_scalars(fork: str) -> dict:

@@ -26,9 +26,16 @@ class ParsedSpec:
     preset_vars: dict = field(default_factory=dict)
     config_vars: dict = field(default_factory=dict)
     custom_types: dict = field(default_factory=dict)  # name -> type expr
+    # `self: Type`-typed markdown functions become Protocol-class
+    # methods (reference setup.py:234-241): class name -> {fn -> source}
+    protocols: dict = field(default_factory=dict)
 
     def merge_over(self, older: "ParsedSpec") -> "ParsedSpec":
         """This spec layered on top of `older` (newer definitions win)."""
+        protocols = {name: dict(fns)
+                     for name, fns in older.protocols.items()}
+        for name, fns in self.protocols.items():
+            protocols.setdefault(name, {}).update(fns)
         out = ParsedSpec(
             functions={**older.functions, **self.functions},
             classes={**older.classes, **self.classes},
@@ -36,6 +43,7 @@ class ParsedSpec:
             preset_vars={**older.preset_vars, **self.preset_vars},
             config_vars={**older.config_vars, **self.config_vars},
             custom_types={**older.custom_types, **self.custom_types},
+            protocols=protocols,
         )
         return out
 
@@ -44,6 +52,8 @@ _NAME_RE = re.compile(r"^[A-Z][A-Z0-9_]*$")
 # anchored per-line: decorators (@dataclass etc.) may precede the keyword
 _DEF_RE = re.compile(r"^def\s+(\w+)", re.M)
 _CLASS_RE = re.compile(r"^class\s+(\w+)", re.M)
+# first parameter `self: Type` marks a Protocol method
+_SELF_TYPE_RE = re.compile(r"^def\s+(\w+)\(\s*self:\s*(\w+)", re.M)
 
 
 def _table_rows(lines, start):
@@ -108,7 +118,12 @@ def parse_markdown(text: str) -> ParsedSpec:
                 if m and (not f or m.start() < f.start()):
                     spec.classes[m.group(1)] = source
                 elif f:
-                    spec.functions[f.group(1)] = source
+                    s = _SELF_TYPE_RE.search(source)
+                    if s:
+                        spec.protocols.setdefault(
+                            s.group(2), {})[s.group(1)] = source
+                    else:
+                        spec.functions[f.group(1)] = source
             skip_next = False
             i = j + 1
             continue

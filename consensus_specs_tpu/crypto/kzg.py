@@ -101,6 +101,10 @@ def hash_to_bls_field(data: bytes) -> int:
 
 
 def bytes_to_bls_field(b: bytes) -> int:
+    # the spec types this input Bytes32 (deneb/polynomial-commitments.md
+    # bytes_to_bls_field) — enforce the length the type system would
+    if len(b) != 32:
+        raise ValueError("field element must be exactly 32 bytes")
     x = int.from_bytes(bytes(b), KZG_ENDIANNESS)
     if x >= BLS_MODULUS:
         raise ValueError("field element out of range")

@@ -2,15 +2,54 @@
 aggregate, fast_aggregate_verify, aggregate_verify,
 eth_aggregate_pubkeys, eth_fast_aggregate_verify.
 
-Format parity with the reference's tests/generators/bls/main.py: yaml
-cases with {input, output}.  Deterministic private keys match the test
-harness convention (small scalars).
+Case battery parity with the reference's tests/generators/bls/main.py
+(:75-417): per-handler valid matrices over the reference's three
+pre-generated private keys and messages, plus the edge suites — zero
+privkey, tampered signatures, wrong pubkeys, zero/infinity/bad-flag
+point encodings, empty input lists.  Every must-reject case asserts the
+local library actually rejects before the vector is emitted.
 """
 from ..typing import TestCase, TestProvider, hex_str as _hex
 from ...utils import bls
 
-PRIVKEYS = [1 + i for i in range(3)]
+
+def _altair():
+    """The eth_ variants are SPEC functions (altair/bls.md), not shim
+    primitives — the reference generator calls spec.eth_* too."""
+    from ...specs import get_spec
+    return get_spec("altair", "minimal")
+
+# the reference's pre-generated keys (tests/generators/bls/main.py:45-52)
+PRIVKEYS = [
+    int("263dbd792f5b1be47ed85f8938c0f29586af0d3ac7b977f21c278fe1462040e3",
+        16),
+    int("47b8192d77bf871b62e87859d653922725724a5c031afeabc60bcef5ff665138",
+        16),
+    int("328388aff0d4a5b7dc9205abd374e7e98f3cd9f3418edb4eafda5fb16473d216",
+        16),
+]
 MESSAGES = [b"\x00" * 32, b"\x56" * 32, b"\xab" * 32]
+SAMPLE_MESSAGE = b"\x12" * 32
+
+ZERO_PUBKEY = b"\x00" * 48
+G1_POINT_AT_INFINITY = b"\xc0" + b"\x00" * 47
+X40_PUBKEY = b"\x40" + b"\x00" * 47
+ZERO_SIGNATURE = b"\x00" * 96
+G2_POINT_AT_INFINITY = b"\xc0" + b"\x00" * 95
+
+PUBKEYS = [bls.SkToPk(k) for k in PRIVKEYS]
+
+
+def _tamper(sig: bytes) -> bytes:
+    return sig[:-4] + b"\xff\xff\xff\xff"
+
+
+def _expect_exception(func, *args):
+    try:
+        func(*args)
+    except Exception:
+        return
+    raise AssertionError(f"{func.__name__} should have raised")
 
 
 def _yaml_case(handler, name, payload):
@@ -23,110 +62,440 @@ def _yaml_case(handler, name, payload):
 
 
 def _sign_cases():
-    for i, sk in enumerate(PRIVKEYS):
-        for j, msg in enumerate(MESSAGES):
-            sig = bls.Sign(sk, msg)
+    for i, privkey in enumerate(PRIVKEYS):
+        for j, message in enumerate(MESSAGES):
+            sig = bls.Sign(privkey, message)
             yield _yaml_case("sign", f"sign_{i}_{j}", {
-                "input": {"privkey": _hex(sk.to_bytes(32, "big")),
-                          "message": _hex(msg)},
+                "input": {"privkey": _hex(privkey.to_bytes(32, "big")),
+                          "message": _hex(message)},
                 "output": _hex(sig)})
+    # privkey out of [1, r-1] is invalid (IETF BLS KeyGen)
+    _R = int("73eda753299d7d483339d80809a1d80553bda402fffe5bfeffffffff"
+             "00000001", 16)
+    for name, sk in [("zero_privkey", 0), ("privkey_equal_to_r", _R),
+                     ("privkey_above_r", _R + 1),
+                     ("privkey_max_u256", (1 << 256) - 1)]:
+        _expect_exception(bls.Sign, sk, MESSAGES[0])
+        yield _yaml_case("sign", f"sign_{name}", {
+            "input": {"privkey": _hex(sk.to_bytes(32, "big")),
+                      "message": _hex(MESSAGES[0])},
+            "output": None})
 
 
 def _verify_cases():
-    sk = PRIVKEYS[0]
-    pk = bls.SkToPk(sk)
-    msg = MESSAGES[0]
-    sig = bls.Sign(sk, msg)
-    yield _yaml_case("verify", "verify_valid", {
-        "input": {"pubkey": _hex(pk), "message": _hex(msg),
-                  "signature": _hex(sig)},
-        "output": True})
-    wrong = bls.Sign(PRIVKEYS[1], msg)
-    yield _yaml_case("verify", "verify_wrong_key", {
-        "input": {"pubkey": _hex(pk), "message": _hex(msg),
-                  "signature": _hex(wrong)},
-        "output": False})
-    yield _yaml_case("verify", "verify_infinity_sig", {
-        "input": {"pubkey": _hex(pk), "message": _hex(msg),
-                  "signature": _hex(b"\xc0" + b"\x00" * 95)},
-        "output": False})
+    for i, privkey in enumerate(PRIVKEYS):
+        for j, message in enumerate(MESSAGES):
+            sig = bls.Sign(privkey, message)
+            pubkey = PUBKEYS[i]
+            assert bls.Verify(pubkey, message, sig)
+            yield _yaml_case("verify", f"verify_valid_{i}_{j}", {
+                "input": {"pubkey": _hex(pubkey),
+                          "message": _hex(message),
+                          "signature": _hex(sig)},
+                "output": True})
+            wrong = PUBKEYS[(i + 1) % len(PUBKEYS)]
+            assert not bls.Verify(wrong, message, sig)
+            yield _yaml_case("verify", f"verify_wrong_pubkey_{i}_{j}", {
+                "input": {"pubkey": _hex(wrong),
+                          "message": _hex(message),
+                          "signature": _hex(sig)},
+                "output": False})
+            tampered = _tamper(sig)
+            assert not bls.Verify(pubkey, message, tampered)
+            yield _yaml_case(
+                "verify", f"verify_tampered_signature_{i}_{j}", {
+                    "input": {"pubkey": _hex(pubkey),
+                              "message": _hex(message),
+                              "signature": _hex(tampered)},
+                    "output": False})
+    assert not bls.Verify(G1_POINT_AT_INFINITY, SAMPLE_MESSAGE,
+                          G2_POINT_AT_INFINITY)
+    yield _yaml_case(
+        "verify", "verify_infinity_pubkey_and_infinity_signature", {
+            "input": {"pubkey": _hex(G1_POINT_AT_INFINITY),
+                      "message": _hex(SAMPLE_MESSAGE),
+                      "signature": _hex(G2_POINT_AT_INFINITY)},
+            "output": False})
+    # deserialization failures must return False, not raise
+    for name, pk, sig in [
+            ("verify_zero_pubkey", ZERO_PUBKEY,
+             bls.Sign(PRIVKEYS[0], SAMPLE_MESSAGE)),
+            ("verify_x40_pubkey", X40_PUBKEY,
+             bls.Sign(PRIVKEYS[0], SAMPLE_MESSAGE)),
+            ("verify_zero_signature", PUBKEYS[0], ZERO_SIGNATURE),
+            ("verify_garbage_signature", PUBKEYS[0], b"\xff" * 96)]:
+        assert not bls.Verify(pk, SAMPLE_MESSAGE, sig)
+        yield _yaml_case("verify", name, {
+            "input": {"pubkey": _hex(pk),
+                      "message": _hex(SAMPLE_MESSAGE),
+                      "signature": _hex(sig)},
+            "output": False})
 
 
 def _aggregate_cases():
-    msg = MESSAGES[1]
-    sigs = [bls.Sign(sk, msg) for sk in PRIVKEYS]
-    agg = bls.Aggregate(sigs)
-    yield _yaml_case("aggregate", "aggregate_3", {
-        "input": [_hex(s) for s in sigs], "output": _hex(agg)})
+    for j, message in enumerate(MESSAGES):
+        sigs = [bls.Sign(k, message) for k in PRIVKEYS]
+        agg = bls.Aggregate(sigs)
+        yield _yaml_case("aggregate", f"aggregate_{j}", {
+            "input": [_hex(s) for s in sigs],
+            "output": _hex(agg)})
+    # empty aggregation is INVALID (IETF BLS draft-04 2.8)
+    _expect_exception(bls.Aggregate, [])
+    yield _yaml_case("aggregate", "aggregate_na_signatures", {
+        "input": [], "output": None})
+    agg = bls.Aggregate([G2_POINT_AT_INFINITY])
+    assert agg == G2_POINT_AT_INFINITY
+    yield _yaml_case("aggregate", "aggregate_infinity_signature", {
+        "input": [_hex(G2_POINT_AT_INFINITY)],
+        "output": _hex(agg)})
+    single = bls.Sign(PRIVKEYS[0], SAMPLE_MESSAGE)
+    assert bls.Aggregate([single]) == single
+    yield _yaml_case("aggregate", "aggregate_single_signature", {
+        "input": [_hex(single)], "output": _hex(single)})
 
 
 def _fast_aggregate_verify_cases():
-    msg = MESSAGES[2]
-    pks = [bls.SkToPk(sk) for sk in PRIVKEYS]
-    agg = bls.Aggregate([bls.Sign(sk, msg) for sk in PRIVKEYS])
-    yield _yaml_case("fast_aggregate_verify", "fav_valid", {
-        "input": {"pubkeys": [_hex(p) for p in pks], "message": _hex(msg),
-                  "signature": _hex(agg)},
-        "output": True})
-    yield _yaml_case("fast_aggregate_verify", "fav_missing_key", {
-        "input": {"pubkeys": [_hex(p) for p in pks[:-1]],
-                  "message": _hex(msg), "signature": _hex(agg)},
-        "output": False})
+    for i, message in enumerate(MESSAGES):
+        privkeys = PRIVKEYS[:i + 1]
+        pubkeys = PUBKEYS[:i + 1]
+        agg = bls.Aggregate([bls.Sign(k, message) for k in privkeys])
+        assert bls.FastAggregateVerify(pubkeys, message, agg)
+        yield _yaml_case(
+            "fast_aggregate_verify", f"fast_aggregate_verify_valid_{i}", {
+                "input": {"pubkeys": [_hex(p) for p in pubkeys],
+                          "message": _hex(message),
+                          "signature": _hex(agg)},
+                "output": True})
+        extra = pubkeys + [PUBKEYS[-1]]
+        assert not bls.FastAggregateVerify(extra, message, agg)
+        yield _yaml_case(
+            "fast_aggregate_verify",
+            f"fast_aggregate_verify_extra_pubkey_{i}", {
+                "input": {"pubkeys": [_hex(p) for p in extra],
+                          "message": _hex(message),
+                          "signature": _hex(agg)},
+                "output": False})
+        tampered = _tamper(agg)
+        assert not bls.FastAggregateVerify(pubkeys, message, tampered)
+        yield _yaml_case(
+            "fast_aggregate_verify",
+            f"fast_aggregate_verify_tampered_signature_{i}", {
+                "input": {"pubkeys": [_hex(p) for p in pubkeys],
+                          "message": _hex(message),
+                          "signature": _hex(tampered)},
+                "output": False})
+    for name, pubkeys, sig in [
+            ("fast_aggregate_verify_na_pubkeys_and_infinity_signature",
+             [], G2_POINT_AT_INFINITY),
+            ("fast_aggregate_verify_na_pubkeys_and_zero_signature",
+             [], ZERO_SIGNATURE)]:
+        assert not bls.FastAggregateVerify(pubkeys, MESSAGES[-1], sig)
+        yield _yaml_case("fast_aggregate_verify", name, {
+            "input": {"pubkeys": [],
+                      "message": _hex(MESSAGES[-1]),
+                      "signature": _hex(sig)},
+            "output": False})
+    with_inf = PUBKEYS + [G1_POINT_AT_INFINITY]
+    agg = bls.Aggregate([bls.Sign(k, SAMPLE_MESSAGE) for k in PRIVKEYS])
+    assert not bls.FastAggregateVerify(with_inf, SAMPLE_MESSAGE, agg)
+    yield _yaml_case(
+        "fast_aggregate_verify", "fast_aggregate_verify_infinity_pubkey", {
+            "input": {"pubkeys": [_hex(p) for p in with_inf],
+                      "message": _hex(SAMPLE_MESSAGE),
+                      "signature": _hex(agg)},
+            "output": False})
 
 
 def _aggregate_verify_cases():
-    """Distinct (pubkey, message) pairs under one aggregate."""
-    pks = [bls.SkToPk(sk) for sk in PRIVKEYS]
-    sigs = [bls.Sign(sk, msg) for sk, msg in zip(PRIVKEYS, MESSAGES)]
+    sigs = [bls.Sign(k, m) for k, m in zip(PRIVKEYS, MESSAGES)]
     agg = bls.Aggregate(sigs)
-    yield _yaml_case("aggregate_verify", "av_valid", {
-        "input": {"pubkeys": [_hex(p) for p in pks],
+    assert bls.AggregateVerify(PUBKEYS, MESSAGES, agg)
+    yield _yaml_case("aggregate_verify", "aggregate_verify_valid", {
+        "input": {"pubkeys": [_hex(p) for p in PUBKEYS],
                   "messages": [_hex(m) for m in MESSAGES],
                   "signature": _hex(agg)},
         "output": True})
-    shuffled = [MESSAGES[1], MESSAGES[0], MESSAGES[2]]
-    yield _yaml_case("aggregate_verify", "av_wrong_message_order", {
-        "input": {"pubkeys": [_hex(p) for p in pks],
-                  "messages": [_hex(m) for m in shuffled],
-                  "signature": _hex(agg)},
-        "output": False})
-    yield _yaml_case("aggregate_verify", "av_empty", {
-        "input": {"pubkeys": [], "messages": [],
-                  "signature": _hex(b"\xc0" + b"\x00" * 95)},
-        "output": False})
+    tampered = _tamper(agg)
+    assert not bls.AggregateVerify(PUBKEYS, MESSAGES, tampered)
+    yield _yaml_case(
+        "aggregate_verify", "aggregate_verify_tampered_signature", {
+            "input": {"pubkeys": [_hex(p) for p in PUBKEYS],
+                      "messages": [_hex(m) for m in MESSAGES],
+                      "signature": _hex(tampered)},
+            "output": False})
+    swapped = [MESSAGES[1], MESSAGES[0], MESSAGES[2]]
+    assert not bls.AggregateVerify(PUBKEYS, swapped, agg)
+    yield _yaml_case(
+        "aggregate_verify", "aggregate_verify_wrong_message_order", {
+            "input": {"pubkeys": [_hex(p) for p in PUBKEYS],
+                      "messages": [_hex(m) for m in swapped],
+                      "signature": _hex(agg)},
+            "output": False})
+    for name, sig in [
+            ("aggregate_verify_na_pubkeys_and_infinity_signature",
+             G2_POINT_AT_INFINITY),
+            ("aggregate_verify_na_pubkeys_and_zero_signature",
+             ZERO_SIGNATURE)]:
+        assert not bls.AggregateVerify([], [], sig)
+        yield _yaml_case("aggregate_verify", name, {
+            "input": {"pubkeys": [], "messages": [],
+                      "signature": _hex(sig)},
+            "output": False})
+    with_inf = PUBKEYS + [G1_POINT_AT_INFINITY]
+    with_msg = MESSAGES + [SAMPLE_MESSAGE]
+    assert not bls.AggregateVerify(with_inf, with_msg, agg)
+    yield _yaml_case(
+        "aggregate_verify", "aggregate_verify_infinity_pubkey", {
+            "input": {"pubkeys": [_hex(p) for p in with_inf],
+                      "messages": [_hex(m) for m in with_msg],
+                      "signature": _hex(agg)},
+            "output": False})
 
 
 def _eth_aggregate_pubkeys_cases():
-    """altair eth_aggregate_pubkeys: sum of pubkeys; empty list invalid."""
-    pks = [bls.SkToPk(sk) for sk in PRIVKEYS]
-    agg = bls.AggregatePKs(pks)
-    yield _yaml_case("eth_aggregate_pubkeys", "eap_3", {
-        "input": [_hex(p) for p in pks], "output": _hex(agg)})
-    yield _yaml_case("eth_aggregate_pubkeys", "eap_single", {
-        "input": [_hex(pks[0])], "output": _hex(pks[0])})
-    yield _yaml_case("eth_aggregate_pubkeys", "eap_empty", {
-        "input": [], "output": None})
+    for i, pubkey in enumerate(PUBKEYS):
+        agg = _altair().eth_aggregate_pubkeys([pubkey])
+        assert agg == pubkey
+        yield _yaml_case(
+            "eth_aggregate_pubkeys", f"eth_aggregate_pubkeys_single_{i}", {
+                "input": [_hex(pubkey)], "output": _hex(agg)})
+    agg = _altair().eth_aggregate_pubkeys(PUBKEYS)
+    yield _yaml_case(
+        "eth_aggregate_pubkeys", "eth_aggregate_pubkeys_valid_pubkeys", {
+            "input": [_hex(p) for p in PUBKEYS], "output": _hex(agg)})
+    for name, pubkeys in [
+            ("eth_aggregate_pubkeys_empty_list", []),
+            ("eth_aggregate_pubkeys_zero_pubkey", [ZERO_PUBKEY]),
+            ("eth_aggregate_pubkeys_infinity_pubkey",
+             [G1_POINT_AT_INFINITY]),
+            ("eth_aggregate_pubkeys_x40_pubkey", [X40_PUBKEY])]:
+        _expect_exception(_altair().eth_aggregate_pubkeys, pubkeys)
+        yield _yaml_case("eth_aggregate_pubkeys", name, {
+            "input": [_hex(p) for p in pubkeys], "output": None})
 
 
 def _eth_fast_aggregate_verify_cases():
-    """altair variant: empty pubkeys + infinity signature is VALID."""
-    msg = MESSAGES[0]
-    pks = [bls.SkToPk(sk) for sk in PRIVKEYS]
-    agg = bls.Aggregate([bls.Sign(sk, msg) for sk in PRIVKEYS])
-    inf_sig = b"\xc0" + b"\x00" * 95
-    yield _yaml_case("eth_fast_aggregate_verify", "efav_valid", {
-        "input": {"pubkeys": [_hex(p) for p in pks], "message": _hex(msg),
-                  "signature": _hex(agg)},
-        "output": True})
-    yield _yaml_case("eth_fast_aggregate_verify", "efav_empty_infinity", {
-        "input": {"pubkeys": [], "message": _hex(msg),
-                  "signature": _hex(inf_sig)},
-        "output": True})
-    yield _yaml_case("eth_fast_aggregate_verify",
-                     "efav_nonempty_infinity", {
-        "input": {"pubkeys": [_hex(p) for p in pks], "message": _hex(msg),
-                  "signature": _hex(inf_sig)},
-        "output": False})
+    for i, message in enumerate(MESSAGES):
+        privkeys = PRIVKEYS[:i + 1]
+        pubkeys = PUBKEYS[:i + 1]
+        agg = bls.Aggregate([bls.Sign(k, message) for k in privkeys])
+        assert _altair().eth_fast_aggregate_verify(pubkeys, message, agg)
+        yield _yaml_case(
+            "eth_fast_aggregate_verify",
+            f"eth_fast_aggregate_verify_valid_{i}", {
+                "input": {"pubkeys": [_hex(p) for p in pubkeys],
+                          "message": _hex(message),
+                          "signature": _hex(agg)},
+                "output": True})
+        tampered = _tamper(agg)
+        assert not _altair().eth_fast_aggregate_verify(pubkeys, message,
+                                                 tampered)
+        yield _yaml_case(
+            "eth_fast_aggregate_verify",
+            f"eth_fast_aggregate_verify_tampered_signature_{i}", {
+                "input": {"pubkeys": [_hex(p) for p in pubkeys],
+                          "message": _hex(message),
+                          "signature": _hex(tampered)},
+                "output": False})
+    # the eth_ variant ACCEPTS the empty set with the infinity signature
+    # (altair/bls.md) — the one divergence from fast_aggregate_verify
+    assert _altair().eth_fast_aggregate_verify([], MESSAGES[-1],
+                                         G2_POINT_AT_INFINITY)
+    yield _yaml_case(
+        "eth_fast_aggregate_verify",
+        "eth_fast_aggregate_verify_na_pubkeys_and_infinity_signature", {
+            "input": {"pubkeys": [],
+                      "message": _hex(MESSAGES[-1]),
+                      "signature": _hex(G2_POINT_AT_INFINITY)},
+            "output": True})
+    assert not _altair().eth_fast_aggregate_verify([], MESSAGES[-1],
+                                             ZERO_SIGNATURE)
+    yield _yaml_case(
+        "eth_fast_aggregate_verify",
+        "eth_fast_aggregate_verify_na_pubkeys_and_zero_signature", {
+            "input": {"pubkeys": [],
+                      "message": _hex(MESSAGES[-1]),
+                      "signature": _hex(ZERO_SIGNATURE)},
+            "output": False})
+    with_inf = PUBKEYS + [G1_POINT_AT_INFINITY]
+    agg = bls.Aggregate([bls.Sign(k, SAMPLE_MESSAGE) for k in PRIVKEYS])
+    assert not _altair().eth_fast_aggregate_verify(with_inf, SAMPLE_MESSAGE,
+                                             agg)
+    yield _yaml_case(
+        "eth_fast_aggregate_verify",
+        "eth_fast_aggregate_verify_infinity_pubkey", {
+            "input": {"pubkeys": [_hex(p) for p in with_inf],
+                      "message": _hex(SAMPLE_MESSAGE),
+                      "signature": _hex(agg)},
+            "output": False})
+
+
+# --------------------------------------------------------------------------
+# deserialization hardening: every malformed encoding must be REJECTED
+# (verify-family returns False; aggregate raises -> output None), like
+# the reference's tampered/infinity/zero sweeps
+# --------------------------------------------------------------------------
+
+def _bad_pubkey_encodings():
+    """(name, bytes) malformed G1 compressed encodings."""
+    good = bytearray(PUBKEYS[0])
+    x_ge_p = bytearray(good)
+    x_ge_p[0] |= 0x1f
+    for i in range(1, 48):
+        x_ge_p[i] = 0xff
+    not_on_curve = bytearray(good)
+    not_on_curve[-1] ^= 0x01
+    return [
+        ("zero", bytes(ZERO_PUBKEY)),
+        ("infinity_with_x", b"\xc0" + b"\x00" * 46 + b"\x01"),
+        ("compression_bit_unset", bytes([good[0] & 0x7f]) + bytes(good[1:])),
+        ("x40_flag", bytes(X40_PUBKEY)),
+        ("x_ge_modulus", bytes(x_ge_p)),
+        ("not_on_curve", bytes(not_on_curve)),
+        ("short", bytes(good[:47])),
+        ("long", bytes(good) + b"\x00"),
+    ]
+
+
+def _bad_signature_encodings():
+    sig = bytearray(bls.Sign(PRIVKEYS[0], SAMPLE_MESSAGE))
+    x_ge_p = bytearray(sig)
+    x_ge_p[0] |= 0x1f
+    for i in range(1, 96):
+        x_ge_p[i] = 0xff
+    not_on_curve = bytearray(sig)
+    not_on_curve[-1] ^= 0x01
+    return [
+        ("zero", bytes(ZERO_SIGNATURE)),
+        ("infinity_with_x", b"\xc0" + b"\x00" * 94 + b"\x01"),
+        ("compression_bit_unset", bytes([sig[0] & 0x7f]) + bytes(sig[1:])),
+        ("x40_flag", b"\x40" + b"\x00" * 95),
+        ("x_ge_modulus", bytes(x_ge_p)),
+        ("not_on_curve", bytes(not_on_curve)),
+        ("short", bytes(sig[:95])),
+        ("long", bytes(sig) + b"\x00"),
+    ]
+
+
+def _deserialization_cases():
+    sig = bls.Sign(PRIVKEYS[0], SAMPLE_MESSAGE)
+    agg3 = bls.Aggregate(
+        [bls.Sign(k, SAMPLE_MESSAGE) for k in PRIVKEYS])
+    for name, pk in _bad_pubkey_encodings():
+        assert not bls.Verify(pk, SAMPLE_MESSAGE, sig)
+        yield _yaml_case("verify", f"verify_bad_pubkey_{name}", {
+            "input": {"pubkey": _hex(pk),
+                      "message": _hex(SAMPLE_MESSAGE),
+                      "signature": _hex(sig)},
+            "output": False})
+        bad_list = [PUBKEYS[1], pk, PUBKEYS[2]]
+        assert not bls.FastAggregateVerify(bad_list, SAMPLE_MESSAGE, agg3)
+        yield _yaml_case(
+            "fast_aggregate_verify",
+            f"fast_aggregate_verify_bad_pubkey_{name}", {
+                "input": {"pubkeys": [_hex(p) for p in bad_list],
+                          "message": _hex(SAMPLE_MESSAGE),
+                          "signature": _hex(agg3)},
+                "output": False})
+        assert not bls.AggregateVerify(
+            [pk], [SAMPLE_MESSAGE], sig)
+        yield _yaml_case(
+            "aggregate_verify", f"aggregate_verify_bad_pubkey_{name}", {
+                "input": {"pubkeys": [_hex(pk)],
+                          "messages": [_hex(SAMPLE_MESSAGE)],
+                          "signature": _hex(sig)},
+                "output": False})
+    for name, bad_sig in _bad_signature_encodings():
+        assert not bls.Verify(PUBKEYS[0], SAMPLE_MESSAGE, bad_sig)
+        yield _yaml_case("verify", f"verify_bad_signature_{name}", {
+            "input": {"pubkey": _hex(PUBKEYS[0]),
+                      "message": _hex(SAMPLE_MESSAGE),
+                      "signature": _hex(bad_sig)},
+            "output": False})
+        assert not bls.FastAggregateVerify(
+            PUBKEYS, SAMPLE_MESSAGE, bad_sig)
+        yield _yaml_case(
+            "fast_aggregate_verify",
+            f"fast_aggregate_verify_bad_signature_{name}", {
+                "input": {"pubkeys": [_hex(p) for p in PUBKEYS],
+                          "message": _hex(SAMPLE_MESSAGE),
+                          "signature": _hex(bad_sig)},
+                "output": False})
+        # Aggregate must refuse undecodable signatures
+        _expect_exception(bls.Aggregate, [sig, bad_sig])
+        yield _yaml_case(
+            "aggregate", f"aggregate_bad_signature_{name}", {
+                "input": [_hex(sig), _hex(bad_sig)],
+                "output": None})
+
+
+def _cross_handler_negative_cases():
+    """Wrong-message / wrong-signature cross checks per verify handler."""
+    agg3 = bls.Aggregate(
+        [bls.Sign(k, SAMPLE_MESSAGE) for k in PRIVKEYS])
+    for j, message in enumerate(MESSAGES):
+        # signature over SAMPLE_MESSAGE never verifies another message
+        assert not bls.FastAggregateVerify(PUBKEYS, message, agg3)
+        yield _yaml_case(
+            "fast_aggregate_verify",
+            f"fast_aggregate_verify_wrong_message_{j}", {
+                "input": {"pubkeys": [_hex(p) for p in PUBKEYS],
+                          "message": _hex(message),
+                          "signature": _hex(agg3)},
+                "output": False})
+        single = bls.Sign(PRIVKEYS[j], SAMPLE_MESSAGE)
+        assert not bls.Verify(PUBKEYS[j], message, single)
+        yield _yaml_case("verify", f"verify_wrong_message_{j}", {
+            "input": {"pubkey": _hex(PUBKEYS[j]),
+                      "message": _hex(message),
+                      "signature": _hex(single)},
+            "output": False})
+        assert not _altair().eth_fast_aggregate_verify(
+            PUBKEYS, message, agg3)
+        yield _yaml_case(
+            "eth_fast_aggregate_verify",
+            f"eth_fast_aggregate_verify_wrong_message_{j}", {
+                "input": {"pubkeys": [_hex(p) for p in PUBKEYS],
+                          "message": _hex(message),
+                          "signature": _hex(agg3)},
+                "output": False})
+    # degenerate single-signer fast aggregate == plain verify
+    single_sig = bls.Sign(PRIVKEYS[0], SAMPLE_MESSAGE)
+    assert bls.FastAggregateVerify([PUBKEYS[0]], SAMPLE_MESSAGE,
+                                   single_sig)
+    yield _yaml_case(
+        "fast_aggregate_verify",
+        "fast_aggregate_verify_single_pubkey", {
+            "input": {"pubkeys": [_hex(PUBKEYS[0])],
+                      "message": _hex(SAMPLE_MESSAGE),
+                      "signature": _hex(single_sig)},
+            "output": True})
+    # per-position pubkey corruption in aggregate_verify
+    sigs = [bls.Sign(k, m) for k, m in zip(PRIVKEYS, MESSAGES)]
+    agg = bls.Aggregate(sigs)
+    for pos in range(3):
+        pubkeys = list(PUBKEYS)
+        pubkeys[pos] = PUBKEYS[(pos + 1) % 3]
+        assert not bls.AggregateVerify(pubkeys, MESSAGES, agg)
+        yield _yaml_case(
+            "aggregate_verify",
+            f"aggregate_verify_wrong_pubkey_position_{pos}", {
+                "input": {"pubkeys": [_hex(p) for p in pubkeys],
+                          "messages": [_hex(m) for m in MESSAGES],
+                          "signature": _hex(agg)},
+                "output": False})
+    # subset signatures: dropping one signer must fail the aggregate
+    for drop in range(3):
+        partial = bls.Aggregate(
+            [s for i, s in enumerate(sigs) if i != drop])
+        assert not bls.AggregateVerify(PUBKEYS, MESSAGES, partial)
+        yield _yaml_case(
+            "aggregate_verify",
+            f"aggregate_verify_missing_signer_{drop}", {
+                "input": {"pubkeys": [_hex(p) for p in PUBKEYS],
+                          "messages": [_hex(m) for m in MESSAGES],
+                          "signature": _hex(partial)},
+                "output": False})
 
 
 def providers():
@@ -138,4 +507,6 @@ def providers():
         yield from _aggregate_verify_cases()
         yield from _eth_aggregate_pubkeys_cases()
         yield from _eth_fast_aggregate_verify_cases()
+        yield from _deserialization_cases()
+        yield from _cross_handler_negative_cases()
     return [TestProvider(make_cases=make_cases)]

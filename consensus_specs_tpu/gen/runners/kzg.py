@@ -133,4 +133,153 @@ def providers():
                                        _hex(commitment_b)],
                        "proofs": [_hex(proof_b), _hex(proof_a)]},
              "output": False})
+        yield from _invalid_input_cases(kzg)
     return [TestProvider(make_cases=make_cases)]
+
+
+def _must_reject(fn, *args):
+    """Assert the library rejects before emitting a null-output case."""
+    try:
+        fn(*args)
+    except (AssertionError, ValueError):
+        return
+    raise RuntimeError(f"{getattr(fn, '__name__', fn)} accepted bad input")
+
+
+def _invalid_blobs():
+    """(name, bytes) malformed blobs (reference kzg_tests.py
+    INVALID_BLOBS shape: wrong lengths + non-canonical field element)."""
+    good = _blob(0)
+    noncanon = (b"\xff" * 32) + good[32:]        # element >= BLS_MODULUS
+    return [
+        ("empty", b""),
+        ("short", good[:-32]),
+        ("long", good + good[:32]),
+        ("truncated_element", good[:-1]),
+        ("noncanonical_element", noncanon),
+    ]
+
+
+def _invalid_g1_points(kzg):
+    """Malformed 48-byte G1 encodings (INVALID_G1_POINTS shape)."""
+    good = bytearray(kzg.blob_to_kzg_commitment(_blob(0)))
+    not_on_curve = bytearray(good)
+    not_on_curve[-1] ^= 0x01
+    return [
+        ("zero_without_flag", b"\x00" * 48),
+        ("infinity_with_x", b"\xc0" + b"\x00" * 46 + b"\x01"),
+        ("x40_flag", b"\x40" + b"\x00" * 47),
+        ("compression_bit_unset",
+         bytes([good[0] & 0x7f]) + bytes(good[1:])),
+        ("not_on_curve", bytes(not_on_curve)),
+        ("short", bytes(good[:47])),
+        ("long", bytes(good) + b"\x00"),
+    ]
+
+
+def _invalid_field_elements():
+    return [
+        ("ge_modulus", b"\xff" * 32),
+        ("short", b"\x01" * 31),
+        ("long", b"\x01" * 33),
+    ]
+
+
+def _invalid_input_cases(kzg):
+    """The reference's per-handler invalid-encoding batteries
+    (test/utils/kzg_tests.py): every malformed blob/point/field input
+    must make the handler raise -> output null."""
+    blob = _blob(0)
+    commitment = kzg.blob_to_kzg_commitment(blob)
+    blob_proof = kzg.compute_blob_kzg_proof(blob, commitment)
+    z = bls_field_to_bytes(4096)
+    proof, y = kzg.compute_kzg_proof(blob, z)
+
+    for name, bad in _invalid_blobs():
+        _must_reject(kzg.blob_to_kzg_commitment, bad)
+        yield _yaml_case(
+            "blob_to_kzg_commitment", f"commit_invalid_blob_{name}",
+            {"input": {"blob": _hex(bad)}, "output": None})
+        _must_reject(kzg.compute_kzg_proof, bad, z)
+        yield _yaml_case(
+            "compute_kzg_proof", f"proof_invalid_blob_{name}",
+            {"input": {"blob": _hex(bad), "z": _hex(z)}, "output": None})
+        _must_reject(kzg.compute_blob_kzg_proof, bad, commitment)
+        yield _yaml_case(
+            "compute_blob_kzg_proof", f"blob_proof_invalid_blob_{name}",
+            {"input": {"blob": _hex(bad), "commitment": _hex(commitment)},
+             "output": None})
+        _must_reject(kzg.verify_blob_kzg_proof, bad, commitment,
+                     blob_proof)
+        yield _yaml_case(
+            "verify_blob_kzg_proof", f"blob_verify_invalid_blob_{name}",
+            {"input": {"blob": _hex(bad), "commitment": _hex(commitment),
+                       "proof": _hex(blob_proof)},
+             "output": None})
+
+    for name, bad in _invalid_g1_points(kzg):
+        _must_reject(kzg.verify_kzg_proof, bad, z, y, proof)
+        yield _yaml_case(
+            "verify_kzg_proof", f"verify_invalid_commitment_{name}",
+            {"input": {"commitment": _hex(bad), "z": _hex(z),
+                       "y": _hex(y), "proof": _hex(proof)},
+             "output": None})
+        _must_reject(kzg.verify_kzg_proof, commitment, z, y, bad)
+        yield _yaml_case(
+            "verify_kzg_proof", f"verify_invalid_proof_{name}",
+            {"input": {"commitment": _hex(commitment), "z": _hex(z),
+                       "y": _hex(y), "proof": _hex(bad)},
+             "output": None})
+        _must_reject(kzg.verify_blob_kzg_proof, blob, commitment, bad)
+        yield _yaml_case(
+            "verify_blob_kzg_proof", f"blob_verify_invalid_proof_{name}",
+            {"input": {"blob": _hex(blob), "commitment": _hex(commitment),
+                       "proof": _hex(bad)},
+             "output": None})
+        _must_reject(kzg.compute_blob_kzg_proof, blob, bad)
+        yield _yaml_case(
+            "compute_blob_kzg_proof",
+            f"blob_proof_invalid_commitment_{name}",
+            {"input": {"blob": _hex(blob), "commitment": _hex(bad)},
+             "output": None})
+
+    for name, bad in _invalid_field_elements():
+        _must_reject(kzg.compute_kzg_proof, blob, bad)
+        yield _yaml_case(
+            "compute_kzg_proof", f"proof_invalid_z_{name}",
+            {"input": {"blob": _hex(blob), "z": _hex(bad)},
+             "output": None})
+        _must_reject(kzg.verify_kzg_proof, commitment, bad, y, proof)
+        yield _yaml_case(
+            "verify_kzg_proof", f"verify_invalid_z_{name}",
+            {"input": {"commitment": _hex(commitment), "z": _hex(bad),
+                       "y": _hex(y), "proof": _hex(proof)},
+             "output": None})
+        _must_reject(kzg.verify_kzg_proof, commitment, z, bad, proof)
+        yield _yaml_case(
+            "verify_kzg_proof", f"verify_invalid_y_{name}",
+            {"input": {"commitment": _hex(commitment), "z": _hex(z),
+                       "y": _hex(bad), "proof": _hex(proof)},
+             "output": None})
+
+    # batch: empty is trivially valid; length mismatches must raise
+    assert kzg.verify_blob_kzg_proof_batch([], [], [])
+    yield _yaml_case(
+        "verify_blob_kzg_proof_batch", "batch_empty",
+        {"input": {"blobs": [], "commitments": [], "proofs": []},
+         "output": True})
+    _must_reject(kzg.verify_blob_kzg_proof_batch, [blob], [], [])
+    yield _yaml_case(
+        "verify_blob_kzg_proof_batch", "batch_length_mismatch",
+        {"input": {"blobs": [_hex(blob)], "commitments": [],
+                   "proofs": []},
+         "output": None})
+    bad_blob = _invalid_blobs()[4][1]
+    _must_reject(kzg.verify_blob_kzg_proof_batch, [bad_blob],
+                 [commitment], [blob_proof])
+    yield _yaml_case(
+        "verify_blob_kzg_proof_batch", "batch_invalid_blob",
+        {"input": {"blobs": [_hex(bad_blob)],
+                   "commitments": [_hex(commitment)],
+                   "proofs": [_hex(blob_proof)]},
+         "output": None})

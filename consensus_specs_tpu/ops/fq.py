@@ -77,48 +77,86 @@ def unpack(v) -> list:
 
 # ---------------------------------------------------------------------------
 # normalization helpers (all jit-safe, batched over leading axes)
+#
+# CONTROL-FLOW-FREE BY DESIGN.  Every op below is straight-line vector
+# code: fixed carry-compaction rounds plus a Kogge-Stone carry-lookahead
+# resolve carries exactly with zero lax.scan/fori_loop/while ops.  The
+# pairing kernels inline hundreds of field muls — with per-mul loop
+# primitives XLA compile time explodes superlinearly (observed: one
+# 8-bit Miller chunk > 20 min on CPU; the whole fused check never
+# finished), while the flat form traces to a compact elementwise DAG
+# that XLA fuses and compiles in seconds.  Runtime wins too: no
+# sequential 32-step scans on tiny operands, just wide batched vector
+# ops.  Comparisons/borrows are avoided entirely via two's-complement
+# style (+2^384 bias) addition, so subtraction reuses the same carry
+# machinery.
 # ---------------------------------------------------------------------------
 
-def _carry_propagate(t):
-    """Make limbs canonical (< 2^12); t limbs must each fit uint32."""
-    def step(carry, limb):
-        s = limb + carry
-        return s >> LIMB_BITS, s & MASK
-    carry, limbs = jax.lax.scan(step, jnp.zeros(t.shape[:-1], t.dtype),
-                                jnp.moveaxis(t, -1, 0))
-    return jnp.moveaxis(limbs, 0, -1)
+def _shift_limbs(x, d, fill):
+    """x[..., i] -> x[..., i-d] (little-endian shift toward the top)."""
+    pad = jnp.full(x.shape[:-1] + (d,), fill, dtype=x.dtype)
+    return jnp.concatenate([pad, x[..., :-d]], axis=-1)
 
 
-def _geq(a, b):
-    """Lexicographic a >= b over canonical limbs (batched)."""
-    # scan from most-significant: keep first difference
-    gt = jnp.zeros(a.shape[:-1], dtype=jnp.bool_)
-    lt = jnp.zeros(a.shape[:-1], dtype=jnp.bool_)
-    for i in reversed(range(LIMBS)):
-        ai, bi = a[..., i], b[..., i]
-        gt = gt | (~lt & (ai > bi))
-        lt = lt | (~gt & (ai < bi))
-    return ~lt
+def _compact(t, width, rounds):
+    """Value-preserving partial carry compaction: `rounds` shift-add
+    passes over [..., n] uint32 accumulator limbs (< 2^31), padded/
+    truncated to `width`.  Limb bound after r rounds: 2^19 -> 2^8 -> 1
+    excess.  Truncation (width < value limbs) drops exact multiples of
+    2^(12*width) — callers use that for mod-R arithmetic.
+    """
+    pad = width - t.shape[-1]
+    if pad > 0:
+        t = jnp.concatenate(
+            [t, jnp.zeros(t.shape[:-1] + (pad,), t.dtype)], axis=-1)
+    elif pad < 0:
+        t = t[..., :width]
+    for _ in range(rounds):
+        c = t >> LIMB_BITS
+        t = (t & MASK) + _shift_limbs(c, 1, 0)
+    return t
 
 
-def _sub_limbs(a, b):
-    """a - b with borrow propagation; caller guarantees a >= b."""
-    def step(borrow, ab):
-        ai, bi = ab
-        d = ai + BASE - bi - borrow
-        return 1 - (d >> LIMB_BITS), d & MASK
-    borrow, limbs = jax.lax.scan(
-        step, jnp.zeros(a.shape[:-1], a.dtype),
-        (jnp.moveaxis(a, -1, 0), jnp.moveaxis(b, -1, 0)))
-    return jnp.moveaxis(limbs, 0, -1)
+def _norm(t, width):
+    """Exact carry normalization: [..., n] uint32 accumulator limbs
+    (each < 2^31) -> [..., width] canonical 12-bit limbs of the same
+    integer.  `width` must cover the value; top carries are provably
+    zero and dropped.
+
+    Three compaction rounds bring every limb to <= 2^12 (carry bounds
+    2^19 -> 2^8 -> 1), then one Kogge-Stone carry-lookahead resolves
+    the remaining +-1 ripple exactly in log2(width) steps.
+    """
+    t = _compact(t, width, 3)
+    # limbs now in [0, 2^12]; lookahead for the ripple-carry chain
+    g = t > MASK                 # limb generates a carry (== 2^12)
+    p = t == MASK                # limb propagates an incoming carry
+    d = 1
+    while d < width:
+        g = g | (p & _shift_limbs(g, d, False))
+        p = p & _shift_limbs(p, d, False)
+        d <<= 1
+    carry_in = _shift_limbs(g, 1, False).astype(t.dtype)
+    # the carry-out of this add is already accounted for in the chain
+    return ((t & MASK) + carry_in) & MASK
+
+
+# 2^384 - q as limbs: adding it == subtracting q under a +2^384 bias
+_QCOMP_LIMBS = np.array(
+    [((1 << (LIMB_BITS * LIMBS)) - Q >> (LIMB_BITS * i)) & MASK
+     for i in range(LIMBS)], dtype=np.uint32)
 
 
 def _csub_q(a):
-    """Conditionally subtract q when a >= q (canonical limbs in/out)."""
-    q = jnp.asarray(Q_LIMBS)
-    need = _geq(a, jnp.broadcast_to(q, a.shape))
-    diff = _sub_limbs(a, jnp.broadcast_to(q, a.shape))
-    return jnp.where(need[..., None], diff, a)
+    """Conditionally subtract q when a >= q (canonical limbs in/out).
+
+    a + (2^384 - q) overflows into limb 32 exactly when a >= q; the
+    overflow bit selects between the biased difference and a.
+    """
+    t = a + jnp.asarray(_QCOMP_LIMBS)
+    t = _norm(t, LIMBS + 1)
+    need = t[..., LIMBS:LIMBS + 1] > 0
+    return jnp.where(need, t[..., :LIMBS], a)
 
 
 # ---------------------------------------------------------------------------
@@ -126,22 +164,29 @@ def _csub_q(a):
 # ---------------------------------------------------------------------------
 
 def add(a, b):
-    return _csub_q(_carry_propagate(a + b))
+    t = _norm(a + b, LIMBS + 1)[..., :LIMBS]   # a+b < 2q < 2^384
+    return _csub_q(t)
 
 
 def sub(a, b):
-    # (a + q) - b: a+q >= q > b, so the borrow subtraction never underflows
+    # a - b + q, computed as a + q + (2^384-1-b) + 1 under a 2^384 bias:
+    # the complement turns the borrow chain into the carry chain
     q = jnp.asarray(Q_LIMBS)
-    t = _carry_propagate(a + jnp.broadcast_to(q, a.shape))
-    return _csub_q(_sub_limbs(t, b))
+    t = a + q + (MASK - b)
+    t = t.at[..., 0].add(1)
+    t = _norm(t, LIMBS + 2)[..., :LIMBS]       # drop the 2^384 bias
+    return _csub_q(t)
 
 
 def neg(a):
     """-a mod q (Montgomery form preserved); -0 = 0."""
+    # q - a under the 2^384 bias (complement trick, as in sub)
     q = jnp.asarray(Q_LIMBS)
-    is_zero = jnp.all(a == 0, axis=-1)
-    d = _sub_limbs(jnp.broadcast_to(q, a.shape), a)
-    return jnp.where(is_zero[..., None], a, d)
+    t = q + (MASK - a)
+    t = t.at[..., 0].add(1)
+    d = _norm(t, LIMBS + 2)[..., :LIMBS]
+    is_zero_a = jnp.all(a == 0, axis=-1)
+    return jnp.where(is_zero_a[..., None], a, d)
 
 
 # static Toeplitz gather: c[k] = sum_j a[k-j] * b[j] as one batched matvec
@@ -153,10 +198,17 @@ for _k in range(2 * LIMBS - 1):
             _TOEPLITZ_IDX[_k, _j] = _k - _j
             _TOEPLITZ_MASK[_k, _j] = 1
 
-# q shifted left by i limbs, one static row per reduction step
-_Q_SHIFTS = np.zeros((LIMBS, 2 * LIMBS + 1), dtype=np.uint32)
-for _i in range(LIMBS):
-    _Q_SHIFTS[_i, _i:_i + LIMBS] = Q_LIMBS
+# truncated (mod x^LIMBS) Toeplitz for the REDC m-computation
+_TOEPLITZ_IDX_LO = _TOEPLITZ_IDX[:LIMBS]
+_TOEPLITZ_MASK_LO = _TOEPLITZ_MASK[:LIMBS]
+
+# full-width -q^{-1} mod 2^384 (REDC computes m in one truncated product
+# instead of 32 sequential word steps)
+_NINV_FULL = (-pow(Q, -1, 1 << (LIMB_BITS * LIMBS))) % \
+    (1 << (LIMB_BITS * LIMBS))
+_NINV_FULL_LIMBS = np.array(
+    [(_NINV_FULL >> (LIMB_BITS * i)) & MASK for i in range(LIMBS)],
+    dtype=np.uint32)
 
 
 def _conv(a, b):
@@ -169,26 +221,45 @@ def _conv(a, b):
     return jnp.einsum("...kj,...j->...k", at, b)
 
 
+def _conv_lo(a, b):
+    """Truncated product mod x^LIMBS (the low 32 coefficient sums)."""
+    at = a[..., jnp.asarray(_TOEPLITZ_IDX_LO)] \
+        * jnp.asarray(_TOEPLITZ_MASK_LO)
+    return jnp.einsum("...kj,...j->...k", at, b)
+
+
 def _mont_reduce(t):
-    """Montgomery reduction of a [..., 2*LIMBS-1] convolution (base 2^12).
+    """Montgomery REDC of a [..., 2*LIMBS-1] convolution (base 2^12),
+    full-width form: m = (T mod R) * (-q^-1 mod R) mod R in ONE
+    truncated convolution, then (T + m*q) / R.  Straight-line
+    (see the normalization-helpers note): two einsums + three exact
+    carry normalizations, no loop primitives.
 
-    Returns canonical limbs of t * R^{-1} mod q.
+    Returns canonical limbs of T * R^{-1} mod q.
+
+    Exactness is only needed at the final carry resolution: the interim
+    m-computation uses partial compaction.  Bounds: 2 rounds leave
+    limbs <= 2^12 + 2^8, so the truncated m-product coefficients stay
+    < 32 * 2^13 * 2^12 = 2^30 (uint32-safe), m's integer value is
+    < (1 + 2^-4) * 2^384, and (T + m*q)/R < q^2/R + 1.07q < 1.2q —
+    still a single conditional subtract.  Truncating partially-carried
+    polynomials at limb 32 drops exact multiples of 2^384, which is
+    precisely the mod-R the algorithm calls for.
     """
-    q_shifts = jnp.asarray(_Q_SHIFTS)
-    # one extra slot so the final carry add stays in range
-    pad = t.shape[:-1] + (2 * LIMBS + 1 - t.shape[-1],)
-    t = jnp.concatenate([t, jnp.zeros(pad, t.dtype)], axis=-1)
-
-    def body(i, t):
-        m = (t[..., i] * NINV) & MASK
-        t = t + m[..., None] * q_shifts[i]
-        carry = t[..., i] >> LIMB_BITS
-        return t.at[..., i + 1].add(carry)
-
-    t = jax.lax.fori_loop(0, LIMBS, body, t)
-    r = t[..., LIMBS:2 * LIMBS + 1]
-    r = _carry_propagate(r)[..., :LIMBS]
-    return _csub_q(_csub_q(r))
+    # T compacted (value-preserving; T < q^2 fits 64 limbs)
+    t = _compact(t, 2 * LIMBS + 1, 2)
+    # m = T * N' mod 2^384: truncated conv, compact, keep 32 limbs
+    m = _conv_lo(t[..., :LIMBS], jnp.asarray(_NINV_FULL_LIMBS))
+    m = _compact(m, LIMBS, 2)
+    # s = T + m*q == 0 mod 2^384; the high half is the reduced value
+    s = _conv(m, jnp.asarray(Q_LIMBS))
+    pad_t = jnp.zeros(t.shape[:-1] + (2,), t.dtype)
+    pad_s = jnp.zeros(s.shape[:-1] + (4,), s.dtype)
+    total = jnp.concatenate([t, pad_t], axis=-1) \
+        + jnp.concatenate([s, pad_s], axis=-1)
+    total = _norm(total, 2 * LIMBS + 3)
+    r = total[..., LIMBS:2 * LIMBS]
+    return _csub_q(r)
 
 
 def mul(a, b):

@@ -29,13 +29,12 @@ import jax.numpy as jnp
 
 # dispatch granularity (PAIRING_MODE env) — see the mode notes above
 # pairing_check for the tradeoff table.  Default is platform-split: on
-# CPU hosts per-step kernels compile fastest (a chunk kernel costs
-# minutes of XLA time on a small core count) and launch latency is nil,
-# while through a TPU relay every launch pays a network round trip
-# (staged = ~650 trips/check) but compilation is served remotely — so
-# chunks win there.  Resolved lazily from the ACTIVE backend, not env
-# guessing: JAX_PLATFORMS is unset on vanilla CPU hosts and may be a
-# fallback list.
+# CPU hosts per-step kernels (staged) compile in milliseconds and
+# launch latency is nil; on accelerators the whole check runs as ONE
+# fused program (single relay round trip, compile served remotely and
+# persistently cached).  Resolved lazily from the ACTIVE backend, not
+# env guessing: JAX_PLATFORMS is unset on vanilla CPU hosts and may be
+# a fallback list.
 PAIRING_MODE = _os.environ.get("PAIRING_MODE")
 _CHUNK_BITS = 8
 
@@ -44,7 +43,7 @@ def _resolve_mode() -> str:
     global PAIRING_MODE
     if PAIRING_MODE is None:
         PAIRING_MODE = ("staged" if jax.default_backend() == "cpu"
-                        else "chunked")
+                        else "fused")
     return PAIRING_MODE
 
 from . import fq
@@ -437,16 +436,21 @@ def multi_miller_product(xps, yps, xqs, yqs, skip=None):
 _BUCKET_MIN_ROWS = 1
 
 # dispatch granularity (PAIRING_MODE env):
-#   chunked (default) — 8-bit jitted chunks of the Miller loop / exp
-#     ladder with static bit patterns: ~20 one-time compiles, ~70 device
-#     launches per check.  The balance point: per-STEP dispatch (staged)
-#     is ~650 launches and each launch pays a network round trip through
-#     the axon relay; per-CHECK fusion (fused) is one launch but its
-#     scan body inlines ~300 Montgomery multiplies (each an einsum +
-#     fori_loop) and XLA compile blows past 8 minutes even on CPU.
-#   staged — one jitted kernel per step (fastest compile, most launches)
-#   fused — whole check as one lax.scan program (fewest launches,
-#     extreme compile cost; kept for directly-attached devices)
+#   fused (default on accelerators) — the whole batched check as ONE
+#     compiled program (miller scan + final exponentiation + is-one):
+#     a single device launch per check, so relay round-trip latency is
+#     paid once.  Made viable by the control-flow-free fq substrate
+#     (see ops/fq.py): the program lowers to ~350k straight-line
+#     stablehlo lines with only 7 scan ops and compiles in ~4 min on
+#     this sandbox's small CPU (the old fori/scan-heavy substrate never
+#     finished); through the relay, compilation is served remotely
+#     (PALLAS_AXON_REMOTE_COMPILE) and cached persistently.
+#   staged (default on cpu) — one jitted kernel per step: near-zero
+#     compile cost, ~650 dispatches per check; right for tests on CPU
+#     hosts where launch latency is nil.
+#   chunked — 8-bit static-pattern chunks (~20 compiles, ~70 launches);
+#     the historical middle ground, superseded by fused now that the
+#     fused compile is tractable.
 
 
 def _bucket_rows(n: int) -> int:

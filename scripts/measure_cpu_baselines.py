@@ -1,0 +1,62 @@
+"""Measure the pure-Python oracle CPU baselines for BASELINE.json
+configs #1-#4.  The oracle fills the py_ecc slot (same algorithm class:
+pure-python BLS12-381), so these ARE the north-star denominators."""
+import sys, time, json
+sys.path.insert(0, "/root/repo")
+import jax; jax.config.update("jax_platforms", "cpu")
+
+from consensus_specs_tpu.crypto import curve as cv
+from consensus_specs_tpu.crypto import bls12_381 as native
+from consensus_specs_tpu.crypto.fields import R
+from consensus_specs_tpu.crypto.hash_to_curve import hash_to_g2
+
+out = {}
+
+# --- config 2 shape: 512-key FastAggregateVerify (one sync aggregate) ---
+g1 = cv.g1_generator()
+sks = [(i * 6364136223846793005 + 1442695040888963407) % R or 1
+       for i in range(512)]
+pks_b = [cv.g1_to_bytes(g1 * sk) for sk in sks]
+agg = sum(sks) % R
+msg = b"\x5a" * 32
+sig_b = cv.g2_to_bytes(hash_to_g2(msg) * agg)
+t0 = time.perf_counter()
+assert native.FastAggregateVerify(pks_b, msg, sig_b)
+out["sync_aggregate_512key_fastaggverify_s"] = round(
+    time.perf_counter() - t0, 3)
+print("cfg2 512-key FastAggregateVerify:",
+      out["sync_aggregate_512key_fastaggverify_s"], "s", flush=True)
+
+# --- config 1/3 shape: attestation FastAggregateVerify (committee=128) ---
+pks128 = pks_b[:128]
+agg128 = sum(sks[:128]) % R
+sig128 = cv.g2_to_bytes(hash_to_g2(msg) * agg128)
+t0 = time.perf_counter()
+assert native.FastAggregateVerify(pks128, msg, sig128)
+dt = time.perf_counter() - t0
+out["attestation_128key_fastaggverify_s"] = round(dt, 3)
+out["block_128attestations_bls_s"] = round(dt * 128, 1)
+print("cfg3 one 128-key attestation:", round(dt, 3), "s; x128 =",
+      out["block_128attestations_bls_s"], "s", flush=True)
+
+# --- config 4: verify_blob_kzg_proof_batch, 6 blobs x 4096 ---
+from consensus_specs_tpu.crypto.kzg import get_kzg
+kzg = get_kzg(4096)
+BLS_MODULUS = 52435875175126190479447740508185965837690552500527637822603658699938581184513
+FE = 4096
+blobs = [b"".join(((i * 31 + b * 7 + 1) % BLS_MODULUS).to_bytes(32, "big")
+                  for i in range(FE)) for b in range(6)]
+t0 = time.perf_counter()
+commitments = [kzg.blob_to_kzg_commitment(b) for b in blobs]
+t_commit = time.perf_counter() - t0
+proofs = [kzg.compute_blob_kzg_proof(b, c)
+          for b, c in zip(blobs, commitments)]
+t0 = time.perf_counter()
+assert kzg.verify_blob_kzg_proof_batch(blobs, commitments, proofs)
+out["kzg_blob_batch6_verify_s"] = round(time.perf_counter() - t0, 3)
+out["kzg_blob_to_commitment_6x_s"] = round(t_commit, 3)
+print("cfg4 blob_to_kzg_commitment x6:", round(t_commit, 3),
+      "s; verify_blob_kzg_proof_batch(6):",
+      out["kzg_blob_batch6_verify_s"], "s", flush=True)
+
+print(json.dumps(out))

@@ -1,0 +1,51 @@
+"""Differential test for the fused Pippenger MSM program
+(ops/msm.py::_pippenger_g1) against the host Pippenger oracle
+(crypto/curve.py::msm).
+
+Kernel tier: the one-time XLA compile of the fused program costs
+minutes on a small CPU host (it is built for a single accelerator
+launch); `make test-kernels` / RUN_KERNEL_TIERS=1 enables it.
+"""
+import random
+
+import pytest
+
+from consensus_specs_tpu.crypto import curve as cv
+from consensus_specs_tpu.crypto.fields import R
+
+
+@pytest.fixture(scope="module")
+def pippenger_msm():
+    from consensus_specs_tpu.ops import msm
+    old = msm.MSM_MODE
+    msm.MSM_MODE = "pippenger"
+    yield msm
+    msm.MSM_MODE = old
+
+
+def test_pippenger_matches_host_oracle(pippenger_msm):
+    rng = random.Random(7)
+    g = cv.g1_generator()
+    n = 256                      # minimum fused-engine size
+    base = [g * rng.randrange(1, R) for _ in range(32)]
+    pts = base * (n // 32)
+    sc = [rng.randrange(R) for _ in range(n)]
+    # edge scalars and the identity point
+    sc[0] = 0
+    sc[1] = 1
+    sc[2] = R - 1
+    sc[3] = 255                  # single lowest window
+    sc[4] = 1 << 248             # single highest window
+    pts[5] = cv.g1_infinity()
+    got = pippenger_msm.g1_multi_exp(pts, sc)
+    assert got == cv.msm(pts, sc)
+
+
+def test_pippenger_non_multiple_of_threads_pads(pippenger_msm):
+    rng = random.Random(11)
+    g = cv.g1_generator()
+    n = 300                      # not a multiple of _THREADS
+    pts = [g * rng.randrange(1, R) for _ in range(30)] * 10
+    sc = [rng.randrange(R) for _ in range(n)]
+    got = pippenger_msm.g1_multi_exp(pts, sc)
+    assert got == cv.msm(pts, sc)
