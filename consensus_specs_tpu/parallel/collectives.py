@@ -143,46 +143,65 @@ def shard_array(mesh: Mesh, arr, spec=None):
 # epoch deltas (validator-axis data parallelism)
 # ---------------------------------------------------------------------------
 
-def sharded_flag_deltas(local_eff_incr, local_active, local_part,
-                        weight: int, weight_denominator: int,
-                        base_per_increment: int):
-    """Body: one altair participation-flag delta pass over a validator
-    axis sharded across the mesh (altair beacon-chain.md:385-421 made
-    SPMD).  The two global reductions — active increments and
-    participating increments — ride the ICI as psums; everything else is
-    local elementwise math.  Inputs are in EFFECTIVE_BALANCE_INCREMENT
-    units, but the reward numerator base*weight*part_incr tops 2^31 past
-    ~30k mainnet validators, so the lanes run in int64 (make_flag_deltas
-    traces this under enable_x64)."""
+def sharded_flag_set(local_eff_incr, local_active_cur, local_eligible,
+                     local_unsl, base_per_increment, leak,
+                     weight: int, weight_denominator: int,
+                     head_flag: bool):
+    """PRODUCTION altair flag pass (bit-exact to
+    epoch_fast.altair_delta_sets): distinct active/eligible/unslashed-
+    participating masks, the max(1, .) clamps, the leak and head-flag
+    switches.  The two global reductions ride the mesh as psums; the
+    reward/penalty lanes stay local.  `base_per_increment` and `leak`
+    are traced (they change every epoch — baking them would recompile
+    per epoch); weight/denominator/head_flag are per-flag constants."""
     eff64 = local_eff_incr.astype(jnp.int64)
     active_incr = jax.lax.psum(
-        jnp.sum(jnp.where(local_active, eff64, 0)), AXIS)
+        jnp.sum(jnp.where(local_active_cur, eff64, 0)), AXIS)
+    active_incr = jnp.maximum(active_incr, 1)
     part_incr = jax.lax.psum(
-        jnp.sum(jnp.where(local_part & local_active, eff64, 0)),
-        AXIS)
+        jnp.sum(jnp.where(local_unsl, eff64, 0)), AXIS)
+    part_incr = jnp.maximum(part_incr, 1)
     base = eff64 * base_per_increment
     rewards = jnp.where(
-        local_part & local_active,
-        base * weight * part_incr // (active_incr * weight_denominator),
-        0)
-    penalties = jnp.where(
-        local_active & ~local_part,
-        base * weight // weight_denominator, 0)
+        local_eligible & local_unsl & ~leak,
+        base * weight * part_incr
+        // (active_incr * weight_denominator), 0)
+    if head_flag:
+        penalties = jnp.zeros_like(base)
+    else:
+        penalties = jnp.where(
+            local_eligible & ~local_unsl,
+            base * weight // weight_denominator, 0)
     return rewards, penalties
+
+
+def make_flag_set(mesh: Mesh, weight: int, weight_denominator: int,
+                  head_flag: bool):
+    """Compiled production flag pass over a validator axis sharded on
+    `mesh` (used by epoch_fast when the mesh engine is enabled)."""
+    jfn = jax.jit(jax.shard_map(
+        partial(sharded_flag_set, weight=weight,
+                weight_denominator=weight_denominator,
+                head_flag=head_flag),
+        mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(), P()),
+        out_specs=(P(AXIS), P(AXIS)), check_vma=False))
+
+    def call(eff_incr, active_cur, eligible, unsl, base_per_incr, leak):
+        with jax.enable_x64():
+            return jfn(eff_incr, active_cur, eligible, unsl,
+                       jnp.int64(base_per_incr), jnp.bool_(leak))
+    return call
 
 
 def make_flag_deltas(mesh: Mesh, weight: int, weight_denominator: int,
                      base_per_increment: int):
-    jfn = jax.jit(jax.shard_map(
-        partial(sharded_flag_deltas, weight=weight,
-                weight_denominator=weight_denominator,
-                base_per_increment=base_per_increment),
-        mesh=mesh, in_specs=(P(AXIS), P(AXIS), P(AXIS)),
-        out_specs=(P(AXIS), P(AXIS)), check_vma=False))
+    """Demo-shaped wrapper over the production pass: eligible == active,
+    unslashed-participating == part & active, no leak, penalties on."""
+    inner = make_flag_set(mesh, weight, weight_denominator,
+                          head_flag=False)
 
     def call(eff_incr, active, part):
-        # int64 lanes only inside this trace; the process-global dtype
-        # default stays int32
-        with jax.enable_x64():
-            return jfn(eff_incr, active, part)
+        return inner(eff_incr, active, active, part & active,
+                     base_per_increment, False)
     return call

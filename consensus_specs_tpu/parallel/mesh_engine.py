@@ -1,0 +1,119 @@
+"""Mesh engine mode: multi-chip as a PRODUCTION engine, not a demo.
+
+`enable(mesh)` routes two hot production paths through shard_map
+collectives over the device mesh (SURVEY §2.6 "TPU-native equivalent"
+column):
+
+- SSZ merkleization: `hash_tree_root` of any large chunk tree (the
+  BeaconState validator registry, balances, roots histories) flows
+  through `ssz.merkle.set_subtree_hasher` — each device sweeps its
+  local subtree, per-device roots all_gather over ICI, the replicated
+  top closes the tree.
+- Epoch processing: `epoch_fast.altair_delta_sets`' per-flag
+  reward/penalty passes run as validator-axis shard_map bodies whose
+  two global reductions (active and participating increments) are
+  psums (collectives.sharded_flag_set — bit-exact to the host pass).
+
+Everything stays byte-identical to the host engine; the CPU-mesh suite
+(tests/test_mesh_engine.py) and the driver's dryrun_multichip both
+assert it.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from .collectives import make_flag_set, shard_array
+from jax.sharding import Mesh
+
+
+class MeshEngine:
+    """Compiled-callable cache for one mesh; install with .enable()."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+        self.n_dev = int(np.prod(list(mesh.shape.values())))
+        self._merkle_cache: dict = {}
+        self._flag_cache: dict = {}
+        self._threshold = 1 << 14
+
+    # ------------------------------------------------------------------
+    # sharded merkleization (ssz.merkle subtree hook)
+    # ------------------------------------------------------------------
+    def subtree_root(self, level_bytes: bytes, depth: int) -> bytes:
+        from ..ops.sha256 import bytes_to_words, words_to_bytes
+        from .collectives import make_merkle_root
+        n = 1 << depth
+        per_dev = n // self.n_dev
+        if (per_dev < 2 or self.n_dev & (self.n_dev - 1)
+                or per_dev * self.n_dev != n):
+            # tree smaller than the mesh, or a mesh that doesn't divide
+            # the power-of-two tree: single-device fallback
+            from ..ops.sha256 import merkle_root_jax
+            return merkle_root_jax(level_bytes)
+        fn = self._merkle_cache.get(per_dev)
+        if fn is None:
+            fn = make_merkle_root(self.mesh, per_dev)
+            self._merkle_cache[per_dev] = fn
+        words = bytes_to_words(level_bytes)
+        root = fn(shard_array(self.mesh, words))
+        return words_to_bytes(np.asarray(jax.device_get(root))[None])
+
+    # ------------------------------------------------------------------
+    # sharded epoch flag pass (epoch_fast hook)
+    # ------------------------------------------------------------------
+    def _pad_shard(self, arr):
+        n = len(arr)
+        pad = (-n) % self.n_dev
+        if pad:
+            arr = np.concatenate([arr, np.zeros(pad, arr.dtype)])
+        return shard_array(self.mesh, arr)
+
+    def flag_set_batch(self, eff_incr, active_cur, eligible, flags,
+                       base_per_incr: int, leak: bool):
+        """All per-flag altair reward/penalty passes for one epoch:
+        the invariant arrays (balances, active, eligible) pad + shard
+        ONCE; each flag adds only its participation mask.  `flags` is a
+        list of (weight, wd, unsl_mask, head_flag).  Padding lanes (eff
+        0, masks False) contribute nothing to the psums."""
+        n = len(eff_incr)
+        eff_s = self._pad_shard(eff_incr.astype(np.int64))
+        act_s = self._pad_shard(active_cur)
+        elig_s = self._pad_shard(eligible)
+        out = []
+        for weight, wd, unsl, head_flag in flags:
+            key = (len(eff_incr) + (-n) % self.n_dev, weight, wd,
+                   head_flag)
+            fn = self._flag_cache.get(key)
+            if fn is None:
+                fn = make_flag_set(self.mesh, weight, wd, head_flag)
+                self._flag_cache[key] = fn
+            rewards, penalties = fn(
+                eff_s, act_s, elig_s, self._pad_shard(unsl),
+                base_per_incr, leak)
+            out.append(
+                (np.asarray(jax.device_get(rewards))[:n].astype(np.int64),
+                 np.asarray(jax.device_get(penalties))[:n]
+                 .astype(np.int64)))
+        return out
+
+    # ------------------------------------------------------------------
+    def enable(self, merkle_threshold: int | None = None) -> None:
+        from ..ssz import merkle as ssz_merkle
+        from ..specs import epoch_fast
+        if merkle_threshold is not None:
+            self._threshold = merkle_threshold
+        ssz_merkle.set_subtree_hasher(self.subtree_root, self._threshold)
+        epoch_fast.MESH_ENGINE = self
+
+    def disable(self) -> None:
+        from ..ssz import merkle as ssz_merkle
+        from ..specs import epoch_fast
+        ssz_merkle.set_subtree_hasher(None)
+        epoch_fast.MESH_ENGINE = None
+
+
+def enable(mesh: Mesh, merkle_threshold: int = 1 << 14) -> MeshEngine:
+    engine = MeshEngine(mesh)
+    engine.enable(merkle_threshold)
+    return engine

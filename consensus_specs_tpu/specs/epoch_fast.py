@@ -26,6 +26,10 @@ import numpy as np
 
 ENABLED = True
 
+# installed by parallel/mesh_engine.enable(): routes the per-flag
+# reward/penalty passes through validator-axis shard_map collectives
+MESH_ENGINE = None
+
 _I64MAX = np.iinfo(np.int64).max
 _ORDER_BITS = 24          # attestations per epoch < 2**24; delay keys above
 
@@ -249,22 +253,35 @@ def altair_delta_sets(spec, state):
     active_increments = tb // incr
     wd = int(spec.WEIGHT_DENOMINATOR)
 
-    sets = []
+    flag_specs = []
     for flag_index, weight in enumerate(spec.PARTICIPATION_FLAG_WEIGHTS):
-        w = int(weight)
-        unsl = altair_unslashed_participating(
-            spec, state, arr, flag_index, prev)
-        part_incr = int(arr.eff[unsl].sum())
-        part_incr = max(incr, part_incr) // incr
-        rewards = np.zeros(n, np.int64)
-        penalties = np.zeros(n, np.int64)
-        if not leak:
-            num = base * w * part_incr
-            rewards = np.where(eligible & unsl,
-                               num // (active_increments * wd), 0)
-        if flag_index != int(spec.TIMELY_HEAD_FLAG_INDEX):
-            penalties = np.where(eligible & ~unsl, base * w // wd, 0)
-        sets.append((rewards, penalties))
+        flag_specs.append((
+            int(weight),
+            altair_unslashed_participating(
+                spec, state, arr, flag_index, prev),
+            flag_index == int(spec.TIMELY_HEAD_FLAG_INDEX)))
+
+    if MESH_ENGINE is not None:
+        # the production mesh path: psum reductions over ICI, bit-exact
+        # to the host lanes below; invariant arrays shard once
+        sets = MESH_ENGINE.flag_set_batch(
+            arr.eff // incr, arr.active(cur), eligible,
+            [(w, wd, unsl, head) for w, unsl, head in flag_specs],
+            base_per_incr, leak)
+    else:
+        sets = []
+        for w, unsl, head_flag in flag_specs:
+            part_incr = int(arr.eff[unsl].sum())
+            part_incr = max(incr, part_incr) // incr
+            rewards = np.zeros(n, np.int64)
+            penalties = np.zeros(n, np.int64)
+            if not leak:
+                num = base * w * part_incr
+                rewards = np.where(eligible & unsl,
+                                   num // (active_increments * wd), 0)
+            if not head_flag:
+                penalties = np.where(eligible & ~unsl, base * w // wd, 0)
+            sets.append((rewards, penalties))
 
     # inactivity penalties
     scores = np.fromiter(

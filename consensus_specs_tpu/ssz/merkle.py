@@ -96,6 +96,21 @@ def use_tpu_hashing(threshold: int = 2048, pallas: bool = False) -> None:
 def use_host_hashing() -> None:
     set_bulk_level_hasher(None)
 
+
+# Whole-subtree hasher: collapses the level loop for large populated
+# subtrees into one call (the mesh engine shards the subtree across
+# devices and all-gathers the per-device roots — parallel/mesh_engine).
+# `fn(level_bytes, depth)` gets a power-of-two chunk concatenation and
+# returns the 32-byte subtree root.
+_subtree_hasher = None
+_subtree_threshold = 1 << 14
+
+
+def set_subtree_hasher(fn, threshold: int = 1 << 14) -> None:
+    global _subtree_hasher, _subtree_threshold
+    _subtree_hasher = fn
+    _subtree_threshold = threshold
+
 # NOTE: the native C++ tier's sha256_2to1_batch is NOT wired here on
 # purpose — measured 0.92x vs hashlib on a SHA-NI host (OpenSSL's
 # assembly beats portable C++ per hash; the saved Python loop overhead
@@ -123,6 +138,23 @@ def merkleize_chunks(chunks: Sequence[bytes], limit: int | None = None) -> bytes
         return ZERO_HASHES[depth]
 
     level = b"".join(chunks)
+
+    padded = next_power_of_two(count)
+    if (_subtree_hasher is not None and count >= _subtree_threshold
+            and (padded - count) * 8 <= count):
+        # hash the whole populated subtree in one sharded call, then
+        # climb the virtually-padded top with zero-tree siblings.  Only
+        # near-full trees (< 12.5% zero padding) take this path — a
+        # barely-past-a-power-of-two count would nearly double the hash
+        # work vs the level loop's ZERO_HASHES shortcuts
+        sub_depth = chunk_depth(count)
+        if padded != count:
+            level += bytes(32) * (padded - count)
+        root = _subtree_hasher(level, sub_depth)
+        for d in range(sub_depth, depth):
+            root = hash_pair(root, ZERO_HASHES[d])
+        return root
+
     for d in range(depth):
         n = len(level) // 32
         if n % 2 == 1:

@@ -1,0 +1,73 @@
+"""CPU-mesh coverage of the PRODUCTION mesh engine
+(parallel/mesh_engine.py): the sharded subtree merkleization and the
+sharded altair flag passes must be byte-identical to the host engine on
+an 8-virtual-device mesh (conftest forces
+jax_num_cpu_devices=8).  This is the default-suite counterpart of the
+driver's dryrun_multichip."""
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.parallel import get_mesh, device_count
+from consensus_specs_tpu.parallel import mesh_engine
+from consensus_specs_tpu.specs import get_spec, epoch_fast
+from consensus_specs_tpu.ssz import hash_tree_root, merkle
+from consensus_specs_tpu.test_infra.context import DEFAULT_TEST_PRESET
+from consensus_specs_tpu.test_infra.genesis import (
+    create_genesis_state, default_balances)
+from consensus_specs_tpu.test_infra.blocks import next_epoch
+
+
+@pytest.fixture
+def engine():
+    mesh = get_mesh(min(8, device_count()))
+    eng = mesh_engine.enable(mesh, merkle_threshold=64)
+    yield eng
+    eng.disable()
+
+
+def test_sharded_subtree_merkleization_is_byte_identical(engine):
+    rng = np.random.default_rng(3)
+    for count in (64, 257, 1024):
+        chunks = [rng.integers(0, 256, 32, dtype=np.uint8).tobytes()
+                  for _ in range(count)]
+        sharded = merkle.merkleize_chunks(chunks, limit=4096)
+        engine.disable()
+        host = merkle.merkleize_chunks(chunks, limit=4096)
+        engine.enable(merkle_threshold=64)
+        assert sharded == host, count
+
+
+def test_sharded_flag_passes_match_host_engine(engine):
+    spec = get_spec("altair", DEFAULT_TEST_PRESET)
+    state = create_genesis_state(spec, default_balances(spec))
+    next_epoch(spec, state)
+    # nonuniform participation so rewards and penalties both fire
+    for i in range(len(state.validators)):
+        state.previous_epoch_participation[i] = (
+            0b111 if i % 3 == 0 else (0b001 if i % 3 == 1 else 0))
+    state_host = state.copy()
+
+    arr_mesh, sets_mesh = epoch_fast.altair_delta_sets(spec, state)
+    engine.disable()
+    arr_host, sets_host = epoch_fast.altair_delta_sets(spec, state_host)
+    engine.enable()
+    assert len(sets_mesh) == len(sets_host)
+    for (rm, pm), (rh, ph) in zip(sets_mesh, sets_host):
+        np.testing.assert_array_equal(np.asarray(rm), np.asarray(rh))
+        np.testing.assert_array_equal(np.asarray(pm), np.asarray(ph))
+
+
+def test_full_epoch_under_mesh_engine_same_root(engine):
+    spec = get_spec("altair", DEFAULT_TEST_PRESET)
+    state = create_genesis_state(spec, default_balances(spec))
+    next_epoch(spec, state)
+    for i in range(len(state.validators)):
+        state.previous_epoch_participation[i] = 0b111 if i % 2 else 0b001
+    mesh_state = state.copy()
+    host_state = state.copy()
+
+    spec.process_epoch(mesh_state)
+    engine.disable()
+    spec.process_epoch(host_state)
+    engine.enable()
+    assert hash_tree_root(mesh_state) == hash_tree_root(host_state)
