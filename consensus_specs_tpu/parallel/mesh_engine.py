@@ -45,10 +45,10 @@ class MeshEngine:
         from .collectives import make_merkle_root
         n = 1 << depth
         per_dev = n // self.n_dev
-        if (per_dev < 2 or self.n_dev & (self.n_dev - 1)
-                or per_dev * self.n_dev != n):
-            # tree smaller than the mesh, or a mesh that doesn't divide
-            # the power-of-two tree: single-device fallback
+        if per_dev < 2 or self.n_dev & (self.n_dev - 1):
+            # tree smaller than the mesh, or a non-power-of-two mesh
+            # (which cannot divide a power-of-two tree): single-device
+            # fallback
             from ..ops.sha256 import merkle_root_jax
             return merkle_root_jax(level_bytes)
         fn = self._merkle_cache.get(per_dev)
@@ -77,13 +77,13 @@ class MeshEngine:
         list of (weight, wd, unsl_mask, head_flag).  Padding lanes (eff
         0, masks False) contribute nothing to the psums."""
         n = len(eff_incr)
+        padded = n + (-n) % self.n_dev
         eff_s = self._pad_shard(eff_incr.astype(np.int64))
         act_s = self._pad_shard(active_cur)
         elig_s = self._pad_shard(eligible)
         out = []
         for weight, wd, unsl, head_flag in flags:
-            key = (len(eff_incr) + (-n) % self.n_dev, weight, wd,
-                   head_flag)
+            key = (padded, weight, wd, head_flag)
             fn = self._flag_cache.get(key)
             if fn is None:
                 fn = make_flag_set(self.mesh, weight, wd, head_flag)
@@ -109,8 +109,12 @@ class MeshEngine:
     def disable(self) -> None:
         from ..ssz import merkle as ssz_merkle
         from ..specs import epoch_fast
-        ssz_merkle.set_subtree_hasher(None)
-        epoch_fast.MESH_ENGINE = None
+        # only uninstall our own hooks — a later-enabled engine owns
+        # the globals now and must not be silently reverted
+        if ssz_merkle._subtree_hasher is self.subtree_root:
+            ssz_merkle.set_subtree_hasher(None)
+        if epoch_fast.MESH_ENGINE is self:
+            epoch_fast.MESH_ENGINE = None
 
 
 def enable(mesh: Mesh, merkle_threshold: int = 1 << 14) -> MeshEngine:

@@ -150,3 +150,135 @@ def test_proposer_in_committee(spec, state):
     next_slot(spec, state)
     block = _block_with_aggregate(spec, state)
     yield from run_sync_committee_processing(spec, state, block)
+
+
+def _aggregate_with(spec, state, bit_positions, signing_positions):
+    """A SyncAggregate whose BITS and SIGNATURE cover different
+    position sets — the invalid-signature battery's workhorse."""
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    bits = [p in set(bit_positions) for p in range(size)]
+    signature = compute_aggregate_sync_committee_signature(
+        spec, state, list(signing_positions))
+    return spec.SyncAggregate(sync_committee_bits=bits,
+                              sync_committee_signature=signature)
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(SYNC_FORKS)
+@spec_state_test
+@always_bls
+def test_invalid_signature_missing_participant(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    transition_to(spec, state, block.slot)
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    block.body.sync_aggregate = _aggregate_with(
+        spec, state, range(size), range(1, size))
+    yield from run_sync_committee_processing(spec, state, block,
+                                             valid=False)
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(SYNC_FORKS)
+@spec_state_test
+@always_bls
+def test_invalid_signature_extra_participant(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    transition_to(spec, state, block.slot)
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    block.body.sync_aggregate = _aggregate_with(
+        spec, state, range(1, size), range(size))
+    yield from run_sync_committee_processing(spec, state, block,
+                                             valid=False)
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(SYNC_FORKS)
+@spec_state_test
+@always_bls
+def test_invalid_signature_infinite_signature_with_all_participants(
+        spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    transition_to(spec, state, block.slot)
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    agg = _aggregate_with(spec, state, range(size), [])
+    assert bytes(agg.sync_committee_signature) == \
+        bytes(spec.G2_POINT_AT_INFINITY)
+    block.body.sync_aggregate = agg
+    yield from run_sync_committee_processing(spec, state, block,
+                                             valid=False)
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(SYNC_FORKS)
+@spec_state_test
+@always_bls
+def test_invalid_signature_infinite_signature_with_single_participant(
+        spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    transition_to(spec, state, block.slot)
+    block.body.sync_aggregate = _aggregate_with(spec, state, [0], [])
+    yield from run_sync_committee_processing(spec, state, block,
+                                             valid=False)
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(SYNC_FORKS)
+@spec_state_test
+@always_bls
+def test_invalid_signature_past_block(spec, state):
+    """An aggregate signed over a two-slots-old root fails (the
+    signature covers the PREVIOUS slot's block root)."""
+    from ...ssz import uint64
+    from ...test_infra.blocks import apply_empty_block
+    # real blocks so historical roots actually differ (empty slots all
+    # repeat the previous block root, which would keep the stale
+    # signature valid)
+    apply_empty_block(spec, state)
+    apply_empty_block(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    transition_to(spec, state, block.slot)
+    block.body.sync_aggregate = get_sync_aggregate(
+        spec, state, signature_slot=uint64(int(state.slot) - 2))
+    yield from run_sync_committee_processing(spec, state, block,
+                                             valid=False)
+
+
+def _committee_member_validator_index(spec, state, position=0):
+    pubkey = state.current_sync_committee.pubkeys[position]
+    for i, v in enumerate(state.validators):
+        if v.pubkey == pubkey:
+            return i
+    raise AssertionError("sync committee pubkey not in registry")
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(SYNC_FORKS)
+@spec_state_test
+@always_bls
+def test_sync_committee_with_participating_exited_member(spec, state):
+    """An exited validator may keep signing sync duties; the aggregate
+    stays valid."""
+    from ...ssz import uint64
+    index = _committee_member_validator_index(spec, state)
+    state.validators[index].exit_epoch = uint64(
+        int(spec.get_current_epoch(state)))
+    block = _block_with_aggregate(spec, state)
+    yield from run_sync_committee_processing(spec, state, block)
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(SYNC_FORKS)
+@spec_state_test
+@always_bls
+def test_sync_committee_with_nonparticipating_exited_member(spec, state):
+    from ...ssz import uint64
+    index = _committee_member_validator_index(spec, state)
+    state.validators[index].exit_epoch = uint64(
+        int(spec.get_current_epoch(state)))
+    pubkey = state.validators[index].pubkey
+    skip = {p for p, pk in
+            enumerate(state.current_sync_committee.pubkeys)
+            if pk == pubkey}
+    block = _block_with_aggregate(
+        spec, state, participation_fn=lambda p: p not in skip)
+    yield from run_sync_committee_processing(spec, state, block)

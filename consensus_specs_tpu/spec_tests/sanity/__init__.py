@@ -2,6 +2,8 @@
 
 SANITY_HANDLERS = {
     "blocks": "consensus_specs_tpu.spec_tests.sanity.test_blocks",
+    "blocks_deneb":
+        "consensus_specs_tpu.spec_tests.sanity.test_blocks_deneb",
     "slots": "consensus_specs_tpu.spec_tests.sanity.test_slots",
     "multi_operations":
         "consensus_specs_tpu.spec_tests.sanity.test_multi_operations",

@@ -110,3 +110,327 @@ def test_invalid_state_root(spec, state):
         spec.state_transition(state, signed)
         return [signed]
     yield from _run_blocks(spec, state, build, valid=False)
+
+
+# ---------------------------------------------------------------------------
+# signature and header rejection paths
+# ---------------------------------------------------------------------------
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_all_zeroed_sig(spec, state):
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        temp = state.copy()
+        spec.process_slots(temp, block.slot)
+        spec.process_block(temp, block)
+        block.state_root = hash_tree_root(temp)
+        signed = spec.SignedBeaconBlock(message=block)   # zero signature
+        spec.state_transition(state, signed, True)
+        return [signed]
+    yield from _run_blocks(spec, state, build, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_incorrect_block_sig(spec, state):
+    from ...test_infra.keys import privkeys
+    from ...utils import bls as bls_shim
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        temp = state.copy()
+        spec.process_slots(temp, block.slot)
+        spec.process_block(temp, block)
+        block.state_root = hash_tree_root(temp)
+        domain = spec.get_domain(
+            state, spec.DOMAIN_BEACON_PROPOSER,
+            spec.compute_epoch_at_slot(block.slot))
+        root = spec.compute_signing_root(block, domain)
+        wrong_key = privkeys[(int(block.proposer_index) + 1)
+                             % len(privkeys)]
+        signed = spec.SignedBeaconBlock(
+            message=block, signature=bls_shim.Sign(wrong_key, root))
+        spec.state_transition(state, signed, True)
+        return [signed]
+    yield from _run_blocks(spec, state, build, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_invalid_incorrect_proposer_index(spec, state):
+    from ...test_infra.blocks import sign_block
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.proposer_index = uint64(
+            (int(block.proposer_index) + 3) % len(state.validators))
+        signed = sign_block(spec, state, block)
+        spec.state_transition(state, signed, True)
+        return [signed]
+    yield from _run_blocks(spec, state, build, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_invalid_proposal_for_genesis_slot(spec, state):
+    from ...test_infra.blocks import build_empty_block, sign_block
+    def build(state):
+        block = build_empty_block(spec, state, slot=state.slot)
+        block.slot = spec.GENESIS_SLOT
+        block.parent_root = b"\x01" * 32
+        signed = sign_block(spec, state, block)
+        spec.state_transition(state, signed, True)
+        return [signed]
+    yield from _run_blocks(spec, state, build, valid=False)
+
+
+# ---------------------------------------------------------------------------
+# slot bookkeeping
+# ---------------------------------------------------------------------------
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_skipped_slots(spec, state):
+    def build(state):
+        from ...test_infra.blocks import build_empty_block
+        block = build_empty_block(spec, state,
+                                  slot=uint64(int(state.slot) + 4))
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build)
+    assert int(state.slot) % int(spec.SLOTS_PER_EPOCH) == 4
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_historical_batch(spec, state):
+    # cross a SLOTS_PER_HISTORICAL_ROOT boundary so the batch updates
+    target = (int(state.slot) - (int(state.slot)
+              % int(spec.SLOTS_PER_HISTORICAL_ROOT))
+              + int(spec.SLOTS_PER_HISTORICAL_ROOT) - 1)
+    transition_to(spec, state, uint64(target))
+    pre_len_hist = (len(state.historical_summaries)
+                    if spec.is_post("capella")
+                    else len(state.historical_roots))
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build)
+    post_len_hist = (len(state.historical_summaries)
+                     if spec.is_post("capella")
+                     else len(state.historical_roots))
+    assert post_len_hist == pre_len_hist + 1
+
+
+# ---------------------------------------------------------------------------
+# operations inside whole blocks
+# ---------------------------------------------------------------------------
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_proposer_slashing_in_block(spec, state):
+    from ...test_infra.slashings import get_valid_proposer_slashing
+    slashing = get_valid_proposer_slashing(spec, state)
+    slashed_index = int(
+        slashing.signed_header_1.message.proposer_index)
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.proposer_slashings.append(slashing)
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build)
+    assert state.validators[slashed_index].slashed
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_invalid_duplicate_proposer_slashings_same_block(spec, state):
+    from ...test_infra.slashings import get_valid_proposer_slashing
+    slashing = get_valid_proposer_slashing(spec, state)
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.proposer_slashings.append(slashing)
+        block.body.proposer_slashings.append(slashing)
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_attester_slashing_in_block(spec, state):
+    from ...test_infra.slashings import get_valid_attester_slashing
+    slashing = get_valid_attester_slashing(spec, state)
+    indices = [int(i)
+               for i in slashing.attestation_1.attesting_indices]
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.attester_slashings.append(slashing)
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build)
+    assert all(state.validators[i].slashed for i in indices)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_invalid_duplicate_attester_slashing_same_block(spec, state):
+    from ...test_infra.slashings import get_valid_attester_slashing
+    slashing = get_valid_attester_slashing(spec, state)
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.attester_slashings.append(slashing)
+        block.body.attester_slashings.append(slashing)
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_proposer_self_slashing(spec, state):
+    from ...test_infra.slashings import get_valid_proposer_slashing
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        proposer = spec.get_beacon_proposer_index(
+            _state_at(spec, state, block.slot))
+        slashing = get_valid_proposer_slashing(
+            spec, state, proposer_index=proposer)
+        block.body.proposer_slashings.append(slashing)
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build)
+
+
+def _state_at(spec, state, slot):
+    temp = state.copy()
+    if temp.slot < slot:
+        spec.process_slots(temp, slot)
+    return temp
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_deposit_in_block(spec, state):
+    from ...test_infra.deposits import prepare_state_and_deposit
+    index = len(state.validators)
+    deposit = prepare_state_and_deposit(
+        spec, state, index, spec.MAX_EFFECTIVE_BALANCE, signed=True)
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.deposits.append(deposit)
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build)
+    if spec.is_post("electra"):
+        assert len(state.pending_deposits) == 1
+    else:
+        assert len(state.validators) == index + 1
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_deposit_top_up_in_block(spec, state):
+    from ...test_infra.deposits import prepare_state_and_deposit
+    amount = int(spec.MAX_EFFECTIVE_BALANCE) // 4
+    deposit = prepare_state_and_deposit(spec, state, 0, amount,
+                                        signed=True)
+    pre_balance = int(state.balances[0])
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.deposits.append(deposit)
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build)
+    if spec.is_post("electra"):
+        assert len(state.pending_deposits) == 1
+    else:
+        assert int(state.balances[0]) > pre_balance
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_voluntary_exit_in_block(spec, state):
+    from ...test_infra.slashings import get_valid_voluntary_exit
+    state.slot = uint64(
+        int(state.slot) + int(spec.config.SHARD_COMMITTEE_PERIOD)
+        * int(spec.SLOTS_PER_EPOCH))
+    exit_op = get_valid_voluntary_exit(spec, state, 3)
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.voluntary_exits.append(exit_op)
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build)
+    assert state.validators[3].exit_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_invalid_duplicate_validator_exit_same_block(spec, state):
+    from ...test_infra.slashings import get_valid_voluntary_exit
+    state.slot = uint64(
+        int(state.slot) + int(spec.config.SHARD_COMMITTEE_PERIOD)
+        * int(spec.SLOTS_PER_EPOCH))
+    exit_op = get_valid_voluntary_exit(spec, state, 3)
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.voluntary_exits.append(exit_op)
+        block.body.voluntary_exits.append(exit_op)
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_duplicate_attestation_same_block(spec, state):
+    # duplicate attestations are redundant but VALID
+    transition_to(
+        spec, state,
+        uint64(int(state.slot) + int(spec.MIN_ATTESTATION_INCLUSION_DELAY)))
+    attestation = get_valid_attestation(
+        spec, state, slot=uint64(int(state.slot) - 1), signed=True)
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.attestations.append(attestation)
+        block.body.attestations.append(attestation)
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_eth1_data_votes_consensus(spec, state):
+    # a majority of votes for one eth1 block adopts it
+    period = int(spec.EPOCHS_PER_ETH1_VOTING_PERIOD) \
+        * int(spec.SLOTS_PER_EPOCH)
+    eth1 = spec.Eth1Data(
+        deposit_root=b"\x11" * 32,
+        deposit_count=state.eth1_data.deposit_count,
+        block_hash=b"\x22" * 32)
+    needed = period // 2 + 1
+    def build(state):
+        out = []
+        for _ in range(needed):
+            block = build_empty_block_for_next_slot(spec, state)
+            block.body.eth1_data = eth1
+            out.append(state_transition_and_sign_block(spec, state, block))
+        return out
+    if period <= 64:
+        yield from _run_blocks(spec, state, build)
+        assert state.eth1_data == eth1
+    else:
+        # still emit a single-vote trajectory for mainnet-sized periods
+        def build_one(state):
+            block = build_empty_block_for_next_slot(spec, state)
+            block.body.eth1_data = eth1
+            return [state_transition_and_sign_block(spec, state, block)]
+        yield from _run_blocks(spec, state, build_one)
+        assert state.eth1_data != eth1

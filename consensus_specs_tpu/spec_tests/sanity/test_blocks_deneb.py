@@ -1,0 +1,70 @@
+"""Deneb blob-commitment whole-block sanity (reference
+test/deneb/sanity/test_blocks.py): blob counts from zero to the limit
+and past it, flowing through the full state_transition with the
+commitments in the body."""
+from ...test_infra.context import (
+    spec_state_test, with_all_phases_from, never_bls)
+from ...test_infra.blocks import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block)
+
+from .test_blocks import _run_blocks
+
+
+def _commitments(count):
+    return [b"\xc0" + bytes(47) for _ in range(count)]
+
+
+def _blob_block_case(spec, state, count, valid=True):
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.blob_kzg_commitments = _commitments(count)
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build, valid=valid)
+
+
+@with_all_phases_from("deneb")
+@spec_state_test
+@never_bls
+def test_zero_blob(spec, state):
+    yield from _blob_block_case(spec, state, 0)
+
+
+@with_all_phases_from("deneb")
+@spec_state_test
+@never_bls
+def test_one_blob(spec, state):
+    yield from _blob_block_case(spec, state, 1)
+
+
+@with_all_phases_from("deneb")
+@spec_state_test
+@never_bls
+def test_max_blobs_per_block(spec, state):
+    yield from _blob_block_case(spec, state,
+                                int(spec.max_blobs_per_block()))
+
+
+@with_all_phases_from("deneb")
+@spec_state_test
+@never_bls
+def test_invalid_exceed_max_blobs_per_block(spec, state):
+    yield from _blob_block_case(
+        spec, state, int(spec.max_blobs_per_block()) + 1, valid=False)
+
+
+@with_all_phases_from("deneb")
+@spec_state_test
+@never_bls
+def test_two_blob_blocks_in_a_row(spec, state):
+    """Commitment lists are per-block; consecutive blob blocks chain."""
+    pre_slot = int(state.slot)
+
+    def build(state):
+        out = []
+        for _ in range(2):
+            block = build_empty_block_for_next_slot(spec, state)
+            block.body.blob_kzg_commitments = _commitments(1)
+            out.append(state_transition_and_sign_block(spec, state, block))
+        return out
+    yield from _run_blocks(spec, state, build)
+    assert int(state.slot) == pre_slot + 2

@@ -27,7 +27,9 @@ def engine():
 
 def test_sharded_subtree_merkleization_is_byte_identical(engine):
     rng = np.random.default_rng(3)
-    for count in (64, 257, 1024):
+    # 1000: non-power-of-two but near-full (24 zero-pad chunks <=
+    # count/8), so the sharded path's padding branch actually runs
+    for count in (64, 1000, 1024):
         chunks = [rng.integers(0, 256, 32, dtype=np.uint8).tobytes()
                   for _ in range(count)]
         sharded = merkle.merkleize_chunks(chunks, limit=4096)
