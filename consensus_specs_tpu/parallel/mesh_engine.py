@@ -110,8 +110,11 @@ class MeshEngine:
         from ..ssz import merkle as ssz_merkle
         from ..specs import epoch_fast
         # only uninstall our own hooks — a later-enabled engine owns
-        # the globals now and must not be silently reverted
-        if ssz_merkle._subtree_hasher is self.subtree_root:
+        # the globals now and must not be silently reverted.  NB: bound
+        # methods are re-created per attribute access, so identity must
+        # be checked via __self__, never `is` on the method itself
+        installed = getattr(ssz_merkle._subtree_hasher, "__self__", None)
+        if installed is self:
             ssz_merkle.set_subtree_hasher(None)
         if epoch_fast.MESH_ENGINE is self:
             epoch_fast.MESH_ENGINE = None

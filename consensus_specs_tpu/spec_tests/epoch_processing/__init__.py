@@ -17,4 +17,7 @@ EPOCH_PROCESSING_HANDLERS = {
     "participation_updates":
         "consensus_specs_tpu.spec_tests.epoch_processing."
         "test_participation_updates",
+    "pending_queues":
+        "consensus_specs_tpu.spec_tests.epoch_processing."
+        "test_pending_queues",
 }

@@ -51,3 +51,76 @@ def test_genesis_epoch_no_op(spec, state):
     yield from run_epoch_processing_with(
         spec, state, "process_justification_and_finalization")
     assert state.justification_bits == pre_bits
+
+
+# ---------------------------------------------------------------------------
+# the four FFG finality rules x {sufficient, insufficient} support
+# (reference test_process_justification_and_finalization.py matrix)
+# ---------------------------------------------------------------------------
+
+from ...test_infra.finality_rules import (
+    finalize_on_234, finalize_on_23, finalize_on_123, finalize_on_12)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_234_ok_support(spec, state):
+    yield from finalize_on_234(spec, state, 5, sufficient_support=True)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_234_poor_support(spec, state):
+    yield from finalize_on_234(spec, state, 5, sufficient_support=False)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_23_ok_support(spec, state):
+    yield from finalize_on_23(spec, state, 4, sufficient_support=True)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_23_poor_support(spec, state):
+    yield from finalize_on_23(spec, state, 4, sufficient_support=False)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_123_ok_support(spec, state):
+    yield from finalize_on_123(spec, state, 6, sufficient_support=True)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_123_poor_support(spec, state):
+    yield from finalize_on_123(spec, state, 6, sufficient_support=False)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_12_ok_support(spec, state):
+    yield from finalize_on_12(spec, state, 3, sufficient_support=True)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_12_ok_support_messed_target(spec, state):
+    yield from finalize_on_12(spec, state, 3, sufficient_support=True,
+                              messed_up_target=True)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_12_poor_support(spec, state):
+    yield from finalize_on_12(spec, state, 3, sufficient_support=False)

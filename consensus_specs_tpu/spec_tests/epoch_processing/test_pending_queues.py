@@ -1,0 +1,270 @@
+"""Electra pending-queue epoch passes: process_pending_deposits
+(finalization + churn gating, postponement for exited validators,
+EIP-6110 bridge ordering) and process_pending_consolidations
+(withdrawable-epoch gating, slashed-source skip, balance moves).
+
+Reference batteries:
+test/electra/epoch_processing/pending_deposits/ and
+test_process_pending_consolidations.py.
+"""
+from ...ssz import uint64
+from ...test_infra.context import spec_state_test, with_all_phases_from
+from ...test_infra.epoch_processing import run_epoch_processing_with
+from ...test_infra.keys import pubkeys, privkeys
+from ...test_infra.deposits import build_deposit_data
+
+
+def _pending_deposit(spec, state, validator_index, amount, slot=0,
+                     valid_sig=True):
+    creds = b"\x01" + b"\x00" * 31
+    data = build_deposit_data(spec, pubkeys[validator_index],
+                              privkeys[validator_index], amount, creds,
+                              signed=valid_sig)
+    return spec.PendingDeposit(
+        pubkey=pubkeys[validator_index],
+        withdrawal_credentials=creds,
+        amount=uint64(int(amount)),
+        signature=data.signature,
+        slot=uint64(int(slot)))
+
+
+def _finalize_previous(spec, state) -> None:
+    state.finalized_checkpoint.epoch = uint64(
+        max(int(spec.get_current_epoch(state)) - 1, 0))
+
+
+# ---------------------------------------------------------------------------
+# pending deposits
+# ---------------------------------------------------------------------------
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_pending_deposit_top_up_applied(spec, state):
+    _finalize_previous(spec, state)
+    amount = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    state.pending_deposits.append(
+        _pending_deposit(spec, state, 0, amount))
+    pre = int(state.balances[0])
+    yield from run_epoch_processing_with(
+        spec, state, "process_pending_deposits")
+    assert int(state.balances[0]) == pre + amount
+    assert len(state.pending_deposits) == 0
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_pending_deposit_new_validator_valid_sig(spec, state):
+    _finalize_previous(spec, state)
+    fresh = len(state.validators)
+    state.pending_deposits.append(_pending_deposit(
+        spec, state, fresh, int(spec.MIN_ACTIVATION_BALANCE)))
+    yield from run_epoch_processing_with(
+        spec, state, "process_pending_deposits")
+    assert len(state.validators) == fresh + 1
+    assert state.validators[fresh].pubkey == pubkeys[fresh]
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_pending_deposit_new_validator_invalid_sig_dropped(spec, state):
+    """A new-validator deposit with a bad signature is consumed without
+    creating the validator (apply_pending_deposit's KeyValidate-style
+    gate)."""
+    _finalize_previous(spec, state)
+    fresh = len(state.validators)
+    dep = _pending_deposit(spec, state, fresh,
+                           int(spec.MIN_ACTIVATION_BALANCE),
+                           valid_sig=False)
+    dep.signature = b"\x11" + b"\x00" * 95
+    state.pending_deposits.append(dep)
+    yield from run_epoch_processing_with(
+        spec, state, "process_pending_deposits")
+    assert len(state.validators) == fresh
+    assert len(state.pending_deposits) == 0
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_pending_deposit_not_finalized_waits(spec, state):
+    """Deposits from unfinalized slots stay queued."""
+    _finalize_previous(spec, state)
+    far_slot = (int(spec.get_current_epoch(state)) + 10) \
+        * int(spec.SLOTS_PER_EPOCH)
+    state.pending_deposits.append(_pending_deposit(
+        spec, state, 0, int(spec.EFFECTIVE_BALANCE_INCREMENT),
+        slot=far_slot))
+    pre = int(state.balances[0])
+    yield from run_epoch_processing_with(
+        spec, state, "process_pending_deposits")
+    assert int(state.balances[0]) == pre
+    assert len(state.pending_deposits) == 1
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_pending_deposit_churn_limit_carries_balance(spec, state):
+    """Deposits beyond the activation churn wait; the unconsumed churn
+    accumulates in deposit_balance_to_consume."""
+    _finalize_previous(spec, state)
+    churn = int(spec.get_activation_exit_churn_limit(state))
+    big = churn + int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    state.pending_deposits.append(
+        _pending_deposit(spec, state, 0, big))
+    pre = int(state.balances[0])
+    yield from run_epoch_processing_with(
+        spec, state, "process_pending_deposits")
+    # too big for one epoch's churn: postponed, churn banked
+    assert int(state.balances[0]) == pre
+    assert len(state.pending_deposits) == 1
+    assert int(state.deposit_balance_to_consume) == churn
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_pending_deposit_exited_validator_postponed(spec, state):
+    """Deposits to an exited-but-not-withdrawn validator are postponed
+    to the back of the queue."""
+    _finalize_previous(spec, state)
+    state.validators[0].exit_epoch = uint64(
+        int(spec.get_current_epoch(state)) + 2)
+    state.validators[0].withdrawable_epoch = uint64(
+        int(spec.get_current_epoch(state)) + 10)
+    state.pending_deposits.append(_pending_deposit(
+        spec, state, 0, int(spec.EFFECTIVE_BALANCE_INCREMENT)))
+    pre = int(state.balances[0])
+    yield from run_epoch_processing_with(
+        spec, state, "process_pending_deposits")
+    assert int(state.balances[0]) == pre
+    assert len(state.pending_deposits) == 1
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_pending_deposit_withdrawn_validator_applied_free(spec, state):
+    """Deposits to a fully-withdrawable validator apply immediately,
+    outside the churn accounting."""
+    _finalize_previous(spec, state)
+    state.validators[0].exit_epoch = uint64(0)
+    state.validators[0].withdrawable_epoch = uint64(0)
+    amount = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    state.pending_deposits.append(
+        _pending_deposit(spec, state, 0, amount))
+    pre = int(state.balances[0])
+    yield from run_epoch_processing_with(
+        spec, state, "process_pending_deposits")
+    assert int(state.balances[0]) == pre + amount
+    assert len(state.pending_deposits) == 0
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_pending_deposit_eth1_bridge_pending_blocks_requests(spec, state):
+    """Deposit REQUESTS (slot > genesis) wait while eth1-bridge
+    deposits are still being drained (eth1_deposit_index behind
+    deposit_requests_start_index) — even once their slot is
+    finalized."""
+    from ...test_infra.blocks import next_epoch
+    # finalize well past the deposit's slot so ONLY the bridge gate can
+    # hold it back
+    for _ in range(3):
+        next_epoch(spec, state)
+    _finalize_previous(spec, state)
+    state.deposit_requests_start_index = uint64(
+        int(state.eth1_deposit_index) + 5)
+    state.pending_deposits.append(_pending_deposit(
+        spec, state, 0, int(spec.EFFECTIVE_BALANCE_INCREMENT), slot=1))
+    assert int(spec.compute_start_slot_at_epoch(
+        state.finalized_checkpoint.epoch)) > 1
+    yield from run_epoch_processing_with(
+        spec, state, "process_pending_deposits")
+    # the deposit stayed queued (earlier epoch passes may shift
+    # balances via penalties, so the queue length is the witness)
+    assert len(state.pending_deposits) == 1
+    assert state.pending_deposits[0].slot == uint64(1)
+
+
+# ---------------------------------------------------------------------------
+# pending consolidations
+# ---------------------------------------------------------------------------
+
+def _queue_consolidation(spec, state, source, target,
+                         withdrawable_delta=0):
+    state.validators[source].withdrawable_epoch = uint64(
+        int(spec.get_current_epoch(state)) + withdrawable_delta)
+    state.validators[source].exit_epoch = uint64(
+        int(spec.get_current_epoch(state)))
+    state.pending_consolidations.append(spec.PendingConsolidation(
+        source_index=source, target_index=target))
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_pending_consolidation_moves_balance(spec, state):
+    _queue_consolidation(spec, state, 0, 1)
+    src_balance = int(state.balances[0])
+    eff = int(state.validators[0].effective_balance)
+    moved = min(src_balance, eff)
+    pre_target = int(state.balances[1])
+    yield from run_epoch_processing_with(
+        spec, state, "process_pending_consolidations")
+    assert int(state.balances[1]) == pre_target + moved
+    assert int(state.balances[0]) == src_balance - moved
+    assert len(state.pending_consolidations) == 0
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_pending_consolidation_not_withdrawable_waits(spec, state):
+    _queue_consolidation(spec, state, 0, 1, withdrawable_delta=5)
+    pre = (int(state.balances[0]), int(state.balances[1]))
+    yield from run_epoch_processing_with(
+        spec, state, "process_pending_consolidations")
+    assert (int(state.balances[0]), int(state.balances[1])) == pre
+    assert len(state.pending_consolidations) == 1
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_pending_consolidation_slashed_source_skipped(spec, state):
+    """A slashed source forfeits the consolidation: the entry is
+    consumed with NO balance move."""
+    _queue_consolidation(spec, state, 0, 1)
+    state.validators[0].slashed = True
+    pre = (int(state.balances[0]), int(state.balances[1]))
+    yield from run_epoch_processing_with(
+        spec, state, "process_pending_consolidations")
+    assert (int(state.balances[0]), int(state.balances[1])) == pre
+    assert len(state.pending_consolidations) == 0
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_pending_consolidation_source_balance_capped_by_effective(
+        spec, state):
+    """Only min(balance, effective_balance) moves; the excess stays
+    with the source."""
+    _queue_consolidation(spec, state, 0, 1)
+    excess = int(spec.EFFECTIVE_BALANCE_INCREMENT) // 2
+    state.balances[0] = uint64(
+        int(state.validators[0].effective_balance) + excess)
+    pre_target = int(state.balances[1])
+    yield from run_epoch_processing_with(
+        spec, state, "process_pending_consolidations")
+    assert int(state.balances[0]) == excess
+    assert int(state.balances[1]) == pre_target + int(
+        state.validators[0].effective_balance)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_pending_consolidation_chain_stops_at_unwithdrawable(spec, state):
+    """Processing stops at the first not-yet-withdrawable source; later
+    entries wait even if themselves ready."""
+    _queue_consolidation(spec, state, 0, 1, withdrawable_delta=5)
+    _queue_consolidation(spec, state, 2, 3)
+    pre2 = int(state.balances[2])
+    yield from run_epoch_processing_with(
+        spec, state, "process_pending_consolidations")
+    # the ready entry behind the blocked head did NOT process
+    assert int(state.balances[2]) == pre2
+    assert len(state.pending_consolidations) == 2

@@ -51,3 +51,147 @@ def test_eligible_validator_gets_activated(spec, state):
         spec, state, "process_registry_updates")
     assert state.validators[index].activation_epoch != \
         spec.FAR_FUTURE_EPOCH
+
+
+def _queue_validator(spec, state, index, eligibility_epoch):
+    """Put validator `index` into the activation queue with a chosen
+    eligibility epoch."""
+    v = state.validators[index]
+    v.activation_epoch = spec.FAR_FUTURE_EPOCH
+    v.activation_eligibility_epoch = uint64(int(eligibility_epoch))
+
+
+def _finalize_now(spec, state) -> None:
+    # finalize the PREVIOUS epoch: finality can never lead the head
+    # (get_finality_delay = previous_epoch - finalized_epoch underflows
+    # otherwise)
+    state.finalized_checkpoint.epoch = uint64(
+        max(int(spec.get_current_epoch(state)) - 1, 0))
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_queue_no_activation_no_finality(spec, state):
+    """Eligible validators stay queued while finality lags behind
+    their eligibility epoch."""
+    from ...test_infra.blocks import next_epoch
+    next_epoch(spec, state)
+    index = 3
+    _queue_validator(spec, state, index,
+                     int(spec.get_current_epoch(state)) + 10)
+    # finalized checkpoint stays at genesis: eligibility not finalized
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    assert state.validators[index].activation_epoch == \
+        spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_queue_sorting(spec, state):
+    """Dequeue order follows (eligibility epoch, index); the churn
+    limit truncates the tail pre-electra (electra activates everyone
+    eligible — beacon-chain.md:825)."""
+    from ...test_infra.blocks import next_epoch
+    churn = int(spec.get_validator_churn_limit(state)) \
+        if not spec.is_post("electra") else None
+    mock_count = (churn + 2) if churn is not None else 6
+    mock_count = min(mock_count, len(state.validators) - 1)
+    # eligibility epochs must be <= the finalized epoch to dequeue
+    for _ in range(mock_count + 1):
+        next_epoch(spec, state)
+    _finalize_now(spec, state)
+    # later indices get EARLIER eligibility epochs: sorting must win
+    for k in range(mock_count):
+        _queue_validator(spec, state, k, mock_count - k)
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    activated = [k for k in range(mock_count)
+                 if state.validators[k].activation_epoch
+                 != spec.FAR_FUTURE_EPOCH]
+    if churn is None:
+        assert len(activated) == mock_count
+    else:
+        assert len(activated) == min(churn, mock_count)
+        # the k with the LARGEST eligibility epochs (smallest k) are
+        # the ones cut when the queue exceeds churn
+        expected = sorted(
+            range(mock_count),
+            key=lambda k: (mock_count - k, k))[:churn]
+        assert sorted(activated) == sorted(expected)
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_queue_efficiency(spec, state):
+    """Two epochs of queue draining activate two churn batches
+    pre-electra."""
+    if spec.is_post("electra"):
+        # unlimited activations: everything drains in one pass
+        return
+    from ...test_infra.blocks import next_epoch
+    churn = int(spec.get_validator_churn_limit(state))
+    mock_count = min(churn * 2, len(state.validators) - 1)
+    for _ in range(3):
+        next_epoch(spec, state)
+    _finalize_now(spec, state)
+    for k in range(mock_count):
+        _queue_validator(spec, state, k, 1)
+    spec.process_registry_updates(state)
+    first_batch = [k for k in range(mock_count)
+                   if state.validators[k].activation_epoch
+                   != spec.FAR_FUTURE_EPOCH]
+    assert len(first_batch) == min(churn, mock_count)
+    # churn is per-invocation: the SECOND yielded pass drains the rest
+    # (no epoch advance in between — next_epoch would run a full
+    # process_epoch and activate the batch outside the vector)
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    second_batch = [k for k in range(mock_count)
+                    if state.validators[k].activation_epoch
+                    != spec.FAR_FUTURE_EPOCH]
+    assert len(second_batch) == min(churn * 2, mock_count)
+
+
+@with_all_phases
+@spec_state_test
+def test_ejection_past_churn_limit(spec, state):
+    """Ejections are NOT churn-limited: every low-balance validator
+    exits, with exit epochs spread by the churn."""
+    churn = int(spec.get_validator_churn_limit(state)) \
+        if not spec.is_post("electra") else 2
+    eject_count = min(churn + 2, len(state.validators) // 2)
+    for k in range(eject_count):
+        state.validators[k].effective_balance = uint64(
+            int(spec.config.EJECTION_BALANCE))
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    assert all(
+        state.validators[k].exit_epoch != spec.FAR_FUTURE_EPOCH
+        for k in range(eject_count))
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_large_withdrawable_epoch(spec, state):
+    """An exit whose withdrawable epoch would overflow uint64 makes the
+    whole epoch transition fail (reference
+    test_invalid_large_withdrawable_epoch)."""
+    if spec.is_post("electra"):
+        # electra draws exit epochs from the balance-churn accumulator,
+        # not the registry max (beacon-chain.md:558-586)
+        state.earliest_exit_epoch = spec.FAR_FUTURE_EPOCH - uint64(1)
+    else:
+        state.validators[0].exit_epoch = (
+            spec.FAR_FUTURE_EPOCH - uint64(1))
+    state.validators[1].effective_balance = uint64(
+        int(spec.config.EJECTION_BALANCE))
+    yield "pre", state.copy()
+    try:
+        slot = uint64(int(state.slot) + int(spec.SLOTS_PER_EPOCH)
+                      - int(state.slot) % int(spec.SLOTS_PER_EPOCH))
+        spec.process_slots(state, slot)
+    except (ValueError, OverflowError):
+        yield "post", None
+        return
+    raise AssertionError("uint64 overflow unexpectedly tolerated")

@@ -37,3 +37,61 @@ def test_no_slashings_no_penalty(spec, state):
     pre = [int(b) for b in state.balances]
     yield from run_epoch_processing_with(spec, state, "process_slashings")
     assert [int(b) for b in state.balances] == pre
+
+
+@with_all_phases
+@spec_state_test
+def test_max_penalties(spec, state):
+    """Slashing one third of the stake maximizes the correlation
+    penalty: every slashed validator loses its whole effective
+    balance (pre-bellatrix multiplier 1 -> x3 cap; bellatrix+ x3/x2
+    reach the cap at a third)."""
+    n = len(state.validators)
+    slashed = list(range(n // 3))
+    _slash_validators_in_window(spec, state, slashed)
+    # slashings vector records a full third of the total balance
+    total = int(spec.get_total_active_balance(state))
+    epoch = int(spec.get_current_epoch(state))
+    state.slashings[epoch % spec.EPOCHS_PER_SLASHINGS_VECTOR] = \
+        uint64(total // 3)
+    yield from run_epoch_processing_with(spec, state, "process_slashings")
+    for i in slashed:
+        assert int(state.balances[i]) == 0 or \
+            int(state.balances[i]) < int(
+                state.validators[i].effective_balance)
+
+
+@with_all_phases
+@spec_state_test
+def test_minimal_penalty(spec, state):
+    """A single slashed validator among many: the proportional penalty
+    rounds down to whole increments (possibly zero pre-cap)."""
+    _slash_validators_in_window(spec, state, [4])
+    pre = int(state.balances[4])
+    yield from run_epoch_processing_with(spec, state, "process_slashings")
+    penalty = pre - int(state.balances[4])
+    incr = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    # a lone slashing is proportionally small — far below the whole
+    # effective balance
+    assert penalty < int(state.validators[4].effective_balance)
+    if not spec.is_post("electra"):
+        # pre-electra the quotient math quantizes to whole increments
+        # (electra's per-increment penalty rate does not)
+        assert penalty % incr == 0
+
+
+@with_all_phases
+@spec_state_test
+def test_slashings_out_of_window_untouched(spec, state):
+    """Slashed validators whose withdrawable epoch is OUTSIDE the
+    halfway window take no correlation penalty this epoch."""
+    epoch = int(spec.get_current_epoch(state))
+    v = state.validators[5]
+    v.slashed = True
+    # withdrawable far from epoch + EPOCHS_PER_SLASHINGS_VECTOR//2
+    v.withdrawable_epoch = uint64(epoch + 3)
+    state.slashings[epoch % spec.EPOCHS_PER_SLASHINGS_VECTOR] = \
+        uint64(int(v.effective_balance))
+    pre = int(state.balances[5])
+    yield from run_epoch_processing_with(spec, state, "process_slashings")
+    assert int(state.balances[5]) == pre

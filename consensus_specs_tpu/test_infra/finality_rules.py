@@ -1,0 +1,210 @@
+"""Finality-rule scenario builders for
+process_justification_and_finalization (the reference's
+test_process_justification_and_finalization.py mechanism: mock
+checkpoints in block_roots, preset justification bits, inject target
+attestations/participation at a chosen support level, then run the pass
+and check which FFG rule fired).
+
+Rules (fork-choice nomenclature): 234 and 23 finalize via the previous
+justified checkpoint; 123 and 12 via the current one.
+"""
+from __future__ import annotations
+
+from ..ssz import Bitvector, uint64
+from .blocks import transition_to
+
+
+def mock_checkpoints(spec, epoch):
+    """Checkpoints for 1..5 epochs ago with distinct mock roots."""
+    roots = [b"\xaa", b"\xbb", b"\xcc", b"\xdd", b"\xee"]
+    return [spec.Checkpoint(epoch=uint64(int(epoch) - k),
+                            root=roots[k - 1] * 32)
+            if int(epoch) >= k else None
+            for k in range(1, 6)]
+
+
+def put_checkpoints_in_block_roots(spec, state, checkpoints) -> None:
+    for c in checkpoints:
+        slot = int(spec.compute_start_slot_at_epoch(c.epoch))
+        state.block_roots[slot % int(spec.SLOTS_PER_HISTORICAL_ROOT)] = \
+            c.root
+
+
+def add_mock_target_attestations(spec, state, epoch, source, target,
+                                 sufficient_support=True,
+                                 messed_up_target=False) -> None:
+    """Inject target votes worth just over (or under) 2/3 of the active
+    balance for `epoch` (must be the previous or current epoch)."""
+    assert (int(state.slot) + 1) % int(spec.SLOTS_PER_EPOCH) == 0
+    previous_epoch = spec.get_previous_epoch(state)
+    current_epoch = spec.get_current_epoch(state)
+    assert int(epoch) in (int(previous_epoch), int(current_epoch))
+
+    total_balance = int(spec.get_total_active_balance(state))
+    remaining = total_balance * 2 // 3
+
+    if spec.is_post("altair"):
+        participation = (state.current_epoch_participation
+                         if int(epoch) == int(current_epoch)
+                         else state.previous_epoch_participation)
+    else:
+        attestations = (state.current_epoch_attestations
+                        if int(epoch) == int(current_epoch)
+                        else state.previous_epoch_attestations)
+
+    start_slot = int(spec.compute_start_slot_at_epoch(epoch))
+    committees_per_slot = int(
+        spec.get_committee_count_per_slot(state, epoch))
+    for slot in range(start_slot, start_slot + int(spec.SLOTS_PER_EPOCH)):
+        for index in range(committees_per_slot):
+            if remaining < 0:
+                return
+            committee = spec.get_beacon_committee(
+                state, uint64(slot), uint64(index))
+            bits = [0] * len(committee)
+            for v in range(len(committee) * 2 // 3 + 1):
+                if remaining > 0:
+                    remaining -= int(
+                        state.validators[committee[v]].effective_balance)
+                    bits[v] = 1
+                else:
+                    break
+            if not sufficient_support:
+                for i in range(max(len(committee) // 5, 1)):
+                    bits[i] = 0
+            if spec.is_post("altair"):
+                for i, vindex in enumerate(committee):
+                    if not bits[i]:
+                        continue
+                    flags = int(participation[int(vindex)])
+                    flags |= 1 << int(spec.TIMELY_HEAD_FLAG_INDEX)
+                    flags |= 1 << int(spec.TIMELY_SOURCE_FLAG_INDEX)
+                    if not messed_up_target:
+                        flags |= 1 << int(spec.TIMELY_TARGET_FLAG_INDEX)
+                    participation[int(vindex)] = flags
+            else:
+                data = spec.AttestationData(
+                    slot=uint64(slot), index=uint64(index),
+                    beacon_block_root=b"\xff" * 32,
+                    source=source, target=target)
+                if messed_up_target:
+                    data.target.root = b"\x99" * 32
+                attestations.append(spec.PendingAttestation(
+                    aggregation_bits=bits, data=data,
+                    inclusion_delay=uint64(1)))
+
+
+def _start(spec, state, epoch) -> None:
+    transition_to(
+        spec, state,
+        uint64(int(spec.SLOTS_PER_EPOCH) * int(epoch) - 1))
+
+
+def _set_bits(spec, state, indices) -> None:
+    state.justification_bits = Bitvector[
+        int(spec.JUSTIFICATION_BITS_LENGTH)]()
+    for i in indices:
+        state.justification_bits[i] = True
+
+
+def finalize_on_234(spec, state, epoch, sufficient_support):
+    """Rule 234: bits[1:3] justified; justifying epoch-2 with epoch-4
+    source finalizes the old previous-justified (epoch-4)."""
+    assert int(epoch) > 4
+    _start(spec, state, epoch)
+    c1, c2, c3, c4, _ = mock_checkpoints(spec, epoch)
+    put_checkpoints_in_block_roots(spec, state, [c1, c2, c3, c4])
+    old_finalized = state.finalized_checkpoint.copy()
+    state.previous_justified_checkpoint = c4
+    state.current_justified_checkpoint = c3
+    _set_bits(spec, state, [1, 2])
+    add_mock_target_attestations(spec, state, uint64(int(epoch) - 2),
+                                 c4, c2, sufficient_support)
+    yield from _run(spec, state)
+    assert state.previous_justified_checkpoint == c3
+    if sufficient_support:
+        assert state.current_justified_checkpoint == c2
+        assert state.finalized_checkpoint == c4
+    else:
+        assert state.current_justified_checkpoint == c3
+        assert state.finalized_checkpoint == old_finalized
+
+
+def finalize_on_23(spec, state, epoch, sufficient_support):
+    """Rule 23: bit[1] justified; justifying epoch-2 with epoch-3
+    source finalizes epoch-3."""
+    assert int(epoch) > 3
+    _start(spec, state, epoch)
+    c1, c2, c3, _, _ = mock_checkpoints(spec, epoch)
+    put_checkpoints_in_block_roots(spec, state, [c1, c2, c3])
+    old_finalized = state.finalized_checkpoint.copy()
+    state.previous_justified_checkpoint = c3
+    state.current_justified_checkpoint = c3
+    _set_bits(spec, state, [1])
+    add_mock_target_attestations(spec, state, uint64(int(epoch) - 2),
+                                 c3, c2, sufficient_support)
+    yield from _run(spec, state)
+    assert state.previous_justified_checkpoint == c3
+    if sufficient_support:
+        assert state.current_justified_checkpoint == c2
+        assert state.finalized_checkpoint == c3
+    else:
+        assert state.current_justified_checkpoint == c3
+        assert state.finalized_checkpoint == old_finalized
+
+
+def finalize_on_123(spec, state, epoch, sufficient_support):
+    """Rule 123: epoch-3 pre-justified (bit 1); epochs 2 and 1 justify
+    in THIS pass, making bits[0:3] contiguous — finalizes the old
+    current-justified (epoch-3)."""
+    assert int(epoch) > 5
+    _start(spec, state, epoch)
+    c1, c2, c3, c4, c5 = mock_checkpoints(spec, epoch)
+    put_checkpoints_in_block_roots(spec, state, [c1, c2, c3, c4, c5])
+    old_finalized = state.finalized_checkpoint.copy()
+    state.previous_justified_checkpoint = c5
+    state.current_justified_checkpoint = c3
+    _set_bits(spec, state, [1])
+    add_mock_target_attestations(spec, state, uint64(int(epoch) - 2),
+                                 c5, c2, sufficient_support)
+    add_mock_target_attestations(spec, state, uint64(int(epoch) - 1),
+                                 c3, c1, sufficient_support)
+    yield from _run(spec, state)
+    assert state.previous_justified_checkpoint == c3
+    if sufficient_support:
+        assert state.current_justified_checkpoint == c1
+        assert state.finalized_checkpoint == c3
+    else:
+        assert state.current_justified_checkpoint == c3
+        assert state.finalized_checkpoint == old_finalized
+
+
+def finalize_on_12(spec, state, epoch, sufficient_support,
+                   messed_up_target=False):
+    """Rule 12: epoch 2 justified; justifying epoch-1 with epoch-2
+    source finalizes epoch-2."""
+    assert int(epoch) > 2
+    _start(spec, state, epoch)
+    c1, c2, _, _, _ = mock_checkpoints(spec, epoch)
+    put_checkpoints_in_block_roots(spec, state, [c1, c2])
+    old_finalized = state.finalized_checkpoint.copy()
+    state.previous_justified_checkpoint = c2
+    state.current_justified_checkpoint = c2
+    _set_bits(spec, state, [0])
+    add_mock_target_attestations(spec, state, uint64(int(epoch) - 1),
+                                 c2, c1, sufficient_support,
+                                 messed_up_target)
+    yield from _run(spec, state)
+    assert state.previous_justified_checkpoint == c2
+    if sufficient_support and not messed_up_target:
+        assert state.current_justified_checkpoint == c1
+        assert state.finalized_checkpoint == c2
+    else:
+        assert state.current_justified_checkpoint == c2
+        assert state.finalized_checkpoint == old_finalized
+
+
+def _run(spec, state):
+    from .epoch_processing import run_epoch_processing_with
+    yield from run_epoch_processing_with(
+        spec, state, "process_justification_and_finalization")
