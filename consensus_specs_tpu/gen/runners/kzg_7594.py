@@ -119,6 +119,110 @@ def _recover_case(seed, name, keep_fn, expect_reject=False):
         case_name=f"{name}_{seed}", case_fn=fn)
 
 
+def _invalid_input_cases():
+    """Malformed-input batteries per handler (reference kzg_7594
+    invalid suites): bad blob lengths/elements, bad cell/point
+    encodings, index range errors — every must-reject asserted against
+    the library before emission."""
+    kz = _kzg()
+    blob = _blob(9)
+    commitment = kz.blob_to_kzg_commitment(blob)
+    cells, proofs = kz.compute_cells_and_kzg_proofs(blob)
+
+    def must_reject(fn, *args):
+        try:
+            fn(*args)
+        except (AssertionError, ValueError, IndexError):
+            return
+        raise RuntimeError("bad input accepted")
+
+    def case(handler, name, payload):
+        def fn():
+            yield "data", "data", payload
+        return TestCase(
+            fork_name="fulu", preset_name="general",
+            runner_name="kzg_7594", handler_name=handler,
+            suite_name="kzg", case_name=name, case_fn=fn)
+
+    bad_blobs = [
+        ("empty", b""),
+        ("short", blob[:-32]),
+        ("long", blob + blob[:32]),
+        ("noncanonical_element", b"\xff" * 32 + blob[32:]),
+    ]
+    for name, bad in bad_blobs:
+        must_reject(kz.compute_cells_and_kzg_proofs, bad)
+        yield case("compute_cells_and_kzg_proofs",
+                   f"compute_cells_invalid_blob_{name}",
+                   {"input": {"blob": "0x" + bad.hex()},
+                    "output": None})
+
+    # verify_cell_kzg_proof_batch: malformed commitment / proof / index
+    bad_commitment = b"\x12" + bytes(commitment)[1:]
+    must_reject(kz.verify_cell_kzg_proof_batch,
+                [bad_commitment], [0], [cells[0]], [proofs[0]])
+    yield case("verify_cell_kzg_proof_batch",
+               "verify_invalid_commitment",
+               {"input": {"row_commitments": ["0x" + bad_commitment.hex()],
+                          "cell_indices": [0],
+                          "cells": ["0x" + bytes(cells[0]).hex()],
+                          "proofs": ["0x" + bytes(proofs[0]).hex()]},
+                "output": None})
+    bad_proof = b"\x12" + bytes(proofs[0])[1:]
+    must_reject(kz.verify_cell_kzg_proof_batch,
+                [commitment], [0], [cells[0]], [bad_proof])
+    yield case("verify_cell_kzg_proof_batch", "verify_invalid_proof",
+               {"input": {"row_commitments": ["0x" + commitment.hex()],
+                          "cell_indices": [0],
+                          "cells": ["0x" + bytes(cells[0]).hex()],
+                          "proofs": ["0x" + bad_proof.hex()]},
+                "output": None})
+    must_reject(kz.verify_cell_kzg_proof_batch,
+                [commitment], [len(cells) * 2], [cells[0]], [proofs[0]])
+    yield case("verify_cell_kzg_proof_batch",
+               "verify_cell_index_out_of_range",
+               {"input": {"row_commitments": ["0x" + commitment.hex()],
+                          "cell_indices": [len(cells) * 2],
+                          "cells": ["0x" + bytes(cells[0]).hex()],
+                          "proofs": ["0x" + bytes(proofs[0]).hex()]},
+                "output": None})
+    short_cell = bytes(cells[0])[:-1]
+    must_reject(kz.verify_cell_kzg_proof_batch,
+                [commitment], [0], [short_cell], [proofs[0]])
+    yield case("verify_cell_kzg_proof_batch", "verify_short_cell",
+               {"input": {"row_commitments": ["0x" + commitment.hex()],
+                          "cell_indices": [0],
+                          "cells": ["0x" + short_cell.hex()],
+                          "proofs": ["0x" + bytes(proofs[0]).hex()]},
+                "output": None})
+
+    # recover: duplicate indices, out-of-range index, malformed cell
+    half = len(cells) // 2
+    ids = list(range(half))
+    keep = [cells[i] for i in ids]
+    must_reject(kz.recover_cells_and_kzg_proofs,
+                [0] * half, keep)
+    yield case("recover_cells_and_kzg_proofs",
+               "recover_duplicate_indices",
+               {"input": {"cell_indices": [0] * half,
+                          "cells": ["0x" + bytes(c).hex() for c in keep]},
+                "output": None})
+    must_reject(kz.recover_cells_and_kzg_proofs,
+                [len(cells) * 2] + ids[1:], keep)
+    yield case("recover_cells_and_kzg_proofs",
+               "recover_index_out_of_range",
+               {"input": {"cell_indices": [len(cells) * 2] + ids[1:],
+                          "cells": ["0x" + bytes(c).hex() for c in keep]},
+                "output": None})
+    must_reject(kz.recover_cells_and_kzg_proofs,
+                ids, [bytes(keep[0])[:-1]] + keep[1:])
+    yield case("recover_cells_and_kzg_proofs", "recover_short_cell",
+               {"input": {"cell_indices": ids,
+                          "cells": ["0x" + bytes(keep[0])[:-1].hex()]
+                          + ["0x" + bytes(c).hex() for c in keep[1:]]},
+                "output": None})
+
+
 def providers():
     def make_cases():
         yield _compute_cells_case(1)
@@ -131,6 +235,7 @@ def providers():
                             lambda n: list(range(n // 2, n)))
         yield _recover_case(6, "recover_scattered",
                             lambda n: list(range(0, n, 2)))
+        yield from _invalid_input_cases()
         yield _recover_case(7, "recover_insufficient",
                             lambda n: list(range(n // 2 - 1)),
                             expect_reject=True)

@@ -49,3 +49,80 @@ def test_initialize_beacon_state_from_eth1(spec):
     assert len(state.validators) == count
     assert spec.is_valid_genesis_state(state)
     yield "state", state
+
+
+@with_all_phases_from("phase0", to="deneb")
+@spec_test
+@never_bls
+def test_initialize_beacon_state_some_small_balances(spec):
+    """Deposits below MAX_EFFECTIVE_BALANCE still register; validators
+    under the activation threshold don't count toward genesis
+    validity."""
+    count = int(spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT)
+    small_amount = int(spec.config.EJECTION_BALANCE)
+    deposit_data_list = []
+    deposits = []
+    for i in range(count + 2):
+        amount = (spec.MAX_EFFECTIVE_BALANCE if i < count
+                  else uint64(small_amount))
+        wc = spec.BLS_WITHDRAWAL_PREFIX + bytes(
+            spec.hash(pubkeys[i]))[1:]
+        deposit, root, deposit_data_list = build_deposit(
+            spec, deposit_data_list, pubkeys[i], privkeys[i], amount,
+            wc, signed=True)
+        deposits.append(deposit)
+
+    eth1_block_hash = b"\x34" * 32
+    eth1_timestamp = int(spec.config.MIN_GENESIS_TIME)
+    yield "eth1", "data", {
+        "eth1_block_hash": "0x" + eth1_block_hash.hex(),
+        "eth1_timestamp": eth1_timestamp,
+    }
+    for i, d in enumerate(deposits):
+        yield f"deposits_{i}", d
+    yield "deposits_count", "meta", len(deposits)
+    state = spec.initialize_beacon_state_from_eth1(
+        eth1_block_hash, uint64(eth1_timestamp), deposits)
+    assert len(state.validators) == count + 2
+    # the small-balance validators are not active at genesis
+    active = spec.get_active_validator_indices(
+        state, spec.GENESIS_EPOCH)
+    assert len(active) == count
+    assert spec.is_valid_genesis_state(state)
+    yield "state", state
+
+
+@with_all_phases_from("phase0", to="deneb")
+@spec_test
+@never_bls
+def test_initialize_beacon_state_one_topup_activation(spec):
+    """Two half-balance deposits for the same key top up to an active
+    validator."""
+    count = int(spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT)
+    half = int(spec.MAX_EFFECTIVE_BALANCE) // 2
+    deposit_data_list = []
+    deposits = []
+    specs = [(i, int(spec.MAX_EFFECTIVE_BALANCE))
+             for i in range(count - 1)]
+    specs += [(count - 1, half), (count - 1, half)]
+    for key_index, amount in specs:
+        wc = spec.BLS_WITHDRAWAL_PREFIX + bytes(
+            spec.hash(pubkeys[key_index]))[1:]
+        deposit, _root, deposit_data_list = build_deposit(
+            spec, deposit_data_list, pubkeys[key_index],
+            privkeys[key_index], uint64(amount), wc, signed=True)
+        deposits.append(deposit)
+    eth1_block_hash = b"\x56" * 32
+    eth1_timestamp = int(spec.config.MIN_GENESIS_TIME)
+    yield "eth1", "data", {
+        "eth1_block_hash": "0x" + eth1_block_hash.hex(),
+        "eth1_timestamp": eth1_timestamp,
+    }
+    for i, d in enumerate(deposits):
+        yield f"deposits_{i}", d
+    yield "deposits_count", "meta", len(deposits)
+    state = spec.initialize_beacon_state_from_eth1(
+        eth1_block_hash, uint64(eth1_timestamp), deposits)
+    assert len(state.validators) == count
+    assert spec.is_valid_genesis_state(state)
+    yield "state", state

@@ -79,3 +79,127 @@ def test_empty_participation(spec, state):
     _prepare_participation(spec, state, full=False)
     yield "pre", state.copy()
     yield from _emit_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_half_participation(spec, state):
+    next_epoch(spec, state)
+    if spec.is_post("altair"):
+        n = len(state.validators)
+        full = 0
+        for i in range(len(spec.PARTICIPATION_FLAG_WEIGHTS)):
+            full = spec.add_flag(full, i)
+        state.previous_epoch_participation = [
+            full if i % 2 == 0 else 0 for i in range(n)]
+    else:
+        next_epoch_with_attestations(spec, state, False, True)
+        # halve the recorded aggregation bits
+        for att in state.previous_epoch_attestations:
+            bits = att.aggregation_bits
+            for j in range(len(bits)):
+                if j % 2:
+                    bits[j] = False
+    yield "pre", state.copy()
+    yield from _emit_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_quarter_participation(spec, state):
+    next_epoch(spec, state)
+    if spec.is_post("altair"):
+        n = len(state.validators)
+        full = 0
+        for i in range(len(spec.PARTICIPATION_FLAG_WEIGHTS)):
+            full = spec.add_flag(full, i)
+        state.previous_epoch_participation = [
+            full if i % 4 == 0 else 0 for i in range(n)]
+    else:
+        next_epoch_with_attestations(spec, state, False, True)
+        for att in state.previous_epoch_attestations:
+            bits = att.aggregation_bits
+            for j in range(len(bits)):
+                if j % 4:
+                    bits[j] = False
+    yield "pre", state.copy()
+    yield from _emit_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_correct_target_incorrect_head(spec, state):
+    """Target credit without head credit: head rewards vanish while
+    target/source rewards persist."""
+    next_epoch(spec, state)
+    if spec.is_post("altair"):
+        n = len(state.validators)
+        flags = spec.add_flag(
+            spec.add_flag(0, int(spec.TIMELY_SOURCE_FLAG_INDEX)),
+            int(spec.TIMELY_TARGET_FLAG_INDEX))
+        state.previous_epoch_participation = [flags] * n
+    else:
+        next_epoch_with_attestations(spec, state, False, True)
+        for att in state.previous_epoch_attestations:
+            att.data.beacon_block_root = b"\x77" * 32   # wrong head
+    yield "pre", state.copy()
+    yield from _emit_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_with_slashed_validators(spec, state):
+    _prepare_participation(spec, state, full=True)
+    epoch = int(spec.get_current_epoch(state))
+    for i in range(0, len(state.validators), 4):
+        state.validators[i].slashed = True
+        state.validators[i].withdrawable_epoch = uint64(
+            epoch + int(spec.EPOCHS_PER_SLASHINGS_VECTOR))
+    yield "pre", state.copy()
+    yield from _emit_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_with_exited_validators(spec, state):
+    # mutate BEFORE building participation: exits change the active
+    # set, hence committee shapes
+    epoch = int(spec.get_current_epoch(state)) + 1
+    for i in range(0, len(state.validators), 5):
+        state.validators[i].exit_epoch = uint64(max(epoch - 1, 1))
+        state.validators[i].withdrawable_epoch = uint64(epoch + 10)
+    _prepare_participation(spec, state, full=True)
+    yield "pre", state.copy()
+    yield from _emit_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_with_not_yet_activated_validators(spec, state):
+    # mutate BEFORE building participation (committee shapes)
+    epoch = int(spec.get_current_epoch(state)) + 1
+    for i in range(0, len(state.validators), 5):
+        state.validators[i].activation_epoch = uint64(epoch + 4)
+    _prepare_participation(spec, state, full=True)
+    yield "pre", state.copy()
+    yield from _emit_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_low_effective_balance_attesters(spec, state):
+    """Validators at the ejection-balance floor still earn
+    proportionally tiny rewards."""
+    _prepare_participation(spec, state, full=True)
+    for i in range(0, len(state.validators), 3):
+        state.validators[i].effective_balance = uint64(
+            int(spec.config.EJECTION_BALANCE))
+    yield "pre", state.copy()
+    yield from _emit_deltas(spec, state)

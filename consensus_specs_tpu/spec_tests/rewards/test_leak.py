@@ -59,3 +59,58 @@ def test_leak_full_participation(spec, state):
     if spec.is_post("altair"):
         _, inactivity = deltas[-1]
         assert sum(int(p) for p in inactivity.penalties) == 0
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_leak_half_participation_mixed_scores(spec, state):
+    """Half the registry leaks with climbing inactivity scores while
+    the other half participates with zeroed scores: penalties land
+    only on the idle half."""
+    _enter_leak(spec, state, participating=False)
+    n = len(state.validators)
+    flags = 0
+    for i in range(len(spec.PARTICIPATION_FLAG_WEIGHTS)):
+        flags = spec.add_flag(flags, i)
+    state.previous_epoch_participation = [
+        flags if i % 2 == 0 else 0 for i in range(n)]
+    state.inactivity_scores = [
+        0 if i % 2 == 0
+        else int(spec.config.INACTIVITY_SCORE_BIAS) * 8
+        for i in range(n)]
+    yield "pre", state.copy()
+    deltas = list(_emit_deltas(spec, state))
+    for name, d in deltas:
+        yield name, d
+    _, inactivity = deltas[-1]
+    for i in range(n):
+        if i % 2 == 0:
+            assert int(inactivity.penalties[i]) == 0
+        else:
+            assert int(inactivity.penalties[i]) > 0
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_leak_slashed_validators_still_penalized(spec, state):
+    """Slashed validators cannot earn target credit, so the leak's
+    inactivity penalty reaches them even if their flags are set."""
+    _enter_leak(spec, state, participating=True)
+    n = len(state.validators)
+    epoch = int(spec.get_current_epoch(state))
+    scores = list(state.inactivity_scores)
+    for i in range(0, n, 4):
+        state.validators[i].slashed = True
+        state.validators[i].withdrawable_epoch = uint64(
+            epoch + int(spec.EPOCHS_PER_SLASHINGS_VECTOR))
+        scores[i] = int(spec.config.INACTIVITY_SCORE_BIAS) * 4
+    state.inactivity_scores = scores
+    yield "pre", state.copy()
+    deltas = list(_emit_deltas(spec, state))
+    for name, d in deltas:
+        yield name, d
+    _, inactivity = deltas[-1]
+    for i in range(0, n, 4):
+        assert int(inactivity.penalties[i]) > 0

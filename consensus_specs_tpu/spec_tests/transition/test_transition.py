@@ -103,3 +103,85 @@ def test_transition_with_pending_attestations_translated(spec):
     yield "post_fork", "meta", "altair"
     yield "blocks_count", "meta", len(blocks)
     yield "post", post_state
+
+
+def _make_scenario_tests(pre_fork: str, post_fork: str):
+    """Extra per-boundary scenarios (reference transition battery
+    shapes: empty boundary slot, registry churn across the fork)."""
+    out = []
+
+    def missing_first_post_block(spec):
+        from ...ssz import uint64
+        post_spec = get_spec(post_fork, spec.preset_name)
+        state = _genesis_state(spec, default_balances,
+                               default_activation_threshold, "")
+        yield "pre", state.copy()
+        fork_epoch = 2
+        post_state, _no_block = transition_across(
+            spec, post_spec, state, fork_epoch, with_block=False)
+        # the first post-fork block lands one slot AFTER the boundary
+        blk = build_empty_block_for_next_slot(post_spec, post_state)
+        signed = state_transition_and_sign_block(
+            post_spec, post_state, blk)
+        yield "blocks_0", signed
+        yield "fork_epoch", "meta", fork_epoch
+        yield "post_fork", "meta", post_fork
+        yield "blocks_count", "meta", 1
+        yield "post", post_state
+        assert post_state.fork.current_version != \
+            state.fork.current_version
+
+    def activation_crosses_fork(spec):
+        from ...ssz import uint64
+        post_spec = get_spec(post_fork, spec.preset_name)
+        state = _genesis_state(spec, default_balances,
+                               default_activation_threshold, "")
+        # queue a validator whose activation lands post-fork
+        index = 2
+        v = state.validators[index]
+        v.activation_epoch = spec.FAR_FUTURE_EPOCH
+        v.activation_eligibility_epoch = uint64(1)
+        yield "pre", state.copy()
+        fork_epoch = 2
+        post_state, fork_block = transition_across(
+            spec, post_spec, state, fork_epoch, with_block=True)
+        blocks = [fork_block] if fork_block is not None else []
+        # finalize enough post-fork epochs for the activation to fire
+        from ...test_infra.blocks import next_epoch
+        post_state.finalized_checkpoint.epoch = uint64(
+            max(int(post_spec.get_current_epoch(post_state)) - 1, 1))
+        blk = build_empty_block_for_next_slot(post_spec, post_state)
+        blocks.append(state_transition_and_sign_block(
+            post_spec, post_state, blk))
+        for i, sb in enumerate(blocks):
+            yield f"blocks_{i}", sb
+        yield "fork_epoch", "meta", fork_epoch
+        yield "post_fork", "meta", post_fork
+        yield "blocks_count", "meta", len(blocks)
+        yield "post", post_state
+        if post_fork == "electra":
+            # upgrade_to_electra re-queues not-yet-active validators
+            # through the pending-deposit pipeline (electra/fork.md):
+            # eligibility resets and the balance waits in the queue
+            assert post_state.validators[index] \
+                .activation_eligibility_epoch == post_spec.FAR_FUTURE_EPOCH
+            assert any(
+                d.pubkey == post_state.validators[index].pubkey
+                for d in post_state.pending_deposits)
+        else:
+            # the registry entry survives the fork migration intact
+            assert post_state.validators[index] \
+                .activation_eligibility_epoch == uint64(1)
+
+    for fn, tag in [(missing_first_post_block, "missing_first_post_block"),
+                    (activation_crosses_fork, "activation_crosses_fork")]:
+        fn.__name__ = f"test_transition_{tag}_{pre_fork}_to_{post_fork}"
+        fn.__qualname__ = fn.__name__
+        out.append(with_phases([pre_fork])(spec_test(never_bls(fn))))
+    return out
+
+
+for _pre, _post in zip(MAINLINE_FORKS, MAINLINE_FORKS[1:]):
+    for _fn in _make_scenario_tests(_pre, _post):
+        globals()[_fn.__name__] = _fn
+del _fn

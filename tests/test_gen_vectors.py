@@ -196,8 +196,10 @@ def test_bls_and_kzg_runners(tmp_path):
     # spot-check one verify case replays
     import glob
     from consensus_specs_tpu.utils import bls as bls_shim
-    path = glob.glob(os.path.join(
-        out, "general/general/bls/verify/verify/verify_valid/data.yaml"))[0]
+    candidates = sorted(glob.glob(os.path.join(
+        out, "general/general/bls/verify/verify/verify_valid*/data.yaml")))
+    assert candidates, "no verify_valid case emitted"
+    path = candidates[0]
     case = yaml.safe_load(open(path))
     ok = bls_shim.Verify(
         bytes.fromhex(case["input"]["pubkey"][2:]),
