@@ -15,6 +15,7 @@ setup.py:373 `build_spec`):
 """
 from __future__ import annotations
 
+import ast
 import re
 import textwrap
 import types
@@ -55,13 +56,41 @@ class Config(types.SimpleNamespace):
     """Runtime-swappable config namespace."""
 
 
+_SAFE_EXPR_NODES = (
+    ast.Expression, ast.Constant, ast.Name, ast.Load, ast.Call,
+    ast.BinOp, ast.UnaryOp, ast.Tuple, ast.List, ast.keyword,
+    ast.Add, ast.Sub, ast.Mult, ast.Pow, ast.FloorDiv, ast.Mod,
+    ast.USub, ast.LShift, ast.RShift, ast.BitOr, ast.BitAnd,
+)
+
+
+def _check_safe_expr(expr: str) -> None:
+    """Gate for table cells emitted verbatim into the generated module
+    (which is exec'd): only name/call/arithmetic expressions, no
+    attribute access, subscripts, lambdas, comprehensions, or dunder
+    names.  Spec cells are name references and casts like
+    ``uint64(2**3)`` or ``Bytes4('0x01000000')`` — anything outside
+    that grammar is PUBLIC markdown trying to be code, so fail loud."""
+    tree = ast.parse(expr, mode="eval")
+    for node in ast.walk(tree):
+        if not isinstance(node, _SAFE_EXPR_NODES):
+            raise ValueError(
+                f"constant cell {expr!r}: disallowed syntax "
+                f"({type(node).__name__})")
+        if isinstance(node, ast.Name) and node.id.startswith("_"):
+            raise ValueError(
+                f"constant cell {expr!r}: underscore name {node.id!r}")
+
+
 def _const_rhs(expr: str) -> str:
     """Right-hand side for a constant: simple literals collapse to their
     value; anything referencing other names (uint64(...), 10 * BASE) is
-    emitted verbatim and evaluates in the generated module's namespace,
-    where the runtime types and earlier constants are in scope."""
+    emitted after passing the :func:`_check_safe_expr` whitelist and
+    evaluates in the generated module's namespace, where the runtime
+    types and earlier constants are in scope."""
     value = parse_value(expr)
     if isinstance(value, str) and value == expr.strip().strip("`"):
+        _check_safe_expr(value)
         return value        # unresolvable here: defer to module namespace
     return repr(value)
 

@@ -14,6 +14,7 @@ line-oriented GFM subset parser (no external markdown dependency):
 """
 from __future__ import annotations
 
+import ast
 import re
 from dataclasses import dataclass, field
 
@@ -161,11 +162,51 @@ def parse_markdown(text: str) -> ParsedSpec:
     return spec
 
 
+_LITERAL_BINOPS = {
+    ast.Add: lambda a, b: a + b,
+    ast.Sub: lambda a, b: a - b,
+    ast.Mult: lambda a, b: a * b,
+    ast.Pow: lambda a, b: a ** b,
+    ast.FloorDiv: lambda a, b: a // b,
+}
+
+
+def _eval_literal(node: "ast.AST"):
+    """Whitelist evaluator for constant-table cells.  Accepts only
+    int/str/bytes literals, unary minus, and +,-,*,**,// over those —
+    the grammar the spec tables actually use (`2**11`, `16 * 2**10`,
+    `4096`, `0x01`, `'BLS_SIG...'`).  Anything else (names, calls,
+    attribute access) raises, so markdown cells can never reach
+    attribute-walk escapes the way a bare ``eval`` could."""
+    if isinstance(node, ast.Expression):
+        return _eval_literal(node.body)
+    if isinstance(node, ast.Constant) and isinstance(
+            node.value, (int, str, bytes)):
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        operand = _eval_literal(node.operand)
+        if isinstance(operand, int):
+            return -operand
+        raise ValueError("unary minus on non-int")
+    if isinstance(node, ast.BinOp) and type(node.op) in _LITERAL_BINOPS:
+        left = _eval_literal(node.left)
+        right = _eval_literal(node.right)
+        if isinstance(left, int) and isinstance(right, int):
+            if isinstance(node.op, ast.Pow) and (
+                    right > 4096 or abs(left) > 1 << 64):
+                raise ValueError("exponent out of range")
+            return _LITERAL_BINOPS[type(node.op)](left, right)
+        raise ValueError("arithmetic on non-ints")
+    raise ValueError(f"disallowed literal node {type(node).__name__}")
+
+
 def parse_value(expr: str):
-    """Evaluate a constant cell: ints (any base, `2**n`, `10 * SOME`),
-    hex byte strings, quoted strings."""
+    """Evaluate a constant cell: ints (any base, `2**n`, `10 * 2**10`),
+    hex byte strings, quoted strings.  Uses a literal-only AST grammar —
+    never ``eval`` — because cells come from PUBLIC markdown
+    (reference `setup.py` trusts its own tree; we do not)."""
     expr = expr.strip().strip("`")
     try:
-        return eval(expr, {"__builtins__": {}}, {})  # noqa: S307 - spec
+        return _eval_literal(ast.parse(expr, mode="eval"))
     except Exception:
         return expr

@@ -356,3 +356,29 @@ def g2_from_bytes(data: bytes, subgroup_check: bool = True) -> Point:
     if subgroup_check and not p.in_subgroup():
         raise DecodeError("point not in G2 subgroup")
     return p
+
+
+def not_on_curve_x_g1() -> bytes:
+    """48-byte compressed encoding whose x IS a canonical field element
+    but x^3+4 is a quadratic non-residue — guaranteed to exercise the
+    decompression (sqrt-failure) reject path rather than the subgroup
+    check.  Deterministic: smallest such x.  Test-vector helper
+    (reference bls/kzg generators use hand-picked equivalents)."""
+    x = 2
+    while fq_sqrt((x * x * x + 4) % Q) is not None:
+        x += 1
+    enc = bytearray(x.to_bytes(48, "big"))
+    enc[0] |= 0x80
+    return bytes(enc)
+
+
+def not_on_curve_x_g2() -> bytes:
+    """96-byte compressed G2 encoding with x=(c0, 0) chosen so
+    x^3+4(1+u) has no Fq2 square root (same rationale as
+    :func:`not_on_curve_x_g1`)."""
+    c = 2
+    while (Fq2(c, 0).square() * Fq2(c, 0) + B2).sqrt() is not None:
+        c += 1
+    enc = bytearray((0).to_bytes(48, "big") + c.to_bytes(48, "big"))
+    enc[0] |= 0x80
+    return bytes(enc)

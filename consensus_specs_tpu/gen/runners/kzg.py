@@ -162,16 +162,15 @@ def _invalid_blobs():
 
 def _invalid_g1_points(kzg):
     """Malformed 48-byte G1 encodings (INVALID_G1_POINTS shape)."""
+    from ...crypto.curve import not_on_curve_x_g1
     good = bytearray(kzg.blob_to_kzg_commitment(_blob(0)))
-    not_on_curve = bytearray(good)
-    not_on_curve[-1] ^= 0x01
     return [
         ("zero_without_flag", b"\x00" * 48),
         ("infinity_with_x", b"\xc0" + b"\x00" * 46 + b"\x01"),
         ("x40_flag", b"\x40" + b"\x00" * 47),
         ("compression_bit_unset",
          bytes([good[0] & 0x7f]) + bytes(good[1:])),
-        ("not_on_curve", bytes(not_on_curve)),
+        ("not_on_curve", not_on_curve_x_g1()),
         ("short", bytes(good[:47])),
         ("long", bytes(good) + b"\x00"),
     ]

@@ -1,8 +1,8 @@
 """Measure the pure-Python oracle CPU baselines for BASELINE.json
 configs #1-#4.  The oracle fills the py_ecc slot (same algorithm class:
 pure-python BLS12-381), so these ARE the north-star denominators."""
-import sys, time, json
-sys.path.insert(0, "/root/repo")
+import os, sys, time, json
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 import jax; jax.config.update("jax_platforms", "cpu")
 
 from consensus_specs_tpu.crypto import curve as cv

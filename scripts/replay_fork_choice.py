@@ -5,7 +5,8 @@ attester_slashing), and assert each checks step against the rebuilt
 store.  Usage: python scripts/replay_fork_choice.py <vector-dir>
 """
 import sys, glob, os, yaml
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 from consensus_specs_tpu.specs import get_spec
 from consensus_specs_tpu.gen.snappy import decompress
