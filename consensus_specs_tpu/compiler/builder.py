@@ -20,7 +20,7 @@ import re
 import textwrap
 import types
 
-from .parser import ParsedSpec, parse_markdown, parse_value
+from .parser import ParsedSpec, _eval_literal, parse_markdown, parse_value
 
 _HEADER = '''\
 """GENERATED spec module — consensus_specs_tpu.compiler output."""
@@ -80,6 +80,20 @@ def _check_safe_expr(expr: str) -> None:
         if isinstance(node, ast.Name) and node.id.startswith("_"):
             raise ValueError(
                 f"constant cell {expr!r}: underscore name {node.id!r}")
+        if isinstance(node, ast.BinOp) and isinstance(
+                node.op, (ast.Pow, ast.LShift)):
+            # bound the magnitude the exec'd module can compute: the
+            # exponent/shift must itself be a small literal (`10**10**10`
+            # would otherwise hang the build — the DoS half of the
+            # untrusted-markdown threat)
+            try:
+                bound = _eval_literal(node.right)
+            except ValueError:
+                raise ValueError(
+                    f"constant cell {expr!r}: non-literal exponent")
+            if not isinstance(bound, int) or bound > 4096:
+                raise ValueError(
+                    f"constant cell {expr!r}: exponent out of range")
 
 
 def _const_rhs(expr: str) -> str:

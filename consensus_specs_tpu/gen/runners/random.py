@@ -7,6 +7,10 @@ from ..reflect import providers_from_handlers
 
 
 def providers():
+    base = "consensus_specs_tpu.spec_tests.random."
     return providers_from_handlers("random", {
-        "random": "consensus_specs_tpu.spec_tests.random.test_random",
+        "random": [base + "test_random"] + [
+            base + f"test_random_{fork}"
+            for fork in ("phase0", "altair", "bellatrix", "capella",
+                         "deneb", "electra")],
     })

@@ -8,6 +8,7 @@ trajectory, so randomized vectors are replay-exact.
 """
 from __future__ import annotations
 
+import itertools as _itertools
 import random as _random
 
 from ..ssz import uint64
@@ -88,14 +89,16 @@ def _skip_slashed_proposers(spec, state) -> None:
     raise AssertionError("no proposable slot within two epochs")
 
 
-def apply_random_block(spec, state, rng):
+def apply_random_block(spec, state, rng, block_fn=None):
     """Build and apply one random block; if the op mix turns out
     illegal in context, deterministically fall back to an empty
     block."""
+    if block_fn is None:
+        block_fn = random_block
     _skip_slashed_proposers(spec, state)
     scratch = state.copy()
     try:
-        block = random_block(spec, scratch, rng)
+        block = block_fn(spec, scratch, rng)
         signed = state_transition_and_sign_block(spec, scratch, block)
     except (AssertionError, ValueError, IndexError):
         block = build_empty_block_for_next_slot(spec, state)
@@ -129,3 +132,143 @@ def run_random_trajectory(spec, state, seed: int, slots: int = 8):
     blocks are valid by construction (illegal op mixes degrade to empty
     blocks, deterministically per seed)."""
     return list(trajectory_blocks(spec, state, seed, slots))
+
+
+# ── scenario-matrix machinery ─────────────────────────────────────────
+# Reference capability: tests/generators/random/generate.py code-gens 16
+# scenarios per fork = {no-leak, leak} × 8 shuffled (epoch-skip,
+# slot-position) combos, each with two random-block rounds
+# (test/utils/randomized_block_tests.py drives them).  Same matrix
+# shape here, original engine.
+
+SLOT_MODES = ("epoch_first", "immediate", "mid_epoch", "epoch_last")
+
+
+def scenario_matrix():
+    """16 deterministic scenarios: {no-leak, leak} × 8 paired
+    (epochs_to_skip, slot-position) combos.  The pairing across the two
+    rounds comes from two fixed-seed shuffles, so every combo appears in
+    each round exactly once and the matrix is stable across runs."""
+    combos = list(_itertools.product((0, 1), SLOT_MODES))
+    rng = _random.Random(20260730)
+    round1 = rng.sample(combos, len(combos))
+    round2 = rng.sample(combos, len(combos))
+    return [
+        {"leak": leak,
+         "rounds": ({"epochs": round1[i][0], "slot_mode": round1[i][1]},
+                    {"epochs": round2[i][0], "slot_mode": round2[i][1]})}
+        for leak in (False, True)
+        for i in range(len(combos))
+    ]
+
+
+def transition_to_leaking(spec, state) -> None:
+    """Advance through empty epochs (no attestations included) until
+    the inactivity leak engages (finality delay >
+    MIN_EPOCHS_TO_INACTIVITY_PENALTY)."""
+    spe = int(spec.SLOTS_PER_EPOCH)
+    for _ in range(16):
+        if spec.is_in_inactivity_leak(state):
+            return
+        spec.process_slots(state, uint64(int(state.slot) + spe))
+    raise AssertionError("inactivity leak never engaged")
+
+
+def _skip_to_block_pos(spec, state, mode: str, rng) -> None:
+    """Process empty slots so the NEXT block (built for state.slot+1)
+    lands at the requested position within an epoch: its first slot,
+    its last slot, strictly inside, or wherever we already are."""
+    if mode == "immediate":
+        return
+    spe = int(spec.SLOTS_PER_EPOCH)
+    target_pos = {"epoch_first": 0, "epoch_last": spe - 1}.get(mode)
+    if target_pos is None:                      # mid_epoch
+        target_pos = rng.randrange(1, spe - 1)
+    next_pos = (int(state.slot) + 1) % spe
+    skip = (target_pos - next_pos) % spe
+    if skip:
+        spec.process_slots(state, uint64(int(state.slot) + skip))
+
+
+def _random_address_change(spec, state, rng):
+    """A signed BLSToExecutionChange for a validator whose credentials
+    are still the BLS (0x00) form derived from the shared test key
+    table.  Never mutates state — validity on a scratch copy must
+    imply validity on the state the block is replayed onto (a prior
+    round may already have rotated some validators' credentials)."""
+    from .keys import privkeys, pubkeys
+    from ..utils import bls as _bls
+    candidates = [
+        i for i in range(len(state.validators))
+        if bytes(state.validators[i].withdrawal_credentials)
+        == bytes(spec.BLS_WITHDRAWAL_PREFIX)
+        + bytes(spec.hash(pubkeys[i]))[1:]]
+    assert candidates, "no BLS-credentialed validators left"
+    index = rng.choice(candidates)
+    from_pubkey = pubkeys[index]
+    change = spec.BLSToExecutionChange(
+        validator_index=uint64(index),
+        from_bls_pubkey=from_pubkey,
+        to_execution_address=bytes([rng.randrange(256)] * 20))
+    domain = spec.compute_domain(
+        spec.DOMAIN_BLS_TO_EXECUTION_CHANGE,
+        genesis_validators_root=state.genesis_validators_root)
+    signature = _bls.Sign(privkeys[index],
+                          spec.compute_signing_root(change, domain))
+    return spec.SignedBLSToExecutionChange(message=change,
+                                           signature=signature)
+
+
+def random_block_for(spec, state, rng):
+    """Fork-aware random block: the phase0 op mix plus, per fork,
+    sync aggregates with cycling participation (altair+), BLS→execution
+    address changes (capella+), and blob commitments (deneb+)."""
+    block = random_block(spec, state, rng)
+    if spec.is_post("altair") and rng.random() < 0.7:
+        from .sync_committee import get_sync_aggregate
+        frac = rng.choice((1.0, 0.5, 0.0))       # cycling participation
+        committee_rng = _random.Random(rng.randrange(1 << 30))
+        # sign from a lookahead at the block's slot so the message is
+        # the block root process_sync_aggregate will verify (the root
+        # at block.slot-1 under that slot's domain), matching the
+        # op-test call sites that transition before signing
+        look = state.copy()
+        spec.process_slots(look, uint64(block.slot))
+        block.body.sync_aggregate = get_sync_aggregate(
+            spec, look,
+            participation_fn=lambda _p: committee_rng.random() < frac)
+    if spec.is_post("capella") and rng.random() < 0.25:
+        block.body.bls_to_execution_changes = [
+            _random_address_change(spec, state, rng)]
+    if spec.is_post("deneb") and rng.random() < 0.3:
+        from .keys import pubkeys
+        n = rng.randrange(1, int(spec.max_blobs_per_block()) + 1)
+        block.body.blob_kzg_commitments = [
+            bytes(pubkeys[rng.randrange(64)]) for _ in range(n)]
+    return block
+
+
+def run_randomized_scenario(spec, state, scenario, seed: int):
+    """Drive one matrix scenario end to end and yield the standard
+    sanity-blocks vector shape (pre, blocks_<i>, post).  Warm past the
+    genesis epoch, scramble the state, optionally engage the leak, then
+    run the two (epoch-skip, slot-position, random block) rounds."""
+    rng = rng_for(spec, seed)
+    transition_to(spec, state, uint64(int(spec.SLOTS_PER_EPOCH) * 2))
+    randomize_state(spec, state, rng)
+    if scenario["leak"]:
+        transition_to_leaking(spec, state)
+    yield "pre", state.copy()
+    signed = []
+    spe = int(spec.SLOTS_PER_EPOCH)
+    for rnd in scenario["rounds"]:
+        if rnd["epochs"]:
+            boundary = (int(state.slot) // spe + rnd["epochs"]) * spe
+            spec.process_slots(state, uint64(boundary))
+        _skip_to_block_pos(spec, state, rnd["slot_mode"], rng)
+        signed.append(apply_random_block(spec, state, rng,
+                                         block_fn=random_block_for))
+    for i, sb in enumerate(signed):
+        yield f"blocks_{i}", sb
+    yield "blocks_count", "meta", len(signed)
+    yield "post", state
