@@ -64,13 +64,59 @@ _SAFE_EXPR_NODES = (
 )
 
 
+_MAX_CONST_BITS = 1 << 16
+
+
+def _bit_bound(node) -> int:
+    """Abstract upper bound on the bit-length a cell expression can
+    produce when the generated module exec's it.  Names are assumed to
+    be ≤256-bit spec constants; exponents/shifts must be small static
+    literals.  Composes through the whole tree, so nested forms like
+    ``((2**4096)**4096)**4096`` are bounded (each Pow multiplies the
+    operand's bound), closing the build-hang DoS a per-node exponent
+    check misses."""
+    if isinstance(node, ast.Expression):
+        return _bit_bound(node.body)
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, int):
+            return max(int(node.value).bit_length(), 1)
+        return max(len(str(node.value)) * 8, 1)
+    if isinstance(node, ast.Name):
+        return 256
+    if isinstance(node, ast.Call):
+        return max([_bit_bound(a) for a in node.args] + [256])
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return max([_bit_bound(e) for e in node.elts] + [1])
+    if isinstance(node, ast.UnaryOp):
+        return _bit_bound(node.operand)
+    if isinstance(node, ast.BinOp):
+        left = _bit_bound(node.left)
+        op = node.op
+        if isinstance(op, (ast.Add, ast.Sub, ast.BitOr, ast.BitAnd,
+                           ast.Mod, ast.FloorDiv, ast.RShift)):
+            return max(left, _bit_bound(node.right)) + 1
+        if isinstance(op, ast.Mult):
+            return left + _bit_bound(node.right)
+        if isinstance(op, (ast.Pow, ast.LShift)):
+            try:
+                exp = _eval_literal(node.right)
+            except ValueError:
+                raise ValueError("non-literal exponent/shift")
+            if not isinstance(exp, int) or not 0 <= exp <= 4096:
+                raise ValueError("exponent out of range")
+            return left + exp if isinstance(op, ast.LShift) \
+                else left * max(exp, 1)
+    raise ValueError(f"unbounded node {type(node).__name__}")
+
+
 def _check_safe_expr(expr: str) -> None:
     """Gate for table cells emitted verbatim into the generated module
     (which is exec'd): only name/call/arithmetic expressions, no
     attribute access, subscripts, lambdas, comprehensions, or dunder
-    names.  Spec cells are name references and casts like
-    ``uint64(2**3)`` or ``Bytes4('0x01000000')`` — anything outside
-    that grammar is PUBLIC markdown trying to be code, so fail loud."""
+    names, and a composed magnitude bound (:func:`_bit_bound`).  Spec
+    cells are name references and casts like ``uint64(2**3)`` or
+    ``Bytes4('0x01000000')`` — anything outside that grammar is PUBLIC
+    markdown trying to be code, so fail loud."""
     tree = ast.parse(expr, mode="eval")
     for node in ast.walk(tree):
         if not isinstance(node, _SAFE_EXPR_NODES):
@@ -80,20 +126,14 @@ def _check_safe_expr(expr: str) -> None:
         if isinstance(node, ast.Name) and node.id.startswith("_"):
             raise ValueError(
                 f"constant cell {expr!r}: underscore name {node.id!r}")
-        if isinstance(node, ast.BinOp) and isinstance(
-                node.op, (ast.Pow, ast.LShift)):
-            # bound the magnitude the exec'd module can compute: the
-            # exponent/shift must itself be a small literal (`10**10**10`
-            # would otherwise hang the build — the DoS half of the
-            # untrusted-markdown threat)
-            try:
-                bound = _eval_literal(node.right)
-            except ValueError:
-                raise ValueError(
-                    f"constant cell {expr!r}: non-literal exponent")
-            if not isinstance(bound, int) or bound > 4096:
-                raise ValueError(
-                    f"constant cell {expr!r}: exponent out of range")
+    try:
+        bits = _bit_bound(tree)
+    except ValueError as exc:
+        raise ValueError(f"constant cell {expr!r}: {exc}")
+    if bits > _MAX_CONST_BITS:
+        raise ValueError(
+            f"constant cell {expr!r}: magnitude bound {bits} bits "
+            f"exceeds {_MAX_CONST_BITS}")
 
 
 def _const_rhs(expr: str) -> str:

@@ -1,5 +1,9 @@
 """Cross-fork transition spec tests."""
 
 TRANSITION_HANDLERS = {
-    "core": "consensus_specs_tpu.spec_tests.transition.test_transition",
+    "core": [
+        "consensus_specs_tpu.spec_tests.transition.test_transition",
+        "consensus_specs_tpu.spec_tests.transition."
+        "test_transition_battery",
+    ],
 }

@@ -1,0 +1,795 @@
+"""Fork-boundary transition battery.
+
+Reference capability: test/altair/transition/{test_transition,
+test_operations, test_leaking, test_activations_and_exits,
+test_slashing}.py — 26 scenario shapes applied to every mainline fork
+pair (the reference instantiates them per pair via with_fork_metas;
+here each def runs for every pre-fork via @with_phases, the post fork
+being the next rung of the ladder).  All cases emit the transition
+vector format (tests/formats/transition/README.md: pre + blocks_<i> +
+meta{post_fork, fork_epoch, fork_block?, blocks_count} + post).
+"""
+from ...specs import get_spec
+from ...ssz import Bytes32, uint64
+from ...test_infra.context import (
+    MAINLINE_FORKS, _genesis_state, default_activation_threshold,
+    default_balances, never_bls, spec_test, with_phases,
+    with_presets, with_pytest_fork_subset)
+from ...test_infra.attestations import get_valid_attestation
+from ...test_infra.blocks import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block)
+from ...test_infra.deposits import prepare_state_and_deposit
+from ...test_infra.fork_transition import transition_across
+from ...test_infra.random import randomize_state, rng_for
+from ...test_infra.slashings import (
+    get_valid_attester_slashing, get_valid_proposer_slashing,
+    get_valid_voluntary_exit)
+
+# each test's `spec` is the PRE fork; the post fork is the next rung
+PRE_FORKS = MAINLINE_FORKS[:-1]
+# default-pytest boundary subset (generator mode still runs them all):
+# first boundary, payload-carrying boundary, attestation-shape boundary
+PYTEST_BOUNDARIES = ["phase0", "capella", "deneb"]
+
+
+def _post_spec(spec):
+    nxt = MAINLINE_FORKS[MAINLINE_FORKS.index(spec.fork) + 1]
+    return get_spec(nxt, spec.preset_name)
+
+
+def _pre_state(spec):
+    return _genesis_state(spec, default_balances,
+                          default_activation_threshold, "")
+
+
+def _emit(pre, blocks, post_state, post_spec, fork_epoch,
+          fork_block=None):
+    yield "pre", pre
+    for i, sb in enumerate(blocks):
+        yield f"blocks_{i}", sb
+    if fork_block is not None:
+        yield "fork_block", "meta", int(fork_block)
+    yield "fork_epoch", "meta", int(fork_epoch)
+    yield "post_fork", "meta", post_spec.fork
+    yield "blocks_count", "meta", len(blocks)
+    yield "post", post_state
+
+
+def _attest_filter(participation):
+    if participation >= 1.0:
+        return None
+    return lambda parts: set(
+        sorted(parts)[:max(1, int(len(parts) * participation))])
+
+
+def _blocks_until(spec, state, target_slot: int, *, attest=True,
+                  participation=1.0):
+    """Signed blocks at every slot through target_slot; committees of
+    the prior slot attest (fraction `participation` each)."""
+    blocks = []
+    while int(state.slot) < target_slot:
+        block = build_empty_block_for_next_slot(spec, state)
+        if attest and int(state.slot) >= int(
+                spec.MIN_ATTESTATION_INCLUSION_DELAY):
+            slot_to_attest = uint64(
+                int(state.slot)
+                - int(spec.MIN_ATTESTATION_INCLUSION_DELAY) + 1)
+            cps = spec.get_committee_count_per_slot(
+                state, spec.compute_epoch_at_slot(slot_to_attest))
+            for index in range(cps):
+                block.body.attestations.append(get_valid_attestation(
+                    spec, state, slot=slot_to_attest, index=index,
+                    filter_participant_set=_attest_filter(participation),
+                    signed=True))
+        blocks.append(
+            state_transition_and_sign_block(spec, state, block))
+    return blocks
+
+
+def _post_epoch_blocks(post_spec, post_state, epochs=1, attest=True):
+    """Blocks for `epochs` post-fork epochs (every slot, attested)."""
+    spe = int(post_spec.SLOTS_PER_EPOCH)
+    target = (int(post_state.slot) // spe + epochs) * spe
+    return _blocks_until(post_spec, post_state, target, attest=attest)
+
+
+def _versions_differ(pre, post_state):
+    assert post_state.fork.current_version != pre.fork.current_version
+
+
+# ── core trajectories (reference test_transition.py shapes) ──────────
+
+@with_phases(PRE_FORKS)
+@with_pytest_fork_subset(PRE_FORKS)     # cheap: keep all boundaries
+@spec_test
+@never_bls
+def test_simple_transition(spec):
+    """One pre-fork block, the boundary block, one post-fork block."""
+    post_spec = _post_spec(spec)
+    state = _pre_state(spec)
+    pre = state.copy()
+    fork_epoch = 2
+    blocks = [state_transition_and_sign_block(
+        spec, state, build_empty_block_for_next_slot(spec, state))]
+    fork_block_index = len(blocks) - 1
+    post_state, fb = transition_across(spec, post_spec, state, fork_epoch)
+    blocks.append(fb)
+    blocks.append(state_transition_and_sign_block(
+        post_spec, post_state,
+        build_empty_block_for_next_slot(post_spec, post_state)))
+    _versions_differ(pre, post_state)
+    yield from _emit(pre, blocks, post_state, post_spec, fork_epoch,
+                     fork_block=fork_block_index)
+
+
+@with_phases(PRE_FORKS)
+@with_pytest_fork_subset(PYTEST_BOUNDARIES)
+@spec_test
+@never_bls
+def test_normal_transition(spec):
+    """Attestation-filled blocks at every slot through the boundary and
+    one full post-fork epoch — continuous chain, no gaps."""
+    post_spec = _post_spec(spec)
+    state = _pre_state(spec)
+    pre = state.copy()
+    fork_epoch = 2
+    boundary = fork_epoch * int(spec.SLOTS_PER_EPOCH)
+    blocks = _blocks_until(spec, state, boundary - 1)
+    fork_block_index = len(blocks) - 1
+    post_state, fb = transition_across(spec, post_spec, state, fork_epoch)
+    blocks.append(fb)
+    blocks += _post_epoch_blocks(post_spec, post_state)
+    # every slot has a block
+    assert len(blocks) == int(post_state.slot)
+    _versions_differ(pre, post_state)
+    yield from _emit(pre, blocks, post_state, post_spec, fork_epoch,
+                     fork_block=fork_block_index)
+
+
+@with_phases(PRE_FORKS)
+@with_pytest_fork_subset(PYTEST_BOUNDARIES)
+@spec_test
+@never_bls
+def test_transition_randomized_state(spec):
+    """Scrambled balances/participation/inactivity before the upgrade —
+    the fork migration must carry arbitrary (legal) state content."""
+    post_spec = _post_spec(spec)
+    state = _pre_state(spec)
+    randomize_state(spec, state, rng_for(spec, seed=0xF0F0))
+    pre = state.copy()
+    fork_epoch = 2
+    post_state, fb = transition_across(spec, post_spec, state, fork_epoch)
+    blocks = [fb]
+    blocks.append(state_transition_and_sign_block(
+        post_spec, post_state,
+        build_empty_block_for_next_slot(post_spec, post_state)))
+    _versions_differ(pre, post_state)
+    yield from _emit(pre, blocks, post_state, post_spec, fork_epoch)
+
+
+@with_phases(PRE_FORKS)
+@with_pytest_fork_subset(PRE_FORKS)     # cheap: keep all boundaries
+@spec_test
+@never_bls
+def test_transition_missing_first_post_block(spec):
+    """No block at the boundary slot: the first post-fork block lands
+    one slot later."""
+    post_spec = _post_spec(spec)
+    state = _pre_state(spec)
+    pre = state.copy()
+    fork_epoch = 2
+    blocks = [state_transition_and_sign_block(
+        spec, state, build_empty_block_for_next_slot(spec, state))]
+    fork_block_index = len(blocks) - 1
+    post_state, _none = transition_across(
+        spec, post_spec, state, fork_epoch, with_block=False)
+    blocks.append(state_transition_and_sign_block(
+        post_spec, post_state,
+        build_empty_block_for_next_slot(post_spec, post_state)))
+    _versions_differ(pre, post_state)
+    yield from _emit(pre, blocks, post_state, post_spec, fork_epoch,
+                     fork_block=fork_block_index)
+
+
+@with_phases(PRE_FORKS)
+@with_pytest_fork_subset(PYTEST_BOUNDARIES)
+@spec_test
+@never_bls
+def test_transition_missing_last_pre_fork_block(spec):
+    """Blocks every slot except the last pre-fork slot stays empty."""
+    post_spec = _post_spec(spec)
+    state = _pre_state(spec)
+    pre = state.copy()
+    fork_epoch = 2
+    boundary = fork_epoch * int(spec.SLOTS_PER_EPOCH)
+    blocks = _blocks_until(spec, state, boundary - 2)
+    fork_block_index = len(blocks) - 1
+    post_state, fb = transition_across(spec, post_spec, state, fork_epoch)
+    blocks.append(fb)
+    blocks.append(state_transition_and_sign_block(
+        post_spec, post_state,
+        build_empty_block_for_next_slot(post_spec, post_state)))
+    _versions_differ(pre, post_state)
+    yield from _emit(pre, blocks, post_state, post_spec, fork_epoch,
+                     fork_block=fork_block_index)
+
+
+@with_phases(PRE_FORKS)
+@with_pytest_fork_subset(PYTEST_BOUNDARIES)
+@spec_test
+@never_bls
+def test_transition_only_blocks_post_fork(spec):
+    """No pre-fork blocks at all; the chain starts producing only after
+    the upgrade (skipping the boundary slot too)."""
+    post_spec = _post_spec(spec)
+    state = _pre_state(spec)
+    pre = state.copy()
+    fork_epoch = 2
+    post_state, _none = transition_across(
+        spec, post_spec, state, fork_epoch, with_block=False)
+    blocks = _post_epoch_blocks(post_spec, post_state, attest=False)
+    _versions_differ(pre, post_state)
+    yield from _emit(pre, blocks, post_state, post_spec, fork_epoch)
+
+
+@with_phases(PRE_FORKS)
+@with_pytest_fork_subset(PYTEST_BOUNDARIES)
+@spec_test
+@never_bls
+def test_transition_with_finality(spec):
+    """Full participation for two pre-fork epochs and two post-fork
+    epochs: finality must advance across the boundary."""
+    post_spec = _post_spec(spec)
+    state = _pre_state(spec)
+    pre = state.copy()
+    fork_epoch = 2
+    boundary = fork_epoch * int(spec.SLOTS_PER_EPOCH)
+    blocks = _blocks_until(spec, state, boundary - 1)
+    fork_block_index = len(blocks) - 1
+    post_state, fb = transition_across(spec, post_spec, state, fork_epoch)
+    blocks.append(fb)
+    blocks += _post_epoch_blocks(post_spec, post_state, epochs=2)
+    assert int(post_state.finalized_checkpoint.epoch) >= fork_epoch
+    _versions_differ(pre, post_state)
+    yield from _emit(pre, blocks, post_state, post_spec, fork_epoch,
+                     fork_block=fork_block_index)
+
+
+@with_phases(PRE_FORKS)
+@with_pytest_fork_subset(PYTEST_BOUNDARIES)
+@spec_test
+@never_bls
+def test_transition_with_random_three_quarters_participation(spec):
+    """~75% of every committee attests through the boundary."""
+    post_spec = _post_spec(spec)
+    state = _pre_state(spec)
+    pre = state.copy()
+    fork_epoch = 2
+    boundary = fork_epoch * int(spec.SLOTS_PER_EPOCH)
+    blocks = _blocks_until(spec, state, boundary - 1, participation=0.75)
+    fork_block_index = len(blocks) - 1
+    post_state, fb = transition_across(spec, post_spec, state, fork_epoch)
+    blocks.append(fb)
+    blocks += _post_epoch_blocks(post_spec, post_state)
+    _versions_differ(pre, post_state)
+    yield from _emit(pre, blocks, post_state, post_spec, fork_epoch,
+                     fork_block=fork_block_index)
+
+
+@with_phases(PRE_FORKS)
+@with_pytest_fork_subset(PYTEST_BOUNDARIES)
+@spec_test
+@never_bls
+def test_transition_with_random_half_participation(spec):
+    """~50% participation: justification may stall, the chain must not."""
+    post_spec = _post_spec(spec)
+    state = _pre_state(spec)
+    pre = state.copy()
+    fork_epoch = 2
+    boundary = fork_epoch * int(spec.SLOTS_PER_EPOCH)
+    blocks = _blocks_until(spec, state, boundary - 1, participation=0.5)
+    fork_block_index = len(blocks) - 1
+    post_state, fb = transition_across(spec, post_spec, state, fork_epoch)
+    blocks.append(fb)
+    blocks += _post_epoch_blocks(post_spec, post_state)
+    _versions_differ(pre, post_state)
+    yield from _emit(pre, blocks, post_state, post_spec, fork_epoch,
+                     fork_block=fork_block_index)
+
+
+@with_phases(PRE_FORKS)
+@with_pytest_fork_subset(PYTEST_BOUNDARIES)
+@spec_test
+@never_bls
+def test_transition_with_no_attestations_until_after_fork(spec):
+    """Empty blocks pre-fork; attestations only start under the post
+    fork, whose participation accounting must pick them up."""
+    post_spec = _post_spec(spec)
+    state = _pre_state(spec)
+    pre = state.copy()
+    fork_epoch = 2
+    boundary = fork_epoch * int(spec.SLOTS_PER_EPOCH)
+    blocks = _blocks_until(spec, state, boundary - 1, attest=False)
+    fork_block_index = len(blocks) - 1
+    post_state, fb = transition_across(spec, post_spec, state, fork_epoch)
+    blocks.append(fb)
+    blocks += _post_epoch_blocks(post_spec, post_state)
+    if post_spec.is_post("altair"):
+        assert any(int(f) != 0
+                   for f in post_state.previous_epoch_participation) or \
+            any(int(f) != 0
+                for f in post_state.current_epoch_participation)
+    _versions_differ(pre, post_state)
+    yield from _emit(pre, blocks, post_state, post_spec, fork_epoch,
+                     fork_block=fork_block_index)
+
+
+@with_phases(PRE_FORKS)
+@with_pytest_fork_subset(PRE_FORKS)     # cheap: keep all boundaries
+@spec_test
+@never_bls
+def test_transition_non_empty_historical_roots(spec):
+    """Pre-existing historical accumulator entries must survive the
+    migration untouched."""
+    post_spec = _post_spec(spec)
+    state = _pre_state(spec)
+    state.historical_roots.append(Bytes32(b"\x77" * 32))
+    pre = state.copy()
+    fork_epoch = 2
+    post_state, fb = transition_across(spec, post_spec, state, fork_epoch)
+    blocks = [fb]
+    blocks.append(state_transition_and_sign_block(
+        post_spec, post_state,
+        build_empty_block_for_next_slot(post_spec, post_state)))
+    assert len(post_state.historical_roots) == 1
+    assert bytes(post_state.historical_roots[0]) == b"\x77" * 32
+    _versions_differ(pre, post_state)
+    yield from _emit(pre, blocks, post_state, post_spec, fork_epoch)
+
+
+# ── operations at the boundary (reference test_operations.py) ────────
+
+def _op_transition(spec, stage_and_ops):
+    """Shared driver: stage_and_ops(spec, post_spec, state) returns
+    (before_ops, after_ops, fork_epoch, check) where before_ops fills
+    the last pre-fork block and after_ops the first post-fork block.
+    Slashing ops can turn upcoming proposers invalid, so the boundary
+    block is dropped if its proposer is slashed (after_ops then ride
+    the first proposable post-fork block) and trailing slots skip
+    slashed proposers like the randomized trajectory driver does."""
+    from ...test_infra.fork_transition import do_fork, \
+        transition_until_fork
+    from ...test_infra.random import _skip_slashed_proposers
+    post_spec = _post_spec(spec)
+    state = _pre_state(spec)
+    before_ops, after_ops, fork_epoch, check = stage_and_ops(
+        spec, post_spec, state)
+    pre = state.copy()
+    boundary = fork_epoch * int(spec.SLOTS_PER_EPOCH)
+    blocks = []
+    fork_block_index = None
+    if before_ops is not None:
+        # empty slots to boundary-2, then ONE op-carrying block at the
+        # last pre-fork slot (staged ops like deposits oblige every
+        # subsequent block to include them, so no filler blocks)
+        if int(state.slot) < boundary - 2:
+            spec.process_slots(state, uint64(boundary - 2))
+        block = build_empty_block_for_next_slot(spec, state)
+        before_ops(spec, state, block)
+        blocks.append(
+            state_transition_and_sign_block(spec, state, block))
+        fork_block_index = 0
+    transition_until_fork(spec, state, fork_epoch)
+    probe = post_spec.upgrade_from(state.copy())
+    boundary_ok = not probe.validators[
+        int(post_spec.get_beacon_proposer_index(probe))].slashed
+    post_state, fb = do_fork(
+        spec, post_spec, state, with_block=boundary_ok,
+        block_mutator=after_ops if boundary_ok else None)
+    applied_after = boundary_ok
+    if fb is not None:
+        blocks.append(fb)
+    _skip_slashed_proposers(post_spec, post_state)
+    blk = build_empty_block_for_next_slot(post_spec, post_state)
+    if after_ops is not None and not applied_after:
+        after_ops(post_spec, post_state, blk)
+    blocks.append(
+        state_transition_and_sign_block(post_spec, post_state, blk))
+    if check is not None:
+        check(post_spec, post_state)
+    _versions_differ(pre, post_state)
+    yield from _emit(pre, blocks, post_state, post_spec, fork_epoch,
+                     fork_block=fork_block_index)
+
+
+@with_phases(PRE_FORKS)
+@with_pytest_fork_subset(PYTEST_BOUNDARIES)
+@spec_test
+@never_bls
+def test_transition_with_proposer_slashing_right_before_fork(spec):
+    def stage(spec, post_spec, state):
+        slashed = {}
+
+        def before(spec_, state_, block):
+            ps = get_valid_proposer_slashing(
+                spec_, state_,
+                proposer_index=int(
+                    spec_.get_beacon_proposer_index(state_)))
+            slashed["i"] = int(ps.signed_header_1.message.proposer_index)
+            block.body.proposer_slashings.append(ps)
+
+        def check(post_spec_, post_state):
+            assert post_state.validators[slashed["i"]].slashed
+        return before, None, 2, check
+    yield from _op_transition(spec, stage)
+
+
+@with_phases(PRE_FORKS)
+@with_pytest_fork_subset(PYTEST_BOUNDARIES)
+@spec_test
+@never_bls
+def test_transition_with_proposer_slashing_right_after_fork(spec):
+    def stage(spec, post_spec, state):
+        slashed = {}
+
+        def after(post_spec_, post_state, block):
+            ps = get_valid_proposer_slashing(
+                post_spec_, post_state,
+                proposer_index=int(
+                    post_spec_.get_beacon_proposer_index(post_state)))
+            slashed["i"] = int(ps.signed_header_1.message.proposer_index)
+            block.body.proposer_slashings.append(ps)
+
+        def check(post_spec_, post_state):
+            assert post_state.validators[slashed["i"]].slashed
+        return None, after, 2, check
+    yield from _op_transition(spec, stage)
+
+
+@with_phases(PRE_FORKS)
+@with_pytest_fork_subset(PYTEST_BOUNDARIES)
+@spec_test
+@never_bls
+def test_transition_with_attester_slashing_right_before_fork(spec):
+    def stage(spec, post_spec, state):
+        seen = {}
+
+        def before(spec_, state_, block):
+            aslash = get_valid_attester_slashing(spec_, state_)
+            seen["idx"] = [int(i) for i in
+                           aslash.attestation_1.attesting_indices]
+            block.body.attester_slashings.append(aslash)
+
+        def check(post_spec_, post_state):
+            assert any(post_state.validators[i].slashed
+                       for i in seen["idx"])
+        return before, None, 2, check
+    yield from _op_transition(spec, stage)
+
+
+@with_phases(PRE_FORKS)
+@with_pytest_fork_subset(PYTEST_BOUNDARIES)
+@spec_test
+@never_bls
+def test_transition_with_attester_slashing_right_after_fork(spec):
+    def stage(spec, post_spec, state):
+        seen = {}
+
+        def after(post_spec_, post_state, block):
+            # built under the POST spec: the attestation container can
+            # change shape at the boundary (deneb→electra EIP-7549)
+            aslash = get_valid_attester_slashing(post_spec_, post_state)
+            seen["idx"] = [int(i) for i in
+                           aslash.attestation_1.attesting_indices]
+            block.body.attester_slashings.append(aslash)
+
+        def check(post_spec_, post_state):
+            assert any(post_state.validators[i].slashed
+                       for i in seen["idx"])
+        return None, after, 2, check
+    yield from _op_transition(spec, stage)
+
+
+@with_phases(PRE_FORKS)
+@with_pytest_fork_subset(PYTEST_BOUNDARIES)
+@spec_test
+@never_bls
+def test_transition_with_deposit_right_before_fork(spec):
+    def stage(spec, post_spec, state):
+        new_index = len(state.validators)
+        deposit = prepare_state_and_deposit(
+            spec, state, new_index, spec.MAX_EFFECTIVE_BALANCE,
+            signed=True)
+
+        def before(spec_, state_, block):
+            block.body.deposits.append(deposit)
+
+        def check(post_spec_, post_state):
+            if post_spec_.is_post("electra"):
+                # electra routes deposits through the pending queue
+                assert len(post_state.validators) > new_index or \
+                    len(post_state.pending_deposits) > 0
+            else:
+                assert len(post_state.validators) > new_index
+        return before, None, 2, check
+    yield from _op_transition(spec, stage)
+
+
+@with_phases(PRE_FORKS)
+@with_pytest_fork_subset(PYTEST_BOUNDARIES)
+@spec_test
+@never_bls
+def test_transition_with_deposit_right_after_fork(spec):
+    def stage(spec, post_spec, state):
+        new_index = len(state.validators)
+        deposit = prepare_state_and_deposit(
+            spec, state, new_index, spec.MAX_EFFECTIVE_BALANCE,
+            signed=True)
+
+        def after(post_spec_, post_state, block):
+            block.body.deposits.append(deposit)
+
+        def check(post_spec_, post_state):
+            if post_spec_.is_post("electra"):
+                assert len(post_state.validators) > new_index or \
+                    len(post_state.pending_deposits) > 0
+            else:
+                assert len(post_state.validators) > new_index
+        return None, after, 2, check
+    yield from _op_transition(spec, stage)
+
+
+def _teleport_to_exit_eligibility(spec, state):
+    """Validators may exit only after SHARD_COMMITTEE_PERIOD epochs;
+    teleport the clock there (the reference assigns state.slot directly
+    for the same reason) and fork two epochs later."""
+    period = int(spec.config.SHARD_COMMITTEE_PERIOD)
+    state.slot = uint64(period * int(spec.SLOTS_PER_EPOCH))
+    return period + 2
+
+
+@with_phases(PRE_FORKS)
+@with_pytest_fork_subset(PYTEST_BOUNDARIES)
+@with_presets(["minimal"], reason="SHARD_COMMITTEE_PERIOD teleport")
+@spec_test
+@never_bls
+def test_transition_with_voluntary_exit_right_before_fork(spec):
+    def stage(spec, post_spec, state):
+        fork_epoch = _teleport_to_exit_eligibility(spec, state)
+
+        def before(spec_, state_, block):
+            block.body.voluntary_exits.append(
+                get_valid_voluntary_exit(spec_, state_, 0))
+
+        def check(post_spec_, post_state):
+            assert int(post_state.validators[0].exit_epoch) != int(
+                post_spec_.FAR_FUTURE_EPOCH)
+        return before, None, fork_epoch, check
+    yield from _op_transition(spec, stage)
+
+
+@with_phases(PRE_FORKS)
+@with_pytest_fork_subset(PYTEST_BOUNDARIES)
+@with_presets(["minimal"], reason="SHARD_COMMITTEE_PERIOD teleport")
+@spec_test
+@never_bls
+def test_transition_with_voluntary_exit_right_after_fork(spec):
+    def stage(spec, post_spec, state):
+        fork_epoch = _teleport_to_exit_eligibility(spec, state)
+
+        def after(post_spec_, post_state, block):
+            block.body.voluntary_exits.append(
+                get_valid_voluntary_exit(post_spec_, post_state, 0))
+
+        def check(post_spec_, post_state):
+            assert int(post_state.validators[0].exit_epoch) != int(
+                post_spec_.FAR_FUTURE_EPOCH)
+        return None, after, fork_epoch, check
+    yield from _op_transition(spec, stage)
+
+
+# ── inactivity leak across the boundary (reference test_leaking.py) ──
+
+@with_phases(PRE_FORKS)
+@with_pytest_fork_subset(PYTEST_BOUNDARIES)
+@spec_test
+@never_bls
+def test_transition_with_leaking_pre_fork(spec):
+    """The leak engages well before the fork and must still be active
+    (and keep penalizing) under the post fork."""
+    post_spec = _post_spec(spec)
+    state = _pre_state(spec)
+    pre = state.copy()
+    leak_engages = int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 2
+    fork_epoch = leak_engages + 2      # leaking for 2 epochs pre-fork
+    post_state, fb = transition_across(spec, post_spec, state, fork_epoch)
+    blocks = [fb]
+    assert post_spec.is_in_inactivity_leak(post_state)
+    blocks += _post_epoch_blocks(post_spec, post_state, attest=False)
+    _versions_differ(pre, post_state)
+    yield from _emit(pre, blocks, post_state, post_spec, fork_epoch)
+
+
+@with_phases(PRE_FORKS)
+@with_pytest_fork_subset(PYTEST_BOUNDARIES)
+@spec_test
+@never_bls
+def test_transition_with_leaking_at_fork(spec):
+    """The leak threshold is crossed exactly at the fork epoch."""
+    post_spec = _post_spec(spec)
+    state = _pre_state(spec)
+    pre = state.copy()
+    fork_epoch = int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 2
+    post_state, fb = transition_across(spec, post_spec, state, fork_epoch)
+    blocks = [fb]
+    assert post_spec.is_in_inactivity_leak(post_state)
+    blocks += _post_epoch_blocks(post_spec, post_state, attest=False)
+    _versions_differ(pre, post_state)
+    yield from _emit(pre, blocks, post_state, post_spec, fork_epoch)
+
+
+# ── registry churn across the boundary (reference
+#    test_activations_and_exits.py + test_slashing.py) ────────────────
+
+def _exiting_validators(spec, state, exit_epoch):
+    """Mark a quarter of the registry as exiting at `exit_epoch`."""
+    out = []
+    for i in range(0, len(state.validators), 4):
+        v = state.validators[i]
+        v.exit_epoch = uint64(exit_epoch)
+        v.withdrawable_epoch = uint64(
+            exit_epoch + int(spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY))
+        out.append(i)
+    return out
+
+
+@with_phases(PRE_FORKS)
+@with_pytest_fork_subset(PYTEST_BOUNDARIES)
+@spec_test
+@never_bls
+def test_transition_one_fourth_exiting_validators_exit_post_fork(spec):
+    """A quarter of validators have exit epochs landing after the
+    boundary; they must still be active at the fork and exit under the
+    post spec."""
+    post_spec = _post_spec(spec)
+    state = _pre_state(spec)
+    fork_epoch = 2
+    exiting = _exiting_validators(spec, state, fork_epoch + 1)
+    pre = state.copy()
+    post_state, fb = transition_across(spec, post_spec, state, fork_epoch)
+    blocks = [fb]
+    assert all(
+        post_spec.is_active_validator(
+            post_state.validators[i],
+            post_spec.get_current_epoch(post_state))
+        for i in exiting)
+    blocks += _post_epoch_blocks(post_spec, post_state, epochs=2,
+                                 attest=False)
+    cur = post_spec.get_current_epoch(post_state)
+    assert all(
+        not post_spec.is_active_validator(post_state.validators[i], cur)
+        for i in exiting)
+    _versions_differ(pre, post_state)
+    yield from _emit(pre, blocks, post_state, post_spec, fork_epoch)
+
+
+@with_phases(PRE_FORKS)
+@with_pytest_fork_subset(PYTEST_BOUNDARIES)
+@spec_test
+@never_bls
+def test_transition_one_fourth_exiting_validators_exit_at_fork(spec):
+    """Exit epochs land exactly on the fork epoch: the validators are
+    already inactive in the first post-fork epoch."""
+    post_spec = _post_spec(spec)
+    state = _pre_state(spec)
+    fork_epoch = 2
+    exiting = _exiting_validators(spec, state, fork_epoch)
+    pre = state.copy()
+    post_state, fb = transition_across(spec, post_spec, state, fork_epoch)
+    blocks = [fb]
+    cur = post_spec.get_current_epoch(post_state)
+    assert all(
+        not post_spec.is_active_validator(post_state.validators[i], cur)
+        for i in exiting)
+    blocks.append(state_transition_and_sign_block(
+        post_spec, post_state,
+        build_empty_block_for_next_slot(post_spec, post_state)))
+    _versions_differ(pre, post_state)
+    yield from _emit(pre, blocks, post_state, post_spec, fork_epoch)
+
+
+@with_phases(PRE_FORKS)
+@with_pytest_fork_subset(PYTEST_BOUNDARIES)
+@spec_test
+@never_bls
+def test_transition_with_non_empty_activation_queue(spec):
+    """Validators waiting in the activation queue cross the boundary;
+    the queue state must be preserved by the migration (electra resets
+    eligibility through the pending-deposit pipeline)."""
+    post_spec = _post_spec(spec)
+    state = _pre_state(spec)
+    queued = list(range(0, 8, 2))
+    for i in queued:
+        v = state.validators[i]
+        v.activation_epoch = spec.FAR_FUTURE_EPOCH
+        v.activation_eligibility_epoch = uint64(1)
+    pre = state.copy()
+    fork_epoch = 2
+    post_state, fb = transition_across(spec, post_spec, state, fork_epoch)
+    blocks = [fb]
+    blocks.append(state_transition_and_sign_block(
+        post_spec, post_state,
+        build_empty_block_for_next_slot(post_spec, post_state)))
+    for i in queued:
+        v = post_state.validators[i]
+        if post_spec.fork == "electra":
+            assert int(v.activation_eligibility_epoch) == int(
+                post_spec.FAR_FUTURE_EPOCH)
+            assert any(d.pubkey == v.pubkey
+                       for d in post_state.pending_deposits)
+        else:
+            assert int(v.activation_eligibility_epoch) == 1
+    _versions_differ(pre, post_state)
+    yield from _emit(pre, blocks, post_state, post_spec, fork_epoch)
+
+
+@with_phases(PRE_FORKS)
+@with_pytest_fork_subset(PYTEST_BOUNDARIES)
+@spec_test
+@never_bls
+def test_transition_with_activation_at_fork_epoch(spec):
+    """A validator whose activation epoch IS the fork epoch becomes
+    active in the first post-fork epoch."""
+    post_spec = _post_spec(spec)
+    state = _pre_state(spec)
+    fork_epoch = 2
+    index = 3
+    state.validators[index].activation_epoch = uint64(fork_epoch)
+    pre = state.copy()
+    post_state, fb = transition_across(spec, post_spec, state, fork_epoch)
+    blocks = [fb]
+    assert post_spec.is_active_validator(
+        post_state.validators[index],
+        post_spec.get_current_epoch(post_state))
+    blocks.append(state_transition_and_sign_block(
+        post_spec, post_state,
+        build_empty_block_for_next_slot(post_spec, post_state)))
+    _versions_differ(pre, post_state)
+    yield from _emit(pre, blocks, post_state, post_spec, fork_epoch)
+
+
+@with_phases(PRE_FORKS)
+@with_pytest_fork_subset(PYTEST_BOUNDARIES)
+@spec_test
+@never_bls
+def test_transition_with_one_fourth_slashed_active_validators_pre_fork(
+        spec):
+    """A quarter of the registry is slashed before the boundary; the
+    post fork inherits the slashings accumulator and flags, and epoch
+    processing keeps working over the mixed registry."""
+    from ...test_infra.fork_transition import do_fork, \
+        transition_until_fork
+    from ...test_infra.random import _skip_slashed_proposers
+    post_spec = _post_spec(spec)
+    state = _pre_state(spec)
+    slashed = []
+    for i in range(0, len(state.validators), 4):
+        spec.slash_validator(state, uint64(i))
+        slashed.append(i)
+    pre = state.copy()
+    fork_epoch = 2
+    transition_until_fork(spec, state, fork_epoch)
+    probe = post_spec.upgrade_from(state.copy())
+    boundary_ok = not probe.validators[
+        int(post_spec.get_beacon_proposer_index(probe))].slashed
+    post_state, fb = do_fork(spec, post_spec, state,
+                             with_block=boundary_ok)
+    blocks = [fb] if fb is not None else []
+    assert all(post_state.validators[i].slashed for i in slashed)
+    for _ in range(4):
+        _skip_slashed_proposers(post_spec, post_state)
+        blocks.append(state_transition_and_sign_block(
+            post_spec, post_state,
+            build_empty_block_for_next_slot(post_spec, post_state)))
+    _versions_differ(pre, post_state)
+    yield from _emit(pre, blocks, post_state, post_spec, fork_epoch)

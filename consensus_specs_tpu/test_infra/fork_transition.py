@@ -25,9 +25,13 @@ def transition_until_fork(pre_spec, state, fork_epoch: int) -> None:
         pre_spec.process_slots(state, boundary_slot)
 
 
-def do_fork(pre_spec, post_spec, state, with_block: bool = True):
+def do_fork(pre_spec, post_spec, state, with_block: bool = True,
+            block_mutator=None):
     """Upgrade `state` (sitting at an epoch boundary) to the post fork,
     optionally applying an empty post-fork block at the boundary slot.
+    `block_mutator(post_spec, post_state, block)` can inject operations
+    into that first post-fork block before it is signed (reference
+    run_transition_with_operation's is_right_after_fork arm).
     Returns (post_state, signed_block_or_None)."""
     assert state.slot % pre_spec.SLOTS_PER_EPOCH == 0
     post_state = post_spec.upgrade_from(state)
@@ -37,6 +41,8 @@ def do_fork(pre_spec, post_spec, state, with_block: bool = True):
         return post_state, None
 
     block = build_empty_block(post_spec, post_state, slot=post_state.slot)
+    if block_mutator is not None:
+        block_mutator(post_spec, post_state, block)
     # apply directly (process_slots already ran under the pre spec)
     temp = post_state.copy()
     post_spec.process_block(temp, block)
@@ -47,7 +53,8 @@ def do_fork(pre_spec, post_spec, state, with_block: bool = True):
 
 
 def transition_across(pre_spec, post_spec, state, fork_epoch: int,
-                      with_block: bool = True):
+                      with_block: bool = True, block_mutator=None):
     """transition_until_fork + do_fork in one step."""
     transition_until_fork(pre_spec, state, fork_epoch)
-    return do_fork(pre_spec, post_spec, state, with_block=with_block)
+    return do_fork(pre_spec, post_spec, state, with_block=with_block,
+                   block_mutator=block_mutator)
