@@ -3,4 +3,5 @@
 REWARDS_HANDLERS = {
     "basic": "consensus_specs_tpu.spec_tests.rewards.test_basic",
     "leak": "consensus_specs_tpu.spec_tests.rewards.test_leak",
+    "random": "consensus_specs_tpu.spec_tests.rewards.test_random",
 }

@@ -203,3 +203,242 @@ def test_low_effective_balance_attesters(spec, state):
             int(spec.config.EJECTION_BALANCE))
     yield "pre", state.copy()
     yield from _emit_deltas(spec, state)
+
+
+def _full_flags(spec) -> int:
+    flags = 0
+    for i in range(len(spec.PARTICIPATION_FLAG_WEIGHTS)):
+        flags = spec.add_flag(flags, i)
+    return flags
+
+
+def _set_participation_fraction(spec, state, keep_fn):
+    """Thin participation to the validators selected by keep_fn(i)."""
+    if spec.is_post("altair"):
+        n = len(state.validators)
+        full = _full_flags(spec)
+        state.previous_epoch_participation = [
+            full if keep_fn(i) else 0 for i in range(n)]
+    else:
+        for att in state.previous_epoch_attestations:
+            bits = att.aggregation_bits
+            for j in range(len(bits)):
+                if not keep_fn(j):
+                    bits[j] = False
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_one_attestation_one_correct(spec, state):
+    """A single participant: everyone else accrues penalties, the one
+    attester earns every component."""
+    _prepare_participation(spec, state, full=True)
+    if spec.is_post("altair"):
+        n = len(state.validators)
+        flags = _full_flags(spec)
+        state.previous_epoch_participation = [
+            flags if i == 0 else 0 for i in range(n)]
+    else:
+        # keep only the first attestation, with a single bit set
+        atts = list(state.previous_epoch_attestations)[:1]
+        for att in atts:
+            bits = att.aggregation_bits
+            for j in range(1, len(bits)):
+                bits[j] = False
+        state.previous_epoch_attestations = atts
+    yield "pre", state.copy()
+    yield from _emit_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_full_but_partial_participation(spec, state):
+    """Every committee is covered but only ~2/3 of each participates."""
+    _prepare_participation(spec, state, full=True)
+    _set_participation_fraction(spec, state, lambda i: i % 3 != 0)
+    yield "pre", state.copy()
+    yield from _emit_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_low_effective_balance_did_not_attest(spec, state):
+    """Ejection-floor validators that sat out: penalties stay
+    proportional to their tiny effective balance."""
+    _prepare_participation(spec, state, full=True)
+    floor = uint64(int(spec.config.EJECTION_BALANCE))
+    for i in range(0, len(state.validators), 3):
+        state.validators[i].effective_balance = floor
+    _set_participation_fraction(spec, state, lambda i: i % 3 != 0)
+    yield "pre", state.copy()
+    yield from _emit_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_full_half_correct_target_incorrect_head(spec, state):
+    """Half the voters hit the target but miss the head."""
+    next_epoch(spec, state)
+    if spec.is_post("altair"):
+        n = len(state.validators)
+        full = _full_flags(spec)
+        partial = spec.add_flag(
+            spec.add_flag(0, int(spec.TIMELY_SOURCE_FLAG_INDEX)),
+            int(spec.TIMELY_TARGET_FLAG_INDEX))
+        state.previous_epoch_participation = [
+            full if i % 2 else partial for i in range(n)]
+    else:
+        next_epoch_with_attestations(spec, state, False, True)
+        for k, att in enumerate(state.previous_epoch_attestations):
+            if k % 2 == 0:
+                att.data.beacon_block_root = b"\x77" * 32
+    yield "pre", state.copy()
+    yield from _emit_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_full_half_incorrect_target_correct_head(spec, state):
+    """Half the voters miss the target (head credit requires target in
+    altair's flag machinery; phase0 scores them independently)."""
+    next_epoch(spec, state)
+    if spec.is_post("altair"):
+        n = len(state.validators)
+        full = _full_flags(spec)
+        partial = spec.add_flag(0, int(spec.TIMELY_SOURCE_FLAG_INDEX))
+        state.previous_epoch_participation = [
+            full if i % 2 else partial for i in range(n)]
+    else:
+        next_epoch_with_attestations(spec, state, False, True)
+        for k, att in enumerate(state.previous_epoch_attestations):
+            if k % 2 == 0:
+                att.data.target.root = b"\x55" * 32
+    yield "pre", state.copy()
+    yield from _emit_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_full_half_incorrect_target_incorrect_head(spec, state):
+    """Half the voters carry source credit only."""
+    next_epoch(spec, state)
+    if spec.is_post("altair"):
+        n = len(state.validators)
+        full = _full_flags(spec)
+        partial = spec.add_flag(0, int(spec.TIMELY_SOURCE_FLAG_INDEX))
+        state.previous_epoch_participation = [
+            full if i % 2 else partial for i in range(n)]
+        state.inactivity_scores = [0] * n
+    else:
+        next_epoch_with_attestations(spec, state, False, True)
+        for k, att in enumerate(state.previous_epoch_attestations):
+            if k % 2 == 0:
+                att.data.target.root = b"\x55" * 32
+                att.data.beacon_block_root = b"\x77" * 32
+    yield "pre", state.copy()
+    yield from _emit_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_all_balances_too_low_for_reward(spec, state):
+    """Effective balances below one increment: base rewards collapse to
+    the floor and deltas stay consistent."""
+    _prepare_participation(spec, state, full=True)
+    for v in state.validators:
+        v.effective_balance = uint64(
+            int(spec.EFFECTIVE_BALANCE_INCREMENT) // 2
+            if int(spec.EFFECTIVE_BALANCE_INCREMENT) > 1 else 0)
+    yield "pre", state.copy()
+    yield from _emit_deltas(spec, state)
+
+
+# ── phase0-only inclusion-delay component shapes (altair+ has no
+#    inclusion-delay deltas; reference keeps these under phase0) ──────
+
+from ...test_infra.context import with_phases  # noqa: E402
+
+
+@with_phases(["phase0"])
+@spec_state_test
+@never_bls
+def test_full_delay_one_slot(spec, state):
+    _prepare_participation(spec, state, full=True)
+    for att in state.previous_epoch_attestations:
+        att.inclusion_delay = uint64(int(att.inclusion_delay) + 1)
+    yield "pre", state.copy()
+    yield from _emit_deltas(spec, state)
+
+
+@with_phases(["phase0"])
+@spec_state_test
+@never_bls
+def test_full_delay_max_slots(spec, state):
+    _prepare_participation(spec, state, full=True)
+    for att in state.previous_epoch_attestations:
+        att.inclusion_delay = uint64(int(spec.SLOTS_PER_EPOCH))
+    yield "pre", state.copy()
+    yield from _emit_deltas(spec, state)
+
+
+@with_phases(["phase0"])
+@spec_state_test
+@never_bls
+def test_full_mixed_delay(spec, state):
+    _prepare_participation(spec, state, full=True)
+    for k, att in enumerate(state.previous_epoch_attestations):
+        att.inclusion_delay = uint64(
+            1 + (k % int(spec.SLOTS_PER_EPOCH)))
+    yield "pre", state.copy()
+    yield from _emit_deltas(spec, state)
+
+
+@with_phases(["phase0"])
+@spec_state_test
+@never_bls
+def test_proposer_not_in_attestations(spec, state):
+    """Strip any attestation whose proposer also attested: the
+    proposer-reward component of inclusion-delay deltas must skip
+    them."""
+    _prepare_participation(spec, state, full=True)
+    kept = []
+    for att in state.previous_epoch_attestations:
+        bits = att.aggregation_bits
+        committee = spec.get_beacon_committee(
+            state, att.data.slot, att.data.index)
+        proposer = int(att.proposer_index)
+        filtered = [b and int(committee[j]) != proposer
+                    for j, b in enumerate(bits)]
+        if any(filtered):
+            for j, b in enumerate(filtered):
+                bits[j] = b
+            kept.append(att)
+    state.previous_epoch_attestations = kept
+    yield "pre", state.copy()
+    yield from _emit_deltas(spec, state)
+
+
+@with_phases(["phase0"])
+@spec_state_test
+@never_bls
+def test_duplicate_attestations_at_later_slots(spec, state):
+    """Duplicate pending attestations with larger inclusion delays:
+    the min-delay copy must win for the inclusion-delay component."""
+    _prepare_participation(spec, state, full=True)
+    dupes = []
+    for att in list(state.previous_epoch_attestations)[:4]:
+        d = att.copy()
+        d.inclusion_delay = uint64(
+            min(int(d.inclusion_delay) + 3, int(spec.SLOTS_PER_EPOCH)))
+        dupes.append(d)
+    state.previous_epoch_attestations = \
+        list(state.previous_epoch_attestations) + dupes
+    yield "pre", state.copy()
+    yield from _emit_deltas(spec, state)

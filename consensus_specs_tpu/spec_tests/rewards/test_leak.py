@@ -114,3 +114,121 @@ def test_leak_slashed_validators_still_penalized(spec, state):
     _, inactivity = deltas[-1]
     for i in range(0, n, 4):
         assert int(inactivity.penalties[i]) > 0
+
+
+from .test_basic import (  # noqa: E402
+    _emit_deltas as _deltas, _full_flags, _set_participation_fraction)
+
+
+def _emit_all(spec, state):
+    yield "pre", state.copy()
+    for name, d in _deltas(spec, state):
+        yield name, d
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_leak_quarter_participation(spec, state):
+    _enter_leak(spec, state, participating=False)
+    n = len(state.validators)
+    full = _full_flags(spec)
+    state.previous_epoch_participation = [
+        full if i % 4 == 0 else 0 for i in range(n)]
+    state.inactivity_scores = [
+        0 if i % 4 == 0 else int(spec.config.INACTIVITY_SCORE_BIAS) * 4
+        for i in range(n)]
+    yield from _emit_all(spec, state)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_leak_correct_target_incorrect_head(spec, state):
+    """Under a leak, target credit still cancels inactivity penalties
+    while head rewards are zeroed (leak scaling)."""
+    _enter_leak(spec, state, participating=False)
+    n = len(state.validators)
+    partial = spec.add_flag(
+        spec.add_flag(0, int(spec.TIMELY_SOURCE_FLAG_INDEX)),
+        int(spec.TIMELY_TARGET_FLAG_INDEX))
+    state.previous_epoch_participation = [partial] * n
+    state.inactivity_scores = [0] * n
+    yield from _emit_all(spec, state)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_leak_with_exited_validators(spec, state):
+    _enter_leak(spec, state, participating=True)
+    epoch = int(spec.get_current_epoch(state))
+    for i in range(0, len(state.validators), 5):
+        state.validators[i].exit_epoch = uint64(max(epoch - 1, 1))
+        state.validators[i].withdrawable_epoch = uint64(epoch + 10)
+    yield from _emit_all(spec, state)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_leak_with_not_yet_activated_validators(spec, state):
+    _enter_leak(spec, state, participating=True)
+    epoch = int(spec.get_current_epoch(state))
+    for i in range(0, len(state.validators), 5):
+        state.validators[i].activation_epoch = uint64(epoch + 4)
+    yield from _emit_all(spec, state)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_leak_low_effective_balance(spec, state):
+    _enter_leak(spec, state, participating=False)
+    floor = uint64(int(spec.config.EJECTION_BALANCE))
+    for i in range(0, len(state.validators), 3):
+        state.validators[i].effective_balance = floor
+    yield from _emit_all(spec, state)
+
+
+def _deep_leak(spec, state, epochs: int):
+    """Leak that has been running `epochs` epochs: scores scaled to
+    epochs * bias for the idle half."""
+    _enter_leak(spec, state, participating=False)
+    n = len(state.validators)
+    bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+    state.inactivity_scores = [epochs * bias] * n
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_leak_seven_epochs(spec, state):
+    _deep_leak(spec, state, 7)
+    yield from _emit_all(spec, state)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_leak_ten_epochs(spec, state):
+    _deep_leak(spec, state, 10)
+    yield from _emit_all(spec, state)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_leak_full_random_participation(spec, state):
+    """Seeded random flag mix under an active leak."""
+    import random as _r
+    rng = _r.Random(f"{spec.fork}:leak-random")
+    _enter_leak(spec, state, participating=False)
+    n = len(state.validators)
+    hi = _full_flags(spec) + 1
+    state.previous_epoch_participation = [
+        rng.randrange(0, hi) for _ in range(n)]
+    bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+    state.inactivity_scores = [
+        rng.randrange(0, 10 * bias) for _ in range(n)]
+    yield from _emit_all(spec, state)

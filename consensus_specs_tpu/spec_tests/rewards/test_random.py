@@ -1,0 +1,139 @@
+"""Randomized reward-delta states (reference:
+test/phase0/rewards/test_random.py shape; vector format
+tests/formats/rewards).  Seeded scrambles of participation, balances,
+and registry status, emitted through the shared per-component deltas
+path so the scalar and vectorized engines stay pinned together.
+"""
+import random as _random
+
+from ...ssz import uint64
+from ...test_infra.context import (
+    default_activation_threshold, low_balances, misc_balances, never_bls,
+    spec_state_test, with_all_phases, with_custom_state,
+    zero_activation_threshold)
+from ...test_infra.blocks import next_epoch, transition_to
+from ...test_infra.attestations import next_epoch_with_attestations
+from .test_basic import _emit_deltas, _full_flags
+
+
+def _randomize_deltas_state(spec, state, rng, *, leak=False,
+                            exits=False):
+    """Scramble participation + registry the way the reference's
+    run_deltas randomization does: random flags/bits, random inactivity
+    scores, optional exits, optional active leak."""
+    if leak:
+        target = (int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 3) * \
+            int(spec.SLOTS_PER_EPOCH)
+        transition_to(spec, state, uint64(target))
+        assert spec.is_in_inactivity_leak(state)
+    else:
+        next_epoch(spec, state)
+        assert not spec.is_in_inactivity_leak(state)
+
+    n = len(state.validators)
+    if spec.is_post("altair"):
+        hi = _full_flags(spec) + 1
+        state.previous_epoch_participation = [
+            rng.randrange(0, hi) for _ in range(n)]
+        bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+        state.inactivity_scores = [
+            rng.randrange(0, 8 * bias) for _ in range(n)]
+    else:
+        if not leak:
+            next_epoch_with_attestations(spec, state, False, True)
+        for att in state.previous_epoch_attestations:
+            bits = att.aggregation_bits
+            for j in range(len(bits)):
+                if rng.random() < 0.4:
+                    bits[j] = False
+            att.inclusion_delay = uint64(
+                rng.randrange(1, int(spec.SLOTS_PER_EPOCH) + 1))
+
+    if exits:
+        epoch = int(spec.get_current_epoch(state))
+        for i in rng.sample(range(n), max(n // 8, 1)):
+            state.validators[i].exit_epoch = uint64(max(epoch, 1))
+            state.validators[i].withdrawable_epoch = uint64(epoch + 10)
+
+
+def _run_random(spec, state, tag, **kw):
+    rng = _random.Random(f"{spec.fork}:{spec.preset_name}:{tag}")
+    _randomize_deltas_state(spec, state, rng, **kw)
+    yield "pre", state.copy()
+    yield from _emit_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_full_random_0(spec, state):
+    yield from _run_random(spec, state, "r0", leak=True)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_full_random_1(spec, state):
+    yield from _run_random(spec, state, "r1", leak=True)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_full_random_2(spec, state):
+    yield from _run_random(spec, state, "r2", leak=True, exits=True)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_full_random_3(spec, state):
+    yield from _run_random(spec, state, "r3", leak=True)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_full_random_4(spec, state):
+    yield from _run_random(spec, state, "r4", leak=True, exits=True)
+
+
+@with_all_phases
+@with_custom_state(balances_fn=low_balances,
+                   threshold_fn=zero_activation_threshold)
+@spec_state_test
+@never_bls
+def test_full_random_low_balances_0(spec, state):
+    yield from _run_random(spec, state, "lb0", leak=True)
+
+
+@with_all_phases
+@with_custom_state(balances_fn=low_balances,
+                   threshold_fn=zero_activation_threshold)
+@spec_state_test
+@never_bls
+def test_full_random_low_balances_1(spec, state):
+    yield from _run_random(spec, state, "lb1", leak=True, exits=True)
+
+
+@with_all_phases
+@with_custom_state(balances_fn=misc_balances,
+                   threshold_fn=default_activation_threshold)
+@spec_state_test
+@never_bls
+def test_full_random_misc_balances(spec, state):
+    yield from _run_random(spec, state, "misc", leak=True, exits=True)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_full_random_without_leak_0(spec, state):
+    yield from _run_random(spec, state, "nl0", leak=False)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_full_random_without_leak_and_current_exit_0(spec, state):
+    yield from _run_random(spec, state, "nlx0", leak=False, exits=True)
