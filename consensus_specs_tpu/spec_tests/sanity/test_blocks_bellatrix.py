@@ -1,0 +1,64 @@
+"""Bellatrix whole-block sanity (reference
+test/bellatrix/sanity/test_blocks.py): payload-carrying empty blocks,
+randomized payload contents, and the pre-merge (execution disabled)
+path where blocks carry no meaningful payload.
+"""
+from ...ssz import uint64
+from ...test_infra.context import (
+    never_bls, spec_state_test, with_phases)
+from ...test_infra.blocks import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block)
+
+from .test_blocks import _run_blocks
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+@never_bls
+def test_empty_block_transition_no_tx(spec, state):
+    """Post-merge block whose payload carries zero transactions."""
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        assert len(block.body.execution_payload.transactions) == 0
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build)
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+@never_bls
+def test_block_transition_randomized_payload(spec, state):
+    """Opaque randomized transaction payloads flow through the noop
+    engine unchanged — consensus only binds the payload root."""
+    import random as _r
+    rng = _r.Random(f"{spec.fork}:payload")
+
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        payload = block.body.execution_payload
+        payload.transactions = [
+            bytes(rng.randrange(256) for _ in range(rng.randrange(1, 64)))
+            for _ in range(rng.randrange(1, 5))]
+        payload.gas_used = uint64(21000)
+        payload.extra_data = b"\x42" * 12
+        # rebind the fake block hash to the mutated contents
+        payload.block_hash = spec.hash(
+            bytes(spec.hash_tree_root(payload)) + b"FAKE RLP HASH")
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build)
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+@never_bls
+def test_is_execution_enabled_false(spec, state):
+    """Pre-merge state (zeroed payload header): blocks process without
+    touching the payload path."""
+    state.latest_execution_payload_header = \
+        spec.ExecutionPayloadHeader()
+    assert not spec.is_merge_transition_complete(state)
+
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build)

@@ -1,0 +1,274 @@
+"""Capella whole-block sanity (reference
+test/capella/sanity/test_blocks.py): BLS→execution credential changes
+in full blocks (alone, with deposits, with exits, duplicate-rejection)
+and withdrawal sweeps riding epoch transitions.
+"""
+from ...ssz import uint64
+from ...test_infra.context import (
+    never_bls, spec_state_test, with_all_phases_from)
+from ...test_infra.blocks import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block)
+from ...test_infra.withdrawals import (
+    get_expected_withdrawals, prepare_fully_withdrawable_validator,
+    prepare_partially_withdrawable_validator,
+    set_eth1_withdrawal_credentials)
+
+from .test_blocks import _run_blocks
+from ..operations.test_bls_to_execution_change import (
+    _signed_change, _stage_bls_credentials)
+
+
+def _change_for(spec, state, index):
+    from_pubkey, privkey = _stage_bls_credentials(spec, state, index)
+    return _signed_change(spec, state, index, from_pubkey, privkey)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+@never_bls
+def test_bls_change(spec, state):
+    change = _change_for(spec, state, 0)
+
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.bls_to_execution_changes = [change]
+        signed = state_transition_and_sign_block(spec, state, block)
+        creds = bytes(state.validators[0].withdrawal_credentials)
+        assert creds[:1] == bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX)
+        return [signed]
+    yield from _run_blocks(spec, state, build)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+@never_bls
+def test_deposit_and_bls_change(spec, state):
+    from ...test_infra.deposits import prepare_state_and_deposit
+    new_index = len(state.validators)
+    deposit = prepare_state_and_deposit(
+        spec, state, new_index, spec.MAX_EFFECTIVE_BALANCE, signed=True)
+    change = _change_for(spec, state, 1)
+
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.deposits = [deposit]
+        block.body.bls_to_execution_changes = [change]
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+@never_bls
+def test_exit_and_bls_change(spec, state):
+    from ...test_infra.slashings import get_valid_voluntary_exit
+    state.slot = uint64(int(spec.config.SHARD_COMMITTEE_PERIOD)
+                        * int(spec.SLOTS_PER_EPOCH))
+    change = _change_for(spec, state, 0)
+
+    def build(state):
+        ve = get_valid_voluntary_exit(spec, state, 0)
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.voluntary_exits = [ve]
+        block.body.bls_to_execution_changes = [change]
+        signed = state_transition_and_sign_block(spec, state, block)
+        assert int(state.validators[0].exit_epoch) != int(
+            spec.FAR_FUTURE_EPOCH)
+        creds = bytes(state.validators[0].withdrawal_credentials)
+        assert creds[:1] == bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX)
+        return [signed]
+    yield from _run_blocks(spec, state, build)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+@never_bls
+def test_invalid_duplicate_bls_changes_same_block(spec, state):
+    change = _change_for(spec, state, 0)
+
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.bls_to_execution_changes = [change, change]
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build, valid=False)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+@never_bls
+def test_invalid_two_bls_changes_of_different_addresses_same_validator_same_block(
+        spec, state):
+    from_pubkey, privkey = _stage_bls_credentials(spec, state, 0)
+    c1 = _signed_change(spec, state, 0, from_pubkey, privkey,
+                        address=b"\x11" * 20)
+    c2 = _signed_change(spec, state, 0, from_pubkey, privkey,
+                        address=b"\x22" * 20)
+
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.bls_to_execution_changes = [c1, c2]
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build, valid=False)
+
+
+def _epoch_crossing_block(spec, state):
+    from ...test_infra.blocks import build_empty_block
+    target = ((int(state.slot) // int(spec.SLOTS_PER_EPOCH)) + 1) * \
+        int(spec.SLOTS_PER_EPOCH)
+    return build_empty_block(spec, state, uint64(target))
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+@never_bls
+def test_full_withdrawal_in_epoch_transition(spec, state):
+    index = 0
+    prepare_fully_withdrawable_validator(spec, state, index)
+
+    def build(state):
+        block = _epoch_crossing_block(spec, state)
+        signed = state_transition_and_sign_block(spec, state, block)
+        assert int(state.balances[index]) == 0
+        return [signed]
+    yield from _run_blocks(spec, state, build)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+@never_bls
+def test_partial_withdrawal_in_epoch_transition(spec, state):
+    index = 1
+    excess = 1_000_000_000
+    prepare_partially_withdrawable_validator(spec, state, index,
+                                             excess=excess)
+
+    def build(state):
+        block = _epoch_crossing_block(spec, state)
+        signed = state_transition_and_sign_block(spec, state, block)
+        # the excess is withdrawn; epoch deltas may nudge the remainder
+        assert int(state.balances[index]) <= int(
+            spec.MAX_EFFECTIVE_BALANCE)
+        return [signed]
+    yield from _run_blocks(spec, state, build)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+@never_bls
+def test_many_partial_withdrawals_in_epoch_transition(spec, state):
+    """More eligible partials than the per-payload cap: the sweep
+    rotates across blocks."""
+    count = int(spec.MAX_WITHDRAWALS_PER_PAYLOAD) + 2
+    for i in range(count):
+        prepare_partially_withdrawable_validator(
+            spec, state, i % len(state.validators), excess=1_000_000)
+
+    def build(state):
+        block = _epoch_crossing_block(spec, state)
+        assert len(block.body.execution_payload.withdrawals) == \
+            int(spec.MAX_WITHDRAWALS_PER_PAYLOAD)
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+@never_bls
+def test_withdrawal_success_two_blocks(spec, state):
+    """Withdrawal sweep progresses across two consecutive blocks."""
+    prepare_fully_withdrawable_validator(spec, state, 0)
+
+    def build(state):
+        b1 = build_empty_block_for_next_slot(spec, state)
+        s1 = state_transition_and_sign_block(spec, state, b1)
+        b2 = build_empty_block_for_next_slot(spec, state)
+        s2 = state_transition_and_sign_block(spec, state, b2)
+        assert int(state.balances[0]) == 0
+        return [s1, s2]
+    yield from _run_blocks(spec, state, build)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+@never_bls
+def test_invalid_withdrawal_fail_second_block_payload_isnt_compatible(
+        spec, state):
+    """Replaying the first block's withdrawals in the second block
+    mismatches the expected sweep and must fail."""
+    prepare_fully_withdrawable_validator(spec, state, 0)
+
+    def build(state):
+        b1 = build_empty_block_for_next_slot(spec, state)
+        s1 = state_transition_and_sign_block(spec, state, b1)
+        b2 = build_empty_block_for_next_slot(spec, state)
+        b2.body.execution_payload.withdrawals = \
+            s1.message.body.execution_payload.withdrawals
+        payload = b2.body.execution_payload
+        payload.block_hash = spec.hash(
+            bytes(spec.hash_tree_root(payload)) + b"FAKE RLP HASH")
+        s2 = state_transition_and_sign_block(spec, state, b2)
+        return [s1, s2]
+    yield from _run_blocks(spec, state, build, valid=False)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+@never_bls
+def test_top_up_and_partial_withdrawable_validator(spec, state):
+    """A deposit top-up pushing a validator over MAX_EFFECTIVE_BALANCE
+    makes it partially withdrawable at the next sweep."""
+    from ...test_infra.deposits import prepare_state_and_deposit
+    index = 0
+    set_eth1_withdrawal_credentials(spec, state, index)
+    state.balances[index] = spec.MAX_EFFECTIVE_BALANCE
+    state.validators[index].effective_balance = \
+        spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(
+        spec, state, index, uint64(2_000_000_000),
+        withdrawal_credentials=state.validators[index]
+        .withdrawal_credentials, signed=True)
+
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.deposits = [deposit]
+        signed = state_transition_and_sign_block(spec, state, block)
+        if not spec.is_post("electra"):
+            # electra routes top-ups through the pending queue instead
+            assert int(state.balances[index]) > int(
+                spec.MAX_EFFECTIVE_BALANCE)
+            # the rotating sweep window may not cover `index` yet, but
+            # the validator is now in the partially-withdrawable set
+            assert spec.is_partially_withdrawable_validator(
+                state.validators[index], state.balances[index])
+        return [signed]
+    yield from _run_blocks(spec, state, build)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+@never_bls
+def test_top_up_to_fully_withdrawn_validator(spec, state):
+    """Topping up a fully-withdrawn validator re-accumulates balance
+    that the next sweep withdraws again."""
+    from ...test_infra.deposits import prepare_state_and_deposit
+    index = 0
+    prepare_fully_withdrawable_validator(spec, state, index)
+    deposit = prepare_state_and_deposit(
+        spec, state, index, uint64(1_000_000_000),
+        withdrawal_credentials=state.validators[index]
+        .withdrawal_credentials, signed=True)
+
+    def build(state):
+        # withdrawals run before operations: the sweep drains the
+        # balance, then the same block's deposit tops it back up
+        b1 = build_empty_block_for_next_slot(spec, state)
+        b1.body.deposits = [deposit]
+        s1 = state_transition_and_sign_block(spec, state, b1)
+        if not spec.is_post("electra"):
+            # exact top-up modulo sync-committee participation deltas
+            assert abs(int(state.balances[index]) - 1_000_000_000) < \
+                100_000_000
+        b2 = build_empty_block_for_next_slot(spec, state)
+        s2 = state_transition_and_sign_block(spec, state, b2)
+        return [s1, s2]
+    yield from _run_blocks(spec, state, build)

@@ -68,3 +68,83 @@ def test_two_blob_blocks_in_a_row(spec, state):
         return out
     yield from _run_blocks(spec, state, build)
     assert int(state.slot) == pre_slot + 2
+
+
+def _blob_tx(spec, commitments):
+    """An opaque blob-carrying transaction body binding `commitments`
+    (the noop engine treats transactions as opaque bytes; consensus
+    only counts commitments)."""
+    return b"\x03" + b"".join(bytes(c) for c in commitments)
+
+
+@with_all_phases_from("deneb")
+@spec_state_test
+@never_bls
+def test_one_blob_two_txs(spec, state):
+    """One commitment split across two blob transactions."""
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        cs = _commitments(1)
+        block.body.blob_kzg_commitments = cs
+        block.body.execution_payload.transactions = [
+            _blob_tx(spec, cs), _blob_tx(spec, [])]
+        payload = block.body.execution_payload
+        payload.block_hash = spec.hash(
+            bytes(spec.hash_tree_root(payload)) + b"FAKE RLP HASH")
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build)
+
+
+@with_all_phases_from("deneb")
+@spec_state_test
+@never_bls
+def test_one_blob_max_txs(spec, state):
+    """A full transaction list alongside a single commitment."""
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        cs = _commitments(1)
+        block.body.blob_kzg_commitments = cs
+        block.body.execution_payload.transactions = [
+            _blob_tx(spec, cs if i == 0 else [])
+            for i in range(16)]
+        payload = block.body.execution_payload
+        payload.block_hash = spec.hash(
+            bytes(spec.hash_tree_root(payload)) + b"FAKE RLP HASH")
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build)
+
+
+@with_all_phases_from("deneb")
+@spec_state_test
+@never_bls
+def test_mix_blob_tx_and_non_blob_tx(spec, state):
+    """Blob and plain transactions interleave in one payload."""
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        cs = _commitments(2)
+        block.body.blob_kzg_commitments = cs
+        block.body.execution_payload.transactions = [
+            b"\x02plain-transfer", _blob_tx(spec, cs),
+            b"\x02another-transfer"]
+        payload = block.body.execution_payload
+        payload.block_hash = spec.hash(
+            bytes(spec.hash_tree_root(payload)) + b"FAKE RLP HASH")
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build)
+
+
+@with_all_phases_from("deneb")
+@spec_state_test
+@never_bls
+def test_invalid_exceed_max_blobs_with_txs(spec, state):
+    """Commitment overflow is rejected regardless of the tx mix."""
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        cs = _commitments(int(spec.max_blobs_per_block()) + 1)
+        block.body.blob_kzg_commitments = cs
+        block.body.execution_payload.transactions = [_blob_tx(spec, cs)]
+        payload = block.body.execution_payload
+        payload.block_hash = spec.hash(
+            bytes(spec.hash_tree_root(payload)) + b"FAKE RLP HASH")
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build, valid=False)

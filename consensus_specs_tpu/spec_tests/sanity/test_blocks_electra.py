@@ -1,0 +1,124 @@
+"""Electra whole-block sanity (reference
+test/electra/sanity/blocks/test_blocks.py): EL-triggered withdrawal
+requests riding full blocks, alone and combined with same-block
+credential changes and CL exits.
+"""
+from ...ssz import uint64
+from ...test_infra.context import (
+    never_bls, spec_state_test, with_all_phases_from)
+from ...test_infra.blocks import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block)
+from ...test_infra.electra_requests import (
+    DEFAULT_ADDRESS, age_past_exit_gate)
+from ...test_infra.withdrawals import set_eth1_withdrawal_credentials
+
+from .test_blocks import _run_blocks
+from ..operations.test_bls_to_execution_change import (
+    _signed_change, _stage_bls_credentials)
+
+
+def _el_exit_request(spec, state, index, address=DEFAULT_ADDRESS):
+    return spec.WithdrawalRequest(
+        source_address=address,
+        validator_pubkey=state.validators[index].pubkey,
+        amount=spec.FULL_EXIT_REQUEST_AMOUNT)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@never_bls
+def test_basic_el_withdrawal_request(spec, state):
+    """A full-exit withdrawal request in a block initiates the exit."""
+    age_past_exit_gate(spec, state)
+    index = 0
+    set_eth1_withdrawal_credentials(spec, state, index,
+                                    address=DEFAULT_ADDRESS)
+
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.execution_requests.withdrawals = [
+            _el_exit_request(spec, state, index)]
+        signed = state_transition_and_sign_block(spec, state, block)
+        assert int(state.validators[index].exit_epoch) != int(
+            spec.FAR_FUTURE_EPOCH)
+        return [signed]
+    yield from _run_blocks(spec, state, build)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@never_bls
+def test_basic_btec_and_el_withdrawal_request_in_same_block(spec, state):
+    """Credential rotation and an EL withdrawal request for the same
+    validator in ONE block: BTECs are processed before withdrawal
+    requests (electra operation order), so the request sees the new
+    execution credentials and the exit fires."""
+    age_past_exit_gate(spec, state)
+    index = 0
+    from_pubkey, privkey = _stage_bls_credentials(spec, state, index)
+    change = _signed_change(spec, state, index, from_pubkey, privkey,
+                            address=DEFAULT_ADDRESS)
+
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.bls_to_execution_changes = [change]
+        block.body.execution_requests.withdrawals = [
+            _el_exit_request(spec, state, index)]
+        signed = state_transition_and_sign_block(spec, state, block)
+        assert int(state.validators[index].exit_epoch) != int(
+            spec.FAR_FUTURE_EPOCH)
+        creds = bytes(state.validators[index].withdrawal_credentials)
+        assert creds[:1] == bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX)
+        return [signed]
+    yield from _run_blocks(spec, state, build)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@never_bls
+def test_basic_btec_before_el_withdrawal_request(spec, state):
+    """Rotation in block N, withdrawal request in block N+1: the
+    request now matches the execution credentials and the exit fires."""
+    age_past_exit_gate(spec, state)
+    index = 0
+    from_pubkey, privkey = _stage_bls_credentials(spec, state, index)
+    change = _signed_change(spec, state, index, from_pubkey, privkey,
+                            address=DEFAULT_ADDRESS)
+
+    def build(state):
+        b1 = build_empty_block_for_next_slot(spec, state)
+        b1.body.bls_to_execution_changes = [change]
+        s1 = state_transition_and_sign_block(spec, state, b1)
+        b2 = build_empty_block_for_next_slot(spec, state)
+        b2.body.execution_requests.withdrawals = [
+            _el_exit_request(spec, state, index)]
+        s2 = state_transition_and_sign_block(spec, state, b2)
+        assert int(state.validators[index].exit_epoch) != int(
+            spec.FAR_FUTURE_EPOCH)
+        return [s1, s2]
+    yield from _run_blocks(spec, state, build)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@never_bls
+def test_cl_exit_and_el_withdrawal_request_in_same_block(spec, state):
+    """A CL voluntary exit and an EL withdrawal request for the same
+    validator in one block: the CL exit wins, the request no-ops."""
+    from ...test_infra.slashings import get_valid_voluntary_exit
+    age_past_exit_gate(spec, state)
+    index = 0
+    set_eth1_withdrawal_credentials(spec, state, index,
+                                    address=DEFAULT_ADDRESS)
+
+    def build(state):
+        ve = get_valid_voluntary_exit(spec, state, index)
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.voluntary_exits = [ve]
+        block.body.execution_requests.withdrawals = [
+            _el_exit_request(spec, state, index)]
+        signed = state_transition_and_sign_block(spec, state, block)
+        assert int(state.validators[index].exit_epoch) != int(
+            spec.FAR_FUTURE_EPOCH)
+        return [signed]
+    yield from _run_blocks(spec, state, build)

@@ -1,0 +1,189 @@
+"""Eth1-bridge → EIP-6110 deposit-request transition sanity (electra;
+reference test/electra/sanity/blocks/test_deposit_transition.py): while
+the eth1 deposit queue drains, blocks must keep satisfying the legacy
+inclusion equation, and the first on-chain deposit request pins
+deposit_requests_start_index.
+"""
+from ...ssz import uint64
+from ...test_infra.context import (
+    never_bls, spec_state_test, with_all_phases_from)
+from ...test_infra.blocks import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block)
+from ...test_infra.deposits import build_deposit_data
+from ...test_infra.keys import privkeys, pubkeys
+
+from .test_blocks import _run_blocks
+
+
+def _stage_eth1_queue(spec, state, count):
+    """Commit `count` pending eth1-bridge deposits into eth1_data.
+    All proofs are built against the FINAL tree (every deposit in one
+    eth1 snapshot), unlike build_deposit's incremental-root shape."""
+    from ...ssz.merkle import get_merkle_proof
+    from ...test_infra.deposits import (
+        build_deposit_data, deposit_tree)
+    base = len(state.validators)
+    data_list = []
+    for k in range(count):
+        creds = (bytes(spec.BLS_WITHDRAWAL_PREFIX)
+                 + bytes(spec.hash(pubkeys[base + k]))[1:])
+        data_list.append(build_deposit_data(
+            spec, pubkeys[base + k], privkeys[base + k],
+            spec.MIN_ACTIVATION_BALANCE, creds, signed=True))
+    root, leaves = deposit_tree(spec, data_list)
+    limit = 2 ** spec.DEPOSIT_CONTRACT_TREE_DEPTH
+    deposits = []
+    for k, data in enumerate(data_list):
+        proof = get_merkle_proof(leaves, k, limit=limit) + [
+            int(len(leaves)).to_bytes(32, "little")]
+        deposits.append(spec.Deposit(proof=proof, data=data))
+    state.eth1_deposit_index = uint64(0)
+    state.eth1_data.deposit_root = root
+    state.eth1_data.deposit_count = uint64(count)
+    return deposits
+
+
+def _deposit_request(spec, state, key_index, request_index):
+    creds = (bytes(spec.BLS_WITHDRAWAL_PREFIX)
+             + bytes(spec.hash(pubkeys[key_index]))[1:])
+    from ...test_infra.deposits import build_deposit_data
+    data = build_deposit_data(
+        spec, pubkeys[key_index], privkeys[key_index],
+        spec.MIN_ACTIVATION_BALANCE, creds, signed=True)
+    return spec.DepositRequest(
+        pubkey=data.pubkey,
+        withdrawal_credentials=data.withdrawal_credentials,
+        amount=data.amount,
+        signature=data.signature,
+        index=uint64(request_index))
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@never_bls
+def test_deposit_transition__start_index_is_set(spec, state):
+    """The first deposit request in a block pins
+    deposit_requests_start_index."""
+    assert int(state.deposit_requests_start_index) == int(
+        spec.UNSET_DEPOSIT_REQUESTS_START_INDEX)
+    start = 7070
+
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.execution_requests.deposits = [
+            _deposit_request(spec, state, len(state.validators), start)]
+        signed = state_transition_and_sign_block(spec, state, block)
+        assert int(state.deposit_requests_start_index) == start
+        return [signed]
+    yield from _run_blocks(spec, state, build)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@never_bls
+def test_deposit_transition__process_eth1_deposits(spec, state):
+    """Legacy eth1 deposits still process while requests are queued."""
+    deposits = _stage_eth1_queue(spec, state, 2)
+    pre_validators = len(state.validators)
+
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.deposits = deposits[:2]
+        signed = state_transition_and_sign_block(spec, state, block)
+        assert len(state.pending_deposits) >= 2
+        assert int(state.eth1_deposit_index) == 2
+        return [signed]
+    yield from _run_blocks(spec, state, build)
+    # electra registers new pubkeys immediately (zero balance) and
+    # defers the balance through the pending-deposit queue
+    assert len(state.validators) == pre_validators + 2
+    assert all(int(state.balances[i]) == 0
+               for i in range(pre_validators, pre_validators + 2))
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@never_bls
+def test_deposit_transition__process_max_eth1_deposits(spec, state):
+    """More pending eth1 deposits than MAX_DEPOSITS: the block carries
+    exactly the cap."""
+    cap = int(spec.MAX_DEPOSITS)
+    deposits = _stage_eth1_queue(spec, state, cap + 1)
+
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.deposits = deposits[:cap]
+        signed = state_transition_and_sign_block(spec, state, block)
+        assert int(state.eth1_deposit_index) == cap
+        return [signed]
+    yield from _run_blocks(spec, state, build)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@never_bls
+def test_deposit_transition__process_eth1_deposits_up_to_start_index(
+        spec, state):
+    """Once eth1_deposit_index reaches deposit_requests_start_index the
+    legacy queue is closed: blocks need no deposits even though
+    eth1_data.deposit_count is larger."""
+    state.deposit_requests_start_index = uint64(
+        int(state.eth1_deposit_index))
+    state.eth1_data.deposit_count = uint64(
+        int(state.eth1_deposit_index) + 5)
+
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        assert len(block.body.deposits) == 0
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@never_bls
+def test_deposit_transition__invalid_not_enough_eth1_deposits(spec,
+                                                              state):
+    """Supplying fewer deposits than the inclusion equation demands."""
+    deposits = _stage_eth1_queue(spec, state, 3)
+
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.deposits = deposits[:1]
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build, valid=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@never_bls
+def test_deposit_transition__invalid_too_many_eth1_deposits(spec, state):
+    """Supplying more deposits than the outstanding eth1 count."""
+    deposits = _stage_eth1_queue(spec, state, 2)
+
+    def build(state):
+        # claim only 1 outstanding but carry 2
+        state.eth1_data.deposit_count = uint64(1)
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.deposits = deposits[:2]
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build, valid=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@never_bls
+def test_deposit_transition__deposit_and_top_up_same_block(spec, state):
+    """A legacy eth1 deposit and a deposit REQUEST in the same block
+    both land in the pending queue."""
+    deposits = _stage_eth1_queue(spec, state, 1)
+
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.deposits = deposits
+        block.body.execution_requests.deposits = [
+            _deposit_request(spec, state, 0, 10_000)]
+        signed = state_transition_and_sign_block(spec, state, block)
+        assert len(state.pending_deposits) >= 2
+        return [signed]
+    yield from _run_blocks(spec, state, build)

@@ -37,3 +37,27 @@ def test_double_empty_epoch(spec, state):
 def test_over_epoch_boundary(spec, state):
     spec.process_slots(state, uint64(int(spec.SLOTS_PER_EPOCH) // 2))
     yield from _run_slots(spec, state, int(spec.SLOTS_PER_EPOCH))
+
+
+@with_all_phases
+@spec_state_test
+def test_slots_2(spec, state):
+    yield from _run_slots(spec, state, 2)
+    assert int(state.slot) == 2
+
+
+@with_all_phases
+@spec_state_test
+def test_historical_accumulator(spec, state):
+    """Crossing a SLOTS_PER_HISTORICAL_ROOT boundary appends to the
+    historical accumulator (roots pre-capella, summaries after)."""
+    pre_hist = len(state.historical_roots)
+    pre_summ = len(state.historical_summaries) \
+        if spec.is_post("capella") else 0
+    yield from _run_slots(spec, state,
+                          int(spec.SLOTS_PER_HISTORICAL_ROOT))
+    if spec.is_post("capella"):
+        assert len(state.historical_summaries) == pre_summ + 1
+        assert len(state.historical_roots) == pre_hist
+    else:
+        assert len(state.historical_roots) == pre_hist + 1

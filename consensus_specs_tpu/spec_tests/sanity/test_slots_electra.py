@@ -1,0 +1,114 @@
+"""Electra slot/epoch-boundary sanity (reference
+test/electra/sanity/test_slots.py): pending-deposit and
+pending-consolidation queues draining through epoch processing."""
+from ...ssz import uint64
+from ...test_infra.context import (
+    never_bls, spec_state_test, with_all_phases_from)
+from ...test_infra.keys import pubkeys
+from ...test_infra.withdrawals import (
+    set_compounding_withdrawal_credentials,
+    set_eth1_withdrawal_credentials)
+
+from .test_slots import _run_slots
+
+
+def _queue_deposit(spec, state, index, amount):
+    state.pending_deposits.append(spec.PendingDeposit(
+        pubkey=state.validators[index].pubkey,
+        withdrawal_credentials=state.validators[index]
+        .withdrawal_credentials,
+        amount=uint64(amount),
+        signature=b"\x00" * 96,
+        slot=spec.GENESIS_SLOT))     # GENESIS_SLOT = already finalized
+
+
+def _epoch_boundary_slots(spec, state):
+    spe = int(spec.SLOTS_PER_EPOCH)
+    return spe - int(state.slot) % spe
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@never_bls
+def test_multiple_pending_deposits_same_pubkey(spec, state):
+    """Two queued top-ups for one validator both apply at the epoch
+    sweep."""
+    index = 0
+    amount = 1_000_000
+    pre = int(state.balances[index])
+    _queue_deposit(spec, state, index, amount)
+    _queue_deposit(spec, state, index, amount)
+    yield from _run_slots(spec, state, _epoch_boundary_slots(spec, state))
+    assert int(state.balances[index]) >= pre + 2 * amount - 100_000
+    assert len(state.pending_deposits) == 0
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@never_bls
+def test_multiple_pending_deposits_same_pubkey_compounding(spec, state):
+    """Same, for a compounding (0x02) validator whose ceiling is the
+    electra max effective balance."""
+    index = 0
+    set_compounding_withdrawal_credentials(spec, state, index)
+    amount = int(spec.MIN_ACTIVATION_BALANCE) // 4
+    pre = int(state.balances[index])
+    _queue_deposit(spec, state, index, amount)
+    _queue_deposit(spec, state, index, amount)
+    yield from _run_slots(spec, state, _epoch_boundary_slots(spec, state))
+    assert int(state.balances[index]) >= pre + 2 * amount - 100_000
+    assert len(state.pending_deposits) == 0
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@never_bls
+def test_multiple_pending_deposits_same_pubkey_below_upward_threshold(
+        spec, state):
+    """Top-ups too small to cross the hysteresis threshold leave the
+    effective balance untouched."""
+    index = 0
+    pre_eff = int(state.validators[index].effective_balance)
+    _queue_deposit(spec, state, index, 1)
+    _queue_deposit(spec, state, index, 1)
+    yield from _run_slots(spec, state, _epoch_boundary_slots(spec, state))
+    assert int(state.validators[index].effective_balance) == pre_eff
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@never_bls
+def test_multiple_pending_deposits_same_pubkey_above_upward_threshold(
+        spec, state):
+    """A compounding validator's top-ups past the hysteresis threshold
+    raise the effective balance at the boundary."""
+    index = 0
+    set_compounding_withdrawal_credentials(spec, state, index)
+    pre_eff = int(state.validators[index].effective_balance)
+    bump = int(spec.EFFECTIVE_BALANCE_INCREMENT) * 2
+    _queue_deposit(spec, state, index, bump)
+    _queue_deposit(spec, state, index, bump)
+    yield from _run_slots(spec, state, _epoch_boundary_slots(spec, state))
+    assert int(state.validators[index].effective_balance) > pre_eff
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@never_bls
+def test_pending_consolidation(spec, state):
+    """A ripe pending consolidation moves the source balance into the
+    target at the epoch sweep."""
+    source, target = 0, 1
+    set_eth1_withdrawal_credentials(spec, state, source)
+    set_compounding_withdrawal_credentials(spec, state, target)
+    cur = int(spec.get_current_epoch(state))
+    state.validators[source].exit_epoch = uint64(max(cur, 1))
+    state.validators[source].withdrawable_epoch = uint64(max(cur, 1))
+    state.pending_consolidations.append(spec.PendingConsolidation(
+        source_index=uint64(source), target_index=uint64(target)))
+    pre_target = int(state.balances[target])
+    yield from _run_slots(spec, state, _epoch_boundary_slots(spec, state))
+    assert len(state.pending_consolidations) == 0
+    assert int(state.balances[target]) > pre_target
+    assert int(state.balances[source]) < int(
+        spec.MIN_ACTIVATION_BALANCE)
