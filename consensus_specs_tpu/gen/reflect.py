@@ -91,15 +91,26 @@ def check_mods() -> list:
                         "FORK_CHOICE_HANDLERS"),
         "genesis": ("consensus_specs_tpu.spec_tests.genesis",
                     "GENESIS_HANDLERS"),
+        "transition": ("consensus_specs_tpu.spec_tests.transition",
+                       "TRANSITION_HANDLERS"),
     }
-    # suites whose runners reflect them directly (single-module)
+    # suites whose runners reflect them directly (module lists)
+    base_random = "consensus_specs_tpu.spec_tests.random."
+    base_lc = "consensus_specs_tpu.spec_tests.light_client."
     direct = {
-        "finality": "consensus_specs_tpu.spec_tests.finality.test_finality",
-        "transition":
-            "consensus_specs_tpu.spec_tests.transition.test_transition",
-        "random": "consensus_specs_tpu.spec_tests.random.test_random",
-        "light_client":
-            "consensus_specs_tpu.spec_tests.light_client.test_sync",
+        "finality":
+            ["consensus_specs_tpu.spec_tests.finality.test_finality"],
+        "random": [base_random + "test_random"] + [
+            base_random + f"test_random_{fork}"
+            for fork in ("phase0", "altair", "bellatrix", "capella",
+                         "deneb", "electra")],
+        "light_client": [
+            base_lc + "test_sync",
+            base_lc + "test_update_ranking",
+            # data_collection is deliberately no_vectors (unit-style,
+            # like the reference's pytest-only collection battery)
+            base_lc + "test_data_collection",
+        ],
     }
 
     problems = []
@@ -128,15 +139,15 @@ def check_mods() -> list:
             problems.extend(
                 f"{pkg}/{p}" for p in check_handler_modules(registry))
         elif pkg in direct:
-            missing = files - {direct[pkg]}
+            reflected = set(direct[pkg])
+            missing = files - reflected
             for m in sorted(missing):
                 problems.append(
                     f"{pkg}: {m} exists but the runner reflects only "
-                    f"{direct[pkg]}")
-            if direct[pkg] not in files:
+                    f"{sorted(reflected)}")
+            for m in sorted(reflected - files):
                 problems.append(
-                    f"{pkg}: reflected module {direct[pkg]} has no "
-                    f"file on disk")
+                    f"{pkg}: reflected module {m} has no file on disk")
             problems.extend(
                 f"{pkg}/{p}"
                 for p in check_handler_modules({pkg: direct[pkg]}))

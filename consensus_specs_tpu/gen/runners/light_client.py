@@ -102,4 +102,10 @@ def providers():
         yield from generate_from_tests(
             "light_client", "sync",
             "consensus_specs_tpu.spec_tests.light_client.test_sync")
+        # best-first ordered update lists
+        # (format tests/formats/light_client/update_ranking.md)
+        yield from generate_from_tests(
+            "light_client", "update_ranking",
+            "consensus_specs_tpu.spec_tests.light_client."
+            "test_update_ranking")
     return [TestProvider(make_cases=make_cases)]

@@ -1,0 +1,155 @@
+"""Light-client data-collection battery — the SERVER side (reference:
+test/altair/light_client/test_data_collection.py +
+test/helpers/light_client_data_collection.py): a node imports blocks,
+keeps the best update per sync-committee period, tracks the latest
+finality/optimistic updates, and serves bootstraps + update ranges.
+"""
+from ...ssz import hash_tree_root, uint64
+from ...test_infra.context import (
+    never_bls, no_vectors, spec_test, with_all_phases_from,
+    with_pytest_fork_subset)
+from ...test_infra.light_client_sync import (
+    build_sync_aggregate, build_chain)
+from ...test_infra.blocks import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block)
+
+from .test_sync import LC_FORKS, _setup
+
+
+def _import_chain(spec, state, n_blocks, collection, *,
+                  participation=1.0, finalized_block=None):
+    """Extend the chain with sync-aggregate-carrying blocks, feeding
+    each import into the data collection the way a node would
+    (lc_data_on_block per head block)."""
+    states, blocks = [], []
+    prev_state = state.copy()
+    prev_block = None
+    for _ in range(n_blocks):
+        block = build_empty_block_for_next_slot(spec, state)
+        if prev_block is not None:
+            attested_root = hash_tree_root(prev_block.message)
+            block.body.sync_aggregate = build_sync_aggregate(
+                spec, state, block.slot, attested_root,
+                participation=participation)
+        signed = state_transition_and_sign_block(spec, state, block)
+        if prev_block is not None:
+            spec.lc_data_on_block(
+                collection, state, signed, prev_state, prev_block,
+                finalized_block=finalized_block)
+        states.append(state.copy())
+        blocks.append(signed)
+        prev_state = state.copy()
+        prev_block = signed
+    return states, blocks
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(LC_FORKS)
+@no_vectors
+@spec_test
+@never_bls
+def test_light_client_data_collection(spec):
+    """End-to-end: imports fill best_updates, finality/optimistic
+    updates track the head, and bootstraps serve by block root."""
+    spec, state, test, states, blocks = _setup(spec, n_blocks=1)
+    collection = spec.new_light_client_data_store()
+    states, blocks = _import_chain(spec, state, 5, collection)
+    period = spec.compute_sync_committee_period_at_slot(
+        blocks[-1].message.slot)
+    served = spec.get_light_client_updates(collection, int(period), 1)
+    assert len(served) == 1
+    assert collection.latest_optimistic_update is not None
+    assert int(collection.latest_optimistic_update
+               .attested_header.beacon.slot) == \
+        int(blocks[-2].message.slot)
+    # finalized block becomes bootstrap material
+    spec.lc_data_on_finalized(collection, states[0], blocks[0])
+    root = hash_tree_root(blocks[0].message)
+    bootstrap = spec.get_light_client_bootstrap(collection, bytes(root))
+    assert bootstrap is not None
+    assert bootstrap.header.beacon.slot == blocks[0].message.slot
+    assert spec.get_light_client_bootstrap(
+        collection, b"\x00" * 32) is None
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(LC_FORKS)
+@no_vectors
+@spec_test
+@never_bls
+def test_light_client_data_collection_best_update_replacement(spec):
+    """A later higher-participation import replaces the period's best
+    update under is_better_update."""
+    spec, state, test, states, blocks = _setup(spec, n_blocks=1)
+    collection = spec.new_light_client_data_store()
+    _import_chain(spec, state, 3, collection, participation=0.5)
+    period = spec.compute_sync_committee_period_at_slot(
+        uint64(int(state.slot)))
+    first_best = collection.best_updates[int(period)]
+    first_bits = sum(bool(b) for b in
+                     first_best.sync_aggregate.sync_committee_bits)
+    _import_chain(spec, state, 3, collection, participation=1.0)
+    second_best = collection.best_updates[int(period)]
+    second_bits = sum(bool(b) for b in
+                      second_best.sync_aggregate.sync_committee_bits)
+    assert second_bits > first_bits
+    assert spec.is_better_update(second_best, first_best)
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(LC_FORKS)
+@no_vectors
+@spec_test
+@never_bls
+def test_light_client_data_collection_low_participation_ignored(spec):
+    """Imports whose aggregates are below the creation floor collect
+    nothing instead of failing the block import."""
+    spec, state, test, states, blocks = _setup(spec, n_blocks=1)
+    collection = spec.new_light_client_data_store()
+    _import_chain(spec, state, 3, collection, participation=0.0)
+    assert len(collection.best_updates) == 0
+    assert collection.latest_optimistic_update is None
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(LC_FORKS)
+@no_vectors
+@spec_test
+@never_bls
+def test_light_client_updates_by_range_gap_semantics(spec):
+    """LightClientUpdatesByRange stops at the first period gap and
+    caps at MAX_REQUEST_LIGHT_CLIENT_UPDATES."""
+    spec, state, test, states, blocks = _setup(spec, n_blocks=1)
+    collection = spec.new_light_client_data_store()
+    _import_chain(spec, state, 3, collection)
+    period = int(spec.compute_sync_committee_period_at_slot(
+        uint64(int(state.slot))))
+    update = collection.best_updates[period]
+    # synthesize a gap: periods P and P+2 populated, P+1 missing
+    collection.best_updates[period + 2] = update
+    served = spec.get_light_client_updates(collection, period, 10)
+    assert len(served) == 1
+    served = spec.get_light_client_updates(
+        collection, period, 10**9)
+    assert len(served) <= spec.MAX_REQUEST_LIGHT_CLIENT_UPDATES
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(LC_FORKS)
+@no_vectors
+@spec_test
+@never_bls
+def test_light_client_data_collection_finality_update_tracking(spec):
+    """Finality-bearing imports refresh latest_finality_update by
+    attested slot."""
+    spec, state, test, states, blocks = _setup(spec, n_blocks=2)
+    collection = spec.new_light_client_data_store()
+    state.finalized_checkpoint = spec.Checkpoint(
+        epoch=spec.compute_epoch_at_slot(blocks[1].message.slot),
+        root=hash_tree_root(blocks[1].message))
+    _import_chain(spec, state, 4, collection,
+                  finalized_block=blocks[1])
+    fin = collection.latest_finality_update
+    assert fin is not None
+    assert int(fin.finalized_header.beacon.slot) == \
+        int(blocks[1].message.slot)

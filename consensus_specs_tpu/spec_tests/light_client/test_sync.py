@@ -118,3 +118,124 @@ def test_light_client_force_update(spec):
     test.force_update(timeout_slot)
     assert test.store.best_valid_update is None
     yield from test.yield_parts(state)
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(LC_FORKS)
+@spec_test
+@always_bls
+def test_supply_sync_committee_from_past_update(spec):
+    """A sync-committee-bearing update from earlier in the period fills
+    in the store's next committee even after later optimistic
+    progress (reference altair test_sync shape)."""
+    spec, state, test, states, blocks = _setup(spec, n_blocks=6)
+    # first an optimistic update without committee knowledge check
+    late = make_update(spec, states, blocks, signature_index=4)
+    test.process_update(late, int(blocks[4].message.slot) + 1,
+                        state.genesis_validators_root)
+    assert test.store.optimistic_header.beacon.slot == \
+        blocks[3].message.slot
+    # then a PAST update carrying the next sync committee: it parks in
+    # best_valid_update (no finality proof) and the committee lands on
+    # force-update after the timeout
+    past = make_update(spec, states, blocks, signature_index=2)
+    if spec.is_sync_committee_update(past):
+        current = int(blocks[4].message.slot) + 2
+        test.process_update(past, current,
+                            state.genesis_validators_root)
+        assert test.store.best_valid_update is not None
+        test.force_update(current + int(spec.UPDATE_TIMEOUT))
+        assert spec.is_next_sync_committee_known(test.store)
+    yield from test.yield_parts(state)
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(LC_FORKS)
+@spec_test
+@always_bls
+def test_advance_finality_without_sync_committee(spec):
+    """Finality keeps advancing through updates that carry no
+    sync-committee change."""
+    spec, state, test, states, blocks = _setup(spec, n_blocks=2)
+    state.finalized_checkpoint = spec.Checkpoint(
+        epoch=spec.compute_epoch_at_slot(blocks[1].message.slot),
+        root=hash_tree_root(blocks[1].message))
+    mid_states, mid_blocks = build_chain(spec, 2, state)
+    states += mid_states
+    blocks += mid_blocks
+    # advance finality again on the live chain
+    state.finalized_checkpoint = spec.Checkpoint(
+        epoch=spec.compute_epoch_at_slot(blocks[2].message.slot),
+        root=hash_tree_root(blocks[2].message))
+    more_states, more_blocks = build_chain(spec, 3, state)
+    states += more_states
+    blocks += more_blocks
+    u1 = make_update(spec, states, blocks, signature_index=3,
+                     finalized_index=1)
+    test.process_update(u1, int(blocks[3].message.slot) + 1,
+                        state.genesis_validators_root)
+    assert test.store.finalized_header.beacon.slot == \
+        blocks[1].message.slot
+    u2 = make_update(spec, states, blocks, signature_index=5,
+                     finalized_index=2)
+    test.process_update(u2, int(blocks[5].message.slot) + 1,
+                        state.genesis_validators_root)
+    assert test.store.finalized_header.beacon.slot == \
+        blocks[2].message.slot
+    yield from test.yield_parts(state)
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(LC_FORKS)
+@spec_test
+@always_bls
+def test_light_client_sync_partial_participation(spec):
+    """Above the 1/3 validity floor but below the 2/3 supermajority:
+    the optimistic header advances, finality does not."""
+    spec, state, test, states, blocks = _setup(spec, n_blocks=2)
+    state.finalized_checkpoint = spec.Checkpoint(
+        epoch=spec.compute_epoch_at_slot(blocks[1].message.slot),
+        root=hash_tree_root(blocks[1].message))
+    more_states, more_blocks = build_chain(spec, 3, state)
+    states += more_states
+    blocks += more_blocks
+    pre_finalized_slot = int(test.store.finalized_header.beacon.slot)
+    update = make_update(spec, states, blocks, signature_index=4,
+                         finalized_index=1, participation=0.5)
+    test.process_update(update, int(blocks[4].message.slot) + 1,
+                        state.genesis_validators_root)
+    assert int(test.store.optimistic_header.beacon.slot) == \
+        int(blocks[3].message.slot)
+    assert int(test.store.finalized_header.beacon.slot) == \
+        pre_finalized_slot
+    assert test.store.best_valid_update is not None
+    yield from test.yield_parts(state)
+
+
+from ...test_infra.context import no_vectors  # noqa: E402
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(LC_FORKS)
+@no_vectors
+@spec_test
+@always_bls
+def test_invalid_update_no_participation(spec):
+    """An update with zero sync participants violates
+    MIN_SYNC_COMMITTEE_PARTICIPANTS and is rejected."""
+    spec, state, test, states, blocks = _setup(spec)
+    # the server-side creator refuses zero participation, so build a
+    # valid update and blank the aggregate to hit the CLIENT check
+    update = make_update(spec, states, blocks, signature_index=3)
+    update.sync_aggregate.sync_committee_bits = [
+        False] * int(spec.SYNC_COMMITTEE_SIZE)
+    update.sync_aggregate.sync_committee_signature = \
+        spec.G2_POINT_AT_INFINITY
+    try:
+        spec.process_light_client_update(
+            test.store, update,
+            uint64(int(blocks[3].message.slot) + 1),
+            state.genesis_validators_root)
+    except (AssertionError, ValueError):
+        return
+    raise AssertionError("zero-participation update was accepted")

@@ -1,0 +1,109 @@
+"""is_better_update ordering battery (reference
+test/altair/light_client/test_update_ranking.py; vector format
+tests/formats/light_client/update_ranking.md: updates_<i> sorted
+best-first, clients re-check the ordering).
+"""
+from ...ssz import hash_tree_root
+from ...test_infra.context import (
+    always_bls, no_vectors, spec_test, with_all_phases_from,
+    with_pytest_fork_subset)
+from ...test_infra.light_client_sync import build_chain, make_update
+
+from .test_sync import LC_FORKS, _setup
+
+
+def _updates_for_ranking(spec, state, states, blocks):
+    """A spread of updates with decreasing quality: finality +
+    supermajority, supermajority only, partial participation, low
+    participation."""
+    out = []
+    # finality-bearing supermajority update
+    state.finalized_checkpoint = spec.Checkpoint(
+        epoch=spec.compute_epoch_at_slot(blocks[1].message.slot),
+        root=hash_tree_root(blocks[1].message))
+    more_states, more_blocks = build_chain(spec, 3, state)
+    states = states + more_states
+    blocks = blocks + more_blocks
+    out.append(make_update(spec, states, blocks, signature_index=4,
+                           finalized_index=1))
+    # supermajority, no finality
+    out.append(make_update(spec, states, blocks, signature_index=3))
+    # above-half participation, no finality
+    out.append(make_update(spec, states, blocks, signature_index=3,
+                           participation=0.6))
+    # minimal participation
+    out.append(make_update(spec, states, blocks, signature_index=3,
+                           participation=0.2))
+    return out
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(LC_FORKS)
+@spec_test
+@always_bls
+def test_update_ranking(spec):
+    """The quality spread must sort strictly best-first under
+    is_better_update, and the emitted vector carries that order."""
+    spec, state, test, states, blocks = _setup(spec, n_blocks=2)
+    updates = _updates_for_ranking(spec, state, states, blocks)
+    for better, worse in zip(updates, updates[1:]):
+        assert spec.is_better_update(better, worse)
+        assert not spec.is_better_update(worse, better)
+    yield "updates_count", "meta", len(updates)
+    for i, update in enumerate(updates):
+        yield f"updates_{i}", update
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(LC_FORKS)
+@no_vectors
+@spec_test
+@always_bls
+def test_update_ranking_finality_beats_participation(spec):
+    """A finality-carrying update outranks a higher-participation
+    update without finality."""
+    spec, state, test, states, blocks = _setup(spec, n_blocks=2)
+    state.finalized_checkpoint = spec.Checkpoint(
+        epoch=spec.compute_epoch_at_slot(blocks[1].message.slot),
+        root=hash_tree_root(blocks[1].message))
+    more_states, more_blocks = build_chain(spec, 3, state)
+    states, blocks = states + more_states, blocks + more_blocks
+    with_finality = make_update(spec, states, blocks,
+                                signature_index=4, finalized_index=1,
+                                participation=0.7)
+    without = make_update(spec, states, blocks, signature_index=3,
+                          participation=1.0)
+    assert spec.is_better_update(with_finality, without)
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(LC_FORKS)
+@no_vectors
+@spec_test
+@always_bls
+def test_update_ranking_supermajority_tier(spec):
+    """Within the no-finality tier, crossing 2/3 participation
+    dominates raw participation counts."""
+    spec, state, test, states, blocks = _setup(spec, n_blocks=5)
+    supermajor = make_update(spec, states, blocks, signature_index=3,
+                             participation=0.7)
+    larger_minority = make_update(spec, states, blocks,
+                                  signature_index=3,
+                                  participation=0.6)
+    assert spec.is_better_update(supermajor, larger_minority)
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(LC_FORKS)
+@no_vectors
+@spec_test
+@always_bls
+def test_update_ranking_participation_tiebreak(spec):
+    """All else equal, more sync participation wins."""
+    spec, state, test, states, blocks = _setup(spec, n_blocks=5)
+    more = make_update(spec, states, blocks, signature_index=3,
+                       participation=1.0)
+    fewer = make_update(spec, states, blocks, signature_index=3,
+                        participation=0.8)
+    assert spec.is_better_update(more, fewer)
+    assert not spec.is_better_update(fewer, more)
