@@ -129,6 +129,42 @@ def make_g1_ring_sum(mesh: Mesh):
         out_specs=(P(AXIS, None),) * 3, check_vma=False))
 
 
+def sharded_msm(X, Y, Z, bits):
+    """Body: the PRODUCTION sharded multi-scalar multiplication.
+
+    Each device scalar-multiplies its local (points, scalars) shard
+    with the double-and-add lanes, tree-sums the local products into
+    one per-chip partial (the bucket-partial of a sharded Pippenger),
+    and the partials ring-reduce over ICI exactly like
+    sharded_g1_ring_sum.  This is the in-path shape g1_lincomb uses
+    when the mesh engine is enabled (deneb
+    polynomial-commitments.md:268 over a device mesh)."""
+    n_dev = jax.lax.axis_size(AXIS)
+    prods = cj.g1_scalar_mul((X, Y, Z), bits)
+    local = cj.point_sum_tree(cj.F1, prods)
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    def hop(_i, carry):
+        acc, incoming = carry
+        incoming = tuple(
+            jax.lax.ppermute(c, AXIS, perm) for c in incoming)
+        return cj.point_add(cj.F1, acc, incoming), incoming
+
+    acc, _ = jax.lax.fori_loop(0, n_dev - 1, hop, (local, local))
+    return tuple(c[None] for c in acc)
+
+
+def make_msm(mesh: Mesh):
+    """Compiled sharded MSM: points sharded over the mesh's device
+    axis, scalar bit-planes alongside, one replicated-sum row per
+    device out."""
+    return jax.jit(jax.shard_map(
+        sharded_msm, mesh=mesh,
+        in_specs=(P(AXIS, None), P(AXIS, None), P(AXIS, None),
+                  P(AXIS, None)),
+        out_specs=(P(AXIS, None),) * 3, check_vma=False))
+
+
 # ---------------------------------------------------------------------------
 # device placement helper
 # ---------------------------------------------------------------------------

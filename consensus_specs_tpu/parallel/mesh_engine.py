@@ -35,6 +35,8 @@ class MeshEngine:
         self.n_dev = int(np.prod(list(mesh.shape.values())))
         self._merkle_cache: dict = {}
         self._flag_cache: dict = {}
+        self._msm_fn = None
+        self._prev_kzg_msm = None
         self._threshold = 1 << 14
 
     # ------------------------------------------------------------------
@@ -98,15 +100,57 @@ class MeshEngine:
         return out
 
     # ------------------------------------------------------------------
-    def enable(self, merkle_threshold: int | None = None) -> None:
+    # sharded MSM (kzg.g1_lincomb device-MSM hook)
+    # ------------------------------------------------------------------
+    def g1_msm(self, points, scalars):
+        """sum_i scalars[i]*points[i] with per-device partials + a ring
+        reduction over ICI (collectives.sharded_msm) — the in-path
+        engine for deneb's g1_lincomb (polynomial-commitments.md:268)
+        when the mesh is enabled.  Pads to a multiple of the mesh with
+        infinity*0 lanes; returns an oracle Point."""
+        from ..crypto import curve as cv
+        from ..ops import curve_jax as cj
+        from .collectives import AXIS, make_msm, shard_array
+        from jax.sharding import PartitionSpec as P
+        n = len(points)
+        if n == 0:
+            return cv.g1_infinity()
+        pad = (-n) % self.n_dev
+        pts = list(points) + [cv.g1_infinity()] * pad
+        sc = [int(s) for s in scalars] + [0] * pad
+        if self._msm_fn is None:
+            self._msm_fn = make_msm(self.mesh)
+        X, Y, Z = cj.g1_pack(pts)
+        bits = cj.scalars_to_bits(sc)
+        spec2d = P(AXIS, None)
+        rx, ry, rz = self._msm_fn(
+            shard_array(self.mesh, np.asarray(X), spec2d),
+            shard_array(self.mesh, np.asarray(Y), spec2d),
+            shard_array(self.mesh, np.asarray(Z), spec2d),
+            shard_array(self.mesh, np.asarray(bits), spec2d))
+        return cj.g1_unpack((np.asarray(jax.device_get(rx))[:1],
+                             np.asarray(jax.device_get(ry))[:1],
+                             np.asarray(jax.device_get(rz))[:1]))[0]
+
+    # ------------------------------------------------------------------
+    def enable(self, merkle_threshold: int | None = None,
+               msm_threshold: int = 128) -> None:
+        from ..crypto import kzg as kzg_mod
         from ..ssz import merkle as ssz_merkle
         from ..specs import epoch_fast
         if merkle_threshold is not None:
             self._threshold = merkle_threshold
         ssz_merkle.set_subtree_hasher(self.subtree_root, self._threshold)
         epoch_fast.MESH_ENGINE = self
+        # don't snapshot our own hook on re-enable — disable() would
+        # then "restore" it and leave the engine live after teardown
+        if getattr(kzg_mod._device_msm, "__self__", None) is not self:
+            self._prev_kzg_msm = (kzg_mod._device_msm,
+                                  kzg_mod._device_msm_threshold)
+        kzg_mod.set_device_msm(self.g1_msm, msm_threshold)
 
     def disable(self) -> None:
+        from ..crypto import kzg as kzg_mod
         from ..ssz import merkle as ssz_merkle
         from ..specs import epoch_fast
         # only uninstall our own hooks — a later-enabled engine owns
@@ -118,9 +162,13 @@ class MeshEngine:
             ssz_merkle.set_subtree_hasher(None)
         if epoch_fast.MESH_ENGINE is self:
             epoch_fast.MESH_ENGINE = None
+        if getattr(kzg_mod._device_msm, "__self__", None) is self:
+            prev_fn, prev_thr = self._prev_kzg_msm or (None, 128)
+            kzg_mod.set_device_msm(prev_fn, prev_thr)
 
 
-def enable(mesh: Mesh, merkle_threshold: int = 1 << 14) -> MeshEngine:
+def enable(mesh: Mesh, merkle_threshold: int = 1 << 14,
+           msm_threshold: int = 128) -> MeshEngine:
     engine = MeshEngine(mesh)
-    engine.enable(merkle_threshold)
+    engine.enable(merkle_threshold, msm_threshold=msm_threshold)
     return engine

@@ -20,7 +20,9 @@ from consensus_specs_tpu.test_infra.blocks import next_epoch
 @pytest.fixture
 def engine():
     mesh = get_mesh(min(8, device_count()))
-    eng = mesh_engine.enable(mesh, merkle_threshold=64)
+    # low thresholds so the tiny test shapes actually route through the
+    # mesh paths (production defaults are 1<<14 / 128)
+    eng = mesh_engine.enable(mesh, merkle_threshold=64, msm_threshold=8)
     yield eng
     eng.disable()
 
@@ -73,3 +75,63 @@ def test_full_epoch_under_mesh_engine_same_root(engine):
     spec.process_epoch(host_state)
     engine.enable()
     assert hash_tree_root(mesh_state) == hash_tree_root(host_state)
+
+
+def test_electra_epoch_under_mesh_engine_same_root(engine):
+    """Electra's epoch (pending-deposit/consolidation queues + electra
+    flag deltas) under the mesh engine, byte-identical to host."""
+    from consensus_specs_tpu.ssz import uint64
+    spec = get_spec("electra", DEFAULT_TEST_PRESET)
+    state = create_genesis_state(spec, default_balances(spec))
+    next_epoch(spec, state)
+    for i in range(len(state.validators)):
+        state.previous_epoch_participation[i] = 0b111 if i % 2 else 0b001
+    state.pending_deposits.append(spec.PendingDeposit(
+        pubkey=state.validators[0].pubkey,
+        withdrawal_credentials=state.validators[0].withdrawal_credentials,
+        amount=uint64(1_000_000), signature=b"\x00" * 96,
+        slot=spec.GENESIS_SLOT))
+    cur = int(spec.get_current_epoch(state))
+    state.validators[2].exit_epoch = uint64(max(cur, 1))
+    state.validators[2].withdrawable_epoch = uint64(max(cur, 1))
+    state.pending_consolidations.append(spec.PendingConsolidation(
+        source_index=uint64(2), target_index=uint64(3)))
+    mesh_state, host_state = state.copy(), state.copy()
+
+    spec.process_epoch(mesh_state)
+    engine.disable()
+    spec.process_epoch(host_state)
+    engine.enable()
+    assert len(host_state.pending_deposits) == 0
+    assert len(host_state.pending_consolidations) == 0
+    assert hash_tree_root(mesh_state) == hash_tree_root(host_state)
+
+
+def test_sharded_msm_in_kzg_path(engine):
+    """g1_lincomb routes through the mesh MSM (per-device partials +
+    ring reduction) and matches the host MSM bit-for-bit."""
+    from consensus_specs_tpu.crypto.kzg import KZG, _device_msm
+    from consensus_specs_tpu.utils.kzg_setup_gen import generate_setup
+    assert getattr(_device_msm, "__self__", None) is engine
+    width = 16
+    kzg = KZG(width, setup=generate_setup(width, 4242))
+    blob = b"".join(int(11 * i + 3).to_bytes(32, "big")
+                    for i in range(width))
+    mesh_commitment = kzg.blob_to_kzg_commitment(blob)
+    engine.disable()
+    host_commitment = kzg.blob_to_kzg_commitment(blob)
+    engine.enable()
+    assert mesh_commitment == host_commitment
+
+
+def test_sharded_msm_direct_matches_oracle(engine):
+    """MeshEngine.g1_msm against the pure-python Pippenger oracle on an
+    uneven (padded) batch."""
+    from consensus_specs_tpu.crypto import curve as cv
+    from consensus_specs_tpu.crypto.curve import msm
+    g = cv.g1_generator()
+    points = [g * (i + 2) for i in range(11)]   # not a mesh multiple
+    scalars = [3 * i + 1 for i in range(11)]
+    got = engine.g1_msm(points, scalars)
+    want = msm(points, scalars)
+    assert got == want
