@@ -406,16 +406,188 @@ def bench_attestations():
     }
 
 
-# cheap proven tiers first (a number is banked early), then the
-# flagship; kzg last — its 4096-point MSM compile is the most likely to
-# exhaust a tier budget without producing
+# ---------------------------------------------------------------------------
+# tier: the NORTH STAR (BASELINE.json): mainnet-preset state_transition
+# of a block carrying attestations + a full sync aggregate, BLS ON
+# through the TPU kernels, vs the SAME transition on the pure-python
+# oracle (py_ecc-class) with scalar epoch + host merkleization
+# ---------------------------------------------------------------------------
+
+NS_VALIDATORS = int(os.environ.get("BENCH_NS_VALIDATORS", 2048))
+NS_ATTESTATIONS = int(os.environ.get("BENCH_NS_ATTESTATIONS", 8))
+
+
+def _ns_sync_signing_root(spec, state, block_slot):
+    """(root, domain) the sync committee signs for a block at
+    `block_slot` — shared by the block builder and the oracle leg so
+    they can never drift."""
+    from consensus_specs_tpu.ssz import uint64
+    previous_slot = uint64(int(block_slot) - 1)
+    look = state.copy()
+    spec.process_slots(look, block_slot)
+    domain = spec.get_domain(
+        look, spec.DOMAIN_SYNC_COMMITTEE,
+        spec.compute_epoch_at_slot(previous_slot))
+    root = spec.compute_signing_root(
+        spec.get_block_root_at_slot(look, previous_slot), domain)
+    return root, domain
+
+
+def _ns_signed_block(spec, state):
+    """A boundary-crossing block with NS_ATTESTATIONS real attestations
+    and a fully-participating sync aggregate.  Aggregate signatures use
+    the sum-of-secret-keys identity (all members sign one root), so the
+    build costs one hash-to-curve + one G2 mul per aggregate."""
+    from consensus_specs_tpu.crypto.fields import R
+    from consensus_specs_tpu.test_infra.attestations import (
+        get_valid_attestation)
+    from consensus_specs_tpu.test_infra.blocks import (
+        build_empty_block_for_next_slot, state_transition_and_sign_block)
+    from consensus_specs_tpu.test_infra.keys import privkey_for_pubkey
+    from consensus_specs_tpu.utils import bls as bls_shim
+    from consensus_specs_tpu.ssz import uint64
+
+    block = build_empty_block_for_next_slot(spec, state)
+    # attestations for the last NS_ATTESTATIONS slots (inclusion delay 1)
+    for back in range(NS_ATTESTATIONS):
+        slot = uint64(int(state.slot) - back)
+        att = get_valid_attestation(spec, state, slot=slot, index=0,
+                                    signed=False)
+        committee = spec.get_beacon_committee(state, att.data.slot, 0)
+        sk = sum(privkey_for_pubkey(state.validators[int(i)].pubkey)
+                 for i in committee) % R
+        domain = spec.get_domain(state, spec.DOMAIN_BEACON_ATTESTER,
+                                 att.data.target.epoch)
+        root = spec.compute_signing_root(att.data, domain)
+        att.signature = bls_shim.Sign(sk, root)
+        block.body.attestations.append(att)
+    # full sync-committee participation
+    committee_pks = list(state.current_sync_committee.pubkeys)
+    sk = sum(privkey_for_pubkey(pk) for pk in committee_pks) % R
+    sync_root, _domain = _ns_sync_signing_root(spec, state, block.slot)
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * len(committee_pks),
+        sync_committee_signature=bls_shim.Sign(sk, sync_root))
+    # sign + apply on a scratch copy to fix the state root; the caller
+    # replays the returned signed block on its own states
+    scratch = state.copy()
+    return state_transition_and_sign_block(spec, scratch, block)
+
+
+def bench_north_star():
+    from consensus_specs_tpu.ops import pairing_jax as pj
+    from consensus_specs_tpu.specs import get_spec, epoch_fast
+    from consensus_specs_tpu.ssz import hash_tree_root, merkle, uint64
+    from consensus_specs_tpu.test_infra.genesis import (
+        create_genesis_state)
+    from consensus_specs_tpu.utils import bls as bls_shim
+
+    t_start = time.perf_counter()
+
+    def mark(msg):
+        log(f"[bench] north_star +{time.perf_counter() - t_start:5.1f}s: "
+            f"{msg}")
+
+    spec = get_spec("altair", "mainnet")
+    mark(f"building {NS_VALIDATORS}-validator mainnet genesis ...")
+    state = create_genesis_state(
+        spec, [spec.MAX_EFFECTIVE_BALANCE] * NS_VALIDATORS)
+    mark("advancing to the epoch boundary ...")
+    boundary = 4 * int(spec.SLOTS_PER_EPOCH)
+    spec.process_slots(state, uint64(boundary - 1))
+    full = (1 << len(spec.PARTICIPATION_FLAG_WEIGHTS)) - 1
+    state.previous_epoch_participation = [full] * NS_VALIDATORS
+    state.current_epoch_participation = [full] * NS_VALIDATORS
+    mark(f"signing block ({NS_ATTESTATIONS} attestations + "
+         f"{int(spec.SYNC_COMMITTEE_SIZE)}-member sync aggregate) ...")
+    signed = _ns_signed_block(spec, state)
+
+    mark(f"warming TPU kernels (mode={pj._resolve_mode()}) ...")
+    pj.warmup(k=2, rows=pj._BUCKET_MIN_ROWS)
+    tpu_state = state.copy()
+    bls_shim.use_tpu()
+    merkle.use_tpu_hashing(threshold=4096)
+    try:
+        # one warm pass on a throwaway copy compiles every shape the
+        # timed run needs (the caches persist across states)
+        warm = state.copy()
+        spec.state_transition(warm, signed)
+        mark("timed TPU-backend transition ...")
+        t0 = time.perf_counter()
+        spec.state_transition(tpu_state, signed)
+        tpu_time = time.perf_counter() - t0
+    finally:
+        merkle.use_host_hashing()
+        bls_shim.use_native()
+    tpu_root = hash_tree_root(tpu_state)
+    mark(f"TPU-backend transition: {tpu_time:.2f}s")
+
+    # the SAME transition on the pure-python oracle class: native BLS,
+    # scalar epoch loops, host merkleization (sampled attestations —
+    # each native FastAggregateVerify is seconds — then composed)
+    mark("oracle leg (native BLS sample + scalar epoch) ...")
+    oracle_state = state.copy()
+    t0 = time.perf_counter()
+    att = signed.message.body.attestations[0]
+    committee = spec.get_beacon_committee(oracle_state, att.data.slot, 0)
+    from consensus_specs_tpu.crypto import bls12_381 as native_bls
+    pk_bytes = [bytes(oracle_state.validators[int(i)].pubkey)
+                for i in committee]
+    domain = spec.get_domain(oracle_state, spec.DOMAIN_BEACON_ATTESTER,
+                             att.data.target.epoch)
+    root = spec.compute_signing_root(att.data, domain)
+    assert native_bls.FastAggregateVerify(pk_bytes, bytes(root),
+                                          bytes(att.signature))
+    att_leg = (time.perf_counter() - t0) * NS_ATTESTATIONS
+    # root/domain staging happens OUTSIDE the timed window — a real
+    # oracle transition computes them as part of the (separately
+    # measured) epoch leg, so only the verification itself counts here
+    sync_pks = [bytes(pk) for pk in
+                oracle_state.current_sync_committee.pubkeys]
+    sync_root, _d = _ns_sync_signing_root(spec, oracle_state,
+                                          signed.message.slot)
+    t0 = time.perf_counter()
+    assert native_bls.FastAggregateVerify(
+        sync_pks, bytes(sync_root),
+        bytes(signed.message.body.sync_aggregate
+              .sync_committee_signature))
+    sync_leg = time.perf_counter() - t0
+    # scalar epoch + host merkleization leg, measured end-to-end with
+    # BLS DISABLED (its cost is the two legs above)
+    from consensus_specs_tpu.test_infra import disable_bls
+    with epoch_fast.scalar_epoch(), disable_bls():
+        t0 = time.perf_counter()
+        spec.state_transition(oracle_state, signed,
+                              validate_result=False)
+        epoch_leg = time.perf_counter() - t0
+    oracle_time = att_leg + sync_leg + epoch_leg
+    assert hash_tree_root(oracle_state) == tpu_root, \
+        "oracle and TPU transitions disagree"
+    mark(f"oracle legs: att={att_leg:.1f}s sync={sync_leg:.1f}s "
+         f"epoch={epoch_leg:.1f}s")
+
+    return {
+        "metric": "north_star_state_transition_sec",
+        "value": round(tpu_time, 3),
+        "unit": (f"s (mainnet preset, {NS_VALIDATORS} validators, "
+                 f"{NS_ATTESTATIONS} attestations + full sync aggregate, "
+                 f"BLS on via TPU kernels)"),
+        "vs_baseline": round(oracle_time / tpu_time, 2),
+    }
+
+
+# merkle first (a number is banked in ~2 min), then the NORTH STAR —
+# the tier that ranks first for the stdout line must actually get
+# budget under the driver's default 540s (merkle+epoch+transition alone
+# would exhaust it); the remaining tiers fill whatever budget is left
 TIERS = {
     "merkle": (bench_merkle, 150),
+    "north_star": (bench_north_star, 500),
+    "attestations": (bench_attestations, 420),
     "epoch": (bench_epoch, 300),
     # state build (~80s) + full-state merkleization/slot + scaled scalar
     # baseline: needs more headroom than the epoch tier
     "transition": (bench_transition, 350),
-    "attestations": (bench_attestations, 420),
     "kzg": (bench_kzg, 300),
 }
 
@@ -487,7 +659,8 @@ def main():
             results[name] = out
 
     # most valuable completed tier wins the stdout line
-    for name in ("attestations", "kzg", "transition", "epoch", "merkle"):
+    for name in ("north_star", "attestations", "kzg", "transition",
+                 "epoch", "merkle"):
         if name in results:
             print(json.dumps(results[name]))
             sys.stdout.flush()
