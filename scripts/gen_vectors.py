@@ -35,17 +35,11 @@ def _modcheck() -> int:
 
 
 def _sharded(providers, shard_spec: str):
-    """Filter cases to this host's shard (i % n == i0)."""
+    """Filter cases to this host's shard (i % n == i0) — the one
+    round-robin implementation, shared with the device-mesh fan-out."""
+    from consensus_specs_tpu.gen.mesh_shard import shard_providers
     i0, n = (int(x) for x in shard_spec.split("/"))
-    out = []
-    for provider in providers:
-        def make_cases(p=provider):
-            for idx, case in enumerate(p.make_cases()):
-                if idx % n == i0:
-                    yield case
-        out.append(TestProvider(prepare=provider.prepare,
-                                make_cases=make_cases))
-    return out
+    return shard_providers(providers, i0, n)
 
 
 def _run_jobs(runner: str, rest: list, jobs: int,
