@@ -1,0 +1,540 @@
+"""Polynomial (KZG-backed) whisk shuffle argument — the mainnet-size
+engine for the curdleproofs slot.
+
+Statement: ``post[i] = k * pre[sigma(i)]`` for a hidden permutation
+``sigma`` and hidden uniform rerandomizer ``k`` — the shuffle relation
+of the reference's curdleproofs dependency
+(specs/_features/whisk/beacon-chain.md:105-128).  The switching-network
+argument in whisk_proofs.py is O(n^2) and tops out at the minimal
+preset; this argument is O(n) scalars + O(1) group elements (~5 KiB at
+mainnet's WHISK_VALIDATORS_PER_SHUFFLE=124, well inside
+WHISK_MAX_SHUFFLE_PROOF_SIZE = 2**15).
+
+Construction (original composition over the repo's own KZG/pairing
+stack; not curdleproofs wire-compatible — same capability slot):
+
+1. Pair compression: FS scalar z folds each tracker pair to one point
+   m_i = R_i + z*S_i (pre), n_i = T_i + z*U_i (post); arrays pad to the
+   radix-2 width with m_i = G, n_i = K := k*G.
+2. Permutation commitment FIRST: P_a commits a(X) with a_i = sigma(i)
+   over the domain (Lagrange-basis KZG = Pedersen vector commitment,
+   blinded by Z_H).  Only then is the challenge c drawn, e_i = c^i.
+3. B commits b(X) with b_i = e_{sigma(i)}.  A PLONK-style grand
+   product with FS challenges beta, gamma proves the pairs (b_i, a_i)
+   are a permutation of (e_i, i): the running product of
+   (b + beta*a + gamma)/(e + beta*id + gamma) closes at 1.  Quotient
+   poly + KZG openings at an FS point zeta make it succinct.
+4. MSM link: a Schnorr vector-opening proves N = sum b_i * n_i against
+   the SAME commitment B (masked reply vector, so nothing about b
+   leaks); a Chaum-Pedersen DLEQ proves N = k*M and K = k*G for the
+   publicly computable M = sum e_i * m_i.  With sigma pinned before c,
+   Schwartz-Zippel over the c-polynomial forces n_i = k*m_{sigma(i)}
+   coordinate-wise.
+
+Zero-knowledge: a, b, Z carry Z_H-multiple blinders (their domain
+values are untouched), the vector reply is one-time-pad masked, and K,
+N reveal only DDH-hard images of k.
+"""
+from __future__ import annotations
+
+import os as _os
+
+from ..utils.hash import hash as sha256
+from .curve import (
+    DecodeError, Point, g1_from_bytes, g1_generator, g1_infinity,
+    g1_to_bytes, msm,
+)
+from .fields import R
+
+# domain/width bookkeeping -------------------------------------------------
+
+def _root_of_unity(order: int) -> int:
+    from ..utils.kzg_setup_gen import root_of_unity
+    return root_of_unity(order)
+
+
+def _width_for(n: int) -> int:
+    w = 8
+    while w < n:
+        w <<= 1
+    return w
+
+
+# field polynomial helpers (coefficient form, little-endian) ---------------
+
+def _poly_eval(coeffs, x: int) -> int:
+    acc = 0
+    for c in reversed(coeffs):
+        acc = (acc * x + c) % R
+    return acc
+
+
+def _poly_mul(a, b):
+    out = [0] * (len(a) + len(b) - 1)
+    for i, ai in enumerate(a):
+        if ai:
+            for j, bj in enumerate(b):
+                out[i + j] = (out[i + j] + ai * bj) % R
+    return out
+
+
+def _poly_add(a, b):
+    n = max(len(a), len(b))
+    return [((a[i] if i < len(a) else 0)
+             + (b[i] if i < len(b) else 0)) % R for i in range(n)]
+
+
+def _poly_scale(a, s: int):
+    return [c * s % R for c in a]
+
+
+def _divide_by_vanishing(coeffs, w: int):
+    """Exact division by Z_H = X^w - 1; raises if not divisible."""
+    c = list(coeffs)
+    q = [0] * max(len(c) - w, 0)
+    for i in range(len(c) - 1, w - 1, -1):
+        q[i - w] = (q[i - w] + c[i]) % R
+        c[i - w] = (c[i - w] + c[i]) % R
+        c[i] = 0
+    if any(x % R for x in c[:w]):
+        raise ValueError("quotient remainder nonzero")
+    return q
+
+
+def _divide_linear(coeffs, zeta: int):
+    """(f(X) - f(zeta)) / (X - zeta) via synthetic division."""
+    q = [0] * (len(coeffs) - 1)
+    acc = 0
+    for i in range(len(coeffs) - 1, 0, -1):
+        acc = (acc * zeta + coeffs[i]) % R
+        q[i - 1] = acc
+    return q
+
+
+def _ifft(evals, w: int, omega: int):
+    """Domain evaluations -> coefficients (recursive radix-2)."""
+    roots = [pow(omega, i, R) for i in range(w)]
+    from .kzg_sampling import fft_field
+    return [x % R for x in fft_field([e % R for e in evals], roots,
+                                     inv=True)]
+
+
+# CRS ----------------------------------------------------------------------
+
+class ShuffleCRS:
+    """Powers-of-tau slice for one domain width: monomial G1 points up
+    to degree w+3, the Lagrange basis over the domain, the Z_H blinding
+    bases, and [1, tau] in G2 for the pairing checks."""
+
+    def __init__(self, width: int, monomial: list, g2_points: list):
+        assert len(monomial) >= width + 4
+        self.width = width
+        self.omega = _root_of_unity(width)
+        self.monomial = monomial
+        from ..utils.kzg_setup_gen import monomial_to_lagrange
+        self.lagrange = monomial_to_lagrange(monomial[:width])
+        g = monomial[0]
+        # Z_H(tau)G and X*Z_H(tau)G, X^2*Z_H(tau)G
+        self.zh = [monomial[width + i] + (-monomial[i])
+                   for i in range(3)]
+        self.g2 = g2_points[0]
+        self.tau_g2 = g2_points[1]
+        self.g = g
+
+    @classmethod
+    def from_setup(cls, width: int, setup: dict | None = None):
+        """Build from a trusted-setup dict (default: the repo's 4096
+        ceremony file, the CURDLEPROOFS_CRS slot)."""
+        from .curve import g2_from_bytes
+        if setup is None:
+            import json
+            import os
+            path = os.path.join(os.path.dirname(__file__), "..",
+                                "config", "trusted_setups",
+                                "trusted_setup_4096.json")
+            with open(path) as f:
+                setup = json.load(f)
+        mono = [g1_from_bytes(bytes.fromhex(h[2:]))
+                for h in setup["g1_monomial"][:width + 4]]
+        g2s = [g2_from_bytes(bytes.fromhex(h[2:]))
+               for h in setup["g2_monomial"][:2]]
+        return cls(width, mono, g2s)
+
+
+_CRS_CACHE: dict = {}
+
+
+def get_crs(width: int) -> ShuffleCRS:
+    crs = _CRS_CACHE.get(width)
+    if crs is None:
+        crs = ShuffleCRS.from_setup(width)
+        _CRS_CACHE[width] = crs
+    return crs
+
+
+# transcript ---------------------------------------------------------------
+
+class _Transcript:
+    def __init__(self, label: bytes):
+        self.state = sha256(b"whisk-poly-v1|" + label)
+
+    def absorb(self, *parts: bytes) -> None:
+        acc = self.state
+        for p in parts:
+            acc += p
+        self.state = sha256(acc)
+
+    def challenge(self, label: bytes) -> int:
+        out = int.from_bytes(sha256(self.state + label), "big") % R
+        self.absorb(b"chal|" + label)
+        return out
+
+
+class _Rand:
+    """Deterministic prover randomness (seeded for tests)."""
+
+    def __init__(self, seed: bytes):
+        self._state = sha256(b"whisk-poly-rand|" + seed)
+        self._n = 0
+
+    def scalar(self) -> int:
+        self._n += 1
+        out = int.from_bytes(
+            sha256(self._state + self._n.to_bytes(8, "little")),
+            "big") % R
+        return out or 1
+
+
+# core ---------------------------------------------------------------------
+
+def _compress_pairs(trackers, z: int):
+    pts = []
+    for r_g, k_r_g in trackers:
+        a = g1_from_bytes(bytes(r_g))
+        b = g1_from_bytes(bytes(k_r_g))
+        pts.append(a + b * z)
+    return pts
+
+
+def _commit(crs: ShuffleCRS, evals, blinders):
+    """Commit domain evaluations + Z_H-multiple blinding coefficients:
+    C = sum evals_i * L_i + sum blinders_j * (X^j Z_H)(tau) G."""
+    points = list(crs.lagrange) + list(crs.zh[:len(blinders)])
+    scalars = list(evals) + list(blinders)
+    return msm(points, scalars)
+
+
+def _blinded_coeffs(evals, blinders, w: int, omega: int):
+    """Coefficient form of the blinded polynomial."""
+    coeffs = _ifft(evals, w, omega)
+    # + (sum blinders_j X^j) * (X^w - 1)
+    bl = list(blinders)
+    ext = [0] * (w + len(bl))
+    for j, b in enumerate(bl):
+        ext[w + j] = (ext[w + j] + b) % R
+        ext[j] = (ext[j] - b) % R
+    return _poly_add(coeffs, ext)
+
+
+def _lagrange_0_at(zeta: int, w: int) -> int:
+    """L_0(zeta) = (zeta^w - 1) / (w * (zeta - 1))."""
+    num = (pow(zeta, w, R) - 1) % R
+    den = w * (zeta - 1) % R
+    return num * pow(den, R - 2, R) % R
+
+
+def prove_shuffle_poly(pre_trackers: list, permutation: list, k: int,
+                       seed: bytes | None = None) -> tuple:
+    """Build (post_trackers, proof) with post[i] = k * pre[sigma(i)]."""
+    n = len(pre_trackers)
+    assert sorted(permutation) == list(range(n))
+    k = k % R
+    assert k != 0
+    if seed is None:
+        seed = _os.urandom(32)
+
+    pre_pts = [(g1_from_bytes(bytes(a)), g1_from_bytes(bytes(b)))
+               for a, b in pre_trackers]
+    post_pts = [(pre_pts[permutation[i]][0] * k,
+                 pre_pts[permutation[i]][1] * k) for i in range(n)]
+    post_trackers = [(g1_to_bytes(a), g1_to_bytes(b))
+                     for a, b in post_pts]
+
+    # nonce derivation binds the WHOLE statement + witness: reusing a
+    # seed across different (permutation, k, post) must still yield
+    # fresh blinders/masks, or replies across proofs leak k and b_vec
+    rand = _Rand(
+        seed + b"|" + b"".join(
+            bytes(t[0]) + bytes(t[1]) for t in pre_trackers)
+        + b"|" + b"".join(a + b for a, b in post_trackers)
+        + b"|" + b",".join(str(i).encode() for i in permutation)
+        + b"|" + int(k).to_bytes(32, "big"))
+
+    w = _width_for(n)
+    crs = get_crs(w)
+    omega = crs.omega
+    g = crs.g
+
+    tr = _Transcript(b"shuffle")
+    tr.absorb(n.to_bytes(4, "little"), w.to_bytes(4, "little"))
+    for t in pre_trackers:
+        tr.absorb(bytes(t[0]), bytes(t[1]))
+    for t in post_trackers:
+        tr.absorb(bytes(t[0]), bytes(t[1]))
+
+    z = tr.challenge(b"z")
+    m = _compress_pairs(pre_trackers, z)
+    npts = _compress_pairs(post_trackers, z)
+    K = g * k
+    m += [g] * (w - n)
+    npts += [K] * (w - n)
+    tr.absorb(g1_to_bytes(K))
+
+    # permutation commitment BEFORE the vector challenge c
+    sigma = list(permutation) + list(range(n, w))
+    rho_a = rand.scalar()
+    P_a = _commit(crs, sigma, [rho_a])
+    tr.absorb(g1_to_bytes(P_a))
+
+    c = tr.challenge(b"c")
+    e = [pow(c, i, R) for i in range(w)]
+    b_vec = [e[sigma[i]] for i in range(w)]
+    rho_b = rand.scalar()
+    B = _commit(crs, b_vec, [rho_b])
+    tr.absorb(g1_to_bytes(B))
+
+    beta = tr.challenge(b"beta")
+    gamma = tr.challenge(b"gamma")
+
+    # grand product evaluations
+    zv = [1] * w
+    for i in range(w - 1):
+        num = (e[i] + beta * i + gamma) % R
+        den = (b_vec[i] + beta * sigma[i] + gamma) % R
+        zv[i + 1] = zv[i] * num % R * pow(den, R - 2, R) % R
+    rho_z = [rand.scalar(), rand.scalar(), rand.scalar()]
+    ZC = _commit(crs, zv, rho_z)
+    tr.absorb(g1_to_bytes(ZC))
+
+    alpha = tr.challenge(b"alpha")
+
+    # quotient polynomial
+    a_hat = _blinded_coeffs(sigma, [rho_a], w, omega)
+    b_hat = _blinded_coeffs(b_vec, [rho_b], w, omega)
+    z_hat = _blinded_coeffs(zv, rho_z, w, omega)
+    e_poly = _ifft(e, w, omega)
+    id_poly = _ifft(list(range(w)), w, omega)
+    z_shift = [z_hat[i] * pow(omega, i, R) % R
+               for i in range(len(z_hat))]           # Z(omega X)
+    d_poly = _poly_add(_poly_add(b_hat, _poly_scale(a_hat, beta)),
+                       [gamma])
+    e_side = _poly_add(_poly_add(e_poly, _poly_scale(id_poly, beta)),
+                       [gamma])
+    c2 = _poly_add(_poly_mul(z_shift, d_poly),
+                   _poly_scale(_poly_mul(z_hat, e_side), R - 1))
+    # C1 = L_0(X) * (Z(X) - 1); L_0 evals = [1, 0, ...]
+    l0 = _ifft([1] + [0] * (w - 1), w, omega)
+    c1 = _poly_mul(l0, _poly_add(z_hat, [R - 1]))
+    combined = _poly_add(_poly_scale(c1, alpha), c2)
+    q_poly = _divide_by_vanishing(combined, w)
+    QC = msm(crs.monomial[:len(q_poly)], q_poly)
+    tr.absorb(g1_to_bytes(QC))
+
+    zeta = tr.challenge(b"zeta")
+    a_z = _poly_eval(a_hat, zeta)
+    b_z = _poly_eval(b_hat, zeta)
+    zz = _poly_eval(z_hat, zeta)
+    zwz = _poly_eval(z_hat, omega * zeta % R)
+    tr.absorb(*[int(v).to_bytes(32, "big")
+                for v in (a_z, b_z, zz, zwz)])
+
+    # batched opening at zeta for [a, b, Z, Q] with challenge nu
+    nu = tr.challenge(b"nu")
+    q_zeta = _poly_eval(q_poly, zeta)
+    agg = list(a_hat)
+    for p, scale in ((b_hat, nu), (z_hat, nu * nu % R),
+                     (q_poly, pow(nu, 3, R))):
+        agg = _poly_add(agg, _poly_scale(p, scale))
+    agg_val = (a_z + nu * b_z + nu * nu % R * zz
+               + pow(nu, 3, R) * q_zeta) % R
+    agg[0] = (agg[0] - agg_val) % R
+    w1_poly = _divide_linear(agg, zeta)
+    W1 = msm(crs.monomial[:len(w1_poly)], w1_poly)
+    zh2 = list(z_hat)
+    zh2[0] = (zh2[0] - zwz) % R
+    w2_poly = _divide_linear(zh2, omega * zeta % R)
+    W2 = msm(crs.monomial[:len(w2_poly)], w2_poly)
+    tr.absorb(g1_to_bytes(W1), g1_to_bytes(W2))
+    _ = tr.challenge(b"batch")   # verifier's pairing-batching scalar
+
+    # MSM link: N = sum b_i n_i; Schnorr vector opening against B
+    N = msm(npts, b_vec)
+    a_mask = [rand.scalar() for _ in range(w)]
+    s_mask = rand.scalar()
+    A_rand = _commit(crs, a_mask, [s_mask])
+    E = msm(npts, a_mask)
+    tr.absorb(g1_to_bytes(N), g1_to_bytes(A_rand), g1_to_bytes(E))
+    x = tr.challenge(b"x")
+    z_vec = [(x * b_vec[i] + a_mask[i]) % R for i in range(w)]
+    t_resp = (x * rho_b + s_mask) % R
+
+    # DLEQ: log_G K == log_M N (the uniform rerandomizer k)
+    M = msm(m, e)
+    r_dleq = rand.scalar()
+    C1p = g * r_dleq
+    C2p = M * r_dleq
+    tr.absorb(g1_to_bytes(C1p), g1_to_bytes(C2p))
+    ch = tr.challenge(b"dleq")
+    s_dleq = (r_dleq + ch * k) % R
+
+    proof = b"".join([
+        n.to_bytes(4, "little"), b"POLY",
+        g1_to_bytes(K), g1_to_bytes(P_a), g1_to_bytes(B),
+        g1_to_bytes(ZC), g1_to_bytes(QC),
+        g1_to_bytes(W1), g1_to_bytes(W2),
+        int(a_z).to_bytes(32, "big"), int(b_z).to_bytes(32, "big"),
+        int(zz).to_bytes(32, "big"), int(zwz).to_bytes(32, "big"),
+        g1_to_bytes(N), g1_to_bytes(A_rand), g1_to_bytes(E),
+        b"".join(int(v).to_bytes(32, "big") for v in z_vec),
+        int(t_resp).to_bytes(32, "big"),
+        g1_to_bytes(C1p), g1_to_bytes(C2p),
+        int(s_dleq).to_bytes(32, "big"),
+    ])
+    return post_trackers, proof
+
+
+def _scalar(b: bytes) -> int:
+    """Canonical scalar decode: rejecting >= R makes the wire format
+    non-malleable (value+R would re-encode the same scalar in 32
+    bytes, changing the block root of an embedded proof)."""
+    v = int.from_bytes(b, "big")
+    if v >= R:
+        raise DecodeError("non-canonical scalar")
+    return v
+
+
+def verify_shuffle_poly(pre_trackers: list, post_trackers: list,
+                        proof: bytes) -> bool:
+    from .pairing import pairing_check
+
+    n = len(pre_trackers)
+    if len(post_trackers) != n or n == 0:
+        return False
+    proof = bytes(proof)
+    if len(proof) < 8 or proof[4:8] != b"POLY":
+        return False
+    if int.from_bytes(proof[:4], "little") != n:
+        return False
+    w = _width_for(n)
+    crs = get_crs(w)
+    omega = crs.omega
+    g = crs.g
+
+    expect = 8 + 48 * 7 + 32 * 4 + 48 * 3 + 32 * w + 32 + 48 * 2 + 32
+    if len(proof) != expect:
+        return False
+    off = 8
+
+    def point():
+        nonlocal off
+        p = g1_from_bytes(proof[off:off + 48])
+        off += 48
+        return p
+
+    def scalar():
+        nonlocal off
+        v = _scalar(proof[off:off + 32])
+        off += 32
+        return v
+
+    try:
+        K, P_a, B, ZC, QC, W1, W2 = (point() for _ in range(7))
+        a_z, b_z, zz, zwz = (scalar() for _ in range(4))
+        N, A_rand, E = (point() for _ in range(3))
+        z_vec = [scalar() for _ in range(w)]
+        t_resp = scalar()
+        C1p, C2p = point(), point()
+        s_dleq = scalar()
+    except DecodeError:
+        return False
+    if K == g1_infinity():
+        # k = 0 satisfies the relation trivially (all post trackers at
+        # infinity) — forbidden, like the prover's own k != 0 gate
+        return False
+
+    tr = _Transcript(b"shuffle")
+    tr.absorb(n.to_bytes(4, "little"), w.to_bytes(4, "little"))
+    for t in pre_trackers:
+        tr.absorb(bytes(t[0]), bytes(t[1]))
+    for t in post_trackers:
+        tr.absorb(bytes(t[0]), bytes(t[1]))
+    z = tr.challenge(b"z")
+    try:
+        m = _compress_pairs(pre_trackers, z)
+        npts = _compress_pairs(post_trackers, z)
+    except DecodeError:
+        return False
+    m += [g] * (w - n)
+    npts += [K] * (w - n)
+    tr.absorb(g1_to_bytes(K))
+    tr.absorb(g1_to_bytes(P_a))
+    c = tr.challenge(b"c")
+    e = [pow(c, i, R) for i in range(w)]
+    tr.absorb(g1_to_bytes(B))
+    beta = tr.challenge(b"beta")
+    gamma = tr.challenge(b"gamma")
+    tr.absorb(g1_to_bytes(ZC))
+    alpha = tr.challenge(b"alpha")
+    tr.absorb(g1_to_bytes(QC))
+    zeta = tr.challenge(b"zeta")
+    tr.absorb(*[int(v).to_bytes(32, "big")
+                for v in (a_z, b_z, zz, zwz)])
+    nu = tr.challenge(b"nu")
+
+    # quotient evaluation implied by the constraint system
+    zh_zeta = (pow(zeta, w, R) - 1) % R
+    if zh_zeta == 0:
+        return False
+    e_zeta = _poly_eval(_ifft(e, w, omega), zeta)
+    id_zeta = _poly_eval(_ifft(list(range(w)), w, omega), zeta)
+    l0_zeta = _lagrange_0_at(zeta, w)
+    d_zeta = (b_z + beta * a_z + gamma) % R
+    e_side_zeta = (e_zeta + beta * id_zeta + gamma) % R
+    c2_zeta = (zwz * d_zeta - zz * e_side_zeta) % R
+    c1_zeta = l0_zeta * (zz - 1) % R
+    q_zeta = (alpha * c1_zeta + c2_zeta) % R * pow(
+        zh_zeta, R - 2, R) % R
+
+    # batched KZG check at zeta: agg = P_a + nu B + nu^2 ZC + nu^3 QC
+    agg_c = P_a + B * nu + ZC * (nu * nu % R) + QC * pow(nu, 3, R)
+    agg_v = (a_z + nu * b_z + nu * nu % R * zz
+             + pow(nu, 3, R) * q_zeta) % R
+    tr.absorb(g1_to_bytes(W1), g1_to_bytes(W2))
+    # the two opening equations e(C_i - v_i G + s_i W_i, G2) ==
+    # e(W_i, tau G2) fold into ONE pairing_check with a
+    # transcript-random split scalar (drawn after W1/W2 are absorbed)
+    rho = tr.challenge(b"batch")
+    lhs1 = agg_c + (-(g * agg_v)) + W1 * zeta
+    lhs2 = ZC + (-(g * zwz)) + W2 * (omega * zeta % R)
+    if not pairing_check([(lhs1 + lhs2 * rho, -crs.g2),
+                          (W1 + W2 * rho, crs.tau_g2)]):
+        return False
+
+    # Schnorr vector opening: ties N to the committed b
+    tr.absorb(g1_to_bytes(N), g1_to_bytes(A_rand), g1_to_bytes(E))
+    x = tr.challenge(b"x")
+    lhs = msm(list(crs.lagrange) + [crs.zh[0]], z_vec + [t_resp])
+    if lhs != B * x + A_rand:
+        return False
+    if msm(npts, z_vec) != N * x + E:
+        return False
+
+    # DLEQ: N = k*M, K = k*G
+    M = msm(m, e)
+    tr.absorb(g1_to_bytes(C1p), g1_to_bytes(C2p))
+    ch = tr.challenge(b"dleq")
+    if g * s_dleq != C1p + K * ch:
+        return False
+    if M * s_dleq != C2p + N * ch:
+        return False
+    return True

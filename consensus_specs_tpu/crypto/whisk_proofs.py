@@ -18,10 +18,11 @@ BLS12-381 G1 with the same verifier interface:
   verifier learns only that post is a rerandomized permutation of pre —
   never the permutation itself (computational hiding under DDH in G1;
   honest-verifier ZK made non-interactive by Fiat–Shamir).  Proof size
-  is O(n^2) group elements — fine at the minimal preset's
-  WHISK_VALIDATORS_PER_SHUFFLE=4 (~4.4 KiB, inside the spec's 32 KiB
-  ByteList bound); an IPA-compressed curdleproofs-class argument for
-  mainnet's n=124 is future kernel work behind the same interface.
+  is O(n^2) group elements — the minimal-preset ORACLE engine
+  (WHISK_VALIDATORS_PER_SHUFFLE=4, ~4.4 KiB).  The mainnet-size engine
+  is the polynomial KZG argument in whisk_poly.py (O(n) scalars,
+  ~5 KiB at n=124); verify_shuffle dispatches on the proof's format
+  tag, so both live behind the one spec-facing verifier.
 
 Proof wire formats are length-prefixed concatenations of compressed G1
 points and 32-byte scalars, within the spec's ByteList bounds.
@@ -391,11 +392,19 @@ def prove_shuffle(pre_trackers: list, permutation: list,
 def verify_shuffle(pre_trackers: list, post_trackers: list,
                    proof: bytes) -> bool:
     """Verify post is a rerandomized permutation of pre.  Zero-knowledge:
-    the proof reveals nothing about the permutation."""
+    the proof reveals nothing about the permutation.
+
+    Two proof engines behind one verifier: the O(n^2) switching network
+    (minimal-preset oracle, below) and the polynomial KZG argument
+    (whisk_poly, mainnet n=124 — ~5 KiB), selected by the proof's
+    format tag."""
     n = len(pre_trackers)
     if len(post_trackers) != n or n == 0:
         return False
     proof = bytes(proof)
+    if len(proof) >= 8 and proof[4:8] == b"POLY":
+        from .whisk_poly import verify_shuffle_poly
+        return verify_shuffle_poly(pre_trackers, post_trackers, proof)
     if len(proof) < 4 or int.from_bytes(proof[:4], "little") != n:
         return False
     if n == 1:

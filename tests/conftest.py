@@ -82,15 +82,27 @@ KERNEL_TIER_FILES = {
 }
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-minute pure-python crypto workload (mainnet-size "
+        "whisk proofs); runs under --kernel-tiers / RUN_KERNEL_TIERS=1")
+
+
 def pytest_collection_modifyitems(config, items):
     if config.getoption("--kernel-tiers"):
         return
     skip = pytest.mark.skip(
         reason="kernel tier (multi-minute XLA compile): enable with "
                "--kernel-tiers / RUN_KERNEL_TIERS=1 / make test-kernels")
+    skip_slow = pytest.mark.skip(
+        reason="slow tier (mainnet-size pure-python proof): enable "
+               "with --kernel-tiers / RUN_KERNEL_TIERS=1")
     for item in items:
         if os.path.basename(str(item.fspath)) in KERNEL_TIER_FILES:
             item.add_marker(skip)
+        elif item.get_closest_marker("slow") is not None:
+            item.add_marker(skip_slow)
 
 
 @pytest.fixture(autouse=True, scope="session")

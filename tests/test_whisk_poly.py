@@ -1,0 +1,164 @@
+"""Mainnet-capable polynomial whisk shuffle argument
+(crypto/whisk_poly.py): completeness + soundness at small width, the
+n=124 mainnet shape within the spec's WHISK_MAX_SHUFFLE_PROOF_SIZE
+bound, and the spec-level process_shuffled_trackers path on the
+mainnet preset with a real proof.
+"""
+import random
+
+import pytest
+
+from consensus_specs_tpu.crypto.curve import (
+    g1_from_bytes, g1_generator, g1_to_bytes)
+from consensus_specs_tpu.crypto.whisk_poly import (
+    prove_shuffle_poly, verify_shuffle_poly)
+from consensus_specs_tpu.crypto import whisk_proofs
+from consensus_specs_tpu.specs import get_spec
+
+G = g1_generator()
+
+
+def _trackers(n, base=1000):
+    out = []
+    for i in range(n):
+        r_g = G * (base + i)
+        out.append((g1_to_bytes(r_g), g1_to_bytes(r_g * (77 + i))))
+    return out
+
+
+def test_poly_shuffle_completeness_and_dispatch():
+    pre = _trackers(4)
+    post, proof = prove_shuffle_poly(pre, [2, 0, 3, 1], k=12345,
+                                     seed=b"t")
+    assert verify_shuffle_poly(pre, post, proof)
+    # the shared verifier dispatches on the POLY tag
+    assert whisk_proofs.verify_shuffle(pre, post, proof)
+    # the post trackers really are k * pre[sigma]
+    for i, src in enumerate([2, 0, 3, 1]):
+        assert g1_from_bytes(post[i][0]) == \
+            g1_from_bytes(pre[src][0]) * 12345
+
+
+def test_poly_shuffle_soundness_smokes():
+    pre = _trackers(4)
+    post, proof = prove_shuffle_poly(pre, [1, 0, 2, 3], k=999,
+                                     seed=b"s")
+    swapped = [post[1], post[0]] + post[2:]
+    assert not verify_shuffle_poly(pre, swapped, proof)
+    foreign = list(post)
+    r_g = G * 31337
+    foreign[2] = (g1_to_bytes(r_g), g1_to_bytes(r_g * 3))
+    assert not verify_shuffle_poly(pre, foreign, proof)
+    for off in (9, 60, 200, 400, len(proof) - 10):
+        mutated = bytearray(proof)
+        mutated[off] ^= 1
+        assert not verify_shuffle_poly(pre, post, bytes(mutated))
+    assert not verify_shuffle_poly(_trackers(4, base=5000), post, proof)
+    # per-tracker (non-uniform) rerandomizers are NOT the relation
+    nonuniform = [
+        (g1_to_bytes(g1_from_bytes(a) * (100 + i)),
+         g1_to_bytes(g1_from_bytes(b) * (100 + i)))
+        for i, (a, b) in enumerate(pre)]
+    assert not verify_shuffle_poly(pre, nonuniform, proof)
+
+
+def test_poly_shuffle_hides_permutation_seed_dependence():
+    """Same statement, different prover seeds: transcripts differ (the
+    commitments are blinded), both verify."""
+    pre = _trackers(4)
+    post1, proof1 = prove_shuffle_poly(pre, [3, 2, 1, 0], k=5, seed=b"a")
+    post2, proof2 = prove_shuffle_poly(pre, [3, 2, 1, 0], k=5, seed=b"b")
+    assert post1 == post2
+    assert proof1 != proof2
+    assert verify_shuffle_poly(pre, post1, proof1)
+    assert verify_shuffle_poly(pre, post2, proof2)
+
+
+@pytest.mark.slow
+def test_poly_shuffle_mainnet_shape():
+    spec = get_spec("whisk", "mainnet")
+    n = int(spec.WHISK_VALIDATORS_PER_SHUFFLE)
+    assert n == 124
+    pre = _trackers(n)
+    perm = list(range(n))
+    random.Random(7).shuffle(perm)
+    post, proof = prove_shuffle_poly(pre, perm, k=987654321, seed=b"m")
+    assert len(proof) <= int(spec.WHISK_MAX_SHUFFLE_PROOF_SIZE)
+    assert verify_shuffle_poly(pre, post, proof)
+
+
+@pytest.mark.slow
+def test_mainnet_process_shuffled_trackers_with_real_proof():
+    """The spec-level shuffle-processing path on the MAINNET preset,
+    fed a real polynomial proof over the spec-selected 124 trackers."""
+    spec = get_spec("whisk", "mainnet")
+    state = spec.BeaconState()
+    body = spec.BeaconBlockBody()
+    body.randao_reveal = b"\x5b" * 96
+    indices = spec.get_shuffle_indices(body.randao_reveal)
+    assert len(indices) == 124
+
+    pre = []
+    seen = {}
+    for j, idx in enumerate(indices):
+        # duplicate indices must carry identical trackers
+        if idx in seen:
+            pre.append(pre[seen[idx]])
+            continue
+        seen[idx] = j
+        r_g = G * (4000 + j)
+        tracker = (g1_to_bytes(r_g), g1_to_bytes(r_g * (9 + j)))
+        pre.append(tracker)
+        state.whisk_candidate_trackers[idx] = spec.WhiskTracker(
+            r_G=tracker[0], k_r_G=tracker[1])
+
+    perm = list(range(len(indices)))
+    random.Random(3).shuffle(perm)
+    post, proof = prove_shuffle_poly(pre, perm, k=31337, seed=b"sp")
+    from consensus_specs_tpu.ssz import Vector
+    body.whisk_post_shuffle_trackers = Vector[
+        spec.WhiskTracker, spec.WHISK_VALIDATORS_PER_SHUFFLE](
+        [spec.WhiskTracker(r_G=a, k_r_G=b) for a, b in post])
+    body.whisk_shuffle_proof = proof
+
+    spec.process_shuffled_trackers(state, body)
+    assert bytes(state.whisk_candidate_trackers[indices[0]].r_G) == \
+        bytes(post[0][0])
+
+    # tampered proof rejected through the same spec path
+    state2 = spec.BeaconState()
+    for idx, j in seen.items():
+        state2.whisk_candidate_trackers[idx] = spec.WhiskTracker(
+            r_G=pre[j][0], k_r_G=pre[j][1])
+    mutated = bytearray(proof)
+    mutated[100] ^= 1
+    body.whisk_shuffle_proof = bytes(mutated)
+    with pytest.raises(AssertionError):
+        spec.process_shuffled_trackers(state2, body)
+
+
+def test_poly_proof_non_malleable_scalars():
+    """Re-encoding a scalar as value+R (same value mod R, different
+    bytes) must be rejected — block-root malleability otherwise."""
+    from consensus_specs_tpu.crypto.fields import R
+    pre = _trackers(4)
+    post, proof = prove_shuffle_poly(pre, [0, 1, 3, 2], k=42, seed=b"nm")
+    assert verify_shuffle_poly(pre, post, proof)
+    t_off = len(proof) - 160          # t_resp | C1p | C2p | s_dleq
+    t_val = int.from_bytes(proof[t_off:t_off + 32], "big")
+    alt = t_val + R
+    assert alt < 1 << 256
+    mutated = proof[:t_off] + alt.to_bytes(32, "big") + proof[t_off + 32:]
+    assert mutated != proof
+    assert not verify_shuffle_poly(pre, post, mutated)
+
+
+def test_poly_rejects_zero_k_statement():
+    """A handcrafted k=0 'shuffle' (all post trackers at infinity) must
+    not verify even with a well-formed proof structure."""
+    from consensus_specs_tpu.crypto.curve import g1_infinity
+    pre = _trackers(4)
+    post, proof = prove_shuffle_poly(pre, [0, 1, 2, 3], k=7, seed=b"zk")
+    inf = g1_to_bytes(g1_infinity())
+    zeroed = [(inf, inf)] * 4
+    assert not verify_shuffle_poly(pre, zeroed, proof)
