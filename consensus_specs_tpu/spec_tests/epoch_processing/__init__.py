@@ -20,4 +20,7 @@ EPOCH_PROCESSING_HANDLERS = {
     "pending_queues":
         "consensus_specs_tpu.spec_tests.epoch_processing."
         "test_pending_queues",
+    "inactivity_updates":
+        "consensus_specs_tpu.spec_tests.epoch_processing."
+        "test_inactivity_updates",
 }

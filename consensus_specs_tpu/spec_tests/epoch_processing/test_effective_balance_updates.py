@@ -72,3 +72,92 @@ def test_effective_balance_compounding_ceiling(spec, state):
     assert int(state.validators[0].effective_balance) == big
     assert int(state.validators[1].effective_balance) == \
         int(spec.MIN_ACTIVATION_BALANCE)
+
+
+@with_all_phases
+@spec_state_test
+def test_effective_balance_zero_balance(spec, state):
+    """A fully drained balance floors the effective balance at zero."""
+    state.balances[0] = uint64(0)
+    yield from run_epoch_processing_with(
+        spec, state, "process_effective_balance_updates")
+    assert int(state.validators[0].effective_balance) == 0
+
+
+@with_all_phases
+@spec_state_test
+def test_effective_balance_exact_downward_threshold(spec, state):
+    """Balance exactly AT effective - downward margin: stays (strict
+    inequality)."""
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    down = inc * int(spec.HYSTERESIS_DOWNWARD_MULTIPLIER) // \
+        int(spec.HYSTERESIS_QUOTIENT)
+    max_eb = int(spec.MAX_EFFECTIVE_BALANCE)
+    state.validators[0].effective_balance = uint64(max_eb)
+    state.balances[0] = uint64(max_eb - down)
+    yield from run_epoch_processing_with(
+        spec, state, "process_effective_balance_updates")
+    assert int(state.validators[0].effective_balance) == max_eb
+
+
+@with_all_phases
+@spec_state_test
+def test_effective_balance_one_below_downward_threshold(spec, state):
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    down = inc * int(spec.HYSTERESIS_DOWNWARD_MULTIPLIER) // \
+        int(spec.HYSTERESIS_QUOTIENT)
+    max_eb = int(spec.MAX_EFFECTIVE_BALANCE)
+    state.validators[0].effective_balance = uint64(max_eb)
+    state.balances[0] = uint64(max_eb - down - 1)
+    yield from run_epoch_processing_with(
+        spec, state, "process_effective_balance_updates")
+    assert int(state.validators[0].effective_balance) == max_eb - inc
+
+
+@with_all_phases
+@spec_state_test
+def test_effective_balance_exact_upward_threshold(spec, state):
+    """Balance exactly AT effective + upward margin: stays."""
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    up = inc * int(spec.HYSTERESIS_UPWARD_MULTIPLIER) // \
+        int(spec.HYSTERESIS_QUOTIENT)
+    max_eb = int(spec.MAX_EFFECTIVE_BALANCE)
+    pre = max_eb - 2 * inc
+    state.validators[0].effective_balance = uint64(pre)
+    state.balances[0] = uint64(pre + up)
+    yield from run_epoch_processing_with(
+        spec, state, "process_effective_balance_updates")
+    assert int(state.validators[0].effective_balance) == pre
+
+
+@with_all_phases
+@spec_state_test
+def test_effective_balance_one_above_upward_threshold(spec, state):
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    up = inc * int(spec.HYSTERESIS_UPWARD_MULTIPLIER) // \
+        int(spec.HYSTERESIS_QUOTIENT)
+    max_eb = int(spec.MAX_EFFECTIVE_BALANCE)
+    pre = max_eb - 2 * inc
+    state.validators[0].effective_balance = uint64(pre)
+    state.balances[0] = uint64(pre + up + 1)
+    yield from run_epoch_processing_with(
+        spec, state, "process_effective_balance_updates")
+    assert int(state.validators[0].effective_balance) == pre + inc
+
+
+@with_all_phases
+@spec_state_test
+def test_effective_balance_whole_registry_drifts(spec, state):
+    """Every validator nudged randomly: post-effectives are all
+    increment-quantized and within the ceiling."""
+    import random as _r
+    rng = _r.Random(f"{spec.fork}:eb-drift")
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    for i in range(len(state.validators)):
+        state.balances[i] = uint64(
+            max(int(state.balances[i]) + rng.randrange(-2 * inc,
+                                                       2 * inc), 0))
+    yield from run_epoch_processing_with(
+        spec, state, "process_effective_balance_updates")
+    for v in state.validators:
+        assert int(v.effective_balance) % inc == 0

@@ -1,0 +1,219 @@
+"""process_inactivity_updates epoch tests (altair+; reference:
+test/altair/epoch_processing/test_process_inactivity_updates.py —
+score movement under the {zero, random} x {empty, random, full}
+participation x {leaking, finalizing} matrix).
+"""
+import random as _random
+
+from ...ssz import uint64
+from ...test_infra.context import (
+    never_bls, spec_state_test, with_all_phases_from)
+from ...test_infra.blocks import transition_to
+from ...test_infra.epoch_processing import run_epoch_processing_with
+
+FLAG_COUNT = 3
+
+
+def _full_flags(spec) -> int:
+    flags = 0
+    for i in range(len(spec.PARTICIPATION_FLAG_WEIGHTS)):
+        flags = spec.add_flag(flags, i)
+    return flags
+
+
+def _set_leaking(spec, state) -> None:
+    target = (int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 3) * \
+        int(spec.SLOTS_PER_EPOCH)
+    transition_to(spec, state, uint64(target))
+    assert spec.is_in_inactivity_leak(state)
+
+
+def _participation(spec, state, mode: str, rng=None) -> None:
+    n = len(state.validators)
+    full = _full_flags(spec)
+    if mode == "full":
+        vals = [full] * n
+    elif mode == "empty":
+        vals = [0] * n
+    else:
+        vals = [rng.randrange(0, full + 1) for _ in range(n)]
+    state.previous_epoch_participation = vals
+
+
+def _scores(spec, state, mode: str, rng=None) -> None:
+    n = len(state.validators)
+    if mode == "zero":
+        state.inactivity_scores = [0] * n
+    else:
+        state.inactivity_scores = [
+            uint64(rng.randrange(0, 100)) for _ in range(n)]
+
+
+def _run_case(spec, state, scores: str, participation: str,
+              leaking: bool, seed: str, mutate=None):
+    rng = _random.Random(f"{spec.fork}:{seed}")
+    if leaking:
+        _set_leaking(spec, state)
+    else:
+        transition_to(spec, state, uint64(2 * spec.SLOTS_PER_EPOCH))
+        # keep finality fresh so the leak is off
+        state.finalized_checkpoint.epoch = uint64(
+            max(int(spec.get_current_epoch(state)) - 2, 0))
+    _participation(spec, state, participation, rng)
+    _scores(spec, state, scores, rng)
+    if mutate is not None:
+        mutate(rng)
+    yield from run_epoch_processing_with(
+        spec, state, "process_inactivity_updates")
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_genesis(spec, state):
+    """At the genesis epoch the pass is a no-op."""
+    pre = list(state.inactivity_scores)
+    yield from run_epoch_processing_with(
+        spec, state, "process_inactivity_updates")
+    assert list(state.inactivity_scores) == pre
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_all_zero_scores_empty_participation(spec, state):
+    yield from _run_case(spec, state, "zero", "empty", False, "s1")
+    assert all(int(s) == 0 for s in state.inactivity_scores)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_all_zero_scores_empty_participation_leaking(spec, state):
+    yield from _run_case(spec, state, "zero", "empty", True, "s2")
+    bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+    assert all(int(s) == bias for s in state.inactivity_scores)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_all_zero_scores_random_participation(spec, state):
+    yield from _run_case(spec, state, "zero", "random", False, "s3")
+    assert all(int(s) == 0 for s in state.inactivity_scores)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_all_zero_scores_random_participation_leaking(spec, state):
+    yield from _run_case(spec, state, "zero", "random", True, "s4")
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_all_zero_scores_full_participation(spec, state):
+    yield from _run_case(spec, state, "zero", "full", False, "s5")
+    assert all(int(s) == 0 for s in state.inactivity_scores)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_all_zero_scores_full_participation_leaking(spec, state):
+    """Target-participating validators never accrue score, leak or
+    not."""
+    yield from _run_case(spec, state, "zero", "full", True, "s6")
+    assert all(int(s) == 0 for s in state.inactivity_scores)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_random_scores_empty_participation(spec, state):
+    """No leak: scores decay by the recovery rate, never below 0."""
+    yield from _run_case(spec, state, "random", "empty", False, "s7")
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_random_scores_empty_participation_leaking(spec, state):
+    yield from _run_case(spec, state, "random", "empty", True, "s8")
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_random_scores_random_participation(spec, state):
+    yield from _run_case(spec, state, "random", "random", False, "s9")
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_random_scores_random_participation_leaking(spec, state):
+    yield from _run_case(spec, state, "random", "random", True, "s10")
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_random_scores_full_participation_leaking(spec, state):
+    """During a leak, participating validators shed exactly 1 score
+    point (the recovery-rate decay is gated on NOT leaking)."""
+    pre_done = {}
+
+    def grab(_rng):
+        pre_done.update(
+            {i: int(s) for i, s in
+             enumerate(state.inactivity_scores)})
+    yield from _run_case(spec, state, "random", "full", True, "s11",
+                         mutate=grab)
+    for i, s in enumerate(state.inactivity_scores):
+        assert int(s) == max(pre_done[i] - 1, 0)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_some_slashed_zero_scores_full_participation_leaking(spec,
+                                                             state):
+    """Slashed validators cannot earn target credit: their scores climb
+    during a leak despite full participation flags."""
+    def slash(_rng):
+        for i in range(0, len(state.validators), 4):
+            state.validators[i].slashed = True
+            state.validators[i].withdrawable_epoch = uint64(
+                int(spec.get_current_epoch(state))
+                + int(spec.EPOCHS_PER_SLASHINGS_VECTOR))
+    yield from _run_case(spec, state, "zero", "full", True, "s12",
+                         mutate=slash)
+    bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+    for i, s in enumerate(state.inactivity_scores):
+        assert int(s) == (bias if i % 4 == 0 else 0)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_some_exited_full_random_leaking(spec, state):
+    def exit_some(rng):
+        cur = int(spec.get_current_epoch(state))
+        for i in range(0, len(state.validators), 5):
+            state.validators[i].exit_epoch = uint64(max(cur - 1, 0))
+            state.validators[i].withdrawable_epoch = uint64(cur + 10)
+    yield from _run_case(spec, state, "random", "random", True, "s13",
+                         mutate=exit_some)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_randomized_state_leaking(spec, state):
+    from ...test_infra.random import randomize_state, rng_for
+    def scramble(_rng):
+        randomize_state(spec, state, rng_for(spec, seed=0xABCD))
+    yield from _run_case(spec, state, "random", "random", True, "s14",
+                         mutate=scramble)

@@ -195,3 +195,141 @@ def test_invalid_large_withdrawable_epoch(spec, state):
         yield "post", None
         return
     raise AssertionError("uint64 overflow unexpectedly tolerated")
+
+
+def _queue_validators(spec, state, count, eligibility_epoch=1):
+    """Mark `count` existing validators as queued (eligible, not yet
+    activated)."""
+    out = []
+    for i in range(count):
+        v = state.validators[i]
+        v.activation_epoch = spec.FAR_FUTURE_EPOCH
+        v.activation_eligibility_epoch = uint64(eligibility_epoch)
+        out.append(i)
+    return out
+
+
+def _finalize(spec, state, epochs_back=1):
+    state.finalized_checkpoint.epoch = uint64(
+        max(int(spec.get_current_epoch(state)) - epochs_back, 0))
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_queue_activation_and_ejection_1(spec, state):
+    """One activation and one ejection in the same pass."""
+    from ...test_infra.blocks import next_epoch
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    queued = _queue_validators(spec, state, 1)
+    _finalize(spec, state)
+    eject = len(state.validators) - 1
+    state.validators[eject].effective_balance = uint64(
+        spec.config.EJECTION_BALANCE)
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    assert state.validators[queued[0]].activation_epoch != \
+        spec.FAR_FUTURE_EPOCH
+    assert state.validators[eject].exit_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_queue_activation_and_ejection_churn_limit(spec,
+                                                              state):
+    from ...test_infra.blocks import next_epoch
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    churn = int(spec.get_validator_churn_limit(state))
+    take = min(churn, len(state.validators) // 2)
+    queued = _queue_validators(spec, state, take)
+    _finalize(spec, state)
+    for off in range(take):
+        eject = len(state.validators) - 1 - off
+        state.validators[eject].effective_balance = uint64(
+            spec.config.EJECTION_BALANCE)
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    # electra removed the activation churn gate; pre-electra all fit
+    assert all(state.validators[i].activation_epoch !=
+               spec.FAR_FUTURE_EPOCH for i in queued)
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_queue_exceed_churn_limit(spec, state):
+    """More eligible validators than the churn limit: pre-electra only
+    churn-many activate; electra (EIP-7251) activates all."""
+    from ...test_infra.blocks import next_epoch
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    churn = int(spec.get_validator_churn_limit(state))
+    take = min(churn + 2, len(state.validators))
+    queued = _queue_validators(spec, state, take)
+    _finalize(spec, state)
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    activated = sum(
+        1 for i in queued
+        if state.validators[i].activation_epoch !=
+        spec.FAR_FUTURE_EPOCH)
+    if spec.is_post("electra"):
+        assert activated == take
+    else:
+        assert activated == min(churn, take)
+
+
+@with_all_phases
+@spec_state_test
+def test_ejection_exit_epochs_sequential_past_churn(spec, state):
+    """Ejections beyond the exit churn spread across exit epochs."""
+    churn = int(spec.get_validator_churn_limit(state)) \
+        if not spec.is_post("electra") else 2
+    take = min(churn * 2, len(state.validators) // 2)
+    for i in range(take):
+        state.validators[i].effective_balance = uint64(
+            spec.config.EJECTION_BALANCE)
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    epochs = [int(state.validators[i].exit_epoch) for i in range(take)]
+    assert all(e != int(spec.FAR_FUTURE_EPOCH) for e in epochs)
+    if not spec.is_post("electra") and take > churn:
+        assert len(set(epochs)) >= 2
+
+
+@with_all_phases
+@spec_state_test
+def test_eligibility_requires_max_effective_balance(spec, state):
+    """Below-threshold validators never enter the activation queue."""
+    from ...test_infra.genesis import build_mock_validator
+    fresh = build_mock_validator(
+        spec, len(state.validators),
+        uint64(int(spec.MAX_EFFECTIVE_BALANCE) // 2))
+    fresh.activation_eligibility_epoch = spec.FAR_FUTURE_EPOCH
+    fresh.activation_epoch = spec.FAR_FUTURE_EPOCH
+    state.validators.append(fresh)
+    state.balances.append(uint64(int(spec.MAX_EFFECTIVE_BALANCE) // 2))
+    if spec.is_post("altair"):
+        state.previous_epoch_participation.append(0)
+        state.current_epoch_participation.append(0)
+        state.inactivity_scores.append(0)
+    index = len(state.validators) - 1
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    assert state.validators[index].activation_eligibility_epoch == \
+        spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_already_exited_not_ejected_again(spec, state):
+    """A low-balance validator that already initiated exit keeps its
+    exit epoch."""
+    index = 4
+    spec.initiate_validator_exit(state, uint64(index))
+    before = int(state.validators[index].exit_epoch)
+    state.validators[index].effective_balance = uint64(
+        spec.config.EJECTION_BALANCE)
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    assert int(state.validators[index].exit_epoch) == before

@@ -51,3 +51,102 @@ def test_randao_mixes_reset(spec, state):
         spec, state, "process_randao_mixes_reset")
     assert bytes(state.randao_mixes[next_slot_index]) == bytes(
         spec.get_randao_mix(state, current_epoch))
+
+
+@with_all_phases
+@spec_state_test
+def test_historical_accumulator_update_at_boundary(spec, state):
+    """Crossing a SLOTS_PER_HISTORICAL_ROOT boundary appends one
+    accumulator entry (roots pre-capella, summaries after)."""
+    target = int(spec.SLOTS_PER_HISTORICAL_ROOT) - 1
+    transition_to(spec, state, uint64(target))
+    pass_name = ("process_historical_summaries_update"
+                 if spec.is_post("capella")
+                 else "process_historical_roots_update")
+    pre_hist = len(state.historical_roots)
+    pre_summ = len(state.historical_summaries) \
+        if spec.is_post("capella") else 0
+    yield from run_epoch_processing_with(spec, state, pass_name)
+    if spec.is_post("capella"):
+        assert len(state.historical_summaries) == pre_summ + 1
+    else:
+        assert len(state.historical_roots) == pre_hist + 1
+
+
+@with_all_phases
+@spec_state_test
+def test_historical_accumulator_no_update_mid_period(spec, state):
+    transition_to(spec, state, uint64(int(spec.SLOTS_PER_EPOCH) - 1))
+    pass_name = ("process_historical_summaries_update"
+                 if spec.is_post("capella")
+                 else "process_historical_roots_update")
+    pre_hist = len(state.historical_roots)
+    pre_summ = len(state.historical_summaries) \
+        if spec.is_post("capella") else 0
+    yield from run_epoch_processing_with(spec, state, pass_name)
+    if spec.is_post("capella"):
+        assert len(state.historical_summaries) == pre_summ
+    else:
+        assert len(state.historical_roots) == pre_hist
+
+
+@with_all_phases
+@spec_state_test
+def test_slashings_reset_only_next_slot_cleared(spec, state):
+    """The reset zeroes exactly the NEXT epoch's slashings slot,
+    leaving the rest of the ring intact."""
+    vec = int(spec.EPOCHS_PER_SLASHINGS_VECTOR)
+    for i in range(vec):
+        state.slashings[i] = uint64(1000 + i)
+    cur = int(spec.get_current_epoch(state))
+    nxt = (cur + 1) % vec
+    yield from run_epoch_processing_with(
+        spec, state, "process_slashings_reset")
+    for i in range(vec):
+        expect = 0 if i == nxt else 1000 + i
+        assert int(state.slashings[i]) == expect, i
+
+
+@with_all_phases
+@spec_state_test
+def test_randao_mixes_carry_forward(spec, state):
+    """The next epoch's randao slot inherits the current mix."""
+    vec = int(spec.EPOCHS_PER_HISTORICAL_VECTOR)
+    cur = int(spec.get_current_epoch(state))
+    cur_mix = bytes(state.randao_mixes[cur % vec])
+    yield from run_epoch_processing_with(
+        spec, state, "process_randao_mixes_reset")
+    assert bytes(state.randao_mixes[(cur + 1) % vec]) == cur_mix
+
+
+from ...test_infra.context import with_all_phases_from  # noqa: E402
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+def test_participation_flag_rotation(spec, state):
+    """Epoch rotation moves current flags to previous and zeroes
+    current."""
+    n = len(state.validators)
+    state.current_epoch_participation = [0b101] * n
+    state.previous_epoch_participation = [0b010] * n
+    yield from run_epoch_processing_with(
+        spec, state, "process_participation_flag_updates")
+    assert all(int(f) == 0b101
+               for f in state.previous_epoch_participation)
+    assert all(int(f) == 0
+               for f in state.current_epoch_participation)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+def test_sync_committee_rotation_at_period_boundary(spec, state):
+    """At an EPOCHS_PER_SYNC_COMMITTEE_PERIOD boundary the next
+    committee shifts in and a fresh one is computed."""
+    period_slots = int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD) * \
+        int(spec.SLOTS_PER_EPOCH)
+    transition_to(spec, state, uint64(period_slots - 1))
+    pre_next = state.next_sync_committee.copy()
+    yield from run_epoch_processing_with(
+        spec, state, "process_sync_committee_updates")
+    assert state.current_sync_committee == pre_next

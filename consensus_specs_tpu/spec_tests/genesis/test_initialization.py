@@ -126,3 +126,59 @@ def test_initialize_beacon_state_one_topup_activation(spec):
     assert len(state.validators) == count
     assert spec.is_valid_genesis_state(state)
     yield "state", state
+
+
+@with_all_phases_from("phase0", to="deneb")
+@spec_test
+@never_bls
+def test_initialize_beacon_state_from_eth1_some_zero_balances(spec):
+    """Sub-activation-balance deposits register validators that never
+    activate; the genesis state still forms."""
+    count = int(spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT)
+    deposits, deposit_root = _genesis_deposits(
+        spec, count, spec.MAX_EFFECTIVE_BALANCE)
+    low_wc = spec.BLS_WITHDRAWAL_PREFIX + bytes(
+        spec.hash(pubkeys[count]))[1:]
+    extra, deposit_root, _lst = build_deposit(
+        spec, [d.data for d in deposits], pubkeys[count],
+        privkeys[count], uint64(10**9), low_wc, signed=True)
+    deposits = deposits + [extra]
+    eth1_block_hash = b"\x42" * 32
+    eth1_timestamp = int(spec.config.MIN_GENESIS_TIME)
+    yield "eth1", "data", {
+        "eth1_block_hash": "0x" + eth1_block_hash.hex(),
+        "eth1_timestamp": eth1_timestamp,
+    }
+    yield "deposits_count", "meta", len(deposits)
+    for i, d in enumerate(deposits):
+        yield f"deposits_{i}", d
+    state = spec.initialize_beacon_state_from_eth1(
+        eth1_block_hash, eth1_timestamp, deposits)
+    assert len(state.validators) == count + 1
+    assert int(state.validators[count].activation_epoch) == int(
+        spec.FAR_FUTURE_EPOCH)
+    yield "state", state
+
+
+@with_all_phases_from("phase0", to="deneb")
+@spec_test
+@never_bls
+def test_initialize_beacon_state_early_timestamp_invalid_genesis(spec):
+    """The state forms at any timestamp; genesis VALIDITY is the
+    separate is_valid_genesis_state gate."""
+    count = int(spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT)
+    deposits, deposit_root = _genesis_deposits(
+        spec, count, spec.MAX_EFFECTIVE_BALANCE)
+    eth1_block_hash = b"\x43" * 32
+    eth1_timestamp = 3
+    yield "eth1", "data", {
+        "eth1_block_hash": "0x" + eth1_block_hash.hex(),
+        "eth1_timestamp": eth1_timestamp,
+    }
+    yield "deposits_count", "meta", len(deposits)
+    for i, d in enumerate(deposits):
+        yield f"deposits_{i}", d
+    state = spec.initialize_beacon_state_from_eth1(
+        eth1_block_hash, eth1_timestamp, deposits)
+    assert not spec.is_valid_genesis_state(state)
+    yield "state", state

@@ -49,3 +49,137 @@ def test_invalid_deposit_proof(spec, state):
     deposit.proof[3] = b"\x55" * 32
     yield from run_deposit_processing(spec, state, deposit, validator_index,
                                       valid=False)
+
+
+from ...ssz import uint64  # noqa: E402
+from ...test_infra.context import (  # noqa: E402
+    always_bls, never_bls)
+
+
+@with_all_phases
+@spec_state_test
+def test_new_deposit_max(spec, state):
+    validator_index = len(state.validators)
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, spec.MAX_EFFECTIVE_BALANCE,
+        signed=True)
+    yield from run_deposit_processing(spec, state, deposit,
+                                      validator_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_new_deposit_over_max(spec, state):
+    """Deposits above the max effective balance are accepted; the
+    excess stays as plain balance."""
+    validator_index = len(state.validators)
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index,
+        uint64(int(spec.MAX_EFFECTIVE_BALANCE) + 10**9), signed=True)
+    yield from run_deposit_processing(spec, state, deposit,
+                                      validator_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_new_deposit_eth1_withdrawal_credentials(spec, state):
+    validator_index = len(state.validators)
+    creds = b"\x01" + b"\x00" * 11 + b"\x42" * 20
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, spec.MAX_EFFECTIVE_BALANCE,
+        withdrawal_credentials=creds, signed=True)
+    yield from run_deposit_processing(spec, state, deposit,
+                                      validator_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_new_deposit_non_versioned_withdrawal_credentials(spec, state):
+    """Arbitrary credential prefixes are NOT validated at deposit
+    time (only at withdrawal)."""
+    validator_index = len(state.validators)
+    creds = b"\xff" + b"\x02" * 31
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, spec.MAX_EFFECTIVE_BALANCE,
+        withdrawal_credentials=creds, signed=True)
+    yield from run_deposit_processing(spec, state, deposit,
+                                      validator_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_top_up_less_than_min_activation(spec, state):
+    validator_index = 1
+    amount = uint64(10**9)
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, amount, signed=True)
+    yield from run_deposit_processing(spec, state, deposit,
+                                      validator_index)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_top_up_invalid_sig(spec, state):
+    """Top-ups skip the signature check entirely (pre-electra
+    immediate; electra checks at queue application against the
+    EXISTING validator)."""
+    validator_index = 0
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, uint64(10**9), signed=False)
+    yield from run_deposit_processing(spec, state, deposit,
+                                      validator_index)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_new_deposit_wrong_pubkey_sig(spec, state):
+    """A garbage signature on a NEW pubkey: the deposit processes but
+    takes no effect on any fork (pre-electra: no validator added;
+    electra: nothing queued)."""
+    validator_index = len(state.validators)
+    # stage normally then overwrite the signature (and restage the
+    # eth1 root, which commits to the data incl. signature)
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, spec.MAX_EFFECTIVE_BALANCE,
+        signed=True)
+    deposit.data.signature = b"\x99" * 96
+    # the eth1 root commits to the data incl. signature: restage
+    from ...test_infra.deposits import deposit_tree
+    root, _leaves = deposit_tree(spec, [deposit.data])
+    from ...ssz.merkle import get_merkle_proof
+    limit = 2 ** spec.DEPOSIT_CONTRACT_TREE_DEPTH
+    proof = get_merkle_proof(_leaves, 0, limit=limit) + [
+        (1).to_bytes(32, "little")]
+    deposit.proof = proof
+    state.eth1_data.deposit_root = root
+    yield from run_deposit_processing(
+        spec, state, deposit, validator_index, effective=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_deposit_index_mismatch(spec, state):
+    """eth1_deposit_index pointing past the staged deposit breaks the
+    merkle branch."""
+    validator_index = len(state.validators)
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, spec.MAX_EFFECTIVE_BALANCE,
+        signed=True)
+    state.eth1_deposit_index = uint64(1)
+    yield from run_deposit_processing(
+        spec, state, deposit, validator_index, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_deposit_short_proof(spec, state):
+    validator_index = len(state.validators)
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, spec.MAX_EFFECTIVE_BALANCE,
+        signed=True)
+    deposit.proof = deposit.proof[:-1] + [b"\x00" * 32]
+    deposit.proof[-1] = b"\x07" * 32
+    yield from run_deposit_processing(
+        spec, state, deposit, validator_index, valid=False)

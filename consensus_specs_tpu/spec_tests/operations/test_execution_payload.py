@@ -115,3 +115,102 @@ def test_blob_commitments_at_limit(spec, state):
     limit = int(spec.max_blobs_per_block())
     commitments = [b"\xc0" + b"\x00" * 47] * limit
     yield from _run(spec, state, payload, commitments=commitments)
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+def test_success_first_payload(spec, state):
+    """The merge-transition block: pre-merge header, first payload."""
+    if spec.is_post("capella"):
+        # capella+ states are always post-merge; covered by regular
+        payload = build_empty_execution_payload(spec, state)
+        yield from _run(spec, state, payload)
+        return
+    state.latest_execution_payload_header = \
+        spec.ExecutionPayloadHeader()
+    assert not spec.is_merge_transition_complete(state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.parent_hash = b"\x41" * 32
+    payload.block_hash = spec.hash(
+        bytes(spec.hash_tree_root(payload)) + b"FAKE RLP HASH")
+    yield from _run(spec, state, payload)
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+def test_success_regular_payload_with_gap_slot(spec, state):
+    from ...test_infra.blocks import transition_to
+    transition_to(spec, state, uint64(int(state.slot) + 3))
+    payload = build_empty_execution_payload(spec, state)
+    yield from _run(spec, state, payload)
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+def test_non_empty_extra_data(spec, state):
+    payload = build_empty_execution_payload(spec, state)
+    payload.extra_data = b"\x45" * 12
+    payload.block_hash = spec.hash(
+        bytes(spec.hash_tree_root(payload)) + b"FAKE RLP HASH")
+    yield from _run(spec, state, payload)
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+def test_non_empty_transactions(spec, state):
+    payload = build_empty_execution_payload(spec, state)
+    payload.transactions = [b"\x02" + b"\x99" * 30 for _ in range(3)]
+    payload.block_hash = spec.hash(
+        bytes(spec.hash_tree_root(payload)) + b"FAKE RLP HASH")
+    yield from _run(spec, state, payload)
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+def test_zero_length_transaction(spec, state):
+    payload = build_empty_execution_payload(spec, state)
+    payload.transactions = [b""]
+    payload.block_hash = spec.hash(
+        bytes(spec.hash_tree_root(payload)) + b"FAKE RLP HASH")
+    yield from _run(spec, state, payload)
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+def test_randomized_non_validated_execution_fields(spec, state):
+    """Consensus never inspects fee/gas/bloom contents — randomize
+    them all."""
+    import random as _r
+    rng = _r.Random(f"{spec.fork}:payload-fields")
+    payload = build_empty_execution_payload(spec, state)
+    payload.fee_recipient = bytes(rng.randrange(256) for _ in range(20))
+    payload.state_root = bytes(rng.randrange(256) for _ in range(32))
+    payload.receipts_root = bytes(rng.randrange(256) for _ in range(32))
+    payload.logs_bloom = bytes(
+        rng.randrange(256) for _ in range(int(spec.BYTES_PER_LOGS_BLOOM)))
+    payload.gas_limit = uint64(rng.randrange(1, 2**32))
+    payload.gas_used = uint64(rng.randrange(0, 2**32))
+    payload.base_fee_per_gas = rng.randrange(0, 2**64)
+    payload.block_hash = spec.hash(
+        bytes(spec.hash_tree_root(payload)) + b"FAKE RLP HASH")
+    yield from _run(spec, state, payload)
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+def test_invalid_future_timestamp(spec, state):
+    payload = build_empty_execution_payload(spec, state)
+    payload.timestamp = uint64(int(payload.timestamp) + 12)
+    payload.block_hash = spec.hash(
+        bytes(spec.hash_tree_root(payload)) + b"FAKE RLP HASH")
+    yield from _run(spec, state, payload, valid=False)
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+def test_invalid_past_timestamp(spec, state):
+    payload = build_empty_execution_payload(spec, state)
+    payload.timestamp = uint64(max(int(payload.timestamp) - 12, 0))
+    payload.block_hash = spec.hash(
+        bytes(spec.hash_tree_root(payload)) + b"FAKE RLP HASH")
+    yield from _run(spec, state, payload, valid=False)

@@ -59,3 +59,134 @@ def test_invalid_proposer_not_slashable(spec, state):
     state.validators[index].slashed = True
     yield from run_proposer_slashing_processing(
         spec, state, slashing, valid=False)
+
+
+from ...ssz import uint64  # noqa: E402
+from ...test_infra.keys import privkey_for_pubkey  # noqa: E402
+from ...test_infra.slashings import sign_block_header  # noqa: E402
+from ...test_infra.context import (  # noqa: E402
+    with_pytest_fork_subset)
+
+
+
+
+@with_all_phases
+@with_pytest_fork_subset(["phase0", "deneb", "electra"])
+@spec_state_test
+def test_slashed_and_proposer_index_the_same(spec, state):
+    """Slash the validator who is ALSO the next block proposer."""
+    proposer = int(spec.get_beacon_proposer_index(state))
+    slashing = get_valid_proposer_slashing(spec, state,
+                                           proposer_index=proposer)
+    yield from run_proposer_slashing_processing(spec, state, slashing)
+
+
+@with_all_phases
+@with_pytest_fork_subset(["phase0", "deneb", "electra"])
+@spec_state_test
+def test_block_header_from_future(spec, state):
+    """Headers at a future slot are still slashable evidence."""
+    slashing = get_valid_proposer_slashing(spec, state)
+    future = uint64(int(state.slot) + 5)
+    index = int(slashing.signed_header_1.message.proposer_index)
+    privkey = privkey_for_pubkey(state.validators[index].pubkey)
+    for which in ("signed_header_1", "signed_header_2"):
+        header = getattr(slashing, which).message
+        header.slot = future
+        setattr(slashing, which,
+                sign_block_header(spec, state, header, privkey))
+    yield from run_proposer_slashing_processing(spec, state, slashing)
+
+
+@with_all_phases
+@with_pytest_fork_subset(["phase0", "deneb", "electra"])
+@spec_state_test
+@always_bls
+def test_invalid_sig_2(spec, state):
+    slashing = get_valid_proposer_slashing(
+        spec, state, signed_1=True, signed_2=False)
+    yield from run_proposer_slashing_processing(
+        spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@with_pytest_fork_subset(["phase0", "deneb", "electra"])
+@spec_state_test
+@always_bls
+def test_invalid_sig_1_and_2_swap(spec, state):
+    """Swap the two (valid) signatures between the headers."""
+    slashing = get_valid_proposer_slashing(spec, state)
+    s1 = slashing.signed_header_1.signature
+    slashing.signed_header_1.signature = \
+        slashing.signed_header_2.signature
+    slashing.signed_header_2.signature = s1
+    yield from run_proposer_slashing_processing(
+        spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@with_pytest_fork_subset(["phase0", "deneb", "electra"])
+@spec_state_test
+def test_invalid_proposer_index_out_of_range(spec, state):
+    slashing = get_valid_proposer_slashing(spec, state)
+    high = len(state.validators)
+    for sh in (slashing.signed_header_1, slashing.signed_header_2):
+        sh.message.proposer_index = uint64(high)
+    yield from run_proposer_slashing_processing(
+        spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@with_pytest_fork_subset(["phase0", "deneb", "electra"])
+@spec_state_test
+def test_invalid_different_proposer_indices(spec, state):
+    slashing = get_valid_proposer_slashing(spec, state)
+    other = (int(slashing.signed_header_1.message.proposer_index) + 1) \
+        % len(state.validators)
+    header = slashing.signed_header_2.message
+    header.proposer_index = uint64(other)
+    slashing.signed_header_2 = sign_block_header(
+        spec, state, header,
+        privkey_for_pubkey(state.validators[other].pubkey))
+    yield from run_proposer_slashing_processing(
+        spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@with_pytest_fork_subset(["phase0", "deneb", "electra"])
+@spec_state_test
+def test_invalid_slots_of_different_epochs(spec, state):
+    slashing = get_valid_proposer_slashing(spec, state)
+    index = int(slashing.signed_header_1.message.proposer_index)
+    privkey = privkey_for_pubkey(state.validators[index].pubkey)
+    header = slashing.signed_header_2.message
+    header.slot = uint64(int(header.slot) + int(spec.SLOTS_PER_EPOCH))
+    slashing.signed_header_2 = sign_block_header(spec, state, header,
+                                                 privkey)
+    yield from run_proposer_slashing_processing(
+        spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@with_pytest_fork_subset(["phase0", "deneb", "electra"])
+@spec_state_test
+def test_invalid_proposer_is_not_activated(spec, state):
+    slashing = get_valid_proposer_slashing(spec, state)
+    index = int(slashing.signed_header_1.message.proposer_index)
+    state.validators[index].activation_epoch = uint64(
+        int(spec.get_current_epoch(state)) + 2)
+    yield from run_proposer_slashing_processing(
+        spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@with_pytest_fork_subset(["phase0", "deneb", "electra"])
+@spec_state_test
+def test_invalid_proposer_is_withdrawn(spec, state):
+    slashing = get_valid_proposer_slashing(spec, state)
+    index = int(slashing.signed_header_1.message.proposer_index)
+    cur = int(spec.get_current_epoch(state))
+    state.validators[index].exit_epoch = uint64(max(cur - 1, 0))
+    state.validators[index].withdrawable_epoch = uint64(cur)
+    yield from run_proposer_slashing_processing(
+        spec, state, slashing, valid=False)

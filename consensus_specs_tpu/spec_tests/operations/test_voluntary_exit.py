@@ -2,15 +2,18 @@
 from ...ssz import uint64
 from ...test_infra.context import (
     spec_state_test, with_all_phases, always_bls)
-from ...test_infra.blocks import transition_to
+
 from ...test_infra.slashings import get_valid_voluntary_exit
 
 
 def _mature_state(spec, state):
-    """Exit requires activation + SHARD_COMMITTEE_PERIOD epochs."""
+    """Exit requires activation + SHARD_COMMITTEE_PERIOD epochs; jump
+    the clock there directly (the reference assigns state.slot the
+    same way — processing ~520 empty slots adds nothing the exit path
+    reads)."""
     epochs = int(spec.config.SHARD_COMMITTEE_PERIOD) + 1
-    transition_to(spec, state,
-                  state.slot + epochs * spec.SLOTS_PER_EPOCH)
+    state.slot = uint64(int(state.slot)
+                        + epochs * int(spec.SLOTS_PER_EPOCH))
 
 
 def run_voluntary_exit_processing(spec, state, signed_exit, valid=True):
@@ -68,4 +71,109 @@ def test_invalid_exit_in_future(spec, state):
         spec, state, exit_msg,
         privkey_for_pubkey(state.validators[0].pubkey))
     yield from run_voluntary_exit_processing(spec, state, signed_exit,
+                                             valid=False)
+
+
+from ...test_infra.context import (  # noqa: E402
+    with_pytest_fork_subset)
+
+
+def _teleport_mature(spec, state):
+
+    """Jump the clock past the exit-eligibility gate (cheap: no slot
+    processing, like the reference's direct slot assignment)."""
+    state.slot = uint64(
+        (int(spec.config.SHARD_COMMITTEE_PERIOD) + 1)
+        * int(spec.SLOTS_PER_EPOCH))
+
+
+@with_all_phases
+@with_pytest_fork_subset(["phase0", "deneb", "electra"])
+@spec_state_test
+def test_success_exit_queue_min_churn(spec, state):
+    """Fill one churn-limit worth of exits; all land on the same exit
+    epoch."""
+    _teleport_mature(spec, state)
+    churn = int(spec.get_validator_churn_limit(state)) \
+        if not spec.is_post("electra") else 2
+    exits = [get_valid_voluntary_exit(spec, state, i)
+             for i in range(churn)]
+    yield "pre", state.copy()
+    for ve in exits:
+        spec.process_voluntary_exit(state, ve)
+    epochs = {int(state.validators[i].exit_epoch)
+              for i in range(churn)}
+    if not spec.is_post("electra"):
+        assert len(epochs) == 1
+    yield "voluntary_exit", exits[0]
+    yield "post", state
+
+
+@with_all_phases
+@with_pytest_fork_subset(["phase0", "deneb", "electra"])
+@spec_state_test
+def test_default_exit_epoch_subsequent_exit(spec, state):
+    """A second exit in the same epoch lands at (or after) the first's
+    exit epoch."""
+    _teleport_mature(spec, state)
+    first = get_valid_voluntary_exit(spec, state, 0)
+    second = get_valid_voluntary_exit(spec, state, 1)
+    yield "pre", state.copy()
+    spec.process_voluntary_exit(state, first)
+    spec.process_voluntary_exit(state, second)
+    assert int(state.validators[1].exit_epoch) >= \
+        int(state.validators[0].exit_epoch)
+    yield "voluntary_exit", second
+    yield "post", state
+
+
+@with_all_phases
+@with_pytest_fork_subset(["phase0", "deneb", "electra"])
+@spec_state_test
+def test_invalid_validator_exit_in_future(spec, state):
+    _teleport_mature(spec, state)
+    exit_msg = spec.VoluntaryExit(
+        epoch=uint64(int(spec.get_current_epoch(state)) + 1),
+        validator_index=uint64(0))
+    from ...test_infra.keys import privkey_for_pubkey
+    from ...test_infra.slashings import sign_voluntary_exit
+    signed = sign_voluntary_exit(
+        spec, state, exit_msg,
+        privkey_for_pubkey(state.validators[0].pubkey))
+    yield from run_voluntary_exit_processing(spec, state, signed,
+                                             valid=False)
+
+
+@with_all_phases
+@with_pytest_fork_subset(["phase0", "deneb", "electra"])
+@spec_state_test
+def test_invalid_incorrect_validator_index(spec, state):
+    _teleport_mature(spec, state)
+    signed = get_valid_voluntary_exit(spec, state, 0)
+    signed.message.validator_index = uint64(len(state.validators))
+    yield from run_voluntary_exit_processing(spec, state, signed,
+                                             valid=False)
+
+
+@with_all_phases
+@with_pytest_fork_subset(["phase0", "deneb", "electra"])
+@spec_state_test
+def test_invalid_validator_not_active(spec, state):
+    _teleport_mature(spec, state)
+    cur = int(spec.get_current_epoch(state))
+    state.validators[0].exit_epoch = uint64(max(cur - 1, 0))
+    signed = get_valid_voluntary_exit(spec, state, 0)
+    yield from run_voluntary_exit_processing(spec, state, signed,
+                                             valid=False)
+
+
+@with_all_phases
+@with_pytest_fork_subset(["phase0", "deneb", "electra"])
+@spec_state_test
+def test_invalid_validator_already_exited(spec, state):
+    _teleport_mature(spec, state)
+    state.validators[0].exit_epoch = uint64(
+        int(spec.get_current_epoch(state)) + 5)
+    signed = get_valid_voluntary_exit(spec, state, 0)
+    yield from run_voluntary_exit_processing(spec, state, signed,
                                              valid=False)

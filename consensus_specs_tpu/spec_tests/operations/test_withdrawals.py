@@ -114,3 +114,203 @@ def test_pending_partial_withdrawal(spec, state):
     assert len(payload.withdrawals) >= 1
     yield from run_withdrawals_processing(spec, state, payload)
     assert len(state.pending_partial_withdrawals) == 0
+
+
+from ...test_infra.withdrawals import (  # noqa: E402
+    set_eth1_withdrawal_credentials)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+def test_all_fully_withdrawable_in_sweep_window(spec, state):
+    """Every validator in the sweep window fully withdrawable: payload
+    carries the per-payload cap."""
+    bound = min(int(spec.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP),
+                len(state.validators),
+                int(spec.MAX_WITHDRAWALS_PER_PAYLOAD) + 4)
+    for i in range(bound):
+        prepare_fully_withdrawable_validator(spec, state, i)
+    payload = payload_with_expected_withdrawals(spec, state)
+    assert len(payload.withdrawals) == min(
+        bound, int(spec.MAX_WITHDRAWALS_PER_PAYLOAD))
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+def test_max_partial_withdrawals_in_one_payload(spec, state):
+    cap = int(spec.MAX_WITHDRAWALS_PER_PAYLOAD)
+    for i in range(cap + 2):
+        prepare_partially_withdrawable_validator(
+            spec, state, i % len(state.validators), excess=10**6)
+    payload = payload_with_expected_withdrawals(spec, state)
+    assert len(payload.withdrawals) == cap
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+def test_withdrawable_epoch_but_0_balance(spec, state):
+    """Fully withdrawable with zero balance: skipped by the sweep."""
+    prepare_fully_withdrawable_validator(spec, state, 0, balance=0)
+    payload = payload_with_expected_withdrawals(spec, state)
+    assert all(int(w.validator_index) != 0
+               for w in payload.withdrawals)
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+def test_withdrawable_epoch_but_0_effective_balance_not_0_balance(
+        spec, state):
+    """Zero EFFECTIVE balance with real balance: fully withdrawable
+    (the sweep keys on withdrawable_epoch + balance)."""
+    index = 0
+    prepare_fully_withdrawable_validator(spec, state, index)
+    state.validators[index].effective_balance = uint64(0)
+    payload = payload_with_expected_withdrawals(spec, state)
+    assert any(int(w.validator_index) == index
+               for w in payload.withdrawals)
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+def test_no_withdrawals_but_some_next_epoch(spec, state):
+    """Withdrawability starting next epoch: nothing withdrawable yet."""
+    index = 0
+    prepare_fully_withdrawable_validator(spec, state, index)
+    state.validators[index].withdrawable_epoch = uint64(
+        int(spec.get_current_epoch(state)) + 1)
+    payload = payload_with_expected_withdrawals(spec, state)
+    assert len(payload.withdrawals) == 0
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+def test_partially_withdrawable_exact_max_balance(spec, state):
+    """Balance exactly AT the max effective balance: NOT partially
+    withdrawable (strict inequality)."""
+    index = 0
+    set_eth1_withdrawal_credentials(spec, state, index)
+    state.validators[index].effective_balance = \
+        spec.MAX_EFFECTIVE_BALANCE
+    state.balances[index] = spec.MAX_EFFECTIVE_BALANCE
+    payload = payload_with_expected_withdrawals(spec, state)
+    assert all(int(w.validator_index) != index
+               for w in payload.withdrawals)
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+def test_bls_credentials_not_withdrawable(spec, state):
+    """0x00-credentialed validators never enter the sweep, however
+    ripe."""
+    index = 0
+    v = state.validators[index]
+    epoch = spec.get_current_epoch(state)
+    v.exit_epoch = uint64(max(int(epoch) - 1, 0))
+    v.withdrawable_epoch = epoch
+    assert bytes(v.withdrawal_credentials)[:1] == \
+        bytes(spec.BLS_WITHDRAWAL_PREFIX)
+    payload = payload_with_expected_withdrawals(spec, state)
+    assert all(int(w.validator_index) != index
+               for w in payload.withdrawals)
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+def test_invalid_withdrawal_index_gap(spec, state):
+    prepare_fully_withdrawable_validator(spec, state, 0)
+    payload = payload_with_expected_withdrawals(spec, state)
+    assert len(payload.withdrawals) > 0
+    payload.withdrawals[0].index = uint64(
+        int(payload.withdrawals[0].index) + 1)
+    yield from run_withdrawals_processing(spec, state, payload,
+                                          valid=False)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+def test_invalid_extra_withdrawal(spec, state):
+    prepare_fully_withdrawable_validator(spec, state, 0)
+    payload = payload_with_expected_withdrawals(spec, state)
+    extra = payload.withdrawals[0].copy()
+    extra.index = uint64(int(extra.index) + 1)
+    extra.validator_index = uint64(1)
+    payload.withdrawals = list(payload.withdrawals) + [extra]
+    yield from run_withdrawals_processing(spec, state, payload,
+                                          valid=False)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+def test_invalid_address_mismatch(spec, state):
+    prepare_fully_withdrawable_validator(spec, state, 0)
+    payload = payload_with_expected_withdrawals(spec, state)
+    assert len(payload.withdrawals) > 0
+    payload.withdrawals[0].address = b"\xde" * 20
+    yield from run_withdrawals_processing(spec, state, payload,
+                                          valid=False)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+def test_invalid_empty_when_expected(spec, state):
+    prepare_fully_withdrawable_validator(spec, state, 0)
+    payload = payload_with_expected_withdrawals(spec, state)
+    assert len(payload.withdrawals) > 0
+    payload.withdrawals = []
+    yield from run_withdrawals_processing(spec, state, payload,
+                                          valid=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_electra_pending_partial_before_sweep(spec, state):
+    """EIP-7251 pending partial withdrawals drain before the sweep and
+    consume the per-payload partial budget."""
+    from ...test_infra.withdrawals import (
+        set_compounding_withdrawal_credentials)
+    index = 0
+    set_compounding_withdrawal_credentials(spec, state, index)
+    state.validators[index].effective_balance = \
+        spec.MIN_ACTIVATION_BALANCE
+    state.balances[index] = uint64(
+        int(spec.MIN_ACTIVATION_BALANCE) + 3 * 10**9)
+    state.pending_partial_withdrawals.append(
+        spec.PendingPartialWithdrawal(
+            validator_index=uint64(index), amount=uint64(10**9),
+            withdrawable_epoch=spec.get_current_epoch(state)))
+    payload = payload_with_expected_withdrawals(spec, state)
+    assert any(int(w.validator_index) == index
+               for w in payload.withdrawals)
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_electra_pending_partial_not_ripe(spec, state):
+    """A pending partial whose withdrawable_epoch is in the future
+    stays queued."""
+    from ...test_infra.withdrawals import (
+        set_compounding_withdrawal_credentials)
+    index = 0
+    set_compounding_withdrawal_credentials(spec, state, index)
+    state.validators[index].effective_balance = \
+        spec.MIN_ACTIVATION_BALANCE
+    state.balances[index] = uint64(
+        int(spec.MIN_ACTIVATION_BALANCE) + 3 * 10**9)
+    state.pending_partial_withdrawals.append(
+        spec.PendingPartialWithdrawal(
+            validator_index=uint64(index), amount=uint64(10**9),
+            withdrawable_epoch=uint64(
+                int(spec.get_current_epoch(state)) + 4)))
+    payload = payload_with_expected_withdrawals(spec, state)
+    assert all(int(w.validator_index) != index
+               for w in payload.withdrawals)
+    yield from run_withdrawals_processing(spec, state, payload)
+    assert len(state.pending_partial_withdrawals) == 1

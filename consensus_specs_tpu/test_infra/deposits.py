@@ -110,8 +110,12 @@ def run_deposit_processing(spec, state, deposit, validator_index,
 
     if spec.is_post("electra"):
         # EIP-6110: the balance is queued as a PendingDeposit; a new valid
-        # pubkey still lands in the registry immediately (with 0 balance)
-        assert len(state.pending_deposits) == pre_pending + 1
+        # pubkey still lands in the registry immediately (with 0 balance).
+        # An invalid-signature NEW deposit queues nothing (effective=False).
+        assert len(state.pending_deposits) == \
+            pre_pending + (1 if effective else 0)
+        if not effective:
+            assert len(state.validators) == pre_validator_count
     elif not effective:
         assert len(state.validators) == pre_validator_count
     elif is_top_up:

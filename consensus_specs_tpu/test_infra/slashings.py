@@ -84,3 +84,37 @@ def get_valid_voluntary_exit(spec, state, validator_index, signed=True):
             state.validators[validator_index].pubkey)
         return sign_voluntary_exit(spec, state, voluntary_exit, privkey)
     return spec.SignedVoluntaryExit(message=voluntary_exit)
+
+
+def sign_indexed_attestation(spec, state, indexed) -> None:
+    """(Re)build the aggregate signature over indexed.attesting_indices
+    — used after index-set surgery in slashing edge tests."""
+    domain = spec.get_domain(state, spec.DOMAIN_BEACON_ATTESTER,
+                            indexed.data.target.epoch)
+    root = spec.compute_signing_root(indexed.data, domain)
+    sigs = [bls.Sign(privkey_for_pubkey(
+                state.validators[int(i)].pubkey), root)
+            for i in indexed.attesting_indices]
+    indexed.signature = bls.Aggregate(sigs) if sigs \
+        else spec.G2_POINT_AT_INFINITY
+
+
+def get_surround_attester_slashing(spec, state):
+    """att_1 surrounds att_2: source_1 < source_2 and
+    target_1 > target_2 (the second slashable relation)."""
+    att_1 = get_valid_attestation(spec, state, signed=False)
+    indexed_1 = spec.get_indexed_attestation(state, att_1)
+    indexed_2 = indexed_1.copy()
+    # craft epochs: source 0 / target T for att_1, source 1 /
+    # target T-1 for att_2 (both <= current epoch)
+    cur = int(spec.get_current_epoch(state))
+    assert cur >= 3, "surround slashing needs >= 3 epochs of history"
+    indexed_1.data.source.epoch = uint64(0)
+    indexed_1.data.target.epoch = uint64(cur)
+    indexed_2.data.source.epoch = uint64(1)
+    indexed_2.data.target.epoch = uint64(cur - 1)
+    indexed_2.data.beacon_block_root = b"\x01" * 32
+    sign_indexed_attestation(spec, state, indexed_1)
+    sign_indexed_attestation(spec, state, indexed_2)
+    return spec.AttesterSlashing(attestation_1=indexed_1,
+                                 attestation_2=indexed_2)
