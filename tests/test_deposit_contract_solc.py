@@ -1,0 +1,62 @@
+"""Real-solc deposit contract compile (docker/compile_deposit_contract.py):
+runs wherever a solc toolchain exists (the docker image; skipped in the
+zero-egress sandbox, where the differential Python model keeps
+behavioral coverage — test_deposit_contract.py)."""
+import json
+import os
+import shutil
+import subprocess
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "..", "deposit_contract",
+                   "deposit_contract.sol")
+BUILD = os.path.join(HERE, "..", "deposit_contract", "build")
+
+
+def _have_solc() -> bool:
+    if shutil.which("solc"):
+        return True
+    try:
+        import solcx  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+@pytest.mark.skipif(not _have_solc(),
+                    reason="no solc toolchain in this environment "
+                           "(compiled in the docker image instead)")
+def test_deposit_contract_compiles_with_real_solc(tmp_path):
+    if shutil.which("solc"):
+        out = subprocess.run(
+            ["solc", "--bin-runtime", "--abi", SRC, "-o", str(tmp_path),
+             "--overwrite"], capture_output=True, text=True)
+        assert out.returncode == 0, out.stderr
+        produced = list(tmp_path.iterdir())
+        assert any(p.suffix == ".abi" for p in produced)
+    else:
+        import solcx
+        solcx.install_solc("0.8.24")
+        compiled = solcx.compile_files(
+            [SRC], output_values=["abi", "bin-runtime"],
+            solc_version="0.8.24")
+        assert compiled
+
+
+def test_prebuilt_artifacts_wellformed_if_present():
+    """When the docker build shipped artifacts, they must parse."""
+    if not os.path.isdir(BUILD):
+        pytest.skip("no prebuilt artifacts (sandbox build)")
+    for name in os.listdir(BUILD):
+        path = os.path.join(BUILD, name)
+        if name.endswith(".abi.json"):
+            with open(path) as f:
+                abi = json.load(f)
+            assert any(e.get("type") == "event" for e in abi)
+        elif name.endswith(".bin-runtime"):
+            with open(path) as f:
+                data = f.read().strip()
+            assert data and len(data) % 2 == 0
+            bytes.fromhex(data)
