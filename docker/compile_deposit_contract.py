@@ -23,15 +23,19 @@ def main() -> int:
         [SRC], output_values=["abi", "bin-runtime"],
         solc_version=SOLC_VERSION, optimize=True)
     os.makedirs(OUT, exist_ok=True)
+    wrote = 0
     for name, artifact in compiled.items():
         base = name.split(":")[-1]
+        if not artifact["bin-runtime"]:
+            continue        # interfaces (IERC165 etc.) have no bytecode
         with open(os.path.join(OUT, f"{base}.abi.json"), "w") as f:
             json.dump(artifact["abi"], f, indent=1)
         with open(os.path.join(OUT, f"{base}.bin-runtime"), "w") as f:
             f.write(artifact["bin-runtime"])
-        assert artifact["bin-runtime"], "empty runtime bytecode"
+        wrote += 1
         print(f"compiled {base}: {len(artifact['bin-runtime']) // 2} "
               f"bytes runtime, {len(artifact['abi'])} ABI entries")
+    assert wrote, "no deployable compilation unit produced bytecode"
     return 0
 
 

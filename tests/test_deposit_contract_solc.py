@@ -15,21 +15,43 @@ SRC = os.path.join(HERE, "..", "deposit_contract",
 BUILD = os.path.join(HERE, "..", "deposit_contract", "build")
 
 
-def _have_solc() -> bool:
-    if shutil.which("solc"):
-        return True
-    try:
-        import solcx  # noqa: F401
-        return True
-    except ImportError:
+SOLC_MIN = (0, 8, 20)     # the contract's pragma floor
+
+
+def _binary_solc_usable() -> bool:
+    solc = shutil.which("solc")
+    if not solc:
         return False
+    try:
+        out = subprocess.run([solc, "--version"], capture_output=True,
+                             text=True, timeout=30).stdout
+        import re
+        m = re.search(r"(\d+)\.(\d+)\.(\d+)", out)
+        return bool(m) and tuple(int(x) for x in m.groups()) >= SOLC_MIN
+    except Exception:
+        return False
+
+
+def _solcx_usable() -> bool:
+    """py-solc-x counts only with a compiler already installed (a bare
+    import would try to DOWNLOAD one — unavailable in the zero-egress
+    sandbox this test must skip in)."""
+    try:
+        import solcx
+        return bool(solcx.get_installed_solc_versions())
+    except Exception:
+        return False
+
+
+def _have_solc() -> bool:
+    return _binary_solc_usable() or _solcx_usable()
 
 
 @pytest.mark.skipif(not _have_solc(),
                     reason="no solc toolchain in this environment "
                            "(compiled in the docker image instead)")
 def test_deposit_contract_compiles_with_real_solc(tmp_path):
-    if shutil.which("solc"):
+    if _binary_solc_usable():
         out = subprocess.run(
             ["solc", "--bin-runtime", "--abi", SRC, "-o", str(tmp_path),
              "--overwrite"], capture_output=True, text=True)
@@ -37,12 +59,15 @@ def test_deposit_contract_compiles_with_real_solc(tmp_path):
         produced = list(tmp_path.iterdir())
         assert any(p.suffix == ".abi" for p in produced)
     else:
-        import solcx
-        solcx.install_solc("0.8.24")
-        compiled = solcx.compile_files(
-            [SRC], output_values=["abi", "bin-runtime"],
-            solc_version="0.8.24")
-        assert compiled
+        # one compile path: run the docker script itself
+        import importlib.util
+        spec_ = importlib.util.spec_from_file_location(
+            "compile_deposit_contract",
+            os.path.join(HERE, "..", "docker",
+                         "compile_deposit_contract.py"))
+        mod = importlib.util.module_from_spec(spec_)
+        spec_.loader.exec_module(mod)
+        assert mod.main() == 0
 
 
 def test_prebuilt_artifacts_wellformed_if_present():
@@ -51,10 +76,13 @@ def test_prebuilt_artifacts_wellformed_if_present():
         pytest.skip("no prebuilt artifacts (sandbox build)")
     for name in os.listdir(BUILD):
         path = os.path.join(BUILD, name)
-        if name.endswith(".abi.json"):
+        if name == "DepositContract.abi.json":
             with open(path) as f:
                 abi = json.load(f)
             assert any(e.get("type") == "event" for e in abi)
+        elif name.endswith(".abi.json"):
+            with open(path) as f:
+                json.load(f)          # interfaces: well-formed is enough
         elif name.endswith(".bin-runtime"):
             with open(path) as f:
                 data = f.read().strip()
