@@ -265,3 +265,112 @@ def test_sync_committee_with_nonparticipating_exited_member(spec, state):
     block = _block_with_aggregate(
         spec, state, participation_fn=lambda p: p not in skip)
     yield from run_sync_committee_processing(spec, state, block)
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(SYNC_FORKS)
+@spec_state_test
+@always_bls
+def test_sync_committee_quarter_participating(spec, state):
+    block = _block_with_aggregate(
+        spec, state, participation_fn=lambda i: i % 4 == 0)
+    yield from run_sync_committee_processing(spec, state, block)
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(SYNC_FORKS)
+@spec_state_test
+@always_bls
+def test_sync_committee_one_participant(spec, state):
+    block = _block_with_aggregate(
+        spec, state, participation_fn=lambda i: i == 0)
+    yield from run_sync_committee_processing(spec, state, block)
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(SYNC_FORKS)
+@spec_state_test
+@always_bls
+def test_sync_committee_rewards_duplicate_committee_members(spec,
+                                                           state):
+    """Small registries may repeat members across the 32 seats; each
+    SEAT earns independently (exact per-seat accounting holds either
+    way)."""
+    block = _block_with_aggregate(spec, state)
+    pre = list(state.balances)
+    yield from run_sync_committee_processing(spec, state, block)
+    assert sum(state.balances) > sum(pre)
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(SYNC_FORKS)
+@spec_state_test
+@always_bls
+def test_sync_committee_nonparticipants_penalized(spec, state):
+    """Non-participating seats take the mirrored penalty."""
+    from ...test_infra.keys import privkey_for_pubkey
+    keep = set(range(0, int(spec.SYNC_COMMITTEE_SIZE), 2))
+    participants = {
+        bytes(pk) for i, pk in
+        enumerate(state.current_sync_committee.pubkeys) if i in keep}
+    block = _block_with_aggregate(
+        spec, state, participation_fn=lambda i: i in keep)
+    # a validator whose EVERY seat is non-participating must lose
+    all_seats = {}
+    for i, pk in enumerate(state.current_sync_committee.pubkeys):
+        all_seats.setdefault(bytes(pk), []).append(i in keep)
+    never = [pk for pk, seats in all_seats.items()
+             if not any(seats)]
+    pre = {bytes(v.pubkey): int(state.balances[j])
+           for j, v in enumerate(state.validators)}
+    yield from run_sync_committee_processing(spec, state, block)
+    post = {bytes(v.pubkey): int(state.balances[j])
+            for j, v in enumerate(state.validators)}
+    proposer = bytes(
+        state.validators[
+            int(spec.get_beacon_proposer_index(state))].pubkey)
+    for pk in never:
+        if pk != proposer:
+            assert post[pk] < pre[pk]
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(SYNC_FORKS)
+@spec_state_test
+@always_bls
+def test_invalid_signature_no_participants_nonzero_sig(spec, state):
+    """Zero bits with a random (non-infinity) signature must fail."""
+    block = build_empty_block_for_next_slot(spec, state)
+    transition_to(spec, state, block.slot)
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[False] * int(spec.SYNC_COMMITTEE_SIZE),
+        sync_committee_signature=b"\x11" + b"\x22" * 95)
+    yield from run_sync_committee_processing(spec, state, block,
+                                             valid=False)
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(SYNC_FORKS)
+@spec_state_test
+@always_bls
+def test_invalid_signature_previous_committee(spec, state):
+    """A signature by the NEXT committee over the current message
+    fails (wrong key set)."""
+    from ...test_infra.keys import privkey_for_pubkey
+    from ...utils import bls as _bls
+    block = build_empty_block_for_next_slot(spec, state)
+    transition_to(spec, state, block.slot)
+    aggregate = get_sync_aggregate(spec, state)
+    # re-sign with the NEXT committee's keys instead
+    from ...test_infra.sync_committee import (
+        compute_sync_committee_signing_root)
+    root = compute_sync_committee_signing_root(spec, state)
+    sigs = [_bls.Sign(privkey_for_pubkey(pk), root)
+            for pk in state.next_sync_committee.pubkeys]
+    if list(state.next_sync_committee.pubkeys) == \
+            list(state.current_sync_committee.pubkeys):
+        return   # identical committees on this preset: nothing to test
+    aggregate.sync_committee_signature = _bls.Aggregate(sigs)
+    block.body.sync_aggregate = aggregate
+    yield from run_sync_committee_processing(spec, state, block,
+                                             valid=False)
