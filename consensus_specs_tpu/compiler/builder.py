@@ -66,6 +66,27 @@ _SAFE_EXPR_NODES = (
 
 _MAX_CONST_BITS = 1 << 16
 
+# The only names a constant cell may CALL: the runtime casts/type
+# constructors the generated module's header imports.  Cells calling
+# anything else — ``eval``, ``pow``, ``__import__`` chains — are PUBLIC
+# markdown trying to execute code at module-exec time and fail the gate.
+# Spec-defined custom types (Slot, Epoch, Gwei, …) extend this set per
+# build via the ``extra_callees`` argument.
+_RUNTIME_CALLEES = frozenset({
+    "boolean", "uint", "uint8", "uint16", "uint32", "uint64", "uint128",
+    "uint256", "Bytes1", "Bytes4", "Bytes8", "Bytes20", "Bytes31",
+    "Bytes32", "Bytes48", "Bytes96", "ByteList", "ByteVector",
+})
+
+# result-magnitude bound by callee semantics: a cast cannot produce a
+# value wider than the target type, whatever its argument was
+_CALLEE_BITS = {
+    "boolean": 1, "uint8": 8, "uint16": 16, "uint32": 32, "uint64": 64,
+    "uint128": 128, "uint256": 256,
+    "Bytes1": 8, "Bytes4": 32, "Bytes8": 64, "Bytes20": 160,
+    "Bytes31": 248, "Bytes32": 256, "Bytes48": 384, "Bytes96": 768,
+}
+
 
 def _bit_bound(node) -> int:
     """Abstract upper bound on the bit-length a cell expression can
@@ -84,7 +105,22 @@ def _bit_bound(node) -> int:
     if isinstance(node, ast.Name):
         return 256
     if isinstance(node, ast.Call):
-        return max([_bit_bound(a) for a in node.args] + [256])
+        # Python evaluates every argument (positional AND keyword)
+        # before the callee runs, so the evaluation COST must stay
+        # under the cap regardless of the callee's result width — a
+        # cast truncates its result, it does not shrink the 17 GB
+        # integer the interpreter built to pass in
+        arg_bits = [_bit_bound(a) for a in node.args]
+        arg_bits += [_bit_bound(kw.value) for kw in node.keywords]
+        if max(arg_bits, default=0) > _MAX_CONST_BITS:
+            raise ValueError("call argument magnitude exceeds cap")
+        callee = node.func.id if isinstance(node.func, ast.Name) else ""
+        if callee in _CALLEE_BITS:
+            return _CALLEE_BITS[callee]
+        return max(arg_bits + [256])
+    if isinstance(node, ast.Subscript):
+        # type expressions: List[X, N * M] — bound the index cost
+        return max(_bit_bound(node.value), _bit_bound(node.slice))
     if isinstance(node, (ast.Tuple, ast.List)):
         return max([_bit_bound(e) for e in node.elts] + [1])
     if isinstance(node, ast.UnaryOp):
@@ -109,14 +145,18 @@ def _bit_bound(node) -> int:
     raise ValueError(f"unbounded node {type(node).__name__}")
 
 
-def _check_safe_expr(expr: str) -> None:
+def _check_safe_expr(expr: str,
+                     extra_callees: frozenset = frozenset()) -> None:
     """Gate for table cells emitted verbatim into the generated module
     (which is exec'd): only name/call/arithmetic expressions, no
     attribute access, subscripts, lambdas, comprehensions, or dunder
-    names, and a composed magnitude bound (:func:`_bit_bound`).  Spec
-    cells are name references and casts like ``uint64(2**3)`` or
-    ``Bytes4('0x01000000')`` — anything outside that grammar is PUBLIC
-    markdown trying to be code, so fail loud."""
+    names, and a composed magnitude bound (:func:`_bit_bound`).  Calls
+    are restricted to the runtime cast whitelist (plus the build's
+    spec-defined custom types): spec cells are name references and casts
+    like ``uint64(2**3)`` or ``Bytes4('0x01000000')`` — a call to any
+    other name (``eval``, ``pow``, …) is PUBLIC markdown trying to be
+    code, so fail loud."""
+    allowed_callees = _RUNTIME_CALLEES | extra_callees
     tree = ast.parse(expr, mode="eval")
     for node in ast.walk(tree):
         if not isinstance(node, _SAFE_EXPR_NODES):
@@ -126,6 +166,14 @@ def _check_safe_expr(expr: str) -> None:
         if isinstance(node, ast.Name) and node.id.startswith("_"):
             raise ValueError(
                 f"constant cell {expr!r}: underscore name {node.id!r}")
+        if isinstance(node, ast.Call):
+            if not isinstance(node.func, ast.Name) \
+                    or node.func.id not in allowed_callees:
+                callee = (node.func.id if isinstance(node.func, ast.Name)
+                          else type(node.func).__name__)
+                raise ValueError(
+                    f"constant cell {expr!r}: call to non-whitelisted "
+                    f"callee {callee!r}")
     try:
         bits = _bit_bound(tree)
     except ValueError as exc:
@@ -136,7 +184,43 @@ def _check_safe_expr(expr: str) -> None:
             f"exceeds {_MAX_CONST_BITS}")
 
 
-def _const_rhs(expr: str) -> str:
+# custom-type cells are TYPE expressions: names and subscripted names
+# with arithmetic index math (`ByteVector[A * B]`, `List[X, N]`) — no
+# calls at all, unlike constant cells
+_SAFE_TYPE_NODES = (
+    ast.Expression, ast.Constant, ast.Name, ast.Load, ast.Subscript,
+    ast.Tuple, ast.BinOp, ast.Add, ast.Sub, ast.Mult, ast.Pow,
+    ast.FloorDiv, ast.LShift,
+)
+
+
+def _check_safe_type_expr(expr: str) -> None:
+    """Gate for custom-type table cells ('SSZ equivalent' column),
+    which emit verbatim into the exec'd module exactly like constant
+    cells do: same untrusted-markdown channel, same treatment.  Type
+    grammar only — any Call, attribute access, or unbounded index
+    arithmetic fails loud."""
+    tree = ast.parse(expr, mode="eval")
+    for node in ast.walk(tree):
+        if not isinstance(node, _SAFE_TYPE_NODES):
+            raise ValueError(
+                f"custom-type cell {expr!r}: disallowed syntax "
+                f"({type(node).__name__})")
+        if isinstance(node, ast.Name) and node.id.startswith("_"):
+            raise ValueError(
+                f"custom-type cell {expr!r}: underscore name {node.id!r}")
+    try:
+        bits = _bit_bound(tree)
+    except ValueError as exc:
+        raise ValueError(f"custom-type cell {expr!r}: {exc}")
+    if bits > _MAX_CONST_BITS:
+        raise ValueError(
+            f"custom-type cell {expr!r}: magnitude bound {bits} bits "
+            f"exceeds {_MAX_CONST_BITS}")
+
+
+def _const_rhs(expr: str,
+               extra_callees: frozenset = frozenset()) -> str:
     """Right-hand side for a constant: simple literals collapse to their
     value; anything referencing other names (uint64(...), 10 * BASE) is
     emitted after passing the :func:`_check_safe_expr` whitelist and
@@ -144,7 +228,7 @@ def _const_rhs(expr: str) -> str:
     types and earlier constants are in scope."""
     value = parse_value(expr)
     if isinstance(value, str) and value == expr.strip().strip("`"):
-        _check_safe_expr(value)
+        _check_safe_expr(value, extra_callees)
         return value        # unresolvable here: defer to module namespace
     return repr(value)
 
@@ -232,12 +316,17 @@ def emit_source(spec: ParsedSpec, preset: dict | None = None,
     # FIELD_ELEMENTS_PER_BLOB]) — emit them in one dependency-ordered
     # fixpoint, like the class ordering below
     preset = dict(preset or {})
+    # spec-defined custom types (Slot, Epoch, Gwei, DomainType, …) are
+    # legitimate cast targets in constant cells; prelude-defined names
+    # are trusted repo code (fork builders), not markdown
+    cell_callees = frozenset(spec.custom_types) | frozenset(prelude_names)
     scalars: dict[str, str] = {}
     for name, expr in spec.preset_vars.items():
         if name not in prelude_names:
             scalars[name] = (repr(preset[name]) if name in preset
-                             else _const_rhs(expr))
+                             else _const_rhs(expr, cell_callees))
     for name, type_expr in spec.custom_types.items():
+        _check_safe_type_expr(type_expr)
         scalars[name] = type_expr
     for name, expr in spec.constants.items():
         if name in prelude_names:
@@ -246,7 +335,7 @@ def emit_source(spec: ParsedSpec, preset: dict | None = None,
             # draft placeholder (e.g. whisk's CURDLEPROOFS_CRS) — a
             # definition must come from extra_scalars or the prelude
             continue
-        scalars[name] = _const_rhs(expr)
+        scalars[name] = _const_rhs(expr, cell_callees)
     for name, rhs in (extra_scalars or {}).items():
         scalars.setdefault(name, rhs)
 
@@ -311,6 +400,53 @@ def emit_source(spec: ParsedSpec, preset: dict | None = None,
     return "\n\n\n".join(parts) + "\n"
 
 
+# import roots a generated module may touch: its header + fork preludes
+# import only the runtime package, dataclasses and typing
+_ALLOWED_IMPORT_ROOTS = ("consensus_specs_tpu", "dataclasses", "typing")
+
+
+def _guarded_import(name, globals=None, locals=None, fromlist=(), level=0):
+    if level == 0 and name.split(".")[0] not in _ALLOWED_IMPORT_ROOTS:
+        raise ImportError(
+            f"generated spec module may not import {name!r}")
+    return __import__(name, globals, locals, fromlist, level)
+
+
+# builtins reachable from a generated module.  Everything spec markdown
+# legitimately uses (casts, container ops, arithmetic, exceptions, the
+# class machinery) minus the escape hatches: no eval/exec/compile, no
+# open/input/breakpoint, no vars/globals/locals/delattr/setattr, and
+# __import__ is root-whitelisted.  This is the exec-side half of the
+# constant-cell gate: even an expression that slipped the static check
+# finds no dangerous callable at module-exec time.
+_SAFE_BUILTIN_NAMES = (
+    "abs", "all", "any", "bin", "bool", "bytearray", "bytes", "callable",
+    "chr", "classmethod", "dict", "divmod", "enumerate", "filter",
+    "float", "format", "frozenset", "getattr", "hasattr", "hash", "hex",
+    "id", "int", "isinstance", "issubclass", "iter", "len", "list",
+    "map", "max", "min", "next", "object", "oct", "ord", "pow", "print",
+    "property", "range", "repr", "reversed", "round", "set", "slice",
+    "sorted", "staticmethod", "str", "sum", "super", "tuple", "type",
+    "zip",
+    "ArithmeticError", "AssertionError", "AttributeError",
+    "BaseException", "Exception", "IndexError", "KeyError", "KeyboardInterrupt",
+    "NotImplementedError", "OverflowError", "RecursionError",
+    "RuntimeError", "StopIteration", "TypeError", "ValueError",
+    "ZeroDivisionError", "NotImplemented", "Ellipsis",
+    "True", "False", "None",
+)
+
+
+def _restricted_builtins() -> dict:
+    import builtins as _b
+    safe = {n: getattr(_b, n) for n in _SAFE_BUILTIN_NAMES
+            if hasattr(_b, n)}
+    safe["__import__"] = _guarded_import
+    safe["__build_class__"] = _b.__build_class__
+    safe["__name__"] = "builtins"
+    return safe
+
+
 def build_spec(doc_texts: list, preset: dict | None = None,
                config: dict | None = None,
                module_name: str = "generated_spec",
@@ -330,7 +466,10 @@ def build_spec(doc_texts: list, preset: dict | None = None,
     module = types.ModuleType(module_name)
     # dont_inherit: this builder's __future__ flags (stringified
     # annotations) must not leak into the generated module — SSZ field
-    # annotations have to stay live class objects
+    # annotations have to stay live class objects.  Restricted builtins:
+    # markdown-derived code execs without eval/exec/open/__import__
+    # escape hatches (see _restricted_builtins)
+    module.__dict__["__builtins__"] = _restricted_builtins()
     exec(compile(source, f"<{module_name}>", "exec", dont_inherit=True),
          module.__dict__)
     return module, source

@@ -29,7 +29,7 @@ import yaml
 
 from . import snappy
 from .typing import TestCase, TestProvider
-from .vector_test import run_yields
+from .vector_test import SkippedTest, run_yields
 
 INCOMPLETE_TAG = "INCOMPLETE"
 SLOW_CASE_SECONDS = 1.0
@@ -145,6 +145,12 @@ def run_generator(runner_name: str, providers, args=None) -> dict:
                 shutil.rmtree(case_dir)  # incomplete or forced: regenerate
             try:
                 result = _write_case(case, case_dir)
+            except SkippedTest:
+                # inapplicable under this (fork, preset): no case dir,
+                # no error-log entry — mirror the reference's skip path
+                shutil.rmtree(case_dir, ignore_errors=True)
+                diagnostics["skipped"] += 1
+                continue
             except Exception:
                 diagnostics["failed"] += 1
                 with open(error_log, "a") as f:

@@ -1,9 +1,10 @@
 """process_sync_aggregate operation tests (altair+; reference:
 test/altair/block_processing/sync_aggregate/*; vector format
 tests/formats/operations)."""
+from ...gen.vector_test import SkippedTest
 from ...test_infra.context import (
-    spec_state_test, with_all_phases_from, with_pytest_fork_subset,
-    always_bls)
+    spec_state_test, with_all_phases_from, with_presets,
+    with_pytest_fork_subset, always_bls)
 
 # real-signature suite: the default PYTEST run covers two
 # representative forks (32 committee signatures per target); the
@@ -349,27 +350,76 @@ def test_invalid_signature_no_participants_nonzero_sig(spec, state):
                                              valid=False)
 
 
+def _advance_periods(spec, state, n: int) -> None:
+    """process_slots to the first slot of the sync-committee period `n`
+    periods ahead.  At genesis current == next (both derived from epoch
+    0), so distinguishing committees requires crossing a boundary."""
+    from ...ssz import uint64
+    epochs_per_period = int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)
+    cur_epoch = int(spec.get_current_epoch(state))
+    target_epoch = (cur_epoch // epochs_per_period + n) * epochs_per_period
+    transition_to(spec, state,
+                  uint64(target_epoch * int(spec.SLOTS_PER_EPOCH)))
+
+
 @with_all_phases_from("altair")
 @with_pytest_fork_subset(SYNC_FORKS)
+@with_presets(["minimal"], reason="period fast-forward too slow on mainnet")
 @spec_state_test
 @always_bls
-def test_invalid_signature_previous_committee(spec, state):
+def test_invalid_signature_next_committee(spec, state):
     """A signature by the NEXT committee over the current message
-    fails (wrong key set)."""
+    fails (wrong key set).  One period past genesis so next != current
+    (at genesis both committees are computed from epoch 0)."""
     from ...test_infra.keys import privkey_for_pubkey
+    from ...test_infra.sync_committee import (
+        compute_sync_committee_signing_root)
     from ...utils import bls as _bls
+    _advance_periods(spec, state, 1)
+    if list(state.next_sync_committee.pubkeys) == \
+            list(state.current_sync_committee.pubkeys):
+        raise SkippedTest(
+            "current and next sync committees identical on this preset")
     block = build_empty_block_for_next_slot(spec, state)
     transition_to(spec, state, block.slot)
     aggregate = get_sync_aggregate(spec, state)
     # re-sign with the NEXT committee's keys instead
-    from ...test_infra.sync_committee import (
-        compute_sync_committee_signing_root)
     root = compute_sync_committee_signing_root(spec, state)
     sigs = [_bls.Sign(privkey_for_pubkey(pk), root)
             for pk in state.next_sync_committee.pubkeys]
-    if list(state.next_sync_committee.pubkeys) == \
-            list(state.current_sync_committee.pubkeys):
-        return   # identical committees on this preset: nothing to test
+    aggregate.sync_committee_signature = _bls.Aggregate(sigs)
+    block.body.sync_aggregate = aggregate
+    yield from run_sync_committee_processing(spec, state, block,
+                                             valid=False)
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(SYNC_FORKS)
+@with_presets(["minimal"], reason="period fast-forward too slow on mainnet")
+@spec_state_test
+@always_bls
+def test_invalid_signature_previous_committee(spec, state):
+    """A committee that has rotated out (now 'previous') signs a block
+    two periods later: wrong key set, must fail.  Two boundaries are
+    needed because the genesis committee serves the first TWO periods
+    (current == next at genesis).  Reference namesake:
+    test/altair/block_processing/sync_aggregate/
+    test_process_sync_aggregate.py (period-boundary variant)."""
+    from ...test_infra.keys import privkey_for_pubkey
+    from ...test_infra.sync_committee import (
+        compute_sync_committee_signing_root)
+    from ...utils import bls as _bls
+    _advance_periods(spec, state, 1)
+    old_committee = list(state.current_sync_committee.pubkeys)
+    _advance_periods(spec, state, 1)
+    if old_committee == list(state.current_sync_committee.pubkeys):
+        raise SkippedTest("committee did not rotate on this preset")
+    block = build_empty_block_for_next_slot(spec, state)
+    transition_to(spec, state, block.slot)
+    aggregate = get_sync_aggregate(spec, state)
+    root = compute_sync_committee_signing_root(spec, state)
+    sigs = [_bls.Sign(privkey_for_pubkey(pk), root)
+            for pk in old_committee]
     aggregate.sync_committee_signature = _bls.Aggregate(sigs)
     block.body.sync_aggregate = aggregate
     yield from run_sync_committee_processing(spec, state, block,

@@ -268,14 +268,19 @@ def _run_single(fn, meta, spec, needs_state, collect):
 def _make_runner(fn, needs_state: bool):
     @functools.wraps(fn)
     def runner():
+        from ..gen.vector_test import SkippedTest
         meta = _meta(runner)
         ran = 0
         # pytest-only narrowing; make_vector_cases ignores this so the
         # generator keeps full fork coverage
         for _fork, _preset, spec in _selected_targets(
                 meta, forks=meta.get("pytest_forks")):
-            with _bls_mode(meta, generator_mode=False):
-                _run_single(fn, meta, spec, needs_state, collect=False)
+            try:
+                with _bls_mode(meta, generator_mode=False):
+                    _run_single(fn, meta, spec, needs_state,
+                                collect=False)
+            except SkippedTest:
+                continue  # inapplicable for this target only
             ran += 1
         if ran == 0:
             import pytest

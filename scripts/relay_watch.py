@@ -1,0 +1,137 @@
+"""Relay watcher: bank a TPU bench number the moment the device relay
+comes up.
+
+Rounds 1-4 ended with ``BENCH_r0N.json: device_unreachable`` — the axon
+relay never admitted a backend during the driver's ~540 s window.  This
+watcher runs from round start instead: it probes the accelerator on a
+fixed cadence, appends a timestamped outcome line to ``RELAY_LOG`` for
+every probe (so a fully-wedged relay leaves an auditable trail), and the
+moment a probe succeeds it immediately runs the bench tiers most worth
+banking (``merkle`` banks in ~2 min, then the north-star crypto tier),
+recording each tier's JSON line + wall time back into ``RELAY_LOG`` and
+into ``BENCH_WATCH.json``.
+
+Provenance: every line carries a UTC timestamp and the probe/bench
+subprocess return code, so a mid-round 10-minute relay window converts
+into a banked, timestamped builder-measured number even if the relay is
+wedged again by the time the driver runs ``bench.py``.
+
+Usage: ``python scripts/relay_watch.py`` (run detached, e.g. in tmux).
+Environment: ``RELAY_PROBE_INTERVAL_S`` (default 60), ``RELAY_LOG``
+(default ``RELAY_LOG`` at repo root).
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from datetime import datetime, timezone
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+LOG_PATH = os.environ.get("RELAY_LOG", os.path.join(REPO, "RELAY_LOG"))
+BANK_PATH = os.path.join(REPO, "BENCH_WATCH.json")
+INTERVAL = float(os.environ.get("RELAY_PROBE_INTERVAL_S", "60"))
+PROBE_TIMEOUT = float(os.environ.get("RELAY_PROBE_TIMEOUT_S", "90"))
+
+# tiers in banking order: merkle lands a number fast; north_star is the
+# headline crypto tier; the rest only if the relay window stays open
+TIER_BUDGETS = [("merkle", 200), ("north_star", 600),
+                ("attestations", 480), ("kzg", 360), ("epoch", 360)]
+
+
+def _now() -> str:
+    return datetime.now(timezone.utc).isoformat(timespec="seconds")
+
+
+def _log(entry: dict) -> None:
+    entry = {"ts": _now(), **entry}
+    with open(LOG_PATH, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+    print(entry, flush=True)
+
+
+def probe() -> tuple[bool, float, int | None]:
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            [sys.executable, BENCH, "__probe__"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            timeout=PROBE_TIMEOUT)
+        return proc.returncode == 0, time.monotonic() - t0, proc.returncode
+    except subprocess.TimeoutExpired:
+        return False, time.monotonic() - t0, None
+
+
+def run_tier(name: str, budget_s: float) -> dict | None:
+    """Run one bench tier in a subprocess; return its JSON line or None."""
+    t0 = time.monotonic()
+    env = dict(os.environ, BENCH_BUDGET_S=str(budget_s))
+    try:
+        proc = subprocess.run(
+            [sys.executable, BENCH, name], capture_output=True, text=True,
+            timeout=budget_s + 120, env=env)
+    except subprocess.TimeoutExpired:
+        _log({"event": "tier_timeout", "tier": name,
+              "elapsed_s": round(time.monotonic() - t0, 1)})
+        return None
+    elapsed = round(time.monotonic() - t0, 1)
+    line = None
+    for out_line in (proc.stdout or "").splitlines():
+        out_line = out_line.strip()
+        if out_line.startswith("{") and '"metric"' in out_line:
+            try:
+                line = json.loads(out_line)
+            except json.JSONDecodeError:
+                continue
+    _log({"event": "tier_done", "tier": name, "rc": proc.returncode,
+          "elapsed_s": elapsed, "result": line,
+          "stderr_tail": (proc.stderr or "")[-400:] if proc.returncode else ""})
+    return line if proc.returncode == 0 else None
+
+
+def main() -> None:
+    _log({"event": "watch_start", "interval_s": INTERVAL,
+          "pid": os.getpid()})
+    banked: dict[str, dict] = {}
+    if os.path.exists(BANK_PATH):
+        try:
+            with open(BANK_PATH) as f:
+                banked = json.load(f).get("tiers", {})
+        except (json.JSONDecodeError, OSError):
+            banked = {}
+    n_probe = 0
+    while True:
+        ok, elapsed, rc = probe()
+        n_probe += 1
+        _log({"event": "probe", "n": n_probe, "alive": ok,
+              "elapsed_s": round(elapsed, 1), "rc": rc})
+        if ok:
+            for tier, budget in TIER_BUDGETS:
+                if tier in banked:
+                    continue
+                # re-probe between tiers: the window may have closed
+                alive, p_el, p_rc = probe()
+                _log({"event": "probe", "n": -1, "alive": alive,
+                      "elapsed_s": round(p_el, 1), "rc": p_rc,
+                      "before_tier": tier})
+                if not alive:
+                    break
+                result = run_tier(tier, budget)
+                if result is not None:
+                    banked[tier] = {"ts": _now(), **result}
+                    with open(BANK_PATH, "w") as f:
+                        json.dump({"provenance":
+                                   "relay_watch banked on live probe",
+                                   "tiers": banked}, f, indent=1)
+            if all(t in banked for t, _ in TIER_BUDGETS):
+                _log({"event": "all_banked"})
+                # keep probing (cheap) so the log still shows relay
+                # health for the rest of the round
+        time.sleep(INTERVAL)
+
+
+if __name__ == "__main__":
+    main()

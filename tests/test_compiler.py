@@ -93,3 +93,90 @@ def test_config_runtime_swap():
 def test_emitted_source_is_deterministic():
     spec = parse_markdown(_read("base.md"))
     assert emit_source(spec) == emit_source(spec)
+
+
+# --- untrusted-markdown hardening (constant-cell gate + exec sandbox) ---
+
+def _md_with_constant(expr):
+    """Minimal spec doc whose constants table carries one attacker cell."""
+    return (
+        "# Evil\n\n## Constants\n\n"
+        "| Name | Value |\n| - | - |\n"
+        f"| `EVIL_CONST` | `{expr}` |\n"
+    )
+
+
+@pytest.mark.parametrize("payload", [
+    # arbitrary code execution through a whitelisted-shape Call
+    "eval(\"__import__('os').system('true')\")",
+    # build-hang DoS: pow() call semantics ignored by a naive arg bound
+    "pow(2, 4096**4096)",
+    # exec/compile/__import__ by any other name
+    "exec('x = 1')",
+    "__import__('os')",
+    # non-Name callee shapes (a Call as the callee)
+    "uint64(1)(2)",
+])
+def test_constant_cell_rejects_non_whitelisted_calls(payload):
+    with pytest.raises(ValueError, match="callee|disallowed|underscore"):
+        build_spec([_md_with_constant(payload)])
+
+
+def test_constant_cell_allows_runtime_casts():
+    mod, _ = build_spec([_md_with_constant("uint64(2**6)")])
+    assert mod.EVIL_CONST == 64
+
+
+def test_generated_module_builtins_are_restricted():
+    mod, _ = build_spec([_md_with_constant("uint64(1)")])
+    bi = mod.__dict__["__builtins__"]
+    for name in ("eval", "exec", "compile", "open", "input", "vars",
+                 "globals", "locals", "setattr", "delattr"):
+        assert name not in bi, f"{name} reachable from generated module"
+    # guarded import: runtime package yes, os no
+    with pytest.raises(ImportError):
+        bi["__import__"]("os")
+    assert bi["__import__"]("consensus_specs_tpu") is not None
+
+
+def test_call_bound_uses_callee_semantics():
+    # uint64(huge-but-bounded arg) is fine: result is 64-bit by type
+    mod, _ = build_spec([_md_with_constant("uint64(2**63)")])
+    assert mod.EVIL_CONST == 2**63
+    # but an unbounded nested exponent still fails the arg-cost bound
+    with pytest.raises(ValueError):
+        build_spec([_md_with_constant("uint64(2**4096**4096)")])
+
+
+@pytest.mark.parametrize("payload", [
+    # cast result-width must not hide the argument's evaluation cost
+    "uint64(((2**4096)**4096)**4096)",
+    # kwargs evaluate before the call too
+    "uint64(x=2**4096**4096)",
+])
+def test_call_arguments_stay_bounded(payload):
+    with pytest.raises(ValueError):
+        build_spec([_md_with_constant(payload)])
+
+
+def _md_with_custom_type(type_expr):
+    return (
+        "# Evil\n\n## Custom types\n\n"
+        "| Name | SSZ equivalent | Description |\n| - | - | - |\n"
+        f"| `EvilType` | `{type_expr}` | x |\n"
+    )
+
+
+@pytest.mark.parametrize("payload", [
+    "max(print('PWNED') or 7, 7)",      # call channel
+    "2**4096**4096",                     # build-hang channel
+    "uint64.__class__",                  # attribute channel
+])
+def test_custom_type_cell_is_gated(payload):
+    with pytest.raises(ValueError):
+        build_spec([_md_with_custom_type(payload)])
+
+
+def test_custom_type_cell_allows_type_grammar():
+    mod, _ = build_spec([_md_with_custom_type("ByteVector[4 * 8]")])
+    assert mod.EvilType(b"\x00" * 32) is not None
