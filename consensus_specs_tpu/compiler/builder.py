@@ -88,6 +88,25 @@ _CALLEE_BITS = {
 }
 
 
+def _may_be_sequence(node) -> bool:
+    """Could this subtree evaluate to a str/bytes/tuple/list?  Names
+    are spec constants (ints); uintN casts are ints; BytesN/ByteVector/
+    ByteList casts and literal sequences are sequences; arithmetic
+    propagates from its operands."""
+    if isinstance(node, ast.Constant):
+        return not isinstance(node.value, (int, bool))
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return True
+    if isinstance(node, ast.Call):
+        callee = node.func.id if isinstance(node.func, ast.Name) else ""
+        return callee.startswith(("Bytes", "ByteVector", "ByteList"))
+    if isinstance(node, ast.BinOp):
+        return _may_be_sequence(node.left) or _may_be_sequence(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _may_be_sequence(node.operand)
+    return False
+
+
 def _bit_bound(node) -> int:
     """Abstract upper bound on the bit-length a cell expression can
     produce when the generated module exec's it.  Names are assumed to
@@ -126,6 +145,27 @@ def _bit_bound(node) -> int:
     if isinstance(node, ast.UnaryOp):
         return _bit_bound(node.operand)
     if isinstance(node, ast.BinOp):
+        # sequence arithmetic obeys SIZE semantics, not integer bit
+        # semantics: repetition multiplies (b'\x00' * 95 is 95 bytes,
+        # not a 25-bit number), so it takes a literal, range-bounded
+        # count — ('a' * 65000) * 65000 would otherwise slip a ~TB
+        # allocation past an integer Mult bound
+        left_seq = _may_be_sequence(node.left)
+        right_seq = _may_be_sequence(node.right)
+        if left_seq or right_seq:
+            if isinstance(node.op, ast.Add) and left_seq and right_seq:
+                return _bit_bound(node.left) + _bit_bound(node.right)
+            if isinstance(node.op, ast.Mult) and (left_seq != right_seq):
+                seq, count_node = ((node.left, node.right) if left_seq
+                                   else (node.right, node.left))
+                try:
+                    count = _eval_literal(count_node)
+                except ValueError:
+                    raise ValueError("non-literal repetition count")
+                if not isinstance(count, int) or not 0 <= count <= 4096:
+                    raise ValueError("repetition count out of range")
+                return _bit_bound(seq) * max(count, 1)
+            raise ValueError("unsupported sequence arithmetic")
         left = _bit_bound(node.left)
         op = node.op
         if isinstance(op, (ast.Add, ast.Sub, ast.BitOr, ast.BitAnd,

@@ -3,8 +3,13 @@
 FORK_CHOICE_HANDLERS = {
     "get_head":
         "consensus_specs_tpu.spec_tests.fork_choice.test_get_head",
-    "on_block":
+    "on_block": [
         "consensus_specs_tpu.spec_tests.fork_choice.test_on_block",
+        # deneb+ blob-availability cases belong to the on_block handler
+        # but live in their own module
+        "consensus_specs_tpu.spec_tests.fork_choice."
+        "test_on_block_blob_data",
+    ],
     "on_attestation":
         "consensus_specs_tpu.spec_tests.fork_choice.test_on_attestation",
     "ex_ante":
@@ -12,4 +17,13 @@ FORK_CHOICE_HANDLERS = {
     "get_proposer_head":
         "consensus_specs_tpu.spec_tests.fork_choice."
         "test_get_proposer_head",
+    "reorg":
+        "consensus_specs_tpu.spec_tests.fork_choice.test_reorg",
+    "withholding":
+        "consensus_specs_tpu.spec_tests.fork_choice.test_withholding",
+    "on_merge_block":
+        "consensus_specs_tpu.spec_tests.fork_choice.test_on_merge_block",
+    "should_override_forkchoice_update":
+        "consensus_specs_tpu.spec_tests.fork_choice."
+        "test_should_override_forkchoice_update",
 }

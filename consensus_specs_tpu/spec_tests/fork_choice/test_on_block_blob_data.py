@@ -1,0 +1,135 @@
+"""Blob data-availability on_block battery (deneb+; reference
+test/deneb/fork_choice/test_on_block.py, 5 cases; spec:
+deneb/fork-choice.md is_data_available, specs/deneb.py:257).
+
+on_block must reject a block whose blob sidecar data is missing,
+mismatched in length, or fails KZG batch verification — and accept it
+when the retrieved (blobs, proofs) verify against the block's
+commitments.  Fulu replaces blob retrieval with column sampling, so it
+is excluded like the reference does.
+"""
+from random import Random
+
+from ...ssz import hash_tree_root
+from ...test_infra.context import (
+    spec_state_test, with_all_phases_from, with_pytest_fork_subset,
+    never_bls)
+from ...test_infra.blob import (
+    BlobData, blob_data_patch, get_sample_blob_tx)
+from ...test_infra.blocks import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block)
+from ...test_infra.fork_choice import (
+    start_fork_choice_test, tick_and_add_block, on_tick_and_append_step,
+    output_store_checks, emit_steps,
+    get_head_root, tick_to_state_slot)
+
+BLOB_FORKS = ["deneb", "electra"]
+
+
+def _block_with_blob(spec, state, rng):
+    block = build_empty_block_for_next_slot(spec, state)
+    opaque_tx, blobs, commitments, proofs = get_sample_blob_tx(
+        spec, blob_count=1, rng=rng)
+    block.body.execution_payload.transactions = [opaque_tx]
+    block.body.blob_kzg_commitments = commitments
+    return block, blobs, proofs
+
+
+def _start(spec, state):
+    store, steps, parts = start_fork_choice_test(spec, state)
+    on_tick_and_append_step(
+        spec, store,
+        int(store.genesis_time)
+        + int(state.slot) * int(spec.config.SECONDS_PER_SLOT), steps)
+    return store, steps, parts
+
+
+def _run_blob_case(spec, state, blob_data_fn, valid):
+    """Build one blob-carrying block and apply it under the retrieval
+    patch; `blob_data_fn(blobs, proofs)` shapes what the node 'has'."""
+    rng = Random(1234)
+    store, steps, parts = _start(spec, state)
+    for name, v in parts:
+        yield name, v
+    block, blobs, proofs = _block_with_blob(spec, state, rng)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    blob_data = blob_data_fn(blobs, proofs)
+    with blob_data_patch(spec, blob_data):
+        for name, v in tick_and_add_block(spec, store, signed_block,
+                                          steps, valid=valid):
+            yield name, v
+    root = hash_tree_root(signed_block.message)
+    if valid:
+        assert get_head_root(spec, store) == root
+    else:
+        assert get_head_root(spec, store) != root
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)
+
+
+@with_all_phases_from("deneb", to="electra")
+@with_pytest_fork_subset(BLOB_FORKS)
+@spec_state_test
+@never_bls
+def test_simple_blob_data(spec, state):
+    """Available, verifying blob data over two consecutive blocks."""
+    rng = Random(1234)
+    store, steps, parts = _start(spec, state)
+    for name, v in parts:
+        yield name, v
+    for _ in range(2):
+        block, blobs, proofs = _block_with_blob(spec, state, rng)
+        signed_block = state_transition_and_sign_block(spec, state, block)
+        with blob_data_patch(spec, BlobData(blobs, proofs)):
+            for name, v in tick_and_add_block(spec, store, signed_block,
+                                              steps):
+                yield name, v
+        assert get_head_root(spec, store) == hash_tree_root(signed_block.message)
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)
+
+
+@with_all_phases_from("deneb", to="electra")
+@with_pytest_fork_subset(BLOB_FORKS)
+@spec_state_test
+@never_bls
+def test_invalid_incorrect_proof(spec, state):
+    """A syntactically valid but WRONG proof fails batch verification."""
+    yield from _run_blob_case(
+        spec, state,
+        lambda blobs, proofs: BlobData(
+            blobs, [b"\xc0" + b"\x00" * 47]),
+        valid=False)
+
+
+@with_all_phases_from("deneb", to="electra")
+@with_pytest_fork_subset(BLOB_FORKS)
+@spec_state_test
+@never_bls
+def test_invalid_data_unavailable(spec, state):
+    """Nothing retrieved at all: data unavailable, block rejected."""
+    yield from _run_blob_case(
+        spec, state, lambda blobs, proofs: BlobData([], []),
+        valid=False)
+
+
+@with_all_phases_from("deneb", to="electra")
+@with_pytest_fork_subset(BLOB_FORKS)
+@spec_state_test
+@never_bls
+def test_invalid_wrong_proofs_length(spec, state):
+    """Blobs present but proofs missing: length mismatch rejected."""
+    yield from _run_blob_case(
+        spec, state, lambda blobs, proofs: BlobData(blobs, []),
+        valid=False)
+
+
+@with_all_phases_from("deneb", to="electra")
+@with_pytest_fork_subset(BLOB_FORKS)
+@spec_state_test
+@never_bls
+def test_invalid_wrong_blobs_length(spec, state):
+    """Proofs present but blobs missing: length mismatch rejected."""
+    yield from _run_blob_case(
+        spec, state, lambda blobs, proofs: BlobData([], proofs),
+        valid=False)

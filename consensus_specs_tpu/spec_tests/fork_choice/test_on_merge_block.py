@@ -1,0 +1,125 @@
+"""Merge-transition on_block battery: validate_merge_block TTD edge
+cases (reference test/bellatrix/fork_choice/test_on_merge_block.py,
+4 cases; spec: bellatrix/fork-choice.md on_block +
+specs/bellatrix.py::validate_merge_block).
+
+The transition block (first block carrying a payload) must point at a
+PoW block with total_difficulty >= TTD whose PARENT is still below TTD;
+both must be known to the PoW chain view.
+"""
+from random import Random
+
+from ...ssz import hash_tree_root, uint256
+from ...test_infra.context import (
+    spec_state_test, with_phases, never_bls)
+from ...test_infra.blocks import (
+    build_empty_block_for_next_slot, build_empty_execution_payload,
+    state_transition_and_sign_block)
+from ...test_infra.fork_choice import (
+    start_fork_choice_test, tick_and_add_block, add_pow_block,
+    on_tick_and_append_step, output_store_checks, emit_steps,
+    get_head_root, tick_to_state_slot)
+from ...test_infra.pow_block import (
+    prepare_random_pow_block, pow_chain_patch,
+    build_state_with_incomplete_transition,
+    recompute_payload_block_hash)
+
+
+def _merge_block_test(spec, state, pow_blocks, valid):
+    """Shared driver: anchor on a pre-merge state, surface `pow_blocks`
+    through the PoW view, then apply the transition block whose payload
+    parent is pow_blocks[0]."""
+    state = build_state_with_incomplete_transition(spec, state)
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    on_tick_and_append_step(
+        spec, store,
+        int(store.genesis_time)
+        + int(state.slot) * int(spec.config.SECONDS_PER_SLOT), steps)
+
+    for pb in pow_blocks:
+        for name, v in add_pow_block(spec, store, pb, steps):
+            yield name, v
+
+    with pow_chain_patch(spec, pow_blocks):
+        block = build_empty_block_for_next_slot(spec, state)
+        # pre-merge states get no payload from the block builder — the
+        # transition block carries the FIRST payload, pointed at the
+        # terminal PoW block
+        lookahead = state.copy()
+        spec.process_slots(lookahead, block.slot)
+        payload = build_empty_execution_payload(spec, lookahead)
+        payload.parent_hash = pow_blocks[0].block_hash
+        recompute_payload_block_hash(spec, payload)
+        block.body.execution_payload = payload
+        signed_block = state_transition_and_sign_block(spec, state, block)
+        for name, v in tick_and_add_block(spec, store, signed_block,
+                                          steps, valid=valid):
+            yield name, v
+        if valid:
+            assert get_head_root(spec, store) == hash_tree_root(
+                signed_block.message)
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+@never_bls
+def test_all_valid(spec, state):
+    """PoW block at exactly TTD with a parent just below: valid."""
+    rng = Random(3131)
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    pow_parent = prepare_random_pow_block(spec, rng)
+    pow_parent.total_difficulty = uint256(ttd - 1)
+    pow_block = prepare_random_pow_block(spec, rng)
+    pow_block.parent_hash = pow_parent.block_hash
+    pow_block.total_difficulty = uint256(ttd)
+    yield from _merge_block_test(spec, state, [pow_block, pow_parent],
+                                 valid=True)
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+@never_bls
+def test_block_lookup_failed(spec, state):
+    """The referenced PoW parent is unknown to the chain view: the
+    merge block must be rejected."""
+    rng = Random(3131)
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    pow_block = prepare_random_pow_block(spec, rng)
+    pow_block.total_difficulty = uint256(ttd - 1)
+    yield from _merge_block_test(spec, state, [pow_block], valid=False)
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+@never_bls
+def test_too_early_for_merge(spec, state):
+    """Terminal block below TTD: the chain has not reached the merge."""
+    rng = Random(3131)
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    pow_parent = prepare_random_pow_block(spec, rng)
+    pow_parent.total_difficulty = uint256(ttd - 2)
+    pow_block = prepare_random_pow_block(spec, rng)
+    pow_block.parent_hash = pow_parent.block_hash
+    pow_block.total_difficulty = uint256(ttd - 1)
+    yield from _merge_block_test(spec, state, [pow_block, pow_parent],
+                                 valid=False)
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+@never_bls
+def test_too_late_for_merge(spec, state):
+    """Parent already at TTD: the terminal block is one too late."""
+    rng = Random(3131)
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    pow_parent = prepare_random_pow_block(spec, rng)
+    pow_parent.total_difficulty = uint256(ttd)
+    pow_block = prepare_random_pow_block(spec, rng)
+    pow_block.parent_hash = pow_parent.block_hash
+    pow_block.total_difficulty = uint256(ttd + 1)
+    yield from _merge_block_test(spec, state, [pow_block, pow_parent],
+                                 valid=False)

@@ -1,0 +1,460 @@
+"""Reorg-resistance fork-choice battery: competing chains around
+justification boundaries, voting-source filtering, delayed
+justification.
+
+Reference battery: test/phase0/fork_choice/test_reorg.py (8 cases).
+Each case scripts two chains (`y` arrives first, `z` attempts the
+reorg) through the step-emitting store harness and asserts which head
+survives across epoch boundaries — exercising get_voting_source and
+the filter_block_tree voting-source window (fork-choice.md reorg
+helpers, specs/fork_choice.py).
+"""
+from ...ssz import hash_tree_root, uint64
+from ...test_infra.context import (
+    spec_state_test, with_all_phases_from, with_presets,
+    with_pytest_fork_subset, never_bls)
+from ...test_infra.attestations import (
+    get_valid_attestation, get_valid_attestations_at_slot,
+    state_transition_with_full_block)
+from ...test_infra.blocks import (
+    build_empty_block, build_empty_block_for_next_slot, next_epoch,
+    next_slot, state_transition_and_sign_block, transition_to)
+from ...test_infra.fork_choice import (
+    start_fork_choice_test, tick_and_add_block, add_attestations,
+    apply_next_epoch_with_attestations, find_next_justifying_slot,
+    is_ready_to_justify, on_tick_and_append_step, output_store_checks,
+    emit_steps,
+    get_head_root, tick_to_state_slot)
+
+# two representative forks under pytest; the generator emits all
+REORG_FORKS = ["altair", "electra"]
+
+
+def _start(spec, state):
+    """Anchor the store and tick to the state's slot."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    tick_to_state_slot(spec, store, state, [])
+    return store, steps, parts
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(REORG_FORKS)
+@with_presets(["minimal"], reason="too slow")
+@spec_state_test
+@never_bls
+def test_simple_attempted_reorg_without_enough_ffg_votes(spec, state):
+    """[Case 1]
+
+    {      epoch 4             }{     epoch 5     }
+    [c4]<--[a]<--[-]<--[y]
+            |____[-]<--[z]
+
+    Neither y nor z carries enough votes to justify c4: y keeps the
+    head (first arrival wins LMD) through the boundary."""
+    store, steps, parts = _start(spec, state)
+    for name, v in parts:
+        yield name, v
+    next_epoch(spec, state)
+    tick_to_state_slot(spec, store, state, steps)
+
+    # fill epochs 1-3 so epoch 3 is justified
+    for _ in range(3):
+        more, _blocks = apply_next_epoch_with_attestations(
+            spec, state, store, steps, fill_cur_epoch=True,
+            fill_prev_epoch=True)
+        for name, v in more:
+            yield name, v
+    assert int(state.current_justified_checkpoint.epoch) \
+        == int(store.justified_checkpoint.epoch) == 3
+
+    # block a: stop two blocks short of the justifying chain
+    signed_blocks, justifying_slot = find_next_justifying_slot(
+        spec, state, True, True)
+    assert int(spec.compute_epoch_at_slot(uint64(justifying_slot))) \
+        == int(spec.get_current_epoch(state))
+    for signed_block in signed_blocks[:-2]:
+        for name, v in tick_and_add_block(spec, store, signed_block,
+                                          steps):
+            yield name, v
+        assert get_head_root(spec, store) == hash_tree_root(signed_block.message)
+    state = store.block_states[get_head_root(spec, store)].copy()
+    assert int(state.current_justified_checkpoint.epoch) == 3
+    next_slot(spec, state)
+    state_a = state.copy()
+
+    # chain y: one empty block, then one full block — not enough FFG
+    blocks_y = []
+    block_y = build_empty_block_for_next_slot(spec, state)
+    blocks_y.append(state_transition_and_sign_block(spec, state, block_y))
+    blocks_y.append(state_transition_with_full_block(
+        spec, state, True, True))
+    assert not is_ready_to_justify(spec, state)
+
+    # chain z: one block with a single attestation, then one empty
+    state = state_a.copy()
+    blocks_z = []
+    attestation = get_valid_attestation(spec, state, slot=state.slot,
+                                        signed=True)
+    block_z = build_empty_block_for_next_slot(spec, state)
+    block_z.body.attestations = [attestation]
+    blocks_z.append(state_transition_and_sign_block(spec, state, block_z))
+    block_z = build_empty_block_for_next_slot(spec, state)
+    blocks_z.append(state_transition_and_sign_block(spec, state, block_z))
+    assert not is_ready_to_justify(spec, state)
+
+    # interleave: y first at each slot height
+    for signed in (blocks_y[0], blocks_z[0], blocks_z[1], blocks_y[1]):
+        for name, v in tick_and_add_block(spec, store, signed, steps):
+            yield name, v
+    # y arrived first and z has no FFG edge: y stays head
+    assert get_head_root(spec, store) == hash_tree_root(blocks_y[1].message)
+    assert int(store.justified_checkpoint.epoch) == 3
+
+    # through the boundary into epoch 5: still y, still epoch-3 JC
+    next_epoch(spec, state)
+    tick_to_state_slot(spec, store, state, steps)
+    assert get_head_root(spec, store) == hash_tree_root(blocks_y[1].message)
+    assert int(store.justified_checkpoint.epoch) == 3
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)
+
+
+def _run_delayed_justification(spec, state, attempted_reorg,
+                               is_justifying_previous_epoch):
+    """Chain b justifies the pending checkpoint only when its epoch
+    boundary processes; a late fork z cannot displace y meanwhile."""
+    store, steps, parts = _start(spec, state)
+    for name, v in parts:
+        yield name, v
+    next_epoch(spec, state)
+    tick_to_state_slot(spec, store, state, steps)
+
+    for _ in range(2):
+        more, _ = apply_next_epoch_with_attestations(
+            spec, state, store, steps, fill_cur_epoch=True,
+            fill_prev_epoch=True)
+        for name, v in more:
+            yield name, v
+    if is_justifying_previous_epoch:
+        # one empty epoch: justification stalls at epoch 2
+        more, _ = apply_next_epoch_with_attestations(
+            spec, state, store, steps, fill_cur_epoch=False,
+            fill_prev_epoch=False)
+        for name, v in more:
+            yield name, v
+        assert int(store.justified_checkpoint.epoch) == 2
+        signed_blocks, justifying_slot = find_next_justifying_slot(
+            spec, state, False, True)
+    else:
+        more, _ = apply_next_epoch_with_attestations(
+            spec, state, store, steps, fill_cur_epoch=True,
+            fill_prev_epoch=True)
+        for name, v in more:
+            yield name, v
+        assert int(store.justified_checkpoint.epoch) == 3
+        signed_blocks, justifying_slot = find_next_justifying_slot(
+            spec, state, True, True)
+    assert int(spec.compute_epoch_at_slot(uint64(justifying_slot))) \
+        == int(spec.get_current_epoch(state))
+
+    for signed_block in signed_blocks:
+        for name, v in tick_and_add_block(spec, store, signed_block,
+                                          steps):
+            yield name, v
+    state = store.block_states[get_head_root(spec, store)].copy()
+    expected_jc = 2 if is_justifying_previous_epoch else 3
+    assert int(state.current_justified_checkpoint.epoch) == expected_jc
+    assert is_ready_to_justify(spec, state)
+    state_b = state.copy()
+
+    # chain y extends b with one more full block
+    signed_block_y = state_transition_with_full_block(
+        spec, state, not is_justifying_previous_epoch, True)
+    for name, v in tick_and_add_block(spec, store, signed_block_y, steps):
+        yield name, v
+    assert get_head_root(spec, store) == hash_tree_root(signed_block_y.message)
+    assert int(store.justified_checkpoint.epoch) == expected_jc
+
+    # attestations for y land in the next slot
+    temp_state = state.copy()
+    next_slot(spec, temp_state)
+    votes_y = list(get_valid_attestations_at_slot(
+        temp_state, spec, signed_block_y.message.slot))
+    tick_to_state_slot(spec, store, temp_state, steps)
+    for name, v in add_attestations(spec, store, votes_y, steps):
+        yield name, v
+    assert get_head_root(spec, store) == hash_tree_root(signed_block_y.message)
+
+    if attempted_reorg:
+        # z: empty fork landing at the first slot of the next epoch
+        state = state_b.copy()
+        slot = (int(state.slot) + int(spec.SLOTS_PER_EPOCH)
+                - int(state.slot) % int(spec.SLOTS_PER_EPOCH) - 1)
+        transition_to(spec, state, uint64(slot))
+        block_z = build_empty_block_for_next_slot(spec, state)
+        assert int(spec.compute_epoch_at_slot(block_z.slot)) == 5
+        signed_block_z = state_transition_and_sign_block(
+            spec, state, block_z)
+        for name, v in tick_and_add_block(spec, store, signed_block_z,
+                                          steps):
+            yield name, v
+    else:
+        state = state_b.copy()
+        next_epoch(spec, state)
+        tick_to_state_slot(spec, store, state, steps)
+
+    # the boundary processed b's pending votes: JC advances, y holds
+    assert get_head_root(spec, store) == hash_tree_root(signed_block_y.message)
+    assert int(store.justified_checkpoint.epoch) == expected_jc + 1
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(REORG_FORKS)
+@with_presets(["minimal"], reason="too slow")
+@spec_state_test
+@never_bls
+def test_simple_attempted_reorg_delayed_justification_current_epoch(
+        spec, state):
+    """[Case 2] z (first slot of epoch 5) cannot reorg y once b's
+    delayed justification lands."""
+    yield from _run_delayed_justification(
+        spec, state, attempted_reorg=True,
+        is_justifying_previous_epoch=False)
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(REORG_FORKS)
+@with_presets(["minimal"], reason="too slow")
+@spec_state_test
+@never_bls
+def test_delayed_justification_current_epoch(spec, state):
+    """[Case 5] No fork at all: the delayed justification simply lands
+    at the boundary."""
+    yield from _run_delayed_justification(
+        spec, state, attempted_reorg=False,
+        is_justifying_previous_epoch=False)
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(REORG_FORKS)
+@with_presets(["minimal"], reason="too slow")
+@spec_state_test
+@never_bls
+def test_delayed_justification_previous_epoch(spec, state):
+    """[Case 6] Same, with the justifying votes targeting the previous
+    epoch (empty epoch 3 stalls JC at 2)."""
+    yield from _run_delayed_justification(
+        spec, state, attempted_reorg=False,
+        is_justifying_previous_epoch=True)
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(REORG_FORKS)
+@with_presets(["minimal"], reason="too slow")
+@spec_state_test
+@never_bls
+def test_simple_attempted_reorg_delayed_justification_previous_epoch(
+        spec, state):
+    """[Case 7] Attempted reorg against a previous-epoch delayed
+    justification."""
+    yield from _run_delayed_justification(
+        spec, state, attempted_reorg=True,
+        is_justifying_previous_epoch=True)
+
+
+def _run_include_votes_of_another_empty_chain(spec, state, enough_ffg,
+                                              is_justifying_previous_epoch):
+    """Empty chain y gets the LMD votes; fork z INCLUDES those votes as
+    on-chain attestations.  Whether y survives later boundaries depends
+    on its voting source staying within the filter window."""
+    store, steps, parts = _start(spec, state)
+    for name, v in parts:
+        yield name, v
+    next_epoch(spec, state)
+    tick_to_state_slot(spec, store, state, steps)
+
+    for _ in range(2):
+        more, _ = apply_next_epoch_with_attestations(
+            spec, state, store, steps, fill_cur_epoch=True,
+            fill_prev_epoch=True)
+        for name, v in more:
+            yield name, v
+
+    if is_justifying_previous_epoch:
+        # head in epoch 3, JC at 2
+        block_a = build_empty_block_for_next_slot(spec, state)
+        signed_block_a = state_transition_and_sign_block(
+            spec, state, block_a)
+        for name, v in tick_and_add_block(spec, store, signed_block_a,
+                                          steps):
+            yield name, v
+        expected_jc = 2
+    else:
+        # head in epoch 4, JC at 3
+        more, _ = apply_next_epoch_with_attestations(
+            spec, state, store, steps, fill_cur_epoch=True,
+            fill_prev_epoch=True)
+        for name, v in more:
+            yield name, v
+        signed_block_a = state_transition_with_full_block(
+            spec, state, True, True)
+        for name, v in tick_and_add_block(spec, store, signed_block_a,
+                                          steps):
+            yield name, v
+        expected_jc = 3
+    state = store.block_states[get_head_root(spec, store)].copy()
+    assert int(state.current_justified_checkpoint.epoch) == expected_jc
+    state_a = state.copy()
+
+    if is_justifying_previous_epoch:
+        _, justifying_slot = find_next_justifying_slot(
+            spec, state, False, True)
+    else:
+        _, justifying_slot = find_next_justifying_slot(
+            spec, state, True, True)
+    assert int(spec.compute_epoch_at_slot(uint64(justifying_slot))) == 4
+
+    last_slot_of_z = justifying_slot if enough_ffg else justifying_slot - 1
+    last_slot_of_y = justifying_slot if is_justifying_previous_epoch \
+        else last_slot_of_z - 1
+
+    # empty chain y up to last_slot_of_y
+    blocks_y = []
+    states_of_y = []
+    for slot in range(int(state.slot) + 1, last_slot_of_y + 1):
+        block = build_empty_block(spec, state, slot=uint64(slot))
+        blocks_y.append(
+            state_transition_and_sign_block(spec, state, block))
+        states_of_y.append(state.copy())
+    assert int(spec.compute_epoch_at_slot(
+        blocks_y[-1].message.slot)) == 4
+
+    # 2/3 votes FOR the empty chain (collected per empty-chain state)
+    votes_for_y = [list(get_valid_attestations_at_slot(
+        state, spec, state_a.slot))]
+    for st in states_of_y:
+        votes_for_y.append(
+            list(get_valid_attestations_at_slot(st, spec, st.slot)))
+
+    # chain z re-includes those votes as on-chain attestations.  Until
+    # the first attestation batch lands, z's empty blocks are byte-
+    # identical to y's (same parent/proposer/body) — only add z when it
+    # actually diverges.  signed_block_y tracks the last APPLIED y
+    # block; the early break can leave trailing list entries unapplied.
+    state = state_a.copy()
+    pending_y = list(blocks_y)
+    signed_block_y = None
+    signed_block_z = None
+    for slot in range(int(state_a.slot) + 1, last_slot_of_z + 1):
+        if slot <= last_slot_of_y and pending_y:
+            signed_block_y = pending_y.pop(0)
+            assert int(signed_block_y.message.slot) == slot
+            for name, v in tick_and_add_block(spec, store,
+                                              signed_block_y, steps):
+                yield name, v
+        block = build_empty_block(spec, state, slot=uint64(slot))
+        if votes_for_y and (
+                not is_justifying_previous_epoch
+                or int(votes_for_y[0][0].data.slot) == slot - 5):
+            for att in votes_for_y.pop(0):
+                block.body.attestations.append(att)
+        signed_block_z = state_transition_and_sign_block(
+            spec, state, block)
+        if signed_block_y is None or hash_tree_root(
+                signed_block_z.message) != hash_tree_root(
+                signed_block_y.message):
+            for name, v in tick_and_add_block(spec, store, signed_block_z,
+                                              steps):
+                yield name, v
+        if is_ready_to_justify(spec, state):
+            break
+    signed_block_y = signed_block_y or blocks_y[-1]
+
+    # while inside epoch 4: y wins LMD, voting source == store JC
+    y_root = hash_tree_root(signed_block_y.message)
+    assert int(spec.get_voting_source(store, y_root).epoch) == expected_jc
+    assert int(store.justified_checkpoint.epoch) == expected_jc
+    assert get_head_root(spec, store) == y_root
+    assert is_ready_to_justify(spec, state) == bool(enough_ffg)
+
+    # epoch 5 boundary
+    next_epoch(spec, state)
+    tick_to_state_slot(spec, store, state, steps)
+    z_root = hash_tree_root(signed_block_z.message)
+    y_source = int(spec.get_voting_source(store, y_root).epoch)
+    cur_epoch = int(spec.compute_epoch_at_slot(
+        spec.get_current_slot(store)))
+    if is_justifying_previous_epoch:
+        # z's included votes justified epoch 3; y's source (2) is now
+        # outside the 2-epoch window: y filtered, z is head
+        assert int(store.justified_checkpoint.epoch) == 3
+        assert y_source == 2 and y_source + 2 < cur_epoch
+        assert get_head_root(spec, store) == z_root
+    elif enough_ffg:
+        # JC advanced to 4 but y's source (3) is within the window
+        assert int(store.justified_checkpoint.epoch) == 4
+        assert y_source == 3 and y_source + 2 >= cur_epoch
+        assert get_head_root(spec, store) == y_root
+    else:
+        assert int(store.justified_checkpoint.epoch) == 3
+        assert y_source == 3
+        assert get_head_root(spec, store) == y_root
+
+    # epoch 6 boundary: the window closes
+    next_epoch(spec, state)
+    tick_to_state_slot(spec, store, state, steps)
+    cur_epoch = int(spec.compute_epoch_at_slot(
+        spec.get_current_slot(store)))
+    y_source = int(spec.get_voting_source(store, y_root).epoch)
+    if is_justifying_previous_epoch:
+        assert int(store.justified_checkpoint.epoch) == 3
+        assert get_head_root(spec, store) == z_root
+    elif enough_ffg:
+        # now y's source is stale: filtered out, z takes the head
+        assert int(store.justified_checkpoint.epoch) == 4
+        assert y_source == 3 and y_source + 2 < cur_epoch
+        assert get_head_root(spec, store) == z_root
+    else:
+        assert int(store.justified_checkpoint.epoch) == 3
+        assert y_source == 3
+        assert get_head_root(spec, store) == y_root
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(REORG_FORKS)
+@with_presets(["minimal"], reason="too slow")
+@spec_state_test
+@never_bls
+def test_include_votes_another_empty_chain_with_enough_ffg_votes_current_epoch(
+        spec, state):
+    """[Case 3]"""
+    yield from _run_include_votes_of_another_empty_chain(
+        spec, state, enough_ffg=True, is_justifying_previous_epoch=False)
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(REORG_FORKS)
+@with_presets(["minimal"], reason="too slow")
+@spec_state_test
+@never_bls
+def test_include_votes_another_empty_chain_without_enough_ffg_votes_current_epoch(
+        spec, state):
+    """[Case 4]"""
+    yield from _run_include_votes_of_another_empty_chain(
+        spec, state, enough_ffg=False, is_justifying_previous_epoch=False)
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(REORG_FORKS)
+@with_presets(["minimal"], reason="too slow")
+@spec_state_test
+@never_bls
+def test_include_votes_another_empty_chain_with_enough_ffg_votes_previous_epoch(
+        spec, state):
+    """[Case 8]"""
+    yield from _run_include_votes_of_another_empty_chain(
+        spec, state, enough_ffg=True, is_justifying_previous_epoch=True)

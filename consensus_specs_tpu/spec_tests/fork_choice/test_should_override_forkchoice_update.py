@@ -1,0 +1,146 @@
+"""should_override_forkchoice_update battery (reference
+test/bellatrix/fork_choice/test_should_override_forkchoice_update.py,
+2 cases; spec: specs/bellatrix.py::should_override_forkchoice_update,
+fork_choice/safe-block.md + bellatrix honest-validator guide).
+
+A proposer about to reorg a late, weak head withholds the fcU for it —
+the predicate must fire only when every reorg precondition holds.
+"""
+from ...ssz import hash_tree_root
+from ...test_infra.context import (
+    spec_state_test, with_all_phases_from, with_presets,
+    with_pytest_fork_subset, never_bls)
+from ...test_infra.attestations import get_valid_attestations_at_slot
+from ...test_infra.blocks import (
+    build_empty_block_for_next_slot, next_epoch, next_slot,
+    state_transition_and_sign_block)
+from ...test_infra.fork_choice import (
+    start_fork_choice_test, tick_and_add_block,
+    apply_next_epoch_with_attestations,
+    apply_next_slots_with_attestations, tick_and_run_on_attestation,
+    on_tick_and_append_step, output_store_checks, emit_steps,
+    get_head_root, tick_to_state_slot)
+
+OVERRIDE_FORKS = ["bellatrix", "electra"]
+
+
+def _emit_override_check(steps, result) -> None:
+    steps.append({"checks": {"should_override_forkchoice_update": {
+        "validator_is_connected": True, "result": bool(result)}}})
+
+
+@with_all_phases_from("bellatrix")
+@with_pytest_fork_subset(OVERRIDE_FORKS)
+@with_presets(["minimal"], reason="too slow")
+@spec_state_test
+@never_bls
+def test_should_override_forkchoice_update__false(spec, state):
+    """A timely, healthy head one slot back: no override."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    tick_to_state_slot(spec, store, state, steps)
+
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    for name, v in tick_and_add_block(spec, store, signed_block, steps):
+        yield name, v
+    head_root = get_head_root(spec, store)
+    assert head_root == hash_tree_root(signed_block.message)
+
+    next_slot(spec, state)
+    tick_to_state_slot(spec, store, state, steps)
+
+    should_override = spec.should_override_forkchoice_update(
+        store, head_root)
+    assert not should_override
+    output_store_checks(spec, store, steps)
+    _emit_override_check(steps, should_override)
+    yield from emit_steps(steps)
+
+
+@with_all_phases_from("bellatrix")
+@with_pytest_fork_subset(OVERRIDE_FORKS)
+@with_presets(["minimal"], reason="too slow")
+@spec_state_test
+@never_bls
+def test_should_override_forkchoice_update__true(spec, state):
+    """A late, weak head on a strong parent at the reorg slot: the fcU
+    for the head should be withheld."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    tick_to_state_slot(spec, store, state, steps)
+    next_epoch(spec, state)
+    tick_to_state_slot(spec, store, state, steps)
+
+    # healthy finalization first (epochs 1-3)
+    for _ in range(3):
+        more, _ = apply_next_epoch_with_attestations(
+            spec, state, store, steps, fill_cur_epoch=True,
+            fill_prev_epoch=True)
+        for name, v in more:
+            yield name, v
+    assert int(store.justified_checkpoint.epoch) == 3
+    assert int(store.finalized_checkpoint.epoch) == 2
+
+    # an empty block, then an attested parent
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    for name, v in tick_and_add_block(spec, store, signed_block, steps):
+        yield name, v
+    more, signed_parent = apply_next_slots_with_attestations(
+        spec, state, store, 1, steps, fill_cur_epoch=True,
+        fill_prev_epoch=True)
+    for name, v in more:
+        yield name, v
+
+    # the head block: carries the parent's attestations, arrives LATE
+    block = build_empty_block_for_next_slot(spec, state)
+    parent_block_slot = int(block.slot) - 1
+    for att in get_valid_attestations_at_slot(
+            state, spec, parent_block_slot):
+        block.body.attestations.append(att)
+    signed_head = state_transition_and_sign_block(spec, state, block)
+    attesting_cutoff = (int(spec.config.SECONDS_PER_SLOT)
+                        // int(spec.INTERVALS_PER_SLOT))
+    on_tick_and_append_step(
+        spec, store,
+        int(store.genesis_time)
+        + int(state.slot) * int(spec.config.SECONDS_PER_SLOT)
+        + attesting_cutoff, steps)
+    for name, v in tick_and_add_block(spec, store, signed_head, steps):
+        yield name, v
+
+    head_root = get_head_root(spec, store)
+    head_block = store.blocks[head_root]
+    parent_root = head_block.parent_root
+    assert parent_root == hash_tree_root(signed_parent.message)
+
+    # attestations voting the PARENT (not the late head)
+    temp_state = state.copy()
+    next_slot(spec, temp_state)
+    for att in get_valid_attestations_at_slot(
+            temp_state, spec, int(temp_state.slot) - 1,
+            beacon_block_root=parent_root):
+        for name, v in tick_and_run_on_attestation(
+                spec, store, att, steps):
+            yield name, v
+
+    proposal_slot = int(head_block.slot) + 1
+    assert spec.is_head_late(store, head_root)
+    assert spec.is_shuffling_stable(proposal_slot)
+    assert spec.is_ffg_competitive(store, head_root, parent_root)
+    assert spec.is_finalization_ok(store, proposal_slot)
+    assert spec.is_proposing_on_time(store)
+    assert int(store.blocks[parent_root].slot) + 1 \
+        == int(head_block.slot)
+    assert spec.is_head_weak(store, head_root)
+    assert spec.is_parent_strong(store, parent_root)
+
+    should_override = spec.should_override_forkchoice_update(
+        store, head_root)
+    assert should_override
+    output_store_checks(spec, store, steps)
+    _emit_override_check(steps, should_override)
+    yield from emit_steps(steps)

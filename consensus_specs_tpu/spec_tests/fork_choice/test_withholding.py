@@ -1,0 +1,187 @@
+"""Justification-withholding attack battery.
+
+Reference battery: test/phase0/fork_choice/test_withholding.py (2
+cases).  An attacker builds (but withholds) the block whose included
+attestations would justify the current epoch; honest proposers later
+re-include those same attestations.  The pull-up logic must credit the
+justification to the store while the honest chain keeps (or regains)
+the head — the withheld reveal must not win fork choice durably.
+"""
+from ...ssz import hash_tree_root, uint64
+from ...test_infra.context import (
+    spec_state_test, with_all_phases_from, with_presets,
+    with_pytest_fork_subset, never_bls)
+from ...test_infra.attestations import state_transition_with_full_block
+from ...test_infra.blocks import (
+    build_empty_block_for_next_slot, next_epoch,
+    state_transition_and_sign_block)
+from ...test_infra.fork_choice import (
+    start_fork_choice_test, tick_and_add_block,
+    apply_next_epoch_with_attestations, find_next_justifying_slot,
+    on_tick_and_append_step, output_store_checks, emit_steps,
+    get_head_root, tick_to_state_slot)
+
+WITHHOLD_FORKS = ["altair", "electra"]
+
+
+def _setup_through_epoch_4(spec, state, store, steps):
+    """Common prologue: epochs 1-3 fully attested, JC at 3."""
+    parts = []
+    next_epoch(spec, state)
+    tick_to_state_slot(spec, store, state, steps)
+    for _ in range(3):
+        more, _ = apply_next_epoch_with_attestations(
+            spec, state, store, steps, fill_cur_epoch=True,
+            fill_prev_epoch=True)
+        parts.extend(more)
+    assert int(store.justified_checkpoint.epoch) == 3
+    return parts
+
+
+def _build_withheld_chain(spec, state, store, steps):
+    """Extend the canonical chain up to (but not including) the block
+    that would justify the current epoch; return (parts, withheld)."""
+    parts = []
+    signed_blocks, justifying_slot = find_next_justifying_slot(
+        spec, state, True, False)
+    assert int(spec.compute_epoch_at_slot(uint64(justifying_slot))) \
+        == int(spec.get_current_epoch(state))
+    assert len(signed_blocks) > 1
+    withheld = signed_blocks[-1]
+    for signed_block in signed_blocks[:-1]:
+        parts.extend(tick_and_add_block(spec, store, signed_block, steps))
+        assert get_head_root(spec, store) == hash_tree_root(signed_block.message)
+    return parts, withheld
+
+
+def _honest_chain_with_attack_votes(spec, state, store, steps, withheld):
+    """Two fully-attested honest blocks in the next epoch, then one that
+    re-includes the withheld block's justifying attestations."""
+    parts = []
+    next_epoch(spec, state)
+    for _ in range(2):
+        signed_block = state_transition_with_full_block(
+            spec, state, True, False)
+        parts.extend(tick_and_add_block(spec, store, signed_block, steps))
+    honest_block = build_empty_block_for_next_slot(spec, state)
+    honest_block.body.attestations = withheld.message.body.attestations
+    signed_honest = state_transition_and_sign_block(
+        spec, state, honest_block)
+    parts.extend(tick_and_add_block(spec, store, signed_honest, steps))
+    # past the proposer-boost window
+    on_tick_and_append_step(
+        spec, store,
+        int(store.genesis_time)
+        + (int(honest_block.slot) + 1)
+        * int(spec.config.SECONDS_PER_SLOT), steps)
+    return parts, signed_honest
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(WITHHOLD_FORKS)
+@with_presets(["minimal"], reason="too slow")
+@spec_state_test
+@never_bls
+def test_withholding_attack(spec, state):
+    """Reveal in epoch 5 of a block withheld in epoch 4: the honest
+    block holds the head both at reveal and into the next epoch; the
+    pull-up still credits the justification."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    tick_to_state_slot(spec, store, state, steps)
+    for name, v in _setup_through_epoch_4(spec, state, store, steps):
+        yield name, v
+    assert int(spec.compute_epoch_at_slot(
+        spec.get_current_slot(store))) == 4
+
+    more, withheld = _build_withheld_chain(spec, state, store, steps)
+    for name, v in more:
+        yield name, v
+    state = store.block_states[get_head_root(spec, store)].copy()
+    assert int(spec.compute_epoch_at_slot(state.slot)) == 4
+    assert int(store.justified_checkpoint.epoch) == 3
+
+    more, signed_honest = _honest_chain_with_attack_votes(
+        spec, state, store, steps, withheld)
+    for name, v in more:
+        yield name, v
+    honest_root = hash_tree_root(signed_honest.message)
+    assert get_head_root(spec, store) == honest_root
+    assert int(store.justified_checkpoint.epoch) == 3
+
+    # reveal: honest chain keeps the head; pull-up bumps JC to 4
+    for name, v in tick_and_add_block(spec, store, withheld, steps):
+        yield name, v
+    assert get_head_root(spec, store) == honest_root
+    assert int(store.justified_checkpoint.epoch) == 4
+
+    # next epoch: head unchanged
+    slot = (int(spec.get_current_slot(store)) + int(spec.SLOTS_PER_EPOCH)
+            - int(state.slot) % int(spec.SLOTS_PER_EPOCH))
+    on_tick_and_append_step(
+        spec, store,
+        int(store.genesis_time)
+        + slot * int(spec.config.SECONDS_PER_SLOT), steps)
+    assert int(spec.compute_epoch_at_slot(
+        spec.get_current_slot(store))) == 6
+    assert get_head_root(spec, store) == honest_root
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(WITHHOLD_FORKS)
+@with_presets(["minimal"], reason="too slow")
+@spec_state_test
+@never_bls
+def test_withholding_attack_unviable_honest_chain(spec, state):
+    """With an empty epoch 4 the honest chain's voting source (3) goes
+    stale: the reveal DOES take the head for one epoch, until the
+    boundary restores the honest block."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    tick_to_state_slot(spec, store, state, steps)
+    for name, v in _setup_through_epoch_4(spec, state, store, steps):
+        yield name, v
+
+    # skip epoch 4 entirely: nothing attests, JC stays 3
+    next_epoch(spec, state)
+    assert int(spec.compute_epoch_at_slot(state.slot)) == 5
+
+    more, withheld = _build_withheld_chain(spec, state, store, steps)
+    for name, v in more:
+        yield name, v
+    state = store.block_states[get_head_root(spec, store)].copy()
+    assert int(spec.compute_epoch_at_slot(state.slot)) == 5
+    assert int(store.justified_checkpoint.epoch) == 3
+
+    more, signed_honest = _honest_chain_with_attack_votes(
+        spec, state, store, steps, withheld)
+    for name, v in more:
+        yield name, v
+    honest_root = hash_tree_root(signed_honest.message)
+    assert int(spec.compute_epoch_at_slot(
+        spec.get_current_slot(store))) == 6
+    assert get_head_root(spec, store) == honest_root
+    assert int(store.justified_checkpoint.epoch) == 3
+
+    # reveal: attack block IS the head this time (honest source stale)
+    for name, v in tick_and_add_block(spec, store, withheld, steps):
+        yield name, v
+    assert int(store.justified_checkpoint.epoch) == 5
+    assert get_head_root(spec, store) == hash_tree_root(withheld.message)
+
+    # next epoch: honest block re-qualifies and takes the head back
+    slot = (int(spec.get_current_slot(store)) + int(spec.SLOTS_PER_EPOCH)
+            - int(state.slot) % int(spec.SLOTS_PER_EPOCH))
+    on_tick_and_append_step(
+        spec, store,
+        int(store.genesis_time)
+        + slot * int(spec.config.SECONDS_PER_SLOT), steps)
+    assert int(spec.compute_epoch_at_slot(
+        spec.get_current_slot(store))) == 7
+    assert get_head_root(spec, store) == honest_root
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)

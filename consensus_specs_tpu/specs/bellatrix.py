@@ -187,6 +187,13 @@ class BellatrixSpec(OptimisticSync, AltairSpec):
         return is_total_difficulty_reached \
             and is_parent_total_difficulty_valid
 
+    def validate_merge_transition_block(self, pre_state, block) -> None:
+        """on_block hook (bellatrix/fork-choice.md): the first block
+        carrying an execution payload must descend from a valid
+        terminal PoW block."""
+        if self.is_merge_transition_block(pre_state, block.body):
+            self.validate_merge_block(block)
+
     def validate_merge_block(self, block) -> None:
         terminal_hash = bytes.fromhex(
             str(self.config.TERMINAL_BLOCK_HASH)[2:])

@@ -273,8 +273,13 @@ class Phase0ForkChoice:
 
         self.check_block_data_availability(store, signed_block)
 
-        state = store.block_states[block.parent_root].copy()
+        pre_state = store.block_states[block.parent_root]
+        state = pre_state.copy()
         self.state_transition(state, signed_block, True)
+
+        # [New in Bellatrix] merge-transition validation hook — no-op
+        # before the merge fork (bellatrix/fork-choice.md on_block)
+        self.validate_merge_transition_block(pre_state, block)
 
         block_root = hash_tree_root(block)
         store.blocks[block_root] = block
@@ -298,6 +303,10 @@ class Phase0ForkChoice:
 
     def check_block_data_availability(self, store, signed_block) -> None:
         """Phase0: nothing to check (deneb overrides for blob DA)."""
+
+    def validate_merge_transition_block(self, pre_state, block) -> None:
+        """Phase0/altair: nothing to validate (bellatrix overrides with
+        the TTD terminal-pow-block check, bellatrix/fork-choice.md)."""
 
     def validate_target_epoch_against_current_time(self, store,
                                                    attestation) -> None:

@@ -11,10 +11,13 @@ from .keys import privkey_for_pubkey
 from .blocks import build_empty_block_for_next_slot
 
 
-def build_attestation_data(spec, state, slot, index):
+def build_attestation_data(spec, state, slot, index,
+                           beacon_block_root=None):
     assert state.slot >= slot
 
-    if slot == state.slot:
+    if beacon_block_root is not None:
+        pass  # explicit LMD vote (e.g. voting the parent over the head)
+    elif slot == state.slot:
         beacon_block_root = build_empty_block_for_next_slot(
             spec, state).parent_root
     else:
@@ -64,7 +67,8 @@ def sign_attestation(spec, state, attestation) -> None:
 
 
 def get_valid_attestation(spec, state, slot=None, index=None,
-                          filter_participant_set=None, signed=False):
+                          filter_participant_set=None, signed=False,
+                          beacon_block_root=None):
     # No slot/index implies the current slot's first committee
     if slot is None:
         slot = state.slot
@@ -73,11 +77,13 @@ def get_valid_attestation(spec, state, slot=None, index=None,
 
     if spec.is_post("electra"):
         # EIP-7549: committee index moves to committee_bits; data.index == 0
-        attestation_data = build_attestation_data(spec, state, slot, 0)
+        attestation_data = build_attestation_data(
+            spec, state, slot, 0, beacon_block_root=beacon_block_root)
         committee = spec.get_beacon_committee(
             state, attestation_data.slot, index)
     else:
-        attestation_data = build_attestation_data(spec, state, slot, index)
+        attestation_data = build_attestation_data(
+            spec, state, slot, index, beacon_block_root=beacon_block_root)
         committee = spec.get_beacon_committee(
             state, attestation_data.slot, attestation_data.index)
 
@@ -115,7 +121,8 @@ def get_empty_eip7549_aggregation_bits(spec, state, committee_bits, slot):
 
 
 def get_valid_attestations_at_slot(state, spec, slot_to_attest,
-                                   participation_fn=None):
+                                   participation_fn=None,
+                                   beacon_block_root=None):
     """One signed single-committee attestation per committee of the slot."""
     epoch = spec.compute_epoch_at_slot(slot_to_attest)
     committees_per_slot = spec.get_committee_count_per_slot(state, epoch)
@@ -126,7 +133,8 @@ def get_valid_attestations_at_slot(state, spec, slot_to_attest,
             return participation_fn(slot_to_attest, index, comm)
         yield get_valid_attestation(
             spec, state, slot_to_attest, index=index,
-            filter_participant_set=participants_filter, signed=True)
+            filter_participant_set=participants_filter, signed=True,
+            beacon_block_root=beacon_block_root)
 
 
 def get_valid_attestation_at_slot(state, spec, slot_to_attest,
@@ -155,6 +163,50 @@ def add_attestations_to_state(spec, state, attestations, slot) -> None:
     transition_to(spec, state, slot)
     for attestation in attestations:
         spec.process_attestation(state, attestation)
+
+
+def add_valid_attestations_to_block(spec, state, block, slot_to_attest,
+                                    participation_fn=None) -> None:
+    """Attach every committee's attestation for `slot_to_attest` to the
+    block — one on-chain aggregate post-electra, per-committee otherwise
+    (reference helpers/attestations.py::_add_valid_attestations)."""
+    if spec.is_post("electra"):
+        block.body.attestations.append(get_valid_attestation_at_slot(
+            state, spec, slot_to_attest, participation_fn))
+    else:
+        for attestation in get_valid_attestations_at_slot(
+                state, spec, slot_to_attest, participation_fn):
+            block.body.attestations.append(attestation)
+
+
+def state_transition_with_full_block(spec, state, fill_cur_epoch,
+                                     fill_prev_epoch,
+                                     participation_fn=None,
+                                     sync_aggregate=None, block=None):
+    """Build + apply ONE block carrying the attestations for the
+    current and/or previous epoch's computed attesting slot (reference
+    helpers/attestations.py:306).  Returns the signed block."""
+    from .blocks import build_empty_block_for_next_slot, \
+        state_transition_and_sign_block
+    if block is None:
+        block = build_empty_block_for_next_slot(spec, state)
+    if fill_cur_epoch and \
+            state.slot >= spec.MIN_ATTESTATION_INCLUSION_DELAY:
+        slot_to_attest = uint64(
+            state.slot - spec.MIN_ATTESTATION_INCLUSION_DELAY + 1)
+        if slot_to_attest >= spec.compute_start_slot_at_epoch(
+                spec.get_current_epoch(state)):
+            add_valid_attestations_to_block(
+                spec, state, block, slot_to_attest,
+                participation_fn=participation_fn)
+    if fill_prev_epoch and state.slot >= spec.SLOTS_PER_EPOCH:
+        slot_to_attest = uint64(state.slot - spec.SLOTS_PER_EPOCH + 1)
+        add_valid_attestations_to_block(
+            spec, state, block, slot_to_attest,
+            participation_fn=participation_fn)
+    if sync_aggregate is not None:
+        block.body.sync_aggregate = sync_aggregate
+    return state_transition_and_sign_block(spec, state, block)
 
 
 def next_epoch_with_attestations(spec, state, fill_cur_epoch: bool,

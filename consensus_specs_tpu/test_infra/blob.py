@@ -1,0 +1,57 @@
+"""Blob test infra (deneb+): sample blobs with real KZG artifacts and
+the retrieval monkeypatch driving blob data availability (reference
+helpers/blob.py + helpers/fork_choice.py::with_blob_data)."""
+from __future__ import annotations
+
+import contextlib
+from random import Random
+
+
+def get_sample_blob(spec, rng=None):
+    """A mostly-sparse blob (valid field elements; sparse keeps the
+    pure-Python KZG oracle fast while remaining non-trivial)."""
+    rng = rng or Random(5566)
+    n = int(spec.FIELD_ELEMENTS_PER_BLOB)
+    values = [0] * n
+    for _ in range(4):
+        values[rng.randrange(n)] = rng.randrange(
+            int(spec.BLS_MODULUS))
+    return b"".join(v.to_bytes(32, "big") for v in values)
+
+
+def get_sample_blob_tx(spec, blob_count=1, rng=None):
+    """(opaque_tx, blobs, commitments, proofs) — the transaction bytes
+    are opaque to the consensus layer (noop engine); the KZG artifacts
+    are real and verify against the baked trusted setup."""
+    rng = rng or Random(5566)
+    blobs, commitments, proofs = [], [], []
+    for _ in range(blob_count):
+        blob = get_sample_blob(spec, rng=rng)
+        commitment = spec.blob_to_kzg_commitment(blob)
+        proofs.append(spec.compute_blob_kzg_proof(blob, commitment))
+        blobs.append(blob)
+        commitments.append(spec.KZGCommitment(bytes(commitment)))
+    opaque_tx = bytes([0x03]) + bytes(
+        rng.getrandbits(8) for _ in range(31))
+    return opaque_tx, blobs, commitments, proofs
+
+
+class BlobData:
+    """The sidecar data a node 'retrieved' for a block."""
+
+    def __init__(self, blobs, proofs):
+        self.blobs = list(blobs)
+        self.proofs = list(proofs)
+
+
+@contextlib.contextmanager
+def blob_data_patch(spec, blob_data: BlobData):
+    """Route spec.retrieve_blobs_and_proofs to `blob_data` for the
+    duration (spec instances are cached across tests — restore)."""
+    try:
+        # instance attribute shadows the class-level stub
+        spec.retrieve_blobs_and_proofs = \
+            lambda beacon_block_root: (blob_data.blobs, blob_data.proofs)
+        yield
+    finally:
+        del spec.retrieve_blobs_and_proofs

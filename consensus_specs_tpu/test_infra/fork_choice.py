@@ -37,6 +37,20 @@ def on_tick_and_append_step(spec, store, time, steps) -> None:
     steps.append({"tick": int(time)})
 
 
+def get_head_root(spec, store):
+    """get_head as a root (eip7732 returns a ChildNode; unwrap)."""
+    head = spec.get_head(store)
+    return getattr(head, "root", head)
+
+
+def tick_to_state_slot(spec, store, state, steps) -> None:
+    """Tick the store to the wall-clock time of `state`'s slot."""
+    on_tick_and_append_step(
+        spec, store,
+        int(store.genesis_time)
+        + int(state.slot) * int(spec.config.SECONDS_PER_SLOT), steps)
+
+
 def tick_to_slot(spec, store, slot, steps) -> None:
     time = (int(store.genesis_time)
             + int(slot) * int(spec.config.SECONDS_PER_SLOT))
@@ -145,6 +159,83 @@ def apply_next_epoch_with_attestations(spec, state, store, steps,
         parts.extend(
             tick_and_add_block(spec, store, signed_block, steps))
     return parts, signed_blocks
+
+
+def tick_and_run_on_attestation(spec, store, attestation, steps,
+                                is_from_block=False):
+    """Tick past the attestation's slot if needed, then apply it."""
+    min_time = (int(store.genesis_time)
+                + (int(attestation.data.slot) + 1)
+                * int(spec.config.SECONDS_PER_SLOT))
+    if int(store.time) < min_time:
+        on_tick_and_append_step(spec, store, min_time, steps)
+    root = hash_tree_root(attestation)
+    name = f"attestation_{root.hex()[:16]}"
+    spec.on_attestation(store, attestation, is_from_block=is_from_block)
+    steps.append({"attestation": name})
+    return [(name, attestation)]
+
+
+def apply_next_slots_with_attestations(spec, state, store, slots, steps,
+                                       fill_cur_epoch=True,
+                                       fill_prev_epoch=False):
+    """Advance `slots` slots with attestation-filled blocks fed through
+    the store (reference helpers/fork_choice.py::
+    apply_next_slots_with_attestations).  Returns (parts, last_block)."""
+    from .attestations import state_transition_with_full_block
+    parts = []
+    last_signed = None
+    for _ in range(slots):
+        last_signed = state_transition_with_full_block(
+            spec, state, fill_cur_epoch, fill_prev_epoch)
+        parts.extend(
+            tick_and_add_block(spec, store, last_signed, steps))
+    return parts, last_signed
+
+
+def add_pow_block(spec, store, pow_block, steps):
+    """Record a PoW-chain block artifact (fork_choice format
+    'pow_block' step).  The block is made visible to get_pow_block via
+    test_infra.pow_block.pow_chain_patch."""
+    name = f"pow_block_{bytes(pow_block.block_hash).hex()}"
+    steps.append({"pow_block": name})
+    return [(name, pow_block)]
+
+
+def add_attestations(spec, store, attestations, steps, valid=True):
+    """Apply a batch of attestations; returns the artifacts to yield."""
+    parts = []
+    for attestation in attestations:
+        parts.extend(
+            add_attestation(spec, store, attestation, steps, valid=valid))
+    return parts
+
+
+def is_ready_to_justify(spec, state) -> bool:
+    """Would the epoch-boundary justification pass bump the justified
+    checkpoint, given the votes already in `state`?  (reference
+    helpers/fork_choice.py:349)."""
+    temp = state.copy()
+    spec.process_justification_and_finalization(temp)
+    return int(temp.current_justified_checkpoint.epoch) \
+        > int(state.current_justified_checkpoint.epoch)
+
+
+def find_next_justifying_slot(spec, state, fill_cur_epoch,
+                              fill_prev_epoch, participation_fn=None):
+    """Extend a throwaway copy of `state` with attestation-filled blocks
+    until the pending votes suffice to justify at the next boundary
+    (reference helpers/fork_choice.py:358).  Returns (signed_blocks,
+    justifying_slot)."""
+    from .attestations import state_transition_with_full_block
+    temp = state.copy()
+    signed_blocks = []
+    while True:
+        signed_blocks.append(state_transition_with_full_block(
+            spec, temp, fill_cur_epoch, fill_prev_epoch,
+            participation_fn))
+        if is_ready_to_justify(spec, temp):
+            return signed_blocks, int(temp.slot)
 
 
 def output_store_checks(spec, store, steps) -> None:

@@ -1,0 +1,54 @@
+"""Merge-transition test infra: stub PoW chain views and pre-merge
+states (reference helpers/pow_block.py + helpers/execution_payload.py
+:360 build_state_with_incomplete_transition)."""
+from __future__ import annotations
+
+import contextlib
+from random import Random
+
+from ..ssz import hash_tree_root
+
+
+def prepare_random_pow_block(spec, rng):
+    """A PowBlock with random hashes and zero difficulty fields —
+    callers set total_difficulty around the TTD as the case needs.
+
+    `rng` is required and must be ONE per-case Random instance shared
+    by all of a case's blocks: per-case seeding keeps emitted vectors
+    identical between full and incremental generator runs, while the
+    shared stream keeps successive hashes distinct."""
+    return spec.PowBlock(
+        block_hash=bytes(rng.getrandbits(8) for _ in range(32)),
+        parent_hash=bytes(rng.getrandbits(8) for _ in range(32)),
+        total_difficulty=0)
+
+
+@contextlib.contextmanager
+def pow_chain_patch(spec, pow_blocks):
+    """Expose `pow_blocks` through spec.get_pow_block for the duration
+    of the test (spec instances are cached across tests — restore)."""
+    saved = dict(spec.pow_chain)
+    try:
+        for block in pow_blocks:
+            spec.pow_chain[bytes(block.block_hash)] = block
+        yield
+    finally:
+        spec.pow_chain.clear()
+        spec.pow_chain.update(saved)
+
+
+def build_state_with_incomplete_transition(spec, state):
+    """Zero the latest execution payload header: the merge has not
+    happened yet from this state's point of view."""
+    state = state.copy()
+    state.latest_execution_payload_header = spec.ExecutionPayloadHeader()
+    return state
+
+
+def recompute_payload_block_hash(spec, payload) -> None:
+    """Re-derive the deterministic fake block hash after mutating
+    payload fields (same convention as
+    blocks.build_empty_execution_payload)."""
+    payload.block_hash = b"\x00" * 32
+    payload.block_hash = spec.hash(
+        bytes(hash_tree_root(payload)) + b"FAKE RLP HASH")

@@ -103,6 +103,7 @@ def main() -> None:
         except (json.JSONDecodeError, OSError):
             banked = {}
     n_probe = 0
+    all_banked_logged = False
     while True:
         ok, elapsed, rc = probe()
         n_probe += 1
@@ -126,8 +127,10 @@ def main() -> None:
                         json.dump({"provenance":
                                    "relay_watch banked on live probe",
                                    "tiers": banked}, f, indent=1)
-            if all(t in banked for t, _ in TIER_BUDGETS):
+            if not all_banked_logged and \
+                    all(t in banked for t, _ in TIER_BUDGETS):
                 _log({"event": "all_banked"})
+                all_banked_logged = True
                 # keep probing (cheap) so the log still shows relay
                 # health for the rest of the round
         time.sleep(INTERVAL)

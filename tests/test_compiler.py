@@ -180,3 +180,14 @@ def test_custom_type_cell_is_gated(payload):
 def test_custom_type_cell_allows_type_grammar():
     mod, _ = build_spec([_md_with_custom_type("ByteVector[4 * 8]")])
     assert mod.EvilType(b"\x00" * 32) is not None
+
+
+@pytest.mark.parametrize("payload", [
+    # sequence repetition multiplies sizes — int bounds don't apply
+    "('a' * 65000) * 65000 * 65000",
+    "(1, 2) * 65000 * 65000",
+    "'a' + 'b' * 65000",
+])
+def test_sequence_arithmetic_is_rejected(payload):
+    with pytest.raises(ValueError):
+        build_spec([_md_with_constant(payload)])
