@@ -88,18 +88,33 @@ _CALLEE_BITS = {
 }
 
 
+# names/callees whose byte-typedness is tracked per build: the thread-
+# local is set by emit_source so the recursive bound helpers (and the
+# cells that reference earlier constants) see the same type knowledge
+# without threading a parameter through every arithmetic case
+_BYTE_NAMES: set = set()
+
+
+def _is_byte_callee(name: str) -> bool:
+    return name.startswith(("Bytes", "ByteVector", "ByteList")) \
+        or name in _BYTE_NAMES
+
+
 def _may_be_sequence(node) -> bool:
-    """Could this subtree evaluate to a str/bytes/tuple/list?  Names
-    are spec constants (ints); uintN casts are ints; BytesN/ByteVector/
-    ByteList casts and literal sequences are sequences; arithmetic
-    propagates from its operands."""
+    """Could this subtree evaluate to a str/bytes/tuple/list?  Uses the
+    build's type knowledge (_BYTE_NAMES): a Name bound to a Bytes-typed
+    constant (GENESIS_FORK_VERSION) or a call through a byte-typed
+    custom type (Root('0x…')) is a sequence — repeating one multiplies
+    its size, so the integer Mult bound must not apply to it."""
     if isinstance(node, ast.Constant):
         return not isinstance(node.value, (int, bool))
     if isinstance(node, (ast.Tuple, ast.List)):
         return True
+    if isinstance(node, ast.Name):
+        return node.id in _BYTE_NAMES
     if isinstance(node, ast.Call):
         callee = node.func.id if isinstance(node.func, ast.Name) else ""
-        return callee.startswith(("Bytes", "ByteVector", "ByteList"))
+        return _is_byte_callee(callee)
     if isinstance(node, ast.BinOp):
         return _may_be_sequence(node.left) or _may_be_sequence(node.right)
     if isinstance(node, ast.UnaryOp):
@@ -122,7 +137,10 @@ def _bit_bound(node) -> int:
             return max(int(node.value).bit_length(), 1)
         return max(len(str(node.value)) * 8, 1)
     if isinstance(node, ast.Name):
-        return 256
+        # byte-typed names can be wider than any uint (Bytes96 = 768
+        # bits; string-literal constants unbounded in principle) — use a
+        # bound that still trips the cap after modest repetition
+        return 1024 if node.id in _BYTE_NAMES else 256
     if isinstance(node, ast.Call):
         # Python evaluates every argument (positional AND keyword)
         # before the callee runs, so the evaluation COST must stay
@@ -136,6 +154,8 @@ def _bit_bound(node) -> int:
         callee = node.func.id if isinstance(node.func, ast.Name) else ""
         if callee in _CALLEE_BITS:
             return _CALLEE_BITS[callee]
+        if callee in _BYTE_NAMES:
+            return 1024  # byte-typed custom type of statically unknown width
         return max(arg_bits + [256])
     if isinstance(node, ast.Subscript):
         # type expressions: List[X, N * M] — bound the index cost
@@ -273,6 +293,57 @@ def _const_rhs(expr: str,
     return repr(value)
 
 
+def _collect_byte_names(spec) -> set:
+    """Names this build binds to byte/string values: custom types that
+    resolve (transitively) to Bytes*/ByteVector/ByteList, plus
+    constants whose cell is a string literal, a byte-typed cast, or a
+    reference/concatenation of other byte names.  Fixpoint because
+    constants reference each other."""
+    byte_names: set = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, texpr in spec.custom_types.items():
+            if name in byte_names:
+                continue
+            root = texpr.split("[")[0].strip()
+            if root.startswith(("Bytes", "ByteVector", "ByteList")) \
+                    or root in byte_names:
+                byte_names.add(name)
+                changed = True
+        for name, expr in {**spec.preset_vars,
+                           **spec.constants}.items():
+            if name in byte_names:
+                continue
+            cell = str(expr).strip().strip("`")
+            try:
+                body = ast.parse(cell, mode="eval").body
+            except SyntaxError:
+                continue
+            seq = (isinstance(body, ast.Constant)
+                   and isinstance(body.value, (str, bytes)))
+            if isinstance(body, ast.Call) \
+                    and isinstance(body.func, ast.Name):
+                callee = body.func.id
+                seq = callee.startswith(
+                    ("Bytes", "ByteVector", "ByteList")) \
+                    or callee in byte_names
+            if isinstance(body, (ast.Name, ast.BinOp)):
+                # alias of / arithmetic over byte names
+                prev = set(_BYTE_NAMES)
+                _BYTE_NAMES.clear()
+                _BYTE_NAMES.update(byte_names)
+                try:
+                    seq = _may_be_sequence(body)
+                finally:
+                    _BYTE_NAMES.clear()
+                    _BYTE_NAMES.update(prev)
+            if seq:
+                byte_names.add(name)
+                changed = True
+    return byte_names
+
+
 def _dependency_order(defs: dict) -> list:
     """Order name->rhs definitions so referenced names precede their
     users; ties keep input order, unresolvable cycles fall back to input
@@ -360,22 +431,31 @@ def emit_source(spec: ParsedSpec, preset: dict | None = None,
     # legitimate cast targets in constant cells; prelude-defined names
     # are trusted repo code (fork builders), not markdown
     cell_callees = frozenset(spec.custom_types) | frozenset(prelude_names)
-    scalars: dict[str, str] = {}
-    for name, expr in spec.preset_vars.items():
-        if name not in prelude_names:
-            scalars[name] = (repr(preset[name]) if name in preset
-                             else _const_rhs(expr, cell_callees))
-    for name, type_expr in spec.custom_types.items():
-        _check_safe_type_expr(type_expr)
-        scalars[name] = type_expr
-    for name, expr in spec.constants.items():
-        if name in prelude_names:
-            continue
-        if expr.strip().rstrip("*") in ("TBD", "N/A"):
-            # draft placeholder (e.g. whisk's CURDLEPROOFS_CRS) — a
-            # definition must come from extra_scalars or the prelude
-            continue
-        scalars[name] = _const_rhs(expr, cell_callees)
+    # type knowledge for the repetition guard: which names hold BYTES
+    # (repeating those multiplies size — see _may_be_sequence)
+    saved_byte_names = set(_BYTE_NAMES)
+    _BYTE_NAMES.clear()
+    _BYTE_NAMES.update(_collect_byte_names(spec))
+    try:
+        scalars: dict[str, str] = {}
+        for name, expr in spec.preset_vars.items():
+            if name not in prelude_names:
+                scalars[name] = (repr(preset[name]) if name in preset
+                                 else _const_rhs(expr, cell_callees))
+        for name, type_expr in spec.custom_types.items():
+            _check_safe_type_expr(type_expr)
+            scalars[name] = type_expr
+        for name, expr in spec.constants.items():
+            if name in prelude_names:
+                continue
+            if expr.strip().rstrip("*") in ("TBD", "N/A"):
+                # draft placeholder (e.g. whisk's CURDLEPROOFS_CRS) — a
+                # definition must come from extra_scalars or the prelude
+                continue
+            scalars[name] = _const_rhs(expr, cell_callees)
+    finally:
+        _BYTE_NAMES.clear()
+        _BYTE_NAMES.update(saved_byte_names)
     for name, rhs in (extra_scalars or {}).items():
         scalars.setdefault(name, rhs)
 

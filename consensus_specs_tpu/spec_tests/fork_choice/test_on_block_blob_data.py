@@ -19,7 +19,7 @@ from ...test_infra.blob import (
 from ...test_infra.blocks import (
     build_empty_block_for_next_slot, state_transition_and_sign_block)
 from ...test_infra.fork_choice import (
-    start_fork_choice_test, tick_and_add_block, on_tick_and_append_step,
+    start_fork_choice_test, tick_and_add_block,
     output_store_checks, emit_steps,
     get_head_root, tick_to_state_slot)
 
@@ -37,10 +37,7 @@ def _block_with_blob(spec, state, rng):
 
 def _start(spec, state):
     store, steps, parts = start_fork_choice_test(spec, state)
-    on_tick_and_append_step(
-        spec, store,
-        int(store.genesis_time)
-        + int(state.slot) * int(spec.config.SECONDS_PER_SLOT), steps)
+    tick_to_state_slot(spec, store, state, steps)
     return store, steps, parts
 
 

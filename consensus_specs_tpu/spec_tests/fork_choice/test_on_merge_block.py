@@ -17,7 +17,7 @@ from ...test_infra.blocks import (
     state_transition_and_sign_block)
 from ...test_infra.fork_choice import (
     start_fork_choice_test, tick_and_add_block, add_pow_block,
-    on_tick_and_append_step, output_store_checks, emit_steps,
+    output_store_checks, emit_steps,
     get_head_root, tick_to_state_slot)
 from ...test_infra.pow_block import (
     prepare_random_pow_block, pow_chain_patch,
@@ -33,10 +33,7 @@ def _merge_block_test(spec, state, pow_blocks, valid):
     store, steps, parts = start_fork_choice_test(spec, state)
     for name, v in parts:
         yield name, v
-    on_tick_and_append_step(
-        spec, store,
-        int(store.genesis_time)
-        + int(state.slot) * int(spec.config.SECONDS_PER_SLOT), steps)
+    tick_to_state_slot(spec, store, state, steps)
 
     for pb in pow_blocks:
         for name, v in add_pow_block(spec, store, pb, steps):

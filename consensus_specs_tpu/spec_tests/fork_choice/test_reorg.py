@@ -31,9 +31,9 @@ REORG_FORKS = ["altair", "electra"]
 
 
 def _start(spec, state):
-    """Anchor the store and tick to the state's slot."""
+    """Anchor the store and tick to the state's slot (recorded)."""
     store, steps, parts = start_fork_choice_test(spec, state)
-    tick_to_state_slot(spec, store, state, [])
+    tick_to_state_slot(spec, store, state, steps)
     return store, steps, parts
 
 

@@ -1,0 +1,5 @@
+"""Pure-assert unit batteries (reference test/*/unittests/): config
+invariants, helper/validator-duty units, fork-choice handler units.
+These never emit conformance vectors (every test is @no_vectors) — they
+exist to localize constant/helper regressions the trajectory suites can
+only detect, not attribute."""

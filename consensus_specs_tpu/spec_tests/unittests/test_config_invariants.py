@@ -1,0 +1,218 @@
+"""Config/preset invariant units (reference
+test/phase0/unittests/test_config_invariants.py + the altair, deneb,
+electra, fulu and whisk per-fork variants).  Pure asserts over the
+baked constants — no state transitions, no vectors."""
+from ...test_infra.context import (
+    spec_state_test, spec_test, no_vectors, with_all_phases,
+    with_all_phases_from)
+
+UINT64_MAX = 2**64 - 1
+
+
+def _check_bound(value, lower, upper) -> None:
+    assert lower <= value <= upper
+
+
+# ----------------------------------------------------------------------
+# phase0 (reference test_config_invariants.py: 7 defs)
+# ----------------------------------------------------------------------
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+def test_validators(spec, state):
+    _check_bound(spec.VALIDATOR_REGISTRY_LIMIT, 1, UINT64_MAX)
+    _check_bound(spec.MAX_COMMITTEES_PER_SLOT, 1, UINT64_MAX)
+    _check_bound(spec.TARGET_COMMITTEE_SIZE, 1, UINT64_MAX)
+    maximum_validators_per_committee = (
+        spec.VALIDATOR_REGISTRY_LIMIT
+        // spec.SLOTS_PER_EPOCH
+        // spec.MAX_COMMITTEES_PER_SLOT)
+    _check_bound(spec.MAX_VALIDATORS_PER_COMMITTEE, 1,
+                 maximum_validators_per_committee)
+    _check_bound(spec.config.MIN_PER_EPOCH_CHURN_LIMIT, 1,
+                 spec.VALIDATOR_REGISTRY_LIMIT)
+    _check_bound(spec.config.CHURN_LIMIT_QUOTIENT, 1,
+                 spec.VALIDATOR_REGISTRY_LIMIT)
+    _check_bound(spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT,
+                 spec.TARGET_COMMITTEE_SIZE, UINT64_MAX)
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+def test_balances(spec, state):
+    assert spec.MAX_EFFECTIVE_BALANCE \
+        % spec.EFFECTIVE_BALANCE_INCREMENT == 0
+    _check_bound(spec.MIN_DEPOSIT_AMOUNT, 1, UINT64_MAX)
+    _check_bound(spec.MAX_EFFECTIVE_BALANCE, spec.MIN_DEPOSIT_AMOUNT,
+                 UINT64_MAX)
+    _check_bound(spec.MAX_EFFECTIVE_BALANCE,
+                 spec.EFFECTIVE_BALANCE_INCREMENT, UINT64_MAX)
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+def test_hysteresis_quotient(spec, state):
+    _check_bound(spec.HYSTERESIS_QUOTIENT, 1, UINT64_MAX)
+    _check_bound(spec.HYSTERESIS_DOWNWARD_MULTIPLIER, 1,
+                 spec.HYSTERESIS_QUOTIENT)
+    _check_bound(spec.HYSTERESIS_UPWARD_MULTIPLIER,
+                 spec.HYSTERESIS_QUOTIENT, UINT64_MAX)
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+def test_incentives(spec, state):
+    # no ETH is minted in slash_validator
+    if spec.is_post("electra"):
+        assert spec.MIN_SLASHING_PENALTY_QUOTIENT_ELECTRA \
+            <= spec.WHISTLEBLOWER_REWARD_QUOTIENT_ELECTRA
+    elif spec.is_post("bellatrix"):
+        assert spec.MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX \
+            <= spec.WHISTLEBLOWER_REWARD_QUOTIENT
+    elif spec.is_post("altair"):
+        assert spec.MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR \
+            <= spec.WHISTLEBLOWER_REWARD_QUOTIENT
+    else:
+        assert spec.MIN_SLASHING_PENALTY_QUOTIENT \
+            <= spec.WHISTLEBLOWER_REWARD_QUOTIENT
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+def test_time(spec, state):
+    assert spec.SLOTS_PER_EPOCH <= spec.SLOTS_PER_HISTORICAL_ROOT
+    assert spec.MIN_SEED_LOOKAHEAD < spec.MAX_SEED_LOOKAHEAD
+    assert spec.SLOTS_PER_HISTORICAL_ROOT % spec.SLOTS_PER_EPOCH == 0
+    _check_bound(spec.SLOTS_PER_HISTORICAL_ROOT, spec.SLOTS_PER_EPOCH,
+                 UINT64_MAX)
+    _check_bound(spec.MIN_ATTESTATION_INCLUSION_DELAY, 1,
+                 spec.SLOTS_PER_EPOCH)
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+def test_networking(spec, state):
+    assert spec.config.MIN_EPOCHS_FOR_BLOCK_REQUESTS == (
+        spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+        + spec.config.CHURN_LIMIT_QUOTIENT // 2)
+    ceillog2_subnets = (int(spec.config.ATTESTATION_SUBNET_COUNT)
+                        - 1).bit_length()
+    assert spec.config.ATTESTATION_SUBNET_PREFIX_BITS == (
+        ceillog2_subnets + spec.config.ATTESTATION_SUBNET_EXTRA_BITS)
+    assert spec.config.SUBNETS_PER_NODE \
+        <= spec.config.ATTESTATION_SUBNET_COUNT
+    assert spec.NODE_ID_BITS == 256
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+def test_fork_choice(spec, state):
+    assert spec.INTERVALS_PER_SLOT < spec.config.SECONDS_PER_SLOT
+    assert spec.config.PROPOSER_SCORE_BOOST <= 100
+
+
+# ----------------------------------------------------------------------
+# altair (reference test/altair/unittests/test_config_invariants.py)
+# ----------------------------------------------------------------------
+
+@with_all_phases_from("altair")
+@spec_test
+@no_vectors
+def test_weight_denominator(spec):
+    assert (spec.TIMELY_HEAD_WEIGHT + spec.TIMELY_SOURCE_WEIGHT
+            + spec.TIMELY_TARGET_WEIGHT + spec.SYNC_REWARD_WEIGHT
+            + spec.PROPOSER_WEIGHT) == spec.WEIGHT_DENOMINATOR
+
+
+@with_all_phases_from("altair")
+@spec_test
+@no_vectors
+def test_inactivity_score(spec):
+    # leaks must decay no slower than they accrue
+    assert spec.config.INACTIVITY_SCORE_BIAS \
+        <= spec.config.INACTIVITY_SCORE_RECOVERY_RATE \
+        * spec.config.INACTIVITY_SCORE_BIAS
+
+
+# ----------------------------------------------------------------------
+# deneb (reference test/deneb/unittests/test_config_invariants.py)
+# ----------------------------------------------------------------------
+
+@with_all_phases_from("deneb")
+@spec_test
+@no_vectors
+def test_blob_bounds(spec):
+    assert int(spec.config.MAX_BLOBS_PER_BLOCK) \
+        <= int(spec.MAX_BLOB_COMMITMENTS_PER_BLOCK)
+
+
+@with_all_phases_from("deneb")
+@spec_test
+@no_vectors
+def test_blob_fields(spec):
+    assert int(spec.FIELD_ELEMENTS_PER_BLOB) \
+        * int(spec.BYTES_PER_FIELD_ELEMENT) == int(spec.BYTES_PER_BLOB)
+
+
+# ----------------------------------------------------------------------
+# electra (reference test/electra/unittests/test_config_invariants.py)
+# ----------------------------------------------------------------------
+
+@with_all_phases_from("electra")
+@spec_test
+@no_vectors
+def test_electra_churn(spec):
+    assert int(spec.config.MIN_PER_EPOCH_CHURN_LIMIT_ELECTRA) \
+        <= int(spec.config.MAX_PER_EPOCH_ACTIVATION_EXIT_CHURN_LIMIT)
+
+
+@with_all_phases_from("electra")
+@spec_test
+@no_vectors
+def test_electra_balances(spec):
+    assert int(spec.MIN_ACTIVATION_BALANCE) \
+        <= int(spec.MAX_EFFECTIVE_BALANCE_ELECTRA)
+    assert int(spec.MIN_ACTIVATION_BALANCE) \
+        % int(spec.EFFECTIVE_BALANCE_INCREMENT) == 0
+    assert int(spec.MAX_EFFECTIVE_BALANCE_ELECTRA) \
+        % int(spec.EFFECTIVE_BALANCE_INCREMENT) == 0
+
+
+# ----------------------------------------------------------------------
+# fulu (reference test/fulu/unittests/test_config_invariants.py)
+# ----------------------------------------------------------------------
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_custody_groups_bound(spec):
+    assert int(spec.config.CUSTODY_REQUIREMENT) \
+        <= int(spec.config.NUMBER_OF_CUSTODY_GROUPS)
+    assert int(spec.config.NUMBER_OF_CUSTODY_GROUPS) \
+        <= int(spec.config.NUMBER_OF_COLUMNS)
+    assert int(spec.config.NUMBER_OF_COLUMNS) \
+        % int(spec.config.NUMBER_OF_CUSTODY_GROUPS) == 0
+
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_columns_match_cells(spec):
+    # the extended matrix splits evenly into columns
+    assert int(spec.CELLS_PER_EXT_BLOB) \
+        == int(spec.config.NUMBER_OF_COLUMNS)
+
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_sampling_bound(spec):
+    assert int(spec.config.SAMPLES_PER_SLOT) \
+        <= int(spec.config.NUMBER_OF_COLUMNS)

@@ -1,0 +1,25 @@
+"""Math helper units (reference
+test/phase0/unittests/math/test_integer_squareroot.py)."""
+import random
+from math import isqrt
+
+from ...ssz import uint64
+from ...test_infra.context import spec_test, no_vectors, with_all_phases
+
+
+@with_all_phases
+@spec_test
+@no_vectors
+def test_integer_squareroot(spec):
+    for n in (0, 100, 2**64 - 2, 2**64 - 1):
+        assert int(spec.integer_squareroot(uint64(n))) == isqrt(n)
+    rng = random.Random(5566)
+    for _ in range(10):
+        n = rng.randint(0, 2**64 - 1)
+        assert int(spec.integer_squareroot(uint64(n))) == isqrt(n)
+    # out-of-range input is rejected at the type boundary
+    try:
+        spec.integer_squareroot(uint64(2**64))
+        raise AssertionError("uint64 overflow accepted")
+    except ValueError:
+        pass

@@ -1,0 +1,108 @@
+"""Merge-transition predicate units (reference
+test/bellatrix/unittests/test_is_valid_terminal_pow_block.py, 3 defs +
+test_transition.py, 3 defs)."""
+from random import Random
+
+from ...ssz import uint256
+from ...test_infra.context import (
+    spec_state_test, no_vectors, with_all_phases_from, never_bls)
+from ...test_infra.blocks import build_empty_execution_payload
+from ...test_infra.pow_block import (
+    prepare_random_pow_block, build_state_with_complete_transition,
+    build_state_with_incomplete_transition)
+
+
+# --- is_valid_terminal_pow_block ------------------------------------------
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+@no_vectors
+@never_bls
+def test_is_valid_terminal_pow_block_success_valid(spec, state):
+    rng = Random(3131)
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    parent_block = prepare_random_pow_block(spec, rng)
+    parent_block.total_difficulty = uint256(ttd - 1)
+    block = prepare_random_pow_block(spec, rng)
+    block.parent_hash = parent_block.block_hash
+    block.total_difficulty = uint256(ttd)
+    assert spec.is_valid_terminal_pow_block(block, parent_block)
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+@no_vectors
+@never_bls
+def test_is_valid_terminal_pow_block_fail_before_terminal(spec, state):
+    rng = Random(3131)
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    parent_block = prepare_random_pow_block(spec, rng)
+    parent_block.total_difficulty = uint256(ttd - 2)
+    block = prepare_random_pow_block(spec, rng)
+    block.parent_hash = parent_block.block_hash
+    block.total_difficulty = uint256(ttd - 1)
+    assert not spec.is_valid_terminal_pow_block(block, parent_block)
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+@no_vectors
+@never_bls
+def test_is_valid_terminal_pow_block_fail_just_after_terminal(spec, state):
+    rng = Random(3131)
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    parent_block = prepare_random_pow_block(spec, rng)
+    parent_block.total_difficulty = uint256(ttd)
+    block = prepare_random_pow_block(spec, rng)
+    block.parent_hash = parent_block.block_hash
+    block.total_difficulty = uint256(ttd + 1)
+    assert not spec.is_valid_terminal_pow_block(block, parent_block)
+
+
+# --- is_merge_transition_complete / _block / is_execution_enabled ---------
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+@no_vectors
+@never_bls
+def test_fail_merge_complete(spec, state):
+    state = build_state_with_incomplete_transition(spec, state)
+    assert not spec.is_merge_transition_complete(state)
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+@no_vectors
+@never_bls
+def test_success_merge_complete(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    assert spec.is_merge_transition_complete(state)
+
+
+# (complete_transition, with_payload) -> (is_merge_block, exec_enabled)
+EXPECTED = [
+    (True, True, False, True),
+    (True, False, False, True),
+    (False, True, True, True),
+    (False, False, False, False),
+]
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+@no_vectors
+@never_bls
+def test_is_merge_block_and_is_execution_enabled(spec, state):
+    for (complete, with_payload, is_merge_block, enabled) in EXPECTED:
+        if complete:
+            case_state = build_state_with_complete_transition(spec, state)
+        else:
+            case_state = build_state_with_incomplete_transition(spec,
+                                                                state)
+        body = spec.BeaconBlockBody()
+        if with_payload:
+            body.execution_payload = build_empty_execution_payload(
+                spec, case_state)
+        assert spec.is_merge_transition_block(case_state, body) \
+            == is_merge_block
+        assert spec.is_execution_enabled(case_state, body) == enabled

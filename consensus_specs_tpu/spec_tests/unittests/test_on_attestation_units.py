@@ -1,0 +1,320 @@
+"""on_attestation unit battery (reference
+test/phase0/unittests/fork_choice/test_on_attestation.py, 13 defs):
+latest-message bookkeeping plus every rejection path of
+validate_on_attestation, asserted directly on the store."""
+from ...ssz import hash_tree_root
+from ...test_infra.context import (
+    spec_state_test, no_vectors, with_all_phases, never_bls)
+from ...test_infra.attestations import (
+    get_valid_attestation, sign_attestation)
+from ...test_infra.blocks import (
+    build_empty_block_for_next_slot, next_epoch, next_slot,
+    state_transition_and_sign_block, transition_to)
+from ...test_infra.fork_choice import get_genesis_forkchoice_store
+
+
+def _run_on_attestation(spec, state, store, attestation, valid=True):
+    if not valid:
+        try:
+            spec.on_attestation(store, attestation)
+        except (AssertionError, KeyError, ValueError, IndexError):
+            return
+        raise AssertionError("attestation unexpectedly valid")
+    indexed = spec.get_indexed_attestation(state, attestation)
+    spec.on_attestation(store, attestation)
+    sample_index = indexed.attesting_indices[0]
+    latest = store.latest_messages[sample_index]
+    assert int(latest.epoch) == int(attestation.data.target.epoch)
+    assert latest.root == attestation.data.beacon_block_root
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+@never_bls
+def test_on_attestation_current_epoch(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store,
+                 int(store.time) + int(spec.config.SECONDS_PER_SLOT) * 2)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    spec.on_block(store, signed_block)
+    attestation = get_valid_attestation(spec, state, slot=block.slot,
+                                        signed=True)
+    assert int(attestation.data.target.epoch) == int(spec.GENESIS_EPOCH)
+    _run_on_attestation(spec, state, store, attestation)
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+@never_bls
+def test_on_attestation_previous_epoch(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store,
+                 int(store.time) + int(spec.config.SECONDS_PER_SLOT)
+                 * int(spec.SLOTS_PER_EPOCH))
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    spec.on_block(store, signed_block)
+    attestation = get_valid_attestation(spec, state, slot=block.slot,
+                                        signed=True)
+    assert int(attestation.data.target.epoch) == int(spec.GENESIS_EPOCH)
+    assert int(spec.compute_epoch_at_slot(
+        spec.get_current_slot(store))) == int(spec.GENESIS_EPOCH) + 1
+    _run_on_attestation(spec, state, store, attestation)
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+@never_bls
+def test_on_attestation_past_epoch(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store,
+                 int(store.time) + 2 * int(spec.config.SECONDS_PER_SLOT)
+                 * int(spec.SLOTS_PER_EPOCH))
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    spec.on_block(store, signed_block)
+    attestation = get_valid_attestation(spec, state, slot=state.slot,
+                                        signed=True)
+    assert int(attestation.data.target.epoch) == int(spec.GENESIS_EPOCH)
+    _run_on_attestation(spec, state, store, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+@never_bls
+def test_on_attestation_mismatched_target_and_slot(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store,
+                 int(store.time) + int(spec.config.SECONDS_PER_SLOT)
+                 * int(spec.SLOTS_PER_EPOCH))
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    spec.on_block(store, signed_block)
+    attestation = get_valid_attestation(spec, state, slot=block.slot)
+    attestation.data.target.epoch += 1
+    sign_attestation(spec, state, attestation)
+    _run_on_attestation(spec, state, store, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+@never_bls
+def test_on_attestation_inconsistent_target_and_head(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store,
+                 int(store.time) + 2 * int(spec.config.SECONDS_PER_SLOT)
+                 * int(spec.SLOTS_PER_EPOCH))
+
+    # chain 1: empty through epoch 1
+    target_state_1 = state.copy()
+    next_epoch(spec, target_state_1)
+
+    # chain 2: diverges with a different first block
+    target_state_2 = state.copy()
+    diff_block = build_empty_block_for_next_slot(spec, target_state_2)
+    signed_diff_block = state_transition_and_sign_block(
+        spec, target_state_2, diff_block)
+    spec.on_block(store, signed_diff_block)
+    next_epoch(spec, target_state_2)
+    next_slot(spec, target_state_2)
+
+    head_block = build_empty_block_for_next_slot(spec, target_state_1)
+    signed_head_block = state_transition_and_sign_block(
+        spec, target_state_1, head_block)
+    spec.on_block(store, signed_head_block)
+
+    # attest chain 1's head but claim chain 2's target
+    attestation = get_valid_attestation(spec, target_state_1,
+                                        slot=head_block.slot,
+                                        signed=False)
+    epoch = spec.compute_epoch_at_slot(attestation.data.slot)
+    attestation.data.target = spec.Checkpoint(
+        epoch=epoch, root=spec.get_block_root(target_state_2, epoch))
+    sign_attestation(spec, state, attestation)
+    assert spec.get_block_root(target_state_1, epoch) \
+        != attestation.data.target.root
+    _run_on_attestation(spec, state, store, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+@never_bls
+def test_on_attestation_target_block_not_in_store(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store,
+                 int(store.time) + int(spec.config.SECONDS_PER_SLOT)
+                 * (int(spec.SLOTS_PER_EPOCH) + 1))
+    target_epoch = spec.get_current_epoch(state) + 1
+    transition_to(spec, state,
+                  spec.compute_start_slot_at_epoch(target_epoch) - 1)
+    target_block = build_empty_block_for_next_slot(spec, state)
+    state_transition_and_sign_block(spec, state, target_block)
+    # target block NOT added to the store
+    attestation = get_valid_attestation(spec, state,
+                                        slot=target_block.slot,
+                                        signed=True)
+    assert attestation.data.target.root == hash_tree_root(target_block)
+    _run_on_attestation(spec, state, store, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+@never_bls
+def test_on_attestation_target_checkpoint_not_in_store(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store,
+                 int(store.time) + int(spec.config.SECONDS_PER_SLOT)
+                 * (int(spec.SLOTS_PER_EPOCH) + 1))
+    target_epoch = spec.get_current_epoch(state) + 1
+    transition_to(spec, state,
+                  spec.compute_start_slot_at_epoch(target_epoch) - 1)
+    target_block = build_empty_block_for_next_slot(spec, state)
+    signed_target_block = state_transition_and_sign_block(
+        spec, state, target_block)
+    spec.on_block(store, signed_target_block)
+    # checkpoint state derives on demand
+    attestation = get_valid_attestation(spec, state,
+                                        slot=target_block.slot,
+                                        signed=True)
+    assert attestation.data.target.root == hash_tree_root(target_block)
+    _run_on_attestation(spec, state, store, attestation)
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+@never_bls
+def test_on_attestation_target_checkpoint_not_in_store_diff_slot(
+        spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store,
+                 int(store.time) + int(spec.config.SECONDS_PER_SLOT)
+                 * (int(spec.SLOTS_PER_EPOCH) + 1))
+    target_epoch = spec.get_current_epoch(state) + 1
+    transition_to(spec, state,
+                  spec.compute_start_slot_at_epoch(target_epoch) - 2)
+    target_block = build_empty_block_for_next_slot(spec, state)
+    signed_target_block = state_transition_and_sign_block(
+        spec, state, target_block)
+    spec.on_block(store, signed_target_block)
+    # attest one empty slot later: target root crosses the skip
+    attestation_slot = target_block.slot + 1
+    transition_to(spec, state, attestation_slot)
+    attestation = get_valid_attestation(spec, state,
+                                        slot=attestation_slot,
+                                        signed=True)
+    assert attestation.data.target.root == hash_tree_root(target_block)
+    _run_on_attestation(spec, state, store, attestation)
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+@never_bls
+def test_on_attestation_beacon_block_not_in_store(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store,
+                 int(store.time) + int(spec.config.SECONDS_PER_SLOT)
+                 * (int(spec.SLOTS_PER_EPOCH) + 1))
+    target_epoch = spec.get_current_epoch(state) + 1
+    transition_to(spec, state,
+                  spec.compute_start_slot_at_epoch(target_epoch) - 1)
+    target_block = build_empty_block_for_next_slot(spec, state)
+    signed_target_block = state_transition_and_sign_block(
+        spec, state, target_block)
+    spec.on_block(store, signed_target_block)
+    head_block = build_empty_block_for_next_slot(spec, state)
+    state_transition_and_sign_block(spec, state, head_block)
+    # head block NOT added to the store
+    attestation = get_valid_attestation(spec, state,
+                                        slot=head_block.slot,
+                                        signed=True)
+    assert attestation.data.target.root == hash_tree_root(target_block)
+    assert attestation.data.beacon_block_root \
+        == hash_tree_root(head_block)
+    _run_on_attestation(spec, state, store, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+@never_bls
+def test_on_attestation_future_epoch(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store,
+                 int(store.time) + 3 * int(spec.config.SECONDS_PER_SLOT))
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    spec.on_block(store, signed_block)
+    # state advances an epoch; the store does not
+    next_epoch(spec, state)
+    attestation = get_valid_attestation(spec, state, slot=state.slot,
+                                        signed=True)
+    _run_on_attestation(spec, state, store, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+@never_bls
+def test_on_attestation_future_block(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store,
+                 int(store.time) + int(spec.config.SECONDS_PER_SLOT) * 5)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    spec.on_block(store, signed_block)
+    # LMD vote for a block NEWER than the attestation slot
+    attestation = get_valid_attestation(spec, state,
+                                        slot=block.slot - 1,
+                                        signed=False)
+    attestation.data.beacon_block_root = hash_tree_root(block)
+    sign_attestation(spec, state, attestation)
+    _run_on_attestation(spec, state, store, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+@never_bls
+def test_on_attestation_same_slot(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store,
+                 int(store.time) + int(spec.config.SECONDS_PER_SLOT))
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    spec.on_block(store, signed_block)
+    # attestation for the current slot arrives a slot too early
+    attestation = get_valid_attestation(spec, state, slot=block.slot,
+                                        signed=True)
+    _run_on_attestation(spec, state, store, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+@never_bls
+def test_on_attestation_invalid_attestation(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store,
+                 int(store.time) + 3 * int(spec.config.SECONDS_PER_SLOT))
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    spec.on_block(store, signed_block)
+    attestation = get_valid_attestation(spec, state, slot=block.slot,
+                                        signed=True)
+    # corrupt the committee reference
+    if spec.is_post("electra"):
+        attestation.committee_bits = type(attestation.committee_bits)()
+    else:
+        attestation.data.index = \
+            spec.MAX_COMMITTEES_PER_SLOT * spec.SLOTS_PER_EPOCH
+    _run_on_attestation(spec, state, store, attestation, valid=False)

@@ -1,0 +1,104 @@
+"""on_tick unit battery (reference
+test/phase0/unittests/fork_choice/test_on_tick.py)."""
+from ...ssz import hash_tree_root, uint64
+from ...test_infra.context import (
+    spec_state_test, no_vectors, with_all_phases, never_bls)
+from ...test_infra.blocks import (
+    build_empty_block_for_next_slot, next_epoch,
+    state_transition_and_sign_block, transition_to)
+from ...test_infra.fork_choice import get_genesis_forkchoice_store
+
+
+def _run_on_tick(spec, store, time, new_justified_checkpoint=False):
+    previous = store.justified_checkpoint.copy()
+    spec.on_tick(store, int(time))
+    assert int(store.time) == int(time)
+    if new_justified_checkpoint:
+        assert int(store.justified_checkpoint.epoch) > int(previous.epoch)
+        assert store.justified_checkpoint.root != previous.root
+    else:
+        assert store.justified_checkpoint == previous
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+@never_bls
+def test_basic(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    _run_on_tick(spec, store, int(store.time) + 1)
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+@never_bls
+def test_update_justified_single_not_on_store_finalized_chain(
+        spec, state):
+    """An unrealized-justification candidate on a branch CONFLICTING
+    with the store's finalized checkpoint must not be adopted at the
+    epoch tick."""
+    store = get_genesis_forkchoice_store(spec, state)
+    init_state = state.copy()
+
+    # branch 1: a block at epoch 1, then finalize the store on it
+    next_epoch(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.graffiti = b"\x11" * 32
+    state_transition_and_sign_block(spec, state, block)
+    store.blocks[hash_tree_root(block)] = block.copy()
+    store.block_states[hash_tree_root(block)] = state.copy()
+    store.finalized_checkpoint = spec.Checkpoint(
+        epoch=spec.compute_epoch_at_slot(block.slot),
+        root=hash_tree_root(block))
+
+    # branch 2: a conflicting epoch-1 block whose descendant claims
+    # justification of it at the epoch-2 boundary
+    state = init_state.copy()
+    next_epoch(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.graffiti = b"\x22" * 32
+    state_transition_and_sign_block(spec, state, block)
+    store.blocks[hash_tree_root(block)] = block.copy()
+    store.block_states[hash_tree_root(block)] = state.copy()
+    parent_block = block.copy()
+    transition_to(
+        spec, state,
+        uint64(int(state.slot) + int(spec.SLOTS_PER_EPOCH)
+               - int(state.slot) % int(spec.SLOTS_PER_EPOCH) - 1))
+    block = build_empty_block_for_next_slot(spec, state)
+    state.current_justified_checkpoint = spec.Checkpoint(
+        epoch=spec.compute_epoch_at_slot(parent_block.slot),
+        root=hash_tree_root(parent_block))
+    state_transition_and_sign_block(spec, state, block)
+    store.blocks[hash_tree_root(block)] = block.copy()
+    store.block_states[hash_tree_root(block)] = state.copy()
+
+    _run_on_tick(
+        spec, store,
+        int(store.genesis_time)
+        + int(state.slot) * int(spec.config.SECONDS_PER_SLOT))
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+@never_bls
+def test_tick_through_epoch_boundary_adopts_unrealized(spec, state):
+    """Crossing an epoch boundary promotes the store's unrealized
+    checkpoints (fork-choice.md on_tick_per_slot)."""
+    store = get_genesis_forkchoice_store(spec, state)
+    # hand the store an unrealized justification on the anchor chain
+    anchor_root = store.justified_checkpoint.root
+    store.unrealized_justified_checkpoint = spec.Checkpoint(
+        epoch=uint64(int(store.justified_checkpoint.epoch) + 1),
+        root=anchor_root)
+    store.unrealized_finalized_checkpoint = \
+        store.finalized_checkpoint.copy()
+    target = (int(store.genesis_time)
+              + int(spec.SLOTS_PER_EPOCH)
+              * int(spec.config.SECONDS_PER_SLOT))
+    spec.on_tick(store, target)
+    assert int(store.time) == target
+    assert store.justified_checkpoint \
+        == store.unrealized_justified_checkpoint

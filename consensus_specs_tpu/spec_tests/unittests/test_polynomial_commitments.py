@@ -1,0 +1,260 @@
+"""Deneb KZG polynomial-commitment unit battery (reference
+test/deneb/unittests/polynomial_commitments/
+test_polynomial_commitments.py, 17 defs): proof round trips at the
+_impl and bytes tiers, barycentric evaluation in/out of domain, field
+deserialization bounds, G1 input validation.
+
+Polynomials here are SPARSE (few nonzero coefficients) so the
+pure-Python oracle stays fast; the algebraic identities under test are
+degree-independent."""
+import random
+
+from ...crypto.kzg import BLS_MODULUS, KZG_ENDIANNESS
+from ...test_infra.blob import get_sample_blob
+from ...test_infra.context import (
+    spec_test, no_vectors, with_all_phases_from)
+from ...utils import bls
+
+P1_NOT_IN_G1 = bytes.fromhex(
+    "8123456789abcdef0123456789abcdef0123456789abcdef"
+    "0123456789abcdef0123456789abcdef0123456789abcdef")
+P1_NOT_ON_CURVE = bytes.fromhex(
+    "8123456789abcdef0123456789abcdef0123456789abcdef"
+    "0123456789abcdef0123456789abcdef0123456789abcde0")
+
+
+def _bls_add_one(x):
+    """Add the generator to a compressed G1 point: a definitely
+    incorrect proof that still deserializes."""
+    return bls.G1_to_bytes48(bls.add(bls.bytes48_to_G1(x), bls.G1()))
+
+
+def _sparse_poly_in_both_forms(spec, rng, nonzero=8):
+    """(coeffs, evals) for a sparse random polynomial; evals computed
+    term-by-term so building the evaluation form costs O(n * nonzero)
+    instead of O(n^2)."""
+    n = int(spec.FIELD_ELEMENTS_PER_BLOB)
+    roots_brp = spec.bit_reversal_permutation(
+        spec.compute_roots_of_unity(n))
+    coeffs = [0] * n
+    for _ in range(nonzero):
+        coeffs[rng.randrange(n)] = rng.randint(0, BLS_MODULUS - 1)
+    terms = [(j, c) for j, c in enumerate(coeffs) if c]
+    evals = [sum(c * pow(int(z), j, BLS_MODULUS) for j, c in terms)
+             % BLS_MODULUS for z in roots_brp]
+    return coeffs, evals
+
+
+def _eval_poly_in_coeff_form(coeffs, x):
+    total = 0
+    for a in reversed(coeffs):
+        total = (total * x + a) % BLS_MODULUS
+    return total
+
+
+# --- proof round trips ----------------------------------------------------
+
+@with_all_phases_from("deneb")
+@spec_test
+@no_vectors
+def test_verify_kzg_proof(spec):
+    x = spec.bls_field_to_bytes(3)
+    blob = get_sample_blob(spec)
+    commitment = spec.blob_to_kzg_commitment(blob)
+    proof, y = spec.compute_kzg_proof(blob, x)
+    assert spec.verify_kzg_proof(commitment, x, y, proof)
+
+
+@with_all_phases_from("deneb")
+@spec_test
+@no_vectors
+def test_verify_kzg_proof_incorrect_proof(spec):
+    x = spec.bls_field_to_bytes(3465)
+    blob = get_sample_blob(spec)
+    commitment = spec.blob_to_kzg_commitment(blob)
+    proof, y = spec.compute_kzg_proof(blob, x)
+    proof = _bls_add_one(proof)
+    assert not spec.verify_kzg_proof(commitment, x, y, proof)
+
+
+@with_all_phases_from("deneb")
+@spec_test
+@no_vectors
+def test_verify_kzg_proof_impl(spec):
+    x = BLS_MODULUS - 1
+    blob = get_sample_blob(spec)
+    commitment = spec.blob_to_kzg_commitment(blob)
+    polynomial = spec.blob_to_polynomial(blob)
+    proof, y = spec.compute_kzg_proof_impl(polynomial, x)
+    assert spec.verify_kzg_proof_impl(commitment, x, y, proof)
+
+
+@with_all_phases_from("deneb")
+@spec_test
+@no_vectors
+def test_verify_kzg_proof_impl_incorrect_proof(spec):
+    x = 324561
+    blob = get_sample_blob(spec)
+    commitment = spec.blob_to_kzg_commitment(blob)
+    polynomial = spec.blob_to_polynomial(blob)
+    proof, y = spec.compute_kzg_proof_impl(polynomial, x)
+    proof = _bls_add_one(proof)
+    assert not spec.verify_kzg_proof_impl(commitment, x, y, proof)
+
+
+# --- barycentric evaluation -----------------------------------------------
+
+@with_all_phases_from("deneb")
+@spec_test
+@no_vectors
+def test_barycentric_outside_domain(spec):
+    rng = random.Random(5566)
+    poly_coeff, poly_eval = _sparse_poly_in_both_forms(spec, rng)
+    roots_brp = spec.bit_reversal_permutation(
+        spec.compute_roots_of_unity(spec.FIELD_ELEMENTS_PER_BLOB))
+    assert len(poly_coeff) == len(poly_eval) == len(roots_brp)
+    root_set = {int(z) for z in roots_brp}
+    for _ in range(12):
+        z = rng.randint(0, BLS_MODULUS - 1)
+        while z in root_set:
+            z = rng.randint(0, BLS_MODULUS - 1)
+        p_z_coeff = _eval_poly_in_coeff_form(poly_coeff, z)
+        p_z_eval = spec.evaluate_polynomial_in_evaluation_form(
+            poly_eval, z)
+        assert int(p_z_eval) == p_z_coeff
+
+
+@with_all_phases_from("deneb")
+@spec_test
+@no_vectors
+def test_barycentric_within_domain(spec):
+    rng = random.Random(5566)
+    poly_coeff, poly_eval = _sparse_poly_in_both_forms(spec, rng)
+    roots_brp = spec.bit_reversal_permutation(
+        spec.compute_roots_of_unity(spec.FIELD_ELEMENTS_PER_BLOB))
+    n = len(poly_coeff)
+    for _ in range(12):
+        i = rng.randint(0, n - 1)
+        z = int(roots_brp[i])
+        p_z_coeff = _eval_poly_in_coeff_form(poly_coeff, z)
+        p_z_eval = spec.evaluate_polynomial_in_evaluation_form(
+            poly_eval, z)
+        assert int(p_z_eval) == p_z_coeff == poly_eval[i]
+
+
+@with_all_phases_from("deneb")
+@spec_test
+@no_vectors
+def test_compute_kzg_proof_within_domain(spec):
+    rng = random.Random(5566)
+    blob = get_sample_blob(spec)
+    commitment = spec.blob_to_kzg_commitment(blob)
+    polynomial = spec.blob_to_polynomial(blob)
+    roots_brp = spec.bit_reversal_permutation(
+        spec.compute_roots_of_unity(spec.FIELD_ELEMENTS_PER_BLOB))
+    for _ in range(3):
+        z = int(rng.choice(roots_brp))
+        proof, y = spec.compute_kzg_proof_impl(polynomial, z)
+        assert spec.verify_kzg_proof_impl(commitment, z, y, proof)
+
+
+# --- blob proofs ----------------------------------------------------------
+
+@with_all_phases_from("deneb")
+@spec_test
+@no_vectors
+def test_verify_blob_kzg_proof(spec):
+    blob = get_sample_blob(spec)
+    commitment = spec.blob_to_kzg_commitment(blob)
+    proof = spec.compute_blob_kzg_proof(blob, commitment)
+    assert spec.verify_blob_kzg_proof(blob, commitment, proof)
+
+
+@with_all_phases_from("deneb")
+@spec_test
+@no_vectors
+def test_verify_blob_kzg_proof_incorrect_proof(spec):
+    blob = get_sample_blob(spec)
+    commitment = spec.blob_to_kzg_commitment(blob)
+    proof = spec.compute_blob_kzg_proof(blob, commitment)
+    proof = _bls_add_one(proof)
+    assert not spec.verify_blob_kzg_proof(blob, commitment, proof)
+
+
+# --- field deserialization bounds -----------------------------------------
+
+@with_all_phases_from("deneb")
+@spec_test
+@no_vectors
+def test_bytes_to_bls_field_zero(spec):
+    assert int(spec.bytes_to_bls_field(b"\x00" * 32)) == 0
+
+
+@with_all_phases_from("deneb")
+@spec_test
+@no_vectors
+def test_bytes_to_bls_field_modulus_minus_one(spec):
+    b = (BLS_MODULUS - 1).to_bytes(32, KZG_ENDIANNESS)
+    assert int(spec.bytes_to_bls_field(b)) == BLS_MODULUS - 1
+
+
+@with_all_phases_from("deneb")
+@spec_test
+@no_vectors
+def test_bytes_to_bls_field_modulus(spec):
+    b = BLS_MODULUS.to_bytes(32, KZG_ENDIANNESS)
+    try:
+        spec.bytes_to_bls_field(b)
+        raise RuntimeError("modulus accepted as field element")
+    except (AssertionError, ValueError):
+        pass
+
+
+@with_all_phases_from("deneb")
+@spec_test
+@no_vectors
+def test_bytes_to_bls_field_max(spec):
+    b = b"\xff" * 32
+    try:
+        spec.bytes_to_bls_field(b)
+        raise RuntimeError("2**256-1 accepted as field element")
+    except (AssertionError, ValueError):
+        pass
+
+
+# --- G1 input validation --------------------------------------------------
+
+@with_all_phases_from("deneb")
+@spec_test
+@no_vectors
+def test_validate_kzg_g1_generator(spec):
+    spec.validate_kzg_g1(bls.G1_to_bytes48(bls.G1()))
+
+
+@with_all_phases_from("deneb")
+@spec_test
+@no_vectors
+def test_validate_kzg_g1_neutral_element(spec):
+    spec.validate_kzg_g1(b"\xc0" + b"\x00" * 47)
+
+
+@with_all_phases_from("deneb")
+@spec_test
+@no_vectors
+def test_validate_kzg_g1_not_in_g1(spec):
+    try:
+        spec.validate_kzg_g1(P1_NOT_IN_G1)
+        raise RuntimeError("point outside G1 accepted")
+    except (AssertionError, ValueError):
+        pass
+
+
+@with_all_phases_from("deneb")
+@spec_test
+@no_vectors
+def test_validate_kzg_g1_not_on_curve(spec):
+    try:
+        spec.validate_kzg_g1(P1_NOT_ON_CURVE)
+        raise RuntimeError("point off the curve accepted")
+    except (AssertionError, ValueError):
+        pass

@@ -1,0 +1,165 @@
+"""validate_merge_block unit battery (reference
+test/bellatrix/unittests/test_validate_merge_block.py, 8 defs): the
+terminal PoW block rule and the TERMINAL_BLOCK_HASH override path,
+called directly (no store)."""
+from random import Random
+
+from ...ssz import uint256
+from ...test_infra.context import (
+    spec_state_test, no_vectors, with_all_phases_from,
+    with_config_overrides, never_bls)
+from ...test_infra.blocks import (
+    build_empty_block_for_next_slot, build_empty_execution_payload)
+from ...test_infra.pow_block import (
+    prepare_random_pow_chain, pow_chain_patch,
+    recompute_payload_block_hash)
+
+TBH = "0x" + "00" * 31 + "01"
+
+
+def _merge_block(spec, state, parent_hash):
+    block = build_empty_block_for_next_slot(spec, state)
+    lookahead = state.copy()
+    spec.process_slots(lookahead, block.slot)
+    payload = build_empty_execution_payload(spec, lookahead)
+    payload.parent_hash = parent_hash
+    recompute_payload_block_hash(spec, payload)
+    block.body.execution_payload = payload
+    return block
+
+
+def _run_validate_merge_block(spec, pow_chain, block, valid=True):
+    with pow_chain_patch(spec, list(pow_chain)):
+        caught = False
+        try:
+            spec.validate_merge_block(block)
+        except AssertionError:
+            caught = True
+    assert caught != valid
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+@no_vectors
+@never_bls
+def test_validate_merge_block_success(spec, state):
+    rng = Random(3131)
+    pow_chain = prepare_random_pow_chain(spec, 2, rng)
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    pow_chain.head(-1).total_difficulty = uint256(ttd - 1)
+    pow_chain.head().total_difficulty = uint256(ttd)
+    block = _merge_block(spec, state, pow_chain.head().block_hash)
+    _run_validate_merge_block(spec, pow_chain, block)
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+@no_vectors
+@never_bls
+def test_validate_merge_block_fail_block_lookup(spec, state):
+    rng = Random(3131)
+    pow_chain = prepare_random_pow_chain(spec, 2, rng)
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    pow_chain.head(-1).total_difficulty = uint256(ttd - 1)
+    pow_chain.head().total_difficulty = uint256(ttd)
+    # payload parent is NOT in the chain view (default zero hash)
+    block = build_empty_block_for_next_slot(spec, state)
+    _run_validate_merge_block(spec, pow_chain, block, valid=False)
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+@no_vectors
+@never_bls
+def test_validate_merge_block_fail_parent_block_lookup(spec, state):
+    rng = Random(3131)
+    pow_chain = prepare_random_pow_chain(spec, 1, rng)
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    pow_chain.head().total_difficulty = uint256(ttd)
+    block = _merge_block(spec, state, pow_chain.head().block_hash)
+    _run_validate_merge_block(spec, pow_chain, block, valid=False)
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+@no_vectors
+@never_bls
+def test_validate_merge_block_fail_after_terminal(spec, state):
+    rng = Random(3131)
+    pow_chain = prepare_random_pow_chain(spec, 2, rng)
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    pow_chain.head(-1).total_difficulty = uint256(ttd)
+    pow_chain.head().total_difficulty = uint256(ttd + 1)
+    block = _merge_block(spec, state, pow_chain.head().block_hash)
+    _run_validate_merge_block(spec, pow_chain, block, valid=False)
+
+
+@with_all_phases_from("bellatrix")
+@with_config_overrides({"TERMINAL_BLOCK_HASH": TBH,
+                        "TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH": 0})
+@spec_state_test
+@no_vectors
+@never_bls
+def test_validate_merge_block_tbh_override_success(spec, state):
+    rng = Random(3131)
+    pow_chain = prepare_random_pow_chain(spec, 2, rng)
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    # TTD NOT reached: only the TBH override can admit the block
+    pow_chain.head(-1).total_difficulty = uint256(ttd - 2)
+    pow_chain.head().total_difficulty = uint256(ttd - 1)
+    pow_chain.head().block_hash = bytes.fromhex(TBH[2:])
+    block = _merge_block(spec, state, pow_chain.head().block_hash)
+    _run_validate_merge_block(spec, pow_chain, block)
+
+
+@with_all_phases_from("bellatrix")
+@with_config_overrides({"TERMINAL_BLOCK_HASH": TBH,
+                        "TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH": 0})
+@spec_state_test
+@no_vectors
+@never_bls
+def test_validate_merge_block_fail_parent_hash_is_not_tbh(spec, state):
+    rng = Random(3131)
+    pow_chain = prepare_random_pow_chain(spec, 2, rng)
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    # TTD reached — irrelevant once TBH is configured
+    pow_chain.head(-1).total_difficulty = uint256(ttd - 1)
+    pow_chain.head().total_difficulty = uint256(ttd)
+    block = _merge_block(spec, state, pow_chain.head().block_hash)
+    _run_validate_merge_block(spec, pow_chain, block, valid=False)
+
+
+@with_all_phases_from("bellatrix")
+@with_config_overrides({"TERMINAL_BLOCK_HASH": TBH,
+                        "TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH": 1})
+@spec_state_test
+@no_vectors
+@never_bls
+def test_validate_merge_block_terminal_block_hash_fail_activation_not_reached(
+        spec, state):
+    rng = Random(3131)
+    pow_chain = prepare_random_pow_chain(spec, 2, rng)
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    pow_chain.head(-1).total_difficulty = uint256(ttd - 1)
+    pow_chain.head().total_difficulty = uint256(ttd)
+    pow_chain.head().block_hash = bytes.fromhex(TBH[2:])
+    block = _merge_block(spec, state, pow_chain.head().block_hash)
+    # genesis epoch < activation epoch: reject even with TBH parent
+    _run_validate_merge_block(spec, pow_chain, block, valid=False)
+
+
+@with_all_phases_from("bellatrix")
+@with_config_overrides({"TERMINAL_BLOCK_HASH": TBH,
+                        "TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH": 1})
+@spec_state_test
+@no_vectors
+@never_bls
+def test_validate_merge_block_fail_activation_not_reached_parent_hash_is_not_tbh(
+        spec, state):
+    rng = Random(3131)
+    pow_chain = prepare_random_pow_chain(spec, 2, rng)
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    pow_chain.head(-1).total_difficulty = uint256(ttd - 1)
+    pow_chain.head().total_difficulty = uint256(ttd)
+    block = _merge_block(spec, state, pow_chain.head().block_hash)
+    _run_validate_merge_block(spec, pow_chain, block, valid=False)

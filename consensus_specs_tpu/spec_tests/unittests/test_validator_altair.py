@@ -1,0 +1,288 @@
+"""Altair sync-committee validator-duty unit battery (reference
+test/altair/unittests/validator/test_validator.py, 9 defs)."""
+import random
+from collections import defaultdict
+
+from ...ssz import Bytes32, uint64
+from ...test_infra.context import (
+    spec_state_test, no_vectors, with_all_phases_from, with_presets,
+    always_bls)
+from ...test_infra.blocks import build_empty_block, transition_to
+from ...test_infra.keys import privkeys, pubkeys, privkey_for_pubkey
+from ...utils import bls
+
+rng = random.Random(1337)
+
+
+def _ensure_assignments_in_sync_committee(spec, state, epoch,
+                                          sync_committee, active_pubkeys):
+    assert len(sync_committee.pubkeys) >= 3
+    some_pubkeys = rng.sample(list(sync_committee.pubkeys), 3)
+    for pubkey in some_pubkeys:
+        validator_index = active_pubkeys.index(pubkey)
+        assert spec.is_assigned_to_sync_committee(state, epoch,
+                                                  validator_index)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@no_vectors
+def test_is_assigned_to_sync_committee(spec, state):
+    epoch = spec.get_current_epoch(state)
+    validator_indices = spec.get_active_validator_indices(state, epoch)
+    query_epoch = uint64(int(epoch) + 1)
+    next_query_epoch = uint64(
+        int(query_epoch) + int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD))
+    active_pubkeys = [state.validators[i].pubkey
+                      for i in validator_indices]
+    _ensure_assignments_in_sync_committee(
+        spec, state, query_epoch, state.current_sync_committee,
+        active_pubkeys)
+    _ensure_assignments_in_sync_committee(
+        spec, state, next_query_epoch, state.next_sync_committee,
+        active_pubkeys)
+    committee_pubkeys = set(
+        list(state.current_sync_committee.pubkeys)
+        + list(state.next_sync_committee.pubkeys))
+    disqualified = sorted(
+        bytes(k) for k in active_pubkeys if k not in committee_pubkeys)
+    if disqualified:
+        for pubkey in rng.sample(disqualified, min(3, len(disqualified))):
+            validator_index = [bytes(k) for k in active_pubkeys].index(
+                pubkey)
+            assert not (
+                spec.is_assigned_to_sync_committee(
+                    state, query_epoch, validator_index)
+                or spec.is_assigned_to_sync_committee(
+                    state, next_query_epoch, validator_index))
+
+
+def _sync_committee_signature_for(spec, state, target_slot,
+                                  target_block_root, subcommittee_index,
+                                  index_in_subcommittee):
+    subcommittee_size = int(spec.SYNC_COMMITTEE_SIZE) \
+        // int(spec.SYNC_COMMITTEE_SUBNET_COUNT)
+    position = subcommittee_index * subcommittee_size \
+        + index_in_subcommittee
+    pubkey = state.current_sync_committee.pubkeys[position]
+    privkey = privkey_for_pubkey(pubkey)
+    domain = spec.get_domain(state, spec.DOMAIN_SYNC_COMMITTEE,
+                             spec.compute_epoch_at_slot(target_slot))
+    signing_root = spec.compute_signing_root(
+        Bytes32(target_block_root), domain)
+    return bls.Sign(privkey, signing_root)
+
+
+@with_all_phases_from("altair")
+@with_presets(["minimal"], reason="too slow")
+@spec_state_test
+@no_vectors
+@always_bls
+def test_process_sync_committee_contributions(spec, state):
+    transition_to(spec, state, uint64(int(state.slot) + 3))
+    block = build_empty_block(spec, state)
+    previous_slot = uint64(int(state.slot) - 1)
+    target_block_root = spec.get_block_root_at_slot(state, previous_slot)
+    subcommittee_size = int(spec.SYNC_COMMITTEE_SIZE) \
+        // int(spec.SYNC_COMMITTEE_SUBNET_COUNT)
+    bits_type = type(block.body.sync_aggregate.sync_committee_bits)
+
+    aggregation_index = 0
+    contributions = []
+    for i in range(int(spec.SYNC_COMMITTEE_SUBNET_COUNT)):
+        aggregation_bits = [False] * subcommittee_size
+        aggregation_bits[aggregation_index] = True
+        contributions.append(spec.SyncCommitteeContribution(
+            slot=block.slot,
+            beacon_block_root=target_block_root,
+            subcommittee_index=uint64(i),
+            aggregation_bits=aggregation_bits,
+            signature=_sync_committee_signature_for(
+                spec, state, previous_slot, target_block_root, i,
+                aggregation_index)))
+
+    # empty aggregate before ...
+    assert not any(block.body.sync_aggregate.sync_committee_bits)
+    assert bytes(block.body.sync_aggregate.sync_committee_signature) \
+        == bytes(spec.G2_POINT_AT_INFINITY)
+    spec.process_sync_committee_contributions(block, contributions)
+    # ... non-empty and VALID after
+    assert any(block.body.sync_aggregate.sync_committee_bits)
+    assert bytes(block.body.sync_aggregate.sync_committee_signature) \
+        != bytes(spec.G2_POINT_AT_INFINITY)
+    assert isinstance(block.body.sync_aggregate.sync_committee_bits,
+                      bits_type)
+    spec.process_block(state, block)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@no_vectors
+@always_bls
+def test_get_sync_committee_message(spec, state):
+    validator_index = 0
+    block_root = b"\x12" * 32
+    message = spec.get_sync_committee_message(
+        state=state, block_root=block_root,
+        validator_index=validator_index,
+        privkey=privkeys[validator_index])
+    assert message.slot == state.slot
+    assert bytes(message.beacon_block_root) == block_root
+    assert message.validator_index == validator_index
+    epoch = spec.get_current_epoch(state)
+    domain = spec.get_domain(state, spec.DOMAIN_SYNC_COMMITTEE, epoch)
+    signing_root = spec.compute_signing_root(Bytes32(block_root), domain)
+    assert bytes(message.signature) == bytes(
+        bls.Sign(privkeys[validator_index], signing_root))
+
+
+def _subnet_for_sync_committee_index(spec, i):
+    return i // (int(spec.SYNC_COMMITTEE_SIZE)
+                 // int(spec.SYNC_COMMITTEE_SUBNET_COUNT))
+
+
+def _expected_subnets_by_pubkey(members):
+    expected = defaultdict(set)
+    for subnet, pubkey in members:
+        expected[bytes(pubkey)].add(subnet)
+    return expected
+
+
+def _check_subnets_against_committee(spec, state, committee):
+    members = [(_subnet_for_sync_committee_index(spec, i), pubkey)
+               for i, pubkey in enumerate(committee.pubkeys)]
+    expected = _expected_subnets_by_pubkey(members)
+    all_pubkeys = [bytes(v.pubkey) for v in state.validators]
+    for _, pubkey in members:
+        validator_index = all_pubkeys.index(bytes(pubkey))
+        subnets = spec.compute_subnets_for_sync_committee(
+            state, validator_index)
+        assert {int(s) for s in subnets} \
+            == {int(s) for s in expected[bytes(pubkey)]}
+
+
+@with_all_phases_from("altair")
+@with_presets(["minimal"], reason="too slow")
+@spec_state_test
+@no_vectors
+def test_compute_subnets_for_sync_committee(spec, state):
+    # head of the next period: next slot stays in the SAME period
+    transition_to(spec, state,
+                  uint64(int(spec.SLOTS_PER_EPOCH)
+                         * int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)))
+    next_slot_epoch = spec.compute_epoch_at_slot(
+        uint64(int(state.slot) + 1))
+    assert spec.compute_sync_committee_period(
+        spec.get_current_epoch(state)) \
+        == spec.compute_sync_committee_period(next_slot_epoch)
+    _check_subnets_against_committee(spec, state,
+                                     state.current_sync_committee)
+
+
+@with_all_phases_from("altair")
+@with_presets(["minimal"], reason="too slow")
+@spec_state_test
+@no_vectors
+def test_compute_subnets_for_sync_committee_slot_period_boundary(
+        spec, state):
+    # end of the period: next slot crosses into the NEXT period
+    transition_to(spec, state,
+                  uint64(int(spec.SLOTS_PER_EPOCH)
+                         * int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)
+                         - 1))
+    next_slot_epoch = spec.compute_epoch_at_slot(
+        uint64(int(state.slot) + 1))
+    assert spec.compute_sync_committee_period(
+        spec.get_current_epoch(state)) \
+        != spec.compute_sync_committee_period(next_slot_epoch)
+    _check_subnets_against_committee(spec, state,
+                                     state.next_sync_committee)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@no_vectors
+@always_bls
+def test_get_sync_committee_selection_proof(spec, state):
+    slot = uint64(1)
+    subcommittee_index = uint64(0)
+    privkey = privkeys[1]
+    proof = spec.get_sync_committee_selection_proof(
+        state, slot, subcommittee_index, privkey)
+    domain = spec.get_domain(
+        state, spec.DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+        spec.compute_epoch_at_slot(slot))
+    signing_data = spec.SyncAggregatorSelectionData(
+        slot=slot, subcommittee_index=subcommittee_index)
+    signing_root = spec.compute_signing_root(signing_data, domain)
+    assert bls.Verify(pubkeys[1], signing_root, proof)
+
+
+@with_all_phases_from("altair")
+@with_presets(["mainnet"],
+              reason="statistical check needs the mainnet committee size")
+@spec_state_test
+@no_vectors
+def test_is_sync_committee_aggregator(spec, state):
+    sample_count = (int(spec.SYNC_COMMITTEE_SIZE)
+                    // int(spec.SYNC_COMMITTEE_SUBNET_COUNT)) * 100
+    is_aggregator_count = 0
+    for i in range(sample_count):
+        signature = spec.hash(i.to_bytes(32, byteorder="little"))
+        if spec.is_sync_committee_aggregator(signature):
+            is_aggregator_count += 1
+    target = int(spec.TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE) * 100
+    assert target * 0.9 <= is_aggregator_count <= target * 1.1
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@no_vectors
+def test_get_contribution_and_proof(spec, state):
+    aggregator_index = uint64(10)
+    privkey = privkeys[3]
+    subcommittee_size = int(spec.SYNC_COMMITTEE_SIZE) \
+        // int(spec.SYNC_COMMITTEE_SUBNET_COUNT)
+    contribution = spec.SyncCommitteeContribution(
+        slot=uint64(10),
+        beacon_block_root=b"\x12" * 32,
+        subcommittee_index=uint64(1),
+        aggregation_bits=[False] * subcommittee_size,
+        signature=b"\x32" * 96)
+    selection_proof = spec.get_sync_committee_selection_proof(
+        state, contribution.slot, contribution.subcommittee_index,
+        privkey)
+    contribution_and_proof = spec.get_contribution_and_proof(
+        state, aggregator_index, contribution, privkey)
+    assert contribution_and_proof == spec.ContributionAndProof(
+        aggregator_index=aggregator_index,
+        contribution=contribution,
+        selection_proof=selection_proof)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@no_vectors
+@always_bls
+def test_get_contribution_and_proof_signature(spec, state):
+    privkey = privkeys[3]
+    subcommittee_size = int(spec.SYNC_COMMITTEE_SIZE) \
+        // int(spec.SYNC_COMMITTEE_SUBNET_COUNT)
+    contribution_and_proof = spec.ContributionAndProof(
+        aggregator_index=uint64(10),
+        contribution=spec.SyncCommitteeContribution(
+            slot=uint64(10),
+            beacon_block_root=b"\x12" * 32,
+            subcommittee_index=uint64(1),
+            aggregation_bits=[False] * subcommittee_size,
+            signature=b"\x34" * 96),
+        selection_proof=b"\x56" * 96)
+    signature = spec.get_contribution_and_proof_signature(
+        state, contribution_and_proof, privkey)
+    domain = spec.get_domain(
+        state, spec.DOMAIN_CONTRIBUTION_AND_PROOF,
+        spec.compute_epoch_at_slot(
+            contribution_and_proof.contribution.slot))
+    signing_root = spec.compute_signing_root(contribution_and_proof,
+                                             domain)
+    assert bls.Verify(pubkeys[3], signing_root, signature)

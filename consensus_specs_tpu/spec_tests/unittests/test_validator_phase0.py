@@ -1,0 +1,449 @@
+"""Phase0 validator-duty unit battery (reference
+test/phase0/unittests/validator/test_validator_unittest.py, 24 defs):
+signing helpers, committee assignment, eth1 voting, aggregation
+selection, subnet computation — asserted directly against
+specs/validator_duties.py."""
+import random
+
+from ...ssz import hash_tree_root, uint64
+from ...test_infra.context import (
+    spec_state_test, spec_test, no_vectors, with_all_phases, always_bls)
+from ...test_infra.attestations import get_valid_attestation
+from ...test_infra.blocks import (
+    build_empty_block, build_empty_block_for_next_slot, next_epoch)
+from ...test_infra.keys import privkeys, pubkeys, pubkey_of
+from ...utils import bls
+
+
+def _run_get_signature_test(spec, state, domain, signature,
+                            signing_ssz_object, privkey):
+    signing_root = spec.compute_signing_root(signing_ssz_object, domain)
+    assert bls.Verify(pubkey_of(privkey), signing_root, signature)
+
+
+def _min_new_period_epochs(spec) -> int:
+    return ((int(spec.config.SECONDS_PER_ETH1_BLOCK)
+             * int(spec.config.ETH1_FOLLOW_DISTANCE) * 2)
+            // int(spec.config.SECONDS_PER_SLOT)
+            // int(spec.SLOTS_PER_EPOCH))
+
+
+def _mock_aggregate(spec):
+    return spec.Attestation(data=spec.AttestationData(slot=uint64(10)))
+
+
+# --- becoming a validator -------------------------------------------------
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+def test_check_if_validator_active(spec, state):
+    active_index = 0
+    assert spec.check_if_validator_active(state, active_index)
+    # a fresh deposit is not active yet
+    new_index = len(state.validators)
+    validator = state.validators[0].copy()
+    validator.activation_epoch = spec.FAR_FUTURE_EPOCH
+    validator.activation_eligibility_epoch = spec.FAR_FUTURE_EPOCH
+    state.validators.append(validator)
+    state.balances.append(spec.MAX_EFFECTIVE_BALANCE)
+    assert not spec.check_if_validator_active(state, new_index)
+
+
+# --- committee assignment -------------------------------------------------
+
+def _run_get_committee_assignment(spec, state, epoch, validator_index,
+                                  valid=True):
+    try:
+        committee, committee_index, slot = spec.get_committee_assignment(
+            state, epoch, validator_index)
+        assert int(spec.compute_epoch_at_slot(slot)) == int(epoch)
+        assert list(committee) == list(spec.get_beacon_committee(
+            state, slot, committee_index))
+        assert int(committee_index) < int(
+            spec.get_committee_count_per_slot(state, epoch))
+        assert validator_index in committee
+        assert valid
+    except AssertionError:
+        assert not valid
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+def test_get_committee_assignment_current_epoch(spec, state):
+    _run_get_committee_assignment(
+        spec, state, spec.get_current_epoch(state), 0)
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+def test_get_committee_assignment_next_epoch(spec, state):
+    _run_get_committee_assignment(
+        spec, state, spec.get_current_epoch(state) + 1, 0)
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+def test_get_committee_assignment_out_bound_epoch(spec, state):
+    _run_get_committee_assignment(
+        spec, state, spec.get_current_epoch(state) + 2, 0, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+def test_is_proposer(spec, state):
+    proposer_index = spec.get_beacon_proposer_index(state)
+    assert spec.is_proposer(state, proposer_index)
+    proposer_index = (proposer_index + 1) % len(state.validators)
+    assert not spec.is_proposer(state, proposer_index)
+
+
+# --- block proposal signatures -------------------------------------------
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+@always_bls
+def test_get_epoch_signature(spec, state):
+    block = spec.BeaconBlock()
+    privkey = privkeys[0]
+    signature = spec.get_epoch_signature(state, block, privkey)
+    domain = spec.get_domain(state, spec.DOMAIN_RANDAO,
+                             spec.compute_epoch_at_slot(block.slot))
+    _run_get_signature_test(
+        spec, state, domain, signature,
+        uint64(spec.compute_epoch_at_slot(block.slot)), privkey)
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+@always_bls
+def test_get_block_signature(spec, state):
+    privkey = privkeys[0]
+    block = build_empty_block_for_next_slot(spec, state)
+    signature = spec.get_block_signature(state, block, privkey)
+    domain = spec.get_domain(state, spec.DOMAIN_BEACON_PROPOSER,
+                             spec.compute_epoch_at_slot(block.slot))
+    _run_get_signature_test(spec, state, domain, signature, block,
+                            privkey)
+
+
+# --- eth1 voting ----------------------------------------------------------
+
+def _run_is_candidate_block(spec, eth1_block, period_start,
+                            success=True):
+    assert success == spec.is_candidate_block(eth1_block, period_start)
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+def test_is_candidate_block(spec, state):
+    distance = int(spec.config.SECONDS_PER_ETH1_BLOCK) \
+        * int(spec.config.ETH1_FOLLOW_DISTANCE)
+    period_start = distance * 2 + 1000
+    _run_is_candidate_block(
+        spec, spec.Eth1Block(timestamp=period_start - distance),
+        period_start, success=True)
+    _run_is_candidate_block(
+        spec, spec.Eth1Block(timestamp=period_start - distance + 1),
+        period_start, success=False)
+    _run_is_candidate_block(
+        spec, spec.Eth1Block(timestamp=period_start - distance * 2),
+        period_start, success=True)
+    _run_is_candidate_block(
+        spec, spec.Eth1Block(timestamp=period_start - distance * 2 - 1),
+        period_start, success=False)
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+def test_get_eth1_vote_default_vote(spec, state):
+    for _ in range(_min_new_period_epochs(spec)):
+        next_epoch(spec, state)
+    state.eth1_data_votes = type(state.eth1_data_votes)()
+    assert spec.get_eth1_vote(state, []) == state.eth1_data
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+def test_get_eth1_vote_consensus_vote(spec, state):
+    for _ in range(_min_new_period_epochs(spec) + 2):
+        next_epoch(spec, state)
+    period_start = spec.voting_period_start_time(state)
+    votes_length = int(spec.get_current_epoch(state)) \
+        % int(spec.EPOCHS_PER_ETH1_VOTING_PERIOD)
+    assert votes_length >= 3
+    state.eth1_data_votes = type(state.eth1_data_votes)()
+    follow = int(spec.config.SECONDS_PER_ETH1_BLOCK) \
+        * int(spec.config.ETH1_FOLLOW_DISTANCE)
+    block_1 = spec.Eth1Block(
+        timestamp=int(period_start) - follow - 1,
+        deposit_count=state.eth1_data.deposit_count,
+        deposit_root=b"\x04" * 32)
+    block_2 = spec.Eth1Block(
+        timestamp=int(period_start) - follow,
+        deposit_count=int(state.eth1_data.deposit_count) + 1,
+        deposit_root=b"\x05" * 32)
+    eth1_chain = [block_1, block_2]
+    votes = [spec.get_eth1_data(block_1)]
+    votes += [spec.get_eth1_data(block_2)] * (votes_length - 1)
+    state.eth1_data_votes = votes
+    eth1_data = spec.get_eth1_vote(state, eth1_chain)
+    assert eth1_data.block_hash == hash_tree_root(block_2)
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+def test_get_eth1_vote_tie(spec, state):
+    for _ in range(_min_new_period_epochs(spec) + 1):
+        next_epoch(spec, state)
+    period_start = spec.voting_period_start_time(state)
+    votes_length = int(spec.get_current_epoch(state)) \
+        % int(spec.EPOCHS_PER_ETH1_VOTING_PERIOD)
+    assert votes_length > 0 and votes_length % 2 == 0
+    state.eth1_data_votes = type(state.eth1_data_votes)()
+    follow = int(spec.config.SECONDS_PER_ETH1_BLOCK) \
+        * int(spec.config.ETH1_FOLLOW_DISTANCE)
+    block_1 = spec.Eth1Block(
+        timestamp=int(period_start) - follow - 1,
+        deposit_count=state.eth1_data.deposit_count,
+        deposit_root=b"\x04" * 32)
+    block_2 = spec.Eth1Block(
+        timestamp=int(period_start) - follow,
+        deposit_count=int(state.eth1_data.deposit_count) + 1,
+        deposit_root=b"\x05" * 32)
+    eth1_chain = [block_1, block_2]
+    votes = [spec.get_eth1_data(block_1 if i % 2 == 0 else block_2)
+             for i in range(votes_length)]
+    state.eth1_data_votes = votes
+    eth1_data = spec.get_eth1_vote(state, eth1_chain)
+    # tiebreak: the earliest vote wins -> block_1
+    assert eth1_data.block_hash == hash_tree_root(eth1_chain[0])
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+def test_get_eth1_vote_chain_in_past(spec, state):
+    for _ in range(_min_new_period_epochs(spec) + 1):
+        next_epoch(spec, state)
+    period_start = spec.voting_period_start_time(state)
+    follow = int(spec.config.SECONDS_PER_ETH1_BLOCK) \
+        * int(spec.config.ETH1_FOLLOW_DISTANCE)
+    block_1 = spec.Eth1Block(
+        timestamp=int(period_start) - follow,
+        deposit_count=int(state.eth1_data.deposit_count) - 1,
+        deposit_root=b"\x42" * 32)
+    state.eth1_data_votes = type(state.eth1_data_votes)()
+    # a chain behind the current eth1 data is never a candidate
+    assert spec.get_eth1_vote(state, [block_1]) == state.eth1_data
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+def test_compute_new_state_root(spec, state):
+    pre_state = state.copy()
+    post_state = state.copy()
+    block = build_empty_block(spec, state, uint64(int(state.slot) + 1))
+    state_root = spec.compute_new_state_root(state, block)
+    assert state_root != hash_tree_root(pre_state)
+    assert state == pre_state  # input state untouched
+    # matches the actual transition
+    signed = spec.SignedBeaconBlock(message=block)
+    spec.state_transition(post_state, signed, validate_result=False)
+    assert state_root == hash_tree_root(post_state)
+
+
+# --- fork digest / subnets ------------------------------------------------
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+def test_compute_fork_digest(spec, state):
+    actual = spec.compute_fork_digest(state.fork.current_version,
+                                      state.genesis_validators_root)
+    expected = bytes(spec.compute_fork_data_root(
+        state.fork.current_version,
+        state.genesis_validators_root))[:4]
+    assert bytes(actual) == expected
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+def test_compute_subnet_for_attestation(spec, state):
+    for committee_idx in range(
+            int(spec.get_committee_count_per_slot(
+                state, spec.get_current_epoch(state)))):
+        actual = spec.compute_subnet_for_attestation(
+            spec.get_committee_count_per_slot(
+                state, spec.get_current_epoch(state)),
+            state.slot, committee_idx)
+        committees_per_slot = int(spec.get_committee_count_per_slot(
+            state, spec.get_current_epoch(state)))
+        slots_since_epoch_start = int(state.slot) \
+            % int(spec.SLOTS_PER_EPOCH)
+        expected = (committees_per_slot * slots_since_epoch_start
+                    + committee_idx) \
+            % int(spec.ATTESTATION_SUBNET_COUNT)
+        assert int(actual) == expected
+
+
+# --- attestation signatures & aggregation ---------------------------------
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+@always_bls
+def test_get_attestation_signature_phase0(spec, state):
+    privkey = privkeys[0]
+    attestation_data = spec.AttestationData(slot=uint64(10))
+    signature = spec.get_attestation_signature(
+        state, attestation_data, privkey)
+    domain = spec.get_domain(state, spec.DOMAIN_BEACON_ATTESTER,
+                             attestation_data.target.epoch)
+    _run_get_signature_test(spec, state, domain, signature,
+                            attestation_data, privkey)
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+@always_bls
+def test_get_slot_signature(spec, state):
+    privkey = privkeys[0]
+    slot = uint64(10)
+    signature = spec.get_slot_signature(state, slot, privkey)
+    domain = spec.get_domain(state, spec.DOMAIN_SELECTION_PROOF,
+                             spec.compute_epoch_at_slot(slot))
+    _run_get_signature_test(spec, state, domain, signature, slot,
+                            privkey)
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+@always_bls
+def test_is_aggregator(spec, state):
+    # at least one committee member is selected as aggregator
+    slot = state.slot
+    committee_index = 0
+    has_aggregator = False
+    committee = spec.get_beacon_committee(state, slot, committee_index)
+    for validator_index in committee:
+        privkey = privkeys[pubkeys.index(
+            bytes(state.validators[validator_index].pubkey))]
+        slot_signature = spec.get_slot_signature(state, slot, privkey)
+        if spec.is_aggregator(state, slot, committee_index,
+                              slot_signature):
+            has_aggregator = True
+            break
+    assert has_aggregator
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+@always_bls
+def test_get_aggregate_signature(spec, state):
+    attestations = []
+    attesting_pubkeys = []
+    slot = state.slot
+    committee_index = 0
+    attestation_data = spec.AttestationData(
+        slot=slot, index=committee_index)
+    committee = spec.get_beacon_committee(state, slot, committee_index)
+    domain = spec.get_domain(state, spec.DOMAIN_BEACON_ATTESTER,
+                             attestation_data.target.epoch)
+    signing_root = spec.compute_signing_root(attestation_data, domain)
+    for i, validator_index in enumerate(committee):
+        bits = [False] * len(committee)
+        bits[i] = True
+        privkey = privkeys[pubkeys.index(
+            bytes(state.validators[validator_index].pubkey))]
+        attestation = spec.Attestation(
+            data=attestation_data,
+            aggregation_bits=bits,
+            signature=bls.Sign(privkey, signing_root))
+        attestations.append(attestation)
+        attesting_pubkeys.append(
+            bytes(state.validators[validator_index].pubkey))
+    assert len(attestations) > 0
+    signature = spec.get_aggregate_signature(attestations)
+    assert bls.FastAggregateVerify(attesting_pubkeys, signing_root,
+                                   signature)
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+def test_get_aggregate_and_proof(spec, state):
+    privkey = privkeys[0]
+    aggregator_index = uint64(10)
+    aggregate = _mock_aggregate(spec)
+    aggregate_and_proof = spec.get_aggregate_and_proof(
+        state, aggregator_index, aggregate, privkey)
+    assert aggregate_and_proof.aggregator_index == aggregator_index
+    assert aggregate_and_proof.aggregate == aggregate
+    assert aggregate_and_proof.selection_proof == \
+        spec.get_slot_signature(state, aggregate.data.slot, privkey)
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+@always_bls
+def test_get_aggregate_and_proof_signature(spec, state):
+    privkey = privkeys[0]
+    aggregate = _mock_aggregate(spec)
+    aggregate_and_proof = spec.get_aggregate_and_proof(
+        state, uint64(10), aggregate, privkey)
+    signature = spec.get_aggregate_and_proof_signature(
+        state, aggregate_and_proof, privkey)
+    domain = spec.get_domain(
+        state, spec.DOMAIN_AGGREGATE_AND_PROOF,
+        spec.compute_epoch_at_slot(aggregate.data.slot))
+    _run_get_signature_test(spec, state, domain, signature,
+                            aggregate_and_proof, privkey)
+
+
+# --- subscribed subnets ---------------------------------------------------
+
+def _run_compute_subscribed_subnets_arguments(spec, rng):
+    node_id = rng.randint(0, 2**256 - 1)
+    epoch = rng.randint(0, 2**64 - 1)
+    subnets = spec.compute_subscribed_subnets(node_id, epoch)
+    assert len(subnets) == int(spec.config.SUBNETS_PER_NODE)
+    for subnet in subnets:
+        assert 0 <= int(subnet) < int(spec.config.ATTESTATION_SUBNET_COUNT)
+
+
+@with_all_phases
+@spec_test
+@no_vectors
+def test_compute_subscribed_subnets_random_1(spec):
+    _run_compute_subscribed_subnets_arguments(spec, random.Random(1111))
+
+
+@with_all_phases
+@spec_test
+@no_vectors
+def test_compute_subscribed_subnets_random_2(spec):
+    _run_compute_subscribed_subnets_arguments(spec, random.Random(2222))
+
+
+@with_all_phases
+@spec_test
+@no_vectors
+def test_compute_subscribed_subnets_random_3(spec):
+    _run_compute_subscribed_subnets_arguments(spec, random.Random(3333))

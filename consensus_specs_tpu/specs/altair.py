@@ -660,6 +660,28 @@ class AltairSpec(LightClientMixin, Phase0Spec):
         return bytes_to_uint64(
             self.hash(bytes(signature))[0:8]) % modulo == 0
 
+    def process_sync_committee_contributions(self, block,
+                                             contributions) -> None:
+        """Assemble the block's SyncAggregate out of per-subnet
+        contributions (altair/validator.md)."""
+        sync_aggregate = self.SyncAggregate()
+        signatures = []
+        sync_subcommittee_size = (self.SYNC_COMMITTEE_SIZE
+                                  // self.SYNC_COMMITTEE_SUBNET_COUNT)
+        for contribution in contributions:
+            subcommittee_index = int(contribution.subcommittee_index)
+            for index, participated in enumerate(
+                    contribution.aggregation_bits):
+                if participated:
+                    participant_index = (sync_subcommittee_size
+                                         * subcommittee_index + index)
+                    sync_aggregate.sync_committee_bits[
+                        participant_index] = True
+            signatures.append(contribution.signature)
+        sync_aggregate.sync_committee_signature = bls.Aggregate(
+            [bytes(sig) for sig in signatures])
+        block.body.sync_aggregate = sync_aggregate
+
     def get_contribution_and_proof(self, state, aggregator_index,
                                    contribution, privkey):
         selection_proof = self.get_sync_committee_selection_proof(

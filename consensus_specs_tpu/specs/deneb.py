@@ -182,6 +182,24 @@ class DenebSpec(CapellaSpec):
     g1_lincomb = property(lambda self: self._kzg.g1_lincomb)
     evaluate_polynomial_in_evaluation_form = property(
         lambda self: self._kzg.evaluate_polynomial_in_evaluation_form)
+    # _impl tier + input validation (polynomial-commitments.md:364-521)
+    compute_kzg_proof_impl = property(
+        lambda self: self._kzg.compute_kzg_proof_impl)
+    verify_kzg_proof_impl = property(
+        lambda self: self._kzg.verify_kzg_proof_impl)
+    validate_kzg_g1 = property(lambda self: self._kzg.validate_kzg_g1)
+
+    def compute_roots_of_unity(self, order=None):
+        """Roots of unity in NATURAL order (polynomial-commitments.md
+        :155) — callers bit-reverse as needed, like the markdown does."""
+        from ..crypto.kzg import (
+            BLS_MODULUS, PRIMITIVE_ROOT_OF_UNITY, compute_powers)
+        order = (int(order) if order is not None
+                 else int(self.FIELD_ELEMENTS_PER_BLOB))
+        assert (BLS_MODULUS - 1) % order == 0
+        root = pow(PRIMITIVE_ROOT_OF_UNITY, (BLS_MODULUS - 1) // order,
+                   BLS_MODULUS)
+        return compute_powers(root, order)
 
     bytes_to_bls_field = staticmethod(bytes_to_bls_field)
     bls_field_to_bytes = staticmethod(bls_field_to_bytes)

@@ -110,6 +110,17 @@ class ElectraSpec(DenebSpec):
             data: p.AttestationData
             signature: Bytes96
 
+        # [Modified in Electra] rebuilt over the EIP-7549 Attestation
+        # (electra/validator.md AggregateAndProof/SignedAggregateAndProof)
+        class AggregateAndProof(Container):
+            aggregator_index: uint64
+            aggregate: Attestation
+            selection_proof: Bytes96
+
+        class SignedAggregateAndProof(Container):
+            message: AggregateAndProof
+            signature: Bytes96
+
         class BeaconBlockBody(Container):
             randao_reveal: Bytes96
             eth1_data: p.Eth1Data

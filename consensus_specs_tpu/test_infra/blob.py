@@ -48,10 +48,18 @@ class BlobData:
 def blob_data_patch(spec, blob_data: BlobData):
     """Route spec.retrieve_blobs_and_proofs to `blob_data` for the
     duration (spec instances are cached across tests — restore)."""
+    # save/restore the INSTANCE slot (the spec object is cached across
+    # tests; nesting must unwind to the previous patch, not the class
+    # stub)
+    sentinel = object()
+    saved = spec.__dict__.get("retrieve_blobs_and_proofs", sentinel)
     try:
         # instance attribute shadows the class-level stub
         spec.retrieve_blobs_and_proofs = \
             lambda beacon_block_root: (blob_data.blobs, blob_data.proofs)
         yield
     finally:
-        del spec.retrieve_blobs_and_proofs
+        if saved is sentinel:
+            spec.__dict__.pop("retrieve_blobs_and_proofs", None)
+        else:
+            spec.retrieve_blobs_and_proofs = saved

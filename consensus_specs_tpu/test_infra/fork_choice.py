@@ -20,6 +20,12 @@ from __future__ import annotations
 from ..ssz import hash_tree_root
 
 
+def get_genesis_forkchoice_store(spec, state):
+    """Bare anchor store for unit tests (no steps artifact)."""
+    anchor_block = spec.BeaconBlock(state_root=hash_tree_root(state))
+    return spec.get_forkchoice_store(state, anchor_block)
+
+
 def start_fork_choice_test(spec, state):
     """Build the anchor store and the initial artifacts.
 

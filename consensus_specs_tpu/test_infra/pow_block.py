@@ -37,6 +37,44 @@ def pow_chain_patch(spec, pow_blocks):
         spec.pow_chain.update(saved)
 
 
+class PowChain:
+    """A linked list of PowBlocks, newest last (reference
+    helpers/pow_block.py::PowChain): head(-1) is the parent of head()."""
+
+    def __init__(self, blocks):
+        self.blocks = list(blocks)
+
+    def __iter__(self):
+        return iter(self.blocks)
+
+    def head(self, offset=0):
+        assert offset <= 0
+        return self.blocks[-1 + offset]
+
+
+def prepare_random_pow_chain(spec, length, rng=None) -> PowChain:
+    rng = rng or Random(3131)
+    blocks = []
+    for _ in range(length):
+        block = prepare_random_pow_block(spec, rng)
+        if blocks:
+            block.parent_hash = blocks[-1].block_hash
+        blocks.append(block)
+    return PowChain(blocks)
+
+
+def build_state_with_complete_transition(spec, state):
+    """A state that already merged: non-empty latest payload header."""
+    state = state.copy()
+    if spec.is_merge_transition_complete(state):
+        return state
+    header = spec.ExecutionPayloadHeader()
+    header.block_hash = b"\x11" * 32
+    header.block_number = 1
+    state.latest_execution_payload_header = header
+    return state
+
+
 def build_state_with_incomplete_transition(spec, state):
     """Zero the latest execution payload header: the merge has not
     happened yet from this state's point of view."""

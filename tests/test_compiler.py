@@ -191,3 +191,34 @@ def test_custom_type_cell_allows_type_grammar():
 def test_sequence_arithmetic_is_rejected(payload):
     with pytest.raises(ValueError):
         build_spec([_md_with_constant(payload)])
+
+
+@pytest.mark.parametrize("payload", [
+    # a Bytes4-valued NAME repeated: size multiplies, int bound lies
+    "GENESIS_VER * 4096 * 4096 * 4096",
+    # byte-typed custom-type call repeated
+    "EvilRoot('0x' + '00' * 32) * 4096 * 4096 * 4096",
+])
+def test_byte_valued_name_repetition_is_rejected(payload):
+    md = (
+        "# Evil\n\n## Custom types\n\n"
+        "| Name | SSZ equivalent | Description |\n| - | - | - |\n"
+        "| `EvilRoot` | `Bytes32` | x |\n\n"
+        "## Constants\n\n"
+        "| Name | Value |\n| - | - |\n"
+        "| `GENESIS_VER` | `Bytes4('0x01000000')` |\n"
+        f"| `EVIL_CONST` | `{payload}` |\n"
+    )
+    with pytest.raises(ValueError):
+        build_spec([md])
+
+
+def test_int_name_arithmetic_still_allowed():
+    md = (
+        "# Ok\n\n## Constants\n\n"
+        "| Name | Value |\n| - | - |\n"
+        "| `BASE` | `uint64(2**10)` |\n"
+        "| `DERIVED` | `BASE * BASE` |\n"
+    )
+    mod, _ = build_spec([md])
+    assert mod.DERIVED == 2**20
