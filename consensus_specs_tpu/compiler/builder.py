@@ -88,41 +88,32 @@ _CALLEE_BITS = {
 }
 
 
-# names/callees whose byte-typedness is tracked per build: the thread-
-# local is set by emit_source so the recursive bound helpers (and the
-# cells that reference earlier constants) see the same type knowledge
-# without threading a parameter through every arithmetic case
-_BYTE_NAMES: set = set()
-
-
-def _is_byte_callee(name: str) -> bool:
-    return name.startswith(("Bytes", "ByteVector", "ByteList")) \
-        or name in _BYTE_NAMES
-
-
-def _may_be_sequence(node) -> bool:
-    """Could this subtree evaluate to a str/bytes/tuple/list?  Uses the
-    build's type knowledge (_BYTE_NAMES): a Name bound to a Bytes-typed
-    constant (GENESIS_FORK_VERSION) or a call through a byte-typed
-    custom type (Root('0x…')) is a sequence — repeating one multiplies
-    its size, so the integer Mult bound must not apply to it."""
+def _may_be_sequence(node, seq_names: frozenset) -> bool:
+    """Could this subtree evaluate to a str/bytes/tuple/list?
+    `seq_names` is the build's type knowledge: a Name bound to a
+    byte/tuple-valued constant (GENESIS_FORK_VERSION, a tuple literal)
+    or a call through a byte-typed custom type (Root('0x…')) is a
+    sequence — repeating one multiplies its size, so the integer Mult
+    bound must not apply to it."""
     if isinstance(node, ast.Constant):
         return not isinstance(node.value, (int, bool))
     if isinstance(node, (ast.Tuple, ast.List)):
         return True
     if isinstance(node, ast.Name):
-        return node.id in _BYTE_NAMES
+        return node.id in seq_names
     if isinstance(node, ast.Call):
         callee = node.func.id if isinstance(node.func, ast.Name) else ""
-        return _is_byte_callee(callee)
+        return callee.startswith(("Bytes", "ByteVector", "ByteList")) \
+            or callee in seq_names
     if isinstance(node, ast.BinOp):
-        return _may_be_sequence(node.left) or _may_be_sequence(node.right)
+        return _may_be_sequence(node.left, seq_names) \
+            or _may_be_sequence(node.right, seq_names)
     if isinstance(node, ast.UnaryOp):
-        return _may_be_sequence(node.operand)
+        return _may_be_sequence(node.operand, seq_names)
     return False
 
 
-def _bit_bound(node) -> int:
+def _bit_bound(node, seq_names: frozenset = frozenset()) -> int:
     """Abstract upper bound on the bit-length a cell expression can
     produce when the generated module exec's it.  Names are assumed to
     be ≤256-bit spec constants; exponents/shifts must be small static
@@ -131,7 +122,7 @@ def _bit_bound(node) -> int:
     operand's bound), closing the build-hang DoS a per-node exponent
     check misses."""
     if isinstance(node, ast.Expression):
-        return _bit_bound(node.body)
+        return _bit_bound(node.body, seq_names)
     if isinstance(node, ast.Constant):
         if isinstance(node.value, int):
             return max(int(node.value).bit_length(), 1)
@@ -140,41 +131,45 @@ def _bit_bound(node) -> int:
         # byte-typed names can be wider than any uint (Bytes96 = 768
         # bits; string-literal constants unbounded in principle) — use a
         # bound that still trips the cap after modest repetition
-        return 1024 if node.id in _BYTE_NAMES else 256
+        return 1024 if node.id in seq_names else 256
     if isinstance(node, ast.Call):
         # Python evaluates every argument (positional AND keyword)
         # before the callee runs, so the evaluation COST must stay
         # under the cap regardless of the callee's result width — a
         # cast truncates its result, it does not shrink the 17 GB
         # integer the interpreter built to pass in
-        arg_bits = [_bit_bound(a) for a in node.args]
-        arg_bits += [_bit_bound(kw.value) for kw in node.keywords]
+        arg_bits = [_bit_bound(a, seq_names) for a in node.args]
+        arg_bits += [_bit_bound(kw.value, seq_names)
+                     for kw in node.keywords]
         if max(arg_bits, default=0) > _MAX_CONST_BITS:
             raise ValueError("call argument magnitude exceeds cap")
         callee = node.func.id if isinstance(node.func, ast.Name) else ""
         if callee in _CALLEE_BITS:
             return _CALLEE_BITS[callee]
-        if callee in _BYTE_NAMES:
+        if callee in seq_names:
             return 1024  # byte-typed custom type of statically unknown width
         return max(arg_bits + [256])
     if isinstance(node, ast.Subscript):
         # type expressions: List[X, N * M] — bound the index cost
-        return max(_bit_bound(node.value), _bit_bound(node.slice))
+        return max(_bit_bound(node.value, seq_names),
+                   _bit_bound(node.slice, seq_names))
     if isinstance(node, (ast.Tuple, ast.List)):
-        return max([_bit_bound(e) for e in node.elts] + [1])
+        return max([_bit_bound(e, seq_names)
+                    for e in node.elts] + [1])
     if isinstance(node, ast.UnaryOp):
-        return _bit_bound(node.operand)
+        return _bit_bound(node.operand, seq_names)
     if isinstance(node, ast.BinOp):
         # sequence arithmetic obeys SIZE semantics, not integer bit
         # semantics: repetition multiplies (b'\x00' * 95 is 95 bytes,
         # not a 25-bit number), so it takes a literal, range-bounded
         # count — ('a' * 65000) * 65000 would otherwise slip a ~TB
         # allocation past an integer Mult bound
-        left_seq = _may_be_sequence(node.left)
-        right_seq = _may_be_sequence(node.right)
+        left_seq = _may_be_sequence(node.left, seq_names)
+        right_seq = _may_be_sequence(node.right, seq_names)
         if left_seq or right_seq:
             if isinstance(node.op, ast.Add) and left_seq and right_seq:
-                return _bit_bound(node.left) + _bit_bound(node.right)
+                return _bit_bound(node.left, seq_names) \
+                    + _bit_bound(node.right, seq_names)
             if isinstance(node.op, ast.Mult) and (left_seq != right_seq):
                 seq, count_node = ((node.left, node.right) if left_seq
                                    else (node.right, node.left))
@@ -184,15 +179,15 @@ def _bit_bound(node) -> int:
                     raise ValueError("non-literal repetition count")
                 if not isinstance(count, int) or not 0 <= count <= 4096:
                     raise ValueError("repetition count out of range")
-                return _bit_bound(seq) * max(count, 1)
+                return _bit_bound(seq, seq_names) * max(count, 1)
             raise ValueError("unsupported sequence arithmetic")
-        left = _bit_bound(node.left)
+        left = _bit_bound(node.left, seq_names)
         op = node.op
         if isinstance(op, (ast.Add, ast.Sub, ast.BitOr, ast.BitAnd,
                            ast.Mod, ast.FloorDiv, ast.RShift)):
-            return max(left, _bit_bound(node.right)) + 1
+            return max(left, _bit_bound(node.right, seq_names)) + 1
         if isinstance(op, ast.Mult):
-            return left + _bit_bound(node.right)
+            return left + _bit_bound(node.right, seq_names)
         if isinstance(op, (ast.Pow, ast.LShift)):
             try:
                 exp = _eval_literal(node.right)
@@ -206,7 +201,8 @@ def _bit_bound(node) -> int:
 
 
 def _check_safe_expr(expr: str,
-                     extra_callees: frozenset = frozenset()) -> None:
+                     extra_callees: frozenset = frozenset(),
+                     seq_names: frozenset = frozenset()) -> None:
     """Gate for table cells emitted verbatim into the generated module
     (which is exec'd): only name/call/arithmetic expressions, no
     attribute access, subscripts, lambdas, comprehensions, or dunder
@@ -235,7 +231,7 @@ def _check_safe_expr(expr: str,
                     f"constant cell {expr!r}: call to non-whitelisted "
                     f"callee {callee!r}")
     try:
-        bits = _bit_bound(tree)
+        bits = _bit_bound(tree, seq_names)
     except ValueError as exc:
         raise ValueError(f"constant cell {expr!r}: {exc}")
     if bits > _MAX_CONST_BITS:
@@ -280,7 +276,8 @@ def _check_safe_type_expr(expr: str) -> None:
 
 
 def _const_rhs(expr: str,
-               extra_callees: frozenset = frozenset()) -> str:
+               extra_callees: frozenset = frozenset(),
+               seq_names: frozenset = frozenset()) -> str:
     """Right-hand side for a constant: simple literals collapse to their
     value; anything referencing other names (uint64(...), 10 * BASE) is
     emitted after passing the :func:`_check_safe_expr` whitelist and
@@ -288,60 +285,46 @@ def _const_rhs(expr: str,
     types and earlier constants are in scope."""
     value = parse_value(expr)
     if isinstance(value, str) and value == expr.strip().strip("`"):
-        _check_safe_expr(value, extra_callees)
+        _check_safe_expr(value, extra_callees, seq_names)
         return value        # unresolvable here: defer to module namespace
     return repr(value)
 
 
-def _collect_byte_names(spec) -> set:
-    """Names this build binds to byte/string values: custom types that
-    resolve (transitively) to Bytes*/ByteVector/ByteList, plus
-    constants whose cell is a string literal, a byte-typed cast, or a
-    reference/concatenation of other byte names.  Fixpoint because
-    constants reference each other."""
-    byte_names: set = set()
+def _collect_seq_names(spec) -> frozenset:
+    """Names this build binds to SEQUENCE values (bytes, strings,
+    tuples, lists): custom types that resolve (transitively) to
+    Bytes*/ByteVector/ByteList, plus constants whose cell is a
+    string/tuple/list literal, a byte-typed cast, or a reference/
+    concatenation of other sequence names.  Fixpoint because constants
+    reference each other."""
+    seq_names: set = set()
     changed = True
     while changed:
         changed = False
         for name, texpr in spec.custom_types.items():
-            if name in byte_names:
+            if name in seq_names:
                 continue
             root = texpr.split("[")[0].strip()
             if root.startswith(("Bytes", "ByteVector", "ByteList")) \
-                    or root in byte_names:
-                byte_names.add(name)
+                    or root in seq_names:
+                seq_names.add(name)
                 changed = True
         for name, expr in {**spec.preset_vars,
                            **spec.constants}.items():
-            if name in byte_names:
+            if name in seq_names:
                 continue
             cell = str(expr).strip().strip("`")
             try:
                 body = ast.parse(cell, mode="eval").body
             except SyntaxError:
                 continue
-            seq = (isinstance(body, ast.Constant)
-                   and isinstance(body.value, (str, bytes)))
-            if isinstance(body, ast.Call) \
-                    and isinstance(body.func, ast.Name):
-                callee = body.func.id
-                seq = callee.startswith(
-                    ("Bytes", "ByteVector", "ByteList")) \
-                    or callee in byte_names
-            if isinstance(body, (ast.Name, ast.BinOp)):
-                # alias of / arithmetic over byte names
-                prev = set(_BYTE_NAMES)
-                _BYTE_NAMES.clear()
-                _BYTE_NAMES.update(byte_names)
-                try:
-                    seq = _may_be_sequence(body)
-                finally:
-                    _BYTE_NAMES.clear()
-                    _BYTE_NAMES.update(prev)
-            if seq:
-                byte_names.add(name)
+            # _may_be_sequence covers every cell shape: literals
+            # (str/bytes/tuple/list), byte casts, aliases of and
+            # arithmetic over already-known sequence names
+            if _may_be_sequence(body, frozenset(seq_names)):
+                seq_names.add(name)
                 changed = True
-    return byte_names
+    return frozenset(seq_names)
 
 
 def _dependency_order(defs: dict) -> list:
@@ -431,31 +414,26 @@ def emit_source(spec: ParsedSpec, preset: dict | None = None,
     # legitimate cast targets in constant cells; prelude-defined names
     # are trusted repo code (fork builders), not markdown
     cell_callees = frozenset(spec.custom_types) | frozenset(prelude_names)
-    # type knowledge for the repetition guard: which names hold BYTES
-    # (repeating those multiplies size — see _may_be_sequence)
-    saved_byte_names = set(_BYTE_NAMES)
-    _BYTE_NAMES.clear()
-    _BYTE_NAMES.update(_collect_byte_names(spec))
-    try:
-        scalars: dict[str, str] = {}
-        for name, expr in spec.preset_vars.items():
-            if name not in prelude_names:
-                scalars[name] = (repr(preset[name]) if name in preset
-                                 else _const_rhs(expr, cell_callees))
-        for name, type_expr in spec.custom_types.items():
-            _check_safe_type_expr(type_expr)
-            scalars[name] = type_expr
-        for name, expr in spec.constants.items():
-            if name in prelude_names:
-                continue
-            if expr.strip().rstrip("*") in ("TBD", "N/A"):
-                # draft placeholder (e.g. whisk's CURDLEPROOFS_CRS) — a
-                # definition must come from extra_scalars or the prelude
-                continue
-            scalars[name] = _const_rhs(expr, cell_callees)
-    finally:
-        _BYTE_NAMES.clear()
-        _BYTE_NAMES.update(saved_byte_names)
+    # type knowledge for the repetition guard: which names hold
+    # sequences (repeating those multiplies size — _may_be_sequence)
+    seq_names = _collect_seq_names(spec)
+    scalars: dict[str, str] = {}
+    for name, expr in spec.preset_vars.items():
+        if name not in prelude_names:
+            scalars[name] = (repr(preset[name]) if name in preset
+                             else _const_rhs(expr, cell_callees,
+                                             seq_names))
+    for name, type_expr in spec.custom_types.items():
+        _check_safe_type_expr(type_expr)
+        scalars[name] = type_expr
+    for name, expr in spec.constants.items():
+        if name in prelude_names:
+            continue
+        if expr.strip().rstrip("*") in ("TBD", "N/A"):
+            # draft placeholder (e.g. whisk's CURDLEPROOFS_CRS) — a
+            # definition must come from extra_scalars or the prelude
+            continue
+        scalars[name] = _const_rhs(expr, cell_callees, seq_names)
     for name, rhs in (extra_scalars or {}).items():
         scalars.setdefault(name, rhs)
 

@@ -94,6 +94,23 @@ def check_mods() -> list:
         "transition": ("consensus_specs_tpu.spec_tests.transition",
                        "TRANSITION_HANDLERS"),
     }
+    # pytest-only packages: every test is @no_vectors by design (the
+    # reference excludes test/*/unittests/ from vector generation too);
+    # modules must import and carry decorated tests, nothing emits
+    base_units = "consensus_specs_tpu.spec_tests.unittests."
+    pytest_only = {
+        "unittests": [
+            base_units + m for m in (
+                "test_config_invariants", "test_math", "test_on_tick",
+                "test_on_attestation_units", "test_validator_phase0",
+                "test_validator_altair", "test_validate_merge_block",
+                "test_merge_transition_units",
+                "test_polynomial_commitments",
+                "test_execution_requests", "test_fulu_das",
+                "test_fulu_custody", "test_fulu_networking",
+                "test_fulu_security", "test_misc_units")],
+    }
+
     # suites whose runners reflect them directly (module lists)
     base_random = "consensus_specs_tpu.spec_tests.random."
     base_lc = "consensus_specs_tpu.spec_tests.light_client."
@@ -138,6 +155,18 @@ def check_mods() -> list:
                     f"tests emit no vectors")
             problems.extend(
                 f"{pkg}/{p}" for p in check_handler_modules(registry))
+        elif pkg in pytest_only:
+            reflected = set(pytest_only[pkg])
+            for m in sorted(files - reflected):
+                problems.append(
+                    f"{pkg}: {m} exists but is not in the pytest-only "
+                    f"registry")
+            for m in sorted(reflected - files):
+                problems.append(
+                    f"{pkg}: registered module {m} has no file on disk")
+            problems.extend(
+                f"{pkg}/{p}"
+                for p in check_handler_modules({pkg: pytest_only[pkg]}))
         elif pkg in direct:
             reflected = set(direct[pkg])
             missing = files - reflected

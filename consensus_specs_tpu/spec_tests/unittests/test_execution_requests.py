@@ -1,0 +1,92 @@
+"""EIP-7685 execution-requests (de)serialization units (reference
+test/electra/unittests/test_execution_requests.py, 8 defs)."""
+from ...ssz import uint64
+from ...test_infra.context import (
+    spec_test, no_vectors, with_all_phases_from)
+
+
+def _roundtrip(spec, execution_requests):
+    serialized = spec.get_execution_requests_list(execution_requests)
+    deserialized = spec.get_execution_requests(serialized)
+    assert deserialized == execution_requests
+
+
+@with_all_phases_from("electra")
+@spec_test
+@no_vectors
+def test_requests_serialization_round_trip__empty(spec):
+    _roundtrip(spec, spec.ExecutionRequests())
+
+
+@with_all_phases_from("electra")
+@spec_test
+@no_vectors
+def test_requests_serialization_round_trip__one_request(spec):
+    _roundtrip(spec, spec.ExecutionRequests(
+        deposits=[spec.DepositRequest()]))
+
+
+@with_all_phases_from("electra")
+@spec_test
+@no_vectors
+def test_requests_serialization_round_trip__multiple_requests(spec):
+    _roundtrip(spec, spec.ExecutionRequests(
+        deposits=[spec.DepositRequest()],
+        withdrawals=[spec.WithdrawalRequest()],
+        consolidations=[spec.ConsolidationRequest()]))
+
+
+@with_all_phases_from("electra")
+@spec_test
+@no_vectors
+def test_requests_serialization_round_trip__one_request_with_real_data(
+        spec):
+    _roundtrip(spec, spec.ExecutionRequests(
+        deposits=[spec.DepositRequest(
+            pubkey=b"\xaa" * 48,
+            withdrawal_credentials=b"\xbb" * 32,
+            amount=uint64(11111111),
+            signature=b"\xcc" * 96,
+            index=uint64(22222222))]))
+
+
+def _expect_reject(spec, serialized_requests):
+    try:
+        spec.get_execution_requests(serialized_requests)
+        raise RuntimeError("malformed request list accepted")
+    except (AssertionError, ValueError):
+        pass
+
+
+@with_all_phases_from("electra")
+@spec_test
+@no_vectors
+def test_requests_deserialize__reject_duplicate_request(spec):
+    serialized_withdrawal = 76 * b"\x0a"
+    _expect_reject(spec, [
+        spec.WITHDRAWAL_REQUEST_TYPE + serialized_withdrawal,
+        spec.WITHDRAWAL_REQUEST_TYPE + serialized_withdrawal])
+
+
+@with_all_phases_from("electra")
+@spec_test
+@no_vectors
+def test_requests_deserialize__reject_out_of_order_requests(spec):
+    requests = [spec.WITHDRAWAL_REQUEST_TYPE + 76 * b"\x0a",
+                spec.DEPOSIT_REQUEST_TYPE + 192 * b"\x0b"]
+    assert requests[0][0] > requests[1][0]
+    _expect_reject(spec, requests)
+
+
+@with_all_phases_from("electra")
+@spec_test
+@no_vectors
+def test_requests_deserialize__reject_empty_request(spec):
+    _expect_reject(spec, [b"\x01"])
+
+
+@with_all_phases_from("electra")
+@spec_test
+@no_vectors
+def test_requests_deserialize__reject_unexpected_request_type(spec):
+    _expect_reject(spec, [b"\x03\xff\xff\xff"])

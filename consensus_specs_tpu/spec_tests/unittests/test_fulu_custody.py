@@ -1,0 +1,74 @@
+"""Fulu custody unit battery (reference
+test/fulu/unittests/test_custody.py, 5 defs)."""
+from ...test_infra.context import (
+    spec_test, no_vectors, with_all_phases_from)
+
+
+def _run_get_custody_columns(spec, peer_count, custody_group_count):
+    assignments = [spec.get_custody_groups(node_id, custody_group_count)
+                   for node_id in range(peer_count)]
+    columns_per_group = int(spec.config.NUMBER_OF_COLUMNS) \
+        // int(spec.config.NUMBER_OF_CUSTODY_GROUPS)
+    for assignment in assignments:
+        columns = []
+        for group in assignment:
+            group_columns = spec.compute_columns_for_custody_group(group)
+            assert len(group_columns) == columns_per_group
+            columns.extend(group_columns)
+        assert len(columns) == int(custody_group_count) \
+            * columns_per_group
+        assert len(columns) == len(set(columns))
+
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_get_custody_columns_peers_within_number_of_columns(spec):
+    peer_count = 10
+    assert int(spec.config.NUMBER_OF_COLUMNS) > peer_count
+    _run_get_custody_columns(spec, peer_count,
+                             spec.config.CUSTODY_REQUIREMENT)
+
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_get_custody_columns_peers_more_than_number_of_columns(spec):
+    peer_count = 200
+    assert int(spec.config.NUMBER_OF_COLUMNS) < peer_count
+    _run_get_custody_columns(spec, peer_count,
+                             spec.config.CUSTODY_REQUIREMENT)
+
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_get_custody_columns_maximum_groups(spec):
+    _run_get_custody_columns(spec, 10,
+                             spec.config.NUMBER_OF_CUSTODY_GROUPS)
+
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_get_custody_columns_custody_size_more_than_number_of_groups(
+        spec):
+    try:
+        spec.get_custody_groups(
+            1, int(spec.config.NUMBER_OF_CUSTODY_GROUPS) + 1)
+        raise RuntimeError("oversized custody request accepted")
+    except AssertionError:
+        pass
+
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_compute_columns_for_custody_group_out_of_bound_custody_group(
+        spec):
+    try:
+        spec.compute_columns_for_custody_group(
+            int(spec.config.NUMBER_OF_CUSTODY_GROUPS))
+        raise RuntimeError("out-of-bound custody group accepted")
+    except AssertionError:
+        pass

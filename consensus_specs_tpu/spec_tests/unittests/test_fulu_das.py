@@ -1,0 +1,159 @@
+"""Fulu DAS unit battery (reference
+test/fulu/unittests/das/test_das.py, 9 defs): extended-matrix
+construction/recovery and the extended-sample-count bound.
+
+Matrix tests run on a FRESH FuluSpec with a small insecure dev KZG
+sampling engine (width 128 — the pattern of tests/test_fulu.py), so the
+pure-Python erasure code stays fast while the spec methods under test
+are the real ones."""
+import random
+
+from ...crypto.fields import R as BLS_MODULUS
+from ...crypto.kzg_sampling import KZGSampling
+from ...test_infra.context import (
+    spec_test, no_vectors, with_all_phases_from, with_config_overrides)
+from ...utils.kzg_setup_gen import generate_setup
+
+_DEV_WIDTH = 128
+_dev_engine = None
+
+
+def _dev_spec():
+    """Fresh minimal FuluSpec with the shared dev sampling engine."""
+    global _dev_engine
+    from ...specs.fulu import FuluSpec
+    if _dev_engine is None:
+        _dev_engine = KZGSampling(_DEV_WIDTH, 64,
+                                  setup=generate_setup(_DEV_WIDTH))
+    spec = FuluSpec("minimal")
+    spec._kzg_sampling = _dev_engine
+    return spec
+
+
+def _dev_blob(rng):
+    return b"".join(rng.randrange(BLS_MODULUS).to_bytes(32, "big")
+                    for _ in range(_DEV_WIDTH))
+
+
+def _chunks(lst, n):
+    return [lst[i:i + n] for i in range(0, len(lst), n)]
+
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_compute_matrix(spec):
+    rng = random.Random(5566)
+    spec = _dev_spec()
+    cells_per_ext_blob = spec._kzg_sampling.cells_per_ext_blob
+    blob_count = 2
+    input_blobs = [_dev_blob(rng) for _ in range(blob_count)]
+    matrix = spec.compute_matrix(input_blobs)
+    assert len(matrix) == cells_per_ext_blob * blob_count
+    rows = _chunks(matrix, cells_per_ext_blob)
+    assert len(rows) == blob_count
+    for row in rows:
+        assert len(row) == cells_per_ext_blob
+    for blob_index, row in enumerate(rows):
+        extended_blob = []
+        for entry in row:
+            extended_blob.extend(spec.cell_to_coset_evals(
+                bytes(entry.cell)))
+        blob_part = extended_blob[0:len(extended_blob) // 2]
+        blob = b"".join(x.to_bytes(32, "big") for x in blob_part)
+        assert blob == input_blobs[blob_index]
+
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_recover_matrix(spec):
+    rng = random.Random(5566)
+    spec = _dev_spec()
+    cells_per_ext_blob = spec._kzg_sampling.cells_per_ext_blob
+    n_samples = cells_per_ext_blob // 2
+    blob_count = 2
+    blobs = [_dev_blob(rng) for _ in range(blob_count)]
+    matrix = spec.compute_matrix(blobs)
+    partial_matrix = []
+    for blob_entries in _chunks(matrix, cells_per_ext_blob):
+        rng.shuffle(blob_entries)
+        partial_matrix.extend(blob_entries[:n_samples])
+    recovered = spec.recover_matrix(partial_matrix, blob_count)
+    key = lambda e: (int(e.row_index), int(e.column_index))  # noqa: E731
+    assert sorted(map(key, recovered)) == sorted(map(key, matrix))
+    by_key = {key(e): e for e in matrix}
+    for e in recovered:
+        assert bytes(e.cell) == bytes(by_key[key(e)].cell)
+        assert bytes(e.kzg_proof) == bytes(by_key[key(e)].kzg_proof)
+
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_get_extended_sample_count__1(spec):
+    rng = random.Random(1111)
+    allowed_failures = rng.randint(
+        0, int(spec.config.NUMBER_OF_COLUMNS) // 2)
+    spec.get_extended_sample_count(allowed_failures)
+
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_get_extended_sample_count__2(spec):
+    rng = random.Random(2222)
+    allowed_failures = rng.randint(
+        0, int(spec.config.NUMBER_OF_COLUMNS) // 2)
+    spec.get_extended_sample_count(allowed_failures)
+
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_get_extended_sample_count__3(spec):
+    rng = random.Random(3333)
+    allowed_failures = rng.randint(
+        0, int(spec.config.NUMBER_OF_COLUMNS) // 2)
+    spec.get_extended_sample_count(allowed_failures)
+
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_get_extended_sample_count__lower_bound(spec):
+    spec.get_extended_sample_count(0)
+
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_get_extended_sample_count__upper_bound(spec):
+    spec.get_extended_sample_count(
+        int(spec.config.NUMBER_OF_COLUMNS) // 2)
+
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_get_extended_sample_count__upper_bound_exceed(spec):
+    try:
+        spec.get_extended_sample_count(
+            int(spec.config.NUMBER_OF_COLUMNS) // 2 + 1)
+        raise RuntimeError("out-of-bound allowed_failures accepted")
+    except AssertionError:
+        pass
+
+
+@with_all_phases_from("fulu")
+@with_config_overrides({"NUMBER_OF_COLUMNS": 128,
+                        "SAMPLES_PER_SLOT": 16})
+@spec_test
+@no_vectors
+def test_get_extended_sample_count__table_in_spec(spec):
+    # the worked table from fulu/peer-sampling.md
+    table = {0: 16, 1: 20, 2: 24, 3: 27, 4: 29,
+             5: 32, 6: 35, 7: 37, 8: 40}
+    for allowed_failures, expected in table.items():
+        assert int(spec.get_extended_sample_count(allowed_failures)) \
+            == expected

@@ -1,0 +1,207 @@
+"""Fulu data-column sidecar networking unit battery (reference
+test/fulu/unittests/test_networking.py, 14 defs): structural sidecar
+validation, column KZG batch proofs, commitment inclusion proofs,
+sidecar subnet mapping.
+
+Sidecars are built on a FRESH FuluSpec with the small dev sampling
+engine (width 128) and its column count shrunk to match — the sidecar
+container shapes and merkle machinery are the real ones."""
+import random
+
+from ...crypto.fields import R as BLS_MODULUS
+from ...crypto.kzg_sampling import KZGSampling
+from ...debug.random_value import RandomizationMode, get_random_ssz_object
+from ...ssz import uint64
+from ...test_infra.context import (
+    spec_test, no_vectors, with_all_phases_from)
+from ...utils.kzg_setup_gen import generate_setup
+
+_DEV_WIDTH = 128
+_dev_engine = None
+
+
+def _dev_spec():
+    global _dev_engine
+    from ...specs.fulu import FuluSpec
+    if _dev_engine is None:
+        _dev_engine = KZGSampling(_DEV_WIDTH, 64,
+                                  setup=generate_setup(_DEV_WIDTH))
+    spec = FuluSpec("minimal")
+    spec._kzg_sampling = _dev_engine
+    # column fan-out must match the dev engine's extended-blob shape
+    spec.config = spec.config.replace(
+        NUMBER_OF_COLUMNS=_dev_engine.cells_per_ext_blob)
+    return spec
+
+
+def _compute_data_column_sidecar(spec):
+    """A sidecar from a chaos-random block carrying two real (dev-width)
+    blob commitments (reference compute_data_column_sidecar shape)."""
+    rng = random.Random(5566)
+    blobs = [b"".join(rng.randrange(BLS_MODULUS).to_bytes(32, "big")
+                      for _ in range(_DEV_WIDTH)) for _ in range(2)]
+    commitments = [spec._kzg_sampling.blob_to_kzg_commitment(b)
+                   for b in blobs]
+    block = get_random_ssz_object(
+        rng, spec.BeaconBlock, max_bytes_length=2000,
+        max_list_length=2000, mode=RandomizationMode.RANDOM,
+        chaos=True)
+    block.body.blob_kzg_commitments = [bytes(c) for c in commitments]
+    signed_block = spec.SignedBeaconBlock(message=block,
+                                          signature=b"\x11" * 96)
+    cells_and_kzg_proofs = [
+        spec.compute_cells_and_kzg_proofs(blob) for blob in blobs]
+    return spec.get_data_column_sidecars(signed_block,
+                                         cells_and_kzg_proofs)[0]
+
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_verify_data_column_sidecar__valid(spec):
+    spec = _dev_spec()
+    sidecar = _compute_data_column_sidecar(spec)
+    assert spec.verify_data_column_sidecar(sidecar)
+
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_verify_data_column_sidecar__invalid_zero_blobs(spec):
+    spec = _dev_spec()
+    sidecar = _compute_data_column_sidecar(spec)
+    sidecar.column = []
+    sidecar.kzg_commitments = []
+    sidecar.kzg_proofs = []
+    assert not spec.verify_data_column_sidecar(sidecar)
+
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_verify_data_column_sidecar__invalid_index(spec):
+    spec = _dev_spec()
+    sidecar = _compute_data_column_sidecar(spec)
+    sidecar.index = 128
+    assert not spec.verify_data_column_sidecar(sidecar)
+
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_verify_data_column_sidecar__invalid_mismatch_len_column(spec):
+    spec = _dev_spec()
+    sidecar = _compute_data_column_sidecar(spec)
+    sidecar.column = sidecar.column[1:]
+    assert not spec.verify_data_column_sidecar(sidecar)
+
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_verify_data_column_sidecar__invalid_mismatch_len_kzg_commitments(
+        spec):
+    spec = _dev_spec()
+    sidecar = _compute_data_column_sidecar(spec)
+    sidecar.kzg_commitments = sidecar.kzg_commitments[1:]
+    assert not spec.verify_data_column_sidecar(sidecar)
+
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_verify_data_column_sidecars__invalid_mismatch_len_kzg_proofs(
+        spec):
+    spec = _dev_spec()
+    sidecar = _compute_data_column_sidecar(spec)
+    sidecar.kzg_proofs = sidecar.kzg_proofs[1:]
+    assert not spec.verify_data_column_sidecar(sidecar)
+
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_verify_data_column_sidecar_kzg_proofs__valid(spec):
+    spec = _dev_spec()
+    sidecar = _compute_data_column_sidecar(spec)
+    assert spec.verify_data_column_sidecar_kzg_proofs(sidecar)
+
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_verify_data_column_sidecar_kzg_proofs__invalid_wrong_column(
+        spec):
+    spec = _dev_spec()
+    sidecar = _compute_data_column_sidecar(spec)
+    sidecar.column[0] = sidecar.column[1]
+    assert not spec.verify_data_column_sidecar_kzg_proofs(sidecar)
+
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_verify_data_column_sidecar_kzg_proofs__invalid_wrong_commitment(
+        spec):
+    spec = _dev_spec()
+    sidecar = _compute_data_column_sidecar(spec)
+    sidecar.kzg_commitments[0] = sidecar.kzg_commitments[1]
+    assert not spec.verify_data_column_sidecar_kzg_proofs(sidecar)
+
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_verify_data_column_sidecar_kzg_proofs__invalid_wrong_proof(spec):
+    spec = _dev_spec()
+    sidecar = _compute_data_column_sidecar(spec)
+    sidecar.kzg_proofs[0] = sidecar.kzg_proofs[1]
+    assert not spec.verify_data_column_sidecar_kzg_proofs(sidecar)
+
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_verify_data_column_sidecar_inclusion_proof__valid(spec):
+    spec = _dev_spec()
+    sidecar = _compute_data_column_sidecar(spec)
+    assert spec.verify_data_column_sidecar_inclusion_proof(sidecar)
+
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_verify_data_column_sidecar_inclusion_proof__invalid_missing_commitment(
+        spec):
+    spec = _dev_spec()
+    sidecar = _compute_data_column_sidecar(spec)
+    sidecar.kzg_commitments = sidecar.kzg_commitments[1:]
+    assert not spec.verify_data_column_sidecar_inclusion_proof(sidecar)
+
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_verify_data_column_sidecar_inclusion_proof__invalid_duplicate_commitment(
+        spec):
+    spec = _dev_spec()
+    sidecar = _compute_data_column_sidecar(spec)
+    sidecar.kzg_commitments = list(sidecar.kzg_commitments) \
+        + [sidecar.kzg_commitments[0]]
+    assert not spec.verify_data_column_sidecar_inclusion_proof(sidecar)
+
+
+@with_all_phases_from("fulu")
+@spec_test
+@no_vectors
+def test_compute_subnet_for_data_column_sidecar(spec):
+    subnet_results = []
+    for column_index in range(
+            int(spec.config.DATA_COLUMN_SIDECAR_SUBNET_COUNT)):
+        subnet = spec.compute_subnet_for_data_column_sidecar(
+            uint64(column_index))
+        assert int(subnet) \
+            < int(spec.config.DATA_COLUMN_SIDECAR_SUBNET_COUNT)
+        subnet_results.append(int(subnet))
+    # no duplicates within one subnet-count span
+    assert len(subnet_results) == len(set(subnet_results))

@@ -1,0 +1,21 @@
+"""Fulu sampling security/bandwidth invariants (reference
+test/fulu/unittests/test_security.py, 1 def — mainnet numbers)."""
+from ...test_infra.context import (
+    spec_test, no_vectors, with_all_phases_from, with_presets)
+
+
+@with_all_phases_from("fulu")
+@with_presets(["mainnet"],
+              reason="security/bandwidth budgets are mainnet numbers")
+@spec_test
+@no_vectors
+def test_sampling_config(spec):
+    probability_of_unavailable = 2 ** (
+        -int(spec.config.SAMPLES_PER_SLOT))
+    assert probability_of_unavailable <= 0.01
+    column_size_in_bytes = (int(spec.FIELD_ELEMENTS_PER_CELL)
+                            * int(spec.BYTES_PER_FIELD_ELEMENT)
+                            * int(spec.config.MAX_BLOBS_PER_BLOCK))
+    bytes_per_slot = column_size_in_bytes \
+        * int(spec.config.SAMPLES_PER_SLOT)
+    assert bytes_per_slot // int(spec.config.SECONDS_PER_SLOT) < 10000

@@ -1,0 +1,177 @@
+"""Remaining per-fork unit batteries in one module:
+
+- altair config-override units (reference
+  test/altair/unittests/test_config_override.py, 3 defs)
+- altair sync-subnet pubkeys (test/altair/unittests/networking/
+  test_networking.py, 2 defs)
+- deneb blob-sidecar inclusion proofs (test/deneb/unittests/validator/
+  test_validator.py, 3 defs)
+"""
+import random
+
+from ...ssz import hash_tree_root, uint64
+from ...test_infra.context import (
+    spec_state_test, no_vectors, with_all_phases, with_all_phases_from,
+    with_phases, with_config_overrides, never_bls)
+from ...test_infra.blob import get_sample_blob_tx
+from ...test_infra.blocks import (
+    build_empty_block_for_next_slot, sign_block, transition_to)
+
+# --- config override ------------------------------------------------------
+
+
+@with_phases(["altair"])
+@with_config_overrides({"GENESIS_FORK_VERSION": "0x12345678",
+                        "ALTAIR_FORK_VERSION": "0x11111111",
+                        "ALTAIR_FORK_EPOCH": 4})
+@spec_state_test
+@no_vectors
+@never_bls
+def test_config_override(spec, state):
+    assert int(spec.config.ALTAIR_FORK_EPOCH) == 4
+    assert spec.config.GENESIS_FORK_VERSION != "0x00000000"
+    assert spec.config.GENESIS_FORK_VERSION == "0x12345678"
+    assert spec.config.ALTAIR_FORK_VERSION == "0x11111111"
+    assert bytes(state.fork.current_version) == bytes.fromhex("11111111")
+
+
+@with_all_phases
+@spec_state_test
+@no_vectors
+@never_bls
+def test_config_override_matching_fork_epochs(spec, state):
+    """The genesis state's fork version is its own fork's configured
+    version, and the config's fork-epoch schedule is monotonic
+    (the reference asserts this under a zeroed-epoch config; our
+    harness builds states at the fork directly, so the state-side
+    check binds version, not epoch)."""
+    version_fields = {"phase0": "GENESIS_FORK_VERSION"}
+    for f in ("altair", "bellatrix", "capella", "deneb", "electra",
+              "fulu"):
+        version_fields[f] = f"{f.upper()}_FORK_VERSION"
+    field = version_fields.get(spec.fork)
+    if field is not None and hasattr(spec.config, field):
+        assert bytes(state.fork.current_version) == bytes.fromhex(
+            str(getattr(spec.config, field))[2:])
+    # schedule monotonicity where epochs are configured
+    prev = 0
+    for f in ("ALTAIR", "BELLATRIX", "CAPELLA", "DENEB", "ELECTRA"):
+        epoch_field = f"{f}_FORK_EPOCH"
+        if hasattr(spec.config, epoch_field):
+            cur = int(getattr(spec.config, epoch_field))
+            assert cur >= prev
+            prev = cur
+
+
+@with_phases(["altair"])
+@with_config_overrides({"ALTAIR_FORK_VERSION": "0x11111111"})
+@spec_state_test
+@no_vectors
+@never_bls
+def test_config_override_isolation(spec, state):
+    """Overrides live on a per-test spec instance; the cached default
+    target is untouched (the reference's across-phases isolation
+    property)."""
+    from ...specs import get_spec
+    assert spec.config.ALTAIR_FORK_VERSION == "0x11111111"
+    default_spec = get_spec("altair", "minimal")
+    assert default_spec.config.ALTAIR_FORK_VERSION != "0x11111111"
+
+
+# --- altair networking ----------------------------------------------------
+
+
+def _check_subcommittee_pubkeys(spec, state, committee):
+    size = int(spec.SYNC_COMMITTEE_SIZE) \
+        // int(spec.SYNC_COMMITTEE_SUBNET_COUNT)
+    subcommittee_index = 1
+    i = subcommittee_index * size
+    expect = [bytes(k) for k in committee.pubkeys[i:i + size]]
+    got = [bytes(k) for k in spec.get_sync_subcommittee_pubkeys(
+        state, subcommittee_index)]
+    assert got == expect
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@no_vectors
+@never_bls
+def test_get_sync_subcommittee_pubkeys_current_sync_committee(spec, state):
+    transition_to(spec, state,
+                  uint64(int(spec.SLOTS_PER_EPOCH)
+                         * int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)))
+    next_slot_epoch = spec.compute_epoch_at_slot(
+        uint64(int(state.slot) + 1))
+    assert spec.compute_sync_committee_period(
+        spec.get_current_epoch(state)) \
+        == spec.compute_sync_committee_period(next_slot_epoch)
+    _check_subcommittee_pubkeys(spec, state,
+                                state.current_sync_committee)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@no_vectors
+@never_bls
+def test_get_sync_subcommittee_pubkeys_next_sync_committee(spec, state):
+    transition_to(spec, state,
+                  uint64(int(spec.SLOTS_PER_EPOCH)
+                         * int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)
+                         - 1))
+    next_slot_epoch = spec.compute_epoch_at_slot(
+        uint64(int(state.slot) + 1))
+    assert spec.compute_sync_committee_period(
+        spec.get_current_epoch(state)) \
+        != spec.compute_sync_committee_period(next_slot_epoch)
+    _check_subcommittee_pubkeys(spec, state, state.next_sync_committee)
+
+
+# --- deneb blob sidecar inclusion proofs ----------------------------------
+
+
+def _sample_sidecars(spec, state, rng):
+    block = build_empty_block_for_next_slot(spec, state)
+    opaque_tx_1, blobs_1, commitments_1, proofs_1 = get_sample_blob_tx(
+        spec, blob_count=2, rng=rng)
+    opaque_tx_2, blobs_2, commitments_2, proofs_2 = get_sample_blob_tx(
+        spec, blob_count=2, rng=rng)
+    assert opaque_tx_1 != opaque_tx_2
+    block.body.blob_kzg_commitments = commitments_1 + commitments_2
+    block.body.execution_payload.transactions = [opaque_tx_1, opaque_tx_2]
+    signed_block = sign_block(spec, state, block)
+    return spec.get_blob_sidecars(signed_block, blobs_1 + blobs_2,
+                                  proofs_1 + proofs_2)
+
+
+@with_all_phases_from("deneb", to="electra")
+@spec_state_test
+@no_vectors
+@never_bls
+def test_blob_sidecar_inclusion_proof_correct(spec, state):
+    rng = random.Random(1234)
+    for sidecar in _sample_sidecars(spec, state, rng):
+        assert spec.verify_blob_sidecar_inclusion_proof(sidecar)
+
+
+@with_all_phases_from("deneb", to="electra")
+@spec_state_test
+@no_vectors
+@never_bls
+def test_blob_sidecar_inclusion_proof_incorrect_wrong_body(spec, state):
+    rng = random.Random(1234)
+    for sidecar in _sample_sidecars(spec, state, rng):
+        header = sidecar.signed_block_header.message
+        header.body_root = spec.hash(bytes(header.body_root))
+        assert not spec.verify_blob_sidecar_inclusion_proof(sidecar)
+
+
+@with_all_phases_from("deneb", to="electra")
+@spec_state_test
+@no_vectors
+@never_bls
+def test_blob_sidecar_inclusion_proof_incorrect_wrong_proof(spec, state):
+    rng = random.Random(1234)
+    for sidecar in _sample_sidecars(spec, state, rng):
+        sidecar.kzg_commitment_inclusion_proof = [
+            b"\x00" * 32] * int(spec.KZG_COMMITMENT_INCLUSION_PROOF_DEPTH)
+        assert not spec.verify_blob_sidecar_inclusion_proof(sidecar)

@@ -660,6 +660,22 @@ class AltairSpec(LightClientMixin, Phase0Spec):
         return bytes_to_uint64(
             self.hash(bytes(signature))[0:8]) % modulo == 0
 
+    def get_sync_subcommittee_pubkeys(self, state, subcommittee_index):
+        """Pubkeys of one sync subnet's subcommittee
+        (altair/p2p-interface.md)."""
+        next_slot_epoch = self.compute_epoch_at_slot(
+            uint64(state.slot + 1))
+        if self.compute_sync_committee_period(
+                self.get_current_epoch(state)) \
+                == self.compute_sync_committee_period(next_slot_epoch):
+            sync_committee = state.current_sync_committee
+        else:
+            sync_committee = state.next_sync_committee
+        size = (self.SYNC_COMMITTEE_SIZE
+                // self.SYNC_COMMITTEE_SUBNET_COUNT)
+        i = int(subcommittee_index) * size
+        return list(sync_committee.pubkeys[i:i + size])
+
     def process_sync_committee_contributions(self, block,
                                              contributions) -> None:
         """Assemble the block's SyncAggregate out of per-subnet

@@ -728,6 +728,45 @@ class ElectraSpec(DenebSpec):
                 for request_type, request_data in requests
                 if len(request_data) != 0]
 
+    def get_execution_requests(self, execution_requests_list):
+        """EIP-7685 decoding (electra/validator.md:198): typed request
+        chunks in strictly ascending type order, no empties."""
+        from ..ssz import List
+        deposits: list = []
+        withdrawals: list = []
+        consolidations: list = []
+        request_types = [self.DEPOSIT_REQUEST_TYPE,
+                         self.WITHDRAWAL_REQUEST_TYPE,
+                         self.CONSOLIDATION_REQUEST_TYPE]
+        prev_request_type = None
+        for request in execution_requests_list:
+            request_type, request_data = \
+                bytes(request[0:1]), bytes(request[1:])
+            assert request_type in request_types
+            assert len(request_data) != 0
+            # strictly ascending, no duplicates
+            assert prev_request_type is None \
+                or prev_request_type < request_type
+            prev_request_type = request_type
+            if request_type == self.DEPOSIT_REQUEST_TYPE:
+                deposits = List[
+                    self.DepositRequest,
+                    self.MAX_DEPOSIT_REQUESTS_PER_PAYLOAD
+                ].deserialize(request_data)
+            elif request_type == self.WITHDRAWAL_REQUEST_TYPE:
+                withdrawals = List[
+                    self.WithdrawalRequest,
+                    self.MAX_WITHDRAWAL_REQUESTS_PER_PAYLOAD
+                ].deserialize(request_data)
+            elif request_type == self.CONSOLIDATION_REQUEST_TYPE:
+                consolidations = List[
+                    self.ConsolidationRequest,
+                    self.MAX_CONSOLIDATION_REQUESTS_PER_PAYLOAD
+                ].deserialize(request_data)
+        return self.ExecutionRequests(
+            deposits=deposits, withdrawals=withdrawals,
+            consolidations=consolidations)
+
     def process_execution_payload(self, state, body,
                                   execution_engine) -> None:
         payload = body.execution_payload

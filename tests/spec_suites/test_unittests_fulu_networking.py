@@ -1,0 +1,2 @@
+"""pytest collection shim for the dual-mode spec suite."""
+from consensus_specs_tpu.spec_tests.unittests.test_fulu_networking import *  # noqa: F401,F403

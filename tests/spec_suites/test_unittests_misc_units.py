@@ -1,0 +1,2 @@
+"""pytest collection shim for the dual-mode spec suite."""
+from consensus_specs_tpu.spec_tests.unittests.test_misc_units import *  # noqa: F401,F403

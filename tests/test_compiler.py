@@ -222,3 +222,14 @@ def test_int_name_arithmetic_still_allowed():
     )
     mod, _ = build_spec([md])
     assert mod.DERIVED == 2**20
+
+
+def test_tuple_valued_name_repetition_is_rejected():
+    md = (
+        "# Evil\n\n## Constants\n\n"
+        "| Name | Value |\n| - | - |\n"
+        "| `TUP` | `(1, 2)` |\n"
+        "| `EVIL_CONST` | `TUP * 4096 * 4096 * 4096` |\n"
+    )
+    with pytest.raises(ValueError):
+        build_spec([md])
