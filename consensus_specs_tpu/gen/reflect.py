@@ -108,7 +108,8 @@ def check_mods() -> list:
                 "test_polynomial_commitments",
                 "test_execution_requests", "test_fulu_das",
                 "test_fulu_custody", "test_fulu_networking",
-                "test_fulu_security", "test_misc_units")],
+                "test_fulu_security", "test_misc_units",
+                "test_lc_sync_protocol")],
     }
 
     # suites whose runners reflect them directly (module lists)
@@ -127,6 +128,10 @@ def check_mods() -> list:
             # data_collection is deliberately no_vectors (unit-style,
             # like the reference's pytest-only collection battery)
             base_lc + "test_data_collection",
+            # reflected by the merkle_proof runner, not the LC runner
+            base_lc + "test_single_merkle_proof",
+            # cross-fork store upgrades; unit-style (no_vectors)
+            base_lc + "test_fork_upgrades",
         ],
     }
 

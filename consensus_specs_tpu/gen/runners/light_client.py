@@ -64,38 +64,27 @@ def _bootstrap_case(fork):
         case_fn=fn)
 
 
-def _sync_committee_proof_case(fork):
-    def fn():
-        spec = _lc_spec(fork)
-        state, _blocks = _chain(spec)
-        from ...ssz.proofs import (
-            compute_merkle_proof, get_subtree_index,
-            get_generalized_index_length)
-        gindex = spec.current_sync_committee_gindex_at_slot(state.slot)
-        branch = compute_merkle_proof(state, gindex)
-        leaf = bytes(hash_tree_root(state.current_sync_committee))
-        from ...ssz.merkle import is_valid_merkle_branch
-        assert is_valid_merkle_branch(
-            leaf, branch, get_generalized_index_length(gindex),
-            get_subtree_index(gindex), hash_tree_root(state))
-        yield "object", state.copy()
-        yield "proof", "data", {
-            "leaf": "0x" + leaf.hex(),
-            "leaf_index": int(gindex),
-            "branch": ["0x" + bytes(b).hex() for b in branch],
-        }
-    return TestCase(
-        fork_name=fork, preset_name="minimal",
-        runner_name="light_client",
-        handler_name="single_merkle_proof", suite_name="BeaconState",
-        case_name="current_sync_committee_merkle_proof", case_fn=fn)
-
-
 def providers():
     def make_cases():
         for fork in FORKS:
             yield _bootstrap_case(fork)
-            yield _sync_committee_proof_case(fork)
+        # per-fork LC gindex proof batteries, reflected from the
+        # dual-mode suite (reference test/*/light_client/
+        # test_single_merkle_proof.py; supersedes the old hand-built
+        # current_sync_committee case to avoid double emission)
+        from ...spec_tests.light_client import test_single_merkle_proof \
+            as lc_proofs
+        for fn, suite in (
+                (lc_proofs.test_current_sync_committee_merkle_proof,
+                 "BeaconState"),
+                (lc_proofs.test_next_sync_committee_merkle_proof,
+                 "BeaconState"),
+                (lc_proofs.test_finality_root_merkle_proof,
+                 "BeaconState"),
+                (lc_proofs.test_execution_merkle_proof,
+                 "BeaconBlockBody")):
+            yield from fn.make_vector_cases(
+                "light_client", "single_merkle_proof", suite_name=suite)
         # step-driven sync scenarios, reflected from the dual-mode suite
         # (format tests/formats/light_client/sync.md counterpart)
         from ..reflect import generate_from_tests

@@ -77,6 +77,9 @@ def _finalized_root_proof_case(fork):
 
 
 def providers():
+    # the LC gindex proof batteries emit under the light_client runner
+    # (reference generators/light_client lists single_merkle_proof;
+    # generators/merkle_proof carries only the deneb+ blob proofs)
     def make_cases():
         for fork in FORKS:
             yield _blob_commitments_proof_case(fork)

@@ -1,0 +1,137 @@
+"""Light-client sync across fork boundaries (reference
+test/bellatrix/light_client/test_sync.py test_capella_fork +
+variants, test/capella/light_client/test_sync.py test_deneb_fork /
+test_deneb_electra_fork, test/deneb/light_client/test_sync.py
+test_electra_fork — 6 defs).
+
+Each case drives one LC store through real fork transitions: process a
+pre-fork update, upgrade the store with upgrade_lc_store_from
+(capella+ light-client/fork.md), transition the chain across the
+boundary, then process a post-fork update — the store must track the
+post-fork optimistic head."""
+from ...ssz import hash_tree_root, uint64
+from ...test_infra.context import (
+    spec_test, no_vectors, with_phases, with_presets, always_bls,
+    _genesis_state, default_balances, default_activation_threshold)
+from ...test_infra.fork_transition import transition_across
+from ...test_infra.light_client_sync import build_chain, make_update
+
+_FORK_ORDER = ["altair", "bellatrix", "capella", "deneb", "electra",
+               "fulu"]
+
+
+def _specs_for_chain(base_spec, fork_chain):
+    """Spec instances for every fork in `fork_chain`, under ONE config:
+    forks up to the base pinned at epoch 0, each later chain fork at
+    epoch i (so boundary i sits at slot i*SLOTS_PER_EPOCH)."""
+    from ...specs import get_spec
+    overrides = {}
+    for name in _FORK_ORDER[:_FORK_ORDER.index(fork_chain[0]) + 1]:
+        overrides[f"{name.upper()}_FORK_EPOCH"] = 0
+    for i, fork in enumerate(fork_chain[1:], start=1):
+        overrides[f"{fork.upper()}_FORK_EPOCH"] = i
+    config = base_spec.config.replace(**overrides)
+    return [get_spec(fork, base_spec.preset_name, config)
+            for fork in fork_chain]
+
+
+def _process_segment(spec, state, store, n_blocks=3):
+    """Extend the chain and feed the newest update into the store."""
+    states, blocks = build_chain(spec, n_blocks, state)
+    update = make_update(spec, states, blocks,
+                         signature_index=len(blocks) - 1)
+    spec.process_light_client_update(
+        store, update, uint64(int(state.slot) + 1),
+        state.genesis_validators_root)
+    assert store.optimistic_header == update.attested_header
+    return update
+
+
+def _run_lc_fork_sync(base_spec, fork_chain):
+    specs = _specs_for_chain(base_spec, fork_chain)
+    spec = specs[0]
+    state = _genesis_state(spec, default_balances,
+                           default_activation_threshold,
+                           f"lc-fork-{'-'.join(fork_chain)}")
+    state = state.copy()
+
+    # bootstrap at the genesis block
+    trusted_block = spec.SignedBeaconBlock()
+    trusted_block.message.state_root = hash_tree_root(state)
+    bootstrap = spec.create_light_client_bootstrap(state, trusted_block)
+    store = spec.initialize_light_client_store(
+        hash_tree_root(trusted_block.message), bootstrap)
+    store.next_sync_committee = state.next_sync_committee
+
+    # pre-fork update under the first spec
+    _process_segment(spec, state, store)
+
+    for i, next_spec in enumerate(specs[1:], start=1):
+        state, _block = transition_across(spec, next_spec, state,
+                                          fork_epoch=i)
+        # the store upgrades locally, ahead of any post-fork data
+        store = next_spec.upgrade_lc_store_from(store)
+        spec = next_spec
+        update = _process_segment(spec, state, store)
+        assert store.optimistic_header == update.attested_header
+    # the store's headers really are instances of the FINAL fork's LC
+    # header class (a no-op upgrade would leave the pre-fork class)
+    final_header_cls = spec._lc()["LightClientHeader"]
+    assert isinstance(store.finalized_header, final_header_cls)
+    assert isinstance(store.optimistic_header, final_header_cls)
+    yield "fork_chain", "meta", list(fork_chain)
+
+
+@with_phases(["bellatrix"])
+@with_presets(["minimal"], reason="too slow")
+@spec_test
+@no_vectors
+@always_bls
+def test_capella_fork(spec):
+    yield from _run_lc_fork_sync(spec, ["bellatrix", "capella"])
+
+
+@with_phases(["bellatrix"])
+@with_presets(["minimal"], reason="too slow")
+@spec_test
+@no_vectors
+@always_bls
+def test_capella_deneb_fork(spec):
+    yield from _run_lc_fork_sync(spec, ["bellatrix", "capella", "deneb"])
+
+
+@with_phases(["bellatrix"])
+@with_presets(["minimal"], reason="too slow")
+@spec_test
+@no_vectors
+@always_bls
+def test_capella_deneb_electra_fork(spec):
+    yield from _run_lc_fork_sync(
+        spec, ["bellatrix", "capella", "deneb", "electra"])
+
+
+@with_phases(["capella"])
+@with_presets(["minimal"], reason="too slow")
+@spec_test
+@no_vectors
+@always_bls
+def test_deneb_fork(spec):
+    yield from _run_lc_fork_sync(spec, ["capella", "deneb"])
+
+
+@with_phases(["capella"])
+@with_presets(["minimal"], reason="too slow")
+@spec_test
+@no_vectors
+@always_bls
+def test_deneb_electra_fork(spec):
+    yield from _run_lc_fork_sync(spec, ["capella", "deneb", "electra"])
+
+
+@with_phases(["deneb"])
+@with_presets(["minimal"], reason="too slow")
+@spec_test
+@no_vectors
+@always_bls
+def test_electra_fork(spec):
+    yield from _run_lc_fork_sync(spec, ["deneb", "electra"])

@@ -1,0 +1,87 @@
+"""Per-fork single-merkle-proof batteries for the light-client data
+paths (reference test/altair/light_client/test_single_merkle_proof.py
+3 defs, test/capella/light_client/test_single_merkle_proof.py 1 def):
+branch extraction + verification for the sync-committee/finality
+gindices an LC server proves, and capella's execution-payload branch.
+
+Emitted through the merkle_proof runner (handler single_merkle_proof,
+suites BeaconState / BeaconBlockBody) like the reference's
+tests/generators/merkle_proof."""
+from ...ssz import hash_tree_root
+from ...ssz.merkle import is_valid_merkle_branch
+from ...ssz.proofs import compute_merkle_proof, get_subtree_index
+from ...specs.light_client import floorlog2
+from ...test_infra.attestations import state_transition_with_full_block
+from ...test_infra.context import (
+    spec_state_test, with_all_phases_from, with_pytest_fork_subset,
+    never_bls)
+
+LC_PROOF_FORKS = ["altair", "electra"]
+
+
+def _run_state_proof(spec, state, gindex, leaf):
+    branch = compute_merkle_proof(state, gindex)
+    yield "object", state.copy()
+    yield "proof", "data", {
+        "leaf": "0x" + bytes(leaf).hex(),
+        "leaf_index": int(gindex),
+        "branch": ["0x" + bytes(root).hex() for root in branch],
+    }
+    assert is_valid_merkle_branch(
+        bytes(leaf), branch, floorlog2(gindex),
+        get_subtree_index(gindex), hash_tree_root(state))
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(LC_PROOF_FORKS)
+@spec_state_test
+@never_bls
+def test_current_sync_committee_merkle_proof(spec, state):
+    yield from _run_state_proof(
+        spec, state,
+        spec.latest_current_sync_committee_gindex(),
+        hash_tree_root(state.current_sync_committee))
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(LC_PROOF_FORKS)
+@spec_state_test
+@never_bls
+def test_next_sync_committee_merkle_proof(spec, state):
+    yield from _run_state_proof(
+        spec, state,
+        spec.latest_next_sync_committee_gindex(),
+        hash_tree_root(state.next_sync_committee))
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(LC_PROOF_FORKS)
+@spec_state_test
+@never_bls
+def test_finality_root_merkle_proof(spec, state):
+    yield from _run_state_proof(
+        spec, state,
+        spec.latest_finalized_root_gindex(),
+        state.finalized_checkpoint.root)
+
+
+@with_all_phases_from("capella")
+@with_pytest_fork_subset(["capella", "electra"])
+@spec_state_test
+@never_bls
+def test_execution_merkle_proof(spec, state):
+    signed_block = state_transition_with_full_block(spec, state, True,
+                                                    False)
+    body = signed_block.message.body
+    gindex = spec.execution_payload_gindex()
+    branch = compute_merkle_proof(body, gindex)
+    leaf = hash_tree_root(body.execution_payload)
+    yield "object", body
+    yield "proof", "data", {
+        "leaf": "0x" + bytes(leaf).hex(),
+        "leaf_index": int(gindex),
+        "branch": ["0x" + bytes(root).hex() for root in branch],
+    }
+    assert is_valid_merkle_branch(
+        bytes(leaf), branch, floorlog2(gindex),
+        get_subtree_index(gindex), hash_tree_root(body))

@@ -1,0 +1,203 @@
+"""Light-client sync-protocol unit battery (reference
+test/altair/unittests/light_client/test_sync_protocol.py, 4 defs):
+process_light_client_update store-state assertions around timeouts,
+period boundaries, and finality advances."""
+from ...ssz import hash_tree_root, uint64
+from ...test_infra.context import (
+    spec_state_test, no_vectors, with_all_phases_from, with_presets,
+    with_pytest_fork_subset, always_bls, _genesis_state,
+    default_balances, default_activation_threshold)
+from ...test_infra.attestations import (
+    next_epoch_with_attestations, state_transition_with_full_block)
+from ...test_infra.blocks import transition_to
+from ...test_infra.light_client_sync import build_sync_aggregate
+from ...ssz.proofs import compute_merkle_proof
+
+LC_FORKS = ["altair", "capella"]
+
+
+def _lc_spec_and_state(spec):
+    """LC protocol functions are fork-epoch-gated; pin every active
+    fork's epoch to 0 (the with_config_overrides LC pattern of
+    test_sync.py) and build a genesis state under that config."""
+    from ...specs import get_spec
+    overrides = {}
+    for name in ["ALTAIR", "BELLATRIX", "CAPELLA", "DENEB", "ELECTRA",
+                 "FULU"]:
+        if spec.is_post(name.lower()):
+            overrides[f"{name}_FORK_EPOCH"] = 0
+    spec = get_spec(spec.fork, spec.preset_name,
+                    spec.config.replace(**overrides))
+    state = _genesis_state(spec, default_balances,
+                           default_activation_threshold, "lc-units")
+    return spec, state
+
+
+def _setup_test(spec, state):
+    trusted_block = spec.SignedBeaconBlock()
+    trusted_block.message.state_root = hash_tree_root(state)
+    trusted_block_root = hash_tree_root(trusted_block.message)
+    bootstrap = spec.create_light_client_bootstrap(state, trusted_block)
+    store = spec.initialize_light_client_store(trusted_block_root,
+                                               bootstrap)
+    store.next_sync_committee = state.next_sync_committee
+    return trusted_block, store
+
+
+def _create_update(spec, attested_state, attested_block, finalized_block,
+                   with_next, with_finality, participation_rate):
+    """Update with independently togglable next-committee and finality
+    sections (reference helpers/light_client.py::create_update)."""
+    types = spec._lc()
+    update = types["LightClientUpdate"]()
+    update.attested_header = spec.block_to_light_client_header(
+        attested_block)
+    if with_next:
+        update.next_sync_committee = attested_state.next_sync_committee
+        update.next_sync_committee_branch = compute_merkle_proof(
+            attested_state, spec.next_sync_committee_gindex_at_slot(
+                attested_state.slot))
+    if with_finality:
+        update.finalized_header = spec.block_to_light_client_header(
+            finalized_block)
+        update.finality_branch = compute_merkle_proof(
+            attested_state, spec.finalized_root_gindex_at_slot(
+                attested_state.slot))
+    signature_slot = uint64(int(attested_block.message.slot) + 1)
+    update.sync_aggregate = build_sync_aggregate(
+        spec, attested_state, signature_slot,
+        hash_tree_root(attested_block.message),
+        participation=participation_rate)
+    update.signature_slot = signature_slot
+    return update
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(LC_FORKS)
+@spec_state_test
+@no_vectors
+@always_bls
+def test_process_light_client_update_not_timeout(spec, state):
+    spec, state = _lc_spec_and_state(spec)
+    genesis_block, store = _setup_test(spec, state)
+    attested_block = state_transition_with_full_block(spec, state,
+                                                      False, False)
+    signature_slot = uint64(int(state.slot) + 1)
+    assert int(state.finalized_checkpoint.epoch) == 0
+    update = _create_update(spec, state, attested_block, genesis_block,
+                            with_next=False, with_finality=False,
+                            participation_rate=1.0)
+    pre_finalized = store.finalized_header.copy()
+    spec.process_light_client_update(store, update, signature_slot,
+                                     state.genesis_validators_root)
+    assert store.finalized_header == pre_finalized
+    assert store.best_valid_update == update
+    assert store.optimistic_header == update.attested_header
+    assert int(store.current_max_active_participants) > 0
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(LC_FORKS)
+@with_presets(["minimal"], reason="too slow")
+@spec_state_test
+@no_vectors
+@always_bls
+def test_process_light_client_update_at_period_boundary(spec, state):
+    spec, state = _lc_spec_and_state(spec)
+    genesis_block, store = _setup_test(spec, state)
+    # final slot of the store's period
+    transition_to(spec, state,
+                  uint64(int(state.slot) + int(spec.UPDATE_TIMEOUT) - 2))
+    store_period = spec.compute_sync_committee_period_at_slot(
+        store.optimistic_header.beacon.slot)
+    update_period = spec.compute_sync_committee_period_at_slot(
+        state.slot)
+    assert store_period == update_period
+    attested_block = state_transition_with_full_block(spec, state,
+                                                      False, False)
+    signature_slot = uint64(int(state.slot) + 1)
+    update = _create_update(spec, state, attested_block, genesis_block,
+                            with_next=False, with_finality=False,
+                            participation_rate=1.0)
+    pre_finalized = store.finalized_header.copy()
+    spec.process_light_client_update(store, update, signature_slot,
+                                     state.genesis_validators_root)
+    assert store.finalized_header == pre_finalized
+    assert store.best_valid_update == update
+    assert store.optimistic_header == update.attested_header
+    assert int(store.current_max_active_participants) > 0
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(LC_FORKS)
+@with_presets(["minimal"], reason="too slow")
+@spec_state_test
+@no_vectors
+@always_bls
+def test_process_light_client_update_timeout(spec, state):
+    spec, state = _lc_spec_and_state(spec)
+    genesis_block, store = _setup_test(spec, state)
+    # into the next sync-committee period
+    transition_to(spec, state,
+                  uint64(int(state.slot) + int(spec.UPDATE_TIMEOUT)))
+    store_period = spec.compute_sync_committee_period_at_slot(
+        store.optimistic_header.beacon.slot)
+    update_period = spec.compute_sync_committee_period_at_slot(
+        state.slot)
+    assert store_period + 1 == update_period
+    attested_block = state_transition_with_full_block(spec, state,
+                                                      False, False)
+    signature_slot = uint64(int(state.slot) + 1)
+    update = _create_update(spec, state, attested_block, genesis_block,
+                            with_next=True, with_finality=False,
+                            participation_rate=1.0)
+    pre_finalized = store.finalized_header.copy()
+    spec.process_light_client_update(store, update, signature_slot,
+                                     state.genesis_validators_root)
+    assert store.finalized_header == pre_finalized
+    assert store.best_valid_update == update
+    assert store.optimistic_header == update.attested_header
+    assert int(store.current_max_active_participants) > 0
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(LC_FORKS)
+@with_presets(["minimal"], reason="too slow")
+@spec_state_test
+@no_vectors
+@always_bls
+def test_process_light_client_update_finality_updated(spec, state):
+    spec, state = _lc_spec_and_state(spec)
+    _genesis_block, store = _setup_test(spec, state)
+    # build three attested epochs so finality advances to epoch 3
+    blocks = []
+    transition_to(spec, state,
+                  uint64(int(state.slot) + 2 * int(spec.SLOTS_PER_EPOCH)))
+    for _ in range(3):
+        new_blocks, state = next_epoch_with_attestations(
+            spec, state, True, True)
+        blocks += new_blocks
+    assert int(state.finalized_checkpoint.epoch) == 3
+    store_period = spec.compute_sync_committee_period_at_slot(
+        store.optimistic_header.beacon.slot)
+    update_period = spec.compute_sync_committee_period_at_slot(
+        state.slot)
+    assert store_period == update_period
+
+    attested_block = blocks[-1]
+    signature_slot = uint64(int(state.slot) + 1)
+    finalized_block = blocks[int(spec.SLOTS_PER_EPOCH) - 1]
+    assert int(finalized_block.message.slot) == int(
+        spec.compute_start_slot_at_epoch(state.finalized_checkpoint.epoch))
+    assert hash_tree_root(finalized_block.message) \
+        == state.finalized_checkpoint.root
+
+    update = _create_update(spec, state, attested_block, finalized_block,
+                            with_next=False, with_finality=True,
+                            participation_rate=1.0)
+    spec.process_light_client_update(store, update, signature_slot,
+                                     state.genesis_validators_root)
+    assert store.finalized_header == update.finalized_header
+    assert store.best_valid_update is None
+    assert store.optimistic_header == update.attested_header
+    assert int(store.current_max_active_participants) > 0

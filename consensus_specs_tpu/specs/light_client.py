@@ -74,6 +74,23 @@ class LightClientMixin:
             lambda: get_generalized_index(self.BeaconBlockBody,
                                           "execution_payload"))
 
+    def latest_finalized_root_gindex(self) -> int:
+        """This fork's own gindex (electra sync-protocol.md
+        *_GINDEX_ELECTRA; frozen constants before)."""
+        if self.is_post("electra"):
+            return self._own_state_gindex("finalized_checkpoint", "root")
+        return self.FINALIZED_ROOT_GINDEX
+
+    def latest_current_sync_committee_gindex(self) -> int:
+        if self.is_post("electra"):
+            return self._own_state_gindex("current_sync_committee")
+        return self.CURRENT_SYNC_COMMITTEE_GINDEX
+
+    def latest_next_sync_committee_gindex(self) -> int:
+        if self.is_post("electra"):
+            return self._own_state_gindex("next_sync_committee")
+        return self.NEXT_SYNC_COMMITTEE_GINDEX
+
     def finalized_root_gindex_at_slot(self, slot) -> int:
         epoch = self.compute_epoch_at_slot(slot)
         if self.is_post("electra") and \
@@ -101,17 +118,10 @@ class LightClientMixin:
     def _lc(self) -> dict:
         def build():
             p = self
-            fin_len = floorlog2(
-                self._own_state_gindex("finalized_checkpoint", "root")
-                if self.is_post("electra") else self.FINALIZED_ROOT_GINDEX)
+            fin_len = floorlog2(self.latest_finalized_root_gindex())
             csc_len = floorlog2(
-                self._own_state_gindex("current_sync_committee")
-                if self.is_post("electra")
-                else self.CURRENT_SYNC_COMMITTEE_GINDEX)
-            nsc_len = floorlog2(
-                self._own_state_gindex("next_sync_committee")
-                if self.is_post("electra")
-                else self.NEXT_SYNC_COMMITTEE_GINDEX)
+                self.latest_current_sync_committee_gindex())
+            nsc_len = floorlog2(self.latest_next_sync_committee_gindex())
 
             if self.is_post("capella"):
                 exec_len = floorlog2(self.execution_payload_gindex())
@@ -515,6 +525,103 @@ class LightClientMixin:
             beacon=beacon,
             execution=execution_header,
             execution_branch=execution_branch)
+
+    # ------------------------------------------------------------------
+    # cross-fork data upgrades (capella/deneb/electra light-client/
+    # fork.md upgrade_lc_*_to_*): a post-fork store can still process
+    # pre-fork data after locally upgrading it.  One generic family per
+    # object — field-compatible copies, new fields at their defaults —
+    # replaces the reference's per-fork triplication.
+    # ------------------------------------------------------------------
+    @staticmethod
+    def normalize_merkle_branch(branch, gindex):
+        """electra/light-client/fork.md:27: left-pad a shallower branch
+        with zero hashes up to the gindex's depth."""
+        depth = floorlog2(int(gindex))
+        num_extra = depth - len(branch)
+        return [Bytes32()] * num_extra + [Bytes32(b) for b in branch]
+
+    def upgrade_lc_header_from(self, pre):
+        """capella/light-client/fork.md:25 upgrade_lc_header_to_capella,
+        deneb fork.md:25 (blob-gas fields default to 0), electra."""
+        types = self._lc()
+        header_cls = types["LightClientHeader"]
+        if not self.is_post("capella") or not hasattr(pre, "execution"):
+            # pre-capella data: no execution info to carry over
+            return header_cls(beacon=pre.beacon)
+        eh_cls = self.ExecutionPayloadHeader
+        common = [n for n in eh_cls._field_names
+                  if n in type(pre.execution)._field_names]
+        execution = eh_cls(**{n: getattr(pre.execution, n)
+                              for n in common})
+        return header_cls(beacon=pre.beacon, execution=execution,
+                          execution_branch=pre.execution_branch)
+
+    def upgrade_lc_bootstrap_from(self, pre):
+        types = self._lc()
+        return types["LightClientBootstrap"](
+            header=self.upgrade_lc_header_from(pre.header),
+            current_sync_committee=pre.current_sync_committee,
+            current_sync_committee_branch=self.normalize_merkle_branch(
+                pre.current_sync_committee_branch,
+                self.latest_current_sync_committee_gindex()))
+
+    def upgrade_lc_update_from(self, pre):
+        types = self._lc()
+        return types["LightClientUpdate"](
+            attested_header=self.upgrade_lc_header_from(
+                pre.attested_header),
+            next_sync_committee=pre.next_sync_committee,
+            next_sync_committee_branch=self.normalize_merkle_branch(
+                pre.next_sync_committee_branch,
+                self.latest_next_sync_committee_gindex()),
+            finalized_header=self.upgrade_lc_header_from(
+                pre.finalized_header),
+            finality_branch=self.normalize_merkle_branch(
+                pre.finality_branch,
+                self.latest_finalized_root_gindex()),
+            sync_aggregate=pre.sync_aggregate,
+            signature_slot=pre.signature_slot)
+
+    def upgrade_lc_finality_update_from(self, pre):
+        types = self._lc()
+        return types["LightClientFinalityUpdate"](
+            attested_header=self.upgrade_lc_header_from(
+                pre.attested_header),
+            finalized_header=self.upgrade_lc_header_from(
+                pre.finalized_header),
+            finality_branch=self.normalize_merkle_branch(
+                pre.finality_branch,
+                self.latest_finalized_root_gindex()),
+            sync_aggregate=pre.sync_aggregate,
+            signature_slot=pre.signature_slot)
+
+    def upgrade_lc_optimistic_update_from(self, pre):
+        types = self._lc()
+        return types["LightClientOptimisticUpdate"](
+            attested_header=self.upgrade_lc_header_from(
+                pre.attested_header),
+            sync_aggregate=pre.sync_aggregate,
+            signature_slot=pre.signature_slot)
+
+    def upgrade_lc_store_from(self, pre):
+        """capella/light-client/fork.md:78 upgrade_lc_store_to_capella
+        (and the deneb/electra equivalents)."""
+        best_valid_update = (
+            None if pre.best_valid_update is None
+            else self.upgrade_lc_update_from(pre.best_valid_update))
+        return LightClientStore(
+            finalized_header=self.upgrade_lc_header_from(
+                pre.finalized_header),
+            current_sync_committee=pre.current_sync_committee,
+            next_sync_committee=pre.next_sync_committee,
+            best_valid_update=best_valid_update,
+            optimistic_header=self.upgrade_lc_header_from(
+                pre.optimistic_header),
+            previous_max_active_participants=(
+                pre.previous_max_active_participants),
+            current_max_active_participants=(
+                pre.current_max_active_participants))
 
     def create_light_client_bootstrap(self, state, block):
         types = self._lc()

@@ -1,0 +1,2 @@
+"""pytest collection shim for the dual-mode spec suite."""
+from consensus_specs_tpu.spec_tests.light_client.test_single_merkle_proof import *  # noqa: F401,F403

@@ -1,0 +1,2 @@
+"""pytest collection shim for the dual-mode spec suite."""
+from consensus_specs_tpu.spec_tests.unittests.test_lc_sync_protocol import *  # noqa: F401,F403
