@@ -128,7 +128,7 @@ def check_mods() -> list:
             # data_collection is deliberately no_vectors (unit-style,
             # like the reference's pytest-only collection battery)
             base_lc + "test_data_collection",
-            # reflected by the merkle_proof runner, not the LC runner
+            # reflected by the light_client runner (single_merkle_proof)
             base_lc + "test_single_merkle_proof",
             # cross-fork store upgrades; unit-style (no_vectors)
             base_lc + "test_fork_upgrades",

@@ -72,8 +72,7 @@ def _run_lc_fork_sync(base_spec, fork_chain):
         # the store upgrades locally, ahead of any post-fork data
         store = next_spec.upgrade_lc_store_from(store)
         spec = next_spec
-        update = _process_segment(spec, state, store)
-        assert store.optimistic_header == update.attested_header
+        _process_segment(spec, state, store)
     # the store's headers really are instances of the FINAL fork's LC
     # header class (a no-op upgrade would leave the pre-fork class)
     final_header_cls = spec._lc()["LightClientHeader"]

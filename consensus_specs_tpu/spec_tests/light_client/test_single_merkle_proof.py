@@ -4,9 +4,9 @@ paths (reference test/altair/light_client/test_single_merkle_proof.py
 branch extraction + verification for the sync-committee/finality
 gindices an LC server proves, and capella's execution-payload branch.
 
-Emitted through the merkle_proof runner (handler single_merkle_proof,
+Emitted through the light_client runner (handler single_merkle_proof,
 suites BeaconState / BeaconBlockBody) like the reference's
-tests/generators/merkle_proof."""
+tests/generators/light_client."""
 from ...ssz import hash_tree_root
 from ...ssz.merkle import is_valid_merkle_branch
 from ...ssz.proofs import compute_merkle_proof, get_subtree_index

@@ -102,3 +102,134 @@ def test_invalid_validator_index_out_of_range(spec, state):
     signed = _signed_change(spec, state, 0, pub, priv)
     signed.message.validator_index = uint64(len(state.validators))
     yield from _run(spec, state, signed, valid=False)
+
+
+# ---------------------------------------------------------------------------
+# validator-status long tail: the change is status-independent
+# ---------------------------------------------------------------------------
+
+@with_all_phases_from("capella")
+@spec_state_test
+@always_bls
+def test_success_not_activated(spec, state):
+    index = 3
+    pub, priv = _stage_bls_credentials(spec, state, index)
+    validator = state.validators[index]
+    validator.activation_eligibility_epoch = spec.FAR_FUTURE_EPOCH
+    validator.activation_epoch = spec.FAR_FUTURE_EPOCH
+    yield from _run(spec, state,
+                    _signed_change(spec, state, index, pub, priv))
+    assert not spec.is_active_validator(
+        state.validators[index], spec.get_current_epoch(state))
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+@always_bls
+def test_success_in_activation_queue(spec, state):
+    index = 3
+    pub, priv = _stage_bls_credentials(spec, state, index)
+    validator = state.validators[index]
+    validator.activation_eligibility_epoch = spec.get_current_epoch(state)
+    validator.activation_epoch = uint64(
+        int(spec.get_current_epoch(state)) + 3)
+    yield from _run(spec, state,
+                    _signed_change(spec, state, index, pub, priv))
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+@always_bls
+def test_success_in_exit_queue(spec, state):
+    index = 3
+    pub, priv = _stage_bls_credentials(spec, state, index)
+    spec.initiate_validator_exit(state, index)
+    assert spec.is_active_validator(
+        state.validators[index], spec.get_current_epoch(state))
+    yield from _run(spec, state,
+                    _signed_change(spec, state, index, pub, priv))
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+@always_bls
+def test_success_exited(spec, state):
+    index = 4
+    pub, priv = _stage_bls_credentials(spec, state, index)
+    state.validators[index].exit_epoch = spec.get_current_epoch(state)
+    yield from _run(spec, state,
+                    _signed_change(spec, state, index, pub, priv))
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+@always_bls
+def test_success_withdrawable(spec, state):
+    index = 4
+    pub, priv = _stage_bls_credentials(spec, state, index)
+    state.validators[index].exit_epoch = spec.get_current_epoch(state)
+    state.validators[index].withdrawable_epoch = \
+        spec.get_current_epoch(state)
+    yield from _run(spec, state,
+                    _signed_change(spec, state, index, pub, priv))
+
+
+# ---------------------------------------------------------------------------
+# signing-domain matrix: the change domain pins the GENESIS fork version
+# ---------------------------------------------------------------------------
+
+def _signed_change_with_version(spec, state, index, from_pubkey, privkey,
+                                version, genesis_validators_root=None):
+    if genesis_validators_root is None:
+        genesis_validators_root = state.genesis_validators_root
+    change = spec.BLSToExecutionChange(
+        validator_index=uint64(index),
+        from_bls_pubkey=from_pubkey,
+        to_execution_address=b"\x42" * 20)
+    domain = spec.compute_domain(
+        spec.DOMAIN_BLS_TO_EXECUTION_CHANGE, version,
+        genesis_validators_root)
+    signature = bls.Sign(privkey,
+                         spec.compute_signing_root(change, domain))
+    return spec.SignedBLSToExecutionChange(message=change,
+                                           signature=signature)
+
+
+def _fork_version(spec, name):
+    return bytes.fromhex(str(getattr(spec.config, name))[2:])
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+@always_bls
+def test_genesis_fork_version(spec, state):
+    """The domain uses GENESIS_FORK_VERSION regardless of the current
+    fork (capella/beacon-chain.md process_bls_to_execution_change)."""
+    pub, priv = _stage_bls_credentials(spec, state, 0)
+    signed_change = _signed_change_with_version(
+        spec, state, 0, pub, priv,
+        _fork_version(spec, "GENESIS_FORK_VERSION"))
+    yield from _run(spec, state, signed_change)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+@always_bls
+def test_invalid_current_fork_version(spec, state):
+    pub, priv = _stage_bls_credentials(spec, state, 0)
+    signed_change = _signed_change_with_version(
+        spec, state, 0, pub, priv,
+        _fork_version(spec, f"{spec.fork.upper()}_FORK_VERSION"))
+    yield from _run(spec, state, signed_change, valid=False)
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+@always_bls
+def test_invalid_genesis_validators_root(spec, state):
+    pub, priv = _stage_bls_credentials(spec, state, 0)
+    signed_change = _signed_change_with_version(
+        spec, state, 0, pub, priv,
+        _fork_version(spec, "GENESIS_FORK_VERSION"),
+        genesis_validators_root=b"\x99" * 32)
+    yield from _run(spec, state, signed_change, valid=False)

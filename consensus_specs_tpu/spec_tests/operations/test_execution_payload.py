@@ -12,7 +12,8 @@ writes ``name + '.yaml'`` — gen_runner.py:382 — despite the format
 README calling it execution.yml).
 """
 from ...ssz import uint64
-from ...test_infra.context import spec_state_test, with_all_phases_from
+from ...test_infra.context import (
+    spec_state_test, with_all_phases_from, with_phases)
 from ...test_infra.blocks import build_empty_execution_payload
 
 
@@ -214,3 +215,206 @@ def test_invalid_past_timestamp(spec, state):
     payload.block_hash = spec.hash(
         bytes(spec.hash_tree_root(payload)) + b"FAKE RLP HASH")
     yield from _run(spec, state, payload, valid=False)
+
+
+# ---------------------------------------------------------------------------
+# first-vs-regular payload matrix (reference bellatrix battery: the
+# merge-transition block's FIRST payload skips the parent-hash link)
+# ---------------------------------------------------------------------------
+
+from ...test_infra.pow_block import (  # noqa: E402
+    build_state_with_incomplete_transition)
+
+
+def _first_payload_state(spec, state):
+    return build_state_with_incomplete_transition(spec, state)
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+def test_success_first_payload_pre_merge(spec, state):
+    """The transition block's payload: parent-hash link not enforced —
+    bellatrix only (capella made the check unconditional)."""
+    state = _first_payload_state(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.parent_hash = b"\x55" * 32
+    yield from _run(spec, state, payload)
+
+
+@with_all_phases_from("bellatrix", to="capella")
+@spec_state_test
+def test_success_first_payload_with_gap_slot(spec, state):
+    state = _first_payload_state(spec, state)
+    spec.process_slots(state, uint64(int(state.slot) + 2))
+    payload = build_empty_execution_payload(spec, state)
+    yield from _run(spec, state, payload)
+
+
+@with_all_phases_from("bellatrix", to="capella")
+@spec_state_test
+def test_invalid_bad_prev_randao_first_payload(spec, state):
+    state = _first_payload_state(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.prev_randao = b"\x42" * 32
+    yield from _run(spec, state, payload, valid=False)
+
+
+@with_all_phases_from("bellatrix", to="capella")
+@spec_state_test
+def test_invalid_future_timestamp_first_payload(spec, state):
+    state = _first_payload_state(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.timestamp = uint64(int(payload.timestamp) + 1)
+    yield from _run(spec, state, payload, valid=False)
+
+
+@with_all_phases_from("bellatrix", to="capella")
+@spec_state_test
+def test_invalid_past_timestamp_first_payload(spec, state):
+    state = _first_payload_state(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.timestamp = uint64(max(int(payload.timestamp) - 1, 0))
+    yield from _run(spec, state, payload, valid=False)
+
+
+@with_all_phases_from("bellatrix", to="capella")
+@spec_state_test
+def test_invalid_bad_execution_first_payload(spec, state):
+    state = _first_payload_state(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    yield from _run(spec, state, payload, execution_valid=False)
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+def test_invalid_bad_execution_regular_payload(spec, state):
+    payload = build_empty_execution_payload(spec, state)
+    yield from _run(spec, state, payload, execution_valid=False)
+
+
+@with_all_phases_from("bellatrix", to="capella")
+@spec_state_test
+def test_invalid_bad_everything_first_payload(spec, state):
+    state = _first_payload_state(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.prev_randao = b"\x42" * 32
+    payload.timestamp = uint64(0 if int(payload.timestamp) else 1)
+    yield from _run(spec, state, payload, valid=False,
+                    execution_valid=False)
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+def test_invalid_bad_everything_regular_payload(spec, state):
+    payload = build_empty_execution_payload(spec, state)
+    payload.parent_hash = b"\x55" * 32
+    payload.prev_randao = b"\x42" * 32
+    yield from _run(spec, state, payload, valid=False,
+                    execution_valid=False)
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+def test_non_empty_extra_data_regular_payload(spec, state):
+    payload = build_empty_execution_payload(spec, state)
+    payload.extra_data = b"\x45" * 12
+    yield from _run(spec, state, payload)
+    assert bytes(
+        state.latest_execution_payload_header.extra_data) == b"\x45" * 12
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+def test_non_empty_transactions_regular_payload(spec, state):
+    payload = build_empty_execution_payload(spec, state)
+    payload.transactions = [spec.Transaction(b"\x99" * 128)
+                            for _ in range(2)]
+    yield from _run(spec, state, payload)
+
+
+# ---------------------------------------------------------------------------
+# deneb blob-carrying payloads: the CL accepts shapes it cannot verify
+# (the engine mock answers VALID; reference deneb battery)
+# ---------------------------------------------------------------------------
+
+def _fake_tx_and_commitments(spec, count=1, tx_type=0x03):
+    opaque_tx = bytes([tx_type]) + b"\x9a" * 31
+    commitments = [bytes([0x01 + i]) + b"\x00" * 47 for i in range(count)]
+    return opaque_tx, commitments
+
+
+@with_all_phases_from("deneb")
+@spec_state_test
+def test_incorrect_blob_tx_type(spec, state):
+    """Wrong tx type byte: opaque to the CL, engine says VALID."""
+    payload = build_empty_execution_payload(spec, state)
+    opaque_tx, commitments = _fake_tx_and_commitments(spec, tx_type=0x04)
+    payload.transactions = [opaque_tx]
+    yield from _run(spec, state, payload, commitments=commitments)
+
+
+@with_all_phases_from("deneb")
+@spec_state_test
+def test_incorrect_transaction_length_1_extra_byte(spec, state):
+    payload = build_empty_execution_payload(spec, state)
+    opaque_tx, commitments = _fake_tx_and_commitments(spec)
+    payload.transactions = [opaque_tx + b"\x00"]
+    yield from _run(spec, state, payload, commitments=commitments)
+
+
+@with_all_phases_from("deneb")
+@spec_state_test
+def test_incorrect_transaction_length_1_byte_short(spec, state):
+    payload = build_empty_execution_payload(spec, state)
+    opaque_tx, commitments = _fake_tx_and_commitments(spec)
+    payload.transactions = [opaque_tx[:-1]]
+    yield from _run(spec, state, payload, commitments=commitments)
+
+
+@with_all_phases_from("deneb")
+@spec_state_test
+def test_incorrect_transaction_length_empty(spec, state):
+    payload = build_empty_execution_payload(spec, state)
+    _, commitments = _fake_tx_and_commitments(spec)
+    payload.transactions = [b""]
+    yield from _run(spec, state, payload, commitments=commitments)
+
+
+@with_all_phases_from("deneb")
+@spec_state_test
+def test_incorrect_commitments_order(spec, state):
+    payload = build_empty_execution_payload(spec, state)
+    opaque_tx, commitments = _fake_tx_and_commitments(spec, count=2)
+    payload.transactions = [opaque_tx]
+    yield from _run(spec, state, payload,
+                    commitments=list(reversed(commitments)))
+
+
+@with_all_phases_from("deneb")
+@spec_state_test
+def test_no_transactions_with_commitments(spec, state):
+    payload = build_empty_execution_payload(spec, state)
+    _, commitments = _fake_tx_and_commitments(spec)
+    payload.transactions = []
+    yield from _run(spec, state, payload, commitments=commitments)
+
+
+@with_all_phases_from("deneb")
+@spec_state_test
+def test_zeroed_commitment(spec, state):
+    payload = build_empty_execution_payload(spec, state)
+    opaque_tx, _ = _fake_tx_and_commitments(spec)
+    payload.transactions = [opaque_tx]
+    yield from _run(spec, state, payload,
+                    commitments=[b"\x00" * 48])
+
+
+@with_all_phases_from("deneb")
+@spec_state_test
+def test_incorrect_block_hash(spec, state):
+    """The CL itself never verifies the EL block hash."""
+    payload = build_empty_execution_payload(spec, state)
+    opaque_tx, commitments = _fake_tx_and_commitments(spec)
+    payload.transactions = [opaque_tx]
+    payload.block_hash = b"\x12" * 32
+    yield from _run(spec, state, payload, commitments=commitments)

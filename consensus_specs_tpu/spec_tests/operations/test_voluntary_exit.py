@@ -1,7 +1,7 @@
 """process_voluntary_exit operation tests."""
 from ...ssz import uint64
 from ...test_infra.context import (
-    spec_state_test, with_all_phases, always_bls)
+    spec_state_test, with_all_phases, with_all_phases_from, always_bls)
 
 from ...test_infra.slashings import get_valid_voluntary_exit
 
@@ -176,4 +176,218 @@ def test_invalid_validator_already_exited(spec, state):
         int(spec.get_current_epoch(state)) + 5)
     signed = get_valid_voluntary_exit(spec, state, 0)
     yield from run_voluntary_exit_processing(spec, state, signed,
+                                             valid=False)
+
+
+# ---------------------------------------------------------------------------
+# fork-version signing matrix (EIP-7044; reference deneb
+# test_process_voluntary_exit.py fork-version battery)
+# ---------------------------------------------------------------------------
+
+def _signed_exit_with_version(spec, state, validator_index, version):
+    from ...test_infra.keys import privkey_for_pubkey
+    from ...utils import bls
+    voluntary_exit = spec.VoluntaryExit(
+        epoch=spec.get_current_epoch(state),
+        validator_index=uint64(validator_index))
+    domain = spec.compute_domain(
+        spec.DOMAIN_VOLUNTARY_EXIT,
+        version, state.genesis_validators_root)
+    signing_root = spec.compute_signing_root(voluntary_exit, domain)
+    privkey = privkey_for_pubkey(state.validators[validator_index].pubkey)
+    return spec.SignedVoluntaryExit(
+        message=voluntary_exit, signature=bls.Sign(privkey, signing_root))
+
+
+def _version_bytes(spec, name):
+    return bytes.fromhex(str(getattr(spec.config, name))[2:])
+
+
+@with_all_phases_from("deneb")
+@spec_state_test
+@always_bls
+def test_voluntary_exit_with_pinned_capella_fork_version(spec, state):
+    """EIP-7044: post-deneb exits sign over the CAPELLA fork domain
+    regardless of the exit epoch's fork."""
+    _mature_state(spec, state)
+    signed_exit = _signed_exit_with_version(
+        spec, state, 0, _version_bytes(spec, "CAPELLA_FORK_VERSION"))
+    yield from run_voluntary_exit_processing(spec, state, signed_exit)
+
+
+@with_all_phases_from("deneb")
+@spec_state_test
+@always_bls
+def test_invalid_voluntary_exit_with_current_fork_version(spec, state):
+    """Post-deneb, signing over the CURRENT fork version must fail —
+    only the pinned capella domain verifies."""
+    _mature_state(spec, state)
+    signed_exit = _signed_exit_with_version(
+        spec, state, 0,
+        _version_bytes(spec, f"{spec.fork.upper()}_FORK_VERSION"))
+    yield from run_voluntary_exit_processing(spec, state, signed_exit,
+                                             valid=False)
+
+
+@with_all_phases_from("deneb")
+@spec_state_test
+@always_bls
+def test_invalid_voluntary_exit_with_genesis_fork_version(spec, state):
+    _mature_state(spec, state)
+    signed_exit = _signed_exit_with_version(
+        spec, state, 0, _version_bytes(spec, "GENESIS_FORK_VERSION"))
+    yield from run_voluntary_exit_processing(spec, state, signed_exit,
+                                             valid=False)
+
+
+# ---------------------------------------------------------------------------
+# electra exit churn (EIP-7251; reference electra voluntary-exit battery)
+# ---------------------------------------------------------------------------
+
+def _prepare_exit_balance(spec, state, validator_index, balance):
+    from ...test_infra.withdrawals import (
+        set_compounding_withdrawal_credentials)
+    set_compounding_withdrawal_credentials(spec, state, validator_index)
+    state.validators[validator_index].effective_balance = uint64(balance)
+    state.balances[validator_index] = uint64(balance)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_exit_with_balance_equal_to_churn_limit(spec, state):
+    _mature_state(spec, state)
+    # raising the validator's EB raises total balance and with it the
+    # churn limit — iterate to a fixpoint so balance == churn exactly
+    for _ in range(4):
+        churn_limit = int(spec.get_activation_exit_churn_limit(state))
+        _prepare_exit_balance(spec, state, 0, churn_limit)
+    assert int(spec.get_activation_exit_churn_limit(state)) \
+        == int(state.validators[0].effective_balance)
+    signed_exit = get_valid_voluntary_exit(spec, state, 0)
+    yield from run_voluntary_exit_processing(spec, state, signed_exit)
+    # consumed exactly one epoch's churn
+    assert int(state.validators[0].exit_epoch) == int(
+        spec.compute_activation_exit_epoch(spec.get_current_epoch(state)))
+    assert int(state.exit_balance_to_consume) == 0
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_exit_with_balance_multiple_of_churn_limit(spec, state):
+    _mature_state(spec, state)
+    mult = 2
+    for _ in range(4):
+        churn_limit = int(spec.get_activation_exit_churn_limit(state))
+        _prepare_exit_balance(spec, state, 0, churn_limit * mult)
+    assert int(spec.get_activation_exit_churn_limit(state)) * mult \
+        == int(state.validators[0].effective_balance)
+    signed_exit = get_valid_voluntary_exit(spec, state, 0)
+    yield from run_voluntary_exit_processing(spec, state, signed_exit)
+    # the exit needs `mult` epochs of churn
+    assert int(state.validators[0].exit_epoch) == int(
+        spec.compute_activation_exit_epoch(
+            spec.get_current_epoch(state))) + mult - 1
+    assert int(state.exit_balance_to_consume) == 0
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_exit_existing_churn_and_churn_limit_balance(spec, state):
+    _mature_state(spec, state)
+    churn_limit = int(spec.get_activation_exit_churn_limit(state))
+    existing = churn_limit // 2
+    # pre-consume half the current epoch's churn
+    state.earliest_exit_epoch = spec.compute_activation_exit_epoch(
+        spec.get_current_epoch(state))
+    state.exit_balance_to_consume = uint64(churn_limit - existing)
+    _prepare_exit_balance(spec, state, 0, churn_limit)
+    signed_exit = get_valid_voluntary_exit(spec, state, 0)
+    yield from run_voluntary_exit_processing(spec, state, signed_exit)
+    # the new exit overflows into the next churn epoch
+    assert int(state.validators[0].exit_epoch) == int(
+        spec.compute_activation_exit_epoch(
+            spec.get_current_epoch(state))) + 1
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_min_balance_exit(spec, state):
+    _mature_state(spec, state)
+    churn_limit = int(spec.get_activation_exit_churn_limit(state))
+    _prepare_exit_balance(spec, state, 0,
+                          int(spec.MIN_ACTIVATION_BALANCE))
+    signed_exit = get_valid_voluntary_exit(spec, state, 0)
+    yield from run_voluntary_exit_processing(spec, state, signed_exit)
+    assert int(state.exit_balance_to_consume) == \
+        churn_limit - int(spec.MIN_ACTIVATION_BALANCE)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_min_balance_exits_up_to_churn(spec, state):
+    """Several min-balance exits inside one epoch's churn all land in
+    the same exit epoch."""
+    _mature_state(spec, state)
+    churn_limit = int(spec.get_activation_exit_churn_limit(state))
+    n = churn_limit // int(spec.MIN_ACTIVATION_BALANCE)
+    expected_epoch = spec.compute_activation_exit_epoch(
+        spec.get_current_epoch(state))
+    for i in range(n):
+        _prepare_exit_balance(spec, state, i,
+                              int(spec.MIN_ACTIVATION_BALANCE))
+        signed_exit = get_valid_voluntary_exit(spec, state, i)
+        if i == n - 1:
+            yield from run_voluntary_exit_processing(spec, state,
+                                                     signed_exit)
+        else:
+            spec.process_voluntary_exit(state, signed_exit)
+        assert int(state.validators[i].exit_epoch) == int(expected_epoch)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_min_balance_exits_above_churn(spec, state):
+    """One exit beyond the epoch's churn spills to the next epoch."""
+    _mature_state(spec, state)
+    churn_limit = int(spec.get_activation_exit_churn_limit(state))
+    n = churn_limit // int(spec.MIN_ACTIVATION_BALANCE)
+    expected_epoch = spec.compute_activation_exit_epoch(
+        spec.get_current_epoch(state))
+    for i in range(n):
+        _prepare_exit_balance(spec, state, i,
+                              int(spec.MIN_ACTIVATION_BALANCE))
+        spec.process_voluntary_exit(
+            state, get_valid_voluntary_exit(spec, state, i))
+    _prepare_exit_balance(spec, state, n,
+                          int(spec.MIN_ACTIVATION_BALANCE))
+    signed_exit = get_valid_voluntary_exit(spec, state, n)
+    yield from run_voluntary_exit_processing(spec, state, signed_exit)
+    assert int(state.validators[n].exit_epoch) == int(expected_epoch) + 1
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_max_balance_exit(spec, state):
+    _mature_state(spec, state)
+    max_eb = int(spec.MAX_EFFECTIVE_BALANCE_ELECTRA)
+    _prepare_exit_balance(spec, state, 0, max_eb)
+    # churn evaluated AFTER the balance bump (it feeds total balance)
+    churn_limit = int(spec.get_activation_exit_churn_limit(state))
+    signed_exit = get_valid_voluntary_exit(spec, state, 0)
+    yield from run_voluntary_exit_processing(spec, state, signed_exit)
+    # exit spans ceil(max_eb / churn) epochs of churn
+    earliest = int(spec.compute_activation_exit_epoch(
+        spec.get_current_epoch(state)))
+    additional = (max_eb - churn_limit + churn_limit - 1) // churn_limit
+    assert int(state.validators[0].exit_epoch) == earliest + additional
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_invalid_validator_has_pending_withdrawal(spec, state):
+    from ...test_infra.withdrawals import prepare_pending_withdrawal
+    _mature_state(spec, state)
+    prepare_pending_withdrawal(spec, state, 0)
+    signed_exit = get_valid_voluntary_exit(spec, state, 0)
+    yield from run_voluntary_exit_processing(spec, state, signed_exit,
                                              valid=False)

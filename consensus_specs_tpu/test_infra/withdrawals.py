@@ -52,6 +52,28 @@ def prepare_partially_withdrawable_validator(spec, state, index,
         int(spec.MAX_EFFECTIVE_BALANCE) + excess)
 
 
+def prepare_pending_withdrawal(spec, state, validator_index,
+                               effective_balance=32_000_000_000,
+                               amount=1_000_000_000,
+                               withdrawable_epoch=None):
+    """Electra: queue a PendingPartialWithdrawal for a compounding
+    validator holding `effective_balance + amount` (reference
+    helpers/withdrawals.py:110)."""
+    assert spec.is_post("electra")
+    if withdrawable_epoch is None:
+        withdrawable_epoch = spec.get_current_epoch(state)
+    set_compounding_withdrawal_credentials(spec, state, validator_index)
+    state.validators[validator_index].effective_balance = \
+        uint64(effective_balance)
+    state.balances[validator_index] = uint64(
+        int(effective_balance) + int(amount))
+    withdrawal = spec.PendingPartialWithdrawal(
+        validator_index=validator_index, amount=amount,
+        withdrawable_epoch=withdrawable_epoch)
+    state.pending_partial_withdrawals.append(withdrawal)
+    return withdrawal
+
+
 def get_expected_withdrawals(spec, state):
     """Fork-agnostic expected-withdrawals list (electra returns a
     (withdrawals, processed_partial_count) pair)."""
