@@ -219,14 +219,24 @@ def _epoch_state(spec, n):
 def bench_epoch():
     from consensus_specs_tpu.specs import get_spec
     from consensus_specs_tpu.specs import epoch_fast
+    from consensus_specs_tpu.parallel import mesh_engine
 
     spec = get_spec("altair", "mainnet")
     log(f"[bench] epoch: building {EPOCH_VALIDATORS}-validator state ...")
     state = _epoch_state(spec, EPOCH_VALIDATORS)
 
-    t0 = time.perf_counter()
-    spec.process_epoch(state)
-    fast_time = time.perf_counter() - t0
+    # single-chip device engine: flag-delta + slashing sweeps run as
+    # the same compiled XLA programs the multi-chip mesh uses
+    engine = mesh_engine.enable_single_device()
+    try:
+        warm = _epoch_state(spec, EPOCH_VALIDATORS)
+        spec.process_epoch(warm)   # compile warm-up outside the timer
+
+        t0 = time.perf_counter()
+        spec.process_epoch(state)
+        fast_time = time.perf_counter() - t0
+    finally:
+        engine.disable()
 
     # baseline: reference-shaped scalar loops at a feasible size, scaled
     # linearly (conservative: the scalar path has O(n^2) components)
@@ -591,6 +601,37 @@ TIERS = {
     "kzg": (bench_kzg, 300),
 }
 
+# the driver's ~540s window fits merkle + ONE heavy tier — without
+# rotation, attestations/kzg/epoch/transition would never get a
+# driver-verified number (VERDICT r4 weakness #8)
+_ROTATING = ["north_star", "attestations", "kzg", "epoch", "transition"]
+
+
+def _round_index() -> int:
+    """Driver rounds leave BENCH_r0N.json at the repo root — count them
+    so the tier order provably varies per round without any driver-side
+    plumbing."""
+    import glob
+    here = os.path.dirname(os.path.abspath(__file__))
+    return len(glob.glob(os.path.join(here, "BENCH_r*.json")))
+
+
+def tier_order() -> list:
+    """merkle first (fast bank), then the heavy tiers rotated by round
+    index; BENCH_TIER=name[,name...] overrides outright."""
+    override = os.environ.get("BENCH_TIER")
+    if override:
+        names = [t.strip() for t in override.split(",") if t.strip()]
+        unknown = [t for t in names if t not in TIERS]
+        if unknown:
+            raise SystemExit(f"BENCH_TIER: unknown tiers {unknown}")
+        return names
+    # anchor so the round after the 4th failed bench (round 5, index 4)
+    # still leads with the unproven north-star tier
+    k = (_round_index() - 4) % len(_ROTATING)
+    heavy = _ROTATING[k:] + _ROTATING[:k]
+    return ["merkle"] + heavy
+
 
 def _device_alive(timeout_s: float = 90.0) -> bool:
     """Probe the accelerator in a subprocess.  A stale claim on the
@@ -649,7 +690,10 @@ def main():
         time.sleep(20)
 
     results = {}
-    for name, (_fn, tier_budget) in TIERS.items():
+    order = tier_order()
+    log(f"[bench] tier order this round: {order}")
+    for name in order:
+        _fn, tier_budget = TIERS[name]
         remaining = deadline - time.monotonic() - 15
         if remaining <= 10:
             log(f"[bench] skipping {name}: global budget exhausted")
@@ -658,9 +702,11 @@ def main():
         if out is not None:
             results[name] = out
 
-    # most valuable completed tier wins the stdout line
-    for name in ("north_star", "attestations", "kzg", "transition",
-                 "epoch", "merkle"):
+    # most valuable completed tier wins the stdout line, by value rank
+    # (rotation changes which tiers RUN, not which result headlines)
+    rank = ["north_star", "attestations", "kzg", "transition", "epoch",
+            "merkle"]
+    for name in rank:
         if name in results:
             print(json.dumps(results[name]))
             sys.stdout.flush()

@@ -241,3 +241,37 @@ def make_flag_deltas(mesh: Mesh, weight: int, weight_denominator: int,
         return inner(eff_incr, active, active, part & active,
                      base_per_increment, False)
     return call
+
+
+def sharded_slashings(local_eff_incr, local_mask, adjusted_total,
+                      total_balance, increment, electra: bool):
+    """PRODUCTION slashing-penalty sweep (bit-exact to
+    epoch_fast.slashings_pass): the correlation penalty for every
+    validator whose withdrawable epoch sits at the slashing-window
+    midpoint.  Penalty lanes are local; the inputs that need global
+    agreement (adjusted total, total balance) are traced scalars the
+    caller derives once — electra factors the increment out before the
+    multiply, pre-electra divides afterwards."""
+    eff64 = local_eff_incr.astype(jnp.int64)
+    if electra:
+        per_incr = adjusted_total // (total_balance // increment)
+        pen = eff64 * per_incr
+    else:
+        pen = eff64 * adjusted_total // total_balance * increment
+    return jnp.where(local_mask, pen, 0)
+
+
+def make_slashings(mesh: Mesh, electra: bool):
+    """Compiled slashing sweep over a validator axis sharded on
+    `mesh` (used by epoch_fast when the mesh engine is enabled)."""
+    jfn = jax.jit(jax.shard_map(
+        partial(sharded_slashings, electra=electra),
+        mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS), P(), P(), P()),
+        out_specs=P(AXIS), check_vma=False))
+
+    def call(eff_incr, mask, adjusted_total, total_balance, increment):
+        with jax.enable_x64():
+            return jfn(eff_incr, mask, jnp.int64(adjusted_total),
+                       jnp.int64(total_balance), jnp.int64(increment))
+    return call

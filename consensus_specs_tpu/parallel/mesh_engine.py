@@ -23,7 +23,7 @@ from __future__ import annotations
 import numpy as np
 import jax
 
-from .collectives import make_flag_set, shard_array
+from .collectives import make_flag_set, make_slashings, shard_array
 from jax.sharding import Mesh
 
 
@@ -35,6 +35,7 @@ class MeshEngine:
         self.n_dev = int(np.prod(list(mesh.shape.values())))
         self._merkle_cache: dict = {}
         self._flag_cache: dict = {}
+        self._slash_cache: dict = {}
         self._msm_fn = None
         self._prev_kzg_msm = None
         self._threshold = 1 << 14
@@ -98,6 +99,24 @@ class MeshEngine:
                  np.asarray(jax.device_get(penalties))[:n]
                  .astype(np.int64)))
         return out
+
+    def slashings_batch(self, eff_incr, mask, adjusted_total: int,
+                        total_balance: int, increment: int,
+                        electra: bool):
+        """The slashing-penalty sweep as a compiled validator-axis
+        program (collectives.sharded_slashings — bit-exact to the host
+        lane in epoch_fast.slashings_pass)."""
+        n = len(eff_incr)
+        padded = n + (-n) % self.n_dev
+        key = (padded, electra)
+        fn = self._slash_cache.get(key)
+        if fn is None:
+            fn = make_slashings(self.mesh, electra)
+            self._slash_cache[key] = fn
+        pen = fn(self._pad_shard(eff_incr.astype(np.int64)),
+                 self._pad_shard(mask), adjusted_total, total_balance,
+                 increment)
+        return np.asarray(jax.device_get(pen))[:n].astype(np.int64)
 
     # ------------------------------------------------------------------
     # sharded MSM (kzg.g1_lincomb device-MSM hook)
@@ -172,3 +191,14 @@ def enable(mesh: Mesh, merkle_threshold: int = 1 << 14,
     engine = MeshEngine(mesh)
     engine.enable(merkle_threshold, msm_threshold=msm_threshold)
     return engine
+
+
+def enable_single_device(merkle_threshold: int = 1 << 14,
+                         msm_threshold: int = 128) -> MeshEngine:
+    """The SAME compiled programs the multi-chip mesh runs, on a
+    1-device mesh over the default accelerator: psums collapse to
+    no-ops, everything else is identical XLA.  This is the single-chip
+    production path — 'TPU-native epoch processing' on one chip, not
+    only on the mesh (bench.py's epoch tier enables it)."""
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    return enable(mesh, merkle_threshold, msm_threshold=msm_threshold)

@@ -17,9 +17,15 @@ EPOCH_PROCESSING_HANDLERS = {
     "participation_updates":
         "consensus_specs_tpu.spec_tests.epoch_processing."
         "test_participation_updates",
-    "pending_queues":
+    "pending_queues": [
         "consensus_specs_tpu.spec_tests.epoch_processing."
         "test_pending_queues",
+        "consensus_specs_tpu.spec_tests.epoch_processing."
+        "test_apply_pending_deposit",
+    ],
+    "sync_committee_updates":
+        "consensus_specs_tpu.spec_tests.epoch_processing."
+        "test_sync_committee_updates",
     "inactivity_updates":
         "consensus_specs_tpu.spec_tests.epoch_processing."
         "test_inactivity_updates",

@@ -1,0 +1,456 @@
+"""apply_pending_deposit battery (electra; reference
+test/electra/epoch_processing/pending_deposits/
+test_apply_pending_deposit.py, 25 defs): every credential shape,
+signature outcome, and top-up interaction of a single queued deposit
+draining through process_pending_deposits."""
+from ...ssz import Bytes32, uint64
+from ...test_infra.context import (
+    spec_state_test, with_all_phases_from, always_bls)
+from ...test_infra.deposits import build_deposit_data
+from ...test_infra.epoch_processing import run_epoch_processing_to
+from ...test_infra.keys import pubkeys, privkeys
+
+# a positive non-infinity G1 x-coordinate outside the subgroup
+_PUBKEY_NOT_IN_SUBGROUP = bytes.fromhex(
+    "8123456789abcdef0123456789abcdef0123456789abcdef"
+    "0123456789abcdef0123456789abcdef0123456789abcdef")
+_PUBKEY_NOT_DECOMPRESSIBLE = bytes.fromhex(
+    "8123456789abcdef0123456789abcdef0123456789abcdef"
+    "0123456789abcdef0123456789abcdef0123456789abcde0")
+
+
+def _bls_creds(spec, pubkey):
+    return bytes(spec.BLS_WITHDRAWAL_PREFIX) + \
+        bytes(spec.hash(pubkey))[1:]
+
+
+def _eth1_creds(spec):
+    return bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX) + b"\x00" * 11 \
+        + b"\x42" * 20
+
+
+def _compounding_creds(spec):
+    return bytes(spec.COMPOUNDING_WITHDRAWAL_PREFIX) + b"\x00" * 11 \
+        + b"\x42" * 20
+
+
+def _pending_deposit_for(spec, key_index, amount, creds=None,
+                         signed=True, pubkey_override=None, slot=0):
+    pubkey = pubkeys[key_index] if pubkey_override is None \
+        else pubkey_override
+    if creds is None:
+        creds = _bls_creds(spec, pubkey)
+    data = build_deposit_data(spec, pubkey, privkeys[key_index],
+                              amount, creds, signed=signed)
+    return spec.PendingDeposit(
+        pubkey=pubkey, withdrawal_credentials=Bytes32(creds),
+        amount=uint64(int(amount)), signature=data.signature,
+        slot=uint64(slot))
+
+
+def _run_apply(spec, state, pending_deposit, validator_index,
+               effective=True):
+    """Queue one deposit and drain it through
+    process_pending_deposits (reference run_pending_deposit_applying)."""
+    state.deposit_requests_start_index = state.eth1_deposit_index
+    if int(pending_deposit.amount) > int(
+            spec.get_activation_exit_churn_limit(state)):
+        state.deposit_balance_to_consume = uint64(
+            int(pending_deposit.amount)
+            - int(spec.get_activation_exit_churn_limit(state)))
+    state.pending_deposits.append(pending_deposit)
+    run_epoch_processing_to(spec, state,
+                            "process_justification_and_finalization")
+    pre_count = len(state.validators)
+    is_top_up = validator_index < pre_count
+    pre_balance = int(state.balances[validator_index]) if is_top_up else 0
+    yield "pre", state.copy()
+    spec.process_pending_deposits(state)
+    yield "post", state
+    assert len(state.pending_deposits) == 0
+    if effective:
+        if is_top_up:
+            assert len(state.validators) == pre_count
+            assert int(state.balances[validator_index]) == \
+                pre_balance + int(pending_deposit.amount)
+        else:
+            assert len(state.validators) == pre_count + 1
+            assert int(state.balances[validator_index]) == \
+                int(pending_deposit.amount)
+    else:
+        assert len(state.validators) == pre_count
+        if is_top_up:
+            assert int(state.balances[validator_index]) == pre_balance
+
+
+# --- new-validator deposits: amounts ---------------------------------------
+
+@with_all_phases_from("electra")
+@spec_state_test
+@always_bls
+def test_apply_pending_deposit_under_min_activation(spec, state):
+    index = len(state.validators)
+    amount = int(spec.MIN_ACTIVATION_BALANCE) - 1
+    pd = _pending_deposit_for(spec, index, amount, signed=True)
+    yield from _run_apply(spec, state, pd, index)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@always_bls
+def test_apply_pending_deposit_min_activation(spec, state):
+    index = len(state.validators)
+    pd = _pending_deposit_for(spec, index,
+                              int(spec.MIN_ACTIVATION_BALANCE),
+                              signed=True)
+    yield from _run_apply(spec, state, pd, index)
+    assert int(state.validators[index].effective_balance) == \
+        int(spec.MIN_ACTIVATION_BALANCE)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@always_bls
+def test_apply_pending_deposit_over_min_activation(spec, state):
+    index = len(state.validators)
+    amount = int(spec.MIN_ACTIVATION_BALANCE) \
+        + int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    pd = _pending_deposit_for(spec, index, amount, signed=True)
+    yield from _run_apply(spec, state, pd, index)
+    # 0x00 creds: effective balance capped at MIN_ACTIVATION_BALANCE
+    assert int(state.validators[index].effective_balance) == \
+        int(spec.MIN_ACTIVATION_BALANCE)
+
+
+# --- credential shapes -----------------------------------------------------
+
+@with_all_phases_from("electra")
+@spec_state_test
+@always_bls
+def test_apply_pending_deposit_eth1_withdrawal_credentials(spec, state):
+    index = len(state.validators)
+    pd = _pending_deposit_for(spec, index,
+                              int(spec.MIN_ACTIVATION_BALANCE),
+                              creds=_eth1_creds(spec), signed=True)
+    yield from _run_apply(spec, state, pd, index)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@always_bls
+def test_apply_pending_deposit_compounding_withdrawal_credentials_under_max(
+        spec, state):
+    index = len(state.validators)
+    amount = int(spec.MAX_EFFECTIVE_BALANCE_ELECTRA) \
+        - int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    pd = _pending_deposit_for(spec, index, amount,
+                              creds=_compounding_creds(spec),
+                              signed=True)
+    yield from _run_apply(spec, state, pd, index)
+    assert int(state.validators[index].effective_balance) == amount
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@always_bls
+def test_apply_pending_deposit_compounding_withdrawal_credentials_max(
+        spec, state):
+    index = len(state.validators)
+    amount = int(spec.MAX_EFFECTIVE_BALANCE_ELECTRA)
+    pd = _pending_deposit_for(spec, index, amount,
+                              creds=_compounding_creds(spec),
+                              signed=True)
+    yield from _run_apply(spec, state, pd, index)
+    assert int(state.validators[index].effective_balance) == amount
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@always_bls
+def test_apply_pending_deposit_compounding_withdrawal_credentials_over_max(
+        spec, state):
+    index = len(state.validators)
+    amount = int(spec.MAX_EFFECTIVE_BALANCE_ELECTRA) \
+        + int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    pd = _pending_deposit_for(spec, index, amount,
+                              creds=_compounding_creds(spec),
+                              signed=True)
+    yield from _run_apply(spec, state, pd, index)
+    # balance holds the full amount; EB caps at the compounding max
+    assert int(state.validators[index].effective_balance) == \
+        int(spec.MAX_EFFECTIVE_BALANCE_ELECTRA)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@always_bls
+def test_apply_pending_deposit_non_versioned_withdrawal_credentials(
+        spec, state):
+    index = len(state.validators)
+    creds = b"\xff" + b"\x02" * 31  # unknown prefix: still accepted
+    pd = _pending_deposit_for(spec, index,
+                              int(spec.MIN_ACTIVATION_BALANCE),
+                              creds=creds, signed=True)
+    yield from _run_apply(spec, state, pd, index)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@always_bls
+def test_apply_pending_deposit_non_versioned_withdrawal_credentials_over_min_activation(
+        spec, state):
+    index = len(state.validators)
+    creds = b"\xff" + b"\x02" * 31
+    amount = int(spec.MIN_ACTIVATION_BALANCE) \
+        + int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    pd = _pending_deposit_for(spec, index, amount, creds=creds,
+                              signed=True)
+    yield from _run_apply(spec, state, pd, index)
+
+
+# --- signature / pubkey validation ----------------------------------------
+
+@with_all_phases_from("electra")
+@spec_state_test
+@always_bls
+def test_apply_pending_deposit_incorrect_sig_new_deposit(spec, state):
+    index = len(state.validators)
+    pd = _pending_deposit_for(spec, index,
+                              int(spec.MIN_ACTIVATION_BALANCE),
+                              signed=False)
+    pd.signature = b"\x11" + b"\x00" * 95
+    yield from _run_apply(spec, state, pd, index, effective=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@always_bls
+def test_apply_pending_deposit_key_validate_invalid_subgroup(spec, state):
+    index = len(state.validators)
+    pd = _pending_deposit_for(
+        spec, index, int(spec.MIN_ACTIVATION_BALANCE), signed=False,
+        pubkey_override=_PUBKEY_NOT_IN_SUBGROUP)
+    yield from _run_apply(spec, state, pd, index, effective=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@always_bls
+def test_apply_pending_deposit_key_validate_invalid_decompression(
+        spec, state):
+    index = len(state.validators)
+    pd = _pending_deposit_for(
+        spec, index, int(spec.MIN_ACTIVATION_BALANCE), signed=False,
+        pubkey_override=_PUBKEY_NOT_DECOMPRESSIBLE)
+    yield from _run_apply(spec, state, pd, index, effective=False)
+
+
+# --- top-ups ---------------------------------------------------------------
+
+@with_all_phases_from("electra")
+@spec_state_test
+@always_bls
+def test_apply_pending_deposit_top_up__min_activation_balance(spec,
+                                                              state):
+    index = 0
+    amount = int(spec.MIN_ACTIVATION_BALANCE) // 4
+    pd = _pending_deposit_for(spec, index, amount, signed=True)
+    yield from _run_apply(spec, state, pd, index)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@always_bls
+def test_apply_pending_deposit_top_up__max_effective_balance_compounding(
+        spec, state):
+    from ...test_infra.withdrawals import (
+        set_compounding_withdrawal_credentials)
+    index = 0
+    set_compounding_withdrawal_credentials(spec, state, index)
+    state.validators[index].effective_balance = \
+        spec.MAX_EFFECTIVE_BALANCE_ELECTRA
+    state.balances[index] = spec.MAX_EFFECTIVE_BALANCE_ELECTRA
+    amount = int(spec.MIN_ACTIVATION_BALANCE) // 4
+    pd = _pending_deposit_for(spec, index, amount, signed=True)
+    yield from _run_apply(spec, state, pd, index)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@always_bls
+def test_apply_pending_deposit_top_up__less_effective_balance(spec,
+                                                              state):
+    index = 0
+    state.validators[index].effective_balance = uint64(
+        int(spec.MIN_ACTIVATION_BALANCE)
+        - int(spec.EFFECTIVE_BALANCE_INCREMENT))
+    state.balances[index] = uint64(
+        int(spec.MIN_ACTIVATION_BALANCE)
+        - int(spec.EFFECTIVE_BALANCE_INCREMENT))
+    amount = int(spec.MIN_ACTIVATION_BALANCE) // 4
+    pd = _pending_deposit_for(spec, index, amount, signed=True)
+    yield from _run_apply(spec, state, pd, index)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@always_bls
+def test_apply_pending_deposit_top_up__zero_balance(spec, state):
+    index = 0
+    state.validators[index].effective_balance = 0
+    state.balances[index] = 0
+    amount = int(spec.MIN_ACTIVATION_BALANCE) // 4
+    pd = _pending_deposit_for(spec, index, amount, signed=True)
+    yield from _run_apply(spec, state, pd, index)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@always_bls
+def test_apply_pending_deposit_incorrect_sig_top_up(spec, state):
+    """Top-ups skip signature verification entirely."""
+    index = 0
+    amount = int(spec.MIN_ACTIVATION_BALANCE) // 4
+    pd = _pending_deposit_for(spec, index, amount, signed=False)
+    pd.signature = b"\x11" + b"\x00" * 95
+    yield from _run_apply(spec, state, pd, index)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@always_bls
+def test_apply_pending_deposit_incorrect_withdrawal_credentials_top_up(
+        spec, state):
+    """A top-up with mismatched credentials still credits the balance
+    (credentials are pinned at first deposit)."""
+    index = 0
+    amount = int(spec.MIN_ACTIVATION_BALANCE) // 4
+    creds = bytes(spec.BLS_WITHDRAWAL_PREFIX) \
+        + bytes(spec.hash(b"\x03" * 48))[1:]
+    pd = _pending_deposit_for(spec, index, amount, creds=creds,
+                              signed=True)
+    yield from _run_apply(spec, state, pd, index)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@always_bls
+def test_apply_pending_deposit_success_top_up_to_withdrawn_validator(
+        spec, state):
+    from ...test_infra.withdrawals import (
+        prepare_fully_withdrawable_validator)
+    index = 0
+    prepare_fully_withdrawable_validator(spec, state, index, balance=0)
+    state.validators[index].effective_balance = 0
+    amount = int(spec.MIN_ACTIVATION_BALANCE) // 4
+    pd = _pending_deposit_for(spec, index, amount, signed=True)
+    yield from _run_apply(spec, state, pd, index)
+
+
+# --- fork-version signing --------------------------------------------------
+
+def _pending_deposit_with_version(spec, key_index, amount, version):
+    from ...utils import bls as _bls
+    pubkey = pubkeys[key_index]
+    creds = _bls_creds(spec, pubkey)
+    deposit_message = spec.DepositMessage(
+        pubkey=pubkey, withdrawal_credentials=Bytes32(creds),
+        amount=uint64(amount))
+    domain = spec.compute_domain(spec.DOMAIN_DEPOSIT, version, Bytes32())
+    signature = _bls.Sign(privkeys[key_index],
+                          spec.compute_signing_root(deposit_message,
+                                                    domain))
+    return spec.PendingDeposit(
+        pubkey=pubkey, withdrawal_credentials=Bytes32(creds),
+        amount=uint64(amount), signature=signature, slot=uint64(0))
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@always_bls
+def test_apply_pending_deposit_effective_deposit_with_genesis_fork_version(
+        spec, state):
+    index = len(state.validators)
+    version = bytes.fromhex(
+        str(spec.config.GENESIS_FORK_VERSION)[2:])
+    pd = _pending_deposit_with_version(
+        spec, index, int(spec.MIN_ACTIVATION_BALANCE), version)
+    yield from _run_apply(spec, state, pd, index)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@always_bls
+def test_apply_pending_deposit_ineffective_deposit_with_bad_fork_version(
+        spec, state):
+    index = len(state.validators)
+    pd = _pending_deposit_with_version(
+        spec, index, int(spec.MIN_ACTIVATION_BALANCE), b"\xaa\xbb\xcc\xdd")
+    yield from _run_apply(spec, state, pd, index, effective=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@always_bls
+def test_apply_pending_deposit_ineffective_deposit_with_current_fork_version(
+        spec, state):
+    """Deposits must sign over the GENESIS fork version — the current
+    fork's version does not verify."""
+    index = len(state.validators)
+    version = bytes.fromhex(
+        str(getattr(spec.config, f"{spec.fork.upper()}_FORK_VERSION"))[2:])
+    pd = _pending_deposit_with_version(
+        spec, index, int(spec.MIN_ACTIVATION_BALANCE), version)
+    yield from _run_apply(spec, state, pd, index, effective=False)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@always_bls
+def test_apply_pending_deposit_correct_sig_but_forked_state(spec, state):
+    """Deposit domains pin GENESIS_FORK_VERSION: a mangled state fork
+    version changes nothing."""
+    index = len(state.validators)
+    state.fork.current_version = b"\x12\x34\xab\xcd"
+    pd = _pending_deposit_for(spec, index,
+                              int(spec.MIN_ACTIVATION_BALANCE),
+                              signed=True)
+    yield from _run_apply(spec, state, pd, index)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@always_bls
+def test_apply_pending_deposit_top_up__min_activation_balance_compounding(
+        spec, state):
+    """Top-up to an at-cap 0x02 validator with a 32-ETH max: balance
+    grows, effective balance stays pinned."""
+    index = 0
+    creds = _compounding_creds(spec)
+    state.validators[index].withdrawal_credentials = Bytes32(creds)
+    state.validators[index].effective_balance = \
+        spec.MIN_ACTIVATION_BALANCE
+    state.balances[index] = spec.MIN_ACTIVATION_BALANCE
+    amount = int(spec.MIN_ACTIVATION_BALANCE) // 4
+    pd = _pending_deposit_for(spec, index, amount, signed=True)
+    yield from _run_apply(spec, state, pd, index)
+    assert int(state.balances[index]) == \
+        int(spec.MIN_ACTIVATION_BALANCE) + amount
+    assert int(state.validators[index].effective_balance) == \
+        int(spec.MIN_ACTIVATION_BALANCE)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+@always_bls
+def test_apply_pending_deposit_with_previous_fork_version(spec, state):
+    """Signed over state.fork.previous_version: ineffective — deposits
+    only verify over GENESIS_FORK_VERSION (this WAS effective in
+    altair's process_deposit)."""
+    assert bytes(state.fork.previous_version) \
+        != bytes(state.fork.current_version)
+    index = len(state.validators)
+    pd = _pending_deposit_with_version(
+        spec, index, int(spec.MIN_ACTIVATION_BALANCE),
+        bytes(state.fork.previous_version))
+    yield from _run_apply(spec, state, pd, index, effective=False)

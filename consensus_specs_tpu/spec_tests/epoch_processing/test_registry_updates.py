@@ -1,7 +1,8 @@
 """process_registry_updates epoch tests (eligibility, ejection,
 activation queue)."""
 from ...ssz import uint64
-from ...test_infra.context import spec_state_test, with_all_phases
+from ...test_infra.context import (
+    spec_state_test, with_all_phases, with_all_phases_from)
 from ...test_infra.epoch_processing import run_epoch_processing_with
 from ...test_infra.genesis import build_mock_validator
 
@@ -333,3 +334,133 @@ def test_already_exited_not_ejected_again(spec, state):
     yield from run_epoch_processing_with(
         spec, state, "process_registry_updates")
     assert int(state.validators[index].exit_epoch) == before
+
+
+# ---------------------------------------------------------------------------
+# eligibility balance thresholds (electra: eligibility keys off
+# MIN_ACTIVATION_BALANCE; credentials don't change the threshold)
+# ---------------------------------------------------------------------------
+
+def _append_fresh_validator(spec, state, balance, creds_prefix=None):
+    fresh = build_mock_validator(
+        spec, len(state.validators), balance)
+    if creds_prefix is not None:
+        fresh.withdrawal_credentials = bytes([creds_prefix]) \
+            + bytes(fresh.withdrawal_credentials)[1:]
+    state.validators.append(fresh)
+    state.balances.append(uint64(balance))
+    if spec.is_post("altair"):
+        state.previous_epoch_participation.append(0)
+        state.current_epoch_participation.append(0)
+        state.inactivity_scores.append(0)
+    return len(state.validators) - 1
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_activation_queue_eligibility__less_than_min_activation_balance(
+        spec, state):
+    index = _append_fresh_validator(
+        spec, state,
+        int(spec.MIN_ACTIVATION_BALANCE)
+        - int(spec.EFFECTIVE_BALANCE_INCREMENT))
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    assert state.validators[index].activation_eligibility_epoch == \
+        spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_activation_queue_eligibility__min_activation_balance(spec,
+                                                              state):
+    index = _append_fresh_validator(
+        spec, state, int(spec.MIN_ACTIVATION_BALANCE))
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    assert state.validators[index].activation_eligibility_epoch != \
+        spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_activation_queue_eligibility__min_activation_balance_eth1_creds(
+        spec, state):
+    index = _append_fresh_validator(
+        spec, state, int(spec.MIN_ACTIVATION_BALANCE),
+        creds_prefix=int.from_bytes(
+            bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX), "big"))
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    assert state.validators[index].activation_eligibility_epoch != \
+        spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_activation_queue_eligibility__min_activation_balance_compounding_creds(
+        spec, state):
+    index = _append_fresh_validator(
+        spec, state, int(spec.MIN_ACTIVATION_BALANCE),
+        creds_prefix=int.from_bytes(
+            bytes(spec.COMPOUNDING_WITHDRAWAL_PREFIX), "big"))
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    assert state.validators[index].activation_eligibility_epoch != \
+        spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_activation_queue_eligibility__greater_than_min_activation_balance(
+        spec, state):
+    index = _append_fresh_validator(
+        spec, state,
+        int(spec.MIN_ACTIVATION_BALANCE)
+        + int(spec.EFFECTIVE_BALANCE_INCREMENT))
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    assert state.validators[index].activation_eligibility_epoch != \
+        spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_queue_to_activated_if_finalized(spec, state):
+    """Eligible + finalized ancestor => activated at the churned
+    epoch."""
+    index = 4
+    v = state.validators[index]
+    v.activation_epoch = spec.FAR_FUTURE_EPOCH
+    v.activation_eligibility_epoch = uint64(0)
+    state.finalized_checkpoint.epoch = uint64(
+        int(spec.get_current_epoch(state)))
+    expected_activation = spec.compute_activation_exit_epoch(
+        spec.get_current_epoch(state))
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    # activated at exactly the churned activation-exit epoch
+    assert int(state.validators[index].activation_epoch) == \
+        int(expected_activation)
+    assert spec.is_active_validator(state.validators[index],
+                                    expected_activation)
+
+
+@with_all_phases
+@spec_state_test
+def test_ejection_and_activation_interleaved(spec, state):
+    """One ejection and one activation processed in the same pass."""
+    eject = 2
+    activate = 5
+    state.validators[eject].effective_balance = uint64(
+        spec.config.EJECTION_BALANCE)
+    v = state.validators[activate]
+    v.activation_epoch = spec.FAR_FUTURE_EPOCH
+    v.activation_eligibility_epoch = uint64(0)
+    state.finalized_checkpoint.epoch = uint64(
+        int(spec.get_current_epoch(state)))
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    assert state.validators[eject].exit_epoch != spec.FAR_FUTURE_EPOCH
+    assert state.validators[activate].activation_epoch != \
+        spec.FAR_FUTURE_EPOCH

@@ -1,0 +1,85 @@
+"""process_sync_committee_updates epoch battery (altair+; reference
+test/altair/epoch_processing/test_process_sync_committee_updates.py,
+5 defs): committee rotation at period boundaries, no-ops elsewhere,
+and rotation under mixed balances."""
+from ...ssz import uint64
+from ...test_infra.context import (
+    spec_state_test, with_all_phases_from, with_presets,
+    with_custom_state, misc_balances, default_activation_threshold)
+from ...test_infra.blocks import transition_to
+from ...test_infra.epoch_processing import run_epoch_processing_with
+
+
+def _to_last_epoch_of_period(spec, state, periods=1) -> None:
+    """Advance so the NEXT epoch boundary is a sync-committee period
+    boundary."""
+    period_epochs = int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)
+    cur = int(spec.get_current_epoch(state))
+    target_epoch = ((cur // period_epochs) + periods) * period_epochs - 1
+    transition_to(
+        spec, state,
+        uint64(target_epoch * int(spec.SLOTS_PER_EPOCH)))
+
+
+def _run_rotation(spec, state):
+    pre_current = state.current_sync_committee.copy()
+    pre_next = state.next_sync_committee.copy()
+    yield from run_epoch_processing_with(
+        spec, state, "process_sync_committee_updates")
+    # rotated: next became current, a fresh next was computed
+    assert state.current_sync_committee == pre_next
+    assert state.next_sync_committee != pre_next
+    return pre_current
+
+
+@with_all_phases_from("altair")
+@with_presets(["minimal"], reason="period fast-forward too slow")
+@spec_state_test
+def test_sync_committees_progress_genesis(spec, state):
+    assert int(spec.get_current_epoch(state)) == 0
+    _to_last_epoch_of_period(spec, state)
+    yield from _run_rotation(spec, state)
+
+
+@with_all_phases_from("altair")
+@with_presets(["minimal"], reason="period fast-forward too slow")
+@spec_state_test
+def test_sync_committees_progress_not_genesis(spec, state):
+    # start one epoch in, still rotating at the same boundary
+    transition_to(spec, state, uint64(int(spec.SLOTS_PER_EPOCH)))
+    _to_last_epoch_of_period(spec, state)
+    yield from _run_rotation(spec, state)
+
+
+@with_all_phases_from("altair")
+@with_presets(["minimal"], reason="period fast-forward too slow")
+@with_custom_state(misc_balances, default_activation_threshold)
+@spec_state_test
+def test_sync_committees_progress_misc_balances_genesis(spec, state):
+    _to_last_epoch_of_period(spec, state)
+    yield from _run_rotation(spec, state)
+
+
+@with_all_phases_from("altair")
+@with_presets(["minimal"], reason="period fast-forward too slow")
+@with_custom_state(misc_balances, default_activation_threshold)
+@spec_state_test
+def test_sync_committees_progress_misc_balances_not_genesis(spec, state):
+    transition_to(spec, state, uint64(int(spec.SLOTS_PER_EPOCH)))
+    _to_last_epoch_of_period(spec, state)
+    yield from _run_rotation(spec, state)
+
+
+@with_all_phases_from("altair")
+@with_presets(["minimal"], reason="period fast-forward too slow")
+@spec_state_test
+def test_sync_committees_no_progress_not_at_period_boundary(spec, state):
+    period_epochs = int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)
+    assert period_epochs > 1
+    # an ordinary epoch boundary inside the period
+    pre_current = state.current_sync_committee.copy()
+    pre_next = state.next_sync_committee.copy()
+    yield from run_epoch_processing_with(
+        spec, state, "process_sync_committee_updates")
+    assert state.current_sync_committee == pre_current
+    assert state.next_sync_committee == pre_next

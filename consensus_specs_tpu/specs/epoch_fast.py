@@ -345,12 +345,23 @@ def slashings_pass(spec, state) -> bool:
     mask = arr.slashed & (
         np.uint64(epoch + int(spec.EPOCHS_PER_SLASHINGS_VECTOR) // 2)
         == arr.withdrawable)
-    if spec.is_post("electra"):
+    electra = bool(spec.is_post("electra"))
+    if adj == 0 or not mask.any():
+        # nothing slashable this epoch: skip the sweep entirely (the
+        # device dispatch would provably return all zeros)
+        masked_pen = np.zeros(arr.n, np.int64)
+    elif MESH_ENGINE is not None:
+        # the compiled validator-axis sweep (single-chip or mesh —
+        # same program, psums collapse at n_dev=1)
+        masked_pen = MESH_ENGINE.slashings_batch(
+            arr.eff // incr, mask, adj, tb, incr, electra)
+    elif electra:
         per_incr = adj // (tb // incr)
-        pen = (arr.eff // incr) * per_incr
+        masked_pen = np.where(mask, (arr.eff // incr) * per_incr, 0)
     else:
-        pen = (arr.eff // incr) * adj // tb * incr
-    new = np.maximum(arr.balances - np.where(mask, pen, 0), 0)
+        masked_pen = np.where(mask,
+                              (arr.eff // incr) * adj // tb * incr, 0)
+    new = np.maximum(arr.balances - masked_pen, 0)
     _write_balances(state, arr.balances, new)
     return True
 

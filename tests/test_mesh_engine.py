@@ -135,3 +135,82 @@ def test_sharded_msm_direct_matches_oracle(engine):
     got = engine.g1_msm(points, scalars)
     want = msm(points, scalars)
     assert got == want
+
+
+# ---------------------------------------------------------------------------
+# single-device engine (the n_dev=1 production path bench.py enables)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def single_engine():
+    eng = mesh_engine.enable_single_device(merkle_threshold=64,
+                                           msm_threshold=8)
+    yield eng
+    eng.disable()
+
+
+def test_single_device_epoch_same_root(single_engine):
+    """The 1-device mesh runs the SAME compiled flag/slashing programs;
+    a full epoch must stay byte-identical to the host engine."""
+    spec = get_spec("altair", DEFAULT_TEST_PRESET)
+    state = create_genesis_state(spec, default_balances(spec))
+    next_epoch(spec, state)
+    for i in range(len(state.validators)):
+        state.previous_epoch_participation[i] = 0b111 if i % 2 else 0b001
+    dev_state = state.copy()
+    host_state = state.copy()
+
+    spec.process_epoch(dev_state)
+    single_engine.disable()
+    spec.process_epoch(host_state)
+    single_engine.enable()
+    assert hash_tree_root(dev_state) == hash_tree_root(host_state)
+
+
+def _slashed_state(spec):
+    from consensus_specs_tpu.ssz import uint64
+    state = create_genesis_state(spec, default_balances(spec))
+    next_epoch(spec, state)
+    epoch = int(spec.get_current_epoch(state))
+    window = int(spec.EPOCHS_PER_SLASHINGS_VECTOR)
+    for i in range(0, len(state.validators), 3):
+        v = state.validators[i]
+        v.slashed = True
+        v.withdrawable_epoch = uint64(epoch + window // 2)
+    state.slashings[epoch % window] = uint64(
+        3 * int(spec.MAX_EFFECTIVE_BALANCE))
+    return state
+
+
+@pytest.mark.parametrize("fork", ["altair", "electra"])
+def test_sharded_slashings_match_host_engine(single_engine, fork):
+    """Both slashing-penalty forms (pre-electra and the increment-
+    factored electra form) through the compiled sweep."""
+    spec = get_spec(fork, DEFAULT_TEST_PRESET)
+    state = _slashed_state(spec)
+    dev_state = state.copy()
+    host_state = state.copy()
+
+    assert epoch_fast.slashings_pass(spec, dev_state)
+    single_engine.disable()
+    assert epoch_fast.slashings_pass(spec, host_state)
+    single_engine.enable()
+    assert [int(b) for b in dev_state.balances] \
+        == [int(b) for b in host_state.balances]
+    # penalties actually fired (the sweep wasn't a no-op)
+    assert any(int(a) != int(b) for a, b in
+               zip(dev_state.balances, state.balances))
+
+
+def test_sharded_slashings_match_on_mesh(engine):
+    """Same sweep on the multi-device mesh: psums and padding lanes."""
+    spec = get_spec("altair", DEFAULT_TEST_PRESET)
+    state = _slashed_state(spec)
+    dev_state = state.copy()
+    host_state = state.copy()
+    assert epoch_fast.slashings_pass(spec, dev_state)
+    engine.disable()
+    assert epoch_fast.slashings_pass(spec, host_state)
+    engine.enable()
+    assert [int(b) for b in dev_state.balances] \
+        == [int(b) for b in host_state.balances]
