@@ -9,6 +9,25 @@ from ...test_infra.blocks import (
     transition_to)
 
 
+class InvalidBlock(Exception):
+    """Raised by an invalid-case builder AFTER constructing the signed
+    block(s), so the vector still carries the block a consumer must
+    reject (bare raises would emit zero blocks — nothing to reject)."""
+
+    def __init__(self, blocks):
+        super().__init__("invalid block built")
+        self.blocks = blocks
+
+
+def _apply_invalid(spec, state, signed):
+    """Apply a block that MUST fail; carry it out via InvalidBlock."""
+    try:
+        spec.state_transition(state, signed, True)
+    except (AssertionError, ValueError, IndexError):
+        raise InvalidBlock([signed])
+    raise AssertionError("block unexpectedly valid")
+
+
 def _run_blocks(spec, state, blocks_builder, valid=True):
     """Yield pre, apply blocks from `blocks_builder(state)`, yield each
     signed block and post."""
@@ -16,6 +35,13 @@ def _run_blocks(spec, state, blocks_builder, valid=True):
     signed_blocks = []
     try:
         signed_blocks = blocks_builder(state)
+    except InvalidBlock as exc:
+        assert not valid, "InvalidBlock raised in a valid case"
+        for i, sb in enumerate(exc.blocks):
+            yield f"blocks_{i}", sb
+        yield "blocks_count", "meta", len(exc.blocks)
+        yield "post", None
+        return
     except (AssertionError, ValueError, IndexError):
         if valid:
             raise
@@ -68,8 +94,9 @@ def test_empty_epoch_transition(spec, state):
 @with_all_phases
 @spec_state_test
 @never_bls
-def test_attestation_block(spec, state):
-    """A block carrying one attestation; participation is recorded."""
+def test_attestation(spec, state):
+    """A block carrying one attestation; participation is recorded
+    (reference name; the operations battery covers the handler)."""
     transition_to(spec, state,
                   state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
     def build(state):
@@ -82,6 +109,10 @@ def test_attestation_block(spec, state):
         block.body.attestations.append(attestation)
         return [state_transition_and_sign_block(spec, state, block)]
     yield from _run_blocks(spec, state, build)
+    if spec.is_post("altair"):
+        assert any(int(p) for p in state.current_epoch_participation)
+    else:
+        assert len(state.current_epoch_attestations) == 1
 
 
 @with_all_phases
@@ -93,22 +124,20 @@ def test_invalid_prev_slot_block(spec, state):
         signed = state_transition_and_sign_block(spec, state.copy(), block)
         # re-applying at the same slot must fail
         spec.state_transition(state, signed)
-        spec.state_transition(state, signed)
-        return [signed]
+        _apply_invalid(spec, state, signed)
     yield from _run_blocks(spec, state, build, valid=False)
 
 
 @with_all_phases
 @spec_state_test
 @never_bls
-def test_invalid_state_root(spec, state):
+def test_invalid_incorrect_state_root(spec, state):
     def build(state):
         block = build_empty_block_for_next_slot(spec, state)
         block.state_root = b"\xaa" * 32
         from ...test_infra.blocks import sign_block
         signed = sign_block(spec, state, block)
-        spec.state_transition(state, signed)
-        return [signed]
+        _apply_invalid(spec, state, signed)
     yield from _run_blocks(spec, state, build, valid=False)
 
 
@@ -127,8 +156,7 @@ def test_invalid_all_zeroed_sig(spec, state):
         spec.process_block(temp, block)
         block.state_root = hash_tree_root(temp)
         signed = spec.SignedBeaconBlock(message=block)   # zero signature
-        spec.state_transition(state, signed, True)
-        return [signed]
+        _apply_invalid(spec, state, signed)
     yield from _run_blocks(spec, state, build, valid=False)
 
 
@@ -152,8 +180,7 @@ def test_invalid_incorrect_block_sig(spec, state):
                              % len(privkeys)]
         signed = spec.SignedBeaconBlock(
             message=block, signature=bls_shim.Sign(wrong_key, root))
-        spec.state_transition(state, signed, True)
-        return [signed]
+        _apply_invalid(spec, state, signed)
     yield from _run_blocks(spec, state, build, valid=False)
 
 
@@ -167,8 +194,7 @@ def test_invalid_incorrect_proposer_index(spec, state):
         block.proposer_index = uint64(
             (int(block.proposer_index) + 3) % len(state.validators))
         signed = sign_block(spec, state, block)
-        spec.state_transition(state, signed, True)
-        return [signed]
+        _apply_invalid(spec, state, signed)
     yield from _run_blocks(spec, state, build, valid=False)
 
 
@@ -182,8 +208,7 @@ def test_invalid_proposal_for_genesis_slot(spec, state):
         block.slot = spec.GENESIS_SLOT
         block.parent_root = b"\x01" * 32
         signed = sign_block(spec, state, block)
-        spec.state_transition(state, signed, True)
-        return [signed]
+        _apply_invalid(spec, state, signed)
     yield from _run_blocks(spec, state, build, valid=False)
 
 
@@ -213,17 +238,28 @@ def test_historical_batch(spec, state):
               % int(spec.SLOTS_PER_HISTORICAL_ROOT))
               + int(spec.SLOTS_PER_HISTORICAL_ROOT) - 1)
     transition_to(spec, state, uint64(target))
-    pre_len_hist = (len(state.historical_summaries)
-                    if spec.is_post("capella")
-                    else len(state.historical_roots))
+    pre_historical_roots = list(state.historical_roots)
+    pre_len_summaries = (len(state.historical_summaries)
+                         if spec.is_post("capella") else 0)
+    built = []
     def build(state):
         block = build_empty_block_for_next_slot(spec, state)
+        built.append(block)
         return [state_transition_and_sign_block(spec, state, block)]
     yield from _run_blocks(spec, state, build)
-    post_len_hist = (len(state.historical_summaries)
-                     if spec.is_post("capella")
-                     else len(state.historical_roots))
-    assert post_len_hist == pre_len_hist + 1
+    # full reference assertion set (test/phase0/sanity/
+    # test_blocks.py:1047): landing slot + epoch alignment + capella's
+    # FROZEN historical_roots
+    assert int(state.slot) == int(built[0].slot)
+    assert int(spec.get_current_epoch(state)) % (
+        int(spec.SLOTS_PER_HISTORICAL_ROOT)
+        // int(spec.SLOTS_PER_EPOCH)) == 0
+    if spec.is_post("capella"):
+        assert list(state.historical_roots) == pre_historical_roots
+        assert len(state.historical_summaries) == pre_len_summaries + 1
+    else:
+        assert len(state.historical_roots) == \
+            len(pre_historical_roots) + 1
 
 
 # ---------------------------------------------------------------------------
@@ -256,7 +292,8 @@ def test_invalid_duplicate_proposer_slashings_same_block(spec, state):
         block = build_empty_block_for_next_slot(spec, state)
         block.body.proposer_slashings.append(slashing)
         block.body.proposer_slashings.append(slashing)
-        return [state_transition_and_sign_block(spec, state, block)]
+        raise InvalidBlock([state_transition_and_sign_block(
+            spec, state, block, expect_fail=True)])
     yield from _run_blocks(spec, state, build, valid=False)
 
 
@@ -286,7 +323,8 @@ def test_invalid_duplicate_attester_slashing_same_block(spec, state):
         block = build_empty_block_for_next_slot(spec, state)
         block.body.attester_slashings.append(slashing)
         block.body.attester_slashings.append(slashing)
-        return [state_transition_and_sign_block(spec, state, block)]
+        raise InvalidBlock([state_transition_and_sign_block(
+            spec, state, block, expect_fail=True)])
     yield from _run_blocks(spec, state, build, valid=False)
 
 
@@ -382,7 +420,8 @@ def test_invalid_duplicate_validator_exit_same_block(spec, state):
         block = build_empty_block_for_next_slot(spec, state)
         block.body.voluntary_exits.append(exit_op)
         block.body.voluntary_exits.append(exit_op)
-        return [state_transition_and_sign_block(spec, state, block)]
+        raise InvalidBlock([state_transition_and_sign_block(
+            spec, state, block, expect_fail=True)])
     yield from _run_blocks(spec, state, build, valid=False)
 
 
@@ -408,32 +447,46 @@ def test_duplicate_attestation_same_block(spec, state):
 @spec_state_test
 @never_bls
 def test_eth1_data_votes_consensus(spec, state):
-    # a majority of votes for one eth1 block adopts it
+    """Full reference assertion set (test/phase0/sanity/
+    test_blocks.py:1077): A reaches majority mid-period and is adopted;
+    switching votes to B afterwards changes nothing; the period
+    boundary resets the vote list to the single new C vote while the
+    adopted data stays A."""
     period = int(spec.EPOCHS_PER_ETH1_VOTING_PERIOD) \
         * int(spec.SLOTS_PER_EPOCH)
-    eth1 = spec.Eth1Data(
-        deposit_root=b"\x11" * 32,
-        deposit_count=state.eth1_data.deposit_count,
-        block_hash=b"\x22" * 32)
-    needed = period // 2 + 1
+    if period > 64:
+        from ...gen.vector_test import SkippedTest
+        raise SkippedTest("voting period too long outside minimal")
+    a, b, c = b"\xaa" * 32, b"\xbb" * 32, b"\xcc" * 32
+
     def build(state):
+        from ...test_infra.blocks import build_empty_block
         out = []
-        for _ in range(needed):
+        # offset so the loop below spans exactly one voting period
+        offset_block = build_empty_block(spec, state,
+                                         slot=uint64(period - 1))
+        out.append(state_transition_and_sign_block(spec, state,
+                                                   offset_block))
+        for i in range(period):
             block = build_empty_block_for_next_slot(spec, state)
-            block.body.eth1_data = eth1
-            out.append(state_transition_and_sign_block(spec, state, block))
+            # majority for A, then the electorate switches to B
+            block.body.eth1_data.block_hash = \
+                b if i * 2 > period else a
+            out.append(state_transition_and_sign_block(spec, state,
+                                                       block))
+        assert len(state.eth1_data_votes) == period
+        assert bytes(state.eth1_data.block_hash) == a
+        # cross into the next voting period with a C vote
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.eth1_data.block_hash = c
+        out.append(state_transition_and_sign_block(spec, state, block))
         return out
-    if period <= 64:
-        yield from _run_blocks(spec, state, build)
-        assert state.eth1_data == eth1
-    else:
-        # still emit a single-vote trajectory for mainnet-sized periods
-        def build_one(state):
-            block = build_empty_block_for_next_slot(spec, state)
-            block.body.eth1_data = eth1
-            return [state_transition_and_sign_block(spec, state, block)]
-        yield from _run_blocks(spec, state, build_one)
-        assert state.eth1_data != eth1
+
+    yield from _run_blocks(spec, state, build)
+    assert bytes(state.eth1_data.block_hash) == a
+    assert int(state.slot) % period == 0
+    assert len(state.eth1_data_votes) == 1
+    assert bytes(state.eth1_data_votes[0].block_hash) == c
 
 
 # ── header/proposer edge shapes (reference phase0 sanity battery) ────
@@ -450,8 +503,9 @@ def test_invalid_same_slot_block_transition(spec, state):
         b1 = build_empty_block_for_next_slot(spec, state)
         signed = state_transition_and_sign_block(spec, state, b1)
         b2 = build_empty_block(spec, state, slot=state.slot)
-        return [signed,
-                state_transition_and_sign_block(spec, state, b2)]
+        raise InvalidBlock([
+            signed, state_transition_and_sign_block(
+                spec, state, b2, expect_fail=True)])
     yield from _run_blocks(spec, state, build, valid=False)
 
 
@@ -466,7 +520,8 @@ def test_invalid_parent_from_same_slot(spec, state):
         block.parent_root = hash_tree_root(state.latest_block_header
                                            .copy())
         block.parent_root = b"\x12" * 32
-        return [state_transition_and_sign_block(spec, state, block)]
+        raise InvalidBlock([state_transition_and_sign_block(
+            spec, state, block, expect_fail=True)])
     yield from _run_blocks(spec, state, build, valid=False)
 
 
@@ -488,8 +543,7 @@ def test_invalid_proposer_index_sig_from_expected_proposer(spec, state):
         signed = sign_block(spec, scratch, block)
         signed.message.proposer_index = uint64(
             (expected + 1) % len(state.validators))
-        spec.state_transition(state, signed)
-        return [signed]
+        _apply_invalid(spec, state, signed)
     yield from _run_blocks(spec, state, build, valid=False)
 
 
@@ -517,8 +571,7 @@ def test_invalid_proposer_index_sig_from_proposer_index(spec, state):
         sig = _bls.Sign(privkey, spec.compute_signing_root(
             block, domain))
         signed = spec.SignedBeaconBlock(message=block, signature=sig)
-        spec.state_transition(state, signed)
-        return [signed]
+        _apply_invalid(spec, state, signed)
     yield from _run_blocks(spec, state, build, valid=False)
 
 
@@ -599,7 +652,8 @@ def test_invalid_similar_proposer_slashings_same_block(spec, state):
             signed_header_2=ps.signed_header_1)
         block = build_empty_block_for_next_slot(spec, state)
         block.body.proposer_slashings = [ps, ps2]
-        return [state_transition_and_sign_block(spec, state, block)]
+        raise InvalidBlock([state_transition_and_sign_block(
+            spec, state, block, expect_fail=True)])
     yield from _run_blocks(spec, state, build, valid=False)
 
 
@@ -665,13 +719,58 @@ def test_multiple_attester_slashings_no_overlap(spec, state):
 @with_all_phases
 @spec_state_test
 @never_bls
+def test_multiple_attester_slashings_partial_overlap(spec, state):
+    """Two slashings whose index sets OVERLAP by a third (reference
+    test/phase0/sanity/test_blocks.py:631): every validator in the
+    union is slashed exactly once, balances decrease once."""
+    from ...test_infra.slashings import (
+        get_valid_attester_slashing_by_indices)
+    limit = int(spec.MAX_ATTESTER_SLASHINGS_ELECTRA) \
+        if spec.is_post("electra") else int(spec.MAX_ATTESTER_SLASHINGS)
+    if limit < 2:
+        from ...gen.vector_test import SkippedTest
+        raise SkippedTest("config caps attester slashings below 2/block")
+    pre_state = state.copy()
+    full_indices = [int(i) for i in spec.get_active_validator_indices(
+        state, spec.get_current_epoch(state))[:8]]
+    third = len(full_indices) // 3
+
+    def build(state):
+        slashing_1 = get_valid_attester_slashing_by_indices(
+            spec, state, full_indices[:third * 2])
+        slashing_2 = get_valid_attester_slashing_by_indices(
+            spec, state, full_indices[third:])
+        assert not any(state.validators[i].slashed
+                       for i in full_indices)
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.attester_slashings = [slashing_1, slashing_2]
+        return [state_transition_and_sign_block(spec, state, block)]
+
+    yield from _run_blocks(spec, state, build)
+    # union slashed exactly once: flag set, withdrawable set; balances
+    # strictly decrease EXCEPT for the proposer, whose whistleblower
+    # rewards (EB/512 per slashed validator) can offset the penalty
+    proposer = int(state.latest_block_header.proposer_index)
+    for i in full_indices:
+        v = state.validators[i]
+        assert bool(v.slashed)
+        assert int(v.exit_epoch) != int(spec.FAR_FUTURE_EPOCH)
+        assert int(v.withdrawable_epoch) != int(spec.FAR_FUTURE_EPOCH)
+        if i != proposer:
+            assert int(state.balances[i]) < int(pre_state.balances[i])
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
 def test_invalid_only_increase_deposit_count(spec, state):
     """eth1 deposit_count bumped without supplying the deposit: the
     per-block deposit-inclusion equation fails."""
     def build(state):
         state.eth1_data.deposit_count += 1
         block = build_empty_block_for_next_slot(spec, state)
-        return [state_transition_and_sign_block(spec, state, block)]
+        raise InvalidBlock([state_transition_and_sign_block(
+            spec, state, block, expect_fail=True)])
     yield from _run_blocks(spec, state, build, valid=False)
 
 
@@ -687,7 +786,8 @@ def test_invalid_duplicate_deposit_same_block(spec, state):
     def build(state):
         block = build_empty_block_for_next_slot(spec, state)
         block.body.deposits = [deposit, deposit]
-        return [state_transition_and_sign_block(spec, state, block)]
+        raise InvalidBlock([state_transition_and_sign_block(
+            spec, state, block, expect_fail=True)])
     yield from _run_blocks(spec, state, build, valid=False)
 
 
@@ -787,24 +887,37 @@ def test_balance_driven_status_transitions(spec, state):
 @spec_state_test
 @never_bls
 def test_eth1_data_votes_no_consensus(spec, state):
-    """A minority eth1 vote never resets eth1_data."""
-    if int(spec.EPOCHS_PER_ETH1_VOTING_PERIOD) > 2:
-        return  # only exercised on minimal-scale voting periods
-    voting_slots = int(spec.EPOCHS_PER_ETH1_VOTING_PERIOD) * \
-        int(spec.SLOTS_PER_EPOCH)
-    pre_eth1 = state.eth1_data.copy()
+    """Full reference assertion set (test/phase0/sanity/
+    test_blocks.py:1118): an exact 50/50 A-vs-B split across the whole
+    period never reaches the strict-majority threshold, so eth1_data
+    keeps its pre-period value."""
+    period = int(spec.EPOCHS_PER_ETH1_VOTING_PERIOD) \
+        * int(spec.SLOTS_PER_EPOCH)
+    if period > 64:
+        from ...gen.vector_test import SkippedTest
+        raise SkippedTest("voting period too long outside minimal")
+    pre_eth1_hash = bytes(state.eth1_data.block_hash)
+    a, b = b"\xaa" * 32, b"\xbb" * 32
+
     def build(state):
-        blocks = []
-        for k in range(voting_slots // 2 - 1):
+        from ...test_infra.blocks import build_empty_block
+        out = []
+        offset_block = build_empty_block(spec, state,
+                                         slot=uint64(period - 1))
+        out.append(state_transition_and_sign_block(spec, state,
+                                                   offset_block))
+        for i in range(period):
             block = build_empty_block_for_next_slot(spec, state)
-            block.body.eth1_data.block_hash = b"\xaa" * 32
-            block.body.eth1_data.deposit_count = \
-                state.eth1_data.deposit_count
-            blocks.append(
-                state_transition_and_sign_block(spec, state, block))
-        assert state.eth1_data == pre_eth1
-        return blocks
+            # precisely 50% for A, the other 50% for B
+            block.body.eth1_data.block_hash = \
+                b if i * 2 >= period else a
+            out.append(state_transition_and_sign_block(spec, state,
+                                                       block))
+        assert len(state.eth1_data_votes) == period
+        return out
+
     yield from _run_blocks(spec, state, build)
+    assert bytes(state.eth1_data.block_hash) == pre_eth1_hash
 
 
 # ── seeded random op mixes (reference full_random_operations_N) ──────
@@ -848,3 +961,140 @@ def test_full_random_operations_2(spec, state):
 @never_bls
 def test_full_random_operations_3(spec, state):
     yield from _random_ops_case(spec, state, 103)
+
+
+# ── single-operation whole-block trajectories (reference phase0
+#    sanity names: the operation batteries cover the handlers; these
+#    cover their BLOCK-level integration) ─────────────────────────────
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_attester_slashing(spec, state):
+    from ...test_infra.slashings import get_valid_attester_slashing
+    pre_state = state.copy()
+    slashed = []
+    def build(state):
+        aslash = get_valid_attester_slashing(spec, state)
+        slashed.extend(int(i) for i in
+                       aslash.attestation_1.attesting_indices)
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.attester_slashings = [aslash]
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build)
+    proposer = int(state.latest_block_header.proposer_index)
+    for i in slashed:
+        assert bool(state.validators[i].slashed)
+        if i != proposer:
+            assert int(state.balances[i]) < int(pre_state.balances[i])
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_proposer_slashing(spec, state):
+    from ...test_infra.slashings import get_valid_proposer_slashing
+    pre_state = state.copy()
+    box = []
+    def build(state):
+        pslash = get_valid_proposer_slashing(spec, state)
+        box.append(int(pslash.signed_header_1.message.proposer_index))
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.proposer_slashings = [pslash]
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build)
+    i = box[0]
+    assert bool(state.validators[i].slashed)
+    assert int(state.balances[i]) < int(pre_state.balances[i])
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_voluntary_exit(spec, state):
+    from ...test_infra.slashings import get_valid_voluntary_exit
+    # maturity jump BEFORE the pre-state is emitted, so pre + block
+    # replays to post on a conforming consumer
+    state.slot = uint64(
+        int(state.slot)
+        + (int(spec.config.SHARD_COMMITTEE_PERIOD) + 1)
+        * int(spec.SLOTS_PER_EPOCH))
+    def build(state):
+        signed_exit = get_valid_voluntary_exit(spec, state, 2)
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.voluntary_exits = [signed_exit]
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build)
+    assert int(state.validators[2].exit_epoch) \
+        != int(spec.FAR_FUTURE_EPOCH)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_deposit_top_up(spec, state):
+    from ...test_infra.deposits import prepare_state_and_deposit
+    amount = int(spec.MAX_EFFECTIVE_BALANCE) // 4
+    control_balance = []
+    def build(state):
+        # control: the same empty block on a PRE-deposit copy isolates
+        # the deposit credit from per-block sync rewards/penalties
+        control = state.copy()
+        control_block = build_empty_block_for_next_slot(spec, control)
+        state_transition_and_sign_block(spec, control, control_block)
+        control_balance.append(int(control.balances[0]))
+        deposit = prepare_state_and_deposit(spec, state, 0, amount,
+                                            signed=True)
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.deposits = [deposit]
+        return [state_transition_and_sign_block(spec, state, block)]
+    yield from _run_blocks(spec, state, build)
+    if spec.is_post("electra"):
+        # EIP-6110: the top-up sits in the pending queue, not balances
+        assert any(int(d.amount) == amount
+                   for d in state.pending_deposits)
+    else:
+        assert int(state.balances[0]) == control_balance[0] + amount
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_invalid_prev_slot_block_transition(spec, state):
+    """A block whose slot is BEHIND the state (already-processed slot)."""
+    def build(state):
+        # a perfectly valid next-slot block ...
+        block = build_empty_block_for_next_slot(spec, state)
+        lookahead = state.copy()
+        signed = state_transition_and_sign_block(spec, lookahead, block)
+        # ... arriving after the state already advanced past its slot
+        spec.process_slots(state, uint64(int(block.slot) + 1))
+        _apply_invalid(spec, state, signed)
+    yield from _run_blocks(spec, state, build, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_incorrect_proposer_index_sig_from_expected_proposer(
+        spec, state):
+    """Wrong proposer_index in the block, signed by the EXPECTED
+    proposer: header check rejects before signatures matter."""
+    from ...test_infra.blocks import proposer_privkey, sign_block
+    def build(state):
+        block = build_empty_block_for_next_slot(spec, state)
+        expected = int(block.proposer_index)
+        block.proposer_index = uint64(
+            (expected + 3) % len(state.validators))
+        lookahead = state.copy()
+        spec.process_slots(lookahead, block.slot)
+        from ...utils import bls as _bls
+        domain = spec.get_domain(
+            lookahead, spec.DOMAIN_BEACON_PROPOSER,
+            spec.compute_epoch_at_slot(block.slot))
+        privkey = proposer_privkey(spec, lookahead, expected)
+        sig = _bls.Sign(privkey,
+                        spec.compute_signing_root(block, domain))
+        signed = spec.SignedBeaconBlock(message=block, signature=sig)
+        _apply_invalid(spec, state, signed)
+    yield from _run_blocks(spec, state, build, valid=False)

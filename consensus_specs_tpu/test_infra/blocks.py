@@ -112,12 +112,26 @@ def sign_block(spec, state, block):
         message=block, signature=bls.Sign(privkey, signing_root))
 
 
-def state_transition_and_sign_block(spec, state, block):
+def state_transition_and_sign_block(spec, state, block,
+                                    expect_fail=False):
     """Fill block.state_root, sign, and apply to `state`; returns the
-    signed block (the harness's standard way to extend a chain)."""
+    signed block (the harness's standard way to extend a chain).
+
+    `expect_fail` mirrors the reference helper (helpers/state.py:94):
+    the transition must raise, and the block is still signed over the
+    slot-advanced state root so invalid vectors carry a real block."""
     temp = state.copy()
     if temp.slot < block.slot:
         spec.process_slots(temp, block.slot)
+    if expect_fail:
+        try:
+            spec.process_block(temp, block)
+        except (AssertionError, ValueError, IndexError):
+            pass
+        else:
+            raise AssertionError("block unexpectedly valid")
+        block.state_root = hash_tree_root(temp)
+        return sign_block(spec, state, block)
     spec.process_block(temp, block)
     block.state_root = hash_tree_root(temp)
     signed_block = sign_block(spec, state, block)

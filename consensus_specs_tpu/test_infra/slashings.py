@@ -75,6 +75,38 @@ def sign_voluntary_exit(spec, state, voluntary_exit, privkey):
         message=voluntary_exit, signature=bls.Sign(privkey, signing_root))
 
 
+def get_valid_attester_slashing_by_indices(spec, state, indices,
+                                           signed_1=True, signed_2=True):
+    """Double-vote slashing whose indexed attestations cover exactly
+    `indices` (reference helpers/attester_slashings.py equivalent):
+    builds the data from a live attestation, then replaces the index
+    sets and re-signs per set."""
+    att = get_valid_attestation(spec, state, signed=False)
+    indices = sorted(int(i) for i in indices)
+    indexed_1 = spec.IndexedAttestation(
+        attesting_indices=indices, data=att.data)
+    data_2 = att.data.copy()
+    data_2.beacon_block_root = b"\x01" * 32
+    indexed_2 = spec.IndexedAttestation(
+        attesting_indices=indices, data=data_2)
+
+    def _sign(indexed):
+        domain = spec.get_domain(state, spec.DOMAIN_BEACON_ATTESTER,
+                                 indexed.data.target.epoch)
+        root = spec.compute_signing_root(indexed.data, domain)
+        sigs = [bls.Sign(privkey_for_pubkey(
+            state.validators[i].pubkey), root)
+            for i in indexed.attesting_indices]
+        indexed.signature = bls.Aggregate(sigs) if sigs \
+            else spec.G2_POINT_AT_INFINITY
+    if signed_1:
+        _sign(indexed_1)
+    if signed_2:
+        _sign(indexed_2)
+    return spec.AttesterSlashing(attestation_1=indexed_1,
+                                 attestation_2=indexed_2)
+
+
 def get_valid_voluntary_exit(spec, state, validator_index, signed=True):
     voluntary_exit = spec.VoluntaryExit(
         epoch=spec.get_current_epoch(state),
