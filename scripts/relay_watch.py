@@ -38,8 +38,11 @@ PROBE_TIMEOUT = float(os.environ.get("RELAY_PROBE_TIMEOUT_S", "90"))
 
 # tiers in banking order: merkle lands a number fast; north_star is the
 # headline crypto tier; the rest only if the relay window stays open
-TIER_BUDGETS = [("merkle", 200), ("north_star", 600),
-                ("attestations", 480), ("kzg", 360), ("epoch", 360)]
+# north_star's fused-pairing TPU compile alone can take >10 min cold —
+# give it a window-sized budget (the 03:53Z window ran 720s and died
+# in compile; merkle banked in 41.5s)
+TIER_BUDGETS = [("merkle", 200), ("north_star", 1500),
+                ("attestations", 900), ("kzg", 600), ("epoch", 600)]
 
 
 def _now() -> str:
