@@ -256,3 +256,138 @@ def test_discard_equivocations_on_attester_slashing(spec, state):
     assert _head_root(spec, store) == root_a
     output_store_checks(spec, store, steps)
     yield from emit_steps(steps)
+
+
+# ---------------------------------------------------------------------------
+# voting-source window (reference test_get_head.py:475-629)
+# ---------------------------------------------------------------------------
+
+from ...test_infra.context import (  # noqa: E402
+    with_all_phases_from, with_presets, with_pytest_fork_subset)
+from ...test_infra.attestations import (  # noqa: E402
+    next_epoch_with_attestations)
+from ...test_infra.blocks import next_epoch  # noqa: E402
+from ...test_infra.fork_choice import (  # noqa: E402
+    apply_next_epoch_with_attestations, get_head_root,
+    tick_to_state_slot)
+
+VS_FORKS = ["altair", "electra"]
+
+
+from ...test_infra.fork_choice import (  # noqa: E402
+    fill_epochs_with_attestations)
+
+
+def _prologue_three_epochs(spec, state, store, steps):
+    next_epoch(spec, state)
+    tick_to_state_slot(spec, store, state, steps)
+    parts = fill_epochs_with_attestations(spec, state, store, steps, 3)
+    assert int(store.justified_checkpoint.epoch) == 3
+    assert int(store.finalized_checkpoint.epoch) == 2
+    return parts
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(VS_FORKS)
+@with_presets(["minimal"], reason="too slow")
+@spec_state_test
+@never_bls
+def test_voting_source_within_two_epoch(spec, state):
+    """A fork whose voting source trails the store's justified
+    checkpoint stays viable while within the 2-epoch window — the fork
+    (with fresher LMD votes) takes the head."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    tick_to_state_slot(spec, store, state, steps)
+    for name, v in _prologue_three_epochs(spec, state, store, steps):
+        yield name, v
+    fork_state = state.copy()
+
+    more, _ = apply_next_epoch_with_attestations(
+        spec, state, store, steps, fill_cur_epoch=True,
+        fill_prev_epoch=True)
+    for name, v in more:
+        yield name, v
+    assert int(store.justified_checkpoint.epoch) == 4
+    assert int(store.finalized_checkpoint.epoch) == 3
+
+    # fork from the epoch-4 boundary, voting source stuck at 3
+    next_epoch(spec, fork_state)
+    assert int(spec.compute_epoch_at_slot(fork_state.slot)) == 5
+    signed_blocks, _post = next_epoch_with_attestations(
+        spec, fork_state, True, True)
+    signed_blocks = signed_blocks[:-1]   # keep epoch-5 blocks only
+    last_fork_block = signed_blocks[-1].message
+    assert int(spec.compute_epoch_at_slot(last_fork_block.slot)) == 5
+
+    for signed_block in signed_blocks:
+        for name, v in tick_and_add_block(spec, store, signed_block,
+                                          steps):
+            yield name, v
+    assert int(store.justified_checkpoint.epoch) == 4
+    root = hash_tree_root(last_fork_block)
+    assert int(store.unrealized_justifications[root].epoch) \
+        >= int(store.justified_checkpoint.epoch)
+    assert store.finalized_checkpoint.root == spec.get_checkpoint_block(
+        store, root, store.finalized_checkpoint.epoch)
+    # within the window: the fork's fresher LMD votes win the head
+    assert get_head_root(spec, store) == root
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(VS_FORKS)
+@with_presets(["minimal"], reason="too slow")
+@spec_state_test
+@never_bls
+def test_voting_source_beyond_two_epoch(spec, state):
+    """Beyond the 2-epoch window the stale-source fork is filtered:
+    the canonical head stands."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    tick_to_state_slot(spec, store, state, steps)
+    for name, v in _prologue_three_epochs(spec, state, store, steps):
+        yield name, v
+    fork_state = state.copy()
+
+    last_canonical = []
+    for _ in range(2):
+        more, blocks = apply_next_epoch_with_attestations(
+            spec, state, store, steps, fill_cur_epoch=True,
+            fill_prev_epoch=True)
+        last_canonical = blocks
+        for name, v in more:
+            yield name, v
+    assert int(store.justified_checkpoint.epoch) == 5
+    assert int(store.finalized_checkpoint.epoch) == 4
+    correct_head = hash_tree_root(last_canonical[-1].message)
+    assert get_head_root(spec, store) == correct_head
+
+    # fork left two epochs behind: its voting source (3) is stale
+    for _ in range(2):
+        next_epoch(spec, fork_state)
+    assert int(spec.compute_epoch_at_slot(fork_state.slot)) == 6
+    assert int(fork_state.current_justified_checkpoint.epoch) == 3
+    signed_blocks, _post = next_epoch_with_attestations(
+        spec, fork_state, True, True)
+    signed_blocks = signed_blocks[:-1]
+    last_fork_block = signed_blocks[-1].message
+
+    for signed_block in signed_blocks:
+        for name, v in tick_and_add_block(spec, store, signed_block,
+                                          steps):
+            yield name, v
+    root = hash_tree_root(last_fork_block)
+    assert int(store.block_states[root]
+               .current_justified_checkpoint.epoch) == 3
+    assert int(store.unrealized_justifications[root].epoch) \
+        >= int(store.justified_checkpoint.epoch)
+    assert store.finalized_checkpoint.root == spec.get_checkpoint_block(
+        store, root, store.finalized_checkpoint.epoch)
+    # filtered out: head unchanged
+    assert get_head_root(spec, store) == correct_head
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)

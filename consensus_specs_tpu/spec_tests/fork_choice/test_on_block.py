@@ -286,3 +286,265 @@ def test_justification_withholding(spec, state):
     assert int(store.justified_checkpoint.epoch) > justified_before
     output_store_checks(spec, store, steps)
     yield from emit_steps(steps)
+
+
+# ---------------------------------------------------------------------------
+# pull-up tips & delayed justification reveals (reference phase0
+# test_on_block.py:685-1400)
+# ---------------------------------------------------------------------------
+
+from ...test_infra.context import with_all_phases_from, with_presets  # noqa: E402
+from ...test_infra.context import with_pytest_fork_subset as _subset  # noqa: E402
+from ...test_infra.attestations import (  # noqa: E402
+    state_transition_with_full_block)
+from ...test_infra.fork_choice import (  # noqa: E402
+    find_next_justifying_slot, get_head_root, is_ready_to_justify,
+    on_tick_and_append_step, tick_to_state_slot)
+
+PULL_UP_FORKS = ["altair", "electra"]
+
+
+from ...test_infra.fork_choice import (  # noqa: E402
+    fill_epochs_with_attestations as _fill_epochs)
+
+
+@with_all_phases_from("altair")
+@_subset(PULL_UP_FORKS)
+@with_presets(["minimal"], reason="too slow")
+@spec_state_test
+@never_bls
+def test_pull_up_past_epoch_block(spec, state):
+    """A past-epoch chain whose tip justifies its own epoch: adding it
+    later pulls the justification (and finalization) up immediately."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    tick_to_state_slot(spec, store, state, steps)
+    next_epoch(spec, state)
+    tick_to_state_slot(spec, store, state, steps)
+    for name, v in _fill_epochs(spec, state, store, steps, 3):
+        yield name, v
+    assert int(store.justified_checkpoint.epoch) == 3
+    assert int(store.finalized_checkpoint.epoch) == 2
+
+    # a chain inside epoch 4 that justifies epoch 4 — withheld for now
+    signed_blocks, justifying_slot = find_next_justifying_slot(
+        spec, state, True, True)
+    assert int(spec.compute_epoch_at_slot(uint64(justifying_slot))) == 4
+
+    next_epoch(spec, state)
+    tick_to_state_slot(spec, store, state, steps)
+    assert int(spec.compute_epoch_at_slot(
+        spec.get_current_slot(store))) == 5
+    assert int(store.justified_checkpoint.epoch) == 3
+
+    for signed_block in signed_blocks:
+        for name, v in tick_and_add_block(spec, store, signed_block,
+                                          steps):
+            yield name, v
+        assert get_head_root(spec, store) == \
+            hash_tree_root(signed_block.message)
+    # past-epoch block: pulled up on arrival
+    assert int(store.justified_checkpoint.epoch) == 4
+    assert int(store.finalized_checkpoint.epoch) == 3
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)
+
+
+@with_all_phases_from("altair")
+@_subset(PULL_UP_FORKS)
+@with_presets(["minimal"], reason="too slow")
+@spec_state_test
+@never_bls
+def test_not_pull_up_current_epoch_block(spec, state):
+    """A CURRENT-epoch chain is not pulled up while its epoch runs."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    tick_to_state_slot(spec, store, state, steps)
+    next_epoch(spec, state)
+    tick_to_state_slot(spec, store, state, steps)
+    for name, v in _fill_epochs(spec, state, store, steps, 3):
+        yield name, v
+    assert int(store.justified_checkpoint.epoch) == 3
+
+    next_epoch(spec, state)
+    tick_to_state_slot(spec, store, state, steps)
+    signed_blocks, justifying_slot = find_next_justifying_slot(
+        spec, state, True, True)
+    assert int(spec.compute_epoch_at_slot(uint64(justifying_slot))) == 5
+
+    for signed_block in signed_blocks:
+        for name, v in tick_and_add_block(spec, store, signed_block,
+                                          steps):
+            yield name, v
+    assert int(spec.compute_epoch_at_slot(
+        spec.get_current_slot(store))) == 5
+    # current-epoch blocks: justification stays put until the boundary
+    assert int(store.justified_checkpoint.epoch) == 3
+    assert int(store.finalized_checkpoint.epoch) == 2
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)
+
+
+@with_all_phases_from("altair")
+@_subset(PULL_UP_FORKS)
+@with_presets(["minimal"], reason="too slow")
+@spec_state_test
+@never_bls
+def test_pull_up_on_tick(spec, state):
+    """The epoch-boundary tick promotes the unrealized checkpoints the
+    current-epoch chain accumulated."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    tick_to_state_slot(spec, store, state, steps)
+    next_epoch(spec, state)
+    tick_to_state_slot(spec, store, state, steps)
+    for name, v in _fill_epochs(spec, state, store, steps, 3):
+        yield name, v
+
+    next_epoch(spec, state)
+    tick_to_state_slot(spec, store, state, steps)
+    signed_blocks, _ = find_next_justifying_slot(spec, state, True, True)
+    for signed_block in signed_blocks:
+        for name, v in tick_and_add_block(spec, store, signed_block,
+                                          steps):
+            yield name, v
+    assert int(store.justified_checkpoint.epoch) == 3
+
+    # tick across the boundary: pull-up applies
+    next_epoch(spec, state)
+    tick_to_state_slot(spec, store, state, steps)
+    assert int(spec.compute_epoch_at_slot(
+        spec.get_current_slot(store))) == 6
+    assert int(store.justified_checkpoint.epoch) == 5
+    assert int(store.finalized_checkpoint.epoch) == 3
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)
+
+
+def _run_justification_update(spec, state, at_epoch_end):
+    """A withheld better-justification chain revealed at the first
+    (or last) slot of the next epoch updates the store immediately."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    tick_to_state_slot(spec, store, state, steps)
+    next_epoch(spec, state)
+    tick_to_state_slot(spec, store, state, steps)
+    for name, v in _fill_epochs(spec, state, store, steps, 3):
+        yield name, v
+    assert int(store.justified_checkpoint.epoch) == 3
+
+    another_state = state.copy()
+    signed_blocks, _post = next_epoch_with_attestations(
+        spec, another_state, True, False)
+    assert int(spec.compute_epoch_at_slot(another_state.slot)) == 5
+    assert int(another_state.current_justified_checkpoint.epoch) == 4
+
+    slot = (int(state.slot) + int(spec.SLOTS_PER_EPOCH)
+            - int(state.slot) % int(spec.SLOTS_PER_EPOCH))
+    if at_epoch_end:
+        slot += int(spec.SLOTS_PER_EPOCH) - 1
+    on_tick_and_append_step(
+        spec, store,
+        int(store.genesis_time) + slot * int(spec.config.SECONDS_PER_SLOT),
+        steps)
+    assert int(spec.compute_epoch_at_slot(
+        spec.get_current_slot(store))) == 5
+
+    for signed_block in signed_blocks:
+        for name, v in tick_and_add_block(spec, store, signed_block,
+                                          steps):
+            yield name, v
+        assert get_head_root(spec, store) == \
+            hash_tree_root(signed_block.message)
+    assert int(store.justified_checkpoint.epoch) == 4
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)
+
+
+@with_all_phases_from("altair")
+@_subset(PULL_UP_FORKS)
+@with_presets(["minimal"], reason="too slow")
+@spec_state_test
+@never_bls
+def test_justification_update_beginning_of_epoch(spec, state):
+    yield from _run_justification_update(spec, state,
+                                         at_epoch_end=False)
+
+
+@with_all_phases_from("altair")
+@_subset(PULL_UP_FORKS)
+@with_presets(["minimal"], reason="too slow")
+@spec_state_test
+@never_bls
+def test_justification_update_end_of_epoch(spec, state):
+    yield from _run_justification_update(spec, state, at_epoch_end=True)
+
+
+@with_all_phases_from("altair")
+@_subset(PULL_UP_FORKS)
+@with_presets(["minimal"], reason="too slow")
+@spec_state_test
+@never_bls
+def test_justification_withholding_reverse_order(spec, state):
+    """The attacker reveals its justifying chain BLOCK BY BLOCK and
+    holds the head; an honest epoch-5 block that re-includes the tip's
+    justifying attestations retakes the head via proposer boost while
+    the pull-up credits the justification (reference
+    test_on_block.py:685)."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    tick_to_state_slot(spec, store, state, steps)
+    for _ in range(2):
+        next_epoch(spec, state)
+    tick_to_state_slot(spec, store, state, steps)
+    for name, v in _fill_epochs(spec, state, store, steps, 2):
+        yield name, v
+    assert int(store.finalized_checkpoint.epoch) == 2
+    assert int(store.justified_checkpoint.epoch) == 3
+    assert int(spec.get_current_epoch(state)) == 4
+
+    # attacker extends with per-slot full blocks until epoch 4 can
+    # justify, streaming every block to the store as it goes
+    attacker_state = state
+    attacker_signed_blocks = []
+    while not is_ready_to_justify(spec, attacker_state):
+        signed = state_transition_with_full_block(
+            spec, attacker_state, True, False)
+        attacker_signed_blocks.append(signed)
+        for name, v in tick_and_add_block(spec, store, signed, steps):
+            yield name, v
+    assert int(attacker_state.current_justified_checkpoint.epoch) == 3
+    attackers_head = hash_tree_root(attacker_signed_blocks[-1].message)
+    assert get_head_root(spec, store) == attackers_head
+
+    # the honest view forked BEFORE the attacker's tip; an epoch-5
+    # honest block re-includes the tip's justifying attestations
+    honest_signed_blocks = attacker_signed_blocks[:-1]
+    assert len(honest_signed_blocks) > 0
+    last_honest_block = honest_signed_blocks[-1].message
+    honest_state = store.block_states[
+        hash_tree_root(last_honest_block)].copy()
+    assert int(honest_state.current_justified_checkpoint.epoch) == 3
+    next_epoch(spec, honest_state)
+    assert int(spec.get_current_epoch(honest_state)) == 5
+
+    honest_block = build_empty_block_for_next_slot(spec, honest_state)
+    honest_block.body.attestations =         attacker_signed_blocks[-1].message.body.attestations
+    signed_honest = state_transition_and_sign_block(
+        spec, honest_state, honest_block)
+    assert is_ready_to_justify(spec, honest_state)
+
+    # proposer boost flips the head to the honest block; the pull-up
+    # realizes justification 4 / finalization 3
+    for name, v in tick_and_add_block(spec, store, signed_honest, steps):
+        yield name, v
+    assert int(store.finalized_checkpoint.epoch) == 3
+    assert int(store.justified_checkpoint.epoch) == 4
+    assert get_head_root(spec, store) == hash_tree_root(honest_block)
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)

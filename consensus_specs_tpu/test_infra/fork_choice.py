@@ -244,6 +244,18 @@ def find_next_justifying_slot(spec, state, fill_cur_epoch,
             return signed_blocks, int(temp.slot)
 
 
+def fill_epochs_with_attestations(spec, state, store, steps, n):
+    """Advance `n` fully-attested epochs through the store; returns the
+    accumulated artifacts to yield."""
+    parts = []
+    for _ in range(n):
+        more, _ = apply_next_epoch_with_attestations(
+            spec, state, store, steps, fill_cur_epoch=True,
+            fill_prev_epoch=True)
+        parts.extend(more)
+    return parts
+
+
 def output_store_checks(spec, store, steps) -> None:
     """Record the observable store state (format README 'checks' step)."""
     head = spec.get_head(store)
