@@ -23,6 +23,9 @@ EPOCH_PROCESSING_HANDLERS = {
         "consensus_specs_tpu.spec_tests.epoch_processing."
         "test_apply_pending_deposit",
     ],
+    "rewards_and_penalties":
+        "consensus_specs_tpu.spec_tests.epoch_processing."
+        "test_rewards_and_penalties",
     "sync_committee_updates":
         "consensus_specs_tpu.spec_tests.epoch_processing."
         "test_sync_committee_updates",

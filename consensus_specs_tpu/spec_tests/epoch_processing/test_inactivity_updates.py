@@ -217,3 +217,90 @@ def test_randomized_state_leaking(spec, state):
         randomize_state(spec, state, rng_for(spec, seed=0xABCD))
     yield from _run_case(spec, state, "random", "random", True, "s14",
                          mutate=scramble)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_genesis_random_scores(spec, state):
+    """At the genesis epoch the pass is a no-op even with nonzero
+    scores staged."""
+    rng = _random.Random(f"{spec.fork}:s15")
+    _scores(spec, state, "random", rng)
+    pre = list(int(s) for s in state.inactivity_scores)
+    yield from run_epoch_processing_with(
+        spec, state, "process_inactivity_updates")
+    assert [int(s) for s in state.inactivity_scores] == pre
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_random_scores_full_participation(spec, state):
+    """Not leaking + fully participating: scores decay toward zero."""
+    yield from _run_case(spec, state, "random", "full", False, "s16")
+    # every score moved down by min(score, 1 + recovery rate)
+    assert all(int(s) <= 100 for s in state.inactivity_scores)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_some_slashed_zero_scores_full_participation(spec, state):
+    """Without a leak, slashed validators' scores still rise by the
+    bias-minus-recovery delta (they can't earn target credit)."""
+    def slash(_rng):
+        for i in range(0, len(state.validators), 4):
+            state.validators[i].slashed = True
+            state.validators[i].withdrawable_epoch = uint64(
+                int(spec.get_current_epoch(state))
+                + int(spec.EPOCHS_PER_SLASHINGS_VECTOR))
+    yield from _run_case(spec, state, "zero", "full", False, "s17",
+                         mutate=slash)
+    bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+    rec = int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE)
+    expected = max(bias - rec, 0)
+    for i, s in enumerate(state.inactivity_scores):
+        if state.validators[i].slashed:
+            assert int(s) == expected
+        else:
+            assert int(s) == 0
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_some_slashed_full_random(spec, state):
+    def slash(_rng):
+        for i in range(0, len(state.validators), 4):
+            state.validators[i].slashed = True
+            state.validators[i].withdrawable_epoch = uint64(
+                int(spec.get_current_epoch(state))
+                + int(spec.EPOCHS_PER_SLASHINGS_VECTOR))
+    yield from _run_case(spec, state, "random", "random", False, "s18",
+                         mutate=slash)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_some_slashed_full_random_leaking(spec, state):
+    def slash(_rng):
+        for i in range(0, len(state.validators), 4):
+            state.validators[i].slashed = True
+            state.validators[i].withdrawable_epoch = uint64(
+                int(spec.get_current_epoch(state))
+                + int(spec.EPOCHS_PER_SLASHINGS_VECTOR))
+    yield from _run_case(spec, state, "random", "random", True, "s19",
+                         mutate=slash)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+@never_bls
+def test_randomized_state(spec, state):
+    from ...test_infra.random import randomize_state, rng_for
+    def scramble(_rng):
+        randomize_state(spec, state, rng_for(spec, seed=0xBCDE))
+    yield from _run_case(spec, state, "random", "random", False, "s20",
+                         mutate=scramble)

@@ -184,3 +184,87 @@ def test_pending_consolidation_applied(spec, state):
     assert len(state.pending_consolidations) == 0
     assert int(state.balances[source]) == 0
     assert int(state.balances[target]) == pre_source + pre_target
+
+
+# ---------------------------------------------------------------------------
+# flag-rotation matrix (reference altair
+# test_process_participation_flag_updates.py, 12 defs)
+# ---------------------------------------------------------------------------
+
+import random as _random  # noqa: E402
+
+
+def _run_flag_rotation(spec, state, prev_fn, cur_fn):
+    n = len(state.validators)
+    state.previous_epoch_participation = [prev_fn(i) for i in range(n)]
+    state.current_epoch_participation = [cur_fn(i) for i in range(n)]
+    staged_current = [int(p) for p in state.current_epoch_participation]
+    yield from run_epoch_processing_with(
+        spec, state, "process_participation_flag_updates")
+    # rotation: current -> previous, current zeroed
+    assert [int(p) for p in state.previous_epoch_participation] \
+        == staged_current
+    assert all(int(p) == 0 for p in state.current_epoch_participation)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+def test_flag_rotation_all_zeroed(spec, state):
+    yield from _run_flag_rotation(spec, state, lambda i: 0, lambda i: 0)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+def test_flag_rotation_filled(spec, state):
+    yield from _run_flag_rotation(spec, state, lambda i: 0b111,
+                                  lambda i: 0b111)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+def test_flag_rotation_previous_filled(spec, state):
+    yield from _run_flag_rotation(spec, state, lambda i: 0b111,
+                                  lambda i: 0)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+def test_flag_rotation_current_filled(spec, state):
+    yield from _run_flag_rotation(spec, state, lambda i: 0,
+                                  lambda i: 0b111)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+def test_flag_rotation_previous_epoch_zeroed(spec, state):
+    rng = _random.Random(4041)
+    yield from _run_flag_rotation(
+        spec, state, lambda i: 0,
+        lambda i: rng.randrange(0, 0b1000))
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+def test_flag_rotation_current_epoch_zeroed(spec, state):
+    rng = _random.Random(4042)
+    yield from _run_flag_rotation(
+        spec, state, lambda i: rng.randrange(0, 0b1000),
+        lambda i: 0)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+def test_flag_rotation_random_0(spec, state):
+    rng = _random.Random(1010)
+    yield from _run_flag_rotation(
+        spec, state, lambda i: rng.randrange(0, 0b1000),
+        lambda i: rng.randrange(0, 0b1000))
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+def test_flag_rotation_large_random(spec, state):
+    rng = _random.Random(2020)
+    yield from _run_flag_rotation(
+        spec, state, lambda i: rng.getrandbits(8) & 0b111,
+        lambda i: rng.getrandbits(8) & 0b111)

@@ -1,0 +1,232 @@
+"""process_rewards_and_penalties epoch battery (altair+; reference
+test/*/epoch_processing/test_process_rewards_and_penalties.py, 19 defs
+across forks): participation shapes x leak, genesis-epoch no-ops,
+slashed exclusions, balance diversity.
+
+Participation is staged directly on the flag registers (altair's
+accounting input) — the attestation-to-flag path is covered by the
+operations battery and the phase0 pending-attestation form by the
+rewards package."""
+import random
+
+from ...ssz import uint64
+from ...test_infra.context import (
+    spec_state_test, with_all_phases_from, with_custom_state,
+    misc_balances, default_activation_threshold)
+from ...test_infra.blocks import transition_to
+from ...test_infra.epoch_processing import run_epoch_processing_with
+
+FULL_FLAGS = 0b111
+
+
+def _set_participation(spec, state, fn):
+    """previous-epoch participation per validator index via `fn(i)`."""
+    for i in range(len(state.validators)):
+        state.previous_epoch_participation[i] = fn(i)
+
+
+def _advance_epochs(spec, state, n):
+    transition_to(spec, state,
+                  uint64(int(state.slot)
+                         + n * int(spec.SLOTS_PER_EPOCH)))
+
+
+def _induce_leak(spec, state):
+    """Past the inactivity-leak threshold with finality stuck at 0."""
+    _advance_epochs(spec, state,
+                    int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 2)
+    assert spec.is_in_inactivity_leak(state)
+
+
+def _run_pass(spec, state):
+    pre_balances = [int(b) for b in state.balances]
+    yield from run_epoch_processing_with(
+        spec, state, "process_rewards_and_penalties")
+    return pre_balances
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+def test_full_attestation_participation(spec, state):
+    _advance_epochs(spec, state, 2)
+    _set_participation(spec, state, lambda i: FULL_FLAGS)
+    pre = [int(b) for b in state.balances]
+    yield from run_epoch_processing_with(
+        spec, state, "process_rewards_and_penalties")
+    assert all(int(b) > p for b, p in zip(state.balances, pre))
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+def test_full_attestation_participation_with_leak(spec, state):
+    _induce_leak(spec, state)
+    _set_participation(spec, state, lambda i: FULL_FLAGS)
+    pre = [int(b) for b in state.balances]
+    yield from run_epoch_processing_with(
+        spec, state, "process_rewards_and_penalties")
+    # leak: no attestation rewards — full participants stay flat
+    assert all(int(b) == p for b, p in zip(state.balances, pre))
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+def test_almost_empty_attestations(spec, state):
+    _advance_epochs(spec, state, 2)
+    _set_participation(spec, state,
+                       lambda i: FULL_FLAGS if i == 0 else 0)
+    pre = [int(b) for b in state.balances]
+    yield from run_epoch_processing_with(
+        spec, state, "process_rewards_and_penalties")
+    assert int(state.balances[0]) > pre[0]
+    assert all(int(state.balances[i]) < pre[i]
+               for i in range(1, len(pre)))
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+def test_almost_empty_attestations_with_leak(spec, state):
+    _induce_leak(spec, state)
+    _set_participation(spec, state,
+                       lambda i: FULL_FLAGS if i == 0 else 0)
+    pre = [int(b) for b in state.balances]
+    yield from run_epoch_processing_with(
+        spec, state, "process_rewards_and_penalties")
+    # leaking: non-participants bleed (flag penalties + inactivity)
+    assert all(int(state.balances[i]) < pre[i]
+               for i in range(1, len(pre)))
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+def test_almost_full_attestations(spec, state):
+    _advance_epochs(spec, state, 2)
+    _set_participation(spec, state,
+                       lambda i: 0 if i == 0 else FULL_FLAGS)
+    pre = [int(b) for b in state.balances]
+    yield from run_epoch_processing_with(
+        spec, state, "process_rewards_and_penalties")
+    assert int(state.balances[0]) < pre[0]
+    assert all(int(state.balances[i]) > pre[i]
+               for i in range(1, len(pre)))
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+def test_almost_full_attestations_with_leak(spec, state):
+    _induce_leak(spec, state)
+    _set_participation(spec, state,
+                       lambda i: 0 if i == 0 else FULL_FLAGS)
+    pre = [int(b) for b in state.balances]
+    yield from run_epoch_processing_with(
+        spec, state, "process_rewards_and_penalties")
+    assert int(state.balances[0]) < pre[0]
+    assert all(int(state.balances[i]) == pre[i]
+               for i in range(1, len(pre)))
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+def test_no_attestations_all_penalties(spec, state):
+    _advance_epochs(spec, state, 2)
+    _set_participation(spec, state, lambda i: 0)
+    pre = [int(b) for b in state.balances]
+    yield from run_epoch_processing_with(
+        spec, state, "process_rewards_and_penalties")
+    assert all(int(b) < p for b, p in zip(state.balances, pre))
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+def test_genesis_epoch_no_attestations_no_penalties(spec, state):
+    assert int(spec.get_current_epoch(state)) == int(spec.GENESIS_EPOCH)
+    pre = [int(b) for b in state.balances]
+    yield from run_epoch_processing_with(
+        spec, state, "process_rewards_and_penalties")
+    # the pass is a no-op during the genesis epoch
+    assert all(int(b) == p for b, p in zip(state.balances, pre))
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+def test_genesis_epoch_full_attestations_no_rewards(spec, state):
+    assert int(spec.get_current_epoch(state)) == int(spec.GENESIS_EPOCH)
+    _set_participation(spec, state, lambda i: FULL_FLAGS)
+    pre = [int(b) for b in state.balances]
+    yield from run_epoch_processing_with(
+        spec, state, "process_rewards_and_penalties")
+    assert all(int(b) == p for b, p in zip(state.balances, pre))
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+def test_attestations_some_slashed(spec, state):
+    """Slashed validators earn nothing even with full flags set."""
+    _advance_epochs(spec, state, 2)
+    _set_participation(spec, state, lambda i: FULL_FLAGS)
+    epoch = int(spec.get_current_epoch(state))
+    for i in range(0, 4):
+        state.validators[i].slashed = True
+        state.validators[i].withdrawable_epoch = uint64(
+            epoch + int(spec.EPOCHS_PER_SLASHINGS_VECTOR))
+    pre = [int(b) for b in state.balances]
+    yield from run_epoch_processing_with(
+        spec, state, "process_rewards_and_penalties")
+    # slashed: denied participation rewards AND penalized as absent
+    for i in range(0, 4):
+        assert int(state.balances[i]) < pre[i]
+    assert all(int(state.balances[i]) > pre[i]
+               for i in range(4, len(pre)))
+
+
+@with_all_phases_from("altair")
+@with_custom_state(misc_balances, default_activation_threshold)
+@spec_state_test
+def test_full_attestations_misc_balances(spec, state):
+    _advance_epochs(spec, state, 2)
+    _set_participation(spec, state, lambda i: FULL_FLAGS)
+    eligible = [i for i in range(len(state.validators))
+                if spec.is_active_validator(
+                    state.validators[i], spec.get_previous_epoch(state))]
+    assert eligible
+    pre = [int(b) for b in state.balances]
+    yield from run_epoch_processing_with(
+        spec, state, "process_rewards_and_penalties")
+    assert all(int(state.balances[i]) > pre[i] for i in eligible)
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+def test_full_attestations_one_validator_one_gwei(spec, state):
+    _advance_epochs(spec, state, 2)
+    _set_participation(spec, state, lambda i: FULL_FLAGS)
+    state.balances[4] = uint64(1)
+    state.validators[4].effective_balance = uint64(0)
+    pre = [int(b) for b in state.balances]
+    yield from run_epoch_processing_with(
+        spec, state, "process_rewards_and_penalties")
+    # zero effective balance: zero base reward, balance unchanged
+    assert int(state.balances[4]) == pre[4]
+
+
+def _random_fill(spec, state, rng):
+    _set_participation(
+        spec, state,
+        lambda i: rng.choice((0, 0b001, 0b011, 0b111)))
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+def test_random_fill_attestations(spec, state):
+    _advance_epochs(spec, state, 2)
+    _random_fill(spec, state, random.Random(4040))
+    yield from run_epoch_processing_with(
+        spec, state, "process_rewards_and_penalties")
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+def test_random_fill_attestations_with_leak(spec, state):
+    _induce_leak(spec, state)
+    _random_fill(spec, state, random.Random(4041))
+    yield from run_epoch_processing_with(
+        spec, state, "process_rewards_and_penalties")

@@ -1,0 +1,2 @@
+"""pytest collection shim for the dual-mode spec suite."""
+from consensus_specs_tpu.spec_tests.epoch_processing.test_rewards_and_penalties import *  # noqa: F401,F403
