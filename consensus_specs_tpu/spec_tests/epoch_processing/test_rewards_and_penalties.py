@@ -38,13 +38,6 @@ def _induce_leak(spec, state):
     assert spec.is_in_inactivity_leak(state)
 
 
-def _run_pass(spec, state):
-    pre_balances = [int(b) for b in state.balances]
-    yield from run_epoch_processing_with(
-        spec, state, "process_rewards_and_penalties")
-    return pre_balances
-
-
 @with_all_phases_from("altair")
 @spec_state_test
 def test_full_attestation_participation(spec, state):
