@@ -163,14 +163,10 @@ def test_random_scores_random_participation_leaking(spec, state):
 def test_random_scores_full_participation_leaking(spec, state):
     """During a leak, participating validators shed exactly 1 score
     point (the recovery-rate decay is gated on NOT leaking)."""
-    pre_done = {}
-
-    def grab(_rng):
-        pre_done.update(
-            {i: int(s) for i, s in
-             enumerate(state.inactivity_scores)})
+    staged = []
     yield from _run_case(spec, state, "random", "full", True, "s11",
-                         mutate=grab)
+                         mutate=_snapshot_scores(state, staged))
+    pre_done = dict(enumerate(staged))
     for i, s in enumerate(state.inactivity_scores):
         assert int(s) == max(pre_done[i] - 1, 0)
 
@@ -213,6 +209,13 @@ def test_randomized_state_leaking(spec, state):
                          mutate=scramble)
 
 
+def _snapshot_scores(state, out):
+    """mutate-hook: record the staged scores before the pass runs."""
+    def capture(_rng):
+        out.extend(int(s) for s in state.inactivity_scores)
+    return capture
+
+
 def _slash_quarter(spec, state):
     """mutate-hook: slash every 4th validator with the withdrawable
     epoch inside the slashing window."""
@@ -246,10 +249,8 @@ def test_random_scores_full_participation(spec, state):
     """Not leaking + fully participating: every score decays by
     exactly min(1, s) + min(recovery, remaining)."""
     staged = []
-    def capture(_rng):
-        staged.extend(int(s) for s in state.inactivity_scores)
     yield from _run_case(spec, state, "random", "full", False, "s16",
-                         mutate=capture)
+                         mutate=_snapshot_scores(state, staged))
     rec = int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE)
     for s, pre in zip(state.inactivity_scores, staged):
         after_flag = pre - min(1, pre)
