@@ -424,3 +424,94 @@ def test_invalid_signature_previous_committee(spec, state):
     block.body.sync_aggregate = aggregate
     yield from run_sync_committee_processing(spec, state, block,
                                              valid=False)
+
+
+# ---------------------------------------------------------------------------
+# randomized participation (reference
+# test_process_sync_aggregate_random.py; the minimal-preset committee
+# repeats validators, i.e. the reference's *_with_duplicates arm)
+# ---------------------------------------------------------------------------
+
+import random as _random  # noqa: E402
+
+
+def _run_random_participation(spec, state, seed, select_fn,
+                              mutate_state=None):
+    rng = _random.Random(f"{spec.fork}:{seed}")
+    if mutate_state is not None:
+        mutate_state(rng)
+    block = build_empty_block_for_next_slot(spec, state)
+    transition_to(spec, state, block.slot)
+    committee_size = int(spec.SYNC_COMMITTEE_SIZE)
+    chosen = select_fn(rng, committee_size)
+    block.body.sync_aggregate = get_sync_aggregate(
+        spec, state, participation_fn=lambda p: p in chosen)
+    yield from run_sync_committee_processing(spec, state, block)
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(SYNC_FORKS)
+@spec_state_test
+@always_bls
+def test_random_only_one_participant_with_duplicates(spec, state):
+    yield from _run_random_participation(
+        spec, state, "one",
+        lambda rng, n: {rng.randrange(n)})
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(SYNC_FORKS)
+@spec_state_test
+@always_bls
+def test_random_low_participation_with_duplicates(spec, state):
+    yield from _run_random_participation(
+        spec, state, "low",
+        lambda rng, n: set(rng.sample(range(n), max(1, n // 4))))
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(SYNC_FORKS)
+@spec_state_test
+@always_bls
+def test_random_high_participation_with_duplicates(spec, state):
+    yield from _run_random_participation(
+        spec, state, "high",
+        lambda rng, n: set(rng.sample(range(n), max(1, 3 * n // 4))))
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(SYNC_FORKS)
+@spec_state_test
+@always_bls
+def test_random_all_but_one_participating_with_duplicates(spec, state):
+    yield from _run_random_participation(
+        spec, state, "allbutone",
+        lambda rng, n: set(range(n)) - {rng.randrange(n)})
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(SYNC_FORKS)
+@spec_state_test
+@always_bls
+def test_random_half_participation_with_duplicates(spec, state):
+    yield from _run_random_participation(
+        spec, state, "half",
+        lambda rng, n: set(rng.sample(range(n), n // 2)))
+
+
+@with_all_phases_from("altair")
+@with_pytest_fork_subset(SYNC_FORKS)
+@spec_state_test
+@always_bls
+def test_random_with_exits_with_duplicates(spec, state):
+    """Exited-but-unwithdrawn committee members still sign."""
+    from ...ssz import uint64 as _u64
+    def exit_some(rng):
+        cur = int(spec.get_current_epoch(state))
+        for i in range(0, len(state.validators), 7):
+            state.validators[i].exit_epoch = _u64(max(cur, 1))
+            state.validators[i].withdrawable_epoch = _u64(cur + 10)
+    yield from _run_random_participation(
+        spec, state, "exits",
+        lambda rng, n: set(rng.sample(range(n), n // 2)),
+        mutate_state=exit_some)
