@@ -302,3 +302,147 @@ def test_invalid_duplicate_index_att_2(spec, state):
     sign_indexed_attestation(spec, state, att)
     yield from run_attester_slashing_processing(
         spec, state, slashing, valid=False)
+
+
+# ---------------------------------------------------------------------------
+# index-set corruption matrix (reference
+# test_process_attester_slashing.py long tail)
+# ---------------------------------------------------------------------------
+
+@with_all_phases
+@spec_state_test
+def test_attestation_from_future(spec, state):
+    """Double vote whose data sits at the state's current slot: still
+    slashable (slashing has no inclusion-window check)."""
+    slashing = get_valid_attester_slashing(spec, state,
+                                           slot=state.slot)
+    yield from run_attester_slashing_processing(spec, state, slashing)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_incorrect_sig_1(spec, state):
+    slashing = get_valid_attester_slashing(spec, state)
+    sig = bytearray(bytes(slashing.attestation_1.signature))
+    sig[5] ^= 0xFF
+    slashing.attestation_1.signature = bytes(sig)
+    yield from run_attester_slashing_processing(
+        spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_incorrect_sig_2(spec, state):
+    slashing = get_valid_attester_slashing(spec, state)
+    sig = bytearray(bytes(slashing.attestation_2.signature))
+    sig[5] ^= 0xFF
+    slashing.attestation_2.signature = bytes(sig)
+    yield from run_attester_slashing_processing(
+        spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_incorrect_sig_1_and_2(spec, state):
+    slashing = get_valid_attester_slashing(spec, state)
+    for att in (slashing.attestation_1, slashing.attestation_2):
+        sig = bytearray(bytes(att.signature))
+        sig[5] ^= 0xFF
+        att.signature = bytes(sig)
+    yield from run_attester_slashing_processing(
+        spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_unsorted_att_2(spec, state):
+    slashing = get_valid_attester_slashing(spec, state)
+    indices = list(slashing.attestation_2.attesting_indices)
+    if len(indices) < 2:
+        from ...gen.vector_test import SkippedTest
+        raise SkippedTest("committee too small to unsort")
+    indices[0], indices[1] = indices[1], indices[0]
+    slashing.attestation_2.attesting_indices = indices
+    sign_indexed_attestation(spec, state, slashing.attestation_2)
+    yield from run_attester_slashing_processing(
+        spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_att1_bad_replaced_index(spec, state):
+    """A non-committee index swapped in WITHOUT re-signing."""
+    slashing = get_valid_attester_slashing(spec, state)
+    indices = list(slashing.attestation_1.attesting_indices)
+    indices[0] = len(state.validators) - 1
+    slashing.attestation_1.attesting_indices = sorted(indices)
+    # signature no longer matches the index set
+    yield from run_attester_slashing_processing(
+        spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_att2_bad_extra_index(spec, state):
+    slashing = get_valid_attester_slashing(spec, state)
+    indices = list(slashing.attestation_2.attesting_indices)
+    indices.append(len(state.validators) - 1)
+    slashing.attestation_2.attesting_indices = sorted(indices)
+    yield from run_attester_slashing_processing(
+        spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_att1_duplicate_index_normal_signed(spec, state):
+    """A duplicated index (still 'sorted' but not strictly unique)
+    fails is_valid_indexed_attestation even when re-signed."""
+    slashing = get_valid_attester_slashing(spec, state)
+    indices = list(slashing.attestation_1.attesting_indices)
+    indices.append(indices[0])
+    slashing.attestation_1.attesting_indices = sorted(indices)
+    sign_indexed_attestation(spec, state, slashing.attestation_1)
+    yield from run_attester_slashing_processing(
+        spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_att2_duplicate_index_normal_signed(spec, state):
+    slashing = get_valid_attester_slashing(spec, state)
+    indices = list(slashing.attestation_2.attesting_indices)
+    indices.append(indices[0])
+    slashing.attestation_2.attesting_indices = sorted(indices)
+    sign_indexed_attestation(spec, state, slashing.attestation_2)
+    yield from run_attester_slashing_processing(
+        spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_att2_empty_indices(spec, state):
+    slashing = get_valid_attester_slashing(spec, state)
+    slashing.attestation_2.attesting_indices = []
+    slashing.attestation_2.signature = b"\xc0" + b"\x00" * 95
+    yield from run_attester_slashing_processing(
+        spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_with_effective_balance_disparity(spec, state):
+    """Mixed effective balances across the slashed set: every member
+    still slashed, penalties scale per balance."""
+    slashing = get_valid_attester_slashing(spec, state)
+    indices = [int(i) for i in
+               slashing.attestation_1.attesting_indices]
+    incr = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    for k, i in enumerate(indices):
+        eb = int(spec.MAX_EFFECTIVE_BALANCE) - (k % 4) * incr
+        state.validators[i].effective_balance = uint64(eb)
+        state.balances[i] = uint64(eb)
+    yield from run_attester_slashing_processing(spec, state, slashing)
+    assert all(state.validators[i].slashed for i in indices)
