@@ -183,3 +183,178 @@ def test_invalid_deposit_short_proof(spec, state):
     deposit.proof[-1] = b"\x07" * 32
     yield from run_deposit_processing(
         spec, state, deposit, validator_index, valid=False)
+
+
+# ---------------------------------------------------------------------------
+# signature/key/fork-version long tail (reference
+# test_process_deposit.py)
+# ---------------------------------------------------------------------------
+
+from ...ssz import Bytes32, uint64  # noqa: E402
+from ...test_infra.deposits import build_deposit_data  # noqa: E402
+from ...test_infra.keys import pubkeys, privkeys  # noqa: E402
+
+_PUBKEY_NOT_IN_SUBGROUP = bytes.fromhex(
+    "8123456789abcdef0123456789abcdef0123456789abcdef"
+    "0123456789abcdef0123456789abcdef0123456789abcdef")
+_PUBKEY_NOT_DECOMPRESSIBLE = bytes.fromhex(
+    "8123456789abcdef0123456789abcdef0123456789abcdef"
+    "0123456789abcdef0123456789abcdef0123456789abcde0")
+
+
+def _deposit_with_pubkey(spec, state, pubkey, amount):
+    """A deposit for an arbitrary (possibly invalid) pubkey with a
+    valid merkle proof and a garbage signature."""
+    creds = bytes(spec.BLS_WITHDRAWAL_PREFIX) \
+        + bytes(spec.hash(pubkey))[1:]
+    data = spec.DepositData(
+        pubkey=pubkey, withdrawal_credentials=Bytes32(creds),
+        amount=uint64(amount), signature=b"\x11" + b"\x00" * 95)
+    leaves = [data]
+    deposit, root = build_deposit_from_list(spec, leaves, 0)
+    state.eth1_data.deposit_root = root
+    state.eth1_data.deposit_count = uint64(len(leaves))
+    state.eth1_deposit_index = uint64(0)
+    return deposit
+
+
+def build_deposit_from_list(spec, data_list, index):
+    from ...test_infra.deposits import deposit_tree
+    from ...ssz.merkle import get_merkle_proof
+    root, leaves = deposit_tree(spec, data_list)
+    limit = 2 ** spec.DEPOSIT_CONTRACT_TREE_DEPTH
+    proof = get_merkle_proof(leaves, index, limit=limit) + [
+        int(len(leaves)).to_bytes(32, "little")]
+    return spec.Deposit(proof=proof, data=data_list[index]), root
+
+
+@with_all_phases_from("phase0", to="deneb")
+@spec_state_test
+def test_key_validate_invalid_subgroup(spec, state):
+    """A pubkey outside the G1 subgroup: KeyValidate fails, the deposit
+    processes but adds no validator (pre-electra semantics)."""
+    index = len(state.validators)
+    deposit = _deposit_with_pubkey(
+        spec, state, _PUBKEY_NOT_IN_SUBGROUP,
+        int(spec.MAX_EFFECTIVE_BALANCE))
+    yield from run_deposit_processing(spec, state, deposit, index,
+                                      effective=False)
+
+
+@with_all_phases_from("phase0", to="deneb")
+@spec_state_test
+def test_key_validate_invalid_decompression(spec, state):
+    index = len(state.validators)
+    deposit = _deposit_with_pubkey(
+        spec, state, _PUBKEY_NOT_DECOMPRESSIBLE,
+        int(spec.MAX_EFFECTIVE_BALANCE))
+    yield from run_deposit_processing(spec, state, deposit, index,
+                                      effective=False)
+
+
+@with_all_phases_from("phase0", to="deneb")
+@spec_state_test
+def test_incorrect_withdrawal_credentials_top_up(spec, state):
+    """Top-up with mismatched credentials still credits the balance
+    (credentials were pinned at first deposit)."""
+    validator_index = 0
+    amount = int(spec.MAX_EFFECTIVE_BALANCE) // 4
+    wrong_creds = bytes(spec.BLS_WITHDRAWAL_PREFIX) \
+        + bytes(spec.hash(b"l" * 48))[1:]
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, amount, signed=True,
+        withdrawal_credentials=wrong_creds)
+    yield from run_deposit_processing(spec, state, deposit,
+                                      validator_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_top_up__zero_balance(spec, state):
+    validator_index = 0
+    state.balances[validator_index] = 0
+    state.validators[validator_index].effective_balance = 0
+    amount = int(spec.MAX_EFFECTIVE_BALANCE) // 4
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, amount, signed=True)
+    yield from run_deposit_processing(spec, state, deposit,
+                                      validator_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_top_up__less_effective_balance(spec, state):
+    validator_index = 0
+    incr = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    state.validators[validator_index].effective_balance = uint64(
+        int(spec.MAX_EFFECTIVE_BALANCE) - incr)
+    state.balances[validator_index] = uint64(
+        int(spec.MAX_EFFECTIVE_BALANCE) - incr)
+    amount = int(spec.MAX_EFFECTIVE_BALANCE) // 4
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, amount, signed=True)
+    yield from run_deposit_processing(spec, state, deposit,
+                                      validator_index)
+
+
+@with_all_phases_from("phase0", to="deneb")
+@spec_state_test
+def test_correct_sig_but_forked_state(spec, state):
+    """Deposits pin the GENESIS fork version: a mangled state fork
+    changes nothing."""
+    index = len(state.validators)
+    state.fork.current_version = b"\x12\x34\xab\xcd"
+    deposit = prepare_state_and_deposit(
+        spec, state, index, int(spec.MAX_EFFECTIVE_BALANCE),
+        signed=True)
+    yield from run_deposit_processing(spec, state, deposit, index)
+
+
+@with_all_phases_from("phase0", to="deneb")
+@spec_state_test
+def test_ineffective_deposit_with_bad_fork_version(spec, state):
+    """Signed over a bogus fork version: processes but adds nothing."""
+    from ...utils import bls as _bls
+    index = len(state.validators)
+    pubkey = pubkeys[index]
+    creds = bytes(spec.BLS_WITHDRAWAL_PREFIX) \
+        + bytes(spec.hash(pubkey))[1:]
+    message = spec.DepositMessage(
+        pubkey=pubkey, withdrawal_credentials=Bytes32(creds),
+        amount=uint64(int(spec.MAX_EFFECTIVE_BALANCE)))
+    domain = spec.compute_domain(spec.DOMAIN_DEPOSIT,
+                                 b"\xaa\xbb\xcc\xdd", Bytes32())
+    signature = _bls.Sign(privkeys[index],
+                          spec.compute_signing_root(message, domain))
+    data = spec.DepositData(
+        pubkey=pubkey, withdrawal_credentials=Bytes32(creds),
+        amount=uint64(int(spec.MAX_EFFECTIVE_BALANCE)),
+        signature=signature)
+    deposit, root = build_deposit_from_list(spec, [data], 0)
+    state.eth1_data.deposit_root = root
+    state.eth1_data.deposit_count = uint64(1)
+    state.eth1_deposit_index = uint64(0)
+    yield from run_deposit_processing(spec, state, deposit, index,
+                                      effective=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_wrong_deposit_for_deposit_count(spec, state):
+    """Proof built against leaf 1 while the state expects leaf 0."""
+    from ...test_infra.deposits import build_deposit_data
+    creds = bytes(spec.BLS_WITHDRAWAL_PREFIX) + b"\x00" * 31
+    data_0 = build_deposit_data(
+        spec, pubkeys[len(state.validators)],
+        privkeys[len(state.validators)],
+        int(spec.MAX_EFFECTIVE_BALANCE), creds, signed=True)
+    data_1 = build_deposit_data(
+        spec, pubkeys[len(state.validators) + 1],
+        privkeys[len(state.validators) + 1],
+        int(spec.MAX_EFFECTIVE_BALANCE), creds, signed=True)
+    deposit, root = build_deposit_from_list(spec, [data_0, data_1], 1)
+    state.eth1_data.deposit_root = root
+    state.eth1_data.deposit_count = uint64(2)
+    state.eth1_deposit_index = uint64(0)   # expects leaf 0, given leaf 1
+    yield from run_deposit_processing(
+        spec, state, deposit, len(state.validators), valid=False)
