@@ -5,7 +5,8 @@ from ...test_infra.blocks import build_empty_block_for_next_slot
 
 
 def run_block_header_processing(spec, state, block, valid=True):
-    spec.process_slots(state, block.slot)
+    if int(state.slot) < int(block.slot):
+        spec.process_slots(state, block.slot)
     yield "pre", state.copy()
     yield "block", block
     if not valid:
@@ -56,3 +57,26 @@ def test_invalid_proposer_index(spec, state):
     block.proposer_index = uint64(
         (int(block.proposer_index) + 1) % len(state.validators))
     yield from run_block_header_processing(spec, state, block, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_slashed(spec, state):
+    """A slashed proposer may not propose."""
+    block = build_empty_block_for_next_slot(spec, state)
+    state.validators[int(block.proposer_index)].slashed = True
+    yield from run_block_header_processing(spec, state, block,
+                                           valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_multiple_blocks_single_slot(spec, state):
+    """A second header at an already-headed slot must be rejected."""
+    block = build_empty_block_for_next_slot(spec, state)
+    spec.process_slots(state, block.slot)
+    spec.process_block_header(state, block)
+    second = block.copy()
+    second.body.graffiti = b"\x22" * 32
+    yield from run_block_header_processing(spec, state, second,
+                                           valid=False)

@@ -190,3 +190,48 @@ def test_invalid_proposer_is_withdrawn(spec, state):
     state.validators[index].withdrawable_epoch = uint64(cur)
     yield from run_proposer_slashing_processing(
         spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_incorrect_sig_1_and_2(spec, state):
+    slashing = get_valid_proposer_slashing(
+        spec, state, signed_1=False, signed_2=False)
+    yield from run_proposer_slashing_processing(
+        spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_headers_are_same_sigs_are_same(spec, state):
+    slashing = get_valid_proposer_slashing(spec, state)
+    slashing.signed_header_2 = slashing.signed_header_1.copy()
+    yield from run_proposer_slashing_processing(
+        spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_headers_are_same_sigs_are_different(spec, state):
+    """Identical header messages with differing signature bytes are
+    still the SAME header — not slashable."""
+    slashing = get_valid_proposer_slashing(spec, state)
+    slashing.signed_header_2 = slashing.signed_header_1.copy()
+    sig = bytearray(bytes(slashing.signed_header_2.signature))
+    sig[5] ^= 0xFF
+    slashing.signed_header_2.signature = bytes(sig)
+    yield from run_proposer_slashing_processing(
+        spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_is_slashed(spec, state):
+    """An already-slashed proposer is no longer slashable."""
+    slashing = get_valid_proposer_slashing(spec, state)
+    index = int(slashing.signed_header_1.message.proposer_index)
+    state.validators[index].slashed = True
+    yield from run_proposer_slashing_processing(
+        spec, state, slashing, valid=False)
