@@ -66,7 +66,7 @@ def test_basic_consolidation(spec, state):
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_basic_consolidation_with_compounding_source(spec, state):
+def test_basic_consolidation_with_compounding_credentials(spec, state):
     _stage(spec, state, source_compounding=True)
     yield from run_request_processing(
         spec, state, "consolidation_request", _request(spec, state))
@@ -138,7 +138,7 @@ def test_basic_switch_to_compounding(spec, state):
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_switch_to_compounding_with_excess_balance(spec, state):
+def test_switch_to_compounding_with_excess(spec, state):
     age_past_exit_gate(spec, state)
     set_eth1_withdrawal_credentials(spec, state, 0,
                                     address=DEFAULT_ADDRESS)
@@ -283,7 +283,7 @@ def test_incorrect_target_with_eth1_credential(spec, state):
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_incorrect_source_address(spec, state):
+def test_incorrect_incorrect_source_address(spec, state):
     _stage(spec, state)
     yield from run_request_processing(
         spec, state, "consolidation_request",
@@ -343,7 +343,7 @@ def test_incorrect_source_not_active_long_enough(spec, state):
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_switch_to_compounding_exited_source_ignored(spec, state):
+def test_switch_to_compounding_exited_source(spec, state):
     age_past_exit_gate(spec, state)
     set_eth1_withdrawal_credentials(spec, state, 0,
                                     address=DEFAULT_ADDRESS)
@@ -355,7 +355,7 @@ def test_switch_to_compounding_exited_source_ignored(spec, state):
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_switch_to_compounding_inactive_source_ignored(spec, state):
+def test_switch_to_compounding_inactive_source(spec, state):
     age_past_exit_gate(spec, state)
     set_eth1_withdrawal_credentials(spec, state, 0,
                                     address=DEFAULT_ADDRESS)
@@ -367,7 +367,7 @@ def test_switch_to_compounding_inactive_source_ignored(spec, state):
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_switch_to_compounding_source_bls_credential_ignored(spec, state):
+def test_switch_to_compounding_source_bls_withdrawal_credential(spec, state):
     # 0x00 source credentials: neither a valid switch nor (same-pubkey)
     # a valid consolidation
     age_past_exit_gate(spec, state)
@@ -378,7 +378,7 @@ def test_switch_to_compounding_source_bls_credential_ignored(spec, state):
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_switch_to_compounding_already_compounding_ignored(spec, state):
+def test_switch_to_compounding_source_coumpounding_withdrawal_credential(spec, state):
     age_past_exit_gate(spec, state)
     set_compounding_withdrawal_credentials(spec, state, 0,
                                            address=DEFAULT_ADDRESS)
@@ -389,7 +389,7 @@ def test_switch_to_compounding_already_compounding_ignored(spec, state):
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_switch_to_compounding_not_authorized_ignored(spec, state):
+def test_switch_to_compounding_not_authorized(spec, state):
     age_past_exit_gate(spec, state)
     set_eth1_withdrawal_credentials(spec, state, 0,
                                     address=DEFAULT_ADDRESS)
@@ -401,7 +401,7 @@ def test_switch_to_compounding_not_authorized_ignored(spec, state):
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_switch_to_compounding_unknown_source_pubkey_ignored(spec, state):
+def test_switch_to_compounding_unknown_source_pubkey(spec, state):
     age_past_exit_gate(spec, state)
     unknown = pubkeys[len(state.validators) + 3]
     request = spec.ConsolidationRequest(
@@ -410,3 +410,94 @@ def test_switch_to_compounding_unknown_source_pubkey_ignored(spec, state):
         target_pubkey=unknown)
     yield from run_request_processing(
         spec, state, "consolidation_request", request, mutates=False)
+
+
+# ---------------------------------------------------------------------------
+# consolidation-churn epoch placement (reference round-out)
+# ---------------------------------------------------------------------------
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_basic_consolidation_in_current_consolidation_epoch(spec, state):
+    """Churn already flowing in the CURRENT consolidation epoch with
+    room to spare: the new consolidation shares that epoch."""
+    _stage(spec, state)
+    churn_epoch = spec.compute_activation_exit_epoch(
+        spec.get_current_epoch(state))
+    state.earliest_consolidation_epoch = churn_epoch
+    state.consolidation_balance_to_consume = uint64(
+        int(spec.get_consolidation_churn_limit(state)))
+    yield from run_request_processing(
+        spec, state, "consolidation_request", _request(spec, state))
+    assert len(state.pending_consolidations) == 1
+    assert int(state.validators[0].exit_epoch) == int(churn_epoch)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_basic_consolidation_in_new_consolidation_epoch(spec, state):
+    """No churn flowing yet: the consolidation opens a fresh epoch at
+    the activation-exit horizon."""
+    _stage(spec, state)
+    assert int(state.earliest_consolidation_epoch) <= int(
+        spec.get_current_epoch(state))
+    yield from run_request_processing(
+        spec, state, "consolidation_request", _request(spec, state))
+    assert len(state.pending_consolidations) == 1
+    assert int(state.validators[0].exit_epoch) == int(
+        spec.compute_activation_exit_epoch(
+            spec.get_current_epoch(state)))
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_basic_consolidation_with_insufficient_preexisting_churn(
+        spec, state):
+    """Almost no churn left this epoch: the exit spills to the NEXT
+    consolidation epoch."""
+    _stage(spec, state)
+    churn_epoch = spec.compute_activation_exit_epoch(
+        spec.get_current_epoch(state))
+    state.earliest_consolidation_epoch = churn_epoch
+    state.consolidation_balance_to_consume = uint64(1)
+    yield from run_request_processing(
+        spec, state, "consolidation_request", _request(spec, state))
+    assert len(state.pending_consolidations) == 1
+    assert int(state.validators[0].exit_epoch) > int(churn_epoch)
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_consolidation_churn_limit_balance(spec, state):
+    """Source balance EXACTLY the churn limit: consumes the whole epoch
+    but stays within it."""
+    _stage(spec, state)
+    # the churn limit moves with total balance as we raise the source's
+    # EB — iterate to the fixpoint where balance == churn exactly
+    for _ in range(6):
+        churn = int(spec.get_consolidation_churn_limit(state))
+        state.validators[0].effective_balance = uint64(churn)
+        state.balances[0] = uint64(churn)
+    assert int(spec.get_consolidation_churn_limit(state)) == \
+        int(state.validators[0].effective_balance)
+    yield from run_request_processing(
+        spec, state, "consolidation_request", _request(spec, state))
+    assert len(state.pending_consolidations) == 1
+    assert int(state.consolidation_balance_to_consume) == 0
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_consolidation_balance_through_two_churn_epochs(spec, state):
+    """Source balance worth ~3 epochs of churn: the exit epoch lands
+    two epochs past the horizon."""
+    _stage(spec, state)
+    churn = int(spec.get_consolidation_churn_limit(state))
+    state.validators[0].effective_balance = uint64(churn * 3)
+    state.balances[0] = uint64(churn * 3)
+    horizon = int(spec.compute_activation_exit_epoch(
+        spec.get_current_epoch(state)))
+    yield from run_request_processing(
+        spec, state, "consolidation_request", _request(spec, state))
+    assert len(state.pending_consolidations) == 1
+    assert int(state.validators[0].exit_epoch) >= horizon + 2

@@ -49,7 +49,7 @@ def _stage_partial(spec, state, index, excess):
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_basic_full_exit(spec, state):
+def test_basic_withdrawal_request(spec, state):
     age_past_exit_gate(spec, state)
     set_eth1_withdrawal_credentials(spec, state, 1,
                                     address=DEFAULT_ADDRESS)
@@ -61,7 +61,7 @@ def test_basic_full_exit(spec, state):
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_basic_full_exit_first_validator(spec, state):
+def test_basic_withdrawal_request_with_first_validator(spec, state):
     age_past_exit_gate(spec, state)
     set_eth1_withdrawal_credentials(spec, state, 0,
                                     address=DEFAULT_ADDRESS)
@@ -73,7 +73,7 @@ def test_basic_full_exit_first_validator(spec, state):
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_full_exit_with_compounding_credentials(spec, state):
+def test_basic_withdrawal_request_with_compounding_credentials(spec, state):
     age_past_exit_gate(spec, state)
     set_compounding_withdrawal_credentials(spec, state, 0,
                                            address=DEFAULT_ADDRESS)
@@ -86,7 +86,7 @@ def test_full_exit_with_compounding_credentials(spec, state):
 @with_all_phases_from("electra")
 @with_presets(["minimal"], "filling the queue is preset-sized")
 @spec_state_test
-def test_full_exit_with_full_partial_withdrawal_queue(spec, state):
+def test_basic_withdrawal_request_with_full_partial_withdrawal_queue(spec, state):
     # the queue-limit early-out only applies to partial requests; a full
     # exit goes through even with the queue at its limit
     age_past_exit_gate(spec, state)
@@ -103,7 +103,7 @@ def test_full_exit_with_full_partial_withdrawal_queue(spec, state):
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_incorrect_source_address_ignored(spec, state):
+def test_incorrect_source_address(spec, state):
     age_past_exit_gate(spec, state)
     set_eth1_withdrawal_credentials(spec, state, 0,
                                     address=DEFAULT_ADDRESS)
@@ -114,7 +114,7 @@ def test_incorrect_source_address_ignored(spec, state):
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_incorrect_credential_prefix_ignored(spec, state):
+def test_incorrect_withdrawal_credential_prefix(spec, state):
     # 0x00 BLS credentials are not execution credentials
     age_past_exit_gate(spec, state)
     request = _full_exit_request(spec, state, 0)
@@ -124,7 +124,7 @@ def test_incorrect_credential_prefix_ignored(spec, state):
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_on_exit_initiated_validator_ignored(spec, state):
+def test_on_withdrawal_request_initiated_validator(spec, state):
     age_past_exit_gate(spec, state)
     set_eth1_withdrawal_credentials(spec, state, 0,
                                     address=DEFAULT_ADDRESS)
@@ -136,7 +136,7 @@ def test_on_exit_initiated_validator_ignored(spec, state):
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_activation_epoch_too_recent_ignored(spec, state):
+def test_activation_epoch_less_than_shard_committee_period(spec, state):
     # no aging: current epoch < activation + SHARD_COMMITTEE_PERIOD
     set_eth1_withdrawal_credentials(spec, state, 0,
                                     address=DEFAULT_ADDRESS)
@@ -147,7 +147,7 @@ def test_activation_epoch_too_recent_ignored(spec, state):
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_unknown_pubkey_ignored(spec, state):
+def test_unknown_pubkey(spec, state):
     age_past_exit_gate(spec, state)
     request = spec.WithdrawalRequest(
         source_address=DEFAULT_ADDRESS,
@@ -159,7 +159,7 @@ def test_unknown_pubkey_ignored(spec, state):
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_inactive_validator_ignored(spec, state):
+def test_incorrect_inactive_validator(spec, state):
     age_past_exit_gate(spec, state)
     set_eth1_withdrawal_credentials(spec, state, 0,
                                     address=DEFAULT_ADDRESS)
@@ -171,7 +171,7 @@ def test_inactive_validator_ignored(spec, state):
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_full_exit_with_pending_withdrawal_ignored(spec, state):
+def test_full_exit_request_has_partial_withdrawal(spec, state):
     # a full exit is deferred while pending partials exist for the
     # validator (pending_balance_to_withdraw != 0)
     age_past_exit_gate(spec, state)
@@ -204,7 +204,7 @@ def test_basic_partial_withdrawal_request(spec, state):
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_partial_withdrawal_higher_excess_balance(spec, state):
+def test_basic_partial_withdrawal_request_higher_excess_balance(spec, state):
     # excess above the requested amount: full amount is withdrawn
     age_past_exit_gate(spec, state)
     amount = int(spec.EFFECTIVE_BALANCE_INCREMENT)
@@ -217,7 +217,7 @@ def test_partial_withdrawal_higher_excess_balance(spec, state):
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_partial_withdrawal_amount_capped_at_excess(spec, state):
+def test_partial_withdrawal_request_with_high_amount(spec, state):
     # request above the excess: only the excess is withdrawable
     age_past_exit_gate(spec, state)
     excess = int(spec.EFFECTIVE_BALANCE_INCREMENT)
@@ -230,7 +230,7 @@ def test_partial_withdrawal_amount_capped_at_excess(spec, state):
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_partial_withdrawal_with_pending_withdrawals(spec, state):
+def test_partial_withdrawal_request_with_pending_withdrawals(spec, state):
     # pending amounts reduce the remaining excess
     age_past_exit_gate(spec, state)
     unit = int(spec.EFFECTIVE_BALANCE_INCREMENT)
@@ -245,7 +245,7 @@ def test_partial_withdrawal_with_pending_withdrawals(spec, state):
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_partial_withdrawal_low_amount(spec, state):
+def test_partial_withdrawal_request_with_low_amount(spec, state):
     age_past_exit_gate(spec, state)
     unit = int(spec.EFFECTIVE_BALANCE_INCREMENT)
     _stage_partial(spec, state, 0, unit)
@@ -258,7 +258,7 @@ def test_partial_withdrawal_low_amount(spec, state):
 @with_all_phases_from("electra")
 @with_presets(["minimal"], "filling the queue is preset-sized")
 @spec_state_test
-def test_partial_withdrawal_queue_full_ignored(spec, state):
+def test_partial_withdrawal_queue_full(spec, state):
     age_past_exit_gate(spec, state)
     unit = int(spec.EFFECTIVE_BALANCE_INCREMENT)
     _stage_partial(spec, state, 0, unit)
@@ -272,7 +272,7 @@ def test_partial_withdrawal_queue_full_ignored(spec, state):
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_partial_no_compounding_credentials_ignored(spec, state):
+def test_no_compounding_credentials(spec, state):
     # 0x01 credentials cannot take partial withdrawals
     age_past_exit_gate(spec, state)
     set_eth1_withdrawal_credentials(spec, state, 0,
@@ -286,7 +286,7 @@ def test_partial_no_compounding_credentials_ignored(spec, state):
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_partial_no_excess_balance_ignored(spec, state):
+def test_no_excess_balance(spec, state):
     age_past_exit_gate(spec, state)
     _stage_partial(spec, state, 0, 0)
     request = _partial_request(
@@ -297,7 +297,7 @@ def test_partial_no_excess_balance_ignored(spec, state):
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_partial_insufficient_effective_balance_ignored(spec, state):
+def test_insufficient_effective_balance(spec, state):
     age_past_exit_gate(spec, state)
     unit = int(spec.EFFECTIVE_BALANCE_INCREMENT)
     _stage_partial(spec, state, 0, unit)
@@ -310,7 +310,7 @@ def test_partial_insufficient_effective_balance_ignored(spec, state):
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_pending_withdrawals_consume_all_excess_ignored(spec, state):
+def test_pending_withdrawals_consume_all_excess_balance(spec, state):
     # pending amounts already cover the excess: nothing left to withdraw
     age_past_exit_gate(spec, state)
     unit = int(spec.EFFECTIVE_BALANCE_INCREMENT)
@@ -325,7 +325,7 @@ def test_pending_withdrawals_consume_all_excess_ignored(spec, state):
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_partial_withdrawal_incorrect_source_address_ignored(spec, state):
+def test_partial_withdrawal_incorrect_source_address(spec, state):
     age_past_exit_gate(spec, state)
     unit = int(spec.EFFECTIVE_BALANCE_INCREMENT)
     _stage_partial(spec, state, 0, unit)
@@ -337,7 +337,7 @@ def test_partial_withdrawal_incorrect_source_address_ignored(spec, state):
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_partial_withdrawal_on_exit_initiated_validator_ignored(
+def test_partial_withdrawal_on_exit_initiated_validator(
         spec, state):
     age_past_exit_gate(spec, state)
     unit = int(spec.EFFECTIVE_BALANCE_INCREMENT)
@@ -350,9 +350,105 @@ def test_partial_withdrawal_on_exit_initiated_validator_ignored(
 
 @with_all_phases_from("electra")
 @spec_state_test
-def test_partial_withdrawal_activation_too_recent_ignored(spec, state):
+def test_partial_withdrawal_activation_epoch_less_than_shard_committee_period(spec, state):
     unit = int(spec.EFFECTIVE_BALANCE_INCREMENT)
     _stage_partial(spec, state, 0, unit)
     request = _partial_request(spec, state, 0, unit)
     yield from run_request_processing(
         spec, state, "withdrawal_request", request, mutates=False)
+
+
+# ---------------------------------------------------------------------------
+# remaining reference names (round 5 completion)
+# ---------------------------------------------------------------------------
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_basic_partial_withdrawal_request_lower_than_excess_balance(
+        spec, state):
+    """Requested amount below the excess: the full request amount
+    queues."""
+    age_past_exit_gate(spec, state)
+    excess = 2 * int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    amount = excess // 2
+    _stage_partial(spec, state, 1, excess)
+    request = _partial_request(spec, state, 1, amount)
+    yield from run_request_processing(
+        spec, state, "withdrawal_request", request)
+    assert len(state.pending_partial_withdrawals) == 1
+    assert int(state.pending_partial_withdrawals[0].amount) == amount
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_insufficient_balance(spec, state):
+    """Full exit with balance below the activation floor: ignored...
+    more precisely the EXIT path only needs an active validator, so the
+    meaningful insufficient-balance gate is the PARTIAL path — a
+    request against zero excess queues nothing."""
+    age_past_exit_gate(spec, state)
+    _stage_partial(spec, state, 1, 0)
+    state.balances[1] = uint64(int(spec.MIN_ACTIVATION_BALANCE) // 2)
+    request = _partial_request(
+        spec, state, 1, int(spec.EFFECTIVE_BALANCE_INCREMENT))
+    yield from run_request_processing(
+        spec, state, "withdrawal_request", request)
+    assert len(state.pending_partial_withdrawals) == 0
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_partial_withdrawal_incorrect_withdrawal_credential_prefix(
+        spec, state):
+    """Partial request against 0x01 (non-compounding) credentials is
+    ignored."""
+    age_past_exit_gate(spec, state)
+    set_eth1_withdrawal_credentials(spec, state, 1,
+                                    address=DEFAULT_ADDRESS)
+    state.balances[1] = uint64(
+        int(spec.MIN_ACTIVATION_BALANCE)
+        + int(spec.EFFECTIVE_BALANCE_INCREMENT))
+    request = _partial_request(
+        spec, state, 1, int(spec.EFFECTIVE_BALANCE_INCREMENT))
+    yield from run_request_processing(
+        spec, state, "withdrawal_request", request)
+    assert len(state.pending_partial_withdrawals) == 0
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_partial_withdrawal_request_with_high_balance(spec, state):
+    """Max-EB compounding validator with a big excess: the requested
+    amount queues in full."""
+    age_past_exit_gate(spec, state)
+    set_compounding_withdrawal_credentials(spec, state, 1,
+                                           address=DEFAULT_ADDRESS)
+    state.validators[1].effective_balance = \
+        spec.MAX_EFFECTIVE_BALANCE_ELECTRA
+    state.balances[1] = uint64(
+        int(spec.MAX_EFFECTIVE_BALANCE_ELECTRA)
+        + 8 * int(spec.EFFECTIVE_BALANCE_INCREMENT))
+    amount = 4 * int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    request = _partial_request(spec, state, 1, amount)
+    yield from run_request_processing(
+        spec, state, "withdrawal_request", request)
+    assert len(state.pending_partial_withdrawals) == 1
+    assert int(state.pending_partial_withdrawals[0].amount) == amount
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_partial_withdrawal_request_with_pending_withdrawals_and_high_amount(
+        spec, state):
+    """Queued withdrawals already claim the whole excess: an oversized
+    new request is IGNORED (pending balance counts against the
+    excess)."""
+    age_past_exit_gate(spec, state)
+    excess = 2 * int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    _stage_partial(spec, state, 1, excess)
+    add_pending_partial_withdrawal(spec, state, 1, excess)
+    request = _partial_request(
+        spec, state, 1, 10 * int(spec.EFFECTIVE_BALANCE_INCREMENT))
+    yield from run_request_processing(
+        spec, state, "withdrawal_request", request)
+    assert len(state.pending_partial_withdrawals) == 1
