@@ -366,17 +366,18 @@ def test_partial_withdrawal_activation_epoch_less_than_shard_committee_period(sp
 @spec_state_test
 def test_basic_partial_withdrawal_request_lower_than_excess_balance(
         spec, state):
-    """Requested amount below the excess: the full request amount
-    queues."""
+    """Excess balance LOWER than the requested amount (reference
+    :422): the request queues with the amount CAPPED at the excess
+    (process_withdrawal_request's min() at queue time)."""
     age_past_exit_gate(spec, state)
-    excess = 2 * int(spec.EFFECTIVE_BALANCE_INCREMENT)
-    amount = excess // 2
+    excess = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    amount = 2 * excess
     _stage_partial(spec, state, 1, excess)
     request = _partial_request(spec, state, 1, amount)
     yield from run_request_processing(
         spec, state, "withdrawal_request", request)
     assert len(state.pending_partial_withdrawals) == 1
-    assert int(state.pending_partial_withdrawals[0].amount) == amount
+    assert int(state.pending_partial_withdrawals[0].amount) == excess
 
 
 @with_all_phases_from("electra")
@@ -400,18 +401,18 @@ def test_insufficient_balance(spec, state):
 @spec_state_test
 def test_partial_withdrawal_incorrect_withdrawal_credential_prefix(
         spec, state):
-    """Partial request against 0x01 (non-compounding) credentials is
-    ignored."""
+    """Compounding credentials with the prefix corrupted to 0x00 BLS
+    (reference namesake): fails has_execution_withdrawal_credential,
+    request ignored."""
     age_past_exit_gate(spec, state)
-    set_eth1_withdrawal_credentials(spec, state, 1,
-                                    address=DEFAULT_ADDRESS)
-    state.balances[1] = uint64(
-        int(spec.MIN_ACTIVATION_BALANCE)
-        + int(spec.EFFECTIVE_BALANCE_INCREMENT))
+    _stage_partial(spec, state, 1,
+                   int(spec.EFFECTIVE_BALANCE_INCREMENT))
+    creds = bytes(state.validators[1].withdrawal_credentials)
+    state.validators[1].withdrawal_credentials =         bytes(spec.BLS_WITHDRAWAL_PREFIX) + creds[1:]
     request = _partial_request(
         spec, state, 1, int(spec.EFFECTIVE_BALANCE_INCREMENT))
     yield from run_request_processing(
-        spec, state, "withdrawal_request", request)
+        spec, state, "withdrawal_request", request, mutates=False)
     assert len(state.pending_partial_withdrawals) == 0
 
 
@@ -440,15 +441,18 @@ def test_partial_withdrawal_request_with_high_balance(spec, state):
 @spec_state_test
 def test_partial_withdrawal_request_with_pending_withdrawals_and_high_amount(
         spec, state):
-    """Queued withdrawals already claim the whole excess: an oversized
-    new request is IGNORED (pending balance counts against the
-    excess)."""
+    """Reference :503 SUCCESS case: a near-full pending queue, but the
+    validator's balance still carries excess — a UINT64_MAX request
+    queues anyway."""
     age_past_exit_gate(spec, state)
-    excess = 2 * int(spec.EFFECTIVE_BALANCE_INCREMENT)
-    _stage_partial(spec, state, 1, excess)
-    add_pending_partial_withdrawal(spec, state, 1, excess)
-    request = _partial_request(
-        spec, state, 1, 10 * int(spec.EFFECTIVE_BALANCE_INCREMENT))
+    incr = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    _stage_partial(spec, state, 1, incr)
+    pre_queue = int(spec.PENDING_PARTIAL_WITHDRAWALS_LIMIT) - 1
+    for _ in range(pre_queue):
+        add_pending_partial_withdrawal(spec, state, 1, incr)
+    # balance high enough to leave excess past all the pendings
+    state.balances[1] = spec.MAX_EFFECTIVE_BALANCE_ELECTRA
+    request = _partial_request(spec, state, 1, uint64(2**64 - 1))
     yield from run_request_processing(
         spec, state, "withdrawal_request", request)
-    assert len(state.pending_partial_withdrawals) == 1
+    assert len(state.pending_partial_withdrawals) == pre_queue + 1
