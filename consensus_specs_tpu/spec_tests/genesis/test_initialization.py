@@ -182,3 +182,80 @@ def test_initialize_beacon_state_early_timestamp_invalid_genesis(spec):
         eth1_block_hash, eth1_timestamp, deposits)
     assert not spec.is_valid_genesis_state(state)
     yield "state", state
+
+
+@with_all_phases_from("phase0", to="deneb")
+@spec_test
+@never_bls
+def test_initialize_beacon_state_random_valid_genesis(spec):
+    """Randomized deposit amounts with enough at-threshold validators
+    to reach validity."""
+    import random
+    rng = random.Random(2020)
+    count = int(spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT)
+    deposit_data_list = []
+    deposits = []
+    root = b"\x00" * 32
+    for i in range(count + 4):
+        if i < count:
+            amount = int(spec.MAX_EFFECTIVE_BALANCE)
+        else:
+            amount = rng.randrange(int(spec.MIN_DEPOSIT_AMOUNT),
+                                   int(spec.MAX_EFFECTIVE_BALANCE))
+        wc = spec.BLS_WITHDRAWAL_PREFIX + bytes(
+            spec.hash(pubkeys[i]))[1:]
+        deposit, root, deposit_data_list = build_deposit(
+            spec, deposit_data_list, pubkeys[i], privkeys[i], amount,
+            wc, signed=True)
+        deposits.append(deposit)
+    eth1_block_hash = b"\x13" * 32
+    eth1_timestamp = int(spec.config.MIN_GENESIS_TIME)
+    yield "eth1", "data", {
+        "eth1_block_hash": "0x" + eth1_block_hash.hex(),
+        "eth1_timestamp": eth1_timestamp,
+    }
+    for i, d in enumerate(deposits):
+        yield f"deposits_{i}", d
+    yield "deposits_count", "meta", len(deposits)
+    state = spec.initialize_beacon_state_from_eth1(
+        eth1_block_hash, uint64(eth1_timestamp), deposits)
+    assert spec.is_valid_genesis_state(state)
+    yield "state", state
+
+
+@with_all_phases_from("phase0", to="deneb")
+@spec_test
+@never_bls
+def test_initialize_beacon_state_random_invalid_genesis(spec):
+    """Random sub-threshold amounts only: never enough active
+    validators for validity."""
+    import random
+    rng = random.Random(2021)
+    count = max(4, int(spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT)
+                // 4)
+    deposit_data_list = []
+    deposits = []
+    for i in range(count):
+        amount = rng.randrange(
+            int(spec.MIN_DEPOSIT_AMOUNT),
+            int(spec.MAX_EFFECTIVE_BALANCE)
+            - int(spec.EFFECTIVE_BALANCE_INCREMENT))
+        wc = spec.BLS_WITHDRAWAL_PREFIX + bytes(
+            spec.hash(pubkeys[i]))[1:]
+        deposit, _root, deposit_data_list = build_deposit(
+            spec, deposit_data_list, pubkeys[i], privkeys[i], amount,
+            wc, signed=True)
+        deposits.append(deposit)
+    eth1_block_hash = b"\x14" * 32
+    eth1_timestamp = int(spec.config.MIN_GENESIS_TIME)
+    yield "eth1", "data", {
+        "eth1_block_hash": "0x" + eth1_block_hash.hex(),
+        "eth1_timestamp": eth1_timestamp,
+    }
+    for i, d in enumerate(deposits):
+        yield f"deposits_{i}", d
+    yield "deposits_count", "meta", len(deposits)
+    state = spec.initialize_beacon_state_from_eth1(
+        eth1_block_hash, uint64(eth1_timestamp), deposits)
+    assert not spec.is_valid_genesis_state(state)
+    yield "state", state

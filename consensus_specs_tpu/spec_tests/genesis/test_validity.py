@@ -1,7 +1,8 @@
 """is_valid_genesis_state tests (vector format
 tests/formats/genesis/validity: genesis.ssz_snappy + is_valid.yaml)."""
 from ...test_infra.context import (
-    spec_state_test, with_all_phases, never_bls)
+    spec_state_test, spec_test, with_all_phases, with_all_phases_from,
+    never_bls)
 
 
 @with_all_phases
@@ -23,3 +24,38 @@ def test_early_genesis_time_invalid(spec, state):
     valid = spec.is_valid_genesis_state(state)
     yield "is_valid", "data", bool(valid)
     assert not valid
+
+
+@with_all_phases_from("phase0", to="deneb")
+@spec_test
+@never_bls
+def test_one_more_validator(spec):
+    """Exactly threshold+1 active validators: still valid."""
+    from .test_initialization import _genesis_deposits
+    from ...ssz import uint64
+    count = int(spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT) + 1
+    deposits, _root = _genesis_deposits(
+        spec, count, spec.MAX_EFFECTIVE_BALANCE)
+    state = spec.initialize_beacon_state_from_eth1(
+        b"\x12" * 32, uint64(int(spec.config.MIN_GENESIS_TIME)),
+        deposits)
+    yield "genesis", state
+    assert spec.is_valid_genesis_state(state)
+    yield "is_valid", "meta", True
+
+
+@with_all_phases_from("phase0", to="deneb")
+@spec_test
+@never_bls
+def test_invalid_not_enough_validator_count(spec):
+    from .test_initialization import _genesis_deposits
+    from ...ssz import uint64
+    count = int(spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT) - 1
+    deposits, _root = _genesis_deposits(
+        spec, count, spec.MAX_EFFECTIVE_BALANCE)
+    state = spec.initialize_beacon_state_from_eth1(
+        b"\x12" * 32, uint64(int(spec.config.MIN_GENESIS_TIME)),
+        deposits)
+    yield "genesis", state
+    assert not spec.is_valid_genesis_state(state)
+    yield "is_valid", "meta", False
