@@ -205,3 +205,59 @@ def test_ex_ante_sandwich_with_honest_attestation(spec, state):
     assert _head_root(spec, store) == hash_tree_root(signed_d.message)
     output_store_checks(spec, store, steps)
     yield from emit_steps(steps)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_ex_ante_sandwich_with_boost_not_sufficient(spec, state):
+    """D's proposer boost cannot finish the sandwich: C accumulated
+    boost-beating attestation weight first (reference test_ex_ante.py
+    :341).  A <- {B@N+1, C@N+2}, D@N+3 on B; C receives votes worth
+    boost+1 before D lands."""
+    store, steps, parts = start_fork_choice_test(spec, state)
+    for name, v in parts:
+        yield name, v
+    more, _a = _apply_base_block_a(spec, state, store, steps)
+    for name, v in more:
+        yield name, v
+    (signed_b, state_b), (signed_c, state_c) = \
+        _withheld_b_and_honest_c(spec, state)
+    # D at N+3, parent B
+    state_d = state_b.copy()
+    block_d = build_empty_block(spec, state_d,
+                                slot=int(state.slot) + 3)
+    signed_d = state_transition_and_sign_block(spec, state_d, block_d)
+
+    # C timely at N+2: boosted head; then B reveals — C holds
+    tick_to_slot(spec, store, int(signed_c.message.slot), steps)
+    for name, v in add_block(spec, store, signed_c, steps):
+        yield name, v
+    root_c = hash_tree_root(signed_c.message)
+    assert _head_root(spec, store) == root_c
+    for name, v in add_block(spec, store, signed_b, steps):
+        yield name, v
+    assert _head_root(spec, store) == root_c
+
+    # votes for C worth more than one proposer boost — the SPEC's own
+    # committee-weight form (fork_choice.py get_proposer_score)
+    committee_weight = int(spec.get_total_active_balance(state_c)) \
+        // int(spec.SLOTS_PER_EPOCH)
+    proposer_score = (committee_weight
+                      * int(spec.config.PROPOSER_SCORE_BOOST)) // 100
+    participants = proposer_score // int(
+        state_c.validators[0].effective_balance) + 1
+    attestation = _attestation_to(spec, state_c, signed_c,
+                                  participants=participants)
+
+    tick_to_slot(spec, store, int(signed_d.message.slot), steps)
+    for name, v in add_attestation(spec, store, attestation, steps):
+        yield name, v
+    assert _head_root(spec, store) == root_c
+
+    # D lands with the boost — not sufficient against C's votes
+    for name, v in add_block(spec, store, signed_d, steps):
+        yield name, v
+    assert _head_root(spec, store) == root_c
+    output_store_checks(spec, store, steps)
+    yield from emit_steps(steps)

@@ -195,7 +195,6 @@ def test_initialize_beacon_state_random_valid_genesis(spec):
     count = int(spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT)
     deposit_data_list = []
     deposits = []
-    root = b"\x00" * 32
     for i in range(count + 4):
         if i < count:
             amount = int(spec.MAX_EFFECTIVE_BALANCE)
@@ -204,7 +203,7 @@ def test_initialize_beacon_state_random_valid_genesis(spec):
                                    int(spec.MAX_EFFECTIVE_BALANCE))
         wc = spec.BLS_WITHDRAWAL_PREFIX + bytes(
             spec.hash(pubkeys[i]))[1:]
-        deposit, root, deposit_data_list = build_deposit(
+        deposit, _root, deposit_data_list = build_deposit(
             spec, deposit_data_list, pubkeys[i], privkeys[i], amount,
             wc, signed=True)
         deposits.append(deposit)

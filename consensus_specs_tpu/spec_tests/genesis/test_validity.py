@@ -1,8 +1,10 @@
 """is_valid_genesis_state tests (vector format
 tests/formats/genesis/validity: genesis.ssz_snappy + is_valid.yaml)."""
+from ...ssz import uint64
 from ...test_infra.context import (
     spec_state_test, spec_test, with_all_phases, with_all_phases_from,
     never_bls)
+from .test_initialization import _genesis_deposits
 
 
 @with_all_phases
@@ -31,8 +33,6 @@ def test_early_genesis_time_invalid(spec, state):
 @never_bls
 def test_one_more_validator(spec):
     """Exactly threshold+1 active validators: still valid."""
-    from .test_initialization import _genesis_deposits
-    from ...ssz import uint64
     count = int(spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT) + 1
     deposits, _root = _genesis_deposits(
         spec, count, spec.MAX_EFFECTIVE_BALANCE)
@@ -41,15 +41,13 @@ def test_one_more_validator(spec):
         deposits)
     yield "genesis", state
     assert spec.is_valid_genesis_state(state)
-    yield "is_valid", "meta", True
+    yield "is_valid", "data", True
 
 
 @with_all_phases_from("phase0", to="deneb")
 @spec_test
 @never_bls
 def test_invalid_not_enough_validator_count(spec):
-    from .test_initialization import _genesis_deposits
-    from ...ssz import uint64
     count = int(spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT) - 1
     deposits, _root = _genesis_deposits(
         spec, count, spec.MAX_EFFECTIVE_BALANCE)
@@ -58,4 +56,4 @@ def test_invalid_not_enough_validator_count(spec):
         deposits)
     yield "genesis", state
     assert not spec.is_valid_genesis_state(state)
-    yield "is_valid", "meta", False
+    yield "is_valid", "data", False
