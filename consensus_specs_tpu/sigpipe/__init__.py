@@ -1,0 +1,21 @@
+"""Block-level deferred signature verification pipeline.
+
+Collect every signature check a signed block implies (sets.py), verify
+them together in the fewest device dispatches (scheduler.py), isolate
+failures by bisection (bisect.py), cache decompressed/aggregated pubkeys
+(cache.py), and surface counters (metrics.py).  verify.py wires the
+pipeline into `state_transition` behind the opt-in `enable()` switch; the
+inline scalar path stays the default oracle.
+"""
+from .metrics import METRICS
+from .sets import SignatureSet, collect_block_sets
+from .verify import (
+    block_scope, compute_verdicts, disable, enable, enabled, mode,
+    verify_block_signatures,
+)
+
+__all__ = [
+    "METRICS", "SignatureSet", "collect_block_sets", "block_scope",
+    "compute_verdicts", "disable", "enable", "enabled", "mode",
+    "verify_block_signatures",
+]

@@ -1,0 +1,95 @@
+"""Pubkey caches for the signature pipeline.
+
+Two layers, both bounded (FIFO eviction, same discipline as the spec's
+shuffle-permutation LRU):
+
+* `PubkeyCache` — compressed 48-byte pubkey -> validated decompressed G1
+  Point.  Decompression + subgroup check is the per-key host cost of every
+  verification; real clients cache it across blocks, so do we.
+* `AggregatePubkeyCache` — participant-set digest -> aggregated G1 Point.
+  The committee/sync-aggregate G1 sums are O(committee) point adds per set;
+  re-verifying the same participant set (oracle cross-checks, repeated
+  dispatch of one block, fork-choice replays) hits the cache instead.
+  Entries carry a human-readable hint like ``("att", epoch,
+  committee_index)`` for debugging, but the KEY is a content digest of the
+  participant pubkeys — a label collision can therefore never return the
+  wrong aggregate.
+
+Hit/miss counters land in sigpipe.metrics.METRICS.
+"""
+from __future__ import annotations
+
+import hashlib
+
+from ..crypto import curve as cv
+from ..crypto.bls12_381 import _load_pubkey
+from .metrics import METRICS
+
+
+class PubkeyCache:
+    def __init__(self, max_size: int = 1 << 16, metrics=METRICS):
+        self._cache: dict = {}
+        self._max = max_size
+        self._metrics = metrics
+
+    def get(self, pubkey) -> cv.Point:
+        """Decompressed, validated G1 point for compressed bytes; raises
+        DecodeError/ValueError exactly like the scalar `_load_pubkey`."""
+        key = bytes(pubkey)
+        point = self._cache.get(key)
+        if point is not None:
+            self._metrics.inc("pubkey_cache_hits")
+            return point
+        self._metrics.inc("pubkey_cache_misses")
+        point = _load_pubkey(key)   # DecodeError / ValueError propagate
+        if len(self._cache) >= self._max:
+            self._cache.pop(next(iter(self._cache)))
+        self._cache[key] = point
+        return point
+
+    def clear(self) -> None:
+        self._cache.clear()
+
+    def __len__(self) -> int:
+        return len(self._cache)
+
+
+class AggregatePubkeyCache:
+    def __init__(self, pubkeys: PubkeyCache, max_size: int = 1 << 12,
+                 metrics=METRICS):
+        self._pubkeys = pubkeys
+        self._cache: dict = {}
+        self._max = max_size
+        self._metrics = metrics
+
+    def aggregate(self, pubkey_bytes_list, hint=None) -> cv.Point:
+        """Sum of the (decompressed) pubkeys; cached by content digest."""
+        digest = hashlib.sha256(
+            b"".join(bytes(pk) for pk in pubkey_bytes_list)).digest()
+        entry = self._cache.get(digest)
+        if entry is not None:
+            self._metrics.inc("aggregate_cache_hits")
+            return entry[0]
+        self._metrics.inc("aggregate_cache_misses")
+        agg = cv.g1_infinity()
+        for pk in pubkey_bytes_list:
+            agg = agg + self._pubkeys.get(pk)
+        if len(self._cache) >= self._max:
+            self._cache.pop(next(iter(self._cache)))
+        self._cache[digest] = (agg, hint)
+        return agg
+
+    def clear(self) -> None:
+        self._cache.clear()
+
+    def __len__(self) -> int:
+        return len(self._cache)
+
+
+PUBKEYS = PubkeyCache()
+AGGREGATES = AggregatePubkeyCache(PUBKEYS)
+
+
+def clear() -> None:
+    PUBKEYS.clear()
+    AGGREGATES.clear()

@@ -1,0 +1,73 @@
+"""Lightweight pipeline metrics: counters, observations, wall-clock timers.
+
+One module-global `METRICS` registry is shared by the collector, scheduler,
+bisection and caches so a single `snapshot()` describes a whole verification
+run (batch sizes, dispatch count, bisection depth, cache hit rate) —
+dumpable as JSON for `bench.py` and asserted on by tests/test_sigpipe.py.
+"""
+from __future__ import annotations
+
+import json
+import time
+from contextlib import contextmanager
+
+
+class Metrics:
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self.counters: dict = {}
+        self.observations: dict = {}
+        self.timers: dict = {}
+
+    # -- counters ------------------------------------------------------
+    def inc(self, name: str, by: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + by
+
+    def count(self, name: str) -> int:
+        return self.counters.get(name, 0)
+
+    # -- observations (count/total/min/max, no per-sample storage) -----
+    def observe(self, name: str, value) -> None:
+        o = self.observations.get(name)
+        if o is None:
+            self.observations[name] = {"count": 1, "total": value,
+                                       "min": value, "max": value}
+        else:
+            o["count"] += 1
+            o["total"] += value
+            o["min"] = min(o["min"], value)
+            o["max"] = max(o["max"], value)
+
+    # -- timers --------------------------------------------------------
+    @contextmanager
+    def timer(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.timers[name] = (self.timers.get(name, 0.0)
+                                 + time.perf_counter() - t0)
+
+    # -- export --------------------------------------------------------
+    def snapshot(self) -> dict:
+        out = dict(self.counters)
+        for name, o in self.observations.items():
+            out[name] = dict(o)
+            if o["count"]:
+                out[name]["mean"] = o["total"] / o["count"]
+        for name, secs in self.timers.items():
+            out[f"{name}_sec"] = round(secs, 6)
+        # derived rates the dashboards care about
+        hits = self.count("pubkey_cache_hits")
+        misses = self.count("pubkey_cache_misses")
+        if hits + misses:
+            out["pubkey_cache_hit_rate"] = round(hits / (hits + misses), 4)
+        return out
+
+    def to_json(self) -> str:
+        return json.dumps(self.snapshot(), sort_keys=True)
+
+
+METRICS = Metrics()

@@ -1,0 +1,127 @@
+"""Pipeline driver: collect a block's signature sets, verify them in one
+batch, and let the spec consume the verdicts at its own call sites.
+
+Opt-in, like `parallel/mesh_engine.enable(mesh)`:
+
+    from consensus_specs_tpu import sigpipe
+    sigpipe.enable()            # or sigpipe.enable(mode="per-set")
+    spec.state_transition(state, signed_block)
+    sigpipe.disable()
+
+`state_transition` wraps block processing in `block_scope`, which
+precomputes a verdict for every signature check the block implies
+(sets.collect_block_sets -> scheduler.verify_sets) and installs the map
+on the spec instance.  The verification seams (`BaseSpec.bls_verify` /
+`bls_fast_aggregate_verify`) look verdicts up by content — (pubkeys,
+signing_root, signature) — so a batch verdict substitutes for the scalar
+call at EXACTLY the inline call site: an invalid block raises the same
+AssertionError at the same operation boundary with the same partial state
+mutations, byte-identical to the scalar path.  Any check the collector
+failed to predict simply misses the map and falls back to the scalar
+backend (counted in metrics), so enabling the pipeline can never change
+behavior — only the number of device dispatches.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+from . import scheduler, sets
+from .metrics import METRICS
+
+_enabled = False
+_mode = "fused"
+
+
+def enable(mode: str = "fused") -> None:
+    """Route state_transition signature checks through the batch pipeline.
+    `mode`: "fused" (one combined pairing dispatch + bisection) or
+    "per-set" (VerifyBatch/FastAggregateVerifyBatch grouping)."""
+    global _enabled, _mode
+    if mode not in ("fused", "per-set"):
+        raise ValueError(f"unknown sigpipe mode {mode!r}")
+    _enabled = True
+    _mode = mode
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def mode() -> str:
+    return _mode
+
+
+class VerdictMap:
+    """Content-addressed verdicts: (pubkeys, signing_root, signature) ->
+    bool.  The spec seams consult it; misses fall back to scalar."""
+
+    def __init__(self, verdicts: dict):
+        self._verdicts = verdicts
+
+    def lookup(self, pubkeys, signing_root, signature):
+        v = self._verdicts.get((pubkeys, signing_root, signature))
+        if v is None:
+            METRICS.inc("seam_misses")
+        else:
+            METRICS.inc("seam_hits")
+        return v
+
+    def __len__(self) -> int:
+        return len(self._verdicts)
+
+
+def compute_verdicts(spec, state, signed_block):
+    """Collect + batch-verify every signature check in `signed_block`;
+    returns (VerdictMap, collected sets, per-set verdict list)."""
+    block_sets = sets.collect_block_sets(spec, state, signed_block)
+    # identical checks (same pubkeys/root/signature) verify once
+    unique: dict = {}
+    for s in block_sets:
+        unique.setdefault(s.key(), s)
+    dropped = len(block_sets) - len(unique)
+    if dropped:
+        METRICS.inc("dedup_saved", dropped)
+    unique_sets = list(unique.values())
+    unique_verdicts = scheduler.verify_sets(unique_sets, mode=_mode)
+    by_key = {s.key(): v for s, v in zip(unique_sets, unique_verdicts)}
+    return (VerdictMap(by_key), block_sets,
+            [by_key[s.key()] for s in block_sets])
+
+
+def verify_block_signatures(spec, state, signed_block) -> None:
+    """Eager API: batch-verify every signature check the block implies;
+    None if they all pass, AssertionError naming the first failing
+    operation otherwise (deposit sets are valid-or-skip and never raise).
+    `state` must be advanced to the block's slot."""
+    _vm, block_sets, verdicts = compute_verdicts(spec, state, signed_block)
+    for s, ok in zip(block_sets, verdicts):
+        assert ok or not s.required, \
+            f"sigpipe: invalid {s.kind} signature at {s.origin or s.kind}"
+
+
+@contextmanager
+def block_scope(spec, state, signed_block):
+    """Install batch verdicts on `spec` for the duration of one block's
+    processing; a pipeline failure degrades to the scalar path."""
+    if not _enabled:
+        yield
+        return
+    try:
+        vm, _sets, _verdicts = compute_verdicts(spec, state, signed_block)
+    except Exception:
+        METRICS.inc("pipeline_errors")
+        vm = None
+    if vm is None:
+        yield
+        return
+    previous = spec._sigpipe_verdicts
+    spec._sigpipe_verdicts = vm
+    try:
+        yield
+    finally:
+        spec._sigpipe_verdicts = previous

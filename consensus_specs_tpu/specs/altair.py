@@ -196,7 +196,7 @@ class AltairSpec(LightClientMixin, Phase0Spec):
     def eth_fast_aggregate_verify(self, pubkeys, message, signature) -> bool:
         if len(pubkeys) == 0 and signature == self.G2_POINT_AT_INFINITY:
             return True
-        return bls.FastAggregateVerify(pubkeys, message, signature)
+        return self.bls_fast_aggregate_verify(pubkeys, message, signature)
 
     # ------------------------------------------------------------------
     # accessors / rewards

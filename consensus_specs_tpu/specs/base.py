@@ -12,10 +12,15 @@ from __future__ import annotations
 
 
 from ..config import Config, load_config, load_preset
+from ..utils import bls
 
 
 class BaseSpec:
     fork: str = "base"
+
+    # sigpipe verdict map (sigpipe/verify.py block_scope); None outside a
+    # pipeline window, when every seam call is a plain scalar verify
+    _sigpipe_verdicts = None
 
     def __init__(self, preset_name: str = "mainnet",
                  config: Config | None = None):
@@ -50,3 +55,30 @@ class BaseSpec:
         mro_forks = [c.fork for c in type(self).__mro__
                      if hasattr(c, "fork")]
         return fork_name in mro_forks
+
+    # -- signature verification seam -----------------------------------
+    # Every per-operation signature check in the spec layer flows through
+    # these two methods so a precomputed batch verdict (sigpipe/) can
+    # stand in for the scalar call at the exact inline call site.  A map
+    # miss — a check the collector didn't predict — falls back to the
+    # scalar backend, so routing through the seam can never change
+    # behavior.
+
+    def bls_verify(self, pubkey, signing_root, signature) -> bool:
+        verdicts = self._sigpipe_verdicts
+        if verdicts is not None:
+            v = verdicts.lookup((bytes(pubkey),), bytes(signing_root),
+                                bytes(signature))
+            if v is not None:
+                return v
+        return bls.Verify(pubkey, signing_root, signature)
+
+    def bls_fast_aggregate_verify(self, pubkeys, signing_root,
+                                  signature) -> bool:
+        verdicts = self._sigpipe_verdicts
+        if verdicts is not None:
+            v = verdicts.lookup(tuple(bytes(pk) for pk in pubkeys),
+                                bytes(signing_root), bytes(signature))
+            if v is not None:
+                return v
+        return bls.FastAggregateVerify(pubkeys, signing_root, signature)

@@ -8,7 +8,6 @@ from ..ssz import (
     ByteVector, Bytes4, Bytes20, Bytes32, Bytes48, Bytes96,
     hash_tree_root,
 )
-from ..utils import bls
 from .bellatrix import BellatrixSpec
 
 
@@ -264,8 +263,8 @@ class CapellaSpec(BellatrixSpec):
             self.DOMAIN_BLS_TO_EXECUTION_CHANGE,
             genesis_validators_root=state.genesis_validators_root)
         signing_root = self.compute_signing_root(address_change, domain)
-        assert bls.Verify(address_change.from_bls_pubkey, signing_root,
-                          signed_address_change.signature)
+        assert self.bls_verify(address_change.from_bls_pubkey, signing_root,
+                               signed_address_change.signature)
         validator.withdrawal_credentials = (
             self.ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11
             + bytes(address_change.to_execution_address))

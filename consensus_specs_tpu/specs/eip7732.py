@@ -14,7 +14,6 @@ from ..ssz import (
 )
 from .electra import ElectraSpec, NewPayloadRequest
 from .eip7732_fork_choice import Eip7732ForkChoice
-from ..utils import bls
 
 
 class Eip7732Spec(Eip7732ForkChoice, ElectraSpec):
@@ -242,7 +241,7 @@ class Eip7732Spec(Eip7732ForkChoice, ElectraSpec):
         domain = self.get_domain(state, self.DOMAIN_PTC_ATTESTER, None)
         signing_root = self.compute_signing_root(
             indexed_payload_attestation.data, domain)
-        return bls.FastAggregateVerify(
+        return self.bls_fast_aggregate_verify(
             pubkeys, signing_root, indexed_payload_attestation.signature)
 
     # ------------------------------------------------------------------
@@ -295,8 +294,8 @@ class Eip7732Spec(Eip7732ForkChoice, ElectraSpec):
         signing_root = self.compute_signing_root(
             signed_header.message,
             self.get_domain(state, self.DOMAIN_BEACON_BUILDER))
-        return bls.Verify(builder.pubkey, signing_root,
-                          signed_header.signature)
+        return self.bls_verify(builder.pubkey, signing_root,
+                               signed_header.signature)
 
     def process_execution_payload_header(self, state, block) -> None:
         signed_header = block.body.signed_execution_payload_header
@@ -404,8 +403,8 @@ class Eip7732Spec(Eip7732ForkChoice, ElectraSpec):
         signing_root = self.compute_signing_root(
             signed_envelope.message,
             self.get_domain(state, self.DOMAIN_BEACON_BUILDER))
-        return bls.Verify(builder.pubkey, signing_root,
-                          signed_envelope.signature)
+        return self.bls_verify(builder.pubkey, signing_root,
+                               signed_envelope.signature)
 
     def process_execution_payload(self, state, signed_envelope,
                                   execution_engine=None,

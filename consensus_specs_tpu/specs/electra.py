@@ -905,7 +905,7 @@ class ElectraSpec(DenebSpec):
             amount=amount)
         domain = self.compute_domain(self.DOMAIN_DEPOSIT)
         signing_root = self.compute_signing_root(deposit_message, domain)
-        return bls.Verify(pubkey, signing_root, signature)
+        return self.bls_verify(pubkey, signing_root, signature)
 
     def apply_deposit(self, state, pubkey, withdrawal_credentials, amount,
                       signature) -> None:
@@ -940,8 +940,8 @@ class ElectraSpec(DenebSpec):
             state, voluntary_exit.validator_index) == 0
         domain = self.voluntary_exit_domain(state, voluntary_exit)
         signing_root = self.compute_signing_root(voluntary_exit, domain)
-        assert bls.Verify(validator.pubkey, signing_root,
-                          signed_voluntary_exit.signature)
+        assert self.bls_verify(validator.pubkey, signing_root,
+                               signed_voluntary_exit.signature)
         self.initiate_validator_exit(state, voluntary_exit.validator_index)
 
     def process_withdrawal_request(self, state, withdrawal_request) -> None:

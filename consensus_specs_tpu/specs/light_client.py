@@ -20,7 +20,6 @@ from ..ssz import (
 )
 from ..ssz.merkle import is_valid_merkle_branch
 from ..ssz.proofs import compute_merkle_proof, get_generalized_index
-from ..utils import bls
 
 
 def floorlog2(x: int) -> int:
@@ -400,7 +399,7 @@ class LightClientMixin:
                                      fork_version, genesis_validators_root)
         signing_root = self.compute_signing_root(
             update.attested_header.beacon, domain)
-        assert bls.FastAggregateVerify(
+        assert self.bls_fast_aggregate_verify(
             participant_pubkeys, signing_root,
             sync_aggregate.sync_committee_signature)
 

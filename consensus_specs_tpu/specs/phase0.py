@@ -19,8 +19,8 @@ from ..ssz import (
     hash_tree_root, serialize, uint_to_bytes,
 )
 from ..ssz.merkle import is_valid_merkle_branch as _merkle_branch_ok
-from ..utils.hash import hash as sha256_hash
 from ..utils import bls
+from ..utils.hash import hash as sha256_hash
 from .base import BaseSpec
 from .fork_choice import Phase0ForkChoice
 from .validator_duties import Phase0ValidatorDuties
@@ -313,8 +313,8 @@ class Phase0Spec(Phase0ForkChoice, Phase0ValidatorDuties, BaseSpec):
         domain = self.get_domain(state, self.DOMAIN_BEACON_ATTESTER,
                                  indexed_attestation.data.target.epoch)
         signing_root = self.compute_signing_root(indexed_attestation.data, domain)
-        return bls.FastAggregateVerify(pubkeys, signing_root,
-                                       indexed_attestation.signature)
+        return self.bls_fast_aggregate_verify(pubkeys, signing_root,
+                                              indexed_attestation.signature)
 
     # ------------------------------------------------------------------
     # misc computations (beacon-chain.md "Misc" helpers)
@@ -644,9 +644,14 @@ class Phase0Spec(Phase0ForkChoice, Phase0ValidatorDuties, BaseSpec):
                          validate_result: bool = True) -> None:
         block = signed_block.message
         self.process_slots(state, block.slot)
-        if validate_result:
-            assert self.verify_block_signature(state, signed_block)
-        self.process_block(state, block)
+        # opt-in deferred signature pipeline: precompute one batch verdict
+        # per signature check; the per-operation seams consume them at the
+        # inline call sites (scalar path when disabled)
+        from ..sigpipe import verify as sigpipe_verify
+        with sigpipe_verify.block_scope(self, state, signed_block):
+            if validate_result:
+                assert self.verify_block_signature(state, signed_block)
+            self.process_block(state, block)
         if validate_result:
             assert block.state_root == hash_tree_root(state)
 
@@ -655,7 +660,8 @@ class Phase0Spec(Phase0ForkChoice, Phase0ValidatorDuties, BaseSpec):
         signing_root = self.compute_signing_root(
             signed_block.message,
             self.get_domain(state, self.DOMAIN_BEACON_PROPOSER))
-        return bls.Verify(proposer.pubkey, signing_root, signed_block.signature)
+        return self.bls_verify(proposer.pubkey, signing_root,
+                               signed_block.signature)
 
     def process_slots(self, state, slot) -> None:
         assert state.slot < slot
@@ -1059,7 +1065,8 @@ class Phase0Spec(Phase0ForkChoice, Phase0ValidatorDuties, BaseSpec):
         proposer = state.validators[self.get_beacon_proposer_index(state)]
         signing_root = self.compute_signing_root(
             uint64(epoch), self.get_domain(state, self.DOMAIN_RANDAO))
-        assert bls.Verify(proposer.pubkey, signing_root, body.randao_reveal)
+        assert self.bls_verify(proposer.pubkey, signing_root,
+                               body.randao_reveal)
         mix = xor(self.get_randao_mix(state, epoch),
                   self.hash(bytes(body.randao_reveal)))
         state.randao_mixes[epoch % self.EPOCHS_PER_HISTORICAL_VECTOR] = mix
@@ -1103,8 +1110,8 @@ class Phase0Spec(Phase0ForkChoice, Phase0ValidatorDuties, BaseSpec):
                 self.compute_epoch_at_slot(signed_header.message.slot))
             signing_root = self.compute_signing_root(
                 signed_header.message, domain)
-            assert bls.Verify(proposer.pubkey, signing_root,
-                              signed_header.signature)
+            assert self.bls_verify(proposer.pubkey, signing_root,
+                                   signed_header.signature)
         self.slash_validator(state, header_1.proposer_index)
 
     def process_attester_slashing(self, state, attester_slashing) -> None:
@@ -1187,7 +1194,7 @@ class Phase0Spec(Phase0ForkChoice, Phase0ValidatorDuties, BaseSpec):
                 amount=amount)
             domain = self.compute_domain(self.DOMAIN_DEPOSIT)
             signing_root = self.compute_signing_root(deposit_message, domain)
-            if bls.Verify(pubkey, signing_root, signature):
+            if self.bls_verify(pubkey, signing_root, signature):
                 self.add_validator_to_registry(
                     state, pubkey, withdrawal_credentials, amount)
         else:
@@ -1220,8 +1227,8 @@ class Phase0Spec(Phase0ForkChoice, Phase0ValidatorDuties, BaseSpec):
                 + self.config.SHARD_COMMITTEE_PERIOD)
         domain = self.voluntary_exit_domain(state, voluntary_exit)
         signing_root = self.compute_signing_root(voluntary_exit, domain)
-        assert bls.Verify(validator.pubkey, signing_root,
-                          signed_voluntary_exit.signature)
+        assert self.bls_verify(validator.pubkey, signing_root,
+                               signed_voluntary_exit.signature)
         self.initiate_validator_exit(state, voluntary_exit.validator_index)
 
     def voluntary_exit_domain(self, state, voluntary_exit):

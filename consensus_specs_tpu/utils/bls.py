@@ -128,28 +128,49 @@ def _sig_bytes(sig):
     return bytes(sig)
 
 
-def FastAggregateVerifyBatch(pubkey_lists, messages, signatures):
-    """Verdict list for many FastAggregateVerify jobs.  On the tpu backend
-    all pairings run as one batched kernel; native falls back per-job.
-    With bls disabled every job reads as valid, matching the scalar API's
-    stub-True contract."""
+def _stub_or_dispatch(n_jobs, tpu_fn, native_fn):
+    """Shared batch-API contract: with bls disabled every job reads as
+    valid (the scalar APIs' stub-True semantics — one helper so the three
+    batch entry points can't drift), the tpu backend runs all pairings as
+    one batched kernel dispatch, and native falls back per-job."""
     if not bls_active:
-        return [True] * len(pubkey_lists)
+        return [True] * n_jobs
     if _backend_name == "tpu":
-        return _tpu().fast_aggregate_verify_batch(
-            pubkey_lists, messages, signatures)
-    return [FastAggregateVerify([_pk_bytes(pk) for pk in pks], m,
-                                _sig_bytes(s))
-            for pks, m, s in zip(pubkey_lists, messages, signatures)]
+        return tpu_fn()
+    return native_fn()
+
+
+def FastAggregateVerifyBatch(pubkey_lists, messages, signatures):
+    """Verdict list for many FastAggregateVerify jobs."""
+    return _stub_or_dispatch(
+        len(pubkey_lists),
+        lambda: _tpu().fast_aggregate_verify_batch(
+            pubkey_lists, messages, signatures),
+        lambda: [FastAggregateVerify([_pk_bytes(pk) for pk in pks], m,
+                                     _sig_bytes(s))
+                 for pks, m, s in zip(pubkey_lists, messages, signatures)])
 
 
 def VerifyBatch(pubkeys, messages, signatures):
-    if not bls_active:
-        return [True] * len(pubkeys)
-    if _backend_name == "tpu":
-        return _tpu().verify_batch(pubkeys, messages, signatures)
-    return [Verify(_pk_bytes(pk), m, _sig_bytes(s))
-            for pk, m, s in zip(pubkeys, messages, signatures)]
+    """Verdict list for many independent Verify jobs."""
+    return _stub_or_dispatch(
+        len(pubkeys),
+        lambda: _tpu().verify_batch(pubkeys, messages, signatures),
+        lambda: [Verify(_pk_bytes(pk), m, _sig_bytes(s))
+                 for pk, m, s in zip(pubkeys, messages, signatures)])
+
+
+def AggregateVerifyBatch(pubkey_lists, message_lists, signatures):
+    """Verdict list for many AggregateVerify jobs (distinct message per
+    pubkey within each job)."""
+    return _stub_or_dispatch(
+        len(pubkey_lists),
+        lambda: _tpu().aggregate_verify_batch(
+            pubkey_lists, message_lists, signatures),
+        lambda: [AggregateVerify([_pk_bytes(pk) for pk in pks], ms,
+                                 _sig_bytes(s))
+                 for pks, ms, s in zip(pubkey_lists, message_lists,
+                                       signatures)])
 
 
 @only_with_bls(alt_return=STUB_SIGNATURE)

@@ -16,7 +16,18 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # pre-0.5 jax has no jax_num_cpu_devices; spell it via XLA_FLAGS.
+    # Backends initialize lazily (first device use), so setting the env
+    # var after import is still early enough — and keeping it out of the
+    # jax>=0.5 path matters, since setting both is rejected there.
+    if "--xla_force_host_platform_device_count" not in os.environ.get(
+            "XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8")
 # persistent XLA binary cache: the limb-crypto graphs (pairing, scalar mul)
 # compile in tens of seconds; cache them across pytest runs
 jax.config.update("jax_compilation_cache_dir",

@@ -1,0 +1,390 @@
+"""Block-level deferred signature verification pipeline (sigpipe/).
+
+Covers the PR-1 acceptance criteria:
+  * batch/scalar parity: the shim's batch APIs agree with per-job scalar
+    verdicts for random valid/invalid placements (native backend default;
+    the tpu-backend leg is `slow` — it compiles the pairing kernels);
+  * bisection reports exactly the injected-bad indices;
+  * with sigpipe.enable(), phase0 and altair sanity blocks apply with
+    post-state roots identical to the inline path;
+  * invalid-signature blocks raise at the same operation boundary with
+    the same partial state mutations;
+  * deposit valid-or-skip semantics survive the pipeline;
+  * the bls-disabled stub contract holds end to end (zero dispatches);
+  * pubkey/aggregate caches hit on re-verification.
+"""
+import random
+import sys
+import traceback
+
+import pytest
+
+from consensus_specs_tpu import sigpipe
+from consensus_specs_tpu.sigpipe import METRICS, bisect, cache, scheduler
+from consensus_specs_tpu.sigpipe.sets import SignatureSet
+from consensus_specs_tpu.specs import get_spec
+from consensus_specs_tpu.ssz import hash_tree_root, uint64
+from consensus_specs_tpu.test_infra import disable_bls
+from consensus_specs_tpu.test_infra.attestations import get_valid_attestation
+from consensus_specs_tpu.test_infra.blocks import (
+    build_empty_block_for_next_slot, sign_block,
+    state_transition_and_sign_block)
+from consensus_specs_tpu.test_infra.deposits import prepare_state_and_deposit
+from consensus_specs_tpu.test_infra.genesis import (
+    create_genesis_state, default_balances)
+from consensus_specs_tpu.test_infra.keys import privkeys, pubkeys
+from consensus_specs_tpu.test_infra.sync_committee import get_sync_aggregate
+from consensus_specs_tpu.utils import bls
+
+
+@pytest.fixture(scope="module")
+def phase0_spec():
+    return get_spec("phase0", "minimal")
+
+
+@pytest.fixture(scope="module")
+def altair_spec():
+    return get_spec("altair", "minimal")
+
+
+@pytest.fixture(scope="module")
+def phase0_state(phase0_spec):
+    state = create_genesis_state(phase0_spec, default_balances(phase0_spec))
+    phase0_spec.process_slots(state, uint64(phase0_spec.SLOTS_PER_EPOCH + 2))
+    return state
+
+
+@pytest.fixture(scope="module")
+def altair_state(altair_spec):
+    state = create_genesis_state(altair_spec, default_balances(altair_spec))
+    altair_spec.process_slots(state, uint64(altair_spec.SLOTS_PER_EPOCH + 2))
+    return state
+
+
+@pytest.fixture(autouse=True)
+def _sigpipe_reset():
+    sigpipe.disable()
+    METRICS.reset()
+    yield
+    sigpipe.disable()
+
+
+def _signing_root(i: int) -> bytes:
+    return i.to_bytes(8, "little") + b"\x5b" * 24
+
+
+def _fast_aggregate_jobs(n_jobs, committee, bad_indices):
+    """(pubkey_lists, messages, signatures) with wrong-key (but
+    well-formed) signatures injected at `bad_indices`."""
+    pk_lists, messages, signatures = [], [], []
+    for i in range(n_jobs):
+        ids = list(range(i % 3, i % 3 + committee))
+        msg = _signing_root(i)
+        signer_ids = ids if i not in bad_indices else [x + 7 for x in ids]
+        sigs = [bls.Sign(privkeys[x], msg) for x in signer_ids]
+        pk_lists.append([pubkeys[x] for x in ids])
+        messages.append(msg)
+        signatures.append(bls.Aggregate(sigs))
+    return pk_lists, messages, signatures
+
+
+# ---------------------------------------------------------------------------
+# batch/scalar parity (satellite: utils/bls.py batch API contract)
+# ---------------------------------------------------------------------------
+
+def test_fast_aggregate_verify_batch_matches_scalar():
+    pk_lists, messages, signatures = _fast_aggregate_jobs(
+        n_jobs=4, committee=2, bad_indices={1})
+    batch = bls.FastAggregateVerifyBatch(pk_lists, messages, signatures)
+    scalar = [bls.FastAggregateVerify(pks, m, s)
+              for pks, m, s in zip(pk_lists, messages, signatures)]
+    assert batch == scalar == [True, False, True, True]
+
+
+def test_verify_batch_and_aggregate_verify_batch_match_scalar():
+    messages = [_signing_root(i) for i in range(3)]
+    sigs = [bls.Sign(privkeys[i], messages[i]) for i in range(3)]
+    sigs[2] = bls.Sign(privkeys[5], messages[2])    # wrong key
+    pks = [pubkeys[i] for i in range(3)]
+    batch = bls.VerifyBatch(pks, messages, sigs)
+    scalar = [bls.Verify(pk, m, s) for pk, m, s in zip(pks, messages, sigs)]
+    assert batch == scalar == [True, True, False]
+
+    # AggregateVerify: distinct message per pubkey, one aggregate signature
+    agg_ok = bls.Aggregate(
+        [bls.Sign(privkeys[i], messages[i]) for i in range(2)])
+    agg_bad = bls.Aggregate(
+        [bls.Sign(privkeys[i + 3], messages[i]) for i in range(2)])
+    batch = bls.AggregateVerifyBatch(
+        [pks[:2], pks[:2]], [messages[:2], messages[:2]], [agg_ok, agg_bad])
+    scalar = [bls.AggregateVerify(pks[:2], messages[:2], s)
+              for s in (agg_ok, agg_bad)]
+    assert batch == scalar == [True, False]
+
+
+def test_batch_apis_share_stub_contract():
+    with disable_bls():
+        assert bls.FastAggregateVerifyBatch(
+            [[pubkeys[0]]], [b"\x00" * 32], [b"\x11" * 96]) == [True]
+        assert bls.VerifyBatch(
+            [pubkeys[0]], [b"\x00" * 32], [b"\x11" * 96]) == [True]
+        assert bls.AggregateVerifyBatch(
+            [[pubkeys[0]]], [[b"\x00" * 32]], [b"\x11" * 96]) == [True]
+
+
+@pytest.mark.slow
+def test_fast_aggregate_verify_batch_parity_tpu_backend():
+    """Same placements through the tpu pairing kernels (compile-heavy)."""
+    pk_lists, messages, signatures = _fast_aggregate_jobs(
+        n_jobs=3, committee=2, bad_indices={0})
+    expected = [bls.FastAggregateVerify(pks, m, s)
+                for pks, m, s in zip(pk_lists, messages, signatures)]
+    bls.use_tpu()
+    try:
+        batch = bls.FastAggregateVerifyBatch(pk_lists, messages, signatures)
+    finally:
+        bls.use_native()
+    assert batch == expected == [False, True, True]
+
+
+# ---------------------------------------------------------------------------
+# scheduler + bisection
+# ---------------------------------------------------------------------------
+
+def _single_sets(n, bad_indices):
+    out = []
+    for i in range(n):
+        msg = _signing_root(i)
+        signer = i if i not in bad_indices else i + 11
+        out.append(SignatureSet(
+            pubkeys=(bytes(pubkeys[i]),), signing_root=msg,
+            signature=bytes(bls.Sign(privkeys[signer], msg)),
+            kind="test", origin=("test", i)))
+    return out
+
+
+def test_fused_scheduler_bisects_to_injected_indices():
+    bad = {1, 3}
+    verdicts = scheduler.verify_sets(_single_sets(5, bad), mode="fused")
+    assert [i for i, v in enumerate(verdicts) if not v] == sorted(bad)
+    assert METRICS.count("fused_batch_failures") == 1
+    assert METRICS.count("bisect_dispatches") > 0
+    # the happy dispatch plus log-many bisection probes, never one per sig
+    assert METRICS.count("dispatches") < 1 + 2 * 5
+
+
+def test_fused_and_per_set_modes_agree():
+    sets = _single_sets(4, bad_indices={2})
+    fused = scheduler.verify_sets(sets, mode="fused")
+    METRICS.reset()
+    per_set = scheduler.verify_sets(sets, mode="per-set")
+    assert fused == per_set == [True, True, False, True]
+    assert METRICS.count("dispatches") <= 2   # homogeneous grouping
+
+
+def test_degenerate_sets_match_scalar_without_dispatch():
+    sets = [
+        SignatureSet(pubkeys=(), signing_root=b"\x00" * 32,
+                     signature=b"\x11" * 96, kind="empty"),
+        SignatureSet(pubkeys=(b"\xff" * 48,), signing_root=b"\x00" * 32,
+                     signature=b"\x11" * 96, kind="undecodable"),
+    ]
+    assert scheduler.verify_sets(sets, mode="fused") == [False, False]
+    assert METRICS.count("dispatches") == 0
+
+
+def test_bisection_isolates_arbitrary_patterns():
+    """Pure-logic property check of the splitter (no crypto): for random
+    failure patterns, isolate_failures returns exactly the bad indices."""
+    rng = random.Random(0xb15ec7)
+    for trial in range(50):
+        n = rng.randint(1, 12)
+        bad = {i for i in range(n) if rng.random() < 0.4}
+        if not bad:
+            continue    # the scheduler never bisects a passing batch
+        items = [i not in bad for i in range(n)]
+        got = bisect.isolate_failures(items, all, metrics=None)
+        assert got == sorted(bad), f"trial {trial}: {bad}"
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: state_transition parity
+# ---------------------------------------------------------------------------
+
+def _phase0_signed_block(spec, state):
+    att = get_valid_attestation(spec, state, signed=True)
+    advanced = state.copy()
+    spec.process_slots(
+        advanced, uint64(state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    block = build_empty_block_for_next_slot(spec, advanced)
+    block.body.attestations.append(att)
+    scratch = advanced.copy()
+    return advanced, state_transition_and_sign_block(spec, scratch, block)
+
+
+def _apply_both(spec, state, signed):
+    inline_state = state.copy()
+    spec.state_transition(inline_state, signed)
+    pipe_state = state.copy()
+    METRICS.reset()
+    sigpipe.enable()
+    try:
+        spec.state_transition(pipe_state, signed)
+    finally:
+        sigpipe.disable()
+    return inline_state, pipe_state
+
+
+def test_phase0_block_identical_post_state(phase0_spec, phase0_state):
+    spec = phase0_spec
+    base, signed = _phase0_signed_block(spec, phase0_state)
+    inline_state, pipe_state = _apply_both(spec, base, signed)
+    assert hash_tree_root(inline_state) == hash_tree_root(pipe_state)
+    # proposer + randao + attestation, one fused dispatch, no seam misses
+    assert METRICS.count("signatures_scheduled") == 3
+    assert METRICS.count("dispatches") == 1
+    assert METRICS.count("seam_hits") == 3
+    assert METRICS.count("seam_misses") == 0
+
+
+def test_altair_block_identical_post_state(altair_spec, altair_state):
+    spec = altair_spec
+    block = build_empty_block_for_next_slot(spec, altair_state)
+    look = altair_state.copy()
+    spec.process_slots(look, block.slot)
+    block.body.sync_aggregate = get_sync_aggregate(spec, look)
+    scratch = altair_state.copy()
+    signed = state_transition_and_sign_block(spec, scratch, block)
+
+    inline_state, pipe_state = _apply_both(spec, altair_state, signed)
+    assert hash_tree_root(inline_state) == hash_tree_root(pipe_state)
+    # proposer + randao + sync aggregate in one dispatch
+    assert METRICS.count("signatures_scheduled") == 3
+    assert METRICS.count("dispatches") == 1
+    assert METRICS.count("seam_misses") == 0
+
+
+def _innermost_frame(fn):
+    try:
+        fn()
+    except AssertionError:
+        return traceback.extract_tb(sys.exc_info()[2])[-1].name
+    raise AssertionError("transition unexpectedly valid")
+
+
+def test_invalid_block_raises_at_same_boundary(altair_spec, altair_state):
+    """A wrong-key randao reveal must fail inside process_randao on both
+    paths, with identical partial state mutations — and the pipeline must
+    have isolated the bad set by bisection, not scalar fallback."""
+    spec = altair_spec
+    state = altair_state
+    block = build_empty_block_for_next_slot(spec, state)
+    look = state.copy()
+    spec.process_slots(look, block.slot)
+    epoch = spec.get_current_epoch(look)
+    root = spec.compute_signing_root(
+        uint64(epoch), spec.get_domain(look, spec.DOMAIN_RANDAO))
+    wrong_proposer = int(block.proposer_index) + 1
+    block.body.randao_reveal = bls.Sign(privkeys[wrong_proposer], root)
+    signed = sign_block(spec, state.copy(), block)
+
+    s_inline = state.copy()
+    site_inline = _innermost_frame(
+        lambda: spec.state_transition(s_inline, signed,
+                                      validate_result=False))
+    s_pipe = state.copy()
+    METRICS.reset()
+    sigpipe.enable()
+    try:
+        site_pipe = _innermost_frame(
+            lambda: spec.state_transition(s_pipe, signed,
+                                          validate_result=False))
+    finally:
+        sigpipe.disable()
+    assert site_inline == site_pipe == "process_randao"
+    assert hash_tree_root(s_inline) == hash_tree_root(s_pipe)
+    assert METRICS.count("fused_batch_failures") == 1
+    assert METRICS.count("bisect_dispatches") > 0
+    assert METRICS.count("seam_misses") == 0
+
+
+def test_invalid_deposit_is_skipped_not_raised(phase0_spec, phase0_state):
+    """Deposit signatures are valid-or-skip (proof of possession): an
+    unsigned deposit applies the block but registers no validator —
+    identically on both paths."""
+    spec = phase0_spec
+    state = phase0_state.copy()
+    new_index = len(state.validators)
+    deposit = prepare_state_and_deposit(
+        spec, state, new_index, spec.MAX_EFFECTIVE_BALANCE, signed=False)
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.deposits.append(deposit)
+    scratch = state.copy()
+    signed = state_transition_and_sign_block(spec, scratch, block)
+
+    inline_state, pipe_state = _apply_both(spec, state, signed)
+    assert hash_tree_root(inline_state) == hash_tree_root(pipe_state)
+    assert len(pipe_state.validators) == new_index   # skipped, no raise
+    assert METRICS.count("seam_misses") == 0
+    # a valid block with an invalid deposit must not look like a failed
+    # batch: valid-or-skip sets ride their own dispatch, not the product
+    assert METRICS.count("fused_batch_failures") == 0
+    assert METRICS.count("bisect_dispatches") == 0
+
+
+def test_stub_mode_verifies_nothing(phase0_spec, phase0_state):
+    """bls-disabled harness runs must stay zero-dispatch under sigpipe."""
+    spec = phase0_spec
+    state = phase0_state
+    with disable_bls():
+        block = build_empty_block_for_next_slot(spec, state)
+        inline_state = state.copy()
+        signed = state_transition_and_sign_block(spec, inline_state, block)
+        pipe_state = state.copy()
+        METRICS.reset()
+        sigpipe.enable()
+        try:
+            spec.state_transition(pipe_state, signed)
+        finally:
+            sigpipe.disable()
+    assert hash_tree_root(inline_state) == hash_tree_root(pipe_state)
+    assert METRICS.count("dispatches") == 0
+    assert METRICS.count("stubbed_batches") >= 1
+
+
+def test_caches_hit_on_reverification(phase0_spec, phase0_state):
+    spec = phase0_spec
+    base, signed = _phase0_signed_block(spec, phase0_state)
+    cache.clear()
+    sigpipe.enable()
+    try:
+        first = base.copy()
+        spec.state_transition(first, signed)
+        assert METRICS.count("aggregate_cache_misses") > 0
+        METRICS.reset()
+        again = base.copy()
+        spec.state_transition(again, signed)
+    finally:
+        sigpipe.disable()
+    # every pubkey decompression and committee aggregation is served from
+    # cache the second time through
+    assert METRICS.count("pubkey_cache_misses") == 0
+    assert METRICS.count("aggregate_cache_misses") == 0
+    assert METRICS.count("aggregate_cache_hits") > 0
+
+
+def test_verify_block_signatures_eager_api(altair_spec, altair_state):
+    spec = altair_spec
+    state = altair_state
+    block = build_empty_block_for_next_slot(spec, state)
+    scratch = state.copy()
+    signed = state_transition_and_sign_block(spec, scratch, block)
+    advanced = state.copy()
+    spec.process_slots(advanced, signed.message.slot)
+    assert sigpipe.verify_block_signatures(spec, advanced, signed) is None
+
+    bad_block = signed.message.copy()
+    bad_block.body.randao_reveal = bls.Sign(privkeys[0], b"\x42" * 32)
+    corrupted = sign_block(spec, state.copy(), bad_block)  # proposer sig ok
+    with pytest.raises(AssertionError, match="randao"):
+        sigpipe.verify_block_signatures(spec, advanced, corrupted)
