@@ -12,8 +12,8 @@ RUNNERS := shuffling ssz_static operations epoch_processing sanity bls \
 	kzg rewards finality genesis fork_choice transition ssz_generic \
 	forks merkle_proof networking kzg_7594 random light_client sync
 
-.PHONY: test test-quick test-kernels tier1 lint native pyspec bench gen_all \
-	detect_errors $(addprefix gen_,$(RUNNERS))
+.PHONY: test test-quick test-kernels tier1 chaos lint native pyspec bench \
+	gen_all detect_errors $(addprefix gen_,$(RUNNERS))
 
 # syntax/bytecode check over every package and script (the CI lint job)
 lint:
@@ -33,7 +33,7 @@ test-kernels:
 test-quick:
 	$(PYTHON) -m pytest tests/spec_suites tests/test_ssz.py \
 		tests/test_phase0_sanity.py tests/test_epoch_fast.py \
-		tests/test_sigpipe.py -q
+		tests/test_sigpipe.py tests/test_resilience.py -q
 
 # the exact ROADMAP.md tier-1 verify command (what the driver runs);
 # DOTS_PASSED counts green dots from the -q progress lines
@@ -45,6 +45,13 @@ tier1:
 		| tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; \
 	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' \
 		/tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+
+# chaos tier (resilience/): sanity-block replays under seeded fault
+# schedules with the supervisor + differential guard armed.  Excluded
+# from tier-1 by the `slow` marker; CHAOS_SEED=N reruns one schedule.
+chaos:
+	env JAX_PLATFORMS=cpu CHAOS_SEED=$${CHAOS_SEED:-20260803} \
+		$(PYTHON) -m pytest tests/test_chaos.py -q --kernel-tiers
 
 native:
 	$(PYTHON) scripts/build_native.py

@@ -519,6 +519,88 @@ def bench_block_sigs():
 
 
 # ---------------------------------------------------------------------------
+# tier: graceful degradation (resilience/) — breaker open vs closed
+# ---------------------------------------------------------------------------
+
+DEG_SETS = 16       # signature sets per degraded-tier batch
+DEG_COMMITTEE = 8   # pubkeys per set
+
+
+def bench_degraded():
+    """Cost of graceful degradation: the same signature-set batch
+    verified with the circuit breaker closed (fused accelerator
+    dispatch) vs forced open (native-oracle fallback, reason
+    `disabled`), so BENCH_*.json tracks what a tripped breaker costs in
+    throughput.  `vs_baseline` is the healthy-path speedup over the
+    degraded path — the price of losing the accelerator."""
+    from consensus_specs_tpu import resilience
+    from consensus_specs_tpu.ops import pairing_jax as pj
+    from consensus_specs_tpu.sigpipe import METRICS as SIG_METRICS
+    from consensus_specs_tpu.sigpipe import scheduler as sig_scheduler
+    from consensus_specs_tpu.sigpipe.sets import SignatureSet
+    from consensus_specs_tpu.test_infra.keys import privkeys, pubkeys
+    from consensus_specs_tpu.utils import bls as bls_shim
+
+    t_start = time.perf_counter()
+
+    def mark(msg):
+        log(f"[bench] degraded +{time.perf_counter() - t_start:5.1f}s: "
+            f"{msg}")
+
+    mark(f"building {DEG_SETS} x {DEG_COMMITTEE}-pubkey sets ...")
+    sets = []
+    for i in range(DEG_SETS):
+        ids = list(range(i, i + DEG_COMMITTEE))
+        msg = i.to_bytes(8, "little") + b"\x5d" * 24
+        sigs = [bls_shim.Sign(privkeys[x], msg) for x in ids]
+        sets.append(SignatureSet(
+            pubkeys=tuple(bytes(pubkeys[x]) for x in ids),
+            signing_root=msg, signature=bytes(bls_shim.Aggregate(sigs)),
+            kind="bench", origin=("bench", i)))
+
+    backend = os.environ.get("BENCH_DEGRADED_BACKEND", "tpu")
+    if backend == "tpu":
+        mark(f"warming TPU kernels (mode={pj._resolve_mode()}) ...")
+        pj.warmup(k=2, rows=pj._BUCKET_MIN_ROWS)
+        bls_shim.use_tpu()
+    resilience.enable()
+    try:
+        mark("warm fused dispatch (breaker closed) ...")
+        warm = sig_scheduler.verify_sets(sets)
+        assert all(warm), "degraded-tier warm-up failed"
+        mark("timed run, breaker closed ...")
+        t0 = time.perf_counter()
+        closed_verdicts = sig_scheduler.verify_sets(sets)
+        closed_time = time.perf_counter() - t0
+        assert all(closed_verdicts), "closed-path verification failed"
+
+        resilience.force_scalar(True)
+        SIG_METRICS.reset()
+        mark("timed run, breaker forced open (native fallback) ...")
+        t0 = time.perf_counter()
+        open_verdicts = sig_scheduler.verify_sets(sets)
+        open_time = time.perf_counter() - t0
+        assert all(open_verdicts), "forced-open verification failed"
+        snapshot = SIG_METRICS.snapshot()
+        assert snapshot.get("scalar_fallbacks", {}).get("disabled", 0) \
+            > 0, "forced-open run did not take the fallback path"
+        log("[bench] degraded metrics: "
+            + json.dumps(snapshot, sort_keys=True))
+    finally:
+        resilience.disable()
+        bls_shim.use_native()
+
+    return {
+        "metric": "degraded_scalar_fallback_sets_per_sec",
+        "value": round(DEG_SETS / open_time, 2),
+        "unit": (f"sets/s with breaker open ({DEG_SETS} x "
+                 f"{DEG_COMMITTEE}-pubkey sets; closed path "
+                 f"{round(DEG_SETS / closed_time, 2)} sets/s)"),
+        "vs_baseline": round(open_time / closed_time, 2),
+    }
+
+
+# ---------------------------------------------------------------------------
 # tier: the NORTH STAR (BASELINE.json): mainnet-preset state_transition
 # of a block carrying attestations + a full sync aggregate, BLS ON
 # through the TPU kernels, vs the SAME transition on the pure-python
@@ -704,13 +786,16 @@ TIERS = {
     # baseline: needs more headroom than the epoch tier
     "transition": (bench_transition, 350),
     "kzg": (bench_kzg, 300),
+    # breaker-open vs closed throughput (resilience/): key build + one
+    # kernel warm-up dominate; both timed runs are single dispatches
+    "degraded": (bench_degraded, 420),
 }
 
 # the driver's ~540s window fits merkle + ONE heavy tier — without
 # rotation, attestations/kzg/epoch/transition would never get a
 # driver-verified number (VERDICT r4 weakness #8)
 _ROTATING = ["north_star", "attestations", "block_sigs", "kzg", "epoch",
-             "transition"]
+             "transition", "degraded"]
 
 
 def _round_index() -> int:
@@ -811,7 +896,7 @@ def main():
     # most valuable completed tier wins the stdout line, by value rank
     # (rotation changes which tiers RUN, not which result headlines)
     rank = ["north_star", "attestations", "block_sigs", "kzg",
-            "transition", "epoch", "merkle"]
+            "transition", "epoch", "degraded", "merkle"]
     for name in rank:
         if name in results:
             print(json.dumps(results[name]))

@@ -182,9 +182,14 @@ class KZG:
 
         Routes through the device MSM kernel when installed and the batch
         is large enough to amortize transfer (set_device_msm); otherwise
-        the host Pippenger oracle."""
+        the host Pippenger oracle.  The device call rides the resilience
+        dispatch seam with the host oracle as supervised fallback."""
         if _device_msm is not None and len(points) >= _device_msm_threshold:
-            return cv.g1_to_bytes(_device_msm(points, scalars))
+            from ..resilience.supervisor import dispatch
+            return cv.g1_to_bytes(dispatch(
+                "ops.msm.kzg",
+                lambda: _device_msm(points, scalars),
+                lambda: msm(points, scalars)))
         return cv.g1_to_bytes(msm(points, scalars))
 
     def evaluate_polynomial_in_evaluation_form(self, polynomial: list[int],

@@ -1,0 +1,96 @@
+"""Fault-injection harness + graceful-degradation supervisor for
+accelerator dispatches.
+
+Production consensus clients treat batch verification as an optimization
+that must *degrade*, never *decide*: any doubt about the accelerated path
+falls back to the scalar oracle.  This package builds that guarantee for
+every device dispatch seam in the repo, plus the fault injection needed
+to prove it holds:
+
+* faults.py      — seeded deterministic injection of raised device
+                   errors, watchdog-visible hangs, and silent verdict
+                   corruption (transient or persistent) at named seams.
+* supervisor.py  — per-site circuit breaker: bounded retry w/ backoff,
+                   trip-to-native-scalar on persistent faults, half-open
+                   probes that restore the accelerator path; optional
+                   watchdog deadline.  `dispatch()` is the seam.
+* guard.py       — differential cross-check of sampled fused verdicts
+                   against the pure-Python oracle; quarantines the
+                   backend on mismatch (the only defense against silent
+                   corruption).
+* incidents.py   — bounded, thread-safe structured incident log; the
+                   audit trail the chaos tier asserts on.
+
+Typical production wiring:
+
+    from consensus_specs_tpu import resilience, sigpipe
+    resilience.enable(max_retries=2, breaker_threshold=3,
+                      deadline_s=30.0, guard_sample_rate=0.05)
+    sigpipe.enable()
+    spec.state_transition(state, signed_block)
+
+Chaos wiring (tests/test_chaos.py, `make chaos`):
+
+    plan = resilience.FaultPlan(
+        [resilience.FaultSpec("bls.pairing_check", "corrupt",
+                              persistent=True)], seed=7)
+    with resilience.inject(plan):
+        spec.state_transition(state, signed_block)   # still byte-identical
+"""
+from .faults import DeviceFault, FaultPlan, FaultSpec, inject
+from .incidents import INCIDENTS, IncidentLog
+from .supervisor import (
+    CLOSED, HALF_OPEN, OPEN, QUARANTINED, DispatchTimeout, Supervisor,
+    SupervisorConfig, active, dispatch, enabled,
+)
+from . import faults, guard, incidents, supervisor
+from ..sigpipe.metrics import METRICS
+
+
+def enable(config: SupervisorConfig | None = None,
+           guard_sample_rate: float | None = None,
+           guard_seed: int = 0, **overrides) -> Supervisor:
+    """Enable the supervisor and, if `guard_sample_rate` is given, the
+    differential guard, in one call.  The call describes the WHOLE
+    desired resilience state: omitting `guard_sample_rate` disables any
+    previously enabled guard (symmetric with disable())."""
+    sup = supervisor.enable(config, **overrides)
+    if guard_sample_rate is not None:
+        guard.enable(guard_sample_rate, guard_seed)
+    else:
+        guard.disable()
+    return sup
+
+
+def disable() -> None:
+    supervisor.disable()
+    guard.disable()
+
+
+def force_scalar(on: bool = True) -> None:
+    """Administratively route every dispatch to the scalar fallback
+    (reason `disabled`) — the bench `degraded` tier and operator kill
+    switches.  Requires an enabled supervisor."""
+    sup = supervisor.active()
+    if sup is None:
+        raise RuntimeError("resilience.enable() first")
+    sup.force_scalar(on)
+
+
+def report() -> dict:
+    """One JSON-able dict: metrics + breaker states + incident log."""
+    sup = supervisor.active()
+    return {
+        "metrics": METRICS.snapshot(),
+        "breakers": sup.breaker_states() if sup is not None else {},
+        "incidents": INCIDENTS.snapshot(),
+    }
+
+
+__all__ = [
+    "DeviceFault", "DispatchTimeout", "FaultPlan", "FaultSpec",
+    "IncidentLog", "INCIDENTS", "Supervisor", "SupervisorConfig",
+    "CLOSED", "OPEN", "HALF_OPEN", "QUARANTINED",
+    "active", "dispatch", "disable", "enable", "enabled", "force_scalar",
+    "inject", "report", "faults", "guard", "incidents", "supervisor",
+]

@@ -1,0 +1,168 @@
+"""Deterministic fault injection at the accelerator dispatch seams.
+
+A `FaultPlan` holds `FaultSpec`s — one per targeted dispatch site — and a
+seeded RNG; `inject(plan)` installs it so every `resilience.dispatch()`
+call consults the plan before running the device function.  Three fault
+kinds model the three ways a real accelerator dispatch goes wrong:
+
+* ``raise``   — the dispatch dies with a `DeviceFault` (XLA runtime error,
+                relay disconnect, OOM): loud, immediate.
+* ``timeout`` — the dispatch hangs: the injected function sleeps past the
+                supervisor's watchdog deadline before answering.  Without
+                a supervisor it is merely slow — exactly like a real hang.
+* ``corrupt`` — the dispatch *answers wrong*: a verdict bool (or one
+                element of a verdict list) is silently flipped.  No
+                exception, no signal — only the differential guard can
+                catch this one.
+
+Transient vs persistent: a transient spec fires on a seeded coin-flip per
+call (bounded by `max_fires`); a persistent spec fires on every call once
+triggered — the model of a wedged device that will not heal until the
+breaker quarantines it.
+
+Every fired fault is recorded in the incident log (event ``injected``)
+and counted in METRICS *by the injector itself*, so the chaos tier can
+assert "every injected fault is visible" without trusting the component
+under test to have noticed.
+
+Determinism: decisions come from `random.Random(seed)` in call order, so
+a single-threaded replay with the same plan injects the same faults at
+the same dispatches.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+from ..sigpipe.metrics import METRICS
+from .incidents import INCIDENTS
+
+KINDS = ("raise", "timeout", "corrupt")
+
+
+class DeviceFault(RuntimeError):
+    """Injected stand-in for a raised device/runtime error."""
+
+
+@dataclass
+class FaultSpec:
+    site: str                    # dispatch site name (exact match)
+    kind: str                    # "raise" | "timeout" | "corrupt"
+    rate: float = 1.0            # per-call fire probability (seeded)
+    persistent: bool = False     # once fired, fire on every later call
+    max_fires: int | None = None  # cap for transient specs (None: no cap)
+    sleep_s: float = 0.05        # hang duration for kind="timeout"
+    fires: int = field(default=0, compare=False)
+    _triggered: bool = field(default=False, compare=False)
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+
+
+def _is_bool(v) -> bool:
+    return isinstance(v, bool) or type(v).__name__ == "bool_"  # np.bool_
+
+
+def _flip_verdict(result, rng: random.Random):
+    """Corrupt a verdict-shaped result: flip a bool, or one element of a
+    list of bools.  Non-verdict payloads pass through unchanged (the
+    harness only models verdict corruption — a corrupted point batch
+    surfaces as a False product, which the `raise` path already covers)."""
+    if _is_bool(result):
+        return not bool(result)
+    if isinstance(result, list) and result and all(
+            _is_bool(v) for v in result):
+        out = [bool(v) for v in result]
+        j = rng.randrange(len(out))
+        out[j] = not out[j]
+        return out
+    return result
+
+
+class FaultPlan:
+    """Seeded schedule of faults over named dispatch sites."""
+
+    def __init__(self, specs, seed: int = 0):
+        self.specs = list(specs)
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._lock = threading.RLock()
+        by_site: dict = {}
+        for s in self.specs:
+            by_site.setdefault(s.site, []).append(s)
+        self._by_site = by_site
+
+    def _should_fire(self, spec: FaultSpec) -> bool:
+        if spec.persistent and spec._triggered:
+            return True
+        if spec.max_fires is not None and spec.fires >= spec.max_fires:
+            return False
+        if self._rng.random() >= spec.rate:
+            return False
+        spec._triggered = True
+        return True
+
+    def decide(self, site: str) -> FaultSpec | None:
+        """The spec firing at this call to `site`, if any (first match
+        wins; records the injection)."""
+        with self._lock:
+            for spec in self._by_site.get(site, ()):
+                if self._should_fire(spec):
+                    spec.fires += 1
+                    METRICS.inc("faults_injected")
+                    METRICS.inc_labeled("faults_injected_by_kind",
+                                        spec.kind)
+                    INCIDENTS.record(site, "injected", kind=spec.kind,
+                                     persistent=spec.persistent,
+                                     fire=spec.fires)
+                    return spec
+            return None
+
+    def wrap(self, site: str, fn):
+        """Device function -> possibly-faulting device function.  The
+        decision is made per CALL (at invocation time), so retries of the
+        same dispatch re-roll the schedule — a transient fault heals, a
+        persistent one keeps firing."""
+        if site not in self._by_site:
+            return fn
+
+        def faulty():
+            spec = self.decide(site)
+            if spec is None:
+                return fn()
+            if spec.kind == "raise":
+                raise DeviceFault(f"injected fault at {site} "
+                                  f"(fire {spec.fires})")
+            if spec.kind == "timeout":
+                time.sleep(spec.sleep_s)
+                return fn()
+            # corrupt: silently flip the verdict
+            return _flip_verdict(fn(), self._rng)
+        return faulty
+
+    def total_fires(self) -> int:
+        with self._lock:
+            return sum(s.fires for s in self.specs)
+
+
+_ACTIVE: FaultPlan | None = None
+
+
+def active_plan() -> FaultPlan | None:
+    return _ACTIVE
+
+
+@contextmanager
+def inject(plan: FaultPlan):
+    """Install `plan` at every dispatch seam for the duration."""
+    global _ACTIVE
+    previous = _ACTIVE
+    _ACTIVE = plan
+    try:
+        yield plan
+    finally:
+        _ACTIVE = previous

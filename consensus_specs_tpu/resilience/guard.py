@@ -1,0 +1,115 @@
+"""Differential guard: sampled cross-checks of fused verdicts against the
+per-set native oracle.
+
+Raised errors and hangs are loud; a bit-flipped pairing verdict is not.
+The fused scheduler turns ~n signature checks into one boolean product, so
+a single silent corruption can flip a whole block's validity with no
+exception anywhere.  The only defense is re-deriving a sample of verdicts
+on a path that shares no hardware with the fused dispatch: the pure-Python
+scalar oracle (crypto/bls12_381), called directly — not through the
+backend shim, not through the caches, not through any seam faults can
+reach.
+
+On a mismatch the backend is assumed compromised: the guard quarantines
+every dispatch site the fused path uses (no half-open probes — a device
+that lies cannot be trusted to self-report recovery), recomputes EVERY
+verdict in the batch through the oracle, and hands those back.  The block
+decision is therefore always made on trusted verdicts; the sample rate
+only tunes detection latency, never correctness of what was checked.
+
+`sample_rate=1.0` is the chaos-tier setting (every fused verdict checked);
+production would run low single-digit percent.
+"""
+from __future__ import annotations
+
+import random
+import threading
+
+from ..sigpipe.metrics import METRICS
+from .incidents import INCIDENTS
+
+# every site the fused pipeline's verdicts flow through; quarantined as a
+# unit on mismatch (the guard cannot attribute corruption to one kernel)
+FUSED_SITES = (
+    "bls.pairing_check",
+    "sigpipe.hash_to_g2_batch",
+    "bls.verify_batch",
+    "bls.fast_aggregate_verify_batch",
+    "bls.aggregate_verify_batch",
+)
+
+
+def oracle_verdict(s) -> bool:
+    """Scalar-oracle verdict for one SignatureSet: native FastAggregate
+    semantics (False on empty pubkeys / undecodable points), bypassing
+    the backend shim and every dispatch seam."""
+    from ..crypto import bls12_381 as native
+    if len(s.pubkeys) == 0:
+        return False
+    try:
+        return native.FastAggregateVerify(
+            [bytes(pk) for pk in s.pubkeys], bytes(s.signing_root),
+            bytes(s.signature))
+    except ValueError:
+        return False
+
+
+class DifferentialGuard:
+    def __init__(self, sample_rate: float = 0.05, seed: int = 0):
+        if not 0.0 <= sample_rate <= 1.0:
+            raise ValueError(f"sample_rate {sample_rate} not in [0, 1]")
+        self.sample_rate = sample_rate
+        self._rng = random.Random(seed)
+        self._lock = threading.RLock()
+
+    def check(self, sets, indices, verdicts) -> bool:
+        """Cross-check a sample of `verdicts` (for sets[i], i in indices)
+        against the oracle.  Returns True if the batch is trustworthy;
+        False means a mismatch was found, the backend was quarantined,
+        and the CALLER MUST recompute all verdicts via the oracle."""
+        if self.sample_rate <= 0.0 or not indices:
+            return True
+        with self._lock:
+            sampled = [i for i in indices
+                       if self._rng.random() < self.sample_rate]
+        if not sampled:
+            return True
+        METRICS.inc("guard_samples", len(sampled))
+        for i in sampled:
+            expect = oracle_verdict(sets[i])
+            if bool(verdicts[i]) != expect:
+                METRICS.inc("guard_mismatches")
+                INCIDENTS.record(
+                    "sigpipe.fused", "guard_mismatch",
+                    set_kind=sets[i].kind, got=bool(verdicts[i]),
+                    expected=expect)
+                self._quarantine_backend()
+                return False
+        return True
+
+    @staticmethod
+    def _quarantine_backend() -> None:
+        from . import supervisor
+        sup = supervisor.active()
+        if sup is None:
+            return
+        for site in FUSED_SITES:
+            sup.quarantine(site, reason="guard_mismatch")
+
+
+_ACTIVE: DifferentialGuard | None = None
+
+
+def enable(sample_rate: float = 0.05, seed: int = 0) -> DifferentialGuard:
+    global _ACTIVE
+    _ACTIVE = DifferentialGuard(sample_rate, seed)
+    return _ACTIVE
+
+
+def disable() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active() -> DifferentialGuard | None:
+    return _ACTIVE

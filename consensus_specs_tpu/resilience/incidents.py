@@ -1,0 +1,66 @@
+"""Structured incident log for the accelerator dispatch supervisor.
+
+Every noteworthy event at a dispatch seam — an injected fault, a device
+error, a watchdog timeout, a retry, a breaker trip / half-open probe /
+restore, a differential-guard mismatch, a quarantine — lands here as one
+dict with a monotonic sequence number.  The log is the audit trail the
+chaos tier asserts on: an injected fault that does NOT show up here is a
+silent failure of the harness itself.
+
+Bounded (FIFO over `max_entries`) and thread-safe: the supervisor's
+watchdog runs dispatches on worker threads, and production operators tail
+this from a metrics thread.  `snapshot()` returns plain JSON-able dicts.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+
+
+class IncidentLog:
+    def __init__(self, max_entries: int = 4096):
+        self._lock = threading.RLock()
+        self._entries: deque = deque(maxlen=max_entries)
+        self._seq = 0
+
+    def record(self, site: str, event: str, **detail) -> dict:
+        """Append one incident; returns the record (already sequenced)."""
+        with self._lock:
+            self._seq += 1
+            entry = {"seq": self._seq, "t": round(time.time(), 3),
+                     "site": site, "event": event}
+            entry.update(detail)
+            self._entries.append(entry)
+            return entry
+
+    def snapshot(self) -> list:
+        with self._lock:
+            return [dict(e) for e in self._entries]
+
+    def count(self, event: str | None = None,
+              site: str | None = None) -> int:
+        with self._lock:
+            return sum(1 for e in self._entries
+                       if (event is None or e["event"] == event)
+                       and (site is None or e["site"] == site))
+
+    def events(self, event: str) -> list:
+        with self._lock:
+            return [dict(e) for e in self._entries if e["event"] == event]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._seq = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def to_json(self) -> str:
+        return json.dumps(self.snapshot())
+
+
+INCIDENTS = IncidentLog()

@@ -8,14 +8,17 @@ pipeline into `state_transition` behind the opt-in `enable()` switch; the
 inline scalar path stays the default oracle.
 """
 from .metrics import METRICS
-from .sets import SignatureSet, collect_block_sets
+from .sets import (
+    SignatureSet, collect_block_sets, collect_pending_deposit_sets,
+)
 from .verify import (
     block_scope, compute_verdicts, disable, enable, enabled, mode,
-    verify_block_signatures,
+    pending_deposit_scope, verify_block_signatures,
 )
 
 __all__ = [
-    "METRICS", "SignatureSet", "collect_block_sets", "block_scope",
-    "compute_verdicts", "disable", "enable", "enabled", "mode",
+    "METRICS", "SignatureSet", "collect_block_sets",
+    "collect_pending_deposit_sets", "block_scope", "compute_verdicts",
+    "disable", "enable", "enabled", "mode", "pending_deposit_scope",
     "verify_block_signatures",
 ]

@@ -16,10 +16,17 @@ shuffle-permutation LRU):
   wrong aggregate.
 
 Hit/miss counters land in sigpipe.metrics.METRICS.
+
+Both caches are thread-safe (one lock each around lookup/insert/evict):
+the supervisor's watchdog runs dispatches on worker threads, and the
+gossip-path follow-up (ROADMAP) will share these caches across
+verification threads.  Point decompression runs OUTSIDE the lock — it is
+the expensive part and needs no cache state.
 """
 from __future__ import annotations
 
 import hashlib
+import threading
 
 from ..crypto import curve as cv
 from ..crypto.bls12_381 import _load_pubkey
@@ -31,27 +38,32 @@ class PubkeyCache:
         self._cache: dict = {}
         self._max = max_size
         self._metrics = metrics
+        self._lock = threading.RLock()
 
     def get(self, pubkey) -> cv.Point:
         """Decompressed, validated G1 point for compressed bytes; raises
         DecodeError/ValueError exactly like the scalar `_load_pubkey`."""
         key = bytes(pubkey)
-        point = self._cache.get(key)
+        with self._lock:
+            point = self._cache.get(key)
         if point is not None:
             self._metrics.inc("pubkey_cache_hits")
             return point
         self._metrics.inc("pubkey_cache_misses")
         point = _load_pubkey(key)   # DecodeError / ValueError propagate
-        if len(self._cache) >= self._max:
-            self._cache.pop(next(iter(self._cache)))
-        self._cache[key] = point
+        with self._lock:
+            if len(self._cache) >= self._max:
+                self._cache.pop(next(iter(self._cache)))
+            self._cache[key] = point
         return point
 
     def clear(self) -> None:
-        self._cache.clear()
+        with self._lock:
+            self._cache.clear()
 
     def __len__(self) -> int:
-        return len(self._cache)
+        with self._lock:
+            return len(self._cache)
 
 
 class AggregatePubkeyCache:
@@ -61,12 +73,14 @@ class AggregatePubkeyCache:
         self._cache: dict = {}
         self._max = max_size
         self._metrics = metrics
+        self._lock = threading.RLock()
 
     def aggregate(self, pubkey_bytes_list, hint=None) -> cv.Point:
         """Sum of the (decompressed) pubkeys; cached by content digest."""
         digest = hashlib.sha256(
             b"".join(bytes(pk) for pk in pubkey_bytes_list)).digest()
-        entry = self._cache.get(digest)
+        with self._lock:
+            entry = self._cache.get(digest)
         if entry is not None:
             self._metrics.inc("aggregate_cache_hits")
             return entry[0]
@@ -74,16 +88,19 @@ class AggregatePubkeyCache:
         agg = cv.g1_infinity()
         for pk in pubkey_bytes_list:
             agg = agg + self._pubkeys.get(pk)
-        if len(self._cache) >= self._max:
-            self._cache.pop(next(iter(self._cache)))
-        self._cache[digest] = (agg, hint)
+        with self._lock:
+            if len(self._cache) >= self._max:
+                self._cache.pop(next(iter(self._cache)))
+            self._cache[digest] = (agg, hint)
         return agg
 
     def clear(self) -> None:
-        self._cache.clear()
+        with self._lock:
+            self._cache.clear()
 
     def __len__(self) -> int:
-        return len(self._cache)
+        with self._lock:
+            return len(self._cache)
 
 
 PUBKEYS = PubkeyCache()

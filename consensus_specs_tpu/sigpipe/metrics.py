@@ -1,44 +1,77 @@
 """Lightweight pipeline metrics: counters, observations, wall-clock timers.
 
 One module-global `METRICS` registry is shared by the collector, scheduler,
-bisection and caches so a single `snapshot()` describes a whole verification
-run (batch sizes, dispatch count, bisection depth, cache hit rate) —
-dumpable as JSON for `bench.py` and asserted on by tests/test_sigpipe.py.
+bisection, caches and the resilience supervisor, so a single `snapshot()`
+describes a whole verification run (batch sizes, dispatch count, bisection
+depth, cache hit rate, breaker trips, fallback reasons) — dumpable as JSON
+for `bench.py` and asserted on by tests/test_sigpipe.py.
+
+Thread-safe: a single re-entrant lock guards every mutation and snapshot.
+The gossip-path follow-up (ROADMAP) and the supervisor's watchdog thread
+both touch the registry off the main thread; per-counter races would make
+degradation counters lie exactly when they matter.
+
+Labeled counters (`inc_labeled`) keep one counter per (name, label) pair —
+the `scalar_fallbacks` counter is labeled by degradation reason
+(`collector_miss`, `breaker_open`, `dispatch_failed`, `guard_mismatch`,
+`disabled`) so a metrics snapshot says not just that the pipeline
+degraded but why.
 """
 from __future__ import annotations
 
 import json
+import threading
 import time
 from contextlib import contextmanager
 
 
 class Metrics:
     def __init__(self):
+        self._lock = threading.RLock()
         self.reset()
 
     def reset(self) -> None:
-        self.counters: dict = {}
-        self.observations: dict = {}
-        self.timers: dict = {}
+        with self._lock:
+            self.counters: dict = {}
+            self.labeled: dict = {}
+            self.observations: dict = {}
+            self.timers: dict = {}
 
     # -- counters ------------------------------------------------------
     def inc(self, name: str, by: int = 1) -> None:
-        self.counters[name] = self.counters.get(name, 0) + by
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + by
 
     def count(self, name: str) -> int:
-        return self.counters.get(name, 0)
+        with self._lock:
+            return self.counters.get(name, 0)
+
+    # -- labeled counters (one counter per (name, label) pair) ---------
+    def inc_labeled(self, name: str, label: str, by: int = 1) -> None:
+        with self._lock:
+            series = self.labeled.setdefault(name, {})
+            series[label] = series.get(label, 0) + by
+
+    def count_labeled(self, name: str, label: str | None = None) -> int:
+        """Count for one label, or the sum across all labels of `name`."""
+        with self._lock:
+            series = self.labeled.get(name, {})
+            if label is not None:
+                return series.get(label, 0)
+            return sum(series.values())
 
     # -- observations (count/total/min/max, no per-sample storage) -----
     def observe(self, name: str, value) -> None:
-        o = self.observations.get(name)
-        if o is None:
-            self.observations[name] = {"count": 1, "total": value,
-                                       "min": value, "max": value}
-        else:
-            o["count"] += 1
-            o["total"] += value
-            o["min"] = min(o["min"], value)
-            o["max"] = max(o["max"], value)
+        with self._lock:
+            o = self.observations.get(name)
+            if o is None:
+                self.observations[name] = {"count": 1, "total": value,
+                                           "min": value, "max": value}
+            else:
+                o["count"] += 1
+                o["total"] += value
+                o["min"] = min(o["min"], value)
+                o["max"] = max(o["max"], value)
 
     # -- timers --------------------------------------------------------
     @contextmanager
@@ -47,24 +80,29 @@ class Metrics:
         try:
             yield
         finally:
-            self.timers[name] = (self.timers.get(name, 0.0)
-                                 + time.perf_counter() - t0)
+            elapsed = time.perf_counter() - t0
+            with self._lock:
+                self.timers[name] = self.timers.get(name, 0.0) + elapsed
 
     # -- export --------------------------------------------------------
     def snapshot(self) -> dict:
-        out = dict(self.counters)
-        for name, o in self.observations.items():
-            out[name] = dict(o)
-            if o["count"]:
-                out[name]["mean"] = o["total"] / o["count"]
-        for name, secs in self.timers.items():
-            out[f"{name}_sec"] = round(secs, 6)
-        # derived rates the dashboards care about
-        hits = self.count("pubkey_cache_hits")
-        misses = self.count("pubkey_cache_misses")
-        if hits + misses:
-            out["pubkey_cache_hit_rate"] = round(hits / (hits + misses), 4)
-        return out
+        with self._lock:
+            out = dict(self.counters)
+            for name, series in self.labeled.items():
+                out[name] = dict(series)
+            for name, o in self.observations.items():
+                out[name] = dict(o)
+                if o["count"]:
+                    out[name]["mean"] = o["total"] / o["count"]
+            for name, secs in self.timers.items():
+                out[f"{name}_sec"] = round(secs, 6)
+            # derived rates the dashboards care about
+            hits = self.counters.get("pubkey_cache_hits", 0)
+            misses = self.counters.get("pubkey_cache_misses", 0)
+            if hits + misses:
+                out["pubkey_cache_hit_rate"] = round(
+                    hits / (hits + misses), 4)
+            return out
 
     def to_json(self) -> str:
         return json.dumps(self.snapshot(), sort_keys=True)

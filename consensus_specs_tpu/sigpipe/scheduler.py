@@ -47,12 +47,17 @@ from .metrics import METRICS
 
 def _hash_roots(roots):
     """hash-to-G2 of every signing root; one device cofactor sweep on the
-    tpu backend, host math on native."""
+    tpu backend (supervised, host math as fallback), host math on
+    native."""
+    def host():
+        from ..crypto.hash_to_curve import hash_to_g2
+        return [hash_to_g2(r) for r in roots]
     if bls.current_backend() == "tpu":
         from ..ops.bls_tpu import hash_to_g2_batch
-        return hash_to_g2_batch(roots)
-    from ..crypto.hash_to_curve import hash_to_g2
-    return [hash_to_g2(r) for r in roots]
+        from ..resilience.supervisor import dispatch
+        return dispatch("sigpipe.hash_to_g2_batch",
+                        lambda: hash_to_g2_batch(roots), host)
+    return host()
 
 
 def _coefficients(entries):
@@ -145,6 +150,21 @@ def _verify_per_set(indices, sets, verdicts):
             verdicts[i] = bool(v)
 
 
+def _guard_verdicts(sets, verdicts):
+    """Differential guard (resilience/guard.py): cross-check a sample of
+    batch verdicts against the scalar oracle; on mismatch the backend is
+    quarantined and EVERY verdict is recomputed on the trusted path —
+    silent corruption degrades to the oracle instead of deciding."""
+    from ..resilience import guard
+    g = guard.active()
+    if g is None:
+        return verdicts
+    if g.check(sets, list(range(len(sets))), verdicts):
+        return verdicts
+    METRICS.inc_labeled("scalar_fallbacks", "guard_mismatch")
+    return [guard.oracle_verdict(s) for s in sets]
+
+
 def verify_sets(sets, mode: str = "fused"):
     """Verdict per SignatureSet.  `mode` is "fused" or "per-set"."""
     n = len(sets)
@@ -168,4 +188,5 @@ def verify_sets(sets, mode: str = "fused"):
                 _verify_per_set(lax, sets, verdicts)
         else:
             raise ValueError(f"unknown sigpipe mode {mode!r}")
+        verdicts = _guard_verdicts(sets, verdicts)
     return verdicts

@@ -245,6 +245,57 @@ def _payload_attestations(spec, state, body, out):
         _guarded(out, "payload_attestation", one)
 
 
+def collect_pending_deposit_sets(spec, state):
+    """Every deposit signature check electra's `process_pending_deposits`
+    MAY perform this epoch (EIP-6110: deposits are queued on-block and
+    applied during epoch processing, outside the block window), as
+    valid-or-skip SignatureSets — the spec skips an invalid pending
+    deposit exactly like a block deposit.
+
+    Only unknown-pubkey deposits reach `is_valid_deposit_signature` (a
+    registered pubkey takes the top-up branch), and the loop stops at the
+    first deposit past the finalized slot / eth1-bridge drain point / the
+    per-epoch cap — all statically decidable here.  The churn-limit break
+    depends on registry state mutated mid-loop, so collection
+    over-approximates it: an unused verdict is one wasted pairing inside
+    an already-batched dispatch, never a semantic difference.  A deposit
+    whose pubkey an *earlier in-batch deposit* registers is collected too
+    and simply never looked up.
+    """
+    out: list = []
+    pending = getattr(state, "pending_deposits", None)
+    if pending is None or not len(pending):
+        return out
+    registry = {bytes(v.pubkey) for v in state.validators}
+    finalized_slot = spec.compute_start_slot_at_epoch(
+        state.finalized_checkpoint.epoch)
+    for i, deposit in enumerate(pending):
+        if i >= int(spec.MAX_PENDING_DEPOSITS_PER_EPOCH):
+            break
+        if (deposit.slot > spec.GENESIS_SLOT
+                and state.eth1_deposit_index
+                < state.deposit_requests_start_index):
+            break
+        if deposit.slot > finalized_slot:
+            break
+
+        def one(out, i=i, deposit=deposit):
+            if bytes(deposit.pubkey) in registry:
+                return      # top-up: the inline path never checks it
+            message = spec.DepositMessage(
+                pubkey=deposit.pubkey,
+                withdrawal_credentials=deposit.withdrawal_credentials,
+                amount=deposit.amount)
+            domain = spec.compute_domain(spec.DOMAIN_DEPOSIT)
+            root = spec.compute_signing_root(message, domain)
+            out.append(_set([deposit.pubkey], root, deposit.signature,
+                            "pending_deposit", ("pending_deposit", i),
+                            required=False))
+        _guarded(out, "pending_deposit", one)
+    METRICS.observe("pending_deposit_sets", len(out))
+    return out
+
+
 def collect_block_sets(spec, state, signed_block):
     """Every signature check `state_transition(state, signed_block)` will
     perform, as SignatureSets.  `state` must already be advanced to the

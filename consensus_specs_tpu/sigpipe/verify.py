@@ -67,6 +67,7 @@ class VerdictMap:
         v = self._verdicts.get((pubkeys, signing_root, signature))
         if v is None:
             METRICS.inc("seam_misses")
+            METRICS.inc_labeled("scalar_fallbacks", "collector_miss")
         else:
             METRICS.inc("seam_hits")
         return v
@@ -75,20 +76,25 @@ class VerdictMap:
         return len(self._verdicts)
 
 
-def compute_verdicts(spec, state, signed_block):
-    """Collect + batch-verify every signature check in `signed_block`;
-    returns (VerdictMap, collected sets, per-set verdict list)."""
-    block_sets = sets.collect_block_sets(spec, state, signed_block)
-    # identical checks (same pubkeys/root/signature) verify once
+def _batch_verify_unique(collected):
+    """Dedup identical checks (same pubkeys/root/signature verify once),
+    batch-verify, and return the content-keyed verdict dict."""
     unique: dict = {}
-    for s in block_sets:
+    for s in collected:
         unique.setdefault(s.key(), s)
-    dropped = len(block_sets) - len(unique)
+    dropped = len(collected) - len(unique)
     if dropped:
         METRICS.inc("dedup_saved", dropped)
     unique_sets = list(unique.values())
     unique_verdicts = scheduler.verify_sets(unique_sets, mode=_mode)
-    by_key = {s.key(): v for s, v in zip(unique_sets, unique_verdicts)}
+    return {s.key(): v for s, v in zip(unique_sets, unique_verdicts)}
+
+
+def compute_verdicts(spec, state, signed_block):
+    """Collect + batch-verify every signature check in `signed_block`;
+    returns (VerdictMap, collected sets, per-set verdict list)."""
+    block_sets = sets.collect_block_sets(spec, state, signed_block)
+    by_key = _batch_verify_unique(block_sets)
     return (VerdictMap(by_key), block_sets,
             [by_key[s.key()] for s in block_sets])
 
@@ -119,9 +125,31 @@ def block_scope(spec, state, signed_block):
     if vm is None:
         yield
         return
-    previous = spec._sigpipe_verdicts
-    spec._sigpipe_verdicts = vm
-    try:
+    with spec.install_sigpipe_verdicts(vm):
         yield
-    finally:
-        spec._sigpipe_verdicts = previous
+
+
+@contextmanager
+def pending_deposit_scope(spec, state):
+    """Install batch verdicts for electra's epoch-boundary pending
+    deposits (EIP-6110) around `process_pending_deposits`: the per-epoch
+    prefix of `state.pending_deposits` is collected and verified as one
+    valid-or-skip batch, and `is_valid_deposit_signature`'s seam consumes
+    the verdicts at the inline call sites.  Same degradation contract as
+    block_scope: any pipeline failure falls back to scalar verification.
+    """
+    if not _enabled:
+        yield
+        return
+    try:
+        dep_sets = sets.collect_pending_deposit_sets(spec, state)
+        vm = VerdictMap(_batch_verify_unique(dep_sets)) if dep_sets \
+            else None
+    except Exception:
+        METRICS.inc("pipeline_errors")
+        vm = None
+    if vm is None:
+        yield
+        return
+    with spec.install_sigpipe_verdicts(vm):
+        yield

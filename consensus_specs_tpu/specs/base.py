@@ -9,7 +9,7 @@ wins) directly in Python, with `super()` for upgrade deltas.
 """
 from __future__ import annotations
 
-
+from contextlib import contextmanager
 
 from ..config import Config, load_config, load_preset
 from ..utils import bls
@@ -63,6 +63,19 @@ class BaseSpec:
     # miss — a check the collector didn't predict — falls back to the
     # scalar backend, so routing through the seam can never change
     # behavior.
+
+    @contextmanager
+    def install_sigpipe_verdicts(self, verdict_map):
+        """Install a sigpipe VerdictMap on this spec instance for the
+        duration (nestable: the previous map — usually None — is
+        restored on exit).  Both the block window (sigpipe block_scope)
+        and electra's epoch-boundary pending-deposit batch ride this."""
+        previous = self._sigpipe_verdicts
+        self._sigpipe_verdicts = verdict_map
+        try:
+            yield
+        finally:
+            self._sigpipe_verdicts = previous
 
     def bls_verify(self, pubkey, signing_root, signature) -> bool:
         verdicts = self._sigpipe_verdicts

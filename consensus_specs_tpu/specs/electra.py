@@ -522,7 +522,15 @@ class ElectraSpec(DenebSpec):
 
     def process_pending_deposits(self, state) -> None:
         """Finalization/churn-bounded pending-deposit application
-        (electra/beacon-chain.md:894)."""
+        (electra/beacon-chain.md:894).  With sigpipe enabled, the
+        epoch's deposit signature checks are batch-verified up front
+        (valid-or-skip, like block deposits) and consumed at the
+        `is_valid_deposit_signature` seam inside the loop."""
+        from ..sigpipe import verify as sigpipe_verify
+        with sigpipe_verify.pending_deposit_scope(self, state):
+            self._process_pending_deposits_inline(state)
+
+    def _process_pending_deposits_inline(self, state) -> None:
         next_epoch = uint64(self.get_current_epoch(state) + 1)
         available_for_processing = (
             int(state.deposit_balance_to_consume)

@@ -118,6 +118,22 @@ def set_subtree_hasher(fn, threshold: int = 1 << 14) -> None:
 # vectorized native hasher lands.
 
 
+def _dispatch(site, device_fn, fallback_fn):
+    """Resilience seam for the installed device hashers (lazy import —
+    hash_tree_root must stay importable before the heavier packages)."""
+    from ..resilience.supervisor import dispatch
+    return dispatch(site, device_fn, fallback_fn)
+
+
+def _host_subtree_root(level: bytes, sub_depth: int) -> bytes:
+    """hashlib fallback for a whole populated subtree: the plain level
+    loop the subtree hasher replaces."""
+    for _ in range(sub_depth):
+        level = _hash_level_python(level)
+    assert len(level) == 32
+    return level
+
+
 def merkleize_chunks(chunks: Sequence[bytes], limit: int | None = None) -> bytes:
     """Merkle root of `chunks`, virtually padded with zero chunks.
 
@@ -150,7 +166,10 @@ def merkleize_chunks(chunks: Sequence[bytes], limit: int | None = None) -> bytes
         sub_depth = chunk_depth(count)
         if padded != count:
             level += bytes(32) * (padded - count)
-        root = _subtree_hasher(level, sub_depth)
+        root = _dispatch(
+            "ops.sha256.subtree",
+            lambda: _subtree_hasher(level, sub_depth),
+            lambda: _host_subtree_root(level, sub_depth))
         for d in range(sub_depth, depth):
             root = hash_pair(root, ZERO_HASHES[d])
         return root
@@ -161,7 +180,10 @@ def merkleize_chunks(chunks: Sequence[bytes], limit: int | None = None) -> bytes
             level += ZERO_HASHES[d]
             n += 1
         if _bulk_hash_level is not None and n // 2 >= _bulk_threshold:
-            level = _bulk_hash_level(level)
+            data = level
+            level = _dispatch("ops.sha256.hash_level",
+                              lambda: _bulk_hash_level(data),
+                              lambda: _hash_level_python(data))
         else:
             level = _hash_level(level)
     assert len(level) == 32

@@ -70,25 +70,31 @@ def _tpu():
     return t
 
 
+def _dispatch(site, device_fn, fallback_fn):
+    """Route one accelerator dispatch through the resilience seam
+    (fault injection + circuit-breaker supervision when enabled; a plain
+    call otherwise).  Lazy import: resilience pulls in sigpipe.metrics,
+    and importing it at module scope would cycle through sigpipe ->
+    scheduler -> this module."""
+    from ..resilience.supervisor import dispatch
+    return dispatch(site, device_fn, fallback_fn)
+
+
 # --- signature API (reference: bls.py:141-221) -----------------------------
 
-@only_with_bls(alt_return=True)
-def Verify(PK, message, signature):
-    if _backend_name == "tpu":
-        return _tpu().Verify(bytes(PK), bytes(message), bytes(signature))
-    n = _native()  # backend import errors must surface, not read as "invalid"
+# Native scalar paths, shared between the default backend branch and the
+# supervised fallback of every device dispatch (byte-identical semantics:
+# backend import errors surface, DecodeError/ValueError reads as invalid).
+
+def _native_verify(PK, message, signature):
+    n = _native()
     try:
         return n.Verify(bytes(PK), bytes(message), bytes(signature))
     except ValueError:
         return False
 
 
-@only_with_bls(alt_return=True)
-def AggregateVerify(pubkeys, messages, signature):
-    if _backend_name == "tpu":
-        return _tpu().AggregateVerify(
-            [bytes(pk) for pk in pubkeys],
-            [bytes(m) for m in messages], bytes(signature))
+def _native_aggregate_verify(pubkeys, messages, signature):
     n = _native()
     try:
         return n.AggregateVerify(
@@ -98,17 +104,50 @@ def AggregateVerify(pubkeys, messages, signature):
         return False
 
 
-@only_with_bls(alt_return=True)
-def FastAggregateVerify(pubkeys, message, signature):
-    if _backend_name == "tpu":
-        return _tpu().FastAggregateVerify(
-            [bytes(pk) for pk in pubkeys], bytes(message), bytes(signature))
+def _native_fast_aggregate_verify(pubkeys, message, signature):
     n = _native()
     try:
         return n.FastAggregateVerify(
-            [bytes(pk) for pk in pubkeys], bytes(message), bytes(signature))
+            [bytes(pk) for pk in pubkeys], bytes(message),
+            bytes(signature))
     except ValueError:
         return False
+
+
+@only_with_bls(alt_return=True)
+def Verify(PK, message, signature):
+    if _backend_name == "tpu":
+        return _dispatch(
+            "bls.verify",
+            lambda: _tpu().Verify(bytes(PK), bytes(message),
+                                  bytes(signature)),
+            lambda: _native_verify(PK, message, signature))
+    return _native_verify(PK, message, signature)
+
+
+@only_with_bls(alt_return=True)
+def AggregateVerify(pubkeys, messages, signature):
+    if _backend_name == "tpu":
+        return _dispatch(
+            "bls.aggregate_verify",
+            lambda: _tpu().AggregateVerify(
+                [bytes(pk) for pk in pubkeys],
+                [bytes(m) for m in messages], bytes(signature)),
+            lambda: _native_aggregate_verify(pubkeys, messages, signature))
+    return _native_aggregate_verify(pubkeys, messages, signature)
+
+
+@only_with_bls(alt_return=True)
+def FastAggregateVerify(pubkeys, message, signature):
+    if _backend_name == "tpu":
+        return _dispatch(
+            "bls.fast_aggregate_verify",
+            lambda: _tpu().FastAggregateVerify(
+                [bytes(pk) for pk in pubkeys], bytes(message),
+                bytes(signature)),
+            lambda: _native_fast_aggregate_verify(pubkeys, message,
+                                                  signature))
+    return _native_fast_aggregate_verify(pubkeys, message, signature)
 
 
 # --- batched verification (TPU-native extension; one device dispatch for a
@@ -128,35 +167,42 @@ def _sig_bytes(sig):
     return bytes(sig)
 
 
-def _stub_or_dispatch(n_jobs, tpu_fn, native_fn):
+def _stub_or_dispatch(site, n_jobs, tpu_fn, native_fn):
     """Shared batch-API contract: with bls disabled every job reads as
     valid (the scalar APIs' stub-True semantics — one helper so the three
     batch entry points can't drift), the tpu backend runs all pairings as
-    one batched kernel dispatch, and native falls back per-job."""
+    one batched kernel dispatch, and native falls back per-job.
+
+    The batch boundary is a resilience dispatch seam on EVERY backend
+    (it is where a whole block's verdicts ride one call), with the
+    per-job native loop as the supervised fallback — so a fault-injection
+    chaos run and a wedged device both degrade to the scalar oracle
+    instead of deciding block validity."""
     if not bls_active:
         return [True] * n_jobs
-    if _backend_name == "tpu":
-        return tpu_fn()
-    return native_fn()
+    device_fn = tpu_fn if _backend_name == "tpu" else native_fn
+    return _dispatch(site, device_fn, native_fn)
 
 
 def FastAggregateVerifyBatch(pubkey_lists, messages, signatures):
     """Verdict list for many FastAggregateVerify jobs."""
     return _stub_or_dispatch(
+        "bls.fast_aggregate_verify_batch",
         len(pubkey_lists),
         lambda: _tpu().fast_aggregate_verify_batch(
             pubkey_lists, messages, signatures),
-        lambda: [FastAggregateVerify([_pk_bytes(pk) for pk in pks], m,
-                                     _sig_bytes(s))
+        lambda: [_native_fast_aggregate_verify(
+                     [_pk_bytes(pk) for pk in pks], m, _sig_bytes(s))
                  for pks, m, s in zip(pubkey_lists, messages, signatures)])
 
 
 def VerifyBatch(pubkeys, messages, signatures):
     """Verdict list for many independent Verify jobs."""
     return _stub_or_dispatch(
+        "bls.verify_batch",
         len(pubkeys),
         lambda: _tpu().verify_batch(pubkeys, messages, signatures),
-        lambda: [Verify(_pk_bytes(pk), m, _sig_bytes(s))
+        lambda: [_native_verify(_pk_bytes(pk), m, _sig_bytes(s))
                  for pk, m, s in zip(pubkeys, messages, signatures)])
 
 
@@ -164,11 +210,12 @@ def AggregateVerifyBatch(pubkey_lists, message_lists, signatures):
     """Verdict list for many AggregateVerify jobs (distinct message per
     pubkey within each job)."""
     return _stub_or_dispatch(
+        "bls.aggregate_verify_batch",
         len(pubkey_lists),
         lambda: _tpu().aggregate_verify_batch(
             pubkey_lists, message_lists, signatures),
-        lambda: [AggregateVerify([_pk_bytes(pk) for pk in pks], ms,
-                                 _sig_bytes(s))
+        lambda: [_native_aggregate_verify(
+                     [_pk_bytes(pk) for pk in pks], ms, _sig_bytes(s))
                  for pks, ms, s in zip(pubkey_lists, message_lists,
                                        signatures)])
 
@@ -220,7 +267,8 @@ MULTI_EXP_DEVICE_THRESHOLD = 128
 def multi_exp(points, integers):
     """Multi-scalar multiplication over G1 or G2 points (the reference's
     arkworks multiexp slot, bls.py:224-296).  The tpu backend routes big
-    G1/G2 batches through the device MSM kernel."""
+    G1/G2 batches through the device MSM kernel, supervised with the host
+    Pippenger oracle as fallback."""
     if (_backend_name == "tpu"
             and len(points) >= MULTI_EXP_DEVICE_THRESHOLD):
         from ..crypto import curve as cv
@@ -228,15 +276,27 @@ def multi_exp(points, integers):
         first = points[0]
         if isinstance(first, cv.Point):
             if isinstance(first.x, cv.Fq1):
-                return device_msm.g1_multi_exp(points, integers)
-            return device_msm.g2_multi_exp(points, integers)
+                return _dispatch(
+                    "ops.msm.g1",
+                    lambda: device_msm.g1_multi_exp(points, integers),
+                    lambda: _native().multi_exp(points, integers))
+            return _dispatch(
+                "ops.msm.g2",
+                lambda: device_msm.g2_multi_exp(points, integers),
+                lambda: _native().multi_exp(points, integers))
     return _native().multi_exp(points, integers)
 
 
 def pairing_check(values) -> bool:
+    """Combined pairing-product check — the fused scheduler's single
+    device dispatch rides this seam, so a hung or lying pairing kernel
+    degrades to the host oracle instead of deciding block validity."""
     if _backend_name == "tpu":
-        return _tpu().pairing_check_points(values)
-    return _native().pairing_check(values)
+        device_fn = lambda: _tpu().pairing_check_points(values)  # noqa: E731
+    else:
+        device_fn = lambda: _native().pairing_check(values)      # noqa: E731
+    return _dispatch("bls.pairing_check", device_fn,
+                     lambda: _native().pairing_check(values))
 
 
 def G1_to_bytes48(point) -> bytes:

@@ -1,0 +1,229 @@
+"""Chaos tier: sanity block replays under randomized fault schedules
+(`make chaos`; excluded from tier-1 via the `slow` marker).
+
+Every case replays a signed sanity block through `state_transition` with
+sigpipe enabled, the resilience supervisor + differential guard armed,
+and a seeded fault schedule injected at the accelerator dispatch seams —
+then asserts the three invariants the resilience subsystem promises:
+
+  1. the post-state root is byte-identical to the pure-native run
+     (faults degrade, they never decide);
+  2. no unhandled exception escapes `state_transition` while the
+     supervisor is enabled;
+  3. every injected fault is visible: the incident log records each
+     injection, and breaker trips/restores show in the metrics JSON.
+
+The schedule seed is fixed (CHAOS_SEED env override) so a failure
+reproduces exactly.
+"""
+import json
+import os
+import random
+
+import pytest
+
+from consensus_specs_tpu import resilience, sigpipe
+from consensus_specs_tpu.resilience import (
+    FaultPlan, FaultSpec, INCIDENTS, faults,
+)
+from consensus_specs_tpu.sigpipe import METRICS
+from consensus_specs_tpu.specs import get_spec
+from consensus_specs_tpu.ssz import hash_tree_root, uint64
+from consensus_specs_tpu.test_infra.attestations import get_valid_attestation
+from consensus_specs_tpu.test_infra.blocks import (
+    build_empty_block_for_next_slot, sign_block,
+    state_transition_and_sign_block)
+from consensus_specs_tpu.test_infra.genesis import (
+    create_genesis_state, default_balances)
+from consensus_specs_tpu.test_infra.keys import privkeys
+from consensus_specs_tpu.utils import bls
+
+pytestmark = pytest.mark.slow
+
+CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "20260803"))
+
+# the dispatch sites a native-backend replay actually reaches (tpu-only
+# seams like sigpipe.hash_to_g2_batch are covered by unit tests)
+SITES = ("bls.pairing_check", "bls.verify_batch",
+         "bls.fast_aggregate_verify_batch")
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_spec("altair", "minimal")
+
+
+@pytest.fixture(scope="module")
+def workload(spec):
+    """(pre_state, signed_block, native_post_root): one attestation-
+    carrying sanity block and the pure-native transition baseline."""
+    state = create_genesis_state(spec, default_balances(spec))
+    spec.process_slots(state, uint64(spec.SLOTS_PER_EPOCH + 2))
+    att = get_valid_attestation(spec, state, signed=True)
+    advanced = state.copy()
+    spec.process_slots(
+        advanced, uint64(state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    block = build_empty_block_for_next_slot(spec, advanced)
+    block.body.attestations.append(att)
+    scratch = advanced.copy()
+    signed = state_transition_and_sign_block(spec, scratch, block)
+    native_state = advanced.copy()
+    spec.state_transition(native_state, signed)
+    return advanced, signed, hash_tree_root(native_state)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    resilience.disable()
+    sigpipe.disable()
+    INCIDENTS.clear()
+    METRICS.reset()
+    yield
+    resilience.disable()
+    sigpipe.disable()
+    INCIDENTS.clear()
+
+
+def _replay(spec, workload, plan, mode="fused", deadline_s=None):
+    """One supervised, guarded, fault-injected transition; returns the
+    metrics snapshot after asserting the core invariants."""
+    pre_state, signed, native_root = workload
+    resilience.enable(max_retries=1, breaker_threshold=1, probe_after=2,
+                      deadline_s=deadline_s,
+                      guard_sample_rate=1.0, guard_seed=CHAOS_SEED)
+    sigpipe.enable(mode=mode)
+    chaos_state = pre_state.copy()
+    try:
+        with faults.inject(plan):
+            # invariant 2: no unhandled exception escapes
+            spec.state_transition(chaos_state, signed)
+    finally:
+        sigpipe.disable()
+    # invariant 1: byte-identical post-state
+    assert hash_tree_root(chaos_state) == native_root
+    # invariant 3a: every injected fault is in the incident log
+    snapshot = METRICS.snapshot()
+    assert INCIDENTS.count(event="injected") == plan.total_fires()
+    assert snapshot.get("faults_injected", 0) == plan.total_fires()
+    json.dumps(snapshot)    # the metrics snapshot is one JSON document
+    return snapshot
+
+
+@pytest.mark.parametrize("kind", ["raise", "timeout", "corrupt"])
+@pytest.mark.parametrize("persistent", [False, True],
+                         ids=["transient", "persistent"])
+def test_chaos_fault_matrix(spec, workload, kind, persistent):
+    """raise / timeout / corrupt × transient / persistent at the fused
+    pairing seam: state identical, faults logged, and persistent loud
+    faults visibly trip the breaker."""
+    plan = FaultPlan(
+        [FaultSpec("bls.pairing_check", kind, persistent=persistent,
+                   max_fires=None if persistent else 2,
+                   sleep_s=0.2)],
+        seed=CHAOS_SEED)
+    snapshot = _replay(spec, workload, plan,
+                       deadline_s=0.05 if kind == "timeout" else None)
+    assert plan.total_fires() > 0
+    if persistent and kind in ("raise", "timeout"):
+        # invariant 3b: the trip is visible in the metrics JSON
+        assert snapshot["breaker_trips"] >= 1
+        assert snapshot["scalar_fallbacks"]["breaker_open"] >= 1
+        assert resilience.report()["breakers"][
+            "bls.pairing_check"] == resilience.OPEN
+    if persistent and kind == "corrupt":
+        # silent corruption: only the guard can catch it — and it did
+        assert snapshot["guard_mismatches"] >= 1
+        assert resilience.report()["breakers"][
+            "bls.pairing_check"] == resilience.QUARANTINED
+
+
+def test_chaos_breaker_recovery_across_blocks(spec, workload):
+    """A transient device outage trips the breaker; a later replay probes
+    half-open and restores the accelerator path — trip AND recovery both
+    visible in the metrics JSON."""
+    pre_state, signed, native_root = workload
+    resilience.enable(max_retries=0, breaker_threshold=1, probe_after=1,
+                      guard_sample_rate=1.0, guard_seed=CHAOS_SEED)
+    sigpipe.enable()
+    plan = FaultPlan(
+        [FaultSpec("bls.pairing_check", "raise", max_fires=1)],
+        seed=CHAOS_SEED)
+    try:
+        for _ in range(3):      # outage block, probe block, healthy block
+            chaos_state = pre_state.copy()
+            with faults.inject(plan):
+                spec.state_transition(chaos_state, signed)
+            assert hash_tree_root(chaos_state) == native_root
+    finally:
+        sigpipe.disable()
+    snapshot = METRICS.snapshot()
+    assert snapshot["breaker_trips"] >= 1
+    assert snapshot["breaker_restores"] >= 1
+    assert INCIDENTS.count(event="trip") >= 1
+    assert INCIDENTS.count(event="restore") >= 1
+    assert resilience.report()["breakers"][
+        "bls.pairing_check"] == resilience.CLOSED
+
+
+def test_chaos_randomized_schedules(spec, workload):
+    """Seeded random multi-site schedules (kind, persistence, rate drawn
+    per site): whatever fires, the three invariants hold."""
+    rng = random.Random(CHAOS_SEED)
+    for round_i in range(5):
+        INCIDENTS.clear()
+        METRICS.reset()
+        specs = []
+        for site in SITES:
+            if rng.random() < 0.4:
+                continue
+            kind = rng.choice(["raise", "timeout", "corrupt"])
+            specs.append(FaultSpec(
+                site, kind,
+                rate=rng.choice([0.3, 0.7, 1.0]),
+                persistent=rng.random() < 0.5,
+                max_fires=rng.choice([1, 3, None]),
+                sleep_s=0.1))
+        plan = FaultPlan(specs, seed=rng.randrange(1 << 30))
+        _replay(spec, workload, plan,
+                mode=rng.choice(["fused", "per-set"]),
+                deadline_s=0.05)
+        resilience.disable()
+
+
+def test_chaos_invalid_block_same_boundary_under_faults(spec, workload):
+    """An actually-invalid block must still fail at the same operation
+    boundary with the same partial state mutations while faults fly —
+    degradation never converts invalid into valid (or vice versa)."""
+    pre_state, _signed, _root = workload
+    block = build_empty_block_for_next_slot(spec, pre_state)
+    look = pre_state.copy()
+    spec.process_slots(look, block.slot)
+    epoch = spec.get_current_epoch(look)
+    root = spec.compute_signing_root(
+        uint64(epoch), spec.get_domain(look, spec.DOMAIN_RANDAO))
+    block.body.randao_reveal = bls.Sign(
+        privkeys[int(block.proposer_index) + 1], root)
+    bad_signed = sign_block(spec, pre_state.copy(), block)
+
+    native_state = pre_state.copy()
+    with pytest.raises(AssertionError):
+        spec.state_transition(native_state, bad_signed,
+                              validate_result=False)
+
+    resilience.enable(max_retries=1, breaker_threshold=1, probe_after=2,
+                      guard_sample_rate=1.0, guard_seed=CHAOS_SEED)
+    sigpipe.enable()
+    plan = FaultPlan(
+        [FaultSpec("bls.pairing_check", "corrupt", persistent=True)],
+        seed=CHAOS_SEED)
+    chaos_state = pre_state.copy()
+    try:
+        with faults.inject(plan):
+            with pytest.raises(AssertionError):
+                spec.state_transition(chaos_state, bad_signed,
+                                      validate_result=False)
+    finally:
+        sigpipe.disable()
+    assert hash_tree_root(chaos_state) == hash_tree_root(native_state)
+    assert plan.total_fires() > 0
+    assert INCIDENTS.count(event="injected") == plan.total_fires()

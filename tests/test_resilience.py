@@ -1,0 +1,440 @@
+"""Fault-injection harness + graceful-degradation supervisor
+(consensus_specs_tpu/resilience/).
+
+Unit coverage of the breaker state machine (retry / trip / half-open
+probe / restore / quarantine / forced-scalar), the watchdog deadline, the
+seeded fault injector (determinism, transient vs persistent, corrupt
+flips), the structured incident log, the thread-safe labeled metrics, and
+the differential guard — plus scheduler-level integration: injected
+faults at the fused pipeline's dispatch sites must degrade to correct
+verdicts, never decide them.  The full randomized block-replay chaos
+tier lives in tests/test_chaos.py (`make chaos`).
+"""
+import json
+import threading
+
+import pytest
+
+from consensus_specs_tpu import resilience
+from consensus_specs_tpu.resilience import (
+    CLOSED, HALF_OPEN, OPEN, QUARANTINED, DeviceFault, DispatchTimeout,
+    FaultPlan, FaultSpec, INCIDENTS, faults, guard, supervisor,
+)
+from consensus_specs_tpu.sigpipe import METRICS, scheduler
+from consensus_specs_tpu.sigpipe.sets import SignatureSet
+from consensus_specs_tpu.test_infra.keys import privkeys, pubkeys
+from consensus_specs_tpu.utils import bls
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    resilience.disable()
+    INCIDENTS.clear()
+    METRICS.reset()
+    yield
+    resilience.disable()
+    INCIDENTS.clear()
+
+
+def _boom():
+    raise RuntimeError("boom")
+
+
+# ---------------------------------------------------------------------------
+# dispatch seam, unsupervised
+# ---------------------------------------------------------------------------
+
+def test_unsupervised_dispatch_is_transparent():
+    assert resilience.dispatch("t.site", lambda: 42, lambda: -1) == 42
+    with pytest.raises(RuntimeError, match="boom"):
+        resilience.dispatch("t.site", _boom, lambda: -1)
+
+
+def test_unsupervised_injected_fault_escapes():
+    """Without a supervisor, an injected device error propagates raw —
+    the failure mode this subsystem exists to remove."""
+    plan = FaultPlan([FaultSpec("t.site", "raise")], seed=1)
+    with faults.inject(plan):
+        with pytest.raises(DeviceFault):
+            resilience.dispatch("t.site", lambda: 42, lambda: -1)
+    assert INCIDENTS.count(event="injected") == 1
+
+
+# ---------------------------------------------------------------------------
+# breaker state machine
+# ---------------------------------------------------------------------------
+
+def test_transient_fault_absorbed_by_retry():
+    resilience.enable(max_retries=2, breaker_threshold=2)
+    plan = FaultPlan([FaultSpec("t.site", "raise", max_fires=1)], seed=1)
+    with faults.inject(plan):
+        assert resilience.dispatch("t.site", lambda: 42, lambda: -1) == 42
+    assert supervisor.active().breaker_state("t.site") == CLOSED
+    assert METRICS.count("dispatch_retries") == 1
+    assert INCIDENTS.count(event="retry_recovered") == 1
+
+
+def test_persistent_fault_trips_breaker_and_falls_back():
+    sup = resilience.enable(max_retries=1, breaker_threshold=2,
+                            probe_after=1000)
+    plan = FaultPlan([FaultSpec("t.site", "raise", persistent=True)],
+                     seed=1)
+    with faults.inject(plan):
+        for _ in range(2):      # failures reach the threshold
+            assert resilience.dispatch(
+                "t.site", lambda: 42, lambda: -1) == -1
+        assert sup.breaker_state("t.site") == OPEN
+        # while OPEN the device path is never attempted
+        fires_before = plan.total_fires()
+        assert resilience.dispatch("t.site", _boom, lambda: -1) == -1
+        assert plan.total_fires() == fires_before
+    assert METRICS.count("breaker_trips") == 1
+    # reasons track what the breaker actually did: the pre-threshold
+    # failure is dispatch_failed, the trip call + open-state call are
+    # breaker_open — the snapshot never claims an open breaker that the
+    # state map contradicts
+    assert METRICS.count_labeled("scalar_fallbacks",
+                                 "dispatch_failed") == 1
+    assert METRICS.count_labeled("scalar_fallbacks", "breaker_open") == 2
+    assert INCIDENTS.count(event="trip") == 1
+
+
+def test_half_open_probe_restores_accelerator_path():
+    sup = resilience.enable(max_retries=0, breaker_threshold=1,
+                            probe_after=2)
+    plan = FaultPlan([FaultSpec("t.site", "raise", max_fires=1)], seed=1)
+    with faults.inject(plan):
+        assert resilience.dispatch("t.site", lambda: 42, lambda: -1) == -1
+        assert sup.breaker_state("t.site") == OPEN
+        # two fallback calls in OPEN, then the next call probes
+        assert resilience.dispatch("t.site", lambda: 42, lambda: -1) == -1
+        assert resilience.dispatch("t.site", lambda: 42, lambda: -1) == 42
+    assert sup.breaker_state("t.site") == CLOSED
+    assert METRICS.count("breaker_probes") == 1
+    assert METRICS.count("breaker_restores") == 1
+    assert INCIDENTS.count(event="restore") == 1
+
+
+def test_failed_probe_reopens_breaker():
+    sup = resilience.enable(max_retries=0, breaker_threshold=1,
+                            probe_after=1)
+    plan = FaultPlan([FaultSpec("t.site", "raise", persistent=True)],
+                     seed=1)
+    with faults.inject(plan):
+        assert resilience.dispatch("t.site", lambda: 42, lambda: -1) == -1
+        assert sup.breaker_state("t.site") == OPEN
+        assert resilience.dispatch("t.site", lambda: 42, lambda: -1) == -1
+        assert sup.breaker_state("t.site") == OPEN
+    assert METRICS.count("breaker_probe_failures") == 1
+
+
+def test_quarantine_never_probes_until_reset():
+    sup = resilience.enable(probe_after=0)
+    sup.quarantine("t.site")
+    assert sup.breaker_state("t.site") == QUARANTINED
+    for _ in range(20):
+        assert resilience.dispatch("t.site", _boom, lambda: -1) == -1
+    assert sup.breaker_state("t.site") == QUARANTINED
+    assert METRICS.count_labeled("scalar_fallbacks",
+                                 "guard_mismatch") == 20
+    sup.reset("t.site")
+    assert sup.breaker_state("t.site") == CLOSED
+    assert resilience.dispatch("t.site", lambda: 7, lambda: -1) == 7
+
+
+def test_quarantine_reason_labels_every_forced_fallback():
+    sup = resilience.enable()
+    sup.quarantine("t.site", reason="operator_hold")
+    for _ in range(3):
+        assert resilience.dispatch("t.site", _boom, lambda: -1) == -1
+    assert METRICS.count_labeled("scalar_fallbacks",
+                                 "operator_hold") == 3
+    assert INCIDENTS.events("quarantine")[0]["reason"] == "operator_hold"
+
+
+def test_enable_without_guard_rate_disables_stale_guard():
+    resilience.enable(guard_sample_rate=1.0)
+    assert guard.active() is not None
+    resilience.enable(max_retries=5)     # fresh supervisor, no guard arg
+    assert guard.active() is None
+
+
+def test_force_scalar_labels_disabled():
+    resilience.enable()
+    resilience.force_scalar(True)
+    assert resilience.dispatch("t.site", _boom, lambda: -1) == -1
+    assert METRICS.count_labeled("scalar_fallbacks", "disabled") == 1
+    resilience.force_scalar(False)
+    assert resilience.dispatch("t.site", lambda: 9, lambda: -1) == 9
+
+
+def test_watchdog_deadline_times_out_hung_dispatch():
+    resilience.enable(max_retries=0, breaker_threshold=1,
+                      deadline_s=0.05)
+    plan = FaultPlan([FaultSpec("t.site", "timeout", persistent=True,
+                                sleep_s=0.5)], seed=1)
+    with faults.inject(plan):
+        assert resilience.dispatch("t.site", lambda: 42, lambda: -1) == -1
+    assert supervisor.active().breaker_state("t.site") == OPEN
+    assert METRICS.count("watchdog_timeouts") == 1
+    assert INCIDENTS.count(event="timeout") == 1
+
+
+def test_watchdog_worker_is_reused_across_healthy_calls():
+    """The watchdog must not spawn a thread per dispatch: healthy calls
+    share one long-lived per-site worker; only an expired deadline
+    abandons it and provisions a fresh one."""
+    sup = resilience.enable(max_retries=0, breaker_threshold=100,
+                            deadline_s=0.05)
+    for i in range(10):
+        assert resilience.dispatch("t.site", lambda i=i: i,
+                                   lambda: -1) == i
+    assert len(sup._workers) == 1
+    first = sup._workers["t.site"]
+    plan = FaultPlan([FaultSpec("t.site", "timeout", max_fires=1,
+                                sleep_s=0.5)], seed=1)
+    with faults.inject(plan):
+        assert resilience.dispatch("t.site", lambda: 42, lambda: -1) == -1
+    assert first.abandoned
+    assert resilience.dispatch("t.site", lambda: 42, lambda: -1) == 42
+    assert sup._workers["t.site"] is not first
+
+
+def test_concurrent_dispatches_do_not_share_deadline():
+    """Per-site watchdog calls are serialized: a caller arriving while a
+    hung dispatch burns its deadline waits (uncounted) on the site lock,
+    then gets a fresh worker and the full deadline — never a spurious
+    timeout inherited from someone else's job."""
+    resilience.enable(max_retries=0, breaker_threshold=10,
+                      deadline_s=0.15)
+    plan = FaultPlan([FaultSpec("t.site", "timeout", max_fires=1,
+                                sleep_s=0.6)], seed=1)
+    results = {}
+
+    def caller(name):
+        results[name] = resilience.dispatch("t.site", lambda: 42,
+                                            lambda: -1)
+    with faults.inject(plan):
+        threads = [threading.Thread(target=caller, args=(n,))
+                   for n in ("a", "b")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    # exactly one caller hit the injected hang and fell back; the other
+    # ran healthy and must not register a watchdog timeout of its own
+    assert sorted(results.values()) == [-1, 42]
+    assert METRICS.count("watchdog_timeouts") == 1
+
+
+def test_fallback_exceptions_propagate_unwrapped():
+    """The fallback is the scalar oracle: its exceptions are the caller's
+    own semantics and must cross the seam untouched."""
+    resilience.enable(max_retries=0, breaker_threshold=1)
+    plan = FaultPlan([FaultSpec("t.site", "raise", persistent=True)],
+                     seed=1)
+    with faults.inject(plan):
+        with pytest.raises(ValueError, match="oracle says no"):
+            resilience.dispatch(
+                "t.site", lambda: 42,
+                lambda: (_ for _ in ()).throw(ValueError("oracle says no")))
+
+
+# ---------------------------------------------------------------------------
+# fault injector
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_is_deterministic_per_seed():
+    def fires(seed):
+        plan = FaultPlan(
+            [FaultSpec("t.site", "raise", rate=0.5)], seed=seed)
+        out = []
+        with faults.inject(plan):
+            for _ in range(40):
+                try:
+                    resilience.dispatch("t.site", lambda: 1, lambda: -1)
+                    out.append(False)
+                except DeviceFault:
+                    out.append(True)
+        return out
+    a, b, c = fires(7), fires(7), fires(8)
+    assert a == b
+    assert a != c
+    assert any(a) and not all(a)
+
+
+def test_corrupt_flips_bool_and_list_verdicts():
+    rng_plan = FaultPlan(
+        [FaultSpec("t.bool", "corrupt"), FaultSpec("t.list", "corrupt")],
+        seed=3)
+    with faults.inject(rng_plan):
+        assert resilience.dispatch("t.bool", lambda: True,
+                                   lambda: True) is False
+        flipped = resilience.dispatch(
+            "t.list", lambda: [True, True, True], lambda: [])
+    assert flipped.count(False) == 1 and len(flipped) == 3
+    assert METRICS.count_labeled("faults_injected_by_kind",
+                                 "corrupt") == 2
+
+
+def test_timeout_fault_without_watchdog_is_only_slow():
+    plan = FaultPlan([FaultSpec("t.site", "timeout", sleep_s=0.01)],
+                     seed=1)
+    with faults.inject(plan):
+        assert resilience.dispatch("t.site", lambda: 42, lambda: -1) == 42
+    assert INCIDENTS.count(event="injected") == 1
+
+
+def test_untargeted_site_is_never_wrapped():
+    plan = FaultPlan([FaultSpec("other.site", "raise")], seed=1)
+    with faults.inject(plan):
+        assert resilience.dispatch("t.site", lambda: 5, lambda: -1) == 5
+    assert plan.total_fires() == 0
+
+
+# ---------------------------------------------------------------------------
+# incident log + metrics
+# ---------------------------------------------------------------------------
+
+def test_incident_log_is_bounded_and_json_dumpable():
+    log = resilience.IncidentLog(max_entries=8)
+    for i in range(20):
+        log.record("t.site", "event", i=i)
+    snap = log.snapshot()
+    assert len(snap) == 8
+    assert snap[-1]["i"] == 19 and snap[0]["i"] == 12
+    assert json.loads(log.to_json())[0]["site"] == "t.site"
+
+
+def test_report_bundles_metrics_breakers_incidents():
+    sup = resilience.enable(max_retries=0, breaker_threshold=1)
+    plan = FaultPlan([FaultSpec("t.site", "raise", persistent=True)],
+                     seed=1)
+    with faults.inject(plan):
+        resilience.dispatch("t.site", lambda: 1, lambda: -1)
+    report = resilience.report()
+    assert report["breakers"]["t.site"] == OPEN
+    assert report["metrics"]["breaker_trips"] == 1
+    assert report["metrics"]["scalar_fallbacks"]["breaker_open"] == 1
+    assert any(e["event"] == "trip" for e in report["incidents"])
+    json.dumps(report)      # the whole report is one JSON document
+
+
+def test_metrics_labeled_counters_and_thread_safety():
+    METRICS.reset()
+
+    def worker():
+        for _ in range(2000):
+            METRICS.inc("races")
+            METRICS.inc_labeled("scalar_fallbacks", "breaker_open")
+            METRICS.observe("sizes", 3)
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert METRICS.count("races") == 16000
+    assert METRICS.count_labeled("scalar_fallbacks",
+                                 "breaker_open") == 16000
+    assert METRICS.count_labeled("scalar_fallbacks") == 16000
+    snap = METRICS.snapshot()
+    assert snap["scalar_fallbacks"] == {"breaker_open": 16000}
+    assert snap["sizes"]["count"] == 16000
+
+
+# ---------------------------------------------------------------------------
+# scheduler integration: faults at the fused pipeline's dispatch sites
+# ---------------------------------------------------------------------------
+
+def _signing_root(i: int) -> bytes:
+    return i.to_bytes(8, "little") + b"\x5c" * 24
+
+
+def _sets(n, bad_indices=()):
+    out = []
+    for i in range(n):
+        msg = _signing_root(i)
+        signer = i if i not in bad_indices else i + 17
+        out.append(SignatureSet(
+            pubkeys=(bytes(pubkeys[i]),), signing_root=msg,
+            signature=bytes(bls.Sign(privkeys[signer], msg)),
+            kind="test", origin=("test", i)))
+    return out
+
+def test_scheduler_survives_persistent_pairing_failure():
+    """A dead pairing dispatch trips the breaker; verdicts keep coming
+    from the host oracle, byte-identical."""
+    resilience.enable(max_retries=1, breaker_threshold=1,
+                      probe_after=1000)
+    sets = _sets(4, bad_indices={2})
+    plan = FaultPlan(
+        [FaultSpec("bls.pairing_check", "raise", persistent=True)],
+        seed=5)
+    with faults.inject(plan):
+        verdicts = scheduler.verify_sets(sets, mode="fused")
+    assert verdicts == [True, True, False, True]
+    assert supervisor.active().breaker_state("bls.pairing_check") == OPEN
+    assert METRICS.count("breaker_trips") == 1
+    assert METRICS.count_labeled("scalar_fallbacks", "breaker_open") > 0
+
+
+def test_guard_catches_corrupt_verdict_and_quarantines():
+    """Silent corruption of the fused product: no exception anywhere —
+    only the differential guard notices, quarantines the backend, and
+    recomputes every verdict on the oracle."""
+    resilience.enable(guard_sample_rate=1.0, guard_seed=11)
+    sets = _sets(3)
+    plan = FaultPlan(
+        [FaultSpec("bls.pairing_check", "corrupt", persistent=True)],
+        seed=5)
+    with faults.inject(plan):
+        verdicts = scheduler.verify_sets(sets, mode="fused")
+    assert verdicts == [True, True, True]     # oracle verdicts win
+    assert METRICS.count("guard_mismatches") >= 1
+    sup = supervisor.active()
+    assert sup.breaker_state("bls.pairing_check") == QUARANTINED
+    assert INCIDENTS.count(event="guard_mismatch") >= 1
+    assert INCIDENTS.count(event="quarantine") >= 1
+    # quarantined: the next batch never touches the device path, and the
+    # corruption plan cannot reach the oracle fallback
+    with faults.inject(plan):
+        assert scheduler.verify_sets(_sets(2), mode="fused") == [True, True]
+
+
+def test_guard_passes_clean_batches():
+    resilience.enable(guard_sample_rate=1.0, guard_seed=11)
+    assert scheduler.verify_sets(_sets(3), mode="fused") == [True] * 3
+    assert METRICS.count("guard_samples") >= 3
+    assert METRICS.count("guard_mismatches") == 0
+    assert supervisor.active().breaker_state("bls.pairing_check") == CLOSED
+
+
+def test_guard_covers_per_set_mode_too():
+    resilience.enable(guard_sample_rate=1.0, guard_seed=11)
+    plan = FaultPlan(
+        [FaultSpec("bls.verify_batch", "corrupt", persistent=True)],
+        seed=5)
+    with faults.inject(plan):
+        verdicts = scheduler.verify_sets(_sets(3), mode="per-set")
+    assert verdicts == [True, True, True]
+    assert METRICS.count("guard_mismatches") >= 1
+
+
+def test_hash_roots_seam_survives_device_failure(monkeypatch):
+    """The tpu hash-to-G2 sweep seam: a raising device kernel degrades
+    to host hash_to_curve with identical results."""
+    from consensus_specs_tpu.sigpipe import scheduler as sched
+    resilience.enable(max_retries=0, breaker_threshold=1)
+    monkeypatch.setattr(bls, "_backend_name", "tpu")
+    plan = FaultPlan(
+        [FaultSpec("sigpipe.hash_to_g2_batch", "raise",
+                   persistent=True),
+         # keep the pairing itself on the host oracle: this test is
+         # about the hash seam, not the tpu pairing kernels
+         FaultSpec("bls.pairing_check", "raise", persistent=True)],
+        seed=5)
+    with faults.inject(plan):
+        verdicts = sched.verify_sets(_sets(2), mode="fused")
+    assert verdicts == [True, True]
+    assert supervisor.active().breaker_state(
+        "sigpipe.hash_to_g2_batch") == OPEN

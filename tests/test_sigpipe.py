@@ -207,6 +207,81 @@ def test_bisection_isolates_arbitrary_patterns():
         assert got == sorted(bad), f"trial {trial}: {bad}"
 
 
+def test_fused_batch_of_one_invalid_set_costs_no_bisect_dispatch():
+    """A single-set batch that fails IS the isolated failure: the
+    splitter must name it without any extra dispatch."""
+    verdicts = scheduler.verify_sets(_single_sets(1, {0}), mode="fused")
+    assert verdicts == [False]
+    assert METRICS.count("dispatches") == 1
+    assert METRICS.count("bisect_dispatches") == 0
+    assert METRICS.count("fused_batch_failures") == 1
+
+
+def test_fused_all_sets_invalid_batch():
+    n = 5
+    verdicts = scheduler.verify_sets(
+        _single_sets(n, set(range(n))), mode="fused")
+    assert verdicts == [False] * n
+    assert METRICS.count("fused_batch_failures") == 1
+    # bisection must not degenerate to worse than one dispatch per set
+    # on the everything-failed batch (2n - 2 interior probes max)
+    assert METRICS.count("bisect_dispatches") <= 2 * n
+
+
+def test_valid_or_skip_sets_interleaved_with_failing_product():
+    """required=False sets (deposit semantics) ride their own dispatch:
+    a failing fused product bisects ONLY the strict sets, and the lax
+    verdicts are unaffected by the product failure."""
+    strict = _single_sets(4, {1})
+    lax = []
+    for j, i in enumerate((10, 11)):
+        msg = _signing_root(100 + i)
+        signer = i if j == 0 else i + 13      # second lax set invalid
+        lax.append(SignatureSet(
+            pubkeys=(bytes(pubkeys[i]),), signing_root=msg,
+            signature=bytes(bls.Sign(privkeys[signer], msg)),
+            kind="deposit", origin=("deposit", j), required=False))
+    mixed = [strict[0], lax[0], strict[1], lax[1], strict[2], strict[3]]
+    verdicts = scheduler.verify_sets(mixed, mode="fused")
+    assert verdicts == [True, True, False, False, True, True]
+    assert METRICS.count("fused_batch_failures") == 1
+    assert METRICS.count("bisect_dispatches") > 0
+
+
+def test_decode_error_mid_pairing_degrades_to_scalar(
+        monkeypatch, altair_spec, altair_state):
+    """DecodeError after `_prepare` (inside the pairing leg, e.g. a
+    signature that decompresses per-set but whose batch re-encode trips)
+    escapes verify_sets — and block_scope must degrade the whole block
+    to the scalar path with an identical post-state."""
+    from consensus_specs_tpu.crypto.curve import DecodeError
+    from consensus_specs_tpu.sigpipe import scheduler as sched
+
+    spec = altair_spec
+    block = build_empty_block_for_next_slot(spec, altair_state)
+    scratch = altair_state.copy()
+    signed = state_transition_and_sign_block(spec, scratch, block)
+    inline_state = altair_state.copy()
+    spec.state_transition(inline_state, signed)
+
+    def explode(roots):
+        raise DecodeError("mid-pairing decode failure")
+    monkeypatch.setattr(sched, "_hash_roots", explode)
+    # the scheduler itself propagates (callers own the degradation)
+    with pytest.raises(DecodeError):
+        sched.verify_sets(_single_sets(2, set()), mode="fused")
+    METRICS.reset()
+    pipe_state = altair_state.copy()
+    sigpipe.enable()
+    try:
+        spec.state_transition(pipe_state, signed)
+    finally:
+        sigpipe.disable()
+    assert hash_tree_root(pipe_state) == hash_tree_root(inline_state)
+    assert METRICS.count("pipeline_errors") == 1
+    assert METRICS.count("seam_hits") == 0      # no map was installed
+
+
 # ---------------------------------------------------------------------------
 # end-to-end: state_transition parity
 # ---------------------------------------------------------------------------
@@ -371,6 +446,56 @@ def test_caches_hit_on_reverification(phase0_spec, phase0_state):
     assert METRICS.count("pubkey_cache_misses") == 0
     assert METRICS.count("aggregate_cache_misses") == 0
     assert METRICS.count("aggregate_cache_hits") > 0
+
+
+def test_electra_pending_deposits_route_through_scheduler():
+    """EIP-6110 epoch-boundary pending deposits (outside the block
+    window) batch-verify through sigpipe.scheduler with the same
+    valid-or-skip semantics: identical post-state, the valid deposit
+    registers, the unsigned one is skipped, and the signature checks hit
+    the seam instead of scalar calls."""
+    from consensus_specs_tpu.test_infra.deposits import build_deposit_data
+
+    spec = get_spec("electra", "minimal")
+    state = create_genesis_state(spec, default_balances(spec))
+    state.deposit_requests_start_index = state.eth1_deposit_index
+    amount = spec.MIN_ACTIVATION_BALANCE
+    base = len(state.validators)
+    creds = b"\x01" + b"\x00" * 11 + b"\x42" * 20
+    for j, signed_ok in enumerate((True, False)):
+        key_index = base + j
+        data = build_deposit_data(
+            spec, pubkeys[key_index], privkeys[key_index], amount,
+            creds, signed=signed_ok)
+        state.pending_deposits.append(spec.PendingDeposit(
+            pubkey=data.pubkey,
+            withdrawal_credentials=data.withdrawal_credentials,
+            amount=data.amount, signature=data.signature,
+            slot=spec.GENESIS_SLOT))
+    # both deposits fit the churn window (mirrors the spec-suite helper)
+    churn = int(spec.get_activation_exit_churn_limit(state))
+    state.deposit_balance_to_consume = uint64(
+        max(0, 2 * int(amount) - churn))
+
+    inline_state = state.copy()
+    spec.process_pending_deposits(inline_state)
+    METRICS.reset()
+    pipe_state = state.copy()
+    sigpipe.enable()
+    try:
+        spec.process_pending_deposits(pipe_state)
+    finally:
+        sigpipe.disable()
+
+    assert hash_tree_root(inline_state) == hash_tree_root(pipe_state)
+    assert len(pipe_state.validators) == base + 1   # invalid one skipped
+    assert bytes(pipe_state.validators[base].pubkey) == bytes(
+        pubkeys[base])
+    assert METRICS.count("signatures_scheduled") == 2
+    assert METRICS.count("seam_hits") == 2
+    assert METRICS.count("seam_misses") == 0
+    # outside any pending-deposit window the seams are uninstalled again
+    assert spec._sigpipe_verdicts is None
 
 
 def test_verify_block_signatures_eager_api(altair_spec, altair_state):
