@@ -13,7 +13,7 @@ RUNNERS := shuffling ssz_static operations epoch_processing sanity bls \
 	forks merkle_proof networking kzg_7594 random light_client sync
 
 .PHONY: test test-quick test-kernels tier1 chaos lint native pyspec bench \
-	gen_all detect_errors $(addprefix gen_,$(RUNNERS))
+	gossip-bench gen_all detect_errors $(addprefix gen_,$(RUNNERS))
 
 # syntax/bytecode check over every package and script (the CI lint job)
 lint:
@@ -33,7 +33,8 @@ test-kernels:
 test-quick:
 	$(PYTHON) -m pytest tests/spec_suites tests/test_ssz.py \
 		tests/test_phase0_sanity.py tests/test_epoch_fast.py \
-		tests/test_sigpipe.py tests/test_resilience.py -q
+		tests/test_sigpipe.py tests/test_resilience.py \
+		tests/test_gossip.py -q
 
 # the exact ROADMAP.md tier-1 verify command (what the driver runs);
 # DOTS_PASSED counts green dots from the -q progress lines
@@ -64,6 +65,12 @@ pyspec:
 
 bench:
 	$(PYTHON) bench.py
+
+# gossip admission tier alone (gossip/): messages/sec +
+# dispatches-per-message at 1x/10x/100x ingress; BENCH_GOSSIP_BACKEND=
+# native and BENCH_GOSSIP_MSGS=8 give an accelerator-less smoke run
+gossip-bench:
+	$(PYTHON) bench.py gossip
 
 # static pattern rule: GNU make refuses to run implicit pattern rules
 # for .PHONY targets

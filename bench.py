@@ -601,6 +601,175 @@ def bench_degraded():
 
 
 # ---------------------------------------------------------------------------
+# tier: gossip admission pipeline (gossip/) — ingress-rate sweep
+# ---------------------------------------------------------------------------
+
+GOSSIP_MSGS = int(os.environ.get("BENCH_GOSSIP_MSGS", "48"))
+
+
+def bench_gossip():
+    """Gossip admission at 1x / 10x / 100x ingress: single-participant
+    attestations through the AdmissionPipeline against a minimal-preset
+    fork-choice store.  Reports messages/sec and dispatches-per-message
+    per rate (stderr JSON); asserts dispatches-per-message < 1 at 10x
+    and bounded-queue shedding (no unbounded growth) at 100x with the
+    gossip.batch_verify breaker forced open."""
+    from consensus_specs_tpu import resilience
+    from consensus_specs_tpu.gossip import (
+        AdmissionPipeline, GossipConfig, ManualClock)
+    from consensus_specs_tpu.ops import pairing_jax as pj
+    from consensus_specs_tpu.sigpipe import METRICS as SIG_METRICS
+    from consensus_specs_tpu.specs import get_spec
+    from consensus_specs_tpu.ssz import uint64
+    from consensus_specs_tpu.test_infra import disable_bls
+    from consensus_specs_tpu.test_infra.attestations import (
+        get_valid_attestation)
+    from consensus_specs_tpu.test_infra.fork_choice import (
+        get_genesis_forkchoice_store)
+    from consensus_specs_tpu.test_infra.genesis import (
+        create_genesis_state, default_balances)
+    from consensus_specs_tpu.utils import bls as bls_shim
+
+    t_start = time.perf_counter()
+
+    def mark(msg):
+        log(f"[bench] gossip +{time.perf_counter() - t_start:5.1f}s: "
+            f"{msg}")
+
+    spec = get_spec("altair", "minimal")
+    genesis = create_genesis_state(spec, default_balances(spec))
+    state = genesis.copy()
+    spec.process_slots(state, uint64(spec.SLOTS_PER_EPOCH + 2))
+    mark(f"signing {GOSSIP_MSGS} single-participant attestations ...")
+    messages = []
+    slot = int(state.slot) - 1
+    while len(messages) < GOSSIP_MSGS and slot >= 0:
+        committees = int(spec.get_committee_count_per_slot(
+            state, spec.compute_epoch_at_slot(uint64(slot))))
+        for index in range(committees):
+            committee = spec.get_beacon_committee(
+                state, uint64(slot), uint64(index))
+            for validator in committee:
+                if len(messages) >= GOSSIP_MSGS:
+                    break
+                messages.append(get_valid_attestation(
+                    spec, state, slot=uint64(slot), index=index,
+                    filter_participant_set=lambda s, v=validator: {v},
+                    signed=True))
+        slot -= 1
+
+    def fresh_store():
+        store = get_genesis_forkchoice_store(spec, genesis)
+        spec.on_tick(store, store.genesis_time + int(state.slot)
+                     * int(spec.config.SECONDS_PER_SLOT))
+        return store
+
+    def run_rate(per_window, scalar_only=False):
+        """Submit the message pool at `per_window` messages per 50 ms
+        window; returns (elapsed, delivered, dispatches)."""
+        SIG_METRICS.reset()
+        clock = ManualClock()
+        pipe = AdmissionPipeline(
+            spec, fresh_store(),
+            GossipConfig(max_batch=256, bucket_capacity=1 << 16,
+                         scalar_only=scalar_only), clock)
+        t0 = time.perf_counter()
+        for i, att in enumerate(messages):
+            pipe.submit("attestation", att, peer=f"p{i % 8}")
+            if (i + 1) % per_window == 0:
+                clock.advance(0.05)
+                pipe.poll()
+        pipe.drain()
+        elapsed = time.perf_counter() - t0
+        snapshot = SIG_METRICS.snapshot()
+        delivered = len(pipe.delivered_log)
+        assert delivered == len(messages)
+        accepted = sum(1 for r in pipe.verdicts()
+                       if r.status == "accepted")
+        assert accepted == delivered, "gossip bench verification failed"
+        return elapsed, delivered, snapshot.get("dispatches", 0)
+
+    backend = os.environ.get("BENCH_GOSSIP_BACKEND", "tpu")
+    if backend == "tpu":
+        mark(f"warming TPU kernels (mode={pj._resolve_mode()}) ...")
+        pj.warmup(k=2, rows=pj._BUCKET_MIN_ROWS)
+        bls_shim.use_tpu()
+    try:
+        mark("warm run (compiles the batch shapes) ...")
+        run_rate(len(messages))
+        results = {}
+        for label, per_window in (("1x", 4), ("10x", 40)):
+            mark(f"timed run at {label} ({per_window} msgs/window) ...")
+            elapsed, delivered, dispatches = run_rate(per_window)
+            results[label] = {
+                "messages_per_sec": round(delivered / elapsed, 2),
+                "dispatches_per_message": round(
+                    dispatches / delivered, 4),
+            }
+            log(f"[bench] gossip {label}: "
+                + json.dumps(results[label], sort_keys=True))
+        mark("scalar-oracle baseline at 10x ...")
+        scalar_elapsed, _, _ = run_rate(40, scalar_only=True)
+    finally:
+        if backend == "tpu":
+            bls_shim.use_native()
+    assert results["10x"]["dispatches_per_message"] < 1.0, \
+        "gossip batching failed to amortize dispatches at 10x"
+
+    # 100x: pure admission stress — BLS stubbed (decisions, not
+    # signatures), breaker forced open, flood of distinct messages
+    # against a small queue: the pipeline must shed, not grow
+    mark("100x overload leg (breaker open, bounded queue) ...")
+    SIG_METRICS.reset()
+    depth = 32
+    with disable_bls():
+        flood = []
+        for i in range(4 * depth):
+            att = messages[i % len(messages)].copy()
+            att.data.beacon_block_root = i.to_bytes(32, "little")
+            flood.append(att)
+        resilience.enable().quarantine("gossip.batch_verify",
+                                       reason="forced_open")
+        try:
+            pipe = AdmissionPipeline(
+                spec, fresh_store(),
+                GossipConfig(queue_depth=depth, max_batch=1 << 16,
+                             bucket_capacity=1 << 16), ManualClock())
+            peak = 0
+            for i, att in enumerate(flood):
+                pipe.submit("attestation", att, peer=f"p{i % 8}")
+                peak = max(peak, pipe.pending_count())
+            pipe.drain()
+        finally:
+            resilience.disable()
+    snapshot = SIG_METRICS.snapshot()
+    shed = snapshot.get("gossip_shed", {}).get("overflow", 0)
+    assert peak <= depth, "gossip queue grew past its bound at 100x"
+    assert shed == len(flood) - depth, "overload did not shed"
+    results["100x"] = {"peak_queue_depth": peak, "shed_overflow": shed,
+                       "batch_scalar": snapshot.get(
+                           "gossip_batch_scalar", {})}
+    log("[bench] gossip 100x: "
+        + json.dumps(results["100x"], sort_keys=True))
+    log("[bench] gossip metrics: " + json.dumps(snapshot, sort_keys=True))
+
+    ten = results["10x"]
+    n_msgs = len(messages)      # the build loop may cap below the
+    # requested BENCH_GOSSIP_MSGS on small presets
+    return {
+        "metric": "gossip_admission_msgs_per_sec",
+        "value": ten["messages_per_sec"],
+        "unit": (f"msgs/s at 10x ingress ({n_msgs} msgs, "
+                 f"{ten['dispatches_per_message']} dispatches/msg; "
+                 f"100x sheds {results['100x']['shed_overflow']} "
+                 f"bounded at {depth})"),
+        "vs_baseline": round(
+            scalar_elapsed * results["10x"]["messages_per_sec"]
+            / n_msgs, 2),
+    }
+
+
+# ---------------------------------------------------------------------------
 # tier: the NORTH STAR (BASELINE.json): mainnet-preset state_transition
 # of a block carrying attestations + a full sync aggregate, BLS ON
 # through the TPU kernels, vs the SAME transition on the pure-python
@@ -789,13 +958,16 @@ TIERS = {
     # breaker-open vs closed throughput (resilience/): key build + one
     # kernel warm-up dominate; both timed runs are single dispatches
     "degraded": (bench_degraded, 420),
+    # gossip admission rate sweep (gossip/): message signing + kernel
+    # warm-up dominate; each timed leg is a handful of fused dispatches
+    "gossip": (bench_gossip, 420),
 }
 
 # the driver's ~540s window fits merkle + ONE heavy tier — without
 # rotation, attestations/kzg/epoch/transition would never get a
 # driver-verified number (VERDICT r4 weakness #8)
 _ROTATING = ["north_star", "attestations", "block_sigs", "kzg", "epoch",
-             "transition", "degraded"]
+             "transition", "degraded", "gossip"]
 
 
 def _round_index() -> int:
@@ -895,7 +1067,7 @@ def main():
 
     # most valuable completed tier wins the stdout line, by value rank
     # (rotation changes which tiers RUN, not which result headlines)
-    rank = ["north_star", "attestations", "block_sigs", "kzg",
+    rank = ["north_star", "attestations", "block_sigs", "gossip", "kzg",
             "transition", "epoch", "degraded", "merkle"]
     for name in rank:
         if name in results:

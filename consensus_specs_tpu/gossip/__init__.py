@@ -1,0 +1,41 @@
+"""Gossip admission pipeline for the fork-choice hot path.
+
+A production node serving millions of validators lives or dies on how
+its network-facing validation layer behaves under overload and
+adversarial input.  This package puts a bounded, observable, batching
+admission pipeline in front of the fork-choice handlers:
+
+* queues.py   — bounded per-topic ingress (shed-oldest, incident-logged)
+* batcher.py  — deadline/size micro-batcher: one fused signature
+                dispatch per window through sigpipe.scheduler, bisection
+                isolating bad messages, breaker-aware scalar fallback
+* quota.py    — per-peer token buckets with defer/shed backpressure
+* dedup.py    — content-addressed duplicate suppression + slashable
+                equivocation quarantine with logged evidence
+* collect.py  — read-only best-effort SignatureSet prediction per topic
+* prewarm.py  — on_block pre-warm of sigpipe's aggregate-pubkey cache
+                (cross-block fork-choice reuse)
+* pipeline.py — AdmissionPipeline tying it together, plus the
+                `apply_scalar` sequential oracle and store_fingerprint
+
+Semantics contract (pipeline.py docstring): delivered messages behave
+byte-identically to the scalar per-message path; the pipeline only
+decides what to shed and how few dispatches verification costs.
+"""
+from .batcher import DeadlineBatcher
+from .dedup import EquivocationGuard, SeenCache
+from .pipeline import (
+    TOPICS, AdmissionPipeline, GossipConfig, Result, apply_scalar,
+    store_fingerprint,
+)
+from .prewarm import prewarm_block
+from .queues import BoundedQueue
+from .quota import PeerQuotas, TokenBucket
+from ..utils.clock import ManualClock, SystemClock
+
+__all__ = [
+    "AdmissionPipeline", "BoundedQueue", "DeadlineBatcher",
+    "EquivocationGuard", "GossipConfig", "ManualClock", "PeerQuotas",
+    "Result", "SeenCache", "SystemClock", "TOPICS", "TokenBucket",
+    "apply_scalar", "prewarm_block", "store_fingerprint",
+]

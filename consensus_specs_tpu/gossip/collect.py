@@ -1,0 +1,155 @@
+"""Per-message signature-set + vote-key collection for the batcher.
+
+Mirrors the philosophy of sigpipe/sets.py at the gossip layer: for each
+admitted message, predict the BLS checks its fork-choice handler will
+perform and emit them as `SignatureSet`s, plus the (validator, voting
+slot) keys the equivocation guard tracks.  Collection is READ-ONLY and
+best-effort:
+
+* read-only — the handlers mutate the store (on_attestation inserts
+  checkpoint states, on_block inserts blocks); collection must not,
+  or the pipeline's store would drift from the sequential oracle's.
+  Target checkpoint states already cached on the store are read in
+  place; missing ones are computed on a private copy held in the
+  flush-local cache, never written back.
+* best-effort — any failure (unknown target, malformed indices, a
+  pre-assert the handler will raise itself) just skips the set: the
+  handler re-raises at its own boundary at delivery time, and the
+  verification seam falls back to the scalar backend for any check we
+  failed to predict.  Collection can therefore never change a verdict,
+  only the dispatch count.
+
+Blocks are the exception: their signature surface is covered by the
+block-level pipeline (sigpipe.block_scope inside state_transition), so
+this layer only extracts the proposer's (slot -> block) vote key.
+"""
+from __future__ import annotations
+
+from ..sigpipe.metrics import METRICS
+# _set is sigpipe's SignatureSet constructor (byte-normalization in one
+# place); sharing it keeps the two collection layers from drifting
+from ..sigpipe.sets import _set, indexed_attestation_parts
+from ..ssz import hash_tree_root
+
+
+class Collected:
+    """What one gossip message contributes to a flush."""
+
+    __slots__ = ("sets", "votes")
+
+    def __init__(self, sets=(), votes=()):
+        self.sets = list(sets)      # SignatureSets to micro-batch
+        self.votes = list(votes)    # (kind, validator_index, vote_key,
+        #                              content digest) for the guard
+
+
+def resolve_target_state(spec, store, target, cache):
+    """The state `store_target_checkpoint_state` would use for `target`,
+    WITHOUT storing it: the store's cached copy when present, else the
+    spec's own pure compute half (`compute_target_checkpoint_state` —
+    one derivation, no drift) memoized in the flush-local `cache`."""
+    state = store.checkpoint_states.get(target)
+    if state is not None:
+        return state
+    key = (int(target.epoch), bytes(target.root))
+    state = cache.get(key)
+    if state is not None:
+        return state
+    state = spec.compute_target_checkpoint_state(store, target)
+    cache[key] = state
+    return state
+
+
+def _attestation(spec, store, attestation, cache, origin) -> Collected:
+    state = resolve_target_state(spec, store, attestation.data.target,
+                                 cache)
+    indexed = spec.get_indexed_attestation(state, attestation)
+    # the one shared mirror of is_valid_indexed_attestation's derivation
+    parts = indexed_attestation_parts(spec, state, indexed)
+    if parts is None:
+        return Collected()
+    indices, pubkeys, root = parts
+    data = attestation.data
+    data_digest = bytes(hash_tree_root(data))
+    sets = [_set(pubkeys, root, attestation.signature, "gossip_attestation",
+                 origin,
+                 hint=("att", int(data.target.epoch),
+                       int(getattr(data, "index", 0))))]
+    votes = [("attestation", i, int(data.target.epoch), data_digest)
+             for i in indices]
+    return Collected(sets, votes)
+
+
+def _aggregate(spec, store, signed, cache, origin) -> Collected:
+    aggregate_and_proof = signed.message
+    aggregate = aggregate_and_proof.aggregate
+    inner = _attestation(spec, store, aggregate, cache, origin)
+    state = resolve_target_state(spec, store, aggregate.data.target, cache)
+    # both envelope checks come from the handler's own derivation
+    # helpers (fork_choice.py) — one derivation, no drift
+    pubkeys, root, signature = spec.gossip_selection_proof_check(
+        state, aggregate_and_proof)
+    inner.sets.append(_set(pubkeys, root, signature,
+                           "gossip_selection_proof", origin))
+    pubkeys, root, signature = spec.gossip_aggregate_and_proof_check(
+        state, signed)
+    inner.sets.append(_set(pubkeys, root, signature,
+                           "gossip_aggregate_and_proof", origin))
+    return inner
+
+
+def _sync_message(spec, store, message, origin) -> Collected:
+    state = store.block_states[message.beacon_block_root]
+    pubkeys, root, signature = spec.gossip_sync_message_check(
+        state, message)
+    sets = [_set(pubkeys, root, signature, "gossip_sync_message",
+                 origin)]
+    votes = [("sync", int(message.validator_index), int(message.slot),
+              bytes(message.beacon_block_root))]
+    return Collected(sets, votes)
+
+
+def _block(spec, store, signed_block, origin) -> Collected:
+    block = signed_block.message
+    return Collected((), [("block", int(block.proposer_index),
+                           int(block.slot),
+                           bytes(hash_tree_root(block)))])
+
+
+def _payload_attestation(spec, store, message, origin) -> Collected:
+    pubkeys, root, signature = spec.gossip_payload_attestation_check(
+        store, message)
+    votes = [("payload_attestation", int(message.validator_index),
+              int(message.data.slot),
+              bytes(hash_tree_root(message.data)))]
+    return Collected(
+        [_set(pubkeys, root, signature, "gossip_payload_attestation",
+              origin)],
+        votes)
+
+
+_COLLECTORS = {
+    "attestation": lambda spec, store, payload, cache, origin:
+        _attestation(spec, store, payload, cache, origin),
+    "aggregate": _aggregate,
+    "sync": lambda spec, store, payload, cache, origin:
+        _sync_message(spec, store, payload, origin),
+    "block": lambda spec, store, payload, cache, origin:
+        _block(spec, store, payload, origin),
+    "payload_attestation": lambda spec, store, payload, cache, origin:
+        _payload_attestation(spec, store, payload, origin),
+}
+
+TOPICS = tuple(_COLLECTORS)
+
+
+def collect(spec, store, topic, payload, cache, seq) -> Collected:
+    """Best-effort collection for one message; failures yield an empty
+    Collected (scalar delivery, no guard observation) and a counter."""
+    try:
+        return _COLLECTORS[topic](spec, store, payload, cache,
+                                  (topic, seq))
+    except Exception:
+        METRICS.inc("gossip_collect_skipped")
+        METRICS.inc_labeled("gossip_collect_skipped_by_topic", topic)
+        return Collected()

@@ -1,0 +1,124 @@
+"""Duplicate suppression and slashable-equivocation quarantine.
+
+Two independent defenses against adversarial gossip:
+
+* `SeenCache` — content-addressed dedup.  Gossip meshes redeliver: the
+  same attestation arrives from every peer that relays it.  The cache
+  keys messages by hash_tree_root, bounded FIFO (an attacker cannot
+  grow it), so a redelivered message costs one dict lookup instead of a
+  pairing.  Hits land in `gossip_dedup_hits` (the dedup hit rate is one
+  of the headline pipeline metrics).  Digests are recorded when a
+  message is actually admitted and *discarded again* when it is shed
+  for capacity reasons (queue overflow, quota shed, peer eviction) —
+  honest mesh redelivery of a message the node dropped under load must
+  get a second chance once load subsides.
+
+* `EquivocationGuard` — slashable-vote detection at the admission edge.
+  A validator that signs two DIFFERENT messages for the same voting
+  slot (two attestation datas with one target epoch: a double vote;
+  two blocks at one slot; two sync votes for one slot) is provably
+  equivocating.  The guard remembers the first *verified* (key ->
+  content digest) vote per voting key — the pipeline records a vote
+  only after the carrying message passed signature verification and
+  was accepted, and quarantines only when the CONFLICTING message's
+  signature verifies too.  Unverified junk claiming a validator index
+  can therefore never frame that validator (no censorship vector).  On
+  a genuine conflict the validator index is quarantined — its
+  sole-signer traffic is shed from then on — and the evidence pair is
+  surfaced through the incident log (`gossip.equivocation` /
+  `quarantine`, with both digests), which is exactly what a slashing
+  inclusion pipeline needs to pick up.
+
+  Decisions are content-addressed and first-verified-write-wins:
+  re-seeing the SAME digest is a duplicate, not an equivocation, and
+  the decision sequence is a pure function of the (message, verdict)
+  sequence — deterministic under replay, which the chaos tier relies
+  on.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+from ..resilience.incidents import INCIDENTS
+from ..sigpipe.metrics import METRICS
+
+
+class SeenCache:
+    def __init__(self, max_size: int = 1 << 16, metrics=METRICS):
+        self._seen: OrderedDict = OrderedDict()
+        self._max = int(max_size)
+        self._metrics = metrics
+
+    def seen_before(self, digest: bytes) -> bool:
+        """Dedup check (counted): True for a digest already admitted."""
+        if digest in self._seen:
+            self._metrics.inc("gossip_dedup_hits")
+            return True
+        self._metrics.inc("gossip_dedup_misses")
+        return False
+
+    def add(self, digest: bytes) -> None:
+        if digest in self._seen:
+            return
+        if len(self._seen) >= self._max:
+            self._seen.popitem(last=False)
+        self._seen[digest] = True
+
+    def discard(self, digest: bytes) -> None:
+        """Forget a digest whose message was shed for capacity reasons:
+        redelivery deserves a fresh admission attempt."""
+        self._seen.pop(digest, None)
+
+    def __len__(self) -> int:
+        return len(self._seen)
+
+
+class EquivocationGuard:
+    def __init__(self, max_keys: int = 1 << 16,
+                 metrics=METRICS, incidents=INCIDENTS):
+        self._first: OrderedDict = OrderedDict()   # vote key -> digest
+        self._max = int(max_keys)
+        self._metrics = metrics
+        self._incidents = incidents
+        self.quarantined: set = set()              # validator indices
+
+    def is_quarantined(self, validator_index: int) -> bool:
+        return int(validator_index) in self.quarantined
+
+    def first_vote(self, kind: str, validator_index: int, vote_key):
+        """The recorded verified digest for this voting key, if any."""
+        return self._first.get((kind, int(validator_index), vote_key))
+
+    def observe(self, kind: str, validator_index: int, vote_key,
+                digest: bytes) -> bool:
+        """Record one VERIFIED (validator, vote).  Returns True when
+        consistent (first vote, or a repeat of the same content); on a
+        conflict the validator is quarantined with evidence and False
+        is returned.  Only call this for messages whose signatures
+        verified — the pipeline does, post-delivery."""
+        validator_index = int(validator_index)
+        key = (kind, validator_index, vote_key)
+        first = self._first.get(key)
+        if first is None:
+            if len(self._first) >= self._max:
+                self._first.popitem(last=False)
+            self._first[key] = digest
+            return True
+        if first == digest:
+            return True
+        self.quarantine(kind, validator_index, vote_key, first, digest)
+        return False
+
+    def quarantine(self, kind: str, validator_index: int, vote_key,
+                   first: bytes, second: bytes) -> None:
+        """Quarantine `validator_index` over a verified conflicting
+        vote pair, logging the evidence digests."""
+        validator_index = int(validator_index)
+        if validator_index in self.quarantined:
+            return
+        self.quarantined.add(validator_index)
+        self._metrics.inc("gossip_equivocations")
+        self._incidents.record(
+            "gossip.equivocation", "quarantine", kind=kind,
+            validator_index=validator_index, vote=repr(vote_key),
+            first=first.hex(), second=second.hex())

@@ -1,0 +1,414 @@
+"""The gossip admission pipeline: bounded ingress in front of the
+fork-choice handlers.
+
+    pipe = AdmissionPipeline(spec, store, GossipConfig(...), clock)
+    pipe.submit("attestation", attestation, peer="16Uiu...")
+    ...
+    pipe.poll()      # flush when the deadline/size window closes
+    pipe.drain()     # force everything through (end of slot, tests)
+
+Message life cycle:
+
+    submit ──dedup──quota──▶ bounded topic queue
+                               │ (window: 50 ms / 128 msgs / drain)
+    flush: collect sets ──▶ micro-batch verify
+                               │
+    equivocation gate + deliver in arrival order through the
+    fork-choice handlers, batch verdicts installed at the seams;
+    verified-and-accepted messages record their votes in the guard
+
+Admission decisions (duplicate, over-quota, overflow-shed, equivocation
+quarantine) are made from bounded state and an injected clock — a
+seeded schedule replays to the same decisions every run, which is what
+lets the chaos tier diff the pipeline against its oracle.
+
+SEMANTICS CONTRACT.  For the messages the pipeline delivers, per-message
+accept/reject verdicts and the resulting store are byte-identical to
+applying the same messages one at a time through the bare handlers
+(`apply_scalar`): delivery happens in arrival order, the batch verdicts
+are content-addressed substitutes consumed at the handlers' own seam
+call sites, any un-collected check falls back to the scalar backend,
+and collection itself never touches the store.  The pipeline changes
+WHICH messages get processed (that's its job: shed the flood) and HOW
+MANY dispatches verification costs — never what any processed message
+does to the store.
+"""
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+
+from ..sigpipe import scheduler
+from ..sigpipe.metrics import METRICS
+from ..sigpipe.verify import VerdictMap
+from ..ssz import hash_tree_root
+from ..utils.clock import MONOTONIC
+from . import collect as _collect
+from .batcher import FLUSH_DRAIN, DeadlineBatcher
+from .dedup import EquivocationGuard, SeenCache
+from .prewarm import prewarm_block
+from .queues import BoundedQueue
+from .quota import PeerQuotas
+
+TOPICS = _collect.TOPICS
+
+# the exception classes a fork-choice handler uses to reject a message;
+# anything else is a bug and propagates (the chaos tier asserts none do
+# while the supervisor is armed)
+REJECTION_ERRORS = (AssertionError, KeyError, ValueError, IndexError)
+
+# topics whose handler can ACCEPT without having verified the
+# signature (eip7732 on_payload_attestation_message no-ops on
+# stale-slot messages); their votes need an explicit verification gate
+_UNVERIFIED_ACCEPT_TOPICS = frozenset({"payload_attestation"})
+
+
+@dataclass
+class GossipConfig:
+    queue_depth: int = 1024         # per-topic ingress bound
+    window_s: float = 0.05          # micro-batch deadline
+    max_batch: int = 128            # micro-batch size cap
+    mode: str = "fused"             # sigpipe scheduler mode
+    bucket_capacity: float = 64.0   # per-peer token burst
+    refill_rate: float = 16.0       # per-peer tokens/sec
+    quota_policy: str = "defer"     # "defer" (backpressure) or "shed"
+    max_deferred: int = 256         # per-peer backpressure backlog bound
+    max_peers: int = 1024           # peer-table bound (LRU)
+    seen_cache_size: int = 1 << 16  # dedup bound
+    history_bound: int = 1 << 16    # results / delivered_log retention
+    scalar_only: bool = False       # the sequential-oracle mode: same
+    #                                 admission, no micro-batching
+
+
+@dataclass
+class Message:
+    seq: int
+    topic: str
+    peer: str
+    payload: object
+    digest: bytes
+
+
+@dataclass
+class Result:
+    seq: int
+    topic: str
+    peer: str
+    status: str                 # queued|deferred|accepted|rejected|shed
+    detail: str = ""
+
+    @property
+    def final(self) -> bool:
+        return self.status in ("accepted", "rejected", "shed")
+
+
+class AdmissionPipeline:
+    def __init__(self, spec, store, config: GossipConfig | None = None,
+                 clock=MONOTONIC):
+        self.spec = spec
+        self.store = store
+        self.config = config or GossipConfig()
+        self.clock = clock
+        cfg = self.config
+        self.queues = {topic: BoundedQueue(topic, cfg.queue_depth)
+                       for topic in TOPICS}
+        self.batcher = DeadlineBatcher(cfg.window_s, cfg.max_batch,
+                                       cfg.mode, clock)
+        self.quotas = PeerQuotas(cfg.bucket_capacity, cfg.refill_rate,
+                                 policy=cfg.quota_policy,
+                                 max_deferred=cfg.max_deferred,
+                                 max_peers=cfg.max_peers, clock=clock)
+        # only topics this spec can actually handle: a submit for an
+        # unsupported topic must fail THERE, not explode mid-flush and
+        # abandon the rest of an already-popped window
+        self.topics = tuple(t for t in TOPICS
+                            if hasattr(spec, _HANDLER_METHODS[t]))
+        self.seen = SeenCache(cfg.seen_cache_size)
+        self.guard = EquivocationGuard()
+        self.results: dict = {}         # seq -> Result (bounded)
+        self.delivered_log = deque(maxlen=cfg.history_bound)
+        self._finalized_order: deque = deque()  # eviction order for results
+        self._seq = 0
+
+    # -- ingress -------------------------------------------------------
+    def submit(self, topic: str, payload, peer: str = "local") -> int:
+        """Admit one gossip message; returns its sequence number.  May
+        trigger a size-cap flush.  The verdict lands in results[seq]."""
+        assert topic in self.topics, \
+            f"topic {topic!r} not supported by {self.spec.fork} spec"
+        self._seq += 1
+        seq = self._seq
+        digest = bytes(hash_tree_root(payload))
+        message = Message(seq, topic, peer, payload, digest)
+        METRICS.inc_labeled("gossip_submitted", topic)
+
+        if self.seen.seen_before(digest):
+            METRICS.inc_labeled("gossip_shed", "duplicate")
+            self._finalize(message, "shed", "duplicate")
+            return seq
+
+        outcome = self.quotas.admit(peer, message)
+        if outcome == "shed":
+            # capacity shed: NOT marked seen — redelivery retries
+            self._finalize(message, "shed", "quota")
+            return seq
+        self.seen.add(digest)
+        self._shed_evicted_backlogs()
+        if outcome == "deferred":
+            self.results[seq] = Result(seq, topic, peer, "deferred")
+            return seq
+
+        self._enqueue(message)
+        self.poll()
+        return seq
+
+    def _enqueue(self, message: Message) -> None:
+        self.results[message.seq] = Result(
+            message.seq, message.topic, message.peer, "queued")
+        shed = self.queues[message.topic].push(message)
+        if shed is not None:
+            self.seen.discard(shed.digest)      # capacity shed: retryable
+            self._finalize(shed, "shed", "overflow")
+        self.batcher.note_enqueued()
+
+    def _shed_evicted_backlogs(self) -> None:
+        """Finalize deferred messages orphaned by peer-table eviction:
+        their quota lane is gone, so they shed (retryable — the seen
+        cache forgets them)."""
+        for orphan in self.quotas.pop_evicted():
+            METRICS.inc_labeled("gossip_shed", "quota")
+            self.seen.discard(orphan.digest)
+            self._finalize(orphan, "shed", "quota_evicted")
+
+    # -- the window ----------------------------------------------------
+    def pending_count(self) -> int:
+        return sum(len(q) for q in self.queues.values())
+
+    def poll(self) -> bool:
+        """Release any quota-deferred messages whose buckets refilled,
+        then flush if the batch window has closed (deadline or size);
+        returns whether a flush happened.  Releasing here — not just at
+        drain — is what makes deferral backpressure rather than
+        starvation: the normal submit/poll loop frees the backlog as
+        tokens accrue."""
+        for message in self.quotas.take_refilled():
+            self._enqueue(message)
+        reason = self.batcher.flush_reason(self.pending_count())
+        if reason is None:
+            return False
+        self._flush(reason)
+        return True
+
+    def drain(self) -> list:
+        """Force every queued and quota-deferred message through;
+        returns the finalized Results in seq order.  Deferred messages
+        whose buckets are still empty stay deferred (backpressure is
+        allowed to outlive a drain)."""
+        for message in self.quotas.take_refilled():
+            self._enqueue(message)
+        while self.pending_count():
+            self._flush(FLUSH_DRAIN)
+        return self.verdicts()
+
+    def _flush(self, reason: str) -> None:
+        self.batcher.window_closed(reason)
+        batch = sorted(
+            (m for q in self.queues.values() for m in q.pop_all()),
+            key=lambda m: m.seq)
+        if not batch:
+            return
+
+        # collect the predicted checks (read-only) for the whole window
+        target_cache: dict = {}
+        collected_by_seq: dict = {}
+        sets = []
+        for message in batch:
+            collected = _collect.collect(
+                self.spec, self.store, message.topic, message.payload,
+                target_cache, message.seq)
+            collected_by_seq[message.seq] = collected
+            sets.extend(collected.sets)
+
+        # micro-batch them (scalar oracle mode skips)
+        by_key = None
+        if not self.config.scalar_only:
+            by_key = self.batcher.verify(sets)
+        verdict_map = VerdictMap(by_key) if by_key else None
+
+        # screen + deliver in arrival order (interleaved, so a conflict
+        # with an earlier message in the SAME window is caught)
+        for message in batch:
+            self._admit_and_deliver(message, collected_by_seq[message.seq],
+                                    by_key, verdict_map)
+
+    # -- the equivocation gate -----------------------------------------
+    def _sets_verify(self, sets, by_key) -> bool:
+        """Do this message's predicted signature checks ALL verify?
+        Uses the batch verdicts when available, the scheduler otherwise
+        (conflicts are rare, so the extra dispatch is cheap).  Empty
+        collection means we cannot vouch — False."""
+        if not sets:
+            return False
+        for s in sets:
+            verdict = by_key.get(s.key()) if by_key else None
+            if verdict is None:
+                verdict = all(scheduler.verify_sets(
+                    [s], mode=self.config.mode))
+            if not verdict:
+                return False
+        return True
+
+    def _admit_and_deliver(self, message: Message, collected, by_key,
+                           verdict_map) -> None:
+        """Quarantine/equivocation gate, then delivery.  Votes are
+        recorded only from ACCEPTED (signature-verified) messages, and a
+        conflicting message sheds pre-delivery only when its OWN
+        signature verifies — unverified junk can neither frame a
+        validator nor count as evidence.  Multi-signer aggregates are
+        never shed here: one equivocator must not censor a committee."""
+        votes = collected.votes
+        sole = votes[0] if len(votes) == 1 else None
+        # blocks are EXEMPT from the pre-delivery gate: a valid proposal
+        # from a locally-quarantined (attestation-equivocating) validator
+        # is still canonical for the rest of the network — refusing it
+        # would fork this node off the chain.  Proposer equivocation is
+        # still detected post-acceptance (observe() below quarantines
+        # with evidence); only non-block traffic is shed.
+        if sole is not None and message.topic != "block":
+            kind, validator_index, vote_key, digest = sole
+            if self.guard.is_quarantined(validator_index):
+                METRICS.inc_labeled("gossip_shed", "quarantined")
+                self._finalize(message, "shed", "quarantined")
+                return
+            first = self.guard.first_vote(kind, validator_index,
+                                          vote_key)
+            if (first is not None and first != digest
+                    and self._sets_verify(collected.sets, by_key)):
+                self.guard.quarantine(kind, validator_index, vote_key,
+                                      first, digest)
+                METRICS.inc_labeled("gossip_shed", "equivocation")
+                self._finalize(message, "shed", "equivocation")
+                return
+        accepted = self._deliver(message, verdict_map)
+        if accepted and votes:
+            # every handler proves the signature as part of acceptance
+            # EXCEPT eip7732's PTC handler, which no-op-accepts
+            # stale-slot messages unverified — for that topic a vote is
+            # recorded only when the predicted checks verified, so junk
+            # can never frame a validator through the ignore path
+            if (message.topic not in _UNVERIFIED_ACCEPT_TOPICS
+                    or self._sets_verify(collected.sets, by_key)):
+                for kind, validator_index, vote_key, digest in votes:
+                    self.guard.observe(kind, validator_index, vote_key,
+                                       digest)
+
+    # -- delivery ------------------------------------------------------
+    def _deliver(self, message: Message, verdict_map) -> bool:
+        self.delivered_log.append((message.seq, message.topic,
+                                   message.payload))
+        use_map = verdict_map is not None and message.topic != "block"
+        if use_map:
+            with self.spec.install_sigpipe_verdicts(verdict_map):
+                accepted, detail = apply_scalar(
+                    self.spec, self.store, message.topic, message.payload)
+        else:
+            accepted, detail = apply_scalar(
+                self.spec, self.store, message.topic, message.payload)
+        if accepted:
+            METRICS.inc_labeled("gossip_accepted", message.topic)
+            self._finalize(message, "accepted")
+            if message.topic == "block":
+                prewarm_block(self.spec, self.store,
+                              hash_tree_root(message.payload.message))
+        else:
+            METRICS.inc_labeled("gossip_rejected", message.topic)
+            # rejections are often TRANSIENT (attestation a slot early,
+            # target block not yet imported — the p2p spec's IGNORE
+            # class): forget the digest so honest redelivery revalidates
+            # once the condition clears, instead of dying as 'duplicate'
+            self.seen.discard(message.digest)
+            self._finalize(message, "rejected", detail)
+        return accepted
+
+    def _finalize(self, message: Message, status: str,
+                  detail: str = "") -> None:
+        self.results[message.seq] = Result(
+            message.seq, message.topic, message.peer, status, detail)
+        # O(1) amortized pruning: finalized verdicts evict oldest-first
+        # once over the bound.  The bound counts FINALIZED entries only
+        # — in-flight (queued/deferred) entries are never evicted and
+        # must not displace fresh verdicts either, or a large deferred
+        # backlog would evict every new verdict the moment it lands
+        self._finalized_order.append(message.seq)
+        while len(self._finalized_order) > self.config.history_bound:
+            seq = self._finalized_order.popleft()
+            if self.results.get(seq) is not None and \
+                    self.results[seq].final:
+                del self.results[seq]
+
+    def verdicts(self) -> list:
+        """Every finalized Result in arrival order."""
+        return [self.results[seq] for seq in sorted(self.results)
+                if self.results[seq].final]
+
+
+_HANDLER_METHODS = {
+    "attestation": "on_attestation",
+    "aggregate": "on_aggregate_and_proof",
+    "sync": "on_sync_committee_message",
+    "block": "on_block",
+    "payload_attestation": "on_payload_attestation_message",
+}
+
+_HANDLERS = {
+    "attestation": lambda spec, store, payload:
+        spec.on_attestation(store, payload, is_from_block=False),
+    "aggregate": lambda spec, store, payload:
+        spec.on_aggregate_and_proof(store, payload),
+    "sync": lambda spec, store, payload:
+        spec.on_sync_committee_message(store, payload),
+    "block": lambda spec, store, payload:
+        spec.on_block(store, payload),
+    "payload_attestation": lambda spec, store, payload:
+        spec.on_payload_attestation_message(store, payload),
+}
+
+
+def apply_scalar(spec, store, topic, payload):
+    """THE per-message oracle: apply one gossip message through its bare
+    fork-choice handler; returns (accepted, rejection detail).  The
+    pipeline's delivery loop calls exactly this (with batch verdicts
+    installed at the seams), so pipeline and oracle share one handler
+    table and one rejection-exception contract by construction."""
+    try:
+        _HANDLERS[topic](spec, store, payload)
+    except REJECTION_ERRORS as e:
+        return False, f"{type(e).__name__}: {e}"
+    return True, ""
+
+
+def store_fingerprint(spec, store) -> dict:
+    """JSON-able digest of the observable fork-choice store state — what
+    the parity tests compare between the pipeline and the sequential
+    oracle."""
+    head = spec.get_head(store)
+    head = getattr(head, "root", head)
+    checkpoint = lambda c: (int(c.epoch), bytes(c.root).hex())  # noqa: E731
+    return {
+        "time": int(store.time),
+        "head": bytes(head).hex(),
+        "blocks": sorted(bytes(r).hex() for r in store.blocks),
+        "justified": checkpoint(store.justified_checkpoint),
+        "finalized": checkpoint(store.finalized_checkpoint),
+        "unrealized_justified":
+            checkpoint(store.unrealized_justified_checkpoint),
+        "proposer_boost_root": bytes(store.proposer_boost_root).hex(),
+        "checkpoint_states": sorted(
+            checkpoint(c) for c in store.checkpoint_states),
+        "latest_messages": {
+            int(i): (int(getattr(m, "epoch", getattr(m, "slot", 0))),
+                     bytes(m.root).hex())
+            for i, m in store.latest_messages.items()},
+        "equivocating_indices": sorted(
+            int(i) for i in store.equivocating_indices),
+    }

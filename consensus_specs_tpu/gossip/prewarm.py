@@ -1,0 +1,69 @@
+"""Fork-choice cache pre-warm (closes the ROADMAP cross-block reuse item).
+
+`on_block` replays are the norm, not the exception: sibling blocks at
+one slot carry overlapping attestation sets, fork-choice re-applies
+gossip aggregates whose committees a just-imported block already
+aggregated, and checkpoint re-orgs re-verify whole committee surfaces.
+The expensive host-side step is the participant G1 aggregation —
+O(committee) point adds per set — and sigpipe's aggregate cache is
+already content-addressed (keyed by the participant-pubkey digest), so
+a warm entry is correct no matter who computed it.
+
+After a block is accepted into the store, `prewarm_block` pushes every
+participant aggregate the block implies into that cache via
+`AggregatePubkeyCache.warm()` (counted as `aggregate_cache_prewarms`,
+never distorting the hit rate): each attestation's attesting set and
+the sync aggregate's participant set.  A later gossip aggregate, a
+sibling block, or a fork-choice replay with the same participants then
+hits warm regardless of which path first saw the block — even when the
+block itself was verified scalar.
+
+Best-effort like all collection: a skipped set is a missed warm-up,
+never an error.
+"""
+from __future__ import annotations
+
+from ..sigpipe.cache import AGGREGATES
+from ..sigpipe.metrics import METRICS
+
+
+def prewarm_block(spec, store, block_root) -> int:
+    """Warm the aggregate-pubkey cache with every participant set the
+    accepted block at `block_root` implies; returns how many entries
+    were actually cold (work done)."""
+    block = store.blocks[block_root]
+    state = store.block_states[block_root]
+    warmed = 0
+    for attestation in block.body.attestations:
+        try:
+            indexed = spec.get_indexed_attestation(state, attestation)
+            indices = [int(i) for i in indexed.attesting_indices]
+            if not indices:
+                continue
+            pubkeys = [bytes(state.validators[i].pubkey)
+                       for i in indices]
+            data = attestation.data
+            if AGGREGATES.warm(pubkeys,
+                               hint=("att", int(data.target.epoch),
+                                     int(getattr(data, "index", 0)))):
+                warmed += 1
+        except Exception:
+            METRICS.inc("gossip_prewarm_skipped")
+    if spec.is_post("altair"):
+        try:
+            aggregate = block.body.sync_aggregate
+            participants = [
+                bytes(pk) for pk, bit in zip(
+                    state.current_sync_committee.pubkeys,
+                    aggregate.sync_committee_bits) if bit]
+            if participants:
+                epoch = int(spec.get_current_epoch(state))
+                period = epoch // int(
+                    spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)
+                if AGGREGATES.warm(participants, hint=("sync", period)):
+                    warmed += 1
+        except Exception:
+            METRICS.inc("gossip_prewarm_skipped")
+    if warmed:
+        METRICS.inc("gossip_prewarmed_aggregates", warmed)
+    return warmed

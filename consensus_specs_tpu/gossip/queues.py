@@ -1,0 +1,64 @@
+"""Bounded per-topic ingress queues with an explicit overflow policy.
+
+A production node cannot let gossip ingress grow without bound: under a
+spam flood (or a slow verification backend) an unbounded queue turns
+into unbounded memory growth and unbounded latency — the node falls
+minutes behind the chain while faithfully verifying garbage.  The
+admission pipeline therefore buffers each topic in a `BoundedQueue`
+whose overflow policy is *shed-oldest*: the newest message is always
+admitted and the oldest queued message is dropped to make room.
+
+Shed-oldest (not shed-newest) because gossip value decays with age: the
+newest attestation is the one the fork choice still wants; an
+attestation that sat through `depth` arrivals without being drained is
+the one whose slot-clock relevance has already decayed.  Every shed is
+loud: an incident-log entry (`gossip.queue.<topic>` / `overflow_shed`)
+plus the `gossip_shed{overflow}` labeled counter — bounded ingress that
+lies about what it dropped is worse than unbounded ingress.
+"""
+from __future__ import annotations
+
+from collections import deque
+
+from ..resilience.incidents import INCIDENTS
+from ..sigpipe.metrics import METRICS
+
+
+class BoundedQueue:
+    """FIFO of admitted messages for one gossip topic."""
+
+    def __init__(self, topic: str, max_depth: int,
+                 metrics=METRICS, incidents=INCIDENTS):
+        assert max_depth > 0
+        self.topic = topic
+        self.max_depth = int(max_depth)
+        self._items: deque = deque()
+        self._metrics = metrics
+        self._incidents = incidents
+        self.shed_count = 0
+
+    def push(self, item):
+        """Enqueue `item`; returns the message shed to make room (the
+        oldest), or None when the queue had capacity."""
+        shed = None
+        if len(self._items) >= self.max_depth:
+            shed = self._items.popleft()
+            self.shed_count += 1
+            self._metrics.inc_labeled("gossip_shed", "overflow")
+            self._incidents.record(
+                f"gossip.queue.{self.topic}", "overflow_shed",
+                depth=self.max_depth,
+                seq=getattr(shed, "seq", None))
+        self._items.append(item)
+        self._metrics.observe(f"gossip_queue_depth_{self.topic}",
+                              len(self._items))
+        return shed
+
+    def pop_all(self) -> list:
+        """Drain the queue in arrival order."""
+        items = list(self._items)
+        self._items.clear()
+        return items
+
+    def __len__(self) -> int:
+        return len(self._items)

@@ -1,0 +1,166 @@
+"""Per-peer token-bucket quotas with backpressure.
+
+Fairness is the point: one spamming peer must degrade only its own
+throughput, never the node's.  Each peer gets a token bucket
+(`capacity` burst, `refill_rate` tokens/sec, refilled lazily from the
+injected clock — utils/clock.py, so seeded schedules replay exactly);
+each submitted message costs one token.  An over-quota message is
+*deferred* (parked on the peer's bounded backlog and retried when the
+bucket refills — backpressure) or *shed* outright under the "shed"
+policy; both outcomes are recorded in the incident log and the
+`gossip_shed`/`gossip_quota_deferred` counters, so a quota decision is
+always reconstructable from the audit trail.
+
+The peer table itself is bounded (LRU over `max_peers`): an attacker
+who invents a new peer identity per message must not grow node memory —
+evicted peers simply start over with a fresh (full) bucket, which costs
+the attacker more than it costs us.  An evicted peer's deferred backlog
+is handed back to the pipeline (`pop_evicted()`) to be finalized as
+shed, with a `peer_evicted` incident — never silently dropped.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict, deque
+
+from ..resilience.incidents import INCIDENTS
+from ..sigpipe.metrics import METRICS
+from ..utils.clock import MONOTONIC
+
+
+class TokenBucket:
+    def __init__(self, capacity: float, refill_rate: float, clock):
+        self.capacity = float(capacity)
+        self.refill_rate = float(refill_rate)
+        self._clock = clock
+        self._tokens = float(capacity)
+        self._updated = clock.now()
+
+    def _refill(self) -> None:
+        now = self._clock.now()
+        self._tokens = min(self.capacity,
+                           self._tokens
+                           + (now - self._updated) * self.refill_rate)
+        self._updated = now
+
+    def take(self, n: float = 1.0) -> bool:
+        self._refill()
+        if self._tokens >= n:
+            self._tokens -= n
+            return True
+        return False
+
+    def tokens(self) -> float:
+        self._refill()
+        return self._tokens
+
+
+class PeerQuotas:
+    """One bucket per peer, plus the per-peer deferred backlog."""
+
+    def __init__(self, capacity: float, refill_rate: float,
+                 policy: str = "defer", max_deferred: int = 256,
+                 max_peers: int = 1024, clock=MONOTONIC,
+                 metrics=METRICS, incidents=INCIDENTS):
+        assert policy in ("defer", "shed")
+        self.capacity = capacity
+        self.refill_rate = refill_rate
+        self.policy = policy
+        self.max_deferred = int(max_deferred)
+        self.max_peers = int(max_peers)
+        self._clock = clock
+        self._metrics = metrics
+        self._incidents = incidents
+        self._buckets: OrderedDict = OrderedDict()
+        self._deferred: dict = {}       # peer -> deque of messages
+        self._evicted_backlog: list = []    # messages orphaned by LRU
+        # earliest instant any deferred peer can afford a token: the
+        # per-submit refill poll is O(1) until then, so attacker-parked
+        # backlogs cannot tax every later message's admission
+        self._next_refill = float("inf")
+
+    def _bucket(self, peer: str) -> TokenBucket:
+        bucket = self._buckets.get(peer)
+        if bucket is None:
+            while len(self._buckets) >= self.max_peers:
+                evicted, _ = self._buckets.popitem(last=False)
+                orphaned = self._deferred.pop(evicted, ())
+                if orphaned:
+                    self._evicted_backlog.extend(orphaned)
+                    self._incidents.record(
+                        "gossip.quota", "peer_evicted", peer=evicted,
+                        dropped=len(orphaned))
+            bucket = self._buckets[peer] = TokenBucket(
+                self.capacity, self.refill_rate, self._clock)
+        else:
+            self._buckets.move_to_end(peer)
+        return bucket
+
+    def pop_evicted(self) -> list:
+        """Deferred messages orphaned by peer-table eviction since the
+        last call; the pipeline finalizes them as shed."""
+        orphaned, self._evicted_backlog = self._evicted_backlog, []
+        return orphaned
+
+    def admit(self, peer: str, message) -> str:
+        """Charge one token for `message`; returns "ok", "deferred", or
+        "shed".  Deferred messages are held on the peer's backlog and
+        come back via take_refilled() once tokens exist again."""
+        if self._bucket(peer).take(1.0):
+            return "ok"
+        # unlabeled on purpose: a per-peer label would key a metrics
+        # series by attacker-controlled identity (unbounded growth);
+        # the bounded incident log carries the peer attribution
+        self._metrics.inc("gossip_quota_rejections")
+        if self.policy == "defer":
+            backlog = self._deferred.setdefault(peer, deque())
+            if len(backlog) < self.max_deferred:
+                backlog.append(message)
+                self._next_refill = min(self._next_refill,
+                                        self._token_eta(peer))
+                self._metrics.inc("gossip_quota_deferred")
+                self._incidents.record(
+                    "gossip.quota", "quota_deferred", peer=peer,
+                    seq=getattr(message, "seq", None))
+                return "deferred"
+            # backlog full: the slow lane is saturated too — shed
+        self._metrics.inc_labeled("gossip_shed", "quota")
+        self._incidents.record(
+            "gossip.quota", "quota_shed", peer=peer,
+            seq=getattr(message, "seq", None))
+        return "shed"
+
+    def _token_eta(self, peer: str) -> float:
+        """When `peer`'s bucket can next afford one token."""
+        bucket = self._buckets.get(peer)
+        if bucket is None or self.refill_rate <= 0:
+            return float("inf")
+        deficit = max(0.0, 1.0 - bucket.tokens())
+        return self._clock.now() + deficit / self.refill_rate
+
+    def take_refilled(self) -> list:
+        """Deferred messages whose peers have tokens again, charged and
+        released in original arrival (seq) order across peers.  O(1)
+        until the earliest bucket can actually afford a token.  Reads
+        buckets WITHOUT refreshing the LRU: a refill poll is
+        bookkeeping, not peer activity — only real submissions keep a
+        peer warm in the table."""
+        if not self._deferred or self._clock.now() < self._next_refill:
+            return []
+        released = []
+        for peer in list(self._deferred):
+            bucket = self._buckets.get(peer)
+            if bucket is None:
+                continue    # eviction orphans the backlog with it
+            backlog = self._deferred[peer]
+            while backlog and bucket.take(1.0):
+                released.append(backlog.popleft())
+            if not backlog:
+                del self._deferred[peer]
+        self._next_refill = min(
+            (self._token_eta(peer) for peer in self._deferred),
+            default=float("inf"))
+        released.sort(key=lambda m: getattr(m, "seq", 0))
+        return released
+
+    def deferred_count(self) -> int:
+        return sum(len(q) for q in self._deferred.values())

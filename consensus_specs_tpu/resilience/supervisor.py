@@ -39,10 +39,10 @@ from __future__ import annotations
 
 import queue
 import threading
-import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from ..sigpipe.metrics import METRICS
+from ..utils.clock import MONOTONIC
 from . import faults
 from .incidents import INCIDENTS
 
@@ -64,6 +64,12 @@ class SupervisorConfig:
     probe_after: int = 4          # fallback calls in OPEN before a probe
     cooldown_s: float = 0.0       # min wall-clock in OPEN before a probe
     deadline_s: float | None = None   # watchdog; None = no watchdog
+    # decision clock (utils/clock.py): breaker cooldown reads and retry
+    # backoff sleeps go through it so chaos schedules replay
+    # deterministically under a ManualClock.  The watchdog deadline
+    # stays on real thread waits — it times an actual worker thread,
+    # which no virtual clock can advance.
+    clock: object = field(default_factory=lambda: MONOTONIC)
 
 
 class _Breaker:
@@ -125,6 +131,7 @@ class _SiteWorker:
 class Supervisor:
     def __init__(self, config: SupervisorConfig | None = None, **overrides):
         self.config = config or SupervisorConfig(**overrides)
+        self._clock = self.config.clock
         self._breakers: dict = {}
         self._workers: dict = {}
         self._worker_locks: dict = {}
@@ -147,7 +154,7 @@ class Supervisor:
             if br.state != QUARANTINED:
                 br.state = QUARANTINED
                 br.quarantine_reason = reason
-                br.tripped_at = time.monotonic()
+                br.tripped_at = self._clock.now()
                 br.trips += 1
                 METRICS.inc("breaker_trips")
                 METRICS.inc("quarantines")
@@ -182,7 +189,7 @@ class Supervisor:
             if state == OPEN:
                 br.fallbacks_since_trip += 1
                 if (br.fallbacks_since_trip >= self.config.probe_after
-                        and (time.monotonic() - br.tripped_at
+                        and (self._clock.now() - br.tripped_at
                              >= self.config.cooldown_s)):
                     br.state = state = HALF_OPEN
                     INCIDENTS.record(site, "probe")
@@ -209,7 +216,7 @@ class Supervisor:
                     backoff = self.config.backoff_base_s * (
                         2 ** (attempt - 1))
                     if backoff > 0:
-                        time.sleep(backoff)
+                        self._clock.sleep(backoff)
                     continue
                 self._on_failure(site, br, state)
                 # label by what the breaker actually did: below the trip
@@ -266,14 +273,14 @@ class Supervisor:
                 # failed probe: back to OPEN, wait a full window again
                 br.state = OPEN
                 br.fallbacks_since_trip = 0
-                br.tripped_at = time.monotonic()
+                br.tripped_at = self._clock.now()
                 INCIDENTS.record(site, "probe_failed")
                 METRICS.inc("breaker_probe_failures")
             elif (br.state == CLOSED and br.consecutive_failures
                     >= self.config.breaker_threshold):
                 br.state = OPEN
                 br.fallbacks_since_trip = 0
-                br.tripped_at = time.monotonic()
+                br.tripped_at = self._clock.now()
                 br.trips += 1
                 INCIDENTS.record(
                     site, "trip", failures=br.consecutive_failures)

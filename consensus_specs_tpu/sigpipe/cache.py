@@ -75,16 +75,40 @@ class AggregatePubkeyCache:
         self._metrics = metrics
         self._lock = threading.RLock()
 
+    @staticmethod
+    def _digest(pubkey_bytes_list) -> bytes:
+        return hashlib.sha256(
+            b"".join(bytes(pk) for pk in pubkey_bytes_list)).digest()
+
     def aggregate(self, pubkey_bytes_list, hint=None) -> cv.Point:
         """Sum of the (decompressed) pubkeys; cached by content digest."""
-        digest = hashlib.sha256(
-            b"".join(bytes(pk) for pk in pubkey_bytes_list)).digest()
+        digest = self._digest(pubkey_bytes_list)
         with self._lock:
             entry = self._cache.get(digest)
         if entry is not None:
             self._metrics.inc("aggregate_cache_hits")
             return entry[0]
         self._metrics.inc("aggregate_cache_misses")
+        agg = self._compute_and_insert(digest, pubkey_bytes_list, hint)
+        return agg
+
+    def warm(self, pubkey_bytes_list, hint=None) -> bool:
+        """Pre-compute an aggregate OUTSIDE a verification (the
+        fork-choice on_block pre-warm, gossip/prewarm.py): inserts like
+        `aggregate` but counts `aggregate_cache_prewarms` instead of a
+        hit or a miss, so warm-up work never distorts the hit rate the
+        dashboards track.  Returns True when the entry was actually cold
+        (work done), False when it was already cached."""
+        digest = self._digest(pubkey_bytes_list)
+        with self._lock:
+            if digest in self._cache:
+                return False
+        self._metrics.inc("aggregate_cache_prewarms")
+        self._compute_and_insert(digest, pubkey_bytes_list, hint)
+        return True
+
+    def _compute_and_insert(self, digest, pubkey_bytes_list,
+                            hint) -> cv.Point:
         agg = cv.g1_infinity()
         for pk in pubkey_bytes_list:
             agg = agg + self._pubkeys.get(pk)

@@ -16,6 +16,15 @@ the `scalar_fallbacks` counter is labeled by degradation reason
 (`collector_miss`, `breaker_open`, `dispatch_failed`, `guard_mismatch`,
 `disabled`) so a metrics snapshot says not just that the pipeline
 degraded but why.
+
+Histograms (`observe_hist`) bucket integer observations by
+power-of-two: the gossip admission layer records batch occupancy per
+flush here (`batch_occupancy`: how many signature sets each dispatch
+actually fused — the number that decides whether batching pays), and
+the window-flush reason rides a labeled counter (`gossip_window_flushes`:
+`deadline` vs `size` vs `drain`).  Buckets instead of raw samples keep
+the registry O(log max) per series while still answering "mostly
+singletons or mostly full windows?".
 """
 from __future__ import annotations
 
@@ -35,6 +44,7 @@ class Metrics:
             self.counters: dict = {}
             self.labeled: dict = {}
             self.observations: dict = {}
+            self.histograms: dict = {}
             self.timers: dict = {}
 
     # -- counters ------------------------------------------------------
@@ -73,6 +83,25 @@ class Metrics:
                 o["min"] = min(o["min"], value)
                 o["max"] = max(o["max"], value)
 
+    # -- histograms (power-of-two buckets over non-negative ints) ------
+    @staticmethod
+    def _bucket(value: int) -> str:
+        if value <= 0:
+            return "0"
+        return str(1 << (int(value) - 1).bit_length())
+
+    def observe_hist(self, name: str, value: int) -> None:
+        """Count `value` into its power-of-two bucket (1,2,4,8,...):
+        bucket "8" holds observations in (4, 8]."""
+        bucket = self._bucket(value)
+        with self._lock:
+            series = self.histograms.setdefault(name, {})
+            series[bucket] = series.get(bucket, 0) + 1
+
+    def hist_counts(self, name: str) -> dict:
+        with self._lock:
+            return dict(self.histograms.get(name, {}))
+
     # -- timers --------------------------------------------------------
     @contextmanager
     def timer(self, name: str):
@@ -94,6 +123,11 @@ class Metrics:
                 out[name] = dict(o)
                 if o["count"]:
                     out[name]["mean"] = o["total"] / o["count"]
+            for name, series in self.histograms.items():
+                # numeric bucket order so the JSON reads as a histogram
+                out[f"{name}_hist"] = {
+                    b: series[b]
+                    for b in sorted(series, key=lambda s: int(s))}
             for name, secs in self.timers.items():
                 out[f"{name}_sec"] = round(secs, 6)
             # derived rates the dashboards care about
@@ -102,6 +136,11 @@ class Metrics:
             if hits + misses:
                 out["pubkey_cache_hit_rate"] = round(
                     hits / (hits + misses), 4)
+            dedup_hits = self.counters.get("gossip_dedup_hits", 0)
+            dedup_misses = self.counters.get("gossip_dedup_misses", 0)
+            if dedup_hits + dedup_misses:
+                out["gossip_dedup_hit_rate"] = round(
+                    dedup_hits / (dedup_hits + dedup_misses), 4)
             return out
 
     def to_json(self) -> str:

@@ -168,7 +168,11 @@ def _guard_verdicts(sets, verdicts):
 def verify_sets(sets, mode: str = "fused"):
     """Verdict per SignatureSet.  `mode` is "fused" or "per-set"."""
     n = len(sets)
+    if n == 0:
+        return []       # an empty window is not a batch: no dispatch,
+        # no stub counting, no occupancy sample
     METRICS.observe("batch_size", n)
+    METRICS.observe_hist("batch_occupancy", n)
     METRICS.inc("signatures_scheduled", n)
     if not bls.bls_active:
         # stub-True contract, zero dispatches (matches the scalar API)

@@ -30,6 +30,16 @@ Semantics mirrored precisely:
   participant set with the infinity signature — no set is emitted.
 * phase0's `is_valid_indexed_attestation` returns False for empty or
   unsorted indices without touching BLS — no set is emitted.
+* whisk's proposer comes from the opened tracker, not the shuffle: the
+  randao collector uses `block.proposer_index` there (the value the
+  post-header inline check reads).  Whisk's shuffle / registration /
+  opening proofs are *intentionally not collected*: they are
+  curdleproofs-style arguments (crypto/whisk_proofs.py), not BLS
+  (pubkeys, root, signature) triples, so they never reach the bls
+  seams and cannot ride the pairing-product batch.  The per-fork audit
+  (tests/test_sigpipe.py::test_whisk_block_pipeline) pins that a whisk
+  block's *BLS* surface is fully collected — zero `collector_miss`
+  fallbacks — with the proof checks running inline as before.
 """
 from __future__ import annotations
 
@@ -83,22 +93,48 @@ def _proposer(spec, state, signed_block, out):
                     "proposer"))
 
 
-def _randao(spec, state, body, out):
+def _randao(spec, state, signed_block, out):
+    body = signed_block.message.body
     epoch = spec.get_current_epoch(state)
-    proposer = state.validators[spec.get_beacon_proposer_index(state)]
+    if spec.is_post("whisk"):
+        # whisk replaces the computed proposer index with whoever opened
+        # the tracker: get_beacon_proposer_index reads the block header,
+        # which is not processed yet at collection time.  The inline
+        # path verifies randao AFTER process_block_header pinned the
+        # proposer to block.proposer_index, so that field is exactly the
+        # index the scalar check will use.
+        proposer_index = signed_block.message.proposer_index
+    else:
+        proposer_index = spec.get_beacon_proposer_index(state)
+    proposer = state.validators[proposer_index]
     root = spec.compute_signing_root(
         uint64(epoch), spec.get_domain(state, spec.DOMAIN_RANDAO))
     out.append(_set([proposer.pubkey], root, body.randao_reveal, "randao"))
 
 
-def _indexed_attestation_set(spec, state, indexed, kind, origin):
+def indexed_attestation_parts(spec, state, indexed):
+    """(indices, pubkeys, signing_root) that
+    `is_valid_indexed_attestation` will feed into BLS, or None when the
+    inline check returns False before touching BLS (empty or unsorted
+    indices).  THE single mirror of that derivation — the block
+    collector below and the gossip collector (gossip/collect.py) both
+    ride it, so a fork that changes indexed-attestation validity only
+    has one place to update."""
     indices = [int(i) for i in indexed.attesting_indices]
     if len(indices) == 0 or indices != sorted(set(indices)):
-        return None     # inline is_valid_indexed_attestation: False, no BLS
+        return None
     pubkeys = [state.validators[i].pubkey for i in indices]
     domain = spec.get_domain(state, spec.DOMAIN_BEACON_ATTESTER,
                              indexed.data.target.epoch)
     root = spec.compute_signing_root(indexed.data, domain)
+    return indices, pubkeys, root
+
+
+def _indexed_attestation_set(spec, state, indexed, kind, origin):
+    parts = indexed_attestation_parts(spec, state, indexed)
+    if parts is None:
+        return None     # inline is_valid_indexed_attestation: False, no BLS
+    _indices, pubkeys, root = parts
     return _set(pubkeys, root, indexed.signature, kind, origin)
 
 
@@ -305,7 +341,8 @@ def collect_block_sets(spec, state, signed_block):
     body = signed_block.message.body
     _guarded(out, "proposer",
              lambda o: _proposer(spec, state, signed_block, o))
-    _guarded(out, "randao", lambda o: _randao(spec, state, body, o))
+    _guarded(out, "randao",
+             lambda o: _randao(spec, state, signed_block, o))
     if spec.is_post("eip7732"):
         _guarded(out, "payload_header",
                  lambda o: _payload_header(spec, state, body, o))

@@ -76,9 +76,11 @@ class VerdictMap:
         return len(self._verdicts)
 
 
-def _batch_verify_unique(collected):
+def _batch_verify_unique(collected, mode: str | None = None):
     """Dedup identical checks (same pubkeys/root/signature verify once),
-    batch-verify, and return the content-keyed verdict dict."""
+    batch-verify, and return the content-keyed verdict dict.  `mode`
+    defaults to the module's enabled mode; the gossip micro-batcher
+    passes its own."""
     unique: dict = {}
     for s in collected:
         unique.setdefault(s.key(), s)
@@ -86,7 +88,8 @@ def _batch_verify_unique(collected):
     if dropped:
         METRICS.inc("dedup_saved", dropped)
     unique_sets = list(unique.values())
-    unique_verdicts = scheduler.verify_sets(unique_sets, mode=_mode)
+    unique_verdicts = scheduler.verify_sets(
+        unique_sets, mode=mode if mode is not None else _mode)
     return {s.key(): v for s, v in zip(unique_sets, unique_verdicts)}
 
 

@@ -389,6 +389,22 @@ class Eip7732ForkChoice:
                 store, store.unrealized_justified_checkpoint,
                 store.unrealized_finalized_checkpoint)
 
+    def gossip_payload_attestation_check(self, store, ptc_message):
+        """(pubkeys, signing_root, signature) that
+        `on_payload_attestation_message` will verify for a non-block
+        message — the read-only collection hook the gossip micro-batcher
+        uses (gossip/collect.py).  Mirrors
+        is_valid_indexed_payload_attestation for a single-validator
+        indexed attestation; the handler's own call flows through the
+        bls_fast_aggregate_verify seam, so a batch verdict collected
+        from this tuple substitutes at the exact inline call site."""
+        data = ptc_message.data
+        state = store.block_states[data.beacon_block_root]
+        pubkey = state.validators[ptc_message.validator_index].pubkey
+        domain = self.get_domain(state, self.DOMAIN_PTC_ATTESTER, None)
+        signing_root = self.compute_signing_root(data, domain)
+        return (pubkey,), signing_root, ptc_message.signature
+
     def on_payload_attestation_message(self, store, ptc_message,
                                        is_from_block: bool = False) -> None:
         data = ptc_message.data

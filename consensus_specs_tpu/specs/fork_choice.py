@@ -334,15 +334,23 @@ class Phase0ForkChoice:
         # only apply after the attestation's slot has passed
         assert self.get_current_slot(store) >= attestation.data.slot + 1
 
+    def compute_target_checkpoint_state(self, store, target):
+        """The checkpoint state for `target`, computed on a private copy
+        — the pure half of store_target_checkpoint_state.  The gossip
+        collector (gossip/collect.py) calls this directly so its
+        predicted signing roots can never drift from the handler's."""
+        base_state = store.block_states[target.root].copy()
+        if base_state.slot < self.compute_start_slot_at_epoch(
+                target.epoch):
+            self.process_slots(base_state,
+                               self.compute_start_slot_at_epoch(
+                                   target.epoch))
+        return base_state
+
     def store_target_checkpoint_state(self, store, target) -> None:
         if target not in store.checkpoint_states:
-            base_state = store.block_states[target.root].copy()
-            if base_state.slot < self.compute_start_slot_at_epoch(
-                    target.epoch):
-                self.process_slots(base_state,
-                                   self.compute_start_slot_at_epoch(
-                                       target.epoch))
-            store.checkpoint_states[target] = base_state
+            store.checkpoint_states[target] = \
+                self.compute_target_checkpoint_state(store, target)
 
     def update_latest_messages(self, store, attesting_indices,
                                attestation) -> None:
@@ -357,9 +365,10 @@ class Phase0ForkChoice:
                 store.latest_messages[i] = LatestMessage(
                     epoch=int(target.epoch), root=beacon_block_root)
 
-    def on_attestation(self, store, attestation,
-                       is_from_block: bool = False) -> None:
-        self.validate_on_attestation(store, attestation, is_from_block)
+    def apply_attestation(self, store, attestation) -> None:
+        """The store-update half of on_attestation (post-validation):
+        cache the target checkpoint state, verify the indexed
+        attestation, record the latest messages."""
         self.store_target_checkpoint_state(store, attestation.data.target)
         target_state = store.checkpoint_states[attestation.data.target]
         indexed_attestation = self.get_indexed_attestation(
@@ -368,6 +377,129 @@ class Phase0ForkChoice:
             target_state, indexed_attestation)
         self.update_latest_messages(
             store, indexed_attestation.attesting_indices, attestation)
+
+    def on_attestation(self, store, attestation,
+                       is_from_block: bool = False) -> None:
+        self.validate_on_attestation(store, attestation, is_from_block)
+        self.apply_attestation(store, attestation)
+
+    # ------------------------------------------------------------------
+    # gossip-path handlers (p2p-interface.md validation, executable
+    # subset).  These are what the admission pipeline (gossip/) fronts;
+    # every signature check flows through the bls_verify /
+    # bls_fast_aggregate_verify seams so a micro-batch verdict can stand
+    # in for the scalar call with byte-identical accept/reject behavior.
+    # ------------------------------------------------------------------
+    def aggregate_committee_index(self, aggregate) -> int:
+        """Committee index of an aggregate: data.index pre-electra, the
+        single set bit of committee_bits after EIP-7549."""
+        bits = getattr(aggregate, "committee_bits", None)
+        if bits is not None:
+            indices = self.get_committee_indices(bits)
+            assert len(indices) == 1
+            return indices[0]
+        return aggregate.data.index
+
+    def gossip_selection_proof_check(self, state, aggregate_and_proof):
+        """(pubkeys, signing_root, signature) of an aggregator's
+        selection proof — THE single derivation, consumed by both
+        validate_aggregate_and_proof and the gossip collector so the
+        two can never drift."""
+        aggregate = aggregate_and_proof.aggregate
+        pubkey = state.validators[
+            int(aggregate_and_proof.aggregator_index)].pubkey
+        domain = self.get_domain(
+            state, self.DOMAIN_SELECTION_PROOF,
+            self.compute_epoch_at_slot(aggregate.data.slot))
+        root = self.compute_signing_root(uint64(aggregate.data.slot),
+                                         domain)
+        return (pubkey,), root, aggregate_and_proof.selection_proof
+
+    def gossip_aggregate_and_proof_check(self, state, signed):
+        """(pubkeys, signing_root, signature) of the outer
+        SignedAggregateAndProof envelope — shared with the collector."""
+        aggregate_and_proof = signed.message
+        pubkey = state.validators[
+            int(aggregate_and_proof.aggregator_index)].pubkey
+        domain = self.get_domain(
+            state, self.DOMAIN_AGGREGATE_AND_PROOF,
+            self.compute_epoch_at_slot(
+                aggregate_and_proof.aggregate.data.slot))
+        root = self.compute_signing_root(aggregate_and_proof, domain)
+        return (pubkey,), root, signed.signature
+
+    def validate_aggregate_and_proof(self, store, signed) -> None:
+        """beacon_aggregate_and_proof gossip validation: the inner
+        aggregate passes on_attestation validation, the aggregator is a
+        selected member of the committee, and both the selection proof
+        and the outer signature verify."""
+        aggregate_and_proof = signed.message
+        aggregate = aggregate_and_proof.aggregate
+        aggregator_index = int(aggregate_and_proof.aggregator_index)
+        self.validate_on_attestation(store, aggregate, is_from_block=False)
+        self.store_target_checkpoint_state(store, aggregate.data.target)
+        state = store.checkpoint_states[aggregate.data.target]
+        index = self.aggregate_committee_index(aggregate)
+        committee = self.get_beacon_committee(
+            state, aggregate.data.slot, index)
+        assert aggregator_index in [int(i) for i in committee]
+        assert self.is_aggregator(state, aggregate.data.slot, index,
+                                  aggregate_and_proof.selection_proof)
+        pubkeys, root, signature = self.gossip_selection_proof_check(
+            state, aggregate_and_proof)
+        assert self.bls_verify(pubkeys[0], root, signature)
+        pubkeys, root, signature = self.gossip_aggregate_and_proof_check(
+            state, signed)
+        assert self.bls_verify(pubkeys[0], root, signature)
+
+    def on_aggregate_and_proof(self, store, signed) -> None:
+        """Gossip aggregate admission: validate the envelope, then apply
+        the inner aggregate.  validate_aggregate_and_proof already ran
+        the full on_attestation validation, so only the store-update
+        half remains — no double validation on the hot path."""
+        self.validate_aggregate_and_proof(store, signed)
+        self.apply_attestation(store, signed.message.aggregate)
+
+    def validate_sync_committee_message(self, store, message) -> None:
+        """sync_committee_{subnet} gossip validation (altair+): the
+        referenced block is known, the validator is in the sync
+        committee FOR THE MESSAGE'S SLOT (the referenced block may be
+        from the previous period, whose state still knows the message
+        period's committee as next_sync_committee), and the signature
+        over the block root verifies."""
+        assert self.is_post("altair")
+        assert message.beacon_block_root in store.block_states
+        state = store.block_states[message.beacon_block_root]
+        validator = state.validators[message.validator_index]
+        state_period = self.compute_sync_committee_period(
+            self.get_current_epoch(state))
+        message_period = self.compute_sync_committee_period(
+            self.compute_epoch_at_slot(message.slot))
+        assert message_period in (state_period, state_period + 1)
+        committee = (state.current_sync_committee
+                     if message_period == state_period
+                     else state.next_sync_committee)
+        assert validator.pubkey in list(committee.pubkeys)
+        pubkeys, root, signature = self.gossip_sync_message_check(
+            state, message)
+        assert self.bls_verify(pubkeys[0], root, signature)
+
+    def gossip_sync_message_check(self, state, message):
+        """(pubkeys, signing_root, signature) of a sync-committee
+        message — shared by validate_sync_committee_message and the
+        gossip collector."""
+        pubkey = state.validators[message.validator_index].pubkey
+        domain = self.get_domain(state, self.DOMAIN_SYNC_COMMITTEE,
+                                 self.compute_epoch_at_slot(message.slot))
+        root = self.compute_signing_root(
+            Bytes32(message.beacon_block_root), domain)
+        return (pubkey,), root, message.signature
+
+    def on_sync_committee_message(self, store, message) -> None:
+        """Gossip sync-message admission: pure validation — accepted
+        messages feed the local aggregator, not the fork-choice store,
+        so the handler leaves `store` untouched."""
+        self.validate_sync_committee_message(store, message)
 
     def on_attester_slashing(self, store, attester_slashing) -> None:
         attestation_1 = attester_slashing.attestation_1

@@ -1,0 +1,62 @@
+"""Injectable clocks for time-driven decision paths.
+
+Every component whose *decisions* depend on time — the gossip
+micro-batcher's flush deadline, the per-peer token buckets, the
+resilience supervisor's breaker cooldown and retry backoff — takes a
+clock object instead of calling `time.time()`/`time.monotonic()`
+directly.  Production wiring uses `MONOTONIC` (the module singleton);
+tests and the fault injector use `ManualClock` so a seeded schedule
+replays *identically*: the same submits at the same manual timestamps
+produce the same flushes, the same quota verdicts, and the same breaker
+transitions, run after run.
+
+The contract is two methods:
+
+* ``now() -> float``   — monotonic seconds (origin arbitrary).
+* ``sleep(seconds)``   — block (or, for ManualClock, advance) that long.
+
+Timer *measurement* (metrics timers, bench timings) stays on
+`time.perf_counter` — observability may be wall-clock; decisions must
+not be.
+"""
+from __future__ import annotations
+
+import time
+
+
+class SystemClock:
+    """Real monotonic time; `sleep` really sleeps."""
+
+    def now(self) -> float:
+        return time.monotonic()
+
+    def sleep(self, seconds: float) -> None:
+        if seconds > 0:
+            time.sleep(seconds)
+
+
+class ManualClock:
+    """Deterministic clock: time moves only when told to.
+
+    `sleep` advances instead of blocking, so code written against the
+    clock contract (backoff loops, deadline waits) runs instantly and
+    reproducibly under test.
+    """
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+
+    def now(self) -> float:
+        return self._now
+
+    def advance(self, seconds: float) -> float:
+        assert seconds >= 0, "time cannot run backwards"
+        self._now += float(seconds)
+        return self._now
+
+    def sleep(self, seconds: float) -> None:
+        if seconds > 0:
+            self.advance(seconds)
+
+
+MONOTONIC = SystemClock()

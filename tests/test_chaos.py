@@ -29,7 +29,8 @@ from consensus_specs_tpu.resilience import (
 from consensus_specs_tpu.sigpipe import METRICS
 from consensus_specs_tpu.specs import get_spec
 from consensus_specs_tpu.ssz import hash_tree_root, uint64
-from consensus_specs_tpu.test_infra.attestations import get_valid_attestation
+from consensus_specs_tpu.test_infra.attestations import (
+    get_valid_attestation, sign_attestation)
 from consensus_specs_tpu.test_infra.blocks import (
     build_empty_block_for_next_slot, sign_block,
     state_transition_and_sign_block)
@@ -227,3 +228,145 @@ def test_chaos_invalid_block_same_boundary_under_faults(spec, workload):
     assert hash_tree_root(chaos_state) == hash_tree_root(native_state)
     assert plan.total_fires() > 0
     assert INCIDENTS.count(event="injected") == plan.total_fires()
+
+
+# ---------------------------------------------------------------------------
+# gossip tier: the admission pipeline under the fault matrix
+# ---------------------------------------------------------------------------
+
+GOSSIP_SITES = SITES + ("gossip.batch_verify",)
+
+
+@pytest.fixture(scope="module")
+def gossip_workload(spec):
+    """(genesis, schedule): a seeded mixed gossip schedule — valid,
+    invalid-signature, duplicate and equivocating attestations plus one
+    valid signed block — against a genesis-anchored store."""
+    genesis = create_genesis_state(spec, default_balances(spec))
+    state = genesis.copy()
+    spec.process_slots(state, uint64(spec.SLOTS_PER_EPOCH + 2))
+
+    def singles(slot, count):
+        committee = spec.get_beacon_committee(
+            state, uint64(slot), uint64(0))
+        return [get_valid_attestation(
+            spec, state, slot=uint64(slot), index=0,
+            filter_participant_set=lambda s, v=v: {v}, signed=True)
+            for v in list(committee)[:count]]
+
+    atts = singles(int(state.slot) - 1, 3) \
+        + singles(int(state.slot) - 2, 2)
+    bad = singles(int(state.slot) - 3, 1)[0]
+    bad.signature = atts[0].signature           # decodable, wrong
+    # a PROPERLY SIGNED conflicting vote: same validator, same target
+    # epoch, different data — the guard quarantines only on verified
+    # conflicts, so the signature must be real
+    equivocating = atts[0].copy()
+    equivocating.data.beacon_block_root = b"\x11" * 32
+    sign_attestation(spec, state, equivocating)
+
+    att = get_valid_attestation(spec, state, signed=True)
+    advanced = state.copy()
+    spec.process_slots(advanced, uint64(
+        state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    block = build_empty_block_for_next_slot(spec, advanced)
+    block.body.attestations.append(att)
+    signed = state_transition_and_sign_block(spec, advanced.copy(), block)
+
+    # atts[0] is submitted FIRST (outside the shuffle) so its verified
+    # vote is always on record before the equivocating message arrives —
+    # the quarantine is then schedule-deterministic
+    schedule = ([("attestation", a) for a in atts[1:]]
+                + [("attestation", bad),
+                   ("attestation", atts[1]),       # duplicate
+                   ("attestation", equivocating),  # quarantines a signer
+                   ("block", signed)])
+    return genesis, atts[0], schedule, int(signed.message.slot)
+
+
+def _gossip_store(spec, genesis, slot):
+    from consensus_specs_tpu.test_infra.fork_choice import (
+        get_genesis_forkchoice_store)
+    store = get_genesis_forkchoice_store(spec, genesis)
+    spec.on_tick(store, store.genesis_time
+                 + slot * int(spec.config.SECONDS_PER_SLOT))
+    return store
+
+
+def test_chaos_gossip_admission(spec, gossip_workload):
+    """Seeded random fault schedules at the bls seams AND the gossip
+    batch site, over a seeded mixed message schedule: whatever fires,
+    (1) per-message verdicts and the drained store match the clean
+    sequential scalar oracle, (2) no exception escapes the pipeline,
+    (3) every injected fault and every admission event (duplicate,
+    equivocation quarantine) is visible in the logs."""
+    from consensus_specs_tpu.gossip import (
+        AdmissionPipeline, GossipConfig, ManualClock, apply_scalar,
+        store_fingerprint)
+    genesis, first_att, schedule, tick_slot = gossip_workload
+    rng = random.Random(CHAOS_SEED + 7)
+    for round_i in range(3):
+        INCIDENTS.clear()
+        METRICS.reset()
+        fault_specs = []
+        for site in GOSSIP_SITES:
+            if rng.random() < 0.5:
+                continue
+            kind = rng.choice(["raise", "timeout", "corrupt"])
+            fault_specs.append(FaultSpec(
+                site, kind, rate=rng.choice([0.4, 1.0]),
+                persistent=rng.random() < 0.5,
+                max_fires=rng.choice([1, 2, None]), sleep_s=0.2))
+        plan = FaultPlan(fault_specs, seed=rng.randrange(1 << 30))
+        uses_timeout = any(s.kind == "timeout" for s in fault_specs)
+
+        resilience.enable(
+            max_retries=1, breaker_threshold=1, probe_after=2,
+            deadline_s=0.05 if uses_timeout else None,
+            guard_sample_rate=1.0, guard_seed=CHAOS_SEED)
+        store = _gossip_store(spec, genesis, tick_slot)
+        clock = ManualClock()
+        pipe = AdmissionPipeline(
+            spec, store,
+            GossipConfig(mode=rng.choice(["fused", "per-set"])), clock)
+        tail = list(schedule)
+        rng.shuffle(tail)
+        # the verified first vote always lands before the conflicting
+        # one, making the quarantine schedule-deterministic
+        order = [("attestation", first_att)] + tail
+        try:
+            with faults.inject(plan):
+                for i, (topic, payload) in enumerate(order):
+                    # invariant 2: no unhandled exception escapes
+                    pipe.submit(topic, payload, peer=f"p{i % 3}")
+                    if rng.random() < 0.4:
+                        clock.advance(rng.choice([0.02, 0.06]))
+                        pipe.poll()
+                pipe.drain()
+        finally:
+            resilience.disable()
+
+        # invariant 3: every injected fault is visible
+        assert INCIDENTS.count(event="injected") == plan.total_fires()
+        snapshot = METRICS.snapshot()
+        assert snapshot.get("faults_injected", 0) == plan.total_fires()
+        json.dumps(snapshot)
+
+        # invariant 1: verdicts + store identical to the clean scalar
+        # oracle over the same delivered sequence
+        oracle_store = _gossip_store(spec, genesis, tick_slot)
+        oracle = [apply_scalar(spec, oracle_store, topic, payload)
+                  for _seq, topic, payload in pipe.delivered_log]
+        mine = [(pipe.results[seq].status == "accepted",
+                 pipe.results[seq].detail)
+                for seq, _t, _p in pipe.delivered_log]
+        assert mine == oracle
+        assert store_fingerprint(spec, store) == store_fingerprint(
+            spec, oracle_store)
+
+        # admission visibility: the duplicate and the equivocation both
+        # surfaced (they are schedule-deterministic, faults or not)
+        assert METRICS.count("gossip_dedup_hits") >= 1
+        assert METRICS.count("gossip_equivocations") >= 1
+        assert INCIDENTS.count(event="quarantine",
+                               site="gossip.equivocation") == 1

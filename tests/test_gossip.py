@@ -1,0 +1,751 @@
+"""Gossip admission pipeline (gossip/): the PR's acceptance criteria.
+
+* Semantics contract: per-message accept/reject verdicts and the
+  post-drain fork-choice store are byte-identical to the sequential
+  scalar oracle (`apply_scalar` replay), for valid, invalid, duplicate
+  and mixed-topic schedules.
+* Batching: batched dispatch count strictly below message count at
+  occupancy > 1; scalar fallback on single-message windows and on an
+  open `gossip.batch_verify` breaker.
+* Bounded ingress: overflow sheds OLDEST with incident-log visibility;
+  per-peer token-bucket quotas defer (backpressure) or shed with
+  incidents; equivocating validators are quarantined with evidence.
+* Deterministic time: every decision clock is injected (ManualClock),
+  so each case replays identically.
+"""
+import pytest
+
+from consensus_specs_tpu import resilience, sigpipe
+from consensus_specs_tpu.gossip import (
+    AdmissionPipeline, GossipConfig, ManualClock, apply_scalar,
+    store_fingerprint,
+)
+from consensus_specs_tpu.gossip.queues import BoundedQueue
+from consensus_specs_tpu.gossip.quota import TokenBucket
+from consensus_specs_tpu.resilience import INCIDENTS
+from consensus_specs_tpu.sigpipe import METRICS
+from consensus_specs_tpu.sigpipe.cache import AGGREGATES
+from consensus_specs_tpu.sigpipe import cache as sig_cache
+from consensus_specs_tpu.specs import get_spec
+from consensus_specs_tpu.ssz import hash_tree_root, uint64
+from consensus_specs_tpu.test_infra import disable_bls
+from consensus_specs_tpu.test_infra.attestations import get_valid_attestation
+from consensus_specs_tpu.test_infra.blocks import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block)
+from consensus_specs_tpu.test_infra.fork_choice import (
+    get_genesis_forkchoice_store)
+from consensus_specs_tpu.test_infra.genesis import (
+    create_genesis_state, default_balances)
+from consensus_specs_tpu.test_infra.keys import privkey_for_pubkey
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_spec("altair", "minimal")
+
+
+@pytest.fixture(scope="module")
+def genesis(spec):
+    return create_genesis_state(spec, default_balances(spec))
+
+
+@pytest.fixture(scope="module")
+def state(spec, genesis):
+    state = genesis.copy()
+    spec.process_slots(state, uint64(spec.SLOTS_PER_EPOCH + 2))
+    return state
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    resilience.disable()
+    sigpipe.disable()
+    INCIDENTS.clear()
+    METRICS.reset()
+    sig_cache.clear()
+    yield
+    resilience.disable()
+    sigpipe.disable()
+    INCIDENTS.clear()
+
+
+def _store_at(spec, genesis, slot) -> object:
+    """Anchor store ticked to `slot`'s wall-clock time."""
+    store = get_genesis_forkchoice_store(spec, genesis)
+    spec.on_tick(store, store.genesis_time
+                 + int(slot) * int(spec.config.SECONDS_PER_SLOT))
+    return store
+
+
+def _single_attestations(spec, state, slot, count, signed=True):
+    """`count` single-participant attestations for committee 0 of `slot`
+    (one per committee member, distinct signers)."""
+    committee = spec.get_beacon_committee(state, uint64(slot), uint64(0))
+    atts = []
+    for validator_index in list(committee)[:count]:
+        atts.append(get_valid_attestation(
+            spec, state, slot=uint64(slot), index=0,
+            filter_participant_set=lambda s, v=validator_index: {v},
+            signed=signed))
+    return atts
+
+
+def _aggregate_and_proof(spec, state, attestation, aggregator_index):
+    privkey = privkey_for_pubkey(
+        state.validators[int(aggregator_index)].pubkey)
+    proof = spec.get_aggregate_and_proof(
+        state, uint64(aggregator_index), attestation, privkey)
+    signature = spec.get_aggregate_and_proof_signature(
+        state, proof, privkey)
+    return spec.SignedAggregateAndProof(message=proof,
+                                        signature=signature)
+
+
+def _oracle_replay(spec, genesis, slot, pipe):
+    """Apply the pipeline's delivered sequence through the bare scalar
+    handlers on a fresh store; returns (store, verdicts)."""
+    store = _store_at(spec, genesis, slot)
+    verdicts = []
+    for _seq, topic, payload in pipe.delivered_log:
+        verdicts.append(apply_scalar(spec, store, topic, payload))
+    return store, verdicts
+
+
+# ---------------------------------------------------------------------------
+# primitives (pure, no spec)
+# ---------------------------------------------------------------------------
+
+def test_token_bucket_deterministic_under_manual_clock():
+    clock = ManualClock()
+    bucket = TokenBucket(capacity=2, refill_rate=1.0, clock=clock)
+    assert bucket.take() and bucket.take() and not bucket.take()
+    clock.advance(0.5)
+    assert not bucket.take()        # half a token is not a token
+    clock.advance(0.5)
+    assert bucket.take()
+    clock.advance(1000.0)
+    assert bucket.tokens() == 2.0   # capped at burst capacity
+
+
+def test_bounded_queue_sheds_oldest_with_incident():
+    class Msg:
+        def __init__(self, seq):
+            self.seq = seq
+    q = BoundedQueue("attestation", max_depth=3)
+    assert all(q.push(Msg(i)) is None for i in range(3))
+    shed = q.push(Msg(3))
+    assert shed.seq == 0            # oldest out, newest in
+    assert len(q) == 3
+    assert q.shed_count == 1
+    events = INCIDENTS.events("overflow_shed")
+    assert events and events[-1]["site"] == "gossip.queue.attestation"
+    assert events[-1]["seq"] == 0
+    assert METRICS.count_labeled("gossip_shed", "overflow") == 1
+    assert [m.seq for m in q.pop_all()] == [1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# the semantics contract (real BLS)
+# ---------------------------------------------------------------------------
+
+def test_verdict_and_store_parity_mixed_topics(spec, genesis, state):
+    """One batched window holding attestations, a duplicate, an
+    aggregate-and-proof and a sync message: every verdict and the
+    post-drain store match the sequential scalar oracle, and the whole
+    window verified in strictly fewer dispatches than messages."""
+    slot = int(state.slot) - 1
+    atts = _single_attestations(spec, state, slot, 3)
+    full_att = get_valid_attestation(spec, state, slot=uint64(slot),
+                                     index=0, signed=True)
+    committee = spec.get_beacon_committee(state, uint64(slot), uint64(0))
+    aggregate = _aggregate_and_proof(spec, state, full_att,
+                                     int(list(committee)[0]))
+    # sync message validated against the anchor (genesis) block state
+    anchor_root = get_genesis_forkchoice_store(
+        spec, genesis).justified_checkpoint.root
+    sync_pubkey = bytes(genesis.current_sync_committee.pubkeys[0])
+    sync_index = next(i for i, v in enumerate(genesis.validators)
+                      if bytes(v.pubkey) == sync_pubkey)
+    sync_msg = spec.get_sync_committee_message(
+        genesis, anchor_root, uint64(sync_index),
+        privkey_for_pubkey(sync_pubkey))
+
+    store = _store_at(spec, genesis, state.slot)
+    clock = ManualClock()
+    pipe = AdmissionPipeline(spec, store, GossipConfig(), clock)
+    for att in atts:
+        pipe.submit("attestation", att, peer="p1")
+    pipe.submit("attestation", atts[0], peer="p3")      # duplicate
+    pipe.submit("aggregate", aggregate, peer="p1")
+    pipe.submit("sync", sync_msg, peer="p2")
+    results = pipe.drain()
+
+    by_seq = {r.seq: r for r in results}
+    assert [by_seq[i].status for i in (1, 2, 3)] == ["accepted"] * 3
+    assert (by_seq[4].status, by_seq[4].detail) == ("shed", "duplicate")
+    assert by_seq[5].status == "accepted"       # aggregate-and-proof
+    assert by_seq[6].status == "accepted"       # sync message
+
+    snapshot = METRICS.snapshot()
+    delivered = len(pipe.delivered_log)
+    assert delivered == 5
+    # occupancy > 1: one fused dispatch for the whole mixed window
+    assert 0 < snapshot["dispatches"] < delivered
+    assert snapshot["gossip_window_flushes"]["drain"] >= 1
+    assert snapshot["seam_hits"] >= 6   # 3 atts + 3 aggregate checks...
+    assert METRICS.count("gossip_dedup_hits") == 1
+
+    oracle_store, oracle_verdicts = _oracle_replay(
+        spec, genesis, state.slot, pipe)
+    pipe_verdicts = [(by_seq[seq].status == "accepted",
+                      by_seq[seq].detail)
+                     for seq, _t, _p in pipe.delivered_log]
+    assert pipe_verdicts == list(oracle_verdicts)
+    assert store_fingerprint(spec, store) == store_fingerprint(
+        spec, oracle_store)
+
+
+def test_invalid_message_isolated_by_bisection(spec, genesis, state):
+    """A decodable-but-wrong signature inside the window fails the fused
+    product; bisection isolates it so its neighbors keep their batch
+    verdicts, and the rejection is byte-identical to the scalar path."""
+    slot = int(state.slot) - 1
+    atts = _single_attestations(spec, state, slot, 3)
+    atts[2].signature = atts[0].signature       # wrong but well-formed
+    store = _store_at(spec, genesis, state.slot)
+    pipe = AdmissionPipeline(spec, store, GossipConfig(), ManualClock())
+    for att in atts:
+        pipe.submit("attestation", att, peer="p1")
+    results = pipe.drain()
+    assert [r.status for r in results] == ["accepted", "accepted",
+                                           "rejected"]
+    assert "AssertionError" in results[2].detail
+    snapshot = METRICS.snapshot()
+    assert snapshot["fused_batch_failures"] == 1
+    assert snapshot["bisect_dispatches"] > 0
+    assert snapshot["seam_hits"] == 3           # bad verdict consumed too
+    oracle_store, oracle_verdicts = _oracle_replay(
+        spec, genesis, state.slot, pipe)
+    assert [(r.status == "accepted", r.detail)
+            for r in results] == list(oracle_verdicts)
+    assert store_fingerprint(spec, store) == store_fingerprint(
+        spec, oracle_store)
+
+
+def test_breaker_open_degrades_to_scalar_same_verdicts(spec, genesis,
+                                                       state):
+    """With the gossip.batch_verify breaker quarantined, the window
+    delivers scalar — zero batched dispatches — and verdicts still match
+    the oracle exactly."""
+    slot = int(state.slot) - 1
+    atts = _single_attestations(spec, state, slot, 2)
+    store = _store_at(spec, genesis, state.slot)
+    supervisor = resilience.enable()
+    supervisor.quarantine("gossip.batch_verify", reason="forced_open")
+    pipe = AdmissionPipeline(spec, store, GossipConfig(), ManualClock())
+    for att in atts:
+        pipe.submit("attestation", att, peer="p1")
+    results = pipe.drain()
+    assert [r.status for r in results] == ["accepted", "accepted"]
+    snapshot = METRICS.snapshot()
+    assert snapshot.get("dispatches", 0) == 0       # no batch dispatch
+    assert snapshot["gossip_batch_scalar"]["degraded"] >= 1
+    assert snapshot["scalar_fallbacks"]["forced_open"] >= 1
+    oracle_store, oracle_verdicts = _oracle_replay(
+        spec, genesis, state.slot, pipe)
+    assert all(ok for ok, _ in oracle_verdicts)
+    assert store_fingerprint(spec, store) == store_fingerprint(
+        spec, oracle_store)
+
+
+def test_block_accept_prewarms_aggregate_cache(spec, genesis):
+    """An accepted gossip block pushes its committee aggregates into
+    sigpipe's content-addressed cache (ROADMAP cross-block reuse): the
+    same participant set verifying later — a replayed aggregate, a
+    sibling block — hits warm."""
+    state = genesis.copy()
+    spec.process_slots(state, uint64(spec.SLOTS_PER_EPOCH + 2))
+    att = get_valid_attestation(spec, state, signed=True)
+    advanced = state.copy()
+    spec.process_slots(advanced, uint64(
+        state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    block = build_empty_block_for_next_slot(spec, advanced)
+    block.body.attestations.append(att)
+    signed = state_transition_and_sign_block(spec, advanced.copy(), block)
+
+    store = _store_at(spec, genesis, signed.message.slot)
+    pipe = AdmissionPipeline(spec, store, GossipConfig(), ManualClock())
+    pipe.submit("block", signed, peer="p1")
+    results = pipe.drain()
+    assert results[0].status == "accepted"
+    snapshot = METRICS.snapshot()
+    assert snapshot["aggregate_cache_prewarms"] >= 1
+    assert snapshot["gossip_prewarmed_aggregates"] >= 1
+
+    # the block's attestation now replays as gossip: its participant
+    # aggregate must come from the warm cache, not be recomputed
+    hits_before = METRICS.count("aggregate_cache_hits")
+    AGGREGATES.aggregate([bytes(advanced.validators[int(i)].pubkey)
+                          for i in sorted(spec.get_attesting_indices(
+                              advanced, att))])
+    assert METRICS.count("aggregate_cache_hits") == hits_before + 1
+
+    oracle_store, _ = _oracle_replay(spec, genesis, signed.message.slot,
+                                     pipe)
+    assert store_fingerprint(spec, store) == store_fingerprint(
+        spec, oracle_store)
+
+
+# ---------------------------------------------------------------------------
+# admission control (BLS stubbed: decisions, not signatures)
+# ---------------------------------------------------------------------------
+
+def test_overflow_bounded_under_flood(spec, genesis, state):
+    """100x-style ingress against a tiny queue: depth stays bounded, the
+    OLDEST messages shed, every shed is in the incident log, and the
+    flood never reaches an error."""
+    slot = int(state.slot) - 1
+    with disable_bls():
+        atts = _single_attestations(spec, state, slot, 4, signed=False)
+        extra = []
+        for back in range(2, 6):
+            extra.extend(_single_attestations(
+                spec, state, int(state.slot) - back, 2, signed=False))
+        messages = atts + extra            # 12 distinct messages
+        store = _store_at(spec, genesis, state.slot)
+        config = GossipConfig(queue_depth=4, max_batch=1024,
+                              bucket_capacity=1024)
+        pipe = AdmissionPipeline(spec, store, config, ManualClock())
+        for att in messages:
+            pipe.submit("attestation", att, peer="flood")
+            assert pipe.pending_count() <= 4        # never grows past
+        results = pipe.drain()
+    shed = [r for r in results if r.status == "shed"]
+    assert len(shed) == 8
+    assert [r.seq for r in shed] == list(range(1, 9))   # oldest first
+    assert all(r.detail == "overflow" for r in shed)
+    assert len(pipe.delivered_log) == 4
+    assert INCIDENTS.count(event="overflow_shed",
+                           site="gossip.queue.attestation") == 8
+    assert METRICS.count_labeled("gossip_shed", "overflow") == 8
+
+
+def test_quota_backpressure_defers_then_releases(spec, genesis, state):
+    """An over-quota peer's messages defer (backpressure) and come back
+    once its bucket refills; a well-behaved peer is untouched.  All
+    quota decisions land in the incident log."""
+    slot = int(state.slot) - 1
+    with disable_bls():
+        spam = _single_attestations(spec, state, slot, 4, signed=False)
+        good = _single_attestations(spec, state, int(state.slot) - 2, 1,
+                                    signed=False)
+        store = _store_at(spec, genesis, state.slot)
+        clock = ManualClock()
+        config = GossipConfig(bucket_capacity=2, refill_rate=1.0,
+                              quota_policy="defer")
+        pipe = AdmissionPipeline(spec, store, config, clock)
+        seqs = [pipe.submit("attestation", att, peer="spammer")
+                for att in spam]
+        good_seq = pipe.submit("attestation", good[0], peer="good")
+        results = {r.seq: r for r in pipe.drain()}
+        # spammer: first two through, rest deferred (not delivered yet)
+        assert results[seqs[0]].status == "accepted"
+        assert results[seqs[1]].status == "accepted"
+        assert seqs[2] not in results and seqs[3] not in results
+        assert pipe.quotas.deferred_count() == 2
+        # the good peer is unaffected by the spammer's backpressure
+        assert results[good_seq].status == "accepted"
+
+        # refill: two tokens accrue, and the ordinary poll() loop (not
+        # just a drain) releases and delivers the deferred pair
+        clock.advance(2.0)
+        pipe.poll()
+        clock.advance(0.06)
+        pipe.poll()
+        results = {r.seq: r for r in pipe.verdicts()}
+        assert results[seqs[2]].status == "accepted"
+        assert results[seqs[3]].status == "accepted"
+    assert INCIDENTS.count(event="quota_deferred") == 2
+    assert METRICS.count("gossip_quota_rejections") == 2
+    # backpressure delays the spammer's tail past the good peer's
+    # message, but the deferred pair keeps its own relative order
+    delivered_seqs = [seq for seq, _t, _p in pipe.delivered_log]
+    assert delivered_seqs == seqs[:2] + [good_seq] + seqs[2:]
+
+
+def test_quota_shed_policy(spec, genesis, state):
+    slot = int(state.slot) - 1
+    with disable_bls():
+        spam = _single_attestations(spec, state, slot, 3, signed=False)
+        store = _store_at(spec, genesis, state.slot)
+        config = GossipConfig(bucket_capacity=1, refill_rate=0.0,
+                              quota_policy="shed")
+        pipe = AdmissionPipeline(spec, store, config, ManualClock())
+        statuses = []
+        for att in spam:
+            seq = pipe.submit("attestation", att, peer="spammer")
+            if seq in pipe.results and pipe.results[seq].final:
+                statuses.append(pipe.results[seq].status)
+        pipe.drain()
+    assert statuses == ["shed", "shed"]
+    assert METRICS.count_labeled("gossip_shed", "quota") == 2
+    assert INCIDENTS.count(event="quota_shed") == 2
+
+
+def test_equivocation_quarantines_validator_with_evidence(spec, genesis,
+                                                          state):
+    """A validator signing two different attestation datas for one
+    target epoch is quarantined: the second message sheds, the evidence
+    pair is logged, and later traffic from that validator is refused."""
+    slot = int(state.slot) - 1
+    with disable_bls():
+        att = _single_attestations(spec, state, slot, 1,
+                                   signed=False)[0]
+        double = att.copy()
+        double.data.beacon_block_root = b"\x01" * 32    # conflicting vote
+        third = att.copy()
+        third.data.beacon_block_root = b"\x02" * 32
+
+        store = _store_at(spec, genesis, state.slot)
+        pipe = AdmissionPipeline(spec, store, GossipConfig(),
+                                 ManualClock())
+        pipe.submit("attestation", att, peer="p1")
+        pipe.submit("attestation", double, peer="p2")
+        pipe.submit("attestation", third, peer="p3")
+        results = pipe.drain()
+    by_seq = {r.seq: r for r in results}
+    assert by_seq[1].status == "accepted"
+    assert (by_seq[2].status, by_seq[2].detail) == ("shed",
+                                                    "equivocation")
+    assert (by_seq[3].status, by_seq[3].detail) == ("shed",
+                                                    "quarantined")
+    validator_index = int(spec.get_attesting_indices(state, att).pop())
+    assert pipe.guard.is_quarantined(validator_index)
+    events = INCIDENTS.events("quarantine")
+    assert len(events) == 1
+    evidence = events[0]
+    assert evidence["site"] == "gossip.equivocation"
+    assert evidence["validator_index"] == validator_index
+    assert evidence["first"] != evidence["second"]
+    assert METRICS.count("gossip_equivocations") == 1
+    assert METRICS.count_labeled("gossip_shed", "equivocation") == 1
+    assert METRICS.count_labeled("gossip_shed", "quarantined") == 1
+
+
+def test_window_flush_reasons(spec, genesis, state):
+    """The three window-close reasons are all observable: size cap,
+    deadline expiry, and explicit drain."""
+    slot = int(state.slot) - 1
+    with disable_bls():
+        atts = _single_attestations(spec, state, slot, 4, signed=False)
+        more = _single_attestations(spec, state, int(state.slot) - 2, 3,
+                                    signed=False)
+        store = _store_at(spec, genesis, state.slot)
+        clock = ManualClock()
+        config = GossipConfig(max_batch=3, window_s=0.05)
+        pipe = AdmissionPipeline(spec, store, config, clock)
+        for att in atts[:3]:                    # hits the size cap
+            pipe.submit("attestation", att, peer="p1")
+        assert pipe.pending_count() == 0        # size flush fired
+        pipe.submit("attestation", atts[3], peer="p1")
+        assert not pipe.poll()                  # window still open
+        clock.advance(0.06)
+        assert pipe.poll()                      # deadline flush
+        pipe.submit("attestation", more[0], peer="p1")
+        pipe.drain()                            # drain flush
+    flushes = METRICS.snapshot()["gossip_window_flushes"]
+    assert flushes["size"] == 1
+    assert flushes["deadline"] == 1
+    assert flushes["drain"] >= 1
+    # occupancy histogram saw the size-capped window
+    assert METRICS.hist_counts("batch_occupancy")
+
+
+def test_batched_equals_scalar_only_pipeline(spec, genesis, state):
+    """The full-system determinism check: the batched pipeline and the
+    scalar_only oracle pipeline, fed the identical schedule under
+    identical clocks, make identical admission decisions, identical
+    verdicts, and identical stores."""
+    slot = int(state.slot) - 1
+    with disable_bls():
+        messages = (
+            _single_attestations(spec, state, slot, 4, signed=False)
+            + _single_attestations(spec, state, int(state.slot) - 2, 3,
+                                   signed=False))
+        double = messages[0].copy()
+        double.data.beacon_block_root = b"\x03" * 32
+        schedule = (
+            [("attestation", m, f"p{i % 3}")
+             for i, m in enumerate(messages)]
+            + [("attestation", messages[1], "p9"),      # duplicate
+               ("attestation", double, "p9")])          # equivocation
+
+        def run(scalar_only):
+            store = _store_at(spec, genesis, state.slot)
+            clock = ManualClock()
+            pipe = AdmissionPipeline(
+                spec, store,
+                GossipConfig(max_batch=4, bucket_capacity=4,
+                             refill_rate=2.0, window_s=0.05,
+                             scalar_only=scalar_only),
+                clock)
+            for i, (topic, payload, peer) in enumerate(schedule):
+                pipe.submit(topic, payload, peer=peer)
+                if i % 3 == 2:
+                    clock.advance(0.03)
+                    pipe.poll()
+            clock.advance(1.0)
+            results = pipe.drain()
+            return ([(r.seq, r.status, r.detail) for r in results],
+                    store_fingerprint(spec, store))
+
+        batched, batched_fp = run(scalar_only=False)
+        scalar, scalar_fp = run(scalar_only=True)
+    assert batched == scalar
+    assert batched_fp == scalar_fp
+
+
+# ---------------------------------------------------------------------------
+# eip7732: payload-attestation topic
+# ---------------------------------------------------------------------------
+
+def test_payload_attestation_topic_eip7732():
+    """ePBS PTC messages ride the same admission pipeline: batched
+    verification through the gossip_payload_attestation_check collection
+    hook, equivocation quarantine on conflicting payload votes, and
+    verdict/store parity with the scalar oracle."""
+    from consensus_specs_tpu.utils import bls
+
+    pspec = get_spec("eip7732", "minimal")
+    with disable_bls():
+        state = create_genesis_state(pspec, default_balances(pspec))
+        body = pspec.BeaconBlockBody(
+            signed_execution_payload_header=(
+                pspec.SignedExecutionPayloadHeader(
+                    message=pspec.ExecutionPayloadHeader(
+                        block_hash=state.latest_block_hash))))
+        state.latest_block_header.body_root = hash_tree_root(body)
+        anchor = pspec.BeaconBlock(
+            slot=state.latest_block_header.slot,
+            proposer_index=state.latest_block_header.proposer_index,
+            parent_root=state.latest_block_header.parent_root,
+            state_root=hash_tree_root(state), body=body)
+
+        def build_store():
+            store = pspec.get_forkchoice_store(state.copy(), anchor)
+            work = state.copy()
+            pspec.process_slots(work, uint64(1))
+            bid = pspec.ExecutionPayloadHeader(
+                parent_block_hash=work.latest_block_hash,
+                parent_block_root=hash_tree_root(
+                    work.latest_block_header),
+                block_hash=b"\x0b" * 32, gas_limit=30_000_000,
+                builder_index=1, slot=1,
+                blob_kzg_commitments_root=hash_tree_root(
+                    pspec.ExecutionPayloadEnvelope.fields()[
+                        "blob_kzg_commitments"]()))
+            block = pspec.BeaconBlock(
+                slot=uint64(1),
+                proposer_index=pspec.get_beacon_proposer_index(work),
+                parent_root=hash_tree_root(work.latest_block_header),
+                body=pspec.BeaconBlockBody(
+                    signed_execution_payload_header=(
+                        pspec.SignedExecutionPayloadHeader(
+                            message=bid))))
+            scratch = store.block_states[
+                hash_tree_root(anchor)].copy()
+            pspec.state_transition(
+                scratch, pspec.SignedBeaconBlock(message=block),
+                validate_result=False)
+            block.state_root = hash_tree_root(scratch)
+            pspec.on_tick(store, store.genesis_time
+                          + int(pspec.config.SECONDS_PER_SLOT))
+            pspec.on_block(store, pspec.SignedBeaconBlock(message=block))
+            return store, hash_tree_root(block)
+
+        store, root = build_store()
+        block_state = store.block_states[root]
+        ptc = [int(i) for i in pspec.get_ptc(block_state,
+                                             block_state.slot)]
+
+    def ptc_message(validator_index, status):
+        data = pspec.PayloadAttestationData(
+            beacon_block_root=root, slot=block_state.slot,
+            payload_status=status)
+        domain = pspec.get_domain(block_state,
+                                  pspec.DOMAIN_PTC_ATTESTER, None)
+        signing_root = pspec.compute_signing_root(data, domain)
+        privkey = privkey_for_pubkey(
+            block_state.validators[validator_index].pubkey)
+        return pspec.PayloadAttestationMessage(
+            validator_index=uint64(validator_index), data=data,
+            signature=bls.Sign(privkey, signing_root))
+
+    messages = [ptc_message(v, pspec.PAYLOAD_PRESENT)
+                for v in sorted(set(ptc))[:2]]
+    # same validator, same slot, conflicting payload vote: slashable
+    double = ptc_message(sorted(set(ptc))[0], pspec.PAYLOAD_WITHHELD)
+
+    pipe = AdmissionPipeline(pspec, store, GossipConfig(),
+                             ManualClock())
+    for message in messages:
+        pipe.submit("payload_attestation", message, peer="p1")
+    pipe.submit("payload_attestation", double, peer="p2")
+    results = pipe.drain()
+    assert [r.status for r in results] == ["accepted", "accepted",
+                                           "shed"]
+    assert results[2].detail == "equivocation"
+    assert pipe.guard.is_quarantined(sorted(set(ptc))[0])
+    snapshot = METRICS.snapshot()
+    assert 0 < snapshot["dispatches"] < len(pipe.delivered_log) + 1
+    assert snapshot["seam_hits"] == 2
+
+    with disable_bls():
+        oracle_store, _root2 = build_store()
+    oracle = [apply_scalar(pspec, oracle_store, topic, payload)
+              for _seq, topic, payload in pipe.delivered_log]
+    assert all(ok for ok, _ in oracle)
+    assert store_fingerprint(pspec, store) == store_fingerprint(
+        pspec, oracle_store)
+
+
+# ---------------------------------------------------------------------------
+# review regressions: retryable capacity sheds, eviction visibility,
+# bounded history
+# ---------------------------------------------------------------------------
+
+def test_overflow_shed_is_retryable_on_redelivery(spec, genesis, state):
+    """A message shed for CAPACITY (queue overflow) is forgotten by the
+    dedup cache: honest mesh redelivery gets a fresh admission attempt
+    once load subsides — a flood must not permanently censor what it
+    displaced."""
+    slot = int(state.slot) - 1
+    with disable_bls():
+        atts = _single_attestations(spec, state, slot, 3, signed=False)
+        store = _store_at(spec, genesis, state.slot)
+        pipe = AdmissionPipeline(
+            spec, store,
+            GossipConfig(queue_depth=2, max_batch=1024), ManualClock())
+        for att in atts:
+            pipe.submit("attestation", att, peer="p1")
+        results = {r.seq: r for r in pipe.drain()}
+        assert results[1].status == "shed"          # displaced by flood
+        retry_seq = pipe.submit("attestation", atts[0], peer="p1")
+        results = {r.seq: r for r in pipe.drain()}
+    assert results[retry_seq].status == "accepted"
+
+
+def test_peer_eviction_sheds_deferred_with_incident(spec, genesis,
+                                                    state):
+    """LRU peer eviction must not silently strand a deferred backlog:
+    the orphaned messages are finalized as shed (retryable) and the
+    eviction is in the incident log."""
+    slot = int(state.slot) - 1
+    with disable_bls():
+        atts = _single_attestations(spec, state, slot, 2, signed=False)
+        store = _store_at(spec, genesis, state.slot)
+        config = GossipConfig(bucket_capacity=1, refill_rate=0.0,
+                              quota_policy="defer", max_peers=2)
+        pipe = AdmissionPipeline(spec, store, config, ManualClock())
+        ok_seq = pipe.submit("attestation", atts[0], peer="victim")
+        deferred_seq = pipe.submit("attestation", atts[1], peer="victim")
+        assert pipe.results[deferred_seq].status == "deferred"
+        # two fresh identities evict the victim's bucket AND backlog
+        more = _single_attestations(spec, state, int(state.slot) - 2, 2,
+                                    signed=False)
+        pipe.submit("attestation", more[0], peer="sock1")
+        pipe.submit("attestation", more[1], peer="sock2")
+        results = {r.seq: r for r in pipe.drain()}
+    assert results[ok_seq].status == "accepted"
+    assert (results[deferred_seq].status,
+            results[deferred_seq].detail) == ("shed", "quota_evicted")
+    assert INCIDENTS.count(event="peer_evicted") == 1
+    assert pipe.quotas.deferred_count() == 0
+
+
+def test_results_history_is_bounded(spec, genesis, state):
+    """The verdict history cannot grow without bound under sustained
+    ingress — the flood the pipeline exists to survive."""
+    slot = int(state.slot) - 1
+    with disable_bls():
+        atts = (_single_attestations(spec, state, slot, 4, signed=False)
+                + _single_attestations(spec, state, int(state.slot) - 2,
+                                       4, signed=False))
+        store = _store_at(spec, genesis, state.slot)
+        pipe = AdmissionPipeline(
+            spec, store, GossipConfig(history_bound=4), ManualClock())
+        for att in atts:
+            pipe.submit("attestation", att, peer="p1")
+        pipe.drain()
+    assert len(pipe.results) <= 4
+    assert len(pipe.delivered_log) <= 4
+
+
+def test_unverified_conflict_cannot_frame_a_validator(spec, genesis,
+                                                      state):
+    """The censorship regression: a forged message claiming a victim
+    validator (garbage signature, conflicting data) must neither record
+    a vote nor quarantine the victim — the victim's REAL attestation
+    still gets through."""
+    slot = int(state.slot) - 1
+    real = _single_attestations(spec, state, slot, 1)[0]    # signed
+    forged = real.copy()
+    forged.data.beacon_block_root = b"\x66" * 32
+    forged.signature = b"\xaa" + bytes(forged.signature)[1:]  # garbage
+    store = _store_at(spec, genesis, state.slot)
+    pipe = AdmissionPipeline(spec, store, GossipConfig(), ManualClock())
+    forged_seq = pipe.submit("attestation", forged, peer="attacker")
+    real_seq = pipe.submit("attestation", real, peer="honest")
+    results = {r.seq: r for r in pipe.drain()}
+    # the forgery is rejected at delivery (bad signature), records no
+    # vote, frames no one
+    assert results[forged_seq].status == "rejected"
+    assert results[real_seq].status == "accepted"
+    validator_index = int(
+        spec.get_attesting_indices(state, real).pop())
+    assert not pipe.guard.is_quarantined(validator_index)
+    assert METRICS.count("gossip_equivocations") == 0
+
+
+def test_transiently_rejected_message_can_redeliver(spec, genesis,
+                                                    state):
+    """IGNORE-class rejections (attestation one slot early) must not be
+    dedup-suppressed forever: after the store ticks forward, honest
+    mesh redelivery revalidates and is accepted."""
+    slot = int(state.slot)          # too early: needs current > slot
+    with disable_bls():
+        att = get_valid_attestation(spec, state, slot=uint64(slot),
+                                    index=0, signed=False)
+        store = _store_at(spec, genesis, state.slot)
+        pipe = AdmissionPipeline(spec, store, GossipConfig(),
+                                 ManualClock())
+        early_seq = pipe.submit("attestation", att, peer="p1")
+        results = {r.seq: r for r in pipe.drain()}
+        assert results[early_seq].status == "rejected"
+        # next slot arrives; the same attestation is now applicable
+        spec.on_tick(store, store.genesis_time
+                     + (int(state.slot) + 1)
+                     * int(spec.config.SECONDS_PER_SLOT))
+        retry_seq = pipe.submit("attestation", att, peer="p2")
+        results = {r.seq: r for r in pipe.drain()}
+    assert results[retry_seq].status == "accepted"
+
+
+def test_quarantined_proposer_block_still_imports(spec, genesis):
+    """Local quarantine (attestation equivocation) must never refuse a
+    valid BLOCK proposal — the rest of the network accepts it, and
+    shedding it would fork this node off the canonical chain."""
+    with disable_bls():
+        state = genesis.copy()
+        spec.process_slots(state, uint64(spec.SLOTS_PER_EPOCH + 2))
+        block = build_empty_block_for_next_slot(spec, state)
+        signed = state_transition_and_sign_block(spec, state.copy(),
+                                                 block)
+        store = _store_at(spec, genesis, signed.message.slot)
+        pipe = AdmissionPipeline(spec, store, GossipConfig(),
+                                 ManualClock())
+        pipe.guard.quarantined.add(int(signed.message.proposer_index))
+        pipe.submit("block", signed, peer="p1")
+        results = pipe.drain()
+    assert [r.status for r in results] == ["accepted"]
+    assert hash_tree_root(signed.message) in store.blocks

@@ -513,3 +513,119 @@ def test_verify_block_signatures_eager_api(altair_spec, altair_state):
     corrupted = sign_block(spec, state.copy(), bad_block)  # proposer sig ok
     with pytest.raises(AssertionError, match="randao"):
         sigpipe.verify_block_signatures(spec, advanced, corrupted)
+
+
+# ---------------------------------------------------------------------------
+# per-fork collector audit: whisk (feature fork off capella)
+# ---------------------------------------------------------------------------
+
+def _build_whisk_block(spec, state):
+    """A fully valid signed whisk block at the next slot: opening proof
+    for the slot's proposer tracker, shuffle proof over the
+    randao-derived candidate indices, and a first-proposal tracker
+    registration."""
+    from consensus_specs_tpu.crypto import whisk_proofs
+    from consensus_specs_tpu.ssz import Vector
+    from consensus_specs_tpu.test_infra.blocks import (
+        build_empty_execution_payload)
+    from consensus_specs_tpu.test_infra.keys import privkey_for_pubkey
+
+    slot = int(state.slot) + 1
+    tracker = state.whisk_proposer_trackers[
+        slot % spec.WHISK_PROPOSER_TRACKERS_COUNT]
+    # genesis trackers are initial (k_r_G == k*G == the commitment), so
+    # the counter-0 k table inverts commitment -> (index, k)
+    k_by_commitment = {
+        bytes(state.whisk_k_commitments[i]):
+            (i, spec.get_initial_whisk_k(i, 0))
+        for i in range(len(state.validators))}
+    proposer_index, k = k_by_commitment[bytes(tracker.k_r_G)]
+
+    look = state.copy()
+    spec.process_slots(look, uint64(slot))
+    block = spec.BeaconBlock(
+        slot=uint64(slot), proposer_index=uint64(proposer_index),
+        parent_root=hash_tree_root(look.latest_block_header))
+    block.body.eth1_data.deposit_count = look.eth1_deposit_index
+    privkey = privkey_for_pubkey(state.validators[proposer_index].pubkey)
+    block.body.randao_reveal = spec.get_epoch_signature(
+        look, block, privkey)
+    block.body.sync_aggregate.sync_committee_signature = \
+        spec.G2_POINT_AT_INFINITY
+    block.body.execution_payload = build_empty_execution_payload(
+        spec, look)
+    block.body.whisk_opening_proof = whisk_proofs.prove_opening(
+        bytes(tracker.r_G), k, t=777)
+    indices = spec.get_shuffle_indices(block.body.randao_reveal)
+    pre = [(bytes(look.whisk_candidate_trackers[i].r_G),
+            bytes(look.whisk_candidate_trackers[i].k_r_G))
+           for i in indices]
+    post, proof = whisk_proofs.prove_shuffle(
+        pre, list(range(len(indices)))[::-1],
+        [5 + i for i in range(len(indices))])
+    block.body.whisk_post_shuffle_trackers = Vector[
+        spec.WhiskTracker, spec.WHISK_VALIDATORS_PER_SHUFFLE](
+        [spec.WhiskTracker(r_G=a, k_r_G=b) for a, b in post])
+    block.body.whisk_shuffle_proof = proof
+    k_new, r_new = 999999, 31337
+    r_G = bls.G1_to_bytes48(bls.multiply(bls.G1(), r_new))
+    block.body.whisk_tracker = spec.WhiskTracker(
+        r_G=r_G, k_r_G=bls.G1_to_bytes48(
+            bls.multiply(bls.bytes48_to_G1(r_G), k_new)))
+    block.body.whisk_k_commitment = spec.get_k_commitment(k_new)
+    block.body.whisk_registration_proof = whisk_proofs.prove_opening(
+        r_G, k_new, t=4242)
+
+    scratch = state.copy()
+    with disable_bls():
+        spec.state_transition(scratch, spec.SignedBeaconBlock(
+            message=block), validate_result=False)
+    block.state_root = hash_tree_root(scratch)
+    return sign_block(spec, state.copy(), block)
+
+
+def test_whisk_block_pipeline(phase0_spec):
+    """Per-fork collector audit (whisk): the feature fork's BLS surface
+    is fully collected — `block.proposer_index` stands in for the
+    header-derived proposer the randao collector cannot compute
+    pre-block — so a whisk transition batches with ZERO collector-miss
+    fallbacks.  The shuffle / registration / opening proofs are
+    intentionally unbatched (curdleproofs arguments, not BLS triples):
+    they never touch the bls seams, so leaving them inline costs no
+    fallback, which this test pins."""
+    from consensus_specs_tpu.specs import get_spec as _get_spec
+    spec = _get_spec("whisk", "minimal")
+    with disable_bls():
+        state = create_genesis_state(spec, default_balances(spec))
+    signed = _build_whisk_block(spec, state)
+
+    native_state = state.copy()
+    spec.state_transition(native_state, signed)
+    native_root = hash_tree_root(native_state)
+
+    METRICS.reset()
+    sigpipe.enable()
+    try:
+        pipe_state = state.copy()
+        spec.state_transition(pipe_state, signed)
+    finally:
+        sigpipe.disable()
+    assert hash_tree_root(pipe_state) == native_root
+
+    snapshot = METRICS.snapshot()
+    # whole BLS surface batched as one fused dispatch: proposer + randao
+    assert snapshot["seam_hits"] == 2
+    assert snapshot.get("seam_misses", 0) == 0
+    assert snapshot["dispatches"] == 1
+    # the pin: nothing on the whisk path degrades to scalar — the proof
+    # checks live outside the seams, and no collector missed
+    assert snapshot.get("scalar_fallbacks", {}).get(
+        "collector_miss", 0) == 0
+    assert snapshot.get("collect_skipped", 0) == 0
+    # and the collected kinds are exactly the BLS ones (no whisk-proof
+    # pseudo-sets sneak into the batch)
+    advanced = state.copy()
+    spec.process_slots(advanced, signed.message.slot)
+    kinds = {s.kind for s in sigpipe.collect_block_sets(
+        spec, advanced, signed)}
+    assert kinds == {"proposer", "randao"}
