@@ -12,8 +12,9 @@ RUNNERS := shuffling ssz_static operations epoch_processing sanity bls \
 	kzg rewards finality genesis fork_choice transition ssz_generic \
 	forks merkle_proof networking kzg_7594 random light_client sync
 
-.PHONY: test test-quick test-kernels tier1 chaos lint native pyspec bench \
-	gossip-bench gen_all detect_errors $(addprefix gen_,$(RUNNERS))
+.PHONY: test test-quick test-kernels tier1 chaos recovery-chaos lint \
+	native pyspec bench gossip-bench txn-bench gen_all detect_errors \
+	$(addprefix gen_,$(RUNNERS))
 
 # syntax/bytecode check over every package and script (the CI lint job)
 lint:
@@ -34,7 +35,7 @@ test-quick:
 	$(PYTHON) -m pytest tests/spec_suites tests/test_ssz.py \
 		tests/test_phase0_sanity.py tests/test_epoch_fast.py \
 		tests/test_sigpipe.py tests/test_resilience.py \
-		tests/test_gossip.py -q
+		tests/test_gossip.py tests/test_txn.py -q
 
 # the exact ROADMAP.md tier-1 verify command (what the driver runs);
 # DOTS_PASSED counts green dots from the -q progress lines
@@ -54,6 +55,14 @@ chaos:
 	env JAX_PLATFORMS=cpu CHAOS_SEED=$${CHAOS_SEED:-20260803} \
 		$(PYTHON) -m pytest tests/test_chaos.py -q --kernel-tiers
 
+# crash-anywhere recovery tier alone (txn/): seeded kills mid-handler /
+# mid-commit / mid-journal-write, recovered store byte-identical to the
+# never-crashed oracle
+recovery-chaos:
+	env JAX_PLATFORMS=cpu CHAOS_SEED=$${CHAOS_SEED:-20260803} \
+		$(PYTHON) -m pytest tests/test_chaos.py tests/test_txn.py \
+		-k "txn or crash or torn or recover" -q --kernel-tiers
+
 native:
 	$(PYTHON) scripts/build_native.py
 
@@ -71,6 +80,11 @@ bench:
 # native and BENCH_GOSSIP_MSGS=8 give an accelerator-less smoke run
 gossip-bench:
 	$(PYTHON) bench.py gossip
+
+# transactional-store commit overhead alone (txn/): asserts < 10% added
+# latency on native-BLS on_block replays with WAL journaling on
+txn-bench:
+	$(PYTHON) bench.py txn
 
 # static pattern rule: GNU make refuses to run implicit pattern rules
 # for .PHONY targets

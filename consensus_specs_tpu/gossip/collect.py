@@ -40,7 +40,11 @@ class Collected:
     def __init__(self, sets=(), votes=()):
         self.sets = list(sets)      # SignatureSets to micro-batch
         self.votes = list(votes)    # (kind, validator_index, vote_key,
-        #                              content digest) for the guard
+        #                              content digest, ffg) for the
+        #                              guard; ffg is the (source epoch,
+        #                              target epoch) pair for
+        #                              attestation votes (the surround
+        #                              detector's input), None elsewhere
 
 
 def resolve_target_state(spec, store, target, cache):
@@ -75,7 +79,8 @@ def _attestation(spec, store, attestation, cache, origin) -> Collected:
                  origin,
                  hint=("att", int(data.target.epoch),
                        int(getattr(data, "index", 0))))]
-    votes = [("attestation", i, int(data.target.epoch), data_digest)
+    ffg = (int(data.source.epoch), int(data.target.epoch))
+    votes = [("attestation", i, int(data.target.epoch), data_digest, ffg)
              for i in indices]
     return Collected(sets, votes)
 
@@ -105,7 +110,7 @@ def _sync_message(spec, store, message, origin) -> Collected:
     sets = [_set(pubkeys, root, signature, "gossip_sync_message",
                  origin)]
     votes = [("sync", int(message.validator_index), int(message.slot),
-              bytes(message.beacon_block_root))]
+              bytes(message.beacon_block_root), None)]
     return Collected(sets, votes)
 
 
@@ -113,7 +118,7 @@ def _block(spec, store, signed_block, origin) -> Collected:
     block = signed_block.message
     return Collected((), [("block", int(block.proposer_index),
                            int(block.slot),
-                           bytes(hash_tree_root(block)))])
+                           bytes(hash_tree_root(block)), None)])
 
 
 def _payload_attestation(spec, store, message, origin) -> Collected:
@@ -121,7 +126,7 @@ def _payload_attestation(spec, store, message, origin) -> Collected:
         store, message)
     votes = [("payload_attestation", int(message.validator_index),
               int(message.data.slot),
-              bytes(hash_tree_root(message.data)))]
+              bytes(hash_tree_root(message.data)), None)]
     return Collected(
         [_set(pubkeys, root, signature, "gossip_payload_attestation",
               origin)],

@@ -17,8 +17,13 @@ Two independent defenses against adversarial gossip:
   A validator that signs two DIFFERENT messages for the same voting
   slot (two attestation datas with one target epoch: a double vote;
   two blocks at one slot; two sync votes for one slot) is provably
-  equivocating.  The guard remembers the first *verified* (key ->
-  content digest) vote per voting key — the pipeline records a vote
+  equivocating, and so is a validator whose attestation SURROUNDS (or
+  is surrounded by) one of its earlier votes — source_1 < source_2 and
+  target_2 < target_1, the second half of
+  `is_slashable_attestation_data`.  The guard remembers, per verified
+  vote, both the first (key -> content digest) entry for double-vote
+  detection and a bounded per-validator (source epoch, target epoch)
+  FFG history for surround detection — the pipeline records a vote
   only after the carrying message passed signature verification and
   was accepted, and quarantines only when the CONFLICTING message's
   signature verifies too.  Unverified junk claiming a validator index
@@ -26,7 +31,8 @@ Two independent defenses against adversarial gossip:
   a genuine conflict the validator index is quarantined — its
   sole-signer traffic is shed from then on — and the evidence pair is
   surfaced through the incident log (`gossip.equivocation` /
-  `quarantine`, with both digests), which is exactly what a slashing
+  `quarantine`, with both digests; surround evidence carries the two
+  source->target spans too), which is exactly what a slashing
   inclusion pipeline needs to pick up.
 
   Decisions are content-addressed and first-verified-write-wins:
@@ -74,9 +80,17 @@ class SeenCache:
 
 
 class EquivocationGuard:
+    # bound on the per-validator FFG history the surround detector
+    # scans: weak-subjectivity-period-scale voting is epochs apart, so
+    # a recent window is what real surround evidence lands in
+    MAX_FFG_VOTES = 64
+
     def __init__(self, max_keys: int = 1 << 16,
                  metrics=METRICS, incidents=INCIDENTS):
         self._first: OrderedDict = OrderedDict()   # vote key -> digest
+        self._ffg: OrderedDict = OrderedDict()     # validator ->
+        #                                            [(source, target,
+        #                                              digest)]
         self._max = int(max_keys)
         self._metrics = metrics
         self._incidents = incidents
@@ -89,25 +103,69 @@ class EquivocationGuard:
         """The recorded verified digest for this voting key, if any."""
         return self._first.get((kind, int(validator_index), vote_key))
 
+    @staticmethod
+    def _surrounds(a, b) -> bool:
+        """Does FFG vote `a` (source, target) surround `b`?"""
+        return a[0] < b[0] and b[1] < a[1]
+
+    def surround_conflict(self, validator_index: int, ffg):
+        """A recorded verified (source, target, digest) vote that `ffg`
+        surrounds or is surrounded by, if any — the
+        is_slashable_attestation_data surround arm, evaluated against
+        this validator's verified history."""
+        if ffg is None:
+            return None
+        history = self._ffg.get(int(validator_index))
+        if not history:
+            return None
+        for recorded in history:
+            pair = (recorded[0], recorded[1])
+            if self._surrounds(ffg, pair) or self._surrounds(pair, ffg):
+                return recorded
+        return None
+
+    def _record_ffg(self, validator_index: int, ffg,
+                    digest: bytes) -> None:
+        history = self._ffg.get(validator_index)
+        if history is None:
+            if len(self._ffg) >= self._max:
+                self._ffg.popitem(last=False)
+            history = self._ffg[validator_index] = []
+        entry = (ffg[0], ffg[1], digest)
+        if entry not in history:
+            if len(history) >= self.MAX_FFG_VOTES:
+                history.pop(0)
+            history.append(entry)
+
     def observe(self, kind: str, validator_index: int, vote_key,
-                digest: bytes) -> bool:
+                digest: bytes, ffg=None) -> bool:
         """Record one VERIFIED (validator, vote).  Returns True when
         consistent (first vote, or a repeat of the same content); on a
-        conflict the validator is quarantined with evidence and False
-        is returned.  Only call this for messages whose signatures
-        verified — the pipeline does, post-delivery."""
+        conflict — double vote on the key, or a surround against the
+        FFG history when `ffg` is given — the validator is quarantined
+        with evidence and False is returned.  Only call this for
+        messages whose signatures verified — the pipeline does,
+        post-delivery."""
         validator_index = int(validator_index)
         key = (kind, validator_index, vote_key)
         first = self._first.get(key)
+        if first is not None and first != digest:
+            self.quarantine(kind, validator_index, vote_key, first,
+                            digest)
+            return False
+        if ffg is not None:
+            conflict = self.surround_conflict(validator_index, ffg)
+            if conflict is not None:
+                self.quarantine_surround(validator_index, ffg, digest,
+                                         conflict)
+                return False
         if first is None:
             if len(self._first) >= self._max:
                 self._first.popitem(last=False)
             self._first[key] = digest
-            return True
-        if first == digest:
-            return True
-        self.quarantine(kind, validator_index, vote_key, first, digest)
-        return False
+        if ffg is not None:
+            self._record_ffg(validator_index, ffg, digest)
+        return True
 
     def quarantine(self, kind: str, validator_index: int, vote_key,
                    first: bytes, second: bytes) -> None:
@@ -122,3 +180,20 @@ class EquivocationGuard:
             "gossip.equivocation", "quarantine", kind=kind,
             validator_index=validator_index, vote=repr(vote_key),
             first=first.hex(), second=second.hex())
+
+    def quarantine_surround(self, validator_index: int, ffg,
+                            digest: bytes, conflict) -> None:
+        """Quarantine `validator_index` over verified surround evidence:
+        `conflict` is the recorded (source, target, digest) vote the new
+        (ffg, digest) vote surrounds or is surrounded by."""
+        validator_index = int(validator_index)
+        if validator_index in self.quarantined:
+            return
+        self.quarantined.add(validator_index)
+        self._metrics.inc("gossip_equivocations")
+        self._incidents.record(
+            "gossip.equivocation", "quarantine", kind="surround",
+            validator_index=validator_index,
+            first_vote=f"{conflict[0]}->{conflict[1]}",
+            second_vote=f"{ffg[0]}->{ffg[1]}",
+            first=conflict[2].hex(), second=digest.hex())

@@ -22,6 +22,17 @@ quarantine) are made from bounded state and an injected clock — a
 seeded schedule replays to the same decisions every run, which is what
 lets the chaos tier diff the pipeline against its oracle.
 
+CONCURRENCY.  `submit()` is thread-safe: admission state (seq
+allocation, dedup cache, quotas, queues, results) lives under one
+ingress lock, and delivery follows a single-drainer discipline — flushes
+run only under the drainer lock, `submit`'s closing `poll()` simply
+skips when another thread is already draining (that drainer's own
+flush/poll loop picks the window up).  Handler execution — the one place
+the fork-choice store is touched — is therefore always serialized, so
+concurrent ingress can never corrupt queues, quotas, or the store, and
+the delivered sequence remains a valid sequential schedule the scalar
+oracle can replay.
+
 SEMANTICS CONTRACT.  For the messages the pipeline delivers, per-message
 accept/reject verdicts and the resulting store are byte-identical to
 applying the same messages one at a time through the bare handlers
@@ -35,6 +46,7 @@ does to the store.
 """
 from __future__ import annotations
 
+import threading
 from collections import deque
 from dataclasses import dataclass
 
@@ -129,36 +141,46 @@ class AdmissionPipeline:
         self.delivered_log = deque(maxlen=cfg.history_bound)
         self._finalized_order: deque = deque()  # eviction order for results
         self._seq = 0
+        # ingress lock: admission/bookkeeping state (seq, seen, quotas,
+        # queues, batcher window, results).  drainer lock: the
+        # single-drainer discipline — whoever holds it owns flushing and
+        # handler delivery.  Order: drainer may take ingress, never the
+        # reverse.
+        self._ingress_lock = threading.RLock()
+        self._drainer_lock = threading.Lock()
 
     # -- ingress -------------------------------------------------------
     def submit(self, topic: str, payload, peer: str = "local") -> int:
         """Admit one gossip message; returns its sequence number.  May
-        trigger a size-cap flush.  The verdict lands in results[seq]."""
+        trigger a size-cap flush.  The verdict lands in results[seq].
+        Thread-safe: admission runs under the ingress lock; the closing
+        poll() only flushes when no other thread is already draining."""
         assert topic in self.topics, \
             f"topic {topic!r} not supported by {self.spec.fork} spec"
-        self._seq += 1
-        seq = self._seq
-        digest = bytes(hash_tree_root(payload))
-        message = Message(seq, topic, peer, payload, digest)
-        METRICS.inc_labeled("gossip_submitted", topic)
+        digest = bytes(hash_tree_root(payload))     # hash outside locks
+        with self._ingress_lock:
+            self._seq += 1
+            seq = self._seq
+            message = Message(seq, topic, peer, payload, digest)
+            METRICS.inc_labeled("gossip_submitted", topic)
 
-        if self.seen.seen_before(digest):
-            METRICS.inc_labeled("gossip_shed", "duplicate")
-            self._finalize(message, "shed", "duplicate")
-            return seq
+            if self.seen.seen_before(digest):
+                METRICS.inc_labeled("gossip_shed", "duplicate")
+                self._finalize(message, "shed", "duplicate")
+                return seq
 
-        outcome = self.quotas.admit(peer, message)
-        if outcome == "shed":
-            # capacity shed: NOT marked seen — redelivery retries
-            self._finalize(message, "shed", "quota")
-            return seq
-        self.seen.add(digest)
-        self._shed_evicted_backlogs()
-        if outcome == "deferred":
-            self.results[seq] = Result(seq, topic, peer, "deferred")
-            return seq
+            outcome = self.quotas.admit(peer, message)
+            if outcome == "shed":
+                # capacity shed: NOT marked seen — redelivery retries
+                self._finalize(message, "shed", "quota")
+                return seq
+            self.seen.add(digest)
+            self._shed_evicted_backlogs()
+            if outcome == "deferred":
+                self.results[seq] = Result(seq, topic, peer, "deferred")
+                return seq
 
-        self._enqueue(message)
+            self._enqueue(message)
         self.poll()
         return seq
 
@@ -182,39 +204,69 @@ class AdmissionPipeline:
 
     # -- the window ----------------------------------------------------
     def pending_count(self) -> int:
-        return sum(len(q) for q in self.queues.values())
+        with self._ingress_lock:
+            return sum(len(q) for q in self.queues.values())
 
     def poll(self) -> bool:
         """Release any quota-deferred messages whose buckets refilled,
-        then flush if the batch window has closed (deadline or size);
+        then flush while the batch window is closed (deadline or size);
         returns whether a flush happened.  Releasing here — not just at
         drain — is what makes deferral backpressure rather than
         starvation: the normal submit/poll loop frees the backlog as
-        tokens accrue."""
-        for message in self.quotas.take_refilled():
-            self._enqueue(message)
-        reason = self.batcher.flush_reason(self.pending_count())
-        if reason is None:
-            return False
-        self._flush(reason)
-        return True
+        tokens accrue.  Single-drainer: when another thread holds the
+        drainer lock this returns immediately — and so that skipped
+        poll is never lost, the active drainer re-checks the window
+        after RELEASING the lock and resumes if a racing submit filled
+        one (a submit's enqueue always happens before its failed
+        acquire, so the re-check is ordered after it)."""
+        flushed = False
+        while True:
+            if not self._drainer_lock.acquire(blocking=False):
+                return flushed
+            try:
+                while True:
+                    with self._ingress_lock:
+                        for message in self.quotas.take_refilled():
+                            self._enqueue(message)
+                        reason = self.batcher.flush_reason(
+                            self.pending_count())
+                    if reason is None:
+                        break
+                    self._flush(reason)
+                    flushed = True
+            finally:
+                self._drainer_lock.release()
+            with self._ingress_lock:
+                if self.batcher.flush_reason(self.pending_count()) \
+                        is None:
+                    return flushed
 
     def drain(self) -> list:
         """Force every queued and quota-deferred message through;
         returns the finalized Results in seq order.  Deferred messages
         whose buckets are still empty stay deferred (backpressure is
         allowed to outlive a drain)."""
-        for message in self.quotas.take_refilled():
-            self._enqueue(message)
-        while self.pending_count():
-            self._flush(FLUSH_DRAIN)
+        with self._drainer_lock:
+            with self._ingress_lock:
+                for message in self.quotas.take_refilled():
+                    self._enqueue(message)
+            while self.pending_count():
+                self._flush(FLUSH_DRAIN)
+        # cover a racing submit whose poll() skipped while we held the
+        # drainer lock (same re-check-after-release discipline as poll)
+        self.poll()
         return self.verdicts()
 
     def _flush(self, reason: str) -> None:
-        self.batcher.window_closed(reason)
-        batch = sorted(
-            (m for q in self.queues.values() for m in q.pop_all()),
-            key=lambda m: m.seq)
+        """Verify and deliver one window.  Caller holds the drainer
+        lock; queue/batcher state is snapshotted under the ingress lock,
+        then collection + delivery run with ingress open so submitting
+        threads are never blocked behind handler execution."""
+        with self._ingress_lock:
+            self.batcher.window_closed(reason)
+            batch = sorted(
+                (m for q in self.queues.values() for m in q.pop_all()),
+                key=lambda m: m.seq)
         if not batch:
             return
 
@@ -275,7 +327,7 @@ class AdmissionPipeline:
         # still detected post-acceptance (observe() below quarantines
         # with evidence); only non-block traffic is shed.
         if sole is not None and message.topic != "block":
-            kind, validator_index, vote_key, digest = sole
+            kind, validator_index, vote_key, digest, ffg = sole
             if self.guard.is_quarantined(validator_index):
                 METRICS.inc_labeled("gossip_shed", "quarantined")
                 self._finalize(message, "shed", "quarantined")
@@ -289,6 +341,19 @@ class AdmissionPipeline:
                 METRICS.inc_labeled("gossip_shed", "equivocation")
                 self._finalize(message, "shed", "equivocation")
                 return
+            # surround arm: an FFG vote that surrounds (or is
+            # surrounded by) one of this validator's VERIFIED earlier
+            # votes sheds pre-delivery iff its own signature verifies —
+            # the same no-framing discipline as the double-vote gate
+            surround = self.guard.surround_conflict(validator_index,
+                                                    ffg)
+            if (surround is not None
+                    and self._sets_verify(collected.sets, by_key)):
+                self.guard.quarantine_surround(validator_index, ffg,
+                                               digest, surround)
+                METRICS.inc_labeled("gossip_shed", "equivocation")
+                self._finalize(message, "shed", "surround")
+                return
         accepted = self._deliver(message, verdict_map)
         if accepted and votes:
             # every handler proves the signature as part of acceptance
@@ -298,9 +363,9 @@ class AdmissionPipeline:
             # can never frame a validator through the ignore path
             if (message.topic not in _UNVERIFIED_ACCEPT_TOPICS
                     or self._sets_verify(collected.sets, by_key)):
-                for kind, validator_index, vote_key, digest in votes:
+                for kind, validator_index, vote_key, digest, ffg in votes:
                     self.guard.observe(kind, validator_index, vote_key,
-                                       digest)
+                                       digest, ffg)
 
     # -- delivery ------------------------------------------------------
     def _deliver(self, message: Message, verdict_map) -> bool:
@@ -325,31 +390,39 @@ class AdmissionPipeline:
             # rejections are often TRANSIENT (attestation a slot early,
             # target block not yet imported — the p2p spec's IGNORE
             # class): forget the digest so honest redelivery revalidates
-            # once the condition clears, instead of dying as 'duplicate'
-            self.seen.discard(message.digest)
+            # once the condition clears, instead of dying as 'duplicate'.
+            # The seen cache is admission state — mutate it under the
+            # ingress lock even from the drainer's delivery loop
+            with self._ingress_lock:
+                self.seen.discard(message.digest)
             self._finalize(message, "rejected", detail)
         return accepted
 
     def _finalize(self, message: Message, status: str,
                   detail: str = "") -> None:
-        self.results[message.seq] = Result(
-            message.seq, message.topic, message.peer, status, detail)
-        # O(1) amortized pruning: finalized verdicts evict oldest-first
-        # once over the bound.  The bound counts FINALIZED entries only
-        # — in-flight (queued/deferred) entries are never evicted and
-        # must not displace fresh verdicts either, or a large deferred
-        # backlog would evict every new verdict the moment it lands
-        self._finalized_order.append(message.seq)
-        while len(self._finalized_order) > self.config.history_bound:
-            seq = self._finalized_order.popleft()
-            if self.results.get(seq) is not None and \
-                    self.results[seq].final:
-                del self.results[seq]
+        # called from both submit threads (ingress lock held) and the
+        # drainer's delivery loop (ingress open) — take it reentrantly
+        with self._ingress_lock:
+            self.results[message.seq] = Result(
+                message.seq, message.topic, message.peer, status, detail)
+            # O(1) amortized pruning: finalized verdicts evict
+            # oldest-first once over the bound.  The bound counts
+            # FINALIZED entries only — in-flight (queued/deferred)
+            # entries are never evicted and must not displace fresh
+            # verdicts either, or a large deferred backlog would evict
+            # every new verdict the moment it lands
+            self._finalized_order.append(message.seq)
+            while len(self._finalized_order) > self.config.history_bound:
+                seq = self._finalized_order.popleft()
+                if self.results.get(seq) is not None and \
+                        self.results[seq].final:
+                    del self.results[seq]
 
     def verdicts(self) -> list:
         """Every finalized Result in arrival order."""
-        return [self.results[seq] for seq in sorted(self.results)
-                if self.results[seq].final]
+        with self._ingress_lock:
+            return [self.results[seq] for seq in sorted(self.results)
+                    if self.results[seq].final]
 
 
 _HANDLER_METHODS = {

@@ -156,6 +156,26 @@ def active_plan() -> FaultPlan | None:
     return _ACTIVE
 
 
+def fire(site: str) -> None:
+    """Consult the active plan at a *barrier* site — a named point in a
+    control path that produces no value to corrupt (a store mutation, a
+    commit boundary, a journal write; the txn/ subsystem's kill points).
+    A ``raise`` spec dies here with a `DeviceFault` (the simulated
+    crash), a ``timeout`` spec stalls, and a ``corrupt`` spec is a no-op
+    beyond being recorded — there is no verdict at a barrier to flip.
+    With no plan installed this is one global read."""
+    plan = _ACTIVE
+    if plan is None:
+        return
+    spec = plan.decide(site)
+    if spec is None:
+        return
+    if spec.kind == "raise":
+        raise DeviceFault(f"injected crash at {site} (fire {spec.fires})")
+    if spec.kind == "timeout":
+        time.sleep(spec.sleep_s)
+
+
 @contextmanager
 def inject(plan: FaultPlan):
     """Install `plan` at every dispatch seam for the duration."""

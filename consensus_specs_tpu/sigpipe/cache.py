@@ -74,6 +74,37 @@ class AggregatePubkeyCache:
         self._max = max_size
         self._metrics = metrics
         self._lock = threading.RLock()
+        self._track_stack: list = []    # open insert-tracking scopes
+
+    # -- insert tracking (txn/ rollback invalidation) -------------------
+    # A transaction that rolls back must be able to evict exactly the
+    # aggregates it inserted (prewarms and verification-miss inserts):
+    # a rolled-back block's participant sets would otherwise linger in
+    # the cache as warm state the store never accepted.  Content
+    # addressing keeps them CORRECT, but crash-only discipline says a
+    # rolled-back operation leaves no trace.
+
+    def begin_track(self) -> set:
+        """Start recording digests inserted from now on; returns the
+        live set (hand it to `evict` on rollback, `end_track` always)."""
+        tracked: set = set()
+        with self._lock:
+            self._track_stack.append(tracked)
+        return tracked
+
+    def end_track(self, tracked: set) -> None:
+        with self._lock:
+            self._track_stack = [t for t in self._track_stack
+                                 if t is not tracked]
+
+    def evict(self, digests) -> int:
+        """Drop the given digests; returns how many were present."""
+        with self._lock:
+            evicted = sum(1 for d in digests
+                          if self._cache.pop(d, None) is not None)
+        if evicted:
+            self._metrics.inc("aggregate_cache_evictions", evicted)
+        return evicted
 
     @staticmethod
     def _digest(pubkey_bytes_list) -> bytes:
@@ -116,6 +147,8 @@ class AggregatePubkeyCache:
             if len(self._cache) >= self._max:
                 self._cache.pop(next(iter(self._cache)))
             self._cache[digest] = (agg, hint)
+            for tracked in self._track_stack:
+                tracked.add(digest)
         return agg
 
     def clear(self) -> None:

@@ -14,6 +14,7 @@ from dataclasses import dataclass, field
 from typing import Dict
 
 from ..ssz import Bytes32, hash_tree_root, uint64
+from ..txn import transactional
 from .fork_choice import Store as BaseStore
 
 
@@ -303,6 +304,7 @@ class Eip7732ForkChoice:
     # ------------------------------------------------------------------
     # handlers
     # ------------------------------------------------------------------
+    @transactional
     def on_block(self, store, signed_block) -> None:
         block = signed_block.message
         assert block.parent_root in store.block_states
@@ -333,11 +335,12 @@ class Eip7732ForkChoice:
         block_root = hash_tree_root(block)
         self.state_transition(state, signed_block, True)
 
-        store.blocks[block_root] = block
-        store.block_states[block_root] = state
-        store.ptc_vote[block_root] = \
-            [self.PAYLOAD_ABSENT] * int(self.PTC_SIZE)
-
+        # Mutation phase, new-block insertion LAST (same torn-store
+        # defense as the phase0 on_block): the in-block PTC
+        # notifications and the boost/checkpoint updates only touch
+        # ancestor entries (a payload attestation targets the previous
+        # slot's block), so a crash between any two mutations never
+        # leaves a half-visible block.
         self.notify_ptc_messages(store, state,
                                  block.body.payload_attestations)
 
@@ -353,8 +356,13 @@ class Eip7732ForkChoice:
 
         self.update_checkpoints(store, state.current_justified_checkpoint,
                                 state.finalized_checkpoint)
-        self.compute_pulled_up_tip(store, block_root)
+        self._apply_pulled_up_tip(store, block_root, block, state)
+        store.blocks[block_root] = block
+        store.block_states[block_root] = state
+        store.ptc_vote[block_root] = \
+            [self.PAYLOAD_ABSENT] * int(self.PTC_SIZE)
 
+    @transactional
     def on_execution_payload(self, store, signed_envelope) -> None:
         """New handler: a revealed SignedExecutionPayloadEnvelope
         produces the block's FULL state (fork-choice.md:450-476)."""
@@ -405,6 +413,7 @@ class Eip7732ForkChoice:
         signing_root = self.compute_signing_root(data, domain)
         return (pubkey,), signing_root, ptc_message.signature
 
+    @transactional
     def on_payload_attestation_message(self, store, ptc_message,
                                        is_from_block: bool = False) -> None:
         data = ptc_message.data

@@ -11,6 +11,7 @@ from dataclasses import dataclass, field
 from typing import Dict, Set
 
 from ..ssz import Bytes32, hash_tree_root, uint64
+from ..txn import transactional
 
 
 @dataclass
@@ -216,20 +217,30 @@ class Phase0ForkChoice:
                 unrealized_finalized_checkpoint
 
     def compute_pulled_up_tip(self, store: Store, block_root) -> None:
-        state = store.block_states[block_root].copy()
-        self.process_justification_and_finalization(state)
+        self._apply_pulled_up_tip(store, block_root,
+                                  store.blocks[block_root],
+                                  store.block_states[block_root])
+
+    def _apply_pulled_up_tip(self, store: Store, block_root, block,
+                             state) -> None:
+        """The body of compute_pulled_up_tip with the new block and its
+        state passed as locals: on_block calls this BEFORE inserting
+        into store.blocks/block_states, so the insertion can be the
+        handler's last mutation (the torn-store defense)."""
+        pulled = state.copy()
+        self.process_justification_and_finalization(pulled)
         store.unrealized_justifications[block_root] = \
-            state.current_justified_checkpoint
+            pulled.current_justified_checkpoint
         self.update_unrealized_checkpoints(
-            store, state.current_justified_checkpoint,
-            state.finalized_checkpoint)
+            store, pulled.current_justified_checkpoint,
+            pulled.finalized_checkpoint)
         # blocks from prior epochs apply realized checkpoints immediately
-        block_epoch = self.compute_epoch_at_slot(
-            store.blocks[block_root].slot)
+        block_epoch = self.compute_epoch_at_slot(block.slot)
         current_epoch = self.get_current_store_epoch(store)
         if block_epoch < current_epoch:
-            self.update_checkpoints(store, state.current_justified_checkpoint,
-                                    state.finalized_checkpoint)
+            self.update_checkpoints(store,
+                                    pulled.current_justified_checkpoint,
+                                    pulled.finalized_checkpoint)
 
     # ------------------------------------------------------------------
     # handlers
@@ -246,6 +257,7 @@ class Phase0ForkChoice:
                                     store.unrealized_justified_checkpoint,
                                     store.unrealized_finalized_checkpoint)
 
+    @transactional
     def on_tick(self, store: Store, time: int) -> None:
         # tick through every intervening slot boundary
         tick_slot = (int(time) - store.genesis_time) \
@@ -257,6 +269,7 @@ class Phase0ForkChoice:
             self.on_tick_per_slot(store, previous_time)
         self.on_tick_per_slot(store, time)
 
+    @transactional
     def on_block(self, store: Store, signed_block) -> None:
         block = signed_block.message
         # parent known
@@ -282,24 +295,33 @@ class Phase0ForkChoice:
         self.validate_merge_transition_block(pre_state, block)
 
         block_root = hash_tree_root(block)
-        store.blocks[block_root] = block
-        store.block_states[block_root] = state
 
-        # timeliness & proposer boost
+        # timeliness & proposer boost (computed before any mutation)
         time_into_slot = (store.time - store.genesis_time) \
             % self.config.SECONDS_PER_SLOT
         is_before_attesting_interval = time_into_slot < (
             self.config.SECONDS_PER_SLOT // self.INTERVALS_PER_SLOT)
         is_timely = (self.get_current_slot(store) == block.slot
                      and is_before_attesting_interval)
-        store.block_timeliness[block_root] = is_timely
         is_first_block = store.proposer_boost_root == Bytes32()
+
+        # Mutation phase.  blocks/block_states insertion goes LAST: the
+        # final mutations are the ones that make the block visible to
+        # the rest of fork choice, so a crash between any two mutations
+        # can never leave a half-applied block that get_head or the
+        # gossip pipeline would build on (every earlier write is keyed
+        # by a root nothing else resolves yet, or is a monotone
+        # checkpoint update that is valid on its own).  Defense in depth
+        # under the scalar path; the txn overlay makes the whole phase
+        # atomic when enabled.
+        store.block_timeliness[block_root] = is_timely
         if is_timely and is_first_block:
             store.proposer_boost_root = block_root
-
         self.update_checkpoints(store, state.current_justified_checkpoint,
                                 state.finalized_checkpoint)
-        self.compute_pulled_up_tip(store, block_root)
+        self._apply_pulled_up_tip(store, block_root, block, state)
+        store.blocks[block_root] = block
+        store.block_states[block_root] = state
 
     def check_block_data_availability(self, store, signed_block) -> None:
         """Phase0: nothing to check (deneb overrides for blob DA)."""
@@ -378,6 +400,7 @@ class Phase0ForkChoice:
         self.update_latest_messages(
             store, indexed_attestation.attesting_indices, attestation)
 
+    @transactional
     def on_attestation(self, store, attestation,
                        is_from_block: bool = False) -> None:
         self.validate_on_attestation(store, attestation, is_from_block)
@@ -452,6 +475,7 @@ class Phase0ForkChoice:
             state, signed)
         assert self.bls_verify(pubkeys[0], root, signature)
 
+    @transactional
     def on_aggregate_and_proof(self, store, signed) -> None:
         """Gossip aggregate admission: validate the envelope, then apply
         the inner aggregate.  validate_aggregate_and_proof already ran
@@ -495,12 +519,14 @@ class Phase0ForkChoice:
             Bytes32(message.beacon_block_root), domain)
         return (pubkey,), root, message.signature
 
+    @transactional
     def on_sync_committee_message(self, store, message) -> None:
         """Gossip sync-message admission: pure validation — accepted
         messages feed the local aggregator, not the fork-choice store,
         so the handler leaves `store` untouched."""
         self.validate_sync_committee_message(store, message)
 
+    @transactional
     def on_attester_slashing(self, store, attester_slashing) -> None:
         attestation_1 = attester_slashing.attestation_1
         attestation_2 = attester_slashing.attestation_2

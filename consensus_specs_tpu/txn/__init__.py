@@ -1,0 +1,238 @@
+"""Transactional fork-choice store: atomic commit/rollback, write-ahead
+journaling, and crash recovery for every fork-choice handler.
+
+The problem (crash-only software, Candea & Fox 2003): `on_block` and its
+siblings perform half a dozen separate store mutations.  A fault fired
+mid-handler — an injected device error, a watchdog timeout, a real crash
+— used to leave a half-applied block that the gossip pipeline would
+happily build on.  This package makes every handler atomic-or-absent:
+
+    txn.enable(journal=txn.Journal())      # journaling optional
+    spec.on_block(store, signed_block)     # commits atomically, or
+                                           # rolls back to the exact
+                                           # pre-call store
+    ...
+    recovered = txn.recover(spec, journal)   # after a crash
+
+Mechanics, in the order a handler call experiences them:
+
+1. intent — with journaling on, the call (op + deep-copied args) is
+   appended to the WAL first (journal.py; ``txn.journal`` kill point).
+2. isolation — the handler runs against a `StoreTransaction`
+   copy-on-write view (overlay.py); the base store is never written
+   while the handler can still fail.  Every overlay mutation is a
+   ``txn.mutate`` kill point: the chaos tier can crash the handler
+   between any two store writes and rollback must hold.
+3. commit — routed through `resilience.dispatch("txn.commit", ...)`:
+   a REAL dispatch site, so the fault injector targets it and the
+   supervisor's retry/breaker discipline covers it (the fallback is the
+   same idempotent apply with fault consultation off — the trusted
+   path, byte-identical by construction).  The journal commit marker is
+   written first (the redo decision), then the overlay applies field by
+   field (``txn.commit.apply`` kill points between fields).
+4. rollback — ANY exception before the commit marker discards the
+   overlay, evicts the aggregate-pubkey cache entries this transaction
+   inserted (sigpipe/cache.py insert tracking — a rolled-back block's
+   pre-warmed aggregates must not linger), records a ``txn.rollback``
+   incident, and re-raises at the handler's own boundary.  A crash
+   AFTER the marker is a torn commit: recorded (``txn.torn``), and
+   repaired by recovery replaying the marked operation.
+5. recovery — `recover(spec, journal)` clones the latest
+   content-addressed snapshot, re-verifies its `store_root`, replays
+   the committed tail through the bare handlers, and returns a store
+   byte-identical to one that never crashed.
+
+Reentrancy: a wrapped handler calling another wrapped handler (eip7732
+`on_block` → `on_payload_attestation_message`) sees the view and joins
+the enclosing transaction — one handler call, one commit.
+
+With txn disabled (the default) the decorator is a global read and the
+handlers are byte-for-byte the pre-txn code paths.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from contextlib import contextmanager
+
+from ..resilience.incidents import INCIDENTS
+from ..resilience.supervisor import dispatch
+from ..sigpipe.cache import AGGREGATES
+from ..sigpipe.metrics import METRICS
+from .journal import Journal, JournalEntry, Snapshot
+from .oracle import store_root
+from .overlay import OverlayDict, OverlaySet, StoreTransaction, clone_store
+
+COMMIT_SITE = "txn.commit"
+
+_ACTIVE = None
+_lock = threading.RLock()
+
+
+class TxnManager:
+    """Session state for transactional handler execution: the optional
+    journal, the snapshot cadence, and the commit/rollback machinery."""
+
+    def __init__(self, journal: Journal | None = None,
+                 snapshot_interval: int = 32):
+        self.journal = journal
+        self.snapshot_interval = max(1, int(snapshot_interval))
+        self._commits_since_snapshot = 0
+
+    def run(self, spec, fn, store, args, kwargs):
+        journal = self.journal
+        entry = None
+        if journal is not None:
+            if journal.needs_anchor():
+                journal.snapshot(store)     # the startup anchor
+            entry = journal.append_intent(fn.__name__, args, kwargs)
+        view = StoreTransaction(store)
+        tracked = AGGREGATES.begin_track()
+        marked = [False]
+        try:
+            result = fn(spec, view, *args, **kwargs)
+            self._commit(view, entry, marked)
+        except BaseException as e:
+            if marked[0]:
+                # the redo decision was already durable: the live store
+                # may hold a partial apply.  Crash-only discipline —
+                # don't patch it in place, recover from the journal.
+                METRICS.inc_labeled("txn_torn_commits", fn.__name__)
+                INCIDENTS.record("txn.commit", "torn", op=fn.__name__,
+                                 error=f"{type(e).__name__}: {e}")
+            else:
+                AGGREGATES.evict(tracked)
+                METRICS.inc_labeled("txn_rollbacks", fn.__name__)
+                INCIDENTS.record("txn", "rollback", op=fn.__name__,
+                                 error=f"{type(e).__name__}: {e}")
+            raise
+        finally:
+            AGGREGATES.end_track(tracked)
+        METRICS.inc_labeled("txn_commits", fn.__name__)
+        if journal is not None:
+            self._commits_since_snapshot += 1
+            if self._commits_since_snapshot >= self.snapshot_interval:
+                self._commits_since_snapshot = 0
+                journal.snapshot(store)
+        return result
+
+    def _commit(self, view: StoreTransaction, entry, marked) -> None:
+        journal = self.journal
+
+        def apply(consult_faults: bool):
+            if entry is not None:
+                journal.mark_committed(entry)
+            marked[0] = True
+            view.apply(consult_faults=consult_faults)
+
+        # A real dispatch site: the injector can kill it, the supervisor
+        # retries transient faults and, once the breaker trips, routes
+        # to the fallback — the same apply with fault consultation off.
+        # Both paths are idempotent, so retry-after-partial is safe.
+        dispatch(COMMIT_SITE,
+                 lambda: apply(True),
+                 lambda: apply(False))
+
+
+def enable(journal: Journal | None = None,
+           snapshot_interval: int = 32) -> TxnManager:
+    """Run every wrapped fork-choice handler transactionally; returns
+    the manager.  Pass a `Journal` to add write-ahead logging + periodic
+    snapshots (what `recover` replays)."""
+    global _ACTIVE
+    with _lock:
+        _ACTIVE = TxnManager(journal, snapshot_interval)
+        return _ACTIVE
+
+
+def disable() -> None:
+    global _ACTIVE
+    with _lock:
+        _ACTIVE = None
+
+
+def enabled() -> bool:
+    return _ACTIVE is not None
+
+
+def active() -> TxnManager | None:
+    return _ACTIVE
+
+
+@contextmanager
+def scope(journal: Journal | None = None, snapshot_interval: int = 32):
+    """Transactional execution for a lexical region (tests, replay)."""
+    global _ACTIVE
+    with _lock:
+        previous = _ACTIVE
+        _ACTIVE = TxnManager(journal, snapshot_interval)
+        manager = _ACTIVE
+    try:
+        yield manager
+    finally:
+        with _lock:
+            _ACTIVE = previous
+
+
+@contextmanager
+def _suspended():
+    """Run with transactions off (recovery replay must not re-journal)."""
+    global _ACTIVE
+    with _lock:
+        previous = _ACTIVE
+        _ACTIVE = None
+    try:
+        yield
+    finally:
+        with _lock:
+            _ACTIVE = previous
+
+
+def transactional(fn):
+    """Wrap a fork-choice handler (method taking `store` first after
+    self) in commit/rollback semantics.  Pass-through when txn is
+    disabled or when the store is already a transaction view (nested
+    handler calls join the enclosing transaction)."""
+
+    @functools.wraps(fn)
+    def wrapper(self, store, *args, **kwargs):
+        manager = _ACTIVE
+        if manager is None or isinstance(store, StoreTransaction):
+            return fn(self, store, *args, **kwargs)
+        return manager.run(self, fn, store, args, kwargs)
+
+    return wrapper
+
+
+def recover(spec, journal: Journal):
+    """Rebuild a store from the journal: clone the latest snapshot,
+    re-verify its content address, replay the committed tail through
+    the bare handlers.  Returns a store byte-identical (store_root) to
+    the sequential application of every committed operation."""
+    snap = journal.latest_snapshot()
+    if snap is None:
+        raise RuntimeError("journal has no snapshot to recover from; "
+                           "enable(journal=...) anchors one at startup")
+    store = clone_store(snap.store)
+    root = store_root(store)
+    if root != snap.root:
+        raise RuntimeError(
+            f"snapshot integrity check failed: stored root "
+            f"{snap.root.hex()} != recomputed {root.hex()}")
+    tail = journal.committed_entries(after_seq=snap.entry_seq)
+    with _suspended():
+        for entry in tail:
+            getattr(spec, entry.op)(store, *entry.args, **entry.kwargs)
+    METRICS.inc("txn_recoveries")
+    INCIDENTS.record("txn.recover", "recovered",
+                     snapshot_entry_seq=snap.entry_seq,
+                     snapshot_root=snap.root.hex(), replayed=len(tail))
+    return store
+
+
+__all__ = [
+    "COMMIT_SITE", "Journal", "JournalEntry", "OverlayDict", "OverlaySet",
+    "Snapshot", "StoreTransaction", "TxnManager", "active", "clone_store",
+    "disable", "enable", "enabled", "recover", "scope", "store_root",
+    "transactional",
+]

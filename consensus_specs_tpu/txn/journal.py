@@ -1,0 +1,155 @@
+"""Write-ahead intent journal + content-addressed checkpoint snapshots.
+
+The durability half of the transactional store (ARIES-style logical
+logging, specialized to fork-choice handlers):
+
+* Before a wrapped handler runs, its *intent* is appended: operation
+  name plus deep-copied arguments, integrity-digested.  An intent
+  without a commit marker is a handler that died mid-flight — recovery
+  ignores it (atomic-or-absent).
+* The commit marker is written at the START of the commit step, before
+  the overlay touches the base store.  That makes the marker the redo
+  decision: a crash anywhere in the (idempotent) apply leaves a torn
+  live store, but replaying the marked operation from the journal
+  reproduces the full commit.  Marker rule in one line: *marked ⇒ the
+  operation is in the recovered store; unmarked ⇒ it is not.*
+* Every `snapshot_interval` commits (and once at startup, the anchor)
+  the whole store is cloned and content-addressed by `store_root`; a
+  recovery re-verifies the root before trusting the clone, then replays
+  only the committed tail after it.
+
+Kill points: `append_intent` consults the fault plan at the
+``txn.journal`` barrier site before anything is recorded — a seeded
+raise there models a crash mid-journal-write, and the operation is
+absent from both the journal and the store.
+
+The journal is in-memory (this is a reproduction node, not a disk
+format) but the discipline is the durable one: nothing in recovery
+reads the live store, only the journal and its snapshots.
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+from dataclasses import dataclass, field
+
+from ..resilience.faults import fire
+from ..resilience.incidents import INCIDENTS
+from ..sigpipe.metrics import METRICS
+from ..ssz import hash_tree_root
+from .oracle import store_root
+from .overlay import clone_store
+
+JOURNAL_SITE = "txn.journal"
+
+
+def _copy_arg(value):
+    """Deep-enough copy of a handler argument for replay: SSZ containers
+    copy; ints/bytes/bools are immutable and pass through."""
+    if hasattr(value, "copy") and not isinstance(
+            value, (dict, set, list, bytes, bytearray)):
+        return value.copy()
+    return value
+
+
+def _digest(op: str, args, kwargs) -> bytes:
+    h = hashlib.sha256()
+    h.update(op.encode())
+    for a in args:
+        if hasattr(a, "hash_tree_root"):
+            h.update(bytes(hash_tree_root(a)))
+        else:
+            h.update(repr(a).encode())
+    for k in sorted(kwargs):
+        h.update(k.encode())
+        h.update(repr(kwargs[k]).encode())
+    return h.digest()
+
+
+@dataclass
+class JournalEntry:
+    seq: int
+    op: str                     # handler method name, e.g. "on_block"
+    args: tuple
+    kwargs: dict
+    digest: bytes
+    committed: bool = False
+
+
+@dataclass
+class Snapshot:
+    entry_seq: int              # last journaled entry when taken
+    root: bytes                 # store_root of the clone (the address)
+    store: object = field(repr=False)
+
+
+class Journal:
+    def __init__(self, max_snapshots: int = 4):
+        self.max_snapshots = int(max_snapshots)
+        self._entries: list = []
+        self._snapshots: list = []
+        self._seq = 0
+        self._lock = threading.RLock()
+
+    # -- the write-ahead half ------------------------------------------
+    def append_intent(self, op: str, args, kwargs) -> JournalEntry:
+        fire(JOURNAL_SITE)      # seeded mid-journal-write kill point
+        args = tuple(_copy_arg(a) for a in args)
+        kwargs = {k: _copy_arg(v) for k, v in kwargs.items()}
+        with self._lock:
+            self._seq += 1
+            entry = JournalEntry(self._seq, op, args, kwargs,
+                                 _digest(op, args, kwargs))
+            self._entries.append(entry)
+        METRICS.inc("txn_journal_intents")
+        return entry
+
+    def mark_committed(self, entry: JournalEntry) -> None:
+        """The redo decision.  Idempotent: the commit dispatch may retry
+        or fall back after a transient fault and re-mark."""
+        if entry.committed:
+            return
+        entry.committed = True
+        METRICS.inc("txn_journal_commits")
+
+    # -- snapshots ------------------------------------------------------
+    def needs_anchor(self) -> bool:
+        return not self._snapshots
+
+    def snapshot(self, store) -> bytes:
+        """Clone `store` and address it by content; returns the root."""
+        clone = clone_store(store)
+        root = store_root(clone)
+        with self._lock:
+            self._snapshots.append(Snapshot(self._seq, root, clone))
+            while len(self._snapshots) > self.max_snapshots:
+                self._snapshots.pop(0)
+        METRICS.inc("txn_snapshots")
+        INCIDENTS.record("txn.journal", "snapshot",
+                         entry_seq=self._seq, root=root.hex())
+        return root
+
+    def latest_snapshot(self) -> Snapshot | None:
+        with self._lock:
+            return self._snapshots[-1] if self._snapshots else None
+
+    # -- the read side (recovery & audits) ------------------------------
+    def committed_entries(self, after_seq: int = 0) -> list:
+        with self._lock:
+            return [e for e in self._entries
+                    if e.committed and e.seq > after_seq]
+
+    def entries(self) -> list:
+        with self._lock:
+            return list(self._entries)
+
+    def verify(self) -> bool:
+        """Integrity sweep: every entry's digest still matches its
+        recorded (op, args, kwargs)."""
+        with self._lock:
+            return all(e.digest == _digest(e.op, e.args, e.kwargs)
+                       for e in self._entries)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)

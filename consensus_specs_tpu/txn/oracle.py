@@ -1,0 +1,72 @@
+"""The byte-identity oracle for fork-choice stores.
+
+`store_root(store)` folds EVERY field of a store — scalars, checkpoints,
+the block/state maps, timeliness flags, latest messages, equivocation
+set, eip7732's payload bookkeeping — into one 32-byte digest.  Two
+stores digest equal iff they are observably identical, which is the
+whole transactional contract in one comparison:
+
+* rollback parity — a handler that raised leaves `store_root` unchanged;
+* commit parity — a committed transaction digests identically to the
+  bare handler applied to the same store;
+* recovery convergence — `txn.recover()` rebuilds a store whose root
+  matches the never-crashed sequential application of the journal's
+  committed operations;
+* snapshot integrity — checkpoint snapshots are content-addressed by
+  this root and re-verified before a recovery trusts them.
+
+The encoding is canonical, not clever: every value is tagged by type and
+length-framed, SSZ objects contribute their `hash_tree_root`, dicts are
+folded in key-sorted order and sets in element-sorted order (the live
+store and a recovered store legitimately differ in dict insertion
+order).  An unknown value type is a hard TypeError — silently skipping a
+field would turn the oracle into a liar.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+from ..ssz import hash_tree_root
+
+
+def _encode(value) -> bytes:
+    if isinstance(value, bool):
+        return b"b" + (b"\x01" if value else b"\x00")
+    if isinstance(value, int):            # covers ssz uints (int subtypes)
+        data = str(int(value)).encode()
+        return b"i" + len(data).to_bytes(4, "little") + data
+    if isinstance(value, (bytes, bytearray)):   # covers ssz ByteVectors
+        data = bytes(value)
+        return b"y" + len(data).to_bytes(4, "little") + data
+    if isinstance(value, str):
+        data = value.encode()
+        return b"s" + len(data).to_bytes(4, "little") + data
+    if isinstance(value, (list, tuple)):
+        parts = [_encode(v) for v in value]
+        return (b"l" + len(parts).to_bytes(4, "little") + b"".join(parts))
+    if isinstance(value, (set, frozenset)):
+        parts = sorted(_encode(v) for v in value)
+        return (b"e" + len(parts).to_bytes(4, "little") + b"".join(parts))
+    if isinstance(value, dict):
+        parts = sorted((_encode(k), _encode(v)) for k, v in value.items())
+        return (b"d" + len(parts).to_bytes(4, "little")
+                + b"".join(k + v for k, v in parts))
+    if hasattr(value, "hash_tree_root"):        # SSZ containers
+        return b"h" + bytes(hash_tree_root(value))
+    if dataclasses.is_dataclass(value):         # LatestMessage & kin
+        parts = [_encode(f.name) + _encode(getattr(value, f.name))
+                 for f in dataclasses.fields(value)]
+        return b"c" + type(value).__name__.encode() + b"".join(parts)
+    raise TypeError(
+        f"store_root cannot canonically encode {type(value).__name__}")
+
+
+def store_root(store) -> bytes:
+    """32-byte canonical digest of every field of a fork-choice store."""
+    h = hashlib.sha256()
+    h.update(type(store).__name__.encode())
+    for f in dataclasses.fields(store):
+        h.update(_encode(f.name))
+        h.update(_encode(getattr(store, f.name)))
+    return h.digest()

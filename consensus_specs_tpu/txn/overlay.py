@@ -1,0 +1,237 @@
+"""Copy-on-write overlay views of a fork-choice store.
+
+`StoreTransaction` is the isolation half of the transactional store:
+every attribute write and every collection mutation a fork-choice
+handler performs lands in an overlay, never in the wrapped store.  The
+handler reads its own writes (the overlay shadows the base), the rest of
+the node keeps reading the untouched base, and the transaction ends one
+of two ways:
+
+* ``apply()`` — the commit: overlay writes are copied onto the base
+  store field by field.  Every individual application is an idempotent
+  assignment (dict put / set union / attribute set with a fixed value),
+  so a torn apply can be safely *redone* by replaying the operation from
+  the journal — the ARIES redo discipline, txn/__init__.py.
+* dropping the view — the rollback: the base store was never written, so
+  there is nothing to undo.  Rollback cannot fail, which is what makes
+  "any exception aborts the handler" a safe contract even for injected
+  faults and watchdog timeouts.
+
+The view is generic over the store's dataclass shape (`Store` and
+`Eip7732Store` both work): fields are classified by their *value* type —
+dicts get an `OverlayDict`, sets an `OverlaySet`, everything else is a
+scalar buffered on first assignment.  One value family needs special
+care: eip7732's ``ptc_vote`` maps roots to plain lists that the handler
+mutates IN PLACE (``ptc_vote[i] = status``).  `OverlayDict` therefore
+promotes list values on read — the caller gets a private copy parked in
+the overlay, so in-place mutation stays transactional.
+
+Sharing contract (also what makes `clone_store` snapshots cheap): the
+handlers replace stored SSZ objects, they never mutate one that is
+already in the store — states are ``.copy()``'d before
+``state_transition``, blocks and checkpoints are inserted whole.  Lists
+(ptc_vote) are the single in-place-mutable value family, and both the
+overlay and the clone copy them.
+
+Every overlay mutation consults the fault plan at the ``txn.mutate``
+barrier site (resilience/faults.py `fire`), which is what gives the
+chaos tier its "crash anywhere mid-handler" granularity: a seeded raise
+between any two store mutations models a crash at that instruction, and
+rollback must hold from every one of them.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from ..resilience.faults import fire
+
+MUTATE_SITE = "txn.mutate"
+COMMIT_APPLY_SITE = "txn.commit.apply"
+
+
+class _TxnList(list):
+    """Promoted copy of an in-place-mutable list value (eip7732
+    ptc_vote): element writes stay buffered in the overlay AND consult
+    the txn.mutate kill point like every other store mutation."""
+
+    __slots__ = ()
+
+    def __setitem__(self, index, value):
+        fire(MUTATE_SITE)
+        list.__setitem__(self, index, value)
+
+
+class OverlayDict:
+    """Dict view: reads fall through to the base, writes buffer."""
+
+    __slots__ = ("_base", "_writes")
+
+    def __init__(self, base: dict):
+        self._base = base
+        self._writes: dict = {}
+
+    def __getitem__(self, key):
+        if key in self._writes:
+            return self._writes[key]
+        value = self._base[key]
+        if isinstance(value, list):
+            # promote in-place-mutable values (eip7732 ptc_vote) to a
+            # private copy so the caller's item writes stay buffered
+            value = _TxnList(value)
+            self._writes[key] = value
+        return value
+
+    def __setitem__(self, key, value) -> None:
+        fire(MUTATE_SITE)
+        self._writes[key] = value
+
+    def __contains__(self, key) -> bool:
+        return key in self._writes or key in self._base
+
+    def get(self, key, default=None):
+        try:
+            return self[key]
+        except KeyError:
+            return default
+
+    def __iter__(self):
+        # base insertion order first, then overlay-only keys in write
+        # order — the same order a committed store would iterate in
+        for key in self._base:
+            yield key
+        for key in self._writes:
+            if key not in self._base:
+                yield key
+
+    def __len__(self) -> int:
+        return len(self._base) + sum(1 for k in self._writes
+                                     if k not in self._base)
+
+    def keys(self):
+        return list(iter(self))
+
+    def values(self):
+        return [self[k] for k in self]
+
+    def items(self):
+        return [(k, self[k]) for k in self]
+
+    def apply(self) -> None:
+        # promoted lists land in the base as plain lists again — the
+        # committed store must not keep firing kill points
+        self._base.update(
+            {k: (list(v) if isinstance(v, _TxnList) else v)
+             for k, v in self._writes.items()})
+
+
+class OverlaySet:
+    """Set view: membership falls through, additions buffer.  The
+    fork-choice handlers only ever grow their one set field
+    (equivocating_indices), so removal is deliberately unsupported."""
+
+    __slots__ = ("_base", "_added")
+
+    def __init__(self, base: set):
+        self._base = base
+        self._added: set = set()
+
+    def __contains__(self, value) -> bool:
+        return value in self._added or value in self._base
+
+    def __iter__(self):
+        yield from self._base
+        for value in self._added:
+            if value not in self._base:
+                yield value
+
+    def __len__(self) -> int:
+        return len(self._base) + sum(1 for v in self._added
+                                     if v not in self._base)
+
+    def add(self, value) -> None:
+        fire(MUTATE_SITE)
+        self._added.add(value)
+
+    def update(self, values) -> None:
+        fire(MUTATE_SITE)
+        self._added.update(values)
+
+    def apply(self) -> None:
+        self._base.update(self._added)
+
+
+class StoreTransaction:
+    """One handler call's buffered view of a fork-choice store."""
+
+    def __init__(self, store):
+        object.__setattr__(self, "_base", store)
+        object.__setattr__(self, "_overlays", {})
+        object.__setattr__(self, "_scalars", {})
+        names = set()
+        for f in dataclasses.fields(store):
+            names.add(f.name)
+            value = getattr(store, f.name)
+            if isinstance(value, dict):
+                self._overlays[f.name] = OverlayDict(value)
+            elif isinstance(value, (set, frozenset)):
+                self._overlays[f.name] = OverlaySet(value)
+        object.__setattr__(self, "_field_names", names)
+
+    def __getattr__(self, name):
+        overlays = object.__getattribute__(self, "_overlays")
+        overlay = overlays.get(name)
+        if overlay is not None:
+            return overlay
+        scalars = object.__getattribute__(self, "_scalars")
+        if name in scalars:
+            return scalars[name]
+        return getattr(object.__getattribute__(self, "_base"), name)
+
+    def __setattr__(self, name, value) -> None:
+        if name not in self._field_names:
+            raise AttributeError(
+                f"{type(self._base).__name__} has no field {name!r}; a "
+                f"StoreTransaction only buffers store fields")
+        if name in self._overlays:
+            raise AttributeError(
+                f"collection field {name!r} must be mutated in place, "
+                f"not reassigned")
+        fire(MUTATE_SITE)
+        self._scalars[name] = value
+
+    def apply(self, consult_faults: bool = False) -> None:
+        """Copy the overlay onto the base store, one field at a time.
+        Idempotent by construction (fixed-value assignments), so a torn
+        apply is redone — not undone — by journal replay.  With
+        `consult_faults` the seeded fault plan is consulted between
+        fields (``txn.commit.apply``): that is the chaos tier's
+        mid-commit kill point."""
+        base = self._base
+        for overlay in self._overlays.values():
+            overlay.apply()
+            if consult_faults:
+                fire(COMMIT_APPLY_SITE)
+        for name, value in self._scalars.items():
+            setattr(base, name, value)
+            if consult_faults:
+                fire(COMMIT_APPLY_SITE)
+
+
+def clone_store(store):
+    """Structural copy of a fork-choice store for snapshots and
+    recovery: fresh top-level collections (plus copies of the one
+    in-place-mutable value family, lists), shared immutable-by-contract
+    SSZ blocks/states/checkpoints — see the module docstring's sharing
+    contract."""
+    kwargs = {}
+    for f in dataclasses.fields(store):
+        value = getattr(store, f.name)
+        if isinstance(value, dict):
+            kwargs[f.name] = {
+                k: (list(v) if isinstance(v, list) else v)
+                for k, v in value.items()}
+        elif isinstance(value, (set, frozenset)):
+            kwargs[f.name] = set(value)
+        else:
+            kwargs[f.name] = value
+    return type(store)(**kwargs)

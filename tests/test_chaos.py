@@ -75,13 +75,16 @@ def workload(spec):
 
 @pytest.fixture(autouse=True)
 def _clean():
+    from consensus_specs_tpu import txn
     resilience.disable()
     sigpipe.disable()
+    txn.disable()
     INCIDENTS.clear()
     METRICS.reset()
     yield
     resilience.disable()
     sigpipe.disable()
+    txn.disable()
     INCIDENTS.clear()
 
 
@@ -370,3 +373,223 @@ def test_chaos_gossip_admission(spec, gossip_workload):
         assert METRICS.count("gossip_equivocations") >= 1
         assert INCIDENTS.count(event="quarantine",
                                site="gossip.equivocation") == 1
+
+
+# ---------------------------------------------------------------------------
+# txn tier: crash-anywhere recovery (the transactional store's contract)
+# ---------------------------------------------------------------------------
+
+# every seeded kill-point family the transactional store exposes:
+# between any two store mutations, at the commit barrier, inside the
+# (idempotent) overlay apply, and mid-journal-write
+KILL_SITES = ("txn.mutate", "txn.commit", "txn.commit.apply",
+              "txn.journal")
+
+
+@pytest.fixture(scope="module")
+def txn_workload(spec):
+    """(genesis, ops): a mixed fork-choice handler schedule — ticks, a
+    signed block, attestations (one invalid: the rejected-op intent must
+    never replay), an attester slashing — used for both the crashing run
+    and the never-crashed oracle."""
+    from consensus_specs_tpu.test_infra.slashings import (
+        get_valid_attester_slashing)
+    from consensus_specs_tpu.test_infra import disable_bls
+    with disable_bls():
+        genesis = create_genesis_state(spec, default_balances(spec))
+        state = genesis.copy()
+        spec.process_slots(state, uint64(spec.SLOTS_PER_EPOCH + 2))
+        att = get_valid_attestation(spec, state, signed=True)
+        att2 = get_valid_attestation(
+            spec, state, slot=uint64(int(state.slot) - 2), index=0,
+            signed=True)
+        bad = att.copy()
+        bad.data.beacon_block_root = b"\x77" * 32       # unknown block
+        advanced = state.copy()
+        spec.process_slots(advanced, uint64(
+            state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY))
+        block = build_empty_block_for_next_slot(spec, advanced)
+        block.body.attestations.append(att)
+        signed = state_transition_and_sign_block(spec, advanced.copy(),
+                                                 block)
+        slashing = get_valid_attester_slashing(
+            spec, state, slot=uint64(int(state.slot) - 3),
+            signed_1=True, signed_2=True)
+    slot_time = lambda s: int(genesis.genesis_time) \
+        + s * int(spec.config.SECONDS_PER_SLOT)        # noqa: E731
+    ops = [
+        ("on_tick", slot_time(int(signed.message.slot))),
+        ("on_block", signed),
+        ("on_attestation", att),
+        ("on_attestation", bad),
+        ("on_tick", slot_time(int(signed.message.slot) + 1)),
+        ("on_attestation", att2),
+        ("on_attester_slashing", slashing),
+    ]
+    return genesis, ops
+
+
+def test_chaos_crash_anywhere_recovery(spec, txn_workload):
+    """Kill the node at seeded points mid-handler, mid-commit,
+    mid-apply, and mid-journal-write: after every crash the recovered
+    store's root is byte-identical to the never-crashed sequential
+    oracle (the journal's committed prefix), every injected fault is in
+    the incident log, and continuing past recovery converges."""
+    from consensus_specs_tpu import txn
+    from consensus_specs_tpu.test_infra import disable_bls
+    from consensus_specs_tpu.test_infra.fork_choice import (
+        get_genesis_forkchoice_store)
+    genesis, ops = txn_workload
+    rng = random.Random(CHAOS_SEED + 13)
+    crashes_seen = 0
+    with disable_bls():
+        for round_i in range(8):
+            INCIDENTS.clear()
+            METRICS.reset()
+            site = KILL_SITES[round_i % len(KILL_SITES)]
+            plan = FaultPlan(
+                [FaultSpec(site, "raise",
+                           rate=rng.choice([0.05, 0.2, 0.5]),
+                           max_fires=1)],
+                seed=rng.randrange(1 << 30))
+            journal = txn.Journal()
+            txn.enable(journal=journal, snapshot_interval=2)
+            store = get_genesis_forkchoice_store(spec, genesis)
+            try:
+                with faults.inject(plan):
+                    for op, arg in ops:
+                        try:
+                            getattr(spec, op)(store, arg)
+                        except AssertionError:
+                            continue    # rejected op: rolled back
+            except resilience.DeviceFault:
+                crashes_seen += 1       # the node dies here
+            finally:
+                txn.disable()
+
+            # the never-crashed oracle: sequentially apply exactly the
+            # operations whose commit marker became durable
+            oracle = get_genesis_forkchoice_store(spec, genesis)
+            committed = journal.committed_entries()
+            for entry in committed:
+                getattr(spec, entry.op)(oracle, *entry.args,
+                                        **entry.kwargs)
+            recovered = txn.recover(spec, journal)
+            assert txn.store_root(recovered) == txn.store_root(oracle)
+
+            # every injected fault is visible
+            assert INCIDENTS.count(event="injected") == \
+                plan.total_fires()
+            assert METRICS.snapshot().get("faults_injected", 0) == \
+                plan.total_fires()
+            assert journal.verify()
+
+            # crash-only convergence: the recovered node finishes the
+            # schedule and lands exactly where an uncrashed node does
+            for op, arg in ops:
+                try:
+                    getattr(spec, op)(recovered, arg)
+                except AssertionError:
+                    continue
+            clean = get_genesis_forkchoice_store(spec, genesis)
+            for op, arg in ops:
+                try:
+                    getattr(spec, op)(clean, arg)
+                except AssertionError:
+                    continue
+            assert txn.store_root(recovered) == txn.store_root(clean), \
+                (site, len(committed))
+    # the seeded schedule must actually exercise crashes
+    assert crashes_seen >= 3
+
+
+def test_chaos_torn_commit_recovers_to_full_op(spec, txn_workload):
+    """The mid-commit kill specifically: the commit marker is durable,
+    the live store is torn, and recovery REDOES the operation — the
+    recovered store contains the block in full."""
+    from consensus_specs_tpu import txn
+    from consensus_specs_tpu.ssz import hash_tree_root as htr
+    from consensus_specs_tpu.test_infra import disable_bls
+    from consensus_specs_tpu.test_infra.fork_choice import (
+        get_genesis_forkchoice_store)
+    genesis, ops = txn_workload
+    signed = ops[1][1]
+    with disable_bls():
+        journal = txn.Journal()
+        txn.enable(journal=journal, snapshot_interval=100)
+        store = get_genesis_forkchoice_store(spec, genesis)
+        getattr(spec, ops[0][0])(store, ops[0][1])      # tick
+        plan = FaultPlan(
+            [FaultSpec("txn.commit.apply", "raise", rate=1.0,
+                       max_fires=1)],
+            seed=CHAOS_SEED)
+        with faults.inject(plan):
+            with pytest.raises(resilience.DeviceFault):
+                spec.on_block(store, signed)
+        txn.disable()
+        assert INCIDENTS.count(event="torn", site="txn.commit") == 1
+
+        recovered = txn.recover(spec, journal)
+        oracle = get_genesis_forkchoice_store(spec, genesis)
+        getattr(spec, ops[0][0])(oracle, ops[0][1])
+        spec.on_block(oracle, signed)
+    assert txn.store_root(recovered) == txn.store_root(oracle)
+    assert htr(signed.message) in recovered.blocks
+    # and the torn live store really was torn (the redo mattered)
+    assert txn.store_root(store) != txn.store_root(oracle)
+
+
+def test_chaos_gossip_pipeline_with_txn_store(spec, gossip_workload):
+    """The integration the tentpole exists for: the admission pipeline
+    delivering into a TRANSACTIONAL store under injected faults — every
+    delivery commits or rolls back whole, and the drained store matches
+    the txn-enabled sequential oracle over the same delivered log."""
+    from consensus_specs_tpu import txn
+    from consensus_specs_tpu.gossip import (
+        AdmissionPipeline, GossipConfig, ManualClock, apply_scalar,
+        store_fingerprint)
+    genesis, first_att, schedule, tick_slot = gossip_workload
+    rng = random.Random(CHAOS_SEED + 29)
+    fault_specs = [
+        FaultSpec("txn.commit", "raise", rate=0.3, max_fires=2),
+        FaultSpec("bls.pairing_check", "raise", rate=0.5,
+                  persistent=True),
+    ]
+    plan = FaultPlan(fault_specs, seed=rng.randrange(1 << 30))
+
+    resilience.enable(max_retries=1, breaker_threshold=1, probe_after=2,
+                      guard_sample_rate=1.0, guard_seed=CHAOS_SEED)
+    txn.enable()        # pipeline path: per-delivery commit
+    store = _gossip_store(spec, genesis, tick_slot)
+    clock = ManualClock()
+    pipe = AdmissionPipeline(spec, store, GossipConfig(), clock)
+    order = [("attestation", first_att)] + list(schedule)
+    try:
+        with faults.inject(plan):
+            for i, (topic, payload) in enumerate(order):
+                pipe.submit(topic, payload, peer=f"p{i % 3}")
+                if rng.random() < 0.4:
+                    clock.advance(0.06)
+                    pipe.poll()
+            pipe.drain()
+    finally:
+        txn.disable()
+        resilience.disable()
+
+    assert INCIDENTS.count(event="injected") == plan.total_fires()
+
+    # oracle: the SAME delivered sequence, txn on, no faults
+    oracle_store = _gossip_store(spec, genesis, tick_slot)
+    txn.enable()
+    try:
+        oracle = [apply_scalar(spec, oracle_store, topic, payload)
+                  for _seq, topic, payload in pipe.delivered_log]
+    finally:
+        txn.disable()
+    mine = [(pipe.results[seq].status == "accepted",
+             pipe.results[seq].detail)
+            for seq, _t, _p in pipe.delivered_log]
+    assert mine == oracle
+    assert store_fingerprint(spec, store) == store_fingerprint(
+        spec, oracle_store)
+    assert txn.store_root(store) == txn.store_root(oracle_store)

@@ -731,6 +731,212 @@ def test_transiently_rejected_message_can_redeliver(spec, genesis,
     assert results[retry_seq].status == "accepted"
 
 
+def test_surround_vote_quarantines_with_evidence(spec, genesis, state):
+    """A validator whose second attestation SURROUNDS its first (wider
+    source->target span) is quarantined on verified evidence: the
+    surrounding message sheds pre-delivery, the incident carries both
+    FFG spans + digests, and later traffic from the validator is
+    refused — same discipline as double votes."""
+    slot = int(state.slot) - 1
+    with disable_bls():
+        att = _single_attestations(spec, state, slot, 1,
+                                   signed=False)[0]
+        # the recorded vote carries span (1 -> 1); the second vote's
+        # span (0 -> 2) strictly surrounds it.  The handler accept path
+        # never validates data.source (FFG source checking lives in
+        # process_attestation), so the doctored first vote is accepted
+        # and recorded — exactly the history a live surround attack
+        # plays against.
+        att.data.source.epoch = uint64(1)
+        surround = att.copy()
+        surround.data.source.epoch = uint64(0)
+        surround.data.target.epoch = int(att.data.target.epoch) + 1
+        follow_up = att.copy()
+        follow_up.data.beacon_block_root = b"\x05" * 32
+
+        store = _store_at(spec, genesis, state.slot)
+        pipe = AdmissionPipeline(spec, store, GossipConfig(),
+                                 ManualClock())
+        pipe.submit("attestation", att, peer="p1")
+        pipe.submit("attestation", surround, peer="p2")
+        pipe.submit("attestation", follow_up, peer="p3")
+        results = pipe.drain()
+    by_seq = {r.seq: r for r in results}
+    assert by_seq[1].status == "accepted"
+    assert (by_seq[2].status, by_seq[2].detail) == ("shed", "surround")
+    assert (by_seq[3].status, by_seq[3].detail) == ("shed",
+                                                    "quarantined")
+    validator_index = int(spec.get_attesting_indices(state, att).pop())
+    assert pipe.guard.is_quarantined(validator_index)
+    events = INCIDENTS.events("quarantine")
+    assert len(events) == 1
+    evidence = events[0]
+    assert evidence["site"] == "gossip.equivocation"
+    assert evidence["kind"] == "surround"
+    assert evidence["validator_index"] == validator_index
+    assert "->" in evidence["first_vote"]
+    assert evidence["first"] != evidence["second"]
+    assert METRICS.count("gossip_equivocations") == 1
+
+
+def test_surrounded_vote_also_quarantines(spec, genesis, state):
+    """The mirror case: the second vote is INSIDE the first one's span
+    (surrounded), which is equally slashable — caught post-acceptance
+    by the guard's observe()."""
+    from consensus_specs_tpu.gossip.dedup import EquivocationGuard
+    guard = EquivocationGuard()
+    assert guard.observe("attestation", 7, 10, b"\x01" * 32,
+                         ffg=(2, 10))
+    # same validator, narrower span (3..9) with a DIFFERENT target
+    # epoch: not a double vote, but surrounded by (2, 10)
+    assert not guard.observe("attestation", 7, 9, b"\x02" * 32,
+                             ffg=(3, 9))
+    assert guard.is_quarantined(7)
+    events = INCIDENTS.events("quarantine")
+    assert events and events[-1]["kind"] == "surround"
+
+
+def test_unverified_surround_cannot_frame(spec, genesis, state):
+    """A forged surrounding vote with a garbage signature must neither
+    shed pre-delivery as surround evidence nor quarantine the victim —
+    the gate demands the CONFLICTING message's own signature verify
+    (real BLS here)."""
+    slot = int(state.slot) - 1
+    real = _single_attestations(spec, state, slot, 1)[0]    # signed
+    validator_index = int(spec.get_attesting_indices(state, real).pop())
+    store = _store_at(spec, genesis, state.slot)
+    pipe = AdmissionPipeline(spec, store, GossipConfig(), ManualClock())
+    # verified history as a live node would hold it: the victim's
+    # recorded vote spans (1 -> target)
+    pipe.guard.observe("attestation", validator_index,
+                       int(real.data.target.epoch), b"\x99" * 32,
+                       ffg=(1, int(real.data.target.epoch)))
+    forged = real.copy()
+    forged.data.source.epoch = uint64(0)            # surrounds (1, t)
+    forged.data.target.epoch = int(real.data.target.epoch) + 1
+    forged.signature = b"\xaa" + bytes(forged.signature)[1:]
+    assert pipe.guard.surround_conflict(
+        validator_index,
+        (0, int(forged.data.target.epoch))) is not None
+    forged_seq = pipe.submit("attestation", forged, peer="attacker")
+    results = {r.seq: r for r in pipe.drain()}
+    # the conflict exists, but the forged signature does not verify:
+    # no pre-delivery shed, the handler rejects it, nobody is framed
+    assert results[forged_seq].status == "rejected"
+    assert not pipe.guard.is_quarantined(validator_index)
+    assert METRICS.count("gossip_equivocations") == 0
+
+
+# ---------------------------------------------------------------------------
+# concurrent ingress: thread-safe submit + single-drainer discipline
+# ---------------------------------------------------------------------------
+
+def test_threaded_submit_stress(spec, genesis, state):
+    """Concurrent ingress from several threads — interleaved with
+    duplicates and size-cap flushes — must corrupt nothing: every
+    message gets exactly one final verdict, the delivered sequence is a
+    valid sequential schedule (the scalar oracle replays it to the
+    identical store), and accounting adds up."""
+    import threading
+
+    slot = int(state.slot) - 1
+    with disable_bls():
+        messages = []
+        for back in range(1, 5):
+            messages.extend(_single_attestations(
+                spec, state, int(state.slot) - back, 4, signed=False))
+        store = _store_at(spec, genesis, state.slot)
+        # small batches force mid-submission flushes from worker
+        # threads; ManualClock never advances, so every flush is a
+        # size-cap or drain flush (deterministic decisions, any thread)
+        config = GossipConfig(max_batch=4, bucket_capacity=1024,
+                              seen_cache_size=1 << 12)
+        pipe = AdmissionPipeline(spec, store, config, ManualClock())
+
+        errors = []
+        n_threads = 4
+
+        def worker(worker_i):
+            try:
+                for j, att in enumerate(messages):
+                    pipe.submit("attestation", att,
+                                peer=f"w{worker_i}")
+            except Exception as e:      # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        results = pipe.drain()
+
+        # exactly one final verdict per submission
+        submitted = n_threads * len(messages)
+        assert pipe._seq == submitted
+        assert len(results) == submitted
+        assert {r.seq for r in results} == set(range(1, submitted + 1))
+        statuses = {}
+        for r in results:
+            statuses[r.status] = statuses.get(r.status, 0) + 1
+        # each distinct attestation delivered once; the rest deduped
+        assert statuses.get("accepted", 0) == len(messages)
+        assert statuses.get("shed", 0) == submitted - len(messages)
+        assert len(pipe.delivered_log) == len(messages)
+        delivered_seqs = [seq for seq, _t, _p in pipe.delivered_log]
+        assert len(delivered_seqs) == len(set(delivered_seqs))
+
+        # the delivered sequence replays on the scalar oracle to the
+        # byte-identical store
+        oracle_store, oracle_verdicts = _oracle_replay(
+            spec, genesis, state.slot, pipe)
+        assert all(ok for ok, _ in oracle_verdicts)
+        assert store_fingerprint(spec, store) == store_fingerprint(
+            spec, oracle_store)
+
+
+def test_threaded_submit_with_transactional_store(spec, genesis, state):
+    """Concurrency + txn together (the tentpole's production shape):
+    concurrent submit threads, single-drainer delivery, every delivery
+    a committed transaction — drained store matches the txn oracle."""
+    import threading
+
+    from consensus_specs_tpu import txn
+
+    slot = int(state.slot) - 1
+    with disable_bls():
+        messages = _single_attestations(spec, state, slot, 4,
+                                        signed=False) \
+            + _single_attestations(spec, state, int(state.slot) - 2, 4,
+                                   signed=False)
+        store = _store_at(spec, genesis, state.slot)
+        pipe = AdmissionPipeline(
+            spec, store, GossipConfig(max_batch=4), ManualClock())
+        txn.enable()
+        try:
+            threads = [
+                threading.Thread(target=lambda i=i: [
+                    pipe.submit("attestation", m, peer=f"w{i}")
+                    for m in messages])
+                for i in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            pipe.drain()
+            oracle_store = _store_at(spec, genesis, state.slot)
+            for _seq, topic, payload in pipe.delivered_log:
+                apply_scalar(spec, oracle_store, topic, payload)
+        finally:
+            txn.disable()
+    assert store_fingerprint(spec, store) == store_fingerprint(
+        spec, oracle_store)
+    assert txn.store_root(store) == txn.store_root(oracle_store)
+    assert METRICS.count_labeled("txn_rollbacks") == 0
+
+
 def test_quarantined_proposer_block_still_imports(spec, genesis):
     """Local quarantine (attestation equivocation) must never refuse a
     valid BLOCK proposal — the rest of the network accepts it, and

@@ -1,0 +1,521 @@
+"""Transactional fork-choice store (txn/): the PR's acceptance criteria.
+
+* Commit parity: a handler run through the transaction overlay leaves a
+  store byte-identical (`store_root`) to the bare handler.
+* Rollback parity: every fork-choice handler x every fault kind from
+  the resilience matrix — an exception anywhere in the handler or at
+  the commit barrier leaves `store_root` unchanged; non-fatal kinds
+  (timeout without a watchdog, corrupt at a barrier) degrade without
+  ever changing the committed result.
+* Journal: write-ahead intents, commit markers, content-addressed
+  snapshots, digest integrity, and deterministic replay.
+* Recovery: `txn.recover()` rebuilds a store byte-identical to the
+  sequential application of the journal's committed operations — from
+  clean shutdowns, mid-handler crashes, and torn commits (redo).
+* Hygiene: rolled-back transactions evict the aggregate-pubkey cache
+  entries they inserted; the supervisor turns commit-site faults into
+  retries/fallbacks with no semantic change.
+"""
+import pytest
+
+from consensus_specs_tpu import resilience, txn
+from consensus_specs_tpu.resilience import (
+    DeviceFault, FaultPlan, FaultSpec, INCIDENTS, faults,
+)
+from consensus_specs_tpu.sigpipe import METRICS
+from consensus_specs_tpu.sigpipe.cache import AGGREGATES
+from consensus_specs_tpu.specs import get_spec
+from consensus_specs_tpu.ssz import uint64
+from consensus_specs_tpu.test_infra import disable_bls
+from consensus_specs_tpu.test_infra.attestations import get_valid_attestation
+from consensus_specs_tpu.test_infra.blocks import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block)
+from consensus_specs_tpu.test_infra.fork_choice import (
+    get_genesis_forkchoice_store)
+from consensus_specs_tpu.test_infra.genesis import (
+    create_genesis_state, default_balances)
+from consensus_specs_tpu.test_infra.keys import privkey_for_pubkey
+from consensus_specs_tpu.test_infra.slashings import (
+    get_valid_attester_slashing)
+from consensus_specs_tpu.txn import (
+    Journal, OverlayDict, OverlaySet, StoreTransaction, clone_store,
+    store_root,
+)
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_spec("altair", "minimal")
+
+
+@pytest.fixture(scope="module")
+def genesis(spec):
+    with disable_bls():
+        return create_genesis_state(spec, default_balances(spec))
+
+
+@pytest.fixture(scope="module")
+def workload(spec, genesis):
+    """A mixed, BLS-stubbed handler schedule: tick, a signed block, two
+    attestations, an aggregate-and-proof, and an attester slashing —
+    every wrapped fork-choice entry point exercised in one sequence."""
+    with disable_bls():
+        state = genesis.copy()
+        spec.process_slots(state, uint64(spec.SLOTS_PER_EPOCH + 2))
+        att = get_valid_attestation(spec, state, signed=True)
+        att2 = get_valid_attestation(
+            spec, state, slot=uint64(int(state.slot) - 2), index=0,
+            signed=True)
+        advanced = state.copy()
+        spec.process_slots(advanced, uint64(
+            state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY))
+        block = build_empty_block_for_next_slot(spec, advanced)
+        block.body.attestations.append(att)
+        signed = state_transition_and_sign_block(spec, advanced.copy(),
+                                                 block)
+        committee = spec.get_beacon_committee(
+            state, att2.data.slot, uint64(0))
+        aggregator = int(list(committee)[0])
+        privkey = privkey_for_pubkey(
+            state.validators[aggregator].pubkey)
+        proof = spec.get_aggregate_and_proof(
+            state, uint64(aggregator), att2, privkey)
+        aggregate = spec.SignedAggregateAndProof(
+            message=proof,
+            signature=spec.get_aggregate_and_proof_signature(
+                state, proof, privkey))
+        slashing = get_valid_attester_slashing(
+            spec, state, slot=uint64(int(state.slot) - 3),
+            signed_1=True, signed_2=True)
+    tick_time = int(genesis.genesis_time) \
+        + int(signed.message.slot) * int(spec.config.SECONDS_PER_SLOT)
+    ops = [
+        ("on_tick", tick_time),
+        ("on_block", signed),
+        ("on_attestation", att),
+        ("on_aggregate_and_proof", aggregate),
+        ("on_attestation", att2),
+        ("on_attester_slashing", slashing),
+    ]
+    return ops
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    txn.disable()
+    resilience.disable()
+    INCIDENTS.clear()
+    METRICS.reset()
+    yield
+    txn.disable()
+    resilience.disable()
+    INCIDENTS.clear()
+
+
+def _fresh_store(spec, genesis):
+    return get_genesis_forkchoice_store(spec, genesis)
+
+
+def _apply(spec, store, ops):
+    for op, arg in ops:
+        getattr(spec, op)(store, arg)
+
+
+# ---------------------------------------------------------------------------
+# overlay primitives
+# ---------------------------------------------------------------------------
+
+def test_overlay_dict_buffers_until_apply():
+    base = {"a": 1}
+    view = OverlayDict(base)
+    view["b"] = 2
+    assert view["a"] == 1 and view["b"] == 2
+    assert "b" in view and len(view) == 2
+    assert sorted(view) == ["a", "b"]
+    assert base == {"a": 1}            # nothing leaked
+    view.apply()
+    assert base == {"a": 1, "b": 2}
+
+
+def test_overlay_dict_promotes_list_values():
+    base = {"k": [0, 0, 0]}
+    view = OverlayDict(base)
+    view["k"][1] = 9                   # in-place mutation (ptc_vote shape)
+    assert view["k"] == [0, 9, 0]
+    assert base["k"] == [0, 0, 0]      # buffered, not applied
+    view.apply()
+    assert base["k"] == [0, 9, 0]
+
+
+def test_eip7732_ptc_vote_promotion_and_kill_point():
+    """The one in-place-mutable store value family (eip7732 ptc_vote):
+    element writes buffer in the overlay, consult the txn.mutate kill
+    point, and commit back as plain lists."""
+    from consensus_specs_tpu.specs.eip7732_fork_choice import Eip7732Store
+    store = Eip7732Store(
+        time=0, genesis_time=0, justified_checkpoint=0,
+        finalized_checkpoint=0, unrealized_justified_checkpoint=0,
+        unrealized_finalized_checkpoint=0,
+        proposer_boost_root=b"\x00" * 32,
+        ptc_vote={b"r": [0, 0, 0]})
+    view = StoreTransaction(store)
+    view.ptc_vote[b"r"][1] = 2
+    assert view.ptc_vote[b"r"] == [0, 2, 0]
+    assert store.ptc_vote[b"r"] == [0, 0, 0]        # buffered
+    plan = FaultPlan(
+        [FaultSpec("txn.mutate", "raise", rate=1.0)], seed=1)
+    with faults.inject(plan):
+        with pytest.raises(DeviceFault):
+            view.ptc_vote[b"r"][2] = 1              # crash-anywhere
+    assert view.ptc_vote[b"r"] == [0, 2, 0]         # write never landed
+    view.apply()
+    assert store.ptc_vote[b"r"] == [0, 2, 0]
+    assert type(store.ptc_vote[b"r"]) is list       # no _TxnList leak
+
+
+def test_overlay_set_buffers_until_apply():
+    base = {1}
+    view = OverlaySet(base)
+    view.update({2, 3})
+    assert 2 in view and len(view) == 3
+    assert base == {1}
+    view.apply()
+    assert base == {1, 2, 3}
+
+
+def test_store_transaction_reads_own_writes(spec, genesis):
+    store = _fresh_store(spec, genesis)
+    view = StoreTransaction(store)
+    view.time = 12345
+    view.blocks[b"\x01" * 32] = "blk"
+    assert view.time == 12345
+    assert view.blocks[b"\x01" * 32] == "blk"
+    assert b"\x01" * 32 in view.blocks
+    assert store.time != 12345
+    assert b"\x01" * 32 not in store.blocks
+    with pytest.raises(AttributeError):
+        view.blocks = {}               # collections mutate, not reassign
+    with pytest.raises(AttributeError):
+        view.not_a_field = 1
+
+
+def test_clone_store_isolated(spec, genesis):
+    store = _fresh_store(spec, genesis)
+    clone = clone_store(store)
+    assert store_root(clone) == store_root(store)
+    spec.on_tick(store, store.genesis_time + 12)
+    assert store_root(clone) != store_root(store)
+
+
+# ---------------------------------------------------------------------------
+# commit parity
+# ---------------------------------------------------------------------------
+
+def test_commit_parity_full_schedule(spec, genesis, workload):
+    with disable_bls():
+        bare = _fresh_store(spec, genesis)
+        _apply(spec, bare, workload)
+        oracle_root = store_root(bare)
+
+        store = _fresh_store(spec, genesis)
+        txn.enable()
+        _apply(spec, store, workload)
+    assert store_root(store) == oracle_root
+    assert METRICS.count_labeled("txn_commits") == len(workload)
+    assert METRICS.count_labeled("txn_rollbacks") == 0
+
+
+def test_nested_handlers_share_one_transaction(spec, genesis, workload):
+    """eip7732-style nesting is modeled by a wrapped handler calling
+    another wrapped handler: the inner call must join the outer
+    transaction, not commit its own."""
+    with disable_bls():
+        store = _fresh_store(spec, genesis)
+        txn.enable()
+        tick_time, signed = workload[0][1], workload[1][1]
+        spec.on_tick(store, tick_time)
+        commits_before = METRICS.count_labeled("txn_commits")
+        view = StoreTransaction(store)
+        spec.on_block(view, signed)        # pre-wrapped store: joins
+        assert METRICS.count_labeled("txn_commits") == commits_before
+        view.apply()
+        oracle = _fresh_store(spec, genesis)
+        txn.disable()
+        spec.on_tick(oracle, tick_time)
+        spec.on_block(oracle, signed)
+    assert store_root(store) == store_root(oracle)
+
+
+# ---------------------------------------------------------------------------
+# rollback parity: every handler x every fault kind
+# ---------------------------------------------------------------------------
+
+HANDLER_OPS = ["on_tick", "on_block", "on_attestation",
+               "on_aggregate_and_proof", "on_attester_slashing"]
+
+
+@pytest.mark.parametrize("kind", ["raise", "timeout", "corrupt"])
+@pytest.mark.parametrize("op_name", HANDLER_OPS)
+def test_rollback_parity_matrix(spec, genesis, workload, op_name, kind):
+    """The PR 2 fault matrix against the commit barrier of every
+    handler: a `raise` aborts the transaction with store_root unchanged;
+    `timeout` (no watchdog) and `corrupt` (no verdict at a barrier) are
+    recorded but cannot change the committed result."""
+    index = next(i for i, (op, _a) in enumerate(workload)
+                 if op == op_name)
+    prefix, (op, arg) = workload[:index], workload[index]
+    with disable_bls():
+        store = _fresh_store(spec, genesis)
+        txn.enable()
+        _apply(spec, store, prefix)
+        pre_root = store_root(store)
+
+        oracle = clone_store(store)
+        txn.disable()
+        getattr(spec, op)(oracle, arg)
+        committed_root = store_root(oracle)
+        assert committed_root != pre_root      # the op really mutates
+
+        txn.enable()
+        plan = FaultPlan(
+            [FaultSpec("txn.commit", kind, persistent=True,
+                       sleep_s=0.01)],
+            seed=7)
+        with faults.inject(plan):
+            if kind == "raise":
+                with pytest.raises(DeviceFault):
+                    getattr(spec, op)(store, arg)
+                assert store_root(store) == pre_root
+                assert METRICS.count_labeled("txn_rollbacks", op) == 1
+                assert INCIDENTS.count(event="rollback") == 1
+            else:
+                getattr(spec, op)(store, arg)
+                assert store_root(store) == committed_root
+        assert plan.total_fires() > 0
+        assert INCIDENTS.count(event="injected") == plan.total_fires()
+
+
+def test_mid_handler_crash_rolls_back(spec, genesis, workload):
+    """A crash between two store mutations (the txn.mutate barrier)
+    leaves no trace: the half-finished handler's buffered writes are
+    dropped wholesale."""
+    with disable_bls():
+        store = _fresh_store(spec, genesis)
+        txn.enable()
+        _apply(spec, store, workload[:1])      # tick only
+        pre_root = store_root(store)
+        # rate < 1: the seeded coin lets some mutations through, so the
+        # crash lands BETWEEN store writes with earlier writes buffered
+        plan = FaultPlan(
+            [FaultSpec("txn.mutate", "raise", rate=0.5,
+                       persistent=True)],
+            seed=11)
+        signed = workload[1][1]
+        with faults.inject(plan):
+            with pytest.raises(DeviceFault):
+                spec.on_block(store, signed)
+        assert plan.total_fires() > 0
+        assert store_root(store) == pre_root
+        from consensus_specs_tpu.ssz import hash_tree_root
+        assert hash_tree_root(signed.message) not in store.blocks
+
+
+def test_rejected_handler_rolls_back_partial_mutations(spec, genesis,
+                                                       workload):
+    """An on_attestation whose validation fails AFTER caching a target
+    checkpoint state used to leave that state behind; under txn the
+    rejection leaves the store byte-identical to the pre-call store."""
+    with disable_bls():
+        store = _fresh_store(spec, genesis)
+        txn.enable()
+        _apply(spec, store, workload[:2])      # tick + block
+        pre_root = store_root(store)
+        att = workload[2][1].copy()
+        att.data.beacon_block_root = b"\x42" * 32   # unknown block
+        with pytest.raises(AssertionError):
+            spec.on_attestation(store, att)
+    assert store_root(store) == pre_root
+    assert METRICS.count_labeled("txn_rollbacks") == 1
+
+
+def test_rollback_evicts_inserted_aggregates(spec, genesis):
+    """A rolled-back transaction's aggregate-cache inserts are evicted:
+    no pre-warmed state from a store mutation that never happened."""
+    AGGREGATES.clear()
+    store = _fresh_store(spec, genesis)
+    txn.enable()
+
+    class Boom(RuntimeError):
+        pass
+
+    from consensus_specs_tpu.txn import active
+    manager = active()
+
+    def fake_handler(spec_self, view):
+        AGGREGATES.aggregate(
+            [bytes(genesis.validators[0].pubkey)], hint=("t", 0))
+        view.time = int(view.time) + 1
+        raise Boom()
+
+    fake_handler.__name__ = "fake_handler"
+    with pytest.raises(Boom):
+        manager.run(spec, fake_handler, store, (), {})
+    assert len(AGGREGATES) == 0
+    assert METRICS.count("aggregate_cache_evictions") == 1
+
+
+def test_supervisor_absorbs_commit_faults(spec, genesis, workload):
+    """With the resilience supervisor armed, persistent faults at the
+    txn.commit site trip the breaker and route to the trusted fallback
+    apply — handlers succeed, the store is byte-identical, and the
+    degradation is visible in breaker state + metrics."""
+    with disable_bls():
+        oracle = _fresh_store(spec, genesis)
+        _apply(spec, oracle, workload)
+        oracle_root = store_root(oracle)
+
+        store = _fresh_store(spec, genesis)
+        resilience.enable(max_retries=1, breaker_threshold=1,
+                          probe_after=1000)
+        txn.enable()
+        plan = FaultPlan(
+            [FaultSpec("txn.commit", "raise", persistent=True)],
+            seed=5)
+        with faults.inject(plan):
+            _apply(spec, store, workload)
+    assert store_root(store) == oracle_root
+    assert resilience.report()["breakers"]["txn.commit"] \
+        == resilience.OPEN
+    assert METRICS.snapshot()["scalar_fallbacks"]["breaker_open"] >= 1
+    assert METRICS.count_labeled("txn_rollbacks") == 0
+
+
+# ---------------------------------------------------------------------------
+# journal + recovery
+# ---------------------------------------------------------------------------
+
+def test_journal_records_intents_and_commit_markers(spec, genesis,
+                                                    workload):
+    with disable_bls():
+        journal = Journal()
+        store = _fresh_store(spec, genesis)
+        txn.enable(journal=journal, snapshot_interval=100)
+        _apply(spec, store, workload)
+        # one rejected op: intent recorded, never committed
+        bad = workload[2][1].copy()
+        bad.data.beacon_block_root = b"\x24" * 32
+        with pytest.raises(AssertionError):
+            spec.on_attestation(store, bad)
+    entries = journal.entries()
+    assert len(entries) == len(workload) + 1
+    assert [e.committed for e in entries] == [True] * len(workload) \
+        + [False]
+    assert [e.op for e in entries][:2] == ["on_tick", "on_block"]
+    assert journal.verify()
+
+
+def test_recovery_matches_live_store(spec, genesis, workload):
+    with disable_bls():
+        journal = Journal()
+        store = _fresh_store(spec, genesis)
+        txn.enable(journal=journal, snapshot_interval=100)
+        _apply(spec, store, workload)
+        live_root = store_root(store)
+        txn.disable()
+        recovered = txn.recover(spec, journal)
+    assert store_root(recovered) == live_root
+    assert METRICS.count("txn_recoveries") == 1
+    assert INCIDENTS.count(event="recovered", site="txn.recover") == 1
+
+
+def test_recovery_replay_is_deterministic(spec, genesis, workload):
+    with disable_bls():
+        journal = Journal()
+        store = _fresh_store(spec, genesis)
+        txn.enable(journal=journal, snapshot_interval=2)
+        _apply(spec, store, workload)
+        txn.disable()
+        roots = {bytes(store_root(txn.recover(spec, journal)))
+                 for _ in range(3)}
+    assert len(roots) == 1
+    assert roots == {store_root(store)}
+
+
+def test_snapshot_cadence_and_content_addressing(spec, genesis,
+                                                 workload):
+    with disable_bls():
+        journal = Journal()
+        store = _fresh_store(spec, genesis)
+        txn.enable(journal=journal, snapshot_interval=2)
+        _apply(spec, store, workload)
+    # anchor + one every 2 commits over 6 ops
+    assert METRICS.count("txn_snapshots") == 1 + len(workload) // 2
+    snap = journal.latest_snapshot()
+    assert store_root(snap.store) == snap.root
+    # recovery replays only the committed tail after the snapshot
+    assert all(e.seq > snap.entry_seq
+               for e in journal.committed_entries(snap.entry_seq))
+
+
+def test_recovery_detects_corrupted_snapshot(spec, genesis, workload):
+    with disable_bls():
+        journal = Journal()
+        store = _fresh_store(spec, genesis)
+        txn.enable(journal=journal, snapshot_interval=100)
+        _apply(spec, store, workload[:2])
+        txn.disable()
+    snap = journal.latest_snapshot()
+    snap.store.time = int(snap.store.time) + 1      # bit-rot the clone
+    with pytest.raises(RuntimeError, match="integrity"):
+        txn.recover(spec, journal)
+
+
+def test_torn_commit_redo_recovery(spec, genesis, workload):
+    """A crash mid-apply (after the commit marker) tears the live store;
+    recovery REDOES the marked operation and converges to the oracle
+    that applied it fully."""
+    with disable_bls():
+        journal = Journal()
+        store = _fresh_store(spec, genesis)
+        txn.enable(journal=journal, snapshot_interval=100)
+        _apply(spec, store, workload[:1])
+        signed = workload[1][1]
+        plan = FaultPlan(
+            [FaultSpec("txn.commit.apply", "raise", rate=1.0,
+                       max_fires=1)],
+            seed=2)
+        with faults.inject(plan):
+            with pytest.raises(DeviceFault):
+                spec.on_block(store, signed)
+        txn.disable()
+        assert INCIDENTS.count(event="torn") == 1
+        assert METRICS.count_labeled("txn_torn_commits") == 1
+
+        recovered = txn.recover(spec, journal)
+        oracle = _fresh_store(spec, genesis)
+        _apply(spec, oracle, workload[:2])
+    assert store_root(recovered) == store_root(oracle)
+    # the torn live store is NOT the oracle — recovery, not luck
+    assert store_root(store) != store_root(oracle)
+
+
+def test_journal_kill_point_drops_the_op(spec, genesis, workload):
+    """A crash mid-journal-write: the op is absent from both the journal
+    and every recovered store (atomic-or-absent)."""
+    with disable_bls():
+        journal = Journal()
+        store = _fresh_store(spec, genesis)
+        txn.enable(journal=journal, snapshot_interval=100)
+        _apply(spec, store, workload[:1])
+        pre_root = store_root(store)
+        plan = FaultPlan(
+            [FaultSpec("txn.journal", "raise", rate=1.0, max_fires=1)],
+            seed=4)
+        with faults.inject(plan):
+            with pytest.raises(DeviceFault):
+                spec.on_block(store, workload[1][1])
+        txn.disable()
+        assert store_root(store) == pre_root
+        recovered = txn.recover(spec, journal)
+    assert store_root(recovered) == pre_root
+    assert len(journal.committed_entries()) == 1    # just the tick
