@@ -13,8 +13,8 @@ RUNNERS := shuffling ssz_static operations epoch_processing sanity bls \
 	forks merkle_proof networking kzg_7594 random light_client sync
 
 .PHONY: test test-quick test-kernels tier1 chaos recovery-chaos lint \
-	native pyspec bench gossip-bench txn-bench gen_all detect_errors \
-	$(addprefix gen_,$(RUNNERS))
+	native pyspec bench gossip-bench txn-bench msm-bench gen_all \
+	detect_errors $(addprefix gen_,$(RUNNERS))
 
 # syntax/bytecode check over every package and script (the CI lint job)
 lint:
@@ -85,6 +85,13 @@ gossip-bench:
 # latency on native-BLS on_block replays with WAL journaling on
 txn-bench:
 	$(PYTHON) bench.py txn
+
+# device G1 sweep alone (ops/g1_sweep + weighted MSM): asserts one
+# aggregation + one MSM dispatch per flush and zero host point adds on
+# the device path at 10x gossip ingress; BENCH_MSM_BACKEND=native and
+# BENCH_MSM_MSGS=8 give an accelerator-less smoke run
+msm-bench:
+	$(PYTHON) bench.py msm
 
 # static pattern rule: GNU make refuses to run implicit pattern rules
 # for .PHONY targets

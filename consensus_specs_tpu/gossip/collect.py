@@ -19,9 +19,12 @@ best-effort:
   failed to predict.  Collection can therefore never change a verdict,
   only the dispatch count.
 
-Blocks are the exception: their signature surface is covered by the
-block-level pipeline (sigpipe.block_scope inside state_transition), so
-this layer only extracts the proposer's (slot -> block) vote key.
+Blocks contribute their PROPOSER signature to the window (predicted
+from the parent state — see `_block`) plus the proposer's
+(slot -> block) vote key; the rest of a block's signature surface
+(randao, in-block operations) stays with the block-level pipeline
+(sigpipe.block_scope inside state_transition), which reuses the
+window's proposer verdict instead of re-batching it.
 """
 from __future__ import annotations
 
@@ -116,9 +119,31 @@ def _sync_message(spec, store, message, origin) -> Collected:
 
 def _block(spec, store, signed_block, origin) -> Collected:
     block = signed_block.message
-    return Collected((), [("block", int(block.proposer_index),
-                           int(block.slot),
-                           bytes(hash_tree_root(block)), None)])
+    votes = [("block", int(block.proposer_index), int(block.slot),
+              bytes(hash_tree_root(block)), None)]
+    # Predict the proposer-signature check (state_transition's
+    # verify_block_signature) from the PARENT state, without running
+    # process_slots: a validator's pubkey never changes at an existing
+    # index, and the signing domain only needs the fork version at the
+    # block's epoch (passed explicitly — the at-slot state would read
+    # the same field).  Mispredictions — proposer index activated at
+    # the epoch boundary, a fork upgrade in the slot gap rotating
+    # state.fork — just produce a key no seam ever looks up: the block
+    # verifies scalar exactly as before, and its own failed-collection
+    # counter says so.
+    sets = []
+    try:
+        state = store.block_states[block.parent_root]
+        proposer = state.validators[block.proposer_index]
+        domain = spec.get_domain(
+            state, spec.DOMAIN_BEACON_PROPOSER,
+            spec.compute_epoch_at_slot(block.slot))
+        root = spec.compute_signing_root(block, domain)
+        sets.append(_set([proposer.pubkey], root, signed_block.signature,
+                         "gossip_block_proposer", origin))
+    except Exception:
+        METRICS.inc("gossip_proposer_predict_skipped")
+    return Collected(sets, votes)
 
 
 def _payload_attestation(spec, store, message, origin) -> Collected:

@@ -371,7 +371,15 @@ class AdmissionPipeline:
     def _deliver(self, message: Message, verdict_map) -> bool:
         self.delivered_log.append((message.seq, message.topic,
                                    message.payload))
-        use_map = verdict_map is not None and message.topic != "block"
+        # blocks consume the window map too: the collector predicts the
+        # proposer signature, state_transition's verify_block_signature
+        # consumes its verdict at the bls_verify seam, and sigpipe's
+        # block scope (when enabled) REUSES it rather than re-batching
+        # (verify.compute_verdicts lifts outer-map verdicts).  Every
+        # other in-block check either rides the block scope or falls
+        # back scalar at the seam — content addressing makes a stale
+        # or mispredicted key simply invisible.
+        use_map = verdict_map is not None
         if use_map:
             with self.spec.install_sigpipe_verdicts(verdict_map):
                 accepted, detail = apply_scalar(

@@ -11,7 +11,8 @@ a warm entry is correct no matter who computed it.
 
 After a block is accepted into the store, `prewarm_block` pushes every
 participant aggregate the block implies into that cache via
-`AggregatePubkeyCache.warm()` (counted as `aggregate_cache_prewarms`,
+`AggregatePubkeyCache.warm_many()` — one batched ops.g1_aggregate
+dispatch for all cold sets (counted as `aggregate_cache_prewarms`,
 never distorting the hit rate): each attestation's attesting set and
 the sync aggregate's participant set.  A later gossip aggregate, a
 sibling block, or a fork-choice replay with the same participants then
@@ -30,10 +31,12 @@ from ..sigpipe.metrics import METRICS
 def prewarm_block(spec, store, block_root) -> int:
     """Warm the aggregate-pubkey cache with every participant set the
     accepted block at `block_root` implies; returns how many entries
-    were actually cold (work done)."""
+    were actually cold (work done).  All cold sums ride ONE batched
+    `warm_many` device dispatch (ops.g1_aggregate) instead of a
+    per-committee host add loop."""
     block = store.blocks[block_root]
     state = store.block_states[block_root]
-    warmed = 0
+    jobs = []
     for attestation in block.body.attestations:
         try:
             indexed = spec.get_indexed_attestation(state, attestation)
@@ -43,10 +46,9 @@ def prewarm_block(spec, store, block_root) -> int:
             pubkeys = [bytes(state.validators[i].pubkey)
                        for i in indices]
             data = attestation.data
-            if AGGREGATES.warm(pubkeys,
-                               hint=("att", int(data.target.epoch),
-                                     int(getattr(data, "index", 0)))):
-                warmed += 1
+            jobs.append((pubkeys,
+                         ("att", int(data.target.epoch),
+                          int(getattr(data, "index", 0)))))
         except Exception:
             METRICS.inc("gossip_prewarm_skipped")
     if spec.is_post("altair"):
@@ -60,10 +62,17 @@ def prewarm_block(spec, store, block_root) -> int:
                 epoch = int(spec.get_current_epoch(state))
                 period = epoch // int(
                     spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)
-                if AGGREGATES.warm(participants, hint=("sync", period)):
-                    warmed += 1
+                jobs.append((participants, ("sync", period)))
         except Exception:
             METRICS.inc("gossip_prewarm_skipped")
+    try:
+        warmed = AGGREGATES.warm_many(jobs) if jobs else 0
+    except Exception:
+        # unsupervised dispatch has no fallback: a device failure inside
+        # the batched sweep must stay a missed warm-up, not abort the
+        # gossip drain that already accepted the block
+        METRICS.inc("gossip_prewarm_skipped")
+        return 0
     if warmed:
         METRICS.inc("gossip_prewarmed_aggregates", warmed)
     return warmed

@@ -37,10 +37,7 @@ from ..crypto.fields import R
 from . import curve_jax as cj
 from . import fq
 from .curve_jax import F1, point_add, point_double, point_infinity_like
-
-
-def _pad_pow2(n: int) -> int:
-    return 1 << (n - 1).bit_length() if n > 1 else 1
+from .g1_sweep import _pow2 as _pad_pow2
 
 
 # ---------------------------------------------------------------------------
@@ -258,6 +255,49 @@ def g1_multi_exp(points, scalars):
     Z = np.asarray(out[2])[None]
     return cj.g1_unpack((jnp.asarray(X), jnp.asarray(Y),
                          jnp.asarray(Z)))[0]
+
+
+def g1_weighted_sweep(points, scalars):
+    """Per-pair weighted points [s_i * P_i] — NO reduction — in one
+    batched dispatch.
+
+    The fused scheduler's Fiat–Shamir weighting (sigpipe/scheduler.py)
+    needs each c_i * agg_i and c_i * (-g1) *individually* (every
+    weighted point feeds its own pairing leg), so the classic summed
+    MSM shape does not apply; what batches is the scalar-mul ladder
+    itself: all 2N 64-bit ladders of a flush ride one
+    `cj.g1_scalar_mul` launch over a [n, bits] digit tensor instead of
+    2N host double-and-add loops.  The bit width adapts to the widest
+    scalar (64 for the scheduler's coefficients — a 4x shorter scan
+    than the generic 256), and the batch axis pads to a power of two so
+    XLA only sees log-many shapes.
+
+    Platform split follows g1_sweep.G1_SWEEP_MODE (jax engine off-CPU,
+    vectorized host oracle on CPU); the per-pair host ladder is the
+    *fallback* of the `ops.msm` resilience dispatch site, counted in
+    sigpipe.metrics as `host_point_adds`."""
+    if len(points) != len(scalars):
+        raise ValueError("g1_weighted_sweep: length mismatch")
+    if not points:
+        return []
+    from .g1_sweep import _resolve_mode as _sweep_mode
+    sc = [int(s) % R for s in scalars]
+    if _sweep_mode() != "jax":
+        # scalars are subgroup-order-reduced either way: every input
+        # point is in the r-torsion subgroup (validated pubkeys, the
+        # generator), so s*P == (s mod R)*P
+        return [p * s for p, s in zip(points, sc)]
+    n = len(points)
+    m = _pad_pow2(n)
+    pts = list(points) + [cv.g1_infinity()] * (m - n)
+    sc = sc + [0] * (m - n)
+    width = max((s.bit_length() for s in sc), default=1) or 1
+    n_bits = 64 if width <= 64 else 256
+    packed = cj.g1_pack(pts)
+    bits = cj.scalars_to_bits(sc, n_bits=n_bits)
+    prods = cj.g1_scalar_mul(packed, bits)
+    return cj.g1_unpack(tuple(
+        jnp.asarray(np.asarray(c)) for c in prods))[:n]
 
 
 def g2_multi_exp(points, scalars):

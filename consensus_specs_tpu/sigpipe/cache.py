@@ -15,6 +15,17 @@ shuffle-permutation LRU):
   participant pubkeys — a label collision can therefore never return the
   wrong aggregate.
 
+COLD sums run on the accelerator, not the host: every compute path
+(`aggregate`, `aggregate_many`, `warm_many`) funnels into
+`_sum_batch`, which fuses all cold sets of a call into ONE
+`ops/g1_sweep.g1_add_sweep` ragged-segment reduction behind the
+`ops.g1_aggregate` resilience dispatch seam — the scheduler's flush and
+the gossip prewarm therefore cost one batched dispatch each instead of
+O(sets x committee) Python point adds.  The supervised fallback is the
+byte-identical per-set host loop, and every add it performs lands in
+the `host_point_adds` counter (the number the device offload exists to
+drive to ~0); `g1_aggregate_dispatches` counts the batched calls.
+
 Hit/miss counters land in sigpipe.metrics.METRICS.
 
 Both caches are thread-safe (one lock each around lookup/insert/evict):
@@ -30,6 +41,7 @@ import threading
 
 from ..crypto import curve as cv
 from ..crypto.bls12_381 import _load_pubkey
+from ..crypto.curve import DecodeError
 from .metrics import METRICS
 
 
@@ -123,33 +135,132 @@ class AggregatePubkeyCache:
         agg = self._compute_and_insert(digest, pubkey_bytes_list, hint)
         return agg
 
-    def warm(self, pubkey_bytes_list, hint=None) -> bool:
-        """Pre-compute an aggregate OUTSIDE a verification (the
-        fork-choice on_block pre-warm, gossip/prewarm.py): inserts like
-        `aggregate` but counts `aggregate_cache_prewarms` instead of a
-        hit or a miss, so warm-up work never distorts the hit rate the
-        dashboards track.  Returns True when the entry was actually cold
-        (work done), False when it was already cached."""
-        digest = self._digest(pubkey_bytes_list)
-        with self._lock:
-            if digest in self._cache:
-                return False
-        self._metrics.inc("aggregate_cache_prewarms")
-        self._compute_and_insert(digest, pubkey_bytes_list, hint)
-        return True
+    def _collect_cold(self, jobs, hit_counter, miss_counter):
+        """Shared cold-collection for the batch entry points: digest
+        each (pubkey_bytes_list, hint) job, count cache hits/misses
+        under the given metric names (None skips the count), decode the
+        cold sets — a job whose pubkeys fail decode is dropped, the
+        per-job stand-in for the scalar path's DecodeError/ValueError —
+        and dedup by content digest within the call.  Returns
+        (hits: job index -> cached Point,
+         cold: digest -> (decompressed points, hint),
+         slots: digest -> job indices awaiting that cold sum)."""
+        hits: dict = {}
+        cold: dict = {}
+        slots: dict = {}
+        for k, (pks, hint) in enumerate(jobs):
+            digest = self._digest(pks)
+            with self._lock:
+                entry = self._cache.get(digest)
+            if entry is not None:
+                if hit_counter:
+                    self._metrics.inc(hit_counter)
+                hits[k] = entry[0]
+                continue
+            if digest in cold:
+                # an intra-call duplicate reads as a HIT, matching the
+                # sequential scalar path (first call misses and
+                # computes, the second hits the fresh entry)
+                if hit_counter:
+                    self._metrics.inc(hit_counter)
+                slots[digest].append(k)
+                continue
+            if miss_counter:
+                self._metrics.inc(miss_counter)
+            try:
+                pts = [self._pubkeys.get(pk) for pk in pks]
+            except (DecodeError, ValueError):
+                continue
+            cold[digest] = (pts, hint)
+            slots[digest] = [k]
+        return hits, cold, slots
+
+    def _sum_and_insert(self, cold) -> list:
+        """ONE batched `_sum_batch` dispatch over every cold set, each
+        sum inserted under its digest; returns the sums in `cold`
+        iteration order."""
+        digests = list(cold)
+        sums = self._sum_batch([cold[d][0] for d in digests])
+        for digest, agg in zip(digests, sums):
+            self._insert(digest, agg, cold[digest][1])
+        return sums
+
+    def aggregate_many(self, jobs) -> list:
+        """Batch form of `aggregate` for a whole scheduler flush: `jobs`
+        is a list of (pubkey_bytes_list, hint) pairs; returns one
+        aggregated Point per job, or None where a pubkey failed
+        decode/validation (the per-job stand-in for the scalar path's
+        DecodeError/ValueError).  Hits come straight from the cache; ALL
+        cold jobs' committee sums fuse into one `_sum_batch` device
+        dispatch, deduplicated by content digest within the call."""
+        hits, cold, slots = self._collect_cold(
+            jobs, "aggregate_cache_hits", "aggregate_cache_misses")
+        results = [None] * len(jobs)
+        for k, agg in hits.items():
+            results[k] = agg
+        if cold:
+            for digest, agg in zip(cold, self._sum_and_insert(cold)):
+                for k in slots[digest]:
+                    results[k] = agg
+        return results
+
+    def warm_many(self, jobs) -> int:
+        """Pre-compute aggregates OUTSIDE a verification (the on_block
+        prewarm sweep, gossip/prewarm.py): inserts every cold
+        participant set of `jobs` via one `_sum_batch` dispatch,
+        counting `aggregate_cache_prewarms` instead of hits/misses so
+        warm-up work never distorts the hit rate the dashboards track;
+        returns how many sets were actually cold.  Best-effort like the
+        prewarm path itself — a set whose pubkeys fail decode is
+        skipped, never an error."""
+        _hits, cold, _slots = self._collect_cold(jobs, None, None)
+        if not cold:
+            return 0
+        self._metrics.inc("aggregate_cache_prewarms", len(cold))
+        self._sum_and_insert(cold)
+        return len(cold)
 
     def _compute_and_insert(self, digest, pubkey_bytes_list,
                             hint) -> cv.Point:
+        # decompression (the expensive per-key host step, cached in
+        # PubkeyCache) raises DecodeError/ValueError exactly like the
+        # scalar path; the sum itself rides the batched dispatch seam
+        pts = [self._pubkeys.get(pk) for pk in pubkey_bytes_list]
+        agg = self._sum_batch([pts])[0]
+        self._insert(digest, agg, hint)
+        return agg
+
+    def _sum_batch(self, point_lists) -> list:
+        """THE cold-sum path: one `ops.g1_aggregate` dispatch for every
+        cold participant set of a call (ops/g1_sweep.py padded
+        ragged-segment reduction); the supervised fallback is the
+        byte-identical per-set host loop, its adds counted."""
+        from ..resilience.supervisor import dispatch
+        self._metrics.inc("g1_aggregate_dispatches")
+
+        def device():
+            from ..ops.g1_sweep import g1_add_sweep
+            return g1_add_sweep(point_lists)
+
+        return dispatch("ops.g1_aggregate", device,
+                        lambda: [self._host_sum(pts)
+                                 for pts in point_lists])
+
+    def _host_sum(self, pts) -> cv.Point:
         agg = cv.g1_infinity()
-        for pk in pubkey_bytes_list:
-            agg = agg + self._pubkeys.get(pk)
+        for p in pts:
+            agg = agg + p
+        if pts:
+            self._metrics.inc("host_point_adds", len(pts))
+        return agg
+
+    def _insert(self, digest, agg, hint) -> None:
         with self._lock:
             if len(self._cache) >= self._max:
                 self._cache.pop(next(iter(self._cache)))
             self._cache[digest] = (agg, hint)
             for tracked in self._track_stack:
                 tracked.add(digest)
-        return agg
 
     def clear(self) -> None:
         with self._lock:

@@ -17,6 +17,17 @@ the `scalar_fallbacks` counter is labeled by degradation reason
 `disabled`) so a metrics snapshot says not just that the pipeline
 degraded but why.
 
+The device-G1-sweep offload (PR 5) is observable through three plain
+counters: `g1_aggregate_dispatches` (batched committee-sum calls at the
+`ops.g1_aggregate` seam) and `msm_dispatches` (coefficient-weighted
+sweep calls at `ops.msm`) count the per-flush device work — exactly one
+of each per fused flush — while `host_point_adds` counts every
+point add/double the per-set HOST fallback loops perform (cache sums,
+weighting ladders, bisection's oracle re-derivation): ~0 whenever the
+device path is healthy, which is what `make msm-bench` and the sweep
+tests pin.  All three ride the ordinary counter path and land in the
+JSON dump.
+
 Histograms (`observe_hist`) bucket integer observations by
 power-of-two: the gossip admission layer records batch occupancy per
 flush here (`batch_occupancy`: how many signature sets each dispatch

@@ -31,6 +31,22 @@ Two strategies, both returning one verdict per set:
 Degenerate sets never reach a dispatch: empty pubkey lists and
 undecodable points read as invalid immediately, exactly matching the
 scalar API's False-on-DecodeError contract.
+
+DEVICE G1 SWEEP.  The elliptic-curve *preparation* of a flush is
+batched onto the accelerator alongside the pairing itself: all cold
+committee sums ride one `ops.g1_aggregate` dispatch
+(cache.aggregate_many -> ops/g1_sweep.py) and all 2N Fiat-Shamir
+weightings one `ops.msm` dispatch (`_weighted_g1` ->
+ops/msm.g1_weighted_sweep), so a flush costs O(1) device calls where it
+used to cost O(sets x committee) host point ops.  Both sites carry the
+per-set host loop as supervised byte-identical fallback (every fallback
+add counted in `host_point_adds`), and the bisection path re-derives
+its weighted pairs on the host ladder so a corrupt device sweep cannot
+flip a verdict through a FAILING product (valid sets survive the host
+re-check).  The accept direction is weaker by construction: a sweep
+returning all-identity points makes the product vacuously pass and
+bisection never runs — that corruption is the differential guard's
+case (guard.py), not this path's.
 """
 from __future__ import annotations
 
@@ -85,23 +101,58 @@ def _coefficients(entries):
 
 
 def _prepare(indices, sets, verdicts):
-    """Decompress + aggregate each set's G1 side and decode its signature,
-    through the pubkey caches.  Fills `verdicts` with False for sets the
-    scalar API would reject before pairing."""
-    prepared = []
+    """Decode each set's signature and batch-aggregate every G1 side
+    through the aggregate cache: all cold committee sums of the flush
+    fuse into ONE `ops.g1_aggregate` device dispatch
+    (cache.aggregate_many) instead of a per-set Python add loop.  Fills
+    `verdicts` with False for sets the scalar API would reject before
+    pairing."""
+    pending = []
     for i in indices:
         s = sets[i]
         if len(s.pubkeys) == 0:
             verdicts[i] = False      # scalar FastAggregateVerify: False
             continue
         try:
-            agg = AGGREGATES.aggregate(s.pubkeys, hint=s.hint)
             sig = _load_signature(s.signature)
         except (DecodeError, ValueError):
             verdicts[i] = False
             continue
+        pending.append((i, s, sig))
+    aggs = AGGREGATES.aggregate_many(
+        [(s.pubkeys, s.hint) for _i, s, _sig in pending])
+    prepared = []
+    for (i, _s, sig), agg in zip(pending, aggs):
+        if agg is None:              # a pubkey failed decode/validation
+            verdicts[i] = False
+            continue
         prepared.append((i, agg, sig))
     return prepared
+
+
+def _host_scalar_mul(point, k):
+    """Host double-and-add ladder with its point-op cost counted — the
+    per-set arithmetic the device sweep exists to eliminate (~96 ops
+    per 64-bit coefficient)."""
+    k = int(k)
+    METRICS.inc("host_point_adds",
+                max(k.bit_length(), 1) + bin(k).count("1"))
+    return point * k
+
+
+def _weighted_g1(points, coeffs):
+    """All 2N Fiat-Shamir weightings of a flush as ONE batched dispatch
+    (ops/msm.py `g1_weighted_sweep`) behind the `ops.msm` resilience
+    seam; the supervised fallback is the byte-identical per-pair host
+    ladder."""
+    from ..ops import msm as _msm
+    from ..resilience.supervisor import dispatch
+    METRICS.inc("msm_dispatches")
+    return dispatch(
+        "ops.msm",
+        lambda: _msm.g1_weighted_sweep(points, coeffs),
+        lambda: [_host_scalar_mul(p, c)
+                 for p, c in zip(points, coeffs)])
 
 
 def _verify_fused(sets, prepared, verdicts):
@@ -109,14 +160,30 @@ def _verify_fused(sets, prepared, verdicts):
     hashes = _hash_roots([s.signing_root for s, _, _ in entries])
     coeffs = _coefficients(entries)
     neg_g1 = -cv.g1_generator()
-    weighted = []
-    for (s, agg, sig), h, c in zip(entries, hashes, coeffs):
-        weighted.append([(agg * c, h), (neg_g1 * c, sig)])
+    bases, scalars = [], []
+    for (_s, agg, _sig), c in zip(entries, coeffs):
+        bases.extend((agg, neg_g1))
+        scalars.extend((c, c))
+    weighted_flat = _weighted_g1(bases, scalars)
+    weighted, groups = [], []
+    for k, ((s, agg, sig), h, c) in enumerate(
+            zip(entries, hashes, coeffs)):
+        weighted.append([(weighted_flat[2 * k], h),
+                         (weighted_flat[2 * k + 1], sig)])
+        groups.append((agg, c, h, sig))
 
-    def group_valid(pair_groups):
+    def group_valid(sub_groups):
+        # bisection probe: re-derive each group's weighted pairs on the
+        # HOST ladder, so invalid-set isolation never trusts a possibly
+        # corrupt device sweep — a lying `ops.msm` answer degrades to
+        # one failed product plus an oracle-weighted re-check, not to
+        # wrong per-set verdicts
         METRICS.inc("dispatches")
-        return bls.pairing_check(
-            [pair for group in pair_groups for pair in group])
+        pairs = []
+        for agg, c, h, sig in sub_groups:
+            pairs.append((_host_scalar_mul(agg, c), h))
+            pairs.append((_host_scalar_mul(neg_g1, c), sig))
+        return bls.pairing_check(pairs)
 
     METRICS.inc("dispatches")
     ok = bls.pairing_check([p for group in weighted for p in group])
@@ -124,7 +191,15 @@ def _verify_fused(sets, prepared, verdicts):
         bad_local = set()
     else:
         METRICS.inc("fused_batch_failures")
-        bad_local = set(_bisect.isolate_failures(weighted, group_valid))
+        if len(groups) == 1:
+            # isolate_failures condemns a singleton without re-probing
+            # (its contract assumes the caller's failing check is
+            # trusted) — but OUR failing product used device-weighted
+            # points, so a one-set flush must re-check on the host
+            # ladder or a corrupt sweep could flip the verdict
+            bad_local = set() if group_valid(groups) else {0}
+        else:
+            bad_local = set(_bisect.isolate_failures(groups, group_valid))
     for rank, (i, _agg, _sig) in enumerate(prepared):
         verdicts[i] = rank not in bad_local
 
@@ -142,9 +217,24 @@ def _verify_per_set(indices, sets, verdicts):
                 [sets[i].signature for i in singles])):
             verdicts[i] = bool(v)
     if multis:
+        # the multi-pubkey leg: every job's committee sum rides the one
+        # batched aggregation dispatch, and the batch API receives the
+        # pre-aggregated point (the aggregate of one point is itself).
+        # Jobs whose pubkeys fail decode keep their original list — the
+        # batch API's own screening reads them as invalid — and so does
+        # the identity aggregate (a pubkey list summing to infinity
+        # must reach the scalar check undisturbed: compressed-infinity
+        # pubkeys are rejected at decode, which a substitution would
+        # wrongly trigger).
+        aggs = AGGREGATES.aggregate_many(
+            [(sets[i].pubkeys, sets[i].hint) for i in multis])
+        pk_lists = [
+            [agg] if agg is not None and not agg.is_infinity()
+            else list(sets[i].pubkeys)
+            for i, agg in zip(multis, aggs)]
         METRICS.inc("dispatches")
         for i, v in zip(multis, bls.FastAggregateVerifyBatch(
-                [list(sets[i].pubkeys) for i in multis],
+                pk_lists,
                 [sets[i].signing_root for i in multis],
                 [sets[i].signature for i in multis])):
             verdicts[i] = bool(v)

@@ -72,32 +72,57 @@ class VerdictMap:
             METRICS.inc("seam_hits")
         return v
 
+    def peek(self, key):
+        """Verdict for a content key WITHOUT seam-metrics side effects —
+        the block scope's window-reuse probe (a probe is not a seam
+        consultation; counting it as hit or miss would distort both)."""
+        return self._verdicts.get(key)
+
     def __len__(self) -> int:
         return len(self._verdicts)
 
 
-def _batch_verify_unique(collected, mode: str | None = None):
+def _batch_verify_unique(collected, mode: str | None = None,
+                         reuse: VerdictMap | None = None):
     """Dedup identical checks (same pubkeys/root/signature verify once),
     batch-verify, and return the content-keyed verdict dict.  `mode`
     defaults to the module's enabled mode; the gossip micro-batcher
-    passes its own."""
+    passes its own.  `reuse` is an already-installed outer VerdictMap
+    (the gossip window's): checks it has a verdict for — the block
+    proposer signature the gossip collector predicted — are lifted into
+    the result instead of re-verified, so one signature never rides two
+    batches."""
     unique: dict = {}
     for s in collected:
         unique.setdefault(s.key(), s)
     dropped = len(collected) - len(unique)
     if dropped:
         METRICS.inc("dedup_saved", dropped)
+    by_key: dict = {}
+    if reuse is not None:
+        for key in list(unique):
+            v = reuse.peek(key)
+            if v is not None:
+                by_key[key] = v
+                del unique[key]
+        if by_key:
+            METRICS.inc("window_verdicts_reused", len(by_key))
     unique_sets = list(unique.values())
     unique_verdicts = scheduler.verify_sets(
         unique_sets, mode=mode if mode is not None else _mode)
-    return {s.key(): v for s, v in zip(unique_sets, unique_verdicts)}
+    by_key.update(
+        {s.key(): v for s, v in zip(unique_sets, unique_verdicts)})
+    return by_key
 
 
 def compute_verdicts(spec, state, signed_block):
     """Collect + batch-verify every signature check in `signed_block`;
-    returns (VerdictMap, collected sets, per-set verdict list)."""
+    returns (VerdictMap, collected sets, per-set verdict list).  An
+    outer verdict map already installed on `spec` (the gossip window's)
+    is consulted first — its verdicts are reused, not recomputed."""
     block_sets = sets.collect_block_sets(spec, state, signed_block)
-    by_key = _batch_verify_unique(block_sets)
+    by_key = _batch_verify_unique(
+        block_sets, reuse=getattr(spec, "_sigpipe_verdicts", None))
     return (VerdictMap(by_key), block_sets,
             [by_key[s.key()] for s in block_sets])
 

@@ -44,9 +44,13 @@ pytestmark = pytest.mark.slow
 CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "20260803"))
 
 # the dispatch sites a native-backend replay actually reaches (tpu-only
-# seams like sigpipe.hash_to_g2_batch are covered by unit tests)
+# seams like sigpipe.hash_to_g2_batch are covered by unit tests).
+# ops.g1_aggregate / ops.msm are the PR-5 device G1 sweep sites — every
+# scheduler flush crosses both, so the randomized schedules and the
+# gossip tier now exercise trips/fallbacks there too.
 SITES = ("bls.pairing_check", "bls.verify_batch",
-         "bls.fast_aggregate_verify_batch")
+         "bls.fast_aggregate_verify_batch",
+         "ops.g1_aggregate", "ops.msm")
 
 
 @pytest.fixture(scope="module")

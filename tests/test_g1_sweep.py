@@ -1,0 +1,101 @@
+"""JAX-engine parity for the device G1 sweep ops (kernel tier).
+
+The fast suites (tests/test_sigpipe.py "device G1 sweep" section) pin
+the oracle-engine parity, the dispatch seams and the metrics contract;
+this file forces the `jax` engine — the batched limb kernels an
+accelerator actually runs — and diffs it against the host oracle on
+the same edge cases.  Compile-heavy (tens of seconds per point-add
+shape on a CPU host), hence gated behind --kernel-tiers like the other
+limb-kernel suites.
+"""
+import pytest
+
+from consensus_specs_tpu.crypto import curve as cv
+from consensus_specs_tpu.ops import g1_sweep
+from consensus_specs_tpu.ops import msm as ops_msm
+
+
+@pytest.fixture(autouse=True)
+def _force_jax_engine():
+    prev = g1_sweep.G1_SWEEP_MODE
+    g1_sweep.G1_SWEEP_MODE = "jax"
+    yield
+    g1_sweep.G1_SWEEP_MODE = prev
+
+
+def _points(ids):
+    return [cv.g1_generator() * (5 + i) for i in ids]
+
+
+def _oracle_sums(lists):
+    out = []
+    for pts in lists:
+        acc = cv.g1_infinity()
+        for p in pts:
+            acc = acc + p
+        out.append(acc)
+    return out
+
+
+def test_jax_add_sweep_ragged_segments_match_oracle():
+    """Non-power-of-two segment count AND lengths, an empty segment,
+    identity points inside a segment, a cancelling pair — every sum
+    equals the sequential host oracle."""
+    p, q, r = _points([1, 2, 3])
+    inf = cv.g1_infinity()
+    lists = [[p, q, r], [], [q], [p, -p], [inf, r, inf, q, p]]
+    assert g1_sweep.g1_add_sweep(lists) == _oracle_sums(lists)
+
+
+def test_jax_add_sweep_single_segment_single_point():
+    p = _points([9])[0]
+    assert g1_sweep.g1_add_sweep([[p]]) == [p]
+    assert g1_sweep.g1_add_sweep([[]]) == [cv.g1_infinity()]
+
+
+def test_jax_weighted_sweep_matches_host_ladder():
+    """64-bit coefficient ladders on the jax engine: coeff 0 and 1, the
+    identity point, a max-width coefficient, non-power-of-two batch."""
+    p, q, r = _points([4, 5, 6])
+    pts = [p, q, cv.g1_infinity(), r, p]
+    coeffs = [0, 1, (1 << 64) - 1, 0xC0FFEE, 2]
+    got = ops_msm.g1_weighted_sweep(pts, coeffs)
+    assert got == [pt * c for pt, c in zip(pts, coeffs)]
+
+
+def test_jax_weighted_sweep_wide_scalar_falls_back_to_256_bits():
+    """A scalar past 64 bits widens the whole ladder (the scheduler
+    never produces one, but the op must not silently truncate)."""
+    p, q = _points([7, 8])
+    coeffs = [(1 << 80) + 3, 5]
+    got = ops_msm.g1_weighted_sweep([p, q], coeffs)
+    assert got == [p * ((1 << 80) + 3), q * 5]
+
+
+def test_scheduler_fused_flush_on_jax_engines():
+    """End-to-end: a fused scheduler flush with BOTH device engines
+    forced to jax produces the same verdicts as the host path and zero
+    host point adds."""
+    from consensus_specs_tpu.sigpipe import METRICS, cache, scheduler
+    from consensus_specs_tpu.sigpipe.sets import SignatureSet
+    from consensus_specs_tpu.test_infra.keys import privkeys, pubkeys
+    from consensus_specs_tpu.utils import bls
+
+    sets = []
+    for i in range(3):
+        msg = i.to_bytes(8, "little") + b"\x77" * 24
+        ids = [i, i + 1]
+        signer_ids = ids if i != 1 else [x + 9 for x in ids]
+        sig = bls.Aggregate([bls.Sign(privkeys[x], msg)
+                             for x in signer_ids])
+        sets.append(SignatureSet(
+            pubkeys=tuple(bytes(pubkeys[x]) for x in ids),
+            signing_root=msg, signature=bytes(sig), kind="test",
+            origin=("jax", i)))
+    cache.clear()
+    METRICS.reset()
+    verdicts = scheduler.verify_sets(sets, mode="fused")
+    assert verdicts == [True, False, True]
+    snapshot = METRICS.snapshot()
+    assert snapshot["g1_aggregate_dispatches"] == 1
+    assert snapshot["msm_dispatches"] == 1

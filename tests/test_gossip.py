@@ -296,6 +296,34 @@ def test_block_accept_prewarms_aggregate_cache(spec, genesis):
         spec, oracle_store)
 
 
+def test_prewarm_device_failure_stays_best_effort(spec, genesis,
+                                                  monkeypatch):
+    """An unsupervised device failure inside the batched warm sweep must
+    read as a missed warm-up (gossip_prewarm_skipped), never abort the
+    drain that already accepted the block."""
+    state = genesis.copy()
+    spec.process_slots(state, uint64(spec.SLOTS_PER_EPOCH + 2))
+    att = get_valid_attestation(spec, state, signed=True)
+    advanced = state.copy()
+    spec.process_slots(advanced, uint64(
+        state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    block = build_empty_block_for_next_slot(spec, advanced)
+    block.body.attestations.append(att)
+    signed = state_transition_and_sign_block(spec, advanced.copy(), block)
+
+    def boom(jobs):
+        raise RuntimeError("simulated XLA failure in g1_add_sweep")
+    monkeypatch.setattr(AGGREGATES, "warm_many", boom)
+
+    store = _store_at(spec, genesis, signed.message.slot)
+    pipe = AdmissionPipeline(spec, store, GossipConfig(), ManualClock())
+    pipe.submit("block", signed, peer="p1")
+    results = pipe.drain()
+    assert results[0].status == "accepted"
+    assert METRICS.count("gossip_prewarm_skipped") >= 1
+    assert METRICS.count("gossip_prewarmed_aggregates") == 0
+
+
 # ---------------------------------------------------------------------------
 # admission control (BLS stubbed: decisions, not signatures)
 # ---------------------------------------------------------------------------
@@ -955,3 +983,95 @@ def test_quarantined_proposer_block_still_imports(spec, genesis):
         results = pipe.drain()
     assert [r.status for r in results] == ["accepted"]
     assert hash_tree_root(signed.message) in store.blocks
+
+
+# ---------------------------------------------------------------------------
+# proposer-signature batching (PR 5): blocks ride the gossip window
+# ---------------------------------------------------------------------------
+
+def _signed_empty_block(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    return state_transition_and_sign_block(spec, state.copy(), block)
+
+
+def test_block_proposer_signature_rides_gossip_window(spec, genesis,
+                                                      state):
+    """The collector predicts the block's proposer check from the
+    parent state; the window batches it with the attestations; and
+    on_block's verify_block_signature consumes the verdict at the
+    bls_verify seam instead of paying a scalar pairing."""
+    signed = _signed_empty_block(spec, state)
+    slot = int(state.slot) - 1
+    atts = _single_attestations(spec, state, slot, 2)
+    store = _store_at(spec, genesis, signed.message.slot)
+    pipe = AdmissionPipeline(spec, store, GossipConfig(), ManualClock())
+    for att in atts:
+        pipe.submit("attestation", att, peer="p1")
+    pipe.submit("block", signed, peer="p1")
+    results = pipe.drain()
+    assert all(r.status == "accepted" for r in results)
+    snapshot = METRICS.snapshot()
+    # nothing failed to predict on the block leg ...
+    assert snapshot.get("gossip_proposer_predict_skipped", 0) == 0
+    # ... and the proposer verdict was consumed from the window map
+    assert snapshot.get("seam_hits", 0) >= 1
+    oracle_store, _ = _oracle_replay(spec, genesis, signed.message.slot,
+                                     pipe)
+    assert store_fingerprint(spec, store) == store_fingerprint(
+        spec, oracle_store)
+
+
+def test_block_scope_reuses_window_proposer_verdict(spec, genesis,
+                                                    state):
+    """With sigpipe enabled, the block scope inside state_transition
+    lifts the window's proposer verdict instead of re-batching the same
+    signature (one check, one verification)."""
+    signed = _signed_empty_block(spec, state)
+    slot = int(state.slot) - 1
+    atts = _single_attestations(spec, state, slot, 2)
+    store = _store_at(spec, genesis, signed.message.slot)
+    pipe = AdmissionPipeline(spec, store, GossipConfig(), ManualClock())
+    sigpipe.enable()
+    try:
+        for att in atts:
+            pipe.submit("attestation", att, peer="p1")
+        pipe.submit("block", signed, peer="p1")
+        results = pipe.drain()
+    finally:
+        sigpipe.disable()
+    assert all(r.status == "accepted" for r in results)
+    assert METRICS.count("window_verdicts_reused") >= 1
+    oracle_store, _ = _oracle_replay(spec, genesis, signed.message.slot,
+                                     pipe)
+    assert store_fingerprint(spec, store) == store_fingerprint(
+        spec, oracle_store)
+
+
+def test_invalid_proposer_signature_block_rejected_via_window(
+        spec, genesis, state):
+    """A block with a wrong proposer signature still rejects at
+    on_block's own boundary when its (False) verdict arrives through
+    the window map — byte-identical to the scalar oracle."""
+    from consensus_specs_tpu.test_infra.keys import privkeys
+    from consensus_specs_tpu.utils import bls
+    signed = _signed_empty_block(spec, state)
+    bad = signed.copy()
+    bad.signature = bls.Sign(privkeys[11], b"\x42" * 32)
+    slot = int(state.slot) - 1
+    atts = _single_attestations(spec, state, slot, 2)
+    store = _store_at(spec, genesis, signed.message.slot)
+    pipe = AdmissionPipeline(spec, store, GossipConfig(), ManualClock())
+    for att in atts:
+        pipe.submit("attestation", att, peer="p1")
+    pipe.submit("block", bad, peer="p1")
+    results = pipe.drain()
+    by_topic = {r.topic: r.status for r in results}
+    assert by_topic["block"] == "rejected"
+    assert by_topic["attestation"] == "accepted"
+    assert hash_tree_root(bad.message) not in store.blocks
+    oracle_store, oracle_verdicts = _oracle_replay(
+        spec, genesis, signed.message.slot, pipe)
+    assert [r.status == "accepted" for r in pipe.verdicts()] \
+        == [ok for ok, _ in oracle_verdicts]
+    assert store_fingerprint(spec, store) == store_fingerprint(
+        spec, oracle_store)

@@ -209,10 +209,13 @@ def test_bisection_isolates_arbitrary_patterns():
 
 def test_fused_batch_of_one_invalid_set_costs_no_bisect_dispatch():
     """A single-set batch that fails IS the isolated failure: the
-    splitter must name it without any extra dispatch."""
+    splitter is never entered.  Since the fused product is built from
+    device-weighted points, condemning it still takes exactly one
+    host-ladder re-check (a corrupt sweep must not flip the verdict) —
+    one product dispatch plus one probe, zero bisect dispatches."""
     verdicts = scheduler.verify_sets(_single_sets(1, {0}), mode="fused")
     assert verdicts == [False]
-    assert METRICS.count("dispatches") == 1
+    assert METRICS.count("dispatches") == 2
     assert METRICS.count("bisect_dispatches") == 0
     assert METRICS.count("fused_batch_failures") == 1
 
@@ -629,3 +632,271 @@ def test_whisk_block_pipeline(phase0_spec):
     kinds = {s.kind for s in sigpipe.collect_block_sets(
         spec, advanced, signed)}
     assert kinds == {"proposer", "randao"}
+
+
+# ---------------------------------------------------------------------------
+# device G1 sweep (PR 5): batched aggregation + coefficient-weighted MSM
+# ---------------------------------------------------------------------------
+# The jax engines are kernel-tier (tests/test_g1_sweep.py); these pin
+# the oracle-engine parity, the dispatch seams, and the metrics
+# contract at tier-1 speed.
+
+from consensus_specs_tpu import resilience  # noqa: E402
+from consensus_specs_tpu.crypto import curve as cv  # noqa: E402
+from consensus_specs_tpu.ops import g1_sweep  # noqa: E402
+from consensus_specs_tpu.ops import msm as ops_msm  # noqa: E402
+from consensus_specs_tpu.resilience import (  # noqa: E402
+    FaultPlan, FaultSpec, INCIDENTS, faults)
+from consensus_specs_tpu.sigpipe.cache import AGGREGATES, PUBKEYS  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _resilience_reset():
+    resilience.disable()
+    INCIDENTS.clear()
+    yield
+    resilience.disable()
+    INCIDENTS.clear()
+
+
+def _committee_sets(n, committee, bad_indices, tag=0):
+    """Multi-pubkey SignatureSets (one committee aggregate each), wrong
+    signers injected at `bad_indices`."""
+    pk_lists, messages, signatures = _fast_aggregate_jobs(
+        n_jobs=n, committee=committee, bad_indices=bad_indices)
+    return [SignatureSet(
+        pubkeys=tuple(bytes(pk) for pk in pks), signing_root=m,
+        signature=bytes(s), kind="test", origin=("sweep", tag, i))
+        for i, (pks, m, s) in enumerate(
+            zip(pk_lists, messages, signatures))]
+
+
+def _points(ids):
+    return [cv.g1_generator() * (7 + i) for i in ids]
+
+
+def test_g1_add_sweep_edge_cases_match_sequential_sum():
+    """Ragged edge cases through the sweep: empty input, empty segment,
+    single point, identity points inside a segment, non-power-of-two
+    segment count and lengths — each sum equals the sequential oracle."""
+    assert g1_sweep.g1_add_sweep([]) == []
+    p, q, r = _points([1, 2, 3])
+    inf = cv.g1_infinity()
+    lists = [[], [p], [p, -p], [inf, q, inf], [p, q, r], [q] * 5]
+    got = g1_sweep.g1_add_sweep(lists)
+    expected = []
+    for pts in lists:
+        acc = cv.g1_infinity()
+        for pt in pts:
+            acc = acc + pt
+        expected.append(acc)
+    assert got == expected
+    assert got[0].is_infinity() and got[2].is_infinity()
+
+
+def test_g1_weighted_sweep_matches_host_ladder():
+    """Per-pair weighted points equal the host double-and-add, including
+    coeff 0 / 1, the identity point, and a non-power-of-two batch."""
+    p, q, r = _points([4, 5, 6])
+    pts = [p, q, cv.g1_infinity(), r, p]
+    coeffs = [0, 1, (1 << 64) - 1, 0xDEADBEEF, 2]
+    got = ops_msm.g1_weighted_sweep(pts, coeffs)
+    assert got == [pt * c for pt, c in zip(pts, coeffs)]
+    assert got[0].is_infinity() and got[2].is_infinity()
+    assert ops_msm.g1_weighted_sweep([], []) == []
+    with pytest.raises(ValueError):
+        ops_msm.g1_weighted_sweep([p], [1, 2])
+
+
+def test_g1_multi_exp_empty_and_mismatch():
+    assert ops_msm.g1_multi_exp([], []).is_infinity()
+    with pytest.raises(ValueError):
+        ops_msm.g1_multi_exp(_points([1]), [1, 2])
+
+
+def test_aggregate_many_isolates_decode_failures():
+    """One undecodable pubkey fails only its own job (None), exactly
+    like aggregate()'s DecodeError — the rest of the batch still sums,
+    in ONE batched dispatch."""
+    cache.clear()
+    METRICS.reset()
+    good = [bytes(pubkeys[i]) for i in range(3)]
+    jobs = [(tuple(good[:2]), None),
+            ((b"\xff" * 48,), None),            # undecodable
+            (tuple(good), None),
+            (tuple(good[:2]), None)]            # duplicate of job 0
+    results = AGGREGATES.aggregate_many(jobs)
+    assert results[1] is None
+    assert results[0] is not None and results[0] == results[3]
+    assert results[2] is not None
+    assert METRICS.count("g1_aggregate_dispatches") == 1
+    with pytest.raises(Exception):
+        AGGREGATES.aggregate([b"\xff" * 48])
+
+
+def test_fused_flush_is_two_batched_dispatches():
+    """THE acceptance pin at scheduler level: one flush of committee
+    sets = one aggregation dispatch + one weighted-MSM dispatch + one
+    pairing dispatch, and ZERO host point adds on the device path."""
+    cache.clear()
+    METRICS.reset()
+    sets = _committee_sets(3, committee=2, bad_indices=set())
+    verdicts = scheduler.verify_sets(sets, mode="fused")
+    assert verdicts == [True] * 3
+    snapshot = METRICS.snapshot()
+    assert snapshot["g1_aggregate_dispatches"] == 1
+    assert snapshot["msm_dispatches"] == 1
+    assert snapshot["dispatches"] == 1
+    assert snapshot.get("host_point_adds", 0) == 0
+
+
+def test_fused_parity_device_sweep_on_vs_host_fallback():
+    """Flush verdicts are byte-identical with the device sweep on and
+    with both ops sites forced to the host fallback (kill switch); the
+    host leg visibly pays the per-set adds the sweep eliminates."""
+    sets = _committee_sets(4, committee=2, bad_indices={2}, tag=1)
+    cache.clear()
+    METRICS.reset()
+    device_verdicts = scheduler.verify_sets(sets, mode="fused")
+    # the bad set makes bisection pay its (host-laddered) probes even on
+    # the device path — but only those; the flush itself stays batched
+    device_adds = METRICS.count("host_point_adds")
+    assert METRICS.count("g1_aggregate_dispatches") == 1
+    assert METRICS.count("msm_dispatches") == 1
+
+    cache.clear()
+    METRICS.reset()
+    resilience.enable().force_scalar(True)
+    try:
+        host_verdicts = scheduler.verify_sets(sets, mode="fused")
+    finally:
+        resilience.disable()
+    assert device_verdicts == host_verdicts == [True, True, False, True]
+    snapshot = METRICS.snapshot()
+    assert snapshot["host_point_adds"] > device_adds
+    assert snapshot["scalar_fallbacks"]["disabled"] >= 2
+
+
+@pytest.mark.parametrize("site", ["ops.g1_aggregate", "ops.msm"])
+def test_fused_verdicts_survive_injected_ops_faults(site):
+    """A persistent raise at either new dispatch site trips the breaker
+    to the host path: verdicts (including bisection isolation of a bad
+    set) are unchanged, the fallback adds are counted, and every
+    injected fault is visible."""
+    sets = _committee_sets(4, committee=2, bad_indices={1}, tag=2)
+    cache.clear()
+    METRICS.reset()
+    clean = scheduler.verify_sets(sets, mode="fused")
+
+    cache.clear()
+    METRICS.reset()
+    resilience.enable(max_retries=0, breaker_threshold=1, probe_after=99)
+    plan = FaultPlan([FaultSpec(site, "raise", persistent=True)])
+    try:
+        with faults.inject(plan):
+            faulted = scheduler.verify_sets(sets, mode="fused")
+    finally:
+        sup = resilience.supervisor.active()
+        state_after = sup.breaker_state(site) if sup else None
+        resilience.disable()
+    assert faulted == clean == [True, False, True, True]
+    assert state_after == "open"
+    snapshot = METRICS.snapshot()
+    assert snapshot["host_point_adds"] > 0
+    assert plan.total_fires() >= 1
+    assert snapshot.get("faults_injected", 0) == plan.total_fires()
+    assert INCIDENTS.count(event="injected") == plan.total_fires()
+
+
+def test_corrupt_device_weighting_cannot_flip_verdicts(monkeypatch):
+    """A lying ops.msm sweep (garbage weighted points) fails the fused
+    product, but bisection re-derives every probe on the HOST ladder —
+    so the verdicts still come out right, for valid and invalid sets
+    alike."""
+    sets = _committee_sets(3, committee=2, bad_indices={2}, tag=3)
+    cache.clear()
+    METRICS.reset()
+    monkeypatch.setattr(
+        ops_msm, "g1_weighted_sweep",
+        lambda points, scalars: [cv.g1_generator() * (3 + i)
+                                 for i in range(len(points))])
+    verdicts = scheduler.verify_sets(sets, mode="fused")
+    assert verdicts == [True, True, False]
+    assert METRICS.count("fused_batch_failures") == 1
+    assert METRICS.count("host_point_adds") > 0   # bisection's ladder
+
+
+def test_corrupt_sweep_cannot_flip_a_single_set_flush(monkeypatch):
+    """The bisection contract condemns a singleton without re-probing,
+    so a ONE-set flush whose product failed only because the device
+    sweep lied must be re-checked on the host ladder — a valid set
+    keeps True, an invalid one keeps False."""
+    monkeypatch.setattr(
+        ops_msm, "g1_weighted_sweep",
+        lambda points, scalars: [cv.g1_generator() * (3 + i)
+                                 for i in range(len(points))])
+    for bad in (set(), {0}):
+        sets = _committee_sets(1, committee=2, bad_indices=bad, tag=6)
+        cache.clear()
+        METRICS.reset()
+        verdicts = scheduler.verify_sets(sets, mode="fused")
+        assert verdicts == [not bad]
+        assert METRICS.count("fused_batch_failures") == 1
+        assert METRICS.count("host_point_adds") > 0   # the host re-check
+
+
+def test_identity_corrupting_device_sweep_is_caught_by_guard(monkeypatch):
+    """The one corruption bisection cannot see — an all-identity sweep
+    makes the product trivially pass — is exactly what the differential
+    guard exists for: with the guard armed, the mismatch quarantines the
+    backend and every verdict is recomputed on the scalar oracle."""
+    sets = _committee_sets(3, committee=2, bad_indices={2}, tag=5)
+    cache.clear()
+    METRICS.reset()
+    monkeypatch.setattr(
+        ops_msm, "g1_weighted_sweep",
+        lambda points, scalars: [cv.g1_infinity()] * len(points))
+    resilience.enable(guard_sample_rate=1.0, guard_seed=7)
+    try:
+        verdicts = scheduler.verify_sets(sets, mode="fused")
+    finally:
+        resilience.disable()
+    assert verdicts == [True, True, False]
+    assert METRICS.count_labeled("scalar_fallbacks",
+                                 "guard_mismatch") >= 1
+
+
+def test_per_set_multis_ride_batched_aggregation():
+    """Per-set mode's multi-pubkey leg: committee sums come from ONE
+    aggregation dispatch, the batch API receives pre-aggregated points,
+    and verdicts match the fused mode and the scalar oracle."""
+    sets = _committee_sets(3, committee=3, bad_indices={0}, tag=4)
+    cache.clear()
+    METRICS.reset()
+    per_set = scheduler.verify_sets(sets, mode="per-set")
+    assert METRICS.count("g1_aggregate_dispatches") == 1
+    assert METRICS.count("host_point_adds") == 0
+    scalar = [bls.FastAggregateVerify(list(s.pubkeys), s.signing_root,
+                                      s.signature) for s in sets]
+    cache.clear()
+    fused = scheduler.verify_sets(sets, mode="fused")
+    assert per_set == fused == scalar == [False, True, True]
+
+
+def test_identity_aggregate_keeps_original_pubkey_list():
+    """A pubkey list summing to the identity must reach the batch API
+    undisturbed (parity with the scalar check), never as a substituted
+    compressed-infinity pubkey the decoder would reject."""
+    from consensus_specs_tpu.crypto.bls12_381 import G1_to_bytes48
+    point = cv.g1_generator() * 1234
+    pk = G1_to_bytes48(point)
+    pk_neg = G1_to_bytes48(-point)
+    msg = _signing_root(99)
+    sig = bls.Sign(privkeys[0], msg)
+    s = SignatureSet(pubkeys=(pk, pk_neg), signing_root=msg,
+                     signature=bytes(sig), kind="identity")
+    cache.clear()
+    scalar = bls.FastAggregateVerify([pk, pk_neg], msg, sig)
+    assert scheduler.verify_sets([s], mode="per-set") == [scalar]
+    cache.clear()
+    assert scheduler.verify_sets([s], mode="fused") == [scalar]
