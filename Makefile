@@ -13,8 +13,8 @@ RUNNERS := shuffling ssz_static operations epoch_processing sanity bls \
 	forks merkle_proof networking kzg_7594 random light_client sync
 
 .PHONY: test test-quick test-kernels tier1 chaos recovery-chaos lint \
-	native pyspec bench gossip-bench txn-bench msm-bench gen_all \
-	detect_errors $(addprefix gen_,$(RUNNERS))
+	native pyspec bench gossip-bench txn-bench msm-bench merkle-bench \
+	gen_all detect_errors $(addprefix gen_,$(RUNNERS))
 
 # syntax/bytecode check over every package and script (the CI lint job)
 lint:
@@ -35,7 +35,8 @@ test-quick:
 	$(PYTHON) -m pytest tests/spec_suites tests/test_ssz.py \
 		tests/test_phase0_sanity.py tests/test_epoch_fast.py \
 		tests/test_sigpipe.py tests/test_resilience.py \
-		tests/test_gossip.py tests/test_txn.py -q
+		tests/test_gossip.py tests/test_txn.py \
+		tests/test_merkle_inc.py -q
 
 # the exact ROADMAP.md tier-1 verify command (what the driver runs);
 # DOTS_PASSED counts green dots from the -q progress lines
@@ -92,6 +93,13 @@ txn-bench:
 # BENCH_MSM_MSGS=8 give an accelerator-less smoke run
 msm-bench:
 	$(PYTHON) bench.py msm
+
+# incremental merkleization alone (ssz/incremental.py): asserts a
+# block-shaped re-root hashes O(diff . log state) chunks (not O(state))
+# in one ssz.merkle_sweep dispatch, byte-identical to the forced
+# full-rebuild path; BENCH_MERKLE_VALIDATORS=N resizes the state
+merkle-bench:
+	$(PYTHON) bench.py merkle_inc
 
 # static pattern rule: GNU make refuses to run implicit pattern rules
 # for .PHONY targets

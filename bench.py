@@ -8,6 +8,8 @@ SIGALRM budget — a slow tier degrades the report instead of killing it
 
 Tiers (cheap -> expensive; the most valuable completed tier wins stdout):
   merkle        SSZ merkleization: 1M-chunk hash_tree_root sweep on device
+  merkle_inc    incremental merkleization: block-shaped diff re-roots a
+                mainnet-shaped state in O(diff . log state) hashed chunks
   epoch         mainnet-preset vectorized epoch processing (validator axis)
   attestations  flagship: batched FastAggregateVerify — 32 attestations x
                 128-pubkey committees through the TPU pairing kernels
@@ -179,6 +181,96 @@ def bench_merkle(depth: int = 20, sample_baseline_depth: int = 14):
         "value": round(total_hashes / tpu_time, 1),
         "unit": "sha256_2to1/s",
         "vs_baseline": round(cpu_time / tpu_time, 2),
+    }
+
+
+# ---------------------------------------------------------------------------
+# tier: incremental merkleization (ssz/incremental.py) — diff-sized re-roots
+# ---------------------------------------------------------------------------
+
+MERKLE_INC_VALIDATORS = int(
+    os.environ.get("BENCH_MERKLE_VALIDATORS", 1 << 14))
+MERKLE_INC_BLOCKS = int(os.environ.get("BENCH_MERKLE_BLOCKS", "8"))
+
+
+def bench_merkle_inc():
+    """Incremental merkleization acceptance pin: on a mainnet-shaped
+    BeaconState, a block-shaped diff (slot advance + a committee's worth
+    of balance/participation credits + one randao mix) must re-root by
+    hashing O(diff · log state) chunks — a small fraction of the full
+    chunk tree — in ONE `ssz.merkle_sweep` dispatch, byte-identical to
+    the forced full-rebuild oracle.  Pure planner/hashlib measurement:
+    no device dependency (the kernel path is pinned by
+    tests/test_merkle_sweep_jax.py)."""
+    import random as _random
+
+    from consensus_specs_tpu.sigpipe import METRICS
+    from consensus_specs_tpu.specs import get_spec
+    from consensus_specs_tpu.ssz import incremental, uint64
+
+    t_start = time.perf_counter()
+
+    def mark(msg):
+        log(f"[bench] merkle_inc +{time.perf_counter() - t_start:5.1f}s: "
+            f"{msg}")
+
+    n = MERKLE_INC_VALIDATORS
+    spec = get_spec("altair", "mainnet")
+    mark(f"building {n}-validator mainnet-preset state ...")
+    state = _epoch_state(spec, n)
+
+    incremental.enable()
+    try:
+        METRICS.reset()
+        incremental.track(state)
+        t0 = time.perf_counter()
+        bytes(state.hash_tree_root())
+        build_time = time.perf_counter() - t0
+        total_chunks = METRICS.count("merkle_chunks_hashed")
+        mark(f"cache build: {total_chunks} chunks hashed "
+             f"in {build_time:.2f} s")
+
+        rng = _random.Random(42)
+        inc_time = 0.0
+        diff_chunks = []
+        inc_root = None
+        for b in range(MERKLE_INC_BLOCKS):
+            state.slot = uint64(int(state.slot) + 1)
+            for _ in range(COMMITTEE):
+                i = rng.randrange(n)
+                state.balances[i] = uint64(int(state.balances[i]) + 1)
+                state.current_epoch_participation[i] = 7
+            state.randao_mixes[b] = bytes([b + 1]) * 32
+            METRICS.reset()
+            t0 = time.perf_counter()
+            inc_root = bytes(state.hash_tree_root())
+            inc_time += time.perf_counter() - t0
+            assert METRICS.count("merkle_sweep_dispatches") == 1, \
+                "block re-root must be ONE ssz.merkle_sweep dispatch"
+            diff_chunks.append(METRICS.count("merkle_chunks_hashed"))
+
+        # byte-identical to the full-rebuild path (cache bypassed)
+        t0 = time.perf_counter()
+        full_root = incremental.oracle_root(state)
+        full_time = time.perf_counter() - t0
+        assert inc_root == full_root, "incremental root != full rebuild"
+    finally:
+        incremental.disable()
+
+    worst = max(diff_chunks)
+    avg_inc = inc_time / MERKLE_INC_BLOCKS
+    mark(f"per-block re-root: worst {worst}/{total_chunks} chunks, "
+         f"avg {avg_inc * 1000:.1f} ms vs full rebuild "
+         f"{full_time * 1000:.1f} ms")
+    # re-root cost scales with the diff, not the state
+    assert worst * 20 <= total_chunks, \
+        f"diff sweep hashed {worst} of {total_chunks} chunks (>5%)"
+    return {
+        "metric": "merkle_inc_block_reroot_speedup",
+        "value": round(full_time / avg_inc, 1),
+        "unit": (f"x vs full re-root ({worst}/{total_chunks} chunks "
+                 f"worst block, {n} validators)"),
+        "vs_baseline": round(full_time / avg_inc, 1),
     }
 
 
@@ -1201,6 +1293,9 @@ def bench_north_star():
 # would exhaust it); the remaining tiers fill whatever budget is left
 TIERS = {
     "merkle": (bench_merkle, 150),
+    # incremental merkleization (ssz/incremental.py): pure host-side
+    # planner measurement, no device dependency
+    "merkle_inc": (bench_merkle_inc, 240),
     "north_star": (bench_north_star, 500),
     "attestations": (bench_attestations, 420),
     # genesis build + block signing dominate; the timed dispatch is one
@@ -1230,7 +1325,8 @@ TIERS = {
 # rotation, attestations/kzg/epoch/transition would never get a
 # driver-verified number (VERDICT r4 weakness #8)
 _ROTATING = ["north_star", "attestations", "block_sigs", "kzg", "epoch",
-             "transition", "degraded", "gossip", "txn", "msm"]
+             "transition", "degraded", "gossip", "txn", "msm",
+             "merkle_inc"]
 
 
 def _round_index() -> int:
@@ -1331,7 +1427,7 @@ def main():
     # most valuable completed tier wins the stdout line, by value rank
     # (rotation changes which tiers RUN, not which result headlines)
     rank = ["north_star", "attestations", "block_sigs", "gossip", "kzg",
-            "transition", "epoch", "degraded", "merkle"]
+            "transition", "epoch", "degraded", "merkle_inc", "merkle"]
     for name in rank:
         if name in results:
             print(json.dumps(results[name]))

@@ -160,6 +160,19 @@ def hash_level_jax(data: bytes) -> bytes:
     return words_to_bytes(jax.device_get(out))
 
 
+def hash_level_ragged(data: bytes) -> bytes:
+    """Batched-level interface for the incremental merkle sweep
+    (ssz/incremental.py): one RAGGED level of dirty-node pairs — an
+    arbitrary, non-power-of-two number of independent 64-byte parent
+    computations gathered from many subtrees — hashed in one device
+    call.  hash_pairs' power-of-two bucket padding absorbs the ragged
+    batch size, so every level of a sweep reuses one cached kernel per
+    size bucket instead of compiling per distinct dirty-set shape.
+    This is the bulk hasher `merkle.use_tpu_hashing()` installs (the
+    legacy full-rebuild path rides the same contract)."""
+    return hash_level_jax(data)
+
+
 def merkle_root_jax(chunks: bytes) -> bytes:
     """Device-resident merkle root of a power-of-two chunk array."""
     words = bytes_to_words(chunks)

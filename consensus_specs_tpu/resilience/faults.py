@@ -67,11 +67,21 @@ def _is_bool(v) -> bool:
     return isinstance(v, bool) or type(v).__name__ == "bool_"  # np.bool_
 
 
-def _flip_verdict(result, rng: random.Random):
-    """Corrupt a verdict-shaped result: flip a bool, or one element of a
-    list of bools.  Non-verdict payloads pass through unchanged (the
-    harness only models verdict corruption — a corrupted point batch
-    surfaces as a False product, which the `raise` path already covers)."""
+# sites whose dispatch result is a root digest guarded by a
+# differential oracle check — ONLY these get bytes corruption (a bytes
+# result at an unguarded site, e.g. ops.sha256.hash_level, has no
+# quarantine path, so corrupting it would just break the byte-identical
+# invariant instead of modeling a catchable silent fault)
+_DIGEST_GUARDED_SITES = frozenset({"ssz.merkle_sweep"})
+
+
+def _flip_verdict(result, rng: random.Random, site: str | None = None):
+    """Corrupt a verdict-shaped result: flip a bool, one element of a
+    list of bools, or — at digest-guarded sites only — one bit of a
+    bytes root (the silent corruption only the differential guard can
+    catch).  Other payloads pass through unchanged (a corrupted point
+    batch surfaces as a False product, which the `raise` path already
+    covers)."""
     if _is_bool(result):
         return not bool(result)
     if isinstance(result, list) and result and all(
@@ -80,6 +90,12 @@ def _flip_verdict(result, rng: random.Random):
         j = rng.randrange(len(out))
         out[j] = not out[j]
         return out
+    if (site in _DIGEST_GUARDED_SITES
+            and isinstance(result, (bytes, bytearray)) and result):
+        out = bytearray(result)
+        j = rng.randrange(len(out))
+        out[j] ^= 1 << rng.randrange(8)
+        return bytes(out)
     return result
 
 
@@ -141,7 +157,7 @@ class FaultPlan:
                 time.sleep(spec.sleep_s)
                 return fn()
             # corrupt: silently flip the verdict
-            return _flip_verdict(fn(), self._rng)
+            return _flip_verdict(fn(), self._rng, site)
         return faulty
 
     def total_fires(self) -> int:

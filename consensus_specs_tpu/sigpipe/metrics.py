@@ -28,6 +28,20 @@ device path is healthy, which is what `make msm-bench` and the sweep
 tests pin.  All three ride the ordinary counter path and land in the
 JSON dump.
 
+Incremental merkleization (ssz/incremental.py) reports here too, so one
+snapshot covers the whole per-block device story: `merkle_sweep_dispatches`
+(one `ssz.merkle_sweep` dispatch per re-rooted tracked view),
+`merkle_sweep_levels` (ragged batched level-calls inside those sweeps —
+bounded by the state tree height), `merkle_chunks_hashed` (2-to-1 hashes
+the sweeps performed — O(diff · log state), the number the merkle bench
+asserts scales with diff size), `merkle_dirty_nodes` (dirty leaf chunks
+swept) with the power-of-two `merkle_dirty_occupancy` histogram,
+`merkle_cache_builds` (first full builds of a tracked view),
+`merkle_full_rebuilds` (legacy full re-roots taken as the sweep-site
+fallback), `merkle_cached_roots` (re-roots answered from cache with no
+hashing), and `merkle_guard_samples` / `merkle_guard_mismatches` for the
+differential guard.
+
 Histograms (`observe_hist`) bucket integer observations by
 power-of-two: the gossip admission layer records batch occupancy per
 flush here (`batch_occupancy`: how many signature sets each dispatch

@@ -18,6 +18,7 @@ from ..ssz import (
     Vector, List, Container, Bytes4, Bytes32, Bytes48, Bytes96,
     hash_tree_root, serialize, uint_to_bytes,
 )
+from ..ssz import incremental as ssz_incremental
 from ..ssz.merkle import is_valid_merkle_branch as _merkle_branch_ok
 from ..utils import bls
 from ..utils.hash import hash as sha256_hash
@@ -642,6 +643,11 @@ class Phase0Spec(Phase0ForkChoice, Phase0ValidatorDuties, BaseSpec):
     # ------------------------------------------------------------------
     def state_transition(self, state, signed_block,
                          validate_result: bool = True) -> None:
+        # opt-in incremental merkleization (ssz/incremental.py): track
+        # the hot state so every hash_tree_root below re-hashes only the
+        # block's dirty cone in one ssz.merkle_sweep dispatch (no-op
+        # while the mode is disabled)
+        ssz_incremental.track(state)
         block = signed_block.message
         self.process_slots(state, block.slot)
         # opt-in deferred signature pipeline: precompute one batch verdict
@@ -665,6 +671,7 @@ class Phase0Spec(Phase0ForkChoice, Phase0ValidatorDuties, BaseSpec):
 
     def process_slots(self, state, slot) -> None:
         assert state.slot < slot
+        ssz_incremental.track(state)
         while state.slot < slot:
             self.process_slot(state)
             if (state.slot + 1) % self.SLOTS_PER_EPOCH == 0:

@@ -19,3 +19,4 @@ from .merkle import (  # noqa: F401
     merkleize_chunks, mix_in_length, get_merkle_proof, is_valid_merkle_branch,
     ZERO_HASHES,
 )
+from . import incremental  # noqa: F401  (dirty-subtree hash_tree_root)

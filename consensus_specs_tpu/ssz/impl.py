@@ -34,6 +34,9 @@ def serialize(obj: SSZType) -> bytes:
 
 
 def hash_tree_root(obj) -> bytes:
+    # composite views route through ssz/incremental.py's dirty-subtree
+    # cache when that mode is enabled and the view is tracked; the
+    # legacy full chunk rebuild otherwise (byte-identical either way)
     from .types import Bytes32
     return Bytes32(obj.hash_tree_root())
 

@@ -89,8 +89,10 @@ def use_tpu_hashing(threshold: int = 2048, pallas: bool = False) -> None:
         from ..ops.sha256_pallas import hash_level_pallas
         set_bulk_level_hasher(hash_level_pallas, threshold)
     else:
-        from ..ops.sha256 import hash_level_jax
-        set_bulk_level_hasher(hash_level_jax, threshold)
+        # hash_level_ragged: same kernel, ragged-batch contract — the
+        # incremental sweep's per-round levels are arbitrary-size
+        from ..ops.sha256 import hash_level_ragged
+        set_bulk_level_hasher(hash_level_ragged, threshold)
 
 
 def use_host_hashing() -> None:

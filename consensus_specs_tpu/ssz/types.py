@@ -18,6 +18,26 @@ from .merkle import (
 
 BYTES_PER_CHUNK = 32
 
+# Incremental-merkleization seam (ssz/incremental.py).  While that mode
+# is enabled it installs `_inc_root_hook` (view -> cached/swept root, or
+# None to fall through to the legacy full computation) and `_inc_mut`
+# (the mutation-hook table that keeps dirty-chunk tracking current).
+# Both are None when disabled: the only overhead on the legacy path is
+# one global check per call.
+_inc_root_hook = None
+_inc_mut = None
+
+
+def _htr(view) -> bytes:
+    """Composite hash_tree_root entry: incremental when tracked, legacy
+    full chunk rebuild (`_htr_full`) otherwise."""
+    hook = _inc_root_hook
+    if hook is not None:
+        root = hook(view)
+        if root is not None:
+            return root
+    return view._htr_full()
+
 
 class SSZType:
     """Base for all SSZ views.  Class-level descriptors double as types."""
@@ -388,6 +408,8 @@ class Bits(SSZType):
 
     def __setitem__(self, i, v):
         self._bits[i] = bool(v)
+        if _inc_mut is not None:
+            _inc_mut.on_bits_set(self, i)
 
     def copy(self):
         return _structural_copy(self)
@@ -456,6 +478,9 @@ class Bitvector(Bits, metaclass=ParamMeta):
         return cls(bits)
 
     def hash_tree_root(self):
+        return _htr(self)
+
+    def _htr_full(self):
         chunks = _bytes_to_chunks(self._pack_bits())
         limit = (self.LENGTH + 255) // 256
         return merkleize_chunks(chunks, limit=limit)
@@ -490,6 +515,8 @@ class Bitlist(Bits, metaclass=ParamMeta):
         if len(self._bits) >= self.LIMIT:
             raise ValueError("bitlist full")
         self._bits.append(bool(v))
+        if _inc_mut is not None:
+            _inc_mut.on_bits_append(self)
 
     def serialize(self):
         # delimiter bit marks the length
@@ -516,6 +543,9 @@ class Bitlist(Bits, metaclass=ParamMeta):
         return cls(bits)
 
     def hash_tree_root(self):
+        return _htr(self)
+
+    def _htr_full(self):
         chunks = _bytes_to_chunks(self._pack_bits())
         limit = (self.LIMIT + 255) // 256
         return mix_in_length(merkleize_chunks(chunks, limit=limit), len(self._bits))
@@ -562,7 +592,15 @@ class _Sequence(SSZType):
         return self._elems[i]
 
     def __setitem__(self, i, v):
-        self._elems[i] = self.ELEM_TYPE.coerce_assign(v)
+        # slice assignment is unsupported either way: coerce_assign
+        # raises on a non-element value before the store happens
+        coerced = self.ELEM_TYPE.coerce_assign(v)
+        if _inc_mut is None:
+            self._elems[i] = coerced
+        else:
+            old = self._elems[i]
+            self._elems[i] = coerced
+            _inc_mut.on_seq_set(self, i, old, coerced)
 
     def index(self, v):
         return self._elems.index(self.ELEM_TYPE.coerce(v))
@@ -683,6 +721,9 @@ class Vector(_Sequence, metaclass=ParamMeta):
         return cls._from_elems(elems)
 
     def hash_tree_root(self):
+        return _htr(self)
+
+    def _htr_full(self):
         if is_basic_type(self.ELEM_TYPE):
             return merkleize_chunks(self._elem_chunks())
         return merkleize_chunks(self._elem_chunks(), limit=self.LENGTH)
@@ -719,9 +760,16 @@ class List(_Sequence, metaclass=ParamMeta):
         if len(self._elems) >= self.LIMIT:
             raise ValueError("list full")
         self._elems.append(self.ELEM_TYPE.coerce_assign(v))
+        if _inc_mut is not None:
+            _inc_mut.on_seq_append(self)
 
     def pop(self, i=-1):
-        return self._elems.pop(i)
+        if _inc_mut is None:
+            return self._elems.pop(i)
+        old_len = len(self._elems)
+        v = self._elems.pop(i)
+        _inc_mut.on_seq_pop(self, v, i if i >= 0 else i + old_len, old_len)
+        return v
 
     def serialize(self):
         return self._serialize_elems()
@@ -735,6 +783,9 @@ class List(_Sequence, metaclass=ParamMeta):
         return cls._from_elems(elems)
 
     def hash_tree_root(self):
+        return _htr(self)
+
+    def _htr_full(self):
         if is_basic_type(self.ELEM_TYPE):
             elem_len = self.ELEM_TYPE.type_byte_length()
             limit = (self.LIMIT * elem_len + 31) // 32
@@ -824,7 +875,13 @@ class Container(SSZType):
     def __setattr__(self, name, value):
         if name in self._field_names:
             idx = self._field_names.index(name)
-            self._values[name] = self._field_types[idx].coerce_assign(value)
+            coerced = self._field_types[idx].coerce_assign(value)
+            if _inc_mut is None:
+                self._values[name] = coerced
+            else:
+                old = self._values[name]
+                self._values[name] = coerced
+                _inc_mut.on_container_set(self, idx, old, coerced)
         else:
             object.__setattr__(self, name, value)
 
@@ -900,9 +957,12 @@ class Container(SSZType):
         return _structural_copy(self)
 
     def hash_tree_root(self) -> bytes:
+        if not self._field_names:
+            return merkleize_chunks([ZERO_CHUNK])
+        return _htr(self)
+
+    def _htr_full(self) -> bytes:
         chunks = [self._values[n].hash_tree_root() for n in self._field_names]
-        if not chunks:
-            chunks = [ZERO_CHUNK]
         return merkleize_chunks(chunks)
 
     @classmethod
@@ -945,6 +1005,12 @@ class Union(SSZType, metaclass=ParamMeta):
         self.selector = selector
         self.value = value
 
+    def __setattr__(self, name, value):
+        old = self.__dict__.get("value") if name == "value" else None
+        object.__setattr__(self, name, value)
+        if _inc_mut is not None and name in ("selector", "value"):
+            _inc_mut.on_union_set(self, old)
+
     @classmethod
     def is_fixed_size(cls):
         return False
@@ -976,6 +1042,9 @@ class Union(SSZType, metaclass=ParamMeta):
         return _structural_copy(self)
 
     def hash_tree_root(self):
+        return _htr(self)
+
+    def _htr_full(self):
         root = ZERO_CHUNK if self.value is None else self.value.hash_tree_root()
         return mix_in_selector(root, self.selector)
 
@@ -1011,13 +1080,20 @@ def _structural_copy(v):
     SSZType.copy(): rebuild the object graph, sharing immutable leaves
     (uints/bytes) and recursing only through mutable views.  This is the
     hot path of coerce_assign — every composite assignment/append pays
-    it."""
+    it.
+
+    When the source carries an incremental-merkleization cache, the copy
+    shares it copy-on-write (ssz/incremental.on_copy): the level arrays
+    are shared until either side's next sweep needs to write, so a
+    transactional state copy costs no re-hashing."""
     if isinstance(v, _Sequence):
         t = v.ELEM_TYPE
         if is_basic_type(t) or not issubclass(t, _MUTABLE_VIEW_BASES):
-            return type(v)._from_elems(list(v._elems))
-        return type(v)._from_elems([_structural_copy(e) for e in v._elems])
-    if isinstance(v, Container):
+            obj = type(v)._from_elems(list(v._elems))
+        else:
+            obj = type(v)._from_elems(
+                [_structural_copy(e) for e in v._elems])
+    elif isinstance(v, Container):
         values = {}
         for name in v._field_names:
             f = v._values[name]
@@ -1025,17 +1101,18 @@ def _structural_copy(v):
                             if isinstance(f, _MUTABLE_VIEW_BASES) else f)
         obj = type(v).__new__(type(v))
         object.__setattr__(obj, "_values", values)
-        return obj
-    if isinstance(v, Bits):
+    elif isinstance(v, Bits):
         obj = type(v).__new__(type(v))
         obj._bits = list(v._bits)
-        return obj
-    if isinstance(v, Union):
+    elif isinstance(v, Union):
         val = v.value
         if isinstance(val, _MUTABLE_VIEW_BASES):
             val = _structural_copy(val)
         obj = type(v).__new__(type(v))
         obj.selector = v.selector
         obj.value = val
-        return obj
-    raise TypeError(f"not a composite view: {type(v).__name__}")
+    else:
+        raise TypeError(f"not a composite view: {type(v).__name__}")
+    if _inc_mut is not None:
+        _inc_mut.on_copy(v, obj)
+    return obj

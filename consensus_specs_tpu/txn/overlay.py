@@ -33,6 +33,16 @@ already in the store — states are ``.copy()``'d before
 (ptc_vote) are the single in-place-mutable value family, and both the
 overlay and the clone copy them.
 
+The same contract is what keeps incremental merkleization
+(ssz/incremental.py) transactional for free: a state's ``.copy()``
+shares its merkle cache copy-on-write, so the mutations a handler makes
+inside a transaction dirty only the copy's private dirty set and cloned
+level arrays.  Commit inserts the copy (cache and all) as a new store
+value; rollback drops it — either way the base state's cache is never
+written, so an aborted handler can neither corrupt a cached chunk tree
+nor leak dirty marks into the committed store (pinned by
+tests/test_merkle_inc.py's txn interaction tests).
+
 Every overlay mutation consults the fault plan at the ``txn.mutate``
 barrier site (resilience/faults.py `fire`), which is what gives the
 chaos tier its "crash anywhere mid-handler" granularity: a seeded raise

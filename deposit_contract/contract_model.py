@@ -27,9 +27,12 @@ def _le64(value: int) -> bytes:
     return int(value).to_bytes(8, "little")
 
 
-ZERO_HASHES = [b"\x00" * 32]
-for _ in range(TREE_DEPTH - 1):
-    ZERO_HASHES.append(_sha256(ZERO_HASHES[-1] + ZERO_HASHES[-1]))
+# canonical zero-subtree ladder: shared with ssz/merkle.py (one source
+# of truth, pinned by tests/test_merkle_inc.py) instead of rebuilding a
+# private copy here
+from consensus_specs_tpu.ssz.merkle import ZERO_HASHES as _ZERO_HASHES
+
+ZERO_HASHES = _ZERO_HASHES[:TREE_DEPTH]
 
 
 def deposit_data_root(pubkey: bytes, withdrawal_credentials: bytes,

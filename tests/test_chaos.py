@@ -28,7 +28,7 @@ from consensus_specs_tpu.resilience import (
 )
 from consensus_specs_tpu.sigpipe import METRICS
 from consensus_specs_tpu.specs import get_spec
-from consensus_specs_tpu.ssz import hash_tree_root, uint64
+from consensus_specs_tpu.ssz import hash_tree_root, incremental, uint64
 from consensus_specs_tpu.test_infra.attestations import (
     get_valid_attestation, sign_attestation)
 from consensus_specs_tpu.test_infra.blocks import (
@@ -47,10 +47,13 @@ CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "20260803"))
 # seams like sigpipe.hash_to_g2_batch are covered by unit tests).
 # ops.g1_aggregate / ops.msm are the PR-5 device G1 sweep sites — every
 # scheduler flush crosses both, so the randomized schedules and the
-# gossip tier now exercise trips/fallbacks there too.
+# gossip tier now exercise trips/fallbacks there too.  ssz.merkle_sweep
+# is the incremental-merkleization dispatch (ssz/incremental.py):
+# _replay runs with that mode on, so every re-root of the tracked state
+# crosses it.
 SITES = ("bls.pairing_check", "bls.verify_batch",
          "bls.fast_aggregate_verify_batch",
-         "ops.g1_aggregate", "ops.msm")
+         "ops.g1_aggregate", "ops.msm", "ssz.merkle_sweep")
 
 
 @pytest.fixture(scope="module")
@@ -83,12 +86,14 @@ def _clean():
     resilience.disable()
     sigpipe.disable()
     txn.disable()
+    incremental.disable()
     INCIDENTS.clear()
     METRICS.reset()
     yield
     resilience.disable()
     sigpipe.disable()
     txn.disable()
+    incremental.disable()
     INCIDENTS.clear()
 
 
@@ -100,6 +105,7 @@ def _replay(spec, workload, plan, mode="fused", deadline_s=None):
                       deadline_s=deadline_s,
                       guard_sample_rate=1.0, guard_seed=CHAOS_SEED)
     sigpipe.enable(mode=mode)
+    incremental.enable(guard_sample_rate=1.0, guard_seed=CHAOS_SEED)
     chaos_state = pre_state.copy()
     try:
         with faults.inject(plan):
@@ -107,6 +113,7 @@ def _replay(spec, workload, plan, mode="fused", deadline_s=None):
             spec.state_transition(chaos_state, signed)
     finally:
         sigpipe.disable()
+        incremental.disable()
     # invariant 1: byte-identical post-state
     assert hash_tree_root(chaos_state) == native_root
     # invariant 3a: every injected fault is in the incident log
@@ -143,6 +150,32 @@ def test_chaos_fault_matrix(spec, workload, kind, persistent):
         assert snapshot["guard_mismatches"] >= 1
         assert resilience.report()["breakers"][
             "bls.pairing_check"] == resilience.QUARANTINED
+
+
+@pytest.mark.parametrize("kind", ["raise", "timeout", "corrupt"])
+def test_chaos_merkle_sweep_matrix(spec, workload, kind):
+    """Persistent faults at the incremental-merkleization sweep site:
+    raise/timeout trip the breaker to the legacy full python re-root,
+    corrupt roots are caught by the differential guard and quarantine
+    the caches — the post-state root never moves either way."""
+    plan = FaultPlan(
+        [FaultSpec("ssz.merkle_sweep", kind, persistent=True,
+                   sleep_s=0.2)],
+        seed=CHAOS_SEED)
+    snapshot = _replay(spec, workload, plan,
+                       deadline_s=0.05 if kind == "timeout" else None)
+    assert plan.total_fires() > 0
+    assert snapshot["merkle_sweep_dispatches"] >= 1
+    if kind in ("raise", "timeout"):
+        # breaker open -> every later re-root is a counted full rebuild
+        assert snapshot["merkle_full_rebuilds"] >= 1
+        assert resilience.report()["breakers"][
+            "ssz.merkle_sweep"] == resilience.OPEN
+    else:
+        # silent corruption: only the merkle guard can catch it
+        assert snapshot["merkle_guard_mismatches"] >= 1
+        assert resilience.report()["breakers"][
+            "ssz.merkle_sweep"] == resilience.QUARANTINED
 
 
 def test_chaos_breaker_recovery_across_blocks(spec, workload):

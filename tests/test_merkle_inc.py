@@ -1,0 +1,491 @@
+"""Incremental merkleization (ssz/incremental.py): dirty-subtree tracked
+hash_tree_root with one layer-parallel sweep per re-root.
+
+The contract under test:
+
+* Parity: after ANY mutation sequence over any composite type (container
+  field sets, list/vector element sets, append/pop, bit flips, union
+  re-selects, nested mutations through child views), the incremental
+  root is byte-identical to the full-rebuild oracle.
+* Diff scaling: a re-root after k leaf mutations hashes O(k · log state)
+  chunks and issues level-calls bounded by the static tree height, all
+  inside ONE `ssz.merkle_sweep` dispatch.
+* Copy-on-write: `copy()` shares the cache; mutating either side never
+  corrupts the other — the txn/ overlay discipline (rollback drops the
+  copy, commit adopts it, the base cache is never written).
+* Resilience: a faulted/broken-open sweep site degrades to the legacy
+  full Python re-root with identical bytes; a corrupted sweep is caught
+  by the differential guard, which quarantines the caches.
+* ZERO_HASHES has one source of truth (merkle.py), shared by proofs.py
+  and the deposit-contract model.
+"""
+from random import Random
+
+import pytest
+
+from consensus_specs_tpu import resilience
+from consensus_specs_tpu.resilience import FaultPlan, FaultSpec, faults
+from consensus_specs_tpu.resilience.supervisor import OPEN, QUARANTINED
+from consensus_specs_tpu.sigpipe import METRICS
+from consensus_specs_tpu.specs import get_spec
+from consensus_specs_tpu.ssz import (
+    Bitlist, Bitvector, Bytes32, Container, List, Union, Vector,
+    hash_tree_root, incremental, uint8, uint64,
+)
+from consensus_specs_tpu.test_infra import disable_bls
+from consensus_specs_tpu.test_infra.attestations import get_valid_attestation
+from consensus_specs_tpu.test_infra.blocks import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block)
+from consensus_specs_tpu.test_infra.genesis import (
+    create_genesis_state, default_balances)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    incremental.disable()
+    resilience.disable()
+    METRICS.reset()
+    yield
+    incremental.disable()
+    resilience.disable()
+
+
+def oracle(view) -> bytes:
+    """Fully independent root: serialize -> deserialize -> legacy hash
+    on a fresh, never-tracked object."""
+    return bytes(type(view).deserialize(view.serialize()).hash_tree_root())
+
+
+# ---------------------------------------------------------------------------
+# type zoo
+# ---------------------------------------------------------------------------
+
+class Checkpoint(Container):
+    epoch: uint64
+    root: Bytes32
+
+
+class Inner(Container):
+    a: uint64
+    cps: List[Checkpoint, 16]
+    bits: Bitlist[300]
+    bv: Bitvector[10]
+
+
+Opt = Union[None, uint64, Checkpoint]
+
+
+class Zoo(Container):
+    x: uint64
+    inner: Inner
+    bal: List[uint64, 1 << 20]
+    small: List[uint8, 100]
+    vec: Vector[Bytes32, 8]
+    cvec: Vector[Checkpoint, 4]
+    u: Opt
+
+
+def random_zoo(rng: Random) -> Zoo:
+    z = Zoo(x=rng.randrange(1 << 32))
+    for _ in range(rng.randrange(0, 9)):
+        z.bal.append(rng.randrange(1 << 50))
+    for _ in range(rng.randrange(0, 20)):
+        z.small.append(rng.randrange(256))
+    for _ in range(rng.randrange(0, 5)):
+        z.inner.cps.append(Checkpoint(epoch=rng.randrange(100),
+                                      root=Bytes32(rng.randbytes(32))))
+    for _ in range(rng.randrange(0, 40)):
+        z.inner.bits.append(rng.random() < 0.5)
+    sel = rng.randrange(3)
+    z.u = Opt(sel, None if sel == 0 else
+              (uint64(rng.randrange(1000)) if sel == 1
+               else Checkpoint(epoch=rng.randrange(50))))
+    return z
+
+
+def _mutate_once(rng: Random, z: Zoo) -> None:
+    """One random mutation drawn from every mutation family the type
+    system supports."""
+    ops = []
+    ops.append(lambda: setattr(z, "x", uint64(rng.randrange(1 << 32))))
+    ops.append(lambda: setattr(z.inner, "a", uint64(rng.randrange(1 << 20))))
+    ops.append(lambda: z.vec.__setitem__(
+        rng.randrange(8), Bytes32(rng.randbytes(32))))
+    ops.append(lambda: setattr(
+        z.cvec[rng.randrange(4)], "epoch", uint64(rng.randrange(1000))))
+    if len(z.bal) < 9:
+        ops.append(lambda: z.bal.append(rng.randrange(1 << 50)))
+    if len(z.bal):
+        ops.append(lambda: z.bal.__setitem__(
+            rng.randrange(len(z.bal)), uint64(rng.randrange(1 << 50))))
+        ops.append(lambda: z.bal.pop())
+    if len(z.small) < 100:
+        ops.append(lambda: z.small.append(rng.randrange(256)))
+    if len(z.small):
+        ops.append(lambda: z.small.pop(rng.randrange(len(z.small))))
+    if len(z.inner.cps) < 16:
+        ops.append(lambda: z.inner.cps.append(
+            Checkpoint(epoch=rng.randrange(100))))
+    if len(z.inner.cps):
+        ops.append(lambda: setattr(
+            z.inner.cps[rng.randrange(len(z.inner.cps))],
+            "root", Bytes32(rng.randbytes(32))))
+        ops.append(lambda: z.inner.cps.pop(rng.randrange(len(z.inner.cps))))
+    if len(z.inner.bits) < 300:
+        ops.append(lambda: z.inner.bits.append(rng.random() < 0.5))
+    if len(z.inner.bits):
+        ops.append(lambda: z.inner.bits.__setitem__(
+            rng.randrange(len(z.inner.bits)), rng.random() < 0.5))
+    ops.append(lambda: z.inner.bv.__setitem__(
+        rng.randrange(10), rng.random() < 0.5))
+    sel = rng.randrange(3)
+    ops.append(lambda: setattr(z, "u", Opt(
+        sel, None if sel == 0 else
+        (uint64(rng.randrange(1000)) if sel == 1
+         else Checkpoint(epoch=rng.randrange(50))))))
+    if z.u.selector == 2:
+        ops.append(lambda: setattr(
+            z.u.value, "epoch", uint64(rng.randrange(1000))))
+    rng.choice(ops)()
+
+
+# ---------------------------------------------------------------------------
+# randomized mutation parity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", range(6))
+def test_randomized_mutation_parity(seed):
+    rng = Random(f"merkle-inc-{seed}")
+    incremental.enable()
+    z = incremental.track(random_zoo(rng))
+    assert bytes(z.hash_tree_root()) == oracle(z)
+    for step in range(40):
+        _mutate_once(rng, z)
+        if rng.random() < 0.4:   # re-root mid-sequence, not only at the end
+            assert bytes(z.hash_tree_root()) == oracle(z), (seed, step)
+    assert bytes(z.hash_tree_root()) == oracle(z)
+    # cached fast path answers without hashing and stays identical
+    before = METRICS.count("merkle_chunks_hashed")
+    assert bytes(z.hash_tree_root()) == oracle(z)
+    assert METRICS.count("merkle_chunks_hashed") == before
+
+
+def test_pop_to_empty_and_regrow():
+    incremental.enable()
+    z = incremental.track(Zoo())
+    z.bal.append(1)
+    z.inner.cps.append(Checkpoint(epoch=3))
+    assert bytes(z.hash_tree_root()) == oracle(z)
+    z.bal.pop()
+    z.inner.cps.pop()
+    assert bytes(z.hash_tree_root()) == oracle(z)
+    z.bal.append(7)
+    assert bytes(z.hash_tree_root()) == oracle(z)
+
+
+def test_untracked_views_keep_legacy_path():
+    incremental.enable()
+    z = Zoo(x=3)
+    assert bytes(z.hash_tree_root()) == oracle(z)
+    assert METRICS.count("merkle_sweep_dispatches") == 0
+
+
+# ---------------------------------------------------------------------------
+# diff scaling: O(k log n) chunks, bounded level-calls, one dispatch
+# ---------------------------------------------------------------------------
+
+def test_diff_scaling_and_single_dispatch():
+    incremental.enable()
+    z = Zoo()
+    for i in range(512):
+        z.bal.append(i)
+    incremental.track(z)
+    z.hash_tree_root()
+    built = METRICS.count("merkle_chunks_hashed")
+    height = incremental.type_tree_height(Zoo)
+
+    METRICS.reset()
+    z.bal[17] = uint64(12345)       # k = 1 dirty leaf
+    root = bytes(z.hash_tree_root())
+    assert root == oracle(z)
+    assert METRICS.count("merkle_sweep_dispatches") == 1
+    assert METRICS.count("merkle_sweep_levels") <= height
+    # one leaf re-roots one path: far fewer chunks than the full build
+    assert 0 < METRICS.count("merkle_chunks_hashed") <= height
+    assert METRICS.count("merkle_chunks_hashed") < built // 4
+    assert METRICS.count("merkle_full_rebuilds") == 0
+
+    METRICS.reset()
+    for i in range(8):              # k = 8 scattered leaves
+        z.bal[i * 60] = uint64(i)
+    assert bytes(z.hash_tree_root()) == oracle(z)
+    assert METRICS.count("merkle_sweep_dispatches") == 1
+    assert METRICS.count("merkle_sweep_levels") <= height
+    assert METRICS.count("merkle_chunks_hashed") <= 8 * height
+    occ = METRICS.hist_counts("merkle_dirty_occupancy")
+    assert sum(occ.values()) == 1   # one sweep observed
+
+
+# ---------------------------------------------------------------------------
+# copy-on-write / txn discipline
+# ---------------------------------------------------------------------------
+
+def test_copy_shares_cache_copy_on_write():
+    incremental.enable()
+    rng = Random("cow")
+    z = incremental.track(random_zoo(rng))
+    base_root = bytes(z.hash_tree_root())
+
+    c = z.copy()
+    before = METRICS.count("merkle_chunks_hashed")
+    assert bytes(c.hash_tree_root()) == base_root   # cached, no rehash
+    assert METRICS.count("merkle_chunks_hashed") == before
+
+    # mutate the COPY: base cache must stay intact (rollback semantics)
+    for _ in range(10):
+        _mutate_once(rng, c)
+    assert bytes(c.hash_tree_root()) == oracle(c)
+    assert bytes(z.hash_tree_root()) == base_root == oracle(z)
+
+    # mutate the BASE: the copy keeps its own root (commit semantics)
+    copy_root = bytes(c.hash_tree_root())
+    for _ in range(10):
+        _mutate_once(rng, z)
+    assert bytes(z.hash_tree_root()) == oracle(z)
+    assert bytes(c.hash_tree_root()) == copy_root == oracle(c)
+
+
+def test_txn_rollback_never_corrupts_base_cache():
+    """The txn/ overlay contract: handlers mutate a .copy() of the
+    stored state; an abort drops the copy.  The base state's cached
+    tree must answer the same root afterwards, with no rehash."""
+    incremental.enable()
+    rng = Random("txn")
+    z = incremental.track(random_zoo(rng))
+    base_root = bytes(z.hash_tree_root())
+
+    class Abort(Exception):
+        pass
+
+    try:
+        txn_state = z.copy()
+        for _ in range(8):
+            _mutate_once(rng, txn_state)
+        txn_state.hash_tree_root()     # mid-txn re-root, then crash
+        raise Abort()
+    except Abort:
+        del txn_state                  # rollback: the copy is dropped
+
+    before = METRICS.count("merkle_chunks_hashed")
+    assert bytes(z.hash_tree_root()) == base_root == oracle(z)
+    assert METRICS.count("merkle_chunks_hashed") == before
+
+
+# ---------------------------------------------------------------------------
+# resilience: faulted sweep site, breaker, guard
+# ---------------------------------------------------------------------------
+
+def _tracked_state_with_dirt(rng):
+    z = incremental.track(random_zoo(rng))
+    z.hash_tree_root()
+    for _ in range(5):
+        _mutate_once(rng, z)
+    return z
+
+
+def test_sweep_site_raise_falls_back_to_full_rebuild():
+    incremental.enable()
+    resilience.enable(max_retries=0, breaker_threshold=1, probe_after=1000)
+    rng = Random("fault-raise")
+    z = _tracked_state_with_dirt(rng)
+    plan = FaultPlan([FaultSpec("ssz.merkle_sweep", "raise",
+                                persistent=True)], seed=7)
+    with faults.inject(plan):
+        root = bytes(z.hash_tree_root())
+        assert root == oracle(z)       # degraded, byte-identical
+        sup = resilience.active()
+        assert sup.breaker_state("ssz.merkle_sweep") == OPEN
+        assert METRICS.count("merkle_full_rebuilds") >= 1
+        # breaker open: further re-roots keep answering correctly
+        _mutate_once(rng, z)
+        assert bytes(z.hash_tree_root()) == oracle(z)
+    assert plan.total_fires() >= 1
+    # dirty marks survived the degraded period: once the site heals,
+    # the sweep resumes incrementally and stays byte-identical
+    resilience.disable()
+    _mutate_once(rng, z)
+    assert bytes(z.hash_tree_root()) == oracle(z)
+
+
+def test_abandoned_sweep_never_writes_caches(monkeypatch):
+    """Watchdog-abandonment race: a timed-out sweep keeps running on the
+    abandoned worker thread after the block thread has taken the
+    fallback root and resumed mutating.  The dispatched device fn must
+    be pure — running it arbitrarily late must not write a cache level
+    or clear a dirty mark made in the meantime (a cleared mark would
+    make the next hash_tree_root serve a stale cached root)."""
+    incremental.enable()
+    rng = Random("zombie")
+    z = _tracked_state_with_dirt(rng)
+
+    captured = []
+
+    def timed_out_dispatch(site, device, fallback):
+        # deadline expired: the caller gets the fallback answer while
+        # the device fn lives on (returned to the test = the zombie)
+        captured.append(device)
+        return fallback()
+
+    monkeypatch.setattr(incremental, "_dispatch", timed_out_dispatch)
+    assert bytes(z.hash_tree_root()) == oracle(z)   # degraded root
+    monkeypatch.undo()
+    assert len(captured) == 1
+
+    # block thread resumes and dirties a leaf the zombie's plan covered
+    for _ in range(3):
+        _mutate_once(rng, z)
+    cache = z.__dict__["_mcache"]
+    dirty_before = set(cache.dirty)
+    assert dirty_before and cache.root is None
+    captured[0]()   # the abandoned worker finishes its sweep late
+    # late completion wrote nothing: dirty marks and the invalidated
+    # root are exactly as the block thread left them, and the next
+    # (real) sweep answers the post-mutation root, not a stale one
+    assert cache.dirty == dirty_before and cache.root is None
+    assert bytes(z.hash_tree_root()) == oracle(z)
+    _mutate_once(rng, z)
+    assert bytes(z.hash_tree_root()) == oracle(z)
+
+
+def test_sweep_corruption_caught_by_guard_and_quarantined():
+    incremental.enable(guard_sample_rate=1.0, guard_seed=11)
+    resilience.enable(max_retries=0, breaker_threshold=3)
+    rng = Random("fault-corrupt")
+    z = _tracked_state_with_dirt(rng)
+    plan = FaultPlan([FaultSpec("ssz.merkle_sweep", "corrupt",
+                                persistent=True)], seed=13)
+    with faults.inject(plan):
+        root = bytes(z.hash_tree_root())
+    # the guard re-derived the root from the oracle: the verdict the
+    # caller sees is never the corrupted one
+    assert root == oracle(z)
+    assert METRICS.count("merkle_guard_mismatches") >= 1
+    sup = resilience.active()
+    assert sup.breaker_state("ssz.merkle_sweep") == QUARANTINED
+    # quarantine dropped the caches: the view is untracked now, so
+    # re-roots take the legacy full path (no further sweep dispatches)
+    # and keep answering correctly
+    dispatches = METRICS.count("merkle_sweep_dispatches")
+    _mutate_once(rng, z)
+    assert bytes(z.hash_tree_root()) == oracle(z)
+    assert METRICS.count("merkle_sweep_dispatches") == dispatches
+    # a re-tracked state behind the quarantined site degrades to the
+    # full-rebuild fallback (counted), never to a wrong root
+    incremental.track(z)
+    assert bytes(z.hash_tree_root()) == oracle(z)
+    assert METRICS.count("merkle_full_rebuilds") >= 1
+
+
+def test_guard_passes_clean_sweeps():
+    incremental.enable(guard_sample_rate=1.0, guard_seed=3)
+    rng = Random("guard-clean")
+    z = _tracked_state_with_dirt(rng)
+    assert bytes(z.hash_tree_root()) == oracle(z)
+    assert METRICS.count("merkle_guard_samples") >= 1
+    assert METRICS.count("merkle_guard_mismatches") == 0
+
+
+# ---------------------------------------------------------------------------
+# spec integration: process_slots / state_transition consume the cache
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_spec("altair", "minimal")
+
+
+@pytest.fixture(scope="module")
+def workload(spec):
+    with disable_bls():
+        state = create_genesis_state(spec, default_balances(spec))
+        spec.process_slots(state, uint64(spec.SLOTS_PER_EPOCH + 2))
+        att = get_valid_attestation(spec, state, signed=True)
+        advanced = state.copy()
+        spec.process_slots(
+            advanced,
+            uint64(state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY))
+        block = build_empty_block_for_next_slot(spec, advanced)
+        block.body.attestations.append(att)
+        scratch = advanced.copy()
+        signed = state_transition_and_sign_block(spec, scratch, block)
+    return advanced, signed
+
+
+def test_state_transition_incremental_parity(spec, workload):
+    advanced, signed = workload
+    with disable_bls():
+        legacy = advanced.copy()
+        spec.state_transition(legacy, signed)
+        legacy_root = bytes(hash_tree_root(legacy))
+
+        incremental.enable()
+        st = advanced.copy()
+        spec.state_transition(st, signed)
+        cached_root = bytes(st.hash_tree_root())
+        assert METRICS.count("merkle_sweep_dispatches") >= 1
+        incremental.disable()
+        # final comparison on the legacy path: truly independent bytes
+        assert bytes(hash_tree_root(st)) == cached_root == legacy_root
+
+
+def test_process_slots_epoch_boundary_parity(spec, workload):
+    advanced, _ = workload
+    with disable_bls():
+        target = uint64(advanced.slot + 2 * spec.SLOTS_PER_EPOCH)
+        legacy = advanced.copy()
+        spec.process_slots(legacy, target)
+
+        incremental.enable()
+        st = advanced.copy()
+        spec.process_slots(st, target)
+        incremental.disable()
+        assert bytes(hash_tree_root(st)) == bytes(hash_tree_root(legacy))
+
+
+def test_per_slot_sweep_is_diff_sized(spec, workload):
+    """Steady-state slot processing re-hashes the diff, not the state:
+    after the first build, each process_slot's sweep touches far fewer
+    chunks than the build did, within the height-derived bound."""
+    advanced, _ = workload
+    with disable_bls():
+        incremental.enable()
+        st = advanced.copy()
+        incremental.track(st)
+        st.hash_tree_root()
+        built = METRICS.count("merkle_chunks_hashed")
+        height = incremental.type_tree_height(type(st))
+        METRICS.reset()
+        spec.process_slots(st, uint64(advanced.slot + 1))
+        assert bytes(st.hash_tree_root()) == incremental.oracle_root(st)
+        # process_slot dirties a handful of leaves (state_roots,
+        # block_roots, latest_block_header, slot): O(k · height)
+        assert 0 < METRICS.count("merkle_chunks_hashed") <= 8 * height
+        assert METRICS.count("merkle_chunks_hashed") < built // 4
+        assert METRICS.count("merkle_full_rebuilds") == 0
+        incremental.disable()
+
+
+# ---------------------------------------------------------------------------
+# ZERO_HASHES: one ladder, one source of truth
+# ---------------------------------------------------------------------------
+
+def test_zero_hash_ladder_shared():
+    from consensus_specs_tpu.ssz import merkle, proofs
+    from deposit_contract import contract_model
+    assert proofs.ZERO_HASHES is merkle.ZERO_HASHES
+    assert contract_model.ZERO_HASHES == \
+        merkle.ZERO_HASHES[:contract_model.TREE_DEPTH]
+    # the ladder is what it claims: ZERO_HASHES[i+1] = H(Z[i] || Z[i])
+    for i in range(8):
+        assert merkle.ZERO_HASHES[i + 1] == merkle.hash_pair(
+            merkle.ZERO_HASHES[i], merkle.ZERO_HASHES[i])

@@ -1,0 +1,97 @@
+"""Kernel tier: incremental merkle sweep on the batched JAX SHA-256
+kernel (ops/sha256.hash_level_ragged) vs the hashlib host path.
+
+The sweep's ragged per-round levels must hash to the same bytes on the
+device kernel as on hashlib, for both the full cache build and the
+dirty-diff sweeps, end-to-end through a spec state transition.  Listed
+in conftest.KERNEL_TIER_FILES (`make test-kernels`); the default suite
+covers the same planner/executor on the hashlib path via
+test_merkle_inc.py.
+"""
+from random import Random
+
+import pytest
+
+from consensus_specs_tpu.sigpipe import METRICS
+from consensus_specs_tpu.specs import get_spec
+from consensus_specs_tpu.ssz import (
+    Bytes32, Container, List, hash_tree_root, incremental, merkle, uint64,
+)
+from consensus_specs_tpu.test_infra import disable_bls
+from consensus_specs_tpu.test_infra.genesis import (
+    create_genesis_state, default_balances)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    incremental.disable()
+    merkle.use_host_hashing()
+    METRICS.reset()
+    yield
+    incremental.disable()
+    merkle.use_host_hashing()
+
+
+class Checkpoint(Container):
+    epoch: uint64
+    root: Bytes32
+
+
+class Blob(Container):
+    bal: List[uint64, 1 << 20]
+    cps: List[Checkpoint, 1 << 12]
+
+
+def _build(rng, n):
+    b = Blob()
+    for i in range(n):
+        b.bal.append(rng.randrange(1 << 50))
+        b.cps.append(Checkpoint(epoch=i, root=Bytes32(rng.randbytes(32))))
+    return b
+
+
+def test_sweep_device_vs_host_parity():
+    rng = Random("sweep-jax")
+    host = _build(Random("sweep-jax"), 700)
+    dev = _build(Random("sweep-jax"), 700)
+
+    incremental.enable()
+    incremental.track(host)
+    host_build = bytes(host.hash_tree_root())
+
+    # threshold=1 forces EVERY ragged sweep level through the kernel
+    merkle.use_tpu_hashing(threshold=1)
+    incremental.track(dev)
+    dev_build = bytes(dev.hash_tree_root())
+    assert dev_build == host_build
+
+    for step in range(10):
+        for target in (host, dev):
+            target.bal[step * 37] = uint64(step)
+            target.cps[step * 41].epoch = uint64(9000 + step)
+            target.cps.append(Checkpoint(epoch=step))
+        merkle.use_host_hashing()
+        h = bytes(host.hash_tree_root())
+        merkle.use_tpu_hashing(threshold=1)
+        d = bytes(dev.hash_tree_root())
+        assert d == h, step
+    assert METRICS.count("merkle_sweep_dispatches") >= 12
+
+
+def test_state_transition_on_device_sweeps():
+    spec = get_spec("altair", "minimal")
+    with disable_bls():
+        state = create_genesis_state(spec, default_balances(spec))
+        target = uint64(spec.SLOTS_PER_EPOCH + 2)
+
+        legacy = state.copy()
+        spec.process_slots(legacy, target)
+        legacy_root = bytes(hash_tree_root(legacy))
+
+        incremental.enable()
+        merkle.use_tpu_hashing(threshold=1)
+        st = state.copy()
+        spec.process_slots(st, target)
+        incremental.disable()
+        merkle.use_host_hashing()
+        assert bytes(hash_tree_root(st)) == legacy_root
