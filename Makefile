@@ -12,9 +12,10 @@ RUNNERS := shuffling ssz_static operations epoch_processing sanity bls \
 	kzg rewards finality genesis fork_choice transition ssz_generic \
 	forks merkle_proof networking kzg_7594 random light_client sync
 
-.PHONY: test test-quick test-kernels tier1 chaos recovery-chaos lint \
-	native pyspec bench gossip-bench txn-bench msm-bench merkle-bench \
-	gen_all detect_errors $(addprefix gen_,$(RUNNERS))
+.PHONY: test test-quick test-kernels tier1 chaos recovery-chaos \
+	scenario-chaos lint native pyspec bench gossip-bench txn-bench \
+	msm-bench merkle-bench scenario-bench gen_all detect_errors \
+	$(addprefix gen_,$(RUNNERS))
 
 # syntax/bytecode check over every package and script (the CI lint job)
 lint:
@@ -36,7 +37,7 @@ test-quick:
 		tests/test_phase0_sanity.py tests/test_epoch_fast.py \
 		tests/test_sigpipe.py tests/test_resilience.py \
 		tests/test_gossip.py tests/test_txn.py \
-		tests/test_merkle_inc.py -q
+		tests/test_merkle_inc.py tests/test_scenario.py -q
 
 # the exact ROADMAP.md tier-1 verify command (what the driver runs);
 # DOTS_PASSED counts green dots from the -q progress lines
@@ -63,6 +64,15 @@ recovery-chaos:
 	env JAX_PLATFORMS=cpu CHAOS_SEED=$${CHAOS_SEED:-20260803} \
 		$(PYTHON) -m pytest tests/test_chaos.py tests/test_txn.py \
 		-k "txn or crash or torn or recover" -q --kernel-tiers
+
+# fleet battlefield tier (scenario/): the named scenario library plus
+# the seeded randomized scenario matrix — partitions, equivocation
+# storms, surround votes, long-range forks, crash-and-recover nodes,
+# degraded windows — every node converging to the oracle store root
+# with every attack attributed to a node-tagged incident
+scenario-chaos:
+	env JAX_PLATFORMS=cpu \
+		$(PYTHON) -m pytest tests/test_scenario.py -q --kernel-tiers
 
 native:
 	$(PYTHON) scripts/build_native.py
@@ -100,6 +110,13 @@ msm-bench:
 # full-rebuild path; BENCH_MERKLE_VALIDATORS=N resizes the state
 merkle-bench:
 	$(PYTHON) bench.py merkle_inc
+
+# fleet battlefield alone (scenario/): 16 nodes at 10x ingress through
+# a partition + equivocation storm + heal; asserts oracle convergence,
+# full attribution, and bounded duplicate shed; BENCH_SCENARIO=name
+# and BENCH_SCENARIO_SEED=N pick another battlefield
+scenario-bench:
+	env JAX_PLATFORMS=cpu $(PYTHON) bench.py scenario
 
 # static pattern rule: GNU make refuses to run implicit pattern rules
 # for .PHONY targets

@@ -48,12 +48,14 @@ from __future__ import annotations
 
 import threading
 from collections import deque
+from contextlib import nullcontext
 from dataclasses import dataclass
 
 from ..sigpipe import scheduler
 from ..sigpipe.metrics import METRICS
 from ..sigpipe.verify import VerdictMap
 from ..ssz import hash_tree_root
+from ..utils import nodectx
 from ..utils.clock import MONOTONIC
 from . import collect as _collect
 from .batcher import FLUSH_DRAIN, DeadlineBatcher
@@ -116,27 +118,45 @@ class Result:
 
 class AdmissionPipeline:
     def __init__(self, spec, store, config: GossipConfig | None = None,
-                 clock=MONOTONIC):
+                 clock=MONOTONIC, *, batcher=None, quotas=None,
+                 seen=None, guard=None, transport=None, ctx=None):
+        """Every stateful component is injected per-instance (clock,
+        batcher, quotas, dedup cache, equivocation guard) so N
+        pipelines can coexist in one process without aliasing — the
+        scenario harness's per-node instantiation contract.  Pass a
+        pre-built component to share state across pipeline lifetimes
+        (the driver keeps each node's `guard` across a simulated crash:
+        slashing-protection history is durable state, the seen cache is
+        not).
+
+        `transport`, when given, is called as ``transport(message)``
+        for every ACCEPTED message — the relay seam a mesh simulation
+        (or a real gossipsub binding) hangs forwarding on.  `ctx` is a
+        `nodectx.NodeContext`; when set, every public entry point runs
+        under it so metrics and incidents from this pipeline (and the
+        handlers it drives) land in that node's own registries."""
         self.spec = spec
         self.store = store
         self.config = config or GossipConfig()
         self.clock = clock
+        self.ctx = ctx
+        self.transport = transport
         cfg = self.config
         self.queues = {topic: BoundedQueue(topic, cfg.queue_depth)
                        for topic in TOPICS}
-        self.batcher = DeadlineBatcher(cfg.window_s, cfg.max_batch,
-                                       cfg.mode, clock)
-        self.quotas = PeerQuotas(cfg.bucket_capacity, cfg.refill_rate,
-                                 policy=cfg.quota_policy,
-                                 max_deferred=cfg.max_deferred,
-                                 max_peers=cfg.max_peers, clock=clock)
+        self.batcher = batcher or DeadlineBatcher(
+            cfg.window_s, cfg.max_batch, cfg.mode, clock)
+        self.quotas = quotas or PeerQuotas(
+            cfg.bucket_capacity, cfg.refill_rate,
+            policy=cfg.quota_policy, max_deferred=cfg.max_deferred,
+            max_peers=cfg.max_peers, clock=clock)
         # only topics this spec can actually handle: a submit for an
         # unsupported topic must fail THERE, not explode mid-flush and
         # abandon the rest of an already-popped window
         self.topics = tuple(t for t in TOPICS
                             if hasattr(spec, _HANDLER_METHODS[t]))
-        self.seen = SeenCache(cfg.seen_cache_size)
-        self.guard = EquivocationGuard()
+        self.seen = seen or SeenCache(cfg.seen_cache_size)
+        self.guard = guard or EquivocationGuard()
         self.results: dict = {}         # seq -> Result (bounded)
         self.delivered_log = deque(maxlen=cfg.history_bound)
         self._finalized_order: deque = deque()  # eviction order for results
@@ -149,12 +169,23 @@ class AdmissionPipeline:
         self._ingress_lock = threading.RLock()
         self._drainer_lock = threading.Lock()
 
+    def _scope(self):
+        """The node-context region every public entry point runs under
+        (no-op without a ctx).  Reentrant, so submit->poll nesting just
+        shadows."""
+        return nodectx.use(self.ctx) if self.ctx is not None \
+            else nullcontext()
+
     # -- ingress -------------------------------------------------------
     def submit(self, topic: str, payload, peer: str = "local") -> int:
         """Admit one gossip message; returns its sequence number.  May
         trigger a size-cap flush.  The verdict lands in results[seq].
         Thread-safe: admission runs under the ingress lock; the closing
         poll() only flushes when no other thread is already draining."""
+        with self._scope():
+            return self._submit(topic, payload, peer)
+
+    def _submit(self, topic: str, payload, peer: str) -> int:
         assert topic in self.topics, \
             f"topic {topic!r} not supported by {self.spec.fork} spec"
         digest = bytes(hash_tree_root(payload))     # hash outside locks
@@ -219,6 +250,10 @@ class AdmissionPipeline:
         after RELEASING the lock and resumes if a racing submit filled
         one (a submit's enqueue always happens before its failed
         acquire, so the re-check is ordered after it)."""
+        with self._scope():
+            return self._poll()
+
+    def _poll(self) -> bool:
         flushed = False
         while True:
             if not self._drainer_lock.acquire(blocking=False):
@@ -246,16 +281,18 @@ class AdmissionPipeline:
         returns the finalized Results in seq order.  Deferred messages
         whose buckets are still empty stay deferred (backpressure is
         allowed to outlive a drain)."""
-        with self._drainer_lock:
-            with self._ingress_lock:
-                for message in self.quotas.take_refilled():
-                    self._enqueue(message)
-            while self.pending_count():
-                self._flush(FLUSH_DRAIN)
-        # cover a racing submit whose poll() skipped while we held the
-        # drainer lock (same re-check-after-release discipline as poll)
-        self.poll()
-        return self.verdicts()
+        with self._scope():
+            with self._drainer_lock:
+                with self._ingress_lock:
+                    for message in self.quotas.take_refilled():
+                        self._enqueue(message)
+                while self.pending_count():
+                    self._flush(FLUSH_DRAIN)
+            # cover a racing submit whose poll() skipped while we held
+            # the drainer lock (same re-check-after-release discipline
+            # as poll)
+            self._poll()
+            return self.verdicts()
 
     def _flush(self, reason: str) -> None:
         """Verify and deliver one window.  Caller holds the drainer
@@ -393,6 +430,11 @@ class AdmissionPipeline:
             if message.topic == "block":
                 prewarm_block(self.spec, self.store,
                               hash_tree_root(message.payload.message))
+            if self.transport is not None:
+                # the relay seam: a validated message is what a mesh
+                # forwards.  Called after finalize so a forwarding
+                # simulation observing results sees this message done.
+                self.transport(message)
         else:
             METRICS.inc_labeled("gossip_rejected", message.topic)
             # rejections are often TRANSIENT (attestation a slot early,

@@ -10,6 +10,14 @@ silent failure of the harness itself.
 Bounded (FIFO over `max_entries`) and thread-safe: the supervisor's
 watchdog runs dispatches on worker threads, and production operators tail
 this from a metrics thread.  `snapshot()` returns plain JSON-able dicts.
+
+`INCIDENTS` is a *router*: each record consults the node-context stack
+(utils/nodectx.py) and lands in the active node's own `IncidentLog`
+when the scenario harness installed one — tagged with that node's
+`node_id` — or in the process-global default otherwise.  Per-node logs
+may also inject a clock (the driver passes its ManualClock) so the `t`
+field is simulation time and a seeded scenario's incident stream
+replays bit-identically.
 """
 from __future__ import annotations
 
@@ -18,19 +26,28 @@ import threading
 import time
 from collections import deque
 
+from ..utils import nodectx
+
 
 class IncidentLog:
-    def __init__(self, max_entries: int = 4096):
+    def __init__(self, max_entries: int = 4096,
+                 node_id: str | None = None, clock=None):
         self._lock = threading.RLock()
         self._entries: deque = deque(maxlen=max_entries)
         self._seq = 0
+        self.node_id = node_id
+        self._clock = clock          # None -> wall clock
 
     def record(self, site: str, event: str, **detail) -> dict:
         """Append one incident; returns the record (already sequenced)."""
         with self._lock:
             self._seq += 1
-            entry = {"seq": self._seq, "t": round(time.time(), 3),
+            t = (round(time.time(), 3) if self._clock is None
+                 else round(self._clock.now(), 6))
+            entry = {"seq": self._seq, "t": t,
                      "site": site, "event": event}
+            if self.node_id is not None:
+                entry["node_id"] = self.node_id
             entry.update(detail)
             self._entries.append(entry)
             return entry
@@ -63,4 +80,4 @@ class IncidentLog:
         return json.dumps(self.snapshot())
 
 
-INCIDENTS = IncidentLog()
+INCIDENTS = nodectx.Router(IncidentLog(), "incidents")

@@ -1,0 +1,62 @@
+"""Network-scale adversarial scenario harness.
+
+PRs 1-6 hardened a single node: fused deferred verification (sigpipe/),
+a graceful-degradation supervisor (resilience/), bounded gossip
+admission (gossip/), a transactional store with crash recovery (txn/),
+device G1 sweeps (ops/) and incremental merkleization (ssz/).  This
+package composes them into the SYSTEM story: N simulated nodes — each
+with its own gossip pipeline, transactional store, and node-tagged
+metrics/incident books — driven over a seeded topology through
+mainnet-shaped and adversarial traffic, with an omniscient sequential
+oracle defining truth.
+
+    from consensus_specs_tpu import scenario
+    report = scenario.run_scenario(scenario.named("battlefield3"),
+                                   seed=7)
+    scenario.assert_converged(report)     # byte-identical store roots
+    scenario.assert_attributed(report)    # every attack pinned to a
+                                          # node-tagged incident
+
+* dsl.py      — declarative scenarios: topology, traffic mix, and a
+                timeline of partitions, equivocation storms,
+                surround-vote attacks, long-range forks,
+                crash-and-recover nodes, breaker-open windows; plus
+                the named LIBRARY and the seeded `randomized()`
+                generator.
+* net.py      — the simulated network: per-origin FIFO streams with
+                stall/flush loss semantics (the determinism invariant
+                convergence rests on), seeded delay/jitter/drops,
+                mesh-redundancy duplicate copies.
+* traffic.py  — one canonical chain + the full message feed + crafted
+                adversarial messages, precomputed from
+                (scenario, seed).
+* node.py     — SimNode: per-node pipeline/store/journal/guard with
+                the durable-vs-volatile crash contract.
+* driver.py   — the seeded scheduler: agenda loop, event application,
+                heal/recovery sync, end-of-run convergence,
+                ScenarioReport with a deterministic fingerprint().
+* oracle.py   — the sequential omniscient oracle and the
+                convergence + attribution assertions.
+
+Every run is a pure function of `(scenario, seed)`; docs/scenario.md
+derives why (per-origin FIFO x home-mapping x burned-validator muting
+x uniform block timeliness).
+"""
+from .driver import Driver, ScenarioReport, run_scenario
+from .dsl import (
+    LIBRARY, LinkSpec, Scenario, Topology, TrafficSpec, crash,
+    degraded, equivocation_storm, heal, long_range_fork, named,
+    partition, randomized, recover, surround_attack,
+)
+from .oracle import (
+    Oracle, assert_attributed, assert_converged, attribution_report,
+)
+
+__all__ = [
+    "Driver", "LIBRARY", "LinkSpec", "Oracle", "Scenario",
+    "ScenarioReport", "Topology", "TrafficSpec", "assert_attributed",
+    "assert_converged", "attribution_report", "crash", "degraded",
+    "equivocation_storm", "heal", "long_range_fork", "named",
+    "partition", "randomized", "recover", "run_scenario",
+    "surround_attack",
+]

@@ -1,0 +1,362 @@
+"""Declarative scenario specs: the battlefield DSL.
+
+A `Scenario` is pure data — validator population (implied by the
+preset), node count, topology (link delay/jitter/drop + partitions),
+traffic mix (solo attestations, aggregates, sync messages, blocks, an
+ingress multiplier for mesh redundancy), and a timeline of injected
+events on the driver's ManualClock.  `(scenario, seed)` fully
+determines a run: the driver derives every random decision (jitter,
+drops, adversarial validator picks) from one seeded RNG, so two runs
+replay bit-identically — the determinism pin the test tier asserts.
+
+Time is measured in SLOTS (floats allowed): `at_slot=3.5` is halfway
+through slot 3.  Events are constructed with the helpers below, e.g.:
+
+    Scenario(
+        name="battlefield3", nodes=3, slots=8,
+        events=(
+            partition(2.0, ((0, 1), (2,))),
+            equivocation_storm(3.2, origin=0, validators=2),
+            crash(4.1, node=1),
+            heal(5.0),
+            recover(6.1, node=1),
+        ))
+
+DETERMINISM DISCIPLINE (what makes byte-identical convergence a
+theorem rather than luck — docs/scenario.md derives each point):
+
+* every message carrying a given validator's sole vote originates at
+  ONE node (its home, or the adversarial event's origin — event
+  validators are picked from the origin's population), and the network
+  delivers each origin's stream in publish order to every recipient
+  (per-origin FIFO with stall/flush loss semantics, net.py) — so
+  first-vote-wins guard decisions agree fleet-wide;
+* validators burned by an adversarial event are muted from canonical
+  solo traffic (their conflicting votes come from the event itself;
+  they still ride committee aggregates) — a quarantine decision can
+  therefore never race an honest vote across origins;
+* blocks are published at the attesting-interval boundary, so
+  `block_timeliness` is uniformly False at every node and the oracle
+  (proposer-boost scenarios exist, they just assert head convergence
+  instead of full store identity);
+* partitions heal within the attestation staleness window (target
+  epoch current-or-previous at flush time) — `validate()` rejects a
+  scenario that cannot converge by construction.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+__all__ = [
+    "LinkSpec", "Topology", "TrafficSpec", "Event", "Scenario",
+    "partition", "heal", "equivocation_storm", "surround_attack",
+    "long_range_fork", "crash", "recover", "degraded",
+    "ADVERSARIAL_KINDS", "LIBRARY", "named", "randomized",
+]
+
+ADVERSARIAL_KINDS = frozenset({
+    "partition", "equivocation_storm", "surround_attack",
+    "long_range_fork", "crash", "degraded",
+})
+
+
+@dataclass(frozen=True)
+class LinkSpec:
+    delay_s: float = 0.25       # base one-way delay
+    jitter_s: float = 0.25      # seeded uniform extra, per (msg, dest)
+    drop_rate: float = 0.0      # seeded per-(msg, dest) stall odds
+
+
+@dataclass(frozen=True)
+class Topology:
+    kind: str = "full_mesh"     # full_mesh is the only kind today;
+    #                             partitions are EVENTS, not topology
+    link: LinkSpec = field(default_factory=LinkSpec)
+
+
+@dataclass(frozen=True)
+class TrafficSpec:
+    attestation_fraction: float = 1.0   # of each committee, solo votes
+    aggregates: bool = True             # one aggregate per committee
+    sync_messages: int = 2              # sync-committee msgs per slot
+    ingress_multiplier: int = 1         # mesh redundancy: duplicate
+    #                                     copies per delivery (dedup
+    #                                     sheds them; >1 models the
+    #                                     10x-100x gossip fan-in)
+
+
+@dataclass(frozen=True)
+class Event:
+    at_slot: float
+    kind: str
+    params: tuple = ()          # sorted (key, value) pairs
+
+    def get(self, key, default=None):
+        for k, v in self.params:
+            if k == key:
+                return v
+        return default
+
+
+def _event(at_slot: float, kind: str, **params) -> Event:
+    return Event(float(at_slot), kind, tuple(sorted(params.items())))
+
+
+def partition(at_slot: float, groups) -> Event:
+    """Cut the mesh into `groups` (a tuple of node-id tuples; every
+    node must appear exactly once).  Cross-group streams stall until
+    the next heal."""
+    return _event(at_slot, "partition",
+                  groups=tuple(tuple(int(n) for n in g) for g in groups))
+
+
+def heal(at_slot: float) -> Event:
+    """Restore the full mesh and flush every partition-stalled stream;
+    healed nodes run an anti-entropy catch-up (recorded as a
+    `scenario.sync` incident in their own logs)."""
+    return _event(at_slot, "heal")
+
+
+def equivocation_storm(at_slot: float, origin: int,
+                       validators: int = 2) -> Event:
+    """`validators` origin-hosted validators each publish a double vote:
+    their real head attestation immediately followed by a conflicting
+    same-target vote for its parent."""
+    return _event(at_slot, "equivocation_storm", origin=int(origin),
+                  validators=int(validators))
+
+
+def surround_attack(at_slot: float, origin: int) -> Event:
+    """One origin-hosted validator publishes a verified epoch-1 vote,
+    then a crafted older-target vote whose source claims epoch 1 — the
+    recorded vote surrounds it (the second arm of
+    is_slashable_attestation_data).  Needs at_slot in epoch >= 1."""
+    return _event(at_slot, "surround_attack", origin=int(origin))
+
+
+def long_range_fork(at_slot: float, origin: int, fork_slot: int,
+                    length: int = 2) -> Event:
+    """Publish a `length`-block fork built on the canonical block at
+    `fork_slot` — each fork block is a second proposal for a slot that
+    already has one, so the guard quarantines every fork-slot proposer
+    post-acceptance (blocks are exempt from pre-delivery shed)."""
+    return _event(at_slot, "long_range_fork", origin=int(origin),
+                  fork_slot=int(fork_slot), length=int(length))
+
+
+def crash(at_slot: float, node: int) -> Event:
+    """Power-cut `node`: store, pipeline, queues and dedup state are
+    lost; the WAL journal and the slashing-protection guard survive
+    (they are the node's durable state)."""
+    return _event(at_slot, "crash", node=int(node))
+
+
+def recover(at_slot: float, node: int) -> Event:
+    """`txn.recover()` the node from its journal, rebuild the pipeline
+    around the durable guard, tick forward, and catch up."""
+    return _event(at_slot, "recover", node=int(node))
+
+
+def degraded(at_slot: float, until_slot: float,
+             site: str = "gossip.batch_verify") -> Event:
+    """A breaker-open window: a persistent injected fault at `site`
+    trips the (process-shared) breaker during some node's dispatch;
+    at `until_slot` the fault is lifted and the breaker reset.
+    Verdicts stay byte-identical throughout (that is the breaker's
+    contract) — the window shows up in incidents and fallback
+    metrics."""
+    return _event(at_slot, "degraded", until_slot=float(until_slot),
+                  site=site)
+
+
+@dataclass(frozen=True)
+class Scenario:
+    name: str
+    nodes: int = 3
+    slots: int = 8              # traffic length; the run ends at the
+    #                             slot `slots + 1` boundary tick
+    fork: str = "altair"
+    preset: str = "minimal"
+    topology: Topology = field(default_factory=Topology)
+    traffic: TrafficSpec = field(default_factory=TrafficSpec)
+    events: tuple = ()
+    # convergence contract: assert byte-identical txn.store_root against
+    # the oracle (requires the determinism discipline above).  Scenarios
+    # outside the envelope set this False and get head/checkpoint
+    # assertions only.
+    assert_store_identity: bool = True
+
+    def sorted_events(self) -> tuple:
+        return tuple(sorted(self.events, key=lambda e: e.at_slot))
+
+    def validate(self) -> None:
+        assert self.nodes >= 1 and self.slots >= 2
+        down: set = set()
+        partitioned = False
+        degraded_until = 0.0
+        for e in self.sorted_events():
+            assert 0.0 <= e.at_slot, f"event before genesis: {e}"
+            assert e.at_slot <= self.slots + 1, f"event after end: {e}"
+            if e.kind == "partition":
+                groups = e.get("groups")
+                flat = sorted(n for g in groups for n in g)
+                assert flat == list(range(self.nodes)), \
+                    f"partition groups must cover every node: {e}"
+                partitioned = True
+            elif e.kind == "heal":
+                partitioned = False
+            elif e.kind == "crash":
+                node = e.get("node")
+                assert 0 <= node < self.nodes and node not in down
+                down.add(node)
+            elif e.kind == "recover":
+                node = e.get("node")
+                assert node in down, f"recover without crash: {e}"
+                down.discard(node)
+            elif e.kind in ("equivocation_storm", "surround_attack",
+                            "long_range_fork"):
+                assert 0 <= e.get("origin") < self.nodes
+            elif e.kind == "degraded":
+                assert e.get("until_slot") > e.at_slot
+                assert e.at_slot >= degraded_until, \
+                    f"overlapping degraded windows: {e}"
+                degraded_until = e.get("until_slot")
+            else:
+                raise AssertionError(f"unknown event kind {e.kind!r}")
+        assert not down, f"nodes still crashed at scenario end: {down}"
+        assert not partitioned, "partition never healed"
+
+    def burned_validators_hint(self) -> bool:
+        """Whether any event mutes validators from canonical traffic."""
+        return any(e.kind in ("equivocation_storm", "surround_attack",
+                              "long_range_fork") for e in self.events)
+
+
+# ---------------------------------------------------------------------------
+# the named library (scripts/run_scenario.py and the tests use these)
+# ---------------------------------------------------------------------------
+
+LIBRARY: dict = {}
+
+
+def _lib(s: Scenario) -> Scenario:
+    LIBRARY[s.name] = s
+    return s
+
+
+# the zero-event baseline: convergence of plain mainnet-shaped traffic
+_lib(Scenario(name="smoke", nodes=3, slots=4))
+
+# THE acceptance scenario: seeded partition + equivocation storm + one
+# crash-and-recover node, all converging to the oracle head
+_lib(Scenario(
+    name="battlefield3", nodes=3, slots=8,
+    events=(
+        partition(2.0, ((0, 1), (2,))),
+        equivocation_storm(3.2, origin=0, validators=2),
+        crash(4.1, node=1),
+        heal(5.0),
+        recover(6.1, node=1),
+    )))
+
+# surround-vote attack needs two epochs of timeline (minimal preset:
+# 8-slot epochs) — light traffic keeps it quick
+_lib(Scenario(
+    name="surround", nodes=2, slots=10,
+    traffic=TrafficSpec(attestation_fraction=0.5, aggregates=False,
+                        sync_messages=0),
+    events=(surround_attack(9.2, origin=0),)))
+
+# long-range fork: a late-published 2-block fork off slot 2
+_lib(Scenario(
+    name="longrange", nodes=3, slots=7,
+    traffic=TrafficSpec(attestation_fraction=0.5, sync_messages=1),
+    events=(long_range_fork(5.4, origin=2, fork_slot=2, length=2),)))
+
+# breaker-open degraded window riding a partition
+_lib(Scenario(
+    name="degraded_window", nodes=3, slots=6,
+    events=(
+        degraded(1.5, 3.5),
+        partition(2.0, ((0,), (1, 2))),
+        heal(4.0),
+    )))
+
+# the bench scenario: 16 nodes at 10x ingress with a partition+heal
+# burst in the middle (bench.py asserts convergence + bounded shed)
+_lib(Scenario(
+    name="mainnet_burst16", nodes=16, slots=6,
+    traffic=TrafficSpec(ingress_multiplier=10),
+    topology=Topology(link=LinkSpec(delay_s=0.2, jitter_s=0.3)),
+    events=(
+        partition(2.0, (tuple(range(12)), tuple(range(12, 16)))),
+        # origin 1 hosts a slot-1 committee member under the 16-node
+        # home mapping (origin 0 does not host one until slot 5, after
+        # the cut) — the storm planner needs an established pre-cut vote
+        equivocation_storm(2.6, origin=1, validators=4),
+        heal(4.0),
+    )))
+
+
+def named(name: str) -> Scenario:
+    try:
+        return LIBRARY[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown scenario {name!r}; known: {sorted(LIBRARY)}")
+
+
+def randomized(rng, nodes: int | None = None) -> Scenario:
+    """A seeded random scenario inside the convergence envelope: random
+    partition/heal pairs (healed within the staleness window), storms,
+    crash/recover pairs, degraded windows.  Drives the slow-marked
+    scenario-matrix tier — "as many scenarios as you can imagine" as a
+    generator, not a hand-written list."""
+    n = nodes if nodes is not None else rng.choice([3, 4, 5])
+    slots = rng.choice([6, 7, 8])
+    events: list = []
+    # partitions start at slot >= 2 so at least block 1 is established
+    # fleet-wide before the cut (the storm planner's envelope)
+    t = 2.0 + rng.random()
+    if rng.random() < 0.8:      # partition + heal within an epoch
+        ids = list(range(n))
+        rng.shuffle(ids)
+        cut = rng.randrange(1, n)
+        events.append(partition(t, (tuple(ids[:cut]), tuple(ids[cut:]))))
+        heal_at = min(t + 1.0 + 2.0 * rng.random(), slots - 1.0)
+        events.append(heal(max(heal_at, t + 0.5)))
+    if rng.random() < 0.8:
+        # storm slot is int(at_slot) - 1 and needs an established
+        # parent, so the window starts at slot 3
+        events.append(equivocation_storm(
+            3.0 + rng.random() * (slots - 4.0),
+            origin=rng.randrange(n),
+            validators=rng.choice([1, 2, 3])))
+    if rng.random() < 0.6 and n > 2:
+        victim = rng.randrange(1, n)
+        at = 2.0 + rng.random() * (slots - 4.0)
+        events.append(crash(at, node=victim))
+        events.append(recover(
+            min(at + 1.0 + rng.random() * 1.5, slots - 0.5),
+            node=victim))
+    if rng.random() < 0.4:
+        at = 1.0 + rng.random() * (slots - 3.0)
+        events.append(degraded(at, at + 1.0 + rng.random()))
+    if rng.random() < 0.4 and slots >= 6:
+        events.append(long_range_fork(
+            slots - 1.5 + rng.random(), origin=rng.randrange(n),
+            fork_slot=rng.choice([1, 2]), length=rng.choice([1, 2])))
+    scenario = Scenario(
+        name=f"random_{n}n_{slots}s", nodes=n, slots=slots,
+        traffic=TrafficSpec(
+            attestation_fraction=rng.choice([0.5, 1.0]),
+            aggregates=rng.random() < 0.8,
+            sync_messages=rng.choice([0, 1, 2]),
+            ingress_multiplier=rng.choice([1, 2, 3])),
+        topology=Topology(link=LinkSpec(
+            delay_s=0.1 + 0.3 * rng.random(),
+            jitter_s=0.3 * rng.random(),
+            drop_rate=rng.choice([0.0, 0.05, 0.15]))),
+        events=tuple(events))
+    scenario.validate()
+    return scenario

@@ -1,0 +1,219 @@
+"""Seeded simulated network: per-origin FIFO streams with stall/flush
+loss semantics.
+
+The one invariant everything else leans on: **every recipient sees each
+origin's messages in publish order**.  LMD-GHOST's `latest_messages` is
+first-accepted-wins within an epoch and the equivocation guard is
+first-verified-wins, so two nodes that see a conflicting vote pair in
+different orders end up with different stores *forever*.  Because every
+message carrying a given validator's sole vote originates at one node
+(the DSL's home-mapping discipline), per-origin FIFO makes every guard
+and latest-message decision identical fleet-wide — the core of the
+oracle-convergence theorem (docs/scenario.md).
+
+Loss therefore cannot reorder, only delay: a "dropped" message STALLS
+its (origin, dest) stream — it and everything published behind it
+queue head-of-line until the next flush point (slot boundary for drop
+stalls, heal for partition stalls, recovery for crash stalls), then
+deliver in order.  This models what gossipsub redundancy + req/resp
+backfill achieve in a real network: messages are late, rarely truly
+lost, and a resynced peer replays gaps in order.
+
+Mechanics:
+
+* `publish(time, origin, topic, payload)` assigns a global seq and
+  fans the message out to every node (including the origin: a real
+  node processes its own proposals) through per-(origin, dest)
+  streams.  Primary delivery time = publish + delay + seeded jitter,
+  clamped monotonically per stream (FIFO).
+* `ingress_multiplier` extra copies are scheduled strictly AFTER the
+  primary on each stream — mesh-redundancy duplicates can add load
+  (dedup sheds them) but can never flip a first-arrival order.
+* partitions stall whole cross-group streams; `heal()` marks them
+  flushable.  `pump(now)` returns every (dest, message, peer) due for
+  delivery, in (time, seq) order.
+
+Everything is driven by one `random.Random` owned by the driver — no
+wall clock, no global state, bit-identical replay from the seed.
+"""
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass, field
+
+# delivery epsilon: duplicate copies and FIFO clamps space successive
+# deliveries by this much so ordering is strict and reproducible
+EPS = 1e-6
+
+
+@dataclass(order=True)
+class _Delivery:
+    time: float
+    seq: int
+    dup: int                    # 0 = primary copy
+    dest: int = field(compare=False)
+    message: "Publish" = field(compare=False)
+
+
+@dataclass
+class Publish:
+    seq: int
+    time: float                 # publish time (seconds, sim clock)
+    origin: int
+    topic: str
+    payload: object
+    tag: str = "traffic"        # traffic | storm | surround | fork ...
+
+    @property
+    def peer(self) -> str:
+        return f"node{self.origin}"
+
+
+class _Stream:
+    """One (origin, dest) FIFO lane."""
+
+    __slots__ = ("last_time", "stalled", "stall_kind")
+
+    def __init__(self):
+        self.last_time = 0.0    # monotonic delivery clamp
+        self.stalled: list = []  # [(sched_time, Publish), ...] in order
+        self.stall_kind: str | None = None   # drop|partition|crash
+
+
+class SimNetwork:
+    def __init__(self, nodes: int, link, rng,
+                 ingress_multiplier: int = 1):
+        self.n = int(nodes)
+        self.link = link
+        self.rng = rng
+        self.multiplier = max(1, int(ingress_multiplier))
+        self._heap: list = []
+        self._streams = {(o, d): _Stream()
+                         for o in range(self.n) for d in range(self.n)}
+        self._group_of = {i: 0 for i in range(self.n)}   # partition id
+        self._down: set = set()
+        self._seq = 0
+        self.published: list = []        # the canonical feed, in order
+        self.dropped_stalls = 0
+
+    # -- topology state ------------------------------------------------
+    def partition(self, groups) -> None:
+        for gid, group in enumerate(groups):
+            for node in group:
+                self._group_of[int(node)] = gid
+
+    def heal(self) -> None:
+        for node in self._group_of:
+            self._group_of[node] = 0
+
+    def connected(self, a: int, b: int) -> bool:
+        return self._group_of[a] == self._group_of[b]
+
+    def node_down(self, node: int, down: bool = True) -> None:
+        if down:
+            self._down.add(int(node))
+        else:
+            self._down.discard(int(node))
+
+    # -- publish -------------------------------------------------------
+    def publish(self, time: float, origin: int, topic: str, payload,
+                tag: str = "traffic") -> Publish:
+        self._seq += 1
+        msg = Publish(self._seq, float(time), int(origin), topic,
+                      payload, tag)
+        self.published.append(msg)
+        for dest in range(self.n):
+            self._schedule(msg, dest)
+        return msg
+
+    def _schedule(self, msg: Publish, dest: int) -> None:
+        stream = self._streams[(msg.origin, dest)]
+        link = self.link
+        if msg.origin == dest:
+            delay = EPS                  # local publication
+            dropped = False
+        else:
+            delay = (link.delay_s
+                     + link.jitter_s * self.rng.random())
+            dropped = (link.drop_rate > 0.0
+                       and self.rng.random() < link.drop_rate)
+        when = msg.time + delay
+        blocked = (not self.connected(msg.origin, dest)
+                   or dest in self._down)
+        if stream.stalled or dropped or blocked:
+            # head-of-line: once anything on the stream stalls, every
+            # later message queues behind it — loss may delay, never
+            # reorder
+            if not stream.stalled:
+                stream.stall_kind = ("drop" if dropped else
+                                     "crash" if dest in self._down
+                                     else "partition")
+                if dropped:
+                    self.dropped_stalls += 1
+            stream.stalled.append((when, msg))
+            return
+        self._push(msg, dest, when)
+
+    def _push(self, msg: Publish, dest: int, when: float) -> None:
+        stream = self._streams[(msg.origin, dest)]
+        when = max(when, stream.last_time + EPS)     # FIFO clamp
+        stream.last_time = when
+        heapq.heappush(self._heap, _Delivery(when, msg.seq, 0, dest,
+                                             msg))
+        for dup in range(1, self.multiplier):
+            # redundant mesh copies: strictly after the primary
+            extra = when + EPS * dup + 0.01 * self.rng.random()
+            heapq.heappush(self._heap,
+                           _Delivery(extra, msg.seq, dup, dest, msg))
+
+    # -- stall release -------------------------------------------------
+    def flush_stalls(self, now: float, kinds=("drop",)) -> int:
+        """Release stalled streams whose blocking condition cleared:
+        called with kinds=("drop",) each slot boundary (gossip
+        redundancy re-covers plain losses fast), and with
+        ("drop", "partition", "crash") at heal / recovery sync points.
+        Streams still blocked (cross-partition, dest down) stay
+        stalled.  Returns released message count."""
+        released = 0
+        for (origin, dest), stream in self._streams.items():
+            if not stream.stalled or stream.stall_kind not in kinds:
+                continue
+            if not self.connected(origin, dest) or dest in self._down:
+                continue
+            # seq order, not arrival-at-stall order: an in-flight
+            # message re-stalled at pump time may have been appended
+            # after a younger direct-to-stall publish
+            for _when, msg in sorted(stream.stalled,
+                                     key=lambda p: p[1].seq):
+                self._push(msg, dest, now + EPS)
+                released += 1
+            stream.stalled.clear()
+            stream.stall_kind = None
+        return released
+
+    def stalled_count(self) -> int:
+        return sum(len(s.stalled) for s in self._streams.values())
+
+    # -- delivery ------------------------------------------------------
+    def pump(self, now: float) -> list:
+        """Every delivery due at or before `now`, in (time, seq, dup)
+        order.  Deliveries to crashed nodes are silently re-stalled on
+        their stream (the node is not listening; recovery sync replays
+        the feed anyway)."""
+        due = []
+        while self._heap and self._heap[0].time <= now + 1e-12:
+            d = heapq.heappop(self._heap)
+            if d.dest in self._down:
+                continue        # lost with the crash; sync repairs
+            if not self.connected(d.message.origin, d.dest):
+                # partitioned mid-flight: decided at delivery time
+                stream = self._streams[(d.message.origin, d.dest)]
+                if d.dup == 0:
+                    stream.stalled.append((d.time, d.message))
+                    stream.stall_kind = stream.stall_kind or "partition"
+                continue
+            due.append(d)
+        return [(d.dest, d.message) for d in due]
+
+    def idle(self) -> bool:
+        return not self._heap and self.stalled_count() == 0

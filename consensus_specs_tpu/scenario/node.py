@@ -1,0 +1,203 @@
+"""One simulated node: gossip pipeline + transactional store + its own
+observability namespace.
+
+A `SimNode` owns everything PR 1-6 built, instantiated per node:
+
+* an `AdmissionPipeline` over its own fork-choice store, with its own
+  injected clock, quotas, dedup cache and equivocation guard;
+* a `txn.TxnManager` around its own write-ahead `Journal` — every
+  handler the pipeline delivers commits atomically and is replayable;
+* a `NodeContext` carrying a `Metrics(node_id=...)` registry and an
+  `IncidentLog(node_id=..., clock=sim)` — every metric and incident
+  from this node's steps lands in ITS books, which is what fleet-wide
+  attribution asserts against.
+
+Durable vs volatile state is the crash model's contract:
+
+    durable   — the WAL journal (disk in a real node) and the
+                equivocation guard (the slashing-protection DB real
+                validators persist separately from the store);
+    volatile  — the store (recovered via `txn.recover()`), the
+                pipeline (queues, dedup cache, quotas, batch window:
+                in-flight messages die with the process and come back
+                through the driver's sync replay).
+
+Handler execution always runs inside `scope()` — node context +
+`txn.use(manager)` — so a store mutation can neither escape the
+transaction nor mis-attribute its incidents.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+from .. import txn
+from ..gossip import AdmissionPipeline, GossipConfig
+from ..gossip.dedup import EquivocationGuard
+from ..resilience.incidents import IncidentLog
+from ..sigpipe.metrics import Metrics
+from ..test_infra.fork_choice import get_genesis_forkchoice_store
+from ..utils import nodectx
+
+
+class SimNode:
+    def __init__(self, node_id: int, spec, anchor_state, clock,
+                 config: GossipConfig | None = None, transport=None,
+                 snapshot_interval: int = 256):
+        self.node_id = int(node_id)
+        self.name = f"node{node_id}"
+        self.spec = spec
+        self.clock = clock
+        self.anchor_state = anchor_state
+        self.config = config or GossipConfig(
+            # convergence scenarios want backpressure, not starvation:
+            # quotas generous by default (the bench scenario overrides)
+            bucket_capacity=1 << 14, refill_rate=1 << 12,
+            queue_depth=1 << 12)
+        self.ctx = nodectx.NodeContext(
+            self.name, metrics=Metrics(node_id=self.name),
+            incidents=IncidentLog(max_entries=1 << 14,
+                                  node_id=self.name, clock=clock))
+        # durable state
+        self.journal = txn.Journal()
+        self.manager = txn.TxnManager(self.journal,
+                                      snapshot_interval=snapshot_interval)
+        self.guard = EquivocationGuard()
+        # volatile state
+        self.transport = transport
+        self.store = None
+        self.pipe = None
+        self.up = False
+        # driver-side bookkeeping (observability, not node state)
+        self.accepted: set = set()           # digests applied to store
+        self.seq_digest: dict = {}           # live pipeline seq -> digest
+        self.retry: list = []                # [(due_s, topic, payload, peer)]
+        self.crashes = 0
+        self.boot()
+
+    # -- lifecycle -----------------------------------------------------
+    def boot(self) -> None:
+        assert not self.up
+        if self.store is None:
+            self.store = get_genesis_forkchoice_store(self.spec,
+                                                      self.anchor_state)
+        self.pipe = AdmissionPipeline(
+            self.spec, self.store, self.config, self.clock,
+            guard=self.guard, transport=self.transport, ctx=self.ctx)
+        self.seq_digest = {}
+        self.up = True
+
+    def crash(self) -> None:
+        """Power cut: volatile state gone; journal + guard survive."""
+        assert self.up
+        self.up = False
+        self.crashes += 1
+        self.store = None
+        self.pipe = None
+        self.seq_digest = {}
+        self.retry = []
+
+    def recover(self, now_time: int) -> None:
+        """Rebuild the store from the journal (`txn.recover` verifies
+        the snapshot root and replays the committed tail — the
+        `recovered` incident lands in THIS node's log), tick forward to
+        the present, and restart the pipeline around the durable
+        guard."""
+        assert not self.up and self.store is None
+        with self.scope():
+            self.store = txn.recover(self.spec, self.journal)
+        self.boot()
+        self.tick(now_time)
+
+    @contextmanager
+    def scope(self):
+        with nodectx.use(self.ctx):
+            with txn.use(self.manager):
+                yield
+
+    # -- the driver-facing surface -------------------------------------
+    def tick(self, time: int) -> None:
+        if not self.up:
+            return
+        if int(self.store.time) >= int(time):
+            return
+        with self.scope():
+            self.spec.on_tick(self.store, int(time))
+
+    def submit(self, topic: str, payload, digest: bytes,
+               peer: str) -> None:
+        if not self.up:
+            return
+        with self.scope():
+            seq = self.pipe.submit(topic, payload, peer=peer)
+        self.seq_digest[seq] = (digest, topic, payload, peer)
+
+    def poll(self) -> None:
+        if not self.up:
+            return
+        with self.scope():
+            self.pipe.poll()
+        self._harvest()
+
+    def drain(self) -> None:
+        if not self.up:
+            return
+        with self.scope():
+            self.pipe.drain()
+        self._harvest()
+
+    def _harvest(self) -> None:
+        """Fold finalized pipeline verdicts into the accepted-digest
+        set and the retry queue (a REJECTED message is usually a
+        transient ordering artifact — a block before its parent, an
+        attestation before its target — redelivered a little later,
+        exactly like mesh redelivery)."""
+        done = []
+        for seq, (digest, topic, payload, peer) in \
+                self.seq_digest.items():
+            result = self.pipe.results.get(seq)
+            if result is None or not result.final:
+                continue
+            done.append(seq)
+            if result.status == "accepted":
+                self.accepted.add(digest)
+            elif result.status == "rejected":
+                self.retry.append((self.clock.now() + 1.0, topic,
+                                   payload, peer, digest))
+        for seq in done:
+            del self.seq_digest[seq]
+
+    def pump_retries(self, now: float, max_attempts: int = 64) -> int:
+        """Redeliver due rejected messages; bounded by list turnover
+        (each redelivery re-enters _harvest if it fails again).  Due
+        items past `max_attempts` stay queued for the next pump."""
+        if not self.up or not self.retry:
+            return 0
+        due = [r for r in self.retry if r[0] <= now]
+        self.retry = [r for r in self.retry if r[0] > now] \
+            + due[max_attempts:]
+        for _t, topic, payload, peer, digest in due[:max_attempts]:
+            self.submit(topic, payload, digest, peer)
+        return len(due[:max_attempts])
+
+    # -- reporting -----------------------------------------------------
+    def head_root(self) -> bytes:
+        head = self.spec.get_head(self.store)
+        return bytes(getattr(head, "root", head))
+
+    def store_root(self) -> bytes:
+        return txn.store_root(self.store)
+
+    def leak_check(self) -> None:
+        """No deadlock, no unbounded queue/peer/history state: called
+        after the final drain."""
+        assert self.up, f"{self.name} ended the scenario down"
+        assert self.pipe.pending_count() == 0, \
+            f"{self.name} still has queued messages"
+        statuses = [r.status for r in self.pipe.results.values()]
+        assert "queued" not in statuses, f"{self.name} stuck message"
+        assert "deferred" not in statuses, \
+            f"{self.name} starved a deferred message"
+        cfg = self.config
+        assert len(self.pipe.seen) <= cfg.seen_cache_size
+        assert len(self.pipe.results) <= cfg.history_bound + \
+            len(self.seq_digest) + 1

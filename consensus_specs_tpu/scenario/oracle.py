@@ -1,0 +1,219 @@
+"""The omniscient sequential oracle + the convergence/attribution
+contract.
+
+The oracle is a SimNode stripped of the network: one scalar-only
+admission pipeline (same admission semantics — dedup, equivocation
+guard, quotas so generous they never bind — no micro-batching, no
+transactions) consuming the ENTIRE canonical feed in publish order at
+publish time.  It is what a node with a perfect network would compute.
+
+The contract the driver's report is asserted against:
+
+* convergence — after heal + sync, every node's head and finalized
+  checkpoint equal the oracle's, and (for scenarios inside the
+  determinism envelope, dsl.py) `txn.store_root(node.store)` is
+  byte-identical to the oracle's store root;
+* attribution — every adversarial event left a fingerprint in some
+  node's OWN incident log: storm/surround/fork -> a
+  `gossip.equivocation` quarantine naming each burned validator;
+  crash -> that node's `txn.recover` `recovered` incident;
+  partition -> a `scenario.sync` catch-up incident on a healed node;
+  degraded -> an injected-fault or breaker incident at the window's
+  site in some node's log;
+* liveness — no node deadlocked or leaked unbounded state
+  (SimNode.leak_check, called by the driver before reporting).
+"""
+from __future__ import annotations
+
+from ..gossip import AdmissionPipeline, GossipConfig
+from ..gossip.dedup import EquivocationGuard
+from ..resilience.incidents import IncidentLog
+from ..sigpipe.metrics import Metrics
+from ..test_infra.fork_choice import get_genesis_forkchoice_store
+from ..utils import nodectx
+from .. import txn
+
+ORACLE_CONFIG = GossipConfig(
+    queue_depth=1 << 16, bucket_capacity=float(1 << 30),
+    refill_rate=float(1 << 30), max_peers=1 << 12,
+    seen_cache_size=1 << 18, history_bound=1 << 18,
+    scalar_only=True)
+
+
+class Oracle:
+    """The sequential reference consumer of the canonical feed."""
+
+    def __init__(self, spec, plan, clock):
+        self.spec = spec
+        self.clock = clock
+        self.ctx = nodectx.NodeContext(
+            "oracle", metrics=Metrics(node_id="oracle"),
+            incidents=IncidentLog(max_entries=1 << 14,
+                                  node_id="oracle", clock=clock))
+        self.store = get_genesis_forkchoice_store(spec,
+                                                  plan.genesis_state)
+        self.guard = EquivocationGuard()
+        self.pipe = AdmissionPipeline(spec, self.store, ORACLE_CONFIG,
+                                      clock, guard=self.guard,
+                                      ctx=self.ctx)
+        self.accepted: set = set()
+        self._seq_digest: dict = {}
+        self.retry: list = []
+
+    def deliver(self, topic, payload, digest: bytes,
+                peer: str) -> None:
+        with nodectx.use(self.ctx):
+            seq = self.pipe.submit(topic, payload, peer=peer)
+        self._seq_digest[seq] = (digest, topic, payload, peer)
+
+    def tick(self, time: int) -> None:
+        if int(self.store.time) < int(time):
+            with nodectx.use(self.ctx):
+                self.spec.on_tick(self.store, int(time))
+
+    def poll(self) -> None:
+        with nodectx.use(self.ctx):
+            self.pipe.poll()
+        self._harvest()
+
+    def drain(self) -> None:
+        with nodectx.use(self.ctx):
+            self.pipe.drain()
+        self._harvest()
+
+    def pump_retries(self, now: float) -> None:
+        """The oracle consumes in publish order, so retries only cover
+        same-instant ordering artifacts; normally empty."""
+        due = [r for r in self.retry if r[0] <= now]
+        self.retry = [r for r in self.retry if r[0] > now]
+        for _t, digest, topic, payload, peer in due:
+            self.deliver(topic, payload, digest, peer)
+
+    def _harvest(self) -> None:
+        done = []
+        for seq, (digest, topic, payload, peer) in \
+                self._seq_digest.items():
+            result = self.pipe.results.get(seq)
+            if result is None or not result.final:
+                continue
+            done.append(seq)
+            if result.status == "accepted":
+                self.accepted.add(digest)
+            elif result.status == "rejected":
+                self.retry.append((self.clock.now() + 1.0, digest,
+                                   topic, payload, peer))
+        for seq in done:
+            del self._seq_digest[seq]
+
+    def head_root(self) -> bytes:
+        head = self.spec.get_head(self.store)
+        return bytes(getattr(head, "root", head))
+
+    def summary(self) -> dict:
+        checkpoint = self.store.finalized_checkpoint
+        return {
+            "node_id": "oracle",
+            "store_root": txn.store_root(self.store).hex(),
+            "head": self.head_root().hex(),
+            "finalized": (int(checkpoint.epoch),
+                          bytes(checkpoint.root).hex()),
+            "accepted": len(self.accepted),
+            "metrics": self.ctx.metrics.snapshot(),
+            "incidents": self.ctx.incidents.snapshot(),
+        }
+
+
+def node_summary(node) -> dict:
+    checkpoint = node.store.finalized_checkpoint
+    return {
+        "node_id": node.name,
+        "store_root": node.store_root().hex(),
+        "head": node.head_root().hex(),
+        "finalized": (int(checkpoint.epoch),
+                      bytes(checkpoint.root).hex()),
+        "accepted": len(node.accepted),
+        "crashes": node.crashes,
+        "quarantined": sorted(node.guard.quarantined),
+        "metrics": node.ctx.metrics.snapshot(),
+        "incidents": node.ctx.incidents.snapshot(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# assertions
+# ---------------------------------------------------------------------------
+
+def assert_converged(report) -> None:
+    """Every node reached the oracle: heads and finalized checkpoints
+    always; byte-identical store roots when the scenario is inside the
+    determinism envelope."""
+    oracle = report.oracle
+    for node in report.nodes:
+        assert node["head"] == oracle["head"], \
+            f"{node['node_id']} head {node['head'][:12]}.. != " \
+            f"oracle {oracle['head'][:12]}.."
+        assert node["finalized"] == oracle["finalized"], \
+            f"{node['node_id']} finalized diverged"
+        if report.scenario.assert_store_identity:
+            assert node["store_root"] == oracle["store_root"], \
+                f"{node['node_id']} store_root diverged from oracle"
+
+
+def _quarantine_incidents(nodes) -> list:
+    out = []
+    for node in nodes:
+        for e in node["incidents"]:
+            if e["site"] == "gossip.equivocation" \
+                    and e["event"] == "quarantine":
+                out.append(e)
+    return out
+
+
+def attribution_report(plan, summaries) -> dict:
+    """For every adversarial event: which node-tagged incidents pin it
+    (`summaries` are `node_summary` dicts).  Keys are `kind@at_slot`;
+    every entry must end up `attributed`."""
+    quarantines = _quarantine_incidents(summaries)
+    report = {}
+    for event in plan.scenario.sorted_events():
+        key = f"{event.kind}@{event.at_slot}"
+        entry = {"attributed": False, "incidents": []}
+        if event.kind in ("equivocation_storm", "surround_attack",
+                          "long_range_fork"):
+            expected = set(plan.expected[event]["validators"])
+            hits = [q for q in quarantines
+                    if q.get("validator_index") in expected]
+            entry["incidents"] = hits
+            entry["attributed"] = \
+                {q["validator_index"] for q in hits} == expected
+        elif event.kind == "crash":
+            name = f"node{event.get('node')}"
+            hits = [e for s in summaries if s["node_id"] == name
+                    for e in s["incidents"]
+                    if e["site"] == "txn.recover"
+                    and e["event"] == "recovered"]
+            entry["incidents"] = hits
+            entry["attributed"] = bool(hits)
+        elif event.kind == "partition":
+            hits = [e for s in summaries for e in s["incidents"]
+                    if e["site"] == "scenario.sync"
+                    and e.get("replayed", 0) > 0]
+            entry["incidents"] = hits
+            entry["attributed"] = bool(hits)
+        elif event.kind == "degraded":
+            site = event.get("site")
+            hits = [e for s in summaries for e in s["incidents"]
+                    if e["site"] == site]
+            entry["incidents"] = hits
+            entry["attributed"] = bool(hits)
+        elif event.kind in ("heal", "recover"):
+            continue            # remedies, not attacks
+        report[key] = entry
+    return report
+
+
+def assert_attributed(report) -> None:
+    for key, entry in report.attribution.items():
+        assert entry["attributed"], \
+            f"adversarial event {key} left no node-tagged incident " \
+            f"({len(entry['incidents'])} partial hits)"

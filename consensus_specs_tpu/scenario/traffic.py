@@ -1,0 +1,386 @@
+"""Canonical chain + traffic feed + adversarial message crafting.
+
+The feed is computed UP FRONT from the scenario and the seeded RNG:
+one canonical chain (built once, independent of any node's behavior —
+empty blocks, so justification stays at the anchor and a long-range
+fork can never race a moving finality frontier), plus every message
+any node will ever publish, each stamped with its publish time and its
+ORIGIN node.  The driver feeds these through the simulated network;
+the oracle consumes the same list in publish order.  Pre-computation
+is what makes the run a pure function of `(scenario, seed)` — and what
+gives the anti-entropy sync a canonical replay order.
+
+Home mapping: validator `v` lives on node `v % nodes`; every message
+carrying v's sole vote originates there — except adversarial events,
+which pick their validators from the EVENT ORIGIN's population, so the
+per-origin FIFO invariant (net.py) still covers every conflicting
+pair.
+
+Burned validators — those an adversarial event makes provably
+slashable (storm equivocators, the surround voter, long-range-fork
+proposers) — are muted from canonical SOLO traffic: their conflicting
+votes come from the event itself, so a quarantine decision can never
+race an honest same-validator vote published from another origin.
+They still propose their canonical blocks (blocks are exempt from the
+pre-delivery gate) and still ride committee aggregates (multi-signer
+messages are never shed).  This mirrors reality: a slashed validator's
+solo voice disappears from the network.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..ssz import hash_tree_root, uint64
+from ..test_infra.attestations import (
+    build_attestation_data, get_valid_attestation, sign_attestation)
+from ..test_infra.blocks import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block)
+from ..test_infra.genesis import create_genesis_state, default_balances
+from ..test_infra.keys import privkey_for_pubkey
+
+
+@dataclass
+class Planned:
+    time_s: float
+    origin: int
+    topic: str
+    payload: object
+    tag: str
+
+
+@dataclass
+class EventAction:
+    """A non-message control point on the timeline (partition, heal,
+    crash, recover, degraded open/close)."""
+    time_s: float
+    kind: str
+    params: dict
+
+
+class TrafficPlan:
+    """Everything the driver replays: canonical chain, message feed
+    (publish order), control actions, burned validators, and the
+    adversarial bookkeeping the attribution report checks against."""
+
+    def __init__(self, spec, scenario, rng):
+        self.spec = spec
+        self.scenario = scenario
+        self.seconds_per_slot = int(spec.config.SECONDS_PER_SLOT)
+        self.attest_offset = (self.seconds_per_slot
+                              // int(spec.INTERVALS_PER_SLOT))
+        self.genesis_state = create_genesis_state(
+            spec, default_balances(spec))
+        self.genesis_time = int(self.genesis_state.genesis_time)
+        # canonical chain: slot -> (root, signed_block, post_state)
+        self.chain: dict = {}
+        self.block_slots: dict = {}          # proposer bookkeeping
+        self.messages: list = []
+        self.actions: list = []
+        self.burned: set = set()
+        self.expected: dict = {}             # event -> attribution facts
+        self._build(rng)
+
+    # -- helpers -------------------------------------------------------
+    def slot_time(self, slot: float) -> float:
+        return float(slot) * self.seconds_per_slot
+
+    def home(self, validator_index: int) -> int:
+        return int(validator_index) % self.scenario.nodes
+
+    def _committee_members(self, state, slot):
+        spec = self.spec
+        members = []
+        count = int(spec.get_committee_count_per_slot(
+            state, spec.compute_epoch_at_slot(uint64(slot))))
+        for index in range(count):
+            for v in spec.get_beacon_committee(state, uint64(slot),
+                                               uint64(index)):
+                members.append((int(v), index))
+        return members
+
+    def _solo_attestation(self, state, slot, index, validator,
+                          beacon_block_root=None):
+        return get_valid_attestation(
+            self.spec, state, slot=uint64(slot), index=index,
+            filter_participant_set=lambda s, v=validator: {v},
+            signed=True, beacon_block_root=beacon_block_root)
+
+    # -- the build -----------------------------------------------------
+    def _build(self, rng) -> None:
+        spec, scenario = self.spec, self.scenario
+        state = self.genesis_state.copy()
+        anchor_root = None   # slot 0 lives in the anchor store already
+
+        # 1. canonical chain (deterministic, rng-free)
+        for slot in range(1, scenario.slots + 1):
+            block = build_empty_block_for_next_slot(spec, state)
+            signed = state_transition_and_sign_block(spec, state, block)
+            root = bytes(hash_tree_root(signed.message))
+            self.chain[slot] = (root, signed, state.copy())
+            self.block_slots[slot] = int(signed.message.proposer_index)
+
+        # 2. adversarial events: crafted messages + control actions +
+        #    the burned set (computed BEFORE canonical attestations so
+        #    muting can apply)
+        for event in scenario.sorted_events():
+            self._plan_event(event, rng)
+
+        # 3. canonical traffic
+        traffic = scenario.traffic
+        for slot in range(1, scenario.slots + 1):
+            root, signed, post = self.chain[slot]
+            proposer = self.block_slots[slot]
+            # the block, published at the attesting-interval boundary
+            # (untimely by construction: uniform block_timeliness —
+            # see dsl.py's determinism discipline)
+            self.messages.append(Planned(
+                self.slot_time(slot) + self.attest_offset,
+                self.home(proposer), "block", signed, "block"))
+            # solo attestations for `slot`, published next slot (the
+            # handler applies a vote only after its slot has passed)
+            base = self.slot_time(slot + 1)
+            for validator, index in self._committee_members(post, slot):
+                if validator in self.burned:
+                    continue
+                if rng.random() >= traffic.attestation_fraction:
+                    continue
+                att = self._solo_attestation(post, slot, index,
+                                             validator)
+                self.messages.append(Planned(
+                    base + 0.8 * rng.random(), self.home(validator),
+                    "attestation", att, "attestation"))
+            # one aggregate per committee (full participation),
+            # published by its aggregator next slot
+            if traffic.aggregates:
+                count = int(spec.get_committee_count_per_slot(
+                    post, spec.compute_epoch_at_slot(uint64(slot))))
+                for index in range(count):
+                    committee = [int(v) for v in spec.get_beacon_committee(
+                        post, uint64(slot), uint64(index))]
+                    agg = get_valid_attestation(
+                        spec, post, slot=uint64(slot), index=index,
+                        signed=True)
+                    aggregator = committee[0]
+                    sap = self._aggregate_and_proof(post, agg,
+                                                    aggregator)
+                    self.messages.append(Planned(
+                        base + 0.4 + 0.4 * rng.random(),
+                        self.home(aggregator), "aggregate", sap,
+                        "aggregate"))
+            # sync-committee messages for this slot's block
+            for k in range(traffic.sync_messages):
+                pubkey = bytes(post.current_sync_committee.pubkeys[
+                    (slot + k) % len(post.current_sync_committee.pubkeys)])
+                validator = next(
+                    i for i, v in enumerate(post.validators)
+                    if bytes(v.pubkey) == pubkey)
+                msg = spec.get_sync_committee_message(
+                    post, root, uint64(validator),
+                    privkey_for_pubkey(pubkey))
+                self.messages.append(Planned(
+                    self.slot_time(slot) + self.attest_offset + 1.0
+                    + 0.5 * rng.random(),
+                    self.home(validator), "sync", msg, "sync"))
+
+        self.messages.sort(key=lambda p: p.time_s)
+        self.actions.sort(key=lambda a: a.time_s)
+
+    def _aggregate_and_proof(self, state, attestation, aggregator):
+        spec = self.spec
+        privkey = privkey_for_pubkey(
+            state.validators[int(aggregator)].pubkey)
+        proof = spec.get_aggregate_and_proof(
+            state, uint64(aggregator), attestation, privkey)
+        signature = spec.get_aggregate_and_proof_signature(
+            state, proof, privkey)
+        return spec.SignedAggregateAndProof(message=proof,
+                                            signature=signature)
+
+    # -- adversarial events --------------------------------------------
+    def _plan_event(self, event, rng) -> None:
+        t = self.slot_time(event.at_slot)
+        kind = event.kind
+        if kind in ("partition", "heal", "crash", "recover",
+                    "degraded"):
+            self.actions.append(EventAction(
+                t, kind, {k: v for k, v in event.params}))
+            if kind == "degraded":
+                self.actions.append(EventAction(
+                    self.slot_time(event.get("until_slot")),
+                    "degraded_end", {"site": event.get("site")}))
+            return
+        if kind == "equivocation_storm":
+            self._plan_storm(event, t, rng)
+        elif kind == "surround_attack":
+            self._plan_surround(event, t)
+        elif kind == "long_range_fork":
+            self._plan_fork(event, t)
+        else:                                # pragma: no cover
+            raise AssertionError(f"unplanned event kind {kind!r}")
+
+    def _partition_group_at(self, at_slot: float, node: int):
+        """The partition group `node` sits in at `at_slot` (None when
+        the mesh is whole) — the planner replays partition/heal
+        events."""
+        groups = None
+        for e in self.scenario.sorted_events():
+            if e.at_slot >= at_slot:
+                break
+            if e.kind == "partition":
+                groups = e.get("groups")
+            elif e.kind == "heal":
+                groups = None
+        if groups is None:
+            return None
+        for g in groups:
+            if node in g:
+                return frozenset(g)
+        return None                          # pragma: no cover
+
+    def _established_storm_slot(self, event) -> int:
+        """The attestation slot for a storm: the latest slot whose head
+        block is provably deliverable to every node the storm can reach
+        BEFORE heal (the origin's partition group) and which has an
+        origin-hosted committee member.  Convergence depends on this:
+        if some reachable node cannot apply vote1 (missing block), it
+        accepts vote2 first and its latest-message entry inverts
+        against the fleet — the exact first-wins asymmetry the
+        per-origin FIFO discipline exists to prevent."""
+        origin = event.get("origin")
+        group = self._partition_group_at(event.at_slot, origin)
+        link = self.scenario.topology.link
+        margin = link.delay_s + link.jitter_s + 0.1
+        cut = None
+        if group is not None:
+            for e in self.scenario.sorted_events():
+                if e.kind == "partition" and e.at_slot < event.at_slot:
+                    cut = self.slot_time(e.at_slot)
+        for slot in range(int(event.at_slot) - 1, 0, -1):
+            _root, _signed, post = self.chain[slot]
+            if not any(self.home(v) == origin for v, _idx in
+                       self._committee_members(post, slot)):
+                continue
+            if group is not None:
+                in_group = self.home(self.block_slots[slot]) in group
+                if link.drop_rate > 0.0:
+                    # a drop-stalled block stream only flushes at the
+                    # NEXT slot boundary — if the cut lands first, the
+                    # drop stall becomes a partition stall and the
+                    # block is unestablished until heal
+                    publish = self.slot_time(slot + 1)
+                else:
+                    publish = self.slot_time(slot) + self.attest_offset
+                pre_cut = publish + margin < cut
+                if not (in_group or pre_cut):
+                    continue
+            return slot
+        raise AssertionError(
+            f"no established storm slot for {event}: the partition "
+            f"predates every block the origin's group could hold")
+
+    def _plan_storm(self, event, t, rng) -> None:
+        """Double votes: for each picked validator, the real head vote
+        for the established storm slot immediately followed by a
+        conflicting same-target vote for its parent — both valid on
+        their own, provably slashable together."""
+        origin = event.get("origin")
+        slot = self._established_storm_slot(event)
+        _root, _signed, post = self.chain[slot]
+        hosted = [(v, idx) for v, idx in
+                  self._committee_members(post, slot)
+                  if self.home(v) == origin]
+        picks = hosted[:event.get("validators")]
+        parent_root = self.chain[slot - 1][0] if slot >= 2 else bytes(
+            hash_tree_root(self.spec.BeaconBlock(
+                state_root=hash_tree_root(self.genesis_state))))
+        victims = []
+        for offset, (validator, index) in enumerate(picks):
+            vote1 = self._solo_attestation(post, slot, index, validator)
+            vote2 = self._solo_attestation(post, slot, index, validator,
+                                           beacon_block_root=parent_root)
+            at = t + 0.02 * offset
+            self.messages.append(Planned(at, origin, "attestation",
+                                         vote1, "storm"))
+            self.messages.append(Planned(at + 0.005, origin,
+                                         "attestation", vote2, "storm"))
+            victims.append(validator)
+            self.burned.add(validator)
+        self.expected[event] = {"validators": victims}
+
+    def _plan_surround(self, event, t) -> None:
+        """A verified (source 0, target 1) vote, then a crafted
+        (source 1, target 0) vote at an epoch-0 slot: the recorded vote
+        surrounds it — the second arm of is_slashable_attestation_data,
+        caught by the guard's FFG history."""
+        spec = self.spec
+        origin = event.get("origin")
+        epoch_slots = int(spec.SLOTS_PER_EPOCH)
+        assert event.at_slot > epoch_slots + 1, \
+            "surround needs an epoch-1 voting slot in the past"
+        # v must sit in a committee at an epoch-1 slot that has passed,
+        # and (like every validator) in exactly one epoch-0 committee
+        pick = None
+        for slot1 in range(epoch_slots, int(event.at_slot)):
+            _r, _s, post1 = self.chain[slot1]
+            for v, idx in self._committee_members(post1, slot1):
+                if self.home(v) == origin:
+                    pick = (v, idx, slot1, post1)
+                    break
+            if pick:
+                break
+        assert pick, "origin hosts no epoch-1 committee member yet"
+        validator, index1, slot1, post1 = pick
+        vote1 = self._solo_attestation(post1, slot1, index1, validator)
+        # the validator's epoch-0 committee slot
+        slot0 = index0 = None
+        for s in range(1, epoch_slots):
+            _r, _sg, post0 = self.chain[s]
+            for v, idx in self._committee_members(post0, s):
+                if v == validator:
+                    slot0, index0, state0 = s, idx, post0
+                    break
+            if slot0 is not None:
+                break
+        assert slot0 is not None, "validator missing from epoch 0"
+        vote2 = self._solo_attestation(state0, slot0, index0, validator)
+        vote2.data.source = spec.Checkpoint(
+            epoch=uint64(1), root=vote1.data.target.root)
+        sign_attestation(spec, state0, vote2)     # re-sign the mutation
+        self.messages.append(Planned(t, origin, "attestation", vote1,
+                                     "surround"))
+        self.messages.append(Planned(t + 0.005, origin, "attestation",
+                                     vote2, "surround"))
+        self.burned.add(validator)
+        self.expected[event] = {"validators": [validator]}
+
+    def _plan_fork(self, event, t) -> None:
+        """A late-published fork off the canonical block at
+        `fork_slot`: every fork block is a second proposal for an
+        already-proposed slot (empty blocks leave the randao mix and
+        balances identical, so the fork proposer IS the canonical
+        proposer) — proposer equivocation the guard quarantines
+        post-acceptance."""
+        spec = self.spec
+        origin = event.get("origin")
+        fork_slot = event.get("fork_slot")
+        length = event.get("length")
+        assert fork_slot + length <= self.scenario.slots, \
+            "fork must stay within proposed slots"
+        state = self.chain[fork_slot][2].copy()
+        # perturb the graffiti so the fork block differs from the
+        # canonical one even at fork_slot + 1 (parent root already
+        # differs from slot +2 on)
+        proposers = []
+        for slot in range(fork_slot + 1, fork_slot + 1 + length):
+            block = build_empty_block_for_next_slot(spec, state)
+            block.body.graffiti = b"\x66" * 32    # 'f' is for fork
+            signed = state_transition_and_sign_block(spec, state, block)
+            proposer = int(signed.message.proposer_index)
+            assert proposer == self.block_slots[slot], \
+                "fork proposer drifted from canonical (randao changed?)"
+            proposers.append(proposer)
+            self.burned.add(proposer)
+            self.messages.append(Planned(
+                t + 0.05 * (slot - fork_slot), origin, "block", signed,
+                "fork"))
+        self.expected[event] = {"validators": proposers}

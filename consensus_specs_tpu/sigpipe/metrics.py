@@ -6,6 +6,14 @@ describes a whole verification run (batch sizes, dispatch count, bisection
 depth, cache hit rate, breaker trips, fallback reasons) — dumpable as JSON
 for `bench.py` and asserted on by tests/test_sigpipe.py.
 
+`METRICS` is a *router*, not a bare registry: every call consults the
+node-context stack (utils/nodectx.py) and lands in the active node's
+own `Metrics` instance when the scenario harness installed one, or in
+the process-global default otherwise.  Single-node callers never see
+the difference; the multi-node driver gets per-node books (each tagged
+with its `node_id`, which `snapshot()` carries) from the exact same
+call sites.
+
 Thread-safe: a single re-entrant lock guards every mutation and snapshot.
 The gossip-path follow-up (ROADMAP) and the supervisor's watchdog thread
 both touch the registry off the main thread; per-counter races would make
@@ -58,10 +66,13 @@ import threading
 import time
 from contextlib import contextmanager
 
+from ..utils import nodectx
+
 
 class Metrics:
-    def __init__(self):
+    def __init__(self, node_id: str | None = None):
         self._lock = threading.RLock()
+        self.node_id = node_id
         self.reset()
 
     def reset(self) -> None:
@@ -142,6 +153,8 @@ class Metrics:
     def snapshot(self) -> dict:
         with self._lock:
             out = dict(self.counters)
+            if self.node_id is not None:
+                out["node_id"] = self.node_id
             for name, series in self.labeled.items():
                 out[name] = dict(series)
             for name, o in self.observations.items():
@@ -172,4 +185,4 @@ class Metrics:
         return json.dumps(self.snapshot(), sort_keys=True)
 
 
-METRICS = Metrics()
+METRICS = nodectx.Router(Metrics(), "metrics")

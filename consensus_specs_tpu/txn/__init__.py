@@ -175,6 +175,25 @@ def scope(journal: Journal | None = None, snapshot_interval: int = 32):
 
 
 @contextmanager
+def use(manager: TxnManager):
+    """Install an EXISTING manager for a lexical region.  The scenario
+    driver steps N simulated nodes — each owning its journal and its
+    snapshot cadence — through one process; `scope()` would build a
+    fresh manager (resetting the commits-since-snapshot counter) every
+    step, so the per-node manager is constructed once and re-installed
+    around each step instead."""
+    global _ACTIVE
+    with _lock:
+        previous = _ACTIVE
+        _ACTIVE = manager
+    try:
+        yield manager
+    finally:
+        with _lock:
+            _ACTIVE = previous
+
+
+@contextmanager
 def _suspended():
     """Run with transactions off (recovery replay must not re-journal)."""
     global _ACTIVE
@@ -234,5 +253,5 @@ __all__ = [
     "COMMIT_SITE", "Journal", "JournalEntry", "OverlayDict", "OverlaySet",
     "Snapshot", "StoreTransaction", "TxnManager", "active", "clone_store",
     "disable", "enable", "enabled", "recover", "scope", "store_root",
-    "transactional",
+    "transactional", "use",
 ]

@@ -1,0 +1,115 @@
+"""Per-node execution context: the de-globalization seam.
+
+The repo grew up single-node: one process-global incident log
+(`resilience.INCIDENTS`) and one process-global metrics registry
+(`sigpipe.METRICS`), imported by value everywhere.  The scenario
+harness (scenario/) runs N gossip pipelines + transactional stores in
+ONE process, and fleet-level assertions ("every adversarial event is
+attributed to a node") need per-node books.  Rather than threading a
+registry parameter through every call site, the two globals became
+*routers*: each consults the context stack below and delegates to the
+active node's registry, falling back to the process-global default when
+no context is installed — single-node callers and every existing test
+are byte-for-byte untouched.
+
+    ctx = NodeContext("node3", metrics=Metrics(node_id="node3"),
+                      incidents=IncidentLog(node_id="node3"))
+    with nodectx.use(ctx):
+        pipe.submit(...)        # every metric/incident lands in ctx's
+                                # registries, tagged node_id=node3
+
+The stack is deliberately PROCESS-global, not thread-local: the
+scenario driver steps one node at a time on one thread, but a dispatch
+inside that step may hop to the supervisor's watchdog worker — a
+thread-local (or contextvar) stack would silently re-route those
+records to the default registry, losing exactly the incidents the
+chaos tier asserts on.  Concurrent multi-context use is therefore not
+supported (and not needed: production wiring never installs a context;
+the simulation's determinism contract is single-scheduler anyway).
+
+This module sits at the bottom of the dependency graph on purpose: it
+imports nothing from the package, so both resilience/ and sigpipe/ can
+consult it without cycles.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+
+class NodeContext:
+    """One simulated node's observability namespace.
+
+    `metrics` / `incidents` are duck-typed (a `sigpipe.metrics.Metrics`
+    and a `resilience.incidents.IncidentLog` in practice); either may be
+    None to keep that stream on the process-global default.
+    """
+
+    __slots__ = ("node_id", "metrics", "incidents")
+
+    def __init__(self, node_id: str, metrics=None, incidents=None):
+        self.node_id = str(node_id)
+        self.metrics = metrics
+        self.incidents = incidents
+
+    def __repr__(self) -> str:
+        return f"NodeContext({self.node_id!r})"
+
+
+_lock = threading.RLock()
+_stack: list = []
+
+
+class Router:
+    """The module-global delegation seam shared by `resilience.INCIDENTS`
+    and `sigpipe.METRICS` (and any future per-node registry — the
+    ROADMAP names the supervisor's breaker table next): every attribute
+    access consults the context stack and lands on the active context's
+    `attr` registry when one is installed, else on the process-global
+    default.  `from ... import NAME` binds the router object by value
+    everywhere, so the routing must live *inside* it, not in the module
+    name."""
+
+    def __init__(self, default, attr: str):
+        self._default = default
+        self._attr = attr
+
+    @property
+    def default(self):
+        """The process-global registry, bypassing any installed context
+        (the scenario driver reads this for fleet-wide series)."""
+        return self._default
+
+    def _target(self):
+        ctx = current()
+        if ctx is not None:
+            registry = getattr(ctx, self._attr, None)
+            if registry is not None:
+                return registry
+        return self._default
+
+    def __getattr__(self, name):
+        return getattr(self._target(), name)
+
+    def __len__(self) -> int:            # len() bypasses __getattr__
+        return len(self._target())
+
+
+def current() -> NodeContext | None:
+    """The innermost installed context, or None (process-global mode)."""
+    with _lock:
+        return _stack[-1] if _stack else None
+
+
+@contextmanager
+def use(ctx: NodeContext):
+    """Install `ctx` for a lexical region.  Reentrant: the scenario
+    driver wraps both the node step and the pipeline's own methods, so
+    the same context may be pushed twice — inner pushes just shadow."""
+    with _lock:
+        _stack.append(ctx)
+    try:
+        yield ctx
+    finally:
+        with _lock:
+            _stack.pop()

@@ -1,0 +1,314 @@
+"""Network-scale adversarial scenario harness (scenario/): the PR's
+acceptance criteria.
+
+* Acceptance pin: a seeded 3-node scenario with a partition, an
+  equivocation storm, and one crash-and-recover node converges every
+  honest node to the oracle head (byte-identical `txn.store_root`),
+  attributes every injected adversarial event to a node-tagged
+  incident, and replays bit-identically from the same seed — all with
+  stubbed BLS in the default quick tier.
+* De-globalization: `resilience.INCIDENTS` and `sigpipe.METRICS` are
+  routers over the node-context stack — single-node callers land on
+  the process-global default exactly as before; two pipelines in one
+  process share no mutable admission state.
+* The slow tier (`make scenario-chaos`) runs the rest of the named
+  library plus the seeded randomized scenario matrix.
+"""
+import random
+
+import pytest
+
+from consensus_specs_tpu import resilience, scenario, sigpipe, txn
+from consensus_specs_tpu.gossip import (
+    AdmissionPipeline, GossipConfig, ManualClock)
+from consensus_specs_tpu.resilience import INCIDENTS
+from consensus_specs_tpu.resilience.incidents import IncidentLog
+from consensus_specs_tpu.scenario.dsl import (
+    Scenario, crash, equivocation_storm, heal, partition, recover)
+from consensus_specs_tpu.sigpipe import METRICS
+from consensus_specs_tpu.sigpipe import cache as sig_cache
+from consensus_specs_tpu.sigpipe.metrics import Metrics
+from consensus_specs_tpu.specs import get_spec
+from consensus_specs_tpu.test_infra import disable_bls
+from consensus_specs_tpu.test_infra.genesis import (
+    create_genesis_state, default_balances)
+from consensus_specs_tpu.test_infra.fork_choice import (
+    get_genesis_forkchoice_store)
+from consensus_specs_tpu.utils import nodectx
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    resilience.disable()
+    sigpipe.disable()
+    INCIDENTS.clear()
+    METRICS.reset()
+    sig_cache.clear()
+    yield
+    resilience.disable()
+    sigpipe.disable()
+    INCIDENTS.clear()
+    METRICS.reset()
+
+
+# ---------------------------------------------------------------------------
+# DSL validation
+# ---------------------------------------------------------------------------
+
+def test_dsl_validation_rejects_broken_scenarios():
+    with pytest.raises(AssertionError):        # partition never healed
+        Scenario(name="x", events=(partition(2.0, ((0, 1), (2,))),)) \
+            .validate()
+    with pytest.raises(AssertionError):        # groups must cover nodes
+        Scenario(name="x", events=(
+            partition(2.0, ((0,), (2,))), heal(3.0))).validate()
+    with pytest.raises(AssertionError):        # recover without crash
+        Scenario(name="x", events=(recover(3.0, node=1),)).validate()
+    with pytest.raises(AssertionError):        # still down at the end
+        Scenario(name="x", events=(crash(3.0, node=1),)).validate()
+    # every library scenario is inside the envelope
+    for s in scenario.LIBRARY.values():
+        s.validate()
+
+
+def test_named_unknown_scenario():
+    with pytest.raises(KeyError, match="battlefield3"):
+        scenario.named("nope")
+
+
+# ---------------------------------------------------------------------------
+# simulated network: the per-origin FIFO invariant
+# ---------------------------------------------------------------------------
+
+def _mini_net(drop_rate=0.0, nodes=2, multiplier=1):
+    from consensus_specs_tpu.scenario.net import SimNetwork
+    from consensus_specs_tpu.scenario.dsl import LinkSpec
+    return SimNetwork(nodes, LinkSpec(drop_rate=drop_rate),
+                      random.Random(0), ingress_multiplier=multiplier)
+
+
+def test_net_per_origin_fifo_under_jitter_and_drops():
+    """However jitter and drop stalls land, every recipient sees each
+    origin's messages in publish order."""
+    net = _mini_net(drop_rate=0.3)
+    for i in range(40):
+        net.publish(float(i) * 0.1, origin=0, topic="t", payload=i)
+    net.flush_stalls(100.0)
+    seen = [m.payload for dest, m in net.pump(200.0) if dest == 1]
+    assert seen == sorted(seen), "FIFO violated by drop stalls"
+    assert net.idle()
+
+
+def test_net_partition_stalls_and_heal_flushes_in_order():
+    net = _mini_net()
+    net.partition(((0,), (1,)))
+    for i in range(5):
+        net.publish(float(i), origin=0, topic="t", payload=i)
+    assert [d for d, _ in net.pump(50.0) if d == 1] == []
+    assert net.stalled_count() == 5
+    net.heal()
+    net.flush_stalls(50.0, kinds=("drop", "partition", "crash"))
+    seen = [m.payload for dest, m in net.pump(60.0) if dest == 1]
+    assert seen == [0, 1, 2, 3, 4]
+
+
+def test_net_duplicates_never_precede_primary():
+    net = _mini_net(multiplier=3)
+    net.publish(0.0, origin=0, topic="t", payload="m")
+    deliveries = [m.payload for dest, m in net.pump(10.0) if dest == 1]
+    assert deliveries == ["m"] * 3      # copies strictly after primary
+
+
+# ---------------------------------------------------------------------------
+# de-globalization: routers + per-instance pipelines
+# ---------------------------------------------------------------------------
+
+def test_metrics_and_incident_routing():
+    """No context -> the process-global default (existing behavior);
+    with a NodeContext installed, every record lands in the node's own
+    books, tagged with its node_id."""
+    METRICS.inc("txn_commits")
+    assert METRICS.default.count("txn_commits") == 1
+    ctx = nodectx.NodeContext(
+        "nodeX", metrics=Metrics(node_id="nodeX"),
+        incidents=IncidentLog(node_id="nodeX"))
+    with nodectx.use(ctx):
+        METRICS.inc("txn_commits")
+        entry = INCIDENTS.record("scenario.test", "hello")
+    assert entry["node_id"] == "nodeX"
+    assert ctx.metrics.count("txn_commits") == 1
+    assert ctx.metrics.snapshot()["node_id"] == "nodeX"
+    assert ctx.incidents.count(site="scenario.test") == 1
+    # the default books never saw the context's records
+    assert METRICS.default.count("txn_commits") == 1
+    assert INCIDENTS.default.count(site="scenario.test") == 0
+    # and the stack popped clean
+    assert nodectx.current() is None
+
+
+def test_incident_log_sim_clock():
+    clock = ManualClock()
+    clock.advance(42.5)
+    log = IncidentLog(node_id="n", clock=clock)
+    assert log.record("s", "e")["t"] == 42.5
+
+
+def test_two_pipelines_share_no_admission_state():
+    """The per-instance injection audit: submitting to one pipeline
+    must not alias the other's dedup cache, quotas, batcher window, or
+    results."""
+    spec = get_spec("altair", "minimal")
+    genesis = create_genesis_state(spec, default_balances(spec))
+    pipes = []
+    with disable_bls():
+        for _ in range(2):
+            store = get_genesis_forkchoice_store(spec, genesis)
+            spec.on_tick(store, store.genesis_time
+                         + 3 * int(spec.config.SECONDS_PER_SLOT))
+            pipes.append(AdmissionPipeline(
+                spec, store, GossipConfig(), ManualClock()))
+        a, b = pipes
+        assert a.seen is not b.seen and a.quotas is not b.quotas
+        assert a.batcher is not b.batcher and a.guard is not b.guard
+        a.submit("sync", spec.SyncCommitteeMessage(), peer="p")
+        assert a.pending_count() == 1
+        assert b.pending_count() == 0
+        assert len(b.seen) == 0 and not b.results
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance scenario (quick tier, stub BLS)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def battlefield():
+    """battlefield3 run twice with the same seed (shared across the
+    assertions below; building traffic dominates the runtime)."""
+    with disable_bls():
+        first = scenario.run_scenario(scenario.named("battlefield3"),
+                                      seed=7)
+        second = scenario.run_scenario(scenario.named("battlefield3"),
+                                       seed=7)
+    return first, second
+
+
+def test_battlefield3_converges_to_oracle(battlefield):
+    report, _ = battlefield
+    scenario.assert_converged(report)          # incl. byte-identical
+    #                                            store roots (envelope)
+    for node in report.nodes:
+        assert node["store_root"] == report.oracle["store_root"]
+        assert node["head"] == report.oracle["head"]
+
+
+def test_battlefield3_attributes_every_adversarial_event(battlefield):
+    report, _ = battlefield
+    scenario.assert_attributed(report)
+    kinds = {k.split("@")[0] for k in report.attribution}
+    assert kinds == {"partition", "equivocation_storm", "crash"}
+    # the crash is pinned by node1's OWN recovery incident
+    node1 = next(n for n in report.nodes if n["node_id"] == "node1")
+    assert node1["crashes"] == 1
+    assert any(e["site"] == "txn.recover" and e["event"] == "recovered"
+               for e in node1["incidents"])
+    # storm equivocators quarantined with verified evidence
+    storm = next(v for k, v in report.attribution.items()
+                 if k.startswith("equivocation_storm"))
+    assert storm["incidents"], "storm left no quarantine incidents"
+    for q in storm["incidents"]:
+        assert q["node_id"].startswith("node")
+
+
+def test_battlefield3_every_incident_is_node_tagged(battlefield):
+    report, _ = battlefield
+    for node in report.nodes:
+        assert node["incidents"], \
+            f"{node['node_id']} saw the battlefield but logged nothing"
+        for e in node["incidents"]:
+            assert e["node_id"] == node["node_id"]
+        assert node["metrics"]["node_id"] == node["node_id"]
+    # nothing leaked into the process-global default books
+    assert len(INCIDENTS.default) == 0
+
+
+def test_battlefield3_seed_replay_is_bit_identical(battlefield):
+    first, second = battlefield
+    assert first.fingerprint() == second.fingerprint()
+
+
+def test_smoke_scenario_zero_events(battlefield):
+    """The zero-event baseline: plain traffic converges, attribution
+    report is empty, nothing to quarantine."""
+    with disable_bls():
+        report = scenario.run_scenario(scenario.named("smoke"), seed=1)
+    scenario.assert_converged(report)
+    scenario.assert_attributed(report)
+    assert report.attribution == {}
+    for node in report.nodes:
+        assert node["quarantined"] == []
+
+
+# ---------------------------------------------------------------------------
+# slow tier: the rest of the library + the randomized scenario matrix
+# (`make scenario-chaos`)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", ["surround", "longrange",
+                                  "degraded_window", "mainnet_burst16"])
+def test_library_scenario(name):
+    with disable_bls():
+        report = scenario.run_scenario(scenario.named(name), seed=3)
+    scenario.assert_converged(report)
+    scenario.assert_attributed(report)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(20, 26))
+def test_randomized_scenario_matrix(seed):
+    """Seeded random battlefields inside the convergence envelope:
+    whatever mix of partitions, storms, crashes, degraded windows and
+    forks the generator deals, every node converges and every attack is
+    attributed."""
+    rng = random.Random(seed)
+    s = scenario.randomized(rng)
+    with disable_bls():
+        report = scenario.run_scenario(s, seed=seed)
+    scenario.assert_converged(report)
+    scenario.assert_attributed(report)
+
+
+@pytest.mark.slow
+def test_battlefield3_with_native_bls():
+    """One tiny BLS-on run (native pairing ~0.35 s each on this host):
+    the acceptance scenario's semantics hold with real signatures, not
+    just the stub.  Light traffic keeps the signature count small."""
+    s = Scenario(
+        name="bls_mini", nodes=2, slots=4,
+        traffic=scenario.TrafficSpec(attestation_fraction=0.25,
+                                     aggregates=False, sync_messages=0),
+        events=(partition(2.0, ((0,), (1,))), heal(3.0)))
+    report = scenario.run_scenario(s, seed=9)
+    scenario.assert_converged(report)
+    scenario.assert_attributed(report)
+
+
+def test_crash_only_recovery_uses_journal():
+    """A crash-and-recover node comes back through txn.recover over its
+    own journal — the store it rebuilds matches the oracle even before
+    any catch-up is needed."""
+    s = Scenario(
+        name="crashonly", nodes=2, slots=5,
+        traffic=scenario.TrafficSpec(attestation_fraction=0.5,
+                                     aggregates=False, sync_messages=0),
+        events=(crash(2.4, node=1), recover(3.6, node=1)))
+    with disable_bls():
+        report = scenario.run_scenario(s, seed=2)
+    scenario.assert_converged(report)
+    scenario.assert_attributed(report)
+    node1 = next(n for n in report.nodes if n["node_id"] == "node1")
+    recovered = [e for e in node1["incidents"]
+                 if e["site"] == "txn.recover"
+                 and e["event"] == "recovered"]
+    assert len(recovered) == 1
+    assert recovered[0]["node_id"] == "node1"
