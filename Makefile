@@ -13,14 +13,21 @@ RUNNERS := shuffling ssz_static operations epoch_processing sanity bls \
 	forks merkle_proof networking kzg_7594 random light_client sync
 
 .PHONY: test test-quick test-kernels tier1 chaos recovery-chaos \
-	scenario-chaos lint native pyspec bench gossip-bench txn-bench \
-	msm-bench merkle-bench scenario-bench gen_all detect_errors \
-	$(addprefix gen_,$(RUNNERS))
+	scenario-chaos lint speclint native pyspec bench gossip-bench \
+	txn-bench msm-bench merkle-bench scenario-bench gen_all \
+	detect_errors $(addprefix gen_,$(RUNNERS))
 
 # syntax/bytecode check over every package and script (the CI lint job)
 lint:
 	$(PYTHON) -m compileall -q consensus_specs_tpu tests scripts \
 		deposit_contract bench.py __graft_entry__.py
+
+# AST invariant checker (consensus_specs_tpu/analysis/): dispatch-seam
+# conformance, kernel-bypass, determinism, per-node isolation, and
+# txn-purity contracts machine-checked against resilience/sites.py;
+# exits 1 on the first finding.  Stdlib-ast only, budgeted < 10 s.
+speclint:
+	$(PYTHON) scripts/speclint.py
 
 # default suite: the multi-minute XLA limb-kernel compile suites are
 # skipped by conftest (KERNEL_TIER_FILES) so this finishes in a CI
@@ -31,13 +38,16 @@ test:
 test-kernels:
 	$(PYTHON) -m pytest tests/ -q --kernel-tiers
 
-# spec suites only (fastest signal while iterating on spec code)
-test-quick:
+# spec suites only (fastest signal while iterating on spec code);
+# speclint gates first — a seam/determinism/isolation violation fails
+# in seconds, before any test runs
+test-quick: speclint
 	$(PYTHON) -m pytest tests/spec_suites tests/test_ssz.py \
 		tests/test_phase0_sanity.py tests/test_epoch_fast.py \
 		tests/test_sigpipe.py tests/test_resilience.py \
 		tests/test_gossip.py tests/test_txn.py \
-		tests/test_merkle_inc.py tests/test_scenario.py -q
+		tests/test_merkle_inc.py tests/test_scenario.py \
+		tests/test_speclint.py -q
 
 # the exact ROADMAP.md tier-1 verify command (what the driver runs);
 # DOTS_PASSED counts green dots from the -q progress lines

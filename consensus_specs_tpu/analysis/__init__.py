@@ -1,0 +1,31 @@
+"""speclint: AST-based machine enforcement of the repo's cross-cutting
+safety contracts.
+
+Seven PRs built the safety story on conventions — every accelerator
+entry point behind ``resilience.dispatch(site, device_fn, fallback_fn)``,
+every seam chaos-covered and documented, injected clocks instead of
+wall time, per-node routed globals, store mutation only inside
+``@transactional`` seams.  This package turns each convention into a
+lint pass over the whole package (stdlib ``ast`` only — no jax, no
+heavy imports, < 10 s for the full tree), anchored on the canonical
+site registry ``resilience/sites.py``:
+
+* seams.py        — every dispatch/fire/FaultSpec site registered, every
+                    dispatch passes a fallback, registry live + documented.
+* bypass.py       — device kernels only importable from registered
+                    wrapper modules.
+* determinism.py  — no wall clock / unseeded RNG in the replayable
+                    subsystems (sigpipe, gossip, txn, scenario, ssz).
+* globals_.py     — module-level mutable state in per-node subsystems
+                    must be a nodectx Router or registered with a reason.
+* txnpurity.py    — store writes only in (or under) @transactional
+                    handlers.
+
+Entry points: :func:`run_speclint` (library), ``scripts/speclint.py``
+(CLI, JSON or human output, exit 1 on findings), ``make speclint`` /
+``make test-quick`` (CI gate), tests/test_speclint.py (pytest gate).
+Rule catalogue and escape-hatch policy: docs/analysis.md.
+"""
+from .core import RULES, Finding, load_context, run_speclint
+
+__all__ = ["Finding", "RULES", "load_context", "run_speclint"]

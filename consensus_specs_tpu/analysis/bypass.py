@@ -1,0 +1,87 @@
+"""Pass 2 — seam bypass.
+
+The device kernels (``consensus_specs_tpu.ops.*`` and the native C++
+bindings) must only be reached through a registered dispatch wrapper:
+the wrapper is where the circuit breaker, the watchdog, the fault
+injector, and the differential guard live, so a direct import anywhere
+else is an accelerator call that no chaos schedule can kill and no
+breaker can trip.  The allowed importers are derived from the site
+registry (every ``Site.module``) plus the explicitly-registered
+kernel-layer packages below.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Context, Finding, SourceFile
+
+_KERNEL_PREFIXES = (
+    "consensus_specs_tpu.ops",
+    "consensus_specs_tpu.native",
+)
+
+# kernel-layer packages/modules that ARE the device side (importing a
+# kernel there is implementing the seam, not bypassing it)
+_KERNEL_LAYER = (
+    "consensus_specs_tpu.ops",          # the kernels themselves
+    "consensus_specs_tpu.native",       # C++ host-tier bindings
+    "consensus_specs_tpu.parallel",     # mesh engine: multi-chip device layer
+    "consensus_specs_tpu.ssz.impl",     # backend selector: installs the
+                                        # level hasher behind merkle's seam
+    "consensus_specs_tpu.gen",          # offline conformance-vector
+                                        # tooling, not node runtime
+)
+
+
+def _is_kernel(module: str) -> bool:
+    return any(module == p or module.startswith(p + ".")
+               for p in _KERNEL_PREFIXES)
+
+
+def _allowed(sf_module: str, wrappers: frozenset[str]) -> bool:
+    if sf_module in wrappers:
+        return True
+    return any(sf_module == p or sf_module.startswith(p + ".")
+               for p in _KERNEL_LAYER)
+
+
+def _absolute(sf: SourceFile, node: ast.ImportFrom) -> str:
+    """Resolve a (possibly relative) from-import to a dotted module."""
+    if node.level == 0:
+        return node.module or ""
+    pkg = sf.module.split(".") if sf.module else []
+    if not sf.is_package and pkg:
+        pkg = pkg[:-1]
+    if node.level > 1:
+        pkg = pkg[:len(pkg) - (node.level - 1)]
+    return ".".join(pkg + (node.module.split(".") if node.module else []))
+
+
+def run(ctx: Context) -> list[Finding]:
+    findings: list[Finding] = []
+    wrappers = ctx.registry.wrapper_modules()
+    for sf in ctx.files:
+        if not (sf.module or sf.forced):
+            continue            # tests/scripts may drive kernels directly
+        if sf.module and _allowed(sf.module, wrappers):
+            continue
+        for node in ast.walk(sf.tree):
+            targets = []
+            if isinstance(node, ast.Import):
+                targets = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom):
+                mod = _absolute(sf, node)
+                # `from ..ops import msm` names the kernel in the alias
+                targets = [mod] + [f"{mod}.{a.name}" for a in node.names]
+            for mod in targets:
+                if _is_kernel(mod):
+                    findings.append(Finding(
+                        "bypass-direct-kernel", sf.rel, node.lineno,
+                        node.col_offset,
+                        f"direct device-kernel import {mod!r} outside a "
+                        f"registered dispatch wrapper",
+                        hint="route the call through resilience.dispatch "
+                             "in a wrapper module registered in "
+                             "resilience/sites.py"))
+                    break
+    return findings

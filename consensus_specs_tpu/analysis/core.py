@@ -1,0 +1,252 @@
+"""speclint core: findings, the disable escape hatch, source loading,
+and the pass driver.
+
+Everything here is stdlib-``ast`` only — linting never imports jax, the
+crypto packages, or anything else heavy; the one package module it
+loads (resilience/sites.py, the canonical seam registry) is loaded
+standalone by file path, bypassing the package ``__init__`` chain, so a
+full-repo run stays well under the 10 s CI budget.
+
+The escape hatch: a violating line may carry
+
+    # speclint: disable=<rule>[,<rule>...] -- <reason>
+
+(or the comment may stand alone on the line directly above).  The
+reason is mandatory — a disable without one is itself a finding
+(``speclint-bad-disable``), as is a disable naming an unknown rule.
+The policy is docs/analysis.md: the comment documents WHY the invariant
+does not apply, it never waives the obligation to have an answer.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+
+# every rule any pass may emit; disables are validated against this
+RULES: dict[str, str] = {
+    "seam-unregistered-site":
+        "a dispatch/fire/FaultSpec site name is not in resilience/sites.py",
+    "seam-dynamic-site":
+        "a seam call's site argument cannot be resolved statically",
+    "seam-missing-fallback":
+        "a dispatch call does not pass a fallback_fn",
+    "site-undocumented":
+        "a registered site is missing from the docs site table",
+    "site-unused":
+        "a registered site has no dispatch/fire call site in the code",
+    "bypass-direct-kernel":
+        "a device-kernel module is imported outside a registered wrapper",
+    "det-wall-clock":
+        "a decision path reads the wall clock instead of an injected clock",
+    "det-unseeded-rng":
+        "a decision path draws from an unseeded entropy source",
+    "global-mutable-state":
+        "a module-level mutable container is neither a nodectx Router "
+        "nor registered",
+    "txn-unwrapped-store-write":
+        "a Store field write is reachable from no @transactional handler",
+    "speclint-bad-disable":
+        "a speclint disable comment lacks a reason or names an unknown rule",
+}
+
+_DISABLE_RE = re.compile(
+    r"#\s*speclint:\s*disable=([A-Za-z0-9_,\s-]+?)\s*(?:--\s*(.*?)\s*)?$")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One violation: file:line plus rule id and a fix hint."""
+
+    rule: str
+    path: str       # repo-relative, slash-separated
+    line: int
+    col: int
+    message: str
+    hint: str = ""
+
+    def render(self) -> str:
+        tail = f"  [{self.hint}]" if self.hint else ""
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule}: {self.message}{tail}")
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message, "hint": self.hint}
+
+
+@dataclass
+class Disable:
+    rules: tuple[str, ...]
+    reason: str
+    line: int           # the commented line itself
+    applies_to: int     # the line findings must match to be suppressed
+
+
+class SourceFile:
+    """One parsed source file plus everything the passes ask of it."""
+
+    def __init__(self, path: Path, rel: str, text: str,
+                 forced: bool = False):
+        self.path = path
+        self.rel = rel                      # repo-relative, posix
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=str(path))
+        self.forced = forced                # explicit target: all passes apply
+        # dotted module name for package files ("" outside the package)
+        parts = Path(rel).with_suffix("").parts
+        if parts and parts[-1] == "__init__":
+            parts = parts[:-1]
+        self.module = ".".join(parts) if parts and \
+            parts[0] == "consensus_specs_tpu" else ""
+        self.is_package = rel.endswith("__init__.py")
+        self.disables: list[Disable] = self._scan_disables()
+
+    def _scan_disables(self) -> list[Disable]:
+        # real COMMENT tokens only: disable-looking text inside
+        # docstrings or string literals (usage examples, hints) must
+        # neither suppress findings nor trip speclint-bad-disable
+        out = []
+        if "speclint:" not in self.text:
+            return out          # skip tokenizing the common case
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(self.text).readline))
+        except tokenize.TokenError:
+            tokens = []
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _DISABLE_RE.search(tok.string)
+            if not m:
+                continue
+            i = tok.start[0]
+            rules = tuple(r.strip() for r in m.group(1).split(",")
+                          if r.strip())
+            reason = (m.group(2) or "").strip()
+            if self.lines[i - 1].strip().startswith("#"):
+                # a standalone comment guards the next CODE line (the
+                # reason may wrap over several comment lines)
+                applies = i + 1
+                while applies <= len(self.lines) and (
+                        not self.lines[applies - 1].strip()
+                        or self.lines[applies - 1].strip().startswith("#")):
+                    applies += 1
+            else:
+                applies = i
+            out.append(Disable(rules, reason, i, applies))
+        return out
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        return any(rule in d.rules and d.applies_to == line and d.reason
+                   for d in self.disables)
+
+    def in_module(self, *prefixes: str) -> bool:
+        """Pass scoping: explicit targets are always in scope."""
+        if self.forced:
+            return True
+        return any(self.module == p or self.module.startswith(p + ".")
+                   for p in prefixes)
+
+
+def disable_findings(sf: SourceFile) -> list[Finding]:
+    """Malformed escape hatches are violations in their own right."""
+    out = []
+    for d in sf.disables:
+        if not d.reason:
+            out.append(Finding(
+                "speclint-bad-disable", sf.rel, d.line, 0,
+                "disable comment must cite a reason: "
+                "`# speclint: disable=<rule> -- <why the invariant "
+                "does not apply here>`"))
+        for r in d.rules:
+            if r not in RULES:
+                out.append(Finding(
+                    "speclint-bad-disable", sf.rel, d.line, 0,
+                    f"disable names unknown rule {r!r}",
+                    hint="known rules are listed in docs/analysis.md"))
+    return out
+
+
+# directories never worth parsing
+_SKIP_DIRS = {"__pycache__", ".git", ".jax_cache", "build", "out",
+              "node_modules"}
+
+# default lint surface: the whole package, plus the one test module
+# whose site tuples are contractual (other tests use synthetic site
+# names on purpose — they exercise the seam machinery itself)
+_DEFAULT_TARGETS = ("consensus_specs_tpu", "tests/test_chaos.py")
+
+
+def _iter_py(root: Path):
+    for target in _DEFAULT_TARGETS:
+        p = root / target
+        if p.is_file():
+            yield p
+        elif p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if not _SKIP_DIRS.intersection(f.relative_to(root).parts):
+                    yield f
+
+
+class Context:
+    """Shared state for one lint run: sources + the loaded registry."""
+
+    def __init__(self, root: Path, files: list[SourceFile], registry):
+        self.root = root
+        self.files = files
+        self.registry = registry
+
+
+def load_context(root: str | Path,
+                 paths: list[str | Path] | None = None) -> Context:
+    """Parse the lint surface.  With `paths`, lint exactly those files
+    (marked `forced`: every pass applies regardless of module scoping —
+    the fixture/scratch mode); otherwise the package + tests/test_chaos.py.
+    """
+    from .registry import load_registry
+    root = Path(root).resolve()
+    files = []
+    if paths is None:
+        for p in _iter_py(root):
+            rel = p.relative_to(root).as_posix()
+            files.append(SourceFile(p, rel, p.read_text()))
+    else:
+        for p in map(Path, paths):
+            p = p.resolve()
+            try:
+                rel = p.relative_to(root).as_posix()
+            except ValueError:
+                rel = p.name
+            files.append(SourceFile(p, rel, p.read_text(), forced=True))
+    return Context(root, files, load_registry(root))
+
+
+def run_speclint(root: str | Path,
+                 paths: list[str | Path] | None = None) -> list[Finding]:
+    """Run every pass; returns surviving findings sorted by location.
+
+    Disable comments suppress same-line (or next-line, for standalone
+    comments) findings of the named rules — but only when they cite a
+    reason; malformed disables surface as `speclint-bad-disable`.
+    """
+    from . import bypass, determinism, globals_, seams, txnpurity
+    ctx = load_context(root, paths)
+    findings: list[Finding] = []
+    for pass_mod in (seams, bypass, determinism, globals_, txnpurity):
+        findings.extend(pass_mod.run(ctx))
+    by_rel = {sf.rel: sf for sf in ctx.files}
+    kept = []
+    for f in findings:
+        sf = by_rel.get(f.path)
+        if sf is not None and sf.suppressed(f.rule, f.line):
+            continue
+        kept.append(f)
+    for sf in ctx.files:
+        kept.extend(disable_findings(sf))
+    kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return kept

@@ -1,0 +1,143 @@
+"""Pass 3 — determinism.
+
+The replayable subsystems — sigpipe, gossip, txn, scenario, ssz — must
+make every *decision* on injected clocks (utils/clock.py) and seeded
+RNG: a seeded chaos schedule or scenario must replay bit-identically,
+and a wall-clock read or a draw from process-global entropy anywhere in
+those paths breaks the ``(scenario, seed)`` determinism pin.
+
+Policy boundaries (docs/analysis.md):
+
+* ``time.perf_counter`` is allowed — metrics *measure* on wall clock,
+  decisions must not (the utils/clock.py contract).
+* The resilience supervisor's watchdog is exempt by scope: it times a
+  real worker thread no virtual clock can advance, and lives in
+  ``resilience/`` which this pass does not scan.
+* ``random.Random(seed)`` is the required idiom; the module-global
+  functions (``random.random()`` …) and zero-arg ``Random()`` are
+  process-shared or OS-seeded and flagged.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Context, Finding
+
+_SCOPE = (
+    "consensus_specs_tpu.sigpipe",
+    "consensus_specs_tpu.gossip",
+    "consensus_specs_tpu.txn",
+    "consensus_specs_tpu.scenario",
+    "consensus_specs_tpu.ssz",
+)
+
+_WALL_CLOCK = frozenset({
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.sleep", "time.localtime", "time.gmtime", "time.ctime",
+    "datetime.now", "datetime.utcnow", "datetime.today", "date.today",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+})
+
+_ENTROPY = frozenset({
+    "os.urandom", "uuid.uuid1", "uuid.uuid4",
+})
+
+_GLOBAL_RNG_FNS = frozenset({
+    "random", "randint", "randrange", "choice", "choices", "shuffle",
+    "sample", "uniform", "getrandbits", "gauss", "normalvariate",
+    "expovariate", "betavariate", "seed", "randbytes",
+})
+
+
+def _dotted(expr: ast.expr) -> str | None:
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# stdlib modules whose import aliases must be tracked so that
+# `import time as t` / `from time import time` cannot dodge the gate
+_TRACKED_MODULES = ("time", "random", "os", "datetime", "secrets",
+                    "uuid", "numpy", "np")
+
+
+def _alias_map(tree: ast.AST) -> dict[str, str]:
+    """local name -> canonical dotted prefix, for the tracked modules."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                root = a.name.split(".")[0]
+                if root in _TRACKED_MODULES:
+                    aliases[(a.asname or a.name).split(".")[0]] = root
+        elif isinstance(node, ast.ImportFrom) and node.level == 0 and \
+                node.module and node.module.split(".")[0] in \
+                _TRACKED_MODULES:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def _canonical(name: str, aliases: dict[str, str]) -> str:
+    head, _, tail = name.partition(".")
+    mapped = aliases.get(head)
+    if mapped is None:
+        return name
+    return f"{mapped}.{tail}" if tail else mapped
+
+
+def run(ctx: Context) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in ctx.files:
+        if not sf.in_module(*_SCOPE):
+            continue
+        aliases = _alias_map(sf.tree)
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            if name is None:
+                continue
+            name = _canonical(name, aliases)
+            if name in _WALL_CLOCK:
+                findings.append(Finding(
+                    "det-wall-clock", sf.rel, node.lineno,
+                    node.col_offset,
+                    f"decision path calls {name}() — wall clock reads "
+                    f"break seeded replay",
+                    hint="take a clock object (utils/clock.py contract); "
+                         "time.perf_counter is allowed for measurement"))
+            elif name in _ENTROPY or name.startswith("secrets.") \
+                    or name.startswith("numpy.random.") \
+                    or name.startswith("np.random."):
+                findings.append(Finding(
+                    "det-unseeded-rng", sf.rel, node.lineno,
+                    node.col_offset,
+                    f"decision path draws from {name}() — process/OS "
+                    f"entropy breaks seeded replay",
+                    hint="derive from a seeded random.Random owned by "
+                         "the caller"))
+            elif name.startswith("random.") \
+                    and name.split(".", 1)[1] in _GLOBAL_RNG_FNS:
+                findings.append(Finding(
+                    "det-unseeded-rng", sf.rel, node.lineno,
+                    node.col_offset,
+                    f"{name}() uses the process-global RNG — shared, "
+                    f"unseeded state breaks seeded replay and per-node "
+                    f"isolation",
+                    hint="use a seeded random.Random instance"))
+            elif name == "random.Random" and not node.args \
+                    and not node.keywords:
+                findings.append(Finding(
+                    "det-unseeded-rng", sf.rel, node.lineno,
+                    node.col_offset,
+                    "Random() without a seed is OS-seeded — schedules "
+                    "built from it can never replay",
+                    hint="pass an explicit seed"))
+    return findings

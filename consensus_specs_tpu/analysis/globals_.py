@@ -1,0 +1,99 @@
+"""Pass 4 — global state.
+
+The scenario harness (PR 7) runs N simulated nodes in one process;
+its isolation invariant is that observable per-node state lives behind
+``utils.nodectx.Router`` (the pattern ``resilience.INCIDENTS`` and
+``sigpipe.METRICS`` established) — a bare module-level mutable
+container in the per-node subsystems silently shares one node's state
+with the whole fleet.  This pass flags module-level mutable containers
+and stateful singletons in those subsystems unless they are Routers or
+explicitly registered in place with a reasoned disable comment::
+
+    PUBKEYS = PubkeyCache()   # speclint: disable=global-mutable-state -- ...
+
+The comment is the registration: it forces every new global to carry a
+written argument for why sharing it across SimNodes is sound.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Context, Finding
+
+_SCOPE = (
+    "consensus_specs_tpu.resilience",
+    "consensus_specs_tpu.sigpipe",
+    "consensus_specs_tpu.gossip",
+    "consensus_specs_tpu.txn",
+    "consensus_specs_tpu.scenario",
+)
+
+_MUTABLE_BUILTINS = frozenset({
+    "dict", "list", "set", "bytearray", "deque", "defaultdict",
+    "OrderedDict", "Counter", "ChainMap", "local",
+})
+
+# stateful registry classes this repo defines; instantiating one at
+# module level creates fleet-shared state
+_STATEFUL_CLASSES = frozenset({
+    "Metrics", "IncidentLog", "PubkeyCache", "AggregatePubkeyCache",
+    "Supervisor", "DifferentialGuard", "TxnManager", "Journal",
+    "AdmissionPipeline", "IncrementalTracker",
+})
+
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                     ast.SetComp, ast.DictComp)
+
+
+def _callee(expr: ast.expr) -> str | None:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def _mutable_reason(value: ast.expr) -> str | None:
+    if isinstance(value, _MUTABLE_LITERALS):
+        return "a mutable container literal"
+    if isinstance(value, ast.Call):
+        name = _callee(value.func)
+        if name == "Router":
+            return None                     # the sanctioned pattern
+        if name in _MUTABLE_BUILTINS:
+            return f"a mutable {name}()"
+        if name in _STATEFUL_CLASSES:
+            return f"a stateful {name} singleton"
+    return None
+
+
+def run(ctx: Context) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in ctx.files:
+        if not sf.in_module(*_SCOPE):
+            continue
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign):
+                value, targets = node.value, node.targets
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                value, targets = node.value, [node.target]
+            else:
+                continue
+            reason = _mutable_reason(value)
+            if reason is None:
+                continue
+            names = ", ".join(t.id for t in targets
+                              if isinstance(t, ast.Name)) or "<target>"
+            if names.startswith("__") and names.endswith("__"):
+                continue        # __all__ and friends: interpreter protocol
+
+            findings.append(Finding(
+                "global-mutable-state", sf.rel, node.lineno,
+                node.col_offset,
+                f"module-level {names} is {reason} — fleet-shared state "
+                f"in a per-node subsystem",
+                hint="wrap it in utils.nodectx.Router, make it "
+                     "immutable, or register it in place: `# speclint: "
+                     "disable=global-mutable-state -- <why sharing "
+                     "across nodes is sound>`"))
+    return findings

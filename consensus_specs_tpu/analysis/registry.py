@@ -1,0 +1,49 @@
+"""Standalone access to the canonical seam registry and the docs table.
+
+speclint must not import the package it lints (a lint run should never
+pay a jax import, and a broken package must still lint), so the
+registry module — resilience/sites.py, which itself imports only
+stdlib — is loaded by file path with importlib, bypassing
+``consensus_specs_tpu/__init__`` and the resilience package
+``__init__`` entirely.
+"""
+from __future__ import annotations
+
+import importlib.util
+import re
+import sys
+from pathlib import Path
+
+_SITES_REL = Path("consensus_specs_tpu") / "resilience" / "sites.py"
+
+
+def load_registry(root: Path):
+    """The live resilience/sites.py module, loaded standalone."""
+    path = Path(root) / _SITES_REL
+    spec = importlib.util.spec_from_file_location(
+        "_speclint_sites", path)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclasses resolves cls.__module__ through sys.modules at class
+    # creation; register before exec so the standalone load works
+    sys.modules["_speclint_sites"] = mod
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.modules.pop("_speclint_sites", None)
+    return mod
+
+
+_BACKTICK_SITE_RE = re.compile(r"`([a-z0-9_]+(?:\.[a-z0-9_]+)+)`")
+
+
+def documented_sites(root: Path, doc_rel: str) -> frozenset[str]:
+    """Every backticked dotted-lowercase token in `doc_rel`'s markdown
+    TABLE rows — prose mentions don't count, so the forward check
+    (registry ⊆ doc) enforces exactly what docs/resilience.md promises:
+    registering a seam obliges a site-table row."""
+    path = Path(root) / doc_rel
+    if not path.is_file():
+        return frozenset()
+    rows = [line for line in path.read_text().splitlines()
+            if line.lstrip().startswith("|")]
+    return frozenset(_BACKTICK_SITE_RE.findall("\n".join(rows)))

@@ -1,0 +1,215 @@
+"""Pass 1 — seam conformance.
+
+Every site-bearing call (``dispatch``/``_dispatch``/``_stub_or_dispatch``
+at the accelerator seams, ``fire`` at the transactional barriers,
+``FaultSpec`` in chaos schedules) must name a site registered in
+resilience/sites.py, dispatch calls must pass a fallback, and the
+registry itself must be live: every registered site used somewhere,
+every registered site in its doc's site table.  Chaos reachability is
+enforced structurally — the chaos tuples derive from the registry, and
+UNIT-tier entries must cite their covering suite (sites.py raises at
+import otherwise) — so the drift this pass hunts is call-site drift:
+the first bypassed kernel or misspelled site name fails the lint.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Context, Finding, SourceFile
+
+# call name -> (site argument index, minimum args for a fallback; None =
+# the call shape carries no fallback obligation)
+_SEAM_CALLS: dict[str, tuple[int, int | None]] = {
+    "dispatch": (0, 3),
+    "_dispatch": (0, 3),
+    "_stub_or_dispatch": (0, 4),
+    "fire": (0, None),
+    "FaultSpec": (0, None),
+}
+
+_REGISTER_HINT = ("register the seam in consensus_specs_tpu/resilience/"
+                  "sites.py (one Site entry + a docs/resilience.md row)")
+
+
+def _call_name(func: ast.expr) -> str | None:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+class _ModuleConstants(ast.NodeVisitor):
+    """Module-level ``NAME = <resolvable site string>`` bindings, plus
+    names imported from resilience.sites."""
+
+    def __init__(self, sf: SourceFile, registry):
+        self.values: dict[str, str] = {}
+        self.registry = registry
+        for node in sf.tree.body:
+            if isinstance(node, ast.ImportFrom) and node.module and \
+                    node.module.split(".")[-1] == "sites":
+                for alias in node.names:
+                    v = getattr(registry, alias.name, None)
+                    if isinstance(v, str):
+                        self.values[alias.asname or alias.name] = v
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                v = self._value(node.value)
+                if v is not None:
+                    self.values[node.targets[0].id] = v
+
+    def _value(self, expr: ast.expr) -> str | None:
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return expr.value
+        if isinstance(expr, ast.Name):
+            return self.values.get(expr.id)
+        # the registry-derived idiom: sites.site("x").name
+        if isinstance(expr, ast.Attribute) and expr.attr == "name" and \
+                isinstance(expr.value, ast.Call):
+            call = expr.value
+            if _call_name(call.func) == "site" and call.args and \
+                    isinstance(call.args[0], ast.Constant) and \
+                    isinstance(call.args[0].value, str):
+                return call.args[0].value
+        return None
+
+
+class _SeamVisitor(ast.NodeVisitor):
+    def __init__(self, sf: SourceFile, consts: _ModuleConstants,
+                 registry, findings: list[Finding], used: set[str]):
+        self.sf = sf
+        self.consts = consts
+        self.registry = registry
+        self.findings = findings
+        self.used = used
+        self._params: list[set[str]] = []
+
+    def visit_FunctionDef(self, node):
+        self._visit_func(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._visit_func(node)
+
+    def _visit_func(self, node):
+        a = node.args
+        params = {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)}
+        if a.vararg:
+            params.add(a.vararg.arg)
+        if a.kwarg:
+            params.add(a.kwarg.arg)
+        self._params.append(params)
+        self.generic_visit(node)
+        self._params.pop()
+
+    def _is_param(self, name: str) -> bool:
+        return any(name in scope for scope in self._params)
+
+    def visit_Call(self, node: ast.Call):
+        self.generic_visit(node)
+        name = _call_name(node.func)
+        shape = _SEAM_CALLS.get(name or "")
+        if shape is None:
+            return
+        site_idx, min_args = shape
+        site_expr = None
+        if len(node.args) > site_idx:
+            site_expr = node.args[site_idx]
+        else:
+            for kw in node.keywords:
+                if kw.arg == "site":
+                    site_expr = kw.value
+        if site_expr is None:
+            return
+        resolved = self._resolve(site_expr)
+        if resolved is None:
+            if not (isinstance(site_expr, ast.Name)
+                    and self._is_param(site_expr.id)):
+                # forwarding wrappers (`def _dispatch(site, ...)`) are
+                # checked at THEIR call sites; anything else dynamic is
+                # unverifiable and flagged
+                self.findings.append(Finding(
+                    "seam-dynamic-site", self.sf.rel, site_expr.lineno,
+                    site_expr.col_offset,
+                    f"{name}() site argument is not statically "
+                    f"resolvable to a registered site name",
+                    hint="use a string literal or a module constant "
+                         "derived from resilience/sites.py"))
+        else:
+            self.used.add(resolved)
+            if not self.registry.is_registered(resolved):
+                self.findings.append(Finding(
+                    "seam-unregistered-site", self.sf.rel,
+                    site_expr.lineno, site_expr.col_offset,
+                    f"{name}() names unregistered site {resolved!r}",
+                    hint=_REGISTER_HINT))
+        if min_args is not None:
+            # _stub_or_dispatch names its fallback parameter native_fn
+            has_fallback = (len(node.args) >= min_args
+                            or any(kw.arg in ("fallback_fn", "native_fn")
+                                   for kw in node.keywords))
+            if not has_fallback:
+                self.findings.append(Finding(
+                    "seam-missing-fallback", self.sf.rel, node.lineno,
+                    node.col_offset,
+                    f"{name}() call passes no fallback_fn — the seam "
+                    f"contract is dispatch(site, device_fn, fallback_fn)",
+                    hint="the fallback must be the byte-identical "
+                         "native-oracle path"))
+
+    def _resolve(self, expr: ast.expr) -> str | None:
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return expr.value
+        if isinstance(expr, ast.Name) and not self._is_param(expr.id):
+            return self.consts.values.get(expr.id)
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            # sites.SOME_CONSTANT / registry-module attribute access
+            v = getattr(self.registry, expr.attr, None)
+            if isinstance(v, str) and expr.value.id in (
+                    "sites", "site_registry"):
+                return v
+        return None
+
+
+def run(ctx: Context) -> list[Finding]:
+    findings: list[Finding] = []
+    used: set[str] = set()
+    fixture_mode = bool(ctx.files) and all(sf.forced for sf in ctx.files)
+    for sf in ctx.files:
+        if not (sf.module or sf.forced or sf.rel.endswith("test_chaos.py")):
+            continue
+        consts = _ModuleConstants(sf, ctx.registry)
+        _SeamVisitor(sf, consts, ctx.registry, findings, used).visit(sf.tree)
+    if fixture_mode:
+        return findings
+    # registry liveness: every site used, every site documented
+    sites_rel = "consensus_specs_tpu/resilience/sites.py"
+    sites_text = (ctx.root / sites_rel).read_text().splitlines()
+
+    def _decl_line(name: str) -> int:
+        needle = f'"{name}"'
+        for i, line in enumerate(sites_text, start=1):
+            if needle in line:
+                return i
+        return 1
+
+    doc_cache: dict[str, frozenset[str]] = {}
+    from .registry import documented_sites
+    for s in ctx.registry.REGISTRY:
+        if s.name not in used:
+            findings.append(Finding(
+                "site-unused", sites_rel, _decl_line(s.name), 0,
+                f"registered site {s.name!r} has no dispatch/fire call "
+                f"site in the package",
+                hint="delete the registration or wire the seam"))
+        if s.doc not in doc_cache:
+            doc_cache[s.doc] = documented_sites(ctx.root, s.doc)
+        if s.name not in doc_cache[s.doc]:
+            findings.append(Finding(
+                "site-undocumented", sites_rel, _decl_line(s.name), 0,
+                f"registered site {s.name!r} is missing from the "
+                f"{s.doc} site table",
+                hint=f"add a `{s.name}` row describing the device path "
+                     f"and fallback"))
+    return findings

@@ -1,0 +1,147 @@
+"""Pass 5 — transactional purity.
+
+Since PR 4 every fork-choice store mutation must be atomic-or-absent:
+handlers are decorated ``@transactional`` and their writes land in a
+copy-on-write overlay.  The invariant a new handler can silently break
+is forgetting the decorator — its writes would hit the base store
+directly, invisible to the journal, the kill points, and recovery.
+
+Statically: any function that writes through a parameter named
+``store`` must either be decorated ``@transactional`` or be reachable
+(by name, over the package-wide self/direct call graph) from a
+decorated handler — helpers like ``update_checkpoints`` run inside the
+caller's transaction.  The txn machinery itself and the offline
+harnesses (test_infra, spec_tests, gen, debug) are out of scope: they
+ARE the implementation / drive stores outside node runtime.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Context, Finding
+
+_EXEMPT = (
+    "consensus_specs_tpu.txn",          # the commit/overlay machinery
+    "consensus_specs_tpu.test_infra",   # test-side store drivers
+    "consensus_specs_tpu.spec_tests",   # in-package test suites
+    "consensus_specs_tpu.gen",          # offline vector generation
+    "consensus_specs_tpu.debug",
+    # the light-client `store` parameter is a LightClientStore — a sync-
+    # protocol object the txn overlay never wraps; the PR 4 contract
+    # covers the fork-choice Store only
+    "consensus_specs_tpu.specs.light_client",
+)
+
+_MUTATORS = frozenset({
+    "append", "add", "update", "pop", "clear", "extend", "insert",
+    "setdefault", "remove", "discard", "popitem",
+})
+
+
+def _roots_at_store(expr: ast.expr) -> bool:
+    while isinstance(expr, (ast.Attribute, ast.Subscript)):
+        expr = expr.value
+    return isinstance(expr, ast.Name) and expr.id == "store"
+
+
+def _writes_store(fn: ast.AST) -> int | None:
+    """First line where `fn` writes through its `store` parameter."""
+    for node in ast.walk(fn):
+        targets: list[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        elif isinstance(node, ast.Delete):
+            targets = node.targets
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATORS and \
+                _roots_at_store(node.func.value):
+            # store.blocks.update(...) style in-place mutation; reads
+            # like store.blocks[r] stay untouched
+            return node.lineno
+        for t in targets:
+            if isinstance(t, (ast.Attribute, ast.Subscript)) and \
+                    _roots_at_store(t):
+                return t.lineno
+    return None
+
+
+def _has_store_param(fn) -> bool:
+    a = fn.args
+    return any(p.arg == "store"
+               for p in (a.posonlyargs + a.args + a.kwonlyargs))
+
+
+def _is_transactional(fn) -> bool:
+    for dec in fn.decorator_list:
+        d = dec.func if isinstance(dec, ast.Call) else dec
+        name = d.attr if isinstance(d, ast.Attribute) else \
+            d.id if isinstance(d, ast.Name) else None
+        if name == "transactional":
+            return True
+    return False
+
+
+def _called_names(fn) -> set[str]:
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name):
+                out.add(f.id)
+            elif isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id in ("self", "cls", "spec"):
+                out.add(f.attr)
+    return out
+
+
+def _functions(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _exempt(sf) -> bool:
+    # deliberately ignores `forced`: an exempt module stays exempt even
+    # when linted explicitly, but a scratch fixture (no module) never is
+    return any(sf.module == p or sf.module.startswith(p + ".")
+               for p in _EXEMPT)
+
+
+def run(ctx: Context) -> list[Finding]:
+    in_scope = [sf for sf in ctx.files
+                if (sf.module or sf.forced) and not _exempt(sf)]
+    # package-wide name call graph + transactional roots
+    edges: dict[str, set[str]] = {}
+    roots: set[str] = set()
+    writers = []        # (sf, fn, first write line)
+    for sf in in_scope:
+        for fn in _functions(sf.tree):
+            edges.setdefault(fn.name, set()).update(_called_names(fn))
+            if _is_transactional(fn):
+                roots.add(fn.name)
+            if _has_store_param(fn):
+                line = _writes_store(fn)
+                if line is not None:
+                    writers.append((sf, fn, line))
+    reach = set(roots)
+    frontier = list(roots)
+    while frontier:
+        for callee in edges.get(frontier.pop(), ()):
+            if callee not in reach:
+                reach.add(callee)
+                frontier.append(callee)
+    findings = []
+    for sf, fn, line in writers:
+        if _is_transactional(fn) or fn.name in reach:
+            continue
+        findings.append(Finding(
+            "txn-unwrapped-store-write", sf.rel, line, 0,
+            f"{fn.name}() writes the fork-choice store but is neither "
+            f"@transactional nor reachable from a transactional handler",
+            hint="decorate the handler with @txn.transactional (or call "
+                 "it only from one) so the write is atomic-or-absent"))
+    return findings

@@ -158,6 +158,8 @@ def _payload_attestation(spec, store, message, origin) -> Collected:
         votes)
 
 
+# speclint: disable=global-mutable-state -- static topic -> collector
+# dispatch table, fully populated here and never mutated at run time
 _COLLECTORS = {
     "attestation": lambda spec, store, payload, cache, origin:
         _attestation(spec, store, payload, cache, origin),

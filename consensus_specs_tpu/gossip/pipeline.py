@@ -475,6 +475,8 @@ class AdmissionPipeline:
                     if self.results[seq].final]
 
 
+# speclint: disable=global-mutable-state -- static topic -> handler-name
+# table, fully populated here and never mutated at run time
 _HANDLER_METHODS = {
     "attestation": "on_attestation",
     "aggregate": "on_aggregate_and_proof",
@@ -483,6 +485,8 @@ _HANDLER_METHODS = {
     "payload_attestation": "on_payload_attestation_message",
 }
 
+# speclint: disable=global-mutable-state -- static topic -> scalar-apply
+# table, fully populated here and never mutated at run time
 _HANDLERS = {
     "attestation": lambda spec, store, payload:
         spec.on_attestation(store, payload, is_from_block=False),

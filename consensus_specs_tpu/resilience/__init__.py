@@ -43,7 +43,7 @@ from .supervisor import (
     CLOSED, HALF_OPEN, OPEN, QUARANTINED, DispatchTimeout, Supervisor,
     SupervisorConfig, active, dispatch, enabled,
 )
-from . import faults, guard, incidents, supervisor
+from . import faults, guard, incidents, sites, supervisor
 from ..sigpipe.metrics import METRICS
 
 
@@ -92,5 +92,6 @@ __all__ = [
     "IncidentLog", "INCIDENTS", "Supervisor", "SupervisorConfig",
     "CLOSED", "OPEN", "HALF_OPEN", "QUARANTINED",
     "active", "dispatch", "disable", "enable", "enabled", "force_scalar",
-    "inject", "report", "faults", "guard", "incidents", "supervisor",
+    "inject", "report", "faults", "guard", "incidents", "sites",
+    "supervisor",
 ]

@@ -38,6 +38,7 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 
 from ..sigpipe.metrics import METRICS
+from . import sites
 from .incidents import INCIDENTS
 
 KINDS = ("raise", "timeout", "corrupt")
@@ -71,8 +72,9 @@ def _is_bool(v) -> bool:
 # differential oracle check — ONLY these get bytes corruption (a bytes
 # result at an unguarded site, e.g. ops.sha256.hash_level, has no
 # quarantine path, so corrupting it would just break the byte-identical
-# invariant instead of modeling a catchable silent fault)
-_DIGEST_GUARDED_SITES = frozenset({"ssz.merkle_sweep"})
+# invariant instead of modeling a catchable silent fault).  Derived from
+# the canonical site registry (corrupt="digest" entries).
+_DIGEST_GUARDED_SITES = sites.digest_guarded_sites()
 
 
 def _flip_verdict(result, rng: random.Random, site: str | None = None):

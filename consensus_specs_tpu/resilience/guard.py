@@ -27,16 +27,13 @@ import threading
 
 from ..sigpipe.metrics import METRICS
 from .incidents import INCIDENTS
+from .sites import fused_sites
 
 # every site the fused pipeline's verdicts flow through; quarantined as a
-# unit on mismatch (the guard cannot attribute corruption to one kernel)
-FUSED_SITES = (
-    "bls.pairing_check",
-    "sigpipe.hash_to_g2_batch",
-    "bls.verify_batch",
-    "bls.fast_aggregate_verify_batch",
-    "bls.aggregate_verify_batch",
-)
+# unit on mismatch (the guard cannot attribute corruption to one kernel).
+# Derived from the canonical registry so the quarantine unit can never
+# drift from the sites that actually exist (speclint pins the rest).
+FUSED_SITES = fused_sites()
 
 
 def oracle_verdict(s) -> bool:

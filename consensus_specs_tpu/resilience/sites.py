@@ -1,0 +1,219 @@
+"""Canonical registry of every resilience seam in the repo.
+
+Seven PRs grew the safety story on cross-cutting conventions — every
+accelerator entry point behind ``dispatch(site, device_fn,
+fallback_fn)``, every transactional barrier behind ``faults.fire`` —
+but the site NAMES lived as scattered string literals, and the chaos
+tier, the differential guard, and the fault injector each hand-
+maintained their own drift-prone tuples of them.  This module is the
+single source of truth those consumers now derive from:
+
+* ``tests/test_chaos.py`` builds ``SITES`` / ``GOSSIP_SITES`` /
+  ``KILL_SITES`` from :func:`chaos_replay_sites`,
+  :func:`chaos_gossip_sites`, :func:`kill_sites`.
+* ``resilience/guard.py`` builds ``FUSED_SITES`` (the quarantine unit)
+  from :func:`fused_sites`.
+* ``resilience/faults.py`` builds ``_DIGEST_GUARDED_SITES`` (which
+  results bytes-corruption may target) from
+  :func:`digest_guarded_sites`.
+* ``speclint`` (``consensus_specs_tpu/analysis/``) machine-checks every
+  ``dispatch(...)`` / ``fire(...)`` / ``FaultSpec(...)`` site argument,
+  the docs/resilience.md site table, and the chaos reachability policy
+  against this registry — an unregistered site name fails CI.
+
+Registering a new seam means adding ONE :class:`Site` entry here (and a
+row in docs/resilience.md); speclint then enforces that the call site,
+the chaos tier, and the docs all agree.  See docs/analysis.md.
+
+This module deliberately imports nothing from the package (stdlib
+only), mirroring utils/nodectx.py: the cycle-sensitive wrapper modules
+(utils/bls.py, ssz/merkle.py, ssz/incremental.py) keep their lazy-
+import discipline and use validated string literals instead, while
+everything that CAN import it at module scope (txn/, guard, faults,
+tests) derives.  speclint loads it standalone by file path, so linting
+never imports jax or the heavy packages.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+# site kinds
+DISPATCH = "dispatch"   # a resilience.dispatch(site, device_fn, fallback_fn)
+BARRIER = "barrier"     # a faults.fire(site) crash point (no value to corrupt)
+
+# chaos tiers — where the chaos tier reaches the site from
+REPLAY = "replay"   # native-backend sanity replay (test_chaos SITES)
+GOSSIP = "gossip"   # gossip admission tier extra (GOSSIP_SITES adds these)
+KILL = "kill"       # transactional crash points (KILL_SITES)
+UNIT = "unit"       # unreachable from a CPU chaos replay; unit-tier covered
+                    # (entries must say where in `note`)
+
+_KINDS = (DISPATCH, BARRIER)
+_TIERS = (REPLAY, GOSSIP, KILL, UNIT)
+_CORRUPT = ("verdict", "digest", "none")
+
+
+@dataclass(frozen=True)
+class Site:
+    """One registered seam.
+
+    name     — the canonical dotted site string passed to dispatch/fire.
+    module   — the wrapper module that owns the seam (the only module,
+               besides registered kernel-layer ones, allowed to import
+               device kernels directly — speclint's bypass pass).
+    kind     — DISPATCH or BARRIER.
+    chaos    — which chaos tier exercises it (REPLAY/GOSSIP/KILL/UNIT).
+    corrupt  — what the fault injector's "corrupt" kind may flip:
+               "verdict" (bool/bool-list), "digest" (one bit of a bytes
+               root — only sites a differential oracle guards), "none"
+               (barriers: a crash point has no value).
+    fused    — verdicts flow through the fused signature pipeline; the
+               differential guard quarantines all fused sites as a unit.
+    doc      — the document whose site table must list the name.
+    note     — required for UNIT tier: where coverage lives instead.
+    """
+
+    name: str
+    module: str
+    kind: str = DISPATCH
+    chaos: str = UNIT
+    corrupt: str = "verdict"
+    fused: bool = False
+    doc: str = "docs/resilience.md"
+    note: str = ""
+
+
+# Declaration order is contractual: the chaos tuples derive from it, so
+# seeded randomized fault schedules draw sites in this order.
+REGISTRY: tuple[Site, ...] = (
+    # -- replay tier: every native-backend sanity replay crosses these
+    Site("bls.pairing_check", "consensus_specs_tpu.utils.bls",
+         kind=DISPATCH, chaos=REPLAY, fused=True),
+    Site("bls.verify_batch", "consensus_specs_tpu.utils.bls",
+         kind=DISPATCH, chaos=REPLAY, fused=True),
+    Site("bls.fast_aggregate_verify_batch", "consensus_specs_tpu.utils.bls",
+         kind=DISPATCH, chaos=REPLAY, fused=True),
+    Site("ops.g1_aggregate", "consensus_specs_tpu.sigpipe.cache",
+         kind=DISPATCH, chaos=REPLAY),
+    Site("ops.msm", "consensus_specs_tpu.sigpipe.scheduler",
+         kind=DISPATCH, chaos=REPLAY),
+    Site("ssz.merkle_sweep", "consensus_specs_tpu.ssz.incremental",
+         kind=DISPATCH, chaos=REPLAY, corrupt="digest"),
+    # -- gossip tier extra: the admission pipeline's batch window
+    Site("gossip.batch_verify", "consensus_specs_tpu.gossip.batcher",
+         kind=DISPATCH, chaos=GOSSIP),
+    # -- transactional crash points (KILL_SITES order is contractual)
+    Site("txn.mutate", "consensus_specs_tpu.txn.overlay",
+         kind=BARRIER, chaos=KILL, corrupt="none"),
+    Site("txn.commit", "consensus_specs_tpu.txn",
+         kind=DISPATCH, chaos=KILL, corrupt="none"),
+    Site("txn.commit.apply", "consensus_specs_tpu.txn.overlay",
+         kind=BARRIER, chaos=KILL, corrupt="none"),
+    Site("txn.journal", "consensus_specs_tpu.txn.journal",
+         kind=BARRIER, chaos=KILL, corrupt="none"),
+    # -- unit tier: tpu-backend-only seams a CPU chaos replay never
+    #    crosses; each names its covering unit suite
+    Site("bls.verify", "consensus_specs_tpu.utils.bls",
+         kind=DISPATCH, chaos=UNIT,
+         note="tpu-backend scalar seam; tests/test_resilience.py + "
+              "tests/test_bls_tpu.py"),
+    Site("bls.aggregate_verify", "consensus_specs_tpu.utils.bls",
+         kind=DISPATCH, chaos=UNIT,
+         note="tpu-backend scalar seam; tests/test_resilience.py + "
+              "tests/test_bls_tpu.py"),
+    Site("bls.fast_aggregate_verify", "consensus_specs_tpu.utils.bls",
+         kind=DISPATCH, chaos=UNIT,
+         note="tpu-backend scalar seam; tests/test_resilience.py + "
+              "tests/test_bls_tpu.py"),
+    # fused (the guard quarantines it with its sibling batch seams) but
+    # NOT replay-tier: no node-runtime path calls AggregateVerifyBatch
+    # today — the scheduler's per-set mode rides FastAggregateVerifyBatch
+    # — so a chaos FaultSpec here would never fire and the tuple entry
+    # would claim coverage it does not deliver
+    Site("bls.aggregate_verify_batch", "consensus_specs_tpu.utils.bls",
+         kind=DISPATCH, chaos=UNIT, fused=True,
+         note="batch API surface with no runtime caller yet; "
+              "tests/test_bls_tpu.py + tests/test_sigpipe.py"),
+    Site("sigpipe.hash_to_g2_batch", "consensus_specs_tpu.sigpipe.scheduler",
+         kind=DISPATCH, chaos=UNIT, fused=True,
+         note="tpu-backend cofactor sweep; tests/test_resilience.py"),
+    Site("ops.msm.g1", "consensus_specs_tpu.utils.bls",
+         kind=DISPATCH, chaos=UNIT,
+         note="threshold-gated device MSM; tests/test_msm_pippenger.py"),
+    Site("ops.msm.g2", "consensus_specs_tpu.utils.bls",
+         kind=DISPATCH, chaos=UNIT,
+         note="threshold-gated device MSM; tests/test_msm_pippenger.py"),
+    Site("ops.msm.kzg", "consensus_specs_tpu.crypto.kzg",
+         kind=DISPATCH, chaos=UNIT,
+         note="threshold-gated device MSM; tests/test_kzg.py"),
+    Site("ops.sha256.hash_level", "consensus_specs_tpu.ssz.merkle",
+         kind=DISPATCH, chaos=UNIT,
+         note="install-gated bulk hasher; tests/test_sha256_jax.py + "
+              "tests/test_merkle_sweep_jax.py"),
+    Site("ops.sha256.subtree", "consensus_specs_tpu.ssz.merkle",
+         kind=DISPATCH, chaos=UNIT,
+         note="install-gated subtree hasher; tests/test_sha256_jax.py"),
+)
+
+# speclint: disable=global-mutable-state -- name index over the frozen
+# REGISTRY tuple, built once at import and read-only afterwards
+SITES: dict[str, Site] = {s.name: s for s in REGISTRY}
+
+if len(SITES) != len(REGISTRY):
+    raise RuntimeError("duplicate site name in resilience.sites.REGISTRY")
+for _s in REGISTRY:
+    if _s.kind not in _KINDS:
+        raise RuntimeError(f"{_s.name}: bad kind {_s.kind!r}")
+    if _s.chaos not in _TIERS:
+        raise RuntimeError(f"{_s.name}: bad chaos tier {_s.chaos!r}")
+    if _s.corrupt not in _CORRUPT:
+        raise RuntimeError(f"{_s.name}: bad corrupt class {_s.corrupt!r}")
+    if _s.chaos == UNIT and not _s.note:
+        raise RuntimeError(
+            f"{_s.name}: UNIT-tier sites must say where coverage lives")
+
+
+def site(name: str) -> Site:
+    """Look up one registered site; KeyError on unregistered names."""
+    return SITES[name]
+
+
+def is_registered(name: str) -> bool:
+    return name in SITES
+
+
+def names() -> tuple[str, ...]:
+    return tuple(s.name for s in REGISTRY)
+
+
+def chaos_replay_sites() -> tuple[str, ...]:
+    """test_chaos.py SITES: seams a native-backend sanity replay crosses."""
+    return tuple(s.name for s in REGISTRY if s.chaos == REPLAY)
+
+
+def chaos_gossip_sites() -> tuple[str, ...]:
+    """test_chaos.py GOSSIP_SITES: the replay tier plus the admission
+    pipeline's own seams."""
+    return chaos_replay_sites() + tuple(
+        s.name for s in REGISTRY if s.chaos == GOSSIP)
+
+
+def kill_sites() -> tuple[str, ...]:
+    """test_chaos.py KILL_SITES: every transactional crash-point family."""
+    return tuple(s.name for s in REGISTRY if s.chaos == KILL)
+
+
+def fused_sites() -> tuple[str, ...]:
+    """guard.py FUSED_SITES: quarantined as a unit on a guard mismatch."""
+    return tuple(s.name for s in REGISTRY if s.fused)
+
+
+def digest_guarded_sites() -> frozenset[str]:
+    """faults.py _DIGEST_GUARDED_SITES: bytes-root results the corrupt
+    fault kind may bit-flip (a differential oracle guards them)."""
+    return frozenset(s.name for s in REGISTRY if s.corrupt == "digest")
+
+
+def wrapper_modules() -> frozenset[str]:
+    """Modules that own a seam — allowed to import device kernels."""
+    return frozenset(s.module for s in REGISTRY)

@@ -237,6 +237,9 @@ class Driver:
             assert self._degraded is None, "nested degraded windows"
             self._degraded = ExitStack()
             self._degraded.enter_context(resilience.inject(FaultPlan(
+                # speclint: disable=seam-dynamic-site -- the site comes
+                # from the scenario DSL; dsl.validate() rejects any name
+                # not in the resilience.sites registry before a run starts
                 [FaultSpec(action.params["site"], "raise",
                            persistent=True)], seed=self.seed)))
         elif kind == "degraded_end":

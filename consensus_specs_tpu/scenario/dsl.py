@@ -220,6 +220,12 @@ class Scenario:
                 assert e.get("until_slot") > e.at_slot
                 assert e.at_slot >= degraded_until, \
                     f"overlapping degraded windows: {e}"
+                # the driver injects a persistent fault at this site;
+                # an unregistered name would inject at a seam that does
+                # not exist and the window would silently test nothing
+                from ..resilience import sites
+                assert sites.is_registered(e.get("site")), \
+                    f"degraded window names unregistered site: {e}"
                 degraded_until = e.get("until_slot")
             else:
                 raise AssertionError(f"unknown event kind {e.kind!r}")
@@ -236,6 +242,9 @@ class Scenario:
 # the named library (scripts/run_scenario.py and the tests use these)
 # ---------------------------------------------------------------------------
 
+# speclint: disable=global-mutable-state -- static scenario registry,
+# populated once at import by named() declarations below, read-only at
+# run time; scenarios are frozen dataclasses shared safely by value
 LIBRARY: dict = {}
 
 

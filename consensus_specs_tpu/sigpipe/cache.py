@@ -271,7 +271,13 @@ class AggregatePubkeyCache:
             return len(self._cache)
 
 
+# speclint: disable=global-mutable-state -- content-addressed cache:
+# pubkey bytes -> decompressed Point, identical whichever node computes
+# it, so fleet-wide sharing is sound (and what makes SimNode fleets cheap)
 PUBKEYS = PubkeyCache()
+# speclint: disable=global-mutable-state -- keyed by participant-set
+# digest, values node-independent; txn rollback evicts only entries the
+# aborted transaction itself inserted (begin_track/end_track)
 AGGREGATES = AggregatePubkeyCache(PUBKEYS)
 
 

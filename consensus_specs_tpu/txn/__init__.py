@@ -55,6 +55,7 @@ import functools
 import threading
 from contextlib import contextmanager
 
+from ..resilience import sites
 from ..resilience.incidents import INCIDENTS
 from ..resilience.supervisor import dispatch
 from ..sigpipe.cache import AGGREGATES
@@ -63,7 +64,9 @@ from .journal import Journal, JournalEntry, Snapshot
 from .oracle import store_root
 from .overlay import OverlayDict, OverlaySet, StoreTransaction, clone_store
 
-COMMIT_SITE = "txn.commit"
+# canonical name from the site registry: speclint checks every dispatch
+# call site against it, and test_chaos's KILL_SITES derive from it
+COMMIT_SITE = sites.site("txn.commit").name
 
 _ACTIVE = None
 _lock = threading.RLock()

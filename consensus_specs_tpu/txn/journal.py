@@ -33,6 +33,7 @@ import hashlib
 import threading
 from dataclasses import dataclass, field
 
+from ..resilience import sites
 from ..resilience.faults import fire
 from ..resilience.incidents import INCIDENTS
 from ..sigpipe.metrics import METRICS
@@ -40,7 +41,7 @@ from ..ssz import hash_tree_root
 from .oracle import store_root
 from .overlay import clone_store
 
-JOURNAL_SITE = "txn.journal"
+JOURNAL_SITE = sites.site("txn.journal").name
 
 
 def _copy_arg(value):

@@ -53,10 +53,11 @@ from __future__ import annotations
 
 import dataclasses
 
+from ..resilience import sites
 from ..resilience.faults import fire
 
-MUTATE_SITE = "txn.mutate"
-COMMIT_APPLY_SITE = "txn.commit.apply"
+MUTATE_SITE = sites.site("txn.mutate").name
+COMMIT_APPLY_SITE = sites.site("txn.commit.apply").name
 
 
 class _TxnList(list):

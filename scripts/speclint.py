@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""speclint CLI — machine-enforce the dispatch-seam, determinism,
+isolation, and txn-purity contracts (consensus_specs_tpu/analysis/).
+
+    python scripts/speclint.py                # lint the repo, human output
+    python scripts/speclint.py --json         # machine-readable findings
+    python scripts/speclint.py path.py ...    # lint specific files (all
+                                              # passes apply — fixture mode)
+
+Exit status: 0 clean, 1 findings, 2 usage/internal error.  The full-repo
+run is stdlib-ast only and budgeted well under 10 s, so it rides in
+`make speclint` / `make test-quick` and as a pytest gate
+(tests/test_speclint.py).  Rule catalogue: docs/analysis.md.
+"""
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from consensus_specs_tpu.analysis import RULES, run_speclint  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="speclint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*",
+                    help="specific .py files to lint (default: the "
+                         "package + tests/test_chaos.py)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as a JSON document")
+    ap.add_argument("--root", default=str(REPO_ROOT),
+                    help="repository root (default: this checkout)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalogue and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule:28s} {desc}")
+        return 0
+
+    t0 = time.perf_counter()
+    try:
+        findings = run_speclint(args.root, args.paths or None)
+    except (OSError, SyntaxError, RuntimeError) as e:
+        # RuntimeError: resilience/sites.py's own import-time structural
+        # validation (duplicate name, bad tier, noteless UNIT entry)
+        print(f"speclint: error: {e}", file=sys.stderr)
+        return 2
+    elapsed = time.perf_counter() - t0
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_json() for f in findings],
+            "count": len(findings),
+            "elapsed_s": round(elapsed, 3),
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        noun = "finding" if len(findings) == 1 else "findings"
+        print(f"speclint: {len(findings)} {noun} ({elapsed:.2f}s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

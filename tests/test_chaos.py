@@ -24,7 +24,7 @@ import pytest
 
 from consensus_specs_tpu import resilience, sigpipe
 from consensus_specs_tpu.resilience import (
-    FaultPlan, FaultSpec, INCIDENTS, faults,
+    FaultPlan, FaultSpec, INCIDENTS, faults, sites,
 )
 from consensus_specs_tpu.sigpipe import METRICS
 from consensus_specs_tpu.specs import get_spec
@@ -43,17 +43,15 @@ pytestmark = pytest.mark.slow
 
 CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "20260803"))
 
-# the dispatch sites a native-backend replay actually reaches (tpu-only
-# seams like sigpipe.hash_to_g2_batch are covered by unit tests).
-# ops.g1_aggregate / ops.msm are the PR-5 device G1 sweep sites — every
-# scheduler flush crosses both, so the randomized schedules and the
-# gossip tier now exercise trips/fallbacks there too.  ssz.merkle_sweep
-# is the incremental-merkleization dispatch (ssz/incremental.py):
-# _replay runs with that mode on, so every re-root of the tracked state
-# crosses it.
-SITES = ("bls.pairing_check", "bls.verify_batch",
-         "bls.fast_aggregate_verify_batch",
-         "ops.g1_aggregate", "ops.msm", "ssz.merkle_sweep")
+# the dispatch sites a native-backend replay actually reaches, DERIVED
+# from the canonical registry (resilience/sites.py, chaos tier
+# "replay") so chaos coverage can never drift from the seams that
+# exist: registering a new replay-tier site automatically puts it under
+# the randomized schedules below, and speclint fails CI on any site
+# name the registry does not know.  tpu-only seams (tier "unit", e.g.
+# sigpipe.hash_to_g2_batch) are covered by unit tests instead — each
+# registry entry names its covering suite.
+SITES = sites.chaos_replay_sites()
 
 
 @pytest.fixture(scope="module")
@@ -219,6 +217,8 @@ def test_chaos_randomized_schedules(spec, workload):
                 continue
             kind = rng.choice(["raise", "timeout", "corrupt"])
             specs.append(FaultSpec(
+                # speclint: disable=seam-dynamic-site -- drawn from the
+                # registry-derived SITES tuple above
                 site, kind,
                 rate=rng.choice([0.3, 0.7, 1.0]),
                 persistent=rng.random() < 0.5,
@@ -274,7 +274,9 @@ def test_chaos_invalid_block_same_boundary_under_faults(spec, workload):
 # gossip tier: the admission pipeline under the fault matrix
 # ---------------------------------------------------------------------------
 
-GOSSIP_SITES = SITES + ("gossip.batch_verify",)
+# replay tier + the admission pipeline's own seams (registry tier
+# "gossip"); derived, like SITES, so the tuple cannot drift
+GOSSIP_SITES = sites.chaos_gossip_sites()
 
 
 @pytest.fixture(scope="module")
@@ -354,6 +356,8 @@ def test_chaos_gossip_admission(spec, gossip_workload):
                 continue
             kind = rng.choice(["raise", "timeout", "corrupt"])
             fault_specs.append(FaultSpec(
+                # speclint: disable=seam-dynamic-site -- drawn from the
+                # registry-derived GOSSIP_SITES tuple above
                 site, kind, rate=rng.choice([0.4, 1.0]),
                 persistent=rng.random() < 0.5,
                 max_fires=rng.choice([1, 2, None]), sleep_s=0.2))
@@ -418,9 +422,9 @@ def test_chaos_gossip_admission(spec, gossip_workload):
 
 # every seeded kill-point family the transactional store exposes:
 # between any two store mutations, at the commit barrier, inside the
-# (idempotent) overlay apply, and mid-journal-write
-KILL_SITES = ("txn.mutate", "txn.commit", "txn.commit.apply",
-              "txn.journal")
+# (idempotent) overlay apply, and mid-journal-write — derived from the
+# registry (chaos tier "kill")
+KILL_SITES = sites.kill_sites()
 
 
 @pytest.fixture(scope="module")
@@ -485,6 +489,8 @@ def test_chaos_crash_anywhere_recovery(spec, txn_workload):
             METRICS.reset()
             site = KILL_SITES[round_i % len(KILL_SITES)]
             plan = FaultPlan(
+                # speclint: disable=seam-dynamic-site -- cycles through
+                # the registry-derived KILL_SITES tuple above
                 [FaultSpec(site, "raise",
                            rate=rng.choice([0.05, 0.2, 0.5]),
                            max_fires=1)],

@@ -1,0 +1,327 @@
+"""speclint (consensus_specs_tpu/analysis/): the invariant checker that
+machine-enforces the dispatch-seam, determinism, isolation, and
+txn-purity contracts.
+
+Three layers:
+
+* fixture tier — scratch files seeding ≥ 1 violation per pass, asserting
+  exact rule ids and locations, plus the disable escape hatch (reasoned
+  disables suppress; reasonless or unknown-rule disables are findings).
+* registry tier — the chaos tuples really derive from
+  resilience/sites.py, a fake unregistered site fails the lint, and the
+  registry's structural guarantees (UNIT tier requires a covering note).
+* repo tier — the gate itself: the tree lints clean, inside the < 10 s
+  budget, with every pass having run.
+"""
+import subprocess
+import sys
+import textwrap
+import time
+from pathlib import Path
+
+import pytest
+
+from consensus_specs_tpu.analysis import RULES, run_speclint
+from consensus_specs_tpu.resilience import sites
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def lint_snippet(tmp_path, source, name="fixture.py"):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(source))
+    return run_speclint(REPO_ROOT, [path])
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# fixture tier: one seeded violation per pass, exact rule id + location
+# ---------------------------------------------------------------------------
+
+def test_seam_unregistered_site(tmp_path):
+    findings = lint_snippet(tmp_path, """\
+        from consensus_specs_tpu.resilience.supervisor import dispatch
+
+        def f():
+            return dispatch("bogus.site", lambda: 1, lambda: 1)
+    """)
+    assert rules_of(findings) == ["seam-unregistered-site"]
+    assert findings[0].line == 4
+    assert "bogus.site" in findings[0].message
+
+
+def test_seam_missing_fallback(tmp_path):
+    findings = lint_snippet(tmp_path, """\
+        from consensus_specs_tpu.resilience.supervisor import dispatch
+
+        def f():
+            return dispatch("bls.pairing_check", lambda: 1)
+    """)
+    assert rules_of(findings) == ["seam-missing-fallback"]
+    assert findings[0].line == 4
+
+
+def test_seam_site_resolved_through_module_constant(tmp_path):
+    findings = lint_snippet(tmp_path, """\
+        from consensus_specs_tpu.resilience.supervisor import dispatch
+
+        MY_SITE = "not.registered"
+
+        def f():
+            return dispatch(MY_SITE, lambda: 1, lambda: 1)
+    """)
+    assert rules_of(findings) == ["seam-unregistered-site"]
+    assert findings[0].line == 6
+
+
+def test_seam_faultspec_site_checked(tmp_path):
+    findings = lint_snippet(tmp_path, """\
+        from consensus_specs_tpu.resilience import FaultSpec
+
+        SPEC = FaultSpec("bogus.kill", "raise")
+    """)
+    assert rules_of(findings) == ["seam-unregistered-site"]
+
+
+def test_bypass_direct_kernel_import(tmp_path):
+    findings = lint_snippet(tmp_path, """\
+        from consensus_specs_tpu.ops.sha256_pallas import hash_level_pallas
+
+        def f(level):
+            return hash_level_pallas(level)
+    """)
+    assert rules_of(findings) == ["bypass-direct-kernel"]
+    assert findings[0].line == 1
+    assert "sha256_pallas" in findings[0].message
+
+
+def test_determinism_wall_clock_and_rng(tmp_path):
+    findings = lint_snippet(tmp_path, """\
+        import random
+        import time
+
+        def decide():
+            deadline = time.time() + 5
+            return random.random() < 0.5, random.Random()
+    """)
+    assert rules_of(findings) == [
+        "det-wall-clock", "det-unseeded-rng", "det-unseeded-rng"]
+    assert [f.line for f in findings] == [5, 6, 6]
+
+
+def test_determinism_sees_through_import_aliases(tmp_path):
+    """`from time import time` / `import random as r` must not dodge
+    the gate: names are canonicalized through the file's imports."""
+    findings = lint_snippet(tmp_path, """\
+        import random as r
+        from random import Random
+        from time import time as now
+
+        def decide():
+            return now() + r.random(), Random()
+    """)
+    assert rules_of(findings) == [
+        "det-wall-clock", "det-unseeded-rng", "det-unseeded-rng"]
+    assert all(f.line == 6 for f in findings)
+
+
+def test_disable_text_inside_string_literal_is_inert(tmp_path):
+    """Disable-looking text in docstrings/strings (usage examples) must
+    neither suppress findings nor trip speclint-bad-disable."""
+    findings = lint_snippet(tmp_path, '''\
+        DOC = """example: # speclint: disable=det-wall-clock"""
+
+        def decide():
+            HINT = "# speclint: disable=det-wall-clock -- reasoned"
+            import time
+            return time.time()
+    ''')
+    assert rules_of(findings) == ["det-wall-clock"]
+
+
+def test_determinism_allows_seeded_rng_and_perf_counter(tmp_path):
+    findings = lint_snippet(tmp_path, """\
+        import random
+        import time
+
+        def measure(seed):
+            rng = random.Random(seed)
+            t0 = time.perf_counter()
+            return rng.random(), time.perf_counter() - t0
+    """)
+    assert findings == []
+
+
+def test_global_mutable_state(tmp_path):
+    findings = lint_snippet(tmp_path, """\
+        CACHE = {}
+    """)
+    assert rules_of(findings) == ["global-mutable-state"]
+    assert findings[0].line == 1
+
+
+def test_global_router_is_sanctioned(tmp_path):
+    findings = lint_snippet(tmp_path, """\
+        from consensus_specs_tpu.utils import nodectx
+
+        THINGS = nodectx.Router(object(), "things")
+    """)
+    assert findings == []
+
+
+def test_txn_unwrapped_store_write(tmp_path):
+    findings = lint_snippet(tmp_path, """\
+        def rogue_handler(spec, store, block):
+            store.blocks[b"root"] = block
+    """)
+    assert rules_of(findings) == ["txn-unwrapped-store-write"]
+    assert findings[0].line == 2
+    assert "rogue_handler" in findings[0].message
+
+
+def test_txn_transactional_handler_and_helper_pass(tmp_path):
+    findings = lint_snippet(tmp_path, """\
+        from consensus_specs_tpu.txn import transactional
+
+        class Spec:
+            @transactional
+            def on_widget(self, store, widget):
+                self.update_widget_checkpoint(store, widget)
+
+            def update_widget_checkpoint(self, store, widget):
+                store.widgets[widget.root] = widget
+    """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# the escape hatch: reasoned disables suppress, malformed ones are findings
+# ---------------------------------------------------------------------------
+
+def test_disable_with_reason_suppresses(tmp_path):
+    findings = lint_snippet(tmp_path, """\
+        import time
+
+        def decide():
+            # speclint: disable=det-wall-clock -- boundary with the real
+            # world: this path only runs in production wiring
+            return time.time()
+    """)
+    assert findings == []
+
+
+def test_disable_without_reason_is_a_finding(tmp_path):
+    findings = lint_snippet(tmp_path, """\
+        import time
+
+        def decide():
+            return time.time()  # speclint: disable=det-wall-clock
+    """)
+    # the reasonless disable does NOT suppress, and is itself flagged
+    assert sorted(rules_of(findings)) == [
+        "det-wall-clock", "speclint-bad-disable"]
+
+
+def test_disable_unknown_rule_is_a_finding(tmp_path):
+    findings = lint_snippet(tmp_path, """\
+        X = 1  # speclint: disable=no-such-rule -- because
+    """)
+    assert rules_of(findings) == ["speclint-bad-disable"]
+    assert "no-such-rule" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# registry tier: the chaos tuples derive, fakes fail, structure holds
+# ---------------------------------------------------------------------------
+
+def test_chaos_tuples_derive_from_registry():
+    import tests.test_chaos as chaos
+    assert chaos.SITES == sites.chaos_replay_sites()
+    assert chaos.GOSSIP_SITES == sites.chaos_gossip_sites()
+    assert chaos.KILL_SITES == sites.kill_sites()
+    # every chaos-tuple member is a registered site of the right tier
+    for name in chaos.SITES:
+        assert sites.site(name).chaos == "replay"
+    # bls.aggregate_verify_batch is deliberately NOT in SITES: no node-
+    # runtime path calls AggregateVerifyBatch, so a chaos fault there
+    # would never fire — the registry records that as UNIT tier with
+    # its covering suites, instead of claiming coverage it can't deliver
+    assert sites.site("bls.aggregate_verify_batch").chaos == "unit"
+    # ...but the guard still quarantines it with its sibling batch seams
+    assert "bls.aggregate_verify_batch" in sites.fused_sites()
+
+
+def test_fake_unregistered_site_fails_speclint(tmp_path):
+    """The pin the registry exists for: a site name the registry does
+    not know — as a chaos FaultSpec or a dispatch — fails the lint."""
+    findings = lint_snippet(tmp_path, """\
+        from consensus_specs_tpu.resilience import FaultSpec
+        from consensus_specs_tpu.resilience.supervisor import dispatch
+
+        FAKE_SITES = ("bls.pairing_check", "bls.paring_check_typo")
+        SPEC = FaultSpec("bls.paring_check_typo", "corrupt")
+
+        def f():
+            return dispatch("ops.brand_new_kernel", lambda: 1, lambda: 1)
+    """)
+    assert rules_of(findings) == [
+        "seam-unregistered-site", "seam-unregistered-site"]
+
+
+def test_registry_structure():
+    names = sites.names()
+    assert len(names) == len(set(names))
+    for s in sites.REGISTRY:
+        assert s.kind in ("dispatch", "barrier")
+        if s.chaos == "unit":
+            assert s.note, f"{s.name}: unit tier must cite coverage"
+        if s.kind == "barrier":
+            assert s.corrupt == "none"  # a crash point has no value
+    # derived views agree with the guard/fault-injector consumers
+    from consensus_specs_tpu.resilience import faults, guard
+    assert guard.FUSED_SITES == sites.fused_sites()
+    assert faults._DIGEST_GUARDED_SITES == sites.digest_guarded_sites()
+    assert set(sites.kill_sites()) == {
+        "txn.mutate", "txn.commit", "txn.commit.apply", "txn.journal"}
+
+
+def test_every_rule_documented():
+    doc = (REPO_ROOT / "docs" / "analysis.md").read_text()
+    for rule in RULES:
+        assert f"`{rule}`" in doc, f"rule {rule} missing from docs/analysis.md"
+
+
+# ---------------------------------------------------------------------------
+# repo tier: the gate
+# ---------------------------------------------------------------------------
+
+def test_repo_is_clean_and_fast():
+    t0 = time.perf_counter()
+    findings = run_speclint(REPO_ROOT)
+    elapsed = time.perf_counter() - t0
+    assert findings == [], "\n".join(f.render() for f in findings)
+    assert elapsed < 10.0, f"speclint took {elapsed:.1f}s (> 10s budget)"
+
+
+@pytest.mark.slow
+def test_cli_exit_codes(tmp_path):
+    """`scripts/speclint.py`: exit 0 on a clean tree, 1 with findings,
+    and --json emits a machine-readable document."""
+    script = str(REPO_ROOT / "scripts" / "speclint.py")
+    clean = subprocess.run([sys.executable, script],
+                           capture_output=True, text=True)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("CACHE = {}\n")
+    dirty = subprocess.run(
+        [sys.executable, script, "--json", str(bad)],
+        capture_output=True, text=True)
+    assert dirty.returncode == 1
+    import json
+    doc = json.loads(dirty.stdout)
+    assert doc["count"] == 1
+    assert doc["findings"][0]["rule"] == "global-mutable-state"
