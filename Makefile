@@ -13,9 +13,9 @@ RUNNERS := shuffling ssz_static operations epoch_processing sanity bls \
 	forks merkle_proof networking kzg_7594 random light_client sync
 
 .PHONY: test test-quick test-kernels tier1 chaos recovery-chaos \
-	scenario-chaos lint speclint native pyspec bench gossip-bench \
-	txn-bench msm-bench merkle-bench scenario-bench gen_all \
-	detect_errors $(addprefix gen_,$(RUNNERS))
+	scenario-chaos shard-verify lint speclint native pyspec bench \
+	gossip-bench txn-bench msm-bench merkle-bench scenario-bench \
+	multichip-bench gen_all detect_errors $(addprefix gen_,$(RUNNERS))
 
 # syntax/bytecode check over every package and script (the CI lint job)
 lint:
@@ -40,7 +40,10 @@ test-kernels:
 
 # spec suites only (fastest signal while iterating on spec code);
 # speclint gates first — a seam/determinism/isolation violation fails
-# in seconds, before any test runs
+# in seconds, before any test runs.  The sharded-verify fast pins ride
+# along (test_sigpipe engine-mode/sweep seams, test_resilience
+# shard_dead breaker contract); the mesh-kernel leg is `make
+# shard-verify` / the test-kernels tier (conftest KERNEL_TIER_FILES)
 test-quick: speclint
 	$(PYTHON) -m pytest tests/spec_suites tests/test_ssz.py \
 		tests/test_phase0_sanity.py tests/test_epoch_fast.py \
@@ -83,6 +86,17 @@ recovery-chaos:
 scenario-chaos:
 	env JAX_PLATFORMS=cpu \
 		$(PYTHON) -m pytest tests/test_scenario.py -q --kernel-tiers
+
+# sharded verify path alone (parallel/shard_verify.py): the forced
+# 8-device host-mesh parity + shard-fault suite.  The file rides the
+# suite's kernel tier (conftest KERNEL_TIER_FILES — `make test-kernels`
+# runs it with the other limb-kernel suites); this target is the
+# focused loop while iterating on the sharding layer.  The fast seams
+# (shard_dead breaker contract, oracle-engine sweeps) stay in tier-1
+# via test_resilience/test_sigpipe.
+shard-verify:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_shard_verify.py \
+		-q --kernel-tiers
 
 native:
 	$(PYTHON) scripts/build_native.py
@@ -127,6 +141,15 @@ merkle-bench:
 # and BENCH_SCENARIO_SEED=N pick another battlefield
 scenario-bench:
 	env JAX_PLATFORMS=cpu $(PYTHON) bench.py scenario
+
+# multi-chip sharded verify alone (parallel/shard_verify.py): one
+# >=1k-set flush's aggregation sweep + weighted MSM + fused pairing
+# product at 1/2/4/8 forced-host devices; asserts byte-identical
+# outputs across every mesh width, O(1) dispatches per flush, and
+# >= 3x 1->8 device throughput scaling; emits MULTICHIP_r06.json.
+# BENCH_MULTICHIP_SETS=64 BENCH_MULTICHIP_DEVICES=1,2 give a smoke run
+multichip-bench:
+	env JAX_PLATFORMS=cpu $(PYTHON) bench.py multichip
 
 # static pattern rule: GNU make refuses to run implicit pattern rules
 # for .PHONY targets

@@ -31,6 +31,7 @@ def mesh_case_assignment(mesh, n_cases: int) -> list[list[int]]:
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
     from ..parallel.collectives import AXIS
+    from ..parallel.mesh import shard_map
 
     n_dev = int(np.prod(list(mesh.shape.values())))
     per = -(-n_cases // n_dev) if n_cases else 0
@@ -42,8 +43,8 @@ def mesh_case_assignment(mesh, n_cases: int) -> list[list[int]]:
         idx = d + jnp.arange(per, dtype=jnp.int32) * n_dev
         return jnp.where(idx < n_cases, idx, -1)[None]
 
-    fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=(),
-                               out_specs=P(AXIS, None)))
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(),
+                           out_specs=P(AXIS, None)))
     rows = np.asarray(jax.device_get(fn()))
     return [[int(i) for i in row if i >= 0] for row in rows]
 

@@ -22,6 +22,12 @@ a per-set Python loop (that loop is the *fallback* of the
 `ops.g1_aggregate` resilience dispatch site, and is what
 sigpipe.metrics' `host_point_adds` counts).
 
+Multi-chip: with a >1-device verify mesh the padded segment axis is
+partitioned over the mesh (parallel/shard_verify.py `shard_jobs`) —
+each device tree-sums its own segments with zero cross-device traffic,
+inside the same single dispatch; a 1-device mesh is byte-identical to
+the unsharded path.
+
 Oracle: summing each list with crypto/curve.py `Point.__add__`.
 """
 from __future__ import annotations
@@ -30,15 +36,31 @@ import os as _os
 
 from ..crypto import curve as cv
 
-G1_SWEEP_MODE = _os.environ.get("G1_SWEEP_MODE")
+# resolved LAZILY (first sweep call): the env var is read at resolve
+# time, not import time, so tests/benches that flip G1_SWEEP_MODE in
+# the environment are not order-dependent on when this module was first
+# imported.  Assigning the global directly still wins (the test-fixture
+# idiom); `reset_mode()` forgets a cached choice.
+G1_SWEEP_MODE = None
+
+
+def reset_mode() -> None:
+    """Forget the cached engine choice: the next call re-reads the
+    G1_SWEEP_MODE env var and the active jax backend."""
+    global G1_SWEEP_MODE
+    G1_SWEEP_MODE = None
 
 
 def _resolve_mode() -> str:
     global G1_SWEEP_MODE
     if G1_SWEEP_MODE is None:
-        import jax
-        G1_SWEEP_MODE = ("oracle" if jax.default_backend() == "cpu"
-                         else "jax")
+        env = _os.environ.get("G1_SWEEP_MODE")
+        if env:
+            G1_SWEEP_MODE = env
+        else:
+            import jax
+            G1_SWEEP_MODE = ("oracle" if jax.default_backend() == "cpu"
+                             else "jax")
     return G1_SWEEP_MODE
 
 
@@ -80,6 +102,12 @@ def _jax_sweep(point_lists):
     X = X.reshape(n_pad, seg_len, fq.LIMBS)
     Y = Y.reshape(n_pad, seg_len, fq.LIMBS)
     Z = Z.reshape(n_pad, seg_len, fq.LIMBS)
+    # multi-chip: partition the (padded, power-of-two) segment axis
+    # over the verify mesh — the halving tree below reduces along the
+    # LENGTH axis, so each device sums its own segments with zero
+    # cross-device traffic; a 1-device mesh is a no-op
+    from ..parallel import shard_verify
+    X, Y, Z = shard_verify.shard_jobs((X, Y, Z), "ops.g1_aggregate")
     # halving tree along the segment-length axis: log2(L) launches of
     # the one jitted pairwise-add kernel at power-of-two shapes (the
     # fully unrolled tree is the compile blow-up msm.py already avoids)

@@ -54,16 +54,27 @@ _PIPPENGER_MIN = 256            # below this the plain lanes win
 # split like pairing_jax._resolve_mode: the fused Pippenger program is
 # a multi-minute XLA compile on a small CPU host (fine once, cached on
 # accelerators) while the lanes kernels compile in seconds, so CPU
-# defaults to lanes and accelerators to pippenger
+# defaults to lanes and accelerators to pippenger.  Resolved LAZILY:
+# the env var is read at first use, not import, so flipping it in a
+# test/bench is not order-dependent; assigning the global directly
+# still wins, and reset_mode() forgets a cached choice.
 import os as _os
-MSM_MODE = _os.environ.get("MSM_MODE")
+MSM_MODE = None
+
+
+def reset_mode() -> None:
+    """Forget the cached engine choice: the next call re-reads the
+    MSM_MODE env var and the active jax backend."""
+    global MSM_MODE
+    MSM_MODE = None
 
 
 def _resolve_mode() -> str:
     global MSM_MODE
     if MSM_MODE is None:
-        MSM_MODE = ("lanes" if jax.default_backend() == "cpu"
-                    else "pippenger")
+        MSM_MODE = (_os.environ.get("MSM_MODE")
+                    or ("lanes" if jax.default_backend() == "cpu"
+                        else "pippenger"))
     return MSM_MODE
 
 
@@ -275,7 +286,11 @@ def g1_weighted_sweep(points, scalars):
     Platform split follows g1_sweep.G1_SWEEP_MODE (jax engine off-CPU,
     vectorized host oracle on CPU); the per-pair host ladder is the
     *fallback* of the `ops.msm` resilience dispatch site, counted in
-    sigpipe.metrics as `host_point_adds`."""
+    sigpipe.metrics as `host_point_adds`.  Multi-chip: a >1-device
+    verify mesh partitions the padded pair axis
+    (parallel/shard_verify.py `shard_jobs`) so each device runs its
+    slice of the ladder scan — same single dispatch, byte-identical
+    results."""
     if len(points) != len(scalars):
         raise ValueError("g1_weighted_sweep: length mismatch")
     if not points:
@@ -295,7 +310,13 @@ def g1_weighted_sweep(points, scalars):
     n_bits = 64 if width <= 64 else 256
     packed = cj.g1_pack(pts)
     bits = cj.scalars_to_bits(sc, n_bits=n_bits)
-    prods = cj.g1_scalar_mul(packed, bits)
+    # multi-chip: partition the (padded, power-of-two) pair axis over
+    # the verify mesh — every ladder is independent, so each device
+    # runs its slice of the scalar-mul scan in parallel; a 1-device
+    # mesh is a no-op
+    from ..parallel import shard_verify
+    X, Y, Z, bits = shard_verify.shard_jobs((*packed, bits), "ops.msm")
+    prods = cj.g1_scalar_mul((X, Y, Z), bits)
     return cj.g1_unpack(tuple(
         jnp.asarray(np.asarray(c)) for c in prods))[:n]
 

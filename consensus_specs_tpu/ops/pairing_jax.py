@@ -34,16 +34,27 @@ import jax.numpy as jnp
 # fused program (single relay round trip, compile served remotely and
 # persistently cached).  Resolved lazily from the ACTIVE backend, not
 # env guessing: JAX_PLATFORMS is unset on vanilla CPU hosts and may be
-# a fallback list.
-PAIRING_MODE = _os.environ.get("PAIRING_MODE")
+# a fallback list.  The env var too is read at resolve time, not
+# import time (same lazy discipline as msm.MSM_MODE /
+# g1_sweep.G1_SWEEP_MODE), with reset_mode() forgetting a cached
+# choice.
+PAIRING_MODE = None
 _CHUNK_BITS = 8
+
+
+def reset_mode() -> None:
+    """Forget the cached dispatch-granularity choice: the next check
+    re-reads the PAIRING_MODE env var and the active jax backend."""
+    global PAIRING_MODE
+    PAIRING_MODE = None
 
 
 def _resolve_mode() -> str:
     global PAIRING_MODE
     if PAIRING_MODE is None:
-        PAIRING_MODE = ("staged" if jax.default_backend() == "cpu"
-                        else "fused")
+        PAIRING_MODE = (_os.environ.get("PAIRING_MODE")
+                        or ("staged" if jax.default_backend() == "cpu"
+                            else "fused"))
     return PAIRING_MODE
 
 from . import fq
@@ -349,6 +360,65 @@ def _pairing_check_fused(xps, yps, xqs, yqs, skip):
         conj=ft.fq12_conj, frob=ft.fq12_frobenius,
         expx=_exp_by_neg_x_scan)
     return ft.fq12_is_one(v)
+
+
+# ---------------------------------------------------------------------------
+# partial-product surface (the mesh-sharded verify path)
+# ---------------------------------------------------------------------------
+# parallel/shard_verify.py partitions one big pairing product's pairs
+# axis over the device mesh: each shard needs its slice's Miller
+# product WITHOUT the final exponentiation (partials are all-reduced by
+# Fp12 multiply first, then ONE final exponentiation decides the whole
+# product).  These two helpers expose exactly that split, mode-split
+# like pairing_check: staged per-bit kernels on CPU hosts, one fused
+# scan program per piece on accelerators.
+
+@jax.jit
+def _miller_partial_fused(xps, yps, xqs, yqs, skip):
+    f = _miller_scan(xps, yps, xqs, yqs)
+    f = ft.fq12_select(skip, ft.fq12_one(f.shape[:-2]), f)
+    return _prod_reduce_raw(f)
+
+
+@jax.jit
+def _final_exp_is_one_fused(f):
+    m = _easy_part(f)
+    v = _hard_chain(
+        m, cyc=ft.fq12_cyclotomic_square, mul=ft.fq12_mul,
+        conj=ft.fq12_conj, frob=ft.fq12_frobenius,
+        expx=_exp_by_neg_x_scan)
+    return ft.fq12_is_one(v)
+
+
+def miller_partial_products(xps, yps, xqs, yqs, skip):
+    """Fq12 Miller product over the trailing pairs axis, NO final
+    exponentiation: xps [..., k, 32] (+ G2/skip shapes as in
+    pairing_check) -> [..., 12, 32].  Inputs sharded on a leading mesh
+    axis stay sharded — the batch math is elementwise over that axis,
+    so each device computes exactly its rows' partial."""
+    mode = _resolve_mode()
+    if mode == "fused":
+        return _miller_partial_fused(xps, yps, xqs, yqs, skip)
+    if mode == "chunked":
+        return _prod_reduce(_miller_chunked(xps, yps, xqs, yqs, skip))
+    return _prod_reduce(miller_loop(xps, yps, xqs, yqs, skip))
+
+
+def fq12_product_is_one(partials):
+    """prod_i partials[i] == 1 over the leading axis: host-driven
+    halving-tree Fq12 multiplies (log2(n) launches — on a sharded axis
+    these are the cross-shard all-reduce) into ONE final exponentiation
+    + is-one.  partials [n, 12, 32] -> scalar bool (on device)."""
+    X = partials
+    while X.shape[0] > 1:
+        h = X.shape[0] // 2
+        X = _mul_jit(X[:h], X[h:])
+    mode = _resolve_mode()
+    if mode == "fused":
+        return _final_exp_is_one_fused(X)[0]
+    if mode == "chunked":
+        return _is_one_jit(final_exponentiation_chunked(X))[0]
+    return _is_one_jit(final_exponentiation_staged(X))[0]
 
 
 # ---------------------------------------------------------------------------

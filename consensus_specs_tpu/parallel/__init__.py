@@ -6,6 +6,11 @@ shards its data-parallel axes — validators, merkle chunks, G1 point sets,
 generator cases — over a jax.sharding.Mesh and reduces with lax
 collectives (psum / all_gather) riding ICI.  Host-level fan-out across
 DCN stays at the generator layer (scripts/gen_vectors.py --shard).
+
+shard_verify.py is the verify hot path's slice of this layer: the
+fused pairing product, committee-aggregation sweep, and Fiat–Shamir
+weighted MSM partitioned over the mesh behind their resilience seams
+(docs/sigpipe.md "Sharded verify").
 """
 from .mesh import get_mesh, device_count  # noqa: F401
 from .collectives import (  # noqa: F401

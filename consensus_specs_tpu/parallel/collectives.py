@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .mesh import axis_size, enable_x64, shard_map
+
 from ..ops import curve_jax as cj
 from ..ops.sha256 import sha256_64byte
 
@@ -41,7 +43,7 @@ def sharded_balance_total(local_balances):
 
 
 def make_balance_total(mesh: Mesh):
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         sharded_balance_total, mesh=mesh,
         in_specs=P(AXIS), out_specs=P(), check_vma=False))
 
@@ -67,7 +69,7 @@ def sharded_merkle_root(local_chunks, local_depth: int):
 
 def make_merkle_root(mesh: Mesh, chunks_per_device: int):
     local_depth = int(np.log2(chunks_per_device))
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         partial(sharded_merkle_root, local_depth=local_depth), mesh=mesh,
         in_specs=P(AXIS, None), out_specs=P(), check_vma=False))
 
@@ -87,7 +89,7 @@ def sharded_g1_sum(X, Y, Z):
 
 
 def make_g1_sum(mesh: Mesh):
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         sharded_g1_sum, mesh=mesh,
         in_specs=(P(AXIS, None),) * 3, out_specs=(P(),) * 3,
         check_vma=False))
@@ -104,7 +106,7 @@ def sharded_g1_ring_sum(X, Y, Z):
     all-gather of per-chip partial MSM buckets" pattern of SURVEY §2.6;
     big MSMs shard their buckets exactly like this.
     """
-    n_dev = jax.lax.axis_size(AXIS)
+    n_dev = axis_size(AXIS)
     local = cj.point_sum_tree(cj.F1, (X, Y, Z))   # local partial
     perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
 
@@ -123,7 +125,7 @@ def sharded_g1_ring_sum(X, Y, Z):
 
 
 def make_g1_ring_sum(mesh: Mesh):
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         sharded_g1_ring_sum, mesh=mesh,
         in_specs=(P(AXIS, None),) * 3,
         out_specs=(P(AXIS, None),) * 3, check_vma=False))
@@ -139,7 +141,7 @@ def sharded_msm(X, Y, Z, bits):
     sharded_g1_ring_sum.  This is the in-path shape g1_lincomb uses
     when the mesh engine is enabled (deneb
     polynomial-commitments.md:268 over a device mesh)."""
-    n_dev = jax.lax.axis_size(AXIS)
+    n_dev = axis_size(AXIS)
     prods = cj.g1_scalar_mul((X, Y, Z), bits)
     local = cj.point_sum_tree(cj.F1, prods)
     perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
@@ -158,7 +160,7 @@ def make_msm(mesh: Mesh):
     """Compiled sharded MSM: points sharded over the mesh's device
     axis, scalar bit-planes alongside, one replicated-sum row per
     device out."""
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         sharded_msm, mesh=mesh,
         in_specs=(P(AXIS, None), P(AXIS, None), P(AXIS, None),
                   P(AXIS, None)),
@@ -215,7 +217,7 @@ def make_flag_set(mesh: Mesh, weight: int, weight_denominator: int,
                   head_flag: bool):
     """Compiled production flag pass over a validator axis sharded on
     `mesh` (used by epoch_fast when the mesh engine is enabled)."""
-    jfn = jax.jit(jax.shard_map(
+    jfn = jax.jit(shard_map(
         partial(sharded_flag_set, weight=weight,
                 weight_denominator=weight_denominator,
                 head_flag=head_flag),
@@ -224,7 +226,7 @@ def make_flag_set(mesh: Mesh, weight: int, weight_denominator: int,
         out_specs=(P(AXIS), P(AXIS)), check_vma=False))
 
     def call(eff_incr, active_cur, eligible, unsl, base_per_incr, leak):
-        with jax.enable_x64():
+        with enable_x64():
             return jfn(eff_incr, active_cur, eligible, unsl,
                        jnp.int64(base_per_incr), jnp.bool_(leak))
     return call
@@ -264,14 +266,14 @@ def sharded_slashings(local_eff_incr, local_mask, adjusted_total,
 def make_slashings(mesh: Mesh, electra: bool):
     """Compiled slashing sweep over a validator axis sharded on
     `mesh` (used by epoch_fast when the mesh engine is enabled)."""
-    jfn = jax.jit(jax.shard_map(
+    jfn = jax.jit(shard_map(
         partial(sharded_slashings, electra=electra),
         mesh=mesh,
         in_specs=(P(AXIS), P(AXIS), P(), P(), P()),
         out_specs=P(AXIS), check_vma=False))
 
     def call(eff_incr, mask, adjusted_total, total_balance, increment):
-        with jax.enable_x64():
+        with enable_x64():
             return jfn(eff_incr, mask, jnp.int64(adjusted_total),
                        jnp.int64(total_balance), jnp.int64(increment))
     return call

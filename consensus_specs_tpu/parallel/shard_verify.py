@@ -1,0 +1,257 @@
+"""Multi-chip sharded verify path: mesh-partitioned pairing product,
+G1 sweep, and weighted MSM.
+
+The verify hot path is fully batched (O(1) device dispatches per
+gossip flush — PRs 1, 5) but each dispatch ran on ONE chip while the
+repo's device mesh (parallel/mesh.py, the MULTICHIP_r0* 8-device
+history) sat idle.  This module is the layer that spreads those
+dispatches over the mesh:
+
+* **job-axis sharding** (`shard_jobs`) — the padded segment/pair axis
+  of `ops/g1_sweep.g1_add_sweep` and `ops/msm.g1_weighted_sweep` is
+  placed with a `NamedSharding(mesh, P(AXIS, ...))`; the existing limb
+  kernels then run GSPMD-partitioned, each device reducing its own
+  slice with ZERO cross-device traffic (the SNIPPETS.md pjit-with-
+  explicit-shardings pattern).  A flush of thousands of signature sets
+  scales near-linearly with chip count.
+* **pairing-product sharding** (`pairing_product`) — the scheduler's
+  fused Fiat–Shamir product partitions its pairs axis over the mesh:
+  each shard computes the partial Fp12 Miller product of its slice
+  (`pairing_jax.miller_partial_products`), the partials are all-reduced
+  by Fp12 multiply (a log2(mesh) halving tree over the sharded axis),
+  and ONE final exponentiation decides the whole product
+  (`pairing_jax.fq12_product_is_one`).  Fp12 multiplication is exact
+  integer math and commutative, so the verdict is bit-identical to the
+  single-device product whatever the partition.
+
+Resilience contract: the sharded pairing product is its own seam —
+ONE ``resilience.dispatch("ops.pairing_product", ...)`` per flush with
+the host pairing oracle as byte-identical fallback — and the sharded
+sweeps stay INSIDE the existing ``ops.g1_aggregate`` / ``ops.msm``
+dispatches (sharding changes where the device fn runs, never the seam
+shape).  "One shard of the mesh died" is just another fault: the
+``shard_dead`` kind raises ``resilience.ShardDead`` (a ``DeviceFault``;
+the XLA runtime surfaces a dead mesh device as a
+failed collective launch), tripping the same breaker → scalar-fallback
+→ half-open contract as every other fault, and :func:`poison_shard`
+lets the kernel-tier tests model the returns-garbage flavor with real
+data (a garbage partial fails the product — it can never validate a
+set, because bisection re-derives probes on the host ladder).
+
+Degradation: with one device (`jax.device_count() == 1`, or
+``SHARD_VERIFY=0``, or ``configure(max_devices=1)``) every entry point
+is byte-identical to the unsharded path — tier-1 CPU runs never change.
+The mesh width is the largest power of two ≤ the device count, so a
+power-of-two-padded job axis always divides evenly.
+"""
+from __future__ import annotations
+
+import os as _os
+from contextlib import contextmanager
+
+AXIS = "shard"
+
+_MAX_DEVICES: int | None = None     # configure() cap; None = all devices
+_MESH = None                        # cached Mesh (one per configuration)
+_MESH_WIDTH: int | None = None      # cached mesh_devices() result
+_POISONED: int | None = None        # poison_shard() test hook
+
+
+def configure(max_devices: int | None = None) -> None:
+    """Cap the verify mesh at `max_devices` (None: use every device).
+    The bench's 1/2/4/8 scan uses this; tests use it to force the
+    single-device degrade path in-process."""
+    global _MAX_DEVICES
+    _MAX_DEVICES = max_devices
+    reset()
+
+
+def reset() -> None:
+    """Drop the cached mesh (after device/backend reconfiguration)."""
+    global _MESH, _MESH_WIDTH
+    _MESH = None
+    _MESH_WIDTH = None
+
+
+def mesh_devices() -> int:
+    """Devices the verify mesh would use: the largest power of two ≤
+    jax.device_count() (capped by configure()/SHARD_VERIFY env); 1
+    means sharding is off."""
+    global _MESH_WIDTH
+    if _MESH_WIDTH is None:
+        if _os.environ.get("SHARD_VERIFY", "") in ("0", "off"):
+            _MESH_WIDTH = 1
+        else:
+            import jax
+            n = jax.device_count()
+            if _MAX_DEVICES is not None:
+                n = min(n, max(_MAX_DEVICES, 1))
+            _MESH_WIDTH = 1 << (max(n, 1).bit_length() - 1)
+    return _MESH_WIDTH
+
+
+def enabled() -> bool:
+    return mesh_devices() > 1
+
+
+def get_mesh():
+    """The (cached) verify mesh, or None when sharding is off."""
+    global _MESH
+    if not enabled():
+        return None
+    if _MESH is None:
+        from .mesh import get_mesh as _build
+        _MESH = _build(mesh_devices(), axis_name=AXIS)
+    return _MESH
+
+
+# ---------------------------------------------------------------------------
+# shard-fault hooks
+# ---------------------------------------------------------------------------
+
+@contextmanager
+def poison_shard(idx: int):
+    """Model 'one mesh device returns garbage' with REAL data: while
+    active, the sharded pairing product replaces shard `idx`'s partial
+    Fp12 product with a deterministic garbage value before the
+    all-reduce.  The product then fails (never falsely passes): the
+    fail-safe the kernel-tier suite pins."""
+    global _POISONED
+    previous = _POISONED
+    _POISONED = int(idx)
+    try:
+        yield
+    finally:
+        _POISONED = previous
+
+
+def _apply_poison(partials):
+    """Replace the poisoned shard's [12, 32] partial with garbage limbs
+    (a fixed pattern, so a poisoned run replays deterministically)."""
+    if _POISONED is None:
+        return partials
+    import jax.numpy as jnp
+    idx = _POISONED % partials.shape[0]
+    garbage = (jnp.arange(12 * partials.shape[-1], dtype=jnp.uint32)
+               .reshape(12, partials.shape[-1])
+               * jnp.uint32(2654435761) + jnp.uint32(97))
+    return partials.at[idx].set(garbage & jnp.uint32(0xFFFF))
+
+
+# ---------------------------------------------------------------------------
+# job-axis sharding (g1_add_sweep / g1_weighted_sweep)
+# ---------------------------------------------------------------------------
+
+def shard_jobs(arrays, label: str):
+    """Place each array with its leading (job) axis partitioned over
+    the verify mesh; returns the arrays unchanged when sharding is off
+    or the axis is smaller than the mesh.  The callers' job axes are
+    already power-of-two padded, so a live mesh (power-of-two wide by
+    construction) always divides them evenly.  `label` names the owning
+    dispatch site in the `sharded_dispatches` metric."""
+    mesh = get_mesh()
+    n = int(arrays[0].shape[0])
+    n_dev = mesh_devices()
+    if mesh is None or n < n_dev or n % n_dev:
+        return arrays
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..sigpipe.metrics import METRICS
+    METRICS.inc_labeled("sharded_dispatches", label)
+    out = []
+    for a in arrays:
+        spec = P(AXIS, *([None] * (a.ndim - 1)))
+        out.append(jax.device_put(a, NamedSharding(mesh, spec)))
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# the sharded pairing product (ops.pairing_product seam)
+# ---------------------------------------------------------------------------
+
+def pairing_live() -> bool:
+    """Whether the scheduler's fused product should ride the sharded
+    seam: a >1-device mesh AND the device pairing kernels active (on
+    the native backend the product is host math — nothing to shard)."""
+    if not enabled():
+        return False
+    from ..utils import bls
+    return bls.current_backend() == "tpu"
+
+
+def _host_pairing_product(pairs) -> bool:
+    """The supervised fallback: the same native pairing oracle
+    `bls.pairing_check` falls back to."""
+    from ..crypto import bls12_381 as native
+    return native.pairing_check(pairs)
+
+
+def _device_pairing_product(pairs) -> bool:
+    """Mesh-partitioned pairing product: pack the pairs axis, shard it
+    over the mesh, per-shard partial Miller products, Fp12-multiply
+    all-reduce, one final exponentiation."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..ops import fq, fq_tower as ft, pairing_jax as pj
+    from ..ops.bls_tpu import _affine_or_skip_g1, _affine_or_skip_g2
+    from ..crypto import curve as cv
+
+    mesh = get_mesh()
+    if mesh is None:            # mesh vanished (breaker probe after a
+        from ..ops import bls_tpu   # reconfigure): single-device kernel
+        return bool(bls_tpu.pairing_check_points(pairs))
+    from ..sigpipe.metrics import METRICS
+    METRICS.inc_labeled("sharded_dispatches", "ops.pairing_product")
+    n_dev = mesh_devices()
+    k = len(pairs)
+    k_local = max(-(-k // n_dev), 1)
+    k_local = 1 << (k_local - 1).bit_length() if k_local > 1 else 1
+    rows = list(pairs) + [(cv.g1_infinity(), cv.g2_infinity())] \
+        * (n_dev * k_local - k)
+    x1s, y1s, x2s, y2s, sks = [], [], [], [], []
+    for p, q in rows:
+        x1, y1, s1 = _affine_or_skip_g1(p)
+        x2, y2, s2 = _affine_or_skip_g2(q)
+        x1s.append(x1)
+        y1s.append(y1)
+        x2s.append(x2)
+        y2s.append(y2)
+        sks.append(s1 or s2)
+    xp = np.asarray(fq.pack_mont(x1s)).reshape(n_dev, k_local, fq.LIMBS)
+    yp = np.asarray(fq.pack_mont(y1s)).reshape(n_dev, k_local, fq.LIMBS)
+    xq = np.asarray(ft.fq2_pack_mont(x2s)).reshape(
+        n_dev, k_local, 2, fq.LIMBS)
+    yq = np.asarray(ft.fq2_pack_mont(y2s)).reshape(
+        n_dev, k_local, 2, fq.LIMBS)
+    sk = np.asarray(sks).reshape(n_dev, k_local)
+
+    def put(a):
+        spec = P(AXIS, *([None] * (a.ndim - 1)))
+        return jax.device_put(jnp.asarray(a), NamedSharding(mesh, spec))
+
+    partials = pj.miller_partial_products(
+        put(xp), put(yp), put(xq), put(yq), put(sk))  # [n_dev, 12, 32]
+    partials = _apply_poison(partials)
+    return bool(np.asarray(pj.fq12_product_is_one(partials)))
+
+
+def pairing_product(pairs) -> bool:
+    """THE sharded fused-product entry point: ONE dispatch per flush at
+    the `ops.pairing_product` seam, host pairing oracle as supervised
+    byte-identical fallback (sigpipe/scheduler.py routes here instead
+    of `bls.pairing_check` when :func:`pairing_live`)."""
+    pairs = list(pairs)
+    if not pairs:
+        return True
+    from ..resilience.supervisor import dispatch
+    # `sharded_dispatches` is counted inside _device_pairing_product
+    # AFTER the mesh check (matching shard_jobs): a breaker-open flush
+    # riding the host fallback, or a degraded 1-device mesh, must not
+    # read as sharded activity
+    return bool(dispatch(
+        "ops.pairing_product",
+        lambda: _device_pairing_product(pairs),
+        lambda: _host_pairing_product(pairs)))

@@ -37,7 +37,7 @@ Chaos wiring (tests/test_chaos.py, `make chaos`):
     with resilience.inject(plan):
         spec.state_transition(state, signed_block)   # still byte-identical
 """
-from .faults import DeviceFault, FaultPlan, FaultSpec, inject
+from .faults import DeviceFault, FaultPlan, FaultSpec, ShardDead, inject
 from .incidents import INCIDENTS, IncidentLog
 from .supervisor import (
     CLOSED, HALF_OPEN, OPEN, QUARANTINED, DispatchTimeout, Supervisor,

@@ -2,8 +2,8 @@
 
 A `FaultPlan` holds `FaultSpec`s — one per targeted dispatch site — and a
 seeded RNG; `inject(plan)` installs it so every `resilience.dispatch()`
-call consults the plan before running the device function.  Three fault
-kinds model the three ways a real accelerator dispatch goes wrong:
+call consults the plan before running the device function.  Four fault
+kinds model the ways a real accelerator dispatch goes wrong:
 
 * ``raise``   — the dispatch dies with a `DeviceFault` (XLA runtime error,
                 relay disconnect, OOM): loud, immediate.
@@ -14,6 +14,14 @@ kinds model the three ways a real accelerator dispatch goes wrong:
                 element of a verdict list) is silently flipped.  No
                 exception, no signal — only the differential guard can
                 catch this one.
+* ``shard_dead`` — one seeded device of the verify mesh dies under a
+                SHARDED dispatch (registry `sharded=True` sites): the
+                runtime surfaces a dead mesh member as a failed launch,
+                so the seam sees a raised `ShardDead` (a `DeviceFault`)
+                and the incident log records which shard died.  Same
+                breaker → scalar-fallback → half-open contract as
+                ``raise`` — "one shard of the mesh died" is just
+                another fault.
 
 Transient vs persistent: a transient spec fires on a seeded coin-flip per
 call (bounded by `max_fires`); a persistent spec fires on every call once
@@ -41,11 +49,38 @@ from ..sigpipe.metrics import METRICS
 from . import sites
 from .incidents import INCIDENTS
 
-KINDS = ("raise", "timeout", "corrupt")
+KINDS = ("raise", "timeout", "corrupt", "shard_dead")
 
 
 class DeviceFault(RuntimeError):
     """Injected stand-in for a raised device/runtime error."""
+
+
+class ShardDead(DeviceFault):
+    """One device of the verify mesh died mid-dispatch — it raised, or
+    returned garbage the collective's checksum rejected.  Either way
+    the XLA runtime surfaces a dead mesh member as a FAILED launch, so
+    at the dispatch seam "one shard died" is just another raised
+    fault: same retry → breaker-trip → scalar-fallback → half-open
+    contract (parallel/shard_verify.py owns the sharded entry points;
+    its `poison_shard` hook models the returns-garbage flavor with
+    real data in the kernel tier)."""
+
+    def __init__(self, site: str, shard: int, fire: int):
+        super().__init__(
+            f"injected dead mesh shard {shard} at {site} (fire {fire})")
+        self.shard = shard
+
+
+def _mesh_width() -> int:
+    """Shards a seeded shard_dead fault can kill: the live verify-mesh
+    width, 1 when the mesh (or jax itself) is unavailable — the fault
+    still fires, modeling the last chip of a 1-wide mesh."""
+    try:
+        from ..parallel.shard_verify import mesh_devices
+        return max(mesh_devices(), 1)
+    except Exception:
+        return 1
 
 
 @dataclass
@@ -155,6 +190,14 @@ class FaultPlan:
             if spec.kind == "raise":
                 raise DeviceFault(f"injected fault at {site} "
                                   f"(fire {spec.fires})")
+            if spec.kind == "shard_dead":
+                # a seeded mesh member dies; the launch fails loud
+                # (ShardDead is a DeviceFault: the breaker contract is
+                # identical, the incident records WHICH shard)
+                shard = self._rng.randrange(_mesh_width())
+                INCIDENTS.record(site, "shard_dead", shard=shard,
+                                 fire=spec.fires)
+                raise ShardDead(site, shard, spec.fires)
             if spec.kind == "timeout":
                 time.sleep(spec.sleep_s)
                 return fn()

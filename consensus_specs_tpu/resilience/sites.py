@@ -69,6 +69,10 @@ class Site:
                (barriers: a crash point has no value).
     fused    — verdicts flow through the fused signature pipeline; the
                differential guard quarantines all fused sites as a unit.
+    sharded  — the device path may run mesh-partitioned over >1 chip
+               (parallel/shard_verify.py): the `shard_dead` fault kind
+               models a dead mesh member at exactly these seams, and
+               the chaos tier's shard matrix derives from this flag.
     doc      — the document whose site table must list the name.
     note     — required for UNIT tier: where coverage lives instead.
     """
@@ -79,6 +83,7 @@ class Site:
     chaos: str = UNIT
     corrupt: str = "verdict"
     fused: bool = False
+    sharded: bool = False
     doc: str = "docs/resilience.md"
     note: str = ""
 
@@ -94,9 +99,9 @@ REGISTRY: tuple[Site, ...] = (
     Site("bls.fast_aggregate_verify_batch", "consensus_specs_tpu.utils.bls",
          kind=DISPATCH, chaos=REPLAY, fused=True),
     Site("ops.g1_aggregate", "consensus_specs_tpu.sigpipe.cache",
-         kind=DISPATCH, chaos=REPLAY),
+         kind=DISPATCH, chaos=REPLAY, sharded=True),
     Site("ops.msm", "consensus_specs_tpu.sigpipe.scheduler",
-         kind=DISPATCH, chaos=REPLAY),
+         kind=DISPATCH, chaos=REPLAY, sharded=True),
     Site("ssz.merkle_sweep", "consensus_specs_tpu.ssz.incremental",
          kind=DISPATCH, chaos=REPLAY, corrupt="digest"),
     # -- gossip tier extra: the admission pipeline's batch window
@@ -137,6 +142,16 @@ REGISTRY: tuple[Site, ...] = (
     Site("sigpipe.hash_to_g2_batch", "consensus_specs_tpu.sigpipe.scheduler",
          kind=DISPATCH, chaos=UNIT, fused=True,
          note="tpu-backend cofactor sweep; tests/test_resilience.py"),
+    # the mesh-sharded fused pairing product: engages only when the
+    # verify mesh has >1 device AND the tpu backend is active, which a
+    # native-backend CPU chaos replay never is — the sharded sweeps at
+    # ops.g1_aggregate / ops.msm (replay tier, sharded=True) carry the
+    # shard_dead chaos matrix instead
+    Site("ops.pairing_product", "consensus_specs_tpu.parallel.shard_verify",
+         kind=DISPATCH, chaos=UNIT, fused=True, sharded=True,
+         note="mesh-sharded pairing product (tpu backend + >1-device "
+              "mesh only); tests/test_shard_verify.py (kernel tier) + "
+              "tests/test_resilience.py shard_dead unit suite"),
     Site("ops.msm.g1", "consensus_specs_tpu.utils.bls",
          kind=DISPATCH, chaos=UNIT,
          note="threshold-gated device MSM; tests/test_msm_pippenger.py"),
@@ -212,6 +227,15 @@ def digest_guarded_sites() -> frozenset[str]:
     """faults.py _DIGEST_GUARDED_SITES: bytes-root results the corrupt
     fault kind may bit-flip (a differential oracle guards them)."""
     return frozenset(s.name for s in REGISTRY if s.corrupt == "digest")
+
+
+def sharded_sites() -> tuple[str, ...]:
+    """Seams whose device path may run mesh-partitioned
+    (parallel/shard_verify.py): the shard_dead fault kind models a dead
+    mesh member here, and test_chaos.py's shard matrix derives from
+    this tuple (intersected with the replay tier — the sharded pairing
+    product itself is tpu-backend-only and unit-covered)."""
+    return tuple(s.name for s in REGISTRY if s.sharded)
 
 
 def wrapper_modules() -> frozenset[str]:

@@ -47,6 +47,18 @@ re-check).  The accept direction is weaker by construction: a sweep
 returning all-identity points makes the product vacuously pass and
 bisection never runs — that corruption is the differential guard's
 case (guard.py), not this path's.
+
+MULTI-CHIP.  With a >1-device verify mesh (parallel/shard_verify.py)
+all three dispatches spread over the mesh: the sweeps shard their
+padded job axes inside the same `ops.g1_aggregate`/`ops.msm` seams,
+and the fused product partitions its pairs axis at the
+`ops.pairing_product` seam — per-shard partial Fp12 Miller products,
+Fp12-multiply all-reduce, ONE final exponentiation — taken instead of
+`bls.pairing_check` only when the tpu backend is active.  One device
+(tier-1 CPU) is byte-identical to the unsharded path, and "one shard
+of the mesh died" is just another fault (`shard_dead` in
+resilience/faults.py: same breaker -> scalar-fallback -> half-open
+contract; docs/sigpipe.md "Sharded verify").
 """
 from __future__ import annotations
 
@@ -140,6 +152,22 @@ def _host_scalar_mul(point, k):
     return point * k
 
 
+def _pairing_product(pairs):
+    """The fused product's single device dispatch.  With a >1-device
+    verify mesh and the device pairing kernels active, the pairs axis
+    is partitioned over the mesh — per-shard partial Fp12 Miller
+    products, all-reduced by Fp12 multiply into one final
+    exponentiation — at the `ops.pairing_product` seam
+    (parallel/shard_verify.py, host pairing oracle as fallback).
+    Otherwise this is exactly the single-device `bls.pairing_check`
+    seam, so tier-1 CPU runs are byte-identical."""
+    if bls.current_backend() == "tpu":    # cheap gate before the
+        from ..parallel import shard_verify   # jax-heavy mesh import
+        if shard_verify.pairing_live():
+            return shard_verify.pairing_product(pairs)
+    return bls.pairing_check(pairs)
+
+
 def _weighted_g1(points, coeffs):
     """All 2N Fiat-Shamir weightings of a flush as ONE batched dispatch
     (ops/msm.py `g1_weighted_sweep`) behind the `ops.msm` resilience
@@ -186,7 +214,7 @@ def _verify_fused(sets, prepared, verdicts):
         return bls.pairing_check(pairs)
 
     METRICS.inc("dispatches")
-    ok = bls.pairing_check([p for group in weighted for p in group])
+    ok = _pairing_product([p for group in weighted for p in group])
     if ok:
         bad_local = set()
     else:

@@ -87,6 +87,7 @@ KERNEL_TIER_FILES = {
     "test_pairing_jax.py", "test_bls_tpu.py", "test_curve_jax.py",
     "test_fq_tower_jax.py", "test_fq_jax.py", "test_msm_pippenger.py",
     "test_g1_sweep.py", "test_merkle_sweep_jax.py",
+    "test_shard_verify.py",
     # pure-python KZG oracle suite: ~3 min of host Pippenger MSMs (the
     # KZG surface keeps default coverage via test_fulu's sampling tests
     # and the kzg runner smoke)

@@ -176,6 +176,37 @@ def test_chaos_merkle_sweep_matrix(spec, workload, kind):
             "ssz.merkle_sweep"] == resilience.QUARANTINED
 
 
+# sharded verify seams a native-backend replay actually crosses — the
+# shard matrix derives from the registry's sharded flag intersected
+# with the replay tier (ops.pairing_product is tpu-backend-only and
+# covered by its kernel-tier suite instead)
+SHARD_SITES = tuple(s for s in sites.sharded_sites()
+                    if s in sites.chaos_replay_sites())
+
+
+@pytest.mark.parametrize("site", SHARD_SITES)
+def test_chaos_shard_dead_matrix(spec, workload, site):
+    """'One shard of the mesh died' is just another fault: a seeded
+    persistent shard_dead at a sharded verify seam trips the breaker to
+    the scalar path with unchanged verdicts, and the incident log
+    records WHICH shard died."""
+    from consensus_specs_tpu.sigpipe import cache as sig_cache
+    sig_cache.clear()       # cold committee sums, so the aggregation
+    # sweep genuinely dispatches (a warm cache skips the seam)
+    plan = FaultPlan(
+        # speclint: disable=seam-dynamic-site -- drawn from the
+        # registry-derived SHARD_SITES tuple above
+        [FaultSpec(site, "shard_dead", persistent=True)],
+        seed=CHAOS_SEED)
+    snapshot = _replay(spec, workload, plan)
+    assert plan.total_fires() > 0
+    # the shard-tagged incident is visible alongside the injection
+    assert INCIDENTS.count(event="shard_dead", site=site) >= 1
+    assert snapshot["breaker_trips"] >= 1
+    assert snapshot["scalar_fallbacks"]["breaker_open"] >= 1
+    assert resilience.report()["breakers"][site] == resilience.OPEN
+
+
 def test_chaos_breaker_recovery_across_blocks(spec, workload):
     """A transient device outage trips the breaker; a later replay probes
     half-open and restores the accelerator path — trip AND recovery both

@@ -277,6 +277,50 @@ def test_corrupt_flips_bool_and_list_verdicts():
                                  "corrupt") == 2
 
 
+def test_shard_dead_is_a_device_fault_with_a_shard_tag():
+    """The shard_dead kind: unsupervised it escapes as a ShardDead (a
+    DeviceFault — 'one shard died' is just another raised dispatch),
+    and the incident log records which seeded shard died."""
+    plan = FaultPlan([FaultSpec("t.site", "shard_dead")], seed=3)
+    with faults.inject(plan):
+        with pytest.raises(resilience.ShardDead) as exc:
+            resilience.dispatch("t.site", lambda: 42, lambda: -1)
+    assert isinstance(exc.value, DeviceFault)
+    assert 0 <= exc.value.shard < 16
+    assert INCIDENTS.count(event="injected") == 1
+    assert INCIDENTS.count(event="shard_dead", site="t.site") == 1
+
+
+def test_shard_dead_trips_breaker_to_scalar_and_half_opens():
+    """Supervised, a persistent shard_dead rides the exact raise
+    contract: retries absorb nothing, the breaker trips to the scalar
+    fallback, and once the shard 'heals' (fault exhausted) a half-open
+    probe restores the device path."""
+    sup = resilience.enable(max_retries=0, breaker_threshold=1,
+                            probe_after=2)
+    plan = FaultPlan([FaultSpec("t.site", "shard_dead", max_fires=1)],
+                     seed=5)
+    with faults.inject(plan):
+        assert resilience.dispatch("t.site", lambda: 42, lambda: -1) == -1
+        assert sup.breaker_state("t.site") == OPEN
+        assert resilience.dispatch("t.site", lambda: 42, lambda: -1) == -1
+        assert resilience.dispatch("t.site", lambda: 42, lambda: -1) == 42
+    assert sup.breaker_state("t.site") == CLOSED
+    assert METRICS.count("breaker_trips") == 1
+    assert METRICS.count("breaker_restores") == 1
+
+
+def test_shard_dead_seeded_shard_is_deterministic():
+    """Same plan seed -> same dead shard: chaos schedules replay."""
+    def dead_shard(seed):
+        plan = FaultPlan([FaultSpec("t.site", "shard_dead")], seed=seed)
+        with faults.inject(plan):
+            with pytest.raises(resilience.ShardDead) as exc:
+                resilience.dispatch("t.site", lambda: 42, lambda: -1)
+        return exc.value.shard
+    assert dead_shard(11) == dead_shard(11)
+
+
 def test_timeout_fault_without_watchdog_is_only_slow():
     plan = FaultPlan([FaultSpec("t.site", "timeout", sleep_s=0.01)],
                      seed=1)

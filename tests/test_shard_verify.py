@@ -1,0 +1,270 @@
+"""Multi-chip sharded verify path (kernel tier).
+
+Runs on the conftest-forced 8-virtual-device CPU mesh
+(``--xla_force_host_platform_device_count=8`` / jax_num_cpu_devices),
+with the device G1/MSM engines forced to their jax limb kernels — the
+configuration an accelerator pod actually runs.  Pins the tentpole
+contract of parallel/shard_verify.py:
+
+* sharded vs single-device vs host-oracle BYTE-IDENTICAL results for
+  the aggregation sweep, the weighted-MSM sweep, and the fused pairing
+  product (Fp12 multiplication is exact and commutative, so the
+  partition must never move a verdict);
+* one dispatch per sharded site per flush (sharding changes where the
+  device fn runs, never the seam shape);
+* shard faults: a seeded ``shard_dead`` trips the breaker to the
+  scalar path with unchanged verdicts, and a poisoned (returns-
+  garbage) shard can only FAIL the product — bisection re-derives its
+  probes on the host ladder, so garbage can never validate a set.
+
+The fast suites (tests/test_sigpipe.py, tests/test_resilience.py) pin
+the oracle-engine seams and the shard_dead breaker contract without
+kernels; this file is gated behind --kernel-tiers like the other
+limb-kernel suites.
+"""
+import numpy as np
+import pytest
+
+from consensus_specs_tpu import resilience
+from consensus_specs_tpu.crypto import curve as cv
+from consensus_specs_tpu.ops import g1_sweep, msm as ops_msm
+from consensus_specs_tpu.parallel import shard_verify
+from consensus_specs_tpu.resilience import (
+    FaultPlan, FaultSpec, INCIDENTS, faults,
+)
+from consensus_specs_tpu.sigpipe import METRICS, cache as sig_cache
+from consensus_specs_tpu.sigpipe import scheduler
+from consensus_specs_tpu.sigpipe.sets import SignatureSet
+from consensus_specs_tpu.test_infra.keys import privkeys, pubkeys
+from consensus_specs_tpu.utils import bls
+
+N_DEV = 8
+
+
+@pytest.fixture(autouse=True)
+def _jax_engines_and_clean_state():
+    """Force the jax sweep engines (the accelerator configuration),
+    reset the verify mesh to the full device set, and restore
+    everything — backend included — afterwards."""
+    prev_sweep = g1_sweep.G1_SWEEP_MODE
+    g1_sweep.G1_SWEEP_MODE = "jax"
+    shard_verify.configure(None)
+    resilience.disable()
+    INCIDENTS.clear()
+    METRICS.reset()
+    sig_cache.clear()
+    yield
+    g1_sweep.G1_SWEEP_MODE = prev_sweep
+    shard_verify.configure(None)
+    resilience.disable()
+    bls.use_native()
+    INCIDENTS.clear()
+
+
+def _points(ids):
+    return [cv.g1_generator() * (5 + i) for i in ids]
+
+
+def _host_sums(lists):
+    out = []
+    for pts in lists:
+        acc = cv.g1_infinity()
+        for p in pts:
+            acc = acc + p
+        out.append(acc)
+    return out
+
+
+def _product_one_pairs(n_legs):
+    """2*n_legs pairs whose pairing product is exactly one:
+    e(aG1, bG2) · e(-abG1, G2) per leg."""
+    pairs = []
+    for i in range(n_legs):
+        a, b = 2 + i, 9 + i
+        pairs.append((cv.g1_generator() * a, cv.g2_generator() * b))
+        pairs.append((-(cv.g1_generator() * (a * b)), cv.g2_generator()))
+    return pairs
+
+
+# ---------------------------------------------------------------------------
+# mesh acquisition + degrade
+# ---------------------------------------------------------------------------
+
+def test_mesh_acquisition_and_single_device_degrade():
+    """The verify mesh is the largest power of two <= the device count;
+    a cap of 1 (or SHARD_VERIFY=0) degrades every entry point to the
+    unsharded path."""
+    assert shard_verify.mesh_devices() == N_DEV
+    assert shard_verify.enabled()
+    assert shard_verify.get_mesh() is not None
+    shard_verify.configure(max_devices=3)   # non-pow2 cap -> 2 devices
+    assert shard_verify.mesh_devices() == 2
+    shard_verify.configure(max_devices=1)
+    assert not shard_verify.enabled()
+    assert shard_verify.get_mesh() is None
+    shard_verify.configure(None)
+    assert shard_verify.mesh_devices() == N_DEV
+
+
+def test_small_job_axis_stays_unsharded():
+    """A job axis smaller than the mesh is left on one device (the
+    degrade contract) — and the result is still exact."""
+    p, q = _points([1, 2])
+    lists = [[p, q]]            # 1 segment < 8 devices
+    assert g1_sweep.g1_add_sweep(lists) == _host_sums(lists)
+    assert METRICS.snapshot().get("sharded_dispatches") is None
+
+
+# ---------------------------------------------------------------------------
+# sharded sweeps: byte-identical across mesh widths
+# ---------------------------------------------------------------------------
+
+def test_sharded_add_sweep_matches_single_device_and_oracle():
+    """Ragged segments (empties, identities, a cancelling pair) summed
+    on the 8-device mesh == the 1-device jax sweep == the host oracle,
+    byte-identical."""
+    pts = _points(range(20))
+    inf = cv.g1_infinity()
+    lists = [pts[i:i + 1 + (i % 3)] for i in range(12)]
+    lists += [[], [pts[0], -pts[0]], [inf, pts[3], inf]]
+    sharded = g1_sweep.g1_add_sweep(lists)
+    assert METRICS.count_labeled(
+        "sharded_dispatches", "ops.g1_aggregate") == 1
+    shard_verify.configure(max_devices=1)
+    single = g1_sweep.g1_add_sweep(lists)
+    assert sharded == single == _host_sums(lists)
+
+
+def test_sharded_weighted_sweep_matches_single_device_and_ladder():
+    """The 2N Fiat–Shamir ladders on the mesh == 1 device == the host
+    ladder: coeff 0/1, identity point, max-width 64-bit coefficient."""
+    pts = _points(range(12)) + [cv.g1_infinity()] * 2
+    coeffs = [0, 1, (1 << 64) - 1] + [
+        (0xC0FFEE * (i + 1)) % (1 << 64) for i in range(11)]
+    sharded = ops_msm.g1_weighted_sweep(pts, coeffs)
+    assert METRICS.count_labeled("sharded_dispatches", "ops.msm") == 1
+    shard_verify.configure(max_devices=1)
+    single = ops_msm.g1_weighted_sweep(pts, coeffs)
+    assert sharded == single == [p * c for p, c in zip(pts, coeffs)]
+
+
+# ---------------------------------------------------------------------------
+# sharded pairing product
+# ---------------------------------------------------------------------------
+
+def test_sharded_pairing_product_matches_host_and_single_device():
+    """Verdict parity over mesh widths 8 / 2 / 1 and the host oracle,
+    for a passing product, a failing product, and infinity pairs
+    (skip-mask path)."""
+    from consensus_specs_tpu.crypto import bls12_381 as native
+    good = _product_one_pairs(3)
+    bad = list(good)
+    bad[0] = (cv.g1_generator() * 99, bad[0][1])
+    with_inf = good + [(cv.g1_infinity(), cv.g2_generator())]
+    for pairs in (good, bad, with_inf):
+        oracle = native.pairing_check(pairs)
+        # width 1: the mesh-is-None degrade branch (single-device
+        # pairing kernel) — the same verdict, no mesh
+        for width in (None, 2, 1):
+            shard_verify.configure(width)
+            assert shard_verify._device_pairing_product(pairs) == oracle
+    shard_verify.configure(None)
+
+
+def test_pairing_product_is_one_dispatch_at_the_registered_seam():
+    good = _product_one_pairs(2)
+    assert shard_verify.pairing_product(good) is True
+    assert METRICS.count_labeled(
+        "sharded_dispatches", "ops.pairing_product") == 1
+
+
+def test_poisoned_shard_fails_safe():
+    """'One mesh device returns garbage': the poisoned partial can only
+    FAIL the product — a valid batch reads False (degrade, re-check),
+    never an invalid batch reading True."""
+    good = _product_one_pairs(3)
+    with shard_verify.poison_shard(3):
+        assert shard_verify._device_pairing_product(good) is False
+    # and the poison is scoped: the same pairs pass again
+    assert shard_verify._device_pairing_product(good) is True
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: a fused scheduler flush on the mesh
+# ---------------------------------------------------------------------------
+
+def _flush_sets(n=3):
+    """n valid 2-pubkey SignatureSets (n >= 8 gives the sweeps a job
+    axis that covers the 8-device mesh)."""
+    sets = []
+    for i in range(n):
+        msg = i.to_bytes(8, "little") + b"\x55" * 24
+        ids = [i, i + 1]
+        sig = bls.Aggregate([bls.Sign(privkeys[x], msg) for x in ids])
+        sets.append(SignatureSet(
+            pubkeys=tuple(bytes(pubkeys[x]) for x in ids),
+            signing_root=msg, signature=bytes(sig), kind="test",
+            origin=("shard", i)))
+    return sets
+
+
+def _host_hash_roots(roots):
+    """The host leg of scheduler._hash_roots: the tpu cofactor sweep is
+    its own UNIT-covered seam (sigpipe.hash_to_g2_batch — test_bls_tpu,
+    test_resilience) and its kernel compile would dominate this suite's
+    budget without touching anything sharded, so the end-to-end flushes
+    here pin the SHARDED dispatches and ride host hash-to-G2."""
+    from consensus_specs_tpu.crypto.hash_to_curve import hash_to_g2
+    return [hash_to_g2(r) for r in roots]
+
+
+def test_fused_flush_sharded_end_to_end(monkeypatch):
+    """A fused flush on the tpu backend with the >1-device mesh: the
+    pairing product rides ONE ops.pairing_product dispatch, each sweep
+    one mesh-sharded dispatch, verdicts equal the native host-oracle
+    flush, zero host point adds on the device path."""
+    monkeypatch.setattr(scheduler, "_hash_roots", _host_hash_roots)
+    sets = _flush_sets(8)       # 8 segments / 16 pairs: covers the mesh
+    bls.use_tpu()
+    try:
+        verdicts = scheduler.verify_sets(sets, mode="fused")
+    finally:
+        bls.use_native()
+    snap = METRICS.snapshot()
+    sig_cache.clear()
+    METRICS.reset()
+    oracle = scheduler.verify_sets(sets, mode="fused")  # native backend
+    assert verdicts == oracle == [True] * 8
+    assert snap["sharded_dispatches"]["ops.pairing_product"] == 1
+    assert snap["sharded_dispatches"]["ops.g1_aggregate"] == 1
+    assert snap["sharded_dispatches"]["ops.msm"] == 1
+    assert snap["g1_aggregate_dispatches"] == 1
+    assert snap["msm_dispatches"] == 1
+    assert snap.get("host_point_adds", 0) == 0
+
+
+def test_shard_dead_at_pairing_seam_trips_breaker_verdicts_unchanged(
+        monkeypatch):
+    """A persistent shard_dead at ops.pairing_product while the mesh is
+    live: the breaker opens, the flush degrades to the host pairing
+    oracle, verdicts identical, incident visible with the dead shard."""
+    monkeypatch.setattr(scheduler, "_hash_roots", _host_hash_roots)
+    sets = _flush_sets()
+    resilience.enable(max_retries=1, breaker_threshold=1, probe_after=4)
+    plan = FaultPlan(
+        [FaultSpec("ops.pairing_product", "shard_dead",
+                   persistent=True)],
+        seed=20260803)
+    bls.use_tpu()
+    try:
+        with faults.inject(plan):
+            verdicts = scheduler.verify_sets(sets, mode="fused")
+    finally:
+        bls.use_native()
+    assert verdicts == [True] * 3
+    assert plan.total_fires() > 0
+    assert INCIDENTS.count(event="shard_dead",
+                           site="ops.pairing_product") >= 1
+    assert resilience.report()["breakers"][
+        "ops.pairing_product"] == resilience.OPEN
+    assert METRICS.count_labeled("scalar_fallbacks", "breaker_open") >= 1

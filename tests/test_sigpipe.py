@@ -675,6 +675,32 @@ def _points(ids):
     return [cv.g1_generator() * (7 + i) for i in ids]
 
 
+def test_engine_mode_resolves_lazily_and_resets(monkeypatch):
+    """The engine-mode env vars are read at RESOLVE time, not import
+    time: a test/bench that flips the env var and calls reset_mode()
+    gets the new engine whatever the import order, and reset_mode()
+    with no env var restores the platform default."""
+    from consensus_specs_tpu.ops import pairing_jax as pj
+    for mod, env, forced, default in (
+            (g1_sweep, "G1_SWEEP_MODE", "jax", "oracle"),
+            (ops_msm, "MSM_MODE", "pippenger", "lanes"),
+            (pj, "PAIRING_MODE", "fused", "staged")):
+        prev = getattr(mod, env)
+        try:
+            monkeypatch.setenv(env, forced)
+            mod.reset_mode()            # forget any cached choice
+            assert mod._resolve_mode() == forced
+            monkeypatch.delenv(env)
+            assert mod._resolve_mode() == forced    # cached until reset
+            mod.reset_mode()
+            assert mod._resolve_mode() == default   # cpu platform default
+            # direct assignment (the test-fixture idiom) still wins
+            setattr(mod, env, "direct")
+            assert mod._resolve_mode() == "direct"
+        finally:
+            setattr(mod, env, prev)
+
+
 def test_g1_add_sweep_edge_cases_match_sequential_sum():
     """Ragged edge cases through the sweep: empty input, empty segment,
     single point, identity points inside a segment, non-power-of-two
