@@ -15,7 +15,8 @@ RUNNERS := shuffling ssz_static operations epoch_processing sanity bls \
 .PHONY: test test-quick test-kernels tier1 chaos recovery-chaos \
 	scenario-chaos shard-verify lint speclint native pyspec bench \
 	gossip-bench txn-bench msm-bench merkle-bench scenario-bench \
-	multichip-bench gen_all detect_errors $(addprefix gen_,$(RUNNERS))
+	multichip-bench pipeline-bench gen_all detect_errors \
+	$(addprefix gen_,$(RUNNERS))
 
 # syntax/bytecode check over every package and script (the CI lint job)
 lint:
@@ -141,6 +142,15 @@ merkle-bench:
 # and BENCH_SCENARIO_SEED=N pick another battlefield
 scenario-bench:
 	env JAX_PLATFORMS=cpu $(PYTHON) bench.py scenario
+
+# async pipelined flush engine alone (sigpipe/pipeline_async.py):
+# sustained multi-flush ingestion with overlap on vs off — asserts
+# byte-identical store roots + verdicts, 0 device idle gaps async, and
+# <= 1 host<->device round-trip per fused merkle re-root; emits
+# PIPELINE_r01.json.  BENCH_PIPELINE_BACKEND=native and
+# BENCH_PIPELINE_MSGS=16 give an accelerator-less smoke run
+pipeline-bench:
+	$(PYTHON) bench.py pipeline
 
 # multi-chip sharded verify alone (parallel/shard_verify.py): one
 # >=1k-set flush's aggregation sweep + weighted MSM + fused pairing

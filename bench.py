@@ -1562,6 +1562,216 @@ def bench_multichip():
     }
 
 
+# ---------------------------------------------------------------------------
+# tier: async pipelined flush engine (sigpipe/pipeline_async.py)
+# ---------------------------------------------------------------------------
+
+PIPELINE_MSGS = int(os.environ.get("BENCH_PIPELINE_MSGS", "48"))
+PIPELINE_PER_WINDOW = int(os.environ.get("BENCH_PIPELINE_PER_WINDOW", "8"))
+PIPELINE_MIN_SPEEDUP = float(
+    os.environ.get("BENCH_PIPELINE_MIN_SPEEDUP", "1.3"))
+PIPELINE_JSON = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "PIPELINE_r01.json")
+
+
+def bench_pipeline():
+    """Sustained multi-flush ingestion with the async flush engine on
+    vs off (the `ASYNC_FLUSH=0` escape hatch): the same message pool
+    rides the AdmissionPipeline through many deadline windows both
+    ways; per-flush wall time, `device_idle_gaps` (pinned 0 with
+    overlap on), `flush_overlap_ns`, and the in-flight-depth histogram
+    are reported, and the store fingerprint + per-message verdicts must
+    be byte-identical between the two runs (overlap changes WHEN work
+    happens, never what any message does to the store).  A second leg
+    measures the device-resident merkle sweep: fused one-program
+    re-root vs the per-level path (`MERKLE_FUSED=0`), pinning <= 1
+    host<->device round-trip per re-root.  Emits PIPELINE_r01.json."""
+    from consensus_specs_tpu.gossip import (
+        AdmissionPipeline, GossipConfig, ManualClock)
+    from consensus_specs_tpu.gossip.pipeline import store_fingerprint
+    from consensus_specs_tpu.ops import pairing_jax as pj
+    from consensus_specs_tpu.sigpipe import METRICS as SIG_METRICS
+    from consensus_specs_tpu.sigpipe import pipeline_async
+    from consensus_specs_tpu.specs import get_spec
+    from consensus_specs_tpu.ssz import uint64
+    from consensus_specs_tpu.test_infra.attestations import (
+        get_valid_attestation)
+    from consensus_specs_tpu.test_infra.fork_choice import (
+        get_genesis_forkchoice_store)
+    from consensus_specs_tpu.test_infra.genesis import (
+        create_genesis_state, default_balances)
+    from consensus_specs_tpu.utils import bls as bls_shim
+
+    t_start = time.perf_counter()
+
+    def mark(msg):
+        log(f"[bench] pipeline +{time.perf_counter() - t_start:5.1f}s: "
+            f"{msg}")
+
+    spec = get_spec("altair", "minimal")
+    genesis = create_genesis_state(spec, default_balances(spec))
+    state = genesis.copy()
+    spec.process_slots(state, uint64(spec.SLOTS_PER_EPOCH + 2))
+    mark(f"signing {PIPELINE_MSGS} attestations ...")
+    messages = []
+    slot = int(state.slot) - 1
+    while len(messages) < PIPELINE_MSGS and slot >= 0:
+        committees = int(spec.get_committee_count_per_slot(
+            state, spec.compute_epoch_at_slot(uint64(slot))))
+        for index in range(committees):
+            committee = spec.get_beacon_committee(
+                state, uint64(slot), uint64(index))
+            for validator in committee:
+                if len(messages) >= PIPELINE_MSGS:
+                    break
+                messages.append(get_valid_attestation(
+                    spec, state, slot=uint64(slot), index=index,
+                    filter_participant_set=lambda s, v=validator: {v},
+                    signed=True))
+        slot -= 1
+
+    def fresh_store():
+        store = get_genesis_forkchoice_store(spec, genesis)
+        spec.on_tick(store, store.genesis_time + int(state.slot)
+                     * int(spec.config.SECONDS_PER_SLOT))
+        return store
+
+    def run_ingestion(overlap: bool, pool=None):
+        """One sustained run: windows of PIPELINE_PER_WINDOW messages,
+        flushed on the deadline; returns (elapsed, fingerprint,
+        verdict statuses, metrics snapshot)."""
+        (pipeline_async.enable if overlap
+         else pipeline_async.disable)()
+        SIG_METRICS.reset()
+        clock = ManualClock()
+        store = fresh_store()
+        pipe = AdmissionPipeline(
+            spec, store,
+            GossipConfig(max_batch=256, bucket_capacity=1 << 16), clock)
+        pool = messages if pool is None else pool
+        t0 = time.perf_counter()
+        for i, att in enumerate(pool):
+            pipe.submit("attestation", att, peer=f"p{i % 8}")
+            if (i + 1) % PIPELINE_PER_WINDOW == 0:
+                clock.advance(0.05)
+                pipe.poll()
+        pipe.drain()
+        pipeline_async.drain()
+        elapsed = time.perf_counter() - t0
+        statuses = [(r.seq, r.status) for r in pipe.verdicts()]
+        return (elapsed, store_fingerprint(spec, store), statuses,
+                SIG_METRICS.snapshot())
+
+    backend = os.environ.get("BENCH_PIPELINE_BACKEND", "tpu")
+    if backend == "tpu":
+        mark(f"warming TPU kernels (mode={pj._resolve_mode()}) ...")
+        pj.warmup(k=2, rows=pj._BUCKET_MIN_ROWS)
+        bls_shim.use_tpu()
+    try:
+        mark("warm run (one window: compiles the batch shapes) ...")
+        run_ingestion(overlap=True, pool=messages[:PIPELINE_PER_WINDOW])
+        mark("timed run: overlap OFF (ASYNC_FLUSH=0 path) ...")
+        t_off, fp_off, verdicts_off, snap_off = run_ingestion(False)
+        mark("timed run: overlap ON ...")
+        t_on, fp_on, verdicts_on, snap_on = run_ingestion(True)
+    finally:
+        if backend == "tpu":
+            bls_shim.use_native()
+        pipeline_async.reset()
+
+    assert fp_on == fp_off, \
+        "async store fingerprint diverged from the synchronous path"
+    assert verdicts_on == verdicts_off, \
+        "async per-message verdicts diverged from the synchronous path"
+    assert snap_on.get("device_idle_gaps", 0) == 0, \
+        "the async path recorded a host-sync stall between dispatches"
+    assert snap_off.get("device_idle_gaps", 0) > 0, \
+        "the sync path recorded no dispatch gaps (instrumentation broke)"
+
+    # merkle leg: fused device-resident sweep vs per-level round-trips
+    mark("merkle leg: fused vs per-level sweep ...")
+    from consensus_specs_tpu.ssz import incremental, merkle
+    merkle_leg = {}
+    mstate = genesis.copy()
+    try:
+        incremental.enable()
+        merkle.use_tpu_hashing(threshold=1)     # every level on device
+        incremental.track(mstate)
+        bytes(mstate.hash_tree_root())          # cache build (untimed)
+        def mutate():
+            mstate.slot = uint64(int(mstate.slot) + 1)  # dirty leaves
+            for k in range(8):
+                mstate.balances[k] = uint64(
+                    int(mstate.balances[k]) + 1)
+
+        for fused, label in ((True, "fused"), (False, "per_level")):
+            os.environ["MERKLE_FUSED"] = "1" if fused else "0"
+            mutate()
+            bytes(mstate.hash_tree_root())      # warm (compiles the
+            mutate()                            # diff's sweep shapes)
+            SIG_METRICS.reset()
+            t0 = time.perf_counter()
+            root = bytes(mstate.hash_tree_root())
+            dt = time.perf_counter() - t0
+            trips = SIG_METRICS.snapshot().get(
+                "merkle_device_round_trips", 0)
+            assert root == incremental.oracle_root(mstate)
+            merkle_leg[label] = {"reroot_s": round(dt, 4),
+                                 "device_round_trips": trips}
+            mark(f"merkle {label}: {merkle_leg[label]}")
+    finally:
+        os.environ.pop("MERKLE_FUSED", None)
+        merkle.set_bulk_level_hasher(None)
+        incremental.disable()
+    assert merkle_leg["fused"]["device_round_trips"] <= 1, \
+        "fused sweep paid more than one host<->device round-trip"
+
+    speedup = round(t_off / t_on, 3) if t_on > 0 else 0.0
+    windows = max(len(messages) // PIPELINE_PER_WINDOW, 1)
+    # the >=1.3x acceptance pin binds on the full device-backed run
+    # (the default 48-message workload or larger); native/smoke
+    # overrides report without claiming it
+    binds = backend == "tpu" and len(messages) >= 48
+    ok = (not binds) or speedup >= PIPELINE_MIN_SPEEDUP
+    report = {
+        "workload": {"messages": len(messages),
+                     "per_window": PIPELINE_PER_WINDOW,
+                     "windows": windows, "backend": backend},
+        "sync": {"elapsed_s": round(t_off, 3),
+                 "per_flush_s": round(t_off / windows, 4),
+                 "device_idle_gaps": snap_off.get("device_idle_gaps", 0)},
+        "async": {"elapsed_s": round(t_on, 3),
+                  "per_flush_s": round(t_on / windows, 4),
+                  "device_idle_gaps": snap_on.get("device_idle_gaps", 0),
+                  "flush_overlap_ms": round(
+                      snap_on.get("flush_overlap_ns", 0) / 1e6, 3),
+                  "inflight_depth_hist": snap_on.get(
+                      "flush_inflight_depth_hist", {})},
+        "store_roots_identical": True,
+        "merkle": merkle_leg,
+        "speedup": speedup,
+        "min_speedup": PIPELINE_MIN_SPEEDUP if binds else None,
+        "ok": ok,
+    }
+    with open(PIPELINE_JSON, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+    log("[bench] pipeline: " + json.dumps(report, sort_keys=True))
+    assert ok, (f"async flush speedup {speedup}x "
+                f"< {PIPELINE_MIN_SPEEDUP}x")
+    return {
+        "metric": "pipeline_flush_speedup",
+        "value": speedup,
+        "unit": (f"x sustained multi-flush throughput, overlap on vs "
+                 f"off ({len(messages)} msgs / {windows} windows, "
+                 f"0 idle gaps async, store roots byte-identical, "
+                 f"merkle {merkle_leg['fused']['device_round_trips']} "
+                 f"round-trip/re-root fused vs "
+                 f"{merkle_leg['per_level']['device_round_trips']} "
+                 f"per-level)"),
+        "vs_baseline": speedup,
+    }
+
+
 # merkle first (a number is banked in ~2 min), then the NORTH STAR —
 # the tier that ranks first for the stdout line must actually get
 # budget under the driver's default 540s (merkle+epoch+transition alone
@@ -1601,6 +1811,11 @@ TIERS = {
     # flush's sweeps + pairing product at 1/2/4/8 forced-host devices;
     # per-width compiles dominate the first run (persistent cache)
     "multichip": (bench_multichip, 420),
+    # async pipelined flush engine (sigpipe/pipeline_async.py):
+    # sustained multi-flush ingestion with overlap on vs off, plus the
+    # fused device-resident merkle sweep leg; message signing + kernel
+    # warm-up dominate
+    "pipeline": (bench_pipeline, 420),
 }
 
 # the driver's ~540s window fits merkle + ONE heavy tier — without
@@ -1608,7 +1823,7 @@ TIERS = {
 # driver-verified number (VERDICT r4 weakness #8)
 _ROTATING = ["north_star", "attestations", "block_sigs", "kzg", "epoch",
              "transition", "degraded", "gossip", "txn", "msm",
-             "merkle_inc", "scenario", "multichip"]
+             "merkle_inc", "scenario", "multichip", "pipeline"]
 
 
 def _round_index() -> int:
@@ -1653,6 +1868,102 @@ def _device_alive(timeout_s: float = 90.0) -> bool:
         return False
 
 
+# ---------------------------------------------------------------------------
+# stale-relay recovery (BENCH_r04/r05 device_unreachable root cause)
+# ---------------------------------------------------------------------------
+# The relay's claim protocol leaves a lock file behind; a SIGKILLed
+# process (the driver's escalation path when a tier overruns) cannot
+# release it, and every later backend init then blocks on the dead
+# claim.  Recovery is mechanical: a lock whose recorded/observed owner
+# pid no longer exists is stale by definition and safe to remove.  Only
+# dead-owner locks are ever touched — a lock held by a LIVE process is
+# a real claim and is left alone.
+
+_RELAY_LOCK_GLOBS = [
+    "/tmp/libtpu_lockfile*",
+    "/tmp/tpu_lockfile*",
+    "/tmp/axon*lock*",
+    "/tmp/axon_relay*",
+]
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True         # exists, owned by someone else: live
+
+
+def _lock_owner(path: str):
+    """Best-effort owner pid of a relay lock: the conventional
+    pid-in-file content first, then a /proc open-fd scan (the flock
+    style leaves the file empty).  Returns (owner_pid_or_None,
+    scan_complete): stale-by-absence is only trustworthy when the fd
+    scan actually covered every live process."""
+    try:
+        with open(path, "rb") as f:
+            head = f.read(64).decode("ascii", "replace").strip()
+        if head and head.split()[0].isdigit():
+            return int(head.split()[0]), True
+    except OSError:
+        pass
+    scan_complete = True
+    try:
+        real = os.path.realpath(path)
+        for pid in os.listdir("/proc"):
+            if not pid.isdigit() or int(pid) == os.getpid():
+                continue
+            fd_dir = f"/proc/{pid}/fd"
+            try:
+                for fd in os.listdir(fd_dir):
+                    if os.path.realpath(
+                            os.path.join(fd_dir, fd)) == real:
+                        return int(pid), True
+            except OSError:
+                scan_complete = False   # e.g. unreadable /proc entry
+                continue
+    except OSError:
+        scan_complete = False
+    return None, scan_complete
+
+
+def _clear_stale_relay() -> int:
+    """Remove relay/TPU lock files with POSITIVE evidence of
+    staleness — a recorded owner pid that is dead, or (flock-style, no
+    pid content) a complete /proc scan finding no live holder.  An
+    undeterminable owner leaves the file alone: deleting a live claim
+    would wedge the relay for the claimer, the exact corruption this
+    recovery exists to undo.  `AXON_RELAY_LOCK_GLOBS`
+    (colon-separated) extends the pattern list."""
+    import glob
+    pats = list(_RELAY_LOCK_GLOBS)
+    pats += [p for p in
+             os.environ.get("AXON_RELAY_LOCK_GLOBS", "").split(":") if p]
+    cleared = 0
+    for pat in pats:
+        for path in glob.glob(pat):
+            owner, scan_complete = _lock_owner(path)
+            if owner is not None and _pid_alive(owner):
+                log(f"[bench] relay lock {path} held by live pid "
+                    f"{owner}; leaving it")
+                continue
+            if owner is None and not scan_complete:
+                log(f"[bench] relay lock {path}: owner undeterminable "
+                    f"(incomplete /proc scan); leaving it")
+                continue
+            try:
+                os.unlink(path)
+                cleared += 1
+                log(f"[bench] cleared stale relay lock {path} "
+                    f"({'owner %d dead' % owner if owner is not None else 'no live holder'})")
+            except OSError as e:
+                log(f"[bench] could not clear {path}: {e}")
+    return cleared
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     budget = float(os.environ.get("BENCH_BUDGET_S", "540"))
@@ -1681,9 +1992,36 @@ def main():
         print(json.dumps(result))
         return
 
+    # proactively clear any dead-owner relay lock BEFORE the first
+    # probe: the r04/r05 rounds burned half their budget probing a
+    # relay wedged by a SIGKILLed predecessor's stale claim
+    _clear_stale_relay()
+    sidestepped = False
+    clears_left = 2     # bounded: a SIGKILLed probe child can itself
+    # leave a fresh dead-owner lock, so an unbounded clear-and-retry
+    # loop could spin past the whole budget without ever reaching the
+    # half-budget sidestep below
     while not _device_alive():
         remaining = deadline - time.monotonic()
+        if remaining >= budget / 2 and clears_left > 0 \
+                and _clear_stale_relay():
+            clears_left -= 1
+            log("[bench] cleared a stale relay claim; re-probing")
+            continue
         if remaining < budget / 2:
+            if os.environ.get("BENCH_RELAY_SIDESTEP", "1") \
+                    not in ("0", "off"):
+                # sidestep: the relay is wedged by something alive (or
+                # unclearable) — run the tiers on the forced-host
+                # platform instead of emitting a device_unreachable
+                # placeholder, and LABEL the numbers so nobody reads a
+                # host run as device-side
+                log("[bench] relay wedged past half budget; "
+                    "sidestepping to the host platform")
+                os.environ["BENCH_PLATFORM"] = os.environ.get(
+                    "BENCH_RELAY_SIDESTEP_PLATFORM", "cpu")
+                sidestepped = True
+                break
             log("[bench] device unreachable past half budget; "
                 "reporting none")
             print(json.dumps({"metric": "device_unreachable", "value": 0,
@@ -1704,12 +2042,15 @@ def main():
             continue
         out = run_tier_subprocess(name, min(tier_budget, remaining))
         if out is not None:
+            if sidestepped:
+                out["platform"] = "host_sidestep"   # not device-side
             results[name] = out
 
     # most valuable completed tier wins the stdout line, by value rank
     # (rotation changes which tiers RUN, not which result headlines)
-    rank = ["north_star", "attestations", "block_sigs", "gossip", "kzg",
-            "transition", "epoch", "degraded", "merkle_inc", "merkle"]
+    rank = ["north_star", "attestations", "block_sigs", "pipeline",
+            "gossip", "kzg", "transition", "epoch", "degraded",
+            "merkle_inc", "merkle"]
     for name in rank:
         if name in results:
             print(json.dumps(results[name]))

@@ -49,6 +49,9 @@ RULES: dict[str, str] = {
         "nor registered",
     "txn-unwrapped-store-write":
         "a Store field write is reachable from no @transactional handler",
+    "async-host-sync":
+        "a host-sync primitive (device_get/block_until_ready/np.asarray) "
+        "sits outside a declared join barrier in a pipelined package",
     "speclint-bad-disable":
         "a speclint disable comment lacks a reason or names an unknown rule",
 }
@@ -234,10 +237,11 @@ def run_speclint(root: str | Path,
     comments) findings of the named rules — but only when they cite a
     reason; malformed disables surface as `speclint-bad-disable`.
     """
-    from . import bypass, determinism, globals_, seams, txnpurity
+    from . import bypass, determinism, globals_, hostsync, seams, txnpurity
     ctx = load_context(root, paths)
     findings: list[Finding] = []
-    for pass_mod in (seams, bypass, determinism, globals_, txnpurity):
+    for pass_mod in (seams, bypass, determinism, globals_, txnpurity,
+                     hostsync):
         findings.extend(pass_mod.run(ctx))
     by_rel = {sf.rel: sf for sf in ctx.files}
     kept = []

@@ -1,0 +1,141 @@
+"""Pass 6 — host-sync points (the async-flush re-serialization gate).
+
+The async pipelined flush engine (sigpipe/pipeline_async.py) hides
+host-side planning under device work by keeping every dispatch's result
+un-forced until a DECLARED join barrier.  One stray
+``jax.device_get(...)`` / ``.block_until_ready()`` / ``np.asarray(...)``
+on a device value in the middle of a dispatch chain silently
+re-serializes the whole pipeline — the code still passes every parity
+test, it just stops overlapping, which is exactly the kind of
+regression only a machine check catches.
+
+This pass flags the host-sync primitives in the pipelined packages
+(``sigpipe``, ``ssz``, ``parallel``) unless they sit inside a function
+registered as a join barrier in ``resilience/sites.py
+HOST_SYNC_BARRIERS`` (the same canonical-registry discipline as the
+dispatch seams: adding a barrier means adding a registry row, and the
+row obliges the function's docstring to say what join it is).
+
+``np.asarray`` is flagged because it is how device values are forced in
+this codebase's numpy-bridge idiom; a *host-side* ``np.asarray`` in
+these packages should live behind a registered barrier function or, if
+genuinely device-free, carry an inline ``# speclint:
+disable=async-host-sync -- <why this never touches a device value>``.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Context, Finding
+
+_SCOPE = (
+    "consensus_specs_tpu.sigpipe",
+    "consensus_specs_tpu.ssz",
+    "consensus_specs_tpu.parallel",
+)
+
+# dotted call names that force a device value back to the host
+_SYNC_CALLS = frozenset({
+    "jax.device_get", "np.asarray", "numpy.asarray", "onp.asarray",
+})
+
+
+def _dotted(expr: ast.expr) -> str | None:
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _alias_map(tree: ast.AST) -> dict:
+    """local name -> canonical prefix for jax / numpy imports, so
+    `import numpy as anything` or `from jax import device_get` cannot
+    dodge the gate."""
+    aliases: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                root = a.name.split(".")[0]
+                if root in ("jax", "numpy"):
+                    aliases[(a.asname or a.name).split(".")[0]] = root
+        elif isinstance(node, ast.ImportFrom) and node.level == 0 and \
+                node.module and node.module.split(".")[0] in \
+                ("jax", "numpy"):
+            for a in node.names:
+                aliases[a.asname or a.name] = \
+                    f"{node.module.split('.')[0]}.{a.name}"
+    return aliases
+
+
+def _canonical(name: str, aliases: dict) -> str:
+    head, _, tail = name.partition(".")
+    mapped = aliases.get(head)
+    if mapped is None:
+        return name
+    return f"{mapped}.{tail}" if tail else mapped
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, sf, module: str, barriers: frozenset,
+                 aliases: dict, findings: list):
+        self.sf = sf
+        self.module = module
+        self.barriers = barriers
+        self.aliases = aliases
+        self.findings = findings
+        self.stack: list = []       # enclosing function names
+
+    def _in_barrier(self) -> bool:
+        return any((self.module, name) in self.barriers
+                   for name in self.stack)
+
+    def visit_FunctionDef(self, node):
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if self._in_barrier():
+            return
+        name = _dotted(node.func)
+        if name is None:
+            return
+        if name.endswith(".block_until_ready") or \
+                name == "block_until_ready":
+            self._flag(node, "block_until_ready()")
+            return
+        canon = _canonical(name, self.aliases)
+        # numpy.asarray in any spelling (np.asarray, onp.asarray, a
+        # from-import) and jax.device_get in any spelling
+        if canon in _SYNC_CALLS or canon == "numpy.asarray" \
+                or canon == "jax.device_get":
+            self._flag(node, f"{name}()")
+
+    def _flag(self, node, what: str) -> None:
+        self.findings.append(Finding(
+            "async-host-sync", self.sf.rel, node.lineno, node.col_offset,
+            f"{what} forces a device value outside a declared join "
+            f"barrier — this re-serializes the async flush pipeline",
+            hint="move the forced read into a registered barrier "
+                 "function (resilience/sites.py HOST_SYNC_BARRIERS) or "
+                 "register this one; a genuinely device-free asarray "
+                 "may carry a reasoned disable"))
+
+
+def run(ctx: Context) -> list[Finding]:
+    barriers = frozenset(getattr(ctx.registry, "HOST_SYNC_BARRIERS", ()))
+    findings: list[Finding] = []
+    for sf in ctx.files:
+        if not sf.in_module(*_SCOPE):
+            continue
+        aliases = _alias_map(sf.tree)
+        v = _Visitor(sf, sf.module, barriers, aliases, findings)
+        v.visit(sf.tree)
+    return findings

@@ -32,6 +32,7 @@ decisions replay deterministically from a seeded schedule.
 from __future__ import annotations
 
 from ..resilience.supervisor import dispatch
+from ..sigpipe import pipeline_async
 from ..sigpipe.metrics import METRICS
 from ..sigpipe.verify import _batch_verify_unique
 
@@ -101,3 +102,13 @@ class DeadlineBatcher:
             # no supervisor installed: degrade here instead
             self._metrics.inc("gossip_batch_errors")
             return degraded()
+
+    def verify_async(self, sets):
+        """Submit this window's batch-verify to the async flush engine;
+        returns the :class:`pipeline_async.FlushTicket` the delivery
+        loop joins on (`result()` is exactly `verify(sets)`'s value).
+        The degradation ladder is unchanged — every rung runs on the
+        worker and lands in the ticket; with the engine off the ticket
+        completes inline before returning."""
+        return pipeline_async.submit(
+            lambda: self.verify(sets), "gossip_window")

@@ -33,6 +33,17 @@ concurrent ingress can never corrupt queues, quotas, or the store, and
 the delivered sequence remains a valid sequential schedule the scalar
 oracle can replay.
 
+PIPELINING.  The drainer double-buffers flushes through the async
+engine (sigpipe/pipeline_async.py): window N+1 is STAGED (popped,
+collected, its batch-verify submitted as a FlushTicket) before window
+N is joined and delivered, so handler execution overlaps the next
+window's device verify.  Only the verify crosses a thread boundary —
+collection and delivery both stay on the drainer, in window order, so
+the store is never touched concurrently and the single-drainer
+discipline above is unchanged.  With `ASYNC_FLUSH=0` (or a node
+context installed — scenario fleets) tickets complete inline and the
+flush shape is exactly the historical one.
+
 SEMANTICS CONTRACT.  For the messages the pipeline delivers, per-message
 accept/reject verdicts and the resulting store are byte-identical to
 applying the same messages one at a time through the bare handlers
@@ -259,16 +270,42 @@ class AdmissionPipeline:
             if not self._drainer_lock.acquire(blocking=False):
                 return flushed
             try:
-                while True:
-                    with self._ingress_lock:
-                        for message in self.quotas.take_refilled():
-                            self._enqueue(message)
-                        reason = self.batcher.flush_reason(
-                            self.pending_count())
-                    if reason is None:
-                        break
-                    self._flush(reason)
-                    flushed = True
+                staged_prev = None
+                try:
+                    while True:
+                        with self._ingress_lock:
+                            for message in self.quotas.take_refilled():
+                                self._enqueue(message)
+                            reason = self.batcher.flush_reason(
+                                self.pending_count())
+                        if reason is None:
+                            break
+                        # double-buffered flush pipeline: stage window
+                        # N+1 (pop + collect + submit its verify to the
+                        # async engine) BEFORE joining and delivering
+                        # window N, so N's handler execution overlaps
+                        # N+1's device verify.  Delivery stays in
+                        # window order, so the equivocation gate and
+                        # the store see the exact sequential schedule
+                        # the scalar oracle replays.
+                        staged = self._stage_flush(reason)
+                        if staged_prev is not None:
+                            prev, staged_prev = staged_prev, staged
+                            self._complete_flush(prev)
+                        else:
+                            staged_prev = staged
+                        flushed = True
+                    if staged_prev is not None:
+                        prev, staged_prev = staged_prev, None
+                        self._complete_flush(prev)
+                finally:
+                    # a non-rejection handler exception while delivering
+                    # window N must not silently drop already-popped
+                    # window N+1 (the sequential path would have left it
+                    # queued): deliver it best-effort; the PRIMARY
+                    # exception keeps propagating
+                    if staged_prev is not None:
+                        self._complete_salvage(staged_prev)
             finally:
                 self._drainer_lock.release()
             with self._ingress_lock:
@@ -286,8 +323,21 @@ class AdmissionPipeline:
                 with self._ingress_lock:
                     for message in self.quotas.take_refilled():
                         self._enqueue(message)
-                while self.pending_count():
-                    self._flush(FLUSH_DRAIN)
+                staged_prev = None
+                try:
+                    while self.pending_count():
+                        staged = self._stage_flush(FLUSH_DRAIN)
+                        if staged_prev is not None:
+                            prev, staged_prev = staged_prev, staged
+                            self._complete_flush(prev)
+                        else:
+                            staged_prev = staged
+                    if staged_prev is not None:
+                        prev, staged_prev = staged_prev, None
+                        self._complete_flush(prev)
+                finally:
+                    if staged_prev is not None:
+                        self._complete_salvage(staged_prev)
             # cover a racing submit whose poll() skipped while we held
             # the drainer lock (same re-check-after-release discipline
             # as poll)
@@ -295,19 +345,36 @@ class AdmissionPipeline:
             return self.verdicts()
 
     def _flush(self, reason: str) -> None:
-        """Verify and deliver one window.  Caller holds the drainer
-        lock; queue/batcher state is snapshotted under the ingress lock,
-        then collection + delivery run with ingress open so submitting
-        threads are never blocked behind handler execution."""
+        """Verify and deliver one window back-to-back (the unpipelined
+        shape — stage + immediate complete)."""
+        staged = self._stage_flush(reason)
+        if staged is not None:
+            self._complete_flush(staged)
+
+    def _stage_flush(self, reason: str):
+        """The HOST half of a flush: snapshot the window, collect the
+        predicted checks (read-only), and submit the batch-verify to
+        the async flush engine.  Caller holds the drainer lock;
+        queue/batcher state is snapshotted under the ingress lock, then
+        collection runs with ingress open so submitting threads are
+        never blocked behind it.  Returns (batch, collected_by_seq,
+        ticket) — the staged flush `_complete_flush` joins — or None
+        for an empty window.
+
+        A window staged before the PREVIOUS window delivered may
+        collect against a store that window is still about to advance;
+        any check that mispredicts simply misses the verdict map and
+        falls back to scalar at the seam (the content-addressing
+        contract), so pipelining can change dispatch counts, never
+        verdicts."""
         with self._ingress_lock:
             self.batcher.window_closed(reason)
             batch = sorted(
                 (m for q in self.queues.values() for m in q.pop_all()),
                 key=lambda m: m.seq)
         if not batch:
-            return
+            return None
 
-        # collect the predicted checks (read-only) for the whole window
         target_cache: dict = {}
         collected_by_seq: dict = {}
         sets = []
@@ -319,13 +386,30 @@ class AdmissionPipeline:
             sets.extend(collected.sets)
 
         # micro-batch them (scalar oracle mode skips)
-        by_key = None
+        ticket = None
         if not self.config.scalar_only:
-            by_key = self.batcher.verify(sets)
-        verdict_map = VerdictMap(by_key) if by_key else None
+            ticket = self.batcher.verify_async(sets)
+        return (batch, collected_by_seq, ticket)
 
-        # screen + deliver in arrival order (interleaved, so a conflict
-        # with an earlier message in the SAME window is caught)
+    def _complete_salvage(self, staged) -> None:
+        """Deliver a staged window after the PREVIOUS window's delivery
+        raised a non-rejection (bug-class) exception: the messages are
+        already popped, so dropping them would lose verdicts the
+        sequential path would still have produced.  A secondary failure
+        here is counted, not raised — the primary exception is the one
+        that must surface."""
+        try:
+            self._complete_flush(staged)
+        except Exception:
+            METRICS.inc("gossip_salvage_errors")
+
+    def _complete_flush(self, staged) -> None:
+        """The JOIN half: block on the window's verify ticket, then
+        screen + deliver in arrival order (interleaved, so a conflict
+        with an earlier message in the SAME window is caught)."""
+        batch, collected_by_seq, ticket = staged
+        by_key = ticket.result() if ticket is not None else None
+        verdict_map = VerdictMap(by_key) if by_key else None
         for message in batch:
             self._admit_and_deliver(message, collected_by_seq[message.seq],
                                     by_key, verdict_map)

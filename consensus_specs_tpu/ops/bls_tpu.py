@@ -35,7 +35,14 @@ _H_EFF_BITS = np.array(
 def hash_to_g2_batch(messages, dst=h2c.DST_G2):
     """Batched hash-to-curve: host hash-to-field + SSWU + isogeny (cheap
     int math), ONE device scalar-mul sweep for the 636-bit cofactor
-    clearing (~90% of the host cost of crypto/hash_to_curve.hash_to_g2)."""
+    clearing (~90% of the host cost of crypto/hash_to_curve.hash_to_g2).
+
+    With a >1-device verify mesh the padded message axis is partitioned
+    over it (parallel/shard_verify.py `shard_jobs`) — this was the last
+    unsharded per-flush device call: each device clears the cofactor of
+    its own slice with zero cross-device traffic, inside the unchanged
+    `sigpipe.hash_to_g2_batch` dispatch seam (a 1-device mesh is
+    byte-identical to the unsharded path)."""
     if not messages:
         return []
     pre = []
@@ -48,7 +55,11 @@ def hash_to_g2_batch(messages, dst=h2c.DST_G2):
     pre += [pre[0]] * (_next_pow2(n_real) - n_real)  # pow2: bounded shapes
     bits = jnp.broadcast_to(jnp.asarray(_H_EFF_BITS),
                             (len(pre), _H_EFF_BITS.shape[0]))
-    out = cj.g2_scalar_mul(cj.g2_pack(pre), bits)
+    from ..parallel.shard_verify import shard_jobs
+    X, Y, Z, bits = shard_jobs(
+        (*cj.g2_pack(pre), jnp.asarray(bits)),
+        "sigpipe.hash_to_g2_batch")
+    out = cj.g2_scalar_mul((X, Y, Z), bits)
     return cj.g2_unpack(out)[:n_real]
 
 

@@ -140,6 +140,91 @@ def merkle_tree_root(chunks, depth: int):
 
 
 # ---------------------------------------------------------------------------
+# fused multi-round sweep (device-resident incremental merkle re-root)
+# ---------------------------------------------------------------------------
+
+def _fused_rounds_device(lits, idx_ls, idx_rs):
+    """All rounds of one merkle sweep as ONE traced program: the pool
+    starts as the literal chunks, each round gathers its pair inputs
+    from the pool (dirty-index gather on device), hashes them in one
+    batch, and appends its outputs to the pool for later rounds.
+    Nothing returns to the host until every round is done — jax.jit
+    caches one executable per (pool size, round sizes) signature, which
+    the power-of-two padding below keeps to log-many shapes."""
+    pool = lits
+    outs = []
+    for il, ir in zip(idx_ls, idx_rs):
+        blocks = jnp.concatenate([pool[il], pool[ir]], axis=-1)
+        out = sha256_64byte(blocks)
+        outs.append(out)
+        pool = jnp.concatenate([pool, out], axis=0)
+    return outs
+
+
+_fused_rounds_jit = jax.jit(_fused_rounds_device)
+
+
+def _pow2(n: int, floor: int = 8) -> int:
+    return max(floor, 1 << (n - 1).bit_length()) if n > 1 else floor
+
+
+def fused_rounds(literals: bytes, rounds) -> list:
+    """Device-resident execution of a whole hash-job DAG
+    (ssz/incremental.py `_Sweep`): `literals` is the concatenation of
+    every distinct 32-byte input chunk, `rounds` is a list of
+    (left_idx, right_idx) int lists indexing the virtual UNPADDED pool
+    [literals..., round0 outputs..., round1 outputs...] — every index
+    must refer to a literal or an EARLIER round's output.  Returns one
+    bytes object per round (that round's concatenated 32-byte digests).
+
+    One host->device upload (literal words + index arrays), one
+    device->host download (all round outputs): a sweep costs ONE
+    round-trip where the per-level path paid one per tree level.  Both
+    axes are power-of-two padded (literal pad = zero words, index pad =
+    0) so the jitted program recompiles only per log-shape.
+    """
+    if not rounds:
+        return []
+    lit_words = bytes_to_words(literals) if literals \
+        else np.zeros((0, 8), dtype=np.uint32)
+    n_lits = lit_words.shape[0]
+    p_lits = _pow2(n_lits)
+    if p_lits != n_lits:
+        lit_words = np.concatenate(
+            [lit_words, np.zeros((p_lits - n_lits, 8), dtype=np.uint32)])
+    # unpadded -> padded pool index: literals keep their index, round
+    # outputs shift by the padding the pool accumulated before them
+    sizes = [len(il) for il, _ir in rounds]
+    p_sizes = [_pow2(s) for s in sizes]
+    unpadded_off = [n_lits]
+    padded_off = [p_lits]
+    for s, p in zip(sizes, p_sizes):
+        unpadded_off.append(unpadded_off[-1] + s)
+        padded_off.append(padded_off[-1] + p)
+
+    uo = np.asarray(unpadded_off, dtype=np.int64)
+    po = np.asarray(padded_off, dtype=np.int64)
+
+    def remap(idx_list, p):
+        out = np.zeros(p, dtype=np.int64)
+        out[:len(idx_list)] = idx_list
+        hi = out >= n_lits
+        seg = np.searchsorted(uo, out[hi], side="right") - 1
+        out[hi] = po[seg] + (out[hi] - uo[seg])
+        return out.astype(np.int32)
+
+    idx_ls, idx_rs = [], []
+    for (il, ir), p in zip(rounds, p_sizes):
+        idx_ls.append(jnp.asarray(remap(il, p)))
+        idx_rs.append(jnp.asarray(remap(ir, p)))
+    outs = _fused_rounds_jit(jnp.asarray(lit_words), idx_ls, idx_rs)
+    # speclint: disable=async-host-sync -- THE declared download of the
+    # fused sweep: one device_get for every round's outputs at once
+    host = jax.device_get(outs)
+    return [words_to_bytes(o[:s]) for o, s in zip(host, sizes)]
+
+
+# ---------------------------------------------------------------------------
 # host-side bridges
 # ---------------------------------------------------------------------------
 

@@ -8,12 +8,14 @@ history) sat idle.  This module is the layer that spreads those
 dispatches over the mesh:
 
 * **job-axis sharding** (`shard_jobs`) — the padded segment/pair axis
-  of `ops/g1_sweep.g1_add_sweep` and `ops/msm.g1_weighted_sweep` is
-  placed with a `NamedSharding(mesh, P(AXIS, ...))`; the existing limb
-  kernels then run GSPMD-partitioned, each device reducing its own
-  slice with ZERO cross-device traffic (the SNIPPETS.md pjit-with-
-  explicit-shardings pattern).  A flush of thousands of signature sets
-  scales near-linearly with chip count.
+  of `ops/g1_sweep.g1_add_sweep` and `ops/msm.g1_weighted_sweep`, and
+  the padded message axis of `ops/bls_tpu.hash_to_g2_batch`'s cofactor
+  sweep (the last per-flush device call to go multi-chip — async-flush
+  PR), is placed with a `NamedSharding(mesh, P(AXIS, ...))`; the
+  existing limb kernels then run GSPMD-partitioned, each device
+  reducing its own slice with ZERO cross-device traffic (the
+  SNIPPETS.md pjit-with-explicit-shardings pattern).  A flush of
+  thousands of signature sets scales near-linearly with chip count.
 * **pairing-product sharding** (`pairing_product`) — the scheduler's
   fused Fiat–Shamir product partitions its pairs axis over the mesh:
   each shard computes the partial Fp12 Miller product of its slice

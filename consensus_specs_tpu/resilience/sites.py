@@ -139,9 +139,13 @@ REGISTRY: tuple[Site, ...] = (
          kind=DISPATCH, chaos=UNIT, fused=True,
          note="batch API surface with no runtime caller yet; "
               "tests/test_bls_tpu.py + tests/test_sigpipe.py"),
+    # sharded since the async-flush PR: the padded message axis of the
+    # cofactor sweep partitions over the verify mesh via shard_jobs —
+    # the last unsharded per-flush device call
     Site("sigpipe.hash_to_g2_batch", "consensus_specs_tpu.sigpipe.scheduler",
-         kind=DISPATCH, chaos=UNIT, fused=True,
-         note="tpu-backend cofactor sweep; tests/test_resilience.py"),
+         kind=DISPATCH, chaos=UNIT, fused=True, sharded=True,
+         note="tpu-backend cofactor sweep; tests/test_resilience.py + "
+              "tests/test_shard_verify.py (kernel tier)"),
     # the mesh-sharded fused pairing product: engages only when the
     # verify mesh has >1 device AND the tpu backend is active, which a
     # native-backend CPU chaos replay never is — the sharded sweeps at
@@ -241,3 +245,30 @@ def sharded_sites() -> tuple[str, ...]:
 def wrapper_modules() -> frozenset[str]:
     """Modules that own a seam — allowed to import device kernels."""
     return frozenset(s.module for s in REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# declared host-sync join barriers (speclint async-host-sync pass)
+# ---------------------------------------------------------------------------
+# The async flush engine's contract is that device dispatches stay
+# un-forced until a DECLARED join barrier: a host-sync primitive
+# (`jax.device_get`, `.block_until_ready()`, `np.asarray` on a device
+# value) anywhere else in sigpipe/ssz/parallel silently re-serializes
+# the pipeline.  Each entry is (module, function) naming a function
+# whose body IS a declared barrier — the verdict joins and result
+# downloads the pipeline design blesses.  speclint's hostsync pass
+# (analysis/hostsync.py) flags any sync primitive outside this table;
+# adding a new barrier means adding a row HERE (and saying why in the
+# function's docstring), not sprinkling a disable.
+HOST_SYNC_BARRIERS: tuple = (
+    # the sharded pairing product's verdict join: pack + upload + ONE
+    # np.asarray of the final Fp12-is-one verdict per flush
+    ("consensus_specs_tpu.parallel.shard_verify",
+     "_device_pairing_product"),
+    # mesh-engine result downloads: each is the single forced read at
+    # the end of one fused epoch-processing dispatch
+    ("consensus_specs_tpu.parallel.mesh_engine", "subtree_root"),
+    ("consensus_specs_tpu.parallel.mesh_engine", "flag_set_batch"),
+    ("consensus_specs_tpu.parallel.mesh_engine", "slashings_batch"),
+    ("consensus_specs_tpu.parallel.mesh_engine", "g1_msm"),
+)

@@ -254,7 +254,13 @@ class Supervisor:
                 worker = self._workers.get(site)
                 if worker is None or worker.abandoned:
                     worker = self._workers[site] = _SiteWorker(site)
-            outcome = worker.call(fn, deadline)
+            # carry the async flush engine's in-flight ticket across
+            # the thread hop: the abandoned-flush cache-write
+            # suppression (pipeline_async.writes_allowed) is
+            # thread-local and must follow the dispatch onto this
+            # site's worker
+            from ..sigpipe.pipeline_async import bind_current_ticket
+            outcome = worker.call(bind_current_ticket(fn), deadline)
         if outcome is None:
             # abandoned: the worker parks on the hung dispatch; the next
             # call gets a fresh one

@@ -3,10 +3,13 @@
 Collect every signature check a signed block implies (sets.py), verify
 them together in the fewest device dispatches (scheduler.py), isolate
 failures by bisection (bisect.py), cache decompressed/aggregated pubkeys
-(cache.py), and surface counters (metrics.py).  verify.py wires the
-pipeline into `state_transition` behind the opt-in `enable()` switch; the
-inline scalar path stays the default oracle.
+(cache.py), surface counters (metrics.py), and overlap flushes with
+host-side work through the async engine (pipeline_async.py,
+`ASYNC_FLUSH=0` to disable).  verify.py wires the pipeline into
+`state_transition` behind the opt-in `enable()` switch; the inline
+scalar path stays the default oracle.
 """
+from . import pipeline_async
 from .metrics import METRICS
 from .sets import (
     SignatureSet, collect_block_sets, collect_pending_deposit_sets,
@@ -20,5 +23,5 @@ __all__ = [
     "METRICS", "SignatureSet", "collect_block_sets",
     "collect_pending_deposit_sets", "block_scope", "compute_verdicts",
     "disable", "enable", "enabled", "mode", "pending_deposit_scope",
-    "verify_block_signatures",
+    "pipeline_async", "verify_block_signatures",
 ]

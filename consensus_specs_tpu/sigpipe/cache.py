@@ -42,6 +42,7 @@ import threading
 from ..crypto import curve as cv
 from ..crypto.bls12_381 import _load_pubkey
 from ..crypto.curve import DecodeError
+from . import pipeline_async
 from .metrics import METRICS
 
 
@@ -63,6 +64,8 @@ class PubkeyCache:
             return point
         self._metrics.inc("pubkey_cache_misses")
         point = _load_pubkey(key)   # DecodeError / ValueError propagate
+        if not pipeline_async.writes_allowed():
+            return point    # abandoned in-flight flush: leave no trace
         with self._lock:
             if len(self._cache) >= self._max:
                 self._cache.pop(next(iter(self._cache)))
@@ -255,6 +258,13 @@ class AggregatePubkeyCache:
         return agg
 
     def _insert(self, digest, agg, hint) -> None:
+        if not pipeline_async.writes_allowed():
+            # a flush the caller abandoned past its watchdog deadline
+            # keeps computing on the engine worker but may no longer
+            # warm shared state: same purity pin as the abandoned
+            # merkle sweep (values would be content-correct, but
+            # crash-only discipline says a zombie leaves no trace)
+            return
         with self._lock:
             if len(self._cache) >= self._max:
                 self._cache.pop(next(iter(self._cache)))

@@ -50,6 +50,17 @@ fallback), `merkle_cached_roots` (re-roots answered from cache with no
 hashing), and `merkle_guard_samples` / `merkle_guard_mismatches` for the
 differential guard.
 
+The async flush engine (pipeline_async.py) reports overlap here:
+`async_flushes` / `inline_flushes` (engine-worker vs caller-inline
+submits), `flush_overlap_ns` (worker wall time that ran while the
+caller did host work — overlapped flushes only, so scenario replays
+stay bit-identical), `device_idle_gaps` (host-sync stalls between a
+flush's verify dispatches on the synchronous path; pinned 0 with
+overlap on), `abandoned_flushes`, the power-of-two
+`flush_inflight_depth` histogram, and
+`merkle_device_round_trips` (host<->device transfers per merkle sweep:
+1 on the fused device-resident path, one per bulk level otherwise).
+
 Histograms (`observe_hist`) bucket integer observations by
 power-of-two: the gossip admission layer records batch occupancy per
 flush here (`batch_occupancy`: how many signature sets each dispatch

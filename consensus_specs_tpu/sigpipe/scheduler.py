@@ -69,6 +69,7 @@ from ..crypto.bls12_381 import _load_signature
 from ..crypto.curve import DecodeError
 from ..utils import bls
 from . import bisect as _bisect
+from . import pipeline_async
 from .cache import AGGREGATES
 from .metrics import METRICS
 
@@ -183,9 +184,19 @@ def _weighted_g1(points, coeffs):
                  for p, c in zip(points, coeffs)])
 
 
-def _verify_fused(sets, prepared, verdicts):
+def _verify_fused(sets, prepared, verdicts, strict=None, hash_leg=None):
+    """`hash_leg` (pipeline_async.Leg over the STRICT indices' roots)
+    is the overlapped hash-to-G2 dispatch: launched before `_prepare`'s
+    G1 aggregation sweep, joined here AFTER the weighted MSM — so all
+    three of a flush's verify dispatches are in flight with no
+    host-sync stall between them, and the first forced read is the
+    verdict join below.  Without a leg (ASYNC_FLUSH=0, scenario
+    fleets) the dispatch order is byte-for-byte the historical one,
+    with the host stall it implies counted as a `device_idle_gaps`."""
     entries = [(sets[i], agg, sig) for i, agg, sig in prepared]
-    hashes = _hash_roots([s.signing_root for s, _, _ in entries])
+    if hash_leg is None:
+        pipeline_async.sync_gap()
+        hashes = _hash_roots([s.signing_root for s, _, _ in entries])
     coeffs = _coefficients(entries)
     neg_g1 = -cv.g1_generator()
     bases, scalars = [], []
@@ -193,6 +204,16 @@ def _verify_fused(sets, prepared, verdicts):
         bases.extend((agg, neg_g1))
         scalars.extend((c, c))
     weighted_flat = _weighted_g1(bases, scalars)
+    if hash_leg is not None:
+        # join as late as the data flow allows: hash-to-G2 of every
+        # strict root ran concurrently with prepare/aggregate/MSM; a
+        # set `_prepare` screened out (bad signature, cold decode
+        # failure) simply leaves its hash unused — per-root outputs are
+        # independent, so the subset is byte-identical to hashing only
+        # the surviving roots
+        all_hashes = hash_leg.get()
+        pos = {i: k for k, i in enumerate(strict)}
+        hashes = [all_hashes[pos[i]] for i, _agg, _sig in prepared]
     weighted, groups = [], []
     for k, ((s, agg, sig), h, c) in enumerate(
             zip(entries, hashes, coeffs)):
@@ -303,9 +324,21 @@ def verify_sets(sets, mode: str = "fused"):
         elif mode == "fused":
             strict = [i for i, s in enumerate(sets) if s.required]
             lax = [i for i, s in enumerate(sets) if not s.required]
+            hash_leg = None
+            if strict and pipeline_async.overlap_live():
+                # overlapped leg: hash-to-G2 needs only the signing
+                # roots, so it launches BEFORE the G1 aggregation sweep
+                # and runs concurrently with the whole prepare chain
+                roots = [sets[i].signing_root for i in strict]
+                hash_leg = pipeline_async.launch_leg(
+                    lambda: _hash_roots(roots), "hash_to_g2")
             prepared = _prepare(strict, sets, verdicts)
             if prepared:
-                _verify_fused(sets, prepared, verdicts)
+                _verify_fused(sets, prepared, verdicts, strict, hash_leg)
+            elif hash_leg is not None:
+                # every strict set screened out pre-pairing: drain the
+                # leg so nothing is left in flight past this flush
+                hash_leg.get()
             if lax:
                 _verify_per_set(lax, sets, verdicts)
         else:

@@ -25,7 +25,7 @@ from __future__ import annotations
 
 from contextlib import contextmanager
 
-from . import scheduler, sets
+from . import pipeline_async, scheduler, sets
 from .metrics import METRICS
 
 _enabled = False
@@ -80,6 +80,37 @@ class VerdictMap:
 
     def __len__(self) -> int:
         return len(self._verdicts)
+
+
+class LazyVerdictMap:
+    """VerdictMap facade over an in-flight :class:`pipeline_async.
+    FlushTicket`: the block scope installs it IMMEDIATELY and the
+    engine verifies concurrently with the spec's host-side block work;
+    the first seam consultation is the join barrier.  A failed or
+    abandoned ticket degrades to an empty map — every lookup then
+    misses and the seams fall back to the scalar backend, byte-
+    identical to the historical block_scope error path."""
+
+    __slots__ = ("_ticket", "_vm")
+
+    def __init__(self, ticket):
+        self._ticket = ticket
+        self._vm = None
+
+    def _join(self) -> VerdictMap:
+        if self._vm is None:
+            by_key = self._ticket.result()
+            self._vm = VerdictMap(by_key if by_key is not None else {})
+        return self._vm
+
+    def lookup(self, pubkeys, signing_root, signature):
+        return self._join().lookup(pubkeys, signing_root, signature)
+
+    def peek(self, key):
+        return self._join().peek(key)
+
+    def __len__(self) -> int:
+        return len(self._join())
 
 
 def _batch_verify_unique(collected, mode: str | None = None,
@@ -141,12 +172,31 @@ def verify_block_signatures(spec, state, signed_block) -> None:
 @contextmanager
 def block_scope(spec, state, signed_block):
     """Install batch verdicts on `spec` for the duration of one block's
-    processing; a pipeline failure degrades to the scalar path."""
+    processing; a pipeline failure degrades to the scalar path.
+
+    With the async flush engine live, collection runs HERE (on the
+    calling thread — it reads `state`, which the spec is about to
+    mutate) but verification rides a :class:`pipeline_async.FlushTicket`
+    whose join barrier is the first seam consultation
+    (:class:`LazyVerdictMap`): the proposer-signature check and block
+    processing's host-side prefix overlap the flush's device
+    dispatches.  An outer gossip-window map is consulted at collect
+    time exactly as before (its verdicts are lifted, not recomputed).
+    """
     if not _enabled:
         yield
         return
     try:
-        vm, _sets, _verdicts = compute_verdicts(spec, state, signed_block)
+        if pipeline_async.overlap_live():
+            block_sets = sets.collect_block_sets(spec, state, signed_block)
+            reuse = getattr(spec, "_sigpipe_verdicts", None)
+            ticket = pipeline_async.submit(
+                lambda: _batch_verify_unique(block_sets, reuse=reuse),
+                "block_scope")
+            vm = LazyVerdictMap(ticket)
+        else:
+            vm, _sets, _verdicts = compute_verdicts(
+                spec, state, signed_block)
     except Exception:
         METRICS.inc("pipeline_errors")
         vm = None

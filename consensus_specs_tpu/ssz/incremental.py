@@ -581,30 +581,86 @@ def _leaf_ref(sw: _Sweep, view, i: int):
     return bytes(child.hash_tree_root())
 
 
-def _level_hash(data: bytes) -> bytes:
+def _level_hash(data: bytes) -> tuple:
     """One ragged level: route through the installed bulk device hasher
     (ops/sha256.hash_level_ragged) above the bulk threshold, hashlib
-    below it — the same split every legacy hash_tree_root uses."""
+    below it — the same split every legacy hash_tree_root uses.
+    Returns (hashed bytes, device round-trip count: 1 for a bulk call,
+    0 for hashlib)."""
     bulk = _merkle._bulk_hash_level
     if bulk is not None and len(data) // 64 >= _merkle._bulk_threshold:
-        return bulk(data)
-    return _merkle._hash_level_python(data)
+        return bulk(data), 1
+    return _merkle._hash_level_python(data), 0
 
 
 def _hash_rounds(sw: _Sweep) -> list:
-    """Run the sweep's hash rounds and return the per-round outputs.
+    """Run the sweep's hash rounds level-by-level and return the
+    per-round outputs (the PER-LEVEL path: each bulk level pays its own
+    host<->device round-trip — counted in `merkle_device_round_trips`).
     Pure: every input is a literal chunk copied in by the planner or a
     lower round's output, so this is safe to run on the supervisor's
     watchdog worker — an abandoned (timed-out) run touches no cache."""
     outs = []
+    trips = 0
     for jobs in sw.rounds:
         buf = bytearray()
         for left, right in jobs:
             buf += left if type(left) is bytes else outs[left[0] - 1][left[1]]
             buf += right if type(right) is bytes else outs[right[0] - 1][right[1]]
-        hashed = _level_hash(bytes(buf))
+        hashed, t = _level_hash(bytes(buf))
+        trips += t
         outs.append([hashed[k * 32:(k + 1) * 32] for k in range(len(jobs))])
+    if trips:
+        _METRICS.inc("merkle_device_round_trips", trips)
     return outs
+
+
+def _hash_rounds_fused(sw: _Sweep) -> list:
+    """Run ALL the sweep's rounds as ONE compiled device program
+    (ops/sha256.fused_rounds): literal inputs are deduped and uploaded
+    once, every round's dirty-index gather and batched hash stays in
+    device memory, and the per-round outputs come back in a single
+    download — one host<->device round-trip per re-root instead of one
+    per tree level.  Pure, like `_hash_rounds`: inputs are copied into
+    the job plan, nothing touches a cache."""
+    from ..ops import sha256 as _sha
+    lits: list = []
+    lit_pos: dict = {}
+    for jobs in sw.rounds:
+        for ref in (r for job in jobs for r in job):
+            if type(ref) is bytes and ref not in lit_pos:
+                lit_pos[ref] = len(lits)
+                lits.append(ref)
+    n_lits = len(lits)
+    cum = [0]
+    for jobs in sw.rounds:
+        cum.append(cum[-1] + len(jobs))
+
+    def idx(ref):
+        if type(ref) is bytes:
+            return lit_pos[ref]
+        return n_lits + cum[ref[0] - 1] + ref[1]
+
+    rounds = [([idx(left) for left, _r in jobs],
+               [idx(right) for _l, right in jobs]) for jobs in sw.rounds]
+    out_bytes = _sha.fused_rounds(b"".join(lits), rounds)
+    _METRICS.inc("merkle_device_round_trips")
+    return [[ob[k * 32:(k + 1) * 32] for k in range(len(jobs))]
+            for ob, jobs in zip(out_bytes, sw.rounds)]
+
+
+def _run_rounds(sw: _Sweep) -> list:
+    """Pick the sweep execution engine: the fused device-resident
+    program when bulk device hashing is installed and the sweep is big
+    enough to be worth a dispatch (MERKLE_FUSED=0 forces the per-level
+    path), else the per-level split."""
+    import os
+    total = sum(len(jobs) for jobs in sw.rounds)
+    if (_merkle._bulk_hash_level is not None
+            and total >= _merkle._bulk_threshold
+            and os.environ.get("MERKLE_FUSED", "") not in ("0", "off")):
+        return _hash_rounds_fused(sw)
+    return _hash_rounds(sw)
 
 
 def _commit(sw: _Sweep, outs: list) -> None:
@@ -655,7 +711,7 @@ def _recompute(view, cache: _MCache) -> bytes:
     outs_box = [None]
 
     def device():
-        outs = _hash_rounds(sw)
+        outs = _run_rounds(sw)
         outs_box[0] = outs
         return sw.resolve(outs, root_ref)
 
