@@ -13,7 +13,8 @@ RUNNERS := shuffling ssz_static operations epoch_processing sanity bls \
 	forks merkle_proof networking kzg_7594 random light_client sync
 
 .PHONY: test test-quick test-kernels tier1 chaos recovery-chaos \
-	scenario-chaos shard-verify lint speclint native pyspec bench \
+	scenario-chaos pipeline-chaos shard-verify lint speclint native \
+	pyspec bench \
 	gossip-bench txn-bench msm-bench merkle-bench scenario-bench \
 	multichip-bench pipeline-bench gen_all detect_errors \
 	$(addprefix gen_,$(RUNNERS))
@@ -24,9 +25,12 @@ lint:
 		deposit_contract bench.py __graft_entry__.py
 
 # AST invariant checker (consensus_specs_tpu/analysis/): dispatch-seam
-# conformance, kernel-bypass, determinism, per-node isolation, and
-# txn-purity contracts machine-checked against resilience/sites.py;
-# exits 1 on the first finding.  Stdlib-ast only, budgeted < 10 s.
+# conformance, kernel-bypass, determinism, per-node isolation,
+# txn-purity, host-sync, and the concurrency contracts (lock
+# discipline / lock order / thread escape, against the CONCURRENCY
+# registry) machine-checked against resilience/sites.py; exits 1 on
+# the first finding.  Stdlib-ast only, budgeted < 10 s.
+# `--pass <name>` / `--list-passes` focus a run while iterating.
 speclint:
 	$(PYTHON) scripts/speclint.py
 
@@ -67,17 +71,31 @@ tier1:
 # chaos tier (resilience/): sanity-block replays under seeded fault
 # schedules with the supervisor + differential guard armed.  Excluded
 # from tier-1 by the `slow` marker; CHAOS_SEED=N reruns one schedule.
+# SPECLINT_TSAN=1 arms the runtime lock-order sanitizer
+# (utils/locks.py): every named lock is traced and the session fails
+# on an acquisition order the static speclint graph contradicts.
 chaos:
-	env JAX_PLATFORMS=cpu CHAOS_SEED=$${CHAOS_SEED:-20260803} \
+	env JAX_PLATFORMS=cpu SPECLINT_TSAN=1 \
+		CHAOS_SEED=$${CHAOS_SEED:-20260803} \
 		$(PYTHON) -m pytest tests/test_chaos.py -q --kernel-tiers
 
 # crash-anywhere recovery tier alone (txn/): seeded kills mid-handler /
 # mid-commit / mid-journal-write, recovered store byte-identical to the
 # never-crashed oracle
 recovery-chaos:
-	env JAX_PLATFORMS=cpu CHAOS_SEED=$${CHAOS_SEED:-20260803} \
+	env JAX_PLATFORMS=cpu SPECLINT_TSAN=1 \
+		CHAOS_SEED=$${CHAOS_SEED:-20260803} \
 		$(PYTHON) -m pytest tests/test_chaos.py tests/test_txn.py \
 		-k "txn or crash or torn or recover" -q --kernel-tiers
+
+# async flush engine slow tier under the runtime lock sanitizer: the
+# full overlapped-flush fault matrix with every named lock traced, so
+# real double-buffered windows and watchdog hops feed the observed
+# acquisition graph the static lock-order pass is checked against
+pipeline-chaos:
+	env JAX_PLATFORMS=cpu SPECLINT_TSAN=1 \
+		$(PYTHON) -m pytest tests/test_pipeline_async.py \
+		tests/test_locktrace.py -q --kernel-tiers
 
 # fleet battlefield tier (scenario/): the named scenario library plus
 # the seeded randomized scenario matrix — partitions, equivocation

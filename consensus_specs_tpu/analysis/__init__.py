@@ -20,12 +20,23 @@ site registry ``resilience/sites.py``:
                     must be a nodectx Router or registered with a reason.
 * txnpurity.py    — store writes only in (or under) @transactional
                     handlers.
+* hostsync.py     — host-sync primitives only inside declared join
+                    barriers (the async-flush re-serialization gate).
+* concurrency.py  — lock discipline (guarded attrs only under their
+                    registered lock), lock order (static acquisition
+                    graph must be acyclic), thread escape (worker-role
+                    mutations lock-guarded or via registered handoffs);
+                    anchored on the CONCURRENCY registry and paired
+                    with the SPECLINT_TSAN runtime tracer
+                    (utils/locks.py).
 
 Entry points: :func:`run_speclint` (library), ``scripts/speclint.py``
-(CLI, JSON or human output, exit 1 on findings), ``make speclint`` /
-``make test-quick`` (CI gate), tests/test_speclint.py (pytest gate).
-Rule catalogue and escape-hatch policy: docs/analysis.md.
+(CLI, JSON or human output, ``--pass``/``--list-passes`` filters, exit
+1 on findings), ``make speclint`` / ``make test-quick`` (CI gate),
+tests/test_speclint.py (pytest gate).  Rule catalogue and escape-hatch
+policy: docs/analysis.md.
 """
-from .core import RULES, Finding, load_context, run_speclint
+from .core import RULES, Finding, load_context, pass_names, run_speclint
 
-__all__ = ["Finding", "RULES", "load_context", "run_speclint"]
+__all__ = ["Finding", "RULES", "load_context", "pass_names",
+           "run_speclint"]

@@ -1,0 +1,833 @@
+"""Passes 7-9 — concurrency: lock discipline, lock order, thread escape.
+
+PR 11 made the hot path genuinely multi-threaded (engine worker, leg
+worker, gossip drainer, watchdog workers) with ~20 lock sites across
+sigpipe/gossip/txn/resilience — and the overlap contracts were enforced
+only by tests that happen to race.  These passes check the source
+against the ``CONCURRENCY`` registry in ``resilience/sites.py`` (locks
+with the attribute sets they guard, thread roles, sanctioned
+cross-thread handoffs), the same declare-once discipline the seam
+passes apply to dispatch sites:
+
+* **lock-discipline** (``conc-unguarded-attr`` / ``conc-unregistered-
+  lock`` / ``registry-dead-entry``) — an attribute a registered lock
+  guards may be read or written only while that lock is held: lexically
+  inside ``with <lock>`` (or after an explicit ``.acquire()`` in the
+  same function), or in a function the package-wide name-union call
+  graph shows is invoked from under the lock (the txn-purity pass's
+  reachability idiom — over-approximate on purpose: a helper called
+  from both locked and unlocked contexts is assumed locked, and the
+  runtime tracer covers what static analysis must guess).  Bare
+  ``threading.Lock/RLock/Condition`` constructions in the concurrency-
+  scoped packages are findings — locks are built via
+  ``utils/locks.py`` named constructors so the SPECLINT_TSAN tracer
+  can see them — and every registry entry (locks, roles, handoffs,
+  HOST_SYNC_BARRIERS) must resolve to real code.
+* **lock-order** (``conc-lock-order-cycle``) — the static lock-
+  acquisition graph: holding A while acquiring B (lexically nested
+  ``with``s, or a call under A to a function whose call-graph closure
+  acquires B) adds edge A->B.  Any cycle is a potential deadlock; a
+  lexical self-edge on a non-reentrant ``lock`` kind is a guaranteed
+  one.  The same graph is what the runtime
+  :class:`utils.locks.LockTracer` checks observed acquisition
+  sequences against.
+* **thread-escape** (``conc-thread-escape``) — state mutated from a
+  registered worker role's entry point (within its own module, over
+  the in-module call closure) must be lock-guarded, a registered
+  cross-thread handoff, or thread-local.  This is exactly the contract
+  per-node async needs before the nodectx breaker table can be
+  namespaced: a worker that scribbles on unguarded shared state cannot
+  be fenced into a node.
+
+Scope: ``sigpipe``, ``gossip``, ``txn``, ``resilience``, ``scenario``
+and ``utils`` (minus ``utils/locks.py`` itself, which IS the
+primitive layer).  Like every pass: stdlib ``ast`` only.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Context, Finding
+
+_SCOPE = (
+    "consensus_specs_tpu.sigpipe",
+    "consensus_specs_tpu.gossip",
+    "consensus_specs_tpu.txn",
+    "consensus_specs_tpu.resilience",
+    "consensus_specs_tpu.scenario",
+    "consensus_specs_tpu.utils",
+)
+
+# the primitive layer: the one module allowed to touch threading locks
+_EXEMPT_MODULES = ("consensus_specs_tpu.utils.locks",)
+
+_NAMED_CTORS = frozenset({"named_lock", "named_rlock", "named_condition"})
+_RAW_CTORS = frozenset({"Lock", "RLock", "Condition"})
+
+# in-place mutator method names (the txn-purity set)
+_MUTATORS = frozenset({
+    "append", "add", "update", "pop", "clear", "extend", "insert",
+    "setdefault", "remove", "discard", "popitem",
+})
+
+
+def _in_scope(sf) -> bool:
+    if sf.module in _EXEMPT_MODULES:
+        return False
+    return sf.in_module(*_SCOPE)
+
+
+def _called_names(fn) -> set:
+    """Direct callees resolvable by name: bare-name calls and
+    self/cls-method calls (the txn-purity resolution rule — ubiquitous
+    dict/list method names on arbitrary bases are deliberately NOT
+    resolved, they would wire the graph into spaghetti)."""
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name):
+                out.add(f.id)
+            elif isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id in ("self", "cls"):
+                out.add(f.attr)
+    return out
+
+
+def _root_name(expr):
+    """The base Name of an attribute/subscript chain, plus the
+    outermost attribute directly on it ('' for the bare Name)."""
+    attr = ""
+    while isinstance(expr, (ast.Attribute, ast.Subscript)):
+        if isinstance(expr, ast.Attribute):
+            attr = expr.attr
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        return expr.id, attr
+    return None, attr
+
+
+class _FnInfo:
+    __slots__ = ("sf", "node", "name", "cls", "calls", "acquires",
+                 "accesses", "mutations", "calls_under", "edges")
+
+    def __init__(self, sf, node, cls):
+        self.sf = sf
+        self.node = node
+        self.name = node.name
+        self.cls = cls
+        self.calls = _called_names(node)
+        self.acquires: set = set()       # lock names acquired anywhere
+        self.accesses: list = []         # (attr, kind, held, line, col)
+        self.mutations: list = []        # (root, name, held, line, col)
+        self.calls_under: dict = {}      # lock name -> called names
+        self.edges: set = set()          # lexical (outer, inner) pairs
+
+
+class _FnWalker:
+    """Walks one function body tracking the lexically-held lock set."""
+
+    def __init__(self, model, info):
+        self.m = model
+        self.info = info
+        self.held: list = []            # lock names, outer first
+        self.rest: set = set()          # .acquire()-style, rest-of-fn
+
+    def _held_set(self):
+        return frozenset(self.held) | frozenset(self.rest)
+
+    def walk(self):
+        for stmt in self.info.node.body:
+            self._stmt(stmt)
+
+    def _stmt(self, node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return                      # nested defs walked separately
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            names = []
+            for item in node.items:
+                self._expr(item.context_expr)
+                spec = self.m.lock_of(item.context_expr, self.info)
+                if spec is not None:
+                    self._note_acquire(spec.name)
+                    names.append(spec.name)
+                    # held immediately: `with A, B:` acquires A first,
+                    # so B's acquisition must see A on the stack or the
+                    # order pass misses cycles written in one statement
+                    self.held.append(spec.name)
+            for stmt in node.body:
+                self._stmt(stmt)
+            for _ in names:
+                self.held.pop()
+            return
+        self._targets(node)
+        self._children(node)
+
+    def _children(self, node):
+        """Dispatch every AST child: statements re-enter _stmt (so
+        nested withs stack), expressions go to _expr, anything else
+        (except handlers, match cases) recurses field-wise."""
+        for _field, value in ast.iter_fields(node):
+            for child in (value if isinstance(value, list) else [value]):
+                if isinstance(child, ast.stmt):
+                    self._stmt(child)
+                elif isinstance(child, ast.expr):
+                    self._expr(child)
+                elif isinstance(child, ast.AST):
+                    self._children(child)
+
+    def _note_acquire(self, name: str) -> None:
+        info = self.info
+        info.acquires.add(name)
+        for outer in self._held_set():
+            info.edges.add((outer, name))
+
+    def _targets(self, node):
+        """Record mutations for the thread-escape pass."""
+        targets: list = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        elif isinstance(node, ast.Delete):
+            targets = node.targets
+        work = list(targets)    # a worklist COPY: extending the live
+        #                         node.targets would corrupt the shared
+        #                         AST every other pass re-walks
+        while work:
+            t = work.pop()
+            if isinstance(t, ast.Tuple):
+                work.extend(t.elts)
+                continue
+            if isinstance(t, (ast.Attribute, ast.Subscript)):
+                root, attr = _root_name(t)
+                if root == "self" and attr:
+                    self.info.mutations.append(
+                        ("self", attr, self._held_set(),
+                         t.lineno, t.col_offset))
+                elif root in self.m.module_globals.get(self.info.sf.rel,
+                                                       ()):
+                    self.info.mutations.append(
+                        ("global", root, self._held_set(),
+                         t.lineno, t.col_offset))
+            elif isinstance(t, ast.Name) and \
+                    t.id in self._declared_globals():
+                self.info.mutations.append(
+                    ("global", t.id, self._held_set(),
+                     t.lineno, t.col_offset))
+
+    def _declared_globals(self):
+        return self.m.fn_globals.get(id(self.info.node), frozenset())
+
+    def _expr(self, node):
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._call(sub)
+            elif isinstance(sub, ast.Attribute):
+                self._access(sub.attr, sub.lineno, sub.col_offset)
+            elif isinstance(sub, ast.Name):
+                self._name(sub)
+
+    def _call(self, node):
+        f = node.func
+        # lock.acquire(): held for the rest of the function (the
+        # try/finally-release idiom the gossip drainer uses)
+        if isinstance(f, ast.Attribute) and f.attr == "acquire":
+            spec = self.m.lock_of(f.value, self.info)
+            if spec is not None:
+                self._note_acquire(spec.name)
+                self.rest.add(spec.name)
+                return
+        # calls made while holding a lock (interprocedural order edges
+        # + the under-lock reachability seeds)
+        held = self._held_set()
+        if held:
+            callee = None
+            if isinstance(f, ast.Name):
+                callee = f.id
+            elif isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id in ("self", "cls"):
+                callee = f.attr
+            if callee is not None:
+                for lock in held:
+                    self.info.calls_under.setdefault(
+                        lock, set()).add(callee)
+
+    def _access(self, attr, line, col):
+        if attr in self.m.guard_attrs.get(self.info.sf.rel, ()):
+            self.info.accesses.append(
+                (attr, "attr", self._held_set(), line, col))
+
+    def _name(self, node):
+        if node.id in self.m.guard_globals.get(self.info.sf.rel, ()):
+            self.info.accesses.append(
+                (node.id, "name", self._held_set(),
+                 node.lineno, node.col_offset))
+
+
+class _Model:
+    """The shared concurrency model: built once per lint run, consumed
+    by all three passes (cached on the Context)."""
+
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+        conc = getattr(ctx.registry, "CONCURRENCY", None)
+        self.locks = tuple(conc.locks) if conc is not None else ()
+        self.roles = tuple(conc.roles) if conc is not None else ()
+        self.handoffs = tuple(conc.handoffs) if conc is not None else ()
+        self.files = [sf for sf in ctx.files if _in_scope(sf)]
+        # per-file lookup tables -----------------------------------------
+        self.specs_by_module: dict = {}
+        for spec in self.locks:
+            self.specs_by_module.setdefault(spec.module, []).append(spec)
+        self.guard_attrs: dict = {}      # sf.rel -> guarded attr names
+        self.guard_globals: dict = {}    # sf.rel -> guarded global names
+        self.guards_for: dict = {}       # (sf.rel, name) -> [specs]
+        for sf in self.files:
+            for spec in self.specs_by_module.get(sf.module, ()):
+                for g in spec.guards:
+                    self.guards_for.setdefault((sf.rel, g), []).append(
+                        spec)
+                    if spec.cls:
+                        self.guard_attrs.setdefault(sf.rel, set()).add(g)
+                    else:
+                        self.guard_globals.setdefault(
+                            sf.rel, set()).add(g)
+                        self.guard_attrs.setdefault(sf.rel, set()).add(g)
+        self.module_globals: dict = {}   # sf.rel -> module-level names
+        self.fn_globals: dict = {}       # id(fn node) -> `global` names
+        self.threading_aliases: dict = {}  # sf.rel -> alias map
+        self.raw_locks: list = []
+        self.named_ctor_calls: list = []
+        self.fns: list = []              # every _FnInfo
+        self.fns_by_file: dict = {}      # sf.rel -> [_FnInfo]
+        self._collect()
+        self._walk()
+        self._close()
+
+    # -- collection ----------------------------------------------------
+    def _collect(self):
+        for sf in self.files:
+            top = set()
+            for node in sf.tree.body:
+                tgts = node.targets if isinstance(node, ast.Assign) else \
+                    [node.target] if isinstance(
+                        node, (ast.AnnAssign, ast.AugAssign)) else []
+                for t in tgts:
+                    if isinstance(t, ast.Name):
+                        top.add(t.id)
+            self.module_globals[sf.rel] = frozenset(top)
+            aliases = {}
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        if a.name.split(".")[0] == "threading":
+                            aliases[(a.asname or a.name).split(".")[0]] \
+                                = "threading"
+                elif isinstance(node, ast.ImportFrom) and \
+                        node.module == "threading":
+                    for a in node.names:
+                        aliases[a.asname or a.name] = f"t.{a.name}"
+                elif isinstance(node,
+                                (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    decl = set()
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Global):
+                            decl.update(sub.names)
+                    if decl:
+                        self.fn_globals[id(node)] = frozenset(decl)
+            self.threading_aliases[sf.rel] = aliases
+            # lock constructions anywhere in the file (module level
+            # included — _ENGINE_LOCK-style globals are the norm)
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                name = f.id if isinstance(f, ast.Name) else \
+                    f.attr if isinstance(f, ast.Attribute) else None
+                if name in _RAW_CTORS and self.is_threading_ref(f, sf):
+                    self.raw_locks.append(
+                        (sf, name, node.lineno, node.col_offset))
+                elif name in _NAMED_CTORS:
+                    arg = node.args[0] if node.args else None
+                    lock_name = arg.value \
+                        if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str) else None
+                    self.named_ctor_calls.append(
+                        (sf, lock_name, node.lineno, node.col_offset))
+            # functions with their enclosing class
+            def visit(body, cls):
+                for node in body:
+                    if isinstance(node, ast.ClassDef):
+                        visit(node.body, node.name)
+                    elif isinstance(node, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                        info = _FnInfo(sf, node, cls)
+                        self.fns.append(info)
+                        self.fns_by_file.setdefault(
+                            sf.rel, []).append(info)
+                        visit(node.body, cls)
+            visit(sf.tree.body, "")
+
+    def is_threading_ref(self, func, sf) -> bool:
+        aliases = self.threading_aliases.get(sf.rel, {})
+        if isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name):
+            return aliases.get(func.value.id) == "threading"
+        if isinstance(func, ast.Name):
+            return aliases.get(func.id, "").startswith("t.")
+        return False
+
+    def lock_of(self, expr, info):
+        """Resolve a with-item / acquire target to a LockSpec, by
+        attribute or bare name within the owning module, disambiguated
+        by the enclosing class when a module declares several locks
+        under one attribute name."""
+        if isinstance(expr, ast.Call):      # with self._lock() style: no
+            return None
+        name = None
+        if isinstance(expr, ast.Attribute):
+            name = expr.attr
+        elif isinstance(expr, ast.Name):
+            name = expr.id
+        if name is None:
+            return None
+        cands = [s for s in self.specs_by_module.get(info.sf.module, ())
+                 if s.attr == name]
+        if not cands and info.sf.forced:
+            # fixture mode: forced files have no package module; match
+            # any registered lock by attribute so scratch fixtures and
+            # fake registries exercise the pass
+            cands = [s for s in self.locks if s.attr == name]
+        if len(cands) == 1:
+            return cands[0]
+        for s in cands:
+            if s.cls == info.cls:
+                return s
+        return cands[0] if cands else None
+
+    def resolve_guard(self, sf, info, name):
+        cands = self.guards_for.get((sf.rel, name), [])
+        if not cands and sf.forced:
+            cands = [s for s in self.locks if name in s.guards]
+        scoped = [s for s in cands if not s.cls or s.cls == info.cls]
+        return scoped or cands
+
+    # -- the walks -----------------------------------------------------
+    def _walk(self):
+        for info in self.fns:
+            _FnWalker(self, info).walk()
+
+    def _close(self):
+        """Interprocedural closures: ACQ* (locks a call may acquire),
+        UNDER (functions assumed to run with a lock held), and the
+        final order-edge set."""
+        acq: dict = {}                   # fn name -> set of lock names
+        edges_by_name: dict = {}         # fn name -> called names
+        for info in self.fns:
+            acq.setdefault(info.name, set()).update(info.acquires)
+            edges_by_name.setdefault(info.name, set()).update(info.calls)
+        changed = True
+        while changed:
+            changed = False
+            for name, callees in edges_by_name.items():
+                mine = acq.setdefault(name, set())
+                before = len(mine)
+                for c in callees:
+                    mine.update(acq.get(c, ()))
+                changed = changed or len(mine) != before
+        self.acq_closure = acq
+        under: dict = {}                 # lock name -> set of fn names
+        for info in self.fns:
+            for lock, callees in info.calls_under.items():
+                under.setdefault(lock, set()).update(callees)
+        for lock, seed in under.items():
+            frontier = list(seed)
+            while frontier:
+                for c in edges_by_name.get(frontier.pop(), ()):
+                    if c not in seed:
+                        seed.add(c)
+                        frontier.append(c)
+        self.under = under
+        order: set = set()
+        order_sites: dict = {}           # (a, b) -> (sf, line)
+        for info in self.fns:
+            for (a, b) in info.edges:
+                order.add((a, b))
+                order_sites.setdefault((a, b),
+                                       (info.sf, info.node.lineno))
+            for lock, callees in info.calls_under.items():
+                for c in callees:
+                    for inner in self.acq_closure.get(c, ()):
+                        order.add((lock, inner))
+                        order_sites.setdefault(
+                            (lock, inner), (info.sf, info.node.lineno))
+        self.order_edges = order
+        self.order_sites = order_sites
+
+    def under_lock(self, info, spec) -> bool:
+        return info.name in self.under.get(spec.name, ())
+
+
+def _model(ctx: Context) -> _Model:
+    m = getattr(ctx, "_concurrency_model", None)
+    if m is None:
+        m = ctx._concurrency_model = _Model(ctx)
+    return m
+
+
+def static_lock_edges(root) -> frozenset:
+    """The static lock-acquisition graph (name pairs, self-edges
+    dropped) over the default lint surface — what the runtime
+    LockTracer checks observed acquisition orders against."""
+    from .core import load_context
+    m = _Model(load_context(root))
+    return frozenset((a, b) for a, b in m.order_edges if a != b)
+
+
+# ---------------------------------------------------------------------------
+# pass 7: lock discipline (+ registry liveness)
+# ---------------------------------------------------------------------------
+
+def run_lock_discipline(ctx: Context) -> list:
+    m = _model(ctx)
+    findings: list = []
+    registered = {s.name for s in m.locks}
+    for sf, kind, line, col in m.raw_locks:
+        findings.append(Finding(
+            "conc-unregistered-lock", sf.rel, line, col,
+            f"bare threading.{kind}() in a concurrency-scoped package — "
+            f"invisible to the lock registry and the SPECLINT_TSAN "
+            f"tracer",
+            hint="construct it via utils.locks.named_lock/named_rlock/"
+                 "named_condition with a name declared in "
+                 "resilience/sites.py CONCURRENCY"))
+    for sf, lock_name, line, col in m.named_ctor_calls:
+        if lock_name is None:
+            findings.append(Finding(
+                "conc-unregistered-lock", sf.rel, line, col,
+                "named lock constructor called with a non-literal name "
+                "— the registry binding cannot be checked statically",
+                hint="pass the canonical name as a string literal"))
+        elif lock_name not in registered:
+            findings.append(Finding(
+                "conc-unregistered-lock", sf.rel, line, col,
+                f"lock name {lock_name!r} is not declared in "
+                f"resilience/sites.py CONCURRENCY",
+                hint="add a LockSpec entry (name, owning module/class, "
+                     "attr, kind, guarded attribute set)"))
+    for info in m.fns:
+        if info.name in ("__init__", "__new__", "__del__"):
+            continue        # construction precedes sharing
+        for name, kind, held, line, col in info.accesses:
+            specs = m.resolve_guard(info.sf, info, name)
+            if not specs:
+                continue
+            ok = any(s.name in held for s in specs) or \
+                any(m.under_lock(info, s) for s in specs)
+            if ok:
+                continue
+            locks = " / ".join(s.name for s in specs)
+            findings.append(Finding(
+                "conc-unguarded-attr", info.sf.rel, line, col,
+                f"{name!r} is guarded by {locks} but accessed in "
+                f"{info.name}() with no path holding the lock",
+                hint="take the lock (or restructure so the access is "
+                     "reached only from under it); a deliberately "
+                     "lock-free access needs a reasoned disable"))
+    findings.extend(_liveness(ctx, m))
+    return findings
+
+
+def _liveness(ctx: Context, m: _Model) -> list:
+    """registry-dead-entry: every CONCURRENCY lock/role/handoff and
+    every HOST_SYNC_BARRIERS row must resolve to real code.  Full-
+    surface runs only — a fixture run sees none of the package files
+    and could prove nothing."""
+    if not getattr(ctx, "full_surface", False):
+        return []
+    findings: list = []
+    by_module = {sf.module: sf for sf in ctx.files if sf.module}
+    sites_sf = next((sf for sf in ctx.files
+                     if sf.rel.endswith("resilience/sites.py")), None)
+
+    def where(name: str) -> tuple:
+        if sites_sf is not None:
+            for i, line in enumerate(sites_sf.lines, 1):
+                if f'"{name}"' in line:
+                    return sites_sf.rel, i
+        return "consensus_specs_tpu/resilience/sites.py", 1
+
+    def dead(name: str, what: str, hint: str) -> None:
+        rel, line = where(name)
+        findings.append(Finding(
+            "registry-dead-entry", rel, line, 0,
+            f"{what} — dead registry entry", hint=hint))
+
+    def functions_of(sf):
+        out = {}
+        def visit(body, cls):
+            for node in body:
+                if isinstance(node, ast.ClassDef):
+                    visit(node.body, node.name)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    out[(cls, node.name)] = node
+                    out[("", node.name)] = node
+                    visit(node.body, cls)
+        visit(sf.tree.body, "")
+        return out
+
+    for spec in m.locks:
+        sf = by_module.get(spec.module)
+        if sf is None:
+            dead(spec.name, f"lock {spec.name!r}: module {spec.module} "
+                            f"not found", "fix the module path")
+            continue
+        # one whole-tree walk: module-level bindings and every
+        # method-body binding are all under sf.tree
+        bound = any(_binds_named_lock(node, spec)
+                    for node in ast.walk(sf.tree))
+        if not bound:
+            dead(spec.name,
+                 f"lock {spec.name!r}: no `{spec.attr} = named_*("
+                 f"\"{spec.name}\")` binding in {spec.module}",
+                 "bind the lock through utils.locks with its registry "
+                 "name")
+    for role in m.roles:
+        if not role.func:
+            continue
+        sf = by_module.get(role.module)
+        fns = functions_of(sf) if sf is not None else {}
+        cls, _, fname = role.func.rpartition(".")
+        if sf is None or (cls, fname) not in fns:
+            dead(role.name, f"role {role.name!r}: entry point "
+                            f"{role.module}.{role.func} not found",
+                 "fix the role's module/func")
+    for h in m.handoffs:
+        sf = by_module.get(h.module)
+        present = sf is not None and any(
+            (isinstance(n, ast.Name) and n.id == h.attr)
+            or (isinstance(n, ast.Attribute) and n.attr == h.attr)
+            or (isinstance(n, ast.ClassDef) and n.name == h.attr)
+            for n in ast.walk(sf.tree))
+        if not present:
+            dead(h.name, f"handoff {h.name!r}: {h.attr!r} not found in "
+                         f"{h.module}", "fix the handoff's module/attr")
+    for module, func in getattr(ctx.registry, "HOST_SYNC_BARRIERS", ()):
+        sf = by_module.get(module)
+        fns = functions_of(sf) if sf is not None else {}
+        if sf is None or ("", func) not in fns:
+            dead(func, f"HOST_SYNC_BARRIERS: {module}.{func} not found",
+                 "fix the barrier's module/function")
+    return findings
+
+
+def _binds_named_lock(node, spec) -> bool:
+    """`<attr> = named_*("<name>")` (plain or chained assignment)."""
+    if not isinstance(node, ast.Assign) or \
+            not isinstance(node.value, ast.Call):
+        return False
+    f = node.value.func
+    fname = f.id if isinstance(f, ast.Name) else \
+        f.attr if isinstance(f, ast.Attribute) else None
+    if fname not in _NAMED_CTORS:
+        return False
+    args = node.value.args
+    if not (args and isinstance(args[0], ast.Constant)
+            and args[0].value == spec.name):
+        return False
+    for t in node.targets:
+        if isinstance(t, ast.Name) and t.id == spec.attr:
+            return True
+        if isinstance(t, ast.Attribute) and t.attr == spec.attr:
+            return True
+        if isinstance(t, ast.Subscript):
+            return True     # dict-slot binding (per-site worker locks)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# pass 8: lock order
+# ---------------------------------------------------------------------------
+
+def run_lock_order(ctx: Context) -> list:
+    m = _model(ctx)
+    findings: list = []
+    kind_of = {s.name: s.kind for s in m.locks}
+    graph: dict = {}
+    for a, b in m.order_edges:
+        if a == b:
+            if kind_of.get(a) == "lock":
+                sf, line = m.order_sites[(a, b)]
+                findings.append(Finding(
+                    "conc-lock-order-cycle", sf.rel, line, 0,
+                    f"non-reentrant lock {a!r} re-acquired while held — "
+                    f"guaranteed self-deadlock",
+                    hint="make it an rlock or hoist the inner acquire"))
+            continue
+        graph.setdefault(a, set()).add(b)
+    # cycle detection: iterative DFS with colors
+    color: dict = {}
+    stack_path: list = []
+    cycles: list = []
+
+    def dfs(node):
+        color[node] = 1
+        stack_path.append(node)
+        for nxt in sorted(graph.get(node, ())):
+            if color.get(nxt, 0) == 0:
+                dfs(nxt)
+            elif color.get(nxt) == 1:
+                cycles.append(tuple(stack_path[stack_path.index(nxt):])
+                              + (nxt,))
+        stack_path.pop()
+        color[node] = 2
+
+    for node in sorted(graph):
+        if color.get(node, 0) == 0:
+            dfs(node)
+    seen = set()
+    for cyc in cycles:
+        key = frozenset(cyc)
+        if key in seen:
+            continue
+        seen.add(key)
+        edge = (cyc[0], cyc[1])
+        sf, line = m.order_sites.get(edge, (None, 1))
+        rel = sf.rel if sf is not None else \
+            "consensus_specs_tpu/resilience/sites.py"
+        findings.append(Finding(
+            "conc-lock-order-cycle", rel, line, 0,
+            f"static lock-acquisition cycle: {' -> '.join(cyc)} — "
+            f"two threads taking these in opposite order deadlock",
+            hint="impose one global order (registry note) and "
+                 "restructure the acquisition that breaks it"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# pass 9: thread escape
+# ---------------------------------------------------------------------------
+
+def run_thread_escape(ctx: Context) -> list:
+    m = _model(ctx)
+    findings: list = []
+    handoff_attrs: dict = {}
+    for h in m.handoffs:
+        handoff_attrs.setdefault(h.module, set()).add(h.attr)
+    for role in m.roles:
+        if not role.func:
+            continue                    # the implicit block-thread role
+        _, _, entry = role.func.rpartition(".")
+        infos = [i for i in m.fns_by_file.get(_rel_of(m, role.module),
+                                              [])]
+        if not infos and any(sf.forced for sf in m.files):
+            infos = [i for sf in m.files if sf.forced
+                     for i in m.fns_by_file.get(sf.rel, [])]
+        by_name: dict = {}
+        for i in infos:
+            by_name.setdefault(i.name, []).append(i)
+        if entry not in by_name:
+            continue                    # liveness pass reports it
+        reach = {entry}
+        frontier = [entry]
+        while frontier:
+            for i in by_name.get(frontier.pop(), []):
+                for c in i.calls:
+                    if c in by_name and c not in reach:
+                        reach.add(c)
+                        frontier.append(c)
+        module = infos[0].sf.module if infos else role.module
+        allowed = handoff_attrs.get(role.module, set()) | \
+            handoff_attrs.get(module, set())
+        for name in reach:
+            for info in by_name[name]:
+                if info.name in ("__init__", "__new__"):
+                    continue
+                for root, tgt, held, line, col in _escapes(m, info):
+                    if held:
+                        continue        # lock-guarded: discipline pass
+                        #                 owns whether it's the RIGHT one
+                    if tgt in allowed:
+                        continue
+                    if any(m.under_lock(info, s) for s in m.locks):
+                        continue
+                    findings.append(Finding(
+                        "conc-thread-escape", info.sf.rel, line, col,
+                        f"{info.name}() runs on the {role.name!r} "
+                        f"worker role and mutates shared "
+                        f"{'attribute' if root == 'self' else 'global'} "
+                        f"{tgt!r} with no lock held and no registered "
+                        f"handoff",
+                        hint="guard it with a registered lock, route "
+                             "it through a CONCURRENCY handoff, or a "
+                             "nodectx Router; thread-local state is "
+                             "exempt by registration"))
+    # dedup: two roles sharing one entry point (engine + leg workers)
+    # would otherwise double-report the same line
+    out, seen = [], set()
+    for f in findings:
+        key = (f.path, f.line, f.col, f.message)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
+def _rel_of(m, module: str) -> str:
+    for sf in m.files:
+        if sf.module == module:
+            return sf.rel
+    return ""
+
+
+def _escapes(m, info):
+    """Mutations recorded for `info`: direct assignments plus mutator-
+    method calls rooted at self attributes or module globals."""
+    yield from info.mutations
+    for node in ast.walk(info.node):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATORS:
+            root, attr = _root_name(node.func.value)
+            held = _held_at(m, info, node.lineno)
+            if root == "self" and attr:
+                yield ("self", attr, held, node.lineno, node.col_offset)
+            elif root == "self" and not attr:
+                continue
+            elif root in m.module_globals.get(info.sf.rel, ()):
+                yield ("global", root, held, node.lineno,
+                       node.col_offset)
+
+
+def _held_at(m, info, line: int) -> frozenset:
+    """Approximate the held-lock set at `line` from the recorded
+    guarded-access walk: re-walk is avoided by checking whether any
+    with-region of the function covers the line."""
+    held = set()
+    for node in ast.walk(info.node):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= line <= end:
+                for item in node.items:
+                    spec = m.lock_of(item.context_expr, info)
+                    if spec is not None:
+                        held.add(spec.name)
+    for node in ast.walk(info.node):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "acquire" and node.lineno <= line:
+            spec = m.lock_of(node.func.value, info)
+            if spec is not None:
+                held.add(spec.name)
+    return frozenset(held)
+
+
+def run(ctx: Context) -> list:
+    """All three concurrency passes (the driver calls the named
+    runners individually; this is the convenience aggregate)."""
+    return (run_lock_discipline(ctx) + run_lock_order(ctx)
+            + run_thread_escape(ctx))

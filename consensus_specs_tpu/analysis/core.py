@@ -52,6 +52,21 @@ RULES: dict[str, str] = {
     "async-host-sync":
         "a host-sync primitive (device_get/block_until_ready/np.asarray) "
         "sits outside a declared join barrier in a pipelined package",
+    "conc-unregistered-lock":
+        "a bare threading lock (or a named lock with an unregistered "
+        "name) in a concurrency-scoped package",
+    "conc-unguarded-attr":
+        "an attribute a registered lock guards is accessed with no path "
+        "holding the lock",
+    "conc-lock-order-cycle":
+        "the static lock-acquisition graph has a cycle (or a "
+        "non-reentrant lock self-edge): potential deadlock",
+    "conc-thread-escape":
+        "a worker-role function mutates shared state that is neither "
+        "lock-guarded nor a registered cross-thread handoff",
+    "registry-dead-entry":
+        "a CONCURRENCY or HOST_SYNC_BARRIERS registry entry resolves to "
+        "no code",
     "speclint-bad-disable":
         "a speclint disable comment lacks a reason or names an unknown rule",
 }
@@ -226,23 +241,58 @@ def load_context(root: str | Path,
             except ValueError:
                 rel = p.name
             files.append(SourceFile(p, rel, p.read_text(), forced=True))
-    return Context(root, files, load_registry(root))
+    ctx = Context(root, files, load_registry(root))
+    # registry-liveness checks only make sense when the whole package
+    # surface is loaded — a fixture run sees none of it
+    ctx.full_surface = paths is None
+    return ctx
+
+
+def _pass_table() -> dict:
+    """Ordered name -> runner table (the CLI's --pass / --list-passes
+    vocabulary).  Import is deferred so `from .core import Finding`
+    inside the pass modules does not cycle."""
+    from . import (bypass, concurrency, determinism, globals_, hostsync,
+                   seams, txnpurity)
+    return {
+        "seams": seams.run,
+        "bypass": bypass.run,
+        "determinism": determinism.run,
+        "globals": globals_.run,
+        "txnpurity": txnpurity.run,
+        "hostsync": hostsync.run,
+        "lock-discipline": concurrency.run_lock_discipline,
+        "lock-order": concurrency.run_lock_order,
+        "thread-escape": concurrency.run_thread_escape,
+    }
+
+
+def pass_names() -> tuple:
+    return tuple(_pass_table())
 
 
 def run_speclint(root: str | Path,
-                 paths: list[str | Path] | None = None) -> list[Finding]:
-    """Run every pass; returns surviving findings sorted by location.
+                 paths: list[str | Path] | None = None,
+                 passes: list[str] | None = None) -> list[Finding]:
+    """Run every pass (or just `passes`, by name — see
+    :func:`pass_names`); returns surviving findings sorted by location.
 
     Disable comments suppress same-line (or next-line, for standalone
     comments) findings of the named rules — but only when they cite a
     reason; malformed disables surface as `speclint-bad-disable`.
     """
-    from . import bypass, determinism, globals_, hostsync, seams, txnpurity
+    table = _pass_table()
+    if passes is not None:
+        unknown = [p for p in passes if p not in table]
+        if unknown:
+            raise RuntimeError(
+                f"unknown pass(es): {', '.join(unknown)} "
+                f"(known: {', '.join(table)})")
+        table = {name: table[name] for name in table if name in passes}
     ctx = load_context(root, paths)
     findings: list[Finding] = []
-    for pass_mod in (seams, bypass, determinism, globals_, txnpurity,
-                     hostsync):
-        findings.extend(pass_mod.run(ctx))
+    for runner in table.values():
+        findings.extend(runner(ctx))
     by_rel = {sf.rel: sf for sf in ctx.files}
     kept = []
     for f in findings:

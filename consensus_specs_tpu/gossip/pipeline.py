@@ -57,7 +57,6 @@ does to the store.
 """
 from __future__ import annotations
 
-import threading
 from collections import deque
 from contextlib import nullcontext
 from dataclasses import dataclass
@@ -68,6 +67,7 @@ from ..sigpipe.verify import VerdictMap
 from ..ssz import hash_tree_root
 from ..utils import nodectx
 from ..utils.clock import MONOTONIC
+from ..utils.locks import named_lock, named_rlock
 from . import collect as _collect
 from .batcher import FLUSH_DRAIN, DeadlineBatcher
 from .dedup import EquivocationGuard, SeenCache
@@ -177,8 +177,8 @@ class AdmissionPipeline:
         # single-drainer discipline — whoever holds it owns flushing and
         # handler delivery.  Order: drainer may take ingress, never the
         # reverse.
-        self._ingress_lock = threading.RLock()
-        self._drainer_lock = threading.Lock()
+        self._ingress_lock = named_rlock("gossip.ingress")
+        self._drainer_lock = named_lock("gossip.drainer")
 
     def _scope(self):
         """The node-context region every public entry point runs under
@@ -388,6 +388,11 @@ class AdmissionPipeline:
         # micro-batch them (scalar oracle mode skips)
         ticket = None
         if not self.config.scalar_only:
+            # speclint: disable=conc-unguarded-attr -- verify_async only
+            # wraps the already-collected sets into a flush submit; it
+            # reads none of the batcher's window state (that was closed
+            # under the ingress lock above), so holding ingress here
+            # would serialize submitters behind the device dispatch
             ticket = self.batcher.verify_async(sets)
         return (batch, collected_by_seq, ticket)
 

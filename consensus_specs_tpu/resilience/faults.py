@@ -40,12 +40,12 @@ the same dispatches.
 from __future__ import annotations
 
 import random
-import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
 from ..sigpipe.metrics import METRICS
+from ..utils.locks import named_rlock
 from . import sites
 from .incidents import INCIDENTS
 
@@ -143,7 +143,7 @@ class FaultPlan:
         self.specs = list(specs)
         self.seed = seed
         self._rng = random.Random(seed)
-        self._lock = threading.RLock()
+        self._lock = named_rlock("resilience.faults")
         by_site: dict = {}
         for s in self.specs:
             by_site.setdefault(s.site, []).append(s)
@@ -193,16 +193,25 @@ class FaultPlan:
             if spec.kind == "shard_dead":
                 # a seeded mesh member dies; the launch fails loud
                 # (ShardDead is a DeviceFault: the breaker contract is
-                # identical, the incident records WHICH shard)
-                shard = self._rng.randrange(_mesh_width())
+                # identical, the incident records WHICH shard).  The
+                # shard draw rides the plan lock like every other draw:
+                # concurrent dispatches racing the seeded stream would
+                # otherwise de-determinize the schedule
+                with self._lock:
+                    shard = self._rng.randrange(_mesh_width())
                 INCIDENTS.record(site, "shard_dead", shard=shard,
                                  fire=spec.fires)
                 raise ShardDead(site, shard, spec.fires)
             if spec.kind == "timeout":
                 time.sleep(spec.sleep_s)
                 return fn()
-            # corrupt: silently flip the verdict
-            return _flip_verdict(fn(), self._rng, site)
+            # corrupt: silently flip the verdict.  The dispatch itself
+            # runs OUTSIDE the plan lock (holding it across a device
+            # call would serialize every site behind one flush); only
+            # the flip's draws are serialized
+            result = fn()
+            with self._lock:
+                return _flip_verdict(result, self._rng, site)
         return faulty
 
     def total_fires(self) -> int:

@@ -23,9 +23,9 @@ production would run low single-digit percent.
 from __future__ import annotations
 
 import random
-import threading
 
 from ..sigpipe.metrics import METRICS
+from ..utils.locks import named_rlock
 from .incidents import INCIDENTS
 from .sites import fused_sites
 
@@ -57,7 +57,7 @@ class DifferentialGuard:
             raise ValueError(f"sample_rate {sample_rate} not in [0, 1]")
         self.sample_rate = sample_rate
         self._rng = random.Random(seed)
-        self._lock = threading.RLock()
+        self._lock = named_rlock("resilience.guard")
 
     def check(self, sets, indices, verdicts) -> bool:
         """Cross-check a sample of `verdicts` (for sets[i], i in indices)

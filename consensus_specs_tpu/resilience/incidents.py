@@ -22,17 +22,17 @@ replays bit-identically.
 from __future__ import annotations
 
 import json
-import threading
 import time
 from collections import deque
 
 from ..utils import nodectx
+from ..utils.locks import named_rlock
 
 
 class IncidentLog:
     def __init__(self, max_entries: int = 4096,
                  node_id: str | None = None, clock=None):
-        self._lock = threading.RLock()
+        self._lock = named_rlock("resilience.incidents")
         self._entries: deque = deque(maxlen=max_entries)
         self._seq = 0
         self.node_id = node_id

@@ -272,3 +272,241 @@ HOST_SYNC_BARRIERS: tuple = (
     ("consensus_specs_tpu.parallel.mesh_engine", "slashings_batch"),
     ("consensus_specs_tpu.parallel.mesh_engine", "g1_msm"),
 )
+
+
+# ---------------------------------------------------------------------------
+# the concurrency registry (speclint lock-discipline / lock-order /
+# thread-escape passes + the SPECLINT_TSAN runtime lock tracer)
+# ---------------------------------------------------------------------------
+# PR 11 made the hot path genuinely multi-threaded; the overlap
+# contracts (single-drainer delivery, ticket-joined verdicts,
+# abandoned-flush write suppression) were until now enforced only by
+# tests that happen to race.  This registry applies the same
+# declare-once discipline as the seam table above to threads and locks:
+#
+# * every named lock is declared HERE (name -> owning module/class,
+#   attribute, kind, the attribute set it guards) and constructed in
+#   code via ``utils/locks.py`` ``named_lock``/``named_rlock``/
+#   ``named_condition`` with its registry name — speclint's
+#   lock-discipline pass fails on a bare ``threading.Lock()`` in the
+#   concurrency-scoped packages, and with ``SPECLINT_TSAN=1`` the
+#   named constructors return traced wrappers so the runtime sanitizer
+#   can compare observed acquisition orders against the static graph.
+# * every thread ROLE (who may run which entry point) is declared so
+#   the thread-escape pass can check that state mutated from a worker
+#   is lock-guarded or reaches the worker through a registered handoff.
+# * every legal cross-thread HANDOFF object is declared; anything else
+#   crossing a thread boundary is a finding.
+
+@dataclass(frozen=True)
+class LockSpec:
+    """One named lock.
+
+    name    — canonical dotted name (what named_lock(...) is called with
+              and what the tracer reports).
+    module  — the owning module; the lock-discipline pass checks guarded
+              attributes only inside it (cross-module access to guarded
+              state is a bug by construction: the attrs are private).
+    attr    — the attribute / module global the lock object binds to.
+    cls     — owning class ("" = module-level global); disambiguates
+              modules holding several ``_lock`` attributes.
+    kind    — "lock" | "rlock" | "condition".  A static self-edge on a
+              plain "lock" is a self-deadlock finding; on an rlock or
+              condition it is legal reentrancy.
+    guards  — attribute / global names that may be read or written only
+              under this lock (lexically or via the under-lock call
+              closure).  Guarding is a claim the pass ENFORCES — list
+              only what really holds, and record the deliberate
+              exceptions with reasoned disables at the access site.
+    note    — why the guard set is shaped the way it is (e.g. which
+              state is serialized by a role discipline instead).
+    """
+
+    name: str
+    module: str
+    attr: str
+    cls: str = ""
+    kind: str = "rlock"
+    guards: tuple = ()
+    note: str = ""
+
+
+@dataclass(frozen=True)
+class ThreadRole:
+    """One thread role: who may run which entry point.
+
+    func is the role's entry point ("Class.method" or a module-level
+    function); "" marks the implicit role of the default thread.  The
+    thread-escape pass analyzes mutations reachable from the entry
+    point inside its own module — cross-module work a worker performs
+    is covered by the lock-discipline pass and the runtime tracer.
+    """
+
+    name: str
+    module: str = ""
+    func: str = ""
+    note: str = ""
+
+
+@dataclass(frozen=True)
+class Handoff:
+    """One sanctioned cross-thread handoff object: state may legally
+    cross a thread boundary only as (or through) one of these."""
+
+    name: str
+    module: str
+    attr: str
+    note: str = ""
+
+
+@dataclass(frozen=True)
+class Concurrency:
+    locks: tuple
+    roles: tuple
+    handoffs: tuple
+
+    def lock_names(self) -> tuple:
+        return tuple(spec.name for spec in self.locks)
+
+
+_PA = "consensus_specs_tpu.sigpipe.pipeline_async"
+_GP = "consensus_specs_tpu.gossip.pipeline"
+
+CONCURRENCY = Concurrency(
+    locks=(
+        # -- sigpipe: the async flush engine ---------------------------
+        LockSpec("sigpipe.engine", _PA, "_ENGINE_LOCK", kind="lock",
+                 guards=("_FLUSH_WORKER", "_LEG_WORKER"),
+                 note="worker singletons: creation and respawn checks"),
+        LockSpec("sigpipe.ticket", _PA, "_lock", cls="FlushTicket",
+                 kind="lock", guards=("_state", "_value", "_error"),
+                 note="ticket outcome; _done Event is the join handoff, "
+                      "_overlapped is written pre-publication only"),
+        LockSpec("sigpipe.worker_cv", _PA, "_cv", cls="_Worker",
+                 kind="condition", guards=("_pending",),
+                 note="queued+running job count; drain() waits on it"),
+        LockSpec("sigpipe.pubkey_cache",
+                 "consensus_specs_tpu.sigpipe.cache", "_lock",
+                 cls="PubkeyCache", guards=("_cache",)),
+        LockSpec("sigpipe.aggregate_cache",
+                 "consensus_specs_tpu.sigpipe.cache", "_lock",
+                 cls="AggregatePubkeyCache",
+                 guards=("_cache", "_track_stack")),
+        LockSpec("sigpipe.metrics",
+                 "consensus_specs_tpu.sigpipe.metrics", "_lock",
+                 cls="Metrics",
+                 guards=("counters", "labeled", "observations",
+                         "histograms", "timers")),
+        # -- gossip: ingress vs the single drainer ---------------------
+        LockSpec("gossip.ingress", _GP, "_ingress_lock",
+                 cls="AdmissionPipeline",
+                 guards=("_seq", "seen", "results", "queues", "quotas",
+                         "batcher", "_finalized_order"),
+                 note="admission state; order: drainer may take "
+                      "ingress, never the reverse"),
+        LockSpec("gossip.drainer", _GP, "_drainer_lock",
+                 cls="AdmissionPipeline", kind="lock",
+                 guards=("delivered_log", "guard"),
+                 note="single-drainer discipline: whoever holds it owns "
+                      "flushing, handler delivery, and the equivocation "
+                      "guard; the store itself is serialized by it"),
+        # -- txn -------------------------------------------------------
+        LockSpec("txn.active", "consensus_specs_tpu.txn", "_lock",
+                 guards=("_ACTIVE",),
+                 note="manager installs; hot-path reads of the single "
+                      "reference are atomic under the GIL and carry "
+                      "reasoned disables in place"),
+        LockSpec("txn.journal", "consensus_specs_tpu.txn.journal",
+                 "_lock", cls="Journal",
+                 guards=("_entries", "_snapshots", "_seq")),
+        # -- resilience ------------------------------------------------
+        LockSpec("resilience.supervisor",
+                 "consensus_specs_tpu.resilience.supervisor", "_lock",
+                 cls="Supervisor",
+                 guards=("_breakers", "_workers", "_worker_locks")),
+        LockSpec("resilience.site_worker",
+                 "consensus_specs_tpu.resilience.supervisor",
+                 "site_lock", cls="Supervisor", kind="lock",
+                 note="per-site watchdog serialization: a job is handed "
+                      "to the site worker only while holding it"),
+        LockSpec("resilience.incidents",
+                 "consensus_specs_tpu.resilience.incidents", "_lock",
+                 cls="IncidentLog", guards=("_entries", "_seq")),
+        LockSpec("resilience.faults",
+                 "consensus_specs_tpu.resilience.faults", "_lock",
+                 cls="FaultPlan", guards=("_rng",),
+                 note="seeded decision stream: every draw must be "
+                      "serialized or replay determinism dies; specs/"
+                      "_by_site are frozen after __init__"),
+        LockSpec("resilience.guard",
+                 "consensus_specs_tpu.resilience.guard", "_lock",
+                 cls="DifferentialGuard", guards=("_rng",)),
+        # -- utils -----------------------------------------------------
+        LockSpec("nodectx.stack", "consensus_specs_tpu.utils.nodectx",
+                 "_lock", guards=("_stack",)),
+    ),
+    roles=(
+        ThreadRole("block",
+                   note="the default thread: block processing, flush "
+                        "submit, merkle plan/commit, scenario stepping"),
+        ThreadRole("engine-worker", _PA, "_Worker._loop",
+                   note="runs a whole flush's batch-verify behind its "
+                        "FlushTicket (thread 'sigpipe-flush-engine')"),
+        ThreadRole("leg-worker", _PA, "_Worker._loop",
+                   note="runs the hash-to-G2 leg of an in-flight flush "
+                        "(thread 'sigpipe-flush-leg')"),
+        ThreadRole("gossip-drainer", _GP, "AdmissionPipeline._poll",
+                   note="whichever thread wins _drainer_lock; stages "
+                        "window N+1 and delivers window N in order"),
+        ThreadRole("watchdog-worker",
+                   "consensus_specs_tpu.resilience.supervisor",
+                   "_SiteWorker._loop",
+                   note="per-site daemon running watchdog'd dispatches; "
+                        "abandoned on deadline expiry"),
+    ),
+    handoffs=(
+        Handoff("flush.ticket", _PA, "FlushTicket",
+                note="THE join handle: result()/Leg.get() are the only "
+                     "ways a flush outcome crosses back"),
+        Handoff("flush.ticket_tls", _PA, "_TL",
+                note="thread-local slot carrying a worker's own "
+                     "in-flight ticket (writes_allowed)"),
+        Handoff("engine.jobs", _PA, "_jobs",
+                note="FIFO staging queue into the engine/leg workers; "
+                     "FIFO is the determinism contract"),
+        Handoff("watchdog.jobs",
+                "consensus_specs_tpu.resilience.supervisor", "_jobs",
+                note="site-worker job queue; the result box + done "
+                     "Event travel inside each job"),
+        Handoff("watchdog.done",
+                "consensus_specs_tpu.resilience.supervisor", "done",
+                note="the supervisor Event a watchdog'd caller waits "
+                     "on; expiry abandons the worker"),
+    ),
+)
+
+_LOCK_KINDS = ("lock", "rlock", "condition")
+
+if len(set(CONCURRENCY.lock_names())) != len(CONCURRENCY.locks):
+    raise RuntimeError("duplicate lock name in sites.CONCURRENCY")
+for _l in CONCURRENCY.locks:
+    if _l.kind not in _LOCK_KINDS:
+        raise RuntimeError(f"{_l.name}: bad lock kind {_l.kind!r}")
+    if not isinstance(_l.guards, tuple):
+        raise RuntimeError(f"{_l.name}: guards must be a tuple")
+if len({r.name for r in CONCURRENCY.roles}) != len(CONCURRENCY.roles):
+    raise RuntimeError("duplicate role name in sites.CONCURRENCY")
+if len({h.name for h in CONCURRENCY.handoffs}) != len(CONCURRENCY.handoffs):
+    raise RuntimeError("duplicate handoff name in sites.CONCURRENCY")
+
+
+def lock_spec(name: str) -> LockSpec:
+    """Look up one registered lock; KeyError on unregistered names."""
+    for spec in CONCURRENCY.locks:
+        if spec.name == name:
+            return spec
+    raise KeyError(name)
+
+
+def lock_names() -> tuple:
+    return CONCURRENCY.lock_names()

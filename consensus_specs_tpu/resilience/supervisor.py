@@ -43,6 +43,7 @@ from dataclasses import dataclass, field
 
 from ..sigpipe.metrics import METRICS
 from ..utils.clock import MONOTONIC
+from ..utils.locks import named_lock, named_rlock
 from . import faults
 from .incidents import INCIDENTS
 
@@ -135,7 +136,7 @@ class Supervisor:
         self._breakers: dict = {}
         self._workers: dict = {}
         self._worker_locks: dict = {}
-        self._lock = threading.RLock()
+        self._lock = named_rlock("resilience.supervisor")
         self._forced_scalar = False
 
     # -- administrative controls --------------------------------------
@@ -248,7 +249,8 @@ class Supervisor:
         with self._lock:
             site_lock = self._worker_locks.get(site)
             if site_lock is None:
-                site_lock = self._worker_locks[site] = threading.Lock()
+                site_lock = self._worker_locks[site] = named_lock(
+                    "resilience.site_worker")
         with site_lock:
             with self._lock:
                 worker = self._workers.get(site)

@@ -37,11 +37,11 @@ the expensive part and needs no cache state.
 from __future__ import annotations
 
 import hashlib
-import threading
 
 from ..crypto import curve as cv
 from ..crypto.bls12_381 import _load_pubkey
 from ..crypto.curve import DecodeError
+from ..utils.locks import named_rlock
 from . import pipeline_async
 from .metrics import METRICS
 
@@ -51,7 +51,7 @@ class PubkeyCache:
         self._cache: dict = {}
         self._max = max_size
         self._metrics = metrics
-        self._lock = threading.RLock()
+        self._lock = named_rlock("sigpipe.pubkey_cache")
 
     def get(self, pubkey) -> cv.Point:
         """Decompressed, validated G1 point for compressed bytes; raises
@@ -88,7 +88,7 @@ class AggregatePubkeyCache:
         self._cache: dict = {}
         self._max = max_size
         self._metrics = metrics
-        self._lock = threading.RLock()
+        self._lock = named_rlock("sigpipe.aggregate_cache")
         self._track_stack: list = []    # open insert-tracking scopes
 
     # -- insert tracking (txn/ rollback invalidation) -------------------

@@ -73,16 +73,16 @@ singletons or mostly full windows?".
 from __future__ import annotations
 
 import json
-import threading
 import time
 from contextlib import contextmanager
 
 from ..utils import nodectx
+from ..utils.locks import named_rlock
 
 
 class Metrics:
     def __init__(self, node_id: str | None = None):
-        self._lock = threading.RLock()
+        self._lock = named_rlock("sigpipe.metrics")
         self.node_id = node_id
         self.reset()
 

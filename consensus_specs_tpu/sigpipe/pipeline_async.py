@@ -62,6 +62,7 @@ import threading
 import time
 
 from ..utils import nodectx
+from ..utils.locks import named_condition, named_lock
 from .metrics import METRICS
 
 # states a ticket moves through (monotonic)
@@ -124,7 +125,7 @@ class FlushTicket:
         self._state = PENDING
         self._value = None
         self._error = None
-        self._lock = threading.Lock()
+        self._lock = named_lock("sigpipe.ticket")
         self._overlapped = False    # ran on a worker (submit sets it)
         self._submitted_ns = time.perf_counter_ns()
         self._started_ns = None
@@ -251,7 +252,7 @@ class _Worker:
     def __init__(self, name: str):
         self._jobs: queue.Queue = queue.Queue()
         self._pending = 0               # queued + running jobs
-        self._cv = threading.Condition()
+        self._cv = named_condition("sigpipe.worker_cv")
         self._thread = threading.Thread(
             target=self._loop, name=name, daemon=True)
         self._thread.start()
@@ -295,7 +296,7 @@ class _Worker:
 # dispatch overlap) are separate on purpose: a flush RUNNING on the
 # flush worker launches its hash leg on the leg worker, so one thread
 # for both would deadlock the leg behind its own flush
-_ENGINE_LOCK = threading.Lock()
+_ENGINE_LOCK = named_lock("sigpipe.engine")
 _FLUSH_WORKER: _Worker | None = None
 _LEG_WORKER: _Worker | None = None
 
@@ -388,7 +389,12 @@ def drain(timeout: float = 30.0) -> bool:
     when the caller re-reads shared state).  Returns False on timeout.
     """
     deadline = time.perf_counter() + timeout
-    for w in (_FLUSH_WORKER, _LEG_WORKER):
+    with _ENGINE_LOCK:
+        # snapshot under the engine lock: _worker() may be respawning a
+        # dead worker concurrently, and a torn read here would join an
+        # orphaned instance while jobs land on its replacement
+        workers = (_FLUSH_WORKER, _LEG_WORKER)
+    for w in workers:
         if w is None:
             continue
         if not w.join_idle(max(deadline - time.perf_counter(), 0.0)):

@@ -52,7 +52,6 @@ handlers are byte-for-byte the pre-txn code paths.
 from __future__ import annotations
 
 import functools
-import threading
 from contextlib import contextmanager
 
 from ..resilience import sites
@@ -60,6 +59,7 @@ from ..resilience.incidents import INCIDENTS
 from ..resilience.supervisor import dispatch
 from ..sigpipe.cache import AGGREGATES
 from ..sigpipe.metrics import METRICS
+from ..utils.locks import named_rlock
 from .journal import Journal, JournalEntry, Snapshot
 from .oracle import store_root
 from .overlay import OverlayDict, OverlaySet, StoreTransaction, clone_store
@@ -69,7 +69,7 @@ from .overlay import OverlayDict, OverlaySet, StoreTransaction, clone_store
 COMMIT_SITE = sites.site("txn.commit").name
 
 _ACTIVE = None
-_lock = threading.RLock()
+_lock = named_rlock("txn.active")
 
 
 class TxnManager:
@@ -155,10 +155,15 @@ def disable() -> None:
 
 
 def enabled() -> bool:
+    # speclint: disable=conc-unguarded-attr -- lock-free read of one
+    # reference: atomic under the GIL, and any answer racing an
+    # enable/disable was equally valid a microsecond either way
     return _ACTIVE is not None
 
 
 def active() -> TxnManager | None:
+    # speclint: disable=conc-unguarded-attr -- same atomic-read contract
+    # as enabled(); installers serialize on txn.active, readers do not
     return _ACTIVE
 
 
@@ -218,6 +223,10 @@ def transactional(fn):
 
     @functools.wraps(fn)
     def wrapper(self, store, *args, **kwargs):
+        # speclint: disable=conc-unguarded-attr -- THE handler hot path:
+        # one atomic reference read per fork-choice call; taking the
+        # rlock here would serialize every handler behind installs that
+        # happen a handful of times per process
         manager = _ACTIVE
         if manager is None or isinstance(store, StoreTransaction):
             return fn(self, store, *args, **kwargs)

@@ -30,7 +30,6 @@ reads the live store, only the journal and its snapshots.
 from __future__ import annotations
 
 import hashlib
-import threading
 from dataclasses import dataclass, field
 
 from ..resilience import sites
@@ -38,6 +37,7 @@ from ..resilience.faults import fire
 from ..resilience.incidents import INCIDENTS
 from ..sigpipe.metrics import METRICS
 from ..ssz import hash_tree_root
+from ..utils.locks import named_rlock
 from .oracle import store_root
 from .overlay import clone_store
 
@@ -90,7 +90,7 @@ class Journal:
         self._entries: list = []
         self._snapshots: list = []
         self._seq = 0
-        self._lock = threading.RLock()
+        self._lock = named_rlock("txn.journal")
 
     # -- the write-ahead half ------------------------------------------
     def append_intent(self, op: str, args, kwargs) -> JournalEntry:
@@ -115,19 +115,24 @@ class Journal:
 
     # -- snapshots ------------------------------------------------------
     def needs_anchor(self) -> bool:
-        return not self._snapshots
+        with self._lock:
+            return not self._snapshots
 
     def snapshot(self, store) -> bytes:
         """Clone `store` and address it by content; returns the root."""
         clone = clone_store(store)
         root = store_root(clone)
         with self._lock:
-            self._snapshots.append(Snapshot(self._seq, root, clone))
+            # capture the anchor seq under the lock: the incident below
+            # must name the seq this snapshot was actually taken at, not
+            # whatever a concurrent append_intent advanced it to
+            entry_seq = self._seq
+            self._snapshots.append(Snapshot(entry_seq, root, clone))
             while len(self._snapshots) > self.max_snapshots:
                 self._snapshots.pop(0)
         METRICS.inc("txn_snapshots")
         INCIDENTS.record("txn.journal", "snapshot",
-                         entry_seq=self._seq, root=root.hex())
+                         entry_seq=entry_seq, root=root.hex())
         return root
 
     def latest_snapshot(self) -> Snapshot | None:

@@ -1,0 +1,323 @@
+"""Named locks + the SPECLINT_TSAN runtime lock-order sanitizer.
+
+Every lock in the concurrency-scoped packages is constructed through
+:func:`named_lock` / :func:`named_rlock` / :func:`named_condition` with
+its canonical name from ``resilience/sites.py CONCURRENCY`` — speclint's
+lock-discipline pass fails on a bare ``threading.Lock()`` there, the
+same way the seam pass fails on an unregistered dispatch site.  With
+tracing off (the default) the constructors return the plain
+``threading`` primitives: zero wrapping, zero overhead.
+
+With ``SPECLINT_TSAN=1`` (the async/chaos suites — ``make chaos``,
+``make pipeline-chaos``) they return traced wrappers that record, per
+thread, which registered locks were held at every acquisition.  The
+:class:`LockTracer` then fails the run when
+
+* an observed acquisition order **contradicts the static graph** the
+  lock-order speclint pass derived from the source (the static model
+  says B-before-A somewhere, this thread just did A-then-B), or
+* both orders of the same lock pair are **observed at runtime** (a
+  real potential deadlock, whether or not the static pass saw either
+  side), or
+* an **unregistered lock name** participates (a named lock whose name
+  the CONCURRENCY registry does not know).
+
+This is the same keep-the-registry-honest wiring the differential
+guard provides for the kernels: the static model is only trustworthy
+while reality is checked against it.  Violations are recorded, not
+raised — raising inside an arbitrary ``acquire()`` on a worker thread
+would corrupt the very suites doing the observing — and asserted
+empty by a session-teardown gate in tests/conftest.py.
+
+Module-level imports are stdlib-only (``threading``/``os``), so
+``utils/nodectx.py`` and the other bottom-of-the-graph modules can use
+the constructors without import cycles; the registry and the static
+graph load lazily, first time tracing actually needs them.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+
+def tracing() -> bool:
+    """Whether named locks are constructed traced: the SPECLINT_TSAN
+    env var, or a `force_tracing` override (tests)."""
+    if _FORCED is not None:
+        return _FORCED
+    return os.environ.get("SPECLINT_TSAN", "") not in ("", "0")
+
+
+_FORCED: bool | None = None
+
+
+def force_tracing(on: bool | None) -> None:
+    """Override the environment for the current process (None = back to
+    the env).  Only affects locks constructed AFTER the call."""
+    global _FORCED
+    _FORCED = on
+
+
+class LockTracer:
+    """Records per-thread lock-acquisition sequences and checks them
+    against the static lock-order graph.
+
+    `static_edges` is a set of (before, after) registered-name pairs —
+    the sanctioned orders the speclint lock-order pass derived; its
+    transitive closure is the order relation observed acquisitions must
+    not contradict.  `registered` is the set of legal lock names.
+    """
+
+    def __init__(self, static_edges=None, registered=None):
+        self._mu = threading.Lock()     # guards everything below
+        self._held = threading.local()  # per-thread [(name, count), ...]
+        self.observed: dict = {}        # (a, b) -> first-seen detail
+        self.violations: list = []
+        if static_edges is None or registered is None:
+            derived_edges, derived_names = _repo_static_model()
+            static_edges = derived_edges if static_edges is None \
+                else static_edges
+            registered = derived_names if registered is None \
+                else registered
+        self.registered = frozenset(registered)
+        self._reach = _closure(static_edges)
+
+    # -- bookkeeping ---------------------------------------------------
+    def _stack(self) -> list:
+        stack = getattr(self._held, "stack", None)
+        if stack is None:
+            stack = self._held.stack = []
+        return stack
+
+    def _violate(self, kind: str, **detail) -> None:
+        detail["kind"] = kind
+        detail["thread"] = threading.current_thread().name
+        self.violations.append(detail)
+
+    def register_creation(self, name: str) -> None:
+        if name not in self.registered:
+            with self._mu:
+                self._violate(
+                    "unregistered-lock", lock=name,
+                    hint="declare it in resilience/sites.py CONCURRENCY")
+
+    def note_acquired(self, name: str) -> None:
+        """Called with the lock just taken by this thread."""
+        stack = self._stack()
+        for held_name, count in stack:
+            if held_name == name:       # reentrant re-acquire: no edge
+                stack[stack.index((held_name, count))] = (name, count + 1)
+                return
+        held = [h for h, _ in stack]
+        with self._mu:
+            for h in held:
+                edge = (h, name)
+                if edge not in self.observed:
+                    if name in self._reach.get(h, frozenset()) \
+                            and h in self._reach.get(name, frozenset()):
+                        pass    # statically cyclic pair: already a
+                        #         lock-order finding, don't double-report
+                    elif h in self._reach.get(name, frozenset()):
+                        self._violate(
+                            "order-contradiction", held=h, acquired=name,
+                            static_order=f"{name} -> {h}")
+                    elif (name, h) in self.observed:
+                        self._violate(
+                            "observed-reversal", held=h, acquired=name,
+                            first_seen=self.observed[(name, h)])
+                    self.observed[edge] = {
+                        "thread": threading.current_thread().name,
+                        "held": tuple(held)}
+        stack.append((name, 1))
+
+    def note_released(self, name: str) -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][0] == name:
+                held_name, count = stack[i]
+                if count > 1:
+                    stack[i] = (held_name, count - 1)
+                else:
+                    del stack[i]
+                return
+
+    def assert_clean(self) -> None:
+        if self.violations:
+            lines = "\n".join(f"  {v}" for v in self.violations)
+            raise AssertionError(
+                f"SPECLINT_TSAN: {len(self.violations)} lock-order "
+                f"violation(s):\n{lines}")
+
+
+def _closure(edges) -> dict:
+    reach: dict = {}
+    for a, b in edges:
+        if a != b:
+            reach.setdefault(a, set()).add(b)
+    changed = True
+    while changed:
+        changed = False
+        for a, outs in reach.items():
+            add = set()
+            for b in outs:
+                add |= reach.get(b, set())
+            add -= outs
+            if add:
+                outs |= add
+                changed = True
+    return {a: frozenset(outs) for a, outs in reach.items()}
+
+
+def _repo_static_model():
+    """(static edges, registered names) derived from this checkout:
+    the lock-order pass's graph plus the CONCURRENCY registry.  Falls
+    back to an empty graph when the analysis cannot run (installed
+    without sources) — the tracer then still checks unregistered
+    participation and observed reversals.
+
+    CRITICAL: this runs while `_TRACER_MU` is held, from whatever
+    module happened to construct the process's first traced lock — so
+    it must never import a package module that constructs named locks
+    (resilience/, sigpipe/, ...): the nested construction would
+    re-enter `_tracer()` and self-deadlock the sanitizer.  The
+    registry is therefore loaded STANDALONE by file path (the
+    analysis/registry.py discipline), and analysis/ itself is
+    stdlib-only."""
+    from pathlib import Path
+    root = Path(__file__).resolve().parents[2]
+    try:
+        from ..analysis import concurrency as _conc
+        edges = _conc.static_lock_edges(root)
+    except Exception:
+        edges = frozenset()
+    try:
+        from ..analysis.registry import load_registry
+        names = load_registry(root).lock_names()
+    except Exception:
+        names = ()
+    return edges, names
+
+
+class TracedLock:
+    """A named, traced Lock/RLock: every acquire/release updates the
+    tracer's per-thread held stack."""
+
+    def __init__(self, name: str, kind: str = "lock", tracer=None):
+        self.name = name
+        self.kind = kind
+        self._lock = threading.RLock() if kind == "rlock" \
+            else threading.Lock()
+        self._tracer = tracer if tracer is not None else _tracer()
+        self._tracer.register_creation(name)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            self._tracer.note_acquired(self.name)
+        return got
+
+    def release(self) -> None:
+        self._tracer.note_released(self.name)
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked() if hasattr(self._lock, "locked") \
+            else False
+
+
+class TracedCondition:
+    """A named, traced Condition over an RLock.  `wait`/`wait_for`
+    release the lock for the wait's duration and re-acquire after — the
+    tracer's held stack mirrors that, so edges taken on re-acquire
+    reflect what is really held across the wakeup."""
+
+    def __init__(self, name: str, tracer=None):
+        self.name = name
+        self.kind = "condition"
+        self._cond = threading.Condition()
+        self._tracer = tracer if tracer is not None else _tracer()
+        self._tracer.register_creation(name)
+
+    def acquire(self, *args):
+        got = self._cond.acquire(*args)
+        if got:
+            self._tracer.note_acquired(self.name)
+        return got
+
+    def release(self) -> None:
+        self._tracer.note_released(self.name)
+        self._cond.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def wait(self, timeout: float | None = None):
+        self._tracer.note_released(self.name)
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            self._tracer.note_acquired(self.name)
+
+    def wait_for(self, predicate, timeout: float | None = None):
+        self._tracer.note_released(self.name)
+        try:
+            return self._cond.wait_for(predicate, timeout)
+        finally:
+            self._tracer.note_acquired(self.name)
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+
+_TRACER: LockTracer | None = None
+# RLock as defense in depth: tracer construction runs user-visible code
+# (the static-model derivation above) under this mutex; a plain Lock
+# would turn any accidental reentry into a silent process hang
+_TRACER_MU = threading.RLock()
+
+
+def _tracer() -> LockTracer:
+    global _TRACER
+    with _TRACER_MU:
+        if _TRACER is None:
+            _TRACER = LockTracer()
+        return _TRACER
+
+
+def tracer() -> LockTracer | None:
+    """The process tracer, if any traced lock was ever constructed."""
+    return _TRACER
+
+
+def named_lock(name: str):
+    """A mutex registered under `name` in sites.CONCURRENCY: a plain
+    `threading.Lock` normally, a TracedLock under SPECLINT_TSAN=1."""
+    if tracing():
+        return TracedLock(name, "lock")
+    return threading.Lock()
+
+
+def named_rlock(name: str):
+    if tracing():
+        return TracedLock(name, "rlock")
+    return threading.RLock()
+
+
+def named_condition(name: str):
+    if tracing():
+        return TracedCondition(name)
+    return threading.Condition()

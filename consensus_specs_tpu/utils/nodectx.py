@@ -28,13 +28,15 @@ supported (and not needed: production wiring never installs a context;
 the simulation's determinism contract is single-scheduler anyway).
 
 This module sits at the bottom of the dependency graph on purpose: it
-imports nothing from the package, so both resilience/ and sigpipe/ can
-consult it without cycles.
+imports nothing from the package except the equally-bottom
+``utils/locks.py`` primitive layer (stdlib-only at module scope), so
+both resilience/ and sigpipe/ can consult it without cycles.
 """
 from __future__ import annotations
 
-import threading
 from contextlib import contextmanager
+
+from .locks import named_rlock
 
 
 class NodeContext:
@@ -56,7 +58,7 @@ class NodeContext:
         return f"NodeContext({self.node_id!r})"
 
 
-_lock = threading.RLock()
+_lock = named_rlock("nodectx.stack")
 _stack: list = []
 
 

@@ -4,13 +4,17 @@ isolation, and txn-purity contracts (consensus_specs_tpu/analysis/).
 
     python scripts/speclint.py                # lint the repo, human output
     python scripts/speclint.py --json         # machine-readable findings
+    python scripts/speclint.py --pass lock-order --pass lock-discipline
+    python scripts/speclint.py --list-passes  # the pass vocabulary
     python scripts/speclint.py path.py ...    # lint specific files (all
                                               # passes apply — fixture mode)
 
 Exit status: 0 clean, 1 findings, 2 usage/internal error.  The full-repo
 run is stdlib-ast only and budgeted well under 10 s, so it rides in
 `make speclint` / `make test-quick` and as a pytest gate
-(tests/test_speclint.py).  Rule catalogue: docs/analysis.md.
+(tests/test_speclint.py).  JSON output carries `schema_version` so CI
+consumers (the vector-factory pipeline) can parse it stably.  Rule
+catalogue: docs/analysis.md.
 """
 import argparse
 import json
@@ -21,7 +25,11 @@ from pathlib import Path
 REPO_ROOT = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO_ROOT))
 
-from consensus_specs_tpu.analysis import RULES, run_speclint  # noqa: E402
+from consensus_specs_tpu.analysis import (  # noqa: E402
+    RULES, pass_names, run_speclint)
+
+# bump when the JSON document's shape changes incompatibly
+SCHEMA_VERSION = 1
 
 
 def main(argv=None) -> int:
@@ -37,25 +45,38 @@ def main(argv=None) -> int:
                     help="repository root (default: this checkout)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalogue and exit")
+    ap.add_argument("--list-passes", action="store_true",
+                    help="print the pass names --pass accepts and exit")
+    ap.add_argument("--pass", action="append", dest="passes",
+                    metavar="NAME",
+                    help="run only this pass (repeatable; default: all)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         for rule, desc in sorted(RULES.items()):
             print(f"{rule:28s} {desc}")
         return 0
+    if args.list_passes:
+        for name in pass_names():
+            print(name)
+        return 0
 
     t0 = time.perf_counter()
     try:
-        findings = run_speclint(args.root, args.paths or None)
+        findings = run_speclint(args.root, args.paths or None,
+                                passes=args.passes)
     except (OSError, SyntaxError, RuntimeError) as e:
         # RuntimeError: resilience/sites.py's own import-time structural
-        # validation (duplicate name, bad tier, noteless UNIT entry)
+        # validation (duplicate name, bad tier, noteless UNIT entry) —
+        # or an unknown --pass name
         print(f"speclint: error: {e}", file=sys.stderr)
         return 2
     elapsed = time.perf_counter() - t0
 
     if args.as_json:
         print(json.dumps({
+            "schema_version": SCHEMA_VERSION,
+            "passes": list(args.passes or pass_names()),
             "findings": [f.to_json() for f in findings],
             "count": len(findings),
             "elapsed_s": round(elapsed, 3),

@@ -119,6 +119,20 @@ def pytest_collection_modifyitems(config, items):
 
 
 @pytest.fixture(autouse=True, scope="session")
+def _locktrace_gate():
+    """SPECLINT_TSAN=1 (make chaos / make pipeline-chaos): every named
+    lock is constructed traced, and this gate fails the session if any
+    observed acquisition order contradicted the static lock graph, both
+    orders of a pair were observed, or an unregistered lock
+    participated (utils/locks.py LockTracer)."""
+    yield
+    from consensus_specs_tpu.utils import locks
+    tracer = locks.tracer()
+    if tracer is not None:
+        tracer.assert_clean()
+
+
+@pytest.fixture(autouse=True, scope="session")
 def _configure(request):
     from consensus_specs_tpu.test_infra import context
     context.DEFAULT_TEST_PRESET = request.config.getoption("--preset")

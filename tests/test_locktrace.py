@@ -1,0 +1,271 @@
+"""The SPECLINT_TSAN runtime lock-order sanitizer (utils/locks.py).
+
+Three layers:
+
+* tracer unit tier — a private LockTracer catches a deliberately
+  reversed acquisition against a static order, an observed runtime
+  reversal with no static knowledge, unregistered participation, and
+  stays quiet for legal reentrancy.
+* wiring tier — the named constructors return plain threading
+  primitives with tracing off and traced wrappers with it on, and the
+  default tracer derives the real static graph (drainer-before-ingress
+  must be in it).
+* integration tier — the async flush engine and a traced condition
+  variable run real overlapped work under forced tracing with zero
+  violations, proving the sanitizer is quiet exactly when the static
+  model says the code is clean (the loud case is pinned by the unit
+  tier, so together they show the gate can both pass and fail).
+"""
+import threading
+
+import pytest
+
+from consensus_specs_tpu.resilience import sites
+from consensus_specs_tpu.utils import locks
+
+
+def private_tracer(static_edges=(), registered=("a", "b", "x", "y", "r")):
+    return locks.LockTracer(static_edges=set(static_edges),
+                            registered=set(registered))
+
+
+# ---------------------------------------------------------------------------
+# tracer unit tier
+# ---------------------------------------------------------------------------
+
+def test_reversed_acquisition_contradicts_static_graph():
+    """THE sanitizer pin: the static graph sanctions a->b, a thread
+    acquires b-then-a, the tracer records an order-contradiction."""
+    tr = private_tracer(static_edges={("a", "b")})
+    a = locks.TracedLock("a", "lock", tracer=tr)
+    b = locks.TracedLock("b", "lock", tracer=tr)
+    with a:
+        with b:
+            pass
+    assert tr.violations == []          # the sanctioned order is quiet
+    with b:
+        with a:
+            pass
+    kinds = [v["kind"] for v in tr.violations]
+    assert kinds == ["order-contradiction"]
+    assert tr.violations[0]["held"] == "b"
+    assert tr.violations[0]["acquired"] == "a"
+    with pytest.raises(AssertionError):
+        tr.assert_clean()
+
+
+def test_observed_reversal_without_static_knowledge():
+    """Both orders of a pair observed at runtime is a violation even
+    when the static pass knew neither edge — the tracer catches what
+    interprocedural analysis must guess."""
+    tr = private_tracer()
+    x = locks.TracedLock("x", "lock", tracer=tr)
+    y = locks.TracedLock("y", "lock", tracer=tr)
+    with x:
+        with y:
+            pass
+    with y:
+        with x:
+            pass
+    assert [v["kind"] for v in tr.violations] == ["observed-reversal"]
+
+
+def test_unregistered_lock_participation_is_a_violation():
+    tr = private_tracer(registered={"a"})
+    locks.TracedLock("not.registered", "lock", tracer=tr)
+    assert [v["kind"] for v in tr.violations] == ["unregistered-lock"]
+    assert tr.violations[0]["lock"] == "not.registered"
+
+
+def test_rlock_reentrancy_and_repeat_edges_are_quiet():
+    tr = private_tracer(static_edges={("a", "b")})
+    r = locks.TracedLock("r", "rlock", tracer=tr)
+    a = locks.TracedLock("a", "lock", tracer=tr)
+    b = locks.TracedLock("b", "lock", tracer=tr)
+    with r:
+        with r:                 # reentrant: no self-edge, no violation
+            pass
+    for _ in range(3):          # a repeated sanctioned edge stays one
+        with a:
+            with b:
+                pass
+    assert tr.violations == []
+    assert ("a", "b") in tr.observed
+
+
+def test_transitive_contradiction_via_static_closure():
+    """static a->b->c: acquiring c then a contradicts through the
+    closure, not just the direct edges."""
+    tr = private_tracer(static_edges={("a", "b"), ("b", "c")},
+                        registered={"a", "b", "c"})
+    a = locks.TracedLock("a", "lock", tracer=tr)
+    c = locks.TracedLock("c", "lock", tracer=tr)
+    with c:
+        with a:
+            pass
+    assert [v["kind"] for v in tr.violations] == ["order-contradiction"]
+
+
+def test_edges_are_per_thread():
+    """A lock held on thread 1 imposes no order on thread 2's
+    acquisitions — held stacks are thread-local."""
+    tr = private_tracer()
+    x = locks.TracedLock("x", "lock", tracer=tr)
+    y = locks.TracedLock("y", "lock", tracer=tr)
+    seen = []
+
+    def other():
+        with y:
+            seen.append(True)
+
+    with x:
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+    assert seen == [True]
+    assert ("x", "y") not in tr.observed
+    assert tr.violations == []
+
+
+# ---------------------------------------------------------------------------
+# wiring tier
+# ---------------------------------------------------------------------------
+
+def test_named_constructors_plain_when_tracing_off():
+    locks.force_tracing(False)
+    try:
+        assert isinstance(locks.named_lock("sigpipe.engine"),
+                          type(threading.Lock()))
+        assert isinstance(locks.named_rlock("txn.active"),
+                          type(threading.RLock()))
+        assert isinstance(locks.named_condition("sigpipe.worker_cv"),
+                          threading.Condition)
+    finally:
+        locks.force_tracing(None)
+
+
+def test_named_constructors_traced_when_forced():
+    locks.force_tracing(True)
+    try:
+        lk = locks.named_lock("sigpipe.engine")
+        assert isinstance(lk, locks.TracedLock)
+        cv = locks.named_condition("sigpipe.worker_cv")
+        assert isinstance(cv, locks.TracedCondition)
+    finally:
+        locks.force_tracing(None)
+
+
+def test_default_static_model_matches_the_repo():
+    """The tracer's derived static graph contains the two contractual
+    orders: gossip drainer-before-ingress and watchdog
+    site-worker-before-supervisor."""
+    edges, names = locks._repo_static_model()
+    assert ("gossip.drainer", "gossip.ingress") in edges
+    assert ("resilience.site_worker", "resilience.supervisor") in edges
+    assert set(names) == set(sites.lock_names())
+
+
+def test_traced_condition_wait_releases_for_edge_purposes():
+    """While a condition wait sleeps, the cv is NOT held: an acquire on
+    the waiting thread after wakeup re-establishes it, and a second
+    thread acquiring other locks during the wait sees no cv edge."""
+    tr = private_tracer(registered={"cv", "x"})
+    cv = locks.TracedCondition("cv", tracer=tr)
+    x = locks.TracedLock("x", "lock", tracer=tr)
+    woke = []
+
+    def waiter():
+        with cv:
+            cv.wait_for(lambda: woke, timeout=5.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    with x:                     # no cv held here: no edge recorded
+        pass
+    with cv:
+        woke.append(True)
+        cv.notify_all()
+    t.join()
+    assert tr.violations == []
+    assert ("cv", "x") not in tr.observed
+
+
+# ---------------------------------------------------------------------------
+# integration tier: real overlapped work under forced tracing
+# ---------------------------------------------------------------------------
+
+def test_async_engine_runs_clean_under_tracing():
+    """Real double-buffered flushes through the engine + leg workers
+    with every (new) lock traced: zero violations, and the engine's
+    ticket joins still deliver."""
+    from consensus_specs_tpu.sigpipe import pipeline_async
+    tracer_before = locks.tracer()
+    before = len(tracer_before.violations) if tracer_before else 0
+    locks.force_tracing(True)
+    pipeline_async.enable()
+    try:
+        tickets = [pipeline_async.submit(lambda i=i: i * i, f"t{i}")
+                   for i in range(8)]
+        legs = [pipeline_async.launch_leg(lambda i=i: -i, f"l{i}")
+                for i in range(4)]
+        assert [t.result(timeout=10.0) for t in tickets] == \
+            [i * i for i in range(8)]
+        assert [leg.get() for leg in legs] == [0, -1, -2, -3]
+        assert pipeline_async.drain(timeout=10.0)
+    finally:
+        pipeline_async.reset()
+        locks.force_tracing(None)
+    tracer = locks.tracer()
+    assert tracer is not None           # traced tickets were built
+    assert len(tracer.violations) == before, tracer.violations
+
+
+def test_gossip_submit_poll_runs_clean_under_tracing():
+    """The drainer/ingress pair exercised for real: concurrent submits
+    against a stub spec, drained, with traced locks and no
+    contradiction of the static drainer-before-ingress order."""
+    from consensus_specs_tpu.gossip.pipeline import (AdmissionPipeline,
+                                                     GossipConfig)
+    from consensus_specs_tpu.utils.clock import ManualClock
+
+    class Attn:
+        def __init__(self, i):
+            self.i = i
+
+    class StubSpec:
+        fork = "stub"
+
+        def on_attestation(self, store, att, is_from_block=False):
+            return None
+
+    import consensus_specs_tpu.gossip.pipeline as gp
+    orig = gp.hash_tree_root
+    gp.hash_tree_root = lambda payload: \
+        getattr(payload, "i", 0).to_bytes(32, "little")
+    tracer_before = locks.tracer()
+    before = len(tracer_before.violations) if tracer_before else 0
+    locks.force_tracing(True)
+    try:
+        pipe = AdmissionPipeline(
+            StubSpec(), object(),
+            GossipConfig(scalar_only=True, window_s=0.0),
+            clock=ManualClock())
+        threads = [threading.Thread(
+            target=lambda base=base: [
+                pipe.submit("attestation", Attn(base * 100 + j),
+                            peer=f"p{base}")
+                for j in range(20)]) for base in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        verdicts = pipe.drain()
+        assert len(verdicts) == 80
+        assert all(v.status == "accepted" for v in verdicts)
+    finally:
+        gp.hash_tree_root = orig
+        locks.force_tracing(None)
+    tracer = locks.tracer()
+    assert tracer is not None
+    assert len(tracer.violations) == before, tracer.violations
+    assert ("gossip.drainer", "gossip.ingress") in tracer.observed

@@ -17,11 +17,13 @@ import subprocess
 import sys
 import textwrap
 import time
+import types
 from pathlib import Path
 
 import pytest
 
-from consensus_specs_tpu.analysis import RULES, run_speclint
+from consensus_specs_tpu.analysis import (RULES, pass_names,
+                                          run_speclint)
 from consensus_specs_tpu.resilience import sites
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
@@ -282,6 +284,340 @@ def test_hostsync_barrier_functions_are_exempt():
 
 
 # ---------------------------------------------------------------------------
+# concurrency passes: lock discipline, lock order, thread escape
+# ---------------------------------------------------------------------------
+
+def _fake_lock(name, attr, cls="", kind="lock", guards=()):
+    return types.SimpleNamespace(name=name, module="", attr=attr,
+                                 cls=cls, kind=kind, guards=guards,
+                                 note="")
+
+
+def _fake_registry(locks=(), roles=(), handoffs=()):
+    conc = types.SimpleNamespace(locks=locks, roles=roles,
+                                 handoffs=handoffs)
+    return types.SimpleNamespace(CONCURRENCY=conc, HOST_SYNC_BARRIERS=())
+
+
+def _conc_ctx(tmp_path, source, registry):
+    from consensus_specs_tpu.analysis import load_context
+    path = tmp_path / "fixture.py"
+    path.write_text(textwrap.dedent(source))
+    ctx = load_context(REPO_ROOT, [path])
+    ctx.registry = registry
+    return ctx
+
+
+def test_bare_threading_lock_is_a_finding(tmp_path):
+    """Locks in the concurrency-scoped packages must be constructed
+    through the named utils.locks constructors so the registry and the
+    TSAN tracer can see them."""
+    findings = lint_snippet(tmp_path, """\
+        import threading
+
+        LOCK = threading.Lock()
+        COND = threading.Condition()
+    """)
+    assert rules_of(findings) == ["conc-unregistered-lock"] * 2
+    assert findings[0].line == 3
+
+
+def test_unregistered_named_lock_is_a_finding(tmp_path):
+    findings = lint_snippet(tmp_path, """\
+        from consensus_specs_tpu.utils.locks import named_lock
+
+        LOCK = named_lock("no.such.lock")
+    """)
+    assert rules_of(findings) == ["conc-unregistered-lock"]
+    assert "no.such.lock" in findings[0].message
+
+
+def test_lock_discipline_unguarded_access(tmp_path):
+    """A guarded attribute read outside the lock (and outside the
+    under-lock call closure) is a finding; locked and closure-reached
+    accesses are not."""
+    from consensus_specs_tpu.analysis import concurrency
+    ctx = _conc_ctx(tmp_path, """\
+        class Thing:
+            def __init__(self):
+                self._state = 0
+
+            def locked_write(self):
+                with self._lock:
+                    self._state += 1
+                    self._helper()
+
+            def _helper(self):
+                self._state += 2    # reached only from under the lock
+
+            def bad_read(self):
+                return self._state
+    """, _fake_registry(locks=(
+        _fake_lock("fix.thing", "_lock", cls="Thing", kind="rlock",
+                   guards=("_state",)),)))
+    findings = concurrency.run_lock_discipline(ctx)
+    assert rules_of(findings) == ["conc-unguarded-attr"]
+    assert findings[0].line == 14
+    assert "fix.thing" in findings[0].message
+
+
+def test_lock_discipline_disable_suppresses(tmp_path):
+    from consensus_specs_tpu.analysis import concurrency
+    ctx = _conc_ctx(tmp_path, """\
+        class Thing:
+            def ok(self):
+                # speclint: disable=conc-unguarded-attr -- atomic read
+                return self._state
+    """, _fake_registry(locks=(
+        _fake_lock("fix.thing", "_lock", cls="Thing",
+                   guards=("_state",)),)))
+    findings = concurrency.run_lock_discipline(ctx)
+    sf = ctx.files[0]
+    assert [f for f in findings if not sf.suppressed(f.rule, f.line)] \
+        == []
+
+
+def test_lock_order_cycle_on_synthetic_ab_ba(tmp_path):
+    """THE deadlock pin: with A: with B in one path, with B: with A in
+    another — the static graph has a cycle."""
+    from consensus_specs_tpu.analysis import concurrency
+    ctx = _conc_ctx(tmp_path, """\
+        _A = object()
+        _B = object()
+
+        def ab():
+            with _A:
+                with _B:
+                    pass
+
+        def ba():
+            with _B:
+                with _A:
+                    pass
+    """, _fake_registry(locks=(_fake_lock("fix.a", "_A"),
+                               _fake_lock("fix.b", "_B"))))
+    findings = concurrency.run_lock_order(ctx)
+    assert rules_of(findings) == ["conc-lock-order-cycle"]
+    assert "fix.a" in findings[0].message
+    assert "fix.b" in findings[0].message
+
+
+def test_lock_order_cycle_in_multi_item_with(tmp_path):
+    """`with A, B:` acquires A first — reversing it elsewhere is the
+    same deadlock as nested withs and must not slip the graph."""
+    from consensus_specs_tpu.analysis import concurrency
+    ctx = _conc_ctx(tmp_path, """\
+        _A = object()
+        _B = object()
+
+        def ab():
+            with _A, _B:
+                pass
+
+        def ba():
+            with _B:
+                with _A:
+                    pass
+    """, _fake_registry(locks=(_fake_lock("fix.a", "_A"),
+                               _fake_lock("fix.b", "_B"))))
+    findings = concurrency.run_lock_order(ctx)
+    assert rules_of(findings) == ["conc-lock-order-cycle"]
+
+
+def test_tuple_target_reported_once_and_tree_unmutated(tmp_path):
+    """A tuple-unpack write to a guarded attr is ONE finding, and the
+    walker must not append to the live ast.Assign.targets (the tree is
+    shared by every pass and re-walked)."""
+    import ast as ast_mod
+    from consensus_specs_tpu.analysis import concurrency
+    ctx = _conc_ctx(tmp_path, """\
+        class Thing:
+            def bad(self):
+                self._state, other = 1, 2
+    """, _fake_registry(locks=(
+        _fake_lock("fix.thing", "_lock", cls="Thing",
+                   guards=("_state",)),)))
+    findings = concurrency.run_lock_discipline(ctx)
+    assert rules_of(findings) == ["conc-unguarded-attr"]
+    assign = next(n for n in ast_mod.walk(ctx.files[0].tree)
+                  if isinstance(n, ast_mod.Assign))
+    assert len(assign.targets) == 1     # still just the Tuple
+
+
+def test_lock_order_interprocedural_cycle(tmp_path):
+    """The edge hides behind a call: with A held, f() is called and f
+    acquires B — while another path nests them the other way."""
+    from consensus_specs_tpu.analysis import concurrency
+    ctx = _conc_ctx(tmp_path, """\
+        _A = object()
+        _B = object()
+
+        def takes_b():
+            with _B:
+                pass
+
+        def ab():
+            with _A:
+                takes_b()
+
+        def ba():
+            with _B:
+                with _A:
+                    pass
+    """, _fake_registry(locks=(_fake_lock("fix.a", "_A"),
+                               _fake_lock("fix.b", "_B"))))
+    findings = concurrency.run_lock_order(ctx)
+    assert rules_of(findings) == ["conc-lock-order-cycle"]
+
+
+def test_lock_order_nonreentrant_self_edge(tmp_path):
+    """A plain (non-rlock) lock re-acquired while held — lexically or
+    through a call — is a guaranteed self-deadlock."""
+    from consensus_specs_tpu.analysis import concurrency
+    ctx = _conc_ctx(tmp_path, """\
+        _A = object()
+
+        def inner():
+            with _A:
+                pass
+
+        def outer():
+            with _A:
+                inner()
+    """, _fake_registry(locks=(_fake_lock("fix.a", "_A", kind="lock"),)))
+    findings = concurrency.run_lock_order(ctx)
+    assert rules_of(findings) == ["conc-lock-order-cycle"]
+    assert "self-deadlock" in findings[0].message
+
+
+def test_lock_order_rlock_self_edge_is_legal(tmp_path):
+    from consensus_specs_tpu.analysis import concurrency
+    ctx = _conc_ctx(tmp_path, """\
+        _A = object()
+
+        def inner():
+            with _A:
+                pass
+
+        def outer():
+            with _A:
+                inner()
+    """, _fake_registry(locks=(_fake_lock("fix.a", "_A",
+                                          kind="rlock"),)))
+    assert concurrency.run_lock_order(ctx) == []
+
+
+def test_thread_escape_unguarded_worker_mutation(tmp_path):
+    """State mutated from a worker role's entry point must be
+    lock-guarded or a registered handoff; thread-local/handoff writes
+    and under-lock writes pass."""
+    from consensus_specs_tpu.analysis import concurrency
+    role = types.SimpleNamespace(name="worker", module="",
+                                 func="Worker._loop", note="")
+    handoff = types.SimpleNamespace(name="fix.tls", module="",
+                                    attr="_TL", note="")
+    ctx = _conc_ctx(tmp_path, """\
+        _A = object()
+        _TL = object()
+        _SHARED = {}
+
+        class Worker:
+            def _loop(self):
+                _TL.ticket = 1          # registered handoff: fine
+                with _A:
+                    self.guarded = 2    # lock-guarded: fine
+                self.naked = 3          # finding
+                _SHARED["k"] = 4        # finding
+
+            def helper(self):
+                pass
+    """, _fake_registry(locks=(_fake_lock("fix.a", "_A"),),
+                        roles=(role,), handoffs=(handoff,)))
+    findings = concurrency.run_thread_escape(ctx)
+    assert rules_of(findings) == ["conc-thread-escape"] * 2
+    assert [f.line for f in findings] == [10, 11]
+    assert "worker" in findings[0].message
+
+
+def test_real_registry_static_graph_is_cycle_free():
+    """The acceptance pin: the repo's own static lock-acquisition graph
+    has no cycle, and contains the two contractual orders."""
+    from consensus_specs_tpu.analysis import concurrency
+    edges = concurrency.static_lock_edges(REPO_ROOT)
+    assert ("gossip.drainer", "gossip.ingress") in edges
+    assert ("resilience.site_worker", "resilience.supervisor") in edges
+    # acyclic: Kahn peel-off consumes every node
+    nodes = {n for e in edges for n in e}
+    remaining = set(edges)
+    while True:
+        sinks = nodes - {a for a, _ in remaining}
+        if not sinks:
+            break
+        nodes -= sinks
+        remaining = {(a, b) for a, b in remaining if b not in sinks}
+    assert not remaining, f"static lock graph has a cycle: {remaining}"
+
+
+def test_concurrency_registry_liveness():
+    """Every CONCURRENCY lock resolves to a named_* binding, every role
+    to its entry point, every handoff/HOST_SYNC_BARRIERS row to code —
+    and a fake dead entry IS caught (the dead-entry check can fail)."""
+    from consensus_specs_tpu.analysis import concurrency, load_context
+    ctx = load_context(REPO_ROOT)
+    assert [f for f in concurrency.run_lock_discipline(ctx)
+            if f.rule == "registry-dead-entry"] == []
+    # now poison the registry copy with a dead lock + dead role
+    real = ctx.registry.CONCURRENCY
+    dead_lock = types.SimpleNamespace(
+        name="ghost.lock", module="consensus_specs_tpu.txn",
+        attr="_ghost", cls="", kind="lock", guards=(), note="")
+    dead_role = types.SimpleNamespace(
+        name="ghost-role", module="consensus_specs_tpu.txn",
+        func="Ghost._loop", note="")
+    ctx2 = load_context(REPO_ROOT)
+    ctx2.registry = types.SimpleNamespace(
+        CONCURRENCY=types.SimpleNamespace(
+            locks=real.locks + (dead_lock,),
+            roles=real.roles + (dead_role,),
+            handoffs=real.handoffs),
+        HOST_SYNC_BARRIERS=ctx.registry.HOST_SYNC_BARRIERS)
+    dead = [f for f in concurrency.run_lock_discipline(ctx2)
+            if f.rule == "registry-dead-entry"]
+    assert len(dead) == 2
+    assert any("ghost.lock" in f.message for f in dead)
+    assert any("ghost-role" in f.message for f in dead)
+
+
+def test_every_registered_lock_constructed_with_its_name():
+    """Code <-> registry binding: each LockSpec's owning module really
+    constructs `attr = named_*(\"<name>\")` (what makes the TSAN
+    tracer's registered-name check meaningful)."""
+    import ast as ast_mod
+    for spec in sites.CONCURRENCY.locks:
+        rel = Path(spec.module.replace(".", "/") + ".py")
+        path = REPO_ROOT / rel
+        if not path.exists():
+            path = REPO_ROOT / spec.module.replace(".", "/") / \
+                "__init__.py"
+        assert path.exists(), f"{spec.name}: module file missing"
+        assert f'"{spec.name}"' in path.read_text(), \
+            f"{spec.name}: no named_* construction in {rel}"
+        ast_mod.parse(path.read_text())
+
+
+def test_pass_filter_and_names():
+    names = pass_names()
+    assert names == ("seams", "bypass", "determinism", "globals",
+                     "txnpurity", "hostsync", "lock-discipline",
+                     "lock-order", "thread-escape")
+    # a filtered run executes only the named pass
+    findings = run_speclint(REPO_ROOT, passes=["lock-order"])
+    assert findings == []
+    with pytest.raises(RuntimeError, match="unknown pass"):
+        run_speclint(REPO_ROOT, passes=["no-such-pass"])
+
+
+# ---------------------------------------------------------------------------
 # registry tier: the chaos tuples derive, fakes fail, structure holds
 # ---------------------------------------------------------------------------
 
@@ -357,7 +693,8 @@ def test_repo_is_clean_and_fast():
 @pytest.mark.slow
 def test_cli_exit_codes(tmp_path):
     """`scripts/speclint.py`: exit 0 on a clean tree, 1 with findings,
-    and --json emits a machine-readable document."""
+    --json emits a schema-versioned machine-readable document, and the
+    --pass/--list-passes filters work."""
     script = str(REPO_ROOT / "scripts" / "speclint.py")
     clean = subprocess.run([sys.executable, script],
                            capture_output=True, text=True)
@@ -371,5 +708,26 @@ def test_cli_exit_codes(tmp_path):
     assert dirty.returncode == 1
     import json
     doc = json.loads(dirty.stdout)
+    assert doc["schema_version"] == 1
     assert doc["count"] == 1
     assert doc["findings"][0]["rule"] == "global-mutable-state"
+    assert set(doc["passes"]) == set(pass_names())
+
+    listing = subprocess.run([sys.executable, script, "--list-passes"],
+                             capture_output=True, text=True)
+    assert listing.returncode == 0
+    assert listing.stdout.split() == list(pass_names())
+
+    # --pass filters: the globals finding vanishes under lock-order only
+    filtered = subprocess.run(
+        [sys.executable, script, "--json", "--pass", "lock-order",
+         str(bad)],
+        capture_output=True, text=True)
+    doc = json.loads(filtered.stdout)
+    assert filtered.returncode == 0 and doc["count"] == 0
+    assert doc["passes"] == ["lock-order"]
+
+    bogus = subprocess.run([sys.executable, script, "--pass", "nope"],
+                           capture_output=True, text=True)
+    assert bogus.returncode == 2
+    assert "unknown pass" in bogus.stderr
