@@ -13,8 +13,8 @@ RUNNERS := shuffling ssz_static operations epoch_processing sanity bls \
 	forks merkle_proof networking kzg_7594 random light_client sync
 
 .PHONY: test test-quick test-kernels tier1 chaos recovery-chaos \
-	scenario-chaos pipeline-chaos shard-verify lint speclint native \
-	pyspec bench \
+	kill-drill scenario-chaos pipeline-chaos shard-verify lint \
+	speclint native pyspec bench \
 	gossip-bench txn-bench msm-bench merkle-bench scenario-bench \
 	multichip-bench pipeline-bench gen_all detect_errors \
 	$(addprefix gen_,$(RUNNERS))
@@ -80,13 +80,29 @@ chaos:
 		$(PYTHON) -m pytest tests/test_chaos.py -q --kernel-tiers
 
 # crash-anywhere recovery tier alone (txn/): seeded kills mid-handler /
-# mid-commit / mid-journal-write, recovered store byte-identical to the
-# never-crashed oracle
+# mid-commit / mid-journal-write / mid-fsync over a REAL on-disk
+# DurableJournal (reopened cold for every recovery), the durable-format
+# unit tier (torn tails, rotation, compaction, codec), and the
+# process-boundary SIGKILL drill — recovered stores byte-identical to
+# the never-crashed oracle throughout
 recovery-chaos:
 	env JAX_PLATFORMS=cpu SPECLINT_TSAN=1 \
 		CHAOS_SEED=$${CHAOS_SEED:-20260803} \
 		$(PYTHON) -m pytest tests/test_chaos.py tests/test_txn.py \
-		-k "txn or crash or torn or recover" -q --kernel-tiers
+		-k "txn or crash or torn or recover or durable" -q --kernel-tiers
+	env JAX_PLATFORMS=cpu SPECLINT_TSAN=1 \
+		$(PYTHON) -m pytest tests/test_txn_durable.py \
+		tests/test_kill_drill.py -q --kernel-tiers
+
+# the subprocess SIGKILL drill alone (scripts/kill_drill.py): spawn a
+# node over a durable journal, SIGKILL it at each seeded barrier family
+# (mid-mutate / mid-apply / mid-journal-write / mid-fsync), restart in
+# a fresh process, recover from disk, and assert store-root convergence
+# with the never-crashed oracle; plus a rotation+compaction soak.
+# KILL_DRILL_ARGS=--quick runs one kill per family.
+kill-drill:
+	env JAX_PLATFORMS=cpu $(PYTHON) scripts/kill_drill.py \
+		$(KILL_DRILL_ARGS)
 
 # async flush engine slow tier under the runtime lock sanitizer: the
 # full overlapped-flush fault matrix with every named lock traced, so
@@ -136,7 +152,9 @@ gossip-bench:
 	$(PYTHON) bench.py gossip
 
 # transactional-store commit overhead alone (txn/): asserts < 10% added
-# latency on native-BLS on_block replays with WAL journaling on
+# latency on native-BLS on_block replays with WAL journaling on, then
+# measures the DURABLE journal per fsync policy (append+commit µs/op,
+# fsync counts, recovery replay ops/s) and emits TXN_r01.json
 txn-bench:
 	$(PYTHON) bench.py txn
 

@@ -1108,13 +1108,72 @@ def bench_txn():
     if not stub:
         assert overhead_pct < 10.0, \
             f"txn commit overhead {overhead_pct:.2f}% >= 10%"
-    return {
+
+    # -- durable journal leg: append latency + recovery replay rate per
+    # fsync policy (on_tick commits: the smallest real handler, so the
+    # number isolates the journal's own cost), emitted as TXN_r01.json
+    import shutil
+    import tempfile
+
+    from consensus_specs_tpu.sigpipe import METRICS as _M
+
+    appends = int(os.environ.get("BENCH_TXN_APPENDS", "256"))
+    durable = {}
+    for policy in ("always", "marker_only", "never"):
+        mark(f"durable journal x{appends} commits, fsync={policy} ...")
+        workdir = tempfile.mkdtemp(prefix=f"txnbench-{policy}-")
+        try:
+            _M.reset()
+            journal = txn.DurableJournal(workdir, fsync_policy=policy,
+                                         segment_bytes=1 << 18)
+            store = txn.clone_store(base_store)
+            base_time = int(store.time)
+            txn.enable(journal=journal, snapshot_interval=1 << 30)
+            t0 = time.perf_counter()
+            for i in range(appends):
+                spec.on_tick(store, base_time + i + 1)
+            append_s = time.perf_counter() - t0
+            txn.disable()
+            journal.close()
+            fsyncs = _M.count("txn_journal_fsyncs")
+            reopened = txn.open_dir(workdir)
+            t0 = time.perf_counter()
+            recovered = txn.recover(spec, reopened)
+            recover_s = time.perf_counter() - t0
+            replayed = len(reopened.committed_entries())
+            assert txn.store_root(recovered) == txn.store_root(store), \
+                f"durable recovery diverged under fsync={policy}"
+            reopened.close()
+            durable[policy] = {
+                "append_commit_us_per_op":
+                    round(append_s / appends * 1e6, 1),
+                "fsyncs": fsyncs,
+                "recover_replay_ops_per_s":
+                    round(replayed / recover_s, 1) if recover_s else 0.0,
+                "replayed_ops": replayed,
+                "disk_bytes": reopened.disk_bytes(),
+            }
+            mark(f"  {durable[policy]['append_commit_us_per_op']} µs/op "
+                 f"({fsyncs} fsyncs), recovery "
+                 f"{durable[policy]['recover_replay_ops_per_s']} ops/s")
+        finally:
+            txn.disable()
+            shutil.rmtree(workdir, ignore_errors=True)
+
+    result = {
         "metric": "txn_commit_overhead_pct",
         "value": round(overhead_pct, 2),
         "unit": (f"% on_block overhead w/ WAL journaling "
                  f"(median of {TXN_ITERS}, bare {bare * 1000:.1f} ms)"),
         "vs_baseline": round(bare / txn_t, 3),
+        "durable_journal": durable,
     }
+    out_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "TXN_r01.json")
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+    mark(f"wrote {out_path}")
+    return result
 
 
 # ---------------------------------------------------------------------------

@@ -116,6 +116,12 @@ REGISTRY: tuple[Site, ...] = (
          kind=BARRIER, chaos=KILL, corrupt="none"),
     Site("txn.journal", "consensus_specs_tpu.txn.journal",
          kind=BARRIER, chaos=KILL, corrupt="none"),
+    # the durable journal's mid-fsync crash window: record bytes are
+    # written (page cache) but not yet durable when this fires — the
+    # chaos crash-anywhere tier drives it through a DurableJournal, and
+    # scripts/kill_drill.py SIGKILLs a real subprocess at it
+    Site("txn.journal.fsync", "consensus_specs_tpu.txn.durable",
+         kind=BARRIER, chaos=KILL, corrupt="none"),
     # -- unit tier: tpu-backend-only seams a CPU chaos replay never
     #    crosses; each names its covering unit suite
     Site("bls.verify", "consensus_specs_tpu.utils.bls",
@@ -419,6 +425,17 @@ CONCURRENCY = Concurrency(
         LockSpec("txn.journal", "consensus_specs_tpu.txn.journal",
                  "_lock", cls="Journal",
                  guards=("_entries", "_snapshots", "_seq")),
+        LockSpec("txn.durable.io", "consensus_specs_tpu.txn.durable",
+                 "_io", cls="DurableJournal",
+                 guards=("_seg_fh", "_seg_index", "_seg_written",
+                         "_seg_max_seq", "_closed_segments",
+                         "_raw_entries", "_raw_snaps", "_scanned_snaps",
+                         "_dirty"),
+                 note="segment file handle + rotation/compaction "
+                      "bookkeeping and the raw records loaded by "
+                      "open_dir; ordered after txn.journal (the entry "
+                      "book) — durable methods append in memory first, "
+                      "then persist under this lock"),
         # -- resilience ------------------------------------------------
         LockSpec("resilience.supervisor",
                  "consensus_specs_tpu.resilience.supervisor", "_lock",

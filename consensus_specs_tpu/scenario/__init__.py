@@ -45,7 +45,7 @@ x uniform block timeliness).
 from .driver import Driver, ScenarioReport, run_scenario
 from .dsl import (
     LIBRARY, LinkSpec, Scenario, Topology, TrafficSpec, crash,
-    degraded, equivocation_storm, heal, long_range_fork, named,
+    degraded, equivocation_storm, heal, kill, long_range_fork, named,
     partition, randomized, recover, surround_attack,
 )
 from .oracle import (
@@ -56,7 +56,7 @@ __all__ = [
     "Driver", "LIBRARY", "LinkSpec", "Oracle", "Scenario",
     "ScenarioReport", "Topology", "TrafficSpec", "assert_attributed",
     "assert_converged", "attribution_report", "crash", "degraded",
-    "equivocation_storm", "heal", "long_range_fork", "named",
+    "equivocation_storm", "heal", "kill", "long_range_fork", "named",
     "partition", "randomized", "recover", "run_scenario",
     "surround_attack",
 ]

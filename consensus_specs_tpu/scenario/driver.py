@@ -31,7 +31,10 @@ construction (see utils/nodectx.py).  `run()` returns a
 """
 from __future__ import annotations
 
+import os
 import random
+import shutil
+import tempfile
 from contextlib import ExitStack
 from dataclasses import dataclass, field
 
@@ -105,10 +108,21 @@ class Driver:
         self.net = SimNetwork(
             scenario.nodes, scenario.topology.link, self.rng,
             ingress_multiplier=scenario.traffic.ingress_multiplier)
+        # durable scenarios: every node journals to its own on-disk
+        # segment directory under one per-run temp root (removed at the
+        # end of run(); the dirs are runtime plumbing, not part of the
+        # deterministic fingerprint)
+        self._durable_root = None
+        if scenario.durable:
+            self._durable_root = tempfile.mkdtemp(
+                prefix=f"scenario-{scenario.name}-")
         self.nodes = [
             SimNode(i, self.spec, self.plan.genesis_state, self.clock,
                     config=node_config,
-                    transport=self._transport_for(i))
+                    transport=self._transport_for(i),
+                    durable_dir=os.path.join(self._durable_root,
+                                             f"node{i}")
+                    if self._durable_root else None)
             for i in range(scenario.nodes)]
         self.oracle = Oracle(self.spec, self.plan, self.clock)
         self._digests: dict = {}            # feed seq -> payload digest
@@ -145,6 +159,12 @@ class Driver:
                 self._degraded.close()
                 self._degraded = None
             resilience.supervisor._ACTIVE = previous_sup
+            if self._durable_root is not None:
+                for node in self.nodes:
+                    if node.journal is not None and \
+                            hasattr(node.journal, "close"):
+                        node.journal.close()
+                shutil.rmtree(self._durable_root, ignore_errors=True)
 
     def _run(self, sup) -> ScenarioReport:
         scenario = self.scenario
@@ -226,6 +246,10 @@ class Driver:
         elif kind == "crash":
             node = self.nodes[action.params["node"]]
             node.crash()
+            self.net.node_down(node.node_id, True)
+        elif kind == "kill":
+            node = self.nodes[action.params["node"]]
+            node.kill()
             self.net.node_down(node.node_id, True)
         elif kind == "recover":
             node = self.nodes[action.params["node"]]

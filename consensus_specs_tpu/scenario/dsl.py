@@ -50,13 +50,13 @@ from dataclasses import dataclass, field
 __all__ = [
     "LinkSpec", "Topology", "TrafficSpec", "Event", "Scenario",
     "partition", "heal", "equivocation_storm", "surround_attack",
-    "long_range_fork", "crash", "recover", "degraded",
+    "long_range_fork", "crash", "kill", "recover", "degraded",
     "ADVERSARIAL_KINDS", "LIBRARY", "named", "randomized",
 ]
 
 ADVERSARIAL_KINDS = frozenset({
     "partition", "equivocation_storm", "surround_attack",
-    "long_range_fork", "crash", "degraded",
+    "long_range_fork", "crash", "kill", "degraded",
 })
 
 
@@ -151,8 +151,21 @@ def crash(at_slot: float, node: int) -> Event:
     return _event(at_slot, "crash", node=int(node))
 
 
+def kill(at_slot: float, node: int) -> Event:
+    """SIGKILL `node`: unlike `crash` (a power cut whose in-process
+    journal object survives by fiat), NOTHING in-process survives a
+    kill — the journal object dies with the pipeline, and recovery must
+    reopen the on-disk segment journal, repair any torn tail, and
+    replay from the snapshot anchor.  Requires `Scenario.durable=True`
+    (a non-durable node has nothing to recover from).  The
+    slashing-protection guard is still modeled as durable (real
+    validators persist it in a separate DB)."""
+    return _event(at_slot, "kill", node=int(node))
+
+
 def recover(at_slot: float, node: int) -> Event:
-    """`txn.recover()` the node from its journal, rebuild the pipeline
+    """`txn.recover()` the node from its journal — reopened from its
+    on-disk segment directory after a `kill` — rebuild the pipeline
     around the durable guard, tick forward, and catch up."""
     return _event(at_slot, "recover", node=int(node))
 
@@ -180,6 +193,10 @@ class Scenario:
     topology: Topology = field(default_factory=Topology)
     traffic: TrafficSpec = field(default_factory=TrafficSpec)
     events: tuple = ()
+    # durable=True gives every node an on-disk segment journal
+    # (txn.DurableJournal in a per-run temp dir) — the prerequisite for
+    # `kill` events, whose recovery reopens the journal from disk
+    durable: bool = False
     # convergence contract: assert byte-identical txn.store_root against
     # the oracle (requires the determinism discipline above).  Scenarios
     # outside the envelope set this False and get head/checkpoint
@@ -205,9 +222,13 @@ class Scenario:
                 partitioned = True
             elif e.kind == "heal":
                 partitioned = False
-            elif e.kind == "crash":
+            elif e.kind in ("crash", "kill"):
                 node = e.get("node")
                 assert 0 <= node < self.nodes and node not in down
+                if e.kind == "kill":
+                    assert self.durable, \
+                        f"kill needs Scenario.durable=True (only the " \
+                        f"on-disk journal survives a SIGKILL): {e}"
                 down.add(node)
             elif e.kind == "recover":
                 node = e.get("node")
@@ -289,6 +310,18 @@ _lib(Scenario(
         degraded(1.5, 3.5),
         partition(2.0, ((0,), (1, 2))),
         heal(4.0),
+    )))
+
+# SIGKILL battlefield: durable on-disk journals, one node killed cold
+# (in-memory journal object lost) and recovered by reopening its
+# segment directory, with a partition riding alongside
+_lib(Scenario(
+    name="blackout3", nodes=3, slots=8, durable=True,
+    events=(
+        partition(2.0, ((0, 1), (2,))),
+        kill(3.1, node=1),
+        heal(4.0),
+        recover(4.6, node=1),
     )))
 
 # the bench scenario: 16 nodes at 10x ingress with a partition+heal

@@ -22,6 +22,17 @@ Durable vs volatile state is the crash model's contract:
                 in-flight messages die with the process and come back
                 through the driver's sync replay).
 
+Two crash severities exercise it:
+
+    crash()   — power-cut fiction: the in-memory journal OBJECT
+                survives (pre-durable behavior, still the default);
+    kill()    — SIGKILL: requires `durable_dir` (a real
+                `txn.DurableJournal`); the journal object dies too and
+                `recover()` must reopen the on-disk segment directory,
+                repair any torn tail, and replay from the snapshot
+                anchor — the in-process twin of the subprocess drill
+                in scripts/kill_drill.py.
+
 Handler execution always runs inside `scope()` — node context +
 `txn.use(manager)` — so a store mutation can neither escape the
 transaction nor mis-attribute its incidents.
@@ -42,7 +53,8 @@ from ..utils import nodectx
 class SimNode:
     def __init__(self, node_id: int, spec, anchor_state, clock,
                  config: GossipConfig | None = None, transport=None,
-                 snapshot_interval: int = 256):
+                 snapshot_interval: int = 256,
+                 durable_dir: str | None = None):
         self.node_id = int(node_id)
         self.name = f"node{node_id}"
         self.spec = spec
@@ -58,7 +70,12 @@ class SimNode:
             incidents=IncidentLog(max_entries=1 << 14,
                                   node_id=self.name, clock=clock))
         # durable state
-        self.journal = txn.Journal()
+        self.durable_dir = durable_dir
+        self.snapshot_interval = snapshot_interval
+        if durable_dir is not None:
+            self.journal = txn.DurableJournal(durable_dir)
+        else:
+            self.journal = txn.Journal()
         self.manager = txn.TxnManager(self.journal,
                                       snapshot_interval=snapshot_interval)
         self.guard = EquivocationGuard()
@@ -96,13 +113,32 @@ class SimNode:
         self.seq_digest = {}
         self.retry = []
 
+    def kill(self) -> None:
+        """SIGKILL: volatile state AND the in-memory journal object are
+        gone — only the on-disk segments (and the guard, modeled as a
+        separate durable DB) survive.  `recover()` reopens the
+        directory."""
+        assert self.durable_dir is not None, \
+            "kill() needs a durable journal (SimNode durable_dir)"
+        self.crash()
+        self.journal.close()
+        self.journal = None
+        self.manager = None
+
     def recover(self, now_time: int) -> None:
         """Rebuild the store from the journal (`txn.recover` verifies
         the snapshot root and replays the committed tail — the
         `recovered` incident lands in THIS node's log), tick forward to
         the present, and restart the pipeline around the durable
-        guard."""
+        guard.  After a `kill()` the journal is first reopened from its
+        segment directory (torn-tail repair incidents land in this
+        node's log too)."""
         assert not self.up and self.store is None
+        if self.journal is None:            # killed: reopen from disk
+            with nodectx.use(self.ctx):
+                self.journal = txn.open_dir(self.durable_dir)
+            self.manager = txn.TxnManager(
+                self.journal, snapshot_interval=self.snapshot_interval)
         with self.scope():
             self.store = txn.recover(self.spec, self.journal)
         self.boot()

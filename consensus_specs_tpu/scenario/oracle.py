@@ -186,7 +186,7 @@ def attribution_report(plan, summaries) -> dict:
             entry["incidents"] = hits
             entry["attributed"] = \
                 {q["validator_index"] for q in hits} == expected
-        elif event.kind == "crash":
+        elif event.kind in ("crash", "kill"):
             name = f"node{event.get('node')}"
             hits = [e for s in summaries if s["node_id"] == name
                     for e in s["incidents"]

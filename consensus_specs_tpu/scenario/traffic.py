@@ -200,7 +200,7 @@ class TrafficPlan:
     def _plan_event(self, event, rng) -> None:
         t = self.slot_time(event.at_slot)
         kind = event.kind
-        if kind in ("partition", "heal", "crash", "recover",
+        if kind in ("partition", "heal", "crash", "kill", "recover",
                     "degraded"):
             self.actions.append(EventAction(
                 t, kind, {k: v for k, v in event.params}))

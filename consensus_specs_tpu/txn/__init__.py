@@ -60,6 +60,7 @@ from ..resilience.supervisor import dispatch
 from ..sigpipe.cache import AGGREGATES
 from ..sigpipe.metrics import METRICS
 from ..utils.locks import named_rlock
+from .durable import DurableJournal, open_dir
 from .journal import Journal, JournalEntry, Snapshot
 from .oracle import store_root
 from .overlay import OverlayDict, OverlaySet, StoreTransaction, clone_store
@@ -124,8 +125,20 @@ class TxnManager:
 
         def apply(consult_faults: bool):
             if entry is not None:
-                journal.mark_committed(entry)
-            marked[0] = True
+                try:
+                    journal.mark_committed(entry)
+                finally:
+                    # the journal-side committed flag IS the redo
+                    # decision: if marking raised mid-persist (a
+                    # durable journal's fsync window) the journal may
+                    # already say committed, and the failure must be
+                    # classified TORN — journal ahead of store, repair
+                    # by recovery — never rollback, which would leave
+                    # the live store quietly diverging from what any
+                    # recovery reproduces
+                    marked[0] = marked[0] or bool(entry.committed)
+            else:
+                marked[0] = True
             view.apply(consult_faults=consult_faults)
 
         # A real dispatch site: the injector can kill it, the supervisor
@@ -239,7 +252,15 @@ def recover(spec, journal: Journal):
     """Rebuild a store from the journal: clone the latest snapshot,
     re-verify its content address, replay the committed tail through
     the bare handlers.  Returns a store byte-identical (store_root) to
-    the sequential application of every committed operation."""
+    the sequential application of every committed operation.
+
+    A journal opened from disk (`txn.open_dir` / `DurableJournal` on an
+    existing directory) holds raw records until a spec can decode them:
+    materialize first, then recover exactly as the in-memory path
+    does."""
+    materialize = getattr(journal, "materialize", None)
+    if materialize is not None:
+        materialize(spec)
     snap = journal.latest_snapshot()
     if snap is None:
         raise RuntimeError("journal has no snapshot to recover from; "
@@ -262,8 +283,9 @@ def recover(spec, journal: Journal):
 
 
 __all__ = [
-    "COMMIT_SITE", "Journal", "JournalEntry", "OverlayDict", "OverlaySet",
-    "Snapshot", "StoreTransaction", "TxnManager", "active", "clone_store",
-    "disable", "enable", "enabled", "recover", "scope", "store_root",
+    "COMMIT_SITE", "DurableJournal", "Journal", "JournalEntry",
+    "OverlayDict", "OverlaySet", "Snapshot", "StoreTransaction",
+    "TxnManager", "active", "clone_store", "disable", "enable",
+    "enabled", "open_dir", "recover", "scope", "store_root",
     "transactional", "use",
 ]

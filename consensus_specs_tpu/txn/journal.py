@@ -23,9 +23,11 @@ Kill points: `append_intent` consults the fault plan at the
 raise there models a crash mid-journal-write, and the operation is
 absent from both the journal and the store.
 
-The journal is in-memory (this is a reproduction node, not a disk
-format) but the discipline is the durable one: nothing in recovery
-reads the live store, only the journal and its snapshots.
+This base journal is in-memory; `txn.durable.DurableJournal` extends it
+with the real on-disk format (CRC32C-framed records, segment rotation,
+snapshot files, fsync discipline) for kill-the-process drills.  Either
+way the discipline is the durable one: nothing in recovery reads the
+live store, only the journal and its snapshots.
 """
 from __future__ import annotations
 
@@ -46,9 +48,24 @@ JOURNAL_SITE = sites.site("txn.journal").name
 
 def _copy_arg(value):
     """Deep-enough copy of a handler argument for replay: SSZ containers
-    copy; ints/bytes/bools are immutable and pass through."""
-    if hasattr(value, "copy") and not isinstance(
-            value, (dict, set, list, bytes, bytearray)):
+    copy; mutable builtins (dict/list/set/bytearray — and tuples, which
+    may hold them) are copied recursively, so a caller mutating one
+    after the handler returns cannot rewrite the journaled intent out
+    from under `verify()` and replay; ints/bytes/strs are immutable and
+    pass through."""
+    if isinstance(value, dict):
+        return {_copy_arg(k): _copy_arg(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_copy_arg(v) for v in value]
+    if isinstance(value, tuple):
+        return tuple(_copy_arg(v) for v in value)
+    if isinstance(value, (set, frozenset)):
+        return type(value)(_copy_arg(v) for v in value)
+    if isinstance(value, bytearray):
+        return bytearray(value)
+    if isinstance(value, bytes):
+        return value
+    if hasattr(value, "copy"):
         return value.copy()
     return value
 
@@ -105,13 +122,19 @@ class Journal:
         METRICS.inc("txn_journal_intents")
         return entry
 
-    def mark_committed(self, entry: JournalEntry) -> None:
+    def mark_committed(self, entry: JournalEntry) -> bool:
         """The redo decision.  Idempotent: the commit dispatch may retry
-        or fall back after a transient fault and re-mark."""
-        if entry.committed:
-            return
-        entry.committed = True
+        or fall back after a transient fault and re-mark.  Returns
+        whether THIS call freshly marked the entry (the durable journal
+        persists the marker record exactly once off that answer).  The
+        check-and-set rides the journal rlock so a racing retry cannot
+        double-count the commit."""
+        with self._lock:
+            if entry.committed:
+                return False
+            entry.committed = True
         METRICS.inc("txn_journal_commits")
+        return True
 
     # -- snapshots ------------------------------------------------------
     def needs_anchor(self) -> bool:
@@ -130,7 +153,19 @@ class Journal:
             self._snapshots.append(Snapshot(entry_seq, root, clone))
             while len(self._snapshots) > self.max_snapshots:
                 self._snapshots.pop(0)
+            # the in-memory mirror of disk compaction: entries at or
+            # before the anchor are reachable only through the snapshot
+            # now (recovery clones the latest snapshot and replays the
+            # tail AFTER it), so pruning them bounds a months-long
+            # soak's journal memory the way segment deletion bounds its
+            # disk
+            pruned = sum(1 for e in self._entries if e.seq <= entry_seq)
+            if pruned:
+                self._entries = [e for e in self._entries
+                                 if e.seq > entry_seq]
         METRICS.inc("txn_snapshots")
+        if pruned:
+            METRICS.inc("txn_journal_pruned_entries", pruned)
         INCIDENTS.record("txn.journal", "snapshot",
                          entry_seq=entry_seq, root=root.hex())
         return root

@@ -1,0 +1,336 @@
+#!/usr/bin/env python
+"""Subprocess SIGKILL crash drills for the durable txn journal.
+
+The chaos tier crashes a node with an in-process raise; this drill
+crashes it with the real thing.  For every transactional barrier family
+— mid-mutation (``txn.mutate``), mid-commit-apply (``txn.commit.apply``),
+mid-journal-write (``txn.journal``), and mid-fsync
+(``txn.journal.fsync``) — the driver:
+
+1. spawns a child process that runs a deterministic fork-choice
+   workload over a `txn.DurableJournal`, with a plan that SIGKILLs the
+   process at the N-th consultation of the target barrier;
+2. spawns a fresh "restarted node" process that reopens the journal
+   directory (torn-tail repair included), runs ``txn.recover``, asserts
+   the recovered store is byte-identical to the marker-rule oracle
+   (genesis + exactly the committed prefix), finishes the remaining
+   schedule, and reports the final store root;
+3. asserts that final root equals the never-crashed oracle computed in
+   the driver process.
+
+A rotation/compaction soak then runs in-process: small segments, a
+tight snapshot cadence, and enough commits for several rotations —
+asserting superseded segments are deleted (disk stays bounded) and a
+reopened journal still recovers byte-identically.
+
+Usage:
+    python scripts/kill_drill.py [--quick] [--fsync POLICY]
+    (internal) --child {run,recover} --dir D --site S --nth N
+"""
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+KILL_FAMILIES = ("txn.mutate", "txn.commit.apply", "txn.journal",
+                 "txn.journal.fsync")
+# anchor-only snapshots in the kill matrix: committed_entries() then IS
+# the full committed prefix, so the marker-rule oracle is exact
+ANCHOR_ONLY = 1 << 30
+
+
+def log(msg: str) -> None:
+    print(f"[kill-drill] {msg}", flush=True)
+
+
+# ---------------------------------------------------------------------------
+# the deterministic workload (identical in every process)
+# ---------------------------------------------------------------------------
+
+def build_world():
+    """(spec, genesis, ops): the mixed all-valid handler schedule both
+    the crashing child and the oracle apply."""
+    from consensus_specs_tpu.specs import get_spec
+    from consensus_specs_tpu.ssz import uint64
+    from consensus_specs_tpu.test_infra import disable_bls
+    from consensus_specs_tpu.test_infra.attestations import (
+        get_valid_attestation)
+    from consensus_specs_tpu.test_infra.blocks import (
+        build_empty_block_for_next_slot, state_transition_and_sign_block)
+    from consensus_specs_tpu.test_infra.genesis import (
+        create_genesis_state, default_balances)
+    from consensus_specs_tpu.test_infra.slashings import (
+        get_valid_attester_slashing)
+
+    spec = get_spec("altair", "minimal")
+    with disable_bls():
+        genesis = create_genesis_state(spec, default_balances(spec))
+        state = genesis.copy()
+        spec.process_slots(state, uint64(spec.SLOTS_PER_EPOCH + 2))
+        att = get_valid_attestation(spec, state, signed=True)
+        att2 = get_valid_attestation(
+            spec, state, slot=uint64(int(state.slot) - 2), index=0,
+            signed=True)
+        advanced = state.copy()
+        spec.process_slots(advanced, uint64(
+            state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY))
+        block = build_empty_block_for_next_slot(spec, advanced)
+        block.body.attestations.append(att)
+        signed = state_transition_and_sign_block(spec, advanced.copy(),
+                                                 block)
+        slashing = get_valid_attester_slashing(
+            spec, state, slot=uint64(int(state.slot) - 3),
+            signed_1=True, signed_2=True)
+    slot_time = lambda s: int(genesis.genesis_time) \
+        + s * int(spec.config.SECONDS_PER_SLOT)        # noqa: E731
+    ops = [
+        ("on_tick", slot_time(int(signed.message.slot))),
+        ("on_block", signed),
+        ("on_attestation", att),
+        ("on_tick", slot_time(int(signed.message.slot) + 1)),
+        ("on_attestation", att2),
+        ("on_attester_slashing", slashing),
+    ]
+    return spec, genesis, ops
+
+
+def fresh_store(spec, genesis):
+    from consensus_specs_tpu.test_infra.fork_choice import (
+        get_genesis_forkchoice_store)
+    return get_genesis_forkchoice_store(spec, genesis)
+
+
+def oracle_root(spec, genesis, ops) -> bytes:
+    from consensus_specs_tpu import txn
+    from consensus_specs_tpu.test_infra import disable_bls
+    store = fresh_store(spec, genesis)
+    with disable_bls():
+        for op, arg in ops:
+            getattr(spec, op)(store, arg)
+    return txn.store_root(store)
+
+
+# ---------------------------------------------------------------------------
+# child: run-until-SIGKILL
+# ---------------------------------------------------------------------------
+
+def child_run(args) -> int:
+    from consensus_specs_tpu import txn
+    from consensus_specs_tpu.resilience import faults
+    from consensus_specs_tpu.test_infra import disable_bls
+
+    class KillPlan(faults.FaultPlan):
+        """SIGKILL this process at the nth consultation of one barrier
+        site — the process-boundary analogue of a seeded raise."""
+
+        def __init__(self, site, nth):
+            super().__init__([], seed=0)
+            self._target = site
+            self._nth = int(nth)
+            self._count = 0
+
+        def decide(self, site):
+            if site == self._target:
+                self._count += 1
+                if self._count >= self._nth:
+                    os.kill(os.getpid(), signal.SIGKILL)
+            return None
+
+    spec, genesis, ops = build_world()
+    journal = txn.DurableJournal(
+        args.dir, fsync_policy=args.fsync,
+        segment_bytes=args.segment_bytes)
+    store = fresh_store(spec, genesis)
+    txn.enable(journal=journal, snapshot_interval=ANCHOR_ONLY)
+    with disable_bls():
+        with faults.inject(KillPlan(args.site, args.nth)):
+            for op, arg in ops:
+                getattr(spec, op)(store, arg)
+    txn.disable()
+    journal.close()
+    # only reached when the kill never fired (nth > total consults)
+    print(json.dumps({"completed": True,
+                      "root": txn.store_root(store).hex()}))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# child: restart-and-recover
+# ---------------------------------------------------------------------------
+
+def child_recover(args) -> int:
+    from consensus_specs_tpu import txn
+    from consensus_specs_tpu.resilience import INCIDENTS
+    from consensus_specs_tpu.test_infra import disable_bls
+
+    spec, genesis, ops = build_world()
+    journal = txn.open_dir(args.dir, fsync_policy=args.fsync,
+                           segment_bytes=args.segment_bytes)
+    with disable_bls():
+        if journal.needs_anchor():
+            # killed before the startup anchor snapshot became durable:
+            # nothing is recoverable by construction (no op could have
+            # committed without the anchor), so the restarted node
+            # starts from its anchor state and re-anchors
+            recovered = fresh_store(spec, genesis)
+            journal.materialize(spec)
+            k = 0
+        else:
+            recovered = txn.recover(spec, journal)
+            k = len(journal.committed_entries())
+        # the marker rule, byte-for-byte: recovered == genesis + the
+        # committed prefix (anchor-only snapshots make the prefix whole)
+        prefix = fresh_store(spec, genesis)
+        for op, arg in ops[:k]:
+            getattr(spec, op)(prefix, arg)
+        assert txn.store_root(recovered) == txn.store_root(prefix), \
+            "recovered store diverges from the marker-rule oracle"
+        assert journal.verify(), "entry digests broke in the round trip"
+        # the restarted node finishes the schedule on the SAME journal
+        manager = txn.TxnManager(journal, snapshot_interval=ANCHOR_ONLY)
+        with txn.use(manager):
+            for op, arg in ops[k:]:
+                getattr(spec, op)(recovered, arg)
+    journal.close()
+    print(json.dumps({
+        "root": txn.store_root(recovered).hex(),
+        "committed_at_recovery": k,
+        "torn_tails": INCIDENTS.count(site="txn.journal",
+                                      event="torn_tail"),
+        "segments": journal.segment_indices(),
+    }))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def spawn(extra, timeout=600):
+    cmd = [sys.executable, os.path.abspath(__file__)] + extra
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(cmd, capture_output=True, text=True,
+                          env=env, timeout=timeout)
+
+
+def run_matrix(args) -> bool:
+    spec, genesis, ops = build_world()
+    expect = oracle_root(spec, genesis, ops).hex()
+    log(f"oracle root {expect[:16]}… over {len(ops)} ops")
+    nths = (1,) if args.quick else (1, 3)
+    ok = True
+    for site in KILL_FAMILIES:
+        for nth in nths:
+            wd = tempfile.mkdtemp(prefix="kill-drill-")
+            try:
+                base = ["--dir", wd, "--site", site, "--nth", str(nth),
+                        "--fsync", args.fsync,
+                        "--segment-bytes", str(args.segment_bytes)]
+                run = spawn(["--child", "run"] + base)
+                killed = run.returncode == -signal.SIGKILL
+                if not killed and run.returncode != 0:
+                    log(f"FAIL {site} nth={nth}: run child died "
+                        f"rc={run.returncode}\n{run.stderr[-2000:]}")
+                    ok = False
+                    continue
+                rec = spawn(["--child", "recover"] + base)
+                if rec.returncode != 0:
+                    log(f"FAIL {site} nth={nth}: recover child died "
+                        f"rc={rec.returncode}\n{rec.stderr[-2000:]}")
+                    ok = False
+                    continue
+                report = json.loads(rec.stdout.strip().splitlines()[-1])
+                if report["root"] != expect:
+                    log(f"FAIL {site} nth={nth}: recovered+finished "
+                        f"root {report['root'][:16]}… != oracle")
+                    ok = False
+                    continue
+                log(f"ok   {site:<18} nth={nth} "
+                    f"{'SIGKILL' if killed else 'survived'} "
+                    f"committed@recovery="
+                    f"{report['committed_at_recovery']} "
+                    f"torn_tails={report['torn_tails']}")
+            finally:
+                shutil.rmtree(wd, ignore_errors=True)
+    return ok
+
+
+def run_soak(args) -> bool:
+    """Rotation + compaction soak, in-process: small segments, tight
+    snapshot cadence, enough commits for >= 3 rotations; superseded
+    segments must be deleted and recovery must still be byte-exact."""
+    from consensus_specs_tpu import txn
+    from consensus_specs_tpu.sigpipe import METRICS
+    from consensus_specs_tpu.test_infra import disable_bls
+
+    spec, genesis, ops = build_world()
+    wd = tempfile.mkdtemp(prefix="kill-drill-soak-")
+    try:
+        METRICS.reset()
+        journal = txn.DurableJournal(wd, fsync_policy=args.fsync,
+                                     segment_bytes=1024)
+        store = fresh_store(spec, genesis)
+        base_time = int(store.time)
+        txn.enable(journal=journal, snapshot_interval=8)
+        with disable_bls():
+            for i in range(120):
+                spec.on_tick(store, base_time + i + 1)
+        txn.disable()
+        journal.close()
+        rotations = METRICS.count("txn_journal_rotations")
+        compacted = METRICS.count("txn_journal_compacted_segments")
+        live = journal.segment_indices()
+        disk = journal.disk_bytes()
+        assert rotations >= 3, f"only {rotations} rotations"
+        assert compacted > 0, "compaction never deleted a segment"
+        assert len(live) < rotations, \
+            f"{len(live)} live segments after {rotations} rotations — " \
+            f"disk not bounded"
+        reopened = txn.open_dir(wd)
+        with disable_bls():
+            recovered = txn.recover(spec, reopened)
+        assert txn.store_root(recovered) == txn.store_root(store), \
+            "post-soak recovery diverged"
+        log(f"ok   soak: {rotations} rotations, {compacted} segments "
+            f"compacted, {len(live)} live ({disk} bytes on disk), "
+            f"recovery byte-identical")
+        return True
+    except AssertionError as e:
+        log(f"FAIL soak: {e}")
+        return False
+    finally:
+        shutil.rmtree(wd, ignore_errors=True)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--child", choices=("run", "recover"))
+    p.add_argument("--dir")
+    p.add_argument("--site", default="txn.mutate")
+    p.add_argument("--nth", type=int, default=1)
+    p.add_argument("--fsync", default="marker_only",
+                   choices=("always", "marker_only", "never"))
+    p.add_argument("--segment-bytes", type=int, default=1 << 16)
+    p.add_argument("--quick", action="store_true",
+                   help="one kill per barrier family instead of two")
+    args = p.parse_args()
+    if args.child == "run":
+        return child_run(args)
+    if args.child == "recover":
+        return child_recover(args)
+    ok = run_matrix(args)
+    ok = run_soak(args) and ok
+    log("PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

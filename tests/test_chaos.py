@@ -19,6 +19,8 @@ reproduces exactly.
 import json
 import os
 import random
+import shutil
+import tempfile
 
 import pytest
 
@@ -503,10 +505,13 @@ def txn_workload(spec):
 
 def test_chaos_crash_anywhere_recovery(spec, txn_workload):
     """Kill the node at seeded points mid-handler, mid-commit,
-    mid-apply, and mid-journal-write: after every crash the recovered
-    store's root is byte-identical to the never-crashed sequential
-    oracle (the journal's committed prefix), every injected fault is in
-    the incident log, and continuing past recovery converges."""
+    mid-apply, mid-journal-write, and mid-fsync: the journal is a real
+    on-disk `DurableJournal` (aggressive snapshot cadence, tiny
+    segments, fsync=always so the fsync barrier fires constantly), and
+    recovery REOPENS the directory cold — the process-restart model —
+    then finishes the schedule and lands byte-identical to a node that
+    never crashed.  Every injected fault stays visible, and the
+    reopened journal's decoded entries still verify their digests."""
     from consensus_specs_tpu import txn
     from consensus_specs_tpu.test_infra import disable_bls
     from consensus_specs_tpu.test_infra.fork_choice import (
@@ -515,7 +520,14 @@ def test_chaos_crash_anywhere_recovery(spec, txn_workload):
     rng = random.Random(CHAOS_SEED + 13)
     crashes_seen = 0
     with disable_bls():
-        for round_i in range(8):
+        clean = get_genesis_forkchoice_store(spec, genesis)
+        for op, arg in ops:
+            try:
+                getattr(spec, op)(clean, arg)
+            except AssertionError:
+                continue
+        clean_root = txn.store_root(clean)
+        for round_i in range(10):
             INCIDENTS.clear()
             METRICS.reset()
             site = KILL_SITES[round_i % len(KILL_SITES)]
@@ -526,8 +538,15 @@ def test_chaos_crash_anywhere_recovery(spec, txn_workload):
                            rate=rng.choice([0.05, 0.2, 0.5]),
                            max_fires=1)],
                 seed=rng.randrange(1 << 30))
-            journal = txn.Journal()
-            txn.enable(journal=journal, snapshot_interval=2)
+            jdir = tempfile.mkdtemp(prefix="chaos-journal-")
+            journal = txn.DurableJournal(jdir, fsync_policy="always",
+                                         segment_bytes=4096)
+            # alternate cadences: anchor-only rounds keep the whole
+            # committed prefix on disk (the exact marker-rule oracle is
+            # checkable), interval-2 rounds exercise snapshot +
+            # compaction under the same kills
+            interval = 100 if round_i % 2 == 0 else 2
+            txn.enable(journal=journal, snapshot_interval=interval)
             store = get_genesis_forkchoice_store(spec, genesis)
             try:
                 with faults.inject(plan):
@@ -540,23 +559,37 @@ def test_chaos_crash_anywhere_recovery(spec, txn_workload):
                 crashes_seen += 1       # the node dies here
             finally:
                 txn.disable()
-
-            # the never-crashed oracle: sequentially apply exactly the
-            # operations whose commit marker became durable
-            oracle = get_genesis_forkchoice_store(spec, genesis)
-            committed = journal.committed_entries()
-            for entry in committed:
-                getattr(spec, entry.op)(oracle, *entry.args,
-                                        **entry.kwargs)
-            recovered = txn.recover(spec, journal)
-            assert txn.store_root(recovered) == txn.store_root(oracle)
+                journal.close()
 
             # every injected fault is visible
             assert INCIDENTS.count(event="injected") == \
                 plan.total_fires()
             assert METRICS.snapshot().get("faults_injected", 0) == \
                 plan.total_fires()
-            assert journal.verify()
+
+            # process restart: open the directory cold and recover
+            reopened = txn.open_dir(jdir)
+            if reopened.needs_anchor():
+                # killed before the startup anchor snapshot became
+                # durable (a first-fsync crash): nothing could have
+                # committed, so the restarted node starts from its
+                # anchor state
+                reopened.materialize(spec)
+                recovered = get_genesis_forkchoice_store(spec, genesis)
+            else:
+                recovered = txn.recover(spec, reopened)
+                if interval == 100:
+                    # anchor-only cadence ⇒ committed_entries() IS the
+                    # full committed prefix: the marker rule, exactly —
+                    # recovered == genesis + every marked op, no more,
+                    # no less
+                    oracle = get_genesis_forkchoice_store(spec, genesis)
+                    for entry in reopened.committed_entries():
+                        getattr(spec, entry.op)(oracle, *entry.args,
+                                                **entry.kwargs)
+                    assert txn.store_root(recovered) == \
+                        txn.store_root(oracle), (site, round_i)
+            assert reopened.verify()
 
             # crash-only convergence: the recovered node finishes the
             # schedule and lands exactly where an uncrashed node does
@@ -565,14 +598,10 @@ def test_chaos_crash_anywhere_recovery(spec, txn_workload):
                     getattr(spec, op)(recovered, arg)
                 except AssertionError:
                     continue
-            clean = get_genesis_forkchoice_store(spec, genesis)
-            for op, arg in ops:
-                try:
-                    getattr(spec, op)(clean, arg)
-                except AssertionError:
-                    continue
-            assert txn.store_root(recovered) == txn.store_root(clean), \
-                (site, len(committed))
+            assert txn.store_root(recovered) == clean_root, \
+                (site, round_i)
+            reopened.close()
+            shutil.rmtree(jdir, ignore_errors=True)
     # the seeded schedule must actually exercise crashes
     assert crashes_seen >= 3
 

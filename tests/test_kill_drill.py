@@ -1,0 +1,37 @@
+"""Process-boundary SIGKILL drill (scripts/kill_drill.py), slow tier.
+
+The in-process chaos tier (tests/test_chaos.py) models a crash with a
+raised `DeviceFault`; this tier kills a real child process with
+SIGKILL at each transactional barrier family and asserts a freshly
+spawned process recovers the on-disk journal to the marker-rule oracle
+and finishes the schedule byte-identical to the never-crashed run.
+`make kill-drill` runs the full matrix; this test runs the --quick
+matrix (one kill per barrier family + the rotation/compaction soak) so
+`make recovery-chaos` exercises the process boundary too.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(ROOT, "scripts", "kill_drill.py")
+
+
+def test_kill_drill_quick_matrix():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--quick"],
+        capture_output=True, text=True, env=env, timeout=900)
+    assert proc.returncode == 0, \
+        f"kill drill failed:\n{proc.stdout[-4000:]}\n{proc.stderr[-2000:]}"
+    out = proc.stdout
+    # every barrier family was exercised and the soak saw compaction
+    for site in ("txn.mutate", "txn.commit.apply", "txn.journal",
+                 "txn.journal.fsync"):
+        assert f"ok   {site}" in out, f"{site} family missing:\n{out}"
+    assert "ok   soak:" in out
+    assert "PASS" in out

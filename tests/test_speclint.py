@@ -669,7 +669,8 @@ def test_registry_structure():
     assert guard.FUSED_SITES == sites.fused_sites()
     assert faults._DIGEST_GUARDED_SITES == sites.digest_guarded_sites()
     assert set(sites.kill_sites()) == {
-        "txn.mutate", "txn.commit", "txn.commit.apply", "txn.journal"}
+        "txn.mutate", "txn.commit", "txn.commit.apply", "txn.journal",
+        "txn.journal.fsync"}
 
 
 def test_every_rule_documented():

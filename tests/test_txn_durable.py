@@ -1,0 +1,462 @@
+"""Durable segment-rotated txn journal (txn/durable.py + txn/codec.py).
+
+Runs against a miniature transactional spec (a dataclass store + three
+wrapped handlers + one real SSZ container) so every case is
+milliseconds: the journal/codec contracts are type-driven, not
+chain-driven.  The real-spec integration (full fork-choice workload
+through a DurableJournal, reopen, recover) lives in tests/test_txn.py,
+the in-process chaos matrix in tests/test_chaos.py, and the
+process-boundary SIGKILL drill in scripts/kill_drill.py (slow tier via
+tests/test_kill_drill.py / `make kill-drill`).
+
+Contracts pinned here:
+
+* codec: typed round trip for the whole value grammar, hard CodecError
+  outside it, canonical CRC32C check value;
+* durability: enable → commit → close → `txn.open_dir` → recover is
+  byte-identical to the live store, entry digests survive the round
+  trip, unmarked intents never replay (the marker rule);
+* torn tails: truncating the final record at EVERY byte offset, and
+  flipping any bit of it, yields atomic-or-absent recovery with a
+  `txn.journal`/`torn_tail` incident — never an exception escape;
+* rotation at `segment_bytes` + snapshot-anchored compaction bounding
+  disk, fsync-policy accounting, the `txn.journal.fsync` kill point;
+* the in-memory journal's prune-on-snapshot mirror (bounded memory,
+  recovery still converges from snapshot + tail);
+* the `_copy_arg` deep-copy regression: mutating a list argument after
+  the handler returns must not corrupt the journaled intent.
+"""
+import os
+import shutil
+
+import pytest
+from dataclasses import dataclass, field
+
+from consensus_specs_tpu import resilience, txn
+from consensus_specs_tpu.resilience import (
+    DeviceFault, FaultPlan, FaultSpec, INCIDENTS, faults,
+)
+from consensus_specs_tpu.sigpipe import METRICS
+from consensus_specs_tpu.ssz import Bytes32, Container, uint64
+from consensus_specs_tpu.txn import codec
+from consensus_specs_tpu.txn.durable import (
+    FSYNC_ALWAYS, FSYNC_MARKER, FSYNC_NEVER,
+)
+
+
+@dataclass
+class MiniStore:
+    time: int
+    head: bytes
+    blocks: dict = field(default_factory=dict)
+    votes: set = field(default_factory=set)
+
+
+class Point(Container):
+    x: uint64
+    root: Bytes32
+
+
+@dataclass
+class MiniMessage:             # the LatestMessage-shaped dataclass case
+    epoch: int
+    root: bytes
+
+
+class MiniSpec:
+    MiniStore = MiniStore
+    MiniMessage = MiniMessage
+    Point = Point
+
+    @txn.transactional
+    def on_tick(self, store, t):
+        store.time = int(t)
+
+    @txn.transactional
+    def on_block(self, store, root, point):
+        store.blocks[root] = point
+
+    @txn.transactional
+    def on_vote(self, store, v):
+        store.votes.add(v)
+
+    @txn.transactional
+    def on_meta(self, store, items):
+        store.blocks[b"meta"] = list(items)
+
+
+SPEC = MiniSpec()
+
+
+def fresh_store() -> MiniStore:
+    return MiniStore(0, b"\x00" * 8)
+
+
+def ops_schedule(n_blocks: int = 4):
+    ops = [("on_tick", (1,))]
+    for i in range(n_blocks):
+        ops.append(("on_block",
+                    (bytes([i]) * 4,
+                     Point(x=uint64(i), root=Bytes32(bytes([i]) * 32)))))
+        ops.append(("on_vote", (i,)))
+    ops.append(("on_tick", (7,)))
+    return ops
+
+
+def apply_ops(store, ops):
+    for op, args in ops:
+        getattr(SPEC, op)(store, *args)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    txn.disable()
+    resilience.disable()
+    INCIDENTS.clear()
+    METRICS.reset()
+    yield
+    txn.disable()
+    resilience.disable()
+    INCIDENTS.clear()
+
+
+# ---------------------------------------------------------------------------
+# codec
+# ---------------------------------------------------------------------------
+
+def test_crc32c_check_value():
+    # the canonical Castagnoli check vector
+    assert codec.crc32c(b"123456789") == 0xE3069283
+    assert codec.crc32c(b"") == 0
+
+
+@pytest.mark.parametrize("value", [
+    None, True, False, 0, -3, 1 << 130, uint64(9), b"", b"abc",
+    bytearray(b"xy"), "text", [1, [2, None]], (3, b"4"),
+    {1, 2}, frozenset({b"z"}), {b"k": [1, 2], 5: "v"},
+    Bytes32(b"\x07" * 32),
+    Point(x=uint64(3), root=Bytes32(b"\x01" * 32)),
+    MiniMessage(epoch=2, root=b"r"),
+    MiniStore(5, b"h", {b"r": Point()}, {1, 2}),
+])
+def test_codec_round_trip_typed(value):
+    resolver = codec.TypeResolver(SPEC)
+    out = codec.decode_value(codec.encode_value(value), resolver)
+    assert out == value
+    assert type(out) is type(value)
+
+
+def test_codec_rejects_unknown_types():
+    with pytest.raises(codec.CodecError):
+        codec.encode_value(object())
+    resolver = codec.TypeResolver(SPEC)
+    with pytest.raises(codec.CodecError):
+        resolver("NoSuchClassAnywhere")
+
+
+def test_codec_dict_preserves_insertion_order():
+    resolver = codec.TypeResolver(SPEC)
+    d = {b"b": 1, b"a": 2}
+    out = codec.decode_value(codec.encode_value(d), resolver)
+    assert list(out) == [b"b", b"a"]
+
+
+# ---------------------------------------------------------------------------
+# durability round trip
+# ---------------------------------------------------------------------------
+
+def _run_journal(path, ops=None, fsync_policy=FSYNC_MARKER,
+                 segment_bytes=1 << 16, snapshot_interval=1 << 30):
+    journal = txn.DurableJournal(path, fsync_policy=fsync_policy,
+                                 segment_bytes=segment_bytes)
+    store = fresh_store()
+    txn.enable(journal=journal, snapshot_interval=snapshot_interval)
+    apply_ops(store, ops if ops is not None else ops_schedule())
+    txn.disable()
+    journal.close()
+    return store, journal
+
+
+def test_reopen_recover_is_byte_identical(tmp_path):
+    store, _ = _run_journal(str(tmp_path))
+    reopened = txn.open_dir(str(tmp_path))
+    recovered = txn.recover(SPEC, reopened)
+    assert txn.store_root(recovered) == txn.store_root(store)
+    assert reopened.verify()
+    # and the reopened journal keeps working: append + recover again
+    txn.enable(journal=reopened, snapshot_interval=1 << 30)
+    SPEC.on_tick(recovered, 99)
+    txn.disable()
+    again = txn.recover(SPEC, txn.open_dir(str(tmp_path)))
+    assert txn.store_root(again) == txn.store_root(recovered)
+
+
+def test_reads_before_materialize_raise(tmp_path):
+    _run_journal(str(tmp_path))
+    reopened = txn.open_dir(str(tmp_path))
+    with pytest.raises(RuntimeError, match="materialize"):
+        reopened.committed_entries()
+    with pytest.raises(RuntimeError, match="materialize"):
+        reopened.verify()
+    reopened.materialize(SPEC)
+    assert reopened.verify()
+
+
+def test_unmarked_intent_never_replays(tmp_path):
+    """The marker rule across the process boundary: an intent written
+    without its commit marker is absent from every recovered store."""
+    store, journal = _run_journal(str(tmp_path))
+    # a handler that died mid-flight: intent on disk, no marker
+    journal2 = txn.DurableJournal(str(tmp_path))
+    journal2.materialize(SPEC)
+    journal2.append_intent("on_tick", (12345,), {})
+    journal2.close()
+    recovered = txn.recover(SPEC, txn.open_dir(str(tmp_path)))
+    assert txn.store_root(recovered) == txn.store_root(store)
+    assert recovered.time != 12345
+
+
+def test_mutable_arg_copy_regression(tmp_path):
+    """_copy_arg satellite: mutating a list argument after the handler
+    returns must corrupt neither verify() nor replay."""
+    journal = txn.DurableJournal(str(tmp_path))
+    store = fresh_store()
+    txn.enable(journal=journal, snapshot_interval=1 << 30)
+    payload = [1, 2, {b"nested": 3}]
+    SPEC.on_meta(store, payload)
+    committed_root = txn.store_root(store)
+    txn.disable()
+    payload.append(99)                      # caller mutates post-commit
+    payload[2][b"nested"] = -1
+    assert journal.verify(), \
+        "a caller mutation reached the journaled intent"
+    journal.close()
+    recovered = txn.recover(SPEC, txn.open_dir(str(tmp_path)))
+    assert recovered.blocks[b"meta"] == [1, 2, {b"nested": 3}]
+    assert txn.store_root(recovered) == committed_root
+
+
+# ---------------------------------------------------------------------------
+# torn tails: truncation at every offset + bit rot
+# ---------------------------------------------------------------------------
+
+def _single_segment(path) -> str:
+    segs = [n for n in os.listdir(path) if n.startswith("seg-")]
+    assert len(segs) == 1
+    return os.path.join(path, segs[0])
+
+
+def _build_torn_world(tmp_path):
+    """One pristine journal dir + the roots of every valid prefix."""
+    base = os.path.join(str(tmp_path), "base")
+    ops = ops_schedule(2)
+    store, _ = _run_journal(base, ops=ops)
+    prefix_roots = []
+    s = fresh_store()
+    prefix_roots.append(txn.store_root(s))
+    for op, args in ops:
+        getattr(SPEC, op)(s, *args)
+        prefix_roots.append(txn.store_root(s))
+    return base, store, prefix_roots
+
+
+# the final record is the last op's commit marker: frame (8) + payload
+# ('M' + u64 seq = 9) = 17 bytes
+_MARKER_RECORD = 17
+
+
+@pytest.mark.parametrize("back", range(1, _MARKER_RECORD + 1))
+def test_torn_tail_truncation_every_offset(tmp_path, back):
+    """Chop the final (marker) record at every byte offset: the final
+    op flips to unmarked ⇒ absent, with a torn_tail incident — and a
+    full-length copy stays complete."""
+    base, store, prefix_roots = _build_torn_world(tmp_path)
+    case = os.path.join(str(tmp_path), f"case{back}")
+    shutil.copytree(base, case)
+    seg = _single_segment(case)
+    size = os.path.getsize(seg)
+    with open(seg, "r+b") as fh:
+        fh.truncate(size - back)
+    INCIDENTS.clear()
+    recovered = txn.recover(SPEC, txn.open_dir(case))
+    # a cut at the exact record boundary (back == record size) leaves a
+    # WHOLE shorter log — no repair needed; any mid-record cut is torn
+    expected_torn = 1 if back < _MARKER_RECORD else 0
+    assert INCIDENTS.count(site="txn.journal",
+                           event="torn_tail") == expected_torn
+    # marker gone ⇒ exactly the previous prefix; intents partially
+    # chopped further back would drop the same op
+    assert txn.store_root(recovered) == prefix_roots[-2]
+    assert txn.store_root(recovered) != txn.store_root(store)
+
+
+def test_untruncated_copy_recovers_in_full(tmp_path):
+    base, store, _ = _build_torn_world(tmp_path)
+    recovered = txn.recover(SPEC, txn.open_dir(base))
+    assert txn.store_root(recovered) == txn.store_root(store)
+    assert INCIDENTS.count(site="txn.journal", event="torn_tail") == 0
+
+
+@pytest.mark.parametrize("bit", [0, 3, 7])
+@pytest.mark.parametrize("where", ["last", "middle"])
+def test_crc_bit_flip_is_atomic_or_absent(tmp_path, where, bit):
+    """Bit rot anywhere in the log: the flipped record fails its CRC,
+    the suffix is discarded (atomic-or-absent), recovery lands on a
+    valid marker-rule prefix, and no exception escapes."""
+    base, store, prefix_roots = _build_torn_world(tmp_path)
+    case = os.path.join(str(tmp_path), f"flip-{where}-{bit}")
+    shutil.copytree(base, case)
+    seg = _single_segment(case)
+    size = os.path.getsize(seg)
+    offset = size - 5 if where == "last" else size // 2
+    with open(seg, "r+b") as fh:
+        fh.seek(offset)
+        byte = fh.read(1)[0]
+        fh.seek(offset)
+        fh.write(bytes([byte ^ (1 << bit)]))
+    INCIDENTS.clear()
+    reopened = txn.open_dir(case)
+    assert INCIDENTS.count(site="txn.journal", event="torn_tail") == 1
+    recovered = txn.recover(SPEC, reopened)
+    assert txn.store_root(recovered) in prefix_roots
+    if where == "last":
+        assert txn.store_root(recovered) == prefix_roots[-2]
+
+
+def test_torn_tail_repair_then_append_reopens_clean(tmp_path):
+    """After a torn-tail repair the truncated segment accepts new
+    records, and a THIRD open sees a whole log (no stale garbage left
+    between records)."""
+    base, _, prefix_roots = _build_torn_world(tmp_path)
+    seg = _single_segment(base)
+    with open(seg, "r+b") as fh:
+        fh.truncate(os.path.getsize(seg) - 5)
+    reopened = txn.open_dir(base)
+    recovered = txn.recover(SPEC, reopened)
+    txn.enable(journal=reopened, snapshot_interval=1 << 30)
+    SPEC.on_tick(recovered, 41)
+    txn.disable()
+    reopened.close()
+    INCIDENTS.clear()
+    final = txn.recover(SPEC, txn.open_dir(base))
+    assert INCIDENTS.count(site="txn.journal", event="torn_tail") == 0
+    assert txn.store_root(final) == txn.store_root(recovered)
+
+
+# ---------------------------------------------------------------------------
+# rotation, compaction, fsync policies
+# ---------------------------------------------------------------------------
+
+def test_rotation_and_compaction_bound_disk(tmp_path):
+    store, journal = _run_journal(
+        str(tmp_path), ops=[("on_tick", (i + 1,)) for i in range(120)],
+        segment_bytes=512, snapshot_interval=8)
+    rotations = METRICS.count("txn_journal_rotations")
+    assert rotations >= 3
+    assert METRICS.count("txn_journal_compacted_segments") > 0
+    assert INCIDENTS.count(site="txn.journal", event="compacted") > 0
+    live = journal.segment_indices()
+    assert len(live) < rotations, "superseded segments not deleted"
+    # snapshot files capped at the retention window
+    snaps = [n for n in os.listdir(str(tmp_path))
+             if n.startswith("snap-")]
+    assert len(snaps) <= journal.max_snapshots
+    recovered = txn.recover(SPEC, txn.open_dir(str(tmp_path)))
+    assert txn.store_root(recovered) == txn.store_root(store)
+
+
+@pytest.mark.parametrize("policy",
+                         [FSYNC_ALWAYS, FSYNC_MARKER, FSYNC_NEVER])
+def test_fsync_policy_accounting(tmp_path, policy):
+    store, journal = _run_journal(str(tmp_path), fsync_policy=policy)
+    records = METRICS.count("txn_journal_records")
+    fsyncs = METRICS.count("txn_journal_fsyncs")
+    commits = METRICS.count("txn_journal_commits")
+    assert records > 0
+    if policy == FSYNC_NEVER:
+        assert fsyncs == 0
+    elif policy == FSYNC_ALWAYS:
+        assert fsyncs >= records
+    else:                                   # marker_only: one per commit
+        assert commits <= fsyncs < records
+    recovered = txn.recover(SPEC, txn.open_dir(str(tmp_path)))
+    assert txn.store_root(recovered) == txn.store_root(store)
+
+
+def test_fsync_kill_point_rolls_back_and_recovers(tmp_path):
+    """A seeded raise at the mid-fsync barrier aborts the handler
+    (rollback holds) and recovery converges on the committed prefix."""
+    journal = txn.DurableJournal(str(tmp_path),
+                                 fsync_policy=FSYNC_ALWAYS)
+    store = fresh_store()
+    txn.enable(journal=journal, snapshot_interval=1 << 30)
+    SPEC.on_tick(store, 1)
+    pre_root = txn.store_root(store)
+    plan = FaultPlan(
+        [FaultSpec("txn.journal.fsync", "raise", rate=1.0,
+                   max_fires=1)],
+        seed=3)
+    with faults.inject(plan):
+        with pytest.raises(DeviceFault):
+            SPEC.on_vote(store, 1)
+    txn.disable()
+    assert plan.total_fires() == 1
+    assert txn.store_root(store) == pre_root
+    journal.close()
+    recovered = txn.recover(SPEC, txn.open_dir(str(tmp_path)))
+    assert txn.store_root(recovered) == pre_root
+
+
+def test_marker_fsync_failure_is_torn_not_rollback(tmp_path):
+    """A raise inside mark_committed's fsync lands AFTER the marker is
+    (possibly) durable: the failure must classify as a TORN commit —
+    journal ahead of store, repaired by recovery — never as a rollback
+    that would leave the live store quietly diverging from what any
+    recovery reproduces."""
+    journal = txn.DurableJournal(str(tmp_path),
+                                 fsync_policy=FSYNC_MARKER)
+    store = fresh_store()
+    txn.enable(journal=journal, snapshot_interval=1 << 30)
+    SPEC.on_tick(store, 1)
+    pre_root = txn.store_root(store)
+    INCIDENTS.clear()
+    METRICS.reset()
+    plan = FaultPlan(
+        [FaultSpec("txn.journal.fsync", "raise", rate=1.0,
+                   persistent=True)],
+        seed=9)
+    with faults.inject(plan):
+        with pytest.raises(DeviceFault):
+            SPEC.on_vote(store, 7)
+    txn.disable()
+    # classified torn, not rollback: the marker record reached the OS
+    assert INCIDENTS.count(site="txn.commit", event="torn") == 1
+    assert INCIDENTS.count(event="rollback") == 0
+    assert METRICS.count_labeled("txn_torn_commits") == 1
+    journal.close()
+    # ... and recovery REDOES the marked op the live store dropped
+    recovered = txn.recover(SPEC, txn.open_dir(str(tmp_path)))
+    assert 7 in recovered.votes
+    assert txn.store_root(store) == pre_root        # live store torn
+    assert txn.store_root(recovered) != pre_root
+
+
+# ---------------------------------------------------------------------------
+# the in-memory mirror: prune-on-snapshot
+# ---------------------------------------------------------------------------
+
+def test_in_memory_prune_bounds_entries_and_recovers():
+    journal = txn.Journal()
+    store = fresh_store()
+    txn.enable(journal=journal, snapshot_interval=4)
+    for i in range(64):
+        SPEC.on_tick(store, i + 1)
+    txn.disable()
+    # entries at or before the latest anchor are pruned: the book holds
+    # at most one snapshot interval's tail, not 64 entries
+    assert len(journal) <= 4
+    assert METRICS.count("txn_journal_pruned_entries") > 0
+    recovered = txn.recover(SPEC, journal)
+    assert txn.store_root(recovered) == txn.store_root(store)
+    snap = journal.latest_snapshot()
+    assert all(e.seq > snap.entry_seq for e in journal.entries())
