@@ -13,7 +13,7 @@ RUNNERS := shuffling ssz_static operations epoch_processing sanity bls \
 	forks merkle_proof networking kzg_7594 random light_client sync
 
 .PHONY: test test-quick test-kernels tier1 chaos recovery-chaos \
-	kill-drill scenario-chaos pipeline-chaos shard-verify lint \
+	kill-drill scenario-chaos pipeline-chaos shard-verify soak lint \
 	speclint native pyspec bench \
 	gossip-bench txn-bench msm-bench merkle-bench scenario-bench \
 	multichip-bench pipeline-bench gen_all detect_errors \
@@ -93,6 +93,23 @@ recovery-chaos:
 	env JAX_PLATFORMS=cpu SPECLINT_TSAN=1 \
 		$(PYTHON) -m pytest tests/test_txn_durable.py \
 		tests/test_kill_drill.py -q --kernel-tiers
+	env JAX_PLATFORMS=cpu SPECLINT_TSAN=1 SOAK_SECONDS=45 \
+		$(PYTHON) scripts/soak.py
+
+# wall-clock soak runner (scripts/soak.py): loop durable fleet
+# scenarios — the blackout3 SIGKILL battlefield alternating with
+# randomized(durable=True) battlefields dealing kills and per-node
+# fault windows — for SOAK_SECONDS of real time under tiny journal
+# segments, asserting every round converges + attributes, disk stays
+# bounded across rounds (compaction holds), and the journal/incident
+# histories stay pruned; emits the rolling SOAK_r01.json health
+# report.  SPECLINT_TSAN rides along so the namespaced per-node lock
+# set feeds the lock-order sanitizer.  SOAK_SECONDS=45 is the quick
+# CI leg (also run by recovery-chaos); default 300.
+SOAK_SECONDS ?= 300
+soak:
+	env JAX_PLATFORMS=cpu SPECLINT_TSAN=1 \
+		SOAK_SECONDS=$(SOAK_SECONDS) $(PYTHON) scripts/soak.py
 
 # the subprocess SIGKILL drill alone (scripts/kill_drill.py): spawn a
 # node over a durable journal, SIGKILL it at each seeded barrier family

@@ -58,8 +58,10 @@ def _mutable_reason(value: ast.expr) -> str | None:
         return "a mutable container literal"
     if isinstance(value, ast.Call):
         name = _callee(value.func)
-        if name == "Router":
-            return None                     # the sanctioned pattern
+        if name in ("Router", "StateRouter"):
+            return None                     # the sanctioned patterns:
+            # attribute-delegating Router (INCIDENTS/METRICS) and the
+            # optional-singleton StateRouter (supervisor/plan/guard)
         if name in _MUTABLE_BUILTINS:
             return f"a mutable {name}()"
         if name in _STATEFUL_CLASSES:

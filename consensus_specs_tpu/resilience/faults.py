@@ -45,6 +45,7 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 
 from ..sigpipe.metrics import METRICS
+from ..utils import nodectx
 from ..utils.locks import named_rlock
 from . import sites
 from .incidents import INCIDENTS
@@ -219,11 +220,17 @@ class FaultPlan:
             return sum(s.fires for s in self.specs)
 
 
-_ACTIVE: FaultPlan | None = None
+# The active plan is a per-node-context ROUTER: a SimNode that owns a
+# `fault_plan` Slot has its own seeded schedule (possibly empty — a
+# Slot holding None is "no faults for THIS node", never a fall-through
+# to a globally injected plan), so the scenario generator can kill one
+# node's device while the rest of the fleet stays healthy.  Callers
+# with no node context land on the process-global default cell.
+_ACTIVE = nodectx.StateRouter("fault_plan")
 
 
 def active_plan() -> FaultPlan | None:
-    return _ACTIVE
+    return _ACTIVE.get()
 
 
 def fire(site: str) -> None:
@@ -233,8 +240,8 @@ def fire(site: str) -> None:
     A ``raise`` spec dies here with a `DeviceFault` (the simulated
     crash), a ``timeout`` spec stalls, and a ``corrupt`` spec is a no-op
     beyond being recorded — there is no verdict at a barrier to flip.
-    With no plan installed this is one global read."""
-    plan = _ACTIVE
+    With no plan installed this is one routed read."""
+    plan = _ACTIVE.get()
     if plan is None:
         return
     spec = plan.decide(site)
@@ -248,11 +255,13 @@ def fire(site: str) -> None:
 
 @contextmanager
 def inject(plan: FaultPlan):
-    """Install `plan` at every dispatch seam for the duration."""
-    global _ACTIVE
-    previous = _ACTIVE
-    _ACTIVE = plan
+    """Install `plan` at every dispatch seam for the duration — into
+    the active node context's plan slot when one is installed (and
+    still installed at exit: enter and exit must see the same
+    context), else process-global."""
+    previous = _ACTIVE.get()
+    _ACTIVE.set(plan)
     try:
         yield plan
     finally:
-        _ACTIVE = previous
+        _ACTIVE.set(previous)

@@ -25,6 +25,7 @@ from __future__ import annotations
 import random
 
 from ..sigpipe.metrics import METRICS
+from ..utils import nodectx
 from ..utils.locks import named_rlock
 from .incidents import INCIDENTS
 from .sites import fused_sites
@@ -94,19 +95,24 @@ class DifferentialGuard:
             sup.quarantine(site, reason="guard_mismatch")
 
 
-_ACTIVE: DifferentialGuard | None = None
+# Per-node-context ROUTER like the supervisor and the fault plan: a
+# SimNode owning a `guard` Slot samples (and quarantines) with its own
+# seeded guard — `_quarantine_backend` consults `supervisor.active()`,
+# itself routed, so a mismatch on one node quarantines only that
+# node's breaker table.  No node context installed -> the
+# process-global default cell, byte-identical to the old singleton.
+_ACTIVE = nodectx.StateRouter("guard")
 
 
 def enable(sample_rate: float = 0.05, seed: int = 0) -> DifferentialGuard:
-    global _ACTIVE
-    _ACTIVE = DifferentialGuard(sample_rate, seed)
-    return _ACTIVE
+    g = DifferentialGuard(sample_rate, seed)
+    _ACTIVE.set(g)
+    return g
 
 
 def disable() -> None:
-    global _ACTIVE
-    _ACTIVE = None
+    _ACTIVE.set(None)
 
 
 def active() -> DifferentialGuard | None:
-    return _ACTIVE
+    return _ACTIVE.get()

@@ -461,6 +461,13 @@ CONCURRENCY = Concurrency(
         # -- utils -----------------------------------------------------
         LockSpec("nodectx.stack", "consensus_specs_tpu.utils.nodectx",
                  "_lock", guards=("_stack",)),
+        LockSpec("nodectx.slot", "consensus_specs_tpu.utils.nodectx",
+                 "_lock", cls="StateRouter", guards=("_global",),
+                 note="a StateRouter's process-global default cell "
+                      "(supervisor/plan/guard singletons); per-context "
+                      "Slot values are serialized by the scenario "
+                      "driver's single-scheduler discipline, like the "
+                      "context stack itself"),
     ),
     roles=(
         ThreadRole("block",

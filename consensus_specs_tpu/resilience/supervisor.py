@@ -42,6 +42,7 @@ import threading
 from dataclasses import dataclass, field
 
 from ..sigpipe.metrics import METRICS
+from ..utils import nodectx
 from ..utils.clock import MONOTONIC
 from ..utils.locks import named_lock, named_rlock
 from . import faults
@@ -311,27 +312,34 @@ class Supervisor:
         return fallback_fn()
 
 
-_ACTIVE: Supervisor | None = None
+# The active supervisor is a per-node-context ROUTER (the
+# INCIDENTS/METRICS discipline): a SimNode that owns a `supervisor`
+# Slot gets its own breaker table — a trip, quarantine, or
+# force_scalar on node 3 leaves nodes 0-2 on the device path — while
+# callers with no node context installed land on the process-global
+# default cell exactly as before.
+_ACTIVE = nodectx.StateRouter("supervisor")
 
 
 def enable(config: SupervisorConfig | None = None, **overrides) -> Supervisor:
-    """Install a supervisor at every dispatch seam; returns it."""
-    global _ACTIVE
-    _ACTIVE = Supervisor(config, **overrides)
-    return _ACTIVE
+    """Install a supervisor at every dispatch seam (for the active node
+    context's slot when one is installed, else process-global);
+    returns it."""
+    sup = Supervisor(config, **overrides)
+    _ACTIVE.set(sup)
+    return sup
 
 
 def disable() -> None:
-    global _ACTIVE
-    _ACTIVE = None
+    _ACTIVE.set(None)
 
 
 def enabled() -> bool:
-    return _ACTIVE is not None
+    return _ACTIVE.get() is not None
 
 
 def active() -> Supervisor | None:
-    return _ACTIVE
+    return _ACTIVE.get()
 
 
 def dispatch(site: str, device_fn, fallback_fn):
@@ -345,7 +353,7 @@ def dispatch(site: str, device_fn, fallback_fn):
     """
     plan = faults.active_plan()
     fn = plan.wrap(site, device_fn) if plan is not None else device_fn
-    sup = _ACTIVE
+    sup = _ACTIVE.get()
     if sup is None:
         return fn()
     return sup.run(site, fn, fallback_fn)

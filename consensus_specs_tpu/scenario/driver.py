@@ -35,7 +35,6 @@ import os
 import random
 import shutil
 import tempfile
-from contextlib import ExitStack
 from dataclasses import dataclass, field
 
 from .. import resilience
@@ -44,6 +43,7 @@ from ..resilience import FaultPlan, FaultSpec
 from ..resilience.supervisor import SupervisorConfig
 from ..ssz import hash_tree_root
 from ..specs import get_spec
+from ..utils import nodectx
 from ..utils.clock import ManualClock
 from .dsl import Scenario
 from .net import SimNetwork
@@ -64,6 +64,11 @@ class ScenarioReport:
     feed_size: int = 0
     sync_replays: int = 0
     convergence_rounds: int = 0
+    # durable scenarios: the fleet's on-disk journal high-water mark
+    # (bytes, sampled at every slot tick) — the soak runner's
+    # bounded-disk signal.  Runtime plumbing, not part of the
+    # deterministic fingerprint.
+    durable_bytes_hw: int = 0
 
     def fingerprint(self) -> dict:
         """The deterministic projection: everything here is a pure
@@ -95,7 +100,10 @@ class ScenarioReport:
 
 class Driver:
     def __init__(self, scenario: Scenario, seed: int = 0,
-                 node_config: GossipConfig | None = None):
+                 node_config: GossipConfig | None = None,
+                 snapshot_interval: int = 256,
+                 journal_kwargs: dict | None = None,
+                 supervisor_overrides: dict | None = None):
         scenario.validate()
         self.scenario = scenario
         self.seed = int(seed)
@@ -116,19 +124,29 @@ class Driver:
         if scenario.durable:
             self._durable_root = tempfile.mkdtemp(
                 prefix=f"scenario-{scenario.name}-")
+        # every node gets its OWN supervisor (breaker table on the
+        # shared ManualClock) and fault-plan slot: a degraded window or
+        # shard death on one node is invisible to the others;
+        # `supervisor_overrides` tunes the per-node breakers (the soak
+        # runner and the isolation tests run trippier thresholds)
+        sup_overrides = supervisor_overrides or {}
         self.nodes = [
             SimNode(i, self.spec, self.plan.genesis_state, self.clock,
                     config=node_config,
                     transport=self._transport_for(i),
+                    supervisor_config=SupervisorConfig(
+                        clock=self.clock, **sup_overrides),
+                    snapshot_interval=snapshot_interval,
+                    journal_kwargs=journal_kwargs,
                     durable_dir=os.path.join(self._durable_root,
                                              f"node{i}")
                     if self._durable_root else None)
             for i in range(scenario.nodes)]
         self.oracle = Oracle(self.spec, self.plan, self.clock)
         self._digests: dict = {}            # feed seq -> payload digest
-        self._degraded = None               # open fault-window stack
         self.sync_replays = 0
         self.convergence_rounds = 0
+        self.durable_bytes_hw = 0
 
     # -- transport seam ------------------------------------------------
     def _transport_for(self, node_id: int):
@@ -150,15 +168,16 @@ class Driver:
 
     # -- the run -------------------------------------------------------
     def run(self) -> ScenarioReport:
-        previous_sup = resilience.supervisor.active()
-        sup = resilience.enable(SupervisorConfig(clock=self.clock))
+        # the process-global DEFAULT supervisor serves the oracle and
+        # any out-of-context work; each SimNode routes to its own
+        previous_sup = resilience.supervisor._ACTIVE.default
+        resilience.enable(SupervisorConfig(clock=self.clock))
         try:
-            return self._run(sup)
+            return self._run()
         finally:
-            if self._degraded is not None:
-                self._degraded.close()
-                self._degraded = None
-            resilience.supervisor._ACTIVE = previous_sup
+            for node in self.nodes:
+                node.install_fault_plan(None)
+            resilience.supervisor._ACTIVE.set_default(previous_sup)
             if self._durable_root is not None:
                 for node in self.nodes:
                     if node.journal is not None and \
@@ -166,7 +185,7 @@ class Driver:
                         node.journal.close()
                 shutil.rmtree(self._durable_root, ignore_errors=True)
 
-    def _run(self, sup) -> ScenarioReport:
+    def _run(self) -> ScenarioReport:
         scenario = self.scenario
         agenda = []
         end_slot = scenario.slots + 2
@@ -198,7 +217,15 @@ class Driver:
             elif kind == "interval_tick":
                 self._tick_stores(time_s)
             elif kind == "action":
-                self._action(item, sup)
+                # deliveries already DUE land before the control point
+                # mutates topology: a partition cut must not
+                # retroactively stall an in-flight message the storm
+                # planner's establishment contract (publish + margin <
+                # cut => delivered pre-cut) counted as arrived — the
+                # agenda can be sparse enough that no pump ran between
+                # the due time and the cut
+                self._pump()
+                self._action(item)
             else:
                 self._publish(item)
             self._pump()
@@ -224,13 +251,14 @@ class Driver:
 
     def _tick(self, slot: int) -> None:
         self._tick_stores(self.plan.slot_time(slot))
+        self._sample_disk()
         # slot boundary: gossip redundancy repairs plain drop losses
         self.net.flush_stalls(self.clock.now(), kinds=("drop",))
         for node in self.nodes:
             node.pump_retries(self.clock.now())
         self.oracle.pump_retries(self.clock.now())
 
-    def _action(self, action, sup) -> None:
+    def _action(self, action) -> None:
         now = self.clock.now()
         kind = action.kind
         if kind == "partition":
@@ -258,20 +286,51 @@ class Driver:
             self.net.flush_stalls(now, kinds=("drop", "crash"))
             self._catch_up(node, reason="recover")
         elif kind == "degraded":
-            assert self._degraded is None, "nested degraded windows"
-            self._degraded = ExitStack()
-            self._degraded.enter_context(resilience.inject(FaultPlan(
-                # speclint: disable=seam-dynamic-site -- the site comes
-                # from the scenario DSL; dsl.validate() rejects any name
-                # not in the resilience.sites registry before a run starts
-                [FaultSpec(action.params["site"], "raise",
-                           persistent=True)], seed=self.seed)))
+            site = action.params["site"]
+            fault = action.params.get("fault") or "raise"
+            for node in self._window_targets(action.params.get("node")):
+                # one seeded plan PER NODE, installed in that node's
+                # own slot: a fleet-wide window still trips N separate
+                # breakers (one per book), and a targeted window never
+                # draws from — or fires on — any other node's stream
+                node.install_fault_plan(FaultPlan(
+                    # speclint: disable=seam-dynamic-site -- the site
+                    # comes from the scenario DSL; dsl.validate() rejects
+                    # any name not in the resilience.sites registry
+                    # before a run starts
+                    [FaultSpec(site, fault, persistent=True)],
+                    seed=self.seed * 1000003 + node.node_id))
         elif kind == "degraded_end":
-            self._degraded.close()
-            self._degraded = None
-            sup.reset(action.params["site"])
+            site = action.params["site"]
+            for node in self._window_targets(action.params.get("node")):
+                node.install_fault_plan(None)
+                # under the node's context: the reset incident is that
+                # node's record, like the trip that preceded it
+                with nodectx.use(node.ctx):
+                    node.supervisor.reset(site)
         else:                                # pragma: no cover
             raise AssertionError(f"unknown action {kind!r}")
+
+    def _window_targets(self, target) -> list:
+        """The nodes a degraded window arms/disarms: all of them for a
+        fleet-wide window (target None), else exactly one."""
+        return self.nodes if target is None \
+            else [self.nodes[int(target)]]
+
+    def _sample_disk(self) -> None:
+        """Track the fleet's on-disk journal high-water mark (durable
+        scenarios): the soak runner asserts it stays bounded across
+        rounds, i.e. snapshot-anchored compaction is really deleting
+        superseded segments."""
+        if self._durable_root is None:
+            return
+        total = 0
+        for node in self.nodes:
+            journal = node.journal
+            if journal is not None and hasattr(journal, "disk_bytes"):
+                total += journal.disk_bytes()
+        if total > self.durable_bytes_hw:
+            self.durable_bytes_hw = total
 
     def _publish(self, planned) -> None:
         digest = bytes(hash_tree_root(planned.payload))
@@ -350,12 +409,14 @@ class Driver:
 
     # -- reporting -----------------------------------------------------
     def _report(self) -> ScenarioReport:
+        self._sample_disk()
         report = ScenarioReport(
             scenario=self.scenario, seed=self.seed,
             oracle=self.oracle.summary(),
             feed_size=len(self.net.published),
             sync_replays=self.sync_replays,
-            convergence_rounds=self.convergence_rounds)
+            convergence_rounds=self.convergence_rounds,
+            durable_bytes_hw=self.durable_bytes_hw)
         for node in self.nodes:
             node.leak_check()
             report.nodes.append(node_summary(node))
@@ -365,6 +426,12 @@ class Driver:
 
 
 def run_scenario(scenario: Scenario, seed: int = 0,
-                 node_config: GossipConfig | None = None) \
+                 node_config: GossipConfig | None = None,
+                 snapshot_interval: int = 256,
+                 journal_kwargs: dict | None = None,
+                 supervisor_overrides: dict | None = None) \
         -> ScenarioReport:
-    return Driver(scenario, seed, node_config).run()
+    return Driver(scenario, seed, node_config,
+                  snapshot_interval=snapshot_interval,
+                  journal_kwargs=journal_kwargs,
+                  supervisor_overrides=supervisor_overrides).run()

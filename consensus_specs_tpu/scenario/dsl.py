@@ -51,7 +51,8 @@ __all__ = [
     "LinkSpec", "Topology", "TrafficSpec", "Event", "Scenario",
     "partition", "heal", "equivocation_storm", "surround_attack",
     "long_range_fork", "crash", "kill", "recover", "degraded",
-    "ADVERSARIAL_KINDS", "LIBRARY", "named", "randomized",
+    "ADVERSARIAL_KINDS", "DEGRADED_FAULTS", "LIBRARY", "named",
+    "randomized",
 ]
 
 ADVERSARIAL_KINDS = frozenset({
@@ -170,16 +171,29 @@ def recover(at_slot: float, node: int) -> Event:
     return _event(at_slot, "recover", node=int(node))
 
 
+DEGRADED_FAULTS = ("raise", "shard_dead")
+
+
 def degraded(at_slot: float, until_slot: float,
-             site: str = "gossip.batch_verify") -> Event:
+             site: str = "gossip.batch_verify",
+             node: int | None = None, fault: str = "raise") -> Event:
     """A breaker-open window: a persistent injected fault at `site`
-    trips the (process-shared) breaker during some node's dispatch;
-    at `until_slot` the fault is lifted and the breaker reset.
-    Verdicts stay byte-identical throughout (that is the breaker's
-    contract) — the window shows up in incidents and fallback
+    trips the breaker during the targeted node's dispatches; at
+    `until_slot` the fault is lifted and the breaker reset.
+
+    `node=None` degrades the whole fleet (every node gets its own
+    seeded plan — breakers are per-node since the fault-isolation PR,
+    so N breakers trip, one per book); `node=i` degrades ONLY node i:
+    every other node stays on the device path, pinned by the isolation
+    tests.  `fault` picks the injected kind: ``raise`` (a dead device
+    runtime) or ``shard_dead`` (one seeded mesh member dies — same
+    breaker contract, the incident records which shard).  Verdicts
+    stay byte-identical throughout (that is the breaker's contract) —
+    the window shows up in the targeted node's incidents and fallback
     metrics."""
     return _event(at_slot, "degraded", until_slot=float(until_slot),
-                  site=site)
+                  site=site, node=None if node is None else int(node),
+                  fault=fault)
 
 
 @dataclass(frozen=True)
@@ -210,7 +224,7 @@ class Scenario:
         assert self.nodes >= 1 and self.slots >= 2
         down: set = set()
         partitioned = False
-        degraded_until = 0.0
+        degraded_windows: list = []     # (until_slot, target-or-None)
         for e in self.sorted_events():
             assert 0.0 <= e.at_slot, f"event before genesis: {e}"
             assert e.at_slot <= self.slots + 1, f"event after end: {e}"
@@ -239,15 +253,31 @@ class Scenario:
                 assert 0 <= e.get("origin") < self.nodes
             elif e.kind == "degraded":
                 assert e.get("until_slot") > e.at_slot
-                assert e.at_slot >= degraded_until, \
-                    f"overlapping degraded windows: {e}"
+                target = e.get("node")
+                if target is not None:
+                    assert 0 <= target < self.nodes, \
+                        f"degraded window targets unknown node: {e}"
+                assert e.get("fault", "raise") in DEGRADED_FAULTS, \
+                    f"degraded window names unknown fault kind: {e}"
+                # windows on DIFFERENT nodes may overlap freely (that
+                # is the point of per-node isolation); two windows on
+                # the same target — or any overlap with a fleet-wide
+                # window — would have the second install clobber the
+                # first plan and the first end clear the second
+                for until, other in degraded_windows:
+                    if e.at_slot < until and (target is None
+                                              or other is None
+                                              or target == other):
+                        raise AssertionError(
+                            f"overlapping degraded windows on the "
+                            f"same target: {e}")
                 # the driver injects a persistent fault at this site;
                 # an unregistered name would inject at a seam that does
                 # not exist and the window would silently test nothing
                 from ..resilience import sites
                 assert sites.is_registered(e.get("site")), \
                     f"degraded window names unregistered site: {e}"
-                degraded_until = e.get("until_slot")
+                degraded_windows.append((e.get("until_slot"), target))
             else:
                 raise AssertionError(f"unknown event kind {e.kind!r}")
         assert not down, f"nodes still crashed at scenario end: {down}"
@@ -348,48 +378,107 @@ def named(name: str) -> Scenario:
             f"unknown scenario {name!r}; known: {sorted(LIBRARY)}")
 
 
-def randomized(rng, nodes: int | None = None) -> Scenario:
+def randomized(rng, nodes: int | None = None,
+               durable: bool | None = None) -> Scenario:
     """A seeded random scenario inside the convergence envelope: random
     partition/heal pairs (healed within the staleness window), storms,
-    crash/recover pairs, degraded windows.  Drives the slow-marked
-    scenario-matrix tier — "as many scenarios as you can imagine" as a
-    generator, not a hand-written list."""
+    crash-or-KILL/recover pairs, *per-node* fault schedules (fleet-wide
+    or single-node degraded windows, plus shard_dead windows targeting
+    one node while the rest of the fleet stays on the device path), and
+    long-range forks.
+
+    `durable` controls the SIGKILL model: True forces on-disk journals
+    (the soak runner's setting, making kill draws legal), False never
+    deals a kill, and None (the default) lets the draw decide — a dealt
+    `kill` sets `Scenario.durable=True`, since `validate()` rejects a
+    kill without a disk journal to recover from.  Drives the
+    slow-marked scenario-matrix tier and the wall-clock soak runner —
+    "as many scenarios as you can imagine" as a generator, not a
+    hand-written list."""
     n = nodes if nodes is not None else rng.choice([3, 4, 5])
     slots = rng.choice([6, 7, 8])
     events: list = []
     # partitions start at slot >= 2 so at least block 1 is established
     # fleet-wide before the cut (the storm planner's envelope)
     t = 2.0 + rng.random()
+    dealt_partition = False
+    dealt_storm = False
+    heal_at = 0.0
     if rng.random() < 0.8:      # partition + heal within an epoch
+        dealt_partition = True
         ids = list(range(n))
         rng.shuffle(ids)
         cut = rng.randrange(1, n)
         events.append(partition(t, (tuple(ids[:cut]), tuple(ids[cut:]))))
-        heal_at = min(t + 1.0 + 2.0 * rng.random(), slots - 1.0)
-        events.append(heal(max(heal_at, t + 0.5)))
+        heal_at = max(min(t + 1.0 + 2.0 * rng.random(), slots - 1.0),
+                      t + 0.5)
+        events.append(heal(heal_at))
     if rng.random() < 0.8:
+        dealt_storm = True
         # storm slot is int(at_slot) - 1 and needs an established
         # parent, so the window starts at slot 3
         events.append(equivocation_storm(
             3.0 + rng.random() * (slots - 4.0),
             origin=rng.randrange(n),
             validators=rng.choice([1, 2, 3])))
+    victim = None
     if rng.random() < 0.6 and n > 2:
         victim = rng.randrange(1, n)
         at = 2.0 + rng.random() * (slots - 4.0)
-        events.append(crash(at, node=victim))
+        # SIGKILL model when the journal is (or may become) durable:
+        # the in-memory journal object dies with the node and recovery
+        # reopens the on-disk segment directory
+        deal_kill = durable is not False and rng.random() < 0.4
+        events.append((kill if deal_kill else crash)(at, node=victim))
         events.append(recover(
             min(at + 1.0 + rng.random() * 1.5, slots - 0.5),
             node=victim))
+    # fault windows: one raise window (fleet-wide or single-node), and
+    # maybe a shard_dead window pinned to one node.  A crashed victim
+    # is never targeted, and when a partition was dealt the windows
+    # ride strictly AFTER the heal: a down — or singleton-partitioned —
+    # target sees only single-message windows, so the batch site never
+    # dispatches and the window would leave no incident to attribute.
+    windows: list = []          # (until, target) dealt so far
+    healthy = [i for i in range(n) if i != victim]
+    window_lo = max(1.0, heal_at)
+    window_hi = max(window_lo + 0.2, slots - 2.0)
+
+    def deal_window(target, fault):
+        at = window_lo + rng.random() * (window_hi - window_lo)
+        # dodge a conflicting earlier window (same node, or a
+        # fleet-wide one): start strictly after it ends
+        for until0, target0 in windows:
+            if at < until0 and (target0 is None or target0 == target
+                                or target is None):
+                at = until0 + 0.1
+        until = min(at + 1.0 + rng.random(), slots + 0.9)
+        if until - at >= 0.5:
+            events.append(degraded(at, until, node=target, fault=fault))
+            windows.append((until, target))
+
     if rng.random() < 0.4:
-        at = 1.0 + rng.random() * (slots - 3.0)
-        events.append(degraded(at, at + 1.0 + rng.random()))
+        target = rng.choice(healthy) if victim is not None \
+            else rng.choice([None] + healthy)
+        deal_window(target, "raise")
+    if rng.random() < 0.4:
+        deal_window(rng.choice(healthy), "shard_dead")
     if rng.random() < 0.4 and slots >= 6:
         events.append(long_range_fork(
             slots - 1.5 + rng.random(), origin=rng.randrange(n),
             fork_slot=rng.choice([1, 2]), length=rng.choice([1, 2])))
+    # the envelope's drop rule (mainnet_burst16 precedent): a drop
+    # stall straddling partition onset is upgraded to partition
+    # severity, so a pre-cut block can arrive only at heal — and a
+    # storm's conflicting same-epoch vote would then win the
+    # first-vote-wins LMD race at partitioned nodes while the oracle
+    # saw the canonical vote first.  Storm + partition scenarios
+    # therefore run lossless links; either alone keeps random drops.
+    drop = 0.0 if (dealt_partition and dealt_storm) \
+        else rng.choice([0.0, 0.05, 0.15])
     scenario = Scenario(
         name=f"random_{n}n_{slots}s", nodes=n, slots=slots,
+        durable=bool(durable) or any(e.kind == "kill" for e in events),
         traffic=TrafficSpec(
             attestation_fraction=rng.choice([0.5, 1.0]),
             aggregates=rng.random() < 0.8,
@@ -398,7 +487,7 @@ def randomized(rng, nodes: int | None = None) -> Scenario:
         topology=Topology(link=LinkSpec(
             delay_s=0.1 + 0.3 * rng.random(),
             jitter_s=0.3 * rng.random(),
-            drop_rate=rng.choice([0.0, 0.05, 0.15]))),
+            drop_rate=drop)),
         events=tuple(events))
     scenario.validate()
     return scenario

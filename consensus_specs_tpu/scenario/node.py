@@ -10,7 +10,12 @@ A `SimNode` owns everything PR 1-6 built, instantiated per node:
 * a `NodeContext` carrying a `Metrics(node_id=...)` registry and an
   `IncidentLog(node_id=..., clock=sim)` — every metric and incident
   from this node's steps lands in ITS books, which is what fleet-wide
-  attribution asserts against.
+  attribution asserts against;
+* its OWN resilience namespace (supervisor / fault-plan / guard
+  Slots): a breaker trip, injected fault schedule, or quarantine on
+  this node is invisible to every other node — the per-node fault
+  isolation the soak runner and the randomized generator's per-node
+  schedules drive.
 
 Durable vs volatile state is the crash model's contract:
 
@@ -45,6 +50,7 @@ from .. import txn
 from ..gossip import AdmissionPipeline, GossipConfig
 from ..gossip.dedup import EquivocationGuard
 from ..resilience.incidents import IncidentLog
+from ..resilience.supervisor import Supervisor, SupervisorConfig
 from ..sigpipe.metrics import Metrics
 from ..test_infra.fork_choice import get_genesis_forkchoice_store
 from ..utils import nodectx
@@ -54,7 +60,9 @@ class SimNode:
     def __init__(self, node_id: int, spec, anchor_state, clock,
                  config: GossipConfig | None = None, transport=None,
                  snapshot_interval: int = 256,
-                 durable_dir: str | None = None):
+                 durable_dir: str | None = None,
+                 supervisor_config: SupervisorConfig | None = None,
+                 journal_kwargs: dict | None = None):
         self.node_id = int(node_id)
         self.name = f"node{node_id}"
         self.spec = spec
@@ -65,15 +73,32 @@ class SimNode:
             # quotas generous by default (the bench scenario overrides)
             bucket_capacity=1 << 14, refill_rate=1 << 12,
             queue_depth=1 << 12)
+        # the node's OWN resilience namespace: its own breaker table
+        # (supervisor Slot), its own fault-plan Slot (empty = no
+        # faults for THIS node, never a fall-through to a globally
+        # injected plan), and a guard Slot — a degraded window,
+        # shard_dead, or breaker trip here leaves every other node on
+        # the device path.  Like metrics/incidents, the slots survive
+        # crash()/kill(): they are the driver's per-node books, not
+        # in-process node state.
         self.ctx = nodectx.NodeContext(
             self.name, metrics=Metrics(node_id=self.name),
             incidents=IncidentLog(max_entries=1 << 14,
-                                  node_id=self.name, clock=clock))
+                                  node_id=self.name, clock=clock),
+            supervisor=nodectx.Slot(Supervisor(
+                supervisor_config or SupervisorConfig(clock=clock))),
+            fault_plan=nodectx.Slot(None),
+            guard=nodectx.Slot(None))
         # durable state
         self.durable_dir = durable_dir
         self.snapshot_interval = snapshot_interval
+        # extra DurableJournal knobs (segment_bytes, fsync_policy): the
+        # soak runner shrinks segments so rotation + compaction really
+        # fire inside a round
+        self.journal_kwargs = dict(journal_kwargs or {})
         if durable_dir is not None:
-            self.journal = txn.DurableJournal(durable_dir)
+            self.journal = txn.DurableJournal(durable_dir,
+                                              **self.journal_kwargs)
         else:
             self.journal = txn.Journal()
         self.manager = txn.TxnManager(self.journal,
@@ -136,7 +161,8 @@ class SimNode:
         assert not self.up and self.store is None
         if self.journal is None:            # killed: reopen from disk
             with nodectx.use(self.ctx):
-                self.journal = txn.open_dir(self.durable_dir)
+                self.journal = txn.open_dir(self.durable_dir,
+                                            **self.journal_kwargs)
             self.manager = txn.TxnManager(
                 self.journal, snapshot_interval=self.snapshot_interval)
         with self.scope():
@@ -149,6 +175,19 @@ class SimNode:
         with nodectx.use(self.ctx):
             with txn.use(self.manager):
                 yield
+
+    # -- the per-node resilience surface -------------------------------
+    @property
+    def supervisor(self) -> Supervisor:
+        return self.ctx.supervisor.value
+
+    def breaker_states(self) -> dict:
+        return self.supervisor.breaker_states()
+
+    def install_fault_plan(self, plan) -> None:
+        """Arm `plan` for THIS node only (the driver's per-node
+        degraded windows); None disarms."""
+        self.ctx.fault_plan.value = plan
 
     # -- the driver-facing surface -------------------------------------
     def tick(self, time: int) -> None:

@@ -125,6 +125,7 @@ class Oracle:
 
 def node_summary(node) -> dict:
     checkpoint = node.store.finalized_checkpoint
+    journal = node.journal
     return {
         "node_id": node.name,
         "store_root": node.store_root().hex(),
@@ -134,6 +135,15 @@ def node_summary(node) -> dict:
         "accepted": len(node.accepted),
         "crashes": node.crashes,
         "quarantined": sorted(node.guard.quarantined),
+        # per-node resilience + journal books (the soak runner's
+        # bounded-memory/bounded-disk and fault-accounting signals;
+        # deliberately NOT part of the fingerprint projection)
+        "breakers": node.breaker_states(),
+        "journal_entries": len(journal) if journal is not None else 0,
+        "journal_disk_bytes": journal.disk_bytes()
+        if hasattr(journal, "disk_bytes") else 0,
+        "journal_segments": len(journal.segment_indices())
+        if hasattr(journal, "segment_indices") else 0,
         "metrics": node.ctx.metrics.snapshot(),
         "incidents": node.ctx.incidents.snapshot(),
     }

@@ -205,9 +205,13 @@ class TrafficPlan:
             self.actions.append(EventAction(
                 t, kind, {k: v for k, v in event.params}))
             if kind == "degraded":
+                # the end action mirrors the window's target so the
+                # driver disarms (and breaker-resets) exactly the
+                # node(s) the open action armed
                 self.actions.append(EventAction(
                     self.slot_time(event.get("until_slot")),
-                    "degraded_end", {"site": event.get("site")}))
+                    "degraded_end", {"site": event.get("site"),
+                                     "node": event.get("node")}))
             return
         if kind == "equivocation_storm":
             self._plan_storm(event, t, rng)

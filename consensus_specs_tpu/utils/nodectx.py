@@ -39,20 +39,51 @@ from contextlib import contextmanager
 from .locks import named_rlock
 
 
+class Slot:
+    """A mutable per-context cell for a value that may legitimately be
+    None — an uninstalled `FaultPlan`, a disabled `Supervisor` or
+    `DifferentialGuard`.  A `NodeContext` attribute that is a Slot (even
+    one holding None) CLAIMS that stream: the StateRouter stops at the
+    slot instead of falling through to the process-global default,
+    which is exactly what keeps a globally injected fault plan from
+    leaking into a SimNode that owns its own (empty) plan slot."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value=None):
+        self.value = value
+
+    def __repr__(self) -> str:
+        return f"Slot({self.value!r})"
+
+
 class NodeContext:
-    """One simulated node's observability namespace.
+    """One simulated node's observability + resilience namespace.
 
     `metrics` / `incidents` are duck-typed (a `sigpipe.metrics.Metrics`
     and a `resilience.incidents.IncidentLog` in practice); either may be
     None to keep that stream on the process-global default.
+
+    `supervisor` / `fault_plan` / `guard` are the resilience slots
+    (each a :class:`Slot` or None): a node that owns them gets its own
+    circuit-breaker table, injected fault schedule, and differential
+    guard — a breaker trip or degraded window on this node leaves the
+    rest of the fleet on the device path.  Leaving a slot at None keeps
+    that singleton on the process-global default, exactly like
+    metrics/incidents.
     """
 
-    __slots__ = ("node_id", "metrics", "incidents")
+    __slots__ = ("node_id", "metrics", "incidents",
+                 "supervisor", "fault_plan", "guard")
 
-    def __init__(self, node_id: str, metrics=None, incidents=None):
+    def __init__(self, node_id: str, metrics=None, incidents=None,
+                 supervisor=None, fault_plan=None, guard=None):
         self.node_id = str(node_id)
         self.metrics = metrics
         self.incidents = incidents
+        self.supervisor = supervisor
+        self.fault_plan = fault_plan
+        self.guard = guard
 
     def __repr__(self) -> str:
         return f"NodeContext({self.node_id!r})"
@@ -64,13 +95,13 @@ _stack: list = []
 
 class Router:
     """The module-global delegation seam shared by `resilience.INCIDENTS`
-    and `sigpipe.METRICS` (and any future per-node registry — the
-    ROADMAP names the supervisor's breaker table next): every attribute
-    access consults the context stack and lands on the active context's
-    `attr` registry when one is installed, else on the process-global
-    default.  `from ... import NAME` binds the router object by value
-    everywhere, so the routing must live *inside* it, not in the module
-    name."""
+    and `sigpipe.METRICS`: every attribute access consults the context
+    stack and lands on the active context's `attr` registry when one is
+    installed, else on the process-global default.  `from ... import
+    NAME` binds the router object by value everywhere, so the routing
+    must live *inside* it, not in the module name.  (Singletons that may
+    be None — the supervisor/plan/guard — ride :class:`StateRouter`
+    below instead.)"""
 
     def __init__(self, default, attr: str):
         self._default = default
@@ -95,6 +126,57 @@ class Router:
 
     def __len__(self) -> int:            # len() bypasses __getattr__
         return len(self._target())
+
+
+class StateRouter:
+    """Router over an *optional singleton* — the resilience layer's
+    `supervisor._ACTIVE` / `faults._ACTIVE` / `guard._ACTIVE` — where
+    the routed value may legitimately be None (disabled / no plan
+    installed), so the attribute-delegation `Router` above cannot
+    carry it.  `get()`/`set()` land on the active context's
+    :class:`Slot` when one is installed (a Slot holding None is an
+    explicit "this node has no supervisor/plan/guard", NOT a
+    fall-through), else on the process-global default cell — the same
+    `.default` bypass contract as INCIDENTS/METRICS, so callers with
+    no node context installed are byte-for-byte untouched."""
+
+    def __init__(self, attr: str):
+        self._attr = attr
+        self._lock = named_rlock("nodectx.slot")
+        self._global = None
+
+    def _slot(self) -> Slot | None:
+        ctx = current()
+        if ctx is not None:
+            return getattr(ctx, self._attr, None)
+        return None
+
+    def get(self):
+        slot = self._slot()
+        if slot is not None:
+            return slot.value
+        with self._lock:
+            return self._global
+
+    def set(self, value) -> None:
+        slot = self._slot()
+        if slot is not None:
+            slot.value = value
+            return
+        with self._lock:
+            self._global = value
+
+    @property
+    def default(self):
+        """The process-global value, bypassing any installed context."""
+        with self._lock:
+            return self._global
+
+    def set_default(self, value) -> None:
+        """Write the process-global cell, bypassing any installed
+        context (the scenario driver's restore path)."""
+        with self._lock:
+            self._global = value
 
 
 def current() -> NodeContext | None:

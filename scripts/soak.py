@@ -1,0 +1,239 @@
+#!/usr/bin/env python
+"""Wall-clock soak runner: loop durable fleet scenarios for a time
+budget, asserting the bounded-disk / bounded-memory / convergence
+contracts hold round after round.
+
+The scenario harness proves one run converges; the ROADMAP's
+months-long-drill direction needs the orthogonal claim — that NOTHING
+accumulates across runs: snapshot-anchored compaction really deletes
+superseded segments (disk bounded), the journal / incident / verdict
+histories really prune (memory bounded), and every round still
+converges byte-identically to the oracle with every fault attributed.
+This runner is that claim as an executable: it alternates the
+`blackout3` SIGKILL battlefield (the stable disk-comparison baseline —
+same scenario shape every time, so its disk high-water mark across
+rounds is directly comparable) with seeded `randomized(durable=True)`
+battlefields (kill events, per-node degraded and shard_dead windows),
+under aggressive journal settings (tiny segments, short snapshot
+interval) so rotation + compaction fire INSIDE every round.
+
+After every round the rolling health report is rewritten atomically
+(tmp+rename), so a soak killed mid-flight still leaves a valid JSON
+snapshot of everything it proved up to that point.
+
+Environment:
+    SOAK_SECONDS     wall-clock budget (default 300); the current
+                     round always finishes
+    SOAK_MIN_ROUNDS  complete at least this many rounds even past the
+                     budget (default 3)
+    SOAK_SEED        master seed (default 20260804)
+    SOAK_NODES       fixed node count for randomized rounds (optional)
+    SOAK_REPORT      report path (default SOAK_r01.json)
+
+Exit status: 0 with `"ok": true` in the report, 1 on any violated
+contract (the report records the failure first).  Under SPECLINT_TSAN=1
+the run also fails on any lock-order violation the runtime sanitizer
+observed (`make soak` arms it).
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import resource
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from consensus_specs_tpu import scenario                  # noqa: E402
+from consensus_specs_tpu.test_infra import disable_bls    # noqa: E402
+from consensus_specs_tpu.utils import locks               # noqa: E402
+
+# aggressive journal settings: ~50-commit rounds must rotate segments
+# and compact, or the bounded-disk assertion is vacuous
+SNAPSHOT_INTERVAL = 8
+JOURNAL_KWARGS = {"segment_bytes": 4096}
+
+# trippy per-node breakers: a degraded window inside a short round
+# should actually OPEN the targeted node's breaker, so the report's
+# trip counts exercise (and witness) the per-node isolation path
+SUPERVISOR_OVERRIDES = {"max_retries": 0, "breaker_threshold": 2}
+
+# a node's in-memory journal prunes to <= the snapshot interval plus
+# the uncommitted tail of the window in flight
+JOURNAL_ENTRY_BOUND = SNAPSHOT_INTERVAL + 16
+# a SimNode's IncidentLog caps at 1<<14 by FIFO eviction; a round that
+# FILLS it has silently dropped records, and attribution (which reads
+# the book) can no longer be trusted — so the soak asserts rounds stay
+# strictly below the cap, not at it
+INCIDENT_SATURATION = 1 << 14
+
+# the same-scenario disk high-water mark may drift with the per-round
+# seed (jitter draws reshape the feed slightly) but must not trend:
+# compaction holds iff every blackout3 round stays within this factor
+# of the smallest one
+DISK_DRIFT_FACTOR = 2.0
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    return int(raw) if raw else default
+
+
+def _round_scenario(index: int, rng: random.Random):
+    """Alternate the stable baseline with randomized durable
+    battlefields; every returned scenario owns on-disk journals."""
+    if index % 2 == 0:
+        return scenario.named("blackout3")
+    nodes = _env_int("SOAK_NODES", 0)
+    return scenario.randomized(rng, nodes=nodes or None, durable=True)
+
+
+def _run_round(sc, seed: int) -> dict:
+    with disable_bls():
+        report = scenario.run_scenario(
+            sc, seed=seed, snapshot_interval=SNAPSHOT_INTERVAL,
+            journal_kwargs=JOURNAL_KWARGS,
+            supervisor_overrides=SUPERVISOR_OVERRIDES)
+    scenario.assert_converged(report)
+    scenario.assert_attributed(report)
+    faults = {}
+    trips = restores = compactions = segments = 0
+    for node in report.nodes:
+        counters = node["metrics"]
+        faults[node["node_id"]] = int(counters.get("faults_injected", 0))
+        trips += int(counters.get("breaker_trips", 0))
+        restores += int(counters.get("breaker_restores", 0))
+        segments += node["journal_segments"]
+        compactions += sum(1 for e in node["incidents"]
+                           if e["site"] == "txn.journal"
+                           and e["event"] == "compacted")
+        assert node["journal_entries"] <= JOURNAL_ENTRY_BOUND, \
+            f"{node['node_id']} journal grew past the prune bound: " \
+            f"{node['journal_entries']} > {JOURNAL_ENTRY_BOUND}"
+        assert len(node["incidents"]) < INCIDENT_SATURATION, \
+            f"{node['node_id']} incident book saturated — FIFO " \
+            f"eviction is silently dropping records"
+    assert report.durable_bytes_hw > 0, \
+        "durable round sampled no disk usage — the high-water probe " \
+        "is broken"
+    return {
+        "scenario": sc.name,
+        "seed": seed,
+        "nodes": sc.nodes,
+        "events": len(sc.events),
+        "feed_size": report.feed_size,
+        "disk_hw_bytes": report.durable_bytes_hw,
+        "segments_at_end": segments,
+        "compactions": compactions,
+        "faults_per_node": faults,
+        "breaker_trips": trips,
+        "breaker_restores": restores,
+    }
+
+
+def _write_report(path: str, payload: dict) -> None:
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def main() -> int:
+    budget_s = _env_int("SOAK_SECONDS", 300)
+    min_rounds = _env_int("SOAK_MIN_ROUNDS", 3)
+    master_seed = _env_int("SOAK_SEED", 20260804)
+    report_path = os.environ.get("SOAK_REPORT", "SOAK_r01.json")
+    rng = random.Random(master_seed)
+
+    started = time.monotonic()
+    deadline = started + budget_s
+    rounds: list = []
+    report = {
+        "schema_version": 1,
+        "budget_s": budget_s,
+        "min_rounds": min_rounds,
+        "seed": master_seed,
+        "snapshot_interval": SNAPSHOT_INTERVAL,
+        "journal": JOURNAL_KWARGS,
+        "ok": False,
+        "rounds": rounds,
+    }
+
+    def aggregate(error: str | None) -> None:
+        faults: dict = {}
+        for r in rounds:
+            for node_id, count in r["faults_per_node"].items():
+                faults[node_id] = faults.get(node_id, 0) + count
+        baseline = [r["disk_hw_bytes"] for r in rounds
+                    if r["scenario"] == "blackout3"]
+        report.update({
+            "elapsed_s": round(time.monotonic() - started, 3),
+            "rounds_completed": len(rounds),
+            "faults_fired_per_node": dict(sorted(faults.items())),
+            "breaker_trips": sum(r["breaker_trips"] for r in rounds),
+            "breaker_restores": sum(r["breaker_restores"]
+                                    for r in rounds),
+            "compactions": sum(r["compactions"] for r in rounds),
+            "disk_high_water_bytes": max(
+                (r["disk_hw_bytes"] for r in rounds), default=0),
+            "baseline_disk_hw_bytes": baseline,
+            "ru_maxrss_kb": resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss,
+            "ok": error is None,
+        })
+        if error is not None:
+            report["error"] = error
+        _write_report(report_path, report)
+
+    index = 0
+    try:
+        while index < min_rounds or time.monotonic() < deadline:
+            seed = master_seed + index
+            sc = _round_scenario(index, rng)
+            t0 = time.monotonic()
+            entry = _run_round(sc, seed)
+            entry["round"] = index + 1
+            entry["round_s"] = round(time.monotonic() - t0, 3)
+            rounds.append(entry)
+            # bounded disk ACROSS rounds: every stable-baseline round
+            # must stay within DISK_DRIFT_FACTOR of the smallest —
+            # an unbounded journal would trend up monotonically
+            baseline = [r["disk_hw_bytes"] for r in rounds
+                        if r["scenario"] == "blackout3"]
+            if baseline:
+                assert max(baseline) <= DISK_DRIFT_FACTOR * min(baseline), \
+                    f"disk high-water drifting across rounds: {baseline}"
+            aggregate(None)     # rolling: valid after every round
+            print(f"round {index + 1}: {entry['scenario']} "
+                  f"seed={seed} disk_hw={entry['disk_hw_bytes']} "
+                  f"faults={sum(entry['faults_per_node'].values())} "
+                  f"trips={entry['breaker_trips']} "
+                  f"({entry['round_s']}s)")
+            index += 1
+        # the soak must actually have exercised rotation + compaction,
+        # or the bounded-disk claim proved nothing
+        assert sum(r["compactions"] for r in rounds) > 0, \
+            "no snapshot compaction fired in the whole soak"
+        tracer = locks.tracer()
+        if tracer is not None:
+            tracer.assert_clean()
+    except AssertionError as exc:
+        aggregate(str(exc))
+        print(f"SOAK FAILED after {len(rounds)} round(s): {exc}",
+              file=sys.stderr)
+        return 1
+    aggregate(None)
+    print(f"soak ok: {len(rounds)} rounds in "
+          f"{report['elapsed_s']}s, disk high-water "
+          f"{report['disk_high_water_bytes']} bytes, "
+          f"{report['compactions']} compactions, report "
+          f"-> {report_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

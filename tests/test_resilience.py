@@ -20,10 +20,14 @@ from consensus_specs_tpu.resilience import (
     CLOSED, HALF_OPEN, OPEN, QUARANTINED, DeviceFault, DispatchTimeout,
     FaultPlan, FaultSpec, INCIDENTS, faults, guard, supervisor,
 )
+from consensus_specs_tpu.resilience.incidents import IncidentLog
+from consensus_specs_tpu.resilience.supervisor import (
+    Supervisor, SupervisorConfig)
 from consensus_specs_tpu.sigpipe import METRICS, scheduler
+from consensus_specs_tpu.sigpipe.metrics import Metrics
 from consensus_specs_tpu.sigpipe.sets import SignatureSet
 from consensus_specs_tpu.test_infra.keys import privkeys, pubkeys
-from consensus_specs_tpu.utils import bls
+from consensus_specs_tpu.utils import bls, nodectx
 
 
 @pytest.fixture(autouse=True)
@@ -334,6 +338,116 @@ def test_untargeted_site_is_never_wrapped():
     with faults.inject(plan):
         assert resilience.dispatch("t.site", lambda: 5, lambda: -1) == 5
     assert plan.total_fires() == 0
+
+
+# ---------------------------------------------------------------------------
+# per-node-context routing: supervisor / fault plan / guard
+# ---------------------------------------------------------------------------
+
+def _node_ctx(name, sup_config=None):
+    """A context owning its whole resilience namespace, the SimNode
+    shape: own supervisor, empty fault-plan slot, empty guard slot."""
+    return nodectx.NodeContext(
+        name, metrics=Metrics(node_id=name),
+        incidents=IncidentLog(node_id=name),
+        supervisor=nodectx.Slot(Supervisor(
+            sup_config or SupervisorConfig(max_retries=0,
+                                           breaker_threshold=1))),
+        fault_plan=nodectx.Slot(None),
+        guard=nodectx.Slot(None))
+
+
+def test_router_default_is_byte_identical_without_context():
+    """The default-global regression pin: with no node context — or a
+    context that owns no resilience slots — enable/active/dispatch hit
+    the process-global cell exactly as the old singletons did."""
+    sup = resilience.enable(max_retries=0, breaker_threshold=1)
+    assert supervisor.active() is sup
+    assert supervisor._ACTIVE.default is sup
+    # a slot-less context (the PR-7 shape) falls through to the default
+    bare = nodectx.NodeContext("bare", metrics=Metrics(node_id="bare"))
+    with nodectx.use(bare):
+        assert supervisor.active() is sup
+        assert faults.active_plan() is None
+        assert guard.active() is None
+    plan = FaultPlan([FaultSpec("t.site", "raise", persistent=True)],
+                     seed=1)
+    with faults.inject(plan):
+        assert faults.active_plan() is plan
+        with nodectx.use(bare):
+            assert faults.active_plan() is plan
+    assert faults.active_plan() is None
+
+
+def test_per_context_supervisor_isolation():
+    """Node A's breaker trips at a site; node B's table — and the
+    process-global default — never hear about it, and A's trip
+    incidents land only in A's book."""
+    default_sup = resilience.enable(max_retries=0, breaker_threshold=1)
+    a, b = _node_ctx("nodeA"), _node_ctx("nodeB")
+    plan = FaultPlan([FaultSpec("t.site", "raise", persistent=True)],
+                     seed=1)
+    with nodectx.use(a):
+        a.fault_plan.value = plan
+        assert resilience.dispatch("t.site", lambda: 42, lambda: -1) == -1
+        assert supervisor.active().breaker_state("t.site") == OPEN
+    with nodectx.use(b):
+        assert supervisor.active().breaker_state("t.site") == CLOSED
+        assert resilience.dispatch("t.site", lambda: 42, lambda: -1) == 42
+    assert default_sup.breaker_state("t.site") == CLOSED
+    assert resilience.dispatch("t.site", lambda: 42, lambda: -1) == 42
+    assert a.incidents.count(event="trip", site="t.site") == 1
+    assert b.incidents.count(site="t.site") == 0
+    assert INCIDENTS.default.count(site="t.site") == 0
+    assert a.metrics.count_labeled("scalar_fallbacks",
+                                   "breaker_open") == 1
+    assert b.metrics.count_labeled("scalar_fallbacks") == 0
+
+
+def test_global_plan_never_leaks_into_a_node_with_its_own_slot():
+    """A Slot holding None is an explicit empty schedule, NOT a
+    fall-through: the process-global injected plan must not fire on a
+    node that owns its own (empty) plan slot."""
+    resilience.enable(max_retries=0, breaker_threshold=1)
+    ctx = _node_ctx("nodeA")
+    plan = FaultPlan([FaultSpec("t.site", "raise", persistent=True)],
+                     seed=1)
+    with faults.inject(plan):               # installed globally
+        with nodectx.use(ctx):
+            assert faults.active_plan() is None
+            assert resilience.dispatch("t.site", lambda: 42,
+                                       lambda: -1) == 42
+        # and outside the context it still fires
+        assert resilience.dispatch("t.site", lambda: 42, lambda: -1) == -1
+    assert plan.total_fires() == 1
+
+
+def test_inject_under_context_lands_in_the_slot():
+    ctx = _node_ctx("nodeA")
+    plan = FaultPlan([FaultSpec("t.site", "raise")], seed=1)
+    with nodectx.use(ctx):
+        with faults.inject(plan):
+            assert ctx.fault_plan.value is plan
+            assert faults.active_plan() is plan
+        assert ctx.fault_plan.value is None
+    assert faults.active_plan() is None
+
+
+def test_guard_routes_per_context_and_quarantines_locally():
+    """A guard mismatch inside a node context quarantines THAT node's
+    supervisor (guard -> supervisor.active() is routed too)."""
+    resilience.enable()                     # default supervisor
+    ctx = _node_ctx("nodeA")
+    with nodectx.use(ctx):
+        guard.enable(sample_rate=1.0)
+        assert ctx.guard.value is guard.active()
+        guard.active()._quarantine_backend()
+        states = supervisor.active().breaker_states()
+        assert states and all(s == QUARANTINED for s in states.values())
+    # the default guard was never installed, the default supervisor
+    # never quarantined
+    assert guard.active() is None
+    assert supervisor.active().breaker_states() == {}
 
 
 # ---------------------------------------------------------------------------

@@ -24,7 +24,9 @@ from consensus_specs_tpu.gossip import (
 from consensus_specs_tpu.resilience import INCIDENTS
 from consensus_specs_tpu.resilience.incidents import IncidentLog
 from consensus_specs_tpu.scenario.dsl import (
-    Scenario, crash, equivocation_storm, heal, kill, partition, recover)
+    Scenario, crash, degraded, equivocation_storm, heal, kill,
+    partition, recover)
+from consensus_specs_tpu.scenario.driver import Driver
 from consensus_specs_tpu.sigpipe import METRICS
 from consensus_specs_tpu.sigpipe import cache as sig_cache
 from consensus_specs_tpu.sigpipe.metrics import Metrics
@@ -72,6 +74,25 @@ def test_dsl_validation_rejects_broken_scenarios():
             kill(3.0, node=1), recover(4.0, node=1))).validate()
     Scenario(name="x", durable=True, events=(
         kill(3.0, node=1), recover(4.0, node=1))).validate()
+    with pytest.raises(AssertionError, match="same target"):
+        # two windows on one node overlap
+        Scenario(name="x", events=(
+            degraded(1.0, 3.0, node=1), degraded(2.0, 4.0, node=1))) \
+            .validate()
+    with pytest.raises(AssertionError, match="same target"):
+        # a fleet-wide window overlaps everything
+        Scenario(name="x", events=(
+            degraded(1.0, 3.0), degraded(2.0, 4.0, node=2))).validate()
+    with pytest.raises(AssertionError, match="unknown node"):
+        Scenario(name="x", events=(degraded(1.0, 2.0, node=7),)) \
+            .validate()
+    with pytest.raises(AssertionError, match="unknown fault"):
+        Scenario(name="x", events=(
+            degraded(1.0, 2.0, fault="corrupt"),)).validate()
+    # per-node windows on DIFFERENT nodes may overlap freely
+    Scenario(name="x", events=(
+        degraded(1.0, 3.0, node=0),
+        degraded(2.0, 4.0, node=1, fault="shard_dead"))).validate()
     # every library scenario is inside the envelope
     for s in scenario.LIBRARY.values():
         s.validate()
@@ -354,3 +375,96 @@ def test_crash_only_recovery_uses_journal():
                  and e["event"] == "recovered"]
     assert len(recovered) == 1
     assert recovered[0]["node_id"] == "node1"
+
+
+# ---------------------------------------------------------------------------
+# per-node fault isolation (the namespaced-resilience acceptance pins)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("fault", ["raise", "shard_dead"])
+def test_per_node_degraded_window_isolates_the_breaker(fault):
+    """THE fault-isolation pin: a fault schedule targeting node 0
+    opens only node 0's OWN breaker at the named site and lands
+    incidents only in node 0's book; node 1's breaker table stays
+    closed and its dispatches never take the breaker_open fallback —
+    and both nodes still converge byte-identically to the oracle."""
+    site = "gossip.batch_verify"
+    s = Scenario(name=f"iso2_{fault}", nodes=2, slots=6,
+                 events=(degraded(1.5, 4.5, site=site, node=0,
+                                  fault=fault),))
+    with disable_bls():
+        d = Driver(s, seed=4, supervisor_overrides={
+            "max_retries": 0, "breaker_threshold": 1})
+        report = d.run()
+    scenario.assert_converged(report)
+    scenario.assert_attributed(report)
+    hit, spared = d.nodes[0], d.nodes[1]
+    # node 0: faults fired, its own breaker tripped, everything in its
+    # own book (the window's end reset the breaker, so the final state
+    # map holds no open entry — the trip is pinned by incident+counter)
+    hit_incidents = hit.ctx.incidents.snapshot()
+    assert any(e["event"] == "injected" and e["site"] == site
+               for e in hit_incidents)
+    assert any(e["event"] == "trip" and e["site"] == site
+               for e in hit_incidents)
+    assert hit.ctx.metrics.count("breaker_trips") >= 1
+    assert hit.ctx.metrics.count_labeled("scalar_fallbacks",
+                                         "breaker_open") >= 1
+    if fault == "shard_dead":
+        assert any(e["event"] == "shard_dead" and "shard" in e
+                   for e in hit_incidents)
+    # node 1: no faults, no trips, never off the device path
+    assert all(state == resilience.CLOSED
+               for state in spared.breaker_states().values())
+    assert spared.ctx.incidents.count(site=site) == 0
+    assert spared.ctx.metrics.count("faults_injected") == 0
+    assert spared.ctx.metrics.count("breaker_trips") == 0
+    assert spared.ctx.metrics.count_labeled("scalar_fallbacks",
+                                            "breaker_open") == 0
+    assert spared.ctx.metrics.count_labeled("scalar_fallbacks",
+                                            "dispatch_failed") == 0
+    # nothing leaked into the process-global default books either
+    assert INCIDENTS.default.count(site=site) == 0
+
+
+def test_randomized_generator_seed_matrix():
+    """Generator pins over a wide seed sweep: every draw validates,
+    every kill-bearing draw is durable (the validate() contract), and
+    the per-node fault machinery is actually exercised — targeted
+    windows, shard_dead windows, and kills all occur."""
+    kills = shard_windows = targeted_windows = 0
+    for seed in range(200):
+        s = scenario.randomized(random.Random(seed))
+        s.validate()
+        if any(e.kind == "kill" for e in s.events):
+            kills += 1
+            assert s.durable, f"seed {seed}: kill dealt without durable"
+        for e in s.events:
+            if e.kind == "degraded":
+                if e.get("fault") == "shard_dead":
+                    shard_windows += 1
+                if e.get("node") is not None:
+                    targeted_windows += 1
+    assert kills > 0 and shard_windows > 0 and targeted_windows > 0
+    for seed in range(40):
+        s = scenario.randomized(random.Random(seed), durable=False)
+        assert not s.durable
+        assert all(e.kind != "kill" for e in s.events)
+        s = scenario.randomized(random.Random(seed), durable=True)
+        assert s.durable
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(40, 44))
+def test_randomized_durable_scenario_matrix(seed):
+    """The soak runner's round shape as a pytest tier: seeded durable
+    battlefields (kills, per-node windows) under tiny journal segments
+    — convergence, attribution, and a live disk high-water sample."""
+    s = scenario.randomized(random.Random(seed), durable=True)
+    with disable_bls():
+        report = scenario.run_scenario(
+            s, seed=seed, snapshot_interval=8,
+            journal_kwargs={"segment_bytes": 4096})
+    scenario.assert_converged(report)
+    scenario.assert_attributed(report)
+    assert report.durable_bytes_hw > 0
