@@ -16,7 +16,7 @@ RUNNERS := shuffling ssz_static operations epoch_processing sanity bls \
 	kill-drill scenario-chaos pipeline-chaos shard-verify soak lint \
 	speclint native pyspec bench \
 	gossip-bench txn-bench msm-bench merkle-bench scenario-bench \
-	multichip-bench pipeline-bench gen_all detect_errors \
+	multichip-bench pipeline-bench fold-bench gen_all detect_errors \
 	$(addprefix gen_,$(RUNNERS))
 
 # syntax/bytecode check over every package and script (the CI lint job)
@@ -213,6 +213,14 @@ pipeline-bench:
 # BENCH_MULTICHIP_SETS=64 BENCH_MULTICHIP_DEVICES=1,2 give a smoke run
 multichip-bench:
 	env JAX_PLATFORMS=cpu $(PYTHON) bench.py multichip
+
+# folded pairing product alone (sigpipe/fold.py): counted Miller-leg
+# and dispatch invariants folded vs unfolded (2N -> N+1) at
+# N in {16, 256, 1024}, real fold-on/off verdict parity incl. bisection,
+# and the folded G2 MSM at 1- and 8-device forced-host mesh; emits
+# FOLD_r01.json.  BENCH_FOLD_SETS=16 BENCH_FOLD_MESH=0 give a smoke run
+fold-bench:
+	env JAX_PLATFORMS=cpu $(PYTHON) bench.py fold
 
 # static pattern rule: GNU make refuses to run implicit pattern rules
 # for .PHONY targets

@@ -1622,6 +1622,206 @@ def bench_multichip():
 
 
 # ---------------------------------------------------------------------------
+# tier: folded pairing product (sigpipe/fold.py, the ops.pairing_fold seam)
+# ---------------------------------------------------------------------------
+
+FOLD_SETS = os.environ.get("BENCH_FOLD_SETS", "16,256,1024")
+FOLD_PARITY_SETS = int(os.environ.get("BENCH_FOLD_PARITY_SETS", "16"))
+FOLD_MESH = os.environ.get("BENCH_FOLD_MESH", "1") not in ("0", "off")
+FOLD_MESH_DEVICES = os.environ.get("BENCH_FOLD_MESH_DEVICES", "1,8")
+FOLD_JSON = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "FOLD_r01.json")
+
+
+def bench_fold():
+    """The G2-leg folding acceptance pin as COUNTED invariants (the
+    CPU-only container cannot time device pairings — BENCH_r04/r05
+    `device_unreachable`): per flush size N in BENCH_FOLD_SETS, the
+    folded flush assembles N+1 Miller legs (vs 2N unfolded), one
+    `ops.pairing_fold` + one halved `ops.msm` dispatch; a real
+    FOLD_PARITY_SETS-set flush (one bad signature — bisection under
+    folding) verifies byte-identical verdicts fold-on vs FOLD_VERIFY=0;
+    and the mesh leg runs the folded G2 MSM at 1 and 8 forced-host
+    devices, byte-identical sums with one sharded dispatch.  Emits
+    FOLD_r01.json."""
+    sizes = [int(s) for s in FOLD_SETS.split(",") if s.strip()]
+    mesh_counts = [int(c) for c in FOLD_MESH_DEVICES.split(",")
+                   if c.strip()]
+
+    # force the CPU host platform with enough virtual devices BEFORE
+    # any backend use — the multichip-tier discipline
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass
+    n_max = max(mesh_counts) if FOLD_MESH else 1
+    try:
+        jax.config.update("jax_num_cpu_devices", n_max)
+    except AttributeError:
+        if "--xla_force_host_platform_device_count" not in \
+                os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={n_max}")
+
+    from consensus_specs_tpu.crypto import curve as cv
+    from consensus_specs_tpu.ops import g1_sweep, msm as ops_msm
+    from consensus_specs_tpu.parallel import shard_verify
+    from consensus_specs_tpu.sigpipe import (
+        METRICS as SIG_METRICS, cache as sig_cache, fold, scheduler)
+    from consensus_specs_tpu.sigpipe.sets import SignatureSet
+    from consensus_specs_tpu.test_infra.keys import privkeys, pubkeys
+    from consensus_specs_tpu.utils import bls as bls_shim
+
+    t_start = time.perf_counter()
+
+    def mark(msg):
+        log(f"[bench] fold +{time.perf_counter() - t_start:5.1f}s: {msg}")
+
+    # -- leg A: counted Miller-leg / dispatch invariants per N --------
+    # the heavy engines are stubbed (constant points, product forced
+    # True) so the 1024-set legs count in milliseconds; the counting
+    # sits in the scheduler's real assembly path
+    mark(f"counting legs at N in {sizes} ...")
+    g1 = cv.g1_generator()
+    g2 = cv.g2_generator()
+    pk = bytes(pubkeys[0])
+    saved = (scheduler._hash_roots, scheduler._load_signature,
+             scheduler._weighted_g1, fold._fold_sweep,
+             scheduler._pairing_product)
+    per_n = {}
+    try:
+        scheduler._hash_roots = lambda roots: [g2] * len(roots)
+        scheduler._load_signature = lambda b: g2
+        scheduler._weighted_g1 = lambda pts, cs: [g1] * len(pts)
+        fold._fold_sweep = lambda sigs, cs: cv.g2_infinity()
+        scheduler._pairing_product = lambda pairs: True
+        for n in sizes:
+            sets = [SignatureSet(pubkeys=(pk,), signing_root=b"\x11" * 32,
+                                 signature=b"\x22" * 96, kind="bench")
+                    for _ in range(n)]
+            row = {}
+            for mode, expect in (("on", n + 1), ("off", 2 * n)):
+                fold.FOLD_MODE = mode
+                sig_cache.clear()
+                SIG_METRICS.reset()
+                assert scheduler.verify_sets(sets, mode="fused") \
+                    == [True] * n
+                snap = SIG_METRICS.snapshot()
+                legs = snap["miller_loops_per_flush"]["total"]
+                assert legs == expect, (n, mode, legs, expect)
+                # the weighting engine is stubbed in this leg, so its
+                # dispatch counter would read 0 — report only what ran
+                row["folded" if mode == "on" else "unfolded"] = {
+                    "miller_legs": legs,
+                    "fold_dispatches": snap.get("fold_dispatches", 0),
+                    "g1_aggregate_dispatches":
+                        snap.get("g1_aggregate_dispatches", 0),
+                }
+            row["reduction"] = round(2 * n / (n + 1), 3)
+            per_n[n] = row
+            mark(f"N={n}: {2 * n} -> {n + 1} legs "
+                 f"({row['reduction']}x fewer Miller loops)")
+    finally:
+        (scheduler._hash_roots, scheduler._load_signature,
+         scheduler._weighted_g1, fold._fold_sweep,
+         scheduler._pairing_product) = saved
+        fold.reset_mode()
+
+    # -- leg B: real verdict parity with bisection under folding ------
+    n_par = FOLD_PARITY_SETS
+    mark(f"real {n_par}-set parity flush (one bad signature) ...")
+    sets = []
+    for i in range(n_par):
+        msg = i.to_bytes(8, "little") + b"\x6e" * 24
+        signed = msg if i != n_par // 2 else b"\x01" * 32
+        sig = bls_shim.Sign(privkeys[i % 16], signed)
+        sets.append(SignatureSet(
+            pubkeys=(bytes(pubkeys[i % 16]),), signing_root=msg,
+            signature=bytes(sig), kind="bench", origin=("fold", i)))
+    verdicts = {}
+    for mode in ("on", "off"):
+        fold.FOLD_MODE = mode
+        sig_cache.clear()
+        SIG_METRICS.reset()
+        t0 = time.perf_counter()
+        verdicts[mode] = scheduler.verify_sets(sets, mode="fused")
+        mark(f"parity leg fold={mode}: "
+             f"{time.perf_counter() - t0:.1f}s host pairing work")
+    fold.reset_mode()
+    expect = [i != n_par // 2 for i in range(n_par)]
+    assert verdicts["on"] == verdicts["off"] == expect, \
+        "folded verdicts diverged from the unfolded path"
+
+    # -- leg C: the folded G2 MSM on the forced-host mesh -------------
+    mesh_leg = {}
+    if FOLD_MESH:
+        if len(jax.devices()) < n_max:
+            raise RuntimeError(
+                f"fold mesh leg needs {n_max} host devices, "
+                f"have {len(jax.devices())}")
+        g1_sweep.reset_mode()
+        g1_sweep.G1_SWEEP_MODE = "jax"
+        try:
+            sigs = [cv.g2_generator() * (3 + i) for i in range(8)]
+            coeffs = [(0x9E3779B97F4A7C15 * (i + 1)) % (1 << 64)
+                      for i in range(8)]
+            expect_S = cv.g2_infinity()
+            for s, c in zip(sigs, coeffs):
+                expect_S = expect_S + s * c
+            baseline_S = None
+            for n_dev in mesh_counts:
+                shard_verify.configure(max_devices=n_dev)
+                SIG_METRICS.reset()
+                mark(f"G2 fold MSM at {n_dev} device(s) "
+                     f"(compiles this width) ...")
+                t0 = time.perf_counter()
+                S = ops_msm.g2_multi_exp(sigs, coeffs,
+                                         label="ops.pairing_fold")
+                dt = time.perf_counter() - t0
+                assert S == expect_S, \
+                    f"{n_dev}-device fold MSM != host sum"
+                sharded = SIG_METRICS.snapshot().get(
+                    "sharded_dispatches", {}).get("ops.pairing_fold", 0)
+                assert sharded == (1 if n_dev > 1 else 0), sharded
+                mesh_leg[n_dev] = {"msm_s": round(dt, 3),
+                                   "sharded_dispatches": sharded}
+                if baseline_S is None:
+                    baseline_S = S
+                else:
+                    assert S == baseline_S
+        finally:
+            # a failed assertion must not leak the forced jax sweep
+            # mode / capped mesh into later tiers of the same process
+            shard_verify.configure(None)
+            g1_sweep.reset_mode()
+
+    max_n = max(sizes)
+    reduction = per_n[max_n]["reduction"]
+    report = {
+        "sizes": sizes,
+        "per_n": {str(n): row for n, row in per_n.items()},
+        "parity": {"sets": n_par, "bad_index": n_par // 2,
+                   "verdicts_identical": True},
+        "mesh": {str(k): v for k, v in mesh_leg.items()},
+        "ok": True,
+    }
+    with open(FOLD_JSON, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+    log("[bench] fold: " + json.dumps(report, sort_keys=True))
+    return {
+        "metric": "fold_miller_loop_reduction",
+        "value": reduction,
+        "unit": (f"x fewer Miller loops per {max_n}-set flush "
+                 f"({2 * max_n} -> {max_n + 1} legs, counted; verdicts "
+                 f"byte-identical fold on/off at N={n_par} incl. "
+                 f"bisection)"),
+        "vs_baseline": reduction,
+    }
+
+
+# ---------------------------------------------------------------------------
 # tier: async pipelined flush engine (sigpipe/pipeline_async.py)
 # ---------------------------------------------------------------------------
 
@@ -1808,6 +2008,15 @@ def bench_pipeline():
                       "flush_inflight_depth_hist", {})},
         "store_roots_identical": True,
         "merkle": merkle_leg,
+        # the folded-product invariants ride the same ingestion run:
+        # fold_enabled says which leg assembly every flush used, and
+        # miller_loops_per_flush carries the counted N+1 (vs 2N) win
+        "fold": {
+            "fold_enabled": snap_on.get("fold_enabled", {}),
+            "miller_loops_per_flush": snap_on.get(
+                "miller_loops_per_flush", {}),
+            "fold_dispatches": snap_on.get("fold_dispatches", 0),
+        },
         "speedup": speedup,
         "min_speedup": PIPELINE_MIN_SPEEDUP if binds else None,
         "ok": ok,
@@ -1875,6 +2084,11 @@ TIERS = {
     # fused device-resident merkle sweep leg; message signing + kernel
     # warm-up dominate
     "pipeline": (bench_pipeline, 420),
+    # folded pairing product (sigpipe/fold.py): counted Miller-leg /
+    # dispatch invariants (2N -> N+1) per flush size, real fold-on/off
+    # verdict parity with bisection, and the folded G2 MSM on the
+    # forced-host mesh — the parity leg's host pairings dominate
+    "fold": (bench_fold, 420),
 }
 
 # the driver's ~540s window fits merkle + ONE heavy tier — without
@@ -1882,7 +2096,7 @@ TIERS = {
 # driver-verified number (VERDICT r4 weakness #8)
 _ROTATING = ["north_star", "attestations", "block_sigs", "kzg", "epoch",
              "transition", "degraded", "gossip", "txn", "msm",
-             "merkle_inc", "scenario", "multichip", "pipeline"]
+             "merkle_inc", "scenario", "multichip", "pipeline", "fold"]
 
 
 def _round_index() -> int:

@@ -29,6 +29,10 @@ site registry ``resilience/sites.py``:
                     anchored on the CONCURRENCY registry and paired
                     with the SPECLINT_TSAN runtime tracer
                     (utils/locks.py).
+* foldgate.py     — pairing_product reachable only through the seam
+                    registry's fold-aware entry (sigpipe.scheduler /
+                    the ops.pairing_fold seam), so nothing quietly
+                    re-introduces an unfolded 2N-leg product.
 
 Entry points: :func:`run_speclint` (library), ``scripts/speclint.py``
 (CLI, JSON or human output, ``--pass``/``--list-passes`` filters, exit

@@ -67,6 +67,9 @@ RULES: dict[str, str] = {
     "registry-dead-entry":
         "a CONCURRENCY or HOST_SYNC_BARRIERS registry entry resolves to "
         "no code",
+    "fold-unaware-pairing":
+        "a pairing_product call bypasses the fold-aware entry "
+        "(sigpipe.scheduler / the ops.pairing_fold seam)",
     "speclint-bad-disable":
         "a speclint disable comment lacks a reason or names an unknown rule",
 }
@@ -252,8 +255,8 @@ def _pass_table() -> dict:
     """Ordered name -> runner table (the CLI's --pass / --list-passes
     vocabulary).  Import is deferred so `from .core import Finding`
     inside the pass modules does not cycle."""
-    from . import (bypass, concurrency, determinism, globals_, hostsync,
-                   seams, txnpurity)
+    from . import (bypass, concurrency, determinism, foldgate, globals_,
+                   hostsync, seams, txnpurity)
     return {
         "seams": seams.run,
         "bypass": bypass.run,
@@ -264,6 +267,7 @@ def _pass_table() -> dict:
         "lock-discipline": concurrency.run_lock_discipline,
         "lock-order": concurrency.run_lock_order,
         "thread-escape": concurrency.run_thread_escape,
+        "foldgate": foldgate.run,
     }
 
 

@@ -1,0 +1,66 @@
+"""Pass 10 — fold-aware pairing-product gate.
+
+The folded verify path (sigpipe/fold.py, the ``ops.pairing_fold``
+seam) owns the decision of how a fused flush's pairing legs are
+assembled: N+1 folded legs by default, the 2N-leg assembly behind the
+``FOLD_VERIFY=0`` escape hatch, and the one-launch fused program on
+the tpu backend.  A caller that reaches ``pairing_product`` directly —
+instead of going through the scheduler's fold-aware entry
+(``sigpipe.scheduler._pairing_product``) or the fold seam itself —
+silently re-introduces an unfolded 2N-leg product (or worse, a product
+that skips the seam registry's breaker/bisect/fallback contract), and
+every counted invariant (`miller_loops_per_flush`) stops describing
+what actually launched.
+
+This pass flags any ``pairing_product(...)`` call in the package
+outside the modules the seam registry blesses: the wrapper modules of
+``ops.pairing_product`` and ``ops.pairing_fold`` (the owning layers)
+and ``sigpipe.scheduler`` (the fold-aware router).  Like every pass,
+``# speclint: disable=fold-unaware-pairing -- <reason>`` is the escape
+hatch for a deliberate exception.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Context, Finding
+
+_ROUTER = "consensus_specs_tpu.sigpipe.scheduler"
+
+
+def _allowed_modules(registry) -> frozenset:
+    allowed = {_ROUTER}
+    for name in ("ops.pairing_product", "ops.pairing_fold"):
+        try:
+            allowed.add(registry.site(name).module)
+        except KeyError:
+            pass
+    return frozenset(allowed)
+
+
+def run(ctx: Context) -> list[Finding]:
+    allowed = _allowed_modules(ctx.registry)
+    findings: list[Finding] = []
+    for sf in ctx.files:
+        if not (sf.module or sf.forced):
+            continue
+        if sf.module in allowed:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else None)
+            if name != "pairing_product":
+                continue
+            findings.append(Finding(
+                "fold-unaware-pairing", sf.rel, node.lineno,
+                node.col_offset,
+                "pairing_product() called outside the seam registry's "
+                "fold-aware entry — the folded N+1-leg assembly (and "
+                "the FOLD_VERIFY escape hatch) is bypassed",
+                hint="route the product through sigpipe.scheduler."
+                     "_pairing_product / the ops.pairing_fold seam, or "
+                     "carry a reasoned disable"))
+    return findings

@@ -321,8 +321,19 @@ def g1_weighted_sweep(points, scalars):
         jnp.asarray(np.asarray(c)) for c in prods))[:n]
 
 
-def g2_multi_exp(points, scalars):
-    """sum_i scalars[i] * points[i] over G2; returns an oracle Point."""
+def g2_multi_exp(points, scalars, label=None):
+    """sum_i scalars[i] * points[i] over G2; returns an oracle Point.
+
+    The ladder width adapts to the widest scalar (64 bits for the fold
+    path's Fiat–Shamir coefficients — a 4x shorter scan than the
+    generic 256), and the batch axis pads to a power of two so XLA only
+    sees log-many shapes.  With `label` set (the `ops.pairing_fold`
+    fold of a fused flush's signature legs — sigpipe/fold.py), the
+    padded ladder axis is partitioned over the verify mesh via
+    `shard_jobs`: each device runs its slice of the scalar-mul scan,
+    and the halving-tree sum's first log2(D) rounds are the cross-shard
+    all-reduce.  Exact integer math throughout, so the sum is
+    byte-identical at any mesh width."""
     if len(points) != len(scalars):
         raise ValueError("g2_multi_exp: length mismatch")
     if not points:
@@ -331,8 +342,14 @@ def g2_multi_exp(points, scalars):
     m = _pad_pow2(n)
     pts = list(points) + [cv.g2_infinity()] * (m - n)
     sc = [int(s) % R for s in scalars] + [0] * (m - n)
+    width = max((s.bit_length() for s in sc), default=1) or 1
+    n_bits = 64 if width <= 64 else 256
     packed = cj.g2_pack(pts)
-    bits = cj.scalars_to_bits(sc)
+    bits = cj.scalars_to_bits(sc, n_bits=n_bits)
+    if label is not None:
+        from ..parallel import shard_verify
+        X, Y, Z, bits = shard_verify.shard_jobs((*packed, bits), label)
+        packed = (X, Y, Z)
     prods = cj.g2_scalar_mul(packed, bits)
     out = _tree_sum_host(cj.g2_add, prods)
     return cj.g2_unpack(tuple(

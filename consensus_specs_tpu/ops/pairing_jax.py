@@ -422,6 +422,182 @@ def fq12_product_is_one(partials):
 
 
 # ---------------------------------------------------------------------------
+# folded-flush surface (the one-launch fused verify path)
+# ---------------------------------------------------------------------------
+# sigpipe/fold.py folds every signature leg of a fused flush into ONE
+# e(-g1, S) pair over the G2 MSM S = sum_i c_i * sig_i; on the tpu
+# backend the whole folded flush fuses into one compiled program PER
+# MESH SHARD (parallel/shard_verify.pairing_fold): the hash-to-G2
+# cofactor ladder, the Fiat-Shamir G1 weighting ladder, the local G2
+# signature MSM, in-program Jacobian->affine conversion (batched
+# Fermat inversion — ft.fq_inv / ft.fq2_inv), and the partial Miller
+# product over the shard's k+1 pairs — its k weighted-aggregate legs
+# plus one e(-g1, S_d) leg over the shard's LOCAL partial MSM.  The
+# per-shard S_d legs are sound because the final exponentiation
+# restores bilinearity: FE(prod_d miller(-g1, S_d)) ==
+# prod_d e(-g1, S_d) == e(-g1, sum_d S_d), so the all-reduced product
+# decides exactly the folded check at any mesh width.  Mode-split like
+# everything here: `fused` composes the whole body under one jit (one
+# launch per shard); staged drives the existing per-piece kernels —
+# identical math, what the CPU kernel tier verifies.
+
+def _h_eff_bits():
+    """The cofactor ladder's bit vector — bls_tpu's precomputed
+    `_H_EFF_BITS`, imported lazily (bls_tpu imports this module at its
+    top level, so an eager import here would cycle).  ONE copy on
+    purpose: the fold program's cofactor ladder must walk exactly the
+    bits `hash_to_g2_batch` walks."""
+    from .bls_tpu import _H_EFF_BITS
+    return _H_EFF_BITS
+
+
+def _g1_jacobian_to_affine(P, sub_x, sub_y):
+    """Batched Jacobian->affine over G1 limbs: (x, y, inf).  Infinity
+    rows (Z == 0) read the substitute coords (the generator — a valid
+    curve point, the established skip-row idiom) and set the mask."""
+    X, Y, Z = P
+    inf = fq.is_zero(Z)
+    Zs = fq.select(inf, fq.one_mont(Z), Z)
+    zi = ft.fq_inv(Zs)
+    zi2 = fq.square(zi)
+    x = fq.mul(X, zi2)
+    y = fq.mul(Y, fq.mul(zi2, zi))
+    x = fq.select(inf, jnp.broadcast_to(sub_x, x.shape), x)
+    y = fq.select(inf, jnp.broadcast_to(sub_y, y.shape), y)
+    return x, y, inf
+
+
+def _g2_jacobian_to_affine(P, sub_x, sub_y):
+    """Batched Jacobian->affine over G2 (Fq2) limbs: (x, y, inf)."""
+    X, Y, Z = P
+    inf = ft.fq2_is_zero(Z)
+    one2 = jnp.broadcast_to(
+        jnp.asarray(np.stack([fq.ONE_MONT_LIMBS, fq.ZERO_LIMBS])), Z.shape)
+    Zs = jnp.where(inf[..., None, None], one2, Z)
+    zi = ft.fq2_inv(Zs)
+    zi2 = ft.fq2_square(zi)
+    x = ft.fq2_mul(X, zi2)
+    y = ft.fq2_mul(Y, ft.fq2_mul(zi2, zi))
+    x = jnp.where(inf[..., None, None], jnp.broadcast_to(sub_x, x.shape), x)
+    y = jnp.where(inf[..., None, None], jnp.broadcast_to(sub_y, y.shape), y)
+    return x, y, inf
+
+
+_FOLD_CONSTS = None     # lazy: packed once, reused every flush
+
+
+def _fold_consts():
+    """Host-packed affine constants the fold program substitutes and
+    appends: (g1 gen x/y, g2 gen x/y, -g1 x/y), each [32] / [2, 32].
+    Cached — the packing (host bigint affine conversions) would
+    otherwise rerun on the hot path once per folded flush."""
+    global _FOLD_CONSTS
+    if _FOLD_CONSTS is None:
+        from ..crypto import curve as cv
+        g1x, g1y = cv.g1_generator().affine()
+        g2x, g2y = cv.g2_generator().affine()
+        n1x, n1y = (-cv.g1_generator()).affine()
+        _FOLD_CONSTS = (
+            fq.pack_mont([g1x.v])[0], fq.pack_mont([g1y.v])[0],
+            ft.fq2_pack_mont([g2x])[0], ft.fq2_pack_mont([g2y])[0],
+            fq.pack_mont([n1x.v])[0], fq.pack_mont([n1y.v])[0])
+    return _FOLD_CONSTS
+
+
+def _fold_assemble(w, H, S, consts, g1_affine, g2_affine, miller):
+    """The shared tail of both fold variants: affinize the weighted
+    aggregates / hashes / local MSM, assemble the batch's k+1 pairs —
+    its k weighted-aggregate legs plus the e(-g1, S) leg — with the
+    skip mask, and run `miller` over them.  One assembly block on
+    purpose: the staged and fused paths are pinned 'identical math',
+    which only holds while they share it."""
+    g1x, g1y, g2x, g2y, n1x, n1y = consts
+    xw, yw, w_inf = g1_affine(w, g1x, g1y)
+    xh, yh, h_inf = g2_affine(H, g2x, g2y)
+    xs, ys, s_inf = g2_affine(S, g2x, g2y)
+    xp = jnp.concatenate(
+        [xw, jnp.broadcast_to(n1x, xw.shape[:-2] + (1, fq.LIMBS))], axis=-2)
+    yp = jnp.concatenate(
+        [yw, jnp.broadcast_to(n1y, yw.shape[:-2] + (1, fq.LIMBS))], axis=-2)
+    xq = jnp.concatenate([xh, xs[..., None, :, :]], axis=-3)
+    yq = jnp.concatenate([yh, ys[..., None, :, :]], axis=-3)
+    skip = jnp.concatenate([w_inf | h_inf, s_inf[..., None]], axis=-1)
+    return miller(xp, yp, xq, yq, skip)
+
+
+def _fold_partial_core(aggP, cbits, hP, sP, consts, miller):
+    """The folded flush body shared by the fused and staged variants.
+
+    aggP: G1 Jacobian [.., k, 32] x3; cbits [.., k, 64] msb-first;
+    hP/sP: G2 Jacobian [.., k, 2, 32] x3 (pre-cofactor hash points,
+    signatures); consts from _fold_consts.  Returns the partial Fq12
+    Miller product [.., 12, 32] over the batch's k+1 pairs."""
+    from . import curve_jax as cj
+    # Fiat-Shamir weighting ladder: w_i = c_i * agg_i
+    w = cj.point_scalar_mul(cj.F1, aggP, cbits)
+    # cofactor-clearing ladder: H_i = h_eff * Q_i
+    hbits = jnp.broadcast_to(jnp.asarray(_h_eff_bits()),
+                             cbits.shape[:-1] + (_h_eff_bits().shape[0],))
+    H = cj.point_scalar_mul(cj.F2, hP, hbits)
+    # local G2 signature MSM: S_d = sum_i c_i * sig_i over this batch
+    # (pairs axis moved to front — point_sum_tree reduces axis 0)
+    sw = cj.point_scalar_mul(cj.F2, sP, cbits)
+    S = cj.point_sum_tree(
+        cj.F2, tuple(jnp.moveaxis(c, -3, 0) for c in sw))
+    return _fold_assemble(w, H, S, consts, _g1_jacobian_to_affine,
+                          _g2_jacobian_to_affine, miller)
+
+
+@jax.jit
+def _fold_partial_fused(aggX, aggY, aggZ, cbits, hX, hY, hZ,
+                        sX, sY, sZ, g1x, g1y, g2x, g2y, n1x, n1y):
+    """One launch per shard: the whole folded flush body under one jit
+    (ladders + MSM + affinization + miller scan + product reduce)."""
+    return _fold_partial_core(
+        (aggX, aggY, aggZ), cbits, (hX, hY, hZ), (sX, sY, sZ),
+        (g1x, g1y, g2x, g2y, n1x, n1y),
+        lambda xp, yp, xq, yq, skip: _prod_reduce_raw(
+            ft.fq12_select(skip, ft.fq12_one(skip.shape),
+                           _miller_scan(xp, yp, xq, yq))))
+
+
+_g1_affine_jit = jax.jit(_g1_jacobian_to_affine)
+_g2_affine_jit = jax.jit(_g2_jacobian_to_affine)
+
+
+def fold_partial_products(aggP, cbits, hP, sP):
+    """Per-shard partial Fq12 product of one folded flush: the shard's
+    k weighted-aggregate Miller legs times its e(-g1, S_d) local-MSM
+    leg, [.., k, ...] -> [.., 12, 32].  Inputs sharded on a leading
+    mesh axis stay sharded (the math is elementwise over it).  Fused
+    mode runs the whole body as ONE compiled program per device;
+    staged mode (CPU hosts) drives the per-piece jitted kernels —
+    identical math, millisecond compiles."""
+    consts = _fold_consts()
+    if _resolve_mode() == "fused":
+        return _fold_partial_fused(*aggP, cbits, *hP, *sP, *consts)
+    from . import curve_jax as cj
+    w = cj.g1_scalar_mul(aggP, cbits)
+    hbits = jnp.broadcast_to(jnp.asarray(_h_eff_bits()),
+                             cbits.shape[:-1] + (_h_eff_bits().shape[0],))
+    H = cj.g2_scalar_mul(hP, hbits)
+    sw = cj.g2_scalar_mul(sP, cbits)
+    # local MSM: halving-tree sum over the pairs axis (host-driven
+    # log2(k) launches of the pairwise-add kernel, the _tree_sum_host
+    # discipline — unrolling it in-graph is the fused variant's job)
+    X, Y, Z = sw
+    while X.shape[-3] > 1:
+        h = X.shape[-3] // 2
+        X, Y, Z = cj.g2_add((X[..., :h, :, :], Y[..., :h, :, :],
+                             Z[..., :h, :, :]),
+                            (X[..., h:, :, :], Y[..., h:, :, :],
+                             Z[..., h:, :, :]))
+    S = (X[..., 0, :, :], Y[..., 0, :, :], Z[..., 0, :, :])
+    return _fold_assemble(w, H, S, consts, _g1_affine_jit,
+                          _g2_affine_jit, miller_partial_products)
+
+
+# ---------------------------------------------------------------------------
 # chunked path: static-bit-pattern chunk kernels
 # ---------------------------------------------------------------------------
 

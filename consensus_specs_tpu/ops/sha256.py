@@ -168,7 +168,97 @@ def _pow2(n: int, floor: int = 8) -> int:
     return max(floor, 1 << (n - 1).bit_length()) if n > 1 else floor
 
 
-def fused_rounds(literals: bytes, rounds) -> list:
+# ---------------------------------------------------------------------------
+# device-resident literal pool (consecutive fused sweeps share buffers)
+# ---------------------------------------------------------------------------
+# Between two consecutive fused sweeps most literal inputs repeat: the
+# clean cached SIBLINGS along every dirty path (read from the merkle
+# cache levels), the shared zero-hash ladder, and the parents the
+# PREVIOUS sweep just computed.  Keeping them resident in a
+# content-addressed device pool means a re-root uploads only the dirty
+# leaf literals — the clean-sibling level buffers stay on device (the
+# ROADMAP async follow-up (c)).  The pool is keyed by exact 32-byte
+# content, so sharing is always sound; capacity is bounded and an
+# overflow simply drops the pool (correctness never depends on a hit).
+from ..utils.locks import named_lock
+
+_POOL_CAP = 1 << 15         # 32k chunks = 1 MiB of device residency
+_LIT_POOL = None            # jnp [pow2 cap, 8] device words
+_LIT_INDEX: dict = {}       # chunk bytes -> pool row
+_LIT_USED = 0
+# registered in resilience/sites.py CONCURRENCY: a sweep abandoned by
+# the watchdog keeps running on the site worker while the block thread
+# starts the next sweep — unserialized inserts could recycle a pool row
+# under a live index entry.  Mutations hold this; the jitted program
+# runs on an immutable snapshot outside it.
+_POOL_LOCK = named_lock("ops.sha256.pool")
+
+
+def _reset_pool_unlocked() -> None:
+    global _LIT_POOL, _LIT_INDEX, _LIT_USED
+    _LIT_POOL = None
+    _LIT_INDEX = {}
+    _LIT_USED = 0
+
+
+def reset_literal_pool() -> None:
+    """Drop the device literal pool (backend reconfiguration, tests)."""
+    with _POOL_LOCK:
+        _reset_pool_unlocked()
+
+
+def _pool_insert_host(chunks: list) -> None:
+    """Append host-side chunk bytes to the pool (one upload for all)."""
+    global _LIT_POOL, _LIT_USED
+    words = jnp.asarray(bytes_to_words(b"".join(chunks)))
+    _pool_reserve(_LIT_USED + len(chunks))
+    _LIT_POOL = _LIT_POOL.at[_LIT_USED:_LIT_USED + len(chunks)].set(words)
+    for c in chunks:
+        _LIT_INDEX[c] = _LIT_USED
+        _LIT_USED += 1
+
+
+def _pool_reserve(need: int) -> None:
+    """Grow the pool array to a power-of-two capacity >= need."""
+    global _LIT_POOL
+    cap = _pow2(need)
+    if _LIT_POOL is None:
+        _LIT_POOL = jnp.zeros((cap, 8), dtype=jnp.uint32)
+    elif cap > _LIT_POOL.shape[0]:
+        _LIT_POOL = jnp.concatenate(
+            [_LIT_POOL, jnp.zeros((cap - _LIT_POOL.shape[0], 8),
+                                  dtype=jnp.uint32)], axis=0)
+
+
+def _pool_adopt_outputs(out_arrays, out_bytes) -> None:
+    """Keep the sweep's computed level buffers device-resident: append
+    each new output chunk's device row to the pool (device-to-device —
+    no host upload), so the NEXT sweep's clean siblings hit the pool."""
+    global _LIT_POOL, _LIT_USED
+    for arr, blist in zip(out_arrays, out_bytes):
+        fresh = []
+        seen = set()
+        for k, b in enumerate(blist):
+            # dedupe within the round too (sparse trees repeat parent
+            # digests) — a duplicate would burn a pool row the index
+            # can never reach
+            if b not in _LIT_INDEX and b not in seen:
+                seen.add(b)
+                fresh.append((k, b))
+        if not fresh:
+            continue
+        if _LIT_USED + len(fresh) > _POOL_CAP:
+            return                      # bounded residency: stop adopting
+        _pool_reserve(_LIT_USED + len(fresh))
+        take = jnp.take(arr, jnp.asarray([k for k, _b in fresh]), axis=0)
+        _LIT_POOL = _LIT_POOL.at[
+            _LIT_USED:_LIT_USED + len(fresh)].set(take)
+        for _k, b in fresh:
+            _LIT_INDEX[b] = _LIT_USED
+            _LIT_USED += 1
+
+
+def fused_rounds(literals: bytes, rounds, stats: dict | None = None) -> list:
     """Device-resident execution of a whole hash-job DAG
     (ssz/incremental.py `_Sweep`): `literals` is the concatenation of
     every distinct 32-byte input chunk, `rounds` is a list of
@@ -177,51 +267,99 @@ def fused_rounds(literals: bytes, rounds) -> list:
     must refer to a literal or an EARLIER round's output.  Returns one
     bytes object per round (that round's concatenated 32-byte digests).
 
-    One host->device upload (literal words + index arrays), one
-    device->host download (all round outputs): a sweep costs ONE
-    round-trip where the per-level path paid one per tree level.  Both
-    axes are power-of-two padded (literal pad = zero words, index pad =
-    0) so the jitted program recompiles only per log-shape.
+    One host->device upload (ONLY the literals the device pool has not
+    seen — clean sibling buffers and the previous sweep's outputs stay
+    resident between sweeps), one device->host download (all round
+    outputs): a sweep costs ONE round-trip where the per-level path
+    paid one per tree level.  `stats`, when given, is filled with
+    {"uploaded": fresh literals uploaded, "skipped": pool hits that
+    skipped a re-upload}.  Index axes are power-of-two padded and the
+    pool grows by doubling, so the jitted program recompiles only per
+    log-shape.
     """
     if not rounds:
         return []
-    lit_words = bytes_to_words(literals) if literals \
-        else np.zeros((0, 8), dtype=np.uint32)
-    n_lits = lit_words.shape[0]
-    p_lits = _pow2(n_lits)
-    if p_lits != n_lits:
-        lit_words = np.concatenate(
-            [lit_words, np.zeros((p_lits - n_lits, 8), dtype=np.uint32)])
-    # unpadded -> padded pool index: literals keep their index, round
-    # outputs shift by the padding the pool accumulated before them
+    chunks = [literals[k * 32:(k + 1) * 32]
+              for k in range(len(literals) // 32)]
+    n_lits = len(chunks)
+    pooled = n_lits <= _POOL_CAP
+    with _POOL_LOCK:
+        if pooled:
+            fresh = []
+            seen_fresh = set()
+            for c in chunks:
+                if c not in _LIT_INDEX and c not in seen_fresh:
+                    seen_fresh.add(c)
+                    fresh.append(c)
+            skipped = n_lits - len(fresh)
+            if _LIT_USED + len(fresh) > _POOL_CAP:
+                _reset_pool_unlocked()      # overflow: drop and re-seed
+                fresh = list(dict.fromkeys(chunks))
+                skipped = 0
+            if fresh:
+                _pool_insert_host(fresh)
+            elif _LIT_POOL is None:
+                _pool_reserve(1)
+            lit_rows = [_LIT_INDEX[c] for c in chunks]
+            pool = _LIT_POOL        # immutable jnp snapshot
+        else:
+            # a sweep larger than the pool bypasses residency entirely
+            _reset_pool_unlocked()
+            skipped = 0
+            fresh = chunks
+            words = bytes_to_words(literals)
+            p = _pow2(n_lits)
+            if p != n_lits:
+                words = np.concatenate(
+                    [words, np.zeros((p - n_lits, 8), dtype=np.uint32)])
+            pool = jnp.asarray(words)
+            lit_rows = list(range(n_lits))
+    if stats is not None:
+        stats["uploaded"] = len(fresh)
+        stats["skipped"] = skipped
+    pool_rows = int(pool.shape[0])
+
+    # caller index -> program pool index: literal k maps to its pool
+    # row; round outputs live past the pool at padded offsets
     sizes = [len(il) for il, _ir in rounds]
     p_sizes = [_pow2(s) for s in sizes]
     unpadded_off = [n_lits]
-    padded_off = [p_lits]
+    padded_off = [pool_rows]
     for s, p in zip(sizes, p_sizes):
         unpadded_off.append(unpadded_off[-1] + s)
         padded_off.append(padded_off[-1] + p)
 
     uo = np.asarray(unpadded_off, dtype=np.int64)
     po = np.asarray(padded_off, dtype=np.int64)
+    row_map = np.asarray(lit_rows, dtype=np.int64) if lit_rows \
+        else np.zeros(0, dtype=np.int64)
 
     def remap(idx_list, p):
         out = np.zeros(p, dtype=np.int64)
         out[:len(idx_list)] = idx_list
         hi = out >= n_lits
         seg = np.searchsorted(uo, out[hi], side="right") - 1
-        out[hi] = po[seg] + (out[hi] - uo[seg])
-        return out.astype(np.int32)
+        lo = ~hi
+        mapped = np.zeros_like(out)
+        mapped[lo] = row_map[out[lo]]
+        mapped[hi] = po[seg] + (out[hi] - uo[seg])
+        return mapped.astype(np.int32)
 
     idx_ls, idx_rs = [], []
     for (il, ir), p in zip(rounds, p_sizes):
         idx_ls.append(jnp.asarray(remap(il, p)))
         idx_rs.append(jnp.asarray(remap(ir, p)))
-    outs = _fused_rounds_jit(jnp.asarray(lit_words), idx_ls, idx_rs)
+    outs = _fused_rounds_jit(pool, idx_ls, idx_rs)
     # speclint: disable=async-host-sync -- THE declared download of the
     # fused sweep: one device_get for every round's outputs at once
     host = jax.device_get(outs)
-    return [words_to_bytes(o[:s]) for o, s in zip(host, sizes)]
+    out_bytes = [words_to_bytes(o[:s]) for o, s in zip(host, sizes)]
+    if pooled:
+        with _POOL_LOCK:
+            _pool_adopt_outputs(
+                outs, [[ob[k * 32:(k + 1) * 32] for k in range(s)]
+                       for ob, s in zip(out_bytes, sizes)])
+    return out_bytes
 
 
 # ---------------------------------------------------------------------------

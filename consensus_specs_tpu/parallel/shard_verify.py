@@ -257,3 +257,77 @@ def pairing_product(pairs) -> bool:
         "ops.pairing_product",
         lambda: _device_pairing_product(pairs),
         lambda: _host_pairing_product(pairs)))
+
+
+# ---------------------------------------------------------------------------
+# the one-launch folded flush (device fn of the ops.pairing_fold seam)
+# ---------------------------------------------------------------------------
+
+def pairing_fold(aggs, coeffs, roots, sigs) -> bool:
+    """ONE compiled program per mesh shard for an ENTIRE folded flush
+    (sigpipe/fold.py `fold_flush`'s device fn): the hash-to-G2 cofactor
+    ladder, the Fiat–Shamir G1 weighting ladder, the shard-local G2
+    signature MSM and the partial Miller product all run inside one
+    fused launch per device (ops/pairing_jax.fold_partial_products;
+    staged per-piece kernels on CPU hosts — identical math).  Each
+    shard's partial covers its k weighted-aggregate legs PLUS one
+    `e(-g1, S_d)` leg over its local MSM partial — sound because the
+    final exponentiation restores bilinearity, so the all-reduced
+    product equals the folded `e(-g1, sum_d S_d)` check at any width.
+    Only the host hash-to-field/SSWU/isogeny prep (cheap int math, the
+    same split as `ops/bls_tpu.hash_to_g2_batch`) and the final
+    Fp12-is-one verdict read touch the host: ONE np.asarray per flush
+    (this function is a registered HOST_SYNC_BARRIERS join)."""
+    import numpy as np
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..crypto import curve as cv
+    from ..crypto import hash_to_curve as h2c
+    from ..ops import curve_jax as cj, pairing_jax as pj
+    from ..sigpipe.metrics import METRICS
+
+    n = len(aggs)
+    if n == 0:
+        return True
+    mesh = get_mesh()
+    n_dev = mesh_devices() if mesh is not None else 1
+    k_local = max(-(-n // n_dev), 1)
+    k_local = 1 << (k_local - 1).bit_length() if k_local > 1 else 1
+    rows = n_dev * k_local
+    pre = []
+    for root in roots:
+        u0, u1 = h2c.hash_to_field_fq2(bytes(root), 2)
+        pre.append(h2c.iso_map(*h2c.sswu_map(u0))
+                   + h2c.iso_map(*h2c.sswu_map(u1)))
+    pad = rows - n
+    aggs = list(aggs) + [cv.g1_infinity()] * pad
+    coeffs = [int(c) for c in coeffs] + [0] * pad
+    pre = pre + [pre[0]] * pad          # padded rows are skip-masked
+    sigs = list(sigs) + [cv.g2_infinity()] * pad
+
+    def shape(a, trailing):
+        return a.reshape((n_dev, k_local) + trailing)
+
+    aggP = tuple(shape(c, (32,)) for c in cj.g1_pack(aggs))
+    cbits = shape(cj.scalars_to_bits(coeffs, n_bits=64), (64,))
+    hP = tuple(shape(c, (2, 32)) for c in cj.g2_pack(pre))
+    sP = tuple(shape(c, (2, 32)) for c in cj.g2_pack(sigs))
+    if mesh is not None:
+        METRICS.inc_labeled("sharded_dispatches", "ops.pairing_fold")
+
+        def put(a):
+            spec = P(AXIS, *([None] * (a.ndim - 1)))
+            return jax.device_put(a, NamedSharding(mesh, spec))
+
+        aggP = tuple(put(c) for c in aggP)
+        cbits = put(cbits)
+        hP = tuple(put(c) for c in hP)
+        sP = tuple(put(c) for c in sP)
+    partials = pj.fold_partial_products(aggP, cbits, hP, sP)
+    partials = _apply_poison(partials)
+    # leg accounting (N aggregate legs + one local-MSM leg per shard)
+    # is observed by the CALLER (fold.fold_flush) after the dispatch
+    # returns — observing here would double-count a watchdog-abandoned
+    # dispatch alongside its host fallback
+    return bool(np.asarray(pj.fq12_product_is_one(partials)))

@@ -60,39 +60,47 @@ class DifferentialGuard:
         self._rng = random.Random(seed)
         self._lock = named_rlock("resilience.guard")
 
-    def check(self, sets, indices, verdicts) -> bool:
+    def check(self, sets, indices, verdicts, reason_for=None):
         """Cross-check a sample of `verdicts` (for sets[i], i in indices)
-        against the oracle.  Returns True if the batch is trustworthy;
-        False means a mismatch was found, the backend was quarantined,
-        and the CALLER MUST recompute all verdicts via the oracle."""
+        against the oracle.  Returns None if the batch is trustworthy;
+        otherwise the mismatch's reason label — the backend was
+        quarantined under it and the CALLER MUST recompute all verdicts
+        via the oracle.  `reason_for(i)` maps the MISMATCHING set index
+        to the label of the path that produced its verdict
+        (`fold_mismatch` for a folded fused leg, `guard_mismatch`
+        otherwise), so incident streams attribute a trip to the path
+        that actually corrupted — not merely to whatever mode the flush
+        ran in."""
         if self.sample_rate <= 0.0 or not indices:
-            return True
+            return None
         with self._lock:
             sampled = [i for i in indices
                        if self._rng.random() < self.sample_rate]
         if not sampled:
-            return True
+            return None
         METRICS.inc("guard_samples", len(sampled))
         for i in sampled:
             expect = oracle_verdict(sets[i])
             if bool(verdicts[i]) != expect:
+                reason = (reason_for(i) if reason_for is not None
+                          else "guard_mismatch")
                 METRICS.inc("guard_mismatches")
                 INCIDENTS.record(
                     "sigpipe.fused", "guard_mismatch",
                     set_kind=sets[i].kind, got=bool(verdicts[i]),
-                    expected=expect)
-                self._quarantine_backend()
-                return False
-        return True
+                    expected=expect, reason=reason)
+                self._quarantine_backend(reason)
+                return reason
+        return None
 
     @staticmethod
-    def _quarantine_backend() -> None:
+    def _quarantine_backend(reason: str = "guard_mismatch") -> None:
         from . import supervisor
         sup = supervisor.active()
         if sup is None:
             return
         for site in FUSED_SITES:
-            sup.quarantine(site, reason="guard_mismatch")
+            sup.quarantine(site, reason=reason)
 
 
 # Per-node-context ROUTER like the supervisor and the fault plan: a

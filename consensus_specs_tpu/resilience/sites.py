@@ -102,6 +102,14 @@ REGISTRY: tuple[Site, ...] = (
          kind=DISPATCH, chaos=REPLAY, sharded=True),
     Site("ops.msm", "consensus_specs_tpu.sigpipe.scheduler",
          kind=DISPATCH, chaos=REPLAY, sharded=True),
+    # the folded signature-leg seam (sigpipe/fold.py): the G2 MSM that
+    # collapses every e(-c_i*g1, sig_i) leg into ONE e(-g1, S) pair —
+    # and, on the tpu backend's one-launch path, the whole fused flush
+    # program per shard.  REPLAY tier: folding is on by default, so
+    # every native-backend fused replay crosses it (FOLD_VERIFY=0 is
+    # the escape hatch back to the 2N-leg flush)
+    Site("ops.pairing_fold", "consensus_specs_tpu.sigpipe.fold",
+         kind=DISPATCH, chaos=REPLAY, fused=True, sharded=True),
     Site("ssz.merkle_sweep", "consensus_specs_tpu.ssz.incremental",
          kind=DISPATCH, chaos=REPLAY, corrupt="digest"),
     # -- gossip tier extra: the admission pipeline's batch window
@@ -271,6 +279,9 @@ HOST_SYNC_BARRIERS: tuple = (
     # np.asarray of the final Fp12-is-one verdict per flush
     ("consensus_specs_tpu.parallel.shard_verify",
      "_device_pairing_product"),
+    # the one-launch folded flush's verdict join: one compiled program
+    # per shard, then ONE np.asarray of the final Fp12-is-one verdict
+    ("consensus_specs_tpu.parallel.shard_verify", "pairing_fold"),
     # mesh-engine result downloads: each is the single forced read at
     # the end of one fused epoch-processing dispatch
     ("consensus_specs_tpu.parallel.mesh_engine", "subtree_root"),
@@ -458,6 +469,19 @@ CONCURRENCY = Concurrency(
         LockSpec("resilience.guard",
                  "consensus_specs_tpu.resilience.guard", "_lock",
                  cls="DifferentialGuard", guards=("_rng",)),
+        # -- ops (outside the static pass scope; registered for the
+        # runtime tracer and the dead-entry check) ---------------------
+        LockSpec("ops.sha256.pool", "consensus_specs_tpu.ops.sha256",
+                 "_POOL_LOCK", kind="lock",
+                 guards=(),
+                 note="device-resident merkle literal pool "
+                      "(_LIT_POOL/_LIT_INDEX/_LIT_USED): an abandoned "
+                      "watchdog sweep may still be inserting while the "
+                      "block thread starts the next sweep; the jitted "
+                      "program runs on an immutable snapshot outside "
+                      "the lock.  ops is outside the lock-discipline "
+                      "pass scope, so the guard set is enforced by "
+                      "review + the TSAN tracer, not listed here"),
         # -- utils -----------------------------------------------------
         LockSpec("nodectx.stack", "consensus_specs_tpu.utils.nodectx",
                  "_lock", guards=("_stack",)),

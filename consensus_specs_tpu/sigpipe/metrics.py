@@ -31,10 +31,21 @@ counters: `g1_aggregate_dispatches` (batched committee-sum calls at the
 sweep calls at `ops.msm`) count the per-flush device work — exactly one
 of each per fused flush — while `host_point_adds` counts every
 point add/double the per-set HOST fallback loops perform (cache sums,
-weighting ladders, bisection's oracle re-derivation): ~0 whenever the
-device path is healthy, which is what `make msm-bench` and the sweep
-tests pin.  All three ride the ordinary counter path and land in the
-JSON dump.
+weighting ladders, the G2 fold's fallback sum, bisection's oracle
+re-derivation): ~0 whenever the device path is healthy, which is what
+`make msm-bench` and the sweep tests pin.  All three ride the ordinary
+counter path and land in the JSON dump.
+
+The folded pairing product (sigpipe/fold.py) adds the COUNTED perf
+invariant the fold bench and tier-1 assert without wall-clock timing:
+`miller_loops_per_flush` (an observation — per fused flush, the number
+of pairing legs assembled: N+1 folded vs 2N unfolded for an N-set
+flush), the labeled `fold_enabled` counter (one `on`/`off` tick per
+fused flush, so a snapshot says which assembly every flush used), and
+`fold_dispatches` (one `ops.pairing_fold` dispatch per folded flush).
+The `scalar_fallbacks` reason vocabulary gains `fold_mismatch`: a
+differential-guard trip on the folded path, distinguishable from a
+legacy `guard_mismatch` in incident streams.
 
 Incremental merkleization (ssz/incremental.py) reports here too, so one
 snapshot covers the whole per-block device story: `merkle_sweep_dispatches`
@@ -59,7 +70,11 @@ flush's verify dispatches on the synchronous path; pinned 0 with
 overlap on), `abandoned_flushes`, the power-of-two
 `flush_inflight_depth` histogram, and
 `merkle_device_round_trips` (host<->device transfers per merkle sweep:
-1 on the fused device-resident path, one per bulk level otherwise).
+1 on the fused device-resident path, one per bulk level otherwise)
+with its sibling counters `merkle_sibling_uploads` (literal chunks a
+fused sweep actually uploaded) and `merkle_sibling_uploads_skipped`
+(clean-sibling level buffers found already device-resident in the
+literal pool — the re-uploads the pool exists to skip).
 
 Histograms (`observe_hist`) bucket integer observations by
 power-of-two: the gossip admission layer records batch occupancy per

@@ -59,6 +59,20 @@ Fp12-multiply all-reduce, ONE final exponentiation — taken instead of
 of the mesh died" is just another fault (`shard_dead` in
 resilience/faults.py: same breaker -> scalar-fallback -> half-open
 contract; docs/sigpipe.md "Sharded verify").
+
+FOLDED SIGNATURE LEGS.  The `e(-c_i * g1, sig_i)` legs all share the
+base -g1, so by bilinearity they fold to ONE pair `e(-g1, S)` over the
+G2 MSM `S = sum_i c_i * sig_i` (sigpipe/fold.py, the
+`ops.pairing_fold` seam): an N-set flush pays N+1 Miller loops instead
+of 2N — the counted `miller_loops_per_flush` invariant — and the
+weighted-G1 MSM halves to N jobs.  On the tpu backend with the fused
+pairing mode the ENTIRE folded flush further fuses into one compiled
+program per mesh shard (fold.fold_flush -> shard_verify.pairing_fold:
+cofactor sweep + weighting + G2 MSM + partial Miller product in one
+launch, the log2(D) Fp12 all-reduce unchanged).  Bisection is
+untouched either way — probes re-derive both legs per set on the HOST
+ladder — and `FOLD_VERIFY=0` restores the 2N-leg flush byte-for-byte
+(docs/sigpipe.md "Folded pairing product").
 """
 from __future__ import annotations
 
@@ -69,6 +83,7 @@ from ..crypto.bls12_381 import _load_signature
 from ..crypto.curve import DecodeError
 from ..utils import bls
 from . import bisect as _bisect
+from . import fold
 from . import pipeline_async
 from .cache import AGGREGATES
 from .metrics import METRICS
@@ -192,54 +207,99 @@ def _verify_fused(sets, prepared, verdicts, strict=None, hash_leg=None):
     host-sync stall between them, and the first forced read is the
     verdict join below.  Without a leg (ASYNC_FLUSH=0, scenario
     fleets) the dispatch order is byte-for-byte the historical one,
-    with the host stall it implies counted as a `device_idle_gaps`."""
+    with the host stall it implies counted as a `device_idle_gaps`.
+
+    With folding live (sigpipe/fold.py, the default) the flush emits
+    N+1 pairing legs — N weighted aggregate legs plus ONE `e(-g1, S)`
+    leg over the folded G2 MSM — instead of 2N; on the one-launch path
+    (tpu backend, fused pairing mode) the whole chain collapses into a
+    single `ops.pairing_fold` dispatch.  `FOLD_VERIFY=0` restores the
+    2N-leg assembly byte-for-byte."""
     entries = [(sets[i], agg, sig) for i, agg, sig in prepared]
-    if hash_leg is None:
+    folded = fold.live()
+    one_launch = folded and hash_leg is None and fold.one_launch_live()
+    roots = [s.signing_root for s, _, _ in entries]
+    hashes = None
+    if hash_leg is None and not one_launch:
         pipeline_async.sync_gap()
-        hashes = _hash_roots([s.signing_root for s, _, _ in entries])
+        hashes = _hash_roots(roots)
     coeffs = _coefficients(entries)
     neg_g1 = -cv.g1_generator()
-    bases, scalars = [], []
-    for (_s, agg, _sig), c in zip(entries, coeffs):
-        bases.extend((agg, neg_g1))
-        scalars.extend((c, c))
-    weighted_flat = _weighted_g1(bases, scalars)
-    if hash_leg is not None:
-        # join as late as the data flow allows: hash-to-G2 of every
-        # strict root ran concurrently with prepare/aggregate/MSM; a
-        # set `_prepare` screened out (bad signature, cold decode
-        # failure) simply leaves its hash unused — per-root outputs are
-        # independent, so the subset is byte-identical to hashing only
-        # the surviving roots
-        all_hashes = hash_leg.get()
-        pos = {i: k for k, i in enumerate(strict)}
-        hashes = [all_hashes[pos[i]] for i, _agg, _sig in prepared]
-    weighted, groups = [], []
-    for k, ((s, agg, sig), h, c) in enumerate(
-            zip(entries, hashes, coeffs)):
-        weighted.append([(weighted_flat[2 * k], h),
-                         (weighted_flat[2 * k + 1], sig)])
-        groups.append((agg, c, h, sig))
+    METRICS.inc_labeled("fold_enabled", "on" if folded else "off")
+    if one_launch:
+        # ONE launch per shard: hash cofactor sweep + G1 weighting +
+        # G2 signature MSM + partial Miller products fused into a
+        # single `ops.pairing_fold` dispatch (the pairs-axis all-reduce
+        # and final exponentiation unchanged)
+        METRICS.inc("dispatches")
+        ok = fold.fold_flush(
+            [agg for _s, agg, _sig in entries], coeffs, roots,
+            [sig for _s, _agg, sig in entries])
+    else:
+        if folded:
+            # N weightings instead of 2N: the signature legs need no
+            # G1 weighting — their coefficients ride the G2 fold
+            bases = [agg for _s, agg, _sig in entries]
+            scalars = list(coeffs)
+        else:
+            bases, scalars = [], []
+            for (_s, agg, _sig), c in zip(entries, coeffs):
+                bases.extend((agg, neg_g1))
+                scalars.extend((c, c))
+        weighted_flat = _weighted_g1(bases, scalars)
+        if folded:
+            S = fold.fold_signatures(
+                [sig for _s, _agg, sig in entries], coeffs)
+        if hash_leg is not None:
+            # join as late as the data flow allows: hash-to-G2 of every
+            # strict root ran concurrently with prepare/aggregate/MSM
+            # (and the G2 fold); a set `_prepare` screened out (bad
+            # signature, cold decode failure) simply leaves its hash
+            # unused — per-root outputs are independent, so the subset
+            # is byte-identical to hashing only the surviving roots
+            all_hashes = hash_leg.get()
+            pos = {i: k for k, i in enumerate(strict)}
+            hashes = [all_hashes[pos[i]] for i, _agg, _sig in prepared]
+        if folded:
+            pairs = [(weighted_flat[k], h)
+                     for k, h in enumerate(hashes)]
+            pairs.append((neg_g1, S))
+        else:
+            pairs = []
+            for k, ((_s, _agg, sig), h) in enumerate(
+                    zip(entries, hashes)):
+                pairs.append((weighted_flat[2 * k], h))
+                pairs.append((weighted_flat[2 * k + 1], sig))
+        METRICS.observe("miller_loops_per_flush", len(pairs))
+        METRICS.inc("dispatches")
+        ok = _pairing_product(pairs)
 
     def group_valid(sub_groups):
         # bisection probe: re-derive each group's weighted pairs on the
         # HOST ladder, so invalid-set isolation never trusts a possibly
-        # corrupt device sweep — a lying `ops.msm` answer degrades to
-        # one failed product plus an oracle-weighted re-check, not to
-        # wrong per-set verdicts
+        # corrupt device sweep OR a corrupt folded MSM — a lying device
+        # answer degrades to one failed product plus an oracle-weighted
+        # re-check, not to wrong per-set verdicts.  Probes always carry
+        # both legs per set (the folded product cannot attribute, so
+        # isolation re-derives the unfolded algebra)
         METRICS.inc("dispatches")
-        pairs = []
+        probe_pairs = []
         for agg, c, h, sig in sub_groups:
-            pairs.append((_host_scalar_mul(agg, c), h))
-            pairs.append((_host_scalar_mul(neg_g1, c), sig))
-        return bls.pairing_check(pairs)
+            probe_pairs.append((_host_scalar_mul(agg, c), h))
+            probe_pairs.append((_host_scalar_mul(neg_g1, c), sig))
+        return bls.pairing_check(probe_pairs)
 
-    METRICS.inc("dispatches")
-    ok = _pairing_product([p for group in weighted for p in group])
     if ok:
         bad_local = set()
     else:
         METRICS.inc("fused_batch_failures")
+        if hashes is None:
+            # one-launch failure: the per-set hashes never existed on
+            # the host — derive them now for the probes (the same
+            # supervised hash seam the staged chain crosses)
+            hashes = _hash_roots(roots)
+        groups = [(agg, c, h, sig) for (_s, agg, sig), h, c in zip(
+            entries, hashes, coeffs)]
         if len(groups) == 1:
             # isolate_failures condemns a singleton without re-probing
             # (its contract assumes the caller's failing check is
@@ -289,18 +349,26 @@ def _verify_per_set(indices, sets, verdicts):
             verdicts[i] = bool(v)
 
 
-def _guard_verdicts(sets, verdicts):
+def _guard_verdicts(sets, verdicts, reason_for=None):
     """Differential guard (resilience/guard.py): cross-check a sample of
     batch verdicts against the scalar oracle; on mismatch the backend is
     quarantined and EVERY verdict is recomputed on the trusted path —
-    silent corruption degrades to the oracle instead of deciding."""
+    silent corruption degrades to the oracle instead of deciding.
+    `reason_for(i)` labels the fallback (and the quarantine) by the
+    path that produced the MISMATCHING verdict: `fold_mismatch` for a
+    folded fused leg, `guard_mismatch` otherwise — so incident streams
+    attribute a folded-path trip precisely, and a corruption in an
+    unrelated leg (a lax per-set batch of the same flush) never points
+    operators at the fold."""
     from ..resilience import guard
     g = guard.active()
     if g is None:
         return verdicts
-    if g.check(sets, list(range(len(sets))), verdicts):
+    mismatch = g.check(sets, list(range(len(sets))), verdicts,
+                       reason_for=reason_for)
+    if mismatch is None:
         return verdicts
-    METRICS.inc_labeled("scalar_fallbacks", "guard_mismatch")
+    METRICS.inc_labeled("scalar_fallbacks", mismatch)
     return [guard.oracle_verdict(s) for s in sets]
 
 
@@ -318,17 +386,28 @@ def verify_sets(sets, mode: str = "fused"):
         METRICS.inc("stubbed_batches")
         return [True] * n
     verdicts: list = [None] * n
+    guard_reason_for = None
     with METRICS.timer("verify_sets"):
         if mode == "per-set":
             _verify_per_set(list(range(n)), sets, verdicts)
         elif mode == "fused":
+            if fold.live():
+                # only the strict (required) sets ride the folded
+                # product; lax sets take the per-set batch APIs, so a
+                # mismatch there keeps the legacy label
+                guard_reason_for = (
+                    lambda i: "fold_mismatch" if sets[i].required
+                    else "guard_mismatch")
             strict = [i for i, s in enumerate(sets) if s.required]
             lax = [i for i, s in enumerate(sets) if not s.required]
             hash_leg = None
-            if strict and pipeline_async.overlap_live():
+            if strict and pipeline_async.overlap_live() \
+                    and not fold.one_launch_live():
                 # overlapped leg: hash-to-G2 needs only the signing
                 # roots, so it launches BEFORE the G1 aggregation sweep
-                # and runs concurrently with the whole prepare chain
+                # and runs concurrently with the whole prepare chain.
+                # The one-launch folded path owns the cofactor sweep
+                # inside its single fused program — nothing to overlap
                 roots = [sets[i].signing_root for i in strict]
                 hash_leg = pipeline_async.launch_leg(
                     lambda: _hash_roots(roots), "hash_to_g2")
@@ -343,5 +422,6 @@ def verify_sets(sets, mode: str = "fused"):
                 _verify_per_set(lax, sets, verdicts)
         else:
             raise ValueError(f"unknown sigpipe mode {mode!r}")
-        verdicts = _guard_verdicts(sets, verdicts)
+        verdicts = _guard_verdicts(sets, verdicts,
+                                   reason_for=guard_reason_for)
     return verdicts

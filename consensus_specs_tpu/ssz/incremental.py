@@ -621,8 +621,14 @@ def _hash_rounds_fused(sw: _Sweep) -> list:
     once, every round's dirty-index gather and batched hash stays in
     device memory, and the per-round outputs come back in a single
     download — one host<->device round-trip per re-root instead of one
-    per tree level.  Pure, like `_hash_rounds`: inputs are copied into
-    the job plan, nothing touches a cache."""
+    per tree level.  Between consecutive sweeps the device literal pool
+    keeps the clean-sibling level buffers (and the previous sweep's
+    outputs) resident, so a re-root uploads only the DIRTY literals —
+    pool hits land in `merkle_sibling_uploads_skipped`, the sibling
+    counter next to `merkle_device_round_trips`.  Pure, like
+    `_hash_rounds`: inputs are copied into the job plan, nothing
+    touches a cache (the pool is content-addressed device residency,
+    never consulted for roots)."""
     from ..ops import sha256 as _sha
     lits: list = []
     lit_pos: dict = {}
@@ -643,8 +649,12 @@ def _hash_rounds_fused(sw: _Sweep) -> list:
 
     rounds = [([idx(left) for left, _r in jobs],
                [idx(right) for _l, right in jobs]) for jobs in sw.rounds]
-    out_bytes = _sha.fused_rounds(b"".join(lits), rounds)
+    stats: dict = {}
+    out_bytes = _sha.fused_rounds(b"".join(lits), rounds, stats=stats)
     _METRICS.inc("merkle_device_round_trips")
+    if stats.get("skipped"):
+        _METRICS.inc("merkle_sibling_uploads_skipped", stats["skipped"])
+    _METRICS.inc("merkle_sibling_uploads", stats.get("uploaded", 0))
     return [[ob[k * 32:(k + 1) * 32] for k in range(len(jobs))]
             for ob, jobs in zip(out_bytes, sw.rounds)]
 

@@ -1,0 +1,439 @@
+"""Folded pairing product (sigpipe/fold.py + the ops.pairing_fold seam).
+
+The acceptance contract:
+
+  * `miller_loops_per_flush` == N+1 with folding on (vs 2N off) for an
+    N-set fused flush, at N in {1, 16, 1024} — a counted invariant,
+    not a wall-clock claim;
+  * folded and unfolded paths produce byte-identical verdicts and
+    store roots, including under injected faults and the bisection
+    matrix (single-set flush, all-invalid, one-bad-in-N, a
+    zero/identity-point signature through the G2 MSM);
+  * `FOLD_VERIFY=0` restores the 2N-leg flush byte-for-byte (lazy env
+    resolution, the MSM_MODE discipline);
+  * a breaker trip at `ops.pairing_fold` degrades to the counted
+    per-set host ladder with unchanged verdicts; a corrupt fold can
+    only FAIL the product (bisection re-derives probes on the host
+    ladder); the vacuous-pass corruption is the differential guard's
+    case and is labeled `fold_mismatch` on this path.
+
+The mesh-width legs (sharded G2 fold MSM, the one-launch fused
+program) live in tests/test_shard_verify.py (kernel tier).
+"""
+import pytest
+
+from consensus_specs_tpu import resilience, sigpipe
+from consensus_specs_tpu.crypto import curve as cv
+from consensus_specs_tpu.ops import msm as ops_msm
+from consensus_specs_tpu.resilience import (
+    FaultPlan, FaultSpec, INCIDENTS, faults,
+)
+from consensus_specs_tpu.sigpipe import METRICS, cache, fold, scheduler
+from consensus_specs_tpu.sigpipe.sets import SignatureSet
+from consensus_specs_tpu.specs import get_spec
+from consensus_specs_tpu.ssz import hash_tree_root, uint64
+from consensus_specs_tpu.test_infra.attestations import get_valid_attestation
+from consensus_specs_tpu.test_infra.blocks import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block)
+from consensus_specs_tpu.test_infra.genesis import (
+    create_genesis_state, default_balances)
+from consensus_specs_tpu.test_infra.keys import privkeys, pubkeys
+from consensus_specs_tpu.utils import bls
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    fold.reset_mode()
+    resilience.disable()
+    sigpipe.disable()
+    INCIDENTS.clear()
+    METRICS.reset()
+    cache.clear()
+    yield
+    fold.reset_mode()
+    resilience.disable()
+    sigpipe.disable()
+    INCIDENTS.clear()
+
+
+def _sets(n, bad=()):
+    """n real single-pubkey SignatureSets; wrong-message signatures at
+    `bad`."""
+    out = []
+    for i in range(n):
+        msg = i.to_bytes(8, "little") + b"\x3c" * 24
+        signed = msg if i not in bad else b"\x01" * 32
+        sig = bls.Sign(privkeys[i % 16], signed)
+        out.append(SignatureSet(
+            pubkeys=(bytes(pubkeys[i % 16]),), signing_root=msg,
+            signature=bytes(sig), kind="fold", origin=("fold", i)))
+    return out
+
+
+def _both_modes(sets_fn):
+    """(fold-on verdicts, fold-off verdicts) over fresh caches and
+    metrics — the snapshot after the call describes the OFF leg."""
+    fold.FOLD_MODE = "on"
+    cache.clear()
+    METRICS.reset()
+    on = scheduler.verify_sets(sets_fn(), mode="fused")
+    fold.FOLD_MODE = "off"
+    cache.clear()
+    METRICS.reset()
+    off = scheduler.verify_sets(sets_fn(), mode="fused")
+    fold.reset_mode()
+    return on, off
+
+
+# ---------------------------------------------------------------------------
+# mode resolution (the FOLD_VERIFY escape hatch)
+# ---------------------------------------------------------------------------
+
+def test_fold_mode_resolves_lazily_and_resets(monkeypatch):
+    """FOLD_VERIFY is read at resolve time, not import time: flipping
+    the env var plus reset_mode() always wins, direct assignment (the
+    test-fixture idiom) wins over both, and the default is ON."""
+    monkeypatch.setenv("FOLD_VERIFY", "0")
+    fold.reset_mode()
+    assert not fold.live()
+    monkeypatch.delenv("FOLD_VERIFY")
+    assert not fold.live()          # cached until reset
+    fold.reset_mode()
+    assert fold.live()              # default: folding on
+    monkeypatch.setenv("FOLD_VERIFY", "off")
+    fold.reset_mode()
+    assert not fold.live()
+    fold.FOLD_MODE = "on"
+    assert fold.live()              # direct assignment wins
+
+
+# ---------------------------------------------------------------------------
+# the counted invariant: miller_loops_per_flush == N+1 (vs 2N)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n", [1, 16, 1024])
+def test_miller_loops_per_flush_is_n_plus_one(n, monkeypatch):
+    """THE acceptance pin, at N in {1, 16, 1024}.  The flush's heavy
+    engines are stubbed (one shared pubkey, constant hash/sig/weight
+    points, product forced True) so the 1024-set leg counts legs in
+    milliseconds — the counting sits in the scheduler's assembly, which
+    runs for real."""
+    g1 = cv.g1_generator()
+    g2 = cv.g2_generator()
+    seen = {}
+
+    def fake_product(pairs):
+        seen["pairs"] = len(pairs)
+        return True
+
+    monkeypatch.setattr(scheduler, "_hash_roots",
+                        lambda roots: [g2] * len(roots))
+    monkeypatch.setattr(scheduler, "_load_signature", lambda b: g2)
+    monkeypatch.setattr(scheduler, "_weighted_g1",
+                        lambda pts, cs: [g1] * len(pts))
+    monkeypatch.setattr(fold, "_fold_sweep",
+                        lambda sigs, cs: cv.g2_infinity())
+    monkeypatch.setattr(scheduler, "_pairing_product", fake_product)
+    pk = bytes(pubkeys[0])
+    sets = [SignatureSet(pubkeys=(pk,), signing_root=b"\x11" * 32,
+                         signature=b"\x22" * 96, kind="fold")
+            for _ in range(n)]
+
+    for mode, expect in (("on", n + 1), ("off", 2 * n)):
+        fold.FOLD_MODE = mode
+        cache.clear()
+        METRICS.reset()
+        seen.clear()
+        assert scheduler.verify_sets(sets, mode="fused") == [True] * n
+        snap = METRICS.snapshot()
+        assert seen["pairs"] == expect
+        assert snap["miller_loops_per_flush"]["total"] == expect
+        assert snap["miller_loops_per_flush"]["count"] == 1
+        assert snap["fold_enabled"] == {mode: 1}
+        if mode == "on":
+            assert snap["fold_dispatches"] == 1
+        else:
+            assert "fold_dispatches" not in snap
+
+
+# ---------------------------------------------------------------------------
+# fold-on/off byte parity: verdicts, bisection, adversarial edges
+# ---------------------------------------------------------------------------
+
+def test_fold_parity_one_bad_in_n_bisects_to_exact_indices():
+    on, off = _both_modes(lambda: _sets(6, bad={3}))
+    assert on == off == [True, True, True, False, True, True]
+    assert METRICS.count("fused_batch_failures") == 1
+
+
+def test_fold_parity_single_set_flush():
+    for bad in ((), (0,)):
+        on, off = _both_modes(lambda b=bad: _sets(1, bad=b))
+        assert on == off == [not bad]
+
+
+def test_fold_parity_all_invalid():
+    on, off = _both_modes(lambda: _sets(4, bad={0, 1, 2, 3}))
+    assert on == off == [False] * 4
+
+
+def test_fold_parity_identity_point_signature_through_the_msm():
+    """A compressed-infinity signature folds c*O into S — the G2 MSM's
+    identity edge — and must read invalid exactly like the unfolded
+    skip-masked leg (and like the scalar oracle)."""
+    inf_sig = b"\xc0" + b"\x00" * 95
+    msg = b"\x09" * 32
+
+    def mixed():
+        s = _sets(3)
+        s.append(SignatureSet(pubkeys=(bytes(pubkeys[5]),),
+                              signing_root=msg, signature=inf_sig,
+                              kind="fold", origin=("fold", "inf")))
+        return s
+
+    on, off = _both_modes(mixed)
+    scalar = bls.FastAggregateVerify([bytes(pubkeys[5])], msg, inf_sig)
+    assert on == off == [True, True, True, scalar]
+
+
+def test_fold_parity_block_root_byte_identical():
+    """state_transition under sigpipe: folded and unfolded flushes
+    produce byte-identical post-state roots (and FOLD_VERIFY=0 really
+    is today's path: zero fold dispatches)."""
+    spec = get_spec("altair", "minimal")
+    state = create_genesis_state(spec, default_balances(spec))
+    spec.process_slots(state, uint64(spec.SLOTS_PER_EPOCH + 2))
+    att = get_valid_attestation(spec, state, signed=True)
+    advanced = state.copy()
+    spec.process_slots(advanced, uint64(
+        state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    block = build_empty_block_for_next_slot(spec, advanced)
+    block.body.attestations.append(att)
+    signed = state_transition_and_sign_block(spec, advanced.copy(), block)
+    native = advanced.copy()
+    spec.state_transition(native, signed)
+
+    roots = {}
+    for mode in ("on", "off"):
+        fold.FOLD_MODE = mode
+        cache.clear()
+        METRICS.reset()
+        sigpipe.enable()
+        trial = advanced.copy()
+        try:
+            spec.state_transition(trial, signed)
+        finally:
+            sigpipe.disable()
+        roots[mode] = hash_tree_root(trial)
+        if mode == "off":
+            assert METRICS.count("fold_dispatches") == 0
+        else:
+            assert METRICS.count("fold_dispatches") >= 1
+    assert roots["on"] == roots["off"] == hash_tree_root(native)
+
+
+# ---------------------------------------------------------------------------
+# the ops.pairing_fold seam: breaker, corrupt fold, guard label
+# ---------------------------------------------------------------------------
+
+def test_fold_breaker_trips_to_counted_host_ladder():
+    """A persistent raise at ops.pairing_fold trips the breaker; the
+    flush degrades to the per-set host fold (its ladder ops counted in
+    host_point_adds) with verdicts unchanged."""
+    sets = _sets(4, bad={1})
+    clean = scheduler.verify_sets(sets, mode="fused")
+    cache.clear()
+    METRICS.reset()
+    resilience.enable(max_retries=0, breaker_threshold=1, probe_after=99)
+    plan = FaultPlan(
+        [FaultSpec("ops.pairing_fold", "raise", persistent=True)],
+        seed=7)
+    try:
+        with faults.inject(plan):
+            faulted = scheduler.verify_sets(sets, mode="fused")
+        state_after = resilience.supervisor.active().breaker_state(
+            "ops.pairing_fold")
+    finally:
+        resilience.disable()
+    assert faulted == clean == [True, False, True, True]
+    assert state_after == "open"
+    assert plan.total_fires() >= 1
+    assert METRICS.count("host_point_adds") > 0
+    assert INCIDENTS.count(event="injected") == plan.total_fires()
+
+
+def test_corrupt_fold_sweep_cannot_flip_verdicts(monkeypatch):
+    """A lying G2 fold (garbage S) fails the product; bisection
+    re-derives every probe's BOTH legs on the host ladder, so verdicts
+    come out right for valid and invalid sets alike."""
+    monkeypatch.setattr(
+        fold, "_fold_sweep",
+        lambda sigs, coeffs: cv.g2_generator() * 1234567)
+    sets = _sets(3, bad={2})
+    verdicts = scheduler.verify_sets(sets, mode="fused")
+    assert verdicts == [True, True, False]
+    assert METRICS.count("fused_batch_failures") == 1
+    assert METRICS.count("host_point_adds") > 0
+
+
+def test_corrupt_fold_cannot_flip_a_single_set_flush(monkeypatch):
+    """The singleton host re-check covers the folded path too: a one-
+    set flush whose product failed only because the fold lied keeps its
+    true verdict after the host ladder re-check."""
+    monkeypatch.setattr(
+        fold, "_fold_sweep",
+        lambda sigs, coeffs: cv.g2_generator() * 555)
+    for bad in ((), (0,)):
+        cache.clear()
+        METRICS.reset()
+        verdicts = scheduler.verify_sets(_sets(1, bad=bad), mode="fused")
+        assert verdicts == [not bad]
+        assert METRICS.count("fused_batch_failures") == 1
+
+
+def test_vacuous_pass_corruption_labeled_fold_mismatch(monkeypatch):
+    """The corruption bisection cannot see — BOTH device sweeps
+    answering identity makes the folded product trivially pass — is the
+    differential guard's case, and on the folded path the trip is
+    labeled `fold_mismatch` (satellite: distinguishable from a legacy
+    guard_mismatch in incident streams)."""
+    monkeypatch.setattr(
+        ops_msm, "g1_weighted_sweep",
+        lambda points, scalars: [cv.g1_infinity()] * len(points))
+    monkeypatch.setattr(
+        fold, "_fold_sweep", lambda sigs, coeffs: cv.g2_infinity())
+    sets = _sets(3, bad={2})
+    resilience.enable(guard_sample_rate=1.0, guard_seed=7)
+    try:
+        verdicts = scheduler.verify_sets(sets, mode="fused")
+    finally:
+        resilience.disable()
+    assert verdicts == [True, True, False]      # oracle verdicts win
+    assert METRICS.count_labeled("scalar_fallbacks", "fold_mismatch") >= 1
+    assert METRICS.count_labeled("scalar_fallbacks", "guard_mismatch") == 0
+    assert INCIDENTS.count(event="quarantine") >= 1
+    assert INCIDENTS.events("quarantine")[0]["reason"] == "fold_mismatch"
+
+
+def test_lax_set_corruption_keeps_legacy_guard_label():
+    """Attribution precision: with folding ON, a corrupt verdict in the
+    flush's LAX per-set leg (valid-or-skip sets never touch the folded
+    product) must still label its guard trip `guard_mismatch` — the
+    fold_mismatch label is reserved for verdicts the folded legs
+    produced."""
+    strict = _sets(2)
+    lax_msg = b"\x4d" * 32
+    lax = SignatureSet(
+        pubkeys=(bytes(pubkeys[9]),), signing_root=lax_msg,
+        signature=bytes(bls.Sign(privkeys[9], lax_msg)), kind="deposit",
+        required=False)
+    resilience.enable(guard_sample_rate=1.0, guard_seed=3)
+    plan = FaultPlan(
+        [FaultSpec("bls.verify_batch", "corrupt", persistent=True)],
+        seed=3)
+    try:
+        with faults.inject(plan):
+            verdicts = scheduler.verify_sets(strict + [lax], mode="fused")
+    finally:
+        resilience.disable()
+    assert verdicts == [True, True, True]       # oracle verdicts win
+    assert plan.total_fires() >= 1
+    assert METRICS.count_labeled("scalar_fallbacks", "guard_mismatch") >= 1
+    assert METRICS.count_labeled("scalar_fallbacks", "fold_mismatch") == 0
+
+
+# ---------------------------------------------------------------------------
+# fold-on/off parity across the gossip chaos matrix (the PR-11 harness)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def gossip_ingestion():
+    """(spec, genesis, schedule, tick_slot): a small mixed gossip
+    schedule — valid singles, one bad signature, one duplicate — the
+    async-parity harness shape from tests/test_pipeline_async.py."""
+    spec = get_spec("altair", "minimal")
+    genesis = create_genesis_state(spec, default_balances(spec))
+    state = genesis.copy()
+    spec.process_slots(state, uint64(spec.SLOTS_PER_EPOCH + 2))
+    def singles(slot, count):
+        committee = spec.get_beacon_committee(
+            state, uint64(slot), uint64(0))
+        return [get_valid_attestation(
+            spec, state, slot=uint64(slot), index=0,
+            filter_participant_set=lambda s, v=v: {v}, signed=True)
+            for v in list(committee)[:count]]
+
+    atts = singles(int(state.slot) - 1, 3)
+    bad = singles(int(state.slot) - 2, 1)[0]
+    bad.signature = atts[0].signature       # decodable, wrong
+    schedule = ([("attestation", a) for a in atts]
+                + [("attestation", bad), ("attestation", atts[0])])
+    return spec, genesis, schedule, int(state.slot)
+
+
+def _run_gossip(spec, genesis, schedule, tick_slot):
+    from consensus_specs_tpu.gossip import (
+        AdmissionPipeline, GossipConfig, ManualClock, store_fingerprint)
+    from consensus_specs_tpu.test_infra.fork_choice import (
+        get_genesis_forkchoice_store)
+    store = get_genesis_forkchoice_store(spec, genesis)
+    spec.on_tick(store, store.genesis_time
+                 + tick_slot * int(spec.config.SECONDS_PER_SLOT))
+    clock = ManualClock()
+    pipe = AdmissionPipeline(spec, store, GossipConfig(), clock)
+    for i, (topic, payload) in enumerate(schedule):
+        pipe.submit(topic, payload, peer=f"p{i % 2}")
+        if (i + 1) % 2 == 0:
+            clock.advance(0.06)
+            pipe.poll()
+    pipe.drain()
+    statuses = [(r.seq, r.topic, r.status) for r in pipe.verdicts()]
+    return statuses, store_fingerprint(spec, store)
+
+
+def test_fold_gossip_parity_clean(gossip_ingestion):
+    spec, genesis, schedule, tick_slot = gossip_ingestion
+    fold.FOLD_MODE = "on"
+    cache.clear()
+    on = _run_gossip(spec, genesis, schedule, tick_slot)
+    assert METRICS.count("fold_dispatches") >= 1
+    fold.FOLD_MODE = "off"
+    cache.clear()
+    METRICS.reset()
+    off = _run_gossip(spec, genesis, schedule, tick_slot)
+    assert METRICS.count("fold_dispatches") == 0
+    assert on == off
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kind", ["raise", "timeout", "corrupt"])
+@pytest.mark.parametrize("site", [
+    "bls.pairing_check", "ops.g1_aggregate", "ops.msm",
+    "ops.pairing_fold", "gossip.batch_verify",
+])
+def test_fold_fault_matrix_parity(gossip_ingestion, site, kind):
+    """The chaos matrix over the folded flush's sites (including the
+    new seam): folded verdicts + store fingerprint byte-identical to
+    the clean UNFOLDED run, whatever fires."""
+    spec, genesis, schedule, tick_slot = gossip_ingestion
+    fold.FOLD_MODE = "off"
+    cache.clear()
+    clean = _run_gossip(spec, genesis, schedule, tick_slot)
+    fold.FOLD_MODE = "on"
+    cache.clear()
+    METRICS.reset()
+    INCIDENTS.clear()
+    # speclint: disable=seam-dynamic-site -- parametrized over the
+    # folded flush's registered site list above
+    plan = FaultPlan([FaultSpec(site, kind, persistent=True,
+                                sleep_s=0.15)], seed=13)
+    resilience.enable(max_retries=0, breaker_threshold=1, probe_after=99,
+                      deadline_s=0.05 if kind == "timeout" else None,
+                      guard_sample_rate=1.0, guard_seed=13)
+    try:
+        with faults.inject(plan):
+            folded = _run_gossip(spec, genesis, schedule, tick_slot)
+    finally:
+        resilience.disable()
+    assert folded == clean
+    assert INCIDENTS.count(event="injected") == plan.total_fires()

@@ -458,6 +458,7 @@ def test_fused_sweep_matches_per_level_path(monkeypatch):
 def test_fused_rounds_kernel_parity_vs_hashlib():
     import hashlib
     from consensus_specs_tpu.ops import sha256 as S
+    S.reset_literal_pool()
     lits = [bytes([i]) * 32 for i in range(6)]
     r0 = ([0, 2, 4], [1, 3, 5])
     r1 = ([6], [7])     # global idx 6,7 = round-0 outputs 0,1
@@ -466,3 +467,51 @@ def test_fused_rounds_kernel_parity_vs_hashlib():
     e0 = h(lits[0], lits[1]) + h(lits[2], lits[3]) + h(lits[4], lits[5])
     assert out[0] == e0
     assert out[1] == h(e0[:32], e0[32:64])
+    # the device literal pool: a second run of the same DAG uploads
+    # nothing (every literal — and the previous run's outputs — is
+    # resident), byte-identical results
+    stats: dict = {}
+    again = S.fused_rounds(b"".join(lits), [r0, r1], stats=stats)
+    assert again == out
+    assert stats == {"uploaded": 0, "skipped": 6}
+    S.reset_literal_pool()
+
+
+def test_fused_sweep_sibling_pool_skips_clean_reuploads():
+    """ROADMAP async follow-up (c): between consecutive fused sweeps
+    the clean-sibling level buffers stay device-resident, so a re-root
+    uploads ONLY the dirty literals — pool hits counted in
+    `merkle_sibling_uploads_skipped` (the sibling counter next to
+    `merkle_device_round_trips`), roots byte-identical throughout."""
+    from consensus_specs_tpu.ops import sha256 as S
+    from consensus_specs_tpu.ssz import merkle
+    incremental.enable()
+    merkle.use_tpu_hashing(threshold=1)
+    S.reset_literal_pool()
+    try:
+        view = _small_container()
+        incremental.track(view)
+        root = bytes(view.hash_tree_root())     # cache-build sweep
+        assert root == incremental.oracle_root(view)
+        build_uploads = METRICS.count("merkle_sibling_uploads")
+        assert build_uploads > 0
+        view.a[3] = uint64(424242)
+        root = bytes(view.hash_tree_root())     # incremental re-root
+        assert root == incremental.oracle_root(view)
+        second_uploads = METRICS.count(
+            "merkle_sibling_uploads") - build_uploads
+        # only the dirty leaf literal is fresh; every clean sibling
+        # (incl. the previous sweep's parents) hit the device pool
+        assert METRICS.count("merkle_sibling_uploads_skipped") > 0
+        assert second_uploads < build_uploads
+        assert second_uploads <= 2      # dirty chunk (+ length mix-in)
+        # and again: an identical-shape diff re-uses the same residency
+        skipped_before = METRICS.count("merkle_sibling_uploads_skipped")
+        view.a[3] = uint64(424243)
+        root = bytes(view.hash_tree_root())
+        assert root == incremental.oracle_root(view)
+        assert METRICS.count(
+            "merkle_sibling_uploads_skipped") > skipped_before
+    finally:
+        S.reset_literal_pool()
+        merkle.set_bulk_level_hasher(None)

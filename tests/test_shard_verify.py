@@ -221,9 +221,16 @@ def _host_hash_roots(roots):
 def test_fused_flush_sharded_end_to_end(monkeypatch):
     """A fused flush on the tpu backend with the >1-device mesh: the
     pairing product rides ONE ops.pairing_product dispatch, each sweep
-    one mesh-sharded dispatch, verdicts equal the native host-oracle
-    flush, zero host point adds on the device path."""
+    one mesh-sharded dispatch, the folded G2 signature MSM one
+    mesh-sharded `ops.pairing_fold` dispatch, verdicts equal the native
+    host-oracle flush, zero host point adds on the device path, and the
+    flush pays N+1 Miller legs (the folded invariant on the mesh)."""
+    from consensus_specs_tpu.sigpipe import fold
     monkeypatch.setattr(scheduler, "_hash_roots", _host_hash_roots)
+    # the one-launch path is gated on the fused pairing mode; this
+    # suite runs the staged kernels, so the folded flush crosses the
+    # staged chain (sweeps + G2 fold + sharded product) — pin that
+    assert not fold.one_launch_live()
     sets = _flush_sets(8)       # 8 segments / 16 pairs: covers the mesh
     bls.use_tpu()
     try:
@@ -238,9 +245,90 @@ def test_fused_flush_sharded_end_to_end(monkeypatch):
     assert snap["sharded_dispatches"]["ops.pairing_product"] == 1
     assert snap["sharded_dispatches"]["ops.g1_aggregate"] == 1
     assert snap["sharded_dispatches"]["ops.msm"] == 1
+    assert snap["sharded_dispatches"]["ops.pairing_fold"] == 1
     assert snap["g1_aggregate_dispatches"] == 1
     assert snap["msm_dispatches"] == 1
+    assert snap["fold_dispatches"] == 1
+    assert snap["miller_loops_per_flush"]["total"] == 9     # N+1
     assert snap.get("host_point_adds", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# the folded flush: sharded G2 MSM + the one-launch program
+# ---------------------------------------------------------------------------
+
+def _fold_workload(n=2):
+    """(aggs, coeffs, roots, sigs) — n real single-key sets as oracle
+    Points, the shape `shard_verify.pairing_fold` consumes."""
+    from consensus_specs_tpu.crypto.bls12_381 import (
+        _load_pubkey, _load_signature)
+    aggs, coeffs, roots, sigs = [], [], [], []
+    for i in range(n):
+        msg = i.to_bytes(8, "little") + b"\x2a" * 24
+        sig = bls.Sign(privkeys[i], msg)
+        aggs.append(_load_pubkey(bytes(pubkeys[i])))
+        coeffs.append(5 + 3 * i)
+        roots.append(msg)
+        sigs.append(_load_signature(bytes(sig)))
+    return aggs, coeffs, roots, sigs
+
+
+def _host_folded_product(aggs, coeffs, roots, sigs) -> bool:
+    from consensus_specs_tpu.crypto import bls12_381 as native
+    from consensus_specs_tpu.crypto.hash_to_curve import hash_to_g2
+    S = cv.g2_infinity()
+    for s, c in zip(sigs, coeffs):
+        S = S + s * c
+    pairs = [(a * c, hash_to_g2(r))
+             for a, c, r in zip(aggs, coeffs, roots)]
+    pairs.append((-cv.g1_generator(), S))
+    return native.pairing_check(pairs)
+
+
+def test_sharded_g2_fold_msm_matches_host_sum():
+    """The staged fold's G2 MSM (64-bit ladder axis mesh-sharded via
+    the ops.pairing_fold label) equals the host ladder sum at widths 8
+    and 1 — including a zero coefficient and an identity point."""
+    sigs = [cv.g2_generator() * (3 + i) for i in range(7)]
+    sigs.append(cv.g2_infinity())
+    coeffs = [0, 1, (1 << 64) - 1] + [0xBEEF01 * (i + 1) for i in range(5)]
+    expect = cv.g2_infinity()
+    for s, c in zip(sigs, coeffs):
+        expect = expect + s * c
+    sharded = ops_msm.g2_multi_exp(sigs, coeffs, label="ops.pairing_fold")
+    assert METRICS.count_labeled(
+        "sharded_dispatches", "ops.pairing_fold") == 1
+    shard_verify.configure(max_devices=1)
+    single = ops_msm.g2_multi_exp(sigs, coeffs, label="ops.pairing_fold")
+    assert sharded == single == expect
+
+
+def test_pairing_fold_one_launch_matches_host_oracle():
+    """The whole-flush fold program (per-shard: weighting ladder +
+    cofactor ladder + local G2 MSM + partial Miller product incl. the
+    e(-g1, S_d) leg) decides exactly the host folded product — valid
+    flush True, one wrong signature False — at mesh widths 8 and 1."""
+    aggs, coeffs, roots, sigs = _fold_workload(2)
+    bad_sigs = [sigs[0], sigs[0]]
+    assert _host_folded_product(aggs, coeffs, roots, sigs) is True
+    for width in (None, 1):
+        shard_verify.configure(width)
+        assert shard_verify.pairing_fold(
+            aggs, coeffs, roots, sigs) is True
+        assert shard_verify.pairing_fold(
+            aggs, coeffs, roots, bad_sigs) is False
+    shard_verify.configure(None)
+
+
+def test_poisoned_shard_fails_the_folded_product_safe():
+    """A garbage shard partial can only FAIL the folded product (the
+    fail-safe direction): bisection then re-derives on the host ladder,
+    so poison can never validate a set."""
+    aggs, coeffs, roots, sigs = _fold_workload(2)
+    with shard_verify.poison_shard(2):
+        assert shard_verify.pairing_fold(
+            aggs, coeffs, roots, sigs) is False
+    assert shard_verify.pairing_fold(aggs, coeffs, roots, sigs) is True
 
 
 def test_shard_dead_at_pairing_seam_trips_breaker_verdicts_unchanged(

@@ -875,10 +875,17 @@ def test_identity_corrupting_device_sweep_is_caught_by_guard(monkeypatch):
     """The one corruption bisection cannot see — an all-identity sweep
     makes the product trivially pass — is exactly what the differential
     guard exists for: with the guard armed, the mismatch quarantines the
-    backend and every verdict is recomputed on the scalar oracle."""
+    backend and every verdict is recomputed on the scalar oracle.
+    Pinned on the UNFOLDED path (FOLD_VERIFY=0): with folding on the
+    signature legs ride the G2 fold, so an all-identity G1 sweep FAILS
+    the product instead of vacuously passing — the folded flavor of
+    this corruption (both sweeps identity, `fold_mismatch` label) is
+    tests/test_fold.py's case."""
+    from consensus_specs_tpu.sigpipe import fold
     sets = _committee_sets(3, committee=2, bad_indices={2}, tag=5)
     cache.clear()
     METRICS.reset()
+    monkeypatch.setattr(fold, "FOLD_MODE", "off")
     monkeypatch.setattr(
         ops_msm, "g1_weighted_sweep",
         lambda points, scalars: [cv.g1_infinity()] * len(points))

@@ -283,6 +283,38 @@ def test_hostsync_barrier_functions_are_exempt():
     assert repo_findings == []
 
 
+def test_foldgate_flags_direct_pairing_product_call(tmp_path):
+    """A caller reaching pairing_product without going through the seam
+    registry's fold-aware entry (sigpipe.scheduler / the
+    ops.pairing_fold seam) re-introduces an unfolded 2N-leg product —
+    the foldgate pass flags it; a reasoned disable suppresses."""
+    findings = lint_snippet(tmp_path, """\
+        from consensus_specs_tpu.parallel import shard_verify
+
+        def sneaky(pairs):
+            return shard_verify.pairing_product(pairs)
+    """)
+    assert rules_of(findings) == ["fold-unaware-pairing"]
+    assert findings[0].line == 4
+    findings = lint_snippet(tmp_path, """\
+        from consensus_specs_tpu.parallel import shard_verify
+
+        def blessed(pairs):
+            # speclint: disable=fold-unaware-pairing -- fixture reason
+            return shard_verify.pairing_product(pairs)
+    """)
+    assert findings == []
+
+
+def test_foldgate_allows_the_registry_blessed_modules():
+    """The live repo's pairing_product callers all sit inside the
+    fold-aware modules (scheduler's router + the owning wrapper
+    layers): zero findings on the tree."""
+    repo_findings = [f for f in run_speclint(REPO_ROOT)
+                     if f.rule == "fold-unaware-pairing"]
+    assert repo_findings == []
+
+
 # ---------------------------------------------------------------------------
 # concurrency passes: lock discipline, lock order, thread escape
 # ---------------------------------------------------------------------------
@@ -609,7 +641,7 @@ def test_pass_filter_and_names():
     names = pass_names()
     assert names == ("seams", "bypass", "determinism", "globals",
                      "txnpurity", "hostsync", "lock-discipline",
-                     "lock-order", "thread-escape")
+                     "lock-order", "thread-escape", "foldgate")
     # a filtered run executes only the named pass
     findings = run_speclint(REPO_ROOT, passes=["lock-order"])
     assert findings == []
