@@ -16,7 +16,8 @@ RUNNERS := shuffling ssz_static operations epoch_processing sanity bls \
 	kill-drill scenario-chaos pipeline-chaos shard-verify soak lint \
 	speclint native pyspec bench \
 	gossip-bench txn-bench msm-bench merkle-bench scenario-bench \
-	multichip-bench pipeline-bench fold-bench gen_all detect_errors \
+	multichip-bench pipeline-bench fold-bench factory-bench \
+	factory-drill gen_all detect_errors \
 	$(addprefix gen_,$(RUNNERS))
 
 # syntax/bytecode check over every package and script (the CI lint job)
@@ -116,10 +117,22 @@ soak:
 # (mid-mutate / mid-apply / mid-journal-write / mid-fsync), restart in
 # a fresh process, recover from disk, and assert store-root convergence
 # with the never-crashed oracle; plus a rotation+compaction soak.
-# KILL_DRILL_ARGS=--quick runs one kill per family.
+# KILL_DRILL_ARGS=--quick runs one kill per family.  The factory's
+# quick drill rides along: the same SIGKILL discipline over the vector
+# factory's barrier families (scripts/factory_drill.py).
 kill-drill:
 	env JAX_PLATFORMS=cpu $(PYTHON) scripts/kill_drill.py \
 		$(KILL_DRILL_ARGS)
+	env JAX_PLATFORMS=cpu $(PYTHON) scripts/factory_drill.py --quick
+
+# the factory SIGKILL drill alone, full matrix (two kills per barrier
+# family): spawn a real generation shard over a factory journal,
+# SIGKILL it at each factory barrier (mid-journal-write / mid-fsync /
+# mid-publish / pre-manifest-replace), restart in a fresh process,
+# resume, and assert the recovered manifest + artifact set + vector
+# tree are byte-identical to the never-crashed oracle run.
+factory-drill:
+	env JAX_PLATFORMS=cpu $(PYTHON) scripts/factory_drill.py
 
 # async flush engine slow tier under the runtime lock sanitizer: the
 # full overlapped-flush fault matrix with every named lock traced, so
@@ -221,6 +234,13 @@ multichip-bench:
 # FOLD_r01.json.  BENCH_FOLD_SETS=16 BENCH_FOLD_MESH=0 give a smoke run
 fold-bench:
 	env JAX_PLATFORMS=cpu $(PYTHON) bench.py fold
+
+# vector factory throughput (factory/): engines-on vs engines-off
+# generation of real transition-shaped cases, byte-identity asserted,
+# plus the resume-overhead leg; emits FACTORY_r01.json.
+# BENCH_FACTORY_CASES=3 gives a smoke run
+factory-bench:
+	env JAX_PLATFORMS=cpu $(PYTHON) bench.py factory
 
 # static pattern rule: GNU make refuses to run implicit pattern rules
 # for .PHONY targets

@@ -2044,6 +2044,155 @@ def bench_pipeline():
 # the tier that ranks first for the stdout line must actually get
 # budget under the driver's default 540s (merkle+epoch+transition alone
 # would exhaust it); the remaining tiers fill whatever budget is left
+# ---------------------------------------------------------------------------
+# tier: vector factory (factory/) — durable engine-accelerated generation
+# ---------------------------------------------------------------------------
+
+FACTORY_CASES = int(os.environ.get("BENCH_FACTORY_CASES", "6"))
+FACTORY_JSON = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "FACTORY_r01.json")
+
+
+def bench_factory():
+    """Factory generation throughput, engines on vs off, on a
+    transition-shaped workload: FACTORY_CASES signed full blocks
+    (proposer + randao + per-committee attestations, altair minimal)
+    generated as real vector cases through `factory.VectorFactory` —
+    once with engines="scalar" (the inline oracle `run_generator`
+    would use) and once with engines="device" (sigpipe fused flushes,
+    N+1 folded Miller legs over `ops.pairing_fold`, incremental merkle
+    sweep).  Asserts the two trees are byte-identical (the factory's
+    core contract), then times the resume path (re-open + journal scan
+    + skip all cases) for the resume-overhead number.  Emits
+    FACTORY_r01.json."""
+    import hashlib
+    import shutil
+    import tempfile
+
+    from consensus_specs_tpu.factory import VectorFactory
+    from consensus_specs_tpu.gen.typing import TestCase, TestProvider
+    from consensus_specs_tpu.sigpipe import METRICS as SIG_METRICS
+    from consensus_specs_tpu.specs import get_spec
+    from consensus_specs_tpu.ssz import uint64
+    from consensus_specs_tpu.test_infra.attestations import (
+        state_transition_with_full_block)
+    from consensus_specs_tpu.test_infra.genesis import (
+        create_genesis_state, default_balances)
+
+    t_start = time.perf_counter()
+
+    def mark(msg):
+        log(f"[bench] factory +{time.perf_counter() - t_start:5.1f}s: "
+            f"{msg}")
+
+    spec = get_spec("altair", "minimal")
+    mark("building minimal genesis + signed full-block chain ...")
+    genesis = create_genesis_state(spec, default_balances(spec))
+    state = genesis.copy()
+    spec.process_slots(state, uint64(spec.SLOTS_PER_EPOCH + 1))
+    chain = []      # (pre_state, signed_block), the case payloads
+    for _ in range(FACTORY_CASES):
+        pre = state.copy()
+        signed = state_transition_with_full_block(spec, state, True, False)
+        chain.append((pre, signed))
+    mark(f"{len(chain)} signed blocks "
+         f"({sum(len(b.message.body.attestations) for _, b in chain)} "
+         f"attestations total)")
+
+    def providers():
+        def make_cases():
+            for idx, (pre, signed) in enumerate(chain):
+                def case_fn(pre=pre, signed=signed):
+                    post = pre.copy()
+                    yield "pre", "ssz", pre.encode_bytes()
+                    spec.state_transition(post, signed,
+                                          validate_result=True)
+                    yield "blocks_0", "ssz", signed.encode_bytes()
+                    yield "post", "ssz", post.encode_bytes()
+                yield TestCase("altair", "minimal", "bench", "full_block",
+                               "bench_tests", f"case_{idx}", case_fn)
+        return {"bench": [TestProvider(prepare=lambda: None,
+                                       make_cases=make_cases)]}
+
+    def tree_digest(work_dir):
+        h = hashlib.sha256()
+        tree = os.path.join(work_dir, "tree")
+        for base, dirs, files in sorted(os.walk(tree)):
+            dirs.sort()
+            for name in sorted(files):
+                if name.startswith(("factory_diagnostics",
+                                    "testgen_error_log")):
+                    continue
+                path = os.path.join(base, name)
+                h.update(os.path.relpath(path, tree).encode())
+                with open(path, "rb") as fh:
+                    h.update(fh.read())
+        return h.hexdigest()
+
+    def leg(engines, work_dir):
+        SIG_METRICS.reset()
+        factory = VectorFactory(work_dir, ["bench"], engines=engines,
+                                durable=False)
+        t0 = time.perf_counter()
+        diag = factory.run(providers_by_runner=providers())
+        seconds = time.perf_counter() - t0
+        assert diag["generated"] == len(chain) and not diag["failed"], \
+            f"{engines} leg: {diag}"
+        mark(f"engines={engines}: {diag['generated']} cases in "
+             f"{seconds:.1f}s")
+        return {"seconds": round(seconds, 3),
+                "cases_per_s": round(len(chain) / seconds, 3),
+                "engine": diag["engine"]}
+
+    scalar_dir = tempfile.mkdtemp(prefix="bench-factory-scalar-")
+    device_dir = tempfile.mkdtemp(prefix="bench-factory-device-")
+    try:
+        scalar = leg("scalar", scalar_dir)
+        device = leg("device", device_dir)
+        assert device["engine"]["dispatches"] > 0, \
+            "device leg never dispatched an engine seam"
+        identical = tree_digest(scalar_dir) == tree_digest(device_dir)
+        assert identical, "engines changed the emitted vectors"
+
+        # resume overhead: re-open the device work dir, scan the
+        # journal, skip everything — the restart cost of durability
+        t0 = time.perf_counter()
+        resumed = VectorFactory(device_dir, ["bench"], engines="device",
+                                durable=False).run(
+            providers_by_runner=providers())
+        resume_s = time.perf_counter() - t0
+        assert resumed["generated"] == 0 and \
+            resumed["resumed"] == len(chain), f"resume leg: {resumed}"
+        mark(f"resume: {len(chain)} cases skipped in {resume_s:.2f}s")
+    finally:
+        shutil.rmtree(scalar_dir, ignore_errors=True)
+        shutil.rmtree(device_dir, ignore_errors=True)
+
+    speedup = round(scalar["seconds"] / device["seconds"], 2)
+    report = {
+        "cases": len(chain),
+        "scalar": scalar,
+        "device": device,
+        "speedup": speedup,
+        "trees_identical": identical,
+        "resume": {"seconds": round(resume_s, 3),
+                   "per_case_ms": round(1000 * resume_s / len(chain), 2),
+                   "fraction_of_generate":
+                       round(resume_s / device["seconds"], 4)},
+        "ok": True,
+    }
+    with open(FACTORY_JSON, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+    log("[bench] factory: " + json.dumps(report, sort_keys=True))
+    return {
+        "metric": "factory_cases_per_sec",
+        "value": device["cases_per_s"],
+        "unit": (f"vector cases/s ({len(chain)} full-block cases, "
+                 f"device engines; scalar {scalar['cases_per_s']}/s)"),
+        "vs_baseline": speedup,
+    }
+
+
 TIERS = {
     "merkle": (bench_merkle, 150),
     # incremental merkleization (ssz/incremental.py): pure host-side
@@ -2089,6 +2238,10 @@ TIERS = {
     # verdict parity with bisection, and the folded G2 MSM on the
     # forced-host mesh — the parity leg's host pairings dominate
     "fold": (bench_fold, 420),
+    # vector factory (factory/): engines-on vs engines-off generation of
+    # real transition-shaped cases + resume overhead; genesis build and
+    # block signing dominate the setup, both timed legs are host-path
+    "factory": (bench_factory, 420),
 }
 
 # the driver's ~540s window fits merkle + ONE heavy tier — without
@@ -2096,7 +2249,8 @@ TIERS = {
 # driver-verified number (VERDICT r4 weakness #8)
 _ROTATING = ["north_star", "attestations", "block_sigs", "kzg", "epoch",
              "transition", "degraded", "gossip", "txn", "msm",
-             "merkle_inc", "scenario", "multichip", "pipeline", "fold"]
+             "merkle_inc", "scenario", "multichip", "pipeline", "fold",
+             "factory"]
 
 
 def _round_index() -> int:

@@ -70,6 +70,9 @@ RULES: dict[str, str] = {
     "fold-unaware-pairing":
         "a pairing_product call bypasses the fold-aware entry "
         "(sigpipe.scheduler / the ops.pairing_fold seam)",
+    "factory-scalar-bypass":
+        "factory code imports crypto.* or calls a scalar BLS/KZG oracle "
+        "verb instead of riding the registered engine seams",
     "speclint-bad-disable":
         "a speclint disable comment lacks a reason or names an unknown rule",
 }
@@ -255,8 +258,8 @@ def _pass_table() -> dict:
     """Ordered name -> runner table (the CLI's --pass / --list-passes
     vocabulary).  Import is deferred so `from .core import Finding`
     inside the pass modules does not cycle."""
-    from . import (bypass, concurrency, determinism, foldgate, globals_,
-                   hostsync, seams, txnpurity)
+    from . import (bypass, concurrency, determinism, factoryseam,
+                   foldgate, globals_, hostsync, seams, txnpurity)
     return {
         "seams": seams.run,
         "bypass": bypass.run,
@@ -268,6 +271,7 @@ def _pass_table() -> dict:
         "lock-order": concurrency.run_lock_order,
         "thread-escape": concurrency.run_thread_escape,
         "foldgate": foldgate.run,
+        "factoryseam": factoryseam.run,
     }
 
 

@@ -1,0 +1,101 @@
+"""Pass 11 — factory scalar-bypass gate.
+
+The vector factory's whole bargain (factory/engine.py) is that
+generation-time BLS / KZG / merkle work rides the registered seams —
+the sigpipe fused flush, the ``ops.pairing_fold`` fold, the incremental
+merkle sweep — with the scalar oracle reachable only as a seam's
+counted fallback.  Factory code that imports the scalar `crypto.*`
+suite directly, or calls a scalar oracle verb by name, silently moves
+generation work off the engines: the bench's device-vs-scalar split
+stops describing the service, and the seam registry's
+breaker/fallback/counting contract no longer covers the call.
+
+This pass flags, inside ``consensus_specs_tpu.factory`` modules only:
+
+* any import of ``consensus_specs_tpu.crypto.*`` (absolute or
+  relative) — the scalar suite is the engines' fallback, not a factory
+  dependency;
+* any call whose terminal name is a scalar oracle verb
+  (``Verify`` / ``FastAggregateVerify`` / ``pairing_check`` /
+  ``hash_to_g2`` / the KZG verify verbs / ...).
+
+Case fns whose *vector content* is a scalar oracle result (the `bls`
+runner's own Verify cases) live in `gen/` and `spec_tests/`, outside
+this scope — the factory invokes them through `gen.runner._write_case`,
+which is the point.  A deliberate exception inside the factory carries
+``# speclint: disable=factory-scalar-bypass -- <reason>``.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Context, Finding
+
+_SCOPE = ("consensus_specs_tpu.factory",)
+_CRYPTO = "consensus_specs_tpu.crypto"
+
+# terminal call names that ARE the scalar oracle surface
+_SCALAR_CALLS = frozenset({
+    "Verify", "AggregateVerify", "FastAggregateVerify", "Sign",
+    "KeyValidate", "Aggregate", "AggregatePKs", "pairing_check",
+    "multi_exp", "hash_to_g2", "verify_kzg_proof",
+    "verify_blob_kzg_proof", "verify_blob_kzg_proof_batch",
+    "verify_kzg_proof_batch", "compute_kzg_proof",
+})
+
+
+def _resolved_import(sf, node) -> str:
+    """The dotted module an Import/ImportFrom reaches (best effort for
+    relative imports; '' when unresolvable)."""
+    if isinstance(node, ast.Import):
+        return ""               # handled per-alias by the caller
+    base = sf.module.split(".") if sf.module else []
+    if node.level:
+        if len(base) < node.level:
+            return node.module or ""
+        base = base[:len(base) - node.level]
+    else:
+        base = []
+    return ".".join(base + ([node.module] if node.module else []))
+
+
+def run(ctx: Context) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in ctx.files:
+        if not sf.in_module(*_SCOPE):
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == _CRYPTO or \
+                            alias.name.startswith(_CRYPTO + "."):
+                        findings.append(_import_finding(sf, node))
+            elif isinstance(node, ast.ImportFrom):
+                mod = _resolved_import(sf, node)
+                if mod == _CRYPTO or mod.startswith(_CRYPTO + "."):
+                    findings.append(_import_finding(sf, node))
+            elif isinstance(node, ast.Call):
+                func = node.func
+                name = func.attr if isinstance(func, ast.Attribute) \
+                    else (func.id if isinstance(func, ast.Name) else None)
+                if name in _SCALAR_CALLS:
+                    findings.append(Finding(
+                        "factory-scalar-bypass", sf.rel, node.lineno,
+                        node.col_offset,
+                        f"factory code calls the scalar oracle verb "
+                        f"{name}() directly — generation work moves off "
+                        f"the registered engines uncounted",
+                        hint="route through the sigpipe / ops seams "
+                             "(factory/engine.py arms them) or carry a "
+                             "reasoned disable"))
+    return findings
+
+
+def _import_finding(sf, node) -> Finding:
+    return Finding(
+        "factory-scalar-bypass", sf.rel, node.lineno, node.col_offset,
+        "factory code imports the scalar crypto suite directly — the "
+        "scalar path is a seam's counted fallback, not a factory "
+        "dependency",
+        hint="generate through gen.runner case fns with the engines "
+             "armed (factory/engine.py), or carry a reasoned disable")

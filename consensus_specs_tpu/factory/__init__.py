@@ -1,0 +1,52 @@
+"""Vector factory: a durable, device-accelerated conformance-vector
+generation service (the production shape of the `gen/` runner layer).
+
+The seed pipeline (`scripts/gen_vectors.py`, `gen/runner.py`) already
+generates the reference's vector tree resumably — but entirely on the
+scalar path, with no resilience seams and no crash story beyond the
+INCOMPLETE tag.  This package wraps that layer into a long-lived
+generation service built from the engines PRs 11-15 grew:
+
+* engine.py    — generation-time BLS / merkle routed through the fused
+                 + folded verify engines (`sigpipe` fused flushes over
+                 the `ops.pairing_fold` seam, the incremental merkle
+                 sweep) behind the registered-seam discipline; the
+                 scalar oracle stays the counted byte-identical
+                 fallback, so engines on vs off never changes a vector.
+* journal.py   — per-case generation progress as a durable CRC-framed
+                 intent/done journal (the PR 13 `DurableJournal`
+                 discipline: marker-durability-before-success, torn-tail
+                 repair, segment rotation), so a shard survives real
+                 process death (SIGKILL) and resumes to the identical
+                 output set.
+* artifacts.py — content-addressed, CRC-framed case artifacts plus a
+                 manifest, so shard unions are verifiable byte-for-byte
+                 before they are shipped.
+* service.py   — the orchestrator: shard via the one round-robin
+                 contract (`gen.mesh_shard.shard_providers`), journal
+                 every case, publish every artifact, flush the manifest.
+
+Byte-identity contract: the artifact union a factory run publishes is
+byte-identical to the serial scalar `run_generator` tree — engines
+change only dispatch counts, resume only skips work already proven
+durable.  `scripts/factory_drill.py` (`make factory-drill`) SIGKILLs a
+real shard at every registered barrier family and asserts exactly that;
+`make factory-bench` (bench.py `factory` tier) reports cases/s, device
+vs scalar speedup, and resume overhead.  See docs/factory.md.
+"""
+from .artifacts import (
+    ArtifactStore, Manifest, ManifestConflict, digest_of, materialize,
+    pack_case_dir, pack_files, unpack,
+)
+from .engine import engine_scope
+from .journal import (
+    DIGEST_SKIP, FSYNC_ALWAYS, FSYNC_MARKER, FSYNC_NEVER, FactoryJournal,
+)
+from .service import VectorFactory, merge_shards
+
+__all__ = [
+    "ArtifactStore", "DIGEST_SKIP", "FSYNC_ALWAYS", "FSYNC_MARKER",
+    "FSYNC_NEVER", "FactoryJournal", "Manifest", "ManifestConflict",
+    "VectorFactory", "digest_of", "engine_scope", "materialize",
+    "merge_shards", "pack_case_dir", "pack_files", "unpack",
+]

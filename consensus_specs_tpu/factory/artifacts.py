@@ -1,0 +1,270 @@
+"""Content-addressed, CRC-framed vector artifacts and the manifest
+that makes shard unions verifiable.
+
+A generated case dir (meta.yaml + *.yaml + *.ssz_snappy, the
+`gen.runner` layout) is packed into ONE artifact blob:
+
+    blob:   MAGIC | u32 file_count | entry*
+    entry:  u32 name_len | name | u32 data_len | u32 crc32c(data) | data
+
+Entries are sorted by name, so the blob — and therefore its content
+address, sha256(blob) — is a deterministic function of the case's
+bytes.  Unpacking re-checks every CRC (and the store re-checks the
+sha256 on read), so a bit-rotted artifact can never silently
+materialize into a vector tree.
+
+`ArtifactStore` lays blobs out as ``objects/<aa>/<digest>.art`` and
+publishes atomically: staged tmp write + fsync, the ``factory.publish``
+barrier (the kill window between staging and visibility), one
+``os.replace``, directory fsync.  Content addressing makes concurrent
+publishes of the same case by different processes trivially safe — both
+write identical bytes.
+
+`Manifest` maps case path -> {digest, bytes}: the verifiable statement
+of which cases a shard produced.  ``Manifest.merge`` unions shard
+manifests and refuses conflicting digests for the same case path — the
+check that makes a sharded run's union trustworthy without re-running
+anything.  Saving goes through the same staged-replace discipline
+behind the ``factory.manifest`` barrier.  The manifest is derivable
+from journal + store at any time, so a crash between manifest flushes
+loses nothing (scripts/factory_drill.py proves it with SIGKILL).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import struct
+
+from ..resilience import sites
+from ..resilience.faults import fire
+from ..sigpipe.metrics import METRICS
+from ..txn.codec import CodecError, crc32c
+
+PUBLISH_SITE = sites.site("factory.publish").name
+MANIFEST_SITE = sites.site("factory.manifest").name
+
+ART_MAGIC = b"CSTPART1"
+MANIFEST_SCHEMA = 1
+_U32 = struct.Struct("<I")
+
+
+class ManifestConflict(RuntimeError):
+    """Two shards claim the same case path with different digests."""
+
+
+# ---------------------------------------------------------------------------
+# the blob format
+# ---------------------------------------------------------------------------
+
+def pack_files(files: dict) -> bytes:
+    """name -> bytes, framed + CRC'd, sorted for determinism."""
+    out = [ART_MAGIC, _U32.pack(len(files))]
+    for name in sorted(files):
+        encoded = name.encode()
+        data = files[name]
+        out.append(_U32.pack(len(encoded)) + encoded)
+        out.append(_U32.pack(len(data)) + _U32.pack(crc32c(data)))
+        out.append(data)
+    return b"".join(out)
+
+
+def pack_case_dir(case_dir: str) -> bytes:
+    """Pack one generated case dir (flat, the gen.runner layout)."""
+    files = {}
+    for name in sorted(os.listdir(case_dir)):
+        path = os.path.join(case_dir, name)
+        if os.path.isfile(path):
+            with open(path, "rb") as fh:
+                files[name] = fh.read()
+    return pack_files(files)
+
+
+def unpack(blob: bytes) -> dict:
+    """blob -> {name: bytes}; CodecError on bad magic, frame, or CRC."""
+    if not blob.startswith(ART_MAGIC):
+        raise CodecError("artifact blob has a bad magic")
+    off = len(ART_MAGIC)
+    if off + _U32.size > len(blob):
+        raise CodecError("artifact blob truncated at file count")
+    count = _U32.unpack_from(blob, off)[0]
+    off += _U32.size
+    files = {}
+    for _ in range(count):
+        if off + _U32.size > len(blob):
+            raise CodecError("artifact entry truncated at name")
+        name_len = _U32.unpack_from(blob, off)[0]
+        off += _U32.size
+        name = blob[off:off + name_len]
+        if len(name) != name_len:
+            raise CodecError("artifact entry name truncated")
+        off += name_len
+        if off + 2 * _U32.size > len(blob):
+            raise CodecError("artifact entry truncated at data frame")
+        data_len = _U32.unpack_from(blob, off)[0]
+        crc = _U32.unpack_from(blob, off + _U32.size)[0]
+        off += 2 * _U32.size
+        data = blob[off:off + data_len]
+        if len(data) != data_len:
+            raise CodecError("artifact entry data truncated")
+        if crc32c(data) != crc:
+            raise CodecError(
+                f"artifact entry {name.decode()!r} failed its CRC")
+        off += data_len
+        files[name.decode()] = data
+    if off != len(blob):
+        raise CodecError("artifact blob has trailing garbage")
+    return files
+
+
+def digest_of(blob: bytes) -> bytes:
+    """The content address: sha256 over the framed blob."""
+    return hashlib.sha256(blob).digest()
+
+
+# ---------------------------------------------------------------------------
+# the store
+# ---------------------------------------------------------------------------
+
+class ArtifactStore:
+    """Content-addressed artifact store with atomic, durable publish."""
+
+    def __init__(self, root: str, *, durable: bool = True):
+        self.root = os.path.abspath(root)
+        self.durable = durable      # False: no fsync (benches/tests)
+        os.makedirs(os.path.join(self.root, "objects"), exist_ok=True)
+
+    def path_for(self, digest: bytes) -> str:
+        hexd = digest.hex()
+        return os.path.join(self.root, "objects", hexd[:2],
+                            f"{hexd}.art")
+
+    def has(self, digest: bytes) -> bool:
+        return os.path.exists(self.path_for(digest))
+
+    def put(self, blob: bytes) -> bytes:
+        """Publish a blob; returns its content address.  Idempotent —
+        an existing object is identical bytes by construction."""
+        digest = digest_of(blob)
+        path = self.path_for(digest)
+        if os.path.exists(path):
+            return digest
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(blob)
+            fh.flush()
+            if self.durable:
+                os.fsync(fh.fileno())
+        # the publish kill window: the artifact is staged and durable
+        # but not yet visible at its content address
+        fire(PUBLISH_SITE)
+        os.replace(tmp, path)
+        self._fsync_dir(os.path.dirname(path))
+        METRICS.inc("factory_artifacts_published")
+        return digest
+
+    def get(self, digest: bytes) -> bytes:
+        """Read a blob, re-checking its content address."""
+        with open(self.path_for(digest), "rb") as fh:
+            blob = fh.read()
+        if digest_of(blob) != digest:
+            raise CodecError(
+                f"artifact {digest.hex()[:16]}… fails its content "
+                f"address")
+        return blob
+
+    def verify(self, digest: bytes) -> bool:
+        try:
+            self.get(digest)
+        except (OSError, CodecError):
+            return False
+        return True
+
+    def _fsync_dir(self, path: str) -> None:
+        if not self.durable:
+            return
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+
+# ---------------------------------------------------------------------------
+# the manifest
+# ---------------------------------------------------------------------------
+
+class Manifest:
+    """case path -> {"digest": hex, "bytes": n}; the verifiable
+    statement of a shard's output set."""
+
+    def __init__(self, cases: dict | None = None):
+        self.cases = dict(cases or {})
+
+    def add(self, case_path: str, digest: bytes, nbytes: int) -> None:
+        self.cases[case_path] = {"digest": digest.hex(),
+                                 "bytes": int(nbytes)}
+
+    def digest(self, case_path: str) -> bytes:
+        return bytes.fromhex(self.cases[case_path]["digest"])
+
+    def to_json(self) -> dict:
+        return {"schema": MANIFEST_SCHEMA,
+                "cases": {k: self.cases[k] for k in sorted(self.cases)}}
+
+    def save(self, path: str, *, durable: bool = True) -> None:
+        """Staged-replace save (never a torn manifest), behind the
+        ``factory.manifest`` barrier."""
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(self.to_json(), fh, indent=1, sort_keys=True)
+            fh.flush()
+            if durable:
+                os.fsync(fh.fileno())
+        fire(MANIFEST_SITE)
+        os.replace(tmp, path)
+        METRICS.inc("factory_manifest_flushes")
+
+    @classmethod
+    def load(cls, path: str) -> "Manifest":
+        with open(path) as fh:
+            doc = json.load(fh)
+        if doc.get("schema") != MANIFEST_SCHEMA:
+            raise CodecError(
+                f"manifest {path}: unknown schema {doc.get('schema')!r}")
+        return cls(doc.get("cases", {}))
+
+    @classmethod
+    def merge(cls, manifests) -> "Manifest":
+        """Union of shard manifests; a case path claimed twice must
+        carry the same digest — the shard-union verification."""
+        merged = cls()
+        for m in manifests:
+            for path, entry in m.cases.items():
+                prev = merged.cases.get(path)
+                if prev is not None and prev["digest"] != entry["digest"]:
+                    raise ManifestConflict(
+                        f"case {path!r}: digest {prev['digest'][:16]}… "
+                        f"vs {entry['digest'][:16]}…")
+                merged.cases[path] = dict(entry)
+        return merged
+
+    def missing_from(self, store: ArtifactStore) -> list:
+        """Case paths whose artifact is absent or fails verification."""
+        return sorted(path for path, entry in self.cases.items()
+                      if not store.verify(bytes.fromhex(entry["digest"])))
+
+
+def materialize(store: ArtifactStore, manifest: Manifest,
+                out_dir: str) -> int:
+    """Unpack every manifest case into a vector tree byte-identical to
+    the tree the generating run wrote.  Returns the case count."""
+    for case_path in sorted(manifest.cases):
+        blob = store.get(manifest.digest(case_path))
+        case_dir = os.path.join(out_dir, case_path)
+        os.makedirs(case_dir, exist_ok=True)
+        for name, data in unpack(blob).items():
+            with open(os.path.join(case_dir, name), "wb") as fh:
+                fh.write(data)
+    return len(manifest.cases)

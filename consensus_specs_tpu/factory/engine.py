@@ -1,0 +1,93 @@
+"""The factory's device-engine scope: generation-time BLS / merkle
+routed through the fused + folded verify engines, scalar as the
+counted byte-identical fallback.
+
+``engine_scope("device")`` arms, for the duration of a generation run:
+
+* the sigpipe fused flush (`sigpipe.enable(mode="fused")`): every
+  state-transition-shaped case fn (sanity / finality / random /
+  transition runners, pending-deposit epoch scopes) batches its block's
+  signature sets into ONE folded flush — N+1 Miller legs over the
+  ``ops.pairing_fold`` seam instead of 2N scalar legs — with verdicts
+  consumed at the inline spec call sites.  A set the collector failed
+  to predict simply misses the verdict map and falls back to the scalar
+  oracle (counted in `scalar_fallbacks`), so engines on vs off can
+  never change an emitted vector, only the dispatch counts.
+* the incremental merkle sweep (`ssz.incremental.enable`): tracked
+  views re-root dirty cones through the ``ssz.merkle_sweep`` seam;
+  untracked views keep the legacy path.
+* optionally the tpu BLS backend: ``FACTORY_BACKEND=tpu`` switches
+  `utils.bls` onto the device kernels for the scope (real-accelerator
+  sessions only — on CPU hosts the limb kernels would compile for
+  minutes, and the engines above already ride the host-oracle split).
+
+Scalar-path assertions *inside* case fns (the `bls` runner's own
+Verify/Sign oracle checks — they ARE the vector content) stay scalar by
+design; the seam discipline is enforced statically by speclint's
+`factory-scalar-bypass` pass (docs/analysis.md).
+
+The scope restores every engine to its prior state on exit and fills
+its report dict with the metric deltas the bench and diagnostics
+publish: seam hits/misses, dispatches, fold dispatches, scalar
+fallbacks.
+"""
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+
+from ..sigpipe.metrics import METRICS
+
+ENGINES = ("device", "scalar")
+
+# the counters whose per-run delta the engine report carries
+_COUNTERS = ("seam_hits", "seam_misses", "dispatches",
+             "fold_dispatches", "fused_batch_failures")
+
+
+def _counter_state() -> dict:
+    state = {name: METRICS.count(name) for name in _COUNTERS}
+    state["scalar_fallbacks"] = METRICS.count_labeled("scalar_fallbacks")
+    return state
+
+
+@contextmanager
+def engine_scope(engines: str = "device"):
+    """Arm the generation engines; yields the report dict (filled with
+    metric deltas at exit)."""
+    if engines not in ENGINES:
+        raise ValueError(f"unknown engine mode {engines!r}; "
+                         f"one of {ENGINES}")
+    report = {"engines": engines}
+    if engines == "scalar":
+        yield report
+        return
+
+    from .. import sigpipe
+    from ..ssz import incremental
+    from ..utils import bls
+
+    base = _counter_state()
+    prev_enabled, prev_mode = sigpipe.enabled(), sigpipe.mode()
+    prev_incremental = incremental.enabled()
+    prev_backend = bls.current_backend()
+    backend = os.environ.get("FACTORY_BACKEND", "")
+    sigpipe.enable(mode="fused")
+    if not prev_incremental:
+        incremental.enable()
+    if backend and backend != prev_backend:
+        bls.use_backend(backend)
+    try:
+        yield report
+    finally:
+        if backend and backend != prev_backend:
+            bls.use_backend(prev_backend)
+        if not prev_incremental:
+            incremental.disable()
+        if not prev_enabled:
+            sigpipe.disable()
+        elif prev_mode != "fused":
+            sigpipe.enable(mode=prev_mode)
+        now = _counter_state()
+        for name, start in base.items():
+            report[name] = now[name] - start

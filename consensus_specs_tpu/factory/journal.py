@@ -1,0 +1,330 @@
+"""Durable generation-progress journal: per-case intents and done
+markers, crash-safe across real process death.
+
+The factory applies the PR 13 `DurableJournal` discipline (txn/
+durable.py) to generation progress instead of store mutations, with a
+purpose-built record grammar — decoding a txn journal needs a spec,
+while generation progress is spec-free strings and digests:
+
+    segment file:  MAGIC | record*            seg-00000001.log, ...
+    record:        u32 len | u32 crc32c(payload) | payload
+    payload:       'I' u64 seq | u32 path_len | case_path    (intent)
+                   'D' u64 seq | 32B artifact digest         (done)
+
+The **marker rule**, generation-shaped: a ``'D'`` marker means the
+case's content-addressed artifact is durable in the store (publish
+happens strictly before ``mark_done``), so resume skips it; an
+unmarked intent means the case must be regenerated — its tree dir, if
+any, is exactly a crashed `gen.runner` case dir with the INCOMPLETE
+tag's semantics (atomic-or-absent).  A ``'D'`` whose digest is
+:data:`DIGEST_SKIP` (32 zero bytes) records a `SkippedTest` — decided
+deterministically, so resume need not re-run it; no artifact exists.
+
+**Fsync discipline** mirrors the txn journal: the done marker is the
+skip decision, so marker durability is the correctness floor —
+
+    always       fsync after every record
+    marker_only  fsync when a done marker is written (and at rotation):
+                 an intent that reaches disk late is at worst an
+                 unmarked intent, but a marker that is not durable
+                 could let a resumed shard trust an artifact that a
+                 power loss then loses with it
+    never        no fsync (tests/benches; OS page cache only)
+
+Every record write consults the ``factory.journal`` barrier (the
+mid-journal-write kill point) and every fsync the
+``factory.journal.fsync`` barrier (written-but-not-yet-durable
+window); `scripts/factory_drill.py` SIGKILLs a real shard at both.
+
+**Torn tails.**  On open, segments are scanned in order; a truncated or
+CRC-failing record ends the valid log — it is a shard that died
+mid-journal-write, i.e. an unmarked intent.  The file is truncated back
+to the last whole record, later segments are dropped, and the repair is
+incident-logged as ``factory.journal`` / ``torn_tail``.
+
+**Single-writer discipline.**  One shard process owns one journal
+directory (the `--shard I/N` contract already makes case sets
+disjoint), so unlike the txn journal there is no lock: the factory's
+concurrency unit is the process, enforced by directory ownership.
+"""
+from __future__ import annotations
+
+import os
+import re
+import struct
+
+from ..resilience import sites
+from ..resilience.faults import fire
+from ..resilience.incidents import INCIDENTS
+from ..sigpipe.metrics import METRICS
+from ..txn.codec import CodecError, crc32c
+
+JOURNAL_SITE = sites.site("factory.journal").name
+FSYNC_SITE = sites.site("factory.journal.fsync").name
+
+FSYNC_ALWAYS = "always"
+FSYNC_MARKER = "marker_only"
+FSYNC_NEVER = "never"
+FSYNC_POLICIES = (FSYNC_ALWAYS, FSYNC_MARKER, FSYNC_NEVER)
+
+SEG_MAGIC = b"CSTPFAC1"
+_SEG_RE = re.compile(r"seg-(\d{8})\.log")
+_FRAME = struct.Struct("<II")           # payload length, crc32c(payload)
+_U32 = struct.Struct("<I")
+_SEQ = struct.Struct("<Q")
+
+_INTENT, _DONE = b"I", b"D"
+
+# a done marker carrying this digest records a deterministic SkippedTest:
+# no artifact exists, but resume must not re-run the case either
+DIGEST_SKIP = bytes(32)
+
+
+class FactoryJournal:
+    """Append-only file-backed progress journal with segment rotation
+    and torn-tail repair; see the module docstring for the format."""
+
+    def __init__(self, path: str, *, segment_bytes: int = 1 << 20,
+                 fsync_policy: str = FSYNC_MARKER):
+        if fsync_policy not in FSYNC_POLICIES:
+            raise ValueError(f"unknown fsync policy {fsync_policy!r}; "
+                             f"one of {FSYNC_POLICIES}")
+        self.dir = os.path.abspath(path)
+        self.segment_bytes = max(1, int(segment_bytes))
+        self.fsync_policy = fsync_policy
+        self._seg_fh = None
+        self._seg_index = 1
+        self._seg_written = 0
+        self._dirty = False                 # bytes written, not fsynced
+        self._seq = 0
+        self._path_by_seq: dict = {}        # seq -> case path (intents)
+        self._done_by_path: dict = {}       # case path -> artifact digest
+        self._records = 0
+        os.makedirs(self.dir, exist_ok=True)
+        self._scan()
+
+    # -- the write side -------------------------------------------------
+    def append_intent(self, case_path: str) -> int:
+        """Record that generation of `case_path` is about to start.
+        Returns the sequence number :meth:`mark_done` takes."""
+        self._seq += 1
+        seq = self._seq
+        encoded = case_path.encode()
+        self._write_record(_INTENT + _SEQ.pack(seq)
+                           + _U32.pack(len(encoded)) + encoded)
+        self._path_by_seq[seq] = case_path
+        if self.fsync_policy == FSYNC_ALWAYS:
+            self._fsync()
+        return seq
+
+    def mark_done(self, seq: int, digest: bytes) -> None:
+        """Record that intent `seq`'s artifact is durable in the store
+        (or, with :data:`DIGEST_SKIP`, that the case deterministically
+        skips).  Returns only after the marker record is fsynced — the
+        marker is the resume decision."""
+        if len(digest) != 32:
+            raise ValueError("artifact digest must be 32 bytes")
+        path = self._path_by_seq.get(seq)
+        if path is None:
+            raise KeyError(f"mark_done for unknown intent seq {seq}")
+        self._write_record(_DONE + _SEQ.pack(seq) + digest)
+        if self.fsync_policy != FSYNC_NEVER and self._dirty:
+            self._fsync()
+        self._done_by_path[path] = digest
+
+    def close(self) -> None:
+        if self._seg_fh is not None:
+            if self.fsync_policy != FSYNC_NEVER and self._dirty:
+                self._fsync()
+            self._seg_fh.close()
+            self._seg_fh = None
+
+    # -- the read side --------------------------------------------------
+    def done(self) -> dict:
+        """case path -> artifact digest for every marked case (the
+        marker rule: marked means the artifact is durable)."""
+        return dict(self._done_by_path)
+
+    def pending(self) -> tuple:
+        """Case paths with an intent but no marker — exactly the cases
+        a resumed shard must regenerate."""
+        marked = set(self._done_by_path)
+        out = []
+        for seq in sorted(self._path_by_seq):
+            path = self._path_by_seq[seq]
+            if path not in marked and path not in out:
+                out.append(path)
+        return tuple(out)
+
+    def records(self) -> int:
+        return self._records
+
+    def segment_indices(self) -> list:
+        return sorted(
+            int(m.group(1)) for m in
+            (_SEG_RE.fullmatch(n) for n in os.listdir(self.dir))
+            if m is not None)
+
+    def disk_bytes(self) -> int:
+        total = 0
+        for name in os.listdir(self.dir):
+            try:
+                total += os.path.getsize(os.path.join(self.dir, name))
+            except OSError:                 # pragma: no cover
+                pass
+        return total
+
+    # -- segment I/O ----------------------------------------------------
+    def _seg_path(self, index: int) -> str:
+        return os.path.join(self.dir, f"seg-{index:08d}.log")
+
+    def _ensure_segment(self):
+        if self._seg_fh is None:
+            path = self._seg_path(self._seg_index)
+            fresh = not os.path.exists(path) or \
+                os.path.getsize(path) == 0
+            self._seg_fh = open(path, "ab")
+            if fresh:
+                self._seg_fh.write(SEG_MAGIC)
+                self._seg_fh.flush()
+                self._seg_written = len(SEG_MAGIC)
+                self._dirty = True
+                self._fsync_dir()       # the new dirent must be durable
+        return self._seg_fh
+
+    def _write_record(self, payload: bytes) -> None:
+        fh = self._ensure_segment()
+        # the mid-journal-write kill point: the intent (or marker) is
+        # decided but its bytes are not yet in the page cache
+        fire(JOURNAL_SITE)
+        fh.write(_FRAME.pack(len(payload), crc32c(payload)))
+        fh.write(payload)
+        fh.flush()
+        self._dirty = True
+        self._records += 1
+        self._seg_written += _FRAME.size + len(payload)
+        METRICS.inc("factory_journal_records")
+        if self._seg_written >= self.segment_bytes:
+            self._rotate()
+
+    def _rotate(self) -> None:
+        if self.fsync_policy != FSYNC_NEVER and self._dirty:
+            self._fsync()
+        self._seg_fh.close()
+        self._seg_fh = None
+        self._seg_index += 1
+        self._seg_written = 0
+        METRICS.inc("factory_journal_rotations")
+
+    def _fsync(self) -> None:
+        if self._seg_fh is None:
+            return
+        # written-but-not-yet-durable window: a crash here is the power
+        # loss the marker-only policy reasons about
+        fire(FSYNC_SITE)
+        os.fsync(self._seg_fh.fileno())
+        self._dirty = False
+        METRICS.inc("factory_journal_fsyncs")
+
+    def _fsync_dir(self) -> None:
+        """fsync the journal DIRECTORY: fsync(file) does not make the
+        dirent durable on POSIX."""
+        if self.fsync_policy == FSYNC_NEVER:
+            return
+        fd = os.open(self.dir, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    # -- open: scan + torn-tail repair ----------------------------------
+    def _scan(self) -> None:
+        segments = sorted(
+            (int(m.group(1)), os.path.join(self.dir, m.group(0)))
+            for m in (_SEG_RE.fullmatch(n) for n in os.listdir(self.dir))
+            if m is not None)
+        torn_at = None                      # (index, path, valid_end)
+        for index, path in segments:
+            valid_end, torn = self._scan_segment(path)
+            if torn:
+                torn_at = (index, path, valid_end)
+                break
+        if torn_at is not None:
+            self._repair(segments, *torn_at)
+            segments = [(i, p) for i, p in segments if i <= torn_at[0]]
+        # resume appends: reuse the last segment while it has room,
+        # else start at the next index
+        if segments:
+            last_index, last_path = segments[-1]
+            size = os.path.getsize(last_path) \
+                if os.path.exists(last_path) else 0
+            if size < self.segment_bytes and os.path.exists(last_path):
+                self._seg_index = last_index
+                self._seg_written = size
+            else:
+                self._seg_index = last_index + 1
+
+    def _scan_segment(self, path: str):
+        """Parse one segment; returns (valid_end, torn)."""
+        with open(path, "rb") as fh:
+            data = fh.read()
+        if len(data) == 0:
+            return 0, False                 # created, never written
+        if not data.startswith(SEG_MAGIC):
+            return 0, True                  # torn mid-header
+        off = len(SEG_MAGIC)
+        while off < len(data):
+            if off + _FRAME.size > len(data):
+                return off, True
+            length, crc = _FRAME.unpack_from(data, off)
+            start = off + _FRAME.size
+            payload = data[start:start + length]
+            if len(payload) != length or crc32c(payload) != crc:
+                return off, True
+            try:
+                self._parse_record(payload)
+            except (CodecError, struct.error, UnicodeDecodeError):
+                return off, True            # frame ok, body garbage
+            off = start + length
+        return off, False
+
+    def _parse_record(self, payload: bytes) -> None:
+        tag, body = payload[:1], payload[1:]
+        seq = _SEQ.unpack_from(body)[0]
+        body = body[_SEQ.size:]
+        if tag == _INTENT:
+            path_len = _U32.unpack_from(body)[0]
+            encoded = body[_U32.size:_U32.size + path_len]
+            if len(encoded) != path_len:
+                raise CodecError("intent record body truncated")
+            self._path_by_seq[seq] = encoded.decode()
+        elif tag == _DONE:
+            if len(body) != 32:
+                raise CodecError("done record body truncated")
+            path = self._path_by_seq.get(seq)
+            if path is not None:
+                self._done_by_path[path] = body
+            # a marker without its intent cannot happen in sequence
+            # order; tolerate it (pre-torn-tail bookkeeping)
+        else:
+            raise CodecError(f"unknown record tag {tag!r}")
+        self._seq = max(self._seq, seq)
+        self._records += 1
+
+    def _repair(self, segments, index, path, valid_end) -> None:
+        """Truncate the torn record and drop every later segment: a torn
+        record is an unmarked intent, and nothing after an unreadable
+        record can be trusted to be in sequence."""
+        with open(path, "r+b") as fh:
+            fh.truncate(valid_end)
+        dropped = 0
+        for i, p in segments:
+            if i > index:
+                try:
+                    os.unlink(p)
+                except OSError:             # pragma: no cover
+                    pass
+                dropped += 1
+        METRICS.inc("factory_journal_torn_tails")
+        INCIDENTS.record("factory.journal", "torn_tail", segment=index,
+                         offset=valid_end, dropped_segments=dropped)

@@ -16,6 +16,8 @@ oracle.
 """
 from __future__ import annotations
 
+import hashlib
+
 import numpy as np
 import jax.numpy as jnp
 
@@ -23,6 +25,7 @@ from ..crypto import curve as cv
 from ..crypto import hash_to_curve as h2c
 from ..crypto.bls12_381 import _load_pubkey, _load_signature
 from ..crypto.curve import DecodeError, Point
+from ..sigpipe.metrics import METRICS
 from . import curve_jax as cj
 from . import fq
 from . import fq_tower as ft
@@ -167,8 +170,43 @@ def verify_batch(pubkeys, messages, signatures):
     return results
 
 
+def _fold_coefficients(prepared):
+    """64-bit nonzero Fiat-Shamir coefficients for a
+    FastAggregateVerifyBatch fold, bound to a length-framed transcript
+    of the whole batch (slot, compressed aggregate, message, compressed
+    signature — so no two distinct batches share a transcript).  Same
+    derivation discipline as the fused scheduler's `_coefficients`."""
+    h = hashlib.sha256()
+    h.update(len(prepared).to_bytes(4, "little"))
+    for i, agg, msg, sig in prepared:
+        h.update(i.to_bytes(4, "little"))
+        h.update(cv.g1_to_bytes(agg))
+        h.update(len(msg).to_bytes(4, "little"))
+        h.update(msg)
+        h.update(cv.g2_to_bytes(sig))
+    seed = h.digest()
+    out = []
+    for i in range(len(prepared)):
+        x = int.from_bytes(
+            hashlib.sha256(seed + i.to_bytes(4, "little")).digest()[:8],
+            "little")
+        out.append(1 + x % (2**64 - 1))
+    return out
+
+
 def fast_aggregate_verify_batch(pubkey_lists, messages, signatures):
-    """Batch of FastAggregateVerify jobs (shared message per job)."""
+    """Batch of FastAggregateVerify jobs (shared message per job).
+
+    With folding live (sigpipe/fold.py; ``FOLD_VERIFY=0`` restores the
+    2N shape), the whole batch rides ONE (N+1)-pair job: bilinearity
+    moves a per-job Fiat-Shamir coefficient onto each side —
+
+        prod_i e(c_i*agg_i, h_i) * e(-g1, S),   S = sum_i c_i * sig_i
+
+    — with S folded through the ``ops.pairing_fold`` seam (one batched
+    G2 MSM dispatch, host ladder as counted fallback).  A passing
+    product proves every job valid; a failing one degrades to the exact
+    per-job 2-leg derivation so per-job attribution is unchanged."""
     prepared = []   # (slot, agg, msg, sig)
     results = [False] * len(pubkey_lists)
     neg_g1 = -cv.g1_generator()
@@ -187,8 +225,23 @@ def fast_aggregate_verify_batch(pubkey_lists, messages, signatures):
     if not prepared:
         return results
     hashes = hash_to_g2_batch([p[2] for p in prepared])
+    from ..sigpipe import fold
+    if fold.live() and len(prepared) > 1:
+        coeffs = _fold_coefficients(prepared)
+        S = fold.fold_signatures([sig for (_, _, _, sig) in prepared],
+                                 coeffs)
+        folded = [(agg * c, h) for (_, agg, _, _), c, h
+                  in zip(prepared, coeffs, hashes)]
+        folded.append((neg_g1, S))
+        METRICS.observe("miller_loops_per_batch", len(folded))
+        if bool(_run_pairing_checks([folded])[0]):
+            for (i, *_) in prepared:
+                results[i] = True
+            return results
+        # >=1 job is invalid: exact per-job legs for attribution
     jobs = [[(agg, h), (neg_g1, sig)]
             for (_, agg, _, sig), h in zip(prepared, hashes)]
+    METRICS.observe("miller_loops_per_batch", 2 * len(jobs))
     for (i, *_), v in zip(prepared, _run_pairing_checks(jobs)):
         results[i] = bool(v)
     return results

@@ -186,6 +186,30 @@ REGISTRY: tuple[Site, ...] = (
     Site("ops.sha256.subtree", "consensus_specs_tpu.ssz.merkle",
          kind=DISPATCH, chaos=UNIT,
          note="install-gated subtree hasher; tests/test_sha256_jax.py"),
+    # -- vector-factory barrier kill points: the generation service's
+    #    durable progress journal and content-addressed artifact store
+    #    (factory/).  UNIT tier — the chaos replay tier drives txn
+    #    stores, not generation shards; coverage is the process-boundary
+    #    SIGKILL drill (scripts/factory_drill.py, `make factory-drill`)
+    #    plus the in-process crash suite.  Family order here is the
+    #    drill's matrix order.
+    Site("factory.journal", "consensus_specs_tpu.factory.journal",
+         kind=BARRIER, chaos=UNIT, corrupt="none",
+         note="mid-journal-record-write kill point; "
+              "scripts/factory_drill.py + tests/test_factory.py"),
+    Site("factory.journal.fsync", "consensus_specs_tpu.factory.journal",
+         kind=BARRIER, chaos=UNIT, corrupt="none",
+         note="the factory journal's written-but-not-yet-durable "
+              "window; scripts/factory_drill.py + tests/test_factory.py"),
+    Site("factory.publish", "consensus_specs_tpu.factory.artifacts",
+         kind=BARRIER, chaos=UNIT, corrupt="none",
+         note="between an artifact's staged tmp write and its atomic "
+              "rename into the content-addressed store; "
+              "scripts/factory_drill.py + tests/test_factory.py"),
+    Site("factory.manifest", "consensus_specs_tpu.factory.artifacts",
+         kind=BARRIER, chaos=UNIT, corrupt="none",
+         note="before the manifest's atomic replace; "
+              "scripts/factory_drill.py + tests/test_factory.py"),
 )
 
 # speclint: disable=global-mutable-state -- name index over the frozen

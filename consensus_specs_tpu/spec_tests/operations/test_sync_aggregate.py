@@ -1,6 +1,8 @@
 """process_sync_aggregate operation tests (altair+; reference:
 test/altair/block_processing/sync_aggregate/*; vector format
 tests/formats/operations)."""
+import pytest
+
 from ...gen.vector_test import SkippedTest
 from ...test_infra.context import (
     spec_state_test, with_all_phases_from, with_presets,
@@ -205,6 +207,7 @@ def test_invalid_signature_infinite_signature_with_single_participant(
                                              valid=False)
 
 
+@pytest.mark.slow  # wrong-committee signing under always_bls (~10 s each); the cheaper invalid-signature rows keep the quick signal
 @with_all_phases_from("altair")
 @with_pytest_fork_subset(SYNC_FORKS)
 @spec_state_test
@@ -362,6 +365,7 @@ def _advance_periods(spec, state, n: int) -> None:
                   uint64(target_epoch * int(spec.SLOTS_PER_EPOCH)))
 
 
+@pytest.mark.slow  # wrong-committee signing under always_bls (~10 s each); the cheaper invalid-signature rows keep the quick signal
 @with_all_phases_from("altair")
 @with_pytest_fork_subset(SYNC_FORKS)
 @with_presets(["minimal"], reason="period fast-forward too slow on mainnet")
@@ -393,6 +397,7 @@ def test_invalid_signature_next_committee(spec, state):
                                              valid=False)
 
 
+@pytest.mark.slow  # wrong-committee signing under always_bls (~10 s each); the cheaper invalid-signature rows keep the quick signal
 @with_all_phases_from("altair")
 @with_pytest_fork_subset(SYNC_FORKS)
 @with_presets(["minimal"], reason="period fast-forward too slow on mainnet")

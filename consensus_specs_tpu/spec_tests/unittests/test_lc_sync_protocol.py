@@ -2,6 +2,8 @@
 test/altair/unittests/light_client/test_sync_protocol.py, 4 defs):
 process_light_client_update store-state assertions around timeouts,
 period boundaries, and finality advances."""
+import pytest
+
 from ...ssz import hash_tree_root, uint64
 from ...test_infra.context import (
     spec_state_test, no_vectors, with_all_phases_from, with_presets,
@@ -160,6 +162,7 @@ def test_process_light_client_update_timeout(spec, state):
     assert int(store.current_max_active_participants) > 0
 
 
+@pytest.mark.slow  # three signed attested epochs under always_bls (~3 min)
 @with_all_phases_from("altair")
 @with_pytest_fork_subset(LC_FORKS)
 @with_presets(["minimal"], reason="too slow")

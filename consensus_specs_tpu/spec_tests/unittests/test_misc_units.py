@@ -9,6 +9,8 @@
 """
 import random
 
+import pytest
+
 from ...ssz import hash_tree_root, uint64
 from ...test_infra.context import (
     spec_state_test, no_vectors, with_all_phases, with_all_phases_from,
@@ -145,6 +147,7 @@ def _sample_sidecars(spec, state, rng):
                                   proofs_1 + proofs_2)
 
 
+@pytest.mark.slow  # full-body merkle proof build (~10 s each)
 @with_all_phases_from("deneb", to="electra")
 @spec_state_test
 @no_vectors
@@ -155,6 +158,7 @@ def test_blob_sidecar_inclusion_proof_correct(spec, state):
         assert spec.verify_blob_sidecar_inclusion_proof(sidecar)
 
 
+@pytest.mark.slow  # full-body merkle proof build (~10 s each)
 @with_all_phases_from("deneb", to="electra")
 @spec_state_test
 @no_vectors
@@ -167,6 +171,7 @@ def test_blob_sidecar_inclusion_proof_incorrect_wrong_body(spec, state):
         assert not spec.verify_blob_sidecar_inclusion_proof(sidecar)
 
 
+@pytest.mark.slow  # full-body merkle proof build (~10 s each)
 @with_all_phases_from("deneb", to="electra")
 @spec_state_test
 @no_vectors

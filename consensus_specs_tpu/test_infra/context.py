@@ -30,6 +30,14 @@ from ..utils import bls as bls_utils
 # set by tests/conftest.py from CLI flags
 DEFAULT_TEST_PRESET = "minimal"
 DEFAULT_PYTEST_FORKS = None  # None = all mainline forks
+# Quick-tier fork thinning (set by tests/conftest.py): when True, each
+# pytest spec test runs only the ENDPOINTS of its selected fork span —
+# earliest + latest — instead of every fork in between.  The middle
+# forks are the redundant rows of the matrix (the bodies branch on
+# is_post_fork, so the endpoints exercise both sides of every guard);
+# the full matrix still runs under --kernel-tiers (`make test-kernels`)
+# and generator mode (make_vector_cases) never thins.
+QUICK_FORK_SPAN = False
 
 MAINLINE_FORKS = ["phase0", "altair", "bellatrix", "capella", "deneb",
                   "electra", "fulu"]
@@ -265,6 +273,18 @@ def _run_single(fn, meta, spec, needs_state, collect):
     return []
 
 
+def _span_endpoints(targets):
+    """Keep the earliest and latest fork of each preset's span."""
+    by_preset: dict = {}
+    for t in targets:
+        by_preset.setdefault(t[1], []).append(t)
+    kept = []
+    for group in by_preset.values():
+        kept.extend(group if len(group) <= 2
+                    else [group[0], group[-1]])
+    return kept
+
+
 def _make_runner(fn, needs_state: bool):
     @functools.wraps(fn)
     def runner():
@@ -273,8 +293,11 @@ def _make_runner(fn, needs_state: bool):
         ran = 0
         # pytest-only narrowing; make_vector_cases ignores this so the
         # generator keeps full fork coverage
-        for _fork, _preset, spec in _selected_targets(
-                meta, forks=meta.get("pytest_forks")):
+        targets = list(_selected_targets(
+            meta, forks=meta.get("pytest_forks")))
+        if QUICK_FORK_SPAN:
+            targets = _span_endpoints(targets)
+        for _fork, _preset, spec in targets:
             try:
                 with _bls_mode(meta, generator_mode=False):
                     _run_single(fn, meta, spec, needs_state,

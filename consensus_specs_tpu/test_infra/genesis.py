@@ -41,9 +41,35 @@ def build_mock_validator(spec, i: int, balance: int):
             max_effective_balance)))
 
 
+# Built genesis states keyed by (spec instance, balances, threshold):
+# building one costs ~2 s (sync-committee pubkey aggregation dominates)
+# while a COW copy costs ~0.5 ms, and the quick tier builds hundreds of
+# identical ones.  Keying on the spec OBJECT (not its name) makes
+# custom-config specs miss instead of aliasing; the FIFO bound keeps
+# those misses from accumulating states forever.
+_STATE_CACHE: dict = {}
+_STATE_CACHE_MAX = 64
+
+
 def create_genesis_state(spec, validator_balances, activation_threshold=None):
     if activation_threshold is None:
         activation_threshold = spec.MAX_EFFECTIVE_BALANCE
+    key = (id(spec), tuple(int(b) for b in validator_balances),
+           int(activation_threshold))
+    cached = _STATE_CACHE.get(key)
+    if cached is not None and cached[0] is spec:
+        return cached[1].copy()
+    state = _build_genesis_state(spec, validator_balances,
+                                 activation_threshold)
+    if len(_STATE_CACHE) >= _STATE_CACHE_MAX:
+        _STATE_CACHE.pop(next(iter(_STATE_CACHE)))
+    # the cached entry keeps a strong ref to `spec`, so the id() in the
+    # key can never be recycled onto a different live spec
+    _STATE_CACHE[key] = (spec, state.copy())
+    return state
+
+
+def _build_genesis_state(spec, validator_balances, activation_threshold):
     deposit_root = b"\x42" * 32
     eth1_block_hash = b"\xda" * 32
     state = spec.BeaconState(

@@ -1,0 +1,231 @@
+#!/usr/bin/env python
+"""Subprocess SIGKILL crash drills for the vector factory.
+
+The in-process factory crash suite (tests/test_factory.py) kills a
+shard with a seeded raise; this drill kills it with the real thing.
+For every factory barrier family — mid-journal-record-write
+(``factory.journal``), mid-fsync (``factory.journal.fsync``),
+between artifact staging and publish (``factory.publish``), and before
+the manifest replace (``factory.manifest``) — the driver:
+
+1. spawns a child that runs a real generation shard (the `shuffling`
+   runner's 0/16 round-robin slice) through `factory.VectorFactory`,
+   with a plan that SIGKILLs the process at the N-th consultation of
+   the target barrier;
+2. spawns a fresh "restarted shard" process that reopens the same work
+   dir (journal torn-tail repair included) and re-runs the identical
+   shard — the resume path — then derives the manifest and hashes the
+   artifact set and materialized tree;
+3. asserts the recovered manifest, artifact set and vector tree are
+   byte-identical to an uninterrupted oracle run computed in the
+   driver process.
+
+Usage:
+    python scripts/factory_drill.py [--quick] [--fsync POLICY]
+    (internal) --child {run,recover} --dir D --site S --nth N
+"""
+import argparse
+import hashlib
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+KILL_FAMILIES = ("factory.journal", "factory.journal.fsync",
+                 "factory.publish", "factory.manifest")
+
+# the drill workload: a real runner slice, small enough that the whole
+# matrix stays seconds-per-child.  manifest_every=1 makes the
+# factory.manifest barrier fire per case, so every family is reachable
+# at nth=1 within the first few cases.
+RUNNER = "shuffling"
+SHARD = (0, 16)
+
+
+def log(msg: str) -> None:
+    print(f"[factory-drill] {msg}", flush=True)
+
+
+def build_factory(work_dir, fsync, segment_bytes):
+    from consensus_specs_tpu.factory import VectorFactory
+    return VectorFactory(work_dir, [RUNNER], shard=SHARD,
+                         fsync_policy=fsync, segment_bytes=segment_bytes,
+                         manifest_every=1)
+
+
+def output_fingerprint(work_dir) -> dict:
+    """Manifest + artifact-set + materialized-tree digests: the whole
+    observable output of a shard, as one comparable dict."""
+    from consensus_specs_tpu.factory import ArtifactStore, Manifest
+
+    manifest = Manifest.load(os.path.join(work_dir, "manifest.json"))
+    store = ArtifactStore(os.path.join(work_dir, "store"))
+    arts = hashlib.sha256()
+    for case_path in sorted(manifest.cases):
+        digest = manifest.digest(case_path)
+        arts.update(case_path.encode())
+        arts.update(store.get(digest))      # re-checks content address
+    tree = hashlib.sha256()
+    tree_dir = os.path.join(work_dir, "tree")
+    for base, dirs, files in sorted(os.walk(tree_dir)):
+        dirs.sort()
+        for name in sorted(files):
+            if name.startswith(("factory_diagnostics",
+                                "testgen_error_log")):
+                continue
+            path = os.path.join(base, name)
+            tree.update(os.path.relpath(path, tree_dir).encode())
+            with open(path, "rb") as fh:
+                tree.update(fh.read())
+    return {"cases": len(manifest.cases),
+            "manifest": hashlib.sha256(
+                json.dumps(manifest.to_json(),
+                           sort_keys=True).encode()).hexdigest(),
+            "artifacts": arts.hexdigest(),
+            "tree": tree.hexdigest()}
+
+
+# ---------------------------------------------------------------------------
+# children
+# ---------------------------------------------------------------------------
+
+def child_run(args) -> int:
+    from consensus_specs_tpu.resilience import faults
+
+    class KillPlan(faults.FaultPlan):
+        """SIGKILL this process at the nth consultation of one factory
+        barrier — the process-boundary analogue of a seeded raise."""
+
+        def __init__(self, site, nth):
+            super().__init__([], seed=0)
+            self._target = site
+            self._nth = int(nth)
+            self._count = 0
+
+        def decide(self, site):
+            if site == self._target:
+                self._count += 1
+                if self._count >= self._nth:
+                    os.kill(os.getpid(), signal.SIGKILL)
+            return None
+
+    factory = build_factory(args.dir, args.fsync, args.segment_bytes)
+    with faults.inject(KillPlan(args.site, args.nth)):
+        diag = factory.run()
+    # only reached when the kill never fired (nth > total consults)
+    print(json.dumps({"completed": True, "generated": diag["generated"]}))
+    return 0
+
+
+def child_recover(args) -> int:
+    from consensus_specs_tpu.resilience import INCIDENTS
+
+    factory = build_factory(args.dir, args.fsync, args.segment_bytes)
+    diag = factory.run()
+    report = output_fingerprint(args.dir)
+    report.update({
+        "resumed": diag["resumed"], "generated": diag["generated"],
+        "rematerialized": diag["rematerialized"],
+        "torn_tails": INCIDENTS.count(site="factory.journal",
+                                      event="torn_tail"),
+    })
+    print(json.dumps(report))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def spawn(extra, timeout=600):
+    cmd = [sys.executable, os.path.abspath(__file__)] + extra
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(cmd, capture_output=True, text=True,
+                          env=env, timeout=timeout)
+
+
+def oracle_fingerprint(args) -> dict:
+    """The uninterrupted run, in-process: the byte-identity target."""
+    wd = tempfile.mkdtemp(prefix="factory-drill-oracle-")
+    try:
+        build_factory(wd, args.fsync, args.segment_bytes).run()
+        return output_fingerprint(wd)
+    finally:
+        shutil.rmtree(wd, ignore_errors=True)
+
+
+def run_matrix(args) -> bool:
+    expect = oracle_fingerprint(args)
+    log(f"oracle: {expect['cases']} cases, "
+        f"artifacts {expect['artifacts'][:16]}…")
+    nths = (1,) if args.quick else (1, 3)
+    ok = True
+    for site in KILL_FAMILIES:
+        for nth in nths:
+            wd = tempfile.mkdtemp(prefix="factory-drill-")
+            try:
+                base = ["--dir", wd, "--site", site, "--nth", str(nth),
+                        "--fsync", args.fsync,
+                        "--segment-bytes", str(args.segment_bytes)]
+                run = spawn(["--child", "run"] + base)
+                killed = run.returncode == -signal.SIGKILL
+                if not killed and run.returncode != 0:
+                    log(f"FAIL {site} nth={nth}: run child died "
+                        f"rc={run.returncode}\n{run.stderr[-2000:]}")
+                    ok = False
+                    continue
+                rec = spawn(["--child", "recover"] + base)
+                if rec.returncode != 0:
+                    log(f"FAIL {site} nth={nth}: recover child died "
+                        f"rc={rec.returncode}\n{rec.stderr[-2000:]}")
+                    ok = False
+                    continue
+                report = json.loads(rec.stdout.strip().splitlines()[-1])
+                mismatched = [k for k in ("cases", "manifest",
+                                          "artifacts", "tree")
+                              if report[k] != expect[k]]
+                if mismatched:
+                    log(f"FAIL {site} nth={nth}: recovered output "
+                        f"diverges on {mismatched}")
+                    ok = False
+                    continue
+                log(f"ok   {site:<22} nth={nth} "
+                    f"{'SIGKILL' if killed else 'survived'} "
+                    f"resumed={report['resumed']} "
+                    f"regenerated={report['generated']} "
+                    f"torn_tails={report['torn_tails']}")
+            finally:
+                shutil.rmtree(wd, ignore_errors=True)
+    return ok
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--child", choices=("run", "recover"))
+    p.add_argument("--dir")
+    p.add_argument("--site", default="factory.journal")
+    p.add_argument("--nth", type=int, default=1)
+    p.add_argument("--fsync", default="marker_only",
+                   choices=("always", "marker_only", "never"))
+    p.add_argument("--segment-bytes", type=int, default=1 << 16)
+    p.add_argument("--quick", action="store_true",
+                   help="one kill per barrier family instead of two")
+    args = p.parse_args()
+    if args.child == "run":
+        return child_run(args)
+    if args.child == "recover":
+        return child_recover(args)
+    ok = run_matrix(args)
+    log("PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

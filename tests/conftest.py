@@ -139,6 +139,11 @@ def _configure(request):
     context.DEFAULT_PYTEST_FORKS = (
         [request.config.getoption("--fork")]
         if request.config.getoption("--fork") else None)
+    # quick tier: spec batteries run their fork-span endpoints only;
+    # --kernel-tiers (make test-kernels / chaos tiers) restores the
+    # full fork matrix, as does an explicit --fork filter
+    context.QUICK_FORK_SPAN = not request.config.getoption(
+        "--kernel-tiers")
     from consensus_specs_tpu.utils import bls
     if request.config.getoption("--disable-bls"):
         bls.bls_active = False

@@ -1,2 +1,10 @@
-"""pytest collection shim for the dual-mode spec suite."""
+"""pytest collection shim for the dual-mode spec suite.
+
+Slow tier: multi-epoch simulation battery whose quick-tier signal is
+covered by the retained sibling batteries; the full run rides
+--kernel-tiers (`make test-kernels`).
+"""
+import pytest
+
+pytestmark = pytest.mark.slow
 from consensus_specs_tpu.spec_tests.fork_choice.test_on_block_blob_data import *  # noqa: F401,F403

@@ -17,7 +17,12 @@ from consensus_specs_tpu.crypto.hash_to_curve import (
 )
 from consensus_specs_tpu.utils import bls as shim
 
-TRUSTED_SETUP = "/root/reference/presets/mainnet/trusted_setups/trusted_setup_4096.json"
+import os
+
+TRUSTED_SETUP = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "consensus_specs_tpu", "config", "trusted_setups",
+    "trusted_setup_4096.json")
 
 
 def test_generators_on_curve_and_order():

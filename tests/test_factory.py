@@ -1,0 +1,601 @@
+"""Vector factory (consensus_specs_tpu/factory/): the durable,
+engine-accelerated generation service.
+
+Four layers:
+
+* unit tier — the progress journal (intent/done grammar, DIGEST_SKIP,
+  fsync policies, rotation, torn-tail repair) and the content-addressed
+  artifact store + manifest (CRC framing, atomic publish, merge
+  conflicts, materialization).
+* crash tier — a seeded `DeviceFault` raised at each registered factory
+  barrier family mid-run; a reopened factory must recover to an output
+  set byte-identical to the never-crashed oracle.  (The process
+  boundary version — real SIGKILL — is scripts/factory_drill.py,
+  exercised by the slow tier below and `make factory-drill`.)
+* parity tier — for real runners, a factory run with the device engines
+  armed produces a vector tree byte-identical to the serial scalar
+  `run_generator` tree (the core contract: engines change dispatch
+  counts, never bytes).  The cheap four run in tier-1; the `bls` leg
+  (pure-python pairings, ~15 s/case) and the sharded-union merge ride
+  the slow tier.
+* seam tier — the drill's kill matrix really derives from the
+  registered factory barrier sites, and the folded
+  FastAggregateVerifyBatch pin (N+1 pairing legs instead of 2N over a
+  host-oracle recorder, exact fallback attribution, FOLD_VERIFY=0
+  escape hatch).
+"""
+import hashlib
+import json
+import os
+import shutil
+
+import pytest
+
+from consensus_specs_tpu.factory import (
+    DIGEST_SKIP, FSYNC_ALWAYS, FSYNC_NEVER, ArtifactStore, FactoryJournal,
+    Manifest, ManifestConflict, VectorFactory, digest_of, engine_scope,
+    materialize, merge_shards, pack_case_dir, pack_files, unpack,
+)
+from consensus_specs_tpu.gen.typing import TestCase as GenCase
+from consensus_specs_tpu.gen.typing import TestProvider as GenProvider
+from consensus_specs_tpu.gen.vector_test import SkippedTest
+from consensus_specs_tpu.resilience import faults, sites
+from consensus_specs_tpu.txn.codec import CodecError
+
+FACTORY_BARRIERS = ("factory.journal", "factory.journal.fsync",
+                    "factory.publish", "factory.manifest")
+
+
+# ---------------------------------------------------------------------------
+# unit tier: the journal
+# ---------------------------------------------------------------------------
+
+def test_journal_round_trip(tmp_path):
+    j = FactoryJournal(tmp_path / "j")
+    s1 = j.append_intent("a/b/c/case_0")
+    s2 = j.append_intent("a/b/c/case_1")
+    s3 = j.append_intent("a/b/c/case_2")
+    j.mark_done(s1, b"\x11" * 32)
+    j.mark_done(s2, DIGEST_SKIP)
+    j.close()
+
+    j2 = FactoryJournal(tmp_path / "j")
+    assert j2.done() == {"a/b/c/case_0": b"\x11" * 32,
+                         "a/b/c/case_1": DIGEST_SKIP}
+    assert j2.pending() == ("a/b/c/case_2",)
+    # seq numbering continues across reopen
+    s4 = j2.append_intent("a/b/c/case_3")
+    assert s4 > s3
+    j2.close()
+
+
+def test_journal_rejects_bad_marks(tmp_path):
+    j = FactoryJournal(tmp_path / "j")
+    seq = j.append_intent("x")
+    with pytest.raises(ValueError):
+        j.mark_done(seq, b"short")
+    with pytest.raises(KeyError):
+        j.mark_done(seq + 99, b"\x00" * 32)
+    j.close()
+
+
+def test_journal_fsync_policies(tmp_path):
+    from consensus_specs_tpu.sigpipe.metrics import METRICS
+    for policy, floor in ((FSYNC_ALWAYS, 2), (FSYNC_NEVER, 0)):
+        METRICS.reset()
+        j = FactoryJournal(tmp_path / policy, fsync_policy=policy)
+        seq = j.append_intent("x")
+        j.mark_done(seq, b"\x22" * 32)
+        j.close()
+        count = METRICS.count("factory_journal_fsyncs")
+        if floor:
+            assert count >= floor
+        else:
+            assert count == 0
+
+
+def test_journal_torn_tail_repair(tmp_path):
+    j = FactoryJournal(tmp_path / "j")
+    seq = j.append_intent("done_case")
+    j.mark_done(seq, b"\x33" * 32)
+    j.append_intent("torn_case")
+    j.close()
+    seg = tmp_path / "j" / "seg-00000001.log"
+    data = seg.read_bytes()
+    # tear the final record mid-frame: the crashed-mid-write shape
+    seg.write_bytes(data[:-5])
+
+    j2 = FactoryJournal(tmp_path / "j")
+    assert j2.done() == {"done_case": b"\x33" * 32}
+    assert j2.pending() == ()       # the torn intent is GONE, not pending
+    # the repair truncated the file back to whole records
+    assert len(seg.read_bytes()) < len(data)
+    # and appending works on the repaired tail
+    j2.append_intent("fresh")
+    j2.close()
+    j3 = FactoryJournal(tmp_path / "j")
+    assert j3.pending() == ("fresh",)
+    j3.close()
+
+
+def test_journal_torn_tail_drops_later_segments(tmp_path):
+    j = FactoryJournal(tmp_path / "j", segment_bytes=64)
+    for i in range(8):
+        seq = j.append_intent(f"case_{i}")
+        j.mark_done(seq, bytes([i]) * 32)
+    j.close()
+    segs = j.segment_indices()
+    assert len(segs) >= 3, "workload too small for a rotation test"
+    # corrupt a record in the FIRST segment: everything after it is
+    # untrusted by construction
+    first = tmp_path / "j" / "seg-00000001.log"
+    raw = bytearray(first.read_bytes())
+    raw[-3] ^= 0xFF
+    first.write_bytes(bytes(raw))
+
+    j2 = FactoryJournal(tmp_path / "j")
+    assert j2.segment_indices() == [1]
+    assert len(j2.done()) < 8
+    j2.close()
+
+
+def test_journal_rotation_counts(tmp_path):
+    from consensus_specs_tpu.sigpipe.metrics import METRICS
+    METRICS.reset()
+    j = FactoryJournal(tmp_path / "j", segment_bytes=64)
+    for i in range(6):
+        seq = j.append_intent(f"r/{i}")
+        j.mark_done(seq, bytes([i]) * 32)
+    j.close()
+    assert METRICS.count("factory_journal_rotations") >= 2
+    assert len(j.segment_indices()) >= 2
+    assert j.disk_bytes() > 0
+    j2 = FactoryJournal(tmp_path / "j", segment_bytes=64)
+    assert len(j2.done()) == 6
+    j2.close()
+
+
+# ---------------------------------------------------------------------------
+# unit tier: artifacts + manifest
+# ---------------------------------------------------------------------------
+
+def test_pack_unpack_round_trip():
+    files = {"meta.yaml": b"a: 1\n", "post.ssz_snappy": bytes(range(256)),
+             "empty.yaml": b""}
+    blob = pack_files(files)
+    assert unpack(blob) == files
+    # sorted framing => deterministic content address
+    assert digest_of(blob) == digest_of(pack_files(dict(
+        reversed(list(files.items())))))
+
+
+def test_unpack_rejects_corruption():
+    blob = pack_files({"a": b"hello"})
+    with pytest.raises(CodecError):
+        unpack(b"NOTMAGIC" + blob[8:])
+    flipped = bytearray(blob)
+    flipped[-1] ^= 1                        # payload bit flip: CRC catches
+    with pytest.raises(CodecError):
+        unpack(bytes(flipped))
+    with pytest.raises(CodecError):
+        unpack(blob + b"trailing")
+    with pytest.raises(CodecError):
+        unpack(blob[:-2])                   # truncated data
+
+
+def test_store_publish_and_content_address(tmp_path):
+    store = ArtifactStore(tmp_path / "s")
+    blob = pack_files({"x": b"payload"})
+    digest = store.put(blob)
+    assert store.has(digest) and store.verify(digest)
+    assert store.get(digest) == blob
+    assert store.put(blob) == digest        # idempotent
+    # bit-rot on disk can never materialize silently
+    path = store.path_for(digest)
+    raw = bytearray(open(path, "rb").read())
+    raw[-1] ^= 1
+    open(path, "wb").write(bytes(raw))
+    assert not store.verify(digest)
+    with pytest.raises(CodecError):
+        store.get(digest)
+
+
+def test_manifest_save_load_merge(tmp_path):
+    m1, m2 = Manifest(), Manifest()
+    m1.add("p/a", b"\x01" * 32, 10)
+    m1.add("p/b", b"\x02" * 32, 20)
+    m2.add("p/b", b"\x02" * 32, 20)         # same digest: mergeable
+    m2.add("p/c", b"\x03" * 32, 30)
+    path = tmp_path / "manifest.json"
+    m1.save(str(path), durable=False)
+    assert Manifest.load(str(path)).cases == m1.cases
+    merged = Manifest.merge([m1, m2])
+    assert sorted(merged.cases) == ["p/a", "p/b", "p/c"]
+    m2.add("p/a", b"\xFF" * 32, 10)         # conflicting digest
+    with pytest.raises(ManifestConflict):
+        Manifest.merge([m1, m2])
+    bad = {"schema": 999, "cases": {}}
+    path.write_text(json.dumps(bad))
+    with pytest.raises(CodecError):
+        Manifest.load(str(path))
+
+
+def test_materialize_byte_identical(tmp_path):
+    case_dir = tmp_path / "case"
+    case_dir.mkdir()
+    (case_dir / "meta.yaml").write_bytes(b"bls_setting: 1\n")
+    (case_dir / "post.ssz_snappy").write_bytes(os.urandom(64))
+    blob = pack_case_dir(str(case_dir))
+    store = ArtifactStore(tmp_path / "s", durable=False)
+    digest = store.put(blob)
+    manifest = Manifest()
+    manifest.add("pre/fork/r/h/s/case", digest, len(blob))
+    out = tmp_path / "out"
+    assert materialize(store, manifest, str(out)) == 1
+    rebuilt = out / "pre/fork/r/h/s/case"
+    for f in case_dir.iterdir():
+        assert (rebuilt / f.name).read_bytes() == f.read_bytes()
+
+
+# ---------------------------------------------------------------------------
+# service tier: synthetic providers (no spec build, tier-1 cheap)
+# ---------------------------------------------------------------------------
+
+def synthetic_providers(n=6, skip_at=2, fail_at=None):
+    """Deterministic no-spec cases: case i writes one yaml + one ssz
+    part; `skip_at` raises SkippedTest; `fail_at` raises ValueError."""
+    def make_cases():
+        for i in range(n):
+            def case_fn(i=i):
+                if i == skip_at:
+                    raise SkippedTest(f"case {i} inapplicable")
+                if fail_at is not None and i == fail_at:
+                    raise ValueError(f"case {i} broken")
+                yield "index", "meta", i
+                yield "data", "data", {"value": i * 7}
+                yield "obj", "ssz", bytes([i]) * (32 + i)
+            yield GenCase("phase0", "minimal", "synth", "h", "s",
+                          f"case_{i}", case_fn)
+    return {"synth": [GenProvider(prepare=lambda: None,
+                                  make_cases=make_cases)]}
+
+
+def tree_fingerprint(work_dir):
+    h = hashlib.sha256()
+    tree = os.path.join(work_dir, "tree")
+    for base, dirs, files in sorted(os.walk(tree)):
+        dirs.sort()
+        for name in sorted(files):
+            if name.startswith(("factory_diagnostics",
+                                "testgen_error_log")):
+                continue
+            path = os.path.join(base, name)
+            h.update(os.path.relpath(path, tree).encode())
+            h.update(open(path, "rb").read())
+    return h.hexdigest()
+
+
+def run_synthetic(work_dir, durable=False, **kw):
+    """durable=True uses the always-fsync journal so the
+    `factory.journal.fsync` barrier is reachable (the crash suite)."""
+    factory = VectorFactory(str(work_dir), ["synth"], engines="scalar",
+                            durable=durable, manifest_every=1,
+                            fsync_policy=FSYNC_ALWAYS)
+    return factory.run(providers_by_runner=synthetic_providers(**kw))
+
+
+def test_service_generates_manifest_and_diagnostics(tmp_path):
+    diag = run_synthetic(tmp_path / "w")
+    assert diag["generated"] == 5 and diag["skipped"] == 1 \
+        and not diag["failed"]
+    manifest = Manifest.load(str(tmp_path / "w" / "manifest.json"))
+    assert len(manifest.cases) == 5
+    store = ArtifactStore(str(tmp_path / "w" / "store"))
+    assert manifest.missing_from(store) == []
+    assert os.path.exists(
+        tmp_path / "w" / "factory_diagnostics.json")
+
+
+def test_service_resume_skips_everything(tmp_path):
+    first = run_synthetic(tmp_path / "w")
+    again = run_synthetic(tmp_path / "w")
+    assert again["generated"] == 0
+    assert again["resumed"] == first["generated"]
+    assert again["skipped"] == 1            # DIGEST_SKIP honored, not re-run
+    assert tree_fingerprint(tmp_path / "w") == tree_fingerprint(
+        tmp_path / "w")
+
+
+def test_service_heals_torn_tree_from_store(tmp_path):
+    run_synthetic(tmp_path / "w")
+    before = tree_fingerprint(tmp_path / "w")
+    # simulate a crashed materialization: one case dir half-gone
+    victim = None
+    for base, dirs, files in os.walk(tmp_path / "w" / "tree"):
+        if files and "case_0" in base:
+            victim = base
+    shutil.rmtree(victim)
+    diag = run_synthetic(tmp_path / "w")
+    assert diag["rematerialized"] == 1 and diag["generated"] == 0
+    assert tree_fingerprint(tmp_path / "w") == before
+
+
+def test_service_error_isolation_and_retry(tmp_path):
+    diag = run_synthetic(tmp_path / "w", fail_at=4)
+    assert diag["failed"] == 1 and diag["generated"] == 4
+    log = (tmp_path / "w" / "tree" / "testgen_error_log.txt").read_text()
+    assert "case_4" in log and "ValueError" in log
+    # the failed case's intent stays unmarked: a later (fixed) run
+    # regenerates exactly it
+    healed = run_synthetic(tmp_path / "w")
+    assert healed["generated"] == 1 and healed["failed"] == 0
+    assert len(Manifest.load(
+        str(tmp_path / "w" / "manifest.json")).cases) == 5
+
+
+# ---------------------------------------------------------------------------
+# crash tier: seeded DeviceFault at every factory barrier family
+# ---------------------------------------------------------------------------
+
+class _RaiseAt(faults.FaultPlan):
+    """Raise DeviceFault at the nth consultation of one barrier site —
+    the in-process analogue of the SIGKILL drill."""
+
+    def __init__(self, site, nth):
+        super().__init__([], seed=0)
+        self._target = site
+        self._nth = nth
+        self._count = 0
+
+    def decide(self, site):
+        if site == self._target:
+            self._count += 1
+            if self._count == self._nth:
+                raise faults.DeviceFault(
+                    f"injected crash at {site} (consult {self._count})")
+        return None
+
+
+@pytest.mark.parametrize("site", FACTORY_BARRIERS)
+@pytest.mark.parametrize("nth", (1, 2))
+def test_crash_at_barrier_recovers_byte_identical(tmp_path, site, nth):
+    oracle = tmp_path / "oracle"
+    run_synthetic(oracle, durable=True)
+    expect = tree_fingerprint(oracle)
+    expect_manifest = Manifest.load(str(oracle / "manifest.json")).cases
+
+    crashed = tmp_path / "crashed"
+    with faults.inject(_RaiseAt(site, nth)):
+        try:
+            run_synthetic(crashed, durable=True)
+            survived = True
+        except faults.DeviceFault:
+            survived = False
+    assert not survived, f"{site} consulted < {nth} times"
+
+    recovered = run_synthetic(crashed, durable=True)
+    assert recovered["failed"] == 0
+    assert tree_fingerprint(crashed) == expect
+    assert Manifest.load(
+        str(crashed / "manifest.json")).cases == expect_manifest
+
+
+def test_merge_shards_union_equals_serial(tmp_path):
+    serial = tmp_path / "serial"
+    run_synthetic(serial)
+
+    shards = []
+    for i in range(2):
+        wd = tmp_path / f"shard{i}"
+        factory = VectorFactory(str(wd), ["synth"], shard=(i, 2),
+                                engines="scalar", durable=False)
+        factory.run(providers_by_runner=synthetic_providers())
+        shards.append(str(wd))
+    union = tmp_path / "union"
+    report = merge_shards(shards, str(union))
+    assert report["missing"] == [] and report["shards"] == 2
+    assert report["cases"] == 5
+    # the union tree is byte-identical to the unsharded run's tree
+    for base, dirs, files in os.walk(serial / "tree"):
+        for name in files:
+            if name.startswith(("factory_diagnostics",
+                                "testgen_error_log", "manifest")):
+                continue
+            rel = os.path.relpath(os.path.join(base, name),
+                                  serial / "tree")
+            assert (union / rel).read_bytes() == \
+                open(os.path.join(base, name), "rb").read(), rel
+
+
+# ---------------------------------------------------------------------------
+# engine scope
+# ---------------------------------------------------------------------------
+
+def test_engine_scope_arms_and_restores(tmp_path):
+    from consensus_specs_tpu import sigpipe
+    from consensus_specs_tpu.ssz import incremental
+    before = (sigpipe.enabled(), sigpipe.mode(), incremental.enabled())
+    with engine_scope("device") as report:
+        assert sigpipe.enabled() and sigpipe.mode() == "fused"
+        assert incremental.enabled()
+    assert (sigpipe.enabled(), sigpipe.mode(),
+            incremental.enabled()) == before
+    for key in ("seam_hits", "seam_misses", "dispatches",
+                "fold_dispatches", "scalar_fallbacks"):
+        assert key in report
+    assert report["engines"] == "device"
+
+
+def test_engine_scope_scalar_is_inert():
+    from consensus_specs_tpu import sigpipe
+    with engine_scope("scalar") as report:
+        assert not sigpipe.enabled()
+    assert report == {"engines": "scalar"}
+    with pytest.raises(ValueError):
+        with engine_scope("warp"):
+            pass
+
+
+# ---------------------------------------------------------------------------
+# seam tier: registry <-> drill contract, folded batch BLS pin
+# ---------------------------------------------------------------------------
+
+def test_drill_matrix_derives_from_registry():
+    """The drill's kill families are exactly the registered factory
+    barrier sites, in declaration order (the contractual matrix
+    order)."""
+    registered = tuple(s.name for s in sites.REGISTRY
+                       if s.name.startswith("factory."))
+    assert registered == FACTORY_BARRIERS
+    for name in registered:
+        assert sites.site(name).kind == sites.BARRIER
+    import importlib.util
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "factory_drill", os.path.join(root, "scripts",
+                                      "factory_drill.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert tuple(mod.KILL_FAMILIES) == registered
+
+
+def test_fast_aggregate_verify_batch_folds_to_n_plus_1(monkeypatch):
+    """The folded batch pin: N jobs -> ONE (N+1)-pair pairing check
+    (over a host-oracle recorder), exact per-job fallback attribution
+    on a tampered batch, and the FOLD_VERIFY=0 2N escape hatch."""
+    from consensus_specs_tpu.crypto import bls12_381 as native
+    from consensus_specs_tpu.crypto.hash_to_curve import hash_to_g2
+    from consensus_specs_tpu.ops import bls_tpu
+    from consensus_specs_tpu.sigpipe import fold
+
+    shapes = []
+
+    def oracle_hash(messages, dst=None):
+        return [hash_to_g2(bytes(m)) for m in messages]
+
+    def oracle_checks(jobs):
+        import numpy as np
+        shapes.append([len(j) for j in jobs])
+        return np.array([native.pairing_check(list(j)) for j in jobs])
+
+    monkeypatch.setattr(bls_tpu, "hash_to_g2_batch", oracle_hash)
+    monkeypatch.setattr(bls_tpu, "_run_pairing_checks", oracle_checks)
+
+    sks = [1000 + i for i in range(3)]
+    pks = [native.SkToPk(sk) for sk in sks]
+    msgs = [b"factory msg %d" % i for i in range(3)]
+    pk_lists, sigs = [], []
+    for i, m in enumerate(msgs):
+        pk_lists.append([pks[i % 3], pks[(i + 1) % 3]])
+        sigs.append(native.Aggregate([
+            native.Sign(sks[i % 3], m), native.Sign(sks[(i + 1) % 3], m)]))
+
+    fold.reset_mode()
+    assert bls_tpu.fast_aggregate_verify_batch(pk_lists, msgs, sigs) == \
+        [True, True, True]
+    assert shapes == [[4]], shapes          # N+1 = 4 legs, ONE job
+
+    shapes.clear()
+    tampered = list(sigs)
+    tampered[1] = native.Sign(sks[0], b"wrong message")
+    assert bls_tpu.fast_aggregate_verify_batch(
+        pk_lists, msgs, tampered) == [True, False, True]
+    assert shapes == [[4], [2, 2, 2]], shapes   # fold fails -> exact legs
+
+    shapes.clear()
+    monkeypatch.setattr(fold, "FOLD_MODE", "off")
+    assert bls_tpu.fast_aggregate_verify_batch(pk_lists, msgs, sigs) == \
+        [True, True, True]
+    assert shapes == [[2, 2, 2]], shapes        # the legacy 2N shape
+
+
+# ---------------------------------------------------------------------------
+# parity tier: factory(device engines) == serial scalar run_generator
+# ---------------------------------------------------------------------------
+
+def _parity_check(tmp_path, runner, shard, preset_list=None,
+                  fork_list=None):
+    from consensus_specs_tpu.gen.mesh_shard import shard_providers
+    from consensus_specs_tpu.gen.runner import run_generator
+    from consensus_specs_tpu.gen.runners import get_providers
+
+    fac_dir = tmp_path / "factory"
+    factory = VectorFactory(str(fac_dir), [runner], shard=shard,
+                            engines="device", durable=False,
+                            preset_list=preset_list, fork_list=fork_list)
+    diag = factory.run()
+    assert diag["failed"] == 0
+    assert diag["generated"] > 0, "shard produced no cases"
+
+    serial_dir = tmp_path / "serial"
+    providers = shard_providers(get_providers(runner), *shard)
+    args = ["-o", str(serial_dir)]
+    if preset_list:
+        args += ["--preset-list", *preset_list]
+    if fork_list:
+        args += ["--fork-list", *fork_list]
+    sdiag = run_generator(runner, providers, args)
+    assert sdiag["generated"] == diag["generated"]
+
+    def digest(root):
+        h = hashlib.sha256()
+        for base, dirs, files in sorted(os.walk(root)):
+            dirs.sort()
+            for name in sorted(files):
+                if name.startswith(("diagnostics", "factory_diagnostics",
+                                    "testgen_error_log")):
+                    continue
+                path = os.path.join(base, name)
+                h.update(os.path.relpath(path, root).encode())
+                h.update(open(path, "rb").read())
+        return h.hexdigest()
+
+    assert digest(fac_dir / "tree") == digest(serial_dir), \
+        f"{runner}: factory tree diverges from serial scalar run"
+    # and resume over the same work dir regenerates nothing
+    resumed = VectorFactory(str(fac_dir), [runner], shard=shard,
+                            engines="device", durable=False,
+                            preset_list=preset_list,
+                            fork_list=fork_list).run()
+    assert resumed["generated"] == 0
+    assert resumed["resumed"] == diag["generated"]
+
+
+@pytest.mark.parametrize("runner,shard,presets,forks", [
+    ("shuffling", (0, 16), None, None),
+    ("ssz_generic", (0, 64), None, None),
+    ("networking", (0, 1), ["minimal"], None),
+    ("epoch_processing", (0, 200), ["minimal"], ["phase0"]),
+])
+def test_factory_parity_quick(tmp_path, runner, shard, presets, forks):
+    _parity_check(tmp_path, runner, shard, presets, forks)
+
+
+@pytest.mark.slow
+def test_factory_parity_bls(tmp_path):
+    """The `bls` leg of the acceptance matrix (pure-python pairings:
+    ~15 s/case, so slow tier; `make factory-drill` + factory-bench
+    cover the quick path)."""
+    _parity_check(tmp_path, "bls", (0, 60))
+
+
+@pytest.mark.slow
+def test_factory_drill_quick_matrix():
+    """The process-boundary drill: SIGKILL a real shard at every
+    factory barrier family, resume in a fresh process, byte-identical
+    output set (scripts/factory_drill.py --quick)."""
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "scripts",
+                                      "factory_drill.py"), "--quick"],
+        capture_output=True, text=True, env=env, timeout=900)
+    assert proc.returncode == 0, \
+        f"factory drill failed:\n{proc.stdout[-4000:]}" \
+        f"\n{proc.stderr[-2000:]}"
+    for site in FACTORY_BARRIERS:
+        assert f"ok   {site}" in proc.stdout, \
+            f"{site} family missing:\n{proc.stdout}"
+    assert "PASS" in proc.stdout

@@ -142,6 +142,7 @@ def test_shuffling_runner_output_matches_spec(tmp_path):
         assert v == spec.compute_shuffled_index(i, data["count"], seed)
 
 
+@pytest.mark.slow  # full operations battery reflection (~1 min)
 def test_operations_runner_end_to_end(tmp_path):
     from consensus_specs_tpu.gen.runners import get_providers
     from consensus_specs_tpu.specs import get_spec
@@ -186,6 +187,7 @@ def test_operations_runner_end_to_end(tmp_path):
                 "written invalid vector replayed successfully")
 
 
+@pytest.mark.slow  # host pairing vectors (~30 s)
 def test_bls_and_kzg_runners(tmp_path):
     from consensus_specs_tpu.gen.runners import get_providers
     out = str(tmp_path)

@@ -60,6 +60,7 @@ def test_mainnet_scale_hash_tree_root(big_state):
     assert hash_tree_root(state) == root
 
 
+@pytest.mark.slow  # mainnet-size level hasher (~9 s)
 def test_bulk_level_hasher_byte_identical(big_state):
     """The JAX bulk level hasher (set_bulk_level_hasher plug point) must
     produce byte-identical roots to hashlib on the full mainnet-shape

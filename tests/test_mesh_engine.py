@@ -107,6 +107,7 @@ def test_electra_epoch_under_mesh_engine_same_root(engine):
     assert hash_tree_root(mesh_state) == hash_tree_root(host_state)
 
 
+@pytest.mark.slow  # sharded-MSM XLA compile (~2 min)
 def test_sharded_msm_in_kzg_path(engine):
     """g1_lincomb routes through the mesh MSM (per-device partials +
     ring reduction) and matches the host MSM bit-for-bit."""
@@ -124,6 +125,7 @@ def test_sharded_msm_in_kzg_path(engine):
     assert mesh_commitment == host_commitment
 
 
+@pytest.mark.slow  # sharded-MSM XLA compile
 def test_sharded_msm_direct_matches_oracle(engine):
     """MeshEngine.g1_msm against the pure-python Pippenger oracle on an
     uneven (padded) batch."""

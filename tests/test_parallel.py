@@ -44,6 +44,7 @@ def test_sharded_merkle_root_matches_oracle(mesh):
     assert words_to_bytes(jax.device_get(root)) == want
 
 
+@pytest.mark.slow  # sharded-add XLA compile (~2.5 min)
 def test_sharded_g1_sum_matches_oracle(mesh):
     rng = Random(11)
     G1 = cv.g1_generator()
@@ -87,6 +88,7 @@ def test_sharded_flag_deltas_matches_numpy(mesh):
     assert (np.asarray(penalties) == want_p).all()
 
 
+@pytest.mark.slow  # sharded ring-add XLA compile (~1 min)
 def test_sharded_g1_ring_sum_matches_oracle(mesh):
     """Ring (ppermute) reduction of per-device G1 partials: every
     device ends with the full sum, equal to the oracle."""

@@ -578,6 +578,7 @@ def test_guard_covers_per_set_mode_too():
     assert METRICS.count("guard_mismatches") >= 1
 
 
+@pytest.mark.slow  # host hash_to_g2 fallback sweep (~7 min)
 def test_hash_roots_seam_survives_device_failure(monkeypatch):
     """The tpu hash-to-G2 sweep seam: a raising device kernel degrades
     to host hash_to_curve with identical results."""

@@ -587,6 +587,7 @@ def _build_whisk_block(spec, state):
     return sign_block(spec, state.copy(), block)
 
 
+@pytest.mark.slow  # whisk feature-fork pipeline (~8 s)
 def test_whisk_block_pipeline(phase0_spec):
     """Per-fork collector audit (whisk): the feature fork's BLS surface
     is fully collected — `block.proposer_index` stands in for the

@@ -315,6 +315,40 @@ def test_foldgate_allows_the_registry_blessed_modules():
     assert repo_findings == []
 
 
+def test_factoryseam_flags_crypto_import_and_scalar_verb(tmp_path):
+    """Factory-scoped code importing the scalar crypto suite or calling
+    a scalar oracle verb moves generation work off the registered
+    engines uncounted — the factoryseam pass flags both shapes."""
+    findings = lint_snippet(tmp_path, """\
+        from consensus_specs_tpu.crypto import bls12_381
+
+        def sneaky(pk, msg, sig):
+            return bls12_381.Verify(pk, msg, sig)
+    """)
+    assert rules_of(findings) == ["factory-scalar-bypass",
+                                  "factory-scalar-bypass"]
+    assert [f.line for f in findings] == [1, 4]
+    assert "scalar" in findings[0].message
+
+
+def test_factoryseam_disable_suppresses(tmp_path):
+    findings = lint_snippet(tmp_path, """\
+        def deliberate(pairs):
+            # speclint: disable=factory-scalar-bypass -- fixture reason
+            return pairing_check(pairs)
+    """)
+    assert findings == []
+
+
+def test_factoryseam_repo_is_clean():
+    """The live factory package itself honours its own gate: zero
+    findings on the tree (the engines are armed via engine_scope, never
+    by direct crypto calls)."""
+    repo_findings = [f for f in run_speclint(REPO_ROOT)
+                     if f.rule == "factory-scalar-bypass"]
+    assert repo_findings == []
+
+
 # ---------------------------------------------------------------------------
 # concurrency passes: lock discipline, lock order, thread escape
 # ---------------------------------------------------------------------------
@@ -641,7 +675,8 @@ def test_pass_filter_and_names():
     names = pass_names()
     assert names == ("seams", "bypass", "determinism", "globals",
                      "txnpurity", "hostsync", "lock-discipline",
-                     "lock-order", "thread-escape", "foldgate")
+                     "lock-order", "thread-escape", "foldgate",
+                     "factoryseam")
     # a filtered run executes only the named pass
     findings = run_speclint(REPO_ROOT, passes=["lock-order"])
     assert findings == []
