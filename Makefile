@@ -17,7 +17,7 @@ RUNNERS := shuffling ssz_static operations epoch_processing sanity bls \
 	speclint native pyspec bench \
 	gossip-bench txn-bench msm-bench merkle-bench scenario-bench \
 	multichip-bench pipeline-bench fold-bench factory-bench \
-	factory-drill gen_all detect_errors \
+	factory-drill node-drill node-bench gen_all detect_errors \
 	$(addprefix gen_,$(RUNNERS))
 
 # syntax/bytecode check over every package and script (the CI lint job)
@@ -96,6 +96,7 @@ recovery-chaos:
 		tests/test_kill_drill.py -q --kernel-tiers
 	env JAX_PLATFORMS=cpu SPECLINT_TSAN=1 SOAK_SECONDS=45 \
 		$(PYTHON) scripts/soak.py
+	env JAX_PLATFORMS=cpu $(PYTHON) scripts/node_drill.py --quick
 
 # wall-clock soak runner (scripts/soak.py): loop durable fleet
 # scenarios — the blackout3 SIGKILL battlefield alternating with
@@ -133,6 +134,18 @@ kill-drill:
 # tree are byte-identical to the never-crashed oracle run.
 factory-drill:
 	env JAX_PLATFORMS=cpu $(PYTHON) scripts/factory_drill.py
+
+# SIGKILL crash drills through the real front door
+# (scripts/node_drill.py): spawn a real `scripts/run_node.py` process,
+# replay the smoke TrafficPlan over its unix socket at N× wall-clock
+# rate, SIGKILL it at every registered barrier family in the serving
+# path (the four txn barriers + node.ingest / node.drain), restart the
+# same data dir, and assert the recovered store root is byte-identical
+# to the in-process oracle.  NODE_DRILL_ARGS=--quick runs one kill per
+# family (also the recovery-chaos leg).
+node-drill:
+	env JAX_PLATFORMS=cpu $(PYTHON) scripts/node_drill.py \
+		$(NODE_DRILL_ARGS)
 
 # async flush engine slow tier under the runtime lock sanitizer: the
 # full overlapped-flush fault matrix with every named lock traced, so
@@ -241,6 +254,16 @@ fold-bench:
 # BENCH_FACTORY_CASES=3 gives a smoke run
 factory-bench:
 	env JAX_PLATFORMS=cpu $(PYTHON) bench.py factory
+
+# front-door sustained-load bench (node/): spawn a real run_node.py
+# process, replay the smoke TrafficPlan over the unix socket at >=10×
+# wall-clock ingress plus a full-speed flood leg against a small
+# ingest bound, and report sustained msgs/s, shed counts, RSS, and
+# server-side p50/p99 admission→delivery latency; asserts the process
+# survives with bounded queue/shed behavior; emits NODE_r01.json.
+# BENCH_NODE_RATE=10 BENCH_NODE_PASSES=1 give a smoke run
+node-bench:
+	env JAX_PLATFORMS=cpu $(PYTHON) bench.py node
 
 # static pattern rule: GNU make refuses to run implicit pattern rules
 # for .PHONY targets

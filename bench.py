@@ -2193,6 +2193,124 @@ def bench_factory():
     }
 
 
+NODE_RATE = float(os.environ.get("BENCH_NODE_RATE", "10"))
+NODE_FLOOD_PASSES = int(os.environ.get("BENCH_NODE_PASSES", "3"))
+NODE_JSON = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "NODE_r01.json")
+
+
+def bench_node():
+    """Front-door sustained load (node/): spawn a REAL run_node.py
+    process and drive it over its unix socket with the smoke
+    TrafficPlan replay encoder.  Two legs: (1) a paced leg at
+    BENCH_NODE_RATE× wall-clock ingress (default 10×) against the
+    default ingest bound, asserting the served store root stays
+    byte-identical to the in-process oracle; (2) a full-speed flood
+    leg (BENCH_NODE_PASSES back-to-back replays) against a tiny
+    ingest bound, asserting bounded shed behavior: the process
+    survives, the queue never exceeds its bound, RSS stays sane, and
+    health keeps answering.  Reports sustained msgs/s, shed counts
+    and server-side p50/p99 admission→delivery latency; emits
+    NODE_r01.json."""
+    import shutil
+    import tempfile
+
+    from consensus_specs_tpu.node.client import (
+        NodeClient, build_plan, converged_root, oracle_root,
+        replay_once, replay_sequence, spawn_node)
+
+    t_start = time.perf_counter()
+
+    def mark(msg):
+        log(f"[bench] node +{time.perf_counter() - t_start:5.1f}s: "
+            f"{msg}")
+
+    spec, plan = build_plan("smoke", 1)
+    seq = replay_sequence(plan)
+    n_msgs = sum(1 for item in seq if item[0] == "msg")
+    expect = oracle_root(spec, plan)
+    mark(f"oracle: {n_msgs} messages / {len(seq)} frames, "
+         f"root {expect[:16]}…")
+
+    def run_leg(name, rate, passes, ingest_bound):
+        root = tempfile.mkdtemp(prefix="bench-node-")
+        sock = os.path.join(root, "n.sock")
+        proc = spawn_node(sock, os.path.join(root, "data"),
+                          "--ingest-bound", ingest_bound)
+        try:
+            client = NodeClient(sock, connect_timeout_s=120.0)
+            t0 = time.perf_counter()
+            sent = 0
+            for _ in range(passes):
+                sent += replay_once(client, seq, rate=rate)["sent"]
+            served_root = client.root()      # drains the pipeline
+            wall = time.perf_counter() - t0
+            health = client.health()
+            assert proc.poll() is None, f"{name}: node died mid-leg"
+            depth = health["ingest"]["depth"]
+            assert depth <= health["ingest"]["bound"], \
+                f"{name}: queue over bound ({depth})"
+            assert health["rss_kb"] < 8 * 1024 * 1024, \
+                f"{name}: RSS unbounded ({health['rss_kb']} kB)"
+            client.drain()
+            client.close()
+            rc = proc.wait(timeout=120)
+            assert rc == 0, f"{name}: drain exit rc={rc}"
+            mark(f"{name}: {sent} msgs in {wall:.2f}s "
+                 f"({sent / wall:.0f} msgs/s), "
+                 f"shed_overload={health['ingest']['shed_overload']} "
+                 f"p99={health['latency']['p99_ms']}ms")
+            return {
+                "messages": sent,
+                "seconds": round(wall, 3),
+                "msgs_per_s": round(sent / wall, 1),
+                "served_root": served_root,
+                "shed_overload": health["ingest"]["shed_overload"],
+                "pipeline_shed": health["pipeline"]["shed"],
+                "accepted": health["pipeline"]["accepted"],
+                "degraded": health["degraded"],
+                "rss_kb": health["rss_kb"],
+                "p50_ms": health["latency"]["p50_ms"],
+                "p99_ms": health["latency"]["p99_ms"],
+            }
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            shutil.rmtree(root, ignore_errors=True)
+
+    # leg 1: paced >=10x ingress, default bound — byte-identity under
+    # sustained wall-clock load
+    paced = run_leg(f"paced {NODE_RATE:g}x", NODE_RATE, 1, 4096)
+    assert paced["served_root"] == expect, \
+        "paced leg diverged from the oracle root"
+    assert paced["shed_overload"] == 0, \
+        "paced leg shed at the default bound"
+
+    # leg 2: full-speed flood into a tiny bound — the overload
+    # contract (bounded queue, shed-oldest, process survives)
+    flood = run_leg("flood", 0.0, NODE_FLOOD_PASSES, 64)
+
+    report = {
+        "plan": {"scenario": "smoke", "messages": n_msgs,
+                 "frames": len(seq)},
+        "paced": paced,
+        "flood": flood,
+        "oracle_root": expect,
+        "ok": True,
+    }
+    with open(NODE_JSON, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+    log("[bench] node: " + json.dumps(report, sort_keys=True))
+    return {
+        "metric": "node_msgs_per_sec",
+        "value": flood["msgs_per_s"],
+        "unit": (f"msgs/s through the real socket (flood leg; paced "
+                 f"{NODE_RATE:g}x leg {paced['msgs_per_s']}/s, "
+                 f"p99 {paced['p99_ms']}ms, byte-identical root)"),
+        "vs_baseline": 1.0,
+    }
+
+
 TIERS = {
     "merkle": (bench_merkle, 150),
     # incremental merkleization (ssz/incremental.py): pure host-side
@@ -2242,6 +2360,11 @@ TIERS = {
     # real transition-shaped cases + resume overhead; genesis build and
     # block signing dominate the setup, both timed legs are host-path
     "factory": (bench_factory, 420),
+    # front-door node (node/): a real subprocess served over its unix
+    # socket — paced >=10x ingress with byte-identity vs the oracle,
+    # plus a flood leg against a tiny ingest bound; process spawns and
+    # the paced timeline dominate, stub BLS, no kernels
+    "node": (bench_node, 420),
 }
 
 # the driver's ~540s window fits merkle + ONE heavy tier — without
@@ -2250,7 +2373,7 @@ TIERS = {
 _ROTATING = ["north_star", "attestations", "block_sigs", "kzg", "epoch",
              "transition", "degraded", "gossip", "txn", "msm",
              "merkle_inc", "scenario", "multichip", "pipeline", "fold",
-             "factory"]
+             "factory", "node"]
 
 
 def _round_index() -> int:

@@ -56,6 +56,7 @@ _SCOPE = (
     "consensus_specs_tpu.resilience",
     "consensus_specs_tpu.scenario",
     "consensus_specs_tpu.utils",
+    "consensus_specs_tpu.node",
 )
 
 # the primitive layer: the one module allowed to touch threading locks

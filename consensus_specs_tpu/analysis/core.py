@@ -73,6 +73,9 @@ RULES: dict[str, str] = {
     "factory-scalar-bypass":
         "factory code imports crypto.* or calls a scalar BLS/KZG oracle "
         "verb instead of riding the registered engine seams",
+    "node-scalar-bypass":
+        "node code imports crypto.* or calls a scalar BLS/KZG oracle "
+        "verb instead of feeding the admission pipeline's counted seams",
     "speclint-bad-disable":
         "a speclint disable comment lacks a reason or names an unknown rule",
 }
@@ -226,6 +229,25 @@ class Context:
         self.registry = registry
 
 
+# full-surface parse cache: one (path, mtime, size) -> SourceFile map.
+# The quick tier runs several whole-tree lints (repo-is-clean gates for
+# three seam passes + registry liveness); parsing the package dominates
+# each, and SourceFiles are read-only after construction, so re-lints
+# only re-parse files that actually changed.
+_PARSE_CACHE: dict = {}
+
+
+def _cached_source(p: Path, rel: str) -> SourceFile:
+    stat = p.stat()
+    key = str(p)
+    hit = _PARSE_CACHE.get(key)
+    if hit is not None and hit[0] == (stat.st_mtime_ns, stat.st_size):
+        return hit[1]
+    sf = SourceFile(p, rel, p.read_text())
+    _PARSE_CACHE[key] = ((stat.st_mtime_ns, stat.st_size), sf)
+    return sf
+
+
 def load_context(root: str | Path,
                  paths: list[str | Path] | None = None) -> Context:
     """Parse the lint surface.  With `paths`, lint exactly those files
@@ -238,7 +260,7 @@ def load_context(root: str | Path,
     if paths is None:
         for p in _iter_py(root):
             rel = p.relative_to(root).as_posix()
-            files.append(SourceFile(p, rel, p.read_text()))
+            files.append(_cached_source(p, rel))
     else:
         for p in map(Path, paths):
             p = p.resolve()
@@ -259,7 +281,8 @@ def _pass_table() -> dict:
     vocabulary).  Import is deferred so `from .core import Finding`
     inside the pass modules does not cycle."""
     from . import (bypass, concurrency, determinism, factoryseam,
-                   foldgate, globals_, hostsync, seams, txnpurity)
+                   foldgate, globals_, hostsync, nodeseam, seams,
+                   txnpurity)
     return {
         "seams": seams.run,
         "bypass": bypass.run,
@@ -272,6 +295,7 @@ def _pass_table() -> dict:
         "thread-escape": concurrency.run_thread_escape,
         "foldgate": foldgate.run,
         "factoryseam": factoryseam.run,
+        "nodeseam": nodeseam.run,
     }
 
 

@@ -1,0 +1,68 @@
+"""Pass 12 — node scalar-bypass gate.
+
+The front-door node (node/) serves traffic by FEEDING the gossip
+`AdmissionPipeline` — verification rides the pipeline's registered
+seams (micro-batched device verify, ``scalar_only`` as the counted
+degradation mode).  Node code that imports the scalar `crypto.*`
+suite directly, or calls a scalar oracle verb by name, verifies
+traffic outside the pipeline's breaker/fallback/counting contract —
+the overload watermark, the degraded-mode metrics, and the drill's
+byte-identity argument all stop describing the process.
+
+Same shape as the ``factory-scalar-bypass`` pass: inside
+``consensus_specs_tpu.node`` modules only, flag any import of
+``consensus_specs_tpu.crypto.*`` and any call whose terminal name is
+a scalar oracle verb.  A deliberate exception carries
+``# speclint: disable=node-scalar-bypass -- <reason>``.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Context, Finding
+from .factoryseam import _SCALAR_CALLS, _resolved_import
+
+_SCOPE = ("consensus_specs_tpu.node",)
+_CRYPTO = "consensus_specs_tpu.crypto"
+
+
+def run(ctx: Context) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in ctx.files:
+        if not sf.in_module(*_SCOPE):
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == _CRYPTO or \
+                            alias.name.startswith(_CRYPTO + "."):
+                        findings.append(_import_finding(sf, node))
+            elif isinstance(node, ast.ImportFrom):
+                mod = _resolved_import(sf, node)
+                if mod == _CRYPTO or mod.startswith(_CRYPTO + "."):
+                    findings.append(_import_finding(sf, node))
+            elif isinstance(node, ast.Call):
+                func = node.func
+                name = func.attr if isinstance(func, ast.Attribute) \
+                    else (func.id if isinstance(func, ast.Name) else None)
+                if name in _SCALAR_CALLS:
+                    findings.append(Finding(
+                        "node-scalar-bypass", sf.rel, node.lineno,
+                        node.col_offset,
+                        f"node code calls the scalar oracle verb "
+                        f"{name}() directly — traffic verifies outside "
+                        f"the admission pipeline's counted seams",
+                        hint="submit through the AdmissionPipeline "
+                             "(scalar_only is its counted degradation "
+                             "mode) or carry a reasoned disable"))
+    return findings
+
+
+def _import_finding(sf, node) -> Finding:
+    return Finding(
+        "node-scalar-bypass", sf.rel, node.lineno, node.col_offset,
+        "node code imports the scalar crypto suite directly — the "
+        "front door verifies only through the admission pipeline's "
+        "registered seams",
+        hint="feed the AdmissionPipeline instead, or carry a "
+             "reasoned disable")

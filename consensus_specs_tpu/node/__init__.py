@@ -1,0 +1,12 @@
+"""The front door: a long-lived node process serving the gossip
+admission pipeline over a framed unix socket (docs/node.md).
+
+    wire     framed CRC32C wire protocol + incremental deframer
+    ingest   accept loop / per-connection readers (bounded, shedding)
+    service  NodeService: pipeline + durable txn store + lifecycle
+    client   NodeClient + TrafficPlan replay encoder + oracle
+"""
+from .service import NodeConfig, NodeService
+from .wire import FrameReader, WireError
+
+__all__ = ["NodeConfig", "NodeService", "FrameReader", "WireError"]

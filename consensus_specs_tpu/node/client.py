@@ -1,0 +1,245 @@
+"""Client side of the front door: a framed-socket client plus the
+scenario `TrafficPlan` -> wire replay encoder the drill, soak leg and
+bench tier all share.
+
+The replay discipline is the drill's determinism contract: a plan is
+flattened into ONE canonical sequence of TICK and MESSAGE frames
+(ticks at every integer-second boundary of the publish timeline, then
+the messages published inside that second, in publish order).  The
+same sequence drives both the real process over the socket and the
+in-process `apply_scalar` oracle, so the two store roots are
+comparable byte-for-byte.  Replays are idempotent: re-running the
+sequence against a recovered node re-offers everything (duplicates
+shed in-process, earlier rejects retried), and both sides converge to
+a fixpoint root.
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import socket
+import subprocess
+import sys
+import time
+
+from ..gossip.pipeline import apply_scalar
+from ..scenario import named
+from ..scenario.traffic import TrafficPlan
+from ..specs import get_spec
+from ..test_infra import disable_bls
+from ..test_infra.fork_choice import get_genesis_forkchoice_store
+from ..txn import store_root
+from . import wire
+
+RUN_NODE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "scripts", "run_node.py")
+
+
+class NodeClient:
+    """One connection to a running node.  Requests carry a client-side
+    msg_id; responses are read inline (the server answers every frame,
+    though message verdicts may arrive out of submission order)."""
+
+    def __init__(self, socket_path: str, connect_timeout_s: float = 10.0):
+        deadline = time.monotonic() + connect_timeout_s
+        self.sock = None
+        while True:
+            try:
+                sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                sock.connect(socket_path)
+                self.sock = sock
+                break
+            except OSError:
+                sock.close()
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.05)
+        self.reader = wire.FrameReader()
+        self._responses = []
+        self._next_id = 0
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    # -- frames ---------------------------------------------------------
+
+    def send_message(self, topic: str, payload, peer: str = "client") -> int:
+        self._next_id += 1
+        self.sock.sendall(wire.encode_message(self._next_id, topic,
+                                              peer, payload))
+        return self._next_id
+
+    def send_tick(self, t: int) -> int:
+        self._next_id += 1
+        self.sock.sendall(wire.frame(wire.KIND_TICK,
+                                     (self._next_id, int(t))))
+        return self._next_id
+
+    def request(self, kind: str) -> dict:
+        """Send a control frame and wait for ITS response (every frame
+        carries a client-assigned id; stale verdicts are skipped)."""
+        self._next_id += 1
+        rid = self._next_id
+        self.sock.sendall(wire.frame(kind, rid))
+        while True:
+            resp = self.read_response()
+            if resp.get("id") == rid:
+                return resp
+
+    def health(self) -> dict:
+        return json.loads(self.request(wire.KIND_HEALTH)["health"])
+
+    def root(self) -> str:
+        return self.request(wire.KIND_ROOT)["root"]
+
+    def drain(self) -> dict:
+        return self.request(wire.KIND_DRAIN)
+
+    def read_response(self, timeout_s: float = 30.0) -> dict:
+        while not self._responses:
+            self.sock.settimeout(timeout_s)
+            data = self.sock.recv(1 << 16)
+            if not data:
+                raise ConnectionError("node closed the connection")
+            for body in self.reader.feed(data):
+                kind, value = wire.decode_body(body)
+                assert kind == wire.KIND_RESPONSE, kind
+                self._responses.append(value)
+        return self._responses.pop(0)
+
+    def drain_responses(self) -> list:
+        """Non-blocking: collect whatever responses already arrived."""
+        out = []
+        try:
+            self.sock.settimeout(0.0)
+            while True:
+                data = self.sock.recv(1 << 16)
+                if not data:
+                    break
+                for body in self.reader.feed(data):
+                    _, value = wire.decode_body(body)
+                    self._responses.append(value)
+        except (BlockingIOError, OSError):
+            pass
+        finally:
+            self.sock.settimeout(None)
+        out, self._responses = self._responses, out
+        return out
+
+
+# ---------------------------------------------------------------------------
+# TrafficPlan -> canonical replay sequence
+# ---------------------------------------------------------------------------
+
+def build_plan(scenario_name: str, seed: int):
+    """(spec, plan) for a named scenario — the same (scenario, seed)
+    draw order the scenario driver uses, so the feed is the canonical
+    one."""
+    scenario = named(scenario_name)
+    spec = get_spec(scenario.fork, scenario.preset)
+    plan = TrafficPlan(spec, scenario, random.Random(int(seed)))
+    return spec, plan
+
+
+def replay_sequence(plan) -> list:
+    """Flatten a plan into the canonical frame sequence:
+    ("tick", t) | ("msg", topic, payload, peer), ending on the
+    end-of-run boundary tick."""
+    seq = []
+    last_tick = None
+    for planned in plan.messages:
+        t = int(plan.genesis_time + int(planned.time_s))
+        if last_tick is None or t > last_tick:
+            seq.append(("tick", t))
+            last_tick = t
+        seq.append(("msg", planned.topic, planned.payload,
+                    f"origin{planned.origin}"))
+    end = int(plan.genesis_time
+              + plan.slot_time(plan.scenario.slots + 1))
+    if last_tick is None or end > last_tick:
+        seq.append(("tick", end))
+    return seq
+
+
+def replay_once(client: NodeClient, seq, rate: float = 0.0,
+                slot_seconds: float = 6.0) -> dict:
+    """Stream one full sequence.  ``rate`` > 0 paces the send so the
+    plan's timeline is compressed rate-fold (10.0 = 10x wall-clock);
+    0 streams at full speed.  Returns send-side stats."""
+    t0 = time.monotonic()
+    plan_t0 = None
+    sent = 0
+    for item in seq:
+        if item[0] == "tick":
+            if rate > 0:
+                if plan_t0 is None:
+                    plan_t0 = item[1]
+                due = t0 + (item[1] - plan_t0) / rate
+                delay = due - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+            client.send_tick(item[1])
+        else:
+            client.send_message(item[1], item[2], peer=item[3])
+            sent += 1
+        client.drain_responses()
+    return {"sent": sent, "wall_s": time.monotonic() - t0}
+
+
+# ---------------------------------------------------------------------------
+# the in-process oracle
+# ---------------------------------------------------------------------------
+
+def oracle_root(spec, plan, max_passes: int = 4) -> str:
+    """Apply the canonical sequence with the sequential scalar oracle
+    until the store root reaches a fixpoint; the byte-identity target
+    for the recovered node."""
+    seq = replay_sequence(plan)
+    with disable_bls():
+        store = get_genesis_forkchoice_store(spec, plan.genesis_state)
+        last = None
+        for _ in range(max_passes):
+            for item in seq:
+                if item[0] == "tick":
+                    if item[1] > int(store.time):
+                        spec.on_tick(store, item[1])
+                else:
+                    apply_scalar(spec, store, item[1], item[2])
+            root = store_root(store).hex()
+            if root == last:
+                return root
+            last = root
+    return last
+
+
+def converged_root(client: NodeClient, seq, max_passes: int = 4) -> str:
+    """Replay the sequence against a live node until ITS root reaches
+    a fixpoint (re-offers are idempotent)."""
+    last = None
+    for _ in range(max_passes):
+        replay_once(client, seq)
+        root = client.root()
+        if root == last:
+            return root
+        last = root
+    return last
+
+
+# ---------------------------------------------------------------------------
+# process spawning
+# ---------------------------------------------------------------------------
+
+def spawn_node(socket_path: str, data_dir: str, *extra,
+               env_extra=None) -> subprocess.Popen:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.update(env_extra or {})
+    return subprocess.Popen(
+        [sys.executable, RUN_NODE, "--socket", socket_path,
+         "--dir", data_dir, *map(str, extra)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)

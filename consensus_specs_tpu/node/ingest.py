@@ -1,0 +1,162 @@
+"""Unix-socket ingest: the accept loop and per-connection readers.
+
+One `IngestServer` owns the listening socket.  Each accepted
+connection gets its own reader thread (role ``node-conn``) holding a
+`wire.FrameReader`; decoded frames dispatch into
+`NodeService.handle`, and every response is written back under the
+connection's ``node.conn`` send lock (the pump thread and the
+conn reader both answer on the same socket).
+
+Damage handling is the tentpole contract: a malformed frame (bad
+magic / oversize / CRC flip / undecodable body) sheds THAT frame with
+an incident and — when the framing itself is broken and resync is
+impossible — closes only that connection.  Nothing a peer sends can
+raise out of the reader thread.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import threading
+
+from ..utils.locks import named_lock
+from . import wire
+
+INGEST_SITE = "node.ingest"
+
+
+class _Connection:
+    def __init__(self, sock: socket.socket, conn_id: int):
+        self.sock = sock
+        self.conn_id = int(conn_id)
+        self._send_lock = named_lock("node.conn")
+        self.reader = wire.FrameReader()
+
+    def respond(self, payload: dict) -> None:
+        """Send one response frame; a peer that hung up is not an
+        error (its verdict is simply undeliverable)."""
+        data = wire.frame(wire.KIND_RESPONSE, payload)
+        try:
+            with self._send_lock:
+                self.sock.sendall(data)
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class IngestServer:
+    def __init__(self, path: str, service, backlog: int = 16):
+        self.path = path
+        self.service = service
+        if os.path.exists(path):
+            os.unlink(path)                 # stale socket from a kill
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(path)
+        self._listener.listen(backlog)
+        self._lock = named_lock("node.server")
+        self._conns = {}                    # conn_id -> _Connection
+        self._next_id = 0
+        self._accepting = True
+
+    def start(self) -> None:
+        threading.Thread(target=self._accept_loop,
+                         name="node-listener", daemon=True).start()
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return                      # listener closed: drain
+            with self._lock:
+                if not self._accepting:
+                    sock.close()
+                    continue
+                self._next_id += 1
+                conn = _Connection(sock, self._next_id)
+                self._conns[conn.conn_id] = conn
+            threading.Thread(target=self._conn_loop, args=(conn,),
+                             name=f"node-conn-{conn.conn_id}",
+                             daemon=True).start()
+
+    def _conn_loop(self, conn: _Connection) -> None:
+        service = self.service
+        try:
+            while True:
+                try:
+                    data = conn.sock.recv(1 << 16)
+                except OSError:
+                    return
+                if not data:
+                    if conn.reader.pending:
+                        # peer hung up mid-frame: ITS torn tail
+                        service.ctx.incidents.record(
+                            INGEST_SITE, "torn_frame",
+                            pending=conn.reader.pending)
+                        service.ctx.metrics.inc("node_torn_frames")
+                    return
+                try:
+                    bodies = conn.reader.feed(data)
+                except wire.WireError as exc:
+                    # framing broken: no resync point — shed + close
+                    service.ctx.incidents.record(
+                        INGEST_SITE, "malformed_frame", detail=str(exc))
+                    service.ctx.metrics.inc("node_malformed_frames")
+                    conn.respond({"id": None, "status": "shed",
+                                  "detail": str(exc)})
+                    return
+                for body in bodies:
+                    try:
+                        kind, value = wire.decode_body(
+                            body, service._resolver)
+                    except wire.WireError as exc:
+                        # framing intact, body poisoned: shed the
+                        # frame, keep the connection
+                        service.ctx.incidents.record(
+                            INGEST_SITE, "malformed_frame",
+                            detail=str(exc))
+                        service.ctx.metrics.inc("node_malformed_frames")
+                        conn.respond({"id": None, "status": "shed",
+                                      "detail": str(exc)})
+                        continue
+                    try:
+                        service.handle(kind, value, conn.respond)
+                    except Exception as exc:  # never crash a reader
+                        service.ctx.incidents.record(
+                            INGEST_SITE, "handler_error",
+                            detail=f"{type(exc).__name__}: {exc}")
+                        service.ctx.metrics.inc("node_handler_errors")
+                        conn.respond({"id": None, "status": "shed",
+                                      "detail": "handler error"})
+        finally:
+            conn.close()
+            with self._lock:
+                self._conns.pop(conn.conn_id, None)
+
+    def stop_accepting(self) -> None:
+        with self._lock:
+            self._accepting = False
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        self.stop_accepting()
+        with self._lock:
+            conns = list(self._conns.values())
+        for conn in conns:
+            conn.close()
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass

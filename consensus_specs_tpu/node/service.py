@@ -1,0 +1,470 @@
+"""The long-lived node: a real process wrapped around the gossip
+`AdmissionPipeline` + durable txn store, fed through the framed unix
+socket in `wire.py` and run on the `SystemClock`.
+
+Threading model (every lock/role below is registered in
+`resilience.sites.CONCURRENCY` and checked by the speclint
+lock-discipline / thread-escape passes):
+
+    node-listener  accept loop; spawns one node-conn per connection
+    node-conn      deframes + decodes one socket; enqueues work items
+                   on the bounded ingest queue under ``node.ingest``
+    node-pump      the ONLY thread that touches the pipeline/store:
+                   pops the queue, submits under `scope()` (node
+                   context + txn manager), harvests verdicts, answers
+
+Overload contract (ISSUE 17 tentpole (a)):
+
+* the ingest queue is bounded; when full the OLDEST queued message is
+  shed (evicted with an explicit ``shed``/``overload`` response and an
+  incident) so fresh traffic keeps a bounded wait — control frames
+  (tick/root) are never evicted;
+* past the degrade watermark the pipeline is flipped to
+  ``scalar_only`` verification (cheaper, byte-identical verdicts)
+  BEFORE any admission refusal — restored below the low watermark;
+* per-peer quota verdicts (defer/shed) from the pipeline propagate
+  back to the socket as the message's explicit response.
+
+Lifecycle contract (tentpole (b)):
+
+* SIGTERM (or a DRAIN frame) -> graceful drain: stop accepting, shed
+  late arrivals with ``draining``, flush in-flight windows, fsync +
+  close the journal, exit 0 — all inside a hard deadline enforced by
+  a watchdog (`os._exit(1)` past it, so a stuck drain is visible);
+* SIGKILL anywhere -> on restart the same data dir reopens through
+  `txn.open_dir` (torn-tail repair) + `txn.recover`; the two
+  registered barriers ``node.ingest`` / ``node.drain`` give the kill
+  drill deterministic spots inside the serving path itself.
+
+Determinism note for the drill: the node never advances store time on
+its own — store time moves ONLY on client TICK frames, and each tick
+drains the pipeline first, so delivery order (and therefore the store
+bytes) is a pure function of the frame sequence, comparable 1:1 with
+the sequential `apply_scalar` oracle.
+"""
+from __future__ import annotations
+
+import json
+import os
+import resource
+import signal
+import threading
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+from .. import txn
+from ..gossip import AdmissionPipeline, GossipConfig
+from ..gossip.dedup import EquivocationGuard
+from ..gossip.pipeline import TOPICS
+from ..resilience import faults
+from ..resilience.incidents import IncidentLog
+from ..resilience.supervisor import Supervisor, SupervisorConfig
+from ..sigpipe.metrics import Metrics
+from ..specs import get_spec
+from ..test_infra import disable_bls
+from ..test_infra.fork_choice import get_genesis_forkchoice_store
+from ..test_infra.genesis import create_genesis_state, default_balances
+from ..txn.codec import TypeResolver
+from ..utils import nodectx
+from ..utils.clock import MONOTONIC
+from ..utils.locks import named_condition, named_lock
+from . import wire
+from .ingest import IngestServer
+
+INGEST_SITE = "node.ingest"
+DRAIN_SITE = "node.drain"
+
+
+@dataclass
+class NodeConfig:
+    socket_path: str
+    data_dir: str
+    fork: str = "altair"
+    preset: str = "minimal"
+    fsync_policy: str = "marker_only"
+    segment_bytes: int = 1 << 16
+    snapshot_interval: int = 64
+    ingest_bound: int = 4096            # bounded accept queue
+    degrade_watermark: float = 0.5      # of ingest_bound: scalar_only on
+    restore_watermark: float = 0.125    # of ingest_bound: scalar_only off
+    health_every_s: float = 5.0
+    drain_deadline_s: float = 30.0
+    latency_window: int = 4096          # admission->delivery samples kept
+    stub_bls: bool = True               # real BLS only when asked
+    gossip: GossipConfig = field(default_factory=lambda: GossipConfig(
+        bucket_capacity=1 << 14, refill_rate=1 << 12,
+        queue_depth=1 << 12))
+
+
+def _percentile(sorted_vals, q: float):
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[idx]
+
+
+class NodeService:
+    def __init__(self, config: NodeConfig, clock=MONOTONIC):
+        self.config = config
+        self.clock = clock
+        self._bls_guard = disable_bls() if config.stub_bls else None
+        if self._bls_guard is not None:
+            self._bls_guard.__enter__()
+        self.spec = get_spec(config.fork, config.preset)
+        self._resolver = TypeResolver(self.spec)
+        self.ctx = nodectx.NodeContext(
+            "node", metrics=Metrics(node_id="node"),
+            incidents=IncidentLog(max_entries=1 << 14, node_id="node",
+                                  clock=clock),
+            supervisor=nodectx.Slot(Supervisor(
+                SupervisorConfig(clock=clock))),
+            fault_plan=nodectx.Slot(None),
+            guard=nodectx.Slot(None))
+        os.makedirs(config.data_dir, exist_ok=True)
+        journal_dir = os.path.join(config.data_dir, "journal")
+        with nodectx.use(self.ctx):
+            self.journal = txn.open_dir(
+                journal_dir, fsync_policy=config.fsync_policy,
+                segment_bytes=config.segment_bytes)
+        self.manager = txn.TxnManager(
+            self.journal, snapshot_interval=config.snapshot_interval)
+        self.recovered = not self.journal.needs_anchor()
+        if self.recovered:
+            with self.scope():
+                self.store = txn.recover(self.spec, self.journal)
+        else:
+            anchor = create_genesis_state(self.spec,
+                                          default_balances(self.spec))
+            self.store = get_genesis_forkchoice_store(self.spec, anchor)
+        self.guard = EquivocationGuard()
+        self.pipe = AdmissionPipeline(self.spec, self.store,
+                                      config.gossip, clock,
+                                      guard=self.guard, ctx=self.ctx)
+        # -- ingest queue (conn readers -> pump), bounded, shed-oldest
+        self._cond = named_condition("node.ingest")
+        self._queue = deque()               # guarded by _cond
+        self._shed_overload = 0             # guarded by _cond
+        self._shed_draining = 0             # guarded by _cond
+        # -- pump-side bookkeeping, read by health() from conn threads
+        self._state_lock = named_lock("node.state")
+        self._inflight = {}                 # seq -> (msg_id, respond, t0)
+        self._latencies = deque(maxlen=config.latency_window)
+        self._degraded = False
+        self._started = clock.now()
+        self._draining = threading.Event()
+        self._drain_done = threading.Event()
+        self._stopping = False
+        self._exit_code = 0
+        self.server = IngestServer(config.socket_path, self)
+        self._pump = threading.Thread(target=self._pump_loop,
+                                      name="node-pump", daemon=True)
+
+    @contextmanager
+    def scope(self):
+        with nodectx.use(self.ctx):
+            with txn.use(self.manager):
+                yield
+
+    # -- conn-thread surface -------------------------------------------
+
+    def handle(self, kind: str, value, respond) -> None:
+        """Dispatch one decoded frame from a conn reader.  Shape errors
+        answer with a shed response + incident — never an exception."""
+        if kind == wire.KIND_HEALTH:
+            if not isinstance(value, int):
+                self._shed_frame(respond, None, "bad health request")
+                return
+            # JSON string, not a codec value: health carries floats,
+            # which the journal codec (deliberately) refuses
+            respond({"id": value, "status": "ok",
+                     "health": json.dumps(self.health(), sort_keys=True)})
+            return
+        if kind == wire.KIND_DRAIN:
+            if not isinstance(value, int):
+                self._shed_frame(respond, None, "bad drain request")
+                return
+            respond({"id": value, "status": "draining"})
+            self.request_drain("drain frame")
+            return
+        if kind == wire.KIND_MESSAGE:
+            if (not isinstance(value, (tuple, list)) or len(value) != 4
+                    or not isinstance(value[0], int)
+                    or not isinstance(value[1], str)
+                    or not isinstance(value[2], str)):
+                self._shed_frame(respond, None, "bad message shape")
+                return
+            msg_id, topic, peer, payload = value
+            if topic not in self.pipe.topics:
+                self._shed_frame(respond, msg_id, f"bad topic {topic!r}")
+                return
+            self._enqueue(("msg", msg_id, topic, peer, payload, respond,
+                           self.clock.now()), respond)
+            return
+        if kind == wire.KIND_TICK:
+            if (not isinstance(value, (tuple, list)) or len(value) != 2
+                    or not all(isinstance(v, int) for v in value)):
+                self._shed_frame(respond, None, "bad tick value")
+                return
+            self._enqueue(("tick", value[0], value[1], respond), respond,
+                          control=True)
+            return
+        if kind == wire.KIND_ROOT:
+            if not isinstance(value, int):
+                self._shed_frame(respond, None, "bad root request")
+                return
+            self._enqueue(("root", value, respond), respond, control=True)
+            return
+        self._shed_frame(respond, None, f"unhandled kind {kind!r}")
+
+    def _shed_frame(self, respond, msg_id, detail) -> None:
+        self.ctx.incidents.record(INGEST_SITE, "malformed_frame",
+                                  detail=str(detail))
+        self.ctx.metrics.inc("node_malformed_frames")
+        respond({"id": msg_id, "status": "shed", "detail": str(detail)})
+
+    def _enqueue(self, item, respond, control: bool = False) -> None:
+        evicted = None
+        with self._cond:
+            if self._draining.is_set() and not control:
+                self._shed_draining += 1
+                respond({"id": item[1], "status": "shed",
+                         "detail": "draining"})
+                return
+            if not control and len(self._queue) >= self.config.ingest_bound:
+                # shed-OLDEST: evict the first queued message (never a
+                # control frame) so fresh traffic keeps a bounded wait
+                for i, old in enumerate(self._queue):
+                    if old[0] == "msg":
+                        evicted = old
+                        del self._queue[i]
+                        break
+                if evicted is None:         # bound full of controls
+                    self._shed_overload += 1
+                    respond({"id": item[1], "status": "shed",
+                             "detail": "overload"})
+                    return
+                self._shed_overload += 1
+            self._queue.append(item)
+            self._cond.notify()
+        if evicted is not None:
+            self.ctx.incidents.record(INGEST_SITE, "shed_oldest",
+                                      msg_id=evicted[1], topic=evicted[2])
+            self.ctx.metrics.inc("node_shed_overload")
+            evicted[5]({"id": evicted[1], "status": "shed",
+                        "detail": "overload"})
+
+    def request_drain(self, why: str) -> None:
+        if self._draining.is_set():
+            return
+        self.ctx.incidents.record(DRAIN_SITE, "drain_begin",
+                                  detail=str(why))
+        self._draining.set()
+        with self._cond:
+            self._cond.notify()
+
+    # -- pump ----------------------------------------------------------
+
+    def _pump_loop(self) -> None:
+        while True:
+            with self._cond:
+                if not self._queue and not self._stopping:
+                    self._cond.wait(timeout=0.05)
+                batch = []
+                while self._queue and len(batch) < 256:
+                    batch.append(self._queue.popleft())
+                stop = self._stopping and not self._queue
+            with self.scope():
+                for item in batch:
+                    try:
+                        self._process(item)
+                    except Exception as exc:  # never crash the pump
+                        self.ctx.incidents.record(
+                            INGEST_SITE, "handler_error",
+                            detail=f"{type(exc).__name__}: {exc}")
+                        self.ctx.metrics.inc("node_handler_errors")
+                        if item[0] == "msg":
+                            item[5]({"id": item[1], "status": "shed",
+                                     "detail": "handler error"})
+                self.pipe.poll()
+            self._harvest()
+            self._watermark()
+            if stop:
+                return
+
+    def _process(self, item) -> None:
+        if item[0] == "msg":
+            _, msg_id, topic, peer, payload, respond, t0 = item
+            faults.fire(INGEST_SITE)
+            seq = self.pipe.submit(topic, payload, peer=peer)
+            result = self.pipe.results.get(seq)
+            if result is not None and result.final:
+                with self._state_lock:
+                    self._latencies.append(self.clock.now() - t0)
+                respond({"id": msg_id, "status": result.status,
+                         "detail": result.detail})
+            elif result is not None and result.status == "deferred":
+                respond({"id": msg_id, "status": "deferred",
+                         "detail": result.detail})
+                with self._state_lock:
+                    self._inflight[seq] = (msg_id, None, t0)
+            else:
+                with self._state_lock:
+                    self._inflight[seq] = (msg_id, respond, t0)
+        elif item[0] == "tick":
+            _, rid, t, respond = item
+            self.pipe.drain()
+            self._harvest()
+            if int(t) > int(self.store.time):
+                self.spec.on_tick(self.store, int(t))
+            respond({"id": rid, "status": "ok", "time": int(t)})
+        elif item[0] == "root":
+            _, rid, respond = item
+            self.pipe.drain()
+            self._harvest()
+            respond({"id": rid, "status": "ok",
+                     "root": txn.store_root(self.store).hex()})
+
+    def _harvest(self) -> None:
+        """Deliver final verdicts for previously queued/deferred
+        messages back to their sockets; record admission->delivery
+        latency."""
+        done = []
+        with self._state_lock:
+            for seq, (msg_id, respond, t0) in list(self._inflight.items()):
+                result = self.pipe.results.get(seq)
+                if result is None or not result.final:
+                    continue
+                self._latencies.append(self.clock.now() - t0)
+                del self._inflight[seq]
+                if respond is not None:
+                    done.append((respond, msg_id, result))
+        for respond, msg_id, result in done:
+            respond({"id": msg_id, "status": result.status,
+                     "detail": result.detail})
+
+    def _watermark(self) -> None:
+        with self._cond:
+            depth = len(self._queue)
+        bound = self.config.ingest_bound
+        flip = None
+        with self._state_lock:
+            if (not self._degraded
+                    and depth >= bound * self.config.degrade_watermark):
+                self._degraded = flip = True
+            elif (self._degraded
+                  and depth <= bound * self.config.restore_watermark):
+                self._degraded = False
+                flip = False
+        if flip is None:
+            return
+        # the pump is the only thread that drains this pipeline, so
+        # the flag it flips here is read back only by itself
+        # speclint: disable=conc-thread-escape -- scalar_only is
+        # consumed by the drainer, which on a node IS the pump thread
+        self.pipe.config.scalar_only = flip
+        if flip:
+            self.ctx.incidents.record(INGEST_SITE, "degraded", depth=depth)
+            self.ctx.metrics.inc("node_degraded_flips")
+        else:
+            self.ctx.incidents.record(INGEST_SITE, "restored", depth=depth)
+
+    # -- health ---------------------------------------------------------
+
+    def health(self) -> dict:
+        with self._state_lock:
+            lats = sorted(self._latencies)
+            inflight = len(self._inflight)
+            degraded = self._degraded
+        with self._cond:
+            depth = len(self._queue)
+            shed_overload = self._shed_overload
+            shed_draining = self._shed_draining
+        metrics = self.ctx.metrics
+        return {
+            "uptime_s": round(self.clock.now() - self._started, 3),
+            "rss_kb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss,
+            "pid": os.getpid(),
+            "recovered": self.recovered,
+            "draining": self._draining.is_set(),
+            "degraded": degraded,
+            "breakers": self.ctx.supervisor.value.breaker_states(),
+            "journal": {"disk_bytes": self.journal.disk_bytes(),
+                        "segments": len(self.journal.segment_indices()),
+                        "fsyncs": metrics.count("txn_journal_fsyncs")},
+            "ingest": {"depth": depth, "bound": self.config.ingest_bound,
+                       "inflight": inflight,
+                       "shed_overload": shed_overload,
+                       "shed_draining": shed_draining,
+                       "malformed": metrics.count("node_malformed_frames"),
+                       "handler_errors": metrics.count(
+                           "node_handler_errors")},
+            "pipeline": {
+                "pending": self.pipe.pending_count(),
+                "submitted": metrics.count_labeled("gossip_submitted"),
+                "accepted": metrics.count_labeled("gossip_accepted"),
+                "rejected": metrics.count_labeled("gossip_rejected"),
+                "shed": metrics.count_labeled("gossip_shed")},
+            "latency": {
+                "samples": len(lats),
+                "p50_ms": (round(_percentile(lats, 0.50) * 1e3, 3)
+                           if lats else None),
+                "p99_ms": (round(_percentile(lats, 0.99) * 1e3, 3)
+                           if lats else None)},
+            "store": {"time": int(self.store.time)},
+        }
+
+    def _dump_health(self, final: bool = False) -> None:
+        report = self.health()
+        report["final"] = final
+        path = os.path.join(self.config.data_dir, "health.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True)
+        os.replace(tmp, path)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def serve(self) -> int:
+        """Run until drained (SIGTERM / DRAIN frame).  Returns the exit
+        code (0 on a clean drain)."""
+        signal.signal(signal.SIGTERM,
+                      lambda *_: self.request_drain("SIGTERM"))
+        signal.signal(signal.SIGINT,
+                      lambda *_: self.request_drain("SIGINT"))
+        self.server.start()
+        self._pump.start()
+        self._dump_health()
+        next_health = self.clock.now() + self.config.health_every_s
+        while not self._draining.wait(timeout=0.2):
+            if self.clock.now() >= next_health:
+                self._dump_health()
+                next_health = self.clock.now() + self.config.health_every_s
+        self._shutdown()
+        return self._exit_code
+
+    def _shutdown(self) -> None:
+        # a stuck drain must not hang forever: hard-exit past deadline
+        watchdog = threading.Timer(self.config.drain_deadline_s,
+                                   os._exit, args=(1,))
+        watchdog.daemon = True
+        watchdog.start()
+        # 1. stop accepting; late messages now shed with "draining"
+        self.server.stop_accepting()
+        with self.scope():
+            faults.fire(DRAIN_SITE)         # the drill's drain barrier
+        # 2. flush: pump finishes the queue, then the pipeline windows
+        self._stopping = True
+        with self._cond:
+            self._cond.notify()
+        self._pump.join(timeout=self.config.drain_deadline_s)
+        with self.scope():
+            self.pipe.drain()
+        self._harvest()
+        # 3. fsync + close the journal BEFORE declaring drained
+        self.journal.close()
+        self.ctx.incidents.record(DRAIN_SITE, "drain_done")
+        self._dump_health(final=True)
+        self.server.close()
+        self._drain_done.set()
+        watchdog.cancel()

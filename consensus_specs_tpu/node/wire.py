@@ -1,0 +1,112 @@
+"""The front door's framed wire protocol.
+
+Every frame on the unix socket is::
+
+    MAGIC(4) | u32 body_len | u32 crc32c(body) | body
+
+with the body being one kind byte followed by a `txn.codec` value —
+the same tagged grammar the durable journal persists, so SSZ payloads
+cross the socket in their canonical serialization and decode back
+through the spec's `TypeResolver`.
+
+The contract the quick-tier tests pin (tests/test_node.py):
+
+* a TORN frame (any prefix of a valid frame) is not an error — the
+  reader waits for more bytes; leftover bytes at connection EOF are
+  the *peer's* torn tail and the server sheds them with an incident;
+* a MALFORMED frame (bad magic, oversize length, CRC flip, or a body
+  the codec rejects) raises `WireError` — never anything else — and
+  the server turns that into a shed response + incident, never a
+  crash.
+
+Frame kinds (client -> server unless noted):
+
+    M  message   (msg_id, topic, peer, payload)  -> async response
+    T  tick      int absolute store time         -> response
+    H  health    None                            -> health dict
+    R  root      None                            -> {"root": hex}
+    D  drain     None                            -> {"status": ...}
+    r  response  dict (server -> client)
+"""
+from __future__ import annotations
+
+import struct
+
+from ..txn.codec import CodecError, crc32c, decode_value, encode_value
+
+MAGIC = b"ND17"
+HEADER = struct.Struct("<4sII")
+# one frame carries at most one gossip message; 4 MiB is an order of
+# magnitude above the largest minimal-preset block we ever encode
+MAX_BODY = 4 << 20
+
+KIND_MESSAGE = "M"
+KIND_TICK = "T"
+KIND_HEALTH = "H"
+KIND_ROOT = "R"
+KIND_DRAIN = "D"
+KIND_RESPONSE = "r"
+KINDS = frozenset({KIND_MESSAGE, KIND_TICK, KIND_HEALTH, KIND_ROOT,
+                   KIND_DRAIN, KIND_RESPONSE})
+
+
+class WireError(ValueError):
+    """The only exception the wire layer raises: framing or body
+    damage.  The server's answer is always shed + incident."""
+
+
+def frame(kind: str, value) -> bytes:
+    assert kind in KINDS, kind
+    body = kind.encode("ascii") + encode_value(value)
+    assert len(body) <= MAX_BODY, "frame body over MAX_BODY"
+    return HEADER.pack(MAGIC, len(body), crc32c(body)) + body
+
+
+def encode_message(msg_id: int, topic: str, peer: str, payload) -> bytes:
+    return frame(KIND_MESSAGE, (int(msg_id), topic, peer, payload))
+
+
+def decode_body(body: bytes, resolver=None):
+    """-> (kind, value).  Raises WireError on any damage."""
+    if not body:
+        raise WireError("empty frame body")
+    kind = body[:1].decode("ascii", errors="replace")
+    if kind not in KINDS:
+        raise WireError(f"unknown frame kind {body[0]:#04x}")
+    try:
+        value = decode_value(body[1:], resolver)
+    except CodecError as exc:
+        raise WireError(f"undecodable {kind} body: {exc}") from exc
+    return kind, value
+
+
+class FrameReader:
+    """Incremental deframer: feed() raw socket bytes, get back complete
+    verified bodies.  A partial frame simply waits; `pending` says how
+    many bytes sit unconsumed (torn tail if the peer hangs up)."""
+
+    def __init__(self, max_body: int = MAX_BODY):
+        self._buf = bytearray()
+        self._max_body = int(max_body)
+
+    @property
+    def pending(self) -> int:
+        return len(self._buf)
+
+    def feed(self, data: bytes) -> list:
+        self._buf += data
+        bodies = []
+        while len(self._buf) >= HEADER.size:
+            magic, length, crc = HEADER.unpack_from(self._buf)
+            if magic != MAGIC:
+                raise WireError(f"bad magic {magic!r}")
+            if length > self._max_body:
+                raise WireError(f"oversized frame ({length} bytes)")
+            if len(self._buf) < HEADER.size + length:
+                break                       # torn: wait for the rest
+            body = bytes(self._buf[HEADER.size:HEADER.size + length])
+            del self._buf[:HEADER.size + length]
+            if crc32c(body) != crc:
+                raise WireError("frame CRC mismatch")
+            bodies.append(body)
+        return bodies

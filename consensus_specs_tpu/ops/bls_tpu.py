@@ -247,8 +247,49 @@ def fast_aggregate_verify_batch(pubkey_lists, messages, signatures):
     return results
 
 
+def _fold_coefficients_multi(prepared):
+    """64-bit nonzero Fiat-Shamir coefficients for an
+    AggregateVerifyBatch fold.  Multi-message transcript: each job
+    binds its slot, every (compressed pubkey, length-framed message)
+    pair IN ORDER, and the compressed signature — so permuting
+    pk/message pairs within a job, or moving a pair between jobs,
+    changes every coefficient."""
+    h = hashlib.sha256(b"aggregate-verify-fold-v1")
+    h.update(len(prepared).to_bytes(4, "little"))
+    for i, pk_points, msgs, sig in prepared:
+        h.update(i.to_bytes(4, "little"))
+        h.update(len(msgs).to_bytes(4, "little"))
+        for pk, msg in zip(pk_points, msgs):
+            h.update(cv.g1_to_bytes(pk))
+            h.update(len(msg).to_bytes(4, "little"))
+            h.update(msg)
+        h.update(cv.g2_to_bytes(sig))
+    seed = h.digest()
+    out = []
+    for i in range(len(prepared)):
+        x = int.from_bytes(
+            hashlib.sha256(seed + i.to_bytes(4, "little")).digest()[:8],
+            "little")
+        out.append(1 + x % (2**64 - 1))
+    return out
+
+
 def aggregate_verify_batch(pubkey_lists, message_lists, signatures):
-    """Batch of AggregateVerify jobs (distinct message per pubkey)."""
+    """Batch of AggregateVerify jobs (distinct message per pubkey).
+
+    With folding live (sigpipe/fold.py; ``FOLD_VERIFY=0`` restores the
+    per-job shape), the whole batch rides ONE job of
+    sum_i len(msgs_i) + 1 pairs: a per-job Fiat-Shamir coefficient
+    scales every pubkey leg of job i and its signature's contribution
+    to the folded S —
+
+        prod_i prod_j e(c_i*pk_ij, h_ij) * e(-g1, S),
+        S = sum_i c_i * sig_i
+
+    — with S folded through the ``ops.pairing_fold`` seam exactly like
+    the fast-aggregate path.  A passing product proves every job
+    valid; a failing one degrades to the exact per-job derivation so
+    per-job attribution is unchanged."""
     prepared = []   # (slot, pk_points, msgs, sig)
     results = [False] * len(pubkey_lists)
     neg_g1 = -cv.g1_generator()
@@ -267,12 +308,31 @@ def aggregate_verify_batch(pubkey_lists, message_lists, signatures):
     # one flat hash batch across all jobs, then regroup
     flat_msgs = [m for (_, _, msgs, _) in prepared for m in msgs]
     flat_hashes = hash_to_g2_batch(flat_msgs)
-    jobs = []
+    grouped = []
     pos = 0
-    for (_, pk_points, msgs, sig) in prepared:
-        hs = flat_hashes[pos:pos + len(msgs)]
+    for (_, _, msgs, _) in prepared:
+        grouped.append(flat_hashes[pos:pos + len(msgs)])
         pos += len(msgs)
-        jobs.append(list(zip(pk_points, hs)) + [(neg_g1, sig)])
+    from ..sigpipe import fold
+    if fold.live() and len(prepared) > 1:
+        coeffs = _fold_coefficients_multi(prepared)
+        S = fold.fold_signatures([sig for (_, _, _, sig) in prepared],
+                                 coeffs)
+        folded = []
+        for (_, pk_points, _, _), c, hs in zip(prepared, coeffs,
+                                               grouped):
+            folded.extend((pk * c, h) for pk, h in zip(pk_points, hs))
+        folded.append((neg_g1, S))
+        METRICS.observe("miller_loops_per_batch", len(folded))
+        if bool(_run_pairing_checks([folded])[0]):
+            for (i, *_) in prepared:
+                results[i] = True
+            return results
+        # >=1 job is invalid: exact per-job legs for attribution
+    jobs = [list(zip(pk_points, hs)) + [(neg_g1, sig)]
+            for (_, pk_points, _, sig), hs in zip(prepared, grouped)]
+    METRICS.observe("miller_loops_per_batch",
+                    sum(len(j) for j in jobs))
     for (i, *_), v in zip(prepared, _run_pairing_checks(jobs)):
         results[i] = bool(v)
     return results

@@ -210,6 +210,20 @@ REGISTRY: tuple[Site, ...] = (
          kind=BARRIER, chaos=UNIT, corrupt="none",
          note="before the manifest's atomic replace; "
               "scripts/factory_drill.py + tests/test_factory.py"),
+    # -- front-door barrier kill points: the long-lived node process's
+    #    serving path (node/).  UNIT tier — coverage is the
+    #    process-boundary SIGKILL drill through the real socket
+    #    (scripts/node_drill.py, `make node-drill`) plus the
+    #    in-process codec/drain tests.
+    Site("node.ingest", "consensus_specs_tpu.node.service",
+         kind=BARRIER, chaos=UNIT, corrupt="none",
+         note="before each socket message's pipeline submit; "
+              "scripts/node_drill.py + tests/test_node.py"),
+    Site("node.drain", "consensus_specs_tpu.node.service",
+         kind=BARRIER, chaos=UNIT, corrupt="none",
+         note="inside graceful drain, after accepts stop and before "
+              "the flush/fsync; scripts/node_drill.py + "
+              "tests/test_node.py"),
 )
 
 # speclint: disable=global-mutable-state -- name index over the frozen
@@ -412,6 +426,8 @@ class Concurrency:
 
 _PA = "consensus_specs_tpu.sigpipe.pipeline_async"
 _GP = "consensus_specs_tpu.gossip.pipeline"
+_NS = "consensus_specs_tpu.node.service"
+_NI = "consensus_specs_tpu.node.ingest"
 
 CONCURRENCY = Concurrency(
     locks=(
@@ -506,6 +522,28 @@ CONCURRENCY = Concurrency(
                       "the lock.  ops is outside the lock-discipline "
                       "pass scope, so the guard set is enforced by "
                       "review + the TSAN tracer, not listed here"),
+        # -- node: the front-door process ------------------------------
+        LockSpec("node.ingest", _NS, "_cond", cls="NodeService",
+                 kind="condition",
+                 guards=("_queue", "_shed_overload", "_shed_draining"),
+                 note="the bounded ingest queue (conn readers push, "
+                      "the pump pops) + overload counters; submits and "
+                      "verdict work happen OUTSIDE it on the pump"),
+        LockSpec("node.state", _NS, "_state_lock", cls="NodeService",
+                 kind="lock",
+                 guards=("_inflight", "_latencies", "_degraded"),
+                 note="pump-side verdict bookkeeping, read by health() "
+                      "from conn threads; never nested with node.ingest"),
+        LockSpec("node.conn", _NI, "_send_lock", cls="_Connection",
+                 kind="lock", guards=(),
+                 note="per-connection response writes (pump, conn "
+                      "reader, and evictions all answer on the same "
+                      "socket); sendall is the only guarded effect"),
+        LockSpec("node.server", _NI, "_lock", cls="IngestServer",
+                 kind="lock",
+                 guards=("_conns", "_next_id", "_accepting"),
+                 note="live-connection table shared by the accept loop "
+                      "and each conn reader's teardown"),
         # -- utils -----------------------------------------------------
         LockSpec("nodectx.stack", "consensus_specs_tpu.utils.nodectx",
                  "_lock", guards=("_stack",)),
@@ -535,6 +573,17 @@ CONCURRENCY = Concurrency(
                    "_SiteWorker._loop",
                    note="per-site daemon running watchdog'd dispatches; "
                         "abandoned on deadline expiry"),
+        ThreadRole("node-listener", _NI, "IngestServer._accept_loop",
+                   note="the front door's accept loop; spawns one "
+                        "node-conn reader per connection"),
+        ThreadRole("node-conn", _NI, "IngestServer._conn_loop",
+                   note="per-connection deframer/decoder; pushes work "
+                        "items onto the bounded ingest queue, never "
+                        "touches the pipeline or store"),
+        ThreadRole("node-pump", _NS, "NodeService._pump_loop",
+                   note="the ONLY thread that drives the node's "
+                        "pipeline/store: pops the ingest queue, submits "
+                        "under scope(), harvests verdicts"),
     ),
     handoffs=(
         Handoff("flush.ticket", _PA, "FlushTicket",
@@ -554,6 +603,15 @@ CONCURRENCY = Concurrency(
                 "consensus_specs_tpu.resilience.supervisor", "done",
                 note="the supervisor Event a watchdog'd caller waits "
                      "on; expiry abandons the worker"),
+        Handoff("node.ingest_queue", _NS, "_queue",
+                note="decoded socket frames cross from conn readers to "
+                     "the pump as queue items; FIFO is the front "
+                     "door's ordering contract, shed-oldest its "
+                     "overload contract"),
+        Handoff("node.respond", _NI, "respond",
+                note="each work item carries its connection's respond "
+                     "callable back to the pump; writes serialize "
+                     "under node.conn"),
     ),
 )
 

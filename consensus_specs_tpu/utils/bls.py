@@ -86,32 +86,71 @@ def _dispatch(site, device_fn, fallback_fn):
 # supervised fallback of every device dispatch (byte-identical semantics:
 # backend import errors surface, DecodeError/ValueError reads as invalid).
 
+class _Memo:
+    """Bounded FIFO memo over PURE primitives (sign and the scalar
+    verify oracles are functions of their byte inputs, nothing else).
+    The test tier rebuilds identical blocks from the cached genesis
+    state file after file, re-deriving byte-identical signatures and
+    verdicts hundreds of times at ~100 ms a pairing; the memo sits
+    BELOW the dispatch seam, so fault injection, supervision, and the
+    differential guard still fire on every call."""
+
+    _MISS = object()
+
+    def __init__(self, cap: int = 1 << 14):
+        self._store: dict = {}
+        self._cap = cap
+
+    def get(self, key, compute):
+        hit = self._store.get(key, self._MISS)
+        if hit is not self._MISS:
+            return hit
+        value = compute()
+        if len(self._store) >= self._cap:
+            self._store.pop(next(iter(self._store)))
+        self._store[key] = value
+        return value
+
+
+_SIGN_MEMO = _Memo()
+_VERIFY_MEMO = _Memo()
+
+
 def _native_verify(PK, message, signature):
-    n = _native()
-    try:
-        return n.Verify(bytes(PK), bytes(message), bytes(signature))
-    except ValueError:
-        return False
+    key = ("v", bytes(PK), bytes(message), bytes(signature))
+
+    def compute():
+        try:
+            return _native().Verify(key[1], key[2], key[3])
+        except ValueError:
+            return False
+    return _VERIFY_MEMO.get(key, compute)
 
 
 def _native_aggregate_verify(pubkeys, messages, signature):
-    n = _native()
-    try:
-        return n.AggregateVerify(
-            [bytes(pk) for pk in pubkeys],
-            [bytes(m) for m in messages], bytes(signature))
-    except ValueError:
-        return False
+    key = ("av", tuple(bytes(pk) for pk in pubkeys),
+           tuple(bytes(m) for m in messages), bytes(signature))
+
+    def compute():
+        try:
+            return _native().AggregateVerify(
+                list(key[1]), list(key[2]), key[3])
+        except ValueError:
+            return False
+    return _VERIFY_MEMO.get(key, compute)
 
 
 def _native_fast_aggregate_verify(pubkeys, message, signature):
-    n = _native()
-    try:
-        return n.FastAggregateVerify(
-            [bytes(pk) for pk in pubkeys], bytes(message),
-            bytes(signature))
-    except ValueError:
-        return False
+    key = ("fav", tuple(bytes(pk) for pk in pubkeys), bytes(message),
+           bytes(signature))
+
+    def compute():
+        try:
+            return _native().FastAggregateVerify(
+                list(key[1]), key[2], key[3])
+        except ValueError:
+            return False
+    return _VERIFY_MEMO.get(key, compute)
 
 
 @only_with_bls(alt_return=True)
@@ -227,7 +266,8 @@ def Aggregate(signatures):
 
 @only_with_bls(alt_return=STUB_SIGNATURE)
 def Sign(SK, message):
-    return _native().Sign(int(SK), bytes(message))
+    key = (int(SK), bytes(message))
+    return _SIGN_MEMO.get(key, lambda: _native().Sign(key[0], key[1]))
 
 
 @only_with_bls(alt_return=STUB_PUBKEY)

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""SIGKILL crash drills through the real front door.
+
+`scripts/kill_drill.py` kills an in-process txn workload;
+`scripts/factory_drill.py` kills the vector factory.  This drill
+kills the THING production traffic actually talks to: a real
+`scripts/run_node.py` process serving a scenario `TrafficPlan` over
+the framed unix socket at N× wall-clock rate.  For every registered
+barrier family in the serving path — the txn barriers
+(``txn.mutate``, ``txn.commit.apply``, ``txn.journal``,
+``txn.journal.fsync``) plus the node's own ingest/drain barriers
+(``node.ingest``, ``node.drain``) — the driver:
+
+1. spawns a node armed with ``--kill-site F --kill-nth N`` and
+   replays the smoke plan's canonical frame sequence at rate× until
+   the process SIGKILLs itself mid-load (for ``node.drain`` the kill
+   fires inside the graceful-drain sequence instead);
+2. restarts the same data dir (journal torn-tail repair + snapshot
+   replay through ``txn.recover``), re-replays the FULL sequence to a
+   fixpoint (re-offers are idempotent: duplicates shed, earlier
+   rejects retried), drains it gracefully, and
+3. asserts the recovered store root is byte-identical to the
+   in-process `apply_scalar` oracle run over the very same sequence.
+
+Usage:
+    python scripts/node_drill.py [--quick] [--rate R] [--scenario S]
+"""
+import argparse
+import os
+import shutil
+import signal
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+KILL_FAMILIES = ("txn.mutate", "txn.commit.apply", "txn.journal",
+                 "txn.journal.fsync", "node.ingest", "node.drain")
+
+
+def log(msg: str) -> None:
+    print(f"[node-drill] {msg}", flush=True)
+
+
+def stream_until_death(client_factory, proc, seq, rate):
+    """Replay frames until the node dies (expected: SIGKILL mid-load)
+    or the sequence ends.  Returns frames sent before death."""
+    from consensus_specs_tpu.node.client import replay_once
+    sent = 0
+    try:
+        client = client_factory()
+        stats = replay_once(client, seq, rate=rate)
+        sent = stats["sent"]
+        client.drain()                      # node.drain fires here
+        client.close()
+    except (OSError, ConnectionError):
+        pass
+    # wait for the process to finish dying (kill plans race the socket)
+    deadline = time.monotonic() + 60
+    while proc.poll() is None and time.monotonic() < deadline:
+        time.sleep(0.05)
+    if proc.poll() is None:
+        proc.kill()
+    return sent
+
+
+def run_case(site, nth, spec, seq, expect_root, rate, sock_dir) -> bool:
+    from consensus_specs_tpu.node.client import (NodeClient,
+                                                 converged_root,
+                                                 spawn_node)
+    data_dir = tempfile.mkdtemp(prefix="node-drill-")
+    sock = os.path.join(sock_dir, f"drill-{site.replace('.', '-')}.sock")
+    try:
+        proc = spawn_node(sock, data_dir, "--kill-site", site,
+                          "--kill-nth", nth, "--segment-bytes", 4096,
+                          "--snapshot-interval", 16)
+        stream_until_death(lambda: NodeClient(sock), proc, seq, rate)
+        rc = proc.wait()
+        killed = rc == -signal.SIGKILL
+        if not killed and rc != 0:
+            err = proc.stderr.read()[-2000:]
+            log(f"FAIL {site} nth={nth}: run leg died rc={rc}\n{err}")
+            return False
+        # restart the same dir: recovery through the door
+        proc2 = spawn_node(sock, data_dir)
+        client = NodeClient(sock, connect_timeout_s=60.0)
+        health = client.health()
+        root = converged_root(client, seq)
+        final = client.health()
+        client.drain()
+        client.close()
+        rc2 = proc2.wait(timeout=120)
+        if rc2 != 0:
+            err = proc2.stderr.read()[-2000:]
+            log(f"FAIL {site} nth={nth}: recovered node exited "
+                f"rc={rc2}\n{err}")
+            return False
+        if root != expect_root:
+            log(f"FAIL {site} nth={nth}: recovered root {root[:16]}… "
+                f"!= oracle {expect_root[:16]}…")
+            return False
+        if final["ingest"]["shed_overload"]:
+            log(f"FAIL {site} nth={nth}: overload shed during recovery "
+                f"leg masks byte-identity")
+            return False
+        log(f"ok   {site:<18} nth={nth} "
+            f"{'SIGKILL' if killed else 'survived'} "
+            f"recovered={health['recovered']} "
+            f"accepted={final['pipeline']['accepted']} "
+            f"root={root[:16]}…")
+        return True
+    finally:
+        shutil.rmtree(data_dir, ignore_errors=True)
+        if os.path.exists(sock):
+            os.unlink(sock)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--quick", action="store_true",
+                   help="one kill per barrier family instead of two")
+    p.add_argument("--rate", type=float, default=20.0,
+                   help="wall-clock compression of the plan timeline")
+    p.add_argument("--scenario", default="smoke")
+    p.add_argument("--seed", type=int, default=1)
+    args = p.parse_args()
+
+    from consensus_specs_tpu.node.client import (build_plan, oracle_root,
+                                                 replay_sequence)
+    spec, plan = build_plan(args.scenario, args.seed)
+    seq = replay_sequence(plan)
+    expect = oracle_root(spec, plan)
+    log(f"oracle: {len(seq)} frames, root {expect[:16]}…")
+
+    sock_dir = tempfile.mkdtemp(prefix="node-drill-sock-")
+    nths = (1,) if args.quick else (1, 3)
+    ok = True
+    try:
+        for site in KILL_FAMILIES:
+            for nth in nths:
+                ok &= run_case(site, nth, spec, seq, expect,
+                               args.rate, sock_dir)
+    finally:
+        shutil.rmtree(sock_dir, ignore_errors=True)
+    log("PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

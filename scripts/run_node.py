@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Run one long-lived node serving the framed unix-socket front door.
+
+    python scripts/run_node.py --socket /tmp/node.sock --dir /tmp/node
+
+The process serves until SIGTERM / SIGINT / a DRAIN frame, then
+drains gracefully (stop accepting, flush in-flight windows, fsync the
+journal) and exits 0 within --drain-deadline.  SIGKILL it instead and
+the same --dir recovers on the next start through txn.open_dir (torn
+tail repair) + txn.recover.
+
+--kill-site/--kill-nth arm the drill's in-process SIGKILL plan: the
+process shoots itself at the nth consultation of the named barrier
+(see scripts/node_drill.py).
+"""
+import argparse
+import os
+import signal
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--socket", required=True)
+    p.add_argument("--dir", required=True)
+    p.add_argument("--fork", default="altair")
+    p.add_argument("--preset", default="minimal")
+    p.add_argument("--fsync", default="marker_only",
+                   choices=("always", "marker_only", "never"))
+    p.add_argument("--segment-bytes", type=int, default=1 << 16)
+    p.add_argument("--snapshot-interval", type=int, default=64)
+    p.add_argument("--ingest-bound", type=int, default=4096)
+    p.add_argument("--health-every", type=float, default=5.0)
+    p.add_argument("--drain-deadline", type=float, default=30.0)
+    p.add_argument("--real-bls", action="store_true",
+                   help="verify with real BLS (default: stubbed)")
+    p.add_argument("--kill-site", default=None,
+                   help="SIGKILL self at this barrier (drill mode)")
+    p.add_argument("--kill-nth", type=int, default=1)
+    args = p.parse_args()
+
+    from consensus_specs_tpu.node import NodeConfig, NodeService
+
+    service = NodeService(NodeConfig(
+        socket_path=args.socket, data_dir=args.dir,
+        fork=args.fork, preset=args.preset, fsync_policy=args.fsync,
+        segment_bytes=args.segment_bytes,
+        snapshot_interval=args.snapshot_interval,
+        ingest_bound=args.ingest_bound,
+        health_every_s=args.health_every,
+        drain_deadline_s=args.drain_deadline,
+        stub_bls=not args.real_bls))
+
+    if args.kill_site:
+        from consensus_specs_tpu.resilience import faults
+
+        class KillPlan(faults.FaultPlan):
+            """SIGKILL this process at the nth consultation of one
+            node/txn barrier — the drill's crash injector."""
+
+            def __init__(self, site, nth):
+                super().__init__([], seed=0)
+                self._target = site
+                self._nth = int(nth)
+                self._count = 0
+
+            def decide(self, site):
+                if site == self._target:
+                    self._count += 1
+                    if self._count >= self._nth:
+                        os.kill(os.getpid(), signal.SIGKILL)
+                return None
+
+        # arm on the node's OWN fault-plan slot: under nodectx.use the
+        # router resolves through the context, so a globally injected
+        # plan would be masked
+        service.ctx.fault_plan.value = KillPlan(args.kill_site,
+                                                args.kill_nth)
+
+    print(f"[node] pid={os.getpid()} socket={args.socket} "
+          f"dir={args.dir} recovered={service.recovered}", flush=True)
+    rc = service.serve()
+    print(f"[node] drained, exit {rc}", flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,14 @@ After every round the rolling health report is rewritten atomically
 (tmp+rename), so a soak killed mid-flight still leaves a valid JSON
 snapshot of everything it proved up to that point.
 
+With SOAK_NODE=1 every third round rides the REAL front-door process
+instead of in-process SimNodes: spawn `scripts/run_node.py`, replay
+the smoke TrafficPlan over its unix socket at 20× wall-clock rate,
+SIGKILL it at a seeded barrier family mid-load, restart the same data
+dir, and assert the recovered store root converges byte-identically
+to the in-process oracle — the nightly-soak shape of `make
+node-drill`.
+
 Environment:
     SOAK_SECONDS     wall-clock budget (default 300); the current
                      round always finishes
@@ -28,7 +36,9 @@ Environment:
                      budget (default 3)
     SOAK_SEED        master seed (default 20260804)
     SOAK_NODES       fixed node count for randomized rounds (optional)
-    SOAK_REPORT      report path (default SOAK_r01.json)
+    SOAK_NODE        1 = interleave real-process front-door rounds
+    SOAK_REPORT      report path (default: the next free SOAK_r0N.json
+                     — per-run reports archive instead of overwriting)
 
 Exit status: 0 with `"ok": true` in the report, 1 on any violated
 contract (the report records the failure first).  Under SPECLINT_TSAN=1
@@ -77,9 +87,34 @@ INCIDENT_SATURATION = 1 << 14
 DISK_DRIFT_FACTOR = 2.0
 
 
+# barrier families the real-process round may SIGKILL at (the same
+# set scripts/node_drill.py sweeps exhaustively; the soak samples one
+# per node round, seeded)
+NODE_KILL_FAMILIES = (
+    "txn.mutate",
+    "txn.commit.apply",
+    "txn.journal",
+    "txn.journal.fsync",
+    "node.ingest",
+    "node.drain",
+)
+
+
 def _env_int(name: str, default: int) -> int:
     raw = os.environ.get(name, "")
     return int(raw) if raw else default
+
+
+def _next_report_path() -> str:
+    """SOAK_REPORT wins; otherwise archive under the next free
+    SOAK_r0N.json so successive soaks never overwrite each other."""
+    explicit = os.environ.get("SOAK_REPORT", "")
+    if explicit:
+        return explicit
+    n = 1
+    while os.path.exists(f"SOAK_r{n:02d}.json"):
+        n += 1
+    return f"SOAK_r{n:02d}.json"
 
 
 def _round_scenario(index: int, rng: random.Random):
@@ -134,6 +169,83 @@ def _run_round(sc, seed: int) -> dict:
     }
 
 
+def _run_node_round(seed: int) -> dict:
+    """One real-process front-door round: spawn scripts/run_node.py,
+    replay the smoke TrafficPlan over the unix socket under load,
+    SIGKILL the process at a seeded barrier family, restart the same
+    data dir, and assert the recovered store converges byte-identically
+    to the in-process oracle."""
+    import shutil
+    import signal
+    import tempfile
+
+    from consensus_specs_tpu.node.client import (
+        NodeClient, build_plan, converged_root, oracle_root,
+        replay_once, replay_sequence, spawn_node)
+
+    rng = random.Random(seed)
+    site = rng.choice(NODE_KILL_FAMILIES)
+    nth = rng.randint(1, 3)
+    spec, plan = build_plan("smoke", seed)
+    seq = replay_sequence(plan)
+    expect = oracle_root(spec, plan)
+
+    work = tempfile.mkdtemp(prefix="soak-node-")
+    sock = os.path.join(work, "node.sock")
+    data = os.path.join(work, "data")
+    t0 = time.monotonic()
+    try:
+        proc = spawn_node(
+            sock, data, "--kill-site", site, "--kill-nth", str(nth),
+            "--segment-bytes", "4096", "--snapshot-interval", "8")
+        try:
+            client = NodeClient(sock, connect_timeout_s=120)
+            replay_once(client, seq, rate=20.0)
+            client.drain()
+            client.close()
+        except (OSError, ConnectionError):
+            pass        # the armed SIGKILL tore the socket mid-replay
+        rc = proc.wait(timeout=180)
+        killed = rc == -signal.SIGKILL
+        assert killed or rc == 0, \
+            f"node round: load leg exited rc={rc} (expected SIGKILL " \
+            f"or clean drain): {proc.stderr.read() if proc.stderr else ''}"
+
+        proc2 = spawn_node(sock, data)
+        client = NodeClient(sock, connect_timeout_s=120)
+        root = converged_root(client, seq)
+        health = client.health()
+        client.drain()
+        client.close()
+        rc2 = proc2.wait(timeout=180)
+        assert rc2 == 0, \
+            f"node round: recovery leg exited rc={rc2}: " \
+            f"{proc2.stderr.read() if proc2.stderr else ''}"
+        assert root == expect, \
+            f"node round diverged after SIGKILL at {site}#{nth}: " \
+            f"recovered {root} != oracle {expect}"
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    return {
+        "scenario": "node:smoke",
+        "seed": seed,
+        "nodes": 1,
+        "events": 0,
+        "feed_size": len(seq),
+        "disk_hw_bytes": int(health["journal"]["disk_bytes"]),
+        "segments_at_end": int(health["journal"]["segments"]),
+        "compactions": 0,
+        "faults_per_node": {"node0": 1 if killed else 0},
+        "breaker_trips": 0,
+        "breaker_restores": 0,
+        "kill_site": site,
+        "kill_nth": nth,
+        "killed": killed,
+        "recovered": bool(health["recovered"]),
+        "node_round_s": round(time.monotonic() - t0, 3),
+    }
+
+
 def _write_report(path: str, payload: dict) -> None:
     tmp = f"{path}.tmp"
     with open(tmp, "w") as fh:
@@ -146,7 +258,8 @@ def main() -> int:
     budget_s = _env_int("SOAK_SECONDS", 300)
     min_rounds = _env_int("SOAK_MIN_ROUNDS", 3)
     master_seed = _env_int("SOAK_SEED", 20260804)
-    report_path = os.environ.get("SOAK_REPORT", "SOAK_r01.json")
+    node_leg = os.environ.get("SOAK_NODE", "") == "1"
+    report_path = _next_report_path()
     rng = random.Random(master_seed)
 
     started = time.monotonic()
@@ -193,9 +306,12 @@ def main() -> int:
     try:
         while index < min_rounds or time.monotonic() < deadline:
             seed = master_seed + index
-            sc = _round_scenario(index, rng)
             t0 = time.monotonic()
-            entry = _run_round(sc, seed)
+            if node_leg and index % 3 == 2:
+                entry = _run_node_round(seed)
+            else:
+                sc = _round_scenario(index, rng)
+                entry = _run_round(sc, seed)
             entry["round"] = index + 1
             entry["round_s"] = round(time.monotonic() - t0, 3)
             rounds.append(entry)

@@ -59,6 +59,72 @@ def test_aggregate_verify_parity():
     assert got == want == [True, False]
 
 
+def test_aggregate_verify_fold_legs_and_parity(monkeypatch):
+    """An all-valid AggregateVerify batch rides ONE fold job of
+    sum_i len(msgs_i) + 1 pairs; FOLD_VERIFY=0 restores the per-job
+    len(msgs_i)+1 legs with byte-identical verdicts."""
+    from consensus_specs_tpu.sigpipe import METRICS, fold
+
+    msgs = [bytes([i]) * 32 for i in range(len(SKS))]
+    sigs = [native.Sign(sk, m) for sk, m in zip(SKS, msgs)]
+    jobs = [(PKS, msgs, native.Aggregate(sigs)),
+            (PKS[:2], msgs[:2], native.Aggregate(sigs[:2]))]
+
+    def run():
+        METRICS.reset()
+        got = bls_tpu.aggregate_verify_batch(
+            [j[0] for j in jobs], [j[1] for j in jobs],
+            [j[2] for j in jobs])
+        return got, METRICS.snapshot()["miller_loops_per_batch"]
+
+    try:
+        monkeypatch.delenv("FOLD_VERIFY", raising=False)
+        fold.reset_mode()
+        folded, obs = run()
+        assert folded == [True, True]
+        # one observation: the whole batch was one (sum(len)+1)-pair job
+        assert obs["count"] == 1
+        assert obs["total"] == (4 + 2) + 1
+
+        monkeypatch.setenv("FOLD_VERIFY", "0")
+        fold.reset_mode()
+        flat, obs_off = run()
+        assert flat == folded
+        assert obs_off["count"] == 1
+        assert obs_off["total"] == (4 + 1) + (2 + 1)
+    finally:
+        monkeypatch.delenv("FOLD_VERIFY", raising=False)
+        fold.reset_mode()
+        METRICS.reset()
+
+
+def test_aggregate_verify_fold_failure_keeps_per_job_attribution():
+    """A bad job in the batch fails the folded product; the exact
+    per-job derivation then attributes True/False per slot, matching
+    the oracle byte-for-byte."""
+    from consensus_specs_tpu.sigpipe import METRICS, fold
+
+    msgs = [bytes([i]) * 32 for i in range(len(SKS))]
+    sigs = [native.Sign(sk, m) for sk, m in zip(SKS, msgs)]
+    agg = native.Aggregate(sigs)
+    fold.reset_mode()
+    METRICS.reset()
+    try:
+        got = bls_tpu.aggregate_verify_batch(
+            [PKS, PKS], [msgs, msgs[::-1]], [agg, agg])
+        want = [native.AggregateVerify(PKS, msgs, agg),
+                native.AggregateVerify(PKS, msgs[::-1], agg)]
+        assert got == want == [True, False]
+        if fold.live():
+            # fold attempt (9 legs) + exact fallback (10 legs)
+            obs = METRICS.snapshot()["miller_loops_per_batch"]
+            assert obs["count"] == 2
+            assert obs["total"] == (4 + 4 + 1) + (4 + 1) * 2
+    finally:
+        fold.reset_mode()
+        METRICS.reset()
+
+
 def test_shim_backend_switch():
     shim.use_tpu()
     try:

@@ -1,0 +1,216 @@
+"""Front-door quick-tier contracts (node/wire.py + node/service.py).
+
+The process-level legs — SIGKILL at every barrier family, N-times
+overload through a real socket — live in scripts/node_drill.py and the
+`node` bench tier.  This file pins the two in-process contracts the
+drill assumes:
+
+* WIRE DAMAGE IS NEVER AN EXCEPTION: a frame torn at any offset waits;
+  a frame malformed at any byte (magic, length, CRC, kind, body)
+  raises `WireError` and nothing else, and the service answers damage
+  with a shed response + incident;
+* GRACEFUL DRAIN ORDERS ITS STEPS: once drain begins no new message
+  reaches the pipeline (late arrivals shed with ``draining``), the
+  journal is fsynced and closed before ``drain_done`` is declared, and
+  the drained store root is byte-identical to the sequential oracle.
+"""
+import tempfile
+import time
+
+import pytest
+
+from consensus_specs_tpu.node import wire
+from consensus_specs_tpu.node.client import (
+    build_plan, oracle_root, replay_sequence)
+from consensus_specs_tpu.node.service import (
+    DRAIN_SITE, NodeConfig, NodeService)
+
+
+# -- wire codec ---------------------------------------------------------
+
+def _frames():
+    return [
+        (wire.KIND_TICK, (1, 12345)),
+        (wire.KIND_MESSAGE, (7, "beacon_block", "origin0", b"\x2a" * 48)),
+        (wire.KIND_HEALTH, 3),
+        (wire.KIND_ROOT, 4),
+        (wire.KIND_DRAIN, 5),
+        (wire.KIND_RESPONSE, {"id": 7, "status": "ok"}),
+    ]
+
+
+def test_wire_round_trip_every_kind():
+    reader = wire.FrameReader()
+    blob = b"".join(wire.frame(k, v) for k, v in _frames())
+    bodies = reader.feed(blob)
+    assert reader.pending == 0
+    got = [wire.decode_body(b) for b in bodies]
+    assert got == _frames()
+
+
+def test_wire_torn_at_every_offset_waits_then_completes():
+    """A prefix of a valid stream is never an error: the reader holds
+    the tail and completes once the rest arrives — at EVERY split."""
+    blob = wire.frame(wire.KIND_TICK, (1, 42)) + \
+        wire.frame(wire.KIND_MESSAGE, (2, "t", "p", b"\x01" * 9))
+    for cut in range(len(blob) + 1):
+        reader = wire.FrameReader()
+        first = reader.feed(blob[:cut])
+        assert len(first) <= 2
+        rest = reader.feed(blob[cut:])
+        assert reader.pending == 0
+        got = [wire.decode_body(b) for b in first + rest]
+        assert got == [(wire.KIND_TICK, (1, 42)),
+                       (wire.KIND_MESSAGE, (2, "t", "p", b"\x01" * 9))]
+
+
+def test_wire_flip_at_every_offset_is_wireerror_or_wait():
+    """Corrupt any single byte of a frame: the reader either raises
+    WireError (magic/length/CRC damage) or keeps waiting (the flip
+    inflated the length) — never any other exception, and never a
+    silently delivered frame."""
+    good = wire.frame(wire.KIND_TICK, (9, 77))
+    for i in range(len(good)):
+        bad = bytearray(good)
+        bad[i] ^= 0xFF
+        reader = wire.FrameReader()
+        try:
+            bodies = reader.feed(bytes(bad))
+        except wire.WireError:
+            continue
+        assert bodies == [] and reader.pending > 0, \
+            f"flip at offset {i} delivered a corrupt frame"
+
+
+def test_wire_bad_kind_and_poisoned_body_are_wireerror():
+    raw = b"Z" + b"\x00\x01"                # unknown kind byte
+    framed = wire.HEADER.pack(wire.MAGIC, len(raw),
+                              wire.crc32c(raw)) + raw
+    [body] = wire.FrameReader().feed(framed)
+    with pytest.raises(wire.WireError):
+        wire.decode_body(body)
+    raw = b"M" + b"\xff\xff\xff"            # codec-rejected body
+    framed = wire.HEADER.pack(wire.MAGIC, len(raw),
+                              wire.crc32c(raw)) + raw
+    [body] = wire.FrameReader().feed(framed)
+    with pytest.raises(wire.WireError):
+        wire.decode_body(body)
+    with pytest.raises(wire.WireError):
+        wire.FrameReader(max_body=16).feed(
+            wire.frame(wire.KIND_MESSAGE, (1, "t", "p", b"\x00" * 64)))
+
+
+# -- service ------------------------------------------------------------
+
+@pytest.fixture
+def service():
+    work = tempfile.mkdtemp(prefix="node-test-")
+    svc = NodeService(NodeConfig(
+        socket_path=f"{work}/node.sock", data_dir=f"{work}/data",
+        segment_bytes=4096, snapshot_interval=16, ingest_bound=64))
+    try:
+        yield svc
+    finally:
+        if svc._bls_guard is not None:
+            svc._bls_guard.__exit__(None, None, None)
+        svc.server.close()
+        svc.journal.close()
+        import shutil
+        shutil.rmtree(work, ignore_errors=True)
+
+
+def test_service_sheds_malformed_shapes_without_raising(service):
+    """Every shape violation answers shed + incident, no exception."""
+    responses = []
+    bad = [
+        (wire.KIND_HEALTH, "not an int"),
+        (wire.KIND_DRAIN, b"nope"),
+        (wire.KIND_TICK, (1, 2, 3)),
+        (wire.KIND_TICK, "late"),
+        (wire.KIND_ROOT, None),
+        (wire.KIND_MESSAGE, (1, "beacon_block")),
+        (wire.KIND_MESSAGE, ("id", "beacon_block", "p", b"")),
+        (wire.KIND_MESSAGE, (1, "no_such_topic", "p", b"")),
+        ("x", None),
+    ]
+    for kind, value in bad:
+        service.handle(kind, value, responses.append)
+    assert [r["status"] for r in responses] == ["shed"] * len(bad)
+    assert service.ctx.metrics.count("node_malformed_frames") == len(bad)
+    assert service.ctx.incidents.count("malformed_frame") == len(bad)
+    assert not service._draining.is_set()    # the bad drain didn't drain
+
+
+def _pump_until_idle(service, deadline_s=60):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        with service._cond:
+            empty = not service._queue
+        with service._state_lock:
+            inflight = len(service._inflight)
+        if empty and not inflight:
+            return
+        time.sleep(0.02)
+    raise AssertionError("pump never went idle")
+
+
+def test_graceful_drain_ordering_and_oracle_root(service):
+    """No intent is accepted after drain begins; the journal is fsynced
+    and closed before drain_done; the drained root matches the oracle."""
+    service._pump.start()
+    spec, plan = build_plan("smoke", 1)
+    seq = replay_sequence(plan)
+    responses = []
+    roots = []
+
+    def replay_pass():
+        nid = [len(responses) * 1000]
+
+        def offer(item):
+            nid[0] += 1
+            if item[0] == "tick":
+                service.handle(wire.KIND_TICK, (nid[0], item[1]),
+                               responses.append)
+            else:
+                service.handle(wire.KIND_MESSAGE,
+                               (nid[0], item[1], item[3], item[2]),
+                               responses.append)
+        for item in seq:
+            offer(item)
+        _pump_until_idle(service)
+        service.handle(wire.KIND_ROOT, nid[0] + 1,
+                       lambda r: roots.append(r["root"]))
+        _pump_until_idle(service)
+
+    replay_pass()
+    for _ in range(3):                       # fixpoint, like the drill
+        if len(roots) >= 2 and roots[-1] == roots[-2]:
+            break
+        replay_pass()
+    assert roots[-1] == oracle_root(spec, plan)
+
+    # -- drain begins: late arrivals shed, nothing reaches the pipeline
+    service.request_drain("test")
+    submitted = service.ctx.metrics.count_labeled("gossip_submitted")
+    late = []
+    msg = next(i for i in seq if i[0] == "msg")
+    service.handle(wire.KIND_MESSAGE, (99999, msg[1], msg[3], msg[2]),
+                   late.append)
+    assert late == [{"id": 99999, "status": "shed", "detail": "draining"}]
+    assert service.ctx.metrics.count_labeled("gossip_submitted") \
+        == submitted
+
+    service._shutdown()
+    # journal fsynced + closed BEFORE drain_done was declared
+    assert service.journal._seg_fh is None
+    assert service.journal._dirty is False
+    health = service.health()
+    assert health["journal"]["fsyncs"] > 0
+    assert health["ingest"]["shed_draining"] == 1
+    drain_events = [e["event"]
+                    for e in service.ctx.incidents.snapshot()
+                    if e["site"] == DRAIN_SITE]
+    assert drain_events == ["drain_begin", "drain_done"]
+    # the drained store still carries the oracle bytes
+    from consensus_specs_tpu import txn
+    assert txn.store_root(service.store).hex() == oracle_root(spec, plan)

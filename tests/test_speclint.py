@@ -325,19 +325,48 @@ def test_factoryseam_flags_crypto_import_and_scalar_verb(tmp_path):
         def sneaky(pk, msg, sig):
             return bls12_381.Verify(pk, msg, sig)
     """)
+    # a forced fixture file is in scope for BOTH seam gates: the
+    # factory pass and the node pass each flag the import + the verb
     assert rules_of(findings) == ["factory-scalar-bypass",
-                                  "factory-scalar-bypass"]
-    assert [f.line for f in findings] == [1, 4]
+                                  "node-scalar-bypass",
+                                  "factory-scalar-bypass",
+                                  "node-scalar-bypass"]
+    assert [f.line for f in findings] == [1, 1, 4, 4]
     assert "scalar" in findings[0].message
 
 
 def test_factoryseam_disable_suppresses(tmp_path):
     findings = lint_snippet(tmp_path, """\
         def deliberate(pairs):
-            # speclint: disable=factory-scalar-bypass -- fixture reason
+            # speclint: disable=factory-scalar-bypass,node-scalar-bypass -- fixture reason
             return pairing_check(pairs)
     """)
     assert findings == []
+
+
+def test_nodeseam_filtered_pass_flags_both_shapes(tmp_path):
+    """The node seam gate alone: crypto import + scalar verb, same
+    shapes as the factory gate, its own rule id."""
+    path = tmp_path / "fixture.py"
+    path.write_text(textwrap.dedent("""\
+        from consensus_specs_tpu.crypto import bls12_381
+
+        def sneaky(pk, msg, sig):
+            return bls12_381.FastAggregateVerify([pk], msg, sig)
+    """))
+    findings = run_speclint(REPO_ROOT, [path], passes=["nodeseam"])
+    assert rules_of(findings) == ["node-scalar-bypass",
+                                  "node-scalar-bypass"]
+    assert [f.line for f in findings] == [1, 4]
+    assert "pipeline" in findings[0].message
+
+
+def test_nodeseam_repo_is_clean():
+    """The live node package honours its own gate: the front door
+    verifies only by feeding the admission pipeline."""
+    repo_findings = [f for f in run_speclint(REPO_ROOT)
+                     if f.rule == "node-scalar-bypass"]
+    assert repo_findings == []
 
 
 def test_factoryseam_repo_is_clean():
@@ -676,7 +705,7 @@ def test_pass_filter_and_names():
     assert names == ("seams", "bypass", "determinism", "globals",
                      "txnpurity", "hostsync", "lock-discipline",
                      "lock-order", "thread-escape", "foldgate",
-                     "factoryseam")
+                     "factoryseam", "nodeseam")
     # a filtered run executes only the named pass
     findings = run_speclint(REPO_ROOT, passes=["lock-order"])
     assert findings == []
