@@ -17,7 +17,8 @@ RUNNERS := shuffling ssz_static operations epoch_processing sanity bls \
 	speclint native pyspec bench \
 	gossip-bench txn-bench msm-bench merkle-bench scenario-bench \
 	multichip-bench pipeline-bench fold-bench factory-bench \
-	factory-drill node-drill node-bench gen_all detect_errors \
+	factory-drill node-drill node-bench mesh-drill mesh-bench \
+	gen_all detect_errors \
 	$(addprefix gen_,$(RUNNERS))
 
 # syntax/bytecode check over every package and script (the CI lint job)
@@ -97,6 +98,7 @@ recovery-chaos:
 	env JAX_PLATFORMS=cpu SPECLINT_TSAN=1 SOAK_SECONDS=45 \
 		$(PYTHON) scripts/soak.py
 	env JAX_PLATFORMS=cpu $(PYTHON) scripts/node_drill.py --quick
+	env JAX_PLATFORMS=cpu $(PYTHON) scripts/mesh_drill.py --quick
 
 # wall-clock soak runner (scripts/soak.py): loop durable fleet
 # scenarios — the blackout3 SIGKILL battlefield alternating with
@@ -146,6 +148,19 @@ factory-drill:
 node-drill:
 	env JAX_PLATFORMS=cpu $(PYTHON) scripts/node_drill.py \
 		$(NODE_DRILL_ARGS)
+
+# the process-mesh drill (scripts/mesh_drill.py): scenario-library
+# partition / SIGKILL / link-corruption timelines against three REAL
+# run_node.py processes meshed over their framed unix sockets — PEERS
+# frames impose the partition on the link layer, anti-entropy replays
+# what a dead or isolated node missed, and every surviving node must
+# converge byte-identically to the in-process oracle with each fault
+# attributed in the right node's incident book and no process or
+# socket leaked.  MESH_DRILL_ARGS=--quick runs the partition+heal case
+# alone (also the recovery-chaos leg).
+mesh-drill:
+	env JAX_PLATFORMS=cpu $(PYTHON) scripts/mesh_drill.py \
+		$(MESH_DRILL_ARGS)
 
 # async flush engine slow tier under the runtime lock sanitizer: the
 # full overlapped-flush fault matrix with every named lock traced, so
@@ -264,6 +279,15 @@ factory-bench:
 # BENCH_NODE_RATE=10 BENCH_NODE_PASSES=1 give a smoke run
 node-bench:
 	env JAX_PLATFORMS=cpu $(PYTHON) bench.py node
+
+# fleet front-door bench (mesh/): three real run_node.py processes in
+# a full mesh — the partition+heal drill timeline with zero divergence
+# and per-hop p50/p99 admission→delivery latency, then a partition
+# flood against a tiny ingest bound asserting bounded shed, surviving
+# processes, and byte-identical post-heal convergence; emits
+# MESH_r01.json.  BENCH_MESH_SEED / BENCH_MESH_PASSES tune it
+mesh-bench:
+	env JAX_PLATFORMS=cpu $(PYTHON) bench.py mesh
 
 # static pattern rule: GNU make refuses to run implicit pattern rules
 # for .PHONY targets

@@ -2311,6 +2311,137 @@ def bench_node():
     }
 
 
+MESH_SEED = int(os.environ.get("BENCH_MESH_SEED", "1"))
+MESH_FLOOD_PASSES = int(os.environ.get("BENCH_MESH_PASSES", "3"))
+MESH_JSON = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "MESH_r01.json")
+
+
+def bench_mesh():
+    """Fleet front door (mesh/ + scenario/processes.py): three REAL
+    run_node.py processes in a full mesh over their unix sockets.
+    Two legs: (1) the partition+heal drill timeline, asserting zero
+    divergence — every node's served root byte-identical to the
+    in-process oracle — while reporting fleet throughput and each
+    node's admission→delivery (per-hop) p50/p99; (2) a partition
+    flood: with one node isolated by PEERS frames and a tiny ingest
+    bound armed fleet-wide, BENCH_MESH_PASSES full-speed replays slam
+    the majority side — the queues must stay at or under their bound
+    (shed-oldest, never unbounded), every process must survive and
+    keep answering health, and after a heal the fleet must still
+    converge byte-identically.  Emits MESH_r01.json."""
+    from consensus_specs_tpu.scenario.processes import (
+        MESH_PART, MESH_SMOKE, ProcessMesh, run_scenario_processes)
+
+    t_start = time.perf_counter()
+
+    def mark(msg):
+        log(f"[bench] mesh +{time.perf_counter() - t_start:5.1f}s: "
+            f"{msg}")
+
+    # -- leg 1: drill timeline — zero divergence + per-hop latency
+    report = run_scenario_processes(MESH_PART, seed=MESH_SEED)
+    assert report["converged"], \
+        f"mesh leg diverged: oracle {report['oracle'][:16]}… vs " \
+        f"{[r[:16] for r in report['roots']]}"
+    assert not report["orphan_procs"] and not report["orphan_sockets"], \
+        "mesh leg leaked processes or sockets"
+    nodes = report["nodes"]
+    accepted = sum(n["health"]["pipeline"]["accepted"]
+                   for n in nodes.values())
+    forwarded = sum(n["health"]["mesh"]["forwarded"]
+                    for n in nodes.values())
+    fleet_rate = round(accepted / report["wall_s"], 1)
+    hops = {name: {"p50_ms": n["health"]["latency"]["p50_ms"],
+                   "p99_ms": n["health"]["latency"]["p99_ms"]}
+            for name, n in nodes.items()}
+    hop_p99 = max(h["p99_ms"] for h in hops.values())
+    mark(f"drill: {accepted} admissions fleet-wide "
+         f"({fleet_rate}/s incl. spawn), {forwarded} forwards, "
+         f"worst per-hop p99 {hop_p99}ms, zero divergence")
+
+    # -- leg 2: partition flood against a tiny ingest bound
+    bound = 64
+    mesh = ProcessMesh(
+        MESH_SMOKE, seed=MESH_SEED,
+        extra_args={i: ("--ingest-bound", str(bound)) for i in range(3)})
+    with mesh:
+        mesh.run()
+        # isolate node2 by hand and slam the majority side full speed
+        mesh.blocked[0] = {"node2"}
+        mesh.blocked[1] = {"node2"}
+        mesh.blocked[2] = {"node0", "node1"}
+        mesh._push_partition_view(mesh.up_nodes())
+        client = mesh.clients[0]
+        t0 = time.perf_counter()
+        sent = 0
+        for _ in range(MESH_FLOOD_PASSES):
+            for planned in mesh.plan.messages:
+                client.send_message(planned.topic, planned.payload,
+                                    peer=f"origin{planned.origin}")
+                client.drain_responses()
+                sent += 1
+        client.root()                    # drain the flooded pipeline
+        flood_wall = time.perf_counter() - t0
+        healths = {f"node{i}": mesh.clients[i].health()
+                   for i in mesh.up_nodes()}
+        shed = 0
+        for name, health in healths.items():
+            assert health["ingest"]["depth"] <= bound, \
+                f"{name}: queue over bound under flood " \
+                f"({health['ingest']['depth']})"
+            assert health["rss_kb"] < 8 * 1024 * 1024, \
+                f"{name}: RSS unbounded ({health['rss_kb']} kB)"
+            shed += health["ingest"]["shed_overload"]
+        # heal and converge: the flood must not have wedged the fleet
+        for s in mesh.blocked:
+            s.clear()
+        mesh._push_partition_view(mesh.up_nodes())
+        oracle, roots = mesh.converge()
+        assert roots and all(r == oracle for r in roots), \
+            "post-flood heal did not converge to the oracle"
+        leaks = mesh.teardown()
+    assert not leaks["orphan_procs"] and not leaks["orphan_sockets"], \
+        "flood leg leaked processes or sockets"
+    flood_rate = round(sent / flood_wall, 1)
+    mark(f"flood: {sent} msgs in {flood_wall:.2f}s ({flood_rate}/s), "
+         f"shed_overload={shed}, bound held at {bound}, healed "
+         f"fleet converged")
+
+    out = {
+        "drill": {
+            "scenario": MESH_PART.name,
+            "wall_s": round(report["wall_s"], 3),
+            "fleet_accepted": accepted,
+            "fleet_msgs_per_s": fleet_rate,
+            "mesh_forwarded": forwarded,
+            "per_hop_latency": hops,
+            "oracle_root": report["oracle"],
+            "converged": True,
+        },
+        "flood": {
+            "messages": sent,
+            "seconds": round(flood_wall, 3),
+            "msgs_per_s": flood_rate,
+            "ingest_bound": bound,
+            "shed_overload": shed,
+            "post_heal_root": oracle,
+        },
+        "ok": True,
+    }
+    with open(MESH_JSON, "w") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+    log("[bench] mesh: " + json.dumps(out, sort_keys=True))
+    return {
+        "metric": "mesh_flood_msgs_per_sec",
+        "value": flood_rate,
+        "unit": (f"msgs/s into a partitioned 3-node mesh (flood leg; "
+                 f"drill fleet {fleet_rate}/s, worst per-hop p99 "
+                 f"{hop_p99}ms, zero divergence)"),
+        "vs_baseline": 1.0,
+    }
+
+
 TIERS = {
     "merkle": (bench_merkle, 150),
     # incremental merkleization (ssz/incremental.py): pure host-side
@@ -2365,6 +2496,11 @@ TIERS = {
     # plus a flood leg against a tiny ingest bound; process spawns and
     # the paced timeline dominate, stub BLS, no kernels
     "node": (bench_node, 420),
+    # fleet front door (mesh/): three meshed run_node.py processes —
+    # the partition+heal drill with per-hop latency, then a partition
+    # flood against a tiny ingest bound; process spawns dominate, stub
+    # BLS, no kernels
+    "mesh": (bench_mesh, 420),
 }
 
 # the driver's ~540s window fits merkle + ONE heavy tier — without
@@ -2373,7 +2509,7 @@ TIERS = {
 _ROTATING = ["north_star", "attestations", "block_sigs", "kzg", "epoch",
              "transition", "degraded", "gossip", "txn", "msm",
              "merkle_inc", "scenario", "multichip", "pipeline", "fold",
-             "factory", "node"]
+             "factory", "node", "mesh"]
 
 
 def _round_index() -> int:

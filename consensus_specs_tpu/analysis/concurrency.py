@@ -57,6 +57,7 @@ _SCOPE = (
     "consensus_specs_tpu.scenario",
     "consensus_specs_tpu.utils",
     "consensus_specs_tpu.node",
+    "consensus_specs_tpu.mesh",
 )
 
 # the primitive layer: the one module allowed to touch threading locks

@@ -22,7 +22,7 @@ import ast
 from .core import Context, Finding
 from .factoryseam import _SCALAR_CALLS, _resolved_import
 
-_SCOPE = ("consensus_specs_tpu.node",)
+_SCOPE = ("consensus_specs_tpu.node", "consensus_specs_tpu.mesh")
 _CRYPTO = "consensus_specs_tpu.crypto"
 
 

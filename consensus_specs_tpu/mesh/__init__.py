@@ -1,0 +1,11 @@
+"""Process mesh: real node processes gossiping to each other over the
+framed unix-socket wire, with fault-injecting peer links (link.py) and
+digest-keyed anti-entropy repair (service.py).  The scenario driver's
+``processes=True`` backend (scenario/processes.py) runs the DSL's
+partition/kill timelines against this mesh; `scripts/mesh_drill.py`
+is the drill."""
+from .link import LinkConfig, PeerLink, backoff_delay
+from .service import MeshConfig, MeshNodeService
+
+__all__ = ["LinkConfig", "PeerLink", "backoff_delay",
+           "MeshConfig", "MeshNodeService"]

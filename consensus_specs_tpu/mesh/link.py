@@ -1,0 +1,330 @@
+"""Peer links: the mesh's outbound half.
+
+One :class:`PeerLink` per peer owns a bounded outbound frame queue and
+a single worker thread (role ``mesh-link``) that connects to the
+peer's unix socket and streams frames at it.  The failure model is the
+whole point:
+
+* **bounded reconnect + exponential backoff + jitter** — a dead peer
+  costs `reconnect_max` connect attempts spaced by
+  ``min(base·2^n, cap)·(1 + jitter·U[0,1))``; past the budget the link
+  QUARANTINES itself (sticky, incident-logged) instead of spinning.
+* **send timeouts** — a half-open peer (accepted but never reads)
+  stalls `sendall` for at most `send_timeout_s` before the link drops
+  the connection and retries through the same backoff budget.
+* **shed-oldest backpressure** — `offer()` never blocks the pump: a
+  full queue evicts its oldest frame (incident + metric); the
+  anti-entropy pass repairs whatever a shed frame would have carried.
+* **registered fault boundary** — every send consults the active
+  `FaultPlan` at the ``mesh.link`` dispatch site (raise = the frame
+  and the connection are lost, timeout = the wire stalls, corrupt =
+  one on-wire bit flips so the RECEIVER's CRC check sheds it) and
+  crosses the ``mesh.send`` barrier, so the seeded injector faults
+  real socket traffic exactly like it faults device dispatches.
+* **quarantine, never crash** — damage in the peer's response stream
+  (a `WireError` from the deframer) quarantines THIS link; the node
+  keeps serving.  `reset()` (a `B` peers frame, or the drill healing a
+  partition) clears quarantine and re-arms the reconnect budget.
+
+Attribution: the owning process pins its `NodeContext` as resident
+(service construction), so the worker's incident/metric records — and
+the fault injector's own ``injected`` records — land in the right
+node's books without any per-thread context push.
+"""
+from __future__ import annotations
+
+import random
+import socket
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+
+from ..node import wire
+from ..resilience import faults
+from ..utils.locks import named_condition
+
+LINK_SITE = "mesh.link"
+SEND_SITE = "mesh.send"
+
+
+@dataclass
+class LinkConfig:
+    queue_bound: int = 1024          # outbound frames kept per peer
+    send_timeout_s: float = 5.0      # half-open peer stall budget
+    connect_timeout_s: float = 2.0
+    reconnect_max: int = 8           # consecutive failures -> quarantine
+    backoff_base_s: float = 0.05
+    backoff_max_s: float = 2.0
+    backoff_jitter: float = 0.25     # +0..25% per wait, seeded
+
+
+def backoff_delay(config: LinkConfig, attempt: int,
+                  rng: random.Random) -> float:
+    """Wait before reconnect attempt ``attempt`` (0-based): exponential
+    growth capped at `backoff_max_s`, stretched by up to `+jitter` so a
+    restarted peer is not hit by every link in lockstep."""
+    base = min(config.backoff_base_s * (2 ** attempt),
+               config.backoff_max_s)
+    return base * (1.0 + config.backoff_jitter * rng.random())
+
+
+def _flip_byte(data: bytes, rng: random.Random) -> bytes:
+    """On-wire corruption: one flipped bit anywhere in the framed
+    bytes.  The receiver's magic/CRC check turns it into a
+    malformed-frame shed + connection close — never a crash."""
+    out = bytearray(data)
+    j = rng.randrange(len(out))
+    out[j] ^= 1 << rng.randrange(8)
+    return bytes(out)
+
+
+class PeerLink:
+    """Outbound link to one peer.  Thread shape: any thread may
+    `offer()`/`block()`/`reset()`; one ``mesh-link`` worker sends."""
+
+    def __init__(self, peer_id: str, socket_path: str, ctx,
+                 config: LinkConfig | None = None,
+                 rng: random.Random | None = None, on_heal=None):
+        self.peer_id = str(peer_id)
+        self.socket_path = socket_path
+        self.ctx = ctx                  # owning node's NodeContext
+        self.config = config or LinkConfig()
+        self.on_heal = on_heal          # called after quarantine/block lift
+        self._rng = rng or random.Random(0)
+        self._cond = named_condition("mesh.link")
+        self._queue = deque()           # guarded by _cond (handoff)
+        self._blocked = False           # partition control (B frames)
+        self._quarantined = None        # sticky reason string
+        self._closing = False
+        self._sent = 0
+        self._shed = 0                  # evicted by backpressure
+        self._dropped = 0               # lost to block/quarantine
+        self._connects = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"mesh-link-{self.peer_id}",
+            daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    # -- any-thread surface ---------------------------------------------
+
+    def offer(self, data: bytes) -> bool:
+        """Enqueue one framed message; never blocks.  Returns False when
+        the link is down (blocked/quarantined/closing) — the frame is
+        dropped and the anti-entropy pass owns the repair."""
+        evicted = False
+        with self._cond:
+            if (self._closing or self._blocked
+                    or self._quarantined is not None):
+                self._dropped += 1
+                return False
+            if len(self._queue) >= self.config.queue_bound:
+                self._queue.popleft()       # shed-OLDEST
+                self._shed += 1
+                evicted = True
+            self._queue.append(data)
+            self._cond.notify()
+        if evicted:
+            self.ctx.incidents.record(LINK_SITE, "link_shed",
+                                      peer=self.peer_id)
+            self.ctx.metrics.inc("mesh_link_shed")
+        return True
+
+    def block(self) -> None:
+        """Partition control: drop everything queued and everything
+        offered until `reset()`."""
+        with self._cond:
+            if self._blocked:
+                return
+            self._blocked = True
+            self._dropped += len(self._queue)
+            self._queue.clear()
+            self._cond.notify()
+        self.ctx.incidents.record(LINK_SITE, "link_blocked",
+                                  peer=self.peer_id)
+
+    def reset(self) -> None:
+        """Heal: lift a partition block AND a sticky quarantine (the
+        peer restarted, or the drill healed the cut), re-arming the
+        reconnect budget.  Fires `on_heal` so the owner can schedule an
+        anti-entropy pass."""
+        healed = False
+        with self._cond:
+            if self._blocked or self._quarantined is not None:
+                healed = True
+            self._blocked = False
+            self._quarantined = None
+            self._cond.notify()
+        if healed:
+            self.ctx.incidents.record(LINK_SITE, "link_healed",
+                                      peer=self.peer_id)
+            if self.on_heal is not None:
+                self.on_heal(self.peer_id)
+
+    def quarantine(self, reason: str) -> None:
+        """Sticky failure isolation: the LINK goes dark (queue dropped,
+        offers refused) until `reset()`; the node keeps serving."""
+        with self._cond:
+            if self._quarantined is not None or self._closing:
+                return
+            self._quarantined = str(reason)
+            self._dropped += len(self._queue)
+            self._queue.clear()
+            self._cond.notify()
+        self.ctx.incidents.record(LINK_SITE, "link_quarantined",
+                                  peer=self.peer_id, detail=str(reason))
+        self.ctx.metrics.inc("mesh_link_quarantined")
+
+    def healthy(self) -> bool:
+        with self._cond:
+            return (not self._blocked and self._quarantined is None
+                    and not self._closing)
+
+    def state(self) -> dict:
+        with self._cond:
+            return {"peer": self.peer_id,
+                    "depth": len(self._queue),
+                    "blocked": self._blocked,
+                    "quarantined": self._quarantined,
+                    "sent": self._sent,
+                    "shed": self._shed,
+                    "dropped": self._dropped,
+                    "connects": self._connects}
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        with self._cond:
+            self._closing = True
+            self._cond.notify()
+        self._stop.set()
+        if self._thread.ident is not None:
+            self._thread.join(timeout=timeout_s)
+
+    # -- the mesh-link worker -------------------------------------------
+
+    def _run(self) -> None:
+        sock = None
+        reader = None
+        attempts = 0
+        while True:
+            with self._cond:
+                while not self._queue and not self._closing:
+                    self._cond.wait(timeout=0.1)
+                if self._closing:
+                    break
+                if self._blocked or self._quarantined is not None:
+                    # a control thread downed the link between the
+                    # notify and this pop: drop what raced in
+                    self._dropped += len(self._queue)
+                    self._queue.clear()
+                    continue
+                data = self._queue.popleft()
+            # the registered fault boundary: the injector models real
+            # wire damage on this hop
+            spec = None
+            plan = faults.active_plan()
+            if plan is not None:
+                spec = plan.decide(LINK_SITE)
+            if spec is not None:
+                if spec.kind in ("raise", "shard_dead"):
+                    # frame AND connection lost: a peer hangup mid-send
+                    self.ctx.metrics.inc("mesh_link_injected_drops")
+                    sock, reader = self._hangup(sock), None
+                    attempts += 1
+                    continue
+                if spec.kind == "timeout":
+                    time.sleep(spec.sleep_s)
+                elif spec.kind == "corrupt":
+                    data = _flip_byte(data, self._rng)
+            while data is not None:
+                if sock is None:
+                    sock, reader, attempts = self._connect(attempts)
+                    if sock is None:
+                        break           # quarantined / downed / closing
+                try:
+                    faults.fire(SEND_SITE)
+                except faults.DeviceFault as exc:
+                    self.ctx.incidents.record(
+                        SEND_SITE, "send_fault", peer=self.peer_id,
+                        detail=str(exc))
+                    self.ctx.metrics.inc("mesh_send_faults")
+                    break               # frame shed at the barrier
+                try:
+                    sock.settimeout(self.config.send_timeout_s)
+                    sock.sendall(data)
+                except OSError:
+                    sock, reader = self._hangup(sock), None
+                    attempts += 1
+                    continue            # reconnect, resend this frame
+                with self._cond:
+                    self._sent += 1
+                attempts = 0
+                data = None
+                if not self._drain_responses(sock, reader):
+                    sock, reader = self._hangup(sock), None
+        self._hangup(sock)
+
+    def _connect(self, attempts: int):
+        """(sock, reader, attempts) or (None, None, attempts): bounded
+        reconnect with jittered exponential backoff; budget exhaustion
+        quarantines the link."""
+        while True:
+            with self._cond:
+                if (self._closing or self._blocked
+                        or self._quarantined is not None):
+                    return None, None, attempts
+            if attempts > self.config.reconnect_max:
+                self.quarantine(
+                    f"reconnect budget exhausted "
+                    f"({self.config.reconnect_max} retries)")
+                return None, None, attempts
+            if attempts > 0 and self._stop.wait(
+                    backoff_delay(self.config, attempts - 1, self._rng)):
+                return None, None, attempts
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.settimeout(self.config.connect_timeout_s)
+            try:
+                sock.connect(self.socket_path)
+            except OSError:
+                sock.close()
+                attempts += 1
+                continue
+            with self._cond:
+                self._connects += 1
+            return sock, wire.FrameReader(), attempts
+
+    def _drain_responses(self, sock, reader) -> bool:
+        """Read whatever the peer answered without blocking.  The
+        forward path is fire-and-forget, but the response stream must
+        be drained (a never-read socket would eventually wedge the
+        peer's responder) and VERIFIED: framing damage quarantines the
+        link, never the node."""
+        try:
+            sock.settimeout(0.0)
+            while True:
+                buf = sock.recv(1 << 16)
+                if not buf:
+                    return False        # peer hung up
+                reader.feed(buf)        # CRC-checked; bodies discarded
+        except (BlockingIOError, InterruptedError):
+            return True
+        except wire.WireError as exc:
+            self.quarantine(f"corrupt response frame: {exc}")
+            return False
+        except OSError:
+            return False
+        finally:
+            try:
+                sock.settimeout(None)
+            except OSError:
+                pass
+
+    def _hangup(self, sock):
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        return None

@@ -1,0 +1,257 @@
+"""The mesh node: a `NodeService` that floods admitted gossip to real
+peer processes and repairs itself by anti-entropy.
+
+Topology is static config: `MeshConfig.peers` names each neighbour's
+(id, socket path); every neighbour gets one :class:`PeerLink`.  The
+flood rides the admission pipeline's ``transport`` seam — a message
+fires `_forward` only AFTER local validation accepts it, and the
+content-addressed `SeenCache` dedup at each hop (duplicates shed
+before transport fires) keeps an arbitrary cyclic topology loop-free.
+Split horizon: a message is never forwarded back to the peer it
+arrived from (peers identify themselves as ``mesh:<node_id>``).
+
+Anti-entropy (the ``scenario.sync`` contract, realized over sockets):
+every accepted message's digest -> (topic, origin peer, payload) is
+kept in a bounded replay log.  `S`/`P` frames serve the log INLINE on
+conn threads (lock-guarded, no pump involvement — two nodes can sync
+each other concurrently without deadlock); the `Y` sync frame queues a
+control item so the PULL + re-submit side runs on the pump, the only
+thread allowed to touch the pipeline.  A healed link (quarantine or
+partition block lifted by a `B` peers frame) schedules an automatic
+sync on the pump via the `_pump_extra` hook.
+
+Fault surface: peer-forwarded messages cross the registered
+``mesh.recv`` barrier before admission; each link's sends consult
+``mesh.link`` and cross ``mesh.send`` (link.py).  The `I` incidents
+frame exposes the node's incident book so the drill can assert every
+injected fault and SIGKILL is attributed in the right process.
+"""
+from __future__ import annotations
+
+import json
+import random
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+from ..node import wire
+from ..node.client import NodeClient
+from ..node.service import NodeConfig, NodeService
+from ..resilience import faults
+from ..utils.clock import MONOTONIC
+from ..utils.locks import named_lock
+from .link import LinkConfig, PeerLink
+
+RECV_SITE = "mesh.recv"
+SYNC_SITE = "mesh.sync"          # incident site (scenario.sync's twin)
+PEER_PREFIX = "mesh:"            # how mesh nodes identify to each other
+
+
+@dataclass
+class MeshConfig(NodeConfig):
+    node_id: str = "node0"
+    peers: tuple = ()            # ((peer_id, socket_path), ...)
+    link: LinkConfig = field(default_factory=LinkConfig)
+    replay_bound: int = 1 << 14  # digests kept for anti-entropy
+    sync_page: int = 64          # digests per PULL page
+    link_seed: int = 0           # seeds per-link backoff jitter
+
+
+class MeshNodeService(NodeService):
+    def __init__(self, config: MeshConfig, clock=MONOTONIC):
+        super().__init__(config, clock)
+        self._replay_lock = named_lock("mesh.replay")
+        self._replay = OrderedDict()    # digest -> (topic, peer, payload)
+        self._sync_wanted = threading.Event()
+        seeder = random.Random(config.link_seed)
+        self.links = {}
+        for peer_id, path in config.peers:
+            self.links[str(peer_id)] = PeerLink(
+                peer_id, path, self.ctx, config.link,
+                rng=random.Random(seeder.randrange(1 << 30)),
+                on_heal=self._on_heal)
+        # admitted messages flood through the pipeline's transport seam
+        self.pipe.transport = self._forward
+        for link in self.links.values():
+            link.start()
+
+    # -- the flood (pump thread, under scope) ---------------------------
+
+    def _forward(self, message) -> None:
+        """Transport seam: record the accepted message for anti-entropy,
+        then offer it to every link except the sender's."""
+        with self._replay_lock:
+            if message.digest not in self._replay:
+                if len(self._replay) >= self.config.replay_bound:
+                    self._replay.popitem(last=False)
+                self._replay[message.digest] = (
+                    message.topic, message.peer, message.payload)
+        data = wire.encode_message(
+            0, message.topic, PEER_PREFIX + self.config.node_id,
+            message.payload)
+        for peer_id, link in self.links.items():
+            if message.peer == PEER_PREFIX + peer_id:
+                continue                # split horizon
+            link.offer(data)
+        self.ctx.metrics.inc("mesh_forwarded")
+
+    # -- conn-thread surface --------------------------------------------
+
+    def handle(self, kind: str, value, respond) -> None:
+        if (kind == wire.KIND_MESSAGE
+                and isinstance(value, (tuple, list)) and len(value) == 4
+                and isinstance(value[2], str)
+                and value[2].startswith(PEER_PREFIX)):
+            # peer-forwarded gossip crosses the registered recv barrier
+            # before admission: the injector drops/delays it here
+            try:
+                faults.fire(RECV_SITE)
+            except faults.DeviceFault as exc:
+                self.ctx.incidents.record(RECV_SITE, "recv_fault",
+                                          detail=str(exc))
+                self.ctx.metrics.inc("mesh_recv_faults")
+                respond({"id": value[0], "status": "shed",
+                         "detail": "recv fault"})
+                return
+        if kind == wire.KIND_SUMMARY:
+            if not isinstance(value, int):
+                self._shed_frame(respond, None, "bad summary request")
+                return
+            with self._replay_lock:
+                digests = list(self._replay.keys())
+            respond({"id": value, "status": "ok", "digests": digests})
+            return
+        if kind == wire.KIND_PULL:
+            if (not isinstance(value, (tuple, list)) or len(value) != 2
+                    or not isinstance(value[0], int)
+                    or not isinstance(value[1], (tuple, list))):
+                self._shed_frame(respond, None, "bad pull request")
+                return
+            rid, wanted = value
+            out = []
+            with self._replay_lock:
+                for digest in wanted:
+                    entry = self._replay.get(digest)
+                    if entry is not None:
+                        out.append(entry)
+            respond({"id": rid, "status": "ok", "messages": out})
+            return
+        if kind == wire.KIND_SYNC:
+            if not isinstance(value, int):
+                self._shed_frame(respond, None, "bad sync request")
+                return
+            # the pull+resubmit side must run on the pump
+            self._enqueue(("sync", value, respond), respond, control=True)
+            return
+        if kind == wire.KIND_PEERS:
+            if (not isinstance(value, (tuple, list)) or len(value) != 2
+                    or not isinstance(value[0], int)
+                    or not isinstance(value[1], (tuple, list))):
+                self._shed_frame(respond, None, "bad peers request")
+                return
+            rid, blocked = value
+            blocked = {str(b) for b in blocked}
+            for peer_id, link in self.links.items():
+                if peer_id in blocked:
+                    link.block()
+                else:
+                    link.reset()
+            respond({"id": rid, "status": "ok",
+                     "blocked": sorted(blocked)})
+            return
+        if kind == wire.KIND_INCIDENTS:
+            if not isinstance(value, int):
+                self._shed_frame(respond, None, "bad incidents request")
+                return
+            # JSON string like health: incident detail values may be
+            # floats, which the wire codec (deliberately) refuses
+            respond({"id": value, "status": "ok",
+                     "incidents": json.dumps(self.ctx.incidents.snapshot(),
+                                             default=str)})
+            return
+        super().handle(kind, value, respond)
+
+    # -- anti-entropy (pump thread, under scope) ------------------------
+
+    def _on_heal(self, peer_id: str) -> None:
+        self._sync_wanted.set()
+
+    def _pump_extra(self) -> None:
+        if self._sync_wanted.is_set():
+            self._sync_wanted.clear()
+            self._sync()
+
+    def _process(self, item) -> None:
+        if item[0] == "sync":
+            _, rid, respond = item
+            respond({"id": rid, "status": "ok",
+                     "replayed": self._sync()})
+            return
+        super()._process(item)
+
+    def _sync(self) -> int:
+        """One anti-entropy pass: for every reachable peer, fetch its
+        digest summary, PULL what this node has not admitted, and
+        re-submit the misses through the pipeline under their original
+        origin — the mesh twin of the scenario driver's catch-up
+        replay.  Failures are per-peer and non-fatal."""
+        replayed = 0
+        for peer_id, link in self.links.items():
+            if not link.healthy():
+                continue
+            try:
+                client = NodeClient(link.socket_path,
+                                    connect_timeout_s=2.0,
+                                    resolver=self._resolver)
+            except OSError:
+                continue
+            try:
+                missing = [d for d in client.summary()
+                           if not self.pipe.seen.seen_before(d)]
+                for start in range(0, len(missing),
+                                   self.config.sync_page):
+                    page = missing[start:start + self.config.sync_page]
+                    for topic, peer, payload in client.pull(page):
+                        if topic not in self.pipe.topics:
+                            continue
+                        self.pipe.submit(topic, payload, peer=peer)
+                        replayed += 1
+                    self.pipe.drain()
+            except (OSError, ConnectionError, wire.WireError,
+                    AssertionError):
+                continue                # peer died mid-sync: next pass
+            finally:
+                client.close()
+        if replayed:
+            self.pipe.drain()
+            self._harvest()
+        self.ctx.incidents.record(SYNC_SITE, "catch_up",
+                                  replayed=replayed)
+        self.ctx.metrics.inc("mesh_syncs")
+        return replayed
+
+    # -- health / lifecycle ---------------------------------------------
+
+    def health(self) -> dict:
+        report = super().health()
+        with self._replay_lock:
+            log_size = len(self._replay)
+        report["mesh"] = {
+            "node_id": self.config.node_id,
+            "forwarded": self.ctx.metrics.count("mesh_forwarded"),
+            "syncs": self.ctx.metrics.count("mesh_syncs"),
+            "replay_log": log_size,
+            "links": {pid: link.state()
+                      for pid, link in self.links.items()},
+        }
+        return report
+
+    def _shutdown(self) -> None:
+        for link in self.links.values():
+            link.close()
+        super()._shutdown()
+
+    def close(self) -> None:
+        for link in self.links.values():
+            link.close()
+        super().close()

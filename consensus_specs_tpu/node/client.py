@@ -42,7 +42,8 @@ class NodeClient:
     msg_id; responses are read inline (the server answers every frame,
     though message verdicts may arrive out of submission order)."""
 
-    def __init__(self, socket_path: str, connect_timeout_s: float = 10.0):
+    def __init__(self, socket_path: str, connect_timeout_s: float = 10.0,
+                 resolver=None):
         deadline = time.monotonic() + connect_timeout_s
         self.sock = None
         while True:
@@ -57,6 +58,9 @@ class NodeClient:
                     raise
                 time.sleep(0.05)
         self.reader = wire.FrameReader()
+        # mesh responses (PULL) carry SSZ payloads, which decode only
+        # through the spec's TypeResolver; plain clients leave it None
+        self.resolver = resolver
         self._responses = []
         self._next_id = 0
 
@@ -80,12 +84,15 @@ class NodeClient:
                                      (self._next_id, int(t))))
         return self._next_id
 
-    def request(self, kind: str) -> dict:
+    def request(self, kind: str, value=None) -> dict:
         """Send a control frame and wait for ITS response (every frame
-        carries a client-assigned id; stale verdicts are skipped)."""
+        carries a client-assigned id; stale verdicts are skipped).
+        `value` replaces the bare request id for mesh frames whose
+        bodies are tuples — it must embed the id as element 0."""
         self._next_id += 1
         rid = self._next_id
-        self.sock.sendall(wire.frame(kind, rid))
+        self.sock.sendall(wire.frame(
+            kind, rid if value is None else (rid, *value)))
         while True:
             resp = self.read_response()
             if resp.get("id") == rid:
@@ -100,6 +107,32 @@ class NodeClient:
     def drain(self) -> dict:
         return self.request(wire.KIND_DRAIN)
 
+    # -- mesh control frames (mesh/service.py answers these) ------------
+
+    def summary(self) -> list:
+        """The peer's admitted-digest summary (anti-entropy keys)."""
+        return list(self.request(wire.KIND_SUMMARY)["digests"])
+
+    def pull(self, digests) -> list:
+        """[(topic, peer, payload), ...] for the digests the peer still
+        holds in its replay log."""
+        return list(self.request(wire.KIND_PULL,
+                                 (list(digests),))["messages"])
+
+    def sync(self) -> dict:
+        """Ask the node to run one anti-entropy pass NOW (pull from all
+        reachable peers); returns {"replayed": n}."""
+        return self.request(wire.KIND_SYNC)
+
+    def set_blocked_peers(self, peer_ids) -> dict:
+        """Partition control: block links to `peer_ids` ([] heals and
+        resets quarantined links)."""
+        return self.request(wire.KIND_PEERS, (list(peer_ids),))
+
+    def incidents(self) -> list:
+        """The node's incident book (drill attribution surface)."""
+        return json.loads(self.request(wire.KIND_INCIDENTS)["incidents"])
+
     def read_response(self, timeout_s: float = 30.0) -> dict:
         while not self._responses:
             self.sock.settimeout(timeout_s)
@@ -107,7 +140,7 @@ class NodeClient:
             if not data:
                 raise ConnectionError("node closed the connection")
             for body in self.reader.feed(data):
-                kind, value = wire.decode_body(body)
+                kind, value = wire.decode_body(body, self.resolver)
                 assert kind == wire.KIND_RESPONSE, kind
                 self._responses.append(value)
         return self._responses.pop(0)
@@ -122,7 +155,7 @@ class NodeClient:
                 if not data:
                     break
                 for body in self.reader.feed(data):
-                    _, value = wire.decode_body(body)
+                    _, value = wire.decode_body(body, self.resolver)
                     self._responses.append(value)
         except (BlockingIOError, OSError):
             pass

@@ -1,0 +1,171 @@
+"""Minimal HTTP/JSON ingest beside the framed socket.
+
+One deliberately thin adapter: every POST maps onto the SAME bounded
+ingest queue as the socket path — same shed-oldest overload contract,
+same degrade watermark, same per-peer quota verdicts — by calling
+`NodeService.handle` with a capture callback in place of a socket
+respond.  The node has exactly one admission path; HTTP is a second
+door onto it, not a second path.
+
+Surface (JSON in, JSON out):
+
+    POST /ingest  {"id": n, "topic": t, "peer": p, "value": hex}
+                  -> the message's verdict ({"status": "accepted" |
+                     "rejected" | "shed" | "deferred", ...}).  `value`
+                     is the hex of a `txn.codec` encoding, so SSZ
+                     payloads cross in their canonical serialization.
+    POST /tick    {"id": n, "time": t}      -> {"status": "ok", ...}
+    GET  /health                            -> the health report
+    GET  /root                              -> {"root": hex}
+
+Malformed JSON, a bad hex payload, or an undecodable value sheds with
+an incident (HTTP 400) — never a crash; a verdict that does not
+arrive within the wait budget answers 504 with ``status: timeout``
+(the message itself may still land — ids let the client correlate).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..txn.codec import CodecError, decode_value
+from . import wire
+
+_WAIT_S = 30.0          # verdict wait budget per request
+
+
+class _Capture:
+    """A respond() stand-in: parks the HTTP handler thread until the
+    pump (or the shed path) answers."""
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.value = None
+
+    def __call__(self, resp) -> None:
+        self.value = resp
+        self.event.set()
+
+    def wait(self, timeout_s: float = _WAIT_S):
+        if self.event.wait(timeout_s):
+            return self.value
+        return None
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args) -> None:    # stdout stays the node's
+        pass
+
+    @property
+    def service(self):
+        return self.server.service
+
+    def _reply(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload, sort_keys=True).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _shed(self, detail: str) -> None:
+        capture = _Capture()
+        # the service's shed path: incident + metric + shed response
+        self.service._shed_frame(capture, None, detail)
+        self._reply(400, capture.wait(1.0)
+                    or {"status": "shed", "detail": detail})
+
+    def _json_body(self):
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+        except ValueError:
+            return None, "bad content-length"
+        if length <= 0 or length > wire.MAX_BODY:
+            return None, f"bad content-length {length}"
+        try:
+            body = json.loads(self.rfile.read(length))
+        except (ValueError, OSError) as exc:
+            return None, f"malformed JSON: {exc}"
+        if not isinstance(body, dict):
+            return None, "JSON body must be an object"
+        return body, None
+
+    def do_POST(self) -> None:          # noqa: N802 (http.server API)
+        body, err = self._json_body()
+        if err is not None:
+            self._shed(err)
+            return
+        if self.path == "/ingest":
+            try:
+                msg_id = int(body["id"])
+                topic = str(body["topic"])
+                peer = str(body["peer"])
+                payload = decode_value(bytes.fromhex(body["value"]),
+                                       self.service._resolver)
+            except (KeyError, TypeError, ValueError, CodecError) as exc:
+                self._shed(f"bad ingest body: {exc}")
+                return
+            capture = _Capture()
+            self.service.handle(wire.KIND_MESSAGE,
+                                (msg_id, topic, peer, payload), capture)
+            verdict = capture.wait()
+            self._reply(200 if verdict else 504,
+                        verdict or {"id": msg_id, "status": "timeout"})
+            return
+        if self.path == "/tick":
+            try:
+                rid, t = int(body["id"]), int(body["time"])
+            except (KeyError, TypeError, ValueError) as exc:
+                self._shed(f"bad tick body: {exc}")
+                return
+            capture = _Capture()
+            self.service.handle(wire.KIND_TICK, (rid, t), capture)
+            verdict = capture.wait()
+            self._reply(200 if verdict else 504,
+                        verdict or {"id": rid, "status": "timeout"})
+            return
+        self._reply(404, {"status": "shed", "detail": "unknown path"})
+
+    def do_GET(self) -> None:           # noqa: N802 (http.server API)
+        if self.path == "/health":
+            self._reply(200, self.service.health())
+            return
+        if self.path == "/root":
+            capture = _Capture()
+            self.service.handle(wire.KIND_ROOT, 0, capture)
+            verdict = capture.wait()
+            self._reply(200 if verdict else 504,
+                        verdict or {"status": "timeout"})
+            return
+        self._reply(404, {"status": "shed", "detail": "unknown path"})
+
+
+class HttpIngest:
+    """The HTTP door: a ThreadingHTTPServer whose handlers feed
+    `service.handle` and park on capture events for their verdicts."""
+
+    def __init__(self, service, host: str, port: int):
+        self.server = ThreadingHTTPServer((host, int(port)), _Handler)
+        self.server.daemon_threads = True
+        self.server.service = service
+        self._thread = threading.Thread(target=self._serve,
+                                        name="node-http", daemon=True)
+
+    @property
+    def port(self) -> int:
+        return self.server.server_address[1]
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _serve(self) -> None:
+        self.server.serve_forever(poll_interval=0.2)
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+        if self._thread.ident is not None:
+            self._thread.join(timeout=5.0)

@@ -92,6 +92,10 @@ class NodeConfig:
     drain_deadline_s: float = 30.0
     latency_window: int = 4096          # admission->delivery samples kept
     stub_bls: bool = True               # real BLS only when asked
+    # the minimal HTTP/JSON ingest surface (node/http.py) beside the
+    # framed socket; None keeps it off, 0 binds an ephemeral port
+    http_port: int | None = None
+    http_host: str = "127.0.0.1"
     gossip: GossipConfig = field(default_factory=lambda: GossipConfig(
         bucket_capacity=1 << 14, refill_rate=1 << 12,
         queue_depth=1 << 12))
@@ -113,14 +117,25 @@ class NodeService:
             self._bls_guard.__enter__()
         self.spec = get_spec(config.fork, config.preset)
         self._resolver = TypeResolver(self.spec)
+        # mesh configs carry a per-process node_id; the single-node
+        # config keeps the historical "node" name
+        name = getattr(config, "node_id", None) or "node"
         self.ctx = nodectx.NodeContext(
-            "node", metrics=Metrics(node_id="node"),
-            incidents=IncidentLog(max_entries=1 << 14, node_id="node",
+            name, metrics=Metrics(node_id=name),
+            incidents=IncidentLog(max_entries=1 << 14, node_id=name,
                                   clock=clock),
             supervisor=nodectx.Slot(Supervisor(
                 SupervisorConfig(clock=clock))),
             fault_plan=nodectx.Slot(None),
             guard=nodectx.Slot(None))
+        # one process, one node: the context is process-RESIDENT, so
+        # every thread (conn readers, link workers, the async flush
+        # engine's workers) attributes to this node without pushing,
+        # and pipeline_async's forced-inline rule is lifted — the node
+        # process's device verifies genuinely overlap.  Tests that
+        # build a NodeService in-process must unpin on teardown
+        # (close() does).
+        nodectx.pin(self.ctx)
         os.makedirs(config.data_dir, exist_ok=True)
         journal_dir = os.path.join(config.data_dir, "journal")
         with nodectx.use(self.ctx):
@@ -157,6 +172,7 @@ class NodeService:
         self._stopping = False
         self._exit_code = 0
         self.server = IngestServer(config.socket_path, self)
+        self._http = None                   # started in serve() if asked
         self._pump = threading.Thread(target=self._pump_loop,
                                       name="node-pump", daemon=True)
 
@@ -287,10 +303,17 @@ class NodeService:
                             item[5]({"id": item[1], "status": "shed",
                                      "detail": "handler error"})
                 self.pipe.poll()
+                self._pump_extra()
             self._harvest()
             self._watermark()
             if stop:
                 return
+
+    def _pump_extra(self) -> None:
+        """Subclass hook, called once per pump iteration under
+        `scope()`: the mesh service runs its deferred anti-entropy
+        sync here so pulls land on the pump — the only thread allowed
+        to touch the pipeline."""
 
     def _process(self, item) -> None:
         if item[0] == "msg":
@@ -385,6 +408,7 @@ class NodeService:
             "uptime_s": round(self.clock.now() - self._started, 3),
             "rss_kb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss,
             "pid": os.getpid(),
+            "http_port": self._http.port if self._http else None,
             "recovered": self.recovered,
             "draining": self._draining.is_set(),
             "degraded": degraded,
@@ -433,6 +457,11 @@ class NodeService:
         signal.signal(signal.SIGINT,
                       lambda *_: self.request_drain("SIGINT"))
         self.server.start()
+        if self.config.http_port is not None:
+            from .http import HttpIngest   # deferred: http imports us
+            self._http = HttpIngest(self, self.config.http_host,
+                                    self.config.http_port)
+            self._http.start()
         self._pump.start()
         self._dump_health()
         next_health = self.clock.now() + self.config.health_every_s
@@ -451,6 +480,8 @@ class NodeService:
         watchdog.start()
         # 1. stop accepting; late messages now shed with "draining"
         self.server.stop_accepting()
+        if self._http is not None:
+            self._http.stop()
         with self.scope():
             faults.fire(DRAIN_SITE)         # the drill's drain barrier
         # 2. flush: pump finishes the queue, then the pipeline windows
@@ -468,3 +499,18 @@ class NodeService:
         self.server.close()
         self._drain_done.set()
         watchdog.cancel()
+        nodectx.unpin(self.ctx)
+
+    def close(self) -> None:
+        """Test/teardown helper for services that never ran serve():
+        release the BLS stub, journal, socket, and the pinned resident
+        context (which would otherwise leak into the next test)."""
+        nodectx.unpin(self.ctx)
+        try:
+            self.journal.close()
+        except Exception:
+            pass
+        self.server.close()
+        if self._bls_guard is not None:
+            self._bls_guard.__exit__(None, None, None)
+            self._bls_guard = None

@@ -27,6 +27,22 @@ Frame kinds (client -> server unless noted):
     R  root      None                            -> {"root": hex}
     D  drain     None                            -> {"status": ...}
     r  response  dict (server -> client)
+
+Mesh kinds (peer links and the anti-entropy pass, mesh/): the range-
+summary exchange is keyed by the admission dedup digests, so two nodes
+compare and repair exactly the content-addressed set the SeenCache
+floods on:
+
+    S  summary    rid                    -> {"digests": [bytes32, ...]}
+    P  pull       (rid, [digest, ...])   -> {"messages": [(topic,
+                                             peer, payload), ...]}
+    Y  sync       rid                    -> {"replayed": n} (the node
+                                            pulls what it missed from
+                                            every reachable peer)
+    B  peers      (rid, [peer_id, ...])  -> blocked-peer set (partition
+                                            control; [] heals + resets
+                                            quarantined links)
+    I  incidents  rid                    -> {"incidents": json}
 """
 from __future__ import annotations
 
@@ -46,8 +62,15 @@ KIND_HEALTH = "H"
 KIND_ROOT = "R"
 KIND_DRAIN = "D"
 KIND_RESPONSE = "r"
+# mesh kinds (mesh/service.py): anti-entropy + partition control
+KIND_SUMMARY = "S"
+KIND_PULL = "P"
+KIND_SYNC = "Y"
+KIND_PEERS = "B"
+KIND_INCIDENTS = "I"
 KINDS = frozenset({KIND_MESSAGE, KIND_TICK, KIND_HEALTH, KIND_ROOT,
-                   KIND_DRAIN, KIND_RESPONSE})
+                   KIND_DRAIN, KIND_RESPONSE, KIND_SUMMARY, KIND_PULL,
+                   KIND_SYNC, KIND_PEERS, KIND_INCIDENTS})
 
 
 class WireError(ValueError):

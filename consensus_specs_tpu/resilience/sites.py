@@ -224,6 +224,31 @@ REGISTRY: tuple[Site, ...] = (
          note="inside graceful drain, after accepts stop and before "
               "the flush/fsync; scripts/node_drill.py + "
               "tests/test_node.py"),
+    # -- mesh: real peer-to-peer socket traffic (mesh/).  UNIT tier —
+    #    coverage is the multi-process drill over the scenario
+    #    library's partition/kill timelines (scripts/mesh_drill.py,
+    #    `make mesh-drill`) plus the link-layer unit suite.
+    # speclint: disable=site-unused -- the link worker consults
+    # plan.decide(site) directly: a corrupt spec must damage the
+    # in-flight FRAME bytes (there is no verdict or return value at
+    # this seam), which the dispatch/fire grammar cannot express
+    Site("mesh.link", "consensus_specs_tpu.mesh.link",
+         kind=DISPATCH, chaos=UNIT, corrupt="none",
+         note="per-send link fault consult: raise = frame + connection "
+              "lost, timeout = wire stall, corrupt = one on-wire bit "
+              "flip the RECEIVER's CRC sheds (the link applies the "
+              "damage itself — no verdict to flip, so corrupt='none'); "
+              "scripts/mesh_drill.py + tests/test_mesh.py"),
+    Site("mesh.send", "consensus_specs_tpu.mesh.link",
+         kind=BARRIER, chaos=UNIT, corrupt="none",
+         note="before each link sendall — the drill's kill/shed point "
+              "on the outbound hop; scripts/mesh_drill.py + "
+              "tests/test_mesh.py"),
+    Site("mesh.recv", "consensus_specs_tpu.mesh.service",
+         kind=BARRIER, chaos=UNIT, corrupt="none",
+         note="before a peer-forwarded message's admission — the "
+              "drill's kill/shed point on the inbound hop; "
+              "scripts/mesh_drill.py + tests/test_mesh.py"),
 )
 
 # speclint: disable=global-mutable-state -- name index over the frozen
@@ -428,6 +453,8 @@ _PA = "consensus_specs_tpu.sigpipe.pipeline_async"
 _GP = "consensus_specs_tpu.gossip.pipeline"
 _NS = "consensus_specs_tpu.node.service"
 _NI = "consensus_specs_tpu.node.ingest"
+_ML = "consensus_specs_tpu.mesh.link"
+_MS = "consensus_specs_tpu.mesh.service"
 
 CONCURRENCY = Concurrency(
     locks=(
@@ -544,6 +571,23 @@ CONCURRENCY = Concurrency(
                  guards=("_conns", "_next_id", "_accepting"),
                  note="live-connection table shared by the accept loop "
                       "and each conn reader's teardown"),
+        # -- mesh: peer links + anti-entropy ---------------------------
+        LockSpec("mesh.link", _ML, "_cond", cls="PeerLink",
+                 kind="condition",
+                 guards=("_queue", "_blocked", "_quarantined",
+                         "_closing", "_sent", "_shed", "_dropped",
+                         "_connects"),
+                 note="one per-peer outbound queue + link state "
+                      "machine (blocked/quarantined) shared by "
+                      "offerers, control frames, and the mesh-link "
+                      "worker; the socket itself is worker-local"),
+        LockSpec("mesh.replay", _MS, "_replay_lock",
+                 cls="MeshNodeService", kind="lock",
+                 guards=("_replay",),
+                 note="the anti-entropy replay log: the pump appends "
+                      "on accept (transport seam), conn threads serve "
+                      "SUMMARY/PULL from it inline; never nested with "
+                      "mesh.link — offers happen after release"),
         # -- utils -----------------------------------------------------
         LockSpec("nodectx.stack", "consensus_specs_tpu.utils.nodectx",
                  "_lock", guards=("_stack",)),
@@ -583,7 +627,14 @@ CONCURRENCY = Concurrency(
         ThreadRole("node-pump", _NS, "NodeService._pump_loop",
                    note="the ONLY thread that drives the node's "
                         "pipeline/store: pops the ingest queue, submits "
-                        "under scope(), harvests verdicts"),
+                        "under scope(), harvests verdicts (on a mesh "
+                        "node: also runs the anti-entropy sync via the "
+                        "_pump_extra hook)"),
+        ThreadRole("mesh-link", _ML, "PeerLink._run",
+                   note="one per peer (thread 'mesh-link-<peer>'): "
+                        "pops the outbound queue, reconnects with "
+                        "backoff, sends under the mesh.link/mesh.send "
+                        "fault boundary; never touches the pipeline"),
     ),
     handoffs=(
         Handoff("flush.ticket", _PA, "FlushTicket",
@@ -612,6 +663,10 @@ CONCURRENCY = Concurrency(
                 note="each work item carries its connection's respond "
                      "callable back to the pump; writes serialize "
                      "under node.conn"),
+        Handoff("mesh.outbound", _ML, "_queue",
+                note="framed bytes cross from the pump (transport "
+                     "seam) to each mesh-link worker; bounded, "
+                     "shed-oldest under backpressure"),
     ),
 )
 

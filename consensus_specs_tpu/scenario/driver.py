@@ -429,8 +429,17 @@ def run_scenario(scenario: Scenario, seed: int = 0,
                  node_config: GossipConfig | None = None,
                  snapshot_interval: int = 256,
                  journal_kwargs: dict | None = None,
-                 supervisor_overrides: dict | None = None) \
-        -> ScenarioReport:
+                 supervisor_overrides: dict | None = None,
+                 processes: bool = False):
+    """One scenario run.  ``processes=True`` swaps the in-process
+    simulated fleet for N real run_node.py processes meshed over their
+    framed sockets (scenario/processes.py) — the recovery-chaos
+    backend; it supports only the partition/heal/kill/recover event
+    subset and ignores the in-process tuning knobs, and returns the
+    process backend's report dict instead of a ScenarioReport."""
+    if processes:
+        from .processes import run_scenario_processes
+        return run_scenario_processes(scenario, seed=seed)
     return Driver(scenario, seed, node_config,
                   snapshot_interval=snapshot_interval,
                   journal_kwargs=journal_kwargs,

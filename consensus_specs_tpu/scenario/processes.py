@@ -1,0 +1,391 @@
+"""Real-process mesh backend for scenario drills.
+
+The in-process scenario driver (driver.py) simulates N nodes inside
+one interpreter; this backend runs the SAME scenario timelines against
+N real ``scripts/run_node.py`` processes wired into a full mesh over
+their framed unix sockets (mesh/service.py).  The driver here only
+feeds each message to its ORIGIN node and operates the control plane —
+the mesh itself floods admitted gossip peer-to-peer, partitions are
+imposed with PEERS frames (mesh link block/reset), kills are real
+SIGKILLs, and recovery is a real respawn over the surviving segment
+journal.  Convergence is asserted against the same in-process scalar
+oracle the socket drill uses (node/client.py), byte-for-byte on
+``txn.store_root``.
+
+Event support is deliberately the recovery-chaos subset: partition /
+heal / kill / recover.  Adversarial traffic events (storms, surround,
+long-range forks) are crafted INTO the plan's message feed by
+traffic.py and need no process-level control, but degraded windows and
+``crash`` (a power-cut fiction no real process can perform — SIGKILL
+is the honest version) raise ``UnsupportedEvent``.
+
+Determinism note: the mesh floods asynchronously, so mid-run state is
+timing-dependent — the contract is the END state.  After the timeline
+the driver re-offers every message to its origin (re-offers are
+idempotent: duplicates shed, earlier rejects retry), ticks past the
+end boundary, runs an anti-entropy pass on every node, and repeats to
+a fixpoint that must equal the oracle root on EVERY node.
+"""
+from __future__ import annotations
+
+import os
+import random
+import shutil
+import signal
+import tempfile
+import time
+
+from ..node.client import (
+    NodeClient, oracle_root, spawn_node)
+from ..specs import get_spec
+from .dsl import LIBRARY, Scenario, heal, kill, partition, recover
+from .traffic import TrafficPlan
+
+__all__ = [
+    "UnsupportedEvent", "ProcessMesh", "mesh_agenda",
+    "run_scenario_processes", "DRILL_CASES", "drill_case",
+]
+
+SUPPORTED_EVENTS = frozenset({"partition", "heal", "kill", "recover"})
+
+# respawn/connect budget: a fresh run_node.py pays the JAX import
+# (~15-30 s on a cold container) before it binds its socket
+CONNECT_TIMEOUT_S = 120.0
+DRAIN_TIMEOUT_S = 60.0
+
+
+class UnsupportedEvent(Exception):
+    """The scenario uses an event kind the process backend cannot
+    impose on a real process (crash, degraded, ...)."""
+
+
+def mesh_agenda(plan: TrafficPlan) -> list:
+    """Flatten a plan into the process-mesh timeline: a sorted list of
+    ("tick", t) | ("msg", topic, payload, origin) | ("event", Event).
+    Ticks fall on every integer-second boundary of the publish
+    timeline (same boundaries as client.replay_sequence, so the oracle
+    feed matches); at equal times a tick sorts before an event, and an
+    event before the messages published inside that second."""
+    entries = []        # (time, priority, insert-order, item)
+    order = 0
+    last_tick = None
+    for planned in plan.messages:
+        t = int(plan.genesis_time + int(planned.time_s))
+        if last_tick is None or t > last_tick:
+            entries.append((float(t), 0, order, ("tick", t)))
+            order += 1
+            last_tick = t
+        entries.append((plan.genesis_time + float(planned.time_s), 2,
+                        order, ("msg", planned.topic, planned.payload,
+                                int(planned.origin))))
+        order += 1
+    end = int(plan.genesis_time + plan.slot_time(plan.scenario.slots + 1))
+    if last_tick is None or end > last_tick:
+        entries.append((float(end), 0, order, ("tick", end)))
+        order += 1
+    for event in plan.scenario.sorted_events():
+        if event.kind not in SUPPORTED_EVENTS:
+            raise UnsupportedEvent(
+                f"process mesh cannot impose {event.kind!r} "
+                f"(supported: {sorted(SUPPORTED_EVENTS)})")
+        t = plan.genesis_time + plan.slot_time(event.at_slot)
+        entries.append((float(t), 1, order, ("event", event)))
+        order += 1
+    entries.sort(key=lambda e: (e[0], e[1], e[2]))
+    return [e[3] for e in entries]
+
+
+class ProcessMesh:
+    """N run_node.py processes in a full mesh, driven through one
+    scenario timeline.  Use as a context manager — __exit__ reaps every
+    process and removes the work directory even on failure."""
+
+    def __init__(self, scenario: Scenario, seed: int = 0,
+                 extra_args: dict | None = None, base_dir: str | None = None):
+        scenario.validate()
+        self.scenario = scenario
+        self.seed = int(seed)
+        self.spec = get_spec(scenario.fork, scenario.preset)
+        self.plan = TrafficPlan(self.spec, scenario,
+                                random.Random(self.seed))
+        self.extra_args = dict(extra_args or {})   # node index -> [argv]
+        self.workdir = tempfile.mkdtemp(prefix="mesh_", dir=base_dir)
+        n = scenario.nodes
+        self.sockets = [os.path.join(self.workdir, f"node{i}.sock")
+                        for i in range(n)]
+        self.dirs = [os.path.join(self.workdir, f"node{i}")
+                     for i in range(n)]
+        self.procs: list = [None] * n
+        self.clients: list = [None] * n
+        self.up = [False] * n
+        # node index -> set of blocked peer ids (current partition view)
+        self.blocked = [set() for _ in range(n)]
+        self.events_applied: list = []
+
+    # -- lifecycle -----------------------------------------------------
+
+    def __enter__(self) -> "ProcessMesh":
+        self.start()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.teardown(force=exc_type is not None)
+
+    def _spawn_args(self, i: int) -> list:
+        args = ["--node-id", f"node{i}"]
+        for j in range(self.scenario.nodes):
+            if j != i:
+                args += ["--peer", f"node{j}={self.sockets[j]}"]
+        args += [str(a) for a in self.extra_args.get(i, ())]
+        return args
+
+    def _spawn(self, i: int) -> None:
+        self.procs[i] = spawn_node(self.sockets[i], self.dirs[i],
+                                   *self._spawn_args(i))
+
+    def _connect(self, i: int) -> None:
+        self.clients[i] = NodeClient(
+            self.sockets[i], connect_timeout_s=CONNECT_TIMEOUT_S)
+        self.up[i] = True
+
+    def start(self) -> None:
+        for i in range(self.scenario.nodes):
+            self._spawn(i)
+        for i in range(self.scenario.nodes):
+            self._connect(i)
+
+    def up_nodes(self) -> list:
+        return [i for i in range(self.scenario.nodes) if self.up[i]]
+
+    # -- the timeline --------------------------------------------------
+
+    def run(self) -> None:
+        for item in mesh_agenda(self.plan):
+            if item[0] == "tick":
+                for i in self.up_nodes():
+                    self.clients[i].send_tick(item[1])
+                    self.clients[i].drain_responses()
+            elif item[0] == "msg":
+                _, topic, payload, origin = item
+                if self.up[origin]:
+                    self.clients[origin].send_message(
+                        topic, payload, peer=f"origin{origin}")
+                    self.clients[origin].drain_responses()
+            else:
+                self._apply_event(item[1])
+
+    def _apply_event(self, event) -> None:
+        self.events_applied.append((event.kind, dict(event.params)))
+        if event.kind == "partition":
+            groups = event.get("groups")
+            group_of = {n: set(g) for g in groups for n in g}
+            for i in range(self.scenario.nodes):
+                self.blocked[i] = {f"node{j}"
+                                   for j in range(self.scenario.nodes)
+                                   if j != i and j not in group_of[i]}
+            self._push_partition_view(self.up_nodes())
+        elif event.kind == "heal":
+            for s in self.blocked:
+                s.clear()
+            self._push_partition_view(self.up_nodes())
+            # reset() fires the links' on_heal auto-sync on each pump;
+            # an explicit pass here makes catch-up a synchronous fact
+            # before the timeline continues
+            for i in self.up_nodes():
+                self.clients[i].sync()
+        elif event.kind == "kill":
+            node = event.get("node")
+            # settle the victim first: ROOT drains its pipeline, so the
+            # pre-kill state is committed to the journal and recovery is
+            # a deterministic fact to assert (mid-WRITE kills are
+            # node_drill.py's job — this drill kills the mesh member)
+            self.clients[node].root()
+            os.kill(self.procs[node].pid, signal.SIGKILL)
+            self.procs[node].wait()
+            self.clients[node].close()
+            self.clients[node] = None
+            self.up[node] = False
+        elif event.kind == "recover":
+            node = event.get("node")
+            self._spawn(node)           # same --dir: txn.open_dir +
+            self._connect(node)         # recover repair the journal
+            # refresh EVERY node's partition view: links the survivors
+            # quarantined while the peer was dead reset here, and the
+            # restarted node learns any still-open partition
+            self._push_partition_view(self.up_nodes())
+            self.clients[node].sync()
+
+    def _push_partition_view(self, nodes, settle_s: float = 30.0) -> None:
+        """Install the current partition view on every node and re-push
+        until the links actually settle: a link whose reconnect budget
+        expires BETWEEN a respawn and the first refresh quarantines
+        itself (sticky by design) a beat after the reset — the control
+        plane re-arms until the view sticks."""
+        deadline = time.perf_counter() + settle_s
+        while True:
+            for i in nodes:
+                self.clients[i].set_blocked_peers(sorted(self.blocked[i]))
+            if self._links_settled() or time.perf_counter() >= deadline:
+                return
+            # speclint: disable=det-wall-clock -- real-process control
+            # plane: this polls OS-level link state on live sockets, no
+            # seeded replay decision flows through the wait
+            time.sleep(0.2)
+
+    def _links_settled(self) -> bool:
+        for i in self.up_nodes():
+            links = self.clients[i].health()["mesh"]["links"]
+            for peer_id, state in links.items():
+                if not self.up[int(peer_id.removeprefix("node"))]:
+                    continue
+                if peer_id in self.blocked[i]:
+                    if not state["blocked"]:
+                        return False
+                elif state["blocked"] or state["quarantined"] is not None:
+                    return False
+        return True
+
+    # -- convergence ---------------------------------------------------
+
+    def converge(self, max_passes: int = 8) -> tuple:
+        """Drive every node to the oracle fixpoint: re-offer each
+        message to its origin (idempotent), tick past the end, sync
+        everyone, compare roots.  Returns (oracle_hex, roots)."""
+        oracle = oracle_root(self.spec, self.plan)
+        end = int(self.plan.genesis_time
+                  + self.plan.slot_time(self.scenario.slots + 1))
+        roots = []
+        for _ in range(max_passes):
+            for planned in self.plan.messages:
+                client = self.clients[planned.origin]
+                client.send_message(planned.topic, planned.payload,
+                                    peer=f"origin{planned.origin}")
+                client.drain_responses()
+            for i in self.up_nodes():
+                self.clients[i].send_tick(end)
+                self.clients[i].drain_responses()
+            for i in self.up_nodes():
+                self.clients[i].sync()
+            roots = [self.clients[i].root() for i in self.up_nodes()]
+            if all(r == oracle for r in roots):
+                break
+        return oracle, roots
+
+    # -- reporting -----------------------------------------------------
+
+    def report(self) -> dict:
+        nodes = {}
+        for i in self.up_nodes():
+            client = self.clients[i]
+            nodes[f"node{i}"] = {
+                "root": client.root(),
+                "health": client.health(),
+                "incidents": client.incidents(),
+            }
+        return {"scenario": self.scenario.name, "seed": self.seed,
+                "events": list(self.events_applied), "nodes": nodes}
+
+    # -- teardown ------------------------------------------------------
+
+    def teardown(self, force: bool = False) -> dict:
+        """Graceful drain of every live node (SIGKILL on `force` or a
+        drain that hangs), reap every process, remove the work dir.
+        Returns {"orphan_procs": [...], "orphan_sockets": [...]} —
+        both empty is the drill's no-leak assertion."""
+        for i, client in enumerate(self.clients):
+            if client is None:
+                continue
+            if not force:
+                try:
+                    client.drain()
+                except (OSError, ConnectionError, AssertionError):
+                    pass
+            client.close()
+            self.clients[i] = None
+        orphan_procs = []
+        for i, proc in enumerate(self.procs):
+            if proc is None:
+                continue
+            try:
+                proc.wait(timeout=1.0 if force else DRAIN_TIMEOUT_S)
+            except Exception:
+                proc.kill()
+                try:
+                    proc.wait(timeout=10.0)
+                except Exception:
+                    orphan_procs.append(proc.pid)
+            if proc.stdout is not None:
+                proc.stdout.close()
+            if proc.stderr is not None:
+                proc.stderr.close()
+            self.up[i] = False
+        orphan_sockets = [p for p in self.sockets if os.path.exists(p)]
+        shutil.rmtree(self.workdir, ignore_errors=True)
+        return {"orphan_procs": orphan_procs,
+                "orphan_sockets": orphan_sockets}
+
+
+def run_scenario_processes(scenario: Scenario, seed: int = 0,
+                           extra_args: dict | None = None,
+                           max_passes: int = 8) -> dict:
+    """One full drill round: spawn the mesh, walk the timeline,
+    converge, report, tear down.  The report gains "oracle", "roots",
+    "converged", "wall_s" and the teardown's leak lists."""
+    t0 = time.perf_counter()
+    mesh = ProcessMesh(scenario, seed=seed, extra_args=extra_args)
+    try:
+        mesh.start()
+        mesh.run()
+        oracle, roots = mesh.converge(max_passes=max_passes)
+        report = mesh.report()
+        leaks = mesh.teardown()
+    except BaseException:
+        mesh.teardown(force=True)
+        raise
+    report["oracle"] = oracle
+    report["roots"] = roots
+    report["converged"] = bool(roots) and all(r == oracle for r in roots)
+    report["wall_s"] = time.perf_counter() - t0
+    report.update(leaks)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# the drill matrix (scripts/mesh_drill.py, soak's SOAK_MESH leg and the
+# bench mesh tier all draw from here)
+# ---------------------------------------------------------------------------
+
+MESH_PART = Scenario(
+    name="mesh_part", nodes=3, slots=4,
+    events=(partition(2.0, ((0, 1), (2,))), heal(3.0)))
+
+MESH_KILL = Scenario(
+    name="mesh_kill", nodes=3, slots=5, durable=True,
+    events=(kill(2.2, node=1), recover(3.2, node=1)))
+
+MESH_SMOKE = Scenario(name="mesh_smoke", nodes=3, slots=4)
+
+# node 2 damages its OWN outbound link frames (one flipped bit per
+# fire): receivers shed on CRC and quarantine the inbound connection,
+# node 2's link layer records the injection — and anti-entropy still
+# converges the fleet
+# speclint: disable=global-mutable-state -- read-only drill fixture:
+# ProcessMesh copies it at construction and nothing writes through it
+_CORRUPT_ARGS = {2: ("--fault-site", "mesh.link", "--fault-kind",
+                     "corrupt", "--fault-nth", "3", "--fault-fires", "2")}
+
+DRILL_CASES = (
+    # (case name, scenario, per-node extra argv)
+    ("partition_heal", MESH_PART, None),
+    ("kill_recover", MESH_KILL, None),
+    ("link_corrupt", MESH_SMOKE, _CORRUPT_ARGS),
+    ("blackout3", LIBRARY["blackout3"], None),
+)
+
+
+def drill_case(name: str) -> tuple:
+    for case, scenario, extra in DRILL_CASES:
+        if case == name:
+            return case, scenario, extra
+    raise KeyError(f"unknown drill case {name!r}; "
+                   f"known: {[c[0] for c in DRILL_CASES]}")

@@ -39,12 +39,18 @@ abandonment on, the flush may no longer write shared caches
 SCOPE.  The engine is process-global and deliberately SYNCHRONOUS in
 two situations: `ASYNC_FLUSH=0` (the escape hatch — every submit runs
 inline on the caller, byte-identical by construction since the worker
-would execute the very same closure), and whenever a node context is
-installed (utils/nodectx.py): the context stack is process-global, so
-overlapping two nodes' flushes would interleave push/pop and
-mis-attribute exactly the incidents the scenario tier asserts on —
-fleet simulations therefore run inline, and per-node async is the
-ROADMAP's namespaced-breaker follow-up.
+would execute the very same closure), and whenever a TRANSIENT node
+context is installed (utils/nodectx.py): the context stack is
+process-global, so overlapping two simulated nodes' flushes would
+interleave push/pop and mis-attribute exactly the incidents the
+scenario tier asserts on — fleet simulations therefore run inline.  A
+RESIDENT context (`nodectx.pin`, the real node process's one-process/
+one-node wiring) is exempt: it sits at the base of the stack for the
+process's whole lifetime, every worker thread resolves to the same
+context with no push/pop to interleave, so the node process's device
+verifies genuinely pipeline (the mesh PR lifted the old blanket
+restriction; tests/test_node.py pins async-on/off byte parity of the
+served roots).
 
 Observability (sigpipe metrics): `async_flushes` / `inline_flushes`,
 `flush_overlap_ns` (wall nanoseconds of worker device work that
@@ -101,11 +107,16 @@ def reset() -> None:
 
 
 def overlap_live() -> bool:
-    """True when a submit would actually overlap: async on AND no node
-    context installed (the nodectx stack is process-global — overlapped
-    per-node flushes would interleave its push/pop; scenario fleets run
-    inline)."""
-    return enabled() and nodectx.current() is None
+    """True when a submit would actually overlap: async on AND either
+    no node context installed or the active context is process-RESIDENT
+    (`nodectx.pin` — the real node process).  A transient context (a
+    scenario SimNode's `use()` push) still forces inline: the stack is
+    process-global, and overlapping two simulated nodes' flushes would
+    interleave its push/pop and mis-attribute their records."""
+    if not enabled():
+        return False
+    ctx = nodectx.current()
+    return ctx is None or getattr(ctx, "resident", False)
 
 
 class FlushTicket:

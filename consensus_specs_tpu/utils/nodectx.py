@@ -71,10 +71,20 @@ class NodeContext:
     rest of the fleet on the device path.  Leaving a slot at None keeps
     that singleton on the process-global default, exactly like
     metrics/incidents.
+
+    `resident` marks a context that :func:`pin` installed as the
+    PROCESS-OWNING base of the stack (a real node process serving one
+    node for its whole lifetime).  A resident context is the opposite
+    of a scenario SimNode's transient push: every thread in the process
+    — conn readers, link workers, the async flush engine's workers —
+    resolves to it by default, so cross-thread records attribute
+    correctly without each thread pushing/popping, and the async
+    engine's forced-inline rule does not apply (pipeline_async
+    `overlap_live`).
     """
 
     __slots__ = ("node_id", "metrics", "incidents",
-                 "supervisor", "fault_plan", "guard")
+                 "supervisor", "fault_plan", "guard", "resident")
 
     def __init__(self, node_id: str, metrics=None, incidents=None,
                  supervisor=None, fault_plan=None, guard=None):
@@ -84,6 +94,7 @@ class NodeContext:
         self.supervisor = supervisor
         self.fault_plan = fault_plan
         self.guard = guard
+        self.resident = False
 
     def __repr__(self) -> str:
         return f"NodeContext({self.node_id!r})"
@@ -183,6 +194,30 @@ def current() -> NodeContext | None:
     """The innermost installed context, or None (process-global mode)."""
     with _lock:
         return _stack[-1] if _stack else None
+
+
+def pin(ctx: NodeContext) -> NodeContext:
+    """Install `ctx` as the process-RESIDENT base context: it sits at
+    the BOTTOM of the stack (transient `use()` pushes still shadow it)
+    and stays installed until :func:`unpin`.  This is the real node
+    process's wiring — one process, one node, every thread's records
+    attributed to it — and what lifts the async flush engine's
+    forced-inline rule (`pipeline_async.overlap_live`): with a single
+    resident context there is no per-node push/pop to interleave.
+    Reentrant-safe: pinning an already-pinned context is a no-op."""
+    ctx.resident = True
+    with _lock:
+        if ctx not in _stack:
+            _stack.insert(0, ctx)
+    return ctx
+
+
+def unpin(ctx: NodeContext) -> None:
+    """Remove a pinned context (service shutdown / test teardown)."""
+    ctx.resident = False
+    with _lock:
+        while ctx in _stack:
+            _stack.remove(ctx)
 
 
 @contextmanager

@@ -9,9 +9,16 @@ journal) and exits 0 within --drain-deadline.  SIGKILL it instead and
 the same --dir recovers on the next start through txn.open_dir (torn
 tail repair) + txn.recover.
 
---kill-site/--kill-nth arm the drill's in-process SIGKILL plan: the
-process shoots itself at the nth consultation of the named barrier
-(see scripts/node_drill.py).
+Mesh mode: pass --node-id and one --peer ID=SOCKET_PATH per neighbour
+to run a MeshNodeService — admitted gossip floods to the peers over
+their own framed sockets, with anti-entropy repair after partitions
+(see scripts/mesh_drill.py).  --http-port adds the JSON ingest door.
+
+Fault arming (drill mode):
+--kill-site/--kill-nth SIGKILL the process at the nth consultation of
+the named site; --fault-site/--fault-kind/--fault-nth/--fault-fires
+arm a seeded fault (drop/delay/corrupt) from the nth consultation —
+both on the node's OWN fault-plan slot.
 """
 import argparse
 import os
@@ -38,14 +45,25 @@ def main() -> int:
     p.add_argument("--drain-deadline", type=float, default=30.0)
     p.add_argument("--real-bls", action="store_true",
                    help="verify with real BLS (default: stubbed)")
+    p.add_argument("--node-id", default=None,
+                   help="mesh identity (enables MeshNodeService)")
+    p.add_argument("--peer", action="append", default=[],
+                   metavar="ID=SOCKET_PATH",
+                   help="one mesh neighbour (repeatable)")
+    p.add_argument("--http-port", type=int, default=None,
+                   help="bind the HTTP/JSON ingest door (0 = ephemeral)")
     p.add_argument("--kill-site", default=None,
                    help="SIGKILL self at this barrier (drill mode)")
     p.add_argument("--kill-nth", type=int, default=1)
+    p.add_argument("--fault-site", default=None,
+                   help="arm a seeded fault at this site (drill mode)")
+    p.add_argument("--fault-kind", default="raise",
+                   choices=("raise", "timeout", "corrupt"))
+    p.add_argument("--fault-nth", type=int, default=1)
+    p.add_argument("--fault-fires", type=int, default=1)
     args = p.parse_args()
 
-    from consensus_specs_tpu.node import NodeConfig, NodeService
-
-    service = NodeService(NodeConfig(
+    common = dict(
         socket_path=args.socket, data_dir=args.dir,
         fork=args.fork, preset=args.preset, fsync_policy=args.fsync,
         segment_bytes=args.segment_bytes,
@@ -53,14 +71,30 @@ def main() -> int:
         ingest_bound=args.ingest_bound,
         health_every_s=args.health_every,
         drain_deadline_s=args.drain_deadline,
-        stub_bls=not args.real_bls))
+        stub_bls=not args.real_bls,
+        http_port=args.http_port)
 
-    if args.kill_site:
+    if args.node_id is not None or args.peer:
+        from consensus_specs_tpu.mesh import MeshConfig, MeshNodeService
+        peers = []
+        for spec in args.peer:
+            peer_id, _, path = spec.partition("=")
+            if not peer_id or not path:
+                p.error(f"--peer wants ID=SOCKET_PATH, got {spec!r}")
+            peers.append((peer_id, path))
+        service = MeshNodeService(MeshConfig(
+            node_id=args.node_id or "node0", peers=tuple(peers),
+            **common))
+    else:
+        from consensus_specs_tpu.node import NodeConfig, NodeService
+        service = NodeService(NodeConfig(**common))
+
+    if args.kill_site or args.fault_site:
         from consensus_specs_tpu.resilience import faults
 
         class KillPlan(faults.FaultPlan):
             """SIGKILL this process at the nth consultation of one
-            node/txn barrier — the drill's crash injector."""
+            node/txn/mesh site — the drill's crash injector."""
 
             def __init__(self, site, nth):
                 super().__init__([], seed=0)
@@ -75,11 +109,38 @@ def main() -> int:
                         os.kill(os.getpid(), signal.SIGKILL)
                 return None
 
+        class NthPlan(faults.FaultPlan):
+            """Fire a seeded fault spec from the nth consultation of
+            one site onward — the drill's link-damage injector.  The
+            super().decide() path keeps the canonical 'injected'
+            incident/metric recording."""
+
+            def __init__(self, site, kind, nth, fires):
+                super().__init__(
+                    [faults.FaultSpec(site, kind, rate=1.0,
+                                      max_fires=int(fires))], seed=0)
+                self._target = site
+                self._nth = int(nth)
+                self._count = 0
+
+            def decide(self, site):
+                if site != self._target:
+                    return None
+                self._count += 1
+                if self._count < self._nth:
+                    return None
+                return super().decide(site)
+
         # arm on the node's OWN fault-plan slot: under nodectx.use the
         # router resolves through the context, so a globally injected
         # plan would be masked
-        service.ctx.fault_plan.value = KillPlan(args.kill_site,
-                                                args.kill_nth)
+        if args.kill_site:
+            service.ctx.fault_plan.value = KillPlan(args.kill_site,
+                                                    args.kill_nth)
+        else:
+            service.ctx.fault_plan.value = NthPlan(
+                args.fault_site, args.fault_kind, args.fault_nth,
+                args.fault_fires)
 
     print(f"[node] pid={os.getpid()} socket={args.socket} "
           f"dir={args.dir} recovered={service.recovered}", flush=True)

@@ -29,6 +29,13 @@ dir, and assert the recovered store root converges byte-identically
 to the in-process oracle — the nightly-soak shape of `make
 node-drill`.
 
+With SOAK_MESH=1 those interleaved rounds run a short real-process
+MESH drill instead (or alternate with node rounds when both are set):
+three `scripts/run_node.py` processes meshed over their sockets ride
+the partition+heal timeline (`make mesh-drill` quick case) and must
+converge byte-identically to the oracle with no orphaned process or
+socket — the nightly-soak shape of the mesh drill.
+
 Environment:
     SOAK_SECONDS     wall-clock budget (default 300); the current
                      round always finishes
@@ -37,8 +44,11 @@ Environment:
     SOAK_SEED        master seed (default 20260804)
     SOAK_NODES       fixed node count for randomized rounds (optional)
     SOAK_NODE        1 = interleave real-process front-door rounds
+    SOAK_MESH        1 = interleave real-process mesh drill rounds
     SOAK_REPORT      report path (default: the next free SOAK_r0N.json
-                     — per-run reports archive instead of overwriting)
+                     — per-run reports archive instead of overwriting;
+                     the slot is claimed with O_CREAT|O_EXCL so racing
+                     soaks cannot clobber each other)
 
 Exit status: 0 with `"ok": true` in the report, 1 on any violated
 contract (the report records the failure first).  Under SPECLINT_TSAN=1
@@ -106,15 +116,23 @@ def _env_int(name: str, default: int) -> int:
 
 
 def _next_report_path() -> str:
-    """SOAK_REPORT wins; otherwise archive under the next free
-    SOAK_r0N.json so successive soaks never overwrite each other."""
+    """SOAK_REPORT wins; otherwise CLAIM the next free SOAK_r0N.json
+    slot atomically (O_CREAT|O_EXCL) so two soaks racing the rotation
+    can never pick the same slot — the old exists()-then-open gap let
+    a pair of concurrent runs both see r02 free and clobber each
+    other's report."""
     explicit = os.environ.get("SOAK_REPORT", "")
     if explicit:
         return explicit
     n = 1
-    while os.path.exists(f"SOAK_r{n:02d}.json"):
-        n += 1
-    return f"SOAK_r{n:02d}.json"
+    while True:
+        path = f"SOAK_r{n:02d}.json"
+        try:
+            os.close(os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY,
+                             0o644))
+            return path
+        except FileExistsError:
+            n += 1
 
 
 def _round_scenario(index: int, rng: random.Random):
@@ -246,6 +264,49 @@ def _run_node_round(seed: int) -> dict:
     }
 
 
+def _run_mesh_round(seed: int) -> dict:
+    """One short real-process mesh drill round: the partition+heal
+    case from the drill matrix (scenario/processes.py) — three meshed
+    run_node.py processes, a PEERS-frame partition, a heal with
+    anti-entropy — asserting byte-identical convergence to the oracle
+    and a leak-free teardown."""
+    from consensus_specs_tpu.scenario.processes import (
+        MESH_PART, run_scenario_processes)
+
+    report = run_scenario_processes(MESH_PART, seed=seed)
+    assert report["converged"], \
+        f"mesh round diverged: oracle {report['oracle'][:16]}… vs " \
+        f"roots {[r[:16] for r in report['roots']]}"
+    assert not report["orphan_procs"] and not report["orphan_sockets"], \
+        f"mesh round leaked: procs={report['orphan_procs']} " \
+        f"sockets={report['orphan_sockets']}"
+    nodes = report["nodes"]
+    assert any(
+        any(e.get("event") == "link_healed" for e in n["incidents"])
+        for n in nodes.values()), \
+        "mesh round: no node recorded the heal (link_healed)"
+    forwarded = sum(n["health"]["mesh"]["forwarded"]
+                    for n in nodes.values())
+    disk_hw = max(int(n["health"]["journal"]["disk_bytes"])
+                  for n in nodes.values())
+    return {
+        "scenario": "mesh:partition_heal",
+        "seed": seed,
+        "nodes": len(nodes),
+        "events": len(MESH_PART.events),
+        "feed_size": forwarded,
+        "disk_hw_bytes": disk_hw,
+        "segments_at_end": sum(int(n["health"]["journal"]["segments"])
+                               for n in nodes.values()),
+        "compactions": 0,
+        "faults_per_node": {name: 0 for name in nodes},
+        "breaker_trips": 0,
+        "breaker_restores": 0,
+        "mesh_forwarded": forwarded,
+        "mesh_wall_s": report["wall_s"],
+    }
+
+
 def _write_report(path: str, payload: dict) -> None:
     tmp = f"{path}.tmp"
     with open(tmp, "w") as fh:
@@ -259,6 +320,7 @@ def main() -> int:
     min_rounds = _env_int("SOAK_MIN_ROUNDS", 3)
     master_seed = _env_int("SOAK_SEED", 20260804)
     node_leg = os.environ.get("SOAK_NODE", "") == "1"
+    mesh_leg = os.environ.get("SOAK_MESH", "") == "1"
     report_path = _next_report_path()
     rng = random.Random(master_seed)
 
@@ -307,8 +369,13 @@ def main() -> int:
         while index < min_rounds or time.monotonic() < deadline:
             seed = master_seed + index
             t0 = time.monotonic()
-            if node_leg and index % 3 == 2:
-                entry = _run_node_round(seed)
+            if (node_leg or mesh_leg) and index % 3 == 2:
+                # the real-process slot: node and mesh legs alternate
+                # when both are armed
+                if mesh_leg and (not node_leg or (index // 3) % 2 == 1):
+                    entry = _run_mesh_round(seed)
+                else:
+                    entry = _run_node_round(seed)
             else:
                 sc = _round_scenario(index, rng)
                 entry = _run_round(sc, seed)

@@ -1,0 +1,300 @@
+"""Mesh link-layer contracts (mesh/link.py + mesh/service.py).
+
+The process-level legs — real SIGKILLs, PEERS-frame partitions,
+anti-entropy over sockets — live in scripts/mesh_drill.py.  This file
+pins the in-process contracts the drill assumes:
+
+* backoff is exponential, capped, and jitter-bounded;
+* a peer that restarts ten times costs reconnects, never a quarantine;
+* a half-open peer (accepts, never reads) stalls a send for at most
+  `send_timeout_s`, and a dead one burns the bounded reconnect budget
+  into a sticky, incident-logged quarantine — offers drop, nothing
+  raises;
+* framing damage in the response stream quarantines THAT link and the
+  owning node keeps serving; `reset()` heals it;
+* the content-addressed dedup stops flood loops on a cyclic topology.
+"""
+import os
+import random
+import socket
+import tempfile
+import threading
+import time
+
+import pytest
+
+from consensus_specs_tpu.mesh.link import (
+    LINK_SITE, LinkConfig, PeerLink, backoff_delay)
+from consensus_specs_tpu.node import wire
+from consensus_specs_tpu.resilience.incidents import IncidentLog
+from consensus_specs_tpu.sigpipe.metrics import Metrics
+from consensus_specs_tpu.utils import nodectx
+
+
+def make_ctx(name="linktest"):
+    return nodectx.NodeContext(
+        name, metrics=Metrics(node_id=name),
+        incidents=IncidentLog(max_entries=4096, node_id=name))
+
+
+def _recv_exact(conn, n):
+    buf = b""
+    conn.settimeout(10.0)
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer stream ended")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(conn):
+    header = _recv_exact(conn, wire.HEADER.size)
+    _, body_len, _ = wire.HEADER.unpack(header)
+    return _recv_exact(conn, body_len)
+
+
+def _listener(path, backlog=8):
+    if os.path.exists(path):
+        os.unlink(path)
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.bind(path)
+    sock.listen(backlog)
+    return sock
+
+
+def _wait_until(predicate, deadline_s=20.0, what="condition"):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.fixture
+def sock_dir():
+    with tempfile.TemporaryDirectory(prefix="mesh-test-") as d:
+        yield d
+
+
+# -- backoff ------------------------------------------------------------
+
+def test_backoff_growth_and_jitter_bounds():
+    cfg = LinkConfig(backoff_base_s=0.05, backoff_max_s=2.0,
+                     backoff_jitter=0.25)
+    rng = random.Random(7)
+    for attempt in range(12):
+        base = min(0.05 * (2 ** attempt), 2.0)
+        for _ in range(64):
+            delay = backoff_delay(cfg, attempt, rng)
+            assert base <= delay < base * 1.25, (attempt, delay)
+    # jitter off: pure doubling until the cap
+    flat = LinkConfig(backoff_base_s=0.05, backoff_max_s=2.0,
+                      backoff_jitter=0.0)
+    seq = [backoff_delay(flat, a, rng) for a in range(8)]
+    assert seq == [0.05, 0.1, 0.2, 0.4, 0.8, 1.6, 2.0, 2.0]
+
+
+# -- reconnect storm ----------------------------------------------------
+
+def test_reconnect_storm_peer_restarts_ten_times(sock_dir):
+    """The peer binds, serves one frame, and vanishes — ten times over.
+    The link rides every outage through backoff and never quarantines:
+    a successful send re-arms the budget."""
+    path = os.path.join(sock_dir, "peer.sock")
+    rounds = 10
+    served = []
+
+    def peer():
+        for _ in range(rounds):
+            listener = _listener(path)
+            conn, _ = listener.accept()
+            _recv_frame(conn)
+            served.append(1)
+            conn.close()
+            listener.close()
+            os.unlink(path)
+            time.sleep(0.01)
+
+    thread = threading.Thread(target=peer, daemon=True)
+    thread.start()
+    ctx = make_ctx()
+    link = PeerLink("peer", path, ctx, LinkConfig(
+        queue_bound=64, connect_timeout_s=0.5, reconnect_max=10_000,
+        backoff_base_s=0.005, backoff_max_s=0.05),
+        rng=random.Random(1))
+    link.start()
+    frame = wire.frame(wire.KIND_TICK, (1, 1))
+    try:
+        deadline = time.monotonic() + 30.0
+        while len(served) < rounds and time.monotonic() < deadline:
+            link.offer(frame)
+            time.sleep(0.005)
+        thread.join(timeout=10.0)
+        assert len(served) == rounds, "storm never completed"
+        state = link.state()
+        assert state["connects"] >= rounds
+        assert state["quarantined"] is None
+        assert ctx.incidents.count("link_quarantined", LINK_SITE) == 0
+    finally:
+        link.close()
+
+
+# -- half-open peer -----------------------------------------------------
+
+def test_half_open_peer_times_out_then_quarantines(sock_dir):
+    """A peer that accepts but never reads stalls `sendall` for at most
+    `send_timeout_s` per attempt; the bounded budget then turns the
+    half-open link into a sticky quarantine — no hang, no exception,
+    nothing ever counted sent."""
+    path = os.path.join(sock_dir, "peer.sock")
+    listener = _listener(path)       # connects queue in the backlog;
+    ctx = make_ctx()                 # nobody ever accepts or reads
+    link = PeerLink("peer", path, ctx, LinkConfig(
+        send_timeout_s=0.2, reconnect_max=2, connect_timeout_s=1.0,
+        backoff_base_s=0.01, backoff_max_s=0.02),
+        rng=random.Random(2))
+    link.start()
+    # far past any unix-socket buffer: the send MUST stall
+    big = wire.frame(wire.KIND_MESSAGE, (1, "t", "p", b"\x00" * (1 << 21)))
+    try:
+        t0 = time.monotonic()
+        assert link.offer(big)
+        _wait_until(lambda: link.state()["quarantined"] is not None,
+                    what="half-open quarantine")
+        elapsed = time.monotonic() - t0
+        state = link.state()
+        assert "reconnect budget exhausted" in state["quarantined"]
+        assert state["sent"] == 0
+        assert elapsed < 10.0, "send timeout did not bound the stall"
+        assert ctx.incidents.count("link_quarantined", LINK_SITE) == 1
+        # quarantine is sticky: offers drop without blocking
+        assert link.offer(big) is False
+        assert link.state()["dropped"] >= 1
+    finally:
+        link.close()
+        listener.close()
+
+
+# -- response-stream corruption -----------------------------------------
+
+def test_corrupt_response_frame_quarantines_only_that_link(sock_dir):
+    """Garbage in a peer's response stream is a WireError at the
+    deframer: the link quarantines itself (incident-logged) and the
+    owner keeps running; `reset()` heals it and frames flow again."""
+    path = os.path.join(sock_dir, "peer.sock")
+    clean = []
+
+    def peer():
+        listener = _listener(path)
+        conn, _ = listener.accept()
+        _recv_frame(conn)
+        conn.sendall(b"\x00" * 16)          # not a frame: bad magic
+        # second life: after reset() the link reconnects and the peer
+        # serves normally
+        conn2, _ = listener.accept()
+        _recv_frame(conn2)
+        clean.append(1)
+        conn.close()
+        conn2.close()
+        listener.close()
+
+    thread = threading.Thread(target=peer, daemon=True)
+    thread.start()
+    ctx = make_ctx()
+    link = PeerLink("peer", path, ctx, LinkConfig(
+        connect_timeout_s=1.0, backoff_base_s=0.01, backoff_max_s=0.05),
+        rng=random.Random(3))
+    link.start()
+    frame = wire.frame(wire.KIND_TICK, (1, 1))
+    try:
+        # keep offering: the garbage is only noticed on the drain after
+        # a send, so one frame may not be enough to trip it
+        def quarantined():
+            link.offer(frame)
+            return link.state()["quarantined"] is not None
+        _wait_until(quarantined, what="corrupt-response quarantine")
+        assert "corrupt response frame" in link.state()["quarantined"]
+        assert ctx.incidents.count("link_quarantined", LINK_SITE) == 1
+        # the owner is not dead: healing the link restores service
+        link.reset()
+        assert link.healthy()
+        assert ctx.incidents.count("link_healed", LINK_SITE) == 1
+        sent_before = link.state()["sent"]
+
+        def resent():
+            link.offer(frame)
+            return link.state()["sent"] > sent_before
+        _wait_until(resent, what="post-heal resend")
+        thread.join(timeout=10.0)
+        assert clean == [1]
+    finally:
+        link.close()
+
+
+# -- flood-loop dedup (3-cycle of real services) ------------------------
+
+@pytest.mark.slow
+def test_dedup_prevents_flood_loops_on_three_cycle(tmp_path):
+    """Three MeshNodeServices in a full cycle (every pair linked both
+    ways): one message submitted at node0 reaches every node EXACTLY
+    once and the flood terminates — each node forwards it once, the
+    copies coming back around shed on the content-addressed dedup
+    before the transport seam can re-fire."""
+    from consensus_specs_tpu.mesh import MeshConfig, MeshNodeService
+    from consensus_specs_tpu.node.client import build_plan, \
+        replay_sequence
+
+    socks = [str(tmp_path / f"node{i}.sock") for i in range(3)]
+    services = []
+    try:
+        for i in range(3):
+            config = MeshConfig(
+                socket_path=socks[i],
+                data_dir=str(tmp_path / f"node{i}"),
+                segment_bytes=4096, snapshot_interval=16,
+                ingest_bound=256, node_id=f"node{i}",
+                peers=tuple((f"node{j}", socks[j])
+                            for j in range(3) if j != i))
+            svc = MeshNodeService(config)
+            svc.server.start()
+            svc._pump.start()
+            services.append(svc)
+
+        # the smoke plan opens with (tick, slot-1 block from origin0):
+        # one self-contained admissible message to flood
+        _, plan = build_plan("smoke", 1)
+        seq = replay_sequence(plan)
+        assert seq[0][0] == "tick" and seq[1][0] == "msg"
+        responses = []
+        for svc in services:        # every node agrees on the time
+            svc.handle(wire.KIND_TICK, (1, seq[0][1]), responses.append)
+        services[0].handle(
+            wire.KIND_MESSAGE, (2, seq[1][1], seq[1][3], seq[1][2]),
+            responses.append)
+        _wait_until(
+            lambda: all(s.ctx.metrics.count_labeled("gossip_accepted")
+                        >= 1 for s in services),
+            deadline_s=60.0, what="flood to reach every node")
+        # the flood must TERMINATE: forwards stop growing
+        counts = None
+        for _ in range(50):
+            time.sleep(0.1)
+            now = [s.ctx.metrics.count("mesh_forwarded")
+                   for s in services]
+            if now == counts:
+                break
+            counts = now
+        for svc in services:
+            # exactly one forward each: the first arrival re-offers to
+            # its other peers, every echo sheds on dedup pre-transport
+            assert svc.ctx.metrics.count("mesh_forwarded") == 1
+            assert svc.ctx.metrics.count_labeled("gossip_accepted") == 1
+    finally:
+        for svc in services:
+            svc._stopping = True
+            with svc._cond:
+                svc._cond.notify()
+            svc._pump.join(timeout=10.0)
+            svc.close()

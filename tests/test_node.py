@@ -14,6 +14,7 @@ drill assumes:
   journal is fsynced and closed before ``drain_done`` is declared, and
   the drained store root is byte-identical to the sequential oracle.
 """
+import json
 import tempfile
 import time
 
@@ -111,10 +112,9 @@ def service():
     try:
         yield svc
     finally:
-        if svc._bls_guard is not None:
-            svc._bls_guard.__exit__(None, None, None)
-        svc.server.close()
-        svc.journal.close()
+        # close() also UNPINS the resident context — without it the
+        # next test's records would attribute to this node
+        svc.close()
         import shutil
         shutil.rmtree(work, ignore_errors=True)
 
@@ -214,3 +214,175 @@ def test_graceful_drain_ordering_and_oracle_root(service):
     # the drained store still carries the oracle bytes
     from consensus_specs_tpu import txn
     assert txn.store_root(service.store).hex() == oracle_root(spec, plan)
+
+
+# -- async residency (pipeline_async x nodectx.pin) ---------------------
+
+def test_resident_context_lifts_forced_inline(service):
+    """The node fixture pinned its context as process-resident, so the
+    async flush engine's forced-inline rule is lifted; a transient
+    `use()` push on top of it forces inline again (scenario SimNode
+    semantics are unchanged)."""
+    from consensus_specs_tpu.sigpipe import pipeline_async
+    from consensus_specs_tpu.utils import nodectx
+    try:
+        pipeline_async.enable()
+        assert nodectx.current() is service.ctx
+        assert service.ctx.resident
+        assert pipeline_async.overlap_live()
+        transient = nodectx.NodeContext("transient")
+        with nodectx.use(transient):
+            assert not pipeline_async.overlap_live()
+        assert pipeline_async.overlap_live()
+        pipeline_async.disable()
+        assert not pipeline_async.overlap_live()
+    finally:
+        pipeline_async.reset()
+
+
+@pytest.mark.slow
+def test_async_on_off_served_roots_byte_identical():
+    """Satellite pin: the SAME replay through two services — async
+    flush engine on vs forced off — serves byte-identical roots.  The
+    overlap engine may reorder device work, never verdicts."""
+    from consensus_specs_tpu.sigpipe import pipeline_async
+    spec, plan = build_plan("smoke", 1)
+    seq = replay_sequence(plan)
+    roots = {}
+    for mode in ("on", "off"):
+        work = tempfile.mkdtemp(prefix=f"node-async-{mode}-")
+        (pipeline_async.enable if mode == "on"
+         else pipeline_async.disable)()
+        svc = NodeService(NodeConfig(
+            socket_path=f"{work}/node.sock", data_dir=f"{work}/data",
+            segment_bytes=4096, snapshot_interval=16,
+            ingest_bound=4096))
+        try:
+            assert pipeline_async.overlap_live() == (mode == "on")
+            svc._pump.start()
+            responses = []
+            last = None
+            for _ in range(4):                  # fixpoint replay
+                nid = [len(responses) * 1000]
+                for item in seq:
+                    nid[0] += 1
+                    if item[0] == "tick":
+                        svc.handle(wire.KIND_TICK, (nid[0], item[1]),
+                                   responses.append)
+                    else:
+                        svc.handle(
+                            wire.KIND_MESSAGE,
+                            (nid[0], item[1], item[3], item[2]),
+                            responses.append)
+                _pump_until_idle(svc)
+                got = []
+                svc.handle(wire.KIND_ROOT, nid[0] + 1,
+                           lambda r: got.append(r["root"]))
+                # _pump_until_idle can return while the pump is still
+                # INSIDE the dequeued root item (queue empty, control
+                # items never inflight) — wait for the respond itself
+                deadline = time.monotonic() + 60
+                while not got and time.monotonic() < deadline:
+                    time.sleep(0.02)
+                assert got, "root respond never arrived"
+                if got[-1] == last:
+                    break
+                last = got[-1]
+            roots[mode] = last
+        finally:
+            pipeline_async.reset()
+            svc._stopping = True
+            with svc._cond:
+                svc._cond.notify()
+            svc._pump.join(timeout=30)
+            svc.close()
+            import shutil
+            shutil.rmtree(work, ignore_errors=True)
+    assert roots["on"] == roots["off"] == oracle_root(spec, plan)
+
+
+# -- the HTTP/JSON door -------------------------------------------------
+
+@pytest.fixture
+def http_service(service):
+    from consensus_specs_tpu.node.http import HttpIngest
+    service._pump.start()
+    http = HttpIngest(service, "127.0.0.1", 0)
+    http.start()
+    try:
+        yield service, http.port
+    finally:
+        http.stop()
+        service._stopping = True
+        with service._cond:
+            service._cond.notify()
+        service._pump.join(timeout=30)
+
+
+def _http_json(port, method, path, body=None):
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        import json as _json
+        payload = None if body is None else _json.dumps(body)
+        conn.request(method, path, body=payload)
+        resp = conn.getresponse()
+        return resp.status, _json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def test_http_ingest_same_verdicts_as_socket(http_service):
+    """POST /ingest rides the same bounded ingest queue as the framed
+    socket: same admission verdicts, same health, same root."""
+    from consensus_specs_tpu.txn.codec import encode_value
+    service, port = http_service
+    spec, plan = build_plan("smoke", 1)
+    seq = replay_sequence(plan)
+    tick = next(i for i in seq if i[0] == "tick")
+    msg = next(i for i in seq if i[0] == "msg")
+    status, verdict = _http_json(port, "POST", "/tick",
+                                 {"id": 1, "time": tick[1]})
+    assert (status, verdict["status"]) == (200, "ok")
+    status, verdict = _http_json(
+        port, "POST", "/ingest",
+        {"id": 2, "topic": msg[1], "peer": msg[3],
+         "value": encode_value(msg[2]).hex()})
+    assert status == 200
+    assert verdict["status"] in ("accepted", "rejected", "deferred")
+    assert service.ctx.metrics.count_labeled("gossip_submitted") >= 1
+    status, health = _http_json(port, "GET", "/health")
+    assert status == 200 and health["store"]["time"] == tick[1]
+    status, root = _http_json(port, "GET", "/root")
+    assert status == 200 and len(root["root"]) == 64
+
+
+def test_http_malformed_sheds_with_incident_never_crashes(http_service):
+    """Malformed JSON, bad hex, bad shapes: every one answers 400 with
+    a shed body + malformed_frame incident — the node keeps serving."""
+    import http.client
+    service, port = http_service
+    before = service.ctx.incidents.count("malformed_frame")
+    bad = [
+        ("POST", "/ingest", b"{not json"),
+        ("POST", "/ingest", b'"a string, not an object"'),
+        ("POST", "/ingest", b'{"id": 1, "topic": "beacon_block"}'),
+        ("POST", "/ingest", b'{"id": 1, "topic": "beacon_block", '
+                            b'"peer": "p", "value": "zz"}'),
+        ("POST", "/tick", b'{"id": "x", "time": "y"}'),
+        ("POST", "/nowhere", b"{}"),
+    ]
+    for method, path, body in bad:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        try:
+            conn.request(method, path, body=body)
+            resp = conn.getresponse()
+            payload = json.loads(resp.read())
+        finally:
+            conn.close()
+        assert resp.status in (400, 404), (path, resp.status)
+        assert payload["status"] == "shed"
+    assert service.ctx.incidents.count("malformed_frame") > before
+    # still serving after the abuse
+    status, health = _http_json(port, "GET", "/health")
+    assert status == 200 and "ingest" in health
