@@ -280,12 +280,15 @@ factory-bench:
 node-bench:
 	env JAX_PLATFORMS=cpu $(PYTHON) bench.py node
 
-# fleet front-door bench (mesh/): three real run_node.py processes in
-# a full mesh — the partition+heal drill timeline with zero divergence
-# and per-hop p50/p99 admission→delivery latency, then a partition
-# flood against a tiny ingest bound asserting bounded shed, surviving
-# processes, and byte-identical post-heal convergence; emits
-# MESH_r01.json.  BENCH_MESH_SEED / BENCH_MESH_PASSES tune it
+# fleet front-door bench (mesh/): real run_node.py processes over
+# unix sockets — the partition+heal drill timeline with zero
+# divergence and per-hop p50/p99 admission→delivery latency, a
+# partition flood against a tiny ingest bound asserting bounded shed,
+# surviving processes, and byte-identical post-heal convergence, and
+# a 5-node RING flood asserting 100% multi-hop delivery coverage over
+# windowed anti-entropy; emits the next free MESH_r0N.json slot and
+# fails if the worst per-hop p99 regressed > 2x vs the previous
+# archived report.  BENCH_MESH_SEED / BENCH_MESH_PASSES tune it
 mesh-bench:
 	env JAX_PLATFORMS=cpu $(PYTHON) bench.py mesh
 

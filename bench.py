@@ -2313,8 +2313,40 @@ def bench_node():
 
 MESH_SEED = int(os.environ.get("BENCH_MESH_SEED", "1"))
 MESH_FLOOD_PASSES = int(os.environ.get("BENCH_MESH_PASSES", "3"))
-MESH_JSON = os.path.join(
-    os.path.dirname(os.path.abspath(__file__)), "MESH_r01.json")
+MESH_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _claim_mesh_report() -> tuple:
+    """CLAIM the next free MESH_r0N.json slot atomically
+    (O_CREAT|O_EXCL, the soak rotation's discipline) and return
+    (path, previous_path_or_None) — the previous archived report is
+    the SLO baseline this run is pinned against."""
+    n = 1
+    prev = None
+    while True:
+        path = os.path.join(MESH_DIR, f"MESH_r{n:02d}.json")
+        try:
+            os.close(os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY,
+                             0o644))
+            return path, prev
+        except FileExistsError:
+            prev = path
+            n += 1
+
+
+def _mesh_slo_baseline(prev_path) -> float:
+    """Worst per-hop p99 from the previous archived report, or 0.0
+    when there is none (first run) or it predates the per-hop shape."""
+    if prev_path is None:
+        return 0.0
+    try:
+        with open(prev_path) as fh:
+            prev = json.load(fh)
+        return float(max(
+            h["p99_ms"]
+            for h in prev["drill"]["per_hop_latency"].values()))
+    except (OSError, ValueError, KeyError, TypeError):
+        return 0.0
 
 
 def bench_mesh():
@@ -2329,9 +2361,16 @@ def bench_mesh():
     the majority side — the queues must stay at or under their bound
     (shed-oldest, never unbounded), every process must survive and
     keep answering health, and after a heal the fleet must still
-    converge byte-identically.  Emits MESH_r01.json."""
+    converge byte-identically; (3) a 5-node RING flood asserting
+    100% multi-hop delivery coverage — every node's anti-entropy
+    digest set byte-identical, accepted hop depths landing in the
+    `mesh_hops` histogram's >= 2 buckets, windowed summaries doing
+    the repair.  Emits the next free MESH_r0N.json slot and PINS the
+    worst per-hop p99 against the previous archived report: more
+    than 2x worse is a failed run, not a data point."""
     from consensus_specs_tpu.scenario.processes import (
-        MESH_PART, MESH_SMOKE, ProcessMesh, run_scenario_processes)
+        MESH_PART, MESH_RING, MESH_SMOKE, ProcessMesh,
+        run_scenario_processes)
 
     t_start = time.perf_counter()
 
@@ -2408,6 +2447,56 @@ def bench_mesh():
          f"shed_overload={shed}, bound held at {bound}, healed "
          f"fleet converged")
 
+    # -- leg 3: ring flood — multi-hop delivery coverage must be 100%
+    mesh = ProcessMesh(MESH_RING, seed=MESH_SEED)
+    with mesh:
+        mesh.run()
+        ring_oracle, ring_roots = mesh.converge()
+        assert ring_roots and all(r == ring_oracle for r in ring_roots), \
+            "ring flood did not converge to the oracle"
+        # one explicit pass per node: every peer serves its summary
+        # WINDOWED (the fallback counter must stay at zero)
+        for i in mesh.up_nodes():
+            mesh.clients[i].sync()
+        digest_sets = [frozenset(mesh.clients[i].summary())
+                       for i in mesh.up_nodes()]
+        assert digest_sets and digest_sets[0], "ring flood carried nothing"
+        assert all(s == digest_sets[0] for s in digest_sets), \
+            "ring delivery coverage under 100%: digest sets diverge"
+        ring_health = {f"node{i}": mesh.clients[i].health()["mesh"]
+                       for i in mesh.up_nodes()}
+        multi_hop = sum(
+            count for h in ring_health.values()
+            for bucket, count in h["hops"].items() if int(bucket) >= 2)
+        assert multi_hop > 0, \
+            "ring flood never delivered across >= 2 hops"
+        windowed = sum(h["summary_windowed"]
+                       for h in ring_health.values())
+        fallbacks = sum(h["sync_full_fallbacks"]
+                        for h in ring_health.values())
+        assert windowed > 0 and fallbacks == 0, \
+            f"anti-entropy not windowed (windowed={windowed}, " \
+            f"full fallbacks={fallbacks})"
+        leaks = mesh.teardown()
+    assert not leaks["orphan_procs"] and not leaks["orphan_sockets"], \
+        "ring leg leaked processes or sockets"
+    mark(f"ring: 5 nodes, {len(digest_sets[0])} digests on every node "
+         f"(100% coverage), multi-hop mass {multi_hop}, "
+         f"{windowed} windowed summaries, 0 full fallbacks")
+
+    # -- SLO pin: rotation-archived per-hop p99 must not regress > 2x
+    report_path, prev_path = _claim_mesh_report()
+    baseline_p99 = _mesh_slo_baseline(prev_path)
+    if baseline_p99 > 0:
+        assert hop_p99 <= 2.0 * baseline_p99, \
+            f"per-hop p99 SLO regression: {hop_p99}ms vs " \
+            f"{baseline_p99}ms in {os.path.basename(prev_path)} (> 2x)"
+        mark(f"slo: worst per-hop p99 {hop_p99}ms within 2x of "
+             f"{baseline_p99}ms ({os.path.basename(prev_path)})")
+    else:
+        mark(f"slo: first archived run — {hop_p99}ms becomes the "
+             f"baseline")
+
     out = {
         "drill": {
             "scenario": MESH_PART.name,
@@ -2427,9 +2516,25 @@ def bench_mesh():
             "shed_overload": shed,
             "post_heal_root": oracle,
         },
+        "ring": {
+            "scenario": MESH_RING.name,
+            "nodes": len(digest_sets),
+            "digests_per_node": len(digest_sets[0]),
+            "coverage_pct": 100.0,
+            "multi_hop_mass": multi_hop,
+            "windowed_summaries": windowed,
+            "full_fallbacks": fallbacks,
+            "oracle_root": ring_oracle,
+        },
+        "slo": {
+            "worst_per_hop_p99_ms": hop_p99,
+            "baseline_p99_ms": baseline_p99,
+            "baseline_report": (os.path.basename(prev_path)
+                                if prev_path else None),
+        },
         "ok": True,
     }
-    with open(MESH_JSON, "w") as f:
+    with open(report_path, "w") as f:
         json.dump(out, f, indent=2, sort_keys=True)
     log("[bench] mesh: " + json.dumps(out, sort_keys=True))
     return {

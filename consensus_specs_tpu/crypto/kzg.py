@@ -320,7 +320,13 @@ class KZG:
 
     def verify_kzg_proof_batch(self, commitments, zs, ys, proofs) -> bool:
         """Random-linear-combination batch check with one pairing
-        (polynomial-commitments.md:410)."""
+        (polynomial-commitments.md:410).
+
+        The three shared-base lincombs ride the `ops.pairing_fold`
+        seam (sigpipe/fold.fold_kzg_lincombs) — the same supervised
+        shape as the signature fold, with the counted host ladder as
+        byte-identical fallback; FOLD_VERIFY=0 keeps the plain host
+        msm() calls byte-for-byte."""
         assert len(commitments) == len(zs) == len(ys) == len(proofs)
         proof_points = [cv.g1_from_bytes(bytes(p), subgroup_check=False)
                         for p in proofs]
@@ -331,9 +337,17 @@ class KZG:
         r_powers = self.compute_r_powers(commitments, zs, ys, proofs)
         r_times_z = [r * z % BLS_MODULUS for r, z in zip(r_powers, zs)]
 
-        proof_lincomb = msm(proof_points, r_powers)
-        proof_z_lincomb = msm(proof_points, r_times_z)
-        c_minus_y_lincomb = msm(c_minus_ys, r_powers)
+        # lazy: crypto/ must not import sigpipe/ at module load (the
+        # scheduler imports crypto right back)
+        from ..sigpipe import fold
+        if fold.live():
+            proof_lincomb, proof_z_lincomb, c_minus_y_lincomb = \
+                fold.fold_kzg_lincombs(proof_points, c_minus_ys,
+                                       r_powers, r_times_z)
+        else:
+            proof_lincomb = msm(proof_points, r_powers)
+            proof_z_lincomb = msm(proof_points, r_times_z)
+            c_minus_y_lincomb = msm(c_minus_ys, r_powers)
 
         from .pairing import pairing_check
         g2 = cv.g2_generator()
@@ -356,7 +370,15 @@ class KZG:
 
     def verify_blob_kzg_proof_batch(self, blobs, commitments,
                                     proofs) -> bool:
-        """North-star config #4 (polynomial-commitments.md:569)."""
+        """North-star config #4 (polynomial-commitments.md:569).
+
+        With folding live the N blobs cost ONE 2-leg pairing (the RLC
+        batch, its lincombs on the `ops.pairing_fold` seam), observed
+        in `kzg_pairing_legs`; FOLD_VERIFY=0 is the escape hatch back
+        to N per-blob 2-leg checks, byte-identical verdicts.  A batch
+        that fails re-runs per-blob so the REJECTION is attributed to
+        specific blobs (`kzg_batch_attributions`) instead of one
+        opaque product — degraded cost, never a degraded verdict."""
         assert len(blobs) == len(commitments) == len(proofs)
         evaluation_challenges = []
         ys = []
@@ -369,8 +391,29 @@ class KZG:
                 polynomial, challenge))
         for proof in proofs:
             self.validate_kzg_g1(proof)
-        return self.verify_kzg_proof_batch(
+        # lazy for the same crypto<->sigpipe cycle as the batch check
+        from ..sigpipe import fold
+        from ..sigpipe.metrics import METRICS
+        n = len(blobs)
+        if not fold.live():
+            METRICS.observe("kzg_pairing_legs", 2 * max(n, 1))
+            return all(
+                self.verify_kzg_proof_impl(c, z, y, p)
+                for c, z, y, p in zip(commitments, evaluation_challenges,
+                                      ys, proofs))
+        ok = self.verify_kzg_proof_batch(
             commitments, evaluation_challenges, ys, proofs)
+        METRICS.observe("kzg_pairing_legs", 2)
+        if ok:
+            return True
+        # the RLC product only says "some blob lied" — degrade to
+        # per-blob checks so the verdict names the liars
+        METRICS.inc("kzg_batch_attributions")
+        METRICS.observe("kzg_pairing_legs", 2 * max(n, 1))
+        return all(
+            self.verify_kzg_proof_impl(c, z, y, p)
+            for c, z, y, p in zip(commitments, evaluation_challenges,
+                                  ys, proofs))
 
 
 @lru_cache(maxsize=4)

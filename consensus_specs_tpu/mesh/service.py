@@ -1,36 +1,53 @@
 """The mesh node: a `NodeService` that floods admitted gossip to real
 peer processes and repairs itself by anti-entropy.
 
-Topology is static config: `MeshConfig.peers` names each neighbour's
-(id, socket path); every neighbour gets one :class:`PeerLink`.  The
-flood rides the admission pipeline's ``transport`` seam — a message
-fires `_forward` only AFTER local validation accepts it, and the
-content-addressed `SeenCache` dedup at each hop (duplicates shed
-before transport fires) keeps an arbitrary cyclic topology loop-free.
-Split horizon: a message is never forwarded back to the peer it
-arrived from (peers identify themselves as ``mesh:<node_id>``).
+Topology starts as config — `MeshConfig.peers` names each neighbour's
+(id, socket path) and every neighbour gets one :class:`PeerLink` — but
+membership is DYNAMIC: a `J` join frame builds a live link to a new
+member at runtime and an `L` leave frame drains and removes one, both
+under the registered ``mesh.links`` lock, so a fleet can churn without
+respawning survivors.  The flood rides the admission pipeline's
+``transport`` seam — a message fires `_forward` only AFTER local
+validation accepts it, and the content-addressed `SeenCache` dedup at
+each hop (duplicates shed before transport fires) keeps an arbitrary
+cyclic topology loop-free.  Split horizon: a message is never
+forwarded back to the peer it arrived from (peers identify themselves
+as ``mesh:<node_id>``).  Mesh-forwarded frames additionally carry a
+hop counter in the `M` frame's msg_id slot: each forward increments
+it, accepted hop depths land in the ``mesh_hops`` pow-2 histogram, and
+a frame arriving past ``MeshConfig.ttl`` hops is shed with a
+``ttl_exhausted`` incident — a backstop on top of dedup, priced and
+observable.
 
 Anti-entropy (the ``scenario.sync`` contract, realized over sockets):
-every accepted message's digest -> (topic, origin peer, payload) is
-kept in a bounded replay log.  `S`/`P` frames serve the log INLINE on
-conn threads (lock-guarded, no pump involvement — two nodes can sync
-each other concurrently without deadlock); the `Y` sync frame queues a
-control item so the PULL + re-submit side runs on the pump, the only
-thread allowed to touch the pipeline.  A healed link (quarantine or
-partition block lifted by a `B` peers frame) schedules an automatic
-sync on the pump via the `_pump_extra` hook.
+every accepted message's digest -> (topic, origin peer, payload,
+accept slot) is kept in a bounded replay log.  `S`/`P` frames serve
+the log INLINE on conn threads (lock-guarded, no pump involvement —
+two nodes can sync each other concurrently without deadlock); the `Y`
+sync frame queues a control item so the PULL + re-submit side runs on
+the pump, the only thread allowed to touch the pipeline.  Summaries
+are SLOT-WINDOWED: the syncing node tracks the slot through which it
+believes itself complete (`_synced_through`, advanced only when a pass
+reached every configured peer) and asks each peer for digests accepted
+at or after that watermark, so repair cost after a W-slot outage is
+O(W), not O(history); the bare full-set summary stays available as the
+counted fallback (``mesh_sync_full_fallbacks``).  A healed link
+(quarantine or partition block lifted by a `B` peers frame) schedules
+an automatic sync on the pump via the `_pump_extra` hook.
 
 Fault surface: peer-forwarded messages cross the registered
-``mesh.recv`` barrier before admission; each link's sends consult
-``mesh.link`` and cross ``mesh.send`` (link.py).  The `I` incidents
-frame exposes the node's incident book so the drill can assert every
-injected fault and SIGKILL is attributed in the right process.
+``mesh.recv`` barrier before admission; membership changes cross
+``mesh.join`` / ``mesh.leave``; each link's sends consult ``mesh.link``
+and cross ``mesh.send`` (link.py).  The `I` incidents frame exposes
+the node's incident book so the drill can assert every injected fault
+and SIGKILL is attributed in the right process.
 """
 from __future__ import annotations
 
 import json
 import random
 import threading
+import zlib
 from collections import OrderedDict
 from dataclasses import dataclass, field
 
@@ -38,13 +55,17 @@ from ..node import wire
 from ..node.client import NodeClient
 from ..node.service import NodeConfig, NodeService
 from ..resilience import faults
+from ..ssz import hash_tree_root
 from ..utils.clock import MONOTONIC
 from ..utils.locks import named_lock
 from .link import LinkConfig, PeerLink
 
 RECV_SITE = "mesh.recv"
 SYNC_SITE = "mesh.sync"          # incident site (scenario.sync's twin)
+JOIN_SITE = "mesh.join"          # barrier: before admitting a member
+LEAVE_SITE = "mesh.leave"        # barrier: before draining a member out
 PEER_PREFIX = "mesh:"            # how mesh nodes identify to each other
+HOPS_BOUND = 4096                # inbound hop counts awaiting acceptance
 
 
 @dataclass
@@ -55,14 +76,24 @@ class MeshConfig(NodeConfig):
     replay_bound: int = 1 << 14  # digests kept for anti-entropy
     sync_page: int = 64          # digests per PULL page
     link_seed: int = 0           # seeds per-link backoff jitter
+    ttl: int = 16                # max hops a forwarded frame may travel
 
 
 class MeshNodeService(NodeService):
     def __init__(self, config: MeshConfig, clock=MONOTONIC):
         super().__init__(config, clock)
         self._replay_lock = named_lock("mesh.replay")
-        self._replay = OrderedDict()    # digest -> (topic, peer, payload)
+        # digest -> (topic, peer, payload, accept slot)
+        self._replay = OrderedDict()
         self._sync_wanted = threading.Event()
+        # runtime peer-table mutation (J/L frames) vs pump/conn readers
+        self._links_lock = named_lock("mesh.links")
+        # pump-only: inbound hop counts keyed by digest, consumed when
+        # acceptance fires the transport seam; slot watermark the
+        # windowed anti-entropy pass believes itself complete through
+        self._hops = OrderedDict()
+        self._max_slot = 0
+        self._synced_through = 0
         seeder = random.Random(config.link_seed)
         self.links = {}
         for peer_id, path in config.peers:
@@ -75,21 +106,34 @@ class MeshNodeService(NodeService):
         for link in self.links.values():
             link.start()
 
+    def _link_rng(self, peer_id: str) -> random.Random:
+        """Deterministic per-link jitter seed that does not depend on
+        join ORDER — dynamic membership must replay under a seed."""
+        return random.Random(
+            (int(self.config.link_seed) << 32)
+            ^ zlib.crc32(str(peer_id).encode("utf-8")))
+
     # -- the flood (pump thread, under scope) ---------------------------
 
     def _forward(self, message) -> None:
         """Transport seam: record the accepted message for anti-entropy,
         then offer it to every link except the sender's."""
+        slot = int(self.spec.get_current_slot(self.store))
+        self._max_slot = max(self._max_slot, slot)
+        hops = int(self._hops.pop(message.digest, 0))
+        self.ctx.metrics.observe_hist("mesh_hops", hops)
         with self._replay_lock:
             if message.digest not in self._replay:
                 if len(self._replay) >= self.config.replay_bound:
                     self._replay.popitem(last=False)
                 self._replay[message.digest] = (
-                    message.topic, message.peer, message.payload)
+                    message.topic, message.peer, message.payload, slot)
         data = wire.encode_message(
-            0, message.topic, PEER_PREFIX + self.config.node_id,
-            message.payload)
-        for peer_id, link in self.links.items():
+            hops + 1, message.topic,
+            PEER_PREFIX + self.config.node_id, message.payload)
+        with self._links_lock:
+            targets = list(self.links.items())
+        for peer_id, link in targets:
             if message.peer == PEER_PREFIX + peer_id:
                 continue                # split horizon
             link.offer(data)
@@ -102,6 +146,16 @@ class MeshNodeService(NodeService):
                 and isinstance(value, (tuple, list)) and len(value) == 4
                 and isinstance(value[2], str)
                 and value[2].startswith(PEER_PREFIX)):
+            # the msg_id slot of a mesh-forwarded frame is its hop count
+            hops = value[0] if isinstance(value[0], int) else 0
+            if hops >= max(1, int(self.config.ttl)):
+                self.ctx.incidents.record(RECV_SITE, "ttl_exhausted",
+                                          hops=int(hops),
+                                          peer=str(value[2]))
+                self.ctx.metrics.inc("mesh_ttl_exhausted")
+                respond({"id": value[0], "status": "shed",
+                         "detail": "ttl exhausted"})
+                return
             # peer-forwarded gossip crosses the registered recv barrier
             # before admission: the injector drops/delays it here
             try:
@@ -114,12 +168,27 @@ class MeshNodeService(NodeService):
                          "detail": "recv fault"})
                 return
         if kind == wire.KIND_SUMMARY:
-            if not isinstance(value, int):
+            window = None
+            if isinstance(value, (tuple, list)) and len(value) == 3 \
+                    and all(isinstance(v, int) for v in value):
+                rid, lo, hi = value
+                window = (lo, hi)
+            elif isinstance(value, int):
+                rid = value
+                self.ctx.metrics.inc("mesh_summary_full")
+            else:
                 self._shed_frame(respond, None, "bad summary request")
                 return
             with self._replay_lock:
-                digests = list(self._replay.keys())
-            respond({"id": value, "status": "ok", "digests": digests})
+                if window is None:
+                    digests = list(self._replay.keys())
+                else:
+                    lo, hi = window
+                    digests = [d for d, e in self._replay.items()
+                               if e[3] >= lo and (hi < 0 or e[3] < hi)]
+            if window is not None:
+                self.ctx.metrics.inc("mesh_summary_windowed")
+            respond({"id": rid, "status": "ok", "digests": digests})
             return
         if kind == wire.KIND_PULL:
             if (not isinstance(value, (tuple, list)) or len(value) != 2
@@ -133,8 +202,47 @@ class MeshNodeService(NodeService):
                 for digest in wanted:
                     entry = self._replay.get(digest)
                     if entry is not None:
-                        out.append(entry)
+                        out.append(entry[:3])
             respond({"id": rid, "status": "ok", "messages": out})
+            return
+        if kind == wire.KIND_JOIN:
+            if (not isinstance(value, (tuple, list)) or len(value) != 3
+                    or not isinstance(value[0], int)
+                    or not isinstance(value[1], str)
+                    or not isinstance(value[2], str)):
+                self._shed_frame(respond, None, "bad join request")
+                return
+            rid, peer_id, path = value
+            try:
+                faults.fire(JOIN_SITE)
+            except faults.DeviceFault as exc:
+                self.ctx.incidents.record(JOIN_SITE, "join_fault",
+                                          peer=peer_id, detail=str(exc))
+                respond({"id": rid, "status": "shed",
+                         "detail": "join fault"})
+                return
+            added = self._add_link(peer_id, path)
+            respond({"id": rid, "status": "ok", "added": added,
+                     "peers": self._peer_ids()})
+            return
+        if kind == wire.KIND_LEAVE:
+            if (not isinstance(value, (tuple, list)) or len(value) != 2
+                    or not isinstance(value[0], int)
+                    or not isinstance(value[1], str)):
+                self._shed_frame(respond, None, "bad leave request")
+                return
+            rid, peer_id = value
+            try:
+                faults.fire(LEAVE_SITE)
+            except faults.DeviceFault as exc:
+                self.ctx.incidents.record(LEAVE_SITE, "leave_fault",
+                                          peer=peer_id, detail=str(exc))
+                respond({"id": rid, "status": "shed",
+                         "detail": "leave fault"})
+                return
+            removed = self._remove_link(peer_id)
+            respond({"id": rid, "status": "ok", "removed": removed,
+                     "peers": self._peer_ids()})
             return
         if kind == wire.KIND_SYNC:
             if not isinstance(value, int):
@@ -151,7 +259,9 @@ class MeshNodeService(NodeService):
                 return
             rid, blocked = value
             blocked = {str(b) for b in blocked}
-            for peer_id, link in self.links.items():
+            with self._links_lock:
+                targets = list(self.links.items())
+            for peer_id, link in targets:
                 if peer_id in blocked:
                     link.block()
                 else:
@@ -171,6 +281,53 @@ class MeshNodeService(NodeService):
             return
         super().handle(kind, value, respond)
 
+    # -- dynamic membership (conn threads) ------------------------------
+
+    def _peer_ids(self) -> list:
+        with self._links_lock:
+            return sorted(self.links)
+
+    def _add_link(self, peer_id: str, path: str) -> bool:
+        """Admit a member at runtime: build, register and start a link.
+        Idempotent on (peer_id, path); a peer re-joining on a NEW
+        socket replaces its old link.  The link starts outside the
+        table lock — `start`/`close` may wait on worker threads."""
+        peer_id = str(peer_id)
+        stale = None
+        with self._links_lock:
+            old = self.links.get(peer_id)
+            if old is not None and old.socket_path == path:
+                old.reset()             # re-join on the same socket
+                return False
+            link = PeerLink(peer_id, path, self.ctx, self.config.link,
+                            rng=self._link_rng(peer_id),
+                            on_heal=self._on_heal)
+            stale, self.links[peer_id] = old, link
+        if stale is not None:
+            stale.close()
+        link.start()
+        self.ctx.incidents.record(JOIN_SITE, "peer_joined",
+                                  peer=peer_id)
+        self.ctx.metrics.inc("mesh_joins")
+        return True
+
+    def _remove_link(self, peer_id: str) -> bool:
+        """Drain a member out: unregister its link, then close it —
+        the worker flushes what it can before the socket drops, and
+        anything still queued is priced as `link_shed`/`dropped`
+        rather than silently lost (anti-entropy owns the repair if the
+        peer ever returns)."""
+        peer_id = str(peer_id)
+        with self._links_lock:
+            link = self.links.pop(peer_id, None)
+        if link is None:
+            return False
+        link.close()
+        self.ctx.incidents.record(LEAVE_SITE, "peer_left",
+                                  peer=peer_id)
+        self.ctx.metrics.inc("mesh_leaves")
+        return True
+
     # -- anti-entropy (pump thread, under scope) ------------------------
 
     def _on_heal(self, peer_id: str) -> None:
@@ -187,6 +344,19 @@ class MeshNodeService(NodeService):
             respond({"id": rid, "status": "ok",
                      "replayed": self._sync()})
             return
+        if (item[0] == "msg" and isinstance(item[3], str)
+                and item[3].startswith(PEER_PREFIX)
+                and isinstance(item[1], int) and item[1] > 0):
+            # stash the inbound hop count by content digest so the
+            # transport seam (which fires at ACCEPTANCE, possibly a
+            # later flush) forwards with hops+1 and histograms the
+            # depth.  Pump-thread only; FIFO-bounded because shed or
+            # rejected messages never consume their entry.
+            digest = bytes(hash_tree_root(item[4]))
+            if digest not in self._hops:
+                while len(self._hops) >= HOPS_BOUND:
+                    self._hops.popitem(last=False)
+                self._hops[digest] = int(item[1])
         super()._process(item)
 
     def _sync(self) -> int:
@@ -194,19 +364,40 @@ class MeshNodeService(NodeService):
         digest summary, PULL what this node has not admitted, and
         re-submit the misses through the pipeline under their original
         origin — the mesh twin of the scenario driver's catch-up
-        replay.  Failures are per-peer and non-fatal."""
+        replay.  Failures are per-peer and non-fatal.
+
+        Summaries are windowed on the node's own completeness
+        watermark: digests accepted before `_synced_through` were
+        already compared in a pass that reached EVERY peer, so only
+        the missed window crosses the wire — O(W) repair after a
+        W-slot outage.  A peer that rejects the windowed request gets
+        the full-set exchange as counted fallback."""
         replayed = 0
-        for peer_id, link in self.links.items():
+        reached_all = True
+        lo = int(self._synced_through)
+        with self._links_lock:
+            targets = list(self.links.items())
+        for peer_id, link in targets:
             if not link.healthy():
+                reached_all = False
                 continue
             try:
                 client = NodeClient(link.socket_path,
                                     connect_timeout_s=2.0,
                                     resolver=self._resolver)
             except OSError:
+                reached_all = False
                 continue
             try:
-                missing = [d for d in client.summary()
+                try:
+                    remote = client.summary(lo=lo, hi=-1)
+                except (OSError, ConnectionError, wire.WireError,
+                        AssertionError):
+                    # an old or damaged peer: full-set fallback, counted
+                    self.ctx.metrics.inc("mesh_sync_full_fallbacks")
+                    remote = client.summary()
+                self.ctx.metrics.inc("mesh_sync_digests", len(remote))
+                missing = [d for d in remote
                            if not self.pipe.seen.seen_before(d)]
                 for start in range(0, len(missing),
                                    self.config.sync_page):
@@ -219,12 +410,19 @@ class MeshNodeService(NodeService):
                     self.pipe.drain()
             except (OSError, ConnectionError, wire.WireError,
                     AssertionError):
+                reached_all = False
                 continue                # peer died mid-sync: next pass
             finally:
                 client.close()
         if replayed:
             self.pipe.drain()
             self._harvest()
+        if reached_all:
+            # complete through everything we have now admitted; the
+            # NEXT pass only repairs what lands after this watermark.
+            # One slot of overlap absorbs tick skew between nodes (a
+            # peer may still be a slot behind when it accepts).
+            self._synced_through = max(0, int(self._max_slot) - 1)
         self.ctx.incidents.record(SYNC_SITE, "catch_up",
                                   replayed=replayed)
         self.ctx.metrics.inc("mesh_syncs")
@@ -236,22 +434,37 @@ class MeshNodeService(NodeService):
         report = super().health()
         with self._replay_lock:
             log_size = len(self._replay)
+        with self._links_lock:
+            links = list(self.links.items())
         report["mesh"] = {
             "node_id": self.config.node_id,
             "forwarded": self.ctx.metrics.count("mesh_forwarded"),
             "syncs": self.ctx.metrics.count("mesh_syncs"),
+            "joins": self.ctx.metrics.count("mesh_joins"),
+            "leaves": self.ctx.metrics.count("mesh_leaves"),
+            "sync_digests": self.ctx.metrics.count("mesh_sync_digests"),
+            "summary_windowed":
+                self.ctx.metrics.count("mesh_summary_windowed"),
+            "summary_full": self.ctx.metrics.count("mesh_summary_full"),
+            "sync_full_fallbacks":
+                self.ctx.metrics.count("mesh_sync_full_fallbacks"),
+            "ttl_exhausted": self.ctx.metrics.count("mesh_ttl_exhausted"),
+            "hops": self.ctx.metrics.hist_counts("mesh_hops"),
             "replay_log": log_size,
-            "links": {pid: link.state()
-                      for pid, link in self.links.items()},
+            "links": {pid: link.state() for pid, link in links},
         }
         return report
 
-    def _shutdown(self) -> None:
-        for link in self.links.values():
+    def _close_links(self) -> None:
+        with self._links_lock:
+            links = list(self.links.values())
+        for link in links:
             link.close()
+
+    def _shutdown(self) -> None:
+        self._close_links()
         super()._shutdown()
 
     def close(self) -> None:
-        for link in self.links.values():
-            link.close()
+        self._close_links()
         super().close()

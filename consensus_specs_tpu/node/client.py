@@ -109,9 +109,26 @@ class NodeClient:
 
     # -- mesh control frames (mesh/service.py answers these) ------------
 
-    def summary(self) -> list:
-        """The peer's admitted-digest summary (anti-entropy keys)."""
-        return list(self.request(wire.KIND_SUMMARY)["digests"])
+    def summary(self, lo: int | None = None, hi: int = -1) -> list:
+        """The peer's admitted-digest summary (anti-entropy keys).
+        With `lo`, only digests accepted in slots [lo, hi) cross the
+        wire (hi < 0 = unbounded) — the O(missed-window) repair path;
+        bare `summary()` is the full-set fallback."""
+        if lo is None:
+            return list(self.request(wire.KIND_SUMMARY)["digests"])
+        return list(self.request(
+            wire.KIND_SUMMARY, (int(lo), int(hi)))["digests"])
+
+    def join(self, peer_id: str, socket_path: str) -> dict:
+        """Dynamic membership: tell the node to build a live link to
+        `peer_id` at `socket_path` (idempotent on the same socket)."""
+        return self.request(wire.KIND_JOIN,
+                            (str(peer_id), str(socket_path)))
+
+    def leave(self, peer_id: str) -> dict:
+        """Dynamic membership: tell the node to drain and drop its
+        link to the departing `peer_id`."""
+        return self.request(wire.KIND_LEAVE, (str(peer_id),))
 
     def pull(self, digests) -> list:
         """[(topic, peer, payload), ...] for the digests the peer still

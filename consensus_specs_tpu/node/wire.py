@@ -34,6 +34,10 @@ compare and repair exactly the content-addressed set the SeenCache
 floods on:
 
     S  summary    rid                    -> {"digests": [bytes32, ...]}
+                  or (rid, lo, hi)          slot-windowed: only digests
+                                            whose accept-slot is in
+                                            [lo, hi); a bare rid is the
+                                            full-set fallback (counted)
     P  pull       (rid, [digest, ...])   -> {"messages": [(topic,
                                              peer, payload), ...]}
     Y  sync       rid                    -> {"replayed": n} (the node
@@ -43,6 +47,17 @@ floods on:
                                             control; [] heals + resets
                                             quarantined links)
     I  incidents  rid                    -> {"incidents": json}
+    J  join       (rid, peer_id, socket) -> the receiver builds a live
+                                            link to the new member
+                                            (dynamic membership)
+    L  leave      (rid, peer_id)         -> the receiver drains and
+                                            removes its link to the
+                                            departing member
+
+Mesh-forwarded `M` frames reuse the `msg_id` slot as a hop counter:
+direct clients send 0 and the mesh increments it per forward, so the
+receiver can histogram flood depth (`mesh_hops`) and shed frames whose
+TTL is exhausted without changing the 4-tuple frame shape.
 """
 from __future__ import annotations
 
@@ -68,9 +83,13 @@ KIND_PULL = "P"
 KIND_SYNC = "Y"
 KIND_PEERS = "B"
 KIND_INCIDENTS = "I"
+# dynamic membership (mesh/service.py): runtime peer-table mutation
+KIND_JOIN = "J"
+KIND_LEAVE = "L"
 KINDS = frozenset({KIND_MESSAGE, KIND_TICK, KIND_HEALTH, KIND_ROOT,
                    KIND_DRAIN, KIND_RESPONSE, KIND_SUMMARY, KIND_PULL,
-                   KIND_SYNC, KIND_PEERS, KIND_INCIDENTS})
+                   KIND_SYNC, KIND_PEERS, KIND_INCIDENTS, KIND_JOIN,
+                   KIND_LEAVE})
 
 
 class WireError(ValueError):

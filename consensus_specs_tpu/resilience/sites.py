@@ -249,6 +249,16 @@ REGISTRY: tuple[Site, ...] = (
          note="before a peer-forwarded message's admission — the "
               "drill's kill/shed point on the inbound hop; "
               "scripts/mesh_drill.py + tests/test_mesh.py"),
+    Site("mesh.join", "consensus_specs_tpu.mesh.service",
+         kind=BARRIER, chaos=UNIT, corrupt="none",
+         note="before a JOIN frame mutates the peer table — the "
+              "churn drill's kill/shed point on dynamic admission; "
+              "scripts/mesh_drill.py + tests/test_mesh.py"),
+    Site("mesh.leave", "consensus_specs_tpu.mesh.service",
+         kind=BARRIER, chaos=UNIT, corrupt="none",
+         note="before a LEAVE frame drains a member's link out — the "
+              "churn drill's kill/shed point on graceful departure; "
+              "scripts/mesh_drill.py + tests/test_mesh.py"),
 )
 
 # speclint: disable=global-mutable-state -- name index over the frozen
@@ -588,6 +598,15 @@ CONCURRENCY = Concurrency(
                       "on accept (transport seam), conn threads serve "
                       "SUMMARY/PULL from it inline; never nested with "
                       "mesh.link — offers happen after release"),
+        LockSpec("mesh.links", _MS, "_links_lock",
+                 cls="MeshNodeService", kind="lock",
+                 guards=("links",),
+                 note="the runtime peer table: JOIN/LEAVE frames "
+                      "mutate it on conn threads while the pump "
+                      "(flood, sync) and health snapshot it; links "
+                      "start/close OUTSIDE the lock (they join worker "
+                      "threads), and it never nests under mesh.link "
+                      "or mesh.replay"),
         # -- utils -----------------------------------------------------
         LockSpec("nodectx.stack", "consensus_specs_tpu.utils.nodectx",
                  "_lock", guards=("_stack",)),

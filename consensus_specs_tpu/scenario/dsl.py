@@ -68,10 +68,15 @@ class LinkSpec:
     drop_rate: float = 0.0      # seeded per-(msg, dest) stall odds
 
 
+# graph shapes the process-mesh backend can wire (scenario/processes.py
+# builds the peer sets); the in-process driver models direct delivery
+# and treats every kind as full_mesh.  Partitions stay EVENTS.
+TOPOLOGY_KINDS = frozenset({"full_mesh", "ring", "bridge", "star"})
+
+
 @dataclass(frozen=True)
 class Topology:
-    kind: str = "full_mesh"     # full_mesh is the only kind today;
-    #                             partitions are EVENTS, not topology
+    kind: str = "full_mesh"     # one of TOPOLOGY_KINDS
     link: LinkSpec = field(default_factory=LinkSpec)
 
 
@@ -171,6 +176,26 @@ def recover(at_slot: float, node: int) -> Event:
     return _event(at_slot, "recover", node=int(node))
 
 
+def join(at_slot: float, node: int) -> Event:
+    """Dynamic membership: `node` joins the mesh at runtime.  A node
+    whose FIRST membership event is a join starts the scenario ABSENT
+    (never spawned); a join after a `leave` is a graceful rejoin over
+    the same data dir.  The joiner builds links to its topology
+    neighbours, the neighbours learn it through `J` frames, and a
+    windowed anti-entropy pass catches it up to the fleet."""
+    return _event(at_slot, "join", node=int(node))
+
+
+def leave(at_slot: float, node: int) -> Event:
+    """Dynamic membership: `node` departs GRACEFULLY — its neighbours
+    drain and drop their links on `L` frames (no reconnect burn, the
+    departure is attributed, not priced as a failure), then the node
+    itself drains and exits 0.  Requires `Scenario.durable=True` so a
+    later rejoin recovers the journal.  Abrupt departure is `kill` —
+    that one rides the quarantine path."""
+    return _event(at_slot, "leave", node=int(node))
+
+
 DEGRADED_FAULTS = ("raise", "shard_dead")
 
 
@@ -222,23 +247,35 @@ class Scenario:
 
     def validate(self) -> None:
         assert self.nodes >= 1 and self.slots >= 2
+        assert self.topology.kind in TOPOLOGY_KINDS, \
+            f"unknown topology kind {self.topology.kind!r}"
         down: set = set()
         partitioned = False
         degraded_windows: list = []     # (until_slot, target-or-None)
+        # a node whose FIRST membership event is `join` starts absent
+        first_membership: dict = {}
+        for e in self.sorted_events():
+            if e.kind in ("join", "leave"):
+                node = e.get("node")
+                assert isinstance(node, int) and 0 <= node < self.nodes, \
+                    f"membership event targets unknown node: {e}"
+                first_membership.setdefault(node, e.kind)
+        absent = {n for n, k in first_membership.items() if k == "join"}
         for e in self.sorted_events():
             assert 0.0 <= e.at_slot, f"event before genesis: {e}"
             assert e.at_slot <= self.slots + 1, f"event after end: {e}"
             if e.kind == "partition":
                 groups = e.get("groups")
                 flat = sorted(n for g in groups for n in g)
-                assert flat == list(range(self.nodes)), \
-                    f"partition groups must cover every node: {e}"
+                assert flat == sorted(set(range(self.nodes)) - absent), \
+                    f"partition groups must cover every present node: {e}"
                 partitioned = True
             elif e.kind == "heal":
                 partitioned = False
             elif e.kind in ("crash", "kill"):
                 node = e.get("node")
                 assert 0 <= node < self.nodes and node not in down
+                assert node not in absent, f"kill of an absent node: {e}"
                 if e.kind == "kill":
                     assert self.durable, \
                         f"kill needs Scenario.durable=True (only the " \
@@ -248,6 +285,19 @@ class Scenario:
                 node = e.get("node")
                 assert node in down, f"recover without crash: {e}"
                 down.discard(node)
+            elif e.kind == "join":
+                node = e.get("node")
+                assert node in absent, f"join of a present node: {e}"
+                assert node not in down, f"join of a dead node: {e}"
+                absent.discard(node)
+            elif e.kind == "leave":
+                node = e.get("node")
+                assert node not in absent and node not in down, \
+                    f"leave of an absent or dead node: {e}"
+                assert self.durable, \
+                    f"leave needs Scenario.durable=True (a rejoin " \
+                    f"recovers the drained journal): {e}"
+                absent.add(node)
             elif e.kind in ("equivocation_storm", "surround_attack",
                             "long_range_fork"):
                 assert 0 <= e.get("origin") < self.nodes
@@ -282,6 +332,9 @@ class Scenario:
                 raise AssertionError(f"unknown event kind {e.kind!r}")
         assert not down, f"nodes still crashed at scenario end: {down}"
         assert not partitioned, "partition never healed"
+        assert not absent, \
+            f"nodes still absent at scenario end (every member must " \
+            f"rejoin before the convergence check): {absent}"
 
     def burned_validators_hint(self) -> bool:
         """Whether any event mutes validators from canonical traffic."""

@@ -2,22 +2,31 @@
 
 The in-process scenario driver (driver.py) simulates N nodes inside
 one interpreter; this backend runs the SAME scenario timelines against
-N real ``scripts/run_node.py`` processes wired into a full mesh over
-their framed unix sockets (mesh/service.py).  The driver here only
-feeds each message to its ORIGIN node and operates the control plane —
-the mesh itself floods admitted gossip peer-to-peer, partitions are
-imposed with PEERS frames (mesh link block/reset), kills are real
-SIGKILLs, and recovery is a real respawn over the surviving segment
-journal.  Convergence is asserted against the same in-process scalar
-oracle the socket drill uses (node/client.py), byte-for-byte on
+N real ``scripts/run_node.py`` processes wired over their framed unix
+sockets (mesh/service.py) into the scenario's TOPOLOGY — full mesh,
+ring, star, or a bridge of two cliques (`topology_peers`).  The driver
+here only feeds each message to its ORIGIN node and operates the
+control plane — the mesh itself floods admitted gossip peer-to-peer
+across however many hops the graph demands (dedup keeps cycles
+loop-free, the TTL hop counter backstops), partitions are imposed with
+PEERS frames (mesh link block/reset), kills are real SIGKILLs, and
+recovery is a real respawn over the surviving segment journal.
+Convergence is asserted against the same in-process scalar oracle the
+socket drill uses (node/client.py), byte-for-byte on
 ``txn.store_root``.
 
 Event support is deliberately the recovery-chaos subset: partition /
-heal / kill / recover.  Adversarial traffic events (storms, surround,
-long-range forks) are crafted INTO the plan's message feed by
-traffic.py and need no process-level control, but degraded windows and
-``crash`` (a power-cut fiction no real process can perform — SIGKILL
-is the honest version) raise ``UnsupportedEvent``.
+heal / kill / recover, plus DYNAMIC MEMBERSHIP — ``join`` spawns a
+member mid-run (a node whose first membership event is a join was
+never spawned at start; its neighbours learn it through `J` frames and
+it catches up by windowed anti-entropy) and ``leave`` departs one
+gracefully (neighbours drain + drop their links on `L` frames, then
+the node drains and exits 0; a later join is a rejoin over the same
+data dir).  Adversarial traffic events (storms, surround, long-range
+forks) are crafted INTO the plan's message feed by traffic.py and need
+no process-level control, but degraded windows and ``crash`` (a
+power-cut fiction no real process can perform — SIGKILL is the honest
+version) raise ``UnsupportedEvent``.
 
 Determinism note: the mesh floods asynchronously, so mid-run state is
 timing-dependent — the contract is the END state.  After the timeline
@@ -38,15 +47,19 @@ import time
 from ..node.client import (
     NodeClient, oracle_root, spawn_node)
 from ..specs import get_spec
-from .dsl import LIBRARY, Scenario, heal, kill, partition, recover
+from ..utils.clock import MONOTONIC
+from .dsl import (
+    LIBRARY, Scenario, Topology, heal, join, kill, leave, partition,
+    recover)
 from .traffic import TrafficPlan
 
 __all__ = [
-    "UnsupportedEvent", "ProcessMesh", "mesh_agenda",
+    "UnsupportedEvent", "ProcessMesh", "mesh_agenda", "topology_peers",
     "run_scenario_processes", "DRILL_CASES", "drill_case",
 ]
 
-SUPPORTED_EVENTS = frozenset({"partition", "heal", "kill", "recover"})
+SUPPORTED_EVENTS = frozenset({"partition", "heal", "kill", "recover",
+                              "join", "leave"})
 
 # respawn/connect budget: a fresh run_node.py pays the JAX import
 # (~15-30 s on a cold container) before it binds its socket
@@ -57,6 +70,45 @@ DRAIN_TIMEOUT_S = 60.0
 class UnsupportedEvent(Exception):
     """The scenario uses an event kind the process backend cannot
     impose on a real process (crash, degraded, ...)."""
+
+
+def topology_peers(scenario: Scenario) -> list:
+    """node index -> frozenset of neighbour indices (symmetric), from
+    the scenario's topology kind.  The non-complete shapes are the
+    multi-hop drills' substrate: a ring of N has diameter N//2, a star
+    routes everything through its hub, and a bridge joins two cliques
+    through one cut vertex whose death partitions the graph."""
+    n = scenario.nodes
+    kind = scenario.topology.kind
+    peers: list = [set() for _ in range(n)]
+
+    def connect(a: int, b: int) -> None:
+        peers[a].add(b)
+        peers[b].add(a)
+
+    if kind == "full_mesh":
+        for i in range(n):
+            for j in range(i + 1, n):
+                connect(i, j)
+    elif kind == "ring":
+        assert n >= 3, "a ring needs >= 3 nodes"
+        for i in range(n):
+            connect(i, (i + 1) % n)
+    elif kind == "star":
+        assert n >= 2, "a star needs >= 2 nodes"
+        for i in range(1, n):
+            connect(0, i)
+    elif kind == "bridge":
+        assert n >= 3, "a bridge needs >= 3 nodes"
+        mid = n // 2                     # the cut vertex
+        for clique in (list(range(0, mid + 1)),
+                       list(range(mid, n))):
+            for x in range(len(clique)):
+                for y in range(x + 1, len(clique)):
+                    connect(clique[x], clique[y])
+    else:                                # pragma: no cover
+        raise AssertionError(f"unknown topology kind {kind!r}")
+    return [frozenset(p) for p in peers]
 
 
 def mesh_agenda(plan: TrafficPlan) -> list:
@@ -96,19 +148,23 @@ def mesh_agenda(plan: TrafficPlan) -> list:
 
 
 class ProcessMesh:
-    """N run_node.py processes in a full mesh, driven through one
-    scenario timeline.  Use as a context manager — __exit__ reaps every
-    process and removes the work directory even on failure."""
+    """N run_node.py processes wired into the scenario's topology,
+    driven through one scenario timeline.  Use as a context manager —
+    __exit__ reaps every process and removes the work directory even
+    on failure."""
 
     def __init__(self, scenario: Scenario, seed: int = 0,
-                 extra_args: dict | None = None, base_dir: str | None = None):
+                 extra_args: dict | None = None,
+                 base_dir: str | None = None, clock=MONOTONIC):
         scenario.validate()
         self.scenario = scenario
         self.seed = int(seed)
+        self.clock = clock
         self.spec = get_spec(scenario.fork, scenario.preset)
         self.plan = TrafficPlan(self.spec, scenario,
                                 random.Random(self.seed))
         self.extra_args = dict(extra_args or {})   # node index -> [argv]
+        self.peers_of = topology_peers(scenario)
         self.workdir = tempfile.mkdtemp(prefix="mesh_", dir=base_dir)
         n = scenario.nodes
         self.sockets = [os.path.join(self.workdir, f"node{i}.sock")
@@ -120,6 +176,15 @@ class ProcessMesh:
         self.up = [False] * n
         # node index -> set of blocked peer ids (current partition view)
         self.blocked = [set() for _ in range(n)]
+        # dynamic membership: a node whose FIRST membership event is a
+        # join starts absent (never spawned); spawn args exclude
+        # currently-absent peers — the join itself introduces the new
+        # member to its neighbours via J frames
+        first: dict = {}
+        for e in scenario.sorted_events():
+            if e.kind in ("join", "leave"):
+                first.setdefault(e.get("node"), e.kind)
+        self.absent = {i for i, k in first.items() if k == "join"}
         self.events_applied: list = []
 
     # -- lifecycle -----------------------------------------------------
@@ -133,8 +198,8 @@ class ProcessMesh:
 
     def _spawn_args(self, i: int) -> list:
         args = ["--node-id", f"node{i}"]
-        for j in range(self.scenario.nodes):
-            if j != i:
+        for j in sorted(self.peers_of[i]):
+            if j not in self.absent:
                 args += ["--peer", f"node{j}={self.sockets[j]}"]
         args += [str(a) for a in self.extra_args.get(i, ())]
         return args
@@ -150,9 +215,11 @@ class ProcessMesh:
 
     def start(self) -> None:
         for i in range(self.scenario.nodes):
-            self._spawn(i)
+            if i not in self.absent:
+                self._spawn(i)
         for i in range(self.scenario.nodes):
-            self._connect(i)
+            if i not in self.absent:
+                self._connect(i)
 
     def up_nodes(self) -> list:
         return [i for i in range(self.scenario.nodes) if self.up[i]]
@@ -180,6 +247,12 @@ class ProcessMesh:
             groups = event.get("groups")
             group_of = {n: set(g) for g in groups for n in g}
             for i in range(self.scenario.nodes):
+                # absent members are outside every group (validate
+                # lets the partition omit them); their view is rebuilt
+                # when they join
+                if i not in group_of:
+                    self.blocked[i] = set()
+                    continue
                 self.blocked[i] = {f"node{j}"
                                    for j in range(self.scenario.nodes)
                                    if j != i and j not in group_of[i]}
@@ -214,23 +287,63 @@ class ProcessMesh:
             # restarted node learns any still-open partition
             self._push_partition_view(self.up_nodes())
             self.clients[node].sync()
+        elif event.kind == "join":
+            node = event.get("node")
+            self.absent.discard(node)   # spawn args see current view
+            self._spawn(node)
+            self._connect(node)
+            # the joiner dialed its neighbours from spawn args; the
+            # neighbours learn the new member through J frames
+            for j in sorted(self.peers_of[node]):
+                if self.up[j]:
+                    self.clients[j].join(f"node{node}",
+                                         self.sockets[node])
+            self._push_partition_view(self.up_nodes())
+            # windowed anti-entropy catch-up: the joiner's watermark
+            # is 0, so one pass pulls exactly what the fleet admitted
+            self.clients[node].sync()
+        elif event.kind == "leave":
+            node = event.get("node")
+            # neighbours drain + drop their links FIRST: departure is
+            # attributed (`peer_left`), never priced as a failure
+            for j in sorted(self.peers_of[node]):
+                if self.up[j]:
+                    self.clients[j].leave(f"node{node}")
+            # then the member itself drains gracefully: ROOT settles
+            # the pipeline, DRAIN stops accepts, flushes and exits 0
+            self.clients[node].root()
+            try:
+                self.clients[node].drain()
+            except (OSError, ConnectionError, AssertionError):
+                pass
+            self.clients[node].close()
+            self.clients[node] = None
+            proc = self.procs[node]
+            proc.wait(timeout=DRAIN_TIMEOUT_S)
+            if proc.stdout is not None:
+                proc.stdout.close()
+            if proc.stderr is not None:
+                proc.stderr.close()
+            self.procs[node] = None
+            self.up[node] = False
+            self.absent.add(node)
 
     def _push_partition_view(self, nodes, settle_s: float = 30.0) -> None:
         """Install the current partition view on every node and re-push
         until the links actually settle: a link whose reconnect budget
         expires BETWEEN a respawn and the first refresh quarantines
         itself (sticky by design) a beat after the reset — the control
-        plane re-arms until the view sticks."""
-        deadline = time.perf_counter() + settle_s
+        plane re-arms until the view sticks.  The deadline rides the
+        injected clock (utils/clock.py contract), so tests drive it
+        with a ManualClock and slow hosts can widen it without wall-
+        clock flake."""
+        deadline = self.clock.now() + settle_s
         while True:
             for i in nodes:
                 self.clients[i].set_blocked_peers(sorted(self.blocked[i]))
-            if self._links_settled() or time.perf_counter() >= deadline:
+            if self._links_settled() or self.clock.now() >= deadline:
                 return
-            # speclint: disable=det-wall-clock -- real-process control
-            # plane: this polls OS-level link state on live sockets, no
-            # seeded replay decision flows through the wait
-            time.sleep(0.2)
+            self.clock.sleep(0.2)
 
     def _links_settled(self) -> bool:
         for i in self.up_nodes():
@@ -365,6 +478,31 @@ MESH_KILL = Scenario(
 
 MESH_SMOKE = Scenario(name="mesh_smoke", nodes=3, slots=4)
 
+# a 5-ring: diameter 2, every delivery to a non-neighbour is a real
+# multi-hop flood (the bench asserts 100% coverage + >=2-hop depth)
+MESH_RING = Scenario(name="mesh_ring", nodes=5, slots=4,
+                     topology=Topology(kind="ring"))
+
+# seeded churn on a durable 5-ring: node4 was never spawned and joins
+# mid-run (windowed anti-entropy catch-up), node1 leaves gracefully
+# and rejoins over its drained journal, node2 is SIGKILLed and
+# recovers — membership, graceful departure, and abrupt death all in
+# one timeline, converging to the same oracle root
+MESH_CHURN = Scenario(
+    name="mesh_churn", nodes=5, slots=6, durable=True,
+    topology=Topology(kind="ring"),
+    events=(join(1.5, node=4), leave(2.5, node=1), kill(3.0, node=2),
+            recover(4.0, node=2), join(4.5, node=1)))
+
+# two cliques ({0,1,2} and {2,3,4}) joined through cut vertex 2: kill
+# it mid-flood and the graph partitions BY DEATH — the case a static
+# full mesh can never express; recovery re-bridges and anti-entropy
+# repairs both sides
+MESH_BRIDGE = Scenario(
+    name="mesh_bridge", nodes=5, slots=6, durable=True,
+    topology=Topology(kind="bridge"),
+    events=(kill(2.5, node=2), recover(3.5, node=2)))
+
 # node 2 damages its OWN outbound link frames (one flipped bit per
 # fire): receivers shed on CRC and quarantine the inbound connection,
 # node 2's link layer records the injection — and anti-entropy still
@@ -380,6 +518,8 @@ DRILL_CASES = (
     ("kill_recover", MESH_KILL, None),
     ("link_corrupt", MESH_SMOKE, _CORRUPT_ARGS),
     ("blackout3", LIBRARY["blackout3"], None),
+    ("churn_storm", MESH_CHURN, None),
+    ("bridge_kill", MESH_BRIDGE, None),
 )
 
 

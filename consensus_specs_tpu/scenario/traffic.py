@@ -201,7 +201,7 @@ class TrafficPlan:
         t = self.slot_time(event.at_slot)
         kind = event.kind
         if kind in ("partition", "heal", "crash", "kill", "recover",
-                    "degraded"):
+                    "degraded", "join", "leave"):
             self.actions.append(EventAction(
                 t, kind, {k: v for k, v in event.params}))
             if kind == "degraded":

@@ -23,6 +23,12 @@ standard breaker -> bisect -> scalar-fallback contract:
   g1_sweep.G1_SWEEP_MODE platform split); the supervised fallback is
   the per-set host ladder with every point op counted in
   `host_point_adds`.
+* :func:`fold_kzg_lincombs` — the KZG batch verifier's three
+  shared-base G1 lincombs (crypto/kzg.verify_kzg_proof_batch) as one
+  dispatch on the same seam: the RLC batch is the same algebra — N
+  untrusted points under Fiat-Shamir weights folding to a 2-leg
+  pairing — so it shares the breaker, the bisect policy, and the
+  counted host-ladder fallback.
 * :func:`fold_flush` — the ONE-LAUNCH path (tpu backend, fused pairing
   mode): hash-to-G2's cofactor sweep, the Fiat-Shamir G1 weighting, the
   G2 signature MSM and the per-shard partial Miller product all fused
@@ -142,6 +148,59 @@ def fold_signatures(sigs, coeffs):
         "ops.pairing_fold",
         lambda: _fold_sweep(sigs, coeffs),
         lambda: _host_fold(sigs, coeffs))
+
+
+def _host_kzg_lincombs(proof_points, c_minus_ys, r_powers, r_times_z):
+    """The supervised fallback for the KZG fold: each lincomb on the
+    per-point host ladder, every point op counted in
+    `host_point_adds` — the same visible degradation `_host_fold`
+    prices for signature legs."""
+    def lincomb(points, coeffs):
+        acc = cv.g1_infinity()
+        for point, c in zip(points, coeffs):
+            acc = acc + _host_ladder_mul(point, c)
+        if points:
+            METRICS.inc("host_point_adds", len(points))
+        return acc
+    return (lincomb(proof_points, r_powers),
+            lincomb(proof_points, r_times_z),
+            lincomb(c_minus_ys, r_powers))
+
+
+def _kzg_lincombs_sweep(proof_points, c_minus_ys, r_powers, r_times_z):
+    """Device fn of the KZG fold: the three lincombs as batched G1
+    MSMs (ops/msm.g1_multi_exp) when the limb kernels are live, the
+    vectorized host oracle on CPU hosts — the same engine split as
+    `_fold_sweep`."""
+    from ..ops.g1_sweep import _resolve_mode as _sweep_mode
+    if _sweep_mode() == "jax":
+        from ..ops import msm as _msm
+        return (_msm.g1_multi_exp(proof_points, r_powers),
+                _msm.g1_multi_exp(proof_points, r_times_z),
+                _msm.g1_multi_exp(c_minus_ys, r_powers))
+    from ..crypto.curve import msm as _host_msm
+    return (_host_msm(proof_points, r_powers),
+            _host_msm(proof_points, r_times_z),
+            _host_msm(c_minus_ys, r_powers))
+
+
+def fold_kzg_lincombs(proof_points, c_minus_ys, r_powers, r_times_z):
+    """The KZG batch verifier's three shared-base G1 lincombs —
+    sum r_i * proof_i, sum (r_i z_i) * proof_i, sum r_i * (C_i - y_i)
+    — as ONE supervised `ops.pairing_fold` dispatch, the exact
+    shared-base shape the signature fold rides: N untrusted points
+    weighted by Fiat-Shamir coefficients collapsing to the two legs
+    of one pairing.  Returns (proof_lincomb, proof_z_lincomb,
+    c_minus_y_lincomb); the counted host ladder is the byte-identical
+    fallback."""
+    from ..resilience.supervisor import dispatch
+    METRICS.inc("fold_dispatches")
+    return dispatch(
+        "ops.pairing_fold",
+        lambda: _kzg_lincombs_sweep(proof_points, c_minus_ys,
+                                    r_powers, r_times_z),
+        lambda: _host_kzg_lincombs(proof_points, c_minus_ys,
+                                   r_powers, r_times_z))
 
 
 def _host_fold_flush(aggs, coeffs, roots, sigs) -> bool:

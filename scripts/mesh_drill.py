@@ -11,9 +11,11 @@ segment journal, and anti-entropy replays whatever a partitioned or
 dead node missed.
 
 For every case in the drill matrix — partition+heal, kill+recover,
-link-corrupt (one node bit-flips its own outbound frames), and the
-blackout3 library timeline (partition + SIGKILL + heal + recover) —
-the drill asserts:
+link-corrupt (one node bit-flips its own outbound frames), the
+blackout3 library timeline (partition + SIGKILL + heal + recover),
+churn_storm (seeded join/leave/kill/rejoin on a durable 5-ring), and
+bridge_kill (the bridge node of two cliques SIGKILLed mid-flood —
+partition by death) — the drill asserts:
 
 1. every surviving/recovered node's ``txn.store_root`` is
    byte-identical to the in-process scalar oracle over the same plan;
@@ -103,11 +105,78 @@ def check_blackout3(report) -> list:
     return fails
 
 
+def _multi_hop_mass(report) -> int:
+    """Accepted deliveries that traveled >= 2 hops, fleet-wide (the
+    `mesh_hops` pow-2 histogram: bucket "2" holds (1, 2], so every
+    bucket keyed >= 2 is multi-hop)."""
+    mass = 0
+    for node in report["nodes"].values():
+        for bucket, count in node["health"]["mesh"]["hops"].items():
+            if int(bucket) >= 2:
+                mass += count
+    return mass
+
+
+def check_churn_storm(report) -> list:
+    fails = []
+    # node4 joined mid-run: its neighbours (3, 0) admitted it through
+    # the mesh.join barrier, and its catch-up rode WINDOWED summaries
+    for name in ("node3", "node0"):
+        if not has_incident(report["nodes"][name], "peer_joined",
+                            "mesh.join"):
+            fails.append(f"{name}: no peer_joined for the mid-run join")
+    joiner = report["nodes"]["node4"]
+    if not has_incident(joiner, "catch_up", "mesh.sync"):
+        fails.append("node4: no mesh.sync catch_up after joining")
+    served_windowed = sum(
+        n["health"]["mesh"]["summary_windowed"]
+        for n in report["nodes"].values())
+    if served_windowed == 0:
+        fails.append("no node served a windowed summary "
+                     "(anti-entropy ran full-set only)")
+    # node1 left gracefully: the departure is ATTRIBUTED at its
+    # neighbour (peer_left at mesh.leave).  node0 only — node2 is the
+    # other neighbour, but its in-memory incident book is wiped by the
+    # SIGKILL that follows the leave.
+    if not has_incident(report["nodes"]["node0"], "peer_left",
+                        "mesh.leave"):
+        fails.append("node0: node1's graceful leave left no "
+                     "peer_left incident")
+    # node2 died abruptly and recovered over its journal
+    victim = report["nodes"]["node2"]
+    if not victim["health"]["recovered"]:
+        fails.append("node2 did not report recovered=True")
+    if not has_incident(victim, "recovered", "txn.recover"):
+        fails.append("node2: no txn.recover incident after SIGKILL")
+    if _multi_hop_mass(report) == 0:
+        fails.append("ring flood never delivered across >= 2 hops")
+    return fails
+
+
+def check_bridge_kill(report) -> list:
+    fails = []
+    victim = report["nodes"]["node2"]
+    if not victim["health"]["recovered"]:
+        fails.append("bridge node2 did not report recovered=True")
+    if not has_incident(victim, "recovered", "txn.recover"):
+        fails.append("node2: no txn.recover incident after SIGKILL")
+    # while the bridge was dead the two cliques could not exchange;
+    # repair is anti-entropy's job and must be on the record
+    if not any(has_incident(n, "catch_up", "mesh.sync")
+               for n in report["nodes"].values()):
+        fails.append("no node recorded a mesh.sync catch_up")
+    if _multi_hop_mass(report) == 0:
+        fails.append("bridge flood never delivered across >= 2 hops")
+    return fails
+
+
 CHECKS = {
     "partition_heal": check_partition_heal,
     "kill_recover": check_kill_recover,
     "link_corrupt": check_link_corrupt,
     "blackout3": check_blackout3,
+    "churn_storm": check_churn_storm,
+    "bridge_kill": check_bridge_kill,
 }
 
 

@@ -31,10 +31,14 @@ node-drill`.
 
 With SOAK_MESH=1 those interleaved rounds run a short real-process
 MESH drill instead (or alternate with node rounds when both are set):
-three `scripts/run_node.py` processes meshed over their sockets ride
-the partition+heal timeline (`make mesh-drill` quick case) and must
-converge byte-identically to the oracle with no orphaned process or
-socket — the nightly-soak shape of the mesh drill.
+`scripts/run_node.py` processes meshed over their sockets ride either
+the partition+heal timeline (`make mesh-drill` quick case) or — on a
+seeded coin flip — the churn_storm timeline (mid-run join over
+windowed anti-entropy, graceful attributed leave, SIGKILL+recover,
+re-join on a 5-ring) and must converge byte-identically to the
+oracle with no orphaned process or socket AND with the soak's own fd
+and child-process counts back at baseline — churn is exactly where
+handles leak, so the bound is asserted every round.
 
 Environment:
     SOAK_SECONDS     wall-clock budget (default 300); the current
@@ -264,36 +268,91 @@ def _run_node_round(seed: int) -> dict:
     }
 
 
-def _run_mesh_round(seed: int) -> dict:
-    """One short real-process mesh drill round: the partition+heal
-    case from the drill matrix (scenario/processes.py) — three meshed
-    run_node.py processes, a PEERS-frame partition, a heal with
-    anti-entropy — asserting byte-identical convergence to the oracle
-    and a leak-free teardown."""
-    from consensus_specs_tpu.scenario.processes import (
-        MESH_PART, run_scenario_processes)
+def _count_fds() -> int:
+    return len(os.listdir("/proc/self/fd"))
 
-    report = run_scenario_processes(MESH_PART, seed=seed)
+
+def _count_children() -> int:
+    """Live child processes of this soak, via /proc ppid scan."""
+    me = str(os.getpid())
+    n = 0
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/stat") as fh:
+                stat = fh.read()
+        except OSError:
+            continue            # raced a process exit
+        # ppid is the second field AFTER the parenthesized comm (which
+        # may itself contain spaces)
+        if stat.rsplit(")", 1)[-1].split()[1] == me:
+            n += 1
+    return n
+
+
+# client/link sockets churn during a round; the bound is that nothing
+# TRENDS — a leaked PeerLink or journal fd would survive teardown
+FD_SLACK = 8
+
+
+def _run_mesh_round(seed: int) -> dict:
+    """One short real-process mesh drill round, seeded CHURN half the
+    time: even draws ride the partition+heal case, odd draws the
+    churn_storm timeline — a mid-run join catching up over windowed
+    anti-entropy, a graceful attributed leave, a SIGKILL+recover, a
+    re-join (scenario/processes.py).  Every round asserts
+    byte-identical convergence to the oracle, a leak-free teardown,
+    and — because churn is exactly where handles leak — that the
+    soak's own fd count and child-process count return to baseline."""
+    from consensus_specs_tpu.scenario.processes import (
+        MESH_CHURN, MESH_PART, run_scenario_processes)
+
+    churn = random.Random(seed).random() < 0.5
+    sc = MESH_CHURN if churn else MESH_PART
+    fds_before = _count_fds()
+    children_before = _count_children()
+    report = run_scenario_processes(sc, seed=seed)
     assert report["converged"], \
         f"mesh round diverged: oracle {report['oracle'][:16]}… vs " \
         f"roots {[r[:16] for r in report['roots']]}"
     assert not report["orphan_procs"] and not report["orphan_sockets"], \
         f"mesh round leaked: procs={report['orphan_procs']} " \
         f"sockets={report['orphan_sockets']}"
+    fds_after = _count_fds()
+    children_after = _count_children()
+    assert fds_after <= fds_before + FD_SLACK, \
+        f"mesh round leaked fds: {fds_before} -> {fds_after}"
+    assert children_after <= children_before, \
+        f"mesh round leaked processes: {children_before} -> " \
+        f"{children_after}"
     nodes = report["nodes"]
-    assert any(
-        any(e.get("event") == "link_healed" for e in n["incidents"])
-        for n in nodes.values()), \
-        "mesh round: no node recorded the heal (link_healed)"
+    if churn:
+        assert any(
+            any(e.get("event") == "peer_joined" for e in n["incidents"])
+            for n in nodes.values()), \
+            "churn round: no node attributed the join (peer_joined)"
+        assert any(
+            any(e.get("event") == "peer_left" for e in n["incidents"])
+            for n in nodes.values()), \
+            "churn round: no node attributed the leave (peer_left)"
+        assert sum(n["health"]["mesh"]["summary_windowed"]
+                   for n in nodes.values()) > 0, \
+            "churn round: catch-up never rode a windowed summary"
+    else:
+        assert any(
+            any(e.get("event") == "link_healed" for e in n["incidents"])
+            for n in nodes.values()), \
+            "mesh round: no node recorded the heal (link_healed)"
     forwarded = sum(n["health"]["mesh"]["forwarded"]
                     for n in nodes.values())
     disk_hw = max(int(n["health"]["journal"]["disk_bytes"])
                   for n in nodes.values())
     return {
-        "scenario": "mesh:partition_heal",
+        "scenario": f"mesh:{'churn_storm' if churn else 'partition_heal'}",
         "seed": seed,
         "nodes": len(nodes),
-        "events": len(MESH_PART.events),
+        "events": len(sc.events),
         "feed_size": forwarded,
         "disk_hw_bytes": disk_hw,
         "segments_at_end": sum(int(n["health"]["journal"]["segments"])

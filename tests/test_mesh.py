@@ -12,7 +12,19 @@ pins the in-process contracts the drill assumes:
   raises;
 * framing damage in the response stream quarantines THAT link and the
   owning node keeps serving; `reset()` heals it;
-* the content-addressed dedup stops flood loops on a cyclic topology.
+* the content-addressed dedup stops flood loops on a cyclic topology;
+* a frame past `MeshConfig.ttl` hops sheds with a `ttl_exhausted`
+  incident before the recv barrier ever fires;
+* windowed `S` summaries serve EXACTLY the requested slot window —
+  repair traffic is O(missed window), never O(history);
+* `J`/`L` frames mutate the live peer table with attribution
+  (`peer_joined`/`peer_left`), idempotently, re-join-on-new-socket
+  replacing the stale link;
+* a joiner converges by windowed anti-entropy over real sockets, the
+  repair digests counted;
+* a ring floods every member across >= 2 hops; cutting a bridge
+  node's links isolates the far clique until anti-entropy repairs it;
+* `_push_partition_view`'s settle deadline rides the injected clock.
 """
 import os
 import random
@@ -298,3 +310,300 @@ def test_dedup_prevents_flood_loops_on_three_cycle(tmp_path):
                 svc._cond.notify()
             svc._pump.join(timeout=10.0)
             svc.close()
+
+
+# -- churn-survival contracts -------------------------------------------
+
+def _mesh_config(tmp_path, name, peers=(), **overrides):
+    from consensus_specs_tpu.mesh import MeshConfig
+    return MeshConfig(
+        socket_path=str(tmp_path / f"{name}.sock"),
+        data_dir=str(tmp_path / name),
+        segment_bytes=4096, snapshot_interval=16, ingest_bound=256,
+        node_id=name, peers=tuple(peers), **overrides)
+
+
+def _start_fleet(tmp_path, peers_of, **overrides):
+    """Build one MeshNodeService per adjacency entry, servers + pumps
+    running, sockets under tmp_path.  Caller must _stop_fleet."""
+    from consensus_specs_tpu.mesh import MeshNodeService
+    n = len(peers_of)
+    socks = [str(tmp_path / f"node{i}.sock") for i in range(n)]
+    services = []
+    for i, neighbours in enumerate(peers_of):
+        config = _mesh_config(
+            tmp_path, f"node{i}",
+            peers=tuple((f"node{j}", socks[j])
+                        for j in sorted(neighbours)), **overrides)
+        svc = MeshNodeService(config)
+        svc.server.start()
+        svc._pump.start()
+        services.append(svc)
+    return services
+
+
+def _stop_fleet(services):
+    for svc in services:
+        svc._stopping = True
+        with svc._cond:
+            svc._cond.notify()
+        svc._pump.join(timeout=10.0)
+        svc.close()
+
+
+def _flood_one(services, origin=0):
+    """Tick every service to slot 1 and submit the smoke plan's first
+    admissible message at `origin`; returns its accept digest."""
+    from consensus_specs_tpu.node.client import build_plan, \
+        replay_sequence
+    from consensus_specs_tpu.ssz import hash_tree_root
+    _, plan = build_plan("smoke", 1)
+    seq = replay_sequence(plan)
+    assert seq[0][0] == "tick" and seq[1][0] == "msg"
+    sink = []
+    for svc in services:
+        svc.handle(wire.KIND_TICK, (1, seq[0][1]), sink.append)
+    services[origin].handle(
+        wire.KIND_MESSAGE, (2, seq[1][1], seq[1][3], seq[1][2]),
+        sink.append)
+    return bytes(hash_tree_root(seq[1][2]))
+
+
+# -- TTL backstop -------------------------------------------------------
+
+def test_ttl_exhausted_sheds_with_incident(tmp_path):
+    """A mesh-forwarded frame whose hop counter has reached the TTL
+    sheds BEFORE the recv barrier: incident-attributed, counted, and
+    the pipeline never sees it.  One hop under the limit passes."""
+    from consensus_specs_tpu.mesh import MeshNodeService
+    from consensus_specs_tpu.mesh.service import RECV_SITE
+    svc = MeshNodeService(_mesh_config(tmp_path, "node0", ttl=4))
+    try:
+        responses = []
+        svc.handle(wire.KIND_MESSAGE, (4, "t", "mesh:nodeX", b"\x01"),
+                   responses.append)
+        assert responses == [{"id": 4, "status": "shed",
+                              "detail": "ttl exhausted"}]
+        assert svc.ctx.incidents.count("ttl_exhausted", RECV_SITE) == 1
+        assert svc.ctx.metrics.count("mesh_ttl_exhausted") == 1
+        # one hop under the limit crosses the TTL gate — what sheds it
+        # now is ordinary admission (unknown topic), not the TTL
+        svc.handle(wire.KIND_MESSAGE, (3, "t", "mesh:nodeX", b"\x02"),
+                   responses.append)
+        assert responses[-1]["detail"] == "bad topic 't'"
+        assert svc.ctx.metrics.count("mesh_ttl_exhausted") == 1
+        assert svc.ctx.incidents.count("ttl_exhausted", RECV_SITE) == 1
+    finally:
+        svc.close()
+
+
+# -- windowed anti-entropy summaries ------------------------------------
+
+def test_windowed_summary_serves_exactly_the_window(tmp_path):
+    """The `S` frame's windowed form returns EXACTLY the digests whose
+    accept slot lands in [lo, hi) — the O(W) repair contract — with
+    hi=-1 unbounded above and the bare-int form the counted full-set
+    fallback."""
+    from consensus_specs_tpu.mesh import MeshNodeService
+    svc = MeshNodeService(_mesh_config(tmp_path, "node0"))
+    try:
+        digests = {}
+        with svc._replay_lock:
+            for slot in range(10):
+                d = bytes([slot]) * 32
+                digests[slot] = d
+                svc._replay[d] = ("t", "p", b"", slot)
+        out = []
+        svc.handle(wire.KIND_SUMMARY, (1, 4, 8), out.append)
+        assert out[-1]["status"] == "ok"
+        assert sorted(out[-1]["digests"]) == sorted(
+            digests[s] for s in range(4, 8))
+        svc.handle(wire.KIND_SUMMARY, (2, 6, -1), out.append)
+        assert sorted(out[-1]["digests"]) == sorted(
+            digests[s] for s in range(6, 10))
+        assert svc.ctx.metrics.count("mesh_summary_windowed") == 2
+        assert svc.ctx.metrics.count("mesh_summary_full") == 0
+        # bare int: the full set, priced as the fallback it is
+        svc.handle(wire.KIND_SUMMARY, 3, out.append)
+        assert len(out[-1]["digests"]) == 10
+        assert svc.ctx.metrics.count("mesh_summary_full") == 1
+    finally:
+        svc.close()
+
+
+# -- dynamic membership -------------------------------------------------
+
+def test_join_leave_mutate_peer_table_with_attribution(tmp_path):
+    """`J` admits a member at runtime (idempotent on the same socket,
+    replacing on a new one), `L` drains one out; both land attributed
+    incidents at their barrier sites and mutate the live table."""
+    from consensus_specs_tpu.mesh import MeshNodeService
+    from consensus_specs_tpu.mesh.service import JOIN_SITE, LEAVE_SITE
+    svc = MeshNodeService(_mesh_config(tmp_path, "node0"))
+    try:
+        out = []
+        path9 = str(tmp_path / "node9.sock")
+        svc.handle(wire.KIND_JOIN, (1, "node9", path9), out.append)
+        assert out[-1]["added"] is True
+        assert out[-1]["peers"] == ["node9"]
+        assert svc.ctx.incidents.count("peer_joined", JOIN_SITE) == 1
+        # same socket again: a no-op reset, not a second join
+        svc.handle(wire.KIND_JOIN, (2, "node9", path9), out.append)
+        assert out[-1]["added"] is False
+        assert svc.ctx.metrics.count("mesh_joins") == 1
+        # a NEW socket replaces the stale link
+        path9b = str(tmp_path / "node9b.sock")
+        svc.handle(wire.KIND_JOIN, (3, "node9", path9b), out.append)
+        assert out[-1]["added"] is True
+        assert out[-1]["peers"] == ["node9"]
+        with svc._links_lock:
+            assert svc.links["node9"].socket_path == path9b
+        svc.handle(wire.KIND_LEAVE, (4, "node9"), out.append)
+        assert out[-1]["removed"] is True
+        assert out[-1]["peers"] == []
+        assert svc.ctx.incidents.count("peer_left", LEAVE_SITE) == 1
+        # leaving twice is a visible no-op
+        svc.handle(wire.KIND_LEAVE, (5, "node9"), out.append)
+        assert out[-1]["removed"] is False
+        assert svc.ctx.metrics.count("mesh_leaves") == 1
+    finally:
+        svc.close()
+
+
+# -- clock-injected settle deadline -------------------------------------
+
+def test_partition_settle_deadline_rides_injected_clock(tmp_path):
+    """`_push_partition_view` re-pushes until links settle OR its
+    deadline passes — and the deadline is the INJECTED clock's, so a
+    ManualClock walks a never-settling mesh through the full 30s
+    budget instantly, with zero wall-clock sleeps."""
+    from consensus_specs_tpu.scenario.dsl import Scenario
+    from consensus_specs_tpu.scenario.processes import ProcessMesh
+    from consensus_specs_tpu.utils.clock import ManualClock
+    clock = ManualClock()
+    mesh = ProcessMesh(Scenario(name="settle", nodes=2, slots=2),
+                       base_dir=str(tmp_path), clock=clock)
+    try:
+        mesh._links_settled = lambda: False
+        t0 = time.monotonic()
+        mesh._push_partition_view([])       # no node to push to: the
+        wall = time.monotonic() - t0        # loop is pure clock walk
+        assert clock.now() >= 30.0, "deadline did not ride the clock"
+        assert wall < 5.0, "ManualClock settle burned wall time"
+        # a settled mesh returns without advancing the clock at all
+        mesh._links_settled = lambda: True
+        before = clock.now()
+        mesh._push_partition_view([])
+        assert clock.now() == before
+    finally:
+        mesh.teardown(force=True)
+
+
+# -- multi-hop topologies (real services over sockets) ------------------
+
+@pytest.mark.slow
+def test_ring_flood_covers_all_five_nodes_multi_hop(tmp_path):
+    """Five services in a RING (each linked only to its neighbours):
+    one message at node0 reaches all five exactly once, and the two
+    nodes at ring-distance 2 record their delivery in the `mesh_hops`
+    histogram's >= 2 buckets — multi-hop coverage is observable, not
+    assumed."""
+    ring = [{(i - 1) % 5, (i + 1) % 5} for i in range(5)]
+    services = _start_fleet(tmp_path, ring)
+    try:
+        digest = _flood_one(services, origin=0)
+        _wait_until(
+            lambda: all(s.ctx.metrics.count_labeled("gossip_accepted")
+                        >= 1 for s in services),
+            deadline_s=60.0, what="ring flood to reach every node")
+        for svc in services:
+            assert svc.ctx.metrics.count_labeled("gossip_accepted") == 1
+            assert svc.pipe.seen.seen_before(digest)
+        multi_hop = sum(
+            count
+            for svc in services
+            for bucket, count in
+            svc.ctx.metrics.hist_counts("mesh_hops").items()
+            if int(bucket) >= 2)
+        assert multi_hop >= 2, "far side of the ring took a shortcut"
+    finally:
+        _stop_fleet(services)
+
+
+@pytest.mark.slow
+def test_bridge_cut_isolates_far_clique_until_sync(tmp_path):
+    """Bridge topology {0,1,2} - 2 - {2,3,4}: with the bridge node's
+    links to the far clique cut (both directions), a flood from node0
+    covers only the near clique; healing the cut lets windowed
+    anti-entropy carry the miss across — delivery through repair, not
+    re-flood."""
+    bridge = [{1, 2}, {0, 2}, {0, 1, 3, 4}, {2, 4}, {2, 3}]
+    services = _start_fleet(tmp_path, bridge)
+    sink = []
+    try:
+        # cut: node2 blocks the far clique, the far clique blocks node2
+        services[2].handle(wire.KIND_PEERS,
+                           (1, ("node3", "node4")), sink.append)
+        for i in (3, 4):
+            services[i].handle(wire.KIND_PEERS, (1, ("node2",)),
+                               sink.append)
+        digest = _flood_one(services, origin=0)
+        _wait_until(
+            lambda: all(services[i].ctx.metrics.count_labeled(
+                "gossip_accepted") >= 1 for i in (0, 1, 2)),
+            deadline_s=60.0, what="flood to cover the near clique")
+        time.sleep(0.5)                 # give a leak a chance to show
+        for i in (3, 4):
+            assert services[i].ctx.metrics.count_labeled(
+                "gossip_accepted") == 0, "the cut leaked the flood"
+        # heal both directions, then one explicit pass on node3 (the
+        # healed links ALSO schedule auto-syncs; either path repairs)
+        services[2].handle(wire.KIND_PEERS, (2, ()), sink.append)
+        for i in (3, 4):
+            services[i].handle(wire.KIND_PEERS, (2, ()), sink.append)
+        services[3].handle(wire.KIND_SYNC, 9, sink.append)
+        _wait_until(
+            lambda: all(services[i].ctx.metrics.count_labeled(
+                "gossip_accepted") >= 1 for i in (3, 4)),
+            deadline_s=60.0, what="anti-entropy to repair the far clique")
+        for svc in services:
+            assert svc.pipe.seen.seen_before(digest)
+            assert svc.ctx.metrics.count_labeled("gossip_accepted") == 1
+    finally:
+        _stop_fleet(services)
+
+
+@pytest.mark.slow
+def test_joiner_converges_by_windowed_anti_entropy(tmp_path):
+    """The join lifecycle end-to-end over real sockets: nodeA floods
+    alone, nodeB joins at runtime (J frames both ways), and one
+    windowed sync pulls exactly the missed traffic — the repair
+    digests counted, the summary served windowed, the catch-up
+    attributed at mesh.sync."""
+    from consensus_specs_tpu.mesh.service import SYNC_SITE
+    services = _start_fleet(tmp_path, [set(), set()])
+    a, b = services
+    sink = []
+    try:
+        digest = _flood_one(services, origin=0)
+        _wait_until(
+            lambda: a.ctx.metrics.count_labeled("gossip_accepted") >= 1,
+            what="nodeA to accept the flood seed")
+        assert b.ctx.metrics.count_labeled("gossip_accepted") == 0
+        # runtime admission, both directions
+        a.handle(wire.KIND_JOIN,
+                 (1, "node1", b.config.socket_path), sink.append)
+        b.handle(wire.KIND_JOIN,
+                 (1, "node0", a.config.socket_path), sink.append)
+        assert all(r["added"] for r in sink[-2:])
+        b.handle(wire.KIND_SYNC, 2, sink.append)
+        _wait_until(
+            lambda: b.ctx.metrics.count_labeled("gossip_accepted") >= 1,
+            what="the joiner to converge")
+        assert b.pipe.seen.seen_before(digest)
+        assert b.ctx.metrics.count("mesh_sync_digests") >= 1
+        assert b.ctx.metrics.count("mesh_sync_full_fallbacks") == 0
+        assert a.ctx.metrics.count("mesh_summary_windowed") >= 1
+        assert b.ctx.incidents.count("catch_up", SYNC_SITE) >= 1
+    finally:
+        _stop_fleet(services)
