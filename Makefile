@@ -15,7 +15,8 @@ RUNNERS := shuffling ssz_static operations epoch_processing sanity bls \
 .PHONY: test test-quick test-kernels tier1 chaos recovery-chaos \
 	kill-drill scenario-chaos pipeline-chaos shard-verify soak lint \
 	speclint native pyspec bench \
-	gossip-bench txn-bench msm-bench merkle-bench scenario-bench \
+	gossip-bench txn-bench msm-bench merkle-bench epoch-bench \
+	scenario-bench \
 	multichip-bench pipeline-bench fold-bench factory-bench \
 	factory-drill node-drill node-bench mesh-drill mesh-bench \
 	gen_all detect_errors \
@@ -229,6 +230,18 @@ msm-bench:
 # full-rebuild path; BENCH_MERKLE_VALIDATORS=N resizes the state
 merkle-bench:
 	$(PYTHON) bench.py merkle_inc
+
+# fused epoch engine alone (specs/epoch_fast.py -> ops.epoch_sweep):
+# device/numpy/scalar process_epoch legs at the mainnet preset over
+# ONE 2^18-validator state (copies), root identity pinned, exactly one
+# counted ops.epoch_sweep dispatch per epoch, plus the slot+epoch
+# boundary-transition leg (device merkleization + fused epoch) vs the
+# scalar transition — the >= 50x north-star shape; emits the next free
+# EPOCH_r0N.json slot and fails if device s/epoch regressed > 2x vs
+# the previous archived report.  BENCH_EPOCH_VALIDATORS=4096 gives a
+# small smoke run
+epoch-bench:
+	$(PYTHON) bench.py epoch
 
 # fleet battlefield alone (scenario/): 16 nodes at 10x ingress through
 # a partition + equivocation storm + heal; asserts oracle convergence,

@@ -274,8 +274,27 @@ def bench_merkle_inc():
     }
 
 
+def _claim_report_slot(prefix: str) -> tuple:
+    """CLAIM the next free <prefix>_r0N.json slot atomically
+    (O_CREAT|O_EXCL, the soak rotation's discipline) and return
+    (path, previous_path_or_None) — the previous archived report is
+    the SLO baseline this run is pinned against."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    n = 1
+    prev = None
+    while True:
+        path = os.path.join(here, f"{prefix}_r{n:02d}.json")
+        try:
+            os.close(os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY,
+                             0o644))
+            return path, prev
+        except FileExistsError:
+            prev = path
+            n += 1
+
+
 # ---------------------------------------------------------------------------
-# tier: epoch processing (vectorized validator axis, mainnet preset)
+# tier: epoch processing (fused ops.epoch_sweep seam, mainnet preset)
 # ---------------------------------------------------------------------------
 
 def _epoch_state(spec, n):
@@ -312,42 +331,199 @@ def _epoch_state(spec, n):
     return state
 
 
+def _epoch_slo_baseline(prev_path) -> float:
+    """Device seconds-per-epoch from the previous archived EPOCH
+    report, or 0.0 when there is none (first run)."""
+    if prev_path is None:
+        return 0.0
+    try:
+        with open(prev_path) as fh:
+            return float(json.load(fh)["epoch"]["device_s"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return 0.0
+
+
 def bench_epoch():
-    from consensus_specs_tpu.specs import get_spec
-    from consensus_specs_tpu.specs import epoch_fast
-    from consensus_specs_tpu.parallel import mesh_engine
+    """Fused epoch engine (specs/epoch_fast.py -> the registered
+    ops.epoch_sweep seam) at the mainnet preset: one state build,
+    three legs over copies of the SAME shape — (1) device: the fused
+    one-dispatch program (counted pin: exactly ONE ops.epoch_sweep
+    dispatch per process_epoch, zero fallbacks); (2) numpy: the
+    byte-identical counted fallback twin, forced via the supervisor's
+    scalar kill switch; (3) scalar: reference-shaped per-validator
+    loops at a feasible size, scaled linearly (conservative — the
+    scalar path has O(n^2) components).  Root identity across all
+    three is asserted at the baseline size.  A fourth leg times the
+    full slot+epoch `process_slots` boundary transition (device
+    merkleization + fused epoch) vs the scalar-shaped transition —
+    the north-star ≥50x shape.  Emits the next free EPOCH_r0N.json
+    slot and PINS device seconds-per-epoch against the previous
+    archived report: more than 2x slower is a failed run, not a
+    data point."""
+    from consensus_specs_tpu import resilience
+    from consensus_specs_tpu.sigpipe.metrics import METRICS
+    from consensus_specs_tpu.specs import epoch_fast, get_spec
+    from consensus_specs_tpu.ssz import merkle, uint64
+
+    t_start = time.perf_counter()
+
+    def mark(msg):
+        log(f"[bench] epoch +{time.perf_counter() - t_start:5.1f}s: "
+            f"{msg}")
 
     spec = get_spec("altair", "mainnet")
-    log(f"[bench] epoch: building {EPOCH_VALIDATORS}-validator state ...")
-    state = _epoch_state(spec, EPOCH_VALIDATORS)
 
-    # single-chip device engine: flag-delta + slashing sweeps run as
-    # the same compiled XLA programs the multi-chip mesh uses
-    engine = mesh_engine.enable_single_device()
+    # -- correctness pin at the baseline size: device == numpy == scalar
+    def _run_small(run):
+        s = _epoch_state(spec, EPOCH_BASELINE_VALIDATORS)
+        run(s)
+        return spec.hash_tree_root(s)
+
+    dev_root = _run_small(spec.process_epoch)
+    resilience.enable()
+    resilience.force_scalar(True)
     try:
-        warm = _epoch_state(spec, EPOCH_VALIDATORS)
-        spec.process_epoch(warm)   # compile warm-up outside the timer
-
-        t0 = time.perf_counter()
-        spec.process_epoch(state)
-        fast_time = time.perf_counter() - t0
+        np_root = _run_small(spec.process_epoch)
     finally:
-        engine.disable()
+        resilience.disable()
+    with epoch_fast.scalar_epoch():
+        scalar_root = _run_small(spec.process_epoch)
+    assert dev_root == np_root == scalar_root, \
+        "device/numpy/scalar post-epoch roots diverge"
+    mark(f"roots identical across device/numpy/scalar "
+         f"({EPOCH_BASELINE_VALIDATORS} validators)")
 
-    # baseline: reference-shaped scalar loops at a feasible size, scaled
-    # linearly (conservative: the scalar path has O(n^2) components)
+    mark(f"building {EPOCH_VALIDATORS}-validator state ...")
+    base = _epoch_state(spec, EPOCH_VALIDATORS)
+    warm = base.copy()
+    spec.process_epoch(warm)       # compile warm-up outside the timer
+
+    # -- leg 1: device — the one-dispatch pin is counted, not assumed
+    state = base.copy()
+    METRICS.reset()
+    t0 = time.perf_counter()
+    spec.process_epoch(state)
+    device_time = time.perf_counter() - t0
+    snap = METRICS.snapshot()
+    assert snap.get("epoch_sweep_dispatches", 0) == 1, \
+        f"expected exactly 1 ops.epoch_sweep dispatch, saw " \
+        f"{snap.get('epoch_sweep_dispatches', 0)}"
+    assert not snap.get("epoch_sweep_fallbacks"), \
+        f"device leg degraded: {snap.get('epoch_sweep_fallbacks')}"
+    wb_elems = snap.get("epoch_writeback_elems", 0)
+    mark(f"device: {device_time:.3f}s (1 dispatch, "
+         f"{wb_elems} writeback elems)")
+
+    # -- leg 2: the numpy twin (counted fallback), same shape
+    np_state = base.copy()
+    resilience.enable()
+    resilience.force_scalar(True)
+    try:
+        METRICS.reset()
+        t0 = time.perf_counter()
+        spec.process_epoch(np_state)
+        numpy_time = time.perf_counter() - t0
+    finally:
+        resilience.disable()
+    assert METRICS.count_labeled(
+        "epoch_sweep_fallbacks", "disabled") == 1, \
+        "numpy leg did not ride the counted fallback"
+    assert list(np_state.balances) == list(state.balances) and \
+        list(np_state.inactivity_scores) == \
+        list(state.inactivity_scores), \
+        "numpy twin diverged from the device sweep at full size"
+    mark(f"numpy twin: {numpy_time:.3f}s, outputs identical")
+
+    # -- leg 3: scalar baseline at a feasible size, scaled linearly
     small = _epoch_state(spec, EPOCH_BASELINE_VALIDATORS)
     with epoch_fast.scalar_epoch():
         t0 = time.perf_counter()
         spec.process_epoch(small)
         scalar_time = (time.perf_counter() - t0) * (
             EPOCH_VALIDATORS / EPOCH_BASELINE_VALIDATORS)
+    device_x = scalar_time / device_time
+    numpy_x = scalar_time / numpy_time
+    mark(f"scalar (scaled): {scalar_time:.1f}s -> device {device_x:.0f}x, "
+         f"numpy {numpy_x:.0f}x")
 
+    # -- leg 4: the full slot+epoch boundary transition (north-star
+    # shape: device merkleization + fused epoch in one process_slots)
+    trans = base.copy()
+    boundary = uint64(3 * spec.SLOTS_PER_EPOCH)
+    merkle.use_tpu_hashing(threshold=4096)
+    try:
+        METRICS.reset()
+        t0 = time.perf_counter()
+        spec.process_slots(trans, boundary)
+        trans_time = time.perf_counter() - t0
+    finally:
+        merkle.use_host_hashing()
+    assert METRICS.snapshot().get("epoch_sweep_dispatches", 0) == 1, \
+        "boundary transition crossed != 1 epoch sweep dispatch"
+    small = _epoch_state(spec, EPOCH_BASELINE_VALIDATORS)
+    with epoch_fast.scalar_epoch():
+        t0 = time.perf_counter()
+        spec.process_slots(small, boundary)
+        trans_scalar = (time.perf_counter() - t0) * (
+            EPOCH_VALIDATORS / EPOCH_BASELINE_VALIDATORS)
+    trans_x = trans_scalar / trans_time
+    mark(f"transition: {trans_time:.3f}s vs scalar "
+         f"{trans_scalar:.1f}s -> {trans_x:.0f}x (target >= 50x)")
+
+    # -- SLO pin: rotation-archived device s/epoch must not regress > 2x
+    report_path, prev_path = _claim_report_slot("EPOCH")
+    baseline_s = _epoch_slo_baseline(prev_path)
+    if baseline_s > 0:
+        assert device_time <= 2.0 * baseline_s, \
+            f"device epoch SLO regression: {device_time:.3f}s vs " \
+            f"{baseline_s:.3f}s in {os.path.basename(prev_path)} (> 2x)"
+        mark(f"slo: {device_time:.3f}s within 2x of {baseline_s:.3f}s "
+             f"({os.path.basename(prev_path)})")
+    else:
+        mark(f"slo: first archived run — {device_time:.3f}s becomes "
+             f"the baseline")
+
+    out = {
+        "preset": "mainnet",
+        "fork": "altair",
+        "validators": EPOCH_VALIDATORS,
+        "epoch": {
+            "device_s": round(device_time, 4),
+            "numpy_s": round(numpy_time, 4),
+            "scalar_s_scaled": round(scalar_time, 2),
+            "device_x_vs_scalar": round(device_x, 1),
+            "numpy_x_vs_scalar": round(numpy_x, 1),
+            "dispatches": 1,
+            "writeback_elems": wb_elems,
+        },
+        "transition": {
+            "device_s": round(trans_time, 4),
+            "scalar_s_scaled": round(trans_scalar, 2),
+            "device_x_vs_scalar": round(trans_x, 1),
+            "target_x": 50,
+        },
+        "roots": {
+            "baseline_validators": EPOCH_BASELINE_VALIDATORS,
+            "identical": True,
+        },
+        "slo": {
+            "device_epoch_s": round(device_time, 4),
+            "baseline_s": round(baseline_s, 4),
+            "baseline_report": (os.path.basename(prev_path)
+                                if prev_path else None),
+        },
+        "ok": True,
+    }
+    with open(report_path, "w") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+    log("[bench] epoch: " + json.dumps(out, sort_keys=True))
     return {
         "metric": "mainnet_epoch_process_epoch_sec",
-        "value": round(fast_time, 3),
-        "unit": f"s/epoch ({EPOCH_VALIDATORS} validators)",
-        "vs_baseline": round(scalar_time / fast_time, 2),
+        "value": round(device_time, 3),
+        "unit": (f"s/epoch ({EPOCH_VALIDATORS} validators; numpy twin "
+                 f"{round(numpy_time, 3)}s, boundary transition "
+                 f"{trans_x:.0f}x vs scalar)"),
+        "vs_baseline": round(device_x, 2),
     }
 
 
@@ -2313,25 +2489,11 @@ def bench_node():
 
 MESH_SEED = int(os.environ.get("BENCH_MESH_SEED", "1"))
 MESH_FLOOD_PASSES = int(os.environ.get("BENCH_MESH_PASSES", "3"))
-MESH_DIR = os.path.dirname(os.path.abspath(__file__))
 
 
 def _claim_mesh_report() -> tuple:
-    """CLAIM the next free MESH_r0N.json slot atomically
-    (O_CREAT|O_EXCL, the soak rotation's discipline) and return
-    (path, previous_path_or_None) — the previous archived report is
-    the SLO baseline this run is pinned against."""
-    n = 1
-    prev = None
-    while True:
-        path = os.path.join(MESH_DIR, f"MESH_r{n:02d}.json")
-        try:
-            os.close(os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY,
-                             0o644))
-            return path, prev
-        except FileExistsError:
-            prev = path
-            n += 1
+    """Next free MESH_r0N.json slot (see _claim_report_slot)."""
+    return _claim_report_slot("MESH")
 
 
 def _mesh_slo_baseline(prev_path) -> float:
@@ -2557,7 +2719,10 @@ TIERS = {
     # genesis build + block signing dominate; the timed dispatch is one
     # fused pairing kernel call
     "block_sigs": (bench_block_sigs, 420),
-    "epoch": (bench_epoch, 300),
+    # fused one-dispatch epoch engine: device/numpy/scalar legs + the
+    # boundary-transition leg share ONE mainnet-scale state build
+    # (copies); emits rotation-claimed EPOCH_r0N.json with a 2x SLO pin
+    "epoch": (bench_epoch, 480),
     # state build (~80s) + full-state merkleization/slot + scaled scalar
     # baseline: needs more headroom than the epoch tier
     "transition": (bench_transition, 350),

@@ -76,6 +76,10 @@ RULES: dict[str, str] = {
     "node-scalar-bypass":
         "node code imports crypto.* or calls a scalar BLS/KZG oracle "
         "verb instead of feeding the admission pipeline's counted seams",
+    "epoch-scalar-bypass":
+        "package code imports the ops.epoch_sweep device program or "
+        "reaches epoch_fast internals instead of riding the registered "
+        "ops.epoch_sweep seam (or the scalar_epoch escape hatch)",
     "speclint-bad-disable":
         "a speclint disable comment lacks a reason or names an unknown rule",
 }
@@ -280,9 +284,9 @@ def _pass_table() -> dict:
     """Ordered name -> runner table (the CLI's --pass / --list-passes
     vocabulary).  Import is deferred so `from .core import Finding`
     inside the pass modules does not cycle."""
-    from . import (bypass, concurrency, determinism, factoryseam,
-                   foldgate, globals_, hostsync, nodeseam, seams,
-                   txnpurity)
+    from . import (bypass, concurrency, determinism, epochseam,
+                   factoryseam, foldgate, globals_, hostsync, nodeseam,
+                   seams, txnpurity)
     return {
         "seams": seams.run,
         "bypass": bypass.run,
@@ -296,6 +300,7 @@ def _pass_table() -> dict:
         "foldgate": foldgate.run,
         "factoryseam": factoryseam.run,
         "nodeseam": nodeseam.run,
+        "epochseam": epochseam.run,
     }
 
 

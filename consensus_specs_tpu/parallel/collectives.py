@@ -185,13 +185,16 @@ def sharded_flag_set(local_eff_incr, local_active_cur, local_eligible,
                      local_unsl, base_per_increment, leak,
                      weight: int, weight_denominator: int,
                      head_flag: bool):
-    """PRODUCTION altair flag pass (bit-exact to
-    epoch_fast.altair_delta_sets): distinct active/eligible/unslashed-
-    participating masks, the max(1, .) clamps, the leak and head-flag
-    switches.  The two global reductions ride the mesh as psums; the
-    reward/penalty lanes stay local.  `base_per_increment` and `leak`
-    are traced (they change every epoch — baking them would recompile
-    per epoch); weight/denominator/head_flag are per-flag constants."""
+    """Standalone altair flag pass (bit-exact to the per-flag lanes
+    inside ops.epoch_sweep's fused program): distinct active/eligible/
+    unslashed-participating masks, the max(1, .) clamps, the leak and
+    head-flag switches.  The two global reductions ride the mesh as
+    psums; the reward/penalty lanes stay local.  `base_per_increment`
+    and `leak` are traced (they change every epoch — baking them would
+    recompile per epoch); weight/denominator/head_flag are per-flag
+    constants.  Production epoch processing now runs the single fused
+    sweep instead; this pass remains the mesh-collective reference the
+    CPU-mesh suite pins against it."""
     eff64 = local_eff_incr.astype(jnp.int64)
     active_incr = jax.lax.psum(
         jnp.sum(jnp.where(local_active_cur, eff64, 0)), AXIS)
@@ -215,8 +218,9 @@ def sharded_flag_set(local_eff_incr, local_active_cur, local_eligible,
 
 def make_flag_set(mesh: Mesh, weight: int, weight_denominator: int,
                   head_flag: bool):
-    """Compiled production flag pass over a validator axis sharded on
-    `mesh` (used by epoch_fast when the mesh engine is enabled)."""
+    """Compiled flag pass over a validator axis sharded on `mesh`
+    (reference collective; production epoch flags ride the fused
+    ops.epoch_sweep dispatch)."""
     jfn = jax.jit(shard_map(
         partial(sharded_flag_set, weight=weight,
                 weight_denominator=weight_denominator,
@@ -247,8 +251,8 @@ def make_flag_deltas(mesh: Mesh, weight: int, weight_denominator: int,
 
 def sharded_slashings(local_eff_incr, local_mask, adjusted_total,
                       total_balance, increment, electra: bool):
-    """PRODUCTION slashing-penalty sweep (bit-exact to
-    epoch_fast.slashings_pass): the correlation penalty for every
+    """Standalone slashing-penalty sweep (bit-exact to the slashings
+    lane inside ops.epoch_sweep): the correlation penalty for every
     validator whose withdrawable epoch sits at the slashing-window
     midpoint.  Penalty lanes are local; the inputs that need global
     agreement (adjusted total, total balance) are traced scalars the
@@ -264,8 +268,9 @@ def sharded_slashings(local_eff_incr, local_mask, adjusted_total,
 
 
 def make_slashings(mesh: Mesh, electra: bool):
-    """Compiled slashing sweep over a validator axis sharded on
-    `mesh` (used by epoch_fast when the mesh engine is enabled)."""
+    """Compiled slashing sweep over a validator axis sharded on `mesh`
+    (reference collective; production slashings ride the fused
+    ops.epoch_sweep dispatch)."""
     jfn = jax.jit(shard_map(
         partial(sharded_slashings, electra=electra),
         mesh=mesh,

@@ -9,10 +9,13 @@ column):
   through `ssz.merkle.set_subtree_hasher` — each device sweeps its
   local subtree, per-device roots all_gather over ICI, the replicated
   top closes the tree.
-- Epoch processing: `epoch_fast.altair_delta_sets`' per-flag
-  reward/penalty passes run as validator-axis shard_map bodies whose
-  two global reductions (active and participating increments) are
-  psums (collectives.sharded_flag_set — bit-exact to the host pass).
+
+Epoch processing no longer hooks through here: the fused
+`ops.epoch_sweep` program shards its validator axis via
+`parallel/shard_verify.shard_jobs` against the SAME verify mesh, so a
+live mesh partitions the one-dispatch epoch sweep with no
+engine-specific monkey-patching (the old `flag_set_batch` /
+`slashings_batch` per-pass hooks are retired into that seam).
 
 Everything stays byte-identical to the host engine; the CPU-mesh suite
 (tests/test_mesh_engine.py) and the driver's dryrun_multichip both
@@ -23,7 +26,7 @@ from __future__ import annotations
 import numpy as np
 import jax
 
-from .collectives import make_flag_set, make_slashings, shard_array
+from .collectives import shard_array
 from jax.sharding import Mesh
 
 
@@ -34,8 +37,6 @@ class MeshEngine:
         self.mesh = mesh
         self.n_dev = int(np.prod(list(mesh.shape.values())))
         self._merkle_cache: dict = {}
-        self._flag_cache: dict = {}
-        self._slash_cache: dict = {}
         self._msm_fn = None
         self._prev_kzg_msm = None
         self._threshold = 1 << 14
@@ -61,62 +62,6 @@ class MeshEngine:
         words = bytes_to_words(level_bytes)
         root = fn(shard_array(self.mesh, words))
         return words_to_bytes(np.asarray(jax.device_get(root))[None])
-
-    # ------------------------------------------------------------------
-    # sharded epoch flag pass (epoch_fast hook)
-    # ------------------------------------------------------------------
-    def _pad_shard(self, arr):
-        n = len(arr)
-        pad = (-n) % self.n_dev
-        if pad:
-            arr = np.concatenate([arr, np.zeros(pad, arr.dtype)])
-        return shard_array(self.mesh, arr)
-
-    def flag_set_batch(self, eff_incr, active_cur, eligible, flags,
-                       base_per_incr: int, leak: bool):
-        """All per-flag altair reward/penalty passes for one epoch:
-        the invariant arrays (balances, active, eligible) pad + shard
-        ONCE; each flag adds only its participation mask.  `flags` is a
-        list of (weight, wd, unsl_mask, head_flag).  Padding lanes (eff
-        0, masks False) contribute nothing to the psums."""
-        n = len(eff_incr)
-        padded = n + (-n) % self.n_dev
-        eff_s = self._pad_shard(eff_incr.astype(np.int64))
-        act_s = self._pad_shard(active_cur)
-        elig_s = self._pad_shard(eligible)
-        out = []
-        for weight, wd, unsl, head_flag in flags:
-            key = (padded, weight, wd, head_flag)
-            fn = self._flag_cache.get(key)
-            if fn is None:
-                fn = make_flag_set(self.mesh, weight, wd, head_flag)
-                self._flag_cache[key] = fn
-            rewards, penalties = fn(
-                eff_s, act_s, elig_s, self._pad_shard(unsl),
-                base_per_incr, leak)
-            out.append(
-                (np.asarray(jax.device_get(rewards))[:n].astype(np.int64),
-                 np.asarray(jax.device_get(penalties))[:n]
-                 .astype(np.int64)))
-        return out
-
-    def slashings_batch(self, eff_incr, mask, adjusted_total: int,
-                        total_balance: int, increment: int,
-                        electra: bool):
-        """The slashing-penalty sweep as a compiled validator-axis
-        program (collectives.sharded_slashings — bit-exact to the host
-        lane in epoch_fast.slashings_pass)."""
-        n = len(eff_incr)
-        padded = n + (-n) % self.n_dev
-        key = (padded, electra)
-        fn = self._slash_cache.get(key)
-        if fn is None:
-            fn = make_slashings(self.mesh, electra)
-            self._slash_cache[key] = fn
-        pen = fn(self._pad_shard(eff_incr.astype(np.int64)),
-                 self._pad_shard(mask), adjusted_total, total_balance,
-                 increment)
-        return np.asarray(jax.device_get(pen))[:n].astype(np.int64)
 
     # ------------------------------------------------------------------
     # sharded MSM (kzg.g1_lincomb device-MSM hook)
@@ -156,11 +101,9 @@ class MeshEngine:
                msm_threshold: int = 128) -> None:
         from ..crypto import kzg as kzg_mod
         from ..ssz import merkle as ssz_merkle
-        from ..specs import epoch_fast
         if merkle_threshold is not None:
             self._threshold = merkle_threshold
         ssz_merkle.set_subtree_hasher(self.subtree_root, self._threshold)
-        epoch_fast.MESH_ENGINE = self
         # don't snapshot our own hook on re-enable — disable() would
         # then "restore" it and leave the engine live after teardown
         if getattr(kzg_mod._device_msm, "__self__", None) is not self:
@@ -171,7 +114,6 @@ class MeshEngine:
     def disable(self) -> None:
         from ..crypto import kzg as kzg_mod
         from ..ssz import merkle as ssz_merkle
-        from ..specs import epoch_fast
         # only uninstall our own hooks — a later-enabled engine owns
         # the globals now and must not be silently reverted.  NB: bound
         # methods are re-created per attribute access, so identity must
@@ -179,8 +121,6 @@ class MeshEngine:
         installed = getattr(ssz_merkle._subtree_hasher, "__self__", None)
         if installed is self:
             ssz_merkle.set_subtree_hasher(None)
-        if epoch_fast.MESH_ENGINE is self:
-            epoch_fast.MESH_ENGINE = None
         if getattr(kzg_mod._device_msm, "__self__", None) is self:
             prev_fn, prev_thr = self._prev_kzg_msm or (None, 128)
             kzg_mod.set_device_msm(prev_fn, prev_thr)
@@ -198,7 +138,8 @@ def enable_single_device(merkle_threshold: int = 1 << 14,
     """The SAME compiled programs the multi-chip mesh runs, on a
     1-device mesh over the default accelerator: psums collapse to
     no-ops, everything else is identical XLA.  This is the single-chip
-    production path — 'TPU-native epoch processing' on one chip, not
-    only on the mesh (bench.py's epoch tier enables it)."""
+    production path for the merkle/MSM hooks; epoch processing no
+    longer needs it — the fused ops.epoch_sweep program is device-run
+    (and mesh-sharded) by default."""
     mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
     return enable(mesh, merkle_threshold, msm_threshold=msm_threshold)

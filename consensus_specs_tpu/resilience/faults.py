@@ -112,14 +112,20 @@ def _is_bool(v) -> bool:
 # the canonical site registry (corrupt="digest" entries).
 _DIGEST_GUARDED_SITES = sites.digest_guarded_sites()
 
+# sites whose dispatch result is a tuple of numpy lane arrays guarded by
+# a differential oracle (corrupt="lanes" entries): corruption damages
+# one element of one array — the silent-lane fault only the sampled
+# guard comparison can catch.
+_LANES_GUARDED_SITES = sites.lanes_guarded_sites()
+
 
 def _flip_verdict(result, rng: random.Random, site: str | None = None):
     """Corrupt a verdict-shaped result: flip a bool, one element of a
-    list of bools, or — at digest-guarded sites only — one bit of a
-    bytes root (the silent corruption only the differential guard can
-    catch).  Other payloads pass through unchanged (a corrupted point
-    batch surfaces as a False product, which the `raise` path already
-    covers)."""
+    list of bools, at digest-guarded sites one bit of a bytes root, or
+    at lanes-guarded sites one element of one numpy lane array (the
+    silent corruption only the differential guard can catch).  Other
+    payloads pass through unchanged (a corrupted point batch surfaces
+    as a False product, which the `raise` path already covers)."""
     if _is_bool(result):
         return not bool(result)
     if isinstance(result, list) and result and all(
@@ -134,6 +140,19 @@ def _flip_verdict(result, rng: random.Random, site: str | None = None):
         j = rng.randrange(len(out))
         out[j] ^= 1 << rng.randrange(8)
         return bytes(out)
+    if (site in _LANES_GUARDED_SITES and isinstance(result, tuple)
+            and result and all(hasattr(a, "dtype") for a in result)):
+        lanes = [a.copy() for a in result]
+        k = rng.randrange(len(lanes))
+        arr = lanes[k]
+        if arr.size:
+            j = rng.randrange(arr.size)
+            flat = arr.reshape(-1)
+            if flat.dtype.kind == "b":
+                flat[j] = not bool(flat[j])
+            else:
+                flat[j] = flat[j] ^ 1
+        return tuple(lanes)
     return result
 
 

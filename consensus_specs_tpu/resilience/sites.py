@@ -50,7 +50,7 @@ UNIT = "unit"       # unreachable from a CPU chaos replay; unit-tier covered
 
 _KINDS = (DISPATCH, BARRIER)
 _TIERS = (REPLAY, GOSSIP, KILL, UNIT)
-_CORRUPT = ("verdict", "digest", "none")
+_CORRUPT = ("verdict", "digest", "lanes", "none")
 
 
 @dataclass(frozen=True)
@@ -65,8 +65,10 @@ class Site:
     chaos    — which chaos tier exercises it (REPLAY/GOSSIP/KILL/UNIT).
     corrupt  — what the fault injector's "corrupt" kind may flip:
                "verdict" (bool/bool-list), "digest" (one bit of a bytes
-               root — only sites a differential oracle guards), "none"
-               (barriers: a crash point has no value).
+               root — only sites a differential oracle guards), "lanes"
+               (one element of one numpy lane array in a tuple result —
+               again only oracle-guarded sites), "none" (barriers: a
+               crash point has no value).
     fused    — verdicts flow through the fused signature pipeline; the
                differential guard quarantines all fused sites as a unit.
     sharded  — the device path may run mesh-partitioned over >1 chip
@@ -112,6 +114,17 @@ REGISTRY: tuple[Site, ...] = (
          kind=DISPATCH, chaos=REPLAY, fused=True, sharded=True),
     Site("ssz.merkle_sweep", "consensus_specs_tpu.ssz.incremental",
          kind=DISPATCH, chaos=REPLAY, corrupt="digest"),
+    # the fused epoch sweep (ops/epoch_sweep.py behind specs/
+    # epoch_fast.fused_epoch): ONE dispatch per process_epoch carrying
+    # every hot per-validator pass; numpy twin as the counted
+    # byte-identical fallback, sampled lane guard quarantines on
+    # mismatch.  REPLAY tier — any replay crossing an epoch boundary
+    # dispatches here (the block-level replay workload does not, so the
+    # shard matrix and fault kinds run in the dedicated epoch-boundary
+    # chaos matrix; see tests/test_chaos.py).  sharded: the validator
+    # axis partitions over the verify mesh via shard_jobs.
+    Site("ops.epoch_sweep", "consensus_specs_tpu.specs.epoch_fast",
+         kind=DISPATCH, chaos=REPLAY, corrupt="lanes", sharded=True),
     # -- gossip tier extra: the admission pipeline's batch window
     Site("gossip.batch_verify", "consensus_specs_tpu.gossip.batcher",
          kind=DISPATCH, chaos=GOSSIP),
@@ -320,6 +333,13 @@ def digest_guarded_sites() -> frozenset[str]:
     return frozenset(s.name for s in REGISTRY if s.corrupt == "digest")
 
 
+def lanes_guarded_sites() -> frozenset[str]:
+    """faults.py _LANES_GUARDED_SITES: tuple-of-numpy-lane results the
+    corrupt fault kind may damage by one element (a differential oracle
+    guards them)."""
+    return frozenset(s.name for s in REGISTRY if s.corrupt == "lanes")
+
+
 def sharded_sites() -> tuple[str, ...]:
     """Seams whose device path may run mesh-partitioned
     (parallel/shard_verify.py): the shard_dead fault kind models a dead
@@ -356,11 +376,13 @@ HOST_SYNC_BARRIERS: tuple = (
     # per shard, then ONE np.asarray of the final Fp12-is-one verdict
     ("consensus_specs_tpu.parallel.shard_verify", "pairing_fold"),
     # mesh-engine result downloads: each is the single forced read at
-    # the end of one fused epoch-processing dispatch
+    # the end of one fused device dispatch (the per-pass epoch rows —
+    # flag_set_batch / slashings_batch — retired into ops.epoch_sweep)
     ("consensus_specs_tpu.parallel.mesh_engine", "subtree_root"),
-    ("consensus_specs_tpu.parallel.mesh_engine", "flag_set_batch"),
-    ("consensus_specs_tpu.parallel.mesh_engine", "slashings_batch"),
     ("consensus_specs_tpu.parallel.mesh_engine", "g1_msm"),
+    # the fused epoch sweep's single download: ONE jax.device_get of
+    # every output lane per process_epoch
+    ("consensus_specs_tpu.ops.epoch_sweep", "run_sweep"),
 )
 
 

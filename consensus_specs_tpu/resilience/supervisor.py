@@ -140,6 +140,11 @@ class Supervisor:
         self._lock = named_rlock("resilience.supervisor")
         self._forced_scalar = False
 
+    @property
+    def forced_scalar(self) -> bool:
+        """True while the force_scalar() kill switch is held on."""
+        return self._forced_scalar
+
     # -- administrative controls --------------------------------------
     def force_scalar(self, on: bool = True) -> None:
         """Administratively disable the accelerator path (every dispatch

@@ -76,6 +76,20 @@ fused sweep actually uploaded) and `merkle_sibling_uploads_skipped`
 (clean-sibling level buffers found already device-resident in the
 literal pool — the re-uploads the pool exists to skip).
 
+The fused epoch sweep (specs/epoch_fast.py) pins its one-dispatch
+contract here: `epoch_sweep_dispatches` counts `ops.epoch_sweep`
+seam dispatches — exactly one per `process_epoch` when the device
+path is live, which the fork-matrix tests and `make epoch-bench`
+assert — while the labeled `epoch_sweep_fallbacks` counter says why
+any epoch instead ran the counted numpy twin (`unsupervised`,
+`disabled`, `quarantined`, `breaker_open`, `dispatch_failed`).
+`epoch_writeback_elems` totals the leaf elements the batched
+`bulk_set_basic` writeback pushed into tracked SSZ views (O(1)
+Python-level calls per epoch regardless of validator count), and
+`epoch_guard_samples` / `epoch_guard_mismatches` record the sampled
+lane-level differential guard that quarantines a corrupting device
+program before its outputs reach the state.
+
 Histograms (`observe_hist`) bucket integer observations by
 power-of-two: the gossip admission layer records batch occupancy per
 flush here (`batch_occupancy`: how many signature sets each dispatch

@@ -1,6 +1,8 @@
 """get_head fork-choice tests: chains, ties, and attestation weight
 (reference test/phase0/fork_choice/test_get_head.py shape; vector format
 tests/formats/fork_choice)."""
+import pytest
+
 from ...ssz import hash_tree_root
 from ...test_infra.context import (
     spec_state_test, with_all_phases, never_bls)
@@ -337,6 +339,7 @@ def test_voting_source_within_two_epoch(spec, state):
     yield from emit_steps(steps)
 
 
+@pytest.mark.slow  # ~12 s three-epoch sim; the within-window half (above) keeps the quick voting-source signal
 @with_all_phases_from("altair")
 @with_pytest_fork_subset(VS_FORKS)
 @with_presets(["minimal"], reason="too slow")

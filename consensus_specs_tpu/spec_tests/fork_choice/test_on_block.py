@@ -3,6 +3,8 @@
 boost, checkpoint bookkeeping, justification withholding.
 
 Reference battery: test/phase0/fork_choice/test_on_block.py."""
+import pytest
+
 from ...ssz import Bytes32, hash_tree_root, uint64
 from ...test_infra.context import (
     spec_state_test, with_all_phases, with_pytest_fork_subset, never_bls)
@@ -308,6 +310,7 @@ from ...test_infra.fork_choice import (  # noqa: E402
     fill_epochs_with_attestations as _fill_epochs)
 
 
+@pytest.mark.slow  # ~7 s multi-epoch sim; pull_up_on_tick + not_pull_up_current_epoch_block keep the quick pull-up signal
 @with_all_phases_from("altair")
 @_subset(PULL_UP_FORKS)
 @with_presets(["minimal"], reason="too slow")
@@ -475,6 +478,7 @@ def test_justification_update_beginning_of_epoch(spec, state):
                                          at_epoch_end=False)
 
 
+@pytest.mark.slow  # ~8 s multi-epoch sim; the beginning-of-epoch half (above) keeps the quick justification-update signal
 @with_all_phases_from("altair")
 @_subset(PULL_UP_FORKS)
 @with_presets(["minimal"], reason="too slow")
@@ -484,6 +488,7 @@ def test_justification_update_end_of_epoch(spec, state):
     yield from _run_justification_update(spec, state, at_epoch_end=True)
 
 
+@pytest.mark.slow  # ~7 s multi-epoch sim; plain test_justification_withholding keeps the quick withholding signal
 @with_all_phases_from("altair")
 @_subset(PULL_UP_FORKS)
 @with_presets(["minimal"], reason="too slow")

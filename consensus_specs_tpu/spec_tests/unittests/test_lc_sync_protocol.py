@@ -98,6 +98,7 @@ def test_process_light_client_update_not_timeout(spec, state):
     assert int(store.current_max_active_participants) > 0
 
 
+@pytest.mark.slow  # ~6 s UPDATE_TIMEOUT walk under always_bls; not_timeout + timeout keep the quick signal on both period branches
 @with_all_phases_from("altair")
 @with_pytest_fork_subset(LC_FORKS)
 @with_presets(["minimal"], reason="too slow")

@@ -330,6 +330,19 @@ class AltairSpec(LightClientMixin, Phase0Spec):
     # epoch processing (altair ordering)
     # ------------------------------------------------------------------
     def process_epoch(self, state) -> None:
+        from . import epoch_fast
+        if epoch_fast.fused_epoch(self, state):
+            # the fused ONE-dispatch sweep handled justification through
+            # the effective-balance update; only the cheap tail resets
+            # remain (eth1_data_reset commutes past the sweep: it clears
+            # vote bookkeeping no fused pass reads or writes)
+            self.process_eth1_data_reset(state)
+            self.process_slashings_reset(state)
+            self.process_randao_mixes_reset(state)
+            self.process_historical_roots_update(state)
+            self.process_participation_flag_updates(state)
+            self.process_sync_committee_updates(state)
+            return
         self.process_justification_and_finalization(state)
         self.process_inactivity_updates(state)
         self.process_rewards_and_penalties(state)
@@ -345,14 +358,6 @@ class AltairSpec(LightClientMixin, Phase0Spec):
 
     def process_justification_and_finalization(self, state) -> None:
         if self.get_current_epoch(state) <= self.GENESIS_EPOCH + 1:
-            return
-        from . import epoch_fast
-        if epoch_fast.ENABLED:
-            arr = epoch_fast.StateArrays(state)
-            total, prev_bal, cur_bal = epoch_fast.altair_target_balances(
-                self, state, arr)
-            self.weigh_justification_and_finalization(
-                state, uint64(total), uint64(prev_bal), uint64(cur_bal))
             return
         previous_indices = self.get_unslashed_participating_indices(
             state, self.TIMELY_TARGET_FLAG_INDEX,
@@ -372,10 +377,6 @@ class AltairSpec(LightClientMixin, Phase0Spec):
     def process_inactivity_updates(self, state) -> None:
         # no inactivity accounting in the genesis epoch
         if self.get_current_epoch(state) == self.GENESIS_EPOCH:
-            return
-        from . import epoch_fast
-        if epoch_fast.ENABLED:
-            epoch_fast.altair_inactivity_updates(self, state)
             return
         previous_target_indices = self.get_unslashed_participating_indices(
             state, self.TIMELY_TARGET_FLAG_INDEX,
@@ -397,11 +398,6 @@ class AltairSpec(LightClientMixin, Phase0Spec):
 
     def process_rewards_and_penalties(self, state) -> None:
         if self.get_current_epoch(state) == self.GENESIS_EPOCH:
-            return
-        from . import epoch_fast
-        if epoch_fast.ENABLED:
-            arr, sets = epoch_fast.altair_delta_sets(self, state)
-            epoch_fast.apply_delta_sets(state, arr, sets)
             return
         flag_deltas = [
             self.get_flag_index_deltas(state, flag_index)

@@ -278,6 +278,15 @@ class CapellaSpec(BellatrixSpec):
     # epoch processing: historical summaries replace historical roots
     # ------------------------------------------------------------------
     def process_epoch(self, state) -> None:
+        from . import epoch_fast
+        if epoch_fast.fused_epoch(self, state):
+            self.process_eth1_data_reset(state)
+            self.process_slashings_reset(state)
+            self.process_randao_mixes_reset(state)
+            self.process_historical_summaries_update(state)
+            self.process_participation_flag_updates(state)
+            self.process_sync_committee_updates(state)
+            return
         self.process_justification_and_finalization(state)
         self.process_inactivity_updates(state)
         self.process_rewards_and_penalties(state)

@@ -450,6 +450,17 @@ class ElectraSpec(DenebSpec):
     # epoch processing (electra/beacon-chain.md:793-1003)
     # ------------------------------------------------------------------
     def process_epoch(self, state) -> None:
+        from . import epoch_fast
+        if epoch_fast.fused_epoch(self, state):
+            # fused_epoch ran the scalar registry + pending-deposit /
+            # consolidation queues at their reference positions itself
+            self.process_eth1_data_reset(state)
+            self.process_slashings_reset(state)
+            self.process_randao_mixes_reset(state)
+            self.process_historical_summaries_update(state)
+            self.process_participation_flag_updates(state)
+            self.process_sync_committee_updates(state)
+            return
         self.process_justification_and_finalization(state)
         self.process_inactivity_updates(state)
         self.process_rewards_and_penalties(state)
@@ -484,10 +495,6 @@ class ElectraSpec(DenebSpec):
     def process_slashings(self, state) -> None:
         """Increment-factored correlation penalty
         (electra/beacon-chain.md:846)."""
-        from . import epoch_fast
-        if epoch_fast.ENABLED:
-            epoch_fast.slashings_pass(self, state)
-            return
         epoch = self.get_current_epoch(state)
         total_balance = self.get_total_active_balance(state)
         adjusted_total_slashing_balance = min(

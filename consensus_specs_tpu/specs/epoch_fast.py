@@ -1,48 +1,81 @@
-"""Vectorized (validator-axis) epoch processing.
+"""Fused epoch processing behind the `ops.epoch_sweep` dispatch seam.
 
 The reference's epoch passes are per-validator Python loops over O(n)
-validators with O(n) helpers inside (e.g. `get_base_reward` recomputing the
-total active balance), which is quadratic at mainnet scale
-(reference: specs/phase0/beacon-chain.md:1553-1589, altair:385-421).  This
-engine re-designs each hot pass as numpy array sweeps over a
-structure-of-arrays extraction of the validator registry: masks instead of
-per-index `if`, scatter-adds instead of dict accumulation, one pass per
-delta family.  Write-back touches only changed elements, so the SSZ views
-stay the source of truth and results are bit-identical to the scalar spec
-methods (differential tests: tests/test_epoch_fast.py).
+validators with O(n) helpers inside (e.g. `get_base_reward` recomputing
+the total active balance), which is quadratic at mainnet scale
+(reference: specs/phase0/beacon-chain.md:1553-1589, altair:385-421).
+This engine extracts a structure-of-arrays snapshot of the validator
+registry ONCE per epoch (`StateArrays`), precomputes the
+committee-dependent masks and global scalars on host, and hands every
+hot pass — attestation / participation-flag delta sets, inactivity
+scores, slashings, effective-balance hysteresis, registry-eligibility
+masks — to ONE registered device dispatch::
 
-The engine is enabled by default (ENABLED); `scalar_epoch()` restores the
-reference-shaped scalar path for differential testing.  The heavy pure
-reductions here are numpy on host — the device-bound work of an epoch
-(hash_tree_root merkleization, BLS verification, shuffling) flows through
-the JAX kernels in ops/.
+    resilience.dispatch("ops.epoch_sweep", device_fn, numpy_fallback)
+
+`ops/epoch_sweep.py` holds the fused jitted program (the only module
+allowed to import it is this one — speclint `epoch-scalar-bypass`);
+`numpy_sweep` here is the counted, byte-identical fallback AND the
+differential-guard oracle.  Writeback is batched through
+`ssz.incremental.bulk_set_basic` — one Python-level call per mutated
+column (balances, inactivity scores), marking the dirty merkle cone in
+one pass — so a mainnet everyone's-balance-changed epoch no longer pays
+1M `__setitem__` round trips and the re-root stays the O(dirty) fused
+device sweep.  The rare per-validator mutations (registry churn,
+effective-balance hysteresis hits) stay scalar spec calls.
+
+Escape hatches: `scalar_epoch()` restores the reference-shaped scalar
+pass list (differential testing, the bench scalar leg);
+`supervisor.force_scalar()` keeps the fused shape but pins the numpy
+fallback (counted, reason `disabled`).  `set_guard(rate, seed)` arms
+sampled lane-for-lane comparison of device output against the numpy
+oracle — a mismatch quarantines the site and returns the oracle lanes.
+
+Public surface (everything else is engine-internal — speclint
+`epoch-scalar-bypass` flags outside access): ENABLED, SWEEP_SITE,
+scalar_epoch, fused_epoch, set_guard.
 """
 from __future__ import annotations
 
 import contextlib
+import random
 from math import isqrt
 
 import numpy as np
 
 ENABLED = True
 
-# installed by parallel/mesh_engine.enable(): routes the per-flag
-# reward/penalty passes through validator-axis shard_map collectives
-MESH_ENGINE = None
+SWEEP_SITE = "ops.epoch_sweep"
 
 _I64MAX = np.iinfo(np.int64).max
 _ORDER_BITS = 24          # attestations per epoch < 2**24; delay keys above
 
+_GUARD_RATE = 0.0
+_GUARD_RNG = random.Random(0)
+
 
 @contextlib.contextmanager
 def scalar_epoch():
-    """Temporarily disable the vectorized engine (differential testing)."""
+    """Temporarily disable the fused engine (differential testing)."""
     global ENABLED
     prev, ENABLED = ENABLED, False
     try:
         yield
     finally:
         ENABLED = prev
+
+
+def set_guard(rate: float, seed: int = 0) -> None:
+    """Differential-guard sampling probability for the fused sweep
+    (production: low single-digit percent; the chaos tier runs 1.0).
+    A sampled epoch recomputes every lane through `numpy_sweep` and
+    compares; a mismatch quarantines `ops.epoch_sweep` and the oracle
+    lanes are the ones written back."""
+    global _GUARD_RATE, _GUARD_RNG
+    if not 0.0 <= rate <= 1.0:
+        raise ValueError(f"guard rate {rate} outside [0, 1]")
+    _GUARD_RATE = rate
+    _GUARD_RNG = random.Random(seed)
 
 
 # ---------------------------------------------------------------------------
@@ -82,11 +115,6 @@ class StateArrays:
 
     def total_active_balance(self, epoch, increment) -> int:
         return max(int(increment), int(self.eff[self.active(epoch)].sum()))
-
-
-def _write_balances(state, old: np.ndarray, new: np.ndarray) -> None:
-    for i in np.nonzero(new != old)[0]:
-        state.balances[int(i)] = int(new[i])
 
 
 # ---------------------------------------------------------------------------
@@ -133,294 +161,449 @@ def phase0_attestation_masks(spec, state, epoch, targets_only=False):
     return src, tgt, head, best_key, best_prop
 
 
-def phase0_target_balances(spec, state, arr: StateArrays):
-    """(total_active, prev_target, cur_target) attesting balances for
-    justification (beacon-chain.md:1360-1386)."""
-    incr = int(spec.EFFECTIVE_BALANCE_INCREMENT)
-    cur = int(spec.get_current_epoch(state))
-    prev = int(spec.get_previous_epoch(state))
-    total = arr.total_active_balance(cur, incr)
-    out = []
-    for epoch in (prev, cur):
-        _, tgt, _, _, _ = phase0_attestation_masks(
-            spec, state, epoch, targets_only=True)
-        m = tgt & ~arr.slashed
-        out.append(max(incr, int(arr.eff[m].sum())))
-    return total, out[0], out[1]
-
-
-def phase0_attestation_deltas(spec, state):
-    """Vectorized get_attestation_deltas (beacon-chain.md:1553-1589):
-    source/target/head components, inclusion-delay rewards with proposer
-    scatter, inactivity-leak penalties."""
-    arr = StateArrays(state)
-    n = arr.n
-    incr = int(spec.EFFECTIVE_BALANCE_INCREMENT)
-    cur = int(spec.get_current_epoch(state))
-    prev = int(spec.get_previous_epoch(state))
-    tb = arr.total_active_balance(cur, incr)
-    base = (arr.eff * int(spec.BASE_REWARD_FACTOR) // isqrt(tb)
-            // int(spec.BASE_REWARDS_PER_EPOCH))
-    prop_reward = base // int(spec.PROPOSER_REWARD_QUOTIENT)
-    eligible = arr.eligible(prev)
-    leak = bool(spec.is_in_inactivity_leak(state))
-    finality_delay = int(spec.get_finality_delay(state))
-
-    src, tgt, head, best_key, best_prop = phase0_attestation_masks(
-        spec, state, prev)
-
-    rewards = np.zeros(n, np.int64)
-    penalties = np.zeros(n, np.int64)
-
-    # source/target/head components
-    for mask in (src, tgt, head):
-        unsl = mask & ~arr.slashed
-        att_bal = max(incr, int(arr.eff[unsl].sum()))
-        if leak:
-            comp = base
-        else:
-            comp = base * (att_bal // incr) // (tb // incr)
-        rewards += np.where(eligible & unsl, comp, 0)
-        penalties += np.where(eligible & ~unsl, base, 0)
-
-    # inclusion-delay rewards (no eligibility filter, matches scalar)
-    unsl_src = np.nonzero(src & ~arr.slashed)[0]
-    if unsl_src.size:
-        delays = best_key[unsl_src] >> _ORDER_BITS
-        max_att = base[unsl_src] - prop_reward[unsl_src]
-        np.add.at(rewards, unsl_src, max_att // delays)
-        np.add.at(rewards, best_prop[unsl_src], prop_reward[unsl_src])
-
-    # inactivity leak penalties
-    if leak:
-        unsl_tgt = tgt & ~arr.slashed
-        pen = int(spec.BASE_REWARDS_PER_EPOCH) * base - prop_reward
-        penalties += np.where(eligible, pen, 0)
-        extra = (arr.eff * finality_delay
-                 // int(spec.INACTIVITY_PENALTY_QUOTIENT))
-        penalties += np.where(eligible & ~unsl_tgt, extra, 0)
-
-    return arr, rewards, penalties
-
-
-# ---------------------------------------------------------------------------
-# altair-family: flag-based deltas
-# ---------------------------------------------------------------------------
-
 def _participation(state, which: str, n: int) -> np.ndarray:
     col = (state.previous_epoch_participation if which == "previous"
            else state.current_epoch_participation)
     return np.fromiter((int(x) for x in col), np.int64, n)
 
 
-def altair_unslashed_participating(spec, state, arr, flag_index, epoch):
-    which = ("current"
-             if int(epoch) == int(spec.get_current_epoch(state))
-             else "previous")
-    part = _participation(state, which, arr.n)
-    return (arr.active(epoch) & (((part >> int(flag_index)) & 1) == 1)
-            & ~arr.slashed)
+# ---------------------------------------------------------------------------
+# sweep inputs: everything the fused program needs, host-extracted once
+# ---------------------------------------------------------------------------
+
+class SweepInputs:
+    """Immutable-by-convention bundle crossing the dispatch seam.
+
+    `family` is "phase0" or "altair"; `cols` maps the family's column
+    names (ops.epoch_sweep.{PHASE0,ALTAIR}_COLS) to length-n numpy
+    arrays; `scalars` maps the family's scalar names to numpy 0-d
+    values; `statics` is a sorted tuple of (name, value) pairs baked
+    into the compiled program (the compile-cache key)."""
+
+    __slots__ = ("family", "n", "cols", "scalars", "statics")
+
+    def __init__(self, family, n, cols, scalars, statics):
+        self.family = family
+        self.n = n
+        self.cols = cols
+        self.scalars = scalars
+        self.statics = statics
 
 
-def altair_target_balances(spec, state, arr: StateArrays):
-    incr = int(spec.EFFECTIVE_BALANCE_INCREMENT)
-    cur = int(spec.get_current_epoch(state))
-    prev = int(spec.get_previous_epoch(state))
-    flag = int(spec.TIMELY_TARGET_FLAG_INDEX)
-    total = arr.total_active_balance(cur, incr)
-    prev_m = altair_unslashed_participating(spec, state, arr, flag, prev)
-    cur_m = altair_unslashed_participating(spec, state, arr, flag, cur)
-    return (total,
-            max(incr, int(arr.eff[prev_m].sum())),
-            max(incr, int(arr.eff[cur_m].sum())))
-
-
-def altair_delta_sets(spec, state):
-    """Vectorized flag deltas + inactivity deltas (altair
-    beacon-chain.md:385-421), as an ordered list of (rewards, penalties) —
-    the scalar path applies each set sequentially with zero-flooring, so
-    the order is part of the semantics."""
-    arr = StateArrays(state)
+def _collect(spec, state, arr, part_prev, masks_prev, do_rewards, leak,
+             tb, cur, prev):
+    """Build SweepInputs from the post-justification state.  Every value
+    here is read ONCE; the sweep (device or numpy) is a pure function of
+    this bundle."""
     n = arr.n
     incr = int(spec.EFFECTIVE_BALANCE_INCREMENT)
-    cur = int(spec.get_current_epoch(state))
-    prev = int(spec.get_previous_epoch(state))
-    tb = arr.total_active_balance(cur, incr)
-    base_per_incr = (incr * int(spec.BASE_REWARD_FACTOR) // isqrt(tb))
-    base = (arr.eff // incr) * base_per_incr
-    eligible = arr.eligible(prev)
-    leak = bool(spec.is_in_inactivity_leak(state))
-    active_increments = tb // incr
-    wd = int(spec.WEIGHT_DENOMINATOR)
-
-    flag_specs = []
-    for flag_index, weight in enumerate(spec.PARTICIPATION_FLAG_WEIGHTS):
-        flag_specs.append((
-            int(weight),
-            altair_unslashed_participating(
-                spec, state, arr, flag_index, prev),
-            flag_index == int(spec.TIMELY_HEAD_FLAG_INDEX)))
-
-    if MESH_ENGINE is not None:
-        # the production mesh path: psum reductions over ICI, bit-exact
-        # to the host lanes below; invariant arrays shard once
-        sets = MESH_ENGINE.flag_set_batch(
-            arr.eff // incr, arr.active(cur), eligible,
-            [(w, wd, unsl, head) for w, unsl, head in flag_specs],
-            base_per_incr, leak)
-    else:
-        sets = []
-        for w, unsl, head_flag in flag_specs:
-            part_incr = int(arr.eff[unsl].sum())
-            part_incr = max(incr, part_incr) // incr
-            rewards = np.zeros(n, np.int64)
-            penalties = np.zeros(n, np.int64)
-            if not leak:
-                num = base * w * part_incr
-                rewards = np.where(eligible & unsl,
-                                   num // (active_increments * wd), 0)
-            if not head_flag:
-                penalties = np.where(eligible & ~unsl, base * w // wd, 0)
-            sets.append((rewards, penalties))
-
-    # inactivity penalties
-    scores = np.fromiter(
-        (int(s) for s in state.inactivity_scores), np.int64, n)
-    tgt_unsl = altair_unslashed_participating(
-        spec, state, arr, int(spec.TIMELY_TARGET_FLAG_INDEX), prev)
-    denom = (int(spec.config.INACTIVITY_SCORE_BIAS)
-             * int(spec.inactivity_penalty_quotient()))
-    pen = arr.eff * scores // denom
-    penalties = np.where(eligible & ~tgt_unsl, pen, 0)
-    sets.append((np.zeros(n, np.int64), penalties))
-    return arr, sets
-
-
-def altair_inactivity_updates(spec, state) -> None:
-    """Vectorized process_inactivity_updates (altair beacon-chain.md:602)."""
-    arr = StateArrays(state)
-    prev = int(spec.get_previous_epoch(state))
-    eligible = arr.eligible(prev)
-    tgt_unsl = altair_unslashed_participating(
-        spec, state, arr, int(spec.TIMELY_TARGET_FLAG_INDEX), prev)
-    scores = np.fromiter(
-        (int(s) for s in state.inactivity_scores), np.int64, arr.n)
-    new = scores.copy()
-    bias = int(spec.config.INACTIVITY_SCORE_BIAS)
-    new = np.where(eligible & tgt_unsl, new - np.minimum(1, new), new)
-    new = np.where(eligible & ~tgt_unsl, new + bias, new)
-    if not bool(spec.is_in_inactivity_leak(state)):
-        rec = int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE)
-        new = np.where(eligible, new - np.minimum(rec, new), new)
-    for i in np.nonzero(new != scores)[0]:
-        state.inactivity_scores[int(i)] = int(new[i])
-
-
-# ---------------------------------------------------------------------------
-# balance application & remaining passes
-# ---------------------------------------------------------------------------
-
-def apply_delta_sets(state, arr: StateArrays, sets) -> None:
-    """Apply (rewards, penalties) sets sequentially with the spec's
-    zero-floor decrease semantics."""
-    bal = arr.balances
-    new = bal.copy()
-    for rewards, penalties in sets:
-        new = np.maximum(new + rewards - penalties, 0)
-    _write_balances(state, bal, new)
-    arr.balances = new
-
-
-def slashings_pass(spec, state) -> bool:
-    """Vectorized process_slashings; handles both the phase0/altair form
-    (beacon-chain.md:1640) and electra's increment-factored penalty
-    (electra beacon-chain.md:846).  Returns False if the spec overrides
-    process_slashings with something unknown."""
-    arr = StateArrays(state)
-    incr = int(spec.EFFECTIVE_BALANCE_INCREMENT)
-    epoch = int(spec.get_current_epoch(state))
-    tb = arr.total_active_balance(epoch, incr)
+    altair_family = bool(spec.is_post("altair"))
+    electra = bool(spec.is_post("electra"))
+    finalized = int(state.finalized_checkpoint.epoch)
     adj = min(sum(int(x) for x in state.slashings)
               * int(spec.proportional_slashing_multiplier()), tb)
-    mask = arr.slashed & (
-        np.uint64(epoch + int(spec.EPOCHS_PER_SLASHINGS_VECTOR) // 2)
-        == arr.withdrawable)
-    electra = bool(spec.is_post("electra"))
-    if adj == 0 or not mask.any():
-        # nothing slashable this epoch: skip the sweep entirely (the
-        # device dispatch would provably return all zeros)
-        masked_pen = np.zeros(arr.n, np.int64)
-    elif MESH_ENGINE is not None:
-        # the compiled validator-axis sweep (single-chip or mesh —
-        # same program, psums collapse at n_dev=1)
-        masked_pen = MESH_ENGINE.slashings_batch(
-            arr.eff // incr, mask, adj, tb, incr, electra)
-    elif electra:
-        per_incr = adj // (tb // incr)
-        masked_pen = np.where(mask, (arr.eff // incr) * per_incr, 0)
-    else:
-        masked_pen = np.where(mask,
-                              (arr.eff // incr) * adj // tb * incr, 0)
-    new = np.maximum(arr.balances - masked_pen, 0)
-    _write_balances(state, arr.balances, new)
-    return True
-
-
-def effective_balance_updates_pass(spec, state) -> None:
-    """Vectorized process_effective_balance_updates
-    (beacon-chain.md:1656; electra compounding max via credential
-    prefix)."""
-    arr = StateArrays(state)
-    incr = int(spec.EFFECTIVE_BALANCE_INCREMENT)
-    h = incr // int(spec.HYSTERESIS_QUOTIENT)
-    down = h * int(spec.HYSTERESIS_DOWNWARD_MULTIPLIER)
-    up = h * int(spec.HYSTERESIS_UPWARD_MULTIPLIER)
-    if spec.is_post("electra"):
+    slash_epoch = cur + int(spec.EPOCHS_PER_SLASHINGS_VECTOR) // 2
+    if electra:
         prefix = np.fromiter(
             (v.withdrawal_credentials[0] for v in state.validators),
-            np.uint8, arr.n)
+            np.uint8, n)
         comp = prefix == int.from_bytes(
             bytes(spec.COMPOUNDING_WITHDRAWAL_PREFIX), "big")
         max_eff = np.where(comp, int(spec.MAX_EFFECTIVE_BALANCE_ELECTRA),
-                           int(spec.MIN_ACTIVATION_BALANCE))
+                           int(spec.MIN_ACTIVATION_BALANCE)).astype(np.int64)
     else:
-        max_eff = np.full(arr.n, int(spec.MAX_EFFECTIVE_BALANCE), np.int64)
-    cond = ((arr.balances + down < arr.eff)
-            | (arr.eff + up < arr.balances))
-    new_eff = np.minimum(arr.balances - arr.balances % incr, max_eff)
-    for i in np.nonzero(cond & (new_eff != arr.eff))[0]:
-        state.validators[int(i)].effective_balance = int(new_eff[i])
+        max_eff = np.full(n, int(spec.MAX_EFFECTIVE_BALANCE), np.int64)
+    cols = {
+        "eff": arr.eff, "slashed": arr.slashed,
+        "activation": arr.activation, "exit_epoch": arr.exit,
+        "act_elig": arr.activation_eligibility,
+        "withdrawable": arr.withdrawable,
+        "balances": arr.balances, "max_eff": max_eff,
+    }
+    scalars = {
+        "cur": np.uint64(cur), "prev": np.uint64(prev),
+        "finalized": np.uint64(finalized),
+        "slash_epoch": np.uint64(slash_epoch),
+        "tb": np.int64(tb), "adj": np.int64(adj),
+    }
+    statics = {
+        "do_rewards": bool(do_rewards), "leak": bool(leak), "incr": incr,
+        "max_eb": int(spec.MAX_EFFECTIVE_BALANCE),
+        "ejection": int(spec.config.EJECTION_BALANCE),
+        "hyst_q": int(spec.HYSTERESIS_QUOTIENT),
+        "hyst_down": int(spec.HYSTERESIS_DOWNWARD_MULTIPLIER),
+        "hyst_up": int(spec.HYSTERESIS_UPWARD_MULTIPLIER),
+    }
+    if altair_family:
+        family = "altair"
+        cols["part_prev"] = part_prev
+        cols["scores"] = np.fromiter(
+            (int(s) for s in state.inactivity_scores), np.int64, n)
+        scalars["base_per_incr"] = np.int64(
+            incr * int(spec.BASE_REWARD_FACTOR) // isqrt(tb))
+        scalars["bias"] = np.int64(int(spec.config.INACTIVITY_SCORE_BIAS))
+        scalars["recovery"] = np.int64(
+            int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE))
+        scalars["inact_denom"] = np.int64(
+            int(spec.config.INACTIVITY_SCORE_BIAS)
+            * int(spec.inactivity_penalty_quotient()))
+        statics["electra"] = electra
+        statics["wd"] = int(spec.WEIGHT_DENOMINATOR)
+        statics["target_flag"] = int(spec.TIMELY_TARGET_FLAG_INDEX)
+        statics["flags"] = tuple(
+            (i, int(w), i == int(spec.TIMELY_HEAD_FLAG_INDEX))
+            for i, w in enumerate(spec.PARTICIPATION_FLAG_WEIGHTS))
+    else:
+        family = "phase0"
+        if masks_prev is None:
+            src = np.zeros(n, bool)
+            tgt = np.zeros(n, bool)
+            head = np.zeros(n, bool)
+            best_key = np.full(n, _I64MAX, np.int64)
+            best_prop = np.zeros(n, np.int64)
+        else:
+            src, tgt, head, best_key, best_prop = masks_prev
+        cols.update(src=src, tgt=tgt, head=head,
+                    best_key=best_key, best_prop=best_prop)
+        scalars["sqrt_tb"] = np.int64(isqrt(tb))
+        scalars["finality_delay"] = np.int64(
+            int(spec.get_finality_delay(state)) if do_rewards else 1)
+        statics["brf"] = int(spec.BASE_REWARD_FACTOR)
+        statics["brpe"] = int(spec.BASE_REWARDS_PER_EPOCH)
+        statics["prop_q"] = int(spec.PROPOSER_REWARD_QUOTIENT)
+        statics["inact_q"] = int(spec.INACTIVITY_PENALTY_QUOTIENT)
+    return SweepInputs(family, n, cols, scalars,
+                       tuple(sorted(statics.items())))
 
 
-def registry_updates_pass(spec, state) -> None:
-    """Vectorized pre-electra process_registry_updates
-    (beacon-chain.md:1590): mask-based eligibility/ejection detection,
-    lexsort-based activation queue; only the (rare) mutating indices run
-    scalar spec calls so churn bookkeeping stays identical."""
-    arr = StateArrays(state)
-    cur = int(spec.get_current_epoch(state))
-    far = np.uint64(int(spec.FAR_FUTURE_EPOCH))
+# ---------------------------------------------------------------------------
+# the numpy twin: counted fallback AND differential-guard oracle
+# ---------------------------------------------------------------------------
 
-    # eligibility for the activation queue
-    elig_q = (arr.activation_eligibility == far) & (
-        arr.eff == int(spec.MAX_EFFECTIVE_BALANCE))
-    for i in np.nonzero(elig_q)[0]:
-        state.validators[int(i)].activation_eligibility_epoch = cur + 1
-        arr.activation_eligibility[i] = cur + 1
+def numpy_sweep(inp: SweepInputs):
+    """Exact lane math of the `ops.epoch_sweep` device program, in host
+    numpy, from the same SweepInputs — byte-identical by construction
+    (the fork-matrix differential tests pin device == numpy ==
+    `scalar_epoch()` post-state roots).  All integer math is int64 with
+    non-negative operands and non-zero divisors, so `//` agrees with
+    the device's floor division exactly."""
+    st = dict(inp.statics)
+    c = inp.cols
+    incr = st["incr"]
+    n = inp.n
+    cur = np.uint64(inp.scalars["cur"])
+    prev = np.uint64(inp.scalars["prev"])
+    finalized = np.uint64(inp.scalars["finalized"])
+    slash_epoch = np.uint64(inp.scalars["slash_epoch"])
+    tb = int(inp.scalars["tb"])
+    adj = int(inp.scalars["adj"])
+    eff = c["eff"]
+    slashed = c["slashed"]
+    activation = c["activation"]
+    exit_epoch = c["exit_epoch"]
+    far = np.uint64((1 << 64) - 1)
 
-    # ejections (sequential churn semantics via scalar initiate)
-    eject = arr.active(cur) & (
-        arr.eff <= int(spec.config.EJECTION_BALANCE))
-    for i in np.nonzero(eject)[0]:
-        spec.initiate_validator_exit(state, int(i))
+    active_prev = (activation <= prev) & (prev < exit_epoch)
+    active_cur = (activation <= cur) & (cur < exit_epoch)
+    eligible = active_prev | (
+        slashed & (np.uint64(int(prev) + 1) < c["withdrawable"]))
+    unsl = ~slashed
+    bal = c["balances"]
+    new_scores = None
 
-    # activation queue: finalized-eligibility, not yet activated
-    finalized = int(state.finalized_checkpoint.epoch)
-    ready = ((arr.activation_eligibility <= np.uint64(finalized))
-             & (arr.activation == far))
+    if inp.family == "phase0":
+        if st["do_rewards"]:
+            base = eff * st["brf"] // int(inp.scalars["sqrt_tb"]) \
+                // st["brpe"]
+            prop_reward = base // st["prop_q"]
+            rewards = np.zeros(n, np.int64)
+            penalties = np.zeros(n, np.int64)
+            for mask in (c["src"], c["tgt"], c["head"]):
+                m = mask & unsl
+                if st["leak"]:
+                    comp = base
+                else:
+                    att_bal = max(incr, int(eff[m].sum()))
+                    comp = base * (att_bal // incr) // (tb // incr)
+                rewards = rewards + np.where(eligible & m, comp, 0)
+                penalties = penalties + np.where(eligible & ~m, base, 0)
+            unsl_src = c["src"] & unsl
+            delays = c["best_key"] >> _ORDER_BITS
+            rewards = rewards + np.where(
+                unsl_src, (base - prop_reward) // delays, 0)
+            prop_gain = np.zeros(n, np.int64)
+            np.add.at(prop_gain, c["best_prop"],
+                      np.where(unsl_src, prop_reward, 0))
+            rewards = rewards + prop_gain
+            if st["leak"]:
+                unsl_tgt = c["tgt"] & unsl
+                penalties = penalties + np.where(
+                    eligible, st["brpe"] * base - prop_reward, 0)
+                penalties = penalties + np.where(
+                    eligible & ~unsl_tgt,
+                    eff * int(inp.scalars["finality_delay"])
+                    // st["inact_q"], 0)
+            bal = np.maximum(bal + rewards - penalties, 0)
+    else:
+        new_scores = c["scores"]
+        if st["do_rewards"]:
+            part_prev = c["part_prev"]
+            tflag = st["target_flag"]
+            tgt_unsl = (active_prev & (((part_prev >> tflag) & 1) == 1)
+                        & unsl)
+            bias = int(inp.scalars["bias"])
+            new_scores = np.where(
+                eligible & tgt_unsl,
+                new_scores - np.minimum(1, new_scores), new_scores)
+            new_scores = np.where(
+                eligible & ~tgt_unsl, new_scores + bias, new_scores)
+            if not st["leak"]:
+                rec = int(inp.scalars["recovery"])
+                new_scores = np.where(
+                    eligible, new_scores - np.minimum(rec, new_scores),
+                    new_scores)
+            active_incr = tb // incr
+            base = (eff // incr) * int(inp.scalars["base_per_incr"])
+            for flag_idx, weight, is_head in st["flags"]:
+                funsl = (active_prev
+                         & (((part_prev >> flag_idx) & 1) == 1) & unsl)
+                if st["leak"]:
+                    r = 0
+                else:
+                    part_incr = max(incr, int(eff[funsl].sum())) // incr
+                    r = np.where(
+                        eligible & funsl,
+                        base * weight * part_incr
+                        // (active_incr * st["wd"]), 0)
+                if is_head:
+                    p = 0
+                else:
+                    p = np.where(eligible & ~funsl,
+                                 base * weight // st["wd"], 0)
+                bal = np.maximum(bal + r - p, 0)
+            pen = eff * new_scores // int(inp.scalars["inact_denom"])
+            bal = np.maximum(
+                bal - np.where(eligible & ~tgt_unsl, pen, 0), 0)
+
+    # slashings
+    eff_incr = eff // incr
+    if st.get("electra"):
+        pen = eff_incr * (adj // (tb // incr))
+    else:
+        pen = eff_incr * adj // tb * incr
+    slash_mask = slashed & (c["withdrawable"] == slash_epoch)
+    bal = np.maximum(bal - np.where(slash_mask, pen, 0), 0)
+
+    # effective-balance hysteresis
+    h = incr // st["hyst_q"]
+    cond = ((bal + h * st["hyst_down"] < eff)
+            | (eff + h * st["hyst_up"] < bal))
+    new_eff = np.where(
+        cond, np.minimum(bal - bal % incr, c["max_eff"]), eff)
+
+    # registry-update eligibility masks
+    elig_q = (c["act_elig"] == far) & (eff == st["max_eb"])
+    eject = active_cur & (eff <= st["ejection"])
+    ready = (c["act_elig"] <= finalized) & (activation == far)
+
+    if new_scores is None:
+        return bal, new_eff, elig_q, eject, ready
+    return bal, new_scores, new_eff, elig_q, eject, ready
+
+
+# ---------------------------------------------------------------------------
+# writeback + registry application (the rare scalar mutations)
+# ---------------------------------------------------------------------------
+
+def _bulk_write(view, old: np.ndarray, new: np.ndarray) -> int:
+    """ONE Python-level writeback call for a whole mutated column: the
+    changed-index vector + packed values go through
+    `incremental.bulk_set_basic`, which marks the dirty merkle cone in
+    one pass.  Returns the element count (epoch_writeback_elems)."""
+    changed = np.nonzero(new != old)[0]
+    if changed.size:
+        from ..ssz import incremental
+        incremental.bulk_set_basic(view, changed, new[changed])
+    return int(changed.size)
+
+
+def _apply_registry(spec, state, cur, arr, elig_q, eject, ready) -> None:
+    """Pre-electra process_registry_updates from the sweep's masks
+    (beacon-chain.md:1590): only the (rare) mutating indices run scalar
+    spec calls so churn bookkeeping stays identical."""
+    for i in np.nonzero(elig_q)[0].tolist():
+        state.validators[i].activation_eligibility_epoch = cur + 1
+    for i in np.nonzero(eject)[0].tolist():
+        spec.initiate_validator_exit(state, i)
+    # activation queue: the sweep's `ready` mask is computed from the
+    # PRE-update eligibility epochs, which is exact — newly eligible
+    # validators get epoch cur+1 > finalized and can never be ready in
+    # the same epoch
     idx = np.nonzero(ready)[0]
     order = np.lexsort((idx, arr.activation_eligibility[idx]))
     churn = int(spec.get_validator_churn_limit(state))
     target_epoch = int(spec.compute_activation_exit_epoch(cur))
-    for i in idx[order][:churn]:
-        state.validators[int(i)].activation_epoch = target_epoch
+    for i in idx[order][:churn].tolist():
+        state.validators[i].activation_epoch = target_epoch
+
+
+def _fallback_reason() -> str:
+    from ..resilience import supervisor
+    sup = supervisor.active()
+    if sup is None:
+        return "unsupervised"
+    if sup.forced_scalar:
+        return "disabled"
+    state = sup.breaker_state(SWEEP_SITE)
+    if state == supervisor.QUARANTINED:
+        return "quarantined"
+    if state == supervisor.OPEN:
+        return "breaker_open"
+    return "dispatch_failed"
+
+
+# ---------------------------------------------------------------------------
+# the orchestrator: ONE dispatch per process_epoch
+# ---------------------------------------------------------------------------
+
+def fused_epoch(spec, state) -> bool:
+    """Run the fused head of `process_epoch` — justification through the
+    effective-balance update (electra: including the scalar registry +
+    pending-deposit/consolidation queues at their reference positions) —
+    with exactly ONE `ops.epoch_sweep` dispatch.  Returns True when it
+    handled that prefix (the caller then runs only the tail resets);
+    returns False — before mutating anything — when the engine is
+    disabled, so the caller falls through to the reference-shaped scalar
+    pass list."""
+    if not ENABLED:
+        return False
+    n = len(state.validators)
+    if n == 0:
+        return False
+    from ..sigpipe.metrics import METRICS
+
+    altair_family = bool(spec.is_post("altair"))
+    electra = bool(spec.is_post("electra"))
+    cur = int(spec.get_current_epoch(state))
+    prev = int(spec.get_previous_epoch(state))
+    genesis = int(spec.GENESIS_EPOCH)
+    do_rewards = cur != genesis
+    incr = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    arr = StateArrays(state)
+    tb = arr.total_active_balance(cur, incr)
+
+    # -- host prefix: justification (checkpoint/bit mutations only) ----
+    part_prev = None
+    masks_prev = None
+    if altair_family:
+        part_prev = _participation(state, "previous", n)
+    elif do_rewards:
+        masks_prev = phase0_attestation_masks(spec, state, prev)
+    if cur > genesis + 1:
+        if altair_family:
+            tflag = int(spec.TIMELY_TARGET_FLAG_INDEX)
+            prev_m = (arr.active(prev)
+                      & (((part_prev >> tflag) & 1) == 1) & ~arr.slashed)
+            part_cur = _participation(state, "current", n)
+            cur_m = (arr.active(cur)
+                     & (((part_cur >> tflag) & 1) == 1) & ~arr.slashed)
+        else:
+            prev_m = masks_prev[1] & ~arr.slashed
+            cur_m = phase0_attestation_masks(
+                spec, state, cur, targets_only=True)[1] & ~arr.slashed
+        spec.weigh_justification_and_finalization(
+            state, tb,
+            max(incr, int(arr.eff[prev_m].sum())),
+            max(incr, int(arr.eff[cur_m].sum())))
+
+    # leak/finality/finalized all read the POST-justification state
+    leak = bool(spec.is_in_inactivity_leak(state)) if do_rewards else False
+    inp = _collect(spec, state, arr, part_prev, masks_prev,
+                   do_rewards, leak, tb, cur, prev)
+
+    # -- the ONE dispatch ----------------------------------------------
+    from ..resilience import supervisor
+
+    used_fallback = False
+
+    def _device():
+        from ..ops import epoch_sweep
+        return epoch_sweep.run_sweep(inp)
+
+    def _numpy_fallback():
+        nonlocal used_fallback
+        used_fallback = True
+        METRICS.inc_labeled("epoch_sweep_fallbacks", _fallback_reason())
+        return numpy_sweep(inp)
+
+    METRICS.inc("epoch_sweep_dispatches")
+    out = supervisor.dispatch(SWEEP_SITE, _device, _numpy_fallback)
+
+    # -- differential guard: sampled, device output only, pre-writeback
+    if not used_fallback and _GUARD_RNG.random() < _GUARD_RATE:
+        METRICS.inc("epoch_guard_samples")
+        oracle = numpy_sweep(inp)
+        if not all(np.array_equal(a, b) for a, b in zip(out, oracle)):
+            METRICS.inc("epoch_guard_mismatches")
+            from ..resilience.incidents import INCIDENTS
+            INCIDENTS.record(SWEEP_SITE, "guard_mismatch",
+                             detail="sweep lanes != numpy oracle")
+            sup = supervisor.active()
+            if sup is not None:
+                sup.quarantine(SWEEP_SITE, "guard_mismatch")
+            out = oracle
+
+    if altair_family:
+        new_bal, new_scores, new_eff, elig_q, eject, ready = out
+    else:
+        new_bal, new_eff, elig_q, eject, ready = out
+        new_scores = None
+
+    # -- batched writeback + the rare scalar mutations ------------------
+    wb = 0
+    if new_scores is not None:
+        wb += _bulk_write(state.inactivity_scores,
+                          inp.cols["scores"], new_scores)
+    wb += _bulk_write(state.balances, arr.balances, new_bal)
+
+    if not electra:
+        _apply_registry(spec, state, cur, arr, elig_q, eject, ready)
+        changed = np.nonzero(new_eff != arr.eff)[0]
+        for i in changed.tolist():
+            state.validators[i].effective_balance = int(new_eff[i])
+        wb += int(changed.size)
+    else:
+        # electra's single-pass registry and its deposit/consolidation
+        # queues stay scalar spec calls at their reference positions;
+        # they read effective balances (untouched so far) and may move
+        # balances or append validators — the sweep's hysteresis lanes
+        # stay valid exactly for the untouched validators
+        spec.process_registry_updates(state)
+        spec.process_pending_deposits(state)
+        spec.process_pending_consolidations(state)
+        n2 = len(state.validators)
+        bal_after = np.fromiter(
+            (int(b) for b in state.balances), np.int64, n2)
+        moved = np.ones(n2, bool)
+        moved[:n] = bal_after[:n] != new_bal
+        untouched = np.nonzero(~moved[:n] & (new_eff != arr.eff))[0]
+        for i in untouched.tolist():
+            state.validators[i].effective_balance = int(new_eff[i])
+        wb += int(untouched.size)
+        h = incr // int(spec.HYSTERESIS_QUOTIENT)
+        down = h * int(spec.HYSTERESIS_DOWNWARD_MULTIPLIER)
+        up = h * int(spec.HYSTERESIS_UPWARD_MULTIPLIER)
+        comp_prefix = int.from_bytes(
+            bytes(spec.COMPOUNDING_WITHDRAWAL_PREFIX), "big")
+        for i in np.nonzero(moved)[0].tolist():
+            v = state.validators[i]
+            bal_i = int(bal_after[i])
+            eff_i = int(v.effective_balance)
+            if bal_i + down < eff_i or eff_i + up < bal_i:
+                max_eb = (int(spec.MAX_EFFECTIVE_BALANCE_ELECTRA)
+                          if v.withdrawal_credentials[0] == comp_prefix
+                          else int(spec.MIN_ACTIVATION_BALANCE))
+                v.effective_balance = min(bal_i - bal_i % incr, max_eb)
+
+    METRICS.inc("epoch_writeback_elems", wb)
+    return True

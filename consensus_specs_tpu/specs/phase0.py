@@ -692,6 +692,18 @@ class Phase0Spec(Phase0ForkChoice, Phase0ValidatorDuties, BaseSpec):
     # epoch processing (beacon-chain.md "Epoch processing")
     # ------------------------------------------------------------------
     def process_epoch(self, state) -> None:
+        from . import epoch_fast
+        if epoch_fast.fused_epoch(self, state):
+            # the fused ONE-dispatch sweep handled justification through
+            # the effective-balance update; only the cheap tail resets
+            # remain (eth1_data_reset commutes past the sweep: it clears
+            # vote bookkeeping no fused pass reads or writes)
+            self.process_eth1_data_reset(state)
+            self.process_slashings_reset(state)
+            self.process_randao_mixes_reset(state)
+            self.process_historical_roots_update(state)
+            self.process_participation_record_updates(state)
+            return
         self.process_justification_and_finalization(state)
         self.process_rewards_and_penalties(state)
         self.process_registry_updates(state)
@@ -733,14 +745,6 @@ class Phase0Spec(Phase0ForkChoice, Phase0ValidatorDuties, BaseSpec):
     def process_justification_and_finalization(self, state) -> None:
         # no processing within the first two epochs
         if self.get_current_epoch(state) <= self.GENESIS_EPOCH + 1:
-            return
-        from . import epoch_fast
-        if epoch_fast.ENABLED:
-            arr = epoch_fast.StateArrays(state)
-            total, prev_bal, cur_bal = epoch_fast.phase0_target_balances(
-                self, state, arr)
-            self.weigh_justification_and_finalization(
-                state, uint64(total), uint64(prev_bal), uint64(cur_bal))
             return
         previous_attestations = self.get_matching_target_attestations(
             state, self.get_previous_epoch(state))
@@ -925,12 +929,6 @@ class Phase0Spec(Phase0ForkChoice, Phase0ValidatorDuties, BaseSpec):
         # no rewards in GENESIS_EPOCH (no previous epoch to attest to)
         if self.get_current_epoch(state) == self.GENESIS_EPOCH:
             return
-        from . import epoch_fast
-        if epoch_fast.ENABLED:
-            arr, rewards, penalties = epoch_fast.phase0_attestation_deltas(
-                self, state)
-            epoch_fast.apply_delta_sets(state, arr, [(rewards, penalties)])
-            return
         rewards, penalties = self.get_attestation_deltas(state)
         for index in range(len(state.validators)):
             self.increase_balance(state, index, rewards[index])
@@ -938,10 +936,6 @@ class Phase0Spec(Phase0ForkChoice, Phase0ValidatorDuties, BaseSpec):
 
     # -- registry & leftovers
     def process_registry_updates(self, state) -> None:
-        from . import epoch_fast
-        if epoch_fast.ENABLED:
-            epoch_fast.registry_updates_pass(self, state)
-            return
         # eligibility and ejections
         for index, validator in enumerate(state.validators):
             if self.is_eligible_for_activation_queue(validator):
@@ -966,10 +960,6 @@ class Phase0Spec(Phase0ForkChoice, Phase0ValidatorDuties, BaseSpec):
                 self.get_current_epoch(state))
 
     def process_slashings(self, state) -> None:
-        from . import epoch_fast
-        if epoch_fast.ENABLED:
-            epoch_fast.slashings_pass(self, state)
-            return
         epoch = self.get_current_epoch(state)
         total_balance = self.get_total_active_balance(state)
         adjusted_total_slashing_balance = min(
@@ -995,10 +985,6 @@ class Phase0Spec(Phase0ForkChoice, Phase0ValidatorDuties, BaseSpec):
             state.eth1_data_votes = type(state.eth1_data_votes)()
 
     def process_effective_balance_updates(self, state) -> None:
-        from . import epoch_fast
-        if epoch_fast.ENABLED:
-            epoch_fast.effective_balance_updates_pass(self, state)
-            return
         for index, validator in enumerate(state.validators):
             balance = state.balances[index]
             hysteresis_increment = uint64(

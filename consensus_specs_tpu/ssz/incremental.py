@@ -155,6 +155,47 @@ def quarantine_caches(reason: str = "guard_mismatch") -> None:
         sup.quarantine(SWEEP_SITE, reason=reason)
 
 
+def bulk_set_basic(view, indices, values) -> int:
+    """Batched element assignment on a basic-element sequence view: ONE
+    Python-level writeback call replaces len(indices) `__setitem__`
+    round trips (the fused epoch engine's balances / inactivity-scores
+    columns — a mainnet everyone's-balance-changed epoch is one call,
+    not 1M).  Semantically identical to the per-element path: values are
+    coerced through `ELEM_TYPE.coerce_assign`, and when the view is
+    tracked every touched leaf chunk is marked dirty (the whole cone in
+    one pass), so the next re-root stays the O(dirty) fused sweep.
+
+    `indices` / `values` are parallel sequences (numpy arrays welcome);
+    indices must be in-range and non-negative.  Returns the element
+    count written."""
+    t = view.ELEM_TYPE
+    if not isinstance(view, _Sequence) or not is_basic_type(t):
+        raise TypeError(
+            f"bulk_set_basic needs a basic-element sequence view, "
+            f"got {type(view).__name__}")
+    idx = [int(i) for i in
+           (indices.tolist() if hasattr(indices, "tolist") else indices)]
+    vals = (values.tolist() if hasattr(values, "tolist")
+            else list(values))
+    if len(idx) != len(vals):
+        raise ValueError(
+            f"{len(idx)} indices vs {len(vals)} values")
+    if not idx:
+        return 0
+    elems = view._elems
+    n = len(elems)
+    if min(idx) < 0 or max(idx) >= n:
+        raise IndexError(f"bulk index outside [0, {n})")
+    coerce = t.coerce_assign
+    for i, v in zip(idx, vals):
+        elems[i] = coerce(v)
+    if _types._inc_mut is not None and _cache_of(view) is not None:
+        esz = t.type_byte_length()
+        for ci in {(i * esz) // 32 for i in idx}:
+            _mark(view, ci)
+    return len(idx)
+
+
 def type_tree_height(typ) -> int:
     """Static height of the padded merkle tree of `typ` =
     ceil(log2(total padded chunk capacity)): the upper bound on sweep

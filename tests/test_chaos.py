@@ -181,9 +181,13 @@ def test_chaos_merkle_sweep_matrix(spec, workload, kind):
 # sharded verify seams a native-backend replay actually crosses — the
 # shard matrix derives from the registry's sharded flag intersected
 # with the replay tier (ops.pairing_product is tpu-backend-only and
-# covered by its kernel-tier suite instead)
+# covered by its kernel-tier suite instead; ops.epoch_sweep only
+# dispatches at an epoch boundary, which the block-replay workload
+# never crosses — its shard_dead case runs in the dedicated
+# epoch-boundary matrix below)
 SHARD_SITES = tuple(s for s in sites.sharded_sites()
-                    if s in sites.chaos_replay_sites())
+                    if s in sites.chaos_replay_sites()
+                    and s != "ops.epoch_sweep")
 
 
 @pytest.mark.parametrize("site", SHARD_SITES)
@@ -207,6 +211,80 @@ def test_chaos_shard_dead_matrix(spec, workload, site):
     assert snapshot["breaker_trips"] >= 1
     assert snapshot["scalar_fallbacks"]["breaker_open"] >= 1
     assert resilience.report()["breakers"][site] == resilience.OPEN
+
+
+# ---------------------------------------------------------------------------
+# epoch-boundary chaos: the fused ops.epoch_sweep seam
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def epoch_workload(spec):
+    """(pre_state, boundary_slot, scalar_root): a participation-rich
+    state one slot short of an epoch boundary — with a slashed validator
+    in the correlated-penalty window so the slashings lane fires — plus
+    the reference scalar-engine baseline root after crossing it."""
+    from consensus_specs_tpu.specs import epoch_fast
+    state = create_genesis_state(spec, default_balances(spec))
+    spe = int(spec.SLOTS_PER_EPOCH)
+    spec.process_slots(state, uint64(2 * spe - 1))
+    for i in range(len(state.validators)):
+        state.previous_epoch_participation[i] = 0b111 if i % 2 else 0b001
+        state.current_epoch_participation[i] = 0b111 if i % 3 else 0
+    epoch = int(spec.get_current_epoch(state))
+    state.validators[3].slashed = True
+    state.validators[3].withdrawable_epoch = uint64(
+        epoch + int(spec.EPOCHS_PER_SLASHINGS_VECTOR) // 2)
+    state.slashings[epoch % int(spec.EPOCHS_PER_SLASHINGS_VECTOR)] = \
+        uint64(10**9)
+    scalar_state = state.copy()
+    with epoch_fast.scalar_epoch():
+        spec.process_slots(scalar_state, uint64(2 * spe))
+    return state, uint64(2 * spe), hash_tree_root(scalar_state)
+
+
+@pytest.mark.parametrize("kind",
+                         ["raise", "timeout", "corrupt", "shard_dead"])
+def test_chaos_epoch_sweep_matrix(spec, epoch_workload, kind):
+    """Persistent faults at the fused epoch dispatch: raise / timeout /
+    shard_dead trip the breaker to the counted numpy fallback, a
+    silently corrupted lane is caught by the sampled differential guard
+    (site quarantined, oracle lanes written back) — and the post-state
+    root always equals the reference scalar engine's."""
+    from consensus_specs_tpu.specs import epoch_fast
+    pre_state, boundary, scalar_root = epoch_workload
+    resilience.enable(max_retries=1, breaker_threshold=1, probe_after=2,
+                      deadline_s=0.05 if kind == "timeout" else None,
+                      guard_sample_rate=1.0, guard_seed=CHAOS_SEED)
+    epoch_fast.set_guard(1.0, CHAOS_SEED)
+    incremental.enable(guard_sample_rate=1.0, guard_seed=CHAOS_SEED)
+    plan = FaultPlan(
+        [FaultSpec("ops.epoch_sweep", kind, persistent=True,
+                   sleep_s=0.2)],
+        seed=CHAOS_SEED)
+    chaos_state = pre_state.copy()
+    try:
+        with faults.inject(plan):
+            spec.process_slots(chaos_state, boundary)
+    finally:
+        epoch_fast.set_guard(0.0)
+        incremental.disable()
+    assert hash_tree_root(chaos_state) == scalar_root
+    assert plan.total_fires() > 0
+    snapshot = METRICS.snapshot()
+    assert INCIDENTS.count(event="injected") == plan.total_fires()
+    assert snapshot["epoch_sweep_dispatches"] >= 1
+    breakers = resilience.report()["breakers"]
+    if kind == "corrupt":
+        # the fault is silent: only the lane guard can catch it
+        assert snapshot["epoch_guard_mismatches"] >= 1
+        assert breakers["ops.epoch_sweep"] == resilience.QUARANTINED
+    else:
+        # loud faults: breaker open, fallback counted under its reason
+        assert snapshot["epoch_sweep_fallbacks"]["breaker_open"] >= 1
+        assert breakers["ops.epoch_sweep"] == resilience.OPEN
+        if kind == "shard_dead":
+            assert INCIDENTS.count(
+                event="shard_dead", site="ops.epoch_sweep") >= 1
 
 
 def test_chaos_breaker_recovery_across_blocks(spec, workload):

@@ -1,0 +1,180 @@
+"""Fork-matrix pins for the fused `ops.epoch_sweep` seam.
+
+Three engines must agree byte-for-byte on the post-state root across
+the fork matrix (phase0 / altair / electra, leaking and non-leaking,
+with slashed / ejectable / pending-activation edge validators):
+
+  * device  — the fused jitted program (one dispatch per epoch);
+  * numpy   — the counted scalar fallback (`numpy_sweep`), reached here
+              through the supervisor's force_scalar kill switch so the
+              fallback COUNTER is pinned too;
+  * scalar  — the reference-shaped per-validator pass list behind the
+              `scalar_epoch()` escape hatch.
+
+Also pinned: exactly ONE `epoch_sweep_dispatches` per process_epoch,
+O(1) Python-level writeback calls (`ssz.incremental.bulk_set_basic`),
+and the bulk-leaf API's dirty-cone marking under the incremental
+merkle cache.
+"""
+import numpy as np
+import pytest
+
+from consensus_specs_tpu import resilience
+from consensus_specs_tpu.sigpipe import METRICS
+from consensus_specs_tpu.specs import get_spec, epoch_fast
+from consensus_specs_tpu.ssz import (
+    hash_tree_root, incremental, uint64)
+from consensus_specs_tpu.test_infra import disable_bls
+from consensus_specs_tpu.test_infra.genesis import (
+    build_mock_validator, create_genesis_state, default_balances)
+from consensus_specs_tpu.test_infra.blocks import next_epoch
+from consensus_specs_tpu.test_infra.attestations import (
+    next_epoch_with_attestations)
+
+FORKS = ("phase0", "altair", "electra")
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    resilience.disable()
+    METRICS.reset()
+    yield
+    resilience.disable()
+
+
+def _edge_state(spec):
+    """Live attestations/participation plus the registry edge cases:
+    a slashed validator inside the correlated-penalty window, an
+    ejectable validator, an exited validator, and a fresh one headed
+    for the activation queue."""
+    state = create_genesis_state(spec, default_balances(spec))
+    next_epoch(spec, state)
+    _, state = next_epoch_with_attestations(spec, state, True, False)
+    _, state = next_epoch_with_attestations(spec, state, True, True)
+    epoch = int(spec.get_current_epoch(state))
+    v = state.validators[3]
+    v.slashed = True
+    v.withdrawable_epoch = uint64(
+        epoch + int(spec.EPOCHS_PER_SLASHINGS_VECTOR) // 2)
+    state.slashings[epoch % int(spec.EPOCHS_PER_SLASHINGS_VECTOR)] = \
+        uint64(10**9)
+    state.validators[5].effective_balance = uint64(
+        spec.config.EJECTION_BALANCE)
+    state.validators[7].exit_epoch = uint64(max(epoch, 1))
+    state.validators[7].withdrawable_epoch = uint64(epoch + 2)
+    fresh = build_mock_validator(
+        spec, len(state.validators), spec.MAX_EFFECTIVE_BALANCE)
+    state.validators.append(fresh)
+    state.balances.append(spec.MAX_EFFECTIVE_BALANCE)
+    if spec.is_post("altair"):
+        state.previous_epoch_participation.append(0)
+        state.current_epoch_participation.append(0)
+        state.inactivity_scores.append(0)
+    return state
+
+
+def _leak_state(spec):
+    """Finality delay past MIN_EPOCHS_TO_INACTIVITY_PENALTY: the leak
+    formulas (and altair's score growth) are live."""
+    state = create_genesis_state(spec, default_balances(spec))
+    for _ in range(int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 3):
+        next_epoch(spec, state)
+    _, state = next_epoch_with_attestations(spec, state, True, False)
+    assert spec.is_in_inactivity_leak(state)
+    return state
+
+
+@pytest.mark.parametrize("fork", FORKS)
+@pytest.mark.parametrize("leaking", [False, True],
+                         ids=["finalizing", "leaking"])
+def test_device_numpy_scalar_roots_identical(fork, leaking):
+    spec = get_spec(fork, "minimal")
+    with disable_bls():
+        state = _leak_state(spec) if leaking else _edge_state(spec)
+        device_state = state.copy()
+        numpy_state = state.copy()
+        scalar_state = state.copy()
+
+        METRICS.reset()
+        spec.process_epoch(device_state)
+        # exactly ONE fused dispatch per process_epoch
+        assert METRICS.snapshot()["epoch_sweep_dispatches"] == 1
+
+        resilience.enable()
+        resilience.force_scalar(True)
+        spec.process_epoch(numpy_state)
+        # the numpy twin ran as the COUNTED fallback, reason `disabled`
+        assert METRICS.count_labeled(
+            "epoch_sweep_fallbacks", "disabled") == 1
+        resilience.disable()
+
+        with epoch_fast.scalar_epoch():
+            spec.process_epoch(scalar_state)
+
+    scalar_root = hash_tree_root(scalar_state)
+    assert hash_tree_root(device_state) == scalar_root
+    assert hash_tree_root(numpy_state) == scalar_root
+
+
+def test_scalar_epoch_restores_reference_shape():
+    """Inside `scalar_epoch()` the seam is never dispatched — the
+    reference-shaped pass list runs instead."""
+    spec = get_spec("altair", "minimal")
+    with disable_bls():
+        state = _edge_state(spec)
+        METRICS.reset()
+        with epoch_fast.scalar_epoch():
+            spec.process_epoch(state)
+    assert METRICS.snapshot().get("epoch_sweep_dispatches") is None
+
+
+def test_writeback_is_bulk(monkeypatch):
+    """The everyone-moved columns (balances, inactivity scores) write
+    back in O(1) Python-level calls — one `bulk_set_basic` per mutated
+    column, with the element count in the metrics."""
+    spec = get_spec("altair", "minimal")
+    with disable_bls():
+        state = _edge_state(spec)
+        calls = []
+        orig = incremental.bulk_set_basic
+
+        def counting(view, idx, vals):
+            calls.append(len(idx))
+            return orig(view, idx, vals)
+
+        monkeypatch.setattr(incremental, "bulk_set_basic", counting)
+        METRICS.reset()
+        spec.process_epoch(state)
+    assert 1 <= len(calls) <= 2       # balances + (maybe) scores
+    assert METRICS.snapshot()["epoch_writeback_elems"] >= sum(calls)
+
+
+def test_bulk_set_basic_marks_dirty_cone():
+    """Bulk writes under the incremental merkle cache re-root to the
+    same digest a from-scratch merkleization produces."""
+    spec = get_spec("altair", "minimal")
+    state = create_genesis_state(spec, default_balances(spec))
+    incremental.enable()
+    try:
+        hash_tree_root(state)       # prime the cache
+        n = len(state.balances)
+        idx = np.asarray([0, 1, n - 1], np.int64)
+        vals = np.asarray([7, 11, 13], np.int64)
+        assert incremental.bulk_set_basic(state.balances, idx, vals) == 3
+        cached = hash_tree_root(state)
+    finally:
+        incremental.disable()
+    assert [int(state.balances[i]) for i in (0, 1, n - 1)] == [7, 11, 13]
+    assert cached == hash_tree_root(state)
+
+
+def test_bulk_set_basic_rejects_bad_input():
+    spec = get_spec("altair", "minimal")
+    state = create_genesis_state(spec, default_balances(spec))
+    with pytest.raises(TypeError):
+        incremental.bulk_set_basic(state.validators, [0], [0])
+    with pytest.raises(ValueError):
+        incremental.bulk_set_basic(state.balances, [0, 1], [5])
+    with pytest.raises(IndexError):
+        incremental.bulk_set_basic(
+            state.balances, [len(state.balances)], [5])

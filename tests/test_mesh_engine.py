@@ -1,15 +1,18 @@
 """CPU-mesh coverage of the PRODUCTION mesh engine
-(parallel/mesh_engine.py): the sharded subtree merkleization and the
-sharded altair flag passes must be byte-identical to the host engine on
-an 8-virtual-device mesh (conftest forces
-jax_num_cpu_devices=8).  This is the default-suite counterpart of the
-driver's dryrun_multichip."""
+(parallel/mesh_engine.py) and the mesh-sharded epoch sweep: sharded
+subtree merkleization must be byte-identical to the host engine, and
+the fused `ops.epoch_sweep` dispatch must produce identical post-state
+roots whether its validator axis is partitioned over the 8-virtual-
+device mesh (conftest forces jax_num_cpu_devices=8) or runs on one
+device.  This is the default-suite counterpart of the driver's
+dryrun_multichip."""
 import numpy as np
 import pytest
 
 from consensus_specs_tpu.parallel import get_mesh, device_count
-from consensus_specs_tpu.parallel import mesh_engine
-from consensus_specs_tpu.specs import get_spec, epoch_fast
+from consensus_specs_tpu.parallel import mesh_engine, shard_verify
+from consensus_specs_tpu.sigpipe.metrics import METRICS
+from consensus_specs_tpu.specs import get_spec
 from consensus_specs_tpu.ssz import hash_tree_root, merkle
 from consensus_specs_tpu.test_infra.context import DEFAULT_TEST_PRESET
 from consensus_specs_tpu.test_infra.genesis import (
@@ -41,7 +44,11 @@ def test_sharded_subtree_merkleization_is_byte_identical(engine):
         assert sharded == host, count
 
 
-def test_sharded_flag_passes_match_host_engine(engine):
+def test_epoch_sweep_sharded_over_mesh_same_root():
+    """The fused epoch dispatch with its validator axis partitioned
+    over the 8-device verify mesh is byte-identical to the same sweep
+    on one device — and the sharded run is visible in the
+    `sharded_dispatches` metric under its seam name."""
     spec = get_spec("altair", DEFAULT_TEST_PRESET)
     state = create_genesis_state(spec, default_balances(spec))
     next_epoch(spec, state)
@@ -49,16 +56,21 @@ def test_sharded_flag_passes_match_host_engine(engine):
     for i in range(len(state.validators)):
         state.previous_epoch_participation[i] = (
             0b111 if i % 3 == 0 else (0b001 if i % 3 == 1 else 0))
-    state_host = state.copy()
+    mesh_state = state.copy()
+    single_state = state.copy()
 
-    arr_mesh, sets_mesh = epoch_fast.altair_delta_sets(spec, state)
-    engine.disable()
-    arr_host, sets_host = epoch_fast.altair_delta_sets(spec, state_host)
-    engine.enable()
-    assert len(sets_mesh) == len(sets_host)
-    for (rm, pm), (rh, ph) in zip(sets_mesh, sets_host):
-        np.testing.assert_array_equal(np.asarray(rm), np.asarray(rh))
-        np.testing.assert_array_equal(np.asarray(pm), np.asarray(ph))
+    shard_verify.configure(None)        # full 8-device mesh
+    try:
+        before = METRICS.count_labeled(
+            "sharded_dispatches", "ops.epoch_sweep")
+        spec.process_epoch(mesh_state)
+        assert METRICS.count_labeled(
+            "sharded_dispatches", "ops.epoch_sweep") == before + 1
+        shard_verify.configure(max_devices=1)
+        spec.process_epoch(single_state)
+    finally:
+        shard_verify.configure(None)
+    assert hash_tree_root(mesh_state) == hash_tree_root(single_state)
 
 
 def test_full_epoch_under_mesh_engine_same_root(engine):
@@ -152,8 +164,9 @@ def single_engine():
 
 
 def test_single_device_epoch_same_root(single_engine):
-    """The 1-device mesh runs the SAME compiled flag/slashing programs;
-    a full epoch must stay byte-identical to the host engine."""
+    """A full epoch under the 1-device engine (sharded merkle hook
+    live, epoch sweep on one device) stays byte-identical to the host
+    engine with every hook uninstalled."""
     spec = get_spec("altair", DEFAULT_TEST_PRESET)
     state = create_genesis_state(spec, default_balances(spec))
     next_epoch(spec, state)
@@ -185,34 +198,26 @@ def _slashed_state(spec):
 
 
 @pytest.mark.parametrize("fork", ["altair", "electra"])
-def test_sharded_slashings_match_host_engine(single_engine, fork):
+def test_sharded_slashings_lane_on_mesh_same_root(fork):
     """Both slashing-penalty forms (pre-electra and the increment-
-    factored electra form) through the compiled sweep."""
+    factored electra form) inside the fused sweep, with the validator
+    axis mesh-sharded vs single-device: identical balances and roots,
+    and the penalties actually fired."""
     spec = get_spec(fork, DEFAULT_TEST_PRESET)
     state = _slashed_state(spec)
-    dev_state = state.copy()
-    host_state = state.copy()
+    mesh_state = state.copy()
+    single_state = state.copy()
 
-    assert epoch_fast.slashings_pass(spec, dev_state)
-    single_engine.disable()
-    assert epoch_fast.slashings_pass(spec, host_state)
-    single_engine.enable()
-    assert [int(b) for b in dev_state.balances] \
-        == [int(b) for b in host_state.balances]
-    # penalties actually fired (the sweep wasn't a no-op)
+    shard_verify.configure(None)
+    try:
+        spec.process_epoch(mesh_state)
+        shard_verify.configure(max_devices=1)
+        spec.process_epoch(single_state)
+    finally:
+        shard_verify.configure(None)
+    assert [int(b) for b in mesh_state.balances] \
+        == [int(b) for b in single_state.balances]
+    # penalties actually fired (the slashings lane wasn't a no-op)
     assert any(int(a) != int(b) for a, b in
-               zip(dev_state.balances, state.balances))
-
-
-def test_sharded_slashings_match_on_mesh(engine):
-    """Same sweep on the multi-device mesh: psums and padding lanes."""
-    spec = get_spec("altair", DEFAULT_TEST_PRESET)
-    state = _slashed_state(spec)
-    dev_state = state.copy()
-    host_state = state.copy()
-    assert epoch_fast.slashings_pass(spec, dev_state)
-    engine.disable()
-    assert epoch_fast.slashings_pass(spec, host_state)
-    engine.enable()
-    assert [int(b) for b in dev_state.balances] \
-        == [int(b) for b in host_state.balances]
+               zip(mesh_state.balances, state.balances))
+    assert hash_tree_root(mesh_state) == hash_tree_root(single_state)

@@ -378,6 +378,66 @@ def test_factoryseam_repo_is_clean():
     assert repo_findings == []
 
 
+def test_epochseam_flags_device_import_and_internal_surface(tmp_path):
+    """Package code importing the fused epoch device program, from-
+    importing an epoch_fast internal, or touching one through the
+    module alias runs epoch math off the registered ops.epoch_sweep
+    seam — the epochseam pass flags all three shapes."""
+    path = tmp_path / "fixture.py"
+    path.write_text(textwrap.dedent("""\
+        from consensus_specs_tpu.ops import epoch_sweep
+        from consensus_specs_tpu.specs.epoch_fast import numpy_sweep
+        from consensus_specs_tpu.specs import epoch_fast
+
+        def sneaky(state):
+            arr = epoch_fast.StateArrays(state)
+            return epoch_sweep.run_sweep(numpy_sweep(arr))
+    """))
+    findings = run_speclint(REPO_ROOT, [path], passes=["epochseam"])
+    assert rules_of(findings) == ["epoch-scalar-bypass"] * 3
+    assert [f.line for f in findings] == [1, 2, 6]
+    assert "epoch_fast.StateArrays" in findings[2].message
+
+
+def test_epochseam_allows_public_surface(tmp_path):
+    """The wrapper's public surface (the seam entry point and the
+    escape hatches) lints clean."""
+    path = tmp_path / "fixture.py"
+    path.write_text(textwrap.dedent("""\
+        from consensus_specs_tpu.specs import epoch_fast
+        from consensus_specs_tpu.specs.epoch_fast import scalar_epoch
+
+        def fine(spec, state):
+            epoch_fast.set_guard(0.01, seed=7)
+            with scalar_epoch():
+                pass
+            return epoch_fast.fused_epoch(spec, state)
+    """))
+    findings = run_speclint(REPO_ROOT, [path], passes=["epochseam"])
+    assert findings == []
+
+
+def test_epochseam_disable_suppresses(tmp_path):
+    path = tmp_path / "fixture.py"
+    path.write_text(textwrap.dedent("""\
+        from consensus_specs_tpu.specs import epoch_fast
+
+        def deliberate(inp):
+            # speclint: disable=epoch-scalar-bypass -- fixture reason
+            return epoch_fast.numpy_sweep(inp)
+    """))
+    findings = run_speclint(REPO_ROOT, [path], passes=["epochseam"])
+    assert findings == []
+
+
+def test_epochseam_repo_is_clean():
+    """The live package honours the epoch gate: every epoch array pass
+    reaches the device only through the registered seam."""
+    repo_findings = [f for f in run_speclint(REPO_ROOT)
+                     if f.rule == "epoch-scalar-bypass"]
+    assert repo_findings == []
+
+
 # ---------------------------------------------------------------------------
 # concurrency passes: lock discipline, lock order, thread escape
 # ---------------------------------------------------------------------------
@@ -705,7 +765,7 @@ def test_pass_filter_and_names():
     assert names == ("seams", "bypass", "determinism", "globals",
                      "txnpurity", "hostsync", "lock-discipline",
                      "lock-order", "thread-escape", "foldgate",
-                     "factoryseam", "nodeseam")
+                     "factoryseam", "nodeseam", "epochseam")
     # a filtered run executes only the named pass
     findings = run_speclint(REPO_ROOT, passes=["lock-order"])
     assert findings == []
